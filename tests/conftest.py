@@ -1,0 +1,28 @@
+"""Test configuration.
+
+Tests run hardware-free: JAX is forced onto a virtual 8-device CPU mesh
+(multi-chip sharding is validated the way the driver's dryrun does), and all
+driver components run against the stub tpulib backend + fake k8s cluster.
+"""
+
+import os
+
+# Must be set before any jax import anywhere in the test session.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+from tpu_dra.infra import featuregates  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh_feature_gates():
+    """Isolate the feature-gate singleton between tests."""
+    featuregates.reset_for_tests(None)
+    yield
+    featuregates.reset_for_tests(None)

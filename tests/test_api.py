@@ -1,0 +1,364 @@
+"""API-layer tests.
+
+Reference analogs: api/nvidia.com/resource/v1beta1/sharing_test.go
+(per-device limit normalization) plus decoder strict/nonstrict behavior
+(api.go:46-98).
+"""
+
+import json
+
+import pytest
+
+from tpu_dra import api
+from tpu_dra.api import (
+    ComputeDomain,
+    ComputeDomainChannelConfig,
+    ComputeDomainDaemonConfig,
+    MultiplexingConfig,
+    PerProcessHbmLimit,
+    Quantity,
+    TpuConfig,
+    TpuSubsliceConfig,
+    VfioDeviceConfig,
+    default_tpu_config,
+)
+from tpu_dra.api.serde import ApiError, DecodeError
+from tpu_dra.api.sharing import InvalidDeviceSelector, time_slice_ordinal
+from tpu_dra.infra import featuregates as fg
+
+CD_UID = "8d7d6d3e-1111-4222-8333-444455556666"
+
+
+def gates(**kwargs):
+    g = fg.FeatureGates()
+    for k, v in kwargs.items():
+        g.set(k, v)
+    fg.reset_for_tests(g)
+
+
+# --- Quantity grammar -------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "raw,expect",
+    [
+        ("1", 1),
+        ("1Ki", 1024),
+        ("4Gi", 4 * 2**30),
+        ("1G", 10**9),
+        ("2.5Gi", int(2.5 * 2**30)),
+        ("500m", 1),  # milli rounds up for byte consumption
+    ],
+)
+def test_quantity_parse(raw, expect):
+    assert Quantity.parse(raw).to_bytes() == expect
+
+
+def test_quantity_invalid():
+    with pytest.raises(ValueError):
+        Quantity.parse("4GiB")
+    with pytest.raises(ValueError):
+        Quantity.parse("banana")
+
+
+def test_quantity_compare():
+    assert Quantity.parse("1Gi") > Quantity.parse("1G")
+    assert Quantity.parse("1024") == Quantity.parse("1Ki")
+
+
+# --- decoders ---------------------------------------------------------------
+
+
+def _tpu_config_json(extra=None):
+    d = {
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "TpuConfig",
+        "sharing": {"strategy": "Multiplexing"},
+    }
+    if extra:
+        d.update(extra)
+    return json.dumps(d)
+
+
+def test_strict_decode_round_trip():
+    obj = api.strict_decode(_tpu_config_json())
+    assert isinstance(obj, TpuConfig)
+    assert obj.sharing.is_multiplexing()
+    re = api.strict_decode(api.encode(obj))
+    assert re == obj
+
+
+def test_strict_decoder_rejects_unknown_fields():
+    with pytest.raises(DecodeError, match="unknown field"):
+        api.strict_decode(_tpu_config_json({"futureField": 1}))
+
+
+def test_nonstrict_decoder_drops_unknown_fields():
+    # Down/upgrade safety: checkpoint JSON from a newer driver decodes.
+    obj = api.nonstrict_decode(_tpu_config_json({"futureField": 1}))
+    assert isinstance(obj, TpuConfig)
+
+
+def test_nested_unknown_fields_respect_strictness():
+    d = {
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "TpuConfig",
+        "sharing": {"strategy": "Multiplexing", "zap": True},
+    }
+    with pytest.raises(DecodeError):
+        api.strict_decode(json.dumps(d))
+    assert api.nonstrict_decode(json.dumps(d)).sharing.is_multiplexing()
+
+
+def test_decode_unknown_kind():
+    with pytest.raises(DecodeError, match="no kind"):
+        api.strict_decode(
+            json.dumps(
+                {"apiVersion": "resource.tpu.google.com/v1beta1", "kind": "Nope"}
+            )
+        )
+
+
+def test_decode_missing_type_meta():
+    with pytest.raises(DecodeError):
+        api.strict_decode(json.dumps({"sharing": None}))
+
+
+# --- TpuConfig normalize/validate ------------------------------------------
+
+
+def test_default_config_plain_without_gates():
+    cfg = default_tpu_config()
+    assert cfg.sharing is None
+    cfg.normalize()
+    cfg.validate()
+    assert cfg.sharing is None
+
+
+def test_default_config_with_timeslicing_gate():
+    gates(TimeSlicingSettings=True)
+    cfg = default_tpu_config()
+    assert cfg.sharing.is_time_slicing()
+    cfg.normalize()
+    cfg.validate()
+    assert cfg.sharing.time_slicing_config.interval == "Default"
+
+
+def test_multiplexing_requires_gate():
+    cfg = api.strict_decode(_tpu_config_json())
+    cfg.normalize()
+    with pytest.raises(ApiError, match="MultiplexingSupport"):
+        cfg.validate()
+    gates(MultiplexingSupport=True)
+    cfg2 = api.strict_decode(_tpu_config_json())
+    cfg2.normalize()
+    cfg2.validate()
+    # normalize under the gate fills an empty multiplexing config
+    assert cfg2.sharing.multiplexing_config is not None
+
+
+def test_sharing_strategy_mutual_exclusion():
+    gates(MultiplexingSupport=True, TimeSlicingSettings=True)
+    d = {
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "TpuConfig",
+        "sharing": {
+            "strategy": "Multiplexing",
+            "timeSlicingConfig": {"interval": "Short"},
+        },
+    }
+    cfg = api.strict_decode(json.dumps(d))
+    with pytest.raises(ApiError, match="timeSlicingConfig invalid"):
+        cfg.validate()
+
+
+def test_invalid_interval_rejected():
+    gates(TimeSlicingSettings=True)
+    d = {
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "TpuConfig",
+        "sharing": {
+            "strategy": "TimeSlicing",
+            "timeSlicingConfig": {"interval": "Banana"},
+        },
+    }
+    cfg = api.strict_decode(json.dumps(d))
+    with pytest.raises(ApiError, match="interval"):
+        cfg.validate()
+
+
+def test_time_slice_ordinals():
+    assert time_slice_ordinal("Default") == 0
+    assert time_slice_ordinal("Short") == 1
+    assert time_slice_ordinal("Medium") == 2
+    assert time_slice_ordinal("Long") == 3
+    assert time_slice_ordinal("X") == -1
+
+
+# --- per-device HBM limit normalization (sharing_test.go analog) ------------
+
+UUIDS = ["tpu-aaa", "tpu-bbb", "tpu-ccc"]
+
+
+def test_limits_default_applied_to_all():
+    mc = MultiplexingConfig(default_hbm_limit=Quantity.parse("4Gi"))
+    assert mc.normalized_limits(UUIDS) == {u: "4Gi" for u in UUIDS}
+
+
+def test_limits_per_device_overrides_default():
+    mc = MultiplexingConfig(
+        default_hbm_limit=Quantity.parse("4Gi"),
+        default_per_device_hbm_limit=PerProcessHbmLimit.from_dict(
+            {"1": "2Gi", "tpu-ccc": "1Gi"}
+        ),
+    )
+    assert mc.normalized_limits(UUIDS) == {
+        "tpu-aaa": "4Gi",
+        "tpu-bbb": "2Gi",
+        "tpu-ccc": "1Gi",
+    }
+
+
+def test_limits_no_default_only_selected_devices():
+    mc = MultiplexingConfig(
+        default_per_device_hbm_limit=PerProcessHbmLimit.from_dict({"0": "2Gi"})
+    )
+    assert mc.normalized_limits(UUIDS) == {"tpu-aaa": "2Gi"}
+
+
+def test_limits_invalid_selector():
+    mc = MultiplexingConfig(
+        default_per_device_hbm_limit=PerProcessHbmLimit.from_dict({"9": "2Gi"})
+    )
+    with pytest.raises(InvalidDeviceSelector):
+        mc.normalized_limits(UUIDS)
+    mc2 = MultiplexingConfig(
+        default_per_device_hbm_limit=PerProcessHbmLimit.from_dict({"tpu-zzz": "2Gi"})
+    )
+    with pytest.raises(InvalidDeviceSelector):
+        mc2.normalized_limits(UUIDS)
+
+
+def test_multiplexing_validate_bounds():
+    gates(MultiplexingSupport=True)
+    MultiplexingConfig(default_compute_share_percentage=50).validate()
+    with pytest.raises(ApiError):
+        MultiplexingConfig(default_compute_share_percentage=0).validate()
+    with pytest.raises(ApiError):
+        MultiplexingConfig(default_compute_share_percentage=101).validate()
+
+
+# --- subslice + vfio + CD configs ------------------------------------------
+
+
+def test_subslice_config_accepts_timeslicing_noop():
+    cfg = TpuSubsliceConfig.from_dict(
+        {"sharing": {"strategy": "TimeSlicing"}}, strict=True
+    )
+    cfg.normalize()
+    cfg.validate()  # no-op accepted for reference parity
+
+
+def test_vfio_config_roundtrip():
+    obj = api.strict_decode(
+        json.dumps(
+            {
+                "apiVersion": "resource.tpu.google.com/v1beta1",
+                "kind": "VfioDeviceConfig",
+            }
+        )
+    )
+    assert isinstance(obj, VfioDeviceConfig)
+    obj.normalize()
+    obj.validate()
+
+
+def test_channel_config_validation():
+    cfg = ComputeDomainChannelConfig(domain_id=CD_UID, allocation_mode="Single")
+    cfg.validate()
+    with pytest.raises(ApiError, match="domainID"):
+        ComputeDomainChannelConfig(domain_id="").validate()
+    with pytest.raises(ApiError, match="UUID"):
+        ComputeDomainChannelConfig(domain_id="not-a-uuid").validate()
+    with pytest.raises(ApiError, match="allocationMode"):
+        ComputeDomainChannelConfig(domain_id=CD_UID, allocation_mode="Some").validate()
+
+
+def test_daemon_config_validation():
+    ComputeDomainDaemonConfig(domain_id=CD_UID).validate()
+    with pytest.raises(ApiError):
+        ComputeDomainDaemonConfig(domain_id="").validate()
+
+
+def test_channel_config_missing_required_field():
+    with pytest.raises(DecodeError, match="domainID"):
+        ComputeDomainChannelConfig.from_dict({}, strict=True)
+
+
+# --- CRD round-trip ---------------------------------------------------------
+
+
+def test_computedomain_crd_roundtrip():
+    d = {
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "ComputeDomain",
+        "metadata": {"name": "cd1", "namespace": "default", "uid": CD_UID},
+        "spec": {
+            "numNodes": 4,
+            "topology": "4x4",
+            "acceleratorType": "v5p-16",
+            "channel": {
+                "resourceClaimTemplate": {"name": "cd1-channel"},
+                "allocationMode": "Single",
+            },
+        },
+        "status": {
+            "status": "Ready",
+            "nodes": [
+                {"name": "n0", "ipAddress": "10.0.0.1", "cliqueID": "s1.0",
+                 "index": 0, "status": "Ready"}
+            ],
+        },
+    }
+    cd = api.strict_decode(json.dumps(d))
+    assert isinstance(cd, ComputeDomain)
+    assert cd.spec.num_nodes == 4
+    assert cd.spec.channel.resource_claim_template.name == "cd1-channel"
+    assert cd.status.nodes[0].clique_id == "s1.0"
+    cd2 = api.strict_decode(api.encode(cd))
+    assert cd2 == cd
+
+
+# --- review-hardening regressions ------------------------------------------
+
+
+def test_negative_milli_limit_rejected():
+    from tpu_dra.api.errors import ApiError as AE
+
+    mc = MultiplexingConfig(default_hbm_limit=Quantity.parse("-500m"))
+    with pytest.raises(AE):
+        mc.validate()
+
+
+def test_quantity_error_is_api_error():
+    from tpu_dra.api.errors import ApiError as AE, QuantityError
+
+    with pytest.raises(QuantityError):
+        Quantity.parse("12XYZ")
+    assert issubclass(QuantityError, AE)
+    # Malformed quantity inside user claim config surfaces as ApiError.
+    d = {
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "TpuConfig",
+        "sharing": {
+            "strategy": "Multiplexing",
+            "multiplexingConfig": {"defaultHbmLimit": "12XYZ"},
+        },
+    }
+    with pytest.raises(AE):
+        api.strict_decode(json.dumps(d))
+
+
+def test_quantity_total_ordering():
+    assert Quantity.parse("1") <= Quantity.parse("2")
+    assert Quantity.parse("2Gi") >= Quantity.parse("1Gi")

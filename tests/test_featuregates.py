@@ -1,0 +1,120 @@
+"""Feature-gate semantics tests.
+
+Reference analog: pkg/featuregates/featuregates_test.go (488 LoC) — defaults,
+overrides, unknown gates, dependency validation.
+"""
+
+import pytest
+
+from tpu_dra.infra.featuregates import (
+    COMPUTE_DOMAIN_CLIQUES,
+    CRASH_ON_ICI_FABRIC_ERRORS,
+    DEVICE_HEALTH_CHECK,
+    DYNAMIC_SUBSLICE,
+    MULTIPLEXING_SUPPORT,
+    PASSTHROUGH_SUPPORT,
+    SLICE_DAEMONS_WITH_DNS_NAMES,
+    TIME_SLICING_SETTINGS,
+    FeatureGateError,
+    FeatureGates,
+    Stage,
+    VersionedSpec,
+)
+
+
+def test_defaults():
+    fg = FeatureGates()
+    assert fg.enabled(TIME_SLICING_SETTINGS) is False
+    assert fg.enabled(MULTIPLEXING_SUPPORT) is False
+    assert fg.enabled(DYNAMIC_SUBSLICE) is False
+    assert fg.enabled(PASSTHROUGH_SUPPORT) is False
+    assert fg.enabled(DEVICE_HEALTH_CHECK) is False
+    # Beta gates default on.
+    assert fg.enabled(SLICE_DAEMONS_WITH_DNS_NAMES) is True
+    assert fg.enabled(COMPUTE_DOMAIN_CLIQUES) is True
+    assert fg.enabled(CRASH_ON_ICI_FABRIC_ERRORS) is True
+
+
+def test_set_and_parse_string():
+    fg = FeatureGates()
+    fg.set_from_string(" DynamicSubslice=true , TimeSlicingSettings=TRUE ")
+    assert fg.enabled(DYNAMIC_SUBSLICE) is True
+    assert fg.enabled(TIME_SLICING_SETTINGS) is True
+    fg.set_from_string("DynamicSubslice=false")
+    assert fg.enabled(DYNAMIC_SUBSLICE) is False
+
+
+def test_unknown_gate_rejected():
+    fg = FeatureGates()
+    with pytest.raises(FeatureGateError):
+        fg.enabled("NoSuchGate")
+    with pytest.raises(FeatureGateError):
+        fg.set_from_string("NoSuchGate=true")
+    with pytest.raises(FeatureGateError):
+        fg.set_from_string("DynamicSubslice=banana")
+    with pytest.raises(FeatureGateError):
+        fg.set_from_string("DynamicSubslice")
+
+
+def test_versioned_specs_pick_newest_applicable():
+    fg = FeatureGates(
+        component_version=(0, 2),
+        specs={
+            "G": [
+                VersionedSpec((0, 1), False, Stage.ALPHA),
+                VersionedSpec((0, 2), True, Stage.BETA),
+                VersionedSpec((0, 3), True, Stage.GA, lock_to_default=True),
+            ]
+        },
+    )
+    assert fg.enabled("G") is True  # the (0,2) beta spec applies
+    fg2 = FeatureGates(component_version=(0, 1), specs=fg.specs)
+    assert fg2.enabled("G") is False  # alpha default at (0,1)
+
+
+def test_ga_lock_to_default():
+    fg = FeatureGates(
+        component_version=(1, 0),
+        specs={"G": [VersionedSpec((0, 1), True, Stage.GA, lock_to_default=True)]},
+    )
+    with pytest.raises(FeatureGateError):
+        fg.set("G", False)
+    fg.set("G", True)  # setting to the default is allowed
+    assert fg.enabled("G") is True
+
+
+def test_dependency_validation_cliques_need_dns():
+    fg = FeatureGates()
+    fg.set(COMPUTE_DOMAIN_CLIQUES, True)
+    fg.set(SLICE_DAEMONS_WITH_DNS_NAMES, False)
+    with pytest.raises(FeatureGateError, match="requires"):
+        fg.validate()
+
+
+@pytest.mark.parametrize(
+    "other", [PASSTHROUGH_SUPPORT, DEVICE_HEALTH_CHECK, MULTIPLEXING_SUPPORT]
+)
+def test_dynamic_subslice_mutual_exclusions(other):
+    fg = FeatureGates()
+    fg.set(DYNAMIC_SUBSLICE, True)
+    fg.set(other, True)
+    with pytest.raises(FeatureGateError, match="mutually"):
+        fg.validate()
+
+
+def test_valid_combination_passes():
+    fg = FeatureGates()
+    fg.set(DYNAMIC_SUBSLICE, True)
+    fg.validate()
+    fg2 = FeatureGates()
+    fg2.set(MULTIPLEXING_SUPPORT, True)
+    fg2.set(TIME_SLICING_SETTINGS, True)
+    fg2.validate()
+
+
+def test_to_map_roundtrip():
+    fg = FeatureGates()
+    m = fg.to_map()
+    assert m[COMPUTE_DOMAIN_CLIQUES] is True
+    assert m[DYNAMIC_SUBSLICE] is False
+    assert set(m) == set(fg.known())

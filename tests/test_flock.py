@@ -1,0 +1,62 @@
+"""File-lock tests (pkg/flock/flock.go analog behavior)."""
+
+import threading
+import time
+
+import pytest
+
+from tpu_dra.infra.flock import Flock, FlockTimeout
+
+
+def test_acquire_release(tmp_path):
+    lock = Flock(str(tmp_path / "l"))
+    release = lock.acquire(timeout=1)
+    release()
+    # Re-acquirable after release.
+    release = lock.acquire(timeout=1)
+    release()
+
+
+def test_contention_times_out(tmp_path):
+    path = str(tmp_path / "l")
+    # A lock held by another *process* blocks us. (Same-process flock on a
+    # separate fd of the same file also conflicts on Linux.)
+    a = Flock(path)
+    ra = a.acquire(timeout=1)
+    b = Flock(path)
+    t0 = time.monotonic()
+    with pytest.raises(FlockTimeout):
+        b.acquire(timeout=0.3, poll_period=0.02)
+    assert time.monotonic() - t0 >= 0.3
+    ra()
+    rb = b.acquire(timeout=1, poll_period=0.02)
+    rb()
+
+
+def test_blocks_until_released(tmp_path):
+    path = str(tmp_path / "l")
+    a = Flock(path)
+    ra = a.acquire()
+    got = []
+
+    def taker():
+        r = Flock(path).acquire(timeout=5, poll_period=0.02)
+        got.append(time.monotonic())
+        r()
+
+    t = threading.Thread(target=taker)
+    t.start()
+    time.sleep(0.2)
+    assert not got
+    ra()
+    t.join(timeout=5)
+    assert got
+
+
+def test_context_manager(tmp_path):
+    lock = Flock(str(tmp_path / "l"))
+    with lock.held(timeout=1):
+        with pytest.raises(FlockTimeout):
+            Flock(lock.path).acquire(timeout=0.1, poll_period=0.02)
+    with lock.held(timeout=1):
+        pass

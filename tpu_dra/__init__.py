@@ -1,0 +1,29 @@
+"""tpu-dra-driver: a TPU-native Kubernetes Dynamic Resource Allocation driver.
+
+A from-scratch re-imagining of the NVIDIA DRA driver for GPUs
+(reference: fabiendupont/k8s-dra-driver-gpu) for Cloud TPU:
+
+- chip discovery via vfio-pci / /dev/accel / libtpu metadata instead of NVML
+  (reference: cmd/gpu-kubelet-plugin/nvlib.go)
+- dynamic TPU sub-slice reshaping in place of dynamic MIG partitioning
+  (reference: cmd/gpu-kubelet-plugin/partitions.go, nvlib.go:860-1089)
+- per-process chip multiplexing in place of MPS
+  (reference: cmd/gpu-kubelet-plugin/sharing.go)
+- ComputeDomains orchestrating multi-host ICI pod-slice topology instead of
+  IMEX / Multi-Node NVLink (reference: cmd/compute-domain-*)
+
+Package layout (mapping to the reference's layer map, SURVEY.md §1):
+
+- ``tpu_dra.api``            -> api/nvidia.com/resource/v1beta1
+- ``tpu_dra.k8sclient``      -> pkg/nvidia.com generated clients (+fakes)
+- ``tpu_dra.infra``          -> pkg/{featuregates,flags,flock,workqueue}, internal/
+- ``tpu_dra.tpulib``         -> nvlib.go / go-nvml hardware abstraction
+- ``tpu_dra.plugin``         -> cmd/gpu-kubelet-plugin
+- ``tpu_dra.computedomain``  -> cmd/compute-domain-{controller,daemon,kubelet-plugin}
+- ``tpu_dra.webhook``        -> cmd/webhook
+- ``tpu_dra.workloads``      -> the JAX/XLA payloads the driver schedules
+  (models/ops/parallel/utils: Llama-3 pjit flagship, pallas kernels,
+  ring-attention sequence parallelism, mesh/sharding helpers)
+"""
+
+from tpu_dra.version import __version__  # noqa: F401

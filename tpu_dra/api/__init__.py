@@ -1,0 +1,79 @@
+"""API group ``resource.tpu.google.com/v1beta1``.
+
+Reference analog: api/nvidia.com/resource/v1beta1 (api.go:26-98). Two kinds of
+types share the group:
+
+1. CRDs stored in the API server: :class:`ComputeDomain`,
+   :class:`ComputeDomainClique`.
+2. Opaque device-config types, never stored, embedded as opaque JSON in
+   ResourceClaims and decoded by the kubelet plugins: :class:`TpuConfig`,
+   :class:`TpuSubsliceConfig`, :class:`VfioDeviceConfig`,
+   :class:`ComputeDomainChannelConfig`, :class:`ComputeDomainDaemonConfig`.
+
+Two decoders (api.go:46-98):
+
+- :func:`strict_decode` fails on unknown fields — used on user-supplied claim
+  configs in NodePrepareResources.
+- :func:`nonstrict_decode` drops unknown fields — used for checkpoint JSON
+  that may come from older/newer driver versions (down/upgrade safety).
+"""
+
+from tpu_dra.api.serde import (  # noqa: F401
+    ApiError,
+    DecodeError,
+    Interface,
+    decode,
+    encode,
+    nonstrict_decode,
+    register,
+    strict_decode,
+)
+from tpu_dra.api.quantity import Quantity  # noqa: F401
+from tpu_dra.api.sharing import (  # noqa: F401
+    DEFAULT_TIME_SLICE,
+    LONG_TIME_SLICE,
+    MEDIUM_TIME_SLICE,
+    MULTIPLEXING_STRATEGY,
+    SHORT_TIME_SLICE,
+    TIME_SLICING_STRATEGY,
+    MultiplexingConfig,
+    PerProcessHbmLimit,
+    TimeSlicingConfig,
+    TpuSharing,
+    TpuSubsliceSharing,
+)
+from tpu_dra.api.configs import (  # noqa: F401
+    ComputeDomainChannelConfig,
+    ComputeDomainDaemonConfig,
+    TpuConfig,
+    TpuSubsliceConfig,
+    VfioDeviceConfig,
+    default_tpu_config,
+    default_tpu_subslice_config,
+    default_vfio_device_config,
+)
+from tpu_dra.api.computedomain import (  # noqa: F401
+    CD_STATUS_NOT_READY,
+    CD_STATUS_NONE,
+    CD_STATUS_READY,
+    CHANNEL_ALLOCATION_MODE_ALL,
+    CHANNEL_ALLOCATION_MODE_SINGLE,
+    ComputeDomain,
+    ComputeDomainClique,
+    ComputeDomainDaemonInfo,
+    ComputeDomainNode,
+    ComputeDomainSpec,
+    ComputeDomainStatus,
+)
+
+GROUP_NAME = "resource.tpu.google.com"
+VERSION = "v1beta1"
+API_VERSION = f"{GROUP_NAME}/{VERSION}"
+
+TPU_CONFIG_KIND = "TpuConfig"
+TPU_SUBSLICE_CONFIG_KIND = "TpuSubsliceConfig"
+VFIO_DEVICE_CONFIG_KIND = "VfioDeviceConfig"
+CD_CHANNEL_CONFIG_KIND = "ComputeDomainChannelConfig"
+CD_DAEMON_CONFIG_KIND = "ComputeDomainDaemonConfig"
+CD_KIND = "ComputeDomain"
+CD_CLIQUE_KIND = "ComputeDomainClique"

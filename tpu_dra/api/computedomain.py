@@ -1,0 +1,174 @@
+"""ComputeDomain and ComputeDomainClique CRD types.
+
+Reference analog: api/nvidia.com/resource/v1beta1/computedomain.go:39-48 and
+computedomainclique.go:30-41.
+
+TPU-native semantics: a ComputeDomain represents one multi-host **ICI
+pod-slice** (plus optional DCN-connected extensions) instead of an IMEX/MNNVL
+domain. A *clique* is the physical ICI domain — all hosts wired into one TPU
+pod slice — named ``<cdUID>.<cliqueID>`` where cliqueID is the slice/ICI
+fabric identifier discovered on-node (the NVLink clusterUUID.cliqueId analog,
+cmd/compute-domain-kubelet-plugin/nvlib.go:188-357).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tpu_dra.api.serde import Field, Serde, nested, nested_list, register
+
+_API_VERSION = "resource.tpu.google.com/v1beta1"
+
+CD_STATUS_NONE = ""
+CD_STATUS_READY = "Ready"
+CD_STATUS_NOT_READY = "NotReady"
+
+CHANNEL_ALLOCATION_MODE_SINGLE = "Single"
+CHANNEL_ALLOCATION_MODE_ALL = "All"
+
+
+@dataclass
+class ObjectMeta(Serde):
+    name: str = ""
+    namespace: str = ""
+    uid: str = ""
+    resource_version: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    finalizers: List[str] = field(default_factory=list)
+    owner_references: List[dict] = field(default_factory=list)
+    deletion_timestamp: Optional[str] = None
+    creation_timestamp: Optional[str] = None
+    generation: int = 0
+
+    FIELDS = {
+        "name": Field("name"),
+        "namespace": Field("namespace"),
+        "uid": Field("uid"),
+        "resourceVersion": Field("resource_version"),
+        "labels": Field("labels"),
+        "annotations": Field("annotations"),
+        "finalizers": Field("finalizers"),
+        "ownerReferences": Field("owner_references"),
+        "deletionTimestamp": Field("deletion_timestamp"),
+        "creationTimestamp": Field("creation_timestamp"),
+        "generation": Field("generation"),
+    }
+
+
+@dataclass
+class ComputeDomainResourceClaimTemplate(Serde):
+    name: str = ""
+
+    FIELDS = {"name": Field("name", required=True)}
+
+
+@dataclass
+class ComputeDomainChannelSpec(Serde):
+    resource_claim_template: ComputeDomainResourceClaimTemplate = field(
+        default_factory=ComputeDomainResourceClaimTemplate
+    )
+    allocation_mode: str = ""
+
+    FIELDS = {
+        "resourceClaimTemplate": Field(
+            "resource_claim_template",
+            *nested(ComputeDomainResourceClaimTemplate),
+            required=True,
+        ),
+        "allocationMode": Field("allocation_mode"),
+    }
+
+
+@dataclass
+class ComputeDomainSpec(Serde):
+    """numNodes = number of hosts in the slice; topology optionally pins the
+    ICI mesh shape (e.g. "4x4" for v5p-16) — a TPU-native extension the
+    scheduler and daemon use to validate complete slice membership."""
+
+    num_nodes: int = 0
+    channel: Optional[ComputeDomainChannelSpec] = None
+    topology: str = ""
+    accelerator_type: str = ""
+
+    FIELDS = {
+        "numNodes": Field("num_nodes", required=True),
+        "channel": Field("channel", *nested(ComputeDomainChannelSpec)),
+        "topology": Field("topology"),
+        "acceleratorType": Field("accelerator_type"),
+    }
+
+
+@dataclass
+class ComputeDomainNode(Serde):
+    name: str = ""
+    ip_address: str = ""
+    clique_id: str = ""
+    index: int = 0
+    status: str = ""
+
+    FIELDS = {
+        "name": Field("name"),
+        "ipAddress": Field("ip_address"),
+        "cliqueID": Field("clique_id"),
+        "index": Field("index"),
+        "status": Field("status"),
+    }
+
+
+@dataclass
+class ComputeDomainStatus(Serde):
+    status: str = ""
+    nodes: List[ComputeDomainNode] = field(default_factory=list)
+
+    FIELDS = {
+        "status": Field("status"),
+        "nodes": Field("nodes", *nested_list(ComputeDomainNode)),
+    }
+
+
+@register(_API_VERSION, "ComputeDomain")
+@dataclass
+class ComputeDomain(Serde):
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ComputeDomainSpec = field(default_factory=ComputeDomainSpec)
+    status: ComputeDomainStatus = field(default_factory=ComputeDomainStatus)
+
+    FIELDS = {
+        "metadata": Field("metadata", *nested(ObjectMeta)),
+        "spec": Field("spec", *nested(ComputeDomainSpec)),
+        "status": Field("status", *nested(ComputeDomainStatus)),
+    }
+
+
+@dataclass
+class ComputeDomainDaemonInfo(Serde):
+    """One slice daemon's registration (computedomainclique.go:30-41 analog):
+    host identity + stable index used for DNS naming + readiness."""
+
+    node_name: str = ""
+    ip_address: str = ""
+    clique_id: str = ""
+    index: int = 0
+    status: str = ""
+
+    FIELDS = {
+        "nodeName": Field("node_name"),
+        "ipAddress": Field("ip_address"),
+        "cliqueID": Field("clique_id"),
+        "index": Field("index"),
+        "status": Field("status"),
+    }
+
+
+@register(_API_VERSION, "ComputeDomainClique")
+@dataclass
+class ComputeDomainClique(Serde):
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    daemons: List[ComputeDomainDaemonInfo] = field(default_factory=list)
+
+    FIELDS = {
+        "metadata": Field("metadata", *nested(ObjectMeta)),
+        "daemons": Field("daemons", *nested_list(ComputeDomainDaemonInfo)),
+    }

@@ -1,0 +1,198 @@
+"""Opaque device-config types embedded in ResourceClaims.
+
+Reference analog: api/nvidia.com/resource/v1beta1/{gpuconfig.go, migconfig.go,
+vfiodeviceconfig.go, computedomainconfig.go}. Semantics preserved:
+
+- each type implements normalize() (fill defaults, feature-gate-aware) and
+  validate();
+- defaults are feature-gate dependent: e.g. a default TpuConfig carries
+  time-slicing settings only when the TimeSlicingSettings gate is on
+  (gpuconfig.go DefaultGpuConfig);
+- ComputeDomain{Channel,Daemon}Config carry the domainID that ties a claim
+  back to its ComputeDomain (computedomainconfig.go).
+"""
+
+from __future__ import annotations
+
+import uuid as uuidlib
+from dataclasses import dataclass
+from typing import Optional
+
+from tpu_dra.api.serde import ApiError, Field, Interface, Serde, nested, register
+from tpu_dra.api.sharing import (
+    DEFAULT_TIME_SLICE,
+    MULTIPLEXING_STRATEGY,
+    TIME_SLICING_STRATEGY,
+    MultiplexingConfig,
+    TimeSlicingConfig,
+    TpuSharing,
+    TpuSubsliceSharing,
+)
+
+_API_VERSION = "resource.tpu.google.com/v1beta1"
+
+
+@register(_API_VERSION, "TpuConfig")
+@dataclass
+class TpuConfig(Serde, Interface):
+    """Config for a full-chip device claim (gpuconfig.go GpuConfig)."""
+
+    sharing: Optional[TpuSharing] = None
+
+    FIELDS = {"sharing": Field("sharing", *nested(TpuSharing))}
+
+    def normalize(self) -> None:
+        from tpu_dra.infra import featuregates as fg
+
+        if self.sharing is None:
+            if not fg.enabled(fg.TIME_SLICING_SETTINGS):
+                return
+            self.sharing = TpuSharing(strategy=TIME_SLICING_STRATEGY)
+
+        if fg.enabled(fg.TIME_SLICING_SETTINGS):
+            if (
+                self.sharing.strategy == TIME_SLICING_STRATEGY
+                and self.sharing.time_slicing_config is None
+            ):
+                self.sharing.time_slicing_config = TimeSlicingConfig(
+                    interval=DEFAULT_TIME_SLICE
+                )
+        if fg.enabled(fg.MULTIPLEXING_SUPPORT):
+            if (
+                self.sharing.strategy == MULTIPLEXING_STRATEGY
+                and self.sharing.multiplexing_config is None
+            ):
+                self.sharing.multiplexing_config = MultiplexingConfig()
+
+    def validate(self) -> None:
+        if self.sharing is None:
+            return
+        self.sharing.validate()
+
+
+def default_tpu_config() -> TpuConfig:
+    from tpu_dra.infra import featuregates as fg
+
+    cfg = TpuConfig()
+    if fg.enabled(fg.TIME_SLICING_SETTINGS):
+        cfg.sharing = TpuSharing(
+            strategy=TIME_SLICING_STRATEGY,
+            time_slicing_config=TimeSlicingConfig(interval=DEFAULT_TIME_SLICE),
+        )
+    return cfg
+
+
+@register(_API_VERSION, "TpuSubsliceConfig")
+@dataclass
+class TpuSubsliceConfig(Serde, Interface):
+    """Config for a sub-slice device claim (migconfig.go MigDeviceConfig)."""
+
+    sharing: Optional[TpuSubsliceSharing] = None
+
+    FIELDS = {"sharing": Field("sharing", *nested(TpuSubsliceSharing))}
+
+    def normalize(self) -> None:
+        from tpu_dra.infra import featuregates as fg
+
+        if self.sharing is None:
+            if not fg.enabled(fg.TIME_SLICING_SETTINGS):
+                return
+            self.sharing = TpuSubsliceSharing(strategy=TIME_SLICING_STRATEGY)
+        if fg.enabled(fg.MULTIPLEXING_SUPPORT):
+            if (
+                self.sharing.strategy == MULTIPLEXING_STRATEGY
+                and self.sharing.multiplexing_config is None
+            ):
+                self.sharing.multiplexing_config = MultiplexingConfig()
+
+    def validate(self) -> None:
+        if self.sharing is None:
+            return
+        self.sharing.validate()
+
+
+def default_tpu_subslice_config() -> TpuSubsliceConfig:
+    from tpu_dra.infra import featuregates as fg
+
+    cfg = TpuSubsliceConfig()
+    if fg.enabled(fg.TIME_SLICING_SETTINGS):
+        cfg.sharing = TpuSubsliceSharing(strategy=TIME_SLICING_STRATEGY)
+    return cfg
+
+
+@register(_API_VERSION, "VfioDeviceConfig")
+@dataclass
+class VfioDeviceConfig(Serde, Interface):
+    """Config requesting vfio-pci passthrough of a chip
+    (vfiodeviceconfig.go). Carries no fields; its presence selects the path."""
+
+    FIELDS = {}
+
+    def normalize(self) -> None:
+        return
+
+    def validate(self) -> None:
+        return
+
+
+def default_vfio_device_config() -> Optional[VfioDeviceConfig]:
+    from tpu_dra.infra import featuregates as fg
+
+    if not fg.enabled(fg.PASSTHROUGH_SUPPORT):
+        return None
+    return VfioDeviceConfig()
+
+
+def _validate_domain_id(domain_id: str) -> None:
+    if not domain_id:
+        raise ApiError("domainID cannot be empty")
+    try:
+        uuidlib.UUID(domain_id)
+    except ValueError as e:
+        raise ApiError(f"domainID must be a UUID: {domain_id!r}") from e
+
+
+@register(_API_VERSION, "ComputeDomainChannelConfig")
+@dataclass
+class ComputeDomainChannelConfig(Serde, Interface):
+    """Opaque config on workload channel claims (computedomainconfig.go:28-34).
+
+    ``domain_id`` is the ComputeDomain's UID; ``allocation_mode`` selects one
+    channel vs. all channels (computedomain.go AllocationMode values).
+    """
+
+    domain_id: str = ""
+    allocation_mode: str = ""
+
+    FIELDS = {
+        "domainID": Field("domain_id", required=True),
+        "allocationMode": Field("allocation_mode"),
+    }
+
+    def normalize(self) -> None:
+        return
+
+    def validate(self) -> None:
+        _validate_domain_id(self.domain_id)
+        if self.allocation_mode not in ("", "Single", "All"):
+            raise ApiError(
+                f"allocationMode must be 'Single' or 'All', got "
+                f"{self.allocation_mode!r}"
+            )
+
+
+@register(_API_VERSION, "ComputeDomainDaemonConfig")
+@dataclass
+class ComputeDomainDaemonConfig(Serde, Interface):
+    """Opaque config on the per-node daemon claim
+    (computedomainconfig.go:60-65)."""
+
+    domain_id: str = ""
+
+    FIELDS = {"domainID": Field("domain_id", required=True)}
+
+    def normalize(self) -> None:
+        return
+
+    def validate(self) -> None:
+        _validate_domain_id(self.domain_id)

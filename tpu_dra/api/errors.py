@@ -1,0 +1,18 @@
+"""Error hierarchy for the API layer.
+
+All errors that user-supplied claim config can trigger derive from
+:class:`ApiError`, so the kubelet plugins can catch one type and convert it
+into a typed NodePrepareResources failure.
+"""
+
+
+class ApiError(ValueError):
+    pass
+
+
+class DecodeError(ApiError):
+    pass
+
+
+class QuantityError(ApiError):
+    pass

@@ -1,0 +1,83 @@
+"""Kubernetes-style resource quantities.
+
+The reference leans on ``k8s.io/apimachinery/pkg/api/resource.Quantity`` for
+MPS pinned-memory limits (api/nvidia.com/resource/v1beta1/sharing.go:60,
+75-80). The TPU build needs the same grammar for per-process HBM limits, so
+this implements the subset of the k8s quantity grammar the driver uses:
+plain integers, decimal SI suffixes (k, M, G, T, P, E, m for milli) and
+binary suffixes (Ki, Mi, Gi, Ti, Pi, Ei).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass
+from fractions import Fraction
+from functools import total_ordering
+
+from tpu_dra.api.errors import QuantityError
+
+_BINARY = {"Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50, "Ei": 2**60}
+_DECIMAL = {
+    "m": Fraction(1, 1000),
+    "k": 10**3,
+    "M": 10**6,
+    "G": 10**9,
+    "T": 10**12,
+    "P": 10**15,
+    "E": 10**18,
+    "": 1,
+}
+
+_RE = re.compile(r"^([+-]?[0-9]+(?:\.[0-9]+)?)(Ki|Mi|Gi|Ti|Pi|Ei|m|k|M|G|T|P|E)?$")
+
+
+@total_ordering
+@dataclass(frozen=True)
+class Quantity:
+    """An immutable quantity; preserves the original string form."""
+
+    raw: str
+
+    def __post_init__(self):
+        m = _RE.match(self.raw.strip())
+        if not m:
+            raise QuantityError(f"unparseable quantity: {self.raw!r}")
+        num, suffix = m.groups()
+        mult = _BINARY.get(suffix or "") or _DECIMAL.get(suffix or "")
+        if mult is None:
+            raise QuantityError(f"unknown suffix in quantity: {self.raw!r}")
+        object.__setattr__(self, "_value", Fraction(num) * Fraction(mult))
+
+    @property
+    def value(self) -> Fraction:
+        return self._value  # type: ignore[attr-defined]
+
+    def to_bytes(self) -> int:
+        """Integral value (ceil), the form device runtimes consume."""
+        return math.ceil(self.value)
+
+    def __str__(self) -> str:
+        return self.raw
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, Quantity):
+            return self.value == other.value
+        return NotImplemented
+
+    def __lt__(self, other: "Quantity") -> bool:
+        if not isinstance(other, Quantity):
+            return NotImplemented
+        return self.value < other.value
+
+    def __hash__(self) -> int:
+        return hash(self.value)
+
+    @classmethod
+    def parse(cls, s: "str | int | Quantity") -> "Quantity":
+        if isinstance(s, Quantity):
+            return s
+        if isinstance(s, int):
+            return cls(str(s))
+        return cls(s)

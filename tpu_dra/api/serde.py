@@ -1,0 +1,169 @@
+"""Typed JSON (de)serialization with strict/nonstrict modes.
+
+Reference analog: api/nvidia.com/resource/v1beta1/api.go:41-98 — a scheme
+mapping (apiVersion, kind) to types, with a StrictDecoder (fails on unknown
+fields; for user input) and a NonstrictDecoder (drops unknown fields; for
+checkpoint JSON written by older/newer driver versions).
+
+Types register themselves with :func:`register`; each declares a
+``FIELDS: dict[json_key, Field]`` table that drives decode/encode. Nested
+types, lists, and Quantity values are supported declaratively.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple, Type
+
+from tpu_dra.api.errors import ApiError, DecodeError
+from tpu_dra.api.quantity import Quantity
+
+
+class Interface:
+    """Common API for all config types (api.go:41-44)."""
+
+    def normalize(self) -> None:
+        raise NotImplementedError
+
+    def validate(self) -> None:
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class Field:
+    """Declarative field spec: attribute name + optional nested codec."""
+
+    attr: str
+    # decode: json value -> python value; encode: python value -> json value
+    decode: Optional[Callable[[Any, bool], Any]] = None
+    encode: Optional[Callable[[Any], Any]] = None
+    required: bool = False
+
+
+def nested(cls: type) -> Tuple[Callable, Callable]:
+    def dec(v, strict):
+        if v is None:
+            return None
+        return cls.from_dict(v, strict=strict)
+
+    def enc(v):
+        if v is None:
+            return None
+        return v.to_dict()
+
+    return dec, enc
+
+
+def nested_list(cls: type) -> Tuple[Callable, Callable]:
+    def dec(v, strict):
+        if v is None:
+            return None
+        return [cls.from_dict(x, strict=strict) for x in v]
+
+    def enc(v):
+        if v is None:
+            return None
+        return [x.to_dict() for x in v]
+
+    return dec, enc
+
+
+def quantity_codec() -> Tuple[Callable, Callable]:
+    def dec(v, strict):
+        if v is None:
+            return None
+        return Quantity.parse(v)
+
+    def enc(v):
+        if v is None:
+            return None
+        return str(v)
+
+    return dec, enc
+
+
+class Serde:
+    """Mixin implementing FIELDS-driven from_dict/to_dict."""
+
+    FIELDS: Dict[str, Field] = {}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any], strict: bool = True):
+        if not isinstance(d, dict):
+            raise DecodeError(f"{cls.__name__}: expected object, got {type(d).__name__}")
+        known = set(cls.FIELDS)
+        unknown = set(d) - known - {"apiVersion", "kind"}
+        if strict and unknown:
+            raise DecodeError(
+                f"{cls.__name__}: unknown field(s): {sorted(unknown)}"
+            )
+        kwargs = {}
+        for key, f in cls.FIELDS.items():
+            if key in d:
+                v = d[key]
+                kwargs[f.attr] = f.decode(v, strict) if f.decode else v
+            elif f.required:
+                raise DecodeError(f"{cls.__name__}: missing required field {key!r}")
+        return cls(**kwargs)  # type: ignore[call-arg]
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for key, f in self.FIELDS.items():
+            v = getattr(self, f.attr)
+            if v is None or (v == [] and not isinstance(v, (int, float))):
+                continue
+            out[key] = f.encode(v) if f.encode else v
+        return out
+
+
+# (group/version, kind) -> type registry; the runtime.Scheme analog.
+_REGISTRY: Dict[Tuple[str, str], Type] = {}
+
+
+def register(api_version: str, kind: str):
+    def wrap(cls):
+        _REGISTRY[(api_version, kind)] = cls
+        cls.API_VERSION = api_version
+        cls.KIND = kind
+        return cls
+
+    return wrap
+
+
+def registered_kinds() -> Dict[Tuple[str, str], Type]:
+    return dict(_REGISTRY)
+
+
+def decode(data: "bytes | str | Dict[str, Any]", strict: bool):
+    """Decode a typed object keyed on apiVersion+kind."""
+    if isinstance(data, (bytes, str)):
+        try:
+            d = json.loads(data)
+        except json.JSONDecodeError as e:
+            raise DecodeError(f"invalid JSON: {e}") from e
+    else:
+        d = data
+    if not isinstance(d, dict):
+        raise DecodeError(f"expected JSON object, got {type(d).__name__}")
+    av, kind = d.get("apiVersion"), d.get("kind")
+    if not av or not kind:
+        raise DecodeError("object is missing apiVersion and/or kind")
+    cls = _REGISTRY.get((av, kind))
+    if cls is None:
+        raise DecodeError(f"no kind {kind!r} registered for {av!r}")
+    return cls.from_dict(d, strict=strict)
+
+
+def strict_decode(data):
+    return decode(data, strict=True)
+
+
+def nonstrict_decode(data):
+    return decode(data, strict=False)
+
+
+def encode(obj) -> str:
+    d = {"apiVersion": obj.API_VERSION, "kind": obj.KIND}
+    d.update(obj.to_dict())
+    return json.dumps(d, sort_keys=True)

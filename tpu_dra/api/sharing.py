@@ -1,0 +1,274 @@
+"""Chip-sharing config types.
+
+Reference analog: api/nvidia.com/resource/v1beta1/sharing.go. The GPU
+strategies map onto TPU-native mechanisms:
+
+- ``TimeSlicing``   — cooperative runtime time-share of one chip. On GPUs this
+  maps to ``nvidia-smi compute-policy --set-timeslice``; on TPU it maps to the
+  runtime scheduler knob carried into the workload env.
+- ``Multiplexing``  — the MPS analog: multiple processes on one chip via the
+  TPU runtime's per-process multiplexing, bounded by a per-process HBM limit
+  (the pinned-device-memory-limit analog, sharing.go:73-80) and a per-process
+  share of compute (the active-thread-percentage analog).
+
+``PerProcessHbmLimit`` keeps the reference's selector algebra
+(sharing.go MpsPerDevicePinnedMemoryLimit.Normalize): keys may be a device
+index ("0") or a device UUID, an explicit per-device entry overrides the
+default limit, and unknown selectors are rejected.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tpu_dra.api.quantity import Quantity
+from tpu_dra.api.serde import ApiError, Field, Serde, nested, quantity_codec
+
+TIME_SLICING_STRATEGY = "TimeSlicing"
+MULTIPLEXING_STRATEGY = "Multiplexing"
+
+DEFAULT_TIME_SLICE = "Default"
+SHORT_TIME_SLICE = "Short"
+MEDIUM_TIME_SLICE = "Medium"
+LONG_TIME_SLICE = "Long"
+
+_TIME_SLICE_ORDINALS = {
+    DEFAULT_TIME_SLICE: 0,
+    SHORT_TIME_SLICE: 1,
+    MEDIUM_TIME_SLICE: 2,
+    LONG_TIME_SLICE: 3,
+}
+
+
+def time_slice_ordinal(interval: str) -> int:
+    """Runtime knob value for a named interval (sharing.go TimeSliceInterval.Int)."""
+    return _TIME_SLICE_ORDINALS.get(interval, -1)
+
+
+class InvalidDeviceSelector(ApiError):
+    pass
+
+
+class InvalidLimit(ApiError):
+    pass
+
+
+@dataclass
+class TimeSlicingConfig(Serde):
+    interval: Optional[str] = None
+
+    FIELDS = {"interval": Field("interval")}
+
+    def validate(self) -> None:
+        if self.interval is not None and self.interval not in _TIME_SLICE_ORDINALS:
+            raise ApiError(
+                f"unknown time-slice interval: {self.interval!r} "
+                f"(want one of {sorted(_TIME_SLICE_ORDINALS)})"
+            )
+
+
+class PerProcessHbmLimit(dict):
+    """Map of device selector (index or UUID) -> HBM limit Quantity."""
+
+    @classmethod
+    def from_dict(cls, d, strict: bool = True) -> "PerProcessHbmLimit":
+        out = cls()
+        for k, v in (d or {}).items():
+            out[str(k)] = Quantity.parse(v)
+        return out
+
+    def to_dict(self):
+        return {k: str(v) for k, v in self.items()}
+
+    def normalize(
+        self,
+        uuids: List[str],
+        default_limit: Optional[Quantity],
+    ) -> Dict[str, str]:
+        """Resolve selectors against the claim's device UUIDs.
+
+        Mirrors MpsPerDevicePinnedMemoryLimit.Normalize: start from the
+        default limit applied to every device (when set), then apply
+        per-device overrides; a key may be a positional index into ``uuids``
+        or a UUID; anything else is an invalid selector.
+        """
+        limits: Dict[str, str] = {}
+        if default_limit is not None:
+            for u in uuids:
+                limits[u] = str(default_limit)
+        for k, v in self.items():
+            uuid = self._resolve(k, uuids)
+            limits[uuid] = str(v)
+        return limits
+
+    @staticmethod
+    def _resolve(key: str, uuids: List[str]) -> str:
+        if key in uuids:
+            return key
+        if key.isdigit():
+            idx = int(key)
+            if 0 <= idx < len(uuids):
+                return uuids[idx]
+            raise InvalidDeviceSelector(
+                f"device index {idx} out of range (have {len(uuids)} devices)"
+            )
+        raise InvalidDeviceSelector(f"invalid device selector: {key!r}")
+
+
+def _per_proc_codec():
+    def dec(v, strict):
+        if v is None:
+            return None
+        return PerProcessHbmLimit.from_dict(v, strict=strict)
+
+    def enc(v):
+        if v is None:
+            return None
+        return v.to_dict()
+
+    return dec, enc
+
+
+@dataclass
+class MultiplexingConfig(Serde):
+    """MPS-analog config (sharing.go MpsConfig)."""
+
+    # Percentage of chip compute each client may use (active-thread-% analog).
+    default_compute_share_percentage: Optional[int] = None
+    # HBM limit applied to all devices unless overridden per-device.
+    default_hbm_limit: Optional[Quantity] = None
+    # Per-device overrides keyed by index or UUID.
+    default_per_device_hbm_limit: Optional[PerProcessHbmLimit] = None
+
+    FIELDS = {
+        "defaultComputeSharePercentage": Field("default_compute_share_percentage"),
+        "defaultHbmLimit": Field("default_hbm_limit", *quantity_codec()),
+        "defaultPerDeviceHbmLimit": Field(
+            "default_per_device_hbm_limit", *_per_proc_codec()
+        ),
+    }
+
+    def validate(self) -> None:
+        p = self.default_compute_share_percentage
+        if p is not None and not (0 < p <= 100):
+            raise ApiError(
+                f"defaultComputeSharePercentage must be in (0, 100], got {p}"
+            )
+        if self.default_hbm_limit is not None and self.default_hbm_limit.to_bytes() <= 0:
+            raise InvalidLimit(
+                f"defaultHbmLimit must be positive, got {self.default_hbm_limit}"
+            )
+        for k, v in (self.default_per_device_hbm_limit or {}).items():
+            if v.to_bytes() <= 0:
+                raise InvalidLimit(f"per-device HBM limit for {k!r} must be positive")
+
+    def normalized_limits(self, uuids: List[str]) -> Dict[str, str]:
+        per_dev = self.default_per_device_hbm_limit or PerProcessHbmLimit()
+        return per_dev.normalize(uuids, self.default_hbm_limit)
+
+
+@dataclass
+class TpuSharing(Serde):
+    """Sharing settings for a full-chip device (sharing.go GpuSharing)."""
+
+    strategy: str = ""
+    time_slicing_config: Optional[TimeSlicingConfig] = None
+    multiplexing_config: Optional[MultiplexingConfig] = None
+
+    FIELDS = {
+        "strategy": Field("strategy", required=True),
+        "timeSlicingConfig": Field("time_slicing_config", *nested(TimeSlicingConfig)),
+        "multiplexingConfig": Field("multiplexing_config", *nested(MultiplexingConfig)),
+    }
+
+    def is_time_slicing(self) -> bool:
+        return self.strategy == TIME_SLICING_STRATEGY
+
+    def is_multiplexing(self) -> bool:
+        return self.strategy == MULTIPLEXING_STRATEGY
+
+    def get_time_slicing_config(self) -> Optional[TimeSlicingConfig]:
+        if self.strategy != TIME_SLICING_STRATEGY:
+            raise ApiError(f"strategy is not set to {TIME_SLICING_STRATEGY!r}")
+        if self.multiplexing_config is not None:
+            raise ApiError(
+                f"cannot use multiplexingConfig with the "
+                f"{TIME_SLICING_STRATEGY!r} strategy"
+            )
+        return self.time_slicing_config
+
+    def get_multiplexing_config(self) -> Optional[MultiplexingConfig]:
+        if self.strategy != MULTIPLEXING_STRATEGY:
+            raise ApiError(f"strategy is not set to {MULTIPLEXING_STRATEGY!r}")
+        if self.time_slicing_config is not None:
+            raise ApiError(
+                f"cannot use timeSlicingConfig with the "
+                f"{MULTIPLEXING_STRATEGY!r} strategy"
+            )
+        return self.multiplexing_config
+
+    def validate(self) -> None:
+        from tpu_dra.infra import featuregates as fg
+
+        if self.strategy == TIME_SLICING_STRATEGY:
+            if not fg.enabled(fg.TIME_SLICING_SETTINGS):
+                raise ApiError(
+                    "time-slicing settings require the TimeSlicingSettings "
+                    "feature gate"
+                )
+            if self.multiplexing_config is not None:
+                raise ApiError("multiplexingConfig invalid with TimeSlicing strategy")
+            if self.time_slicing_config is not None:
+                self.time_slicing_config.validate()
+        elif self.strategy == MULTIPLEXING_STRATEGY:
+            if not fg.enabled(fg.MULTIPLEXING_SUPPORT):
+                raise ApiError(
+                    "multiplexing requires the MultiplexingSupport feature gate"
+                )
+            if self.time_slicing_config is not None:
+                raise ApiError("timeSlicingConfig invalid with Multiplexing strategy")
+            if self.multiplexing_config is not None:
+                self.multiplexing_config.validate()
+        else:
+            raise ApiError(f"unknown sharing strategy: {self.strategy!r}")
+
+
+@dataclass
+class TpuSubsliceSharing(Serde):
+    """Sharing settings for a sub-slice device (sharing.go MigDeviceSharing):
+    sub-slices support multiplexing but not time-slicing settings."""
+
+    strategy: str = ""
+    multiplexing_config: Optional[MultiplexingConfig] = None
+
+    FIELDS = {
+        "strategy": Field("strategy", required=True),
+        "multiplexingConfig": Field("multiplexing_config", *nested(MultiplexingConfig)),
+    }
+
+    def is_time_slicing(self) -> bool:
+        return self.strategy == TIME_SLICING_STRATEGY
+
+    def is_multiplexing(self) -> bool:
+        return self.strategy == MULTIPLEXING_STRATEGY
+
+    def get_multiplexing_config(self) -> Optional[MultiplexingConfig]:
+        if self.strategy != MULTIPLEXING_STRATEGY:
+            raise ApiError(f"strategy is not set to {MULTIPLEXING_STRATEGY!r}")
+        return self.multiplexing_config
+
+    def validate(self) -> None:
+        from tpu_dra.infra import featuregates as fg
+
+        if self.strategy == TIME_SLICING_STRATEGY:
+            return  # accepted as a no-op on sub-slices (reference parity)
+        if self.strategy == MULTIPLEXING_STRATEGY:
+            if not fg.enabled(fg.MULTIPLEXING_SUPPORT):
+                raise ApiError(
+                    "multiplexing requires the MultiplexingSupport feature gate"
+                )
+            if self.multiplexing_config is not None:
+                self.multiplexing_config.validate()
+            return
+        raise ApiError(f"unknown sharing strategy: {self.strategy!r}")

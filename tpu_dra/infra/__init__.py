@@ -1,0 +1,4 @@
+"""Shared infrastructure: feature gates, file locks, work queues, flags.
+
+Reference analog: pkg/{featuregates,flags,flock,workqueue}, internal/common.
+"""

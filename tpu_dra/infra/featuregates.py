@@ -1,0 +1,203 @@
+"""Versioned feature gates.
+
+Reference analog: pkg/featuregates/featuregates.go:32-119 (gate registry with
+versioned alpha/beta specs) and :170-192 (cross-gate dependency validation).
+
+The TPU driver keeps the same gate *semantics* (per-gate pre-release stage
+bound to the component version, lockToDefault for GA gates, dependency
+validation) while swapping the GPU-specific gates for their TPU analogs:
+
+- ``TimeSlicingSettings``          -> kept (cooperative runtime time-share)
+- ``MPSSupport``                   -> ``MultiplexingSupport`` (per-process chip
+                                      multiplexing via the TPU runtime)
+- ``IMEXDaemonsWithDNSNames``      -> ``SliceDaemonsWithDNSNames`` (stable DNS
+                                      names for slice-daemon rendezvous)
+- ``DynamicMIG``                   -> ``DynamicSubslice`` (ICI-contiguous TPU
+                                      sub-slice reshape)
+- ``NVMLDeviceHealthCheck``        -> ``DeviceHealthCheck`` (chip health via
+                                      tpulib/sysfs events)
+- ``CrashOnNVLinkFabricErrors``    -> ``CrashOnICIFabricErrors``
+- ``PassthroughSupport``, ``ComputeDomainCliques`` -> kept as-is.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+class Stage(str, Enum):
+    ALPHA = "ALPHA"
+    BETA = "BETA"
+    GA = ""
+
+
+@dataclass(frozen=True)
+class VersionedSpec:
+    """One (version, default, stage) entry; the newest entry whose version is
+    <= the component version wins (k8s component-base versioned-gate model)."""
+
+    version: Tuple[int, int]
+    default: bool
+    stage: Stage
+    lock_to_default: bool = False
+
+
+# Gate name constants.
+TIME_SLICING_SETTINGS = "TimeSlicingSettings"
+MULTIPLEXING_SUPPORT = "MultiplexingSupport"
+SLICE_DAEMONS_WITH_DNS_NAMES = "SliceDaemonsWithDNSNames"
+PASSTHROUGH_SUPPORT = "PassthroughSupport"
+DEVICE_HEALTH_CHECK = "DeviceHealthCheck"
+DYNAMIC_SUBSLICE = "DynamicSubslice"
+COMPUTE_DOMAIN_CLIQUES = "ComputeDomainCliques"
+CRASH_ON_ICI_FABRIC_ERRORS = "CrashOnICIFabricErrors"
+CONTEXTUAL_LOGGING = "ContextualLogging"
+
+DEFAULT_GATE_SPECS: Dict[str, List[VersionedSpec]] = {
+    TIME_SLICING_SETTINGS: [VersionedSpec((0, 1), False, Stage.ALPHA)],
+    MULTIPLEXING_SUPPORT: [VersionedSpec((0, 1), False, Stage.ALPHA)],
+    SLICE_DAEMONS_WITH_DNS_NAMES: [VersionedSpec((0, 1), True, Stage.BETA)],
+    PASSTHROUGH_SUPPORT: [VersionedSpec((0, 1), False, Stage.ALPHA)],
+    DYNAMIC_SUBSLICE: [VersionedSpec((0, 1), False, Stage.ALPHA)],
+    DEVICE_HEALTH_CHECK: [VersionedSpec((0, 1), False, Stage.ALPHA)],
+    COMPUTE_DOMAIN_CLIQUES: [VersionedSpec((0, 1), True, Stage.BETA)],
+    CRASH_ON_ICI_FABRIC_ERRORS: [VersionedSpec((0, 1), True, Stage.BETA)],
+    # Logging gate override mirrors featuregates.go:160-163.
+    CONTEXTUAL_LOGGING: [VersionedSpec((0, 1), True, Stage.BETA)],
+}
+
+
+class FeatureGateError(ValueError):
+    pass
+
+
+@dataclass
+class FeatureGates:
+    """Mutable versioned feature-gate set."""
+
+    component_version: Tuple[int, int] = (0, 1)
+    specs: Dict[str, List[VersionedSpec]] = field(
+        default_factory=lambda: {k: list(v) for k, v in DEFAULT_GATE_SPECS.items()}
+    )
+    _overrides: Dict[str, bool] = field(default_factory=dict)
+
+    def _active_spec(self, name: str) -> Optional[VersionedSpec]:
+        entries = self.specs.get(name)
+        if not entries:
+            return None
+        candidates = [s for s in entries if s.version <= self.component_version]
+        if not candidates:
+            return None
+        return max(candidates, key=lambda s: s.version)
+
+    def known(self) -> List[str]:
+        return sorted(self.specs)
+
+    def enabled(self, name: str) -> bool:
+        spec = self._active_spec(name)
+        if spec is None:
+            raise FeatureGateError(f"unknown feature gate: {name}")
+        if name in self._overrides and not spec.lock_to_default:
+            return self._overrides[name]
+        return spec.default
+
+    def set(self, name: str, value: bool) -> None:
+        spec = self._active_spec(name)
+        if spec is None:
+            raise FeatureGateError(f"unknown feature gate: {name}")
+        if spec.lock_to_default and value != spec.default:
+            raise FeatureGateError(
+                f"cannot set feature gate {name}: locked to default {spec.default}"
+            )
+        self._overrides[name] = value
+
+    def set_from_map(self, values: Dict[str, bool]) -> None:
+        for k, v in values.items():
+            self.set(k, v)
+
+    def set_from_string(self, s: str) -> None:
+        """Parse ``Gate=true,Other=false`` (k8s --feature-gates syntax)."""
+        if not s.strip():
+            return
+        for part in s.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise FeatureGateError(f"missing '=' in feature gate entry: {part!r}")
+            k, _, v = part.partition("=")
+            lv = v.strip().lower()
+            if lv not in ("true", "false"):
+                raise FeatureGateError(f"invalid bool for gate {k!r}: {v!r}")
+            self.set(k.strip(), lv == "true")
+
+    def to_map(self) -> Dict[str, bool]:
+        return {name: self.enabled(name) for name in self.known()}
+
+    def known_features(self) -> List[str]:
+        """Human-readable descriptions (featuregates.go KnownFeatures analog)."""
+        out = []
+        for name in self.known():
+            spec = self._active_spec(name)
+            if spec is None:
+                continue
+            stage = spec.stage.value or "GA"
+            out.append(f"{name}={spec.default} ({stage} - default={spec.default})")
+        return out
+
+    def validate(self) -> None:
+        """Cross-gate dependency validation.
+
+        Mirrors featuregates.go:170-192: cliques require DNS-named daemons;
+        dynamic repartitioning is mutually exclusive with passthrough, device
+        health-checking, and multiplexing (a reshape invalidates the device
+        inventory those subsystems cache).
+        """
+        if self.enabled(COMPUTE_DOMAIN_CLIQUES) and not self.enabled(
+            SLICE_DAEMONS_WITH_DNS_NAMES
+        ):
+            raise FeatureGateError(
+                f"feature gate {COMPUTE_DOMAIN_CLIQUES} requires "
+                f"{SLICE_DAEMONS_WITH_DNS_NAMES} to also be enabled"
+            )
+        for other in (PASSTHROUGH_SUPPORT, DEVICE_HEALTH_CHECK, MULTIPLEXING_SUPPORT):
+            if self.enabled(DYNAMIC_SUBSLICE) and self.enabled(other):
+                raise FeatureGateError(
+                    f"feature gate {DYNAMIC_SUBSLICE} is currently mutually "
+                    f"exclusive with {other}"
+                )
+
+
+_singleton: Optional[FeatureGates] = None
+_singleton_lock = threading.Lock()
+
+
+def feature_gates() -> FeatureGates:
+    """Package-level singleton (featuregates.go FeatureGates())."""
+    global _singleton
+    if _singleton is None:
+        with _singleton_lock:
+            if _singleton is None:
+                _singleton = FeatureGates()
+    return _singleton
+
+
+def reset_for_tests(gates: Optional[FeatureGates] = None) -> None:
+    global _singleton
+    with _singleton_lock:
+        _singleton = gates
+
+
+def enabled(name: str) -> bool:
+    return feature_gates().enabled(name)
+
+
+def validate() -> None:
+    feature_gates().validate()
+
+
+def to_map() -> Dict[str, bool]:
+    return feature_gates().to_map()

@@ -1,0 +1,169 @@
+"""CLI/env flag plumbing shared by all binaries.
+
+Reference analog: pkg/flags/*.go — every CLI flag has an env-var mirror
+(cmd/gpu-kubelet-plugin/main.go:83-166 uses urfave/cli EnvVars), plus grouped
+configs for the kube client (QPS/burst), leader election, logging verbosity,
+and the feature-gate bridge (pkg/flags/featuregates.go:1-54).
+
+Python rendering: a thin layer over argparse in which every option declares an
+env-var fallback, and config dataclasses that binaries share.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+from tpu_dra.infra import featuregates
+
+log = logging.getLogger(__name__)
+
+
+def env_default(env: str, default: Any = None, cast=str) -> Any:
+    raw = os.environ.get(env)
+    if raw is None:
+        return default
+    try:
+        if cast is bool:
+            return raw.strip().lower() in ("1", "true", "yes", "on")
+        return cast(raw)
+    except (TypeError, ValueError):
+        log.warning("ignoring invalid value for %s: %r", env, raw)
+        return default
+
+
+@dataclass
+class KubeClientConfig:
+    """pkg/flags/kubeclient.go analog: api endpoint + client-side rate limits."""
+
+    kubeconfig: Optional[str] = None
+    kube_api_qps: float = 5.0
+    kube_api_burst: int = 10
+
+    @classmethod
+    def add_flags(cls, p: argparse.ArgumentParser) -> None:
+        p.add_argument(
+            "--kubeconfig",
+            default=env_default("KUBECONFIG"),
+            help="Absolute path to the kubeconfig file (in-cluster config if unset)",
+        )
+        p.add_argument(
+            "--kube-api-qps",
+            type=float,
+            default=env_default("KUBE_API_QPS", 5.0, float),
+            help="QPS to use while communicating with the kubernetes apiserver",
+        )
+        p.add_argument(
+            "--kube-api-burst",
+            type=int,
+            default=env_default("KUBE_API_BURST", 10, int),
+            help="Burst to use while communicating with the kubernetes apiserver",
+        )
+
+    @classmethod
+    def from_args(cls, args: argparse.Namespace) -> "KubeClientConfig":
+        return cls(
+            kubeconfig=args.kubeconfig,
+            kube_api_qps=args.kube_api_qps,
+            kube_api_burst=args.kube_api_burst,
+        )
+
+    def new_client(self):
+        from tpu_dra.k8sclient.rest import KubeClient
+
+        return KubeClient.from_config(
+            kubeconfig=self.kubeconfig,
+            qps=self.kube_api_qps,
+            burst=self.kube_api_burst,
+        )
+
+
+@dataclass
+class LeaderElectionConfig:
+    """pkg/flags/leaderelection.go:25-85 analog (lease-based leader election)."""
+
+    enabled: bool = False
+    namespace: str = "default"
+    lease_name: str = "tpu-dra-driver-controller"
+    lease_duration: float = 15.0
+    renew_deadline: float = 10.0
+    retry_period: float = 2.0
+
+    @classmethod
+    def add_flags(cls, p: argparse.ArgumentParser) -> None:
+        p.add_argument(
+            "--leader-election",
+            action="store_true",
+            default=env_default("LEADER_ELECTION", False, bool),
+            help="Enable lease-based leader election",
+        )
+        p.add_argument(
+            "--leader-election-namespace",
+            default=env_default("LEADER_ELECTION_NAMESPACE", "default"),
+        )
+        p.add_argument(
+            "--leader-election-lease-duration",
+            type=float,
+            default=env_default("LEADER_ELECTION_LEASE_DURATION", 15.0, float),
+        )
+
+    @classmethod
+    def from_args(cls, args: argparse.Namespace) -> "LeaderElectionConfig":
+        return cls(
+            enabled=args.leader_election,
+            namespace=args.leader_election_namespace,
+            lease_duration=args.leader_election_lease_duration,
+        )
+
+
+@dataclass
+class LoggingConfig:
+    """pkg/flags/logging.go analog: numeric verbosity mapped to levels."""
+
+    verbosity: int = 2
+
+    @classmethod
+    def add_flags(cls, p: argparse.ArgumentParser) -> None:
+        p.add_argument(
+            "-v",
+            "--verbosity",
+            type=int,
+            default=env_default("LOG_VERBOSITY", 2, int),
+            help="Log verbosity (klog-style: 0-3 info, >=6 per-step timing)",
+        )
+
+    @classmethod
+    def from_args(cls, args: argparse.Namespace) -> "LoggingConfig":
+        return cls(verbosity=args.verbosity)
+
+    def apply(self) -> None:
+        level = logging.DEBUG if self.verbosity >= 4 else logging.INFO
+        logging.basicConfig(
+            level=level,
+            format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
+        )
+
+
+def add_feature_gate_flag(p: argparse.ArgumentParser) -> None:
+    """pkg/flags/featuregates.go bridge: --feature-gates Gate=true,..."""
+    p.add_argument(
+        "--feature-gates",
+        default=env_default("FEATURE_GATES", ""),
+        help="Comma-separated list of Gate=bool pairs "
+        + "; ".join(featuregates.feature_gates().known_features()),
+    )
+
+
+def apply_feature_gates(args: argparse.Namespace) -> None:
+    featuregates.feature_gates().set_from_string(args.feature_gates or "")
+    featuregates.validate()
+
+
+def log_startup_config(args: argparse.Namespace) -> None:
+    """pkg/flags/utils.go analog: one-shot dump of resolved config."""
+    pairs = ", ".join(f"{k}={v!r}" for k, v in sorted(vars(args).items()))
+    log.info("startup configuration: %s", pairs)
+    log.info("feature gates: %s", featuregates.to_map())

@@ -1,0 +1,44 @@
+"""Debug signal handlers.
+
+Reference analog: internal/common/util.go:29-69 — SIGUSR2 dumps all goroutine
+stacks to /tmp/goroutine-stacks.dump. Python equivalent dumps all thread
+stacks; armed at startup of every binary (cmd/*/main.go).
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import logging
+import signal
+import sys
+import threading
+import traceback
+
+log = logging.getLogger(__name__)
+
+STACK_DUMP_PATH = "/tmp/thread-stacks.dump"
+
+
+def _dump_stacks(signum, frame) -> None:
+    try:
+        with open(STACK_DUMP_PATH, "w") as f:
+            for tid, fr in sys._current_frames().items():
+                name = next(
+                    (t.name for t in threading.enumerate() if t.ident == tid),
+                    str(tid),
+                )
+                f.write(f"--- thread {name} ({tid}) ---\n")
+                traceback.print_stack(fr, file=f)
+        log.info("wrote thread stack dump to %s", STACK_DUMP_PATH)
+    except Exception as e:  # never let a debug handler kill the process
+        log.warning("failed to write stack dump: %s", e)
+
+
+def start_debug_signal_handlers() -> None:
+    """Arm SIGUSR2 → stack dump; also enable faulthandler on SIGSEGV etc."""
+    try:
+        signal.signal(signal.SIGUSR2, _dump_stacks)
+        faulthandler.enable()
+    except (ValueError, OSError) as e:
+        # Not the main thread / restricted environment: debug-only feature.
+        log.debug("debug signal handlers unavailable: %s", e)

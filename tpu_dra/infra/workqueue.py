@@ -1,0 +1,235 @@
+"""Rate-limited work queue with per-key coalescing.
+
+Reference analog: pkg/workqueue/workqueue.go:31-197 and jitterlimiter.go:31-66.
+
+Semantics preserved from the reference:
+
+- items carry a key + object + callback; failures are retried with per-item
+  exponential backoff combined (max) with a global token-bucket limiter
+  (DefaultPrepUnprepRateLimiter: 250ms→3s per item, 5/s burst 10 global);
+- **per-key coalescing**: when a newer item is enqueued under the same key,
+  retries of an older failed item for that key are forgotten
+  (workqueue.go:152-190) — a stale reconcile can never overwrite a newer one;
+- optional relative jitter around the inner backoff delay
+  (jitterlimiter.go:31-66) to de-synchronize herds of retries.
+"""
+
+from __future__ import annotations
+
+import heapq
+import logging
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+log = logging.getLogger(__name__)
+
+
+class RateLimiter:
+    def when(self, key: Any) -> float:
+        raise NotImplementedError
+
+    def forget(self, key: Any) -> None:
+        pass
+
+    def num_requeues(self, key: Any) -> int:
+        return 0
+
+
+class ItemExponentialFailureRateLimiter(RateLimiter):
+    """Per-item exponential backoff: base * 2^failures, capped."""
+
+    def __init__(self, base: float, cap: float):
+        self.base = base
+        self.cap = cap
+        self._failures: Dict[Any, int] = {}
+        self._lock = threading.Lock()
+
+    def when(self, key: Any) -> float:
+        with self._lock:
+            n = self._failures.get(key, 0)
+            self._failures[key] = n + 1
+        return min(self.base * (2**n), self.cap)
+
+    def forget(self, key: Any) -> None:
+        with self._lock:
+            self._failures.pop(key, None)
+
+    def num_requeues(self, key: Any) -> int:
+        with self._lock:
+            return self._failures.get(key, 0)
+
+
+class BucketRateLimiter(RateLimiter):
+    """Global token bucket: qps with burst; returns the wait for a token."""
+
+    def __init__(self, qps: float, burst: int):
+        self.qps = qps
+        self.burst = burst
+        self._tokens = float(burst)
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def when(self, key: Any) -> float:
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(self.burst, self._tokens + (now - self._last) * self.qps)
+            self._last = now
+            self._tokens -= 1.0
+            if self._tokens >= 0:
+                return 0.0
+            return -self._tokens / self.qps
+
+
+class MaxOfRateLimiter(RateLimiter):
+    def __init__(self, *limiters: RateLimiter):
+        self.limiters = limiters
+
+    def when(self, key: Any) -> float:
+        return max(l.when(key) for l in self.limiters)
+
+    def forget(self, key: Any) -> None:
+        for l in self.limiters:
+            l.forget(key)
+
+    def num_requeues(self, key: Any) -> int:
+        return max(l.num_requeues(key) for l in self.limiters)
+
+
+class JitterRateLimiter(RateLimiter):
+    """Relative jitter of width ``factor`` centered on the inner delay
+    (jitterlimiter.go:31-66)."""
+
+    def __init__(self, inner: RateLimiter, factor: float):
+        if factor >= 1.0:
+            raise ValueError("factor must be < 1.0")
+        self.inner = inner
+        self.factor = factor
+
+    def when(self, key: Any) -> float:
+        d = self.inner.when(key)
+        jitter = d * self.factor * (random.random() - 0.5)
+        return max(0.0, d + jitter)
+
+    def forget(self, key: Any) -> None:
+        self.inner.forget(key)
+
+    def num_requeues(self, key: Any) -> int:
+        return self.inner.num_requeues(key)
+
+
+def default_prep_unprep_rate_limiter() -> RateLimiter:
+    return MaxOfRateLimiter(
+        ItemExponentialFailureRateLimiter(0.25, 3.0),
+        BucketRateLimiter(5.0, 10),
+    )
+
+
+def default_cd_daemon_rate_limiter() -> RateLimiter:
+    return JitterRateLimiter(ItemExponentialFailureRateLimiter(0.005, 6.0), 0.5)
+
+
+def default_controller_rate_limiter() -> RateLimiter:
+    return MaxOfRateLimiter(
+        ItemExponentialFailureRateLimiter(0.005, 1000.0),
+        BucketRateLimiter(10.0, 100),
+    )
+
+
+@dataclass(order=True)
+class _Scheduled:
+    due: float
+    seq: int
+    item: "WorkItem" = field(compare=False)
+
+
+@dataclass(eq=False)  # identity hash: the rate limiter is keyed per item
+class WorkItem:
+    key: str
+    obj: Any
+    callback: Callable[[Any], None]
+
+
+class WorkQueue:
+    """Threaded work queue; ``run()`` consumes until ``shutdown()``."""
+
+    def __init__(self, rate_limiter: Optional[RateLimiter] = None):
+        self._rl = rate_limiter or default_controller_rate_limiter()
+        self._heap: list[_Scheduled] = []
+        self._cond = threading.Condition()
+        self._active_ops: Dict[str, WorkItem] = {}
+        self._seq = 0
+        self._shutdown = False
+
+    def enqueue(self, obj: Any, callback: Callable[[Any], None], key: str = "") -> None:
+        # Backoff state is per *item* (matching the reference, which rate-limits
+        # on the WorkItem pointer): a fresh enqueue always starts from the
+        # limiter's base delay, independent of other items' failure history.
+        item = WorkItem(key=key, obj=obj, callback=callback)
+        delay = self._rl.when(item)
+        with self._cond:
+            if key:
+                self._active_ops[key] = item
+            self._push(item, delay)
+            self._cond.notify()
+
+    def _push(self, item: WorkItem, delay: float) -> None:
+        self._seq += 1
+        heapq.heappush(
+            self._heap, _Scheduled(time.monotonic() + delay, self._seq, item)
+        )
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    def run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._shutdown and (
+                    not self._heap or self._heap[0].due > time.monotonic()
+                ):
+                    wait = None
+                    if self._heap:
+                        wait = max(0.0, self._heap[0].due - time.monotonic())
+                    self._cond.wait(timeout=wait)
+                if self._shutdown:
+                    return
+                item = heapq.heappop(self._heap).item
+            self._process(item)
+
+    def run_in_thread(self) -> threading.Thread:
+        t = threading.Thread(target=self.run, daemon=True, name="workqueue")
+        t.start()
+        return t
+
+    def _process(self, item: WorkItem) -> None:
+        attempts = self._rl.num_requeues(item)
+        try:
+            item.callback(item.obj)
+        except Exception as e:
+            # Expected, retryable errors in an eventually-consistent system:
+            # log at info, not error (workqueue.go:166-170).
+            log.info("Reconcile: %s (attempt %d)", e, attempts)
+            with self._cond:
+                current = self._active_ops.get(item.key)
+                if item.key and current is not None and current is not item:
+                    # A newer item exists for this key; drop this retry
+                    # (per-key coalescing, workqueue.go:171-176).
+                    log.info(
+                        "Do not re-enqueue failed work item with key '%s': "
+                        "a newer item was enqueued",
+                        item.key,
+                    )
+                    self._rl.forget(item)
+                else:
+                    self._push(item, self._rl.when(item))
+                self._cond.notify()
+        else:
+            with self._cond:
+                if item.key and self._active_ops.get(item.key) is item:
+                    del self._active_ops[item.key]
+                self._rl.forget(item)

@@ -1,0 +1,32 @@
+"""Version stamping.
+
+Reference analog: internal/info/version.go:22-43 (ldflags injection wired in
+Makefile:104-107). Here the build injects GIT_COMMIT via the environment or
+the generated ``_build_info.py``; defaults keep dev builds identifiable.
+"""
+
+from __future__ import annotations
+
+import os
+
+__version__ = "0.1.0-dev"
+
+DRIVER_NAME = "tpu.google.com"
+CD_DRIVER_NAME = "compute-domain.tpu.google.com"
+
+# API group served by our CRDs and opaque device configs.
+API_GROUP = "resource.tpu.google.com"
+API_VERSION = "v1beta1"
+
+
+def git_commit() -> str:
+    try:
+        from tpu_dra import _build_info  # type: ignore
+
+        return _build_info.GIT_COMMIT
+    except Exception:
+        return os.environ.get("TPU_DRA_GIT_COMMIT", "unknown")
+
+
+def version_string() -> str:
+    return f"{__version__}+{git_commit()[:12]}"
