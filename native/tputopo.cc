@@ -1,0 +1,159 @@
+// libtputopo: native helpers for TPU topology discovery and sub-slice
+// placement. C ABI consumed from Python via ctypes
+// (tpu_dra/tpulib/native.py).
+//
+// Reference analog: the native-code surface the NVIDIA driver reaches
+// through cgo -- NVML device enumeration (vendored go-nvml) and PCI sysfs
+// walking (go-nvlib/nvpci). The TPU build has no NVML, so the equivalents
+// live here:
+//
+//  - tputopo_pci_scan: walk a sysfs tree for Google TPU functions
+//    (vendor 0x1ae0), emitting one JSON object per device with address,
+//    device id, numa node, iommu group and bound driver.
+//  - tputopo_enumerate_placements / tputopo_placement_free: the
+//    mesh-coordinate allocator for dynamic sub-slice reshape (the MIG
+//    placement algebra analog, nvlib.go:1129-1210) -- placements are
+//    axis-aligned contiguous blocks with per-dimension start alignment so
+//    the advertised inventory forms a non-fragmenting partition tree.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <dirent.h>
+#include <string>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+constexpr const char* kGoogleVendor = "0x1ae0";
+
+// Read a small sysfs attribute file; returns trimmed contents or "".
+std::string ReadAttr(const std::string& path) {
+  FILE* f = fopen(path.c_str(), "r");
+  if (!f) return "";
+  char buf[256];
+  size_t n = fread(buf, 1, sizeof(buf) - 1, f);
+  fclose(f);
+  buf[n] = '\0';
+  std::string s(buf);
+  while (!s.empty() && (s.back() == '\n' || s.back() == ' ')) s.pop_back();
+  return s;
+}
+
+// Resolve the basename of a symlink (e.g. driver -> .../vfio-pci).
+std::string LinkBase(const std::string& path) {
+  char buf[512];
+  ssize_t n = readlink(path.c_str(), buf, sizeof(buf) - 1);
+  if (n <= 0) return "";
+  buf[n] = '\0';
+  std::string s(buf);
+  size_t pos = s.find_last_of('/');
+  return pos == std::string::npos ? s : s.substr(pos + 1);
+}
+
+void AppendJsonStr(std::string& out, const char* key, const std::string& val,
+                   bool first = false) {
+  if (!first) out += ",";
+  out += "\"";
+  out += key;
+  out += "\":\"";
+  for (char c : val) {
+    if (c == '"' || c == '\\') out += '\\';
+    out += c;
+  }
+  out += "\"";
+}
+
+}  // namespace
+
+extern "C" {
+
+// Scan `<sysfs_root>/bus/pci/devices` for Google TPU functions. Writes a
+// JSON array into `out` (NUL-terminated). Returns the number of bytes
+// written (excluding NUL), or -1 when the buffer is too small / the tree is
+// unreadable. An empty tree yields "[]".
+int tputopo_pci_scan(const char* sysfs_root, char* out, int cap) {
+  std::string base = std::string(sysfs_root) + "/bus/pci/devices";
+  DIR* dir = opendir(base.c_str());
+  std::string json = "[";
+  bool first = true;
+  if (dir) {
+    std::vector<std::string> names;
+    while (struct dirent* e = readdir(dir)) {
+      if (e->d_name[0] == '.') continue;
+      names.push_back(e->d_name);
+    }
+    closedir(dir);
+    // Deterministic order: sysfs readdir order is arbitrary.
+    std::sort(names.begin(), names.end());
+    for (const auto& name : names) {
+      std::string dev = base + "/" + name;
+      if (ReadAttr(dev + "/vendor") != kGoogleVendor) continue;
+      if (!first) json += ",";
+      first = false;
+      json += "{";
+      AppendJsonStr(json, "address", name, /*first=*/true);
+      AppendJsonStr(json, "device", ReadAttr(dev + "/device"));
+      AppendJsonStr(json, "numa_node", ReadAttr(dev + "/numa_node"));
+      AppendJsonStr(json, "driver", LinkBase(dev + "/driver"));
+      AppendJsonStr(json, "iommu_group", LinkBase(dev + "/iommu_group"));
+      json += "}";
+    }
+  }
+  json += "]";
+  if ((int)json.size() + 1 > cap) return -1;
+  memcpy(out, json.c_str(), json.size() + 1);
+  return (int)json.size();
+}
+
+// Enumerate aligned placements of `shape` within `mesh` (both int[3]).
+// A start coordinate is valid when start[d] % shape[d] == 0 and the block
+// fits. Writes (x,y,z) triples into `out`; returns the placement count, or
+// -1 when `out` is too small or the inputs are degenerate.
+int tputopo_enumerate_placements(const int* mesh, const int* shape, int* out,
+                                 int cap) {
+  for (int d = 0; d < 3; d++) {
+    if (mesh[d] <= 0 || shape[d] <= 0 || shape[d] > mesh[d]) return -1;
+  }
+  int count = 0;
+  for (int z = 0; z + shape[2] <= mesh[2]; z += shape[2]) {
+    for (int y = 0; y + shape[1] <= mesh[1]; y += shape[1]) {
+      for (int x = 0; x + shape[0] <= mesh[0]; x += shape[0]) {
+        if ((count + 1) * 3 > cap) return -1;
+        out[count * 3 + 0] = x;
+        out[count * 3 + 1] = y;
+        out[count * 3 + 2] = z;
+        count++;
+      }
+    }
+  }
+  return count;
+}
+
+// Is the placement at `start` free, given `busy` -- a byte per mesh
+// coordinate (index = x + mesh_x*(y + mesh_y*z); nonzero = occupied)?
+// Returns 1 free, 0 occupied, -1 invalid (out of bounds / misaligned).
+int tputopo_placement_free(const int* mesh, const int* shape, const int* start,
+                           const uint8_t* busy) {
+  for (int d = 0; d < 3; d++) {
+    if (mesh[d] <= 0 || shape[d] <= 0) return -1;  // degenerate input
+    if (start[d] < 0 || start[d] % shape[d] != 0 ||
+        start[d] + shape[d] > mesh[d]) {
+      return -1;
+    }
+  }
+  for (int dz = 0; dz < shape[2]; dz++) {
+    for (int dy = 0; dy < shape[1]; dy++) {
+      for (int dx = 0; dx < shape[0]; dx++) {
+        int idx = (start[0] + dx) +
+                  mesh[0] * ((start[1] + dy) + mesh[1] * (start[2] + dz));
+        if (busy[idx]) return 0;
+      }
+    }
+  }
+  return 1;
+}
+
+}  // extern "C"

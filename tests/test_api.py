@@ -362,3 +362,21 @@ def test_quantity_error_is_api_error():
 def test_quantity_total_ordering():
     assert Quantity.parse("1") <= Quantity.parse("2")
     assert Quantity.parse("2Gi") >= Quantity.parse("1Gi")
+
+
+def test_objectmeta_accepts_apiserver_managed_fields():
+    """Objects fetched from a real cluster strict-decode (managedFields etc.)."""
+    cd = ComputeDomain.from_dict(
+        {
+            "metadata": {
+                "name": "cd",
+                "managedFields": [{"manager": "kubectl"}],
+                "selfLink": "/x",
+                "generateName": "cd-",
+                "deletionGracePeriodSeconds": 0,
+            },
+            "spec": {"numNodes": 1},
+        },
+        strict=True,
+    )
+    assert cd.metadata.name == "cd"

@@ -1,0 +1,241 @@
+"""Fake-cluster + informer semantics tests.
+
+The fake is load-bearing for every controller/plugin test, so its apiserver
+semantics (resourceVersion conflicts, watch ordering, finalizer-gated
+deletion) are pinned here.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tpu_dra.k8sclient import (
+    COMPUTE_DOMAINS,
+    PODS,
+    ApiConflict,
+    ApiNotFound,
+    FakeCluster,
+    Informer,
+    ResourceClient,
+)
+
+
+def cd_obj(name="cd1", ns="default", **spec):
+    return {
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "ComputeDomain",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"numNodes": 2, **spec},
+    }
+
+
+@pytest.fixture
+def fc():
+    c = FakeCluster()
+    yield c
+    c.clear_watches()
+
+
+@pytest.fixture
+def cds(fc):
+    return ResourceClient(fc, COMPUTE_DOMAINS)
+
+
+def test_crud_roundtrip(cds):
+    created = cds.create(cd_obj())
+    assert created["metadata"]["uid"]
+    assert created["metadata"]["resourceVersion"] == "1"
+    got = cds.get("cd1", "default")
+    assert got["spec"]["numNodes"] == 2
+    assert cds.try_get("nope", "default") is None
+    with pytest.raises(ApiNotFound):
+        cds.get("nope", "default")
+    with pytest.raises(ApiConflict):
+        cds.create(cd_obj())  # duplicate
+
+
+def test_update_conflict_on_stale_rv(cds):
+    cds.create(cd_obj())
+    a = cds.get("cd1", "default")
+    b = cds.get("cd1", "default")
+    a["spec"]["numNodes"] = 3
+    cds.update(a)
+    b["spec"]["numNodes"] = 4
+    with pytest.raises(ApiConflict):
+        cds.update(b)  # stale resourceVersion
+
+
+def test_generation_bumps_on_spec_change_only(cds):
+    cds.create(cd_obj())
+    obj = cds.get("cd1", "default")
+    assert obj["metadata"]["generation"] == 1
+    obj["spec"]["numNodes"] = 8
+    obj = cds.update(obj)
+    assert obj["metadata"]["generation"] == 2
+    obj["status"] = {"status": "Ready"}
+    obj = cds.update_status(obj)
+    assert obj["metadata"]["generation"] == 2  # status change: no bump
+    assert cds.get("cd1", "default")["status"]["status"] == "Ready"
+
+
+def test_update_status_does_not_clobber_spec(cds):
+    cds.create(cd_obj())
+    obj = cds.get("cd1", "default")
+    obj["spec"]["numNodes"] = 99  # local mutation must not leak via /status
+    obj["status"] = {"status": "NotReady"}
+    cds.update_status(obj)
+    assert cds.get("cd1", "default")["spec"]["numNodes"] == 2
+
+
+def test_label_selector_list(cds):
+    o = cd_obj("a")
+    o["metadata"]["labels"] = {"team": "x"}
+    cds.create(o)
+    cds.create(cd_obj("b"))
+    assert [o["metadata"]["name"] for o in cds.list(label_selector={"team": "x"})] == [
+        "a"
+    ]
+    assert len(cds.list(namespace="default")) == 2
+    assert cds.list(namespace="other") == []
+
+
+def test_generate_name(fc):
+    pods = ResourceClient(fc, PODS)
+    p = pods.create(
+        {"metadata": {"generateName": "worker-", "namespace": "default"}, "spec": {}}
+    )
+    assert p["metadata"]["name"].startswith("worker-")
+    assert len(p["metadata"]["name"]) > len("worker-")
+
+
+def test_patch_merge_and_delete_key(cds):
+    cds.create(cd_obj())
+    cds.patch("cd1", {"metadata": {"labels": {"a": "1"}}}, "default")
+    assert cds.get("cd1", "default")["metadata"]["labels"] == {"a": "1"}
+    cds.patch("cd1", {"metadata": {"labels": {"a": None, "b": "2"}}}, "default")
+    assert cds.get("cd1", "default")["metadata"]["labels"] == {"b": "2"}
+
+
+def test_finalizer_gated_deletion(cds, fc):
+    o = cd_obj()
+    o["metadata"]["finalizers"] = ["tpu.google.com/cd"]
+    cds.create(o)
+    cds.delete("cd1", "default")
+    # Parked: deletionTimestamp set, object still present.
+    cur = cds.get("cd1", "default")
+    assert cur["metadata"]["deletionTimestamp"]
+    # Removing the finalizer completes deletion.
+    cur["metadata"]["finalizers"] = []
+    cds.update(cur)
+    assert cds.try_get("cd1", "default") is None
+
+
+def test_delete_without_finalizers_is_immediate(cds):
+    cds.create(cd_obj())
+    cds.delete("cd1", "default")
+    assert cds.try_get("cd1", "default") is None
+
+
+def test_watch_event_stream(cds, fc):
+    w = fc.watch(COMPUTE_DOMAINS, namespace="default")
+    cds.create(cd_obj())
+    obj = cds.get("cd1", "default")
+    obj["spec"]["numNodes"] = 5
+    cds.update(obj)
+    cds.delete("cd1", "default")
+    events = []
+    it = iter(w)
+    for _ in range(3):
+        events.append(next(it))
+    assert [e[0] for e in events] == ["ADDED", "MODIFIED", "DELETED"]
+    w.close()
+
+
+def test_watch_label_filtering(cds, fc):
+    w = fc.watch(COMPUTE_DOMAINS, label_selector={"want": "yes"})
+    o = cd_obj("match")
+    o["metadata"]["labels"] = {"want": "yes"}
+    cds.create(cd_obj("skip"))
+    cds.create(o)
+    ev, obj = next(iter(w))
+    assert obj["metadata"]["name"] == "match"
+    w.close()
+
+
+def test_informer_sync_and_events(cds, fc):
+    cds.create(cd_obj("pre"))
+    inf = Informer(fc, COMPUTE_DOMAINS, namespace="default")
+    seen = []
+    done = threading.Event()
+
+    def handler(ev, obj):
+        seen.append((ev, obj["metadata"]["name"]))
+        if len(seen) >= 3:
+            done.set()
+
+    inf.add_handler(handler)
+    inf.start()
+    assert inf.wait_for_sync()
+    assert inf.get("pre", "default") is not None
+    cds.create(cd_obj("live"))
+    obj = cds.get("live", "default")
+    obj["spec"]["numNodes"] = 9
+    cds.update(obj)
+    assert done.wait(3)
+    assert seen[0] == ("ADDED", "pre")
+    assert ("ADDED", "live") in seen
+    assert ("MODIFIED", "live") in seen
+    assert {o["metadata"]["name"] for o in inf.list()} == {"pre", "live"}
+    inf.stop()
+
+
+def test_informer_no_gap_between_list_and_watch(cds, fc):
+    """Objects created during startup are never missed."""
+    inf = Informer(fc, COMPUTE_DOMAINS)
+    inf.start()
+    cds.create(cd_obj("during"))
+    deadline = time.monotonic() + 2
+    while time.monotonic() < deadline:
+        if inf.get("during", "default"):
+            break
+        time.sleep(0.01)
+    assert inf.get("during", "default") is not None
+    inf.stop()
+
+
+def test_informer_survives_watch_stream_end(cds, fc):
+    """Watch stream death must not leave the store silently stale."""
+    inf = Informer(fc, COMPUTE_DOMAINS)
+    inf.resync_backoff = 0.05
+    inf.start()
+    assert inf.wait_for_sync()
+    # Kill the underlying watch (server-side timeout analog).
+    inf._watch.close()
+    cds.create(cd_obj("after-drop"))
+    deadline = time.monotonic() + 3
+    while time.monotonic() < deadline and not inf.get("after-drop", "default"):
+        time.sleep(0.02)
+    assert inf.get("after-drop", "default") is not None
+    inf.stop()
+
+
+def test_informer_relist_emits_deletes(cds, fc):
+    inf = Informer(fc, COMPUTE_DOMAINS)
+    inf.resync_backoff = 0.05
+    inf.start()
+    cds.create(cd_obj("doomed"))
+    deadline = time.monotonic() + 2
+    while time.monotonic() < deadline and not inf.get("doomed", "default"):
+        time.sleep(0.02)
+    deletes = []
+    inf.add_handler(lambda ev, o: deletes.append(o["metadata"]["name"]) if ev == "DELETED" else None)
+    # Drop the watch, delete behind its back, wait for resync.
+    inf._watch.close()
+    cds.delete("doomed", "default")
+    deadline = time.monotonic() + 3
+    while time.monotonic() < deadline and inf.get("doomed", "default"):
+        time.sleep(0.02)
+    assert inf.get("doomed", "default") is None
+    assert "doomed" in deletes
+    inf.stop()

@@ -1,0 +1,299 @@
+"""tpulib tests: placement algebra (native/Python parity), stub backend
+lifecycle, persistence, and linux-backend enumeration against a fabricated
+sysfs tree (the fake-hardware seam the reference lacks, SURVEY.md §4.1)."""
+
+import os
+import subprocess
+
+import pytest
+
+from tpu_dra.tpulib import native, new_tpulib
+from tpu_dra.tpulib.interface import TpuLibError
+from tpu_dra.tpulib.linux import LinuxTpuLib
+from tpu_dra.tpulib.stub import StubTpuLib
+from tpu_dra.tpulib.types import (
+    GENERATIONS,
+    ChipHealthEvent,
+    Placement,
+    SubsliceShape,
+    TopologyCoord,
+    parse_topology,
+    topology_str,
+)
+
+
+# --- topology primitives ----------------------------------------------------
+
+
+def test_parse_topology():
+    assert parse_topology("4x4") == (4, 4, 1)
+    assert parse_topology("2x2x2") == (2, 2, 2)
+    assert topology_str((4, 4, 1)) == "4x4"
+    assert topology_str((2, 2, 2)) == "2x2x2"
+    for bad in ("", "4", "0x2", "2x-1", "axb"):
+        with pytest.raises(ValueError):
+            parse_topology(bad)
+
+
+def test_accelerator_type_counts_cores():
+    assert GENERATIONS["v5p"].accelerator_type(8) == "v5p-16"  # 2 cores/chip
+    assert GENERATIONS["v5e"].accelerator_type(4) == "v5e-4"  # 1 core/chip
+
+
+# --- placement allocator: native + python parity ----------------------------
+
+CASES = [
+    ((2, 2, 1), (1, 1, 1)),
+    ((2, 2, 1), (1, 2, 1)),
+    ((2, 2, 1), (2, 2, 1)),
+    ((4, 4, 4), (2, 2, 2)),
+    ((4, 4, 1), (2, 1, 1)),
+]
+
+
+@pytest.mark.parametrize("mesh,shape", CASES)
+def test_placement_enumeration_parity(mesh, shape):
+    py = native._py_enumerate_placements(mesh, shape)
+    assert native.enumerate_placements(mesh, shape) == py
+    # aligned, in-bounds, non-overlapping tiling
+    for x, y, z in py:
+        assert x % shape[0] == 0 and y % shape[1] == 0 and z % shape[2] == 0
+        assert x + shape[0] <= mesh[0]
+    n_cover = len(py) * shape[0] * shape[1] * shape[2]
+    assert n_cover <= mesh[0] * mesh[1] * mesh[2]
+
+
+def test_native_lib_is_loaded():
+    # The build must actually exercise the C++ path in this environment.
+    assert native.native_available(), "native/build/libtputopo.so missing — run make -C native"
+
+
+def test_placement_enumeration_invalid():
+    with pytest.raises(ValueError):
+        native.enumerate_placements((2, 2, 1), (3, 1, 1))
+    with pytest.raises(ValueError):
+        native.enumerate_placements((0, 2, 1), (1, 1, 1))
+
+
+def test_placement_free_parity():
+    mesh, shape = (2, 2, 1), (1, 2, 1)
+    busy = [False, True, False, False]  # chip (1,0,0) busy
+    for start in ((0, 0, 0), (1, 0, 0)):
+        assert native.placement_free(mesh, shape, start, busy) == \
+            native._py_placement_free(mesh, shape, start, busy)
+    assert native.placement_free(mesh, shape, (0, 0, 0), busy) is True
+    assert native.placement_free(mesh, shape, (1, 0, 0), busy) is False
+    with pytest.raises(ValueError):
+        native.placement_free(mesh, (1, 1, 1), (2, 0, 0), busy)  # oob
+    with pytest.raises(ValueError):
+        native.placement_free(mesh, (2, 2, 1), (1, 0, 0), busy)  # misaligned
+
+
+# --- stub backend -----------------------------------------------------------
+
+
+def make_stub(tmp_path=None, **cfg):
+    cfg.setdefault("generation", "v5e")
+    cfg.setdefault("hostname", "test-host-0")
+    return StubTpuLib(
+        config=cfg, state_dir=str(tmp_path / "state") if tmp_path else None
+    )
+
+
+def test_stub_enumeration_defaults():
+    lib = make_stub()
+    chips = lib.chips()
+    assert len(chips) == 4
+    assert chips[0].generation.name == "v5e"
+    assert chips[0].hbm_bytes == 16 * 1024**3
+    assert chips[0].dev_paths == ["/dev/accel0"]
+    # Stable UUIDs across re-enumeration (handle-cache invariant analog).
+    lib2 = make_stub()
+    assert [c.uuid for c in lib2.chips()] == [c.uuid for c in chips]
+    coords = {c.coord for c in chips}
+    assert coords == {TopologyCoord(x, y, 0) for x in (0, 1) for y in (0, 1)}
+
+
+def test_stub_slice_identity():
+    lib = make_stub(
+        slice={"uuid": "s" * 8, "topology": "4x4", "num_hosts": 4, "worker_id": 2}
+    )
+    ici = lib.ici_domain()
+    assert ici.clique_id() == f"{'s'*8}.0"
+    assert ici.topology == (4, 4, 1)
+    assert all(c.worker_id == 2 for c in lib.chips())
+
+
+def test_subslice_lifecycle(tmp_path):
+    lib = make_stub(tmp_path)
+    shape = SubsliceShape.parse("1x2")
+    placements = lib.possible_placements(shape)
+    assert len(placements) == 2
+    ss = lib.create_subslice(placements[0])
+    assert ss.placement.shape.chip_count == 2
+    assert len(ss.parent_chip_uuids) == 2
+    assert ss.runtime_env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "1,2,1"
+    assert ss.runtime_env["TPU_VISIBLE_DEVICES"].count(",") == 1
+    assert ss.hbm_bytes == 2 * 16 * 1024**3
+
+    # Overlap rejected while live (validateNoOverlapping analog at lib level)
+    with pytest.raises(TpuLibError, match="overlaps"):
+        lib.create_subslice(placements[0])
+    # Disjoint placement fine
+    ss2 = lib.create_subslice(placements[1])
+    assert {s.uuid for s in lib.list_subslices()} == {ss.uuid, ss2.uuid}
+
+    lib.delete_subslice(ss.uuid)
+    assert {s.uuid for s in lib.list_subslices()} == {ss2.uuid}
+    with pytest.raises(TpuLibError, match="unknown"):
+        lib.delete_subslice(ss.uuid)
+    # Freed coordinates immediately reusable
+    lib.create_subslice(placements[0])
+
+
+def test_subslice_persistence_survives_restart(tmp_path):
+    lib = make_stub(tmp_path)
+    ss = lib.create_subslice(lib.possible_placements(SubsliceShape.parse("2x2"))[0])
+    # New instance, same state dir: the startup-obliteration data source.
+    lib2 = make_stub(tmp_path)
+    live = lib2.list_subslices()
+    assert [s.uuid for s in live] == [ss.uuid]
+    assert live[0].runtime_env == ss.runtime_env
+    lib2.delete_subslice(ss.uuid)
+    lib3 = make_stub(tmp_path)
+    assert lib3.list_subslices() == []
+
+
+def test_unhealthy_chip_blocks_subslice():
+    lib = make_stub()
+    victim = lib.chips()[0]
+    lib.inject_health_event(
+        ChipHealthEvent(chip_uuid=victim.uuid, healthy=False, reason="ici error")
+    )
+    ev = lib.health_events().get_nowait()
+    assert ev.chip_uuid == victim.uuid and not ev.healthy
+    with pytest.raises(TpuLibError, match="unhealthy"):
+        lib.create_subslice(
+            Placement(TopologyCoord(0, 0, 0), SubsliceShape.parse("1x1"))
+        )
+
+
+def test_time_slice_knob():
+    lib = make_stub()
+    uuids = [c.uuid for c in lib.chips()[:2]]
+    lib.set_time_slice(uuids, 2)
+    assert lib.get_time_slice(uuids[0]) == 2
+    assert lib.get_time_slice(lib.chips()[3].uuid) is None
+    with pytest.raises(TpuLibError):
+        lib.set_time_slice(["nope"], 1)
+    with pytest.raises(TpuLibError):
+        lib.set_time_slice(uuids, -1)
+
+
+def test_fault_injection():
+    lib = make_stub(fail={"create_subslice": "boom"})
+    with pytest.raises(TpuLibError, match="injected fault: boom"):
+        lib.create_subslice(
+            Placement(TopologyCoord(0, 0, 0), SubsliceShape.parse("1x1"))
+        )
+
+
+def test_factory_selects_stub():
+    lib = new_tpulib("stub", config={"generation": "v5p"})
+    assert lib.generation().name == "v5p"
+    with pytest.raises(ValueError):
+        new_tpulib("banana")
+
+
+# --- linux backend against fabricated sysfs ---------------------------------
+
+
+def fabricate_sysfs(root, n_chips=4, device_id="0x0063", vendor="0x1ae0"):
+    base = root / "sys" / "bus" / "pci" / "devices"
+    for i in range(n_chips):
+        addr = f"0000:0{i}:00.0"
+        d = base / addr
+        real = root / "sys" / "devices" / f"pci0000:0{i}" / addr
+        real.mkdir(parents=True)
+        (real / "vendor").write_text(vendor + "\n")
+        (real / "device").write_text(device_id + "\n")
+        (real / "numa_node").write_text(f"{i // 2}\n")
+        base.mkdir(parents=True, exist_ok=True)
+        os.symlink(real, d)
+        drv = root / "sys" / "bus" / "pci" / "drivers" / "google-tpu"
+        drv.mkdir(parents=True, exist_ok=True)
+        os.symlink(drv, real / "driver")
+        grp = root / "sys" / "kernel" / "iommu_groups" / str(10 + i)
+        grp.mkdir(parents=True, exist_ok=True)
+        os.symlink(grp, real / "iommu_group")
+    dev = root / "dev"
+    dev.mkdir(exist_ok=True)
+    for i in range(n_chips):
+        (dev / f"accel{i}").touch()
+    return str(root / "sys"), str(dev)
+
+
+def test_linux_enumeration(tmp_path):
+    sysfs, dev = fabricate_sysfs(tmp_path)
+    lib = LinuxTpuLib(sysfs_root=sysfs, dev_root=dev, env={})
+    chips = lib.chips()
+    assert len(chips) == 4
+    assert lib.generation().name == "v5e"
+    assert chips[0].pci_bus_id == "0000:00:00.0"
+    assert chips[0].dev_paths == ["/dev/accel0"]
+    assert chips[0].numa_node == 0 and chips[3].numa_node == 1
+    assert chips[0].iommu_group == 10
+    assert chips[0].vfio_capable
+    assert chips[0].pcie_root == "pci0000:00"
+    assert lib.ici_domain() is None  # no slice env -> single-host
+
+
+def test_linux_slice_env(tmp_path):
+    sysfs, dev = fabricate_sysfs(tmp_path, device_id="0x0062")  # v5p
+    env = {
+        "TPU_WORKER_ID": "1",
+        "TPU_WORKER_HOSTNAMES": "h0,h1",
+        "TPU_TOPOLOGY": "2x2x2",
+        "TPU_ACCELERATOR_TYPE": "v5p-16",
+    }
+    lib = LinuxTpuLib(sysfs_root=sysfs, dev_root=dev, env=env)
+    assert lib.generation().name == "v5p"
+    ici = lib.ici_domain()
+    assert ici is not None and ici.topology == (2, 2, 2)
+    assert all(c.worker_id == 1 for c in lib.chips())
+    # Identity is stable across hosts: same hostnames -> same slice uuid.
+    lib2 = LinuxTpuLib(sysfs_root=sysfs, dev_root=dev, env={**env, "TPU_WORKER_ID": "0"})
+    assert lib2.ici_domain().slice_uuid == ici.slice_uuid
+
+
+def test_linux_no_devices_errors(tmp_path):
+    (tmp_path / "sys").mkdir()
+    with pytest.raises(TpuLibError, match="no Google TPU PCI functions"):
+        LinuxTpuLib(sysfs_root=str(tmp_path / "sys"), dev_root="/dev", env={})
+
+
+def test_linux_ignores_foreign_vendor(tmp_path):
+    sysfs, dev = fabricate_sysfs(tmp_path, n_chips=2, vendor="0x10de")
+    with pytest.raises(TpuLibError):
+        LinuxTpuLib(sysfs_root=sysfs, dev_root=dev, env={})
+
+
+def test_pci_scan_native_python_parity(tmp_path):
+    sysfs, _ = fabricate_sysfs(tmp_path)
+    native_result = native.pci_scan(sysfs)
+    py_result = native._py_pci_scan(sysfs)
+    assert native_result == py_result
+    assert len(native_result) == 4
+    assert native_result[0]["driver"] == "google-tpu"
+
+
+def test_degenerate_shape_rejected_not_crash():
+    """A zero-extent shape must raise, not SIGFPE/ZeroDivisionError."""
+    mesh, busy = (2, 2, 1), [False] * 4
+    with pytest.raises(ValueError):
+        native.placement_free(mesh, (1, 0, 1), (0, 0, 0), busy)
+    with pytest.raises(ValueError):
+        native._py_placement_free(mesh, (0, 1, 1), (0, 0, 0), busy)
+    lib = make_stub()
+    with pytest.raises(TpuLibError):
+        lib.create_subslice(Placement(TopologyCoord(0, 0, 0), SubsliceShape((1, 0, 1))))

@@ -41,6 +41,12 @@ class ObjectMeta(Serde):
     deletion_timestamp: Optional[str] = None
     creation_timestamp: Optional[str] = None
     generation: int = 0
+    # Standard apiserver-managed metadata we carry but never interpret; listed
+    # so strict decoding of objects fetched from a real cluster succeeds.
+    generate_name: str = ""
+    managed_fields: Optional[list] = None
+    self_link: str = ""
+    deletion_grace_period_seconds: Optional[int] = None
 
     FIELDS = {
         "name": Field("name"),
@@ -54,6 +60,10 @@ class ObjectMeta(Serde):
         "deletionTimestamp": Field("deletion_timestamp"),
         "creationTimestamp": Field("creation_timestamp"),
         "generation": Field("generation"),
+        "generateName": Field("generate_name"),
+        "managedFields": Field("managed_fields"),
+        "selfLink": Field("self_link"),
+        "deletionGracePeriodSeconds": Field("deletion_grace_period_seconds"),
     }
 
 

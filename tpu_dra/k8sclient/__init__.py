@@ -1,0 +1,38 @@
+"""Minimal typed Kubernetes client layer.
+
+Reference analog: pkg/nvidia.com/ — the client-gen/informer-gen/lister-gen
+output (typed clientset, shared informer factory, listers, and **fake
+clientsets for tests**, SURVEY.md §1.2). This build has no Go codegen, so
+the layer is hand-written but keeps the same shape:
+
+- :mod:`tpu_dra.k8sclient.rest`     — transport (in-cluster / kubeconfig)
+- :mod:`tpu_dra.k8sclient.resources`— GVR descriptors + generic CRUD client
+- :mod:`tpu_dra.k8sclient.fake`     — in-memory apiserver with
+  resourceVersion, watch, and finalizer/deletionTimestamp semantics
+- :mod:`tpu_dra.k8sclient.informer` — list+watch cache with event handlers
+  (the shared-informer/lister analog)
+
+Everything speaks plain JSON dicts; our CRD types decode via
+``tpu_dra.api`` when a typed view is needed.
+"""
+
+from tpu_dra.k8sclient.resources import (  # noqa: F401
+    COMPUTE_DOMAIN_CLIQUES,
+    COMPUTE_DOMAINS,
+    CONFIG_MAPS,
+    DAEMON_SETS,
+    DEPLOYMENTS,
+    LEASES,
+    NODES,
+    PODS,
+    RESOURCE_CLAIM_TEMPLATES,
+    RESOURCE_CLAIMS,
+    RESOURCE_SLICES,
+    ApiConflict,
+    ApiNotFound,
+    K8sApiError,
+    ResourceClient,
+    ResourceDescriptor,
+)
+from tpu_dra.k8sclient.fake import FakeCluster  # noqa: F401
+from tpu_dra.k8sclient.informer import Informer  # noqa: F401

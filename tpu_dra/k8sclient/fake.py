@@ -1,0 +1,259 @@
+"""In-memory fake API server for tests and the kind/CPU-only demo path.
+
+Reference analog: the generated fake clientsets
+(pkg/nvidia.com/clientset/versioned/fake/clientset_generated.go) — but with
+enough real apiserver semantics that the controller/plugin state machines
+can be exercised faithfully:
+
+- monotonically increasing resourceVersions; update/update_status conflict
+  (HTTP 409 analog) when the caller's resourceVersion is stale;
+- watch streams per (resource, namespace, selector) delivering
+  ADDED/MODIFIED/DELETED events in order;
+- **finalizer semantics**: delete on an object with finalizers sets
+  deletionTimestamp and emits MODIFIED; the object is only removed when the
+  last finalizer is stripped — the controller's deletion-ordering logic
+  (cmd/compute-domain-controller/computedomain.go:314-348) depends on this;
+- uid assignment, creationTimestamp, generation bumps on spec change.
+"""
+
+from __future__ import annotations
+
+import copy
+import datetime
+import queue
+import threading
+import uuid as uuidlib
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from tpu_dra.k8sclient.resources import (
+    ApiConflict,
+    ApiNotFound,
+    Backend,
+    K8sApiError,
+    ResourceDescriptor,
+    match_label_selector,
+)
+
+Key = Tuple[str, Optional[str], str]  # (plural, namespace, name)
+
+
+class _Watch:
+    def __init__(self, rd, namespace, selector):
+        self.rd = rd
+        self.namespace = namespace
+        self.selector = selector or {}
+        self.q: "queue.Queue[Optional[Tuple[str, dict]]]" = queue.Queue()
+        self.closed = False
+
+    def matches(self, rd: ResourceDescriptor, obj: dict) -> bool:
+        if rd.plural != self.rd.plural or rd.group != self.rd.group:
+            return False
+        if self.namespace and obj["metadata"].get("namespace") != self.namespace:
+            return False
+        return match_label_selector(
+            obj["metadata"].get("labels", {}) or {}, self.selector
+        )
+
+    def close(self):
+        self.closed = True
+        self.q.put(None)
+
+    def __iter__(self) -> Iterator[Tuple[str, dict]]:
+        while True:
+            item = self.q.get()
+            if item is None:
+                return
+            yield item
+
+
+def _now() -> str:
+    return (
+        datetime.datetime.now(datetime.timezone.utc)
+        .strftime("%Y-%m-%dT%H:%M:%SZ")
+    )
+
+
+class FakeCluster(Backend):
+    def __init__(self):
+        self._objs: Dict[Key, dict] = {}
+        self._rv = 0
+        self._lock = threading.RLock()
+        self._watches: List[_Watch] = []
+
+    # --- helpers ---
+
+    def _key(self, rd: ResourceDescriptor, namespace: Optional[str], name: str) -> Key:
+        ns = namespace if rd.namespaced else None
+        return (f"{rd.group}/{rd.plural}", ns, name)
+
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _emit(self, event: str, rd: ResourceDescriptor, obj: dict) -> None:
+        for w in self._watches:
+            if not w.closed and w.matches(rd, obj):
+                w.q.put((event, copy.deepcopy(obj)))
+
+    # --- Backend API ---
+
+    def get(self, rd, namespace, name) -> dict:
+        with self._lock:
+            obj = self._objs.get(self._key(rd, namespace, name))
+            if obj is None:
+                raise ApiNotFound(f"{rd.plural} {namespace}/{name} not found")
+            return copy.deepcopy(obj)
+
+    def list(self, rd, namespace=None, label_selector=None, field_selector=None):
+        with self._lock:
+            out = []
+            prefix = f"{rd.group}/{rd.plural}"
+            for (plural, ns, _name), obj in sorted(self._objs.items()):
+                if plural != prefix:
+                    continue
+                if rd.namespaced and namespace and ns != namespace:
+                    continue
+                if label_selector and not match_label_selector(
+                    obj["metadata"].get("labels", {}) or {}, label_selector
+                ):
+                    continue
+                if field_selector and not self._match_fields(obj, field_selector):
+                    continue
+                out.append(copy.deepcopy(obj))
+            return out
+
+    @staticmethod
+    def _match_fields(obj: dict, sel: Dict[str, str]) -> bool:
+        for path, want in sel.items():
+            cur = obj
+            for part in path.split("."):
+                if not isinstance(cur, dict) or part not in cur:
+                    return False
+                cur = cur[part]
+            if str(cur) != want:
+                return False
+        return True
+
+    def create(self, rd, obj) -> dict:
+        obj = copy.deepcopy(obj)
+        md = obj.setdefault("metadata", {})
+        name = md.get("name")
+        if not name and md.get("generateName"):
+            name = md["generateName"] + uuidlib.uuid4().hex[:5]
+            md["name"] = name
+        if not name:
+            raise K8sApiError("metadata.name is required", status=422)
+        ns = md.get("namespace") if rd.namespaced else None
+        if rd.namespaced and not ns:
+            ns = "default"
+            md["namespace"] = ns
+        key = self._key(rd, ns, name)
+        with self._lock:
+            if key in self._objs:
+                raise ApiConflict(f"{rd.plural} {ns}/{name} already exists")
+            md["uid"] = str(uuidlib.uuid4())
+            md["resourceVersion"] = self._next_rv()
+            md["creationTimestamp"] = _now()
+            md.setdefault("generation", 1)
+            self._objs[key] = copy.deepcopy(obj)
+            self._emit("ADDED", rd, obj)
+            return copy.deepcopy(obj)
+
+    def _update(self, rd, obj, status_only: bool) -> dict:
+        obj = copy.deepcopy(obj)
+        md = obj.get("metadata", {})
+        name = md.get("name")
+        ns = md.get("namespace") if rd.namespaced else None
+        key = self._key(rd, ns, name)
+        with self._lock:
+            cur = self._objs.get(key)
+            if cur is None:
+                raise ApiNotFound(f"{rd.plural} {ns}/{name} not found")
+            sent_rv = md.get("resourceVersion")
+            if sent_rv and sent_rv != cur["metadata"]["resourceVersion"]:
+                raise ApiConflict(
+                    f"{rd.plural} {ns}/{name}: resourceVersion conflict "
+                    f"(sent {sent_rv}, have {cur['metadata']['resourceVersion']})"
+                )
+            new = copy.deepcopy(cur) if status_only else obj
+            if status_only:
+                new["status"] = copy.deepcopy(obj.get("status", {}))
+            else:
+                # metadata.uid/creationTimestamp are immutable; spec change
+                # bumps generation.
+                new["metadata"]["uid"] = cur["metadata"]["uid"]
+                new["metadata"]["creationTimestamp"] = cur["metadata"][
+                    "creationTimestamp"
+                ]
+                if cur["metadata"].get("deletionTimestamp"):
+                    new["metadata"]["deletionTimestamp"] = cur["metadata"][
+                        "deletionTimestamp"
+                    ]
+                if new.get("spec") != cur.get("spec"):
+                    new["metadata"]["generation"] = (
+                        cur["metadata"].get("generation", 1) + 1
+                    )
+            new["metadata"]["resourceVersion"] = self._next_rv()
+            self._objs[key] = copy.deepcopy(new)
+            self._emit("MODIFIED", rd, new)
+            # Deletion completes when the last finalizer is stripped.
+            if new["metadata"].get("deletionTimestamp") and not new["metadata"].get(
+                "finalizers"
+            ):
+                del self._objs[key]
+                self._emit("DELETED", rd, new)
+            return copy.deepcopy(new)
+
+    def update(self, rd, obj) -> dict:
+        return self._update(rd, obj, status_only=False)
+
+    def update_status(self, rd, obj) -> dict:
+        return self._update(rd, obj, status_only=True)
+
+    def patch(self, rd, namespace, name, patch) -> dict:
+        """Strategic-merge-lite: dict deep-merge; None deletes a key."""
+        with self._lock:
+            cur = self.get(rd, namespace, name)
+
+            def merge(dst, src):
+                for k, v in src.items():
+                    if v is None:
+                        dst.pop(k, None)
+                    elif isinstance(v, dict) and isinstance(dst.get(k), dict):
+                        merge(dst[k], v)
+                    else:
+                        dst[k] = copy.deepcopy(v)
+
+            merge(cur, patch)
+            cur["metadata"]["resourceVersion"] = None  # skip conflict check
+            return self._update(rd, cur, status_only=False)
+
+    def delete(self, rd, namespace, name) -> None:
+        key = self._key(rd, namespace, name)
+        with self._lock:
+            cur = self._objs.get(key)
+            if cur is None:
+                raise ApiNotFound(f"{rd.plural} {namespace}/{name} not found")
+            if cur["metadata"].get("finalizers"):
+                if not cur["metadata"].get("deletionTimestamp"):
+                    cur["metadata"]["deletionTimestamp"] = _now()
+                    cur["metadata"]["resourceVersion"] = self._next_rv()
+                    self._emit("MODIFIED", rd, cur)
+                return  # parked until finalizers are removed
+            del self._objs[key]
+            cur["metadata"]["resourceVersion"] = self._next_rv()
+            self._emit("DELETED", rd, cur)
+
+    def watch(self, rd, namespace=None, label_selector=None) -> _Watch:
+        w = _Watch(rd, namespace, label_selector)
+        with self._lock:
+            self._watches.append(w)
+        return w
+
+    # --- test conveniences ---
+
+    def clear_watches(self):
+        with self._lock:
+            for w in self._watches:
+                w.close()
+            self._watches.clear()

@@ -1,0 +1,141 @@
+"""List+watch informer with a thread-safe store and event handlers.
+
+Reference analog: the generated shared informer factory + listers
+(pkg/nvidia.com/informers/externalversions/factory.go,
+listers/resource/v1beta1/computedomain.go). Handlers run on a dedicated
+dispatch thread; the store is the lister.
+
+Ordering guarantee: the watch is registered *before* the initial list, so
+no event can fall into the gap between them (against the fake backend this
+is exact; against a real API server the transport replays from the list's
+resourceVersion).
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tpu_dra.k8sclient.resources import Backend, ResourceDescriptor
+
+log = logging.getLogger(__name__)
+
+Handler = Callable[[str, dict], None]  # (event_type, obj)
+
+
+class Informer:
+    def __init__(
+        self,
+        backend: Backend,
+        rd: ResourceDescriptor,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ):
+        self.backend = backend
+        self.rd = rd
+        self.namespace = namespace
+        self.label_selector = label_selector
+        self._store: Dict[Tuple[Optional[str], str], dict] = {}
+        self._lock = threading.RLock()
+        self._handlers: List[Handler] = []
+        self._watch = None
+        self._thread: Optional[threading.Thread] = None
+        self._synced = threading.Event()
+        self._stopped = threading.Event()
+        self.resync_backoff = 1.0  # seconds between reconnect attempts
+
+    def add_handler(self, handler: Handler) -> None:
+        self._handlers.append(handler)
+
+    def start(self) -> None:
+        self._watch = self.backend.watch(self.rd, self.namespace, self.label_selector)
+        for obj in self.backend.list(self.rd, self.namespace, self.label_selector):
+            self._apply("ADDED", obj, dispatch=True)
+        self._synced.set()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"informer-{self.rd.plural}"
+        )
+        self._thread.start()
+
+    def wait_for_sync(self, timeout: float = 5.0) -> bool:
+        return self._synced.wait(timeout)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._watch is not None:
+            self._watch.close()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def _run(self) -> None:
+        """Consume the watch; on stream end (server-side watch timeout, network
+        blip), re-establish watch + re-list so the store never goes silently
+        stale. ERROR events (apiserver Status payloads) trigger a resync
+        instead of being stored as objects."""
+        assert self._watch is not None
+        while not self._stopped.is_set():
+            for event, obj in self._watch:
+                if event == "ERROR":
+                    log.warning("watch ERROR event: %s", obj.get("message", obj))
+                    break
+                self._apply(event, obj, dispatch=True)
+            if self._stopped.is_set():
+                return
+            self._stopped.wait(self.resync_backoff)
+            if self._stopped.is_set():
+                return
+            try:
+                self._watch = self.backend.watch(
+                    self.rd, self.namespace, self.label_selector
+                )
+                self._relist()
+            except Exception as e:
+                log.warning("informer resync failed (will retry): %s", e)
+
+    def _relist(self) -> None:
+        """Full re-list: upsert everything current, emit DELETED for objects
+        that vanished while the watch was down."""
+        fresh = self.backend.list(self.rd, self.namespace, self.label_selector)
+        fresh_keys = set()
+        for obj in fresh:
+            md = obj.get("metadata", {})
+            fresh_keys.add((md.get("namespace"), md.get("name")))
+            self._apply("MODIFIED", obj, dispatch=True)
+        with self._lock:
+            gone = [k for k in self._store if k not in fresh_keys]
+            gone_objs = [self._store[k] for k in gone]
+        for obj in gone_objs:
+            self._apply("DELETED", obj, dispatch=True)
+
+    def _apply(self, event: str, obj: dict, dispatch: bool) -> None:
+        md = obj.get("metadata", {})
+        key = (md.get("namespace"), md.get("name"))
+        with self._lock:
+            if event == "DELETED":
+                self._store.pop(key, None)
+            else:
+                prev = self._store.get(key)
+                if prev is not None and prev["metadata"].get(
+                    "resourceVersion"
+                ) == md.get("resourceVersion"):
+                    return  # duplicate replay (list/watch overlap)
+                self._store[key] = obj
+        if dispatch:
+            for h in self._handlers:
+                try:
+                    h(event, copy.deepcopy(obj))
+                except Exception:
+                    log.exception("informer handler failed for %s", key)
+
+    # --- lister ---
+
+    def get(self, name: str, namespace: Optional[str] = None) -> Optional[dict]:
+        with self._lock:
+            obj = self._store.get((namespace, name))
+            return copy.deepcopy(obj) if obj else None
+
+    def list(self) -> List[dict]:
+        with self._lock:
+            return [copy.deepcopy(o) for o in self._store.values()]

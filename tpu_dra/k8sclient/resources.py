@@ -1,0 +1,179 @@
+"""Resource descriptors and the generic resource client.
+
+The GVR table covers every resource the reference driver touches
+(ResourceSlices/Claims/ClaimTemplates + DRA, our CRDs, workload plumbing),
+so controllers and plugins share one CRUD/watch surface regardless of
+whether the backend is a real API server (rest.KubeClient) or the in-memory
+fake (fake.FakeCluster).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+
+class K8sApiError(RuntimeError):
+    def __init__(self, message: str, status: int = 500):
+        super().__init__(message)
+        self.status = status
+
+
+class ApiNotFound(K8sApiError):
+    def __init__(self, message: str):
+        super().__init__(message, status=404)
+
+
+class ApiConflict(K8sApiError):
+    def __init__(self, message: str):
+        super().__init__(message, status=409)
+
+
+@dataclass(frozen=True)
+class ResourceDescriptor:
+    group: str  # "" for core
+    version: str
+    plural: str
+    kind: str
+    namespaced: bool = True
+
+    @property
+    def api_version(self) -> str:
+        return f"{self.group}/{self.version}" if self.group else self.version
+
+    def path(self, namespace: Optional[str] = None, name: Optional[str] = None) -> str:
+        base = (
+            f"/apis/{self.group}/{self.version}"
+            if self.group
+            else f"/api/{self.version}"
+        )
+        if self.namespaced and namespace:
+            base += f"/namespaces/{namespace}"
+        base += f"/{self.plural}"
+        if name:
+            base += f"/{name}"
+        return base
+
+
+# Core + app resources the driver touches.
+PODS = ResourceDescriptor("", "v1", "pods", "Pod")
+NODES = ResourceDescriptor("", "v1", "nodes", "Node", namespaced=False)
+CONFIG_MAPS = ResourceDescriptor("", "v1", "configmaps", "ConfigMap")
+DAEMON_SETS = ResourceDescriptor("apps", "v1", "daemonsets", "DaemonSet")
+DEPLOYMENTS = ResourceDescriptor("apps", "v1", "deployments", "Deployment")
+LEASES = ResourceDescriptor("coordination.k8s.io", "v1", "leases", "Lease")
+
+# DRA resources (KEP-4381 family).
+RESOURCE_CLAIMS = ResourceDescriptor(
+    "resource.k8s.io", "v1beta1", "resourceclaims", "ResourceClaim"
+)
+RESOURCE_CLAIM_TEMPLATES = ResourceDescriptor(
+    "resource.k8s.io", "v1beta1", "resourceclaimtemplates", "ResourceClaimTemplate"
+)
+RESOURCE_SLICES = ResourceDescriptor(
+    "resource.k8s.io", "v1beta1", "resourceslices", "ResourceSlice", namespaced=False
+)
+
+# Our CRDs.
+COMPUTE_DOMAINS = ResourceDescriptor(
+    "resource.tpu.google.com", "v1beta1", "computedomains", "ComputeDomain"
+)
+COMPUTE_DOMAIN_CLIQUES = ResourceDescriptor(
+    "resource.tpu.google.com", "v1beta1", "computedomaincliques", "ComputeDomainClique"
+)
+
+
+def match_label_selector(labels: Dict[str, str], selector: Dict[str, str]) -> bool:
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class Backend:
+    """What a transport must provide (implemented by FakeCluster and
+    rest.KubeClient)."""
+
+    def get(self, rd: ResourceDescriptor, namespace: Optional[str], name: str) -> dict:
+        raise NotImplementedError
+
+    def list(
+        self,
+        rd: ResourceDescriptor,
+        namespace: Optional[str],
+        label_selector: Optional[Dict[str, str]] = None,
+        field_selector: Optional[Dict[str, str]] = None,
+    ) -> List[dict]:
+        raise NotImplementedError
+
+    def create(self, rd: ResourceDescriptor, obj: dict) -> dict:
+        raise NotImplementedError
+
+    def update(self, rd: ResourceDescriptor, obj: dict) -> dict:
+        raise NotImplementedError
+
+    def update_status(self, rd: ResourceDescriptor, obj: dict) -> dict:
+        raise NotImplementedError
+
+    def patch(
+        self, rd: ResourceDescriptor, namespace: Optional[str], name: str, patch: dict
+    ) -> dict:
+        raise NotImplementedError
+
+    def delete(self, rd: ResourceDescriptor, namespace: Optional[str], name: str) -> None:
+        raise NotImplementedError
+
+    def watch(
+        self,
+        rd: ResourceDescriptor,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ):
+        """Returns an iterator of (event_type, obj) plus a close() handle."""
+        raise NotImplementedError
+
+
+class ResourceClient:
+    """Generic CRUD bound to one resource type (typed-clientset analog)."""
+
+    def __init__(self, backend: Backend, rd: ResourceDescriptor):
+        self.backend = backend
+        self.rd = rd
+
+    def get(self, name: str, namespace: Optional[str] = None) -> dict:
+        return self.backend.get(self.rd, namespace, name)
+
+    def try_get(self, name: str, namespace: Optional[str] = None) -> Optional[dict]:
+        try:
+            return self.backend.get(self.rd, namespace, name)
+        except ApiNotFound:
+            return None
+
+    def list(
+        self,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        field_selector: Optional[Dict[str, str]] = None,
+    ) -> List[dict]:
+        return self.backend.list(self.rd, namespace, label_selector, field_selector)
+
+    def create(self, obj: dict) -> dict:
+        obj.setdefault("apiVersion", self.rd.api_version)
+        obj.setdefault("kind", self.rd.kind)
+        return self.backend.create(self.rd, obj)
+
+    def update(self, obj: dict) -> dict:
+        return self.backend.update(self.rd, obj)
+
+    def update_status(self, obj: dict) -> dict:
+        return self.backend.update_status(self.rd, obj)
+
+    def patch(self, name: str, patch: dict, namespace: Optional[str] = None) -> dict:
+        return self.backend.patch(self.rd, namespace, name, patch)
+
+    def delete(self, name: str, namespace: Optional[str] = None) -> None:
+        self.backend.delete(self.rd, namespace, name)
+
+    def watch(
+        self,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ):
+        return self.backend.watch(self.rd, namespace, label_selector)

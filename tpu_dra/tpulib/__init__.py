@@ -1,0 +1,62 @@
+"""tpulib: the TPU hardware-abstraction layer.
+
+Reference analog: ``deviceLib`` in cmd/gpu-kubelet-plugin/nvlib.go (NVML via
+go-nvml cgo) plus nvpci sysfs walking. This is the layer the TPU build
+replaces wholesale (SURVEY.md §1.7): there is no NVML equivalent for TPUs, so
+discovery data comes from PCI sysfs, /dev/accel + /dev/vfio device nodes, and
+GKE TPU environment conventions, unified behind one interface with two
+backends:
+
+- :mod:`tpu_dra.tpulib.stub`  — config-file-driven fake chips; the kind /
+  CPU-only path (BASELINE config 1) and the unit-test seam the reference
+  never had (SURVEY.md §4.1: "no fake NVML layer" is its biggest testability
+  gap).
+- :mod:`tpu_dra.tpulib.linux` — real enumeration from a (configurable-root)
+  sysfs/dev tree, with hot paths in ``native/libtputopo.so`` (C++).
+
+Backend selection mirrors the reference's driver-root resolution
+(cmd/gpu-kubelet-plugin/root.go:29-65): explicit argument > env var >
+auto-detect (real TPU PCI devices present -> linux, else stub).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from tpu_dra.tpulib.types import (  # noqa: F401
+    ChipHealthEvent,
+    ChipInfo,
+    Generation,
+    GENERATIONS,
+    IciDomain,
+    Placement,
+    SubsliceShape,
+    TopologyCoord,
+    parse_topology,
+)
+from tpu_dra.tpulib.interface import TpuLib  # noqa: F401
+
+log = logging.getLogger(__name__)
+
+BACKEND_ENV = "TPU_DRA_BACKEND"
+
+
+def new_tpulib(backend: str = "", **kwargs) -> TpuLib:
+    """Create a tpulib backend (deviceLib constructor analog,
+    nvlib.go:56-96)."""
+    backend = backend or os.environ.get(BACKEND_ENV, "")
+    if not backend:
+        from tpu_dra.tpulib.linux import detect_tpu_pci_devices
+
+        backend = "linux" if detect_tpu_pci_devices() else "stub"
+        log.info("auto-detected tpulib backend: %s", backend)
+    if backend == "stub":
+        from tpu_dra.tpulib.stub import StubTpuLib
+
+        return StubTpuLib(**kwargs)
+    if backend == "linux":
+        from tpu_dra.tpulib.linux import LinuxTpuLib
+
+        return LinuxTpuLib(**kwargs)
+    raise ValueError(f"unknown tpulib backend: {backend!r}")

@@ -1,0 +1,260 @@
+"""Shared backend machinery: sub-slice lifecycle, persistence, health queue.
+
+The sub-slice algebra is identical across backends (what differs is only how
+chips are discovered), so it lives here:
+
+- placements are validated against the host-mesh occupancy with the native
+  allocator (tpu_dra.tpulib.native);
+- live sub-slices are persisted one-JSON-file-per-subslice under
+  ``state_dir`` — that file set is the "reliable runtime introspection
+  source" that startup obliteration of unknown sub-slices reads
+  (DestroyUnknownMIGDevices analog, device_state.go:337-373) and it survives
+  plugin restarts the way real MIG devices survive in hardware;
+- the workload-visible materialization is a rendered runtime env
+  (``TPU_VISIBLE_DEVICES`` / ``TPU_CHIPS_PER_PROCESS_BOUNDS`` / host-bounds
+  variables) instead of the GPU build's /dev/nvidia-caps nodes.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import threading
+import uuid as uuidlib
+from typing import Dict, List, Optional
+
+from tpu_dra.tpulib import native
+from tpu_dra.tpulib.interface import SubsliceInfo, TpuLib, TpuLibError
+from tpu_dra.tpulib.types import (
+    ChipHealthEvent,
+    ChipInfo,
+    Generation,
+    Placement,
+    SubsliceShape,
+    TopologyCoord,
+    topology_str,
+)
+
+log = logging.getLogger(__name__)
+
+
+class BaseTpuLib(TpuLib):
+    def __init__(self, state_dir: Optional[str] = None):
+        self._state_dir = state_dir
+        if state_dir:
+            os.makedirs(state_dir, exist_ok=True)
+        self._subslices: Dict[str, SubsliceInfo] = {}
+        self._timeslice: Dict[str, int] = {}  # chip uuid -> ordinal
+        self._health_q: "queue.Queue[ChipHealthEvent]" = queue.Queue()
+        self._lock = threading.RLock()
+        if state_dir:
+            self._load_persisted_subslices()
+
+    # --- backend hooks ---
+
+    def generation(self) -> Generation:
+        raise NotImplementedError
+
+    # --- mesh helpers ---
+
+    def host_mesh(self) -> "tuple[int, int, int]":
+        return self.generation().host_extent
+
+    def _chips_by_coord(self) -> Dict[TopologyCoord, ChipInfo]:
+        return {c.coord: c for c in self.chips()}
+
+    def _occupancy(self) -> List[bool]:
+        """Busy flag per host-mesh coordinate from live sub-slices."""
+        mx, my, mz = self.host_mesh()
+        busy = [False] * (mx * my * mz)
+        for ss in self._subslices.values():
+            for c in ss.placement.chips():
+                busy[c.x + mx * (c.y + my * c.z)] = True
+        return busy
+
+    # --- inventory ---
+
+    def supported_shapes(self) -> List[SubsliceShape]:
+        return [SubsliceShape(e) for e in self.generation().subslice_shapes]
+
+    def possible_placements(self, shape: SubsliceShape) -> List[Placement]:
+        starts = native.enumerate_placements(self.host_mesh(), shape.extent)
+        return [Placement(TopologyCoord(*s), shape) for s in starts]
+
+    # --- lifecycle ---
+
+    def create_subslice(self, placement: Placement) -> SubsliceInfo:
+        """Materialize a sub-slice (createMigDevice analog,
+        nvlib.go:860-989): validate the placement against live occupancy,
+        persist intent, render the workload runtime env."""
+        with self._lock:
+            mesh = self.host_mesh()
+            try:
+                free = native.placement_free(
+                    mesh, placement.shape.extent,
+                    (placement.start.x, placement.start.y, placement.start.z),
+                    self._occupancy(),
+                )
+            except ValueError as e:
+                raise TpuLibError(str(e)) from e
+            if not free:
+                raise TpuLibError(
+                    f"placement {placement} overlaps an existing sub-slice"
+                )
+            by_coord = self._chips_by_coord()
+            chips: List[ChipInfo] = []
+            for coord in placement.chips():
+                chip = by_coord.get(coord)
+                if chip is None:
+                    raise TpuLibError(
+                        f"placement {placement} references coordinate {coord} "
+                        f"with no chip on this host"
+                    )
+                if not chip.healthy:
+                    raise TpuLibError(
+                        f"placement {placement} includes unhealthy chip "
+                        f"{chip.uuid}"
+                    )
+                chips.append(chip)
+            ss_uuid = f"tpuss-{uuidlib.uuid4()}"
+            info = SubsliceInfo(
+                uuid=ss_uuid,
+                parent_chip_uuids=[c.uuid for c in chips],
+                placement=placement,
+                generation=self.generation(),
+                dev_paths=[p for c in chips for p in c.dev_paths],
+                runtime_env=self._render_runtime_env(chips, placement),
+            )
+            self._materialize(info, chips)
+            self._subslices[ss_uuid] = info
+            self._persist(info)
+            return info
+
+    def delete_subslice(self, uuid: str) -> None:
+        """deleteMigDevice analog (nvlib.go:990-1089); deleting an unknown
+        uuid errors so orphan-GC bugs surface loudly."""
+        with self._lock:
+            info = self._subslices.pop(uuid, None)
+            if info is None:
+                raise TpuLibError(f"unknown sub-slice: {uuid}")
+            self._dematerialize(info)
+            self._unpersist(uuid)
+
+    def list_subslices(self) -> List[SubsliceInfo]:
+        with self._lock:
+            return list(self._subslices.values())
+
+    # --- materialization hooks (stub: no-op; linux: runtime config) ---
+
+    def _render_runtime_env(
+        self, chips: List[ChipInfo], placement: Placement
+    ) -> Dict[str, str]:
+        gen = self.generation()
+        return {
+            "TPU_VISIBLE_DEVICES": ",".join(str(c.index) for c in chips),
+            "TPU_CHIPS_PER_PROCESS_BOUNDS": ",".join(
+                str(d) for d in placement.shape.extent
+            ),
+            "TPU_PROCESS_BOUNDS": "1,1,1",
+            "TPU_ACCELERATOR_TYPE": gen.accelerator_type(len(chips)),
+            "TPU_SUBSLICE_SHAPE": topology_str(placement.shape.extent),
+            "TPU_SUBSLICE_ORIGIN": str(placement.start),
+        }
+
+    def _materialize(self, info: SubsliceInfo, chips: List[ChipInfo]) -> None:
+        pass
+
+    def _dematerialize(self, info: SubsliceInfo) -> None:
+        pass
+
+    # --- persistence ---
+
+    def _ss_path(self, uuid: str) -> str:
+        assert self._state_dir
+        return os.path.join(self._state_dir, f"{uuid}.json")
+
+    def _persist(self, info: SubsliceInfo) -> None:
+        if not self._state_dir:
+            return
+        d = {
+            "uuid": info.uuid,
+            "parentChipUUIDs": info.parent_chip_uuids,
+            "shape": topology_str(info.placement.shape.extent),
+            "start": str(info.placement.start),
+            "generation": info.generation.name,
+            "devPaths": info.dev_paths,
+            "runtimeEnv": info.runtime_env,
+        }
+        tmp = self._ss_path(info.uuid) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(d, f)
+        os.replace(tmp, self._ss_path(info.uuid))
+
+    def _unpersist(self, uuid: str) -> None:
+        if not self._state_dir:
+            return
+        try:
+            os.remove(self._ss_path(uuid))
+        except FileNotFoundError:
+            pass
+
+    def _load_persisted_subslices(self) -> None:
+        from tpu_dra.tpulib.types import GENERATIONS
+
+        assert self._state_dir
+        for name in os.listdir(self._state_dir):
+            if not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self._state_dir, name)) as f:
+                    d = json.load(f)
+                info = SubsliceInfo(
+                    uuid=d["uuid"],
+                    parent_chip_uuids=d["parentChipUUIDs"],
+                    placement=Placement(
+                        TopologyCoord.parse(d["start"]),
+                        SubsliceShape.parse(d["shape"]),
+                    ),
+                    generation=GENERATIONS[d["generation"]],
+                    dev_paths=d.get("devPaths", []),
+                    runtime_env=d.get("runtimeEnv", {}),
+                )
+                self._subslices[info.uuid] = info
+            except (OSError, KeyError, ValueError) as e:
+                log.warning("skipping unreadable sub-slice state %s: %s", name, e)
+
+    # --- sharing knobs ---
+
+    def set_time_slice(self, chip_uuids: List[str], ordinal: int) -> None:
+        """Record the cooperative time-share interval per chip (the
+        nvidia-smi compute-policy --set-timeslice analog, nvlib.go:772-791;
+        carried to the TPU runtime via workload env)."""
+        if ordinal < 0:
+            raise TpuLibError(f"invalid time-slice ordinal: {ordinal}")
+        known = {c.uuid for c in self.chips()}
+        for u in chip_uuids:
+            if u not in known:
+                raise TpuLibError(f"unknown chip uuid: {u}")
+        with self._lock:
+            for u in chip_uuids:
+                self._timeslice[u] = ordinal
+
+    def get_time_slice(self, chip_uuid: str) -> Optional[int]:
+        with self._lock:
+            return self._timeslice.get(chip_uuid)
+
+    # --- health ---
+
+    def health_events(self) -> "queue.Queue[ChipHealthEvent]":
+        return self._health_q
+
+    def inject_health_event(self, ev: ChipHealthEvent) -> None:
+        """Mark a chip (un)healthy and publish the event. On the linux
+        backend this is driven by sysfs/runtime monitors; tests and the stub
+        drive it directly (the XID fault-injection seam the reference lacks)."""
+        for c in self.chips():
+            if c.uuid == ev.chip_uuid:
+                c.healthy = ev.healthy
+        self._health_q.put(ev)

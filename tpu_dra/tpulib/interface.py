@@ -1,0 +1,116 @@
+"""The TpuLib backend interface.
+
+Reference analog: the responsibilities of ``deviceLib``
+(cmd/gpu-kubelet-plugin/nvlib.go:41-51):
+
+- enumerate chips and their attributes (:meth:`TpuLib.chips`;
+  enumerateAllPossibleDevices nvlib.go:170-198)
+- sub-slice shape/placement inventory (:meth:`TpuLib.possible_placements`;
+  inspectMigProfilesAndPlacements nvlib.go:1129-1210)
+- materialize / destroy sub-slices (:meth:`TpuLib.create_subslice`,
+  :meth:`TpuLib.delete_subslice`; createMigDevice/deleteMigDevice
+  nvlib.go:860-1089), plus listing live sub-slices for startup obliteration
+  (DestroyUnknownMIGDevices, device_state.go:337-373)
+- runtime sharing knobs (:meth:`TpuLib.set_time_slice`; setTimeSlice /
+  setComputeMode via nvidia-smi, nvlib.go:772-815)
+- health-event stream (:meth:`TpuLib.health_events`;
+  nvmlDeviceHealthMonitor, device_health.go:38-66)
+- ICI fabric identity (:meth:`TpuLib.ici_domain`; cliqueID discovery,
+  cmd/compute-domain-kubelet-plugin/nvlib.go:188-357)
+"""
+
+from __future__ import annotations
+
+import queue
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tpu_dra.tpulib.types import (
+    ChipHealthEvent,
+    ChipInfo,
+    Generation,
+    IciDomain,
+    Placement,
+    SubsliceShape,
+)
+
+
+class TpuLibError(RuntimeError):
+    pass
+
+
+@dataclass
+class SubsliceInfo:
+    """A live (materialized) sub-slice (MigDeviceInfo analog,
+    deviceinfo.go:61-111)."""
+
+    uuid: str
+    parent_chip_uuids: List[str]
+    placement: Placement
+    generation: Generation
+    dev_paths: List[str] = field(default_factory=list)
+    # Runtime bootstrap env the workload needs to address only this sub-slice
+    # (TPU_VISIBLE_CHIPS-style variables; the /proc/nvcaps dev-node analog).
+    runtime_env: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def hbm_bytes(self) -> int:
+        return self.generation.hbm_bytes * self.placement.shape.chip_count
+
+    def canonical_name(self) -> str:
+        """``tpu-<parentIndexes>-ss-<shape>-<start>`` — the naming algebra
+        the plugin parses back (mig.go:38-106 analog)."""
+        s = self.placement.start
+        return (
+            f"ss-{self.placement.shape}-{s.x}-{s.y}-{s.z}"
+        )
+
+
+class TpuLib:
+    """Abstract backend; see module docstring for the responsibility map."""
+
+    def chips(self) -> List[ChipInfo]:
+        raise NotImplementedError
+
+    def chip_by_uuid(self, uuid: str) -> Optional[ChipInfo]:
+        for c in self.chips():
+            if c.uuid == uuid:
+                return c
+        return None
+
+    def ici_domain(self) -> Optional[IciDomain]:
+        """The pod-slice fabric identity of this host (None when the host is
+        not part of a multi-host slice)."""
+        raise NotImplementedError
+
+    # --- sub-slice lifecycle (dynamic reshape) ---
+
+    def supported_shapes(self) -> List[SubsliceShape]:
+        raise NotImplementedError
+
+    def possible_placements(self, shape: SubsliceShape) -> List[Placement]:
+        raise NotImplementedError
+
+    def create_subslice(self, placement: Placement) -> SubsliceInfo:
+        raise NotImplementedError
+
+    def delete_subslice(self, uuid: str) -> None:
+        raise NotImplementedError
+
+    def list_subslices(self) -> List[SubsliceInfo]:
+        """Live sub-slices, whether or not this driver created them (feeds
+        startup obliteration of unknown sub-slices)."""
+        raise NotImplementedError
+
+    # --- sharing knobs ---
+
+    def set_time_slice(self, chip_uuids: List[str], ordinal: int) -> None:
+        raise NotImplementedError
+
+    # --- health ---
+
+    def health_events(self) -> "queue.Queue[ChipHealthEvent]":
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass

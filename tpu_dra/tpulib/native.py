@@ -1,0 +1,196 @@
+"""ctypes binding to ``native/libtputopo.so`` with pure-Python fallback.
+
+Reference analog: the cgo boundary into NVML
+(vendor/github.com/NVIDIA/go-nvml/pkg/dl/dl.go dlopens libnvidia-ml.so.1 at
+runtime; nvlib.go:56-96 resolves it under a configurable driver root). The
+same shape here: dlopen at first use, resolved from TPU_DRA_NATIVE_LIB or
+the in-repo build dir; when the library is absent every entry point falls
+back to a Python implementation with identical semantics (parity-tested in
+tests/test_tpulib.py) so stub-backend deployments never require a compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import logging
+import os
+from typing import List, Optional, Sequence, Tuple
+
+log = logging.getLogger(__name__)
+
+NATIVE_LIB_ENV = "TPU_DRA_NATIVE_LIB"
+
+_lib: "ctypes.CDLL | None" = None
+_lib_tried = False
+
+
+def _default_lib_paths() -> List[str]:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return [
+        os.path.join(here, "native", "build", "libtputopo.so"),
+        "/usr/local/lib/libtputopo.so",
+    ]
+
+
+def load_native() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    paths = []
+    env = os.environ.get(NATIVE_LIB_ENV)
+    if env:
+        paths.append(env)
+    paths.extend(_default_lib_paths())
+    for p in paths:
+        if not os.path.exists(p):
+            continue
+        try:
+            lib = ctypes.CDLL(p)
+            lib.tputopo_pci_scan.restype = ctypes.c_int
+            lib.tputopo_pci_scan.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_char_p,
+                ctypes.c_int,
+            ]
+            lib.tputopo_enumerate_placements.restype = ctypes.c_int
+            lib.tputopo_enumerate_placements.argtypes = [
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.c_int,
+            ]
+            lib.tputopo_placement_free.restype = ctypes.c_int
+            lib.tputopo_placement_free.argtypes = [
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_uint8),
+            ]
+            _lib = lib
+            log.info("loaded native tputopo library: %s", p)
+            return _lib
+        except OSError as e:
+            log.warning("failed to load %s: %s", p, e)
+    log.info("native tputopo library unavailable; using Python fallback")
+    return None
+
+
+def native_available() -> bool:
+    return load_native() is not None
+
+
+Vec3 = Tuple[int, int, int]
+
+
+def _c3(v: Sequence[int]):
+    return (ctypes.c_int * 3)(*v)
+
+
+def enumerate_placements(mesh: Vec3, shape: Vec3) -> List[Vec3]:
+    """Aligned placements of ``shape`` in ``mesh``; ValueError on degenerate
+    input (shape larger than mesh or non-positive dims)."""
+    lib = load_native()
+    if lib is not None:
+        cap = 3 * (mesh[0] * mesh[1] * mesh[2] + 1)
+        out = (ctypes.c_int * cap)()
+        n = lib.tputopo_enumerate_placements(_c3(mesh), _c3(shape), out, cap)
+        if n < 0:
+            raise ValueError(
+                f"invalid placement enumeration: shape {shape} in mesh {mesh}"
+            )
+        return [(out[i * 3], out[i * 3 + 1], out[i * 3 + 2]) for i in range(n)]
+    return _py_enumerate_placements(mesh, shape)
+
+
+def _py_enumerate_placements(mesh: Vec3, shape: Vec3) -> List[Vec3]:
+    for d in range(3):
+        if mesh[d] <= 0 or shape[d] <= 0 or shape[d] > mesh[d]:
+            raise ValueError(
+                f"invalid placement enumeration: shape {shape} in mesh {mesh}"
+            )
+    return [
+        (x, y, z)
+        for z in range(0, mesh[2] - shape[2] + 1, shape[2])
+        for y in range(0, mesh[1] - shape[1] + 1, shape[1])
+        for x in range(0, mesh[0] - shape[0] + 1, shape[0])
+    ]
+
+
+def placement_free(mesh: Vec3, shape: Vec3, start: Vec3, busy: Sequence[bool]) -> bool:
+    """Whether the aligned placement at ``start`` is unoccupied. ``busy`` has
+    one entry per mesh coordinate, indexed x + X*(y + Y*z). ValueError on an
+    out-of-bounds or misaligned start."""
+    lib = load_native()
+    if lib is not None:
+        arr = (ctypes.c_uint8 * len(busy))(*[1 if b else 0 for b in busy])
+        r = lib.tputopo_placement_free(_c3(mesh), _c3(shape), _c3(start), arr)
+        if r < 0:
+            raise ValueError(f"invalid placement: {shape}@{start} in mesh {mesh}")
+        return bool(r)
+    return _py_placement_free(mesh, shape, start, busy)
+
+
+def _py_placement_free(mesh: Vec3, shape: Vec3, start: Vec3, busy) -> bool:
+    for d in range(3):
+        if mesh[d] <= 0 or shape[d] <= 0:
+            raise ValueError(f"invalid placement: {shape}@{start} in mesh {mesh}")
+        if start[d] < 0 or start[d] % shape[d] != 0 or start[d] + shape[d] > mesh[d]:
+            raise ValueError(f"invalid placement: {shape}@{start} in mesh {mesh}")
+    for dz in range(shape[2]):
+        for dy in range(shape[1]):
+            for dx in range(shape[0]):
+                idx = (start[0] + dx) + mesh[0] * (
+                    (start[1] + dy) + mesh[1] * (start[2] + dz)
+                )
+                if busy[idx]:
+                    return False
+    return True
+
+
+def pci_scan(sysfs_root: str) -> List[dict]:
+    """Google-vendor PCI functions under ``<sysfs_root>/bus/pci/devices``."""
+    lib = load_native()
+    if lib is not None:
+        cap = 1 << 20
+        out = ctypes.create_string_buffer(cap)
+        n = lib.tputopo_pci_scan(sysfs_root.encode(), out, cap)
+        if n < 0:
+            raise RuntimeError(f"pci scan failed under {sysfs_root!r}")
+        return json.loads(out.value.decode())
+    return _py_pci_scan(sysfs_root)
+
+
+def _py_pci_scan(sysfs_root: str) -> List[dict]:
+    base = os.path.join(sysfs_root, "bus", "pci", "devices")
+    out = []
+    if not os.path.isdir(base):
+        return out
+
+    def attr(dev: str, name: str) -> str:
+        try:
+            with open(os.path.join(base, dev, name)) as f:
+                return f.read().strip()
+        except OSError:
+            return ""
+
+    def linkbase(dev: str, name: str) -> str:
+        try:
+            return os.path.basename(os.readlink(os.path.join(base, dev, name)))
+        except OSError:
+            return ""
+
+    for dev in sorted(os.listdir(base)):
+        if attr(dev, "vendor") != "0x1ae0":
+            continue
+        out.append(
+            {
+                "address": dev,
+                "device": attr(dev, "device"),
+                "numa_node": attr(dev, "numa_node"),
+                "driver": linkbase(dev, "driver"),
+                "iommu_group": linkbase(dev, "iommu_group"),
+            }
+        )
+    return out

@@ -1,0 +1,135 @@
+"""Stub tpulib backend: config-driven fake chips.
+
+This is the unit-test / kind / CPU-only path (BASELINE config 1) — the fake
+hardware layer the reference never had (SURVEY.md §4.1 flags "no fake NVML"
+as its biggest testability gap). Configure with a dict, a YAML/JSON file
+(``TPU_DRA_STUB_CONFIG``), or accept the default single-host v5e-4.
+
+Config schema::
+
+    generation: v5p            # v4 | v5e | v5p | v6e
+    chips: 4                   # chips on this host
+    hostname: host-0
+    slice:                     # omit for a single-host node
+      uuid: 1f0e...            # pod-slice UUID (fabric identity)
+      partition: 0
+      topology: 2x2x2          # whole-slice chip topology
+      num_hosts: 2
+      worker_id: 0
+    fail:                      # fault injection knobs (tests)
+      create_subslice: "msg"   # make create_subslice raise
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import uuid as uuidlib
+from typing import Dict, List, Optional
+
+import yaml
+
+from tpu_dra.tpulib.base import BaseTpuLib
+from tpu_dra.tpulib.interface import SubsliceInfo, TpuLibError
+from tpu_dra.tpulib.types import (
+    GENERATIONS,
+    ChipInfo,
+    Generation,
+    IciDomain,
+    Placement,
+    TopologyCoord,
+    parse_topology,
+)
+
+log = logging.getLogger(__name__)
+
+STUB_CONFIG_ENV = "TPU_DRA_STUB_CONFIG"
+
+
+def _stable_uuid(*parts: str) -> str:
+    h = hashlib.sha256("/".join(parts).encode()).hexdigest()
+    return str(uuidlib.UUID(h[:32]))
+
+
+class StubTpuLib(BaseTpuLib):
+    def __init__(
+        self,
+        config: Optional[dict] = None,
+        config_path: Optional[str] = None,
+        state_dir: Optional[str] = None,
+    ):
+        if config is None:
+            path = config_path or os.environ.get(STUB_CONFIG_ENV)
+            if path:
+                with open(path) as f:
+                    config = yaml.safe_load(f) or {}
+            else:
+                config = {}
+        self._config = config
+        gen_name = config.get("generation", "v5e")
+        if gen_name not in GENERATIONS:
+            raise TpuLibError(f"unknown TPU generation: {gen_name!r}")
+        self._generation = GENERATIONS[gen_name]
+        self._hostname = config.get("hostname", os.uname().nodename)
+        n = int(config.get("chips", self._generation.chips_per_host))
+        hx, hy, hz = self._generation.host_extent
+        if n > hx * hy * hz:
+            raise TpuLibError(
+                f"{n} chips exceed host extent "
+                f"{self._generation.host_extent} for {gen_name}"
+            )
+        self._ici: Optional[IciDomain] = None
+        self._worker_id = 0
+        sl = config.get("slice")
+        if sl:
+            self._ici = IciDomain(
+                slice_uuid=sl.get("uuid") or _stable_uuid(self._hostname, "slice"),
+                partition=int(sl.get("partition", 0)),
+                topology=parse_topology(sl.get("topology", "2x2x1")),
+            )
+            self._worker_id = int(sl.get("worker_id", 0))
+        self._chips: List[ChipInfo] = []
+        for i in range(n):
+            # Host-local coords fill x-fastest within the host extent.
+            coord = TopologyCoord(i % hx, (i // hx) % hy, i // (hx * hy))
+            self._chips.append(
+                ChipInfo(
+                    index=i,
+                    uuid=_stable_uuid(self._hostname, gen_name, str(i)),
+                    generation=self._generation,
+                    pci_bus_id=f"0000:0{i}:00.0",
+                    pcie_root=f"pci0000:0{i}",
+                    numa_node=i // max(1, n // 2),
+                    dev_paths=[f"/dev/accel{i}"],
+                    coord=coord,
+                    ici_domain=self._ici,
+                    worker_id=self._worker_id,
+                    iommu_group=i,
+                    vfio_capable=True,
+                )
+            )
+        super().__init__(state_dir=state_dir)
+
+    def generation(self) -> Generation:
+        return self._generation
+
+    def chips(self) -> List[ChipInfo]:
+        return self._chips
+
+    def ici_domain(self) -> Optional[IciDomain]:
+        return self._ici
+
+    # --- fault injection ---
+
+    def create_subslice(self, placement: Placement) -> SubsliceInfo:
+        msg = self._config.get("fail", {}).get("create_subslice")
+        if msg:
+            raise TpuLibError(f"injected fault: {msg}")
+        return super().create_subslice(placement)
+
+    def delete_subslice(self, uuid: str) -> None:
+        msg = self._config.get("fail", {}).get("delete_subslice")
+        if msg:
+            raise TpuLibError(f"injected fault: {msg}")
+        super().delete_subslice(uuid)

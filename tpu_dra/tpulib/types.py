@@ -1,0 +1,229 @@
+"""Core tpulib data model.
+
+Reference analog: the info structs in cmd/gpu-kubelet-plugin/deviceinfo.go
+(GpuInfo :40-111 with uuid/productName/architecture/memory/pciBusID
+attributes) and the MIG profile/placement model (MigProfileInfo,
+MigDevicePlacement in nvlib.go:1129-1210).
+
+TPU-native modeling decisions:
+
+- A **chip** is the allocatable unit (the GPU analog). Chips sit at integer
+  coordinates in the ICI mesh of their pod slice; the coordinate system is
+  the basis for sub-slice placement (the MIG placement analog, which for
+  TPUs is *topology-constrained*: a sub-slice must be a contiguous
+  axis-aligned block of the mesh).
+- A **sub-slice shape** (MIG profile analog) is an axis-aligned extent like
+  ``2x2x1``, with per-generation catalogs mirroring the supported Cloud TPU
+  slice shapes.
+- The **ICI domain** (NVLink clique analog) identifies the pod slice a chip
+  belongs to: ``sliceUUID.partition`` — the cliqueID string the CD machinery
+  shares with the reference (cmd/compute-domain-kubelet-plugin/nvlib.go:188-357).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True, order=True)
+class TopologyCoord:
+    x: int
+    y: int
+    z: int = 0
+
+    def __str__(self) -> str:
+        return f"{self.x},{self.y},{self.z}"
+
+    @classmethod
+    def parse(cls, s: str) -> "TopologyCoord":
+        parts = [int(p) for p in s.split(",")]
+        while len(parts) < 3:
+            parts.append(0)
+        return cls(*parts[:3])
+
+
+def parse_topology(s: str) -> Tuple[int, int, int]:
+    """Parse ``4x4`` / ``2x2x2`` topology strings to a 3D extent."""
+    m = re.fullmatch(r"(\d+)x(\d+)(?:x(\d+))?", s.strip())
+    if not m:
+        raise ValueError(f"invalid topology string: {s!r}")
+    x, y, z = int(m.group(1)), int(m.group(2)), int(m.group(3) or 1)
+    if x <= 0 or y <= 0 or z <= 0:
+        raise ValueError(f"invalid topology string: {s!r}")
+    return (x, y, z)
+
+
+def topology_str(extent: Tuple[int, int, int]) -> str:
+    x, y, z = extent
+    return f"{x}x{y}" if z == 1 else f"{x}x{y}x{z}"
+
+
+@dataclass(frozen=True)
+class Generation:
+    """Per-generation hardware catalog entry."""
+
+    name: str  # "v5p"
+    product_name: str  # "tpu-v5p-slice"
+    cores_per_chip: int
+    hbm_bytes: int
+    chips_per_host: int
+    # Host-local chip arrangement within the mesh (e.g. v5p: 2x2x1 per host).
+    host_extent: Tuple[int, int, int]
+    mesh_dims: int  # 2 for 2D meshes (v5e/v6e), 3 for 3D torus (v4/v5p)
+    # Catalog of sub-slice shapes materializable *within one host's chips*
+    # (the dynamic-reshape inventory; multi-host shapes are ComputeDomains).
+    subslice_shapes: Tuple[Tuple[int, int, int], ...]
+    pci_device_ids: Tuple[str, ...] = ()
+
+    def accelerator_type(self, num_chips: int) -> str:
+        """Cloud TPU naming counts TensorCores: v5p-16 == 8 chips."""
+        return f"{self.name}-{num_chips * self.cores_per_chip}"
+
+
+GIB = 1024**3
+
+# Public Cloud TPU generation data (shapes are per-host sub-slice shapes).
+GENERATIONS: Dict[str, Generation] = {
+    "v4": Generation(
+        name="v4",
+        product_name="tpu-v4-podslice",
+        cores_per_chip=2,
+        hbm_bytes=32 * GIB,
+        chips_per_host=4,
+        host_extent=(2, 2, 1),
+        mesh_dims=3,
+        subslice_shapes=((1, 1, 1), (1, 2, 1), (2, 2, 1)),
+        pci_device_ids=("0x005e",),
+    ),
+    "v5e": Generation(
+        name="v5e",
+        product_name="tpu-v5-lite-podslice",
+        cores_per_chip=1,
+        hbm_bytes=16 * GIB,
+        chips_per_host=4,
+        host_extent=(2, 2, 1),
+        mesh_dims=2,
+        subslice_shapes=((1, 1, 1), (1, 2, 1), (2, 2, 1)),
+        pci_device_ids=("0x0063",),
+    ),
+    "v5p": Generation(
+        name="v5p",
+        product_name="tpu-v5p-slice",
+        cores_per_chip=2,
+        hbm_bytes=95 * GIB,
+        chips_per_host=4,
+        host_extent=(2, 2, 1),
+        mesh_dims=3,
+        subslice_shapes=((1, 1, 1), (1, 2, 1), (2, 2, 1)),
+        pci_device_ids=("0x0062",),
+    ),
+    "v6e": Generation(
+        name="v6e",
+        product_name="tpu-v6e-slice",
+        cores_per_chip=1,
+        hbm_bytes=32 * GIB,
+        chips_per_host=4,
+        host_extent=(2, 2, 1),
+        mesh_dims=2,
+        subslice_shapes=((1, 1, 1), (1, 2, 1), (2, 2, 1)),
+        pci_device_ids=("0x006f",),
+    ),
+}
+
+
+@dataclass(frozen=True)
+class IciDomain:
+    """The pod-slice fabric a chip belongs to (NVLink clique analog).
+
+    ``clique_id()`` yields the stable string the ComputeDomain machinery keys
+    cliques on: ``<sliceUUID>.<partition>``.
+    """
+
+    slice_uuid: str
+    partition: int = 0
+    topology: Tuple[int, int, int] = (0, 0, 0)
+
+    def clique_id(self) -> str:
+        return f"{self.slice_uuid}.{self.partition}"
+
+
+@dataclass
+class ChipInfo:
+    """One TPU chip (GpuInfo analog, deviceinfo.go:40-60)."""
+
+    index: int  # host-local index (minor analog)
+    uuid: str
+    generation: Generation
+    pci_bus_id: str = ""
+    pcie_root: str = ""
+    numa_node: int = -1
+    dev_paths: List[str] = field(default_factory=list)  # /dev/accelN, /dev/vfio/..
+    coord: TopologyCoord = field(default_factory=lambda: TopologyCoord(0, 0, 0))
+    ici_domain: Optional[IciDomain] = None
+    worker_id: int = 0  # this host's index within the pod slice
+    iommu_group: int = -1
+    vfio_capable: bool = False
+    healthy: bool = True
+
+    @property
+    def hbm_bytes(self) -> int:
+        return self.generation.hbm_bytes
+
+    def canonical_name(self) -> str:
+        """DRA device name for the full chip: ``tpu-<index>``."""
+        return f"tpu-{self.index}"
+
+
+@dataclass(frozen=True)
+class SubsliceShape:
+    """A materializable sub-slice profile (MigProfileInfo analog)."""
+
+    extent: Tuple[int, int, int]
+
+    @property
+    def chip_count(self) -> int:
+        x, y, z = self.extent
+        return x * y * z
+
+    def __str__(self) -> str:
+        return topology_str(self.extent)
+
+    @classmethod
+    def parse(cls, s: str) -> "SubsliceShape":
+        return cls(parse_topology(s))
+
+
+@dataclass(frozen=True)
+class Placement:
+    """A concrete placement of a shape in the host mesh
+    (MigDevicePlacement analog: start + size, nvlib.go:1176-1210)."""
+
+    start: TopologyCoord
+    shape: SubsliceShape
+
+    def chips(self) -> List[TopologyCoord]:
+        sx, sy, sz = self.shape.extent
+        return [
+            TopologyCoord(self.start.x + dx, self.start.y + dy, self.start.z + dz)
+            for dz in range(sz)
+            for dy in range(sy)
+            for dx in range(sx)
+        ]
+
+    def overlaps(self, other: "Placement") -> bool:
+        return bool(set(self.chips()) & set(other.chips()))
+
+    def __str__(self) -> str:
+        return f"{self.shape}@{self.start}"
+
+
+@dataclass(frozen=True)
+class ChipHealthEvent:
+    """Health transition for a chip (XID-event analog,
+    device_health.go:38-66)."""
+
+    chip_uuid: str
+    healthy: bool
+    reason: str = ""
