@@ -1,0 +1,464 @@
+"""tpu-kubelet-plugin state-machine tests.
+
+Covers the crash-consistency triad the reference's e2e suites exercise
+(SURVEY.md §5): WAL checkpoints + rollback, idempotent Prepare,
+double-allocation defense, dynamic sub-slice lifecycle, sharing configs,
+checkpoint V1→V2 migration.
+"""
+
+import json
+import uuid as uuidlib
+
+import pytest
+
+from tpu_dra.infra import featuregates as fg
+from tpu_dra.k8sclient import DEPLOYMENTS, FakeCluster, ResourceClient
+from tpu_dra.plugin.cdi import CDIHandler
+from tpu_dra.plugin.checkpoint import (
+    CLAIM_STATE_PREPARE_COMPLETED,
+    CLAIM_STATE_PREPARE_STARTED,
+    Checkpoint,
+    CheckpointManager,
+    ChecksumError,
+    PreparedClaim,
+)
+from tpu_dra.plugin.device_state import (
+    DRIVER_NAME,
+    DeviceState,
+    PermanentError,
+    PrepareError,
+)
+from tpu_dra.plugin.sharing import MultiplexManager
+from tpu_dra.tpulib.stub import StubTpuLib
+
+
+def gates(**kwargs):
+    g = fg.FeatureGates()
+    for k, v in kwargs.items():
+        g.set(k, v)
+    fg.reset_for_tests(g)
+
+
+def make_state(tmp_path, backend=None, stub_cfg=None, **kwargs):
+    lib = StubTpuLib(
+        config={"generation": "v5e", "hostname": "node-0", **(stub_cfg or {})},
+        state_dir=str(tmp_path / "tpustate"),
+    )
+    cdi = CDIHandler(cdi_root=str(tmp_path / "cdi"))
+    cpm = CheckpointManager(str(tmp_path / "ckpt"))
+    backend = backend or FakeCluster()
+    mm = MultiplexManager(backend, node_name="node-0")
+    return DeviceState(
+        tpulib=lib,
+        cdi=cdi,
+        checkpoints=cpm,
+        multiplex_manager=mm,
+        node_name="node-0",
+        **kwargs,
+    ), backend
+
+
+def make_claim(devices=("tpu-0",), configs=None, uid=None, request="req0"):
+    uid = uid or str(uuidlib.uuid4())
+    results = [
+        {"request": request, "driver": DRIVER_NAME, "pool": "node-0", "device": d}
+        for d in devices
+    ]
+    return {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {"name": f"claim-{uid[:6]}", "namespace": "default", "uid": uid},
+        "status": {
+            "allocation": {
+                "devices": {"results": results, "config": configs or []}
+            }
+        },
+    }
+
+
+def opaque(params, requests=None):
+    return {
+        "opaque": {"driver": DRIVER_NAME, "parameters": params},
+        "requests": requests or [],
+        "source": "FromClaim",
+    }
+
+
+# --- basic prepare/unprepare ------------------------------------------------
+
+
+def test_prepare_full_chip(tmp_path):
+    state, _ = make_state(tmp_path)
+    claim = make_claim(["tpu-0"])
+    devices = state.prepare(claim)
+    assert len(devices) == 1
+    assert devices[0].device_name == "tpu-0"
+    assert devices[0].cdi_device_ids == [
+        f"k8s.tpu.google.com/claim={claim['metadata']['uid']}-tpu-0"
+    ]
+    # CDI spec exists with accel node + env
+    spec = state.cdi.read_claim_spec(claim["metadata"]["uid"])
+    dev = spec["devices"][0]
+    assert {"path": "/dev/accel0"} in dev["containerEdits"]["deviceNodes"]
+    assert any(
+        e.startswith("TPU_VISIBLE_DEVICES=0") for e in dev["containerEdits"]["env"]
+    )
+    # checkpoint says PrepareCompleted
+    cp = state.checkpoints.get()
+    pc = cp.prepared_claims[claim["metadata"]["uid"]]
+    assert pc.checkpoint_state == CLAIM_STATE_PREPARE_COMPLETED
+
+    state.unprepare(claim["metadata"]["uid"])
+    assert state.cdi.read_claim_spec(claim["metadata"]["uid"]) is None
+    assert state.checkpoints.get().prepared_claims == {}
+
+
+def test_prepare_is_idempotent(tmp_path):
+    state, _ = make_state(tmp_path)
+    claim = make_claim(["tpu-1"])
+    d1 = state.prepare(claim)
+    d2 = state.prepare(claim)
+    assert [d.device_name for d in d1] == [d.device_name for d in d2]
+
+
+def test_prepare_multi_chip_claim(tmp_path):
+    state, _ = make_state(tmp_path)
+    claim = make_claim(["tpu-0", "tpu-1", "tpu-2", "tpu-3"])
+    devices = state.prepare(claim)
+    assert sorted(d.device_name for d in devices) == [
+        "tpu-0",
+        "tpu-1",
+        "tpu-2",
+        "tpu-3",
+    ]
+
+
+def test_unallocated_claim_rejected(tmp_path):
+    state, _ = make_state(tmp_path)
+    claim = make_claim()
+    del claim["status"]["allocation"]
+    claim["status"]["allocation"] = None
+    with pytest.raises(PrepareError, match="not yet allocated"):
+        state.prepare(claim)
+
+
+def test_unknown_device_rejected(tmp_path):
+    state, _ = make_state(tmp_path)
+    with pytest.raises(PrepareError, match="not allocatable"):
+        state.prepare(make_claim(["tpu-99"]))
+
+
+def test_unprepare_unknown_claim_is_noop(tmp_path):
+    state, _ = make_state(tmp_path)
+    state.unprepare("never-seen")  # must not raise
+
+
+# --- double-allocation defense (device_state.go:1118-1154) ------------------
+
+
+def test_overlapping_prepared_devices_rejected(tmp_path):
+    state, _ = make_state(tmp_path)
+    state.prepare(make_claim(["tpu-0"]))
+    with pytest.raises(PrepareError, match="already prepared"):
+        state.prepare(make_claim(["tpu-0"]))
+    # A different chip is fine.
+    state.prepare(make_claim(["tpu-1"]))
+
+
+def test_subslice_chip_coordinate_overlap_rejected(tmp_path):
+    gates(DynamicSubslice=True)
+    state, _ = make_state(tmp_path)
+    # Prepare the sub-slice covering chips (0,0) and (0,1) == tpu-0, tpu-2.
+    state.prepare(make_claim(["tpu-ss-1x2-0-0-0"]))
+    # A full-chip claim for a covered coordinate must be rejected even
+    # though the device *name* differs.
+    with pytest.raises(PrepareError, match="overlaps"):
+        state.prepare(make_claim(["tpu-0"]))
+    # An uncovered chip is fine.
+    state.prepare(make_claim(["tpu-1"]))
+
+
+# --- WAL rollback (device_state.go:223-228, 482-516) ------------------------
+
+
+def test_stale_prepare_started_rolls_back_orphans(tmp_path):
+    gates(DynamicSubslice=True)
+    state, backend = make_state(tmp_path)
+    claim = make_claim(["tpu-ss-1x2-0-0-0"])
+    uid = claim["metadata"]["uid"]
+
+    # Simulate a crash mid-prepare: PrepareStarted record + an orphaned live
+    # sub-slice, no device detail persisted.
+    state.checkpoints.update(
+        lambda cp: cp.prepared_claims.__setitem__(
+            uid,
+            PreparedClaim(
+                checkpoint_state=CLAIM_STATE_PREPARE_STARTED,
+                name=claim["metadata"]["name"],
+                namespace="default",
+            ),
+        )
+    )
+    orphan = state.tpulib.create_subslice(
+        state.allocatable["tpu-ss-1x2-0-0-0"].placement
+    )
+    assert len(state.tpulib.list_subslices()) == 1
+
+    # Retry must roll back the orphan, then succeed.
+    devices = state.prepare(claim)
+    assert len(devices) == 1
+    live = state.tpulib.list_subslices()
+    assert len(live) == 1
+    assert live[0].uuid != orphan.uuid  # recreated from scratch
+
+
+def test_unprepare_of_partially_prepared_claim(tmp_path):
+    gates(DynamicSubslice=True)
+    state, _ = make_state(tmp_path)
+    uid = str(uuidlib.uuid4())
+    state.checkpoints.update(
+        lambda cp: cp.prepared_claims.__setitem__(
+            uid, PreparedClaim(checkpoint_state=CLAIM_STATE_PREPARE_STARTED)
+        )
+    )
+    orphan = state.tpulib.create_subslice(
+        state.allocatable["tpu-ss-1x1-0-0-0"].placement
+    )
+    state.unprepare(uid)
+    assert state.tpulib.list_subslices() == []
+    assert state.checkpoints.get().prepared_claims == {}
+
+
+# --- dynamic sub-slice lifecycle (BASELINE config 5) ------------------------
+
+
+def test_dynamic_subslice_prepare_materializes(tmp_path):
+    gates(DynamicSubslice=True)
+    state, _ = make_state(tmp_path)
+    assert "tpu-ss-2x2-0-0-0" in state.allocatable
+    claim = make_claim(["tpu-ss-2x2-0-0-0"])
+    devices = state.prepare(claim)
+    assert len(devices) == 1
+    live = state.tpulib.list_subslices()
+    assert len(live) == 1
+    assert str(live[0].placement.shape) == "2x2"
+    spec = state.cdi.read_claim_spec(claim["metadata"]["uid"])
+    env = spec["devices"][0]["containerEdits"]["env"]
+    assert "TPU_CHIPS_PER_PROCESS_BOUNDS=2,2,1" in env
+
+    state.unprepare(claim["metadata"]["uid"])
+    assert state.tpulib.list_subslices() == []
+
+
+def test_destroy_unknown_subslices_at_startup(tmp_path):
+    gates(DynamicSubslice=True)
+    state, _ = make_state(tmp_path)
+    # A completed claim's sub-slice survives; an unknown one is destroyed.
+    claim = make_claim(["tpu-ss-1x1-0-0-0"])
+    state.prepare(claim)
+    unknown = state.tpulib.create_subslice(
+        state.allocatable["tpu-ss-1x1-1-0-0"].placement
+    )
+    destroyed = state.destroy_unknown_subslices()
+    assert destroyed == [unknown.uuid]
+    remaining = [s.uuid for s in state.tpulib.list_subslices()]
+    assert len(remaining) == 1 and remaining[0] != unknown.uuid
+
+
+# --- opaque configs + sharing ----------------------------------------------
+
+
+def test_time_slicing_config_applied(tmp_path):
+    gates(TimeSlicingSettings=True)
+    state, _ = make_state(tmp_path)
+    params = {
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "TpuConfig",
+        "sharing": {
+            "strategy": "TimeSlicing",
+            "timeSlicingConfig": {"interval": "Long"},
+        },
+    }
+    claim = make_claim(["tpu-0"], configs=[opaque(params, ["req0"])])
+    state.prepare(claim)
+    chip = state.tpulib.chips()[0]
+    assert state.tpulib.get_time_slice(chip.uuid) == 3
+    spec = state.cdi.read_claim_spec(claim["metadata"]["uid"])
+    assert "TPU_TIMESLICE_ORDINAL=3" in spec["devices"][0]["containerEdits"]["env"]
+    # Unprepare resets to default interval.
+    state.unprepare(claim["metadata"]["uid"])
+    assert state.tpulib.get_time_slice(chip.uuid) == 0
+
+
+def test_multiplexing_config_spawns_control_daemon(tmp_path):
+    gates(MultiplexingSupport=True)
+    backend = FakeCluster()
+    state, _ = make_state(tmp_path, backend=backend)
+    deployments = ResourceClient(backend, DEPLOYMENTS)
+
+    # Make the daemon "become ready" as soon as it is created.
+    w = backend.watch(DEPLOYMENTS)
+
+    import threading
+
+    def readiness_controller():
+        for ev, obj in w:
+            if ev == "ADDED":
+                obj["status"] = {"readyReplicas": 1}
+                deployments.update_status(obj)
+                return
+
+    t = threading.Thread(target=readiness_controller, daemon=True)
+    t.start()
+
+    params = {
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "TpuConfig",
+        "sharing": {
+            "strategy": "Multiplexing",
+            "multiplexingConfig": {"defaultHbmLimit": "4Gi"},
+        },
+    }
+    claim = make_claim(["tpu-0", "tpu-1"], configs=[opaque(params, ["req0"])])
+    state.prepare(claim)
+    t.join(timeout=3)
+
+    deps = deployments.list(namespace="tpu-dra-driver")
+    assert len(deps) == 1
+    env = {
+        e["name"]: e.get("value", "")
+        for e in deps[0]["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    assert "TPU_MULTIPLEX_HBM_LIMITS" in env
+    assert "=4Gi" in env["TPU_MULTIPLEX_HBM_LIMITS"]
+    spec = state.cdi.read_claim_spec(claim["metadata"]["uid"])
+    env_list = spec["devices"][0]["containerEdits"]["env"]
+    assert "TPU_PROCESS_MULTIPLEXING=true" in env_list
+
+    # Unprepare deletes the daemon Deployment.
+    state.unprepare(claim["metadata"]["uid"])
+    assert deployments.list(namespace="tpu-dra-driver") == []
+
+
+def test_multiplexing_without_gate_is_permanent_error(tmp_path):
+    state, _ = make_state(tmp_path)
+    params = {
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "TpuConfig",
+        "sharing": {"strategy": "Multiplexing"},
+    }
+    with pytest.raises(PermanentError):
+        state.prepare(make_claim(["tpu-0"], configs=[opaque(params, ["req0"])]))
+
+
+def test_malformed_opaque_config_is_permanent_error(tmp_path):
+    state, _ = make_state(tmp_path)
+    params = {
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "TpuConfig",
+        "bogus": True,
+    }
+    with pytest.raises(PermanentError, match="decoding"):
+        state.prepare(make_claim(["tpu-0"], configs=[opaque(params, ["req0"])]))
+
+
+def test_config_type_mismatch_rejected(tmp_path):
+    gates(DynamicSubslice=True)
+    state, _ = make_state(tmp_path)
+    params = {
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "TpuConfig",  # full-chip config...
+    }
+    claim = make_claim(
+        ["tpu-ss-1x1-0-0-0"], configs=[opaque(params, ["req0"])]
+    )  # ...explicitly bound to a sub-slice request
+    with pytest.raises(PermanentError, match="cannot apply"):
+        state.prepare(claim)
+
+
+# --- checkpoint format ------------------------------------------------------
+
+
+def test_checkpoint_corruption_detected(tmp_path):
+    cpm = CheckpointManager(str(tmp_path))
+    cpm.update(
+        lambda cp: cp.prepared_claims.__setitem__(
+            "u1", PreparedClaim(checkpoint_state=CLAIM_STATE_PREPARE_COMPLETED)
+        )
+    )
+    raw = open(cpm.path).read()
+    with open(cpm.path, "w") as f:
+        f.write(raw.replace("PrepareCompleted", "PrepareCorrupted"))
+    with pytest.raises(ChecksumError):
+        cpm.get()
+
+
+def test_checkpoint_v1_migration(tmp_path):
+    """A V1-era checkpoint (pre-WAL) reads as all-PrepareCompleted
+    (checkpointv.go ToV2)."""
+    import zlib
+
+    v1 = {
+        "preparedClaims": {
+            "old-uid": {
+                "status": {},
+                "preparedDevices": [
+                    {
+                        "devices": [
+                            {
+                                "type": "tpu",
+                                "device": {
+                                    "requests": ["r"],
+                                    "poolName": "n",
+                                    "deviceName": "tpu-0",
+                                    "cdiDeviceIDs": [],
+                                },
+                                "chipUUID": "u",
+                            }
+                        ],
+                        "configState": {},
+                    }
+                ],
+            }
+        }
+    }
+    v1_view = {"checksum": 0, "v1": v1}
+    crc = zlib.crc32(
+        json.dumps(v1_view, sort_keys=True, separators=(",", ":")).encode()
+    ) & 0xFFFFFFFF
+    (tmp_path / "checkpoint.json").write_text(
+        json.dumps({"checksum": crc, "v1": v1})
+    )
+    cpm = CheckpointManager(str(tmp_path))
+    cp = cpm.get()
+    pc = cp.prepared_claims["old-uid"]
+    assert pc.checkpoint_state == CLAIM_STATE_PREPARE_COMPLETED
+    assert pc.prepared_devices.device_names() == ["tpu-0"]
+
+
+def test_checkpoint_roundtrip_carries_v1_for_downgrade(tmp_path):
+    """MarshalCheckpoint writes both V1+V2 renderings so a downgraded
+    driver can read the file (checkpoint.go:26-35)."""
+    cpm = CheckpointManager(str(tmp_path))
+    cpm.update(
+        lambda cp: cp.prepared_claims.__setitem__(
+            "u2",
+            PreparedClaim(
+                checkpoint_state=CLAIM_STATE_PREPARE_COMPLETED,
+                name="c",
+                namespace="d",
+            ),
+        )
+    )
+    top = json.loads(open(cpm.path).read())
+    assert "v1" in top and "v2" in top
+    assert "u2" in top["v1"]["preparedClaims"]
+    # In-flight claims are excluded from the V1 view.
+    cpm.update(
+        lambda cp: cp.prepared_claims.__setitem__(
+            "u3", PreparedClaim(checkpoint_state=CLAIM_STATE_PREPARE_STARTED)
+        )
+    )
+    top = json.loads(open(cpm.path).read())
+    assert "u3" not in top["v1"]["preparedClaims"]
+    assert "u3" in top["v2"]["preparedClaims"]

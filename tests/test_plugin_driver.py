@@ -1,0 +1,353 @@
+"""Driver-level tests: ResourceSlice publication, health-driven republish,
+stale-claim GC, and the DRA gRPC surface over a real unix socket."""
+
+import threading
+import time
+import uuid as uuidlib
+
+import grpc
+import pytest
+
+from tpu_dra.infra import featuregates as fg
+from tpu_dra.k8sclient import (
+    RESOURCE_CLAIMS,
+    RESOURCE_SLICES,
+    FakeCluster,
+    ResourceClient,
+)
+from tpu_dra.plugin.driver import Driver, DriverConfig
+from tpu_dra.plugin.device_state import DRIVER_NAME
+from tpu_dra.plugin.pb import dra_v1beta1_pb2 as drapb
+from tpu_dra.plugin.pb import pluginregistration_pb2 as regpb
+from tpu_dra.tpulib.stub import StubTpuLib
+from tpu_dra.tpulib.types import ChipHealthEvent
+
+
+def gates(**kwargs):
+    g = fg.FeatureGates()
+    for k, v in kwargs.items():
+        g.set(k, v)
+    fg.reset_for_tests(g)
+
+
+def make_driver(tmp_path, backend=None, start_grpc=False, **cfg):
+    lib = StubTpuLib(
+        config={"generation": "v5e", "hostname": "node-0"},
+        state_dir=str(tmp_path / "tpustate"),
+    )
+    backend = backend or FakeCluster()
+    config = DriverConfig(
+        node_name="node-0",
+        cdi_root=str(tmp_path / "cdi"),
+        plugin_data_dir=str(tmp_path / "plugin"),
+        kubelet_registrar_dir=str(tmp_path / "registry"),
+        start_grpc=start_grpc,
+        **cfg,
+    )
+    return Driver(lib, backend, config), backend
+
+
+def test_publish_split_slices(tmp_path):
+    driver, backend = make_driver(tmp_path)
+    driver.publish_resources()
+    slices = ResourceClient(backend, RESOURCE_SLICES).list()
+    assert len(slices) == 1  # one per device type; only "tpu" without gates
+    s = slices[0]
+    assert s["spec"]["driver"] == DRIVER_NAME
+    assert s["spec"]["nodeName"] == "node-0"
+    names = [d["name"] for d in s["spec"]["devices"]]
+    assert names == ["tpu-0", "tpu-1", "tpu-2", "tpu-3"]
+    d0 = s["spec"]["devices"][0]["basic"]
+    assert d0["attributes"]["generation"] == {"string": "v5e"}
+    assert d0["attributes"]["topologyCoord"] == {"string": "0,0,0"}
+    assert d0["capacity"]["hbm"]["value"] == str(16 * 1024**3)
+
+
+def test_publish_combined_partitionable_slices(tmp_path):
+    gates(DynamicSubslice=True)
+    driver, backend = make_driver(tmp_path, resource_api_version="v1beta2")
+    driver.publish_resources()
+    slices = ResourceClient(backend, RESOURCE_SLICES).list()
+    assert len(slices) == 1
+    s = slices[0]
+    assert s["apiVersion"] == "resource.k8s.io/v1beta2"
+    counters = s["spec"]["sharedCounters"][0]["counters"]
+    assert set(counters) == {
+        "chip-0-0-0",
+        "chip-1-0-0",
+        "chip-0-1-0",
+        "chip-1-1-0",
+    }
+    by_name = {d["name"]: d for d in s["spec"]["devices"]}
+    # Full host 2x2 sub-slice consumes all four counters; tpu-0 consumes one.
+    ss = by_name["tpu-ss-2x2-0-0-0"]["basic"]["consumesCounters"][0]
+    assert set(ss["counters"]) == set(counters)
+    t0 = by_name["tpu-0"]["basic"]["consumesCounters"][0]
+    assert set(t0["counters"]) == {"chip-0-0-0"}
+
+
+def test_health_event_unpublishes_device(tmp_path):
+    gates(DeviceHealthCheck=True)
+    driver, backend = make_driver(tmp_path)
+    driver.start()
+    slices_client = ResourceClient(backend, RESOURCE_SLICES)
+    assert len(slices_client.list()[0]["spec"]["devices"]) == 4
+
+    victim = driver.tpulib.chips()[2]
+    driver.tpulib.inject_health_event(
+        ChipHealthEvent(chip_uuid=victim.uuid, healthy=False, reason="ici link down")
+    )
+    deadline = time.monotonic() + 3
+    while time.monotonic() < deadline:
+        devs = [d["name"] for d in slices_client.list()[0]["spec"]["devices"]]
+        if "tpu-2" not in devs:
+            break
+        time.sleep(0.02)
+    assert "tpu-2" not in devs and len(devs) == 3
+
+    # Benign reasons must not unpublish.
+    driver.tpulib.inject_health_event(
+        ChipHealthEvent(chip_uuid=victim.uuid, healthy=True, reason="recovered")
+    )
+    deadline = time.monotonic() + 3
+    while time.monotonic() < deadline:
+        devs = [d["name"] for d in slices_client.list()[0]["spec"]["devices"]]
+        if len(devs) == 4:
+            break
+        time.sleep(0.02)
+    assert len(devs) == 4
+    driver.shutdown()
+
+
+def test_cleanup_unprepares_stale_claims(tmp_path):
+    driver, backend = make_driver(tmp_path)
+    claims = ResourceClient(backend, RESOURCE_CLAIMS)
+    uid = str(uuidlib.uuid4())
+    obj = claims.create(
+        {
+            "metadata": {"name": "c1", "namespace": "default"},
+            "spec": {},
+            "status": {
+                "allocation": {
+                    "devices": {
+                        "results": [
+                            {
+                                "request": "r",
+                                "driver": DRIVER_NAME,
+                                "pool": "node-0",
+                                "device": "tpu-0",
+                            }
+                        ],
+                        "config": [],
+                    }
+                }
+            },
+        }
+    )
+    claim = claims.get("c1", "default")
+    driver.state.prepare(claim)
+    # Claim still exists: nothing stale.
+    assert driver.cleanup.cleanup_once() == 0
+    # Delete from the API server: now stale, gets unprepared.
+    claims.delete("c1", "default")
+    assert driver.cleanup.cleanup_once() == 1
+    assert driver.state.checkpoints.get().prepared_claims == {}
+
+
+def test_cleanup_detects_uid_change(tmp_path):
+    driver, backend = make_driver(tmp_path)
+    claims = ResourceClient(backend, RESOURCE_CLAIMS)
+    claims.create({"metadata": {"name": "c1", "namespace": "default"}, "spec": {}})
+    live = claims.get("c1", "default")
+    live["status"] = {
+        "allocation": {
+            "devices": {
+                "results": [
+                    {
+                        "request": "r",
+                        "driver": DRIVER_NAME,
+                        "pool": "node-0",
+                        "device": "tpu-0",
+                    }
+                ],
+                "config": [],
+            }
+        }
+    }
+    claims.update(live)
+    driver.state.prepare(claims.get("c1", "default"))
+    # Recreate under the same name -> new UID -> stale.
+    claims.delete("c1", "default")
+    claims.create({"metadata": {"name": "c1", "namespace": "default"}, "spec": {}})
+    assert driver.cleanup.cleanup_once() == 1
+
+
+# --- gRPC end-to-end --------------------------------------------------------
+
+
+@pytest.fixture
+def grpc_driver(tmp_path):
+    driver, backend = make_driver(tmp_path, start_grpc=True)
+    driver.start()
+    yield driver, backend
+    driver.shutdown()
+
+
+def _dra_stub(driver):
+    channel = grpc.insecure_channel(
+        f"unix://{driver.config.plugin_data_dir}/dra.sock"
+    )
+    return channel
+
+
+def test_grpc_prepare_unprepare_roundtrip(grpc_driver):
+    driver, backend = grpc_driver
+    claims = ResourceClient(backend, RESOURCE_CLAIMS)
+    created = claims.create(
+        {
+            "metadata": {"name": "c1", "namespace": "default"},
+            "spec": {},
+            "status": {
+                "allocation": {
+                    "devices": {
+                        "results": [
+                            {
+                                "request": "r",
+                                "driver": DRIVER_NAME,
+                                "pool": "node-0",
+                                "device": "tpu-0",
+                            }
+                        ],
+                        "config": [],
+                    }
+                }
+            },
+        }
+    )
+    uid = created["metadata"]["uid"]
+    channel = _dra_stub(driver)
+    prepare = channel.unary_unary(
+        "/v1beta1.DRAPlugin/NodePrepareResources",
+        request_serializer=drapb.NodePrepareResourcesRequest.SerializeToString,
+        response_deserializer=drapb.NodePrepareResourcesResponse.FromString,
+    )
+    req = drapb.NodePrepareResourcesRequest(
+        claims=[drapb.Claim(uid=uid, name="c1", namespace="default")]
+    )
+    resp = prepare(req, timeout=10)
+    assert resp.claims[uid].error == ""
+    assert resp.claims[uid].devices[0].device_name == "tpu-0"
+    assert resp.claims[uid].devices[0].cdi_device_ids[0].startswith(
+        "k8s.tpu.google.com/claim="
+    )
+
+    # One bad claim must not fail the batch (per-claim error isolation).
+    req2 = drapb.NodePrepareResourcesRequest(
+        claims=[
+            drapb.Claim(uid="no-such", name="missing", namespace="default"),
+        ]
+    )
+    resp2 = prepare(req2, timeout=10)
+    assert resp2.claims["no-such"].error != ""
+
+    unprepare = channel.unary_unary(
+        "/v1beta1.DRAPlugin/NodeUnprepareResources",
+        request_serializer=drapb.NodeUnprepareResourcesRequest.SerializeToString,
+        response_deserializer=drapb.NodeUnprepareResourcesResponse.FromString,
+    )
+    uresp = unprepare(
+        drapb.NodeUnprepareResourcesRequest(
+            claims=[drapb.Claim(uid=uid, name="c1", namespace="default")]
+        ),
+        timeout=10,
+    )
+    assert uresp.claims[uid].error == ""
+    assert driver.state.checkpoints.get().prepared_claims == {}
+    channel.close()
+
+
+def test_grpc_registration_service(grpc_driver):
+    driver, _ = grpc_driver
+    channel = grpc.insecure_channel(
+        f"unix://{driver.config.kubelet_registrar_dir}/{DRIVER_NAME}-reg.sock"
+    )
+    get_info = channel.unary_unary(
+        "/pluginregistration.Registration/GetInfo",
+        request_serializer=regpb.InfoRequest.SerializeToString,
+        response_deserializer=regpb.PluginInfo.FromString,
+    )
+    info = get_info(regpb.InfoRequest(), timeout=10)
+    assert info.name == DRIVER_NAME
+    assert info.type == "DRAPlugin"
+    assert "v1beta1" in info.supported_versions
+    notify = channel.unary_unary(
+        "/pluginregistration.Registration/NotifyRegistrationStatus",
+        request_serializer=regpb.RegistrationStatus.SerializeToString,
+        response_deserializer=regpb.RegistrationStatusResponse.FromString,
+    )
+    notify(regpb.RegistrationStatus(plugin_registered=True), timeout=10)
+    assert driver.registration.registered.is_set()
+    channel.close()
+
+
+def test_metrics_rendered(tmp_path):
+    driver, _ = make_driver(tmp_path)
+    driver.publish_resources()
+    driver.metrics.inc("prepare_total")
+    driver.metrics.observe("prepare_seconds", 0.05)
+    text = driver.metrics.render()
+    assert "tpu_dra_prepare_total 1.0" in text
+    assert "tpu_dra_prepare_seconds_count 1" in text
+    assert "tpu_dra_published_resource_slices" in text
+
+
+def test_split_slices_declare_total_pool_count(tmp_path):
+    gates(PassthroughSupport=True)
+    driver, backend = make_driver(tmp_path)
+    driver.publish_resources()
+    slices = ResourceClient(backend, RESOURCE_SLICES).list()
+    assert len(slices) == 2  # tpu + vfio types
+    for s in slices:
+        assert s["spec"]["pool"]["resourceSliceCount"] == 2
+
+
+def test_partial_subslice_recovery_stays_unhealthy(tmp_path):
+    """A multi-chip sub-slice recovers only when ALL covered chips do."""
+    gates(DynamicSubslice=True, DeviceHealthCheck=True)
+    driver, backend = make_driver(tmp_path)
+    chips = driver.tpulib.chips()
+    for c in chips[:2]:  # (0,0,0) and (1,0,0) — both under tpu-ss-2x2
+        driver.tpulib.inject_health_event(
+            ChipHealthEvent(chip_uuid=c.uuid, healthy=False, reason="ici")
+        )
+        driver._on_health_change(
+            ChipHealthEvent(chip_uuid=c.uuid, healthy=False, reason="ici")
+        )
+    assert driver.state.allocatable["tpu-ss-2x2-0-0-0"].healthy is False
+    # One chip recovers: still unhealthy.
+    driver.tpulib.inject_health_event(
+        ChipHealthEvent(chip_uuid=chips[0].uuid, healthy=True)
+    )
+    driver._on_health_change(ChipHealthEvent(chip_uuid=chips[0].uuid, healthy=True))
+    assert driver.state.allocatable["tpu-ss-2x2-0-0-0"].healthy is False
+    assert driver.state.allocatable["tpu-0"].healthy is True
+    # Second chip recovers: healthy again.
+    driver.tpulib.inject_health_event(
+        ChipHealthEvent(chip_uuid=chips[1].uuid, healthy=True)
+    )
+    driver._on_health_change(ChipHealthEvent(chip_uuid=chips[1].uuid, healthy=True))
+    assert driver.state.allocatable["tpu-ss-2x2-0-0-0"].healthy is True
+
+
+def test_reenumeration_preserves_health_state(tmp_path):
+    """vfio unprepare re-enumeration must not resurrect unhealthy chips."""
+    gates(DeviceHealthCheck=True)
+    driver, backend = make_driver(tmp_path)
+    victim = driver.tpulib.chips()[1]
+    driver.tpulib.inject_health_event(
+        ChipHealthEvent(chip_uuid=victim.uuid, healthy=False, reason="hw")
+    )
+    driver.state.recompute_health()
+    assert driver.state.allocatable["tpu-1"].healthy is False
+    driver.state.allocatable = driver.state._enumerate_allocatable()
+    assert driver.state.allocatable["tpu-1"].healthy is False

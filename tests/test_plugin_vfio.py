@@ -1,0 +1,139 @@
+"""vfio-pci passthrough tests against a fabricated sysfs tree
+(vfio-device.go:176-298 analog behavior)."""
+
+import os
+
+import pytest
+
+from tpu_dra.infra import featuregates as fg
+from tpu_dra.k8sclient import FakeCluster
+from tpu_dra.plugin.cdi import CDIHandler
+from tpu_dra.plugin.checkpoint import CheckpointManager
+from tpu_dra.plugin.device_state import DRIVER_NAME, DeviceState, PrepareError
+from tpu_dra.plugin.vfio import VfioError, VfioPciManager
+from tpu_dra.tpulib.stub import StubTpuLib
+
+from tests.test_plugin_device_state import make_claim
+
+
+def fabricate_vfio_sysfs(root, addresses, host_driver="google-tpu"):
+    """sysfs with driver bind/unbind plumbing good enough for rebind flow."""
+    sys = root / "sys"
+    devs = sys / "bus" / "pci" / "devices"
+    drivers = sys / "bus" / "pci" / "drivers"
+    for drv in (host_driver, "vfio-pci"):
+        (drivers / drv).mkdir(parents=True, exist_ok=True)
+
+    class FakeBus:
+        """drivers_probe that honors driver_override like the kernel."""
+
+    for i, addr in enumerate(addresses):
+        d = devs / addr
+        d.mkdir(parents=True)
+        (d / "driver_override").write_text("")
+        grp = sys / "kernel" / "iommu_groups" / str(40 + i)
+        grp.mkdir(parents=True)
+        os.symlink(grp, d / "iommu_group")
+        os.symlink(drivers / host_driver, d / "driver")
+    return str(sys)
+
+
+class KernelishVfioManager(VfioPciManager):
+    """VfioPciManager with a write() that emulates the kernel's response to
+    unbind/drivers_probe writes on the fabricated tree."""
+
+    def _write(self, path, value):
+        if path.endswith("/driver/unbind"):
+            dev = os.path.join(self.sysfs_root, "bus", "pci", "devices", value)
+            os.remove(os.path.join(dev, "driver"))
+            return
+        if path.endswith("driver_override"):
+            with open(path, "w") as f:
+                f.write(value)
+            return
+        if path.endswith("drivers_probe"):
+            dev = os.path.join(self.sysfs_root, "bus", "pci", "devices", value)
+            with open(os.path.join(dev, "driver_override")) as f:
+                target = f.read().strip() or "google-tpu"
+            link = os.path.join(dev, "driver")
+            if os.path.islink(link):
+                os.remove(link)
+            os.symlink(
+                os.path.join(self.sysfs_root, "bus", "pci", "drivers", target), link
+            )
+            return
+        raise AssertionError(f"unexpected sysfs write: {path}")
+
+
+@pytest.fixture
+def vfio_env(tmp_path):
+    g = fg.FeatureGates()
+    g.set("PassthroughSupport", True)
+    fg.reset_for_tests(g)
+    lib = StubTpuLib(
+        config={"generation": "v5e", "hostname": "node-0"},
+        state_dir=str(tmp_path / "tpustate"),
+    )
+    addresses = [c.pci_bus_id for c in lib.chips()]
+    sysfs = fabricate_vfio_sysfs(tmp_path, addresses)
+    # drivers_probe file must exist for the manager to choose that path
+    open(os.path.join(sysfs, "bus", "pci", "drivers_probe"), "w").close()
+    mgr = KernelishVfioManager(sysfs_root=sysfs)
+    state = DeviceState(
+        tpulib=lib,
+        cdi=CDIHandler(cdi_root=str(tmp_path / "cdi")),
+        checkpoints=CheckpointManager(str(tmp_path / "ckpt")),
+        vfio_manager=mgr,
+        node_name="node-0",
+    )
+    return state, mgr
+
+
+def test_passthrough_devices_advertised(vfio_env):
+    state, _ = vfio_env
+    assert "tpu-0-passthrough" in state.allocatable
+    assert "tpu-0" in state.allocatable
+
+
+def test_vfio_prepare_rebinds_and_removes_siblings(vfio_env):
+    state, mgr = vfio_env
+    claim = make_claim(["tpu-0-passthrough"])
+    devices = state.prepare(claim)
+    assert devices[0].device_name == "tpu-0-passthrough"
+    chip = state.tpulib.chips()[0]
+    assert mgr.current_driver(chip.pci_bus_id) == "vfio-pci"
+    # The chip's sibling full-chip device left the inventory.
+    assert "tpu-0" not in state.allocatable
+    assert "tpu-1" in state.allocatable
+    # CDI edits expose /dev/vfio nodes.
+    spec = state.cdi.read_claim_spec(claim["metadata"]["uid"])
+    nodes = [n["path"] for n in spec["devices"][0]["containerEdits"]["deviceNodes"]]
+    assert "/dev/vfio/vfio" in nodes
+    assert any(n.startswith("/dev/vfio/4") for n in nodes)
+
+    # Unprepare restores the host driver and re-advertises siblings.
+    state.unprepare(claim["metadata"]["uid"])
+    assert mgr.current_driver(chip.pci_bus_id) == "google-tpu"
+    assert "tpu-0" in state.allocatable
+
+
+def test_vfio_rebind_is_idempotent(vfio_env):
+    state, mgr = vfio_env
+    chip = state.tpulib.chips()[1]
+    mgr.configure(chip)
+    mgr.configure(chip)  # second call noop
+    assert mgr.current_driver(chip.pci_bus_id) == "vfio-pci"
+    mgr.unconfigure(chip)
+    mgr.unconfigure(chip)  # noop
+    assert mgr.current_driver(chip.pci_bus_id) == "google-tpu"
+
+
+def test_vfio_requires_iommu_group(vfio_env, tmp_path):
+    state, mgr = vfio_env
+    chip = state.tpulib.chips()[2]
+    os.remove(
+        os.path.join(mgr.sysfs_root, "bus", "pci", "devices", chip.pci_bus_id,
+                     "iommu_group")
+    )
+    with pytest.raises(VfioError, match="IOMMU"):
+        mgr.configure(chip)

@@ -1,0 +1,110 @@
+"""Minimal Prometheus-text-format metrics registry.
+
+The reference deliberately ships **no** metrics endpoint (SURVEY.md §5 flags
+it as a gap); this is one of the TPU build's improvements. Counters,
+gauges, and summary-style cumulative timings are exposed as
+``text/plain; version=0.0.4`` on an HTTP endpoint each binary can enable.
+"""
+
+from __future__ import annotations
+
+import http.server
+import threading
+from typing import Dict, Optional, Tuple
+
+
+class Metrics:
+    def __init__(self, prefix: str = "tpu_dra"):
+        self.prefix = prefix
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+        self._gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+        self._timing_sum: Dict[str, float] = {}
+        self._timing_count: Dict[str, int] = {}
+
+    @staticmethod
+    def _key(name: str, labels: Optional[Dict[str, str]]):
+        return (name, tuple(sorted((labels or {}).items())))
+
+    def inc(self, name: str, value: float = 1.0, labels: Optional[Dict[str, str]] = None):
+        k = self._key(name, labels)
+        with self._lock:
+            self._counters[k] = self._counters.get(k, 0.0) + value
+
+    def set_gauge(self, name: str, value: float, labels: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self._gauges[self._key(name, labels)] = value
+
+    def observe(self, name: str, seconds: float):
+        with self._lock:
+            self._timing_sum[name] = self._timing_sum.get(name, 0.0) + seconds
+            self._timing_count[name] = self._timing_count.get(name, 0) + 1
+
+    def render(self) -> str:
+        out = []
+        with self._lock:
+            for (name, labels), v in sorted(self._counters.items()):
+                out.append(f"# TYPE {self.prefix}_{name} counter")
+                out.append(f"{self.prefix}_{name}{self._fmt(labels)} {v}")
+            for (name, labels), v in sorted(self._gauges.items()):
+                out.append(f"# TYPE {self.prefix}_{name} gauge")
+                out.append(f"{self.prefix}_{name}{self._fmt(labels)} {v}")
+            for name in sorted(self._timing_sum):
+                out.append(f"# TYPE {self.prefix}_{name} summary")
+                out.append(f"{self.prefix}_{name}_sum {self._timing_sum[name]}")
+                out.append(f"{self.prefix}_{name}_count {self._timing_count[name]}")
+        return "\n".join(out) + "\n"
+
+    @staticmethod
+    def _fmt(labels) -> str:
+        if not labels:
+            return ""
+        inner = ",".join(f'{k}="{v}"' for k, v in labels)
+        return "{" + inner + "}"
+
+
+class MetricsServer:
+    """Serves /metrics (and /healthz via a pluggable callback)."""
+
+    def __init__(self, metrics: Metrics, port: int = 0, healthz=None):
+        self.metrics = metrics
+        self.healthz = healthz or (lambda: (True, "ok"))
+        registry = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path == "/metrics":
+                    body = registry.metrics.render().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                elif self.path == "/healthz":
+                    ok, msg = registry.healthz()
+                    body = msg.encode()
+                    self.send_response(200 if ok else 503)
+                    self.send_header("Content-Type", "text/plain")
+                else:
+                    body = b"not found"
+                    self.send_response(404)
+                    self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="metrics-http"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -81,18 +81,21 @@ class Informer:
                     log.warning("watch ERROR event: %s", obj.get("message", obj))
                     break
                 self._apply(event, obj, dispatch=True)
-            if self._stopped.is_set():
-                return
-            self._stopped.wait(self.resync_backoff)
-            if self._stopped.is_set():
-                return
-            try:
-                self._watch = self.backend.watch(
-                    self.rd, self.namespace, self.label_selector
-                )
-                self._relist()
-            except Exception as e:
-                log.warning("informer resync failed (will retry): %s", e)
+            # Resync: re-establish watch, then relist. Both must succeed
+            # before consuming events again — a failed relist would leave
+            # stale deletions in the store, so retry the whole resync.
+            while not self._stopped.is_set():
+                self._stopped.wait(self.resync_backoff)
+                if self._stopped.is_set():
+                    return
+                try:
+                    self._watch = self.backend.watch(
+                        self.rd, self.namespace, self.label_selector
+                    )
+                    self._relist()
+                    break
+                except Exception as e:
+                    log.warning("informer resync failed (will retry): %s", e)
 
     def _relist(self) -> None:
         """Full re-list: upsert everything current, emit DELETED for objects

@@ -131,15 +131,41 @@ class KubeClient(Backend):
             c["cluster"] for c in cfg["clusters"] if c["name"] == ctx["cluster"]
         )
         user = next(u["user"] for u in cfg["users"] if u["name"] == ctx["user"])
+
+        # kind/GKE-style kubeconfigs embed credentials as base64 *-data keys;
+        # materialize those to files (requests needs paths).
+        def materialize(data_b64: str, suffix: str) -> str:
+            import base64
+            import tempfile
+
+            f = tempfile.NamedTemporaryFile(
+                prefix="tpu-dra-kubeconfig-", suffix=suffix, delete=False
+            )
+            f.write(base64.b64decode(data_b64))
+            f.close()
+            return f.name
+
         ca: "bool | str" = True
         if "certificate-authority" in cluster:
             ca = cluster["certificate-authority"]
+        elif "certificate-authority-data" in cluster:
+            ca = materialize(cluster["certificate-authority-data"], ".ca.crt")
         elif cluster.get("insecure-skip-tls-verify"):
             ca = False
+
         token = user.get("token")
+        if not token and user.get("tokenFile"):
+            with open(user["tokenFile"]) as tf:
+                token = tf.read().strip()
+
         cert = None
         if "client-certificate" in user and "client-key" in user:
             cert = (user["client-certificate"], user["client-key"])
+        elif "client-certificate-data" in user and "client-key-data" in user:
+            cert = (
+                materialize(user["client-certificate-data"], ".crt"),
+                materialize(user["client-key-data"], ".key"),
+            )
         return cls(
             server=cluster["server"],
             token=token,

@@ -1,0 +1,11 @@
+"""tpu-kubelet-plugin: the DRA node plugin for TPU chips.
+
+Reference analog: cmd/gpu-kubelet-plugin (driver name ``gpu.nvidia.com``;
+ours is ``tpu.google.com``). Enumerates chips via tpulib, publishes
+ResourceSlices (flat + KEP-4815 partitionable), prepares claims
+(time-slicing, multiplexing, dynamic sub-slice create/delete, vfio-pci
+rebind), generates per-claim transient CDI specs, and checkpoints state for
+crash-consistent recovery.
+"""
+
+DRIVER_NAME = "tpu.google.com"

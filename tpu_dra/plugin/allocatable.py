@@ -1,0 +1,178 @@
+"""Allocatable-device modeling.
+
+Reference analog: cmd/gpu-kubelet-plugin/allocatable.go (the
+``AllocatableDevice`` sum type {Gpu, MigStatic, MigDynamic, Vfio}, :39-63)
+plus deviceinfo.go's announced DRA attributes (:159-204).
+
+TPU mapping:
+
+- ``TPU``              — a full chip (the Gpu analog)
+- ``SUBSLICE_STATIC``  — a live, already-materialized sub-slice
+- ``SUBSLICE_DYNAMIC`` — an abstract placement, materialized on Prepare
+  (the DynamicMIG analog)
+- ``VFIO``             — the same chip advertised for vfio-pci passthrough
+  (sibling of its TPU device; sibling bookkeeping mirrors
+  allocatable.go:238-289)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tpu_dra.tpulib.interface import SubsliceInfo
+from tpu_dra.tpulib.types import ChipInfo, Placement
+
+TPU_DEVICE_TYPE = "tpu"
+SUBSLICE_STATIC_DEVICE_TYPE = "subslice-static"
+SUBSLICE_DYNAMIC_DEVICE_TYPE = "subslice-dynamic"
+VFIO_DEVICE_TYPE = "vfio"
+
+
+def tpu_device_name(chip: ChipInfo) -> str:
+    return f"tpu-{chip.index}"
+
+
+def vfio_device_name(chip: ChipInfo) -> str:
+    return f"tpu-{chip.index}-passthrough"
+
+
+def dynamic_subslice_device_name(placement: Placement) -> str:
+    """Canonical name algebra for abstract sub-slice devices
+    (mig.go:38-106 analog): ``tpu-ss-<shape>-<x>-<y>-<z>``."""
+    s = placement.start
+    return f"tpu-ss-{placement.shape}-{s.x}-{s.y}-{s.z}"
+
+
+def parse_dynamic_subslice_device_name(name: str) -> Placement:
+    from tpu_dra.tpulib.types import SubsliceShape, TopologyCoord
+
+    parts = name.split("-")
+    if len(parts) != 6 or parts[0] != "tpu" or parts[1] != "ss":
+        raise ValueError(f"not a dynamic sub-slice device name: {name!r}")
+    shape = SubsliceShape.parse(parts[2])
+    return Placement(
+        TopologyCoord(int(parts[3]), int(parts[4]), int(parts[5])), shape
+    )
+
+
+def static_subslice_device_name(ss: SubsliceInfo) -> str:
+    return f"tpu-live-{ss.canonical_name()}"
+
+
+@dataclass
+class AllocatableDevice:
+    """One entry in the allocatable inventory (allocatable.go:39-45)."""
+
+    name: str
+    type: str
+    chip: Optional[ChipInfo] = None  # TPU / VFIO
+    subslice: Optional[SubsliceInfo] = None  # SUBSLICE_STATIC
+    placement: Optional[Placement] = None  # SUBSLICE_DYNAMIC
+    healthy: bool = True
+
+    def is_subslice(self) -> bool:
+        return self.type in (SUBSLICE_STATIC_DEVICE_TYPE, SUBSLICE_DYNAMIC_DEVICE_TYPE)
+
+    def chip_coords(self) -> list:
+        """Host-mesh coordinates this device occupies (drives the KEP-4815
+        shared-counter consumption and overlap checks)."""
+        if self.chip is not None:
+            return [self.chip.coord]
+        if self.subslice is not None:
+            return self.subslice.placement.chips()
+        if self.placement is not None:
+            return self.placement.chips()
+        return []
+
+    def attributes(self) -> Dict[str, object]:
+        """DRA device attributes (deviceinfo.go Attributes analog)."""
+        attrs: Dict[str, object] = {"type": self.type}
+        chip = self.chip
+        if chip is not None:
+            gen = chip.generation
+            attrs.update(
+                {
+                    "uuid": chip.uuid,
+                    "productName": gen.product_name,
+                    "generation": gen.name,
+                    "coresPerChip": gen.cores_per_chip,
+                    "topologyCoord": str(chip.coord),
+                    "workerID": chip.worker_id,
+                    "pciBusID": chip.pci_bus_id,
+                    "pcieRoot": chip.pcie_root,
+                    "numaNode": chip.numa_node,
+                    "driverVersion": _driver_version(),
+                }
+            )
+            if chip.ici_domain is not None:
+                attrs["iciDomainID"] = chip.ici_domain.clique_id()
+        if self.subslice is not None:
+            ss = self.subslice
+            attrs.update(
+                {
+                    "uuid": ss.uuid,
+                    "productName": ss.generation.product_name,
+                    "generation": ss.generation.name,
+                    "subsliceShape": str(ss.placement.shape),
+                    "subsliceOrigin": str(ss.placement.start),
+                }
+            )
+        if self.placement is not None:
+            attrs.update(
+                {
+                    "subsliceShape": str(self.placement.shape),
+                    "subsliceOrigin": str(self.placement.start),
+                }
+            )
+        return attrs
+
+    def capacity(self) -> Dict[str, int]:
+        """DRA device capacity map (hbm is the memory-capacity analog)."""
+        if self.chip is not None:
+            return {"hbm": self.chip.hbm_bytes}
+        if self.subslice is not None:
+            return {"hbm": self.subslice.hbm_bytes}
+        if self.placement is not None:
+            return {"hbm": 0}  # filled by the caller with generation data
+        return {}
+
+
+def _driver_version() -> str:
+    from tpu_dra.version import version_string
+
+    return version_string()
+
+
+class AllocatableDevices(dict):
+    """name -> AllocatableDevice with sibling bookkeeping."""
+
+    def uuids(self) -> List[str]:
+        return [d.chip.uuid for d in self.values() if d.chip is not None]
+
+    def tpu_uuids(self) -> List[str]:
+        return [
+            d.chip.uuid
+            for d in self.values()
+            if d.type == TPU_DEVICE_TYPE and d.chip is not None
+        ]
+
+    def siblings_of(self, device: "AllocatableDevice") -> List[str]:
+        """Devices sharing any chip coordinate with ``device`` (the
+        passthrough sibling set, allocatable.go:238-289)."""
+        coords = set(device.chip_coords())
+        out = []
+        for name, other in self.items():
+            if name == device.name:
+                continue
+            if coords & set(other.chip_coords()):
+                out.append(name)
+        return out
+
+    def remove_sibling_devices(self, device: "AllocatableDevice") -> List[str]:
+        """Drop all siblings from the inventory (done when a passthrough
+        device is prepared: the chip is gone from the host's view)."""
+        removed = self.siblings_of(device)
+        for name in removed:
+            del self[name]
+        return removed

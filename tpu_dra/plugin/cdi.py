@@ -1,0 +1,124 @@
+"""Per-claim transient CDI spec generation.
+
+Reference analog: cmd/gpu-kubelet-plugin/cdi.go — one transient spec per
+claim (vendor ``k8s.tpu.google.com``, class ``claim``, :43-48) written to
+/var/run/cdi (:194-306); the kubelet passes the resulting CDI device IDs
+back to the runtime via PrepareResult.Devices.
+
+TPU content differences: instead of /dev/nvidia* + nvidia-cdi-hook, a
+claim's container edits inject the chip /dev/accel* (or /dev/vfio/*) nodes
+plus the libtpu bootstrap env (TPU_VISIBLE_DEVICES and friends) and any
+sharing-daemon sockets.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Dict, List, Optional
+
+from tpu_dra.plugin.allocatable import AllocatableDevice, VFIO_DEVICE_TYPE
+from tpu_dra.plugin.prepared import PreparedDevices
+
+log = logging.getLogger(__name__)
+
+CDI_VERSION = "0.6.0"
+CDI_VENDOR = "k8s.tpu.google.com"
+CDI_CLASS = "claim"
+CDI_KIND = f"{CDI_VENDOR}/{CDI_CLASS}"
+
+
+class CDIHandler:
+    def __init__(self, cdi_root: str = "/var/run/cdi", driver_version: str = ""):
+        self.cdi_root = cdi_root
+        os.makedirs(cdi_root, exist_ok=True)
+        if not driver_version:
+            from tpu_dra.version import version_string
+
+            driver_version = version_string()
+        self.driver_version = driver_version
+
+    # --- naming conventions (cdi.go GetClaimDeviceName) ---
+
+    def claim_device_name(self, claim_uid: str, device_name: str) -> str:
+        return f"{claim_uid}-{device_name}"
+
+    def qualified_device_id(self, claim_uid: str, device_name: str) -> str:
+        return f"{CDI_KIND}={self.claim_device_name(claim_uid, device_name)}"
+
+    def spec_path(self, claim_uid: str) -> str:
+        return os.path.join(self.cdi_root, f"{CDI_VENDOR}-claim_{claim_uid}.json")
+
+    # --- spec generation ---
+
+    def create_claim_spec_file(
+        self,
+        claim_uid: str,
+        prepared: PreparedDevices,
+    ) -> str:
+        """Write the per-claim transient spec (cdi.go CreateClaimSpecFile).
+
+        Each prepared device becomes one CDI device whose edits carry its
+        device nodes + merged env (device runtime env, then group-level
+        sharing edits which may override)."""
+        devices = []
+        for group in prepared:
+            group_env = dict(group.config_state.container_edits.get("env", {}))
+            group_mounts = list(group.config_state.container_edits.get("mounts", []))
+            for pd in group.devices:
+                env = dict(pd.runtime_env)
+                env.update(group_env)
+                edits: Dict[str, object] = {}
+                if pd.dev_paths:
+                    edits["deviceNodes"] = [{"path": p} for p in pd.dev_paths]
+                if env:
+                    edits["env"] = [f"{k}={v}" for k, v in sorted(env.items())]
+                if group_mounts:
+                    edits["mounts"] = group_mounts
+                devices.append(
+                    {
+                        "name": self.claim_device_name(
+                            claim_uid, pd.device.device_name
+                        ),
+                        "containerEdits": edits,
+                    }
+                )
+        spec = {
+            "cdiVersion": CDI_VERSION,
+            "kind": CDI_KIND,
+            "containerEdits": {
+                "env": [f"TPU_DRA_DRIVER_VERSION={self.driver_version}"]
+            },
+            "devices": devices,
+        }
+        path = self.spec_path(claim_uid)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(spec, f, indent=2, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        log.debug("wrote CDI spec %s (%d devices)", path, len(devices))
+        return path
+
+    def delete_claim_spec_file(self, claim_uid: str) -> None:
+        try:
+            os.remove(self.spec_path(claim_uid))
+        except FileNotFoundError:
+            pass
+
+    def read_claim_spec(self, claim_uid: str) -> Optional[dict]:
+        try:
+            with open(self.spec_path(claim_uid)) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+
+    def list_claim_uids(self) -> List[str]:
+        prefix = f"{CDI_VENDOR}-claim_"
+        out = []
+        for name in os.listdir(self.cdi_root):
+            if name.startswith(prefix) and name.endswith(".json"):
+                out.append(name[len(prefix):-len(".json")])
+        return out

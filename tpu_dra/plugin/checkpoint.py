@@ -1,0 +1,189 @@
+"""Versioned, checksummed node-local checkpoint.
+
+Reference analog: cmd/gpu-kubelet-plugin/{checkpoint.go, checkpointv.go} via
+the k8s checkpointmanager. Design preserved exactly:
+
+- the file carries **both** V1 and V2 renderings so a downgraded driver can
+  still read its older schema (checkpoint.go MarshalCheckpoint: "cp.V1 =
+  cp.V2.ToV1()");
+- V1's checksum lives at the top level, V2 embeds its own
+  (checkpoint.go:26-35 note);
+- checksums are CRC-32 over the JSON with the checksum field zeroed;
+- ``to_latest_version`` upgrades V1-only files by assuming PrepareCompleted
+  (checkpointv.go ToV2: V1 predates the WAL states);
+- reads/writes happen under a dedicated flock so concurrent plugin
+  processes (upgrade window) never interleave read-modify-write cycles
+  (device_state.go:549-582).
+
+The checkpoint is the node-local source of truth for: idempotent Prepare,
+double-allocation defense, sub-slice orphan GC.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+from tpu_dra.infra.flock import Flock
+from tpu_dra.plugin.prepared import PreparedDevices
+
+CLAIM_STATE_UNSET = ""
+CLAIM_STATE_PREPARE_STARTED = "PrepareStarted"
+CLAIM_STATE_PREPARE_COMPLETED = "PrepareCompleted"
+
+
+class ChecksumError(RuntimeError):
+    pass
+
+
+def _crc(data: bytes) -> int:
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def _canonical(obj: dict) -> bytes:
+    return json.dumps(obj, sort_keys=True, separators=(",", ":")).encode()
+
+
+@dataclass
+class PreparedClaim:
+    """PreparedClaimV2 (checkpointv.go:47-55)."""
+
+    checkpoint_state: str = CLAIM_STATE_UNSET
+    status: dict = field(default_factory=dict)  # ResourceClaimStatus JSON
+    prepared_devices: PreparedDevices = field(default_factory=PreparedDevices)
+    name: str = ""
+    namespace: str = ""
+
+    def to_dict(self) -> dict:
+        d: dict = {"checkpointState": self.checkpoint_state}
+        if self.status:
+            d["status"] = self.status
+        if self.prepared_devices:
+            d["preparedDevices"] = self.prepared_devices.to_list()
+        if self.name:
+            d["name"] = self.name
+        if self.namespace:
+            d["namespace"] = self.namespace
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PreparedClaim":
+        return cls(
+            checkpoint_state=d.get("checkpointState", CLAIM_STATE_UNSET),
+            status=d.get("status", {}),
+            prepared_devices=PreparedDevices.from_list(d.get("preparedDevices")),
+            name=d.get("name", ""),
+            namespace=d.get("namespace", ""),
+        )
+
+
+@dataclass
+class Checkpoint:
+    prepared_claims: Dict[str, PreparedClaim] = field(default_factory=dict)
+
+    # --- serialization: both V1 and V2 renderings, each checksummed ---
+
+    def _v2_dict(self) -> dict:
+        return {
+            "checksum": 0,
+            "preparedClaims": {
+                uid: c.to_dict() for uid, c in sorted(self.prepared_claims.items())
+            },
+        }
+
+    def _v1_dict(self) -> dict:
+        # V1 predates checkpointState: it only records completed claims
+        # (checkpointv.go ToV1 drops in-flight detail).
+        claims = {}
+        for uid, c in sorted(self.prepared_claims.items()):
+            if c.checkpoint_state != CLAIM_STATE_PREPARE_COMPLETED:
+                continue
+            claims[uid] = {
+                "status": c.status,
+                "preparedDevices": c.prepared_devices.to_list(),
+            }
+        return {"preparedClaims": claims}
+
+    def marshal(self) -> bytes:
+        v2 = self._v2_dict()
+        v2["checksum"] = _crc(_canonical(v2))
+        top = {"checksum": 0, "v1": self._v1_dict(), "v2": v2}
+        v1_view = {"checksum": 0, "v1": top["v1"]}
+        top["checksum"] = _crc(_canonical(v1_view))
+        return json.dumps(top, sort_keys=True).encode()
+
+    @classmethod
+    def unmarshal(cls, data: bytes) -> "Checkpoint":
+        try:
+            top = json.loads(data)
+        except json.JSONDecodeError as e:
+            raise ChecksumError(f"corrupt checkpoint JSON: {e}") from e
+        v2 = top.get("v2")
+        if v2 is not None:
+            want = v2.get("checksum", 0)
+            probe = dict(v2)
+            probe["checksum"] = 0
+            if _crc(_canonical(probe)) != want:
+                raise ChecksumError("checkpoint v2 checksum mismatch")
+            claims = {
+                uid: PreparedClaim.from_dict(c)
+                for uid, c in (v2.get("preparedClaims") or {}).items()
+            }
+            return cls(prepared_claims=claims)
+        v1 = top.get("v1")
+        if v1 is not None:
+            want = top.get("checksum", 0)
+            v1_view = {"checksum": 0, "v1": v1}
+            if _crc(_canonical(v1_view)) != want:
+                raise ChecksumError("checkpoint v1 checksum mismatch")
+            claims = {}
+            for uid, c in (v1.get("preparedClaims") or {}).items():
+                claims[uid] = PreparedClaim(
+                    checkpoint_state=CLAIM_STATE_PREPARE_COMPLETED,
+                    status=c.get("status", {}),
+                    prepared_devices=PreparedDevices.from_list(
+                        c.get("preparedDevices")
+                    ),
+                )
+            return cls(prepared_claims=claims)
+        return cls()
+
+
+class CheckpointManager:
+    """File-backed checkpoint with flocked read-modify-write.
+
+    Reference analog: k8s checkpointmanager usage + the dedicated cplock
+    (device_state.go:141-177 create-if-missing, :549-582 update under lock).
+    """
+
+    def __init__(self, directory: str, name: str = "checkpoint.json"):
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, name)
+        self._flock = Flock(self.path + ".lock")
+        if not os.path.exists(self.path):
+            self._write(Checkpoint())
+
+    def _write(self, cp: Checkpoint) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(cp.marshal())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def get(self) -> Checkpoint:
+        with self._flock.held():
+            with open(self.path, "rb") as f:
+                return Checkpoint.unmarshal(f.read())
+
+    def update(self, mutate: Callable[[Checkpoint], None]) -> Checkpoint:
+        """Atomic read-modify-write under the checkpoint flock."""
+        with self._flock.held():
+            with open(self.path, "rb") as f:
+                cp = Checkpoint.unmarshal(f.read())
+            mutate(cp)
+            self._write(cp)
+            return cp

@@ -1,0 +1,99 @@
+"""Periodic stale-claim garbage collection.
+
+Reference analog: cmd/gpu-kubelet-plugin/cleanup.go — every 10 minutes
+(:34-36), claims recorded in the checkpoint whose ResourceClaim no longer
+exists in the API server (or exists with a different UID — delete+recreate
+under the same name) are unprepared (:110-189). This is the safety net for
+claims the kubelet never told us to unprepare (force-deleted pods, kubelet
+state loss).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from tpu_dra.k8sclient import RESOURCE_CLAIMS, ApiNotFound, ResourceClient
+from tpu_dra.plugin.checkpoint import CheckpointManager
+from tpu_dra.plugin.device_state import DeviceState
+
+log = logging.getLogger(__name__)
+
+DEFAULT_INTERVAL = 600.0
+
+
+class CheckpointCleanupManager:
+    def __init__(
+        self,
+        state: DeviceState,
+        backend,
+        interval: float = DEFAULT_INTERVAL,
+        pu_flock=None,
+    ):
+        self.state = state
+        self.claims = ResourceClient(backend, RESOURCE_CLAIMS)
+        self.interval = interval
+        # The node-global prepare/unprepare flock: GC must serialize with
+        # concurrent Prepare/Unprepare across plugin *processes* too
+        # (upgrade window), exactly like the RPC paths.
+        self.pu_flock = pu_flock
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="checkpoint-cleanup"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.cleanup_once()
+            except Exception:
+                log.exception("checkpoint cleanup pass failed")
+
+    def cleanup_once(self) -> int:
+        """One GC pass; returns the number of unprepared stale claims."""
+        cp = self.state.checkpoints.get()
+        cleaned = 0
+        for uid, claim in list(cp.prepared_claims.items()):
+            if self._is_stale(uid, claim):
+                log.info(
+                    "unpreparing stale claim %s/%s (%s)",
+                    claim.namespace,
+                    claim.name,
+                    uid,
+                )
+                try:
+                    if self.pu_flock is not None:
+                        release = self.pu_flock.acquire(timeout=60)
+                        try:
+                            self.state.unprepare(uid)
+                        finally:
+                            release()
+                    else:
+                        self.state.unprepare(uid)
+                    cleaned += 1
+                except Exception as e:
+                    log.warning("stale-claim unprepare failed for %s: %s", uid, e)
+        return cleaned
+
+    def _is_stale(self, uid: str, claim) -> bool:
+        """Stale = the API server no longer knows this (name, namespace, uid)
+        (cleanup.go unprepareIfStale :149-189)."""
+        if not claim.name or not claim.namespace:
+            # Pre-upgrade checkpoint without name/namespace: cannot verify,
+            # leave alone (reference behavior for V1-era records).
+            return False
+        try:
+            live = self.claims.get(claim.name, claim.namespace)
+        except ApiNotFound:
+            return True
+        return live["metadata"]["uid"] != uid

@@ -1,0 +1,83 @@
+"""Chip health monitoring.
+
+Reference analog: cmd/gpu-kubelet-plugin/device_health.go — the NVML XID
+event loop (:146-204) marking devices unhealthy and feeding the driver's
+republish path (driver.go:441-505). The TPU source is tpulib's health-event
+queue (sysfs/runtime-driven on the linux backend; injectable on the stub).
+
+Like the reference, there is no auto-remediation: an unhealthy chip is
+dropped from the published ResourceSlice until the event stream marks it
+healthy again. Events whose reason is in the benign skip-list are ignored
+(the XID skip-list analog, device_health.go:306-351).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Callable, List, Optional
+
+from tpu_dra.tpulib.interface import TpuLib
+from tpu_dra.tpulib.types import ChipHealthEvent
+
+log = logging.getLogger(__name__)
+
+# Benign event reasons that must not mark a chip unhealthy.
+BENIGN_REASONS = frozenset(
+    {
+        "preemption",  # workload preempted, chip fine
+        "clock-throttle",  # thermal/power capping
+        "application-error",  # user program crash, not a chip fault
+    }
+)
+
+
+class DeviceHealthMonitor:
+    def __init__(
+        self,
+        tpulib: TpuLib,
+        on_change: Callable[[ChipHealthEvent], None],
+        poll_timeout: float = 5.0,
+    ):
+        self.tpulib = tpulib
+        self.on_change = on_change
+        self.poll_timeout = poll_timeout
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="device-health-monitor"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.poll_timeout + 1)
+
+    def _run(self) -> None:
+        q = self.tpulib.health_events()
+        while not self._stop.is_set():
+            try:
+                ev = q.get(timeout=self.poll_timeout)
+            except queue.Empty:
+                continue
+            if not ev.healthy and ev.reason in BENIGN_REASONS:
+                log.info(
+                    "ignoring benign health event for %s: %s",
+                    ev.chip_uuid,
+                    ev.reason,
+                )
+                continue
+            log.warning(
+                "chip %s -> %s (%s)",
+                ev.chip_uuid,
+                "healthy" if ev.healthy else "UNHEALTHY",
+                ev.reason or "no reason",
+            )
+            try:
+                self.on_change(ev)
+            except Exception:
+                log.exception("health-change callback failed")

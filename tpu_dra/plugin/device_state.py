@@ -1,0 +1,605 @@
+"""Claim prepare/unprepare state machine with WAL-style checkpointing.
+
+Reference analog: cmd/gpu-kubelet-plugin/device_state.go. The crash-
+consistency design is ported whole (device_state.go:287-336 lays out the
+strategy): every Prepare writes a ``PrepareStarted`` intent record first,
+materializes devices, then flips to ``PrepareCompleted``; a retry that finds
+a stale ``PrepareStarted`` rolls back partial sub-slice creation before
+starting over (:223-228, :482-516); Prepare is idempotent on
+``PrepareCompleted`` (:200-207); overlapping prepared devices are rejected
+(:1118-1154); startup obliterates unknown sub-slices (:337-373).
+
+Claims are the JSON dicts the kubelet hands over (resource.k8s.io/v1beta1
+ResourceClaim).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from tpu_dra import api as configapi
+from tpu_dra.api.errors import ApiError
+from tpu_dra.infra import featuregates as fg
+from tpu_dra.plugin.allocatable import (
+    AllocatableDevice,
+    AllocatableDevices,
+    SUBSLICE_DYNAMIC_DEVICE_TYPE,
+    SUBSLICE_STATIC_DEVICE_TYPE,
+    TPU_DEVICE_TYPE,
+    VFIO_DEVICE_TYPE,
+    static_subslice_device_name,
+    tpu_device_name,
+    vfio_device_name,
+)
+from tpu_dra.plugin.cdi import CDIHandler
+from tpu_dra.plugin.checkpoint import (
+    CLAIM_STATE_PREPARE_COMPLETED,
+    CLAIM_STATE_PREPARE_STARTED,
+    Checkpoint,
+    CheckpointManager,
+    PreparedClaim,
+)
+from tpu_dra.plugin.prepared import (
+    DeviceConfigState,
+    KubeletDevice,
+    PreparedDevice,
+    PreparedDeviceGroup,
+    PreparedDevices,
+)
+from tpu_dra.plugin.sharing import MultiplexManager, TimeSlicingManager
+from tpu_dra.plugin.subslice import enumerate_dynamic_subslice_devices
+from tpu_dra.plugin.vfio import VfioPciManager
+from tpu_dra.tpulib.interface import TpuLib, TpuLibError
+
+log = logging.getLogger(__name__)
+
+DRIVER_NAME = "tpu.google.com"
+
+
+class PrepareError(RuntimeError):
+    """Retryable prepare failure."""
+
+
+class PermanentError(PrepareError):
+    """Non-retryable failure (bad user config); the kubelet should not
+    retry this claim (cd-plugin driver.go:55-59 classification analog)."""
+
+
+def claim_to_string(claim: dict) -> str:
+    md = claim.get("metadata", {})
+    return f"{md.get('namespace')}/{md.get('name')}:{md.get('uid', '')[:8]}"
+
+
+class DeviceState:
+    def __init__(
+        self,
+        tpulib: TpuLib,
+        cdi: CDIHandler,
+        checkpoints: CheckpointManager,
+        multiplex_manager: Optional[MultiplexManager] = None,
+        vfio_manager: Optional[VfioPciManager] = None,
+        node_name: str = "",
+        pool_name: str = "",
+    ):
+        self.tpulib = tpulib
+        self.cdi = cdi
+        self.checkpoints = checkpoints
+        self.ts_manager = TimeSlicingManager(tpulib)
+        self.multiplex_manager = multiplex_manager
+        self.vfio_manager = vfio_manager
+        self.node_name = node_name
+        self.pool_name = pool_name or node_name
+        self._lock = threading.Lock()
+        self.allocatable = self._enumerate_allocatable()
+
+    # --- inventory (enumerateAllPossibleDevices analog, nvlib.go:170-198) ---
+
+    def _enumerate_allocatable(self) -> AllocatableDevices:
+        devices = AllocatableDevices()
+        for chip in self.tpulib.chips():
+            dev = AllocatableDevice(
+                name=tpu_device_name(chip), type=TPU_DEVICE_TYPE, chip=chip
+            )
+            devices[dev.name] = dev
+            if fg.enabled(fg.PASSTHROUGH_SUPPORT) and chip.vfio_capable:
+                vdev = AllocatableDevice(
+                    name=vfio_device_name(chip), type=VFIO_DEVICE_TYPE, chip=chip
+                )
+                devices[vdev.name] = vdev
+        if fg.enabled(fg.DYNAMIC_SUBSLICE):
+            for dev in enumerate_dynamic_subslice_devices(self.tpulib):
+                devices[dev.name] = dev
+        else:
+            for ss in self.tpulib.list_subslices():
+                dev = AllocatableDevice(
+                    name=static_subslice_device_name(ss),
+                    type=SUBSLICE_STATIC_DEVICE_TYPE,
+                    subslice=ss,
+                )
+                devices[dev.name] = dev
+        self._apply_chip_health(devices)
+        return devices
+
+    def _apply_chip_health(self, devices: AllocatableDevices) -> None:
+        """Device health derives from chip health: a device is healthy iff
+        every chip coordinate it covers is healthy. Re-enumeration therefore
+        never resets accumulated health state (it lives in tpulib)."""
+        healthy_by_coord = {c.coord: c.healthy for c in self.tpulib.chips()}
+        for dev in devices.values():
+            dev.healthy = all(
+                healthy_by_coord.get(coord, False) for coord in dev.chip_coords()
+            )
+
+    def recompute_health(self) -> bool:
+        """Refresh device health from chip health; True when anything
+        changed (drives ResourceSlice republish)."""
+        before = {name: d.healthy for name, d in self.allocatable.items()}
+        self._apply_chip_health(self.allocatable)
+        return any(
+            d.healthy != before.get(name)
+            for name, d in self.allocatable.items()
+        )
+
+    # --- startup obliteration (device_state.go:337-373) ---
+
+    def destroy_unknown_subslices(self) -> List[str]:
+        """Tear down live sub-slices not referenced by any PrepareCompleted
+        claim. Called once at startup before serving the kubelet."""
+        if not fg.enabled(fg.DYNAMIC_SUBSLICE):
+            return []
+        cp = self.checkpoints.get()
+        known = set()
+        for claim in cp.prepared_claims.values():
+            if claim.checkpoint_state != CLAIM_STATE_PREPARE_COMPLETED:
+                continue
+            for pd in claim.prepared_devices.of_type(SUBSLICE_DYNAMIC_DEVICE_TYPE):
+                known.add(pd.subslice_uuid)
+        destroyed = []
+        for ss in self.tpulib.list_subslices():
+            if ss.uuid in known:
+                continue
+            log.warning("destroying unknown sub-slice %s (%s)", ss.uuid, ss.placement)
+            try:
+                self.tpulib.delete_subslice(ss.uuid)
+                destroyed.append(ss.uuid)
+            except TpuLibError as e:
+                log.error("failed to destroy unknown sub-slice %s: %s", ss.uuid, e)
+        return destroyed
+
+    # --- Prepare (device_state.go:180-285) ---
+
+    def prepare(self, claim: dict) -> List[KubeletDevice]:
+        t0 = time.monotonic()
+        with self._lock:
+            return self._prepare_locked(claim, t0)
+
+    def _prepare_locked(self, claim: dict, t0: float) -> List[KubeletDevice]:
+        claim_uid = claim["metadata"]["uid"]
+        cp = self.checkpoints.get()
+        log.debug("t_prep_get_checkpoint %.3f s", time.monotonic() - t0)
+
+        # Idempotency: PrepareCompleted short-circuits before we would
+        # overwrite it with PrepareStarted (device_state.go:196-207).
+        prev = cp.prepared_claims.get(claim_uid)
+        if prev is not None and prev.checkpoint_state == CLAIM_STATE_PREPARE_COMPLETED:
+            log.info(
+                "skip prepare: claim already PrepareCompleted: %s",
+                claim_to_string(claim),
+            )
+            return prev.prepared_devices.get_devices()
+
+        # Double-allocation defense (device_state.go:211-216, :1118-1154).
+        self._validate_no_overlapping_prepared_devices(cp, claim)
+
+        # Roll back a stale partial prepare before retrying (:223-228).
+        if prev is not None and prev.checkpoint_state == CLAIM_STATE_PREPARE_STARTED:
+            log.info(
+                "claim %s in PrepareStarted: rolling back partial prepare",
+                claim_to_string(claim),
+            )
+            self._unprepare_partially_prepared_claim(claim_uid, prev)
+
+        # WAL intent record (:230-243).
+        def mark_started(c: Checkpoint) -> None:
+            c.prepared_claims[claim_uid] = PreparedClaim(
+                checkpoint_state=CLAIM_STATE_PREPARE_STARTED,
+                status=claim.get("status", {}),
+                name=claim["metadata"].get("name", ""),
+                namespace=claim["metadata"].get("namespace", ""),
+            )
+
+        self.checkpoints.update(mark_started)
+
+        tp = time.monotonic()
+        try:
+            prepared = self._prepare_devices(claim)
+        except Exception:
+            # The PrepareStarted record stays; the kubelet retry path rolls
+            # back whatever was partially created.
+            raise
+        log.debug(
+            "t_prep_core %.3f s (claim %s)", time.monotonic() - tp, claim_to_string(claim)
+        )
+
+        # Passthrough: the chip leaves the host inventory; drop its siblings
+        # (device_state.go:252-262).
+        if fg.enabled(fg.PASSTHROUGH_SUPPORT):
+            for pd in prepared.of_type(VFIO_DEVICE_TYPE):
+                adev = self.allocatable.get(pd.device.device_name)
+                if adev is None:
+                    log.warning(
+                        "allocatable not found for device: %s", pd.device.device_name
+                    )
+                    continue
+                self.allocatable.remove_sibling_devices(adev)
+
+        self.cdi.create_claim_spec_file(claim_uid, prepared)
+
+        def mark_completed(c: Checkpoint) -> None:
+            c.prepared_claims[claim_uid] = PreparedClaim(
+                checkpoint_state=CLAIM_STATE_PREPARE_COMPLETED,
+                status=claim.get("status", {}),
+                prepared_devices=prepared,
+                name=claim["metadata"].get("name", ""),
+                namespace=claim["metadata"].get("namespace", ""),
+            )
+
+        self.checkpoints.update(mark_completed)
+        log.debug("t_prep_total %.3f s", time.monotonic() - t0)
+        return prepared.get_devices()
+
+    # --- Unprepare (device_state.go:375-441) ---
+
+    def unprepare(self, claim_uid: str) -> None:
+        with self._lock:
+            cp = self.checkpoints.get()
+            claim = cp.prepared_claims.get(claim_uid)
+            if claim is None:
+                log.info("unprepare noop: no checkpointed claim %s", claim_uid)
+                return
+            if claim.checkpoint_state == CLAIM_STATE_PREPARE_STARTED:
+                self._unprepare_partially_prepared_claim(claim_uid, claim)
+            else:
+                self._unprepare_devices(claim_uid, claim.prepared_devices)
+            self.cdi.delete_claim_spec_file(claim_uid)
+            self.checkpoints.update(
+                lambda c: c.prepared_claims.pop(claim_uid, None)
+            )
+
+    def _unprepare_partially_prepared_claim(
+        self, claim_uid: str, claim: PreparedClaim
+    ) -> None:
+        """Rollback of a partial prepare (device_state.go:482-516): any live
+        sub-slice whose parent claim never completed is orphaned state."""
+        if claim.prepared_devices:
+            self._unprepare_devices(claim_uid, claim.prepared_devices)
+            return
+        # No device detail was persisted (crash mid-_prepare_devices): find
+        # orphans among live sub-slices not referenced by any completed claim.
+        if fg.enabled(fg.DYNAMIC_SUBSLICE):
+            cp = self.checkpoints.get()
+            known = set()
+            for uid, c in cp.prepared_claims.items():
+                if uid == claim_uid:
+                    continue
+                for pd in c.prepared_devices.of_type(SUBSLICE_DYNAMIC_DEVICE_TYPE):
+                    known.add(pd.subslice_uuid)
+            for ss in self.tpulib.list_subslices():
+                if ss.uuid not in known:
+                    log.info(
+                        "rollback: deleting orphaned sub-slice %s for claim %s",
+                        ss.uuid,
+                        claim_uid,
+                    )
+                    self.tpulib.delete_subslice(ss.uuid)
+        self.checkpoints.update(lambda c: c.prepared_claims.pop(claim_uid, None))
+
+    def _unprepare_devices(self, claim_uid: str, devices: PreparedDevices) -> None:
+        # vfio first (device_state.go:794-886 ordering): restore host driver,
+        # re-advertise siblings.
+        for pd in devices.of_type(VFIO_DEVICE_TYPE):
+            if self.vfio_manager is not None:
+                chip = self.tpulib.chip_by_uuid(pd.chip_uuid)
+                if chip is not None:
+                    self.vfio_manager.unconfigure(chip)
+        if fg.enabled(fg.PASSTHROUGH_SUPPORT) and devices.of_type(VFIO_DEVICE_TYPE):
+            self.allocatable = self._enumerate_allocatable()
+        # Dynamic sub-slices torn down.
+        for pd in devices.of_type(SUBSLICE_DYNAMIC_DEVICE_TYPE):
+            if pd.subslice_uuid:
+                try:
+                    self.tpulib.delete_subslice(pd.subslice_uuid)
+                except TpuLibError as e:
+                    log.warning(
+                        "delete sub-slice %s failed (continuing): %s",
+                        pd.subslice_uuid,
+                        e,
+                    )
+        # Sharing teardown: stop multiplex daemons, reset time-slice.
+        for group in devices:
+            cs = group.config_state
+            if cs.multiplex_daemon_id and self.multiplex_manager is not None:
+                self.multiplex_manager.daemon_by_id(cs.multiplex_daemon_id).stop()
+            if cs.time_slice_ordinal is not None:
+                uuids = [d.chip_uuid for d in group.devices if d.chip_uuid]
+                if uuids:
+                    try:
+                        self.tpulib.set_time_slice(uuids, 0)
+                    except TpuLibError as e:
+                        log.warning("time-slice reset failed: %s", e)
+
+    # --- overlap validation (device_state.go:1118-1154) ---
+
+    def _validate_no_overlapping_prepared_devices(
+        self, cp: Checkpoint, claim: dict
+    ) -> None:
+        requested = self._allocation_results(claim)
+        requested_names = {r["device"] for r in requested}
+        requested_coords = set()
+        for name in requested_names:
+            adev = self.allocatable.get(name)
+            if adev is not None:
+                requested_coords.update(adev.chip_coords())
+        claim_uid = claim["metadata"]["uid"]
+        for uid, prev in cp.prepared_claims.items():
+            if uid == claim_uid:
+                continue
+            for pd in [d for g in prev.prepared_devices for d in g.devices]:
+                if self._claim_had_admin_access(prev):
+                    continue
+                if pd.device.device_name in requested_names:
+                    raise PrepareError(
+                        f"device {pd.device.device_name} already prepared for "
+                        f"claim {uid}"
+                    )
+                # TPU extra: coordinate-level overlap (a sub-slice and a chip
+                # are distinct names but the same silicon).
+                adev = self.allocatable.get(pd.device.device_name)
+                if adev is not None and requested_coords & set(adev.chip_coords()):
+                    raise PrepareError(
+                        f"device {pd.device.device_name} (claim {uid}) overlaps "
+                        f"requested chip coordinates"
+                    )
+
+    @staticmethod
+    def _claim_had_admin_access(prev: PreparedClaim) -> bool:
+        results = (
+            prev.status.get("allocation", {}).get("devices", {}).get("results", [])
+        )
+        return any(r.get("adminAccess") for r in results)
+
+    # --- device preparation core (device_state.go:595-792) ---
+
+    @staticmethod
+    def _allocation_results(claim: dict) -> List[dict]:
+        alloc = claim.get("status", {}).get("allocation")
+        if alloc is None:
+            raise PrepareError("claim not yet allocated")
+        return [
+            r
+            for r in alloc.get("devices", {}).get("results", [])
+            if r.get("driver") == DRIVER_NAME
+        ]
+
+    def _prepare_devices(self, claim: dict) -> PreparedDevices:
+        results = self._allocation_results(claim)
+
+        configs = get_opaque_device_configs(claim)
+        # Defaults at the front = lowest precedence (device_state.go:613-628).
+        defaults: List[Tuple[List[str], configapi.Interface]] = [
+            ([], configapi.default_tpu_subslice_config()),
+            ([], configapi.default_tpu_config()),
+        ]
+        if fg.enabled(fg.PASSTHROUGH_SUPPORT):
+            vf = configapi.default_vfio_device_config()
+            if vf is not None:
+                defaults.insert(0, ([], vf))
+        configs = defaults + configs
+
+        # Map each allocation result to the highest-precedence matching
+        # config (device_state.go:632-677).
+        config_results: Dict[int, List[dict]] = {}
+        for result in results:
+            device = self.allocatable.get(result["device"])
+            if device is None:
+                raise PrepareError(
+                    f"requested device is not allocatable: {result['device']}"
+                )
+            if fg.enabled(fg.DEVICE_HEALTH_CHECK) and not device.healthy:
+                raise PrepareError(
+                    f"requested device is not healthy: {result['device']}"
+                )
+            matched = False
+            for ci in range(len(configs) - 1, -1, -1):
+                requests, cfg = configs[ci]
+                explicit = result["request"] in requests
+                if not explicit and requests:
+                    continue
+                if not self._config_matches_type(cfg, device):
+                    if explicit:
+                        raise PermanentError(
+                            f"cannot apply {type(cfg).__name__} to device type "
+                            f"{device.type} (request: {result['request']})"
+                        )
+                    continue
+                config_results.setdefault(ci, []).append(result)
+                matched = True
+                break
+            if not matched:
+                raise PermanentError(
+                    f"no config matched device {result['device']} "
+                    f"(request {result['request']})"
+                )
+
+        # Normalize, validate, apply each config over its results
+        # (device_state.go:683-717).
+        prepared = PreparedDevices()
+        for ci, cfg_results in config_results.items():
+            _, cfg = configs[ci]
+            try:
+                cfg.normalize()
+                cfg.validate()
+            except ApiError as e:
+                raise PermanentError(f"invalid device config: {e}") from e
+            config_state = self._apply_config(cfg, claim, cfg_results)
+            group = PreparedDeviceGroup(config_state=config_state)
+            for result in cfg_results:
+                group.devices.append(
+                    self._prepare_one(claim, result, config_state)
+                )
+            prepared.append(group)
+        return prepared
+
+    @staticmethod
+    def _config_matches_type(cfg, device: AllocatableDevice) -> bool:
+        if isinstance(cfg, configapi.TpuConfig):
+            return device.type == TPU_DEVICE_TYPE
+        if isinstance(cfg, configapi.TpuSubsliceConfig):
+            return device.is_subslice()
+        if isinstance(cfg, configapi.VfioDeviceConfig):
+            return device.type == VFIO_DEVICE_TYPE
+        return False
+
+    def _apply_config(
+        self, cfg, claim: dict, results: List[dict]
+    ) -> DeviceConfigState:
+        """applyConfig / applySharingConfig / applyVfioDeviceConfig
+        (device_state.go:888-1006)."""
+        requested = AllocatableDevices(
+            {r["device"]: self.allocatable[r["device"]] for r in results}
+        )
+        state = DeviceConfigState()
+        sharing = getattr(cfg, "sharing", None)
+
+        if isinstance(cfg, configapi.VfioDeviceConfig):
+            if self.vfio_manager is None:
+                raise PrepareError("vfio manager not configured on this node")
+            for dev in requested.values():
+                assert dev.chip is not None
+                self.vfio_manager.configure(dev.chip)
+            return state
+
+        if sharing is None:
+            return state
+
+        if fg.enabled(fg.TIME_SLICING_SETTINGS) and sharing.is_time_slicing():
+            tsc = sharing.get_time_slicing_config()
+            state.time_slice_ordinal = self.ts_manager.set_time_slice(
+                requested, tsc
+            )
+
+        if fg.enabled(fg.MULTIPLEXING_SUPPORT) and sharing.is_multiplexing():
+            if fg.enabled(fg.DYNAMIC_SUBSLICE):
+                raise PermanentError(
+                    "multiplexing is not yet supported with "
+                    "featureGates.DynamicSubslice=true"
+                )
+            if self.multiplex_manager is None:
+                raise PrepareError("multiplex manager not configured on this node")
+            mpc = sharing.get_multiplexing_config()
+            daemon = self.multiplex_manager.new_control_daemon(
+                claim["metadata"]["uid"], requested
+            )
+            daemon.start(mpc)
+            daemon.assert_ready()
+            state.multiplex_daemon_id = daemon.get_id()
+            state.container_edits = daemon.container_edits()
+        return state
+
+    def _prepare_one(
+        self, claim: dict, result: dict, config_state: DeviceConfigState
+    ) -> PreparedDevice:
+        claim_uid = claim["metadata"]["uid"]
+        adev = self.allocatable[result["device"]]
+        kdev = KubeletDevice(
+            requests=[result["request"]],
+            pool_name=result.get("pool", self.pool_name),
+            device_name=result["device"],
+            cdi_device_ids=[self.cdi.qualified_device_id(claim_uid, result["device"])],
+        )
+        pd = PreparedDevice(type=adev.type, device=kdev)
+
+        if adev.type == TPU_DEVICE_TYPE:
+            chip = adev.chip
+            assert chip is not None
+            pd.chip_uuid = chip.uuid
+            pd.dev_paths = list(chip.dev_paths)
+            pd.runtime_env = self._chip_runtime_env([chip])
+        elif adev.type == SUBSLICE_STATIC_DEVICE_TYPE:
+            ss = adev.subslice
+            assert ss is not None
+            pd.subslice_uuid = ss.uuid
+            pd.dev_paths = list(ss.dev_paths)
+            pd.runtime_env = dict(ss.runtime_env)
+        elif adev.type == SUBSLICE_DYNAMIC_DEVICE_TYPE:
+            assert adev.placement is not None
+            t0 = time.monotonic()
+            try:
+                ss = self.tpulib.create_subslice(adev.placement)
+            except TpuLibError as e:
+                raise PrepareError(f"error creating sub-slice: {e}") from e
+            log.debug(
+                "t_prep_create_subslice %.3f s (claim %s)",
+                time.monotonic() - t0,
+                claim_to_string(claim),
+            )
+            pd.subslice_uuid = ss.uuid
+            pd.subslice_placement = str(adev.placement)
+            pd.dev_paths = list(ss.dev_paths)
+            pd.runtime_env = dict(ss.runtime_env)
+        elif adev.type == VFIO_DEVICE_TYPE:
+            chip = adev.chip
+            assert chip is not None
+            pd.chip_uuid = chip.uuid
+            edits = (
+                self.vfio_manager.container_edits(chip)
+                if self.vfio_manager
+                else {"devPaths": [], "env": {}}
+            )
+            pd.dev_paths = list(edits.get("devPaths", []))
+            pd.runtime_env = dict(edits.get("env", {}))
+        if config_state.time_slice_ordinal is not None:
+            pd.runtime_env["TPU_TIMESLICE_ORDINAL"] = str(
+                config_state.time_slice_ordinal
+            )
+        return pd
+
+    def _chip_runtime_env(self, chips) -> Dict[str, str]:
+        gen = chips[0].generation
+        env = {
+            "TPU_VISIBLE_DEVICES": ",".join(str(c.index) for c in chips),
+            "TPU_ACCELERATOR_TYPE": gen.accelerator_type(len(chips)),
+        }
+        ici = chips[0].ici_domain
+        if ici is not None:
+            env["TPU_SLICE_ID"] = ici.clique_id()
+            env["TPU_WORKER_ID"] = str(chips[0].worker_id)
+        return env
+
+
+def get_opaque_device_configs(
+    claim: dict,
+) -> List[Tuple[List[str], configapi.Interface]]:
+    """Decode this driver's opaque configs from a claim's allocation
+    (GetOpaqueDeviceConfigs analog, device_state.go:1019-1072). Returns
+    (requests, config) in claim order — later entries take precedence (class
+    configs come before claim configs in the allocation list, so claim
+    configs win)."""
+    out: List[Tuple[List[str], configapi.Interface]] = []
+    alloc = claim.get("status", {}).get("allocation", {})
+    for entry in alloc.get("devices", {}).get("config", []):
+        opaque = entry.get("opaque")
+        if not opaque or opaque.get("driver") != DRIVER_NAME:
+            continue
+        params = opaque.get("parameters")
+        if params is None:
+            raise PermanentError("opaque config contains no parameters")
+        try:
+            cfg = configapi.strict_decode(params)
+        except ApiError as e:
+            raise PermanentError(f"error decoding opaque config: {e}") from e
+        out.append((entry.get("requests", []), cfg))
+    return out

@@ -1,0 +1,221 @@
+"""gRPC DRA plugin service + kubelet registration service.
+
+Reference analog: the gRPC plumbing kubeletplugin.Start() provides
+(cmd/gpu-kubelet-plugin/driver.go:123-136): two unix sockets — the
+registration socket under /var/lib/kubelet/plugins_registry and the DRA
+service socket under /var/lib/kubelet/plugins/<driver>/ — plus the
+Prepare/Unprepare RPC handlers (driver.go:298-332) and per-claim error
+isolation (one failing claim must not fail the batch).
+
+grpc_tools is not available in this environment, so service registration is
+hand-written over protoc-generated message classes (the same method table
+grpc_tools would emit).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from concurrent import futures
+from typing import Callable, List, Optional
+
+import grpc
+
+from tpu_dra.k8sclient import RESOURCE_CLAIMS, ApiNotFound, ResourceClient
+from tpu_dra.plugin.device_state import DeviceState, PermanentError, claim_to_string
+from tpu_dra.plugin.pb import dra_v1beta1_pb2 as drapb
+from tpu_dra.plugin.pb import pluginregistration_pb2 as regpb
+
+log = logging.getLogger(__name__)
+
+DRA_SERVICE_NAME = "v1beta1.DRAPlugin"
+REGISTRATION_SERVICE_NAME = "pluginregistration.Registration"
+
+
+class DRAService:
+    """NodePrepareResources/NodeUnprepareResources over the node's
+    DeviceState, with the node-global prepare/unprepare flock taken around
+    each claim (driver.go:334-400)."""
+
+    def __init__(
+        self,
+        state: DeviceState,
+        backend,
+        pu_flock,
+        metrics=None,
+    ):
+        self.state = state
+        self.claims = ResourceClient(backend, RESOURCE_CLAIMS)
+        self.pu_flock = pu_flock
+        self.metrics = metrics
+
+    # --- RPC handlers ---
+
+    def node_prepare_resources(
+        self, request: drapb.NodePrepareResourcesRequest, context
+    ) -> drapb.NodePrepareResourcesResponse:
+        resp = drapb.NodePrepareResourcesResponse()
+        for claim_ref in request.claims:
+            result = resp.claims[claim_ref.uid]
+            try:
+                devices = self._prepare_one(claim_ref)
+                for d in devices:
+                    result.devices.append(
+                        drapb.Device(
+                            requests=d.requests,
+                            pool_name=d.pool_name,
+                            device_name=d.device_name,
+                            cdi_device_ids=d.cdi_device_ids,
+                        )
+                    )
+            except PermanentError as e:
+                # Mark non-retryable so the kubelet surfaces it to the pod
+                # instead of hot-looping (cd-plugin driver.go:55-59).
+                result.error = f"permanent error: {e}"
+                log.error(
+                    "prepare failed permanently for claim %s: %s", claim_ref.uid, e
+                )
+            except Exception as e:
+                result.error = str(e)
+                log.warning("prepare failed for claim %s: %s", claim_ref.uid, e)
+        return resp
+
+    def node_unprepare_resources(
+        self, request: drapb.NodeUnprepareResourcesRequest, context
+    ) -> drapb.NodeUnprepareResourcesResponse:
+        resp = drapb.NodeUnprepareResourcesResponse()
+        for claim_ref in request.claims:
+            result = resp.claims[claim_ref.uid]
+            try:
+                release = self.pu_flock.acquire(timeout=60)
+                try:
+                    self.state.unprepare(claim_ref.uid)
+                finally:
+                    release()
+                if self.metrics is not None:
+                    self.metrics.inc("unprepare_total")
+            except Exception as e:
+                result.error = str(e)
+                log.warning("unprepare failed for claim %s: %s", claim_ref.uid, e)
+                if self.metrics is not None:
+                    self.metrics.inc("unprepare_failures_total")
+        return resp
+
+    def _prepare_one(self, claim_ref: drapb.Claim):
+        import time
+
+        t0 = time.monotonic()
+        # Fetch the full claim from the API server (the kubelet only hands
+        # over references).
+        claim = self.claims.get(claim_ref.name, claim_ref.namespace)
+        if claim["metadata"]["uid"] != claim_ref.uid:
+            raise ApiNotFound(
+                f"claim {claim_ref.namespace}/{claim_ref.name} UID mismatch: "
+                f"have {claim['metadata']['uid']}, want {claim_ref.uid}"
+            )
+        release = self.pu_flock.acquire(timeout=60)
+        log.debug("t_prep_lock_acq %.3f s", time.monotonic() - t0)
+        try:
+            devices = self.state.prepare(claim)
+        finally:
+            release()
+        if self.metrics is not None:
+            self.metrics.inc("prepare_total")
+            self.metrics.observe("prepare_seconds", time.monotonic() - t0)
+        log.info(
+            "prepared claim %s: %s",
+            claim_to_string(claim),
+            [d.device_name for d in devices],
+        )
+        return devices
+
+    # --- grpc registration (what grpc_tools would generate) ---
+
+    def add_to_server(self, server: grpc.Server) -> None:
+        handlers = {
+            "NodePrepareResources": grpc.unary_unary_rpc_method_handler(
+                self.node_prepare_resources,
+                request_deserializer=drapb.NodePrepareResourcesRequest.FromString,
+                response_serializer=(
+                    drapb.NodePrepareResourcesResponse.SerializeToString
+                ),
+            ),
+            "NodeUnprepareResources": grpc.unary_unary_rpc_method_handler(
+                self.node_unprepare_resources,
+                request_deserializer=drapb.NodeUnprepareResourcesRequest.FromString,
+                response_serializer=(
+                    drapb.NodeUnprepareResourcesResponse.SerializeToString
+                ),
+            ),
+        }
+        server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(DRA_SERVICE_NAME, handlers),)
+        )
+
+
+class RegistrationService:
+    """The kubelet plugin-registration handshake."""
+
+    def __init__(self, driver_name: str, endpoint: str, versions: List[str]):
+        self.driver_name = driver_name
+        self.endpoint = endpoint
+        self.versions = versions
+        self.registered = threading.Event()
+        self.registration_error: Optional[str] = None
+
+    def get_info(self, request: regpb.InfoRequest, context) -> regpb.PluginInfo:
+        return regpb.PluginInfo(
+            type="DRAPlugin",
+            name=self.driver_name,
+            endpoint=self.endpoint,
+            supported_versions=self.versions,
+        )
+
+    def notify_registration_status(
+        self, request: regpb.RegistrationStatus, context
+    ) -> regpb.RegistrationStatusResponse:
+        if request.plugin_registered:
+            log.info("kubelet registered plugin %s", self.driver_name)
+            self.registered.set()
+        else:
+            self.registration_error = request.error
+            log.error("kubelet registration failed: %s", request.error)
+        return regpb.RegistrationStatusResponse()
+
+    def add_to_server(self, server: grpc.Server) -> None:
+        handlers = {
+            "GetInfo": grpc.unary_unary_rpc_method_handler(
+                self.get_info,
+                request_deserializer=regpb.InfoRequest.FromString,
+                response_serializer=regpb.PluginInfo.SerializeToString,
+            ),
+            "NotifyRegistrationStatus": grpc.unary_unary_rpc_method_handler(
+                self.notify_registration_status,
+                request_deserializer=regpb.RegistrationStatus.FromString,
+                response_serializer=(
+                    regpb.RegistrationStatusResponse.SerializeToString
+                ),
+            ),
+        }
+        server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(REGISTRATION_SERVICE_NAME, handlers),)
+        )
+
+
+def serve_unix(
+    services: list, socket_path: str, max_workers: int = 8
+) -> grpc.Server:
+    """Start a gRPC server on a unix socket; returns the running server."""
+    os.makedirs(os.path.dirname(socket_path), exist_ok=True)
+    try:
+        os.remove(socket_path)
+    except FileNotFoundError:
+        pass
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    for s in services:
+        s.add_to_server(server)
+    server.add_insecure_port(f"unix://{socket_path}")
+    server.start()
+    log.info("gRPC server listening on %s", socket_path)
+    return server

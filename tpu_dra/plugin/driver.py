@@ -1,0 +1,243 @@
+"""Driver wiring: ResourceSlice publication, health, cleanup, sockets.
+
+Reference analog: cmd/gpu-kubelet-plugin/driver.go — NewDriver (:66-173),
+ResourceSlice generation split vs combined keyed on API-server version
+(:188-268, :507-540), health-event handling + republish (:441-505),
+Prepare/Unprepare RPC surface (:298-400).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tpu_dra.infra import featuregates as fg
+from tpu_dra.infra.flock import Flock
+from tpu_dra.infra.metrics import Metrics
+from tpu_dra.k8sclient import RESOURCE_SLICES, ResourceClient
+from tpu_dra.plugin.allocatable import AllocatableDevice
+from tpu_dra.plugin.cdi import CDIHandler
+from tpu_dra.plugin.checkpoint import CheckpointManager
+from tpu_dra.plugin.cleanup import CheckpointCleanupManager
+from tpu_dra.plugin.device_health import DeviceHealthMonitor
+from tpu_dra.plugin.device_state import DRIVER_NAME, DeviceState
+from tpu_dra.plugin.dra_service import (
+    DRAService,
+    RegistrationService,
+    serve_unix,
+)
+from tpu_dra.plugin.sharing import MultiplexManager
+from tpu_dra.plugin.subslice import build_partitionable_model
+from tpu_dra.plugin.vfio import VfioPciManager
+from tpu_dra.tpulib.interface import TpuLib
+from tpu_dra.tpulib.types import ChipHealthEvent
+
+log = logging.getLogger(__name__)
+
+
+def _attr_value(v) -> dict:
+    if isinstance(v, bool):
+        return {"bool": v}
+    if isinstance(v, int):
+        return {"int": v}
+    return {"string": str(v)}
+
+
+@dataclass
+class DriverConfig:
+    node_name: str = ""
+    namespace: str = "tpu-dra-driver"
+    cdi_root: str = "/var/run/cdi"
+    plugin_data_dir: str = "/var/lib/kubelet/plugins/tpu.google.com"
+    kubelet_registrar_dir: str = "/var/lib/kubelet/plugins_registry"
+    # "v1beta1" publishes flat split slices; "v1beta2"/"v1" publish combined
+    # partitionable slices with shared counters (driver.go:507-540 analog).
+    resource_api_version: str = "v1beta1"
+    multiplex_image: str = "tpu-dra-driver:latest"
+    start_grpc: bool = True
+
+
+class Driver:
+    def __init__(
+        self,
+        tpulib: TpuLib,
+        backend,
+        config: DriverConfig,
+    ):
+        self.tpulib = tpulib
+        self.backend = backend
+        self.config = config
+        self.metrics = Metrics()
+        self.cdi = CDIHandler(cdi_root=config.cdi_root)
+        self.checkpoints = CheckpointManager(config.plugin_data_dir)
+        self.pu_flock = Flock(f"{config.plugin_data_dir}/pu.lock")
+        multiplex = MultiplexManager(
+            backend,
+            namespace=config.namespace,
+            node_name=config.node_name,
+            image=config.multiplex_image,
+        )
+        vfio = VfioPciManager()
+        self.state = DeviceState(
+            tpulib=tpulib,
+            cdi=self.cdi,
+            checkpoints=self.checkpoints,
+            multiplex_manager=multiplex,
+            vfio_manager=vfio,
+            node_name=config.node_name,
+            pool_name=config.node_name,
+        )
+        self.slices = ResourceClient(backend, RESOURCE_SLICES)
+        self.dra_service = DRAService(
+            self.state, backend, self.pu_flock, metrics=self.metrics
+        )
+        self._servers = []
+        self.health_monitor = DeviceHealthMonitor(tpulib, self._on_health_change)
+        self.cleanup = CheckpointCleanupManager(
+            self.state, backend, pu_flock=self.pu_flock
+        )
+        self._publish_lock = threading.Lock()
+        self._slice_generation = 0
+
+    # --- lifecycle (RunPlugin/NewDriver analog) ---
+
+    def start(self) -> None:
+        # Startup obliteration before serving the kubelet (driver.go:103).
+        destroyed = self.state.destroy_unknown_subslices()
+        if destroyed:
+            log.warning("destroyed %d unknown sub-slices at startup", len(destroyed))
+        if self.config.start_grpc:
+            dra_socket = f"{self.config.plugin_data_dir}/dra.sock"
+            reg_socket = f"{self.config.kubelet_registrar_dir}/{DRIVER_NAME}-reg.sock"
+            self.registration = RegistrationService(
+                DRIVER_NAME, dra_socket, ["v1beta1"]
+            )
+            self._servers.append(serve_unix([self.dra_service], dra_socket))
+            self._servers.append(serve_unix([self.registration], reg_socket))
+        if fg.enabled(fg.DEVICE_HEALTH_CHECK):
+            self.health_monitor.start()
+        self.cleanup.start()
+        self.publish_resources()
+        self.metrics.set_gauge("allocatable_devices", len(self.state.allocatable))
+
+    def shutdown(self) -> None:
+        self.cleanup.stop()
+        self.health_monitor.stop()
+        for s in self._servers:
+            # stop() only *initiates* shutdown; wait for full termination or
+            # the executor's non-daemon workers block interpreter exit.
+            s.stop(grace=1).wait(timeout=5)
+
+    # --- health (driver.go:441-505) ---
+
+    def _on_health_change(self, ev: ChipHealthEvent) -> None:
+        # Chip-level health lives in tpulib (the event source already updated
+        # ChipInfo.healthy); derive device health from it: a device is healthy
+        # iff every chip coordinate it covers is healthy. A multi-chip
+        # sub-slice therefore stays unpublished until ALL its chips recover.
+        if self.state.recompute_health():
+            self.metrics.inc("health_transitions_total")
+            self.publish_resources()
+
+    # --- ResourceSlice publication (driver.go:188-268) ---
+
+    def publish_resources(self) -> None:
+        with self._publish_lock:
+            self._slice_generation += 1
+            if self.config.resource_api_version == "v1beta1":
+                slices = self._generate_split_slices()
+            else:
+                slices = self._generate_combined_slices()
+            existing = {
+                s["metadata"]["name"]: s
+                for s in self.slices.list(
+                    label_selector={"tpu.google.com/driver": "true"}
+                )
+                if s["spec"].get("nodeName") == self.config.node_name
+            }
+            want_names = set()
+            for s in slices:
+                name = s["metadata"]["name"]
+                want_names.add(name)
+                cur = existing.get(name)
+                if cur is None:
+                    self.slices.create(s)
+                else:
+                    s["metadata"]["resourceVersion"] = cur["metadata"][
+                        "resourceVersion"
+                    ]
+                    self.slices.update(s)
+            for name in set(existing) - want_names:
+                self.slices.delete(name)
+            self.metrics.set_gauge("published_resource_slices", len(slices))
+
+    def _device_entry(self, dev: AllocatableDevice) -> Optional[dict]:
+        if not dev.healthy:
+            return None  # unhealthy devices are unpublished (driver.go:441-505)
+        attrs = {k: _attr_value(v) for k, v in dev.attributes().items()}
+        capacity = {
+            k: {"value": str(v)} for k, v in dev.capacity().items() if v
+        }
+        entry: dict = {"name": dev.name, "basic": {"attributes": attrs}}
+        if capacity:
+            entry["basic"]["capacity"] = capacity
+        return entry
+
+    def _slice_skeleton(self, name_suffix: str, device_entries: List[dict]) -> dict:
+        return {
+            "apiVersion": "resource.k8s.io/v1beta1",
+            "kind": "ResourceSlice",
+            "metadata": {
+                "name": f"{self.config.node_name}-{DRIVER_NAME}-{name_suffix}",
+                "labels": {"tpu.google.com/driver": "true"},
+            },
+            "spec": {
+                "driver": DRIVER_NAME,
+                "nodeName": self.config.node_name,
+                "pool": {
+                    "name": self.config.node_name,
+                    "generation": self._slice_generation,
+                    "resourceSliceCount": 1,
+                },
+                "devices": device_entries,
+            },
+        }
+
+    def _generate_split_slices(self) -> List[dict]:
+        """Flat slices, one per device type (generateSplitResourceSlices,
+        driver.go:188-225): older API servers reject counter fields."""
+        by_type: Dict[str, List[dict]] = {}
+        for dev in self.state.allocatable.values():
+            entry = self._device_entry(dev)
+            if entry is not None:
+                by_type.setdefault(dev.type, []).append(entry)
+        out = []
+        for t, entries in sorted(by_type.items()):
+            out.append(self._slice_skeleton(t, sorted(entries, key=lambda e: e["name"])))
+        # The pool is only consistent when every slice declares the total
+        # slice count at this generation (DRA pool semantics; the reference
+        # delegates this bookkeeping to the k8s resourceslice helper).
+        for s in out:
+            s["spec"]["pool"]["resourceSliceCount"] = len(out)
+        return out
+
+    def _generate_combined_slices(self) -> List[dict]:
+        """One combined partitionable slice with KEP-4815 shared counters
+        (generateCombinedResourceSlices, driver.go:230-268)."""
+        model = build_partitionable_model(self.tpulib, self.state.allocatable)
+        entries = []
+        for dev in sorted(self.state.allocatable.values(), key=lambda d: d.name):
+            entry = self._device_entry(dev)
+            if entry is None:
+                continue
+            consumption = model.device_counter_consumption.get(dev.name)
+            if consumption:
+                entry["basic"]["consumesCounters"] = consumption
+            entries.append(entry)
+        s = self._slice_skeleton("combined", entries)
+        s["apiVersion"] = f"resource.k8s.io/{self.config.resource_api_version}"
+        s["spec"]["sharedCounters"] = model.counter_sets
+        s["spec"]["perDeviceNodeSelection"] = False
+        return [s]

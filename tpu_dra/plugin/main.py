@@ -1,0 +1,112 @@
+"""tpu-kubelet-plugin entrypoint.
+
+Reference analog: cmd/gpu-kubelet-plugin/main.go — CLI flags with env-var
+mirrors (:45-162), plugin bootstrap (:224-275), debug signal handlers.
+
+Run with ``--backend stub --fake-cluster`` for the hardware-free kind/demo
+path (BASELINE config 1).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+from tpu_dra.infra import featuregates, flags, signals
+from tpu_dra.infra.metrics import MetricsServer
+from tpu_dra.plugin.driver import Driver, DriverConfig
+from tpu_dra.tpulib import new_tpulib
+
+log = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("tpu-kubelet-plugin")
+    flags.KubeClientConfig.add_flags(p)
+    flags.LoggingConfig.add_flags(p)
+    flags.add_feature_gate_flag(p)
+    p.add_argument("--node-name", default=flags.env_default("NODE_NAME", ""))
+    p.add_argument("--namespace", default=flags.env_default("NAMESPACE", "tpu-dra-driver"))
+    p.add_argument("--cdi-root", default=flags.env_default("CDI_ROOT", "/var/run/cdi"))
+    p.add_argument(
+        "--plugin-data-dir",
+        default=flags.env_default(
+            "PLUGIN_DATA_DIR", "/var/lib/kubelet/plugins/tpu.google.com"
+        ),
+    )
+    p.add_argument(
+        "--kubelet-registrar-dir",
+        default=flags.env_default(
+            "KUBELET_REGISTRAR_DIR", "/var/lib/kubelet/plugins_registry"
+        ),
+    )
+    p.add_argument(
+        "--resource-api-version",
+        default=flags.env_default("RESOURCE_API_VERSION", "v1beta1"),
+        choices=["v1beta1", "v1beta2", "v1"],
+    )
+    p.add_argument("--backend", default=flags.env_default("TPU_DRA_BACKEND", ""))
+    p.add_argument(
+        "--fake-cluster",
+        action="store_true",
+        default=flags.env_default("TPU_DRA_FAKE_CLUSTER", False, bool),
+        help="Use the in-memory fake API server (demo/e2e without a cluster)",
+    )
+    p.add_argument(
+        "--health-port", type=int, default=flags.env_default("HEALTH_PORT", 0, int)
+    )
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    flags.LoggingConfig.from_args(args).apply()
+    signals.start_debug_signal_handlers()
+    flags.apply_feature_gates(args)
+    flags.log_startup_config(args)
+
+    tpulib = new_tpulib(args.backend)
+    if args.fake_cluster:
+        from tpu_dra.k8sclient import FakeCluster
+
+        backend = FakeCluster()
+    else:
+        backend = flags.KubeClientConfig.from_args(args).new_client()
+
+    config = DriverConfig(
+        node_name=args.node_name,
+        namespace=args.namespace,
+        cdi_root=args.cdi_root,
+        plugin_data_dir=args.plugin_data_dir,
+        kubelet_registrar_dir=args.kubelet_registrar_dir,
+        resource_api_version=args.resource_api_version,
+    )
+    driver = Driver(tpulib, backend, config)
+    driver.start()
+
+    health_server = None
+    if args.health_port:
+        health_server = MetricsServer(
+            driver.metrics,
+            port=args.health_port,
+            healthz=lambda: (True, "serving"),
+        )
+        health_server.start()
+        log.info("metrics/healthz on :%d", health_server.port)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    log.info("tpu-kubelet-plugin running (%d allocatable devices)",
+             len(driver.state.allocatable))
+    stop.wait()
+    driver.shutdown()
+    if health_server:
+        health_server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

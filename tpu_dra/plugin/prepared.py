@@ -1,0 +1,158 @@
+"""Prepared-device bookkeeping serialized into the checkpoint.
+
+Reference analog: cmd/gpu-kubelet-plugin/prepared.go — PreparedDevice sum
+type {Gpu, Mig, Vfio} (:34-60) and PreparedDeviceGroup{Devices, ConfigState}
+(:62-65). All types round-trip JSON (they live inside the checkpoint, so
+field names are part of the on-disk format covered by up/downgrade tests).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tpu_dra.plugin.allocatable import (
+    SUBSLICE_DYNAMIC_DEVICE_TYPE,
+    SUBSLICE_STATIC_DEVICE_TYPE,
+    TPU_DEVICE_TYPE,
+    VFIO_DEVICE_TYPE,
+)
+
+
+@dataclass
+class KubeletDevice:
+    """What is returned to the kubelet per prepared device
+    (kubeletplugin.Device analog)."""
+
+    requests: List[str] = field(default_factory=list)
+    pool_name: str = ""
+    device_name: str = ""
+    cdi_device_ids: List[str] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "requests": self.requests,
+            "poolName": self.pool_name,
+            "deviceName": self.device_name,
+            "cdiDeviceIDs": self.cdi_device_ids,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "KubeletDevice":
+        return cls(
+            requests=d.get("requests", []),
+            pool_name=d.get("poolName", ""),
+            device_name=d.get("deviceName", ""),
+            cdi_device_ids=d.get("cdiDeviceIDs", []),
+        )
+
+
+@dataclass
+class PreparedDevice:
+    """Sum type: exactly one of the payloads is set (prepared.go:34-60)."""
+
+    type: str = TPU_DEVICE_TYPE
+    device: KubeletDevice = field(default_factory=KubeletDevice)
+    # TPU / VFIO: the chip uuid; subslices: the live sub-slice uuid.
+    chip_uuid: str = ""
+    subslice_uuid: str = ""
+    # Dynamic subslices: the placement that was materialized (needed for
+    # rollback when the live uuid never got persisted).
+    subslice_placement: str = ""  # "<shape>@<x>,<y>,<z>"
+    # Rendered workload env for this device (sharing / sub-slice bootstrap).
+    runtime_env: Dict[str, str] = field(default_factory=dict)
+    # Device nodes to inject into the workload container.
+    dev_paths: List[str] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        d = {"type": self.type, "device": self.device.to_dict()}
+        if self.chip_uuid:
+            d["chipUUID"] = self.chip_uuid
+        if self.subslice_uuid:
+            d["subsliceUUID"] = self.subslice_uuid
+        if self.subslice_placement:
+            d["subslicePlacement"] = self.subslice_placement
+        if self.runtime_env:
+            d["runtimeEnv"] = self.runtime_env
+        if self.dev_paths:
+            d["devPaths"] = self.dev_paths
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PreparedDevice":
+        return cls(
+            type=d.get("type", TPU_DEVICE_TYPE),
+            device=KubeletDevice.from_dict(d.get("device", {})),
+            chip_uuid=d.get("chipUUID", ""),
+            subslice_uuid=d.get("subsliceUUID", ""),
+            subslice_placement=d.get("subslicePlacement", ""),
+            runtime_env=d.get("runtimeEnv", {}),
+            dev_paths=d.get("devPaths", []),
+        )
+
+
+@dataclass
+class DeviceConfigState:
+    """Result of applying one opaque config to a device group
+    (device_state.go DeviceConfigState)."""
+
+    multiplex_daemon_id: str = ""  # MpsControlDaemonID analog
+    time_slice_ordinal: Optional[int] = None
+    container_edits: Dict[str, object] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        d: dict = {}
+        if self.multiplex_daemon_id:
+            d["multiplexDaemonID"] = self.multiplex_daemon_id
+        if self.time_slice_ordinal is not None:
+            d["timeSliceOrdinal"] = self.time_slice_ordinal
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DeviceConfigState":
+        return cls(
+            multiplex_daemon_id=d.get("multiplexDaemonID", ""),
+            time_slice_ordinal=d.get("timeSliceOrdinal"),
+        )
+
+
+@dataclass
+class PreparedDeviceGroup:
+    devices: List[PreparedDevice] = field(default_factory=list)
+    config_state: DeviceConfigState = field(default_factory=DeviceConfigState)
+
+    def to_dict(self) -> dict:
+        return {
+            "devices": [d.to_dict() for d in self.devices],
+            "configState": self.config_state.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PreparedDeviceGroup":
+        return cls(
+            devices=[PreparedDevice.from_dict(x) for x in d.get("devices", [])],
+            config_state=DeviceConfigState.from_dict(d.get("configState", {})),
+        )
+
+
+class PreparedDevices(list):
+    """List of PreparedDeviceGroup (prepared.go PreparedDevices)."""
+
+    def get_devices(self) -> List[KubeletDevice]:
+        return [d.device for g in self for d in g.devices]
+
+    def device_names(self) -> List[str]:
+        return [d.device.device_name for g in self for d in g.devices]
+
+    def chip_uuids(self) -> List[str]:
+        return [d.chip_uuid for g in self for d in g.devices if d.chip_uuid]
+
+    def of_type(self, t: str) -> List[PreparedDevice]:
+        return [d for g in self for d in g.devices if d.type == t]
+
+    def to_list(self) -> list:
+        return [g.to_dict() for g in self]
+
+    @classmethod
+    def from_list(cls, lst: list) -> "PreparedDevices":
+        return cls(PreparedDeviceGroup.from_dict(x) for x in lst or [])

@@ -1,0 +1,246 @@
+"""Chip-sharing managers: time-slicing + multiplexing control daemon.
+
+Reference analog: cmd/gpu-kubelet-plugin/sharing.go —
+TimeSlicingManager (:75-149, wraps ``nvidia-smi compute-policy``) and
+MpsManager/MpsControlDaemon (:79-99, :214-440): the MPS daemon runs as a
+dynamically-created per-claim **Deployment** rendered from
+templates/mps-control-daemon.tmpl.yaml, with readiness asserted before the
+claim prepare completes, and container edits injecting the daemon's pipe
+directory + env into workload containers.
+
+TPU mapping:
+
+- TimeSlicingManager drives the cooperative runtime scheduler knob through
+  tpulib (carried to workloads via env; there is no privileged CLI to exec).
+- MultiplexManager is the MPS analog: a per-claim control daemon Deployment
+  that owns one chip set and brokers multiple client processes onto it
+  (libtpu per-process multiplexing), with per-process HBM limits and a
+  compute-share percentage. Its socket directory is mounted into workload
+  containers; env points libtpu at it.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional
+
+from tpu_dra.api.sharing import (
+    DEFAULT_TIME_SLICE,
+    MultiplexingConfig,
+    TimeSlicingConfig,
+    time_slice_ordinal,
+)
+from tpu_dra.k8sclient import DEPLOYMENTS, ResourceClient
+from tpu_dra.plugin.allocatable import AllocatableDevices
+from tpu_dra.tpulib.interface import TpuLib
+
+log = logging.getLogger(__name__)
+
+MULTIPLEX_SHM_SIZE = "1Gi"
+
+
+class TimeSlicingManager:
+    """sharing.go:75-149 analog."""
+
+    def __init__(self, tpulib: TpuLib):
+        self.tpulib = tpulib
+
+    def set_time_slice(
+        self, devices: AllocatableDevices, config: Optional[TimeSlicingConfig]
+    ) -> int:
+        interval = DEFAULT_TIME_SLICE
+        if config is not None and config.interval:
+            interval = config.interval
+        ordinal = time_slice_ordinal(interval)
+        if ordinal < 0:
+            raise ValueError(f"unknown time-slice interval: {interval!r}")
+        uuids = devices.tpu_uuids()
+        if uuids:
+            self.tpulib.set_time_slice(uuids, ordinal)
+        return ordinal
+
+
+class MultiplexControlDaemon:
+    """One per-claim control daemon (MpsControlDaemon analog,
+    sharing.go:151-440)."""
+
+    def __init__(
+        self,
+        manager: "MultiplexManager",
+        claim_uid: str,
+        devices: AllocatableDevices,
+    ):
+        self.manager = manager
+        self.claim_uid = claim_uid
+        self.devices = devices
+        self.name = f"tpu-multiplex-{claim_uid[:13]}"
+        self.namespace = manager.namespace
+
+    def get_id(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def deployment(self, config: Optional[MultiplexingConfig]) -> dict:
+        """Render the control-daemon Deployment
+        (templates/mps-control-daemon.tmpl.yaml analog)."""
+        uuids = self.devices.tpu_uuids()
+        limits: Dict[str, str] = {}
+        share_pct = ""
+        if config is not None:
+            limits = config.normalized_limits(uuids)
+            if config.default_compute_share_percentage is not None:
+                share_pct = str(config.default_compute_share_percentage)
+        env = [
+            {"name": "TPU_MULTIPLEX_CHIPS", "value": ",".join(uuids)},
+            {"name": "TPU_MULTIPLEX_SOCKET_DIR", "value": self.socket_dir()},
+        ]
+        if limits:
+            env.append(
+                {
+                    "name": "TPU_MULTIPLEX_HBM_LIMITS",
+                    "value": ",".join(f"{k}={v}" for k, v in sorted(limits.items())),
+                }
+            )
+        if share_pct:
+            env.append(
+                {"name": "TPU_MULTIPLEX_COMPUTE_SHARE_PCT", "value": share_pct}
+            )
+        return {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {
+                "name": self.name,
+                "namespace": self.namespace,
+                "labels": {
+                    "app.kubernetes.io/name": "tpu-multiplex-control-daemon",
+                    "tpu.google.com/claim-uid": self.claim_uid,
+                },
+            },
+            "spec": {
+                "replicas": 1,
+                "selector": {
+                    "matchLabels": {"tpu.google.com/claim-uid": self.claim_uid}
+                },
+                "template": {
+                    "metadata": {
+                        "labels": {"tpu.google.com/claim-uid": self.claim_uid}
+                    },
+                    "spec": {
+                        "nodeName": self.manager.node_name,
+                        "containers": [
+                            {
+                                "name": "multiplex-control-daemon",
+                                "image": self.manager.image,
+                                "command": ["tpu-multiplex-daemon"],
+                                "env": env,
+                                "volumeMounts": [
+                                    {"name": "socket-dir", "mountPath": self.socket_dir()},
+                                    {"name": "shm", "mountPath": "/dev/shm"},
+                                ],
+                            }
+                        ],
+                        "volumes": [
+                            {
+                                "name": "socket-dir",
+                                "hostPath": {
+                                    "path": self.socket_dir(),
+                                    "type": "DirectoryOrCreate",
+                                },
+                            },
+                            {
+                                # tmpfs shared-memory segment for client
+                                # handshake (sharing.go:214-320 shm mount).
+                                "name": "shm",
+                                "emptyDir": {
+                                    "medium": "Memory",
+                                    "sizeLimit": MULTIPLEX_SHM_SIZE,
+                                },
+                            },
+                        ],
+                    },
+                },
+            },
+        }
+
+    def socket_dir(self) -> str:
+        return f"{self.manager.socket_root}/{self.claim_uid}"
+
+    def start(self, config: Optional[MultiplexingConfig]) -> None:
+        dep = self.deployment(config)
+        existing = self.manager.deployments.try_get(self.name, self.namespace)
+        if existing is None:
+            self.manager.deployments.create(dep)
+            log.info("created multiplex control daemon %s", self.get_id())
+
+    def assert_ready(self, timeout: float = 30.0, poll: float = 0.2) -> None:
+        """Gate prepare completion on daemon readiness
+        (sharing.go AssertReady :322-378)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            dep = self.manager.deployments.try_get(self.name, self.namespace)
+            if dep is not None:
+                ready = dep.get("status", {}).get("readyReplicas", 0)
+                if ready >= 1:
+                    return
+            time.sleep(poll)
+        raise TimeoutError(
+            f"multiplex control daemon {self.get_id()} is not yet ready"
+        )
+
+    def stop(self) -> None:
+        try:
+            self.manager.deployments.delete(self.name, self.namespace)
+            log.info("deleted multiplex control daemon %s", self.get_id())
+        except Exception as e:
+            from tpu_dra.k8sclient import ApiNotFound
+
+            if not isinstance(e, ApiNotFound):
+                raise
+
+    def container_edits(self) -> Dict[str, object]:
+        """CDI edits for workload containers (GetCDIContainerEdits analog,
+        sharing.go:379-400)."""
+        return {
+            "env": {
+                "TPU_MULTIPLEX_SOCKET_DIR": self.socket_dir(),
+                "TPU_PROCESS_MULTIPLEXING": "true",
+            },
+            "mounts": [
+                {
+                    "hostPath": self.socket_dir(),
+                    "containerPath": self.socket_dir(),
+                    "options": ["rw", "rbind"],
+                }
+            ],
+        }
+
+
+class MultiplexManager:
+    def __init__(
+        self,
+        backend,
+        namespace: str = "tpu-dra-driver",
+        node_name: str = "",
+        image: str = "tpu-dra-driver:latest",
+        socket_root: str = "/run/tpu-multiplex",
+    ):
+        self.deployments = ResourceClient(backend, DEPLOYMENTS)
+        self.namespace = namespace
+        self.node_name = node_name
+        self.image = image
+        self.socket_root = socket_root
+
+    def new_control_daemon(
+        self, claim_uid: str, devices: AllocatableDevices
+    ) -> MultiplexControlDaemon:
+        return MultiplexControlDaemon(self, claim_uid, devices)
+
+    def daemon_by_id(self, daemon_id: str) -> MultiplexControlDaemon:
+        namespace, name = daemon_id.split("/", 1)
+        d = MultiplexControlDaemon.__new__(MultiplexControlDaemon)
+        d.manager = self
+        d.name = name
+        d.namespace = namespace
+        d.claim_uid = name.removeprefix("tpu-multiplex-")
+        d.devices = AllocatableDevices()
+        return d

@@ -1,0 +1,86 @@
+"""KEP-4815 partitionable-device modeling for dynamic sub-slice reshape.
+
+Reference analog: cmd/gpu-kubelet-plugin/partitions.go — SharedCounters per
+GPU (memory + per-memory-slice counters, :45-55) consumed by each MIG
+profile's abstract device (:141-212).
+
+TPU counter model: the host mesh contributes one counter per chip
+coordinate (``chip-x-y-z``: 1) into a single per-host counter set. Every
+advertised device consumes the counters of the coordinates it covers:
+
+- a full-chip device consumes its own coordinate,
+- an abstract sub-slice device consumes every coordinate in its placement,
+- a passthrough device consumes its chip's coordinate.
+
+The scheduler can then never allocate overlapping devices simultaneously —
+the exact double-booking defense MIG gets from memory-slice counters, but
+expressed in mesh coordinates (the TPU-native constraint is contiguity in
+the ICI mesh, already guaranteed by the placement enumeration).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from tpu_dra.plugin.allocatable import (
+    AllocatableDevice,
+    AllocatableDevices,
+    SUBSLICE_DYNAMIC_DEVICE_TYPE,
+    dynamic_subslice_device_name,
+)
+from tpu_dra.tpulib.interface import TpuLib
+from tpu_dra.tpulib.types import TopologyCoord
+
+COUNTER_SET_NAME = "tpu-host-mesh"
+
+
+def counter_name(coord: TopologyCoord) -> str:
+    return f"chip-{coord.x}-{coord.y}-{coord.z}"
+
+
+@dataclass
+class PartitionableModel:
+    """SharedCounters + per-device counter consumption
+    (partitions.go PartSharedCounterSets/PartGetDevice analog)."""
+
+    counter_sets: List[dict] = field(default_factory=list)
+    # device name -> list of consumed-counter entries
+    device_counter_consumption: Dict[str, List[dict]] = field(default_factory=dict)
+
+
+def build_partitionable_model(
+    tpulib: TpuLib, allocatable: AllocatableDevices
+) -> PartitionableModel:
+    model = PartitionableModel()
+    counters = {
+        counter_name(c.coord): {"value": "1"} for c in tpulib.chips()
+    }
+    model.counter_sets = [{"name": COUNTER_SET_NAME, "counters": counters}]
+    for name, dev in allocatable.items():
+        consumed = {
+            counter_name(coord): {"value": "1"} for coord in dev.chip_coords()
+        }
+        if consumed:
+            model.device_counter_consumption[name] = [
+                {"counterSet": COUNTER_SET_NAME, "counters": consumed}
+            ]
+    return model
+
+
+def enumerate_dynamic_subslice_devices(tpulib: TpuLib) -> List[AllocatableDevice]:
+    """All abstract sub-slice devices for this host
+    (inspectMigProfilesAndPlacements analog, nvlib.go:1129-1210)."""
+    out: List[AllocatableDevice] = []
+    for shape in tpulib.supported_shapes():
+        # A sub-slice equal to the full host extent is just the set of all
+        # chips; still advertised (the analog of the largest MIG profile).
+        for placement in tpulib.possible_placements(shape):
+            out.append(
+                AllocatableDevice(
+                    name=dynamic_subslice_device_name(placement),
+                    type=SUBSLICE_DYNAMIC_DEVICE_TYPE,
+                    placement=placement,
+                )
+            )
+    return out
