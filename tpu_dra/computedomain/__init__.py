@@ -1,0 +1,31 @@
+"""ComputeDomain subsystem: multi-host ICI pod-slice orchestration.
+
+Reference analog: cmd/compute-domain-{controller,daemon,kubelet-plugin} —
+the IMEX/Multi-Node-NVLink domain machinery, re-targeted at TPU slices:
+
+- A **ComputeDomain** is a multi-host workload domain over an ICI pod slice
+  (DCN across slices). No proprietary daemon to babysit: instead of
+  supervising ``nvidia-imex``, the per-node slice daemon discovers local
+  topology, registers into the ComputeDomainClique CRD with a stable index,
+  and renders the JAX/libtpu multi-host bootstrap config (worker ids, peer
+  hostnames, coordinator address) that the CD kubelet plugin injects into
+  workload pods via CDI.
+- A **clique** is one physical ICI domain (pod slice), named
+  ``<cdUID>.<cliqueID>`` where cliqueID comes from tpulib
+  (sliceUUID.partition — the NVLink clusterUUID.cliqueId analog).
+- Readiness gating is identical in shape to the reference: workload pods
+  stay in ContainerCreating until every expected host has registered and
+  reported Ready — but gate on *complete* slice membership, because JAX
+  multi-host init is all-or-nothing per slice (unlike IMEX's incremental
+  join).
+"""
+
+CD_LABEL_KEY = "resource.tpu.google.com/computeDomain"
+CD_FINALIZER = "resource.tpu.google.com/computedomain-finalizer"
+CD_DRIVER_NAME = "compute-domain.tpu.google.com"
+
+DAEMON_DEVICE_CLASS = "compute-domain-daemon.tpu.google.com"
+CHANNEL_DEVICE_CLASS = "compute-domain-default-channel.tpu.google.com"
+
+# Abstract channel devices advertised per node (nvlib.go:358-361 analog).
+NUM_CHANNELS = 2048

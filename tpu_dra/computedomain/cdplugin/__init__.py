@@ -1,0 +1,6 @@
+"""CD kubelet plugin (cmd/compute-domain-kubelet-plugin).
+
+Advertises abstract **channel** devices + one **daemon** device per node,
+gates workload pod startup on ComputeDomain readiness, and injects the
+slice bootstrap config via CDI.
+"""

@@ -1,0 +1,414 @@
+"""CD-plugin claim preparation.
+
+Reference analog: cmd/compute-domain-kubelet-plugin/device_state.go —
+channel claim prep (:147-288, :466-513) and daemon claim prep (:516-573):
+
+- channel claims (workload pods): assert the claim lives in the CD's
+  namespace (:296-311), label the node so the per-CD DaemonSet follows the
+  workload (:312-365), then **assert CD readiness** — failure raises, the
+  kubelet retries, and the pod stays in ContainerCreating until the whole
+  slice is ready (:238-295). CDI edits inject the daemon-rendered bootstrap
+  env + the per-CD config-dir mount (the ``/dev/nvidia-caps-imex-channels``
+  analog is env+mount, TPUs have no channel device nodes).
+- channel devices are **domain-exclusive per node** (:646-674 analog): one
+  node serves exactly one ComputeDomain per channel at a time.
+- daemon claims: create the per-CD config dir the daemon writes and the
+  workloads read (the ``/imexd`` mount analog).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import threading
+import time
+from typing import Dict, List, Optional
+
+from tpu_dra import api as configapi
+from tpu_dra.api.errors import ApiError
+from tpu_dra.computedomain import (
+    CD_DRIVER_NAME,
+    CD_LABEL_KEY,
+    NUM_CHANNELS,
+)
+from tpu_dra.computedomain.daemon.bootstrap import read_bootstrap_env
+from tpu_dra.k8sclient import (
+    COMPUTE_DOMAINS,
+    NODES,
+    ApiNotFound,
+    ResourceClient,
+)
+from tpu_dra.plugin.cdi import CDIHandler
+from tpu_dra.plugin.checkpoint import (
+    CLAIM_STATE_PREPARE_COMPLETED,
+    CLAIM_STATE_PREPARE_STARTED,
+    CheckpointManager,
+    PreparedClaim,
+)
+from tpu_dra.plugin.device_state import PermanentError, PrepareError, claim_to_string
+from tpu_dra.plugin.prepared import (
+    KubeletDevice,
+    PreparedDevice,
+    PreparedDeviceGroup,
+    PreparedDevices,
+)
+
+log = logging.getLogger(__name__)
+
+CHANNEL_DEVICE_TYPE = "cd-channel"
+DAEMON_DEVICE_TYPE = "cd-daemon"
+
+
+def channel_device_name(i: int) -> str:
+    return f"channel-{i}"
+
+
+DAEMON_DEVICE_NAME = "daemon"
+
+
+class CDDeviceState:
+    def __init__(
+        self,
+        backend,
+        cdi: CDIHandler,
+        checkpoints: CheckpointManager,
+        node_name: str,
+        domains_dir: str,
+        ready_timeout: float = 0.0,
+    ):
+        self.backend = backend
+        self.cds = ResourceClient(backend, COMPUTE_DOMAINS)
+        self.nodes = ResourceClient(backend, NODES)
+        self.cdi = cdi
+        self.checkpoints = checkpoints
+        self.node_name = node_name
+        self.domains_dir = domains_dir
+        self.ready_timeout = ready_timeout
+        self._lock = threading.Lock()
+        self._cd_location: Dict[str, tuple] = {}
+        os.makedirs(domains_dir, exist_ok=True)
+
+    # --- inventory (nvlib.go:138-187 analog) ---
+
+    def allocatable_device_names(self) -> List[str]:
+        return [channel_device_name(i) for i in range(NUM_CHANNELS)] + [
+            DAEMON_DEVICE_NAME
+        ]
+
+    def domain_config_dir(self, cd_uid: str) -> str:
+        return os.path.join(self.domains_dir, cd_uid)
+
+    # --- ComputeDomain helpers (computedomain.go analog) ---
+
+    def _get_cd_by_uid(self, domain_id: str) -> Optional[dict]:
+        # Cache uid -> (namespace, name) so the readiness poll loop does a
+        # targeted GET instead of re-listing every CD cluster-wide each tick.
+        cached = self._cd_location.get(domain_id)
+        if cached is not None:
+            cd = self.cds.try_get(cached[1], cached[0])
+            if cd is not None and cd["metadata"]["uid"] == domain_id:
+                return cd
+            del self._cd_location[domain_id]
+        for cd in self.cds.list():
+            if cd["metadata"]["uid"] == domain_id:
+                self._cd_location[domain_id] = (
+                    cd["metadata"]["namespace"],
+                    cd["metadata"]["name"],
+                )
+                return cd
+        return None
+
+    def assert_compute_domain_namespace(self, cd: dict, claim: dict) -> None:
+        """computedomain.go:296-311: a channel claim must live in its CD's
+        namespace (defends against cross-namespace domainID spoofing)."""
+        if claim["metadata"]["namespace"] != cd["metadata"]["namespace"]:
+            raise PermanentError(
+                f"claim namespace {claim['metadata']['namespace']!r} does not "
+                f"match ComputeDomain namespace "
+                f"{cd['metadata']['namespace']!r}"
+            )
+
+    def add_node_label(self, cd_uid: str) -> None:
+        """computedomain.go:312-365: labeling the node triggers the per-CD
+        DaemonSet to schedule here ("the CD follows the workload")."""
+        node = self.nodes.try_get(self.node_name)
+        if node is None:
+            # Single-node/demo path: synthesize the Node object.
+            node = self.nodes.create({"metadata": {"name": self.node_name}})
+        labels = node["metadata"].get("labels") or {}
+        cur = labels.get(CD_LABEL_KEY)
+        if cur == cd_uid:
+            return
+        if cur is not None and cur != cd_uid:
+            raise PrepareError(
+                f"node {self.node_name} already part of compute domain {cur}"
+            )
+        self.nodes.patch(
+            self.node_name, {"metadata": {"labels": {CD_LABEL_KEY: cd_uid}}}
+        )
+
+    def remove_node_label(self, cd_uid: str) -> None:
+        node = self.nodes.try_get(self.node_name)
+        if node is None:
+            return
+        if (node["metadata"].get("labels") or {}).get(CD_LABEL_KEY) == cd_uid:
+            self.nodes.patch(
+                self.node_name, {"metadata": {"labels": {CD_LABEL_KEY: None}}}
+            )
+
+    def assert_compute_domain_ready(self, cd_uid: str) -> dict:
+        """computedomain.go:238-295: raising here holds the workload pod in
+        ContainerCreating; the kubelet retries until the slice is whole."""
+        deadline = time.monotonic() + self.ready_timeout
+        while True:
+            cd = self._get_cd_by_uid(cd_uid)
+            if cd is None:
+                raise PrepareError(f"ComputeDomain {cd_uid} not found")
+            if cd.get("status", {}).get("status") == "Ready":
+                return cd
+            if time.monotonic() >= deadline:
+                raise PrepareError(
+                    f"ComputeDomain {cd_uid} is not ready "
+                    f"({cd.get('status', {}).get('status') or 'no status'})"
+                )
+            time.sleep(0.1)
+
+    # --- prepare/unprepare ---
+
+    def prepare(self, claim: dict) -> List[KubeletDevice]:
+        with self._lock:
+            return self._prepare_locked(claim)
+
+    def _prepare_locked(self, claim: dict) -> List[KubeletDevice]:
+        claim_uid = claim["metadata"]["uid"]
+        cp = self.checkpoints.get()
+        prev = cp.prepared_claims.get(claim_uid)
+        if prev is not None and prev.checkpoint_state == CLAIM_STATE_PREPARE_COMPLETED:
+            return prev.prepared_devices.get_devices()
+
+        results = self._allocation_results(claim)
+        config = self._decode_config(claim)
+
+        self.checkpoints.update(
+            lambda c: c.prepared_claims.__setitem__(
+                claim_uid,
+                PreparedClaim(
+                    checkpoint_state=CLAIM_STATE_PREPARE_STARTED,
+                    status=claim.get("status", {}),
+                    name=claim["metadata"].get("name", ""),
+                    namespace=claim["metadata"].get("namespace", ""),
+                ),
+            )
+        )
+
+        if isinstance(config, configapi.ComputeDomainChannelConfig):
+            prepared = self._prepare_channel(claim, config, results)
+        elif isinstance(config, configapi.ComputeDomainDaemonConfig):
+            prepared = self._prepare_daemon(claim, config, results)
+        else:
+            raise PermanentError(
+                f"unsupported config kind for CD plugin: {type(config).__name__}"
+            )
+
+        self.cdi.create_claim_spec_file(claim_uid, prepared)
+        self.checkpoints.update(
+            lambda c: c.prepared_claims.__setitem__(
+                claim_uid,
+                PreparedClaim(
+                    checkpoint_state=CLAIM_STATE_PREPARE_COMPLETED,
+                    status=claim.get("status", {}),
+                    prepared_devices=prepared,
+                    name=claim["metadata"].get("name", ""),
+                    namespace=claim["metadata"].get("namespace", ""),
+                ),
+            )
+        )
+        return prepared.get_devices()
+
+    def _prepare_channel(
+        self,
+        claim: dict,
+        config: configapi.ComputeDomainChannelConfig,
+        results: List[dict],
+    ) -> PreparedDevices:
+        cd = self._get_cd_by_uid(config.domain_id)
+        if cd is None:
+            raise PrepareError(f"ComputeDomain {config.domain_id} not found")
+        self.assert_compute_domain_namespace(cd, claim)
+        self._assert_channels_not_allocated_to_other_domain(
+            claim, config.domain_id, results
+        )
+        self.add_node_label(config.domain_id)
+        self.assert_compute_domain_ready(config.domain_id)
+
+        config_dir = self.domain_config_dir(config.domain_id)
+        env = read_bootstrap_env(config_dir) or {}
+        if not env:
+            raise PrepareError(
+                f"bootstrap config for domain {config.domain_id} not yet "
+                f"rendered by the slice daemon"
+            )
+        group = PreparedDeviceGroup()
+        group.config_state.container_edits = {
+            "mounts": [
+                {
+                    "hostPath": config_dir,
+                    "containerPath": "/tpu-cd",
+                    "options": ["ro", "rbind"],
+                }
+            ]
+        }
+        for result in results:
+            pd = PreparedDevice(
+                type=CHANNEL_DEVICE_TYPE,
+                device=KubeletDevice(
+                    requests=[result["request"]],
+                    pool_name=result.get("pool", self.node_name),
+                    device_name=result["device"],
+                    cdi_device_ids=[
+                        self.cdi.qualified_device_id(
+                            claim["metadata"]["uid"], result["device"]
+                        )
+                    ],
+                ),
+                runtime_env=dict(env),
+            )
+            group.devices.append(pd)
+        return PreparedDevices([group])
+
+    def _prepare_daemon(
+        self,
+        claim: dict,
+        config: configapi.ComputeDomainDaemonConfig,
+        results: List[dict],
+    ) -> PreparedDevices:
+        config_dir = self.domain_config_dir(config.domain_id)
+        os.makedirs(config_dir, exist_ok=True)
+        group = PreparedDeviceGroup()
+        group.config_state.container_edits = {
+            "mounts": [
+                {
+                    "hostPath": config_dir,
+                    "containerPath": "/tpu-cd",
+                    "options": ["rw", "rbind"],
+                }
+            ]
+        }
+        for result in results:
+            pd = PreparedDevice(
+                type=DAEMON_DEVICE_TYPE,
+                device=KubeletDevice(
+                    requests=[result["request"]],
+                    pool_name=result.get("pool", self.node_name),
+                    device_name=result["device"],
+                    cdi_device_ids=[
+                        self.cdi.qualified_device_id(
+                            claim["metadata"]["uid"], result["device"]
+                        )
+                    ],
+                ),
+                runtime_env={"CD_UID": config.domain_id,
+                             "CD_CONFIG_DIR": "/tpu-cd"},
+            )
+            group.devices.append(pd)
+        return PreparedDevices([group])
+
+    def _assert_channels_not_allocated_to_other_domain(
+        self, claim: dict, domain_id: str, results: List[dict]
+    ) -> None:
+        """device_state.go:646-674 analog: a channel on this node serves one
+        domain at a time."""
+        requested = {r["device"] for r in results}
+        cp = self.checkpoints.get()
+        for uid, prev in cp.prepared_claims.items():
+            if uid == claim["metadata"]["uid"]:
+                continue
+            prev_domain = self._domain_of(prev)
+            for pd in [d for g in prev.prepared_devices for d in g.devices]:
+                if (
+                    pd.type == CHANNEL_DEVICE_TYPE
+                    and pd.device.device_name in requested
+                    and prev_domain
+                    and prev_domain != domain_id
+                ):
+                    raise PrepareError(
+                        f"channel {pd.device.device_name} on this node is "
+                        f"already allocated to compute domain {prev_domain}"
+                    )
+
+    @staticmethod
+    def _domain_of(prev: PreparedClaim) -> str:
+        for cfg in (
+            prev.status.get("allocation", {}).get("devices", {}).get("config", [])
+        ):
+            params = (cfg.get("opaque") or {}).get("parameters") or {}
+            if params.get("domainID"):
+                return params["domainID"]
+        return ""
+
+    def unprepare(self, claim_uid: str) -> None:
+        with self._lock:
+            cp = self.checkpoints.get()
+            claim = cp.prepared_claims.get(claim_uid)
+            if claim is None:
+                log.info("unprepare noop: no checkpointed claim %s", claim_uid)
+                return
+            # Daemon claim teardown removes the per-CD config dir.
+            for pd in claim.prepared_devices.of_type(DAEMON_DEVICE_TYPE):
+                cd_uid = pd.runtime_env.get("CD_UID", "")
+                if cd_uid:
+                    shutil.rmtree(
+                        self.domain_config_dir(cd_uid), ignore_errors=True
+                    )
+            self.cdi.delete_claim_spec_file(claim_uid)
+            self.checkpoints.update(
+                lambda c: c.prepared_claims.pop(claim_uid, None)
+            )
+
+    def cleanup_stale_node_labels(self) -> int:
+        """computedomain.go:384-439 analog: drop our node's CD label when no
+        prepared claim references that domain anymore."""
+        node = self.nodes.try_get(self.node_name)
+        if node is None:
+            return 0
+        uid = (node["metadata"].get("labels") or {}).get(CD_LABEL_KEY)
+        if not uid:
+            return 0
+        cp = self.checkpoints.get()
+        for prev in cp.prepared_claims.values():
+            if self._domain_of(prev) == uid:
+                return 0
+        self.remove_node_label(uid)
+        return 1
+
+    # --- claim plumbing ---
+
+    @staticmethod
+    def _allocation_results(claim: dict) -> List[dict]:
+        alloc = claim.get("status", {}).get("allocation")
+        if alloc is None:
+            raise PrepareError("claim not yet allocated")
+        return [
+            r
+            for r in alloc.get("devices", {}).get("results", [])
+            if r.get("driver") == CD_DRIVER_NAME
+        ]
+
+    @staticmethod
+    def _decode_config(claim: dict):
+        alloc = claim.get("status", {}).get("allocation", {})
+        for entry in alloc.get("devices", {}).get("config", []):
+            opaque = entry.get("opaque")
+            if not opaque or opaque.get("driver") != CD_DRIVER_NAME:
+                continue
+            try:
+                cfg = configapi.strict_decode(opaque.get("parameters"))
+                cfg.normalize()
+                cfg.validate()
+                return cfg
+            except ApiError as e:
+                raise PermanentError(f"error decoding opaque config: {e}") from e
+        raise PermanentError(
+            "CD claim carries no opaque config for this driver"
+        )

@@ -1,0 +1,160 @@
+"""CD-plugin driver wiring.
+
+Reference analog: cmd/compute-domain-kubelet-plugin/driver.go (:55-299):
+mirrors the gpu-plugin driver but publishes abstract channel/daemon devices
+and adds permanent-error classification in prepare results.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass
+from typing import List, Optional
+
+from tpu_dra.computedomain import CD_DRIVER_NAME, NUM_CHANNELS
+from tpu_dra.computedomain.cdplugin.device_state import (
+    CDDeviceState,
+    DAEMON_DEVICE_NAME,
+    channel_device_name,
+)
+from tpu_dra.infra.flock import Flock
+from tpu_dra.infra.metrics import Metrics
+from tpu_dra.k8sclient import RESOURCE_CLAIMS, RESOURCE_SLICES, ResourceClient
+from tpu_dra.plugin.cdi import CDIHandler
+from tpu_dra.plugin.checkpoint import CheckpointManager
+from tpu_dra.plugin.cleanup import CheckpointCleanupManager
+from tpu_dra.plugin.dra_service import DRAService, RegistrationService, serve_unix
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class CDDriverConfig:
+    node_name: str = ""
+    cdi_root: str = "/var/run/cdi"
+    plugin_data_dir: str = "/var/lib/kubelet/plugins/compute-domain.tpu.google.com"
+    kubelet_registrar_dir: str = "/var/lib/kubelet/plugins_registry"
+    start_grpc: bool = True
+    ready_timeout: float = 0.0
+
+
+class CDDriver:
+    def __init__(self, backend, config: CDDriverConfig, clique_id: str = ""):
+        self.backend = backend
+        self.config = config
+        self.clique_id = clique_id
+        self.metrics = Metrics(prefix="tpu_dra_cd")
+        self.cdi = CDIHandler(cdi_root=config.cdi_root)
+        self.checkpoints = CheckpointManager(config.plugin_data_dir)
+        self.pu_flock = Flock(f"{config.plugin_data_dir}/pu.lock")
+        self.state = CDDeviceState(
+            backend,
+            cdi=self.cdi,
+            checkpoints=self.checkpoints,
+            node_name=config.node_name,
+            domains_dir=f"{config.plugin_data_dir}/domains",
+            ready_timeout=config.ready_timeout,
+        )
+        self.slices = ResourceClient(backend, RESOURCE_SLICES)
+        # Same RPC surface as the TPU plugin; only the state machine differs
+        # (DRAService is generic over anything with prepare/unprepare).
+        self.dra_service = DRAService(
+            self.state, backend, self.pu_flock, metrics=self.metrics
+        )
+        self.cleanup = CheckpointCleanupManager(
+            self.state, backend, pu_flock=self.pu_flock
+        )
+        self.label_gc_period = 60.0
+        self._servers = []
+        self._stop = threading.Event()
+        self._label_gc_thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self.config.start_grpc:
+            dra_socket = f"{self.config.plugin_data_dir}/dra.sock"
+            reg_socket = (
+                f"{self.config.kubelet_registrar_dir}/{CD_DRIVER_NAME}-reg.sock"
+            )
+            self.registration = RegistrationService(
+                CD_DRIVER_NAME, dra_socket, ["v1beta1"]
+            )
+            self._servers.append(serve_unix([self.dra_service], dra_socket))
+            self._servers.append(serve_unix([self.registration], reg_socket))
+        self.cleanup.start()
+        # Periodic stale-node-label GC (computedomain.go:384-439 analog):
+        # drops this node's CD label once no prepared claim references the
+        # domain, freeing the node for other ComputeDomains.
+        self._label_gc_thread = threading.Thread(
+            target=self._label_gc_loop, daemon=True, name="cd-label-gc"
+        )
+        self._label_gc_thread.start()
+        self.publish_resources()
+
+    def _label_gc_loop(self) -> None:
+        while not self._stop.wait(self.label_gc_period):
+            try:
+                self.state.cleanup_stale_node_labels()
+            except Exception:
+                log.exception("stale node-label GC failed")
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self.cleanup.stop()
+        for s in self._servers:
+            s.stop(grace=1).wait(timeout=5)
+
+    MAX_DEVICES_PER_SLICE = 128  # apiserver validation cap on spec.devices
+
+    def publish_resources(self) -> None:
+        """NUM_CHANNELS channel devices + the daemon device
+        (nvlib.go:138-187 analog), sharded across slices to respect the
+        128-devices-per-ResourceSlice validation limit, with every slice
+        declaring the pool's total slice count. Channels are abstract (no
+        hardware), so attributes carry only the clique identity."""
+        devices = []
+        for i in range(NUM_CHANNELS):
+            attrs = {"type": {"string": "cd-channel"}, "channel": {"int": i}}
+            if self.clique_id:
+                attrs["cliqueID"] = {"string": self.clique_id}
+            devices.append(
+                {"name": channel_device_name(i), "basic": {"attributes": attrs}}
+            )
+        daemon_attrs = {"type": {"string": "cd-daemon"}}
+        if self.clique_id:
+            daemon_attrs["cliqueID"] = {"string": self.clique_id}
+        devices.append(
+            {"name": DAEMON_DEVICE_NAME, "basic": {"attributes": daemon_attrs}}
+        )
+
+        chunks = [
+            devices[i : i + self.MAX_DEVICES_PER_SLICE]
+            for i in range(0, len(devices), self.MAX_DEVICES_PER_SLICE)
+        ]
+        for idx, chunk in enumerate(chunks):
+            s = {
+                "apiVersion": "resource.k8s.io/v1beta1",
+                "kind": "ResourceSlice",
+                "metadata": {
+                    "name": f"{self.config.node_name}-{CD_DRIVER_NAME}-{idx}",
+                    "labels": {"tpu.google.com/cd-driver": "true"},
+                },
+                "spec": {
+                    "driver": CD_DRIVER_NAME,
+                    "nodeName": self.config.node_name,
+                    "pool": {
+                        "name": f"{self.config.node_name}-cd",
+                        "generation": 1,
+                        "resourceSliceCount": len(chunks),
+                    },
+                    "devices": chunk,
+                },
+            }
+            cur = self.slices.try_get(s["metadata"]["name"])
+            if cur is None:
+                self.slices.create(s)
+            else:
+                s["metadata"]["resourceVersion"] = cur["metadata"][
+                    "resourceVersion"
+                ]
+                self.slices.update(s)

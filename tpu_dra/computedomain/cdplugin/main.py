@@ -1,0 +1,86 @@
+"""compute-domain-kubelet-plugin entrypoint (mirrors the gpu-plugin main)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+from tpu_dra.computedomain.cdplugin.driver import CDDriver, CDDriverConfig
+from tpu_dra.infra import flags, signals
+from tpu_dra.tpulib import new_tpulib
+
+log = logging.getLogger(__name__)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("tpu-compute-domain-kubelet-plugin")
+    flags.KubeClientConfig.add_flags(p)
+    flags.LoggingConfig.add_flags(p)
+    flags.add_feature_gate_flag(p)
+    p.add_argument("--node-name", default=flags.env_default("NODE_NAME", ""))
+    p.add_argument("--cdi-root", default=flags.env_default("CDI_ROOT", "/var/run/cdi"))
+    p.add_argument(
+        "--plugin-data-dir",
+        default=flags.env_default(
+            "PLUGIN_DATA_DIR",
+            "/var/lib/kubelet/plugins/compute-domain.tpu.google.com",
+        ),
+    )
+    p.add_argument(
+        "--kubelet-registrar-dir",
+        default=flags.env_default(
+            "KUBELET_REGISTRAR_DIR", "/var/lib/kubelet/plugins_registry"
+        ),
+    )
+    p.add_argument("--backend", default=flags.env_default("TPU_DRA_BACKEND", ""))
+    p.add_argument(
+        "--fake-cluster",
+        action="store_true",
+        default=flags.env_default("TPU_DRA_FAKE_CLUSTER", False, bool),
+    )
+    args = p.parse_args(argv)
+    flags.LoggingConfig.from_args(args).apply()
+    signals.start_debug_signal_handlers()
+    flags.apply_feature_gates(args)
+    flags.log_startup_config(args)
+
+    if args.fake_cluster:
+        from tpu_dra.k8sclient import FakeCluster
+
+        backend = FakeCluster()
+    else:
+        backend = flags.KubeClientConfig.from_args(args).new_client()
+
+    # Clique identity from local tpulib (nvlib.go:188-357 analog).
+    clique_id = ""
+    try:
+        tpulib = new_tpulib(args.backend)
+        ici = tpulib.ici_domain()
+        clique_id = ici.clique_id() if ici else ""
+    except Exception as e:
+        log.warning("could not discover ICI domain: %s", e)
+
+    driver = CDDriver(
+        backend,
+        CDDriverConfig(
+            node_name=args.node_name,
+            cdi_root=args.cdi_root,
+            plugin_data_dir=args.plugin_data_dir,
+            kubelet_registrar_dir=args.kubelet_registrar_dir,
+        ),
+        clique_id=clique_id,
+    )
+    driver.start()
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    log.info("compute-domain-kubelet-plugin running")
+    stop.wait()
+    driver.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,1 @@
+"""Cluster-level ComputeDomain controller (cmd/compute-domain-controller)."""

@@ -1,0 +1,159 @@
+"""The ComputeDomain reconciler.
+
+Reference analog: cmd/compute-domain-controller/{controller.go,
+computedomain.go} — a leader-elected loop (main.go:269-355) reconciling CD
+objects through a coalescing work queue:
+
+- add/update (computedomain.go:298-374): ensure finalizer, stamp the per-CD
+  DaemonSet + both ResourceClaimTemplates, refresh aggregated status;
+- delete (computedomain.go:314-348): strict teardown order with
+  assert-removed barriers — delete RCTs, delete DS (finalizer removed only
+  once its pods are gone), remove node labels, delete cliques, then drop
+  the CD finalizer.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import List, Optional
+
+from tpu_dra.computedomain import CD_FINALIZER, CD_LABEL_KEY
+from tpu_dra.computedomain.controller.daemonset import DaemonSetManager
+from tpu_dra.computedomain.controller.node import NodeLabelManager
+from tpu_dra.computedomain.controller.rct import ResourceClaimTemplateManager
+from tpu_dra.computedomain.controller.status import StatusManager
+from tpu_dra.infra.workqueue import WorkQueue, default_controller_rate_limiter
+from tpu_dra.k8sclient import (
+    COMPUTE_DOMAIN_CLIQUES,
+    COMPUTE_DOMAINS,
+    ApiNotFound,
+    Informer,
+    ResourceClient,
+)
+
+log = logging.getLogger(__name__)
+
+
+class RetryLater(RuntimeError):
+    """Reconcile barrier not yet met; the work queue re-enqueues."""
+
+
+class ComputeDomainController:
+    def __init__(
+        self,
+        backend,
+        driver_namespace: str = "tpu-dra-driver",
+        image: str = "tpu-dra-driver:latest",
+        status_sync_period: float = 10.0,
+    ):
+        self.backend = backend
+        self.cds = ResourceClient(backend, COMPUTE_DOMAINS)
+        self.daemonsets = DaemonSetManager(backend, driver_namespace, image)
+        self.rcts = ResourceClaimTemplateManager(backend)
+        self.status = StatusManager(backend)
+        self.node_labels = NodeLabelManager(backend)
+        self.queue = WorkQueue(default_controller_rate_limiter())
+        self.cd_informer = Informer(backend, COMPUTE_DOMAINS)
+        self.clique_informer = Informer(backend, COMPUTE_DOMAIN_CLIQUES)
+        self.status_sync_period = status_sync_period
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # --- lifecycle ---
+
+    def start(self) -> None:
+        self.cd_informer.add_handler(self._on_cd_event)
+        self.clique_informer.add_handler(self._on_clique_event)
+        self.cd_informer.start()
+        self.clique_informer.start()
+        self._threads.append(self.queue.run_in_thread())
+        t = threading.Thread(
+            target=self._periodic_sync, daemon=True, name="cd-periodic-sync"
+        )
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.shutdown()
+        self.cd_informer.stop()
+        self.clique_informer.stop()
+
+    def _periodic_sync(self) -> None:
+        """cdstatus.go:120-133 periodic sync + node.go label GC."""
+        while not self._stop.wait(self.status_sync_period):
+            try:
+                for cd in self.cds.list():
+                    self._enqueue(cd)
+                self.node_labels.cleanup_stale_labels()
+            except Exception:
+                log.exception("periodic CD sync failed")
+
+    # --- event plumbing ---
+
+    def _key(self, cd: dict) -> str:
+        return f"{cd['metadata']['namespace']}/{cd['metadata']['name']}"
+
+    def _enqueue(self, cd: dict) -> None:
+        self.queue.enqueue(cd, self._reconcile, key=self._key(cd))
+
+    def _on_cd_event(self, event: str, cd: dict) -> None:
+        if event == "DELETED":
+            return  # finalizer flow handles teardown while it still exists
+        self._enqueue(cd)
+
+    def _on_clique_event(self, event: str, clique: dict) -> None:
+        uid = (clique["metadata"].get("labels") or {}).get(CD_LABEL_KEY)
+        if not uid:
+            return
+        for cd in self.cds.list():
+            if cd["metadata"]["uid"] == uid:
+                self._enqueue(cd)
+                return
+
+    # --- reconcile (computedomain.go:298-374) ---
+
+    def _reconcile(self, cd_snapshot: dict) -> None:
+        md = cd_snapshot["metadata"]
+        cd = self.cds.try_get(md["name"], md["namespace"])
+        if cd is None:
+            return
+        if cd["metadata"].get("deletionTimestamp"):
+            self._teardown(cd)
+            return
+        # Ensure finalizer first (computedomain.go:351).
+        fins = cd["metadata"].setdefault("finalizers", [])
+        if CD_FINALIZER not in fins:
+            fins.append(CD_FINALIZER)
+            cd = self.cds.update(cd)
+        self.rcts.create_or_update(cd)
+        self.daemonsets.create_or_update(cd)
+        self.status.sync(cd)
+
+    def _teardown(self, cd: dict) -> None:
+        """Strict deletion order with barriers (computedomain.go:314-348)."""
+        self.rcts.request_delete(cd)
+        self.daemonsets.request_delete(cd)
+        self.node_labels.remove_labels_for(cd["metadata"]["uid"])
+        if not self.rcts.finalize(cd):
+            raise RetryLater("waiting for ResourceClaimTemplates to terminate")
+        if not self.daemonsets.finalize_if_pods_gone(cd):
+            raise RetryLater("waiting for daemon pods to terminate")
+        if not self.status.delete_cliques(cd):
+            raise RetryLater("waiting for cliques to terminate")
+        # All dependents gone: drop our finalizer, completing deletion.
+        cur = self.cds.try_get(
+            cd["metadata"]["name"], cd["metadata"]["namespace"]
+        )
+        if cur is None:
+            return
+        cur["metadata"]["finalizers"] = [
+            f for f in cur["metadata"].get("finalizers", []) if f != CD_FINALIZER
+        ]
+        self.cds.update(cur)
+        log.info(
+            "computedomain %s/%s fully removed",
+            cd["metadata"]["namespace"],
+            cd["metadata"]["name"],
+        )

@@ -1,0 +1,189 @@
+"""Per-CD DaemonSet management.
+
+Reference analog: cmd/compute-domain-controller/daemonset.go — each
+ComputeDomain gets one DaemonSet running the slice daemon, node-selected on
+the ``resource.tpu.google.com/computeDomain=<cdUID>`` label (which the CD
+kubelet plugin sets on nodes where workload channel claims land: "the CD
+follows the workload", daemonset.go:189-253). Deletion is finalizer-ordered:
+the DaemonSet finalizer is only removed once its pods are gone
+(daemonset.go:317-366).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+from tpu_dra.computedomain import CD_FINALIZER, CD_LABEL_KEY
+from tpu_dra.k8sclient import DAEMON_SETS, PODS, ApiNotFound, ResourceClient
+
+log = logging.getLogger(__name__)
+
+
+class DaemonSetManager:
+    def __init__(
+        self,
+        backend,
+        driver_namespace: str,
+        image: str = "tpu-dra-driver:latest",
+        additional_namespaces: Optional[List[str]] = None,
+    ):
+        self.backend = backend
+        self.daemonsets = ResourceClient(backend, DAEMON_SETS)
+        self.pods = ResourceClient(backend, PODS)
+        self.driver_namespace = driver_namespace
+        self.image = image
+        # mnsdaemonset.go analog: CDs may live in additional namespaces.
+        self.namespaces = [driver_namespace] + (additional_namespaces or [])
+
+    def name_for(self, cd: dict) -> str:
+        return f"compute-domain-daemon-{cd['metadata']['uid'][:13]}"
+
+    def render(self, cd: dict) -> dict:
+        """templates/compute-domain-daemon.tmpl.yaml analog."""
+        uid = cd["metadata"]["uid"]
+        name = self.name_for(cd)
+        labels = {
+            "app.kubernetes.io/name": "compute-domain-daemon",
+            CD_LABEL_KEY: uid,
+        }
+        return {
+            "apiVersion": "apps/v1",
+            "kind": "DaemonSet",
+            "metadata": {
+                "name": name,
+                "namespace": self.driver_namespace,
+                "labels": labels,
+                "finalizers": [CD_FINALIZER],
+                "annotations": {
+                    "resource.tpu.google.com/computeDomainName": cd["metadata"][
+                        "name"
+                    ],
+                    "resource.tpu.google.com/computeDomainNamespace": cd["metadata"][
+                        "namespace"
+                    ],
+                },
+            },
+            "spec": {
+                "selector": {"matchLabels": {CD_LABEL_KEY: uid}},
+                "template": {
+                    "metadata": {"labels": dict(labels)},
+                    "spec": {
+                        # Pods land only on nodes the workload touched
+                        # ("CD follows workload").
+                        "nodeSelector": {CD_LABEL_KEY: uid},
+                        "tolerations": [
+                            {"key": "google.com/tpu", "operator": "Exists"}
+                        ],
+                        "containers": [
+                            {
+                                "name": "compute-domain-daemon",
+                                "image": self.image,
+                                "command": ["tpu-compute-domain-daemon"],
+                                # The container must reference the daemon
+                                # claim or the kubelet never applies its CDI
+                                # edits (the /tpu-cd config-dir mount).
+                                "resources": {
+                                    "claims": [{"name": "cd-daemon-claim"}]
+                                },
+                                "env": [
+                                    {"name": "CD_UID", "value": uid},
+                                    {
+                                        "name": "CD_NAME",
+                                        "value": cd["metadata"]["name"],
+                                    },
+                                    {
+                                        "name": "CD_NAMESPACE",
+                                        "value": cd["metadata"]["namespace"],
+                                    },
+                                    {
+                                        "name": "NUM_NODES",
+                                        "value": str(cd["spec"]["numNodes"]),
+                                    },
+                                    # Downward-API identity: without these
+                                    # every daemon registers as '' and all
+                                    # hosts collapse onto clique index 0.
+                                    {
+                                        "name": "NODE_NAME",
+                                        "valueFrom": {
+                                            "fieldRef": {
+                                                "fieldPath": "spec.nodeName"
+                                            }
+                                        },
+                                    },
+                                    {
+                                        "name": "POD_IP",
+                                        "valueFrom": {
+                                            "fieldRef": {
+                                                "fieldPath": "status.podIP"
+                                            }
+                                        },
+                                    },
+                                ],
+                                # Probes exec the daemon's own check
+                                # subcommand (template :72-94 analog).
+                                "readinessProbe": {
+                                    "exec": {
+                                        "command": [
+                                            "tpu-compute-domain-daemon",
+                                            "check",
+                                        ]
+                                    },
+                                    "periodSeconds": 5,
+                                },
+                            }
+                        ],
+                        "resourceClaims": [
+                            {
+                                "name": "cd-daemon-claim",
+                                "resourceClaimTemplateName": daemon_rct_name(cd),
+                            }
+                        ],
+                    },
+                },
+            },
+        }
+
+    def create_or_update(self, cd: dict) -> dict:
+        want = self.render(cd)
+        cur = self.daemonsets.try_get(
+            want["metadata"]["name"], self.driver_namespace
+        )
+        if cur is None:
+            return self.daemonsets.create(want)
+        if cur["spec"] != want["spec"]:
+            cur["spec"] = want["spec"]
+            return self.daemonsets.update(cur)
+        return cur
+
+    def request_delete(self, cd: dict) -> None:
+        try:
+            self.daemonsets.delete(self.name_for(cd), self.driver_namespace)
+        except ApiNotFound:
+            pass
+
+    def pods_gone(self, cd: dict) -> bool:
+        pods = self.pods.list(
+            namespace=self.driver_namespace,
+            label_selector={CD_LABEL_KEY: cd["metadata"]["uid"]},
+        )
+        return not pods
+
+    def finalize_if_pods_gone(self, cd: dict) -> bool:
+        """Remove our finalizer from the DS once its pods are gone
+        (daemonset.go:317-366); True when the DS is fully gone."""
+        ds = self.daemonsets.try_get(self.name_for(cd), self.driver_namespace)
+        if ds is None:
+            return True
+        if not ds["metadata"].get("deletionTimestamp"):
+            return False
+        if not self.pods_gone(cd):
+            return False
+        fins = [f for f in ds["metadata"].get("finalizers", []) if f != CD_FINALIZER]
+        ds["metadata"]["finalizers"] = fins
+        self.daemonsets.update(ds)
+        return self.daemonsets.try_get(self.name_for(cd), self.driver_namespace) is None
+
+
+def daemon_rct_name(cd: dict) -> str:
+    return f"{cd['metadata']['name']}-daemon-claim"

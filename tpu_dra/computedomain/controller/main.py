@@ -1,0 +1,150 @@
+"""compute-domain-controller entrypoint.
+
+Reference analog: cmd/compute-domain-controller/main.go (:269-355) — a
+leader-elected Deployment. Leader election uses a coordination.k8s.io Lease
+(pkg/flags/leaderelection.go:25-85 analog).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import socket
+import threading
+import time
+import uuid
+
+from tpu_dra.computedomain.controller.controller import ComputeDomainController
+from tpu_dra.infra import flags, signals
+from tpu_dra.k8sclient import LEASES, ApiConflict, ApiNotFound, ResourceClient
+
+log = logging.getLogger(__name__)
+
+
+class LeaderElector:
+    """Lease-based leader election (simplified client-go leaderelection)."""
+
+    def __init__(self, backend, config: flags.LeaderElectionConfig):
+        self.leases = ResourceClient(backend, LEASES)
+        self.config = config
+        self.identity = f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}"
+        self._stop = threading.Event()
+
+    def _now(self) -> str:
+        return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+    def acquire_or_renew(self) -> bool:
+        name, ns = self.config.lease_name, self.config.namespace
+        lease = self.leases.try_get(name, ns)
+        if lease is None:
+            try:
+                self.leases.create(
+                    {
+                        "metadata": {"name": name, "namespace": ns},
+                        "spec": {
+                            "holderIdentity": self.identity,
+                            "acquireTime": self._now(),
+                            "renewTime": self._now(),
+                            "leaseDurationSeconds": int(
+                                self.config.lease_duration
+                            ),
+                        },
+                    }
+                )
+                return True
+            except ApiConflict:
+                return False
+        spec = lease.get("spec", {})
+        if spec.get("holderIdentity") == self.identity:
+            spec["renewTime"] = self._now()
+            try:
+                self.leases.update(lease)
+                return True
+            except ApiConflict:
+                return False
+        # Take over an expired lease.
+        renew = spec.get("renewTime", "1970-01-01T00:00:00Z")
+        expired = (
+            time.time()
+            - time.mktime(time.strptime(renew, "%Y-%m-%dT%H:%M:%SZ"))
+            > spec.get("leaseDurationSeconds", 15)
+        )
+        if not expired:
+            return False
+        spec["holderIdentity"] = self.identity
+        spec["acquireTime"] = self._now()
+        spec["renewTime"] = self._now()
+        try:
+            self.leases.update(lease)
+            return True
+        except ApiConflict:
+            return False
+
+    def run_leading(self, lead) -> None:
+        """Acquire, lead while renewing, and on lost leadership re-enter the
+        election (a transient renewal conflict must not permanently halt
+        reconciliation — the reference exits the process so the pod
+        restarts; re-election is the in-process equivalent)."""
+        while not self._stop.is_set():
+            if not self.acquire_or_renew():
+                self._stop.wait(self.config.retry_period)
+                continue
+            log.info("became leader as %s", self.identity)
+            stop_lead = lead()
+            try:
+                while not self._stop.wait(self.config.renew_deadline / 2):
+                    if not self.acquire_or_renew():
+                        log.error("lost leadership; re-entering election")
+                        break
+            finally:
+                stop_lead()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("tpu-compute-domain-controller")
+    flags.KubeClientConfig.add_flags(p)
+    flags.LoggingConfig.add_flags(p)
+    flags.LeaderElectionConfig.add_flags(p)
+    flags.add_feature_gate_flag(p)
+    p.add_argument("--namespace", default=flags.env_default("NAMESPACE", "tpu-dra-driver"))
+    p.add_argument("--image", default=flags.env_default("DAEMON_IMAGE", "tpu-dra-driver:latest"))
+    args = p.parse_args(argv)
+    flags.LoggingConfig.from_args(args).apply()
+    signals.start_debug_signal_handlers()
+    flags.apply_feature_gates(args)
+    flags.log_startup_config(args)
+
+    backend = flags.KubeClientConfig.from_args(args).new_client()
+    controller = ComputeDomainController(
+        backend, driver_namespace=args.namespace, image=args.image
+    )
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+
+    le_config = flags.LeaderElectionConfig.from_args(args)
+    if le_config.enabled:
+        elector = LeaderElector(backend, le_config)
+
+        def lead():
+            controller.start()
+            return controller.stop
+
+        t = threading.Thread(target=elector.run_leading, args=(lead,), daemon=True)
+        t.start()
+        stop.wait()
+        elector.stop()
+    else:
+        controller.start()
+        stop.wait()
+        controller.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

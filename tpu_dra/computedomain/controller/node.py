@@ -1,0 +1,56 @@
+"""Node-label lifecycle for ComputeDomains.
+
+Reference analog: cmd/compute-domain-controller/node.go (:113-167): the CD
+kubelet plugin labels nodes with ``resource.tpu.google.com/computeDomain=
+<cdUID>`` when workload claims land; this manager removes those labels when
+the CD is deleted, and a periodic pass GC's labels referencing CDs that no
+longer exist.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Set
+
+from tpu_dra.computedomain import CD_LABEL_KEY
+from tpu_dra.k8sclient import COMPUTE_DOMAINS, NODES, ResourceClient
+
+log = logging.getLogger(__name__)
+
+
+class NodeLabelManager:
+    def __init__(self, backend):
+        self.nodes = ResourceClient(backend, NODES)
+        self.cds = ResourceClient(backend, COMPUTE_DOMAINS)
+
+    def labeled_nodes(self, cd_uid: str) -> List[dict]:
+        return self.nodes.list(label_selector={CD_LABEL_KEY: cd_uid})
+
+    def remove_labels_for(self, cd_uid: str) -> int:
+        n = 0
+        for node in self.labeled_nodes(cd_uid):
+            self.nodes.patch(
+                node["metadata"]["name"],
+                {"metadata": {"labels": {CD_LABEL_KEY: None}}},
+            )
+            n += 1
+        return n
+
+    def cleanup_stale_labels(self) -> int:
+        """Periodic GC: drop CD labels whose CD no longer exists
+        (node.go:113-167)."""
+        live_uids: Set[str] = {
+            cd["metadata"]["uid"] for cd in self.cds.list()
+        }
+        cleaned = 0
+        for node in self.nodes.list():
+            uid = (node["metadata"].get("labels") or {}).get(CD_LABEL_KEY)
+            if uid and uid not in live_uids:
+                self.nodes.patch(
+                    node["metadata"]["name"],
+                    {"metadata": {"labels": {CD_LABEL_KEY: None}}},
+                )
+                cleaned += 1
+        if cleaned:
+            log.info("removed %d stale computeDomain node labels", cleaned)
+        return cleaned

@@ -1,0 +1,164 @@
+"""ResourceClaimTemplate management for ComputeDomains.
+
+Reference analog: cmd/compute-domain-controller/resourceclaimtemplate.go
+(:280-399): each CD gets (a) a **daemon RCT** (deviceClass
+``compute-domain-daemon.tpu.google.com``) used by the per-CD DaemonSet, and
+(b) the user-visible **workload RCT** (deviceClass
+``compute-domain-default-channel.tpu.google.com``), named by
+``spec.channel.resourceClaimTemplate.name``, embedding the opaque
+ComputeDomain{Daemon,Channel}Config with domainID = the CD's UID.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from tpu_dra.computedomain import (
+    CD_DRIVER_NAME,
+    CD_FINALIZER,
+    CHANNEL_DEVICE_CLASS,
+    DAEMON_DEVICE_CLASS,
+)
+from tpu_dra.computedomain.controller.daemonset import daemon_rct_name
+from tpu_dra.k8sclient import (
+    RESOURCE_CLAIM_TEMPLATES,
+    ApiNotFound,
+    ResourceClient,
+)
+
+log = logging.getLogger(__name__)
+
+API_VERSION = "resource.tpu.google.com/v1beta1"
+
+
+def _rct(
+    name: str,
+    namespace: str,
+    cd_uid: str,
+    device_class: str,
+    config_kind: str,
+    request_name: str,
+    allocation_mode: str = "",
+) -> dict:
+    params: dict = {
+        "apiVersion": API_VERSION,
+        "kind": config_kind,
+        "domainID": cd_uid,
+    }
+    if allocation_mode:
+        params["allocationMode"] = allocation_mode
+    request: dict = {
+        "name": request_name,
+        "deviceClassName": device_class,
+    }
+    if allocation_mode == "All":
+        request["allocationMode"] = "All"
+    return {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaimTemplate",
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "finalizers": [CD_FINALIZER],
+            "labels": {"resource.tpu.google.com/computeDomain": cd_uid},
+        },
+        "spec": {
+            "spec": {
+                "devices": {
+                    "requests": [request],
+                    "config": [
+                        {
+                            "requests": [request_name],
+                            "opaque": {
+                                "driver": CD_DRIVER_NAME,
+                                "parameters": params,
+                            },
+                        }
+                    ],
+                }
+            }
+        },
+    }
+
+
+class ResourceClaimTemplateManager:
+    def __init__(self, backend):
+        self.rcts = ResourceClient(backend, RESOURCE_CLAIM_TEMPLATES)
+
+    def render_daemon_rct(self, cd: dict) -> dict:
+        return _rct(
+            name=daemon_rct_name(cd),
+            namespace=cd["metadata"]["namespace"],
+            cd_uid=cd["metadata"]["uid"],
+            device_class=DAEMON_DEVICE_CLASS,
+            config_kind="ComputeDomainDaemonConfig",
+            request_name="cd-daemon",
+        )
+
+    def render_workload_rct(self, cd: dict) -> dict:
+        channel = cd["spec"].get("channel") or {}
+        name = channel.get("resourceClaimTemplate", {}).get("name")
+        if not name:
+            raise ValueError(
+                "ComputeDomain.spec.channel.resourceClaimTemplate.name is "
+                "required"
+            )
+        return _rct(
+            name=name,
+            namespace=cd["metadata"]["namespace"],
+            cd_uid=cd["metadata"]["uid"],
+            device_class=CHANNEL_DEVICE_CLASS,
+            config_kind="ComputeDomainChannelConfig",
+            request_name="cd-channel",
+            allocation_mode=channel.get("allocationMode", ""),
+        )
+
+    def create_or_update(self, cd: dict) -> None:
+        for want in (self.render_daemon_rct(cd), self.render_workload_rct(cd)):
+            cur = self.rcts.try_get(
+                want["metadata"]["name"], want["metadata"]["namespace"]
+            )
+            if cur is None:
+                self.rcts.create(want)
+            elif cur["spec"] != want["spec"]:
+                cur["spec"] = want["spec"]
+                self.rcts.update(cur)
+
+    def request_delete(self, cd: dict) -> None:
+        for render in (self.render_daemon_rct, self.render_workload_rct):
+            try:
+                rct = render(cd)
+            except ValueError:
+                continue
+            try:
+                self.rcts.delete(
+                    rct["metadata"]["name"], rct["metadata"]["namespace"]
+                )
+            except ApiNotFound:
+                pass
+
+    def finalize(self, cd: dict) -> bool:
+        """Strip finalizers from deleted RCTs; True when all are gone."""
+        gone = True
+        for render in (self.render_daemon_rct, self.render_workload_rct):
+            try:
+                want = render(cd)
+            except ValueError:
+                continue
+            cur = self.rcts.try_get(
+                want["metadata"]["name"], want["metadata"]["namespace"]
+            )
+            if cur is None:
+                continue
+            if cur["metadata"].get("deletionTimestamp"):
+                cur["metadata"]["finalizers"] = [
+                    f for f in cur["metadata"].get("finalizers", [])
+                    if f != CD_FINALIZER
+                ]
+                self.rcts.update(cur)
+                cur = self.rcts.try_get(
+                    want["metadata"]["name"], want["metadata"]["namespace"]
+                )
+            if cur is not None:
+                gone = False
+        return gone

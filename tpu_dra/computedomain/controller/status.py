@@ -1,0 +1,82 @@
+"""ComputeDomain status aggregation.
+
+Reference analog: cmd/compute-domain-controller/cdstatus.go (:135-241,
+:286-354) + computedomain.go updateGlobalStatus (:251-280): clique daemon
+registrations aggregate into ``CD.Status.Nodes``; the CD goes Ready when
+every one of ``spec.numNodes`` expected hosts has registered **and**
+reported Ready (all-or-nothing slice membership — stricter than IMEX's
+incremental join, per JAX multi-host init semantics). Stale nodes (no
+longer in any clique) are pruned.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List
+
+from tpu_dra.api import CD_STATUS_NOT_READY, CD_STATUS_READY
+from tpu_dra.computedomain import CD_LABEL_KEY
+from tpu_dra.k8sclient import COMPUTE_DOMAIN_CLIQUES, COMPUTE_DOMAINS, ResourceClient
+
+log = logging.getLogger(__name__)
+
+
+class StatusManager:
+    def __init__(self, backend):
+        self.cds = ResourceClient(backend, COMPUTE_DOMAINS)
+        self.cliques = ResourceClient(backend, COMPUTE_DOMAIN_CLIQUES)
+
+    def cliques_for(self, cd: dict) -> List[dict]:
+        return self.cliques.list(
+            namespace=cd["metadata"]["namespace"],
+            label_selector={CD_LABEL_KEY: cd["metadata"]["uid"]},
+        )
+
+    def sync(self, cd: dict) -> dict:
+        """Recompute Status.Nodes + global status from clique registrations;
+        persist when changed. Returns the updated CD."""
+        nodes: List[dict] = []
+        for clique in self.cliques_for(cd):
+            clique_id = clique["metadata"]["name"].removeprefix(
+                cd["metadata"]["uid"] + "."
+            )
+            for d in clique.get("daemons") or []:
+                nodes.append(
+                    {
+                        "name": d.get("nodeName", ""),
+                        "ipAddress": d.get("ipAddress", ""),
+                        "cliqueID": d.get("cliqueID", clique_id),
+                        "index": d.get("index", 0),
+                        "status": d.get("status", ""),
+                    }
+                )
+        nodes.sort(key=lambda n: (n["cliqueID"], n["index"]))
+        num_ready = sum(1 for n in nodes if n["status"] == CD_STATUS_READY)
+        want = cd["spec"]["numNodes"]
+        status = CD_STATUS_READY if num_ready >= want else CD_STATUS_NOT_READY
+        new_status = {"status": status, "nodes": nodes}
+        if cd.get("status") != new_status:
+            cd = self.cds.get(cd["metadata"]["name"], cd["metadata"]["namespace"])
+            cd["status"] = new_status
+            cd = self.cds.update_status(cd)
+            log.info(
+                "computedomain %s/%s status=%s (%d/%d nodes ready)",
+                cd["metadata"]["namespace"],
+                cd["metadata"]["name"],
+                status,
+                num_ready,
+                want,
+            )
+        return cd
+
+    def delete_cliques(self, cd: dict) -> bool:
+        """Delete clique objects on CD teardown; True when all gone."""
+        cliques = self.cliques_for(cd)
+        for c in cliques:
+            try:
+                self.cliques.delete(
+                    c["metadata"]["name"], c["metadata"]["namespace"]
+                )
+            except Exception:
+                pass
+        return not self.cliques_for(cd)

@@ -1,0 +1,9 @@
+"""Per-node ComputeDomain slice daemon (cmd/compute-domain-daemon).
+
+Instead of supervising ``nvidia-imex`` (main.go:44-51), the TPU daemon:
+discovers local chip/ICI topology via tpulib, registers itself into the
+ComputeDomainClique CRD with a stable index, renders the JAX/libtpu
+multi-host bootstrap config the CD kubelet plugin injects into workload
+pods, keeps /etc/hosts-style peer mappings fresh, and reports readiness
+when the slice membership is complete.
+"""

@@ -1,0 +1,108 @@
+"""JAX/libtpu multi-host bootstrap rendering.
+
+This is the heart of the TPU re-imagining: where the reference's daemon
+writes an IMEX config + nodes.cfg for the proprietary daemon
+(cmd/compute-domain-daemon/main.go:454-517), the TPU daemon renders the
+environment a JAX workload needs to run multi-host over the slice:
+
+- ``TPU_WORKER_ID``        — this host's stable index in the domain
+- ``TPU_WORKER_HOSTNAMES`` — all peers' stable DNS names, index order
+- ``TPU_ACCELERATOR_TYPE`` / ``TPU_TOPOLOGY`` — slice shape
+- ``JAX_COORDINATOR_ADDRESS`` — daemon-0's stable DNS name (the
+  distributed-init rendezvous; stability across restarts is exactly why
+  index assignment is gap-filling, cdclique.go:350-372 analog)
+- ``MEGASCALE_*`` — DCN coordinator settings for multi-slice domains
+
+The rendered file lands in the per-CD config dir the CD kubelet plugin
+mounts into workload containers (device_state.go:516-573 analog: the
+``/imexd`` mount becomes ``/tpu-cd``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from tpu_dra.computedomain.daemon.dnsnames import dns_name
+
+COORDINATOR_PORT = 8476
+MEGASCALE_PORT = 8477
+
+
+def render_bootstrap_env(
+    worker_id: int,
+    num_nodes: int,
+    accelerator_type: str,
+    topology: str,
+    peers: List[dict],
+    num_slices: int = 1,
+    slice_index: int = 0,
+) -> Dict[str, str]:
+    hostnames = ",".join(dns_name(i) for i in range(num_nodes))
+    env = {
+        "TPU_WORKER_ID": str(worker_id),
+        "TPU_WORKER_HOSTNAMES": hostnames,
+        "TPU_ACCELERATOR_TYPE": accelerator_type,
+        "TPU_TOPOLOGY": topology,
+        "JAX_COORDINATOR_ADDRESS": f"{dns_name(0)}:{COORDINATOR_PORT}",
+        "JAX_NUM_PROCESSES": str(num_nodes),
+        "JAX_PROCESS_ID": str(worker_id),
+    }
+    if num_slices > 1:
+        # Multi-slice (DCN) domains: megascale coordinator on slice 0.
+        env.update(
+            {
+                "MEGASCALE_COORDINATOR_ADDRESS": f"{dns_name(0)}:{MEGASCALE_PORT}",
+                "MEGASCALE_NUM_SLICES": str(num_slices),
+                "MEGASCALE_SLICE_ID": str(slice_index),
+            }
+        )
+    return env
+
+
+def write_bootstrap_files(
+    config_dir: str,
+    env: Dict[str, str],
+    peers: List[dict],
+) -> None:
+    """Write bootstrap.env (KEY=VALUE lines), peers.json, and hosts
+    fragments into the per-CD config dir."""
+    os.makedirs(config_dir, exist_ok=True)
+    tmp = os.path.join(config_dir, ".bootstrap.env.tmp")
+    with open(tmp, "w") as f:
+        for k, v in sorted(env.items()):
+            f.write(f"{k}={v}\n")
+    os.replace(tmp, os.path.join(config_dir, "bootstrap.env"))
+    tmp = os.path.join(config_dir, ".peers.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(
+            [
+                {
+                    "index": p.get("index", 0),
+                    "nodeName": p.get("nodeName", ""),
+                    "ipAddress": p.get("ipAddress", ""),
+                    "dnsName": dns_name(p.get("index", 0)),
+                    "status": p.get("status", ""),
+                }
+                for p in peers
+            ],
+            f,
+            indent=2,
+        )
+    os.replace(tmp, os.path.join(config_dir, "peers.json"))
+
+
+def read_bootstrap_env(config_dir: str) -> Optional[Dict[str, str]]:
+    path = os.path.join(config_dir, "bootstrap.env")
+    try:
+        with open(path) as f:
+            out = {}
+            for line in f:
+                line = line.strip()
+                if line and "=" in line:
+                    k, _, v = line.partition("=")
+                    out[k] = v
+            return out
+    except FileNotFoundError:
+        return None

@@ -1,0 +1,81 @@
+"""Stable DNS-name rendering for slice peers.
+
+Reference analog: cmd/compute-domain-daemon/dnsnames.go — maps
+``compute-domain-daemon-<index>`` names to peer IPs, rewriting /etc/hosts
+between sentinel markers (:145-190), plus a static nodes config listing all
+possible peer names up front (:191-216; rationale in
+api/.../computedomain.go:63-90 — peers can then join/leave without config
+rewrites, only the hosts mapping changes).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, List
+
+log = logging.getLogger(__name__)
+
+SENTINEL_BEGIN = "# BEGIN tpu-dra-compute-domain"
+SENTINEL_END = "# END tpu-dra-compute-domain"
+DNS_NAME_PREFIX = "compute-domain-daemon"
+
+
+def dns_name(index: int) -> str:
+    return f"{DNS_NAME_PREFIX}-{index}"
+
+
+class DNSNameManager:
+    def __init__(self, hosts_path: str = "/etc/hosts", max_nodes: int = 128):
+        self.hosts_path = hosts_path
+        self.max_nodes = max_nodes
+
+    def write_nodes_config(self, path: str) -> None:
+        """Static peer list with every possible DNS name
+        (dnsnames.go:191-216): membership changes never touch this file."""
+        with open(path, "w") as f:
+            for i in range(self.max_nodes):
+                f.write(f"{dns_name(i)}\n")
+
+    def update_hosts(self, peers: List[dict]) -> bool:
+        """Rewrite the sentinel-delimited block; True when the mapping
+        changed (the caller then pokes consumers, the SIGUSR1 analog)."""
+        mapping: Dict[str, str] = {
+            dns_name(d.get("index", 0)): d.get("ipAddress", "")
+            for d in peers
+            if d.get("ipAddress")
+        }
+        block = [SENTINEL_BEGIN]
+        for name, ip in sorted(mapping.items()):
+            block.append(f"{ip}\t{name}")
+        block.append(SENTINEL_END)
+
+        try:
+            with open(self.hosts_path) as f:
+                lines = f.read().splitlines()
+        except FileNotFoundError:
+            lines = []
+        out, skipping, had_block = [], False, False
+        old_block: List[str] = []
+        for line in lines:
+            if line.strip() == SENTINEL_BEGIN:
+                skipping, had_block = True, True
+                old_block.append(line)
+                continue
+            if line.strip() == SENTINEL_END:
+                skipping = False
+                old_block.append(line)
+                continue
+            if skipping:
+                old_block.append(line)
+                continue
+            out.append(line)
+        if had_block and old_block == block:
+            return False
+        out.extend(block)
+        tmp = self.hosts_path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write("\n".join(out) + "\n")
+        os.replace(tmp, self.hosts_path)
+        log.info("updated %s with %d peer mappings", self.hosts_path, len(mapping))
+        return True

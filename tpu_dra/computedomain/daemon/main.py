@@ -1,0 +1,193 @@
+"""Slice-daemon run loop.
+
+Reference analog: cmd/compute-domain-daemon/main.go — run (:206-339): label
+own pod, write config, register into the clique, then the update loop
+(:376-423, DNS-names mode): refresh /etc/hosts from peers, re-render
+bootstrap config on membership change, and report readiness. Readiness here
+means **complete slice membership** — all ``numNodes`` peers registered and
+the local ICI fabric healthy — probed by the ``check`` subcommand the pod's
+readiness probe execs (template :72-94 analog, replacing
+``nvidia-imex-ctl -q``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from tpu_dra.api import CD_STATUS_READY
+from tpu_dra.computedomain.daemon.bootstrap import (
+    render_bootstrap_env,
+    write_bootstrap_files,
+)
+from tpu_dra.computedomain.daemon.clique import CliqueRegistration
+from tpu_dra.computedomain.daemon.dnsnames import DNSNameManager
+from tpu_dra.infra import flags, signals
+from tpu_dra.tpulib import new_tpulib
+from tpu_dra.tpulib.types import topology_str
+
+log = logging.getLogger(__name__)
+
+READY_FILE = "ready"
+
+
+@dataclass
+class DaemonConfig:
+    cd_uid: str
+    cd_name: str
+    cd_namespace: str
+    num_nodes: int
+    node_name: str
+    pod_ip: str
+    config_dir: str = "/tpu-cd"
+    hosts_path: str = "/etc/hosts"
+    update_period: float = 2.0
+    num_slices: int = 1
+
+
+class SliceDaemon:
+    def __init__(self, config: DaemonConfig, backend, tpulib=None):
+        self.config = config
+        self.backend = backend
+        self.tpulib = tpulib or new_tpulib()
+        ici = self.tpulib.ici_domain()
+        self.clique_id = ici.clique_id() if ici else "local.0"
+        self.registration = CliqueRegistration(
+            backend,
+            cd_uid=config.cd_uid,
+            cd_namespace=config.cd_namespace,
+            clique_id=self.clique_id,
+            node_name=config.node_name,
+            ip_address=config.pod_ip,
+        )
+        self.dns = DNSNameManager(hosts_path=config.hosts_path)
+        self._stop = threading.Event()
+        self._ready = False
+
+    # --- readiness ---
+
+    def compute_ready(self, peers) -> bool:
+        """All expected hosts registered + local chips healthy (the
+        all-or-nothing slice-membership gate)."""
+        if len(peers) < self.config.num_nodes:
+            return False
+        if not all(c.healthy for c in self.tpulib.chips()):
+            return False
+        return True
+
+    def _write_ready_file(self, ready: bool) -> None:
+        path = os.path.join(self.config.config_dir, READY_FILE)
+        if ready:
+            with open(path, "w") as f:
+                f.write("ready\n")
+        else:
+            try:
+                os.remove(path)
+            except FileNotFoundError:
+                pass
+
+    # --- main loop (main.go:343-423 analog) ---
+
+    def run_once(self) -> bool:
+        """One update-loop tick; returns current readiness."""
+        index = self.registration.register()
+        peers = self.registration.peers()
+        self.dns.update_hosts(peers)
+        gen = self.tpulib.generation()
+        ici = self.tpulib.ici_domain()
+        topo = (
+            topology_str(ici.topology)
+            if ici and ici.topology != (0, 0, 0)
+            else topology_str(gen.host_extent)
+        )
+        n_chips = self.config.num_nodes * len(self.tpulib.chips())
+        env = render_bootstrap_env(
+            worker_id=index,
+            num_nodes=self.config.num_nodes,
+            accelerator_type=gen.accelerator_type(n_chips),
+            topology=topo,
+            peers=peers,
+            num_slices=self.config.num_slices,
+        )
+        write_bootstrap_files(self.config.config_dir, env, peers)
+        ready = self.compute_ready(peers)
+        if ready != self._ready:
+            log.info("readiness -> %s (%d/%d peers)", ready, len(peers),
+                     self.config.num_nodes)
+        self._ready = ready
+        self.registration.set_status(ready)
+        self._write_ready_file(ready)
+        return ready
+
+    def run(self) -> None:
+        os.makedirs(self.config.config_dir, exist_ok=True)
+        while not self._stop.is_set():
+            try:
+                self.run_once()
+            except Exception:
+                log.exception("daemon update tick failed")
+            self._stop.wait(self.config.update_period)
+        # Teardown: mark NotReady and deregister.
+        try:
+            self.registration.set_status(False)
+            self.registration.deregister()
+        except Exception:
+            log.exception("daemon deregistration failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def check(config_dir: str = "/tpu-cd") -> int:
+    """Readiness probe subcommand (the nvidia-imex-ctl -q analog,
+    main.go:427-451): exit 0 iff the daemon last reported ready."""
+    if os.path.exists(os.path.join(config_dir, READY_FILE)):
+        print("READY")
+        return 0
+    print("NOT READY")
+    return 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("tpu-compute-domain-daemon")
+    p.add_argument("command", nargs="?", default="run", choices=["run", "check"])
+    flags.KubeClientConfig.add_flags(p)
+    flags.LoggingConfig.add_flags(p)
+    p.add_argument("--cd-uid", default=flags.env_default("CD_UID", ""))
+    p.add_argument("--cd-name", default=flags.env_default("CD_NAME", ""))
+    p.add_argument("--cd-namespace", default=flags.env_default("CD_NAMESPACE", "default"))
+    p.add_argument("--num-nodes", type=int, default=flags.env_default("NUM_NODES", 1, int))
+    p.add_argument("--node-name", default=flags.env_default("NODE_NAME", ""))
+    p.add_argument("--pod-ip", default=flags.env_default("POD_IP", ""))
+    p.add_argument("--config-dir", default=flags.env_default("CD_CONFIG_DIR", "/tpu-cd"))
+    args = p.parse_args(argv)
+    flags.LoggingConfig.from_args(args).apply()
+    if args.command == "check":
+        return check(args.config_dir)
+    signals.start_debug_signal_handlers()
+    backend = flags.KubeClientConfig.from_args(args).new_client()
+    config = DaemonConfig(
+        cd_uid=args.cd_uid,
+        cd_name=args.cd_name,
+        cd_namespace=args.cd_namespace,
+        num_nodes=args.num_nodes,
+        node_name=args.node_name,
+        pod_ip=args.pod_ip,
+        config_dir=args.config_dir,
+    )
+    daemon = SliceDaemon(config, backend)
+    import signal as _sig
+
+    _sig.signal(_sig.SIGTERM, lambda *a: daemon.stop())
+    daemon.run()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

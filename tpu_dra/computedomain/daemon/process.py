@@ -1,0 +1,97 @@
+"""Child-process manager with a polling watchdog.
+
+Reference analog: cmd/compute-domain-daemon/process.go (:49-221) — start /
+stop / restart / signal a child process, SIGCHLD-free 1 s polling watchdog
+that restarts on crash, graceful SIGTERM then SIGKILL on stop.
+
+The TPU daemon has no proprietary binary to babysit, but the manager is
+used for optional pluggable helpers (e.g. an ICI link prober) and keeps the
+supervision semantics available for operators that need a sidecar process.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import subprocess
+import threading
+import time
+from typing import Callable, List, Optional
+
+log = logging.getLogger(__name__)
+
+
+class ProcessManager:
+    def __init__(
+        self,
+        argv: List[str],
+        restart_on_exit: bool = True,
+        watchdog_tick: float = 1.0,
+        on_restart: Optional[Callable[[int], None]] = None,
+    ):
+        self.argv = argv
+        self.restart_on_exit = restart_on_exit
+        self.watchdog_tick = watchdog_tick
+        self.on_restart = on_restart
+        self._proc: Optional[subprocess.Popen] = None
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._watchdog: Optional[threading.Thread] = None
+        self.restarts = 0
+
+    def ensure_started(self) -> None:
+        with self._lock:
+            if self._proc is not None and self._proc.poll() is None:
+                return
+            self._proc = subprocess.Popen(self.argv)
+            log.info("started %s (pid %d)", self.argv[0], self._proc.pid)
+        if self._watchdog is None:
+            self._watchdog = threading.Thread(
+                target=self._watch, daemon=True, name="process-watchdog"
+            )
+            self._watchdog.start()
+
+    def _watch(self) -> None:
+        """1s-tick polling watchdog (process.go:169-204)."""
+        while not self._stop.wait(self.watchdog_tick):
+            with self._lock:
+                proc = self._proc
+            if proc is None:
+                continue
+            rc = proc.poll()
+            if rc is None:
+                continue
+            if not self.restart_on_exit or self._stop.is_set():
+                continue
+            log.warning(
+                "%s exited with %d; restarting", self.argv[0], rc
+            )
+            self.restarts += 1
+            if self.on_restart is not None:
+                self.on_restart(self.restarts)
+            with self._lock:
+                self._proc = subprocess.Popen(self.argv)
+
+    def signal(self, sig: int) -> None:
+        with self._lock:
+            if self._proc is not None and self._proc.poll() is None:
+                self._proc.send_signal(sig)
+
+    def is_running(self) -> bool:
+        with self._lock:
+            return self._proc is not None and self._proc.poll() is None
+
+    def stop(self, term_timeout: float = 5.0) -> None:
+        """Graceful SIGTERM, then SIGKILL (process.go stop semantics)."""
+        self._stop.set()
+        with self._lock:
+            proc = self._proc
+        if proc is None or proc.poll() is not None:
+            return
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=term_timeout)
+        except subprocess.TimeoutExpired:
+            log.warning("%s ignored SIGTERM; killing", self.argv[0])
+            proc.kill()
+            proc.wait(timeout=5)
