@@ -1,0 +1,210 @@
+"""Benchmark: Llama training throughput on a DRA-allocated chip.
+
+Headline metric (BASELINE.md): JAX Llama tokens/sec/chip on a DRA-allocated
+slice must reach >= 95% of direct-attach. Both legs run in **separate
+subprocesses** so the DRA leg's injected claim env is in place *before* the
+JAX backend initializes (the same ordering the container runtime gives real
+workloads):
+
+1. **direct-attach**: train-step throughput with the device as-is;
+2. **DRA path**: a full driver claim lifecycle on the stub-backed kubelet
+   plugin produces the transient CDI spec; its env edits are applied to the
+   child process env, then the identical workload runs.
+
+Prints ONE json line: tokens/sec/chip via the DRA path, with
+``vs_baseline = dra / (0.95 * direct)`` — values >= 1.0 beat the reference
+target. Claim-prepare p50 latency (the reference's ``t_prep_*`` metric) is
+logged to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+import uuid
+from typing import Dict, Tuple
+
+
+def measure_claim_prepare_latency(n: int = 20) -> Tuple[float, Dict[str, str]]:
+    """(p50 seconds, last claim's injected env) for single-chip claim
+    Prepares via the plugin state machine."""
+    if n < 1:
+        raise ValueError("need at least one iteration")
+    from tpu_dra.k8sclient import FakeCluster  # noqa: F401  (stub path)
+    from tpu_dra.plugin.cdi import CDIHandler
+    from tpu_dra.plugin.checkpoint import CheckpointManager
+    from tpu_dra.plugin.device_state import DRIVER_NAME, DeviceState
+    from tpu_dra.tpulib.stub import StubTpuLib
+
+    latencies = []
+    env: Dict[str, str] = {}
+    with tempfile.TemporaryDirectory() as td:
+        state = DeviceState(
+            tpulib=StubTpuLib(
+                config={"generation": "v5e", "hostname": "bench-node"},
+                state_dir=f"{td}/tpu",
+            ),
+            cdi=CDIHandler(cdi_root=f"{td}/cdi"),
+            checkpoints=CheckpointManager(f"{td}/ckpt"),
+            node_name="bench-node",
+        )
+        for i in range(n):
+            uid = str(uuid.uuid4())
+            claim = {
+                "metadata": {"name": f"b{i}", "namespace": "default", "uid": uid},
+                "status": {
+                    "allocation": {
+                        "devices": {
+                            "results": [
+                                {
+                                    "request": "r",
+                                    "driver": DRIVER_NAME,
+                                    "pool": "bench-node",
+                                    "device": "tpu-0",
+                                }
+                            ],
+                            "config": [],
+                        }
+                    }
+                },
+            }
+            t0 = time.monotonic()
+            state.prepare(claim)
+            latencies.append(time.monotonic() - t0)
+            env = _cdi_env(state, uid)
+            state.unprepare(uid)
+    return statistics.median(latencies), env
+
+
+def _cdi_env(state, uid) -> Dict[str, str]:
+    spec = state.cdi.read_claim_spec(uid)
+    env = {}
+    for dev in spec["devices"]:
+        for e in dev["containerEdits"].get("env", []):
+            k, _, v = e.partition("=")
+            env[k] = v
+    return env
+
+
+def bench_config():
+    from tpu_dra.workloads.models.llama import LlamaConfig
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    if platform in ("tpu", "axon"):
+        # ~1B-class Llama (Llama-3.2-1B shape, bench vocab) — large enough
+        # to exercise the MXU, small enough for one v5e chip's 16 GiB.
+        return (
+            LlamaConfig(
+                vocab_size=32_768,
+                dim=2048,
+                n_layers=16,
+                n_heads=32,
+                n_kv_heads=8,
+                ffn_dim=8192,
+                remat=True,
+            ),
+            4,  # batch
+            1024,  # seq
+            20,  # steps
+        )
+    # CPU fallback: tiny but the same code path.
+    from tpu_dra.workloads.models.llama import TINY_LLAMA
+
+    return TINY_LLAMA, 2, 64, 3
+
+
+def measure_tokens_per_sec() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dra.workloads.parallel.mesh import MeshConfig
+    from tpu_dra.workloads.train import TrainConfig, Trainer
+
+    config, batch, seq, steps = bench_config()
+    n_dev = len(jax.devices())
+    trainer = Trainer(
+        config,
+        mesh_config=MeshConfig(fsdp=n_dev),
+        train_config=TrainConfig(),
+    )
+    state = trainer.init_state(batch=batch, seq=seq)
+    step = trainer.make_train_step()
+    tokens = jnp.ones((batch, seq), dtype=jnp.int32)
+    # Warmup / compile.
+    state, loss = step(state, tokens)
+    loss.block_until_ready()
+    t0 = time.monotonic()
+    for _ in range(steps):
+        state, loss = step(state, tokens)
+    loss.block_until_ready()
+    dt = time.monotonic() - t0
+    tokens_per_sec = batch * seq * steps / dt
+    return tokens_per_sec / n_dev
+
+
+def _run_leg(extra_env: Dict[str, str]) -> float:
+    """One measurement in a fresh process (env applied before jax init)."""
+    env = dict(os.environ)
+    env.update(extra_env)
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--leg"],
+        env=env,
+        capture_output=True,
+        text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        timeout=1800,
+    )
+    if out.returncode != 0:
+        sys.stderr.write(out.stderr[-2000:])
+        raise RuntimeError(f"bench leg failed (rc={out.returncode})")
+    return float(out.stdout.strip().splitlines()[-1])
+
+
+def main() -> int:
+    if "--leg" in sys.argv:
+        print(measure_tokens_per_sec())
+        return 0
+
+    prep_p50, dra_env = measure_claim_prepare_latency()
+    print(
+        f"claim prepare p50: {prep_p50 * 1000:.2f} ms; injected env keys: "
+        f"{sorted(dra_env)}",
+        file=sys.stderr,
+    )
+
+    direct = _run_leg({})
+    print(f"direct-attach: {direct:.1f} tok/s/chip", file=sys.stderr)
+
+    # The claim env mirrors what CDI injects; TPU_ACCELERATOR_TYPE from the
+    # stub would mislead the real runtime, visibility/bootstrap vars apply.
+    leg_env = {
+        k: v
+        for k, v in dra_env.items()
+        if k.startswith(("TPU_VISIBLE", "JAX_", "TPU_WORKER", "TPU_SLICE"))
+    }
+    dra = _run_leg(leg_env)
+    print(f"dra-path: {dra:.1f} tok/s/chip", file=sys.stderr)
+
+    vs_baseline = dra / (0.95 * direct)
+    print(
+        json.dumps(
+            {
+                "metric": "llama_train_tokens_per_sec_per_chip_dra",
+                "value": round(dra, 1),
+                "unit": "tok/s/chip",
+                "vs_baseline": round(vs_baseline, 4),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

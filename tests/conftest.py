@@ -7,15 +7,34 @@ driver components run against the stub tpulib backend + fake k8s cluster.
 
 import os
 
-# Must be set before any jax import anywhere in the test session.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force the CPU platform with 8 virtual devices. The environment may pin
+# JAX_PLATFORMS to the real TPU tunnel AND import jax at interpreter startup
+# (sitecustomize), so setting env vars is not enough — override the already-
+# imported config before the backend initializes (it is lazy).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
 import pytest  # noqa: E402
+
+# Build the native library once per checkout (it is not committed).
+_repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if not os.path.exists(os.path.join(_repo, "native", "build", "libtputopo.so")):
+    import subprocess
+
+    subprocess.run(
+        ["make", "-C", os.path.join(_repo, "native")],
+        check=False,
+        capture_output=True,
+    )
 
 from tpu_dra.infra import featuregates  # noqa: E402
 

@@ -1,0 +1,16 @@
+"""JAX/XLA workloads the driver schedules (and benchmarks against).
+
+This is the *payload* side of the TPU re-imagining: the reference driver's
+smoke/perf loads are CUDA binaries (nvbandwidth, nbody —
+demo/specs/imex/nvbandwidth-test-job.yaml, quickstart/gpu-test5.yaml); ours
+are JAX programs designed TPU-first:
+
+- ``models/``   — the flagship Llama-3 family (flax), bf16, GQA + RoPE +
+  SwiGLU, scan-over-layers for compile time
+- ``ops/``      — pallas TPU kernels (flash attention) with XLA fallbacks
+- ``parallel/`` — mesh construction from the driver-injected bootstrap env,
+  parameter/activation sharding rules (dp/fsdp/sp/tp), ring attention for
+  sequence parallelism over ICI
+- ``train.py``  — pjit'd training step with rematerialization
+- ``smoke.py``  — the pmap psum multi-chip smoke test (BASELINE config 2)
+"""

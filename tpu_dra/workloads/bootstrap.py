@@ -1,0 +1,66 @@
+"""Consume the driver-injected bootstrap environment.
+
+The CD kubelet plugin injects (via CDI) the env the slice daemon rendered
+(tpu_dra/computedomain/daemon/bootstrap.py): TPU_WORKER_ID,
+TPU_WORKER_HOSTNAMES, JAX_COORDINATOR_ADDRESS, JAX_NUM_PROCESSES,
+MEGASCALE_*. A workload calls :func:`initialize_from_env` first thing; on a
+single-process allocation it is a no-op.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class SliceEnv:
+    worker_id: int = 0
+    num_processes: int = 1
+    coordinator_address: str = ""
+    accelerator_type: str = ""
+    topology: str = ""
+    num_slices: int = 1
+    slice_id: int = 0
+
+    @property
+    def multi_host(self) -> bool:
+        return self.num_processes > 1
+
+
+def read_slice_env(env: Optional[dict] = None) -> SliceEnv:
+    e = env if env is not None else os.environ
+    return SliceEnv(
+        worker_id=int(e.get("TPU_WORKER_ID", e.get("JAX_PROCESS_ID", "0")) or 0),
+        num_processes=int(e.get("JAX_NUM_PROCESSES", "1") or 1),
+        coordinator_address=e.get("JAX_COORDINATOR_ADDRESS", ""),
+        accelerator_type=e.get("TPU_ACCELERATOR_TYPE", ""),
+        topology=e.get("TPU_TOPOLOGY", ""),
+        num_slices=int(e.get("MEGASCALE_NUM_SLICES", "1") or 1),
+        slice_id=int(e.get("MEGASCALE_SLICE_ID", "0") or 0),
+    )
+
+
+def initialize_from_env(env: Optional[dict] = None) -> SliceEnv:
+    """jax.distributed.initialize from the injected bootstrap env (no-op on
+    single-host allocations)."""
+    se = read_slice_env(env)
+    if se.multi_host and se.coordinator_address:
+        import jax
+
+        log.info(
+            "initializing jax.distributed: process %d/%d via %s",
+            se.worker_id,
+            se.num_processes,
+            se.coordinator_address,
+        )
+        jax.distributed.initialize(
+            coordinator_address=se.coordinator_address,
+            num_processes=se.num_processes,
+            process_id=se.worker_id,
+        )
+    return se

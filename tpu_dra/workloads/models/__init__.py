@@ -1,0 +1,8 @@
+"""Model families the driver's benchmark/smoke workloads run."""
+
+from tpu_dra.workloads.models.llama import (  # noqa: F401
+    LLAMA3_8B,
+    TINY_LLAMA,
+    Llama,
+    LlamaConfig,
+)

@@ -1,0 +1,255 @@
+"""Llama-3 family (flax) — the flagship benchmark workload.
+
+TPU-first choices:
+
+- bf16 everywhere on the forward path (MXU-native), fp32 for softmax,
+  RMSNorm statistics, and the final logits;
+- GQA (grouped-query attention), RoPE, SwiGLU — the Llama-3 architecture;
+- ``scan_layers`` runs the decoder stack under ``nn.scan`` so XLA traces
+  ONE layer (compile time + code cache stay flat as depth grows), with
+  per-layer remat (``nn.remat``) trading FLOPs for HBM;
+- no data-dependent Python control flow anywhere under jit; static shapes
+  only;
+- attention dispatches to the pallas flash kernel on TPU and the XLA
+  reference elsewhere (tpu_dra/workloads/ops/attention.py), or to ring
+  attention when sequence parallelism is active
+  (tpu_dra/workloads/parallel/ring_attention.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tpu_dra.workloads.ops.attention import attention
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128_256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14_336
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.bfloat16
+    scan_layers: bool = True
+    remat: bool = True
+    attention_impl: str = "auto"  # auto | pallas | xla | ring
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+LLAMA3_8B = LlamaConfig()
+
+# Hardware-free test/dryrun config.
+TINY_LLAMA = LlamaConfig(
+    vocab_size=256,
+    dim=64,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    ffn_dim=128,
+    rope_theta=10_000.0,
+    scan_layers=True,
+    remat=False,
+)
+
+
+def rope_frequencies(config: LlamaConfig, positions: jnp.ndarray) -> tuple:
+    """cos/sin tables for rotary embeddings; positions [b, s] or [s]."""
+    hd = config.head_dim
+    inv_freq = 1.0 / (
+        config.rope_theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd)
+    )
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., hd/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """x: [b, s, h, hd]; cos/sin: [b, s, hd/2] or [s, hd/2]."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    if cos.ndim == 2:  # [s, hd/2] -> [1, s, 1, hd/2]
+        cos, sin = cos[None, :, None, :], sin[None, :, None, :]
+    elif cos.ndim == 3:  # [b, s, hd/2] -> [b, s, 1, hd/2]
+        cos, sin = cos[:, :, None, :], sin[:, :, None, :]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    param_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        scale = self.param(
+            "scale", nn.initializers.ones, (x.shape[-1],), self.param_dtype
+        )
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        normed = x32 * jax.lax.rsqrt(var + self.eps)
+        return (normed * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+class LlamaAttention(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, cos, sin) -> jnp.ndarray:
+        c = self.config
+        dense = lambda feats, name: nn.Dense(  # noqa: E731
+            feats,
+            use_bias=False,
+            dtype=c.dtype,
+            param_dtype=c.param_dtype,
+            kernel_init=nn.initializers.normal(0.02),
+            name=name,
+        )
+        b, s, _ = x.shape
+        q = dense(c.n_heads * c.head_dim, "wq")(x)
+        k = dense(c.n_kv_heads * c.head_dim, "wk")(x)
+        v = dense(c.n_kv_heads * c.head_dim, "wv")(x)
+        q = q.reshape(b, s, c.n_heads, c.head_dim)
+        k = k.reshape(b, s, c.n_kv_heads, c.head_dim)
+        v = v.reshape(b, s, c.n_kv_heads, c.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        if c.attention_impl == "ring":
+            from tpu_dra.workloads.parallel.ring_attention import (
+                ring_attention,
+            )
+
+            out = ring_attention(q, k, v)
+        else:
+            out = attention(q, k, v, causal=True, impl=c.attention_impl)
+        out = out.reshape(b, s, c.n_heads * c.head_dim)
+        return dense(c.dim, "wo")(out)
+
+
+class LlamaMLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        c = self.config
+        dense = lambda feats, name: nn.Dense(  # noqa: E731
+            feats,
+            use_bias=False,
+            dtype=c.dtype,
+            param_dtype=c.param_dtype,
+            kernel_init=nn.initializers.normal(0.02),
+            name=name,
+        )
+        gate = dense(c.ffn_dim, "w_gate")(x)
+        up = dense(c.ffn_dim, "w_up")(x)
+        return dense(c.dim, "w_down")(nn.silu(gate) * up)
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, cos, sin) -> jnp.ndarray:
+        c = self.config
+        x = x + LlamaAttention(c, name="attention")(
+            RMSNorm(c.norm_eps, c.param_dtype, name="attention_norm")(x), cos, sin
+        )
+        x = x + LlamaMLP(c, name="mlp")(
+            RMSNorm(c.norm_eps, c.param_dtype, name="mlp_norm")(x)
+        )
+        return x
+
+
+class _ScannedBlock(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, cos, sin):
+        return LlamaBlock(self.config, name="block")(x, cos, sin), None
+
+
+class Llama(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
+        """tokens [b, s] int32 -> logits [b, s, vocab] (fp32)."""
+        c = self.config
+        embed = nn.Embed(
+            c.vocab_size,
+            c.dim,
+            dtype=c.dtype,
+            param_dtype=c.param_dtype,
+            embedding_init=nn.initializers.normal(0.02),
+            name="embed",
+        )
+        x = embed(tokens)
+        positions = jnp.arange(tokens.shape[1])
+        cos, sin = rope_frequencies(c, positions)
+
+        if c.scan_layers:
+            block = _ScannedBlock
+            if c.remat:
+                block = nn.remat(
+                    block,
+                    prevent_cse=False,
+                    policy=jax.checkpoint_policies.nothing_saveable,
+                )
+            x, _ = nn.scan(
+                block,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=c.n_layers,
+                in_axes=(nn.broadcast, nn.broadcast),
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(c, name="layers")(x, cos, sin)
+        else:
+            for i in range(c.n_layers):
+                blk = LlamaBlock(c, name=f"layer_{i}")
+                if c.remat:
+                    blk = nn.remat(blk)
+                x = blk(x, cos, sin)
+
+        x = RMSNorm(c.norm_eps, c.param_dtype, name="final_norm")(x)
+        logits = nn.Dense(
+            c.vocab_size,
+            use_bias=False,
+            dtype=c.dtype,
+            param_dtype=c.param_dtype,
+            kernel_init=nn.initializers.normal(0.02),
+            name="lm_head",
+        )(x)
+        return logits.astype(jnp.float32)
+
+    def init_params(self, rng, batch: int = 1, seq: int = 8):
+        tokens = jnp.zeros((batch, seq), dtype=jnp.int32)
+        return self.init(rng, tokens)["params"]
+
+
+def num_params(config: LlamaConfig) -> int:
+    c = config
+    per_layer = (
+        c.dim * c.n_heads * c.head_dim  # wq
+        + 2 * c.dim * c.n_kv_heads * c.head_dim  # wk, wv
+        + c.n_heads * c.head_dim * c.dim  # wo
+        + 3 * c.dim * c.ffn_dim  # gate, up, down
+        + 2 * c.dim  # norms
+    )
+    return (
+        c.vocab_size * c.dim  # embed
+        + c.n_layers * per_layer
+        + c.dim  # final norm
+        + c.dim * c.vocab_size  # lm head
+    )

@@ -1,0 +1,1 @@
+"""TPU kernels (pallas) and their XLA fallbacks."""

@@ -1,0 +1,1 @@
+"""Parallelism: mesh construction, sharding rules, ring attention."""

@@ -1,0 +1,23 @@
+"""Global mesh context.
+
+The active :class:`jax.sharding.Mesh` is process-global state (one mesh per
+training job); ring attention and other shard_map-based ops look it up here
+instead of threading it through every model module.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from jax.sharding import Mesh
+
+_mesh: Optional[Mesh] = None
+
+
+def set_global_mesh(mesh: Optional[Mesh]) -> None:
+    global _mesh
+    _mesh = mesh
+
+
+def get_global_mesh() -> Optional[Mesh]:
+    return _mesh

@@ -1,0 +1,129 @@
+"""Ring attention: sequence/context parallelism over the ICI ring.
+
+Long-context design (first-class per the framework goals): the sequence is
+sharded over the ``sp`` mesh axis; each device holds one query chunk and
+rotates the K/V chunks around the ring with ``lax.ppermute`` (XLA lowers
+this to ICI neighbor exchanges), merging partial attention results with the
+flash-style log-sum-exp accumulator. Memory per device is O(seq/sp), and
+the K/V transfer overlaps with the attention compute of the previous chunk
+(XLA schedules the ppermute asynchronously).
+
+Causality: device ``i`` attends chunk ``j`` fully when ``j < i``, causally
+when ``j == i``, and not at all when ``j > i`` — masked via NEG_INF so the
+accumulator never sees those contributions. (The skipped work could be
+load-balanced with a zig-zag chunk layout; kept simple for now.)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from tpu_dra.workloads.ops.attention import NEG_INF, _repeat_kv
+from tpu_dra.workloads.parallel.context import get_global_mesh
+
+AXIS = "sp"
+
+
+def _partial_attention(q, k, v, mode, m, l, acc):
+    """One chunk pair; mode: 0=full, 1=causal-diagonal, 2=skip.
+
+    q: [b, sq, h, hd]; k/v: [b, sk, h, hd]; m/l: [b, h, sq]; acc like q
+    but fp32. Returns merged (m, l, acc).
+    """
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    sq, sk = q.shape[1], k.shape[1]
+    causal_mask = jnp.arange(sk)[None, :] <= jnp.arange(sq)[:, None]
+    mask = jax.lax.switch(
+        mode,
+        [
+            lambda: jnp.ones((sq, sk), dtype=bool),
+            lambda: causal_mask,
+            lambda: jnp.zeros((sq, sk), dtype=bool),
+        ],
+    )
+    s = jnp.where(mask[None, None], s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    pv = jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    acc_new = acc * alpha.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, acc_new
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, vary_axes: tuple):
+    """Body running per-device under shard_map; q/k/v are local chunks."""
+    n = jax.lax.psum(1, axis_name)
+    i = jax.lax.axis_index(axis_name)
+    b, sq, h, hd = q.shape
+
+    # Mark the accumulators device-varying so the fori_loop carry types are
+    # consistent with the (varying) K/V they merge with under shard_map.
+    vary = lambda x: jax.lax.pcast(x, vary_axes, to="varying")  # noqa: E731
+    m0 = vary(jnp.full((b, h, sq), NEG_INF, dtype=jnp.float32))
+    l0 = vary(jnp.zeros((b, h, sq), dtype=jnp.float32))
+    acc0 = vary(jnp.zeros((b, sq, h, hd), dtype=jnp.float32))
+
+    def body(t, carry):
+        k_cur, v_cur, m, l, acc = carry
+        j = (i - t) % n  # chunk id currently held
+        mode = jnp.where(j < i, 0, jnp.where(j == i, 1, 2))
+        m, l, acc = _partial_attention(q, k_cur, v_cur, mode, m, l, acc)
+        # Rotate K/V to the next device; after this, we hold chunk (j-1)%n.
+        perm = [(s, (s + 1) % n) for s in range(n)]
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return k_next, v_next, m, l, acc
+
+    _, _, m, l, acc = jax.lax.fori_loop(0, n, body, (k, v, m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str = AXIS,
+    mesh=None,
+) -> jnp.ndarray:
+    """Causal ring attention; q [b, s, h, hd] with s sharded over ``sp``.
+
+    Falls back to single-device attention when no mesh is active or the
+    ``sp`` axis is trivial.
+    """
+    mesh = mesh or get_global_mesh()
+    n_rep = q.shape[2] // k.shape[2]
+    if mesh is None or axis_name not in mesh.shape or mesh.shape[axis_name] == 1:
+        from tpu_dra.workloads.ops.attention import attention
+
+        return attention(q, k, v, causal=True)
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    # Batch shards over whichever data axes this mesh actually has; the
+    # function works on any mesh carrying ``axis_name``.
+    batch_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.shape)
+    spec = P(batch_axes or None, axis_name, None, None)
+    fn = shard_map(
+        functools.partial(
+            _ring_attention_local,
+            axis_name=axis_name,
+            vary_axes=batch_axes + (axis_name,),
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

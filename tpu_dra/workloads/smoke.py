@@ -1,0 +1,46 @@
+"""Multi-chip smoke tests (BASELINE config 2: JAX pmap psum on a 4-chip
+v5e ResourceClaim — the quickstart workload analog of the reference's
+nvbandwidth/nbody pass-fail loads)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def pmap_psum_smoke(n_devices: int = 0) -> dict:
+    """All-reduce across every visible chip; returns a pass/fail report."""
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    devices = devices[:n]
+
+    import functools
+
+    @functools.partial(jax.pmap, axis_name="i", devices=devices)
+    def allreduce(x):
+        return jax.lax.psum(x, axis_name="i")
+
+    x = jnp.arange(n, dtype=jnp.float32)
+    out = allreduce(x.reshape(n, 1))
+    expected = float(x.sum())
+    ok = bool(jnp.all(out == expected))
+    return {
+        "ok": ok,
+        "devices": n,
+        "platform": devices[0].platform,
+        "expected": expected,
+        "got": float(out[0, 0]),
+    }
+
+
+def matmul_smoke(size: int = 1024) -> dict:
+    """One MXU-sized matmul sanity check on the first chip."""
+    x = jnp.ones((size, size), dtype=jnp.bfloat16)
+    y = (x @ x).block_until_ready()
+    ok = bool(jnp.allclose(y[0, 0], size, rtol=1e-2))
+    return {"ok": ok, "size": size, "value": float(y[0, 0])}
+
+
+if __name__ == "__main__":
+    print(pmap_psum_smoke())
+    print(matmul_smoke())
