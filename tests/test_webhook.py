@@ -1,0 +1,388 @@
+"""Validating admission webhook tests.
+
+Modeled on cmd/webhook/main_test.go (reference, 520 LoC): full
+admission-review round-trips through a live HTTP server, valid and invalid
+opaque configs, ResourceClaim and ResourceClaimTemplate GVRs across
+resource.k8s.io v1beta1/v1beta2/v1, content-type and malformed-body errors.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_dra.api.serde import encode
+from tpu_dra.api.configs import (
+    ComputeDomainChannelConfig,
+    TpuConfig,
+)
+from tpu_dra.api.sharing import (
+    MULTIPLEXING_STRATEGY,
+    TIME_SLICING_STRATEGY,
+    MultiplexingConfig,
+    TimeSlicingConfig,
+    TpuSharing,
+)
+from tpu_dra.infra import featuregates as fg
+from tpu_dra.webhook.server import (
+    CD_DRIVER_NAME,
+    DRIVER_NAME,
+    admit_resource_claim_parameters,
+    handle_admission_request,
+    make_server,
+)
+
+CD_UID = "8d7d6d3e-1111-4222-8333-444455556666"
+
+
+def gates(**kwargs):
+    g = fg.FeatureGates()
+    for k, v in kwargs.items():
+        g.set(k, v)
+    fg.reset_for_tests(g)
+
+
+# --- AdmissionReview builders (main_test.go helper analogs) -----------------
+
+
+def opaque_config(obj, driver=DRIVER_NAME) -> dict:
+    return {"opaque": {"driver": driver, "parameters": json.loads(encode(obj))}}
+
+
+def claim_with_configs(version: str, *configs) -> tuple[dict, dict]:
+    resource = {
+        "group": "resource.k8s.io",
+        "version": version,
+        "resource": "resourceclaims",
+    }
+    obj = {
+        "apiVersion": f"resource.k8s.io/{version}",
+        "kind": "ResourceClaim",
+        "spec": {"devices": {"config": list(configs)}},
+    }
+    return resource, obj
+
+
+def template_with_configs(version: str, *configs) -> tuple[dict, dict]:
+    resource = {
+        "group": "resource.k8s.io",
+        "version": version,
+        "resource": "resourceclaimtemplates",
+    }
+    obj = {
+        "apiVersion": f"resource.k8s.io/{version}",
+        "kind": "ResourceClaimTemplate",
+        "spec": {"spec": {"devices": {"config": list(configs)}}},
+    }
+    return resource, obj
+
+
+def admission_review(resource: dict, obj: dict, uid="test-uid-123") -> dict:
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {"uid": uid, "resource": resource, "object": obj},
+    }
+
+
+def valid_tpu_config() -> TpuConfig:
+    return TpuConfig(
+        sharing=TpuSharing(
+            strategy=TIME_SLICING_STRATEGY,
+            time_slicing_config=TimeSlicingConfig(interval="Default"),
+        )
+    )
+
+
+def invalid_interval_config() -> TpuConfig:
+    return TpuConfig(
+        sharing=TpuSharing(
+            strategy=TIME_SLICING_STRATEGY,
+            time_slicing_config=TimeSlicingConfig(interval="Invalid Interval"),
+        )
+    )
+
+
+def invalid_multiplexing_config() -> TpuConfig:
+    return TpuConfig(
+        sharing=TpuSharing(
+            strategy=MULTIPLEXING_STRATEGY,
+            multiplexing_config=MultiplexingConfig(
+                default_compute_share_percentage=-1
+            ),
+        )
+    )
+
+
+# --- Live-server fixture ----------------------------------------------------
+
+
+@pytest.fixture()
+def webhook_url():
+    server = make_server(0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        yield f"http://127.0.0.1:{server.server_address[1]}"
+    finally:
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=5)
+
+
+def post(url, body: bytes, content_type="application/json"):
+    req = urllib.request.Request(
+        url + "/validate-resource-claim-parameters",
+        data=body,
+        headers={"Content-Type": content_type},
+        method="POST",
+    )
+    try:
+        resp = urllib.request.urlopen(req)
+        return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+# --- HTTP-level behavior (TestReadyEndpoint + serve()) ----------------------
+
+
+def test_readyz(webhook_url):
+    with urllib.request.urlopen(webhook_url + "/readyz") as resp:
+        assert resp.status == 200
+        assert resp.read() == b"ok"
+
+
+def test_unknown_path_404(webhook_url):
+    req = urllib.request.Request(
+        webhook_url + "/nope", data=b"{}", method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req)
+    assert ei.value.code == 404
+
+
+def test_bad_content_type(webhook_url):
+    status, _ = post(webhook_url, b"{}", content_type="invalid type")
+    assert status == 415
+
+
+def test_invalid_admission_review(webhook_url):
+    status, _ = post(webhook_url, json.dumps({}).encode())
+    assert status == 400
+
+
+def test_malformed_json(webhook_url):
+    status, _ = post(webhook_url, b"{not json")
+    assert status == 400
+
+
+def test_missing_request_field():
+    body = json.dumps(
+        {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview"}
+    ).encode()
+    status, _, _ = handle_admission_request(body, "application/json")
+    assert status == 400
+
+
+def test_wrong_gvk_rejected():
+    body = json.dumps(
+        {"apiVersion": "admission.k8s.io/v1beta1", "kind": "AdmissionReview",
+         "request": {"uid": "u"}}
+    ).encode()
+    status, _, _ = handle_admission_request(body, "application/json")
+    assert status == 400
+
+
+# --- Admission verdicts through the live server -----------------------------
+
+
+def roundtrip(webhook_url, review: dict):
+    status, body = post(webhook_url, json.dumps(review).encode())
+    assert status == 200
+    out = json.loads(body)
+    assert out["apiVersion"] == "admission.k8s.io/v1"
+    assert out["kind"] == "AdmissionReview"
+    assert out["response"]["uid"] == review["request"]["uid"]
+    return out["response"]
+
+
+@pytest.mark.parametrize("version", ["v1beta1", "v1beta2", "v1"])
+def test_valid_config_in_resource_claim(webhook_url, version):
+    gates(TimeSlicingSettings=True)
+    resource, obj = claim_with_configs(
+        version, opaque_config(valid_tpu_config())
+    )
+    resp = roundtrip(webhook_url, admission_review(resource, obj))
+    assert resp.get("allowed") is True
+
+
+@pytest.mark.parametrize("version", ["v1beta1", "v1beta2", "v1"])
+def test_valid_config_in_resource_claim_template(webhook_url, version):
+    gates(TimeSlicingSettings=True)
+    resource, obj = template_with_configs(
+        version, opaque_config(valid_tpu_config())
+    )
+    resp = roundtrip(webhook_url, admission_review(resource, obj))
+    assert resp.get("allowed") is True
+
+
+def test_invalid_configs_in_resource_claim(webhook_url):
+    gates(TimeSlicingSettings=True, MultiplexingSupport=True)
+    resource, obj = claim_with_configs(
+        "v1beta1",
+        opaque_config(invalid_interval_config()),
+        opaque_config(invalid_multiplexing_config()),
+    )
+    resp = roundtrip(webhook_url, admission_review(resource, obj))
+    assert resp.get("allowed") is not True
+    msg = resp["status"]["message"]
+    assert msg.startswith("2 configs failed to validate:")
+    assert "spec.devices.config[0].opaque.parameters" in msg
+    assert "spec.devices.config[1].opaque.parameters" in msg
+
+
+def test_invalid_configs_in_resource_claim_template(webhook_url):
+    gates(TimeSlicingSettings=True, MultiplexingSupport=True)
+    resource, obj = template_with_configs(
+        "v1beta1",
+        opaque_config(invalid_interval_config()),
+        opaque_config(invalid_multiplexing_config()),
+    )
+    resp = roundtrip(webhook_url, admission_review(resource, obj))
+    assert resp.get("allowed") is not True
+    msg = resp["status"]["message"]
+    # field path reflects the template's nested spec (specPath="spec.spec")
+    assert "spec.spec.devices.config[0].opaque.parameters" in msg
+    assert "spec.spec.devices.config[1].opaque.parameters" in msg
+
+
+def test_unsupported_resource_rejected(webhook_url):
+    resource = {"group": "apps", "version": "v1", "resource": "deployments"}
+    resp = roundtrip(webhook_url, admission_review(resource, {"spec": {}}))
+    assert resp.get("allowed") is not True
+    assert resp["status"]["reason"] == "BadRequest"
+
+
+# --- Unit-level admit behavior ---------------------------------------------
+
+
+def test_foreign_driver_config_skipped():
+    # Another driver's opaque config must not be decoded or validated.
+    resource, obj = claim_with_configs(
+        "v1beta1",
+        {"opaque": {"driver": "gpu.example.com", "parameters": {"bogus": 1}}},
+    )
+    resp = admit_resource_claim_parameters(admission_review(resource, obj))
+    assert resp.get("allowed") is True
+
+
+def test_unknown_fields_rejected_strictly():
+    resource, obj = claim_with_configs("v1beta1", opaque_config(TpuConfig()))
+    obj["spec"]["devices"]["config"][0]["opaque"]["parameters"]["bogus"] = 1
+    resp = admit_resource_claim_parameters(admission_review(resource, obj))
+    assert resp.get("allowed") is not True
+    assert "error decoding object" in resp["status"]["message"]
+    assert "bogus" in resp["status"]["message"]
+
+
+def test_unregistered_kind_rejected():
+    resource, obj = claim_with_configs(
+        "v1beta1",
+        {
+            "opaque": {
+                "driver": DRIVER_NAME,
+                "parameters": {
+                    "apiVersion": "resource.tpu.google.com/v1beta1",
+                    "kind": "NoSuchKind",
+                },
+            }
+        },
+    )
+    resp = admit_resource_claim_parameters(admission_review(resource, obj))
+    assert resp.get("allowed") is not True
+    assert "error decoding object" in resp["status"]["message"]
+
+
+def test_missing_parameters_rejected():
+    resource, obj = claim_with_configs(
+        "v1beta1", {"opaque": {"driver": DRIVER_NAME}}
+    )
+    resp = admit_resource_claim_parameters(admission_review(resource, obj))
+    assert resp.get("allowed") is not True
+    assert "missing parameters" in resp["status"]["message"]
+
+
+def test_compute_domain_channel_config_validated():
+    # CD configs carry the compute-domain driver name; they are validated too
+    # (improvement over the reference, which filters them out).
+    bad = ComputeDomainChannelConfig(domain_id="not-a-uuid")
+    resource, obj = claim_with_configs(
+        "v1beta1", opaque_config(bad, driver=CD_DRIVER_NAME)
+    )
+    resp = admit_resource_claim_parameters(admission_review(resource, obj))
+    assert resp.get("allowed") is not True
+    assert "domainID must be a UUID" in resp["status"]["message"]
+
+    good = ComputeDomainChannelConfig(domain_id=CD_UID, allocation_mode="All")
+    resource, obj = claim_with_configs(
+        "v1beta1", opaque_config(good, driver=CD_DRIVER_NAME)
+    )
+    resp = admit_resource_claim_parameters(admission_review(resource, obj))
+    assert resp.get("allowed") is True
+
+
+def test_no_configs_allowed():
+    resource, obj = claim_with_configs("v1beta1")
+    resp = admit_resource_claim_parameters(admission_review(resource, obj))
+    assert resp.get("allowed") is True
+
+
+@pytest.mark.parametrize(
+    "mutate",
+    [
+        lambda rev: rev["request"].__setitem__("resource", "not-a-dict"),
+        lambda rev: rev["request"].__setitem__("object", {"spec": []}),
+        lambda rev: rev["request"].__setitem__(
+            "object", {"spec": {"devices": "nope"}}
+        ),
+    ],
+)
+def test_structurally_malformed_objects_denied_not_crashed(webhook_url, mutate):
+    # Valid JSON with wrong shapes must come back as a structured deny, not a
+    # dropped connection (failurePolicy=Ignore would fail open otherwise).
+    resource, obj = claim_with_configs(
+        "v1", {"opaque": {"driver": DRIVER_NAME, "parameters": {}}}
+    )
+    review = admission_review(resource, obj)
+    mutate(review)
+    resp = roundtrip(webhook_url, review)
+    assert resp.get("allowed") is not True
+
+
+def test_non_object_opaque_skipped_not_crashed(webhook_url):
+    # opaque as a non-object can't name our driver; it is skipped (the
+    # apiserver's own schema validation rejects it) rather than crashing.
+    resource, obj = claim_with_configs("v1", {"opaque": "x"})
+    resp = roundtrip(webhook_url, admission_review(resource, obj))
+    assert resp.get("allowed") is True
+
+
+def test_gated_strategy_denied_when_gate_off():
+    # Multiplexing strategy without the MultiplexingSupport gate must fail
+    # validation at admission time (sharing.go validation parity).
+    gates(MultiplexingSupport=False)
+    cfg = TpuConfig(
+        sharing=TpuSharing(
+            strategy=MULTIPLEXING_STRATEGY,
+            multiplexing_config=MultiplexingConfig(),
+        )
+    )
+    resource, obj = claim_with_configs("v1beta1", opaque_config(cfg))
+    resp = admit_resource_claim_parameters(admission_review(resource, obj))
+    assert resp.get("allowed") is not True

@@ -1,0 +1,19 @@
+"""Validating admission webhook for opaque TPU device configs.
+
+Reference analog: cmd/webhook/ — a TLS HTTP server that validates the opaque
+config parameters embedded in ResourceClaims and ResourceClaimTemplates at
+admission time (main.go:112-124, resource.go:82-160), complementing the CEL
+ValidatingAdmissionPolicy shipped in the Helm chart.
+"""
+
+from tpu_dra.webhook.server import (
+    admit_resource_claim_parameters,
+    handle_admission_request,
+    make_server,
+)
+
+__all__ = [
+    "admit_resource_claim_parameters",
+    "handle_admission_request",
+    "make_server",
+]

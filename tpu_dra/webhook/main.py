@@ -1,0 +1,77 @@
+"""Validating-webhook entrypoint.
+
+Reference analog: cmd/webhook/main.go:43-110 — CLI flags for TLS cert/key and
+port plus logging + feature-gate flags, then a blocking HTTPS server.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from tpu_dra.infra import flags
+from tpu_dra.webhook.server import make_server
+
+log = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "tpu-dra-webhook",
+        description=(
+            "webhook implements a validating admission webhook complementing "
+            "a DRA driver plugin."
+        ),
+    )
+    flags.LoggingConfig.add_flags(p)
+    flags.add_feature_gate_flag(p)
+    p.add_argument(
+        "--tls-cert-file",
+        default=flags.env_default("TLS_CERT_FILE"),
+        help=(
+            "File containing the default x509 Certificate for HTTPS "
+            "(CA cert, if any, concatenated after server cert). "
+            "Plain HTTP when unset (tests only)."
+        ),
+    )
+    p.add_argument(
+        "--tls-private-key-file",
+        default=flags.env_default("TLS_PRIVATE_KEY_FILE"),
+        help="File containing the x509 private key matching --tls-cert-file",
+    )
+    p.add_argument(
+        "--port",
+        type=int,
+        default=flags.env_default("WEBHOOK_PORT", 443, int),
+        help="Secure port that the webhook listens on",
+    )
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    flags.LoggingConfig.from_args(args).apply()
+    flags.apply_feature_gates(args)
+    flags.log_startup_config(args)
+
+    if bool(args.tls_cert_file) != bool(args.tls_private_key_file):
+        log.error("--tls-cert-file and --tls-private-key-file must be set together")
+        return 1
+
+    server = make_server(
+        args.port,
+        cert_file=args.tls_cert_file,
+        key_file=args.tls_private_key_file,
+    )
+    log.info("starting webhook server on :%d", args.port)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,276 @@
+"""Admission-review handling for the validating webhook.
+
+Reference analog: cmd/webhook/main.go (serve :130-198, readAdmissionReview
+:200-221, admitResourceClaimParameters :223-305) and cmd/webhook/resource.go
+(GVR tables + claim/template extraction :33-160).
+
+Differences from the reference, on purpose:
+
+- The reference only inspects configs whose opaque driver is
+  ``gpu.nvidia.com`` even though it can decode the ComputeDomain kinds; here
+  both driver names (``tpu.google.com`` and ``compute-domain.tpu.google.com``)
+  are validated, so controller-generated channel/daemon claim templates get
+  admission coverage too.
+- Claims/templates arrive as plain JSON objects; the
+  ``resource.k8s.io/{v1beta1,v1beta2,v1}`` variants share the
+  ``spec.devices.config`` path, so no scheme conversion step is needed.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import ssl
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+from tpu_dra.api import serde
+from tpu_dra.api.configs import (
+    ComputeDomainChannelConfig,
+    ComputeDomainDaemonConfig,
+    TpuConfig,
+    TpuSubsliceConfig,
+    VfioDeviceConfig,
+)
+from tpu_dra.api.errors import ApiError, DecodeError
+from tpu_dra.version import CD_DRIVER_NAME, DRIVER_NAME
+
+log = logging.getLogger(__name__)
+
+VALIDATED_DRIVERS = (DRIVER_NAME, CD_DRIVER_NAME)
+
+ADMISSION_API_VERSION = "admission.k8s.io/v1"
+
+# Recognized config types (admitResourceClaimParameters' switch,
+# main.go:260-272) — anything else registered in the scheme is rejected.
+RECOGNIZED_CONFIG_TYPES = (
+    TpuConfig,
+    TpuSubsliceConfig,
+    VfioDeviceConfig,
+    ComputeDomainChannelConfig,
+    ComputeDomainDaemonConfig,
+)
+
+RESOURCE_GROUP = "resource.k8s.io"
+SUPPORTED_VERSIONS = ("v1", "v1beta1", "v1beta2")
+
+CLAIM_RESOURCES = {
+    (RESOURCE_GROUP, v, "resourceclaims") for v in SUPPORTED_VERSIONS
+}
+TEMPLATE_RESOURCES = {
+    (RESOURCE_GROUP, v, "resourceclaimtemplates") for v in SUPPORTED_VERSIONS
+}
+
+
+def _gvr(resource: Any) -> Tuple[str, str, str]:
+    if not isinstance(resource, dict):
+        resource = {}
+    return (
+        resource.get("group", ""),
+        resource.get("version", ""),
+        resource.get("resource", ""),
+    )
+
+
+def _bad_request(msg: str) -> Dict[str, Any]:
+    return {
+        "allowed": False,
+        "status": {"message": msg, "reason": "BadRequest"},
+    }
+
+
+def _invalid(msg: str) -> Dict[str, Any]:
+    return {
+        "allowed": False,
+        "status": {"message": msg, "reason": "Invalid"},
+    }
+
+
+def _device_configs(
+    review: Dict[str, Any]
+) -> Tuple[Optional[List[dict]], str, Optional[Dict[str, Any]]]:
+    """Extract spec.devices.config from the admitted object.
+
+    Returns (configs, specPath, error_response). Mirrors the claim/template
+    switch in admitResourceClaimParameters (main.go:226-257).
+    """
+    request = review.get("request") or {}
+    gvr = _gvr(request.get("resource"))
+    obj = request.get("object")
+    if not isinstance(obj, dict):
+        return None, "", _bad_request("request object is missing or not an object")
+
+    if gvr in CLAIM_RESOURCES:
+        spec = obj.get("spec")
+        spec_path = "spec"
+    elif gvr in TEMPLATE_RESOURCES:
+        outer = obj.get("spec")
+        spec = outer.get("spec") if isinstance(outer, dict) else None
+        spec_path = "spec.spec"
+    else:
+        return None, "", _bad_request(
+            "expected resource to be one of the supported versions for "
+            f"resourceclaims or resourceclaimtemplates, got {gvr}"
+        )
+
+    if not isinstance(spec, dict):
+        return None, "", _bad_request(f"{spec_path} is missing or not an object")
+    devices = spec.get("devices")
+    if devices is None:
+        return [], spec_path, None
+    if not isinstance(devices, dict):
+        return None, "", _bad_request(f"{spec_path}.devices is not an object")
+    configs = devices.get("config") or []
+    if not isinstance(configs, list):
+        return None, "", _bad_request(f"{spec_path}.devices.config is not a list")
+    return configs, spec_path, None
+
+
+def admit_resource_claim_parameters(review: Dict[str, Any]) -> Dict[str, Any]:
+    """Validate every opaque config for our drivers; deny with an aggregated
+    message on the first pass through all of them
+    (admitResourceClaimParameters, main.go:223-305)."""
+    configs, spec_path, err_resp = _device_configs(review)
+    if err_resp is not None:
+        return err_resp
+
+    errs: List[str] = []
+    for i, config in enumerate(configs):
+        opaque = config.get("opaque") if isinstance(config, dict) else None
+        if not isinstance(opaque, dict) or opaque.get("driver") not in VALIDATED_DRIVERS:
+            continue
+        field_path = f"{spec_path}.devices.config[{i}].opaque.parameters"
+        params = opaque.get("parameters")
+        if params is None:
+            errs.append(f"object at {field_path} is missing parameters")
+            continue
+        try:
+            decoded = serde.strict_decode(params)
+        except DecodeError as e:
+            errs.append(f"error decoding object at {field_path}: {e}")
+            continue
+        if not isinstance(decoded, RECOGNIZED_CONFIG_TYPES):
+            errs.append(
+                f"expected a recognized configuration type at {field_path} "
+                f"but got: {type(decoded).__name__}"
+            )
+            continue
+        try:
+            decoded.normalize()
+        except ApiError as e:
+            errs.append(f"error normalizing config at {field_path}: {e}")
+            continue
+        try:
+            decoded.validate()
+        except ApiError as e:
+            errs.append(f"object at {field_path} is invalid: {e}")
+
+    if errs:
+        msg = f"{len(errs)} configs failed to validate: {'; '.join(errs)}"
+        log.error(msg)
+        return _invalid(msg)
+    return {"allowed": True}
+
+
+def handle_admission_request(
+    body: bytes, content_type: str
+) -> Tuple[int, bytes, str]:
+    """The HTTP-agnostic core of serve() (main.go:130-198).
+
+    Returns (status_code, response_body, response_content_type).
+    """
+    if content_type != "application/json":
+        msg = f"contentType={content_type}, expected application/json"
+        log.error(msg)
+        return 415, msg.encode(), "text/plain"
+
+    try:
+        review = json.loads(body)
+    except json.JSONDecodeError as e:
+        msg = f"failed to read AdmissionReview from request body: invalid JSON: {e}"
+        log.error(msg)
+        return 400, msg.encode(), "text/plain"
+
+    if (
+        not isinstance(review, dict)
+        or review.get("apiVersion") != ADMISSION_API_VERSION
+        or review.get("kind") != "AdmissionReview"
+    ):
+        msg = (
+            "failed to read AdmissionReview from request body: unsupported "
+            "group version kind"
+        )
+        log.error(msg)
+        return 400, msg.encode(), "text/plain"
+
+    request = review.get("request")
+    if not isinstance(request, dict):
+        msg = "failed to read AdmissionReview from request body: missing request"
+        log.error(msg)
+        return 400, msg.encode(), "text/plain"
+
+    # Any structural surprise in the admitted object must come back as a
+    # structured deny, never a dropped connection — with failurePolicy=Ignore
+    # a crashed handler fails open and the object is admitted unvalidated.
+    try:
+        response = admit_resource_claim_parameters(review)
+    except Exception as e:  # noqa: BLE001
+        log.exception("admission handler failed")
+        response = _bad_request(f"error processing admission request: {e}")
+    response["uid"] = request.get("uid", "")
+    out = {
+        "apiVersion": ADMISSION_API_VERSION,
+        "kind": "AdmissionReview",
+        "response": response,
+    }
+    return 200, json.dumps(out).encode(), "application/json"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # Keep-alive: the apiserver's webhook client reuses connections; the
+    # HTTP/1.0 default would force a TLS handshake per admission request.
+    protocol_version = "HTTP/1.1"
+
+    # Quiet the default per-request stderr lines; route through logging.
+    def log_message(self, fmt, *args):  # noqa: N802
+        log.debug("%s %s", self.address_string(), fmt % args)
+
+    def _respond(self, status: int, body: bytes, content_type: str) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        if self.path == "/readyz":
+            self._respond(200, b"ok", "text/plain")
+        else:
+            self._respond(404, b"not found", "text/plain")
+
+    def do_POST(self):  # noqa: N802
+        if self.path != "/validate-resource-claim-parameters":
+            self._respond(404, b"not found", "text/plain")
+            return
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        status, out, ctype = handle_admission_request(
+            body, self.headers.get("Content-Type", "")
+        )
+        self._respond(status, out, ctype)
+
+
+def make_server(
+    port: int,
+    cert_file: Optional[str] = None,
+    key_file: Optional[str] = None,
+    address: str = "",
+) -> ThreadingHTTPServer:
+    """Build the webhook HTTP(S) server; TLS when cert/key are given
+    (ListenAndServeTLS analog, main.go:100-106)."""
+    httpd = ThreadingHTTPServer((address, port), _Handler)
+    if cert_file and key_file:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(certfile=cert_file, keyfile=key_file)
+        httpd.socket = ctx.wrap_socket(httpd.socket, server_side=True)
+    return httpd
