@@ -1,0 +1,118 @@
+{{/* Expand the name of the chart. */}}
+{{- define "tpu-dra-driver.name" -}}
+{{- default .Chart.Name .Values.nameOverride | trunc 63 | trimSuffix "-" }}
+{{- end }}
+
+{{/* Fully qualified app name (63 char limit per DNS naming spec). */}}
+{{- define "tpu-dra-driver.fullname" -}}
+{{- if .Values.fullnameOverride }}
+{{- .Values.fullnameOverride | trunc 63 | trimSuffix "-" }}
+{{- else }}
+{{- $name := default .Chart.Name .Values.nameOverride }}
+{{- if contains $name .Release.Name }}
+{{- .Release.Name | trunc 63 | trimSuffix "-" }}
+{{- else }}
+{{- printf "%s-%s" .Release.Name $name | trunc 63 | trimSuffix "-" }}
+{{- end }}
+{{- end }}
+{{- end }}
+
+{{/* Target namespace, overridable for rendering into other namespaces. */}}
+{{- define "tpu-dra-driver.namespace" -}}
+{{- default .Release.Namespace .Values.namespaceOverride }}
+{{- end }}
+
+{{- define "tpu-dra-driver.chart" -}}
+{{- printf "%s-%s" .Chart.Name .Chart.Version | replace "+" "_" | trunc 63 | trimSuffix "-" }}
+{{- end }}
+
+{{/* Common labels. */}}
+{{- define "tpu-dra-driver.labels" -}}
+helm.sh/chart: {{ include "tpu-dra-driver.chart" . }}
+{{ include "tpu-dra-driver.templateLabels" . }}
+{{- end }}
+
+{{- define "tpu-dra-driver.templateLabels" -}}
+app.kubernetes.io/name: {{ include "tpu-dra-driver.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+{{- if .Chart.AppVersion }}
+app.kubernetes.io/version: {{ .Chart.AppVersion | quote }}
+{{- end }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end }}
+
+{{/* Selector labels; pass (dict "context" . "componentName" "x"). */}}
+{{- define "tpu-dra-driver.selectorLabels" -}}
+{{- if .context.Values.selectorLabelsOverride }}
+{{- toYaml .context.Values.selectorLabelsOverride }}
+{{- else }}
+app.kubernetes.io/name: {{ include "tpu-dra-driver.name" .context }}
+app.kubernetes.io/instance: {{ .context.Release.Name }}
+{{- end }}
+{{- if .componentName }}
+tpu-dra-driver-component: {{ .componentName }}
+{{- end }}
+{{- end }}
+
+{{/* Service account name for the controller. */}}
+{{- define "tpu-dra-driver.serviceAccountName" -}}
+{{- if .Values.serviceAccount.create }}
+{{- default (include "tpu-dra-driver.fullname" .) .Values.serviceAccount.name }}
+{{- else }}
+{{- default "default" .Values.serviceAccount.name }}
+{{- end }}
+{{- end }}
+
+{{/* Webhook service account name. */}}
+{{- define "tpu-dra-driver.webhookServiceAccountName" -}}
+{{- if .Values.webhook.serviceAccount.create }}
+{{- default (printf "%s-webhook" (include "tpu-dra-driver.fullname" .)) .Values.webhook.serviceAccount.name }}
+{{- else }}
+{{- default "default" .Values.webhook.serviceAccount.name }}
+{{- end }}
+{{- end }}
+
+{{/* Full image ref; empty tag means "v" + appVersion. */}}
+{{- define "tpu-dra-driver.fullimage" -}}
+{{- $tag := printf "v%s" .Chart.AppVersion }}
+{{- .Values.image.repository }}:{{ .Values.image.tag | default $tag }}
+{{- end }}
+
+{{/*
+resource.k8s.io API version for DRA objects: explicit override if set, else
+highest version the API server reports (v1 > v1beta2 > v1beta1).
+*/}}
+{{- define "tpu-dra-driver.resourceApiVersion" -}}
+{{- if .Values.resourceApiVersion }}
+{{- .Values.resourceApiVersion }}
+{{- else if .Capabilities.APIVersions.Has "resource.k8s.io/v1" }}
+resource.k8s.io/v1
+{{- else if .Capabilities.APIVersions.Has "resource.k8s.io/v1beta2" }}
+resource.k8s.io/v1beta2
+{{- else }}
+resource.k8s.io/v1beta1
+{{- end }}
+{{- end }}
+
+{{/* featureGates map rendered as the CLI/env string "A=true,B=false". */}}
+{{- define "tpu-dra-driver.featureGatesString" -}}
+{{- $pairs := list }}
+{{- range $k, $v := .Values.featureGates }}
+{{- $pairs = append $pairs (printf "%s=%v" $k $v) }}
+{{- end }}
+{{- join "," $pairs }}
+{{- end }}
+
+{{/* Webhook service name. */}}
+{{- define "tpu-dra-driver.webhookServiceName" -}}
+{{ include "tpu-dra-driver.fullname" . }}-webhook
+{{- end }}
+
+{{/* Webhook cert-manager Certificate secret name. */}}
+{{- define "tpu-dra-driver.webhookCertSecretName" -}}
+{{- if eq .Values.webhook.tls.mode "secret" }}
+{{- .Values.webhook.tls.secret.name }}
+{{- else }}
+{{- printf "%s-webhook-tls" (include "tpu-dra-driver.fullname" .) }}
+{{- end }}
+{{- end }}

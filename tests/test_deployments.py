@@ -1,0 +1,202 @@
+"""Deployment-layer consistency checks.
+
+There is no helm binary in the test environment, so these tests do the part
+of `helm lint` that matters for drift: every `.Values.*` path referenced by a
+template exists in values.yaml, the CRDs parse and match the API-layer types,
+device-class names and driver names match the code's constants, and the
+Dockerfile/pyproject entry points reference real modules.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import tomllib
+
+import pytest
+import yaml
+
+import tpu_dra.version as version
+from tpu_dra.computedomain import CHANNEL_DEVICE_CLASS, DAEMON_DEVICE_CLASS
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHART = os.path.join(REPO, "deployments", "helm", "tpu-dra-driver")
+TEMPLATES = os.path.join(CHART, "templates")
+
+
+def read(path: str) -> str:
+    with open(path) as f:
+        return f.read()
+
+
+def template_files():
+    return sorted(
+        os.path.join(TEMPLATES, f)
+        for f in os.listdir(TEMPLATES)
+        if f.endswith((".yaml", ".tpl"))
+    )
+
+
+# --- values.yaml <-> template drift ----------------------------------------
+
+
+def values_paths(d, prefix=""):
+    out = set()
+    if isinstance(d, dict):
+        for k, v in d.items():
+            p = f"{prefix}.{k}" if prefix else k
+            out.add(p)
+            out.update(values_paths(v, p))
+    return out
+
+
+def test_all_referenced_values_exist():
+    defined = values_paths(yaml.safe_load(read(os.path.join(CHART, "values.yaml"))))
+    refs = set()
+    for path in template_files():
+        refs.update(re.findall(r"\.Values\.([A-Za-z0-9_.]+)", read(path)))
+    missing = {r for r in refs if r not in defined}
+    assert not missing, f"templates reference undefined values: {sorted(missing)}"
+
+
+def test_braces_balanced():
+    for path in template_files():
+        text = read(path)
+        assert text.count("{{") == text.count("}}"), f"unbalanced braces in {path}"
+
+
+# --- CRDs ------------------------------------------------------------------
+
+
+def load_crds():
+    crd_dir = os.path.join(CHART, "crds")
+    return {
+        doc["spec"]["names"]["kind"]: doc
+        for f in os.listdir(crd_dir)
+        for doc in [yaml.safe_load(read(os.path.join(crd_dir, f)))]
+    }
+
+
+def test_crds_parse_and_match_api_group():
+    crds = load_crds()
+    assert set(crds) == {"ComputeDomain", "ComputeDomainClique"}
+    for kind, crd in crds.items():
+        assert crd["spec"]["group"] == version.API_GROUP
+        versions = [v["name"] for v in crd["spec"]["versions"]]
+        assert version.API_VERSION in versions
+        plural = crd["spec"]["names"]["plural"]
+        assert crd["metadata"]["name"] == f"{plural}.{version.API_GROUP}"
+
+
+def test_computedomain_crd_schema_covers_api_fields():
+    from tpu_dra.api.computedomain import ComputeDomainSpec, ComputeDomainStatus
+
+    crd = load_crds()["ComputeDomain"]
+    schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+    spec_props = schema["properties"]["spec"]["properties"]
+    assert set(ComputeDomainSpec.FIELDS) <= set(spec_props)
+    status_props = schema["properties"]["status"]["properties"]
+    assert set(ComputeDomainStatus.FIELDS) <= set(status_props)
+    # status must be a subresource so the controller's status updates work
+    assert crd["spec"]["versions"][0]["subresources"] == {"status": {}}
+
+
+def test_clique_crd_schema_covers_api_fields():
+    from tpu_dra.api.computedomain import ComputeDomainDaemonInfo
+
+    crd = load_crds()["ComputeDomainClique"]
+    schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+    daemon_props = schema["properties"]["daemons"]["items"]["properties"]
+    assert set(ComputeDomainDaemonInfo.FIELDS) <= set(daemon_props)
+
+
+# --- device classes / driver names -----------------------------------------
+
+
+def test_deviceclasses_match_code_constants():
+    text = read(os.path.join(TEMPLATES, "deviceclasses.yaml"))
+    for name in (
+        version.DRIVER_NAME,
+        "tpu-subslice.google.com",
+        "vfio-tpu.google.com",
+        DAEMON_DEVICE_CLASS,
+        CHANNEL_DEVICE_CLASS,
+    ):
+        assert f"name: {name}" in text, f"DeviceClass {name} missing"
+    # CEL selectors must reference the real driver names
+    assert f"device.driver == '{version.DRIVER_NAME}'" in text
+    assert f"device.driver == '{version.CD_DRIVER_NAME}'" in text
+    # extended-resource bridging on v1 only
+    assert "extendedResourceName: google.com/tpu" in text
+
+
+def test_device_type_attributes_match_allocatable():
+    from tpu_dra.plugin import allocatable as alloc
+
+    text = read(os.path.join(TEMPLATES, "deviceclasses.yaml"))
+    assert f".type == '{alloc.TPU_DEVICE_TYPE}'" in text
+    assert f".type == '{alloc.VFIO_DEVICE_TYPE}'" in text
+    # both subslice types are covered by the startsWith selector
+    assert alloc.SUBSLICE_STATIC_DEVICE_TYPE.startswith("subslice")
+    assert alloc.SUBSLICE_DYNAMIC_DEVICE_TYPE.startswith("subslice")
+    assert ".type.startsWith('subslice')" in text
+
+
+def test_kubeletplugin_runs_real_modules():
+    text = read(os.path.join(TEMPLATES, "kubeletplugin.yaml"))
+    for mod in ("tpu_dra.plugin.main", "tpu_dra.computedomain.cdplugin.main"):
+        assert mod in text
+        __import__(mod)  # must be importable
+
+
+def test_controller_and_webhook_run_real_modules():
+    for fname, mod in (
+        ("controller.yaml", "tpu_dra.computedomain.controller.main"),
+        ("webhook.yaml", "tpu_dra.webhook.main"),
+    ):
+        assert mod in read(os.path.join(TEMPLATES, fname))
+        __import__(mod)
+
+
+def test_webhook_path_matches_server():
+    text = read(os.path.join(TEMPLATES, "webhook.yaml"))
+    assert "path: /validate-resource-claim-parameters" in text
+
+
+# --- RBAC ------------------------------------------------------------------
+
+
+def test_rbac_covers_crds_and_resourceslices():
+    text = read(os.path.join(TEMPLATES, "rbac.yaml"))
+    assert f'apiGroups: ["{version.API_GROUP}"]' in text
+    assert '"computedomains"' in text
+    assert '"resourceslices"' in text
+    assert '"resourceclaimtemplates"' in text
+
+
+def test_vap_restricts_kubeletplugin_sa():
+    text = read(os.path.join(TEMPLATES, "validatingadmissionpolicy.yaml"))
+    assert "resourceslices" in text
+    assert "kubeletplugin" in text
+    assert "userNodeName == variables.objectNodeName" in text
+
+
+# --- packaging -------------------------------------------------------------
+
+
+def test_pyproject_entry_points_import():
+    with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
+        proj = tomllib.load(f)
+    for target in proj["project"]["scripts"].values():
+        mod, func = target.split(":")
+        m = __import__(mod, fromlist=[func])
+        assert callable(getattr(m, func))
+
+
+def test_dockerfile_consistency():
+    text = read(os.path.join(REPO, "deployments", "container", "Dockerfile"))
+    from tpu_dra.tpulib.native import NATIVE_LIB_ENV
+
+    assert NATIVE_LIB_ENV in text
+    assert "make -C native" in text
+    assert os.path.exists(os.path.join(REPO, "native", "Makefile"))

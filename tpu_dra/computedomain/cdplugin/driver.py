@@ -81,6 +81,7 @@ class CDDriver:
             )
             self._servers.append(serve_unix([self.dra_service], dra_socket))
             self._servers.append(serve_unix([self.registration], reg_socket))
+            self._socket_paths = [dra_socket, reg_socket]
         self.cleanup.start()
         # Periodic stale-node-label GC (computedomain.go:384-439 analog):
         # drops this node's CD label once no prepared claim references the
@@ -103,6 +104,19 @@ class CDDriver:
         self.cleanup.stop()
         for s in self._servers:
             s.stop(grace=1).wait(timeout=5)
+
+    def healthy(self) -> "tuple[bool, str]":
+        """Liveness verdict for /healthz; see Driver.healthy."""
+        import os
+
+        for path in getattr(self, "_socket_paths", []):
+            if not os.path.exists(path):
+                return False, f"socket missing: {path}"
+        registered = (
+            getattr(self, "registration", None) is not None
+            and self.registration.registered.is_set()
+        )
+        return True, f"serving (kubelet registered: {registered})"
 
     MAX_DEVICES_PER_SLICE = 128  # apiserver validation cap on spec.devices
 

@@ -40,6 +40,9 @@ def main(argv=None) -> int:
         action="store_true",
         default=flags.env_default("TPU_DRA_FAKE_CLUSTER", False, bool),
     )
+    p.add_argument(
+        "--health-port", type=int, default=flags.env_default("HEALTH_PORT", 0, int)
+    )
     args = p.parse_args(argv)
     flags.LoggingConfig.from_args(args).apply()
     signals.start_debug_signal_handlers()
@@ -73,12 +76,25 @@ def main(argv=None) -> int:
         clique_id=clique_id,
     )
     driver.start()
+
+    # Health/metrics endpoint probed by the chart's startup/liveness probes
+    # (cmd/compute-domain-kubelet-plugin/health.go analog).
+    from tpu_dra.infra.metrics import start_health_server
+
+    health_server = start_health_server(
+        driver.metrics, args.health_port, healthz=driver.healthy
+    )
+    if health_server:
+        log.info("metrics/healthz on :%d", health_server.port)
+
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     log.info("compute-domain-kubelet-plugin running")
     stop.wait()
     driver.shutdown()
+    if health_server:
+        health_server.stop()
     return 0
 
 

@@ -66,7 +66,9 @@ class Metrics:
 class MetricsServer:
     """Serves /metrics (and /healthz via a pluggable callback)."""
 
-    def __init__(self, metrics: Metrics, port: int = 0, healthz=None):
+    def __init__(self, metrics: Metrics, port: int = 0, healthz=None, address: str = ""):
+        # Default bind is all interfaces: kubelet startup/liveness probes
+        # reach the pod over the pod network, not loopback.
         self.metrics = metrics
         self.healthz = healthz or (lambda: (True, "ok"))
         registry = self
@@ -95,7 +97,7 @@ class MetricsServer:
             def log_message(self, *args):
                 pass
 
-        self._httpd = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._httpd = http.server.ThreadingHTTPServer((address, port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
@@ -108,3 +110,14 @@ class MetricsServer:
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+
+
+def start_health_server(metrics: Metrics, port: int, healthz=None):
+    """Start the /metrics + /healthz endpoint shared by the plugin binaries
+    (cmd/*/health.go analog). Returns the running server, or None when the
+    port is unset/disabled."""
+    if not port or port <= 0:
+        return None
+    server = MetricsServer(metrics, port=port, healthz=healthz)
+    server.start()
+    return server

@@ -116,6 +116,7 @@ class Driver:
             )
             self._servers.append(serve_unix([self.dra_service], dra_socket))
             self._servers.append(serve_unix([self.registration], reg_socket))
+            self._socket_paths = [dra_socket, reg_socket]
         if fg.enabled(fg.DEVICE_HEALTH_CHECK):
             self.health_monitor.start()
         self.cleanup.start()
@@ -129,6 +130,22 @@ class Driver:
             # stop() only *initiates* shutdown; wait for full termination or
             # the executor's non-daemon workers block interpreter exit.
             s.stop(grace=1).wait(timeout=5)
+
+    def healthy(self) -> "tuple[bool, str]":
+        """Liveness verdict for /healthz (health.go:51-149 analog): the DRA
+        and registration sockets must still exist on disk; kubelet
+        registration status is reported but does not fail liveness (it
+        arrives only after kubelet probes us)."""
+        import os
+
+        for path in getattr(self, "_socket_paths", []):
+            if not os.path.exists(path):
+                return False, f"socket missing: {path}"
+        registered = (
+            getattr(self, "registration", None) is not None
+            and self.registration.registered.is_set()
+        )
+        return True, f"serving (kubelet registered: {registered})"
 
     # --- health (driver.go:441-505) ---
 

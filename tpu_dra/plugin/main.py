@@ -15,7 +15,7 @@ import signal
 import threading
 
 from tpu_dra.infra import featuregates, flags, signals
-from tpu_dra.infra.metrics import MetricsServer
+from tpu_dra.infra.metrics import start_health_server
 from tpu_dra.plugin.driver import Driver, DriverConfig
 from tpu_dra.tpulib import new_tpulib
 
@@ -86,14 +86,10 @@ def main(argv=None) -> int:
     driver = Driver(tpulib, backend, config)
     driver.start()
 
-    health_server = None
-    if args.health_port:
-        health_server = MetricsServer(
-            driver.metrics,
-            port=args.health_port,
-            healthz=lambda: (True, "serving"),
-        )
-        health_server.start()
+    health_server = start_health_server(
+        driver.metrics, args.health_port, healthz=driver.healthy
+    )
+    if health_server:
         log.info("metrics/healthz on :%d", health_server.port)
 
     stop = threading.Event()
