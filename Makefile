@@ -1,0 +1,30 @@
+# Build/test targets (reference analog: Makefile, common.mk, versions.mk).
+
+IMAGE_REPO ?= registry.local/tpu-dra-driver
+IMAGE_TAG  ?= v0.1.0
+
+.PHONY: all native test bench image bats lint clean
+
+all: native test
+
+native:
+	$(MAKE) -C native
+
+test: native
+	python -m pytest tests/ -x -q
+
+bench:
+	python bench.py
+
+image:
+	docker build -t $(IMAGE_REPO):$(IMAGE_TAG) -f deployments/container/Dockerfile .
+
+# e2e against the current kubectl context (invasive; see tests/bats/README.md)
+bats:
+	bats tests/bats/
+
+lint:
+	python -m compileall -q tpu_dra tests
+
+clean:
+	rm -rf native/build tpu_dra.egg-info

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Build the driver image and side-load it into the kind cluster.
+set -euo pipefail
+cd "$(dirname "$0")/../../.."
+
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra}"
+IMAGE="${IMAGE:-registry.local/tpu-dra-driver:v0.1.0}"
+
+docker build -t "${IMAGE}" -f deployments/container/Dockerfile .
+"${KIND:-kind}" load docker-image "${IMAGE}" --name "${CLUSTER_NAME}"
+echo "loaded ${IMAGE} into kind cluster ${CLUSTER_NAME}"

@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Create a kind cluster with DRA enabled and stub TPU inventories mounted.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra}"
+KIND="${KIND:-kind}"
+
+"${KIND}" create cluster --name "${CLUSTER_NAME}" --config kind-config.yaml
+
+# kind node labels from the config only apply at join time on recent kinds;
+# assert them here for older versions.
+for node in $("${KIND}" get nodes --name "${CLUSTER_NAME}" | grep worker); do
+  kubectl label node "${node}" google.com/tpu.present=true --overwrite
+done
+
+echo "cluster '${CLUSTER_NAME}' ready; next: ./build-image.sh && ./install-driver.sh"

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Install the driver chart against the stub backend (hardware-free path).
+set -euo pipefail
+cd "$(dirname "$0")/../../.."
+
+NAMESPACE="${NAMESPACE:-tpu-dra-driver}"
+IMAGE_REPO="${IMAGE_REPO:-registry.local/tpu-dra-driver}"
+IMAGE_TAG="${IMAGE_TAG:-v0.1.0}"
+
+helm upgrade --install tpu-dra-driver deployments/helm/tpu-dra-driver \
+  --create-namespace --namespace "${NAMESPACE}" \
+  --set image.repository="${IMAGE_REPO}" \
+  --set image.tag="${IMAGE_TAG}" \
+  --set tpulibBackend=stub \
+  --set stubInventoryPath=/etc/tpu-dra/stub-config.yaml \
+  --set kubeletPlugin.affinity=null \
+  "$@"
+
+kubectl -n "${NAMESPACE}" rollout status ds/tpu-dra-driver-kubelet-plugin --timeout=180s
+kubectl get resourceslices

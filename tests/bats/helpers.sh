@@ -1,0 +1,140 @@
+# Shared plumbing for the bats e2e suites (reference: tests/bats/helpers.sh).
+# shellcheck shell=bash
+
+: "${TEST_CHART_PATH:=deployments/helm/tpu-dra-driver}"
+: "${TEST_NAMESPACE:=tpu-dra-driver}"
+: "${TEST_IMAGE_REPO:=registry.local/tpu-dra-driver}"
+: "${TEST_IMAGE_TAG:=v0.1.0}"
+: "${TEST_STUB_BACKEND:=1}"
+: "${TEST_RELEASE:=tpu-dra-driver}"
+
+_common_setup() {
+  load "$(dirname "$BATS_TEST_FILENAME")/../bats-helpers/bats-support/load" 2>/dev/null || true
+  load "$(dirname "$BATS_TEST_FILENAME")/../bats-helpers/bats-assert/load" 2>/dev/null || true
+  REPO_ROOT="$(cd "$(dirname "$BATS_TEST_FILENAME")/../.." && pwd)"
+  export REPO_ROOT
+}
+
+log() {
+  printf '[%s] %s\n' "$(date -u +%H:%M:%S)" "$*" >&3 2>/dev/null || \
+    printf '[%s] %s\n' "$(date -u +%H:%M:%S)" "$*"
+}
+
+# Install or upgrade the chart and wait for the kubelet-plugin rollout.
+# Extra --set pairs come as the name of an array variable (nameref).
+iupgrade_wait() {
+  local -n _extra_args=${1:-_empty}
+  local _empty=()
+  local args=(
+    upgrade --install "${TEST_RELEASE}" "${REPO_ROOT}/${TEST_CHART_PATH}"
+    --create-namespace --namespace "${TEST_NAMESPACE}"
+    --set "image.repository=${TEST_IMAGE_REPO}"
+    --set "image.tag=${TEST_IMAGE_TAG}"
+  )
+  if [[ "${TEST_STUB_BACKEND}" == "1" ]]; then
+    args+=(
+      --set tpulibBackend=stub
+      --set stubInventoryPath=/etc/tpu-dra/stub-config.yaml
+      --set kubeletPlugin.affinity=null
+    )
+  fi
+  args+=("${_extra_args[@]}")
+  helm "${args[@]}"
+  kubectl -n "${TEST_NAMESPACE}" rollout status \
+    "ds/${TEST_RELEASE}-kubelet-plugin" --timeout=300s
+}
+
+uninstall_driver() {
+  helm uninstall "${TEST_RELEASE}" --namespace "${TEST_NAMESPACE}" || true
+  kubectl delete namespace "${TEST_NAMESPACE}" --ignore-not-found --timeout=120s
+}
+
+log_objects() {
+  log "--- resourceslices ---"
+  kubectl get resourceslices -o wide || true
+  log "--- resourceclaims (all ns) ---"
+  kubectl get resourceclaims -A || true
+  log "--- computedomains (all ns) ---"
+  kubectl get computedomains -A || true
+  log "--- driver pods ---"
+  kubectl -n "${TEST_NAMESPACE}" get pods -o wide || true
+}
+
+get_node_count() {
+  kubectl get nodes --no-headers -l google.com/tpu.present=true | wc -l
+}
+
+# Wait until every TPU node has published at least one ResourceSlice for the
+# given driver (default tpu.google.com).
+wait_for_all_tpu_resource_slices() {
+  local driver="${1:-tpu.google.com}"
+  local want
+  want="$(get_node_count)"
+  local have=0
+  for _ in $(seq 1 60); do
+    have="$(kubectl get resourceslices -o json | \
+      jq -r --arg d "$driver" \
+        '[.items[] | select(.spec.driver == $d) | .spec.nodeName] | unique | length')"
+    [[ "$have" -ge "$want" ]] && return 0
+    sleep 2
+  done
+  log "resource slices: have nodes=$have want=$want"
+  return 1
+}
+
+# Print "<name> <value>" attribute pairs of the first device in any slice of
+# the given driver.
+get_device_attrs_from_any_tpu_slice() {
+  local driver="${1:-tpu.google.com}"
+  kubectl get resourceslices -o json | \
+    jq -r --arg d "$driver" \
+      '[.items[] | select(.spec.driver == $d)][0].spec.devices[0].basic.attributes
+       | to_entries[] | "\(.key) \(.value | to_entries[0].value)"'
+}
+
+assert_attr_equal() {
+  local attrs="$1" name="$2" want="$3"
+  local got
+  got="$(echo "$attrs" | awk -v n="$name" '$1 == n {print $2}')"
+  [[ "$got" == "$want" ]] || {
+    log "attribute $name: got '$got', want '$want'"
+    return 1
+  }
+}
+
+show_kubelet_plugin_log_tails() {
+  local pods
+  pods="$(kubectl -n "${TEST_NAMESPACE}" get pods \
+    -l tpu-dra-driver-component=kubelet-plugin -o name)"
+  for p in $pods; do
+    for c in tpus compute-domains; do
+      log "--- ${p}/${c} (last 30 lines) ---"
+      kubectl -n "${TEST_NAMESPACE}" logs "$p" -c "$c" --tail=30 || true
+    done
+  done
+}
+
+get_current_controller_pod_name() {
+  kubectl -n "${TEST_NAMESPACE}" get pods \
+    -l tpu-dra-driver-component=controller \
+    -o jsonpath='{.items[0].metadata.name}'
+}
+
+wait_for_cd_status() {
+  local ns="$1" name="$2" want="$3"
+  for _ in $(seq 1 90); do
+    local got
+    got="$(kubectl -n "$ns" get computedomain "$name" \
+      -o jsonpath='{.status.status}' 2>/dev/null)"
+    [[ "$got" == "$want" ]] && return 0
+    sleep 2
+  done
+  return 1
+}
+
+restart_kubelet_on_node() {
+  # kind nodes are docker containers; real nodes need node-shell/ssh.
+  local node="$1"
+  docker exec "$node" systemctl restart kubelet 2>/dev/null || \
+    log "cannot restart kubelet on $node (not a kind node?)"
+}

@@ -1,0 +1,23 @@
+# Suite-level preflight (reference: tests/bats/setup_suite.bash): assert the
+# cluster serves a DRA API group version we support and export it.
+
+setup_suite() {
+  if ! command -v kubectl >/dev/null || ! command -v helm >/dev/null; then
+    echo "kubectl and helm are required" >&2
+    return 1
+  fi
+
+  local versions
+  versions="$(kubectl api-versions)"
+  if echo "$versions" | grep -q '^resource.k8s.io/v1$'; then
+    export TEST_RESOURCE_API_VERSION="resource.k8s.io/v1"
+  elif echo "$versions" | grep -q '^resource.k8s.io/v1beta2$'; then
+    export TEST_RESOURCE_API_VERSION="resource.k8s.io/v1beta2"
+  elif echo "$versions" | grep -q '^resource.k8s.io/v1beta1$'; then
+    export TEST_RESOURCE_API_VERSION="resource.k8s.io/v1beta1"
+  else
+    echo "cluster does not serve resource.k8s.io (enable DRA)" >&2
+    return 1
+  fi
+  echo "using ${TEST_RESOURCE_API_VERSION}" >&3 2>/dev/null || true
+}

@@ -1,0 +1,59 @@
+# shellcheck disable=SC2148
+
+setup_file() {
+  load 'helpers.sh'
+  _common_setup
+}
+
+setup() {
+  load 'helpers.sh'
+  _common_setup
+}
+
+bats::on_failure() {
+  log_objects
+}
+
+@test "basics: clean cluster has no leftover driver state" {
+  run kubectl get resourceslices -o json
+  [ "$status" -eq 0 ]
+  run bash -c "kubectl get resourceslices -o json | jq -r '[.items[] | select(.spec.driver | test(\"tpu.google.com\"))] | length'"
+  [ "$output" == "0" ]
+}
+
+@test "basics: chart installs and plugins roll out" {
+  local _iargs=("--set" "logVerbosity=6")
+  iupgrade_wait _iargs
+  run kubectl -n "${TEST_NAMESPACE}" get pods
+  [ "$status" -eq 0 ]
+}
+
+@test "basics: CRDs are served" {
+  run kubectl get crd computedomains.resource.tpu.google.com
+  [ "$status" -eq 0 ]
+  run kubectl get crd computedomaincliques.resource.tpu.google.com
+  [ "$status" -eq 0 ]
+}
+
+@test "basics: DeviceClasses exist" {
+  for dc in tpu.google.com tpu-subslice.google.com vfio-tpu.google.com \
+            compute-domain-daemon.tpu.google.com \
+            compute-domain-default-channel.tpu.google.com; do
+    run kubectl get deviceclass "$dc"
+    [ "$status" -eq 0 ]
+  done
+}
+
+@test "basics: every TPU node publishes resource slices" {
+  wait_for_all_tpu_resource_slices tpu.google.com
+  wait_for_all_tpu_resource_slices compute-domain.tpu.google.com
+}
+
+@test "basics: device attributes are sane" {
+  local attrs
+  attrs="$(get_device_attrs_from_any_tpu_slice tpu.google.com)"
+  echo "$attrs" | grep -q '^type tpu$'
+  echo "$attrs" | grep -q '^uuid '
+  echo "$attrs" | grep -q '^generation '
+  echo "$attrs" | grep -q '^topologyCoord '
+}

@@ -1,0 +1,51 @@
+# shellcheck disable=SC2148
+# Fault injection (reference: test_cd_failover.bats + test_cd_nvb_failover.sh):
+# kill slice daemons / workers mid-run, assert the domain and job recover.
+
+setup_file() {
+  load 'helpers.sh'
+  _common_setup
+  local _iargs=()
+  iupgrade_wait _iargs
+  kubectl apply -f "${REPO_ROOT}/demo/specs/computedomain/computedomain.yaml"
+}
+
+setup() {
+  load 'helpers.sh'
+  _common_setup
+}
+
+teardown_file() {
+  kubectl delete namespace cd-demo --ignore-not-found --timeout=180s
+}
+
+bats::on_failure() {
+  log_objects
+  show_kubelet_plugin_log_tails
+}
+
+@test "failover: force-delete one slice daemon pod, domain recovers" {
+  wait_for_cd_status cd-demo v5p-16 Ready
+  local daemon
+  daemon="$(kubectl -n "${TEST_NAMESPACE}" get pods -o name | grep compute-domain-daemon | head -1)"
+  [ -n "$daemon" ]
+  kubectl -n "${TEST_NAMESPACE}" delete "$daemon" --force --grace-period=0
+  # DS recreates the daemon; it re-registers with its stable index and the
+  # domain converges back to Ready.
+  wait_for_cd_status cd-demo v5p-16 Ready
+}
+
+@test "failover: delete all slice daemons at once, domain recovers" {
+  kubectl -n "${TEST_NAMESPACE}" delete pods -l tpu-dra-driver-component=cd-daemon \
+    --force --grace-period=0 || true
+  wait_for_cd_status cd-demo v5p-16 Ready
+}
+
+@test "failover: workload job survives worker pod deletion" {
+  kubectl apply -f "${REPO_ROOT}/demo/specs/computedomain/llama-pjit-job.yaml"
+  sleep 5
+  local worker
+  worker="$(kubectl -n cd-demo get pods -l job-name=llama-pjit -o name | head -1)"
+  [ -n "$worker" ] && kubectl -n cd-demo delete "$worker" --force --grace-period=0
+  kubectl -n cd-demo wait --for=condition=complete job/llama-pjit --timeout=900s
+}

@@ -1,0 +1,59 @@
+# shellcheck disable=SC2148
+# ComputeDomain bring-up: controller stamps DS + workload RCT, daemons
+# register, readiness gates workload start (reference: test_cd_mnnvl_workload).
+
+setup_file() {
+  load 'helpers.sh'
+  _common_setup
+  local _iargs=()
+  iupgrade_wait _iargs
+  kubectl apply -f "${REPO_ROOT}/demo/specs/computedomain/computedomain.yaml"
+}
+
+setup() {
+  load 'helpers.sh'
+  _common_setup
+}
+
+teardown_file() {
+  kubectl delete namespace cd-demo --ignore-not-found --timeout=180s
+}
+
+bats::on_failure() {
+  log_objects
+  show_kubelet_plugin_log_tails
+}
+
+@test "cd: controller creates workload claim template" {
+  for _ in $(seq 1 30); do
+    kubectl -n cd-demo get resourceclaimtemplate v5p-16-channel 2>/dev/null && return 0
+    sleep 2
+  done
+  return 1
+}
+
+@test "cd: per-CD daemonset exists" {
+  run bash -c "kubectl -n ${TEST_NAMESPACE} get daemonsets -o name | grep -c compute-domain"
+  [ "$output" -ge 1 ]
+}
+
+@test "cd: workload pod is gated until domain is ready, then starts" {
+  kubectl apply -f "${REPO_ROOT}/demo/specs/computedomain/llama-pjit-job.yaml"
+  # The pods stay in ContainerCreating while the CD is NotReady; once every
+  # host registers, status flips Ready and the job runs.
+  wait_for_cd_status cd-demo v5p-16 Ready
+  kubectl -n cd-demo wait --for=condition=complete job/llama-pjit --timeout=900s
+}
+
+@test "cd: deleting the domain cleans up DS, RCT, and node labels" {
+  kubectl -n cd-demo delete computedomain v5p-16 --timeout=180s
+  for _ in $(seq 1 45); do
+    local left
+    left="$(kubectl -n cd-demo get resourceclaimtemplate v5p-16-channel \
+      --no-headers 2>/dev/null | wc -l)"
+    [ "$left" -eq 0 ] && break
+    sleep 2
+  done
+  run bash -c "kubectl get nodes -o json | jq -r '[.items[].metadata.labels | keys[] | select(startswith(\"resource.tpu.google.com/computeDomain\"))] | length'"
+  [ "$output" == "0" ]
+}

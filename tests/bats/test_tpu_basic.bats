@@ -1,0 +1,55 @@
+# shellcheck disable=SC2148
+
+setup_file() {
+  load 'helpers.sh'
+  _common_setup
+  local _iargs=()
+  iupgrade_wait _iargs
+}
+
+setup() {
+  load 'helpers.sh'
+  _common_setup
+}
+
+teardown_file() {
+  kubectl delete namespace bats-tpu-basic --ignore-not-found --timeout=120s
+}
+
+bats::on_failure() {
+  log_objects
+  show_kubelet_plugin_log_tails
+}
+
+@test "tpu: 2 pods get 2 distinct chips" {
+  kubectl apply -f "${REPO_ROOT}/tests/bats/specs/tpu-2pods-2chips.yaml"
+  kubectl -n bats-tpu-basic wait --for=condition=READY pods pod0 pod1 --timeout=120s
+
+  run kubectl -n bats-tpu-basic logs pod0
+  [[ "$output" == *TPU_VISIBLE_DEVICES* ]] || [[ "$output" == *TPU_DRA_DRIVER_VERSION* ]]
+
+  # Exclusive allocation: the two pods must not share a device.
+  local d0 d1
+  d0="$(kubectl -n bats-tpu-basic get resourceclaims -o json | \
+    jq -r '[.items[] | select(.status.allocation != null) | .status.allocation.devices.results[0].device] | .[0]')"
+  d1="$(kubectl -n bats-tpu-basic get resourceclaims -o json | \
+    jq -r '[.items[] | select(.status.allocation != null) | .status.allocation.devices.results[0].device] | .[1]')"
+  [ -n "$d0" ] && [ -n "$d1" ] && [ "$d0" != "$d1" ]
+}
+
+@test "tpu: shared claim across two containers of one pod" {
+  kubectl apply -f "${REPO_ROOT}/demo/specs/quickstart/tpu-test2.yaml"
+  kubectl -n tpu-test2 wait --for=jsonpath='{.status.phase}'=Succeeded pod/pod --timeout=120s
+  kubectl delete namespace tpu-test2 --ignore-not-found --timeout=120s
+}
+
+@test "tpu: claims release on pod deletion" {
+  kubectl -n bats-tpu-basic delete pod pod0 pod1 --ignore-not-found --timeout=120s
+  for _ in $(seq 1 30); do
+    local n
+    n="$(kubectl -n bats-tpu-basic get resourceclaims --no-headers 2>/dev/null | wc -l)"
+    [ "$n" -eq 0 ] && return 0
+    sleep 2
+  done
+  return 1
+}

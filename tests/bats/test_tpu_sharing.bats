@@ -1,0 +1,63 @@
+# shellcheck disable=SC2148
+# Chip-sharing suite (MPS-analog per-process multiplexing).
+
+setup_file() {
+  load 'helpers.sh'
+  _common_setup
+  local _iargs=(
+    "--set" "featureGates.MultiplexingSupport=true"
+    "--set" "featureGates.TimeSlicingSettings=true"
+  )
+  iupgrade_wait _iargs
+}
+
+setup() {
+  load 'helpers.sh'
+  _common_setup
+}
+
+teardown_file() {
+  kubectl delete namespace tpu-test3 --ignore-not-found --timeout=120s
+}
+
+bats::on_failure() {
+  log_objects
+  show_kubelet_plugin_log_tails
+}
+
+@test "sharing: two pods share one chip via multiplexing" {
+  kubectl apply -f "${REPO_ROOT}/demo/specs/quickstart/tpu-test3.yaml"
+  kubectl -n tpu-test3 wait --for=jsonpath='{.status.phase}'=Succeeded pod/pod0 pod/pod1 --timeout=180s
+  run kubectl -n tpu-test3 logs pod0
+  [[ "$output" == *MULTIPLEX* ]] || [[ "$output" == *TPU_* ]]
+}
+
+@test "sharing: invalid sharing config is rejected by admission" {
+  # With the webhook (or validation at prepare), a bad interval must fail.
+  run kubectl apply -n tpu-test3 -f - <<'YAML'
+apiVersion: resource.k8s.io/v1beta1
+kind: ResourceClaim
+metadata:
+  name: bad-sharing
+spec:
+  devices:
+    requests:
+    - name: tpu
+      deviceClassName: tpu.google.com
+    config:
+    - requests: ["tpu"]
+      opaque:
+        driver: tpu.google.com
+        parameters:
+          apiVersion: resource.tpu.google.com/v1beta1
+          kind: TpuConfig
+          sharing:
+            strategy: TimeSlicing
+            timeSlicingConfig:
+              interval: Bogus
+YAML
+  # Webhook enabled -> apply fails; webhook disabled -> claim stays unprepared.
+  if kubectl get validatingwebhookconfigurations | grep -q tpu-dra; then
+    [ "$status" -ne 0 ]
+  fi
+}

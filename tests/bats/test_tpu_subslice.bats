@@ -1,0 +1,48 @@
+# shellcheck disable=SC2148
+# Sub-slice allocation (DynamicMIG-analog) suite: requires the
+# DynamicSubslice feature gate; asserts advertised abstract shapes carry
+# shared counters so overlapping placements cannot be co-allocated.
+
+setup_file() {
+  load 'helpers.sh'
+  _common_setup
+  local _iargs=("--set" "featureGates.DynamicSubslice=true")
+  iupgrade_wait _iargs
+}
+
+setup() {
+  load 'helpers.sh'
+  _common_setup
+}
+
+teardown_file() {
+  kubectl delete namespace tpu-test5 --ignore-not-found --timeout=120s
+}
+
+bats::on_failure() {
+  log_objects
+  show_kubelet_plugin_log_tails
+}
+
+@test "subslice: abstract shapes advertised with shared counters" {
+  wait_for_all_tpu_resource_slices tpu.google.com
+  local combined
+  combined="$(kubectl get resourceslices -o json | \
+    jq -r '[.items[] | select(.spec.driver == "tpu.google.com")
+            | .spec.devices[] | select(.basic.consumesCounters != null)] | length')"
+  [ "$combined" -gt 0 ]
+}
+
+@test "subslice: claim materializes a sub-slice" {
+  kubectl apply -f "${REPO_ROOT}/demo/specs/quickstart/tpu-test5.yaml"
+  kubectl -n tpu-test5 wait --for=jsonpath='{.status.phase}'=Succeeded pod/pod --timeout=180s
+}
+
+@test "subslice: attributes include shape and origin" {
+  local attrs
+  attrs="$(kubectl get resourceslices -o json | \
+    jq -r '[.items[] | select(.spec.driver == "tpu.google.com")
+            | .spec.devices[] | select(.basic.attributes.type.string | startswith("subslice"))][0].basic.attributes | keys[]')"
+  echo "$attrs" | grep -q subsliceShape
+  echo "$attrs" | grep -q subsliceOrigin
+}

@@ -193,6 +193,37 @@ def test_pyproject_entry_points_import():
         assert callable(getattr(m, func))
 
 
+def test_daemonset_render_matches_image_binaries():
+    # The controller-rendered per-CD DaemonSet execs a console script that
+    # must exist in the image (i.e. be declared in pyproject scripts), and
+    # must run under the chart's cd-daemon ServiceAccount.
+    with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
+        scripts = set(tomllib.load(f)["project"]["scripts"])
+
+    from tpu_dra.computedomain.controller.daemonset import DaemonSetManager
+
+    mgr = DaemonSetManager(
+        None, "tpu-dra-driver", "img:1", service_account="cd-daemon-sa"
+    )
+    cd = {
+        "metadata": {"uid": "u" * 36, "name": "cd", "namespace": "ns"},
+        "spec": {"numNodes": 2},
+    }
+    ds = mgr.render(cd) if hasattr(mgr, "render") else mgr._render(cd)
+    pod = ds["spec"]["template"]["spec"]
+    assert pod["serviceAccountName"] == "cd-daemon-sa"
+    for ctr in pod["containers"]:
+        assert ctr["command"][0] in scripts, ctr["command"]
+        probe = ctr.get("readinessProbe", {}).get("exec", {}).get("command")
+        if probe:
+            assert probe[0] in scripts
+    # the chart passes the SA name to the controller
+    text = read(os.path.join(TEMPLATES, "controller.yaml"))
+    assert "DAEMON_SERVICE_ACCOUNT" in text
+    rbac = read(os.path.join(TEMPLATES, "rbac.yaml"))
+    assert "-cd-daemon" in rbac
+
+
 def test_dockerfile_consistency():
     text = read(os.path.join(REPO, "deployments", "container", "Dockerfile"))
     from tpu_dra.tpulib.native import NATIVE_LIB_ENV

@@ -107,16 +107,12 @@ class CDDriver:
 
     def healthy(self) -> "tuple[bool, str]":
         """Liveness verdict for /healthz; see Driver.healthy."""
-        import os
+        from tpu_dra.infra.metrics import sockets_healthy
 
-        for path in getattr(self, "_socket_paths", []):
-            if not os.path.exists(path):
-                return False, f"socket missing: {path}"
-        registered = (
-            getattr(self, "registration", None) is not None
-            and self.registration.registered.is_set()
+        return sockets_healthy(
+            getattr(self, "_socket_paths", []),
+            getattr(self, "registration", None),
         )
-        return True, f"serving (kubelet registered: {registered})"
 
     MAX_DEVICES_PER_SLICE = 128  # apiserver validation cap on spec.devices
 

@@ -46,10 +46,14 @@ class ComputeDomainController:
         driver_namespace: str = "tpu-dra-driver",
         image: str = "tpu-dra-driver:latest",
         status_sync_period: float = 10.0,
+        daemon_service_account: str = "",
     ):
         self.backend = backend
         self.cds = ResourceClient(backend, COMPUTE_DOMAINS)
-        self.daemonsets = DaemonSetManager(backend, driver_namespace, image)
+        self.daemonsets = DaemonSetManager(
+            backend, driver_namespace, image,
+            service_account=daemon_service_account,
+        )
         self.rcts = ResourceClaimTemplateManager(backend)
         self.status = StatusManager(backend)
         self.node_labels = NodeLabelManager(backend)

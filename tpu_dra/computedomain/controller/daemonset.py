@@ -27,12 +27,16 @@ class DaemonSetManager:
         driver_namespace: str,
         image: str = "tpu-dra-driver:latest",
         additional_namespaces: Optional[List[str]] = None,
+        service_account: str = "",
     ):
         self.backend = backend
         self.daemonsets = ResourceClient(backend, DAEMON_SETS)
         self.pods = ResourceClient(backend, PODS)
         self.driver_namespace = driver_namespace
         self.image = image
+        # RBAC identity for daemon pods (clique registration needs write
+        # access to ComputeDomainCliques); empty means the namespace default.
+        self.service_account = service_account
         # mnsdaemonset.go analog: CDs may live in additional namespaces.
         self.namespaces = [driver_namespace] + (additional_namespaces or [])
 
@@ -72,6 +76,11 @@ class DaemonSetManager:
                         # Pods land only on nodes the workload touched
                         # ("CD follows workload").
                         "nodeSelector": {CD_LABEL_KEY: uid},
+                        **(
+                            {"serviceAccountName": self.service_account}
+                            if self.service_account
+                            else {}
+                        ),
                         "tolerations": [
                             {"key": "google.com/tpu", "operator": "Exists"}
                         ],

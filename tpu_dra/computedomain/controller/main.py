@@ -112,6 +112,11 @@ def main(argv=None) -> int:
     flags.add_feature_gate_flag(p)
     p.add_argument("--namespace", default=flags.env_default("NAMESPACE", "tpu-dra-driver"))
     p.add_argument("--image", default=flags.env_default("DAEMON_IMAGE", "tpu-dra-driver:latest"))
+    p.add_argument(
+        "--daemon-service-account",
+        default=flags.env_default("DAEMON_SERVICE_ACCOUNT", ""),
+        help="ServiceAccount for the per-CD daemon pods (clique RBAC)",
+    )
     args = p.parse_args(argv)
     flags.LoggingConfig.from_args(args).apply()
     signals.start_debug_signal_handlers()
@@ -120,7 +125,10 @@ def main(argv=None) -> int:
 
     backend = flags.KubeClientConfig.from_args(args).new_client()
     controller = ComputeDomainController(
-        backend, driver_namespace=args.namespace, image=args.image
+        backend,
+        driver_namespace=args.namespace,
+        image=args.image,
+        daemon_service_account=args.daemon_service_account,
     )
 
     stop = threading.Event()

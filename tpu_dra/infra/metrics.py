@@ -112,6 +112,20 @@ class MetricsServer:
         self._httpd.server_close()
 
 
+def sockets_healthy(socket_paths, registration) -> Tuple[bool, str]:
+    """Shared /healthz verdict for the kubelet plugins (health.go analog):
+    the DRA + registration unix sockets must still exist; kubelet
+    registration status is reported but does not fail liveness (it arrives
+    only after kubelet probes us)."""
+    import os
+
+    for path in socket_paths or []:
+        if not os.path.exists(path):
+            return False, f"socket missing: {path}"
+    registered = registration is not None and registration.registered.is_set()
+    return True, f"serving (kubelet registered: {registered})"
+
+
 def start_health_server(metrics: Metrics, port: int, healthz=None):
     """Start the /metrics + /healthz endpoint shared by the plugin binaries
     (cmd/*/health.go analog). Returns the running server, or None when the

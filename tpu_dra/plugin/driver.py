@@ -132,20 +132,13 @@ class Driver:
             s.stop(grace=1).wait(timeout=5)
 
     def healthy(self) -> "tuple[bool, str]":
-        """Liveness verdict for /healthz (health.go:51-149 analog): the DRA
-        and registration sockets must still exist on disk; kubelet
-        registration status is reported but does not fail liveness (it
-        arrives only after kubelet probes us)."""
-        import os
+        """Liveness verdict for /healthz (health.go:51-149 analog)."""
+        from tpu_dra.infra.metrics import sockets_healthy
 
-        for path in getattr(self, "_socket_paths", []):
-            if not os.path.exists(path):
-                return False, f"socket missing: {path}"
-        registered = (
-            getattr(self, "registration", None) is not None
-            and self.registration.registered.is_set()
+        return sockets_healthy(
+            getattr(self, "_socket_paths", []),
+            getattr(self, "registration", None),
         )
-        return True, f"serving (kubelet registered: {registered})"
 
     # --- health (driver.go:441-505) ---
 

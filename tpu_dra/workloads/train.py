@@ -160,3 +160,77 @@ class Trainer:
             return model.apply({"params": params}, tokens)
 
         return jax.jit(forward)
+
+
+# --- CLI (demo/e2e entrypoint: one worker per host of a DRA-allocated
+# slice; the driver-injected env drives jax.distributed bootstrap) ---
+
+MODEL_PRESETS = {
+    "llama3-8b": "LLAMA3_8B",
+    "tiny": "TINY_LLAMA",
+}
+
+
+def main(argv=None) -> int:
+    import argparse
+    import time
+
+    from tpu_dra.workloads.models import llama as llama_mod
+
+    p = argparse.ArgumentParser("tpu-dra-train")
+    p.add_argument("--model", choices=sorted(MODEL_PRESETS), default="tiny")
+    def positive_int(v):
+        n = int(v)
+        if n < 1:
+            raise argparse.ArgumentTypeError("must be >= 1")
+        return n
+    p.add_argument("--steps", type=positive_int, default=10)
+    p.add_argument("--batch", type=int, default=0, help="global batch (0: one per data shard)")
+    p.add_argument("--seq", type=int, default=512)
+    p.add_argument(
+        "--distributed",
+        action="store_true",
+        help="initialize jax.distributed from the driver-injected slice env",
+    )
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    if args.distributed:
+        from tpu_dra.workloads.bootstrap import initialize_from_env
+
+        slice_env = initialize_from_env()
+        log.info("slice bootstrap: %s", slice_env)
+
+    model_config = getattr(llama_mod, MODEL_PRESETS[args.model])
+    trainer = Trainer(model_config)
+    dp_shards = (
+        trainer.mesh.shape.get("dp", 1) * trainer.mesh.shape.get("fsdp", 1)
+    )
+    batch = args.batch or dp_shards
+    state = trainer.init_state(batch=batch, seq=args.seq)
+    step = trainer.make_train_step()
+
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1),
+        (batch, args.seq),
+        0,
+        model_config.vocab_size,
+        dtype=jnp.int32,
+    )
+    loss = None
+    t0 = time.monotonic()
+    for i in range(args.steps):
+        state, loss = step(state, tokens)
+    loss = float(loss)
+    dt = time.monotonic() - t0
+    tok_per_s = args.steps * batch * args.seq / dt if dt > 0 else 0.0
+    log.info(
+        "trained %d steps (%s, batch=%d seq=%d): loss=%.4f, %.0f tok/s",
+        args.steps, args.model, batch, args.seq, loss, tok_per_s,
+    )
+    print({"ok": loss == loss, "steps": args.steps, "loss": loss, "tok_per_s": tok_per_s})
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
