@@ -5,7 +5,12 @@ cd "$(dirname "$0")/../../.."
 
 CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra}"
 IMAGE="${IMAGE:-registry.local/tpu-dra-driver:v0.1.0}"
+WORKLOAD_IMAGE="${WORKLOAD_IMAGE:-registry.local/tpu-workload:latest}"
 
 docker build -t "${IMAGE}" -f deployments/container/Dockerfile .
+docker build -t "${WORKLOAD_IMAGE}" \
+  --build-arg "DRIVER_IMAGE=${IMAGE}" \
+  -f deployments/container/Dockerfile.workload .
 "${KIND:-kind}" load docker-image "${IMAGE}" --name "${CLUSTER_NAME}"
-echo "loaded ${IMAGE} into kind cluster ${CLUSTER_NAME}"
+"${KIND:-kind}" load docker-image "${WORKLOAD_IMAGE}" --name "${CLUSTER_NAME}"
+echo "loaded ${IMAGE} and ${WORKLOAD_IMAGE} into kind cluster ${CLUSTER_NAME}"

@@ -44,6 +44,19 @@ iupgrade_wait() {
     "ds/${TEST_RELEASE}-kubelet-plugin" --timeout=300s
 }
 
+# Apply a spec file, rewriting the resource.k8s.io apiVersion that specs pin
+# (v1beta1) to the version the cluster actually serves, as detected by
+# setup_suite.bash (reference: setup_suite.bash v1beta1-vs-v1 spec shims).
+k_apply() {
+  sed "s|resource.k8s.io/v1beta1|${TEST_RESOURCE_API_VERSION:-resource.k8s.io/v1beta1}|g" \
+    "$1" | kubectl apply -f -
+}
+
+k_delete() {
+  sed "s|resource.k8s.io/v1beta1|${TEST_RESOURCE_API_VERSION:-resource.k8s.io/v1beta1}|g" \
+    "$1" | kubectl delete --ignore-not-found -f -
+}
+
 uninstall_driver() {
   helm uninstall "${TEST_RELEASE}" --namespace "${TEST_NAMESPACE}" || true
   kubectl delete namespace "${TEST_NAMESPACE}" --ignore-not-found --timeout=120s
@@ -86,9 +99,11 @@ wait_for_all_tpu_resource_slices() {
 # the given driver.
 get_device_attrs_from_any_tpu_slice() {
   local driver="${1:-tpu.google.com}"
+  # `.basic // .`: v1beta1 wraps device fields in `.basic`; v1beta2/v1 hoist
+  # them to the device object itself.
   kubectl get resourceslices -o json | \
     jq -r --arg d "$driver" \
-      '[.items[] | select(.spec.driver == $d)][0].spec.devices[0].basic.attributes
+      '([.items[] | select(.spec.driver == $d)][0].spec.devices[0] | .basic // .).attributes
        | to_entries[] | "\(.key) \(.value | to_entries[0].value)"'
 }
 

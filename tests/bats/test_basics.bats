@@ -3,6 +3,9 @@
 setup_file() {
   load 'helpers.sh'
   _common_setup
+  # Make the suite rerunnable on a long-lived kind cluster: start from a
+  # clean slate so the clean-cluster assertion below is meaningful.
+  uninstall_driver
 }
 
 setup() {
@@ -52,8 +55,10 @@ bats::on_failure() {
 @test "basics: device attributes are sane" {
   local attrs
   attrs="$(get_device_attrs_from_any_tpu_slice tpu.google.com)"
-  echo "$attrs" | grep -q '^type tpu$'
+  assert_attr_equal "$attrs" type tpu
+  # Generation comes from the stub inventory on the kind path
+  # (demo/clusters/kind/stub-config.yaml).
+  [[ "${TEST_STUB_BACKEND}" != "1" ]] || assert_attr_equal "$attrs" generation v5e
   echo "$attrs" | grep -q '^uuid '
-  echo "$attrs" | grep -q '^generation '
   echo "$attrs" | grep -q '^topologyCoord '
 }

@@ -7,7 +7,11 @@ setup_file() {
   _common_setup
   local _iargs=()
   iupgrade_wait _iargs
-  kubectl apply -f "${REPO_ROOT}/demo/specs/computedomain/computedomain.yaml"
+  k_apply "${REPO_ROOT}/demo/specs/computedomain/computedomain.yaml"
+  # "CD follows workload": daemons only schedule onto nodes labeled by a
+  # workload channel-claim Prepare, so the domain cannot reach Ready until a
+  # workload lands (controller/daemonset.py nodeSelector on CD_LABEL_KEY).
+  k_apply "${REPO_ROOT}/demo/specs/computedomain/llama-pjit-job.yaml"
 }
 
 setup() {
@@ -36,16 +40,24 @@ bats::on_failure() {
 }
 
 @test "failover: delete all slice daemons at once, domain recovers" {
-  kubectl -n "${TEST_NAMESPACE}" delete pods -l tpu-dra-driver-component=cd-daemon \
-    --force --grace-period=0 || true
+  local n
+  n="$(kubectl -n "${TEST_NAMESPACE}" get pods \
+    -l app.kubernetes.io/name=compute-domain-daemon --no-headers | wc -l)"
+  [ "$n" -ge 1 ]
+  kubectl -n "${TEST_NAMESPACE}" delete pods \
+    -l app.kubernetes.io/name=compute-domain-daemon --force --grace-period=0
   wait_for_cd_status cd-demo v5p-16 Ready
 }
 
 @test "failover: workload job survives worker pod deletion" {
-  kubectl apply -f "${REPO_ROOT}/demo/specs/computedomain/llama-pjit-job.yaml"
+  # Re-create the job so the deletion hits a live run (the setup_file job may
+  # already be complete by now).
+  kubectl -n cd-demo delete job llama-pjit --ignore-not-found --timeout=120s
+  k_apply "${REPO_ROOT}/demo/specs/computedomain/llama-pjit-job.yaml"
   sleep 5
   local worker
   worker="$(kubectl -n cd-demo get pods -l job-name=llama-pjit -o name | head -1)"
-  [ -n "$worker" ] && kubectl -n cd-demo delete "$worker" --force --grace-period=0
+  [ -n "$worker" ]
+  kubectl -n cd-demo delete "$worker" --force --grace-period=0
   kubectl -n cd-demo wait --for=condition=complete job/llama-pjit --timeout=900s
 }

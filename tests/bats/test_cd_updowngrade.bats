@@ -1,0 +1,59 @@
+# shellcheck disable=SC2148
+# ComputeDomain up/downgrade (reference: test_cd_updowngrade.bats): a live
+# domain with a running workload must survive a chart upgrade — the CD
+# plugin's checkpoint and the controller's informer state both rebuild from
+# the API server on restart.
+
+setup_file() {
+  load 'helpers.sh'
+  _common_setup
+  local _iargs=()
+  iupgrade_wait _iargs
+  k_apply "${REPO_ROOT}/demo/specs/computedomain/computedomain.yaml"
+  # "CD follows workload": the job's channel claims label nodes, which
+  # schedules the per-CD daemons and drives the domain to Ready.
+  k_apply "${REPO_ROOT}/demo/specs/computedomain/llama-pjit-job.yaml"
+}
+
+setup() {
+  load 'helpers.sh'
+  _common_setup
+}
+
+teardown_file() {
+  kubectl delete namespace cd-demo --ignore-not-found --timeout=180s
+}
+
+bats::on_failure() {
+  log_objects
+  show_kubelet_plugin_log_tails
+}
+
+@test "cd-updowngrade: domain reaches Ready before the upgrade" {
+  wait_for_cd_status cd-demo v5p-16 Ready
+}
+
+@test "cd-updowngrade: domain stays functional across a chart upgrade" {
+  local _iargs=("--set" "logVerbosity=7")
+  iupgrade_wait _iargs
+  kubectl -n "${TEST_NAMESPACE}" rollout status \
+    "deploy/${TEST_RELEASE}-controller" --timeout=300s
+  wait_for_cd_status cd-demo v5p-16 Ready
+}
+
+@test "cd-updowngrade: workload completes after the upgrade" {
+  kubectl -n cd-demo wait --for=condition=complete job/llama-pjit \
+    --timeout=900s
+}
+
+@test "cd-updowngrade: deletion cleans up after the upgrade" {
+  kubectl -n cd-demo delete computedomain v5p-16 --timeout=180s
+  local left=1
+  for _ in $(seq 1 45); do
+    left="$(kubectl -n cd-demo get resourceclaimtemplate v5p-16-channel \
+      --no-headers 2>/dev/null | wc -l)"
+    [ "$left" -eq 0 ] && break
+    sleep 2
+  done
+  [ "$left" -eq 0 ]
+}

@@ -7,7 +7,7 @@ setup_file() {
   _common_setup
   local _iargs=()
   iupgrade_wait _iargs
-  kubectl apply -f "${REPO_ROOT}/demo/specs/computedomain/computedomain.yaml"
+  k_apply "${REPO_ROOT}/demo/specs/computedomain/computedomain.yaml"
 }
 
 setup() {
@@ -38,7 +38,7 @@ bats::on_failure() {
 }
 
 @test "cd: workload pod is gated until domain is ready, then starts" {
-  kubectl apply -f "${REPO_ROOT}/demo/specs/computedomain/llama-pjit-job.yaml"
+  k_apply "${REPO_ROOT}/demo/specs/computedomain/llama-pjit-job.yaml"
   # The pods stay in ContainerCreating while the CD is NotReady; once every
   # host registers, status flips Ready and the job runs.
   wait_for_cd_status cd-demo v5p-16 Ready
@@ -54,6 +54,7 @@ bats::on_failure() {
     [ "$left" -eq 0 ] && break
     sleep 2
   done
+  [ "$left" -eq 0 ]
   run bash -c "kubectl get nodes -o json | jq -r '[.items[].metadata.labels | keys[] | select(startswith(\"resource.tpu.google.com/computeDomain\"))] | length'"
   [ "$output" == "0" ]
 }

@@ -22,7 +22,7 @@ bats::on_failure() {
 }
 
 @test "tpu: 2 pods get 2 distinct chips" {
-  kubectl apply -f "${REPO_ROOT}/tests/bats/specs/tpu-2pods-2chips.yaml"
+  k_apply "${REPO_ROOT}/tests/bats/specs/tpu-2pods-2chips.yaml"
   kubectl -n bats-tpu-basic wait --for=condition=READY pods pod0 pod1 --timeout=120s
 
   run kubectl -n bats-tpu-basic logs pod0
@@ -38,7 +38,7 @@ bats::on_failure() {
 }
 
 @test "tpu: shared claim across two containers of one pod" {
-  kubectl apply -f "${REPO_ROOT}/demo/specs/quickstart/tpu-test2.yaml"
+  k_apply "${REPO_ROOT}/demo/specs/quickstart/tpu-test2.yaml"
   kubectl -n tpu-test2 wait --for=jsonpath='{.status.phase}'=Succeeded pod/pod --timeout=120s
   kubectl delete namespace tpu-test2 --ignore-not-found --timeout=120s
 }

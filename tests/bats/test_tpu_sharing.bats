@@ -26,7 +26,7 @@ bats::on_failure() {
 }
 
 @test "sharing: two pods share one chip via multiplexing" {
-  kubectl apply -f "${REPO_ROOT}/demo/specs/quickstart/tpu-test3.yaml"
+  k_apply "${REPO_ROOT}/demo/specs/quickstart/tpu-test3.yaml"
   kubectl -n tpu-test3 wait --for=jsonpath='{.status.phase}'=Succeeded pod/pod0 pod/pod1 --timeout=180s
   run kubectl -n tpu-test3 logs pod0
   [[ "$output" == *MULTIPLEX* ]] || [[ "$output" == *TPU_* ]]
@@ -34,8 +34,8 @@ bats::on_failure() {
 
 @test "sharing: invalid sharing config is rejected by admission" {
   # With the webhook (or validation at prepare), a bad interval must fail.
-  run kubectl apply -n tpu-test3 -f - <<'YAML'
-apiVersion: resource.k8s.io/v1beta1
+  run kubectl apply -n tpu-test3 -f - <<YAML
+apiVersion: ${TEST_RESOURCE_API_VERSION:-resource.k8s.io/v1beta1}
 kind: ResourceClaim
 metadata:
   name: bad-sharing

@@ -29,12 +29,13 @@ bats::on_failure() {
   local combined
   combined="$(kubectl get resourceslices -o json | \
     jq -r '[.items[] | select(.spec.driver == "tpu.google.com")
-            | .spec.devices[] | select(.basic.consumesCounters != null)] | length')"
+            | .spec.devices[] | (.basic // .)
+            | select(.consumesCounters != null)] | length')"
   [ "$combined" -gt 0 ]
 }
 
 @test "subslice: claim materializes a sub-slice" {
-  kubectl apply -f "${REPO_ROOT}/demo/specs/quickstart/tpu-test5.yaml"
+  k_apply "${REPO_ROOT}/demo/specs/quickstart/tpu-test5.yaml"
   kubectl -n tpu-test5 wait --for=jsonpath='{.status.phase}'=Succeeded pod/pod --timeout=180s
 }
 
@@ -42,7 +43,8 @@ bats::on_failure() {
   local attrs
   attrs="$(kubectl get resourceslices -o json | \
     jq -r '[.items[] | select(.spec.driver == "tpu.google.com")
-            | .spec.devices[] | select(.basic.attributes.type.string | startswith("subslice"))][0].basic.attributes | keys[]')"
+            | .spec.devices[] | (.basic // .)
+            | select(.attributes.type.string | startswith("subslice"))][0].attributes | keys[]')"
   echo "$attrs" | grep -q subsliceShape
   echo "$attrs" | grep -q subsliceOrigin
 }

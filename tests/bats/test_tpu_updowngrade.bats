@@ -1,0 +1,84 @@
+# shellcheck disable=SC2148
+# Chart upgrade/downgrade with live state (reference: test_gpu_updowngrade.bats):
+# a claim prepared by one driver rollout must survive the next — the
+# checkpoint carries both V1 and V2 schema renderings so either version can
+# read it (tpu_dra/plugin/checkpoint.py marshal).
+
+setup_file() {
+  load 'helpers.sh'
+  _common_setup
+  local _iargs=()
+  iupgrade_wait _iargs
+  k_apply "${REPO_ROOT}/tests/bats/specs/tpu-sleeper.yaml"
+  kubectl -n bats-updowngrade wait --for=jsonpath='{.status.phase}'=Running \
+    pod/sleeper --timeout=300s
+}
+
+setup() {
+  load 'helpers.sh'
+  _common_setup
+}
+
+teardown_file() {
+  kubectl delete namespace bats-updowngrade --ignore-not-found --timeout=180s
+}
+
+bats::on_failure() {
+  log_objects
+  show_kubelet_plugin_log_tails
+}
+
+@test "updowngrade: prepared claim survives a chart upgrade rollout" {
+  local _iargs=("--set" "logVerbosity=7")
+  iupgrade_wait _iargs
+  # The plugin restarted; the sleeper pod (and its prepared claim) must not.
+  run kubectl -n bats-updowngrade get pod sleeper \
+    -o jsonpath='{.status.phase} {.status.containerStatuses[0].restartCount}'
+  [ "$output" == "Running 0" ]
+}
+
+@test "updowngrade: node checkpoint carries both V1 and V2 renderings" {
+  # kind nodes are docker containers; read the checkpoint where the plugin
+  # wrote it on the node the sleeper landed on.
+  local node
+  node="$(kubectl -n bats-updowngrade get pod sleeper \
+    -o jsonpath='{.spec.nodeName}')"
+  run bash -c "docker exec '$node' \
+    cat /var/lib/kubelet/plugins/tpu.google.com/checkpoint.json | \
+    jq -r 'has(\"v1\") and has(\"v2\")'"
+  [ "$output" == "true" ]
+}
+
+@test "updowngrade: plugin re-registers after kubelet restart" {
+  local node
+  node="$(kubectl -n bats-updowngrade get pod sleeper \
+    -o jsonpath='{.spec.nodeName}')"
+  restart_kubelet_on_node "$node"
+  wait_for_all_tpu_resource_slices tpu.google.com
+}
+
+@test "updowngrade: controller survives rollout with new pod" {
+  local before after
+  before="$(get_current_controller_pod_name)"
+  local _iargs=("--set" "logVerbosity=6")
+  iupgrade_wait _iargs
+  kubectl -n "${TEST_NAMESPACE}" rollout status \
+    "deploy/${TEST_RELEASE}-controller" --timeout=300s
+  after="$(get_current_controller_pod_name)"
+  [ -n "$after" ]
+  [ "$before" != "$after" ]
+}
+
+@test "updowngrade: claim unprepare still works after the upgrades" {
+  k_delete "${REPO_ROOT}/tests/bats/specs/tpu-sleeper.yaml"
+  # Unprepare runs when the pod goes away; the claim must be released and
+  # deleted (it was created from a template, so it is owned by the pod).
+  for _ in $(seq 1 45); do
+    local left
+    left="$(kubectl -n bats-updowngrade get resourceclaims --no-headers \
+      2>/dev/null | wc -l)"
+    [ "$left" -eq 0 ] && return 0
+    sleep 2
+  done
+  return 1
+}

@@ -184,9 +184,17 @@ def main(argv=None) -> int:
         if n < 1:
             raise argparse.ArgumentTypeError("must be >= 1")
         return n
+    def nonnegative_int(v):
+        n = int(v)
+        if n < 0:
+            raise argparse.ArgumentTypeError("must be >= 0")
+        return n
     p.add_argument("--steps", type=positive_int, default=10)
-    p.add_argument("--batch", type=int, default=0, help="global batch (0: one per data shard)")
-    p.add_argument("--seq", type=int, default=512)
+    p.add_argument(
+        "--batch", type=nonnegative_int, default=0,
+        help="global batch (0: one per data shard)",
+    )
+    p.add_argument("--seq", type=positive_int, default=512)
     p.add_argument(
         "--distributed",
         action="store_true",
