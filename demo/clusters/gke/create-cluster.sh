@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Bring up a GKE cluster with a multi-host TPU slice node pool and DRA
+# enabled, ready for the tpu-dra-driver chart.
+#
+# Reference analog: demo/clusters/gke/install-dra-driver.sh (GPU clusters);
+# re-targeted at TPU node pools. Needs: gcloud, a project with TPU quota.
+set -euo pipefail
+
+PROJECT="${PROJECT:?set PROJECT}"
+REGION="${REGION:-us-east5}"
+ZONE="${ZONE:-us-east5-a}"
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra}"
+# v5p-16: 2 hosts x 4 chips over ICI — the BASELINE config-4 shape.
+TPU_TOPOLOGY="${TPU_TOPOLOGY:-2x2x2}"
+MACHINE_TYPE="${MACHINE_TYPE:-ct5p-hightpu-4t}"
+NUM_HOSTS="${NUM_HOSTS:-2}"
+# DRA needs 1.32+ with the resource.k8s.io API group serving.
+CLUSTER_VERSION="${CLUSTER_VERSION:-1.33}"
+
+gcloud container clusters create "${CLUSTER_NAME}" \
+  --project "${PROJECT}" \
+  --location "${ZONE}" \
+  --cluster-version "${CLUSTER_VERSION}" \
+  --enable-kubernetes-unstable-apis=resource.k8s.io/v1beta1/deviceclasses,resource.k8s.io/v1beta1/resourceclaims,resource.k8s.io/v1beta1/resourceclaimtemplates,resource.k8s.io/v1beta1/resourceslices \
+  --num-nodes 1
+
+gcloud container node-pools create tpu-slice \
+  --project "${PROJECT}" \
+  --location "${ZONE}" \
+  --cluster "${CLUSTER_NAME}" \
+  --machine-type "${MACHINE_TYPE}" \
+  --tpu-topology "${TPU_TOPOLOGY}" \
+  --num-nodes "${NUM_HOSTS}" \
+  --node-labels cloud.google.com/gke-tpu-topology="${TPU_TOPOLOGY}"
+
+gcloud container clusters get-credentials "${CLUSTER_NAME}" \
+  --project "${PROJECT}" --location "${ZONE}"
+
+echo "cluster ${CLUSTER_NAME} up; install the driver with ./install-driver.sh"

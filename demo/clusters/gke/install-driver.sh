@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Install the tpu-dra-driver chart on the current kubectl context with the
+# real (linux) tpulib backend — chips are discovered from PCI sysfs +
+# /dev/accel + /dev/vfio on the TPU hosts.
+set -euo pipefail
+cd "$(dirname "$0")/../../.."
+
+RELEASE="${RELEASE:-tpu-dra-driver}"
+NAMESPACE="${NAMESPACE:-tpu-dra-driver}"
+IMAGE_REPO="${IMAGE_REPO:?set IMAGE_REPO (e.g. gcr.io/<project>/tpu-dra-driver)}"
+IMAGE_TAG="${IMAGE_TAG:-v0.1.0}"
+
+helm upgrade --install "${RELEASE}" deployments/helm/tpu-dra-driver \
+  --create-namespace --namespace "${NAMESPACE}" \
+  --set image.repository="${IMAGE_REPO}" \
+  --set image.tag="${IMAGE_TAG}" \
+  --set tpulibBackend=linux
+
+# The DaemonSet name derives from the chart name, not the release.
+kubectl -n "${NAMESPACE}" rollout status ds/tpu-dra-driver-kubelet-plugin \
+  --timeout=300s
+kubectl get resourceslices -o wide

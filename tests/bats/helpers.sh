@@ -40,8 +40,9 @@ iupgrade_wait() {
   fi
   args+=("${_extra_args[@]}")
   helm "${args[@]}"
+  # The DaemonSet name derives from the chart name, not the release.
   kubectl -n "${TEST_NAMESPACE}" rollout status \
-    "ds/${TEST_RELEASE}-kubelet-plugin" --timeout=300s
+    ds/tpu-dra-driver-kubelet-plugin --timeout=300s
 }
 
 # Apply a spec file, rewriting the resource.k8s.io apiVersion that specs pin

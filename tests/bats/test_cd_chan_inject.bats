@@ -1,0 +1,45 @@
+# shellcheck disable=SC2148
+# Channel-injection modes (reference: test_cd_imex_chan_inject.bats): the
+# slice-membership "channel" surface a workload pod sees — bootstrap env +
+# the per-CD config-dir mount — under default and allocationMode=All claims.
+
+setup_file() {
+  load 'helpers.sh'
+  _common_setup
+  local _iargs=()
+  iupgrade_wait _iargs
+  kubectl create namespace cd-demo --dry-run=client -o yaml | kubectl apply -f -
+}
+
+setup() {
+  load 'helpers.sh'
+  _common_setup
+}
+
+teardown_file() {
+  kubectl delete namespace cd-demo --ignore-not-found --timeout=180s
+}
+
+bats::on_failure() {
+  log_objects
+  show_kubelet_plugin_log_tails
+}
+
+@test "chan-inject: allocationMode All injects the slice bootstrap surface" {
+  k_apply "${REPO_ROOT}/demo/specs/computedomain/channel-injection-all.yaml"
+  kubectl -n cd-demo wait --for=jsonpath='{.status.phase}'=Succeeded \
+    pod/channel-inspect --timeout=600s
+  run kubectl -n cd-demo logs channel-inspect
+  # The injected env must carry the multi-host bootstrap identity.
+  [[ "$output" == *TPU_WORKER_ID* ]]
+  [[ "$output" == *TPU_WORKER_HOSTNAMES* ]]
+}
+
+@test "chan-inject: channel claim in the wrong namespace is rejected" {
+  # The CD lives in cd-demo; a claim referencing its template from another
+  # namespace must never prepare (AssertComputeDomainNamespace analog).
+  kubectl create namespace cd-demo-other --dry-run=client -o yaml | kubectl apply -f -
+  run kubectl -n cd-demo-other get resourceclaimtemplate all-channels-rct
+  [ "$status" -ne 0 ]
+  kubectl delete namespace cd-demo-other --ignore-not-found --timeout=120s
+}

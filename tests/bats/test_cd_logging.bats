@@ -1,0 +1,51 @@
+# shellcheck disable=SC2148
+# Structured timing-log assertions (reference: test_cd_logging.bats): the
+# prepare path emits t_prep_* wall-time markers at high verbosity — the
+# observability basis for the claim-latency metric in BASELINE.md.
+
+setup_file() {
+  load 'helpers.sh'
+  _common_setup
+  local _iargs=("--set" "logVerbosity=7")
+  iupgrade_wait _iargs
+}
+
+setup() {
+  load 'helpers.sh'
+  _common_setup
+}
+
+teardown_file() {
+  kubectl delete namespace tpu-test2 --ignore-not-found --timeout=180s
+}
+
+bats::on_failure() {
+  log_objects
+  show_kubelet_plugin_log_tails
+}
+
+@test "logging: prepare emits t_prep_* timing markers" {
+  k_apply "${REPO_ROOT}/demo/specs/quickstart/tpu-test2.yaml"
+  kubectl -n tpu-test2 wait --for=jsonpath='{.status.phase}'=Succeeded \
+    pod/pod --timeout=300s
+  local pods logs=""
+  pods="$(kubectl -n "${TEST_NAMESPACE}" get pods \
+    -l tpu-dra-driver-component=kubelet-plugin -o name)"
+  for p in $pods; do
+    logs+="$(kubectl -n "${TEST_NAMESPACE}" logs "$p" -c tpus --tail=-1 || true)"
+  done
+  [[ "$logs" == *t_prep_lock_acq* ]]
+  [[ "$logs" == *t_prep_total* ]]
+}
+
+@test "logging: unprepare leaves no ERROR lines for the happy path" {
+  kubectl delete namespace tpu-test2 --ignore-not-found --timeout=180s
+  sleep 5
+  local pods
+  pods="$(kubectl -n "${TEST_NAMESPACE}" get pods \
+    -l tpu-dra-driver-component=kubelet-plugin -o name)"
+  for p in $pods; do
+    run bash -c "kubectl -n ${TEST_NAMESPACE} logs $p -c tpus --tail=200 | grep -c ' E '"
+    [ "$output" == "0" ] || [ "$status" -ne 0 ]
+  done
+}

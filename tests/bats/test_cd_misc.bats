@@ -1,0 +1,79 @@
+# shellcheck disable=SC2148
+# Misc ComputeDomain invariants (reference: test_cd_misc.bats).
+
+setup_file() {
+  load 'helpers.sh'
+  _common_setup
+  local _iargs=()
+  iupgrade_wait _iargs
+  k_apply "${REPO_ROOT}/demo/specs/computedomain/computedomain.yaml"
+}
+
+setup() {
+  load 'helpers.sh'
+  _common_setup
+}
+
+teardown_file() {
+  kubectl delete namespace cd-demo --ignore-not-found --timeout=180s
+}
+
+bats::on_failure() {
+  log_objects
+  show_kubelet_plugin_log_tails
+}
+
+@test "misc: controller stamps daemon + workload claim templates" {
+  local rct
+  for rct in v5p-16-daemon-claim v5p-16-channel; do
+    local found=1
+    for _ in $(seq 1 30); do
+      kubectl -n cd-demo get resourceclaimtemplate "$rct" >/dev/null 2>&1 \
+        && { found=0; break; }
+      sleep 2
+    done
+    [ "$found" -eq 0 ]
+  done
+}
+
+@test "misc: workload RCT embeds opaque channel config with the CD's UID" {
+  local uid cfg_uid
+  uid="$(kubectl -n cd-demo get computedomain v5p-16 -o jsonpath='{.metadata.uid}')"
+  cfg_uid="$(kubectl -n cd-demo get resourceclaimtemplate v5p-16-channel -o json | \
+    jq -r '.. | .domainID? // empty' | head -1)"
+  [ -n "$uid" ]
+  [ "$cfg_uid" == "$uid" ]
+}
+
+@test "misc: CD carries our finalizer while alive" {
+  run kubectl -n cd-demo get computedomain v5p-16 \
+    -o jsonpath='{.metadata.finalizers[0]}'
+  [[ "$output" == *computedomain-finalizer* ]] || [[ "$output" == *tpu.google.com* ]]
+}
+
+@test "misc: duplicate ComputeDomain names in different namespaces coexist" {
+  kubectl create namespace cd-demo2 --dry-run=client -o yaml | kubectl apply -f -
+  sed 's/namespace: cd-demo/namespace: cd-demo2/' \
+    "${REPO_ROOT}/demo/specs/computedomain/computedomain.yaml" | kubectl apply -f -
+  local found=1
+  for _ in $(seq 1 30); do
+    kubectl -n cd-demo2 get resourceclaimtemplate v5p-16-channel >/dev/null 2>&1 \
+      && { found=0; break; }
+    sleep 2
+  done
+  [ "$found" -eq 0 ]
+  kubectl -n cd-demo2 delete computedomain v5p-16 --timeout=180s
+  kubectl delete namespace cd-demo2 --ignore-not-found --timeout=180s
+}
+
+@test "misc: deleting a CD with no workload cleans up promptly" {
+  kubectl -n cd-demo delete computedomain v5p-16 --timeout=180s
+  local left=1
+  for _ in $(seq 1 45); do
+    left="$(kubectl -n cd-demo get resourceclaimtemplates --no-headers \
+      2>/dev/null | wc -l)"
+    [ "$left" -eq 0 ] && break
+    sleep 2
+  done
+  [ "$left" -eq 0 ]
+}

@@ -37,7 +37,7 @@ bats::on_failure() {
   local _iargs=("--set" "logVerbosity=7")
   iupgrade_wait _iargs
   kubectl -n "${TEST_NAMESPACE}" rollout status \
-    "deploy/${TEST_RELEASE}-controller" --timeout=300s
+    deploy/tpu-dra-driver-controller --timeout=300s
   wait_for_cd_status cd-demo v5p-16 Ready
 }
 

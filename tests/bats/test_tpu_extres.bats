@@ -1,0 +1,68 @@
+# shellcheck disable=SC2148
+# Extended-resource -> DRA bridging (reference: test_gpu_extres.bats): a pod
+# asking for the classic `google.com/tpu` extended resource is satisfied by
+# DRA allocation via the DeviceClass's extendedResourceName (only served on
+# resource.k8s.io/v1 clusters).
+
+setup_file() {
+  load 'helpers.sh'
+  _common_setup
+  if [[ "${TEST_RESOURCE_API_VERSION:-}" != "resource.k8s.io/v1" ]]; then
+    skip "extendedResourceName needs resource.k8s.io/v1 (have ${TEST_RESOURCE_API_VERSION:-unset})"
+  fi
+  local _iargs=()
+  iupgrade_wait _iargs
+}
+
+setup() {
+  load 'helpers.sh'
+  _common_setup
+  if [[ "${TEST_RESOURCE_API_VERSION:-}" != "resource.k8s.io/v1" ]]; then
+    skip "extendedResourceName needs resource.k8s.io/v1"
+  fi
+}
+
+teardown_file() {
+  kubectl delete namespace bats-extres --ignore-not-found --timeout=180s
+}
+
+bats::on_failure() {
+  log_objects
+  show_kubelet_plugin_log_tails
+}
+
+@test "extres: DeviceClass advertises the extended-resource bridge" {
+  run kubectl get deviceclass tpu.google.com \
+    -o jsonpath='{.spec.extendedResourceName}'
+  [ "$output" == "google.com/tpu" ]
+}
+
+@test "extres: classic resources.limits pod gets a DRA-allocated chip" {
+  kubectl create namespace bats-extres --dry-run=client -o yaml | kubectl apply -f -
+  cat <<EOF | kubectl apply -f -
+apiVersion: v1
+kind: Pod
+metadata:
+  namespace: bats-extres
+  name: classic
+spec:
+  restartPolicy: Never
+  containers:
+  - name: ctr
+    image: ${TEST_IMAGE_REPO}:${TEST_IMAGE_TAG}
+    command: ["python", "-c"]
+    args: ["import os; print('TPU_VISIBLE_DEVICES=' + os.environ.get('TPU_VISIBLE_DEVICES', 'MISSING'))"]
+    resources:
+      limits:
+        google.com/tpu: 1
+  tolerations:
+  - key: google.com/tpu
+    operator: Exists
+    effect: NoSchedule
+EOF
+  kubectl -n bats-extres wait --for=jsonpath='{.status.phase}'=Succeeded \
+    pod/classic --timeout=300s
+  run kubectl -n bats-extres logs classic
+  [[ "$output" == *TPU_VISIBLE_DEVICES=* ]]
+  [[ "$output" != *MISSING* ]]
+}

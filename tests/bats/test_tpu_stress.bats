@@ -1,0 +1,130 @@
+# shellcheck disable=SC2148
+# Claim churn under load (reference: test_gpu_stress.bats): many short-lived
+# claims against the same chips; the checkpointed state machine must never
+# double-allocate or leak prepared devices.
+
+setup_file() {
+  load 'helpers.sh'
+  _common_setup
+  local _iargs=()
+  iupgrade_wait _iargs
+}
+
+setup() {
+  load 'helpers.sh'
+  _common_setup
+}
+
+teardown_file() {
+  kubectl delete namespace bats-stress --ignore-not-found --timeout=300s
+}
+
+bats::on_failure() {
+  log_objects
+  show_kubelet_plugin_log_tails
+}
+
+@test "stress: 20 sequential claim cycles leave no leaked state" {
+  kubectl create namespace bats-stress --dry-run=client -o yaml | kubectl apply -f -
+  for i in $(seq 1 20); do
+    cat <<EOF | sed "s|resource.k8s.io/v1beta1|${TEST_RESOURCE_API_VERSION:-resource.k8s.io/v1beta1}|" | kubectl apply -f -
+apiVersion: resource.k8s.io/v1beta1
+kind: ResourceClaim
+metadata:
+  namespace: bats-stress
+  name: churn-$i
+spec:
+  devices:
+    requests:
+    - name: tpu
+      deviceClassName: tpu.google.com
+EOF
+  done
+  # Pods cycling through the claims in waves of 4 (the stub host has 4 chips).
+  for wave in 0 1 2 3 4; do
+    for j in 1 2 3 4; do
+      local i=$((wave * 4 + j))
+      [ "$i" -le 20 ] || continue
+      cat <<EOF | kubectl apply -f -
+apiVersion: v1
+kind: Pod
+metadata:
+  namespace: bats-stress
+  name: churn-pod-$i
+spec:
+  restartPolicy: Never
+  containers:
+  - name: ctr
+    image: ${TEST_IMAGE_REPO}:${TEST_IMAGE_TAG}
+    command: ["python", "-c", "print('ok')"]
+    resources:
+      claims:
+      - name: tpu
+  resourceClaims:
+  - name: tpu
+    resourceClaimName: churn-$i
+  tolerations:
+  - key: google.com/tpu
+    operator: Exists
+    effect: NoSchedule
+EOF
+    done
+    for j in 1 2 3 4; do
+      local i=$((wave * 4 + j))
+      [ "$i" -le 20 ] || continue
+      kubectl -n bats-stress wait --for=jsonpath='{.status.phase}'=Succeeded \
+        "pod/churn-pod-$i" --timeout=300s
+      kubectl -n bats-stress delete pod "churn-pod-$i" --timeout=120s
+    done
+  done
+  # After the churn every claim must be deallocated (no pod references it).
+  run bash -c "kubectl -n bats-stress get resourceclaims -o json | \
+    jq '[.items[] | select(.status.allocation != null and .status.reservedFor != null and (.status.reservedFor | length) > 0)] | length'"
+  [ "$output" == "0" ]
+}
+
+@test "stress: overcommit claim stays pending, then schedules after release" {
+  # 4-chip stub host: a 5th concurrent single-chip pod cannot schedule.
+  for i in 1 2 3 4 5; do
+    cat <<EOF | sed "s|resource.k8s.io/v1beta1|${TEST_RESOURCE_API_VERSION:-resource.k8s.io/v1beta1}|" | kubectl apply -f -
+apiVersion: resource.k8s.io/v1beta1
+kind: ResourceClaim
+metadata:
+  namespace: bats-stress
+  name: over-$i
+spec:
+  devices:
+    requests:
+    - name: tpu
+      deviceClassName: tpu.google.com
+EOF
+    cat <<EOF | kubectl apply -f -
+apiVersion: v1
+kind: Pod
+metadata:
+  namespace: bats-stress
+  name: over-pod-$i
+spec:
+  restartPolicy: Never
+  containers:
+  - name: ctr
+    image: ${TEST_IMAGE_REPO}:${TEST_IMAGE_TAG}
+    command: ["python", "-c", "import time; time.sleep(30)"]
+    resources:
+      claims:
+      - name: tpu
+  resourceClaims:
+  - name: tpu
+    resourceClaimName: over-$i
+  tolerations:
+  - key: google.com/tpu
+    operator: Exists
+    effect: NoSchedule
+EOF
+  done
+  # All five eventually run (the fifth after one of the first four exits).
+  for i in 1 2 3 4 5; do
+    kubectl -n bats-stress wait --for=jsonpath='{.status.phase}'=Succeeded \
+      "pod/over-pod-$i" --timeout=600s
+  done
+}

@@ -63,7 +63,7 @@ bats::on_failure() {
   local _iargs=("--set" "logVerbosity=6")
   iupgrade_wait _iargs
   kubectl -n "${TEST_NAMESPACE}" rollout status \
-    "deploy/${TEST_RELEASE}-controller" --timeout=300s
+    deploy/tpu-dra-driver-controller --timeout=300s
   after="$(get_current_controller_pod_name)"
   [ -n "$after" ]
   [ "$before" != "$after" ]

@@ -681,3 +681,126 @@ def test_leader_election_reenters_after_loss(fc):
     elector.stop()
     t.join(timeout=3)
     assert len(starts) >= 2
+
+
+# --- legacy (ComputeDomainCliques=off) status path + podmanager -------------
+
+
+def _cliques_off():
+    from tpu_dra.infra import featuregates as fg
+
+    fg.feature_gates().set_from_string("ComputeDomainCliques=false")
+
+
+def _make_daemon_pod(fc, cd, node_name, ready=True):
+    pods = ResourceClient(fc, PODS)
+    return pods.create(
+        {
+            "metadata": {
+                "name": f"daemon-{node_name}",
+                "namespace": DRIVER_NS,
+                "labels": {CD_LABEL_KEY: cd["metadata"]["uid"]},
+            },
+            "spec": {"nodeName": node_name},
+            "status": {
+                "conditions": [
+                    {"type": "Ready", "status": "True" if ready else "False"}
+                ]
+            },
+        }
+    )
+
+
+def test_legacy_direct_status_registration(fc, tmp_path):
+    """Gate off: daemons write straight into CD.Status.Nodes with stable
+    gap-filled indices; no clique objects appear (cdstatus.go:223-333)."""
+    from tpu_dra.computedomain.daemon.status_legacy import (
+        DirectStatusRegistration,
+    )
+
+    _cliques_off()
+    cd = make_cd(fc, num_nodes=2)
+    d0 = make_daemon(fc, cd, 0, tmp_path)
+    d1 = make_daemon(fc, cd, 1, tmp_path)
+    assert isinstance(d0.registration, DirectStatusRegistration)
+    d0.run_once()
+    d1.run_once()
+    d0.run_once()
+    d1.run_once()
+    cds = ResourceClient(fc, COMPUTE_DOMAINS)
+    nodes = cds.get("cd1", NS)["status"]["nodes"]
+    assert {n["index"] for n in nodes} == {0, 1}
+    assert all(n["status"] == "Ready" for n in nodes)
+    cliques = ResourceClient(fc, COMPUTE_DOMAIN_CLIQUES)
+    assert not cliques.list(namespace=NS)
+    # Deregistration removes only our entry.
+    d1.registration.deregister()
+    nodes = cds.get("cd1", NS)["status"]["nodes"]
+    assert [n["name"] for n in nodes] == ["node-0"]
+
+
+def test_legacy_controller_aggregation_and_pruning(fc, tmp_path):
+    """Gate off: the controller aggregates the daemon-written Status.Nodes
+    and prunes entries whose daemon pod is gone (daemonsetpods.go analog)."""
+    _cliques_off()
+    cd = make_cd(fc, num_nodes=2)
+    c = ComputeDomainController(fc, driver_namespace=DRIVER_NS)
+    cds = ResourceClient(fc, COMPUTE_DOMAINS)
+    d0 = make_daemon(fc, cd, 0, tmp_path)
+    d1 = make_daemon(fc, cd, 1, tmp_path)
+    _make_daemon_pod(fc, cd, "node-0")
+    _make_daemon_pod(fc, cd, "node-1")
+    d0.run_once()
+    d1.run_once()
+    d0.run_once()
+    d1.run_once()
+    reconcile(c, cds.get("cd1", NS))
+    assert cds.get("cd1", NS)["status"]["status"] == "Ready"
+    # node-1's daemon pod dies: its entry is pruned, the domain degrades.
+    ResourceClient(fc, PODS).delete("daemon-node-1", DRIVER_NS)
+    reconcile(c, cds.get("cd1", NS))
+    cur = cds.get("cd1", NS)
+    assert cur["status"]["status"] == "NotReady"
+    assert [n["name"] for n in cur["status"]["nodes"]] == ["node-0"]
+
+
+def test_podmanager_readiness_propagation(fc, tmp_path):
+    """The registration status follows the pod's kubelet-probed Ready
+    condition when observable (podmanager.go:32-149)."""
+    cd = make_cd(fc, name="cdp", num_nodes=1)
+    pods = ResourceClient(fc, PODS)
+    pods.create(
+        {
+            "metadata": {"name": "own-pod", "namespace": DRIVER_NS},
+            "spec": {"nodeName": "node-0"},
+            "status": {
+                "conditions": [{"type": "Ready", "status": "False"}]
+            },
+        }
+    )
+    config = DaemonConfig(
+        cd_uid=cd["metadata"]["uid"],
+        cd_name="cdp",
+        cd_namespace=NS,
+        num_nodes=1,
+        node_name="node-0",
+        pod_ip="10.0.0.1",
+        config_dir=str(tmp_path / "cd-config"),
+        hosts_path=str(tmp_path / "hosts"),
+        pod_name="own-pod",
+        pod_namespace=DRIVER_NS,
+    )
+    daemon = SliceDaemon(config, fc, tpulib=make_stub(0))
+    os.makedirs(config.config_dir, exist_ok=True)
+    # Local view is ready (1/1 peers, healthy chips), but the pod condition
+    # is False -> registration must report NotReady.
+    assert daemon.run_once() is True
+    [peer] = daemon.registration.peers()
+    assert peer["status"] == "NotReady"
+    # kubelet flips the pod Ready (readiness probe saw the ready file).
+    pod = pods.get("own-pod", DRIVER_NS)
+    pod["status"]["conditions"][0]["status"] = "True"
+    pods.update_status(pod)
+    daemon.run_once()
+    [peer] = daemon.registration.peers()
+    assert peer["status"] == "Ready"

@@ -462,3 +462,45 @@ def test_checkpoint_roundtrip_carries_v1_for_downgrade(tmp_path):
     top = json.loads(open(cpm.path).read())
     assert "u3" not in top["v1"]["preparedClaims"]
     assert "u3" in top["v2"]["preparedClaims"]
+
+
+def test_checkpoint_legacy_flat_migration(tmp_path):
+    """A pre-versioning flat checkpoint (no v1/v2 wrapper, no checksum —
+    checkpoint_legacy.go analog) loads and the next write persists the
+    versioned V1+V2 rendering."""
+    legacy = {
+        "preparedClaims": {
+            "legacy-uid": {
+                "status": {},
+                "preparedDevices": [
+                    {
+                        "devices": [
+                            {
+                                "type": "tpu",
+                                "device": {
+                                    "requests": ["r"],
+                                    "poolName": "n",
+                                    "deviceName": "tpu-0",
+                                    "cdiDeviceIDs": [],
+                                },
+                                "chipUUID": "u",
+                            }
+                        ],
+                        "configState": {},
+                    }
+                ],
+            }
+        }
+    }
+    (tmp_path / "checkpoint.json").write_text(json.dumps(legacy))
+    cpm = CheckpointManager(str(tmp_path))
+    cp = cpm.get()
+    pc = cp.prepared_claims["legacy-uid"]
+    assert pc.checkpoint_state == CLAIM_STATE_PREPARE_COMPLETED
+    assert pc.prepared_devices.device_names() == ["tpu-0"]
+    # Touch-write, then assert the on-disk file is versioned now.
+    cpm.update(lambda c: None)
+    top = json.loads((tmp_path / "checkpoint.json").read_text())
+    assert "v1" in top and "v2" in top
+    cp2 = cpm.get()
+    assert "legacy-uid" in cp2.prepared_claims

@@ -55,7 +55,7 @@ class ComputeDomainController:
             service_account=daemon_service_account,
         )
         self.rcts = ResourceClaimTemplateManager(backend)
-        self.status = StatusManager(backend)
+        self.status = StatusManager(backend, driver_namespace=driver_namespace)
         self.node_labels = NodeLabelManager(backend)
         self.queue = WorkQueue(default_controller_rate_limiter())
         self.cd_informer = Informer(backend, COMPUTE_DOMAINS)

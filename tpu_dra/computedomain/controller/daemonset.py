@@ -15,6 +15,7 @@ import logging
 from typing import Dict, List, Optional
 
 from tpu_dra.computedomain import CD_FINALIZER, CD_LABEL_KEY
+from tpu_dra.infra import featuregates
 from tpu_dra.k8sclient import DAEMON_SETS, PODS, ApiNotFound, ResourceClient
 
 log = logging.getLogger(__name__)
@@ -127,6 +128,36 @@ class DaemonSetManager:
                                                 "fieldPath": "status.podIP"
                                             }
                                         },
+                                    },
+                                    # Own-pod identity for the podmanager
+                                    # readiness watcher (podmanager.go).
+                                    {
+                                        "name": "POD_NAME",
+                                        "valueFrom": {
+                                            "fieldRef": {
+                                                "fieldPath": "metadata.name"
+                                            }
+                                        },
+                                    },
+                                    {
+                                        "name": "POD_NAMESPACE",
+                                        "valueFrom": {
+                                            "fieldRef": {
+                                                "fieldPath": "metadata.namespace"
+                                            }
+                                        },
+                                    },
+                                    # Propagate the controller's gate view so
+                                    # daemon and controller pick the same
+                                    # clique-vs-direct status path.
+                                    {
+                                        "name": "FEATURE_GATES",
+                                        "value": ",".join(
+                                            f"{k}={str(v).lower()}"
+                                            for k, v in sorted(
+                                                featuregates.to_map().items()
+                                            )
+                                        ),
                                     },
                                 ],
                                 # Probes exec the daemon's own check

@@ -16,15 +16,24 @@ from typing import Dict, List
 
 from tpu_dra.api import CD_STATUS_NOT_READY, CD_STATUS_READY
 from tpu_dra.computedomain import CD_LABEL_KEY
-from tpu_dra.k8sclient import COMPUTE_DOMAIN_CLIQUES, COMPUTE_DOMAINS, ResourceClient
+from tpu_dra.infra import featuregates
+from tpu_dra.k8sclient import (
+    COMPUTE_DOMAIN_CLIQUES,
+    COMPUTE_DOMAINS,
+    PODS,
+    ApiConflict,
+    ResourceClient,
+)
 
 log = logging.getLogger(__name__)
 
 
 class StatusManager:
-    def __init__(self, backend):
+    def __init__(self, backend, driver_namespace: str = "tpu-dra-driver"):
         self.cds = ResourceClient(backend, COMPUTE_DOMAINS)
         self.cliques = ResourceClient(backend, COMPUTE_DOMAIN_CLIQUES)
+        self.pods = ResourceClient(backend, PODS)
+        self.driver_namespace = driver_namespace
 
     def cliques_for(self, cd: dict) -> List[dict]:
         return self.cliques.list(
@@ -32,9 +41,67 @@ class StatusManager:
             label_selector={CD_LABEL_KEY: cd["metadata"]["uid"]},
         )
 
+    def _daemon_pod_node_names(self, cd: dict) -> set:
+        """Nodes currently running a daemon pod for this CD
+        (daemonsetpods.go analog — used to prune stale entries on the
+        legacy path, where no clique object scopes liveness)."""
+        pods = self.pods.list(
+            namespace=self.driver_namespace,
+            label_selector={CD_LABEL_KEY: cd["metadata"]["uid"]},
+        )
+        return {
+            p["spec"].get("nodeName", "")
+            for p in pods
+            if not p["metadata"].get("deletionTimestamp")
+        }
+
     def sync(self, cd: dict) -> dict:
-        """Recompute Status.Nodes + global status from clique registrations;
-        persist when changed. Returns the updated CD."""
+        """Recompute Status.Nodes + global status from clique registrations
+        (or, on the legacy ComputeDomainCliques=off path, take the
+        daemon-written Status.Nodes pruned to live daemon pods —
+        cdstatus.go:286-354); persist when changed. Returns the updated CD.
+
+        Each attempt recomputes from a **fresh** read and writes with that
+        read's resourceVersion: on the legacy path Status.Nodes is
+        daemon-owned, so blind-overwriting with stale-derived data would
+        erase concurrent daemon registrations (lost update). A conflict
+        means a daemon won the race — re-derive and retry."""
+        name, ns = cd["metadata"]["name"], cd["metadata"]["namespace"]
+        for _ in range(20):
+            cur = self.cds.try_get(name, ns)
+            if cur is None:
+                return cd
+            if featuregates.enabled(featuregates.COMPUTE_DOMAIN_CLIQUES):
+                nodes = self._nodes_from_cliques(cur)
+            else:
+                nodes = self._nodes_from_status(cur)
+            num_ready = sum(
+                1 for n in nodes if n.get("status") == CD_STATUS_READY
+            )
+            want = cur["spec"]["numNodes"]
+            status = (
+                CD_STATUS_READY if num_ready >= want else CD_STATUS_NOT_READY
+            )
+            new_status = {"status": status, "nodes": nodes}
+            if cur.get("status") == new_status:
+                return cur
+            cur["status"] = new_status
+            try:
+                cur = self.cds.update_status(cur)
+            except ApiConflict:
+                continue
+            log.info(
+                "computedomain %s/%s status=%s (%d/%d nodes ready)",
+                ns, name, status, num_ready, want,
+            )
+            return cur
+        log.warning(
+            "computedomain %s/%s status sync: too many write conflicts; "
+            "deferring to the next periodic sync", ns, name,
+        )
+        return cd
+
+    def _nodes_from_cliques(self, cd: dict) -> List[dict]:
         nodes: List[dict] = []
         for clique in self.cliques_for(cd):
             clique_id = clique["metadata"]["name"].removeprefix(
@@ -51,23 +118,17 @@ class StatusManager:
                     }
                 )
         nodes.sort(key=lambda n: (n["cliqueID"], n["index"]))
-        num_ready = sum(1 for n in nodes if n["status"] == CD_STATUS_READY)
-        want = cd["spec"]["numNodes"]
-        status = CD_STATUS_READY if num_ready >= want else CD_STATUS_NOT_READY
-        new_status = {"status": status, "nodes": nodes}
-        if cd.get("status") != new_status:
-            cd = self.cds.get(cd["metadata"]["name"], cd["metadata"]["namespace"])
-            cd["status"] = new_status
-            cd = self.cds.update_status(cd)
-            log.info(
-                "computedomain %s/%s status=%s (%d/%d nodes ready)",
-                cd["metadata"]["namespace"],
-                cd["metadata"]["name"],
-                status,
-                num_ready,
-                want,
-            )
-        return cd
+        return nodes
+
+    def _nodes_from_status(self, cd: dict) -> List[dict]:
+        live = self._daemon_pod_node_names(cd)
+        nodes = [
+            dict(n)
+            for n in (cd.get("status") or {}).get("nodes") or []
+            if n.get("name") in live
+        ]
+        nodes.sort(key=lambda n: (n.get("cliqueID", ""), n.get("index", 0)))
+        return nodes
 
     def delete_cliques(self, cd: dict) -> bool:
         """Delete clique objects on CD teardown; True when all gone."""

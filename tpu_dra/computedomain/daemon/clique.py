@@ -4,7 +4,9 @@ Reference analog: cmd/compute-domain-daemon/cdclique.go — the daemon
 registers {nodeName, podIP, cliqueID, index, status} into the
 ComputeDomainClique named ``<cdUID>.<cliqueID>`` (:173-176); index
 assignment fills gaps so restarts keep DNS names stable (:350-372);
-readiness updates flow through the same object (:429-...).
+readiness updates flow through the same object (:429-...). The retry/index
+state machine lives in :mod:`.registration`, shared with the legacy
+direct-status path.
 """
 
 from __future__ import annotations
@@ -12,19 +14,21 @@ from __future__ import annotations
 import logging
 from typing import List, Optional
 
-from tpu_dra.api import CD_STATUS_NOT_READY, CD_STATUS_READY
+from tpu_dra.api import CD_STATUS_NOT_READY
 from tpu_dra.computedomain import CD_LABEL_KEY
+from tpu_dra.computedomain.daemon.registration import RETRY, RegistrationBase
 from tpu_dra.k8sclient import (
     COMPUTE_DOMAIN_CLIQUES,
     ApiConflict,
-    ApiNotFound,
     ResourceClient,
 )
 
 log = logging.getLogger(__name__)
 
 
-class CliqueRegistration:
+class CliqueRegistration(RegistrationBase):
+    node_key = "nodeName"
+
     def __init__(
         self,
         backend,
@@ -34,125 +38,47 @@ class CliqueRegistration:
         node_name: str,
         ip_address: str,
     ):
+        super().__init__(
+            node_name=node_name, ip_address=ip_address, clique_id=clique_id
+        )
         self.cliques = ResourceClient(backend, COMPUTE_DOMAIN_CLIQUES)
         self.cd_uid = cd_uid
         self.cd_namespace = cd_namespace
-        self.clique_id = clique_id
-        self.node_name = node_name
-        self.ip_address = ip_address
-        self.index: Optional[int] = None
 
     @property
     def clique_name(self) -> str:
         return f"{self.cd_uid}.{self.clique_id}"
 
-    @staticmethod
-    def _assign_index(daemons: List[dict]) -> int:
-        """Smallest free index — gap-filling keeps DNS names stable across
-        daemon restarts (cdclique.go:350-372)."""
-        used = {d.get("index", 0) for d in daemons}
-        i = 0
-        while i in used:
-            i += 1
-        return i
+    def _describe(self) -> str:
+        return f"clique {self.cd_namespace}/{self.clique_name}"
 
-    def register(self) -> int:
-        """Insert or refresh our daemon entry; retries on write conflicts
-        (multiple daemons register concurrently). Returns our index."""
-        for _ in range(20):
-            clique = self.cliques.try_get(self.clique_name, self.cd_namespace)
-            if clique is None:
-                obj = {
-                    "apiVersion": "resource.tpu.google.com/v1beta1",
-                    "kind": "ComputeDomainClique",
-                    "metadata": {
-                        "name": self.clique_name,
-                        "namespace": self.cd_namespace,
-                        "labels": {CD_LABEL_KEY: self.cd_uid},
-                    },
-                    "daemons": [self._entry(0, CD_STATUS_NOT_READY)],
-                }
-                try:
-                    self.cliques.create(obj)
-                    self.index = 0
-                    return 0
-                except ApiConflict:
-                    continue  # raced with a peer; re-read
-            daemons = clique.get("daemons") or []
-            mine = next(
-                (d for d in daemons if d.get("nodeName") == self.node_name), None
-            )
-            if mine is not None:
-                # Keep our stable index; refresh IP (pod restart changes it).
-                self.index = mine.get("index", 0)
-                if mine.get("ipAddress") == self.ip_address:
-                    return self.index
-                mine["ipAddress"] = self.ip_address
-            else:
-                self.index = self._assign_index(daemons)
-                daemons.append(self._entry(self.index, CD_STATUS_NOT_READY))
-            clique["daemons"] = daemons
-            try:
-                self.cliques.update(clique)
-                return self.index
-            except ApiConflict:
-                continue
-        raise RuntimeError(
-            f"could not register into clique {self.clique_name}: too many "
-            f"write conflicts"
-        )
+    def _fetch(self) -> Optional[dict]:
+        return self.cliques.try_get(self.clique_name, self.cd_namespace)
 
-    def _entry(self, index: int, status: str) -> dict:
-        return {
-            "nodeName": self.node_name,
-            "ipAddress": self.ip_address,
-            "cliqueID": self.clique_id,
-            "index": index,
-            "status": status,
+    def _persist(self, obj: dict) -> None:
+        self.cliques.update(obj)
+
+    def _entries(self, obj: dict) -> List[dict]:
+        if obj.get("daemons") is None:
+            obj["daemons"] = []
+        return obj["daemons"]
+
+    def _on_missing_register(self):
+        """First daemon of the clique creates the object (cdclique.go
+        create path); a create conflict means a peer raced us — re-read."""
+        obj = {
+            "apiVersion": "resource.tpu.google.com/v1beta1",
+            "kind": "ComputeDomainClique",
+            "metadata": {
+                "name": self.clique_name,
+                "namespace": self.cd_namespace,
+                "labels": {CD_LABEL_KEY: self.cd_uid},
+            },
+            "daemons": [self._entry(0, CD_STATUS_NOT_READY)],
         }
-
-    def set_status(self, ready: bool) -> None:
-        status = CD_STATUS_READY if ready else CD_STATUS_NOT_READY
-        for _ in range(20):
-            clique = self.cliques.try_get(self.clique_name, self.cd_namespace)
-            if clique is None:
-                return
-            changed = False
-            for d in clique.get("daemons") or []:
-                if d.get("nodeName") == self.node_name and d.get("status") != status:
-                    d["status"] = status
-                    changed = True
-            if not changed:
-                return
-            try:
-                self.cliques.update(clique)
-                return
-            except ApiConflict:
-                continue
-
-    def peers(self) -> List[dict]:
-        clique = self.cliques.try_get(self.clique_name, self.cd_namespace)
-        if clique is None:
-            return []
-        return sorted(
-            clique.get("daemons") or [], key=lambda d: d.get("index", 0)
-        )
-
-    def deregister(self) -> None:
-        for _ in range(20):
-            clique = self.cliques.try_get(self.clique_name, self.cd_namespace)
-            if clique is None:
-                return
-            daemons = [
-                d
-                for d in clique.get("daemons") or []
-                if d.get("nodeName") != self.node_name
-            ]
-            if len(daemons) == len(clique.get("daemons") or []):
-                return
-            clique["daemons"] = daemons
-            try:
-                self.cliques.update(clique)
-                return
-            except ApiConflict:
-                continue
+        try:
+            self.cliques.create(obj)
+            self.index = 0
+            return 0
+        except ApiConflict:
+            return RETRY

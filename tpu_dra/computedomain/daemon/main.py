@@ -28,7 +28,9 @@ from tpu_dra.computedomain.daemon.bootstrap import (
 )
 from tpu_dra.computedomain.daemon.clique import CliqueRegistration
 from tpu_dra.computedomain.daemon.dnsnames import DNSNameManager
-from tpu_dra.infra import flags, signals
+from tpu_dra.computedomain.daemon.podmanager import PodManager
+from tpu_dra.computedomain.daemon.status_legacy import DirectStatusRegistration
+from tpu_dra.infra import featuregates, flags, signals
 from tpu_dra.tpulib import new_tpulib
 from tpu_dra.tpulib.types import topology_str
 
@@ -49,6 +51,8 @@ class DaemonConfig:
     hosts_path: str = "/etc/hosts"
     update_period: float = 2.0
     num_slices: int = 1
+    pod_name: str = ""
+    pod_namespace: str = ""
 
 
 class SliceDaemon:
@@ -58,13 +62,29 @@ class SliceDaemon:
         self.tpulib = tpulib or new_tpulib()
         ici = self.tpulib.ici_domain()
         self.clique_id = ici.clique_id() if ici else "local.0"
-        self.registration = CliqueRegistration(
-            backend,
-            cd_uid=config.cd_uid,
-            cd_namespace=config.cd_namespace,
-            clique_id=self.clique_id,
-            node_name=config.node_name,
-            ip_address=config.pod_ip,
+        if featuregates.enabled(featuregates.COMPUTE_DOMAIN_CLIQUES):
+            self.registration = CliqueRegistration(
+                backend,
+                cd_uid=config.cd_uid,
+                cd_namespace=config.cd_namespace,
+                clique_id=self.clique_id,
+                node_name=config.node_name,
+                ip_address=config.pod_ip,
+            )
+        else:
+            # Legacy path (cdstatus.go): write directly into CD.Status.
+            self.registration = DirectStatusRegistration(
+                backend,
+                cd_uid=config.cd_uid,
+                cd_name=config.cd_name,
+                cd_namespace=config.cd_namespace,
+                clique_id=self.clique_id,
+                node_name=config.node_name,
+                ip_address=config.pod_ip,
+            )
+        self.podmanager = PodManager(
+            backend, config.pod_namespace or config.cd_namespace,
+            config.pod_name,
         )
         self.dns = DNSNameManager(hosts_path=config.hosts_path)
         self._stop = threading.Event()
@@ -121,8 +141,12 @@ class SliceDaemon:
             log.info("readiness -> %s (%d/%d peers)", ready, len(peers),
                      self.config.num_nodes)
         self._ready = ready
-        self.registration.set_status(ready)
         self._write_ready_file(ready)
+        # Registration readiness follows the pod's kubelet-probed Ready
+        # condition when observable (podmanager.go:32-149): local view ->
+        # ready file -> probe -> pod condition -> registration.
+        pod_ready = self.podmanager.pod_ready()
+        self.registration.set_status(ready if pod_ready is None else pod_ready)
         return ready
 
     def run(self) -> None:
@@ -166,7 +190,13 @@ def main(argv=None) -> int:
     p.add_argument("--node-name", default=flags.env_default("NODE_NAME", ""))
     p.add_argument("--pod-ip", default=flags.env_default("POD_IP", ""))
     p.add_argument("--config-dir", default=flags.env_default("CD_CONFIG_DIR", "/tpu-cd"))
+    p.add_argument("--pod-name", default=flags.env_default("POD_NAME", ""))
+    p.add_argument(
+        "--pod-namespace", default=flags.env_default("POD_NAMESPACE", "")
+    )
+    flags.add_feature_gate_flag(p)
     args = p.parse_args(argv)
+    flags.apply_feature_gates(args)
     flags.LoggingConfig.from_args(args).apply()
     if args.command == "check":
         return check(args.config_dir)
@@ -180,6 +210,8 @@ def main(argv=None) -> int:
         node_name=args.node_name,
         pod_ip=args.pod_ip,
         config_dir=args.config_dir,
+        pod_name=args.pod_name,
+        pod_namespace=args.pod_namespace,
     )
     daemon = SliceDaemon(config, backend)
     import signal as _sig

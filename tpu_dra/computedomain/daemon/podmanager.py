@@ -1,0 +1,43 @@
+"""Own-pod readiness watcher.
+
+Reference analog: cmd/compute-domain-daemon/podmanager.go:32-149 — the daemon
+watches its *own* pod's Ready condition (which kubelet computes from the
+readiness probe that execs ``tpu-compute-domain-daemon check``) and
+propagates that into the clique/status registration. Registration readiness
+therefore reflects what the cluster sees, not just the daemon's local view:
+local membership+health -> ready file -> probe -> pod Ready condition ->
+registration status. This ordering is what keeps the flow non-circular.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from tpu_dra.k8sclient import PODS, ResourceClient
+
+log = logging.getLogger(__name__)
+
+
+class PodManager:
+    def __init__(self, backend, namespace: str, pod_name: str):
+        self.pods = ResourceClient(backend, PODS)
+        self.namespace = namespace
+        self.pod_name = pod_name
+
+    def pod_ready(self) -> Optional[bool]:
+        """The pod's Ready condition; None when the pod or condition cannot
+        be observed (caller falls back to its local readiness view)."""
+        if not self.pod_name:
+            return None
+        try:
+            pod = self.pods.try_get(self.pod_name, self.namespace)
+        except Exception:
+            log.exception("cannot read own pod %s/%s", self.namespace, self.pod_name)
+            return None
+        if pod is None:
+            return None
+        for cond in (pod.get("status") or {}).get("conditions") or []:
+            if cond.get("type") == "Ready":
+                return cond.get("status") == "True"
+        return None

@@ -1,0 +1,156 @@
+"""Shared daemon-registration machinery.
+
+Both registration paths — clique CRD objects (ComputeDomainCliques=on,
+cdclique.go) and direct CD.Status writes (gate off, cdstatus.go:223-333) —
+are the same state machine: conflict-retried read-modify-writes inserting or
+mutating *our* entry in a shared list, with gap-filled stable indices. The
+subclasses supply only where the list lives and how it persists.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from tpu_dra.api import CD_STATUS_NOT_READY, CD_STATUS_READY
+from tpu_dra.k8sclient import ApiConflict
+
+log = logging.getLogger(__name__)
+
+MAX_CONFLICT_RETRIES = 20
+
+# Sentinel: the subclass handled a missing parent object but the write
+# raced; re-run the retry loop.
+RETRY = object()
+
+
+def assign_gap_filled_index(entries: List[dict]) -> int:
+    """Smallest free index — gap-filling keeps indices (and the DNS names
+    derived from them) stable across daemon restarts (cdclique.go:350-372)."""
+    used = {e.get("index", 0) for e in entries}
+    i = 0
+    while i in used:
+        i += 1
+    return i
+
+
+class RegistrationBase:
+    """Template for clique/direct-status registration.
+
+    Subclasses define: ``node_key`` (the entry field naming the node),
+    ``_fetch()``, ``_persist(obj)``, ``_entries(obj)``, ``_describe()``,
+    and either ``_on_missing_register()`` (create or raise) or accept the
+    default raise.
+    """
+
+    node_key = "nodeName"
+
+    def __init__(self, node_name: str, ip_address: str, clique_id: str):
+        self.node_name = node_name
+        self.ip_address = ip_address
+        self.clique_id = clique_id
+        self.index: Optional[int] = None
+
+    # --- subclass surface ---
+
+    def _fetch(self) -> Optional[dict]:
+        raise NotImplementedError
+
+    def _persist(self, obj: dict) -> None:
+        raise NotImplementedError
+
+    def _entries(self, obj: dict) -> List[dict]:
+        raise NotImplementedError
+
+    def _describe(self) -> str:
+        raise NotImplementedError
+
+    def _on_missing_register(self):
+        """Parent object absent during register(): return an index, RETRY,
+        or raise."""
+        raise RuntimeError(f"{self._describe()} not found")
+
+    def _entry(self, index: int, status: str) -> dict:
+        return {
+            self.node_key: self.node_name,
+            "ipAddress": self.ip_address,
+            "cliqueID": self.clique_id,
+            "index": index,
+            "status": status,
+        }
+
+    # --- shared state machine ---
+
+    def register(self) -> int:
+        """Insert or refresh our entry; returns our stable index."""
+        for _ in range(MAX_CONFLICT_RETRIES):
+            obj = self._fetch()
+            if obj is None:
+                got = self._on_missing_register()
+                if got is RETRY:
+                    continue
+                return got
+            entries = self._entries(obj)
+            mine = next(
+                (e for e in entries if e.get(self.node_key) == self.node_name),
+                None,
+            )
+            if mine is not None:
+                self.index = mine.get("index", 0)
+                if mine.get("ipAddress") == self.ip_address:
+                    return self.index
+                # Pod restart changed our IP; refresh it.
+                mine["ipAddress"] = self.ip_address
+            else:
+                self.index = assign_gap_filled_index(entries)
+                entries.append(self._entry(self.index, CD_STATUS_NOT_READY))
+            try:
+                self._persist(obj)
+                return self.index
+            except ApiConflict:
+                continue
+        raise RuntimeError(
+            f"could not register {self.node_name} into {self._describe()}: "
+            f"too many write conflicts"
+        )
+
+    def set_status(self, ready: bool) -> None:
+        want = CD_STATUS_READY if ready else CD_STATUS_NOT_READY
+        for _ in range(MAX_CONFLICT_RETRIES):
+            obj = self._fetch()
+            if obj is None:
+                return
+            changed = False
+            for e in self._entries(obj):
+                if e.get(self.node_key) == self.node_name and e.get("status") != want:
+                    e["status"] = want
+                    changed = True
+            if not changed:
+                return
+            try:
+                self._persist(obj)
+                return
+            except ApiConflict:
+                continue
+
+    def peers(self) -> List[dict]:
+        obj = self._fetch()
+        if obj is None:
+            return []
+        return sorted(self._entries(obj), key=lambda e: e.get("index", 0))
+
+    def deregister(self) -> None:
+        for _ in range(MAX_CONFLICT_RETRIES):
+            obj = self._fetch()
+            if obj is None:
+                return
+            entries = self._entries(obj)
+            kept = [e for e in entries if e.get(self.node_key) != self.node_name]
+            if len(kept) == len(entries):
+                return
+            entries[:] = kept
+            try:
+                self._persist(obj)
+                return
+            except ApiConflict:
+                continue

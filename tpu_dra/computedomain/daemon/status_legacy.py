@@ -1,0 +1,81 @@
+"""Legacy (ComputeDomainCliques=off) registration: write directly into
+ComputeDomain.Status.
+
+Reference analog: cmd/compute-domain-daemon/cdstatus.go:223-333 — before the
+clique CRD existed, each daemon inserted its `{name, ipAddress, cliqueID,
+index, status}` entry straight into ``CD.Status.Nodes`` with conflict-retried
+read-modify-writes. The shared state machine lives in :mod:`.registration`;
+the interface matches :class:`~tpu_dra.computedomain.daemon.clique.
+CliqueRegistration` so :class:`~tpu_dra.computedomain.daemon.main.
+SliceDaemon` can swap implementations on the gate.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from tpu_dra.computedomain.daemon.registration import RegistrationBase
+from tpu_dra.k8sclient import COMPUTE_DOMAINS, ResourceClient
+
+log = logging.getLogger(__name__)
+
+
+class DirectStatusRegistration(RegistrationBase):
+    # CD.Status.Nodes names its node field "name" (computedomain.go
+    # ComputeDomainNode), unlike clique daemon entries' "nodeName".
+    node_key = "name"
+
+    def __init__(
+        self,
+        backend,
+        cd_uid: str,
+        cd_name: str,
+        cd_namespace: str,
+        clique_id: str,
+        node_name: str,
+        ip_address: str,
+    ):
+        super().__init__(
+            node_name=node_name, ip_address=ip_address, clique_id=clique_id
+        )
+        self.cds = ResourceClient(backend, COMPUTE_DOMAINS)
+        self.cd_uid = cd_uid
+        self.cd_name = cd_name
+        self.cd_namespace = cd_namespace
+
+    def _describe(self) -> str:
+        return (
+            f"computedomain {self.cd_namespace}/{self.cd_name} "
+            f"(uid {self.cd_uid})"
+        )
+
+    def _fetch(self) -> Optional[dict]:
+        cd = self.cds.try_get(self.cd_name, self.cd_namespace)
+        if cd is not None and cd["metadata"].get("uid") not in ("", self.cd_uid):
+            # A same-named CD that is not ours (delete + recreate race).
+            return None
+        return cd
+
+    def _persist(self, obj: dict) -> None:
+        self.cds.update_status(obj)
+
+    def _entries(self, obj: dict) -> List[dict]:
+        status = obj.setdefault("status", {})
+        if status.get("nodes") is None:
+            status["nodes"] = []
+        return status["nodes"]
+
+    def peers(self) -> List[dict]:
+        """Normalize CD.Status node entries to the clique daemon-entry shape
+        consumed by DNSNameManager / bootstrap rendering (key "nodeName")."""
+        return [
+            {
+                "nodeName": n.get("name", ""),
+                "ipAddress": n.get("ipAddress", ""),
+                "cliqueID": n.get("cliqueID", ""),
+                "index": n.get("index", 0),
+                "status": n.get("status", ""),
+            }
+            for n in super().peers()
+        ]

@@ -133,12 +133,18 @@ class Checkpoint:
                 for uid, c in (v2.get("preparedClaims") or {}).items()
             }
             return cls(prepared_claims=claims)
+        # Legacy pre-versioning rendering (checkpoint_legacy.go analog): a
+        # flat {"preparedClaims": ...} with neither version wrapper nor
+        # checksum. Migrated on load; the next write persists V1+V2.
+        if "v1" not in top and "v2" not in top and "preparedClaims" in top:
+            top = {"checksum": None, "v1": top}
         v1 = top.get("v1")
         if v1 is not None:
             want = top.get("checksum", 0)
-            v1_view = {"checksum": 0, "v1": v1}
-            if _crc(_canonical(v1_view)) != want:
-                raise ChecksumError("checkpoint v1 checksum mismatch")
+            if want is not None:  # legacy flat files carry no checksum
+                v1_view = {"checksum": 0, "v1": v1}
+                if _crc(_canonical(v1_view)) != want:
+                    raise ChecksumError("checkpoint v1 checksum mismatch")
             claims = {}
             for uid, c in (v1.get("preparedClaims") or {}).items():
                 claims[uid] = PreparedClaim(
