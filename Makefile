@@ -16,8 +16,12 @@ test: native
 bench:
 	python bench.py
 
+GIT_COMMIT ?= $(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
+
 image:
-	docker build -t $(IMAGE_REPO):$(IMAGE_TAG) -f deployments/container/Dockerfile .
+	docker build -t $(IMAGE_REPO):$(IMAGE_TAG) \
+		--build-arg GIT_COMMIT=$(GIT_COMMIT) \
+		-f deployments/container/Dockerfile .
 
 # e2e against the current kubectl context (invasive; see tests/bats/README.md)
 bats:

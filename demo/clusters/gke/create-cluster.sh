@@ -7,7 +7,6 @@
 set -euo pipefail
 
 PROJECT="${PROJECT:?set PROJECT}"
-REGION="${REGION:-us-east5}"
 ZONE="${ZONE:-us-east5-a}"
 CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra}"
 # v5p-16: 2 hosts x 4 chips over ICI — the BASELINE config-4 shape.

@@ -7,7 +7,9 @@ CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra}"
 IMAGE="${IMAGE:-registry.local/tpu-dra-driver:v0.1.0}"
 WORKLOAD_IMAGE="${WORKLOAD_IMAGE:-registry.local/tpu-workload:latest}"
 
-docker build -t "${IMAGE}" -f deployments/container/Dockerfile .
+docker build -t "${IMAGE}" \
+  --build-arg "GIT_COMMIT=$(git rev-parse --short HEAD 2>/dev/null || echo unknown)" \
+  -f deployments/container/Dockerfile .
 docker build -t "${WORKLOAD_IMAGE}" \
   --build-arg "DRIVER_IMAGE=${IMAGE}" \
   -f deployments/container/Dockerfile.workload .

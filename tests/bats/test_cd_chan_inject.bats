@@ -35,11 +35,58 @@ bats::on_failure() {
   [[ "$output" == *TPU_WORKER_HOSTNAMES* ]]
 }
 
-@test "chan-inject: channel claim in the wrong namespace is rejected" {
-  # The CD lives in cd-demo; a claim referencing its template from another
-  # namespace must never prepare (AssertComputeDomainNamespace analog).
+@test "chan-inject: channel claim forged in another namespace never prepares" {
+  # The CD lives in cd-demo. Forge an RCT in another namespace embedding the
+  # CD's real domainID: prepare must fail the namespace assertion
+  # (AssertComputeDomainNamespace analog) and hold the pod forever.
   kubectl create namespace cd-demo-other --dry-run=client -o yaml | kubectl apply -f -
-  run kubectl -n cd-demo-other get resourceclaimtemplate all-channels-rct
-  [ "$status" -ne 0 ]
+  local uid
+  uid="$(kubectl -n cd-demo get computedomain all-channels -o jsonpath='{.metadata.uid}')"
+  [ -n "$uid" ]
+  cat <<EOF | sed "s|resource.k8s.io/v1beta1|${TEST_RESOURCE_API_VERSION:-resource.k8s.io/v1beta1}|" | kubectl apply -f -
+apiVersion: resource.k8s.io/v1beta1
+kind: ResourceClaimTemplate
+metadata:
+  namespace: cd-demo-other
+  name: forged-channel
+spec:
+  spec:
+    devices:
+      requests:
+      - name: cd-channel
+        deviceClassName: compute-domain-default-channel.tpu.google.com
+      config:
+      - requests: ["cd-channel"]
+        opaque:
+          driver: compute-domain.tpu.google.com
+          parameters:
+            apiVersion: resource.tpu.google.com/v1beta1
+            kind: ComputeDomainChannelConfig
+            domainID: "$uid"
+EOF
+  cat <<EOF | kubectl apply -f -
+apiVersion: v1
+kind: Pod
+metadata:
+  namespace: cd-demo-other
+  name: forged
+spec:
+  restartPolicy: Never
+  containers:
+  - name: ctr
+    image: ${TEST_IMAGE_REPO}:${TEST_IMAGE_TAG}
+    command: ["python", "-c", "print('should never run')"]
+    resources:
+      claims:
+      - name: ch
+  resourceClaims:
+  - name: ch
+    resourceClaimTemplateName: forged-channel
+EOF
+  # The pod must stay un-started: prepare keeps failing the namespace
+  # assertion, kubelet retries, phase never leaves Pending.
+  sleep 45
+  run kubectl -n cd-demo-other get pod forged -o jsonpath='{.status.phase}'
+  [ "$output" == "Pending" ]
   kubectl delete namespace cd-demo-other --ignore-not-found --timeout=120s
 }

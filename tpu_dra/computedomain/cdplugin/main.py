@@ -16,6 +16,7 @@ log = logging.getLogger(__name__)
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser("tpu-compute-domain-kubelet-plugin")
+    flags.add_version_flag(p)
     flags.KubeClientConfig.add_flags(p)
     flags.LoggingConfig.add_flags(p)
     flags.add_feature_gate_flag(p)

@@ -106,6 +106,7 @@ class LeaderElector:
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser("tpu-compute-domain-controller")
+    flags.add_version_flag(p)
     flags.KubeClientConfig.add_flags(p)
     flags.LoggingConfig.add_flags(p)
     flags.LeaderElectionConfig.add_flags(p)

@@ -67,22 +67,17 @@ class StatusManager:
         erase concurrent daemon registrations (lost update). A conflict
         means a daemon won the race — re-derive and retry."""
         name, ns = cd["metadata"]["name"], cd["metadata"]["namespace"]
+        # Fast path on the caller's (informer-cached) copy: skip the API
+        # round-trips entirely when nothing would change.
+        nodes = self._derive_nodes(cd)
+        if cd.get("status") == self._new_status(cd, nodes):
+            return cd
         for _ in range(20):
             cur = self.cds.try_get(name, ns)
             if cur is None:
                 return cd
-            if featuregates.enabled(featuregates.COMPUTE_DOMAIN_CLIQUES):
-                nodes = self._nodes_from_cliques(cur)
-            else:
-                nodes = self._nodes_from_status(cur)
-            num_ready = sum(
-                1 for n in nodes if n.get("status") == CD_STATUS_READY
-            )
-            want = cur["spec"]["numNodes"]
-            status = (
-                CD_STATUS_READY if num_ready >= want else CD_STATUS_NOT_READY
-            )
-            new_status = {"status": status, "nodes": nodes}
+            nodes = self._derive_nodes(cur)
+            new_status = self._new_status(cur, nodes)
             if cur.get("status") == new_status:
                 return cur
             cur["status"] = new_status
@@ -91,8 +86,8 @@ class StatusManager:
             except ApiConflict:
                 continue
             log.info(
-                "computedomain %s/%s status=%s (%d/%d nodes ready)",
-                ns, name, status, num_ready, want,
+                "computedomain %s/%s status=%s (%d nodes)",
+                ns, name, new_status["status"], len(nodes),
             )
             return cur
         log.warning(
@@ -100,6 +95,21 @@ class StatusManager:
             "deferring to the next periodic sync", ns, name,
         )
         return cd
+
+    def _derive_nodes(self, cd: dict) -> List[dict]:
+        if featuregates.enabled(featuregates.COMPUTE_DOMAIN_CLIQUES):
+            return self._nodes_from_cliques(cd)
+        return self._nodes_from_status(cd)
+
+    @staticmethod
+    def _new_status(cd: dict, nodes: List[dict]) -> dict:
+        num_ready = sum(1 for n in nodes if n.get("status") == CD_STATUS_READY)
+        status = (
+            CD_STATUS_READY
+            if num_ready >= cd["spec"]["numNodes"]
+            else CD_STATUS_NOT_READY
+        )
+        return {"status": status, "nodes": nodes}
 
     def _nodes_from_cliques(self, cd: dict) -> List[dict]:
         nodes: List[dict] = []

@@ -180,6 +180,7 @@ def check(config_dir: str = "/tpu-cd") -> int:
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser("tpu-compute-domain-daemon")
+    flags.add_version_flag(p)
     p.add_argument("command", nargs="?", default="run", choices=["run", "check"])
     flags.KubeClientConfig.add_flags(p)
     flags.LoggingConfig.add_flags(p)

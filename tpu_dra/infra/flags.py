@@ -164,6 +164,19 @@ def apply_feature_gates(args: argparse.Namespace) -> None:
 
 def log_startup_config(args: argparse.Namespace) -> None:
     """pkg/flags/utils.go analog: one-shot dump of resolved config."""
+    from tpu_dra.infra import version
+
+    log.info("tpu-dra-driver %s", version.version_string())
     pairs = ", ".join(f"{k}={v!r}" for k, v in sorted(vars(args).items()))
     log.info("startup configuration: %s", pairs)
     log.info("feature gates: %s", featuregates.to_map())
+
+
+def add_version_flag(p: argparse.ArgumentParser) -> None:
+    """--version: print version+commit and exit (internal/info analog)."""
+    from tpu_dra.infra import version
+
+    p.add_argument(
+        "--version", action="version",
+        version=f"%(prog)s {version.version_string()}",
+    )

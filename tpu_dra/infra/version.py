@@ -1,0 +1,31 @@
+"""Version/commit stamping.
+
+Reference analog: internal/info/version.go:22-43 — version and git commit
+injected at build time (Makefile:104-107 ldflags). Python has no ldflags;
+the Dockerfile bakes ``TPU_DRA_GIT_COMMIT`` as an env var and the package
+version comes from installed metadata (pyproject.toml), falling back to the
+dev default on an un-installed checkout.
+"""
+
+from __future__ import annotations
+
+import os
+
+_FALLBACK_VERSION = "0.1.0-dev"
+
+
+def version() -> str:
+    try:
+        from importlib.metadata import version as _v
+
+        return _v("tpu-dra-driver")
+    except Exception:
+        return _FALLBACK_VERSION
+
+
+def git_commit() -> str:
+    return os.environ.get("TPU_DRA_GIT_COMMIT", "unknown")
+
+
+def version_string() -> str:
+    return f"{version()} (commit {git_commit()})"

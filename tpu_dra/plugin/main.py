@@ -24,6 +24,7 @@ log = logging.getLogger(__name__)
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser("tpu-kubelet-plugin")
+    flags.add_version_flag(p)
     flags.KubeClientConfig.add_flags(p)
     flags.LoggingConfig.add_flags(p)
     flags.add_feature_gate_flag(p)

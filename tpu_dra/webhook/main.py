@@ -23,6 +23,7 @@ def build_parser() -> argparse.ArgumentParser:
             "a DRA driver plugin."
         ),
     )
+    flags.add_version_flag(p)
     flags.LoggingConfig.add_flags(p)
     flags.add_feature_gate_flag(p)
     p.add_argument(
