@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Prestart validation for the kubelet plugin container (reference analog:
+# hack/kubelet-plugin-prestart.sh): fail fast with a readable message when
+# the node is missing a mount/prereq the plugin needs, instead of
+# crash-looping with a stack trace.
+set -euo pipefail
+
+fail() { echo "prestart check failed: $*" >&2; exit 1; }
+
+PLUGIN_DATA_DIR="${PLUGIN_DATA_DIR:-/var/lib/kubelet/plugins/tpu.google.com}"
+KUBELET_REGISTRAR_DIR="${KUBELET_REGISTRAR_DIR:-/var/lib/kubelet/plugins_registry}"
+CDI_ROOT="${CDI_ROOT:-/var/run/cdi}"
+
+[ -d "$(dirname "${PLUGIN_DATA_DIR}")" ] || \
+  fail "kubelet plugins dir missing: $(dirname "${PLUGIN_DATA_DIR}") (is /var/lib/kubelet mounted?)"
+mkdir -p "${PLUGIN_DATA_DIR}" 2>/dev/null || \
+  fail "cannot create ${PLUGIN_DATA_DIR} (read-only mount?)"
+[ -d "${KUBELET_REGISTRAR_DIR}" ] || \
+  fail "kubelet registrar dir missing: ${KUBELET_REGISTRAR_DIR}"
+mkdir -p "${CDI_ROOT}" 2>/dev/null || \
+  fail "cannot create CDI root ${CDI_ROOT}"
+
+if [ "${TPU_DRA_BACKEND:-linux}" = "stub" ]; then
+  # An unset TPU_DRA_STUB_CONFIG is valid: the stub backend falls back to
+  # its built-in single-host v5e-4 inventory (tpu_dra/tpulib/stub.py).
+  if [ -n "${TPU_DRA_STUB_CONFIG:-}" ] && [ ! -f "${TPU_DRA_STUB_CONFIG}" ]; then
+    fail "TPU_DRA_STUB_CONFIG set but not found: ${TPU_DRA_STUB_CONFIG}"
+  fi
+else
+  # Real backend: at least one TPU surface must be visible.
+  ls /dev/accel* >/dev/null 2>&1 || ls /dev/vfio/* >/dev/null 2>&1 || \
+    fail "no /dev/accel* or /dev/vfio/* devices visible (TPU runtime installed? hostPath mounted?)"
+fi
+
+echo "prestart checks passed"

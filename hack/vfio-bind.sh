@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# Bind/unbind a TPU PCI function to vfio-pci (reference analog:
+# scripts/bind_to_driver.sh / unbind_from_driver.sh). The plugin does this
+# itself during passthrough prepare (tpu_dra/plugin/vfio.py); this script is
+# the manual/debug path.
+#
+# Usage: vfio-bind.sh bind|unbind <pci-address>    e.g. 0000:00:05.0
+set -euo pipefail
+
+CMD="${1:?bind|unbind}"
+ADDR="${2:?pci address, e.g. 0000:00:05.0}"
+SYSFS="${SYSFS:-/sys}"
+DEV="${SYSFS}/bus/pci/devices/${ADDR}"
+
+[ -d "${DEV}" ] || { echo "no such PCI device: ${ADDR}" >&2; exit 1; }
+
+current_driver() {
+  # readlink -f on a nonexistent symlink still resolves; check existence
+  # first so an unbound device reports "none", not "driver".
+  if [ -e "${DEV}/driver" ]; then
+    basename "$(readlink -f "${DEV}/driver")"
+  else
+    echo none
+  fi
+}
+
+case "${CMD}" in
+  bind)
+    modprobe vfio-pci 2>/dev/null || true
+    cur="$(current_driver)"
+    if [ "${cur}" != "none" ] && [ "${cur}" != "vfio-pci" ]; then
+      echo "${ADDR}" > "${DEV}/driver/unbind"
+    fi
+    echo vfio-pci > "${DEV}/driver_override"
+    echo "${ADDR}" > "${SYSFS}/bus/pci/drivers_probe"
+    echo "bound ${ADDR} to $(current_driver)"
+    ;;
+  unbind)
+    cur="$(current_driver)"
+    [ "${cur}" = "vfio-pci" ] && echo "${ADDR}" > "${DEV}/driver/unbind"
+    echo "" > "${DEV}/driver_override"
+    echo "${ADDR}" > "${SYSFS}/bus/pci/drivers_probe"
+    echo "rebound ${ADDR} to $(current_driver)"
+    ;;
+  *)
+    echo "usage: $0 bind|unbind <pci-address>" >&2; exit 2
+    ;;
+esac

@@ -804,3 +804,62 @@ def test_podmanager_readiness_propagation(fc, tmp_path):
     daemon.run_once()
     [peer] = daemon.registration.peers()
     assert peer["status"] == "Ready"
+
+
+def test_orphaned_daemonset_gc(fc, tmp_path):
+    """A CD-labeled DaemonSet whose ComputeDomain vanished (missed
+    finalizer flow) is GC'd by the periodic orphan sweep, including
+    finalizer removal once its pods are gone (mnsdaemonset.go role)."""
+    cd = make_cd(fc, name="gone", num_nodes=1)
+    c = ComputeDomainController(fc, driver_namespace=DRIVER_NS)
+    reconcile(c, cd)
+    dss = ResourceClient(fc, DAEMON_SETS)
+    [ds] = dss.list(namespace=DRIVER_NS)
+    # Simulate the CD vanishing without its teardown reconcile running.
+    cds = ResourceClient(fc, COMPUTE_DOMAINS)
+    cd = cds.get("gone", NS)
+    cd["metadata"]["finalizers"] = []
+    cds.update(cd)
+    cds.delete("gone", NS)
+    # Live CD set no longer contains the uid -> request delete + lift the
+    # finalizer (no daemon pods exist).
+    n = c.daemonsets.delete_orphans(set())
+    assert n == 1
+    assert dss.list(namespace=DRIVER_NS) == []
+
+
+def test_orphan_gc_spares_live_domains(fc, tmp_path):
+    cd = make_cd(fc, name="alive", num_nodes=1)
+    c = ComputeDomainController(fc, driver_namespace=DRIVER_NS)
+    reconcile(c, cd)
+    assert c.daemonsets.delete_orphans({cd["metadata"]["uid"]}) == 0
+    dss = ResourceClient(fc, DAEMON_SETS)
+    assert len(dss.list(namespace=DRIVER_NS)) == 1
+
+
+def test_daemonset_propagates_feature_gates(fc):
+    """The controller renders its gate view into the daemon pod env so
+    daemon and controller agree on the clique-vs-direct status path."""
+    from tpu_dra.infra import featuregates as fg
+
+    fg.feature_gates().set_from_string("ComputeDomainCliques=false")
+    cd = make_cd(fc, name="gates", num_nodes=1)
+    c = ComputeDomainController(fc, driver_namespace=DRIVER_NS)
+    ds = c.daemonsets.render(cd)
+    env = {
+        e["name"]: e.get("value")
+        for e in ds["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    assert "ComputeDomainCliques=false" in env["FEATURE_GATES"]
+    assert env["POD_NAME"] is None  # valueFrom, not value
+
+
+def test_orphan_gc_toctou_guard(fc):
+    """A CD created after the live-uid snapshot is re-fetched via the DS
+    annotations and spared, even though its uid is missing from the set."""
+    cd = make_cd(fc, name="racy", num_nodes=1)
+    c = ComputeDomainController(fc, driver_namespace=DRIVER_NS)
+    reconcile(c, cd)
+    assert c.daemonsets.delete_orphans(set()) == 0
+    dss = ResourceClient(fc, DAEMON_SETS)
+    assert len(dss.list(namespace=DRIVER_NS)) == 1

@@ -88,9 +88,15 @@ class ComputeDomainController:
         """cdstatus.go:120-133 periodic sync + node.go label GC."""
         while not self._stop.wait(self.status_sync_period):
             try:
-                for cd in self.cds.list():
+                cds = self.cds.list()
+                for cd in cds:
                     self._enqueue(cd)
                 self.node_labels.cleanup_stale_labels()
+                n = self.daemonsets.delete_orphans(
+                    {cd["metadata"]["uid"] for cd in cds}
+                )
+                if n:
+                    log.info("GC'd %d orphaned CD daemonsets", n)
             except Exception:
                 log.exception("periodic CD sync failed")
 

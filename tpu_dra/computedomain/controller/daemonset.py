@@ -16,7 +16,13 @@ from typing import Dict, List, Optional
 
 from tpu_dra.computedomain import CD_FINALIZER, CD_LABEL_KEY
 from tpu_dra.infra import featuregates
-from tpu_dra.k8sclient import DAEMON_SETS, PODS, ApiNotFound, ResourceClient
+from tpu_dra.k8sclient import (
+    COMPUTE_DOMAINS,
+    DAEMON_SETS,
+    PODS,
+    ApiNotFound,
+    ResourceClient,
+)
 
 log = logging.getLogger(__name__)
 
@@ -27,12 +33,13 @@ class DaemonSetManager:
         backend,
         driver_namespace: str,
         image: str = "tpu-dra-driver:latest",
-        additional_namespaces: Optional[List[str]] = None,
+        additional_namespaces: Optional[List[str]] = None,  # mnsdaemonset.go
         service_account: str = "",
     ):
         self.backend = backend
         self.daemonsets = ResourceClient(backend, DAEMON_SETS)
         self.pods = ResourceClient(backend, PODS)
+        self.cds = ResourceClient(backend, COMPUTE_DOMAINS)
         self.driver_namespace = driver_namespace
         self.image = image
         # RBAC identity for daemon pods (clique registration needs write
@@ -40,6 +47,43 @@ class DaemonSetManager:
         self.service_account = service_account
         # mnsdaemonset.go analog: CDs may live in additional namespaces.
         self.namespaces = [driver_namespace] + (additional_namespaces or [])
+
+    def delete_orphans(self, live_uids) -> int:
+        """mnsdaemonset.go GC role: across every managed namespace, request
+        deletion of CD-labeled DaemonSets whose ComputeDomain no longer
+        exists (missed-finalizer safety net). Returns the count deleted."""
+        n = 0
+        for ns in self.namespaces:
+            for ds in self.daemonsets.list(namespace=ns):
+                uid = (ds["metadata"].get("labels") or {}).get(CD_LABEL_KEY)
+                if not uid or uid in live_uids:
+                    continue
+                # live_uids is a snapshot: a CD created after it was taken
+                # could already own this DS. Re-fetch via the DS annotations
+                # before declaring it orphaned (TOCTOU guard).
+                if self._cd_alive(ds, uid):
+                    continue
+                if not ds["metadata"].get("deletionTimestamp"):
+                    try:
+                        self.daemonsets.delete(ds["metadata"]["name"], ns)
+                        n += 1
+                    except ApiNotFound:
+                        continue
+                # With no CD left to drive the teardown reconcile, the GC
+                # must also lift our finalizer once the pods are gone.
+                cur = self.daemonsets.try_get(ds["metadata"]["name"], ns)
+                if cur is not None:
+                    self._strip_finalizer_if_pods_gone(cur, ns, uid)
+        return n
+
+    def _cd_alive(self, ds: dict, uid: str) -> bool:
+        ann = ds["metadata"].get("annotations") or {}
+        name = ann.get("resource.tpu.google.com/computeDomainName")
+        ns = ann.get("resource.tpu.google.com/computeDomainNamespace")
+        if not name or not ns:
+            return False
+        cd = self.cds.try_get(name, ns)
+        return cd is not None and cd["metadata"].get("uid") == uid
 
     def name_for(self, cd: dict) -> str:
         return f"compute-domain-daemon-{cd['metadata']['uid'][:13]}"
@@ -209,6 +253,18 @@ class DaemonSetManager:
         )
         return not pods
 
+    def _strip_finalizer_if_pods_gone(self, ds: dict, ns: str, uid: str) -> None:
+        """Shared finalizer-removal semantics (daemonset.go:317-366): only
+        once no daemon pod of the CD remains."""
+        if CD_FINALIZER not in ds["metadata"].get("finalizers", []):
+            return
+        if self.pods.list(namespace=ns, label_selector={CD_LABEL_KEY: uid}):
+            return
+        ds["metadata"]["finalizers"] = [
+            f for f in ds["metadata"]["finalizers"] if f != CD_FINALIZER
+        ]
+        self.daemonsets.update(ds)
+
     def finalize_if_pods_gone(self, cd: dict) -> bool:
         """Remove our finalizer from the DS once its pods are gone
         (daemonset.go:317-366); True when the DS is fully gone."""
@@ -217,11 +273,9 @@ class DaemonSetManager:
             return True
         if not ds["metadata"].get("deletionTimestamp"):
             return False
-        if not self.pods_gone(cd):
-            return False
-        fins = [f for f in ds["metadata"].get("finalizers", []) if f != CD_FINALIZER]
-        ds["metadata"]["finalizers"] = fins
-        self.daemonsets.update(ds)
+        self._strip_finalizer_if_pods_gone(
+            ds, self.driver_namespace, cd["metadata"]["uid"]
+        )
         return self.daemonsets.try_get(self.name_for(cd), self.driver_namespace) is None
 
 
