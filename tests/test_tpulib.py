@@ -297,3 +297,76 @@ def test_degenerate_shape_rejected_not_crash():
     lib = make_stub()
     with pytest.raises(TpuLibError):
         lib.create_subslice(Placement(TopologyCoord(0, 0, 0), SubsliceShape((1, 0, 1))))
+
+
+def test_linux_health_poller_detects_and_recovers(tmp_path):
+    """The sysfs poller (XID event-stream analog) emits unhealthy on accel
+    node disappearance and healthy on recovery."""
+    import shutil
+
+    sysfs, dev = fabricate_sysfs(tmp_path)
+    lib = LinuxTpuLib(sysfs_root=sysfs, dev_root=dev, env={})
+    chip = lib.chips()[0]
+    assert chip.healthy
+    # Remove the chip's accel node; a probe must flag it.
+    node = tmp_path / "dev" / "accel0"
+    node.unlink()
+    healthy, reason = lib._probe_chip(chip)
+    assert not healthy and reason == "accel-node-vanished"
+    from tpu_dra.tpulib.types import ChipHealthEvent
+
+    lib.inject_health_event(
+        ChipHealthEvent(chip_uuid=chip.uuid, healthy=False, reason=reason)
+    )
+    assert not lib.chips()[0].healthy
+    ev = lib.health_events().get_nowait()
+    assert ev.reason == "accel-node-vanished"
+    # Node returns -> probe recovers.
+    node.touch()
+    healthy, reason = lib._probe_chip(chip)
+    assert healthy
+    # PCI function vanishing is also a fault.
+    shutil.rmtree(tmp_path / "sys" / "devices" / "pci0000:00" / "0000:00:00.0")
+    (tmp_path / "sys" / "bus" / "pci" / "devices" / "0000:00:00.0").unlink()
+    healthy, reason = lib._probe_chip(chip)
+    assert not healthy and reason == "pci-device-vanished"
+
+
+def test_linux_health_poller_thread_lifecycle(tmp_path):
+    import time
+
+    sysfs, dev = fabricate_sysfs(tmp_path)
+    lib = LinuxTpuLib(sysfs_root=sysfs, dev_root=dev, env={})
+    lib.start_health_monitor(period=0.05)
+    (tmp_path / "dev" / "accel1").unlink()
+    ev = lib.health_events().get(timeout=5)
+    assert ev.healthy is False
+    assert ev.chip_uuid == lib.chips()[1].uuid
+    # Recovery event after the node returns.
+    (tmp_path / "dev" / "accel1").touch()
+    ev = lib.health_events().get(timeout=5)
+    assert ev.healthy is True and ev.reason == "recovered"
+    lib.stop_health_monitor()
+
+
+def test_linux_health_probe_vfio_and_unbound(tmp_path):
+    """Passthrough chips (bound to vfio-pci) are not flagged; chips the
+    accel driver never bound are unhealthy until claimed."""
+    import os as _os
+
+    sysfs, dev = fabricate_sysfs(tmp_path)
+    # Chip 3 has no accel node (driver failed to bind it).
+    (tmp_path / "dev" / "accel3").unlink()
+    lib = LinuxTpuLib(sysfs_root=sysfs, dev_root=dev, env={})
+    unbound = lib.chips()[3]
+    assert unbound.dev_paths == []
+    healthy, reason = lib._probe_chip(unbound)
+    assert not healthy and reason == "accel-node-missing"
+    # Rebind chip 3 to vfio-pci: intentionally detached -> healthy.
+    real = tmp_path / "sys" / "devices" / "pci0000:03" / "0000:03:00.0"
+    _os.unlink(real / "driver")
+    vfio_drv = tmp_path / "sys" / "bus" / "pci" / "drivers" / "vfio-pci"
+    vfio_drv.mkdir(parents=True, exist_ok=True)
+    _os.symlink(vfio_drv, real / "driver")
+    healthy, reason = lib._probe_chip(unbound)
+    assert healthy

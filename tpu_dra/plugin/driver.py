@@ -119,6 +119,9 @@ class Driver:
             self._socket_paths = [dra_socket, reg_socket]
         if fg.enabled(fg.DEVICE_HEALTH_CHECK):
             self.health_monitor.start()
+            # Backends with a kernel-surface poller (linux) start producing
+            # events; the stub's hook is a no-op (its queue is test-injected).
+            self.tpulib.start_health_monitor()
         self.cleanup.start()
         self.publish_resources()
         self.metrics.set_gauge("allocatable_devices", len(self.state.allocatable))
@@ -126,6 +129,7 @@ class Driver:
     def shutdown(self) -> None:
         self.cleanup.stop()
         self.health_monitor.stop()
+        self.tpulib.stop_health_monitor()
         for s in self._servers:
             # stop() only *initiates* shutdown; wait for full termination or
             # the executor's non-daemon workers block interpreter exit.

@@ -253,8 +253,21 @@ class BaseTpuLib(TpuLib):
     def inject_health_event(self, ev: ChipHealthEvent) -> None:
         """Mark a chip (un)healthy and publish the event. On the linux
         backend this is driven by sysfs/runtime monitors; tests and the stub
-        drive it directly (the XID fault-injection seam the reference lacks)."""
-        for c in self.chips():
-            if c.uuid == ev.chip_uuid:
-                c.healthy = ev.healthy
+        drive it directly (the XID fault-injection seam the reference lacks).
+
+        Taken under the backend lock so the health write is ordered against
+        in-flight sub-slice creation (whose healthy check also holds it):
+        an event racing a create lands after it and the republish path then
+        unpublishes the affected devices."""
+        with self._lock:
+            for c in self.chips():
+                if c.uuid == ev.chip_uuid:
+                    c.healthy = ev.healthy
         self._health_q.put(ev)
+
+    def start_health_monitor(self, period: float = 5.0) -> None:
+        """Start producing kernel/runtime-driven health events; no-op on
+        backends whose events are injected (stub)."""
+
+    def stop_health_monitor(self) -> None:
+        """Stop the health producer started by start_health_monitor."""

@@ -112,5 +112,12 @@ class TpuLib:
     def health_events(self) -> "queue.Queue[ChipHealthEvent]":
         raise NotImplementedError
 
+    def start_health_monitor(self, period: float = 5.0) -> None:
+        """Start producing backend-driven health events (no-op where events
+        are injected externally)."""
+
+    def stop_health_monitor(self) -> None:
+        pass
+
     def shutdown(self) -> None:
         pass

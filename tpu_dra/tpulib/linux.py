@@ -22,13 +22,15 @@ from __future__ import annotations
 import logging
 import os
 import re
-from typing import Dict, List, Optional
+import threading
+from typing import Dict, List, Optional, Tuple
 
 from tpu_dra.tpulib import native
 from tpu_dra.tpulib.base import BaseTpuLib
 from tpu_dra.tpulib.interface import TpuLibError
 from tpu_dra.tpulib.types import (
     GENERATIONS,
+    ChipHealthEvent,
     ChipInfo,
     Generation,
     IciDomain,
@@ -181,6 +183,82 @@ class LinuxTpuLib(BaseTpuLib):
             slice_uuid = str(uuidlib.UUID(h[:32]))
         topo = parse_topology(topology) if topology else (0, 0, 0)
         return IciDomain(slice_uuid=slice_uuid, partition=0, topology=topo)
+
+    # --- health polling (the XID event-stream analog) ---
+    #
+    # TPUs expose no NVML-style event API; the observable fault surface is
+    # the kernel's: the PCI function must stay present and enabled, and the
+    # accel char device must not vanish. A poller watches for transitions
+    # and feeds the shared health queue consumed by DeviceHealthMonitor
+    # (device_health.go:146-204 analog, poll-based instead of event-based).
+
+    def start_health_monitor(self, period: float = 5.0) -> None:
+        if getattr(self, "_health_thread", None) is not None:
+            return
+        self._health_stop = threading.Event()
+        self._health_thread = threading.Thread(
+            target=self._health_poll_loop, args=(period,),
+            daemon=True, name="tpulib-health-poller",
+        )
+        self._health_thread.start()
+
+    def stop_health_monitor(self) -> None:
+        if getattr(self, "_health_thread", None) is None:
+            return
+        self._health_stop.set()
+        self._health_thread.join(timeout=10)
+        self._health_thread = None
+
+    def _probe_chip(self, chip: ChipInfo) -> Tuple[bool, str]:
+        pci_dir = os.path.join(
+            self._sysfs_root, "bus", "pci", "devices", chip.pci_bus_id
+        )
+        if not os.path.isdir(pci_dir):
+            return False, "pci-device-vanished"
+        # A chip handed to a VM via passthrough is intentionally detached
+        # from the accel driver; do not flag it (the reference likewise
+        # excludes vfio devices from NVML health, they are not NVML-visible).
+        try:
+            bound = os.path.basename(os.readlink(os.path.join(pci_dir, "driver")))
+        except OSError:
+            bound = ""
+        if bound == "vfio-pci":
+            return True, ""
+        # A chip the accel driver never bound has no device node a workload
+        # could use — unhealthy until the driver claims it.
+        if not chip.dev_paths:
+            return False, "accel-node-missing"
+        # A surprise-down/AER-contained function reads enable==0 after the
+        # kernel tears it down; 0 is also the pre-driver state, so only
+        # trust it for chips that do have a device node.
+        try:
+            with open(os.path.join(pci_dir, "enable")) as f:
+                if f.read().strip() == "0":
+                    return False, "pci-function-disabled"
+        except OSError:
+            pass
+        for dev in chip.dev_paths:
+            node = os.path.join(self._dev_root, os.path.basename(dev))
+            if not os.path.exists(node):
+                return False, "accel-node-vanished"
+        return True, ""
+
+    def _health_poll_loop(self, period: float) -> None:
+        while not self._health_stop.wait(period):
+            for chip in self._chips:
+                try:
+                    healthy, reason = self._probe_chip(chip)
+                except Exception:
+                    log.exception("health probe failed for %s", chip.uuid)
+                    continue
+                if healthy != chip.healthy:
+                    self.inject_health_event(
+                        ChipHealthEvent(
+                            chip_uuid=chip.uuid,
+                            healthy=healthy,
+                            reason=reason or "recovered",
+                        )
+                    )
 
     # --- backend hooks ---
 
