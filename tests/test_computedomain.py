@@ -271,11 +271,12 @@ def test_bootstrap_env_rendering():
     assert env["JAX_NUM_PROCESSES"] == "2"
     assert "MEGASCALE_COORDINATOR_ADDRESS" not in env
     multi = render_bootstrap_env(
-        worker_id=0, num_nodes=2, accelerator_type="v5p-16",
+        worker_id=0, num_nodes=8, accelerator_type="v5p-16",
         topology="2x2x2", peers=[], num_slices=4, slice_index=2,
     )
     assert multi["MEGASCALE_NUM_SLICES"] == "4"
     assert multi["MEGASCALE_SLICE_ID"] == "2"
+    assert multi["JAX_NUM_PROCESSES"] == "2"  # per-slice, not domain-wide
 
 
 def test_daemon_readiness_and_check(fc, tmp_path):
@@ -863,3 +864,168 @@ def test_orphan_gc_toctou_guard(fc):
     assert c.daemonsets.delete_orphans(set()) == 0
     dss = ResourceClient(fc, DAEMON_SETS)
     assert len(dss.list(namespace=DRIVER_NS)) == 1
+
+
+# --- multi-slice (DCN/megascale) domains ------------------------------------
+
+
+def test_multislice_domain_bringup(fc, tmp_path):
+    """A numSlices=2 domain: each ICI slice forms its own clique; workers
+    get slice-local identity plus MEGASCALE_* DCN settings; the domain is
+    Ready only when every host of every slice registered."""
+    cds = ResourceClient(fc, COMPUTE_DOMAINS)
+    cd = cds.create(
+        {
+            "metadata": {"name": "ms", "namespace": NS},
+            "spec": {
+                "numNodes": 4,
+                "numSlices": 2,
+                "channel": {"resourceClaimTemplate": {"name": "ms-channel"}},
+                "acceleratorType": "v5p-16",
+                "topology": "2x2x2",
+            },
+        }
+    )
+    c = ComputeDomainController(fc, driver_namespace=DRIVER_NS)
+    reconcile(c, cd)
+
+    daemons = []
+    for s in range(2):  # slice index
+        for w in range(2):  # worker within slice
+            config = DaemonConfig(
+                cd_uid=cd["metadata"]["uid"],
+                cd_name="ms",
+                cd_namespace=NS,
+                num_nodes=4,
+                num_slices=2,
+                node_name=f"node-{s}-{w}",
+                pod_ip=f"10.0.{s}.{w + 1}",
+                config_dir=str(tmp_path / f"cd-{s}-{w}"),
+                hosts_path=str(tmp_path / f"hosts-{s}-{w}"),
+            )
+            d = SliceDaemon(
+                config,
+                fc,
+                tpulib=make_stub(w, slice_uuid=f"slice-{s}-uuid"),
+            )
+            daemons.append(d)
+
+    # First slice alone: registration succeeds but identity is pending
+    # until the controller pins the clique's sliceIndex.
+    for d in daemons[:2]:
+        assert d.run_once() is False
+    reconcile(c, cds.get("ms", NS))  # controller pins sliceIndex=0
+    for d in daemons[:2]:
+        d.run_once()
+    for d in daemons[:2]:
+        assert d.run_once() is True  # slice-locally ready
+    assert cds.get("ms", NS)["status"]["status"] == "NotReady"  # 2/4
+
+    for d in daemons[2:]:
+        d.run_once()
+    reconcile(c, cds.get("ms", NS))  # pins sliceIndex=1 on the new clique
+    for d in daemons:
+        d.run_once()
+    reconcile(c, cds.get("ms", NS))
+    cur = cds.get("ms", NS)
+    assert cur["status"]["status"] == "Ready"
+    assert len(cur["status"]["nodes"]) == 4
+    # Two distinct cliques (one per slice).
+    assert len({n["cliqueID"] for n in cur["status"]["nodes"]}) == 2
+
+    # Bootstrap env: slice-local worker identity + megascale DCN settings.
+    env0 = read_bootstrap_env(str(tmp_path / "cd-0-0"))
+    env1 = read_bootstrap_env(str(tmp_path / "cd-1-1"))
+    assert env0["JAX_NUM_PROCESSES"] == "2"
+    assert env0["MEGASCALE_NUM_SLICES"] == "2"
+    assert env0["TPU_WORKER_HOSTNAMES"].count(",") == 1  # 2 slice-local hosts
+    assert env1["MEGASCALE_NUM_SLICES"] == "2"
+    assert env0["MEGASCALE_SLICE_ID"] != env1["MEGASCALE_SLICE_ID"]
+    assert env1["TPU_WORKER_ID"] in ("0", "1")
+    # Coordinator is slice 0's index-0 host, addressed by pod IP; both
+    # slices agree on it.
+    assert env0["MEGASCALE_COORDINATOR_ADDRESS"] == \
+        env1["MEGASCALE_COORDINATOR_ADDRESS"]
+    ip = env0["MEGASCALE_COORDINATOR_ADDRESS"].split(":")[0]
+    assert ip.startswith("10.0.")
+    # Slice identity is pinned: further ticks never reshuffle it.
+    before = [d.registration.multislice_info()[0] for d in daemons]
+    for d in daemons:
+        d.run_once()
+    after = [d.registration.multislice_info()[0] for d in daemons]
+    assert before == after
+    assert sorted(set(after)) == [0, 1]
+
+
+def test_multislice_legacy_path(fc, tmp_path):
+    """Gate off: domain-wide CD.Status.Nodes still yields slice-local
+    indices/peers, pinned slice ids, and per-slice readiness."""
+    _cliques_off()
+    cds = ResourceClient(fc, COMPUTE_DOMAINS)
+    cd = cds.create(
+        {
+            "metadata": {"name": "msl", "namespace": NS},
+            "spec": {
+                "numNodes": 4,
+                "numSlices": 2,
+                "channel": {"resourceClaimTemplate": {"name": "msl-ch"}},
+                "acceleratorType": "v5p-16",
+                "topology": "2x2x2",
+            },
+        }
+    )
+    daemons = []
+    for s in range(2):
+        for w in range(2):
+            config = DaemonConfig(
+                cd_uid=cd["metadata"]["uid"],
+                cd_name="msl",
+                cd_namespace=NS,
+                num_nodes=4,
+                num_slices=2,
+                node_name=f"n-{s}-{w}",
+                pod_ip=f"10.1.{s}.{w + 1}",
+                config_dir=str(tmp_path / f"msl-{s}-{w}"),
+                hosts_path=str(tmp_path / f"mslh-{s}-{w}"),
+            )
+            daemons.append(
+                SliceDaemon(
+                    config, fc,
+                    tpulib=make_stub(w, slice_uuid=f"legacy-slice-{s}"),
+                )
+            )
+    # Slice 0 registers fully; slice 1 absent. Slice-local readiness only.
+    for d in daemons[:2]:
+        d.run_once()
+    assert daemons[0].run_once() is True
+    nodes = cds.get("msl", NS)["status"]["nodes"]
+    # Domain-wide list carries both entries, but worker ids are slice-local:
+    by_name = {n["name"]: n for n in nodes}
+    assert by_name["n-0-0"]["index"] != by_name["n-0-1"]["index"]
+    for d in daemons[2:]:
+        d.run_once()
+    for d in daemons:
+        d.run_once()
+    nodes = cds.get("msl", NS)["status"]["nodes"]
+    # Indices gap-fill within each clique: both slices use {0,1}.
+    for s in range(2):
+        idxs = {
+            n["index"] for n in nodes if n["cliqueID"].startswith(f"legacy-slice-{s}")
+        }
+        assert idxs == {0, 1}
+    # Pinned slice ids are distinct and stable.
+    infos = [d.registration.multislice_info() for d in daemons]
+    assert sorted({i[0] for i in infos}) == [0, 1]
+    env = read_bootstrap_env(str(tmp_path / "msl-1-0"))
+    assert env["MEGASCALE_NUM_SLICES"] == "2"
+    assert env["JAX_NUM_PROCESSES"] == "2"
+
+
+def test_multislice_validation():
+    from tpu_dra.computedomain.daemon.bootstrap import render_bootstrap_env
+
+    with pytest.raises(ValueError, match="divisible"):
+        render_bootstrap_env(
+            worker_id=0, num_nodes=3, accelerator_type="v5p-16",
+            topology="2x2x2", peers=[], num_slices=2,
+        )

@@ -101,12 +101,16 @@ class ComputeDomainSpec(Serde):
     channel: Optional[ComputeDomainChannelSpec] = None
     topology: str = ""
     accelerator_type: str = ""
+    # Multi-slice (DCN/megascale) domains: number of ICI pod slices the
+    # domain spans; must divide numNodes. 1 = single-slice (the common case).
+    num_slices: int = 1
 
     FIELDS = {
         "numNodes": Field("num_nodes", required=True),
         "channel": Field("channel", *nested(ComputeDomainChannelSpec)),
         "topology": Field("topology"),
         "acceleratorType": Field("accelerator_type"),
+        "numSlices": Field("num_slices"),
     }
 
 

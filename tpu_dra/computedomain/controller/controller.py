@@ -139,6 +139,7 @@ class ComputeDomainController:
             cd = self.cds.update(cd)
         self.rcts.create_or_update(cd)
         self.daemonsets.create_or_update(cd)
+        self.status.assign_slice_indices(cd)
         self.status.sync(cd)
 
     def _teardown(self, cd: dict) -> None:

@@ -154,6 +154,12 @@ class DaemonSetManager:
                                         "name": "NUM_NODES",
                                         "value": str(cd["spec"]["numNodes"]),
                                     },
+                                    {
+                                        "name": "NUM_SLICES",
+                                        "value": str(
+                                            cd["spec"].get("numSlices") or 1
+                                        ),
+                                    },
                                     # Downward-API identity: without these
                                     # every daemon registers as '' and all
                                     # hosts collapse onto clique index 0.

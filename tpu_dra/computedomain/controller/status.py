@@ -140,6 +140,51 @@ class StatusManager:
         nodes.sort(key=lambda n: (n.get("cliqueID", ""), n.get("index", 0)))
         return nodes
 
+    def assign_slice_indices(self, cd: dict) -> None:
+        """Pin gap-filled ``sliceIndex`` on cliques that lack one
+        (multi-slice domains, cliques path). The leader-elected controller
+        is the single writer, so two cliques can never both get 0 — the
+        race daemon-side self-assignment across different objects would
+        have. Deterministic order: creationTimestamp, then name."""
+        if (cd["spec"].get("numSlices") or 1) <= 1:
+            return
+        if not featuregates.enabled(featuregates.COMPUTE_DOMAIN_CLIQUES):
+            return  # legacy path CASes on the single CD status object
+        for _ in range(5):
+            cliques = self.cliques_for(cd)
+            used = {
+                c["sliceIndex"]
+                for c in cliques
+                if c.get("sliceIndex") is not None
+            }
+            missing = sorted(
+                (c for c in cliques if c.get("sliceIndex") is None),
+                key=lambda c: (
+                    c["metadata"].get("creationTimestamp", ""),
+                    c["metadata"]["name"],
+                ),
+            )
+            if not missing:
+                return
+            conflicted = False
+            for c in missing:
+                idx = 0
+                while idx in used:
+                    idx += 1
+                c["sliceIndex"] = idx
+                try:
+                    self.cliques.update(c)
+                    used.add(idx)
+                    log.info(
+                        "pinned sliceIndex=%d on clique %s", idx,
+                        c["metadata"]["name"],
+                    )
+                except ApiConflict:
+                    conflicted = True  # daemon wrote the object; re-read
+                    break
+            if not conflicted:
+                return
+
     def delete_cliques(self, cd: dict) -> bool:
         """Delete clique objects on CD teardown; True when all gone."""
         cliques = self.cliques_for(cd)

@@ -38,26 +38,62 @@ def render_bootstrap_env(
     peers: List[dict],
     num_slices: int = 1,
     slice_index: int = 0,
+    megascale_coordinator_ip: Optional[str] = None,
 ) -> Dict[str, str]:
-    hostnames = ",".join(dns_name(i) for i in range(num_nodes))
+    """``num_nodes`` is domain-global (spec.numNodes); ``worker_id`` is the
+    host's **slice-local** index (its clique registration index — each ICI
+    pod slice forms one clique, and DNS names/peers/hosts mappings are
+    slice-local). The libtpu/JAX identity (TPU_WORKER_ID, hostnames,
+    coordinator) therefore spans one slice, while MEGASCALE_* spans slices
+    over DCN — its coordinator is addressed by **pod IP**, never by the
+    shared DNS names, which each slice's /etc/hosts maps to its own peers
+    and so cannot resolve across slices."""
+    if num_nodes < 1 or num_slices < 1:
+        raise ValueError("num_nodes and num_slices must be >= 1")
+    if num_nodes % num_slices:
+        raise ValueError(
+            f"numNodes ({num_nodes}) must be divisible by numSlices "
+            f"({num_slices})"
+        )
+    per_slice = num_nodes // num_slices
+    if not 0 <= worker_id < per_slice:
+        # An index past the slice size means more hosts registered into the
+        # clique than numNodes/numSlices allows (numSlices misconfigured, or
+        # hosts without ICI identity collapsing onto one fallback clique).
+        # Aliasing it would hand two workers the same identity — fail loud.
+        raise ValueError(
+            f"worker index {worker_id} out of range for a "
+            f"{per_slice}-host slice (numNodes={num_nodes}, "
+            f"numSlices={num_slices})"
+        )
+    local_id = worker_id
+    hostnames = ",".join(dns_name(i) for i in range(per_slice))
     env = {
-        "TPU_WORKER_ID": str(worker_id),
+        "TPU_WORKER_ID": str(local_id),
         "TPU_WORKER_HOSTNAMES": hostnames,
         "TPU_ACCELERATOR_TYPE": accelerator_type,
         "TPU_TOPOLOGY": topology,
         "JAX_COORDINATOR_ADDRESS": f"{dns_name(0)}:{COORDINATOR_PORT}",
-        "JAX_NUM_PROCESSES": str(num_nodes),
-        "JAX_PROCESS_ID": str(worker_id),
+        "JAX_NUM_PROCESSES": str(per_slice),
+        "JAX_PROCESS_ID": str(local_id),
     }
     if num_slices > 1:
-        # Multi-slice (DCN) domains: megascale coordinator on slice 0.
+        # Multi-slice (DCN) domains: megascale coordinator on slice 0's
+        # index-0 host, addressed by pod IP — the shared DNS names resolve
+        # slice-locally via /etc/hosts, so a name cannot reach across
+        # slices. Until slice 0 has registered the IP is unknown and the
+        # variable is omitted; the daemon re-renders every tick and the
+        # readiness gate holds workloads until the domain is complete.
         env.update(
             {
-                "MEGASCALE_COORDINATOR_ADDRESS": f"{dns_name(0)}:{MEGASCALE_PORT}",
                 "MEGASCALE_NUM_SLICES": str(num_slices),
                 "MEGASCALE_SLICE_ID": str(slice_index),
             }
         )
+        if megascale_coordinator_ip:
+            env["MEGASCALE_COORDINATOR_ADDRESS"] = (
+                f"{megascale_coordinator_ip}:{MEGASCALE_PORT}"
+            )
     return env
 
 

@@ -16,7 +16,11 @@ from typing import List, Optional
 
 from tpu_dra.api import CD_STATUS_NOT_READY
 from tpu_dra.computedomain import CD_LABEL_KEY
-from tpu_dra.computedomain.daemon.registration import RETRY, RegistrationBase
+from tpu_dra.computedomain.daemon.registration import (
+    RETRY,
+    MultisliceIdentityPending,
+    RegistrationBase,
+)
 from tpu_dra.k8sclient import (
     COMPUTE_DOMAIN_CLIQUES,
     ApiConflict,
@@ -62,6 +66,41 @@ class CliqueRegistration(RegistrationBase):
         if obj.get("daemons") is None:
             obj["daemons"] = []
         return obj["daemons"]
+
+    def multislice_info(self):
+        """(pinned slice index, megascale coordinator IP or None), one LIST.
+
+        Slice indices are assigned by the **controller** (the single
+        leader-elected writer — daemons racing gap-filled self-assignment
+        across *different* clique objects could both claim 0, since
+        optimistic concurrency only guards same-object writes). Daemons
+        read their clique's pinned ``sliceIndex``; until it lands they
+        report identity-pending and stay NotReady. The coordinator is
+        slice 0's index-0 daemon, addressed by pod IP (each slice's
+        /etc/hosts maps the shared DNS names to its OWN peers, so a name
+        cannot cross slices)."""
+        cliques = self.cliques.list(
+            namespace=self.cd_namespace,
+            label_selector={CD_LABEL_KEY: self.cd_uid},
+        )
+        mine = next(
+            (c for c in cliques if c["metadata"]["name"] == self.clique_name),
+            None,
+        )
+        if mine is None or mine.get("sliceIndex") is None:
+            raise MultisliceIdentityPending(
+                f"clique {self.clique_name} has no controller-assigned "
+                f"sliceIndex yet"
+            )
+        idx = mine["sliceIndex"]
+        coord_ip = None
+        for c in cliques:
+            if c.get("sliceIndex") == 0:
+                for d in c.get("daemons") or []:
+                    if d.get("index", 0) == 0:
+                        coord_ip = d.get("ipAddress") or None
+                break
+        return idx, coord_ip
 
     def _on_missing_register(self):
         """First daemon of the clique creates the object (cdclique.go

@@ -29,6 +29,7 @@ from tpu_dra.computedomain.daemon.bootstrap import (
 from tpu_dra.computedomain.daemon.clique import CliqueRegistration
 from tpu_dra.computedomain.daemon.dnsnames import DNSNameManager
 from tpu_dra.computedomain.daemon.podmanager import PodManager
+from tpu_dra.computedomain.daemon.registration import MultisliceIdentityPending
 from tpu_dra.computedomain.daemon.status_legacy import DirectStatusRegistration
 from tpu_dra.infra import featuregates, flags, signals
 from tpu_dra.tpulib import new_tpulib
@@ -94,8 +95,13 @@ class SliceDaemon:
 
     def compute_ready(self, peers) -> bool:
         """All expected hosts registered + local chips healthy (the
-        all-or-nothing slice-membership gate)."""
-        if len(peers) < self.config.num_nodes:
+        all-or-nothing slice-membership gate). Peers are slice-local, so
+        the expectation is per-slice; domain-wide readiness is the
+        controller's aggregation across cliques."""
+        expected = max(
+            1, self.config.num_nodes // max(1, self.config.num_slices)
+        )
+        if len(peers) < expected:
             return False
         if not all(c.healthy for c in self.tpulib.chips()):
             return False
@@ -126,7 +132,26 @@ class SliceDaemon:
             if ici and ici.topology != (0, 0, 0)
             else topology_str(gen.host_extent)
         )
-        n_chips = self.config.num_nodes * len(self.tpulib.chips())
+        # Accelerator type describes ONE slice (a 4-slice v5p-16 domain is
+        # four v5p-16s over DCN, not a v5p-64).
+        per_slice_nodes = max(
+            1, self.config.num_nodes // max(1, self.config.num_slices)
+        )
+        n_chips = per_slice_nodes * len(self.tpulib.chips())
+        if self.config.num_slices > 1:
+            try:
+                slice_index, coord_ip = self.registration.multislice_info()
+            except MultisliceIdentityPending as e:
+                # Publishing an unresolved identity could alias two slices
+                # onto the same MEGASCALE_SLICE_ID; stay NotReady and let
+                # the next tick retry once the controller has pinned it.
+                log.info("multislice identity pending: %s", e)
+                self._ready = False
+                self._write_ready_file(False)
+                self.registration.set_status(False)
+                return False
+        else:
+            slice_index, coord_ip = 0, None
         env = render_bootstrap_env(
             worker_id=index,
             num_nodes=self.config.num_nodes,
@@ -134,6 +159,8 @@ class SliceDaemon:
             topology=topo,
             peers=peers,
             num_slices=self.config.num_slices,
+            slice_index=slice_index,
+            megascale_coordinator_ip=coord_ip,
         )
         write_bootstrap_files(self.config.config_dir, env, peers)
         ready = self.compute_ready(peers)
@@ -188,6 +215,7 @@ def main(argv=None) -> int:
     p.add_argument("--cd-name", default=flags.env_default("CD_NAME", ""))
     p.add_argument("--cd-namespace", default=flags.env_default("CD_NAMESPACE", "default"))
     p.add_argument("--num-nodes", type=int, default=flags.env_default("NUM_NODES", 1, int))
+    p.add_argument("--num-slices", type=int, default=flags.env_default("NUM_SLICES", 1, int))
     p.add_argument("--node-name", default=flags.env_default("NODE_NAME", ""))
     p.add_argument("--pod-ip", default=flags.env_default("POD_IP", ""))
     p.add_argument("--config-dir", default=flags.env_default("CD_CONFIG_DIR", "/tpu-cd"))
@@ -208,6 +236,7 @@ def main(argv=None) -> int:
         cd_name=args.cd_name,
         cd_namespace=args.cd_namespace,
         num_nodes=args.num_nodes,
+        num_slices=args.num_slices,
         node_name=args.node_name,
         pod_ip=args.pod_ip,
         config_dir=args.config_dir,

@@ -24,6 +24,12 @@ MAX_CONFLICT_RETRIES = 20
 RETRY = object()
 
 
+class MultisliceIdentityPending(RuntimeError):
+    """Raised when a daemon's slice identity (MEGASCALE_SLICE_ID /
+    coordinator) is not yet resolved; the caller degrades to NotReady and
+    retries next tick rather than publishing an aliased identity."""
+
+
 def assign_gap_filled_index(entries: List[dict]) -> int:
     """Smallest free index — gap-filling keeps indices (and the DNS names
     derived from them) stable across daemon restarts (cdclique.go:350-372)."""
@@ -79,6 +85,18 @@ class RegistrationBase:
             "status": status,
         }
 
+    def _scope(self, entries: List[dict]) -> List[dict]:
+        """Restrict to OUR slice's entries. Identity where the fetched
+        object is already slice-scoped (a clique); the legacy CD.Status path
+        overrides to filter the domain-wide node list by cliqueID — indices
+        and peers are always slice-local."""
+        return entries
+
+    def multislice_info(self):
+        """(pinned slice index, megascale coordinator IP or None).
+        Single-slice default."""
+        return 0, None
+
     # --- shared state machine ---
 
     def register(self) -> int:
@@ -102,7 +120,7 @@ class RegistrationBase:
                 # Pod restart changed our IP; refresh it.
                 mine["ipAddress"] = self.ip_address
             else:
-                self.index = assign_gap_filled_index(entries)
+                self.index = assign_gap_filled_index(self._scope(entries))
                 entries.append(self._entry(self.index, CD_STATUS_NOT_READY))
             try:
                 self._persist(obj)
@@ -137,7 +155,9 @@ class RegistrationBase:
         obj = self._fetch()
         if obj is None:
             return []
-        return sorted(self._entries(obj), key=lambda e: e.get("index", 0))
+        return sorted(
+            self._scope(self._entries(obj)), key=lambda e: e.get("index", 0)
+        )
 
     def deregister(self) -> None:
         for _ in range(MAX_CONFLICT_RETRIES):

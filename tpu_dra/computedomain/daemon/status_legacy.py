@@ -15,8 +15,11 @@ from __future__ import annotations
 import logging
 from typing import List, Optional
 
-from tpu_dra.computedomain.daemon.registration import RegistrationBase
-from tpu_dra.k8sclient import COMPUTE_DOMAINS, ResourceClient
+from tpu_dra.computedomain.daemon.registration import (
+    MultisliceIdentityPending,
+    RegistrationBase,
+)
+from tpu_dra.k8sclient import COMPUTE_DOMAINS, ApiConflict, ResourceClient
 
 log = logging.getLogger(__name__)
 
@@ -65,6 +68,60 @@ class DirectStatusRegistration(RegistrationBase):
         if status.get("nodes") is None:
             status["nodes"] = []
         return status["nodes"]
+
+    def _scope(self, entries: List[dict]) -> List[dict]:
+        # CD.Status.Nodes is domain-wide; indices/peers/readiness are
+        # slice-local, so scope to our clique's entries.
+        return [e for e in entries if e.get("cliqueID") == self.clique_id]
+
+    def multislice_info(self):
+        """(pinned slice index, megascale coordinator IP or None).
+
+        The per-clique slice index is persisted as ``sliceIndex`` on the
+        clique's node entries at first assignment (same pin-once rule as
+        the clique-object path) with a conflict-retried status write."""
+        for _ in range(5):
+            cd = self._fetch()
+            if cd is None:
+                return 0, None
+            nodes = (cd.get("status") or {}).get("nodes") or []
+            by_clique = {}
+            for n in nodes:
+                if n.get("sliceIndex") is not None:
+                    by_clique.setdefault(n.get("cliqueID", ""), n["sliceIndex"])
+            idx = by_clique.get(self.clique_id)
+            if idx is None:
+                used = set(by_clique.values())
+                idx = 0
+                while idx in used:
+                    idx += 1
+                changed = False
+                for n in nodes:
+                    if n.get("cliqueID") == self.clique_id:
+                        n["sliceIndex"] = idx
+                        changed = True
+                if changed:
+                    try:
+                        self.cds.update_status(cd)
+                    except ApiConflict:
+                        continue
+            by_clique[self.clique_id] = idx
+            slice0 = next(
+                (cid for cid, si in by_clique.items() if si == 0), None
+            )
+            coord_ip = None
+            if slice0 is not None:
+                for n in nodes:
+                    if n.get("cliqueID") == slice0 and n.get("index", 0) == 0:
+                        coord_ip = n.get("ipAddress") or None
+            return idx, coord_ip
+        # Never alias onto slice 0 after exhausted retries — two slices
+        # sharing MEGASCALE_SLICE_ID misassembles the DCN job (same
+        # fail-loud rule as the worker-id bound in bootstrap.py).
+        raise MultisliceIdentityPending(
+            f"slice index for clique {self.clique_id} unresolved after "
+            f"repeated write conflicts"
+        )
 
     def peers(self) -> List[dict]:
         """Normalize CD.Status node entries to the clique daemon-entry shape
