@@ -42,7 +42,7 @@ class LlamaConfig:
     param_dtype: Any = jnp.bfloat16
     scan_layers: bool = True
     remat: bool = True
-    attention_impl: str = "auto"  # auto | pallas | xla | ring
+    attention_impl: str = "auto"  # auto | pallas | xla | ring | ulysses
 
     @property
     def head_dim(self) -> int:
@@ -126,7 +126,11 @@ class LlamaAttention(nn.Module):
         v = v.reshape(b, s, c.n_kv_heads, c.head_dim)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        if c.attention_impl == "ring":
+        if c.attention_impl == "ulysses":
+            from tpu_dra.workloads.parallel.ulysses import ulysses_attention
+
+            out = ulysses_attention(q, k, v)
+        elif c.attention_impl == "ring":
             from tpu_dra.workloads.parallel.ring_attention import (
                 ring_attention,
             )

@@ -7,9 +7,10 @@ instead of threading it through every model module.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Tuple
 
 from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
 
 _mesh: Optional[Mesh] = None
 
@@ -21,3 +22,18 @@ def set_global_mesh(mesh: Optional[Mesh]) -> None:
 
 def get_global_mesh() -> Optional[Mesh]:
     return _mesh
+
+
+def sequence_parallel_plan(
+    axis_name: str, mesh: Optional[Mesh] = None
+) -> Optional[Tuple[Mesh, P, Tuple[str, ...]]]:
+    """Shared preamble for the sequence-parallel attention impls (ring,
+    ulysses): resolve the active mesh and build the [batch, seq, head, dim]
+    partition spec. Returns None when no mesh is active or the axis is
+    trivial — the caller falls back to single-device attention."""
+    mesh = mesh or get_global_mesh()
+    if mesh is None or axis_name not in mesh.shape or mesh.shape[axis_name] == 1:
+        return None
+    batch_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.shape)
+    spec = P(batch_axes or None, axis_name, None, None)
+    return mesh, spec, batch_axes

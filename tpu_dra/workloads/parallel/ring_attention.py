@@ -17,15 +17,13 @@ load-balanced with a zig-zag chunk layout; kept simple for now.)
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax import shard_map
-from jax.sharding import PartitionSpec as P
 
 from tpu_dra.workloads.ops.attention import NEG_INF, _repeat_kv
-from tpu_dra.workloads.parallel.context import get_global_mesh
+from tpu_dra.workloads.parallel.context import sequence_parallel_plan
 
 AXIS = "sp"
 
@@ -104,18 +102,15 @@ def ring_attention(
     Falls back to single-device attention when no mesh is active or the
     ``sp`` axis is trivial.
     """
-    mesh = mesh or get_global_mesh()
-    n_rep = q.shape[2] // k.shape[2]
-    if mesh is None or axis_name not in mesh.shape or mesh.shape[axis_name] == 1:
+    plan = sequence_parallel_plan(axis_name, mesh)
+    if plan is None:
         from tpu_dra.workloads.ops.attention import attention
 
         return attention(q, k, v, causal=True)
+    mesh, spec, batch_axes = plan
+    n_rep = q.shape[2] // k.shape[2]
     k = _repeat_kv(k, n_rep)
     v = _repeat_kv(v, n_rep)
-    # Batch shards over whichever data axes this mesh actually has; the
-    # function works on any mesh carrying ``axis_name``.
-    batch_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.shape)
-    spec = P(batch_axes or None, axis_name, None, None)
     fn = shard_map(
         functools.partial(
             _ring_attention_local,

@@ -1,0 +1,76 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The complement of ring attention (ring_attention.py) on the long-context
+axis: instead of rotating K/V chunks around the ICI ring, one
+``lax.all_to_all`` re-shards the activations from sequence-sharded to
+head-sharded, full-sequence attention runs locally per head group (so the
+flash/pallas kernel applies unchanged), and a second all_to_all restores
+sequence sharding. Two collectives per layer of O(b*s*h*d/n) each, vs the
+ring's n ppermute steps — all_to_all wins when heads divide evenly and the
+interconnect handles the transpose well (TPU ICI does); the ring wins at
+very long sequences where even head-sharded full-sequence scores blow HBM.
+
+Constraint: n_heads (after GQA expansion) must be divisible by the ``sp``
+axis size.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from tpu_dra.workloads.ops.attention import _repeat_kv, attention
+from tpu_dra.workloads.parallel.context import sequence_parallel_plan
+
+AXIS = "sp"
+
+
+def _ulysses_local(q, k, v, *, axis_name: str):
+    """Per-device body: [b, s/n, h, hd] -> attention -> [b, s/n, h, hd]."""
+    # seq-sharded -> head-sharded: split heads (axis 2), gather seq (axis 1).
+    q = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    k = jax.lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    v = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    out = attention(q, k, v, causal=True)
+    # head-sharded -> seq-sharded.
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str = AXIS,
+    mesh=None,
+) -> jnp.ndarray:
+    """Causal attention with all-to-all sequence parallelism; q [b, s, h, hd]
+    with s sharded over ``sp``. Falls back to single-device attention when
+    no mesh is active or the axis is trivial."""
+    plan = sequence_parallel_plan(axis_name, mesh)
+    if plan is None:
+        return attention(q, k, v, causal=True)
+    mesh, spec, batch_axes = plan
+    n = mesh.shape[axis_name]
+    if q.shape[2] % n:
+        raise ValueError(
+            f"ulysses attention needs n_heads ({q.shape[2]}) divisible by "
+            f"the {axis_name} axis ({n})"
+        )
+    if k.shape[2] % n:
+        # KV heads don't split evenly: materialize the GQA repeat up front.
+        # Costs n_rep in collective volume — only the fallback.
+        n_rep = q.shape[2] // k.shape[2]
+        k = _repeat_kv(k, n_rep)
+        v = _repeat_kv(v, n_rep)
+    # else: exchange the un-repeated K/V (kvh/n heads per device) and let
+    # the local attention resolve GQA by logical head grouping — n_rep x
+    # less collective volume and no materialized repeat.
+    fn = shard_map(
+        functools.partial(_ulysses_local, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
