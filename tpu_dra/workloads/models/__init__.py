@@ -6,3 +6,18 @@ from tpu_dra.workloads.models.llama import (  # noqa: F401
     Llama,
     LlamaConfig,
 )
+from tpu_dra.workloads.models.mixtral import (  # noqa: F401
+    MIXTRAL_8X7B,
+    TINY_MIXTRAL,
+    Mixtral,
+    MixtralConfig,
+)
+
+
+def build_model(config):
+    """Model instance for a family config (LlamaConfig | MixtralConfig)."""
+    if isinstance(config, MixtralConfig):
+        return Mixtral(config)
+    if isinstance(config, LlamaConfig):
+        return Llama(config)
+    raise TypeError(f"unknown model config type: {type(config).__name__}")

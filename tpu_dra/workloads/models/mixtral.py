@@ -1,0 +1,276 @@
+"""Mixtral family (flax) — sparse-MoE workload with expert parallelism.
+
+The second flagship model family: Mixtral-8x7B-style sparse mixture of
+experts — Llama attention (GQA + RoPE) with the dense SwiGLU MLP replaced
+by a top-k routed expert layer.
+
+TPU-first choices (why this is NOT a torch-MoE translation):
+
+- **Static-shape capacity routing.** Token→expert assignment is expressed
+  as dense one-hot dispatch/combine tensors (Switch-Transformer style), so
+  every shape is static under jit: no gather/scatter with data-dependent
+  sizes, no sorting networks. Dropped tokens (over capacity) pass through
+  the residual, as in the reference MoE systems.
+- **Expert compute = one batched einsum per projection.** Expert weights
+  live in a single ``[E, d, f]`` array; the per-expert FFN is a 3D
+  ``einsum`` that XLA tiles straight onto the MXU — no Python loop over
+  experts, no ragged batching.
+- **Expert parallelism via sharding, not send/recv.** The expert dim is
+  sharded over the ``ep`` mesh axis (rules in parallel/mesh.py); XLA
+  lowers the dispatch/combine einsums to all-to-alls over ICI. pjit owns
+  the schedule — the model code never names a collective.
+- Router runs in fp32 (softmax numerics), experts in bf16 (MXU).
+- Load-balance auxiliary loss (`sown` under ``"aux_loss"``) keeps routing
+  uniform, per the Switch/Mixtral recipe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tpu_dra.workloads.models.llama import (
+    LlamaAttention,
+    LlamaConfig,
+    RMSNorm,
+    rope_frequencies,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig:
+    vocab_size: int = 32_000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14_336
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 0.01
+    rope_theta: float = 1_000_000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.bfloat16
+    scan_layers: bool = True
+    remat: bool = True
+    attention_impl: str = "auto"  # auto | pallas | xla | ring | ulysses
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def attention_config(self) -> LlamaConfig:
+        """The attention sub-module reuses the Llama implementation."""
+        return LlamaConfig(
+            vocab_size=self.vocab_size,
+            dim=self.dim,
+            n_layers=self.n_layers,
+            n_heads=self.n_heads,
+            n_kv_heads=self.n_kv_heads,
+            ffn_dim=self.ffn_dim,
+            rope_theta=self.rope_theta,
+            norm_eps=self.norm_eps,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            attention_impl=self.attention_impl,
+        )
+
+    def capacity(self, seq: int) -> int:
+        """Per-expert token-slot capacity for a length-``seq`` sequence."""
+        return max(
+            1,
+            int(math.ceil(self.top_k * seq * self.capacity_factor / self.n_experts)),
+        )
+
+
+MIXTRAL_8X7B = MixtralConfig()
+
+# Hardware-free test/dryrun config.
+TINY_MIXTRAL = MixtralConfig(
+    vocab_size=256,
+    dim=64,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    ffn_dim=128,
+    n_experts=4,
+    top_k=2,
+    rope_theta=10_000.0,
+    remat=False,
+)
+
+
+class MixtralMoE(nn.Module):
+    """Top-k routed SwiGLU expert layer with capacity-based dispatch."""
+
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        c = self.config
+        b, s, d = x.shape
+        cap = c.capacity(s)
+
+        # --- router (fp32) ---
+        router_logits = nn.Dense(
+            c.n_experts,
+            use_bias=False,
+            dtype=jnp.float32,
+            param_dtype=c.param_dtype,
+            kernel_init=nn.initializers.normal(0.02),
+            name="router",
+        )(x.astype(jnp.float32))  # [b, s, E]
+        probs = jax.nn.softmax(router_logits, axis=-1)
+        gate, idx = jax.lax.top_k(probs, c.top_k)  # [b, s, k]
+        gate = gate / jnp.maximum(jnp.sum(gate, axis=-1, keepdims=True), 1e-9)
+
+        # --- load-balance aux loss (Switch eq. 4): E * sum_e f_e * p_e ---
+        token_frac = jnp.mean(
+            jax.nn.one_hot(idx[..., 0], c.n_experts, dtype=jnp.float32),
+            axis=(0, 1),
+        )
+        prob_frac = jnp.mean(probs, axis=(0, 1))
+        aux = c.n_experts * jnp.sum(token_frac * prob_frac)
+        self.sow("aux_loss", "moe", c.router_aux_weight * aux)
+
+        # --- capacity assignment: position of each (token, slot) in its
+        # expert's buffer, computed with a cumsum over flattened slots so
+        # shapes stay static (dropped slots fall through the residual) ---
+        slot_mask = jax.nn.one_hot(idx, c.n_experts, dtype=jnp.float32)
+        # [b, s*k, E] in slot order (token-major: all of token 0's k slots
+        # first), matching Mixtral's priority of earlier tokens.
+        flat_mask = slot_mask.reshape(b, s * c.top_k, c.n_experts)
+        position = jnp.cumsum(flat_mask, axis=1) - 1.0  # [b, s*k, E]
+        keep = flat_mask * (position < cap)
+        dispatch = keep[..., None] * jax.nn.one_hot(
+            position.astype(jnp.int32), cap, dtype=jnp.float32
+        )  # [b, s*k, E, C]
+        flat_gate = gate.reshape(b, s * c.top_k)
+        combine = dispatch * flat_gate[..., None, None]  # [b, s*k, E, C]
+
+        # --- dispatch tokens to expert buffers: all-to-all over ep when
+        # the expert dim is sharded ---
+        x_slots = jnp.repeat(x, c.top_k, axis=1)  # [b, s*k, d]
+        xe = jnp.einsum(
+            "btec,btd->ebcd", dispatch.astype(c.dtype), x_slots
+        )  # [E, b, C, d]
+
+        # --- per-expert SwiGLU, batched over E on the MXU ---
+        init = nn.initializers.normal(0.02)
+        w_gate = self.param(
+            "experts_w_gate", init, (c.n_experts, d, c.ffn_dim), c.param_dtype
+        )
+        w_up = self.param(
+            "experts_w_up", init, (c.n_experts, d, c.ffn_dim), c.param_dtype
+        )
+        w_down = self.param(
+            "experts_w_down", init, (c.n_experts, c.ffn_dim, d), c.param_dtype
+        )
+        h = nn.silu(jnp.einsum("ebcd,edf->ebcf", xe, w_gate)) * jnp.einsum(
+            "ebcd,edf->ebcf", xe, w_up
+        )
+        ye = jnp.einsum("ebcf,efd->ebcd", h, w_down)  # [E, b, C, d]
+
+        # --- combine back to token order, weighted by the gates ---
+        y_slots = jnp.einsum("ebcd,btec->btd", ye, combine.astype(c.dtype))
+        y = y_slots.reshape(b, s, c.top_k, d).sum(axis=2)
+        return y.astype(x.dtype)
+
+
+class MixtralBlock(nn.Module):
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, cos, sin) -> jnp.ndarray:
+        c = self.config
+        attn_c = c.attention_config()
+        x = x + LlamaAttention(attn_c, name="attention")(
+            RMSNorm(c.norm_eps, c.param_dtype, name="attention_norm")(x), cos, sin
+        )
+        x = x + MixtralMoE(c, name="moe")(
+            RMSNorm(c.norm_eps, c.param_dtype, name="moe_norm")(x)
+        )
+        return x
+
+
+class _ScannedMixtralBlock(nn.Module):
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x, cos, sin):
+        return MixtralBlock(self.config, name="block")(x, cos, sin), None
+
+
+class Mixtral(nn.Module):
+    """tokens [b, s] int32 -> logits [b, s, vocab] (fp32)."""
+
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
+        c = self.config
+        x = nn.Embed(
+            c.vocab_size,
+            c.dim,
+            dtype=c.dtype,
+            param_dtype=c.param_dtype,
+            embedding_init=nn.initializers.normal(0.02),
+            name="embed",
+        )(tokens)
+        positions = jnp.arange(tokens.shape[1])
+        cos, sin = rope_frequencies(c.attention_config(), positions)
+
+        if c.scan_layers:
+            block = _ScannedMixtralBlock
+            if c.remat:
+                block = nn.remat(
+                    block,
+                    prevent_cse=False,
+                    policy=jax.checkpoint_policies.nothing_saveable,
+                )
+            x, _ = nn.scan(
+                block,
+                variable_axes={"params": 0, "aux_loss": 0},
+                split_rngs={"params": True},
+                length=c.n_layers,
+                in_axes=(nn.broadcast, nn.broadcast),
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(c, name="layers")(x, cos, sin)
+        else:
+            for i in range(c.n_layers):
+                blk = MixtralBlock(c, name=f"layer_{i}")
+                if c.remat:
+                    blk = nn.remat(blk)
+                x = blk(x, cos, sin)
+
+        x = RMSNorm(c.norm_eps, c.param_dtype, name="final_norm")(x)
+        logits = nn.Dense(
+            c.vocab_size,
+            use_bias=False,
+            dtype=c.dtype,
+            param_dtype=c.param_dtype,
+            kernel_init=nn.initializers.normal(0.02),
+            name="lm_head",
+        )(x)
+        return logits.astype(jnp.float32)
+
+    def init_params(self, rng, batch: int = 1, seq: int = 8):
+        tokens = jnp.zeros((batch, seq), dtype=jnp.int32)
+        return self.init(rng, tokens)["params"]
+
+    def apply_with_aux(self, params, tokens: jnp.ndarray):
+        """(logits, total aux loss) — aux collected across layers."""
+        logits, aux = self.apply(
+            {"params": params}, tokens, mutable=["aux_loss"]
+        )
+        total = sum(
+            jnp.sum(v) for v in jax.tree_util.tree_leaves(aux.get("aux_loss", {}))
+        )
+        return logits, total

@@ -4,15 +4,18 @@ TPU-first design: scale comes from ``jax.sharding.Mesh`` + NamedSharding
 with XLA-inserted collectives (psum / all-gather / reduce-scatter /
 ppermute over ICI) — never hand-written point-to-point sends. Axes:
 
+- ``pp``   — pipeline parallelism (layer stages; ppermute microbatch relay)
 - ``dp``   — pure data parallelism (replicated params; gradients psum)
 - ``fsdp`` — data parallelism with fully-sharded params (params/optimizer
   sharded over this axis; all-gathered per layer)
+- ``ep``   — expert parallelism (MoE expert dim; all-to-all dispatch)
 - ``sp``   — sequence/context parallelism (ring attention over ICI)
 - ``tp``   — tensor parallelism (heads / MLP hidden sharded)
 
 Layout matters: ``tp`` innermost so its collectives ride the
-fastest-varying ICI dimension; ``dp`` outermost so cross-slice (DCN)
-traffic is gradient-only (the scaling-book recipe).
+fastest-varying ICI dimension; ``pp``/``dp`` outermost so cross-slice
+(DCN) traffic is stage-boundary/gradient-only (the scaling-book recipe);
+``ep`` sits between — its all-to-alls stay on-slice ICI.
 """
 
 from __future__ import annotations
@@ -28,7 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 log = logging.getLogger(__name__)
 
-AXES = ("dp", "fsdp", "sp", "tp")
+AXES = ("pp", "dp", "fsdp", "ep", "sp", "tp")
 
 
 @dataclass(frozen=True)
@@ -37,10 +40,12 @@ class MeshConfig:
     fsdp: int = 1
     sp: int = 1
     tp: int = 1
+    ep: int = 1
+    pp: int = 1
 
     @property
     def size(self) -> int:
-        return self.dp * self.fsdp * self.sp * self.tp
+        return self.dp * self.fsdp * self.sp * self.tp * self.ep * self.pp
 
     @classmethod
     def for_device_count(cls, n: int) -> "MeshConfig":
@@ -69,7 +74,9 @@ def build_mesh(config: MeshConfig, devices: Optional[List] = None) -> Mesh:
             f"mesh config {config} needs {config.size} devices, have "
             f"{len(devices)}"
         )
-    arr = np.array(devices).reshape(config.dp, config.fsdp, config.sp, config.tp)
+    arr = np.array(devices).reshape(
+        config.pp, config.dp, config.fsdp, config.ep, config.sp, config.tp
+    )
     return Mesh(arr, AXES)
 
 
@@ -79,6 +86,11 @@ def build_mesh(config: MeshConfig, devices: Optional[List] = None) -> Mesh:
 # feature dims over fsdp and the parallel dims (heads, ffn hidden, vocab)
 # over tp. Biases/norms replicate.
 PARAM_RULES: List[Tuple[str, P]] = [
+    # MoE expert weights carry a leading expert dim sharded over ep
+    # (matched before the generic w_gate/w_up/w_down rules).
+    (r".*experts.*(w_gate|w_up)$", P("ep", "fsdp", "tp")),  # [E, d, ffn]
+    (r".*experts.*w_down$", P("ep", "tp", "fsdp")),  # [E, ffn, d]
+    (r".*router.*kernel$", P("fsdp", None)),  # [d, E]
     (r".*embed.*embedding$", P("tp", "fsdp")),  # [vocab, d]
     (r".*(wq|wk|wv).*kernel$", P("fsdp", "tp")),  # [d, heads*hd]
     (r".*wo.*kernel$", P("tp", "fsdp")),  # [heads*hd, d]
@@ -92,9 +104,10 @@ PARAM_RULES: List[Tuple[str, P]] = [
 def param_spec(path: str, value=None) -> P:
     for pattern, spec in PARAM_RULES:
         if re.fullmatch(pattern, path):
-            # Scanned layers carry a leading layer dimension; shift specs.
-            if value is not None and hasattr(value, "ndim") and value.ndim == len(spec) + 1:
-                return P(None, *spec)
+            # Scanned layers carry extra leading dims (layer stack, and/or
+            # pipeline stage); pad the spec with Nones to match rank.
+            if value is not None and hasattr(value, "ndim") and value.ndim > len(spec):
+                return P(*([None] * (value.ndim - len(spec))), *spec)
             return spec
     return P()
 

@@ -1,0 +1,191 @@
+"""Pipeline parallelism: GPipe-style microbatch relay over the ``pp`` axis.
+
+Reference framing: the reference driver's scale-out axis is the
+ComputeDomain (SURVEY.md §2.5); the workloads that run on DRA-allocated
+slices need every sharding family, and pipeline parallelism is the one
+that spans slices cheapest — only stage-boundary activations cross the
+``pp`` axis, so ``pp`` maps naturally onto DCN between ICI slices
+(mesh.py puts ``pp`` outermost for exactly this reason).
+
+TPU-first design:
+
+- **One program, jit-compiled**: the schedule is a ``lax.scan`` over
+  ``n_microbatches + pp - 1`` ticks inside a ``shard_map`` over ``pp`` —
+  no per-stage processes, no host-side orchestration, fully
+  differentiable (the backward pass is the mirrored pipeline XLA derives
+  from the scan/ppermute transpose).
+- **Stage hand-off = ``lax.ppermute``**: a single collective-permute per
+  tick rides the ICI/DCN ring; no send/recv programming model.
+- **Static shapes**: bubble ticks run the stage on zeros (the standard
+  GPipe trade) so every tick is the same XLA program.
+
+``stage_fn`` must preserve the shape/dtype of its input block (true for
+transformer layer stacks).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def partition_stages(layer_params: Any, n_stages: int) -> Any:
+    """Reshape a scanned-layer param tree ``[L, ...]`` into stage-major
+    ``[n_stages, L/n_stages, ...]`` (leading dim shardable over ``pp``)."""
+
+    def reshape(a):
+        if a.shape[0] % n_stages:
+            raise ValueError(
+                f"layer count {a.shape[0]} not divisible by {n_stages} stages"
+            )
+        return a.reshape(n_stages, a.shape[0] // n_stages, *a.shape[1:])
+
+    return jax.tree_util.tree_map(reshape, layer_params)
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params: Any,
+    x: jnp.ndarray,
+    *extra: Any,
+    mesh: Mesh,
+    axis: str = "pp",
+    n_microbatches: int,
+) -> jnp.ndarray:
+    """Run ``x`` through ``pp`` pipelined stages of ``stage_fn``.
+
+    - ``stage_params``: pytree with leading ``[pp, ...]`` stage dim (see
+      :func:`partition_stages`); sharded over ``axis``.
+    - ``x``: ``[batch, ...]`` input; split into ``n_microbatches`` along
+      batch. ``batch % n_microbatches == 0``.
+    - ``extra``: stage-invariant side inputs (e.g. RoPE tables),
+      replicated.
+
+    Returns ``stage_fn`` applied by every stage in sequence, microbatch-
+    pipelined: tick ``t`` has stage ``i`` working microbatch ``t - i``
+    while ``lax.ppermute`` relays activations around the stage ring.
+    """
+    pp = mesh.shape[axis]
+    batch = x.shape[0]
+    if batch % n_microbatches:
+        raise ValueError(
+            f"batch {batch} not divisible by {n_microbatches} microbatches"
+        )
+    mb = batch // n_microbatches
+    xs = x.reshape(n_microbatches, mb, *x.shape[1:])
+
+    params_specs = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    n_steps = n_microbatches + pp - 1
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(params_specs, P(None)) + tuple(P(None) for _ in extra),
+        out_specs=P(None),
+        check_vma=False,
+    )
+    def run(sp, xs, *extra):
+        # Each shard holds one stage: squeeze the local stage dim.
+        sp = jax.tree_util.tree_map(lambda a: a[0], sp)
+        idx = lax.axis_index(axis)
+
+        def body(carry, t):
+            state, outs = carry
+            # Stage 0 feeds microbatch t (zeros in the drain bubble);
+            # later stages consume the relayed activation.
+            x_t = lax.dynamic_index_in_dim(
+                xs, jnp.clip(t, 0, n_microbatches - 1), 0, keepdims=False
+            )
+            fed = jnp.where(t < n_microbatches, x_t, jnp.zeros_like(x_t))
+            inp = jnp.where(idx == 0, fed, state)
+            y = stage_fn(sp, inp, *extra)
+            # The last stage finishes microbatch t-(pp-1) at tick t.
+            out_t = t - (pp - 1)
+            slot = jnp.clip(out_t, 0, n_microbatches - 1)
+            cur = lax.dynamic_index_in_dim(outs, slot, 0, keepdims=False)
+            done = (idx == pp - 1) & (out_t >= 0) & (out_t < n_microbatches)
+            outs = lax.dynamic_update_index_in_dim(
+                outs, jnp.where(done, y, cur), slot, 0
+            )
+            state = lax.ppermute(y, axis, perm)
+            return (state, outs), None
+
+        init = (jnp.zeros_like(xs[0]), jnp.zeros_like(xs))
+        (_, outs), _ = lax.scan(body, init, jnp.arange(n_steps))
+        # Only the last stage holds real outputs; broadcast to all.
+        return lax.psum(
+            jnp.where(idx == pp - 1, outs, jnp.zeros_like(outs)), axis
+        )
+
+    out = run(stage_params, xs, *extra)
+    return out.reshape(batch, *x.shape[1:])
+
+
+def pipelined_llama_forward(
+    config,
+    params,
+    tokens: jnp.ndarray,
+    *,
+    mesh: Mesh,
+    axis: str = "pp",
+    n_microbatches: int,
+) -> jnp.ndarray:
+    """Llama forward with the decoder stack pipelined over ``axis``.
+
+    Numerically identical to ``Llama(config).apply`` (same modules, same
+    order); requires ``config.scan_layers`` (the stacked ``[L, ...]``
+    layer params are re-cut into ``pp`` stages) and
+    ``config.n_layers % pp == 0``.
+    """
+    import flax.linen as nn
+
+    from tpu_dra.workloads.models.llama import (
+        LlamaBlock,
+        RMSNorm,
+        rope_frequencies,
+    )
+
+    c = config
+    if not c.scan_layers:
+        raise ValueError("pipelined forward needs scan_layers=True")
+    pp = mesh.shape[axis]
+
+    x = nn.Embed(
+        c.vocab_size, c.dim, dtype=c.dtype, param_dtype=c.param_dtype
+    ).apply({"params": params["embed"]}, tokens)
+    cos, sin = rope_frequencies(c, jnp.arange(tokens.shape[1]))
+
+    stage_params = partition_stages(params["layers"]["block"], pp)
+
+    def stage_fn(sp, x, cos, sin):
+        def body(x, layer_params):
+            y = LlamaBlock(c).apply({"params": layer_params}, x, cos, sin)
+            return y, None
+
+        x, _ = lax.scan(body, x, sp)
+        return x
+
+    x = pipeline_apply(
+        stage_fn,
+        stage_params,
+        x,
+        cos,
+        sin,
+        mesh=mesh,
+        axis=axis,
+        n_microbatches=n_microbatches,
+    )
+
+    x = RMSNorm(c.norm_eps, c.param_dtype).apply(
+        {"params": params["final_norm"]}, x
+    )
+    logits = nn.Dense(
+        c.vocab_size, use_bias=False, dtype=c.dtype, param_dtype=c.param_dtype
+    ).apply({"params": params["lm_head"]}, x)
+    return logits.astype(jnp.float32)

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpu_dra.workloads.models.llama import Llama, LlamaConfig
+from tpu_dra.workloads.models import build_model
 from tpu_dra.workloads.parallel.context import set_global_mesh
 from tpu_dra.workloads.parallel.mesh import (
     MeshConfig,
@@ -55,14 +55,18 @@ def make_optimizer(config: TrainConfig) -> optax.GradientTransformation:
     )
 
 
-def loss_fn(model: Llama, params, tokens: jnp.ndarray) -> jnp.ndarray:
-    """Next-token cross entropy over [b, s] int tokens."""
-    logits = model.apply({"params": params}, tokens)  # [b, s, v] fp32
+def loss_fn(model, params, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Next-token cross entropy over [b, s] int tokens (+ MoE aux loss)."""
+    aux = 0.0
+    if hasattr(model, "apply_with_aux"):
+        logits, aux = model.apply_with_aux(params, tokens)
+    else:
+        logits = model.apply({"params": params}, tokens)  # [b, s, v] fp32
     targets = tokens[:, 1:]
     logits = logits[:, :-1]
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    return -jnp.mean(ll) + aux
 
 
 class Trainer:
@@ -70,13 +74,13 @@ class Trainer:
 
     def __init__(
         self,
-        model_config: LlamaConfig,
+        model_config,
         mesh_config: Optional[MeshConfig] = None,
         train_config: TrainConfig = TrainConfig(),
         devices=None,
     ):
         self.model_config = model_config
-        self.model = Llama(model_config)
+        self.model = build_model(model_config)
         devices = devices if devices is not None else jax.devices()
         self.mesh_config = mesh_config or MeshConfig.for_device_count(
             len(devices)
@@ -168,6 +172,8 @@ class Trainer:
 MODEL_PRESETS = {
     "llama3-8b": "LLAMA3_8B",
     "tiny": "TINY_LLAMA",
+    "mixtral-8x7b": "MIXTRAL_8X7B",
+    "tiny-moe": "TINY_MIXTRAL",
 }
 
 
@@ -175,7 +181,7 @@ def main(argv=None) -> int:
     import argparse
     import time
 
-    from tpu_dra.workloads.models import llama as llama_mod
+    from tpu_dra.workloads import models as models_mod
 
     p = argparse.ArgumentParser("tpu-dra-train")
     p.add_argument("--model", choices=sorted(MODEL_PRESETS), default="tiny")
@@ -209,7 +215,7 @@ def main(argv=None) -> int:
         slice_env = initialize_from_env()
         log.info("slice bootstrap: %s", slice_env)
 
-    model_config = getattr(llama_mod, MODEL_PRESETS[args.model])
+    model_config = getattr(models_mod, MODEL_PRESETS[args.model])
     trainer = Trainer(model_config)
     dp_shards = (
         trainer.mesh.shape.get("dp", 1) * trainer.mesh.shape.get("fsdp", 1)
