@@ -1,0 +1,231 @@
+// tpu-cdi-hook: native OCI createContainer hook for TPU claim devices.
+//
+// Reference analog: the nvidia-cdi-hook binary the GPU plugin copies into
+// its plugin directory at startup (cmd/gpu-kubelet-plugin/main.go:277-304)
+// and references from generated CDI specs. The TPU build owns its hook:
+//
+//   tpu-cdi-hook create-symlinks --link <target>::<linkpath> ...
+//       stable in-container names for granted chips, e.g.
+//       /dev/tpu0 -> /dev/accel2 (claims grant arbitrary host minors; the
+//       workload sees a dense, zero-based namespace).
+//   tpu-cdi-hook chmod --mode <octal> --path <p> ...
+//       permission fixup of injected device nodes (the analog of the
+//       reference's IMEX-channel chmod edits).
+//   tpu-cdi-hook update-ldcache [--folder <f>]...
+//       registers libtpu folders in the container's ld cache.
+//
+// The container rootfs is resolved the OCI way: the runtime pipes the
+// container state JSON on stdin; its "bundle" dir holds config.json whose
+// root.path is the rootfs (absolute, or relative to the bundle). An
+// explicit --container-rootfs flag overrides (useful under test).
+//
+// No JSON dependency: the two fields we need are extracted with a
+// quote-aware scanner that understands string escapes.
+
+#include <cctype>
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+std::string ReadAll(FILE* f) {
+  std::string out;
+  char buf[4096];
+  size_t n;
+  while ((n = fread(buf, 1, sizeof(buf), f)) > 0) out.append(buf, n);
+  return out;
+}
+
+std::string ReadFileStr(const std::string& path) {
+  FILE* f = fopen(path.c_str(), "r");
+  if (!f) return "";
+  std::string out = ReadAll(f);
+  fclose(f);
+  return out;
+}
+
+// Extract the string value following `"key"` (then optional whitespace, a
+// colon, whitespace and a quoted string). Starts scanning at `from`;
+// returns "" when absent. Handles backslash escapes inside the value.
+std::string JsonStringAfter(const std::string& body, const std::string& key,
+                            size_t from = 0) {
+  std::string needle = "\"" + key + "\"";
+  size_t pos = body.find(needle, from);
+  if (pos == std::string::npos) return "";
+  pos += needle.size();
+  while (pos < body.size() && (isspace((unsigned char)body[pos]))) pos++;
+  if (pos >= body.size() || body[pos] != ':') return "";
+  pos++;
+  while (pos < body.size() && (isspace((unsigned char)body[pos]))) pos++;
+  if (pos >= body.size() || body[pos] != '"') return "";
+  pos++;
+  std::string out;
+  while (pos < body.size() && body[pos] != '"') {
+    if (body[pos] == '\\' && pos + 1 < body.size()) pos++;
+    out += body[pos++];
+  }
+  return out;
+}
+
+// rootfs := config.json root.path, resolved relative to the bundle dir.
+std::string RootfsFromState(const std::string& state_json) {
+  std::string bundle = JsonStringAfter(state_json, "bundle");
+  if (bundle.empty()) return "";
+  std::string config = ReadFileStr(bundle + "/config.json");
+  if (config.empty()) return "";
+  size_t root_pos = config.find("\"root\"");
+  if (root_pos == std::string::npos) return "";
+  std::string path = JsonStringAfter(config, "path", root_pos);
+  if (path.empty()) return "";
+  if (path[0] == '/') return path;
+  return bundle + "/" + path;
+}
+
+int MkdirParents(const std::string& path) {
+  // Create every parent of `path` (not path itself).
+  for (size_t i = 1; i < path.size(); i++) {
+    if (path[i] != '/') continue;
+    std::string dir = path.substr(0, i);
+    if (mkdir(dir.c_str(), 0755) != 0 && errno != EEXIST) return -1;
+  }
+  return 0;
+}
+
+struct Args {
+  std::string rootfs;
+  std::vector<std::string> links;    // target::linkpath
+  std::vector<std::string> paths;    // chmod targets
+  std::vector<std::string> folders;  // ldcache folders
+  std::string mode;
+};
+
+Args Parse(int argc, char** argv, int start) {
+  Args a;
+  for (int i = start; i < argc; i++) {
+    std::string flag = argv[i];
+    auto next = [&]() -> const char* {
+      if (i + 1 >= argc) {
+        fprintf(stderr, "tpu-cdi-hook: missing value for %s\n", flag.c_str());
+        exit(2);
+      }
+      return argv[++i];
+    };
+    if (flag == "--container-rootfs") a.rootfs = next();
+    else if (flag == "--link") a.links.push_back(next());
+    else if (flag == "--path") a.paths.push_back(next());
+    else if (flag == "--folder") a.folders.push_back(next());
+    else if (flag == "--mode") a.mode = next();
+    else {
+      fprintf(stderr, "tpu-cdi-hook: unknown flag %s\n", flag.c_str());
+      exit(2);
+    }
+  }
+  if (a.rootfs.empty()) a.rootfs = RootfsFromState(ReadAll(stdin));
+  if (a.rootfs.empty()) {
+    fprintf(stderr, "tpu-cdi-hook: cannot resolve container rootfs\n");
+    exit(1);
+  }
+  return a;
+}
+
+int CreateSymlinks(const Args& a) {
+  for (const std::string& spec : a.links) {
+    size_t sep = spec.find("::");
+    if (sep == std::string::npos) {
+      fprintf(stderr, "tpu-cdi-hook: bad --link %s (want target::linkpath)\n",
+              spec.c_str());
+      return 1;
+    }
+    std::string target = spec.substr(0, sep);
+    std::string link = a.rootfs + spec.substr(sep + 2);
+    if (MkdirParents(link) != 0) {
+      perror("tpu-cdi-hook: mkdir");
+      return 1;
+    }
+    unlink(link.c_str());  // replace a stale link from a reused sandbox
+    if (symlink(target.c_str(), link.c_str()) != 0) {
+      fprintf(stderr, "tpu-cdi-hook: symlink %s -> %s: %s\n", link.c_str(),
+              target.c_str(), strerror(errno));
+      return 1;
+    }
+  }
+  return 0;
+}
+
+int Chmod(const Args& a) {
+  if (a.mode.empty() || a.mode.size() > 4 ||
+      a.mode.find_first_not_of("01234567") != std::string::npos) {
+    fprintf(stderr, "tpu-cdi-hook: chmod requires --mode <octal>, got %s\n",
+            a.mode.empty() ? "(none)" : a.mode.c_str());
+    return 2;
+  }
+  mode_t mode = (mode_t)strtol(a.mode.c_str(), nullptr, 8);
+  for (const std::string& p : a.paths) {
+    std::string full = a.rootfs + p;
+    if (chmod(full.c_str(), mode) != 0) {
+      fprintf(stderr, "tpu-cdi-hook: chmod %s: %s\n", full.c_str(),
+              strerror(errno));
+      return 1;
+    }
+  }
+  return 0;
+}
+
+int UpdateLdcache(const Args& a) {
+  // Register folders in the container's linker config, then best-effort
+  // rebuild its cache (ldconfig -r <rootfs>). A missing/failing ldconfig
+  // is not fatal: the conf drop-in alone serves images that run ldconfig
+  // themselves, and hook failure would block container start.
+  std::string confdir = a.rootfs + "/etc/ld.so.conf.d";
+  std::string conf = confdir + "/000-tpu-dra.conf";
+  if (MkdirParents(conf) != 0) {
+    perror("tpu-cdi-hook: mkdir");
+    return 1;
+  }
+  FILE* f = fopen(conf.c_str(), "w");
+  if (!f) {
+    perror("tpu-cdi-hook: open ld.so.conf.d drop-in");
+    return 1;
+  }
+  for (const std::string& d : a.folders) fprintf(f, "%s\n", d.c_str());
+  fclose(f);
+  pid_t pid = fork();
+  if (pid == 0) {
+    execlp("ldconfig", "ldconfig", "-r", a.rootfs.c_str(), (char*)nullptr);
+    _exit(127);
+  }
+  if (pid > 0) {
+    int status = 0;
+    waitpid(pid, &status, 0);
+    if (!WIFEXITED(status) || WEXITSTATUS(status) != 0)
+      fprintf(stderr, "tpu-cdi-hook: ldconfig -r failed (ignored)\n");
+  }
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr,
+            "usage: tpu-cdi-hook {create-symlinks|chmod|update-ldcache} "
+            "[--container-rootfs R] [--link T::L]... [--mode M --path P]... "
+            "[--folder F]...\n");
+    return 2;
+  }
+  std::string cmd = argv[1];
+  Args a = Parse(argc, argv, 2);
+  if (cmd == "create-symlinks") return CreateSymlinks(a);
+  if (cmd == "chmod") return Chmod(a);
+  if (cmd == "update-ldcache") return UpdateLdcache(a);
+  fprintf(stderr, "tpu-cdi-hook: unknown command %s\n", cmd.c_str());
+  return 2;
+}

@@ -25,9 +25,12 @@ jax.config.update("jax_num_cpu_devices", 8)
 
 import pytest  # noqa: E402
 
-# Build the native library once per checkout (it is not committed).
+# Build the native artifacts once per checkout (they are not committed).
 _repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-if not os.path.exists(os.path.join(_repo, "native", "build", "libtputopo.so")):
+if not all(
+    os.path.exists(os.path.join(_repo, "native", "build", n))
+    for n in ("libtputopo.so", "tpu-cdi-hook")
+):
     import subprocess
 
     subprocess.run(

@@ -1,0 +1,216 @@
+"""CDI handler + native tpu-cdi-hook tests.
+
+Covers the per-claim transient spec contract (cdi.go analog), hook staging
+(setNvidiaCDIHookPath analog, main.go:277-304), and the built hook binary
+end-to-end against a fake OCI bundle.
+"""
+
+import json
+import os
+import stat
+import subprocess
+
+import pytest
+
+from tpu_dra.plugin.cdi import (
+    CDI_KIND,
+    CDIHandler,
+    install_cdi_hook,
+)
+from tpu_dra.plugin.prepared import (
+    KubeletDevice,
+    PreparedDevice,
+    PreparedDeviceGroup,
+    PreparedDevices,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HOOK_BIN = os.path.join(REPO, "native", "build", "tpu-cdi-hook")
+
+
+def make_prepared(dev_paths_by_name, env=None):
+    group = PreparedDeviceGroup()
+    for name, paths in dev_paths_by_name.items():
+        pd = PreparedDevice(
+            type="tpu",
+            device=KubeletDevice(
+                requests=["r0"], pool_name="n1", device_name=name,
+                cdi_device_ids=[f"{CDI_KIND}=uid-{name}"],
+            ),
+            runtime_env=dict(env or {}),
+        )
+        pd.dev_paths = list(paths)
+        group.devices.append(pd)
+    return PreparedDevices([group])
+
+
+class TestCDIHandler:
+    def test_spec_roundtrip_and_listing(self, tmp_path):
+        h = CDIHandler(cdi_root=str(tmp_path), driver_version="v1")
+        prepared = make_prepared({"tpu-0": ["/dev/accel0"]},
+                                 env={"TPU_VISIBLE_DEVICES": "0"})
+        path = h.create_claim_spec_file("uid1", prepared)
+        assert os.path.exists(path)
+        spec = h.read_claim_spec("uid1")
+        assert spec["kind"] == CDI_KIND
+        assert spec["devices"][0]["name"] == "uid1-tpu-0"
+        edits = spec["devices"][0]["containerEdits"]
+        assert {"path": "/dev/accel0"} in edits["deviceNodes"]
+        assert "TPU_VISIBLE_DEVICES=0" in edits["env"]
+        assert h.list_claim_uids() == ["uid1"]
+        h.delete_claim_spec_file("uid1")
+        assert h.read_claim_spec("uid1") is None
+        h.delete_claim_spec_file("uid1")  # idempotent
+
+    def test_no_hooks_without_hook_path(self, tmp_path):
+        h = CDIHandler(cdi_root=str(tmp_path), driver_version="v1")
+        h.create_claim_spec_file("u", make_prepared({"d": ["/dev/accel0"]}))
+        spec = h.read_claim_spec("u")
+        assert "hooks" not in spec["devices"][0]["containerEdits"]
+
+    def test_symlink_hooks_are_per_device_and_name_keyed(self, tmp_path):
+        # Hooks must live on each device, not the spec: a container
+        # referencing only one request of a multi-request claim must not
+        # receive sibling devices' aliases. Aliases are keyed by the
+        # node-unique device name so hooks from SEVERAL claims landing on
+        # one container can never fight over a link path (per-claim
+        # zero-based numbering would collide).
+        h = CDIHandler(cdi_root=str(tmp_path), driver_version="v1",
+                       hook_path="/plugin/tpu-cdi-hook")
+        prepared = make_prepared(
+            {"tpu-2": ["/dev/accel2"], "tpu-5": ["/dev/accel5"]})
+        h.create_claim_spec_file("u", prepared)
+        spec = h.read_claim_spec("u")
+        assert "hooks" not in spec["containerEdits"]
+        per_dev = {}
+        for dev in spec["devices"]:
+            hooks = dev["containerEdits"]["hooks"]
+            assert len(hooks) == 1
+            assert hooks[0]["hookName"] == "createContainer"
+            assert hooks[0]["path"] == "/plugin/tpu-cdi-hook"
+            args = hooks[0]["args"]
+            assert args[:2] == ["tpu-cdi-hook", "create-symlinks"]
+            per_dev[dev["name"]] = [args[i + 1] for i in range(2, len(args), 2)]
+        assert per_dev == {
+            "u-tpu-2": ["/dev/accel2::/dev/tpu/tpu-2"],
+            "u-tpu-5": ["/dev/accel5::/dev/tpu/tpu-5"],
+        }
+
+    def test_multi_chip_device_aliases_are_indexed(self, tmp_path):
+        h = CDIHandler(cdi_root=str(tmp_path), driver_version="v1",
+                       hook_path="/plugin/tpu-cdi-hook")
+        prepared = make_prepared({"ss-2x2": ["/dev/accel4", "/dev/accel5"]})
+        h.create_claim_spec_file("u", prepared)
+        spec = h.read_claim_spec("u")
+        args = spec["devices"][0]["containerEdits"]["hooks"][0]["args"]
+        links = [args[i + 1] for i in range(2, len(args), 2)]
+        assert links == [
+            "/dev/accel4::/dev/tpu/ss-2x2-0",
+            "/dev/accel5::/dev/tpu/ss-2x2-1",
+        ]
+
+    def test_vfio_devices_get_no_symlink_hook(self, tmp_path):
+        h = CDIHandler(cdi_root=str(tmp_path), driver_version="v1",
+                       hook_path="/plugin/tpu-cdi-hook")
+        prepared = make_prepared({"pt": ["/dev/vfio/vfio", "/dev/vfio/12"]})
+        h.create_claim_spec_file("u", prepared)
+        spec = h.read_claim_spec("u")
+        assert "hooks" not in spec["devices"][0]["containerEdits"]
+
+
+class TestInstallCDIHook:
+    def test_missing_source_disables_hooks(self, tmp_path):
+        assert install_cdi_hook("", str(tmp_path)) is None
+        assert install_cdi_hook(str(tmp_path / "nope"), str(tmp_path)) is None
+
+    def test_copies_and_marks_executable(self, tmp_path):
+        src = tmp_path / "src-hook"
+        src.write_bytes(b"#!/bin/sh\nexit 0\n")
+        dest_dir = tmp_path / "plugin"
+        installed = install_cdi_hook(str(src), str(dest_dir))
+        assert installed == str(dest_dir / "tpu-cdi-hook")
+        st = os.stat(installed)
+        assert st.st_mode & stat.S_IXUSR
+        # Re-install (image update) replaces atomically.
+        src.write_bytes(b"#!/bin/sh\nexit 1\n")
+        assert install_cdi_hook(str(src), str(dest_dir)) == installed
+        assert open(installed).read().endswith("exit 1\n")
+
+
+@pytest.mark.skipif(not os.path.exists(HOOK_BIN), reason="hook not built")
+class TestHookBinary:
+    def bundle(self, tmp_path):
+        rootfs = tmp_path / "bundle" / "rootfs"
+        (rootfs / "dev").mkdir(parents=True)
+        (tmp_path / "bundle" / "config.json").write_text(
+            json.dumps({"root": {"path": "rootfs"}})
+        )
+        state = json.dumps({"ociVersion": "1.0.2", "id": "c1",
+                            "bundle": str(tmp_path / "bundle")})
+        return rootfs, state
+
+    def run_hook(self, args, state=""):
+        return subprocess.run([HOOK_BIN] + args, input=state.encode(),
+                              capture_output=True)
+
+    def test_create_symlinks_via_oci_state(self, tmp_path):
+        rootfs, state = self.bundle(tmp_path)
+        r = self.run_hook(
+            ["create-symlinks", "--link", "/dev/accel2::/dev/tpu0",
+             "--link", "/dev/accel3::/dev/tpu1"], state)
+        assert r.returncode == 0, r.stderr
+        assert os.readlink(rootfs / "dev" / "tpu0") == "/dev/accel2"
+        assert os.readlink(rootfs / "dev" / "tpu1") == "/dev/accel3"
+        # Re-running (restarted container, reused sandbox) replaces links.
+        r = self.run_hook(
+            ["create-symlinks", "--link", "/dev/accel7::/dev/tpu0"], state)
+        assert r.returncode == 0, r.stderr
+        assert os.readlink(rootfs / "dev" / "tpu0") == "/dev/accel7"
+
+    def test_symlink_creates_parent_dirs(self, tmp_path):
+        rootfs, state = self.bundle(tmp_path)
+        r = self.run_hook(
+            ["create-symlinks", "--link", "/x::/var/run/tpu/link"], state)
+        assert r.returncode == 0, r.stderr
+        assert os.readlink(rootfs / "var" / "run" / "tpu" / "link") == "/x"
+
+    def test_chmod(self, tmp_path):
+        rootfs, state = self.bundle(tmp_path)
+        node = rootfs / "dev" / "accel0"
+        node.write_bytes(b"")
+        node.chmod(0o600)
+        r = self.run_hook(
+            ["chmod", "--mode", "0666", "--path", "/dev/accel0",
+             "--container-rootfs", str(rootfs)])
+        assert r.returncode == 0, r.stderr
+        assert stat.S_IMODE(os.stat(node).st_mode) == 0o666
+
+    def test_update_ldcache_writes_conf(self, tmp_path):
+        rootfs, state = self.bundle(tmp_path)
+        r = self.run_hook(
+            ["update-ldcache", "--folder", "/usr/lib/tpu", "--folder",
+             "/opt/libtpu", "--container-rootfs", str(rootfs)])
+        assert r.returncode == 0, r.stderr
+        conf = rootfs / "etc" / "ld.so.conf.d" / "000-tpu-dra.conf"
+        assert conf.read_text() == "/usr/lib/tpu\n/opt/libtpu\n"
+
+    def test_unresolvable_rootfs_fails_loud(self, tmp_path):
+        r = self.run_hook(["create-symlinks", "--link", "/a::/b"], "{}")
+        assert r.returncode == 1
+        assert b"rootfs" in r.stderr
+
+    def test_bad_link_spec_fails(self, tmp_path):
+        rootfs, state = self.bundle(tmp_path)
+        r = self.run_hook(
+            ["create-symlinks", "--link", "no-separator",
+             "--container-rootfs", str(rootfs)])
+        assert r.returncode == 1
+
+    def test_chmod_rejects_malformed_mode(self, tmp_path):
+        rootfs, state = self.bundle(tmp_path)
+        (rootfs / "dev" / "accel0").write_bytes(b"")
+        r = self.run_hook(
+            ["chmod", "--mode", "rw", "--path", "/dev/accel0",
+             "--container-rootfs", str(rootfs)])
+        assert r.returncode == 2
+        assert b"octal" in r.stderr

@@ -36,6 +36,10 @@ def make_driver(tmp_path, backend=None, start_grpc=False, **cfg):
         state_dir=str(tmp_path / "tpustate"),
     )
     backend = backend or FakeCluster()
+    # Hooks off by default so specs keep the same shape wherever the suite
+    # runs (the driver image ships /usr/local/bin/tpu-cdi-hook, dev hosts
+    # don't); hook wiring is covered explicitly in test_cdi.py.
+    cfg.setdefault("cdi_hook_source", "")
     config = DriverConfig(
         node_name="node-0",
         cdi_root=str(tmp_path / "cdi"),

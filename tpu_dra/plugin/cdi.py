@@ -5,10 +5,17 @@ claim (vendor ``k8s.tpu.google.com``, class ``claim``, :43-48) written to
 /var/run/cdi (:194-306); the kubelet passes the resulting CDI device IDs
 back to the runtime via PrepareResult.Devices.
 
-TPU content differences: instead of /dev/nvidia* + nvidia-cdi-hook, a
-claim's container edits inject the chip /dev/accel* (or /dev/vfio/*) nodes
-plus the libtpu bootstrap env (TPU_VISIBLE_DEVICES and friends) and any
-sharing-daemon sockets.
+TPU content differences: a claim's container edits inject the chip
+/dev/accel* (or /dev/vfio/*) nodes plus the libtpu bootstrap env
+(TPU_VISIBLE_DEVICES and friends) and any sharing-daemon sockets. The
+``nvidia-cdi-hook`` analog is our native ``tpu-cdi-hook`` binary
+(native/tpucdihook.cc): when installed, each device's edits add a
+createContainer hook aliasing its (arbitrary-minor) accel nodes as
+``/dev/tpu/<device-name>[-j]``. Like the reference's by-path GPU names,
+aliases are *unique and stable* rather than dense: device names are
+node-unique and overlap-defended, so hooks from any number of claims can
+land on one container without colliding — which per-claim zero-based
+numbering could not guarantee.
 """
 
 from __future__ import annotations
@@ -16,6 +23,9 @@ from __future__ import annotations
 import json
 import logging
 import os
+import re
+import shutil
+import stat
 from typing import Dict, List, Optional
 
 from tpu_dra.plugin.allocatable import AllocatableDevice, VFIO_DEVICE_TYPE
@@ -28,9 +38,37 @@ CDI_VENDOR = "k8s.tpu.google.com"
 CDI_CLASS = "claim"
 CDI_KIND = f"{CDI_VENDOR}/{CDI_CLASS}"
 
+CDI_HOOK_NAME = "tpu-cdi-hook"
+# Only accel chip nodes get the dense /dev/tpu<k> aliases; vfio nodes are
+# consumed by VMMs that address the group node directly.
+_ACCEL_RE = re.compile(r"^/dev/accel\d+$")
+
+
+def install_cdi_hook(source: str, dest_dir: str) -> Optional[str]:
+    """Copy the hook binary into the plugin dir and return its installed
+    path (setNvidiaCDIHookPath analog, main.go:277-304): generated specs
+    must reference a path that outlives driver-image replacement, so the
+    hook is staged onto the host under the plugin data dir. Returns None
+    (hooks disabled) when the source binary isn't shipped — the stub/demo
+    path."""
+    if not source or not os.path.isfile(source):
+        return None
+    os.makedirs(dest_dir, exist_ok=True)
+    dest = os.path.join(dest_dir, CDI_HOOK_NAME)
+    tmp = dest + ".tmp"
+    shutil.copyfile(source, tmp)
+    os.chmod(tmp, os.stat(tmp).st_mode | stat.S_IXUSR | stat.S_IXGRP | stat.S_IXOTH)
+    os.replace(tmp, dest)
+    return dest
+
 
 class CDIHandler:
-    def __init__(self, cdi_root: str = "/var/run/cdi", driver_version: str = ""):
+    def __init__(
+        self,
+        cdi_root: str = "/var/run/cdi",
+        driver_version: str = "",
+        hook_path: Optional[str] = None,
+    ):
         self.cdi_root = cdi_root
         os.makedirs(cdi_root, exist_ok=True)
         if not driver_version:
@@ -38,6 +76,7 @@ class CDIHandler:
 
             driver_version = version_string()
         self.driver_version = driver_version
+        self.hook_path = hook_path
 
     # --- naming conventions (cdi.go GetClaimDeviceName) ---
 
@@ -61,7 +100,11 @@ class CDIHandler:
 
         Each prepared device becomes one CDI device whose edits carry its
         device nodes + merged env (device runtime env, then group-level
-        sharing edits which may override)."""
+        sharing edits which may override) + its symlink hook. Hooks are
+        per-device — CDI applies spec-level edits to any container that
+        receives ANY device of the spec, which would leak sibling devices'
+        aliases into containers referencing only one request of a
+        multi-request claim."""
         devices = []
         for group in prepared:
             group_env = dict(group.config_state.container_edits.get("env", {}))
@@ -76,6 +119,28 @@ class CDIHandler:
                     edits["env"] = [f"{k}={v}" for k, v in sorted(env.items())]
                 if group_mounts:
                     edits["mounts"] = group_mounts
+                accel = [p for p in pd.dev_paths if _ACCEL_RE.match(p)]
+                if self.hook_path and accel:
+                    # Aliases keyed by the node-unique device name: a chip
+                    # belongs to at most one prepared device (overlap
+                    # defense), so hooks from several claims never fight
+                    # over a link path.
+                    dev_name = pd.device.device_name
+                    links = []
+                    for j, p in enumerate(accel):
+                        alias = (
+                            f"/dev/tpu/{dev_name}"
+                            if len(accel) == 1
+                            else f"/dev/tpu/{dev_name}-{j}"
+                        )
+                        links += ["--link", f"{p}::{alias}"]
+                    edits["hooks"] = [
+                        {
+                            "hookName": "createContainer",
+                            "path": self.hook_path,
+                            "args": [CDI_HOOK_NAME, "create-symlinks"] + links,
+                        }
+                    ]
                 devices.append(
                     {
                         "name": self.claim_device_name(

@@ -18,7 +18,7 @@ from tpu_dra.infra.flock import Flock
 from tpu_dra.infra.metrics import Metrics
 from tpu_dra.k8sclient import RESOURCE_SLICES, ResourceClient
 from tpu_dra.plugin.allocatable import AllocatableDevice
-from tpu_dra.plugin.cdi import CDIHandler
+from tpu_dra.plugin.cdi import CDIHandler, install_cdi_hook
 from tpu_dra.plugin.checkpoint import CheckpointManager
 from tpu_dra.plugin.cleanup import CheckpointCleanupManager
 from tpu_dra.plugin.device_health import DeviceHealthMonitor
@@ -57,6 +57,9 @@ class DriverConfig:
     resource_api_version: str = "v1beta1"
     multiplex_image: str = "tpu-dra-driver:latest"
     start_grpc: bool = True
+    # Shipped hook binary staged into plugin_data_dir at startup
+    # (setNvidiaCDIHookPath analog); "" or missing file disables hooks.
+    cdi_hook_source: str = "/usr/local/bin/tpu-cdi-hook"
 
 
 class Driver:
@@ -70,7 +73,12 @@ class Driver:
         self.backend = backend
         self.config = config
         self.metrics = Metrics()
-        self.cdi = CDIHandler(cdi_root=config.cdi_root)
+        hook_path = install_cdi_hook(
+            config.cdi_hook_source, config.plugin_data_dir
+        )
+        if hook_path:
+            log.info("installed CDI hook at %s", hook_path)
+        self.cdi = CDIHandler(cdi_root=config.cdi_root, hook_path=hook_path)
         self.checkpoints = CheckpointManager(config.plugin_data_dir)
         self.pu_flock = Flock(f"{config.plugin_data_dir}/pu.lock")
         multiplex = MultiplexManager(

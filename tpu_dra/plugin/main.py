@@ -58,6 +58,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--health-port", type=int, default=flags.env_default("HEALTH_PORT", 0, int)
     )
+    p.add_argument(
+        "--cdi-hook",
+        default=flags.env_default("TPU_DRA_CDI_HOOK", "/usr/local/bin/tpu-cdi-hook"),
+        help="Shipped tpu-cdi-hook binary to stage into the plugin dir",
+    )
     return p
 
 
@@ -83,6 +88,7 @@ def main(argv=None) -> int:
         plugin_data_dir=args.plugin_data_dir,
         kubelet_registrar_dir=args.kubelet_registrar_dir,
         resource_api_version=args.resource_api_version,
+        cdi_hook_source=args.cdi_hook,
     )
     driver = Driver(tpulib, backend, config)
     driver.start()
