@@ -89,14 +89,76 @@ std::string RootfsFromState(const std::string& state_json) {
   return bundle + "/" + path;
 }
 
-int MkdirParents(const std::string& path) {
-  // Create every parent of `path` (not path itself).
-  for (size_t i = 1; i < path.size(); i++) {
-    if (path[i] != '/') continue;
-    std::string dir = path.substr(0, i);
-    if (mkdir(dir.c_str(), 0755) != 0 && errno != EEXIST) return -1;
+std::vector<std::string> SplitPath(const std::string& p) {
+  std::vector<std::string> out;
+  std::string cur;
+  for (char c : p) {
+    if (c == '/') {
+      if (!cur.empty()) out.push_back(cur);
+      cur.clear();
+    } else {
+      cur += c;
+    }
   }
-  return 0;
+  if (!cur.empty()) out.push_back(cur);
+  return out;
+}
+
+// Resolve `rel` under `root` without ever escaping it (securejoin-style,
+// the same defense the reference's CDI hook uses): the rootfs content is
+// image-controlled, so a symlink component like /etc -> /host-etc must be
+// re-anchored at the container root, never followed onto the host. Walks
+// component by component with lstat; symlink targets are spliced back into
+// the remaining components (absolute targets restart at root); ".." pops
+// within the resolved prefix and cannot climb above root. With
+// `create_dirs`, missing intermediate components are mkdir'd. Returns ""
+// on a symlink-budget blowout or I/O error.
+std::string SafeResolve(const std::string& root, const std::string& rel,
+                        bool create_dirs, bool resolve_last = true) {
+  std::vector<std::string> parts = SplitPath(rel);
+  std::vector<std::string> done;
+  int budget = 64;
+  while (!parts.empty()) {
+    std::string c = parts.front();
+    parts.erase(parts.begin());
+    if (c == ".") continue;
+    if (c == "..") {
+      if (!done.empty()) done.pop_back();
+      continue;
+    }
+    std::string cur = root;
+    for (const auto& d : done) cur += "/" + d;
+    cur += "/" + c;
+    struct stat st;
+    if (lstat(cur.c_str(), &st) != 0) {
+      if (errno != ENOENT) return "";
+      bool is_last = parts.empty();
+      if (create_dirs && !is_last) {
+        if (mkdir(cur.c_str(), 0755) != 0 && errno != EEXIST) return "";
+      }
+      done.push_back(c);
+      continue;
+    }
+    if (S_ISLNK(st.st_mode)) {
+      if (parts.empty() && !resolve_last) {
+        done.push_back(c);
+        continue;
+      }
+      if (--budget < 0) return "";
+      char buf[4096];
+      ssize_t n = readlink(cur.c_str(), buf, sizeof(buf) - 1);
+      if (n <= 0) return "";
+      buf[n] = '\0';
+      std::vector<std::string> tparts = SplitPath(buf);
+      parts.insert(parts.begin(), tparts.begin(), tparts.end());
+      if (buf[0] == '/') done.clear();
+      continue;
+    }
+    done.push_back(c);
+  }
+  std::string out = root;
+  for (const auto& d : done) out += "/" + d;
+  return out;
 }
 
 struct Args {
@@ -145,9 +207,14 @@ int CreateSymlinks(const Args& a) {
       return 1;
     }
     std::string target = spec.substr(0, sep);
-    std::string link = a.rootfs + spec.substr(sep + 2);
-    if (MkdirParents(link) != 0) {
-      perror("tpu-cdi-hook: mkdir");
+    // The link *target* is a container-internal name stored verbatim; the
+    // link *path* is resolved symlink-safely (creating parents) so image
+    // content cannot steer the root-privileged write outside the rootfs.
+    std::string link = SafeResolve(a.rootfs, spec.substr(sep + 2),
+                                   /*create_dirs=*/true,
+                                   /*resolve_last=*/false);
+    if (link.empty()) {
+      fprintf(stderr, "tpu-cdi-hook: unsafe link path %s\n", spec.c_str());
       return 1;
     }
     unlink(link.c_str());  // replace a stale link from a reused sandbox
@@ -169,7 +236,11 @@ int Chmod(const Args& a) {
   }
   mode_t mode = (mode_t)strtol(a.mode.c_str(), nullptr, 8);
   for (const std::string& p : a.paths) {
-    std::string full = a.rootfs + p;
+    std::string full = SafeResolve(a.rootfs, p, /*create_dirs=*/false);
+    if (full.empty()) {
+      fprintf(stderr, "tpu-cdi-hook: unsafe chmod path %s\n", p.c_str());
+      return 1;
+    }
     if (chmod(full.c_str(), mode) != 0) {
       fprintf(stderr, "tpu-cdi-hook: chmod %s: %s\n", full.c_str(),
               strerror(errno));
@@ -184,10 +255,10 @@ int UpdateLdcache(const Args& a) {
   // rebuild its cache (ldconfig -r <rootfs>). A missing/failing ldconfig
   // is not fatal: the conf drop-in alone serves images that run ldconfig
   // themselves, and hook failure would block container start.
-  std::string confdir = a.rootfs + "/etc/ld.so.conf.d";
-  std::string conf = confdir + "/000-tpu-dra.conf";
-  if (MkdirParents(conf) != 0) {
-    perror("tpu-cdi-hook: mkdir");
+  std::string conf = SafeResolve(a.rootfs, "/etc/ld.so.conf.d/000-tpu-dra.conf",
+                                 /*create_dirs=*/true, /*resolve_last=*/false);
+  if (conf.empty()) {
+    fprintf(stderr, "tpu-cdi-hook: unsafe ld.so.conf.d path\n");
     return 1;
   }
   FILE* f = fopen(conf.c_str(), "w");
@@ -207,6 +278,8 @@ int UpdateLdcache(const Args& a) {
     waitpid(pid, &status, 0);
     if (!WIFEXITED(status) || WEXITSTATUS(status) != 0)
       fprintf(stderr, "tpu-cdi-hook: ldconfig -r failed (ignored)\n");
+  } else {
+    fprintf(stderr, "tpu-cdi-hook: fork for ldconfig failed (ignored)\n");
   }
   return 0;
 }

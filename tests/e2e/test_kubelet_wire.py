@@ -1,0 +1,210 @@
+"""Wire-level e2e: drive the real plugin entrypoint the way the kubelet does.
+
+The reference can only exercise this surface against a live cluster with
+GPUs (SURVEY.md §4.3); here the actual ``tpu_dra.plugin.main`` process is
+launched with the stub backend + seeded fake cluster and spoken to over its
+unix-socket gRPC servers: the plugin-registration handshake, then
+NodePrepareResources / NodeUnprepareResources with real protos. This covers
+CLI parsing, driver bootstrap, both gRPC servers, claim fetch + uid check,
+the full prepare path, CDI spec files on disk, and clean SIGTERM shutdown —
+all across a process boundary.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import uuid
+
+import grpc
+import pytest
+import yaml
+
+from tpu_dra.plugin.dra_service import DRA_SERVICE_NAME, REGISTRATION_SERVICE_NAME
+from tpu_dra.plugin.device_state import DRIVER_NAME
+from tpu_dra.plugin.pb import dra_v1beta1_pb2 as drapb
+from tpu_dra.plugin.pb import pluginregistration_pb2 as regpb
+
+CLAIM_UID = str(uuid.uuid4())
+NODE = "node-e2e"
+
+
+@pytest.fixture(scope="module")
+def plugin_proc(tmp_path_factory):
+    td = tmp_path_factory.mktemp("wire")
+    seed = td / "seed"
+    seed.mkdir()
+    (td / "stub.yaml").write_text(
+        yaml.safe_dump({"generation": "v5e", "hostname": NODE, "chips": 4})
+    )
+    claim = {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {
+            "name": "wire-claim",
+            "namespace": "default",
+            "uid": CLAIM_UID,
+        },
+        "status": {
+            "allocation": {
+                "devices": {
+                    "results": [
+                        {
+                            "request": "r0",
+                            "driver": DRIVER_NAME,
+                            "pool": NODE,
+                            "device": "tpu-0",
+                        },
+                        {
+                            "request": "r0",
+                            "driver": DRIVER_NAME,
+                            "pool": NODE,
+                            "device": "tpu-1",
+                        },
+                    ],
+                    "config": [],
+                }
+            }
+        },
+    }
+    (seed / "claim.json").write_text(json.dumps(claim))
+    plugin_dir = td / "plugin"
+    reg_dir = td / "registry"
+    cdi_dir = td / "cdi"
+    env = dict(os.environ)
+    env["TPU_DRA_STUB_CONFIG"] = str(td / "stub.yaml")
+    env.pop("TPU_DRA_CDI_HOOK", None)
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "tpu_dra.plugin.main",
+            "--backend", "stub",
+            "--fake-cluster",
+            "--fake-cluster-seed", str(seed),
+            "--node-name", NODE,
+            "--cdi-root", str(cdi_dir),
+            "--plugin-data-dir", str(plugin_dir),
+            "--kubelet-registrar-dir", str(reg_dir),
+            "--cdi-hook", "",
+            "-v", "4",
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+    reg_sock = reg_dir / f"{DRIVER_NAME}-reg.sock"
+    dra_sock = plugin_dir / "dra.sock"
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if reg_sock.exists() and dra_sock.exists():
+            break
+        if proc.poll() is not None:
+            out = proc.stdout.read().decode()
+            raise RuntimeError(f"plugin died at startup:\n{out}")
+        time.sleep(0.1)
+    else:
+        proc.kill()
+        raise RuntimeError("plugin sockets never appeared")
+    yield {
+        "proc": proc,
+        "reg_sock": reg_sock,
+        "dra_sock": dra_sock,
+        "cdi_dir": cdi_dir,
+    }
+    if proc.poll() is None:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+def _rpc(sock, service, method, request, response_cls, timeout=10):
+    with grpc.insecure_channel(f"unix://{sock}") as ch:
+        fn = ch.unary_unary(
+            f"/{service}/{method}",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=response_cls.FromString,
+        )
+        return fn(request, timeout=timeout)
+
+
+def test_registration_handshake(plugin_proc):
+    info = _rpc(
+        plugin_proc["reg_sock"], REGISTRATION_SERVICE_NAME, "GetInfo",
+        regpb.InfoRequest(), regpb.PluginInfo,
+    )
+    assert info.name == DRIVER_NAME
+    assert info.type == "DRAPlugin"
+    assert info.endpoint == str(plugin_proc["dra_sock"])
+    assert "v1beta1" in info.supported_versions
+    _rpc(
+        plugin_proc["reg_sock"], REGISTRATION_SERVICE_NAME,
+        "NotifyRegistrationStatus",
+        regpb.RegistrationStatus(plugin_registered=True),
+        regpb.RegistrationStatusResponse,
+    )
+
+
+def _prepare(plugin_proc):
+    req = drapb.NodePrepareResourcesRequest()
+    req.claims.append(
+        drapb.Claim(uid=CLAIM_UID, name="wire-claim", namespace="default")
+    )
+    return _rpc(
+        plugin_proc["dra_sock"], DRA_SERVICE_NAME, "NodePrepareResources",
+        req, drapb.NodePrepareResourcesResponse,
+    )
+
+
+def test_prepare_over_the_wire(plugin_proc):
+    resp = _prepare(plugin_proc)
+    result = resp.claims[CLAIM_UID]
+    assert not result.error
+    assert sorted(d.device_name for d in result.devices) == ["tpu-0", "tpu-1"]
+    ids = [i for d in result.devices for i in d.cdi_device_ids]
+    assert all(i.startswith("k8s.tpu.google.com/claim=") for i in ids)
+    spec_files = list(plugin_proc["cdi_dir"].glob("*.json"))
+    assert len(spec_files) == 1
+    spec = json.loads(spec_files[0].read_text())
+    envs = [e for d in spec["devices"] for e in d["containerEdits"]["env"]]
+    assert "TPU_VISIBLE_DEVICES=0,1" in envs
+
+    # Idempotent second prepare returns the same devices (checkpoint hit).
+    resp2 = _prepare(plugin_proc)
+    assert sorted(
+        d.device_name for d in resp2.claims[CLAIM_UID].devices
+    ) == ["tpu-0", "tpu-1"]
+
+
+def test_prepare_unknown_claim_errors_without_failing_batch(plugin_proc):
+    req = drapb.NodePrepareResourcesRequest()
+    req.claims.append(
+        drapb.Claim(uid="no-such-uid", name="ghost", namespace="default")
+    )
+    req.claims.append(
+        drapb.Claim(uid=CLAIM_UID, name="wire-claim", namespace="default")
+    )
+    resp = _rpc(
+        plugin_proc["dra_sock"], DRA_SERVICE_NAME, "NodePrepareResources",
+        req, drapb.NodePrepareResourcesResponse,
+    )
+    assert resp.claims["no-such-uid"].error
+    assert not resp.claims[CLAIM_UID].error
+
+
+def test_unprepare_over_the_wire(plugin_proc):
+    req = drapb.NodeUnprepareResourcesRequest()
+    req.claims.append(
+        drapb.Claim(uid=CLAIM_UID, name="wire-claim", namespace="default")
+    )
+    resp = _rpc(
+        plugin_proc["dra_sock"], DRA_SERVICE_NAME, "NodeUnprepareResources",
+        req, drapb.NodeUnprepareResourcesResponse,
+    )
+    assert not resp.claims[CLAIM_UID].error
+    assert list(plugin_proc["cdi_dir"].glob("*.json")) == []
+
+
+def test_sigterm_clean_shutdown(plugin_proc):
+    proc = plugin_proc["proc"]
+    proc.send_signal(signal.SIGTERM)
+    assert proc.wait(timeout=15) == 0

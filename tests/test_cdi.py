@@ -214,3 +214,44 @@ class TestHookBinary:
              "--container-rootfs", str(rootfs)])
         assert r.returncode == 2
         assert b"octal" in r.stderr
+
+    def test_symlink_escape_via_image_symlink_is_reanchored(self, tmp_path):
+        # An image-controlled symlink component (dev -> /tmp outside the
+        # rootfs) must be re-anchored at the container root, never followed
+        # onto the host (securejoin semantics).
+        rootfs, state = self.bundle(tmp_path)
+        outside = tmp_path / "outside"
+        outside.mkdir()
+        (rootfs / "evil").symlink_to(str(outside))
+        r = self.run_hook(
+            ["create-symlinks", "--link", "/dev/accel0::/evil/pwn",
+             "--container-rootfs", str(rootfs)])
+        assert r.returncode == 0, r.stderr
+        # The write landed under the rootfs (at the re-anchored target of
+        # the absolute link), not in the host directory.
+        assert not (outside / "pwn").exists()
+
+    def test_chmod_refuses_to_follow_dotdot_escape(self, tmp_path):
+        rootfs, state = self.bundle(tmp_path)
+        victim = tmp_path / "victim"
+        victim.write_bytes(b"")
+        victim.chmod(0o600)
+        r = self.run_hook(
+            ["chmod", "--mode", "0666", "--path", "/../victim",
+             "--container-rootfs", str(rootfs)])
+        # ".." cannot climb above the rootfs: the resolved path is
+        # <rootfs>/victim, which doesn't exist.
+        assert r.returncode == 1
+        assert stat.S_IMODE(os.stat(victim).st_mode) == 0o600
+
+    def test_update_ldcache_conf_symlink_not_followed_to_host(self, tmp_path):
+        rootfs, state = self.bundle(tmp_path)
+        outside = tmp_path / "host-etc"
+        outside.mkdir()
+        (rootfs / "etc").mkdir()
+        (rootfs / "etc" / "ld.so.conf.d").symlink_to(str(outside))
+        r = self.run_hook(
+            ["update-ldcache", "--folder", "/usr/lib/tpu",
+             "--container-rootfs", str(rootfs)])
+        assert r.returncode == 0, r.stderr
+        assert not (outside / "000-tpu-dra.conf").exists()

@@ -239,3 +239,49 @@ def test_informer_relist_emits_deletes(cds, fc):
     assert inf.get("doomed", "default") is None
     assert "doomed" in deletes
     inf.stop()
+
+
+def test_load_dir_seeds_manifests(tmp_path):
+    import json
+
+    from tpu_dra.k8sclient import COMPUTE_DOMAINS, RESOURCE_CLAIMS, FakeCluster
+
+    (tmp_path / "claim.json").write_text(json.dumps({
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {"name": "c1", "namespace": "ns1", "uid": "pinned-uid"},
+        "status": {"allocation": {"devices": {"results": []}}},
+    }))
+    (tmp_path / "cds.yaml").write_text(
+        "apiVersion: resource.tpu.google.com/v1beta1\n"
+        "kind: ComputeDomain\n"
+        "metadata: {name: cd1, namespace: ns1}\n"
+        "spec: {numNodes: 2}\n"
+        "---\n"
+        "apiVersion: resource.tpu.google.com/v1beta1\n"
+        "kind: ComputeDomain\n"
+        "metadata: {name: cd2, namespace: ns1}\n"
+        "spec: {numNodes: 4}\n"
+    )
+    fc = FakeCluster()
+    assert fc.load_dir(str(tmp_path)) == 3
+    claim = fc.get(RESOURCE_CLAIMS, "ns1", "c1")
+    # Pinned uid and status survive seeding (the wire e2e depends on both).
+    assert claim["metadata"]["uid"] == "pinned-uid"
+    assert claim["status"]["allocation"] == {"devices": {"results": []}}
+    assert len(fc.list(COMPUTE_DOMAINS, "ns1")) == 2
+
+
+def test_load_dir_rejects_unknown_kind(tmp_path):
+    import json
+
+    import pytest as _pytest
+
+    from tpu_dra.k8sclient import FakeCluster
+    from tpu_dra.k8sclient.resources import K8sApiError
+
+    (tmp_path / "x.json").write_text(json.dumps(
+        {"apiVersion": "v1", "kind": "Martian", "metadata": {"name": "m"}}
+    ))
+    with _pytest.raises(K8sApiError, match="unknown resource"):
+        FakeCluster().load_dir(str(tmp_path))

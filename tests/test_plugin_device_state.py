@@ -131,6 +131,34 @@ def test_prepare_multi_chip_claim(tmp_path):
         "tpu-2",
         "tpu-3",
     ]
+    # Chips of one request are consumed by one container: every device must
+    # carry the union env (CDI concatenates env last-one-wins; diverging
+    # TPU_VISIBLE_DEVICES values would hide all chips but one).
+    cp = state.checkpoints.get().prepared_claims[claim["metadata"]["uid"]]
+    for group in cp.prepared_devices:
+        for pd in group.devices:
+            assert pd.runtime_env["TPU_VISIBLE_DEVICES"] == "0,1,2,3"
+            assert pd.runtime_env["TPU_ACCELERATOR_TYPE"] == "v5e-4"
+
+
+def test_prepare_distinct_requests_keep_per_chip_env(tmp_path):
+    # Distinct requests go to distinct containers; each keeps its own
+    # single-chip env.
+    state, _ = make_state(tmp_path)
+    claim = make_claim(["tpu-0"], request="r0")
+    claim["status"]["allocation"]["devices"]["results"].append(
+        {"request": "r1", "driver": DRIVER_NAME, "pool": "node-0",
+         "device": "tpu-1"}
+    )
+    state.prepare(claim)
+    cp = state.checkpoints.get().prepared_claims[claim["metadata"]["uid"]]
+    envs = {
+        pd.device.device_name: pd.runtime_env
+        for g in cp.prepared_devices
+        for pd in g.devices
+    }
+    assert envs["tpu-0"]["TPU_VISIBLE_DEVICES"] == "0"
+    assert envs["tpu-1"]["TPU_VISIBLE_DEVICES"] == "1"
 
 
 def test_unallocated_claim_rejected(tmp_path):
@@ -504,3 +532,55 @@ def test_checkpoint_legacy_flat_migration(tmp_path):
     assert "v1" in top and "v2" in top
     cp2 = cpm.get()
     assert "legacy-uid" in cp2.prepared_claims
+
+
+def test_multi_subslice_per_request_rejected(tmp_path):
+    gates(DynamicSubslice=True)
+    state, _ = make_state(tmp_path)
+    names = [
+        n for n, d in state.allocatable.items() if d.type == "subslice-dynamic"
+    ]
+    # Two non-overlapping 1x2 sub-slices (overlap defense would fire first
+    # otherwise); the per-request rejection must win over any env merge.
+    names = [n for n in names if "1x2" in n][:2]
+    assert len(names) == 2, "stub should advertise several sub-slice shapes"
+    claim = make_claim(names)
+    with pytest.raises(PermanentError, match="larger sub-slice shape"):
+        state.prepare(claim)
+
+
+class _FakeVfioManager:
+    def configure(self, chip):
+        pass
+
+    def unconfigure(self, chip):
+        pass
+
+    def container_edits(self, chip):
+        return {
+            "devPaths": ["/dev/vfio/vfio"],
+            "env": {"TPU_VFIO_PCI_ADDRESS": chip.pci_bus_id},
+        }
+
+
+def test_multi_vfio_per_request_merges_pci_addresses(tmp_path):
+    gates(PassthroughSupport=True)
+    state, _ = make_state(tmp_path, vfio_manager=_FakeVfioManager())
+    names = [n for n, d in state.allocatable.items() if d.type == "vfio"][:2]
+    assert len(names) == 2
+    claim = make_claim(
+        names,
+        configs=[opaque({
+            "apiVersion": "resource.tpu.google.com/v1beta1",
+            "kind": "VfioDeviceConfig",
+        })],
+    )
+    state.prepare(claim)
+    cp = state.checkpoints.get().prepared_claims[claim["metadata"]["uid"]]
+    addrs = {
+        pd.runtime_env["TPU_VFIO_PCI_ADDRESS"]
+        for g in cp.prepared_devices
+        for pd in g.devices
+    }
+    assert len(addrs) == 1  # identical merged list on every device
+    assert addrs.pop().count(",") == 1

@@ -42,6 +42,11 @@ def main(argv=None) -> int:
         default=flags.env_default("TPU_DRA_FAKE_CLUSTER", False, bool),
     )
     p.add_argument(
+        "--fake-cluster-seed",
+        default=flags.env_default("TPU_DRA_FAKE_CLUSTER_SEED", ""),
+        help="Directory of manifests to pre-create in the fake cluster",
+    )
+    p.add_argument(
         "--health-port", type=int, default=flags.env_default("HEALTH_PORT", 0, int)
     )
     args = p.parse_args(argv)
@@ -54,6 +59,9 @@ def main(argv=None) -> int:
         from tpu_dra.k8sclient import FakeCluster
 
         backend = FakeCluster()
+        if args.fake_cluster_seed:
+            n = backend.load_dir(args.fake_cluster_seed)
+            log.info("seeded fake cluster with %d objects", n)
     else:
         backend = flags.KubeClientConfig.from_args(args).new_client()
 

@@ -80,6 +80,57 @@ class FakeCluster(Backend):
         self._lock = threading.RLock()
         self._watches: List[_Watch] = []
 
+    # --- seeding (subprocess e2e / demo path) ---
+
+    def load_dir(self, path: str) -> int:
+        """Seed the cluster from a directory of JSON/YAML manifests
+        (multi-doc YAML and k8s List kinds supported). The fake is
+        in-memory and per-process, so components started as separate OS
+        processes (the wire-level e2e harness, the kind demo's stub mode)
+        need their initial objects injected at startup; returns the number
+        of objects created. Pinned ``metadata.uid`` and ``status`` survive
+        (unlike a real apiserver) — the e2e harness depends on both."""
+        import glob
+        import json as _json
+        import os as _os
+
+        import yaml as _yaml
+
+        from tpu_dra.k8sclient import resources as _res
+
+        by_gvk = {}
+        for v in vars(_res).values():
+            if isinstance(v, ResourceDescriptor):
+                by_gvk[(v.api_version, v.kind)] = v
+        n = 0
+        files = sorted(
+            glob.glob(_os.path.join(path, "*.yaml"))
+            + glob.glob(_os.path.join(path, "*.yml"))
+            + glob.glob(_os.path.join(path, "*.json"))
+        )
+        for f in files:
+            with open(f) as fh:
+                docs = (
+                    [_json.load(fh)]
+                    if f.endswith(".json")
+                    else list(_yaml.safe_load_all(fh))
+                )
+            for doc in docs:
+                if not doc:
+                    continue
+                is_list = doc.get("kind", "").endswith("List")
+                items = (doc.get("items") or []) if is_list else [doc]
+                for obj in items:
+                    rd = by_gvk.get((obj.get("apiVersion"), obj.get("kind")))
+                    if rd is None:
+                        raise K8sApiError(
+                            f"{f}: unknown resource "
+                            f"{obj.get('apiVersion')}/{obj.get('kind')}"
+                        )
+                    self.create(rd, obj, preserve_uid=True)
+                    n += 1
+        return n
+
     # --- helpers ---
 
     def _key(self, rd: ResourceDescriptor, namespace: Optional[str], name: str) -> Key:
@@ -134,7 +185,7 @@ class FakeCluster(Backend):
                 return False
         return True
 
-    def create(self, rd, obj) -> dict:
+    def create(self, rd, obj, preserve_uid: bool = False) -> dict:
         obj = copy.deepcopy(obj)
         md = obj.setdefault("metadata", {})
         name = md.get("name")
@@ -151,7 +202,11 @@ class FakeCluster(Backend):
         with self._lock:
             if key in self._objs:
                 raise ApiConflict(f"{rd.plural} {ns}/{name} already exists")
-            md["uid"] = str(uuidlib.uuid4())
+            # Like a real apiserver, create assigns the uid — except for
+            # seeded manifests (load_dir), whose pinned uids the wire e2e
+            # depends on; regular callers must not resurrect stale uids.
+            if not (preserve_uid and md.get("uid")):
+                md["uid"] = str(uuidlib.uuid4())
             md["resourceVersion"] = self._next_rv()
             md["creationTimestamp"] = _now()
             md.setdefault("generation", 1)

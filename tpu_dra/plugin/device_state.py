@@ -450,8 +450,66 @@ class DeviceState:
                 group.devices.append(
                     self._prepare_one(claim, result, config_state)
                 )
+            self._reconcile_request_env(group)
             prepared.append(group)
         return prepared
+
+    # Env keys owned by the request-level merge: cleared before the merged
+    # values land so no device keeps a stale per-chip value (CDI env
+    # resolution is last-one-wins across all injected devices).
+    _REQUEST_ENV_KEYS = (
+        "TPU_VISIBLE_DEVICES",
+        "TPU_ACCELERATOR_TYPE",
+        "TPU_SLICE_ID",
+        "TPU_WORKER_ID",
+    )
+
+    def _reconcile_request_env(self, group: PreparedDeviceGroup) -> None:
+        """Devices granted under one request are injected into one
+        container together, and CDI concatenates every injected device's
+        env with last-one-wins on duplicates — diverging per-device values
+        would silently hide all devices but one. Per type:
+
+        - chips: rewrite every device of the request with the union env
+          (all indices, request-wide accelerator type);
+        - sub-slices: >1 per request is rejected loudly — a process runs
+          one contiguous ICI process-bounds, two disjoint sub-slices can't
+          be addressed by one libtpu process (request a larger shape);
+        - vfio: merge TPU_VFIO_PCI_ADDRESS into a comma-joined list (a VMM
+          can take several passthrough functions)."""
+        by_request: Dict[str, List[PreparedDevice]] = {}
+        for pd in group.devices:
+            for r in pd.device.requests:
+                by_request.setdefault(r, []).append(pd)
+        for req, pds in by_request.items():
+            if len(pds) < 2:
+                continue
+            types = {pd.type for pd in pds}
+            if types & {SUBSLICE_STATIC_DEVICE_TYPE, SUBSLICE_DYNAMIC_DEVICE_TYPE}:
+                raise PermanentError(
+                    f"request {req!r} grants {len(pds)} sub-slice devices; "
+                    "a container can address only one contiguous sub-slice "
+                    "— request a larger sub-slice shape instead"
+                )
+            if types == {VFIO_DEVICE_TYPE}:
+                addrs = ",".join(
+                    sorted(
+                        pd.runtime_env.get("TPU_VFIO_PCI_ADDRESS", "")
+                        for pd in pds
+                    )
+                )
+                for pd in pds:
+                    pd.runtime_env["TPU_VFIO_PCI_ADDRESS"] = addrs
+                continue
+            if types == {TPU_DEVICE_TYPE}:
+                chips = [
+                    self.allocatable[pd.device.device_name].chip for pd in pds
+                ]
+                merged = self._chip_runtime_env(chips)
+                for pd in pds:
+                    for k in self._REQUEST_ENV_KEYS:
+                        pd.runtime_env.pop(k, None)
+                    pd.runtime_env.update(merged)
 
     @staticmethod
     def _config_matches_type(cfg, device: AllocatableDevice) -> bool:

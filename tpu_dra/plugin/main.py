@@ -56,6 +56,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="Use the in-memory fake API server (demo/e2e without a cluster)",
     )
     p.add_argument(
+        "--fake-cluster-seed",
+        default=flags.env_default("TPU_DRA_FAKE_CLUSTER_SEED", ""),
+        help="Directory of manifests to pre-create in the fake cluster",
+    )
+    p.add_argument(
         "--health-port", type=int, default=flags.env_default("HEALTH_PORT", 0, int)
     )
     p.add_argument(
@@ -78,6 +83,9 @@ def main(argv=None) -> int:
         from tpu_dra.k8sclient import FakeCluster
 
         backend = FakeCluster()
+        if args.fake_cluster_seed:
+            n = backend.load_dir(args.fake_cluster_seed)
+            log.info("seeded fake cluster with %d objects", n)
     else:
         backend = flags.KubeClientConfig.from_args(args).new_client()
 
