@@ -261,6 +261,9 @@ int UpdateLdcache(const Args& a) {
     fprintf(stderr, "tpu-cdi-hook: unsafe ld.so.conf.d path\n");
     return 1;
   }
+  // The conf path itself may be an image-shipped symlink; fopen would
+  // follow it out of the rootfs. Replace it with a regular file.
+  unlink(conf.c_str());
   FILE* f = fopen(conf.c_str(), "w");
   if (!f) {
     perror("tpu-cdi-hook: open ld.so.conf.d drop-in");

@@ -1,0 +1,353 @@
+"""Multi-process full-stack e2e over a shared fake apiserver.
+
+The reference's multi-node story requires a real GPU cluster
+(SURVEY.md §4.3); this suite runs the WHOLE driver as separate OS
+processes — fake apiserver, compute-domain controller, two slice daemons
+("nodes" of one ICI slice), the CD kubelet plugin, and the TPU kubelet
+plugin — wired together only through HTTP and unix-socket gRPC, exactly
+as in a cluster:
+
+  apply CD → controller stamps DaemonSet + claim templates → daemons
+  register into the clique and render the JAX bootstrap → CD goes Ready →
+  a workload channel claim prepared over the CD plugin's real gRPC socket
+  returns the bootstrap env → the TPU plugin publishes ResourceSlices to
+  the shared server and prepares a chip claim.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import uuid
+
+import grpc
+import pytest
+import yaml
+
+from tpu_dra.computedomain import CD_DRIVER_NAME
+from tpu_dra.k8sclient import (
+    COMPUTE_DOMAINS,
+    DAEMON_SETS,
+    RESOURCE_CLAIM_TEMPLATES,
+    RESOURCE_CLAIMS,
+    RESOURCE_SLICES,
+)
+from tpu_dra.k8sclient.rest import KubeClient
+from tpu_dra.plugin.device_state import DRIVER_NAME
+from tpu_dra.plugin.dra_service import DRA_SERVICE_NAME
+from tpu_dra.plugin.pb import dra_v1beta1_pb2 as drapb
+
+NS = "team-a"
+DRIVER_NS = "tpu-dra-driver"
+
+
+def wait_for(pred, timeout=30, tick=0.2, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(tick)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+def stub_cfg(path, hostname, worker_id):
+    path.write_text(yaml.safe_dump({
+        "generation": "v5p",
+        "hostname": hostname,
+        "slice": {
+            "uuid": "feedfeed",
+            "topology": "2x2x2",
+            "num_hosts": 2,
+            "worker_id": worker_id,
+        },
+    }))
+    return str(path)
+
+
+class Stack:
+    def __init__(self, td):
+        self.td = td
+        self.procs = {}
+
+    def spawn(self, name, argv, **env_extra):
+        env = dict(os.environ)
+        env.pop("TPU_DRA_CDI_HOOK", None)
+        env.update(env_extra)
+        logf = open(self.td / f"{name}.log", "wb")
+        self.procs[name] = (
+            subprocess.Popen(
+                [sys.executable, "-m"] + argv, env=env,
+                stdout=logf, stderr=subprocess.STDOUT,
+            ),
+            logf,
+        )
+        return self.procs[name][0]
+
+    def assert_alive(self):
+        for name, (p, _) in self.procs.items():
+            if p.poll() is not None:
+                raise RuntimeError(
+                    f"{name} died rc={p.returncode}:\n"
+                    + (self.td / f"{name}.log").read_text()[-4000:]
+                )
+
+    def stop_all(self):
+        for _, (p, _) in self.procs.items():
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for name, (p, logf) in self.procs.items():
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+            logf.close()
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    td = tmp_path_factory.mktemp("stack")
+    st = Stack(td)
+    kc_path = td / "kubeconfig.yaml"
+    api = st.spawn(
+        "apiserver",
+        ["tpu_dra.k8sclient.fakeserver", "--port", "0",
+         "--kubeconfig-out", str(kc_path)],
+    )
+    wait_for(kc_path.exists, what="kubeconfig from apiserver")
+    server = yaml.safe_load(kc_path.read_text())["clusters"][0]["cluster"]["server"]
+    kc = KubeClient(server=server, qps=1000, burst=1000)
+    wait_for(
+        lambda: _ping(kc), what="apiserver readiness",
+    )
+    st.kc = kc
+    st.kubeconfig = str(kc_path)
+    yield st
+    st.stop_all()
+
+
+def _ping(kc):
+    try:
+        kc.list(COMPUTE_DOMAINS, NS)
+        return True
+    except Exception:
+        return False
+
+
+def _rpc(sock, method, request, response_cls, timeout=10):
+    with grpc.insecure_channel(f"unix://{sock}") as ch:
+        fn = ch.unary_unary(
+            f"/{DRA_SERVICE_NAME}/{method}",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=response_cls.FromString,
+        )
+        return fn(request, timeout=timeout)
+
+
+def test_full_stack_bringup(stack):
+    kc = stack.kc
+    td = stack.td
+
+    # 1. User applies a two-node ComputeDomain.
+    cd = kc.create(COMPUTE_DOMAINS, {
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "ComputeDomain",
+        "metadata": {"name": "cd1", "namespace": NS},
+        "spec": {
+            "numNodes": 2,
+            "channel": {"resourceClaimTemplate": {"name": "cd1-channel"}},
+            "acceleratorType": "v5p-16",
+            "topology": "2x2x2",
+        },
+    })
+    cd_uid = cd["metadata"]["uid"]
+
+    # 2. Controller process reconciles: DaemonSet + claim templates appear.
+    stack.spawn(
+        "controller",
+        ["tpu_dra.computedomain.controller.main",
+         "--kubeconfig", stack.kubeconfig, "--namespace", DRIVER_NS],
+    )
+    wait_for(
+        lambda: kc.list(DAEMON_SETS, DRIVER_NS),
+        what="per-CD DaemonSet",
+    )
+    wait_for(
+        lambda: len(kc.list(RESOURCE_CLAIM_TEMPLATES, NS)) >= 1,
+        what="workload claim template",
+    )
+    stack.assert_alive()
+
+    # 3. CD kubelet plugin on node-0 (its domains dir is the host path the
+    #    daemon pod would get mounted).
+    cd_plugin_data = td / "cd-plugin"
+    st_sock = cd_plugin_data / "dra.sock"
+    stack.spawn(
+        "cd-plugin",
+        ["tpu_dra.computedomain.cdplugin.main",
+         "--kubeconfig", stack.kubeconfig,
+         "--node-name", "node-0",
+         "--cdi-root", str(td / "cdi"),
+         "--plugin-data-dir", str(cd_plugin_data),
+         "--kubelet-registrar-dir", str(td / "registry")],
+        TPU_DRA_BACKEND="stub",
+        TPU_DRA_STUB_CONFIG=stub_cfg(td / "stub-cd.yaml", "node-0", 0),
+    )
+    wait_for(st_sock.exists, what="cd-plugin gRPC socket")
+
+    # 4. The slice daemons come up on both nodes ("the DaemonSet pods").
+    #    Daemon 0 writes into the CD plugin's per-domain config dir, the
+    #    path workloads get mounted as /tpu-cd.
+    domain_dir = cd_plugin_data / "domains" / cd_uid
+    domain_dir.mkdir(parents=True)
+    for i in range(2):
+        cfg_dir = domain_dir if i == 0 else td / f"cd-config-{i}"
+        if i != 0:
+            cfg_dir.mkdir()
+        stack.spawn(
+            f"daemon-{i}",
+            ["tpu_dra.computedomain.daemon.main", "run",
+             "--kubeconfig", stack.kubeconfig,
+             "--cd-uid", cd_uid, "--cd-name", "cd1", "--cd-namespace", NS,
+             "--num-nodes", "2", "--node-name", f"node-{i}",
+             "--pod-ip", f"10.0.0.{i + 1}",
+             "--config-dir", str(cfg_dir),
+             "--hosts-path", str(td / f"hosts-{i}")],
+            TPU_DRA_BACKEND="stub",
+            TPU_DRA_STUB_CONFIG=stub_cfg(td / f"stub-d{i}.yaml", f"node-{i}", i),
+        )
+
+    # 5. Clique registration + controller aggregation drive the CD Ready.
+    wait_for(
+        lambda: kc.get(COMPUTE_DOMAINS, NS, "cd1")
+        .get("status", {}).get("status") == "Ready",
+        timeout=60,
+        what="ComputeDomain Ready",
+    )
+    stack.assert_alive()
+
+    # 6. A workload channel claim prepared over the CD plugin's real gRPC
+    #    socket returns the daemon-rendered JAX bootstrap.
+    claim_uid = str(uuid.uuid4())
+    kc.create(RESOURCE_CLAIMS, {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {"name": "wl", "namespace": NS, "uid": claim_uid},
+    })
+    wl = kc.get(RESOURCE_CLAIMS, NS, "wl")
+    claim_uid = wl["metadata"]["uid"]
+    wl["status"] = {
+        "allocation": {
+            "devices": {
+                "results": [{
+                    "request": "cd-channel",
+                    "driver": CD_DRIVER_NAME,
+                    "pool": "node-0-cd",
+                    "device": "channel-0",
+                }],
+                "config": [{
+                    "requests": ["cd-channel"],
+                    "opaque": {
+                        "driver": CD_DRIVER_NAME,
+                        "parameters": {
+                            "apiVersion": "resource.tpu.google.com/v1beta1",
+                            "kind": "ComputeDomainChannelConfig",
+                            "domainID": cd_uid,
+                        },
+                    },
+                    "source": "FromClaim",
+                }],
+            }
+        }
+    }
+    kc.update_status(RESOURCE_CLAIMS, wl)
+
+    def try_prepare():
+        req = drapb.NodePrepareResourcesRequest()
+        req.claims.append(
+            drapb.Claim(uid=claim_uid, name="wl", namespace=NS)
+        )
+        resp = _rpc(st_sock, "NodePrepareResources", req,
+                    drapb.NodePrepareResourcesResponse)
+        result = resp.claims[claim_uid]
+        return result if not result.error else None
+
+    # The kubelet retries Prepare while the CD converges; so do we.
+    result = wait_for(try_prepare, timeout=60, what="channel claim prepare")
+    assert [d.device_name for d in result.devices] == ["channel-0"]
+
+    spec_files = [
+        f for f in (td / "cdi").glob("*.json") if claim_uid in f.name
+    ]
+    assert len(spec_files) == 1
+    spec = json.loads(spec_files[0].read_text())
+    env = dict(
+        e.split("=", 1)
+        for d in spec["devices"]
+        for e in d["containerEdits"]["env"]
+    )
+    assert env["TPU_WORKER_ID"] == "0"
+    assert env["JAX_NUM_PROCESSES"] == "2"
+    assert env["TPU_WORKER_HOSTNAMES"].count(",") == 1
+    mounts = [
+        m for d in spec["devices"]
+        for m in d["containerEdits"].get("mounts", [])
+    ]
+    assert any(m["containerPath"] == "/tpu-cd" for m in mounts)
+
+    # 7. The TPU plugin on node-0 publishes its ResourceSlices into the
+    #    SHARED apiserver (visible to this test's client) and prepares a
+    #    chip claim over its own socket.
+    tpu_plugin_data = td / "tpu-plugin"
+    stack.spawn(
+        "tpu-plugin",
+        ["tpu_dra.plugin.main",
+         "--kubeconfig", stack.kubeconfig,
+         "--node-name", "node-0",
+         "--cdi-root", str(td / "cdi"),
+         "--plugin-data-dir", str(tpu_plugin_data),
+         "--kubelet-registrar-dir", str(td / "registry"),
+         "--cdi-hook", ""],
+        TPU_DRA_BACKEND="stub",
+        TPU_DRA_STUB_CONFIG=stub_cfg(td / "stub-tpu.yaml", "node-0", 0),
+    )
+    slices = wait_for(
+        lambda: [
+            s for s in kc.list(RESOURCE_SLICES)
+            if s["spec"]["driver"] == DRIVER_NAME
+        ],
+        what="TPU ResourceSlices in shared apiserver",
+    )
+    devices = [d["name"] for s in slices for d in s["spec"]["devices"]]
+    assert "tpu-0" in devices
+
+    chip_uid = str(uuid.uuid4())
+    kc.create(RESOURCE_CLAIMS, {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {"name": "chip", "namespace": NS, "uid": chip_uid},
+    })
+    chip = kc.get(RESOURCE_CLAIMS, NS, "chip")
+    chip_uid = chip["metadata"]["uid"]
+    chip["status"] = {
+        "allocation": {
+            "devices": {
+                "results": [{
+                    "request": "r0", "driver": DRIVER_NAME,
+                    "pool": "node-0", "device": "tpu-0",
+                }],
+                "config": [],
+            }
+        }
+    }
+    kc.update_status(RESOURCE_CLAIMS, chip)
+    req = drapb.NodePrepareResourcesRequest()
+    req.claims.append(drapb.Claim(uid=chip_uid, name="chip", namespace=NS))
+    resp = _rpc(tpu_plugin_data / "dra.sock", "NodePrepareResources", req,
+                drapb.NodePrepareResourcesResponse)
+    assert not resp.claims[chip_uid].error
+    assert [d.device_name for d in resp.claims[chip_uid].devices] == ["tpu-0"]
+
+    stack.assert_alive()

@@ -255,3 +255,23 @@ class TestHookBinary:
              "--container-rootfs", str(rootfs)])
         assert r.returncode == 0, r.stderr
         assert not (outside / "000-tpu-dra.conf").exists()
+
+    def test_update_ldcache_conf_file_symlink_replaced_not_followed(
+        self, tmp_path
+    ):
+        # The conf FILE itself (not just its directory) may be an
+        # image-shipped symlink to a host path; fopen must not follow it.
+        rootfs, state = self.bundle(tmp_path)
+        victim = tmp_path / "host-victim"
+        (rootfs / "etc" / "ld.so.conf.d").mkdir(parents=True)
+        (rootfs / "etc" / "ld.so.conf.d" / "000-tpu-dra.conf").symlink_to(
+            str(victim)
+        )
+        r = self.run_hook(
+            ["update-ldcache", "--folder", "/usr/lib/tpu",
+             "--container-rootfs", str(rootfs)])
+        assert r.returncode == 0, r.stderr
+        assert not victim.exists()
+        conf = rootfs / "etc" / "ld.so.conf.d" / "000-tpu-dra.conf"
+        assert not conf.is_symlink()
+        assert conf.read_text() == "/usr/lib/tpu\n"

@@ -219,6 +219,11 @@ def main(argv=None) -> int:
     p.add_argument("--node-name", default=flags.env_default("NODE_NAME", ""))
     p.add_argument("--pod-ip", default=flags.env_default("POD_IP", ""))
     p.add_argument("--config-dir", default=flags.env_default("CD_CONFIG_DIR", "/tpu-cd"))
+    p.add_argument(
+        "--hosts-path",
+        default=flags.env_default("CD_HOSTS_PATH", "/etc/hosts"),
+        help="hosts file the DNS-names manager rewrites (the pod's own)",
+    )
     p.add_argument("--pod-name", default=flags.env_default("POD_NAME", ""))
     p.add_argument(
         "--pod-namespace", default=flags.env_default("POD_NAMESPACE", "")
@@ -240,6 +245,7 @@ def main(argv=None) -> int:
         node_name=args.node_name,
         pod_ip=args.pod_ip,
         config_dir=args.config_dir,
+        hosts_path=args.hosts_path,
         pod_name=args.pod_name,
         pod_namespace=args.pod_namespace,
     )

@@ -36,6 +36,9 @@ from tpu_dra.k8sclient.resources import (
 
 Key = Tuple[str, Optional[str], str]  # (plural, namespace, name)
 
+# Sentinel returned by _Watch.next_event when the timeout elapses.
+WATCH_TIMEOUT = object()
+
 
 class _Watch:
     def __init__(self, rd, namespace, selector):
@@ -57,6 +60,17 @@ class _Watch:
     def close(self):
         self.closed = True
         self.q.put(None)
+
+    def next_event(self, timeout: Optional[float] = None):
+        """One event, or WATCH_TIMEOUT after `timeout` idle seconds, or
+        None once closed. The timeout path lets HTTP watch handlers send
+        liveness heartbeats and reap disconnected clients instead of
+        blocking forever on an idle queue."""
+        try:
+            item = self.q.get(timeout=timeout)
+        except queue.Empty:
+            return WATCH_TIMEOUT
+        return item
 
     def __iter__(self) -> Iterator[Tuple[str, dict]]:
         while True:
@@ -96,12 +110,11 @@ class FakeCluster(Backend):
 
         import yaml as _yaml
 
-        from tpu_dra.k8sclient import resources as _res
+        from tpu_dra.k8sclient.resources import iter_descriptors
 
-        by_gvk = {}
-        for v in vars(_res).values():
-            if isinstance(v, ResourceDescriptor):
-                by_gvk[(v.api_version, v.kind)] = v
+        by_gvk = {
+            (d.api_version, d.kind): d for d in iter_descriptors()
+        }
         n = 0
         files = sorted(
             glob.glob(_os.path.join(path, "*.yaml"))

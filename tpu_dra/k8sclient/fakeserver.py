@@ -1,0 +1,312 @@
+"""HTTP façade over FakeCluster: a minimal fake kube-apiserver.
+
+SURVEY.md §4.3: the reference ships no simulated multi-node test — its e2e
+needs a real GPU cluster. This server closes that gap: every driver
+component can run as a real OS process against one shared in-memory
+cluster, because the production REST transport (rest.KubeClient) speaks to
+this façade exactly as to a real apiserver — JSON verbs over
+``/api``/``/apis`` paths, label/field selectors, merge-patch, the
+``/status`` subresource, and JSON-lines watch streams. The only fake thing
+in a multi-process e2e stack is the cluster state itself.
+
+Also runnable standalone (``python -m tpu_dra.k8sclient.fakeserver --port
+18080 --seed dir --kubeconfig-out kc.yaml``) so demo scripts can bring up
+the full driver without kind.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
+
+from tpu_dra.k8sclient.fake import WATCH_TIMEOUT, FakeCluster
+from tpu_dra.k8sclient.resources import (
+    ResourceDescriptor,
+    iter_descriptors,
+)
+
+log = logging.getLogger(__name__)
+
+# Idle watch streams get a newline heartbeat at this period; a dead client
+# surfaces as a broken pipe on the write, reaping the handler thread and
+# its FakeCluster watch (which would otherwise accumulate every event
+# forever).
+WATCH_HEARTBEAT_SECONDS = 15.0
+
+
+def _registry() -> Dict[Tuple[str, str, str], ResourceDescriptor]:
+    return {(d.group, d.version, d.plural): d for d in iter_descriptors()}
+
+
+class _Route:
+    def __init__(self, rd: ResourceDescriptor, namespace: Optional[str],
+                 name: Optional[str], status: bool):
+        self.rd = rd
+        self.namespace = namespace
+        self.name = name
+        self.status = status
+
+
+def _parse_selector(qs: Dict[str, List[str]], key: str) -> Optional[Dict[str, str]]:
+    raw = qs.get(key, [""])[0]
+    if not raw:
+        return None
+    out = {}
+    for part in raw.split(","):
+        k, _, v = part.partition("=")
+        out[k] = v
+    return out
+
+
+class FakeApiServer:
+    """ThreadingHTTPServer wrapper; one shared FakeCluster behind it."""
+
+    def __init__(self, cluster: Optional[FakeCluster] = None,
+                 port: int = 0, address: str = "127.0.0.1"):
+        self.cluster = cluster or FakeCluster()
+        self._registry = _registry()
+        self._watches = []
+        self._watch_lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _route(self) -> Optional[_Route]:
+                parts = [p for p in urlsplit(self.path).path.split("/") if p]
+                if not parts:
+                    return None
+                if parts[0] == "api" and len(parts) >= 2:
+                    group, version, rest = "", parts[1], parts[2:]
+                elif parts[0] == "apis" and len(parts) >= 3:
+                    group, version, rest = parts[1], parts[2], parts[3:]
+                else:
+                    return None
+                ns = None
+                if len(rest) >= 2 and rest[0] == "namespaces":
+                    ns, rest = rest[1], rest[2:]
+                if not rest:
+                    return None
+                plural, rest = rest[0], rest[1:]
+                rd = outer._registry.get((group, version, plural))
+                if rd is None:
+                    return None
+                name = rest[0] if rest else None
+                status = len(rest) > 1 and rest[1] == "status"
+                return _Route(rd, ns, name, status)
+
+            def _reply(self, code: int, body: dict) -> None:
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _error(self, e: Exception) -> None:
+                status = getattr(e, "status", 500)
+                self._reply(status, {
+                    "kind": "Status", "status": "Failure",
+                    "message": str(e), "code": status,
+                })
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n)) if n else {}
+
+            def do_GET(self):  # noqa: N802
+                r = self._route()
+                if r is None:
+                    return self._reply(404, {"message": "no such route"})
+                qs = parse_qs(urlsplit(self.path).query)
+                try:
+                    if r.name:
+                        return self._reply(
+                            200, outer.cluster.get(r.rd, r.namespace, r.name)
+                        )
+                    labels = _parse_selector(qs, "labelSelector")
+                    if qs.get("watch", ["false"])[0] == "true":
+                        return self._serve_watch(r, labels)
+                    fields = _parse_selector(qs, "fieldSelector")
+                    items = outer.cluster.list(
+                        r.rd, r.namespace, label_selector=labels,
+                        field_selector=fields,
+                    )
+                    return self._reply(200, {
+                        "kind": f"{r.rd.kind}List",
+                        "apiVersion": r.rd.api_version,
+                        "items": items,
+                    })
+                except Exception as e:
+                    return self._error(e)
+
+            def _serve_watch(self, r: _Route, labels) -> None:
+                w = outer.cluster.watch(r.rd, r.namespace, label_selector=labels)
+                with outer._watch_lock:
+                    outer._watches.append(w)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(data: bytes) -> None:
+                    self.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
+                    self.wfile.flush()
+
+                try:
+                    while True:
+                        item = w.next_event(timeout=WATCH_HEARTBEAT_SECONDS)
+                        if item is None:  # watch closed server-side
+                            chunk(b"")
+                            break
+                        if item is WATCH_TIMEOUT:
+                            # Liveness heartbeat: clients skip blank lines;
+                            # a dead client breaks the pipe here.
+                            chunk(b"\n")
+                            continue
+                        event, obj = item
+                        chunk(json.dumps(
+                            {"type": event, "object": obj}
+                        ).encode() + b"\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                finally:
+                    w.close()
+                    with outer._watch_lock:
+                        if w in outer._watches:
+                            outer._watches.remove(w)
+                    self.close_connection = True
+
+            def do_POST(self):  # noqa: N802
+                r = self._route()
+                if r is None:
+                    return self._reply(404, {"message": "no such route"})
+                try:
+                    obj = self._body()
+                    if r.rd.namespaced and r.namespace:
+                        obj.setdefault("metadata", {}).setdefault(
+                            "namespace", r.namespace
+                        )
+                    return self._reply(201, outer.cluster.create(r.rd, obj))
+                except Exception as e:
+                    return self._error(e)
+
+            def do_PUT(self):  # noqa: N802
+                r = self._route()
+                if r is None or not r.name:
+                    return self._reply(404, {"message": "no such route"})
+                try:
+                    obj = self._body()
+                    fn = (
+                        outer.cluster.update_status
+                        if r.status
+                        else outer.cluster.update
+                    )
+                    return self._reply(200, fn(r.rd, obj))
+                except Exception as e:
+                    return self._error(e)
+
+            def do_PATCH(self):  # noqa: N802
+                r = self._route()
+                if r is None or not r.name:
+                    return self._reply(404, {"message": "no such route"})
+                try:
+                    return self._reply(200, outer.cluster.patch(
+                        r.rd, r.namespace, r.name, self._body()
+                    ))
+                except Exception as e:
+                    return self._error(e)
+
+            def do_DELETE(self):  # noqa: N802
+                r = self._route()
+                if r is None or not r.name:
+                    return self._reply(404, {"message": "no such route"})
+                try:
+                    outer.cluster.delete(r.rd, r.namespace, r.name)
+                    return self._reply(200, {"kind": "Status", "status": "Success"})
+                except Exception as e:
+                    return self._error(e)
+
+        self._httpd = ThreadingHTTPServer((address, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def server_url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def write_kubeconfig(self, path: str) -> str:
+        """Minimal kubeconfig so unmodified components (--kubeconfig) talk
+        to this façade."""
+        import yaml
+
+        with open(path, "w") as f:
+            yaml.safe_dump({
+                "apiVersion": "v1",
+                "kind": "Config",
+                "current-context": "fake",
+                "contexts": [
+                    {"name": "fake",
+                     "context": {"cluster": "fake", "user": "fake"}}
+                ],
+                "clusters": [
+                    {"name": "fake", "cluster": {"server": self.server_url}}
+                ],
+                "users": [{"name": "fake", "user": {}}],
+            }, f)
+        return path
+
+    def start(self) -> "FakeApiServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="fake-apiserver"
+        )
+        self._thread.start()
+        log.info("fake apiserver on %s", self.server_url)
+        return self
+
+    def stop(self) -> None:
+        # Unblock streaming watch handlers first or shutdown() deadlocks
+        # waiting on their threads.
+        with self._watch_lock:
+            for w in list(self._watches):
+                w.close()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("tpu-dra-fake-apiserver")
+    p.add_argument("--port", type=int, default=18080)
+    p.add_argument("--address", default="127.0.0.1")
+    p.add_argument("--seed", default="", help="Directory of manifests to load")
+    p.add_argument("--kubeconfig-out", default="",
+                   help="Write a kubeconfig pointing at this server")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    srv = FakeApiServer(port=args.port, address=args.address)
+    if args.seed:
+        n = srv.cluster.load_dir(args.seed)
+        log.info("seeded %d objects", n)
+    if args.kubeconfig_out:
+        srv.write_kubeconfig(args.kubeconfig_out)
+    srv.start()
+    print(f"fake apiserver ready on {srv.server_url}", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -83,6 +83,12 @@ COMPUTE_DOMAIN_CLIQUES = ResourceDescriptor(
 )
 
 
+def iter_descriptors() -> Iterable[ResourceDescriptor]:
+    """Every ResourceDescriptor this package declares (one registry for
+    manifest loading, URL routing, and anything else keying on GVR)."""
+    return [v for v in globals().values() if isinstance(v, ResourceDescriptor)]
+
+
 def match_label_selector(labels: Dict[str, str], selector: Dict[str, str]) -> bool:
     return all(labels.get(k) == v for k, v in selector.items())
 

@@ -52,7 +52,23 @@ class _RestWatch:
         self.closed = False
 
     def close(self) -> None:
+        # close() is called from a different thread than the one blocked in
+        # iter_lines() (informer shutdown); requests/urllib3 response
+        # teardown is not thread-safe against a concurrent read and can
+        # deadlock. Shut the socket down first: the blocked reader sees
+        # EOF and exits, making the close race-free.
         self.closed = True
+        import socket as _socket
+
+        try:
+            conn = getattr(self._resp.raw, "connection", None) or getattr(
+                self._resp.raw, "_connection", None
+            )
+            sock = getattr(conn, "sock", None)
+            if sock is not None:
+                sock.shutdown(_socket.SHUT_RDWR)
+        except OSError:
+            pass
         self._resp.close()
 
     def __iter__(self) -> Iterator[Tuple[str, dict]]:

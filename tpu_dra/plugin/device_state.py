@@ -450,8 +450,12 @@ class DeviceState:
                 group.devices.append(
                     self._prepare_one(claim, result, config_state)
                 )
-            self._reconcile_request_env(group)
             prepared.append(group)
+        # Across ALL groups: devices of one request can land in different
+        # config groups (a request whose selector matches both a chip and a
+        # sub-slice maps them to different default configs), so request-
+        # level reconciliation must see the whole claim.
+        self._reconcile_request_env(prepared)
         return prepared
 
     # Env keys owned by the request-level merge: cleared before the merged
@@ -464,49 +468,57 @@ class DeviceState:
         "TPU_WORKER_ID",
     )
 
-    def _reconcile_request_env(self, group: PreparedDeviceGroup) -> None:
+    def _reconcile_request_env(self, prepared: PreparedDevices) -> None:
         """Devices granted under one request are injected into one
         container together, and CDI concatenates every injected device's
         env with last-one-wins on duplicates — diverging per-device values
         would silently hide all devices but one. Per type:
 
-        - chips: rewrite every device of the request with the union env
-          (all indices, request-wide accelerator type);
-        - sub-slices: >1 per request is rejected loudly — a process runs
-          one contiguous ICI process-bounds, two disjoint sub-slices can't
-          be addressed by one libtpu process (request a larger shape);
+        - chips: rewrite every chip device of the request with the union
+          env (all indices, request-wide accelerator type);
+        - sub-slices: a sub-slice sharing a request with ANY other device
+          is rejected loudly — a process runs one contiguous ICI
+          process-bounds, so neither a second sub-slice nor extra chips
+          can be addressed alongside it (request a larger shape);
         - vfio: merge TPU_VFIO_PCI_ADDRESS into a comma-joined list (a VMM
           can take several passthrough functions)."""
         by_request: Dict[str, List[PreparedDevice]] = {}
-        for pd in group.devices:
-            for r in pd.device.requests:
-                by_request.setdefault(r, []).append(pd)
+        for group in prepared:
+            for pd in group.devices:
+                for r in pd.device.requests:
+                    by_request.setdefault(r, []).append(pd)
         for req, pds in by_request.items():
             if len(pds) < 2:
                 continue
-            types = {pd.type for pd in pds}
-            if types & {SUBSLICE_STATIC_DEVICE_TYPE, SUBSLICE_DYNAMIC_DEVICE_TYPE}:
+            n_subslice = sum(
+                pd.type in (SUBSLICE_STATIC_DEVICE_TYPE, SUBSLICE_DYNAMIC_DEVICE_TYPE)
+                for pd in pds
+            )
+            if n_subslice:
                 raise PermanentError(
-                    f"request {req!r} grants {len(pds)} sub-slice devices; "
-                    "a container can address only one contiguous sub-slice "
-                    "— request a larger sub-slice shape instead"
+                    f"request {req!r} grants {len(pds)} devices including "
+                    f"{n_subslice} sub-slice(s); a container can address "
+                    "only one contiguous sub-slice — request a larger "
+                    "sub-slice shape instead"
                 )
-            if types == {VFIO_DEVICE_TYPE}:
+            vfios = [pd for pd in pds if pd.type == VFIO_DEVICE_TYPE]
+            if len(vfios) > 1:
                 addrs = ",".join(
                     sorted(
                         pd.runtime_env.get("TPU_VFIO_PCI_ADDRESS", "")
-                        for pd in pds
+                        for pd in vfios
                     )
                 )
-                for pd in pds:
+                for pd in vfios:
                     pd.runtime_env["TPU_VFIO_PCI_ADDRESS"] = addrs
-                continue
-            if types == {TPU_DEVICE_TYPE}:
+            chip_pds = [pd for pd in pds if pd.type == TPU_DEVICE_TYPE]
+            if len(chip_pds) > 1:
                 chips = [
-                    self.allocatable[pd.device.device_name].chip for pd in pds
+                    self.allocatable[pd.device.device_name].chip
+                    for pd in chip_pds
                 ]
                 merged = self._chip_runtime_env(chips)
-                for pd in pds:
+                for pd in chip_pds:
                     for k in self._REQUEST_ENV_KEYS:
                         pd.runtime_env.pop(k, None)
                     pd.runtime_env.update(merged)
