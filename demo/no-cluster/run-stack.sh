@@ -1,0 +1,135 @@
+#!/usr/bin/env bash
+# Bring up the ENTIRE driver with no Kubernetes cluster at all: a fake
+# apiserver (HTTP facade over the in-memory cluster), the compute-domain
+# controller, two slice daemons standing in for a 2-host ICI slice, and
+# both kubelet plugins — each a real OS process talking HTTP/gRPC, the
+# same wiring tests/e2e/test_multiprocess_stack.py asserts on.
+#
+# Usage: demo/no-cluster/run-stack.sh [workdir]
+# Ctrl-C tears everything down.
+
+set -euo pipefail
+
+WORK="${1:-$(mktemp -d /tmp/tpu-dra-stack.XXXXXX)}"
+REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
+PY="${PYTHON:-python3}"
+
+mkdir -p "$WORK"
+echo ">>> workdir: $WORK"
+PIDS=()
+cleanup() {
+  echo ">>> tearing down"
+  for pid in "${PIDS[@]}"; do kill "$pid" 2>/dev/null || true; done
+  wait 2>/dev/null || true
+}
+trap cleanup EXIT INT TERM
+
+stub_cfg() { # path hostname worker_id
+  cat > "$1" <<EOF
+generation: v5p
+hostname: $2
+slice:
+  uuid: feedfeed
+  topology: 2x2x2
+  num_hosts: 2
+  worker_id: $3
+EOF
+}
+
+echo ">>> fake apiserver"
+$PY -m tpu_dra.k8sclient.fakeserver --port 18080 \
+  --kubeconfig-out "$WORK/kubeconfig.yaml" > "$WORK/apiserver.log" 2>&1 &
+PIDS+=($!)
+KC="$WORK/kubeconfig.yaml"
+for _ in $(seq 50); do [ -s "$KC" ] && break; sleep 0.1; done
+
+echo ">>> compute-domain controller"
+$PY -m tpu_dra.computedomain.controller.main \
+  --kubeconfig "$KC" --namespace tpu-dra-driver \
+  > "$WORK/controller.log" 2>&1 &
+PIDS+=($!)
+
+echo ">>> applying a 2-node ComputeDomain"
+$PY - "$KC" <<'EOF'
+import sys
+from tpu_dra.k8sclient.rest import KubeClient
+from tpu_dra.k8sclient import COMPUTE_DOMAINS
+kc = KubeClient.from_kubeconfig(sys.argv[1])
+cd = kc.create(COMPUTE_DOMAINS, {
+    "apiVersion": "resource.tpu.google.com/v1beta1",
+    "kind": "ComputeDomain",
+    "metadata": {"name": "demo", "namespace": "default"},
+    "spec": {"numNodes": 2,
+             "channel": {"resourceClaimTemplate": {"name": "demo-channel"}},
+             "acceleratorType": "v5p-16", "topology": "2x2x2"},
+})
+print("ComputeDomain uid:", cd["metadata"]["uid"])
+open(sys.argv[1] + ".cduid", "w").write(cd["metadata"]["uid"])
+EOF
+CD_UID="$(cat "$KC.cduid")"
+
+echo ">>> cd kubelet plugin (node-0)"
+stub_cfg "$WORK/stub-cd.yaml" node-0 0
+TPU_DRA_BACKEND=stub TPU_DRA_STUB_CONFIG="$WORK/stub-cd.yaml" \
+$PY -m tpu_dra.computedomain.cdplugin.main \
+  --kubeconfig "$KC" --node-name node-0 \
+  --cdi-root "$WORK/cdi" \
+  --plugin-data-dir "$WORK/cd-plugin" \
+  --kubelet-registrar-dir "$WORK/registry" \
+  > "$WORK/cd-plugin.log" 2>&1 &
+PIDS+=($!)
+
+echo ">>> slice daemons (node-0, node-1)"
+mkdir -p "$WORK/cd-plugin/domains/$CD_UID" "$WORK/cd-config-1"
+for i in 0 1; do
+  CFGDIR="$WORK/cd-config-$i"
+  [ "$i" = 0 ] && CFGDIR="$WORK/cd-plugin/domains/$CD_UID"
+  stub_cfg "$WORK/stub-d$i.yaml" "node-$i" "$i"
+  TPU_DRA_BACKEND=stub TPU_DRA_STUB_CONFIG="$WORK/stub-d$i.yaml" \
+  $PY -m tpu_dra.computedomain.daemon.main run \
+    --kubeconfig "$KC" \
+    --cd-uid "$CD_UID" --cd-name demo --cd-namespace default \
+    --num-nodes 2 --node-name "node-$i" --pod-ip "10.0.0.$((i + 1))" \
+    --config-dir "$CFGDIR" --hosts-path "$WORK/hosts-$i" \
+    > "$WORK/daemon-$i.log" 2>&1 &
+  PIDS+=($!)
+done
+
+echo ">>> tpu kubelet plugin (node-0)"
+stub_cfg "$WORK/stub-tpu.yaml" node-0 0
+TPU_DRA_BACKEND=stub TPU_DRA_STUB_CONFIG="$WORK/stub-tpu.yaml" \
+$PY -m tpu_dra.plugin.main \
+  --kubeconfig "$KC" --node-name node-0 \
+  --cdi-root "$WORK/cdi" \
+  --plugin-data-dir "$WORK/tpu-plugin" \
+  --kubelet-registrar-dir "$WORK/registry" \
+  --cdi-hook "$REPO/native/build/tpu-cdi-hook" \
+  > "$WORK/tpu-plugin.log" 2>&1 &
+PIDS+=($!)
+
+echo ">>> waiting for the ComputeDomain to go Ready"
+$PY - "$KC" <<'EOF'
+import sys, time
+from tpu_dra.k8sclient.rest import KubeClient
+from tpu_dra.k8sclient import COMPUTE_DOMAINS, RESOURCE_SLICES
+kc = KubeClient.from_kubeconfig(sys.argv[1])
+deadline = time.time() + 60
+while time.time() < deadline:
+    cd = kc.get(COMPUTE_DOMAINS, "default", "demo")
+    status = cd.get("status", {}).get("status")
+    if status == "Ready":
+        print("ComputeDomain: Ready")
+        break
+    time.sleep(0.5)
+else:
+    raise SystemExit("ComputeDomain never became Ready")
+slices = kc.list(RESOURCE_SLICES)
+print(f"{len(slices)} ResourceSlices published:")
+for s in slices:
+    print(f"  {s['metadata']['name']}: {len(s['spec']['devices'])} devices")
+EOF
+
+echo
+echo ">>> stack is up. kubeconfig: $KC ; logs in $WORK/*.log ; Ctrl-C to stop."
+wait

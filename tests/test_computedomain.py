@@ -1029,3 +1029,109 @@ def test_multislice_validation():
             worker_id=0, num_nodes=3, accelerator_type="v5p-16",
             topology="2x2x2", peers=[], num_slices=2,
         )
+
+
+def test_heartbeat_staleness_marks_node_notready(fc, tmp_path):
+    """A registration whose liveness heartbeat went stale counts as
+    NotReady in the controller's aggregation (crash detection without pod
+    reaping — improvement over the reference); entries without a heartbeat
+    (older drivers) are exempt for upgrade compatibility."""
+    import datetime
+
+    from tpu_dra.computedomain.controller.status import StatusManager
+
+    cd = make_cd(fc, num_nodes=2)
+    daemons = [make_daemon(fc, cd, i, tmp_path) for i in range(2)]
+    for d in daemons:
+        d.run_once()
+    for d in daemons:
+        d.run_once()
+    sm = StatusManager(fc, node_stale_after=5.0)
+    nodes = sm._derive_nodes(cd)
+    assert [n["status"] for n in nodes] == ["Ready", "Ready"]
+
+    # Age daemon-1's heartbeat past the staleness window.
+    cliques = ResourceClient(fc, COMPUTE_DOMAIN_CLIQUES)
+    for cl in sm.cliques_for(cd):
+        for e in cl.get("daemons") or []:
+            if e["nodeName"] == "node-1":
+                old = datetime.datetime.now(
+                    datetime.timezone.utc
+                ) - datetime.timedelta(seconds=60)
+                e["lastHeartbeatTime"] = old.strftime("%Y-%m-%dT%H:%M:%SZ")
+        cliques.update(cl)
+    statuses = {n["name"]: n["status"] for n in sm._derive_nodes(cd)}
+    assert statuses == {"node-0": "Ready", "node-1": "NotReady"}
+
+    # Heartbeat-less entries (written by an older driver) stay live.
+    for cl in sm.cliques_for(cd):
+        for e in cl.get("daemons") or []:
+            e.pop("lastHeartbeatTime", None)
+        cliques.update(cl)
+    statuses = {n["name"]: n["status"] for n in sm._derive_nodes(cd)}
+    assert statuses == {"node-0": "Ready", "node-1": "Ready"}
+
+    # node_stale_after=0 disables the check entirely.
+    assert all(
+        n["status"] == "Ready"
+        for n in StatusManager(fc, node_stale_after=0)._derive_nodes(cd)
+    )
+
+
+def test_heartbeat_refresh_only_when_due(fc, tmp_path):
+    """register() must not rewrite the shared clique object every tick —
+    only when the heartbeat period elapsed (64-host slices would conflict-
+    storm otherwise)."""
+    cd = make_cd(fc, num_nodes=1)
+    d = make_daemon(fc, cd, 0, tmp_path)
+    d.config.heartbeat_period = 30.0
+    d.registration.heartbeat_period = 30.0
+    d.run_once()
+    cliques = ResourceClient(fc, COMPUTE_DOMAIN_CLIQUES)
+    rv_before = [
+        c["metadata"]["resourceVersion"] for c in cliques.list(NS)
+    ]
+    d.registration.register()  # fresh heartbeat: no write
+    assert [
+        c["metadata"]["resourceVersion"] for c in cliques.list(NS)
+    ] == rv_before
+    d.registration.heartbeat_period = 0.0  # force due
+    d.registration.register()
+    assert [
+        c["metadata"]["resourceVersion"] for c in cliques.list(NS)
+    ] != rv_before
+
+
+def test_reclaimed_entry_resets_ready_status(fc, tmp_path):
+    """A daemon taking over a dead predecessor's entry (IP change or long
+    heartbeat lapse) must reset its status: refreshing the heartbeat while
+    the stale 'Ready' lingers would let the domain flip Ready before the
+    new daemon validated anything."""
+    cd = make_cd(fc, num_nodes=1)
+    d = make_daemon(fc, cd, 0, tmp_path)
+    d.run_once()
+    d.run_once()  # full membership -> Ready
+    cliques = ResourceClient(fc, COMPUTE_DOMAIN_CLIQUES)
+    entry = lambda: next(  # noqa: E731
+        e
+        for c in cliques.list(NS)
+        for e in c.get("daemons") or []
+        if e["nodeName"] == "node-0"
+    )
+    assert entry()["status"] == "Ready"
+
+    # Same node restarts with a different pod IP: reclaim resets status.
+    d2 = make_daemon(fc, cd, 0, tmp_path)
+    d2.config.pod_ip = "10.9.9.9"
+    d2.registration.ip_address = "10.9.9.9"
+    d2.registration.register()
+    assert entry()["status"] == "NotReady"
+    assert entry()["ipAddress"] == "10.9.9.9"
+
+    # A merely-due heartbeat on a live daemon does NOT reset status.
+    d2.run_once()  # registers + set_status(Ready)
+    d2.run_once()
+    assert entry()["status"] == "Ready"
+    d2.registration.heartbeat_period = 0.0  # heartbeat always due
+    d2.registration.register()
+    assert entry()["status"] == "Ready"

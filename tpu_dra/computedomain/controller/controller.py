@@ -47,6 +47,7 @@ class ComputeDomainController:
         image: str = "tpu-dra-driver:latest",
         status_sync_period: float = 10.0,
         daemon_service_account: str = "",
+        node_stale_after: float = 60.0,
     ):
         self.backend = backend
         self.cds = ResourceClient(backend, COMPUTE_DOMAINS)
@@ -55,7 +56,11 @@ class ComputeDomainController:
             service_account=daemon_service_account,
         )
         self.rcts = ResourceClaimTemplateManager(backend)
-        self.status = StatusManager(backend, driver_namespace=driver_namespace)
+        self.status = StatusManager(
+            backend,
+            driver_namespace=driver_namespace,
+            node_stale_after=node_stale_after,
+        )
         self.node_labels = NodeLabelManager(backend)
         self.queue = WorkQueue(default_controller_rate_limiter())
         self.cd_informer = Informer(backend, COMPUTE_DOMAINS)

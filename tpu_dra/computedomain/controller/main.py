@@ -118,6 +118,13 @@ def main(argv=None) -> int:
         default=flags.env_default("DAEMON_SERVICE_ACCOUNT", ""),
         help="ServiceAccount for the per-CD daemon pods (clique RBAC)",
     )
+    p.add_argument(
+        "--node-stale-after",
+        type=float,
+        default=flags.env_default("NODE_STALE_AFTER", 60.0, float),
+        help="Seconds after which a daemon registration with no heartbeat "
+        "counts as NotReady (0 disables)",
+    )
     args = p.parse_args(argv)
     flags.LoggingConfig.from_args(args).apply()
     signals.start_debug_signal_handlers()
@@ -130,6 +137,7 @@ def main(argv=None) -> int:
         driver_namespace=args.namespace,
         image=args.image,
         daemon_service_account=args.daemon_service_account,
+        node_stale_after=args.node_stale_after,
     )
 
     stop = threading.Event()

@@ -16,6 +16,7 @@ from typing import Dict, List
 
 from tpu_dra.api import CD_STATUS_NOT_READY, CD_STATUS_READY
 from tpu_dra.computedomain import CD_LABEL_KEY
+from tpu_dra.computedomain.daemon.registration import heartbeat_age_seconds
 from tpu_dra.infra import featuregates
 from tpu_dra.k8sclient import (
     COMPUTE_DOMAIN_CLIQUES,
@@ -29,11 +30,28 @@ log = logging.getLogger(__name__)
 
 
 class StatusManager:
-    def __init__(self, backend, driver_namespace: str = "tpu-dra-driver"):
+    def __init__(
+        self,
+        backend,
+        driver_namespace: str = "tpu-dra-driver",
+        node_stale_after: float = 60.0,
+    ):
         self.cds = ResourceClient(backend, COMPUTE_DOMAINS)
         self.cliques = ResourceClient(backend, COMPUTE_DOMAIN_CLIQUES)
         self.pods = ResourceClient(backend, PODS)
         self.driver_namespace = driver_namespace
+        # A registration whose heartbeat is older than this counts as
+        # NotReady (crash liveness without relying on pod reaping — an
+        # improvement over the reference, see registration.py). Must be
+        # well above the daemons' heartbeat period; <= 0 disables.
+        self.node_stale_after = node_stale_after
+
+    def _apply_staleness(self, node: dict, entry: dict) -> dict:
+        if self.node_stale_after > 0:
+            age = heartbeat_age_seconds(entry)
+            if age is not None and age > self.node_stale_after:
+                node["status"] = CD_STATUS_NOT_READY
+        return node
 
     def cliques_for(self, cd: dict) -> List[dict]:
         return self.cliques.list(
@@ -118,22 +136,23 @@ class StatusManager:
                 cd["metadata"]["uid"] + "."
             )
             for d in clique.get("daemons") or []:
-                nodes.append(
+                nodes.append(self._apply_staleness(
                     {
                         "name": d.get("nodeName", ""),
                         "ipAddress": d.get("ipAddress", ""),
                         "cliqueID": d.get("cliqueID", clique_id),
                         "index": d.get("index", 0),
                         "status": d.get("status", ""),
-                    }
-                )
+                    },
+                    d,
+                ))
         nodes.sort(key=lambda n: (n["cliqueID"], n["index"]))
         return nodes
 
     def _nodes_from_status(self, cd: dict) -> List[dict]:
         live = self._daemon_pod_node_names(cd)
         nodes = [
-            dict(n)
+            self._apply_staleness(dict(n), n)
             for n in (cd.get("status") or {}).get("nodes") or []
             if n.get("name") in live
         ]

@@ -17,6 +17,7 @@ from typing import List, Optional
 from tpu_dra.api import CD_STATUS_NOT_READY
 from tpu_dra.computedomain import CD_LABEL_KEY
 from tpu_dra.computedomain.daemon.registration import (
+    DEFAULT_HEARTBEAT_PERIOD,
     RETRY,
     MultisliceIdentityPending,
     RegistrationBase,
@@ -41,9 +42,11 @@ class CliqueRegistration(RegistrationBase):
         clique_id: str,
         node_name: str,
         ip_address: str,
+        heartbeat_period: float = DEFAULT_HEARTBEAT_PERIOD,
     ):
         super().__init__(
-            node_name=node_name, ip_address=ip_address, clique_id=clique_id
+            node_name=node_name, ip_address=ip_address, clique_id=clique_id,
+            heartbeat_period=heartbeat_period,
         )
         self.cliques = ResourceClient(backend, COMPUTE_DOMAIN_CLIQUES)
         self.cd_uid = cd_uid

@@ -51,6 +51,7 @@ class DaemonConfig:
     config_dir: str = "/tpu-cd"
     hosts_path: str = "/etc/hosts"
     update_period: float = 2.0
+    heartbeat_period: float = 10.0
     num_slices: int = 1
     pod_name: str = ""
     pod_namespace: str = ""
@@ -71,6 +72,7 @@ class SliceDaemon:
                 clique_id=self.clique_id,
                 node_name=config.node_name,
                 ip_address=config.pod_ip,
+                heartbeat_period=config.heartbeat_period,
             )
         else:
             # Legacy path (cdstatus.go): write directly into CD.Status.
@@ -82,6 +84,7 @@ class SliceDaemon:
                 clique_id=self.clique_id,
                 node_name=config.node_name,
                 ip_address=config.pod_ip,
+                heartbeat_period=config.heartbeat_period,
             )
         self.podmanager = PodManager(
             backend, config.pod_namespace or config.cd_namespace,
@@ -224,6 +227,12 @@ def main(argv=None) -> int:
         default=flags.env_default("CD_HOSTS_PATH", "/etc/hosts"),
         help="hosts file the DNS-names manager rewrites (the pod's own)",
     )
+    p.add_argument(
+        "--heartbeat-period",
+        type=float,
+        default=flags.env_default("CD_HEARTBEAT_PERIOD", 10.0, float),
+        help="How often to refresh this daemon's liveness heartbeat",
+    )
     p.add_argument("--pod-name", default=flags.env_default("POD_NAME", ""))
     p.add_argument(
         "--pod-namespace", default=flags.env_default("POD_NAMESPACE", "")
@@ -246,6 +255,7 @@ def main(argv=None) -> int:
         pod_ip=args.pod_ip,
         config_dir=args.config_dir,
         hosts_path=args.hosts_path,
+        heartbeat_period=args.heartbeat_period,
         pod_name=args.pod_name,
         pod_namespace=args.pod_namespace,
     )

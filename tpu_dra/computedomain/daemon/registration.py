@@ -9,6 +9,7 @@ subclasses supply only where the list lives and how it persists.
 
 from __future__ import annotations
 
+import datetime
 import logging
 from typing import List, Optional
 
@@ -18,6 +19,36 @@ from tpu_dra.k8sclient import ApiConflict
 log = logging.getLogger(__name__)
 
 MAX_CONFLICT_RETRIES = 20
+
+# How often a registered daemon refreshes its entry's lastHeartbeatTime.
+# Liveness via heartbeats is an improvement over the reference, whose
+# crash detection leans entirely on the pod lifecycle (daemonsetpods.go):
+# with heartbeats the controller can mark a hard-crashed host NotReady
+# even where no kubelet reaps a pod (and the no-cluster e2e stack has no
+# pods at all). Keep this well under the controller's --node-stale-after.
+DEFAULT_HEARTBEAT_PERIOD = 10.0
+
+
+def now_iso() -> str:
+    return (
+        datetime.datetime.now(datetime.timezone.utc)
+        .strftime("%Y-%m-%dT%H:%M:%SZ")
+    )
+
+
+def heartbeat_age_seconds(entry: dict) -> Optional[float]:
+    """Age of an entry's heartbeat, or None when it has none (written by
+    an older driver — treated as always-live for upgrade compatibility)."""
+    raw = entry.get("lastHeartbeatTime")
+    if not raw:
+        return None
+    try:
+        t = datetime.datetime.fromisoformat(raw.replace("Z", "+00:00"))
+    except ValueError:
+        return None
+    return (
+        datetime.datetime.now(datetime.timezone.utc) - t
+    ).total_seconds()
 
 # Sentinel: the subclass handled a missing parent object but the write
 # raced; re-run the retry loop.
@@ -51,10 +82,17 @@ class RegistrationBase:
 
     node_key = "nodeName"
 
-    def __init__(self, node_name: str, ip_address: str, clique_id: str):
+    def __init__(
+        self,
+        node_name: str,
+        ip_address: str,
+        clique_id: str,
+        heartbeat_period: float = DEFAULT_HEARTBEAT_PERIOD,
+    ):
         self.node_name = node_name
         self.ip_address = ip_address
         self.clique_id = clique_id
+        self.heartbeat_period = heartbeat_period
         self.index: Optional[int] = None
 
     # --- subclass surface ---
@@ -83,6 +121,7 @@ class RegistrationBase:
             "cliqueID": self.clique_id,
             "index": index,
             "status": status,
+            "lastHeartbeatTime": now_iso(),
         }
 
     def _scope(self, entries: List[dict]) -> List[dict]:
@@ -115,10 +154,25 @@ class RegistrationBase:
             )
             if mine is not None:
                 self.index = mine.get("index", 0)
-                if mine.get("ipAddress") == self.ip_address:
+                age = heartbeat_age_seconds(mine)
+                fresh = age is not None and age < self.heartbeat_period
+                if mine.get("ipAddress") == self.ip_address and fresh:
                     return self.index
-                # Pod restart changed our IP; refresh it.
+                # Reclaiming a dead predecessor's entry (pod restart: IP
+                # changed, or the heartbeat lapsed for several periods)
+                # must reset its status — refreshing the heartbeat while
+                # the old 'Ready' lingers would un-suppress the entry and
+                # let the domain flip Ready before this daemon validated
+                # anything. A merely *due* heartbeat is not a reclaim.
+                lapsed = (
+                    self.heartbeat_period > 0
+                    and age is not None
+                    and age > 3 * self.heartbeat_period
+                )
+                if mine.get("ipAddress") != self.ip_address or lapsed:
+                    mine["status"] = CD_STATUS_NOT_READY
                 mine["ipAddress"] = self.ip_address
+                mine["lastHeartbeatTime"] = now_iso()
             else:
                 self.index = assign_gap_filled_index(self._scope(entries))
                 entries.append(self._entry(self.index, CD_STATUS_NOT_READY))

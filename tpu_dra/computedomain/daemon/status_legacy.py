@@ -16,6 +16,7 @@ import logging
 from typing import List, Optional
 
 from tpu_dra.computedomain.daemon.registration import (
+    DEFAULT_HEARTBEAT_PERIOD,
     MultisliceIdentityPending,
     RegistrationBase,
 )
@@ -38,9 +39,11 @@ class DirectStatusRegistration(RegistrationBase):
         clique_id: str,
         node_name: str,
         ip_address: str,
+        heartbeat_period: float = DEFAULT_HEARTBEAT_PERIOD,
     ):
         super().__init__(
-            node_name=node_name, ip_address=ip_address, clique_id=clique_id
+            node_name=node_name, ip_address=ip_address, clique_id=clique_id,
+            heartbeat_period=heartbeat_period,
         )
         self.cds = ResourceClient(backend, COMPUTE_DOMAINS)
         self.cd_uid = cd_uid
