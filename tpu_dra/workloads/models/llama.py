@@ -42,6 +42,11 @@ class LlamaConfig:
     param_dtype: Any = jnp.bfloat16
     scan_layers: bool = True
     remat: bool = True
+    # "nothing": recompute everything (min HBM); "dots": save matmul
+    # outputs and recompute only elementwise ops — the MXU work is the
+    # expensive part, so this buys most of remat's memory win at a
+    # fraction of its FLOP cost.
+    remat_policy: str = "nothing"  # nothing | dots
     attention_impl: str = "auto"  # auto | pallas | xla | ring | ulysses
 
     @property
@@ -63,6 +68,14 @@ TINY_LLAMA = LlamaConfig(
     scan_layers=True,
     remat=False,
 )
+
+
+def _remat_policy(name: str):
+    if name == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if name == "nothing":
+        return jax.checkpoint_policies.nothing_saveable
+    raise ValueError(f"unknown remat_policy: {name!r}")
 
 
 def rope_frequencies(config: LlamaConfig, positions: jnp.ndarray) -> tuple:
@@ -209,7 +222,7 @@ class Llama(nn.Module):
                 block = nn.remat(
                     block,
                     prevent_cse=False,
-                    policy=jax.checkpoint_policies.nothing_saveable,
+                    policy=_remat_policy(c.remat_policy),
                 )
             x, _ = nn.scan(
                 block,
@@ -223,7 +236,7 @@ class Llama(nn.Module):
             for i in range(c.n_layers):
                 blk = LlamaBlock(c, name=f"layer_{i}")
                 if c.remat:
-                    blk = nn.remat(blk)
+                    blk = nn.remat(blk, policy=_remat_policy(c.remat_policy))
                 x = blk(x, cos, sin)
 
         x = RMSNorm(c.norm_eps, c.param_dtype, name="final_norm")(x)
