@@ -9,11 +9,15 @@ TPU-first design notes:
 - GQA is resolved *outside* the kernel by logical head grouping (no K/V
   materialized repeat: we reshape queries to [kv_head, group, ...] so the
   kernel contracts each KV head against its query group);
-- backward uses recompute (jax.custom_vjp around the kernel with the XLA
-  reference's VJP) — the standard memory/FLOPs trade on TPU where remat is
-  cheap relative to HBM;
+- backward is a pair of flash kernels (dq over q-blocks; dk/dv over
+  kv-blocks) reusing the forward's saved logsumexp — no s×s
+  materialization in either direction, with the same causal block-skip;
+  shapes the kernels don't cover (sq != skv) fall back to an XLA-recompute
+  VJP;
 - everything falls back to the XLA reference off-TPU (CPU tests, the
-  driver's virtual-device dryrun) — same numerics, fp32 softmax.
+  driver's virtual-device dryrun) — same numerics, fp32 softmax. Setting
+  ``_INTERPRET = True`` runs the pallas kernels in interpreter mode on any
+  backend (numerics tests without a TPU).
 """
 
 from __future__ import annotations
@@ -28,6 +32,9 @@ import jax.numpy as jnp
 log = logging.getLogger(__name__)
 
 NEG_INF = -1e30
+
+# Run pallas kernels in interpreter mode (works on CPU; for tests).
+_INTERPRET = False
 
 
 def _repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
@@ -73,14 +80,18 @@ def reference_attention(
 # --- pallas flash kernel ----------------------------------------------------
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, sq: int, skv: int,
-                  causal: bool, scale: float):
-    """One (batch*head, q-block) program: online softmax over KV blocks."""
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                  sq: int, skv: int, causal: bool, scale: float):
+    """One (batch*head, q-block) program: online softmax over KV blocks.
+    Also emits the per-row logsumexp, the residual the backward kernels
+    rebuild softmax probabilities from."""
     import jax.experimental.pallas as pl
 
     q = q_ref[0].astype(jnp.float32) * scale  # [block_q, hd]
     block_q = q.shape[0]
-    qi = pl.program_id(1)
+    # Grid dim 1 walks the n_rep query heads of this KV head back-to-back;
+    # the causal position only depends on the within-sequence block index.
+    qi = pl.program_id(1) % (sq // block_q)
     q_offset = qi * block_q + (skv - sq)  # global position of q row 0
 
     m0 = jnp.full((block_q,), NEG_INF, dtype=jnp.float32)
@@ -115,12 +126,138 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, sq: int, skv: int
 
     m, l, acc = jax.lax.fori_loop(0, num_visible, body, (m0, l0, acc0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(jnp.maximum(l, 1e-30))
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k: int, sq: int, skv: int,
+                         causal: bool, scale: float):
+    """dQ for one (batch*head, q-block) program: stream KV blocks, rebuild
+    P from the saved logsumexp, accumulate dS·K. delta is the flash-bwd
+    rowsum(dO ⊙ O) term."""
+    import jax.experimental.pallas as pl
+
+    q = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+    block_q = q.shape[0]
+    qi = pl.program_id(1) % (sq // block_q)
+    q_offset = qi * block_q + (skv - sq)
+
+    num_kv_blocks = skv // block_k
+    if causal:
+        last_q_row = q_offset + block_q - 1
+        num_visible = jnp.minimum(last_q_row // block_k + 1, num_kv_blocks)
+    else:
+        num_visible = num_kv_blocks
+
+    def body(ki, acc):
+        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            rows = q_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(cols <= rows, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return acc + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+
+    acc0 = jnp.zeros_like(q)
+    acc = jax.lax.fori_loop(0, num_visible, body, acc0)
+    dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, sq: int, skv: int,
+                          n_rep: int, causal: bool, scale: float):
+    """dK/dV for one (batch*kv_head, kv-block) program: stream the q blocks
+    of all n_rep query heads below the causal frontier, accumulating
+    Pᵀ·dO and dSᵀ·Q across the whole GQA group in-kernel (no fp32
+    per-group gradient buffers or external reduction)."""
+    import jax.experimental.pallas as pl
+
+    k_blk = k_ref[0].astype(jnp.float32)
+    v_blk = v_ref[0].astype(jnp.float32)
+    block_k = k_blk.shape[0]
+    ki = pl.program_id(1)
+    k_start = ki * block_k
+
+    num_q_blocks = sq // block_q
+    if causal:
+        # First q row that can see this kv block: global row == k_start.
+        first_q_row = jnp.maximum(k_start - (skv - sq), 0)
+        qi_start = first_q_row // block_q
+    else:
+        qi_start = 0
+    visible = num_q_blocks - qi_start  # same frontier for every rep
+
+    def body(t, carry):
+        acc_dk, acc_dv = carry
+        rep = t // visible
+        qi = qi_start + t % visible
+        row0 = rep * sq + qi * block_q  # q rows laid out rep-major
+        q = q_ref[0, pl.ds(row0, block_q), :].astype(jnp.float32) * scale
+        do = do_ref[0, pl.ds(row0, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(row0, block_q)]
+        delta = delta_ref[0, 0, pl.ds(row0, block_q)]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_offset = qi * block_q + (skv - sq)
+            rows = q_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(cols <= rows, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        acc_dv = acc_dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        acc_dk = acc_dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return acc_dk, acc_dv
+
+    zeros = jnp.zeros(k_blk.shape, jnp.float32)
+    acc_dk, acc_dv = jax.lax.fori_loop(
+        0, n_rep * visible, body, (zeros, zeros)
+    )
+    # q was pre-scaled, so dS·Q already carries one factor of scale — which
+    # is exactly dK = scale · dSᵀ·Q_unscaled.
+    dk_ref[0] = acc_dk.astype(dk_ref.dtype)
+    dv_ref[0] = acc_dv.astype(dv_ref.dtype)
+
+
+def _group_q(x: jnp.ndarray, kvh: int) -> jnp.ndarray:
+    """[b, s, h, hd] -> [b*kvh, n_rep*s, hd]: the n_rep query heads of one
+    KV head are stacked along the row axis, so a single grid row shares one
+    K/V load across the whole GQA group — no K/V duplication anywhere."""
+    b, s, h, hd = x.shape
+    n_rep = h // kvh
+    return (
+        x.transpose(0, 2, 1, 3)
+        .reshape(b * kvh, n_rep * s, hd)
+    )
+
+
+def _ungroup_q(x: jnp.ndarray, b: int, h: int, s: int) -> jnp.ndarray:
+    hd = x.shape[-1]
+    return x.reshape(b, h, s, hd).transpose(0, 2, 1, 3)
+
+
+def _group_kv(x: jnp.ndarray) -> jnp.ndarray:
+    """[b, skv, kvh, hd] -> [b*kvh, skv, hd]."""
+    b, s, kvh, hd = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * kvh, s, hd)
+
+
+def _ungroup_kv(x: jnp.ndarray, b: int, kvh: int) -> jnp.ndarray:
+    _, s, hd = x.shape
+    return x.reshape(b, kvh, s, hd).transpose(0, 2, 1, 3)
 
 
 def _flash_attention_fwd_impl(
     q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool,
     block_q: int, block_k: int,
-) -> jnp.ndarray:
+):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -129,71 +266,143 @@ def _flash_attention_fwd_impl(
     n_rep = h // kvh
     scale = hd**-0.5
 
-    # Fold batch and KV-head into the grid; queries grouped per KV head so
-    # GQA needs no repeated K/V in memory.
-    qg = q.transpose(0, 2, 1, 3).reshape(b * kvh, n_rep * sq, hd)
-    kg = k.transpose(0, 2, 1, 3).reshape(b * kvh, skv, hd)
-    vg = v.transpose(0, 2, 1, 3).reshape(b * kvh, skv, hd)
-    # Each query group member is an independent sequence; run grid over
-    # (b*kvh*n_rep, q blocks) by viewing qg as [b*kvh*n_rep, sq, hd].
-    qg = qg.reshape(b * kvh * n_rep, sq, hd)
+    qg = _group_q(q, kvh)  # [b*kvh, n_rep*sq, hd]
+    kg = _group_kv(k)
+    vg = _group_kv(v)
 
-    grid = (qg.shape[0], sq // block_q)
+    q_block = lambda i, j: (i, j, 0)  # noqa: E731
+    whole_kv = lambda i, j: (i, 0, 0)  # noqa: E731
+    row_block = lambda i, j: (i, 0, j)  # noqa: E731
+
+    grid = (kg.shape[0], n_rep * (sq // block_q))
     kernel = functools.partial(
         _flash_kernel, block_k=block_k, sq=sq, skv=skv, causal=causal,
         scale=scale,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(qg.shape, q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct(qg.shape, q.dtype),
+            # [bg, 1, n_rep*sq]: mosaic wants the last two block dims
+            # aligned to (8, 128) or full-size; a singleton axis satisfies
+            # that where a [bg, rows] row-block could not.
+            jax.ShapeDtypeStruct((qg.shape[0], 1, qg.shape[1]), jnp.float32),
+        ],
         grid=grid,
         in_specs=[
-            pl.BlockSpec(
-                (1, block_q, hd), lambda i, j: (i, j, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (1, skv, hd), lambda i, j: (i, 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(
-                (1, skv, hd), lambda i, j: (i, 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
+            pl.BlockSpec((1, block_q, hd), q_block, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, skv, hd), whole_kv, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, skv, hd), whole_kv, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, hd), q_block, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), row_block, memory_space=pltpu.VMEM),
+        ],
+        interpret=_INTERPRET,
+    )(qg, kg, vg)
+    return _ungroup_q(out, b, h, sq), lse
+
+
+def _flash_attention_bwd_impl(
+    q, k, v, out, lse, g, causal: bool, block_q: int, block_k: int,
+):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, h, hd = q.shape
+    _, skv, kvh, _ = k.shape
+    n_rep = h // kvh
+    scale = hd**-0.5
+
+    qg = _group_q(q, kvh)
+    kg = _group_kv(k)
+    vg = _group_kv(v)
+    dog = _group_q(g, kvh).astype(jnp.float32)
+    og = _group_q(out, kvh).astype(jnp.float32)
+    delta = jnp.sum(dog * og, axis=-1)[:, None, :]  # [b*kvh, 1, n_rep*sq]
+
+    q_block = lambda i, j: (i, j, 0)  # noqa: E731
+    whole_kv = lambda i, j: (i, 0, 0)  # noqa: E731
+    whole_rows = lambda i, j: (i, 0, 0)  # noqa: E731
+    row_block = lambda i, j: (i, 0, j)  # noqa: E731
+    kv_block = lambda i, j: (i, j, 0)  # noqa: E731
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, block_k=block_k, sq=sq, skv=skv,
+            causal=causal, scale=scale,
+        ),
+        out_shape=jax.ShapeDtypeStruct(qg.shape, q.dtype),
+        grid=(kg.shape[0], n_rep * (sq // block_q)),
+        in_specs=[
+            pl.BlockSpec((1, block_q, hd), q_block, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, skv, hd), whole_kv, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, skv, hd), whole_kv, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, hd), q_block, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), row_block, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q), row_block, memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(
-            (1, block_q, hd), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM
+            (1, block_q, hd), q_block, memory_space=pltpu.VMEM
         ),
-    )(qg, _kv_for_groups(kg, n_rep), _kv_for_groups(vg, n_rep))
-    out = out.reshape(b, kvh * n_rep, sq, hd).transpose(0, 2, 1, 3)
-    return out
+        interpret=_INTERPRET,
+    )(qg, kg, vg, dog.astype(q.dtype), lse, delta)
 
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, block_q=block_q, sq=sq, skv=skv,
+            n_rep=n_rep, causal=causal, scale=scale,
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(kg.shape, k.dtype),
+            jax.ShapeDtypeStruct(vg.shape, v.dtype),
+        ],
+        grid=(kg.shape[0], skv // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_k, hd), kv_block, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, hd), kv_block, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n_rep * sq, hd), whole_rows,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n_rep * sq, hd), whole_rows,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, n_rep * sq), whole_rows,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, n_rep * sq), whole_rows,
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, hd), kv_block, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, hd), kv_block, memory_space=pltpu.VMEM),
+        ],
+        interpret=_INTERPRET,
+    )(kg, vg, qg, dog.astype(q.dtype), lse, delta)
 
-def _kv_for_groups(kv: jnp.ndarray, n_rep: int) -> jnp.ndarray:
-    """[b*kvh, skv, hd] -> [b*kvh*n_rep, skv, hd] — a broadcast view the
-    BlockSpec indexes per program; XLA keeps this as a cheap gather."""
-    if n_rep == 1:
-        return kv
-    bkv, skv, hd = kv.shape
-    return jnp.broadcast_to(
-        kv[:, None, :, :], (bkv, n_rep, skv, hd)
-    ).reshape(bkv * n_rep, skv, hd)
+    return (
+        _ungroup_q(dq, b, h, sq),
+        _ungroup_kv(dk, b, kvh),
+        _ungroup_kv(dv, b, kvh),
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash_attention(q, k, v, causal, block_q, block_k):
-    return _flash_attention_fwd_impl(q, k, v, causal, block_q, block_k)
+    out, _ = _flash_attention_fwd_impl(q, k, v, causal, block_q, block_k)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k):
-    out = _flash_attention_fwd_impl(q, k, v, causal, block_q, block_k)
-    return out, (q, k, v)
+    out, lse = _flash_attention_fwd_impl(q, k, v, causal, block_q, block_k)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, residuals, g):
-    # Recompute-based backward through the XLA reference (numerically
-    # identical softmax; flash bwd kernel is a later optimization).
-    q, k, v = residuals
+    q, k, v, out, lse = residuals
+    if q.shape[1] == k.shape[1] and q.shape[1] % block_k == 0:
+        return _flash_attention_bwd_impl(
+            q, k, v, out, lse, g, causal, block_q, block_k
+        )
+    # Shapes the bwd kernels don't cover (decode suffix q, ragged blocks):
+    # recompute through the XLA reference — identical fp32 softmax.
     _, vjp = jax.vjp(lambda q, k, v: reference_attention(q, k, v, causal), q, k, v)
     return vjp(g)
 
@@ -202,17 +411,20 @@ _flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
 def _pallas_ok(q, k, block_q, block_k) -> bool:
-    try:
-        if jax.devices()[0].platform != "tpu":
+    if not _INTERPRET:
+        try:
+            if jax.devices()[0].platform != "tpu":
+                return False
+        except Exception:
             return False
-    except Exception:
-        return False
     b, sq, h, hd = q.shape
     _, skv, kvh, _ = k.shape
+    # hd must fill VPU/MXU lanes (128) or be a clean power-of-two fraction
+    # the tiler pads cheaply (64 covers Llama-class head dims).
     return (
         sq % block_q == 0
         and skv % block_k == 0
-        and hd % 128 == 0
+        and hd % 64 == 0
         and h % kvh == 0
     )
 
