@@ -1,0 +1,205 @@
+"""tpu-multiplex-daemon (MPS control daemon analog) + client tests.
+
+Covers the lease protocol end-to-end over the real unix socket: FIFO
+arbitration, crash-revocation (a dead client can't wedge the chip), the
+readiness check subcommand, env parsing, and the workload-side
+auto_lease() no-op outside multiplexed containers.
+"""
+
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from tpu_dra.plugin.multiplexd import (
+    MultiplexDaemon,
+    SOCKET_NAME,
+    check,
+    parse_env,
+)
+from tpu_dra.workloads.multiplex_client import (
+    Lease,
+    MultiplexClient,
+    auto_lease,
+)
+
+
+@pytest.fixture
+def daemon(tmp_path):
+    d = MultiplexDaemon(
+        str(tmp_path), ["chip-a", "chip-b"],
+        hbm_limits={"chip-a": "8Gi"}, compute_share_pct=50,
+    ).start()
+    yield d
+    d.stop()
+
+
+def test_acquire_release_roundtrip(daemon, tmp_path):
+    c = MultiplexClient(str(tmp_path), client_name="w0")
+    with c.lease() as lease:
+        assert lease.chips == ["chip-a", "chip-b"]
+        assert lease.hbm_limits == {"chip-a": "8Gi"}
+        assert lease.max_hold_seconds == pytest.approx(5.0)  # 50% of 10s
+        assert c.status()["holder"] == "w0"
+    assert c.status()["holder"] is None
+    c.close()
+
+
+def test_fifo_arbitration_two_clients(daemon, tmp_path):
+    order = []
+    c0 = MultiplexClient(str(tmp_path), client_name="w0")
+    c1 = MultiplexClient(str(tmp_path), client_name="w1")
+    c0.acquire()
+
+    got = threading.Event()
+
+    def second():
+        c1.acquire()  # blocks until w0 releases
+        order.append("w1")
+        got.set()
+
+    t = threading.Thread(target=second, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    assert not got.is_set(), "w1 must wait while w0 holds"
+    assert c0.status()["waiting"] == 1
+    order.append("w0-release")
+    c0.release()
+    assert got.wait(timeout=5)
+    assert order == ["w0-release", "w1"]
+    c1.release()
+    c0.close()
+    c1.close()
+
+
+def test_crashed_holder_lease_is_revoked(daemon, tmp_path):
+    c0 = MultiplexClient(str(tmp_path), client_name="crasher")
+    c0.acquire()
+    c0.close()  # simulates process death: socket closes without release
+    c1 = MultiplexClient(str(tmp_path), client_name="survivor")
+    done = threading.Event()
+    threading.Thread(
+        target=lambda: (c1.acquire(), done.set()), daemon=True
+    ).start()
+    assert done.wait(timeout=5), "lease of dead client must be revoked"
+    c1.release()
+    c1.close()
+
+
+def test_queued_client_hangup_is_dropped(daemon, tmp_path):
+    c0 = MultiplexClient(str(tmp_path), client_name="holder")
+    c0.acquire()
+    # A raw connection queues then hangs up without ever being granted.
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.connect(str(tmp_path / SOCKET_NAME))
+    s.sendall(b'{"op": "acquire", "client": "ghost"}\n')
+    time.sleep(0.3)
+    assert c0.status()["waiting"] == 1
+    s.close()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if c0.status()["waiting"] == 0:
+            break
+        time.sleep(0.05)
+    assert c0.status()["waiting"] == 0
+    c0.release()
+    c0.close()
+
+
+def test_release_without_hold_is_refused(daemon, tmp_path):
+    c = MultiplexClient(str(tmp_path), client_name="nobody")
+    resp = c._rpc({"op": "release"})
+    assert resp == {"ok": False}
+    # ...and the client surfaces it instead of swallowing it.
+    with pytest.raises(RuntimeError, match="release refused"):
+        c.release()
+    c.close()
+
+
+def test_colliding_display_names_cannot_steal_leases(daemon, tmp_path):
+    # Two containers in different PID namespaces can both be "pid-7"; the
+    # lease must be keyed by connection, so B's release/death never frees
+    # A's live lease.
+    a = MultiplexClient(str(tmp_path), client_name="pid-7")
+    b = MultiplexClient(str(tmp_path), client_name="pid-7")
+    a.acquire()
+    resp = b._rpc({"op": "release"})  # B never held it
+    assert resp == {"ok": False}
+    assert a.status()["holder"] == "pid-7"
+    b.close()  # B dying must not revoke A either
+    time.sleep(0.3)
+    assert a.status()["holder"] == "pid-7"
+    a.release()
+    a.close()
+
+
+def test_reacquire_while_holding_is_idempotent(daemon, tmp_path):
+    # A holder retrying acquire must get an immediate grant, not deadlock
+    # the queue behind a lease only it could release.
+    c = MultiplexClient(str(tmp_path), client_name="retry")
+    c.acquire()
+    lease = c.acquire()
+    assert lease.chips == ["chip-a", "chip-b"]
+    c.release()
+    c.close()
+
+
+def test_stop_spares_a_successors_socket(tmp_path):
+    # Pod replacement: the successor re-binds the shared hostPath socket
+    # while the predecessor is still terminating; the predecessor's stop()
+    # must not unlink the live socket.
+    import os
+
+    d1 = MultiplexDaemon(str(tmp_path), ["c"])
+    d1.start()
+    os.remove(d1.socket_path)  # successor replaces the filesystem entry
+    d2 = MultiplexDaemon(str(tmp_path), ["c"]).start()
+    d1.stop()
+    assert check(str(tmp_path)) == 0, "successor socket must survive"
+    d2.stop()
+    assert check(str(tmp_path)) == 1
+
+
+def test_check_subcommand(daemon, tmp_path):
+    assert check(str(tmp_path)) == 0
+    assert check(str(tmp_path / "nowhere")) == 1
+
+
+def test_check_fails_after_stop(tmp_path):
+    d = MultiplexDaemon(str(tmp_path), ["c"]).start()
+    assert check(str(tmp_path)) == 0
+    d.stop()
+    assert check(str(tmp_path)) == 1
+
+
+def test_parse_env():
+    cfg = parse_env({
+        "TPU_MULTIPLEX_CHIPS": "u1,u2",
+        "TPU_MULTIPLEX_SOCKET_DIR": "/run/x",
+        "TPU_MULTIPLEX_HBM_LIMITS": "u1=8Gi,u2=4Gi",
+        "TPU_MULTIPLEX_COMPUTE_SHARE_PCT": "25",
+    })
+    assert cfg == {
+        "chips": ["u1", "u2"],
+        "socket_dir": "/run/x",
+        "hbm_limits": {"u1": "8Gi", "u2": "4Gi"},
+        "compute_share_pct": 25,
+    }
+    assert parse_env({})["chips"] == []
+
+
+def test_auto_lease_noop_outside_multiplexed_container():
+    with auto_lease(environ={}) as lease:
+        assert lease is None
+
+
+def test_auto_lease_acquires_in_multiplexed_container(daemon, tmp_path):
+    env = {
+        "TPU_PROCESS_MULTIPLEXING": "true",
+        "TPU_MULTIPLEX_SOCKET_DIR": str(tmp_path),
+    }
+    with auto_lease(environ=env) as lease:
+        assert isinstance(lease, Lease)
+        assert lease.chips == ["chip-a", "chip-b"]

@@ -1,0 +1,295 @@
+"""tpu-multiplex-daemon: the per-claim chip-sharing control daemon.
+
+Reference analog: the MPS control daemon the GPU plugin runs as a
+dynamically-created Deployment (sharing.go:151-440 +
+templates/mps-control-daemon.tmpl.yaml). CUDA MPS funnels kernels from many
+processes through one server; TPUs have no kernel-level equivalent, so the
+TPU-native design is **cooperative lease arbitration**: one daemon per
+shared claim owns the chips and hands out exclusive, bounded leases to
+client processes over a unix socket in the claim's CDI-mounted socket dir.
+Clients (see :mod:`tpu_dra.workloads.multiplex_client`) acquire before
+touching the chip and release after; a client that dies mid-lease is
+detected by its socket closing and the lease is revoked, so a crashed
+workload can never wedge its neighbors.
+
+Protocol: one JSON object per line over ``<socket_dir>/multiplexd.sock``.
+
+  -> {"op": "acquire", "client": "<name>"}
+  <- {"ok": true, "lease": {"chips": [...], "hbmLimits": {...},
+      "maxHoldSeconds": N}}          # blocks until the lease is granted
+  -> {"op": "release"}
+  <- {"ok": true}
+  -> {"op": "status"}
+  <- {"ok": true, "holder": "...", "waiting": N, "chips": [...]}
+
+Config via env (set by the Deployment the plugin renders):
+``TPU_MULTIPLEX_CHIPS`` (comma uuids), ``TPU_MULTIPLEX_SOCKET_DIR``,
+``TPU_MULTIPLEX_HBM_LIMITS`` (uuid=bytes,...), and
+``TPU_MULTIPLEX_COMPUTE_SHARE_PCT`` — the share percentage maps to each
+lease's max-hold budget within a scheduling window, the analog of MPS
+active-thread-percentage.
+
+``tpu-multiplex-daemon check`` probes a running daemon's socket (the
+Deployment's readiness probe).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import signal
+import socket
+import socketserver
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+SOCKET_NAME = "multiplexd.sock"
+# One scheduling window; a lease's max hold is share% of this.
+SCHEDULING_WINDOW_SECONDS = 10.0
+
+
+class LeaseState:
+    """FIFO lease arbiter. One holder at a time; waiters queue in arrival
+    order; a dropped client connection releases its lease/queue slot.
+
+    Identity is the CONNECTION (a daemon-assigned unique id), never the
+    client-supplied display name: containers in separate PID namespaces
+    can collide on names like ``pid-7``, and a name key would let one
+    workload release or revoke another's live lease."""
+
+    def __init__(self, chips: List[str], hbm_limits: Dict[str, str],
+                 compute_share_pct: Optional[int]):
+        self.chips = chips
+        self.hbm_limits = hbm_limits
+        self.compute_share_pct = compute_share_pct
+        self._lock = threading.Lock()
+        self._granted = threading.Condition(self._lock)
+        self._holder: Optional[str] = None
+        self._queue: "deque[str]" = deque()
+        self._names: Dict[str, str] = {}  # conn id -> display name
+
+    def max_hold_seconds(self) -> float:
+        pct = self.compute_share_pct or 100
+        return SCHEDULING_WINDOW_SECONDS * pct / 100.0
+
+    def lease_body(self) -> dict:
+        return {
+            "chips": self.chips,
+            "hbmLimits": self.hbm_limits,
+            "maxHoldSeconds": self.max_hold_seconds(),
+        }
+
+    def acquire(self, conn_id: str, name: str, cancelled) -> bool:
+        """Block until `conn_id` holds the lease; `cancelled()` aborts
+        (client hung up while queued). Re-acquiring while already holding
+        is an idempotent grant — blocking there would deadlock the whole
+        queue (the holder's handler thread could never process the release
+        that frees it)."""
+        with self._granted:
+            self._names[conn_id] = name
+            if self._holder == conn_id:
+                return True
+            self._queue.append(conn_id)
+            while True:
+                if cancelled():
+                    self._drop_locked(conn_id)
+                    return False
+                if self._holder is None and self._queue[0] == conn_id:
+                    self._queue.popleft()
+                    self._holder = conn_id
+                    return True
+                self._granted.wait(timeout=0.2)
+
+    def release(self, conn_id: str) -> bool:
+        with self._granted:
+            if self._holder != conn_id:
+                return False
+            self._holder = None
+            self._granted.notify_all()
+            return True
+
+    def drop(self, conn_id: str) -> None:
+        """Connection died: free whatever the client held or queued."""
+        with self._granted:
+            self._drop_locked(conn_id)
+            self._names.pop(conn_id, None)
+
+    def _drop_locked(self, conn_id: str) -> None:
+        if self._holder == conn_id:
+            self._holder = None
+        try:
+            self._queue.remove(conn_id)
+        except ValueError:
+            pass
+        self._granted.notify_all()
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "holder": (
+                    self._names.get(self._holder, self._holder)
+                    if self._holder
+                    else None
+                ),
+                "waiting": len(self._queue),
+                "chips": self.chips,
+            }
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):  # noqa: A003
+        state: LeaseState = self.server.lease_state  # type: ignore[attr-defined]
+        # The connection IS the identity (unique per handler); the
+        # client-supplied name is display-only.
+        conn_id = f"conn-{id(self)}"
+        touched = False
+        try:
+            for raw in self.rfile:
+                try:
+                    msg = json.loads(raw)
+                except json.JSONDecodeError:
+                    self._send({"ok": False, "error": "bad json"})
+                    continue
+                op = msg.get("op")
+                if op == "acquire":
+                    name = msg.get("client") or conn_id
+                    touched = True
+                    ok = state.acquire(conn_id, name, cancelled=self._conn_dead)
+                    if ok:
+                        self._send({"ok": True, "lease": state.lease_body()})
+                    else:
+                        return
+                elif op == "release":
+                    self._send({"ok": state.release(conn_id)})
+                elif op == "status":
+                    self._send({"ok": True, **state.status()})
+                elif op == "ping":
+                    self._send({"ok": True})
+                else:
+                    self._send({"ok": False, "error": f"unknown op {op!r}"})
+        finally:
+            if touched:
+                state.drop(conn_id)
+
+    def _send(self, obj: dict) -> None:
+        self.wfile.write(json.dumps(obj).encode() + b"\n")
+        self.wfile.flush()
+
+    def _conn_dead(self) -> bool:
+        # While a client is queued, poll its socket: EOF means it hung up
+        # and must not be granted a dead lease.
+        try:
+            self.connection.setblocking(False)
+            try:
+                data = self.connection.recv(1, socket.MSG_PEEK)
+                return data == b""
+            except BlockingIOError:
+                return False
+            finally:
+                self.connection.setblocking(True)
+        except OSError:
+            return True
+
+
+class MultiplexDaemon:
+    def __init__(self, socket_dir: str, chips: List[str],
+                 hbm_limits: Optional[Dict[str, str]] = None,
+                 compute_share_pct: Optional[int] = None):
+        os.makedirs(socket_dir, exist_ok=True)
+        self.socket_dir = socket_dir
+        self.socket_path = os.path.join(socket_dir, SOCKET_NAME)
+        self.state = LeaseState(chips, hbm_limits or {}, compute_share_pct)
+        try:
+            os.remove(self.socket_path)
+        except FileNotFoundError:
+            pass
+
+        class Server(socketserver.ThreadingUnixStreamServer):
+            daemon_threads = True
+
+        self._server = Server(self.socket_path, _Handler)
+        self._server.lease_state = self.state  # type: ignore[attr-defined]
+        # Remember which filesystem entry is OURS: during pod replacement a
+        # successor daemon may have re-bound the same path (shared hostPath
+        # dir); its socket must survive our teardown.
+        self._socket_ino = os.stat(self.socket_path).st_ino
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MultiplexDaemon":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="multiplexd"
+        )
+        self._thread.start()
+        log.info(
+            "multiplex daemon serving %d chips on %s",
+            len(self.state.chips), self.socket_path,
+        )
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        try:
+            if os.stat(self.socket_path).st_ino == self._socket_ino:
+                os.remove(self.socket_path)
+        except FileNotFoundError:
+            pass
+
+
+def check(socket_dir: str) -> int:
+    """Readiness probe: 0 iff a daemon answers a ping on the socket."""
+    path = os.path.join(socket_dir, SOCKET_NAME)
+    try:
+        with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+            s.settimeout(2.0)
+            s.connect(path)
+            s.sendall(b'{"op": "ping"}\n')
+            resp = json.loads(s.makefile().readline())
+            return 0 if resp.get("ok") else 1
+    except (OSError, json.JSONDecodeError, ValueError):
+        return 1
+
+
+def parse_env(environ=os.environ) -> dict:
+    limits: Dict[str, str] = {}
+    raw = environ.get("TPU_MULTIPLEX_HBM_LIMITS", "")
+    for part in raw.split(","):
+        if "=" in part:
+            k, _, v = part.partition("=")
+            limits[k] = v
+    pct_raw = environ.get("TPU_MULTIPLEX_COMPUTE_SHARE_PCT", "")
+    return {
+        "chips": [c for c in environ.get("TPU_MULTIPLEX_CHIPS", "").split(",") if c],
+        "socket_dir": environ.get("TPU_MULTIPLEX_SOCKET_DIR", "/var/run/tpu-multiplex"),
+        "hbm_limits": limits,
+        "compute_share_pct": int(pct_raw) if pct_raw else None,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("tpu-multiplex-daemon")
+    p.add_argument("command", nargs="?", default="run", choices=["run", "check"])
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    cfg = parse_env()
+    if args.command == "check":
+        return check(cfg["socket_dir"])
+    daemon = MultiplexDaemon(
+        cfg["socket_dir"], cfg["chips"], cfg["hbm_limits"],
+        cfg["compute_share_pct"],
+    ).start()
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    daemon.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

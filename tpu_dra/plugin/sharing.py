@@ -118,6 +118,10 @@ class MultiplexControlDaemon:
             },
             "spec": {
                 "replicas": 1,
+                # Old and new daemon pods share one hostPath socket dir on
+                # the pinned node; overlapping them during rollout would
+                # race on the socket path.
+                "strategy": {"type": "Recreate"},
                 "selector": {
                     "matchLabels": {"tpu.google.com/claim-uid": self.claim_uid}
                 },
@@ -132,6 +136,14 @@ class MultiplexControlDaemon:
                                 "name": "multiplex-control-daemon",
                                 "image": self.manager.image,
                                 "command": ["tpu-multiplex-daemon"],
+                                "readinessProbe": {
+                                    "exec": {
+                                        "command": [
+                                            "tpu-multiplex-daemon", "check"
+                                        ]
+                                    },
+                                    "periodSeconds": 2,
+                                },
                                 "env": env,
                                 "volumeMounts": [
                                     {"name": "socket-dir", "mountPath": self.socket_dir()},

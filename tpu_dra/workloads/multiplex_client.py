@@ -1,0 +1,104 @@
+"""Client side of per-process chip multiplexing.
+
+Workload processes in a shared-claim container cooperate through the
+claim's control daemon (:mod:`tpu_dra.plugin.multiplexd`): acquire the
+chip lease before running device work, release it after. CDI injects
+``TPU_MULTIPLEX_SOCKET_DIR`` + ``TPU_PROCESS_MULTIPLEXING=true`` into
+multiplexed containers, so ``auto_lease()`` is a no-op everywhere else —
+workloads can call it unconditionally.
+
+    from tpu_dra.workloads.multiplex_client import auto_lease
+
+    with auto_lease() as lease:
+        ...  # device work; lease is None when not multiplexed
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import socket
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tpu_dra.plugin.multiplexd import SOCKET_NAME
+
+
+@dataclass
+class Lease:
+    chips: List[str] = field(default_factory=list)
+    hbm_limits: Dict[str, str] = field(default_factory=dict)
+    max_hold_seconds: float = 0.0
+
+
+class MultiplexClient:
+    def __init__(self, socket_dir: str, client_name: Optional[str] = None):
+        self.socket_path = os.path.join(socket_dir, SOCKET_NAME)
+        self.client_name = client_name or f"pid-{os.getpid()}"
+        self._sock: Optional[socket.socket] = None
+        self._file = None
+
+    def _rpc(self, msg: dict) -> dict:
+        if self._sock is None:
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._sock.connect(self.socket_path)
+            self._file = self._sock.makefile("rb")
+        self._sock.sendall(json.dumps(msg).encode() + b"\n")
+        line = self._file.readline()
+        if not line:
+            raise ConnectionError("multiplex daemon closed the connection")
+        return json.loads(line)
+
+    def acquire(self) -> Lease:
+        """Blocks until this process holds the chip lease."""
+        resp = self._rpc({"op": "acquire", "client": self.client_name})
+        if not resp.get("ok"):
+            raise RuntimeError(f"lease acquire failed: {resp}")
+        body = resp["lease"]
+        return Lease(
+            chips=body.get("chips", []),
+            hbm_limits=body.get("hbmLimits", {}),
+            max_hold_seconds=body.get("maxHoldSeconds", 0.0),
+        )
+
+    def release(self) -> None:
+        resp = self._rpc({"op": "release"})
+        if not resp.get("ok"):
+            # The daemon no longer considers us the holder (revoked or
+            # double-released) — surface it, silent success would let the
+            # workload re-enter device work on stale assumptions.
+            raise RuntimeError(f"lease release refused: {resp}")
+
+    def status(self) -> dict:
+        return self._rpc({"op": "status"})
+
+    def close(self) -> None:
+        if self._sock is not None:
+            # Closing the connection releases anything we hold server-side.
+            self._sock.close()
+            self._sock = None
+            self._file = None
+
+    @contextlib.contextmanager
+    def lease(self):
+        lease = self.acquire()
+        try:
+            yield lease
+        finally:
+            self.release()
+
+
+@contextlib.contextmanager
+def auto_lease(environ=os.environ):
+    """Hold the chip lease for the block iff this process runs in a
+    multiplexed container; yields the Lease or None."""
+    if environ.get("TPU_PROCESS_MULTIPLEXING") != "true":
+        yield None
+        return
+    client = MultiplexClient(environ["TPU_MULTIPLEX_SOCKET_DIR"])
+    try:
+        with client.lease() as lease:
+            yield lease
+    finally:
+        client.close()
