@@ -39,6 +39,8 @@ from tpu_dra.plugin.device_state import DRIVER_NAME
 from tpu_dra.plugin.dra_service import DRA_SERVICE_NAME
 from tpu_dra.plugin.pb import dra_v1beta1_pb2 as drapb
 
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
 NS = "team-a"
 DRIVER_NS = "tpu-dra-driver"
 
@@ -455,3 +457,178 @@ def test_daemon_crash_failover_and_recovery(stack):
     )
     assert [d.device_name for d in result.devices] == ["channel-1"]
     stack.assert_alive()
+
+
+def test_multiplexed_claim_full_lifecycle(stack):
+    """MPS-analog path across processes: a shared-chip claim prepared over
+    the TPU plugin's gRPC blocks on the control-daemon Deployment; this
+    test plays kubelet (runs the REAL tpu-multiplex-daemon binary from the
+    rendered pod template's env, patches the Deployment Ready), prepare
+    completes with socket-dir CDI edits, and two workload client processes
+    arbitrate the chip through the daemon's socket."""
+    if "tpu-plugin" not in stack.procs:
+        pytest.skip("requires the bringup test to have run in this module")
+    from tpu_dra.k8sclient import DEPLOYMENTS
+
+    kc = stack.kc
+    td = stack.td
+    socket_root = td / "mux"
+    # Restart the TPU plugin with multiplexing enabled + our socket root.
+    proc, logf = stack.procs.pop("tpu-plugin")
+    proc.send_signal(signal.SIGTERM)
+    proc.wait(timeout=15)
+    logf.close()
+    tpu_plugin_data = td / "tpu-plugin"
+    stack.spawn(
+        "tpu-plugin",
+        ["tpu_dra.plugin.main",
+         "--kubeconfig", stack.kubeconfig,
+         "--node-name", "node-0",
+         "--namespace", DRIVER_NS,
+         "--cdi-root", str(td / "cdi"),
+         "--plugin-data-dir", str(tpu_plugin_data),
+         "--kubelet-registrar-dir", str(td / "registry"),
+         "--cdi-hook", "",
+         "--multiplex-socket-root", str(socket_root),
+         "--feature-gates", "MultiplexingSupport=true"],
+        TPU_DRA_BACKEND="stub",
+        TPU_DRA_STUB_CONFIG=stub_cfg(td / "stub-tpu.yaml", "node-0", 0),
+    )
+    wait_for((tpu_plugin_data / "dra.sock").exists, what="plugin socket")
+
+    shared_uid = str(uuid.uuid4())
+    kc.create(RESOURCE_CLAIMS, {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {"name": "shared", "namespace": NS, "uid": shared_uid},
+    })
+    shared = kc.get(RESOURCE_CLAIMS, NS, "shared")
+    shared_uid = shared["metadata"]["uid"]
+    shared["status"] = {
+        "allocation": {
+            "devices": {
+                "results": [{
+                    "request": "r0", "driver": DRIVER_NAME,
+                    "pool": "node-0", "device": "tpu-1",
+                }],
+                "config": [{
+                    "requests": ["r0"],
+                    "opaque": {
+                        "driver": DRIVER_NAME,
+                        "parameters": {
+                            "apiVersion": "resource.tpu.google.com/v1beta1",
+                            "kind": "TpuConfig",
+                            "sharing": {
+                                "strategy": "Multiplexing",
+                                "multiplexingConfig": {
+                                    "defaultComputeSharePercentage": 40,
+                                },
+                            },
+                        },
+                    },
+                    "source": "FromClaim",
+                }],
+            }
+        }
+    }
+    kc.update_status(RESOURCE_CLAIMS, shared)
+
+    # Prepare blocks on daemon readiness; run it in the background while
+    # we play kubelet for the Deployment.
+    import threading
+
+    result_box = {}
+
+    def do_prepare():
+        req = drapb.NodePrepareResourcesRequest()
+        req.claims.append(
+            drapb.Claim(uid=shared_uid, name="shared", namespace=NS)
+        )
+        resp = _rpc(stack.td / "tpu-plugin" / "dra.sock",
+                    "NodePrepareResources", req,
+                    drapb.NodePrepareResourcesResponse, timeout=60)
+        result_box["result"] = resp.claims[shared_uid]
+
+    t = threading.Thread(target=do_prepare, daemon=True)
+    t.start()
+
+    dep = wait_for(
+        lambda: next(iter(kc.list(
+            DEPLOYMENTS, DRIVER_NS,
+            label_selector={"tpu.google.com/claim-uid": shared_uid},
+        )), None),
+        what="multiplex control-daemon Deployment",
+    )
+    assert dep["spec"]["strategy"] == {"type": "Recreate"}
+    container = dep["spec"]["template"]["spec"]["containers"][0]
+    assert container["command"] == ["tpu-multiplex-daemon"]
+    env = {e["name"]: e["value"] for e in container["env"]}
+    assert env["TPU_MULTIPLEX_COMPUTE_SHARE_PCT"] == "40"
+
+    # Play kubelet: run the real daemon binary with the pod's env, then
+    # mark the Deployment Ready.
+    stack.spawn(
+        "multiplexd",
+        ["tpu_dra.plugin.multiplexd"],
+        **{k: v for k, v in env.items()},
+    )
+    wait_for(
+        lambda: os.path.exists(
+            os.path.join(env["TPU_MULTIPLEX_SOCKET_DIR"], "multiplexd.sock")
+        ),
+        what="daemon socket",
+    )
+    dep["status"] = {"readyReplicas": 1, "replicas": 1}
+    kc.update_status(DEPLOYMENTS, dep)
+
+    t.join(timeout=60)
+    assert "result" in result_box, "prepare RPC never returned"
+    result = result_box["result"]
+    assert not result.error, result.error
+    spec_files = [
+        f for f in (td / "cdi").glob("*.json") if shared_uid in f.name
+    ]
+    spec = json.loads(spec_files[0].read_text())
+    envs = [e for d in spec["devices"] for e in d["containerEdits"]["env"]]
+    assert "TPU_PROCESS_MULTIPLEXING=true" in envs
+    mounts = [
+        m for d in spec["devices"]
+        for m in d["containerEdits"].get("mounts", [])
+    ]
+    assert any(str(socket_root) in m["hostPath"] for m in mounts)
+
+    # Two workload processes share the chip through the daemon.
+    client_code = (
+        "import sys, time\n"
+        "sys.path.insert(0, %r)\n"
+        "from tpu_dra.workloads.multiplex_client import MultiplexClient\n"
+        "c = MultiplexClient(sys.argv[1], client_name=sys.argv[2])\n"
+        "with c.lease() as l:\n"
+        "    assert l.max_hold_seconds == 4.0, l\n"
+        "    time.sleep(0.2)\n"
+        "c.close()\n" % str(REPO_ROOT)
+    )
+    import subprocess as sp
+    ps = [
+        sp.Popen([sys.executable, "-c", client_code,
+                  env["TPU_MULTIPLEX_SOCKET_DIR"], f"wl{i}"])
+        for i in range(2)
+    ]
+    assert all(p.wait(30) == 0 for p in ps)
+
+    # Unprepare deletes the Deployment.
+    req = drapb.NodeUnprepareResourcesRequest()
+    req.claims.append(
+        drapb.Claim(uid=shared_uid, name="shared", namespace=NS)
+    )
+    resp = _rpc(stack.td / "tpu-plugin" / "dra.sock",
+                "NodeUnprepareResources", req,
+                drapb.NodeUnprepareResourcesResponse)
+    assert not resp.claims[shared_uid].error
+    wait_for(
+        lambda: not kc.list(
+            DEPLOYMENTS, DRIVER_NS,
+            label_selector={"tpu.google.com/claim-uid": shared_uid},
+        ),
+        what="control-daemon Deployment deletion",
+    )

@@ -56,6 +56,7 @@ class DriverConfig:
     # partitionable slices with shared counters (driver.go:507-540 analog).
     resource_api_version: str = "v1beta1"
     multiplex_image: str = "tpu-dra-driver:latest"
+    multiplex_socket_root: str = "/run/tpu-multiplex"
     start_grpc: bool = True
     # Shipped hook binary staged into plugin_data_dir at startup
     # (setNvidiaCDIHookPath analog); "" or missing file disables hooks.
@@ -86,6 +87,7 @@ class Driver:
             namespace=config.namespace,
             node_name=config.node_name,
             image=config.multiplex_image,
+            socket_root=config.multiplex_socket_root,
         )
         vfio = VfioPciManager()
         self.state = DeviceState(

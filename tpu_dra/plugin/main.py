@@ -68,6 +68,13 @@ def build_parser() -> argparse.ArgumentParser:
         default=flags.env_default("TPU_DRA_CDI_HOOK", "/usr/local/bin/tpu-cdi-hook"),
         help="Shipped tpu-cdi-hook binary to stage into the plugin dir",
     )
+    p.add_argument(
+        "--multiplex-socket-root",
+        default=flags.env_default(
+            "TPU_DRA_MULTIPLEX_SOCKET_ROOT", "/run/tpu-multiplex"
+        ),
+        help="Host dir under which per-claim multiplex socket dirs live",
+    )
     return p
 
 
@@ -97,6 +104,7 @@ def main(argv=None) -> int:
         kubelet_registrar_dir=args.kubelet_registrar_dir,
         resource_api_version=args.resource_api_version,
         cdi_hook_source=args.cdi_hook,
+        multiplex_socket_root=args.multiplex_socket_root,
     )
     driver = Driver(tpulib, backend, config)
     driver.start()
