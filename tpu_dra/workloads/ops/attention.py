@@ -305,6 +305,7 @@ def _flash_attention_fwd_impl(
 
 def _flash_attention_bwd_impl(
     q, k, v, out, lse, g, causal: bool, block_q: int, block_k: int,
+    g_lse=None,
 ):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -320,6 +321,11 @@ def _flash_attention_bwd_impl(
     dog = _group_q(g, kvh).astype(jnp.float32)
     og = _group_q(out, kvh).astype(jnp.float32)
     delta = jnp.sum(dog * og, axis=-1)[:, None, :]  # [b*kvh, 1, n_rep*sq]
+    if g_lse is not None:
+        # A logsumexp cotangent folds into the delta term: dlse/ds_ij =
+        # p_ij, so ds = p*(dp - delta) + g_lse*p = p*(dp - (delta - g_lse))
+        # — both backward kernels stay untouched.
+        delta = delta - g_lse.astype(jnp.float32)
 
     q_block = lambda i, j: (i, j, 0)  # noqa: E731
     whole_kv = lambda i, j: (i, 0, 0)  # noqa: E731
@@ -384,39 +390,100 @@ def _flash_attention_bwd_impl(
     )
 
 
+def _lse_to_bhs(lse, b: int, h: int, sq: int):
+    """Grouped [b*kvh, 1, n_rep*sq] -> public [b, h, sq] (row order is
+    (kvh, n_rep, sq), which flattens exactly to (h, sq))."""
+    return lse.reshape(b, h, sq)
+
+
+def _lse_from_bhs(g_lse, kvh: int):
+    b, h, sq = g_lse.shape
+    return g_lse.reshape(b * kvh, 1, (h // kvh) * sq)
+
+
+def reference_attention_with_lse(q, k, v, causal: bool):
+    """XLA (out, logsumexp[b,h,sq]) — the fallback/oracle for the joint
+    flash primitive."""
+    n_rep = q.shape[2] // k.shape[2]
+    kr = _repeat_kv(k, n_rep)
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, kr, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        sq, skv = q.shape[1], kr.shape[1]
+        mask = (
+            jnp.arange(skv)[None, :]
+            <= (jnp.arange(sq)[:, None] + (skv - sq))
+        )
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+    # Reuse the logits: probs from the already-computed lse, one PV einsum
+    # (identical numerics to reference_attention at half the cost).
+    probs = jnp.exp(logits.astype(jnp.float32) - lse[..., None])
+    vr = _repeat_kv(v, n_rep)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", probs.astype(vr.dtype), vr,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype), lse
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_attention(q, k, v, causal, block_q, block_k):
-    out, _ = _flash_attention_fwd_impl(q, k, v, causal, block_q, block_k)
-    return out
-
-
-def _flash_fwd(q, k, v, causal, block_q, block_k):
+def flash_attention_with_lse(q, k, v, causal, block_q, block_k):
+    """(out, logsumexp[b, h, sq]) with full custom-VJP support for BOTH
+    outputs — the building block for ring attention's chunk merging."""
+    b, sq, h, _ = q.shape
     out, lse = _flash_attention_fwd_impl(q, k, v, causal, block_q, block_k)
-    return out, (q, k, v, out, lse)
+    return out, _lse_to_bhs(lse, b, h, sq)
 
 
-def _flash_bwd(causal, block_q, block_k, residuals, g):
+def _flash_lse_fwd(q, k, v, causal, block_q, block_k):
+    b, sq, h, _ = q.shape
+    out, lse = _flash_attention_fwd_impl(q, k, v, causal, block_q, block_k)
+    return (out, _lse_to_bhs(lse, b, h, sq)), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd(causal, block_q, block_k, residuals, cts):
     q, k, v, out, lse = residuals
+    g_out, g_lse = cts
+    kvh = k.shape[2]
     if q.shape[1] == k.shape[1] and q.shape[1] % block_k == 0:
         return _flash_attention_bwd_impl(
-            q, k, v, out, lse, g, causal, block_q, block_k
+            q, k, v, out, lse, g_out, causal, block_q, block_k,
+            g_lse=_lse_from_bhs(g_lse, kvh),
         )
     # Shapes the bwd kernels don't cover (decode suffix q, ragged blocks):
     # recompute through the XLA reference — identical fp32 softmax.
-    _, vjp = jax.vjp(lambda q, k, v: reference_attention(q, k, v, causal), q, k, v)
-    return vjp(g)
+    _, vjp = jax.vjp(
+        lambda q, k, v: reference_attention_with_lse(q, k, v, causal), q, k, v
+    )
+    return vjp((g_out, g_lse))
 
 
-_flash_attention.defvjp(_flash_fwd, _flash_bwd)
+flash_attention_with_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def _flash_attention(q, k, v, causal, block_q, block_k):
+    # Out-only view: the lse output is simply unused (its cotangent is
+    # zero, which _flash_attention_bwd_impl folds away for free).
+    return flash_attention_with_lse(q, k, v, causal, block_q, block_k)[0]
+
+
+def flash_platform_ok() -> bool:
+    """Can pallas kernels run here? (TPU, or any backend under interpreter
+    mode.) Shared by the attention dispatcher and ring attention."""
+    if _INTERPRET:
+        return True
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
 
 
 def _pallas_ok(q, k, block_q, block_k) -> bool:
-    if not _INTERPRET:
-        try:
-            if jax.devices()[0].platform != "tpu":
-                return False
-        except Exception:
-            return False
+    if not flash_platform_ok():
+        return False
     b, sq, h, hd = q.shape
     _, skv, kvh, _ = k.shape
     # hd must fill VPU/MXU lanes (128) or be a clean power-of-two fraction

@@ -22,10 +22,33 @@ import jax
 import jax.numpy as jnp
 from jax import shard_map
 
-from tpu_dra.workloads.ops.attention import NEG_INF, _repeat_kv
+from tpu_dra.workloads.ops import attention as attn_ops
+from tpu_dra.workloads.ops.attention import (
+    NEG_INF,
+    _repeat_kv,
+    flash_attention_with_lse,
+)
 from tpu_dra.workloads.parallel.context import sequence_parallel_plan
 
 AXIS = "sp"
+
+
+def _pick_block(s: int) -> int:
+    for cand in (256, 128, 64):
+        if s % cand == 0:
+            return cand
+    return 0
+
+
+def _flash_ok(q) -> bool:
+    """Use the pallas flash kernel for the per-chunk work when the local
+    shapes qualify."""
+    b, sq, h, hd = q.shape
+    return (
+        attn_ops.flash_platform_ok()
+        and hd % 64 == 0
+        and _pick_block(sq) > 0
+    )
 
 
 def _partial_attention(q, k, v, mode, m, l, acc):
@@ -61,32 +84,78 @@ def _partial_attention(q, k, v, mode, m, l, acc):
     return m_new, l_new, acc_new
 
 
+def _flash_chunk(q, k_cur, v_cur, mode, lse, acc):
+    """One chunk pair through the pallas flash kernel; partials merge by
+    logsumexp (each flash output is already normalized, so the merged
+    accumulator needs no final division)."""
+    bq, bk = _pick_block(q.shape[1]), _pick_block(k_cur.shape[1])
+
+    def full(q, k, v):
+        return flash_attention_with_lse(q, k, v, False, bq, bk)
+
+    def diag(q, k, v):
+        return flash_attention_with_lse(q, k, v, True, bq, bk)
+
+    def skip(q, k, v):
+        b, sq, h, hd = q.shape
+        return (
+            jnp.zeros(q.shape, q.dtype),
+            jnp.full((b, h, sq), NEG_INF, dtype=jnp.float32),
+        )
+
+    out_c, lse_c = jax.lax.switch(mode, [full, diag, skip], q, k_cur, v_cur)
+    new_lse = jnp.logaddexp(lse, lse_c)
+    w_prev = jnp.exp(lse - new_lse).transpose(0, 2, 1)[..., None]
+    w_cur = jnp.exp(lse_c - new_lse).transpose(0, 2, 1)[..., None]
+    acc = acc * w_prev + out_c.astype(jnp.float32) * w_cur
+    return new_lse, acc
+
+
 def _ring_attention_local(q, k, v, *, axis_name: str, vary_axes: tuple):
-    """Body running per-device under shard_map; q/k/v are local chunks."""
+    """Body running per-device under shard_map; q/k/v are local chunks.
+
+    Per-chunk attention runs the pallas flash kernel on TPU (no
+    s_local × s_local logits materialization — the point of ring attention
+    is that s_local is big) with logsumexp-weighted merging; off-TPU or on
+    non-qualifying shapes it runs the XLA online-softmax path."""
     n = jax.lax.psum(1, axis_name)
     i = jax.lax.axis_index(axis_name)
     b, sq, h, hd = q.shape
+    use_flash = _flash_ok(q)
 
     # Mark the accumulators device-varying so the fori_loop carry types are
     # consistent with the (varying) K/V they merge with under shard_map.
     vary = lambda x: jax.lax.pcast(x, vary_axes, to="varying")  # noqa: E731
-    m0 = vary(jnp.full((b, h, sq), NEG_INF, dtype=jnp.float32))
-    l0 = vary(jnp.zeros((b, h, sq), dtype=jnp.float32))
     acc0 = vary(jnp.zeros((b, sq, h, hd), dtype=jnp.float32))
+    lse0 = vary(jnp.full((b, h, sq), NEG_INF, dtype=jnp.float32))
+    l0 = vary(jnp.zeros((b, h, sq), dtype=jnp.float32))
+
+    n_rep = h // k.shape[2]
 
     def body(t, carry):
         k_cur, v_cur, m, l, acc = carry
         j = (i - t) % n  # chunk id currently held
         mode = jnp.where(j < i, 0, jnp.where(j == i, 1, 2))
-        m, l, acc = _partial_attention(q, k_cur, v_cur, mode, m, l, acc)
+        if use_flash:
+            # GQA is native to the kernel: K/V stay at kvh heads, so the
+            # ring moves (and each device holds) n_rep x fewer K/V bytes.
+            m, acc = _flash_chunk(q, k_cur, v_cur, mode, m, acc)
+        else:
+            m, l, acc = _partial_attention(
+                q, _repeat_kv(k_cur, n_rep), _repeat_kv(v_cur, n_rep),
+                mode, m, l, acc,
+            )
         # Rotate K/V to the next device; after this, we hold chunk (j-1)%n.
         perm = [(s, (s + 1) % n) for s in range(n)]
         k_next = jax.lax.ppermute(k_cur, axis_name, perm)
         v_next = jax.lax.ppermute(v_cur, axis_name, perm)
         return k_next, v_next, m, l, acc
 
-    _, _, m, l, acc = jax.lax.fori_loop(0, n, body, (k, v, m0, l0, acc0))
-    out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    _, _, m, l, acc = jax.lax.fori_loop(0, n, body, (k, v, lse0, l0, acc0))
+    if use_flash:
+        out = acc  # flash partials are pre-normalized; weights sum to 1
+    else:
+        out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
 
 
@@ -108,9 +177,6 @@ def ring_attention(
 
         return attention(q, k, v, causal=True)
     mesh, spec, batch_axes = plan
-    n_rep = q.shape[2] // k.shape[2]
-    k = _repeat_kv(k, n_rep)
-    v = _repeat_kv(v, n_rep)
     fn = shard_map(
         functools.partial(
             _ring_attention_local,
@@ -120,5 +186,8 @@ def ring_attention(
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        # pallas_call outputs can't declare their varying axes, which
+        # check_vma would demand of the flash path.
+        check_vma=False,
     )
     return fn(q, k, v)
