@@ -6,8 +6,10 @@
 //
 //   tpu-cdi-hook create-symlinks --link <target>::<linkpath> ...
 //       stable in-container names for granted chips, e.g.
-//       /dev/tpu0 -> /dev/accel2 (claims grant arbitrary host minors; the
-//       workload sees a dense, zero-based namespace).
+//       /dev/tpu/<device-name> -> /dev/accel2 (claims grant arbitrary host
+//       minors; the spec generator keys each alias by device name — see
+//       cdi.py — because dense per-claim /dev/tpuN numbering would collide
+//       when two claims' specs merge into one container).
 //   tpu-cdi-hook chmod --mode <octal> --path <p> ...
 //       permission fixup of injected device nodes (the analog of the
 //       reference's IMEX-channel chmod edits).

@@ -1034,8 +1034,11 @@ def test_multislice_validation():
 def test_heartbeat_staleness_marks_node_notready(fc, tmp_path):
     """A registration whose liveness heartbeat went stale counts as
     NotReady in the controller's aggregation (crash detection without pod
-    reaping — improvement over the reference); entries without a heartbeat
-    (older drivers) are exempt for upgrade compatibility."""
+    reaping — improvement over the reference). Staleness is measured on
+    the controller's OWN monotonic clock from when it last saw the
+    heartbeat value change — a skewed daemon wall clock must neither
+    falsely mark a live node NotReady nor mask a dead one. Entries
+    without a heartbeat (older drivers) are exempt for upgrade compat."""
     import datetime
 
     from tpu_dra.computedomain.controller.status import StatusManager
@@ -1050,7 +1053,9 @@ def test_heartbeat_staleness_marks_node_notready(fc, tmp_path):
     nodes = sm._derive_nodes(cd)
     assert [n["status"] for n in nodes] == ["Ready", "Ready"]
 
-    # Age daemon-1's heartbeat past the staleness window.
+    # Clock-skew immunity: node-1's daemon stamps a wall-clock time 60s in
+    # the past (its clock runs behind), but the VALUE just changed — the
+    # controller saw a fresh write, so the node stays Ready.
     cliques = ResourceClient(fc, COMPUTE_DOMAIN_CLIQUES)
     for cl in sm.cliques_for(cd):
         for e in cl.get("daemons") or []:
@@ -1060,6 +1065,15 @@ def test_heartbeat_staleness_marks_node_notready(fc, tmp_path):
                 ) - datetime.timedelta(seconds=60)
                 e["lastHeartbeatTime"] = old.strftime("%Y-%m-%dT%H:%M:%SZ")
         cliques.update(cl)
+    statuses = {n["name"]: n["status"] for n in sm._derive_nodes(cd)}
+    assert statuses == {"node-0": "Ready", "node-1": "Ready"}
+
+    # Now the value stops changing: once the controller has observed no
+    # change for node_stale_after on its monotonic clock, the node goes
+    # NotReady — regardless of what the stamp claims.
+    for key, (raw, seen) in list(sm._observed.items()):
+        if key[2] == "node-1":
+            sm._observed[key] = (raw, seen - 60.0)
     statuses = {n["name"]: n["status"] for n in sm._derive_nodes(cd)}
     assert statuses == {"node-0": "Ready", "node-1": "NotReady"}
 
@@ -1076,6 +1090,22 @@ def test_heartbeat_staleness_marks_node_notready(fc, tmp_path):
         n["status"] == "Ready"
         for n in StatusManager(fc, node_stale_after=0)._derive_nodes(cd)
     )
+
+    # A deregistered node's observed-at bookkeeping is pruned.
+    sm2 = StatusManager(fc, node_stale_after=5.0)
+    for cl in sm2.cliques_for(cd):
+        for e in cl.get("daemons") or []:
+            e["lastHeartbeatTime"] = "2026-01-01T00:00:00Z"
+        cliques.update(cl)
+    sm2._derive_nodes(cd)
+    assert len(sm2._observed) == 2
+    for cl in sm2.cliques_for(cd):
+        cl["daemons"] = [
+            e for e in cl.get("daemons") or [] if e["nodeName"] != "node-1"
+        ]
+        cliques.update(cl)
+    sm2._derive_nodes(cd)
+    assert {k[2] for k in sm2._observed} == {"node-0"}
 
 
 def test_heartbeat_refresh_only_when_due(fc, tmp_path):

@@ -97,6 +97,9 @@ class ComputeDomainController:
                 for cd in cds:
                     self._enqueue(cd)
                 self.node_labels.cleanup_stale_labels()
+                self.status.prune_domains(
+                    {cd["metadata"]["uid"] for cd in cds}
+                )
                 n = self.daemonsets.delete_orphans(
                     {cd["metadata"]["uid"] for cd in cds}
                 )

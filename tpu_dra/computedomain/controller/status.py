@@ -12,11 +12,11 @@ longer in any clique) are pruned.
 from __future__ import annotations
 
 import logging
-from typing import Dict, List
+import time
+from typing import Dict, List, Tuple
 
 from tpu_dra.api import CD_STATUS_NOT_READY, CD_STATUS_READY
 from tpu_dra.computedomain import CD_LABEL_KEY
-from tpu_dra.computedomain.daemon.registration import heartbeat_age_seconds
 from tpu_dra.infra import featuregates
 from tpu_dra.k8sclient import (
     COMPUTE_DOMAIN_CLIQUES,
@@ -40,18 +40,50 @@ class StatusManager:
         self.cliques = ResourceClient(backend, COMPUTE_DOMAIN_CLIQUES)
         self.pods = ResourceClient(backend, PODS)
         self.driver_namespace = driver_namespace
-        # A registration whose heartbeat is older than this counts as
-        # NotReady (crash liveness without relying on pod reaping — an
-        # improvement over the reference, see registration.py). Must be
-        # well above the daemons' heartbeat period; <= 0 disables.
+        # A registration whose heartbeat went stale counts as NotReady
+        # (crash liveness without relying on pod reaping — an improvement
+        # over the reference, see registration.py). Staleness is measured
+        # on the CONTROLLER's monotonic clock, from the moment it last saw
+        # the entry's lastHeartbeatTime *value change* — never by comparing
+        # the daemon's wall-clock stamp against ours, which would let
+        # inter-node clock skew falsely mark live nodes NotReady (or mask
+        # dead ones). Must be well above the daemons' heartbeat period;
+        # <= 0 disables.
         self.node_stale_after = node_stale_after
+        # (cd_uid, cliqueID, nodeName) -> (last seen heartbeat value,
+        # monotonic time we first saw that value).
+        self._observed: Dict[Tuple[str, str, str], Tuple[str, float]] = {}
 
-    def _apply_staleness(self, node: dict, entry: dict) -> dict:
-        if self.node_stale_after > 0:
-            age = heartbeat_age_seconds(entry)
-            if age is not None and age > self.node_stale_after:
-                node["status"] = CD_STATUS_NOT_READY
+    def _apply_staleness(self, cd_uid: str, node: dict, entry: dict) -> dict:
+        raw = entry.get("lastHeartbeatTime")
+        if self.node_stale_after <= 0 or not raw:
+            # Heartbeat-less entries (older drivers) stay live for
+            # upgrade compatibility.
+            return node
+        key = (cd_uid, node.get("cliqueID", ""), node.get("name", ""))
+        now = time.monotonic()
+        prev = self._observed.get(key)
+        if prev is None or prev[0] != raw:
+            # New or changed value: the daemon wrote recently → alive.
+            self._observed[key] = (raw, now)
+        elif now - prev[1] > self.node_stale_after:
+            node["status"] = CD_STATUS_NOT_READY
         return node
+
+    def _prune_observed(self, cd_uid: str, live_keys: set) -> None:
+        for key in [
+            k for k in self._observed
+            if k[0] == cd_uid and k not in live_keys
+        ]:
+            del self._observed[key]
+
+    def prune_domains(self, live_cd_uids: set) -> None:
+        """Drop observed-heartbeat bookkeeping for ComputeDomains that no
+        longer exist (a deleted CD is never synced again, so per-CD
+        pruning alone would leak its keys forever). Called from the
+        controller's periodic sync with the full CD list."""
+        for key in [k for k in self._observed if k[0] not in live_cd_uids]:
+            del self._observed[key]
 
     def cliques_for(self, cd: dict) -> List[dict]:
         return self.cliques.list(
@@ -130,13 +162,13 @@ class StatusManager:
         return {"status": status, "nodes": nodes}
 
     def _nodes_from_cliques(self, cd: dict) -> List[dict]:
+        uid = cd["metadata"]["uid"]
         nodes: List[dict] = []
         for clique in self.cliques_for(cd):
-            clique_id = clique["metadata"]["name"].removeprefix(
-                cd["metadata"]["uid"] + "."
-            )
+            clique_id = clique["metadata"]["name"].removeprefix(uid + ".")
             for d in clique.get("daemons") or []:
                 nodes.append(self._apply_staleness(
+                    uid,
                     {
                         "name": d.get("nodeName", ""),
                         "ipAddress": d.get("ipAddress", ""),
@@ -146,16 +178,24 @@ class StatusManager:
                     },
                     d,
                 ))
+        self._prune_observed(
+            uid, {(uid, n["cliqueID"], n["name"]) for n in nodes}
+        )
         nodes.sort(key=lambda n: (n["cliqueID"], n["index"]))
         return nodes
 
     def _nodes_from_status(self, cd: dict) -> List[dict]:
+        uid = cd["metadata"]["uid"]
         live = self._daemon_pod_node_names(cd)
         nodes = [
-            self._apply_staleness(dict(n), n)
+            self._apply_staleness(uid, dict(n), n)
             for n in (cd.get("status") or {}).get("nodes") or []
             if n.get("name") in live
         ]
+        self._prune_observed(
+            uid,
+            {(uid, n.get("cliqueID", ""), n.get("name", "")) for n in nodes},
+        )
         nodes.sort(key=lambda n: (n.get("cliqueID", ""), n.get("index", 0)))
         return nodes
 

@@ -171,41 +171,52 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, *, block_q: int, sq: int, skv: int,
-                          n_rep: int, causal: bool, scale: float):
-    """dK/dV for one (batch*kv_head, kv-block) program: stream the q blocks
-    of all n_rep query heads below the causal frontier, accumulating
-    Pᵀ·dO and dSᵀ·Q across the whole GQA group in-kernel (no fp32
-    per-group gradient buffers or external reduction)."""
+                          dk_ref, dv_ref, acc_dk_ref, acc_dv_ref, *,
+                          block_q: int, chunk_rows: int, num_chunks: int,
+                          sq: int, skv: int, causal: bool, scale: float):
+    """dK/dV for one (batch*kv_head, kv-block, q-chunk) program.
+
+    The grouped q rows (all n_rep query heads of this KV head, rep-major)
+    are tiled through the innermost grid dimension in `chunk_rows`-row
+    chunks, so VMEM holds one chunk of Q/dO at a time — not the whole
+    [n_rep*sq, hd] plane (which overflows VMEM for long GQA sequences).
+    Partial dK/dV accumulate across chunks in fp32 VMEM scratch; the
+    output block is written once, on the last chunk."""
     import jax.experimental.pallas as pl
 
     k_blk = k_ref[0].astype(jnp.float32)
     v_blk = v_ref[0].astype(jnp.float32)
     block_k = k_blk.shape[0]
     ki = pl.program_id(1)
+    t = pl.program_id(2)
     k_start = ki * block_k
 
-    num_q_blocks = sq // block_q
-    if causal:
-        # First q row that can see this kv block: global row == k_start.
-        first_q_row = jnp.maximum(k_start - (skv - sq), 0)
-        qi_start = first_q_row // block_q
-    else:
-        qi_start = 0
-    visible = num_q_blocks - qi_start  # same frontier for every rep
+    @pl.when(t == 0)
+    def _init():
+        acc_dk_ref[...] = jnp.zeros(acc_dk_ref.shape, jnp.float32)
+        acc_dv_ref[...] = jnp.zeros(acc_dv_ref.shape, jnp.float32)
 
-    def body(t, carry):
+    # chunk_rows divides sq, so a chunk never straddles two query heads;
+    # its first row's within-sequence position only needs the mod.
+    seq0 = (t * chunk_rows) % sq
+    num_sub = chunk_rows // block_q
+    if causal:
+        # First within-sequence q row that can see this kv block.
+        first_row = jnp.maximum(k_start - (skv - sq), 0)
+        u_start = jnp.clip((first_row - seq0) // block_q, 0, num_sub)
+    else:
+        u_start = 0
+
+    def body(u, carry):
         acc_dk, acc_dv = carry
-        rep = t // visible
-        qi = qi_start + t % visible
-        row0 = rep * sq + qi * block_q  # q rows laid out rep-major
+        row0 = u * block_q
         q = q_ref[0, pl.ds(row0, block_q), :].astype(jnp.float32) * scale
         do = do_ref[0, pl.ds(row0, block_q), :].astype(jnp.float32)
         lse = lse_ref[0, 0, pl.ds(row0, block_q)]
         delta = delta_ref[0, 0, pl.ds(row0, block_q)]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
         if causal:
-            q_offset = qi * block_q + (skv - sq)
+            q_offset = seq0 + row0 + (skv - sq)
             rows = q_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(cols <= rows, s, NEG_INF)
@@ -217,13 +228,25 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         return acc_dk, acc_dv
 
     zeros = jnp.zeros(k_blk.shape, jnp.float32)
-    acc_dk, acc_dv = jax.lax.fori_loop(
-        0, n_rep * visible, body, (zeros, zeros)
-    )
-    # q was pre-scaled, so dS·Q already carries one factor of scale — which
-    # is exactly dK = scale · dSᵀ·Q_unscaled.
-    dk_ref[0] = acc_dk.astype(dk_ref.dtype)
-    dv_ref[0] = acc_dv.astype(dv_ref.dtype)
+    acc_dk, acc_dv = jax.lax.fori_loop(u_start, num_sub, body, (zeros, zeros))
+    acc_dk_ref[...] += acc_dk
+    acc_dv_ref[...] += acc_dv
+
+    @pl.when(t == num_chunks - 1)
+    def _flush():
+        # q was pre-scaled, so dS·Q already carries one factor of scale —
+        # which is exactly dK = scale · dSᵀ·Q_unscaled.
+        dk_ref[0] = acc_dk_ref[...].astype(dk_ref.dtype)
+        dv_ref[0] = acc_dv_ref[...].astype(dv_ref.dtype)
+
+
+def _pick_chunk_rows(sq: int, block_q: int, target: int = 1024) -> int:
+    """Largest multiple of block_q ≤ target that divides sq (so a chunk of
+    grouped rep-major q rows never straddles two query heads)."""
+    r = max(block_q, (min(sq, target) // block_q) * block_q)
+    while r > block_q and sq % r:
+        r -= block_q
+    return r if sq % r == 0 else block_q
 
 
 def _group_q(x: jnp.ndarray, kvh: int) -> jnp.ndarray:
@@ -329,9 +352,7 @@ def _flash_attention_bwd_impl(
 
     q_block = lambda i, j: (i, j, 0)  # noqa: E731
     whole_kv = lambda i, j: (i, 0, 0)  # noqa: E731
-    whole_rows = lambda i, j: (i, 0, 0)  # noqa: E731
     row_block = lambda i, j: (i, 0, j)  # noqa: E731
-    kv_block = lambda i, j: (i, j, 0)  # noqa: E731
 
     dq = pl.pallas_call(
         functools.partial(
@@ -354,31 +375,41 @@ def _flash_attention_bwd_impl(
         interpret=_INTERPRET,
     )(qg, kg, vg, dog.astype(q.dtype), lse, delta)
 
+    chunk_rows = _pick_chunk_rows(sq, block_q)
+    num_chunks = (n_rep * sq) // chunk_rows
+    kv_block3 = lambda i, j, t: (i, j, 0)  # noqa: E731
+    q_chunk3 = lambda i, j, t: (i, t, 0)  # noqa: E731
+    row_chunk3 = lambda i, j, t: (i, 0, t)  # noqa: E731
+
     dk, dv = pl.pallas_call(
         functools.partial(
-            _flash_bwd_dkv_kernel, block_q=block_q, sq=sq, skv=skv,
-            n_rep=n_rep, causal=causal, scale=scale,
+            _flash_bwd_dkv_kernel, block_q=block_q, chunk_rows=chunk_rows,
+            num_chunks=num_chunks, sq=sq, skv=skv, causal=causal, scale=scale,
         ),
         out_shape=[
             jax.ShapeDtypeStruct(kg.shape, k.dtype),
             jax.ShapeDtypeStruct(vg.shape, v.dtype),
         ],
-        grid=(kg.shape[0], skv // block_k),
+        grid=(kg.shape[0], skv // block_k, num_chunks),
         in_specs=[
-            pl.BlockSpec((1, block_k, hd), kv_block, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, hd), kv_block, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, n_rep * sq, hd), whole_rows,
+            pl.BlockSpec((1, block_k, hd), kv_block3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, hd), kv_block3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, chunk_rows, hd), q_chunk3,
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, n_rep * sq, hd), whole_rows,
+            pl.BlockSpec((1, chunk_rows, hd), q_chunk3,
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, n_rep * sq), whole_rows,
+            pl.BlockSpec((1, 1, chunk_rows), row_chunk3,
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, n_rep * sq), whole_rows,
+            pl.BlockSpec((1, 1, chunk_rows), row_chunk3,
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, hd), kv_block, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, hd), kv_block, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, hd), kv_block3, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, hd), kv_block3, memory_space=pltpu.VMEM),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, hd), jnp.float32),
+            pltpu.VMEM((block_k, hd), jnp.float32),
         ],
         interpret=_INTERPRET,
     )(kg, vg, qg, dog.astype(q.dtype), lse, delta)
@@ -429,16 +460,49 @@ def reference_attention_with_lse(q, k, v, causal: bool):
     return out.astype(q.dtype), lse
 
 
+# The forward and dq kernels pin the whole K/V plane of one KV head in
+# VMEM; past this many bytes of pinned K+V the pallas path must not be
+# chosen (TPU VMEM is ~16 MiB/core; leave headroom for q blocks, outputs
+# and double-buffering).
+_VMEM_KV_BUDGET_BYTES = 8 * 1024 * 1024
+
+
+def flash_vmem_ok(k: jnp.ndarray) -> bool:
+    """True when one KV head's full K+V plane fits the VMEM budget the
+    flash kernels pin per grid program."""
+    _, skv, _, hd = k.shape
+    return 2 * skv * hd * k.dtype.itemsize <= _VMEM_KV_BUDGET_BYTES
+
+
+def _validate_flash_shapes(q, k, block_q, block_k):
+    b, sq, h, hd = q.shape
+    bk, skv, kvh, hdk = k.shape
+    if sq % block_q or skv % block_k:
+        raise ValueError(
+            f"flash attention needs sq % block_q == 0 and skv % block_k == 0;"
+            f" got sq={sq} block_q={block_q} skv={skv} block_k={block_k}"
+            " (trailing rows would be silently uncomputed)"
+        )
+    if h % kvh:
+        raise ValueError(
+            f"query heads ({h}) must be a multiple of kv heads ({kvh})"
+        )
+    if hd % 64 or hd != hdk:
+        raise ValueError(f"head dim must be a multiple of 64; got {hd}/{hdk}")
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def flash_attention_with_lse(q, k, v, causal, block_q, block_k):
     """(out, logsumexp[b, h, sq]) with full custom-VJP support for BOTH
     outputs — the building block for ring attention's chunk merging."""
+    _validate_flash_shapes(q, k, block_q, block_k)
     b, sq, h, _ = q.shape
     out, lse = _flash_attention_fwd_impl(q, k, v, causal, block_q, block_k)
     return out, _lse_to_bhs(lse, b, h, sq)
 
 
 def _flash_lse_fwd(q, k, v, causal, block_q, block_k):
+    _validate_flash_shapes(q, k, block_q, block_k)
     b, sq, h, _ = q.shape
     out, lse = _flash_attention_fwd_impl(q, k, v, causal, block_q, block_k)
     return (out, _lse_to_bhs(lse, b, h, sq)), (q, k, v, out, lse)
@@ -448,7 +512,8 @@ def _flash_lse_bwd(causal, block_q, block_k, residuals, cts):
     q, k, v, out, lse = residuals
     g_out, g_lse = cts
     kvh = k.shape[2]
-    if q.shape[1] == k.shape[1] and q.shape[1] % block_k == 0:
+    if (q.shape[1] == k.shape[1] and q.shape[1] % block_k == 0
+            and flash_vmem_ok(k)):
         return _flash_attention_bwd_impl(
             q, k, v, out, lse, g_out, causal, block_q, block_k,
             g_lse=_lse_from_bhs(g_lse, kvh),
@@ -484,16 +549,13 @@ def flash_platform_ok() -> bool:
 def _pallas_ok(q, k, block_q, block_k) -> bool:
     if not flash_platform_ok():
         return False
-    b, sq, h, hd = q.shape
-    _, skv, kvh, _ = k.shape
-    # hd must fill VPU/MXU lanes (128) or be a clean power-of-two fraction
-    # the tiler pads cheaply (64 covers Llama-class head dims).
-    return (
-        sq % block_q == 0
-        and skv % block_k == 0
-        and hd % 64 == 0
-        and h % kvh == 0
-    )
+    # The dispatcher's contract: any shape the kernels would reject loudly
+    # takes the XLA path instead (one predicate set, not two copies).
+    try:
+        _validate_flash_shapes(q, k, block_q, block_k)
+    except ValueError:
+        return False
+    return flash_vmem_ok(k)
 
 
 def attention(

@@ -40,14 +40,16 @@ def _pick_block(s: int) -> int:
     return 0
 
 
-def _flash_ok(q) -> bool:
+def _flash_ok(q, k) -> bool:
     """Use the pallas flash kernel for the per-chunk work when the local
-    shapes qualify."""
+    shapes qualify (and one KV head's chunk fits the VMEM the kernels
+    pin per grid program)."""
     b, sq, h, hd = q.shape
     return (
         attn_ops.flash_platform_ok()
         and hd % 64 == 0
         and _pick_block(sq) > 0
+        and attn_ops.flash_vmem_ok(k)
     )
 
 
@@ -121,7 +123,7 @@ def _ring_attention_local(q, k, v, *, axis_name: str, vary_axes: tuple):
     n = jax.lax.psum(1, axis_name)
     i = jax.lax.axis_index(axis_name)
     b, sq, h, hd = q.shape
-    use_flash = _flash_ok(q)
+    use_flash = _flash_ok(q, k)
 
     # Mark the accumulators device-varying so the fori_loop carry types are
     # consistent with the (varying) K/V they merge with under shard_map.
