@@ -632,3 +632,160 @@ def test_multiplexed_claim_full_lifecycle(stack):
         ),
         what="control-daemon Deployment deletion",
     )
+
+
+def test_timesliced_claim_rotates_processes(stack):
+    """Time-slicing end-to-end: a ``sharing: timeSlicing`` claim prepared
+    over gRPC provisions the arbiter daemon in time-slice mode (interval
+    ordinal → lease quantum), and two REAL workload processes stepping
+    through maybe_yield() rotate chip ownership at that quantum — the
+    enforcement the reference gets from `nvidia-smi compute-policy
+    --set-timeslice` (nvlib.go:772-815)."""
+    if "tpu-plugin" not in stack.procs:
+        pytest.skip("requires the bringup test to have run in this module")
+    from tpu_dra.k8sclient import DEPLOYMENTS
+
+    kc = stack.kc
+    td = stack.td
+    socket_root = td / "mux-ts"
+    proc, logf = stack.procs.pop("tpu-plugin")
+    proc.send_signal(signal.SIGTERM)
+    proc.wait(timeout=15)
+    logf.close()
+    tpu_plugin_data = td / "tpu-plugin"
+    stack.spawn(
+        "tpu-plugin",
+        ["tpu_dra.plugin.main",
+         "--kubeconfig", stack.kubeconfig,
+         "--node-name", "node-0",
+         "--namespace", DRIVER_NS,
+         "--cdi-root", str(td / "cdi"),
+         "--plugin-data-dir", str(tpu_plugin_data),
+         "--kubelet-registrar-dir", str(td / "registry"),
+         "--cdi-hook", "",
+         "--multiplex-socket-root", str(socket_root),
+         "--feature-gates", "TimeSlicingSettings=true"],
+        TPU_DRA_BACKEND="stub",
+        TPU_DRA_STUB_CONFIG=stub_cfg(td / "stub-tpu.yaml", "node-0", 0),
+    )
+    wait_for((tpu_plugin_data / "dra.sock").exists, what="plugin socket")
+
+    ts_uid = str(uuid.uuid4())
+    kc.create(RESOURCE_CLAIMS, {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {"name": "tsliced", "namespace": NS, "uid": ts_uid},
+    })
+    claim = kc.get(RESOURCE_CLAIMS, NS, "tsliced")
+    ts_uid = claim["metadata"]["uid"]
+    claim["status"] = {
+        "allocation": {
+            "devices": {
+                "results": [{
+                    "request": "r0", "driver": DRIVER_NAME,
+                    "pool": "node-0", "device": "tpu-2",
+                }],
+                "config": [{
+                    "requests": ["r0"],
+                    "opaque": {
+                        "driver": DRIVER_NAME,
+                        "parameters": {
+                            "apiVersion": "resource.tpu.google.com/v1beta1",
+                            "kind": "TpuConfig",
+                            "sharing": {
+                                "strategy": "TimeSlicing",
+                                "timeSlicingConfig": {"interval": "Short"},
+                            },
+                        },
+                    },
+                    "source": "FromClaim",
+                }],
+            }
+        }
+    }
+    kc.update_status(RESOURCE_CLAIMS, claim)
+
+    import threading
+
+    result_box = {}
+
+    def do_prepare():
+        req = drapb.NodePrepareResourcesRequest()
+        req.claims.append(
+            drapb.Claim(uid=ts_uid, name="tsliced", namespace=NS)
+        )
+        resp = _rpc(stack.td / "tpu-plugin" / "dra.sock",
+                    "NodePrepareResources", req,
+                    drapb.NodePrepareResourcesResponse, timeout=60)
+        result_box["result"] = resp.claims[ts_uid]
+
+    t = threading.Thread(target=do_prepare, daemon=True)
+    t.start()
+
+    dep = wait_for(
+        lambda: next(iter(kc.list(
+            DEPLOYMENTS, DRIVER_NS,
+            label_selector={"tpu.google.com/claim-uid": ts_uid},
+        )), None),
+        what="time-slice arbiter Deployment",
+    )
+    container = dep["spec"]["template"]["spec"]["containers"][0]
+    env = {e["name"]: e["value"] for e in container["env"]}
+    assert env["TPU_MULTIPLEX_TIMESLICE_ORDINAL"] == "1"  # Short
+    # Shrink the window so the e2e rotates fast (prod default: 10s).
+    env["TPU_MULTIPLEX_WINDOW_SECONDS"] = "2.0"
+    stack.spawn("multiplexd-ts", ["tpu_dra.plugin.multiplexd"], **env)
+    wait_for(
+        lambda: os.path.exists(
+            os.path.join(env["TPU_MULTIPLEX_SOCKET_DIR"], "multiplexd.sock")
+        ),
+        what="arbiter socket",
+    )
+    dep["status"] = {"readyReplicas": 1, "replicas": 1}
+    kc.update_status(DEPLOYMENTS, dep)
+
+    t.join(timeout=60)
+    assert "result" in result_box, "prepare RPC never returned"
+    assert not result_box["result"].error, result_box["result"].error
+
+    # Two real processes step under the quantum; both must re-acquire
+    # (rotate) at least once — Short on a 2s window is a 0.1s quantum.
+    client_code = (
+        "import sys, time\n"
+        "sys.path.insert(0, %r)\n"
+        "from tpu_dra.workloads.multiplex_client import MultiplexClient\n"
+        "c = MultiplexClient(sys.argv[1], client_name=sys.argv[2])\n"
+        "lease = c.acquire()\n"
+        "assert lease.max_hold_seconds == 0.1, lease\n"
+        "rotations = 0\n"
+        "stop = time.monotonic() + 3.0\n"
+        "while time.monotonic() < stop:\n"
+        "    time.sleep(0.02)\n"
+        "    before = c._acquired_at\n"
+        "    lease = c.maybe_yield(lease)\n"
+        "    if c._acquired_at != before:\n"
+        "        rotations += 1\n"
+        "c.close()\n"
+        "assert rotations >= 1, rotations\n" % str(REPO_ROOT)
+    )
+    import subprocess as sp
+    ps = [
+        sp.Popen([sys.executable, "-c", client_code,
+                  env["TPU_MULTIPLEX_SOCKET_DIR"], f"ts{i}"])
+        for i in range(2)
+    ]
+    assert all(p.wait(30) == 0 for p in ps)
+
+    req = drapb.NodeUnprepareResourcesRequest()
+    req.claims.append(drapb.Claim(uid=ts_uid, name="tsliced", namespace=NS))
+    resp = _rpc(stack.td / "tpu-plugin" / "dra.sock",
+                "NodeUnprepareResources", req,
+                drapb.NodeUnprepareResourcesResponse)
+    assert not resp.claims[ts_uid].error
+    wait_for(
+        lambda: not kc.list(
+            DEPLOYMENTS, DRIVER_NS,
+            label_selector={"tpu.google.com/claim-uid": ts_uid},
+        ),
+        what="arbiter Deployment deletion",
+    )

@@ -108,6 +108,106 @@ def test_queued_client_hangup_is_dropped(daemon, tmp_path):
     c0.close()
 
 
+def test_queued_client_dead_with_buffered_bytes_is_dropped(daemon, tmp_path):
+    """A queued client that pipelined extra bytes and THEN died must not be
+    granted the lease: unread data in the daemon's receive buffer used to
+    hide the EOF from the MSG_PEEK liveness probe (POLLRDHUP sees the
+    hang-up regardless)."""
+    c0 = MultiplexClient(str(tmp_path), client_name="holder")
+    c0.acquire()
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.connect(str(tmp_path / SOCKET_NAME))
+    # Queue, then leave extra unread bytes behind and die.
+    s.sendall(b'{"op": "acquire", "client": "ghost"}\n{"op": "status"}\n')
+    time.sleep(0.3)
+    assert c0.status()["waiting"] == 1
+    s.close()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if c0.status()["waiting"] == 0:
+            break
+        time.sleep(0.05)
+    assert c0.status()["waiting"] == 0, (
+        "dead queued client with buffered bytes was not dropped"
+    )
+    c0.release()
+    # The lease must remain grantable to a live client.
+    c1 = MultiplexClient(str(tmp_path), client_name="next")
+    done = threading.Event()
+    threading.Thread(
+        target=lambda: (c1.acquire(), done.set()), daemon=True
+    ).start()
+    assert done.wait(timeout=5)
+    c1.release()
+    c1.close()
+    c0.close()
+
+
+def test_timeslice_ordinal_sets_lease_quantum(tmp_path):
+    """The time-slice interval ordinal weights the lease max-hold within
+    the scheduling window (the nvidia-smi --set-timeslice analog): Short
+    rotates fastest, Long hands a holder the full window."""
+    quanta = {}
+    for ordinal in (0, 1, 2, 3):
+        d = MultiplexDaemon(
+            str(tmp_path / str(ordinal)), ["chip-a"],
+            timeslice_ordinal=ordinal, window_seconds=10.0,
+        ).start()
+        c = MultiplexClient(str(tmp_path / str(ordinal)), client_name="w")
+        with c.lease() as lease:
+            quanta[ordinal] = lease.max_hold_seconds
+        c.close()
+        d.stop()
+    assert quanta[1] < quanta[0] == quanta[2] < quanta[3]
+    assert quanta[3] == pytest.approx(10.0)  # Long = whole window
+    assert quanta[1] == pytest.approx(0.5)   # Short = 5%
+
+
+def test_timeslice_cooperative_rotation(tmp_path):
+    """Two clients stepping through maybe_yield() rotate the chip at the
+    quantum: each gets the lease repeatedly — a timeSlicing claim
+    measurably changes scheduling, it is not advisory bookkeeping."""
+    d = MultiplexDaemon(
+        str(tmp_path), ["chip-a"], timeslice_ordinal=1, window_seconds=2.0,
+    ).start()  # Short on a 2s window -> 0.1s quantum
+
+    holds = {"a": 0, "b": 0}
+    stop = time.monotonic() + 3.0
+
+    def worker(name):
+        c = MultiplexClient(str(tmp_path), client_name=name)
+        lease = c.acquire()
+        holds[name] += 1
+        while time.monotonic() < stop:
+            time.sleep(0.02)  # a "step" of device work
+            before = c._acquired_at
+            lease = c.maybe_yield(lease)
+            if c._acquired_at != before:
+                holds[name] += 1
+        c.close()
+
+    threads = [
+        threading.Thread(target=worker, args=(n,), daemon=True)
+        for n in holds
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    # Both clients repeatedly re-acquired (rotation), not one hogging.
+    assert holds["a"] >= 3 and holds["b"] >= 3, holds
+
+
+def test_status_reports_hold_accounting(daemon, tmp_path):
+    c = MultiplexClient(str(tmp_path), client_name="w0")
+    with c.lease():
+        st = c.status()
+        assert st["maxHoldSeconds"] == pytest.approx(5.0)
+        assert st["heldSeconds"] >= 0.0
+        assert st["overdue"] is False
+    c.close()
+
+
 def test_release_without_hold_is_refused(daemon, tmp_path):
     c = MultiplexClient(str(tmp_path), client_name="nobody")
     resp = c._rpc({"op": "release"})
@@ -186,8 +286,16 @@ def test_parse_env():
         "socket_dir": "/run/x",
         "hbm_limits": {"u1": "8Gi", "u2": "4Gi"},
         "compute_share_pct": 25,
+        "timeslice_ordinal": None,
+        "window_seconds": 10.0,
     }
     assert parse_env({})["chips"] == []
+    ts = parse_env({
+        "TPU_MULTIPLEX_TIMESLICE_ORDINAL": "1",
+        "TPU_MULTIPLEX_WINDOW_SECONDS": "2.5",
+    })
+    assert ts["timeslice_ordinal"] == 1
+    assert ts["window_seconds"] == 2.5
 
 
 def test_auto_lease_noop_outside_multiplexed_container():

@@ -296,9 +296,34 @@ def test_destroy_unknown_subslices_at_startup(tmp_path):
 # --- opaque configs + sharing ----------------------------------------------
 
 
+def _auto_ready_deployments(backend):
+    """Background thread that marks any created Deployment ready (the
+    fake cluster has no real controller manager)."""
+    import threading
+
+    deployments = ResourceClient(backend, DEPLOYMENTS)
+    w = backend.watch(DEPLOYMENTS)
+
+    def readiness_controller():
+        for ev, obj in w:
+            if ev == "ADDED":
+                obj["status"] = {"readyReplicas": 1}
+                deployments.update_status(obj)
+                return
+
+    t = threading.Thread(target=readiness_controller, daemon=True)
+    t.start()
+    return t
+
+
 def test_time_slicing_config_applied(tmp_path):
+    """A timeSlicing claim is ENFORCED, not bookkept: it provisions the
+    per-claim arbiter daemon in time-slice mode (the ordinal becomes the
+    lease quantum — nvlib.go:772-815 analog) and injects the client env."""
     gates(TimeSlicingSettings=True)
-    state, _ = make_state(tmp_path)
+    backend = FakeCluster()
+    state, _ = make_state(tmp_path, backend=backend)
+    t = _auto_ready_deployments(backend)
     params = {
         "apiVersion": "resource.tpu.google.com/v1beta1",
         "kind": "TpuConfig",
@@ -309,13 +334,28 @@ def test_time_slicing_config_applied(tmp_path):
     }
     claim = make_claim(["tpu-0"], configs=[opaque(params, ["req0"])])
     state.prepare(claim)
+    t.join(timeout=3)
     chip = state.tpulib.chips()[0]
     assert state.tpulib.get_time_slice(chip.uuid) == 3
     spec = state.cdi.read_claim_spec(claim["metadata"]["uid"])
-    assert "TPU_TIMESLICE_ORDINAL=3" in spec["devices"][0]["containerEdits"]["env"]
-    # Unprepare resets to default interval.
+    env = spec["devices"][0]["containerEdits"]["env"]
+    assert "TPU_TIMESLICE_ORDINAL=3" in env
+    # The arbiter daemon Deployment exists and carries the ordinal; the
+    # workload container is pointed at its socket.
+    deployments = ResourceClient(backend, DEPLOYMENTS)
+    deps = deployments.list(namespace="tpu-dra-driver")
+    assert len(deps) == 1
+    dep_env = {
+        e["name"]: e.get("value", "")
+        for e in deps[0]["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    assert dep_env["TPU_MULTIPLEX_TIMESLICE_ORDINAL"] == "3"
+    assert any(v.startswith("TPU_MULTIPLEX_SOCKET_DIR=") for v in env)
+    assert "TPU_PROCESS_MULTIPLEXING=true" in env
+    # Unprepare resets the interval and deletes the arbiter daemon.
     state.unprepare(claim["metadata"]["uid"])
     assert state.tpulib.get_time_slice(chip.uuid) == 0
+    assert deployments.list(namespace="tpu-dra-driver") == []
 
 
 def test_multiplexing_config_spawns_control_daemon(tmp_path):

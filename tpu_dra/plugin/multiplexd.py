@@ -29,6 +29,14 @@ Config via env (set by the Deployment the plugin renders):
 lease's max-hold budget within a scheduling window, the analog of MPS
 active-thread-percentage.
 
+Time-sliced claims run the same daemon in time-slice mode:
+``TPU_MULTIPLEX_TIMESLICE_ORDINAL`` (Default/Short/Medium/Long ordinal
+from the claim's TimeSlicingConfig) sets the lease quantum as a fraction
+of the window — the analog of ``nvidia-smi compute-policy
+--set-timeslice`` — and cooperative clients rotate at the quantum via
+``MultiplexClient.maybe_yield``. ``TPU_MULTIPLEX_WINDOW_SECONDS``
+overrides the window (tests).
+
 ``tpu-multiplex-daemon check`` probes a running daemon's socket (the
 Deployment's readiness probe).
 """
@@ -39,10 +47,13 @@ import argparse
 import json
 import logging
 import os
+import select
 import signal
 import socket
 import socketserver
+import sys
 import threading
+import time
 from collections import deque
 from typing import Dict, List, Optional
 
@@ -51,6 +62,14 @@ log = logging.getLogger(__name__)
 SOCKET_NAME = "multiplexd.sock"
 # One scheduling window; a lease's max hold is share% of this.
 SCHEDULING_WINDOW_SECONDS = 10.0
+
+# Time-slice interval ordinal (api/sharing.py: Default/Short/Medium/Long)
+# -> fraction of the scheduling window one lease may hold while others
+# wait. The TPU analog of `nvidia-smi compute-policy --set-timeslice`
+# (reference nvlib.go:772-815): shorter slices rotate the chip between
+# cooperating processes more often; Long hands each holder the whole
+# window.
+TIMESLICE_WINDOW_FRACTION = {0: 0.25, 1: 0.05, 2: 0.25, 3: 1.0}
 
 
 class LeaseState:
@@ -63,19 +82,32 @@ class LeaseState:
     workload release or revoke another's live lease."""
 
     def __init__(self, chips: List[str], hbm_limits: Dict[str, str],
-                 compute_share_pct: Optional[int]):
+                 compute_share_pct: Optional[int],
+                 timeslice_ordinal: Optional[int] = None,
+                 window_seconds: float = SCHEDULING_WINDOW_SECONDS):
         self.chips = chips
         self.hbm_limits = hbm_limits
         self.compute_share_pct = compute_share_pct
+        self.timeslice_ordinal = timeslice_ordinal
+        self.window_seconds = window_seconds
         self._lock = threading.Lock()
         self._granted = threading.Condition(self._lock)
         self._holder: Optional[str] = None
+        self._hold_started: float = 0.0
+        # When the current holder FIRST had competition (0.0 = uncontended).
+        # A cooperative holder owes a yield within one quantum of
+        # contention — not of the grant: a client alone on the chip
+        # legitimately holds (and locally restarts its quantum) for hours.
+        self._contended_since: float = 0.0
         self._queue: "deque[str]" = deque()
         self._names: Dict[str, str] = {}  # conn id -> display name
 
     def max_hold_seconds(self) -> float:
+        if self.timeslice_ordinal is not None:
+            frac = TIMESLICE_WINDOW_FRACTION.get(self.timeslice_ordinal, 0.25)
+            return self.window_seconds * frac
         pct = self.compute_share_pct or 100
-        return SCHEDULING_WINDOW_SECONDS * pct / 100.0
+        return self.window_seconds * pct / 100.0
 
     def lease_body(self) -> dict:
         return {
@@ -95,6 +127,8 @@ class LeaseState:
             if self._holder == conn_id:
                 return True
             self._queue.append(conn_id)
+            if self._holder is not None and not self._contended_since:
+                self._contended_since = time.monotonic()
             while True:
                 if cancelled():
                     self._drop_locked(conn_id)
@@ -102,6 +136,9 @@ class LeaseState:
                 if self._holder is None and self._queue[0] == conn_id:
                     self._queue.popleft()
                     self._holder = conn_id
+                    now = time.monotonic()
+                    self._hold_started = now
+                    self._contended_since = now if self._queue else 0.0
                     return True
                 self._granted.wait(timeout=0.2)
 
@@ -126,10 +163,15 @@ class LeaseState:
             self._queue.remove(conn_id)
         except ValueError:
             pass
+        if not self._queue:
+            self._contended_since = 0.0
         self._granted.notify_all()
 
     def status(self) -> dict:
         with self._lock:
+            held = (
+                time.monotonic() - self._hold_started if self._holder else 0.0
+            )
             return {
                 "holder": (
                     self._names.get(self._holder, self._holder)
@@ -138,6 +180,21 @@ class LeaseState:
                 ),
                 "waiting": len(self._queue),
                 "chips": self.chips,
+                "heldSeconds": round(held, 3),
+                "maxHoldSeconds": self.max_hold_seconds(),
+                # A cooperative holder owes a yield within one quantum of
+                # CONTENTION (a lone holder restarts its quantum locally
+                # without telling us); overdue surfaces misbehaving
+                # workloads to probes/operators.
+                "overdue": bool(
+                    self._holder
+                    and self._queue
+                    and self._contended_since
+                    and (
+                        time.monotonic()
+                        - max(self._hold_started, self._contended_since)
+                    ) > self.max_hold_seconds()
+                ),
             }
 
 
@@ -160,9 +217,15 @@ class _Handler(socketserver.StreamRequestHandler):
                     name = msg.get("client") or conn_id
                     touched = True
                     ok = state.acquire(conn_id, name, cancelled=self._conn_dead)
-                    if ok:
+                    if not ok:
+                        return
+                    try:
                         self._send({"ok": True, "lease": state.lease_body()})
-                    else:
+                    except OSError:
+                        # The grant raced the client's death: hand the
+                        # lease straight to the next waiter instead of
+                        # waiting out this handler's teardown.
+                        state.release(conn_id)
                         return
                 elif op == "release":
                     self._send({"ok": state.release(conn_id)})
@@ -180,14 +243,44 @@ class _Handler(socketserver.StreamRequestHandler):
         self.wfile.write(json.dumps(obj).encode() + b"\n")
         self.wfile.flush()
 
+    # Peer shut down its write side (close/crash) — visible even while
+    # unread pipelined bytes sit in our receive buffer, where an
+    # MSG_PEEK-for-EOF probe would see data and judge the peer alive.
+    # Linux-only bit (absent from the select module); node plugins run on
+    # Linux, but keep a portable fallback for dev boxes.
+    _POLLRDHUP = 0x2000 if sys.platform.startswith("linux") else 0
+
     def _conn_dead(self) -> bool:
-        # While a client is queued, poll its socket: EOF means it hung up
-        # and must not be granted a dead lease.
+        # While a client is queued, poll its socket: a hung-up peer must
+        # not be granted a dead lease.
+        if not self._POLLRDHUP:
+            return self._conn_dead_peek()
+        try:
+            p = select.poll()
+            p.register(
+                self.connection,
+                self._POLLRDHUP | select.POLLHUP | select.POLLERR,
+            )
+            for _, events in p.poll(0):
+                if events & (
+                    self._POLLRDHUP
+                    | select.POLLHUP
+                    | select.POLLERR
+                    | select.POLLNVAL
+                ):
+                    return True
+            return False
+        except OSError:
+            return True
+
+    def _conn_dead_peek(self) -> bool:
+        # Portable probe: EOF only shows once the buffer drains, so a dead
+        # client with unread pipelined bytes is caught later, at grant
+        # time (the _send OSError path releases immediately).
         try:
             self.connection.setblocking(False)
             try:
-                data = self.connection.recv(1, socket.MSG_PEEK)
-                return data == b""
+                return self.connection.recv(1, socket.MSG_PEEK) == b""
             except BlockingIOError:
                 return False
             finally:
@@ -199,11 +292,17 @@ class _Handler(socketserver.StreamRequestHandler):
 class MultiplexDaemon:
     def __init__(self, socket_dir: str, chips: List[str],
                  hbm_limits: Optional[Dict[str, str]] = None,
-                 compute_share_pct: Optional[int] = None):
+                 compute_share_pct: Optional[int] = None,
+                 timeslice_ordinal: Optional[int] = None,
+                 window_seconds: float = SCHEDULING_WINDOW_SECONDS):
         os.makedirs(socket_dir, exist_ok=True)
         self.socket_dir = socket_dir
         self.socket_path = os.path.join(socket_dir, SOCKET_NAME)
-        self.state = LeaseState(chips, hbm_limits or {}, compute_share_pct)
+        self.state = LeaseState(
+            chips, hbm_limits or {}, compute_share_pct,
+            timeslice_ordinal=timeslice_ordinal,
+            window_seconds=window_seconds,
+        )
         try:
             os.remove(self.socket_path)
         except FileNotFoundError:
@@ -263,11 +362,15 @@ def parse_env(environ=os.environ) -> dict:
             k, _, v = part.partition("=")
             limits[k] = v
     pct_raw = environ.get("TPU_MULTIPLEX_COMPUTE_SHARE_PCT", "")
+    ts_raw = environ.get("TPU_MULTIPLEX_TIMESLICE_ORDINAL", "")
+    win_raw = environ.get("TPU_MULTIPLEX_WINDOW_SECONDS", "")
     return {
         "chips": [c for c in environ.get("TPU_MULTIPLEX_CHIPS", "").split(",") if c],
         "socket_dir": environ.get("TPU_MULTIPLEX_SOCKET_DIR", "/var/run/tpu-multiplex"),
         "hbm_limits": limits,
         "compute_share_pct": int(pct_raw) if pct_raw else None,
+        "timeslice_ordinal": int(ts_raw) if ts_raw else None,
+        "window_seconds": float(win_raw) if win_raw else SCHEDULING_WINDOW_SECONDS,
     }
 
 
@@ -281,7 +384,8 @@ def main(argv=None) -> int:
         return check(cfg["socket_dir"])
     daemon = MultiplexDaemon(
         cfg["socket_dir"], cfg["chips"], cfg["hbm_limits"],
-        cfg["compute_share_pct"],
+        cfg["compute_share_pct"], cfg["timeslice_ordinal"],
+        cfg["window_seconds"],
     ).start()
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())

@@ -80,9 +80,15 @@ class MultiplexControlDaemon:
     def get_id(self) -> str:
         return f"{self.namespace}/{self.name}"
 
-    def deployment(self, config: Optional[MultiplexingConfig]) -> dict:
+    def deployment(
+        self,
+        config: Optional[MultiplexingConfig],
+        timeslice_ordinal: Optional[int] = None,
+    ) -> dict:
         """Render the control-daemon Deployment
-        (templates/mps-control-daemon.tmpl.yaml analog)."""
+        (templates/mps-control-daemon.tmpl.yaml analog). With
+        ``timeslice_ordinal`` the daemon runs in time-slice mode: the
+        ordinal sets its lease quantum (nvlib.go setTimeSlice analog)."""
         uuids = self.devices.tpu_uuids()
         limits: Dict[str, str] = {}
         share_pct = ""
@@ -104,6 +110,13 @@ class MultiplexControlDaemon:
         if share_pct:
             env.append(
                 {"name": "TPU_MULTIPLEX_COMPUTE_SHARE_PCT", "value": share_pct}
+            )
+        if timeslice_ordinal is not None:
+            env.append(
+                {
+                    "name": "TPU_MULTIPLEX_TIMESLICE_ORDINAL",
+                    "value": str(timeslice_ordinal),
+                }
             )
         return {
             "apiVersion": "apps/v1",
@@ -177,8 +190,12 @@ class MultiplexControlDaemon:
     def socket_dir(self) -> str:
         return f"{self.manager.socket_root}/{self.claim_uid}"
 
-    def start(self, config: Optional[MultiplexingConfig]) -> None:
-        dep = self.deployment(config)
+    def start(
+        self,
+        config: Optional[MultiplexingConfig],
+        timeslice_ordinal: Optional[int] = None,
+    ) -> None:
+        dep = self.deployment(config, timeslice_ordinal=timeslice_ordinal)
         existing = self.manager.deployments.try_get(self.name, self.namespace)
         if existing is None:
             self.manager.deployments.create(dep)

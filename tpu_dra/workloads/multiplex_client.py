@@ -19,6 +19,7 @@ import contextlib
 import json
 import os
 import socket
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -55,12 +56,34 @@ class MultiplexClient:
         resp = self._rpc({"op": "acquire", "client": self.client_name})
         if not resp.get("ok"):
             raise RuntimeError(f"lease acquire failed: {resp}")
+        self._acquired_at = time.monotonic()
         body = resp["lease"]
         return Lease(
             chips=body.get("chips", []),
             hbm_limits=body.get("hbmLimits", {}),
             max_hold_seconds=body.get("maxHoldSeconds", 0.0),
         )
+
+    def maybe_yield(self, lease: Lease) -> Lease:
+        """Cooperative time-slice rotation: call between work steps. When
+        this process has held the chip past the lease quantum AND another
+        client is waiting, release and re-acquire (FIFO puts us behind the
+        waiters); otherwise keep the lease. The quantum comes from the
+        claim's time-slice interval (or compute-share %) via the daemon —
+        this is where a ``sharing: timeSlicing`` claim actually changes
+        scheduling behavior."""
+        if lease.max_hold_seconds <= 0:
+            return lease
+        held = time.monotonic() - getattr(self, "_acquired_at", 0.0)
+        if held < lease.max_hold_seconds:
+            return lease
+        if self.status().get("waiting", 0) == 0:
+            # Alone on the chip: restart the quantum rather than paying a
+            # pointless release/acquire round-trip.
+            self._acquired_at = time.monotonic()
+            return lease
+        self.release()
+        return self.acquire()
 
     def release(self) -> None:
         resp = self._rpc({"op": "release"})
