@@ -45,6 +45,27 @@ def read_slice_env(env: Optional[dict] = None) -> SliceEnv:
     )
 
 
+def mesh_config_from_slice_env(
+    se: SliceEnv, chips_per_host: int, tp: int = 1, sp: int = 1
+):
+    """Mesh factorization a CD-bootstrapped trainer should use: the slice
+    axis (DCN, gradient-only traffic) maps to ``dp`` — outermost in
+    mesh.AXES so cross-slice collectives never interleave with ICI ones —
+    and hosts x chips within a slice fill ``fsdp`` (minus any tp/sp the
+    caller claims). Mirrors the scaling-book recipe encoded in
+    parallel/mesh.py."""
+    from tpu_dra.workloads.parallel.mesh import MeshConfig
+
+    total = se.num_processes * chips_per_host * se.num_slices
+    inner, rem = divmod(total, se.num_slices * tp * sp)
+    if rem:
+        raise ValueError(
+            f"cannot factor {total} devices into slices={se.num_slices} "
+            f"tp={tp} sp={sp}"
+        )
+    return MeshConfig(dp=se.num_slices, fsdp=inner, sp=sp, tp=tp)
+
+
 def initialize_from_env(env: Optional[dict] = None) -> SliceEnv:
     """jax.distributed.initialize from the injected bootstrap env (no-op on
     single-host allocations)."""

@@ -48,6 +48,9 @@ class LlamaConfig:
     # fraction of its FLOP cost.
     remat_policy: str = "nothing"  # nothing | dots
     attention_impl: str = "auto"  # auto | pallas | xla | ring | ulysses
+    # Flash-kernel tile sizes (pallas/auto paths); bench-swept.
+    attention_block_q: int = 256
+    attention_block_k: int = 256
 
     @property
     def head_dim(self) -> int:
@@ -150,7 +153,10 @@ class LlamaAttention(nn.Module):
 
             out = ring_attention(q, k, v)
         else:
-            out = attention(q, k, v, causal=True, impl=c.attention_impl)
+            out = attention(
+                q, k, v, causal=True, impl=c.attention_impl,
+                block_q=c.attention_block_q, block_k=c.attention_block_k,
+            )
         out = out.reshape(b, s, c.n_heads * c.head_dim)
         return dense(c.dim, "wo")(out)
 
@@ -270,3 +276,14 @@ def num_params(config: LlamaConfig) -> int:
         + c.dim  # final norm
         + c.dim * c.vocab_size  # lm head
     )
+
+
+def train_flops_per_token(config: LlamaConfig, seq: int) -> float:
+    """Analytic MODEL FLOPs per trained token: 6 FLOPs per matmul
+    parameter (fwd 2, bwd 4) plus the causal-attention score/value
+    matmuls (4*seq*dim fwd at half visibility, tripled for training).
+    Standard MFU accounting — rematerialized recompute does NOT count,
+    so MFU stays comparable across remat policies."""
+    c = config
+    matmul_params = num_params(c) - c.vocab_size * c.dim  # embed lookup isn't a matmul
+    return 6.0 * matmul_params + 6.0 * c.n_layers * c.dim * seq
