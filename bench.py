@@ -437,12 +437,28 @@ def main() -> int:
 
     # Probe once: when a TPU is attachable, every leg must use it — a leg
     # silently falling back to CPU (tiny model, absurd tok/s) must fail
-    # and retry instead of polluting the numbers.
-    probe = subprocess.run(
-        [sys.executable, os.path.abspath(__file__), "--probe"],
-        capture_output=True, text=True, timeout=300,
-    )
-    platform = probe.stdout.strip().splitlines()[-1] if probe.stdout else ""
+    # and retry instead of polluting the numbers. The probe itself gets
+    # the same transient-failure retry the legs do: a probe that failed
+    # (previous process still holding the chip lock) must not silently
+    # disarm the guard.
+    platform = ""
+    for attempt in range(4):
+        probe = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--probe"],
+            capture_output=True, text=True, timeout=300,
+        )
+        lines = probe.stdout.split()
+        if probe.returncode == 0 and lines:
+            platform = lines[-1]
+            break
+        print(
+            f"probe attempt {attempt + 1} failed (rc={probe.returncode}); "
+            f"retrying in 5s",
+            file=sys.stderr,
+        )
+        time.sleep(5)
+    else:
+        raise RuntimeError("platform probe never succeeded")
     if platform in ("tpu", "axon"):
         os.environ["BENCH_REQUIRE_TPU"] = "1"
     print(f"probe: platform={platform!r}", file=sys.stderr)

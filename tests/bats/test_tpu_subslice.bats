@@ -48,3 +48,51 @@ bats::on_failure() {
   echo "$attrs" | grep -q subsliceShape
   echo "$attrs" | grep -q subsliceOrigin
 }
+
+# --- dynmig-parity depth (reference test_gpu_dynmig.bats:55-90) ---
+
+@test "subslice: shared counter sets model the chips" {
+  # Every published sub-slice consumes from a per-chip counter set, so the
+  # scheduler cannot co-allocate overlapping placements.
+  local sets
+  sets="$(kubectl get resourceslices -o json | \
+    jq -r '[.items[] | select(.spec.driver == "tpu.google.com")
+            | .spec.sharedCounters // [] | .[]] | length')"
+  [ "$sets" -gt 0 ]
+  local consumers
+  consumers="$(kubectl get resourceslices -o json | \
+    jq -r '[.items[] | select(.spec.driver == "tpu.google.com")
+            | .spec.devices[] | (.basic // .)
+            | select(.consumesCounters != null)
+            | .consumesCounters[].counterSet] | unique | length')"
+  [ "$consumers" -gt 0 ]
+}
+
+@test "subslice: overlapping second claim is refused while the first is held" {
+  # The RCT-generated claim from tpu-test5 stays ALLOCATED after its pod
+  # succeeds (released only on pod deletion); the scheduler must refuse a
+  # 2x2 claim whose placement consumes the same chip counters.
+  k_apply "${REPO_ROOT}/tests/bats/specs/tpu-subslice-overlap.yaml"
+  run kubectl -n tpu-test5 wait --for=jsonpath='{.status.phase}'=Succeeded \
+    pod/overlap-pod --timeout=30s
+  [ "$status" -ne 0 ]
+}
+
+@test "subslice: releasing the first claim frees its counters" {
+  # Deleting the first pod releases (and GCs) its RCT claim; the counters
+  # it consumed return to the set, so the previously-refused overlap
+  # claim must now allocate, prepare, and run to completion. This is the
+  # end-to-end proof that unprepare gave the silicon back.
+  kubectl -n tpu-test5 delete pod pod --ignore-not-found --timeout=120s
+  for _ in $(seq 1 30); do
+    local held
+    held="$(kubectl -n tpu-test5 get resourceclaims -o json | \
+      jq -r '[.items[] | select(.metadata.name | startswith("pod-"))] | length')"
+    [ "$held" -eq 0 ] && break
+    sleep 2
+  done
+  kubectl -n tpu-test5 wait --for=jsonpath='{.status.phase}'=Succeeded \
+    pod/overlap-pod --timeout=180s
+  kubectl -n tpu-test5 delete pod overlap-pod --ignore-not-found --timeout=60s
+  kubectl -n tpu-test5 delete resourceclaim overlap-claim --ignore-not-found --timeout=60s
+}

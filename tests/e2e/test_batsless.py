@@ -1,0 +1,39 @@
+"""Keep the bats-parity runner green under pytest.
+
+The bats suites (tests/bats/) need kubectl+helm+a cluster; the runner
+(tests/batsless/runner.py) executes the same assertions against the
+fakeserver-backed stack with minihelm-rendered chart objects and REAL
+plugin processes. This wrapper runs it end-to-end and fails on any
+``not ok`` line, so chart/driver drift that would break the cluster e2e
+surfaces here first.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def test_batsless_suites(tmp_path):
+    log = tmp_path / "RUN.log"
+    out = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO_ROOT, "tests", "batsless", "runner.py"),
+            "--log", str(log),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=REPO_ROOT,
+    )
+    sys.stderr.write(out.stdout[-4000:])
+    assert out.returncode == 0, out.stdout[-4000:] + out.stderr[-2000:]
+    text = log.read_text()
+    assert "not ok" not in text
+    # All three suites actually executed.
+    for suite in ("basics:", "tpu:", "subslice:"):
+        assert f"- {suite}" in text

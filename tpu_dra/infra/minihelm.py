@@ -1,0 +1,622 @@
+"""Render the Helm chart without the helm binary.
+
+A small Go-text/template interpreter covering the constructs this repo's
+chart uses (``deployments/helm/tpu-dra-driver``): actions with whitespace
+trimming (``{{-``/``-}}``), ``if``/``else if``/``else``, ``with``,
+``range`` (lists and maps, with ``$k, $v :=``), ``define``/``include``,
+variables, pipelines, and the sprig/helm functions the templates call
+(default, quote, trim, trunc, trimSuffix, printf, replace, contains,
+toYaml, nindent, indent, list, append, join, eq/ne/gt, int, not, and, or,
+has, fail), plus ``.Capabilities.APIVersions.Has``.
+
+Why it exists: the reference drives its e2e suites through ``helm
+upgrade -i`` against a live cluster (tests/bats/helpers.sh analog). This
+environment has no helm and no cluster, so the fakeserver-backed runner
+(tests/batsless/) renders the chart here and applies the objects to the
+fake apiserver — same manifests, same assertions. The renderer is NOT a
+general helm replacement; unknown constructs raise loudly.
+
+CLI: ``python -m tpu_dra.infra.minihelm template CHART_DIR [--set a.b=v]...``
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+import yaml
+
+
+class TemplateError(Exception):
+    pass
+
+
+# --- values plumbing --------------------------------------------------------
+
+
+def deep_merge(base: dict, overlay: dict) -> dict:
+    out = dict(base)
+    for k, v in overlay.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def parse_set(expr: str) -> dict:
+    """``a.b.c=v`` -> nested dict, with helm-style scalar coercion."""
+    path, _, raw = expr.partition("=")
+    val: Any = raw
+    if raw in ("true", "false"):
+        val = raw == "true"
+    elif re.fullmatch(r"-?\d+", raw):
+        val = int(raw)
+    elif raw == "null":
+        val = None
+    out: dict = {}
+    cur = out
+    keys = path.split(".")
+    for k in keys[:-1]:
+        cur[k] = {}
+        cur = cur[k]
+    cur[keys[-1]] = val
+    return out
+
+
+class Capabilities:
+    def __init__(self, api_versions: Optional[List[str]] = None):
+        self.APIVersions = _APIVersions(api_versions or [])
+
+
+class _APIVersions:
+    def __init__(self, versions: List[str]):
+        self._versions = set(versions)
+
+    def Has(self, v: str) -> bool:  # noqa: N802 (Go-template name)
+        return v in self._versions
+
+
+# --- lexer / parser ---------------------------------------------------------
+
+_ACTION_RE = re.compile(r"\{\{(-?)\s*(.*?)\s*(-?)\}\}", re.S)
+
+
+def _lex(src: str) -> List[Tuple[str, str]]:
+    """[(kind, payload)]: kind 'text' or 'action' (payload = inner expr)."""
+    out: List[Tuple[str, str]] = []
+    pos = 0
+    for m in _ACTION_RE.finditer(src):
+        text = src[pos : m.start()]
+        if m.group(1) == "-":
+            text = text.rstrip()
+        out.append(("text", text))
+        out.append(("action", m.group(2)))
+        pos = m.end()
+        if m.group(3) == "-":
+            # consume following whitespace incl. one newline run
+            rest = src[pos:]
+            stripped = rest.lstrip()
+            pos += len(rest) - len(stripped)
+    out.append(("text", src[pos:]))
+    return out
+
+
+class Node:
+    pass
+
+
+class Text(Node):
+    def __init__(self, s: str):
+        self.s = s
+
+
+class Action(Node):
+    def __init__(self, expr: str):
+        self.expr = expr
+
+
+class Block(Node):
+    """if / with / range with branches [(cond_expr, children)], else last."""
+
+    def __init__(self, kind: str, arms: List[Tuple[Optional[str], list]]):
+        self.kind = kind
+        self.arms = arms
+
+
+def _parse(tokens: List[Tuple[str, str]], defines: Dict[str, list]) -> list:
+    """Token stream -> node list; collects define blocks into ``defines``."""
+
+    def parse_nodes(i: int, terminators: Tuple[str, ...]):
+        nodes: list = []
+        while i < len(tokens):
+            kind, payload = tokens[i]
+            if kind == "text":
+                if payload:
+                    nodes.append(Text(payload))
+                i += 1
+                continue
+            expr = payload
+            if expr.startswith("/*"):
+                i += 1
+                continue
+            word = expr.split(None, 1)[0] if expr else ""
+            if word in terminators:
+                return nodes, i
+            if word == "define":
+                name = _unquote(expr.split(None, 1)[1])
+                body, i = parse_nodes(i + 1, ("end",))
+                defines[name] = body
+                i += 1  # consume end
+                continue
+            if word in ("if", "with", "range"):
+                arms: List[Tuple[Optional[str], list]] = []
+                cond = expr.split(None, 1)[1]
+                children, i = parse_nodes(i + 1, ("else", "end"))
+                arms.append((cond, children))
+                while tokens[i][1].split(None, 1)[0] == "else":
+                    rest = tokens[i][1].split(None, 1)
+                    sub = rest[1] if len(rest) > 1 else ""
+                    if sub.startswith("if"):
+                        cond = sub.split(None, 1)[1]
+                        children, i = parse_nodes(i + 1, ("else", "end"))
+                        arms.append((cond, children))
+                    else:
+                        children, i = parse_nodes(i + 1, ("end",))
+                        arms.append((None, children))
+                        break
+                i += 1  # consume end
+                nodes.append(Block(word, arms))
+                continue
+            nodes.append(Action(expr))
+            i += 1
+        return nodes, i
+
+    nodes, i = parse_nodes(0, ())
+    return nodes
+
+
+def _unquote(s: str) -> str:
+    s = s.strip()
+    if len(s) >= 2 and s[0] == '"' and s[-1] == '"':
+        return s[1:-1]
+    return s
+
+
+# --- expression evaluation --------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<str>"(?:[^"\\]|\\.)*")
+  | (?P<num>-?\d+(?:\.\d+)?)
+  | (?P<var>\$[A-Za-z0-9_]*)
+  | (?P<field>\.[A-Za-z0-9_.]*)
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_.]*)
+  | (?P<lpar>\()
+  | (?P<rpar>\))
+  | (?P<pipe>\|)
+  | (?P<comma>,)
+  | (?P<assign>:=|=)
+""",
+    re.X,
+)
+
+
+def _tokenize_expr(expr: str) -> List[Tuple[str, str, int]]:
+    """(kind, text, start) — start offsets let the parser distinguish the
+    adjacent chain ``$x.field`` from two arguments ``$x .field``."""
+    out = []
+    pos = 0
+    while pos < len(expr):
+        if expr[pos].isspace():
+            pos += 1
+            continue
+        m = _TOKEN_RE.match(expr, pos)
+        if not m:
+            raise TemplateError(f"cannot tokenize: {expr[pos:]!r}")
+        out.append((m.lastgroup, m.group(), m.start()))
+        pos = m.end()
+    return out
+
+
+def _truthy(v: Any) -> bool:
+    if v is None or v is False:
+        return False
+    if isinstance(v, (int, float)) and v == 0:
+        return False
+    if isinstance(v, (str, list, dict)) and len(v) == 0:
+        return False
+    return True
+
+
+def _go_str(v: Any) -> str:
+    if v is None:
+        return ""
+    if v is True:
+        return "true"
+    if v is False:
+        return "false"
+    return str(v)
+
+
+class Vars:
+    """Lexically-scoped template variables: ``:=`` declares in the current
+    scope, ``=`` assigns to the nearest enclosing declaration (Go template
+    semantics — a range body's ``$x = ...`` must survive the iteration)."""
+
+    def __init__(self, parent: Optional["Vars"] = None, initial=None):
+        self.parent = parent
+        self.map: Dict[str, Any] = dict(initial or {})
+
+    def get(self, name: str) -> Any:
+        scope: Optional[Vars] = self
+        while scope is not None:
+            if name in scope.map:
+                return scope.map[name]
+            scope = scope.parent
+        return None
+
+    def declare(self, name: str, value: Any) -> None:
+        self.map[name] = value
+
+    def assign(self, name: str, value: Any) -> None:
+        scope: Optional[Vars] = self
+        while scope is not None:
+            if name in scope.map:
+                scope.map[name] = value
+                return
+            scope = scope.parent
+        self.map[name] = value
+
+
+class Renderer:
+    def __init__(self, defines: Dict[str, list]):
+        self.defines = defines
+
+    # --- functions ---
+
+    def _fn(self, name: str, args: List[Any], dot: Any) -> Any:
+        if name == "include":
+            tpl, idot = args[0], args[1]
+            body = self.defines.get(tpl)
+            if body is None:
+                raise TemplateError(f"include of unknown template {tpl!r}")
+            return self.render_nodes(body, idot, Vars(initial={"$": idot})).strip("\n")
+        if name == "default":
+            return args[1] if _truthy(args[1]) else args[0]
+        if name == "quote":
+            return '"' + _go_str(args[0]).replace('"', '\\"') + '"'
+        if name == "trim":
+            return _go_str(args[0]).strip()
+        if name == "trunc":
+            n = int(args[0])
+            return _go_str(args[1])[:n]
+        if name == "trimSuffix":
+            s = _go_str(args[1])
+            return s[: -len(args[0])] if s.endswith(args[0]) else s
+        if name == "replace":
+            return _go_str(args[2]).replace(args[0], args[1])
+        if name == "contains":
+            return args[0] in _go_str(args[1])
+        if name == "printf":
+            fmt = re.sub(r"%v", "%s", args[0])
+            return fmt % tuple(
+                _go_str(a) if isinstance(a, (bool, type(None))) else a
+                for a in args[1:]
+            )
+        if name == "toYaml":
+            return yaml.safe_dump(args[0], default_flow_style=False).strip()
+        if name == "nindent":
+            pad = " " * int(args[0])
+            return "\n" + "\n".join(
+                pad + line if line else line
+                for line in _go_str(args[1]).splitlines()
+            )
+        if name == "indent":
+            pad = " " * int(args[0])
+            return "\n".join(
+                pad + line if line else line
+                for line in _go_str(args[1]).splitlines()
+            )
+        if name == "list":
+            return list(args)
+        if name == "append":
+            return list(args[0]) + [args[1]]
+        if name == "join":
+            return args[0].join(_go_str(x) for x in args[1])
+        if name == "dict":
+            return {args[i]: args[i + 1] for i in range(0, len(args), 2)}
+        if name == "has":
+            return args[0] in args[1]
+        if name == "eq":
+            return args[0] == args[1]
+        if name == "ne":
+            return args[0] != args[1]
+        if name == "gt":
+            return args[0] > args[1]
+        if name == "lt":
+            return args[0] < args[1]
+        if name == "int":
+            return int(args[0] or 0)
+        if name == "not":
+            return not _truthy(args[0])
+        if name == "and":
+            cur: Any = True
+            for a in args:
+                cur = a
+                if not _truthy(a):
+                    return a
+            return cur
+        if name == "or":
+            for a in args:
+                if _truthy(a):
+                    return a
+            return args[-1] if args else None
+        if name == "fail":
+            raise TemplateError(f"chart fail: {args[0]}")
+        if name == "trimAll":
+            return _go_str(args[1]).strip(args[0])
+        if name == "upper":
+            return _go_str(args[0]).upper()
+        if name == "lower":
+            return _go_str(args[0]).lower()
+        raise TemplateError(f"unknown template function {name!r}")
+
+    # --- expression eval ---
+
+    def _field(self, obj: Any, path: str) -> Any:
+        for part in [p for p in path.split(".") if p]:
+            if obj is None:
+                return None
+            if isinstance(obj, dict):
+                obj = obj.get(part)
+            else:
+                obj = getattr(obj, part, None)
+        return obj
+
+    def eval_expr(self, expr: str, dot: Any, vars: Dict[str, Any]) -> Any:
+        tokens = _tokenize_expr(expr)
+        val, pos = self._eval_pipeline(tokens, 0, dot, vars)
+        if pos != len(tokens):
+            raise TemplateError(f"trailing tokens in {expr!r}")
+        return val
+
+    def _eval_pipeline(self, tokens, pos, dot, vars):
+        val, pos = self._eval_command(tokens, pos, dot, vars, piped=None)
+        while pos < len(tokens) and tokens[pos][0] == "pipe":
+            val, pos = self._eval_command(tokens, pos + 1, dot, vars, piped=val)
+        return val, pos
+
+    def _eval_command(self, tokens, pos, dot, vars, piped):
+        """A command: term term* (function call) or a single value.
+        ``piped`` is appended as the last argument (Go pipe semantics)."""
+        kind, text, _ = tokens[pos]
+        # Function call: identifier followed by args (or with piped input).
+        if kind == "ident" and text not in ("true", "false", "nil"):
+            name = text
+            pos += 1
+            args = []
+            while pos < len(tokens) and tokens[pos][0] not in (
+                "pipe",
+                "rpar",
+                "comma",
+            ):
+                a, pos = self._eval_term(tokens, pos, dot, vars)
+                args.append(a)
+            if piped is not None:
+                args.append(piped)
+            return self._fn(name, args, dot), pos
+        # Plain term (no function): piped value must not also be present
+        # except for bare method-style fields like .Capabilities...Has.
+        val, pos = self._eval_term(tokens, pos, dot, vars)
+        if callable(val):
+            args = []
+            while pos < len(tokens) and tokens[pos][0] not in (
+                "pipe",
+                "rpar",
+                "comma",
+            ):
+                a, pos = self._eval_term(tokens, pos, dot, vars)
+                args.append(a)
+            if piped is not None:
+                args.append(piped)
+            return val(*args), pos
+        return val, pos
+
+    def _eval_term(self, tokens, pos, dot, vars):
+        kind, text, start = tokens[pos]
+        if kind == "str":
+            return text[1:-1].replace('\\"', '"'), pos + 1
+        if kind == "num":
+            return (float(text) if "." in text else int(text)), pos + 1
+        if kind == "var":
+            base = vars.get(text)
+            # An ADJACENT field token is a $x.field chain; with whitespace
+            # between, it is the next argument instead.
+            if (
+                pos + 1 < len(tokens)
+                and tokens[pos + 1][0] == "field"
+                and tokens[pos + 1][2] == start + len(text)
+            ):
+                return self._field(base, tokens[pos + 1][1]), pos + 2
+            return base, pos + 1
+        if kind == "field":
+            return self._field(dot, text), pos + 1
+        if kind == "ident":
+            if text == "true":
+                return True, pos + 1
+            if text == "false":
+                return False, pos + 1
+            if text == "nil":
+                return None, pos + 1
+            # Zero-arg function in term position (e.g. inside parens).
+            return self._fn(text, [], dot), pos + 1
+        if kind == "lpar":
+            val, pos = self._eval_pipeline(tokens, pos + 1, dot, vars)
+            if tokens[pos][0] != "rpar":
+                raise TemplateError("unbalanced parens")
+            return val, pos + 1
+        raise TemplateError(f"unexpected token {text!r}")
+
+    # --- node rendering ---
+
+    def render_nodes(self, nodes: list, dot: Any, vars: Dict[str, Any]) -> str:
+        out: List[str] = []
+        for node in nodes:
+            if isinstance(node, Text):
+                out.append(node.s)
+            elif isinstance(node, Action):
+                out.append(self._render_action(node.expr, dot, vars))
+            elif isinstance(node, Block):
+                out.append(self._render_block(node, dot, vars))
+        return "".join(out)
+
+    def _render_action(self, expr: str, dot: Any, vars: Dict[str, Any]) -> str:
+        # Assignments render nothing.
+        m = re.match(r"^(\$[A-Za-z0-9_]+)\s*(:=|=)\s*(.*)$", expr, re.S)
+        if m:
+            value = self.eval_expr(m.group(3), dot, vars)
+            if m.group(2) == ":=":
+                vars.declare(m.group(1), value)
+            else:
+                vars.assign(m.group(1), value)
+            return ""
+        return _go_str(self.eval_expr(expr, dot, vars))
+
+    def _render_block(self, block: Block, dot: Any, vars: Dict[str, Any]) -> str:
+        if block.kind == "if":
+            for cond, children in block.arms:
+                if cond is None or _truthy(self.eval_expr(cond, dot, vars)):
+                    return self.render_nodes(children, dot, vars)
+            return ""
+        if block.kind == "with":
+            cond, children = block.arms[0]
+            val = self.eval_expr(cond, dot, vars)
+            if _truthy(val):
+                return self.render_nodes(children, val, vars)
+            for arm_cond, children in block.arms[1:]:
+                if arm_cond is None:
+                    return self.render_nodes(children, dot, vars)
+            return ""
+        if block.kind == "range":
+            cond, children = block.arms[0]
+            m = re.match(
+                r"^(\$[A-Za-z0-9_]+)\s*,\s*(\$[A-Za-z0-9_]+)\s*:=\s*(.*)$",
+                cond,
+                re.S,
+            )
+            out = []
+            if m:
+                kvar, vvar, src = m.group(1), m.group(2), m.group(3)
+                coll = self.eval_expr(src, dot, vars) or {}
+                items = (
+                    sorted(coll.items())
+                    if isinstance(coll, dict)
+                    else list(enumerate(coll))
+                )
+                for k, v in items:
+                    sub = Vars(parent=vars)
+                    sub.declare(kvar, k)
+                    sub.declare(vvar, v)
+                    out.append(self.render_nodes(children, v, sub))
+            else:
+                coll = self.eval_expr(cond, dot, vars) or []
+                items = (
+                    [v for _, v in sorted(coll.items())]
+                    if isinstance(coll, dict)
+                    else coll
+                )
+                for v in items:
+                    out.append(self.render_nodes(children, v, Vars(parent=vars)))
+            if not out and len(block.arms) > 1 and block.arms[-1][0] is None:
+                return self.render_nodes(block.arms[-1][1], dot, vars)
+            return "".join(out)
+        raise TemplateError(f"unknown block {block.kind}")
+
+
+# --- chart-level API --------------------------------------------------------
+
+
+def render_chart(
+    chart_dir: str,
+    values_overrides: Optional[dict] = None,
+    release_name: str = "tpu-dra-driver",
+    namespace: str = "tpu-dra-driver",
+    api_versions: Optional[List[str]] = None,
+    include_crds: bool = True,
+) -> List[dict]:
+    """Render every template + CRD into parsed manifest dicts."""
+    with open(os.path.join(chart_dir, "values.yaml")) as f:
+        values = yaml.safe_load(f) or {}
+    for ov in values_overrides or []:
+        values = deep_merge(values, ov)
+    with open(os.path.join(chart_dir, "Chart.yaml")) as f:
+        chart_meta = yaml.safe_load(f) or {}
+
+    dot = {
+        "Values": values,
+        "Chart": {
+            "Name": chart_meta.get("name", os.path.basename(chart_dir)),
+            "Version": str(chart_meta.get("version", "0")),
+            "AppVersion": str(chart_meta.get("appVersion", "0")),
+        },
+        "Release": {
+            "Name": release_name,
+            "Namespace": namespace,
+            "Service": "Helm",
+        },
+        "Capabilities": Capabilities(api_versions),
+    }
+
+    tdir = os.path.join(chart_dir, "templates")
+    defines: Dict[str, list] = {}
+    parsed = {}
+    for fname in sorted(os.listdir(tdir)):
+        if not fname.endswith((".yaml", ".tpl")):
+            continue
+        with open(os.path.join(tdir, fname)) as f:
+            parsed[fname] = _parse(_lex(f.read()), defines)
+
+    renderer = Renderer(defines)
+    docs: List[dict] = []
+    if include_crds:
+        crd_dir = os.path.join(chart_dir, "crds")
+        if os.path.isdir(crd_dir):
+            for fname in sorted(os.listdir(crd_dir)):
+                with open(os.path.join(crd_dir, fname)) as f:
+                    docs.extend(d for d in yaml.safe_load_all(f) if d)
+    for fname, nodes in parsed.items():
+        if fname.endswith(".tpl"):
+            continue
+        text = renderer.render_nodes(nodes, dot, Vars(initial={"$": dot}))
+        for doc in yaml.safe_load_all(text):
+            if doc:
+                docs.append(doc)
+    return docs
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("minihelm")
+    p.add_argument("command", choices=["template"])
+    p.add_argument("chart")
+    p.add_argument("--set", action="append", default=[], dest="sets")
+    p.add_argument("--namespace", default="tpu-dra-driver")
+    p.add_argument("--api-versions", action="append", default=[])
+    p.add_argument("--skip-crds", action="store_true")
+    args = p.parse_args(argv)
+    docs = render_chart(
+        args.chart,
+        values_overrides=[parse_set(s) for s in args.sets],
+        namespace=args.namespace,
+        api_versions=args.api_versions,
+        include_crds=not args.skip_crds,
+    )
+    sys.stdout.write(yaml.safe_dump_all(docs, sort_keys=False))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -20,8 +20,10 @@ from tpu_dra.k8sclient.resources import (  # noqa: F401
     COMPUTE_DOMAIN_CLIQUES,
     COMPUTE_DOMAINS,
     CONFIG_MAPS,
+    CUSTOM_RESOURCE_DEFINITIONS,
     DAEMON_SETS,
     DEPLOYMENTS,
+    DEVICE_CLASSES,
     LEASES,
     NODES,
     PODS,

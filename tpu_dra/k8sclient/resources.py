@@ -73,6 +73,19 @@ RESOURCE_CLAIM_TEMPLATES = ResourceDescriptor(
 RESOURCE_SLICES = ResourceDescriptor(
     "resource.k8s.io", "v1beta1", "resourceslices", "ResourceSlice", namespaced=False
 )
+DEVICE_CLASSES = ResourceDescriptor(
+    "resource.k8s.io", "v1beta1", "deviceclasses", "DeviceClass", namespaced=False
+)
+
+# Cluster-scoped install surface (chart-applied objects the batsless
+# runner and tests assert on, matching `kubectl get crd ...`).
+CUSTOM_RESOURCE_DEFINITIONS = ResourceDescriptor(
+    "apiextensions.k8s.io",
+    "v1",
+    "customresourcedefinitions",
+    "CustomResourceDefinition",
+    namespaced=False,
+)
 
 # Our CRDs.
 COMPUTE_DOMAINS = ResourceDescriptor(

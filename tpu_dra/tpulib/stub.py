@@ -10,6 +10,9 @@ Config schema::
     generation: v5p            # v4 | v5e | v5p | v6e
     chips: 4                   # chips on this host
     hostname: host-0
+    state_dir: /var/lib/...    # persist sub-slices across restarts (the
+                               # stub's "runtime introspection" surface —
+                               # startup obliteration needs it)
     slice:                     # omit for a single-host node
       uuid: 1f0e...            # pod-slice UUID (fabric identity)
       partition: 0
@@ -89,6 +92,7 @@ class StubTpuLib(BaseTpuLib):
                 topology=parse_topology(sl.get("topology", "2x2x1")),
             )
             self._worker_id = int(sl.get("worker_id", 0))
+        state_dir = state_dir or config.get("state_dir") or None
         self._chips: List[ChipInfo] = []
         for i in range(n):
             # Host-local coords fill x-fastest within the host extent.
