@@ -634,6 +634,114 @@ def test_multiplexed_claim_full_lifecycle(stack):
     )
 
 
+def test_webhook_tls_process(stack):
+    """The REAL webhook binary serving HTTPS with a generated cert pair:
+    an AdmissionReview round-trips over TLS (valid config allowed,
+    invalid config denied with a message) — cmd/webhook/main.go:112-124
+    + main_test.go:52-456 analog, over an actual OS process."""
+    import socket
+    import ssl
+    import urllib.request
+
+    pytest.importorskip("cryptography")
+    from tpu_dra.webhook.certs import generate_self_signed
+
+    td = stack.td
+    cert, key = generate_self_signed(
+        str(td / "wh.crt"), str(td / "wh.key")
+    )
+    ctx = ssl.create_default_context(cafile=cert)
+
+    # bind-close-reuse can race another process onto the port; respawn on
+    # a fresh one instead of burning the readiness timeout.
+    url = None
+    for _ in range(3):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        proc = stack.spawn(
+            "webhook",
+            ["tpu_dra.webhook.main",
+             "--port", str(port),
+             "--tls-cert-file", cert,
+             "--tls-private-key-file", key,
+             "--feature-gates", "TimeSlicingSettings=true"],
+        )
+        candidate = f"https://127.0.0.1:{port}"
+
+        def ready():
+            if proc.poll() is not None:
+                return "died"
+            try:
+                with urllib.request.urlopen(
+                    candidate + "/readyz", context=ctx
+                ) as r:
+                    return "up" if r.status == 200 else None
+            except Exception:
+                return None
+
+        state = wait_for(ready, what="webhook TLS readiness")
+        if state == "up":
+            url = candidate
+            break
+        stack.procs.pop("webhook")[1].close()  # lost the port race; retry
+    assert url, "webhook never came up on a free port"
+
+    def review(params):
+        body = json.dumps({
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {
+                "uid": "e2e-uid",
+                "resource": {
+                    "group": "resource.k8s.io",
+                    "version": "v1beta1",
+                    "resource": "resourceclaims",
+                },
+                "object": {
+                    "apiVersion": "resource.k8s.io/v1beta1",
+                    "kind": "ResourceClaim",
+                    "spec": {"devices": {"config": [{
+                        "opaque": {
+                            "driver": DRIVER_NAME,
+                            "parameters": params,
+                        }
+                    }]}},
+                },
+            },
+        }).encode()
+        req = urllib.request.Request(
+            url + "/validate-resource-claim-parameters",
+            data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, context=ctx) as resp:
+            return json.loads(resp.read())["response"]
+
+    ok = review({
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "TpuConfig",
+        "sharing": {
+            "strategy": "TimeSlicing",
+            "timeSlicingConfig": {"interval": "Short"},
+        },
+    })
+    assert ok["allowed"] is True, ok
+
+    denied = review({
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "TpuConfig",
+        "sharing": {
+            "strategy": "TimeSlicing",
+            "timeSlicingConfig": {"interval": "Bogus"},
+        },
+    })
+    assert denied["allowed"] is False
+    assert "interval" in denied["status"]["message"]
+    # Teardown via the module-scoped stack fixture's stop_all.
+
+
 def test_timesliced_claim_rotates_processes(stack):
     """Time-slicing end-to-end: a ``sharing: timeSlicing`` claim prepared
     over gRPC provisions the arbiter daemon in time-slice mode (interval

@@ -68,6 +68,84 @@ class TestCDIHandler:
         spec = h.read_claim_spec("u")
         assert "hooks" not in spec["devices"][0]["containerEdits"]
 
+    def test_dev_edits_cache_hit_expiry_and_invalidation(self, tmp_path):
+        """cdi.go:125-193 analog: per-device base edits cache with expiry;
+        a changed device fingerprint rebuilds instead of serving stale."""
+        h = CDIHandler(cdi_root=str(tmp_path), driver_version="v1",
+                       dev_edits_ttl=60.0)
+        calls = []
+        orig = h._build_device_edits
+
+        def counting(name, paths, env):
+            calls.append(name)
+            return orig(name, paths, env)
+
+        h._build_device_edits = counting
+        e1 = h.device_edits("tpu-0", ["/dev/accel0"], {"A": "1"})
+        e2 = h.device_edits("tpu-0", ["/dev/accel0"], {"A": "1"})
+        assert e1 == e2 and calls == ["tpu-0"]  # cached
+        # Mutating the returned edits must not poison the cache.
+        e1["env"].append("EVIL=1")
+        assert "EVIL=1" not in h.device_edits(
+            "tpu-0", ["/dev/accel0"], {"A": "1"}
+        )["env"]
+        # Changed inputs -> a separate variant entry; the original stays
+        # cached (a time-sliced claim must not evict the warmed exclusive
+        # entry).
+        h.device_edits("tpu-0", ["/dev/accel0"], {"A": "2"})
+        assert calls == ["tpu-0", "tpu-0"]
+        h.device_edits("tpu-0", ["/dev/accel0"], {"A": "1"})
+        assert calls == ["tpu-0", "tpu-0"]  # original still a hit
+        # Expiry -> rebuild.
+        h._dev_edits["tpu-0"] = {
+            k: (0.0, e) for k, (exp, e) in h._dev_edits["tpu-0"].items()
+        }
+        h.device_edits("tpu-0", ["/dev/accel0"], {"A": "2"})
+        assert calls == ["tpu-0", "tpu-0", "tpu-0"]
+        # The per-device variant set is bounded.
+        for i in range(10):
+            h.device_edits("tpu-0", ["/dev/accel0"], {"A": str(100 + i)})
+        assert len(h._dev_edits["tpu-0"]) <= h.dev_edits_variants
+
+    def test_warmup_dev_spec_cache(self, tmp_path):
+        h = CDIHandler(cdi_root=str(tmp_path), driver_version="v1")
+        n = h.warmup_dev_spec_cache([
+            ("tpu-0", ["/dev/accel0"], {"TPU_VISIBLE_DEVICES": "0"}),
+            ("tpu-1", ["/dev/accel1"], {"TPU_VISIBLE_DEVICES": "1"}),
+        ])
+        assert n == 2
+        calls = []
+        h._build_device_edits = lambda *a: calls.append(a)  # must not fire
+        spec_env = h.device_edits(
+            "tpu-1", ["/dev/accel1"], {"TPU_VISIBLE_DEVICES": "1"}
+        )["env"]
+        assert spec_env == ["TPU_VISIBLE_DEVICES=1"] and calls == []
+
+    def test_group_edits_overlay_does_not_corrupt_cache(self, tmp_path):
+        """A claim with sharing edits overlays group env on the CACHED base
+        edits; the next exclusive claim for the same device must not see
+        the sharing env."""
+        h = CDIHandler(cdi_root=str(tmp_path), driver_version="v1")
+        shared = make_prepared(
+            {"tpu-0": ["/dev/accel0"]}, env={"TPU_VISIBLE_DEVICES": "0"}
+        )
+        shared[0].config_state.container_edits = {
+            "env": {"TPU_PROCESS_MULTIPLEXING": "true"},
+            "mounts": [{"hostPath": "/m", "containerPath": "/m"}],
+        }
+        h.create_claim_spec_file("u-shared", shared)
+        edits = h.read_claim_spec("u-shared")["devices"][0]["containerEdits"]
+        assert "TPU_PROCESS_MULTIPLEXING=true" in edits["env"]
+        assert edits["mounts"]
+
+        exclusive = make_prepared(
+            {"tpu-0": ["/dev/accel0"]}, env={"TPU_VISIBLE_DEVICES": "0"}
+        )
+        h.create_claim_spec_file("u-excl", exclusive)
+        edits = h.read_claim_spec("u-excl")["devices"][0]["containerEdits"]
+        assert "TPU_PROCESS_MULTIPLEXING=true" not in edits["env"]
+        assert "mounts" not in edits
+
     def test_symlink_hooks_are_per_device_and_name_keyed(self, tmp_path):
         # Hooks must live on each device, not the spec: a container
         # referencing only one request of a multi-request claim must not

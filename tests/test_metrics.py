@@ -1,0 +1,41 @@
+"""Metrics registry: prometheus text rendering + summary quantiles."""
+
+from tpu_dra.infra.metrics import TIMING_WINDOW, Metrics
+
+
+def test_counters_gauges_render():
+    m = Metrics()
+    m.inc("prepare_total")
+    m.inc("prepare_total")
+    m.set_gauge("allocatable_devices", 4, labels={"node": "n0"})
+    text = m.render()
+    assert "tpu_dra_prepare_total 2.0" in text
+    assert 'tpu_dra_allocatable_devices{node="n0"} 4' in text
+
+
+def test_summary_quantiles_rendered():
+    m = Metrics()
+    for i in range(100):
+        m.observe("prepare_seconds", (i + 1) / 1000.0)
+    assert abs(m.quantile("prepare_seconds", 0.5) - 0.050) < 0.002
+    assert abs(m.quantile("prepare_seconds", 0.99) - 0.099) < 0.002
+    text = m.render()
+    assert 'tpu_dra_prepare_seconds{quantile="0.5"}' in text
+    assert 'tpu_dra_prepare_seconds{quantile="0.9"}' in text
+    assert 'tpu_dra_prepare_seconds{quantile="0.99"}' in text
+    assert "tpu_dra_prepare_seconds_count 100" in text
+
+
+def test_timing_window_bounded():
+    m = Metrics()
+    for i in range(TIMING_WINDOW + 500):
+        m.observe("t", float(i))
+    assert len(m._timing_recent["t"]) == TIMING_WINDOW
+    # Quantiles reflect the recent window (old observations dropped).
+    assert m.quantile("t", 0.0) == 500.0
+    # Cumulative sum/count keep the full history.
+    assert m._timing_count["t"] == TIMING_WINDOW + 500
+
+
+def test_quantile_empty_series():
+    assert Metrics().quantile("nope", 0.5) is None

@@ -157,6 +157,49 @@ def test_readyz(webhook_url):
         assert resp.read() == b"ok"
 
 
+def test_tls_round_trip(tmp_path):
+    """The HTTPS path the chart deploys (main.go:112-124 analog): a real
+    TLS handshake against a generated serving cert, with the client
+    pinning it as CA — not just the bare handler."""
+    import ssl
+
+    pytest.importorskip("cryptography")
+    from tpu_dra.webhook.certs import generate_self_signed
+
+    cert, key = generate_self_signed(
+        str(tmp_path / "tls.crt"), str(tmp_path / "tls.key")
+    )
+    server = make_server(0, cert_file=cert, key_file=key)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        url = f"https://127.0.0.1:{server.server_address[1]}"
+        ctx = ssl.create_default_context(cafile=cert)
+        resource, obj = claim_with_configs(
+            "v1beta1", opaque_config(valid_tpu_config())
+        )
+        gates(TimeSlicingSettings=True)
+        body = json.dumps(admission_review(resource, obj)).encode()
+        req = urllib.request.Request(
+            url + "/validate-resource-claim-parameters",
+            data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, context=ctx) as resp:
+            out = json.loads(resp.read())
+        assert out["response"]["allowed"] is True
+        # Unpinned client must fail the handshake (proves TLS is real).
+        with pytest.raises(urllib.error.URLError):
+            urllib.request.urlopen(
+                url + "/readyz", context=ssl.create_default_context()
+            )
+    finally:
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=5)
+
+
 def test_unknown_path_404(webhook_url):
     req = urllib.request.Request(
         webhook_url + "/nope", data=b"{}", method="POST",

@@ -13,6 +13,21 @@ import threading
 from typing import Dict, Optional, Tuple
 
 
+# Observations kept per timing series for quantile estimation; enough for
+# stable p50/p90/p99 over the recent window without unbounded memory.
+TIMING_WINDOW = 1000
+
+QUANTILES = (0.5, 0.9, 0.99)
+
+
+def _quantile_from_sorted(recent: list, q: float) -> Optional[float]:
+    """Nearest-rank quantile over an ascending-sorted sample."""
+    if not recent:
+        return None
+    idx = min(len(recent) - 1, max(0, round(q * (len(recent) - 1))))
+    return recent[idx]
+
+
 class Metrics:
     def __init__(self, prefix: str = "tpu_dra"):
         self.prefix = prefix
@@ -21,6 +36,7 @@ class Metrics:
         self._gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
         self._timing_sum: Dict[str, float] = {}
         self._timing_count: Dict[str, int] = {}
+        self._timing_recent: Dict[str, list] = {}
 
     @staticmethod
     def _key(name: str, labels: Optional[Dict[str, str]]):
@@ -39,6 +55,16 @@ class Metrics:
         with self._lock:
             self._timing_sum[name] = self._timing_sum.get(name, 0.0) + seconds
             self._timing_count[name] = self._timing_count.get(name, 0) + 1
+            recent = self._timing_recent.setdefault(name, [])
+            recent.append(seconds)
+            if len(recent) > TIMING_WINDOW:
+                del recent[: len(recent) - TIMING_WINDOW]
+
+    def quantile(self, name: str, q: float) -> Optional[float]:
+        """q-quantile over the recent observation window (None if empty)."""
+        with self._lock:
+            recent = sorted(self._timing_recent.get(name, []))
+        return _quantile_from_sorted(recent, q)
 
     def render(self) -> str:
         out = []
@@ -51,6 +77,13 @@ class Metrics:
                 out.append(f"{self.prefix}_{name}{self._fmt(labels)} {v}")
             for name in sorted(self._timing_sum):
                 out.append(f"# TYPE {self.prefix}_{name} summary")
+                recent = sorted(self._timing_recent.get(name, []))
+                for q in QUANTILES:
+                    v = _quantile_from_sorted(recent, q)
+                    if v is not None:
+                        out.append(
+                            f'{self.prefix}_{name}{{quantile="{q}"}} {v}'
+                        )
                 out.append(f"{self.prefix}_{name}_sum {self._timing_sum[name]}")
                 out.append(f"{self.prefix}_{name}_count {self._timing_count[name]}")
         return "\n".join(out) + "\n"

@@ -20,13 +20,16 @@ numbering could not guarantee.
 
 from __future__ import annotations
 
+import copy
 import json
 import logging
 import os
 import re
 import shutil
 import stat
-from typing import Dict, List, Optional
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
 
 from tpu_dra.plugin.allocatable import AllocatableDevice, VFIO_DEVICE_TYPE
 from tpu_dra.plugin.prepared import PreparedDevices
@@ -68,6 +71,7 @@ class CDIHandler:
         cdi_root: str = "/var/run/cdi",
         driver_version: str = "",
         hook_path: Optional[str] = None,
+        dev_edits_ttl: float = 600.0,
     ):
         self.cdi_root = cdi_root
         os.makedirs(cdi_root, exist_ok=True)
@@ -77,6 +81,17 @@ class CDIHandler:
             driver_version = version_string()
         self.driver_version = driver_version
         self.hook_path = hook_path
+        # Expiring per-device base-edits cache (cdi.go:125-193 analog):
+        # a device's nodes/env/hook edits are claim-independent, so churny
+        # claim turnover reuses them instead of re-deriving per prepare.
+        # Keyed by (device, inputs-fingerprint) with a small per-device
+        # bound: claim VARIANTS of one device (a time-slice ordinal in the
+        # env, multi-device visibility rewrites) get their own entries
+        # instead of evicting the warmed exclusive-claim entry.
+        self.dev_edits_ttl = dev_edits_ttl
+        self.dev_edits_variants = 4
+        self._dev_edits: Dict[str, Dict[str, Tuple[float, dict]]] = {}
+        self._dev_edits_lock = threading.Lock()
 
     # --- naming conventions (cdi.go GetClaimDeviceName) ---
 
@@ -88,6 +103,75 @@ class CDIHandler:
 
     def spec_path(self, claim_uid: str) -> str:
         return os.path.join(self.cdi_root, f"{CDI_VENDOR}-claim_{claim_uid}.json")
+
+    # --- per-device base edits (cached) ---
+
+    def _build_device_edits(
+        self, dev_name: str, dev_paths: List[str], runtime_env: Dict[str, str]
+    ) -> dict:
+        edits: Dict[str, object] = {}
+        if dev_paths:
+            edits["deviceNodes"] = [{"path": p} for p in dev_paths]
+        if runtime_env:
+            edits["env"] = [
+                f"{k}={v}" for k, v in sorted(runtime_env.items())
+            ]
+        accel = [p for p in dev_paths if _ACCEL_RE.match(p)]
+        if self.hook_path and accel:
+            # Aliases keyed by the node-unique device name: a chip belongs
+            # to at most one prepared device (overlap defense), so hooks
+            # from several claims never fight over a link path.
+            links = []
+            for j, p in enumerate(accel):
+                alias = (
+                    f"/dev/tpu/{dev_name}"
+                    if len(accel) == 1
+                    else f"/dev/tpu/{dev_name}-{j}"
+                )
+                links += ["--link", f"{p}::{alias}"]
+            edits["hooks"] = [
+                {
+                    "hookName": "createContainer",
+                    "path": self.hook_path,
+                    "args": [CDI_HOOK_NAME, "create-symlinks"] + links,
+                }
+            ]
+        return edits
+
+    def device_edits(
+        self, dev_name: str, dev_paths: List[str], runtime_env: Dict[str, str]
+    ) -> dict:
+        """Base containerEdits for one device, via the expiring cache."""
+        key = json.dumps(
+            [sorted(dev_paths), sorted(runtime_env.items())], sort_keys=True
+        )
+        now = time.monotonic()
+        with self._dev_edits_lock:
+            variants = self._dev_edits.get(dev_name, {})
+            ent = variants.get(key)
+            if ent is not None and ent[0] > now:
+                return copy.deepcopy(ent[1])
+        edits = self._build_device_edits(dev_name, dev_paths, runtime_env)
+        with self._dev_edits_lock:
+            variants = self._dev_edits.setdefault(dev_name, {})
+            variants[key] = (now + self.dev_edits_ttl, copy.deepcopy(edits))
+            while len(variants) > self.dev_edits_variants:
+                # Drop the entry closest to expiry (oldest insert).
+                oldest = min(variants, key=lambda k: variants[k][0])
+                del variants[oldest]
+        return edits
+
+    def warmup_dev_spec_cache(
+        self, devices: Iterable[Tuple[str, List[str], Dict[str, str]]]
+    ) -> int:
+        """Pre-render base edits for (name, dev_paths, runtime_env) triples
+        at startup (WarmupDevSpecCache analog, cdi.go:151); returns the
+        number of entries warmed."""
+        n = 0
+        for dev_name, dev_paths, runtime_env in devices:
+            self.device_edits(dev_name, dev_paths, runtime_env)
+            n += 1
+        return n
 
     # --- spec generation ---
 
@@ -110,37 +194,15 @@ class CDIHandler:
             group_env = dict(group.config_state.container_edits.get("env", {}))
             group_mounts = list(group.config_state.container_edits.get("mounts", []))
             for pd in group.devices:
-                env = dict(pd.runtime_env)
-                env.update(group_env)
-                edits: Dict[str, object] = {}
-                if pd.dev_paths:
-                    edits["deviceNodes"] = [{"path": p} for p in pd.dev_paths]
-                if env:
+                edits = self.device_edits(
+                    pd.device.device_name, list(pd.dev_paths), dict(pd.runtime_env)
+                )
+                if group_env:
+                    env = dict(pd.runtime_env)
+                    env.update(group_env)
                     edits["env"] = [f"{k}={v}" for k, v in sorted(env.items())]
                 if group_mounts:
                     edits["mounts"] = group_mounts
-                accel = [p for p in pd.dev_paths if _ACCEL_RE.match(p)]
-                if self.hook_path and accel:
-                    # Aliases keyed by the node-unique device name: a chip
-                    # belongs to at most one prepared device (overlap
-                    # defense), so hooks from several claims never fight
-                    # over a link path.
-                    dev_name = pd.device.device_name
-                    links = []
-                    for j, p in enumerate(accel):
-                        alias = (
-                            f"/dev/tpu/{dev_name}"
-                            if len(accel) == 1
-                            else f"/dev/tpu/{dev_name}-{j}"
-                        )
-                        links += ["--link", f"{p}::{alias}"]
-                    edits["hooks"] = [
-                        {
-                            "hookName": "createContainer",
-                            "path": self.hook_path,
-                            "args": [CDI_HOOK_NAME, "create-symlinks"] + links,
-                        }
-                    ]
                 devices.append(
                     {
                         "name": self.claim_device_name(

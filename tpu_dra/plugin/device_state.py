@@ -94,6 +94,31 @@ class DeviceState:
         self.pool_name = pool_name or node_name
         self._lock = threading.Lock()
         self.allocatable = self._enumerate_allocatable()
+        warmed = self.cdi.warmup_dev_spec_cache(self._warmup_entries())
+        log.debug("warmed %d CDI dev-spec cache entries", warmed)
+
+    def _warmup_entries(self):
+        """(name, dev_paths, runtime_env) for every allocatable device
+        whose base CDI edits are derivable up front (WarmupDevSpecCache
+        analog, cdi.go:151): full chips + static sub-slices. Dynamic
+        sub-slices materialize at Prepare; vfio edits come from the vfio
+        manager at Configure time."""
+        for dev in self.allocatable.values():
+            if dev.type == TPU_DEVICE_TYPE and dev.chip is not None:
+                yield (
+                    dev.name,
+                    list(dev.chip.dev_paths),
+                    self._chip_runtime_env([dev.chip]),
+                )
+            elif (
+                dev.type == SUBSLICE_STATIC_DEVICE_TYPE
+                and dev.subslice is not None
+            ):
+                yield (
+                    dev.name,
+                    list(dev.subslice.dev_paths),
+                    dict(dev.subslice.runtime_env),
+                )
 
     # --- inventory (enumerateAllPossibleDevices analog, nvlib.go:170-198) ---
 

@@ -1,0 +1,61 @@
+"""Self-signed serving-cert generation for the webhook.
+
+Reference deployments lean on cert-manager (templates/webhook.yaml
+certificate provisioning); for the kind/no-cluster demos and the TLS e2e
+this generates the same shape locally: one self-signed certificate that
+is both the serving cert and the CA bundle callers pin
+(``webhook.tls.secret.caBundle`` analog).
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+from typing import List, Optional, Tuple
+
+
+def generate_self_signed(
+    cert_path: str,
+    key_path: str,
+    common_name: str = "tpu-dra-webhook",
+    dns_names: Optional[List[str]] = None,
+    ip_addresses: Optional[List[str]] = None,
+    days: int = 365,
+) -> Tuple[str, str]:
+    """Write a PEM cert + key pair; returns (cert_path, key_path)."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
+    sans: list = [x509.DNSName(d) for d in (dns_names or ["localhost"])]
+    for ip in ip_addresses or ["127.0.0.1"]:
+        sans.append(x509.IPAddress(ipaddress.ip_address(ip)))
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=days))
+        .add_extension(
+            x509.BasicConstraints(ca=True, path_length=None), critical=True
+        )
+        .add_extension(x509.SubjectAlternativeName(sans), critical=False)
+        .sign(key, hashes.SHA256())
+    )
+    with open(cert_path, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    with open(key_path, "wb") as f:
+        f.write(
+            key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.TraditionalOpenSSL,
+                serialization.NoEncryption(),
+            )
+        )
+    return cert_path, key_path
