@@ -61,3 +61,15 @@ bats::on_failure() {
   kubectl -n cd-demo delete "$worker" --force --grace-period=0
   kubectl -n cd-demo wait --for=condition=complete job/llama-pjit --timeout=900s
 }
+
+@test "failover: ICI bandwidth exerciser passes after daemon churn" {
+  # The nvbandwidth analog (reference test_cd_failover.bats:32-46 payload):
+  # after the daemon-churn tests above, the fabric must still move bytes —
+  # the exerciser measures psum/all-gather/reduce-scatter/ppermute bus
+  # bandwidth across the domain and fails below its threshold.
+  k_apply "${REPO_ROOT}/demo/specs/computedomain/ici-bandwidth-job.yaml"
+  kubectl -n cd-demo wait --for=condition=complete job/ici-bandwidth --timeout=600s
+  run kubectl -n cd-demo logs -l job-name=ici-bandwidth --tail=2
+  [[ "$output" == *busbw_gbps* ]]
+  kubectl -n cd-demo delete job ici-bandwidth --ignore-not-found --timeout=120s
+}

@@ -1,0 +1,38 @@
+"""ICI collective-bandwidth exerciser (nvbandwidth analog) tests.
+
+Runs the real collective probes on the 8-virtual-device CPU mesh and the
+single-device HBM fallback; asserts the pass/fail gate and the JSON
+contract the Job spec (demo/specs/computedomain/ici-bandwidth-job.yaml)
+and failover bats suite consume.
+"""
+
+import json
+
+import jax
+
+from tpu_dra.workloads.icibandwidth import main, measure_collectives
+
+
+def test_collectives_over_mesh():
+    out = measure_collectives(size_mb=1.0, reps=3)
+    assert out["devices"] == 8
+    for leg in ("psum_allreduce", "all_gather", "reduce_scatter",
+                "ppermute_ring"):
+        assert out[leg]["busbw_gbps"] > 0
+        assert out[leg]["seconds"] > 0
+
+
+def test_single_device_hbm_fallback():
+    out = measure_collectives(size_mb=1.0, reps=3, devices=jax.devices()[:1])
+    assert out["devices"] == 1
+    assert out["hbm_copy"]["gbps"] > 0
+
+
+def test_cli_smoke_and_threshold_gate(capsys):
+    assert main(["--size-mb", "1", "--reps", "3", "--min-gbps", "0"]) == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    results = json.loads(line)
+    assert results["devices"] == 8
+    # An impossibly high threshold must fail the probe.
+    assert main(["--size-mb", "1", "--reps", "3",
+                 "--min-gbps", "1000000"]) == 1

@@ -241,6 +241,134 @@ def test_informer_relist_emits_deletes(cds, fc):
     inf.stop()
 
 
+def test_informer_resumes_watch_from_resource_version(cds, fc):
+    """After a stream drop, the informer resumes from its last observed
+    resourceVersion and the server replays the missed window — no relist
+    (asserted by counting backend.list calls)."""
+    inf = Informer(fc, COMPUTE_DOMAINS)
+    inf.resync_backoff = 0.05
+    inf.start()
+    assert inf.wait_for_sync()
+    cds.create(cd_obj("pre-drop"))
+    deadline = time.monotonic() + 2
+    while time.monotonic() < deadline and not inf.get("pre-drop", "default"):
+        time.sleep(0.02)
+
+    lists = []
+    orig_list = fc.list
+    fc.list = lambda *a, **k: (lists.append(1), orig_list(*a, **k))[1]
+    inf._watch.close()
+    cds.create(cd_obj("missed-during-drop"))
+    deadline = time.monotonic() + 3
+    while time.monotonic() < deadline and not inf.get(
+        "missed-during-drop", "default"
+    ):
+        time.sleep(0.02)
+    fc.list = orig_list
+    assert inf.get("missed-during-drop", "default") is not None
+    assert lists == [], "RV resume should have replayed without a relist"
+    inf.stop()
+
+
+def test_informer_error_410_event_forces_relist(fc, cds):
+    """A real apiserver reports an expired watch RV as HTTP 200 + in-stream
+    ERROR(code=410); the informer must drop its resume point and relist
+    instead of re-resuming from the dead version forever."""
+    import queue as queue_mod
+
+    class Stream:
+        def __init__(self, events):
+            self._q = queue_mod.Queue()
+            for e in events:
+                self._q.put(e)
+            self.closed = False
+
+        def close(self):
+            self.closed = True
+            self._q.put(None)
+
+        def __iter__(self):
+            while True:
+                item = self._q.get()
+                if item is None:
+                    return
+                yield item
+
+    class Backend410:
+        """First resumed watch yields ERROR 410 then ends; subsequent
+        watches delegate to the fake."""
+
+        def __init__(self, fc):
+            self.fc = fc
+            self.resume_rvs = []
+
+        def list(self, *a, **k):
+            return self.fc.list(*a, **k)
+
+        def watch(self, rd, namespace=None, label_selector=None,
+                  resource_version=None):
+            if resource_version is not None:
+                self.resume_rvs.append(resource_version)
+                return Stream([
+                    ("ERROR", {"kind": "Status", "code": 410,
+                               "message": "too old resource version"}),
+                ])
+            return self.fc.watch(rd, namespace, label_selector)
+
+    cds.create(cd_obj("existing"))
+    backend = Backend410(fc)
+    inf = Informer(backend, COMPUTE_DOMAINS)
+    inf.resync_backoff = 0.05
+    inf.start()
+    assert inf.wait_for_sync()
+    deadline = time.monotonic() + 2
+    while time.monotonic() < deadline and not inf.get("existing", "default"):
+        time.sleep(0.02)
+
+    # Drop the stream: the informer resumes (gets ERROR 410), must then
+    # fall back to a fresh watch + relist and keep converging.
+    inf._watch.close()
+    cds.create(cd_obj("post-410"))
+    deadline = time.monotonic() + 3
+    while time.monotonic() < deadline and not inf.get("post-410", "default"):
+        time.sleep(0.02)
+    assert inf.get("post-410", "default") is not None
+    assert len(backend.resume_rvs) == 1, (
+        "informer must not re-resume from an RV the server declared gone"
+    )
+    inf.stop()
+
+
+def test_finalizer_completion_delete_gets_own_resource_version(fc, cds):
+    """The DELETED event emitted when the last finalizer is stripped must
+    carry a NEW resourceVersion: a watch resuming from the preceding
+    MODIFIED's version (strict rv > from_rv replay) would otherwise skip
+    the deletion forever."""
+    obj = cd_obj("fin")
+    obj["metadata"]["finalizers"] = ["x"]
+    created = cds.create(obj)
+    cds.delete("fin", "default")
+    cur = cds.get("fin", "default")
+    mod_rv = int(cur["metadata"]["resourceVersion"])
+    w = fc.watch(COMPUTE_DOMAINS, resource_version=str(mod_rv))
+    cur["metadata"]["finalizers"] = []
+    cds.update(cur)
+    seen = []
+    for _ in range(5):
+        item = w.next_event(timeout=2)
+        if not isinstance(item, tuple):
+            break
+        seen.append(item)
+        if item[0] == "DELETED":
+            break
+    w.close()
+    deleted = [o for ev, o in seen if ev == "DELETED"]
+    assert deleted, f"no DELETED event in {[(e, None) for e, _ in seen]}"
+    rvs = [int(o["metadata"]["resourceVersion"]) for _, o in seen]
+    assert int(deleted[0]["metadata"]["resourceVersion"]) == max(rvs)
+    assert len(set(rvs)) == len(rvs), "events must not share resourceVersions"
+
+
 def test_load_dir_seeds_manifests(tmp_path):
     import json
 

@@ -23,10 +23,12 @@ import datetime
 import queue
 import threading
 import uuid as uuidlib
+from collections import deque
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from tpu_dra.k8sclient.resources import (
     ApiConflict,
+    ApiGone,
     ApiNotFound,
     Backend,
     K8sApiError,
@@ -87,12 +89,26 @@ def _now() -> str:
     )
 
 
+# Events retained for watch resourceVersion replay; reconnects from an RV
+# older than the window get 410 Gone (a real apiserver's etcd compaction
+# analog). Overridable via env so integration tests can force compaction
+# quickly.
+EVENT_LOG_WINDOW = 1024
+EVENT_LOG_WINDOW_ENV = "TPU_DRA_FAKE_EVENT_WINDOW"
+
+
 class FakeCluster(Backend):
     def __init__(self):
+        import os
+
         self._objs: Dict[Key, dict] = {}
         self._rv = 0
         self._lock = threading.RLock()
         self._watches: List[_Watch] = []
+        window = int(os.environ.get(EVENT_LOG_WINDOW_ENV, EVENT_LOG_WINDOW))
+        self._event_log: "deque[Tuple[int, ResourceDescriptor, str, dict]]" = (
+            deque(maxlen=window)
+        )
 
     # --- seeding (subprocess e2e / demo path) ---
 
@@ -155,6 +171,11 @@ class FakeCluster(Backend):
         return str(self._rv)
 
     def _emit(self, event: str, rd: ResourceDescriptor, obj: dict) -> None:
+        try:
+            rv = int(obj.get("metadata", {}).get("resourceVersion") or 0)
+        except (TypeError, ValueError):
+            rv = self._rv
+        self._event_log.append((rv, rd, event, copy.deepcopy(obj)))
         for w in self._watches:
             if not w.closed and w.matches(rd, obj):
                 w.q.put((event, copy.deepcopy(obj)))
@@ -264,12 +285,17 @@ class FakeCluster(Backend):
             new["metadata"]["resourceVersion"] = self._next_rv()
             self._objs[key] = copy.deepcopy(new)
             self._emit("MODIFIED", rd, new)
-            # Deletion completes when the last finalizer is stripped.
+            # Deletion completes when the last finalizer is stripped. The
+            # DELETED event gets its OWN resourceVersion (real apiserver
+            # behavior): sharing the MODIFIED's version would let a watch
+            # resuming from it (strictly rv > from_rv) skip the deletion.
             if new["metadata"].get("deletionTimestamp") and not new["metadata"].get(
                 "finalizers"
             ):
                 del self._objs[key]
-                self._emit("DELETED", rd, new)
+                deleted = copy.deepcopy(new)
+                deleted["metadata"]["resourceVersion"] = self._next_rv()
+                self._emit("DELETED", rd, deleted)
             return copy.deepcopy(new)
 
     def update(self, rd, obj) -> dict:
@@ -312,9 +338,33 @@ class FakeCluster(Backend):
             cur["metadata"]["resourceVersion"] = self._next_rv()
             self._emit("DELETED", rd, cur)
 
-    def watch(self, rd, namespace=None, label_selector=None) -> _Watch:
+    def watch(
+        self, rd, namespace=None, label_selector=None, resource_version=None
+    ) -> _Watch:
         w = _Watch(rd, namespace, label_selector)
         with self._lock:
+            if resource_version is not None:
+                try:
+                    from_rv = int(resource_version)
+                except (TypeError, ValueError) as e:
+                    raise K8sApiError(
+                        f"bad resourceVersion {resource_version!r}", status=400
+                    ) from e
+                # The requested horizon must still be inside the retained
+                # window — UNLESS nothing was ever dropped (log shorter
+                # than its bound covers everything since rv 0).
+                if (
+                    self._event_log
+                    and len(self._event_log) == self._event_log.maxlen
+                    and from_rv < self._event_log[0][0] - 1
+                ):
+                    raise ApiGone(
+                        f"resourceVersion {from_rv} is too old "
+                        f"(oldest retained: {self._event_log[0][0]})"
+                    )
+                for ev_rv, ev_rd, event, obj in self._event_log:
+                    if ev_rv > from_rv and w.matches(ev_rd, obj):
+                        w.q.put((event, copy.deepcopy(obj)))
             self._watches.append(w)
         return w
 

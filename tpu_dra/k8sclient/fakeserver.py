@@ -72,6 +72,17 @@ class FakeApiServer:
         self._registry = _registry()
         self._watches = []
         self._watch_lock = threading.Lock()
+        # Fault injection + request accounting for transport integration
+        # tests (client-go-grade behavior the reference gets for free):
+        #   POST /_fault {"throttle": N, "retryAfter": s} -> next N
+        #     non-underscore requests answer 429 with Retry-After;
+        #   POST /_fault {"dropWatches": true} -> server-side close of
+        #     every open watch stream (network-blip analog).
+        # GET /_stats -> {"lists": n, "watches": n, "throttled": n}.
+        self._fault_lock = threading.Lock()
+        self._throttle_remaining = 0
+        self._throttle_retry_after = 1.0
+        self._stats = {"lists": 0, "watches": 0, "throttled": 0}
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -122,7 +133,36 @@ class FakeApiServer:
                 n = int(self.headers.get("Content-Length", 0))
                 return json.loads(self.rfile.read(n)) if n else {}
 
+            def _maybe_throttle(self) -> bool:
+                with outer._fault_lock:
+                    if outer._throttle_remaining <= 0:
+                        return False
+                    outer._throttle_remaining -= 1
+                    outer._stats["throttled"] += 1
+                    retry_after = outer._throttle_retry_after
+                # Drain any request body: leaving it unread corrupts the
+                # keep-alive framing (body bytes parse as the next request).
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                if n:
+                    self.rfile.read(n)
+                body = json.dumps({
+                    "kind": "Status", "status": "Failure",
+                    "message": "too many requests", "code": 429,
+                }).encode()
+                self.send_response(429)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Retry-After", str(retry_after))
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return True
+
             def do_GET(self):  # noqa: N802
+                if self.path == "/_stats":
+                    with outer._fault_lock:
+                        return self._reply(200, dict(outer._stats))
+                if self._maybe_throttle():
+                    return None
                 r = self._route()
                 if r is None:
                     return self._reply(404, {"message": "no such route"})
@@ -134,8 +174,11 @@ class FakeApiServer:
                         )
                     labels = _parse_selector(qs, "labelSelector")
                     if qs.get("watch", ["false"])[0] == "true":
-                        return self._serve_watch(r, labels)
+                        rv = qs.get("resourceVersion", [None])[0]
+                        return self._serve_watch(r, labels, rv)
                     fields = _parse_selector(qs, "fieldSelector")
+                    with outer._fault_lock:
+                        outer._stats["lists"] += 1
                     items = outer.cluster.list(
                         r.rd, r.namespace, label_selector=labels,
                         field_selector=fields,
@@ -148,10 +191,17 @@ class FakeApiServer:
                 except Exception as e:
                     return self._error(e)
 
-            def _serve_watch(self, r: _Route, labels) -> None:
-                w = outer.cluster.watch(r.rd, r.namespace, label_selector=labels)
+            def _serve_watch(self, r: _Route, labels, rv=None) -> None:
+                try:
+                    w = outer.cluster.watch(
+                        r.rd, r.namespace, label_selector=labels,
+                        resource_version=rv,
+                    )
+                except Exception as e:
+                    return self._error(e)
                 with outer._watch_lock:
                     outer._watches.append(w)
+                    outer._stats["watches"] += 1
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Transfer-Encoding", "chunked")
@@ -186,6 +236,21 @@ class FakeApiServer:
                     self.close_connection = True
 
             def do_POST(self):  # noqa: N802
+                if self.path == "/_fault":
+                    body = self._body()
+                    with outer._fault_lock:
+                        if "throttle" in body:
+                            outer._throttle_remaining = int(body["throttle"])
+                            outer._throttle_retry_after = float(
+                                body.get("retryAfter", 1.0)
+                            )
+                    if body.get("dropWatches"):
+                        with outer._watch_lock:
+                            for w in list(outer._watches):
+                                w.close()
+                    return self._reply(200, {"status": "Success"})
+                if self._maybe_throttle():
+                    return None
                 r = self._route()
                 if r is None:
                     return self._reply(404, {"message": "no such route"})
@@ -200,6 +265,8 @@ class FakeApiServer:
                     return self._error(e)
 
             def do_PUT(self):  # noqa: N802
+                if self._maybe_throttle():
+                    return None
                 r = self._route()
                 if r is None or not r.name:
                     return self._reply(404, {"message": "no such route"})
@@ -215,6 +282,8 @@ class FakeApiServer:
                     return self._error(e)
 
             def do_PATCH(self):  # noqa: N802
+                if self._maybe_throttle():
+                    return None
                 r = self._route()
                 if r is None or not r.name:
                     return self._reply(404, {"message": "no such route"})
@@ -226,6 +295,8 @@ class FakeApiServer:
                     return self._error(e)
 
             def do_DELETE(self):  # noqa: N802
+                if self._maybe_throttle():
+                    return None
                 r = self._route()
                 if r is None or not r.name:
                     return self._reply(404, {"message": "no such route"})

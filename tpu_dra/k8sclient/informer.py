@@ -5,10 +5,15 @@ Reference analog: the generated shared informer factory + listers
 listers/resource/v1beta1/computedomain.go). Handlers run on a dedicated
 dispatch thread; the store is the lister.
 
-Ordering guarantee: the watch is registered *before* the initial list, so
-no event can fall into the gap between them (against the fake backend this
-is exact; against a real API server the transport replays from the list's
-resourceVersion).
+Gap-freedom: at startup the watch is registered *before* the initial
+list, so every event at or after the list's state arrives on the stream.
+On stream loss the informer resumes the watch from the last observed
+resourceVersion — the server replays the missed window, no relist needed;
+when that version has fallen out of the server's event window (410 Gone,
+etcd-compaction analog) it falls back to a full relist that emits
+synthetic DELETEDs for objects that vanished while the watch was down.
+Covered end-to-end over real HTTP in
+tests/e2e/test_k8sclient_integration.py.
 """
 
 from __future__ import annotations
@@ -18,7 +23,7 @@ import logging
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
-from tpu_dra.k8sclient.resources import Backend, ResourceDescriptor
+from tpu_dra.k8sclient.resources import ApiGone, Backend, ResourceDescriptor
 
 log = logging.getLogger(__name__)
 
@@ -44,6 +49,7 @@ class Informer:
         self._thread: Optional[threading.Thread] = None
         self._synced = threading.Event()
         self._stopped = threading.Event()
+        self._last_rv: Optional[str] = None
         self.resync_backoff = 1.0  # seconds between reconnect attempts
 
     def add_handler(self, handler: Handler) -> None:
@@ -79,16 +85,42 @@ class Informer:
             for event, obj in self._watch:
                 if event == "ERROR":
                     log.warning("watch ERROR event: %s", obj.get("message", obj))
+                    if obj.get("code") == 410:
+                        # Real apiservers deliver an expired-RV watch as
+                        # HTTP 200 + in-stream ERROR(410); resuming from
+                        # the same RV would loop forever. Drop the resume
+                        # point so the resync relists.
+                        self._last_rv = None
                     break
                 self._apply(event, obj, dispatch=True)
-            # Resync: re-establish watch, then relist. Both must succeed
-            # before consuming events again — a failed relist would leave
-            # stale deletions in the store, so retry the whole resync.
+            # Resync. Preferred: resume the watch from the last observed
+            # resourceVersion — the server replays the missed window and
+            # the (expensive) relist is skipped. 410 Gone means the
+            # version was compacted away: fall back to watch + full
+            # relist, which must BOTH succeed before consuming events
+            # again (a failed relist would leave stale deletions in the
+            # store), so retry the whole resync.
             while not self._stopped.is_set():
                 self._stopped.wait(self.resync_backoff)
                 if self._stopped.is_set():
                     return
                 try:
+                    if self._last_rv is not None:
+                        try:
+                            self._watch = self.backend.watch(
+                                self.rd, self.namespace, self.label_selector,
+                                resource_version=self._last_rv,
+                            )
+                            log.debug(
+                                "watch resumed from resourceVersion %s",
+                                self._last_rv,
+                            )
+                            break
+                        except ApiGone:
+                            log.info(
+                                "resourceVersion %s expired; relisting",
+                                self._last_rv,
+                            )
                     self._watch = self.backend.watch(
                         self.rd, self.namespace, self.label_selector
                     )
@@ -112,18 +144,35 @@ class Informer:
         for obj in gone_objs:
             self._apply("DELETED", obj, dispatch=True)
 
+    @staticmethod
+    def _rv_int(rv) -> Optional[int]:
+        try:
+            return int(rv)
+        except (TypeError, ValueError):
+            return None  # opaque RV: no ordering assumption
+
     def _apply(self, event: str, obj: dict, dispatch: bool) -> None:
         md = obj.get("metadata", {})
         key = (md.get("namespace"), md.get("name"))
+        rv = md.get("resourceVersion")
+        if rv:
+            # Resume point: numerically newest observed version (list
+            # application order is name order, not version order).
+            cur, new = self._rv_int(self._last_rv), self._rv_int(rv)
+            if cur is None or (new is not None and new > cur):
+                self._last_rv = rv
         with self._lock:
             if event == "DELETED":
                 self._store.pop(key, None)
             else:
                 prev = self._store.get(key)
-                if prev is not None and prev["metadata"].get(
-                    "resourceVersion"
-                ) == md.get("resourceVersion"):
-                    return  # duplicate replay (list/watch overlap)
+                if prev is not None:
+                    prev_rv = prev["metadata"].get("resourceVersion")
+                    if prev_rv == rv:
+                        return  # duplicate replay (list/watch overlap)
+                    pi, ni = self._rv_int(prev_rv), self._rv_int(rv)
+                    if pi is not None and ni is not None and ni < pi:
+                        return  # replayed event older than the store
                 self._store[key] = obj
         if dispatch:
             for h in self._handlers:

@@ -29,6 +29,14 @@ class ApiConflict(K8sApiError):
         super().__init__(message, status=409)
 
 
+class ApiGone(K8sApiError):
+    """HTTP 410: a watch resourceVersion fell out of the server's event
+    window — the client must relist and start a fresh watch."""
+
+    def __init__(self, message: str):
+        super().__init__(message, status=410)
+
+
 @dataclass(frozen=True)
 class ResourceDescriptor:
     group: str  # "" for core
@@ -144,8 +152,12 @@ class Backend:
         rd: ResourceDescriptor,
         namespace: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
+        resource_version: Optional[str] = None,
     ):
-        """Returns an iterator of (event_type, obj) plus a close() handle."""
+        """Returns an iterator of (event_type, obj) plus a close() handle.
+        With ``resource_version``, replays events after that version
+        (raising :class:`ApiGone` when it fell out of the server's event
+        window)."""
         raise NotImplementedError
 
 
@@ -194,5 +206,9 @@ class ResourceClient:
         self,
         namespace: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
+        resource_version: Optional[str] = None,
     ):
-        return self.backend.watch(self.rd, namespace, label_selector)
+        return self.backend.watch(
+            self.rd, namespace, label_selector,
+            resource_version=resource_version,
+        )

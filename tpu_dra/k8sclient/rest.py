@@ -23,6 +23,7 @@ import yaml
 from tpu_dra.infra.workqueue import BucketRateLimiter
 from tpu_dra.k8sclient.resources import (
     ApiConflict,
+    ApiGone,
     ApiNotFound,
     Backend,
     K8sApiError,
@@ -193,11 +194,40 @@ class KubeClient(Backend):
 
     # --- REST verbs ---
 
+    # Server-side throttling (429) retries: client-go's default behavior.
+    MAX_429_RETRIES = 4
+    DEFAULT_RETRY_AFTER = 1.0
+
+    def _do(self, send) -> requests.Response:
+        """Issue a request through the client throttle, retrying 429s with
+        the server's Retry-After (a real apiserver under load sheds this
+        way; failing through to the caller would turn routine APF
+        throttling into reconcile errors)."""
+        for attempt in range(self.MAX_429_RETRIES + 1):
+            self._throttle.wait()
+            resp = send()
+            if resp.status_code != 429 or attempt == self.MAX_429_RETRIES:
+                return resp
+            try:
+                delay = float(
+                    resp.headers.get("Retry-After", self.DEFAULT_RETRY_AFTER)
+                )
+            except ValueError:
+                delay = self.DEFAULT_RETRY_AFTER
+            log.debug(
+                "server throttled (429), retrying in %.1fs (attempt %d)",
+                delay, attempt + 1,
+            )
+            time.sleep(delay)
+        raise AssertionError("unreachable: loop returns on final attempt")
+
     def _check(self, resp: requests.Response) -> dict:
         if resp.status_code == 404:
             raise ApiNotFound(resp.text)
         if resp.status_code == 409:
             raise ApiConflict(resp.text)
+        if resp.status_code == 410:
+            raise ApiGone(resp.text)
         if resp.status_code >= 400:
             raise K8sApiError(
                 f"{resp.status_code}: {resp.text[:500]}", status=resp.status_code
@@ -218,78 +248,66 @@ class KubeClient(Backend):
         return params
 
     def get(self, rd, namespace, name) -> dict:
-        self._throttle.wait()
-        return self._check(
-            self._session.get(self.server + rd.path(namespace, name), timeout=30)
-        )
+        return self._check(self._do(lambda: self._session.get(
+            self.server + rd.path(namespace, name), timeout=30
+        )))
 
     def list(self, rd, namespace=None, label_selector=None, field_selector=None):
-        self._throttle.wait()
-        out = self._check(
-            self._session.get(
-                self.server + rd.path(namespace),
-                params=self._selector_params(label_selector, field_selector),
-                timeout=30,
-            )
-        )
+        out = self._check(self._do(lambda: self._session.get(
+            self.server + rd.path(namespace),
+            params=self._selector_params(label_selector, field_selector),
+            timeout=30,
+        )))
         return out.get("items", [])
 
     def create(self, rd, obj) -> dict:
-        self._throttle.wait()
         ns = obj.get("metadata", {}).get("namespace")
-        return self._check(
-            self._session.post(self.server + rd.path(ns), json=obj, timeout=30)
-        )
+        return self._check(self._do(lambda: self._session.post(
+            self.server + rd.path(ns), json=obj, timeout=30
+        )))
 
     def update(self, rd, obj) -> dict:
-        self._throttle.wait()
         md = obj["metadata"]
-        return self._check(
-            self._session.put(
-                self.server + rd.path(md.get("namespace"), md["name"]),
-                json=obj,
-                timeout=30,
-            )
-        )
+        return self._check(self._do(lambda: self._session.put(
+            self.server + rd.path(md.get("namespace"), md["name"]),
+            json=obj,
+            timeout=30,
+        )))
 
     def update_status(self, rd, obj) -> dict:
-        self._throttle.wait()
         md = obj["metadata"]
-        return self._check(
-            self._session.put(
-                self.server + rd.path(md.get("namespace"), md["name"]) + "/status",
-                json=obj,
-                timeout=30,
-            )
-        )
+        return self._check(self._do(lambda: self._session.put(
+            self.server + rd.path(md.get("namespace"), md["name"]) + "/status",
+            json=obj,
+            timeout=30,
+        )))
 
     def patch(self, rd, namespace, name, patch) -> dict:
-        self._throttle.wait()
-        return self._check(
-            self._session.patch(
-                self.server + rd.path(namespace, name),
-                json=patch,
-                headers={"Content-Type": "application/merge-patch+json"},
-                timeout=30,
-            )
-        )
+        return self._check(self._do(lambda: self._session.patch(
+            self.server + rd.path(namespace, name),
+            json=patch,
+            headers={"Content-Type": "application/merge-patch+json"},
+            timeout=30,
+        )))
 
     def delete(self, rd, namespace, name) -> None:
-        self._throttle.wait()
-        self._check(
-            self._session.delete(self.server + rd.path(namespace, name), timeout=30)
-        )
+        self._check(self._do(lambda: self._session.delete(
+            self.server + rd.path(namespace, name), timeout=30
+        )))
 
-    def watch(self, rd, namespace=None, label_selector=None) -> _RestWatch:
-        self._throttle.wait()
+    def watch(
+        self, rd, namespace=None, label_selector=None, resource_version=None
+    ) -> _RestWatch:
         params = self._selector_params(label_selector)
         params["watch"] = "true"
-        resp = self._session.get(
+        if resource_version is not None:
+            params["resourceVersion"] = str(resource_version)
+        resp = self._do(lambda: self._session.get(
             self.server + rd.path(namespace),
             params=params,
             stream=True,
             timeout=(30, None),
-        )
+        ))
         if resp.status_code >= 400:
             self._check(resp)
         return _RestWatch(resp)

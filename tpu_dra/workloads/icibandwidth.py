@@ -1,0 +1,244 @@
+"""ICI collective-bandwidth exerciser — the nvbandwidth analog.
+
+The reference ships nvbandwidth as its ComputeDomain smoke/failover
+payload (demo/specs/imex/nvbandwidth-test-job.yaml,
+tests/bats/test_cd_failover.bats:32-46): a pass/fail probe that the
+fabric actually moves bytes. The TPU-native equivalent measures the XLA
+collectives a training step lives on — psum (all-reduce), all-gather,
+reduce-scatter, and ppermute (the ring-attention primitive) — over the
+device mesh, and fails when achieved bus bandwidth drops below a
+threshold.
+
+Bus-bandwidth conventions (the NCCL-tests algebra nvbandwidth users
+expect): all-reduce moves ``2*(n-1)/n`` bytes per payload byte,
+all-gather/reduce-scatter ``(n-1)/n``, ppermute 1.
+
+On a single-device allocation (no fabric) it degrades to an HBM
+copy-bandwidth probe, so the same job spec stays meaningful on one chip.
+
+CLI (the Job payload):
+    python -m tpu_dra.workloads.icibandwidth \
+        --size-mb 64 --reps 10 --min-gbps 0
+
+Prints one JSON line per run; exit 1 when any collective misses
+``--min-gbps`` (0 disables the gate: smoke mode, like nvbandwidth's
+pass/fail-only use).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Dict, Optional
+
+
+def _fetch(y) -> float:
+    """Force completion with a host read of a FULL reduction: on deferring
+    backends (the axon tunnel) ``block_until_ready`` can return before
+    execution, and fetching one element lets the compiler dead-code the
+    rest of the probe — a sum keeps every element live. The differential
+    timing cancels the reduction's own cost."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    return float(np.asarray(jnp.sum(y)))
+
+
+def _timed_pair(run1, run_n, x, reps: int, outer: int = 3) -> float:
+    """Per-op seconds by DIFFERENTIAL timing: a 1-iteration loop vs an
+    N-iteration loop (both fetched), cancelling dispatch + transfer
+    overhead that would otherwise swamp a single op."""
+    _fetch(run1(x))
+    _fetch(run_n(x))
+
+    def best(run):
+        b = float("inf")
+        for _ in range(outer):
+            t0 = time.perf_counter()
+            _fetch(run(x))
+            b = min(b, time.perf_counter() - t0)
+        return b
+
+    t1, tn = best(run1), best(run_n)
+    per_op = (tn - t1) / (reps - 1) if reps > 1 else tn
+    # Noise floor: differential timing can go epsilon-negative.
+    return max(per_op, 1e-9)
+
+
+def measure_collectives(
+    size_mb: float = 64.0, reps: int = 10, axis: str = "x", devices=None
+) -> Dict[str, dict]:
+    """Bandwidth per collective over the given (default: all) devices —
+    one mesh axis; the exerciser probes the fabric, not a parallelism
+    layout."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    size_bytes = int(size_mb * 1024 * 1024)
+    out: Dict[str, dict] = {
+        "devices": n,
+        "payload_mb": size_mb,
+        "reps": reps,
+    }
+
+    def loop(body, iters, vary=False):
+        def step(i, t):
+            r = body(t)
+            if vary:
+                # Under shard_map the carry must keep its device-varying
+                # type; a psum output is axis-invariant and would change
+                # the fori_loop carry type.
+                r = lax.pcast(r, axis, to="varying")
+            # Materialize every iteration: without the barrier XLA fuses
+            # the whole loop into one kernel and the probe measures
+            # registers, not HBM/ICI.
+            return lax.optimization_barrier(r)
+
+        return lambda s: lax.fori_loop(0, iters, step, s)
+
+    if n == 1:
+        # No fabric: HBM copy probe (read + write size_bytes each rep).
+        x = jax.device_put(
+            jnp.zeros(size_bytes // 4, jnp.float32), devices[0]
+        )
+        body = lambda v: v * 1.000001 + 1e-9  # noqa: E731
+        dt = _timed_pair(
+            jax.jit(loop(body, 1)), jax.jit(loop(body, reps)), x, reps
+        )
+        out["hbm_copy"] = {
+            "seconds": dt,
+            "gbps": 2 * size_bytes / dt / 1e9,
+        }
+        return out
+
+    mesh = Mesh(np.array(devices), (axis,))
+    spec = NamedSharding(mesh, P(axis))
+    # Per-device shard of size_bytes: the collectives move the whole
+    # payload across the fabric each application.
+    x = jax.device_put(
+        jnp.zeros(n * (size_bytes // 4), jnp.float32), spec
+    )
+
+    def timed(body, vary=False):
+        def sharded(iters):
+            return jax.jit(shard_map(
+                loop(body, iters, vary=vary),
+                mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+            ))
+
+        return _timed_pair(sharded(1), sharded(reps), x, reps)
+
+    results = {}
+
+    # all-reduce: every device contributes its shard; busbw factor
+    # 2*(n-1)/n of the full payload.
+    dt = timed(lambda s: jax.lax.psum(s, axis) * (1.0 / n), vary=True)
+    results["psum_allreduce"] = {
+        "seconds": dt,
+        "busbw_gbps": 2 * (n - 1) / n * (n * size_bytes) / dt / 1e9,
+    }
+
+    # all-gather then re-slice back to the shard (keeps shapes stable for
+    # repeated application); busbw factor (n-1)/n of gathered bytes.
+    def ag(s):
+        g = jax.lax.all_gather(s, axis, tiled=True)
+        i = jax.lax.axis_index(axis)
+        return jax.lax.dynamic_slice_in_dim(g, i * s.shape[0], s.shape[0])
+
+    dt = timed(ag)
+    results["all_gather"] = {
+        "seconds": dt,
+        "busbw_gbps": (n - 1) / n * (n * size_bytes) / dt / 1e9,
+    }
+
+    # reduce-scatter via psum_scatter; same busbw factor as all-gather.
+    def rs(s):
+        r = jax.lax.psum_scatter(s, axis, tiled=True)
+        return jnp.tile(r, n)
+
+    dt = timed(rs)
+    results["reduce_scatter"] = {
+        "seconds": dt,
+        "busbw_gbps": (n - 1) / n * (n * size_bytes) / dt / 1e9,
+    }
+
+    # ring ppermute: each device forwards its shard one hop (the ring
+    # attention / pipeline primitive); moves the full payload once.
+    def pp(s):
+        return jax.lax.ppermute(
+            s, axis, [(i, (i + 1) % n) for i in range(n)]
+        )
+
+    dt = timed(pp)
+    results["ppermute_ring"] = {
+        "seconds": dt,
+        "busbw_gbps": (n * size_bytes) / dt / 1e9,
+    }
+
+    out.update(results)
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("tpu-ici-bandwidth")
+    p.add_argument("--size-mb", type=float, default=64.0)
+    p.add_argument("--reps", type=int, default=10)
+    p.add_argument(
+        "--min-gbps", type=float, default=0.0,
+        help="Fail when any collective's bus bandwidth is below this "
+        "(0 = smoke mode: measure and pass)",
+    )
+    p.add_argument(
+        "--distributed", action="store_true",
+        help="Initialize jax.distributed from the CD-injected bootstrap "
+        "env first (multi-host domains)",
+    )
+    p.add_argument(
+        "--cpu-devices", type=int, default=0,
+        help="Force N virtual CPU devices (fabric-free smoke/e2e; env "
+        "vars alone lose to interpreters that import jax at startup)",
+    )
+    args = p.parse_args(argv)
+
+    if args.cpu_devices:
+        import jax
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+    if args.distributed:
+        from tpu_dra.workloads.bootstrap import initialize_from_env
+
+        initialize_from_env()
+    from tpu_dra.workloads.multiplex_client import auto_lease
+
+    with auto_lease():
+        results = measure_collectives(args.size_mb, args.reps)
+    print(json.dumps(results))
+
+    failed: Optional[str] = None
+    if args.min_gbps > 0:
+        for name, leg in results.items():
+            if not isinstance(leg, dict):
+                continue
+            bw = leg.get("busbw_gbps", leg.get("gbps"))
+            if bw is not None and bw < args.min_gbps:
+                failed = f"{name}: {bw:.2f} GB/s < {args.min_gbps}"
+                print(f"FAIL {failed}", file=sys.stderr)
+    if failed:
+        return 1
+    print("PASS", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
