@@ -1,0 +1,533 @@
+// tpu-multiplex-daemon (native): the per-claim chip-sharing control daemon.
+//
+// Reference analog: the MPS control daemon the GPU plugin runs as a
+// dynamically-created Deployment is a NATIVE binary (nvidia-cuda-mps-control);
+// this is the TPU build's native twin of tpu_dra/plugin/multiplexd.py —
+// protocol-compatible (one JSON object per line over
+// <socket_dir>/multiplexd.sock; acquire/release/status/ping), driven by the
+// same env (TPU_MULTIPLEX_CHIPS / _SOCKET_DIR / _HBM_LIMITS /
+// _COMPUTE_SHARE_PCT / _TIMESLICE_ORDINAL / _WINDOW_SECONDS), probed by the
+// same `check` subcommand, and exercised by the same client
+// (tpu_dra/workloads/multiplex_client.py) and tests.
+//
+// Design: a single-threaded poll(2) event loop instead of the Python
+// thread-per-connection server — one fd per client, POLLRDHUP surfacing a
+// dead waiter even with unread pipelined bytes (the same liveness contract
+// the Python daemon implements), FIFO lease arbitration, the time-slice
+// quantum (interval ordinal -> fraction of the scheduling window), and
+// contention-based overdue accounting.
+//
+// No JSON library: requests are {"op": ..., "client": ...} — extracted with
+// a quote-aware scanner (the tpucdihook.cc approach); responses are emitted
+// with proper string escaping.
+
+#include <cerrno>
+#include <cmath>
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <deque>
+#include <map>
+#include <poll.h>
+#include <string>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
+#include <unistd.h>
+#include <vector>
+
+#ifndef POLLRDHUP
+#define POLLRDHUP 0x2000
+#endif
+
+namespace {
+
+constexpr double kDefaultWindowSeconds = 10.0;
+const char* kSocketName = "multiplexd.sock";
+
+volatile sig_atomic_t g_stop = 0;
+void OnSignal(int) { g_stop = 1; }
+
+double MonotonicSeconds() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + ts.tv_nsec / 1e9;
+}
+
+// --- tiny JSON helpers ------------------------------------------------------
+
+std::string JsonEscape(const std::string& s) {
+  std::string out;
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
+// Extract the string value of "key" from a one-line JSON object; empty
+// when absent or non-string. Quote-aware, handles escapes.
+std::string JsonStringField(const std::string& line, const std::string& key) {
+  const std::string needle = "\"" + key + "\"";
+  size_t pos = 0;
+  while ((pos = line.find(needle, pos)) != std::string::npos) {
+    // Ensure the match is a key (followed by optional spaces and ':').
+    size_t p = pos + needle.size();
+    while (p < line.size() && isspace(static_cast<unsigned char>(line[p]))) p++;
+    if (p >= line.size() || line[p] != ':') {
+      pos = p;
+      continue;
+    }
+    p++;
+    while (p < line.size() && isspace(static_cast<unsigned char>(line[p]))) p++;
+    if (p >= line.size() || line[p] != '"') return "";
+    p++;
+    std::string out;
+    while (p < line.size() && line[p] != '"') {
+      if (line[p] == '\\' && p + 1 < line.size()) {
+        p++;
+        switch (line[p]) {
+          case 'n': out += '\n'; break;
+          case 't': out += '\t'; break;
+          case 'r': out += '\r'; break;
+          default: out += line[p];
+        }
+      } else {
+        out += line[p];
+      }
+      p++;
+    }
+    return out;
+  }
+  return "";
+}
+
+bool LooksLikeJsonObject(const std::string& line) {
+  for (char c : line) {
+    if (isspace(static_cast<unsigned char>(c))) continue;
+    return c == '{';
+  }
+  return false;
+}
+
+// --- configuration ----------------------------------------------------------
+
+struct Config {
+  std::vector<std::string> chips;
+  std::string socket_dir = "/var/run/tpu-multiplex";
+  std::vector<std::pair<std::string, std::string>> hbm_limits;
+  int compute_share_pct = -1;    // -1: unset
+  int timeslice_ordinal = -1;    // -1: unset
+  double window_seconds = kDefaultWindowSeconds;
+};
+
+std::vector<std::string> SplitNonEmpty(const char* raw, char sep) {
+  std::vector<std::string> out;
+  if (!raw) return out;
+  std::string cur;
+  for (const char* p = raw;; p++) {
+    if (*p == sep || *p == '\0') {
+      if (!cur.empty()) out.push_back(cur);
+      cur.clear();
+      if (*p == '\0') break;
+    } else {
+      cur += *p;
+    }
+  }
+  return out;
+}
+
+Config ParseEnv() {
+  Config cfg;
+  cfg.chips = SplitNonEmpty(getenv("TPU_MULTIPLEX_CHIPS"), ',');
+  if (const char* d = getenv("TPU_MULTIPLEX_SOCKET_DIR")) cfg.socket_dir = d;
+  for (const std::string& part :
+       SplitNonEmpty(getenv("TPU_MULTIPLEX_HBM_LIMITS"), ',')) {
+    size_t eq = part.find('=');
+    if (eq != std::string::npos) {
+      cfg.hbm_limits.emplace_back(part.substr(0, eq), part.substr(eq + 1));
+    }
+  }
+  if (const char* p = getenv("TPU_MULTIPLEX_COMPUTE_SHARE_PCT"); p && *p) {
+    cfg.compute_share_pct = atoi(p);
+  }
+  if (const char* p = getenv("TPU_MULTIPLEX_TIMESLICE_ORDINAL"); p && *p) {
+    cfg.timeslice_ordinal = atoi(p);
+  }
+  if (const char* p = getenv("TPU_MULTIPLEX_WINDOW_SECONDS"); p && *p) {
+    cfg.window_seconds = atof(p);
+  }
+  return cfg;
+}
+
+// Interval ordinal -> fraction of the window (multiplexd.py
+// TIMESLICE_WINDOW_FRACTION: Default/Medium 25%, Short 5%, Long 100%).
+double MaxHoldSeconds(const Config& cfg) {
+  if (cfg.timeslice_ordinal >= 0) {
+    double frac = 0.25;
+    switch (cfg.timeslice_ordinal) {
+      case 1: frac = 0.05; break;
+      case 3: frac = 1.0; break;
+      default: frac = 0.25;
+    }
+    return cfg.window_seconds * frac;
+  }
+  int pct = cfg.compute_share_pct > 0 ? cfg.compute_share_pct : 100;
+  return cfg.window_seconds * pct / 100.0;
+}
+
+std::string LeaseBodyJson(const Config& cfg) {
+  std::string chips = "[";
+  for (size_t i = 0; i < cfg.chips.size(); i++) {
+    if (i) chips += ", ";
+    chips += "\"" + JsonEscape(cfg.chips[i]) + "\"";
+  }
+  chips += "]";
+  std::string limits = "{";
+  for (size_t i = 0; i < cfg.hbm_limits.size(); i++) {
+    if (i) limits += ", ";
+    limits += "\"" + JsonEscape(cfg.hbm_limits[i].first) + "\": \"" +
+              JsonEscape(cfg.hbm_limits[i].second) + "\"";
+  }
+  limits += "}";
+  char hold[64];
+  snprintf(hold, sizeof hold, "%g", MaxHoldSeconds(cfg));
+  return "{\"chips\": " + chips + ", \"hbmLimits\": " + limits +
+         ", \"maxHoldSeconds\": " + hold + "}";
+}
+
+// --- lease state + event loop -----------------------------------------------
+
+struct Conn {
+  int fd = -1;
+  std::string name;     // display name from the acquire request
+  std::string inbuf;    // unparsed input
+  std::string outbuf;   // unwritten output
+  bool waiting = false;  // queued for the lease (requests held until grant)
+  bool dead = false;
+};
+
+class Daemon {
+ public:
+  explicit Daemon(const Config& cfg) : cfg_(cfg) {}
+
+  static void MakeDirs(const std::string& dir) {
+    // mkdir -p: the socket dir is <socket_root>/<claim_uid> and neither
+    // level may exist yet.
+    std::string cur;
+    for (size_t i = 0; i <= dir.size(); i++) {
+      if (i == dir.size() || dir[i] == '/') {
+        if (!cur.empty()) mkdir(cur.c_str(), 0755);
+        if (i < dir.size()) cur += '/';
+      } else {
+        cur += dir[i];
+      }
+    }
+  }
+
+  int Run() {
+    std::string path = cfg_.socket_dir + "/" + kSocketName;
+    MakeDirs(cfg_.socket_dir);
+    unlink(path.c_str());
+    listen_fd_ = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+    if (listen_fd_ < 0) {
+      perror("socket");
+      return 1;
+    }
+    sockaddr_un addr{};
+    addr.sun_family = AF_UNIX;
+    if (path.size() >= sizeof(addr.sun_path)) {
+      fprintf(stderr, "socket path too long: %s\n", path.c_str());
+      return 1;
+    }
+    strncpy(addr.sun_path, path.c_str(), sizeof(addr.sun_path) - 1);
+    if (bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof addr) < 0 ||
+        listen(listen_fd_, 64) < 0) {
+      perror("bind/listen");
+      return 1;
+    }
+    // Remember which filesystem entry is OURS (a successor daemon may
+    // re-bind the same path during pod replacement; its socket must
+    // survive our teardown).
+    struct stat st {};
+    stat(path.c_str(), &st);
+    own_ino_ = st.st_ino;
+    fprintf(stderr, "tpu-multiplex-daemon (native) serving %zu chips on %s\n",
+            cfg_.chips.size(), path.c_str());
+
+    while (!g_stop) {
+      std::vector<pollfd> fds;
+      fds.push_back({listen_fd_, POLLIN, 0});
+      for (auto& [fd, c] : conns_) {
+        short events = POLLIN | POLLRDHUP;
+        if (!c.outbuf.empty()) events |= POLLOUT;
+        fds.push_back({fd, events, 0});
+      }
+      int n = poll(fds.data(), fds.size(), 200);
+      if (n < 0 && errno != EINTR) {
+        perror("poll");
+        break;
+      }
+      if (g_stop) break;
+      for (const pollfd& p : fds) {
+        if (p.fd == listen_fd_) {
+          if (p.revents & POLLIN) Accept();
+          continue;
+        }
+        auto it = conns_.find(p.fd);
+        if (it == conns_.end()) continue;
+        Conn& c = it->second;
+        if (p.revents & (POLLERR | POLLNVAL | POLLHUP | POLLRDHUP)) {
+          // POLLRDHUP: peer closed even with unread pipelined bytes —
+          // a queued client must not be granted a dead lease.
+          c.dead = true;
+        }
+        if (!c.dead && (p.revents & POLLIN)) ReadFrom(c);
+        if (!c.dead && (p.revents & POLLOUT)) Flush(c);
+      }
+      Reap();
+      GrantIfFree();
+    }
+
+    for (auto& [fd, c] : conns_) close(fd);
+    close(listen_fd_);
+    struct stat cur {};
+    if (stat(path.c_str(), &cur) == 0 && cur.st_ino == own_ino_) {
+      unlink(path.c_str());
+    }
+    return 0;
+  }
+
+ private:
+  void Accept() {
+    int fd = accept4(listen_fd_, nullptr, nullptr,
+                     SOCK_NONBLOCK | SOCK_CLOEXEC);
+    if (fd < 0) return;
+    conns_[fd].fd = fd;
+  }
+
+  void ReadFrom(Conn& c) {
+    char buf[4096];
+    for (;;) {
+      ssize_t n = read(c.fd, buf, sizeof buf);
+      if (n > 0) {
+        c.inbuf.append(buf, n);
+        if (c.inbuf.size() > 1 << 20) {  // runaway client
+          c.dead = true;
+          return;
+        }
+        continue;
+      }
+      if (n == 0) {
+        c.dead = true;
+        return;
+      }
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      if (errno == EINTR) continue;
+      c.dead = true;
+      return;
+    }
+    // A waiter's pipelined requests stay buffered until its grant (the
+    // Python daemon's handler thread blocks in acquire the same way).
+    while (!c.waiting && !c.dead) {
+      size_t nl = c.inbuf.find('\n');
+      if (nl == std::string::npos) break;
+      std::string line = c.inbuf.substr(0, nl);
+      c.inbuf.erase(0, nl + 1);
+      Handle(c, line);
+    }
+  }
+
+  void Handle(Conn& c, const std::string& line) {
+    if (line.find_first_not_of(" \t\r") == std::string::npos) return;
+    if (!LooksLikeJsonObject(line)) {
+      Send(c, "{\"ok\": false, \"error\": \"bad json\"}");
+      return;
+    }
+    std::string op = JsonStringField(line, "op");
+    if (op == "acquire") {
+      std::string name = JsonStringField(line, "client");
+      c.name = name.empty() ? ("conn-" + std::to_string(c.fd)) : name;
+      if (holder_ == c.fd) {  // idempotent re-acquire while holding
+        Send(c, "{\"ok\": true, \"lease\": " + LeaseBodyJson(cfg_) + "}");
+        return;
+      }
+      c.waiting = true;
+      queue_.push_back(c.fd);
+      if (holder_ != -1 && contended_since_ == 0.0) {
+        contended_since_ = MonotonicSeconds();
+      }
+    } else if (op == "release") {
+      if (holder_ == c.fd) {
+        holder_ = -1;
+        Send(c, "{\"ok\": true}");
+      } else {
+        Send(c, "{\"ok\": false}");
+      }
+    } else if (op == "status") {
+      Send(c, StatusJson());
+    } else if (op == "ping") {
+      Send(c, "{\"ok\": true}");
+    } else {
+      Send(c, "{\"ok\": false, \"error\": \"unknown op '" + JsonEscape(op) +
+                  "'\"}");
+    }
+  }
+
+  std::string StatusJson() {
+    double held = holder_ != -1 ? MonotonicSeconds() - hold_started_ : 0.0;
+    double max_hold = MaxHoldSeconds(cfg_);
+    bool overdue = false;
+    if (holder_ != -1 && !queue_.empty() && contended_since_ > 0.0) {
+      double since = std::max(hold_started_, contended_since_);
+      overdue = MonotonicSeconds() - since > max_hold;
+    }
+    std::string holder = "null";
+    if (holder_ != -1) {
+      auto it = conns_.find(holder_);
+      holder = "\"" +
+               JsonEscape(it != conns_.end() ? it->second.name : "?") + "\"";
+    }
+    std::string chips = "[";
+    for (size_t i = 0; i < cfg_.chips.size(); i++) {
+      if (i) chips += ", ";
+      chips += "\"" + JsonEscape(cfg_.chips[i]) + "\"";
+    }
+    chips += "]";
+    char buf[160];
+    snprintf(buf, sizeof buf,
+             ", \"waiting\": %zu, \"heldSeconds\": %.3f, "
+             "\"maxHoldSeconds\": %g, \"overdue\": %s}",
+             queue_.size(), held, max_hold, overdue ? "true" : "false");
+    return "{\"ok\": true, \"holder\": " + holder + ", \"chips\": " + chips +
+           buf;
+  }
+
+  void GrantIfFree() {
+    while (holder_ == -1 && !queue_.empty()) {
+      int fd = queue_.front();
+      auto it = conns_.find(fd);
+      if (it == conns_.end() || it->second.dead) {
+        queue_.pop_front();
+        continue;
+      }
+      queue_.pop_front();
+      Conn& c = it->second;
+      c.waiting = false;
+      holder_ = fd;
+      double now = MonotonicSeconds();
+      hold_started_ = now;
+      contended_since_ = queue_.empty() ? 0.0 : now;
+      Send(c, "{\"ok\": true, \"lease\": " + LeaseBodyJson(cfg_) + "}");
+      if (c.dead) {  // grant write raced the client's death
+        holder_ = -1;
+        continue;
+      }
+      // Process any requests the new holder pipelined while queued.
+      ReadFrom(c);
+    }
+  }
+
+  void Send(Conn& c, const std::string& json) {
+    c.outbuf += json + "\n";
+    Flush(c);
+  }
+
+  void Flush(Conn& c) {
+    while (!c.outbuf.empty()) {
+      ssize_t n = send(c.fd, c.outbuf.data(), c.outbuf.size(), MSG_NOSIGNAL);
+      if (n > 0) {
+        c.outbuf.erase(0, n);
+        continue;
+      }
+      if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return;
+      if (n < 0 && errno == EINTR) continue;
+      c.dead = true;
+      return;
+    }
+  }
+
+  void Reap() {
+    for (auto it = conns_.begin(); it != conns_.end();) {
+      if (!it->second.dead) {
+        ++it;
+        continue;
+      }
+      int fd = it->first;
+      if (holder_ == fd) holder_ = -1;  // crashed holder: revoke
+      for (auto q = queue_.begin(); q != queue_.end();) {
+        q = (*q == fd) ? queue_.erase(q) : q + 1;
+      }
+      if (queue_.empty()) contended_since_ = 0.0;
+      close(fd);
+      it = conns_.erase(it);
+    }
+  }
+
+  Config cfg_;
+  int listen_fd_ = -1;
+  ino_t own_ino_ = 0;
+  std::map<int, Conn> conns_;
+  std::deque<int> queue_;
+  int holder_ = -1;
+  double hold_started_ = 0.0;
+  double contended_since_ = 0.0;
+};
+
+// `check` probe: 0 iff a daemon answers a ping on the socket.
+int Check(const Config& cfg) {
+  std::string path = cfg.socket_dir + "/" + kSocketName;
+  int fd = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  if (fd < 0) return 1;
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  strncpy(addr.sun_path, path.c_str(), sizeof(addr.sun_path) - 1);
+  struct timeval tv {2, 0};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) < 0) {
+    close(fd);
+    return 1;
+  }
+  const char* ping = "{\"op\": \"ping\"}\n";
+  if (send(fd, ping, strlen(ping), MSG_NOSIGNAL) < 0) {
+    close(fd);
+    return 1;
+  }
+  char buf[256];
+  ssize_t n = recv(fd, buf, sizeof buf - 1, 0);
+  close(fd);
+  if (n <= 0) return 1;
+  buf[n] = '\0';
+  return strstr(buf, "\"ok\": true") || strstr(buf, "\"ok\":true") ? 0 : 1;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Config cfg = ParseEnv();
+  if (argc > 1 && strcmp(argv[1], "check") == 0) return Check(cfg);
+  if (argc > 1 && strcmp(argv[1], "run") != 0) {
+    fprintf(stderr, "usage: %s [run|check]\n", argv[0]);
+    return 2;
+  }
+  signal(SIGTERM, OnSignal);
+  signal(SIGINT, OnSignal);
+  signal(SIGPIPE, SIG_IGN);
+  return Daemon(cfg).Run();
+}

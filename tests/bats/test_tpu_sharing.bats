@@ -32,6 +32,19 @@ bats::on_failure() {
   [[ "$output" == *MULTIPLEX* ]] || [[ "$output" == *TPU_* ]]
 }
 
+@test "sharing: two pods rotate one chip under a time-slice quantum" {
+  k_apply "${REPO_ROOT}/demo/specs/quickstart/tpu-test7.yaml"
+  kubectl -n tpu-test7 wait --for=jsonpath='{.status.phase}'=Succeeded pod/pod0 pod/pod1 --timeout=180s
+  # Both pods must have re-acquired the lease (rotation happened): the
+  # quantum measurably changed scheduling, not just env bookkeeping.
+  run kubectl -n tpu-test7 logs pod0
+  [[ "$output" == *"rotations:"* ]]
+  [[ "$output" != *"rotations: 0"* ]]
+  run kubectl -n tpu-test7 logs pod1
+  [[ "$output" != *"rotations: 0"* ]]
+  kubectl delete namespace tpu-test7 --ignore-not-found --timeout=120s
+}
+
 @test "sharing: invalid sharing config is rejected by admission" {
   # With the webhook (or validation at prepare), a bad interval must fail.
   run kubectl apply -n tpu-test3 -f - <<YAML

@@ -29,7 +29,7 @@ import pytest  # noqa: E402
 _repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if not all(
     os.path.exists(os.path.join(_repo, "native", "build", n))
-    for n in ("libtputopo.so", "tpu-cdi-hook")
+    for n in ("libtputopo.so", "tpu-cdi-hook", "tpu-multiplex-daemon")
 ):
     import subprocess
 

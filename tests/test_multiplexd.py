@@ -4,10 +4,17 @@ Covers the lease protocol end-to-end over the real unix socket: FIFO
 arbitration, crash-revocation (a dead client can't wedge the chip), the
 readiness check subcommand, env parsing, and the workload-side
 auto_lease() no-op outside multiplexed containers.
+
+The suite runs against BOTH daemon implementations — the Python one
+(tpu_dra/plugin/multiplexd.py) and the native C++ twin
+(native/tpumultiplexd.cc, protocol-compatible, what production pods run)
+— through the same client, pinning the wire contract.
 """
 
 import json
+import os
 import socket
+import subprocess
 import threading
 import time
 
@@ -25,13 +32,65 @@ from tpu_dra.workloads.multiplex_client import (
     auto_lease,
 )
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE_BIN = os.path.join(REPO, "native", "build", "tpu-multiplex-daemon")
+
+
+class NativeDaemon:
+    """Runs the C++ daemon binary with the Deployment's env contract."""
+
+    def __init__(self, socket_dir, chips, hbm_limits=None,
+                 compute_share_pct=None, timeslice_ordinal=None,
+                 window_seconds=None):
+        env = dict(os.environ)
+        env["TPU_MULTIPLEX_CHIPS"] = ",".join(chips)
+        env["TPU_MULTIPLEX_SOCKET_DIR"] = str(socket_dir)
+        if hbm_limits:
+            env["TPU_MULTIPLEX_HBM_LIMITS"] = ",".join(
+                f"{k}={v}" for k, v in sorted(hbm_limits.items())
+            )
+        if compute_share_pct is not None:
+            env["TPU_MULTIPLEX_COMPUTE_SHARE_PCT"] = str(compute_share_pct)
+        if timeslice_ordinal is not None:
+            env["TPU_MULTIPLEX_TIMESLICE_ORDINAL"] = str(timeslice_ordinal)
+        if window_seconds is not None:
+            env["TPU_MULTIPLEX_WINDOW_SECONDS"] = str(window_seconds)
+        self.proc = subprocess.Popen(
+            [NATIVE_BIN, "run"], env=env, stderr=subprocess.DEVNULL
+        )
+        path = os.path.join(str(socket_dir), SOCKET_NAME)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if os.path.exists(path):
+                return
+            time.sleep(0.02)
+        self.stop()  # don't leak the process on the failure path
+        raise TimeoutError("native daemon socket never appeared")
+
+    def stop(self):
+        self.proc.terminate()
+        self.proc.wait(timeout=10)
+
+
+def new_daemon(backend, socket_dir, chips, **kw):
+    if backend == "py":
+        return MultiplexDaemon(str(socket_dir), chips, **kw).start()
+    if not os.path.exists(NATIVE_BIN):
+        pytest.skip("native daemon not built (make -C native)")
+    return NativeDaemon(socket_dir, chips, **kw)
+
+
+@pytest.fixture(params=["py", "native"])
+def backend(request):
+    return request.param
+
 
 @pytest.fixture
-def daemon(tmp_path):
-    d = MultiplexDaemon(
-        str(tmp_path), ["chip-a", "chip-b"],
+def daemon(backend, tmp_path):
+    d = new_daemon(
+        backend, tmp_path, ["chip-a", "chip-b"],
         hbm_limits={"chip-a": "8Gi"}, compute_share_pct=50,
-    ).start()
+    )
     yield d
     d.stop()
 
@@ -143,59 +202,61 @@ def test_queued_client_dead_with_buffered_bytes_is_dropped(daemon, tmp_path):
     c0.close()
 
 
-def test_timeslice_ordinal_sets_lease_quantum(tmp_path):
+def test_timeslice_ordinal_sets_lease_quantum(backend, tmp_path):
     """The time-slice interval ordinal weights the lease max-hold within
     the scheduling window (the nvidia-smi --set-timeslice analog): Short
     rotates fastest, Long hands a holder the full window."""
     quanta = {}
     for ordinal in (0, 1, 2, 3):
-        d = MultiplexDaemon(
-            str(tmp_path / str(ordinal)), ["chip-a"],
+        d = new_daemon(
+            backend, tmp_path / str(ordinal), ["chip-a"],
             timeslice_ordinal=ordinal, window_seconds=10.0,
-        ).start()
-        c = MultiplexClient(str(tmp_path / str(ordinal)), client_name="w")
-        with c.lease() as lease:
-            quanta[ordinal] = lease.max_hold_seconds
-        c.close()
-        d.stop()
+        )
+        try:
+            c = MultiplexClient(str(tmp_path / str(ordinal)), client_name="w")
+            with c.lease() as lease:
+                quanta[ordinal] = lease.max_hold_seconds
+            c.close()
+        finally:
+            d.stop()
     assert quanta[1] < quanta[0] == quanta[2] < quanta[3]
     assert quanta[3] == pytest.approx(10.0)  # Long = whole window
     assert quanta[1] == pytest.approx(0.5)   # Short = 5%
 
 
-def test_timeslice_cooperative_rotation(tmp_path):
+def test_timeslice_cooperative_rotation(backend, tmp_path):
     """Two clients stepping through maybe_yield() rotate the chip at the
     quantum: each gets the lease repeatedly — a timeSlicing claim
     measurably changes scheduling, it is not advisory bookkeeping."""
-    d = MultiplexDaemon(
-        str(tmp_path), ["chip-a"], timeslice_ordinal=1, window_seconds=2.0,
-    ).start()  # Short on a 2s window -> 0.1s quantum
+    d = new_daemon(
+        backend, tmp_path, ["chip-a"], timeslice_ordinal=1,
+        window_seconds=2.0,
+    )  # Short on a 2s window -> 0.1s quantum
+    try:
+        rotations = {"a": 0, "b": 0}
+        stop = time.monotonic() + 3.0
 
-    holds = {"a": 0, "b": 0}
-    stop = time.monotonic() + 3.0
+        def worker(name):
+            c = MultiplexClient(str(tmp_path), client_name=name)
+            lease = c.acquire()
+            while time.monotonic() < stop:
+                time.sleep(0.02)  # a "step" of device work
+                lease = c.maybe_yield(lease)
+            rotations[name] = c.rotations
+            c.close()
 
-    def worker(name):
-        c = MultiplexClient(str(tmp_path), client_name=name)
-        lease = c.acquire()
-        holds[name] += 1
-        while time.monotonic() < stop:
-            time.sleep(0.02)  # a "step" of device work
-            before = c._acquired_at
-            lease = c.maybe_yield(lease)
-            if c._acquired_at != before:
-                holds[name] += 1
-        c.close()
-
-    threads = [
-        threading.Thread(target=worker, args=(n,), daemon=True)
-        for n in holds
-    ]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join(timeout=10)
-    # Both clients repeatedly re-acquired (rotation), not one hogging.
-    assert holds["a"] >= 3 and holds["b"] >= 3, holds
+        threads = [
+            threading.Thread(target=worker, args=(n,), daemon=True)
+            for n in rotations
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        # Both clients repeatedly re-acquired (rotation), not one hogging.
+        assert rotations["a"] >= 2 and rotations["b"] >= 2, rotations
+    finally:
+        d.stop()
 
 
 def test_status_reports_hold_accounting(daemon, tmp_path):
@@ -272,6 +333,17 @@ def test_check_fails_after_stop(tmp_path):
     assert check(str(tmp_path)) == 0
     d.stop()
     assert check(str(tmp_path)) == 1
+
+
+def test_native_check_subcommand_cross_impl(daemon, tmp_path):
+    """The native binary's `check` probe accepts whichever implementation
+    serves the socket (and vice versa via the parametrized check test)."""
+    if not os.path.exists(NATIVE_BIN):
+        pytest.skip("native daemon not built (make -C native)")
+    env = dict(os.environ)
+    env["TPU_MULTIPLEX_SOCKET_DIR"] = str(tmp_path)
+    r = subprocess.run([NATIVE_BIN, "check"], env=env)
+    assert r.returncode == 0
 
 
 def test_parse_env():

@@ -9,9 +9,13 @@ reduce-scatter, and ppermute (the ring-attention primitive) — over the
 device mesh, and fails when achieved bus bandwidth drops below a
 threshold.
 
-Bus-bandwidth conventions (the NCCL-tests algebra nvbandwidth users
-expect): all-reduce moves ``2*(n-1)/n`` bytes per payload byte,
-all-gather/reduce-scatter ``(n-1)/n``, ppermute 1.
+Bus-bandwidth normalization (the NCCL-tests algebra nvbandwidth users
+expect): every leg divides the wire bytes its algorithm moves per device
+by the elapsed time, so on a balanced fabric with per-link bandwidth B
+each leg reports ~B and a single ``--min-gbps`` threshold gates them all
+equally — all-reduce ``2(n-1)/n * S``, all-gather ``(n-1)S``,
+reduce-scatter ``(n-1)/n * S``, one-hop ppermute ``S`` (S = the per-rank
+shard).
 
 On a single-device allocation (no fabric) it degrades to an HBM
 copy-bandwidth probe, so the same job spec stays meaningful on one chip.
@@ -138,12 +142,15 @@ def measure_collectives(
 
     results = {}
 
-    # all-reduce: every device contributes its shard; busbw factor
-    # 2*(n-1)/n of the full payload.
+    # Bus-bandwidth normalization: on a balanced fabric with per-link
+    # bandwidth B every leg below reports ~B, so one --min-gbps threshold
+    # gates them all equally. Per-rank shard = size_bytes throughout.
+
+    # all-reduce over per-rank buffer S: wire bytes 2(n-1)S/n per device.
     dt = timed(lambda s: jax.lax.psum(s, axis) * (1.0 / n), vary=True)
     results["psum_allreduce"] = {
         "seconds": dt,
-        "busbw_gbps": 2 * (n - 1) / n * (n * size_bytes) / dt / 1e9,
+        "busbw_gbps": 2 * (n - 1) / n * size_bytes / dt / 1e9,
     }
 
     # all-gather then re-slice back to the shard (keeps shapes stable for
@@ -153,10 +160,11 @@ def measure_collectives(
         i = jax.lax.axis_index(axis)
         return jax.lax.dynamic_slice_in_dim(g, i * s.shape[0], s.shape[0])
 
+    # gathered output = n*S; each device receives (n-1)S.
     dt = timed(ag)
     results["all_gather"] = {
         "seconds": dt,
-        "busbw_gbps": (n - 1) / n * (n * size_bytes) / dt / 1e9,
+        "busbw_gbps": (n - 1) * size_bytes / dt / 1e9,
     }
 
     # reduce-scatter via psum_scatter; same busbw factor as all-gather.
@@ -164,10 +172,11 @@ def measure_collectives(
         r = jax.lax.psum_scatter(s, axis, tiled=True)
         return jnp.tile(r, n)
 
+    # scatters the per-rank S into n chunks; each device sends (n-1)S/n.
     dt = timed(rs)
     results["reduce_scatter"] = {
         "seconds": dt,
-        "busbw_gbps": (n - 1) / n * (n * size_bytes) / dt / 1e9,
+        "busbw_gbps": (n - 1) / n * size_bytes / dt / 1e9,
     }
 
     # ring ppermute: each device forwards its shard one hop (the ring
@@ -177,10 +186,11 @@ def measure_collectives(
             s, axis, [(i, (i + 1) % n) for i in range(n)]
         )
 
+    # one hop: each device sends its whole shard S over one link.
     dt = timed(pp)
     results["ppermute_ring"] = {
         "seconds": dt,
-        "busbw_gbps": (n * size_bytes) / dt / 1e9,
+        "busbw_gbps": size_bytes / dt / 1e9,
     }
 
     out.update(results)

@@ -39,6 +39,9 @@ class MultiplexClient:
         self.client_name = client_name or f"pid-{os.getpid()}"
         self._sock: Optional[socket.socket] = None
         self._file = None
+        # Times maybe_yield() actually rotated the lease (released and
+        # re-acquired because a peer was waiting at the quantum).
+        self.rotations = 0
 
     def _rpc(self, msg: dict) -> dict:
         if self._sock is None:
@@ -83,7 +86,9 @@ class MultiplexClient:
             self._acquired_at = time.monotonic()
             return lease
         self.release()
-        return self.acquire()
+        lease = self.acquire()
+        self.rotations += 1
+        return lease
 
     def release(self) -> None:
         resp = self._rpc({"op": "release"})
