@@ -19,10 +19,12 @@ operation (demo/no-cluster/run-stack.sh wiring):
 Output is TAP-ish (`ok N - suite: name`); exit 0 iff everything passed.
 Run: ``python tests/batsless/runner.py [--log PATH]``.
 
-Suites covered: test_basics, test_tpu_basic, test_tpu_subslice — the
-sub-slice suite deepened to reference dynmig parity
-(/root/reference/tests/bats/test_gpu_dynmig.bats:55-90): published
-shared counters, overlap rejection, post-unprepare obliteration.
+Suites covered: test_basics, test_tpu_basic, test_tpu_subslice (deepened
+to reference dynmig parity — /root/reference/tests/bats/
+test_gpu_dynmig.bats:55-90: published shared counters, overlap
+rejection, post-unprepare obliteration), and test_tpu_sharing
+(multiplexing + enforced time-slice rotation, with the NATIVE arbiter
+binary playing the control-daemon pod).
 """
 
 from __future__ import annotations
@@ -48,6 +50,7 @@ import yaml  # noqa: E402
 from tpu_dra.infra.minihelm import parse_set, render_chart  # noqa: E402
 from tpu_dra.k8sclient import (  # noqa: E402
     CUSTOM_RESOURCE_DEFINITIONS,
+    DEPLOYMENTS,
     DEVICE_CLASSES,
     RESOURCE_CLAIMS,
     RESOURCE_SLICES,
@@ -58,6 +61,7 @@ from tpu_dra.k8sclient.rest import KubeClient  # noqa: E402
 from tpu_dra.plugin.device_state import DRIVER_NAME  # noqa: E402
 from tpu_dra.plugin.dra_service import DRA_SERVICE_NAME  # noqa: E402
 from tpu_dra.plugin.pb import dra_v1beta1_pb2 as drapb  # noqa: E402
+from tpu_dra.workloads.multiplex_client import MultiplexClient  # noqa: E402
 
 CD_DRIVER_NAME = "compute-domain.tpu.google.com"
 CHART = REPO_ROOT / "deployments" / "helm" / "tpu-dra-driver"
@@ -91,13 +95,19 @@ class Stack:
         self.kc: KubeClient = None
 
     def spawn(self, name, argv, **env_extra):
+        return self._spawn(name, [sys.executable, "-m"] + argv, env_extra)
+
+    def spawn_bin(self, name, argv, **env_extra):
+        return self._spawn(name, argv, env_extra)
+
+    def _spawn(self, name, cmd, env_extra):
         env = dict(os.environ)
         env.pop("TPU_DRA_CDI_HOOK", None)
         env.update(env_extra)
         logf = open(self.td / f"{name}.log", "wb")
         self.procs[name] = (
             subprocess.Popen(
-                [sys.executable, "-m"] + argv, env=env,
+                cmd, env=env,
                 stdout=logf, stderr=subprocess.STDOUT,
                 cwd=str(REPO_ROOT),
             ),
@@ -196,12 +206,19 @@ def device_attrs(dev):
     return out
 
 
-def make_claim(kc, namespace, name, device, request="r0"):
+def make_claim(kc, namespace, name, device, request="r0", params=None):
     claim = kc.create(RESOURCE_CLAIMS, {
         "apiVersion": "resource.k8s.io/v1beta1",
         "kind": "ResourceClaim",
         "metadata": {"name": name, "namespace": namespace},
     })
+    config = []
+    if params is not None:
+        config = [{
+            "requests": [request],
+            "opaque": {"driver": DRIVER_NAME, "parameters": params},
+            "source": "FromClaim",
+        }]
     claim["status"] = {
         "allocation": {
             "devices": {
@@ -209,7 +226,7 @@ def make_claim(kc, namespace, name, device, request="r0"):
                     "request": request, "driver": DRIVER_NAME,
                     "pool": "node-0", "device": device,
                 }],
-                "config": [],
+                "config": config,
             }
         }
     }
@@ -288,7 +305,9 @@ class Runner:
         return 1 if self.failed else 0
 
 
-def start_tpu_plugin(stack: Stack, td: Path, gates="", resource_api=""):
+def start_tpu_plugin(
+    stack: Stack, td: Path, gates="", resource_api="", extra_args=()
+):
     argv = [
         "tpu_dra.plugin.main",
         "--kubeconfig", stack.kubeconfig,
@@ -298,6 +317,7 @@ def start_tpu_plugin(stack: Stack, td: Path, gates="", resource_api=""):
         "--plugin-data-dir", str(td / "tpu-plugin"),
         "--kubelet-registrar-dir", str(td / "registry"),
         "--cdi-hook", "",
+        *extra_args,
     ]
     if gates:
         argv += ["--feature-gates", gates]
@@ -599,6 +619,194 @@ def run_suites(r: Runner, stack: Stack, td: Path) -> int:
 
     r.run("subslice", "startup obliterates unknown sub-slices",
           startup_obliteration)
+
+    # ---- test_tpu_sharing (MPS-analog + enforced time-slicing) ----
+    # The runner plays kubelet for the control-daemon Deployment: it runs
+    # the NATIVE arbiter binary (what production pods run) from the
+    # rendered pod env, then marks the Deployment Ready.
+
+    native_bin = REPO_ROOT / "native" / "build" / "tpu-multiplex-daemon"
+    mux_root = td / "mux"
+
+    def reinstall_sharing():
+        install_chart(kc, [
+            "featureGates.MultiplexingSupport=true",
+            "featureGates.TimeSlicingSettings=true",
+        ], r.log)
+        stack.stop("tpu-plugin")
+        start_tpu_plugin(
+            stack, td,
+            gates="MultiplexingSupport=true,TimeSlicingSettings=true",
+            extra_args=("--multiplex-socket-root", str(mux_root)),
+        )
+
+    r.run("sharing", "chart upgrade flips the sharing gates",
+          reinstall_sharing)
+
+    def play_kubelet_for_daemon(claim_uid, window_seconds=None):
+        dep = wait_for(
+            lambda: next(iter(kc.list(
+                DEPLOYMENTS, DRIVER_NS,
+                label_selector={"tpu.google.com/claim-uid": claim_uid},
+            )), None),
+            what="control-daemon Deployment",
+        )
+        container = dep["spec"]["template"]["spec"]["containers"][0]
+        env = {e["name"]: e.get("value", "") for e in container["env"]}
+        if window_seconds is not None:
+            env["TPU_MULTIPLEX_WINDOW_SECONDS"] = str(window_seconds)
+        name = f"multiplexd-{claim_uid[:8]}"
+        if native_bin.exists():
+            stack.spawn_bin(name, [str(native_bin), "run"], **env)
+        else:
+            stack.spawn(name, ["tpu_dra.plugin.multiplexd"], **env)
+        wait_for(
+            lambda: os.path.exists(
+                os.path.join(env["TPU_MULTIPLEX_SOCKET_DIR"], "multiplexd.sock")
+            ),
+            what="arbiter socket",
+        )
+        dep["status"] = {"readyReplicas": 1, "replicas": 1}
+        kc.update_status(DEPLOYMENTS, dep)
+        return env
+
+    def prepare_async(claim):
+        import threading
+
+        box = {}
+
+        def do():
+            try:
+                box["res"] = prepare(sock, claim)
+            except Exception as e:  # noqa: BLE001 — surfaced by the assert
+                box["error"] = e
+
+        t = threading.Thread(target=do, daemon=True)
+        t.start()
+        return t, box
+
+    def assert_prepared(box):
+        _assert(
+            "error" not in box,
+            f"prepare raised: {box.get('error')!r}",
+        )
+        _assert(
+            box.get("res") is not None and not box["res"].error,
+            box.get("res"),
+        )
+
+    def multiplexed_share():
+        c = make_claim(kc, "tpu-test3", "shared", "tpu-0", params={
+            "apiVersion": "resource.tpu.google.com/v1beta1",
+            "kind": "TpuConfig",
+            "sharing": {
+                "strategy": "Multiplexing",
+                "multiplexingConfig": {"defaultComputeSharePercentage": 50},
+            },
+        })
+        t, box = prepare_async(c)
+        env = play_kubelet_for_daemon(c["metadata"]["uid"])
+        t.join(timeout=60)
+        assert_prepared(box)
+        # Two clients arbitrate the chip through the (native) daemon.
+        c0 = MultiplexClient(env["TPU_MULTIPLEX_SOCKET_DIR"], "wl0")
+        c1 = MultiplexClient(env["TPU_MULTIPLEX_SOCKET_DIR"], "wl1")
+        with c0.lease() as lease:
+            _assert(lease.max_hold_seconds == 5.0, lease)  # 50% of 10s
+        with c1.lease():
+            pass
+        c0.close()
+        c1.close()
+        res = unprepare(sock, c)
+        _assert(not res.error, res.error)
+        wait_for(
+            lambda: not kc.list(
+                DEPLOYMENTS, DRIVER_NS,
+                label_selector={
+                    "tpu.google.com/claim-uid": c["metadata"]["uid"]
+                },
+            ),
+            what="daemon Deployment deletion",
+        )
+        stack.stop(f"multiplexd-{c['metadata']['uid'][:8]}")
+        kc.delete(RESOURCE_CLAIMS, "tpu-test3", "shared")
+
+    r.run("sharing", "two pods share one chip via multiplexing",
+          multiplexed_share)
+
+    def timeslice_rotation():
+        c = make_claim(kc, "tpu-test7", "tsliced", "tpu-1", params={
+            "apiVersion": "resource.tpu.google.com/v1beta1",
+            "kind": "TpuConfig",
+            "sharing": {
+                "strategy": "TimeSlicing",
+                "timeSlicingConfig": {"interval": "Short"},
+            },
+        })
+        t, box = prepare_async(c)
+        env = play_kubelet_for_daemon(
+            c["metadata"]["uid"], window_seconds=2.0
+        )
+        _assert(env.get("TPU_MULTIPLEX_TIMESLICE_ORDINAL") == "1", env)
+        t.join(timeout=60)
+        assert_prepared(box)
+        envs = cdi_env_for(td, c["metadata"]["uid"])
+        _assert("TPU_TIMESLICE_ORDINAL=1" in envs, envs)
+        # Two cooperating clients rotate at the quantum.
+        import threading
+
+        rotations = {}
+
+        def worker(name):
+            try:
+                cl = MultiplexClient(env["TPU_MULTIPLEX_SOCKET_DIR"], name)
+                lease = cl.acquire()
+                stop = time.monotonic() + 2.5
+                while time.monotonic() < stop:
+                    time.sleep(0.02)
+                    lease = cl.maybe_yield(lease)
+                rotations[name] = cl.rotations
+                cl.close()
+            except Exception as e:  # noqa: BLE001
+                rotations[name] = e
+
+        threads = [
+            threading.Thread(target=worker, args=(n,), daemon=True)
+            for n in ("a", "b")
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=15)
+        _assert(
+            all(
+                isinstance(rotations.get(n), int) and rotations[n] >= 1
+                for n in ("a", "b")
+            ),
+            f"no rotation under contention: {rotations}",
+        )
+        res = unprepare(sock, c)
+        _assert(not res.error, res.error)
+        stack.stop(f"multiplexd-{c['metadata']['uid'][:8]}")
+        kc.delete(RESOURCE_CLAIMS, "tpu-test7", "tsliced")
+
+    r.run("sharing", "two pods rotate one chip under a time-slice quantum",
+          timeslice_rotation)
+
+    def invalid_sharing_rejected():
+        c = make_claim(kc, "tpu-test3", "bad-sharing", "tpu-2", params={
+            "apiVersion": "resource.tpu.google.com/v1beta1",
+            "kind": "TpuConfig",
+            "sharing": {
+                "strategy": "TimeSlicing",
+                "timeSlicingConfig": {"interval": "Bogus"},
+            },
+        })
+        res = prepare(sock, c)
+        _assert(res.error and "interval" in res.error, res.error)
+        kc.delete(RESOURCE_CLAIMS, "tpu-test3", "bad-sharing")
+
+    r.run("sharing", "invalid sharing config is rejected", invalid_sharing_rejected)
 
     return r.finish()
 

@@ -35,5 +35,5 @@ def test_batsless_suites(tmp_path):
     text = log.read_text()
     assert "not ok" not in text
     # All three suites actually executed.
-    for suite in ("basics:", "tpu:", "subslice:"):
+    for suite in ("basics:", "tpu:", "subslice:", "sharing:"):
         assert f"- {suite}" in text
