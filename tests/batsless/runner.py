@@ -22,9 +22,12 @@ Run: ``python tests/batsless/runner.py [--log PATH]``.
 Suites covered: test_basics, test_tpu_basic, test_tpu_subslice (deepened
 to reference dynmig parity — /root/reference/tests/bats/
 test_gpu_dynmig.bats:55-90: published shared counters, overlap
-rejection, post-unprepare obliteration), and test_tpu_sharing
+rejection, post-unprepare obliteration), test_tpu_sharing
 (multiplexing + enforced time-slice rotation, with the NATIVE arbiter
-binary playing the control-daemon pod).
+binary playing the control-daemon pod), and the ComputeDomain family —
+test_cd_workload, test_cd_misc, test_cd_chan_inject, test_cd_failover —
+with the controller and two slice daemons as real processes and the
+ICI bandwidth exerciser as the failover payload.
 """
 
 from __future__ import annotations
@@ -45,13 +48,19 @@ REPO_ROOT = Path(__file__).resolve().parents[2]
 sys.path.insert(0, str(REPO_ROOT))
 
 import grpc  # noqa: E402
+import threading  # noqa: E402
 import yaml  # noqa: E402
 
 from tpu_dra.infra.minihelm import parse_set, render_chart  # noqa: E402
 from tpu_dra.k8sclient import (  # noqa: E402
+    ApiNotFound,
+    COMPUTE_DOMAINS,
     CUSTOM_RESOURCE_DEFINITIONS,
+    DAEMON_SETS,
     DEPLOYMENTS,
     DEVICE_CLASSES,
+    NODES,
+    RESOURCE_CLAIM_TEMPLATES,
     RESOURCE_CLAIMS,
     RESOURCE_SLICES,
     ResourceDescriptor,
@@ -129,10 +138,23 @@ class Stack:
             self.stop(name)
 
 
-def stub_cfg(path: Path, state_dir: Path = None) -> str:
-    cfg = {"generation": "v5e", "hostname": "node-0"}
+def stub_cfg(
+    path: Path,
+    state_dir: Path = None,
+    hostname: str = "node-0",
+    worker_id: int = None,
+) -> str:
+    cfg = {"generation": "v5e", "hostname": hostname}
     if state_dir is not None:
         cfg["state_dir"] = str(state_dir)
+    if worker_id is not None:
+        cfg["generation"] = "v5p"
+        cfg["slice"] = {
+            "uuid": "feedfeed",
+            "topology": "2x2x2",
+            "num_hosts": 2,
+            "worker_id": worker_id,
+        }
     path.write_text(yaml.safe_dump(cfg))
     return str(path)
 
@@ -206,7 +228,8 @@ def device_attrs(dev):
     return out
 
 
-def make_claim(kc, namespace, name, device, request="r0", params=None):
+def make_claim(kc, namespace, name, device, request="r0", params=None,
+               driver=DRIVER_NAME, pool="node-0"):
     claim = kc.create(RESOURCE_CLAIMS, {
         "apiVersion": "resource.k8s.io/v1beta1",
         "kind": "ResourceClaim",
@@ -216,15 +239,15 @@ def make_claim(kc, namespace, name, device, request="r0", params=None):
     if params is not None:
         config = [{
             "requests": [request],
-            "opaque": {"driver": DRIVER_NAME, "parameters": params},
+            "opaque": {"driver": driver, "parameters": params},
             "source": "FromClaim",
         }]
     claim["status"] = {
         "allocation": {
             "devices": {
                 "results": [{
-                    "request": request, "driver": DRIVER_NAME,
-                    "pool": "node-0", "device": device,
+                    "request": request, "driver": driver,
+                    "pool": pool, "device": device,
                 }],
                 "config": config,
             }
@@ -671,8 +694,6 @@ def run_suites(r: Runner, stack: Stack, td: Path) -> int:
         return env
 
     def prepare_async(claim):
-        import threading
-
         box = {}
 
         def do():
@@ -753,8 +774,6 @@ def run_suites(r: Runner, stack: Stack, td: Path) -> int:
         envs = cdi_env_for(td, c["metadata"]["uid"])
         _assert("TPU_TIMESLICE_ORDINAL=1" in envs, envs)
         # Two cooperating clients rotate at the quantum.
-        import threading
-
         rotations = {}
 
         def worker(name):
@@ -808,7 +827,314 @@ def run_suites(r: Runner, stack: Stack, td: Path) -> int:
 
     r.run("sharing", "invalid sharing config is rejected", invalid_sharing_rejected)
 
+    # ---- test_cd_workload / test_cd_misc / test_cd_chan_inject /
+    # ---- test_cd_failover ----
+    # The ComputeDomain trio as REAL processes over the shared apiserver:
+    # the controller, two slice daemons ("the DaemonSet pods" — this
+    # runner plays the DaemonSet controller that would schedule them),
+    # and the CD kubelet plugin already registered in test_basics. The
+    # runner plays kubelet for workload channel claims over the plugin's
+    # real gRPC socket.
+
+    cd_ns = "cd-demo"
+    cd_sock = td / "cd-plugin" / "dra.sock"
+    cds = {}
+
+    def channel_params(cd_uid):
+        return {
+            "apiVersion": "resource.tpu.google.com/v1beta1",
+            "kind": "ComputeDomainChannelConfig",
+            "domainID": cd_uid,
+        }
+
+    def make_channel_claim(namespace, name, device, cd_uid):
+        return make_claim(
+            kc, namespace, name, device, request="cd-channel",
+            params=channel_params(cd_uid),
+            driver=CD_DRIVER_NAME, pool="node-0-cd",
+        )
+
+    def spawn_daemon(i, cd_uid, pod_ip=None):
+        cfg_dir = (
+            td / "cd-plugin" / "domains" / cd_uid
+            if i == 0
+            else td / f"cd-config-{i}"
+        )
+        cfg_dir.mkdir(parents=True, exist_ok=True)
+        stack.spawn(
+            f"daemon-{i}",
+            ["tpu_dra.computedomain.daemon.main", "run",
+             "--kubeconfig", stack.kubeconfig,
+             "--cd-uid", cd_uid, "--cd-name", "v5p-16",
+             "--cd-namespace", cd_ns,
+             "--num-nodes", "2", "--node-name", f"node-{i}",
+             "--pod-ip", pod_ip or f"10.0.0.{i + 1}",
+             "--config-dir", str(cfg_dir),
+             "--hosts-path", str(td / f"hosts-{i}"),
+             "--heartbeat-period", "1"],
+            TPU_DRA_BACKEND="stub",
+            TPU_DRA_STUB_CONFIG=stub_cfg(
+                td / f"stub-d{i}.yaml", hostname=f"node-{i}", worker_id=i
+            ),
+        )
+
+    def cd_status(namespace=cd_ns, name="v5p-16"):
+        return (
+            kc.get(COMPUTE_DOMAINS, namespace, name)
+            .get("status", {})
+            .get("status")
+        )
+
+    def controller_stamps_rcts():
+        doc = next(
+            d
+            for d in yaml.safe_load_all(
+                (REPO_ROOT / "demo" / "specs" / "computedomain"
+                 / "computedomain.yaml").read_text()
+            )
+            if d and d.get("kind") == "ComputeDomain"
+        )
+        cds["cd"] = kc.create(COMPUTE_DOMAINS, doc)
+        stack.spawn(
+            "controller",
+            ["tpu_dra.computedomain.controller.main",
+             "--kubeconfig", stack.kubeconfig,
+             "--namespace", DRIVER_NS,
+             "--node-stale-after", "6", "-v", "6"],
+        )
+        for rct in ("v5p-16-channel", "v5p-16-daemon-claim"):
+            wait_for(
+                lambda rct=rct: _try(
+                    lambda: kc.get(RESOURCE_CLAIM_TEMPLATES, cd_ns, rct)
+                ),
+                what=f"claim template {rct}",
+            )
+
+    r.run("cd", "controller stamps daemon + workload claim templates",
+          controller_stamps_rcts)
+
+    def percd_daemonset():
+        wait_for(
+            lambda: [
+                d for d in kc.list(DAEMON_SETS, DRIVER_NS)
+                if "compute-domain" in d["metadata"]["name"]
+            ],
+            what="per-CD DaemonSet",
+        )
+
+    r.run("cd", "per-CD daemonset exists", percd_daemonset)
+
+    def rct_embeds_uid_and_finalizer():
+        uid = cds["cd"]["metadata"]["uid"]
+        rct = kc.get(RESOURCE_CLAIM_TEMPLATES, cd_ns, "v5p-16-channel")
+        cfg = rct["spec"]["spec"]["devices"]["config"][0]["opaque"]
+        _assert(cfg["driver"] == CD_DRIVER_NAME, cfg)
+        _assert(cfg["parameters"]["domainID"] == uid, cfg)
+        cd = kc.get(COMPUTE_DOMAINS, cd_ns, "v5p-16")
+        fins = cd["metadata"].get("finalizers", [])
+        _assert(
+            any("computedomain-finalizer" in f for f in fins),
+            f"finalizers={fins}",
+        )
+
+    r.run("misc", "workload RCT embeds the CD's UID; finalizer held",
+          rct_embeds_uid_and_finalizer)
+
+    def gated_then_starts():
+        uid = cds["cd"]["metadata"]["uid"]
+        c = make_channel_claim(cd_ns, "wl", "channel-0", uid)
+        cds["wl"] = c
+        res = prepare(cd_sock, c)
+        _assert(
+            res.error and "not ready" in res.error.lower(),
+            f"claim prepared against an unready domain: {res}",
+        )
+        # The DS pods land (we play the DaemonSet controller): both slice
+        # daemons register into the clique, the CD converges to Ready, and
+        # the kubelet's retried Prepare succeeds.
+        for i in range(2):
+            spawn_daemon(i, uid)
+        wait_for(lambda: cd_status() == "Ready", timeout=90,
+                 what="ComputeDomain Ready")
+        result = wait_for(
+            lambda: (lambda rr: rr if not rr.error else None)(
+                prepare(cd_sock, c)
+            ),
+            timeout=60, what="channel claim prepare after Ready",
+        )
+        _assert(
+            [d.device_name for d in result.devices] == ["channel-0"], result
+        )
+        # chan-inject parity: the injected surface carries the multi-host
+        # bootstrap identity + the per-CD config-dir mount.
+        envs = cdi_env_for(td, c["metadata"]["uid"])
+        env = dict(e.split("=", 1) for e in envs)
+        _assert(env.get("TPU_WORKER_ID") in {"0", "1"}, env)
+        _assert(env.get("TPU_WORKER_HOSTNAMES", "").count(",") == 1, env)
+        _assert(env.get("JAX_NUM_PROCESSES") == "2", env)
+
+    r.run("cd", "workload is gated until the domain is ready, then starts",
+          gated_then_starts)
+
+    def forged_namespace_rejected():
+        uid = cds["cd"]["metadata"]["uid"]
+        c = make_channel_claim("cd-demo-other", "forged", "channel-2", uid)
+        res = prepare(cd_sock, c)
+        _assert(
+            res.error and "namespace" in res.error.lower(),
+            f"cross-namespace forge was prepared: {res}",
+        )
+        kc.delete(RESOURCE_CLAIMS, "cd-demo-other", "forged")
+
+    r.run("chan-inject",
+          "channel claim forged in another namespace never prepares",
+          forged_namespace_rejected)
+
+    def daemon_crash_recovery():
+        uid = cds["cd"]["metadata"]["uid"]
+        proc, logf = stack.procs.pop("daemon-1")
+        proc.kill()
+        proc.wait(timeout=10)
+        logf.close()
+        wait_for(lambda: cd_status() == "NotReady", timeout=90,
+                 what="NotReady after daemon crash")
+        c2 = make_channel_claim(cd_ns, "wl2", "channel-1", uid)
+        cds["wl2"] = c2
+        res = prepare(cd_sock, c2)
+        _assert(
+            res.error and "not ready" in res.error.lower(),
+            f"claim prepared against a degraded domain: {res}",
+        )
+        # Pod restart: the host rejoins under a NEW pod IP, reclaims its
+        # stable index, and the domain converges back.
+        spawn_daemon(1, uid, pod_ip="10.0.9.9")
+        wait_for(lambda: cd_status() == "Ready", timeout=90,
+                 what="Ready after daemon restart")
+        result = wait_for(
+            lambda: (lambda rr: rr if not rr.error else None)(
+                prepare(cd_sock, c2)
+            ),
+            timeout=60, what="channel claim prepare after recovery",
+        )
+        _assert(
+            [d.device_name for d in result.devices] == ["channel-1"], result
+        )
+
+    r.run("failover", "daemon crash degrades the domain; restart recovers",
+          daemon_crash_recovery)
+
+    def ici_bandwidth_after_churn():
+        # The nvbandwidth analog (test_cd_failover.bats:32-46 payload):
+        # after daemon churn the fabric must still move bytes. Runs the
+        # REAL exerciser workload as its own process on a 4-device host
+        # mesh; --min-gbps gates it exactly as the Job spec does.
+        # sitecustomize may pin JAX to a real-TPU platform before env vars
+        # are consulted — override the lazy config in-process (the same
+        # dance tests/conftest.py does) so the exerciser gets a 4-device
+        # host mesh.
+        shim = (
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "jax.config.update('jax_num_cpu_devices', 4)\n"
+            "from tpu_dra.workloads.icibandwidth import main\n"
+            "raise SystemExit(main(['--size-mb', '1', '--reps', '2',"
+            " '--min-gbps', '0.001']))\n"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", shim],
+            env=dict(os.environ), cwd=str(REPO_ROOT),
+            capture_output=True, text=True, timeout=300,
+        )
+        _assert(out.returncode == 0, f"rc={out.returncode}: {out.stdout[-1500:]}\n{out.stderr[-1500:]}")
+        _assert("busbw_gbps" in out.stdout, out.stdout[-1500:])
+
+    r.run("failover", "ICI bandwidth exerciser passes after daemon churn",
+          ici_bandwidth_after_churn)
+
+    def duplicate_cd_namespaces():
+        doc = {
+            "apiVersion": "resource.tpu.google.com/v1beta1",
+            "kind": "ComputeDomain",
+            "metadata": {"name": "v5p-16", "namespace": "cd-demo2"},
+            "spec": cds["cd"]["spec"],
+        }
+        kc.create(COMPUTE_DOMAINS, doc)
+        wait_for(
+            lambda: _try(
+                lambda: kc.get(RESOURCE_CLAIM_TEMPLATES, "cd-demo2",
+                               "v5p-16-channel")
+            ),
+            what="duplicate-name RCT in cd-demo2",
+        )
+        kc.delete(COMPUTE_DOMAINS, "cd-demo2", "v5p-16")
+        wait_for(
+            lambda: _gone(
+                lambda: kc.get(COMPUTE_DOMAINS, "cd-demo2", "v5p-16")
+            ),
+            timeout=60, what="cd-demo2 domain deletion",
+        )
+
+    r.run("misc", "duplicate CD names in different namespaces coexist",
+          duplicate_cd_namespaces)
+
+    def delete_cleans_up():
+        # Workload claims release first (pods deleted), then the domain.
+        for key in ("wl", "wl2"):
+            res = unprepare(cd_sock, cds[key])
+            _assert(not res.error, res.error)
+            kc.delete(RESOURCE_CLAIMS, cd_ns, cds[key]["metadata"]["name"])
+        kc.delete(COMPUTE_DOMAINS, cd_ns, "v5p-16")
+        wait_for(
+            lambda: _gone(lambda: kc.get(COMPUTE_DOMAINS, cd_ns, "v5p-16")),
+            timeout=90, what="domain deletion (finalizer release)",
+        )
+        wait_for(
+            lambda: not kc.list(RESOURCE_CLAIM_TEMPLATES, cd_ns),
+            timeout=60, what="claim template cleanup",
+        )
+        wait_for(
+            lambda: not [
+                d for d in kc.list(DAEMON_SETS, DRIVER_NS)
+                if "compute-domain" in d["metadata"]["name"]
+            ],
+            timeout=60, what="per-CD DaemonSet cleanup",
+        )
+        # Node labels are removed (test_cd_workload.bats final jq).
+        def labels_clear():
+            for n in kc.list(NODES):
+                for k in (n["metadata"].get("labels") or {}):
+                    if k.startswith("resource.tpu.google.com/computeDomain"):
+                        return False
+            return True
+        wait_for(labels_clear, timeout=60, what="CD node label cleanup")
+        for name in ("daemon-0", "daemon-1"):
+            if name in stack.procs:
+                stack.stop(name)
+
+    r.run("cd", "deleting the domain cleans up DS, RCT, and node labels",
+          delete_cleans_up)
+
     return r.finish()
+
+
+def _try(fn):
+    try:
+        return fn()
+    except Exception:
+        return None
+
+
+def _gone(fn):
+    """Deletion check: the object is gone only on a genuine 404 — any
+    other failure (apiserver down, transport error) keeps the wait going
+    instead of vacuously confirming cleanup."""
+    try:
+        fn()
+        return False
+    except ApiNotFound:
+        return True
+    except Exception:
+        return False
 
 
 def _assert(cond, msg=""):

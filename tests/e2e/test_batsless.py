@@ -27,13 +27,16 @@ def test_batsless_suites(tmp_path):
         ],
         capture_output=True,
         text=True,
-        timeout=600,
+        timeout=900,
         cwd=REPO_ROOT,
     )
     sys.stderr.write(out.stdout[-4000:])
     assert out.returncode == 0, out.stdout[-4000:] + out.stderr[-2000:]
     text = log.read_text()
     assert "not ok" not in text
-    # All three suites actually executed.
-    for suite in ("basics:", "tpu:", "subslice:", "sharing:"):
+    # Every suite family actually executed.
+    for suite in (
+        "basics:", "tpu:", "subslice:", "sharing:",
+        "cd:", "misc:", "chan-inject:", "failover:",
+    ):
         assert f"- {suite}" in text
