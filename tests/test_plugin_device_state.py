@@ -624,3 +624,26 @@ def test_multi_vfio_per_request_merges_pci_addresses(tmp_path):
     }
     assert len(addrs) == 1  # identical merged list on every device
     assert addrs.pop().count(",") == 1
+
+
+def test_default_time_slice_needs_no_arbiter(tmp_path):
+    """With the TimeSlicingSettings gate ON, the DEFAULT TpuConfig applies
+    interval=Default to every plain claim (configs.py default_tpu_config) —
+    the reference's `--set-timeslice=default` reset (nvlib.go:772-815).
+    That must stay daemon-free: an exclusive claim has nothing to arbitrate
+    and must not stall Prepare on control-daemon readiness."""
+    gates(TimeSlicingSettings=True)
+    backend = FakeCluster()
+    state, _ = make_state(tmp_path, backend=backend)
+    claim = make_claim(["tpu-0"])  # no opaque config: default TpuConfig
+    # No _auto_ready_deployments controller: a spawned daemon would hang
+    # Prepare on assert_ready, so completing at all proves daemon-free.
+    state.prepare(claim)
+    chip = state.tpulib.chips()[0]
+    assert state.tpulib.get_time_slice(chip.uuid) == 0
+    deployments = ResourceClient(backend, DEPLOYMENTS)
+    assert deployments.list(namespace="tpu-dra-driver") == []
+    spec = state.cdi.read_claim_spec(claim["metadata"]["uid"])
+    env = spec["devices"][0]["containerEdits"]["env"]
+    assert not any(e.startswith("TPU_PROCESS_MULTIPLEXING") for e in env)
+    state.unprepare(claim["metadata"]["uid"])

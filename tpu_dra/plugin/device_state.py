@@ -585,23 +585,33 @@ class DeviceState:
             state.time_slice_ordinal = self.ts_manager.set_time_slice(
                 requested, tsc
             )
-            # Time-slicing is ENFORCED through the same per-claim control
-            # daemon as multiplexing, running in time-slice mode: the
-            # interval ordinal sets the lease quantum, and cooperating
-            # clients rotate at the quantum (multiplexd.py). Without this
-            # the ordinal would be advisory bookkeeping — the one wrong
-            # answer (reference execs nvidia-smi: nvlib.go:772-815).
-            if self.multiplex_manager is None:
-                raise PrepareError(
-                    "time-slicing needs the multiplex manager on this node"
+            # A non-Default interval is ENFORCED through the same
+            # per-claim control daemon as multiplexing, running in
+            # time-slice mode: the interval ordinal sets the lease
+            # quantum, and cooperating clients rotate at the quantum
+            # (multiplexd.py). Without this the ordinal would be advisory
+            # bookkeeping — the one wrong answer (reference execs
+            # nvidia-smi: nvlib.go:772-815). Interval "Default" (ordinal
+            # 0) is the reference's `--set-timeslice=default` reset: the
+            # gate-on DEFAULT TpuConfig applies it to every plain claim
+            # (configs.py default_tpu_config), so it must stay
+            # daemon-free — an exclusive claim spawning an arbiter would
+            # serialize nothing and stall Prepare on daemon readiness.
+            if state.time_slice_ordinal > 0:
+                if self.multiplex_manager is None:
+                    raise PrepareError(
+                        "time-slicing needs the multiplex manager on "
+                        "this node"
+                    )
+                daemon = self.multiplex_manager.new_control_daemon(
+                    claim["metadata"]["uid"], requested
                 )
-            daemon = self.multiplex_manager.new_control_daemon(
-                claim["metadata"]["uid"], requested
-            )
-            daemon.start(None, timeslice_ordinal=state.time_slice_ordinal)
-            daemon.assert_ready()
-            state.multiplex_daemon_id = daemon.get_id()
-            state.container_edits = daemon.container_edits()
+                daemon.start(
+                    None, timeslice_ordinal=state.time_slice_ordinal
+                )
+                daemon.assert_ready()
+                state.multiplex_daemon_id = daemon.get_id()
+                state.container_edits = daemon.container_edits()
 
         if fg.enabled(fg.MULTIPLEXING_SUPPORT) and sharing.is_multiplexing():
             if fg.enabled(fg.DYNAMIC_SUBSLICE):
