@@ -184,6 +184,10 @@ def bench_config():
             # ~51% MFU (512/512 -> 15.1k; 2048-row tiles OOM).
             attention_block_q=int(os.environ.get("BENCH_BLOCK_Q", "1024")),
             attention_block_k=int(os.environ.get("BENCH_BLOCK_K", "1024")),
+            # Streamed LM-head loss (ops/loss.py): avoids the [b, s, 32k]
+            # fp32 logit materialization that dominates HBM at this size.
+            fused_ce=os.environ.get("BENCH_FUSED_CE", "0") == "1",
+            ce_chunk=int(os.environ.get("BENCH_CE_CHUNK", "256")),
         )
         # Swept on-chip: batch 4 -> 15.4k, 6 -> 15.8k, 7 -> 14.9k tok/s
         # (8+ fails to compile within this chip's memory).

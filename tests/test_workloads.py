@@ -296,3 +296,49 @@ def test_ulysses_gqa_unrepeated_exchange():
     want = reference_attention(q, k, v, causal=True)
     got = ulysses_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5)
+
+
+def test_fused_ce_matches_unfused_loss_and_grads():
+    """ops/loss.py streamed LM-head loss == the materialized-logits loss,
+    for values AND gradients, including a chunk size that does not divide
+    the sequence (tail chunk zero-padded + masked) and the masked final
+    position."""
+    import dataclasses
+
+    # fp32 end-to-end: the comparison is about the chunked algorithm, not
+    # bf16 rounding (chunk-ordered sums flip the last bf16 bit on a few
+    # near-zero grad elements).
+    base = dataclasses.replace(
+        TINY_LLAMA, dtype=jnp.float32, param_dtype=jnp.float32
+    )
+    cfg = dataclasses.replace(base, fused_ce=True, ce_chunk=5)
+    fused = Llama(cfg)
+    plain = Llama(base)
+    params = plain.init_params(jax.random.PRNGKey(2), batch=2, seq=12)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(3), (2, 12), 0, TINY_LLAMA.vocab_size
+    ).astype(jnp.int32)
+
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda p: loss_fn(plain, p, tokens)
+    )(params)
+    fused_loss, fused_grads = jax.value_and_grad(
+        lambda p: loss_fn(fused, p, tokens)
+    )(params)
+
+    np.testing.assert_allclose(
+        float(fused_loss), float(ref_loss), rtol=2e-5
+    )
+    flat_ref = jax.tree_util.tree_leaves_with_path(ref_grads)
+    flat_fused = dict(
+        (jax.tree_util.keystr(k), v)
+        for k, v in jax.tree_util.tree_leaves_with_path(fused_grads)
+    )
+    for k, g_ref in flat_ref:
+        g = flat_fused[jax.tree_util.keystr(k)]
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32),
+            np.asarray(g_ref, np.float32),
+            rtol=1e-4, atol=1e-6,
+            err_msg=jax.tree_util.keystr(k),
+        )

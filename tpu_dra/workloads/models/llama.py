@@ -51,6 +51,12 @@ class LlamaConfig:
     # Flash-kernel tile sizes (pallas/auto paths); bench-swept.
     attention_block_q: int = 256
     attention_block_k: int = 256
+    # Stream the LM-head loss over sequence chunks instead of
+    # materializing [b, s, vocab] fp32 logits (ops/loss.py) — a large
+    # HBM win at real vocab sizes; the training step picks this up via
+    # the model's return_hidden path.
+    fused_ce: bool = False
+    ce_chunk: int = 256
 
     @property
     def head_dim(self) -> int:
@@ -207,8 +213,13 @@ class Llama(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
-        """tokens [b, s] int32 -> logits [b, s, vocab] (fp32)."""
+    def __call__(
+        self, tokens: jnp.ndarray, return_hidden: bool = False
+    ) -> jnp.ndarray:
+        """tokens [b, s] int32 -> logits [b, s, vocab] (fp32), or the
+        final-norm hidden states [b, s, dim] (compute dtype) when
+        ``return_hidden`` — the fused-loss path applies the LM head
+        chunk-by-chunk itself (ops/loss.py)."""
         c = self.config
         embed = nn.Embed(
             c.vocab_size,
@@ -246,6 +257,10 @@ class Llama(nn.Module):
                 x = blk(x, cos, sin)
 
         x = RMSNorm(c.norm_eps, c.param_dtype, name="final_norm")(x)
+        if return_hidden:
+            # The LM head is still initialized (init traces the default
+            # call); the fused loss reads its kernel from the param tree.
+            return x
         logits = nn.Dense(
             c.vocab_size,
             use_bias=False,

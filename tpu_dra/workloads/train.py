@@ -60,6 +60,19 @@ def loss_fn(model, params, tokens: jnp.ndarray) -> jnp.ndarray:
     aux = 0.0
     if hasattr(model, "apply_with_aux"):
         logits, aux = model.apply_with_aux(params, tokens)
+    elif getattr(getattr(model, "config", None), "fused_ce", False):
+        # Streamed LM-head loss: never materializes [b, s, vocab]
+        # (ops/loss.py); gradients reach the head through the kernel
+        # reference into the same param tree.
+        from tpu_dra.workloads.ops.loss import fused_next_token_xent
+
+        hidden = model.apply({"params": params}, tokens, return_hidden=True)
+        return fused_next_token_xent(
+            hidden,
+            params["lm_head"]["kernel"],
+            tokens,
+            chunk=model.config.ce_chunk,
+        )
     else:
         logits = model.apply({"params": params}, tokens)  # [b, s, v] fp32
     targets = tokens[:, 1:]
