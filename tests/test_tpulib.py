@@ -370,3 +370,59 @@ def test_linux_health_probe_vfio_and_unbound(tmp_path):
     _os.symlink(vfio_drv, real / "driver")
     healthy, reason = lib._probe_chip(unbound)
     assert healthy
+
+
+def test_benign_health_event_does_not_poison_chip_state():
+    """Benign-reason unhealthy events (the XID skip-list analog) are
+    queued for observability but never flip ChipInfo.healthy — otherwise
+    a later unrelated recompute would unpublish a healthy chip."""
+    lib = make_stub()
+    victim = lib.chips()[0]
+    lib.inject_health_event(
+        ChipHealthEvent(
+            chip_uuid=victim.uuid, healthy=False, reason="clock-throttle"
+        )
+    )
+    ev = lib.health_events().get_nowait()
+    assert ev.reason == "clock-throttle" and not ev.healthy
+    assert lib.chips()[0].healthy is True
+    # Real faults still mark.
+    lib.inject_health_event(
+        ChipHealthEvent(chip_uuid=victim.uuid, healthy=False, reason="hw")
+    )
+    assert lib.chips()[0].healthy is False
+
+
+def test_stub_health_file_channel(tmp_path):
+    """The stub's cross-process injection channel: a separate process
+    (e2e runner, kind demo) drops JSON files under
+    <state_dir>/health-events/ to break/heal fake chips."""
+    import json
+    import os
+    import time
+
+    lib = make_stub(tmp_path)
+    lib.start_health_monitor(period=0.05)
+    try:
+        events_dir = tmp_path / "state" / "health-events"
+        assert events_dir.is_dir()
+        (events_dir / "ev1.json").write_text(
+            json.dumps({"chip_index": 1, "healthy": False, "reason": "hbm"})
+        )
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and lib.chips()[1].healthy:
+            time.sleep(0.02)
+        assert lib.chips()[1].healthy is False
+        assert not (events_dir / "ev1.json").exists()  # consumed
+        ev = lib.health_events().get_nowait()
+        assert ev.chip_uuid == lib.chips()[1].uuid and ev.reason == "hbm"
+        # Heal by uuid.
+        (events_dir / "ev2.json").write_text(
+            json.dumps({"chip_uuid": lib.chips()[1].uuid, "healthy": True})
+        )
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not lib.chips()[1].healthy:
+            time.sleep(0.02)
+        assert lib.chips()[1].healthy is True
+    finally:
+        lib.stop_health_monitor()

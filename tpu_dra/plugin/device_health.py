@@ -19,18 +19,18 @@ import threading
 from typing import Callable, List, Optional
 
 from tpu_dra.tpulib.interface import TpuLib
-from tpu_dra.tpulib.types import ChipHealthEvent
+from tpu_dra.tpulib.types import (
+    BENIGN_HEALTH_REASONS,
+    ChipHealthEvent,
+)
+
+# The canonical skip-list lives in tpulib (filtered at injection time so
+# benign events never poison ChipInfo.healthy); aliased here for the
+# monitor's own skip and for compatibility.
+BENIGN_REASONS = BENIGN_HEALTH_REASONS
 
 log = logging.getLogger(__name__)
 
-# Benign event reasons that must not mark a chip unhealthy.
-BENIGN_REASONS = frozenset(
-    {
-        "preemption",  # workload preempted, chip fine
-        "clock-throttle",  # thermal/power capping
-        "application-error",  # user program crash, not a chip fault
-    }
-)
 
 
 class DeviceHealthMonitor:

@@ -28,6 +28,7 @@ from typing import Dict, List, Optional
 from tpu_dra.tpulib import native
 from tpu_dra.tpulib.interface import SubsliceInfo, TpuLib, TpuLibError
 from tpu_dra.tpulib.types import (
+    BENIGN_HEALTH_REASONS,
     ChipHealthEvent,
     ChipInfo,
     Generation,
@@ -255,14 +256,21 @@ class BaseTpuLib(TpuLib):
         backend this is driven by sysfs/runtime monitors; tests and the stub
         drive it directly (the XID fault-injection seam the reference lacks).
 
+        Benign-reason unhealthy events (types.BENIGN_HEALTH_REASONS — the
+        XID skip-list analog) are queued for observability but never
+        mutate chip state: marking here would let a later, unrelated
+        recompute unpublish a healthy chip.
+
         Taken under the backend lock so the health write is ordered against
         in-flight sub-slice creation (whose healthy check also holds it):
         an event racing a create lands after it and the republish path then
         unpublishes the affected devices."""
-        with self._lock:
-            for c in self.chips():
-                if c.uuid == ev.chip_uuid:
-                    c.healthy = ev.healthy
+        benign = not ev.healthy and ev.reason in BENIGN_HEALTH_REASONS
+        if not benign:
+            with self._lock:
+                for c in self.chips():
+                    if c.uuid == ev.chip_uuid:
+                        c.healthy = ev.healthy
         self._health_q.put(ev)
 
     def start_health_monitor(self, period: float = 5.0) -> None:

@@ -26,8 +26,10 @@ Config schema::
 from __future__ import annotations
 
 import hashlib
+import json
 import logging
 import os
+import threading
 import uuid as uuidlib
 from typing import Dict, List, Optional
 
@@ -37,6 +39,7 @@ from tpu_dra.tpulib.base import BaseTpuLib
 from tpu_dra.tpulib.interface import SubsliceInfo, TpuLibError
 from tpu_dra.tpulib.types import (
     GENERATIONS,
+    ChipHealthEvent,
     ChipInfo,
     Generation,
     IciDomain,
@@ -137,3 +140,60 @@ class StubTpuLib(BaseTpuLib):
         if msg:
             raise TpuLibError(f"injected fault: {msg}")
         super().delete_subslice(uuid)
+
+    # --- cross-process health injection ---
+    # The linux backend produces health events from kernel surfaces
+    # (linux.py _probe_chip); the stub's monitor polls
+    # ``<state_dir>/health-events/*.json`` so a SEPARATE process (e2e
+    # runner, kind demo script) can break/heal fake chips:
+    #
+    #   {"chip_uuid": "..."| "chip_index": 0, "healthy": false,
+    #    "reason": "injected"}
+    #
+    # Each file is consumed (deleted) once injected. In-process tests can
+    # keep calling inject_health_event directly.
+
+    def start_health_monitor(self, period: float = 0.5) -> None:
+        if self._state_dir is None or getattr(self, "_hm_thread", None):
+            return
+        events_dir = os.path.join(self._state_dir, "health-events")
+        os.makedirs(events_dir, exist_ok=True)
+        self._hm_stop = threading.Event()
+
+        def loop():
+            while not self._hm_stop.wait(period):
+                for name in sorted(os.listdir(events_dir)):
+                    if not name.endswith(".json"):
+                        continue
+                    path = os.path.join(events_dir, name)
+                    try:
+                        with open(path) as f:
+                            raw = json.load(f)
+                        os.unlink(path)
+                    except (OSError, ValueError):
+                        continue  # partially written; retry next tick
+                    uuid = raw.get("chip_uuid")
+                    if uuid is None and "chip_index" in raw:
+                        idx = int(raw["chip_index"])
+                        if 0 <= idx < len(self._chips):
+                            uuid = self._chips[idx].uuid
+                    if not uuid:
+                        log.warning("health-event file %s names no chip", name)
+                        continue
+                    self.inject_health_event(ChipHealthEvent(
+                        chip_uuid=uuid,
+                        healthy=bool(raw.get("healthy", False)),
+                        reason=str(raw.get("reason", "injected")),
+                    ))
+
+        self._hm_thread = threading.Thread(
+            target=loop, daemon=True, name="stub-health-file-poller"
+        )
+        self._hm_thread.start()
+
+    def stop_health_monitor(self) -> None:
+        if getattr(self, "_hm_thread", None) is None:
+            return
+        self._hm_stop.set()
+        self._hm_thread.join(timeout=5)
+        self._hm_thread = None

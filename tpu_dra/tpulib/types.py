@@ -227,3 +227,17 @@ class ChipHealthEvent:
     chip_uuid: str
     healthy: bool
     reason: str = ""
+
+
+# Event reasons that must not mark a chip unhealthy (the XID skip-list
+# analog, device_health.go:306-351). Filtered at INJECTION time so a
+# benign event can never poison ChipInfo.healthy and get the chip
+# unpublished by a later, unrelated health recompute — the reference
+# likewise drops skipped XIDs before any marking.
+BENIGN_HEALTH_REASONS = frozenset(
+    {
+        "preemption",  # workload preempted, chip fine
+        "clock-throttle",  # thermal/power capping
+        "application-error",  # user program crash, not a chip fault
+    }
+)
