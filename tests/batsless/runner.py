@@ -24,10 +24,15 @@ to reference dynmig parity — /root/reference/tests/bats/
 test_gpu_dynmig.bats:55-90: published shared counters, overlap
 rejection, post-unprepare obliteration), test_tpu_sharing
 (multiplexing + enforced time-slice rotation, with the NATIVE arbiter
-binary playing the control-daemon pod), and the ComputeDomain family —
+binary playing the control-daemon pod), the ComputeDomain family —
 test_cd_workload, test_cd_misc, test_cd_chan_inject, test_cd_failover —
 with the controller and two slice daemons as real processes and the
-ICI bandwidth exerciser as the failover payload.
+ICI bandwidth exerciser as the failover payload, test_tpu_updowngrade
+(checkpoint V1<->V2 across chart rollouts), test_tpu_extres,
+test_tpu_stress (churn + overcommit), test_cd_logging (t_prep_*
+markers), and a device-health suite (file-injected chip faults ->
+unpublish / benign skip / recovery republish) the bats suites cannot
+express without real hardware.
 """
 
 from __future__ import annotations
@@ -87,6 +92,29 @@ def wait_for(pred, timeout=60, tick=0.2, what="condition"):
     raise TimeoutError(f"timed out waiting for {what}")
 
 
+def wait_for_socket(path, timeout=60, what="socket"):
+    """Wait until a unix socket ACCEPTS connections. Existence alone races
+    with restarts: the previous instance's socket file can linger while
+    the new server hasn't bound yet, and the first RPC then hits
+    connection-refused."""
+    import socket as socketlib
+
+    def connectable():
+        if not os.path.exists(path):
+            return False
+        s = socketlib.socket(socketlib.AF_UNIX, socketlib.SOCK_STREAM)
+        try:
+            s.settimeout(1.0)
+            s.connect(str(path))
+            return True
+        except OSError:
+            return False
+        finally:
+            s.close()
+
+    return wait_for(connectable, timeout=timeout, what=what)
+
+
 def _rpc(sock, method, request, response_cls, timeout=30):
     with grpc.insecure_channel(f"unix://{sock}") as ch:
         fn = ch.unary_unary(
@@ -112,8 +140,23 @@ class Stack:
     def _spawn(self, name, cmd, env_extra):
         env = dict(os.environ)
         env.pop("TPU_DRA_CDI_HOOK", None)
+        # Driver processes run the stub backend and never need a real
+        # chip. Environments that route jax at a TPU from interpreter
+        # startup (sitecustomize) would serialize every spawned process
+        # behind the chip — a concurrent workload then wedges plugin
+        # startup entirely. Pin them to CPU jax.
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
         env.update(env_extra)
-        logf = open(self.td / f"{name}.log", "wb")
+        # Rotate instead of truncating: earlier instances' logs stay
+        # inspectable (td/<name>.N.log), current instance at td/<name>.log.
+        cur = self.td / f"{name}.log"
+        if cur.exists():
+            n = 1
+            while (self.td / f"{name}.{n}.log").exists():
+                n += 1
+            cur.rename(self.td / f"{name}.{n}.log")
+        logf = open(cur, "wb")
         self.procs[name] = (
             subprocess.Popen(
                 cmd, env=env,
@@ -351,7 +394,7 @@ def start_tpu_plugin(
         TPU_DRA_BACKEND="stub",
         TPU_DRA_STUB_CONFIG=stub_cfg(td / "stub.yaml", td / "tpustate"),
     )
-    wait_for((td / "tpu-plugin" / "dra.sock").exists, what="tpu plugin socket")
+    wait_for_socket(td / "tpu-plugin" / "dra.sock", what="tpu plugin socket")
     return td / "tpu-plugin" / "dra.sock"
 
 
@@ -1113,6 +1156,281 @@ def run_suites(r: Runner, stack: Stack, td: Path) -> int:
 
     r.run("cd", "deleting the domain cleans up DS, RCT, and node labels",
           delete_cleans_up)
+
+    # ---- test_tpu_updowngrade ----
+    # Chart upgrade/downgrade with live state: a prepared claim must
+    # survive driver rollouts in both directions — the checkpoint carries
+    # V1 and V2 schema renderings so either driver version can read it.
+
+    checkpoint_path = td / "tpu-plugin" / "checkpoint.json"
+    ud = {}
+
+    def claim_survives_upgrade():
+        c = make_claim(kc, "bats-updowngrade", "sleeper", "tpu-3")
+        ud["c"] = c
+        res = prepare(sock, c)
+        _assert(not res.error, res.error)
+        ud["devices"] = [d.device_name for d in res.devices]
+        # Chart upgrade + plugin rollout (the DaemonSet pod restarts).
+        install_chart(kc, ["logVerbosity=7"], r.log)
+        stack.stop("tpu-plugin")
+        start_tpu_plugin(stack, td, extra_args=("-v", "7"))
+        # The sleeper pod never restarted: kubelet's re-Prepare must be
+        # answered from the restored checkpoint with the same devices.
+        res2 = prepare(sock, c)
+        _assert(not res2.error, res2.error)
+        _assert(
+            [d.device_name for d in res2.devices] == ud["devices"],
+            f"devices drifted across rollout: {res2.devices}",
+        )
+        envs = cdi_env_for(td, c["metadata"]["uid"])
+        _assert(
+            any(e.startswith("TPU_VISIBLE_DEVICES=") for e in envs), envs
+        )
+
+    r.run("updowngrade", "prepared claim survives a chart upgrade rollout",
+          claim_survives_upgrade)
+
+    def checkpoint_dual_rendering():
+        top = json.loads(checkpoint_path.read_text())
+        _assert("v1" in top and "v2" in top, sorted(top))
+
+    r.run("updowngrade", "node checkpoint carries both V1 and V2 renderings",
+          checkpoint_dual_rendering)
+
+    def downgrade_reads_v1():
+        # Downgrade: an old driver would have written a V1-only file.
+        # Strip v2 (the top-level checksum covers the v1 view alone, so
+        # the stripped file is byte-for-byte what V1 drivers produce) and
+        # assert the restarted plugin still knows the claim.
+        stack.stop("tpu-plugin")
+        top = json.loads(checkpoint_path.read_text())
+        checkpoint_path.write_text(
+            json.dumps({"checksum": top["checksum"], "v1": top["v1"]})
+        )
+        start_tpu_plugin(stack, td, extra_args=("-v", "7"))
+        res = prepare(sock, ud["c"])
+        _assert(not res.error, res.error)
+        _assert(
+            [d.device_name for d in res.devices] == ud["devices"],
+            f"devices drifted across downgrade: {res.devices}",
+        )
+        # The next checkpoint write re-materializes the dual rendering.
+        c2 = make_claim(kc, "bats-updowngrade", "post-downgrade", "tpu-2")
+        res = prepare(sock, c2)
+        _assert(not res.error, res.error)
+        top = json.loads(checkpoint_path.read_text())
+        _assert("v2" in top, sorted(top))
+        res = unprepare(sock, c2)
+        _assert(not res.error, res.error)
+        kc.delete(RESOURCE_CLAIMS, "bats-updowngrade", "post-downgrade")
+
+    r.run("updowngrade", "V1-only checkpoint from an older driver is migrated",
+          downgrade_reads_v1)
+
+    def unprepare_after_upgrades():
+        res = unprepare(sock, ud["c"])
+        _assert(not res.error, res.error)
+        kc.delete(RESOURCE_CLAIMS, "bats-updowngrade", "sleeper")
+        _assert(
+            cdi_env_for(td, ud["c"]["metadata"]["uid"]) == [],
+            "CDI spec survived unprepare",
+        )
+        top = json.loads(checkpoint_path.read_text())
+        _assert(
+            not top["v2"].get("preparedClaims"),
+            top["v2"].get("preparedClaims"),
+        )
+
+    r.run("updowngrade", "claim unprepare still works after the upgrades",
+          unprepare_after_upgrades)
+
+    # ---- test_tpu_extres ----
+    # extendedResourceName bridging is only served on resource.k8s.io/v1
+    # clusters (the fakeserver speaks v1beta1), so assert the rendered
+    # chart surface exactly as the bats suite's first test does.
+
+    def extres_bridge_rendered():
+        docs = render_chart(
+            str(CHART), values_overrides=None, namespace=DRIVER_NS,
+            api_versions=["resource.k8s.io/v1"],
+        )
+        dcs = [
+            d for d in docs
+            if d.get("kind") == "DeviceClass"
+            and d["metadata"]["name"] == "tpu.google.com"
+        ]
+        _assert(dcs, "tpu.google.com DeviceClass not rendered")
+        _assert(
+            dcs[0]["spec"].get("extendedResourceName") == "google.com/tpu",
+            dcs[0]["spec"],
+        )
+        # ...and on pre-v1 clusters the field must NOT be rendered (the
+        # apiserver would reject it).
+        old = render_chart(
+            str(CHART), values_overrides=None, namespace=DRIVER_NS,
+            api_versions=[],
+        )
+        dcs_old = [
+            d for d in old
+            if d.get("kind") == "DeviceClass"
+            and d["metadata"]["name"] == "tpu.google.com"
+        ]
+        _assert(
+            "extendedResourceName" not in dcs_old[0]["spec"], dcs_old[0]
+        )
+
+    r.run("extres", "DeviceClass advertises the extended-resource bridge",
+          extres_bridge_rendered)
+
+    # ---- test_cd_logging (timing-log observability) ----
+    # The plugin has been running at -v 7 since the upgrade test; its log
+    # must carry the t_prep_* wall-time markers (the observability basis
+    # for the claim-latency metric) and no ERROR lines from the clean
+    # cycles above.
+
+    def timing_markers_logged():
+        text = (td / "tpu-plugin.log").read_text()
+        _assert("t_prep_lock_acq" in text, "t_prep_lock_acq missing")
+        _assert("t_prep_total" in text, "t_prep_total missing")
+
+    r.run("logging", "prepare emits t_prep_* timing markers",
+          timing_markers_logged)
+
+    def no_errors_in_happy_path():
+        # The log was truncated on the last restart (downgrade test), so
+        # everything in it came from clean prepare/unprepare churn.
+        lines = (td / "tpu-plugin.log").read_text().splitlines()
+        errors = [
+            ln for ln in lines
+            if " E " in ln or " C " in ln
+        ]
+        _assert(errors == [], f"error lines in happy path: {errors[:5]}")
+
+    r.run("logging", "happy-path churn leaves no ERROR lines",
+          no_errors_in_happy_path)
+
+    # ---- test_tpu_stress ----
+    # Claim churn: the checkpointed state machine must never
+    # double-allocate or leak prepared devices.
+
+    def churn_waves():
+        churn_uids = []
+        for wave in range(5):
+            claims_w = [
+                make_claim(
+                    kc, "bats-stress", f"churn-{wave}-{j}", f"tpu-{j}"
+                )
+                for j in range(4)
+            ]
+            churn_uids.extend(c["metadata"]["uid"] for c in claims_w)
+            boxes = [prepare_async(c) for c in claims_w]
+            for t, _ in boxes:
+                t.join(timeout=60)
+            for _, box in boxes:
+                assert_prepared(box)
+            for c in claims_w:
+                res = unprepare(sock, c)
+                _assert(not res.error, res.error)
+                kc.delete(
+                    RESOURCE_CLAIMS, "bats-stress", c["metadata"]["name"]
+                )
+        top = json.loads(checkpoint_path.read_text())
+        _assert(
+            not top["v2"].get("preparedClaims"),
+            f"leaked claims: {top['v2'].get('preparedClaims')}",
+        )
+        # Per-claim transient specs are named by claim uid (cdi.py): a
+        # leaked spec for any churn claim is a leak.
+        leaked = [
+            f.name
+            for f in (td / "cdi").glob("*.json")
+            if any(uid in f.name for uid in churn_uids)
+        ]
+        _assert(leaked == [], f"leaked CDI specs: {leaked}")
+
+    r.run("stress", "20 claim cycles in waves of 4 leave no leaked state",
+          churn_waves)
+
+    def overcommit_then_release():
+        over = [
+            make_claim(kc, "bats-stress", f"over-{j}", f"tpu-{j}")
+            for j in range(4)
+        ]
+        for c in over:
+            res = prepare(sock, c)
+            _assert(not res.error, res.error)
+        # 5th single-chip claim on a 4-chip host: every chip is held by
+        # another claim, so Prepare must refuse (the double-allocation
+        # defense the scheduler normally prevents upstream).
+        c5 = make_claim(kc, "bats-stress", "over-5", "tpu-0")
+        res = prepare(sock, c5)
+        _assert(res.error, "overcommitted claim was prepared")
+        # One release later the pending claim schedules.
+        res = unprepare(sock, over[0])
+        _assert(not res.error, res.error)
+        res = prepare(sock, c5)
+        _assert(not res.error, res.error)
+        for c in over[1:] + [c5]:
+            res = unprepare(sock, c)
+            _assert(not res.error, res.error)
+        for c in over + [c5]:
+            kc.delete(RESOURCE_CLAIMS, "bats-stress", c["metadata"]["name"])
+
+    r.run("stress", "overcommit claim is refused, then prepares after release",
+          overcommit_then_release)
+
+    # ---- device health (SURVEY §5 failure detection) ----
+    # No bats analog — the reference's XID path needs real hardware to
+    # fault. The stub's file channel (<state_dir>/health-events/) plays
+    # the kernel: break a fake chip from OUTSIDE the plugin process and
+    # assert the republish path, the benign skip-list, and recovery
+    # (an improvement over the reference, which needs a plugin restart
+    # to re-publish a recovered device — driver.go:487-497).
+
+    def reinstall_health():
+        install_chart(kc, ["featureGates.DeviceHealthCheck=true"], r.log)
+        stack.stop("tpu-plugin")
+        start_tpu_plugin(stack, td, gates="DeviceHealthCheck=true")
+        wait_for(lambda: tpu_slices(kc), what="slices after health restart")
+
+    r.run("health", "chart upgrade flips the DeviceHealthCheck gate",
+          reinstall_health)
+
+    events_dir = td / "tpustate" / "health-events"
+
+    def inject(payload):
+        events_dir.mkdir(parents=True, exist_ok=True)
+        (events_dir / f"ev-{uuidlib.uuid4().hex[:8]}.json").write_text(
+            json.dumps(payload)
+        )
+
+    def published():
+        return [d["name"] for d in slice_devices(kc)]
+
+    def unhealthy_unpublished():
+        _assert("tpu-2" in published(), published())
+        inject({"chip_index": 2, "healthy": False, "reason": "ici-link-down"})
+        wait_for(lambda: "tpu-2" not in published(),
+                 what="unhealthy tpu-2 unpublished")
+
+    r.run("health", "unhealthy chip is unpublished from resource slices",
+          unhealthy_unpublished)
+
+    def benign_ignored():
+        inject({"chip_index": 1, "healthy": False, "reason": "clock-throttle"})
+        time.sleep(2.0)  # > injection poll period; would have republished
+        _assert("tpu-1" in published(), published())
+
+    r.run("health", "benign event reasons never unpublish", benign_ignored)
+
+    def recovery_republishes():
+        inject({"chip_index": 2, "healthy": True, "reason": "recovered"})
+        wait_for(lambda: "tpu-2" in published(),
+                 what="recovered tpu-2 republished")
+
+    r.run("health", "recovered chip is republished without a restart",
+          recovery_republishes)
 
     return r.finish()
 

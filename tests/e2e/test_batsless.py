@@ -38,5 +38,6 @@ def test_batsless_suites(tmp_path):
     for suite in (
         "basics:", "tpu:", "subslice:", "sharing:",
         "cd:", "misc:", "chan-inject:", "failover:",
+        "updowngrade:", "extres:", "stress:", "logging:", "health:",
     ):
         assert f"- {suite}" in text

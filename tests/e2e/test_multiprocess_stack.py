@@ -83,6 +83,11 @@ class Stack:
     def _spawn(self, name, cmd, env_extra):
         env = dict(os.environ)
         env.pop("TPU_DRA_CDI_HOOK", None)
+        # Stub-backend driver processes must not touch a real chip:
+        # sitecustomize-style TPU routing would serialize them behind
+        # whatever workload holds it (see tests/batsless/runner.py).
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
         env.update(env_extra)
         logf = open(self.td / f"{name}.log", "wb")
         self.procs[name] = (
