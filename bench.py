@@ -188,6 +188,10 @@ def bench_config():
             # fp32 logit materialization that dominates HBM at this size.
             fused_ce=os.environ.get("BENCH_FUSED_CE", "0") == "1",
             ce_chunk=int(os.environ.get("BENCH_CE_CHUNK", "256")),
+            # Unrolled layers (BENCH_SCAN=0, default): slower compile,
+            # ~1.7% more tok/s than nn.scan — XLA schedules across layer
+            # boundaries (measured on v5e: 17.56k vs 17.27k fetch-timed).
+            scan_layers=os.environ.get("BENCH_SCAN", "0") == "1",
         )
         # Swept on-chip: batch 4 -> 15.4k, 6 -> 15.8k, 7 -> 14.9k tok/s
         # (8+ fails to compile within this chip's memory).
@@ -220,13 +224,18 @@ def measure_tokens_per_sec() -> dict:
     state = trainer.init_state(batch=batch, seq=seq)
     step = trainer.make_train_step()
     tokens = jnp.ones((batch, seq), dtype=jnp.int32)
-    # Warmup / compile.
+    # Warmup / compile. Timing is closed with a HOST FETCH
+    # (icibandwidth.fetch), not block_until_ready: on deferring backends
+    # (the axon tunnel) block_until_ready can return before execution
+    # finishes and the measurement overstates throughput wildly.
+    from tpu_dra.workloads.icibandwidth import fetch
+
     state, loss = step(state, tokens)
-    loss.block_until_ready()
+    fetch(loss)
     t0 = time.monotonic()
     for _ in range(steps):
         state, loss = step(state, tokens)
-    loss.block_until_ready()
+    fetch(loss)
     dt = time.monotonic() - t0
     total_tokens = batch * seq * steps
     return {

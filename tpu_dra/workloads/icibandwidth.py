@@ -38,7 +38,7 @@ import time
 from typing import Dict, Optional
 
 
-def _fetch(y) -> float:
+def fetch(y) -> float:
     """Force completion with a host read of a FULL reduction: on deferring
     backends (the axon tunnel) ``block_until_ready`` can return before
     execution, and fetching one element lets the compiler dead-code the
@@ -54,14 +54,14 @@ def _timed_pair(run1, run_n, x, reps: int, outer: int = 3) -> float:
     """Per-op seconds by DIFFERENTIAL timing: a 1-iteration loop vs an
     N-iteration loop (both fetched), cancelling dispatch + transfer
     overhead that would otherwise swamp a single op."""
-    _fetch(run1(x))
-    _fetch(run_n(x))
+    fetch(run1(x))
+    fetch(run_n(x))
 
     def best(run):
         b = float("inf")
         for _ in range(outer):
             t0 = time.perf_counter()
-            _fetch(run(x))
+            fetch(run(x))
             b = min(b, time.perf_counter() - t0)
         return b
 

@@ -250,11 +250,15 @@ class Llama(nn.Module):
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(c, name="layers")(x, cos, sin)
         else:
+            # nn.remat is a lifted transform: it wraps the CLASS (an
+            # instance target raises TransformTargetError).
+            block_cls = LlamaBlock
+            if c.remat:
+                block_cls = nn.remat(
+                    LlamaBlock, policy=_remat_policy(c.remat_policy)
+                )
             for i in range(c.n_layers):
-                blk = LlamaBlock(c, name=f"layer_{i}")
-                if c.remat:
-                    blk = nn.remat(blk, policy=_remat_policy(c.remat_policy))
-                x = blk(x, cos, sin)
+                x = block_cls(c, name=f"layer_{i}")(x, cos, sin)
 
         x = RMSNorm(c.norm_eps, c.param_dtype, name="final_norm")(x)
         if return_hidden:
