@@ -470,10 +470,7 @@ def run_suites(r: Runner, stack: Stack, td: Path) -> int:
 
     r.run("basics", "DeviceClasses exist", deviceclasses_exist)
 
-    def slices_published():
-        wait_for(lambda: tpu_slices(kc), what="tpu.google.com slices")
-        # The CD plugin publishes under its own driver name; start it too
-        # (second node agent of the chart's DaemonSet).
+    def start_cd_plugin():
         stack.spawn(
             "cd-plugin",
             ["tpu_dra.computedomain.cdplugin.main",
@@ -485,6 +482,14 @@ def run_suites(r: Runner, stack: Stack, td: Path) -> int:
             TPU_DRA_BACKEND="stub",
             TPU_DRA_STUB_CONFIG=stub_cfg(td / "stub-cd.yaml"),
         )
+        wait_for_socket(td / "cd-plugin" / "dra.sock",
+                        what="cd plugin socket")
+
+    def slices_published():
+        wait_for(lambda: tpu_slices(kc), what="tpu.google.com slices")
+        # The CD plugin publishes under its own driver name; start it too
+        # (second node agent of the chart's DaemonSet).
+        start_cd_plugin()
         wait_for(
             lambda: tpu_slices(kc, CD_DRIVER_NAME),
             what="compute-domain slices",
@@ -897,7 +902,7 @@ def run_suites(r: Runner, stack: Stack, td: Path) -> int:
             driver=CD_DRIVER_NAME, pool="node-0-cd",
         )
 
-    def spawn_daemon(i, cd_uid, pod_ip=None):
+    def spawn_daemon(i, cd_uid, pod_ip=None, namespace=cd_ns):
         cfg_dir = (
             td / "cd-plugin" / "domains" / cd_uid
             if i == 0
@@ -909,7 +914,7 @@ def run_suites(r: Runner, stack: Stack, td: Path) -> int:
             ["tpu_dra.computedomain.daemon.main", "run",
              "--kubeconfig", stack.kubeconfig,
              "--cd-uid", cd_uid, "--cd-name", "v5p-16",
-             "--cd-namespace", cd_ns,
+             "--cd-namespace", namespace,
              "--num-nodes", "2", "--node-name", f"node-{i}",
              "--pod-ip", pod_ip or f"10.0.0.{i + 1}",
              "--config-dir", str(cfg_dir),
@@ -1431,6 +1436,75 @@ def run_suites(r: Runner, stack: Stack, td: Path) -> int:
 
     r.run("health", "recovered chip is republished without a restart",
           recovery_republishes)
+
+    # ---- test_cd_updowngrade ----
+    # A prepared channel claim must survive a cd-plugin rollout: the CD
+    # plugin's checkpoint (same V1+V2 dual rendering as the TPU plugin's)
+    # answers the kubelet's re-Prepare after restart.
+
+    cdu_ns = "cd-up"
+    cdu = {}
+
+    def cd_claim_survives_plugin_rollout():
+        doc = {
+            "apiVersion": "resource.tpu.google.com/v1beta1",
+            "kind": "ComputeDomain",
+            "metadata": {"name": "v5p-16", "namespace": cdu_ns},
+            "spec": cds["cd"]["spec"],
+        }
+        cd2 = kc.create(COMPUTE_DOMAINS, doc)
+        uid = cd2["metadata"]["uid"]
+        cdu["uid"] = uid
+        for i in range(2):
+            spawn_daemon(i, uid, namespace=cdu_ns)
+        wait_for(lambda: cd_status(cdu_ns) == "Ready", timeout=90,
+                 what="cd-up domain Ready")
+        c = make_channel_claim(cdu_ns, "wl-up", "channel-3", uid)
+        cdu["claim"] = c
+        result = wait_for(
+            lambda: (lambda rr: rr if not rr.error else None)(
+                prepare(cd_sock, c)
+            ),
+            timeout=60, what="channel claim prepare",
+        )
+        cdu["devices"] = [d.device_name for d in result.devices]
+        # The rollout: restart the CD kubelet plugin process.
+        stack.stop("cd-plugin")
+        start_cd_plugin()
+        res2 = prepare(cd_sock, c)
+        _assert(not res2.error, res2.error)
+        _assert(
+            [d.device_name for d in res2.devices] == cdu["devices"],
+            f"devices drifted across cd-plugin rollout: {res2.devices}",
+        )
+
+    r.run("cd-updowngrade",
+          "prepared channel claim survives a cd-plugin rollout",
+          cd_claim_survives_plugin_rollout)
+
+    def cd_checkpoint_dual_rendering():
+        top = json.loads((td / "cd-plugin" / "checkpoint.json").read_text())
+        _assert("v1" in top and "v2" in top, sorted(top))
+
+    r.run("cd-updowngrade",
+          "cd-plugin checkpoint carries both V1 and V2 renderings",
+          cd_checkpoint_dual_rendering)
+
+    def cd_unprepare_after_rollout():
+        res = unprepare(cd_sock, cdu["claim"])
+        _assert(not res.error, res.error)
+        kc.delete(RESOURCE_CLAIMS, cdu_ns, "wl-up")
+        kc.delete(COMPUTE_DOMAINS, cdu_ns, "v5p-16")
+        wait_for(
+            lambda: _gone(lambda: kc.get(COMPUTE_DOMAINS, cdu_ns, "v5p-16")),
+            timeout=90, what="cd-up domain deletion",
+        )
+        for name in ("daemon-0", "daemon-1"):
+            if name in stack.procs:
+                stack.stop(name)
+
+    r.run("cd-updowngrade", "claim unprepare still works after the rollout",
+          cd_unprepare_after_rollout)
 
     return r.finish()
 

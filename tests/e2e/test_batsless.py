@@ -39,5 +39,6 @@ def test_batsless_suites(tmp_path):
         "basics:", "tpu:", "subslice:", "sharing:",
         "cd:", "misc:", "chan-inject:", "failover:",
         "updowngrade:", "extres:", "stress:", "logging:", "health:",
+        "cd-updowngrade:",
     ):
         assert f"- {suite}" in text

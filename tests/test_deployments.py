@@ -231,3 +231,16 @@ def test_dockerfile_consistency():
     assert NATIVE_LIB_ENV in text
     assert "make -C native" in text
     assert os.path.exists(os.path.join(REPO, "native", "Makefile"))
+
+
+def test_kubeletplugin_mounts_host_sysfs():
+    """Driver-root resolution (root.go:29-87 analog): the tpus container
+    must see the host's sysfs under /host-sys and point the plugin at it,
+    or vfio driver rebind and linux-backend PCI enumeration fail
+    in-container."""
+    text = read(os.path.join(TEMPLATES, "kubeletplugin.yaml"))
+    # BOTH node agents run the linux tpulib backend by default, so both
+    # containers need the prefix env + mount.
+    assert text.count("TPU_DRA_SYSFS_ROOT") == 2
+    assert text.count("mountPath: /host-sys") == 2
+    assert "path: /sys" in text

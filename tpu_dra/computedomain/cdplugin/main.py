@@ -36,6 +36,18 @@ def main(argv=None) -> int:
         ),
     )
     p.add_argument("--backend", default=flags.env_default("TPU_DRA_BACKEND", ""))
+    # Driver-root resolution (root.go:29-87 analog), same as the TPU
+    # plugin: the containerized plugin sees host trees under a prefix.
+    p.add_argument(
+        "--sysfs-root",
+        default=flags.env_default("TPU_DRA_SYSFS_ROOT", "/sys"),
+        help="Host sysfs mount (PCI/slice enumeration)",
+    )
+    p.add_argument(
+        "--dev-root",
+        default=flags.env_default("TPU_DRA_DEV_ROOT", "/dev"),
+        help="Host /dev mount",
+    )
     p.add_argument(
         "--fake-cluster",
         action="store_true",
@@ -68,7 +80,11 @@ def main(argv=None) -> int:
     # Clique identity from local tpulib (nvlib.go:188-357 analog).
     clique_id = ""
     try:
-        tpulib = new_tpulib(args.backend)
+        tpulib = new_tpulib(
+            args.backend,
+            sysfs_root=args.sysfs_root,
+            dev_root=args.dev_root,
+        )
         ici = tpulib.ici_domain()
         clique_id = ici.clique_id() if ici else ""
     except Exception as e:

@@ -61,6 +61,9 @@ class DriverConfig:
     # Shipped hook binary staged into plugin_data_dir at startup
     # (setNvidiaCDIHookPath analog); "" or missing file disables hooks.
     cdi_hook_source: str = "/usr/local/bin/tpu-cdi-hook"
+    # Driver-root resolution (root.go:29-87 analog): host sysfs mount
+    # prefix for the vfio manager's driver rebind plumbing.
+    sysfs_root: str = "/sys"
 
 
 class Driver:
@@ -89,7 +92,7 @@ class Driver:
             image=config.multiplex_image,
             socket_root=config.multiplex_socket_root,
         )
-        vfio = VfioPciManager()
+        vfio = VfioPciManager(sysfs_root=config.sysfs_root)
         self.state = DeviceState(
             tpulib=tpulib,
             cdi=self.cdi,

@@ -49,6 +49,18 @@ def build_parser() -> argparse.ArgumentParser:
         choices=["v1beta1", "v1beta2", "v1"],
     )
     p.add_argument("--backend", default=flags.env_default("TPU_DRA_BACKEND", ""))
+    # Driver-root resolution (root.go:29-87 analog): a containerized
+    # plugin sees the host's trees mounted under a prefix.
+    p.add_argument(
+        "--sysfs-root",
+        default=flags.env_default("TPU_DRA_SYSFS_ROOT", "/sys"),
+        help="Host sysfs mount (PCI enumeration + vfio driver rebind)",
+    )
+    p.add_argument(
+        "--dev-root",
+        default=flags.env_default("TPU_DRA_DEV_ROOT", "/dev"),
+        help="Host /dev mount (accel + vfio device nodes)",
+    )
     p.add_argument(
         "--fake-cluster",
         action="store_true",
@@ -85,7 +97,9 @@ def main(argv=None) -> int:
     flags.apply_feature_gates(args)
     flags.log_startup_config(args)
 
-    tpulib = new_tpulib(args.backend)
+    tpulib = new_tpulib(
+        args.backend, sysfs_root=args.sysfs_root, dev_root=args.dev_root
+    )
     if args.fake_cluster:
         from tpu_dra.k8sclient import FakeCluster
 
@@ -105,6 +119,7 @@ def main(argv=None) -> int:
         resource_api_version=args.resource_api_version,
         cdi_hook_source=args.cdi_hook,
         multiplex_socket_root=args.multiplex_socket_root,
+        sysfs_root=args.sysfs_root,
     )
     driver = Driver(tpulib, backend, config)
     driver.start()

@@ -42,14 +42,22 @@ log = logging.getLogger(__name__)
 BACKEND_ENV = "TPU_DRA_BACKEND"
 
 
-def new_tpulib(backend: str = "", **kwargs) -> TpuLib:
+def new_tpulib(
+    backend: str = "",
+    sysfs_root: str = "/sys",
+    dev_root: str = "/dev",
+    **kwargs,
+) -> TpuLib:
     """Create a tpulib backend (deviceLib constructor analog,
-    nvlib.go:56-96)."""
+    nvlib.go:56-96). ``sysfs_root``/``dev_root`` are the driver-root
+    resolution analog (root.go:29-87): a containerized plugin sees the
+    host's trees mounted under a prefix. They apply to the linux backend
+    and to auto-detection; the stub fakes its own hardware."""
     backend = backend or os.environ.get(BACKEND_ENV, "")
     if not backend:
         from tpu_dra.tpulib.linux import detect_tpu_pci_devices
 
-        backend = "linux" if detect_tpu_pci_devices() else "stub"
+        backend = "linux" if detect_tpu_pci_devices(sysfs_root) else "stub"
         log.info("auto-detected tpulib backend: %s", backend)
     if backend == "stub":
         from tpu_dra.tpulib.stub import StubTpuLib
@@ -58,5 +66,7 @@ def new_tpulib(backend: str = "", **kwargs) -> TpuLib:
     if backend == "linux":
         from tpu_dra.tpulib.linux import LinuxTpuLib
 
-        return LinuxTpuLib(**kwargs)
+        return LinuxTpuLib(
+            sysfs_root=sysfs_root, dev_root=dev_root, **kwargs
+        )
     raise ValueError(f"unknown tpulib backend: {backend!r}")
