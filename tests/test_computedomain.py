@@ -1165,3 +1165,91 @@ def test_reclaimed_entry_resets_ready_status(fc, tmp_path):
     d2.registration.heartbeat_period = 0.0  # heartbeat always due
     d2.registration.register()
     assert entry()["status"] == "Ready"
+
+
+def test_controller_metrics_surface(fc):
+    """The controller exposes reconcile counters + domain gauges (the
+    reference has no controller observability surface at all)."""
+    cd = make_cd(fc)
+    c = ComputeDomainController(fc, driver_namespace=DRIVER_NS)
+    reconcile(c, cd)
+    reconcile(c, cd)
+    text = c.metrics.render()
+    assert "reconciles_total 2" in text
+
+
+def test_releader_reconciles_with_fresh_controller(fc):
+    """Lost-then-reacquired leadership must RESUME reconciliation. A
+    controller instance is single-use (stop() permanently shuts its
+    queue/informers), so each term builds a fresh instance the way
+    main.py's build_controller does — re-starting the stopped instance
+    would make a zombie leader whose threads exit instantly."""
+    from tpu_dra.computedomain.controller.main import LeaderElector
+    from tpu_dra.infra.flags import LeaderElectionConfig
+    from tpu_dra.k8sclient import LEASES
+
+    cfg = LeaderElectionConfig(
+        enabled=True, namespace="default", lease_name="l2",
+        lease_duration=0.2, renew_deadline=0.1, retry_period=0.05,
+    )
+    elector = LeaderElector(fc, cfg)
+    terms = []
+
+    def lead():
+        c = ComputeDomainController(
+            fc, driver_namespace=DRIVER_NS, status_sync_period=0.1
+        )
+        terms.append(c)
+        c.start()
+        return c.stop
+
+    t = threading.Thread(target=elector.run_leading, args=(lead,), daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not terms:
+            time.sleep(0.02)
+        assert terms, "never became leader"
+        # Another replica steals the lease -> term 1 stops.
+        leases = ResourceClient(fc, LEASES)
+        lease = leases.get("l2", "default")
+        lease["spec"]["holderIdentity"] = "other"
+        lease["spec"]["renewTime"] = "2099-01-01T00:00:00Z"
+        leases.update(lease)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not terms[0]._stop.is_set():
+            time.sleep(0.02)
+        assert terms[0]._stop.is_set(), "term-1 controller never stopped"
+        assert terms[0].healthy()[0]  # stopped-not-leading is healthy
+        # The other replica dies (lease expires) -> re-acquire, term 2.
+        lease = leases.get("l2", "default")
+        lease["spec"]["renewTime"] = "1970-01-01T00:00:00Z"
+        lease["spec"]["leaseDurationSeconds"] = 0
+        leases.update(lease)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and len(terms) < 2:
+            time.sleep(0.02)
+        assert len(terms) >= 2, "leadership never re-acquired"
+        # The SECOND term must actually reconcile: a CD created now gets
+        # its DaemonSet stamped by the fresh controller's workers.
+        make_cd(fc, name="cd-relead")
+        deadline = time.monotonic() + 10
+        rcts = ResourceClient(fc, RESOURCE_CLAIM_TEMPLATES)
+        while time.monotonic() < deadline:
+            if any(
+                r["metadata"]["name"] == "cd-relead-channel"
+                for r in rcts.list(namespace=NS)
+            ):
+                break
+            time.sleep(0.05)
+        names = [r["metadata"]["name"] for r in rcts.list(namespace=NS)]
+        assert "cd-relead-channel" in names, (
+            f"zombie leader: term-2 never reconciled; rcts={names}"
+        )
+        ok, why = terms[-1].healthy()
+        assert ok and why == "ok", (ok, why)
+    finally:
+        elector.stop()
+        t.join(timeout=5)
+        for c in terms:
+            c.stop()

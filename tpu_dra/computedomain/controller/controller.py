@@ -23,6 +23,7 @@ from tpu_dra.computedomain.controller.daemonset import DaemonSetManager
 from tpu_dra.computedomain.controller.node import NodeLabelManager
 from tpu_dra.computedomain.controller.rct import ResourceClaimTemplateManager
 from tpu_dra.computedomain.controller.status import StatusManager
+from tpu_dra.infra.metrics import Metrics
 from tpu_dra.infra.workqueue import WorkQueue, default_controller_rate_limiter
 from tpu_dra.k8sclient import (
     COMPUTE_DOMAIN_CLIQUES,
@@ -48,7 +49,9 @@ class ComputeDomainController:
         status_sync_period: float = 10.0,
         daemon_service_account: str = "",
         node_stale_after: float = 60.0,
+        metrics: Optional[Metrics] = None,
     ):
+        self.metrics = metrics if metrics is not None else Metrics()
         self.backend = backend
         self.cds = ResourceClient(backend, COMPUTE_DOMAINS)
         self.daemonsets = DaemonSetManager(
@@ -89,11 +92,34 @@ class ComputeDomainController:
         self.cd_informer.stop()
         self.clique_informer.stop()
 
+    def healthy(self) -> "tuple[bool, str]":
+        """Liveness verdict for /healthz. A controller instance is
+        single-use (stop() is permanent — lost leadership builds a FRESH
+        instance, see main.py); so: not yet started = healthy standby,
+        started = every worker thread must still be alive, stopped =
+        healthy (a replacement is owned by the election loop)."""
+        if not self._threads:
+            return True, "standby (not leading)"
+        if self._stop.is_set():
+            return True, "stopped (not leading)"
+        dead = [t.name for t in self._threads if not t.is_alive()]
+        if dead:
+            return False, f"dead worker threads: {dead}"
+        return True, "ok"
+
     def _periodic_sync(self) -> None:
         """cdstatus.go:120-133 periodic sync + node.go label GC."""
         while not self._stop.wait(self.status_sync_period):
             try:
                 cds = self.cds.list()
+                self.metrics.set_gauge("compute_domains", len(cds))
+                self.metrics.set_gauge(
+                    "compute_domains_ready",
+                    sum(
+                        1 for c in cds
+                        if (c.get("status") or {}).get("status") == "Ready"
+                    ),
+                )
                 for cd in cds:
                     self._enqueue(cd)
                 self.node_labels.cleanup_stale_labels()
@@ -133,6 +159,7 @@ class ComputeDomainController:
     # --- reconcile (computedomain.go:298-374) ---
 
     def _reconcile(self, cd_snapshot: dict) -> None:
+        self.metrics.inc("reconciles_total")
         md = cd_snapshot["metadata"]
         cd = self.cds.try_get(md["name"], md["namespace"])
         if cd is None:
