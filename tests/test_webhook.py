@@ -229,7 +229,7 @@ def test_missing_request_field():
     body = json.dumps(
         {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview"}
     ).encode()
-    status, _, _ = handle_admission_request(body, "application/json")
+    status, _, _, _ = handle_admission_request(body, "application/json")
     assert status == 400
 
 
@@ -238,7 +238,7 @@ def test_wrong_gvk_rejected():
         {"apiVersion": "admission.k8s.io/v1beta1", "kind": "AdmissionReview",
          "request": {"uid": "u"}}
     ).encode()
-    status, _, _ = handle_admission_request(body, "application/json")
+    status, _, _, _ = handle_admission_request(body, "application/json")
     assert status == 400
 
 
@@ -429,3 +429,39 @@ def test_gated_strategy_denied_when_gate_off():
     resource, obj = claim_with_configs("v1beta1", opaque_config(cfg))
     resp = admit_resource_claim_parameters(admission_review(resource, obj))
     assert resp.get("allowed") is not True
+
+
+def test_admission_metrics_counters(webhook_url):
+    """GET /metrics reports per-outcome admission counters (the
+    reference webhook has no observability surface)."""
+    import json as jsonlib
+
+    from tpu_dra.webhook.server import METRICS
+
+    def count(outcome):
+        text = METRICS.render()
+        for ln in text.splitlines():
+            if "admission_requests_total" in ln and outcome in ln:
+                return float(ln.rsplit(" ", 1)[1])
+        return 0.0
+
+    base_allowed = count("allowed")
+    review = {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {
+            "uid": "m1",
+            "resource": {
+                "group": "resource.k8s.io",
+                "version": "v1beta1",
+                "resource": "resourceclaims",
+            },
+            "object": {"spec": {"devices": {}}},
+        },
+    }
+    status, _ = post(webhook_url, jsonlib.dumps(review).encode())
+    assert status == 200
+    assert count("allowed") == base_allowed + 1
+    with urllib.request.urlopen(webhook_url + "/metrics") as resp:
+        assert resp.status == 200
+        assert "admission_requests_total" in resp.read().decode()

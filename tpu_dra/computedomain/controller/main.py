@@ -82,20 +82,31 @@ class LeaderElector:
         except ApiConflict:
             return False
 
+    def _try_acquire_or_renew(self) -> bool:
+        """acquire_or_renew with transient-failure tolerance: an
+        apiserver hiccup or a malformed lease written by another client
+        must read as 'not leading right now', not kill the election
+        thread (which would leave a replica that never leads again)."""
+        try:
+            return self.acquire_or_renew()
+        except Exception:  # noqa: BLE001 — any failure = not leading
+            log.exception("leader-election attempt failed; will retry")
+            return False
+
     def run_leading(self, lead) -> None:
         """Acquire, lead while renewing, and on lost leadership re-enter the
         election (a transient renewal conflict must not permanently halt
         reconciliation — the reference exits the process so the pod
         restarts; re-election is the in-process equivalent)."""
         while not self._stop.is_set():
-            if not self.acquire_or_renew():
+            if not self._try_acquire_or_renew():
                 self._stop.wait(self.config.retry_period)
                 continue
             log.info("became leader as %s", self.identity)
             stop_lead = lead()
             try:
                 while not self._stop.wait(self.config.renew_deadline / 2):
-                    if not self.acquire_or_renew():
+                    if not self._try_acquire_or_renew():
                         log.error("lost leadership; re-entering election")
                         break
             finally:
@@ -164,9 +175,16 @@ def main(argv=None) -> int:
 
     # Metrics/healthz endpoint (improvement over the reference, which has
     # no controller observability surface): reconcile counters + domain
-    # gauges + leadership state, and a REAL liveness verdict (worker
-    # threads of the leading instance) for the chart's probe.
+    # gauges + leadership state, and a REAL liveness verdict for the
+    # chart's probe — the leading instance's worker threads AND the
+    # election thread itself (a dead election loop is a replica that
+    # will never lead again; the probe must restart it).
+    election: dict = {"thread": None}
+
     def healthz():
+        t = election["thread"]
+        if t is not None and not t.is_alive():
+            return False, "leader-election thread dead"
         c = current["controller"]
         return c.healthy() if c is not None else (True, "standby")
 
@@ -187,12 +205,18 @@ def main(argv=None) -> int:
 
             def stop_lead():
                 metrics.set_gauge("leader", 0)
+                # Domain gauges are only refreshed while leading; zero
+                # them so a standby replica doesn't serve stale counts
+                # as live data.
+                metrics.set_gauge("compute_domains", 0)
+                metrics.set_gauge("compute_domains_ready", 0)
                 controller.stop()
 
             return stop_lead
 
         t = threading.Thread(target=elector.run_leading, args=(lead,), daemon=True)
         t.start()
+        election["thread"] = t
         stop.wait()
         elector.stop()
     else:

@@ -33,7 +33,12 @@ from tpu_dra.api.configs import (
     VfioDeviceConfig,
 )
 from tpu_dra.api.errors import ApiError, DecodeError
+from tpu_dra.infra.metrics import Metrics
 from tpu_dra.version import CD_DRIVER_NAME, DRIVER_NAME
+
+# Admission counters, served on GET /metrics (the reference webhook has
+# no observability surface).
+METRICS = Metrics()
 
 log = logging.getLogger(__name__)
 
@@ -174,22 +179,24 @@ def admit_resource_claim_parameters(review: Dict[str, Any]) -> Dict[str, Any]:
 
 def handle_admission_request(
     body: bytes, content_type: str
-) -> Tuple[int, bytes, str]:
+) -> Tuple[int, bytes, str, str]:
     """The HTTP-agnostic core of serve() (main.go:130-198).
 
-    Returns (status_code, response_body, response_content_type).
+    Returns (status_code, response_body, response_content_type,
+    outcome) where outcome is "allowed" | "denied" | "error" — derived
+    from the response in hand, for the admission counters.
     """
     if content_type != "application/json":
         msg = f"contentType={content_type}, expected application/json"
         log.error(msg)
-        return 415, msg.encode(), "text/plain"
+        return 415, msg.encode(), "text/plain", "error"
 
     try:
         review = json.loads(body)
     except json.JSONDecodeError as e:
         msg = f"failed to read AdmissionReview from request body: invalid JSON: {e}"
         log.error(msg)
-        return 400, msg.encode(), "text/plain"
+        return 400, msg.encode(), "text/plain", "error"
 
     if (
         not isinstance(review, dict)
@@ -201,13 +208,13 @@ def handle_admission_request(
             "group version kind"
         )
         log.error(msg)
-        return 400, msg.encode(), "text/plain"
+        return 400, msg.encode(), "text/plain", "error"
 
     request = review.get("request")
     if not isinstance(request, dict):
         msg = "failed to read AdmissionReview from request body: missing request"
         log.error(msg)
-        return 400, msg.encode(), "text/plain"
+        return 400, msg.encode(), "text/plain", "error"
 
     # Any structural surprise in the admitted object must come back as a
     # structured deny, never a dropped connection — with failurePolicy=Ignore
@@ -223,7 +230,8 @@ def handle_admission_request(
         "kind": "AdmissionReview",
         "response": response,
     }
-    return 200, json.dumps(out).encode(), "application/json"
+    outcome = "allowed" if response.get("allowed") else "denied"
+    return 200, json.dumps(out).encode(), "application/json", outcome
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -245,6 +253,11 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802
         if self.path == "/readyz":
             self._respond(200, b"ok", "text/plain")
+        elif self.path == "/metrics":
+            self._respond(
+                200, METRICS.render().encode(),
+                "text/plain; version=0.0.4",
+            )
         else:
             self._respond(404, b"not found", "text/plain")
 
@@ -254,9 +267,10 @@ class _Handler(BaseHTTPRequestHandler):
             return
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else b""
-        status, out, ctype = handle_admission_request(
+        status, out, ctype, outcome = handle_admission_request(
             body, self.headers.get("Content-Type", "")
         )
+        METRICS.inc("admission_requests_total", labels={"outcome": outcome})
         self._respond(status, out, ctype)
 
 
