@@ -1,0 +1,193 @@
+"""KV-cache greedy decoding for the flagship Llama.
+
+Serving-side companion to workloads/train.py: prefill + incremental
+decode over a static-shape KV cache, fully jittable (``lax.scan`` over
+decode steps, ``lax.dynamic_update_slice`` cache writes — no Python
+control flow on device values, so XLA compiles one prefill and one
+decode-step executable).
+
+The decode forward is a hand-rolled replay of models/llama.py's math
+over the SAME parameter tree (scan-stacked layers). Equivalence is
+pinned by tests/test_workloads.py::test_decode_matches_full_forward:
+teacher-forced decode logits must match the training forward's logits
+position by position, so the two implementations cannot drift silently.
+
+No reference counterpart (the reference is a DRA driver); this is the
+workload-payload layer's serving path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_dra.workloads.models.llama import (
+    LlamaConfig,
+    apply_rope,
+    rope_frequencies,
+)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class DecodeCache:
+    """Per-layer stacked KV cache: k/v [L, b, max_seq, kvh, hd]; pos is
+    the number of positions already written (same for every layer)."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    pos: jnp.ndarray  # scalar int32
+
+    def tree_flatten(self):
+        return (self.k, self.v, self.pos), None
+
+    @classmethod
+    def tree_unflatten(cls, _aux, children):
+        return cls(*children)
+
+
+def init_cache(
+    config: LlamaConfig, batch: int, max_seq: int
+) -> DecodeCache:
+    shape = (
+        config.n_layers, batch, max_seq, config.n_kv_heads, config.head_dim
+    )
+    return DecodeCache(
+        k=jnp.zeros(shape, config.dtype),
+        v=jnp.zeros(shape, config.dtype),
+        pos=jnp.zeros((), jnp.int32),
+    )
+
+
+def _rms(x: jnp.ndarray, scale: jnp.ndarray, eps: float) -> jnp.ndarray:
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    return (
+        x32 * lax.rsqrt(var + eps) * scale.astype(jnp.float32)
+    ).astype(x.dtype)
+
+
+def forward_chunk(
+    config: LlamaConfig,
+    params: dict,
+    cache: DecodeCache,
+    tokens: jnp.ndarray,
+) -> Tuple[DecodeCache, jnp.ndarray]:
+    """Process ``tokens`` [b, s] at absolute positions
+    ``cache.pos .. cache.pos+s-1``: append K/V, attend over everything
+    written so far, and return (updated cache, logits [b, s, vocab]).
+    Prefill is a long chunk; a decode step is s=1. Requires the
+    scan-stacked parameter layout (``scan_layers=True``, the default)."""
+    c = config
+    assert "layers" in params, "decode needs scan_layers=True param layout"
+    b, s = tokens.shape
+    max_seq = cache.k.shape[2]
+    x = params["embed"]["embedding"].astype(c.dtype)[tokens]  # [b, s, d]
+    positions = cache.pos + jnp.arange(s)
+    cos, sin = rope_frequencies(c, positions)  # [s, hd/2]
+    # Absolute-position mask over the whole static cache: key j visible
+    # to query i iff j <= pos+i. Unwritten slots sit at j >= pos+s and
+    # are masked for every query.
+    q_abs = positions  # [s]
+    karange = jnp.arange(max_seq)
+    mask = karange[None, :] <= q_abs[:, None]  # [s, max_seq]
+    scale = c.head_dim ** -0.5
+    n_rep = c.n_heads // c.n_kv_heads
+
+    def block(x, layer):
+        lp, ck, cv = layer  # ck/cv: [b, max_seq, kvh, hd]
+        att = lp["attention"]
+        h = _rms(x, lp["attention_norm"]["scale"], c.norm_eps)
+        q = (h @ att["wq"]["kernel"].astype(c.dtype)).reshape(
+            b, s, c.n_heads, c.head_dim
+        )
+        k = (h @ att["wk"]["kernel"].astype(c.dtype)).reshape(
+            b, s, c.n_kv_heads, c.head_dim
+        )
+        v = (h @ att["wv"]["kernel"].astype(c.dtype)).reshape(
+            b, s, c.n_kv_heads, c.head_dim
+        )
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        ck = lax.dynamic_update_slice(ck, k, (0, cache.pos, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v, (0, cache.pos, 0, 0))
+        # GQA without materializing an n_rep-times copy of the cache
+        # (the decode hot path would pay that per layer per step):
+        # group query heads kv-major — head i belongs to kv group
+        # i // n_rep, matching ops/attention.py _repeat_kv order — and
+        # contract straight against the grouped cache.
+        qg = q.reshape(b, s, c.n_kv_heads, n_rep, c.head_dim)
+        logits = jnp.einsum(
+            "bqhrd,bkhd->bhrqk", qg, ck,
+            preferred_element_type=jnp.float32,
+        ) * scale
+        logits = jnp.where(mask[None, None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        out = jnp.einsum(
+            "bhrqk,bkhd->bqhrd", probs.astype(cv.dtype), cv,
+            preferred_element_type=jnp.float32,
+        ).astype(c.dtype)
+        out = out.reshape(b, s, c.n_heads * c.head_dim)
+        x = x + out @ att["wo"]["kernel"].astype(c.dtype)
+        mlp = lp["mlp"]
+        h2 = _rms(x, lp["mlp_norm"]["scale"], c.norm_eps)
+        gate = h2 @ mlp["w_gate"]["kernel"].astype(c.dtype)
+        up = h2 @ mlp["w_up"]["kernel"].astype(c.dtype)
+        x = x + (jax.nn.silu(gate) * up) @ mlp["w_down"]["kernel"].astype(
+            c.dtype
+        )
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = lax.scan(
+        block, x, (params["layers"]["block"], cache.k, cache.v)
+    )
+    x = _rms(x, params["final_norm"]["scale"], c.norm_eps)
+    logits = (x @ params["lm_head"]["kernel"].astype(c.dtype)).astype(
+        jnp.float32
+    )
+    new_cache = DecodeCache(k=new_k, v=new_v, pos=cache.pos + s)
+    return new_cache, logits
+
+
+def greedy_generate(
+    config: LlamaConfig,
+    params: dict,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    max_seq: int = 0,
+) -> jnp.ndarray:
+    """Greedy-decode ``max_new_tokens`` after ``prompt`` [b, s]; returns
+    [b, s + max_new_tokens]. Jit-friendly: one traced prefill + a
+    ``lax.scan`` of single-token steps."""
+    b, s = prompt.shape
+    max_seq = max_seq or (s + max_new_tokens)
+    # All static at trace time: fail loudly instead of letting a full
+    # cache clamp dynamic_update_slice writes into silent garbage.
+    assert max_new_tokens >= 1, "max_new_tokens must be >= 1"
+    assert max_seq >= s + max_new_tokens, (
+        f"cache too small: max_seq={max_seq} < "
+        f"prompt {s} + max_new_tokens {max_new_tokens}"
+    )
+    cache = init_cache(config, b, max_seq)
+    cache, logits = forward_chunk(config, params, cache, prompt)
+    first = jnp.argmax(logits[:, -1], axis=-1).astype(prompt.dtype)
+
+    def step(carry, _):
+        cache, tok = carry
+        cache, logits = forward_chunk(
+            config, params, cache, tok[:, None]
+        )
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(tok.dtype)
+        return (cache, nxt), nxt
+
+    (_, _), rest = lax.scan(
+        step, (cache, first), None, length=max_new_tokens - 1
+    )
+    generated = jnp.concatenate(
+        [first[:, None], rest.swapaxes(0, 1)], axis=1
+    )
+    return jnp.concatenate([prompt, generated], axis=1)
