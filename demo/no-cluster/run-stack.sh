@@ -13,6 +13,11 @@ set -euo pipefail
 WORK="${1:-$(mktemp -d /tmp/tpu-dra-stack.XXXXXX)}"
 REPO="$(cd "$(dirname "$0")/../.." && pwd)"
 export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
+# Stub-backend driver processes never need a real chip; interpreter-
+# startup TPU routing (sitecustomize) would serialize every process
+# behind whatever workload holds it (see tests/batsless/runner.py).
+export JAX_PLATFORMS=cpu
+unset PALLAS_AXON_POOL_IPS
 PY="${PYTHON:-python3}"
 
 mkdir -p "$WORK"

@@ -195,10 +195,14 @@ def test_trainer_full_sharded_step():
 
 
 def test_smoke_workloads():
+    from tpu_dra.workloads.smoke import decode_smoke
+
     r = pmap_psum_smoke()
     assert r["ok"] and r["devices"] == 8
     m = matmul_smoke(256)
     assert m["ok"]
+    d = decode_smoke(max_new_tokens=4)
+    assert d["ok"], d
 
 
 def test_bootstrap_env_parsing():

@@ -41,6 +41,49 @@ def matmul_smoke(size: int = 1024) -> dict:
     return {"ok": ok, "size": size, "value": float(y[0, 0])}
 
 
+def decode_smoke(
+    batch: int = 2, prompt_len: int = 8, max_new_tokens: int = 16
+) -> dict:
+    """The serving path on whatever chip the claim granted: jitted
+    prefill + KV-cache greedy decode (workloads/generate.py) on a tiny
+    model. Pass = right shape, prompt preserved, finite ids."""
+    import time
+
+    from tpu_dra.workloads.generate import greedy_generate
+    from tpu_dra.workloads.models.llama import TINY_LLAMA, Llama
+
+    model = Llama(TINY_LLAMA)
+    params = model.init_params(
+        jax.random.PRNGKey(0), batch=batch, seq=prompt_len
+    )
+    prompt = jnp.tile(
+        jnp.arange(prompt_len, dtype=jnp.int32)[None], (batch, 1)
+    )
+    gen = jax.jit(
+        lambda p, t: greedy_generate(
+            TINY_LLAMA, p, t, max_new_tokens=max_new_tokens
+        )
+    )
+    out = gen(params, prompt)
+    out.block_until_ready()
+    t0 = time.monotonic()
+    out = gen(params, prompt)
+    last = int(out[0, -1])  # host fetch closes the timing
+    dt = time.monotonic() - t0
+    ok = (
+        out.shape == (batch, prompt_len + max_new_tokens)
+        and bool(jnp.all(out[:, :prompt_len] == prompt))
+        and 0 <= last < TINY_LLAMA.vocab_size
+    )
+    return {
+        "ok": ok,
+        "platform": jax.devices()[0].platform,
+        "decode_tok_s": round(batch * max_new_tokens / dt, 1),
+        "shape": list(out.shape),
+    }
+
+
 if __name__ == "__main__":
     print(pmap_psum_smoke())
     print(matmul_smoke())
+    print(decode_smoke())
