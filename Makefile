@@ -27,6 +27,11 @@ image:
 bats:
 	bats tests/bats/
 
+# the same e2e assertions with no cluster/kubectl/bats at all: fake
+# apiserver + real driver binaries as separate processes (45 checks)
+batsless: native
+	python tests/batsless/runner.py
+
 lint:
 	python -m compileall -q tpu_dra tests
 
