@@ -402,3 +402,41 @@ def test_decode_matches_full_forward():
     assert jnp.array_equal(
         out[:, 10], jnp.argmax(full[:, -1], axis=-1).astype(tokens.dtype)
     )
+
+
+def test_sample_generate_modes():
+    """Sampling shares the greedy cache machinery: top_k=1 and
+    temperature=0 are exactly greedy; near-zero temperature converges to
+    greedy; full sampling stays in-vocab and preserves the prompt."""
+    import dataclasses
+
+    from tpu_dra.workloads.generate import greedy_generate, sample_generate
+
+    cfg = dataclasses.replace(
+        TINY_LLAMA, dtype=jnp.float32, param_dtype=jnp.float32
+    )
+    model = Llama(cfg)
+    params = model.init_params(jax.random.PRNGKey(0), batch=2, seq=6)
+    prompt = jnp.tile(jnp.arange(6, dtype=jnp.int32)[None], (2, 1))
+    rng = jax.random.PRNGKey(42)
+
+    greedy = greedy_generate(cfg, params, prompt, max_new_tokens=6)
+    for kwargs in ({"top_k": 1}, {"temperature": 0.0}):
+        out = sample_generate(
+            cfg, params, prompt, max_new_tokens=6, rng=rng, **kwargs
+        )
+        assert jnp.array_equal(out, greedy), kwargs
+    # Tiny temperature: distribution collapses onto the argmax.
+    cold = sample_generate(
+        cfg, params, prompt, max_new_tokens=6, rng=rng, temperature=1e-4
+    )
+    assert jnp.array_equal(cold, greedy)
+    # Full sampling under jit: in-vocab ids, prompt preserved.
+    hot = jax.jit(
+        lambda p, t, r: sample_generate(
+            cfg, p, t, max_new_tokens=6, rng=r, temperature=1.0, top_k=8
+        )
+    )(params, prompt, rng)
+    assert hot.shape == (2, 12)
+    assert jnp.array_equal(hot[:, :6], prompt)
+    assert bool(jnp.all((hot >= 0) & (hot < cfg.vocab_size)))

@@ -153,16 +153,16 @@ def forward_chunk(
     return new_cache, logits
 
 
-def greedy_generate(
+def _generate(
     config: LlamaConfig,
     params: dict,
     prompt: jnp.ndarray,
     max_new_tokens: int,
-    max_seq: int = 0,
+    max_seq: int,
+    pick,
 ) -> jnp.ndarray:
-    """Greedy-decode ``max_new_tokens`` after ``prompt`` [b, s]; returns
-    [b, s + max_new_tokens]. Jit-friendly: one traced prefill + a
-    ``lax.scan`` of single-token steps."""
+    """Shared prefill + scan-decode loop; ``pick(logits[b, v], i)``
+    chooses the next token for step i."""
     b, s = prompt.shape
     max_seq = max_seq or (s + max_new_tokens)
     # All static at trace time: fail loudly instead of letting a full
@@ -174,20 +174,70 @@ def greedy_generate(
     )
     cache = init_cache(config, b, max_seq)
     cache, logits = forward_chunk(config, params, cache, prompt)
-    first = jnp.argmax(logits[:, -1], axis=-1).astype(prompt.dtype)
+    first = pick(logits[:, -1], 0).astype(prompt.dtype)
 
-    def step(carry, _):
+    def step(carry, i):
         cache, tok = carry
         cache, logits = forward_chunk(
             config, params, cache, tok[:, None]
         )
-        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(tok.dtype)
+        nxt = pick(logits[:, -1], i).astype(tok.dtype)
         return (cache, nxt), nxt
 
     (_, _), rest = lax.scan(
-        step, (cache, first), None, length=max_new_tokens - 1
+        step, (cache, first), jnp.arange(1, max_new_tokens)
     )
     generated = jnp.concatenate(
         [first[:, None], rest.swapaxes(0, 1)], axis=1
     )
     return jnp.concatenate([prompt, generated], axis=1)
+
+
+def greedy_generate(
+    config: LlamaConfig,
+    params: dict,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    max_seq: int = 0,
+) -> jnp.ndarray:
+    """Greedy-decode ``max_new_tokens`` after ``prompt`` [b, s]; returns
+    [b, s + max_new_tokens]. Jit-friendly: one traced prefill + a
+    ``lax.scan`` of single-token steps."""
+    return _generate(
+        config, params, prompt, max_new_tokens, max_seq,
+        pick=lambda logits, _i: jnp.argmax(logits, axis=-1),
+    )
+
+
+def sample_generate(
+    config: LlamaConfig,
+    params: dict,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    rng: jnp.ndarray,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    max_seq: int = 0,
+) -> jnp.ndarray:
+    """Temperature / top-k sampling over the same cache machinery.
+    ``top_k=0`` samples the full distribution; ``top_k=1`` or
+    ``temperature=0`` degenerate to greedy."""
+    assert 0 <= top_k <= config.vocab_size, (
+        f"top_k={top_k} out of range for vocab {config.vocab_size}"
+    )
+    if temperature <= 0.0 or top_k == 1:
+        return greedy_generate(
+            config, params, prompt, max_new_tokens, max_seq
+        )
+
+    def pick(logits, i):
+        step_rng = jax.random.fold_in(rng, i)
+        scaled = logits / temperature
+        if top_k > 0:
+            kth = lax.top_k(scaled, top_k)[0][:, -1:]
+            scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+        return jax.random.categorical(step_rng, scaled, axis=-1)
+
+    return _generate(
+        config, params, prompt, max_new_tokens, max_seq, pick=pick
+    )
