@@ -21,6 +21,7 @@
 // a quote-aware scanner (the tpucdihook.cc approach); responses are emitted
 // with proper string escaping.
 
+#include <algorithm>
 #include <cerrno>
 #include <cmath>
 #include <csignal>
@@ -134,6 +135,11 @@ struct Config {
   int compute_share_pct = -1;    // -1: unset
   int timeslice_ordinal = -1;    // -1: unset
   double window_seconds = kDefaultWindowSeconds;
+  // Revoke a holder that sits on the chip past this many quanta of
+  // contention without yielding (<=0: advisory only — no enforcement).
+  double preempt_after_quanta = -1;
+  // Refuse the offender re-acquire for this long (-1: one quantum).
+  double preempt_cooldown_seconds = -1;
 };
 
 std::vector<std::string> SplitNonEmpty(const char* raw, char sep) {
@@ -172,11 +178,20 @@ Config ParseEnv() {
   if (const char* p = getenv("TPU_MULTIPLEX_WINDOW_SECONDS"); p && *p) {
     cfg.window_seconds = atof(p);
   }
+  if (const char* p = getenv("TPU_MULTIPLEX_PREEMPT_AFTER_QUANTA"); p && *p) {
+    cfg.preempt_after_quanta = atof(p);
+  }
+  if (const char* p = getenv("TPU_MULTIPLEX_PREEMPT_COOLDOWN_SECONDS");
+      p && *p) {
+    cfg.preempt_cooldown_seconds = atof(p);
+  }
   return cfg;
 }
 
 // Interval ordinal -> fraction of the window (multiplexd.py
-// TIMESLICE_WINDOW_FRACTION: Default/Medium 25%, Short 5%, Long 100%).
+// TIMESLICE_WINDOW_FRACTION: Short 5%, Medium 25%, Long 100%; ordinal 0
+// (Default) never provisions a daemon, so the fallback only covers
+// unknown ordinals).
 double MaxHoldSeconds(const Config& cfg) {
   if (cfg.timeslice_ordinal >= 0) {
     double frac = 0.25;
@@ -278,7 +293,14 @@ class Daemon {
         if (!c.outbuf.empty()) events |= POLLOUT;
         fds.push_back({fd, events, 0});
       }
-      int n = poll(fds.data(), fds.size(), 200);
+      // With preemption on, revocation needs its own clock (a silent
+      // holder never wakes poll): tick well inside a quantum.
+      int timeout_ms = 200;
+      if (cfg_.preempt_after_quanta > 0) {
+        int tick = static_cast<int>(MaxHoldSeconds(cfg_) * 1000 / 5);
+        timeout_ms = std::max(10, std::min(200, tick));
+      }
+      int n = poll(fds.data(), fds.size(), timeout_ms);
       if (n < 0 && errno != EINTR) {
         perror("poll");
         break;
@@ -301,6 +323,7 @@ class Daemon {
         if (!c.dead && (p.revents & POLLOUT)) Flush(c);
       }
       Reap();
+      PreemptIfOverdue();
       GrantIfFree();
     }
 
@@ -367,6 +390,19 @@ class Daemon {
         Send(c, "{\"ok\": true, \"lease\": " + LeaseBodyJson(cfg_) + "}");
         return;
       }
+      // Cooldown is keyed by display name on purpose: a revoked client
+      // reconnecting with a fresh fd must not evade it (the name can only
+      // DENY service, never steal a lease — lease identity stays the fd).
+      double remaining = CooldownRemaining(c.name);
+      if (remaining > 0) {
+        char buf[128];
+        snprintf(buf, sizeof buf,
+                 "{\"ok\": false, \"error\": \"revoked for hogging; in "
+                 "cooldown\", \"retryAfterSeconds\": %.3f}",
+                 remaining);
+        Send(c, buf);
+        return;
+      }
       c.waiting = true;
       queue_.push_back(c.fd);
       if (holder_ != -1 && contended_since_ == 0.0) {
@@ -409,13 +445,63 @@ class Daemon {
       chips += "\"" + JsonEscape(cfg_.chips[i]) + "\"";
     }
     chips += "]";
-    char buf[160];
+    char buf[224];
     snprintf(buf, sizeof buf,
              ", \"waiting\": %zu, \"heldSeconds\": %.3f, "
-             "\"maxHoldSeconds\": %g, \"overdue\": %s}",
-             queue_.size(), held, max_hold, overdue ? "true" : "false");
+             "\"maxHoldSeconds\": %g, \"overdue\": %s, "
+             "\"revocations\": %zu, \"preemption\": %s}",
+             queue_.size(), held, max_hold, overdue ? "true" : "false",
+             revocations_, cfg_.preempt_after_quanta > 0 ? "true" : "false");
     return "{\"ok\": true, \"holder\": " + holder + ", \"chips\": " + chips +
            buf;
+  }
+
+  double CooldownRemaining(const std::string& name) {
+    auto it = cooldown_.find(name);
+    if (it == cooldown_.end()) return 0.0;
+    double remaining = it->second - MonotonicSeconds();
+    if (remaining <= 0) {
+      cooldown_.erase(it);
+      return 0.0;
+    }
+    return remaining;
+  }
+
+  // Act on `overdue` (the escalation the Python daemon's sweeper thread
+  // runs): revoke, notify the holder, start its cooldown; GrantIfFree()
+  // right after hands the lease to the next waiter.
+  void PreemptIfOverdue() {
+    if (cfg_.preempt_after_quanta <= 0 || holder_ == -1 || queue_.empty() ||
+        contended_since_ == 0.0) {
+      return;
+    }
+    double now = MonotonicSeconds();
+    double since = std::max(hold_started_, contended_since_);
+    double budget = cfg_.preempt_after_quanta * MaxHoldSeconds(cfg_);
+    if (now - since <= budget) return;
+    auto it = conns_.find(holder_);
+    double cooldown = cfg_.preempt_cooldown_seconds >= 0
+                          ? cfg_.preempt_cooldown_seconds
+                          : MaxHoldSeconds(cfg_);
+    std::string name =
+        it != conns_.end() ? it->second.name : ("fd-" + std::to_string(holder_));
+    cooldown_[name] = now + cooldown;
+    revocations_++;
+    if (it != conns_.end()) {
+      char buf[256];
+      snprintf(buf, sizeof buf,
+               "{\"event\": \"revoked\", \"reason\": \"held the chip %.3fs "
+               "under contention (> %g x %gs quantum) without yielding\", "
+               "\"cooldownSeconds\": %.3f}",
+               now - since, cfg_.preempt_after_quanta, MaxHoldSeconds(cfg_),
+               cooldown);
+      Send(it->second, buf);
+    }
+    fprintf(stderr,
+            "revoked lease of %s after %.3fs under contention; cooldown "
+            "%.3fs (%zu revocations total)\n",
+            name.c_str(), now - since, cooldown, revocations_);
+    holder_ = -1;
   }
 
   void GrantIfFree() {
@@ -487,6 +573,8 @@ class Daemon {
   int holder_ = -1;
   double hold_started_ = 0.0;
   double contended_since_ = 0.0;
+  size_t revocations_ = 0;
+  std::map<std::string, double> cooldown_;  // display name -> until
 };
 
 // `check` probe: 0 iff a daemon answers a ping on the socket.

@@ -41,6 +41,7 @@ import argparse
 import json
 import os
 import signal
+import socket
 import subprocess
 import sys
 import tempfile
@@ -698,6 +699,11 @@ def run_suites(r: Runner, stack: Stack, td: Path) -> int:
 
     native_bin = REPO_ROOT / "native" / "build" / "tpu-multiplex-daemon"
     mux_root = td / "mux"
+    # The sharing plugin instance serves /metrics so checks can assert
+    # arbiter state (revocations) the way an operator would.
+    with socket.socket() as _s:
+        _s.bind(("127.0.0.1", 0))
+        plugin_metrics_port = _s.getsockname()[1]
 
     def reinstall_sharing():
         install_chart(kc, [
@@ -708,7 +714,8 @@ def run_suites(r: Runner, stack: Stack, td: Path) -> int:
         start_tpu_plugin(
             stack, td,
             gates="MultiplexingSupport=true,TimeSlicingSettings=true",
-            extra_args=("--multiplex-socket-root", str(mux_root)),
+            extra_args=("--multiplex-socket-root", str(mux_root),
+                        "--health-port", str(plugin_metrics_port)),
         )
 
     r.run("sharing", "chart upgrade flips the sharing gates",
@@ -859,6 +866,64 @@ def run_suites(r: Runner, stack: Stack, td: Path) -> int:
 
     r.run("sharing", "two pods rotate one chip under a time-slice quantum",
           timeslice_rotation)
+
+    def noncooperative_pod_loses_chip():
+        # Round-3 escalation (featureGates.MultiplexPreemption, rendered
+        # as TPU_MULTIPLEX_PREEMPT_AFTER_QUANTA in the Deployment): a
+        # workload that acquires and never calls maybe_yield is revoked
+        # after 2 quanta of contention, its neighbor is granted without
+        # any cooperation, and the plugin's /metrics shows the revocation.
+        c = make_claim(kc, "tpu-test7", "hogged", "tpu-1", params={
+            "apiVersion": "resource.tpu.google.com/v1beta1",
+            "kind": "TpuConfig",
+            "sharing": {
+                "strategy": "TimeSlicing",
+                "timeSlicingConfig": {"interval": "Short"},
+            },
+        })
+        t, box = prepare_async(c)
+        env = play_kubelet_for_daemon(
+            c["metadata"]["uid"], window_seconds=2.0
+        )
+        _assert(env.get("TPU_MULTIPLEX_PREEMPT_AFTER_QUANTA") == "2", env)
+        t.join(timeout=60)
+        assert_prepared(box)
+
+        hog = MultiplexClient(env["TPU_MULTIPLEX_SOCKET_DIR"], "hog")
+        hog.acquire()  # and never yields
+        victim = MultiplexClient(env["TPU_MULTIPLEX_SOCKET_DIR"], "victim")
+        granted = threading.Event()
+        threading.Thread(
+            target=lambda: (victim.acquire(), granted.set()), daemon=True
+        ).start()
+        _assert(
+            granted.wait(timeout=15),
+            "victim starved: non-cooperative holder never preempted",
+        )
+        st = victim.status()
+        _assert(st["revocations"] >= 1, st)
+        _assert(st["holder"] == "victim", st)
+        victim.release()
+        victim.close()
+        hog.close()
+
+        # The plugin's /metrics scrapes the arbiter (collector path).
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{plugin_metrics_port}/metrics", timeout=10
+        ) as resp:
+            metrics = resp.read().decode()
+        claim_uid = c["metadata"]["uid"]
+        line = f'tpu_dra_multiplex_revocations{{claim="{claim_uid}"}} 1'
+        _assert(line in metrics, metrics[-1500:])
+        res = unprepare(sock, c)
+        _assert(not res.error, res.error)
+        stack.stop(f"multiplexd-{c['metadata']['uid'][:8]}")
+        kc.delete(RESOURCE_CLAIMS, "tpu-test7", "hogged")
+
+    r.run("sharing", "a non-cooperative pod measurably loses the chip",
+          noncooperative_pod_loses_chip)
 
     def invalid_sharing_rejected():
         c = make_claim(kc, "tpu-test3", "bad-sharing", "tpu-2", params={
@@ -1303,7 +1368,8 @@ def run_suites(r: Runner, stack: Stack, td: Path) -> int:
           timing_markers_logged)
 
     def no_errors_in_happy_path():
-        # The log was truncated on the last restart (downgrade test), so
+        # The log was rotated on the last restart (downgrade test), so
+        # td/tpu-plugin.log holds only the current instance's lines and
         # everything in it came from clean prepare/unprepare churn.
         lines = (td / "tpu-plugin.log").read_text().splitlines()
         errors = [

@@ -897,6 +897,57 @@ def test_timesliced_claim_rotates_processes(stack):
     ]
     assert all(p.wait(30) == 0 for p in ps)
 
+    # Adversarial leg: the rendered Deployment arms preemption
+    # (featureGates.MultiplexPreemption default-on), so a REAL process
+    # that acquires and never calls maybe_yield measurably loses the
+    # chip — its cooperative neighbor is granted without any cooperation
+    # from the hog, and the revocation is counted.
+    assert env["TPU_MULTIPLEX_PREEMPT_AFTER_QUANTA"] == "2"
+    sock_dir = env["TPU_MULTIPLEX_SOCKET_DIR"]
+    hog_code = (
+        "import sys, time\n"
+        "sys.path.insert(0, %r)\n"
+        "from tpu_dra.workloads.multiplex_client import MultiplexClient\n"
+        "c = MultiplexClient(sys.argv[1], client_name='hog')\n"
+        "c.acquire()\n"
+        # Never yields/releases; the revocation lands ~0.2s after the
+        # (slow-booting) coop process queues, so poll until the async
+        # revoked event drains through a status read.
+        "deadline = time.monotonic() + 20\n"
+        "while c.revocations == 0 and time.monotonic() < deadline:\n"
+        "    time.sleep(0.1)\n"
+        "    c.status()\n"
+        "assert c.revocations >= 1, 'hog never saw its revocation'\n"
+        "c.close()\n" % str(REPO_ROOT)
+    )
+    coop_code = (
+        "import sys, time\n"
+        "sys.path.insert(0, %r)\n"
+        "from tpu_dra.workloads.multiplex_client import MultiplexClient\n"
+        "c = MultiplexClient(sys.argv[1], client_name='coop')\n"
+        "t0 = time.monotonic()\n"
+        "lease = c.acquire()\n"  # must be granted via preemption alone
+        "waited = time.monotonic() - t0\n"
+        "assert waited < 10, f'starved behind the hog: {waited:.1f}s'\n"
+        "for _ in range(5):\n"
+        "    time.sleep(0.02)\n"
+        "    lease = c.maybe_yield(lease)\n"
+        "c.release()\n"
+        "c.close()\n" % str(REPO_ROOT)
+    )
+    hog = sp.Popen([sys.executable, "-c", hog_code, sock_dir])
+    from tpu_dra.workloads.multiplex_client import MultiplexClient
+    probe = MultiplexClient(sock_dir, client_name="probe")
+    wait_for(
+        lambda: probe.status().get("holder") == "hog",
+        what="hog holding the lease",
+    )
+    coop = sp.Popen([sys.executable, "-c", coop_code, sock_dir])
+    assert coop.wait(30) == 0, "cooperative client starved by the hog"
+    assert hog.wait(30) == 0, "hog exited abnormally"
+    assert probe.status()["revocations"] >= 1
+    probe.close()
+
     req = drapb.NodeUnprepareResourcesRequest()
     req.claims.append(drapb.Claim(uid=ts_uid, name="tsliced", namespace=NS))
     resp = _rpc(stack.td / "tpu-plugin" / "dra.sock",
@@ -910,3 +961,124 @@ def test_timesliced_claim_rotates_processes(stack):
         ),
         what="arbiter Deployment deletion",
     )
+
+
+def test_distributed_rendezvous_from_rendered_envs(stack):
+    """The last link in the ComputeDomain chain, executed for real: two
+    slice daemons (separate OS processes) render bootstrap envs for a
+    2-host clique, and two workload processes consume them — coordinator
+    bind, worker connect, global device assembly, one cross-process psum
+    and one data-parallel train step (test_cd_mnnvl_workload.bats:1-60
+    analog; readiness supervision per main.go:427-451). Until round 3 the
+    rendered env was only ever string-asserted; this drives
+    jax.distributed.initialize through it."""
+    import socket
+
+    kc = stack.kc
+    td = stack.td
+
+    cd = kc.create(COMPUTE_DOMAINS, {
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "ComputeDomain",
+        "metadata": {"name": "cd-rdv", "namespace": NS},
+        "spec": {
+            "numNodes": 2,
+            "channel": {"resourceClaimTemplate": {"name": "cd-rdv-channel"}},
+            "acceleratorType": "v5p-16",
+            "topology": "2x2x2",
+        },
+    })
+    cd_uid = cd["metadata"]["uid"]
+
+    # The daemons register with a loopback pod IP so the rendered
+    # coordinator (daemon-0's stable DNS name, resolved consumer-side via
+    # peers.json) is dialable from this host. A fresh port per run keeps
+    # co-located suites from colliding on the default rendezvous port.
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    cfg_dirs = []
+    for i in range(2):
+        cfg_dir = td / f"rdv-config-{i}"
+        cfg_dir.mkdir(exist_ok=True)
+        cfg_dirs.append(cfg_dir)
+        stack.spawn(
+            f"rdv-daemon-{i}",
+            ["tpu_dra.computedomain.daemon.main", "run",
+             "--kubeconfig", stack.kubeconfig,
+             "--cd-uid", cd_uid, "--cd-name", "cd-rdv",
+             "--cd-namespace", NS,
+             "--num-nodes", "2", "--node-name", f"rdv-node-{i}",
+             "--pod-ip", "127.0.0.1",
+             "--coordinator-port", str(port),
+             "--config-dir", str(cfg_dir),
+             "--hosts-path", str(td / f"rdv-hosts-{i}"),
+             "--heartbeat-period", "1"],
+            TPU_DRA_BACKEND="stub",
+            TPU_DRA_STUB_CONFIG=stub_cfg(
+                td / f"stub-rdv-{i}.yaml", f"rdv-node-{i}", i
+            ),
+        )
+
+    # Complete slice membership: both daemons rendered + ready.
+    wait_for(
+        lambda: all(
+            (d / "bootstrap.env").exists() and (d / "ready").exists()
+            for d in cfg_dirs
+        ),
+        timeout=60,
+        what="both daemons rendered + ready",
+    )
+    stack.assert_alive()
+
+    # Clique indices are registration-order, not spawn-order: map each
+    # rendered env to its TPU_WORKER_ID and launch exactly one workload
+    # per identity.
+    envs = {}
+    for d in cfg_dirs:
+        kv = dict(
+            line.split("=", 1)
+            for line in (d / "bootstrap.env").read_text().splitlines()
+            if "=" in line
+        )
+        envs[int(kv["TPU_WORKER_ID"])] = (d, kv)
+    assert sorted(envs) == [0, 1]
+    assert envs[0][1]["JAX_COORDINATOR_ADDRESS"].endswith(f":{port}")
+    assert envs[0][1]["JAX_NUM_PROCESSES"] == "2"
+
+    workers = []
+    for wid, (d, _) in sorted(envs.items()):
+        workers.append(stack.spawn(
+            f"rdv-worker-{wid}",
+            ["tpu_dra.workloads.rendezvous_smoke",
+             "--config-dir", str(d), "--cpu-devices", "2"],
+        ))
+
+    deadline = time.monotonic() + 180
+    results = []
+    for wid, w in enumerate(workers):
+        rc = w.wait(timeout=max(1, deadline - time.monotonic()))
+        log_text = (td / f"rdv-worker-{wid}.log").read_text()
+        assert rc == 0, f"worker {wid} rc={rc}:\n{log_text[-4000:]}"
+        last_json = [
+            ln for ln in log_text.splitlines() if ln.startswith("{")
+        ][-1]
+        results.append(json.loads(last_json))
+
+    for r in results:
+        assert r["processes"] == 2
+        assert r["global_devices"] == 4
+        assert r["psum"] == 3.0  # 2**0 + 2**1: both workers contributed
+    assert results[0]["loss"] == results[1]["loss"]
+    assert results[0]["loss_after_step"] < results[0]["loss"]
+
+    # The workloads are done; reap the daemons so stop_all stays quick.
+    for i in range(2):
+        name = f"rdv-daemon-{i}"
+        p, logf = stack.procs.pop(name)
+        p.send_signal(signal.SIGTERM)
+        try:
+            p.wait(timeout=15)
+        finally:
+            logf.close()

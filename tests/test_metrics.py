@@ -39,3 +39,23 @@ def test_timing_window_bounded():
 
 def test_quantile_empty_series():
     assert Metrics().quantile("nope", 0.5) is None
+
+
+def test_scrape_time_collector_refreshes_gauges():
+    m = Metrics()
+    state = {"n": 0}
+
+    def collect():
+        state["n"] += 1
+        m.set_gauge("collected", state["n"])
+
+    m.register_collector(collect)
+    assert "tpu_dra_collected 1" in m.render()
+    assert "tpu_dra_collected 2" in m.render()  # re-collected per scrape
+
+
+def test_failing_collector_does_not_break_scrape():
+    m = Metrics()
+    m.inc("ok_counter")
+    m.register_collector(lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    assert "ok_counter 1" in m.render()

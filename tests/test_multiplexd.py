@@ -41,7 +41,8 @@ class NativeDaemon:
 
     def __init__(self, socket_dir, chips, hbm_limits=None,
                  compute_share_pct=None, timeslice_ordinal=None,
-                 window_seconds=None):
+                 window_seconds=None, preempt_after_quanta=None,
+                 preempt_cooldown_seconds=None):
         env = dict(os.environ)
         env["TPU_MULTIPLEX_CHIPS"] = ",".join(chips)
         env["TPU_MULTIPLEX_SOCKET_DIR"] = str(socket_dir)
@@ -55,6 +56,14 @@ class NativeDaemon:
             env["TPU_MULTIPLEX_TIMESLICE_ORDINAL"] = str(timeslice_ordinal)
         if window_seconds is not None:
             env["TPU_MULTIPLEX_WINDOW_SECONDS"] = str(window_seconds)
+        if preempt_after_quanta is not None:
+            env["TPU_MULTIPLEX_PREEMPT_AFTER_QUANTA"] = str(
+                preempt_after_quanta
+            )
+        if preempt_cooldown_seconds is not None:
+            env["TPU_MULTIPLEX_PREEMPT_COOLDOWN_SECONDS"] = str(
+                preempt_cooldown_seconds
+            )
         self.proc = subprocess.Popen(
             [NATIVE_BIN, "run"], env=env, stderr=subprocess.DEVNULL
         )
@@ -259,6 +268,126 @@ def test_timeslice_cooperative_rotation(backend, tmp_path):
         d.stop()
 
 
+def test_noncooperative_holder_is_preempted(backend, tmp_path):
+    """Enforcement, not advice: a holder that never calls maybe_yield
+    loses the chip after preempt_after_quanta quanta of contention — the
+    waiter is granted WITHOUT any cooperation from the hog, the hog is
+    notified and refused re-acquire for the cooldown, and the revocation
+    is counted (matches the guarantee of the reference's driver-enforced
+    time-slice, nvlib.go:772-815)."""
+    d = new_daemon(
+        backend, tmp_path, ["chip-a"], timeslice_ordinal=1,
+        window_seconds=2.0,  # quantum 0.1s, revoke after 0.2s contention
+        preempt_after_quanta=2, preempt_cooldown_seconds=5.0,
+    )
+    try:
+        hog = MultiplexClient(str(tmp_path), client_name="hog")
+        hog.acquire()
+
+        victim = MultiplexClient(str(tmp_path), client_name="victim")
+        granted = threading.Event()
+        threading.Thread(
+            target=lambda: (victim.acquire(), granted.set()), daemon=True
+        ).start()
+        t0 = time.monotonic()
+        assert granted.wait(timeout=10), (
+            "waiter never granted: non-cooperative holder was not preempted"
+        )
+        elapsed = time.monotonic() - t0
+        assert elapsed < 5, f"preemption took {elapsed:.1f}s"
+
+        st = victim.status()
+        assert st["revocations"] == 1, st
+        assert st["preemption"] is True, st
+        assert st["holder"] == "victim", st
+
+        # The hog hears "no" (with a retry-after) instead of re-queueing.
+        from tpu_dra.workloads.multiplex_client import LeaseCooldownError
+
+        with pytest.raises(LeaseCooldownError) as ei:
+            hog.acquire()
+        assert ei.value.retry_after > 0
+        assert hog.revocations == 1  # the async revoked event was seen
+        victim.release()
+        hog.close()
+        victim.close()
+    finally:
+        d.stop()
+
+
+def test_cooperative_clients_never_preempted(backend, tmp_path):
+    """Preemption must be invisible to clients that honor the quantum:
+    the rotation workload from test_timeslice_cooperative_rotation runs
+    under an armed arbiter without a single revocation."""
+    d = new_daemon(
+        backend, tmp_path, ["chip-a"], timeslice_ordinal=2,
+        window_seconds=2.0,  # quantum 0.5s; steps are 0.02s
+        preempt_after_quanta=2,
+    )
+    try:
+        stop = time.monotonic() + 2.0
+
+        def worker(name):
+            c = MultiplexClient(str(tmp_path), client_name=name)
+            lease = c.acquire()
+            while time.monotonic() < stop:
+                time.sleep(0.02)
+                lease = c.maybe_yield(lease)
+            c.release()
+            c.close()
+
+        threads = [
+            threading.Thread(target=worker, args=(n,), daemon=True)
+            for n in ("a", "b")
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        probe = MultiplexClient(str(tmp_path), client_name="probe")
+        assert probe.status()["revocations"] == 0
+        probe.close()
+    finally:
+        d.stop()
+
+
+def test_revoked_cooperative_client_recovers(backend, tmp_path):
+    """A client whose single step overran the budget (honest latency, not
+    malice) sees the revocation on its next maybe_yield and transparently
+    re-acquires through the cooldown — no exception, work continues."""
+    d = new_daemon(
+        backend, tmp_path, ["chip-a"], timeslice_ordinal=1,
+        window_seconds=2.0,  # quantum 0.1s; budget 0.2s
+        preempt_after_quanta=2, preempt_cooldown_seconds=0.2,
+    )
+    try:
+        slow = MultiplexClient(str(tmp_path), client_name="slow")
+        lease = slow.acquire()
+
+        peer = MultiplexClient(str(tmp_path), client_name="peer")
+        granted = threading.Event()
+
+        def peer_run():
+            peer.acquire()
+            granted.set()
+            time.sleep(0.1)
+            peer.release()
+            peer.close()
+
+        threading.Thread(target=peer_run, daemon=True).start()
+        assert granted.wait(timeout=10)  # slow got revoked mid-"step"
+        time.sleep(0.05)  # stay inside the 0.2s cooldown window
+
+        lease = slow.maybe_yield(lease)  # must recover, not raise
+        assert lease.chips == ["chip-a"]
+        assert slow.rotations >= 1
+        assert slow.revocations == 1
+        slow.release()
+        slow.close()
+    finally:
+        d.stop()
+
+
 def test_status_reports_hold_accounting(daemon, tmp_path):
     c = MultiplexClient(str(tmp_path), client_name="w0")
     with c.lease():
@@ -360,8 +489,14 @@ def test_parse_env():
         "compute_share_pct": 25,
         "timeslice_ordinal": None,
         "window_seconds": 10.0,
+        "preempt_after_quanta": None,
+        "preempt_cooldown_seconds": None,
     }
     assert parse_env({})["chips"] == []
+    assert parse_env({
+        "TPU_MULTIPLEX_PREEMPT_AFTER_QUANTA": "2",
+        "TPU_MULTIPLEX_PREEMPT_COOLDOWN_SECONDS": "0.5",
+    })["preempt_after_quanta"] == 2.0
     ts = parse_env({
         "TPU_MULTIPLEX_TIMESLICE_ORDINAL": "1",
         "TPU_MULTIPLEX_WINDOW_SECONDS": "2.5",
@@ -383,3 +518,24 @@ def test_auto_lease_acquires_in_multiplexed_container(daemon, tmp_path):
     with auto_lease(environ=env) as lease:
         assert isinstance(lease, Lease)
         assert lease.chips == ["chip-a", "chip-b"]
+
+
+def test_manager_poll_status_surfaces_arbiter_state(backend, tmp_path):
+    """The plugin's /metrics collector path: MultiplexManager.poll_status
+    asks every per-claim daemon socket for status (revocations, queue
+    depth) — against both daemon implementations."""
+    from tpu_dra.plugin.sharing import MultiplexManager
+
+    d = new_daemon(
+        backend, tmp_path / "claim-1", ["chip-a"], compute_share_pct=50
+    )
+    try:
+        m = MultiplexManager.__new__(MultiplexManager)
+        m.socket_root = str(tmp_path)
+        st = m.poll_status()
+        assert set(st) == {"claim-1"}
+        assert st["claim-1"]["revocations"] == 0
+        assert st["claim-1"]["waiting"] == 0
+    finally:
+        d.stop()
+    assert MultiplexManager.poll_status(m) == {}  # daemon gone -> skipped

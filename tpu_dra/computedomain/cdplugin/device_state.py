@@ -272,7 +272,11 @@ class CDDeviceState:
                         )
                     ],
                 ),
-                runtime_env=dict(env),
+                # CD_CONFIG_DIR points the workload's bootstrap consumer
+                # (workloads/bootstrap.py) at the mounted config dir, so
+                # peers.json coordinator resolution works even when the
+                # pod doesn't share the daemon-maintained hosts file.
+                runtime_env={**env, "CD_CONFIG_DIR": "/tpu-cd"},
             )
             group.devices.append(pd)
         return PreparedDevices([group])

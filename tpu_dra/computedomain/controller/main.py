@@ -105,10 +105,31 @@ class LeaderElector:
             log.info("became leader as %s", self.identity)
             stop_lead = lead()
             try:
-                while not self._stop.wait(self.config.renew_deadline / 2):
-                    if not self._try_acquire_or_renew():
-                        log.error("lost leadership; re-entering election")
+                # client-go semantics: a single failed renew (apiserver
+                # blip, conflict) is retried every retry_period; leadership
+                # is only surrendered once renew_deadline has elapsed with
+                # no successful renew.  Breaking on the first failure would
+                # tear down reconciliation and open a no-leader gap for a
+                # lease we may still validly hold.
+                last_renew = time.monotonic()
+                while not self._stop.wait(self.config.retry_period):
+                    if self._try_acquire_or_renew():
+                        last_renew = time.monotonic()
+                    elif (
+                        time.monotonic() - last_renew
+                        >= self.config.renew_deadline
+                    ):
+                        log.error(
+                            "no successful renew for %.1fs (renew_deadline); "
+                            "re-entering election",
+                            self.config.renew_deadline,
+                        )
                         break
+                    else:
+                        log.warning(
+                            "renew attempt failed; retrying until "
+                            "renew_deadline"
+                        )
             finally:
                 stop_lead()
 

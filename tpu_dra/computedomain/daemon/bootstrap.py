@@ -39,6 +39,7 @@ def render_bootstrap_env(
     num_slices: int = 1,
     slice_index: int = 0,
     megascale_coordinator_ip: Optional[str] = None,
+    coordinator_port: int = COORDINATOR_PORT,
 ) -> Dict[str, str]:
     """``num_nodes`` is domain-global (spec.numNodes); ``worker_id`` is the
     host's **slice-local** index (its clique registration index — each ICI
@@ -73,7 +74,7 @@ def render_bootstrap_env(
         "TPU_WORKER_HOSTNAMES": hostnames,
         "TPU_ACCELERATOR_TYPE": accelerator_type,
         "TPU_TOPOLOGY": topology,
-        "JAX_COORDINATOR_ADDRESS": f"{dns_name(0)}:{COORDINATOR_PORT}",
+        "JAX_COORDINATOR_ADDRESS": f"{dns_name(0)}:{coordinator_port}",
         "JAX_NUM_PROCESSES": str(per_slice),
         "JAX_PROCESS_ID": str(local_id),
     }

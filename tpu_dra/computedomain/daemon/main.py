@@ -53,6 +53,9 @@ class DaemonConfig:
     update_period: float = 2.0
     heartbeat_period: float = 10.0
     num_slices: int = 1
+    # 0 = the default rendezvous port; overridable so co-located test
+    # daemons (or multiple domains on one host network) don't collide.
+    coordinator_port: int = 0
     pod_name: str = ""
     pod_namespace: str = ""
 
@@ -164,6 +167,11 @@ class SliceDaemon:
             num_slices=self.config.num_slices,
             slice_index=slice_index,
             megascale_coordinator_ip=coord_ip,
+            **(
+                {"coordinator_port": self.config.coordinator_port}
+                if self.config.coordinator_port
+                else {}
+            ),
         )
         write_bootstrap_files(self.config.config_dir, env, peers)
         ready = self.compute_ready(peers)
@@ -233,6 +241,13 @@ def main(argv=None) -> int:
         default=flags.env_default("CD_HEARTBEAT_PERIOD", 10.0, float),
         help="How often to refresh this daemon's liveness heartbeat",
     )
+    p.add_argument(
+        "--coordinator-port",
+        type=int,
+        default=flags.env_default("CD_COORDINATOR_PORT", 0, int),
+        help="Override the JAX rendezvous port rendered into "
+        "JAX_COORDINATOR_ADDRESS (0 = built-in default)",
+    )
     p.add_argument("--pod-name", default=flags.env_default("POD_NAME", ""))
     p.add_argument(
         "--pod-namespace", default=flags.env_default("POD_NAMESPACE", "")
@@ -251,6 +266,7 @@ def main(argv=None) -> int:
         cd_namespace=args.cd_namespace,
         num_nodes=args.num_nodes,
         num_slices=args.num_slices,
+        coordinator_port=args.coordinator_port,
         node_name=args.node_name,
         pod_ip=args.pod_ip,
         config_dir=args.config_dir,

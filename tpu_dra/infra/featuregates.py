@@ -55,6 +55,13 @@ DYNAMIC_SUBSLICE = "DynamicSubslice"
 COMPUTE_DOMAIN_CLIQUES = "ComputeDomainCliques"
 CRASH_ON_ICI_FABRIC_ERRORS = "CrashOnICIFabricErrors"
 CONTEXTUAL_LOGGING = "ContextualLogging"
+# Escalate against non-cooperative sharing clients: the per-claim arbiter
+# revokes the lease of a holder that ignores its quantum under contention
+# and refuses it re-acquire for a cooldown (multiplexd.py). Default on:
+# the reference's time-slice setting is driver-enforced
+# (nvlib.go:772-815), so advisory-only sharing would be a weaker
+# contract.
+MULTIPLEX_PREEMPTION = "MultiplexPreemption"
 
 DEFAULT_GATE_SPECS: Dict[str, List[VersionedSpec]] = {
     TIME_SLICING_SETTINGS: [VersionedSpec((0, 1), False, Stage.ALPHA)],
@@ -67,6 +74,7 @@ DEFAULT_GATE_SPECS: Dict[str, List[VersionedSpec]] = {
     CRASH_ON_ICI_FABRIC_ERRORS: [VersionedSpec((0, 1), True, Stage.BETA)],
     # Logging gate override mirrors featuregates.go:160-163.
     CONTEXTUAL_LOGGING: [VersionedSpec((0, 1), True, Stage.BETA)],
+    MULTIPLEX_PREEMPTION: [VersionedSpec((0, 1), True, Stage.BETA)],
 }
 
 

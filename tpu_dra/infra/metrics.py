@@ -37,6 +37,13 @@ class Metrics:
         self._timing_sum: Dict[str, float] = {}
         self._timing_count: Dict[str, int] = {}
         self._timing_recent: Dict[str, list] = {}
+        self._collectors: list = []
+
+    def register_collector(self, fn) -> None:
+        """Register a scrape-time hook: called (best-effort) at the top of
+        every render() so externally-owned state — e.g. per-claim control
+        daemons reachable only over their sockets — can refresh gauges."""
+        self._collectors.append(fn)
 
     @staticmethod
     def _key(name: str, labels: Optional[Dict[str, str]]):
@@ -50,6 +57,12 @@ class Metrics:
     def set_gauge(self, name: str, value: float, labels: Optional[Dict[str, str]] = None):
         with self._lock:
             self._gauges[self._key(name, labels)] = value
+
+    def remove_gauge(self, name: str, labels: Optional[Dict[str, str]] = None):
+        """Drop one gauge series (collectors use this when the entity
+        behind a labeled series disappears)."""
+        with self._lock:
+            self._gauges.pop(self._key(name, labels), None)
 
     def observe(self, name: str, seconds: float):
         with self._lock:
@@ -67,6 +80,11 @@ class Metrics:
         return _quantile_from_sorted(recent, q)
 
     def render(self) -> str:
+        for fn in list(self._collectors):
+            try:
+                fn()  # outside the lock: collectors call set_gauge
+            except Exception:  # noqa: BLE001 — scrape must never 500
+                pass
         out = []
         with self._lock:
             for (name, labels), v in sorted(self._counters.items()):

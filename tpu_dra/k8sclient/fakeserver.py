@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -320,7 +321,11 @@ class FakeApiServer:
         to this façade."""
         import yaml
 
-        with open(path, "w") as f:
+        # Write-to-temp + rename: readers poll for the path and load it
+        # the instant it exists, so the file must never be observable in
+        # a partially-written state.
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
             yaml.safe_dump({
                 "apiVersion": "v1",
                 "kind": "Config",
@@ -334,6 +339,7 @@ class FakeApiServer:
                 ],
                 "users": [{"name": "fake", "user": {}}],
             }, f)
+        os.replace(tmp, path)
         return path
 
     def start(self) -> "FakeApiServer":

@@ -102,6 +102,13 @@ class Driver:
             node_name=config.node_name,
             pool_name=config.node_name,
         )
+        # Scrape-time gauges for per-claim sharing arbiters: revocation
+        # and queue-depth counts live in the control daemons (py or
+        # native), reachable only over their sockets.
+        self._mux_claims_seen: set = set()
+        self.metrics.register_collector(
+            lambda: self._collect_multiplex_metrics(multiplex)
+        )
         self.slices = ResourceClient(backend, RESOURCE_SLICES)
         self.dra_service = DRAService(
             self.state, backend, self.pu_flock, metrics=self.metrics
@@ -113,6 +120,31 @@ class Driver:
         )
         self._publish_lock = threading.Lock()
         self._slice_generation = 0
+
+    def _collect_multiplex_metrics(self, multiplex) -> None:
+        statuses = multiplex.poll_status()
+        # Claims whose arbiter vanished (unprepared, daemon gone) must
+        # drop their series, or dashboards alert forever on a dead
+        # claim's last-seen contention.
+        for claim_uid in self._mux_claims_seen - set(statuses):
+            labels = {"claim": claim_uid}
+            for name in (
+                "multiplex_revocations", "multiplex_waiting",
+                "multiplex_overdue",
+            ):
+                self.metrics.remove_gauge(name, labels)
+        self._mux_claims_seen = set(statuses)
+        for claim_uid, st in statuses.items():
+            labels = {"claim": claim_uid}
+            self.metrics.set_gauge(
+                "multiplex_revocations", st.get("revocations", 0), labels
+            )
+            self.metrics.set_gauge(
+                "multiplex_waiting", st.get("waiting", 0), labels
+            )
+            self.metrics.set_gauge(
+                "multiplex_overdue", 1.0 if st.get("overdue") else 0.0, labels
+            )
 
     # --- lifecycle (RunPlugin/NewDriver analog) ---
 

@@ -37,6 +37,18 @@ of the window — the analog of ``nvidia-smi compute-policy
 ``MultiplexClient.maybe_yield``. ``TPU_MULTIPLEX_WINDOW_SECONDS``
 overrides the window (tests).
 
+Cooperation is verified, not assumed: with
+``TPU_MULTIPLEX_PREEMPT_AFTER_QUANTA=K`` set (the plugin renders it when
+featureGates.MultiplexPreemption is on), a holder that sits on the chip
+for more than K quanta of contention is REVOKED — it gets a
+``{"event": "revoked", ...}`` push on its connection, the next waiter is
+granted, and its re-acquires are refused (``retryAfterSeconds``) for
+``TPU_MULTIPLEX_PREEMPT_COOLDOWN_SECONDS`` (default: one quantum). The
+``status`` op reports the running ``revocations`` count. This matches
+the guarantee of the reference's driver-enforced time-slice
+(nvlib.go:772-815): a client that ignores the quantum cannot starve its
+neighbors.
+
 ``tpu-multiplex-daemon check`` probes a running daemon's socket (the
 Deployment's readiness probe).
 """
@@ -63,13 +75,15 @@ SOCKET_NAME = "multiplexd.sock"
 # One scheduling window; a lease's max hold is share% of this.
 SCHEDULING_WINDOW_SECONDS = 10.0
 
-# Time-slice interval ordinal (api/sharing.py: Default/Short/Medium/Long)
+# Time-slice interval ordinal (api/sharing.py: Short/Medium/Long)
 # -> fraction of the scheduling window one lease may hold while others
 # wait. The TPU analog of `nvidia-smi compute-policy --set-timeslice`
 # (reference nvlib.go:772-815): shorter slices rotate the chip between
 # cooperating processes more often; Long hands each holder the whole
-# window.
-TIMESLICE_WINDOW_FRACTION = {0: 0.25, 1: 0.05, 2: 0.25, 3: 1.0}
+# window. Ordinal 0 (Default) never provisions a daemon — it is the
+# daemon-free reset path (plugin/device_state.py) — so it has no entry
+# here; the .get() fallback below covers any unknown ordinal.
+TIMESLICE_WINDOW_FRACTION = {1: 0.05, 2: 0.25, 3: 1.0}
 
 
 class LeaseState:
@@ -84,12 +98,27 @@ class LeaseState:
     def __init__(self, chips: List[str], hbm_limits: Dict[str, str],
                  compute_share_pct: Optional[int],
                  timeslice_ordinal: Optional[int] = None,
-                 window_seconds: float = SCHEDULING_WINDOW_SECONDS):
+                 window_seconds: float = SCHEDULING_WINDOW_SECONDS,
+                 preempt_after_quanta: Optional[float] = None,
+                 preempt_cooldown_seconds: Optional[float] = None):
         self.chips = chips
         self.hbm_limits = hbm_limits
         self.compute_share_pct = compute_share_pct
         self.timeslice_ordinal = timeslice_ordinal
         self.window_seconds = window_seconds
+        # Escalation against non-cooperative holders: after this many
+        # quanta of contention with no yield, the lease is revoked and the
+        # offender refused re-acquire for a cooldown. None/<=0 = advisory
+        # only (`overdue` in status, no action) — the pre-round-3
+        # behavior. The guarantee this matches is the reference's
+        # driver-enforced time-slice (nvlib.go:772-815): a client that
+        # ignores the quantum cannot starve its neighbors.
+        self.preempt_after_quanta = (
+            preempt_after_quanta
+            if preempt_after_quanta and preempt_after_quanta > 0
+            else None
+        )
+        self.preempt_cooldown_seconds = preempt_cooldown_seconds
         self._lock = threading.Lock()
         self._granted = threading.Condition(self._lock)
         self._holder: Optional[str] = None
@@ -101,6 +130,15 @@ class LeaseState:
         self._contended_since: float = 0.0
         self._queue: "deque[str]" = deque()
         self._names: Dict[str, str] = {}  # conn id -> display name
+        # Revocation bookkeeping. Cooldowns are keyed by DISPLAY NAME on
+        # purpose: an offender that reconnects gets a fresh conn id, and a
+        # conn-keyed cooldown would be evaded by one close(). A name can
+        # only be used to DENY service during the cooldown window, never
+        # to steal or release another client's lease (identity for those
+        # stays the connection).
+        self._cooldown_until: Dict[str, float] = {}
+        self._revocations = 0
+        self._push: Dict[str, object] = {}  # conn id -> best-effort send fn
 
     def max_hold_seconds(self) -> float:
         if self.timeslice_ordinal is not None:
@@ -116,31 +154,108 @@ class LeaseState:
             "maxHoldSeconds": self.max_hold_seconds(),
         }
 
-    def acquire(self, conn_id: str, name: str, cancelled) -> bool:
-        """Block until `conn_id` holds the lease; `cancelled()` aborts
-        (client hung up while queued). Re-acquiring while already holding
-        is an idempotent grant — blocking there would deadlock the whole
-        queue (the holder's handler thread could never process the release
-        that frees it)."""
+    def register_push(self, conn_id: str, send_fn) -> None:
+        """Register a thread-safe best-effort sender for async server →
+        client events (lease revocation) on this connection."""
+        with self._lock:
+            self._push[conn_id] = send_fn
+
+    def cooldown_remaining(self, name: str) -> float:
+        """Seconds left on `name`'s post-revocation cooldown (0 = none).
+        Expired entries are pruned on the way."""
+        with self._lock:
+            return self._cooldown_remaining_locked(name)
+
+    def _cooldown_remaining_locked(self, name: str) -> float:
+        now = time.monotonic()
+        until = self._cooldown_until.get(name, 0.0)
+        if until <= now:
+            self._cooldown_until.pop(name, None)
+            return 0.0
+        return until - now
+
+    def acquire(self, conn_id: str, name: str, cancelled):
+        """Block until `conn_id` holds the lease; returns
+        ``("granted", 0.0)``, ``("cancelled", 0.0)`` (client hung up while
+        queued), or ``("cooldown", seconds)`` — refused outright because
+        the client was recently revoked for hogging. Re-acquiring while
+        already holding is an idempotent grant — blocking there would
+        deadlock the whole queue (the holder's handler thread could never
+        process the release that frees it)."""
         with self._granted:
             self._names[conn_id] = name
             if self._holder == conn_id:
-                return True
+                return ("granted", 0.0)
+            remaining = self._cooldown_remaining_locked(name)
+            if remaining > 0:
+                return ("cooldown", remaining)
             self._queue.append(conn_id)
             if self._holder is not None and not self._contended_since:
                 self._contended_since = time.monotonic()
             while True:
                 if cancelled():
                     self._drop_locked(conn_id)
-                    return False
+                    return ("cancelled", 0.0)
                 if self._holder is None and self._queue[0] == conn_id:
                     self._queue.popleft()
                     self._holder = conn_id
                     now = time.monotonic()
                     self._hold_started = now
                     self._contended_since = now if self._queue else 0.0
-                    return True
+                    return ("granted", 0.0)
                 self._granted.wait(timeout=0.2)
+
+    def preempt_overdue(self) -> bool:
+        """Act on `overdue`: revoke the lease of a holder that sat on the
+        chip past ``preempt_after_quanta`` quanta of contention, notify it
+        (best-effort event push), start its cooldown, and wake the next
+        waiter. Returns True iff a revocation happened. No-op unless
+        preemption is enabled."""
+        push = None
+        event = None
+        with self._granted:
+            if (
+                self.preempt_after_quanta is None
+                or self._holder is None
+                or not self._queue
+                or not self._contended_since
+            ):
+                return False
+            now = time.monotonic()
+            budget = self.preempt_after_quanta * self.max_hold_seconds()
+            since = max(self._hold_started, self._contended_since)
+            if now - since <= budget:
+                return False
+            offender = self._holder
+            name = self._names.get(offender, offender)
+            cooldown = (
+                self.preempt_cooldown_seconds
+                if self.preempt_cooldown_seconds is not None
+                else self.max_hold_seconds()
+            )
+            self._cooldown_until[name] = now + cooldown
+            self._revocations += 1
+            self._holder = None
+            self._granted.notify_all()
+            push = self._push.get(offender)
+            event = {
+                "event": "revoked",
+                "reason": (
+                    f"held the chip {now - since:.3f}s under contention "
+                    f"(> {self.preempt_after_quanta:g} x "
+                    f"{self.max_hold_seconds():g}s quantum) without "
+                    f"yielding"
+                ),
+                "cooldownSeconds": round(cooldown, 3),
+            }
+            log.warning(
+                "revoked lease of %s after %.3fs under contention; "
+                "cooldown %.3fs (%d revocations total)",
+                name, now - since, cooldown, self._revocations,
+            )
+        if push is not None:
+            push(event)  # outside the lock: it writes to a socket
+        return True
 
     def release(self, conn_id: str) -> bool:
         with self._granted:
@@ -155,6 +270,7 @@ class LeaseState:
         with self._granted:
             self._drop_locked(conn_id)
             self._names.pop(conn_id, None)
+            self._push.pop(conn_id, None)
 
     def _drop_locked(self, conn_id: str) -> None:
         if self._holder == conn_id:
@@ -195,6 +311,8 @@ class LeaseState:
                         - max(self._hold_started, self._contended_since)
                     ) > self.max_hold_seconds()
                 ),
+                "revocations": self._revocations,
+                "preemption": self.preempt_after_quanta is not None,
             }
 
 
@@ -204,7 +322,11 @@ class _Handler(socketserver.StreamRequestHandler):
         # The connection IS the identity (unique per handler); the
         # client-supplied name is display-only.
         conn_id = f"conn-{id(self)}"
-        touched = False
+        # Responses and async revocation events share this connection's
+        # write side; the lock keeps a sweeper push from interleaving
+        # bytes with a handler response.
+        self._wlock = threading.Lock()
+        state.register_push(conn_id, self._push_event)
         try:
             for raw in self.rfile:
                 try:
@@ -215,10 +337,18 @@ class _Handler(socketserver.StreamRequestHandler):
                 op = msg.get("op")
                 if op == "acquire":
                     name = msg.get("client") or conn_id
-                    touched = True
-                    ok = state.acquire(conn_id, name, cancelled=self._conn_dead)
-                    if not ok:
+                    verdict, extra = state.acquire(
+                        conn_id, name, cancelled=self._conn_dead
+                    )
+                    if verdict == "cancelled":
                         return
+                    if verdict == "cooldown":
+                        self._send({
+                            "ok": False,
+                            "error": "revoked for hogging; in cooldown",
+                            "retryAfterSeconds": round(extra, 3),
+                        })
+                        continue
                     try:
                         self._send({"ok": True, "lease": state.lease_body()})
                     except OSError:
@@ -236,12 +366,22 @@ class _Handler(socketserver.StreamRequestHandler):
                 else:
                     self._send({"ok": False, "error": f"unknown op {op!r}"})
         finally:
-            if touched:
-                state.drop(conn_id)
+            # Also unregisters the push fn; harmless for connections that
+            # never acquired.
+            state.drop(conn_id)
 
     def _send(self, obj: dict) -> None:
-        self.wfile.write(json.dumps(obj).encode() + b"\n")
-        self.wfile.flush()
+        with self._wlock:
+            self.wfile.write(json.dumps(obj).encode() + b"\n")
+            self.wfile.flush()
+
+    def _push_event(self, obj: dict) -> None:
+        """Best-effort async event to this client (revocation notice); a
+        dead connection is reaped by the handler's own teardown."""
+        try:
+            self._send(obj)
+        except OSError:
+            pass
 
     # Peer shut down its write side (close/crash) — visible even while
     # unread pipelined bytes sit in our receive buffer, where an
@@ -294,7 +434,9 @@ class MultiplexDaemon:
                  hbm_limits: Optional[Dict[str, str]] = None,
                  compute_share_pct: Optional[int] = None,
                  timeslice_ordinal: Optional[int] = None,
-                 window_seconds: float = SCHEDULING_WINDOW_SECONDS):
+                 window_seconds: float = SCHEDULING_WINDOW_SECONDS,
+                 preempt_after_quanta: Optional[float] = None,
+                 preempt_cooldown_seconds: Optional[float] = None):
         os.makedirs(socket_dir, exist_ok=True)
         self.socket_dir = socket_dir
         self.socket_path = os.path.join(socket_dir, SOCKET_NAME)
@@ -302,6 +444,8 @@ class MultiplexDaemon:
             chips, hbm_limits or {}, compute_share_pct,
             timeslice_ordinal=timeslice_ordinal,
             window_seconds=window_seconds,
+            preempt_after_quanta=preempt_after_quanta,
+            preempt_cooldown_seconds=preempt_cooldown_seconds,
         )
         try:
             os.remove(self.socket_path)
@@ -318,19 +462,36 @@ class MultiplexDaemon:
         # dir); its socket must survive our teardown.
         self._socket_ino = os.stat(self.socket_path).st_ino
         self._thread: Optional[threading.Thread] = None
+        self._sweeper: Optional[threading.Thread] = None
+        self._stop_sweeper = threading.Event()
 
     def start(self) -> "MultiplexDaemon":
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True, name="multiplexd"
         )
         self._thread.start()
+        if self.state.preempt_after_quanta is not None:
+            # Nobody calls into a daemon whose holder went silent, so
+            # revocation needs its own clock. Tick well inside a quantum.
+            tick = max(0.01, self.state.max_hold_seconds() / 5)
+
+            def sweep():
+                while not self._stop_sweeper.wait(tick):
+                    self.state.preempt_overdue()
+
+            self._sweeper = threading.Thread(
+                target=sweep, daemon=True, name="multiplexd-sweeper"
+            )
+            self._sweeper.start()
         log.info(
-            "multiplex daemon serving %d chips on %s",
+            "multiplex daemon serving %d chips on %s (preemption: %s)",
             len(self.state.chips), self.socket_path,
+            "on" if self.state.preempt_after_quanta is not None else "off",
         )
         return self
 
     def stop(self) -> None:
+        self._stop_sweeper.set()
         self._server.shutdown()
         self._server.server_close()
         try:
@@ -364,6 +525,8 @@ def parse_env(environ=os.environ) -> dict:
     pct_raw = environ.get("TPU_MULTIPLEX_COMPUTE_SHARE_PCT", "")
     ts_raw = environ.get("TPU_MULTIPLEX_TIMESLICE_ORDINAL", "")
     win_raw = environ.get("TPU_MULTIPLEX_WINDOW_SECONDS", "")
+    paq_raw = environ.get("TPU_MULTIPLEX_PREEMPT_AFTER_QUANTA", "")
+    pcd_raw = environ.get("TPU_MULTIPLEX_PREEMPT_COOLDOWN_SECONDS", "")
     return {
         "chips": [c for c in environ.get("TPU_MULTIPLEX_CHIPS", "").split(",") if c],
         "socket_dir": environ.get("TPU_MULTIPLEX_SOCKET_DIR", "/var/run/tpu-multiplex"),
@@ -371,6 +534,8 @@ def parse_env(environ=os.environ) -> dict:
         "compute_share_pct": int(pct_raw) if pct_raw else None,
         "timeslice_ordinal": int(ts_raw) if ts_raw else None,
         "window_seconds": float(win_raw) if win_raw else SCHEDULING_WINDOW_SECONDS,
+        "preempt_after_quanta": float(paq_raw) if paq_raw else None,
+        "preempt_cooldown_seconds": float(pcd_raw) if pcd_raw else None,
     }
 
 
@@ -386,6 +551,8 @@ def main(argv=None) -> int:
         cfg["socket_dir"], cfg["chips"], cfg["hbm_limits"],
         cfg["compute_share_pct"], cfg["timeslice_ordinal"],
         cfg["window_seconds"],
+        preempt_after_quanta=cfg["preempt_after_quanta"],
+        preempt_cooldown_seconds=cfg["preempt_cooldown_seconds"],
     ).start()
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())

@@ -31,6 +31,7 @@ from tpu_dra.api.sharing import (
     TimeSlicingConfig,
     time_slice_ordinal,
 )
+from tpu_dra.infra import featuregates as fg
 from tpu_dra.k8sclient import DEPLOYMENTS, ResourceClient
 from tpu_dra.plugin.allocatable import AllocatableDevices
 from tpu_dra.tpulib.interface import TpuLib
@@ -117,6 +118,15 @@ class MultiplexControlDaemon:
                     "name": "TPU_MULTIPLEX_TIMESLICE_ORDINAL",
                     "value": str(timeslice_ordinal),
                 }
+            )
+        if fg.enabled(fg.MULTIPLEX_PREEMPTION):
+            # Enforcement against non-cooperative holders: revoke after
+            # this many quanta of contention without a yield (the daemon
+            # defaults the cooldown to one quantum). 2 = one full quantum
+            # of grace past the owed yield, so a holder mid-step at the
+            # boundary is never revoked for honest latency.
+            env.append(
+                {"name": "TPU_MULTIPLEX_PREEMPT_AFTER_QUANTA", "value": "2"}
             )
         return {
             "apiVersion": "apps/v1",
@@ -263,6 +273,39 @@ class MultiplexManager:
         self, claim_uid: str, devices: AllocatableDevices
     ) -> MultiplexControlDaemon:
         return MultiplexControlDaemon(self, claim_uid, devices)
+
+    def poll_status(self, timeout: float = 0.25) -> Dict[str, dict]:
+        """Status of every live control daemon on this node, keyed by
+        claim UID — one `status` op per socket under socket_root. Feeds
+        the plugin's /metrics (revocations, queue depth); daemons that
+        don't answer are skipped (their Deployment may still be coming
+        up)."""
+        import json as _json
+        import os
+        import socket as _socket
+
+        out: Dict[str, dict] = {}
+        try:
+            claim_dirs = os.listdir(self.socket_root)
+        except FileNotFoundError:
+            return out
+        from tpu_dra.plugin.multiplexd import SOCKET_NAME
+
+        for claim_uid in claim_dirs:
+            path = os.path.join(self.socket_root, claim_uid, SOCKET_NAME)
+            try:
+                with _socket.socket(
+                    _socket.AF_UNIX, _socket.SOCK_STREAM
+                ) as s:
+                    s.settimeout(timeout)
+                    s.connect(path)
+                    s.sendall(b'{"op": "status"}\n')
+                    resp = _json.loads(s.makefile().readline())
+                    if resp.get("ok"):
+                        out[claim_uid] = resp
+            except (OSError, ValueError):
+                continue
+        return out
 
     def daemon_by_id(self, daemon_id: str) -> MultiplexControlDaemon:
         namespace, name = daemon_id.split("/", 1)

@@ -9,12 +9,55 @@ single-process allocation it is a no-op.
 
 from __future__ import annotations
 
+import json
 import logging
 import os
+import socket
 from dataclasses import dataclass
 from typing import Optional
 
 log = logging.getLogger(__name__)
+
+#: Where the CD kubelet plugin mounts the per-domain config dir into
+#: workload containers (cdplugin/device_state.py; the /imexd analog).
+DEFAULT_CONFIG_DIR = "/tpu-cd"
+
+
+def resolve_coordinator(address: str, config_dir: Optional[str] = None) -> str:
+    """Resolve the rendered coordinator address to something dialable.
+
+    The daemon renders ``JAX_COORDINATOR_ADDRESS`` with daemon-0's stable
+    DNS name (daemon/bootstrap.py), normally resolvable via the
+    /etc/hosts block the daemon maintains (dnsnames.go:145-190 analog).
+    A workload pod that does not share that hosts file (hostNetwork
+    without the mount, or a test process) can still rendezvous: the same
+    config dir carries ``peers.json`` mapping every peer's DNS name to
+    its registered IP, so fall back to that. The static-DNS-names +
+    dynamic-IP-mapping split is exactly the reference's nodes.cfg design
+    (dnsnames.go:191-216) — this just reads the mapping consumer-side.
+    """
+    host, _, port = address.rpartition(":")
+    if not host:
+        return address
+    try:
+        socket.getaddrinfo(host, None)
+        return address
+    except socket.gaierror:
+        pass
+    cfg = config_dir or os.environ.get("CD_CONFIG_DIR", DEFAULT_CONFIG_DIR)
+    peers_path = os.path.join(cfg, "peers.json")
+    try:
+        with open(peers_path) as f:
+            peers = json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return address
+    for p in peers:
+        if p.get("dnsName") == host and p.get("ipAddress"):
+            resolved = f"{p['ipAddress']}:{port}"
+            log.info("resolved coordinator %s -> %s via %s",
+                     address, resolved, peers_path)
+            return resolved
+    return address
 
 
 @dataclass
@@ -66,21 +109,26 @@ def mesh_config_from_slice_env(
     return MeshConfig(dp=se.num_slices, fsdp=inner, sp=sp, tp=tp)
 
 
-def initialize_from_env(env: Optional[dict] = None) -> SliceEnv:
+def initialize_from_env(
+    env: Optional[dict] = None, config_dir: Optional[str] = None
+) -> SliceEnv:
     """jax.distributed.initialize from the injected bootstrap env (no-op on
-    single-host allocations)."""
+    single-host allocations). ``config_dir`` points at the mounted per-CD
+    config dir for peers.json coordinator resolution (defaults to
+    ``$CD_CONFIG_DIR`` or /tpu-cd)."""
     se = read_slice_env(env)
     if se.multi_host and se.coordinator_address:
         import jax
 
+        coordinator = resolve_coordinator(se.coordinator_address, config_dir)
         log.info(
             "initializing jax.distributed: process %d/%d via %s",
             se.worker_id,
             se.num_processes,
-            se.coordinator_address,
+            coordinator,
         )
         jax.distributed.initialize(
-            coordinator_address=se.coordinator_address,
+            coordinator_address=coordinator,
             num_processes=se.num_processes,
             process_id=se.worker_id,
         )

@@ -41,6 +41,10 @@ def fused_next_token_xent(
                        tokens[i+1], the final position is masked out
     """
     b, s, d = x.shape
+    # Shapes are static at trace time, so a plain assert fails loudly:
+    # s == 1 has no next token to score and the 1/(b*(s-1)) normalizer
+    # would silently produce inf/NaN.
+    assert s >= 2, f"fused_next_token_xent needs seq >= 2, got {s}"
     # Uniform chunks with a masked tail: predict tokens[:, 1:] from
     # x[:, :-1] by shifting targets left and zero-weighting the last
     # position, then zero-pad the sequence up to a whole number of

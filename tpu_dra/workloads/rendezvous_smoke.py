@@ -1,0 +1,148 @@
+"""Cross-process rendezvous smoke: prove a driver-rendered bootstrap env.
+
+The one function that makes a ComputeDomain real for a workload is
+``jax.distributed.initialize`` from the env the slice daemon rendered
+(daemon/bootstrap.py) and the CD kubelet plugin injected (CDI env +
+/tpu-cd mount). This CLI is that workload, reduced to its essence: load
+the bootstrap env, rendezvous, assemble the global device view, run one
+collective and one data-parallel train step, and print one JSON line.
+
+Reference analog: tests/bats/test_cd_mnnvl_workload.bats:1-60 runs
+nvbandwidth across nodes to prove the IMEX domain moves bytes; this
+proves the TPU domain rendezvouses and reduces. Run it as the workload
+container's command (args default to the injected env), or point
+``--config-dir`` at a daemon-rendered dir to source bootstrap.env
+explicitly (what the e2e harness and dryrun do).
+
+Exit 0 iff: coordinator bind + all-worker connect succeeded,
+``jax.device_count()`` equals processes x local devices, the global psum
+saw every process's contribution, and the train-step loss is finite and
+bit-identical on every process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tpu_dra.computedomain.daemon.bootstrap import read_bootstrap_env
+from tpu_dra.workloads.bootstrap import initialize_from_env
+
+FEATURE_DIM = 8
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("tpu-dra-rendezvous-smoke")
+    p.add_argument(
+        "--config-dir",
+        default=os.environ.get("CD_CONFIG_DIR", ""),
+        help="Per-CD config dir; when set, bootstrap.env from it is "
+        "loaded into the process env first (the CDI-injection analog)",
+    )
+    p.add_argument(
+        "--cpu-devices",
+        type=int,
+        default=0,
+        help="Force the CPU platform with N local devices (hardware-free "
+        "harnesses; 0 = leave the platform alone)",
+    )
+    p.add_argument(
+        "--rows-per-device",
+        type=int,
+        default=4,
+        help="Local batch rows per addressable device for the train step",
+    )
+    args = p.parse_args(argv)
+
+    if args.config_dir:
+        env = read_bootstrap_env(args.config_dir)
+        if env is None:
+            print(f"no bootstrap.env under {args.config_dir}", file=sys.stderr)
+            return 2
+        os.environ.update(env)
+        os.environ["CD_CONFIG_DIR"] = args.config_dir
+
+    import jax
+
+    if args.cpu_devices:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+
+    se = initialize_from_env()
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    assert jax.process_count() == se.num_processes, (
+        f"process_count {jax.process_count()} != rendered "
+        f"JAX_NUM_PROCESSES {se.num_processes}"
+    )
+    n_local = jax.local_device_count()
+    n_global = jax.device_count()
+    assert n_global == se.num_processes * n_local, (
+        f"global {n_global} != {se.num_processes} x {n_local}"
+    )
+
+    # 1. Global collective: every process contributes 2**worker_id; the
+    #    allgathered sum proves each worker's bytes crossed the fabric.
+    contrib = multihost_utils.process_allgather(
+        np.array([2.0**se.worker_id], np.float32)
+    )
+    psum = float(contrib.sum())
+    expected = float(2.0**se.num_processes - 1)
+    assert psum == expected, f"psum {psum} != {expected}"
+
+    # 2. One data-parallel train step over the global mesh: inputs sharded
+    #    across all devices (mean reduction = cross-process psum under the
+    #    hood), parameters replicated; the updated loss must be finite and
+    #    identical everywhere.
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    sharding = NamedSharding(mesh, P("x"))
+    local = np.stack(
+        [
+            np.full((FEATURE_DIM,), 1.0 + se.worker_id * 0.5 + i * 0.01,
+                    np.float32)
+            for i in range(args.rows_per_device * n_local)
+        ]
+    )
+    x = jax.make_array_from_process_local_data(sharding, local)
+    w = jnp.ones((FEATURE_DIM,), jnp.float32)
+
+    @jax.jit
+    def step(w, x):
+        def loss_fn(w):
+            return jnp.mean((x @ w) ** 2)
+
+        loss, grad = jax.value_and_grad(loss_fn)(w)
+        return loss, w - 0.01 * grad
+
+    loss, w = step(w, x)
+    loss2, _ = step(w, x)
+    l1, l2 = float(loss), float(loss2)
+    assert np.isfinite(l1) and np.isfinite(l2), f"loss not finite: {l1} {l2}"
+    assert l2 < l1, f"train step did not descend: {l1} -> {l2}"
+    losses = multihost_utils.process_allgather(np.array([l1], np.float32))
+    assert np.all(losses == losses[0]), f"loss disagreement: {losses}"
+
+    print(
+        json.dumps(
+            {
+                "worker": se.worker_id,
+                "processes": se.num_processes,
+                "local_devices": n_local,
+                "global_devices": n_global,
+                "psum": psum,
+                "loss": l1,
+                "loss_after_step": l2,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
