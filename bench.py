@@ -164,6 +164,21 @@ def bench_config():
     import jax
 
     platform = jax.devices()[0].platform
+    if platform in ("tpu", "axon") and os.environ.get("BENCH_MODEL") == "small":
+        # ~200M-class model for legs that put TWO live trainers on one
+        # chip (time-slice rotation): each holds params + optimizer state
+        # in HBM simultaneously, which the 1B bench model cannot.
+        config = LlamaConfig(
+            vocab_size=32_768, dim=1024, n_layers=8, n_heads=16,
+            n_kv_heads=8, ffn_dim=4096, remat=False,
+            attention_block_q=512, attention_block_k=512,
+        )
+        return (
+            config,
+            int(os.environ.get("BENCH_BATCH", "4")),
+            int(os.environ.get("BENCH_SEQ", "512")),
+            int(os.environ.get("BENCH_STEPS", "20")),
+        )
     if platform in ("tpu", "axon"):
         # ~1B-class Llama (Llama-3.2-1B shape, bench vocab) — large enough
         # to exercise the MXU, small enough for one v5e chip's 16 GiB.
@@ -251,6 +266,151 @@ def measure_tokens_per_sec() -> dict:
 RC_NO_TPU = 17  # leg wanted the TPU but the backend fell back to CPU
 
 
+def _require_tpu_or_exit() -> Optional[int]:
+    if os.environ.get("BENCH_REQUIRE_TPU"):
+        import jax
+
+        platform = jax.devices()[0].platform
+        if platform not in ("tpu", "axon"):
+            print(
+                f"leg refused: expected TPU, backend chose {platform!r}",
+                file=sys.stderr,
+            )
+            return RC_NO_TPU
+    return None
+
+
+def _leg_decode_main() -> int:
+    """Serving measurement: KV-cache decode tokens/sec (greedy + top-k
+    sampled) through the same DRA-claim env as the training legs —
+    workloads/generate.py on the real chip, fetch-closed timing."""
+    rc = _require_tpu_or_exit()
+    if rc is not None:
+        return rc
+    # The decode cache machinery walks the scanned (stacked) param
+    # layout; the training legs' unrolled-layers default doesn't apply.
+    os.environ["BENCH_SCAN"] = "1"
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dra.workloads.generate import greedy_generate, sample_generate
+    from tpu_dra.workloads.icibandwidth import fetch
+    from tpu_dra.workloads.models.llama import Llama
+
+    config, _, _, _ = bench_config()
+    batch = int(os.environ.get("BENCH_DECODE_BATCH", "8"))
+    prompt_len = int(os.environ.get("BENCH_DECODE_PROMPT", "128"))
+    new_tokens = int(os.environ.get("BENCH_DECODE_TOKENS", "256"))
+    reps = int(os.environ.get("BENCH_DECODE_REPS", "3"))
+
+    model = Llama(config)
+    params = model.init_params(jax.random.PRNGKey(0), batch=1, seq=8)
+    prompt = jnp.ones((batch, prompt_len), dtype=jnp.int32)
+
+    greedy = jax.jit(
+        lambda p, t: greedy_generate(
+            config, p, t, max_new_tokens=new_tokens
+        )
+    )
+    rng = jax.random.PRNGKey(1)
+    sampled = jax.jit(
+        lambda p, t, r: sample_generate(
+            config, p, t, max_new_tokens=new_tokens, rng=r,
+            temperature=0.8, top_k=40,
+        )
+    )
+
+    results = {}
+    for name, run in (
+        ("greedy", lambda: greedy(params, prompt)),
+        ("sampled", lambda: sampled(params, prompt, rng)),
+    ):
+        out = run()
+        fetch(out)  # compile + correctness-shape warmup
+        assert out.shape == (batch, prompt_len + new_tokens), out.shape
+        t0 = time.monotonic()
+        for _ in range(reps):
+            out = run()
+        fetch(out)
+        dt = time.monotonic() - t0
+        results[f"{name}_tok_s"] = batch * new_tokens * reps / dt
+    results.update(
+        {"batch": batch, "prompt_len": prompt_len,
+         "new_tokens": new_tokens, "reps": reps}
+    )
+    print(json.dumps(results))
+    return 0
+
+
+def _leg_rotate_main() -> int:
+    """Time-slice rotation client: a live trainer that steps only while
+    holding the arbiter lease and yields at the quantum. Both clients
+    keep their backend attached (the chip is shared at dispatch
+    granularity); the lease decides who computes. Compile happens before
+    the synchronized start, so the aggregate excludes it."""
+    from tpu_dra.workloads.multiplex_client import MultiplexClient
+
+    rc = _require_tpu_or_exit()
+    if rc is not None:
+        return rc
+    import jax.numpy as jnp
+
+    from tpu_dra.workloads.icibandwidth import fetch
+    from tpu_dra.workloads.parallel.mesh import MeshConfig
+    from tpu_dra.workloads.train import TrainConfig, Trainer
+
+    config, batch, seq, _ = bench_config()
+    trainer = Trainer(
+        config, mesh_config=MeshConfig(fsdp=1), train_config=TrainConfig()
+    )
+    state = trainer.init_state(batch=batch, seq=seq)
+    step = trainer.make_train_step()
+    tokens = jnp.ones((batch, seq), dtype=jnp.int32)
+    state, loss = step(state, tokens)
+    fetch(loss)  # compiled; steady state from here
+
+    client = MultiplexClient(
+        os.environ["TPU_MULTIPLEX_SOCKET_DIR"],
+        client_name=os.environ.get("BENCH_CLIENT_NAME"),
+    )
+    print("READY", flush=True)
+    start_file = os.environ["BENCH_START_FILE"]
+    while not os.path.exists(start_file):
+        time.sleep(0.05)
+
+    t0 = time.monotonic()
+    acq_wait0 = time.monotonic()
+    lease = client.acquire()
+    waits = [time.monotonic() - acq_wait0]
+    duration = float(os.environ.get("BENCH_ROTATE_SECONDS", "20"))
+    steps_done = 0
+    while time.monotonic() - t0 < duration:
+        state, loss = step(state, tokens)
+        fetch(loss)
+        steps_done += 1
+        w0 = time.monotonic()
+        lease = client.maybe_yield(lease)
+        waits.append(time.monotonic() - w0)
+    client.release()
+    client.close()
+    waits_sorted = sorted(waits)
+
+    def q(p):
+        return round(waits_sorted[int(p * (len(waits_sorted) - 1))], 3)
+
+    print(json.dumps({
+        "tokens": steps_done * batch * seq,
+        "steps": steps_done,
+        "rotations": client.rotations,
+        "revocations": client.revocations,
+        "wait_p50_s": q(0.5),
+        "wait_p90_s": q(0.9),
+        "wait_max_s": q(1.0),
+        "wall_seconds": round(time.monotonic() - t0, 3),
+    }))
+    return 0
+
+
 def _leg_main(shared: bool) -> int:
     """Child-process entry. With ``shared``, the chip lease is acquired
     BEFORE the backend initializes and held for the whole session — the
@@ -266,20 +426,12 @@ def _leg_main(shared: bool) -> int:
         t0 = time.monotonic()
         client.acquire()
         wait = time.monotonic() - t0
-    if os.environ.get("BENCH_REQUIRE_TPU"):
-        import jax
-
-        platform = jax.devices()[0].platform
-        if platform not in ("tpu", "axon"):
-            # The chip exists but this process couldn't attach (usually a
-            # not-yet-released device lock from the previous leg). A
-            # silent CPU-fallback measurement would be a lie; fail with a
-            # distinct code so the parent retries.
-            print(
-                f"leg refused: expected TPU, backend chose {platform!r}",
-                file=sys.stderr,
-            )
-            return RC_NO_TPU
+    # A silent CPU-fallback measurement would be a lie; fail with a
+    # distinct code so the parent retries (the chip exists but this
+    # process couldn't attach, e.g. a not-yet-released device lock).
+    rc = _require_tpu_or_exit()
+    if rc is not None:
+        return rc
     if os.environ.get("BENCH_ASSERT_ONE_DEVICE"):
         import jax
 
@@ -437,6 +589,120 @@ def measure_sharing(steps: int = 8) -> dict:
     }
 
 
+def measure_timeslice_rotation(duration: float = 20.0) -> dict:
+    """Quantum rotation on the real chip (verdict r2 #4): the arbiter in
+    time-slice mode (Short on a 10s window = 0.5s quantum, preemption
+    armed), TWO live trainer processes looping maybe_yield. Compile
+    happens before a synchronized start, so the aggregate is steady-state
+    only. Done = both clients rotate and progress."""
+    from tpu_dra.plugin.multiplexd import MultiplexDaemon
+
+    with tempfile.TemporaryDirectory() as td:
+        daemon = MultiplexDaemon(
+            td, ["bench-chip"], timeslice_ordinal=1, window_seconds=10.0,
+            preempt_after_quanta=2,
+        ).start()
+        start_file = os.path.join(td, "start")
+        try:
+            def leg_env(i):
+                return {
+                    "TPU_MULTIPLEX_SOCKET_DIR": td,
+                    "BENCH_CLIENT_NAME": f"rot{i}",
+                    "BENCH_MODEL": "small",
+                    "BENCH_START_FILE": start_file,
+                    "BENCH_ROTATE_SECONDS": str(duration),
+                    **(
+                        {"BENCH_REQUIRE_TPU": "1"}
+                        if os.environ.get("BENCH_REQUIRE_TPU")
+                        else {}
+                    ),
+                }
+
+            import threading
+
+            procs = []
+            # Release the clients together once BOTH have compiled (each
+            # prints READY). Reader threads drain BOTH pipes for the whole
+            # run — an undrained pipe would block a chatty child while it
+            # holds the lease.
+            outs = [[], []]
+            errs = [[], []]
+            ready = [threading.Event(), threading.Event()]
+
+            def reader(i, p):
+                for line in p.stdout:
+                    outs[i].append(line)
+                    if line.strip() == "READY":
+                        ready[i].set()
+
+            def err_reader(i, p):
+                for line in p.stderr:
+                    errs[i].append(line)
+
+            try:
+                procs.extend(
+                    _spawn_leg(leg_env(i), "--leg-rotate") for i in range(2)
+                )
+                readers = [
+                    threading.Thread(target=fn, args=(i, p), daemon=True)
+                    for i, p in enumerate(procs)
+                    for fn in (reader, err_reader)
+                ]
+                for t in readers:
+                    t.start()
+                for i, ev in enumerate(ready):
+                    if not ev.wait(timeout=900):
+                        raise RuntimeError(
+                            f"rotation client {i} never compiled: "
+                            + "".join(errs[i])[-2000:]
+                        )
+                with open(start_file, "w") as f:
+                    f.write("go\n")
+                t0 = time.monotonic()
+                for i, p in enumerate(procs):
+                    try:
+                        rc = p.wait(timeout=duration + 300)
+                    except subprocess.TimeoutExpired:
+                        raise RuntimeError(f"rotation client {i} hung")
+                    if rc != 0:
+                        sys.stderr.write("".join(errs[i])[-2000:])
+                        raise RuntimeError(f"rotation client {i} rc={rc}")
+                for t in readers:
+                    t.join(timeout=10)
+                wall = time.monotonic() - t0
+            except Exception:
+                # Kill BOTH clients: a leaked live trainer keeps the TPU
+                # device lock and poisons every following leg/re-run with
+                # RC_NO_TPU (the hazard _communicate_or_kill guards the
+                # single-leg path against).
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+                        p.communicate()
+                raise
+        finally:
+            daemon.stop()
+    results = [
+        json.loads([ln for ln in out if ln.startswith("{")][-1])
+        for out in outs
+    ]
+    total_tokens = sum(r["tokens"] for r in results)
+    return {
+        "aggregate_tok_s": total_tokens / max(
+            wall, max(r["wall_seconds"] for r in results)
+        ),
+        "per_client_tok_s": [
+            round(r["tokens"] / r["wall_seconds"], 1) for r in results
+        ],
+        "rotations": [r["rotations"] for r in results],
+        "revocations": [r["revocations"] for r in results],
+        "wait_p50_s": [r["wait_p50_s"] for r in results],
+        "wait_p90_s": [r["wait_p90_s"] for r in results],
+        "wait_max_s": [r["wait_max_s"] for r in results],
+        "steps": [r["steps"] for r in results],
+    }
+
+
 def main() -> int:
     if "--probe" in sys.argv:
         import jax
@@ -447,6 +713,10 @@ def main() -> int:
         return _leg_main(shared=False)
     if "--leg-shared" in sys.argv:
         return _leg_main(shared=True)
+    if "--leg-decode" in sys.argv:
+        return _leg_decode_main()
+    if "--leg-rotate" in sys.argv:
+        return _leg_rotate_main()
 
     # Probe once: when a TPU is attachable, every leg must use it — a leg
     # silently falling back to CPU (tiny model, absurd tok/s) must fail
@@ -526,6 +796,45 @@ def main() -> int:
         file=sys.stderr,
     )
 
+    # Serving: KV-cache decode through the DRA claim env (r3).
+    decode = _run_leg(_filter_claim_env(dra_env), flag="--leg-decode")
+    print(
+        f"decode (batch {decode['batch']}, {decode['new_tokens']} new): "
+        f"greedy {decode['greedy_tok_s']:.1f} tok/s, sampled "
+        f"{decode['sampled_tok_s']:.1f} tok/s",
+        file=sys.stderr,
+    )
+
+    # Enforced time-slice rotation on the real chip (r3).
+    rotation = measure_timeslice_rotation()
+    print(
+        f"time-slice rotation: {rotation['aggregate_tok_s']:.1f} agg "
+        f"tok/s (steady-state), per-client {rotation['per_client_tok_s']},"
+        f" rotations {rotation['rotations']}, wait p50 "
+        f"{rotation['wait_p50_s']}s p90 {rotation['wait_p90_s']}s",
+        file=sys.stderr,
+    )
+
+    # Long-sequence training: seq 2048 must stay on the Pallas path (r3).
+    s2_env = _filter_claim_env(dra_env)
+    s2_env.update({
+        "BENCH_SEQ": "2048",
+        "BENCH_BATCH": os.environ.get("BENCH_SEQ2048_BATCH", "3"),
+        "BENCH_BLOCK_Q": os.environ.get("BENCH_SEQ2048_BLOCK", "1024"),
+        "BENCH_BLOCK_K": os.environ.get("BENCH_SEQ2048_BLOCK", "1024"),
+        "BENCH_STEPS": "12",
+    })
+    seq2048 = _run_leg(s2_env)
+    mfu2048 = (
+        round(seq2048["flops_per_token"] * seq2048["tok_s"] / peak, 4)
+        if peak
+        else None
+    )
+    print(
+        f"seq-2048: {seq2048['tok_s']:.1f} tok/s/chip, mfu {mfu2048}",
+        file=sys.stderr,
+    )
+
     vs_baseline = dra["tok_s"] / (0.95 * direct["tok_s"])
     print(
         json.dumps(
@@ -542,6 +851,16 @@ def main() -> int:
                 "sharing_per_client_tok_s": sharing["per_client_tok_s"],
                 "subslice_tok_s": round(subslice["tok_s"], 1),
                 "prepare_p50_ms": round(prep_p50 * 1000, 2),
+                "decode_tok_s": round(decode["greedy_tok_s"], 1),
+                "decode_sampled_tok_s": round(decode["sampled_tok_s"], 1),
+                "timeslice_aggregate_tok_s": round(
+                    rotation["aggregate_tok_s"], 1
+                ),
+                "timeslice_rotations": rotation["rotations"],
+                "timeslice_wait_p50_s": rotation["wait_p50_s"],
+                "timeslice_wait_p90_s": rotation["wait_p90_s"],
+                "seq2048_tok_s": round(seq2048["tok_s"], 1),
+                "mfu_seq2048": mfu2048,
             }
         )
     )

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Install the TPU DRA driver on an OpenShift cluster (SCC + chart).
+set -euo pipefail
+cd "$(dirname "$0")/../../.."
+
+NAMESPACE="${NAMESPACE:-tpu-dra-driver}"
+IMAGE="${IMAGE:-registry.local/tpu-dra-driver:v0.1.0}"
+
+oc new-project "${NAMESPACE}" 2>/dev/null || oc project "${NAMESPACE}"
+helm upgrade --install tpu-dra-driver deployments/helm/tpu-dra-driver \
+  --namespace "${NAMESPACE}" \
+  --set image.repository="${IMAGE%:*}" \
+  --set image.tag="${IMAGE##*:}"
+
+for sa in tpu-dra-driver-kubeletplugin tpu-dra-driver-cd-daemon; do
+  oc adm policy add-scc-to-user privileged -z "${sa}" -n "${NAMESPACE}"
+done
+echo "installed; watch: oc get pods -n ${NAMESPACE}"

@@ -278,6 +278,13 @@ def make_claim(kc, namespace, name, device, request="r0", params=None,
         "apiVersion": "resource.k8s.io/v1beta1",
         "kind": "ResourceClaim",
         "metadata": {"name": name, "namespace": namespace},
+        # A real claim always carries a spec (the webhook rightly rejects
+        # a spec-less object); the opaque config reaches the plugin via
+        # status.allocation exactly as a scheduler-allocated claim would.
+        "spec": {"devices": {"requests": [{
+            "name": request,
+            "deviceClassName": "tpu.google.com",
+        }]}},
     })
     config = []
     if params is not None:
@@ -372,12 +379,87 @@ class Runner:
         return 1 if self.failed else 0
 
 
+def write_sa_kubeconfig(stack: Stack, sa: str, node: str = "") -> str:
+    """Kubeconfig authenticating as a chart ServiceAccount (the fake
+    bearer contract: the token IS the SA username, optionally carrying
+    the node binding the CEL policy reads). With the apiserver in --rbac
+    mode every call the component makes must fit its rendered
+    ClusterRole — a missing verb fails HERE, not on a customer cluster."""
+    doc = yaml.safe_load(Path(stack.kubeconfig).read_text())
+    token = f"system:serviceaccount:{DRIVER_NS}:{sa}"
+    suffix = sa
+    if node:
+        token += f";node={node}"
+        suffix += f"-{node}"
+    doc["users"][0]["user"] = {"token": token}
+    path = stack.td / f"kubeconfig-{suffix}.yaml"
+    path.write_text(yaml.safe_dump(doc))
+    return str(path)
+
+
+def start_webhook(stack: Stack, td: Path):
+    """Run the REAL webhook binary over TLS; returns (port, caBundle b64)
+    for the chart's ValidatingWebhookConfiguration values."""
+    import base64
+    import ssl
+    import urllib.request
+
+    from tpu_dra.webhook.certs import generate_self_signed
+
+    cert, key = generate_self_signed(str(td / "wh.crt"), str(td / "wh.key"))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    stack.spawn(
+        "webhook",
+        ["tpu_dra.webhook.main", "--port", str(port),
+         "--tls-cert-file", cert, "--tls-private-key-file", key,
+         # The sharing gates make the webhook validate interval/share
+         # fields in depth; DynamicSubslice is mutually exclusive with
+         # them, so subslice configs are (correctly) rejected as
+         # gate-disabled — still an apply-time rejection.
+         "--feature-gates",
+         "TimeSlicingSettings=true,MultiplexingSupport=true"],
+    )
+    ctx = ssl.create_default_context(cafile=cert)
+
+    def ready():
+        try:
+            with urllib.request.urlopen(
+                f"https://127.0.0.1:{port}/readyz", context=ctx, timeout=2
+            ) as r:
+                return r.status == 200
+        except Exception:
+            return False
+
+    wait_for(ready, what="webhook TLS readiness")
+    ca_b64 = base64.b64encode(Path(cert).read_bytes()).decode()
+    return port, ca_b64
+
+
+def webhook_chart_sets(port: int, ca_b64: str) -> list:
+    return [
+        "webhook.enabled=true",
+        "webhook.tls.mode=secret",
+        "webhook.tls.secret.name=wh-certs",
+        f"webhook.tls.secret.caBundle={ca_b64}",
+        "webhook.clientConfig.url="
+        f"https://127.0.0.1:{port}/validate-resource-claim-parameters",
+    ]
+
+
 def start_tpu_plugin(
-    stack: Stack, td: Path, gates="", resource_api="", extra_args=()
+    stack: Stack, td: Path, gates="", resource_api="", extra_args=(),
+    kubeconfig=None,
 ):
+    # Default to the kubeletplugin ServiceAccount identity once the chart
+    # install has rendered it (RBAC-enforced); bare kubeconfig before.
+    kubeconfig = kubeconfig or getattr(stack, "sa_kubeconfigs", {}).get(
+        "kubeletplugin", stack.kubeconfig
+    )
     argv = [
         "tpu_dra.plugin.main",
-        "--kubeconfig", stack.kubeconfig,
+        "--kubeconfig", kubeconfig,
         "--node-name", "node-0",
         "--namespace", DRIVER_NS,
         "--cdi-root", str(td / "cdi"),
@@ -420,12 +502,13 @@ def run_suites(r: Runner, stack: Stack, td: Path) -> int:
     stack.spawn(
         "apiserver",
         ["tpu_dra.k8sclient.fakeserver", "--port", "0",
-         "--kubeconfig-out", str(kc_path)],
+         "--kubeconfig-out", str(kc_path), "--rbac"],
     )
     wait_for(kc_path.exists, what="kubeconfig")
     server = yaml.safe_load(kc_path.read_text())["clusters"][0]["cluster"]["server"]
     kc = KubeClient(server=server, qps=1000, burst=1000)
     stack.kc = kc
+    stack.server = server
     stack.kubeconfig = str(kc_path)
 
     def ping():
@@ -437,14 +520,52 @@ def run_suites(r: Runner, stack: Stack, td: Path) -> int:
 
     wait_for(ping, what="apiserver readiness")
 
+    # A real cluster's kubelet registers Node objects; this runner plays
+    # that kubelet, so seed them. Without this the cd-plugin's
+    # label-add falls back to CREATING the node — a verb its rendered
+    # ClusterRole (correctly) does not grant under --rbac.
+    for i in range(2):
+        kc.create(NODES, {
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": f"node-{i}"},
+        })
+
     # ---- test_basics ----
 
     r.run("basics", "clean cluster has no leftover driver state",
           lambda: _assert(len(tpu_slices(kc)) == 0, "stale tpu slices"))
 
     def install_and_roll_out():
-        applied = install_chart(kc, ["logVerbosity=6"], r.log)
+        # The REAL webhook binary first: the chart wires the apiserver to
+        # it (url-form clientConfig), so every subsequent claim/RCT
+        # create in this run passes through live HTTPS admission.
+        port, ca_b64 = start_webhook(stack, td)
+        stack.webhook_sets = webhook_chart_sets(port, ca_b64)
+        applied = install_chart(
+            kc, ["logVerbosity=6"] + stack.webhook_sets, r.log
+        )
         _assert(applied.get("DaemonSet", 0) >= 1, f"chart applied: {applied}")
+        _assert(
+            applied.get("ValidatingWebhookConfiguration", 0) == 1, applied
+        )
+        _assert(applied.get("ClusterRole", 0) >= 3, applied)
+        # The chart's ServiceAccounts become the identities every driver
+        # process runs under (--rbac enforcement).
+        from tpu_dra.k8sclient.resources import SERVICE_ACCOUNTS
+
+        names = [
+            s["metadata"]["name"]
+            for s in kc.list(SERVICE_ACCOUNTS, DRIVER_NS)
+        ]
+        base = next(n for n in names if f"{n}-kubeletplugin" in names)
+        stack.sa_base = base
+        stack.sa_kubeconfigs = {
+            "controller": write_sa_kubeconfig(stack, base),
+            "kubeletplugin": write_sa_kubeconfig(
+                stack, f"{base}-kubeletplugin", node="node-0"
+            ),
+            "cd-daemon": write_sa_kubeconfig(stack, f"{base}-cd-daemon"),
+        }
         # "plugins roll out": this runner plays the kubelet the DaemonSet
         # would land on — start the real plugin process, wait for its
         # registration socket.
@@ -475,7 +596,7 @@ def run_suites(r: Runner, stack: Stack, td: Path) -> int:
         stack.spawn(
             "cd-plugin",
             ["tpu_dra.computedomain.cdplugin.main",
-             "--kubeconfig", stack.kubeconfig,
+             "--kubeconfig", stack.sa_kubeconfigs["kubeletplugin"],
              "--node-name", "node-0",
              "--cdi-root", str(td / "cdi"),
              "--plugin-data-dir", str(td / "cd-plugin"),
@@ -508,6 +629,147 @@ def run_suites(r: Runner, stack: Stack, td: Path) -> int:
         _assert("topologyCoord" in attrs, "topologyCoord missing")
 
     r.run("basics", "device attributes are sane", attrs_sane)
+
+    # ---- test_admission (round-3: webhook + RBAC in the request path) ----
+    # The round-2 gap: admission was rendered but never called, RBAC
+    # rendered but never evaluated. Now the fakeserver runs in --rbac
+    # mode, every component authenticates as its chart ServiceAccount,
+    # and claim/RCT writes round-trip through the real HTTPS webhook.
+
+    def invalid_config_rejected_at_apply():
+        bad = {
+            "apiVersion": "resource.k8s.io/v1beta1",
+            "kind": "ResourceClaim",
+            "metadata": {"name": "bad-at-apply", "namespace": "bats-adm"},
+            "spec": {"devices": {"requests": [{"name": "r0"}], "config": [{
+                "requests": ["r0"],
+                "opaque": {"driver": DRIVER_NAME, "parameters": {
+                    "apiVersion": "resource.tpu.google.com/v1beta1",
+                    "kind": "TpuConfig",
+                    "sharing": {
+                        "strategy": "TimeSlicing",
+                        "timeSlicingConfig": {"interval": "Bogus"},
+                    },
+                }},
+            }]}},
+        }
+        try:
+            kc.create(RESOURCE_CLAIMS, bad)
+            _assert(False, "invalid opaque config admitted at apply time")
+        except Exception as e:  # noqa: BLE001 — message asserted below
+            msg = str(e)
+            _assert("admission webhook" in msg and "interval" in msg, msg)
+        good = json.loads(json.dumps(bad))
+        good["metadata"]["name"] = "good-at-apply"
+        good["spec"]["devices"]["config"][0]["opaque"]["parameters"][
+            "sharing"]["timeSlicingConfig"]["interval"] = "Short"
+        kc.create(RESOURCE_CLAIMS, good)
+        kc.delete(RESOURCE_CLAIMS, "bats-adm", "good-at-apply")
+
+    r.run("admission",
+          "invalid opaque config is rejected at APPLY time by the "
+          "chart-installed webhook", invalid_config_rejected_at_apply)
+
+    def rct_rejected_at_apply():
+        rct = {
+            "apiVersion": "resource.k8s.io/v1beta1",
+            "kind": "ResourceClaimTemplate",
+            "metadata": {"name": "bad-rct", "namespace": "bats-adm"},
+            "spec": {"spec": {"devices": {"config": [{
+                "opaque": {"driver": DRIVER_NAME, "parameters": {
+                    "apiVersion": "resource.tpu.google.com/v1beta1",
+                    "kind": "TpuSliceConfig",
+                    "shape": "not-a-shape",
+                }},
+            }]}}},
+        }
+        try:
+            kc.create(RESOURCE_CLAIM_TEMPLATES, rct)
+            _assert(False, "invalid RCT admitted at apply time")
+        except Exception as e:  # noqa: BLE001
+            _assert("admission webhook" in str(e), str(e))
+
+    r.run("admission", "invalid claim template rejected at apply time",
+          rct_rejected_at_apply)
+
+    def rbac_denies_cross_component_writes():
+        base = stack.sa_base
+        daemon_kc = KubeClient(
+            server=stack.server,
+            token=f"system:serviceaccount:{DRIVER_NS}:{base}-cd-daemon",
+        )
+        # The daemon's role grants clique writes, NOT DaemonSet writes.
+        try:
+            daemon_kc.create(DAEMON_SETS, {
+                "apiVersion": "apps/v1", "kind": "DaemonSet",
+                "metadata": {"name": "evil", "namespace": DRIVER_NS},
+            })
+            _assert(False, "cd-daemon SA created a DaemonSet")
+        except Exception as e:  # noqa: BLE001
+            _assert("forbidden" in str(e).lower(), str(e))
+        # And the plugin's role reads ComputeDomains, never writes them.
+        plugin_kc = KubeClient(
+            server=stack.server,
+            token=f"system:serviceaccount:{DRIVER_NS}:{base}-kubeletplugin"
+                  ";node=node-0",
+        )
+        _ = plugin_kc.list(COMPUTE_DOMAINS, DRIVER_NS)  # read: allowed
+        try:
+            plugin_kc.create(COMPUTE_DOMAINS, {
+                "apiVersion": "resource.tpu.google.com/v1beta1",
+                "kind": "ComputeDomain",
+                "metadata": {"name": "evil", "namespace": DRIVER_NS},
+                "spec": {"numNodes": 1},
+            })
+            _assert(False, "kubeletplugin SA created a ComputeDomain")
+        except Exception as e:  # noqa: BLE001
+            _assert("forbidden" in str(e).lower(), str(e))
+
+    r.run("admission", "RBAC denies writes outside each component's role",
+          rbac_denies_cross_component_writes)
+
+    def resourceslices_node_restriction():
+        # The chart's CEL policy, enforced: the plugin identity bound to
+        # node-0 may not publish slices for another node
+        # (templates/validatingadmissionpolicy.yaml).
+        plugin_kc = KubeClient(
+            server=stack.server,
+            token=f"system:serviceaccount:{DRIVER_NS}:{stack.sa_base}"
+                  "-kubeletplugin;node=node-0",
+        )
+        try:
+            plugin_kc.create(RESOURCE_SLICES, {
+                "apiVersion": "resource.k8s.io/v1beta1",
+                "kind": "ResourceSlice",
+                "metadata": {"name": "spoofed-slice"},
+                "spec": {"nodeName": "node-9", "driver": DRIVER_NAME,
+                         "pool": {"name": "node-9"}, "devices": []},
+            })
+            _assert(False, "cross-node ResourceSlice write admitted")
+        except Exception as e:  # noqa: BLE001
+            _assert("may not modify resourceslices" in str(e), str(e))
+        # A node-less token (no ServiceAccountTokenPodNodeInfo) is also
+        # refused, with the policy's first validation message.
+        nodeless = KubeClient(
+            server=stack.server,
+            token=f"system:serviceaccount:{DRIVER_NS}:{stack.sa_base}"
+                  "-kubeletplugin",
+        )
+        try:
+            nodeless.create(RESOURCE_SLICES, {
+                "apiVersion": "resource.k8s.io/v1beta1",
+                "kind": "ResourceSlice",
+                "metadata": {"name": "nodeless-slice"},
+                "spec": {"nodeName": "node-0", "driver": DRIVER_NAME,
+                         "pool": {"name": "node-0"}, "devices": []},
+            })
+            _assert(False, "node-less plugin token wrote a ResourceSlice")
+        except Exception as e:  # noqa: BLE001
+            _assert("no node association" in str(e), str(e))
+
+    r.run("admission",
+          "CEL policy: plugin may only write its own node's slices",
+          resourceslices_node_restriction)
 
     # ---- test_tpu_basic ----
 
@@ -977,7 +1239,7 @@ def run_suites(r: Runner, stack: Stack, td: Path) -> int:
         stack.spawn(
             f"daemon-{i}",
             ["tpu_dra.computedomain.daemon.main", "run",
-             "--kubeconfig", stack.kubeconfig,
+             "--kubeconfig", stack.sa_kubeconfigs["cd-daemon"],
              "--cd-uid", cd_uid, "--cd-name", "v5p-16",
              "--cd-namespace", namespace,
              "--num-nodes", "2", "--node-name", f"node-{i}",
@@ -1011,7 +1273,7 @@ def run_suites(r: Runner, stack: Stack, td: Path) -> int:
         stack.spawn(
             "controller",
             ["tpu_dra.computedomain.controller.main",
-             "--kubeconfig", stack.kubeconfig,
+             "--kubeconfig", stack.sa_kubeconfigs["controller"],
              "--namespace", DRIVER_NS,
              "--node-stale-after", "6", "-v", "6"],
         )

@@ -1,0 +1,170 @@
+"""RBAC + admission unit tests for the fakeserver's authz module.
+
+The chart's ClusterRoles/bindings, webhook configuration, and the
+ResourceSlice node-restriction policy are stored in a FakeCluster and
+evaluated exactly as the --rbac fakeserver does per request (the e2e
+proof lives in the batsless admission suite; these pin the evaluation
+semantics in isolation).
+"""
+
+import pytest
+
+from tpu_dra.k8sclient.authz import (
+    AdmissionDenied,
+    Authorizer,
+    Forbidden,
+    Identity,
+    parse_bearer,
+)
+from tpu_dra.k8sclient.fake import FakeCluster
+from tpu_dra.k8sclient.resources import (
+    CLUSTER_ROLE_BINDINGS,
+    CLUSTER_ROLES,
+    RESOURCE_SLICES,
+    VALIDATING_ADMISSION_POLICIES,
+    VALIDATING_WEBHOOK_CONFIGURATIONS,
+)
+
+
+def test_parse_bearer_forms():
+    assert parse_bearer(None) is None
+    assert parse_bearer("Basic abc") is None
+    assert parse_bearer("Bearer not-a-sa-token") is None
+    ident = parse_bearer("Bearer system:serviceaccount:ns1:sa1")
+    assert (ident.namespace, ident.name, ident.node) == ("ns1", "sa1", "")
+    ident = parse_bearer("Bearer system:serviceaccount:ns1:sa1;node=n0")
+    assert ident.node == "n0"
+    assert ident.username == "system:serviceaccount:ns1:sa1"
+
+
+def _cluster_with_role(rules, sa="ctrl", ns="driver"):
+    c = FakeCluster()
+    c.create(CLUSTER_ROLES, {
+        "apiVersion": "rbac.authorization.k8s.io/v1", "kind": "ClusterRole",
+        "metadata": {"name": "r"}, "rules": rules,
+    })
+    c.create(CLUSTER_ROLE_BINDINGS, {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "ClusterRoleBinding",
+        "metadata": {"name": "rb"},
+        "subjects": [
+            {"kind": "ServiceAccount", "name": sa, "namespace": ns}
+        ],
+        "roleRef": {"kind": "ClusterRole", "name": "r",
+                    "apiGroup": "rbac.authorization.k8s.io"},
+    })
+    return c
+
+
+def test_rbac_verb_and_resource_matching():
+    c = _cluster_with_role([
+        {"apiGroups": ["apps"], "resources": ["daemonsets"],
+         "verbs": ["get", "list", "create"]},
+        {"apiGroups": ["resource.tpu.google.com"],
+         "resources": ["computedomains/status"], "verbs": ["update"]},
+    ])
+    a = Authorizer(c)
+    ident = Identity("driver", "ctrl")
+    a.check_rbac(ident, "create", "apps", "daemonsets")
+    a.check_rbac(ident, "update", "resource.tpu.google.com",
+                 "computedomains/status")
+    with pytest.raises(Forbidden):
+        a.check_rbac(ident, "delete", "apps", "daemonsets")
+    with pytest.raises(Forbidden):  # subresource grant != resource grant
+        a.check_rbac(ident, "update", "resource.tpu.google.com",
+                     "computedomains")
+    # Unknown SA has no roles at all.
+    with pytest.raises(Forbidden):
+        a.check_rbac(Identity("driver", "stranger"), "get", "apps",
+                     "daemonsets")
+    # Admin (tokenless) bypasses.
+    a.check_rbac(None, "delete", "apps", "daemonsets")
+
+
+def test_rbac_wildcards():
+    c = _cluster_with_role([
+        {"apiGroups": ["*"], "resources": ["*"], "verbs": ["*"]},
+    ])
+    Authorizer(c).check_rbac(
+        Identity("driver", "ctrl"), "delete", "anything", "whatever"
+    )
+
+
+def _node_policy_cluster(restricted_sa="system:serviceaccount:d:plugin"):
+    c = FakeCluster()
+    c.create(VALIDATING_ADMISSION_POLICIES, {
+        "apiVersion": "admissionregistration.k8s.io/v1",
+        "kind": "ValidatingAdmissionPolicy",
+        "metadata": {"name": "resourceslices-policy"},
+        "spec": {
+            "matchConstraints": {"resourceRules": [{
+                "apiGroups": ["resource.k8s.io"],
+                "operations": ["CREATE", "UPDATE", "DELETE"],
+                "resources": ["resourceslices"],
+            }]},
+            "matchConditions": [{
+                "name": "isRestrictedUser",
+                "expression": (
+                    f'request.userInfo.username == "{restricted_sa}"'
+                ),
+            }],
+        },
+    })
+    return c
+
+
+def test_node_restriction_policy():
+    a = Authorizer(_node_policy_cluster())
+    plugin = Identity("d", "plugin", node="node-0")
+    own = {"spec": {"nodeName": "node-0"}}
+    other = {"spec": {"nodeName": "node-1"}}
+    a.admit(RESOURCE_SLICES, "CREATE", own, None, None, plugin)
+    with pytest.raises(AdmissionDenied, match="other nodes"):
+        a.admit(RESOURCE_SLICES, "CREATE", other, None, None, plugin)
+    # DELETE is judged on the existing object.
+    with pytest.raises(AdmissionDenied, match="other nodes"):
+        a.admit(RESOURCE_SLICES, "DELETE", {}, other, None, plugin)
+    a.admit(RESOURCE_SLICES, "DELETE", {}, own, None, plugin)
+    # No node binding in the token: refused with the policy's message.
+    with pytest.raises(AdmissionDenied, match="no node association"):
+        a.admit(RESOURCE_SLICES, "CREATE", own, None, None,
+                Identity("d", "plugin"))
+    # Other identities are outside the matchCondition; cluster-admin too.
+    a.admit(RESOURCE_SLICES, "CREATE", other, None, None,
+            Identity("d", "scheduler"))
+    a.admit(RESOURCE_SLICES, "CREATE", other, None, None, None)
+
+
+def test_webhook_failure_policy_without_url():
+    c = FakeCluster()
+    base = {
+        "apiVersion": "admissionregistration.k8s.io/v1",
+        "kind": "ValidatingWebhookConfiguration",
+        "metadata": {"name": "wh"},
+        "webhooks": [{
+            "name": "validate.tpu.google.com",
+            "failurePolicy": "Fail",
+            "clientConfig": {"service": {"name": "svc"}},
+            "rules": [{
+                "apiGroups": ["resource.k8s.io"],
+                "apiVersions": ["v1beta1"],
+                "operations": ["CREATE"],
+                "resources": ["resourceslices"],
+            }],
+        }],
+    }
+    c.create(VALIDATING_WEBHOOK_CONFIGURATIONS, base)
+    a = Authorizer(c)
+    # Service-form clientConfig is unreachable without a cluster:
+    # failurePolicy Fail rejects, Ignore admits.
+    with pytest.raises(AdmissionDenied, match="no url"):
+        a.admit(RESOURCE_SLICES, "CREATE", {}, None, None, None)
+    cfg = c.get(VALIDATING_WEBHOOK_CONFIGURATIONS, None, "wh")
+    cfg["webhooks"][0]["failurePolicy"] = "Ignore"
+    c.update(VALIDATING_WEBHOOK_CONFIGURATIONS, cfg)
+    a.admit(RESOURCE_SLICES, "CREATE", {}, None, None, None)
+    # Rules that don't match the GVR/op never call out.
+    cfg["webhooks"][0]["failurePolicy"] = "Fail"
+    cfg["metadata"]["resourceVersion"] = None
+    c.update(VALIDATING_WEBHOOK_CONFIGURATIONS, cfg)
+    a.admit(RESOURCE_SLICES, "UPDATE", {}, None, None, None)

@@ -33,11 +33,21 @@ def all_demo_docs():
 DOCS = all_demo_docs()
 
 
-def enable_all_gates():
+# Real clusters run a VALID gate combination (DynamicSubslice is mutually
+# exclusive with the sharing gates and PassthroughSupport, fg.validate());
+# a demo config is well-formed iff SOME valid profile accepts it.
+GATE_PROFILES = (
+    ("TimeSlicingSettings", "MultiplexingSupport"),
+    ("DynamicSubslice",),
+    ("TimeSlicingSettings", "MultiplexingSupport", "PassthroughSupport"),
+)
+
+
+def set_gates(names):
     g = fg.FeatureGates()
-    for name in ("TimeSlicingSettings", "MultiplexingSupport",
-                 "DynamicSubslice", "PassthroughSupport"):
+    for name in names:
         g.set(name, True)
+    g.validate()  # only real combinations are allowed here
     fg.reset_for_tests(g)
 
 
@@ -55,13 +65,23 @@ def test_demo_specs_exist():
 
 
 def test_opaque_configs_strict_decode_and_validate():
-    enable_all_gates()
     seen = 0
     for fname, doc in DOCS:
         for params in iter_opaque_configs(doc):
-            obj = serde.strict_decode(params)
-            obj.normalize()
-            obj.validate()
+            errs = []
+            for profile in GATE_PROFILES:
+                set_gates(profile)
+                try:
+                    obj = serde.strict_decode(params)
+                    obj.normalize()
+                    obj.validate()
+                    break
+                except Exception as e:  # noqa: BLE001 — aggregated below
+                    errs.append(f"{profile}: {e}")
+            else:
+                raise AssertionError(
+                    f"{fname}: config valid under no gate profile: {errs}"
+                )
             seen += 1
     assert seen >= 3  # multiplexing, subslice, vfio at minimum
 

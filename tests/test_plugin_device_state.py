@@ -647,3 +647,99 @@ def test_default_time_slice_needs_no_arbiter(tmp_path):
     env = spec["devices"][0]["containerEdits"]["env"]
     assert not any(e.startswith("TPU_PROCESS_MULTIPLEXING") for e in env)
     state.unprepare(claim["metadata"]["uid"])
+
+
+def test_multiplexing_over_static_subslice(tmp_path):
+    """MPS-on-MIG analog (reference demo/specs/mig+mps): a multiplexed
+    claim on a STATIC sub-slice device provisions the arbiter over the
+    sub-slice's parent chips — sharing and partitioning compose."""
+    gates(MultiplexingSupport=True)
+    from tpu_dra.plugin.allocatable import static_subslice_device_name
+    from tpu_dra.tpulib.types import Placement, SubsliceShape, TopologyCoord
+
+    backend = FakeCluster()
+    lib = StubTpuLib(
+        config={"generation": "v5e", "hostname": "node-0"},
+        state_dir=str(tmp_path / "tpustate"),
+    )
+    ss = lib.create_subslice(
+        Placement(TopologyCoord(0, 0, 0), SubsliceShape.parse("1x1"))
+    )
+    state = DeviceState(
+        tpulib=lib,
+        cdi=CDIHandler(cdi_root=str(tmp_path / "cdi")),
+        checkpoints=CheckpointManager(str(tmp_path / "ckpt")),
+        multiplex_manager=MultiplexManager(backend, node_name="node-0"),
+        node_name="node-0",
+    )
+    name = static_subslice_device_name(ss)
+    assert name in state.allocatable
+
+    deployments = ResourceClient(backend, DEPLOYMENTS)
+    w = backend.watch(DEPLOYMENTS)
+
+    import threading
+
+    def readiness_controller():
+        for ev, obj in w:
+            if ev == "ADDED":
+                obj["status"] = {"readyReplicas": 1}
+                deployments.update_status(obj)
+                return
+
+    threading.Thread(target=readiness_controller, daemon=True).start()
+
+    params = {
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "TpuSubsliceConfig",
+        "sharing": {"strategy": "Multiplexing"},
+    }
+    claim = make_claim([name], configs=[opaque(params, ["req0"])])
+    state.prepare(claim)
+
+    deps = deployments.list(namespace="tpu-dra-driver")
+    assert len(deps) == 1
+    env = {
+        e["name"]: e.get("value", "")
+        for e in deps[0]["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    # The arbiter owns exactly the sub-slice's parent chips.
+    assert env["TPU_MULTIPLEX_CHIPS"] == ",".join(ss.parent_chip_uuids)
+    spec = state.cdi.read_claim_spec(claim["metadata"]["uid"])
+    env_list = spec["devices"][0]["containerEdits"]["env"]
+    assert "TPU_PROCESS_MULTIPLEXING=true" in env_list
+    # The sub-slice's own visibility env still rides along.
+    assert any(e.startswith("TPU_VISIBLE_DEVICES=") for e in env_list)
+
+    state.unprepare(claim["metadata"]["uid"])
+    assert deployments.list(namespace="tpu-dra-driver") == []
+
+
+def test_multiplexing_with_dynamic_subslice_refused_at_validation(tmp_path):
+    """The DynamicSubslice x Multiplexing combination is refused by config
+    VALIDATION (which the admission webhook runs), so users hear "no" at
+    apply time; the same validate runs in Prepare's strict decode as
+    defense in depth (r2 verdict #7)."""
+    g = fg.FeatureGates()
+    g.set("MultiplexingSupport", True)
+    g.set("DynamicSubslice", True)  # bypasses cross-gate validate()
+    fg.reset_for_tests(g)
+    from tpu_dra.api.errors import ApiError
+    from tpu_dra.api.serde import strict_decode
+
+    cfg = strict_decode({
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "TpuConfig",
+        "sharing": {"strategy": "Multiplexing"},
+    })
+    with pytest.raises(ApiError, match="DynamicSubslice"):
+        cfg.validate()
+
+    state, _ = make_state(tmp_path)
+    params = {
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "TpuConfig",
+        "sharing": {"strategy": "Multiplexing"},
+    }
+    with pytest.raises(PermanentError, match="DynamicSubslice"):
+        state.prepare(make_claim(["tpu-0"], configs=[opaque(params, ["req0"])]))

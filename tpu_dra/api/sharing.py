@@ -226,6 +226,19 @@ class TpuSharing(Serde):
                 raise ApiError(
                     "multiplexing requires the MultiplexingSupport feature gate"
                 )
+            if fg.enabled(fg.DYNAMIC_SUBSLICE):
+                # A dynamic reshape invalidates the arbiter's chip set
+                # mid-lease, so the combination is refused — HERE, at
+                # admission (the webhook runs this validate), so users
+                # hear "no" at apply time rather than at Prepare. Static
+                # sub-slices multiplex fine (arbiter over parent chips,
+                # the MPS-on-MIG analog).
+                raise ApiError(
+                    "multiplexing cannot be combined with "
+                    "featureGates.DynamicSubslice: a dynamic sub-slice "
+                    "reshape would invalidate the sharing arbiter's chip "
+                    "set; use static sub-slices or disable one feature"
+                )
             if self.time_slicing_config is not None:
                 raise ApiError("timeSlicingConfig invalid with Multiplexing strategy")
             if self.multiplexing_config is not None:
@@ -267,6 +280,17 @@ class TpuSubsliceSharing(Serde):
             if not fg.enabled(fg.MULTIPLEXING_SUPPORT):
                 raise ApiError(
                     "multiplexing requires the MultiplexingSupport feature gate"
+                )
+            if fg.enabled(fg.DYNAMIC_SUBSLICE):
+                # Same apply-time refusal as TpuSharing: an arbiter over a
+                # sub-slice owns its parent chips, which a dynamic reshape
+                # would invalidate mid-lease. Static sub-slices multiplex
+                # fine (the MPS-on-MIG analog).
+                raise ApiError(
+                    "multiplexing cannot be combined with "
+                    "featureGates.DynamicSubslice: a dynamic sub-slice "
+                    "reshape would invalidate the sharing arbiter's chip "
+                    "set; use static sub-slices or disable one feature"
                 )
             if self.multiplexing_config is not None:
                 self.multiplexing_config.validate()

@@ -42,6 +42,20 @@ Key = Tuple[str, Optional[str], str]  # (plural, namespace, name)
 WATCH_TIMEOUT = object()
 
 
+def merge_patch(dst: dict, src: dict) -> dict:
+    """Strategic-merge-lite used by patch(); shared with the fakeserver's
+    admission path so a PATCH is reviewed against the same merged object
+    the cluster would store. None deletes a key."""
+    for k, v in src.items():
+        if v is None:
+            dst.pop(k, None)
+        elif isinstance(v, dict) and isinstance(dst.get(k), dict):
+            merge_patch(dst[k], v)
+        else:
+            dst[k] = copy.deepcopy(v)
+    return dst
+
+
 class _Watch:
     def __init__(self, rd, namespace, selector):
         self.rd = rd
@@ -304,21 +318,18 @@ class FakeCluster(Backend):
     def update_status(self, rd, obj) -> dict:
         return self._update(rd, obj, status_only=True)
 
-    def patch(self, rd, namespace, name, patch) -> dict:
-        """Strategic-merge-lite: dict deep-merge; None deletes a key."""
+    def patch(self, rd, namespace, name, patch, admit=None) -> dict:
+        """Strategic-merge-lite: dict deep-merge; None deletes a key.
+        ``admit(merged)`` (if given) runs on the merged object INSIDE the
+        lock, before it is stored — raising aborts the patch. That keeps
+        admission reviews true to what actually lands (no
+        review-then-store race), at the cost of holding the lock across
+        the review; fine for a test apiserver."""
         with self._lock:
             cur = self.get(rd, namespace, name)
-
-            def merge(dst, src):
-                for k, v in src.items():
-                    if v is None:
-                        dst.pop(k, None)
-                    elif isinstance(v, dict) and isinstance(dst.get(k), dict):
-                        merge(dst[k], v)
-                    else:
-                        dst[k] = copy.deepcopy(v)
-
-            merge(cur, patch)
+            merge_patch(cur, patch)
+            if admit is not None:
+                admit(cur)
             cur["metadata"]["resourceVersion"] = None  # skip conflict check
             return self._update(rd, cur, status_only=False)
 

@@ -25,6 +25,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlsplit
 
+from tpu_dra.k8sclient.authz import (
+    AdmissionDenied,
+    Authorizer,
+    Forbidden,
+    parse_bearer,
+)
 from tpu_dra.k8sclient.fake import WATCH_TIMEOUT, FakeCluster
 from tpu_dra.k8sclient.resources import (
     ResourceDescriptor,
@@ -68,8 +74,18 @@ class FakeApiServer:
     """ThreadingHTTPServer wrapper; one shared FakeCluster behind it."""
 
     def __init__(self, cluster: Optional[FakeCluster] = None,
-                 port: int = 0, address: str = "127.0.0.1"):
+                 port: int = 0, address: str = "127.0.0.1",
+                 enforce_rbac: bool = False):
         self.cluster = cluster or FakeCluster()
+        # Admission (stored ValidatingWebhookConfigurations + the
+        # resourceslices node-restriction policy) is ALWAYS active, like a
+        # real apiserver — it simply no-ops until such objects are
+        # applied. RBAC evaluation of bearer identities is opt-in
+        # (--rbac): with it on, any request authenticating as a
+        # ServiceAccount must fit the stored ClusterRoles; tokenless
+        # requests are the test harness acting as cluster-admin.
+        self.enforce_rbac = enforce_rbac
+        self.authz = Authorizer(self.cluster)
         self._registry = _registry()
         self._watches = []
         self._watch_lock = threading.Lock()
@@ -134,6 +150,41 @@ class FakeApiServer:
                 n = int(self.headers.get("Content-Length", 0))
                 return json.loads(self.rfile.read(n)) if n else {}
 
+            def _authorize(self, r: _Route, verb: str) -> bool:
+                """RBAC gate (authn → authz, before any admission/side
+                effects); replies 403 and returns False on denial."""
+                if not outer.enforce_rbac:
+                    return True
+                ident = parse_bearer(self.headers.get("Authorization"))
+                resource = r.rd.plural + ("/status" if r.status else "")
+                try:
+                    outer.authz.check_rbac(ident, verb, r.rd.group, resource)
+                    return True
+                except Forbidden as e:
+                    self._reply(403, {
+                        "kind": "Status", "status": "Failure",
+                        "reason": "Forbidden", "message": str(e), "code": 403,
+                    })
+                    return False
+
+            def _admit(self, r: _Route, operation: str, obj: dict,
+                       old_obj: Optional[dict] = None) -> bool:
+                """Admission (webhooks + stored policies); replies with
+                the denial and returns False when rejected."""
+                try:
+                    outer.authz.admit(
+                        r.rd, operation, obj, old_obj, r.namespace,
+                        parse_bearer(self.headers.get("Authorization")),
+                    )
+                    return True
+                except AdmissionDenied as e:
+                    self._reply(e.status, {
+                        "kind": "Status", "status": "Failure",
+                        "reason": "Invalid", "message": str(e),
+                        "code": e.status,
+                    })
+                    return False
+
             def _maybe_throttle(self) -> bool:
                 with outer._fault_lock:
                     if outer._throttle_remaining <= 0:
@@ -168,13 +219,17 @@ class FakeApiServer:
                 if r is None:
                     return self._reply(404, {"message": "no such route"})
                 qs = parse_qs(urlsplit(self.path).query)
+                watching = qs.get("watch", ["false"])[0] == "true"
+                verb = "get" if r.name else ("watch" if watching else "list")
+                if not self._authorize(r, verb):
+                    return None
                 try:
                     if r.name:
                         return self._reply(
                             200, outer.cluster.get(r.rd, r.namespace, r.name)
                         )
                     labels = _parse_selector(qs, "labelSelector")
-                    if qs.get("watch", ["false"])[0] == "true":
+                    if watching:
                         rv = qs.get("resourceVersion", [None])[0]
                         return self._serve_watch(r, labels, rv)
                     fields = _parse_selector(qs, "fieldSelector")
@@ -255,12 +310,16 @@ class FakeApiServer:
                 r = self._route()
                 if r is None:
                     return self._reply(404, {"message": "no such route"})
+                if not self._authorize(r, "create"):
+                    return None
                 try:
                     obj = self._body()
                     if r.rd.namespaced and r.namespace:
                         obj.setdefault("metadata", {}).setdefault(
                             "namespace", r.namespace
                         )
+                    if not self._admit(r, "CREATE", obj):
+                        return None
                     return self._reply(201, outer.cluster.create(r.rd, obj))
                 except Exception as e:
                     return self._error(e)
@@ -271,8 +330,15 @@ class FakeApiServer:
                 r = self._route()
                 if r is None or not r.name:
                     return self._reply(404, {"message": "no such route"})
+                if not self._authorize(r, "update"):
+                    return None
                 try:
                     obj = self._body()
+                    # Status subresource writes aren't in the webhook's
+                    # rules (resources: [resourceclaims], not .../status)
+                    # — same as a real apiserver.
+                    if not r.status and not self._admit(r, "UPDATE", obj):
+                        return None
                     fn = (
                         outer.cluster.update_status
                         if r.status
@@ -288,10 +354,31 @@ class FakeApiServer:
                 r = self._route()
                 if r is None or not r.name:
                     return self._reply(404, {"message": "no such route"})
+                if not self._authorize(r, "patch"):
+                    return None
                 try:
+                    body = self._body()
+                    ident = parse_bearer(self.headers.get("Authorization"))
+
+                    def admit(merged):
+                        # Status subresource writes aren't in webhook
+                        # rules (same as do_PUT); runs inside the cluster
+                        # lock so the reviewed object IS the stored one.
+                        if not r.status:
+                            outer.authz.admit(
+                                r.rd, "UPDATE", merged, None, r.namespace,
+                                ident,
+                            )
+
                     return self._reply(200, outer.cluster.patch(
-                        r.rd, r.namespace, r.name, self._body()
+                        r.rd, r.namespace, r.name, body, admit=admit
                     ))
+                except AdmissionDenied as e:
+                    return self._reply(e.status, {
+                        "kind": "Status", "status": "Failure",
+                        "reason": "Invalid", "message": str(e),
+                        "code": e.status,
+                    })
                 except Exception as e:
                     return self._error(e)
 
@@ -301,7 +388,15 @@ class FakeApiServer:
                 r = self._route()
                 if r is None or not r.name:
                     return self._reply(404, {"message": "no such route"})
+                if not self._authorize(r, "delete"):
+                    return None
                 try:
+                    # A nonexistent object 404s BEFORE admission — a
+                    # benign double-delete must not surface as a policy
+                    # denial.
+                    old = outer.cluster.get(r.rd, r.namespace, r.name)
+                    if not self._admit(r, "DELETE", {}, old_obj=old):
+                        return None
                     outer.cluster.delete(r.rd, r.namespace, r.name)
                     return self._reply(200, {"kind": "Status", "status": "Success"})
                 except Exception as e:
@@ -367,9 +462,14 @@ def main(argv=None) -> int:
     p.add_argument("--seed", default="", help="Directory of manifests to load")
     p.add_argument("--kubeconfig-out", default="",
                    help="Write a kubeconfig pointing at this server")
+    p.add_argument("--rbac", action="store_true",
+                   help="Evaluate bearer ServiceAccount identities against "
+                   "stored ClusterRoles (tokenless requests stay admin)")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
-    srv = FakeApiServer(port=args.port, address=args.address)
+    srv = FakeApiServer(
+        port=args.port, address=args.address, enforce_rbac=args.rbac
+    )
     if args.seed:
         n = srv.cluster.load_dir(args.seed)
         log.info("seeded %d objects", n)

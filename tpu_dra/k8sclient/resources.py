@@ -103,6 +103,35 @@ COMPUTE_DOMAIN_CLIQUES = ResourceDescriptor(
     "resource.tpu.google.com", "v1beta1", "computedomaincliques", "ComputeDomainClique"
 )
 
+# Identity + admission surface: the chart's ServiceAccounts, RBAC, and
+# webhook/CEL-policy objects are stored AND enforced by the fakeserver's
+# --rbac mode (k8sclient/authz.py), so a missing verb or an unvalidated
+# opaque config fails in the cluster-less e2e the same way it would on a
+# real apiserver.
+SERVICE_ACCOUNTS = ResourceDescriptor("", "v1", "serviceaccounts", "ServiceAccount")
+SERVICES = ResourceDescriptor("", "v1", "services", "Service")
+SECRETS = ResourceDescriptor("", "v1", "secrets", "Secret")
+CLUSTER_ROLES = ResourceDescriptor(
+    "rbac.authorization.k8s.io", "v1", "clusterroles", "ClusterRole",
+    namespaced=False,
+)
+CLUSTER_ROLE_BINDINGS = ResourceDescriptor(
+    "rbac.authorization.k8s.io", "v1", "clusterrolebindings",
+    "ClusterRoleBinding", namespaced=False,
+)
+VALIDATING_WEBHOOK_CONFIGURATIONS = ResourceDescriptor(
+    "admissionregistration.k8s.io", "v1", "validatingwebhookconfigurations",
+    "ValidatingWebhookConfiguration", namespaced=False,
+)
+VALIDATING_ADMISSION_POLICIES = ResourceDescriptor(
+    "admissionregistration.k8s.io", "v1", "validatingadmissionpolicies",
+    "ValidatingAdmissionPolicy", namespaced=False,
+)
+VALIDATING_ADMISSION_POLICY_BINDINGS = ResourceDescriptor(
+    "admissionregistration.k8s.io", "v1", "validatingadmissionpolicybindings",
+    "ValidatingAdmissionPolicyBinding", namespaced=False,
+)
+
 
 def iter_descriptors() -> Iterable[ResourceDescriptor]:
     """Every ResourceDescriptor this package declares (one registry for

@@ -157,6 +157,26 @@ class AllocatableDevices(dict):
             if d.type == TPU_DEVICE_TYPE and d.chip is not None
         ]
 
+    def arbiter_chip_uuids(self) -> List[str]:
+        """Chip set a sharing arbiter (multiplex/time-slice control
+        daemon) owns for these devices: full chips directly, and a static
+        sub-slice's parent chips — the reference runs MPS on MIG devices
+        the same way (sharing.go applies per-device incl. MIG;
+        demo/specs/mig+mps). Dynamic sub-slices are excluded by
+        construction: a reshape would invalidate the arbiter's chip set
+        (rejected at admission, api/sharing.py)."""
+        out: List[str] = []
+        for d in self.values():
+            if d.type == TPU_DEVICE_TYPE and d.chip is not None:
+                out.append(d.chip.uuid)
+            elif (
+                d.type == SUBSLICE_STATIC_DEVICE_TYPE
+                and d.subslice is not None
+            ):
+                out.extend(d.subslice.parent_chip_uuids)
+        seen = set()
+        return [u for u in out if not (u in seen or seen.add(u))]
+
     def siblings_of(self, device: "AllocatableDevice") -> List[str]:
         """Devices sharing any chip coordinate with ``device`` (the
         passthrough sibling set, allocatable.go:238-289)."""

@@ -614,13 +614,22 @@ class DeviceState:
                 state.container_edits = daemon.container_edits()
 
         if fg.enabled(fg.MULTIPLEXING_SUPPORT) and sharing.is_multiplexing():
-            if fg.enabled(fg.DYNAMIC_SUBSLICE):
-                raise PermanentError(
-                    "multiplexing is not yet supported with "
-                    "featureGates.DynamicSubslice=true"
-                )
+            # The DynamicSubslice combination is refused at admission
+            # (api/sharing.py validate, run by the webhook AND by the
+            # strict decode in prepare_devices) — no Prepare-time check
+            # needed. What IS checked here: every requested device must
+            # have a chip set an arbiter can own (full chips or static
+            # sub-slices' parent chips; a dynamic sub-slice request
+            # reaching this point means admission was bypassed).
             if self.multiplex_manager is None:
                 raise PrepareError("multiplex manager not configured on this node")
+            arbiter_chips = requested.arbiter_chip_uuids()
+            if not arbiter_chips:
+                raise PermanentError(
+                    "multiplexing requires full-chip or static sub-slice "
+                    "devices; the requested devices expose no arbiter "
+                    "chip set"
+                )
             mpc = sharing.get_multiplexing_config()
             daemon = self.multiplex_manager.new_control_daemon(
                 claim["metadata"]["uid"], requested

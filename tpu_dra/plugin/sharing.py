@@ -89,8 +89,10 @@ class MultiplexControlDaemon:
         """Render the control-daemon Deployment
         (templates/mps-control-daemon.tmpl.yaml analog). With
         ``timeslice_ordinal`` the daemon runs in time-slice mode: the
-        ordinal sets its lease quantum (nvlib.go setTimeSlice analog)."""
-        uuids = self.devices.tpu_uuids()
+        ordinal sets its lease quantum (nvlib.go setTimeSlice analog).
+        The arbiter's chip set covers full chips and static sub-slices'
+        parent chips (the MPS-on-MIG analog)."""
+        uuids = self.devices.arbiter_chip_uuids()
         limits: Dict[str, str] = {}
         share_pct = ""
         if config is not None:
