@@ -189,16 +189,24 @@ def bench_config():
             n_heads=32,
             n_kv_heads=8,
             ffn_dim=8192,
-            remat=os.environ.get("BENCH_REMAT", "1") == "1",
-            # Save matmul outputs, recompute elementwise: ~8% more
-            # tok/s than full remat at this size (measured on-chip).
+            # r3: this model FITS without remat at the bench batch sizes,
+            # and skipping the recompute wins at both sequence lengths
+            # (seq 1024: 17.3k -> 17.9k tok/s; seq 2048: 14.2k -> 15.3k,
+            # measured on-chip). Set BENCH_REMAT=1 for the memory-bound
+            # regime ("dots" policy: save matmul outputs).
+            remat=os.environ.get("BENCH_REMAT", "0") == "1",
             remat_policy=os.environ.get("BENCH_REMAT_POLICY", "dots"),
-            # Flash-tile sweep on v5e (r2): whole-sequence tiles win at
-            # seq 1024 — 256/256 -> 15.6k, 512/512 -> 16.9k, 1024/1024 ->
-            # 17.3k tok/s (56.7% MFU). At seq 2048 the ceiling measured
-            # ~51% MFU (512/512 -> 15.1k; 2048-row tiles OOM).
+            # Flash-tile sweep on v5e: 1024/1024 wins at both seq 1024
+            # (256 -> 15.6k, 512 -> 16.9k, 1024 -> 17.9k tok/s) and seq
+            # 2048 (512/512 -> 12.8k, 1024/1024 -> 15.3k; 2048-row tiles
+            # OOM). r3 kernel change: matmul inputs stay bf16 with fp32
+            # accumulation (+2.4% at seq 2048 over fp32-input kernels).
+            # Residual seq-2048 gap (51.7% vs 58.4% MFU) is
+            # attention-bound: 4x the s^2 softmax/mask VPU work and
+            # hd=64 QK contractions at half MXU depth.
             attention_block_q=int(os.environ.get("BENCH_BLOCK_Q", "1024")),
             attention_block_k=int(os.environ.get("BENCH_BLOCK_K", "1024")),
+            attention_impl=os.environ.get("BENCH_ATTN_IMPL", "auto"),
             # Streamed LM-head loss (ops/loss.py): avoids the [b, s, 32k]
             # fp32 logit materialization that dominates HBM at this size.
             fused_ce=os.environ.get("BENCH_FUSED_CE", "0") == "1",

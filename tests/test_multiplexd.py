@@ -104,6 +104,17 @@ def daemon(backend, tmp_path):
     d.stop()
 
 
+
+def _wait_status(client, pred, timeout=10):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        st = client.status()
+        if pred(st):
+            return st
+        time.sleep(0.02)
+    raise AssertionError(f"status never satisfied predicate: {client.status()}")
+
+
 def test_acquire_release_roundtrip(daemon, tmp_path):
     c = MultiplexClient(str(tmp_path), client_name="w0")
     with c.lease() as lease:
@@ -163,15 +174,11 @@ def test_queued_client_hangup_is_dropped(daemon, tmp_path):
     s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     s.connect(str(tmp_path / SOCKET_NAME))
     s.sendall(b'{"op": "acquire", "client": "ghost"}\n')
-    time.sleep(0.3)
-    assert c0.status()["waiting"] == 1
+    # Poll, not a fixed sleep: under a loaded CI box the daemon may take
+    # longer than any fixed delay to process the queue request.
+    _wait_status(c0, lambda st: st["waiting"] == 1)
     s.close()
-    deadline = time.monotonic() + 5
-    while time.monotonic() < deadline:
-        if c0.status()["waiting"] == 0:
-            break
-        time.sleep(0.05)
-    assert c0.status()["waiting"] == 0
+    _wait_status(c0, lambda st: st["waiting"] == 0)
     c0.release()
     c0.close()
 
@@ -187,17 +194,9 @@ def test_queued_client_dead_with_buffered_bytes_is_dropped(daemon, tmp_path):
     s.connect(str(tmp_path / SOCKET_NAME))
     # Queue, then leave extra unread bytes behind and die.
     s.sendall(b'{"op": "acquire", "client": "ghost"}\n{"op": "status"}\n')
-    time.sleep(0.3)
-    assert c0.status()["waiting"] == 1
+    _wait_status(c0, lambda st: st["waiting"] == 1)
     s.close()
-    deadline = time.monotonic() + 5
-    while time.monotonic() < deadline:
-        if c0.status()["waiting"] == 0:
-            break
-        time.sleep(0.05)
-    assert c0.status()["waiting"] == 0, (
-        "dead queued client with buffered bytes was not dropped"
-    )
+    _wait_status(c0, lambda st: st["waiting"] == 0)
     c0.release()
     # The lease must remain grantable to a live client.
     c1 = MultiplexClient(str(tmp_path), client_name="next")
