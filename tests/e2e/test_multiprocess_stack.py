@@ -55,18 +55,27 @@ def wait_for(pred, timeout=30, tick=0.2, what="condition"):
     raise TimeoutError(f"timed out waiting for {what}")
 
 
-def stub_cfg(path, hostname, worker_id):
+def stub_cfg(path, hostname, worker_id, slice_uuid="feedfeed"):
     path.write_text(yaml.safe_dump({
         "generation": "v5p",
         "hostname": hostname,
         "slice": {
-            "uuid": "feedfeed",
+            "uuid": slice_uuid,
             "topology": "2x2x2",
             "num_hosts": 2,
             "worker_id": worker_id,
         },
     }))
     return str(path)
+
+
+def read_rendered_env(cfg_dir) -> dict:
+    """Parse a daemon-rendered bootstrap.env (KEY=VALUE lines)."""
+    return dict(
+        ln.split("=", 1)
+        for ln in (cfg_dir / "bootstrap.env").read_text().splitlines()
+        if "=" in ln
+    )
 
 
 class Stack:
@@ -1037,11 +1046,7 @@ def test_distributed_rendezvous_from_rendered_envs(stack):
     # per identity.
     envs = {}
     for d in cfg_dirs:
-        kv = dict(
-            line.split("=", 1)
-            for line in (d / "bootstrap.env").read_text().splitlines()
-            if "=" in line
-        )
+        kv = read_rendered_env(d)
         envs[int(kv["TPU_WORKER_ID"])] = (d, kv)
     assert sorted(envs) == [0, 1]
     assert envs[0][1]["JAX_COORDINATOR_ADDRESS"].endswith(f":{port}")
@@ -1059,6 +1064,10 @@ def test_distributed_rendezvous_from_rendered_envs(stack):
     results = []
     for wid, w in enumerate(workers):
         rc = w.wait(timeout=max(1, deadline - time.monotonic()))
+        # Completed workers must leave stack.procs: assert_alive treats
+        # ANY exited entry as a crash, including a clean rc=0.
+        _, logf = stack.procs.pop(f"rdv-worker-{wid}")
+        logf.close()
         log_text = (td / f"rdv-worker-{wid}.log").read_text()
         assert rc == 0, f"worker {wid} rc={rc}:\n{log_text[-4000:]}"
         last_json = [
@@ -1082,3 +1091,143 @@ def test_distributed_rendezvous_from_rendered_envs(stack):
             p.wait(timeout=15)
         finally:
             logf.close()
+
+
+def test_multislice_rendezvous_from_rendered_envs(stack):
+    """Multi-slice (DCN/megascale) domain, executed across processes: a
+    numSlices=2 x 2-host ComputeDomain with FOUR slice daemons. The
+    controller pins each clique's sliceIndex; every daemon renders
+    MEGASCALE_{NUM_SLICES,SLICE_ID,COORDINATOR_ADDRESS} (DCN identity,
+    pod-IP coordinator) plus its slice-LOCAL JAX rendezvous — and each
+    slice then forms its own real cross-process jax.distributed group
+    from those files (the in-process dryrun leg 6 modeling, driven
+    through live daemons and OS-process workloads)."""
+    import socket
+
+    if "controller" not in stack.procs:
+        pytest.skip("requires the bringup test's controller")
+    kc = stack.kc
+    td = stack.td
+
+    cd = kc.create(COMPUTE_DOMAINS, {
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "ComputeDomain",
+        "metadata": {"name": "cd-ms", "namespace": NS},
+        "spec": {
+            "numNodes": 4,
+            "numSlices": 2,
+            "channel": {"resourceClaimTemplate": {"name": "cd-ms-channel"}},
+            "acceleratorType": "v5p-16",
+            "topology": "2x2x2",
+        },
+    })
+    cd_uid = cd["metadata"]["uid"]
+
+    ports = []
+    for _ in range(2):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+
+    cfg_dirs = {}  # (slice, host) -> dir
+    for sl in range(2):
+        for i in range(2):
+            cfg_dir = td / f"ms-cfg-{sl}{i}"
+            cfg_dir.mkdir(exist_ok=True)
+            cfg_dirs[(sl, i)] = cfg_dir
+            stack.spawn(
+                f"ms-daemon-{sl}{i}",
+                ["tpu_dra.computedomain.daemon.main", "run",
+                 "--kubeconfig", stack.kubeconfig,
+                 "--cd-uid", cd_uid, "--cd-name", "cd-ms",
+                 "--cd-namespace", NS,
+                 "--num-nodes", "4", "--num-slices", "2",
+                 "--node-name", f"ms-node-{sl}{i}",
+                 "--pod-ip", "127.0.0.1",
+                 # Per-slice rendezvous port: in production each slice's
+                 # /etc/hosts maps daemon-0 to a different IP; locally
+                 # both slices are loopback, so ports disambiguate.
+                 "--coordinator-port", str(ports[sl]),
+                 "--config-dir", str(cfg_dir),
+                 "--hosts-path", str(td / f"ms-hosts-{sl}{i}"),
+                 "--heartbeat-period", "1"],
+                TPU_DRA_BACKEND="stub",
+                TPU_DRA_STUB_CONFIG=stub_cfg(
+                    td / f"stub-ms-{sl}{i}.yaml", f"ms-node-{sl}{i}", i,
+                    slice_uuid=f"feed{sl:04d}",
+                ),
+            )
+
+    def all_rendered_with_dcn_identity():
+        for d in cfg_dirs.values():
+            if not ((d / "bootstrap.env").exists() and (d / "ready").exists()):
+                return False
+            env = read_rendered_env(d)
+            # The DCN coordinator only renders once slice 0 is pinned and
+            # registered — require the COMPLETE megascale block.
+            if env.get("MEGASCALE_NUM_SLICES") != "2":
+                return False
+            if "MEGASCALE_COORDINATOR_ADDRESS" not in env:
+                return False
+        return True
+
+    wait_for(all_rendered_with_dcn_identity, timeout=90,
+             what="4 daemons rendered with complete DCN identity")
+    stack.assert_alive()
+
+    # Group rendered envs by controller-pinned slice id; each slice must
+    # be a complete, consistent 2-process rendezvous domain.
+    by_slice = {}
+    for (sl, i), d in cfg_dirs.items():
+        env = read_rendered_env(d)
+        assert env["JAX_NUM_PROCESSES"] == "2", env
+        by_slice.setdefault(env["MEGASCALE_SLICE_ID"], []).append((d, env))
+    assert sorted(by_slice) == ["0", "1"], sorted(by_slice)
+    coords = set()
+    for sid, members in by_slice.items():
+        assert len(members) == 2, (sid, members)
+        assert {e["TPU_WORKER_ID"] for _, e in members} == {"0", "1"}
+        # One rendezvous endpoint per slice; one shared DCN coordinator.
+        assert len({e["JAX_COORDINATOR_ADDRESS"] for _, e in members}) == 1
+        coords |= {e["MEGASCALE_COORDINATOR_ADDRESS"] for _, e in members}
+    assert len(coords) == 1, f"DCN coordinator must be domain-global: {coords}"
+
+    # Execute: each slice rendezvouses as its own 2-process group.
+    workers = {}
+    for sid, members in sorted(by_slice.items()):
+        for d, env in sorted(members, key=lambda m: m[1]["TPU_WORKER_ID"]):
+            name = f"ms-worker-{sid}-{env['TPU_WORKER_ID']}"
+            workers[name] = stack.spawn(
+                name,
+                ["tpu_dra.workloads.rendezvous_smoke",
+                 "--config-dir", str(d), "--cpu-devices", "2"],
+            )
+
+    results = {}
+    deadline = time.monotonic() + 240
+    for name, w in workers.items():
+        rc = w.wait(timeout=max(1, deadline - time.monotonic()))
+        _, logf = stack.procs.pop(name)  # clean exits must leave procs
+        logf.close()
+        log_text = (td / f"{name}.log").read_text()
+        assert rc == 0, f"{name} rc={rc}:\n{log_text[-3000:]}"
+        results[name] = json.loads(
+            [ln for ln in log_text.splitlines() if ln.startswith("{")][-1]
+        )
+
+    for sid in ("0", "1"):
+        pair = [r for n, r in results.items() if n.startswith(f"ms-worker-{sid}")]
+        assert all(r["processes"] == 2 and r["global_devices"] == 4
+                   for r in pair), pair
+        assert all(r["psum"] == 3.0 for r in pair), pair
+        # Slice-mates computed the same sharded step.
+        assert pair[0]["loss"] == pair[1]["loss"], pair
+
+    for sl in range(2):
+        for i in range(2):
+            p, logf = stack.procs.pop(f"ms-daemon-{sl}{i}")
+            p.send_signal(signal.SIGTERM)
+            try:
+                p.wait(timeout=15)
+            finally:
+                logf.close()

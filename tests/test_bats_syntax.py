@@ -1,0 +1,76 @@
+"""Static validation of the bats e2e suites.
+
+The suites need kubectl + a cluster to EXECUTE (reference parity:
+SURVEY.md §4.2; the batsless runner covers their assertions without
+one), but nothing has ever parsed them in this environment — a stray
+quote or brace would first surface on a customer's kind cluster. Bats
+files are bash after its preprocessor rewrites ``@test "name" {`` into a
+function, so applying that one rewrite and running ``bash -n`` gives a
+real syntax gate. Plus structural checks: unique test names per suite
+and every spec file a suite references exists in the tree.
+"""
+
+import glob
+import os
+import re
+import subprocess
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BATS_DIR = os.path.join(REPO, "tests", "bats")
+
+TEST_RE = re.compile(r'^@test\s+(".*?"|\'.*?\')\s*\{', re.M)
+
+
+def _bats_files():
+    files = sorted(glob.glob(os.path.join(BATS_DIR, "*.bats")))
+    assert len(files) >= 13, f"bats suites missing: {files}"
+    return files
+
+
+def _as_bash(src: str) -> str:
+    """The bats preprocessor's essential rewrite: each @test block
+    becomes a plain function (names don't matter for `bash -n`)."""
+    count = iter(range(10_000))
+    return TEST_RE.sub(lambda m: f"bats_test_{next(count)}() {{", src)
+
+
+def test_every_suite_parses_as_bash():
+    companions = [
+        os.path.join(BATS_DIR, "helpers.sh"),
+        os.path.join(BATS_DIR, "setup_suite.bash"),
+    ]
+    for path in companions:
+        # A silently-skipped missing companion would be exactly the
+        # renamed-file regression this gate exists to catch.
+        assert os.path.exists(path), f"bats companion missing: {path}"
+    for path in _bats_files() + companions:
+        with open(path) as f:
+            src = f.read()
+        proc = subprocess.run(
+            ["bash", "-n", "-s"],
+            input=_as_bash(src),
+            capture_output=True,
+            text=True,
+        )
+        assert proc.returncode == 0, f"{path}: {proc.stderr}"
+
+
+def test_test_names_unique_per_suite():
+    for path in _bats_files():
+        with open(path) as f:
+            names = TEST_RE.findall(f.read())
+        assert names, f"{path}: no @test blocks"
+        assert len(names) == len(set(names)), f"{path}: duplicate: {names}"
+
+
+def test_referenced_spec_files_exist():
+    """Any specs/...yaml path a suite applies must exist in the tree —
+    a renamed spec would otherwise 404 mid-suite on a real cluster."""
+    missing = []
+    for path in _bats_files():
+        with open(path) as f:
+            src = f.read()
+        for rel in re.findall(r'(?:\$BATS_TEST_DIRNAME|\$\{BATS_TEST_DIRNAME\})/(specs/[\w./+-]+\.ya?ml)', src):
+            if not os.path.exists(os.path.join(BATS_DIR, rel)):
+                missing.append(f"{os.path.basename(path)}: {rel}")
+    assert not missing, missing
