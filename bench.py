@@ -17,7 +17,13 @@ runtime gives real workloads):
 4. **sub-slice** (BASELINE config 5): one training leg under a 1x1x1
    dynamic sub-slice claim's rendered env (TPU_CHIPS_PER_PROCESS_BOUNDS /
    TPU_PROCESS_BOUNDS / TPU_VISIBLE_DEVICES), asserting the runtime
-   respects the bounds (exactly one visible device).
+   respects the bounds (exactly one visible device);
+5. **decode** (serving): KV-cache prefill + scan decode through the DRA
+   claim env, greedy and temperature/top-k sampled tokens/sec;
+6. **time-slice rotation**: the arbiter in time-slice mode with TWO live
+   trainer processes looping maybe_yield — steady-state aggregate with
+   compile excluded, rotation counts, and per-client wait quantiles;
+7. **seq-2048**: the long-sequence training row with its own MFU.
 
 Prints ONE json line: tokens/sec/chip via the DRA path, with
 ``vs_baseline = dra / (0.95 * direct)`` — values >= 1.0 beat the reference
