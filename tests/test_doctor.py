@@ -1,0 +1,150 @@
+"""Operator doctor CLI: cross-checks of the plugin's own stores."""
+
+import json
+import os
+
+from tpu_dra.plugin.cdi import CDIHandler
+from tpu_dra.plugin.checkpoint import CheckpointManager
+from tpu_dra.plugin.device_state import DeviceState
+from tpu_dra.plugin.multiplexd import MultiplexDaemon
+from tpu_dra.tools.doctor import collect, main, render
+from tpu_dra.tpulib.stub import StubTpuLib
+
+
+def make_state(tmp_path):
+    lib = StubTpuLib(
+        config={"generation": "v5e", "hostname": "node-0"},
+        state_dir=str(tmp_path / "tpu"),
+    )
+    return DeviceState(
+        tpulib=lib,
+        cdi=CDIHandler(cdi_root=str(tmp_path / "cdi")),
+        checkpoints=CheckpointManager(str(tmp_path / "data")),
+        node_name="node-0",
+    ), lib
+
+
+def claim(uid, device="tpu-0"):
+    return {
+        "metadata": {"name": f"c-{uid[:4]}", "namespace": "ns", "uid": uid},
+        "status": {"allocation": {"devices": {"results": [{
+            "request": "r", "driver": "tpu.google.com",
+            "pool": "node-0", "device": device,
+        }], "config": []}}},
+    }
+
+
+def run_collect(tmp_path, lib):
+    return collect(
+        str(tmp_path / "data"), str(tmp_path / "cdi"),
+        str(tmp_path / "mux"), tpulib=lib,
+    )
+
+
+def test_healthy_node_reports_clean(tmp_path):
+    state, lib = make_state(tmp_path)
+    state.prepare(claim("aaaa-1111"))
+    report = run_collect(tmp_path, lib)
+    assert report["warnings"] == []
+    assert "aaaa-1111" in report["checkpoint"]["claims"]
+    assert report["checkpoint"]["claims"]["aaaa-1111"]["state"] == (
+        "PrepareCompleted"
+    )
+    assert report["cdi"]["claim_specs"] == ["aaaa-1111"]
+    assert any(c["healthy"] for c in report["tpulib"]["chips"])
+    out = render(report)
+    assert "healthy: no warnings" in out
+
+
+def test_crashed_prepare_and_orphan_spec_warn(tmp_path):
+    state, lib = make_state(tmp_path)
+    state.prepare(claim("aaaa-1111"))
+    # Orphan CDI spec: an unprepare that died after checkpoint removal.
+    from tpu_dra.plugin.prepared import PreparedDevices
+
+    state.cdi.create_claim_spec_file("dead-beef", PreparedDevices())
+    # Crashed prepare: WAL entry stuck in PrepareStarted.
+    from tpu_dra.plugin.checkpoint import (
+        CLAIM_STATE_PREPARE_STARTED,
+        PreparedClaim,
+    )
+
+    def mutate(cp):
+        cp.prepared_claims["bbbb-2222"] = PreparedClaim(
+            checkpoint_state=CLAIM_STATE_PREPARE_STARTED,
+            name="stuck", namespace="ns",
+        )
+
+    state.checkpoints.update(mutate)
+    report = run_collect(tmp_path, lib)
+    warns = "\n".join(report["warnings"])
+    assert "PrepareStarted" in warns and "bbbb-2222" in warns
+    assert "dead-beef" in warns and "no checkpoint entry" in warns
+
+
+def test_live_arbiter_probed_and_exit_codes(tmp_path, monkeypatch, capsys):
+    state, lib = make_state(tmp_path)
+    state.prepare(claim("aaaa-1111"))
+    mux = tmp_path / "mux" / "aaaa-1111"
+    daemon = MultiplexDaemon(str(mux), ["chip-a"]).start()
+    try:
+        monkeypatch.setenv("TPU_DRA_BACKEND", "stub")
+        import yaml
+
+        (tmp_path / "stub.yaml").write_text(
+            yaml.safe_dump({"generation": "v5e", "hostname": "node-0",
+                            "state_dir": str(tmp_path / "tpu")})
+        )
+        monkeypatch.setenv(
+            "TPU_DRA_STUB_CONFIG", str(tmp_path / "stub.yaml")
+        )
+        rc = main([
+            "--plugin-data-dir", str(tmp_path / "data"),
+            "--cdi-root", str(tmp_path / "cdi"),
+            "--multiplex-socket-root", str(tmp_path / "mux"),
+            "--json",
+        ])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert out["arbiters"]["aaaa-1111"]["waiting"] == 0
+        assert out["arbiters"]["aaaa-1111"]["revocations"] == 0
+    finally:
+        daemon.stop()
+
+
+def test_unhealthy_chip_warns(tmp_path):
+    from tpu_dra.tpulib.types import ChipHealthEvent
+
+    state, lib = make_state(tmp_path)
+    lib.inject_health_event(ChipHealthEvent(
+        chip_uuid=lib.chips()[0].uuid, healthy=False, reason="doctor-test",
+    ))
+    report = run_collect(tmp_path, lib)
+    assert any("UNHEALTHY" in w for w in report["warnings"])
+    assert "WARN" in render(report)
+
+
+def test_orphan_spec_with_empty_checkpoint_still_warns(tmp_path):
+    """The crashed-unprepare scenario: checkpoint exists but is empty,
+    a claim spec lingers — that exact combination must WARN."""
+    state, lib = make_state(tmp_path)
+    c = claim("aaaa-1111")
+    state.prepare(c)
+    # Simulate the crash window: checkpoint entry removed, spec left.
+    spec_path = state.cdi.spec_path("aaaa-1111")
+    assert os.path.exists(spec_path)
+    state.checkpoints.update(lambda cp: cp.prepared_claims.clear())
+    report = run_collect(tmp_path, lib)
+    warns = "\n".join(report["warnings"])
+    assert "aaaa-1111" in warns and "no checkpoint entry" in warns
+
+
+def test_missing_cdi_root_is_noted_not_created(tmp_path):
+    state, lib = make_state(tmp_path)
+    bogus = tmp_path / "no-such-cdi"
+    report = collect(
+        str(tmp_path / "data"), str(bogus), str(tmp_path / "mux"),
+        tpulib=lib,
+    )
+    assert not bogus.exists()  # a diagnostic must not mutate the node
+    assert any("does not exist" in n for n in report.get("notes", []))

@@ -1,0 +1,2 @@
+"""Operator tooling (no reference analog — the reference leaves node
+debugging to kubectl exec + log spelunking)."""

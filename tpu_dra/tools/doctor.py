@@ -1,0 +1,269 @@
+"""tpu-dra doctor: one-shot node-state inspection for operators.
+
+The reference has no equivalent — debugging a node means exec'ing into
+the plugin pod and reading logs. This reads the same stores the plugins
+own and cross-checks them:
+
+- **tpulib**: backend, generation, chips (uuid, coordinate, health),
+  ICI domain identity, live sub-slices;
+- **checkpoint**: every prepared claim and its WAL state — a claim stuck
+  in ``PrepareStarted`` means a crash mid-prepare (the plugin will roll
+  it back on next touch, the cleanup manager will GC it if its
+  ResourceClaim is gone);
+- **CDI**: transient claim specs on disk, cross-checked against the
+  checkpoint (an orphan spec means an unprepare crashed before spec
+  removal);
+- **arbiters**: every per-claim sharing daemon socket, probed live
+  (holder, queue depth, revocations).
+
+Exit 0 when healthy; 1 when any WARN was printed (probe-friendly).
+
+Run it where the plugin runs (same data dirs), e.g.::
+
+    kubectl exec -it <plugin-pod> -- python -m tpu_dra.tools.doctor
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+from typing import Dict, List
+
+from tpu_dra.plugin.checkpoint import (
+    CLAIM_STATE_PREPARE_COMPLETED,
+    CLAIM_STATE_PREPARE_STARTED,
+    CheckpointManager,
+)
+from tpu_dra.plugin.cdi import CDI_VENDOR
+from tpu_dra.plugin.multiplexd import SOCKET_NAME
+from tpu_dra.tpulib import new_tpulib
+
+
+def collect(
+    plugin_data_dir: str,
+    cdi_root: str,
+    multiplex_socket_root: str,
+    tpulib=None,
+) -> dict:
+    """Gather every section; pure data (rendering and exit codes are the
+    caller's problem, so tests and future UIs can reuse this)."""
+    report: dict = {"warnings": []}
+
+    def warn(msg: str) -> None:
+        report["warnings"].append(msg)
+
+    # --- tpulib ---
+    # A fresh lib reflects what the NODE says right now (kernel surfaces
+    # on the linux backend); tests pass the plugin's live instance in.
+    lib = tpulib or new_tpulib()
+    gen = lib.generation()
+    ici = lib.ici_domain()
+    chips = lib.chips()
+    report["tpulib"] = {
+        "backend": type(lib).__name__,
+        "generation": gen.name,
+        "ici_domain": ici.clique_id() if ici else None,
+        "chips": [
+            {
+                "uuid": c.uuid,
+                "index": c.index,
+                "coord": str(c.coord),
+                "healthy": c.healthy,
+            }
+            for c in chips
+        ],
+        "subslices": [
+            {
+                "uuid": ss.uuid,
+                "shape": str(ss.placement.shape),
+                "origin": str(ss.placement.start),
+                "parent_chips": ss.parent_chip_uuids,
+            }
+            for ss in lib.list_subslices()
+        ],
+    }
+    for c in chips:
+        if not c.healthy:
+            warn(f"chip {c.uuid} ({c.coord}) is UNHEALTHY — it is "
+                 f"unpublished from ResourceSlices until it recovers")
+
+    # --- checkpoint (WAL) ---
+    claims: Dict[str, dict] = {}
+    ckpt_path = os.path.join(plugin_data_dir, "checkpoint.json")
+    ckpt_exists = os.path.exists(ckpt_path)
+    if ckpt_exists:
+        cp = CheckpointManager(plugin_data_dir).get()
+        for uid, claim in sorted(cp.prepared_claims.items()):
+            devices = claim.prepared_devices.device_names()
+            claims[uid] = {
+                "state": claim.checkpoint_state,
+                "name": claim.name,
+                "namespace": claim.namespace,
+                "devices": devices,
+            }
+            if claim.checkpoint_state == CLAIM_STATE_PREPARE_STARTED:
+                warn(
+                    f"claim {uid} ({claim.namespace}/{claim.name}) is in "
+                    f"PrepareStarted: a prepare crashed mid-flight; the "
+                    f"plugin rolls it back on the next kubelet retry and "
+                    f"the cleanup manager GCs it if the ResourceClaim is "
+                    f"gone"
+                )
+    else:
+        report.setdefault("notes", []).append(
+            f"no checkpoint at {ckpt_path} (plugin never ran here?)"
+        )
+    report["checkpoint"] = {"path": ckpt_path, "claims": claims}
+
+    # --- CDI specs vs checkpoint ---
+    # Read the directory directly: constructing CDIHandler would CREATE
+    # a mistyped --cdi-root as a side effect (and crash unprivileged
+    # runs) — a diagnostic must not mutate the node.
+    prefix = f"{CDI_VENDOR}-claim_"
+    try:
+        spec_uids = sorted(
+            name[len(prefix):-len(".json")]
+            for name in os.listdir(cdi_root)
+            if name.startswith(prefix) and name.endswith(".json")
+        )
+    except FileNotFoundError:
+        spec_uids = []
+        report.setdefault("notes", []).append(
+            f"CDI root {cdi_root} does not exist (plugin never ran here, "
+            f"or --cdi-root is mistyped)"
+        )
+    report["cdi"] = {"root": cdi_root, "claim_specs": spec_uids}
+    completed = {
+        uid for uid, c in claims.items()
+        if c["state"] == CLAIM_STATE_PREPARE_COMPLETED
+    }
+    for uid in spec_uids:
+        # Keyed on checkpoint-FILE existence, not the claim map's
+        # truthiness: an empty checkpoint with a leftover spec is exactly
+        # the crashed-unprepare scenario this check exists for.
+        if ckpt_exists and uid not in claims:
+            warn(
+                f"CDI spec for claim {uid} has no checkpoint entry — an "
+                f"unprepare likely crashed after checkpoint removal; the "
+                f"spec is inert but should be cleaned up"
+            )
+    for uid in completed:
+        if uid not in spec_uids:
+            warn(
+                f"claim {uid} is PrepareCompleted but its CDI spec is "
+                f"missing — containers for it cannot start; re-Prepare "
+                f"will regenerate it"
+            )
+
+    # --- sharing arbiters ---
+    arbiters: Dict[str, dict] = {}
+    if os.path.isdir(multiplex_socket_root):
+        for claim_uid in sorted(os.listdir(multiplex_socket_root)):
+            path = os.path.join(
+                multiplex_socket_root, claim_uid, SOCKET_NAME
+            )
+            if not os.path.exists(path):
+                continue
+            try:
+                with socket.socket(
+                    socket.AF_UNIX, socket.SOCK_STREAM
+                ) as s:
+                    s.settimeout(1.0)
+                    s.connect(path)
+                    s.sendall(b'{"op": "status"}\n')
+                    st = json.loads(s.makefile().readline())
+                arbiters[claim_uid] = {
+                    k: st.get(k)
+                    for k in ("holder", "waiting", "heldSeconds",
+                              "maxHoldSeconds", "overdue", "revocations",
+                              "preemption")
+                }
+                if st.get("overdue"):
+                    warn(
+                        f"arbiter for claim {claim_uid}: holder "
+                        f"{st.get('holder')!r} is OVERDUE with "
+                        f"{st.get('waiting')} waiter(s)"
+                        + ("" if st.get("preemption")
+                           else " and preemption is OFF — it can starve "
+                                "its neighbors indefinitely")
+                    )
+            except (OSError, ValueError) as e:
+                arbiters[claim_uid] = {"error": str(e)}
+                warn(f"arbiter socket for claim {claim_uid} did not "
+                     f"answer: {e}")
+    report["arbiters"] = arbiters
+    return report
+
+
+def render(report: dict) -> str:
+    t = report["tpulib"]
+    lines = [
+        f"tpulib     : {t['backend']} generation={t['generation']} "
+        f"ici={t['ici_domain']}",
+    ]
+    for c in t["chips"]:
+        mark = "ok " if c["healthy"] else "BAD"
+        lines.append(
+            f"  chip {c['index']} [{mark}] {c['uuid']} @ {c['coord']}"
+        )
+    for ss in t["subslices"]:
+        lines.append(
+            f"  subslice {ss['uuid']} {ss['shape']} @ {ss['origin']}"
+        )
+    ck = report["checkpoint"]
+    lines.append(f"checkpoint : {ck['path']} ({len(ck['claims'])} claims)")
+    for uid, c in ck["claims"].items():
+        lines.append(
+            f"  {uid} {c['state']} {c['namespace']}/{c['name']} "
+            f"devices={c['devices']}"
+        )
+    lines.append(
+        f"cdi        : {report['cdi']['root']} "
+        f"({len(report['cdi']['claim_specs'])} claim specs)"
+    )
+    lines.append(f"arbiters   : {len(report['arbiters'])} live")
+    for uid, st in report["arbiters"].items():
+        lines.append(f"  {uid}: {st}")
+    for note in report.get("notes", []):
+        lines.append(f"note: {note}")
+    for w in report["warnings"]:
+        lines.append(f"WARN: {w}")
+    if not report["warnings"]:
+        lines.append("healthy: no warnings")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("tpu-dra-doctor", description=__doc__)
+    p.add_argument(
+        "--plugin-data-dir",
+        default=os.environ.get(
+            "PLUGIN_DATA_DIR", "/var/lib/kubelet/plugins/tpu.google.com"
+        ),
+    )
+    p.add_argument(
+        "--cdi-root", default=os.environ.get("CDI_ROOT", "/var/run/cdi")
+    )
+    p.add_argument(
+        "--multiplex-socket-root",
+        default=os.environ.get(
+            "TPU_MULTIPLEX_SOCKET_ROOT", "/run/tpu-multiplex"
+        ),
+    )
+    p.add_argument("--json", action="store_true", dest="as_json")
+    args = p.parse_args(argv)
+    report = collect(
+        args.plugin_data_dir, args.cdi_root, args.multiplex_socket_root
+    )
+    if args.as_json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(render(report))
+    return 1 if report["warnings"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
