@@ -1765,6 +1765,48 @@ def run_suites(r: Runner, stack: Stack, td: Path) -> int:
     r.run("health", "recovered chip is republished without a restart",
           recovery_republishes)
 
+    # ---- doctor (operator surface against the live node state) ----
+
+    def run_doctor(extra=()):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "TPU_DRA_BACKEND": "stub",
+            "TPU_DRA_STUB_CONFIG": str(td / "stub.yaml"),
+        })
+        return subprocess.run(
+            [sys.executable, "-m", "tpu_dra.tools.doctor",
+             "--plugin-data-dir", str(td / "tpu-plugin"),
+             "--cdi-root", str(td / "cdi"),
+             "--multiplex-socket-root", str(mux_root), *extra],
+            capture_output=True, text=True, env=env, cwd=str(REPO_ROOT),
+        )
+
+    def doctor_reports_healthy():
+        p = run_doctor()
+        _assert(p.returncode == 0, f"rc={p.returncode}\n{p.stdout}\n{p.stderr}")
+        _assert("healthy: no warnings" in p.stdout, p.stdout)
+
+    r.run("doctor", "doctor reports the churned node healthy",
+          doctor_reports_healthy)
+
+    def doctor_flags_orphan_spec():
+        orphan = td / "cdi" / "k8s.tpu.google.com-claim_dead-beef.json"
+        orphan.write_text('{"cdiVersion": "0.6.0", "devices": []}')
+        try:
+            p = run_doctor()
+            _assert(p.returncode == 1, f"rc={p.returncode}\n{p.stdout}")
+            _assert("dead-beef" in p.stdout and "WARN" in p.stdout,
+                    p.stdout)
+        finally:
+            orphan.unlink()
+        # Back to clean after the repair.
+        _assert(run_doctor().returncode == 0, "doctor still warning")
+
+    r.run("doctor", "doctor flags an orphan CDI spec, clean after repair",
+          doctor_flags_orphan_spec)
+
     # ---- test_cd_updowngrade ----
     # A prepared channel claim must survive a cd-plugin rollout: the CD
     # plugin's checkpoint (same V1+V2 dual rendering as the TPU plugin's)

@@ -413,3 +413,73 @@ def test_load_dir_rejects_unknown_kind(tmp_path):
     ))
     with _pytest.raises(K8sApiError, match="unknown resource"):
         FakeCluster().load_dir(str(tmp_path))
+
+
+def test_informer_survives_raising_watch_stream():
+    """A connection torn down mid-chunk RAISES out of the watch iterator
+    (urllib3 ProtocolError/AttributeError) instead of ending cleanly; the
+    informer thread must resync, not die — a dead thread silently freezes
+    the store until process restart (seen live in the multi-slice e2e
+    when the controller's clique watch broke)."""
+    import time
+
+    fc = FakeCluster()
+    cds = ResourceClient(fc, COMPUTE_DOMAINS)
+
+    class RaisingOnce:
+        """First watch: yields one event, then raises mid-stream.
+        Later watches delegate to the fake cluster."""
+
+        def __init__(self, fc):
+            self.fc = fc
+            self.raised = False
+
+        def list(self, *a, **k):
+            return self.fc.list(*a, **k)
+
+        def watch(self, rd, namespace=None, label_selector=None,
+                  resource_version=None):
+            if not self.raised:
+                self.raised = True
+                real = self.fc.watch(rd, namespace, label_selector)
+
+                def broken():
+                    for i, item in enumerate(real):
+                        yield item
+                        raise AttributeError(
+                            "'NoneType' object has no attribute 'readline'"
+                        )
+
+                class W:
+                    def __iter__(self_w):
+                        return broken()
+
+                    def close(self_w):
+                        real.close()
+
+                return W()
+            return self.fc.watch(rd, namespace, label_selector,
+                                 resource_version=resource_version)
+
+    backend = RaisingOnce(fc)
+    inf = Informer(backend, COMPUTE_DOMAINS)
+    inf.resync_backoff = 0.05
+    inf.start()
+    assert inf.wait_for_sync()
+
+    # First event arrives, then the stream raises; the informer must
+    # reconnect and keep converging on later events.
+    cds.create(cd_obj("first"))
+    deadline = time.monotonic() + 3
+    while time.monotonic() < deadline and not inf.get("first", "default"):
+        time.sleep(0.02)
+    assert inf.get("first", "default") is not None
+
+    cds.create(cd_obj("after-crash"))
+    deadline = time.monotonic() + 3
+    while time.monotonic() < deadline and not inf.get("after-crash", "default"):
+        time.sleep(0.02)
+    assert inf.get("after-crash", "default") is not None, (
+        "informer thread died on the raising stream instead of resyncing"
+    )
+    inf.stop()

@@ -82,17 +82,34 @@ class Informer:
         instead of being stored as objects."""
         assert self._watch is not None
         while not self._stopped.is_set():
-            for event, obj in self._watch:
-                if event == "ERROR":
-                    log.warning("watch ERROR event: %s", obj.get("message", obj))
-                    if obj.get("code") == 410:
-                        # Real apiservers deliver an expired-RV watch as
-                        # HTTP 200 + in-stream ERROR(410); resuming from
-                        # the same RV would loop forever. Drop the resume
-                        # point so the resync relists.
-                        self._last_rv = None
-                    break
-                self._apply(event, obj, dispatch=True)
+            try:
+                for event, obj in self._watch:
+                    if event == "ERROR":
+                        log.warning(
+                            "watch ERROR event: %s", obj.get("message", obj)
+                        )
+                        if obj.get("code") == 410:
+                            # Real apiservers deliver an expired-RV watch
+                            # as HTTP 200 + in-stream ERROR(410); resuming
+                            # from the same RV would loop forever. Drop
+                            # the resume point so the resync relists.
+                            self._last_rv = None
+                        break
+                    self._apply(event, obj, dispatch=True)
+            except Exception as e:  # noqa: BLE001 — any broken stream
+                # A connection torn down mid-chunk surfaces as a RAISING
+                # iterator (urllib3 ProtocolError/AttributeError), not a
+                # clean stream end. client-go's reflector treats every
+                # watch error the same way: log and resync. Letting it
+                # propagate would kill this thread and silently freeze the
+                # store — the controller then misses events until a
+                # process restart (observed in the multi-slice e2e).
+                if self._stopped.is_set():
+                    return
+                log.warning(
+                    "watch stream failed (%s: %s); resyncing",
+                    type(e).__name__, e,
+                )
             # Resync. Preferred: resume the watch from the last observed
             # resourceVersion — the server replays the missed window and
             # the (expensive) relist is skipped. 410 Gone means the
