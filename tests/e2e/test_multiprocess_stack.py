@@ -1104,10 +1104,19 @@ def test_multislice_rendezvous_from_rendered_envs(stack):
     through live daemons and OS-process workloads)."""
     import socket
 
-    if "controller" not in stack.procs:
-        pytest.skip("requires the bringup test's controller")
     kc = stack.kc
     td = stack.td
+
+    # Self-sufficient: bring up a controller if no earlier test did (the
+    # leader election lease makes a second one a harmless standby, so this
+    # also works in full-module order).
+    if "controller" not in stack.procs:
+        stack.spawn(
+            "controller",
+            ["tpu_dra.computedomain.controller.main",
+             "--kubeconfig", stack.kubeconfig, "--namespace", DRIVER_NS,
+             "--node-stale-after", "6"],
+        )
 
     cd = kc.create(COMPUTE_DOMAINS, {
         "apiVersion": "resource.tpu.google.com/v1beta1",
@@ -1159,6 +1168,10 @@ def test_multislice_rendezvous_from_rendered_envs(stack):
             )
 
     def all_rendered_with_dcn_identity():
+        # Liveness INSIDE the wait: a crashed daemon/controller must fail
+        # the test immediately with its log, not mask itself behind the
+        # rendering timeout (round-3 post-mortem).
+        stack.assert_alive()
         for d in cfg_dirs.values():
             if not ((d / "bootstrap.env").exists() and (d / "ready").exists()):
                 return False

@@ -110,6 +110,105 @@ def test_max_of_limiter():
     assert rl.when("k") == 0.5
 
 
+def test_retry_drop_hands_slot_to_newer_item():
+    """Round-3 lost-retry regression: when a failed item's retry is
+    dropped because a newer item arrived mid-processing, that newer item
+    MUST run — the drop hands over the slot, it doesn't orphan the key."""
+    q = WorkQueue(ItemExponentialFailureRateLimiter(0.001, 0.01))
+    old_running = threading.Event()
+    release_old = threading.Event()
+    new_ran = threading.Event()
+
+    def old_cb(obj):
+        old_running.set()
+        assert release_old.wait(2)
+        raise RuntimeError("fails after the newer item was enqueued")
+
+    q.enqueue("old", old_cb, key="k")
+    _run(q)
+    assert old_running.wait(2)
+    # Newer item lands while the old one is mid-callback.
+    q.enqueue("new", lambda o: new_ran.set(), key="k")
+    release_old.set()
+    assert new_ran.wait(2), "newer item never ran after retry drop"
+    q.shutdown()
+
+
+def test_event_storm_dedups_to_single_pending():
+    """Fresh enqueues for one key dedup (client-go dirty set): a burst of
+    N events causes at most a couple of callback runs — with the NEWEST
+    snapshot — not N rate-limited heap entries (the round-3 85s-latency
+    storm)."""
+    q = WorkQueue(ItemExponentialFailureRateLimiter(0.001, 0.01))
+    seen = []
+    gate = threading.Event()
+    done = threading.Event()
+
+    def cb(obj):
+        gate.wait(2)
+        seen.append(obj)
+        if obj == 99:
+            done.set()
+
+    for i in range(100):
+        q.enqueue(i, cb, key="k")
+    _run(q)
+    gate.set()
+    assert done.wait(2)
+    q.shutdown()
+    # First pop may observe any early snapshot; everything else coalesced
+    # into the newest one.
+    assert len(seen) <= 3, seen
+    assert seen[-1] == 99
+
+
+def test_fresh_enqueue_is_not_rate_limited():
+    """A token-bucket limiter must pace RETRIES only: 50 distinct keys
+    enqueued at once all run promptly (client-go Add vs AddRateLimited)."""
+    q = WorkQueue(BucketRateLimiter(qps=1.0, burst=2))  # 1/s: storm-hostile
+    done = threading.Event()
+    count = []
+    lock = threading.Lock()
+
+    def cb(obj):
+        with lock:
+            count.append(obj)
+            if len(count) == 50:
+                done.set()
+
+    t0 = time.monotonic()
+    for i in range(50):
+        q.enqueue(i, cb, key=f"k{i}")
+    _run(q)
+    assert done.wait(5)
+    assert time.monotonic() - t0 < 2.0, "fresh enqueues were rate limited"
+    q.shutdown()
+
+
+def test_metrics_counters_exported():
+    from tpu_dra.infra.metrics import Metrics
+
+    m = Metrics()
+    q = WorkQueue(ItemExponentialFailureRateLimiter(0.001, 0.01), metrics=m)
+    done = threading.Event()
+    calls = []
+
+    def cb(obj):
+        calls.append(obj)
+        if len(calls) < 2:
+            raise RuntimeError("once")
+        done.set()
+
+    q.enqueue("x", cb, key="k")
+    _run(q)
+    assert done.wait(2)
+    q.shutdown()
+    text = m.render()
+    assert "workqueue_failures_total 1.0" in text
+    assert "workqueue_retries_total 1.0" in text
+    assert "workqueue_depth" in text
+
+
 def test_backoff_is_per_item_not_per_key():
     """A fresh enqueue starts at base delay even after another item failed
     repeatedly (reference rate-limits on the WorkItem pointer)."""

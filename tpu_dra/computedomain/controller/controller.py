@@ -65,9 +65,13 @@ class ComputeDomainController:
             node_stale_after=node_stale_after,
         )
         self.node_labels = NodeLabelManager(backend)
-        self.queue = WorkQueue(default_controller_rate_limiter())
-        self.cd_informer = Informer(backend, COMPUTE_DOMAINS)
-        self.clique_informer = Informer(backend, COMPUTE_DOMAIN_CLIQUES)
+        self.queue = WorkQueue(
+            default_controller_rate_limiter(), metrics=self.metrics
+        )
+        self.cd_informer = Informer(backend, COMPUTE_DOMAINS, metrics=self.metrics)
+        self.clique_informer = Informer(
+            backend, COMPUTE_DOMAIN_CLIQUES, metrics=self.metrics
+        )
         self.status_sync_period = status_sync_period
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -148,13 +152,20 @@ class ComputeDomainController:
         self._enqueue(cd)
 
     def _on_clique_event(self, event: str, clique: dict) -> None:
+        """Map a clique event to its owning CD via the CD informer's STORE
+        (the lister), never a live REST list: informer handlers must not
+        block on — or drop events to — apiserver weather
+        (cdclique.go:36-139 uses a lister here for the same reason; a live
+        list in this path dropped the decisive reconcile in round 3). If
+        the CD isn't in the store yet (clique observed before the CD's own
+        ADDED dispatch), dropping is safe: that pending ADDED, and the
+        periodic sync, both enqueue it."""
         uid = (clique["metadata"].get("labels") or {}).get(CD_LABEL_KEY)
         if not uid:
             return
-        for cd in self.cds.list():
-            if cd["metadata"]["uid"] == uid:
-                self._enqueue(cd)
-                return
+        cd = self.cd_informer.get_by_uid(uid)
+        if cd is not None:
+            self._enqueue(cd)
 
     # --- reconcile (computedomain.go:298-374) ---
 

@@ -194,6 +194,12 @@ def feature_gates() -> FeatureGates:
 
 
 def reset_for_tests(gates: Optional[FeatureGates] = None) -> None:
+    if gates is not None and not isinstance(gates, FeatureGates):
+        raise TypeError(
+            f"reset_for_tests takes a FeatureGates instance, got "
+            f"{type(gates).__name__} (a raw dict would silently poison "
+            f"every to_map()/enabled() call later)"
+        )
     global _singleton
     with _singleton_lock:
         _singleton = gates

@@ -1,15 +1,27 @@
-"""Rate-limited work queue with per-key coalescing.
+"""Rate-limited work queue with per-key dedup and coalescing.
 
-Reference analog: pkg/workqueue/workqueue.go:31-197 and jitterlimiter.go:31-66.
-
-Semantics preserved from the reference:
+Reference analog: pkg/workqueue/workqueue.go:31-197 and jitterlimiter.go:31-66,
+which in turn lean on client-go's workqueue. Semantics:
 
 - items carry a key + object + callback; failures are retried with per-item
   exponential backoff combined (max) with a global token-bucket limiter
   (DefaultPrepUnprepRateLimiter: 250ms→3s per item, 5/s burst 10 global);
-- **per-key coalescing**: when a newer item is enqueued under the same key,
-  retries of an older failed item for that key are forgotten
-  (workqueue.go:152-190) — a stale reconcile can never overwrite a newer one;
+- **per-key dedup** (client-go's dirty set): at most ONE pending item per
+  key. A fresh enqueue for a key that is already pending replaces it in
+  place; a fresh enqueue for a key that is mid-processing parks in a dirty
+  slot and is queued the moment processing finishes. Event storms (N
+  daemons heartbeating every second) therefore collapse to one reconcile
+  in flight + one pending, instead of flooding the queue — the round-3
+  multi-slice e2e failed exactly because every event burned a rate-limiter
+  token and its own heap entry, delaying the first real reconcile by 85s;
+- **fresh enqueues are not rate limited** (client-go Add vs AddRateLimited):
+  only retries pay backoff;
+- **per-key coalescing**: when a newer item arrived while an older one was
+  failing, the older item's retry is dropped (workqueue.go:152-190) — but
+  only by *handing its slot to the newer item*, which is pushed in the same
+  critical section. The round-3 bug was dropping the retry on the mere
+  historical fact that a newer item had existed, even when that newer item
+  had already run and gone: the key then stayed unreconciled forever;
 - optional relative jitter around the inner backoff delay
   (jitterlimiter.go:31-66) to de-synchronize herds of retries.
 """
@@ -153,26 +165,68 @@ class WorkItem:
 
 
 class WorkQueue:
-    """Threaded work queue; ``run()`` consumes until ``shutdown()``."""
+    """Threaded work queue; ``run()`` consumes until ``shutdown()``.
 
-    def __init__(self, rate_limiter: Optional[RateLimiter] = None):
+    Optional ``metrics`` (infra.metrics.Metrics) exports the queue's
+    failure/retry/coalescing counters and depth gauge so a stuck or
+    work-dropping reconciler is visible on /metrics (and to the doctor)
+    instead of only in debug logs.
+    """
+
+    def __init__(
+        self,
+        rate_limiter: Optional[RateLimiter] = None,
+        metrics=None,
+    ):
         self._rl = rate_limiter or default_controller_rate_limiter()
+        self.metrics = metrics
         self._heap: list[_Scheduled] = []
         self._cond = threading.Condition()
-        self._active_ops: Dict[str, WorkItem] = {}
+        # Keyed-item states (client-go's queue/dirty/processing sets):
+        # _pending: scheduled in the heap, exactly one per key;
+        # _processing: keys whose callback is running right now;
+        # _dirty: newest item that arrived while its key was processing.
+        self._pending: Dict[str, WorkItem] = {}
+        self._processing: set = set()
+        self._dirty: Dict[str, WorkItem] = {}
         self._seq = 0
         self._shutdown = False
 
+    def _inc(self, name: str) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(name)
+
+    def _update_depth(self) -> None:
+        if self.metrics is not None:
+            self.metrics.set_gauge(
+                "workqueue_depth", len(self._pending) + len(self._dirty)
+            )
+
     def enqueue(self, obj: Any, callback: Callable[[Any], None], key: str = "") -> None:
-        # Backoff state is per *item* (matching the reference, which rate-limits
-        # on the WorkItem pointer): a fresh enqueue always starts from the
-        # limiter's base delay, independent of other items' failure history.
+        """Add work. Fresh enqueues run immediately (no rate limiting —
+        that is reserved for retries, matching client-go Add); a keyed
+        enqueue dedups against pending/processing work for the same key,
+        always keeping the NEWEST object snapshot."""
         item = WorkItem(key=key, obj=obj, callback=callback)
-        delay = self._rl.when(item)
         with self._cond:
+            if self._shutdown:
+                return
             if key:
-                self._active_ops[key] = item
-            self._push(item, delay)
+                if key in self._processing:
+                    self._dirty[key] = item
+                    self._inc("workqueue_coalesced_total")
+                    self._update_depth()
+                    return
+                if key in self._pending:
+                    # Replace in place: the superseded heap entry is
+                    # skipped at pop time (identity check in run()), and
+                    # the superseded item's limiter state is released
+                    # here — no other path will ever see it again.
+                    self._rl.forget(self._pending[key])
+                    self._inc("workqueue_coalesced_total")
+                self._pending[key] = item
+            self._push(item, 0.0)
+            self._update_depth()
             self._cond.notify()
 
     def _push(self, item: WorkItem, delay: float) -> None:
@@ -199,12 +253,48 @@ class WorkQueue:
                 if self._shutdown:
                     return
                 item = heapq.heappop(self._heap).item
+                if item.key:
+                    if self._pending.get(item.key) is not item:
+                        continue  # superseded by a newer enqueue
+                    del self._pending[item.key]
+                    self._processing.add(item.key)
+                    self._update_depth()
             self._process(item)
 
     def run_in_thread(self) -> threading.Thread:
         t = threading.Thread(target=self.run, daemon=True, name="workqueue")
         t.start()
         return t
+
+    def _finish_key(self, item: WorkItem, failed: bool) -> None:
+        """Post-callback bookkeeping for a keyed item, under the lock.
+
+        Invariant: when this returns, either the key has no outstanding
+        work, or exactly one item for it is in _pending (and the heap).
+        A retry is dropped ONLY by handing the slot to the dirty (newer)
+        item in the same critical section — never on the mere existence
+        of a historical newer enqueue (the round-3 lost-retry bug)."""
+        self._processing.discard(item.key)
+        newer = self._dirty.pop(item.key, None)
+        if newer is not None:
+            if failed:
+                log.info(
+                    "Do not re-enqueue failed work item with key '%s': "
+                    "a newer item supersedes it",
+                    item.key,
+                )
+                self._inc("workqueue_retry_drops_total")
+            self._rl.forget(item)
+            self._pending[item.key] = newer
+            self._push(newer, 0.0)
+        elif failed:
+            self._pending[item.key] = item
+            self._push(item, self._rl.when(item))
+            self._inc("workqueue_retries_total")
+        else:
+            self._rl.forget(item)
+        self._update_depth()
+        self._cond.notify()
 
     def _process(self, item: WorkItem) -> None:
         attempts = self._rl.num_requeues(item)
@@ -214,22 +304,17 @@ class WorkQueue:
             # Expected, retryable errors in an eventually-consistent system:
             # log at info, not error (workqueue.go:166-170).
             log.info("Reconcile: %s (attempt %d)", e, attempts)
+            self._inc("workqueue_failures_total")
             with self._cond:
-                current = self._active_ops.get(item.key)
-                if item.key and current is not None and current is not item:
-                    # A newer item exists for this key; drop this retry
-                    # (per-key coalescing, workqueue.go:171-176).
-                    log.info(
-                        "Do not re-enqueue failed work item with key '%s': "
-                        "a newer item was enqueued",
-                        item.key,
-                    )
-                    self._rl.forget(item)
+                if item.key:
+                    self._finish_key(item, failed=True)
                 else:
                     self._push(item, self._rl.when(item))
-                self._cond.notify()
+                    self._inc("workqueue_retries_total")
+                    self._cond.notify()
         else:
             with self._cond:
-                if item.key and self._active_ops.get(item.key) is item:
-                    del self._active_ops[item.key]
-                self._rl.forget(item)
+                if item.key:
+                    self._finish_key(item, failed=False)
+                else:
+                    self._rl.forget(item)

@@ -402,8 +402,17 @@ class FakeApiServer:
                 except Exception as e:
                     return self._error(e)
 
-        self._httpd = ThreadingHTTPServer((address, port), Handler)
-        self._httpd.daemon_threads = True
+        # ThreadingHTTPServer's default listen backlog is 5 — under the
+        # multi-process e2e (4+ daemons with 1s heartbeats, two plugins,
+        # the controller, and the test client, each a distinct process)
+        # accept bursts overflow that and the kernel REFUSES connections.
+        # Round 3's flagship failure started exactly there. A real
+        # apiserver listens with a deep backlog; so do we.
+        class _Server(ThreadingHTTPServer):
+            request_queue_size = 256
+            daemon_threads = True
+
+        self._httpd = _Server((address, port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 

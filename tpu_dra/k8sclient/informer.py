@@ -37,15 +37,20 @@ class Informer:
         rd: ResourceDescriptor,
         namespace: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
+        metrics=None,
     ):
         self.backend = backend
         self.rd = rd
         self.namespace = namespace
         self.label_selector = label_selector
+        self.metrics = metrics  # optional infra.metrics.Metrics
         self._store: Dict[Tuple[Optional[str], str], dict] = {}
         self._lock = threading.RLock()
         self._handlers: List[Handler] = []
         self._watch = None
+        # Serializes watch assignment against stop(): a watch established
+        # concurrently with stop() must end up closed, never consumed.
+        self._watch_assign_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._synced = threading.Event()
         self._stopped = threading.Event()
@@ -55,23 +60,81 @@ class Informer:
     def add_handler(self, handler: Handler) -> None:
         self._handlers.append(handler)
 
+    def _inc(self, name: str) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(
+                name, labels={"informer": self.rd.plural}
+            )
+
     def start(self) -> None:
-        self._watch = self.backend.watch(self.rd, self.namespace, self.label_selector)
-        for obj in self.backend.list(self.rd, self.namespace, self.label_selector):
-            self._apply("ADDED", obj, dispatch=True)
-        self._synced.set()
+        """Start the list+watch loop. The initial sync happens on the
+        informer thread with retry — a reflector must ride through an
+        apiserver that is briefly unreachable at component startup (the
+        controller coming up before/while the apiserver restarts), not
+        crash its process. Callers needing the populated store gate on
+        :meth:`wait_for_sync`, same as client-go's WaitForCacheSync."""
         self._thread = threading.Thread(
             target=self._run, daemon=True, name=f"informer-{self.rd.plural}"
         )
         self._thread.start()
+
+    def _assign_watch(self, watch) -> bool:
+        """Install a freshly-established watch unless stop() already ran;
+        returns False (watch closed) in that case."""
+        with self._watch_assign_lock:
+            if self._stopped.is_set():
+                try:
+                    watch.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                return False
+            self._watch = watch
+            return True
+
+    def _initial_sync(self) -> bool:
+        """Register the watch then list, retrying until success or stop.
+        Watch-before-list keeps the gap-freedom guarantee: every event at
+        or after the list's state arrives on the stream. The list goes
+        through :meth:`_relist` so a PARTIALLY applied earlier attempt
+        (list failed mid-stream, objects deleted during the retry window)
+        is swept — initial sync must leave the store exactly at the
+        list's state, stale keys included."""
+        while not self._stopped.is_set():
+            try:
+                watch = self.backend.watch(
+                    self.rd, self.namespace, self.label_selector
+                )
+                if not self._assign_watch(watch):
+                    return False
+                self._relist()
+                self._synced.set()
+                return True
+            except Exception as e:  # noqa: BLE001 — any transport failure
+                self._inc("informer_sync_failures_total")
+                log.warning(
+                    "informer initial sync failed (%s: %s); retrying",
+                    type(e).__name__, e,
+                )
+                if self._watch is not None:
+                    try:
+                        self._watch.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self._watch = None
+                self._stopped.wait(self.resync_backoff)
+        return False
 
     def wait_for_sync(self, timeout: float = 5.0) -> bool:
         return self._synced.wait(timeout)
 
     def stop(self) -> None:
         self._stopped.set()
-        if self._watch is not None:
-            self._watch.close()
+        # Close under the assignment lock: a watch being established
+        # concurrently either lands before (closed here) or after (its
+        # assigner sees _stopped and closes it) — never leaks blocked.
+        with self._watch_assign_lock:
+            if self._watch is not None:
+                self._watch.close()
         if self._thread is not None:
             self._thread.join(timeout=2)
 
@@ -80,7 +143,8 @@ class Informer:
         blip), re-establish watch + re-list so the store never goes silently
         stale. ERROR events (apiserver Status payloads) trigger a resync
         instead of being stored as objects."""
-        assert self._watch is not None
+        if not self._initial_sync():
+            return
         while not self._stopped.is_set():
             try:
                 for event, obj in self._watch:
@@ -106,6 +170,7 @@ class Informer:
                 # process restart (observed in the multi-slice e2e).
                 if self._stopped.is_set():
                     return
+                self._inc("informer_watch_failures_total")
                 log.warning(
                     "watch stream failed (%s: %s); resyncing",
                     type(e).__name__, e,
@@ -124,10 +189,12 @@ class Informer:
                 try:
                     if self._last_rv is not None:
                         try:
-                            self._watch = self.backend.watch(
+                            w = self.backend.watch(
                                 self.rd, self.namespace, self.label_selector,
                                 resource_version=self._last_rv,
                             )
+                            if not self._assign_watch(w):
+                                return
                             log.debug(
                                 "watch resumed from resourceVersion %s",
                                 self._last_rv,
@@ -138,23 +205,32 @@ class Informer:
                                 "resourceVersion %s expired; relisting",
                                 self._last_rv,
                             )
-                    self._watch = self.backend.watch(
+                    w = self.backend.watch(
                         self.rd, self.namespace, self.label_selector
                     )
+                    if not self._assign_watch(w):
+                        return
                     self._relist()
+                    self._inc("informer_relists_total")
                     break
                 except Exception as e:
+                    self._inc("informer_sync_failures_total")
                     log.warning("informer resync failed (will retry): %s", e)
 
     def _relist(self) -> None:
-        """Full re-list: upsert everything current, emit DELETED for objects
-        that vanished while the watch was down."""
+        """Full (re-)list: upsert everything current — ADDED for keys the
+        store has never seen, MODIFIED for known ones — and emit DELETED
+        for objects that vanished while the watch was down."""
         fresh = self.backend.list(self.rd, self.namespace, self.label_selector)
         fresh_keys = set()
         for obj in fresh:
             md = obj.get("metadata", {})
-            fresh_keys.add((md.get("namespace"), md.get("name")))
-            self._apply("MODIFIED", obj, dispatch=True)
+            key = (md.get("namespace"), md.get("name"))
+            fresh_keys.add(key)
+            with self._lock:
+                known = key in self._store
+            self._apply("ADDED" if not known else "MODIFIED", obj,
+                        dispatch=True)
         with self._lock:
             gone = [k for k in self._store if k not in fresh_keys]
             gone_objs = [self._store[k] for k in gone]
@@ -196,6 +272,7 @@ class Informer:
                 try:
                     h(event, copy.deepcopy(obj))
                 except Exception:
+                    self._inc("informer_handler_errors_total")
                     log.exception("informer handler failed for %s", key)
 
     # --- lister ---
@@ -204,6 +281,16 @@ class Informer:
         with self._lock:
             obj = self._store.get((namespace, name))
             return copy.deepcopy(obj) if obj else None
+
+    def get_by_uid(self, uid: str) -> Optional[dict]:
+        """Scan-by-uid that deep-copies only the match — event handlers
+        on hot paths (one clique heartbeat = one event) must not pay a
+        full-store copy per lookup."""
+        with self._lock:
+            for obj in self._store.values():
+                if obj.get("metadata", {}).get("uid") == uid:
+                    return copy.deepcopy(obj)
+        return None
 
     def list(self) -> List[dict]:
         with self._lock:

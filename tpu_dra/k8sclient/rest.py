@@ -197,29 +197,83 @@ class KubeClient(Backend):
     # Server-side throttling (429) retries: client-go's default behavior.
     MAX_429_RETRIES = 4
     DEFAULT_RETRY_AFTER = 1.0
+    # Connection-level retries (refused/reset/timeout). client-go retries
+    # these transparently; round 3 proved what happens without them — one
+    # apiserver blip under e2e load killed all four slice daemons and
+    # dropped the controller reconcile that would have pinned slice
+    # indices. Retrying is safe for EVERY verb here because Kubernetes
+    # writes are idempotent at the API level: updates are guarded by
+    # resourceVersion (a replayed stale write gets 409, which callers
+    # already conflict-retry), creates of an existing name get 409, and
+    # deletes of a gone object get 404 (callers treat as done).
+    MAX_CONN_RETRIES = 5
+    CONN_BACKOFF_BASE = 0.2  # 0.2, 0.4, 0.8, 1.6, 3.2s
+    # Transient server errors retried with Retry-After when offered
+    # (apiserver restarts / overloaded concierge surface as these).
+    RETRYABLE_5XX = (500, 502, 503, 504)
+    MAX_5XX_RETRIES = 3
 
     def _do(self, send) -> requests.Response:
         """Issue a request through the client throttle, retrying 429s with
         the server's Retry-After (a real apiserver under load sheds this
-        way; failing through to the caller would turn routine APF
-        throttling into reconcile errors)."""
-        for attempt in range(self.MAX_429_RETRIES + 1):
+        way), transient 5xx, and connection-level failures with exponential
+        backoff. Failing any of these through to the caller would turn
+        routine apiserver weather into component crashes."""
+        throttled = errored = served_5xx = 0
+        while True:
             self._throttle.wait()
-            resp = send()
-            if resp.status_code != 429 or attempt == self.MAX_429_RETRIES:
-                return resp
             try:
-                delay = float(
-                    resp.headers.get("Retry-After", self.DEFAULT_RETRY_AFTER)
+                resp = send()
+            except (requests.ConnectionError, requests.Timeout) as e:
+                if errored >= self.MAX_CONN_RETRIES:
+                    raise
+                delay = self.CONN_BACKOFF_BASE * (2 ** errored)
+                errored += 1
+                log.warning(
+                    "apiserver connection failed (%s: %s); retrying in "
+                    "%.1fs (attempt %d/%d)",
+                    type(e).__name__, e, delay, errored,
+                    self.MAX_CONN_RETRIES,
                 )
-            except ValueError:
-                delay = self.DEFAULT_RETRY_AFTER
-            log.debug(
-                "server throttled (429), retrying in %.1fs (attempt %d)",
-                delay, attempt + 1,
-            )
-            time.sleep(delay)
-        raise AssertionError("unreachable: loop returns on final attempt")
+                time.sleep(delay)
+                continue
+            if resp.status_code == 429 and throttled < self.MAX_429_RETRIES:
+                throttled += 1
+                delay = self._retry_after(resp)
+                log.debug(
+                    "server throttled (429), retrying in %.1fs (attempt %d)",
+                    delay, throttled,
+                )
+                time.sleep(delay)
+                continue
+            if (
+                resp.status_code in self.RETRYABLE_5XX
+                and served_5xx < self.MAX_5XX_RETRIES
+            ):
+                # Honor Retry-After when the server offers one; otherwise
+                # a short exponential backoff (a 500 with no header may be
+                # a hard server bug — don't stall for seconds proving it).
+                delay = self._retry_after(
+                    resp, fallback=0.1 * (2 ** served_5xx)
+                )
+                served_5xx += 1
+                log.warning(
+                    "transient server error %d, retrying in %.1fs "
+                    "(attempt %d)",
+                    resp.status_code, delay, served_5xx,
+                )
+                time.sleep(delay)
+                continue
+            return resp
+
+    def _retry_after(
+        self, resp: requests.Response, fallback: Optional[float] = None
+    ) -> float:
+        fallback = self.DEFAULT_RETRY_AFTER if fallback is None else fallback
+        try:
+            return float(resp.headers["Retry-After"])
+        except (KeyError, ValueError):
+            return fallback
 
     def _check(self, resp: requests.Response) -> dict:
         if resp.status_code == 404:
