@@ -209,9 +209,15 @@ def stub_cfg(
 def install_chart(kc: KubeClient, sets, log) -> dict:
     """Render with minihelm + apply; returns {kind: count}. The analog of
     helpers.sh iupgrade_wait (kubectl-free)."""
+    # scheduler.enabled on every install: the structured-parameters
+    # allocator is part of the cluster-less stack (no kube-scheduler
+    # exists to allocate), and chart re-installs with other sets must
+    # not drop its RBAC from the desired state.
     docs = render_chart(
         str(CHART),
-        values_overrides=[parse_set(s) for s in sets],
+        values_overrides=[
+            parse_set(s) for s in (["scheduler.enabled=true"] + list(sets))
+        ],
         namespace=DRIVER_NS,
         api_versions=[],  # fakeserver serves resource.k8s.io/v1beta1
     )
@@ -272,39 +278,139 @@ def device_attrs(dev):
     return out
 
 
+# DeviceClass per advertised device type (the chart's deviceclasses.yaml).
+DEVICE_CLASS_BY_TYPE = {
+    "tpu": "tpu.google.com",
+    "vfio": "vfio-tpu.google.com",
+    "subslice-static": "tpu-subslice.google.com",
+    "subslice-dynamic": "tpu-subslice.google.com",
+    "cd-channel": "compute-domain-default-channel.tpu.google.com",
+    "cd-daemon": "compute-domain-daemon.tpu.google.com",
+}
+
+
+def _published_device(kc, driver, pool, device):
+    for s in kc.list(RESOURCE_SLICES):
+        spec = s.get("spec", {})
+        if spec.get("driver") != driver:
+            continue
+        if pool and spec.get("pool", {}).get("name") != pool:
+            continue
+        for d in spec.get("devices", []):
+            if d.get("name") == device:
+                flat = dict(d.get("basic", {}))
+                flat.update({k: v for k, v in d.items() if k != "basic"})
+                return flat
+    return None
+
+
+def _pinning_selector(driver, attrs) -> str:
+    """A CEL selector uniquely identifying one published device — how a
+    user pins a claim to a specific device through the real allocation
+    path (attributes only; DRA CEL has no device-name variable)."""
+    if attrs.get("uuid"):
+        return f"device.attributes['{driver}'].uuid == '{attrs['uuid']}'"
+    t = attrs.get("type", "")
+    if t == "cd-channel":
+        return (
+            f"device.attributes['{driver}'].type == 'cd-channel' && "
+            f"device.attributes['{driver}'].channel == {attrs['channel']}"
+        )
+    if t == "cd-daemon":
+        return f"device.attributes['{driver}'].type == 'cd-daemon'"
+    if t.startswith("subslice"):
+        return (
+            f"device.attributes['{driver}'].subsliceShape == "
+            f"'{attrs['subsliceShape']}' && "
+            f"device.attributes['{driver}'].subsliceOrigin == "
+            f"'{attrs['subsliceOrigin']}'"
+        )
+    raise AssertionError(f"no pinning selector for device attrs {attrs}")
+
+
 def make_claim(kc, namespace, name, device, request="r0", params=None,
-               driver=DRIVER_NAME, pool="node-0"):
-    claim = kc.create(RESOURCE_CLAIMS, {
-        "apiVersion": "resource.k8s.io/v1beta1",
-        "kind": "ResourceClaim",
-        "metadata": {"name": name, "namespace": namespace},
-        # A real claim always carries a spec (the webhook rightly rejects
-        # a spec-less object); the opaque config reaches the plugin via
-        # status.allocation exactly as a scheduler-allocated claim would.
-        "spec": {"devices": {"requests": [{
-            "name": request,
-            "deviceClassName": "tpu.google.com",
-        }]}},
-    })
+               driver=DRIVER_NAME, pool="node-0", hand_allocate=False,
+               timeout=30):
+    """Create a ResourceClaim for a SPECIFIC device.
+
+    Default path (round 4): the claim carries a CEL selector uniquely
+    identifying the device plus any opaque config in spec.devices.config,
+    and the LIVE tpu-dra-scheduler process allocates it — selectors and
+    KEP-4815 counters evaluated for real, config copied to the
+    allocation the way kube-scheduler's DynamicResources plugin does.
+
+    ``hand_allocate=True`` keeps the round-3 behavior (this harness
+    plays scheduler and writes status.allocation directly) for suites
+    that DELIBERATELY bypass allocation to probe the plugin's own
+    Prepare-time defenses (overlap/double-allocation) or its config
+    validation second line.
+    """
     config = []
     if params is not None:
         config = [{
             "requests": [request],
             "opaque": {"driver": driver, "parameters": params},
-            "source": "FromClaim",
         }]
-    claim["status"] = {
-        "allocation": {
-            "devices": {
-                "results": [{
-                    "request": request, "driver": driver,
-                    "pool": pool, "device": device,
-                }],
-                "config": config,
+    if hand_allocate:
+        claim = kc.create(RESOURCE_CLAIMS, {
+            "apiVersion": "resource.k8s.io/v1beta1",
+            "kind": "ResourceClaim",
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": {"devices": {"requests": [{
+                "name": request,
+                "deviceClassName": "tpu.google.com",
+            }]}},
+        })
+        claim["status"] = {
+            "allocation": {
+                "devices": {
+                    "results": [{
+                        "request": request, "driver": driver,
+                        "pool": pool, "device": device,
+                    }],
+                    "config": [
+                        dict(c, source="FromClaim") for c in config
+                    ],
+                }
             }
         }
-    }
-    return kc.update_status(RESOURCE_CLAIMS, claim)
+        return kc.update_status(RESOURCE_CLAIMS, claim)
+
+    entry = wait_for(
+        lambda: _published_device(kc, driver, pool, device),
+        what=f"device {device} published in pool {pool}",
+    )
+    attrs = device_attrs(entry)
+    class_name = DEVICE_CLASS_BY_TYPE[attrs.get("type", "tpu")]
+    devices_spec = {"requests": [{
+        "name": request,
+        "deviceClassName": class_name,
+        "selectors": [{"cel": {
+            "expression": _pinning_selector(driver, attrs),
+        }}],
+    }]}
+    if config:
+        devices_spec["config"] = config
+    kc.create(RESOURCE_CLAIMS, {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {"devices": devices_spec},
+    })
+
+    def allocated():
+        c = kc.get(RESOURCE_CLAIMS, namespace, name)
+        if (c.get("status") or {}).get("allocation"):
+            return c
+        return None
+
+    claim = wait_for(
+        allocated, timeout=timeout,
+        what=f"scheduler allocation of {namespace}/{name}",
+    )
+    got = claim["status"]["allocation"]["devices"]["results"][0]["device"]
+    _assert(got == device, f"scheduler picked {got}, wanted {device}")
+    return claim
 
 
 def prepare(sock, claim):
@@ -565,7 +671,18 @@ def run_suites(r: Runner, stack: Stack, td: Path) -> int:
                 stack, f"{base}-kubeletplugin", node="node-0"
             ),
             "cd-daemon": write_sa_kubeconfig(stack, f"{base}-cd-daemon"),
+            "scheduler": write_sa_kubeconfig(stack, f"{base}-scheduler"),
         }
+        # The structured-parameters allocator (chart Deployment analog):
+        # every claim this runner creates from here on is allocated by
+        # THIS process through CEL selectors + shared counters, under
+        # its chart ServiceAccount with --rbac enforced.
+        stack.spawn(
+            "scheduler",
+            ["tpu_dra.scheduler.main",
+             "--kubeconfig", stack.sa_kubeconfigs["scheduler"],
+             "--retry-unschedulable-after", "0.5"],
+        )
         # "plugins roll out": this runner plays the kubelet the DaemonSet
         # would land on — start the real plugin process, wait for its
         # registration socket.
@@ -910,8 +1027,12 @@ def run_suites(r: Runner, stack: Stack, td: Path) -> int:
 
     def overlap_rejected():
         # dynmig parity (test_gpu_dynmig.bats:61-90): a second claim whose
-        # placement overlaps the prepared one must be refused.
-        c2 = make_claim(kc, "tpu-test5", "overlap-claim", ss_state["device"])
+        # placement overlaps the prepared one must be refused BY THE
+        # PLUGIN. Hand-allocated on purpose: the scheduler's counter
+        # accounting would (correctly) refuse upstream, and this test
+        # exists to prove the plugin's own Prepare-time defense.
+        c2 = make_claim(kc, "tpu-test5", "overlap-claim", ss_state["device"],
+                        hand_allocate=True)
         res = prepare(sock, c2)
         _assert(res.error, "overlapping claim was prepared")
         kc.delete(RESOURCE_CLAIMS, "tpu-test5", "overlap-claim")
@@ -1188,7 +1309,12 @@ def run_suites(r: Runner, stack: Stack, td: Path) -> int:
           noncooperative_pod_loses_chip)
 
     def invalid_sharing_rejected():
-        c = make_claim(kc, "tpu-test3", "bad-sharing", "tpu-2", params={
+        # Hand-allocated: routed through the spec, the webhook would
+        # reject this at apply (already covered by test_admission); this
+        # test keeps the PLUGIN's own config validation — the second
+        # line for configs that slip past admission — exercised.
+        c = make_claim(kc, "tpu-test3", "bad-sharing", "tpu-2",
+                       hand_allocate=True, params={
             "apiVersion": "resource.tpu.google.com/v1beta1",
             "kind": "TpuConfig",
             "sharing": {
@@ -1695,8 +1821,10 @@ def run_suites(r: Runner, stack: Stack, td: Path) -> int:
             _assert(not res.error, res.error)
         # 5th single-chip claim on a 4-chip host: every chip is held by
         # another claim, so Prepare must refuse (the double-allocation
-        # defense the scheduler normally prevents upstream).
-        c5 = make_claim(kc, "bats-stress", "over-5", "tpu-0")
+        # defense the scheduler normally prevents upstream — hence
+        # hand-allocated, bypassing the live scheduler on purpose).
+        c5 = make_claim(kc, "bats-stress", "over-5", "tpu-0",
+                        hand_allocate=True)
         res = prepare(sock, c5)
         _assert(res.error, "overcommitted claim was prepared")
         # One release later the pending claim schedules.

@@ -27,9 +27,18 @@ from tpu_dra.k8sclient.resources import (
 
 
 def test_parse_bearer_forms():
+    from tpu_dra.k8sclient.authz import InvalidToken
+
+    # Absent header = the test harness acting as admin; a PRESENT but
+    # unrecognized credential is 401, never silent admin (a mangled
+    # token must not bypass RBAC).
     assert parse_bearer(None) is None
-    assert parse_bearer("Basic abc") is None
-    assert parse_bearer("Bearer not-a-sa-token") is None
+    with pytest.raises(InvalidToken):
+        parse_bearer("Basic abc")
+    with pytest.raises(InvalidToken):
+        parse_bearer("Bearer not-a-sa-token")
+    with pytest.raises(InvalidToken):
+        parse_bearer("Bearer system:serviceaccount:only-ns")
     ident = parse_bearer("Bearer system:serviceaccount:ns1:sa1")
     assert (ident.namespace, ident.name, ident.node) == ("ns1", "sa1", "")
     ident = parse_bearer("Bearer system:serviceaccount:ns1:sa1;node=n0")
@@ -91,12 +100,16 @@ def test_rbac_wildcards():
 
 
 def _node_policy_cluster(restricted_sa="system:serviceaccount:d:plugin"):
+    """Install the chart's ValidatingAdmissionPolicy with its REAL CEL
+    expressions (templates/validatingadmissionpolicy.yaml) — evaluated
+    generically by authz, not via hardcoded semantics."""
     c = FakeCluster()
     c.create(VALIDATING_ADMISSION_POLICIES, {
         "apiVersion": "admissionregistration.k8s.io/v1",
         "kind": "ValidatingAdmissionPolicy",
         "metadata": {"name": "resourceslices-policy"},
         "spec": {
+            "failurePolicy": "Fail",
             "matchConstraints": {"resourceRules": [{
                 "apiGroups": ["resource.k8s.io"],
                 "operations": ["CREATE", "UPDATE", "DELETE"],
@@ -108,6 +121,36 @@ def _node_policy_cluster(restricted_sa="system:serviceaccount:d:plugin"):
                     f'request.userInfo.username == "{restricted_sa}"'
                 ),
             }],
+            "variables": [
+                {"name": "userNodeName", "expression": (
+                    "request.userInfo.extra"
+                    "[?'authentication.kubernetes.io/node-name'][0]"
+                    ".orValue('')"
+                )},
+                {"name": "objectNodeName", "expression": (
+                    '(request.operation == "DELETE" ? oldObject : object)'
+                    '.spec.?nodeName.orValue("")'
+                )},
+            ],
+            "validations": [
+                {
+                    "expression": 'variables.userNodeName != ""',
+                    "message": (
+                        "no node association found for user; the plugin "
+                        "must run in a pod on a node with "
+                        "ServiceAccountTokenPodNodeInfo enabled"
+                    ),
+                },
+                {
+                    "expression": (
+                        "variables.userNodeName == variables.objectNodeName"
+                    ),
+                    "messageExpression": (
+                        "\"the plugin on node '\"+variables.userNodeName+"
+                        "\"' may not modify resourceslices of other nodes\""
+                    ),
+                },
+            ],
         },
     })
     return c

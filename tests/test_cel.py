@@ -1,0 +1,266 @@
+"""CEL evaluator tests.
+
+Anchored on the REAL expressions this driver ships: every DeviceClass
+selector in deployments/helm/tpu-dra-driver/templates/deviceclasses.yaml,
+the demo claim selectors in demo/specs/selectors/claims.yaml, and the
+chart's ValidatingAdmissionPolicy expressions — plus the grammar corners
+(optionals, ternary, quantities) those rely on. Reference analog: the
+cel-go environments in vendor/k8s.io/dynamic-resource-allocation/cel and
+the apiserver's VAP evaluator, which the reference driver inherits.
+"""
+
+import pytest
+
+from tpu_dra.infra.cel import CelError, CelOptional, evaluate
+
+
+def device_env(driver="tpu.google.com", attrs=None, capacity=None):
+    return {
+        "device": {
+            "driver": driver,
+            "attributes": {driver: attrs or {}},
+            "capacity": {driver: capacity or {}},
+        }
+    }
+
+
+# --- the chart's DeviceClass selectors, verbatim ---
+
+TPU_CLASS = (
+    "device.driver == 'tpu.google.com' && "
+    "device.attributes['tpu.google.com'].type == 'tpu'"
+)
+SUBSLICE_CLASS = (
+    "device.driver == 'tpu.google.com' && "
+    "device.attributes['tpu.google.com'].type.startsWith('subslice')"
+)
+CHANNEL_CLASS = (
+    "device.driver == 'compute-domain.tpu.google.com' && "
+    "device.attributes['compute-domain.tpu.google.com'].type == 'channel'"
+)
+
+
+def test_tpu_deviceclass_selector():
+    assert evaluate(TPU_CLASS, device_env(attrs={"type": "tpu"})) is True
+    assert evaluate(TPU_CLASS, device_env(attrs={"type": "subslice-static"})) is False
+    assert (
+        evaluate(TPU_CLASS, device_env(driver="other.dev", attrs={"type": "tpu"}))
+        is False
+    )
+
+
+def test_subslice_deviceclass_selector_startswith():
+    for t, want in [
+        ("subslice-static", True),
+        ("subslice-dynamic", True),
+        ("tpu", False),
+    ]:
+        env = device_env(attrs={"type": t})
+        # attribute map is keyed by driver; selector must only see its own
+        assert evaluate(SUBSLICE_CLASS, env) is want
+
+
+def test_channel_deviceclass_selector():
+    env = device_env(
+        driver="compute-domain.tpu.google.com", attrs={"type": "channel"}
+    )
+    assert evaluate(CHANNEL_CLASS, env) is True
+
+
+# --- demo claim selectors ---
+
+def test_demo_generation_selector():
+    expr = 'device.attributes["tpu.google.com"].generation == "v5e"'
+    assert evaluate(expr, device_env(attrs={"generation": "v5e"})) is True
+    assert evaluate(expr, device_env(attrs={"generation": "v5p"})) is False
+
+
+def test_demo_subslice_shape_selector():
+    expr = 'device.attributes["tpu.google.com"].subsliceShape == "2x1"'
+    assert evaluate(expr, device_env(attrs={"subsliceShape": "2x1"})) is True
+
+
+def test_missing_attribute_is_an_error_not_false():
+    """k8s CEL treats a missing attribute as a runtime error (the caller
+    decides match semantics), not silent false."""
+    expr = 'device.attributes["tpu.google.com"].nonexistent == "x"'
+    with pytest.raises(CelError):
+        evaluate(expr, device_env(attrs={"type": "tpu"}))
+
+
+def test_capacity_quantity_comparison():
+    expr = (
+        "device.capacity['tpu.google.com'].hbm.compareTo(quantity('16Gi')) >= 0"
+    )
+    env = device_env(capacity={"hbm": None})
+    from tpu_dra.infra.cel import CelQuantity
+
+    env["device"]["capacity"]["tpu.google.com"]["hbm"] = CelQuantity("96Gi")
+    assert evaluate(expr, env) is True
+    env["device"]["capacity"]["tpu.google.com"]["hbm"] = CelQuantity("8Gi")
+    assert evaluate(expr, env) is False
+
+
+# --- the chart's ValidatingAdmissionPolicy expressions, verbatim ---
+
+VAP_MATCH = (
+    'request.userInfo.username == '
+    '"system:serviceaccount:tpu-dra-driver:tpu-dra-driver-service-account'
+    '-kubeletplugin"'
+)
+VAP_USER_NODE = (
+    "request.userInfo.extra[?'authentication.kubernetes.io/node-name'][0]"
+    ".orValue('')"
+)
+VAP_OBJECT_NODE = (
+    '(request.operation == "DELETE" ? oldObject : object)'
+    '.spec.?nodeName.orValue("")'
+)
+VAP_MESSAGE = (
+    '"the plugin on node \'"+variables.userNodeName+'
+    '"\' may not modify resourceslices of other nodes"'
+)
+
+
+def vap_env(username, node, operation="CREATE", obj=None, old=None):
+    extra = {}
+    if node is not None:
+        extra["authentication.kubernetes.io/node-name"] = [node]
+    return {
+        "request": {
+            "userInfo": {"username": username, "extra": extra},
+            "operation": operation,
+        },
+        "object": obj if obj is not None else {},
+        "oldObject": old if old is not None else {},
+    }
+
+
+def test_vap_match_condition():
+    env = vap_env(
+        "system:serviceaccount:tpu-dra-driver:"
+        "tpu-dra-driver-service-account-kubeletplugin",
+        "node-1",
+    )
+    assert evaluate(VAP_MATCH, env) is True
+    assert evaluate(VAP_MATCH, vap_env("system:serviceaccount:x:y", "n")) is False
+
+
+def test_vap_user_node_variable_with_optional_chain():
+    assert evaluate(VAP_USER_NODE, vap_env("u", "node-7")) == "node-7"
+    # Missing extra key -> absent optional -> orValue default.
+    assert evaluate(VAP_USER_NODE, vap_env("u", None)) == ""
+
+
+def test_vap_object_node_ternary_and_optional_field():
+    obj = {"spec": {"nodeName": "node-3"}}
+    old = {"spec": {"nodeName": "node-9"}}
+    env = vap_env("u", "n", operation="CREATE", obj=obj, old=old)
+    assert evaluate(VAP_OBJECT_NODE, env) == "node-3"
+    env = vap_env("u", "n", operation="DELETE", obj={}, old=old)
+    assert evaluate(VAP_OBJECT_NODE, env) == "node-9"
+    # spec present but nodeName absent -> optional default
+    env = vap_env("u", "n", obj={"spec": {}})
+    assert evaluate(VAP_OBJECT_NODE, env) == ""
+
+
+def test_vap_validation_and_message_expression():
+    env = vap_env("u", "n")
+    env["variables"] = {"userNodeName": "node-2", "objectNodeName": "node-5"}
+    assert evaluate(
+        "variables.userNodeName != ''", env
+    ) is True
+    assert evaluate(
+        "variables.userNodeName == variables.objectNodeName", env
+    ) is False
+    assert evaluate(VAP_MESSAGE, env) == (
+        "the plugin on node 'node-2' may not modify resourceslices of "
+        "other nodes"
+    )
+
+
+# --- grammar corners ---
+
+def test_precedence_and_arithmetic():
+    assert evaluate("1 + 2 * 3", {}) == 7
+    assert evaluate("(1 + 2) * 3", {}) == 9
+    assert evaluate("7 / 2", {}) == 3  # int division truncates
+    assert evaluate("-7 / 2", {}) == -3  # toward zero, not floor
+    assert evaluate("7 % 3", {}) == 1
+    assert evaluate("true || false && false", {}) is True  # && binds tighter
+
+
+def test_short_circuit():
+    # RHS would error (undeclared ref); short-circuit avoids it.
+    assert evaluate("false && nope.field == 1", {}) is False
+    assert evaluate("true || nope.field == 1", {}) is True
+
+
+def test_in_operator_and_lists():
+    assert evaluate("'a' in ['a', 'b']", {}) is True
+    assert evaluate("'z' in ['a', 'b']", {}) is False
+    assert evaluate("'k' in {'k': 1}", {}) is True
+    assert evaluate("size([1, 2, 3])", {}) == 3
+    assert evaluate("[1, 2][1]", {}) == 2
+
+
+def test_string_methods():
+    assert evaluate("'hello'.contains('ell')", {}) is True
+    assert evaluate("'hello'.endsWith('lo')", {}) is True
+    assert evaluate("'hello'.matches('^h.*o$')", {}) is True
+    assert evaluate("'hello'.size()", {}) == 5
+
+
+def test_has_macro():
+    env = {"object": {"spec": {"nodeName": "n"}}}
+    assert evaluate("has(object.spec.nodeName)", env) is True
+    assert evaluate("has(object.spec.other)", env) is False
+    assert evaluate("has(object.missing.deeper)", env) is False
+
+
+def test_comprehension_macros_rejected_not_misevaluated():
+    with pytest.raises(CelError):
+        evaluate("[1,2].all(x, x > 0)", {})
+
+
+def test_optional_indexing_on_lists():
+    assert evaluate("[1,2][?5].orValue(-1)", {}) == -1
+    assert evaluate("[1,2][?1].orValue(-1)", {}) == 2
+
+
+def test_type_errors_raise():
+    with pytest.raises(CelError):
+        evaluate("1 + 'a'", {})
+    with pytest.raises(CelError):
+        evaluate("!'str'", {})
+    with pytest.raises(CelError):
+        evaluate("1 < 'a'", {})
+    with pytest.raises(CelError):
+        evaluate("undeclared_var", {})
+
+
+def test_raw_python_errors_surface_as_cel_errors():
+    """The contract is CelError for ANY evaluation failure — a raw
+    ValueError/TypeError would bypass admission failurePolicy and crash
+    the scheduler's selector loop."""
+    with pytest.raises(CelError):
+        evaluate("int('abc')", {})
+    with pytest.raises(CelError):
+        evaluate("1 in 'abc'", {})
+    with pytest.raises(CelError):
+        evaluate("{[1]: 2}", {})
+
+
+def test_quantities():
+    assert evaluate("quantity('1Gi').compareTo(quantity('1024Mi'))", {}) == 0
+    assert evaluate("quantity('2G').isGreaterThan(quantity('1Gi'))", {}) is True
+    assert evaluate("quantity('16Gi').asInteger()", {}) == 16 * 1024**3
+
+
+def test_optional_value_api():
+    opt = CelOptional("x", True)
+    assert opt.has_value() and opt.value() == "x"
+    absent = CelOptional()
+    assert not absent.has_value()
+    with pytest.raises(CelError):
+        absent.value()

@@ -1,0 +1,343 @@
+"""Structured-parameters allocator tests.
+
+Reference analog: the allocation behavior kube-scheduler provides via
+vendor/k8s.io/dynamic-resource-allocation/structured (selector filtering,
+counter consumption, constraints) — the contract round-3's verdict found
+completely untested because every e2e hand-wrote status.allocation.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tpu_dra.k8sclient import (
+    DEVICE_CLASSES,
+    EVENTS,
+    RESOURCE_CLAIMS,
+    RESOURCE_SLICES,
+    FakeCluster,
+    ResourceClient,
+)
+from tpu_dra.scheduler import Allocator, Unschedulable
+from tpu_dra.scheduler.core import SchedulerCore
+
+TPU_CLASS = {
+    "apiVersion": "resource.k8s.io/v1beta1",
+    "kind": "DeviceClass",
+    "metadata": {"name": "tpu.google.com"},
+    "spec": {
+        "selectors": [{"cel": {"expression":
+            "device.driver == 'tpu.google.com' && "
+            "device.attributes['tpu.google.com'].type == 'tpu'"}}],
+    },
+}
+SUBSLICE_CLASS = {
+    "apiVersion": "resource.k8s.io/v1beta1",
+    "kind": "DeviceClass",
+    "metadata": {"name": "tpu-subslice.google.com"},
+    "spec": {
+        "selectors": [{"cel": {"expression":
+            "device.driver == 'tpu.google.com' && "
+            "device.attributes['tpu.google.com'].type.startsWith('subslice')"}}],
+    },
+}
+
+
+def chip(name, coord, generation="v5p", ici="feedfeed.0"):
+    return {
+        "name": name,
+        "basic": {
+            "attributes": {
+                "type": {"string": "tpu"},
+                "generation": {"string": generation},
+                "topologyCoord": {"string": coord},
+                "iciDomainID": {"string": ici},
+            },
+            "capacity": {"hbm": {"value": "103079215104"}},
+            "consumesCounters": [{
+                "counterSet": "tpu-host-mesh",
+                "counters": {f"chip-{coord}": {"value": "1"}},
+            }],
+        },
+    }
+
+
+def subslice(name, shape, coords):
+    return {
+        "name": name,
+        "basic": {
+            "attributes": {
+                "type": {"string": "subslice-dynamic"},
+                "subsliceShape": {"string": shape},
+            },
+            "consumesCounters": [{
+                "counterSet": "tpu-host-mesh",
+                "counters": {f"chip-{c}": {"value": "1"} for c in coords},
+            }],
+        },
+    }
+
+
+def combined_slice(devices, coords, node="node-0"):
+    return {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceSlice",
+        "metadata": {"name": f"{node}-tpu.google.com-combined"},
+        "spec": {
+            "driver": "tpu.google.com",
+            "nodeName": node,
+            "pool": {"name": node, "generation": 1, "resourceSliceCount": 1},
+            "sharedCounters": [{
+                "name": "tpu-host-mesh",
+                "counters": {f"chip-{c}": {"value": "1"} for c in coords},
+            }],
+            "devices": devices,
+        },
+    }
+
+
+def claim(name, requests, constraints=None, config=None, ns="team-a"):
+    devices = {"requests": requests}
+    if constraints:
+        devices["constraints"] = constraints
+    if config:
+        devices["config"] = config
+    return {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"devices": devices},
+    }
+
+
+def req(name="r0", cls="tpu.google.com", **kw):
+    out = {"name": name, "deviceClassName": cls}
+    out.update(kw)
+    return out
+
+
+COORDS = ["0-0-0", "1-0-0", "0-1-0", "1-1-0"]
+
+
+def two_chip_slice():
+    return combined_slice(
+        [chip("tpu-0-0-0", "0-0-0"), chip("tpu-1-0-0", "1-0-0")],
+        ["0-0-0", "1-0-0"],
+    )
+
+
+def test_basic_allocation_and_exclusivity():
+    alloc = Allocator([TPU_CLASS], [two_chip_slice()], [])
+    r1 = alloc.allocate(claim("c1", [req()]))
+    results = r1.allocation["devices"]["results"]
+    assert len(results) == 1
+    assert results[0]["driver"] == "tpu.google.com"
+    assert results[0]["pool"] == "node-0"
+    assert results[0]["device"] == "tpu-0-0-0"
+    # Node selector pins to the slice's node.
+    terms = r1.allocation["nodeSelector"]["nodeSelectorTerms"]
+    assert terms[0]["matchFields"][0]["values"] == ["node-0"]
+    # Same allocator instance: in-use device is skipped.
+    r2 = alloc.allocate(claim("c2", [req()]))
+    assert r2.allocation["devices"]["results"][0]["device"] == "tpu-1-0-0"
+    with pytest.raises(Unschedulable) as ei:
+        alloc.allocate(claim("c3", [req()]))
+    assert "unallocated" in str(ei.value)
+
+
+def test_existing_allocations_consume_and_release():
+    c1 = claim("c1", [req()])
+    c1["status"] = {"allocation": {"devices": {"results": [{
+        "request": "r0", "driver": "tpu.google.com",
+        "pool": "node-0", "device": "tpu-0-0-0",
+    }]}}}
+    alloc = Allocator([TPU_CLASS], [two_chip_slice()], [c1])
+    got = alloc.allocate(claim("c2", [req()]))
+    assert got.allocation["devices"]["results"][0]["device"] == "tpu-1-0-0"
+    # Release: a fresh snapshot without c1 frees its device.
+    alloc2 = Allocator([TPU_CLASS], [two_chip_slice()], [])
+    got2 = alloc2.allocate(claim("c3", [req()]))
+    assert got2.allocation["devices"]["results"][0]["device"] == "tpu-0-0-0"
+
+
+def test_request_selector_narrows():
+    devices = [
+        chip("tpu-0-0-0", "0-0-0", generation="v5e"),
+        chip("tpu-1-0-0", "1-0-0", generation="v5p"),
+    ]
+    alloc = Allocator(
+        [TPU_CLASS], [combined_slice(devices, ["0-0-0", "1-0-0"])], []
+    )
+    got = alloc.allocate(claim("c", [req(selectors=[{"cel": {"expression":
+        'device.attributes["tpu.google.com"].generation == "v5p"'}}])]))
+    assert got.allocation["devices"]["results"][0]["device"] == "tpu-1-0-0"
+
+
+def test_kep4815_counters_make_overlap_exclusive():
+    """A chip allocation consumes its mesh-coordinate counter, making any
+    sub-slice covering that coordinate unallocatable — the double-booking
+    defense partitions.go advertises counters FOR (reference:
+    cmd/gpu-kubelet-plugin/partitions.go:45-170)."""
+    devices = [
+        chip("tpu-0-0-0", "0-0-0"),
+        chip("tpu-1-0-0", "1-0-0"),
+        subslice("ss-2x1", "2x1", ["0-0-0", "1-0-0"]),
+    ]
+    slices = [combined_slice(devices, ["0-0-0", "1-0-0"])]
+    # Chip first: the overlapping 2x1 sub-slice is then unallocatable.
+    alloc = Allocator([TPU_CLASS, SUBSLICE_CLASS], slices, [])
+    alloc.allocate(claim("c1", [req()]))
+    with pytest.raises(Unschedulable):
+        alloc.allocate(
+            claim("c2", [req(cls="tpu-subslice.google.com")])
+        )
+    # Sub-slice first: BOTH chips become unallocatable.
+    alloc = Allocator([TPU_CLASS, SUBSLICE_CLASS], slices, [])
+    alloc.allocate(claim("c1", [req(cls="tpu-subslice.google.com")]))
+    with pytest.raises(Unschedulable):
+        alloc.allocate(claim("c2", [req()]))
+
+
+def test_count_and_allocation_mode_all():
+    alloc = Allocator([TPU_CLASS], [two_chip_slice()], [])
+    got = alloc.allocate(claim("c", [req(count=2)]))
+    assert len(got.allocation["devices"]["results"]) == 2
+    alloc2 = Allocator([TPU_CLASS], [two_chip_slice()], [])
+    got2 = alloc2.allocate(claim("c", [req(allocationMode="All")]))
+    assert len(got2.allocation["devices"]["results"]) == 2
+    alloc3 = Allocator([TPU_CLASS], [two_chip_slice()], [])
+    with pytest.raises(Unschedulable):
+        alloc3.allocate(claim("c", [req(count=3)]))
+
+
+def test_match_attribute_constraint():
+    """TPU case: all devices of a claim must share an ICI domain."""
+    devices = [
+        chip("tpu-a0", "0-0-0", ici="clique-a"),
+        chip("tpu-b0", "1-0-0", ici="clique-b"),
+        chip("tpu-b1", "0-1-0", ici="clique-b"),
+    ]
+    slices = [combined_slice(devices, COORDS)]
+    alloc = Allocator([TPU_CLASS], slices, [])
+    got = alloc.allocate(claim(
+        "c", [req(count=2)],
+        constraints=[{
+            "requests": ["r0"],
+            "matchAttribute": "tpu.google.com/iciDomainID",
+        }],
+    ))
+    names = {r["device"] for r in got.allocation["devices"]["results"]}
+    # Greedy would try {a0, b0} and fail; backtracking finds the b pair.
+    assert names == {"tpu-b0", "tpu-b1"}
+
+
+def test_admin_access_observes_without_consuming():
+    alloc = Allocator([TPU_CLASS], [two_chip_slice()], [])
+    alloc.allocate(claim("c1", [req()]))
+    alloc.allocate(claim("c2", [req()]))
+    got = alloc.allocate(claim("admin", [req(adminAccess=True)]))
+    res = got.allocation["devices"]["results"][0]
+    assert res["adminAccess"] is True
+
+
+def test_config_merge_class_then_claim():
+    dc = dict(TPU_CLASS, spec=dict(
+        TPU_CLASS["spec"],
+        config=[{"opaque": {
+            "driver": "tpu.google.com",
+            "parameters": {"kind": "TpuConfig", "sharing": None},
+        }}],
+    ))
+    alloc = Allocator([dc], [two_chip_slice()], [])
+    got = alloc.allocate(claim("c", [req()], config=[{
+        "requests": ["r0"],
+        "opaque": {"driver": "tpu.google.com",
+                   "parameters": {"kind": "TpuConfig"}},
+    }]))
+    config = got.allocation["devices"]["config"]
+    assert [c["source"] for c in config] == ["FromClass", "FromClaim"]
+
+
+def test_unknown_device_class():
+    alloc = Allocator([TPU_CLASS], [two_chip_slice()], [])
+    with pytest.raises(Unschedulable) as ei:
+        alloc.allocate(claim("c", [req(cls="nope.example.com")]))
+    assert "does not exist" in str(ei.value)
+
+
+def test_selector_runtime_error_fails_device_with_reason():
+    bad = dict(TPU_CLASS, spec={"selectors": [{"cel": {"expression":
+        "device.attributes['tpu.google.com'].missingAttr == 'x'"}}]})
+    alloc = Allocator([bad], [two_chip_slice()], [])
+    with pytest.raises(Unschedulable) as ei:
+        alloc.allocate(claim("c", [req()]))
+    assert "selector error" in str(ei.value)
+
+
+# --- the claim-watching controller over a fake cluster ---
+
+
+@pytest.fixture()
+def cluster():
+    fc = FakeCluster()
+    classes = ResourceClient(fc, DEVICE_CLASSES)
+    classes.create(dict(TPU_CLASS))
+    classes.create(dict(SUBSLICE_CLASS))
+    ResourceClient(fc, RESOURCE_SLICES).create(two_chip_slice())
+    return fc
+
+
+def wait_for(pred, timeout=10, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(0.05)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+def test_scheduler_core_allocates_and_releases(cluster):
+    claims = ResourceClient(cluster, RESOURCE_CLAIMS)
+    core = SchedulerCore(cluster, retry_unschedulable_after=0.3)
+    core.start()
+    try:
+        claims.create(claim("c1", [req()]))
+        claims.create(claim("c2", [req()]))
+
+        def allocated():
+            got = [
+                c for c in claims.list("team-a")
+                if (c.get("status") or {}).get("allocation")
+            ]
+            return got if len(got) == 2 else None
+
+        both = wait_for(allocated, what="two claims allocated")
+        devs = {
+            c["status"]["allocation"]["devices"]["results"][0]["device"]
+            for c in both
+        }
+        assert devs == {"tpu-0-0-0", "tpu-1-0-0"}
+
+        # Third claim: unschedulable (exhausted) -> Warning event, then
+        # released capacity un-blocks it.
+        claims.create(claim("c3", [req()]))
+        events = ResourceClient(cluster, EVENTS)
+
+        def unsched_event():
+            return [
+                e for e in events.list("team-a")
+                if e.get("reason") == "Unschedulable"
+                and e["involvedObject"]["name"] == "c3"
+            ]
+        wait_for(unsched_event, what="Unschedulable event for c3")
+        claims.delete("c1", "team-a")
+
+        def c3_allocated():
+            c = claims.try_get("c3", "team-a")
+            return (c.get("status") or {}).get("allocation")
+        alloc = wait_for(c3_allocated, what="c3 allocated after release")
+        assert alloc["devices"]["results"][0]["device"] == "tpu-0-0-0"
+    finally:
+        core.stop()

@@ -10,131 +10,18 @@ from __future__ import annotations
 import argparse
 import logging
 import signal
-import socket
 import threading
-import time
-import uuid
 
 from tpu_dra.computedomain.controller.controller import ComputeDomainController
 from tpu_dra.infra import flags, signals
+from tpu_dra.infra.leaderelection import LeaderElector  # noqa: F401
 from tpu_dra.infra.metrics import Metrics, start_health_server
-from tpu_dra.k8sclient import LEASES, ApiConflict, ApiNotFound, ResourceClient
 
 log = logging.getLogger(__name__)
 
 
-class LeaderElector:
-    """Lease-based leader election (simplified client-go leaderelection)."""
-
-    def __init__(self, backend, config: flags.LeaderElectionConfig):
-        self.leases = ResourceClient(backend, LEASES)
-        self.config = config
-        self.identity = f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}"
-        self._stop = threading.Event()
-
-    def _now(self) -> str:
-        return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-
-    def acquire_or_renew(self) -> bool:
-        name, ns = self.config.lease_name, self.config.namespace
-        lease = self.leases.try_get(name, ns)
-        if lease is None:
-            try:
-                self.leases.create(
-                    {
-                        "metadata": {"name": name, "namespace": ns},
-                        "spec": {
-                            "holderIdentity": self.identity,
-                            "acquireTime": self._now(),
-                            "renewTime": self._now(),
-                            "leaseDurationSeconds": int(
-                                self.config.lease_duration
-                            ),
-                        },
-                    }
-                )
-                return True
-            except ApiConflict:
-                return False
-        spec = lease.get("spec", {})
-        if spec.get("holderIdentity") == self.identity:
-            spec["renewTime"] = self._now()
-            try:
-                self.leases.update(lease)
-                return True
-            except ApiConflict:
-                return False
-        # Take over an expired lease.
-        renew = spec.get("renewTime", "1970-01-01T00:00:00Z")
-        expired = (
-            time.time()
-            - time.mktime(time.strptime(renew, "%Y-%m-%dT%H:%M:%SZ"))
-            > spec.get("leaseDurationSeconds", 15)
-        )
-        if not expired:
-            return False
-        spec["holderIdentity"] = self.identity
-        spec["acquireTime"] = self._now()
-        spec["renewTime"] = self._now()
-        try:
-            self.leases.update(lease)
-            return True
-        except ApiConflict:
-            return False
-
-    def _try_acquire_or_renew(self) -> bool:
-        """acquire_or_renew with transient-failure tolerance: an
-        apiserver hiccup or a malformed lease written by another client
-        must read as 'not leading right now', not kill the election
-        thread (which would leave a replica that never leads again)."""
-        try:
-            return self.acquire_or_renew()
-        except Exception:  # noqa: BLE001 — any failure = not leading
-            log.exception("leader-election attempt failed; will retry")
-            return False
-
-    def run_leading(self, lead) -> None:
-        """Acquire, lead while renewing, and on lost leadership re-enter the
-        election (a transient renewal conflict must not permanently halt
-        reconciliation — the reference exits the process so the pod
-        restarts; re-election is the in-process equivalent)."""
-        while not self._stop.is_set():
-            if not self._try_acquire_or_renew():
-                self._stop.wait(self.config.retry_period)
-                continue
-            log.info("became leader as %s", self.identity)
-            stop_lead = lead()
-            try:
-                # client-go semantics: a single failed renew (apiserver
-                # blip, conflict) is retried every retry_period; leadership
-                # is only surrendered once renew_deadline has elapsed with
-                # no successful renew.  Breaking on the first failure would
-                # tear down reconciliation and open a no-leader gap for a
-                # lease we may still validly hold.
-                last_renew = time.monotonic()
-                while not self._stop.wait(self.config.retry_period):
-                    if self._try_acquire_or_renew():
-                        last_renew = time.monotonic()
-                    elif (
-                        time.monotonic() - last_renew
-                        >= self.config.renew_deadline
-                    ):
-                        log.error(
-                            "no successful renew for %.1fs (renew_deadline); "
-                            "re-entering election",
-                            self.config.renew_deadline,
-                        )
-                        break
-                    else:
-                        log.warning(
-                            "renew attempt failed; retrying until "
-                            "renew_deadline"
-                        )
-            finally:
-                stop_lead()
-
-    def stop(self) -> None:
-        self._stop.set()
+# LeaderElector moved to tpu_dra.infra.leaderelection (shared with the
+# DRA scheduler binary); re-exported here for existing importers.
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,722 @@
+"""A small CEL (Common Expression Language) evaluator.
+
+Two production surfaces need real CEL in this driver, both inherited from
+Kubernetes semantics the reference gets for free:
+
+- **DRA device selectors** (DeviceClass.spec.selectors[].cel and
+  ResourceClaim requests[].selectors[].cel) evaluated by the scheduler
+  against ``device.{driver,attributes,capacity}``
+  (vendor/k8s.io/dynamic-resource-allocation/cel in the reference);
+- **ValidatingAdmissionPolicy** expressions (the chart's resourceslices
+  node-restriction policy) evaluated by the fakeserver's admission path
+  against ``request``/``object``/``oldObject``/``variables``.
+
+This is an expression evaluator for the CEL subset those surfaces use —
+not a compiler and not a full spec implementation. Supported grammar:
+
+- literals: int, uint (``u`` suffix dropped), float, string (single or
+  double quoted), bytes (as str), bool, null, list ``[...]``, map
+  ``{...}``;
+- operators with CEL precedence: ``?:`` (ternary, right-assoc), ``||``,
+  ``&&``, relations (``== != < <= > >= in``), additive ``+ -``,
+  multiplicative ``* / %``, unary ``! -``;
+- member access ``x.f``, optional member ``x.?f`` (→ optional),
+  indexing ``x[e]``, optional indexing ``x[?e]`` (→ optional);
+- calls: global ``size() quantity() int() string() double() bool()
+  has() type()`` and methods ``startsWith endsWith contains matches
+  size orValue hasValue value compareTo isInteger asInteger
+  isGreaterThan isLessThan``;
+- macros: only ``has()`` (field-presence test). The comprehension
+  macros (all/exists/map/filter) are not in any chart or demo
+  expression; using one raises CelError rather than mis-evaluating.
+
+Evaluation errors raise :class:`CelError`; callers choose the failure
+semantics (admission: deny on error per failurePolicy; selectors: device
+does not match and the error is surfaced).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+from tpu_dra.api.quantity import Quantity
+
+
+class CelError(Exception):
+    """Parse or evaluation failure."""
+
+
+# --- lexer ---
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+)
+  | (?P<float>\d+\.\d+(?:[eE][+-]?\d+)?|\d+[eE][+-]?\d+)
+  | (?P<int>\d+[uU]?)
+  | (?P<string>r?"(?:\\.|[^"\\])*"|r?'(?:\\.|[^'\\])*')
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+  | (?P<op>\?\.|\.\?|\[\?|==|!=|<=|>=|&&|\|\||[-+*/%!<>()\[\].,?:{}])
+    """,
+    re.VERBOSE,
+)
+
+_KEYWORDS = {"true", "false", "null", "in"}
+
+
+@dataclass
+class _Tok:
+    kind: str  # 'int' 'float' 'string' 'ident' 'op' 'kw'
+    text: str
+    pos: int
+
+
+def _lex(src: str) -> List[_Tok]:
+    out, pos = [], 0
+    while pos < len(src):
+        m = _TOKEN_RE.match(src, pos)
+        if m is None:
+            raise CelError(f"unexpected character {src[pos]!r} at {pos}")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind == "ws":
+            continue
+        text = m.group()
+        if kind == "ident" and text in _KEYWORDS:
+            kind = "kw"
+        out.append(_Tok(kind, text, m.start()))
+    out.append(_Tok("eof", "", len(src)))
+    return out
+
+
+# --- AST ---
+# Nodes are tuples: (op, *args). Ops:
+#   lit value | ident name | list [items] | map [(k,v)...]
+#   select obj field | optsel obj field | index obj e | optindex obj e
+#   call target|None name args | unary op e | binary op l r
+#   ternary c t f | has expr
+
+
+class _Parser:
+    def __init__(self, toks: List[_Tok]):
+        self.toks = toks
+        self.i = 0
+
+    def peek(self) -> _Tok:
+        return self.toks[self.i]
+
+    def next(self) -> _Tok:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def expect(self, text: str) -> _Tok:
+        t = self.next()
+        if t.text != text:
+            raise CelError(f"expected {text!r}, got {t.text!r} at {t.pos}")
+        return t
+
+    def parse(self):
+        e = self.ternary()
+        if self.peek().kind != "eof":
+            t = self.peek()
+            raise CelError(f"trailing input {t.text!r} at {t.pos}")
+        return e
+
+    # precedence climbing, CEL order
+    def ternary(self):
+        cond = self.or_()
+        if self.peek().text == "?":
+            self.next()
+            then = self.ternary()
+            self.expect(":")
+            other = self.ternary()
+            return ("ternary", cond, then, other)
+        return cond
+
+    def or_(self):
+        e = self.and_()
+        while self.peek().text == "||":
+            self.next()
+            e = ("binary", "||", e, self.and_())
+        return e
+
+    def and_(self):
+        e = self.relation()
+        while self.peek().text == "&&":
+            self.next()
+            e = ("binary", "&&", e, self.relation())
+        return e
+
+    def relation(self):
+        e = self.additive()
+        while self.peek().text in ("==", "!=", "<", "<=", ">", ">=", "in"):
+            op = self.next().text
+            e = ("binary", op, e, self.additive())
+        return e
+
+    def additive(self):
+        e = self.multiplicative()
+        while self.peek().text in ("+", "-"):
+            op = self.next().text
+            e = ("binary", op, e, self.multiplicative())
+        return e
+
+    def multiplicative(self):
+        e = self.unary()
+        while self.peek().text in ("*", "/", "%"):
+            op = self.next().text
+            e = ("binary", op, e, self.unary())
+        return e
+
+    def unary(self):
+        if self.peek().text in ("!", "-"):
+            op = self.next().text
+            return ("unary", op, self.unary())
+        return self.postfix()
+
+    def postfix(self):
+        e = self.primary()
+        while True:
+            t = self.peek()
+            if t.text == ".":
+                self.next()
+                name = self._ident()
+                e = self._member_or_call(e, name, optional=False)
+            elif t.text in (".?", "?."):
+                self.next()
+                name = self._ident()
+                e = ("optsel", e, name)
+            elif t.text == "[?":
+                self.next()
+                idx = self.ternary()
+                self.expect("]")
+                e = ("optindex", e, idx)
+            elif t.text == "[":
+                self.next()
+                idx = self.ternary()
+                self.expect("]")
+                e = ("index", e, idx)
+            else:
+                return e
+
+    def _member_or_call(self, obj, name: str, optional: bool):
+        if self.peek().text == "(":
+            self.next()
+            args = self._args()
+            return ("call", obj, name, args)
+        return ("select", obj, name)
+
+    def _ident(self) -> str:
+        t = self.next()
+        if t.kind not in ("ident", "kw"):
+            raise CelError(f"expected identifier, got {t.text!r} at {t.pos}")
+        return t.text
+
+    def _args(self) -> list:
+        args = []
+        if self.peek().text != ")":
+            args.append(self.ternary())
+            while self.peek().text == ",":
+                self.next()
+                args.append(self.ternary())
+        self.expect(")")
+        return args
+
+    def primary(self):
+        t = self.next()
+        if t.kind == "int":
+            return ("lit", int(t.text.rstrip("uU")))
+        if t.kind == "float":
+            return ("lit", float(t.text))
+        if t.kind == "string":
+            return ("lit", _unquote(t.text))
+        if t.kind == "kw":
+            if t.text == "true":
+                return ("lit", True)
+            if t.text == "false":
+                return ("lit", False)
+            if t.text == "null":
+                return ("lit", None)
+            raise CelError(f"unexpected keyword {t.text!r} at {t.pos}")
+        if t.text == "(":
+            e = self.ternary()
+            self.expect(")")
+            return e
+        if t.text == "[":
+            items = []
+            if self.peek().text != "]":
+                items.append(self.ternary())
+                while self.peek().text == ",":
+                    self.next()
+                    items.append(self.ternary())
+            self.expect("]")
+            return ("list", items)
+        if t.text == "{":
+            pairs = []
+            if self.peek().text != "}":
+                while True:
+                    k = self.ternary()
+                    self.expect(":")
+                    pairs.append((k, self.ternary()))
+                    if self.peek().text != ",":
+                        break
+                    self.next()
+            self.expect("}")
+            return ("map", pairs)
+        if t.kind == "ident":
+            if t.text == "has" and self.peek().text == "(":
+                self.next()
+                inner = self.ternary()
+                self.expect(")")
+                return ("has", inner)
+            if self.peek().text == "(":
+                self.next()
+                args = self._args()
+                return ("call", None, t.text, args)
+            return ("ident", t.text)
+        raise CelError(f"unexpected token {t.text!r} at {t.pos}")
+
+
+def _unquote(text: str) -> str:
+    raw = text.startswith("r")
+    if raw:
+        text = text[1:]
+    body = text[1:-1]
+    if raw:
+        return body
+    return body.encode().decode("unicode_escape")
+
+
+# --- values ---
+
+
+class CelOptional:
+    """CEL optional (``optional.of``/absent): produced by ``.?f``/``[?e]``."""
+
+    __slots__ = ("_value", "_present")
+
+    def __init__(self, value: Any = None, present: bool = False):
+        self._value = value
+        self._present = present
+
+    def or_value(self, default: Any) -> Any:
+        return self._value if self._present else default
+
+    def has_value(self) -> bool:
+        return self._present
+
+    def value(self) -> Any:
+        if not self._present:
+            raise CelError("optional.value() on absent optional")
+        return self._value
+
+
+class CelQuantity:
+    """resource.Quantity with the k8s CEL extension methods."""
+
+    __slots__ = ("raw", "num")
+
+    def __init__(self, raw: str):
+        self.raw = str(raw)
+        try:
+            self.num = Quantity.parse(self.raw).value
+        except Exception as e:  # noqa: BLE001 — surfaced as CEL error
+            raise CelError(f"invalid quantity {raw!r}: {e}") from e
+
+    def compare_to(self, other: "CelQuantity") -> int:
+        if not isinstance(other, CelQuantity):
+            raise CelError("compareTo expects a quantity")
+        return (self.num > other.num) - (self.num < other.num)
+
+
+# --- evaluator ---
+
+_COMPREHENSIONS = ("all", "exists", "exists_one", "map", "filter")
+
+
+class _Evaluator:
+    def __init__(self, env: Dict[str, Any]):
+        self.env = env
+
+    def eval(self, node) -> Any:
+        op = node[0]
+        return getattr(self, f"_eval_{op}")(node)
+
+    def _eval_lit(self, node):
+        return node[1]
+
+    def _eval_ident(self, node):
+        name = node[1]
+        if name not in self.env:
+            raise CelError(f"undeclared reference: {name}")
+        return self.env[name]
+
+    def _eval_list(self, node):
+        return [self.eval(e) for e in node[1]]
+
+    def _eval_map(self, node):
+        return {self.eval(k): self.eval(v) for k, v in node[1]}
+
+    def _eval_select(self, node):
+        obj = self.eval(node[1])
+        return _select(obj, node[2], optional=False)
+
+    def _eval_optsel(self, node):
+        obj = self.eval(node[1])
+        return _select(obj, node[2], optional=True)
+
+    def _eval_index(self, node):
+        obj = self.eval(node[1])
+        return _index(obj, self.eval(node[2]), optional=False)
+
+    def _eval_optindex(self, node):
+        obj = self.eval(node[1])
+        return _index(obj, self.eval(node[2]), optional=True)
+
+    def _eval_unary(self, node):
+        v = self.eval(node[2])
+        if node[1] == "!":
+            if not isinstance(v, bool):
+                raise CelError("'!' requires bool")
+            return not v
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            raise CelError("unary '-' requires number")
+        return -v
+
+    def _eval_binary(self, node):
+        op = node[1]
+        if op == "&&":
+            # CEL's && is commutative-ish over errors; short-circuit is a
+            # valid strategy and what the apiserver does in practice.
+            return self._bool(self.eval(node[2])) and self._bool(
+                self.eval(node[3])
+            )
+        if op == "||":
+            return self._bool(self.eval(node[2])) or self._bool(
+                self.eval(node[3])
+            )
+        left, right = self.eval(node[2]), self.eval(node[3])
+        if op == "==":
+            return _equals(left, right)
+        if op == "!=":
+            return not _equals(left, right)
+        if op == "in":
+            if isinstance(right, dict):
+                return left in right
+            if isinstance(right, (list, str)):
+                return left in right
+            raise CelError("'in' requires list, map, or string")
+        if op == "+":
+            if isinstance(left, str) and isinstance(right, str):
+                return left + right
+            if isinstance(left, list) and isinstance(right, list):
+                return left + right
+            return self._arith(op, left, right)
+        if op in ("-", "*", "/", "%"):
+            return self._arith(op, left, right)
+        if op in ("<", "<=", ">", ">="):
+            return self._compare(op, left, right)
+        raise CelError(f"unknown operator {op}")
+
+    @staticmethod
+    def _bool(v) -> bool:
+        if not isinstance(v, bool):
+            raise CelError("logical operator requires bool operands")
+        return v
+
+    @staticmethod
+    def _arith(op, left, right):
+        for v in (left, right):
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                raise CelError(f"'{op}' requires numeric operands")
+        if op == "+":
+            return left + right
+        if op == "-":
+            return left - right
+        if op == "*":
+            return left * right
+        if op == "/":
+            if right == 0:
+                raise CelError("division by zero")
+            # CEL int division truncates toward zero.
+            if isinstance(left, int) and isinstance(right, int):
+                return int(left / right)
+            return left / right
+        if right == 0:
+            raise CelError("modulo by zero")
+        return left - right * int(left / right)
+
+    @staticmethod
+    def _compare(op, left, right) -> bool:
+        if isinstance(left, CelQuantity) or isinstance(right, CelQuantity):
+            if not (
+                isinstance(left, CelQuantity)
+                and isinstance(right, CelQuantity)
+            ):
+                raise CelError("quantity comparison requires two quantities")
+            c = left.compare_to(right)
+            left, right = c, 0
+        ok_types = (int, float, str)
+        if isinstance(left, bool) or isinstance(right, bool):
+            raise CelError("ordering not defined for bool")
+        if not isinstance(left, ok_types) or not isinstance(right, ok_types):
+            raise CelError(f"'{op}' requires comparable operands")
+        if isinstance(left, str) != isinstance(right, str):
+            raise CelError("cannot order string against number")
+        if op == "<":
+            return left < right
+        if op == "<=":
+            return left <= right
+        if op == ">":
+            return left > right
+        return left >= right
+
+    def _eval_ternary(self, node):
+        return (
+            self.eval(node[2])
+            if self._bool(self.eval(node[1]))
+            else self.eval(node[3])
+        )
+
+    def _eval_has(self, node):
+        inner = node[1]
+        if inner[0] not in ("select", "optsel"):
+            raise CelError("has() requires a field selection")
+        try:
+            obj = self.eval(inner[1])
+        except CelError:
+            return False
+        if isinstance(obj, dict):
+            return inner[2] in obj and obj[inner[2]] is not None
+        if isinstance(obj, CelOptional):
+            return obj.has_value() and _has_on(obj.or_value(None), inner[2])
+        return False
+
+    def _eval_call(self, node):
+        _, target, name, arg_nodes = node
+        args = [self.eval(a) for a in arg_nodes]
+        if target is None:
+            return self._global_fn(name, args)
+        recv = self.eval(target)
+        return self._method(recv, name, args)
+
+    def _global_fn(self, name: str, args: list):
+        if name == "size":
+            return _size(_one(name, args))
+        if name == "quantity":
+            return CelQuantity(_one(name, args))
+        if name == "int":
+            v = _one(name, args)
+            if isinstance(v, CelQuantity):
+                return int(v.num)
+            return int(v)
+        if name == "double":
+            return float(_one(name, args))
+        if name == "string":
+            v = _one(name, args)
+            if isinstance(v, bool):
+                return "true" if v else "false"
+            return str(v)
+        if name == "bool":
+            v = _one(name, args)
+            if isinstance(v, str):
+                if v in ("true", "1"):
+                    return True
+                if v in ("false", "0"):
+                    return False
+                raise CelError(f"bool() cannot convert {v!r}")
+            return bool(v)
+        if name == "type":
+            return type(_one(name, args)).__name__
+        if name in _COMPREHENSIONS:
+            raise CelError(f"CEL macro {name!r} is not supported")
+        raise CelError(f"unknown function {name!r}")
+
+    def _method(self, recv, name: str, args: list):
+        if isinstance(recv, CelOptional):
+            if name == "orValue":
+                return recv.or_value(_one(name, args))
+            if name == "hasValue":
+                _none(name, args)
+                return recv.has_value()
+            if name == "value":
+                _none(name, args)
+                return recv.value()
+            raise CelError(f"optional has no method {name!r}")
+        if isinstance(recv, str):
+            if name == "startsWith":
+                return recv.startswith(_one_str(name, args))
+            if name == "endsWith":
+                return recv.endswith(_one_str(name, args))
+            if name == "contains":
+                return _one_str(name, args) in recv
+            if name == "matches":
+                try:
+                    return re.search(_one_str(name, args), recv) is not None
+                except re.error as e:
+                    raise CelError(f"bad matches() pattern: {e}") from e
+            if name == "size":
+                _none(name, args)
+                return len(recv)
+            if name in ("lowerAscii", "upperAscii"):
+                _none(name, args)
+                return recv.lower() if name == "lowerAscii" else recv.upper()
+            if name == "trim":
+                _none(name, args)
+                return recv.strip()
+            raise CelError(f"string has no method {name!r}")
+        if isinstance(recv, CelQuantity):
+            if name == "compareTo":
+                return recv.compare_to(_one(name, args))
+            if name == "isInteger":
+                _none(name, args)
+                return float(recv.num) == int(recv.num)
+            if name == "asInteger":
+                _none(name, args)
+                return int(recv.num)
+            if name == "asApproximateFloat":
+                _none(name, args)
+                return float(recv.num)
+            if name == "isGreaterThan":
+                return recv.compare_to(_one(name, args)) > 0
+            if name == "isLessThan":
+                return recv.compare_to(_one(name, args)) < 0
+            raise CelError(f"quantity has no method {name!r}")
+        if isinstance(recv, (list, dict)):
+            if name == "size":
+                _none(name, args)
+                return len(recv)
+            if name in _COMPREHENSIONS:
+                raise CelError(f"CEL macro {name!r} is not supported")
+        raise CelError(
+            f"no method {name!r} on {type(recv).__name__}"
+        )
+
+
+def _has_on(obj, field) -> bool:
+    return isinstance(obj, dict) and field in obj and obj[field] is not None
+
+
+def _one(name, args):
+    if len(args) != 1:
+        raise CelError(f"{name}() takes exactly one argument")
+    return args[0]
+
+
+def _one_str(name, args) -> str:
+    v = _one(name, args)
+    if not isinstance(v, str):
+        raise CelError(f"{name}() requires a string argument")
+    return v
+
+
+def _none(name, args) -> None:
+    if args:
+        raise CelError(f"{name}() takes no arguments")
+
+
+def _size(v):
+    if isinstance(v, (str, list, dict)):
+        return len(v)
+    raise CelError("size() requires string, list, or map")
+
+
+def _select(obj, field: str, optional: bool):
+    if isinstance(obj, CelOptional):
+        # Optional chaining: .?a.b / .?a.?b both stay optional.
+        if not obj.has_value():
+            return CelOptional()
+        inner = obj.or_value(None)
+        got = _select(inner, field, optional=True)
+        return got if isinstance(got, CelOptional) else CelOptional(got, True)
+    if isinstance(obj, dict):
+        if field in obj:
+            v = obj[field]
+            return CelOptional(v, True) if optional else v
+        if optional:
+            return CelOptional()
+        raise CelError(f"no such key: {field}")
+    if optional:
+        return CelOptional()
+    raise CelError(
+        f"cannot select field {field!r} from {type(obj).__name__}"
+    )
+
+
+def _index(obj, key, optional: bool):
+    if isinstance(obj, CelOptional):
+        if not obj.has_value():
+            return CelOptional()
+        got = _index(obj.or_value(None), key, optional=True)
+        return got if isinstance(got, CelOptional) else CelOptional(got, True)
+    if isinstance(obj, dict):
+        if key in obj:
+            return CelOptional(obj[key], True) if optional else obj[key]
+        if optional:
+            return CelOptional()
+        raise CelError(f"no such key: {key!r}")
+    if isinstance(obj, (list, str)):
+        if not isinstance(key, int) or isinstance(key, bool):
+            raise CelError("list index must be int")
+        if 0 <= key < len(obj):
+            return CelOptional(obj[key], True) if optional else obj[key]
+        if optional:
+            return CelOptional()
+        raise CelError(f"index {key} out of range")
+    if optional:
+        return CelOptional()
+    raise CelError(f"cannot index {type(obj).__name__}")
+
+
+def _equals(left, right) -> bool:
+    if isinstance(left, CelQuantity) and isinstance(right, CelQuantity):
+        return left.compare_to(right) == 0
+    if isinstance(left, bool) != isinstance(right, bool):
+        return False
+    return left == right
+
+
+# --- public API ---
+
+
+class Program:
+    """A parsed expression, reusable across evaluations (the compile-once
+    evaluate-per-object shape both admission and the scheduler need)."""
+
+    def __init__(self, source: str):
+        self.source = source
+        self._ast = _Parser(_lex(source)).parse()
+
+    def evaluate(self, env: Dict[str, Any]) -> Any:
+        try:
+            return _Evaluator(env).eval(self._ast)
+        except CelError:
+            raise
+        except Exception as e:  # noqa: BLE001
+            # The contract is "evaluation errors raise CelError" — a raw
+            # ValueError from int('abc') or TypeError from an unhashable
+            # map key must not bypass the callers' failure semantics
+            # (admission failurePolicy, selector no-match).
+            raise CelError(
+                f"evaluation error: {type(e).__name__}: {e}"
+            ) from e
+
+
+_cache: Dict[str, Program] = {}
+
+
+def compile_expr(source: str) -> Program:
+    """Parse (with a process-wide cache — admission evaluates the same
+    chart-installed expressions on every request)."""
+    prog = _cache.get(source)
+    if prog is None:
+        prog = Program(source)
+        if len(_cache) < 1024:
+            _cache[source] = prog
+    return prog
+
+
+def evaluate(source: str, env: Dict[str, Any]) -> Any:
+    return compile_expr(source).evaluate(env)

@@ -93,7 +93,11 @@ class LeaderElectionConfig:
     retry_period: float = 2.0
 
     @classmethod
-    def add_flags(cls, p: argparse.ArgumentParser) -> None:
+    def add_flags(
+        cls,
+        p: argparse.ArgumentParser,
+        default_lease: str = "tpu-dra-driver-controller",
+    ) -> None:
         p.add_argument(
             "--leader-election",
             action="store_true",
@@ -103,6 +107,12 @@ class LeaderElectionConfig:
         p.add_argument(
             "--leader-election-namespace",
             default=env_default("LEADER_ELECTION_NAMESPACE", "default"),
+        )
+        p.add_argument(
+            "--leader-election-lease-name",
+            default=env_default("LEADER_ELECTION_LEASE_NAME", default_lease),
+            help="Lease object name (each leader-elected binary needs "
+            "its own)",
         )
         p.add_argument(
             "--leader-election-lease-duration",
@@ -115,6 +125,7 @@ class LeaderElectionConfig:
         return cls(
             enabled=args.leader_election,
             namespace=args.leader_election_namespace,
+            lease_name=args.leader_election_lease_name,
             lease_duration=args.leader_election_lease_duration,
         )
 

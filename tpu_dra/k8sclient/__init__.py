@@ -24,6 +24,7 @@ from tpu_dra.k8sclient.resources import (  # noqa: F401
     DAEMON_SETS,
     DEPLOYMENTS,
     DEVICE_CLASSES,
+    EVENTS,
     LEASES,
     NODES,
     PODS,

@@ -22,10 +22,12 @@ validatingwebhookconfiguration.yaml, validatingadmissionpolicy.yaml):
   rules match a CREATE/UPDATE are called over real HTTPS (caBundle
   verified) with an AdmissionReview; a denial fails the API call with
   the webhook's message. failurePolicy Fail/Ignore honored.
-- **CEL policy**: the chart's one ValidatingAdmissionPolicy (only the
-  kubelet-plugin SA may write ResourceSlices, and only for its own node)
-  is enforced natively — the fakeserver implements the policy's
-  semantics keyed on the stored object, not a general CEL interpreter.
+- **CEL policy**: stored ValidatingAdmissionPolicies are evaluated with
+  a real CEL interpreter (:mod:`tpu_dra.infra.cel`) — matchConstraints,
+  matchConditions, variables, validations, and messageExpression, the
+  way the apiserver's VAP admission plugin does. Round 3 shipped this
+  as hardcoded semantics keyed on the stored object; the hardcode is
+  gone.
 """
 
 from __future__ import annotations
@@ -39,6 +41,7 @@ import uuid
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
+from tpu_dra.infra import cel
 from tpu_dra.k8sclient.resources import (
     CLUSTER_ROLE_BINDINGS,
     CLUSTER_ROLES,
@@ -62,21 +65,34 @@ class Identity:
         return f"{SA_PREFIX}{self.namespace}:{self.name}"
 
 
+class InvalidToken(Exception):
+    """An Authorization header was presented but does not parse as a
+    credential this server recognizes — 401, like a real apiserver.
+    Silently treating it as cluster-admin (the round-3 behavior) would
+    let a component with a mangled token bypass RBAC unnoticed."""
+
+    status = 401
+
+
 def parse_bearer(header: Optional[str]) -> Optional[Identity]:
     """``Authorization: Bearer system:serviceaccount:ns:name[;node=n]`` →
-    Identity; None for absent/unrecognized headers (= cluster-admin)."""
-    if not header or not header.startswith("Bearer "):
+    Identity; None for an ABSENT header (the test harness acting as
+    cluster-admin). A header that is present but unparseable raises
+    :class:`InvalidToken`."""
+    if not header:
         return None
+    if not header.startswith("Bearer "):
+        raise InvalidToken(f"unsupported authorization scheme: {header.split(' ')[0]!r}")
     token = header[len("Bearer "):].strip()
     if not token.startswith(SA_PREFIX):
-        return None
+        raise InvalidToken("bearer token is not a recognized service-account token")
     rest = token[len(SA_PREFIX):]
     node = ""
     if ";node=" in rest:
         rest, _, node = rest.partition(";node=")
     ns, _, name = rest.partition(":")
     if not ns or not name:
-        return None
+        raise InvalidToken("malformed service-account token")
     return Identity(namespace=ns, name=name, node=node)
 
 
@@ -153,11 +169,13 @@ class Authorizer:
         self, rd, operation: str, obj: dict, old_obj: Optional[dict],
         namespace: Optional[str], identity: Optional[Identity],
     ) -> None:
-        """Raise AdmissionDenied when a matching webhook or the stored
-        ResourceSlice node-restriction policy rejects the request.
-        `operation` is CREATE / UPDATE / DELETE."""
+        """Raise AdmissionDenied when a matching webhook or a stored
+        ValidatingAdmissionPolicy rejects the request. `operation` is
+        CREATE / UPDATE / DELETE."""
         self._call_webhooks(rd, operation, obj, namespace)
-        self._enforce_node_restriction(rd, operation, obj, old_obj, identity)
+        self._enforce_admission_policies(
+            rd, operation, obj, old_obj, namespace, identity
+        )
 
     def _call_webhooks(self, rd, operation, obj, namespace) -> None:
         for cfg in self.cluster.list(VALIDATING_WEBHOOK_CONFIGURATIONS, None):
@@ -235,36 +253,88 @@ class Authorizer:
         pem = base64.b64decode(ca_bundle_b64).decode()
         return ssl.create_default_context(cadata=pem)
 
-    def _enforce_node_restriction(
-        self, rd, operation, obj, old_obj, identity: Optional[Identity]
+    def _enforce_admission_policies(
+        self, rd, operation, obj, old_obj, namespace,
+        identity: Optional[Identity],
     ) -> None:
-        """The chart's ValidatingAdmissionPolicy, natively: when a stored
-        resourceslices policy matches and the requester is the restricted
-        SA named in its match condition, the slice's spec.nodeName must
-        equal the node bound into the requester's token
-        (templates/validatingadmissionpolicy.yaml; reference analog in
-        the nvidia chart)."""
-        if rd.plural != "resourceslices" or identity is None:
-            return
+        """Evaluate every stored ValidatingAdmissionPolicy with real CEL
+        (the apiserver's VAP admission plugin, in miniature): policies
+        whose matchConstraints cover this GVR+operation and whose
+        matchConditions all hold have each validation evaluated; a false
+        validation denies with ``message``/``messageExpression``. Eval
+        errors follow spec.failurePolicy (default Fail ⇒ deny) — exactly
+        how the chart's node-restriction policy reaches a real cluster
+        (templates/validatingadmissionpolicy.yaml)."""
+        env_request: dict = {
+            "userInfo": {
+                "username": identity.username if identity else "",
+                "extra": (
+                    {"authentication.kubernetes.io/node-name": [identity.node]}
+                    if identity and identity.node
+                    else {}
+                ),
+            },
+            "operation": operation,
+            "namespace": namespace or "",
+            "resource": {
+                "group": rd.group,
+                "version": rd.version,
+                "resource": rd.plural,
+            },
+        }
         for policy in self.cluster.list(VALIDATING_ADMISSION_POLICIES, None):
             spec = policy.get("spec", {})
-            if not _policy_matches_resourceslices(spec, operation):
+            if not _vap_constraints_match(spec, rd, operation):
                 continue
-            restricted = _restricted_username(spec)
-            if restricted and identity.username != restricted:
-                continue  # matchConditions: only the named SA is policed
-            if not identity.node:
+            name = policy.get("metadata", {}).get("name", "?")
+            fail_open = spec.get("failurePolicy", "Fail") == "Ignore"
+            env = {
+                "request": env_request,
+                "object": obj if obj is not None else {},
+                "oldObject": old_obj if old_obj is not None else {},
+            }
+            try:
+                if not all(
+                    cel.evaluate(c.get("expression", "true"), env) is True
+                    for c in spec.get("matchConditions", []) or []
+                ):
+                    continue
+                variables = {}
+                env["variables"] = variables
+                for var in spec.get("variables", []) or []:
+                    variables[var.get("name", "")] = cel.evaluate(
+                        var.get("expression", "null"), env
+                    )
+            except cel.CelError as e:
+                if fail_open:
+                    continue
                 raise AdmissionDenied(
-                    "no node association found for user; the plugin must "
-                    "run in a pod on a node with ServiceAccountTokenPodNodeInfo "
-                    "enabled"
-                )
-            target = obj if operation != "DELETE" else (old_obj or {})
-            node_name = target.get("spec", {}).get("nodeName", "")
-            if node_name != identity.node:
+                    f"ValidatingAdmissionPolicy '{name}' failed to "
+                    f"evaluate: {e}"
+                ) from e
+            for v in spec.get("validations", []) or []:
+                try:
+                    ok = cel.evaluate(v.get("expression", "true"), env)
+                except cel.CelError as e:
+                    if fail_open:
+                        continue
+                    raise AdmissionDenied(
+                        f"ValidatingAdmissionPolicy '{name}' validation "
+                        f"failed to evaluate: {e}"
+                    ) from e
+                if ok is True:
+                    continue
+                message = (v.get("message") or "").strip()
+                if not message and v.get("messageExpression"):
+                    try:
+                        message = str(
+                            cel.evaluate(v["messageExpression"], env)
+                        )
+                    except cel.CelError:
+                        message = ""
                 raise AdmissionDenied(
-                    f"the plugin on node '{identity.node}' may not modify "
-                    f"resourceslices of other nodes"
+                    message
+                    or f"failed expression: {v.get('expression', '')}"
                 )
 
 
@@ -284,25 +354,17 @@ def _rules_match(rules: List[dict], rd, operation: str) -> bool:
     return False
 
 
-def _policy_matches_resourceslices(spec: dict, operation: str) -> bool:
+def _vap_constraints_match(spec: dict, rd, operation: str) -> bool:
     for rule in (
         spec.get("matchConstraints", {}).get("resourceRules", [])
     ):
+        groups = rule.get("apiGroups", ["*"])
+        resources = rule.get("resources", ["*"])
+        ops = rule.get("operations", ["*"])
         if (
-            "resourceslices" in rule.get("resources", [])
-            and operation in rule.get("operations", [])
+            ("*" in groups or rd.group in groups)
+            and ("*" in resources or rd.plural in resources)
+            and ("*" in ops or operation in ops)
         ):
             return True
     return False
-
-
-def _restricted_username(spec: dict) -> str:
-    """Pull the SA username out of the policy's isRestrictedUser match
-    condition (the one expression form the chart renders)."""
-    for cond in spec.get("matchConditions", []):
-        expr = cond.get("expression", "")
-        if "request.userInfo.username ==" in expr:
-            # ... == "system:serviceaccount:ns:name"
-            _, _, rhs = expr.partition("==")
-            return rhs.strip().strip('"')
-    return ""

@@ -29,6 +29,7 @@ from tpu_dra.k8sclient.authz import (
     AdmissionDenied,
     Authorizer,
     Forbidden,
+    InvalidToken,
     parse_bearer,
 )
 from tpu_dra.k8sclient.fake import WATCH_TIMEOUT, FakeCluster
@@ -150,12 +151,27 @@ class FakeApiServer:
                 n = int(self.headers.get("Content-Length", 0))
                 return json.loads(self.rfile.read(n)) if n else {}
 
+            def _identity(self):
+                """Authn: parse the bearer identity, raising InvalidToken
+                (→ 401) for a present-but-unrecognized header — a real
+                apiserver never silently upgrades bad credentials to
+                admin."""
+                return parse_bearer(self.headers.get("Authorization"))
+
             def _authorize(self, r: _Route, verb: str) -> bool:
-                """RBAC gate (authn → authz, before any admission/side
-                effects); replies 403 and returns False on denial."""
+                """Authn + RBAC gate (before any admission/side effects);
+                replies 401/403 and returns False on denial."""
+                try:
+                    ident = self._identity()
+                except InvalidToken as e:
+                    self._reply(401, {
+                        "kind": "Status", "status": "Failure",
+                        "reason": "Unauthorized", "message": str(e),
+                        "code": 401,
+                    })
+                    return False
                 if not outer.enforce_rbac:
                     return True
-                ident = parse_bearer(self.headers.get("Authorization"))
                 resource = r.rd.plural + ("/status" if r.status else "")
                 try:
                     outer.authz.check_rbac(ident, verb, r.rd.group, resource)

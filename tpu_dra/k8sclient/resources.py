@@ -65,6 +65,9 @@ class ResourceDescriptor:
 
 # Core + app resources the driver touches.
 PODS = ResourceDescriptor("", "v1", "pods", "Pod")
+# Scheduler "unschedulable" surface (kube-scheduler records pod events;
+# our claim-driven allocator records claim events the same way).
+EVENTS = ResourceDescriptor("", "v1", "events", "Event")
 NODES = ResourceDescriptor("", "v1", "nodes", "Node", namespaced=False)
 CONFIG_MAPS = ResourceDescriptor("", "v1", "configmaps", "ConfigMap")
 DAEMON_SETS = ResourceDescriptor("apps", "v1", "daemonsets", "DaemonSet")

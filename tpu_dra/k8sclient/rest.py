@@ -197,15 +197,17 @@ class KubeClient(Backend):
     # Server-side throttling (429) retries: client-go's default behavior.
     MAX_429_RETRIES = 4
     DEFAULT_RETRY_AFTER = 1.0
-    # Connection-level retries (refused/reset/timeout). client-go retries
-    # these transparently; round 3 proved what happens without them — one
-    # apiserver blip under e2e load killed all four slice daemons and
-    # dropped the controller reconcile that would have pinned slice
-    # indices. Retrying is safe for EVERY verb here because Kubernetes
-    # writes are idempotent at the API level: updates are guarded by
-    # resourceVersion (a replayed stale write gets 409, which callers
-    # already conflict-retry), creates of an existing name get 409, and
-    # deletes of a gone object get 404 (callers treat as done).
+    # Connection-level retries. client-go retries these transparently;
+    # round 3 proved what happens without them — one apiserver blip
+    # under e2e load killed all four slice daemons and dropped the
+    # controller reconcile that would have pinned slice indices.
+    # Scope: reads (GET/list/watch) retry ANY connection error or
+    # timeout — they are idempotent. Writes retry only failures that
+    # provably occurred BEFORE the request reached the server
+    # (connection refused / failure to establish / connect timeout): a
+    # read-timeout or mid-response reset on a write may have been
+    # APPLIED server-side, and replaying e.g. a fixed-name create would
+    # surface a spurious 409 for an operation that succeeded.
     MAX_CONN_RETRIES = 5
     CONN_BACKOFF_BASE = 0.2  # 0.2, 0.4, 0.8, 1.6, 3.2s
     # Transient server errors retried with Retry-After when offered
@@ -213,7 +215,27 @@ class KubeClient(Backend):
     RETRYABLE_5XX = (500, 502, 503, 504)
     MAX_5XX_RETRIES = 3
 
-    def _do(self, send) -> requests.Response:
+    @staticmethod
+    def _pre_send_failure(e: Exception) -> bool:
+        """True when the failure provably happened before the request
+        reached the server, making a retry safe for ANY verb."""
+        if isinstance(e, requests.exceptions.ConnectTimeout):
+            return True
+        if isinstance(e, requests.ConnectionError):
+            text = str(e)
+            return any(
+                marker in text
+                for marker in (
+                    "Connection refused",
+                    "NewConnectionError",
+                    "Failed to establish a new connection",
+                    "Name or service not known",
+                    "Temporary failure in name resolution",
+                )
+            )
+        return False
+
+    def _do(self, send, idempotent: bool = False) -> requests.Response:
         """Issue a request through the client throttle, retrying 429s with
         the server's Retry-After (a real apiserver under load sheds this
         way), transient 5xx, and connection-level failures with exponential
@@ -227,6 +249,8 @@ class KubeClient(Backend):
             except (requests.ConnectionError, requests.Timeout) as e:
                 if errored >= self.MAX_CONN_RETRIES:
                     raise
+                if not idempotent and not self._pre_send_failure(e):
+                    raise  # the write may have been applied server-side
                 delay = self.CONN_BACKOFF_BASE * (2 ** errored)
                 errored += 1
                 log.warning(
@@ -304,14 +328,14 @@ class KubeClient(Backend):
     def get(self, rd, namespace, name) -> dict:
         return self._check(self._do(lambda: self._session.get(
             self.server + rd.path(namespace, name), timeout=30
-        )))
+        ), idempotent=True))
 
     def list(self, rd, namespace=None, label_selector=None, field_selector=None):
         out = self._check(self._do(lambda: self._session.get(
             self.server + rd.path(namespace),
             params=self._selector_params(label_selector, field_selector),
             timeout=30,
-        )))
+        ), idempotent=True))
         return out.get("items", [])
 
     def create(self, rd, obj) -> dict:
@@ -361,7 +385,7 @@ class KubeClient(Backend):
             params=params,
             stream=True,
             timeout=(30, None),
-        ))
+        ), idempotent=True)
         if resp.status_code >= 400:
             self._check(resp)
         return _RestWatch(resp)

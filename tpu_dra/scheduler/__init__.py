@@ -1,0 +1,20 @@
+"""Structured-parameters DRA allocator (the kube-scheduler role).
+
+In a real cluster the kube-scheduler's DynamicResources plugin performs
+allocation: it filters published ResourceSlices through DeviceClass and
+claim CEL selectors, honors KEP-4815 shared-counter consumption, and
+writes ``status.allocation`` (reference: the machinery vendored at
+/root/reference/vendor/k8s.io/dynamic-resource-allocation/structured,
+consuming the counters cmd/gpu-kubelet-plugin/partitions.go:45-170
+advertises). No kube-scheduler exists in the cluster-less e2e stacks, so
+this package supplies that half of the DRA contract: :mod:`.allocator`
+is the pure allocation algorithm, :mod:`.core` the claim-watching
+controller, :mod:`.main` the ``tpu-dra-scheduler`` binary.
+"""
+
+from tpu_dra.scheduler.allocator import (  # noqa: F401
+    AllocationResult,
+    Allocator,
+    DeviceCatalog,
+    Unschedulable,
+)

@@ -1,0 +1,415 @@
+"""The structured-parameters allocation algorithm.
+
+Given the published ResourceSlices, the installed DeviceClasses, and the
+set of already-allocated claims, allocate a pending ResourceClaim the way
+kube-scheduler's DynamicResources plugin does (reference:
+vendor/k8s.io/dynamic-resource-allocation/structured/allocator.go):
+
+- each request names a DeviceClass; candidate devices must satisfy ALL
+  of the class's CEL selectors and ALL of the request's own selectors
+  (evaluated over ``device.{driver,attributes,capacity}`` with the
+  envelope unwrapped, per the k8s DRA CEL environment);
+- a device already allocated to another claim is unavailable (except to
+  ``adminAccess`` requests, which observe but do not consume);
+- KEP-4815: a candidate whose ``consumesCounters`` cannot be satisfied
+  by the remaining capacity of its pool's ``sharedCounters`` is
+  unavailable — this is what makes overlapping sub-slice placements
+  mutually exclusive at ALLOCATION time (the plugin's Prepare-time
+  overlap defense stays as the second line);
+- ``allocationMode: ExactCount`` (default count 1) and ``All``;
+- claim ``constraints[].matchAttribute`` must hold across all chosen
+  devices (TPU case: co-clique via iciDomainID);
+- the result carries per-request device assignments, merged config
+  (DeviceClass config entries first as ``FromClass``, then claim
+  entries as ``FromClaim`` — the order opaque-config consumers rely
+  on), and a node selector pinning the claim to the devices' node.
+
+The search is exact over the (small) per-claim candidate sets: requests
+are processed in order with backtracking across candidate choices, so a
+satisfiable combination is always found (matchAttribute + counters make
+greedy insufficient).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from tpu_dra.infra.cel import CelError, CelQuantity, compile_expr
+
+log = logging.getLogger(__name__)
+
+
+class Unschedulable(Exception):
+    """The claim cannot be allocated against current cluster state; carry
+    a reason a human can act on (kube-scheduler pod-event analog)."""
+
+
+@dataclass
+class Candidate:
+    driver: str
+    pool: str
+    node_name: Optional[str]
+    name: str
+    attributes: Dict[str, dict]  # enveloped, as published
+    capacity: Dict[str, dict]
+    consumes_counters: List[dict] = field(default_factory=list)
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.driver, self.pool, self.name)
+
+    def cel_env(self) -> dict:
+        attrs = {k: _unwrap_attr(v) for k, v in self.attributes.items()}
+        caps = {
+            k: CelQuantity(str(v.get("value", "0")))
+            for k, v in self.capacity.items()
+        }
+        return {
+            "device": {
+                "driver": self.driver,
+                # k8s scopes both maps by driver/domain name.
+                "attributes": {self.driver: attrs},
+                "capacity": {self.driver: caps},
+            }
+        }
+
+
+def _unwrap_attr(v):
+    """Published attribute envelope -> CEL value ({"string": x} etc.)."""
+    if not isinstance(v, dict):
+        return v
+    for k in ("string", "int", "bool", "version"):
+        if k in v:
+            return v[k]
+    return v
+
+
+class DeviceCatalog:
+    """All published devices + per-pool shared-counter capacity."""
+
+    def __init__(self, slices: List[dict]):
+        self.devices: List[Candidate] = []
+        # (driver, pool, counterSet) -> {counter: int remaining}
+        self.counters: Dict[Tuple[str, str, str], Dict[str, int]] = {}
+        for s in slices:
+            spec = s.get("spec", {})
+            driver = spec.get("driver", "")
+            pool = spec.get("pool", {}).get("name", "")
+            node = spec.get("nodeName")
+            for cs in spec.get("sharedCounters", []) or []:
+                k = (driver, pool, cs.get("name", ""))
+                self.counters[k] = {
+                    name: int(c.get("value", 0))
+                    for name, c in (cs.get("counters") or {}).items()
+                }
+            for dev in spec.get("devices", []) or []:
+                basic = dev.get("basic", dev)
+                self.devices.append(Candidate(
+                    driver=driver,
+                    pool=pool,
+                    node_name=node,
+                    name=dev.get("name", ""),
+                    attributes=basic.get("attributes", {}) or {},
+                    capacity=basic.get("capacity", {}) or {},
+                    consumes_counters=basic.get("consumesCounters", []) or [],
+                ))
+        self.by_key = {c.key(): c for c in self.devices}
+
+
+@dataclass
+class AllocationResult:
+    allocation: dict
+    reasons: List[str] = field(default_factory=list)
+
+
+class _CounterLedger:
+    """Mutable remaining-capacity view with tentative consumption."""
+
+    def __init__(self, catalog: DeviceCatalog):
+        self.remaining = {
+            k: dict(v) for k, v in catalog.counters.items()
+        }
+
+    def can_consume(self, dev: Candidate) -> bool:
+        for entry in dev.consumes_counters:
+            k = (dev.driver, dev.pool, entry.get("counterSet", ""))
+            have = self.remaining.get(k)
+            if have is None:
+                return False  # consumes a set the pool never advertised
+            for name, c in (entry.get("counters") or {}).items():
+                if have.get(name, 0) < int(c.get("value", 0)):
+                    return False
+        return True
+
+    def consume(self, dev: Candidate, sign: int = 1) -> None:
+        for entry in dev.consumes_counters:
+            k = (dev.driver, dev.pool, entry.get("counterSet", ""))
+            have = self.remaining.setdefault(k, {})
+            for name, c in (entry.get("counters") or {}).items():
+                have[name] = have.get(name, 0) - sign * int(c.get("value", 0))
+
+
+class Allocator:
+    """One allocation pass over a snapshot of cluster state.
+
+    Build it fresh per scheduling attempt (stateless, like the
+    scheduler's snapshot): existing allocations are replayed into the
+    ledger so released claims free their devices automatically on the
+    next snapshot.
+    """
+
+    def __init__(
+        self,
+        classes: List[dict],
+        slices: List[dict],
+        allocated_claims: List[dict],
+    ):
+        self.classes = {
+            c["metadata"]["name"]: c for c in classes
+        }
+        self.catalog = DeviceCatalog(slices)
+        self.ledger = _CounterLedger(self.catalog)
+        self.in_use: set = set()
+        for claim in allocated_claims:
+            alloc = (claim.get("status") or {}).get("allocation")
+            if not alloc:
+                continue
+            for res in (alloc.get("devices") or {}).get("results", []) or []:
+                if res.get("adminAccess"):
+                    continue
+                key = (
+                    res.get("driver", ""), res.get("pool", ""),
+                    res.get("device", ""),
+                )
+                self.in_use.add(key)
+                dev = self.catalog.by_key.get(key)
+                if dev is not None:
+                    self.ledger.consume(dev)
+
+    # --- selector evaluation ---
+
+    @staticmethod
+    def _selectors_match(
+        selectors: List[dict], dev: Candidate, reasons: List[str], who: str
+    ) -> bool:
+        env = dev.cel_env()
+        for sel in selectors or []:
+            expr = (sel.get("cel") or {}).get("expression", "")
+            if not expr:
+                continue
+            try:
+                ok = compile_expr(expr).evaluate(env)
+            except CelError as e:
+                # k8s: a runtime CEL error fails the device, surfaced in
+                # the scheduling event — never silently matches.
+                reasons.append(
+                    f"device {dev.name}: {who} selector error: {e}"
+                )
+                return False
+            if ok is not True:
+                return False
+        return True
+
+    def _class_devices(
+        self, request: dict, reasons: List[str]
+    ) -> List[Candidate]:
+        class_name = request.get("deviceClassName", "")
+        dc = self.classes.get(class_name)
+        if dc is None:
+            raise Unschedulable(
+                f"request {request.get('name', '?')!r}: DeviceClass "
+                f"{class_name!r} does not exist"
+            )
+        out = []
+        for dev in self.catalog.devices:
+            if not self._selectors_match(
+                dc.get("spec", {}).get("selectors", []), dev, reasons,
+                f"class {class_name}",
+            ):
+                continue
+            if not self._selectors_match(
+                request.get("selectors", []), dev, reasons,
+                f"request {request.get('name', '?')}",
+            ):
+                continue
+            out.append(dev)
+        # Deterministic order: pool then name (the reference's allocator
+        # is deterministic over its snapshot too).
+        out.sort(key=lambda d: (d.pool, d.name))
+        return out
+
+    # --- constraints ---
+
+    @staticmethod
+    def _attr_of(dev: Candidate, qualified: str):
+        """``domain/name`` or bare ``name`` matchAttribute lookup; the
+        domain, when present, must be the device's driver (the only
+        attribute domain these slices publish under)."""
+        domain, _, name = qualified.rpartition("/")
+        if domain and domain != dev.driver:
+            return None
+        v = dev.attributes.get(name)
+        return None if v is None else _unwrap_attr(v)
+
+    def _constraints_ok(
+        self, claim_spec: dict, chosen: Dict[str, List[Candidate]]
+    ) -> bool:
+        for cons in (claim_spec.get("devices") or {}).get("constraints", []) or []:
+            attr = cons.get("matchAttribute")
+            if not attr:
+                continue
+            requests = cons.get("requests") or list(chosen)
+            values = set()
+            for r in requests:
+                for dev in chosen.get(r, []):
+                    v = self._attr_of(dev, attr)
+                    if v is None:
+                        return False  # device lacks the attribute
+                    values.add(v)
+            if len(values) > 1:
+                return False
+        return True
+
+    # --- allocation ---
+
+    def allocate(self, claim: dict) -> AllocationResult:
+        """Compute (without persisting) the allocation for ``claim``.
+        Raises :class:`Unschedulable` with the collected reasons."""
+        spec = claim.get("spec", {})
+        requests = (spec.get("devices") or {}).get("requests", []) or []
+        if not requests:
+            raise Unschedulable("claim has no device requests")
+        reasons: List[str] = []
+        per_request: List[Tuple[dict, List[Candidate], int]] = []
+        for req in requests:
+            cands = self._class_devices(req, reasons)
+            mode = req.get("allocationMode", "ExactCount")
+            if mode == "All":
+                count = len(cands)
+                if count == 0:
+                    raise Unschedulable(
+                        self._why(req, reasons, "no matching devices")
+                    )
+            else:
+                count = int(req.get("count", 1) or 1)
+            per_request.append((req, cands, count))
+
+        chosen: Dict[str, List[Candidate]] = {}
+        if not self._solve(per_request, 0, chosen, spec):
+            raise Unschedulable(self._summary(per_request, reasons))
+        return AllocationResult(
+            allocation=self._render(claim, spec, per_request, chosen),
+            reasons=reasons,
+        )
+
+    def _solve(self, per_request, i, chosen, claim_spec) -> bool:
+        """Backtracking over candidate subsets, counters consumed
+        tentatively; constraints checked at the leaf (claim-level
+        matchAttribute spans requests)."""
+        if i == len(per_request):
+            return self._constraints_ok(claim_spec, chosen)
+        req, cands, count = per_request[i]
+        name = req.get("name", f"r{i}")
+        admin = bool(req.get("adminAccess"))
+        return self._pick(req, name, admin, cands, count, 0, [],
+                          per_request, i, chosen, claim_spec)
+
+    def _pick(self, req, name, admin, cands, count, start, acc,
+              per_request, i, chosen, claim_spec) -> bool:
+        if len(acc) == count:
+            chosen[name] = list(acc)
+            if self._solve(per_request, i + 1, chosen, claim_spec):
+                return True
+            del chosen[name]
+            return False
+        for j in range(start, len(cands)):
+            dev = cands[j]
+            if not admin:
+                if dev.key() in self.in_use:
+                    continue
+                if any(d.key() == dev.key() for d in acc):
+                    continue
+                if not self.ledger.can_consume(dev):
+                    continue
+                self.ledger.consume(dev)
+                self.in_use.add(dev.key())
+            acc.append(dev)
+            if self._pick(req, name, admin, cands, count, j + 1, acc,
+                          per_request, i, chosen, claim_spec):
+                return True
+            acc.pop()
+            if not admin:
+                self.in_use.discard(dev.key())
+                self.ledger.consume(dev, sign=-1)
+        return False
+
+    # --- result rendering ---
+
+    def _render(self, claim, spec, per_request, chosen) -> dict:
+        results = []
+        node_names = set()
+        for req, _, _ in per_request:
+            name = req.get("name", "")
+            for dev in chosen.get(name, []):
+                entry = {
+                    "request": name,
+                    "driver": dev.driver,
+                    "pool": dev.pool,
+                    "device": dev.name,
+                }
+                if req.get("adminAccess"):
+                    entry["adminAccess"] = True
+                results.append(entry)
+                if dev.node_name:
+                    node_names.add(dev.node_name)
+        config = []
+        for req, _, _ in per_request:
+            dc = self.classes.get(req.get("deviceClassName", ""), {})
+            for c in dc.get("spec", {}).get("config", []) or []:
+                config.append({
+                    "source": "FromClass",
+                    "requests": [req.get("name", "")],
+                    **{k: v for k, v in c.items()},
+                })
+        for c in (spec.get("devices") or {}).get("config", []) or []:
+            entry = dict(c)
+            entry.setdefault("source", "FromClaim")
+            config.append(entry)
+        allocation: dict = {"devices": {"results": results}}
+        if config:
+            allocation["devices"]["config"] = config
+        if node_names:
+            allocation["nodeSelector"] = {
+                "nodeSelectorTerms": [{
+                    "matchFields": [{
+                        "key": "metadata.name",
+                        "operator": "In",
+                        "values": sorted(node_names),
+                    }]
+                }]
+            }
+        return allocation
+
+    @staticmethod
+    def _why(req, reasons, default) -> str:
+        rel = [r for r in reasons if req.get("name", "") in r]
+        return "; ".join(rel) if rel else (
+            f"request {req.get('name', '?')!r}: {default}"
+        )
+
+    def _summary(self, per_request, reasons) -> str:
+        parts = []
+        for req, cands, count in per_request:
+            free = [
+                c for c in cands
+                if c.key() not in self.in_use and self.ledger.can_consume(c)
+            ]
+            parts.append(
+                f"request {req.get('name', '?')!r} needs {count} "
+                f"device(s): {len(cands)} match selectors, {len(free)} "
+                f"unallocated with counter capacity"
+            )
+        if reasons:
+            parts.extend(reasons[:3])
+        return "cannot allocate: " + "; ".join(parts)

@@ -1,0 +1,230 @@
+"""The claim-watching allocation controller.
+
+kube-scheduler allocates claims while binding pods; with no pods to bind
+in the cluster-less stacks, this controller allocates on the claim
+itself: every pending ResourceClaim (no ``status.allocation``) is run
+through :class:`~tpu_dra.scheduler.allocator.Allocator` against a fresh
+snapshot of DeviceClasses + ResourceSlices + allocated claims, and the
+winning allocation is written to ``status.allocation``. Unschedulable
+claims get a core/v1 Event (kube-scheduler's pod-event analog) and are
+retried with backoff — new slices or released claims unblock them.
+
+Deallocation is implicit and stateless: usage is recomputed from live
+claims each attempt, so a deleted/released claim frees its devices and
+counters on the next snapshot (the reference's in-memory allocator is
+rebuilt from informer state the same way).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import List, Optional
+
+from tpu_dra.infra.metrics import Metrics
+from tpu_dra.infra.workqueue import WorkQueue, default_controller_rate_limiter
+from tpu_dra.k8sclient import (
+    DEVICE_CLASSES,
+    EVENTS,
+    RESOURCE_CLAIMS,
+    RESOURCE_SLICES,
+    ApiConflict,
+    ApiNotFound,
+    Informer,
+    ResourceClient,
+)
+from tpu_dra.scheduler.allocator import Allocator, Unschedulable
+
+log = logging.getLogger(__name__)
+
+
+class SchedulerCore:
+    def __init__(
+        self,
+        backend,
+        metrics: Optional[Metrics] = None,
+        retry_unschedulable_after: float = 5.0,
+    ):
+        self.backend = backend
+        self.metrics = metrics if metrics is not None else Metrics()
+        self.claims = ResourceClient(backend, RESOURCE_CLAIMS)
+        self.events = ResourceClient(backend, EVENTS)
+        self.queue = WorkQueue(
+            default_controller_rate_limiter(), metrics=self.metrics
+        )
+        self.claim_informer = Informer(
+            backend, RESOURCE_CLAIMS, metrics=self.metrics
+        )
+        self.slice_informer = Informer(
+            backend, RESOURCE_SLICES, metrics=self.metrics
+        )
+        self.class_informer = Informer(
+            backend, DEVICE_CLASSES, metrics=self.metrics
+        )
+        self.retry_unschedulable_after = retry_unschedulable_after
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        # Event dedup (kube-scheduler's EventRecorder aggregates; we
+        # emit only on message CHANGE): claim key -> last emitted
+        # unschedulable message. Entries clear on allocation/deletion,
+        # bounding growth to currently-pending claims.
+        self._last_unsched: dict = {}
+        self._unsched_lock = threading.Lock()
+
+    # --- lifecycle ---
+
+    def start(self) -> None:
+        self.claim_informer.add_handler(self._on_claim_event)
+        # New capacity or classes can unblock Unschedulable claims — the
+        # DynamicResources plugin re-queues pods on these events too.
+        self.slice_informer.add_handler(self._on_capacity_event)
+        self.class_informer.add_handler(self._on_capacity_event)
+        for inf in (
+            self.claim_informer, self.slice_informer, self.class_informer
+        ):
+            inf.start()
+        self._threads.append(self.queue.run_in_thread())
+        t = threading.Thread(
+            target=self._periodic_sweep, daemon=True, name="sched-sweep"
+        )
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.shutdown()
+        for inf in (
+            self.claim_informer, self.slice_informer, self.class_informer
+        ):
+            inf.stop()
+
+    def healthy(self) -> "tuple[bool, str]":
+        if not self._threads:
+            return True, "standby"
+        if self._stop.is_set():
+            return True, "stopped"
+        dead = [t.name for t in self._threads if not t.is_alive()]
+        if dead:
+            return False, f"dead worker threads: {dead}"
+        return True, "ok"
+
+    # --- events ---
+
+    def _key(self, claim: dict) -> str:
+        md = claim["metadata"]
+        return f"{md.get('namespace')}/{md['name']}"
+
+    def _on_claim_event(self, event: str, claim: dict) -> None:
+        if event == "DELETED":
+            return  # release is implicit in the next snapshot
+        if not (claim.get("status") or {}).get("allocation"):
+            self.queue.enqueue(claim, self._reconcile, key=self._key(claim))
+
+    def _on_capacity_event(self, event: str, obj: dict) -> None:
+        for claim in self.claim_informer.list():
+            if not (claim.get("status") or {}).get("allocation"):
+                self.queue.enqueue(
+                    claim, self._reconcile, key=self._key(claim)
+                )
+
+    def _periodic_sweep(self) -> None:
+        """Backstop for Unschedulable claims waiting on capacity that
+        arrives without an observable event (and for anything dropped
+        while this scheduler wasn't leading)."""
+        while not self._stop.wait(self.retry_unschedulable_after):
+            try:
+                pending = 0
+                for claim in self.claims.list():
+                    if not (claim.get("status") or {}).get("allocation"):
+                        pending += 1
+                        self.queue.enqueue(
+                            claim, self._reconcile, key=self._key(claim)
+                        )
+                self.metrics.set_gauge("scheduler_pending_claims", pending)
+            except Exception:
+                log.exception("scheduler periodic sweep failed")
+
+    # --- allocation ---
+
+    def _snapshot_allocator(self) -> Allocator:
+        return Allocator(
+            classes=self.class_informer.list(),
+            slices=self.slice_informer.list(),
+            allocated_claims=self.claims.list(),
+        )
+
+    def _reconcile(self, claim_snapshot: dict) -> None:
+        md = claim_snapshot["metadata"]
+        key = self._key(claim_snapshot)
+        claim = self.claims.try_get(md["name"], md.get("namespace"))
+        if claim is None or (claim.get("status") or {}).get("allocation"):
+            with self._unsched_lock:
+                self._last_unsched.pop(key, None)
+            return
+        if claim["metadata"].get("deletionTimestamp"):
+            return
+        t0 = time.monotonic()
+        try:
+            result = self._snapshot_allocator().allocate(claim)
+        except Unschedulable as e:
+            self.metrics.inc("scheduler_unschedulable_total")
+            # Every retry/sweep re-attempts allocation, so an event per
+            # attempt would accumulate ~2/s per stuck claim forever;
+            # emit only when the reason CHANGES (recorder aggregation).
+            with self._unsched_lock:
+                changed = self._last_unsched.get(key) != str(e)
+                if changed:
+                    self._last_unsched[key] = str(e)
+            if changed:
+                self._emit_event(claim, "Unschedulable", str(e))
+                log.info(
+                    "claim %s/%s unschedulable: %s",
+                    md.get("namespace"), md["name"], e,
+                )
+            # Raise so the workqueue retries with backoff — capacity
+            # changes also re-enqueue via the capacity handlers.
+            raise
+        claim.setdefault("status", {})["allocation"] = result.allocation
+        try:
+            self.claims.update_status(claim)
+        except (ApiConflict, ApiNotFound):
+            return  # changed underneath us; the claim event re-enqueues
+        with self._unsched_lock:
+            self._last_unsched.pop(key, None)
+        self.metrics.inc("scheduler_allocations_total")
+        self.metrics.observe(
+            "scheduler_allocate_seconds", time.monotonic() - t0
+        )
+        devices = [
+            r["device"] for r in result.allocation["devices"]["results"]
+        ]
+        self._emit_event(
+            claim, "Allocated", f"allocated devices: {', '.join(devices)}"
+        )
+        log.info(
+            "allocated claim %s/%s -> %s",
+            md.get("namespace"), md["name"], devices,
+        )
+
+    def _emit_event(self, claim: dict, reason: str, message: str) -> None:
+        md = claim["metadata"]
+        try:
+            self.events.create({
+                "metadata": {
+                    "generateName": f"{md['name']}.",
+                    "namespace": md.get("namespace") or "default",
+                },
+                "type": "Normal" if reason == "Allocated" else "Warning",
+                "reason": reason,
+                "message": message[:1024],
+                "involvedObject": {
+                    "kind": "ResourceClaim",
+                    "namespace": md.get("namespace"),
+                    "name": md["name"],
+                    "uid": md.get("uid"),
+                },
+                "source": {"component": "tpu-dra-scheduler"},
+            })
+        except Exception:  # noqa: BLE001 — events are best-effort
+            log.debug("event emission failed", exc_info=True)

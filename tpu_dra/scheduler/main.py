@@ -1,0 +1,118 @@
+"""tpu-dra-scheduler entrypoint: the structured-parameters allocator as
+a leader-elected binary.
+
+Occupies the kube-scheduler DynamicResources role for cluster-less
+stacks (reference: the scheduler plugin built on
+vendor/k8s.io/dynamic-resource-allocation/structured). Run it next to
+the fakeserver and every pending ResourceClaim is allocated against the
+published ResourceSlices — CEL selectors, KEP-4815 counters, constraints
+— exactly where tests previously hand-wrote ``status.allocation``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+from tpu_dra.infra import flags, signals
+from tpu_dra.infra.leaderelection import LeaderElector
+from tpu_dra.infra.metrics import Metrics, start_health_server
+from tpu_dra.scheduler.core import SchedulerCore
+
+log = logging.getLogger(__name__)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("tpu-dra-scheduler")
+    flags.add_version_flag(p)
+    flags.KubeClientConfig.add_flags(p)
+    flags.LoggingConfig.add_flags(p)
+    flags.LeaderElectionConfig.add_flags(p, default_lease="tpu-dra-scheduler")
+    flags.add_feature_gate_flag(p)
+    p.add_argument(
+        "--retry-unschedulable-after",
+        type=float,
+        default=flags.env_default("RETRY_UNSCHEDULABLE_AFTER", 5.0, float),
+        help="Periodic sweep re-attempting pending claims",
+    )
+    p.add_argument(
+        "--health-port",
+        type=int,
+        default=flags.env_default("HEALTH_PORT", 0, int),
+        help="Serve /healthz + Prometheus /metrics (0 disables)",
+    )
+    args = p.parse_args(argv)
+    flags.LoggingConfig.from_args(args).apply()
+    signals.start_debug_signal_handlers()
+    flags.apply_feature_gates(args)
+    flags.log_startup_config(args)
+
+    backend = flags.KubeClientConfig.from_args(args).new_client()
+    metrics = Metrics()
+    current: dict = {"core": None}
+
+    def build_core() -> SchedulerCore:
+        c = SchedulerCore(
+            backend,
+            metrics=metrics,
+            retry_unschedulable_after=args.retry_unschedulable_after,
+        )
+        current["core"] = c
+        return c
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+
+    election: dict = {"thread": None}
+
+    def healthz():
+        t = election["thread"]
+        if t is not None and not t.is_alive():
+            return False, "leader-election thread dead"
+        c = current["core"]
+        return c.healthy() if c is not None else (True, "standby")
+
+    health_server = start_health_server(
+        metrics, args.health_port, healthz=healthz
+    )
+    if health_server:
+        log.info("metrics/healthz on :%d", health_server.port)
+
+    le_config = flags.LeaderElectionConfig.from_args(args)
+    if le_config.enabled:
+        elector = LeaderElector(backend, le_config)
+
+        def lead():
+            core = build_core()
+            metrics.set_gauge("leader", 1)
+            core.start()
+
+            def stop_lead():
+                metrics.set_gauge("leader", 0)
+                core.stop()
+
+            return stop_lead
+
+        t = threading.Thread(
+            target=elector.run_leading, args=(lead,), daemon=True
+        )
+        t.start()
+        election["thread"] = t
+        stop.wait()
+        elector.stop()
+    else:
+        core = build_core()
+        metrics.set_gauge("leader", 1)
+        core.start()
+        stop.wait()
+        core.stop()
+    if health_server:
+        health_server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
