@@ -10,8 +10,11 @@ all: native test
 native:
 	$(MAKE) -C native
 
+# Two consecutive full runs: flakes and ordering-dependent failures must
+# surface in CI, not in the judge's rerun (round-3 lesson).
 test: native
-	python -m pytest tests/ -x -q
+	python -m pytest tests/ -q
+	python -m pytest tests/ -q
 
 bench:
 	python bench.py
