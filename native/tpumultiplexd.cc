@@ -231,6 +231,7 @@ std::string LeaseBodyJson(const Config& cfg) {
 struct Conn {
   int fd = -1;
   std::string name;     // display name from the acquire request
+  std::string cred;     // SO_PEERCRED "uid<u>:pid<p>" (cooldown key)
   std::string inbuf;    // unparsed input
   std::string outbuf;   // unwritten output
   bool waiting = false;  // queued for the lease (requests held until grant)
@@ -341,7 +342,18 @@ class Daemon {
     int fd = accept4(listen_fd_, nullptr, nullptr,
                      SOCK_NONBLOCK | SOCK_CLOEXEC);
     if (fd < 0) return;
-    conns_[fd].fd = fd;
+    Conn& c = conns_[fd];
+    c.fd = fd;
+    // Kernel-attested peer identity for cooldown keying: unlike the
+    // client-supplied display name or the fd, a uid:pid survives a
+    // reconnect and cannot be chosen by the client, so a revoked client
+    // cannot shed its cooldown by reconnecting under a fresh name.
+    struct ucred uc;
+    socklen_t len = sizeof uc;
+    if (getsockopt(fd, SOL_SOCKET, SO_PEERCRED, &uc, &len) == 0) {
+      c.cred = "uid" + std::to_string(uc.uid) + ":pid" +
+               std::to_string(uc.pid);
+    }
   }
 
   void ReadFrom(Conn& c) {
@@ -390,10 +402,12 @@ class Daemon {
         Send(c, "{\"ok\": true, \"lease\": " + LeaseBodyJson(cfg_) + "}");
         return;
       }
-      // Cooldown is keyed by display name on purpose: a revoked client
-      // reconnecting with a fresh fd must not evade it (the name can only
-      // DENY service, never steal a lease — lease identity stays the fd).
-      double remaining = CooldownRemaining(c.name);
+      // Cooldown is keyed by SO_PEERCRED uid:pid (display name where the
+      // platform lacks peer credentials): a revoked client reconnecting
+      // with a fresh fd OR a fresh name must not evade it (the key can
+      // only DENY service, never steal a lease — lease identity stays
+      // the fd).
+      double remaining = CooldownRemaining(c.cred.empty() ? c.name : c.cred);
       if (remaining > 0) {
         char buf[128];
         snprintf(buf, sizeof buf,
@@ -485,7 +499,10 @@ class Daemon {
                           : MaxHoldSeconds(cfg_);
     std::string name =
         it != conns_.end() ? it->second.name : ("fd-" + std::to_string(holder_));
-    cooldown_[name] = now + cooldown;
+    std::string key =
+        (it != conns_.end() && !it->second.cred.empty()) ? it->second.cred
+                                                         : name;
+    cooldown_[key] = now + cooldown;
     revocations_++;
     if (it != conns_.end()) {
       char buf[256];
@@ -574,7 +591,7 @@ class Daemon {
   double hold_started_ = 0.0;
   double contended_since_ = 0.0;
   size_t revocations_ = 0;
-  std::map<std::string, double> cooldown_;  // display name -> until
+  std::map<std::string, double> cooldown_;  // peercred (or name) -> until
 };
 
 // `check` probe: 0 iff a daemon answers a ping on the socket.

@@ -314,6 +314,42 @@ def test_noncooperative_holder_is_preempted(backend, tmp_path):
         d.stop()
 
 
+def test_revoked_client_cannot_evade_cooldown_by_reconnecting(
+    backend, tmp_path
+):
+    """The cooldown is keyed by kernel-attested SO_PEERCRED uid:pid, not
+    the client-supplied display name: a revoked hog that reconnects under
+    a brand-new name (fresh socket, fresh name, same OS process) is still
+    refused for the remainder of its cooldown."""
+    d = new_daemon(
+        backend, tmp_path, ["chip-a"], timeslice_ordinal=1,
+        window_seconds=2.0,  # quantum 0.1s; revoke after 0.2s contention
+        preempt_after_quanta=2, preempt_cooldown_seconds=30.0,
+    )
+    try:
+        from tpu_dra.workloads.multiplex_client import LeaseCooldownError
+
+        hog = MultiplexClient(str(tmp_path), client_name="hog")
+        hog.acquire()
+        victim = MultiplexClient(str(tmp_path), client_name="victim")
+        granted = threading.Event()
+        threading.Thread(
+            target=lambda: (victim.acquire(), granted.set()), daemon=True
+        ).start()
+        assert granted.wait(timeout=10), "hog was never preempted"
+        hog.close()  # drop the revoked connection entirely
+
+        fresh = MultiplexClient(str(tmp_path), client_name="innocent-new")
+        with pytest.raises(LeaseCooldownError) as ei:
+            fresh.acquire()
+        assert ei.value.retry_after > 0
+        fresh.close()
+        victim.release()
+        victim.close()
+    finally:
+        d.stop()
+
+
 def test_cooperative_clients_never_preempted(backend, tmp_path):
     """Preemption must be invisible to clients that honor the quantum:
     the rotation workload from test_timeslice_cooperative_rotation runs

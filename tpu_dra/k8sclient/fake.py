@@ -18,8 +18,10 @@ can be exercised faithfully:
 
 from __future__ import annotations
 
+import base64
 import copy
 import datetime
+import json
 import queue
 import threading
 import uuid as uuidlib
@@ -221,6 +223,92 @@ class FakeCluster(Backend):
                 out.append(copy.deepcopy(obj))
             return out
 
+    def list_page(self, rd, namespace=None, label_selector=None,
+                  field_selector=None, limit=None, continue_token=None):
+        """One page of a chunked list (apiserver ``limit``/``continue``
+        semantics): returns ``(items, list_meta)`` where ``list_meta``
+        carries ``resourceVersion`` and, when more items remain, a
+        ``continue`` token. A token whose resourceVersion has fallen out
+        of the retained event window raises :class:`ApiGone` — the 410
+        a real apiserver answers for an expired continue token, which
+        clients must handle by restarting the list. Divergence from a
+        real apiserver (documented, acceptable for a test fake):
+        continuation pages serve the CURRENT store, not a snapshot at
+        the token's version — items are never duplicated or skipped
+        relative to the key order, but late pages can carry newer
+        versions of objects."""
+        start_after = None
+        if continue_token:
+            try:
+                decoded = json.loads(
+                    base64.b64decode(continue_token.encode())
+                )
+                token_rv = int(decoded["rv"])
+                start_after = tuple(decoded["start"])
+            except (ValueError, KeyError, TypeError) as e:
+                raise K8sApiError(
+                    f"invalid continue token: {e}", status=400
+                )
+            with self._lock:
+                if (
+                    self._event_log
+                    and len(self._event_log) == self._event_log.maxlen
+                    and token_rv < self._event_log[0][0] - 1
+                ):
+                    raise ApiGone(
+                        f"continue token resourceVersion {token_rv} is too "
+                        f"old (oldest retained: {self._event_log[0][0]})"
+                    )
+        if limit is not None and limit <= 0:
+            limit = None  # limit=0 is "unlimited" on a real apiserver
+        with self._lock:
+            rv = str(self._rv)
+            out = []
+            next_start = None
+            prefix = f"{rd.group}/{rd.plural}"
+            # Pre-filter to the plural before sorting: a page must not pay
+            # O(M log M) over every resource type in the store.
+            entries = sorted(
+                (k, v) for k, v in self._objs.items() if k[0] == prefix
+            )
+            for (_plural, ns, name), obj in entries:
+                if start_after is not None and (ns or "", name) <= start_after:
+                    continue
+                if rd.namespaced and namespace and ns != namespace:
+                    continue
+                if label_selector and not match_label_selector(
+                    obj["metadata"].get("labels", {}) or {}, label_selector
+                ):
+                    continue
+                if field_selector and not self._match_fields(obj, field_selector):
+                    continue
+                if limit is not None and len(out) >= limit:
+                    next_start = (ns or "", name)
+                    break
+                out.append(copy.deepcopy(obj))
+        meta = {"resourceVersion": rv}
+        if next_start is not None:
+            # The key we stopped AT starts the next page's exclusive scan
+            # from the item before it, so encode the last RETURNED key.
+            last = out[-1]["metadata"]
+            meta["continue"] = base64.b64encode(json.dumps({
+                "rv": int(rv),
+                "start": [last.get("namespace") or "", last["name"]],
+            }).encode()).decode()
+        return out, meta
+
+    def bookmark_rv(self, w: "_Watch") -> Optional[str]:
+        """Current resourceVersion for a watch BOOKMARK, or None if the
+        watch still has undelivered events (a bookmark must never let a
+        resuming client skip past an event it hasn't seen). Checked under
+        the cluster lock — _emit enqueues under the same lock, so an
+        empty queue here proves the bookmark version covers everything
+        this watch will ever be sent up to now."""
+        with self._lock:
+            if w.closed or not w.q.empty():
+                return None
+            return str(self._rv)
+
     @staticmethod
     def _match_fields(obj: dict, sel: Dict[str, str]) -> bool:
         for path, want in sel.items():
@@ -320,18 +408,35 @@ class FakeCluster(Backend):
 
     def patch(self, rd, namespace, name, patch, admit=None) -> dict:
         """Strategic-merge-lite: dict deep-merge; None deletes a key.
-        ``admit(merged)`` (if given) runs on the merged object INSIDE the
-        lock, before it is stored — raising aborts the patch. That keeps
-        admission reviews true to what actually lands (no
-        review-then-store race), at the cost of holding the lock across
-        the review; fine for a test apiserver."""
-        with self._lock:
-            cur = self.get(rd, namespace, name)
-            merge_patch(cur, patch)
+        ``admit(merged)`` (if given) reviews a SNAPSHOT of the merged
+        object OUTSIDE the lock — a slow or hung admission webhook (up to
+        its timeoutSeconds over HTTPS) must not stall every other API
+        operation, including watch dispatch. The store then happens under
+        the lock only if the object is unchanged since the snapshot
+        (resourceVersion compare-and-swap); losing the race re-merges and
+        re-reviews, so what lands is always what was reviewed. Raising
+        from ``admit`` aborts the patch."""
+        for _ in range(16):
+            merged = self.get(rd, namespace, name)  # deepcopy snapshot
+            snap_rv = merged["metadata"]["resourceVersion"]
+            merge_patch(merged, patch)
             if admit is not None:
-                admit(cur)
-            cur["metadata"]["resourceVersion"] = None  # skip conflict check
-            return self._update(rd, cur, status_only=False)
+                admit(merged)  # outside the lock, on the snapshot
+            with self._lock:
+                key = self._key(rd, namespace, name)
+                live = self._objs.get(key)
+                if live is None:
+                    raise ApiNotFound(
+                        f"{rd.plural} {namespace}/{name} not found"
+                    )
+                if live["metadata"]["resourceVersion"] != snap_rv:
+                    continue  # concurrent write: re-merge + re-review
+                merged["metadata"]["resourceVersion"] = None  # skip CAS check
+                return self._update(rd, merged, status_only=False)
+        raise ApiConflict(
+            f"{rd.plural} {namespace}/{name}: patch lost the update race "
+            f"16 times in a row"
+        )
 
     def delete(self, rd, namespace, name) -> None:
         key = self._key(rd, namespace, name)

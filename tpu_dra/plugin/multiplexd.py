@@ -86,6 +86,27 @@ SCHEDULING_WINDOW_SECONDS = 10.0
 TIMESLICE_WINDOW_FRACTION = {1: 0.05, 2: 0.25, 3: 1.0}
 
 
+def _peer_cred(conn) -> Optional[str]:
+    """Kernel-attested peer identity (``uid<u>:pid<p>``) from SO_PEERCRED,
+    or None where the platform/transport doesn't provide it. Used to key
+    post-revocation cooldowns: unlike the client-supplied display name or
+    the per-connection id, a uid:pid survives a reconnect and cannot be
+    chosen by the client, so an offender cannot shed its cooldown by
+    reconnecting under a fresh name."""
+    so_peercred = getattr(socket, "SO_PEERCRED", None)
+    if so_peercred is None:
+        return None
+    try:
+        import struct
+
+        raw = conn.getsockopt(socket.SOL_SOCKET, so_peercred,
+                              struct.calcsize("3i"))
+        pid, uid, _gid = struct.unpack("3i", raw)
+        return f"uid{uid}:pid{pid}"
+    except OSError:
+        return None
+
+
 class LeaseState:
     """FIFO lease arbiter. One holder at a time; waiters queue in arrival
     order; a dropped client connection releases its lease/queue slot.
@@ -130,12 +151,15 @@ class LeaseState:
         self._contended_since: float = 0.0
         self._queue: "deque[str]" = deque()
         self._names: Dict[str, str] = {}  # conn id -> display name
-        # Revocation bookkeeping. Cooldowns are keyed by DISPLAY NAME on
-        # purpose: an offender that reconnects gets a fresh conn id, and a
-        # conn-keyed cooldown would be evaded by one close(). A name can
-        # only be used to DENY service during the cooldown window, never
-        # to steal or release another client's lease (identity for those
-        # stays the connection).
+        # Revocation bookkeeping. Cooldowns need an identity that SURVIVES
+        # a reconnect (a fresh conn id is one close() away) and that the
+        # client cannot choose (a display name is): the key is the peer's
+        # SO_PEERCRED uid:pid when the transport provides it, falling back
+        # to the display name on platforms without peer credentials. A
+        # cooldown key can only be used to DENY service during the window,
+        # never to steal or release another client's lease (identity for
+        # those stays the connection).
+        self._cooldown_keys: Dict[str, str] = {}  # conn id -> cooldown key
         self._cooldown_until: Dict[str, float] = {}
         self._revocations = 0
         self._push: Dict[str, object] = {}  # conn id -> best-effort send fn
@@ -174,7 +198,8 @@ class LeaseState:
             return 0.0
         return until - now
 
-    def acquire(self, conn_id: str, name: str, cancelled):
+    def acquire(self, conn_id: str, name: str, cancelled,
+                cooldown_key: Optional[str] = None):
         """Block until `conn_id` holds the lease; returns
         ``("granted", 0.0)``, ``("cancelled", 0.0)`` (client hung up while
         queued), or ``("cooldown", seconds)`` — refused outright because
@@ -184,9 +209,12 @@ class LeaseState:
         process the release that frees it)."""
         with self._granted:
             self._names[conn_id] = name
+            self._cooldown_keys[conn_id] = cooldown_key or name
             if self._holder == conn_id:
                 return ("granted", 0.0)
-            remaining = self._cooldown_remaining_locked(name)
+            remaining = self._cooldown_remaining_locked(
+                self._cooldown_keys[conn_id]
+            )
             if remaining > 0:
                 return ("cooldown", remaining)
             self._queue.append(conn_id)
@@ -233,7 +261,8 @@ class LeaseState:
                 if self.preempt_cooldown_seconds is not None
                 else self.max_hold_seconds()
             )
-            self._cooldown_until[name] = now + cooldown
+            key = self._cooldown_keys.get(offender, name)
+            self._cooldown_until[key] = now + cooldown
             self._revocations += 1
             self._holder = None
             self._granted.notify_all()
@@ -270,6 +299,7 @@ class LeaseState:
         with self._granted:
             self._drop_locked(conn_id)
             self._names.pop(conn_id, None)
+            self._cooldown_keys.pop(conn_id, None)
             self._push.pop(conn_id, None)
 
     def _drop_locked(self, conn_id: str) -> None:
@@ -338,7 +368,8 @@ class _Handler(socketserver.StreamRequestHandler):
                 if op == "acquire":
                     name = msg.get("client") or conn_id
                     verdict, extra = state.acquire(
-                        conn_id, name, cancelled=self._conn_dead
+                        conn_id, name, cancelled=self._conn_dead,
+                        cooldown_key=_peer_cred(self.connection),
                     )
                     if verdict == "cancelled":
                         return
@@ -377,9 +408,38 @@ class _Handler(socketserver.StreamRequestHandler):
 
     def _push_event(self, obj: dict) -> None:
         """Best-effort async event to this client (revocation notice); a
-        dead connection is reaped by the handler's own teardown."""
+        dead connection is reaped by the handler's own teardown. The send
+        is bounded: a revoked client that stopped reading with a full
+        socket buffer must not wedge the sweeper thread and disable
+        further preemption."""
+        data = json.dumps(obj).encode() + b"\n"
+        dontwait = getattr(socket, "MSG_DONTWAIT", 0)
+        if not dontwait:
+            # No non-blocking send flag on this platform: blocking push
+            # (pre-round-4 behavior; node plugins run on Linux).
+            try:
+                self._send(obj)
+            except OSError:
+                pass
+            return
         try:
-            self._send(obj)
+            with self._wlock:
+                # One non-blocking send: MSG_DONTWAIT leaves the socket's
+                # blocking mode alone, so the handler thread's concurrent
+                # reads are unaffected. A partial write would leave a
+                # truncated frame that corrupts the NEXT reply's framing —
+                # so on partial (or refused) send, shut the connection
+                # down: the handler reaps it, and the revoked client
+                # reconnects into its cooldown, which is the contract
+                # anyway.
+                sent = self.connection.send(data, dontwait)
+                if sent != len(data):
+                    self.connection.shutdown(socket.SHUT_RDWR)
+        except BlockingIOError:
+            try:
+                self.connection.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
         except OSError:
             pass
 
