@@ -45,11 +45,13 @@ def server(tmp_path):
     kc_path = tmp_path / "kubeconfig.yaml"
     env = dict(os.environ)
     # Small event-retention window so the 410 test can age a
-    # resourceVersion out quickly.
+    # resourceVersion out quickly; fast heartbeat so the bookmark test
+    # sees idle-watch progress without multi-second sleeps.
     env["TPU_DRA_FAKE_EVENT_WINDOW"] = "64"
     proc = subprocess.Popen(
         [sys.executable, "-m", "tpu_dra.k8sclient.fakeserver",
-         "--port", "0", "--kubeconfig-out", str(kc_path)],
+         "--port", "0", "--kubeconfig-out", str(kc_path),
+         "--watch-heartbeat", "0.2"],
         cwd=REPO_ROOT,
         env=env,
         stdout=subprocess.DEVNULL,
@@ -170,6 +172,82 @@ def test_429_retry_honors_retry_after(server):
     updated = kc.update(CONFIG_MAPS, obj)
     assert updated["data"] == {"k": "2"}
     assert stats(url)["throttled"] == 3
+
+
+def test_list_paginates_with_limit_and_continue(server):
+    """rest.list issues limit/continue chunked requests (client-go
+    reflector behavior) and reassembles the full set — the informer's
+    relist inherits pagination through this path."""
+    url, kc = server
+    for i in range(12):
+        make_cm(kc, f"cm-page-{i:02d}", {"i": str(i)})
+    kc.LIST_PAGE_SIZE = 5
+    lists_before = stats(url)["lists"]
+    items = kc.list(CONFIG_MAPS, "default")
+    names = [o["metadata"]["name"] for o in items]
+    assert sorted(names) == [f"cm-page-{i:02d}" for i in range(12)]
+    # 12 items at page size 5 = 3 chunked requests.
+    assert stats(url)["lists"] - lists_before == 3
+
+
+def test_expired_continue_token_restarts_pagination(server):
+    """410 on a continue token mid-pagination (etcd compaction between
+    pages): the collected pages are inconsistent, so the client restarts
+    the list from scratch and still returns a complete, duplicate-free
+    set."""
+    url, kc = server
+    for i in range(12):
+        make_cm(kc, f"cm-exp-{i:02d}", {"i": str(i)})
+    kc.LIST_PAGE_SIZE = 5
+    fault(url, {"expireContinue": 1})
+    lists_before = stats(url)["lists"]
+    items = kc.list(CONFIG_MAPS, "default")
+    names = [o["metadata"]["name"] for o in items]
+    assert sorted(names) == [f"cm-exp-{i:02d}" for i in range(12)]
+    assert len(names) == len(set(names)), "restart must not duplicate items"
+    # Page 1, then the expired-continue restart's 3 fresh pages. (The
+    # 410 reply itself is not counted as a served list.)
+    assert stats(url)["lists"] - lists_before == 4
+
+
+def test_watch_bookmarks_keep_idle_resume_point_fresh(server):
+    """Bookmark-only progress: a watch that receives NO object events
+    (all cluster traffic is in another namespace) still advances its
+    resume point via BOOKMARK events, so after a network blip it resumes
+    inside the event window — no 410, no relist — even though its last
+    delivered OBJECT event has long been compacted away."""
+    url, kc = server
+    inf = Informer(kc, CONFIG_MAPS, namespace="default")
+    inf.start()
+    assert inf.wait_for_sync()
+    inf.resync_backoff = 0.1
+
+    make_cm(kc, "cm-bm", {"k": "1"})
+    wait_for(lambda: inf.get("cm-bm", "default"), what="cm-bm in store")
+
+    # Flood ANOTHER namespace: 80 events > the 64-event window, none
+    # delivered to the default-namespace watch.
+    for i in range(40):
+        kc.create(CONFIG_MAPS, {
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": f"noise-{i:04d}", "namespace": "other"},
+        })
+        kc.delete(CONFIG_MAPS, "other", f"noise-{i:04d}")
+
+    # Wait for a bookmark minted AFTER the flood.
+    bm_after_flood = stats(url)["bookmarks"]
+    wait_for(lambda: stats(url)["bookmarks"] > bm_after_flood,
+             timeout=10, what="post-flood bookmark")
+
+    lists_before = stats(url)["lists"]
+    fault(url, {"dropWatches": True})
+    make_cm(kc, "cm-bm2", {"k": "2"})
+    wait_for(lambda: inf.get("cm-bm2", "default"),
+             timeout=10, what="cm-bm2 after bookmark-based resume")
+    assert stats(url)["lists"] == lists_before, (
+        "resume should ride the bookmark resourceVersion, not relist"
+    )
+    inf.stop()
 
 
 def test_conflict_and_crud_over_http(server):

@@ -483,3 +483,36 @@ def test_informer_survives_raising_watch_stream():
         "informer thread died on the raising stream instead of resyncing"
     )
     inf.stop()
+
+
+def test_list_page_chunks_and_expires_tokens(fc, cds, monkeypatch):
+    """FakeCluster.list_page: limit/continue chunking with stable key
+    order, and genuine token expiry — a continue token whose
+    resourceVersion predates the retained event window raises ApiGone
+    (the 410 a real apiserver answers after etcd compaction)."""
+    from tpu_dra.k8sclient import COMPUTE_DOMAINS
+    from tpu_dra.k8sclient.resources import ApiGone
+
+    for i in range(7):
+        cds.create(cd_obj(name=f"cd-{i}"))
+    items, meta = fc.list_page(COMPUTE_DOMAINS, "default", limit=3)
+    assert [o["metadata"]["name"] for o in items] == ["cd-0", "cd-1", "cd-2"]
+    assert meta["continue"]
+    items2, meta2 = fc.list_page(
+        COMPUTE_DOMAINS, "default", limit=3, continue_token=meta["continue"]
+    )
+    assert [o["metadata"]["name"] for o in items2] == ["cd-3", "cd-4", "cd-5"]
+    items3, meta3 = fc.list_page(
+        COMPUTE_DOMAINS, "default", limit=3, continue_token=meta2["continue"]
+    )
+    assert [o["metadata"]["name"] for o in items3] == ["cd-6"]
+    assert "continue" not in meta3
+
+    # Age the first token out of the (shrunken) event window.
+    fc._event_log = type(fc._event_log)(fc._event_log, maxlen=4)
+    for i in range(7):
+        cds.delete(f"cd-{i}", "default")
+    with pytest.raises(ApiGone):
+        fc.list_page(
+            COMPUTE_DOMAINS, "default", limit=3, continue_token=meta["continue"]
+        )

@@ -76,8 +76,10 @@ class FakeApiServer:
 
     def __init__(self, cluster: Optional[FakeCluster] = None,
                  port: int = 0, address: str = "127.0.0.1",
-                 enforce_rbac: bool = False):
+                 enforce_rbac: bool = False,
+                 watch_heartbeat_seconds: float = WATCH_HEARTBEAT_SECONDS):
         self.cluster = cluster or FakeCluster()
+        self._heartbeat = watch_heartbeat_seconds
         # Admission (stored ValidatingWebhookConfigurations + the
         # resourceslices node-restriction policy) is ALWAYS active, like a
         # real apiserver — it simply no-ops until such objects are
@@ -100,7 +102,12 @@ class FakeApiServer:
         self._fault_lock = threading.Lock()
         self._throttle_remaining = 0
         self._throttle_retry_after = 1.0
-        self._stats = {"lists": 0, "watches": 0, "throttled": 0}
+        # expireContinue: next N continue-token list requests answer 410
+        # (etcd-compaction-mid-pagination analog).
+        self._expire_continue = 0
+        self._stats = {
+            "lists": 0, "watches": 0, "throttled": 0, "bookmarks": 0,
+        }
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -247,23 +254,49 @@ class FakeApiServer:
                     labels = _parse_selector(qs, "labelSelector")
                     if watching:
                         rv = qs.get("resourceVersion", [None])[0]
-                        return self._serve_watch(r, labels, rv)
+                        bookmarks = (
+                            qs.get("allowWatchBookmarks", ["false"])[0]
+                            == "true"
+                        )
+                        return self._serve_watch(r, labels, rv, bookmarks)
                     fields = _parse_selector(qs, "fieldSelector")
+                    limit_raw = qs.get("limit", [None])[0]
+                    # limit=0 means "no limit" on a real apiserver.
+                    limit = (int(limit_raw) or None) if limit_raw else None
+                    cont = qs.get("continue", [None])[0]
+                    if cont:
+                        with outer._fault_lock:
+                            if outer._expire_continue > 0:
+                                outer._expire_continue -= 1
+                                expired = True
+                            else:
+                                expired = False
+                        if expired:
+                            return self._reply(410, {
+                                "kind": "Status", "status": "Failure",
+                                "reason": "Expired",
+                                "message": "The provided continue "
+                                "parameter is too old",
+                                "code": 410,
+                            })
                     with outer._fault_lock:
                         outer._stats["lists"] += 1
-                    items = outer.cluster.list(
+                    items, meta = outer.cluster.list_page(
                         r.rd, r.namespace, label_selector=labels,
-                        field_selector=fields,
+                        field_selector=fields, limit=limit,
+                        continue_token=cont,
                     )
                     return self._reply(200, {
                         "kind": f"{r.rd.kind}List",
                         "apiVersion": r.rd.api_version,
+                        "metadata": meta,
                         "items": items,
                     })
                 except Exception as e:
                     return self._error(e)
 
-            def _serve_watch(self, r: _Route, labels, rv=None) -> None:
+            def _serve_watch(self, r: _Route, labels, rv=None,
+                             bookmarks=False) -> None:
                 try:
                     w = outer.cluster.watch(
                         r.rd, r.namespace, label_selector=labels,
@@ -285,14 +318,37 @@ class FakeApiServer:
 
                 try:
                     while True:
-                        item = w.next_event(timeout=WATCH_HEARTBEAT_SECONDS)
+                        item = w.next_event(timeout=outer._heartbeat)
                         if item is None:  # watch closed server-side
                             chunk(b"")
                             break
                         if item is WATCH_TIMEOUT:
-                            # Liveness heartbeat: clients skip blank lines;
-                            # a dead client breaks the pipe here.
-                            chunk(b"\n")
+                            # Liveness heartbeat. With allowWatchBookmarks
+                            # the idle tick carries a BOOKMARK advancing
+                            # the client's resume point (so a quiet or
+                            # tightly-filtered watch doesn't fall out of
+                            # the event window and 410 on reconnect);
+                            # otherwise a blank line clients skip. Either
+                            # way a dead client breaks the pipe here.
+                            bm_rv = (
+                                outer.cluster.bookmark_rv(w)
+                                if bookmarks else None
+                            )
+                            if bm_rv is not None:
+                                with outer._fault_lock:
+                                    outer._stats["bookmarks"] += 1
+                                chunk(json.dumps({
+                                    "type": "BOOKMARK",
+                                    "object": {
+                                        "kind": r.rd.kind,
+                                        "apiVersion": r.rd.api_version,
+                                        "metadata": {
+                                            "resourceVersion": bm_rv,
+                                        },
+                                    },
+                                }).encode() + b"\n")
+                            else:
+                                chunk(b"\n")
                             continue
                         event, obj = item
                         chunk(json.dumps(
@@ -315,6 +371,10 @@ class FakeApiServer:
                             outer._throttle_remaining = int(body["throttle"])
                             outer._throttle_retry_after = float(
                                 body.get("retryAfter", 1.0)
+                            )
+                        if "expireContinue" in body:
+                            outer._expire_continue = int(
+                                body["expireContinue"]
                             )
                     if body.get("dropWatches"):
                         with outer._watch_lock:
@@ -490,10 +550,14 @@ def main(argv=None) -> int:
     p.add_argument("--rbac", action="store_true",
                    help="Evaluate bearer ServiceAccount identities against "
                    "stored ClusterRoles (tokenless requests stay admin)")
+    p.add_argument("--watch-heartbeat", type=float,
+                   default=WATCH_HEARTBEAT_SECONDS,
+                   help="Idle-watch heartbeat/bookmark period in seconds")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     srv = FakeApiServer(
-        port=args.port, address=args.address, enforce_rbac=args.rbac
+        port=args.port, address=args.address, enforce_rbac=args.rbac,
+        watch_heartbeat_seconds=args.watch_heartbeat,
     )
     if args.seed:
         n = srv.cluster.load_dir(args.seed)

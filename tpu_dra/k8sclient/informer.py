@@ -148,6 +148,14 @@ class Informer:
         while not self._stopped.is_set():
             try:
                 for event, obj in self._watch:
+                    if event == "BOOKMARK":
+                        # Progress-only event: advance the resume point,
+                        # store and handlers never see it (client-go
+                        # reflector semantics).
+                        self._advance_rv(
+                            obj.get("metadata", {}).get("resourceVersion")
+                        )
+                        continue
                     if event == "ERROR":
                         log.warning(
                             "watch ERROR event: %s", obj.get("message", obj)
@@ -244,16 +252,21 @@ class Informer:
         except (TypeError, ValueError):
             return None  # opaque RV: no ordering assumption
 
+    def _advance_rv(self, rv) -> None:
+        """Advance the watch resume point to `rv` if it is numerically
+        newer (list/replay application order is name order, not version
+        order), or if the current resume point is absent/unparsable."""
+        if not rv:
+            return
+        cur, new = self._rv_int(self._last_rv), self._rv_int(rv)
+        if cur is None or (new is not None and new > cur):
+            self._last_rv = rv
+
     def _apply(self, event: str, obj: dict, dispatch: bool) -> None:
         md = obj.get("metadata", {})
         key = (md.get("namespace"), md.get("name"))
         rv = md.get("resourceVersion")
-        if rv:
-            # Resume point: numerically newest observed version (list
-            # application order is name order, not version order).
-            cur, new = self._rv_int(self._last_rv), self._rv_int(rv)
-            if cur is None or (new is not None and new > cur):
-                self._last_rv = rv
+        self._advance_rv(rv)
         with self._lock:
             if event == "DELETED":
                 self._store.pop(key, None)

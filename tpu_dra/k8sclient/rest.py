@@ -330,13 +330,43 @@ class KubeClient(Backend):
             self.server + rd.path(namespace, name), timeout=30
         ), idempotent=True))
 
+    # Chunked-list page size (client-go reflector default). Every page is
+    # one GET with limit=<page>&continue=<token>; a real apiserver caps
+    # unpaginated lists' memory amplification this way, and the informer's
+    # relist inherits pagination through here.
+    LIST_PAGE_SIZE = 500
+
     def list(self, rd, namespace=None, label_selector=None, field_selector=None):
-        out = self._check(self._do(lambda: self._session.get(
-            self.server + rd.path(namespace),
-            params=self._selector_params(label_selector, field_selector),
-            timeout=30,
-        ), idempotent=True))
-        return out.get("items", [])
+        base = self._selector_params(label_selector, field_selector)
+        for attempt in (1, 2):
+            items: List[dict] = []
+            cont: Optional[str] = None
+            try:
+                while True:
+                    params = dict(base)
+                    params["limit"] = str(self.LIST_PAGE_SIZE)
+                    if cont:
+                        params["continue"] = cont
+                    out = self._check(self._do(lambda: self._session.get(
+                        self.server + rd.path(namespace),
+                        params=params,
+                        timeout=30,
+                    ), idempotent=True))
+                    items.extend(out.get("items", []))
+                    cont = out.get("metadata", {}).get("continue")
+                    if not cont:
+                        return items
+            except ApiGone:
+                # The continue token expired mid-pagination (etcd
+                # compaction): the collected pages are no longer a
+                # consistent set. Restart the list from scratch once,
+                # like client-go's reflector.
+                if attempt == 2:
+                    raise
+                log.info(
+                    "continue token expired mid-list of %s; restarting "
+                    "pagination", rd.plural,
+                )
 
     def create(self, rd, obj) -> dict:
         ns = obj.get("metadata", {}).get("namespace")
@@ -378,6 +408,10 @@ class KubeClient(Backend):
     ) -> _RestWatch:
         params = self._selector_params(label_selector)
         params["watch"] = "true"
+        # Ask for BOOKMARK progress events: an idle or tightly-filtered
+        # watch still advances its resume point, so reconnecting after a
+        # quiet stretch resumes instead of 410 + full relist.
+        params["allowWatchBookmarks"] = "true"
         if resource_version is not None:
             params["resourceVersion"] = str(resource_version)
         resp = self._do(lambda: self._session.get(
