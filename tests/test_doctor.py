@@ -148,3 +148,96 @@ def test_missing_cdi_root_is_noted_not_created(tmp_path):
     )
     assert not bogus.exists()  # a diagnostic must not mutate the node
     assert any("does not exist" in n for n in report.get("notes", []))
+
+
+def test_metrics_probe_surfaces_failing_informer(tmp_path):
+    """The round-3 incident class, visible in doctor output: a component
+    whose informer cannot reach the apiserver accumulates sync-failure
+    counters on its /metrics; doctor scrapes the endpoint and WARNs."""
+    from tpu_dra.infra.metrics import Metrics, MetricsServer
+    from tpu_dra.k8sclient import Informer
+    from tpu_dra.k8sclient.resources import COMPUTE_DOMAINS
+    from tpu_dra.k8sclient.rest import KubeClient
+
+    metrics = Metrics()
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    # Port 1 is never listening: every initial-sync attempt fails and
+    # increments informer_sync_failures_total (the counter that was
+    # silent in round 3 while four daemons died).
+    kc = KubeClient(server="http://127.0.0.1:1", qps=1000, burst=1000)
+    kc.MAX_CONN_RETRIES = 0
+    inf = Informer(kc, COMPUTE_DOMAINS, metrics=metrics)
+    inf.resync_backoff = 0.02
+    inf.start()
+    try:
+        import time
+
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if "informer_sync_failures_total" in metrics.render():
+                break
+            time.sleep(0.05)
+        endpoint = f"127.0.0.1:{srv.port}"
+        _s, lib = make_state(tmp_path)
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint],
+        )
+        assert any(
+            "informer_sync_failures_total" in w for w in report["warnings"]
+        ), report["warnings"]
+        out = render(report)
+        assert "informer_sync_failures_total" in out
+
+        # Second sample mode: the counter is still climbing (the informer
+        # keeps retrying), so the climb-delta WARN fires too.
+        report2 = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint], metrics_interval=0.3,
+        )
+        assert any("CLIMBED" in w for w in report2["warnings"]), (
+            report2["warnings"]
+        )
+    finally:
+        inf.stop()
+        srv.stop()
+
+
+def test_metrics_probe_quiet_on_stable_counters(tmp_path):
+    """Old nonzero counters from a survived blip: single-sample mode
+    warns (operator should look), but interval mode stays quiet when
+    nothing is climbing — and an unreachable endpoint warns."""
+    from tpu_dra.infra.metrics import Metrics, MetricsServer
+
+    metrics = Metrics()
+    metrics.inc("informer_sync_failures_total",
+                labels={"informer": "computedomains"})
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        endpoint = f"127.0.0.1:{srv.port}"
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint],
+        )
+        assert any("failing to sync" in w for w in report["warnings"])
+        report2 = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint], metrics_interval=0.1,
+        )
+        assert report2["warnings"] == [], report2["warnings"]
+
+        report3 = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=["127.0.0.1:1"],
+        )
+        assert any("did not answer" in w for w in report3["warnings"])
+    finally:
+        srv.stop()

@@ -14,7 +14,14 @@ own and cross-checks them:
   checkpoint (an orphan spec means an unprepare crashed before spec
   removal);
 - **arbiters**: every per-claim sharing daemon socket, probed live
-  (holder, queue depth, revocations).
+  (holder, queue depth, revocations);
+- **component metrics** (``--metrics-endpoint host:port``, repeatable):
+  scrapes a component's ``/metrics`` and WARNs on the failure-class
+  counters of the round-3 incident — informer sync/watch failures,
+  handler errors, workqueue failures and retry drops. With
+  ``--metrics-interval S`` it samples twice and warns only on counters
+  that CLIMBED in the window (a healthy component can carry old
+  nonzero counts from a survived blip).
 
 Exit 0 when healthy; 1 when any WARN was printed (probe-friendly).
 
@@ -30,7 +37,7 @@ import json
 import os
 import socket
 import sys
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from tpu_dra.plugin.checkpoint import (
     CLAIM_STATE_PREPARE_COMPLETED,
@@ -42,11 +49,113 @@ from tpu_dra.plugin.multiplexd import SOCKET_NAME
 from tpu_dra.tpulib import new_tpulib
 
 
+# Failure-class counters (metric name prefixes, label sets vary) that a
+# healthy steady-state component should not be accumulating. These are
+# exactly the signals of the round-3 multi-slice incident: the informer
+# silently failing to sync/watch, handlers throwing, and the workqueue
+# shedding retries.
+FAILURE_COUNTER_PREFIXES = (
+    "tpu_dra_informer_sync_failures_total",
+    "tpu_dra_informer_watch_failures_total",
+    "tpu_dra_informer_handler_errors_total",
+    "tpu_dra_workqueue_failures_total",
+    "tpu_dra_workqueue_retry_drops_total",
+)
+
+
+def _scrape(endpoint: str, timeout: float = 2.0) -> Dict[str, float]:
+    """Fetch and parse a Prometheus text endpoint into
+    ``{"name{labels}": value}`` for counters/gauges (summaries included,
+    harmless)."""
+    import urllib.request
+
+    url = endpoint
+    if not url.startswith(("http://", "https://")):
+        url = f"http://{url}"
+    if not url.endswith("/metrics"):
+        url = url.rstrip("/") + "/metrics"
+    out: Dict[str, float] = {}
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        for line in r.read().decode().splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            series, _, value = line.rpartition(" ")
+            try:
+                out[series] = float(value)
+            except ValueError:
+                continue
+    return out
+
+
+def probe_metrics(
+    endpoints: List[str], interval: float = 0.0, warn=None
+) -> Dict[str, dict]:
+    """Scrape each component endpoint; with ``interval`` > 0 sample twice
+    around ONE shared sleep (N endpoints cost ~interval, not N*interval,
+    and the climb deltas cover comparable windows). Calls ``warn(msg)``
+    for every failure-class series that is nonzero (single sample) or
+    climbing (two samples). A scrape failure — connection, malformed
+    HTTP, non-HTTP protocol on the port — warns and moves on: the doctor
+    must deliver its other sections on exactly the broken nodes it
+    exists for."""
+    import http.client
+    import time as _time
+
+    scrape_errors = (OSError, ValueError, http.client.HTTPException)
+    warn = warn or (lambda _m: None)
+    report: Dict[str, dict] = {}
+    firsts: Dict[str, Dict[str, float]] = {}
+    for ep in endpoints:
+        try:
+            firsts[ep] = _scrape(ep)
+        except scrape_errors as e:
+            report[ep] = {"error": str(e)}
+            warn(f"metrics endpoint {ep} did not answer: {e}")
+    if interval > 0 and firsts:
+        _time.sleep(interval)
+    for ep, first in firsts.items():
+        second = None
+        if interval > 0:
+            try:
+                second = _scrape(ep)
+            except scrape_errors as e:
+                report[ep] = {"error": f"second sample failed: {e}"}
+                warn(f"metrics endpoint {ep} died mid-probe: {e}")
+                continue
+        failures = {}
+        for series, value in sorted((second or first).items()):
+            if not series.startswith(FAILURE_COUNTER_PREFIXES):
+                continue
+            if second is not None:
+                delta = value - first.get(series, 0.0)
+                failures[series] = {"value": value, "climbed": delta}
+                if delta > 0:
+                    warn(
+                        f"{ep}: {series} CLIMBED by {delta:g} in "
+                        f"{interval:g}s (now {value:g}) — the component "
+                        f"is failing right now; check its logs and the "
+                        f"apiserver connection"
+                    )
+            elif value > 0:
+                failures[series] = {"value": value}
+                warn(
+                    f"{ep}: {series} = {value:g} — the component has "
+                    f"been failing to sync/dispatch; re-run with "
+                    f"--metrics-interval to see whether it is still "
+                    f"climbing"
+                )
+        report[ep] = {"failure_counters": failures}
+    return report
+
+
 def collect(
     plugin_data_dir: str,
     cdi_root: str,
     multiplex_socket_root: str,
     tpulib=None,
+    metrics_endpoints: Optional[List[str]] = None,
+    metrics_interval: float = 0.0,
 ) -> dict:
     """Gather every section; pure data (rendering and exit codes are the
     caller's problem, so tests and future UIs can reuse this)."""
@@ -195,6 +304,12 @@ def collect(
                 warn(f"arbiter socket for claim {claim_uid} did not "
                      f"answer: {e}")
     report["arbiters"] = arbiters
+
+    # --- component metrics ---
+    if metrics_endpoints:
+        report["metrics"] = probe_metrics(
+            metrics_endpoints, interval=metrics_interval, warn=warn
+        )
     return report
 
 
@@ -227,6 +342,19 @@ def render(report: dict) -> str:
     lines.append(f"arbiters   : {len(report['arbiters'])} live")
     for uid, st in report["arbiters"].items():
         lines.append(f"  {uid}: {st}")
+    for ep, m in report.get("metrics", {}).items():
+        if "error" in m:
+            lines.append(f"metrics    : {ep} UNREACHABLE ({m['error']})")
+            continue
+        n = len(m.get("failure_counters", {}))
+        lines.append(
+            f"metrics    : {ep} ({n} failure-class series present)"
+        )
+        for series, st in m.get("failure_counters", {}).items():
+            climbed = (
+                f" (climbed {st['climbed']:g})" if "climbed" in st else ""
+            )
+            lines.append(f"  {series} = {st['value']:g}{climbed}")
     for note in report.get("notes", []):
         lines.append(f"note: {note}")
     for w in report["warnings"]:
@@ -253,10 +381,23 @@ def main(argv=None) -> int:
             "TPU_MULTIPLEX_SOCKET_ROOT", "/run/tpu-multiplex"
         ),
     )
+    p.add_argument(
+        "--metrics-endpoint", action="append", default=[],
+        dest="metrics_endpoints", metavar="HOST:PORT",
+        help="Component /metrics endpoint to scrape for failure-class "
+        "counters (repeatable)",
+    )
+    p.add_argument(
+        "--metrics-interval", type=float, default=0.0,
+        help="Sample each metrics endpoint twice, this many seconds "
+        "apart, and warn only on counters that climbed in the window",
+    )
     p.add_argument("--json", action="store_true", dest="as_json")
     args = p.parse_args(argv)
     report = collect(
-        args.plugin_data_dir, args.cdi_root, args.multiplex_socket_root
+        args.plugin_data_dir, args.cdi_root, args.multiplex_socket_root,
+        metrics_endpoints=args.metrics_endpoints,
+        metrics_interval=args.metrics_interval,
     )
     if args.as_json:
         print(json.dumps(report, indent=2))
