@@ -94,6 +94,12 @@ resource.k8s.io/v1beta1
 {{- end }}
 {{- end }}
 
+{{/* Bare DRA version (v1beta1/v1beta2/v1) for the plugin's
+RESOURCE_API_VERSION env: split vs combined slice publishing. */}}
+{{- define "tpu-dra-driver.resourceApiVersionShort" -}}
+{{- include "tpu-dra-driver.resourceApiVersion" . | trim | replace "resource.k8s.io/" "" -}}
+{{- end }}
+
 {{/* featureGates map rendered as the CLI/env string "A=true,B=false". */}}
 {{- define "tpu-dra-driver.featureGatesString" -}}
 {{- $pairs := list }}

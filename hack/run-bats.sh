@@ -1,0 +1,36 @@
+#!/bin/bash
+# Execute the bats e2e suites against a minicluster (kind analog).
+# Usage: hack/run-bats.sh [--log PATH] [suite.bats ...]
+set -euo pipefail
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+LOG=""
+SUITES=()
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    --log) LOG="$2"; shift 2;;
+    *) SUITES+=("$1"); shift;;
+  esac
+done
+[[ ${#SUITES[@]} -gt 0 ]] || SUITES=("${REPO_ROOT}/tests/bats")
+
+command -v g++ >/dev/null && make -C "${REPO_ROOT}/native" >/dev/null
+
+BASE="$(mktemp -d /tmp/tpu-dra-minicluster.XXXXXX)"
+export MINICLUSTER_DIR="$BASE"
+export KUBECONFIG="$BASE/kubeconfig.yaml"
+export TEST_EXPECT_GENERATION=v5p  # minicluster nodes are a v5p slice
+export PATH="${REPO_ROOT}/hack/bats-shims:$PATH"
+export PYTHONPATH="${REPO_ROOT}${PYTHONPATH:+:$PYTHONPATH}"
+
+python -m tpu_dra.minicluster --base-dir "$BASE" >"$BASE/minicluster.log" 2>&1 &
+MC_PID=$!
+trap 'kill "$MC_PID" 2>/dev/null; wait "$MC_PID" 2>/dev/null || true' EXIT
+for _ in $(seq 1 100); do
+  grep -q "minicluster ready" "$BASE/minicluster.log" 2>/dev/null && break
+  kill -0 "$MC_PID" 2>/dev/null || { cat "$BASE/minicluster.log"; exit 1; }
+  sleep 0.2
+done
+
+ARGS=(--workdir "$BASE/batsrun")
+[[ -n "$LOG" ]] && ARGS+=(--log "$LOG")
+python -m tpu_dra.minicluster.batsrun "${ARGS[@]}" "${SUITES[@]}"

@@ -57,8 +57,10 @@ bats::on_failure() {
   attrs="$(get_device_attrs_from_any_tpu_slice tpu.google.com)"
   assert_attr_equal "$attrs" type tpu
   # Generation comes from the stub inventory on the kind path
-  # (demo/clusters/kind/stub-config.yaml).
-  [[ "${TEST_STUB_BACKEND}" != "1" ]] || assert_attr_equal "$attrs" generation v5e
+  # (demo/clusters/kind/stub-config.yaml: v5e; the minicluster runner
+  # provisions a 2-host v5p slice and exports the expectation).
+  [[ "${TEST_STUB_BACKEND}" != "1" ]] || \
+    assert_attr_equal "$attrs" generation "${TEST_EXPECT_GENERATION:-v5e}"
   echo "$attrs" | grep -q '^uuid '
   echo "$attrs" | grep -q '^topologyCoord '
 }

@@ -71,8 +71,13 @@ bats::on_failure() {
 @test "subslice: overlapping second claim is refused while the first is held" {
   # The RCT-generated claim from tpu-test5 stays ALLOCATED after its pod
   # succeeds (released only on pod deletion); the scheduler must refuse a
-  # 2x2 claim whose placement consumes the same chip counters.
-  k_apply "${REPO_ROOT}/tests/bats/specs/tpu-subslice-overlap.yaml"
+  # 2x2 claim whose placement consumes the same chip counters ON THE SAME
+  # HOST — pin the racing pod to the node the first sub-slice landed on.
+  local node
+  node="$(kubectl -n tpu-test5 get pod pod -o jsonpath='{.spec.nodeName}')"
+  [ -n "$node" ]
+  sed "s|OVERLAP_TARGET_NODE|$node|" \
+    "${REPO_ROOT}/tests/bats/specs/tpu-subslice-overlap.yaml" | k_apply /dev/stdin
   run kubectl -n tpu-test5 wait --for=jsonpath='{.status.phase}'=Succeeded \
     pod/overlap-pod --timeout=30s
   [ "$status" -ne 0 ]

@@ -1,0 +1,180 @@
+"""jq-subset interpreter: every jq pipeline the bats suites use, plus
+failure-mode checks (out-of-subset must raise, not mis-evaluate)."""
+
+import pytest
+
+from tpu_dra.minicluster.jqmini import JqError, evaluate
+
+
+SLICES = {
+    "items": [
+        {"spec": {"driver": "tpu.google.com", "nodeName": "n0",
+                  "sharedCounters": [{"name": "chips"}],
+                  "devices": [
+                      {"basic": {
+                          "attributes": {
+                              "type": {"string": "chip"},
+                              "uuid": {"string": "u0"},
+                          },
+                      }},
+                      {"basic": {
+                          "attributes": {
+                              "type": {"string": "subslice-1x2"},
+                              "subsliceShape": {"string": "1x2"},
+                              "subsliceOrigin": {"string": "0,0"},
+                          },
+                          "consumesCounters": [
+                              {"counterSet": "chips"},
+                          ],
+                      }},
+                  ]}},
+        {"spec": {"driver": "other.example.com", "nodeName": "n1",
+                  "devices": []}},
+        {"spec": {"driver": "tpu.google.com", "nodeName": "n1",
+                  "devices": []}},
+    ]
+}
+
+
+def test_select_eq_collect_length():
+    assert evaluate(
+        '[.items[] | select(.spec.driver == "tpu.google.com")] | length',
+        SLICES,
+    ) == [2]
+
+
+def test_select_test_regex():
+    assert evaluate(
+        '[.items[] | select(.spec.driver | test("tpu.google.com"))] | length',
+        SLICES,
+    ) == [2]
+
+
+def test_arg_variable_unique_nodes():
+    out = evaluate(
+        '[.items[] | select(.spec.driver == $d) | .spec.nodeName]'
+        ' | unique | length',
+        SLICES, {"d": "tpu.google.com"},
+    )
+    assert out == [2]
+
+
+def test_index_after_collect_and_basic_fallback():
+    out = evaluate(
+        '([.items[] | select(.spec.driver == $d)][0].spec.devices[0]'
+        ' | .basic // .).attributes'
+        ' | to_entries[] | "\\(.key) \\(.value | to_entries[0].value)"',
+        SLICES, {"d": "tpu.google.com"},
+    )
+    assert "type chip" in out and "uuid u0" in out
+
+
+def test_recursive_descent_optional_empty():
+    doc = {"a": {"deep": {"domainID": "uid-1"}}, "b": 3}
+    assert evaluate(".. | .domainID? // empty", doc) == ["uid-1"]
+    assert evaluate(".. | .missing? // empty", doc) == []
+
+
+def test_keys_select_startswith():
+    doc = {"items": [
+        {"metadata": {"labels": {
+            "resource.tpu.google.com/computeDomain.abc": "x",
+            "other": "y"}}},
+        {"metadata": {"labels": {"plain": "z"}}},
+    ]}
+    out = evaluate(
+        '[.items[].metadata.labels | keys[]'
+        ' | select(startswith("resource.tpu.google.com/computeDomain"))]'
+        ' | length', doc)
+    assert out == [1]
+
+
+def test_allocation_device_picks():
+    doc = {"items": [
+        {"status": {"allocation": {"devices": {"results": [
+            {"device": "tpu-0"}]}}}},
+        {"status": {}},
+        {"status": {"allocation": {"devices": {"results": [
+            {"device": "tpu-3"}]}}}},
+    ]}
+    expr = ('[.items[] | select(.status.allocation != null)'
+            ' | .status.allocation.devices.results[0].device]')
+    assert evaluate(expr + " | .[0]", doc) == ["tpu-0"]
+    assert evaluate(expr + " | .[1]", doc) == ["tpu-3"]
+
+
+def test_and_with_length_guard():
+    doc = {"items": [
+        {"status": {"allocation": {}, "reservedFor": [{"name": "p"}]}},
+        {"status": {"allocation": {}, "reservedFor": []}},
+        {"status": {}},
+    ]}
+    out = evaluate(
+        '[.items[] | select(.status.allocation != null'
+        ' and .status.reservedFor != null'
+        ' and (.status.reservedFor | length) > 0)] | length', doc)
+    assert out == [1]
+
+
+def test_shared_counters_alt_iterate():
+    out = evaluate(
+        '[.items[] | select(.spec.driver == "tpu.google.com")'
+        ' | .spec.sharedCounters // [] | .[]] | length', SLICES)
+    assert out == [1]
+
+
+def test_consumes_counters_chain():
+    out = evaluate(
+        '[.items[] | select(.spec.driver == "tpu.google.com")'
+        ' | .spec.devices[] | (.basic // .)'
+        ' | select(.consumesCounters != null)'
+        ' | .consumesCounters[].counterSet] | unique | length', SLICES)
+    assert out == [1]
+
+
+def test_attributes_startswith_then_keys():
+    out = evaluate(
+        '[.items[] | select(.spec.driver == "tpu.google.com")'
+        ' | .spec.devices[] | (.basic // .)'
+        ' | select(.attributes.type.string | startswith("subslice"))][0]'
+        '.attributes | keys[]', SLICES)
+    assert "subsliceShape" in out and "subsliceOrigin" in out
+
+
+def test_has_and():
+    assert evaluate('has("v1") and has("v2")', {"v1": 1, "v2": 2}) == [True]
+    assert evaluate('has("v1") and has("v2")', {"v1": 1}) == [False]
+
+
+def test_name_startswith_filter():
+    doc = {"items": [
+        {"metadata": {"name": "pod-claim-1"}},
+        {"metadata": {"name": "standalone"}},
+    ]}
+    out = evaluate(
+        '[.items[] | select(.metadata.name | startswith("pod-"))] | length',
+        doc)
+    assert out == [1]
+
+
+def test_out_of_subset_is_loud():
+    with pytest.raises(JqError):
+        evaluate(".items | map(.name)", {"items": []})
+    with pytest.raises(JqError):
+        evaluate("reduce .[] as $x (0; . + $x)", [1, 2])
+    with pytest.raises(JqError):
+        evaluate(".a[1:3]", {"a": [1, 2, 3]})
+
+
+def test_cli_roundtrip(capsys, monkeypatch):
+    import io
+    import sys
+
+    from tpu_dra.minicluster import jqmini
+
+    monkeypatch.setattr(
+        sys, "stdin", io.StringIO('{"items": [{"a": 1}, {"a": 2}]}')
+    )
+    rc = jqmini.main(["-r", "[.items[] | .a] | length"])
+    assert rc == 0
+    assert capsys.readouterr().out.strip() == "2"

@@ -25,7 +25,9 @@ from tpu_dra.k8sclient.resources import (  # noqa: F401
     DEPLOYMENTS,
     DEVICE_CLASSES,
     EVENTS,
+    JOBS,
     LEASES,
+    NAMESPACES,
     NODES,
     PODS,
     RESOURCE_CLAIM_TEMPLATES,

@@ -51,6 +51,10 @@ def _registry() -> Dict[Tuple[str, str, str], ResourceDescriptor]:
     return {(d.group, d.version, d.plural): d for d in iter_descriptors()}
 
 
+class _BadBody(Exception):
+    """Body failed to parse; the 400 reply has already been sent."""
+
+
 class _Route:
     def __init__(self, rd: ResourceDescriptor, namespace: Optional[str],
                  name: Optional[str], status: bool):
@@ -129,6 +133,15 @@ class FakeApiServer:
                 ns = None
                 if len(rest) >= 2 and rest[0] == "namespaces":
                     ns, rest = rest[1], rest[2:]
+                    if not rest:
+                        # /api/v1/namespaces/<name>: the Namespace OBJECT
+                        # itself, not a namespace-scoped collection.
+                        ns_rd = outer._registry.get(
+                            (group, version, "namespaces")
+                        )
+                        if ns_rd is None:
+                            return None
+                        return _Route(ns_rd, None, ns, False)
                 if not rest:
                     return None
                 plural, rest = rest[0], rest[1:]
@@ -207,6 +220,22 @@ class FakeApiServer:
                         "code": e.status,
                     })
                     return False
+
+            def _body_or_400(self):
+                """Drain + parse the request body up front. Raises after
+                replying 400 on malformed JSON — draining must happen
+                before ANY early error reply (unread bytes would parse as
+                the next request on this keep-alive connection), and a
+                bad body must keep its error-reply path."""
+                try:
+                    return self._body()
+                except (ValueError, UnicodeDecodeError) as e:
+                    self._reply(400, {
+                        "kind": "Status", "status": "Failure",
+                        "message": f"invalid request body: {e}",
+                        "code": 400,
+                    })
+                    raise _BadBody()
 
             def _maybe_throttle(self) -> bool:
                 with outer._fault_lock:
@@ -383,13 +412,16 @@ class FakeApiServer:
                     return self._reply(200, {"status": "Success"})
                 if self._maybe_throttle():
                     return None
+                try:
+                    obj = self._body_or_400()
+                except _BadBody:
+                    return None
                 r = self._route()
                 if r is None:
                     return self._reply(404, {"message": "no such route"})
                 if not self._authorize(r, "create"):
                     return None
                 try:
-                    obj = self._body()
                     if r.rd.namespaced and r.namespace:
                         obj.setdefault("metadata", {}).setdefault(
                             "namespace", r.namespace
@@ -403,13 +435,16 @@ class FakeApiServer:
             def do_PUT(self):  # noqa: N802
                 if self._maybe_throttle():
                     return None
+                try:
+                    obj = self._body_or_400()
+                except _BadBody:
+                    return None
                 r = self._route()
                 if r is None or not r.name:
                     return self._reply(404, {"message": "no such route"})
                 if not self._authorize(r, "update"):
                     return None
                 try:
-                    obj = self._body()
                     # Status subresource writes aren't in the webhook's
                     # rules (resources: [resourceclaims], not .../status)
                     # — same as a real apiserver.
@@ -427,13 +462,16 @@ class FakeApiServer:
             def do_PATCH(self):  # noqa: N802
                 if self._maybe_throttle():
                     return None
+                try:
+                    body = self._body_or_400()
+                except _BadBody:
+                    return None
                 r = self._route()
                 if r is None or not r.name:
                     return self._reply(404, {"message": "no such route"})
                 if not self._authorize(r, "patch"):
                     return None
                 try:
-                    body = self._body()
                     ident = parse_bearer(self.headers.get("Authorization"))
 
                     def admit(merged):

@@ -65,6 +65,9 @@ class ResourceDescriptor:
 
 # Core + app resources the driver touches.
 PODS = ResourceDescriptor("", "v1", "pods", "Pod")
+NAMESPACES = ResourceDescriptor("", "v1", "namespaces", "Namespace",
+                                namespaced=False)
+JOBS = ResourceDescriptor("batch", "v1", "jobs", "Job")
 # Scheduler "unschedulable" surface (kube-scheduler records pod events;
 # our claim-driven allocator records claim events the same way).
 EVENTS = ResourceDescriptor("", "v1", "events", "Event")
@@ -86,6 +89,29 @@ RESOURCE_SLICES = ResourceDescriptor(
 )
 DEVICE_CLASSES = ResourceDescriptor(
     "resource.k8s.io", "v1beta1", "deviceclasses", "DeviceClass", namespaced=False
+)
+
+# v1beta2 serving aliases: same kinds, same storage (FakeCluster keys
+# objects by group/plural, not version), additionally routed at
+# resource.k8s.io/v1beta2 — the version that carries KEP-4815 combined
+# partitionable slices. A real apiserver serves DRA at several versions
+# over one store the same way; the driver's combined-slice publishing
+# path (plugin/driver.py v1beta2 mode) and the bats suites' version
+# detection (tests/bats/setup_suite.bash) need the newer GV present.
+RESOURCE_CLAIMS_V1BETA2 = ResourceDescriptor(
+    "resource.k8s.io", "v1beta2", "resourceclaims", "ResourceClaim"
+)
+RESOURCE_CLAIM_TEMPLATES_V1BETA2 = ResourceDescriptor(
+    "resource.k8s.io", "v1beta2", "resourceclaimtemplates",
+    "ResourceClaimTemplate"
+)
+RESOURCE_SLICES_V1BETA2 = ResourceDescriptor(
+    "resource.k8s.io", "v1beta2", "resourceslices", "ResourceSlice",
+    namespaced=False
+)
+DEVICE_CLASSES_V1BETA2 = ResourceDescriptor(
+    "resource.k8s.io", "v1beta2", "deviceclasses", "DeviceClass",
+    namespaced=False
 )
 
 # Cluster-scoped install surface (chart-applied objects the batsless

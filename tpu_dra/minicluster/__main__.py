@@ -1,0 +1,3 @@
+from tpu_dra.minicluster.main import main
+
+raise SystemExit(main())

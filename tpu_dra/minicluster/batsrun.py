@@ -1,0 +1,209 @@
+"""Minimal bats-compatible runner: executes .bats files with bash.
+
+No bats binary ships in this image, so this runner gives the bats suites
+(tests/bats/) the harness surface they use — ``@test`` blocks, ``run``
+(populating ``$status``/``$output``/``$lines``), ``skip``, ``load``,
+``setup_suite``/``setup_file``/``setup``/``teardown_file``, the fd-3 log
+stream, and the repo's ``bats::on_failure`` diagnostic hook — and runs
+each file as one bash process emitting TAP.
+
+Semantics per test (bats-core behavior): setup + body run in a subshell
+with ``set -e``; nonzero exit fails the test, exit 200 (the ``skip``
+sentinel) skips it. setup_file/teardown_file run once in the file's main
+shell so their exports reach every test. Per-test output is captured to
+a log and dumped (indented, TAP-comment style) on failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+TEST_RE = re.compile(r'^@test\s+"(.+)"\s*\{\s*$')
+
+PRELUDE = r"""
+exec 3>>"$__BATS_FILE_LOG"
+run() {
+  local _ec=0
+  output="$("$@" 2>&1)" || _ec=$?
+  status=$_ec
+  mapfile -t lines <<<"$output"
+  return 0
+}
+load() {
+  local f="$(dirname "$BATS_TEST_FILENAME")/$1"
+  [[ -f "$f" ]] || f="$f.bash"
+  source "$f"
+}
+skip() { echo "__BATS_SKIP__:${1:-skipped}"; exit 200; }
+"""
+
+
+def transform(path: Path) -> Tuple[str, List[str]]:
+    """Rewrite @test blocks to numbered functions; returns (bash, names)."""
+    names: List[str] = []
+    out: List[str] = []
+    for line in path.read_text().splitlines():
+        m = TEST_RE.match(line)
+        if m:
+            names.append(m.group(1))
+            out.append(f"bats_test_{len(names) - 1}() {{")
+        else:
+            out.append(line)
+    return "\n".join(out) + "\n", names
+
+
+def build_script(path: Path, log_dir: Path) -> Tuple[str, List[str]]:
+    body, names = transform(path)
+    file_log = log_dir / f"{path.stem}.file.log"
+    suite = path.parent / "setup_suite.bash"
+    lines = [
+        "#!/bin/bash",
+        f'BATS_TEST_FILENAME="{path.resolve()}"',
+        f'__BATS_FILE_LOG="{file_log}"',
+        "export BATS_TEST_FILENAME",
+        PRELUDE,
+        body,
+    ]
+    if suite.exists():
+        lines += [
+            f'source "{suite}"',
+            'if ! setup_suite >>"$__BATS_FILE_LOG" 2>&1; then',
+            '  echo "__BATS_SUITE_FAIL__"; exit 70; fi',
+        ]
+    lines += [
+        "_FILE_SKIP=''",
+        "if declare -F setup_file >/dev/null; then",
+        "  skip() { _FILE_SKIP=\"${1:-skipped}\"; }",
+        '  setup_file >>"$__BATS_FILE_LOG" 2>&1 || '
+        'echo "__BATS_SETUP_FILE_FAIL__"',
+        "  skip() { echo \"__BATS_SKIP__:${1:-skipped}\"; exit 200; }",
+        "fi",
+    ]
+    for i, name in enumerate(names):
+        tlog = log_dir / f"{path.stem}.{i}.log"
+        esc = name.replace('"', '\\"')
+        lines += [
+            f'if [[ -n "$_FILE_SKIP" ]]; then',
+            f'  echo "__BATS_RESULT__:{i}:skip:$_FILE_SKIP"',
+            "else",
+            f'  ( exec >"{tlog}" 2>&1 3>&1; set -e; '
+            f"declare -F setup >/dev/null && setup; bats_test_{i} )",
+            "  _rc=$?",
+            f'  if [[ $_rc -eq 0 ]]; then echo "__BATS_RESULT__:{i}:ok:"',
+            f'  elif [[ $_rc -eq 200 ]]; then '
+            f'echo "__BATS_RESULT__:{i}:skip:$(grep -o '
+            f"'__BATS_SKIP__:.*' \"{tlog}\" | head -1 | cut -d: -f2-)\"",
+            "  else",
+            f'    echo "__BATS_RESULT__:{i}:fail:rc=$_rc"',
+            "    if declare -F bats::on_failure >/dev/null; then",
+            f'      ( exec >>"{tlog}" 2>&1 3>&1; bats::on_failure ) || true',
+            "    fi",
+            "  fi",
+            "fi",
+        ]
+    lines += [
+        "if declare -F teardown_file >/dev/null; then",
+        '  teardown_file >>"$__BATS_FILE_LOG" 2>&1 || true',
+        "fi",
+    ]
+    return "\n".join(lines) + "\n", names
+
+
+def run_file(path: Path, log_dir: Path, out, timeout: float) -> dict:
+    script, names = build_script(path, log_dir)
+    script_path = log_dir / f"{path.stem}.generated.sh"
+    script_path.write_text(script)
+    counts = {"ok": 0, "fail": 0, "skip": 0, "names": names}
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            ["bash", str(script_path)], capture_output=True, text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        out(f"# {path.name}: TIMED OUT after {timeout:.0f}s")
+        counts["fail"] = len(names)
+        for i, name in enumerate(names):
+            out(f"not ok - {path.stem}: {name} (file timeout)")
+        return counts
+    results = {}
+    for line in proc.stdout.splitlines():
+        if line.startswith("__BATS_RESULT__:"):
+            _, idx, verdict, detail = line.split(":", 3)
+            results[int(idx)] = (verdict, detail)
+        elif line.startswith("__BATS_SUITE_FAIL__"):
+            out(f"# {path.name}: setup_suite failed")
+        elif line.startswith("__BATS_SETUP_FILE_FAIL__"):
+            out(f"# {path.name}: setup_file failed "
+                f"(see {log_dir / (path.stem + '.file.log')})")
+    for i, name in enumerate(names):
+        verdict, detail = results.get(i, ("fail", "no result (file died)"))
+        label = f"{path.stem}: {name}"
+        if verdict == "ok":
+            counts["ok"] += 1
+            out(f"ok - {label}")
+        elif verdict == "skip":
+            counts["skip"] += 1
+            out(f"ok - {label} # SKIP {detail}")
+        else:
+            counts["fail"] += 1
+            out(f"not ok - {label} ({detail})")
+            tlog = log_dir / f"{path.stem}.{i}.log"
+            if tlog.exists():
+                for ln in tlog.read_text(errors="replace").splitlines()[-40:]:
+                    out(f"#   {ln}")
+    out(
+        f"# {path.name}: {counts['ok']} ok, {counts['fail']} failed, "
+        f"{counts['skip']} skipped in {time.monotonic() - t0:.1f}s"
+    )
+    return counts
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser("tpu-dra-batsrun")
+    p.add_argument("paths", nargs="+")
+    p.add_argument("--log", default="")
+    p.add_argument("--workdir", default="")
+    p.add_argument("--file-timeout", type=float, default=1800.0)
+    args = p.parse_args(argv)
+    files: List[Path] = []
+    for raw in args.paths:
+        path = Path(raw)
+        if path.is_dir():
+            files.extend(sorted(path.glob("*.bats")))
+        else:
+            files.append(path)
+    log_dir = Path(args.workdir or ".batsrun")
+    log_dir.mkdir(parents=True, exist_ok=True)
+    log_f = open(args.log, "w") if args.log else None
+
+    def out(line: str) -> None:
+        print(line, flush=True)
+        if log_f:
+            log_f.write(line + "\n")
+            log_f.flush()
+
+    out(f"TAP version 13")
+    total = {"ok": 0, "fail": 0, "skip": 0}
+    for f in files:
+        c = run_file(f, log_dir, out, args.file_timeout)
+        for k in total:
+            total[k] += c[k]
+    out(
+        f"# TOTAL: {total['ok']} ok, {total['fail']} failed, "
+        f"{total['skip']} skipped across {len(files)} files"
+    )
+    if log_f:
+        log_f.close()
+    return 1 if total["fail"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,1044 @@
+"""MiniCluster: the kind analog for this clusterless environment.
+
+`kind` gives the reference's bats suites a real control plane + kubelets
+in docker containers. This image has no docker/kind/kubectl, so the
+minicluster supplies the same roles around the repo's own fake apiserver,
+letting the bats suites (tests/bats/) EXECUTE verbatim:
+
+- **apiserver**: FakeApiServer over HTTP (admission always on; the
+  production REST transport speaks to it unmodified);
+- **nodes**: N simulated TPU hosts, each a sandbox directory
+  (``<base>/nodes/<n>/rootfs``) with a per-host stub-tpulib inventory —
+  one 2x2x2 v5p slice split across the hosts, 4 chips each;
+- **kubelet**: pods run as real OS processes (podrun.py); DRA claims are
+  resolved from templates, allocated (structured-parameters allocator,
+  node-constrained the way kube-scheduler's DynamicResources plugin
+  allocates), prepared over the node plugin's real gRPC socket, and the
+  CDI env is injected into the right containers;
+- **controller-manager**: DaemonSet/Deployment/Job reconcilers (template
+  hash rollouts, job completion/retry), ownerReference GC, namespace
+  cascade deletion, reservedFor bookkeeping and claim release.
+
+Everything the driver does — registering plugins, publishing slices,
+stamping CD daemonsets, arbitrating shared chips — is the production
+code running as chart-installed pods.
+"""
+
+from __future__ import annotations
+
+import copy
+import hashlib
+import json
+import logging
+import os
+import socket as socketlib
+import threading
+import time
+import uuid as uuidlib
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+import yaml
+
+from tpu_dra.k8sclient.fake import FakeCluster
+from tpu_dra.k8sclient.fakeserver import FakeApiServer
+from tpu_dra.k8sclient.resources import (
+    DAEMON_SETS,
+    DEPLOYMENTS,
+    DEVICE_CLASSES,
+    JOBS,
+    NAMESPACES,
+    NODES,
+    PODS,
+    RESOURCE_CLAIM_TEMPLATES,
+    RESOURCE_CLAIMS,
+    RESOURCE_SLICES,
+    K8sApiError,
+    iter_descriptors,
+)
+from tpu_dra.minicluster.podrun import PodRunner, PodSandbox
+from tpu_dra.scheduler.allocator import Allocator, Unschedulable
+
+log = logging.getLogger(__name__)
+
+TICK_SECONDS = 0.15
+PREPARE_BACKOFF_SECONDS = 2.0
+
+
+def _template_hash(template: dict) -> str:
+    return hashlib.sha256(
+        json.dumps(template, sort_keys=True).encode()
+    ).hexdigest()[:10]
+
+
+def _owner_ref(obj: dict, controller_kind: str) -> dict:
+    return {
+        "apiVersion": obj.get("apiVersion", ""),
+        "kind": controller_kind,
+        "name": obj["metadata"]["name"],
+        "uid": obj["metadata"]["uid"],
+        "controller": True,
+    }
+
+
+def _match_node_selector(selector: Optional[dict], labels: dict) -> bool:
+    if not selector:
+        return True
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+def _socket_connectable(path: Path) -> bool:
+    if not path.exists():
+        return False
+    s = socketlib.socket(socketlib.AF_UNIX, socketlib.SOCK_STREAM)
+    try:
+        s.settimeout(1.0)
+        s.connect(str(path))
+        return True
+    except OSError:
+        return False
+    finally:
+        s.close()
+
+
+class MiniCluster:
+    def __init__(self, base_dir: str, num_nodes: int = 2,
+                 port: int = 0):
+        self.base = Path(base_dir)
+        self.base.mkdir(parents=True, exist_ok=True)
+        self.num_nodes = num_nodes
+        self.srv = FakeApiServer(port=port, watch_heartbeat_seconds=5.0)
+        self.fc: FakeCluster = self.srv.cluster
+        self.kubeconfig = str(self.base / "kubeconfig.yaml")
+        self.node_names = [f"node-{i}" for i in range(num_nodes)]
+        self.node_dirs = {
+            n: self.base / "nodes" / n for n in self.node_names
+        }
+        self.runner = PodRunner(self.base, self.node_dirs, self.kubeconfig)
+        self.sandboxes: Dict[str, PodSandbox] = {}  # pod uid -> sandbox
+        # pod uid -> {claim uid: (namespace, name, driver, node)}
+        self.prepared: Dict[str, Dict[str, Tuple[str, str, str, str]]] = {}
+        self.released: Set[str] = set()  # pod uids already released
+        self.restarts: Dict[str, int] = {}  # pod uid -> container restarts
+        self._reg_misses: Dict[Tuple[str, str], int] = {}
+        self.next_attempt: Dict[str, float] = {}  # pod uid -> backoff
+        self.ns_seen: Set[str] = set()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._rd_by_gvk = {
+            (d.api_version, d.kind): d for d in iter_descriptors()
+        }
+
+    # --- lifecycle ---
+
+    def start(self) -> "MiniCluster":
+        self.srv.start()
+        self.srv.write_kubeconfig(self.kubeconfig)
+        self._make_nodes()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="minicluster"
+        )
+        self._thread.start()
+        log.info(
+            "minicluster up: %s (%d nodes) base=%s",
+            self.srv.server_url, self.num_nodes, self.base,
+        )
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+        for sandbox in self.sandboxes.values():
+            sandbox.kill()
+        self.srv.stop()
+
+    def _make_nodes(self) -> None:
+        for i, name in enumerate(self.node_names):
+            rootfs = self.node_dirs[name] / "rootfs"
+            rootfs.mkdir(parents=True, exist_ok=True)
+            state_dir = rootfs / "var/lib/tpu-dra/stub-state"
+            state_dir.mkdir(parents=True, exist_ok=True)
+            stub = rootfs / "etc/tpu-dra/stub-config.yaml"
+            stub.parent.mkdir(parents=True, exist_ok=True)
+            stub.write_text(yaml.safe_dump({
+                "generation": "v5p",
+                "hostname": name,
+                "state_dir": str(state_dir),
+                "slice": {
+                    "uuid": "feedfeed",
+                    "topology": "2x2x2",
+                    "num_hosts": self.num_nodes,
+                    "worker_id": i,
+                },
+            }))
+            self.fc.create(NODES, {
+                "apiVersion": "v1", "kind": "Node",
+                "metadata": {
+                    "name": name,
+                    "labels": {
+                        "kubernetes.io/hostname": name,
+                        "google.com/tpu.present": "true",
+                    },
+                },
+                "status": {"conditions": [
+                    {"type": "Ready", "status": "True"}
+                ]},
+            })
+
+    # --- store helpers (direct FakeCluster access: the control loops are
+    # part of the cluster, like kube-controller-manager sharing etcd) ---
+
+    def _list(self, rd, namespace=None, label_selector=None):
+        return self.fc.list(rd, namespace, label_selector=label_selector)
+
+    def _try_get(self, rd, namespace, name):
+        try:
+            return self.fc.get(rd, namespace, name)
+        except K8sApiError:
+            return None
+
+    def _delete_quiet(self, rd, namespace, name):
+        try:
+            self.fc.delete(rd, namespace, name)
+        except K8sApiError:
+            pass
+
+    def _update_status_quiet(self, rd, obj):
+        try:
+            obj["metadata"]["resourceVersion"] = None
+            self.fc.update_status(rd, obj)
+        except K8sApiError as e:
+            log.debug("status update failed: %s", e)
+
+    # --- main loop ---
+
+    def _run(self) -> None:
+        while not self._stop.wait(TICK_SECONDS):
+            try:
+                self._gc_namespaces()
+                self._gc_owners()
+                self._gc_resource_slices()
+                self._reconcile_daemonsets()
+                self._reconcile_deployments()
+                self._reconcile_jobs()
+                self._reconcile_pods()
+                self._reconcile_claims()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                log.exception("minicluster reconcile tick failed")
+
+    # --- namespace cascade ---
+
+    def _gc_namespaces(self) -> None:
+        current = {
+            o["metadata"]["name"] for o in self._list(NAMESPACES)
+        }
+        gone = self.ns_seen - current
+        self.ns_seen |= current
+        for ns in gone:
+            for rd in iter_descriptors():
+                if not rd.namespaced:
+                    continue
+                for obj in self._list(rd, ns):
+                    self._delete_quiet(rd, ns, obj["metadata"]["name"])
+            self.ns_seen.discard(ns)
+
+    def _gc_resource_slices(self) -> None:
+        """The real kubelet deletes a driver's ResourceSlices when the
+        plugin deregisters (DRA manager wipe-on-deregistration). Analog:
+        a slice whose driver's registration socket on its node stops
+        ACCEPTING (a dead socket file still exists after SIGKILL) for a
+        few consecutive ticks is stale — e.g. after `helm uninstall`
+        killed the plugin pods. A restarting plugin republishes on
+        startup, so a wipe during its down-window self-heals."""
+        slices = self._list(RESOURCE_SLICES)
+        keys = set()
+        for s in slices:
+            spec = s.get("spec", {})
+            node, driver = spec.get("nodeName"), spec.get("driver", "")
+            if node in self.node_dirs and driver:
+                keys.add((node, driver))
+        dead = set()
+        for key in keys:
+            node, driver = key
+            reg = (
+                self.runner.node_rootfs(node)
+                / "var/lib/kubelet/plugins_registry"
+                / f"{driver}-reg.sock"
+            )
+            if _socket_connectable(reg):
+                self._reg_misses.pop(key, None)
+                continue
+            self._reg_misses[key] = self._reg_misses.get(key, 0) + 1
+            if self._reg_misses[key] >= 5:
+                dead.add(key)
+        for s in slices:
+            spec = s.get("spec", {})
+            if (spec.get("nodeName"), spec.get("driver", "")) in dead:
+                self._delete_quiet(
+                    RESOURCE_SLICES, None, s["metadata"]["name"]
+                )
+
+    # --- ownerReference GC ---
+
+    def _gc_owners(self) -> None:
+        live_uids: Set[str] = set()
+        for rd in iter_descriptors():
+            for obj in self._list(rd):
+                uid = obj.get("metadata", {}).get("uid")
+                if uid:
+                    live_uids.add(uid)
+        for rd in (PODS, RESOURCE_CLAIMS, RESOURCE_CLAIM_TEMPLATES):
+            for obj in self._list(rd):
+                refs = obj["metadata"].get("ownerReferences") or []
+                if refs and all(
+                    r.get("uid") not in live_uids for r in refs
+                ):
+                    self._delete_quiet(
+                        rd, obj["metadata"].get("namespace"),
+                        obj["metadata"]["name"],
+                    )
+
+    # --- workload controllers ---
+
+    def _pods_of(self, owner_uid: str) -> List[dict]:
+        return [
+            p for p in self._list(PODS)
+            if any(
+                r.get("uid") == owner_uid
+                for r in p["metadata"].get("ownerReferences") or []
+            )
+        ]
+
+    def _make_pod(self, namespace: str, name: str, template: dict,
+                  owner: dict, owner_kind: str, node: Optional[str],
+                  extra_labels=None, extra_annotations=None) -> None:
+        spec = copy.deepcopy(template.get("spec", {}))
+        if node:
+            spec["nodeName"] = node
+        md = copy.deepcopy(template.get("metadata", {}))
+        labels = md.get("labels", {}) or {}
+        labels.update(extra_labels or {})
+        annotations = md.get("annotations", {}) or {}
+        annotations.update(extra_annotations or {})
+        try:
+            self.fc.create(PODS, {
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {
+                    "name": name, "namespace": namespace,
+                    "labels": labels, "annotations": annotations,
+                    "ownerReferences": [_owner_ref(owner, owner_kind)],
+                },
+                "spec": spec,
+            })
+        except K8sApiError:
+            pass  # already exists (or racing delete); reconverge next tick
+
+    def _reconcile_daemonsets(self) -> None:
+        nodes = self._list(NODES)
+        for ds in self._list(DAEMON_SETS):
+            template = ds["spec"].get("template", {})
+            thash = _template_hash(template)
+            selector = (
+                template.get("spec", {}).get("nodeSelector")
+            )
+            eligible = [
+                n["metadata"]["name"] for n in nodes
+                if _match_node_selector(
+                    selector, n["metadata"].get("labels", {}) or {}
+                )
+            ]
+            ns = ds["metadata"]["namespace"]
+            existing = {
+                p["spec"].get("nodeName"): p for p in self._pods_of(
+                    ds["metadata"]["uid"]
+                )
+            }
+            ready = 0
+            for node in eligible:
+                pod = existing.get(node)
+                if pod is not None and (
+                    pod["metadata"].get("labels", {}).get(
+                        "minicluster/template-hash"
+                    ) != thash
+                ):
+                    self._delete_quiet(
+                        PODS, ns, pod["metadata"]["name"]
+                    )
+                    pod = None
+                if pod is None:
+                    self._make_pod(
+                        ns, f"{ds['metadata']['name']}-{node}",
+                        template, ds, "DaemonSet", node,
+                        extra_labels={"minicluster/template-hash": thash},
+                    )
+                elif self._pod_ready(pod):
+                    ready += 1
+            for node, pod in existing.items():
+                if node not in eligible:
+                    self._delete_quiet(PODS, ns, pod["metadata"]["name"])
+            ds["status"] = {
+                "desiredNumberScheduled": len(eligible),
+                "currentNumberScheduled": len(eligible),
+                "numberReady": ready,
+                "updatedNumberScheduled": ready,
+                "observedGeneration": ds["metadata"].get("generation", 1),
+            }
+            self._update_status_quiet(DAEMON_SETS, ds)
+
+    def _reconcile_deployments(self) -> None:
+        for deploy in self._list(DEPLOYMENTS):
+            template = deploy["spec"].get("template", {})
+            thash = _template_hash(template)
+            replicas = int(deploy["spec"].get("replicas", 1) or 1)
+            ns = deploy["metadata"]["namespace"]
+            pods = self._pods_of(deploy["metadata"]["uid"])
+            current = [
+                p for p in pods
+                if p["metadata"].get("labels", {}).get(
+                    "minicluster/template-hash"
+                ) == thash
+            ]
+            stale = [p for p in pods if p not in current]
+            for p in stale:
+                self._delete_quiet(PODS, ns, p["metadata"]["name"])
+            node = template.get("spec", {}).get("nodeName") or (
+                self.node_names[0]
+            )
+            while len(current) < replicas:
+                name = (
+                    f"{deploy['metadata']['name']}-{thash[:6]}-"
+                    f"{uuidlib.uuid4().hex[:5]}"
+                )
+                self._make_pod(
+                    ns, name, template, deploy, "Deployment", node,
+                    extra_labels={"minicluster/template-hash": thash},
+                )
+                current.append({"metadata": {"name": name}})
+            ready = sum(
+                1 for p in current
+                if "uid" in p.get("metadata", {}) and self._pod_ready(p)
+            )
+            deploy["status"] = {
+                "observedGeneration": deploy["metadata"].get(
+                    "generation", 1
+                ),
+                "replicas": len(current),
+                "updatedReplicas": len(current),
+                "readyReplicas": ready,
+                "availableReplicas": ready,
+            }
+            self._update_status_quiet(DEPLOYMENTS, deploy)
+
+    def _reconcile_jobs(self) -> None:
+        for job in self._list(JOBS):
+            spec = job.get("spec", {})
+            template = spec.get("template", {})
+            parallelism = int(spec.get("parallelism", 1) or 1)
+            completions = int(spec.get("completions", parallelism) or 1)
+            backoff_limit = int(spec.get("backoffLimit", 6) or 6)
+            ns = job["metadata"]["namespace"]
+            jname = job["metadata"]["name"]
+            pods = self._pods_of(job["metadata"]["uid"])
+            by_index: Dict[int, List[dict]] = {}
+            failed = 0
+            for p in pods:
+                idx = int(p["metadata"].get("annotations", {}).get(
+                    "batch.kubernetes.io/job-completion-index", 0
+                ))
+                by_index.setdefault(idx, []).append(p)
+                if (p.get("status") or {}).get("phase") == "Failed":
+                    failed += 1
+            succeeded = sum(
+                1 for idx, ps in by_index.items()
+                if any(
+                    (p.get("status") or {}).get("phase") == "Succeeded"
+                    for p in ps
+                )
+            )
+            conditions = (job.get("status") or {}).get("conditions", [])
+            complete = any(
+                c.get("type") == "Complete" and c.get("status") == "True"
+                for c in conditions
+            )
+            if succeeded >= completions:
+                if not complete:
+                    job["status"] = {
+                        "succeeded": succeeded, "failed": failed,
+                        "conditions": [{
+                            "type": "Complete", "status": "True",
+                        }],
+                    }
+                    self._update_status_quiet(JOBS, job)
+                continue
+            if failed > backoff_limit:
+                job["status"] = {
+                    "succeeded": succeeded, "failed": failed,
+                    "conditions": [{"type": "Failed", "status": "True"}],
+                }
+                self._update_status_quiet(JOBS, job)
+                continue
+            for idx in range(parallelism):
+                ps = by_index.get(idx, [])
+                if any(
+                    (p.get("status") or {}).get("phase") == "Succeeded"
+                    for p in ps
+                ):
+                    continue
+                live = [
+                    p for p in ps
+                    if (p.get("status") or {}).get("phase")
+                    not in ("Failed",)
+                ]
+                if live:
+                    continue
+                self._make_pod(
+                    ns,
+                    f"{jname}-{idx}-{uuidlib.uuid4().hex[:5]}",
+                    template, job, "Job", None,
+                    extra_labels={"job-name": jname},
+                    extra_annotations={
+                        "batch.kubernetes.io/job-completion-index": str(idx),
+                    },
+                )
+            job["status"] = {
+                "succeeded": succeeded, "failed": failed,
+                "active": max(0, len(pods) - succeeded - failed),
+                "conditions": conditions,
+            }
+            self._update_status_quiet(JOBS, job)
+
+    # --- kubelet + binder ---
+
+    def _pod_ready(self, pod: dict) -> bool:
+        sandbox = self.sandboxes.get(pod["metadata"].get("uid", ""))
+        return sandbox is not None and sandbox.all_ready()
+
+    def _reconcile_pods(self) -> None:
+        pods = self._list(PODS)
+        seen_uids = set()
+        for pod in pods:
+            uid = pod["metadata"]["uid"]
+            seen_uids.add(uid)
+            sandbox = self.sandboxes.get(uid)
+            try:
+                if sandbox is None:
+                    phase = (pod.get("status") or {}).get("phase")
+                    if phase in ("Succeeded", "Failed"):
+                        continue  # terminal before restart? leave it
+                    self._admit_pod(pod)
+                else:
+                    self._sync_pod_status(pod, sandbox)
+            except Exception:  # noqa: BLE001 — one broken pod must not
+                # starve every pod after it in the list (a kubelet
+                # isolates pod sync failures the same way).
+                log.exception(
+                    "pod %s/%s reconcile failed; backing off",
+                    pod["metadata"].get("namespace"),
+                    pod["metadata"]["name"],
+                )
+                self.next_attempt[uid] = (
+                    time.monotonic() + PREPARE_BACKOFF_SECONDS
+                )
+        # Pods whose objects are gone: tear down.
+        for uid in list(self.sandboxes):
+            if uid not in seen_uids:
+                self._teardown_pod(uid)
+
+    def _claims_of(self, pod: dict) -> Optional[List[dict]]:
+        """Resolve (creating from templates as needed) every claim the
+        pod references; None while templates are still missing."""
+        ns = pod["metadata"].get("namespace", "default")
+        statuses = {
+            s["name"]: s.get("resourceClaimName")
+            for s in (pod.get("status") or {}).get(
+                "resourceClaimStatuses", []
+            ) or []
+        }
+        claims = []
+        dirty = False
+        for ref in pod["spec"].get("resourceClaims", []) or []:
+            refname = ref["name"]
+            template_name = (
+                ref.get("resourceClaimTemplateName")
+                or (ref.get("source") or {}).get(
+                    "resourceClaimTemplateName"
+                )
+            )
+            claim_name = ref.get("resourceClaimName") or (
+                ref.get("source") or {}
+            ).get("resourceClaimName")
+            if claim_name:
+                claim = self._try_get(RESOURCE_CLAIMS, ns, claim_name)
+                if claim is None:
+                    return None
+                claims.append(claim)
+                continue
+            if not template_name:
+                continue
+            existing_name = statuses.get(refname)
+            if existing_name:
+                claim = self._try_get(RESOURCE_CLAIMS, ns, existing_name)
+                if claim is not None:
+                    claims.append(claim)
+                    continue
+            template = self._try_get(
+                RESOURCE_CLAIM_TEMPLATES, ns, template_name
+            )
+            if template is None:
+                return None  # e.g. CD channel RCT not stamped yet
+            claim = self.fc.create(RESOURCE_CLAIMS, {
+                "apiVersion": RESOURCE_CLAIMS.api_version,
+                "kind": "ResourceClaim",
+                "metadata": {
+                    "generateName": (
+                        f"{pod['metadata']['name']}-{refname}-"
+                    ),
+                    "namespace": ns,
+                    "ownerReferences": [_owner_ref(pod, "Pod")],
+                    "annotations": {
+                        "resource.kubernetes.io/pod-claim-name": refname,
+                    },
+                },
+                "spec": copy.deepcopy(
+                    template.get("spec", {}).get("spec", {})
+                ),
+            })
+            statuses[refname] = claim["metadata"]["name"]
+            claims.append(claim)
+            dirty = True
+        if dirty:
+            pod.setdefault("status", {})["resourceClaimStatuses"] = [
+                {"name": k, "resourceClaimName": v}
+                for k, v in statuses.items()
+            ]
+            self._update_status_quiet(PODS, pod)
+        return claims
+
+    def _allocate_for_node(self, node: str, pending: List[dict],
+                           classes, slices, allocated) -> Optional[List[dict]]:
+        """Try to allocate all `pending` claims on `node`; returns the
+        allocation dicts (same order) or None."""
+        node_slices = [
+            s for s in slices
+            if s.get("spec", {}).get("nodeName") in (node, None)
+        ]
+        hypothetical = list(allocated)
+        out = []
+        for claim in pending:
+            alloc = Allocator(classes, node_slices, hypothetical)
+            try:
+                result = alloc.allocate(claim)
+            except Unschedulable:
+                return None
+            except Exception as e:  # noqa: BLE001 — allocator bug, not
+                # a full node: surface it instead of retrying forever.
+                log.warning("allocator error for %s: %s",
+                            claim["metadata"]["name"], e)
+                return None
+            out.append(result.allocation)
+            ghost = copy.deepcopy(claim)
+            ghost.setdefault("status", {})["allocation"] = (
+                result.allocation
+            )
+            hypothetical.append(ghost)
+        return out
+
+    def _admit_pod(self, pod: dict) -> None:
+        uid = pod["metadata"]["uid"]
+        now = time.monotonic()
+        if self.next_attempt.get(uid, 0) > now:
+            return
+        ns = pod["metadata"].get("namespace", "default")
+        claims = self._claims_of(pod)
+        if claims is None:
+            self.next_attempt[uid] = now + 1.0
+            return
+        pending = [
+            c for c in claims
+            if not (c.get("status") or {}).get("allocation")
+        ]
+        node = pod["spec"].get("nodeName")
+        if pending:
+            classes = self._list(DEVICE_CLASSES)
+            slices = self._list(RESOURCE_SLICES)
+            allocated = [
+                c for c in self._list(RESOURCE_CLAIMS)
+                if (c.get("status") or {}).get("allocation")
+            ]
+            if node:
+                candidates = [node]
+            else:
+                # Scheduler filter phase: the pod's nodeSelector prunes
+                # candidates before the allocator scores them.
+                selector = pod["spec"].get("nodeSelector")
+                candidates = [
+                    n["metadata"]["name"] for n in self._list(NODES)
+                    if _match_node_selector(
+                        selector, n["metadata"].get("labels", {}) or {}
+                    )
+                ]
+            chosen = None
+            for cand in candidates:
+                allocs = self._allocate_for_node(
+                    cand, pending, classes, slices, allocated
+                )
+                if allocs is not None:
+                    chosen = (cand, allocs)
+                    break
+            if chosen is None:
+                self.next_attempt[uid] = now + 1.0
+                return
+            node, allocs = chosen
+            for claim, alloc in zip(pending, allocs):
+                claim.setdefault("status", {})["allocation"] = alloc
+                self._update_status_quiet(RESOURCE_CLAIMS, claim)
+        if node is None:
+            # No (pending) claims: place on any node passing the selector.
+            selector = pod["spec"].get("nodeSelector")
+            matching = [
+                n["metadata"]["name"] for n in self._list(NODES)
+                if _match_node_selector(
+                    selector, n["metadata"].get("labels", {}) or {}
+                )
+            ]
+            if not matching:
+                self.next_attempt[uid] = now + 1.0
+                return
+            node = matching[0]
+        if pod["spec"].get("nodeName") != node:
+            pod["spec"]["nodeName"] = node
+            pod["metadata"]["resourceVersion"] = None
+            try:
+                self.fc.update(PODS, pod)
+            except K8sApiError:
+                return
+        # Reserve every claim for this pod.
+        for claim in claims:
+            live = self._try_get(
+                RESOURCE_CLAIMS, ns, claim["metadata"]["name"]
+            )
+            if live is None:
+                return
+            reserved = live.setdefault("status", {}).setdefault(
+                "reservedFor", []
+            )
+            if not any(r.get("uid") == uid for r in reserved):
+                reserved.append({
+                    "resource": "pods",
+                    "name": pod["metadata"]["name"],
+                    "uid": uid,
+                })
+                self._update_status_quiet(RESOURCE_CLAIMS, live)
+        self._prepare_and_launch(pod, node)
+
+    def _prepare_and_launch(self, pod: dict, node: str) -> None:
+        uid = pod["metadata"]["uid"]
+        ns = pod["metadata"].get("namespace", "default")
+        claims = self._claims_of(pod) or []
+        rootfs = self.runner.node_rootfs(node)
+        prepared_here: Dict[str, Tuple[str, str, str, str]] = {}
+        cdi_env_by_claim_ref: Dict[str, Dict[str, str]] = {}
+        ref_by_claim_name = {}
+        for ref in pod["spec"].get("resourceClaims", []) or []:
+            refname = ref["name"]
+            claim_name = ref.get("resourceClaimName") or (
+                ref.get("source") or {}
+            ).get("resourceClaimName")
+            if claim_name:
+                ref_by_claim_name[claim_name] = refname
+        statuses = {
+            s.get("resourceClaimName"): s["name"]
+            for s in (pod.get("status") or {}).get(
+                "resourceClaimStatuses", []
+            ) or []
+        }
+        ref_by_claim_name.update(statuses)
+        try:
+            for claim in claims:
+                alloc = (claim.get("status") or {}).get("allocation") or {}
+                results = (alloc.get("devices") or {}).get("results", [])
+                drivers = sorted({
+                    r.get("driver", "") for r in results if r.get("driver")
+                })
+                env: Dict[str, str] = {}
+                for driver in drivers:
+                    sock = (
+                        rootfs / "var/lib/kubelet/plugins" / driver
+                        / "dra.sock"
+                    )
+                    if not _socket_connectable(sock):
+                        raise RuntimeError(
+                            f"plugin socket for {driver} not up on {node}"
+                        )
+                    self._grpc_prepare(sock, claim)
+                    prepared_here[claim["metadata"]["uid"]] = (
+                        ns, claim["metadata"]["name"], driver, node,
+                    )
+                env.update(self._cdi_env(
+                    rootfs, claim["metadata"]["uid"]
+                ))
+                refname = ref_by_claim_name.get(
+                    claim["metadata"]["name"], claim["metadata"]["name"]
+                )
+                cdi_env_by_claim_ref[refname] = env
+        except Exception as e:  # noqa: BLE001 — prepare failures retry
+            log.info(
+                "pod %s/%s prepare: %s (will retry)",
+                ns, pod["metadata"]["name"], e,
+            )
+            # Claims prepared before the failure stay prepared (prepare
+            # is idempotent); the retry reuses them.
+            self.prepared.setdefault(uid, {}).update(prepared_here)
+            self.next_attempt[uid] = (
+                time.monotonic() + PREPARE_BACKOFF_SECONDS
+            )
+            return
+        self.prepared.setdefault(uid, {}).update(prepared_here)
+
+        # Per-container env: only the claims the container asks for.
+        by_container: Dict[str, Dict[str, str]] = {}
+        for c in pod["spec"].get("containers", []) or []:
+            env: Dict[str, str] = {}
+            for cl in (c.get("resources") or {}).get("claims", []) or []:
+                env.update(cdi_env_by_claim_ref.get(cl.get("name"), {}))
+            by_container[c["name"]] = env
+        extra = {
+            "TPU_DRA_MULTIPLEX_SOCKET_ROOT": str(
+                rootfs / "run/tpu-multiplex"
+            ),
+        }
+        idx = (pod["metadata"].get("annotations") or {}).get(
+            "batch.kubernetes.io/job-completion-index"
+        )
+        if idx is not None:
+            extra["JOB_COMPLETION_INDEX"] = str(idx)
+        pod["status"] = {
+            **(pod.get("status") or {}),
+            "phase": "Pending", "podIP": "127.0.0.1",
+        }
+        self._update_status_quiet(PODS, pod)
+        sandbox = self.runner.launch(
+            pod, extra_env=extra, extra_env_by_container=by_container
+        )
+        if sandbox.init_failed:
+            log.warning(
+                "pod %s/%s init: %s", ns, pod["metadata"]["name"],
+                sandbox.init_failed,
+            )
+            self.next_attempt[uid] = (
+                time.monotonic() + PREPARE_BACKOFF_SECONDS
+            )
+            return
+        self.sandboxes[uid] = sandbox
+        self.next_attempt.pop(uid, None)
+
+    def _grpc_prepare(self, sock: Path, claim: dict) -> None:
+        import grpc
+
+        from tpu_dra.plugin.dra_service import DRA_SERVICE_NAME
+        from tpu_dra.plugin.pb import dra_v1beta1_pb2 as drapb
+
+        req = drapb.NodePrepareResourcesRequest()
+        req.claims.append(drapb.Claim(
+            uid=claim["metadata"]["uid"],
+            name=claim["metadata"]["name"],
+            namespace=claim["metadata"]["namespace"],
+        ))
+        with grpc.insecure_channel(f"unix://{sock}") as ch:
+            resp = ch.unary_unary(
+                f"/{DRA_SERVICE_NAME}/NodePrepareResources",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=(
+                    drapb.NodePrepareResourcesResponse.FromString
+                ),
+            )(req, timeout=30)
+        result = resp.claims[claim["metadata"]["uid"]]
+        if result.error:
+            raise RuntimeError(result.error)
+
+    def _grpc_unprepare(self, sock: Path, cns: str, cname: str,
+                        cuid: str) -> None:
+        import grpc
+
+        from tpu_dra.plugin.dra_service import DRA_SERVICE_NAME
+        from tpu_dra.plugin.pb import dra_v1beta1_pb2 as drapb
+
+        req = drapb.NodeUnprepareResourcesRequest()
+        req.claims.append(drapb.Claim(
+            uid=cuid, name=cname, namespace=cns,
+        ))
+        with grpc.insecure_channel(f"unix://{sock}") as ch:
+            resp = ch.unary_unary(
+                f"/{DRA_SERVICE_NAME}/NodeUnprepareResources",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=(
+                    drapb.NodeUnprepareResourcesResponse.FromString
+                ),
+            )(req, timeout=30)
+        result = resp.claims[cuid]
+        if result.error:
+            raise RuntimeError(result.error)
+
+    @staticmethod
+    def _cdi_env(rootfs: Path, claim_uid: str) -> Dict[str, str]:
+        """Env from the claim's CDI spec containerEdits. Containers get
+        CDI mounts for free; host processes can't mount, so env values
+        that point INTO a CDI mount's containerPath are rewritten to the
+        mount's hostPath (which the plugin already wrote node-sandbox-
+        absolute)."""
+        env: Dict[str, str] = {}
+        mounts: Dict[str, str] = {}  # containerPath -> hostPath
+        cdi_dir = rootfs / "var/run/cdi"
+        if not cdi_dir.is_dir():
+            return env
+        for f in cdi_dir.glob("*.json"):
+            if claim_uid not in f.name:
+                continue
+            spec = json.loads(f.read_text())
+            for d in spec.get("devices", []):
+                edits = d.get("containerEdits") or {}
+                for m in edits.get("mounts", []) or []:
+                    cp = (m.get("containerPath") or "").rstrip("/")
+                    if cp and m.get("hostPath"):
+                        mounts[cp] = m["hostPath"]
+                for kv in edits.get("env", []):
+                    k, _, v = kv.partition("=")
+                    env[k] = v
+        for k, v in env.items():
+            for cp in sorted(mounts, key=len, reverse=True):
+                if v == cp or v.startswith(cp + "/"):
+                    env[k] = mounts[cp] + v[len(cp):]
+                    break
+        return env
+
+    def _sync_pod_status(self, pod: dict, sandbox: PodSandbox) -> None:
+        restart_policy = pod["spec"].get("restartPolicy", "Always")
+        phase = sandbox.phase(restart_policy)
+        prev = (pod.get("status") or {}).get("phase")
+        uid = pod["metadata"]["uid"]
+        if phase in ("Succeeded", "Failed") and (
+            restart_policy == "Always"
+            or (restart_policy == "OnFailure" and phase == "Failed")
+        ):
+            # Service pods (DS/Deployment) restart in place, like a
+            # kubelet restarting a crashed container: same pod object,
+            # bumped restartCount, exponential-ish backoff. Claims stay
+            # prepared — re-admission re-prepares idempotently.
+            sandbox.kill()
+            self.sandboxes.pop(uid, None)
+            n = self.restarts.get(uid, 0) + 1
+            self.restarts[uid] = n
+            self.next_attempt[uid] = time.monotonic() + min(5.0, 0.5 * n)
+            status = pod.setdefault("status", {})
+            status["phase"] = "Running"
+            status["conditions"] = [
+                {"type": "Ready", "status": "False"},
+                {"type": "ContainersReady", "status": "False"},
+            ]
+            self._update_status_quiet(PODS, pod)
+            return
+        ready = sandbox.all_ready()
+        status = pod.setdefault("status", {})
+        status["phase"] = phase
+        status["podIP"] = "127.0.0.1"
+        status["conditions"] = [
+            {"type": "Ready", "status": "True" if ready else "False"},
+            {"type": "ContainersReady",
+             "status": "True" if ready else "False"},
+        ]
+        status["containerStatuses"] = [
+            {
+                "name": c.name,
+                "ready": c.ready(),
+                "restartCount": self.restarts.get(uid, 0),
+                "state": (
+                    {"running": {}} if c.alive() else {
+                        "terminated": {"exitCode": c.proc.returncode}
+                    }
+                ),
+            }
+            for c in sandbox.containers
+        ]
+        self._update_status_quiet(PODS, pod)
+        if phase in ("Succeeded", "Failed") and prev not in (
+            "Succeeded", "Failed"
+        ):
+            self._release_pod_claims(pod["metadata"]["uid"], delete=False)
+
+    def _teardown_pod(self, uid: str) -> None:
+        sandbox = self.sandboxes.pop(uid, None)
+        if sandbox is not None:
+            sandbox.kill()
+        self._release_pod_claims(uid, delete=True)
+        self.next_attempt.pop(uid, None)
+        self.released.discard(uid)
+
+    def _release_pod_claims(self, uid: str, delete: bool) -> None:
+        """Pod done (terminal or deleted): unprepare what this pod held
+        (when no other live pod still reserves it), drop the reservedFor
+        entry, and deallocate standalone claims left unreserved. Claims
+        created from templates are ownerRef'd to the pod — the owner GC
+        deletes them on pod deletion, releasing their devices."""
+        if not delete and uid in self.released:
+            return
+        self.released.add(uid)
+        held = self.prepared.pop(uid, {})
+        for cuid, (cns, cname, driver, node) in held.items():
+            claim = self._try_get(RESOURCE_CLAIMS, cns, cname)
+            if claim is not None:
+                reserved = (claim.get("status") or {}).get(
+                    "reservedFor", []
+                ) or []
+                reserved = [r for r in reserved if r.get("uid") != uid]
+                others_live = any(
+                    r.get("uid") in self.sandboxes
+                    and r.get("uid") not in self.released
+                    for r in reserved
+                )
+                claim.setdefault("status", {})["reservedFor"] = reserved
+                owned_by_pod = any(
+                    (ref.get("kind") == "Pod")
+                    for ref in claim["metadata"].get(
+                        "ownerReferences"
+                    ) or []
+                )
+                if not reserved and not owned_by_pod:
+                    # Standalone claim, no consumers left: deallocate
+                    # (frees devices/counters for the next pod).
+                    claim["status"].pop("allocation", None)
+                self._update_status_quiet(RESOURCE_CLAIMS, claim)
+                if others_live:
+                    continue  # shared claim still in use: stay prepared
+            sock = (
+                self.runner.node_rootfs(node)
+                / "var/lib/kubelet/plugins" / driver / "dra.sock"
+            )
+            try:
+                self._grpc_unprepare(sock, cns, cname, cuid)
+            except Exception as e:  # noqa: BLE001
+                log.info("unprepare %s/%s: %s", cns, cname, e)
+
+    def _reconcile_claims(self) -> None:
+        """reservedFor hygiene: drop entries for pods that no longer
+        exist (force-deleted mid-flight), deallocating standalone claims
+        that end up unreserved."""
+        pod_uids = {
+            p["metadata"]["uid"] for p in self._list(PODS)
+        }
+        for claim in self._list(RESOURCE_CLAIMS):
+            status = claim.get("status") or {}
+            reserved = status.get("reservedFor") or []
+            if not reserved:
+                continue
+            keep = [r for r in reserved if r.get("uid") in pod_uids]
+            if len(keep) == len(reserved):
+                continue
+            claim["status"]["reservedFor"] = keep
+            owned_by_pod = any(
+                ref.get("kind") == "Pod"
+                for ref in claim["metadata"].get("ownerReferences") or []
+            )
+            if not keep and not owned_by_pod and status.get("allocation"):
+                claim["status"].pop("allocation", None)
+            self._update_status_quiet(RESOURCE_CLAIMS, claim)

@@ -1,0 +1,208 @@
+"""helm shim: upgrade --install / uninstall over the fakeserver.
+
+Renders with tpu_dra.infra.minihelm (no helm binary in this image) and
+applies the manifests through the production REST transport. Release
+state (the rendered object list) is recorded in a ConfigMap in the
+release namespace — the role helm's release Secrets play — so uninstall
+deletes exactly what the release installed and an upgrade prunes objects
+that fell out of the render.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import List, Optional
+
+from tpu_dra.infra.minihelm import parse_set, render_chart
+from tpu_dra.k8sclient.resources import (
+    CONFIG_MAPS,
+    ApiNotFound,
+    K8sApiError,
+    iter_descriptors,
+)
+from tpu_dra.k8sclient.rest import KubeClient
+
+
+def _release_cm(release: str) -> str:
+    return f"helm-release-{release}"
+
+
+def _apply(kc, rd, doc) -> None:
+    md = doc.setdefault("metadata", {})
+    try:
+        kc.create(rd, doc)
+        return
+    except K8sApiError as e:
+        if getattr(e, "status", None) != 409:
+            raise
+    # Update with CAS retry: controllers (status writers) race the
+    # upgrade, bumping resourceVersion between our GET and PUT.
+    for attempt in range(8):
+        existing = kc.get(
+            rd, md.get("namespace") if rd.namespaced else None, md["name"]
+        )
+        doc["metadata"]["resourceVersion"] = existing["metadata"][
+            "resourceVersion"
+        ]
+        try:
+            kc.update(rd, doc)
+            return
+        except K8sApiError as e:
+            if getattr(e, "status", None) != 409 or attempt == 7:
+                raise
+
+
+def upgrade(release: str, chart: str, namespace: str,
+            sets: List[str]) -> int:
+    kc = KubeClient.from_config(qps=1000, burst=1000)
+    docs = render_chart(
+        chart,
+        values_overrides=[parse_set(s) for s in sets],
+        release_name=release,
+        namespace=namespace,
+        # Capabilities from the live registry (helm asks the apiserver the
+        # same question), so the chart's resourceApiVersion auto-detect
+        # picks the newest DRA version this cluster serves.
+        api_versions=sorted({d.api_version for d in iter_descriptors()}),
+    )
+    by_gvk = {(d.api_version, d.kind): d for d in iter_descriptors()}
+    # Namespace first (helm --create-namespace).
+    from tpu_dra.k8sclient.resources import NAMESPACES
+
+    try:
+        kc.create(NAMESPACES, {
+            "apiVersion": "v1", "kind": "Namespace",
+            "metadata": {"name": namespace},
+        })
+    except K8sApiError:
+        pass
+    applied = []
+    skipped = []
+    for doc in docs:
+        rd = by_gvk.get((doc.get("apiVersion", ""), doc.get("kind", "")))
+        if rd is None:
+            skipped.append(
+                f"{doc.get('apiVersion')}/{doc.get('kind')}"
+            )
+            continue
+        if rd.namespaced:
+            doc.setdefault("metadata", {}).setdefault(
+                "namespace", namespace
+            )
+        _apply(kc, rd, doc)
+        applied.append([
+            rd.group, rd.version, rd.plural,
+            doc["metadata"].get("namespace"), doc["metadata"]["name"],
+        ])
+    # Prune objects from the previous revision that this render dropped.
+    # Keys omit the VERSION: storage is per group/plural, so the same
+    # object re-applied at a newer DRA version must not be pruned via
+    # its old version's entry.
+    prev = _load_manifest(kc, namespace, release)
+
+    def prune_key(e):
+        return (e[0], e[2], e[3], e[4])
+
+    cur_keys = {prune_key(a) for a in applied}
+    for entry in prev:
+        if prune_key(entry) in cur_keys:
+            continue
+        rd = next(
+            (d for d in iter_descriptors()
+             if [d.group, d.version, d.plural] == entry[:3]),
+            None,
+        )
+        if rd is not None:
+            try:
+                kc.delete(rd, entry[3] if rd.namespaced else None, entry[4])
+            except K8sApiError:
+                pass
+    cm = {
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": _release_cm(release), "namespace": namespace},
+        "data": {"manifest": json.dumps(applied)},
+    }
+    _apply(kc, CONFIG_MAPS, cm)
+    if skipped:
+        print(
+            f"note: kinds not served by this cluster: {sorted(set(skipped))}",
+            file=sys.stderr,
+        )
+    print(f'Release "{release}" has been upgraded. ({len(applied)} objects)')
+    return 0
+
+
+def _load_manifest(kc, namespace: str, release: str) -> List[list]:
+    try:
+        cm = kc.get(CONFIG_MAPS, namespace, _release_cm(release))
+        return json.loads(cm.get("data", {}).get("manifest", "[]"))
+    except (ApiNotFound, ValueError):
+        return []
+
+
+def uninstall(release: str, namespace: str) -> int:
+    kc = KubeClient.from_config(qps=1000, burst=1000)
+    entries = _load_manifest(kc, namespace, release)
+    if not entries:
+        print(f'Error: uninstall: Release not loaded: {release}',
+              file=sys.stderr)
+        return 1
+    for entry in reversed(entries):
+        rd = next(
+            (d for d in iter_descriptors()
+             if [d.group, d.version, d.plural] == entry[:3]),
+            None,
+        )
+        if rd is None:
+            continue
+        try:
+            kc.delete(rd, entry[3] if rd.namespaced else None, entry[4])
+        except K8sApiError:
+            pass
+    try:
+        kc.delete(CONFIG_MAPS, namespace, _release_cm(release))
+    except K8sApiError:
+        pass
+    print(f'release "{release}" uninstalled')
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print("helm shim: missing command", file=sys.stderr)
+        return 1
+    verb = argv[0]
+    positionals = []
+    namespace = "default"
+    sets: List[str] = []
+    i = 1
+    while i < len(argv):
+        a = argv[i]
+        if a in ("--namespace", "-n"):
+            namespace = argv[i + 1]
+            i += 1
+        elif a == "--set":
+            sets.append(argv[i + 1])
+            i += 1
+        elif a.startswith("--set="):
+            sets.append(a.split("=", 1)[1])
+        elif a in ("--install", "--create-namespace", "--wait"):
+            pass
+        else:
+            positionals.append(a)
+        i += 1
+    if verb == "upgrade":
+        if len(positionals) < 2:
+            print("helm shim: upgrade RELEASE CHART", file=sys.stderr)
+            return 1
+        return upgrade(positionals[0], positionals[1], namespace, sets)
+    if verb == "uninstall":
+        return uninstall(positionals[0], namespace)
+    print(f"helm shim: unsupported command {verb}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,565 @@
+"""jq-subset interpreter for the bats e2e suites.
+
+This image ships no ``jq`` binary, and the bats suites (tests/bats/,
+mirroring /root/reference/tests/bats helpers.sh jq pipelines) lean on it
+for every JSON assertion. Rather than rewriting the suites, this module
+evaluates the jq dialect they actually use, so the suites execute
+verbatim through the ``jq`` shim in hack/bats-shims/.
+
+Supported (the suites' working set — see tests/test_jqmini.py):
+  pipes ``a | b``; identity ``.``; field access ``.a.b``, optional
+  ``.a?``; iteration ``.[]`` and ``.items[]``; indexing ``.[0]``;
+  slices of nothing else; array construction ``[ ... ]``; parens;
+  recursive descent ``..``; alternative ``//``; ``and`` / ``or``;
+  comparisons ``==`` ``!=`` ``>`` ``<`` ``>=`` ``<=``; literals
+  (numbers, strings, null, true, false, ``[]``); string interpolation
+  ``"\\(expr)"``; variables ``$name`` (from ``--arg``); functions:
+  ``select/1 length unique keys to_entries empty has/1 startswith/1
+  endswith/1 test/1 not``; comma sequences inside ``[...]`` are not
+  needed and unsupported.
+
+Anything outside the subset raises :class:`JqError` — a loud failure,
+never a silently-wrong answer.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Iterator, List, Optional, Tuple
+
+
+class JqError(ValueError):
+    pass
+
+
+# --- tokenizer ---
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+)
+  | (?P<number>-?\d+(\.\d+)?)
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+  | (?P<var>\$[A-Za-z_][A-Za-z0-9_]*)
+  | (?P<dotdot>\.\.)
+  | (?P<field>\.[A-Za-z_][A-Za-z0-9_]*)
+  | (?P<dot>\.)
+  | (?P<op>==|!=|>=|<=|//|[|()\[\],?><])
+    """,
+    re.VERBOSE,
+)
+
+
+def _tokenize(src: str) -> List[Tuple[str, str]]:
+    out: List[Tuple[str, str]] = []
+    i = 0
+    while i < len(src):
+        if src[i] == '"':
+            j, parts = _scan_string(src, i)
+            out.append(("string", src[i:j]))
+            i = j
+            continue
+        m = _TOKEN_RE.match(src, i)
+        if not m:
+            raise JqError(f"jq: cannot tokenize at {src[i:i+20]!r}")
+        i = m.end()
+        kind = m.lastgroup
+        if kind == "ws":
+            continue
+        out.append((kind, m.group()))
+    return out
+
+
+def _scan_string(src: str, start: int) -> Tuple[int, None]:
+    """Find the end of a double-quoted string starting at `start`,
+    honoring backslash escapes and \\( ... ) interpolations."""
+    i = start + 1
+    while i < len(src):
+        c = src[i]
+        if c == "\\":
+            if src[i + 1] == "(":
+                depth = 1
+                i += 2
+                while i < len(src) and depth:
+                    if src[i] == "(":
+                        depth += 1
+                    elif src[i] == ")":
+                        depth -= 1
+                    i += 1
+                continue
+            i += 2
+            continue
+        if c == '"':
+            return i + 1, None
+        i += 1
+    raise JqError("jq: unterminated string")
+
+
+# --- parser: produces a small AST of tuples ---
+# ("pipe", left, right)  ("field", name, optional)  ("iterate",)
+# ("index", n)  ("identity",)  ("recurse",)  ("collect", expr)
+# ("alt", a, b)  ("and", a, b)  ("or", a, b)  ("cmp", op, a, b)
+# ("lit", value)  ("str", [parts])  ("var", name)
+# ("call", name, [args])  ("chain", head, [postfix...])
+
+
+class _Parser:
+    def __init__(self, tokens: List[Tuple[str, str]]):
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self) -> Optional[Tuple[str, str]]:
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def next(self) -> Tuple[str, str]:
+        t = self.peek()
+        if t is None:
+            raise JqError("jq: unexpected end of expression")
+        self.i += 1
+        return t
+
+    def expect(self, text: str) -> None:
+        t = self.next()
+        if t[1] != text:
+            raise JqError(f"jq: expected {text!r}, got {t[1]!r}")
+
+    def parse(self):
+        e = self.parse_pipe()
+        if self.peek() is not None:
+            raise JqError(f"jq: trailing tokens at {self.peek()[1]!r}")
+        return e
+
+    def parse_pipe(self):
+        left = self.parse_alt()
+        while self.peek() and self.peek()[1] == "|":
+            self.next()
+            right = self.parse_alt()
+            left = ("pipe", left, right)
+        return left
+
+    def parse_alt(self):
+        left = self.parse_logic()
+        while self.peek() and self.peek()[1] == "//":
+            self.next()
+            right = self.parse_logic()
+            left = ("alt", left, right)
+        return left
+
+    def parse_logic(self):
+        left = self.parse_cmp()
+        while self.peek() and self.peek()[0] == "ident" and self.peek()[1] in (
+            "and", "or"
+        ):
+            op = self.next()[1]
+            right = self.parse_cmp()
+            left = (op, left, right)
+        return left
+
+    def parse_cmp(self):
+        left = self.parse_postfix()
+        if self.peek() and self.peek()[1] in ("==", "!=", ">", "<", ">=", "<="):
+            op = self.next()[1]
+            right = self.parse_postfix()
+            return ("cmp", op, left, right)
+        return left
+
+    def parse_postfix(self):
+        head = self.parse_primary()
+        parts = []
+        while True:
+            t = self.peek()
+            if t is None:
+                break
+            if t[0] == "field":
+                self.next()
+                optional = False
+                if self.peek() and self.peek()[1] == "?":
+                    self.next()
+                    optional = True
+                parts.append(("field", t[1][1:], optional))
+            elif t[1] == "[":
+                # .[] or .[N] postfix on the current value
+                self.next()
+                nxt = self.peek()
+                if nxt and nxt[1] == "]":
+                    self.next()
+                    parts.append(("iterate",))
+                elif nxt and nxt[0] == "number":
+                    n = self.next()[1]
+                    self.expect("]")
+                    parts.append(("index", int(n)))
+                else:
+                    raise JqError("jq: unsupported bracket postfix")
+            else:
+                break
+        if not parts:
+            return head
+        return ("chain", head, parts)
+
+    def parse_primary(self):
+        t = self.peek()
+        if t is None:
+            raise JqError("jq: unexpected end")
+        kind, text = t
+        if text == "(":
+            self.next()
+            e = self.parse_pipe()
+            self.expect(")")
+            return e
+        if text == "[":
+            self.next()
+            if self.peek() and self.peek()[1] == "]":
+                self.next()
+                return ("lit", [])
+            e = self.parse_pipe()
+            self.expect("]")
+            return ("collect", e)
+        if kind == "dotdot":
+            self.next()
+            return ("recurse",)
+        if kind == "field":
+            self.next()
+            optional = False
+            if self.peek() and self.peek()[1] == "?":
+                self.next()
+                optional = True
+            return ("chain", ("identity",), [("field", text[1:], optional)])
+        if kind == "dot":
+            self.next()
+            return ("identity",)
+        if kind == "number":
+            self.next()
+            v = float(text)
+            return ("lit", int(v) if v == int(v) else v)
+        if kind == "string":
+            self.next()
+            return _parse_string_literal(text)
+        if kind == "var":
+            self.next()
+            return ("var", text[1:])
+        if kind == "ident":
+            self.next()
+            if text == "null":
+                return ("lit", None)
+            if text == "true":
+                return ("lit", True)
+            if text == "false":
+                return ("lit", False)
+            if text == "empty":
+                return ("call", "empty", [])
+            args = []
+            if self.peek() and self.peek()[1] == "(":
+                self.next()
+                args.append(self.parse_pipe())
+                while self.peek() and self.peek()[1] == ",":
+                    self.next()
+                    args.append(self.parse_pipe())
+                self.expect(")")
+            return ("call", text, args)
+        raise JqError(f"jq: unsupported token {text!r}")
+
+
+def _parse_string_literal(raw: str):
+    """Parse '"...\\(expr)..."' into ("str", [literal-or-AST parts])."""
+    body = raw[1:-1]
+    parts: List[Any] = []
+    buf = ""
+    i = 0
+    while i < len(body):
+        c = body[i]
+        if c == "\\":
+            nxt = body[i + 1]
+            if nxt == "(":
+                depth = 1
+                j = i + 2
+                while j < len(body) and depth:
+                    if body[j] == "(":
+                        depth += 1
+                    elif body[j] == ")":
+                        depth -= 1
+                    j += 1
+                if buf:
+                    parts.append(buf)
+                    buf = ""
+                inner = body[i + 2:j - 1]
+                parts.append(_Parser(_tokenize(inner)).parse())
+                i = j
+                continue
+            buf += json.loads(f'"\\{nxt}"')
+            i += 2
+            continue
+        buf += c
+        i += 1
+    if buf or not parts:
+        parts.append(buf)
+    if len(parts) == 1 and isinstance(parts[0], str):
+        return ("lit", parts[0])
+    return ("str", parts)
+
+
+# --- evaluator: every node yields a stream of values ---
+
+
+def _recurse(v) -> Iterator[Any]:
+    yield v
+    if isinstance(v, dict):
+        for x in v.values():
+            yield from _recurse(x)
+    elif isinstance(v, list):
+        for x in v:
+            yield from _recurse(x)
+
+
+def _truthy(v) -> bool:
+    return v is not None and v is not False
+
+
+class _Env:
+    def __init__(self, variables):
+        self.vars = variables or {}
+
+
+def _eval(node, v, env: _Env) -> Iterator[Any]:
+    kind = node[0]
+    if kind == "identity":
+        yield v
+    elif kind == "lit":
+        yield node[1]
+    elif kind == "var":
+        if node[1] not in env.vars:
+            raise JqError(f"jq: undefined variable ${node[1]}")
+        yield env.vars[node[1]]
+    elif kind == "pipe":
+        for mid in _eval(node[1], v, env):
+            yield from _eval(node[2], mid, env)
+    elif kind == "chain":
+        streams = _eval(node[1], v, env)
+        for base in streams:
+            yield from _eval_postfix(node[2], 0, base, env)
+    elif kind == "collect":
+        yield list(_eval(node[1], v, env))
+    elif kind == "recurse":
+        yield from _recurse(v)
+    elif kind == "alt":
+        got = []
+        try:
+            got = [x for x in _eval(node[1], v, env) if _truthy(x)]
+        except JqError:
+            raise
+        except Exception:  # noqa: BLE001 — jq // swallows errors
+            got = []
+        if got:
+            yield from got
+        else:
+            yield from _eval(node[2], v, env)
+    elif kind in ("and", "or"):
+        for a in _eval(node[1], v, env):
+            for b in _eval(node[2], v, env):
+                yield (_truthy(a) and _truthy(b)) if kind == "and" else (
+                    _truthy(a) or _truthy(b)
+                )
+    elif kind == "cmp":
+        op = node[1]
+        for a in _eval(node[2], v, env):
+            for b in _eval(node[3], v, env):
+                yield _compare(op, a, b)
+    elif kind == "str":
+        out = ""
+        for part in node[1]:
+            if isinstance(part, str):
+                out += part
+            else:
+                vals = list(_eval(part, v, env))
+                if len(vals) != 1:
+                    raise JqError("jq: interpolation must yield one value")
+                x = vals[0]
+                out += x if isinstance(x, str) else json.dumps(x)
+        yield out
+    elif kind == "call":
+        yield from _call(node[1], node[2], v, env)
+    else:
+        raise JqError(f"jq: unhandled node {kind}")
+
+
+def _eval_postfix(parts, i, v, env) -> Iterator[Any]:
+    if i == len(parts):
+        yield v
+        return
+    p = parts[i]
+    if p[0] == "field":
+        _, name, optional = p
+        if v is None:
+            yield from _eval_postfix(parts, i + 1, None, env)
+            return
+        if not isinstance(v, dict):
+            if optional:
+                return
+            raise JqError(
+                f"jq: cannot index {type(v).__name__} with .{name}"
+            )
+        yield from _eval_postfix(parts, i + 1, v.get(name), env)
+    elif p[0] == "iterate":
+        if v is None:
+            return
+        if isinstance(v, dict):
+            items = list(v.values())
+        elif isinstance(v, list):
+            items = v
+        else:
+            raise JqError(f"jq: cannot iterate {type(v).__name__}")
+        for x in items:
+            yield from _eval_postfix(parts, i + 1, x, env)
+    elif p[0] == "index":
+        if v is None:
+            yield from _eval_postfix(parts, i + 1, None, env)
+            return
+        if not isinstance(v, list):
+            raise JqError(f"jq: cannot index {type(v).__name__}")
+        n = p[1]
+        x = v[n] if -len(v) <= n < len(v) else None
+        yield from _eval_postfix(parts, i + 1, x, env)
+    else:
+        raise JqError(f"jq: unhandled postfix {p[0]}")
+
+
+def _compare(op, a, b):
+    if op == "==":
+        return a == b
+    if op == "!=":
+        return a != b
+    try:
+        if op == ">":
+            return a > b
+        if op == "<":
+            return a < b
+        if op == ">=":
+            return a >= b
+        if op == "<=":
+            return a <= b
+    except TypeError:
+        raise JqError(f"jq: cannot compare {a!r} {op} {b!r}")
+    raise JqError(f"jq: unknown comparison {op}")
+
+
+def _call(name, args, v, env) -> Iterator[Any]:
+    if name == "empty":
+        return
+    if name == "select":
+        for cond in _eval(args[0], v, env):
+            if _truthy(cond):
+                yield v
+        return
+    if name == "length":
+        if v is None:
+            yield 0
+        elif isinstance(v, (list, dict, str)):
+            yield len(v)
+        else:
+            raise JqError(f"jq: {type(v).__name__} has no length")
+        return
+    if name == "unique":
+        if not isinstance(v, list):
+            raise JqError("jq: unique input must be an array")
+        seen = []
+        for x in sorted(v, key=lambda x: json.dumps(x, sort_keys=True)):
+            if not seen or seen[-1] != x:
+                seen.append(x)
+        yield seen
+        return
+    if name == "keys":
+        if not isinstance(v, dict):
+            raise JqError("jq: keys input must be an object")
+        yield sorted(v.keys())
+        return
+    if name == "to_entries":
+        if not isinstance(v, dict):
+            raise JqError("jq: to_entries input must be an object")
+        yield [{"key": k, "value": val} for k, val in v.items()]
+        return
+    if name == "not":
+        yield not _truthy(v)
+        return
+    if name == "has":
+        key = _one(args[0], v, env)
+        if isinstance(v, dict):
+            yield key in v
+        elif isinstance(v, list):
+            yield isinstance(key, int) and 0 <= key < len(v)
+        else:
+            raise JqError(f"jq: has() on {type(v).__name__}")
+        return
+    if name in ("startswith", "endswith", "test"):
+        arg = _one(args[0], v, env)
+        if not isinstance(v, str) or not isinstance(arg, str):
+            raise JqError(f"jq: {name}() needs strings")
+        if name == "startswith":
+            yield v.startswith(arg)
+        elif name == "endswith":
+            yield v.endswith(arg)
+        else:
+            yield re.search(arg, v) is not None
+        return
+    raise JqError(f"jq: unsupported function {name}/{len(args)}")
+
+
+def _one(node, v, env):
+    vals = list(_eval(node, v, env))
+    if len(vals) != 1:
+        raise JqError("jq: argument must yield exactly one value")
+    return vals[0]
+
+
+def evaluate(expr: str, value: Any, variables=None) -> List[Any]:
+    """Evaluate `expr` against `value`; returns the output stream."""
+    ast = _Parser(_tokenize(expr)).parse()
+    return list(_eval(ast, value, _Env(variables)))
+
+
+def main(argv=None) -> int:
+    """CLI compatible with the suites' usage: ``jq [-r] [--arg k v] EXPR``
+    reading one JSON document from stdin."""
+    import sys
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    raw_output = False
+    variables = {}
+    expr = None
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "-r":
+            raw_output = True
+        elif a == "--arg":
+            variables[argv[i + 1]] = argv[i + 2]
+            i += 2
+        elif a in ("-c", "--compact-output"):
+            pass
+        elif expr is None:
+            expr = a
+        else:
+            print(f"jq shim: unexpected argument {a!r}", file=sys.stderr)
+            return 2
+        i += 1
+    if expr is None:
+        print("jq shim: missing expression", file=sys.stderr)
+        return 2
+    try:
+        doc = json.load(sys.stdin)
+    except json.JSONDecodeError as e:
+        print(f"jq shim: invalid JSON input: {e}", file=sys.stderr)
+        return 2
+    try:
+        results = evaluate(expr, doc, variables)
+    except JqError as e:
+        print(str(e), file=sys.stderr)
+        return 3
+    for r in results:
+        if raw_output and isinstance(r, str):
+            print(r)
+        else:
+            print(json.dumps(r))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

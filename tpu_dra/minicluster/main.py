@@ -1,0 +1,46 @@
+"""``python -m tpu_dra.minicluster`` — bring up the kind-analog cluster.
+
+Prints one ready line with the base dir and apiserver URL, then serves
+until SIGTERM/SIGINT. hack/run-bats.sh uses this to execute the bats
+suites; ``--nodes`` controls the simulated TPU host count (default 2 =
+one 2x2x2 v5p slice, 4 chips per host).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+from tpu_dra.minicluster.cluster import MiniCluster
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("tpu-dra-minicluster")
+    p.add_argument("--base-dir", required=True)
+    p.add_argument("--nodes", type=int, default=2)
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("-v", "--verbose", action="store_true")
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO
+    )
+    mc = MiniCluster(
+        args.base_dir, num_nodes=args.nodes, port=args.port
+    ).start()
+    print(
+        f"minicluster ready base={mc.base} server={mc.srv.server_url} "
+        f"kubeconfig={mc.kubeconfig}",
+        flush=True,
+    )
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    mc.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

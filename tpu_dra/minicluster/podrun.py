@@ -1,0 +1,422 @@
+"""Pod -> OS process translation for the minicluster.
+
+The minicluster (kind analog for this clusterless environment) runs every
+pod container as a real OS process on this machine. The translation is
+generic over the pod spec — command, args, env (incl. fieldRef downward
+API), hostPath/emptyDir volumes, http/exec probes — with per-image
+*runtime profiles* standing in for container images (the same role kind's
+image side-loading plays):
+
+- the driver image runs repo entrypoints from the repo root (with image
+  filesystem paths like /usr/local/share/tpu-dra/ mapped to hack/, and
+  the ``tpu-multiplex-daemon`` binary to native/build/);
+- the workload image (jax + libtpu in production) runs on this machine's
+  CPU jax with big-model presets substituted for their tiny twins —
+  declared, visible knobs, not silent edits (see PROFILES).
+
+hostPath volumes resolve into the pod's node sandbox
+(``<node_dir>/rootfs/<path>``) unless the path is already inside the
+minicluster base dir (e.g. a Deployment rendered by the plugin whose env
+was itself already sandbox-absolute). Env values under a volumeMount's
+mountPath are rewritten to the resolved host dir, so a process reads and
+writes exactly where a container would have.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket as socketlib
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+from pathlib import Path
+from typing import Dict, List, Optional
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+class Profile:
+    def __init__(self, env=None, arg_subst=None, path_map=None,
+                 cmd_map=None):
+        self.env = env or {}
+        self.arg_subst = arg_subst or {}
+        self.path_map = path_map or {}
+        # Image binary name -> host argv prefix (a container image's
+        # PATH entrypoints don't exist on the host).
+        self.cmd_map = cmd_map or {}
+
+
+PROFILES = {
+    # Driver image: repo entrypoints.
+    "registry.local/tpu-dra-driver": Profile(
+        path_map={
+            "/usr/local/share/tpu-dra/": str(REPO_ROOT / "hack") + "/",
+        },
+        cmd_map={
+            "tpu-multiplex-daemon": [str(
+                REPO_ROOT / "native" / "build" / "tpu-multiplex-daemon"
+            )],
+            "tpu-compute-domain-daemon": [
+                sys.executable, "-m", "tpu_dra.computedomain.daemon.main",
+            ],
+        },
+    ),
+    # Workload image: CPU jax, tiny-model stand-ins for the big presets
+    # (this machine has no multi-host TPU slice; the code path — DRA
+    # claims, CD bootstrap, jax.distributed, the training loop — is the
+    # real one).
+    "registry.local/tpu-workload": Profile(
+        env={"JAX_PLATFORMS": "cpu"},
+        arg_subst={
+            "llama3-8b": "tiny",
+            "mixtral-8x7b": "tiny-moe",
+            "30": "2",  # llama-pjit-job --steps 30 -> 2 (CPU wall time)
+        },
+    ),
+}
+
+
+def profile_for(image: str) -> Profile:
+    name = image.split(":")[0]
+    return PROFILES.get(name, Profile())
+
+
+def resolve_field_ref(path: str, pod: dict) -> str:
+    md = pod.get("metadata", {})
+    if path == "metadata.name":
+        return md.get("name", "")
+    if path == "metadata.namespace":
+        return md.get("namespace", "")
+    if path == "metadata.uid":
+        return md.get("uid", "")
+    if path == "spec.nodeName":
+        return pod.get("spec", {}).get("nodeName", "")
+    if path == "status.podIP":
+        return pod.get("status", {}).get("podIP", "127.0.0.1")
+    return ""
+
+
+class ContainerProc:
+    """One running container: process + log capture + probe state."""
+
+    def __init__(self, name: str, proc: subprocess.Popen, log_path: Path,
+                 ready_check=None):
+        self.name = name
+        self.proc = proc
+        self.log_path = log_path
+        self.ready_check = ready_check  # None = ready when started
+        self.started = time.monotonic()
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def ready(self) -> bool:
+        if not self.alive():
+            return False
+        if self.ready_check is None:
+            return True
+        return self.ready_check()
+
+
+class PodSandbox:
+    """All processes of one pod."""
+
+    def __init__(self, pod: dict):
+        self.uid = pod["metadata"]["uid"]
+        self.namespace = pod["metadata"].get("namespace", "default")
+        self.name = pod["metadata"]["name"]
+        self.containers: List[ContainerProc] = []
+        self.init_failed: Optional[str] = None
+        self.tmp_dirs: List[str] = []
+
+    def all_ready(self) -> bool:
+        return bool(self.containers) and all(
+            c.ready() for c in self.containers
+        )
+
+    def phase(self, restart_policy: str) -> str:
+        """Terminal phase for restartPolicy=Never pods, else Running."""
+        if not self.containers:
+            return "Pending"
+        if any(c.alive() for c in self.containers):
+            return "Running"
+        rcs = [c.proc.returncode for c in self.containers]
+        return "Succeeded" if all(rc == 0 for rc in rcs) else "Failed"
+
+    def kill(self):
+        for c in self.containers:
+            if c.alive():
+                try:
+                    c.proc.terminate()
+                except OSError:
+                    pass
+        deadline = time.monotonic() + 5
+        for c in self.containers:
+            while c.alive() and time.monotonic() < deadline:
+                time.sleep(0.05)
+            if c.alive():
+                try:
+                    c.proc.kill()
+                except OSError:
+                    pass
+            try:
+                c.proc.wait(timeout=5)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def _free_port() -> int:
+    s = socketlib.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class PodRunner:
+    def __init__(self, base_dir: Path, node_dirs: Dict[str, Path],
+                 kubeconfig: str):
+        self.base = Path(base_dir)
+        self.node_dirs = node_dirs
+        self.kubeconfig = kubeconfig
+        self.logs_dir = self.base / "logs"
+        self.logs_dir.mkdir(parents=True, exist_ok=True)
+
+    # --- path plumbing ---
+
+    def node_rootfs(self, node: str) -> Path:
+        return self.node_dirs[node] / "rootfs"
+
+    def resolve_host_path(self, node: str, path: str) -> Path:
+        p = Path(path)
+        if str(p).startswith(str(self.base)):
+            return p  # already sandbox-absolute (plugin-rendered spec)
+        return self.node_rootfs(node) / str(p).lstrip("/")
+
+    def _mounts(self, pod: dict, container: dict, sandbox: PodSandbox):
+        """[(mountPath, resolved_host_dir)] sorted longest-first."""
+        node = pod["spec"].get("nodeName", "")
+        vols = {
+            v["name"]: v for v in pod["spec"].get("volumes", []) or []
+        }
+        out = []
+        for vm in container.get("volumeMounts", []) or []:
+            vol = vols.get(vm["name"])
+            if vol is None:
+                continue
+            if "hostPath" in vol:
+                host = self.resolve_host_path(node, vol["hostPath"]["path"])
+                hp_type = vol["hostPath"].get("type", "")
+                if hp_type == "File":
+                    host.parent.mkdir(parents=True, exist_ok=True)
+                else:
+                    host.mkdir(parents=True, exist_ok=True)
+            elif "emptyDir" in vol:
+                d = tempfile.mkdtemp(prefix=f"empty-{vm['name']}-")
+                sandbox.tmp_dirs.append(d)
+                host = Path(d)
+            else:
+                continue
+            out.append((vm["mountPath"].rstrip("/"), host))
+        out.sort(key=lambda t: -len(t[0]))
+        return out
+
+    def _rewrite(self, value: str, mounts) -> str:
+        for mount_path, host in mounts:
+            if value == mount_path:
+                return str(host)
+            if value.startswith(mount_path + "/"):
+                return str(host) + value[len(mount_path):]
+        return value
+
+    # --- env/argv assembly ---
+
+    def _container_env(self, pod: dict, container: dict, mounts,
+                       profile: Profile, extra_env: Dict[str, str]):
+        env = dict(os.environ)
+        env.pop("PYTEST_CURRENT_TEST", None)
+        env["KUBECONFIG"] = self.kubeconfig
+        env["PYTHONPATH"] = (
+            str(REPO_ROOT) + os.pathsep + env.get("PYTHONPATH", "")
+        )
+        env["PYTHONUNBUFFERED"] = "1"
+        for e in container.get("env", []) or []:
+            name = e.get("name")
+            if "value" in e:
+                env[name] = self._rewrite(str(e["value"]), mounts)
+            elif "valueFrom" in e and "fieldRef" in e["valueFrom"]:
+                env[name] = resolve_field_ref(
+                    e["valueFrom"]["fieldRef"].get("fieldPath", ""), pod
+                )
+        env.update(profile.env)
+        env.update(extra_env)
+        return env
+
+    def _argv(self, container: dict, profile: Profile, mounts) -> List[str]:
+        argv = list(container.get("command", []) or []) + list(
+            container.get("args", []) or []
+        )
+        if argv and argv[0] in profile.cmd_map:
+            argv = list(profile.cmd_map[argv[0]]) + argv[1:]
+        out = []
+        for tok in argv:
+            tok = profile.arg_subst.get(tok, tok)
+            for prefix, repl in profile.path_map.items():
+                if tok == prefix:
+                    tok = repl
+                elif tok.startswith(prefix):
+                    tok = repl + tok[len(prefix):]
+            out.append(self._rewrite(tok, mounts))
+        if out and out[0] == "python":
+            out[0] = sys.executable
+        return out
+
+    # --- probes ---
+
+    def _probe(self, container: dict, env, mounts, port_remap):
+        """Build a ready_check callable from startup/readiness probes."""
+        probe = (
+            container.get("startupProbe")
+            or container.get("readinessProbe")
+            or container.get("livenessProbe")
+        )
+        if not probe:
+            return None
+        if "httpGet" in probe:
+            port = int(probe["httpGet"].get("port", 80))
+            port = port_remap.get(port, port)
+            path = probe["httpGet"].get("path", "/")
+            url = f"http://127.0.0.1:{port}{path}"
+
+            def check_http():
+                try:
+                    with urllib.request.urlopen(url, timeout=2) as r:
+                        return 200 <= r.status < 400
+                except OSError:
+                    return False
+
+            return check_http
+        if "exec" in probe:
+            argv = probe["exec"].get("command", [])
+            profile = Profile()  # exec probes re-resolve through profiles
+            for p in PROFILES.values():
+                profile.path_map.update(p.path_map)
+                profile.cmd_map.update(p.cmd_map)
+            if argv and argv[0] in profile.cmd_map:
+                argv = list(profile.cmd_map[argv[0]]) + argv[1:]
+            resolved = []
+            for tok in argv:
+                for prefix, repl in profile.path_map.items():
+                    if tok == prefix:
+                        tok = repl
+                    elif tok.startswith(prefix):
+                        tok = repl + tok[len(prefix):]
+                resolved.append(self._rewrite(tok, mounts))
+            if resolved and resolved[0] == "python":
+                resolved[0] = sys.executable
+
+            def check_exec():
+                try:
+                    return subprocess.run(
+                        resolved, env=env, capture_output=True, timeout=10
+                    ).returncode == 0
+                except (OSError, subprocess.TimeoutExpired):
+                    return False
+
+            return check_exec
+        return None
+
+    # --- launch ---
+
+    def launch(self, pod: dict, extra_env: Optional[Dict[str, str]] = None,
+               extra_env_by_container=None) -> PodSandbox:
+        """Run initContainers to completion, then start every container.
+        `extra_env` merges into every container (CDI-injected claim env);
+        `extra_env_by_container` maps container name -> env overrides."""
+        sandbox = PodSandbox(pod)
+        extra_env = dict(extra_env or {})
+        by_ctr = extra_env_by_container or {}
+        pod_log_dir = (
+            self.logs_dir / pod["metadata"].get("namespace", "default")
+            / pod["metadata"]["name"]
+        )
+        pod_log_dir.mkdir(parents=True, exist_ok=True)
+
+        # Per-pod HTTP-probe port remapping: two nodes' plugin pods would
+        # otherwise race on one configured healthcheck port. Any env var
+        # carrying the original port number follows the remap.
+        port_remap: Dict[int, int] = {}
+        for c in (pod["spec"].get("containers", []) or []):
+            for probe_kind in (
+                "startupProbe", "readinessProbe", "livenessProbe"
+            ):
+                probe = c.get(probe_kind) or {}
+                if "httpGet" in probe:
+                    orig = int(probe["httpGet"].get("port", 0))
+                    if orig > 0 and orig not in port_remap:
+                        port_remap[orig] = _free_port()
+
+        def remap_env(env):
+            for k, v in list(env.items()):
+                if v.isdigit() and int(v) in port_remap:
+                    env[k] = str(port_remap[int(v)])
+            return env
+
+        for init in pod["spec"].get("initContainers", []) or []:
+            profile = profile_for(init.get("image", ""))
+            mounts = self._mounts(pod, init, sandbox)
+            env = remap_env(self._container_env(
+                pod, init, mounts, profile, extra_env
+            ))
+            argv = self._argv(init, profile, mounts)
+            log_path = pod_log_dir / f"{init['name']}.log"
+            with open(log_path, "ab") as lf:
+                rc = subprocess.run(
+                    argv, env=env, stdout=lf, stderr=subprocess.STDOUT,
+                    cwd=str(REPO_ROOT), timeout=120,
+                ).returncode
+            if rc != 0:
+                sandbox.init_failed = (
+                    f"init container {init['name']} exited {rc}"
+                )
+                return sandbox
+
+        for c in pod["spec"].get("containers", []) or []:
+            profile = profile_for(c.get("image", ""))
+            mounts = self._mounts(pod, c, sandbox)
+            env = remap_env(self._container_env(
+                pod, c, mounts, profile,
+                {**extra_env, **by_ctr.get(c["name"], {})},
+            ))
+            argv = self._argv(c, profile, mounts)
+            log_path = pod_log_dir / f"{c['name']}.log"
+            lf = open(log_path, "ab")
+            try:
+                proc = subprocess.Popen(
+                    argv, env=env, stdout=lf, stderr=subprocess.STDOUT,
+                    cwd=str(REPO_ROOT), start_new_session=True,
+                )
+            except OSError as e:
+                # ErrImagePull analog: record the failure, reap what
+                # already started, and let the kubelet retry/backoff.
+                lf.write(f"spawn failed: {argv[0]}: {e}\n".encode())
+                lf.close()
+                sandbox.init_failed = f"container {c['name']}: {e}"
+                sandbox.kill()
+                sandbox.containers.clear()
+                return sandbox
+            lf.close()
+            ready = self._probe(c, env, mounts, port_remap)
+            sandbox.containers.append(
+                ContainerProc(c["name"], proc, log_path, ready)
+            )
+        return sandbox
+
+
+def container_log_path(base_dir: Path, namespace: str, pod: str,
+                      container: str) -> Path:
+    return Path(base_dir) / "logs" / namespace / pod / f"{container}.log"

@@ -87,6 +87,15 @@ def build_parser() -> argparse.ArgumentParser:
         ),
         help="Host dir under which per-claim multiplex socket dirs live",
     )
+    p.add_argument(
+        "--multiplex-image",
+        default=flags.env_default(
+            "TPU_DRA_MULTIPLEX_IMAGE", "tpu-dra-driver:latest"
+        ),
+        help="Image for the per-claim multiplex control-daemon "
+        "Deployments this plugin renders (the chart passes its own "
+        "image)",
+    )
     return p
 
 
@@ -119,6 +128,7 @@ def main(argv=None) -> int:
         resource_api_version=args.resource_api_version,
         cdi_hook_source=args.cdi_hook,
         multiplex_socket_root=args.multiplex_socket_root,
+        multiplex_image=args.multiplex_image,
         sysfs_root=args.sysfs_root,
     )
     driver = Driver(tpulib, backend, config)
