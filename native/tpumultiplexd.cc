@@ -140,6 +140,11 @@ struct Config {
   double preempt_after_quanta = -1;
   // Refuse the offender re-acquire for this long (-1: one quantum).
   double preempt_cooldown_seconds = -1;
+  // Device-boundary gate (EXCLUSIVE_PROCESS analog): with enforce ==
+  // "chown", these nodes are mode 0000 except while a lease is held,
+  // when they are chown'd to the holder's SO_PEERCRED uid at 0600.
+  std::vector<std::string> device_paths;
+  std::string enforce;
 };
 
 std::vector<std::string> SplitNonEmpty(const char* raw, char sep) {
@@ -185,8 +190,100 @@ Config ParseEnv() {
       p && *p) {
     cfg.preempt_cooldown_seconds = atof(p);
   }
+  cfg.device_paths = SplitNonEmpty(getenv("TPU_MULTIPLEX_DEVICE_PATHS"), ',');
+  if (const char* p = getenv("TPU_MULTIPLEX_ENFORCE")) cfg.enforce = p;
   return cfg;
 }
+
+// Kernel-enforced device gate (multiplexd.py DeviceGate twin): original
+// owner/mode recorded at arm time and restored at daemon exit.
+class DeviceGate {
+ public:
+  // Originals persist in the shared socket dir: a successor daemon
+  // (crash replacement, rollout) must restore the TRUE original state,
+  // not the locked/held state its predecessor left behind
+  // (multiplexd.py DeviceGate twin).
+  void Arm(const std::vector<std::string>& paths,
+           const std::string& state_dir) {
+    orig_file_ = state_dir + "/devgate-orig.txt";
+    std::map<std::string, Entry> persisted;
+    if (FILE* f = fopen(orig_file_.c_str(), "r")) {
+      char path[512];
+      unsigned uid, gid, mode;
+      while (fscanf(f, "%511s %u %u %o", path, &uid, &gid, &mode) == 4) {
+        persisted[path] = Entry{uid, gid, static_cast<mode_t>(mode)};
+      }
+      fclose(f);
+    }
+    for (const std::string& p : paths) {
+      auto it = persisted.find(p);
+      if (it != persisted.end()) {
+        orig_.emplace_back(p, it->second);
+        continue;
+      }
+      struct stat st;
+      if (stat(p.c_str(), &st) != 0) {
+        fprintf(stderr, "device gate: cannot stat %s: %s\n", p.c_str(),
+                strerror(errno));
+        continue;
+      }
+      orig_.emplace_back(
+          p, Entry{st.st_uid, st.st_gid,
+                   static_cast<mode_t>(st.st_mode & 07777)});
+    }
+    // Unarmed when nothing is reachable: status must not claim a
+    // kernel boundary that gates nothing.
+    armed_ = !orig_.empty();
+    if (!armed_) {
+      fprintf(stderr,
+              "device gate requested but no device path is reachable; "
+              "running UNENFORCED\n");
+      return;
+    }
+    if (FILE* f = fopen(orig_file_.c_str(), "w")) {
+      for (auto& [p, e] : orig_) {
+        fprintf(f, "%s %u %u %o\n", p.c_str(), e.uid, e.gid, e.mode);
+      }
+      fclose(f);
+    }
+    Lock();
+  }
+  bool armed() const { return armed_; }
+  void Lock() { Apply(0, 0000); }
+  void Grant(bool has_uid, uid_t uid) {
+    if (!has_uid) return;  // no peer credentials: fail closed
+    Apply(uid, 0600);
+  }
+  void Restore() {
+    for (auto& [p, e] : orig_) {
+      if (chown(p.c_str(), e.uid, e.gid) != 0 ||
+          chmod(p.c_str(), e.mode) != 0) {
+        fprintf(stderr, "device gate: restore %s: %s\n", p.c_str(),
+                strerror(errno));
+      }
+    }
+    if (!orig_file_.empty()) unlink(orig_file_.c_str());
+  }
+
+ private:
+  struct Entry {
+    uid_t uid;
+    gid_t gid;
+    mode_t mode;
+  };
+  void Apply(uid_t uid, mode_t mode) {
+    for (auto& [p, e] : orig_) {
+      if (chown(p.c_str(), uid, e.gid) != 0 ||
+          chmod(p.c_str(), mode) != 0) {
+        fprintf(stderr, "device gate: %s: %s\n", p.c_str(),
+                strerror(errno));
+      }
+    }
+  }
+  std::vector<std::pair<std::string, Entry>> orig_;
+  std::string orig_file_;
+  bool armed_ = false;
+};
 
 // Interval ordinal -> fraction of the window (multiplexd.py
 // TIMESLICE_WINDOW_FRACTION: Short 5%, Medium 25%, Long 100%; ordinal 0
@@ -232,6 +329,8 @@ struct Conn {
   int fd = -1;
   std::string name;     // display name from the acquire request
   std::string cred;     // SO_PEERCRED "uid<u>:pid<p>" (cooldown key)
+  uid_t uid = 0;        // SO_PEERCRED uid (device-gate grant target)
+  bool has_uid = false;
   std::string inbuf;    // unparsed input
   std::string outbuf;   // unwritten output
   bool waiting = false;  // queued for the lease (requests held until grant)
@@ -259,6 +358,13 @@ class Daemon {
   int Run() {
     std::string path = cfg_.socket_dir + "/" + kSocketName;
     MakeDirs(cfg_.socket_dir);
+    if (cfg_.enforce == "chown" && !cfg_.device_paths.empty()) {
+      gate_.Arm(cfg_.device_paths, cfg_.socket_dir);
+      if (gate_.armed()) {
+        fprintf(stderr, "device gate armed over %zu node(s)\n",
+                cfg_.device_paths.size());
+      }
+    }
     unlink(path.c_str());
     listen_fd_ = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
     if (listen_fd_ < 0) {
@@ -277,6 +383,9 @@ class Daemon {
       perror("bind/listen");
       return 1;
     }
+    // Workload containers run arbitrary uids; connecting to a unix
+    // socket needs write permission on the socket inode.
+    chmod(path.c_str(), 0666);
     // Remember which filesystem entry is OURS (a successor daemon may
     // re-bind the same path during pod replacement; its socket must
     // survive our teardown).
@@ -330,6 +439,7 @@ class Daemon {
 
     for (auto& [fd, c] : conns_) close(fd);
     close(listen_fd_);
+    if (gate_.armed()) gate_.Restore();
     struct stat cur {};
     if (stat(path.c_str(), &cur) == 0 && cur.st_ino == own_ino_) {
       unlink(path.c_str());
@@ -353,6 +463,8 @@ class Daemon {
     if (getsockopt(fd, SOL_SOCKET, SO_PEERCRED, &uc, &len) == 0) {
       c.cred = "uid" + std::to_string(uc.uid) + ":pid" +
                std::to_string(uc.pid);
+      c.uid = uc.uid;
+      c.has_uid = true;
     }
   }
 
@@ -425,6 +537,7 @@ class Daemon {
     } else if (op == "release") {
       if (holder_ == c.fd) {
         holder_ = -1;
+        if (gate_.armed()) gate_.Lock();
         Send(c, "{\"ok\": true}");
       } else {
         Send(c, "{\"ok\": false}");
@@ -459,13 +572,15 @@ class Daemon {
       chips += "\"" + JsonEscape(cfg_.chips[i]) + "\"";
     }
     chips += "]";
-    char buf[224];
+    char buf[256];
     snprintf(buf, sizeof buf,
              ", \"waiting\": %zu, \"heldSeconds\": %.3f, "
              "\"maxHoldSeconds\": %g, \"overdue\": %s, "
-             "\"revocations\": %zu, \"preemption\": %s}",
+             "\"revocations\": %zu, \"preemption\": %s, "
+             "\"deviceGate\": %s}",
              queue_.size(), held, max_hold, overdue ? "true" : "false",
-             revocations_, cfg_.preempt_after_quanta > 0 ? "true" : "false");
+             revocations_, cfg_.preempt_after_quanta > 0 ? "true" : "false",
+             gate_.armed() ? "true" : "false");
     return "{\"ok\": true, \"holder\": " + holder + ", \"chips\": " + chips +
            buf;
   }
@@ -519,6 +634,7 @@ class Daemon {
             "%.3fs (%zu revocations total)\n",
             name.c_str(), now - since, cooldown, revocations_);
     holder_ = -1;
+    if (gate_.armed()) gate_.Lock();
   }
 
   void GrantIfFree() {
@@ -536,9 +652,11 @@ class Daemon {
       double now = MonotonicSeconds();
       hold_started_ = now;
       contended_since_ = queue_.empty() ? 0.0 : now;
+      if (gate_.armed()) gate_.Grant(c.has_uid, c.uid);
       Send(c, "{\"ok\": true, \"lease\": " + LeaseBodyJson(cfg_) + "}");
       if (c.dead) {  // grant write raced the client's death
         holder_ = -1;
+        if (gate_.armed()) gate_.Lock();
         continue;
       }
       // Process any requests the new holder pipelined while queued.
@@ -572,7 +690,10 @@ class Daemon {
         continue;
       }
       int fd = it->first;
-      if (holder_ == fd) holder_ = -1;  // crashed holder: revoke
+      if (holder_ == fd) {  // crashed holder: revoke
+        holder_ = -1;
+        if (gate_.armed()) gate_.Lock();
+      }
       for (auto q = queue_.begin(); q != queue_.end();) {
         q = (*q == fd) ? queue_.erase(q) : q + 1;
       }
@@ -592,6 +713,7 @@ class Daemon {
   double contended_since_ = 0.0;
   size_t revocations_ = 0;
   std::map<std::string, double> cooldown_;  // peercred (or name) -> until
+  DeviceGate gate_;
 };
 
 // `check` probe: 0 iff a daemon answers a ping on the socket.

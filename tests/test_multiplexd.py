@@ -15,6 +15,7 @@ import json
 import os
 import socket
 import subprocess
+import sys
 import threading
 import time
 
@@ -42,8 +43,13 @@ class NativeDaemon:
     def __init__(self, socket_dir, chips, hbm_limits=None,
                  compute_share_pct=None, timeslice_ordinal=None,
                  window_seconds=None, preempt_after_quanta=None,
-                 preempt_cooldown_seconds=None):
+                 preempt_cooldown_seconds=None, device_paths=None,
+                 enforce=""):
         env = dict(os.environ)
+        if device_paths:
+            env["TPU_MULTIPLEX_DEVICE_PATHS"] = ",".join(device_paths)
+        if enforce:
+            env["TPU_MULTIPLEX_ENFORCE"] = enforce
         env["TPU_MULTIPLEX_CHIPS"] = ",".join(chips)
         env["TPU_MULTIPLEX_SOCKET_DIR"] = str(socket_dir)
         if hbm_limits:
@@ -526,6 +532,8 @@ def test_parse_env():
         "window_seconds": 10.0,
         "preempt_after_quanta": None,
         "preempt_cooldown_seconds": None,
+        "device_paths": [],
+        "enforce": "",
     }
     assert parse_env({})["chips"] == []
     assert parse_env({
@@ -574,3 +582,129 @@ def test_manager_poll_status_surfaces_arbiter_state(backend, tmp_path):
     finally:
         d.stop()
     assert MultiplexManager.poll_status(m) == {}  # daemon gone -> skipped
+
+
+def _run_as(uid, code, timeout=30):
+    """Run `python -c code` demoted to `uid` (tests run as root)."""
+    def demote():
+        os.setgid(uid)
+        os.setuid(uid)
+
+    return subprocess.run(
+        [sys.executable, "-c", code], preexec_fn=demote,
+        capture_output=True, text=True, timeout=timeout,
+    )
+
+
+@pytest.mark.skipif(os.geteuid() != 0, reason="device gate needs root")
+def test_device_gate_enforces_kernel_boundary(backend, tmp_path_factory):
+    """The EXCLUSIVE_PROCESS analog, end to end at the kernel boundary:
+    with the gate armed, a process that never talks to the arbiter gets
+    EPERM opening the device node; a cooperative non-root client can
+    open it exactly while it holds the lease; release re-locks; daemon
+    shutdown restores the original owner/mode."""
+    import pathlib
+    import tempfile
+
+    # Not pytest's tmp_path: its 0700 ancestors would block the demoted
+    # client from even reaching the socket.
+    tmp_path = pathlib.Path(tempfile.mkdtemp(prefix="tpu-gate-"))
+    dev = tmp_path / "accel0"
+    dev.write_bytes(b"")
+    os.chmod(dev, 0o666)
+    os.chmod(tmp_path, 0o755)
+    nobody = 65534
+    d = new_daemon(
+        backend, tmp_path, ["chip-a"], compute_share_pct=50,
+        device_paths=[str(dev)], enforce="chown",
+    )
+    try:
+        _wait = time.monotonic() + 10
+        while time.monotonic() < _wait:
+            if os.stat(dev).st_mode & 0o777 == 0:
+                break
+            time.sleep(0.05)
+        st = os.stat(dev)
+        assert st.st_mode & 0o777 == 0, "armed gate must lock the node"
+
+        # Bypass: a workload that ignores the arbiter cannot open the
+        # chip — the kernel, not client politeness, refuses it.
+        r = _run_as(nobody, f"open({str(dev)!r}, 'r+b')")
+        assert r.returncode != 0
+        assert "Permission" in r.stderr, r.stderr
+
+        # Cooperative non-root client: open works exactly while held.
+        client_code = f"""
+import json, os, socket, sys
+s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+s.connect({str(tmp_path / SOCKET_NAME)!r})
+f = s.makefile("rw")
+f.write(json.dumps({{"op": "acquire", "client": "coop"}}) + "\\n")
+f.flush()
+resp = json.loads(f.readline())
+assert resp["ok"], resp
+open({str(dev)!r}, "r+b").close()   # granted: kernel lets us in
+print("HELD", flush=True)
+import time; time.sleep(1.0)        # window for the root-side stat
+f.write(json.dumps({{"op": "release"}}) + "\\n")
+f.flush()
+assert json.loads(f.readline())["ok"]
+try:
+    open({str(dev)!r}, "r+b")
+    sys.exit("reopen after release should have failed")
+except PermissionError:
+    pass
+print("RELOCKED", flush=True)
+"""
+        proc = subprocess.Popen(
+            [sys.executable, "-c", client_code],
+            preexec_fn=lambda: (os.setgid(nobody), os.setuid(nobody)),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        try:
+            assert proc.stdout.readline().strip() == "HELD"
+            st = os.stat(dev)
+            assert st.st_uid == nobody
+            assert st.st_mode & 0o777 == 0o600
+            out, err = proc.communicate(timeout=30)
+            assert proc.returncode == 0, err
+            assert "RELOCKED" in out
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
+        st = os.stat(dev)
+        assert st.st_mode & 0o777 == 0, "release must re-lock"
+    finally:
+        d.stop()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if os.stat(dev).st_mode & 0o777 == 0o666:
+            break
+        time.sleep(0.05)
+    assert os.stat(dev).st_mode & 0o777 == 0o666, (
+        "daemon shutdown must restore the original mode"
+    )
+
+
+def test_device_gate_successor_restores_true_original(tmp_path):
+    """A replacement daemon (predecessor crashed mid-lease, node left
+    locked or holder-owned) must restore the TRUE original owner/mode on
+    clean shutdown — the original persists in the shared socket dir, so
+    arming over the predecessor's locked state doesn't memorize 0000 as
+    'original'."""
+    from tpu_dra.plugin.multiplexd import DeviceGate
+
+    dev = tmp_path / "accel0"
+    dev.write_bytes(b"")
+    os.chmod(dev, 0o666)
+    g1 = DeviceGate([str(dev)], state_dir=str(tmp_path))
+    g1.lock()
+    assert os.stat(dev).st_mode & 0o777 == 0
+    # Predecessor crashes: no restore. The successor arms over the
+    # locked node and must still know 0666.
+    g2 = DeviceGate([str(dev)], state_dir=str(tmp_path))
+    g2.lock()
+    g2.restore()
+    assert os.stat(dev).st_mode & 0o777 == 0o666
+    assert not (tmp_path / DeviceGate.ORIG_FILE).exists()

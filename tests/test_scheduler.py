@@ -341,3 +341,42 @@ def test_scheduler_core_allocates_and_releases(cluster):
         assert alloc["devices"]["results"][0]["device"] == "tpu-0-0-0"
     finally:
         core.stop()
+
+
+def test_allocation_mode_all_scales_to_thousands_of_devices():
+    """allocationMode All over a ComputeDomain's 2048 channels must not
+    overflow the interpreter stack (the picker is iterative; found by
+    the bats chan-inject suite executing for real)."""
+    n = 2500
+    slices = [{
+        "metadata": {"name": f"s{b}"},
+        "spec": {
+            "driver": "compute-domain.tpu.google.com",
+            "pool": {"name": "node-0"},
+            "nodeName": "node-0",
+            "devices": [
+                {"name": f"ch-{b}-{i}", "basic": {"attributes": {
+                    "type": {"string": "channel"},
+                }}}
+                for i in range(125)
+            ],
+        },
+    } for b in range(n // 125)]
+    # Selector-free class: this regression targets the PICKER's scale,
+    # not selector evaluation (covered by the other scheduler tests).
+    classes = [{
+        "metadata": {"name": "compute-domain-default-channel.tpu.google.com"},
+        "spec": {},
+    }]
+    alloc = Allocator(classes, slices, [])
+    result = alloc.allocate({
+        "metadata": {"name": "all-channels", "namespace": "d",
+                     "uid": "u-all"},
+        "spec": {"devices": {"requests": [{
+            "name": "ch",
+            "deviceClassName":
+                "compute-domain-default-channel.tpu.google.com",
+            "allocationMode": "All",
+        }]}},
+    })
+    assert len(result.allocation["devices"]["results"]) == n

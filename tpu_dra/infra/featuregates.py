@@ -63,6 +63,14 @@ CONTEXTUAL_LOGGING = "ContextualLogging"
 # contract.
 MULTIPLEX_PREEMPTION = "MultiplexPreemption"
 
+# Kernel-enforced device boundary for shared claims: the arbiter chowns
+# the chip device nodes to the lease holder's SO_PEERCRED uid (mode 0600)
+# and locks them to 0000 otherwise, so a pod that never talks to the
+# arbiter cannot open the chip at all — the EXCLUSIVE_PROCESS compute-mode
+# analog (reference sharing.go:306, nvlib.go:792-809). Requires
+# MultiplexingSupport.
+MULTIPLEX_DEVICE_GATE = "MultiplexDeviceGate"
+
 DEFAULT_GATE_SPECS: Dict[str, List[VersionedSpec]] = {
     TIME_SLICING_SETTINGS: [VersionedSpec((0, 1), False, Stage.ALPHA)],
     MULTIPLEXING_SUPPORT: [VersionedSpec((0, 1), False, Stage.ALPHA)],
@@ -75,6 +83,7 @@ DEFAULT_GATE_SPECS: Dict[str, List[VersionedSpec]] = {
     # Logging gate override mirrors featuregates.go:160-163.
     CONTEXTUAL_LOGGING: [VersionedSpec((0, 1), True, Stage.BETA)],
     MULTIPLEX_PREEMPTION: [VersionedSpec((0, 1), True, Stage.BETA)],
+    MULTIPLEX_DEVICE_GATE: [VersionedSpec((0, 1), False, Stage.ALPHA)],
 }
 
 
@@ -170,6 +179,13 @@ class FeatureGates:
             raise FeatureGateError(
                 f"feature gate {COMPUTE_DOMAIN_CLIQUES} requires "
                 f"{SLICE_DAEMONS_WITH_DNS_NAMES} to also be enabled"
+            )
+        if self.enabled(MULTIPLEX_DEVICE_GATE) and not self.enabled(
+            MULTIPLEXING_SUPPORT
+        ):
+            raise FeatureGateError(
+                f"feature gate {MULTIPLEX_DEVICE_GATE} requires "
+                f"{MULTIPLEXING_SUPPORT} to also be enabled"
             )
         for other in (PASSTHROUGH_SUPPORT, DEVICE_HEALTH_CHECK, MULTIPLEXING_SUPPORT):
             if self.enabled(DYNAMIC_SUBSLICE) and self.enabled(other):

@@ -177,6 +177,22 @@ class AllocatableDevices(dict):
         seen = set()
         return [u for u in out if not (u in seen or seen.add(u))]
 
+    def arbiter_device_paths(self) -> List[str]:
+        """Device nodes the arbiter's kernel gate (multiplexd DeviceGate,
+        the EXCLUSIVE_PROCESS analog) chowns per lease: the chips' nodes
+        plus any static sub-slice nodes, deduped in discovery order."""
+        out: List[str] = []
+        for d in self.values():
+            if d.type == TPU_DEVICE_TYPE and d.chip is not None:
+                out.extend(d.chip.dev_paths)
+            elif (
+                d.type == SUBSLICE_STATIC_DEVICE_TYPE
+                and d.subslice is not None
+            ):
+                out.extend(d.subslice.dev_paths)
+        seen = set()
+        return [p for p in out if not (p in seen or seen.add(p))]
+
     def siblings_of(self, device: "AllocatableDevice") -> List[str]:
         """Devices sharing any chip coordinate with ``device`` (the
         passthrough sibling set, allocatable.go:238-289)."""

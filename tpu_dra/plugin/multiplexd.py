@@ -67,7 +67,7 @@ import sys
 import threading
 import time
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 log = logging.getLogger(__name__)
 
@@ -86,13 +86,99 @@ SCHEDULING_WINDOW_SECONDS = 10.0
 TIMESLICE_WINDOW_FRACTION = {1: 0.05, 2: 0.25, 3: 1.0}
 
 
-def _peer_cred(conn) -> Optional[str]:
-    """Kernel-attested peer identity (``uid<u>:pid<p>``) from SO_PEERCRED,
-    or None where the platform/transport doesn't provide it. Used to key
-    post-revocation cooldowns: unlike the client-supplied display name or
-    the per-connection id, a uid:pid survives a reconnect and cannot be
-    chosen by the client, so an offender cannot shed its cooldown by
-    reconnecting under a fresh name."""
+class DeviceGate:
+    """Kernel-enforced device-boundary gate — the EXCLUSIVE_PROCESS
+    analog (reference sharing.go:306, nvlib.go:792-809): with the gate
+    armed, the chip's device nodes are mode 0000 except while a lease is
+    held, when they are chown'd to the HOLDER's kernel-attested uid
+    (SO_PEERCRED) at mode 0600. A pod that never talks to the arbiter
+    gets EPERM from the kernel on open — cooperation is enforced by DAC,
+    not convention. (Root-uid workloads bypass DAC by definition; the
+    production containers run the workload uid the chart sets.)
+
+    The daemon records each node's original owner/mode and restores them
+    on stop, so an unmanaged chip is never left locked."""
+
+    LOCKED_MODE = 0o000
+    HELD_MODE = 0o600
+    ORIG_FILE = "devgate-orig.json"
+
+    def __init__(self, paths: List[str], state_dir: Optional[str] = None):
+        self.paths: List[str] = []
+        self._orig: Dict[str, Tuple[int, int, int]] = {}  # uid, gid, mode
+        # A successor daemon (crash replacement, rollout) must restore
+        # the TRUE original state, not the locked/held state its
+        # predecessor left behind: originals persist in the shared
+        # socket dir and are loaded in preference to a fresh stat.
+        self._orig_path = (
+            os.path.join(state_dir, self.ORIG_FILE) if state_dir else None
+        )
+        persisted: Dict[str, Tuple[int, int, int]] = {}
+        if self._orig_path and os.path.exists(self._orig_path):
+            try:
+                with open(self._orig_path) as f:
+                    persisted = {
+                        k: tuple(v) for k, v in json.load(f).items()
+                    }
+            except (OSError, ValueError) as e:
+                log.warning("device gate: bad orig file: %s", e)
+        for p in paths:
+            if p in persisted:
+                self._orig[p] = persisted[p]
+                self.paths.append(p)
+                continue
+            try:
+                st = os.stat(p)
+                self._orig[p] = (st.st_uid, st.st_gid, st.st_mode & 0o7777)
+                self.paths.append(p)
+            except OSError as e:
+                log.warning("device gate: cannot stat %s: %s", p, e)
+        if self._orig_path and self.paths:
+            try:
+                with open(self._orig_path, "w") as f:
+                    json.dump(self._orig, f)
+            except OSError as e:
+                log.warning("device gate: cannot persist orig: %s", e)
+
+    def lock(self) -> None:
+        """No holder: nobody (but root) can open the device."""
+        self._apply(0, self.LOCKED_MODE)
+
+    def grant(self, uid: Optional[int]) -> None:
+        if uid is None:
+            return  # no peer credentials: leave locked (fail closed)
+        self._apply(uid, self.HELD_MODE)
+
+    def restore(self) -> None:
+        for p in self.paths:
+            uid, gid, mode = self._orig[p]
+            try:
+                os.chown(p, uid, gid)
+                os.chmod(p, mode)
+            except OSError as e:
+                log.warning("device gate: restore %s: %s", p, e)
+        if self._orig_path:
+            try:
+                os.remove(self._orig_path)
+            except OSError:
+                pass
+
+    def _apply(self, uid: int, mode: int) -> None:
+        for p in self.paths:
+            try:
+                os.chown(p, uid, self._orig[p][1])
+                os.chmod(p, mode)
+            except OSError as e:
+                log.warning("device gate: %s: %s", p, e)
+
+
+def _peer_cred(conn) -> Optional[Tuple[int, int]]:
+    """Kernel-attested peer identity ``(uid, pid)`` from SO_PEERCRED, or
+    None where the platform/transport doesn't provide it. The uid:pid
+    keys post-revocation cooldowns (unlike the client-supplied display
+    name or the per-connection id, it survives a reconnect and cannot be
+    chosen by the client), and the uid is what the device gate chowns
+    the chip nodes to while the lease is held."""
     so_peercred = getattr(socket, "SO_PEERCRED", None)
     if so_peercred is None:
         return None
@@ -102,7 +188,7 @@ def _peer_cred(conn) -> Optional[str]:
         raw = conn.getsockopt(socket.SOL_SOCKET, so_peercred,
                               struct.calcsize("3i"))
         pid, uid, _gid = struct.unpack("3i", raw)
-        return f"uid{uid}:pid{pid}"
+        return (uid, pid)
     except OSError:
         return None
 
@@ -121,7 +207,9 @@ class LeaseState:
                  timeslice_ordinal: Optional[int] = None,
                  window_seconds: float = SCHEDULING_WINDOW_SECONDS,
                  preempt_after_quanta: Optional[float] = None,
-                 preempt_cooldown_seconds: Optional[float] = None):
+                 preempt_cooldown_seconds: Optional[float] = None,
+                 gate: Optional[DeviceGate] = None):
+        self.gate = gate
         self.chips = chips
         self.hbm_limits = hbm_limits
         self.compute_share_pct = compute_share_pct
@@ -160,6 +248,7 @@ class LeaseState:
         # never to steal or release another client's lease (identity for
         # those stays the connection).
         self._cooldown_keys: Dict[str, str] = {}  # conn id -> cooldown key
+        self._uids: Dict[str, Optional[int]] = {}  # conn id -> peer uid
         self._cooldown_until: Dict[str, float] = {}
         self._revocations = 0
         self._push: Dict[str, object] = {}  # conn id -> best-effort send fn
@@ -199,7 +288,8 @@ class LeaseState:
         return until - now
 
     def acquire(self, conn_id: str, name: str, cancelled,
-                cooldown_key: Optional[str] = None):
+                cooldown_key: Optional[str] = None,
+                peer_uid: Optional[int] = None):
         """Block until `conn_id` holds the lease; returns
         ``("granted", 0.0)``, ``("cancelled", 0.0)`` (client hung up while
         queued), or ``("cooldown", seconds)`` — refused outright because
@@ -210,6 +300,7 @@ class LeaseState:
         with self._granted:
             self._names[conn_id] = name
             self._cooldown_keys[conn_id] = cooldown_key or name
+            self._uids[conn_id] = peer_uid
             if self._holder == conn_id:
                 return ("granted", 0.0)
             remaining = self._cooldown_remaining_locked(
@@ -230,6 +321,8 @@ class LeaseState:
                     now = time.monotonic()
                     self._hold_started = now
                     self._contended_since = now if self._queue else 0.0
+                    if self.gate is not None:
+                        self.gate.grant(self._uids.get(conn_id))
                     return ("granted", 0.0)
                 self._granted.wait(timeout=0.2)
 
@@ -265,6 +358,10 @@ class LeaseState:
             self._cooldown_until[key] = now + cooldown
             self._revocations += 1
             self._holder = None
+            if self.gate is not None:
+                # Revocation is not advisory: the kernel stops honoring
+                # the offender's opens before the next waiter is granted.
+                self.gate.lock()
             self._granted.notify_all()
             push = self._push.get(offender)
             event = {
@@ -291,6 +388,8 @@ class LeaseState:
             if self._holder != conn_id:
                 return False
             self._holder = None
+            if self.gate is not None:
+                self.gate.lock()
             self._granted.notify_all()
             return True
 
@@ -300,11 +399,14 @@ class LeaseState:
             self._drop_locked(conn_id)
             self._names.pop(conn_id, None)
             self._cooldown_keys.pop(conn_id, None)
+            self._uids.pop(conn_id, None)
             self._push.pop(conn_id, None)
 
     def _drop_locked(self, conn_id: str) -> None:
         if self._holder == conn_id:
             self._holder = None
+            if self.gate is not None:
+                self.gate.lock()
         try:
             self._queue.remove(conn_id)
         except ValueError:
@@ -343,6 +445,7 @@ class LeaseState:
                 ),
                 "revocations": self._revocations,
                 "preemption": self.preempt_after_quanta is not None,
+                "deviceGate": self.gate is not None,
             }
 
 
@@ -358,7 +461,16 @@ class _Handler(socketserver.StreamRequestHandler):
         self._wlock = threading.Lock()
         state.register_push(conn_id, self._push_event)
         try:
-            for raw in self.rfile:
+            self._handle_lines(state, conn_id)
+        except (ConnectionResetError, BrokenPipeError):
+            pass  # client died mid-read: teardown below reaps it
+        finally:
+            # Also unregisters the push fn; harmless for connections that
+            # never acquired.
+            state.drop(conn_id)
+
+    def _handle_lines(self, state: LeaseState, conn_id: str) -> None:
+        for raw in self.rfile:
                 try:
                     msg = json.loads(raw)
                 except json.JSONDecodeError:
@@ -367,9 +479,13 @@ class _Handler(socketserver.StreamRequestHandler):
                 op = msg.get("op")
                 if op == "acquire":
                     name = msg.get("client") or conn_id
+                    cred = _peer_cred(self.connection)
                     verdict, extra = state.acquire(
                         conn_id, name, cancelled=self._conn_dead,
-                        cooldown_key=_peer_cred(self.connection),
+                        cooldown_key=(
+                            f"uid{cred[0]}:pid{cred[1]}" if cred else None
+                        ),
+                        peer_uid=cred[0] if cred else None,
                     )
                     if verdict == "cancelled":
                         return
@@ -396,10 +512,6 @@ class _Handler(socketserver.StreamRequestHandler):
                     self._send({"ok": True})
                 else:
                     self._send({"ok": False, "error": f"unknown op {op!r}"})
-        finally:
-            # Also unregisters the push fn; harmless for connections that
-            # never acquired.
-            state.drop(conn_id)
 
     def _send(self, obj: dict) -> None:
         with self._wlock:
@@ -496,16 +608,30 @@ class MultiplexDaemon:
                  timeslice_ordinal: Optional[int] = None,
                  window_seconds: float = SCHEDULING_WINDOW_SECONDS,
                  preempt_after_quanta: Optional[float] = None,
-                 preempt_cooldown_seconds: Optional[float] = None):
+                 preempt_cooldown_seconds: Optional[float] = None,
+                 device_paths: Optional[List[str]] = None,
+                 enforce: str = ""):
         os.makedirs(socket_dir, exist_ok=True)
         self.socket_dir = socket_dir
         self.socket_path = os.path.join(socket_dir, SOCKET_NAME)
+        gate = None
+        if enforce == "chown" and device_paths:
+            gate = DeviceGate(device_paths, state_dir=socket_dir)
+            if not gate.paths:
+                # No reachable node: better unarmed-and-reported than
+                # "deviceGate: true" with nothing actually gated.
+                log.warning(
+                    "device gate requested but no device path is "
+                    "reachable; running UNENFORCED"
+                )
+                gate = None
         self.state = LeaseState(
             chips, hbm_limits or {}, compute_share_pct,
             timeslice_ordinal=timeslice_ordinal,
             window_seconds=window_seconds,
             preempt_after_quanta=preempt_after_quanta,
             preempt_cooldown_seconds=preempt_cooldown_seconds,
+            gate=gate,
         )
         try:
             os.remove(self.socket_path)
@@ -517,6 +643,9 @@ class MultiplexDaemon:
 
         self._server = Server(self.socket_path, _Handler)
         self._server.lease_state = self.state  # type: ignore[attr-defined]
+        # Workload containers run arbitrary uids; connecting to a unix
+        # socket needs write permission on the socket inode.
+        os.chmod(self.socket_path, 0o666)
         # Remember which filesystem entry is OURS: during pod replacement a
         # successor daemon may have re-bound the same path (shared hostPath
         # dir); its socket must survive our teardown.
@@ -526,6 +655,8 @@ class MultiplexDaemon:
         self._stop_sweeper = threading.Event()
 
     def start(self) -> "MultiplexDaemon":
+        if self.state.gate is not None:
+            self.state.gate.lock()
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True, name="multiplexd"
         )
@@ -554,6 +685,8 @@ class MultiplexDaemon:
         self._stop_sweeper.set()
         self._server.shutdown()
         self._server.server_close()
+        if self.state.gate is not None:
+            self.state.gate.restore()
         try:
             if os.stat(self.socket_path).st_ino == self._socket_ino:
                 os.remove(self.socket_path)
@@ -587,7 +720,10 @@ def parse_env(environ=os.environ) -> dict:
     win_raw = environ.get("TPU_MULTIPLEX_WINDOW_SECONDS", "")
     paq_raw = environ.get("TPU_MULTIPLEX_PREEMPT_AFTER_QUANTA", "")
     pcd_raw = environ.get("TPU_MULTIPLEX_PREEMPT_COOLDOWN_SECONDS", "")
+    dev_raw = environ.get("TPU_MULTIPLEX_DEVICE_PATHS", "")
     return {
+        "device_paths": [p for p in dev_raw.split(",") if p],
+        "enforce": environ.get("TPU_MULTIPLEX_ENFORCE", ""),
         "chips": [c for c in environ.get("TPU_MULTIPLEX_CHIPS", "").split(",") if c],
         "socket_dir": environ.get("TPU_MULTIPLEX_SOCKET_DIR", "/var/run/tpu-multiplex"),
         "hbm_limits": limits,
@@ -613,6 +749,8 @@ def main(argv=None) -> int:
         cfg["window_seconds"],
         preempt_after_quanta=cfg["preempt_after_quanta"],
         preempt_cooldown_seconds=cfg["preempt_cooldown_seconds"],
+        device_paths=cfg["device_paths"],
+        enforce=cfg["enforce"],
     ).start()
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())

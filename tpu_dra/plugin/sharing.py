@@ -130,6 +130,22 @@ class MultiplexControlDaemon:
             env.append(
                 {"name": "TPU_MULTIPLEX_PREEMPT_AFTER_QUANTA", "value": "2"}
             )
+        gate_paths: List[str] = []
+        if fg.enabled(fg.MULTIPLEX_DEVICE_GATE):
+            # Kernel-enforced boundary (EXCLUSIVE_PROCESS analog): the
+            # daemon chowns these nodes to the holder's SO_PEERCRED uid
+            # per lease and locks them to 0000 between leases. The node
+            # inodes must be IN the daemon pod's mount namespace — each
+            # gated path gets its own hostPath mount below.
+            gate_paths = self.devices.arbiter_device_paths()
+            if gate_paths:
+                env.append({
+                    "name": "TPU_MULTIPLEX_DEVICE_PATHS",
+                    "value": ",".join(gate_paths),
+                })
+                env.append(
+                    {"name": "TPU_MULTIPLEX_ENFORCE", "value": "chown"}
+                )
         return {
             "apiVersion": "apps/v1",
             "kind": "Deployment",
@@ -173,6 +189,13 @@ class MultiplexControlDaemon:
                                 "volumeMounts": [
                                     {"name": "socket-dir", "mountPath": self.socket_dir()},
                                     {"name": "shm", "mountPath": "/dev/shm"},
+                                    *[
+                                        {
+                                            "name": f"gate-dev-{j}",
+                                            "mountPath": p,
+                                        }
+                                        for j, p in enumerate(gate_paths)
+                                    ],
                                 ],
                             }
                         ],
@@ -193,6 +216,13 @@ class MultiplexControlDaemon:
                                     "sizeLimit": MULTIPLEX_SHM_SIZE,
                                 },
                             },
+                            *[
+                                {
+                                    "name": f"gate-dev-{j}",
+                                    "hostPath": {"path": p},
+                                }
+                                for j, p in enumerate(gate_paths)
+                            ],
                         ],
                     },
                 },

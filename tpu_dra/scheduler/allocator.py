@@ -317,32 +317,51 @@ class Allocator:
 
     def _pick(self, req, name, admin, cands, count, start, acc,
               per_request, i, chosen, claim_spec) -> bool:
-        if len(acc) == count:
-            chosen[name] = list(acc)
-            if self._solve(per_request, i + 1, chosen, claim_spec):
+        """Choose `count` of `cands` (explicit-stack backtracking over
+        index combinations). Iterative on purpose: recursion depth would
+        equal `count`, and a claim can legitimately ask for thousands of
+        devices — allocationMode All over a ComputeDomain's 2048
+        channels overflowed the interpreter stack when this recursed
+        (found by the bats chan-inject suite). Cross-REQUEST recursion
+        via _solve stays (requests are few)."""
+        del start, acc  # kept for signature stability; stack-managed now
+
+        def can_take(dev) -> bool:
+            if admin:
                 return True
-            del chosen[name]
-            return False
-        for j in range(start, len(cands)):
-            dev = cands[j]
+            return (
+                dev.key() not in self.in_use
+                and self.ledger.can_consume(dev)
+            )
+
+        def take(dev) -> None:
             if not admin:
-                if dev.key() in self.in_use:
-                    continue
-                if any(d.key() == dev.key() for d in acc):
-                    continue
-                if not self.ledger.can_consume(dev):
-                    continue
                 self.ledger.consume(dev)
                 self.in_use.add(dev.key())
-            acc.append(dev)
-            if self._pick(req, name, admin, cands, count, j + 1, acc,
-                          per_request, i, chosen, claim_spec):
-                return True
-            acc.pop()
+
+        def untake(dev) -> None:
             if not admin:
                 self.in_use.discard(dev.key())
                 self.ledger.consume(dev, sign=-1)
-        return False
+
+        taken: List[int] = []  # indices into cands, ascending
+        j = 0
+        while True:
+            while len(taken) < count and j < len(cands):
+                if can_take(cands[j]):
+                    take(cands[j])
+                    taken.append(j)
+                j += 1
+            if len(taken) == count:
+                chosen[name] = [cands[k] for k in taken]
+                if self._solve(per_request, i + 1, chosen, claim_spec):
+                    return True
+                del chosen[name]
+            if not taken:
+                return False
+            k = taken.pop()
+            untake(cands[k])
+            j = k + 1
 
     # --- result rendering ---
 

@@ -14,3 +14,26 @@ are JAX programs designed TPU-first:
 - ``train.py``  — pjit'd training step with rematerialization
 - ``smoke.py``  — the pmap psum multi-chip smoke test (BASELINE config 2)
 """
+
+
+def apply_forced_platform(environ=None) -> None:
+    """Honor ``TPU_DRA_FORCE_PLATFORM=<platform>[:N]`` (e.g. ``cpu:1``):
+    re-pin the jax backend before first use. Env vars alone are not
+    enough on hosts whose interpreter startup already imported jax
+    against a real accelerator (sitecustomize + device tunnel); the
+    minicluster's workload-image runtime profile sets this — kind's
+    equivalent is simply not mounting the TPU into the container.
+    Called at the top of every workload main()."""
+    import os
+
+    spec = (environ or os.environ).get("TPU_DRA_FORCE_PLATFORM", "")
+    if not spec:
+        return
+    platform, _, n = spec.partition(":")
+    import jax
+    from jax.extend.backend import clear_backends
+
+    clear_backends()
+    jax.config.update("jax_platforms", platform)
+    if n and platform == "cpu":
+        jax.config.update("jax_num_cpu_devices", int(n))

@@ -198,6 +198,10 @@ def measure_collectives(
 
 
 def main(argv=None) -> int:
+    from tpu_dra.workloads import apply_forced_platform
+
+    apply_forced_platform()
+
     p = argparse.ArgumentParser("tpu-ici-bandwidth")
     p.add_argument("--size-mb", type=float, default=64.0)
     p.add_argument("--reps", type=int, default=10)

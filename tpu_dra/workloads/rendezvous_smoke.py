@@ -34,6 +34,10 @@ FEATURE_DIM = 8
 
 
 def main(argv=None) -> int:
+    from tpu_dra.workloads import apply_forced_platform
+
+    apply_forced_platform()
+
     p = argparse.ArgumentParser("tpu-dra-rendezvous-smoke")
     p.add_argument(
         "--config-dir",

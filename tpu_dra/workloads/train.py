@@ -191,6 +191,10 @@ MODEL_PRESETS = {
 
 
 def main(argv=None) -> int:
+    from tpu_dra.workloads import apply_forced_platform
+
+    apply_forced_platform()
+
     import argparse
     import time
 
