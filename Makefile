@@ -30,6 +30,11 @@ image:
 bats:
 	bats tests/bats/
 
+# The same 13 suites executed VERBATIM with no cluster/kubectl/helm/jq/
+# bats installed: minicluster (kind analog) + toolchain shims.
+bats-exec: native
+	hack/run-bats.sh --log RUN_bats.log
+
 # the same e2e assertions with no cluster/kubectl/bats at all: fake
 # apiserver + real driver binaries as separate processes (45 checks)
 batsless: native

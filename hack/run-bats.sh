@@ -15,7 +15,11 @@ done
 
 command -v g++ >/dev/null && make -C "${REPO_ROOT}/native" >/dev/null
 
-BASE="$(mktemp -d /tmp/tpu-dra-minicluster.XXXXXX)"
+# Short base path on purpose: the deepest node-sandbox socket
+# (<base>/nodes/node-N/rootfs/var/lib/kubelet/plugins_registry/
+# compute-domain.tpu.google.com-reg.sock) must fit AF_UNIX's ~107-char
+# sun_path limit.
+BASE="$(mktemp -d /tmp/mcXXXXXX)"
 export MINICLUSTER_DIR="$BASE"
 export KUBECONFIG="$BASE/kubeconfig.yaml"
 export TEST_EXPECT_GENERATION=v5p  # minicluster nodes are a v5p slice

@@ -241,6 +241,17 @@ class DeviceGate {
       return;
     }
     if (FILE* f = fopen(orig_file_.c_str(), "w")) {
+      // Merge: keep records for paths a slimmer replacement no longer
+      // gates — they may still be locked and need their true original.
+      for (auto& [p, e] : persisted) {
+        bool ours = false;
+        for (auto& [op, oe] : orig_) {
+          (void)oe;
+          if (op == p) { ours = true; break; }
+        }
+        if (!ours) fprintf(f, "%s %u %u %o\n", p.c_str(), e.uid, e.gid,
+                           e.mode);
+      }
       for (auto& [p, e] : orig_) {
         fprintf(f, "%s %u %u %o\n", p.c_str(), e.uid, e.gid, e.mode);
       }
@@ -439,8 +450,13 @@ class Daemon {
 
     for (auto& [fd, c] : conns_) close(fd);
     close(listen_fd_);
-    if (gate_.armed()) gate_.Restore();
+    // Successor-aware (same inode guard as the socket unlink): a
+    // replacement daemon that re-bound the socket owns the gate now —
+    // restoring here would briefly un-gate the chip under it.
     struct stat cur {};
+    bool still_active =
+        stat(path.c_str(), &cur) != 0 || cur.st_ino == own_ino_;
+    if (still_active && gate_.armed()) gate_.Restore();
     if (stat(path.c_str(), &cur) == 0 && cur.st_ino == own_ino_) {
       unlink(path.c_str());
     }

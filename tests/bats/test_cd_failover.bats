@@ -67,9 +67,15 @@ bats::on_failure() {
   # after the daemon-churn tests above, the fabric must still move bytes —
   # the exerciser measures psum/all-gather/reduce-scatter/ppermute bus
   # bandwidth across the domain and fails below its threshold.
+  # The finished llama job's pods still hold their four-chip claims
+  # (template claims release on pod deletion); clean it up first or the
+  # exerciser can never allocate the chips.
+  kubectl -n cd-demo delete job llama-pjit --ignore-not-found --timeout=120s
   k_apply "${REPO_ROOT}/demo/specs/computedomain/ici-bandwidth-job.yaml"
   kubectl -n cd-demo wait --for=condition=complete job/ici-bandwidth --timeout=600s
-  run kubectl -n cd-demo logs -l job-name=ici-bandwidth --tail=2
+  # --tail generous: the jax runtime prints coordination-teardown noise
+  # AFTER the result line when the workers exit.
+  run kubectl -n cd-demo logs -l job-name=ici-bandwidth --tail=20
   [[ "$output" == *busbw_gbps* ]]
   kubectl -n cd-demo delete job ici-bandwidth --ignore-not-found --timeout=120s
 }

@@ -24,16 +24,26 @@ bats::on_failure() {
 }
 
 @test "misc: controller stamps daemon + workload claim templates" {
-  local rct
-  for rct in v5p-16-daemon-claim v5p-16-channel; do
-    local found=1
-    for _ in $(seq 1 30); do
-      kubectl -n cd-demo get resourceclaimtemplate "$rct" >/dev/null 2>&1 \
-        && { found=0; break; }
-      sleep 2
-    done
-    [ "$found" -eq 0 ]
+  # Workload RCT in the CD's namespace; daemon RCT uid-named in the
+  # DRIVER namespace (daemon pods are its only consumers and an RCT
+  # reference cannot cross namespaces — resourceclaimtemplate.go:295).
+  local found=1
+  for _ in $(seq 1 30); do
+    kubectl -n cd-demo get resourceclaimtemplate v5p-16-channel \
+      >/dev/null 2>&1 && { found=0; break; }
+    sleep 2
   done
+  [ "$found" -eq 0 ]
+  local uid
+  uid="$(kubectl -n cd-demo get computedomain v5p-16 -o jsonpath='{.metadata.uid}')"
+  [ -n "$uid" ]
+  found=1
+  for _ in $(seq 1 30); do
+    kubectl -n "${TEST_NAMESPACE}" get resourceclaimtemplate \
+      "computedomain-daemon-$uid" >/dev/null 2>&1 && { found=0; break; }
+    sleep 2
+  done
+  [ "$found" -eq 0 ]
 }
 
 @test "misc: workload RCT embeds opaque channel config with the CD's UID" {

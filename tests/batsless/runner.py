@@ -1403,13 +1403,27 @@ def run_suites(r: Runner, stack: Stack, td: Path) -> int:
              "--namespace", DRIVER_NS,
              "--node-stale-after", "6", "-v", "6"],
         )
-        for rct in ("v5p-16-channel", "v5p-16-daemon-claim"):
-            wait_for(
-                lambda rct=rct: _try(
-                    lambda: kc.get(RESOURCE_CLAIM_TEMPLATES, cd_ns, rct)
-                ),
-                what=f"claim template {rct}",
-            )
+        # Workload RCT in the CD's namespace; daemon RCT uid-named in
+        # the DRIVER namespace (resourceclaimtemplate.go:295,320).
+        wait_for(
+            lambda: _try(
+                lambda: kc.get(
+                    RESOURCE_CLAIM_TEMPLATES, cd_ns, "v5p-16-channel"
+                )
+            ),
+            what="claim template v5p-16-channel",
+        )
+        daemon_rct = (
+            f"computedomain-daemon-{cds['cd']['metadata']['uid']}"
+        )
+        wait_for(
+            lambda: _try(
+                lambda: kc.get(
+                    RESOURCE_CLAIM_TEMPLATES, DRIVER_NS, daemon_rct
+                )
+            ),
+            what=f"claim template {daemon_rct}",
+        )
 
     r.run("cd", "controller stamps daemon + workload claim templates",
           controller_stamps_rcts)

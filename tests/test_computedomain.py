@@ -120,9 +120,17 @@ def test_controller_stamps_daemonset_and_rcts(fc):
     uid = cd["metadata"]["uid"]
     assert ds["spec"]["template"]["spec"]["nodeSelector"] == {CD_LABEL_KEY: uid}
 
-    rcts = ResourceClient(fc, RESOURCE_CLAIM_TEMPLATES).list(namespace=NS)
-    names = sorted(r["metadata"]["name"] for r in rcts)
-    assert names == ["cd1-channel", "cd1-daemon-claim"]
+    # Workload RCT in the CD's namespace (workload pods consume it);
+    # daemon RCT uid-named in the DRIVER namespace (the daemon pods are
+    # its only consumers, and RCT references cannot cross namespaces —
+    # resourceclaimtemplate.go:295,320).
+    rct_client = ResourceClient(fc, RESOURCE_CLAIM_TEMPLATES)
+    rcts = rct_client.list(namespace=NS)
+    assert [r["metadata"]["name"] for r in rcts] == ["cd1-channel"]
+    daemon_rcts = rct_client.list(namespace=DRIVER_NS)
+    assert [r["metadata"]["name"] for r in daemon_rcts] == [
+        f"computedomain-daemon-{uid}"
+    ]
     workload = next(r for r in rcts if r["metadata"]["name"] == "cd1-channel")
     cfg = workload["spec"]["spec"]["devices"]["config"][0]["opaque"]
     assert cfg["driver"] == CD_DRIVER_NAME

@@ -58,7 +58,9 @@ class ComputeDomainController:
             backend, driver_namespace, image,
             service_account=daemon_service_account,
         )
-        self.rcts = ResourceClaimTemplateManager(backend)
+        self.rcts = ResourceClaimTemplateManager(
+            backend, driver_namespace=driver_namespace
+        )
         self.status = StatusManager(
             backend,
             driver_namespace=driver_namespace,

@@ -286,4 +286,7 @@ class DaemonSetManager:
 
 
 def daemon_rct_name(cd: dict) -> str:
-    return f"{cd['metadata']['name']}-daemon-claim"
+    # UID-scoped (resourceclaimtemplate.go:321 computedomain-daemon-<uid>):
+    # the daemon RCT lives in the shared driver namespace, where same-named
+    # CDs from different namespaces would collide on a name-derived key.
+    return f"computedomain-daemon-{cd['metadata']['uid']}"

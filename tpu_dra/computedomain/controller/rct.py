@@ -82,13 +82,20 @@ def _rct(
 
 
 class ResourceClaimTemplateManager:
-    def __init__(self, backend):
+    def __init__(self, backend, driver_namespace: str = "tpu-dra-driver"):
         self.rcts = ResourceClient(backend, RESOURCE_CLAIM_TEMPLATES)
+        self.driver_namespace = driver_namespace
 
     def render_daemon_rct(self, cd: dict) -> dict:
+        # The daemon RCT lives in the DRIVER namespace: a
+        # resourceClaimTemplateName reference cannot cross namespaces, and
+        # the per-CD daemon pods (its only consumers) run in the driver's
+        # DaemonSet namespace (resourceclaimtemplate.go:295,320 — found
+        # mis-namespaced by the first real bats execution: daemon pods
+        # could never resolve their claim template).
         return _rct(
             name=daemon_rct_name(cd),
-            namespace=cd["metadata"]["namespace"],
+            namespace=self.driver_namespace,
             cd_uid=cd["metadata"]["uid"],
             device_class=DAEMON_DEVICE_CLASS,
             config_kind="ComputeDomainDaemonConfig",

@@ -122,6 +122,22 @@ class MiniCluster:
         self.restarts: Dict[str, int] = {}  # pod uid -> container restarts
         self._reg_misses: Dict[Tuple[str, str], int] = {}
         self.next_attempt: Dict[str, float] = {}  # pod uid -> backoff
+        self._job_failures: Dict[str, int] = {}  # job uid -> replaced fails
+        # Pod admission (allocation + gRPC prepare + launch) runs on a
+        # worker pool: prepares block (up to the 30s RPC timeout), and a
+        # single-threaded loop would stall teardown/status for EVERY pod
+        # behind one slow prepare — observed as force-deleted pods
+        # running to completion before their kill arrived.
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._admit_pool = ThreadPoolExecutor(
+            max_workers=6, thread_name_prefix="mc-admit"
+        )
+        self._admitting: Set[str] = set()
+        # Allocation is a read-modify-write over shared cluster capacity:
+        # concurrent admits must serialize it (kube-scheduler binds one
+        # pod at a time for the same reason). Prepare/launch parallelize.
+        self._alloc_lock = threading.Lock()
         self.ns_seen: Set[str] = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -131,7 +147,21 @@ class MiniCluster:
 
     # --- lifecycle ---
 
+    # The deepest per-node socket path the driver binds; AF_UNIX caps
+    # sun_path around 107 chars, and gRPC just says "failed to bind".
+    _DEEPEST_SOCKET_SUFFIX = (
+        "/nodes/node-0/rootfs/var/lib/kubelet/plugins_registry/"
+        "compute-domain.tpu.google.com-reg.sock"
+    )
+
     def start(self) -> "MiniCluster":
+        deepest = str(self.base) + self._DEEPEST_SOCKET_SUFFIX
+        if len(deepest) > 107:
+            raise ValueError(
+                f"--base-dir too long: the node registration socket "
+                f"path would be {len(deepest)} chars, over AF_UNIX's "
+                f"~107 limit; use a shorter base (e.g. /tmp/mcXXXXXX)"
+            )
         self.srv.start()
         self.srv.write_kubeconfig(self.kubeconfig)
         self._make_nodes()
@@ -149,6 +179,10 @@ class MiniCluster:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=10)
+        # Drain in-flight admissions BEFORE killing sandboxes: a worker
+        # finishing a blocked prepare after the kill loop would launch an
+        # orphan pod process that outlives the cluster.
+        self._admit_pool.shutdown(wait=True, cancel_futures=True)
         for sandbox in self.sandboxes.values():
             sandbox.kill()
         self.srv.stop()
@@ -159,6 +193,10 @@ class MiniCluster:
             rootfs.mkdir(parents=True, exist_ok=True)
             state_dir = rootfs / "var/lib/tpu-dra/stub-state"
             state_dir.mkdir(parents=True, exist_ok=True)
+            hosts = rootfs / "etc/hosts"
+            hosts.parent.mkdir(parents=True, exist_ok=True)
+            if not hosts.exists():
+                hosts.write_text("127.0.0.1 localhost\n")
             stub = rootfs / "etc/tpu-dra/stub-config.yaml"
             stub.parent.mkdir(parents=True, exist_ok=True)
             stub.write_text(yaml.safe_dump({
@@ -441,7 +479,8 @@ class MiniCluster:
             jname = job["metadata"]["name"]
             pods = self._pods_of(job["metadata"]["uid"])
             by_index: Dict[int, List[dict]] = {}
-            failed = 0
+            # Failures accumulate across replaced-and-deleted pods.
+            failed = self._job_failures.get(job["metadata"]["uid"], 0)
             for p in pods:
                 idx = int(p["metadata"].get("annotations", {}).get(
                     "batch.kubernetes.io/job-completion-index", 0
@@ -492,6 +531,19 @@ class MiniCluster:
                 ]
                 if live:
                     continue
+                # Replace-and-delete (podReplacementPolicy analog): a
+                # Failed worker still OWNS its template-generated claims
+                # (released on pod deletion), so leaving it would starve
+                # its own replacement of the very devices it needs.
+                for p in ps:
+                    self._job_failures[job["metadata"]["uid"]] = (
+                        self._job_failures.get(
+                            job["metadata"]["uid"], 0
+                        ) + 1
+                    )
+                    self._delete_quiet(
+                        PODS, ns, p["metadata"]["name"]
+                    )
                 self._make_pod(
                     ns,
                     f"{jname}-{idx}-{uuidlib.uuid4().hex[:5]}",
@@ -526,7 +578,15 @@ class MiniCluster:
                     phase = (pod.get("status") or {}).get("phase")
                     if phase in ("Succeeded", "Failed"):
                         continue  # terminal before restart? leave it
-                    self._admit_pod(pod)
+                    if not phase:
+                        # Admission stamps Pending immediately (real
+                        # apiserver/kubelet behavior): a pod held back
+                        # by failing prepares must READ as Pending.
+                        pod.setdefault("status", {})["phase"] = "Pending"
+                        self._update_status_quiet(PODS, pod)
+                    if uid not in self._admitting:
+                        self._admitting.add(uid)
+                        self._admit_pool.submit(self._admit_async, pod)
                 else:
                     self._sync_pod_status(pod, sandbox)
             except Exception:  # noqa: BLE001 — one broken pod must not
@@ -644,16 +704,40 @@ class MiniCluster:
             hypothetical.append(ghost)
         return out
 
+    def _admit_async(self, pod: dict) -> None:
+        uid = pod["metadata"]["uid"]
+        try:
+            self._admit_pod(pod)
+        except Exception:  # noqa: BLE001
+            log.exception(
+                "pod %s/%s admission failed; backing off",
+                pod["metadata"].get("namespace"), pod["metadata"]["name"],
+            )
+            self.next_attempt[uid] = (
+                time.monotonic() + PREPARE_BACKOFF_SECONDS
+            )
+        finally:
+            self._admitting.discard(uid)
+
     def _admit_pod(self, pod: dict) -> None:
         uid = pod["metadata"]["uid"]
         now = time.monotonic()
         if self.next_attempt.get(uid, 0) > now:
             return
+        with self._alloc_lock:
+            node = self._bind_pod(pod, uid, now)
+        if node is None:
+            return
+        self._prepare_and_launch(pod, node)
+
+    def _bind_pod(self, pod: dict, uid: str, now: float) -> Optional[str]:
+        """Claims + allocation + reservation + node binding (under the
+        binder lock); returns the bound node or None to retry later."""
         ns = pod["metadata"].get("namespace", "default")
         claims = self._claims_of(pod)
         if claims is None:
             self.next_attempt[uid] = now + 1.0
-            return
+            return None
         pending = [
             c for c in claims
             if not (c.get("status") or {}).get("allocation")
@@ -688,7 +772,7 @@ class MiniCluster:
                     break
             if chosen is None:
                 self.next_attempt[uid] = now + 1.0
-                return
+                return None
             node, allocs = chosen
             for claim, alloc in zip(pending, allocs):
                 claim.setdefault("status", {})["allocation"] = alloc
@@ -704,7 +788,7 @@ class MiniCluster:
             ]
             if not matching:
                 self.next_attempt[uid] = now + 1.0
-                return
+                return None
             node = matching[0]
         if pod["spec"].get("nodeName") != node:
             pod["spec"]["nodeName"] = node
@@ -712,14 +796,14 @@ class MiniCluster:
             try:
                 self.fc.update(PODS, pod)
             except K8sApiError:
-                return
+                return None
         # Reserve every claim for this pod.
         for claim in claims:
             live = self._try_get(
                 RESOURCE_CLAIMS, ns, claim["metadata"]["name"]
             )
             if live is None:
-                return
+                return None
             reserved = live.setdefault("status", {}).setdefault(
                 "reservedFor", []
             )
@@ -730,7 +814,7 @@ class MiniCluster:
                     "uid": uid,
                 })
                 self._update_status_quiet(RESOURCE_CLAIMS, live)
-        self._prepare_and_launch(pod, node)
+        return node
 
     def _prepare_and_launch(self, pod: dict, node: str) -> None:
         uid = pod["metadata"]["uid"]
@@ -807,6 +891,9 @@ class MiniCluster:
             "TPU_DRA_MULTIPLEX_SOCKET_ROOT": str(
                 rootfs / "run/tpu-multiplex"
             ),
+            # A containerized CD daemon rewrites its own /etc/hosts; a
+            # host process must NEVER touch the real one.
+            "CD_HOSTS_PATH": str(rootfs / "etc/hosts"),
         }
         idx = (pod["metadata"].get("annotations") or {}).get(
             "batch.kubernetes.io/job-completion-index"

@@ -41,13 +41,16 @@ REPO_ROOT = Path(__file__).resolve().parents[2]
 
 class Profile:
     def __init__(self, env=None, arg_subst=None, path_map=None,
-                 cmd_map=None):
+                 cmd_map=None, arg_pairs=None):
         self.env = env or {}
         self.arg_subst = arg_subst or {}
         self.path_map = path_map or {}
         # Image binary name -> host argv prefix (a container image's
         # PATH entrypoints don't exist on the host).
         self.cmd_map = cmd_map or {}
+        # (flag, value) -> replacement value: flag-anchored so a bare
+        # numeric can't be rewritten wherever it appears.
+        self.arg_pairs = arg_pairs or {}
 
 
 PROFILES = {
@@ -70,11 +73,22 @@ PROFILES = {
     # claims, CD bootstrap, jax.distributed, the training loop — is the
     # real one).
     "registry.local/tpu-workload": Profile(
-        env={"JAX_PLATFORMS": "cpu"},
+        # JAX_PLATFORMS alone loses on hosts whose interpreter startup
+        # already imported jax against a tunneled accelerator; the
+        # workload mains honor TPU_DRA_FORCE_PLATFORM via
+        # apply_forced_platform().
+        env={"JAX_PLATFORMS": "cpu", "TPU_DRA_FORCE_PLATFORM": "cpu:1"},
         arg_subst={
             "llama3-8b": "tiny",
             "mixtral-8x7b": "tiny-moe",
-            "30": "2",  # llama-pjit-job --steps 30 -> 2 (CPU wall time)
+        },
+        arg_pairs={
+            # CPU wall-time / fabric calibration: steps trimmed; the
+            # bandwidth threshold is ICI-calibrated, the CPU Gloo
+            # fabric measures the same collectives orders of magnitude
+            # slower.
+            ("--steps", "30"): "2",
+            ("--min-gbps", "1"): "0.01",
         },
     ),
 }
@@ -263,7 +277,13 @@ class PodRunner:
         if argv and argv[0] in profile.cmd_map:
             argv = list(profile.cmd_map[argv[0]]) + argv[1:]
         out = []
+        prev = None
         for tok in argv:
+            pair = profile.arg_pairs.get((prev, tok))
+            prev = tok
+            if pair is not None:
+                out.append(pair)
+                continue
             tok = profile.arg_subst.get(tok, tok)
             for prefix, repl in profile.path_map.items():
                 if tok == prefix:

@@ -134,9 +134,14 @@ class DeviceGate:
             except OSError as e:
                 log.warning("device gate: cannot stat %s: %s", p, e)
         if self._orig_path and self.paths:
+            # MERGE with what was already persisted: a replacement
+            # configured with fewer paths must not destroy the only
+            # record of a still-locked node's true original.
+            merged = dict(persisted)
+            merged.update(self._orig)
             try:
                 with open(self._orig_path, "w") as f:
-                    json.dump(self._orig, f)
+                    json.dump(merged, f)
             except OSError as e:
                 log.warning("device gate: cannot persist orig: %s", e)
 
@@ -158,10 +163,24 @@ class DeviceGate:
             except OSError as e:
                 log.warning("device gate: restore %s: %s", p, e)
         if self._orig_path:
+            # Drop only OUR entries; other (no-longer-configured) paths'
+            # originals stay recorded for whoever still needs them.
             try:
-                os.remove(self._orig_path)
-            except OSError:
-                pass
+                with open(self._orig_path) as f:
+                    remaining = {
+                        k: v for k, v in json.load(f).items()
+                        if k not in self._orig
+                    }
+                if remaining:
+                    with open(self._orig_path, "w") as f:
+                        json.dump(remaining, f)
+                else:
+                    os.remove(self._orig_path)
+            except (OSError, ValueError):
+                try:
+                    os.remove(self._orig_path)
+                except OSError:
+                    pass
 
     def _apply(self, uid: int, mode: int) -> None:
         for p in self.paths:
@@ -685,7 +704,18 @@ class MultiplexDaemon:
         self._stop_sweeper.set()
         self._server.shutdown()
         self._server.server_close()
-        if self.state.gate is not None:
+        # Successor-aware teardown, like the socket unlink below: during
+        # a pod replacement the NEW daemon may have re-bound the socket
+        # and re-armed the gate — the predecessor must then leave the
+        # device modes (and the persisted originals) alone, or it would
+        # briefly un-gate the chip under the successor's feet.
+        try:
+            still_active = (
+                os.stat(self.socket_path).st_ino == self._socket_ino
+            )
+        except FileNotFoundError:
+            still_active = True  # nobody re-bound: teardown is ours
+        if still_active and self.state.gate is not None:
             self.state.gate.restore()
         try:
             if os.stat(self.socket_path).st_ino == self._socket_ino:
