@@ -1,0 +1,151 @@
+"""Minicluster (kind analog) e2e under pytest.
+
+The 13 bats suites exercise the minicluster for ~50 minutes
+(hack/run-bats.sh -> RUN_r04_bats.log); this is the CI-sized slice of
+the same machinery: chart install through the helm shim, DaemonSet
+rollout of REAL plugin processes, a claim-bearing pod admitted through
+template resolution -> allocation -> gRPC Prepare -> CDI env injection,
+claim release on deletion, and namespace cascade.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def _kubectl(base, *args, input_text=None):
+    env = dict(
+        os.environ,
+        KUBECONFIG=os.path.join(base, "kubeconfig.yaml"),
+        MINICLUSTER_DIR=base,
+    )
+    return subprocess.run(
+        [sys.executable, "-m", "tpu_dra.minicluster.kubectl", *args],
+        env=env, capture_output=True, text=True, input=input_text,
+        cwd=REPO_ROOT, timeout=240,
+    )
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    import secrets
+
+    from tpu_dra.minicluster.cluster import MiniCluster
+
+    # Short base on purpose: mkdtemp's 8-char random suffix pushes the
+    # deepest node registration socket past the AF_UNIX sun_path limit
+    # (MiniCluster.start guards this loudly).
+    base = f"/tmp/mc{secrets.token_hex(3)}"
+    os.makedirs(base)
+    mc = MiniCluster(base, num_nodes=2).start()
+    try:
+        yield mc
+    finally:
+        mc.stop()
+        shutil.rmtree(base, ignore_errors=True)
+
+
+def test_chart_install_claims_and_cascade(cluster):
+    base = str(cluster.base)
+    env = dict(
+        os.environ,
+        KUBECONFIG=cluster.kubeconfig,
+        MINICLUSTER_DIR=base,
+    )
+    helm = subprocess.run(
+        [sys.executable, "-m", "tpu_dra.minicluster.helmcli",
+         "upgrade", "--install", "tpu-dra-driver",
+         os.path.join(REPO_ROOT, "deployments/helm/tpu-dra-driver"),
+         "--create-namespace", "--namespace", "tpu-dra-driver",
+         "--set", "tpulibBackend=stub",
+         "--set", "stubInventoryPath=/etc/tpu-dra/stub-config.yaml",
+         "--set", "kubeletPlugin.affinity=null"],
+        env=env, capture_output=True, text=True, cwd=REPO_ROOT,
+        timeout=120,
+    )
+    assert helm.returncode == 0, helm.stderr
+
+    r = _kubectl(
+        base, "-n", "tpu-dra-driver", "rollout", "status",
+        "ds/tpu-dra-driver-kubelet-plugin", "--timeout=150s",
+    )
+    if r.returncode != 0:
+        from tpu_dra.k8sclient.resources import PODS
+
+        detail = [
+            (
+                p["metadata"]["name"],
+                (p.get("status") or {}).get("phase"),
+                (p.get("status") or {}).get("containerStatuses"),
+            )
+            for p in cluster.fc.list(PODS, "tpu-dra-driver")
+        ]
+        import glob as globlib
+
+        tails = {
+            f: open(f, errors="replace").read()[-400:]
+            for f in globlib.glob(
+                os.path.join(base, "logs/tpu-dra-driver/*/*.log")
+            )
+        }
+        raise AssertionError(f"rollout: {r.stderr}\npods: {detail}\n{tails}")
+
+    r = _kubectl(
+        base, "apply", "-f",
+        os.path.join(REPO_ROOT, "tests/bats/specs/tpu-2pods-2chips.yaml"),
+    )
+    assert r.returncode == 0, r.stderr
+    r = _kubectl(
+        base, "-n", "bats-tpu-basic", "wait", "--for=condition=READY",
+        "pods", "pod0", "pod1", "--timeout=180s",
+    )
+    assert r.returncode == 0, r.stderr
+
+    # CDI env reached the container process (its stdout prints TPU_*).
+    deadline = time.monotonic() + 30
+    out = ""
+    while time.monotonic() < deadline:
+        out = _kubectl(base, "-n", "bats-tpu-basic", "logs", "pod0").stdout
+        if "TPU_VISIBLE_DEVICES" in out:
+            break
+        time.sleep(0.5)
+    assert "TPU_VISIBLE_DEVICES" in out, out
+
+    # Distinct chips for distinct claims.
+    r = _kubectl(base, "-n", "bats-tpu-basic", "get", "resourceclaims",
+                 "-o", "json")
+    assert r.returncode == 0, r.stderr
+    import json as jsonlib
+
+    claims = jsonlib.loads(r.stdout)["items"]
+    devices = [
+        c["status"]["allocation"]["devices"]["results"][0]["device"]
+        for c in claims if (c.get("status") or {}).get("allocation")
+    ]
+    assert len(devices) == 2 and devices[0] != devices[1], claims
+
+    # Pod deletion GCs the template-generated claims.
+    r = _kubectl(base, "-n", "bats-tpu-basic", "delete", "pod",
+                 "pod0", "pod1", "--timeout=60s")
+    assert r.returncode == 0, r.stderr
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        r = _kubectl(base, "-n", "bats-tpu-basic", "get",
+                     "resourceclaims", "--no-headers")
+        if r.returncode == 0 and not r.stdout.strip():
+            break
+        time.sleep(0.5)
+    assert r.returncode == 0, r.stderr
+    assert not r.stdout.strip(), r.stdout
+
+    # Namespace cascade.
+    r = _kubectl(base, "delete", "namespace", "bats-tpu-basic",
+                 "--timeout=60s")
+    assert r.returncode == 0, r.stderr

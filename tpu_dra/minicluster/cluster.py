@@ -150,17 +150,23 @@ class MiniCluster:
     # The deepest per-node socket path the driver binds; AF_UNIX caps
     # sun_path around 107 chars, and gRPC just says "failed to bind".
     _DEEPEST_SOCKET_SUFFIX = (
-        "/nodes/node-0/rootfs/var/lib/kubelet/plugins_registry/"
+        "/nodes/{node}/rootfs/var/lib/kubelet/plugins_registry/"
         "compute-domain.tpu.google.com-reg.sock"
     )
 
     def start(self) -> "MiniCluster":
-        deepest = str(self.base) + self._DEEPEST_SOCKET_SUFFIX
-        if len(deepest) > 107:
+        longest_node = max(self.node_names, key=len)
+        deepest = str(self.base) + self._DEEPEST_SOCKET_SUFFIX.format(
+            node=longest_node
+        )
+        # Linux sun_path is 108 bytes incl. NUL and gRPC's unix:// bind
+        # fails at 107 measured chars; 105 is the longest observed to
+        # work — keep a safety char.
+        if len(deepest) > 105:
             raise ValueError(
                 f"--base-dir too long: the node registration socket "
-                f"path would be {len(deepest)} chars, over AF_UNIX's "
-                f"~107 limit; use a shorter base (e.g. /tmp/mcXXXXXX)"
+                f"path would be {len(deepest)} chars, over the AF_UNIX "
+                f"sun_path limit; use a shorter base (e.g. /tmp/mcXXXXXX)"
             )
         self.srv.start()
         self.srv.write_kubeconfig(self.kubeconfig)
