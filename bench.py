@@ -17,7 +17,11 @@ runtime gives real workloads):
 4. **sub-slice** (BASELINE config 5): one training leg under a 1x1x1
    dynamic sub-slice claim's rendered env (TPU_CHIPS_PER_PROCESS_BOUNDS /
    TPU_PROCESS_BOUNDS / TPU_VISIBLE_DEVICES), asserting the runtime
-   respects the bounds (exactly one visible device);
+   respects the bounds (exactly one visible device); plus the
+   **reshape-under-load** leg (r4): prepare/unprepare churn on the other
+   chips of the same node state while the sub-slice leg is live-stepping
+   (heartbeat-proven), with per-cycle overlap-refusal probes and a
+   post-churn byte-identical CDI spec check on the held claim;
 5. **decode** (serving): KV-cache prefill + scan decode through the DRA
    claim env, greedy and temperature/top-k sampled tokens/sec;
 6. **time-slice rotation**: the arbiter in time-slice mode with TWO live
@@ -228,10 +232,17 @@ def bench_config():
         seq = int(os.environ.get("BENCH_SEQ", "1024"))
         steps = int(os.environ.get("BENCH_STEPS", "20"))
         return config, batch, seq, steps
-    # CPU fallback: tiny but the same code path.
+    # CPU fallback: tiny but the same code path. Honors the same env
+    # hooks as the chip branches so hardware-free drills (e.g. the
+    # reshape-under-load pytest) can size the leg's runtime.
     from tpu_dra.workloads.models.llama import TINY_LLAMA
 
-    return TINY_LLAMA, 2, 64, 3
+    return (
+        TINY_LLAMA,
+        int(os.environ.get("BENCH_BATCH", "2")),
+        int(os.environ.get("BENCH_SEQ", "64")),
+        int(os.environ.get("BENCH_STEPS", "3")),
+    )
 
 
 def measure_tokens_per_sec() -> dict:
@@ -261,10 +272,27 @@ def measure_tokens_per_sec() -> dict:
 
     state, loss = step(state, tokens)
     fetch(loss)
+    # Optional liveness trace for the reshape-under-load leg: fetch in
+    # small chunks and append a wall-clock heartbeat after each, so the
+    # parent can prove this workload kept advancing while it churned the
+    # node's sub-slice state. Costs a few extra host fetches; only active
+    # when requested.
+    progress_path = os.environ.get("BENCH_PROGRESS_FILE")
     t0 = time.monotonic()
-    for _ in range(steps):
-        state, loss = step(state, tokens)
-    fetch(loss)
+    if progress_path:
+        done = 0
+        while done < steps:
+            chunk = min(4, steps - done)
+            for _ in range(chunk):
+                state, loss = step(state, tokens)
+            fetch(loss)
+            done += chunk
+            with open(progress_path, "a") as f:
+                f.write(f"{done} {time.monotonic()}\n")
+    else:
+        for _ in range(steps):
+            state, loss = step(state, tokens)
+        fetch(loss)
     dt = time.monotonic() - t0
     total_tokens = batch * seq * steps
     return {
@@ -878,7 +906,217 @@ def measure_timeslice_rotation(duration: float = 20.0) -> dict:
     }
 
 
+def measure_reshape_under_load(max_cycles: int = 200) -> dict:
+    """BASELINE config 5, under load: a live training leg holds a 1x1
+    dynamic sub-slice claim (real chip when available) while THIS process
+    churns prepare/unprepare reshape cycles on the *other* chips of the
+    same node's DeviceState — same checkpoint file, same flocks, same CDI
+    directory. Each cycle also attempts an OVERLAPPING prepare against the
+    held coordinates and requires it to be refused (the double-booking
+    defense stays live under churn). Proves the MIG-analog guarantee: a
+    reshape next door never disturbs a running workload's allocation.
+
+    Reports reshape cycle p50/p95 latency, cycles completed while the
+    workload was demonstrably stepping (heartbeat file), and the held
+    claim's post-churn integrity (byte-identical CDI spec + idempotent
+    re-prepare).
+    """
+    from tpu_dra.infra import featuregates as fg
+    from tpu_dra.plugin.device_state import PrepareError
+
+    saved = fg.feature_gates()
+    g = fg.FeatureGates()
+    g.set("DynamicSubslice", True)
+    fg.reset_for_tests(g)
+    td = tempfile.mkdtemp(prefix="bench-reshape-")
+    import shutil
+
+    try:
+        state = make_bench_state(td)
+        by_coords = {
+            name: frozenset(dev.chip_coords())
+            for name, dev in state.allocatable.items()
+            if name.startswith("tpu-ss-1x1-")
+        }
+        if len(by_coords) < 2:
+            raise RuntimeError(
+                "need >= 2 disjoint 1x1 sub-slice shapes for the reshape leg"
+            )
+        held_name = sorted(by_coords)[0]
+        held_coords = by_coords[held_name]
+        disjoint = sorted(
+            n for n, c in by_coords.items() if not (c & held_coords)
+        )
+        overlapping = sorted(
+            n
+            for n, dev in state.allocatable.items()
+            if n != held_name and frozenset(dev.chip_coords()) & held_coords
+        )
+        if not disjoint:
+            raise RuntimeError("no disjoint 1x1 placement on this host model")
+        if not overlapping:
+            raise RuntimeError(
+                f"no advertised device overlaps the held coordinates of "
+                f"{held_name}; cannot probe the double-booking defense"
+            )
+
+        held = make_claim(0, held_name)
+        held_uid = held["metadata"]["uid"]
+        held_devices = state.prepare(held)
+        env_before = _cdi_env(state, held_uid)
+        spec_before = json.dumps(
+            state.cdi.read_claim_spec(held_uid), sort_keys=True
+        )
+
+        progress = os.path.join(td, "progress")
+        leg_env = _filter_claim_env(env_before)
+        leg_env["BENCH_ASSERT_ONE_DEVICE"] = "1"
+        leg_env["BENCH_PROGRESS_FILE"] = progress
+        leg_env.setdefault(
+            "BENCH_STEPS", os.environ.get("BENCH_RESHAPE_STEPS", "40")
+        )
+        proc = _run_leg(leg_env, wait=False)
+
+        def heartbeats() -> int:
+            try:
+                with open(progress) as f:
+                    return sum(1 for _ in f)
+            except FileNotFoundError:
+                return 0
+
+        # Wait out compile: churn only counts while the workload is
+        # demonstrably stepping. A leg that couldn't attach the chip
+        # (previous leg's device lock not yet released) is respawned with
+        # backoff, matching _collect_leg's RC_NO_TPU contract.
+        deadline = time.monotonic() + 600
+        attach_attempts = 0
+        while heartbeats() < 1:
+            rc = proc.poll()
+            if rc is not None:
+                out, err = proc.communicate()
+                if rc == RC_NO_TPU and attach_attempts < 3:
+                    attach_attempts += 1
+                    print(
+                        f"reshape leg could not attach the TPU (attempt "
+                        f"{attach_attempts}); retrying in 5s",
+                        file=sys.stderr,
+                    )
+                    time.sleep(5)
+                    proc = _spawn_leg(leg_env, "--leg")
+                    continue
+                raise RuntimeError(
+                    f"reshape workload died before stepping "
+                    f"(rc={rc}): {err[-2000:]}"
+                )
+            if time.monotonic() > deadline:
+                proc.kill()
+                proc.communicate()
+                raise RuntimeError("reshape workload never produced a step")
+            time.sleep(0.05)
+
+        hb_start = heartbeats()
+        latencies = []
+        hb_at_cycle_start = []
+        refused = 0
+        cycles = 0
+        i = 1
+        # Churn for max_cycles, then keep churning (wall-clock-bounded)
+        # until at least one cycle provably overlapped live stepping — a
+        # fast churner can otherwise finish inside a single heartbeat
+        # interval and prove nothing. heartbeats() is monotonic, so the
+        # FIRST cycle's count is the minimum: one later heartbeat proves
+        # overlap for that cycle.
+        ext_deadline = None
+        try:
+            while proc.poll() is None:
+                hb_now = heartbeats()
+                if cycles >= max_cycles:
+                    if hb_at_cycle_start and hb_at_cycle_start[0] < hb_now:
+                        break
+                    if ext_deadline is None:
+                        ext_deadline = time.monotonic() + 120
+                    elif time.monotonic() > ext_deadline:
+                        break
+                hb_at_cycle_start.append(hb_now)
+                target = disjoint[cycles % len(disjoint)]
+                c = make_claim(i, target)
+                i += 1
+                t0 = time.monotonic()
+                state.prepare(c)
+                state.unprepare(c["metadata"]["uid"])
+                latencies.append(time.monotonic() - t0)
+                # Overlap probe: a device covering the held coordinate must
+                # be refused while the workload's claim is prepared.
+                probe = make_claim(i, overlapping[0])
+                i += 1
+                try:
+                    state.prepare(probe)
+                except PrepareError:
+                    refused += 1
+                else:
+                    state.unprepare(probe["metadata"]["uid"])
+                    raise RuntimeError(
+                        f"overlapping device {overlapping[0]} was prepared "
+                        f"while {held_name} was held"
+                    )
+                cycles += 1
+        except BaseException:
+            # Never orphan the training leg: on a real chip it would hold
+            # the device lock and poison every following leg/re-run.
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
+            raise
+        hb_end = heartbeats()
+        # A cycle overlapped live stepping iff more heartbeats arrived
+        # after it began (the workload demonstrably advanced past it).
+        while_stepping = sum(1 for h in hb_at_cycle_start if h < hb_end)
+        result = _collect_leg(proc)
+        if not latencies:
+            raise RuntimeError(
+                f"no reshape cycle ran while the workload was live — the "
+                f"leg finished before churn could start (leg result: "
+                f"{result})"
+            )
+
+        spec_after = json.dumps(
+            state.cdi.read_claim_spec(held_uid), sort_keys=True
+        )
+        if spec_after != spec_before:
+            raise RuntimeError(
+                "held claim's CDI spec changed under reshape churn"
+            )
+        # Idempotent re-prepare must short-circuit on PrepareCompleted and
+        # hand back the same devices (device_state.go:200-207 analog).
+        again = state.prepare(held)
+        if sorted(d.device_name for d in again) != sorted(
+            d.device_name for d in held_devices
+        ):
+            raise RuntimeError("re-prepare of the held claim drifted")
+        state.unprepare(held_uid)
+
+        lat_ms = sorted(x * 1000 for x in latencies)
+        return {
+            "cycles": cycles,
+            "cycles_while_stepping": while_stepping,
+            "overlap_refusals": refused,
+            "reshape_p50_ms": round(statistics.median(lat_ms), 2),
+            "reshape_p95_ms": round(lat_ms[int(0.95 * (len(lat_ms) - 1))], 2),
+            "neighbor_tok_s": round(result["tok_s"], 1),
+            "heartbeats": (hb_start, hb_end),
+        }
+    finally:
+        fg.reset_for_tests(saved)
+        shutil.rmtree(td, ignore_errors=True)
+
+
 def main() -> int:
+    # Honor TPU_DRA_FORCE_PLATFORM for every entry (probe + all leg
+    # mains): on hosts whose interpreter startup pre-attaches a tunneled
+    # accelerator, env vars alone cannot re-pin the backend.
+    from tpu_dra.workloads import apply_forced_platform
+
+    apply_forced_platform()
     if "--probe" in sys.argv:
         import jax
 
@@ -973,6 +1211,20 @@ def main() -> int:
         file=sys.stderr,
     )
 
+    # Dynamic re-partition UNDER A RUNNING WORKLOAD (BASELINE config 5, r4):
+    # churn reshape cycles on the same node state while a live leg holds
+    # its sub-slice claim.
+    reshape = measure_reshape_under_load()
+    print(
+        f"reshape-under-load: {reshape['cycles']} cycles "
+        f"({reshape['cycles_while_stepping']} while stepping), p50 "
+        f"{reshape['reshape_p50_ms']:.2f} ms p95 "
+        f"{reshape['reshape_p95_ms']:.2f} ms, overlap refusals "
+        f"{reshape['overlap_refusals']}, neighbor "
+        f"{reshape['neighbor_tok_s']:.1f} tok/s/chip",
+        file=sys.stderr,
+    )
+
     # Serving: KV-cache decode through the DRA claim env (r3).
     decode = _run_leg(_filter_claim_env(dra_env), flag="--leg-decode")
     print(
@@ -1038,6 +1290,14 @@ def main() -> int:
                 "sharing_per_client_tok_s": sharing["per_client_tok_s"],
                 "subslice_tok_s": round(subslice["tok_s"], 1),
                 "prepare_p50_ms": round(prep_p50 * 1000, 2),
+                "reshape_cycles": reshape["cycles"],
+                "reshape_cycles_while_stepping": reshape[
+                    "cycles_while_stepping"
+                ],
+                "reshape_p50_ms": reshape["reshape_p50_ms"],
+                "reshape_p95_ms": reshape["reshape_p95_ms"],
+                "reshape_overlap_refusals": reshape["overlap_refusals"],
+                "reshape_neighbor_tok_s": reshape["neighbor_tok_s"],
                 "decode_tok_s": round(decode["greedy_tok_s"], 1),
                 "decode_sampled_tok_s": round(decode["sampled_tok_s"], 1),
                 "timeslice_aggregate_tok_s": round(
