@@ -348,11 +348,13 @@ def test_fused_ce_matches_unfused_loss_and_grads():
         )
 
 
-def test_decode_matches_full_forward():
+@pytest.mark.parametrize("scan", [True, False], ids=["stacked", "unrolled"])
+def test_decode_matches_full_forward(scan):
     """generate.py's hand-rolled KV-cache decode must replay the training
     forward exactly: teacher-forced decode logits == full causal forward
     logits, both for a whole-prompt prefill chunk and for one-token
-    steps."""
+    steps — in BOTH param/cache layouts (scan-stacked and the unrolled
+    in-place-cache fast path)."""
     import dataclasses
 
     from tpu_dra.workloads.generate import (
@@ -362,7 +364,8 @@ def test_decode_matches_full_forward():
     )
 
     cfg = dataclasses.replace(
-        TINY_LLAMA, dtype=jnp.float32, param_dtype=jnp.float32
+        TINY_LLAMA, dtype=jnp.float32, param_dtype=jnp.float32,
+        scan_layers=scan,
     )
     model = Llama(cfg)
     params = model.init_params(jax.random.PRNGKey(7), batch=2, seq=10)
@@ -373,16 +376,27 @@ def test_decode_matches_full_forward():
 
     # Prefill chunk == full forward.
     cache, prefill_logits = forward_chunk(
-        cfg, params, init_cache(cfg, 2, 16), tokens
+        cfg, params, init_cache(cfg, 2, 16, stacked=scan), tokens
     )
     np.testing.assert_allclose(
         np.asarray(prefill_logits), np.asarray(full), rtol=2e-4, atol=2e-4
     )
     assert int(cache.pos) == 10
 
+    # Two-chunk prefill (pos>0 AND s>1): the stacked layout's score
+    # overwrite + value correction at a nonzero offset, the subtlest
+    # configuration of the split contraction.
+    cache_mc = init_cache(cfg, 2, 16, stacked=scan)
+    cache_mc, lg_a = forward_chunk(cfg, params, cache_mc, tokens[:, :6])
+    cache_mc, lg_b = forward_chunk(cfg, params, cache_mc, tokens[:, 6:])
+    np.testing.assert_allclose(
+        np.concatenate([np.asarray(lg_a), np.asarray(lg_b)], axis=1),
+        np.asarray(full), rtol=2e-4, atol=2e-4,
+    )
+
     # Teacher-forced single-token steps == full forward, position by
     # position (the cache path, offsets, and masks all in play).
-    cache2 = init_cache(cfg, 2, 16)
+    cache2 = init_cache(cfg, 2, 16, stacked=scan)
     step_logits = []
     for t in range(10):
         cache2, lg = forward_chunk(cfg, params, cache2, tokens[:, t:t + 1])

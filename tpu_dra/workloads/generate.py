@@ -7,10 +7,12 @@ control flow on device values, so XLA compiles one prefill and one
 decode-step executable).
 
 The decode forward is a hand-rolled replay of models/llama.py's math
-over the SAME parameter tree (scan-stacked layers). Equivalence is
-pinned by tests/test_workloads.py::test_decode_matches_full_forward:
+over the SAME parameter tree, in either layout: scan-stacked layers or
+unrolled ``layer_{i}`` subtrees (the in-place-cache fast path).
+Equivalence of BOTH is pinned by
+tests/test_workloads.py::test_decode_matches_full_forward:
 teacher-forced decode logits must match the training forward's logits
-position by position, so the two implementations cannot drift silently.
+position by position, so the implementations cannot drift silently.
 
 No reference counterpart (the reference is a DRA driver); this is the
 workload-payload layer's serving path.
@@ -35,11 +37,30 @@ from tpu_dra.workloads.models.llama import (
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class DecodeCache:
-    """Per-layer stacked KV cache: k/v [L, b, max_seq, kvh, hd]; pos is
-    the number of positions already written (same for every layer)."""
+    """KV cache; pos is the number of positions already written (same
+    for every layer). Two layouts matching the model's two param
+    layouts:
 
-    k: jnp.ndarray
-    v: jnp.ndarray
+    - stacked (``scan_layers=True`` params): k/v are single arrays
+      [L, b, max_seq, kvh, hd] scanned alongside the stacked layer
+      params;
+    - unrolled (``scan_layers=False`` params, the bench training
+      default): k/v are L-tuples of [b, max_seq, kvh, hd] — each
+      layer's buffer has a single def-use chain per step (in-place
+      dynamic_update_slice then attend), which XLA aliases across
+      decode-scan iterations instead of copying the whole cache every
+      token (the stacked layout pays streamed xs reads + a bulk append
+      against a second buffer).
+
+    INVARIANT (stacked layout): slots at positions >= pos are ZERO.
+    init_cache guarantees it and forward_chunk preserves it (each chunk
+    writes exactly [pos, pos+s)); the stacked attention's split value
+    contraction relies on it. Rewinding pos (speculative-decode
+    rejection) or building a cache by other means breaks it silently —
+    zero the tail first."""
+
+    k: "jnp.ndarray | tuple"  # stacked array or L-tuple of per-layer arrays
+    v: "jnp.ndarray | tuple"
     pos: jnp.ndarray  # scalar int32
 
     def tree_flatten(self):
@@ -51,14 +72,22 @@ class DecodeCache:
 
 
 def init_cache(
-    config: LlamaConfig, batch: int, max_seq: int
+    config: LlamaConfig, batch: int, max_seq: int, stacked: bool = True
 ) -> DecodeCache:
-    shape = (
-        config.n_layers, batch, max_seq, config.n_kv_heads, config.head_dim
-    )
+    shape = (batch, max_seq, config.n_kv_heads, config.head_dim)
+    if stacked:
+        return DecodeCache(
+            k=jnp.zeros((config.n_layers,) + shape, config.dtype),
+            v=jnp.zeros((config.n_layers,) + shape, config.dtype),
+            pos=jnp.zeros((), jnp.int32),
+        )
     return DecodeCache(
-        k=jnp.zeros(shape, config.dtype),
-        v=jnp.zeros(shape, config.dtype),
+        k=tuple(
+            jnp.zeros(shape, config.dtype) for _ in range(config.n_layers)
+        ),
+        v=tuple(
+            jnp.zeros(shape, config.dtype) for _ in range(config.n_layers)
+        ),
         pos=jnp.zeros((), jnp.int32),
     )
 
@@ -71,6 +100,38 @@ def _rms(x: jnp.ndarray, scale: jnp.ndarray, eps: float) -> jnp.ndarray:
     ).astype(x.dtype)
 
 
+def _project_qkv(c, lp, x, cos, sin, b, s):
+    """Shared front half of a decoder layer: pre-norm + roped q/k/v
+    projections (identical in both cache layouts)."""
+    att = lp["attention"]
+    h = _rms(x, lp["attention_norm"]["scale"], c.norm_eps)
+    q = (h @ att["wq"]["kernel"].astype(c.dtype)).reshape(
+        b, s, c.n_heads, c.head_dim
+    )
+    k = (h @ att["wk"]["kernel"].astype(c.dtype)).reshape(
+        b, s, c.n_kv_heads, c.head_dim
+    )
+    v = (h @ att["wv"]["kernel"].astype(c.dtype)).reshape(
+        b, s, c.n_kv_heads, c.head_dim
+    )
+    return apply_rope(q, cos, sin), apply_rope(k, cos, sin), v
+
+
+def _finish_block(c, lp, x, out, b, s):
+    """Shared back half: attention output projection + residual MLP
+    (identical in both cache layouts)."""
+    att = lp["attention"]
+    out = out.reshape(b, s, c.n_heads * c.head_dim)
+    x = x + out @ att["wo"]["kernel"].astype(c.dtype)
+    mlp = lp["mlp"]
+    h2 = _rms(x, lp["mlp_norm"]["scale"], c.norm_eps)
+    gate = h2 @ mlp["w_gate"]["kernel"].astype(c.dtype)
+    up = h2 @ mlp["w_up"]["kernel"].astype(c.dtype)
+    return x + (jax.nn.silu(gate) * up) @ mlp["w_down"]["kernel"].astype(
+        c.dtype
+    )
+
+
 def forward_chunk(
     config: LlamaConfig,
     params: dict,
@@ -80,12 +141,20 @@ def forward_chunk(
     """Process ``tokens`` [b, s] at absolute positions
     ``cache.pos .. cache.pos+s-1``: append K/V, attend over everything
     written so far, and return (updated cache, logits [b, s, vocab]).
-    Prefill is a long chunk; a decode step is s=1. Requires the
-    scan-stacked parameter layout (``scan_layers=True``, the default)."""
+    Prefill is a long chunk; a decode step is s=1. Handles both param
+    layouts: scan-stacked (``scan_layers=True``) and unrolled (the
+    cache layout must match — ``_generate`` wires this up)."""
     c = config
-    assert "layers" in params, "decode needs scan_layers=True param layout"
+    stacked = "layers" in params
+    if isinstance(cache.k, (tuple, list)) == stacked:
+        raise ValueError(
+            f"cache layout does not match param layout: params are "
+            f"{'stacked' if stacked else 'unrolled'} but cache.k is a "
+            f"{type(cache.k).__name__}; build the cache with "
+            f"init_cache(..., stacked={stacked})"
+        )
     b, s = tokens.shape
-    max_seq = cache.k.shape[2]
+    max_seq = cache.k.shape[2] if stacked else cache.k[0].shape[1]
     x = params["embed"]["embedding"].astype(c.dtype)[tokens]  # [b, s, d]
     positions = cache.pos + jnp.arange(s)
     cos, sin = rope_frequencies(c, positions)  # [s, hd/2]
@@ -99,58 +168,108 @@ def forward_chunk(
     n_rep = c.n_heads // c.n_kv_heads
 
     def block(x, layer):
+        # ck/cv are the layer's cache as SCANNED INPUTS (streamed reads);
+        # positions >= cache.pos are guaranteed zero (init_cache zeros
+        # them and every chunk writes exactly [pos, pos+s)). The scan
+        # emits only the s NEW positions' k/v — rewriting the full cache
+        # as stacked scan outputs costs two whole-cache copies per decode
+        # step (measured 4x the roofline step time at batch 128 on v5e).
         lp, ck, cv = layer  # ck/cv: [b, max_seq, kvh, hd]
-        att = lp["attention"]
-        h = _rms(x, lp["attention_norm"]["scale"], c.norm_eps)
-        q = (h @ att["wq"]["kernel"].astype(c.dtype)).reshape(
-            b, s, c.n_heads, c.head_dim
-        )
-        k = (h @ att["wk"]["kernel"].astype(c.dtype)).reshape(
-            b, s, c.n_kv_heads, c.head_dim
-        )
-        v = (h @ att["wv"]["kernel"].astype(c.dtype)).reshape(
-            b, s, c.n_kv_heads, c.head_dim
-        )
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-        ck = lax.dynamic_update_slice(ck, k, (0, cache.pos, 0, 0))
-        cv = lax.dynamic_update_slice(cv, v, (0, cache.pos, 0, 0))
+        q, k, v = _project_qkv(c, lp, x, cos, sin, b, s)
         # GQA without materializing an n_rep-times copy of the cache
         # (the decode hot path would pay that per layer per step):
         # group query heads kv-major — head i belongs to kv group
         # i // n_rep, matching ops/attention.py _repeat_kv order — and
         # contract straight against the grouped cache.
         qg = q.reshape(b, s, c.n_kv_heads, n_rep, c.head_dim)
+        # Scores against the (stale-at-[pos,pos+s)) streamed cache, then
+        # overwrite the in-chunk columns with the fresh keys' scores.
         logits = jnp.einsum(
             "bqhrd,bkhd->bhrqk", qg, ck,
             preferred_element_type=jnp.float32,
         ) * scale
+        chunk_scores = jnp.einsum(
+            "bqhrd,bkhd->bhrqk", qg, k,
+            preferred_element_type=jnp.float32,
+        ) * scale
+        logits = lax.dynamic_update_slice(
+            logits, chunk_scores, (0, 0, 0, 0, cache.pos)
+        )
         logits = jnp.where(mask[None, None, None], logits, -1e30)
         probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        pv = probs.astype(cv.dtype)
+        # Value contraction splits the same way: the streamed cache's
+        # [pos, pos+s) columns are zero, so their term vanishes and the
+        # fresh values enter through the sliced correction.
         out = jnp.einsum(
-            "bhrqk,bkhd->bqhrd", probs.astype(cv.dtype), cv,
+            "bhrqk,bkhd->bqhrd", pv, cv,
             preferred_element_type=jnp.float32,
-        ).astype(c.dtype)
-        out = out.reshape(b, s, c.n_heads * c.head_dim)
-        x = x + out @ att["wo"]["kernel"].astype(c.dtype)
-        mlp = lp["mlp"]
-        h2 = _rms(x, lp["mlp_norm"]["scale"], c.norm_eps)
-        gate = h2 @ mlp["w_gate"]["kernel"].astype(c.dtype)
-        up = h2 @ mlp["w_up"]["kernel"].astype(c.dtype)
-        x = x + (jax.nn.silu(gate) * up) @ mlp["w_down"]["kernel"].astype(
-            c.dtype
         )
-        return x, (ck, cv)
+        chunk_probs = lax.dynamic_slice(
+            pv, (0, 0, 0, 0, cache.pos), (b, c.n_kv_heads, n_rep, s, s)
+        )
+        out = out + jnp.einsum(
+            "bhrqk,bkhd->bqhrd", chunk_probs, v,
+            preferred_element_type=jnp.float32,
+        )
+        return _finish_block(c, lp, x, out.astype(c.dtype), b, s), (k, v)
 
-    x, (new_k, new_v) = lax.scan(
-        block, x, (params["layers"]["block"], cache.k, cache.v)
-    )
+    if stacked:
+        x, (k_new, v_new) = lax.scan(
+            block, x, (params["layers"]["block"], cache.k, cache.v)
+        )
+        # One bulk append outside the scan: k_new/v_new are
+        # [L, b, s, kvh, hd] (s tokens per layer), written into the
+        # static cache at pos.
+        new_k = lax.dynamic_update_slice(
+            cache.k, k_new, (0, 0, cache.pos, 0, 0)
+        )
+        new_v = lax.dynamic_update_slice(
+            cache.v, v_new, (0, 0, cache.pos, 0, 0)
+        )
+        new_cache = DecodeCache(k=new_k, v=new_v, pos=cache.pos + s)
+    else:
+        # Unrolled layers: each layer's cache buffer is updated in place
+        # (single def-use chain per step — XLA aliases it across decode
+        # iterations; measured 8.3k -> on the way to roofline at batch
+        # 128 on v5e vs the stacked path's bulk-append copies).
+        ks, vs = list(cache.k), list(cache.v)
+        for i in range(c.n_layers):
+            x, ks[i], vs[i] = _block_inplace(
+                c, params[f"layer_{i}"], x, ks[i], vs[i], cache.pos,
+                mask, cos, sin, n_rep, b, s,
+            )
+        new_cache = DecodeCache(
+            k=tuple(ks), v=tuple(vs), pos=cache.pos + s
+        )
     x = _rms(x, params["final_norm"]["scale"], c.norm_eps)
     logits = (x @ params["lm_head"]["kernel"].astype(c.dtype)).astype(
         jnp.float32
     )
-    new_cache = DecodeCache(k=new_k, v=new_v, pos=cache.pos + s)
     return new_cache, logits
+
+
+def _block_inplace(c, lp, x, ck, cv, pos, mask, cos, sin, n_rep, b, s):
+    """One unrolled decoder layer over a single-layer cache
+    [b, max_seq, kvh, hd]: append this chunk's K/V in place, then attend
+    over the updated buffer (the straightforward update-then-attend —
+    correct here because the buffer is not simultaneously a scan input)."""
+    scale = c.head_dim ** -0.5
+    q, k, v = _project_qkv(c, lp, x, cos, sin, b, s)
+    ck = lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
+    cv = lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
+    qg = q.reshape(b, s, c.n_kv_heads, n_rep, c.head_dim)
+    logits = jnp.einsum(
+        "bqhrd,bkhd->bhrqk", qg, ck,
+        preferred_element_type=jnp.float32,
+    ) * scale
+    logits = jnp.where(mask[None, None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    out = jnp.einsum(
+        "bhrqk,bkhd->bqhrd", probs.astype(cv.dtype), cv,
+        preferred_element_type=jnp.float32,
+    ).astype(c.dtype)
+    return _finish_block(c, lp, x, out, b, s), ck, cv
 
 
 def _generate(
@@ -172,7 +291,7 @@ def _generate(
         f"cache too small: max_seq={max_seq} < "
         f"prompt {s} + max_new_tokens {max_new_tokens}"
     )
-    cache = init_cache(config, b, max_seq)
+    cache = init_cache(config, b, max_seq, stacked="layers" in params)
     cache, logits = forward_chunk(config, params, cache, prompt)
     first = pick(logits[:, -1], 0).astype(prompt.dtype)
 
