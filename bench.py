@@ -384,6 +384,22 @@ def _leg_decode_main() -> int:
         fetch(out)
         dt = time.monotonic() - t0
         results[f"{name}_tok_s"] = batch * new_tokens * reps / dt
+
+    # int8 weight-only serving leg (workloads/quantize.py): same decode
+    # code over a quantized param tree — halves the per-step weight read.
+    from tpu_dra.workloads.quantize import quantize_params
+
+    qparams = jax.device_put(quantize_params(params))
+    out = greedy(qparams, prompt)
+    fetch(out)
+    assert out.shape == (batch, prompt_len + new_tokens), out.shape
+    t0 = time.monotonic()
+    for _ in range(reps):
+        out = greedy(qparams, prompt)
+    fetch(out)
+    results["greedy_int8_tok_s"] = (
+        batch * new_tokens * reps / (time.monotonic() - t0)
+    )
     results.update(
         {"batch": batch, "prompt_len": prompt_len,
          "new_tokens": new_tokens, "reps": reps}
@@ -1236,7 +1252,8 @@ def main() -> int:
     print(
         f"decode (batch {decode['batch']}, {decode['new_tokens']} new): "
         f"greedy {decode['greedy_tok_s']:.1f} tok/s, sampled "
-        f"{decode['sampled_tok_s']:.1f} tok/s",
+        f"{decode['sampled_tok_s']:.1f} tok/s, int8 weight-only "
+        f"{decode['greedy_int8_tok_s']:.1f} tok/s",
         file=sys.stderr,
     )
 
@@ -1306,6 +1323,9 @@ def main() -> int:
                 "reshape_neighbor_tok_s": reshape["neighbor_tok_s"],
                 "decode_tok_s": round(decode["greedy_tok_s"], 1),
                 "decode_sampled_tok_s": round(decode["sampled_tok_s"], 1),
+                "decode_int8_tok_s": round(
+                    decode["greedy_int8_tok_s"], 1
+                ),
                 "timeslice_aggregate_tok_s": round(
                     rotation["aggregate_tok_s"], 1
                 ),

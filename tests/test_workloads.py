@@ -454,3 +454,108 @@ def test_sample_generate_modes():
     assert hot.shape == (2, 12)
     assert jnp.array_equal(hot[:, :6], prompt)
     assert bool(jnp.all((hot >= 0) & (hot < cfg.vocab_size)))
+
+
+@pytest.mark.parametrize("scan", [True, False], ids=["stacked", "unrolled"])
+def test_int8_weight_only_decode(scan):
+    """workloads/quantize.py: per-output-channel int8 weight-only
+    quantization. Unit bound: dequantization error <= scale/2 per
+    element. E2E: the SAME decode code runs the quantized tree (both
+    param layouts) and its teacher-forced logits stay close to full
+    precision — quantized serving must not fork the forward."""
+    import dataclasses
+
+    from tpu_dra.workloads.generate import (
+        forward_chunk,
+        greedy_generate,
+        init_cache,
+    )
+    from tpu_dra.workloads.quantize import (
+        dequantize_weight,
+        quantize_params,
+        quantize_weight,
+    )
+
+    # Unit: error bound + int8 range, including a zero column (scale
+    # guard) — per-channel scale means each output column is bounded by
+    # ITS OWN absmax/254.
+    w = jax.random.normal(jax.random.PRNGKey(0), (32, 16), jnp.float32)
+    w = w.at[:, 3].set(0.0)
+    q = quantize_weight(w)
+    assert q["kernel_q"].dtype == jnp.int8
+    assert int(jnp.max(jnp.abs(q["kernel_q"]))) <= 127
+    err = jnp.abs(dequantize_weight(q) - w)
+    assert bool(jnp.all(err <= q["scale"] / 2 + 1e-7))
+
+    cfg = dataclasses.replace(
+        TINY_LLAMA, dtype=jnp.float32, param_dtype=jnp.float32,
+        scan_layers=scan,
+    )
+    model = Llama(cfg)
+    params = model.init_params(jax.random.PRNGKey(7), batch=2, seq=10)
+    qparams = quantize_params(params)
+    # Norm scales and embeddings must be untouched; kernels replaced.
+    assert "embedding" in qparams["embed"]
+    assert "kernel_q" in qparams["lm_head"]
+
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(8), (2, 10), 0, cfg.vocab_size
+    ).astype(jnp.int32)
+    _, fp = forward_chunk(
+        cfg, params, init_cache(cfg, 2, 16, stacked=scan), tokens
+    )
+    _, q8 = forward_chunk(
+        cfg, qparams, init_cache(cfg, 2, 16, stacked=scan), tokens
+    )
+    # Quality: relative error of the logit tensor stays small (weight
+    # rounding only; activations stay fp32 here).
+    rel = float(
+        jnp.linalg.norm(q8 - fp) / (jnp.linalg.norm(fp) + 1e-9)
+    )
+    assert rel < 0.05, f"int8 logits drifted {rel:.3f} from fp"
+
+    # Generation over the quantized tree is jit-clean end to end.
+    out = jax.jit(
+        lambda p, t: greedy_generate(cfg, p, t, max_new_tokens=4)
+    )(qparams, tokens)
+    assert out.shape == (2, 14)
+    assert jnp.array_equal(out[:, :10], tokens)
+
+
+def test_int8_pallas_kernel_matches_xla(monkeypatch):
+    """ops/int8mm.py kernel in interpreter mode == the XLA dequant
+    matmul, at kernel-tileable shapes (the bench model's projections)."""
+    from tpu_dra.workloads.ops import int8mm
+    from tpu_dra.workloads.quantize import quantize_weight
+
+    monkeypatch.setattr(int8mm, "_INTERPRET", True)
+    # Shapes must TILE (multiples of _BM/_BN/_BK) or the dispatcher
+    # falls back to XLA and the kernel is never exercised.
+    x = jax.random.normal(jax.random.PRNGKey(0), (128, 1024), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(1), (1024, 1024), jnp.float32)
+    q = quantize_weight(w)
+    assert (
+        x.shape[0] % int8mm._BM == 0
+        and w.shape[1] % int8mm._BN == 0
+        and x.shape[1] % int8mm._BK == 0
+    ), "test shapes no longer tile the kernel blocks"
+    got = int8mm.int8_matmul(x, q["kernel_q"], q["scale"])
+    want = int8mm._xla_int8_matmul(x, q["kernel_q"], q["scale"])
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+    )
+    # Leading batch dims reshape through the same kernel.
+    x3 = x.reshape(2, 64, 1024)
+    got3 = int8mm.int8_matmul(x3, q["kernel_q"], q["scale"])
+    assert got3.shape == (2, 64, 1024)
+    np.testing.assert_allclose(
+        np.asarray(got3.reshape(128, 1024)), np.asarray(want),
+        rtol=1e-5, atol=1e-5,
+    )
+    # Non-tileable shapes fall back to XLA (no crash, same math).
+    xs = jax.random.normal(jax.random.PRNGKey(2), (3, 1024), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(int8mm.int8_matmul(xs, q["kernel_q"], q["scale"])),
+        np.asarray(int8mm._xla_int8_matmul(xs, q["kernel_q"], q["scale"])),
+        rtol=1e-5,
+    )

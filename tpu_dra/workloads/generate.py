@@ -100,20 +100,26 @@ def _rms(x: jnp.ndarray, scale: jnp.ndarray, eps: float) -> jnp.ndarray:
     ).astype(x.dtype)
 
 
+def _mm(x: jnp.ndarray, w: dict) -> jnp.ndarray:
+    """x @ kernel for either weight form: plain ``{"kernel"}`` or
+    int8 weight-only ``{"kernel_q", "scale"}`` (workloads/quantize.py)
+    via ops/int8mm.py — XLA's convert-fused dot by default (measured
+    fastest at decode shapes), Pallas kernel opt-in."""
+    if "kernel_q" in w:
+        from tpu_dra.workloads.ops.int8mm import int8_matmul
+
+        return int8_matmul(x, w["kernel_q"], w["scale"])
+    return x @ w["kernel"].astype(x.dtype)
+
+
 def _project_qkv(c, lp, x, cos, sin, b, s):
     """Shared front half of a decoder layer: pre-norm + roped q/k/v
     projections (identical in both cache layouts)."""
     att = lp["attention"]
     h = _rms(x, lp["attention_norm"]["scale"], c.norm_eps)
-    q = (h @ att["wq"]["kernel"].astype(c.dtype)).reshape(
-        b, s, c.n_heads, c.head_dim
-    )
-    k = (h @ att["wk"]["kernel"].astype(c.dtype)).reshape(
-        b, s, c.n_kv_heads, c.head_dim
-    )
-    v = (h @ att["wv"]["kernel"].astype(c.dtype)).reshape(
-        b, s, c.n_kv_heads, c.head_dim
-    )
+    q = _mm(h, att["wq"]).reshape(b, s, c.n_heads, c.head_dim)
+    k = _mm(h, att["wk"]).reshape(b, s, c.n_kv_heads, c.head_dim)
+    v = _mm(h, att["wv"]).reshape(b, s, c.n_kv_heads, c.head_dim)
     return apply_rope(q, cos, sin), apply_rope(k, cos, sin), v
 
 
@@ -122,14 +128,12 @@ def _finish_block(c, lp, x, out, b, s):
     (identical in both cache layouts)."""
     att = lp["attention"]
     out = out.reshape(b, s, c.n_heads * c.head_dim)
-    x = x + out @ att["wo"]["kernel"].astype(c.dtype)
+    x = x + _mm(out, att["wo"])
     mlp = lp["mlp"]
     h2 = _rms(x, lp["mlp_norm"]["scale"], c.norm_eps)
-    gate = h2 @ mlp["w_gate"]["kernel"].astype(c.dtype)
-    up = h2 @ mlp["w_up"]["kernel"].astype(c.dtype)
-    return x + (jax.nn.silu(gate) * up) @ mlp["w_down"]["kernel"].astype(
-        c.dtype
-    )
+    gate = _mm(h2, mlp["w_gate"])
+    up = _mm(h2, mlp["w_up"])
+    return x + _mm(jax.nn.silu(gate) * up, mlp["w_down"])
 
 
 def forward_chunk(
@@ -243,9 +247,7 @@ def forward_chunk(
             k=tuple(ks), v=tuple(vs), pos=cache.pos + s
         )
     x = _rms(x, params["final_norm"]["scale"], c.norm_eps)
-    logits = (x @ params["lm_head"]["kernel"].astype(c.dtype)).astype(
-        jnp.float32
-    )
+    logits = _mm(x, params["lm_head"]).astype(jnp.float32)
     return new_cache, logits
 
 
