@@ -101,3 +101,38 @@ bats::on_failure() {
   kubectl -n tpu-test5 delete pod overlap-pod --ignore-not-found --timeout=60s
   kubectl -n tpu-test5 delete resourceclaim overlap-claim --ignore-not-found --timeout=60s
 }
+
+@test "subslice: reshape churn never disturbs a held sub-slice workload" {
+  # BASELINE config 5 under load (bench twin: measure_reshape_under_load):
+  # a pod HOLDS a 1x1 sub-slice while neighbors cycle allocate/prepare/
+  # unprepare on the host's remaining chips. The holder must stay Running
+  # on the same claim throughout.
+  for _ in $(seq 1 30); do
+    local held
+    held="$(kubectl -n tpu-test5 get resourceclaims -o json | \
+      jq -r '.items | length')"
+    [ "$held" -eq 0 ] && break
+    sleep 2
+  done
+  k_apply "${REPO_ROOT}/tests/bats/specs/tpu-subslice-churn.yaml"
+  kubectl -n tpu-test5 wait --for=jsonpath='{.status.phase}'=Running \
+    pod/ss-holder --timeout=180s
+  local claim_uid
+  claim_uid="$(kubectl -n tpu-test5 get resourceclaims -o json | \
+    jq -r '[.items[] | select(.metadata.name | startswith("ss-holder-"))][0].metadata.uid // empty')"
+  [ -n "$claim_uid" ]
+  for i in 1 2 3; do
+    sed "s/CHURN_NAME/churn-$i/" \
+      "${REPO_ROOT}/tests/bats/specs/tpu-subslice-churn-pod.yaml" | k_apply /dev/stdin
+    kubectl -n tpu-test5 wait --for=jsonpath='{.status.phase}'=Succeeded \
+      "pod/churn-$i" --timeout=180s
+    kubectl -n tpu-test5 delete pod "churn-$i" --timeout=120s
+  done
+  local phase uid_now
+  phase="$(kubectl -n tpu-test5 get pod ss-holder -o jsonpath='{.status.phase}')"
+  [ "$phase" = "Running" ]
+  uid_now="$(kubectl -n tpu-test5 get resourceclaims -o json | \
+    jq -r '[.items[] | select(.metadata.name | startswith("ss-holder-"))][0].metadata.uid')"
+  [ "$uid_now" = "$claim_uid" ]
+  kubectl -n tpu-test5 delete pod ss-holder --ignore-not-found --timeout=60s
+}

@@ -380,3 +380,44 @@ def test_allocation_mode_all_scales_to_thousands_of_devices():
         }]}},
     })
     assert len(result.allocation["devices"]["results"]) == n
+
+
+def test_least_constraining_placement_avoids_mesh_fragmentation():
+    """Topology-aware scoring (TPU-native improvement over first-fit):
+    sequential 1x1 claims must not split the 2x2 mesh so that no 1x2 row
+    survives. Catalog (origin) order would put the second 1x1 in the
+    OTHER row (origin sort: 0-1-0 before 1-0-0), killing both rows; the
+    least-constraining order parks it in the already-broken row."""
+    devices = [
+        chip("tpu-0-0-0", "0-0-0"),
+        chip("tpu-0-1-0", "0-1-0"),
+        chip("tpu-1-0-0", "1-0-0"),
+        chip("tpu-1-1-0", "1-1-0"),
+        subslice("ss-1x1-0-0", "1x1", ["0-0-0"]),
+        subslice("ss-1x1-0-1", "1x1", ["0-1-0"]),
+        subslice("ss-1x1-1-0", "1x1", ["1-0-0"]),
+        subslice("ss-1x1-1-1", "1x1", ["1-1-0"]),
+        subslice("ss-1x2-r0", "1x2", ["0-0-0", "1-0-0"]),
+        subslice("ss-1x2-r1", "1x2", ["0-1-0", "1-1-0"]),
+    ]
+    slices = [combined_slice(devices, COORDS)]
+    alloc = Allocator([TPU_CLASS, SUBSLICE_CLASS], slices, [])
+    one = {"selectors": [{"cel": {"expression":
+        'device.attributes["tpu.google.com"].subsliceShape == "1x1"'}}]}
+    row = {"selectors": [{"cel": {"expression":
+        'device.attributes["tpu.google.com"].subsliceShape == "1x2"'}}]}
+
+    first = alloc.allocate(
+        claim("c1", [req(cls="tpu-subslice.google.com", **one)])
+    ).allocation["devices"]["results"][0]["device"]
+    second = alloc.allocate(
+        claim("c2", [req(cls="tpu-subslice.google.com", **one)])
+    ).allocation["devices"]["results"][0]["device"]
+    # Both 1x1s must land in the SAME row, leaving the other 1x2 intact.
+    rows = {"ss-1x1-0-0": 0, "ss-1x1-1-0": 0, "ss-1x1-0-1": 1,
+            "ss-1x1-1-1": 1}
+    assert rows[first] == rows[second], (first, second)
+    got = alloc.allocate(
+        claim("c3", [req(cls="tpu-subslice.google.com", **row)])
+    ).allocation["devices"]["results"][0]["device"]
+    assert got == ("ss-1x2-r1" if rows[first] == 0 else "ss-1x2-r0")

@@ -186,6 +186,17 @@ class Allocator:
                 dev = self.catalog.by_key.get(key)
                 if dev is not None:
                     self.ledger.consume(dev)
+        # Counter-consuming peers per pool, built ONCE per snapshot (the
+        # scoring pass would otherwise rescan the catalog on every
+        # backtrack descent). Devices taken later in this allocation are
+        # excluded implicitly: their counters are consumed, so
+        # ledger.can_consume already scores them infeasible.
+        self._peers_by_pool: Dict[Tuple[str, str], List[Candidate]] = {}
+        for d in self.catalog.devices:
+            if d.consumes_counters and d.key() not in self.in_use:
+                self._peers_by_pool.setdefault(
+                    (d.driver, d.pool), []
+                ).append(d)
 
     # --- selector evaluation ---
 
@@ -315,6 +326,44 @@ class Allocator:
         return self._pick(req, name, admin, cands, count, 0, [],
                           per_request, i, chosen, claim_spec)
 
+    def _least_constraining(self, cands):
+        """Topology-aware placement order (TPU-native improvement over
+        first-fit): among counter-consuming placements (sub-slices on a
+        chip mesh), prefer the candidate whose tentative consumption
+        leaves the most OTHER advertised placements feasible, weighted
+        by their size in chips. Catalog order corner-packs, but an
+        earlier small claim can split the mesh so no large contiguous
+        shape survives (e.g. two 1x1s landing in different rows of a
+        2x2 kill both 1x2 rows); least-constraining keeps the big
+        placements alive. Ties keep catalog (origin-sorted) order, so
+        behavior is unchanged wherever scores are equal. Non-counter
+        devices (full chips, CD channels) are returned as-is."""
+        if len(cands) < 2 or not any(c.consumes_counters for c in cands):
+            return cands
+
+        def weight(d):
+            return sum(
+                int(c.get("value", 0))
+                for e in d.consumes_counters
+                for c in (e.get("counters") or {}).values()
+            )
+
+        def score(dev):
+            if not self.ledger.can_consume(dev):
+                return float("-inf")
+            peers = self._peers_by_pool.get((dev.driver, dev.pool), ())
+            self.ledger.consume(dev)
+            s = sum(
+                weight(o)
+                for o in peers
+                if o.key() != dev.key() and self.ledger.can_consume(o)
+            )
+            self.ledger.consume(dev, sign=-1)
+            return s
+
+        scores = {c.key(): score(c) for c in cands}
+        return sorted(cands, key=lambda c: -scores[c.key()])
+
     def _pick(self, req, name, admin, cands, count, start, acc,
               per_request, i, chosen, claim_spec) -> bool:
         """Choose `count` of `cands` (explicit-stack backtracking over
@@ -325,6 +374,7 @@ class Allocator:
         (found by the bats chan-inject suite). Cross-REQUEST recursion
         via _solve stays (requests are few)."""
         del start, acc  # kept for signature stability; stack-managed now
+        cands = self._least_constraining(cands)
 
         def can_take(dev) -> bool:
             if admin:
