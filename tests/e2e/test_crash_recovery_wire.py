@@ -1,0 +1,246 @@
+"""Crash-consistency drill across a REAL process boundary.
+
+Reference analog: the WAL strategy in device_state.go — PrepareStarted
+checkpoint, rollback of partially-created MIG devices on retry
+(device_state.go:223-228,482-516), and startup obliteration of unknown
+MIG devices (driver.go:103, device_state.go:337-373). The reference can
+only prove this against live GPU clusters; here the drill runs anywhere:
+
+1. the real plugin process starts with a stub backend whose
+   create_subslice sleeps AFTER persisting (the slow-GI/CI window);
+2. a kubelet-shaped gRPC Prepare lands; the checkpoint flips to
+   PrepareStarted and the sub-slice materializes on "silicon";
+3. SIGKILL — no cleanup, no atexit: a live orphan sub-slice sits behind
+   a PrepareStarted WAL entry;
+4. the plugin restarts on the same node state and must obliterate the
+   orphan at startup, then serve a RETRY of the same claim to
+   PrepareCompleted with exactly one live sub-slice;
+5. Unprepare returns the silicon.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import uuid
+
+import grpc
+import pytest
+import yaml
+
+from tpu_dra.plugin.device_state import DRIVER_NAME
+from tpu_dra.plugin.dra_service import DRA_SERVICE_NAME
+from tpu_dra.plugin.pb import dra_v1beta1_pb2 as drapb
+
+CLAIM_UID = str(uuid.uuid4())
+NODE = "node-crash"
+
+
+def _live_subslices(state_dir):
+    try:
+        return sorted(
+            f for f in os.listdir(state_dir)
+            if f.endswith(".json")
+        )
+    except FileNotFoundError:
+        return []
+
+
+def _spawn_plugin(td):
+    env = dict(os.environ)
+    env["TPU_DRA_STUB_CONFIG"] = str(td / "stub.yaml")
+    env.pop("TPU_DRA_CDI_HOOK", None)
+    # Log to a file, not a PIPE: nobody drains the pipe during the crash
+    # window, and a -v4 plugin blocked on a full pipe buffer would never
+    # reach PrepareStarted.
+    log_path = td / f"plugin-{int(time.monotonic() * 1000)}.log"
+    log_f = open(log_path, "wb")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "tpu_dra.plugin.main",
+            "--backend", "stub",
+            "--fake-cluster",
+            "--fake-cluster-seed", str(td / "seed"),
+            "--node-name", NODE,
+            "--cdi-root", str(td / "cdi"),
+            "--plugin-data-dir", str(td / "plugin"),
+            "--kubelet-registrar-dir", str(td / "registry"),
+            "--cdi-hook", "",
+            "--feature-gates", "DynamicSubslice=true",
+            "-v", "4",
+        ],
+        env=env,
+        stdout=log_f,
+        stderr=subprocess.STDOUT,
+    )
+    log_f.close()  # the child holds its own descriptor
+    dra_sock = td / "plugin" / "dra.sock"
+    reg_sock = td / "registry" / f"{DRIVER_NAME}-reg.sock"
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if reg_sock.exists() and dra_sock.exists():
+            return proc, dra_sock
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"plugin died at startup:\n{log_path.read_text()[-4000:]}"
+            )
+        time.sleep(0.1)
+    proc.kill()
+    raise RuntimeError("plugin sockets never appeared")
+
+
+def _prepare_rpc(dra_sock, timeout=30):
+    req = drapb.NodePrepareResourcesRequest()
+    c = req.claims.add()
+    c.uid = CLAIM_UID
+    c.name = "crash-claim"
+    c.namespace = "default"
+    with grpc.insecure_channel(f"unix://{dra_sock}") as ch:
+        fn = ch.unary_unary(
+            f"/{DRA_SERVICE_NAME}/NodePrepareResources",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=(
+                drapb.NodePrepareResourcesResponse.FromString
+            ),
+        )
+        return fn(req, timeout=timeout)
+
+
+def _checkpoint_state(td):
+    path = td / "plugin" / "checkpoint.json"
+    try:
+        with open(path) as f:
+            top = json.load(f)
+    except (OSError, ValueError):
+        return None
+    claims = (top.get("v2") or {}).get("preparedClaims") or {}
+    entry = claims.get(CLAIM_UID)
+    return entry.get("checkpointState") if entry else None
+
+
+@pytest.mark.usefixtures("tmp_path")
+def test_sigkill_mid_prepare_rolls_back_and_recovers(tmp_path):
+    td = tmp_path
+    (td / "seed").mkdir()
+    state_dir = td / "stub-state"
+    (td / "stub.yaml").write_text(yaml.safe_dump({
+        "generation": "v5e",
+        "hostname": NODE,
+        "chips": 4,
+        "state_dir": str(state_dir),
+        "delay": {"create_subslice": 20.0},
+    }))
+    claim = {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {
+            "name": "crash-claim", "namespace": "default",
+            "uid": CLAIM_UID,
+        },
+        "status": {"allocation": {"devices": {"results": [{
+            "request": "r0", "driver": DRIVER_NAME,
+            "pool": NODE, "device": "tpu-ss-1x1-0-0-0",
+        }], "config": []}}},
+    }
+    (td / "seed" / "claim.json").write_text(json.dumps(claim))
+
+    proc, dra_sock = _spawn_plugin(td)
+    try:
+        errs = []
+        t = threading.Thread(
+            target=lambda: errs.append(_try(_prepare_rpc, dra_sock)),
+            daemon=True,
+        )
+        t.start()
+        # Kill INSIDE the window: WAL says PrepareStarted and the orphan
+        # sub-slice is live on "silicon".
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if (
+                _checkpoint_state(td) == "PrepareStarted"
+                and _live_subslices(state_dir)
+            ):
+                break
+            assert proc.poll() is None, "plugin died before the window"
+            time.sleep(0.05)
+        else:
+            raise AssertionError(
+                f"never reached the crash window: state="
+                f"{_checkpoint_state(td)} live={_live_subslices(state_dir)}"
+            )
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+        t.join(timeout=30)
+
+        orphans = _live_subslices(state_dir)
+        assert orphans, "expected a live orphan sub-slice after SIGKILL"
+        assert _checkpoint_state(td) == "PrepareStarted"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+
+    # Restart on the same node state: fast silicon this time. SIGKILL
+    # leaves the old unix-socket FILES behind; remove them so the spawn
+    # wait observes the NEW server's bind, not the corpse's inodes (the
+    # kubelet plugin dir gets the same cleanup from the plugin itself on
+    # graceful paths only).
+    for stale in (
+        td / "plugin" / "dra.sock",
+        td / "registry" / f"{DRIVER_NAME}-reg.sock",
+    ):
+        stale.unlink(missing_ok=True)
+    cfg = yaml.safe_load((td / "stub.yaml").read_text())
+    del cfg["delay"]
+    (td / "stub.yaml").write_text(yaml.safe_dump(cfg))
+    proc2, dra_sock2 = _spawn_plugin(td)
+    try:
+        # The kubelet retries Prepare for the SAME claim; the stale
+        # PrepareStarted entry must roll back the partial work and the
+        # retry must complete with exactly ONE live sub-slice (the
+        # orphan was obliterated at startup or rolled back on retry —
+        # either way no double-materialization).
+        resp = _prepare_rpc(dra_sock2)
+        r = resp.claims[CLAIM_UID]
+        assert not r.error, r.error
+        assert len(r.devices) == 1
+        assert _checkpoint_state(td) == "PrepareCompleted"
+        live = _live_subslices(state_dir)
+        assert len(live) == 1, live
+
+        # Unprepare returns the silicon.
+        ureq = drapb.NodeUnprepareResourcesRequest()
+        c = ureq.claims.add()
+        c.uid = CLAIM_UID
+        c.name = "crash-claim"
+        c.namespace = "default"
+        with grpc.insecure_channel(f"unix://{dra_sock2}") as ch:
+            fn = ch.unary_unary(
+                f"/{DRA_SERVICE_NAME}/NodeUnprepareResources",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=(
+                    drapb.NodeUnprepareResourcesResponse.FromString
+                ),
+            )
+            uresp = fn(ureq, timeout=30)
+        assert not uresp.claims[CLAIM_UID].error
+        assert _live_subslices(state_dir) == []
+    finally:
+        if proc2.poll() is None:
+            proc2.send_signal(signal.SIGTERM)
+            try:
+                proc2.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc2.kill()
+                proc2.wait(timeout=10)
+
+
+def _try(fn, *args):
+    try:
+        fn(*args)
+        return None
+    except Exception as e:  # noqa: BLE001 — the kill makes this expected
+        return e

@@ -21,6 +21,8 @@ Config schema::
       worker_id: 0
     fail:                      # fault injection knobs (tests)
       create_subslice: "msg"   # make create_subslice raise
+    delay:                     # crash-window injection (tests)
+      create_subslice: 5.0     # sleep AFTER persisting, BEFORE returning
 """
 
 from __future__ import annotations
@@ -30,6 +32,7 @@ import json
 import logging
 import os
 import threading
+import time
 import uuid as uuidlib
 from typing import Dict, List, Optional
 
@@ -133,7 +136,16 @@ class StubTpuLib(BaseTpuLib):
         msg = self._config.get("fail", {}).get("create_subslice")
         if msg:
             raise TpuLibError(f"injected fault: {msg}")
-        return super().create_subslice(placement)
+        info = super().create_subslice(placement)
+        # delay.create_subslice: sleep AFTER the sub-slice persisted but
+        # before returning — the window where the reference's slow GI/CI
+        # creation (nvlib.go:860-989) can be interrupted by a plugin
+        # crash, leaving a live orphan behind a PrepareStarted WAL entry.
+        # Crash-recovery drills kill the plugin inside this window.
+        delay = float(self._config.get("delay", {}).get("create_subslice", 0))
+        if delay:
+            time.sleep(delay)
+        return info
 
     def delete_subslice(self, uuid: str) -> None:
         msg = self._config.get("fail", {}).get("delete_subslice")
