@@ -178,6 +178,23 @@ def test_sigkill_mid_prepare_rolls_back_and_recovers(tmp_path):
         orphans = _live_subslices(state_dir)
         assert orphans, "expected a live orphan sub-slice after SIGKILL"
         assert _checkpoint_state(td) == "PrepareStarted"
+
+        # The operator's view of this exact incident: doctor must WARN on
+        # the crashed prepare (probe-friendly exit 1) before any restart.
+        denv = dict(os.environ)
+        denv["TPU_DRA_BACKEND"] = "stub"  # hermetic on TPU hosts
+        denv["TPU_DRA_STUB_CONFIG"] = str(td / "stub.yaml")
+        doc = subprocess.run(
+            [
+                sys.executable, "-m", "tpu_dra.tools.doctor",
+                "--plugin-data-dir", str(td / "plugin"),
+                "--cdi-root", str(td / "cdi"),
+                "--multiplex-socket-root", str(td / "no-multiplex"),
+            ],
+            capture_output=True, text=True, timeout=60, env=denv,
+        )
+        assert doc.returncode == 1, doc.stdout + doc.stderr
+        assert "PrepareStarted" in doc.stdout, doc.stdout
     finally:
         if proc.poll() is None:
             proc.kill()
