@@ -559,3 +559,13 @@ def test_int8_pallas_kernel_matches_xla(monkeypatch):
         np.asarray(int8mm._xla_int8_matmul(xs, q["kernel_q"], q["scale"])),
         rtol=1e-5,
     )
+
+
+def test_param_spec_quantized_kernels_inherit_sharding():
+    """int8 trees: kernel_q inherits the plain kernel's spec; the
+    per-channel scale replicates (falls through the rules)."""
+    assert param_spec("layers/block/attention/wq/kernel_q") == P("fsdp", "tp")
+    assert param_spec("layer_3/mlp/w_down/kernel_q") == param_spec(
+        "layer_3/mlp/w_down/kernel"
+    )
+    assert param_spec("layers/block/attention/wq/scale") == P()

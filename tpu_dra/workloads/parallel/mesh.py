@@ -102,6 +102,11 @@ PARAM_RULES: List[Tuple[str, P]] = [
 
 
 def param_spec(path: str, value=None) -> P:
+    # int8 weight-only trees (workloads/quantize.py) replace each
+    # {"kernel"} with {"kernel_q", "scale"}: the quantized kernel takes
+    # the plain kernel's sharding (same [in, out] layout); the small
+    # per-channel scale falls through to replicated.
+    path = re.sub(r"/kernel_q$", "/kernel", path)
     for pattern, spec in PARAM_RULES:
         if re.fullmatch(pattern, path):
             # Scanned layers carry extra leading dims (layer stack, and/or
