@@ -6,7 +6,6 @@ counter consumption, constraints) — the contract round-3's verdict found
 completely untested because every e2e hand-wrote status.allocation.
 """
 
-import threading
 import time
 
 import pytest
@@ -421,3 +420,306 @@ def test_least_constraining_placement_avoids_mesh_fragmentation():
         claim("c3", [req(cls="tpu-subslice.google.com", **row)])
     ).allocation["devices"]["results"][0]["device"]
     assert got == ("ss-1x2-r1" if rows[first] == 0 else "ss-1x2-r0")
+
+
+def test_v1_exactly_request_schema():
+    """GA resource.k8s.io/v1 requests nest the body under `exactly`
+    (upstream structured allocator normalizes the same way); results
+    carry the parent request name."""
+    alloc = Allocator([TPU_CLASS], [two_chip_slice()], [])
+    got = alloc.allocate(claim("c", [{
+        "name": "tpus",
+        "exactly": {
+            "deviceClassName": "tpu.google.com",
+            "allocationMode": "ExactCount",
+            "count": 2,
+        },
+    }]))
+    results = got.allocation["devices"]["results"]
+    assert [r["request"] for r in results] == ["tpus", "tpus"]
+    assert {r["device"] for r in results} == {"tpu-0-0-0", "tpu-1-0-0"}
+
+
+def test_v1_first_available_prefers_earlier_alternative():
+    """firstAvailable tries subrequests in spec order; the winner's
+    result name is `parent/sub`."""
+    alloc = Allocator([TPU_CLASS, SUBSLICE_CLASS], [two_chip_slice()], [])
+    fa = {
+        "name": "either",
+        "firstAvailable": [
+            {"name": "big", "deviceClassName": "tpu.google.com",
+             "count": 2},
+            {"name": "small", "deviceClassName": "tpu.google.com",
+             "count": 1},
+        ],
+    }
+    got = alloc.allocate(claim("c1", [fa]))
+    results = got.allocation["devices"]["results"]
+    assert [r["request"] for r in results] == ["either/big", "either/big"]
+    # Both chips taken: the 2-chip alternative is infeasible, the 1-chip
+    # fallback is not (fresh allocator, one chip pre-consumed).
+    alloc2 = Allocator([TPU_CLASS], [two_chip_slice()], [])
+    alloc2.allocate(claim("c2", [req()]))
+    got2 = alloc2.allocate(claim("c3", [fa]))
+    results2 = got2.allocation["devices"]["results"]
+    assert [r["request"] for r in results2] == ["either/small"]
+
+
+def test_v1_first_available_exhausted_is_unschedulable():
+    alloc = Allocator([TPU_CLASS], [two_chip_slice()], [])
+    alloc.allocate(claim("c1", [req(count=2)]))
+    with pytest.raises(Unschedulable):
+        alloc.allocate(claim("c2", [{
+            "name": "either",
+            "firstAvailable": [
+                {"name": "a", "deviceClassName": "tpu.google.com"},
+                {"name": "b", "deviceClassName": "tpu.google.com"},
+            ],
+        }]))
+
+
+def test_constraint_spans_first_available_parent_name():
+    """A matchAttribute constraint naming the firstAvailable parent must
+    bind whichever subrequest won (chosen keys are parent/sub)."""
+    devices = [
+        chip("tpu-a", "0-0-0", ici="aaaa.0"),
+        chip("tpu-b1", "1-0-0", ici="bbbb.0"),
+        chip("tpu-b2", "0-1-0", ici="bbbb.0"),
+    ]
+    alloc = Allocator(
+        [TPU_CLASS],
+        [combined_slice(devices, ["0-0-0", "1-0-0", "0-1-0"])], []
+    )
+    got = alloc.allocate(claim("c", [
+        req("pin", selectors=[{"cel": {"expression":
+            'device.attributes["tpu.google.com"].iciDomainID == "bbbb.0"'
+        }}]),
+        # Unconstrained, flex/any would pick tpu-a (first in name
+        # order); the parent-named constraint must force it onto the
+        # remaining bbbb.0 chip instead.
+        {"name": "flex", "firstAvailable": [
+            {"name": "any", "deviceClassName": "tpu.google.com"},
+        ]},
+    ], constraints=[{"matchAttribute": "tpu.google.com/iciDomainID",
+                     "requests": ["pin", "flex"]}]))
+    devs = {r["request"]: r["device"]
+            for r in got.allocation["devices"]["results"]}
+    assert devs["pin"] in {"tpu-b1", "tpu-b2"}
+    assert devs["flex/any"] in {"tpu-b1", "tpu-b2"}
+    assert devs["flex/any"] != devs["pin"]
+
+
+# --- upstream conformance vectors (r5, VERDICT #9) --------------------------
+# Scenario shapes drawn from k8s.io/dynamic-resource-allocation/structured
+# allocator_test.go: admin access, backtracking, counter exhaustion,
+# matchAttribute typing, allocationMode All semantics, single-node
+# invariant. Data-driven where the shape allows.
+
+
+def _two_node_slices():
+    return [
+        combined_slice([chip("tpu-a0", "0-0-0"), chip("tpu-a1", "1-0-0")],
+                       ["0-0-0", "1-0-0"], node="node-a"),
+        combined_slice([chip("tpu-b0", "0-0-0")], ["0-0-0"], node="node-b"),
+    ]
+
+
+def test_conformance_single_node_invariant():
+    """Two devices in one claim may not span nodes: the rendered
+    nodeSelector pins ONE node (upstream: candidates are per-node)."""
+    alloc = Allocator([TPU_CLASS], _two_node_slices(), [])
+    got = alloc.allocate(claim("c", [req(count=2)]))
+    devs = {r["device"] for r in got.allocation["devices"]["results"]}
+    assert devs == {"tpu-a0", "tpu-a1"}  # both from node-a, not a0+b0
+    terms = got.allocation["nodeSelector"]["nodeSelectorTerms"]
+    assert terms[0]["matchFields"][0]["values"] == ["node-a"]
+    # Three devices exist but never on one node: unschedulable.
+    alloc2 = Allocator([TPU_CLASS], _two_node_slices(), [])
+    with pytest.raises(Unschedulable):
+        alloc2.allocate(claim("c3", [req(count=3)]))
+
+
+def test_conformance_network_attached_combines_with_node_local():
+    """A node_name-less (network-attached) device combines with any
+    node-local pick (upstream: nil node selector intersects all)."""
+    net = {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceSlice",
+        "metadata": {"name": "net-fabric"},
+        "spec": {
+            "driver": "tpu.google.com",
+            "pool": {"name": "fabric", "generation": 1,
+                     "resourceSliceCount": 1},
+            "devices": [{
+                "name": "fabric-0",
+                "basic": {"attributes": {
+                    "type": {"string": "tpu"},
+                    "generation": {"string": "v5p"},
+                    "iciDomainID": {"string": "feedfeed.0"},
+                }},
+            }],
+        },
+    }
+    alloc = Allocator([TPU_CLASS], _two_node_slices() + [net], [])
+    got = alloc.allocate(claim("c", [
+        req("local"),
+        req("fab", selectors=[{"cel": {"expression":
+            '!has(device.attributes["tpu.google.com"].topologyCoord)'}}]),
+    ]))
+    devs = {r["request"]: r["device"]
+            for r in got.allocation["devices"]["results"]}
+    assert devs["fab"] == "fabric-0"
+    terms = got.allocation["nodeSelector"]["nodeSelectorTerms"]
+    assert terms[0]["matchFields"][0]["values"] == ["node-a"]
+
+
+def test_conformance_backtracking_across_requests():
+    """Greedy would hand request A the device request B needs; the
+    solver must backtrack (upstream: multi-request allocation explores
+    candidate combinations)."""
+    devices = [
+        chip("tpu-0", "0-0-0", generation="v5e"),
+        chip("tpu-1", "1-0-0", generation="v5p"),
+    ]
+    alloc = Allocator(
+        [TPU_CLASS], [combined_slice(devices, ["0-0-0", "1-0-0"])], []
+    )
+    got = alloc.allocate(claim("c", [
+        # 'any' sorts tpu-0 first (name order) and would take it...
+        req("any"),
+        # ...but 'v5e-only' can ONLY use tpu-0.
+        req("v5e-only", selectors=[{"cel": {"expression":
+            'device.attributes["tpu.google.com"].generation == "v5e"'}}]),
+    ]))
+    devs = {r["request"]: r["device"]
+            for r in got.allocation["devices"]["results"]}
+    assert devs == {"any": "tpu-1", "v5e-only": "tpu-0"}
+
+
+def test_conformance_counter_exhaustion_and_release():
+    """KEP-4815: a sub-slice consuming the last free counters is
+    unschedulable until the holder releases (fresh snapshot)."""
+    devices = [
+        chip("tpu-0", "0-0-0"), chip("tpu-1", "1-0-0"),
+        subslice("ss-1x2", "1x2", ["0-0-0", "1-0-0"]),
+    ]
+    slices = [combined_slice(devices, ["0-0-0", "1-0-0"])]
+    holder = claim("held", [req()])
+    holder["status"] = {"allocation": {"devices": {"results": [{
+        "request": "r0", "driver": "tpu.google.com",
+        "pool": "node-0", "device": "tpu-0",
+    }]}}}
+    alloc = Allocator([TPU_CLASS, SUBSLICE_CLASS], slices, [holder])
+    with pytest.raises(Unschedulable) as ei:
+        alloc.allocate(claim("c", [req(cls="tpu-subslice.google.com")]))
+    assert "counter" in str(ei.value)
+    released = Allocator([TPU_CLASS, SUBSLICE_CLASS], slices, [])
+    got = released.allocate(
+        claim("c2", [req(cls="tpu-subslice.google.com")])
+    )
+    assert got.allocation["devices"]["results"][0]["device"] == "ss-1x2"
+
+
+@pytest.mark.parametrize("attr,ok", [
+    ("iciDomainID", True),        # string equality across requests
+    ("generation", True),         # string, both v5p
+    ("topologyCoord", False),     # differs per chip -> constraint fails
+])
+def test_conformance_match_attribute_types(attr, ok):
+    alloc = Allocator([TPU_CLASS], [two_chip_slice()], [])
+    c = claim("c", [req("a"), req("b")],
+              constraints=[{"matchAttribute": f"tpu.google.com/{attr}"}])
+    if ok:
+        got = alloc.allocate(c)
+        assert len(got.allocation["devices"]["results"]) == 2
+    else:
+        with pytest.raises(Unschedulable):
+            alloc.allocate(c)
+
+
+def test_conformance_match_attribute_int_and_bool():
+    """matchAttribute compares typed values (upstream supports string/
+    int/bool/version envelopes)."""
+    devices = [
+        chip("tpu-0", "0-0-0"), chip("tpu-1", "1-0-0"),
+    ]
+    for d in devices:
+        d["basic"]["attributes"]["numaNode"] = {"int": 0}
+        d["basic"]["attributes"]["vfioCapable"] = {"bool": True}
+    alloc = Allocator(
+        [TPU_CLASS], [combined_slice(devices, ["0-0-0", "1-0-0"])], []
+    )
+    got = alloc.allocate(claim("c", [req("a"), req("b")], constraints=[
+        {"matchAttribute": "tpu.google.com/numaNode"},
+        {"matchAttribute": "tpu.google.com/vfioCapable"},
+    ]))
+    assert len(got.allocation["devices"]["results"]) == 2
+
+
+def test_conformance_allocation_mode_all_needs_every_device_free():
+    """All means ALL matching devices — one of them being held makes the
+    claim unschedulable (upstream semantics), not a partial grant."""
+    held = claim("held", [req()])
+    held["status"] = {"allocation": {"devices": {"results": [{
+        "request": "r0", "driver": "tpu.google.com",
+        "pool": "node-0", "device": "tpu-0-0-0",
+    }]}}}
+    alloc = Allocator([TPU_CLASS], [two_chip_slice()], [held])
+    with pytest.raises(Unschedulable):
+        alloc.allocate(claim("c", [req(allocationMode="All")]))
+    free = Allocator([TPU_CLASS], [two_chip_slice()], [])
+    got = free.allocate(claim("c2", [req(allocationMode="All")]))
+    assert len(got.allocation["devices"]["results"]) == 2
+
+
+def test_conformance_admin_access_sees_held_devices():
+    """adminAccess observes without consuming: it can be granted a
+    device another claim holds, and its grant blocks nobody."""
+    held = claim("held", [req()])
+    held["status"] = {"allocation": {"devices": {"results": [{
+        "request": "r0", "driver": "tpu.google.com",
+        "pool": "node-0", "device": "tpu-0-0-0",
+    }]}}}
+    alloc = Allocator([TPU_CLASS], [two_chip_slice()], [held])
+    admin = alloc.allocate(claim("admin", [req(adminAccess=True, count=2)]))
+    results = admin.allocation["devices"]["results"]
+    assert {r["device"] for r in results} == {"tpu-0-0-0", "tpu-1-0-0"}
+    assert all(r.get("adminAccess") for r in results)
+    # The normal claim still gets the remaining free chip.
+    got = alloc.allocate(claim("c", [req()]))
+    assert got.allocation["devices"]["results"][0]["device"] == "tpu-1-0-0"
+
+
+def test_conformance_exact_count_insufficient_devices():
+    alloc = Allocator([TPU_CLASS], [two_chip_slice()], [])
+    with pytest.raises(Unschedulable) as ei:
+        alloc.allocate(claim("c", [req(count=3)]))
+    assert "needs 3" in str(ei.value)
+
+
+def test_conformance_unsatisfiable_multinode_fails_fast():
+    """A count no single node can satisfy must return Unschedulable
+    quickly: the single-node invariant prunes second-node candidates at
+    selection time (leaf-only checking would walk ~C(64, 8) doomed
+    cross-node subsets on a fleet-sized catalog)."""
+    import time as _time
+
+    slices = [
+        combined_slice(
+            [chip(f"tpu-{n}-{i}", f"{i}-0-0") for i in range(4)],
+            [f"{i}-0-0" for i in range(4)],
+            node=f"node-{n:02d}",
+        )
+        for n in range(16)
+    ]
+    alloc = Allocator([TPU_CLASS], slices, [])
+    t0 = _time.monotonic()
+    with pytest.raises(Unschedulable):
+        alloc.allocate(claim("c", [req(count=8)]))
+    assert _time.monotonic() - t0 < 2.0, "cross-node pruning regressed"
+    # And a satisfiable count still allocates (all from one node).
+    got = alloc.allocate(claim("c2", [req(count=4)]))
+    nodes = {
+        r["pool"] for r in got.allocation["devices"]["results"]
+    }
+    assert len(nodes) == 1

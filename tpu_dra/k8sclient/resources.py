@@ -10,7 +10,7 @@ fake (fake.FakeCluster).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+from typing import Dict, Iterable, List, Optional
 
 
 class K8sApiError(RuntimeError):
@@ -111,6 +111,28 @@ RESOURCE_SLICES_V1BETA2 = ResourceDescriptor(
 )
 DEVICE_CLASSES_V1BETA2 = ResourceDescriptor(
     "resource.k8s.io", "v1beta2", "deviceclasses", "DeviceClass",
+    namespaced=False
+)
+
+# GA serving aliases: resource.k8s.io/v1 (the version that carries
+# DeviceClass.spec.extendedResourceName — classic `resources.limits:
+# {google.com/tpu: N}` pods bridged onto DRA, reference
+# deployments/helm/.../deviceclass-gpu.yaml:13 + tests/bats/
+# test_gpu_extres.bats). Same storage as the beta versions; the v1
+# request schema's `exactly`/`firstAvailable` nesting is normalized by
+# the allocator (scheduler/allocator.py).
+RESOURCE_CLAIMS_V1 = ResourceDescriptor(
+    "resource.k8s.io", "v1", "resourceclaims", "ResourceClaim"
+)
+RESOURCE_CLAIM_TEMPLATES_V1 = ResourceDescriptor(
+    "resource.k8s.io", "v1", "resourceclaimtemplates", "ResourceClaimTemplate"
+)
+RESOURCE_SLICES_V1 = ResourceDescriptor(
+    "resource.k8s.io", "v1", "resourceslices", "ResourceSlice",
+    namespaced=False
+)
+DEVICE_CLASSES_V1 = ResourceDescriptor(
+    "resource.k8s.io", "v1", "deviceclasses", "DeviceClass",
     namespaced=False
 )
 

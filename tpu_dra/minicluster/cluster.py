@@ -30,7 +30,6 @@ import copy
 import hashlib
 import json
 import logging
-import os
 import socket as socketlib
 import threading
 import time
@@ -79,6 +78,18 @@ def _owner_ref(obj: dict, controller_kind: str) -> dict:
         "uid": obj["metadata"]["uid"],
         "controller": True,
     }
+
+
+def _int_quantity(amount) -> int:
+    """Integer value of a k8s quantity ("2", 2, "1k"); 0 for anything
+    unparsable — a malformed third-party limit must not crash the
+    binder for every pod on the cluster."""
+    from tpu_dra.api.quantity import Quantity
+
+    try:
+        return int(Quantity.parse(str(amount)).value)
+    except Exception:  # noqa: BLE001 — tolerant by design
+        return 0
 
 
 def _match_node_selector(selector: Optional[dict], labels: dict) -> bool:
@@ -203,12 +214,24 @@ class MiniCluster:
             hosts.parent.mkdir(parents=True, exist_ok=True)
             if not hosts.exists():
                 hosts.write_text("127.0.0.1 localhost\n")
+            # Sandbox device inodes: the stub advertises these paths, so
+            # CDI/device-gate/workloads all see the SAME real inodes (the
+            # device-mode enforcement drill chowns them — bench.py's
+            # `enforcement_mode: "device"` record, r5 VERDICT #8).
+            dev_dir = rootfs / "dev"
+            dev_dir.mkdir(parents=True, exist_ok=True)
+            for c in range(8):
+                node_file = dev_dir / f"accel{c}"
+                if not node_file.exists():
+                    node_file.touch()
+                    node_file.chmod(0o666)
             stub = rootfs / "etc/tpu-dra/stub-config.yaml"
             stub.parent.mkdir(parents=True, exist_ok=True)
             stub.write_text(yaml.safe_dump({
                 "generation": "v5p",
                 "hostname": name,
                 "state_dir": str(state_dir),
+                "dev_root": str(dev_dir),
                 "slice": {
                     "uuid": "feedfeed",
                     "topology": "2x2x2",
@@ -673,6 +696,7 @@ class MiniCluster:
             statuses[refname] = claim["metadata"]["name"]
             claims.append(claim)
             dirty = True
+        dirty |= self._bridge_extended_resources(pod, ns, statuses, claims)
         if dirty:
             pod.setdefault("status", {})["resourceClaimStatuses"] = [
                 {"name": k, "resourceClaimName": v}
@@ -680,6 +704,82 @@ class MiniCluster:
             ]
             self._update_status_quiet(PODS, pod)
         return claims
+
+    def _bridge_extended_resources(
+        self, pod: dict, ns: str, statuses: Dict[str, str],
+        claims: List[dict],
+    ) -> bool:
+        """Extended-resource → DRA bridging (reference: DeviceClass
+        ``spec.extendedResourceName`` on resource.k8s.io/v1,
+        deployments/helm/.../deviceclass-gpu.yaml:13, exercised by
+        tests/bats/test_gpu_extres.bats): a classic ``resources.limits:
+        {google.com/tpu: N}`` pod gets a scheduler-synthesized
+        ResourceClaim against the bridging DeviceClass — one request per
+        consuming container, GA `exactly` schema — and is then bound,
+        allocated, and prepared exactly like an explicit DRA pod.
+        Returns True when pod.status.resourceClaimStatuses changed."""
+        wanted: Dict[str, int] = {}  # extended resource name -> total
+        per_container: List[Tuple[str, str, int]] = []
+        for c in pod["spec"].get("containers", []) or []:
+            limits = ((c.get("resources") or {}).get("limits") or {})
+            for rname, amount in limits.items():
+                # Extended resources are domain-qualified ("vendor/res");
+                # native resources (cpu, memory, hugepages-*) never are.
+                if "/" not in rname:
+                    continue
+                n = _int_quantity(amount)
+                if n > 0:
+                    wanted[rname] = wanted.get(rname, 0) + n
+                    per_container.append((c["name"], rname, n))
+        if not wanted:
+            return False
+        bridges = {}
+        for dc in self._list(DEVICE_CLASSES):
+            ern = (dc.get("spec") or {}).get("extendedResourceName")
+            if ern in wanted:
+                bridges[ern] = dc["metadata"]["name"]
+        dirty = False
+        for rname, total in wanted.items():
+            class_name = bridges.get(rname)
+            if class_name is None:
+                continue  # not bridged: classic device-plugin territory
+            refname = f"extres:{rname}"
+            existing = statuses.get(refname)
+            if existing:
+                claim = self._try_get(RESOURCE_CLAIMS, ns, existing)
+                if claim is not None:
+                    claims.append(claim)
+                    continue
+            requests = [
+                {
+                    "name": f"container-{i}",
+                    "exactly": {
+                        "deviceClassName": class_name,
+                        "allocationMode": "ExactCount",
+                        "count": n,
+                    },
+                }
+                for i, (_, rn, n) in enumerate(per_container)
+                if rn == rname
+            ]
+            claim = self.fc.create(RESOURCE_CLAIMS, {
+                "apiVersion": RESOURCE_CLAIMS.api_version,
+                "kind": "ResourceClaim",
+                "metadata": {
+                    "generateName": f"{pod['metadata']['name']}-extres-",
+                    "namespace": ns,
+                    "ownerReferences": [_owner_ref(pod, "Pod")],
+                    "annotations": {
+                        "resource.kubernetes.io/extended-resource-name":
+                            rname,
+                    },
+                },
+                "spec": {"devices": {"requests": requests}},
+            })
+            statuses[refname] = claim["metadata"]["name"]
+            claims.append(claim)
+            dirty = True
+        return dirty
 
     def _allocate_for_node(self, node: str, pending: List[dict],
                            classes, slices, allocated) -> Optional[List[dict]]:
@@ -886,12 +986,23 @@ class MiniCluster:
             return
         self.prepared.setdefault(uid, {}).update(prepared_here)
 
-        # Per-container env: only the claims the container asks for.
+        # Per-container env: only the claims the container asks for —
+        # explicit resources.claims refs, plus bridged extended-resource
+        # claims for containers with a matching resources.limits entry.
         by_container: Dict[str, Dict[str, str]] = {}
         for c in pod["spec"].get("containers", []) or []:
             env: Dict[str, str] = {}
             for cl in (c.get("resources") or {}).get("claims", []) or []:
                 env.update(cdi_env_by_claim_ref.get(cl.get("name"), {}))
+            limits = ((c.get("resources") or {}).get("limits") or {})
+            for refname, claim_env in cdi_env_by_claim_ref.items():
+                if not refname.startswith("extres:"):
+                    continue
+                rname = refname[len("extres:"):]
+                # Amount-aware: a container with an explicit 0 limit
+                # opted out and must not receive the device env.
+                if _int_quantity(limits.get(rname, 0)) > 0:
+                    env.update(claim_env)
             by_container[c["name"]] = env
         extra = {
             "TPU_DRA_MULTIPLEX_SOCKET_ROOT": str(
@@ -982,6 +1093,7 @@ class MiniCluster:
         absolute)."""
         env: Dict[str, str] = {}
         mounts: Dict[str, str] = {}  # containerPath -> hostPath
+        dev_nodes: List[str] = []
         cdi_dir = rootfs / "var/run/cdi"
         if not cdi_dir.is_dir():
             return env
@@ -995,9 +1107,18 @@ class MiniCluster:
                     cp = (m.get("containerPath") or "").rstrip("/")
                     if cp and m.get("hostPath"):
                         mounts[cp] = m["hostPath"]
+                for dn in edits.get("deviceNodes", []) or []:
+                    if dn.get("path"):
+                        dev_nodes.append(dn["path"])
                 for kv in edits.get("env", []):
                     k, _, v = kv.partition("=")
                     env[k] = v
+        if dev_nodes:
+            # Containers get these injected as real /dev nodes by the CDI
+            # runtime; host-process pods get the inode PATHS instead (the
+            # stub advertises node-sandbox-absolute paths), so a workload
+            # can open — and a device-gate drill can probe — its chips.
+            env["TPU_DRA_DEVICE_NODES"] = ",".join(sorted(set(dev_nodes)))
         for k, v in env.items():
             for cp in sorted(mounts, key=len, reverse=True):
                 if v == cp or v.startswith(cp + "/"):

@@ -24,13 +24,11 @@ writes exactly where a container would have.
 
 from __future__ import annotations
 
-import json
 import os
 import socket as socketlib
 import subprocess
 import sys
 import tempfile
-import threading
 import time
 import urllib.request
 from pathlib import Path
@@ -225,7 +223,11 @@ class PodRunner:
             if "hostPath" in vol:
                 host = self.resolve_host_path(node, vol["hostPath"]["path"])
                 hp_type = vol["hostPath"].get("type", "")
-                if hp_type == "File":
+                if hp_type == "File" or host.is_file():
+                    # Device-node mounts (the arbiter's gate paths) are
+                    # FILES the node sandbox already created; mkdir on
+                    # them would throw and directory-ing them would hide
+                    # the inode the gate chowns.
                     host.parent.mkdir(parents=True, exist_ok=True)
                 else:
                     host.mkdir(parents=True, exist_ok=True)

@@ -171,6 +171,12 @@ class Allocator:
         self.catalog = DeviceCatalog(slices)
         self.ledger = _CounterLedger(self.catalog)
         self.in_use: set = set()
+        # Node usage of the CURRENT partial solve (node name -> devices
+        # taken): lets _pick prune a second node at candidate-selection
+        # time — leaving the single-node invariant to the leaf check
+        # alone would enumerate ~C(n, k) doomed cross-node subsets on a
+        # fleet-sized catalog before concluding Unschedulable.
+        self._solve_nodes: Dict[str, int] = {}
         for claim in allocated_claims:
             alloc = (claim.get("status") or {}).get("allocation")
             if not alloc:
@@ -266,6 +272,16 @@ class Allocator:
     def _constraints_ok(
         self, claim_spec: dict, chosen: Dict[str, List[Candidate]]
     ) -> bool:
+        # Upstream invariant (structured allocator): every node-local
+        # device in one claim must live on the SAME node — the rendered
+        # nodeSelector pins the pod to one node, so a cross-node pick
+        # could never schedule. Network-attached devices (node_name
+        # None) combine freely, and adminAccess picks (observers, not
+        # consumers — absent from _solve_nodes) don't pin. _pick prunes
+        # second-node candidates at selection time; this is the
+        # backstop.
+        if len(self._solve_nodes) > 1:
+            return False
         for cons in (claim_spec.get("devices") or {}).get("constraints", []) or []:
             attr = cons.get("matchAttribute")
             if not attr:
@@ -273,7 +289,13 @@ class Allocator:
             requests = cons.get("requests") or list(chosen)
             values = set()
             for r in requests:
-                for dev in chosen.get(r, []):
+                # A constraint naming a firstAvailable parent spans
+                # whichever subrequest won (chosen keys "parent/sub").
+                devs = chosen.get(r) or [
+                    d for k, v in chosen.items()
+                    if k.startswith(r + "/") for d in v
+                ]
+                for dev in devs:
                     v = self._attr_of(dev, attr)
                     if v is None:
                         return False  # device lacks the attribute
@@ -284,6 +306,27 @@ class Allocator:
 
     # --- allocation ---
 
+    @staticmethod
+    def _expand_request(req: dict) -> List[Tuple[str, dict]]:
+        """Normalize the GA ``resource.k8s.io/v1`` request schema onto the
+        flat (v1beta1) shape the solver consumes: ``exactly`` nests the
+        whole request body under one key, ``firstAvailable`` carries an
+        ordered list of alternative subrequests whose results are named
+        ``parent/sub`` (upstream structured allocator semantics). A flat
+        request passes through unchanged, so every served version lands
+        in one solver."""
+        name = req.get("name", "")
+        subs = req.get("firstAvailable")
+        if subs:
+            return [
+                (f"{name}/{sub.get('name', str(k))}", sub)
+                for k, sub in enumerate(subs)
+            ]
+        exactly = req.get("exactly")
+        if exactly is not None:
+            return [(name, {"name": name, **exactly})]
+        return [(name, req)]
+
     def allocate(self, claim: dict) -> AllocationResult:
         """Compute (without persisting) the allocation for ``claim``.
         Raises :class:`Unschedulable` with the collected reasons."""
@@ -292,19 +335,32 @@ class Allocator:
         if not requests:
             raise Unschedulable("claim has no device requests")
         reasons: List[str] = []
-        per_request: List[Tuple[dict, List[Candidate], int]] = []
-        for req in requests:
-            cands = self._class_devices(req, reasons)
-            mode = req.get("allocationMode", "ExactCount")
-            if mode == "All":
-                count = len(cands)
-                if count == 0:
-                    raise Unschedulable(
-                        self._why(req, reasons, "no matching devices")
-                    )
-            else:
-                count = int(req.get("count", 1) or 1)
-            per_request.append((req, cands, count))
+        # One entry per claim request; each entry is an ordered list of
+        # alternatives (len > 1 only for firstAvailable requests).
+        per_request: List[List[Tuple[dict, List[Candidate], int, str]]] = []
+        for idx, req in enumerate(requests):
+            alts: List[Tuple[dict, List[Candidate], int, str]] = []
+            expanded = self._expand_request(req)
+            for rname, sub in expanded:
+                rname = rname or f"r{idx}"
+                cands = self._class_devices(sub, reasons)
+                mode = sub.get("allocationMode", "ExactCount")
+                if mode == "All":
+                    count = len(cands)
+                    if count == 0:
+                        if len(expanded) == 1:
+                            raise Unschedulable(
+                                self._why(sub, reasons, "no matching devices")
+                            )
+                        continue  # infeasible alternative; try the next
+                else:
+                    count = int(sub.get("count", 1) or 1)
+                alts.append((sub, cands, count, rname))
+            if not alts:
+                raise Unschedulable(
+                    self._why(req, reasons, "no feasible alternative")
+                )
+            per_request.append(alts)
 
         chosen: Dict[str, List[Candidate]] = {}
         if not self._solve(per_request, 0, chosen, spec):
@@ -317,14 +373,17 @@ class Allocator:
     def _solve(self, per_request, i, chosen, claim_spec) -> bool:
         """Backtracking over candidate subsets, counters consumed
         tentatively; constraints checked at the leaf (claim-level
-        matchAttribute spans requests)."""
+        matchAttribute spans requests). firstAvailable alternatives are
+        tried strictly in spec order — a later alternative is considered
+        only when no downstream completion exists for the earlier one."""
         if i == len(per_request):
             return self._constraints_ok(claim_spec, chosen)
-        req, cands, count = per_request[i]
-        name = req.get("name", f"r{i}")
-        admin = bool(req.get("adminAccess"))
-        return self._pick(req, name, admin, cands, count, 0, [],
-                          per_request, i, chosen, claim_spec)
+        for req, cands, count, rname in per_request[i]:
+            admin = bool(req.get("adminAccess"))
+            if self._pick(req, rname, admin, cands, count, 0,
+                          [], per_request, i, chosen, claim_spec):
+                return True
+        return False
 
     def _least_constraining(self, cands):
         """Topology-aware placement order (TPU-native improvement over
@@ -337,7 +396,13 @@ class Allocator:
         2x2 kill both 1x2 rows); least-constraining keeps the big
         placements alive. Ties keep catalog (origin-sorted) order, so
         behavior is unchanged wherever scores are equal. Non-counter
-        devices (full chips, CD channels) are returned as-is."""
+        devices (full chips, CD channels) are returned as-is.
+
+        Known limitation: scores are frozen at _pick entry, but the
+        ledger evolves as backtracking consumes candidates WITHIN the
+        request, so deep backtracks explore a stale order. Correctness
+        is preserved (can_take re-checks the live ledger); only the
+        heuristic's quality degrades for multi-device requests."""
         if len(cands) < 2 or not any(c.consumes_counters for c in cands):
             return cands
 
@@ -379,6 +444,9 @@ class Allocator:
         def can_take(dev) -> bool:
             if admin:
                 return True
+            if dev.node_name is not None and self._solve_nodes and \
+                    dev.node_name not in self._solve_nodes:
+                return False  # would introduce a second node
             return (
                 dev.key() not in self.in_use
                 and self.ledger.can_consume(dev)
@@ -388,11 +456,21 @@ class Allocator:
             if not admin:
                 self.ledger.consume(dev)
                 self.in_use.add(dev.key())
+                if dev.node_name is not None:
+                    self._solve_nodes[dev.node_name] = (
+                        self._solve_nodes.get(dev.node_name, 0) + 1
+                    )
 
         def untake(dev) -> None:
             if not admin:
                 self.in_use.discard(dev.key())
                 self.ledger.consume(dev, sign=-1)
+                if dev.node_name is not None:
+                    n = self._solve_nodes.get(dev.node_name, 0) - 1
+                    if n <= 0:
+                        self._solve_nodes.pop(dev.node_name, None)
+                    else:
+                        self._solve_nodes[dev.node_name] = n
 
         taken: List[int] = []  # indices into cands, ascending
         j = 0
@@ -418,11 +496,16 @@ class Allocator:
     def _render(self, claim, spec, per_request, chosen) -> dict:
         results = []
         node_names = set()
-        for req, _, _ in per_request:
-            name = req.get("name", "")
-            for dev in chosen.get(name, []):
+        # The winning alternative for each request is the one whose
+        # result name landed in `chosen` (exactly one per request).
+        picked = [
+            next(alt for alt in alts if alt[3] in chosen)
+            for alts in per_request
+        ]
+        for req, _, _, rname in picked:
+            for dev in chosen.get(rname, []):
                 entry = {
-                    "request": name,
+                    "request": rname,
                     "driver": dev.driver,
                     "pool": dev.pool,
                     "device": dev.name,
@@ -433,12 +516,12 @@ class Allocator:
                 if dev.node_name:
                     node_names.add(dev.node_name)
         config = []
-        for req, _, _ in per_request:
+        for req, _, _, rname in picked:
             dc = self.classes.get(req.get("deviceClassName", ""), {})
             for c in dc.get("spec", {}).get("config", []) or []:
                 config.append({
                     "source": "FromClass",
-                    "requests": [req.get("name", "")],
+                    "requests": [rname],
                     **{k: v for k, v in c.items()},
                 })
         for c in (spec.get("devices") or {}).get("config", []) or []:
@@ -469,16 +552,18 @@ class Allocator:
 
     def _summary(self, per_request, reasons) -> str:
         parts = []
-        for req, cands, count in per_request:
-            free = [
-                c for c in cands
-                if c.key() not in self.in_use and self.ledger.can_consume(c)
-            ]
-            parts.append(
-                f"request {req.get('name', '?')!r} needs {count} "
-                f"device(s): {len(cands)} match selectors, {len(free)} "
-                f"unallocated with counter capacity"
-            )
+        for alts in per_request:
+            for _, cands, count, rname in alts:
+                free = [
+                    c for c in cands
+                    if c.key() not in self.in_use
+                    and self.ledger.can_consume(c)
+                ]
+                parts.append(
+                    f"request {rname!r} needs {count} "
+                    f"device(s): {len(cands)} match selectors, {len(free)} "
+                    f"unallocated with counter capacity"
+                )
         if reasons:
             parts.extend(reasons[:3])
         return "cannot allocate: " + "; ".join(parts)
