@@ -53,3 +53,24 @@ bats::on_failure() {
   done
   return 1
 }
+
+@test "tpu: adminAccess claims are rejected outside the driver namespace" {
+  # Comprehension-bearing VAP (adminaccess-policy): the filter/all over
+  # spec.devices.requests must deny at APPLY time, with the policy's
+  # messageExpression surfaced to the user.
+  run kubectl apply -f - <<YAML
+apiVersion: ${TEST_RESOURCE_API_VERSION:-resource.k8s.io/v1beta1}
+kind: ResourceClaim
+metadata:
+  namespace: bats-tpu-basic
+  name: snooper
+spec:
+  devices:
+    requests:
+    - name: r0
+      deviceClassName: tpu.google.com
+      adminAccess: true
+YAML
+  [ "$status" -ne 0 ]
+  [[ "$output" == *"only permitted in namespace"* ]]
+}

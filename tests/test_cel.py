@@ -218,9 +218,73 @@ def test_has_macro():
     assert evaluate("has(object.missing.deeper)", env) is False
 
 
-def test_comprehension_macros_rejected_not_misevaluated():
+def test_comprehension_all_exists():
+    """Conformance vectors shaped after cel-spec's macros suite
+    (github.com/google/cel-spec tests/simple/testdata/macros.textproto:
+    the all/exists/exists_one sections)."""
+    assert evaluate("[1, 2, 3].all(x, x > 0)", {}) is True
+    assert evaluate("[1, 2, 0].all(x, x > 0)", {}) is False
+    assert evaluate("[].all(x, x > 0)", {}) is True
+    assert evaluate("[1, 2, 3].exists(x, x == 2)", {}) is True
+    assert evaluate("[1, 2, 3].exists(x, x > 10)", {}) is False
+    assert evaluate("[].exists(x, true)", {}) is False
+    assert evaluate("[1, 2, 3].exists_one(x, x == 2)", {}) is True
+    assert evaluate("[1, 2, 2].exists_one(x, x == 2)", {}) is False
+    assert evaluate("[1, 2, 3].exists_one(x, x > 10)", {}) is False
+
+
+def test_comprehension_map_filter():
+    assert evaluate("[1, 2, 3].map(x, x * 2)", {}) == [2, 4, 6]
+    assert evaluate("[1, 2, 3].map(x, x > 1, x * 2)", {}) == [4, 6]
+    assert evaluate("[1, 2, 3, 4].filter(x, x % 2 == 0)", {}) == [2, 4]
+    assert evaluate("[].map(x, x)", {}) == []
+    # Nesting with distinct variables; inner sees outer's binding.
+    assert evaluate(
+        "[1, 2].map(x, [10, 20].map(y, x * y))", {}
+    ) == [[10, 20], [20, 40]]
+
+
+def test_comprehension_over_maps_iterates_keys():
+    env = {"m": {"a": 1, "b": 2}}
+    assert evaluate("m.all(k, m[k] > 0)", env) is True
+    assert evaluate("m.exists(k, k == 'b')", env) is True
+    assert sorted(evaluate("m.map(k, m[k])", env)) == [1, 2]
+    assert evaluate("m.filter(k, m[k] == 2)", env) == ["b"]
+
+
+def test_comprehension_error_absorption_matches_spec():
+    """cel-spec: && / || aggregation over comprehensions is commutative
+    over errors — a determining element wins even when another element
+    errors; with no determining element the error propagates."""
+    # 'x[1] > 0' errors on element 0 ([]) but element [1] determines
+    # exists -> true; all -> false via [-1].
+    assert evaluate("[[], [1]].exists(x, x[0] > 0)", {}) is True
+    assert evaluate("[[], [-1]].all(x, x[0] > 0)", {}) is False
     with pytest.raises(CelError):
-        evaluate("[1,2].all(x, x > 0)", {})
+        evaluate("[[], [1]].all(x, x[0] > 0)", {})
+    with pytest.raises(CelError):
+        evaluate("[[], [-1]].exists(x, x[0] > 0)", {})
+
+
+def test_comprehension_variable_scoping():
+    """The iteration variable is lexically scoped: it shadows an outer
+    binding inside the macro and is restored after."""
+    env = {"x": "outer", "xs": [1, 2]}
+    assert evaluate("xs.map(x, x * 10) + [0]", env) == [10, 20, 0]
+    assert evaluate("xs.all(x, x > 0) && x == 'outer'", env) is True
+
+
+def test_comprehension_parse_errors():
+    with pytest.raises(CelError):
+        evaluate("[1].all(1 + 1, true)", {})  # var must be an identifier
+    with pytest.raises(CelError):
+        evaluate("[1].all(x)", {})  # missing predicate
+    with pytest.raises(CelError):
+        evaluate("[1].map(x, true, x, x)", {})  # too many args
+    with pytest.raises(CelError):
+        evaluate("'str'.all(x, true)", {})  # range must be list/map
+    with pytest.raises(CelError):
+        evaluate("[1].all(x, x + 1)", {})  # predicate must be bool
 
 
 def test_optional_indexing_on_lists():

@@ -26,9 +26,13 @@ not a compiler and not a full spec implementation. Supported grammar:
   has() type()`` and methods ``startsWith endsWith contains matches
   size orValue hasValue value compareTo isInteger asInteger
   isGreaterThan isLessThan``;
-- macros: only ``has()`` (field-presence test). The comprehension
-  macros (all/exists/map/filter) are not in any chart or demo
-  expression; using one raises CelError rather than mis-evaluating.
+- macros: ``has()`` (field-presence test) and the comprehension macros
+  ``all / exists / exists_one / map / filter`` (r5, VERDICT #5) with
+  cel-spec semantics: the iteration variable is lexically scoped, maps
+  iterate their keys, 3-arg ``map(x, p, t)`` filters then transforms,
+  and ``all``/``exists`` absorb per-element errors when another element
+  already determines the aggregate (a short-circuiting false/true wins
+  over an earlier error, matching the spec's commutative and/or).
 
 Evaluation errors raise :class:`CelError`; callers choose the failure
 semantics (admission: deny on error per failurePolicy; selectors: device
@@ -39,7 +43,7 @@ from __future__ import annotations
 
 import re
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 from tpu_dra.api.quantity import Quantity
 
@@ -95,7 +99,7 @@ def _lex(src: str) -> List[_Tok]:
 #   lit value | ident name | list [items] | map [(k,v)...]
 #   select obj field | optsel obj field | index obj e | optindex obj e
 #   call target|None name args | unary op e | binary op l r
-#   ternary c t f | has expr
+#   ternary c t f | has expr | compr name range var [arg_asts]
 
 
 class _Parser:
@@ -203,8 +207,25 @@ class _Parser:
 
     def _member_or_call(self, obj, name: str, optional: bool):
         if self.peek().text == "(":
+            pos = self.peek().pos
             self.next()
             args = self._args()
+            if name in _COMPREHENSIONS:
+                # Macros are syntactic: the first argument must be the
+                # iteration variable (an identifier), and the remaining
+                # arguments stay UNevaluated ASTs bound per element.
+                want = (2, 3) if name == "map" else (2,)
+                if len(args) not in want:
+                    raise CelError(
+                        f"{name}() takes {' or '.join(map(str, want))} "
+                        f"arguments at {pos}"
+                    )
+                if args[0][0] != "ident":
+                    raise CelError(
+                        f"{name}() iteration variable must be an "
+                        f"identifier at {pos}"
+                    )
+                return ("compr", name, obj, args[0][1], args[1:])
             return ("call", obj, name, args)
         return ("select", obj, name)
 
@@ -494,6 +515,84 @@ class _Evaluator:
             return obj.has_value() and _has_on(obj.or_value(None), inner[2])
         return False
 
+    def _eval_compr(self, node):
+        _, name, range_node, var, body = node
+        recv = self.eval(range_node)
+        if isinstance(recv, dict):
+            items = list(recv.keys())  # CEL: map comprehensions see keys
+        elif isinstance(recv, list):
+            items = recv
+        else:
+            raise CelError(
+                f"{name}() requires a list or map, got "
+                f"{type(recv).__name__}"
+            )
+
+        had = var in self.env
+        prev = self.env.get(var)
+
+        def per_elem(elem, expr):
+            self.env[var] = elem
+            v = self.eval(expr)
+            if not isinstance(v, bool) and name != "map":
+                raise CelError(f"{name}() predicate must return bool")
+            return v
+
+        try:
+            if name in ("all", "exists"):
+                # Commutative and/or over errors: a determining element
+                # (false for all, true for exists) wins even when some
+                # OTHER element errors; with no determining element the
+                # first error propagates.
+                determined = False
+                first_err: Optional[CelError] = None
+                for elem in items:
+                    try:
+                        v = per_elem(elem, body[0])
+                    except CelError as e:
+                        first_err = first_err or e
+                        continue
+                    if name == "all" and v is False:
+                        determined = True
+                        break
+                    if name == "exists" and v is True:
+                        determined = True
+                        break
+                if determined:
+                    return name == "exists"
+                if first_err is not None:
+                    raise first_err
+                return name == "all"
+            if name == "exists_one":
+                hits = 0
+                for elem in items:
+                    if per_elem(elem, body[0]) is True:
+                        hits += 1
+                return hits == 1
+            if name == "filter":
+                return [
+                    e for e in items if per_elem(e, body[0]) is True
+                ]
+            # map: 2-arg transforms every element; 3-arg filters with
+            # body[0] then transforms with body[1].
+            out = []
+            for elem in items:
+                if len(body) == 2:
+                    self.env[var] = elem
+                    keep = self.eval(body[0])
+                    if not isinstance(keep, bool):
+                        raise CelError("map() filter must return bool")
+                    if not keep:
+                        continue
+                self.env[var] = elem
+                out.append(self.eval(body[-1]))
+            return out
+        finally:
+            if had:
+                self.env[var] = prev
+            else:
+                self.env.pop(var, None)
+
     def _eval_call(self, node):
         _, target, name, arg_nodes = node
         args = [self.eval(a) for a in arg_nodes]
@@ -588,8 +687,6 @@ class _Evaluator:
             if name == "size":
                 _none(name, args)
                 return len(recv)
-            if name in _COMPREHENSIONS:
-                raise CelError(f"CEL macro {name!r} is not supported")
         raise CelError(
             f"no method {name!r} on {type(recv).__name__}"
         )
