@@ -9,7 +9,6 @@ from __future__ import annotations
 import glob
 import os
 
-import pytest
 import yaml
 
 from tpu_dra.api import serde
@@ -34,11 +33,13 @@ DOCS = all_demo_docs()
 
 
 # Real clusters run a VALID gate combination (DynamicSubslice is mutually
-# exclusive with the sharing gates and PassthroughSupport, fg.validate());
-# a demo config is well-formed iff SOME valid profile accepts it.
+# exclusive with PassthroughSupport and DeviceHealthCheck, fg.validate();
+# it COMPOSES with the sharing gates since r5); a demo config is
+# well-formed iff SOME valid profile accepts it.
 GATE_PROFILES = (
     ("TimeSlicingSettings", "MultiplexingSupport"),
     ("DynamicSubslice",),
+    ("DynamicSubslice", "MultiplexingSupport"),
     ("TimeSlicingSettings", "MultiplexingSupport", "PassthroughSupport"),
 )
 

@@ -92,7 +92,7 @@ def test_dependency_validation_cliques_need_dns():
 
 
 @pytest.mark.parametrize(
-    "other", [PASSTHROUGH_SUPPORT, DEVICE_HEALTH_CHECK, MULTIPLEXING_SUPPORT]
+    "other", [PASSTHROUGH_SUPPORT, DEVICE_HEALTH_CHECK]
 )
 def test_dynamic_subslice_mutual_exclusions(other):
     fg = FeatureGates()
@@ -110,6 +110,14 @@ def test_valid_combination_passes():
     fg2.set(MULTIPLEXING_SUPPORT, True)
     fg2.set(TIME_SLICING_SETTINGS, True)
     fg2.validate()
+    # r5: DynamicSubslice composes with MultiplexingSupport (the
+    # reference's DynamicMIG x MPSSupport exclusion, featuregates.go:
+    # 184-186, has no TPU analog — the arbiter's chip set is fixed by
+    # the placement, not by materialized instances).
+    fg3 = FeatureGates()
+    fg3.set(DYNAMIC_SUBSLICE, True)
+    fg3.set(MULTIPLEXING_SUPPORT, True)
+    fg3.validate()
 
 
 def test_to_map_roundtrip():

@@ -19,7 +19,7 @@ default limit, and unknown selectors are rejected.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from tpu_dra.api.quantity import Quantity
@@ -226,19 +226,12 @@ class TpuSharing(Serde):
                 raise ApiError(
                     "multiplexing requires the MultiplexingSupport feature gate"
                 )
-            if fg.enabled(fg.DYNAMIC_SUBSLICE):
-                # A dynamic reshape invalidates the arbiter's chip set
-                # mid-lease, so the combination is refused — HERE, at
-                # admission (the webhook runs this validate), so users
-                # hear "no" at apply time rather than at Prepare. Static
-                # sub-slices multiplex fine (arbiter over parent chips,
-                # the MPS-on-MIG analog).
-                raise ApiError(
-                    "multiplexing cannot be combined with "
-                    "featureGates.DynamicSubslice: a dynamic sub-slice "
-                    "reshape would invalidate the sharing arbiter's chip "
-                    "set; use static sub-slices or disable one feature"
-                )
+            # Composes with DynamicSubslice (r5; the reference's
+            # MPS-on-dynamic-MIG, device_state.go:653-677): a dynamic
+            # placement's parent chips are fixed at enumeration, and the
+            # overlap defenses prevent any reshape of a held sub-slice's
+            # chips, so the arbiter's chip set is lease-stable. (r3/r4
+            # refused this combination; the refusal was over-broad.)
             if self.time_slicing_config is not None:
                 raise ApiError("timeSlicingConfig invalid with Multiplexing strategy")
             if self.multiplexing_config is not None:
@@ -281,17 +274,11 @@ class TpuSubsliceSharing(Serde):
                 raise ApiError(
                     "multiplexing requires the MultiplexingSupport feature gate"
                 )
-            if fg.enabled(fg.DYNAMIC_SUBSLICE):
-                # Same apply-time refusal as TpuSharing: an arbiter over a
-                # sub-slice owns its parent chips, which a dynamic reshape
-                # would invalidate mid-lease. Static sub-slices multiplex
-                # fine (the MPS-on-MIG analog).
-                raise ApiError(
-                    "multiplexing cannot be combined with "
-                    "featureGates.DynamicSubslice: a dynamic sub-slice "
-                    "reshape would invalidate the sharing arbiter's chip "
-                    "set; use static sub-slices or disable one feature"
-                )
+            # Valid on static AND dynamic sub-slices (r5): the arbiter
+            # owns the sub-slice's parent chips either way — fixed by the
+            # placement before materialization, reshape-protected by the
+            # overlap defenses for the lease's life (the reference's
+            # MPS-on-MIG incl. dynamic, device_state.go:653-677).
             if self.multiplexing_config is not None:
                 self.multiplexing_config.validate()
             return

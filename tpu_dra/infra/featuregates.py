@@ -25,7 +25,7 @@ from __future__ import annotations
 import threading
 from dataclasses import dataclass, field
 from enum import Enum
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 
 class Stage(str, Enum):
@@ -187,7 +187,15 @@ class FeatureGates:
                 f"feature gate {MULTIPLEX_DEVICE_GATE} requires "
                 f"{MULTIPLEXING_SUPPORT} to also be enabled"
             )
-        for other in (PASSTHROUGH_SUPPORT, DEVICE_HEALTH_CHECK, MULTIPLEXING_SUPPORT):
+        # The reference additionally excludes DynamicMIG x MPSSupport
+        # (featuregates.go:184-186). Here DynamicSubslice COMPOSES with
+        # MultiplexingSupport (r5): a dynamic placement's parent chips
+        # are fixed at enumeration, so the sharing arbiter's chip set is
+        # known before materialization and reshape-protected by the
+        # overlap defenses for the lease's life — the GPU-side hazard
+        # (an MPS daemon pinned to GI/CI instances that a reshape
+        # destroys) has no TPU analog.
+        for other in (PASSTHROUGH_SUPPORT, DEVICE_HEALTH_CHECK):
             if self.enabled(DYNAMIC_SUBSLICE) and self.enabled(other):
                 raise FeatureGateError(
                     f"feature gate {DYNAMIC_SUBSLICE} is currently mutually "

@@ -17,7 +17,7 @@ TPU mapping:
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from tpu_dra.tpulib.interface import SubsliceInfo
@@ -69,6 +69,10 @@ class AllocatableDevice:
     chip: Optional[ChipInfo] = None  # TPU / VFIO
     subslice: Optional[SubsliceInfo] = None  # SUBSLICE_STATIC
     placement: Optional[Placement] = None  # SUBSLICE_DYNAMIC
+    # SUBSLICE_DYNAMIC: the placement's parent chips, fixed at
+    # enumeration — a sharing arbiter's chip set exists before the
+    # sub-slice is materialized on Prepare.
+    parent_chips: Optional[List[ChipInfo]] = None
     healthy: bool = True
 
     def is_subslice(self) -> bool:
@@ -159,12 +163,17 @@ class AllocatableDevices(dict):
 
     def arbiter_chip_uuids(self) -> List[str]:
         """Chip set a sharing arbiter (multiplex/time-slice control
-        daemon) owns for these devices: full chips directly, and a static
-        sub-slice's parent chips — the reference runs MPS on MIG devices
-        the same way (sharing.go applies per-device incl. MIG;
-        demo/specs/mig+mps). Dynamic sub-slices are excluded by
-        construction: a reshape would invalidate the arbiter's chip set
-        (rejected at admission, api/sharing.py)."""
+        daemon) owns for these devices: full chips directly, and a
+        sub-slice's parent chips — static OR dynamic (the reference runs
+        MPS on both static and dynamically-created MIG devices:
+        sharing.go applies per-device incl. MIG, device_state.go:653-677
+        routes MigDeviceConfig+sharing through applySharingConfig;
+        demo/specs/mig+mps). A dynamic placement's parent chips are fixed
+        at enumeration, before materialization; while the claim holds the
+        sub-slice, the overlap defenses (allocator counters + Prepare
+        overlap check + tpulib occupancy) guarantee no reshape can touch
+        these chips, so the arbiter's chip set is stable for the lease's
+        whole life."""
         out: List[str] = []
         for d in self.values():
             if d.type == TPU_DEVICE_TYPE and d.chip is not None:
@@ -174,13 +183,21 @@ class AllocatableDevices(dict):
                 and d.subslice is not None
             ):
                 out.extend(d.subslice.parent_chip_uuids)
+            elif (
+                d.type == SUBSLICE_DYNAMIC_DEVICE_TYPE
+                and d.parent_chips
+            ):
+                out.extend(c.uuid for c in d.parent_chips)
         seen = set()
         return [u for u in out if not (u in seen or seen.add(u))]
 
     def arbiter_device_paths(self) -> List[str]:
         """Device nodes the arbiter's kernel gate (multiplexd DeviceGate,
         the EXCLUSIVE_PROCESS analog) chowns per lease: the chips' nodes
-        plus any static sub-slice nodes, deduped in discovery order."""
+        plus any sub-slice nodes, deduped in discovery order. A
+        sub-slice's dev nodes are exactly its parent chips' nodes
+        (tpulib/base.py create_subslice), so gating the parent chips
+        covers a dynamic sub-slice before it is even materialized."""
         out: List[str] = []
         for d in self.values():
             if d.type == TPU_DEVICE_TYPE and d.chip is not None:
@@ -190,6 +207,11 @@ class AllocatableDevices(dict):
                 and d.subslice is not None
             ):
                 out.extend(d.subslice.dev_paths)
+            elif (
+                d.type == SUBSLICE_DYNAMIC_DEVICE_TYPE
+                and d.parent_chips
+            ):
+                out.extend(p for c in d.parent_chips for p in c.dev_paths)
         seen = set()
         return [p for p in out if not (p in seen or seen.add(p))]
 

@@ -614,21 +614,21 @@ class DeviceState:
                 state.container_edits = daemon.container_edits()
 
         if fg.enabled(fg.MULTIPLEXING_SUPPORT) and sharing.is_multiplexing():
-            # The DynamicSubslice combination is refused at admission
-            # (api/sharing.py validate, run by the webhook AND by the
-            # strict decode in prepare_devices) — no Prepare-time check
-            # needed. What IS checked here: every requested device must
-            # have a chip set an arbiter can own (full chips or static
-            # sub-slices' parent chips; a dynamic sub-slice request
-            # reaching this point means admission was bypassed).
+            # Every requested device must have a chip set an arbiter can
+            # own: full chips, or a sub-slice's parent chips — static
+            # (live SubsliceInfo) or dynamic (placement-resolved parent
+            # chips, fixed at enumeration; the arbiter starts BEFORE the
+            # sub-slice is materialized in _prepare_one, which is safe
+            # because a sub-slice's device nodes are exactly its parent
+            # chips' nodes). MPS-on-MIG analog incl. dynamic MIG
+            # (reference device_state.go:653-677, demo/specs/mig+mps).
             if self.multiplex_manager is None:
                 raise PrepareError("multiplex manager not configured on this node")
             arbiter_chips = requested.arbiter_chip_uuids()
             if not arbiter_chips:
                 raise PermanentError(
-                    "multiplexing requires full-chip or static sub-slice "
-                    "devices; the requested devices expose no arbiter "
-                    "chip set"
+                    "multiplexing requires devices with an ownable chip "
+                    "set; the requested devices expose none"
                 )
             mpc = sharing.get_multiplexing_config()
             daemon = self.multiplex_manager.new_control_daemon(

@@ -70,7 +70,15 @@ def build_partitionable_model(
 
 def enumerate_dynamic_subslice_devices(tpulib: TpuLib) -> List[AllocatableDevice]:
     """All abstract sub-slice devices for this host
-    (inspectMigProfilesAndPlacements analog, nvlib.go:1129-1210)."""
+    (inspectMigProfilesAndPlacements analog, nvlib.go:1129-1210).
+
+    Each abstract device carries its parent ChipInfos (resolved from the
+    placement's coordinates): a sharing arbiter over a dynamic sub-slice
+    owns exactly these chips — they are fixed by the placement BEFORE
+    materialization, which is what makes multiplexing on dynamic
+    sub-slices sound (the reference's MPS-on-dynamic-MIG,
+    device_state.go:653-677)."""
+    by_coord = {c.coord: c for c in tpulib.chips()}
     out: List[AllocatableDevice] = []
     for shape in tpulib.supported_shapes():
         # A sub-slice equal to the full host extent is just the set of all
@@ -81,6 +89,10 @@ def enumerate_dynamic_subslice_devices(tpulib: TpuLib) -> List[AllocatableDevice
                     name=dynamic_subslice_device_name(placement),
                     type=SUBSLICE_DYNAMIC_DEVICE_TYPE,
                     placement=placement,
+                    parent_chips=[
+                        by_coord[c] for c in placement.chips()
+                        if c in by_coord
+                    ],
                 )
             )
     return out
