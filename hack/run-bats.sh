@@ -20,6 +20,10 @@ command -v g++ >/dev/null && make -C "${REPO_ROOT}/native" >/dev/null
 # compute-domain.tpu.google.com-reg.sock) must fit AF_UNIX's ~107-char
 # sun_path limit.
 BASE="$(mktemp -d /tmp/mcXXXXXX)"
+# mktemp creates 0700; demoted-uid drill processes (device-gate bats
+# check) must be able to TRAVERSE into the sandbox — DAC on the device
+# inodes themselves is what the gate controls.
+chmod 755 "$BASE"
 export MINICLUSTER_DIR="$BASE"
 export KUBECONFIG="$BASE/kubeconfig.yaml"
 export TEST_EXPECT_GENERATION=v5p  # minicluster nodes are a v5p slice

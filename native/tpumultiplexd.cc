@@ -345,6 +345,7 @@ struct Conn {
   std::string inbuf;    // unparsed input
   std::string outbuf;   // unwritten output
   bool waiting = false;  // queued for the lease (requests held until grant)
+  double enqueued = 0.0;  // when it joined the queue (grant-wait stats)
   bool dead = false;
 };
 
@@ -546,9 +547,10 @@ class Daemon {
         return;
       }
       c.waiting = true;
+      c.enqueued = MonotonicSeconds();
       queue_.push_back(c.fd);
       if (holder_ != -1 && contended_since_ == 0.0) {
-        contended_since_ = MonotonicSeconds();
+        contended_since_ = c.enqueued;
       }
     } else if (op == "release") {
       if (holder_ == c.fd) {
@@ -593,12 +595,44 @@ class Daemon {
              ", \"waiting\": %zu, \"heldSeconds\": %.3f, "
              "\"maxHoldSeconds\": %g, \"overdue\": %s, "
              "\"revocations\": %zu, \"preemption\": %s, "
-             "\"deviceGate\": %s}",
+             "\"deviceGate\": %s",
              queue_.size(), held, max_hold, overdue ? "true" : "false",
              revocations_, cfg_.preempt_after_quanta > 0 ? "true" : "false",
              gate_.armed() ? "true" : "false");
+    // Grant-wait histogram (same shape/edges as the Python twin; the
+    // parametrized suite pins the field contract on both daemons).
+    std::string waits = ", \"waitSeconds\": {\"count\": " +
+                        std::to_string(wait_count_) + ", \"sum\": ";
+    char num[64];
+    snprintf(num, sizeof num, "%.6f, \"max\": %.6f", wait_sum_, wait_max_);
+    waits += num;
+    waits += ", \"buckets\": {";
+    for (size_t i = 0; i < kWaitEdgeCount; i++) {
+      snprintf(num, sizeof num, "\"%g\": %zu, ", kWaitEdges[i],
+               wait_buckets_[i]);
+      waits += num;
+    }
+    waits += "\"+Inf\": " + std::to_string(wait_buckets_[kWaitEdgeCount]) +
+             "}}}";
     return "{\"ok\": true, \"holder\": " + holder + ", \"chips\": " + chips +
-           buf;
+           buf + waits;
+  }
+
+  static constexpr size_t kWaitEdgeCount = 7;
+  static constexpr double kWaitEdges[kWaitEdgeCount] = {0.01, 0.1, 0.5,
+                                                        1.0,  5.0, 10.0, 30.0};
+
+  void RecordWait(double wait) {
+    wait_count_++;
+    wait_sum_ += wait;
+    if (wait > wait_max_) wait_max_ = wait;
+    for (size_t i = 0; i < kWaitEdgeCount; i++) {
+      if (wait <= kWaitEdges[i]) {
+        wait_buckets_[i]++;
+        return;
+      }
+    }
+    wait_buckets_[kWaitEdgeCount]++;
   }
 
   double CooldownRemaining(const std::string& name) {
@@ -668,6 +702,7 @@ class Daemon {
       double now = MonotonicSeconds();
       hold_started_ = now;
       contended_since_ = queue_.empty() ? 0.0 : now;
+      if (c.enqueued > 0.0) RecordWait(now - c.enqueued);
       if (gate_.armed()) gate_.Grant(c.has_uid, c.uid);
       Send(c, "{\"ok\": true, \"lease\": " + LeaseBodyJson(cfg_) + "}");
       if (c.dead) {  // grant write raced the client's death
@@ -728,6 +763,10 @@ class Daemon {
   double hold_started_ = 0.0;
   double contended_since_ = 0.0;
   size_t revocations_ = 0;
+  size_t wait_count_ = 0;
+  double wait_sum_ = 0.0;
+  double wait_max_ = 0.0;
+  size_t wait_buckets_[kWaitEdgeCount + 1] = {};
   std::map<std::string, double> cooldown_;  // peercred (or name) -> until
   DeviceGate gate_;
 };

@@ -74,3 +74,26 @@ YAML
     [ "$status" -ne 0 ]
   fi
 }
+
+@test "sharing: device gate fences the real sandbox inodes (mode: device)" {
+  # r5 (VERDICT #8): the non-surrogate enforcement record. The gated
+  # paths are the SAME inodes the stub advertises and CDI injects — a
+  # demoted cooperative client is blocked pre-lease and admitted under
+  # its lease; a demoted adversary is EPERM-fenced for its whole window.
+  local _iargs=(
+    "--set" "featureGates.MultiplexingSupport=true"
+    "--set" "featureGates.TimeSlicingSettings=true"
+    "--set" "featureGates.MultiplexDeviceGate=true"
+  )
+  iupgrade_wait _iargs
+  k_apply "${REPO_ROOT}/tests/bats/specs/tpu-devicegate.yaml"
+  kubectl -n tpu-devgate wait --for=jsonpath='{.status.phase}'=Succeeded \
+    pod/coop pod/adversary --timeout=180s
+  run kubectl -n tpu-devgate logs coop
+  [[ "$output" == *"OPENED_UNDER_LEASE=1"* ]]
+  [[ "$output" == *"BLOCKED_PRE_LEASE=1"* ]]
+  run kubectl -n tpu-devgate logs adversary
+  [[ "$output" == *"(mode: device)"* ]]
+  [[ "$output" == *ADVERSARY_BLOCKED* ]]
+  kubectl delete namespace tpu-devgate --ignore-not-found --timeout=120s
+}

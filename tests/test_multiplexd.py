@@ -11,7 +11,6 @@ The suite runs against BOTH daemon implementations — the Python one
 — through the same client, pinning the wire contract.
 """
 
-import json
 import os
 import socket
 import subprocess
@@ -708,3 +707,45 @@ def test_device_gate_successor_restores_true_original(tmp_path):
     g2.restore()
     assert os.stat(dev).st_mode & 0o777 == 0o666
     assert not (tmp_path / DeviceGate.ORIG_FILE).exists()
+
+
+def test_wait_histogram_published_in_status(daemon, tmp_path):
+    """r5 (VERDICT #7): both daemons publish a grant-wait histogram in
+    `status` — count/sum/max plus fixed buckets — which the plugin's
+    /metrics collector turns into multiplex_wait_seconds_* gauges.
+    A contended waiter's real wait must land in the right bucket."""
+    c0 = MultiplexClient(str(tmp_path), client_name="w0")
+    c0.acquire()
+    st = c0.status()
+    ws = st["waitSeconds"]
+    assert ws["count"] == 1
+    assert set(ws["buckets"]) == {
+        "0.01", "0.1", "0.5", "1", "5", "10", "30", "+Inf",
+    }
+
+    got = {}
+
+    def waiter():
+        c1 = MultiplexClient(str(tmp_path), client_name="w1")
+        c1.acquire()
+        got["wait_done"] = time.monotonic()
+        c1.release()
+        c1.close()
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    _wait_status(c0, lambda s: s["waiting"] == 1)
+    time.sleep(0.3)  # hold under contention: the waiter accrues >= 0.3s
+    c0.release()
+    t.join(timeout=10)
+    assert "wait_done" in got
+    st = _wait_status(c0, lambda s: s["waitSeconds"]["count"] == 2)
+    ws = st["waitSeconds"]
+    assert ws["max"] >= 0.3
+    assert ws["sum"] >= 0.3
+    # The contended grant must have left the instant buckets behind.
+    assert sum(
+        v for k, v in ws["buckets"].items()
+        if k not in ("0.01", "0.1")
+    ) >= 1
+    c0.close()

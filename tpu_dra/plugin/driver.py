@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import logging
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from tpu_dra.infra import featuregates as fg
@@ -145,6 +145,26 @@ class Driver:
             self.metrics.set_gauge(
                 "multiplex_overdue", 1.0 if st.get("overdue") else 0.0, labels
             )
+            # Grant-wait histogram (r5): time-to-first-step visibility —
+            # a late joiner starving behind a holder's long compile is a
+            # dashboard alert, not a bench-tail surprise.
+            ws = st.get("waitSeconds") or {}
+            if ws:
+                self.metrics.set_gauge(
+                    "multiplex_wait_seconds_count", ws.get("count", 0),
+                    labels,
+                )
+                self.metrics.set_gauge(
+                    "multiplex_wait_seconds_sum", ws.get("sum", 0.0), labels
+                )
+                self.metrics.set_gauge(
+                    "multiplex_wait_seconds_max", ws.get("max", 0.0), labels
+                )
+                for le, count in (ws.get("buckets") or {}).items():
+                    self.metrics.set_gauge(
+                        "multiplex_wait_seconds_bucket", count,
+                        {**labels, "le": le},
+                    )
 
     # --- lifecycle (RunPlugin/NewDriver analog) ---
 

@@ -271,6 +271,26 @@ class LeaseState:
         self._cooldown_until: Dict[str, float] = {}
         self._revocations = 0
         self._push: Dict[str, object] = {}  # conn id -> best-effort send fn
+        # Grant-wait histogram (r5, VERDICT #7): time from acquire to
+        # grant, published through `status` → the plugin's /metrics, so
+        # time-to-first-step regressions (a client compiling inside its
+        # lease starves late joiners) show up on a dashboard instead of
+        # only in bench tails. Bucket edges in seconds.
+        self._wait_edges = (0.01, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0)
+        self._wait_buckets = [0] * (len(self._wait_edges) + 1)
+        self._wait_count = 0
+        self._wait_sum = 0.0
+        self._wait_max = 0.0
+
+    def _record_wait_locked(self, wait: float) -> None:
+        self._wait_count += 1
+        self._wait_sum += wait
+        self._wait_max = max(self._wait_max, wait)
+        for i, edge in enumerate(self._wait_edges):
+            if wait <= edge:
+                self._wait_buckets[i] += 1
+                return
+        self._wait_buckets[-1] += 1
 
     def max_hold_seconds(self) -> float:
         if self.timeslice_ordinal is not None:
@@ -328,8 +348,9 @@ class LeaseState:
             if remaining > 0:
                 return ("cooldown", remaining)
             self._queue.append(conn_id)
+            enqueued = time.monotonic()
             if self._holder is not None and not self._contended_since:
-                self._contended_since = time.monotonic()
+                self._contended_since = enqueued
             while True:
                 if cancelled():
                     self._drop_locked(conn_id)
@@ -340,6 +361,7 @@ class LeaseState:
                     now = time.monotonic()
                     self._hold_started = now
                     self._contended_since = now if self._queue else 0.0
+                    self._record_wait_locked(now - enqueued)
                     if self.gate is not None:
                         self.gate.grant(self._uids.get(conn_id))
                     return ("granted", 0.0)
@@ -465,6 +487,21 @@ class LeaseState:
                 "revocations": self._revocations,
                 "preemption": self.preempt_after_quanta is not None,
                 "deviceGate": self.gate is not None,
+                "waitSeconds": {
+                    "count": self._wait_count,
+                    "sum": round(self._wait_sum, 6),
+                    "max": round(self._wait_max, 6),
+                    # %g-style keys ("0.5", "1", "10") — identical to the
+                    # native twin's rendering so the two daemons are
+                    # byte-compatible on the wire.
+                    "buckets": {
+                        **{
+                            format(e, "g"): self._wait_buckets[i]
+                            for i, e in enumerate(self._wait_edges)
+                        },
+                        "+Inf": self._wait_buckets[-1],
+                    },
+                },
             }
 
 

@@ -34,7 +34,7 @@ import os
 import threading
 import time
 import uuid as uuidlib
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 import yaml
 
@@ -81,6 +81,11 @@ class StubTpuLib(BaseTpuLib):
             raise TpuLibError(f"unknown TPU generation: {gen_name!r}")
         self._generation = GENERATIONS[gen_name]
         self._hostname = config.get("hostname", os.uname().nodename)
+        # Where the advertised device inodes live: real hosts use /dev;
+        # a minicluster node points this into its sandbox rootfs so the
+        # paths CDI advertises are REAL inodes a device gate can chown
+        # and a workload (or adversarial) process can open.
+        dev_root = config.get("dev_root", "/dev")
         n = int(config.get("chips", self._generation.chips_per_host))
         hx, hy, hz = self._generation.host_extent
         if n > hx * hy * hz:
@@ -111,7 +116,7 @@ class StubTpuLib(BaseTpuLib):
                     pci_bus_id=f"0000:0{i}:00.0",
                     pcie_root=f"pci0000:0{i}",
                     numa_node=i // max(1, n // 2),
-                    dev_paths=[f"/dev/accel{i}"],
+                    dev_paths=[os.path.join(dev_root, f"accel{i}")],
                     coord=coord,
                     ici_domain=self._ici,
                     worker_id=self._worker_id,
