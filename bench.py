@@ -211,9 +211,17 @@ def bench_config():
             # 2048 (512/512 -> 12.8k, 1024/1024 -> 15.3k; 2048-row tiles
             # OOM). r3 kernel change: matmul inputs stay bf16 with fp32
             # accumulation (+2.4% at seq 2048 over fp32-input kernels).
-            # Residual seq-2048 gap (51.7% vs 58.4% MFU) is
-            # attention-bound: 4x the s^2 softmax/mask VPU work and
-            # hd=64 QK contractions at half MXU depth.
+            # r5 kernel changes for the seq-2048 MFU gap (VERDICT #3):
+            # (a) base-2 softmax domain (log2e folded into the QK scale,
+            # native exp2 on the s^2 exp paths): 14.2k -> 15.2k under
+            # identical load; (b) mask-free loop + straight-line masked
+            # diagonal tail in fwd+dq: -> 15.7k. Two A/Bs that LOST,
+            # recorded so they are not retried: a two-fori_loop
+            # mask-free/frontier split (9.0k — sequential dynamic-bound
+            # loops defeat Mosaic pipelining) and a hoisted [bq, bk]
+            # iota-difference mask (13.1k — the 4 MB VMEM resident hurt
+            # more than the per-block iotas). fused_ce at seq 2048
+            # (14.5k) and batch 4 (14.1k) also lost to plain batch 3.
             attention_block_q=int(os.environ.get("BENCH_BLOCK_Q", "1024")),
             attention_block_k=int(os.environ.get("BENCH_BLOCK_K", "1024")),
             attention_impl=os.environ.get("BENCH_ATTN_IMPL", "auto"),
@@ -404,6 +412,41 @@ def _leg_decode_main() -> int:
         {"batch": batch, "prompt_len": prompt_len,
          "new_tokens": new_tokens, "reps": reps}
     )
+    # Quantified roofline (r5, VERDICT #4): is batch-128 decode on this
+    # model weight-bound? Per-step HBM floor = (matmul weight bytes +
+    # KV-cache bytes) / peak BW, vs the measured per-step wall time. If
+    # the step sits far above the bf16 floor, halving the weight bytes
+    # moves the FLOOR, not the step — the ceiling on what weight-only
+    # int8 can buy. Full arithmetic in BASELINE.md.
+    weight_bytes = 2 * sum(
+        leaf.size
+        for path, leaf in jax.tree_util.tree_leaves_with_path(params)
+        if any(
+            getattr(k, "key", None) == "kernel" for k in path
+        ) and leaf.ndim >= 2
+    )
+    kv_bytes = (
+        2 * config.n_layers * batch * (prompt_len + new_tokens)
+        * config.n_kv_heads * config.head_dim * 2
+    )
+    hbm_bw = 819e9  # v5e HBM peak bytes/s
+    step_s = batch / results["greedy_tok_s"]
+    floor_bf16 = (weight_bytes + kv_bytes) / hbm_bw
+    floor_int8 = (weight_bytes / 2 + kv_bytes) / hbm_bw
+    results["roofline"] = {
+        "weight_gb": round(weight_bytes / 1e9, 3),
+        "kv_gb": round(kv_bytes / 1e9, 3),
+        "step_ms": round(step_s * 1e3, 3),
+        "hbm_floor_ms_bf16": round(floor_bf16 * 1e3, 3),
+        "hbm_floor_ms_int8": round(floor_int8 * 1e3, 3),
+        # >1 means the step is NOT bandwidth-bound; int8's upper bound
+        # is floor_bf16/floor_int8 applied to the BW-bound share only.
+        "x_above_bf16_floor": round(step_s / floor_bf16, 2),
+        "int8_floor_ratio": round(floor_bf16 / floor_int8, 3),
+        "int8_measured_ratio": round(
+            results["greedy_int8_tok_s"] / results["greedy_tok_s"], 3
+        ),
+    }
     print(json.dumps(results))
     return 0
 
@@ -478,23 +521,19 @@ def _leg_rotate_main() -> int:
 
 
 def _leg_main(shared: bool) -> int:
-    """Child-process entry. With ``shared``, the chip lease is acquired
-    BEFORE the backend initializes and held for the whole session — the
-    cooperative contract that keeps two processes off the chip at once."""
-    client = None
-    if shared:
-        from tpu_dra.workloads.multiplex_client import MultiplexClient
-
-        client = MultiplexClient(
-            os.environ["TPU_MULTIPLEX_SOCKET_DIR"],
-            client_name=os.environ.get("BENCH_CLIENT_NAME"),
-        )
-        t0 = time.monotonic()
-        client.acquire()
-        wait = time.monotonic() - t0
+    """Child-process entry. With ``shared``, the leg COMPILES OUTSIDE the
+    lease (r5, VERDICT #7: AOT lower+compile is host-side and runs no
+    device program, so it needs no exclusivity) and acquires only for
+    step execution, yielding at the hold budget — so a late joiner's
+    time-to-first-step is bounded by the quantum, never by a neighbor's
+    cold compile. Round 4 held one lease across the whole session incl.
+    compile, and a second cold client measurably waited ~53 s."""
     # A silent CPU-fallback measurement would be a lie; fail with a
-    # distinct code so the parent retries (the chip exists but this
-    # process couldn't attach, e.g. a not-yet-released device lock).
+    # distinct code so the parent retries — single legs via
+    # _collect_leg's respawn, the synchronized sharing pair via
+    # measure_sharing's whole-attempt retry (both clients attach the
+    # backend concurrently, so a not-yet-released device lock can hit
+    # either one at cold start).
     rc = _require_tpu_or_exit()
     if rc is not None:
         return rc
@@ -506,12 +545,75 @@ def _leg_main(shared: bool) -> int:
             raise SystemExit(
                 f"sub-slice env must bound the runtime to 1 device, saw {n}"
             )
-    result = measure_tokens_per_sec()
-    if client is not None:
-        result["lease_wait_seconds"] = round(wait, 3)
-        client.release()
-        client.close()
-    print(json.dumps(result))
+    if not shared:
+        print(json.dumps(measure_tokens_per_sec()))
+        return 0
+    return _leg_shared_body()
+
+
+def _leg_shared_body() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dra.workloads.icibandwidth import fetch
+    from tpu_dra.workloads.multiplex_client import MultiplexClient
+    from tpu_dra.workloads.parallel.mesh import MeshConfig
+    from tpu_dra.workloads.train import TrainConfig, Trainer
+
+    config, batch, seq, _ = bench_config()
+    trainer = Trainer(
+        config, mesh_config=MeshConfig(fsdp=1), train_config=TrainConfig()
+    )
+    state = trainer.init_state(batch=batch, seq=seq)
+    step = trainer.make_train_step()
+    tokens = jnp.ones((batch, seq), dtype=jnp.int32)
+    # AOT compile: lower+compile builds the executable WITHOUT running a
+    # device program — the chip stays free for whoever holds the lease.
+    compiled = jax.jit(step).lower(state, tokens).compile()
+
+    client = MultiplexClient(
+        os.environ["TPU_MULTIPLEX_SOCKET_DIR"],
+        client_name=os.environ.get("BENCH_CLIENT_NAME"),
+    )
+    print("READY", flush=True)
+    start_file = os.environ["BENCH_START_FILE"]
+    while not os.path.exists(start_file):
+        time.sleep(0.05)
+
+    duration = float(os.environ.get("BENCH_SHARE_SECONDS", "20"))
+    t0 = time.monotonic()
+    w0 = time.monotonic()
+    lease = client.acquire()
+    waits = [time.monotonic() - w0]
+    first_step_at = None
+    steps_done = 0
+    train_seconds = 0.0
+    while time.monotonic() - t0 < duration:
+        s0 = time.monotonic()
+        state, loss = compiled(state, tokens)
+        fetch(loss)
+        train_seconds += time.monotonic() - s0
+        if first_step_at is None:
+            first_step_at = time.monotonic() - t0
+        steps_done += 1
+        w0 = time.monotonic()
+        lease = client.maybe_yield(lease)
+        waits.append(time.monotonic() - w0)
+    client.release()
+    client.close()
+    print(json.dumps({
+        "tokens": steps_done * batch * seq,
+        "steps": steps_done,
+        "tok_s": steps_done * batch * seq / max(train_seconds, 1e-9),
+        "train_seconds": round(train_seconds, 3),
+        "rotations": client.rotations,
+        # First acquire = time-to-first-lease for a cold-started pair;
+        # the bench gates max(all waits) < 10 s.
+        "lease_wait_seconds": round(waits[0], 3),
+        "max_wait_seconds": round(max(waits), 3),
+        "time_to_first_step_seconds": round(first_step_at or -1.0, 3),
+        "wall_seconds": round(time.monotonic() - t0, 3),
+    }))
     return 0
 
 
@@ -592,22 +694,54 @@ def _filter_claim_env(env: Dict[str, str]) -> Dict[str, str]:
     }
 
 
-def measure_sharing(steps: int = 8) -> dict:
+def measure_sharing(duration: float = 20.0) -> dict:
     """Two real processes through a REAL multiplex daemon on the real chip
-    (BASELINE config 3). The daemon lives in THIS process (it never touches
-    the device); each child acquires the lease before backend init."""
+    (BASELINE config 3), BOTH COLD-STARTING TOGETHER (r5, VERDICT #7):
+    each client AOT-compiles with the chip released, then acquires only
+    for step execution and yields at its hold budget. The leg fails if
+    any lease wait reaches 10 s — time-to-first-step is a gated bound,
+    not a tail statistic. The daemon's grant-wait histogram is collected
+    as the published-metric record. A client dying with RC_NO_TPU (the
+    previous leg's device lock not yet released) retries the WHOLE
+    synchronized attempt — per-client respawn can't reproduce the
+    cold-start contention being measured."""
+    last: Optional[RuntimeError] = None
+    for attempt in range(3):
+        try:
+            return _measure_sharing_once(duration)
+        except _SharingLegNoTpu as e:
+            last = e
+            print(
+                f"sharing attempt {attempt + 1} could not attach the TPU;"
+                f" retrying in 5s",
+                file=sys.stderr,
+            )
+            time.sleep(5)
+    raise last
+
+
+class _SharingLegNoTpu(RuntimeError):
+    pass
+
+
+def _measure_sharing_once(duration: float) -> dict:
+    import threading
+
     from tpu_dra.plugin.multiplexd import MultiplexDaemon
+    from tpu_dra.workloads.multiplex_client import MultiplexClient
 
     with tempfile.TemporaryDirectory() as td:
-        daemon = MultiplexDaemon(td, ["bench-chip"]).start()
+        daemon = MultiplexDaemon(
+            td, ["bench-chip"], compute_share_pct=50, window_seconds=4.0,
+        ).start()
+        start_file = os.path.join(td, "start")
         try:
-            t0 = time.monotonic()
-
             def leg_env(i):
                 return {
                     "TPU_MULTIPLEX_SOCKET_DIR": td,
                     "BENCH_CLIENT_NAME": f"bench-wl{i}",
-                    "BENCH_STEPS": str(steps),
+                    "BENCH_START_FILE": start_file,
+                    "BENCH_SHARE_SECONDS": str(duration),
                     **(
                         {"BENCH_REQUIRE_TPU": "1"}
                         if os.environ.get("BENCH_REQUIRE_TPU")
@@ -615,53 +749,100 @@ def measure_sharing(steps: int = 8) -> dict:
                     ),
                 }
 
-            procs = [
-                _run_leg(leg_env(i), flag="--leg-shared", wait=False)
-                for i in range(2)
-            ]
-            # Collect concurrently: sequential communicate() would leave
-            # the other child's pipes undrained — a chatty child blocked
-            # on a full stderr pipe while holding the lease deadlocks the
-            # waiter until timeout.
-            import threading
+            procs = []
+            outs: list = [[], []]
+            errs: list = [[], []]
+            ready = [threading.Event(), threading.Event()]
 
-            results: list = [None, None]
-            errors: list = []
+            def reader(i, p):
+                for line in p.stdout:
+                    outs[i].append(line)
+                    if line.strip() == "READY":
+                        ready[i].set()
 
-            def collect(i, p):
-                try:
-                    results[i] = _collect_leg(
-                        p,
-                        respawn=lambda: _spawn_leg(leg_env(i), "--leg-shared"),
+            def err_reader(i, p):
+                for line in p.stderr:
+                    errs[i].append(line)
+
+            try:
+                procs.extend(
+                    _spawn_leg(leg_env(i), "--leg-shared") for i in range(2)
+                )
+                readers = [
+                    threading.Thread(target=fn, args=(i, p), daemon=True)
+                    for i, p in enumerate(procs)
+                    for fn in (reader, err_reader)
+                ]
+                for t in readers:
+                    t.start()
+                # Both clients compile CONCURRENTLY (chip-free AOT); the
+                # synchronized start is the cold-start contention moment
+                # the wait bound is about.
+                for i, ev in enumerate(ready):
+                    if not ev.wait(timeout=900):
+                        raise RuntimeError(
+                            f"sharing client {i} never compiled: "
+                            + "".join(errs[i])[-2000:]
+                        )
+                with open(start_file, "w") as f:
+                    f.write("go\n")
+                t0 = time.monotonic()
+                for i, p in enumerate(procs):
+                    try:
+                        rc = p.wait(timeout=duration + 300)
+                    except subprocess.TimeoutExpired:
+                        raise RuntimeError(f"sharing client {i} hung")
+                    if rc == RC_NO_TPU:
+                        raise _SharingLegNoTpu(
+                            f"sharing client {i} could not attach the TPU"
+                        )
+                    if rc != 0:
+                        sys.stderr.write("".join(errs[i])[-2000:])
+                        raise RuntimeError(f"sharing client {i} rc={rc}")
+                for t in readers:
+                    t.join(timeout=10)
+                wall = time.monotonic() - t0
+            except Exception:
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+                        p.communicate()
+                raise
+            results = []
+            for i in range(2):
+                json_lines = [
+                    ln for ln in outs[i] if ln.strip().startswith("{")
+                ]
+                if not json_lines:
+                    raise RuntimeError(
+                        f"sharing client {i} exited 0 without a JSON "
+                        f"result line; stderr tail: "
+                        f"{''.join(errs[i])[-2000:]!r}"
                     )
-                except Exception as e:  # noqa: BLE001
-                    errors.append(e)
-
-            threads = [
-                threading.Thread(target=collect, args=(i, p), daemon=True)
-                for i, p in enumerate(procs)
-            ]
-            for th in threads:
-                th.start()
-            for th in threads:
-                th.join()
-            if errors:
-                raise errors[0]
-            wall = time.monotonic() - t0
+                results.append(json.loads(json_lines[-1]))
+            probe = MultiplexClient(td, client_name="bench-probe")
+            wait_hist = probe.status().get("waitSeconds", {})
+            probe.close()
         finally:
             daemon.stop()
     total_tokens = sum(r["tokens"] for r in results)
+    max_wait = max(r["max_wait_seconds"] for r in results)
     return {
         "aggregate_tok_s": total_tokens / wall,
-        # Wall time above includes both children's compiles (the leases
-        # serialize whole sessions); this divides by on-chip train time
-        # only — the number a long-running pair would converge to.
         "steady_aggregate_tok_s": total_tokens
         / sum(r["train_seconds"] for r in results),
         "per_client_tok_s": [round(r["tok_s"], 1) for r in results],
         "lease_wait_seconds": [
             r.get("lease_wait_seconds", 0.0) for r in results
         ],
+        "time_to_first_step_seconds": [
+            r.get("time_to_first_step_seconds", -1.0) for r in results
+        ],
+        "rotations": [r.get("rotations", 0) for r in results],
+        "max_wait_seconds": max_wait,
+        # The r5 gate: no client — cold-started, contended — waits 10 s.
+        "wait_bound_ok": bool(max_wait < 10.0),
+        "wait_histogram": wait_hist,
         "wall_seconds": wall,
     }
 
@@ -1214,14 +1395,22 @@ def main() -> int:
 
     sharing = measure_sharing()
     print(
-        f"sharing (2 procs via multiplex daemon): "
+        f"sharing (2 procs via multiplex daemon, cold-start together, "
+        f"compile outside the lease): "
         f"{sharing['steady_aggregate_tok_s']:.1f} steady-state tok/s "
-        f"(wall-clock incl. lease wait+compile: "
-        f"{sharing['aggregate_tok_s']:.1f}, diagnostic only), "
-        f"per-client {sharing['per_client_tok_s']}, lease waits "
-        f"{sharing['lease_wait_seconds']}s",
+        f"(incl. lease waits: {sharing['aggregate_tok_s']:.1f}), "
+        f"per-client {sharing['per_client_tok_s']}, "
+        f"rotations {sharing['rotations']}, max wait "
+        f"{sharing['max_wait_seconds']}s "
+        f"(bound<10s: {sharing['wait_bound_ok']}), ttfs "
+        f"{sharing['time_to_first_step_seconds']}s",
         file=sys.stderr,
     )
+    if not sharing["wait_bound_ok"]:
+        raise RuntimeError(
+            f"sharing wait bound violated: max lease wait "
+            f"{sharing['max_wait_seconds']}s >= 10s"
+        )
 
     ss_env = _filter_claim_env(subslice_env)
     ss_env["BENCH_ASSERT_ONE_DEVICE"] = "1"
@@ -1253,7 +1442,12 @@ def main() -> int:
         f"decode (batch {decode['batch']}, {decode['new_tokens']} new): "
         f"greedy {decode['greedy_tok_s']:.1f} tok/s, sampled "
         f"{decode['sampled_tok_s']:.1f} tok/s, int8 weight-only "
-        f"{decode['greedy_int8_tok_s']:.1f} tok/s",
+        f"{decode['greedy_int8_tok_s']:.1f} tok/s; roofline: step "
+        f"{decode['roofline']['step_ms']}ms = "
+        f"{decode['roofline']['x_above_bf16_floor']}x the bf16 HBM floor "
+        f"({decode['roofline']['hbm_floor_ms_bf16']}ms) — int8 floor "
+        f"ratio {decode['roofline']['int8_floor_ratio']}, measured "
+        f"{decode['roofline']['int8_measured_ratio']}",
         file=sys.stderr,
     )
 
@@ -1326,6 +1520,7 @@ def main() -> int:
                 "decode_int8_tok_s": round(
                     decode["greedy_int8_tok_s"], 1
                 ),
+                "decode_roofline": decode["roofline"],
                 "timeslice_aggregate_tok_s": round(
                     rotation["aggregate_tok_s"], 1
                 ),

@@ -569,3 +569,51 @@ def test_param_spec_quantized_kernels_inherit_sharding():
         "layer_3/mlp/w_down/kernel"
     )
     assert param_spec("layers/block/attention/wq/scale") == P()
+
+
+def test_decode_cache_zero_tail_and_check():
+    """ADVICE r4: the stacked-layout zero-tail invariant gets a
+    re-establishing utility (speculative-decode rewind) and a checkable
+    assertion instead of a docstring-only contract."""
+    from tpu_dra.workloads.generate import DecodeCache, init_cache
+    from tpu_dra.workloads.models.llama import TINY_LLAMA
+
+    cache = init_cache(TINY_LLAMA, batch=2, max_seq=8, stacked=True)
+    assert bool(cache.tail_is_zero())
+    # A rewind without zeroing breaks the invariant...
+    dirty = DecodeCache(
+        k=cache.k + 1.0, v=cache.v + 1.0, pos=jnp.int32(4)
+    )
+    assert not bool(dirty.tail_is_zero())
+    # ...and zero_tail repairs exactly the tail, preserving [0, pos).
+    repaired = dirty.zero_tail()
+    assert bool(repaired.tail_is_zero())
+    np.testing.assert_array_equal(
+        np.asarray(repaired.k[:, :, :4]), np.asarray(dirty.k[:, :, :4])
+    )
+    assert np.all(np.asarray(repaired.k[:, :, 4:]) == 0)
+    # Unrolled (tuple) layout takes the same path.
+    tcache = init_cache(TINY_LLAMA, batch=2, max_seq=8, stacked=False)
+    tdirty = DecodeCache(
+        k=tuple(a + 1.0 for a in tcache.k),
+        v=tuple(a + 1.0 for a in tcache.v),
+        pos=jnp.int32(3),
+    )
+    assert not bool(tdirty.tail_is_zero())
+    assert bool(tdirty.zero_tail().tail_is_zero())
+
+
+def test_quantize_rejects_unexpected_kernel_nodes():
+    """ADVICE r4: a kernel with sibling keys or an unexpected rank must
+    fail loudly, not silently stay bf16."""
+    from tpu_dra.workloads.quantize import quantize_params
+
+    good = {"wq": {"kernel": jnp.ones((4, 4), jnp.float32)}}
+    q = quantize_params(good)
+    assert q["wq"]["kernel_q"].dtype == jnp.int8
+    with pytest.raises(ValueError, match="unquantizable"):
+        quantize_params({"wq": {
+            "kernel": jnp.ones((4, 4)), "bias": jnp.ones((4,))
+        }})
+    with pytest.raises(ValueError, match="unquantizable"):
+        quantize_params({"wq": {"kernel": jnp.ones((4,))}})

@@ -57,7 +57,8 @@ class DecodeCache:
     writes exactly [pos, pos+s)); the stacked attention's split value
     contraction relies on it. Rewinding pos (speculative-decode
     rejection) or building a cache by other means breaks it silently —
-    zero the tail first."""
+    call :meth:`zero_tail` first (and :meth:`tail_is_zero` asserts the
+    invariant in tests/debug runs)."""
 
     k: "jnp.ndarray | tuple"  # stacked array or L-tuple of per-layer arrays
     v: "jnp.ndarray | tuple"
@@ -69,6 +70,45 @@ class DecodeCache:
     @classmethod
     def tree_unflatten(cls, _aux, children):
         return cls(*children)
+
+    def _seq_mask(self, arr: jnp.ndarray, stacked: bool) -> jnp.ndarray:
+        seq_axis = 2 if stacked else 1  # [L, b, s, ...] vs [b, s, ...]
+        idx = jnp.arange(arr.shape[seq_axis])
+        shape = [1] * arr.ndim
+        shape[seq_axis] = arr.shape[seq_axis]
+        return (idx < self.pos).reshape(shape)
+
+    def zero_tail(self) -> "DecodeCache":
+        """Re-establish the zero-tail invariant after an external pos
+        rewind (speculative-decode rejection) or a hand-built cache:
+        returns a cache with every slot at positions >= pos zeroed.
+        Jit-safe (pure mask multiply, no data-dependent shapes)."""
+        stacked = not isinstance(self.k, tuple)
+        if stacked:
+            return DecodeCache(
+                k=self.k * self._seq_mask(self.k, True).astype(self.k.dtype),
+                v=self.v * self._seq_mask(self.v, True).astype(self.v.dtype),
+                pos=self.pos,
+            )
+        return DecodeCache(
+            k=tuple(a * self._seq_mask(a, False).astype(a.dtype)
+                    for a in self.k),
+            v=tuple(a * self._seq_mask(a, False).astype(a.dtype)
+                    for a in self.v),
+            pos=self.pos,
+        )
+
+    def tail_is_zero(self) -> jnp.ndarray:
+        """Scalar bool: does the zero-tail invariant hold? For test
+        assertions and opt-in debug checks (cheap enough to run per
+        rewind: one masked reduction over the cache)."""
+        stacked = not isinstance(self.k, tuple)
+        arrs = (self.k, self.v) if stacked else tuple(self.k) + tuple(self.v)
+        ok = jnp.bool_(True)
+        for a in arrs:
+            tail = a * (~self._seq_mask(a, stacked)).astype(a.dtype)
+            ok = ok & (jnp.sum(jnp.abs(tail.astype(jnp.float32))) == 0)
+        return ok
 
 
 def init_cache(

@@ -29,6 +29,12 @@ import jax.numpy as jnp
 # Run the pallas kernel in interpreter mode (works on CPU; for tests).
 _INTERPRET = False
 
+# Kernel opt-in resolved ONCE at import: the choice is traced into the
+# jit cache, so flipping the env var later in-process could never take
+# effect anyway — capturing it here makes that explicit instead of
+# silently reading a stale value at trace time.
+_KERNEL_OPTED_IN = os.environ.get("TPU_DRA_INT8_KERNEL") == "1"
+
 _BM, _BN, _BK = 128, 1024, 1024
 
 
@@ -103,7 +109,7 @@ def int8_matmul(x: jnp.ndarray, w_q: jnp.ndarray,
     use_kernel = tiles and (
         _INTERPRET
         or (
-            os.environ.get("TPU_DRA_INT8_KERNEL") == "1"
+            _KERNEL_OPTED_IN
             and jax.default_backend() in ("tpu", "axon")
         )
     )

@@ -46,22 +46,31 @@ def quantize_params(params: dict) -> dict:
     unrolled, scan-stacked 2D slices stay 2D only when unrolled — the
     stacked [L, in, out] layout is quantized per (layer, out) channel)."""
 
-    def walk(node):
+    def walk(node, path):
         if isinstance(node, dict):
-            if set(node) == {"kernel"}:
+            if "kernel" in node:
                 k = node["kernel"]
-                if k.ndim == 2:
+                if set(node) == {"kernel"} and k.ndim == 2:
                     return quantize_weight(k)
-                if k.ndim == 3:  # scan-stacked [L, in, out]
-                    q = jax.vmap(quantize_weight)(k)
-                    # vmap gives scale [L, 1, out]; keep that shape — _mm
-                    # broadcasts it against [L, ..., out] per layer.
-                    return q
-                return node
-            return {k: walk(v) for k, v in node.items()}
+                if set(node) == {"kernel"} and k.ndim == 3:
+                    # scan-stacked [L, in, out]: vmap gives scale
+                    # [L, 1, out]; keep that shape — _mm broadcasts it
+                    # against [L, ..., out] per layer.
+                    return jax.vmap(quantize_weight)(k)
+                # A kernel we don't understand (extra sibling keys such
+                # as a bias, or an unexpected rank) must be LOUD: a
+                # silent skip here means the serving path quietly runs
+                # that projection in bf16 and the int8 leg's claimed
+                # weight-traffic cut is no longer true.
+                raise ValueError(
+                    f"unquantizable kernel node at {'/'.join(path)}: "
+                    f"keys={sorted(node)}, ndim={k.ndim} "
+                    "(expected a bare 2D/3D {'kernel': ...} leaf)"
+                )
+            return {k: walk(v, path + (k,)) for k, v in node.items()}
         return node
 
-    return walk(params)
+    return walk(params, ())
 
 
 def dequantize_weight(q: dict) -> jnp.ndarray:
