@@ -1,0 +1,199 @@
+#!/usr/bin/env python3
+"""Minimal AST linter — the `make lint` gate.
+
+The reference gates merges on golangci-lint (.golangci.yaml via
+.github/workflows/golang.yaml:45-75). This environment ships no Python
+linter (no ruff/flake8/pyflakes) and installs are not allowed, so the
+same bar is enforced with a small, deterministic checker over the rules
+that catch real bugs rather than style:
+
+  F401  unused import
+  F811  redefinition of a top-level name by a later def/class
+  E722  bare `except:`
+  B006  mutable default argument (list/dict/set literals)
+  F541  f-string without any placeholders
+  W605  invalid escape sequence in a non-raw string literal (via
+        compile() in default warnings-as-errors mode per file)
+
+Zero findings = exit 0. Any finding prints `path:line: CODE message`
+and exits 1, exactly like a linter in CI.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+import warnings
+from pathlib import Path
+
+CODES_DISABLED_MARKER = "# lint: disable="
+
+
+def _disabled(source_line: str) -> set:
+    if CODES_DISABLED_MARKER not in source_line:
+        return set()
+    return set(
+        source_line.split(CODES_DISABLED_MARKER, 1)[1].strip().split(",")
+    )
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: Path, lines: list):
+        self.path = path
+        self.lines = lines
+        self.findings: list = []
+        # name -> (lineno, used?) for imports at MODULE level only —
+        # function-local import tracking has too many legitimate
+        # late-binding patterns in this codebase (jax-under-jit).
+        self.imports: dict = {}
+        self.used_names: set = set()
+        self.toplevel_defs: dict = {}
+
+    def add(self, lineno: int, code: str, msg: str) -> None:
+        src = self.lines[lineno - 1] if lineno - 1 < len(self.lines) else ""
+        if code in _disabled(src):
+            return
+        self.findings.append((self.path, lineno, code, msg))
+
+    # --- imports ---
+
+    def visit_Module(self, node: ast.Module) -> None:
+        for stmt in node.body:
+            if isinstance(stmt, ast.Import):
+                for a in stmt.names:
+                    name = (a.asname or a.name).split(".")[0]
+                    self.imports[name] = stmt.lineno
+            elif isinstance(stmt, ast.ImportFrom):
+                if stmt.module == "__future__":
+                    continue  # used implicitly by the compiler
+                for a in stmt.names:
+                    if a.name == "*":
+                        continue
+                    self.imports[a.asname or a.name] = stmt.lineno
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                prev = self.toplevel_defs.get(stmt.name)
+                if prev is not None:
+                    self.add(
+                        stmt.lineno, "F811",
+                        f"redefinition of {stmt.name!r} "
+                        f"(first defined at line {prev})",
+                    )
+                self.toplevel_defs[stmt.name] = stmt.lineno
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self.used_names.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        # `pkg.mod.attr` marks `pkg` used via the Name child; nothing
+        # extra needed, but keep walking.
+        self.generic_visit(node)
+
+    # --- hazards ---
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self.add(node.lineno, "E722", "bare `except:`")
+        self.generic_visit(node)
+
+    def _check_defaults(self, node) -> None:
+        for d in list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None
+        ]:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                self.add(
+                    d.lineno, "B006",
+                    "mutable default argument (shared across calls)",
+                )
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
+        if not any(isinstance(v, ast.FormattedValue) for v in node.values):
+            self.add(node.lineno, "F541", "f-string without placeholders")
+        self.generic_visit(node)
+
+    def visit_FormattedValue(self, node: ast.FormattedValue) -> None:
+        # Do NOT recurse into format_spec: `{x:.1f}` carries a nested
+        # placeholder-less JoinedStr ('.1f') that is not an f-string.
+        self.visit(node.value)
+
+    def finish(self, tree: ast.Module, source: str) -> None:
+        # __all__ and doctest-style re-exports count as uses.
+        exported = set()
+        for stmt in tree.body:
+            if (
+                isinstance(stmt, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == "__all__"
+                    for t in stmt.targets
+                )
+                and isinstance(stmt.value, (ast.List, ast.Tuple))
+            ):
+                exported.update(
+                    e.value for e in stmt.value.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                )
+        for name, lineno in self.imports.items():
+            if name in self.used_names or name in exported:
+                continue
+            if name.startswith("_"):
+                continue
+            src = self.lines[lineno - 1] if lineno - 1 < len(self.lines) else ""
+            if "noqa" in src:
+                continue
+            self.add(lineno, "F401", f"{name!r} imported but unused")
+
+
+def lint_file(path: Path) -> list:
+    source = path.read_text(encoding="utf-8", errors="replace")
+    with warnings.catch_warnings():
+        # W605: DeprecationWarning/SyntaxWarning for bad escapes.
+        warnings.simplefilter("error", SyntaxWarning)
+        warnings.simplefilter("error", DeprecationWarning)
+        try:
+            compile(source, str(path), "exec")
+        except SyntaxError as e:
+            return [(path, e.lineno or 0, "E999", f"syntax error: {e.msg}")]
+        except (SyntaxWarning, DeprecationWarning) as e:
+            return [(path, 0, "W605", str(e))]
+    tree = ast.parse(source)
+    v = _Visitor(path, source.splitlines())
+    v.visit(tree)
+    v.finish(tree, source)
+    return v.findings
+
+
+def main(argv: list) -> int:
+    roots = [Path(a) for a in argv] or [Path("tpu_dra"), Path("tests")]
+    files: list = []
+    for root in roots:
+        if root.is_file():
+            files.append(root)
+        else:
+            files.extend(sorted(root.rglob("*.py")))
+    findings = []
+    for f in files:
+        if "/pb/" in str(f):  # protoc output is generated, not linted
+            continue
+        findings.extend(lint_file(f))
+    for path, lineno, code, msg in findings:
+        print(f"{path}:{lineno}: {code} {msg}")
+    print(
+        f"lint: {len(files)} files, {len(findings)} finding(s)",
+        file=sys.stderr,
+    )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -1,0 +1,45 @@
+#!/bin/bash
+# Shell syntax gate over the repo's bash surface (tests/bats/, hack/).
+#
+# The reference runs shellcheck in CI; this image ships none and installs
+# are not allowed, so the gate is `bash -n` (real parser, catches quoting
+# and syntax errors — the class of break that kills a CI e2e run). .bats
+# files use the bats @test preprocessor syntax, which is not bash; they
+# are transformed the same way bats itself does (@test "name" { -> a
+# function) before parsing.
+set -euo pipefail
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+rc=0
+
+check_bash() {
+  if ! bash -n "$1" 2>/tmp/shlint_err.$$; then
+    echo "shlint: $1:"
+    sed "s|^|  |" /tmp/shlint_err.$$
+    rc=1
+  fi
+  rm -f /tmp/shlint_err.$$
+}
+
+while IFS= read -r f; do
+  check_bash "$f"
+done < <(find "$REPO_ROOT/hack" "$REPO_ROOT/demo" -name "*.sh" -type f; \
+         find "$REPO_ROOT/tests/bats" -name "*.sh" -o -name "*.bash" | sort)
+
+# .bats: transform the @test header into plain bash before parsing.
+tmp="$(mktemp -d)"
+trap 'rm -rf "$tmp"' EXIT
+i=0
+while IFS= read -r f; do
+  i=$((i+1))
+  sed -E 's/^@test[[:space:]]+(".*"|'"'"'.*'"'"')[[:space:]]+\{/bats_test_'"$i"'() {/' \
+    "$f" > "$tmp/$(basename "$f").sh"
+  if ! bash -n "$tmp/$(basename "$f").sh" 2>/tmp/shlint_err.$$; then
+    echo "shlint: $f:"
+    sed "s|^|  |" /tmp/shlint_err.$$
+    rc=1
+  fi
+  rm -f /tmp/shlint_err.$$
+done < <(find "$REPO_ROOT/tests/bats" -name "*.bats" | sort)
+
+echo "shlint: checked sh/bash/bats surface, rc=$rc" >&2
+exit "$rc"

@@ -13,7 +13,6 @@ import os
 import re
 import tomllib
 
-import pytest
 import yaml
 
 import tpu_dra.version as version

@@ -1,7 +1,6 @@
 """Driver-level tests: ResourceSlice publication, health-driven republish,
 stale-claim GC, and the DRA gRPC surface over a real unix socket."""
 
-import threading
 import time
 import uuid as uuidlib
 

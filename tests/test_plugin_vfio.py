@@ -6,10 +6,9 @@ import os
 import pytest
 
 from tpu_dra.infra import featuregates as fg
-from tpu_dra.k8sclient import FakeCluster
 from tpu_dra.plugin.cdi import CDIHandler
 from tpu_dra.plugin.checkpoint import CheckpointManager
-from tpu_dra.plugin.device_state import DRIVER_NAME, DeviceState, PrepareError
+from tpu_dra.plugin.device_state import DeviceState
 from tpu_dra.plugin.vfio import VfioError, VfioPciManager
 from tpu_dra.tpulib.stub import StubTpuLib
 

@@ -3,7 +3,6 @@ lifecycle, persistence, and linux-backend enumeration against a fabricated
 sysfs tree (the fake-hardware seam the reference lacks, SURVEY.md §4.1)."""
 
 import os
-import subprocess
 
 import pytest
 

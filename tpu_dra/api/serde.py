@@ -16,7 +16,7 @@ import json
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional, Tuple, Type
 
-from tpu_dra.api.errors import ApiError, DecodeError
+from tpu_dra.api.errors import ApiError, DecodeError  # noqa: F401 — ApiError re-exported via tpu_dra.api
 from tpu_dra.api.quantity import Quantity
 
 

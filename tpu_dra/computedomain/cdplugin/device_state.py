@@ -33,12 +33,7 @@ from tpu_dra.computedomain import (
     NUM_CHANNELS,
 )
 from tpu_dra.computedomain.daemon.bootstrap import read_bootstrap_env
-from tpu_dra.k8sclient import (
-    COMPUTE_DOMAINS,
-    NODES,
-    ApiNotFound,
-    ResourceClient,
-)
+from tpu_dra.k8sclient import COMPUTE_DOMAINS, NODES, ResourceClient
 from tpu_dra.plugin.cdi import CDIHandler
 from tpu_dra.plugin.checkpoint import (
     CLAIM_STATE_PREPARE_COMPLETED,
@@ -46,7 +41,7 @@ from tpu_dra.plugin.checkpoint import (
     CheckpointManager,
     PreparedClaim,
 )
-from tpu_dra.plugin.device_state import PermanentError, PrepareError, claim_to_string
+from tpu_dra.plugin.device_state import PermanentError, PrepareError
 from tpu_dra.plugin.prepared import (
     KubeletDevice,
     PreparedDevice,

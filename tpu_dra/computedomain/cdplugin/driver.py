@@ -10,7 +10,7 @@ from __future__ import annotations
 import logging
 import threading
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import Optional
 
 from tpu_dra.computedomain import CD_DRIVER_NAME, NUM_CHANNELS
 from tpu_dra.computedomain.cdplugin.device_state import (
@@ -20,7 +20,7 @@ from tpu_dra.computedomain.cdplugin.device_state import (
 )
 from tpu_dra.infra.flock import Flock
 from tpu_dra.infra.metrics import Metrics
-from tpu_dra.k8sclient import RESOURCE_CLAIMS, RESOURCE_SLICES, ResourceClient
+from tpu_dra.k8sclient import RESOURCE_SLICES, ResourceClient
 from tpu_dra.plugin.cdi import CDIHandler
 from tpu_dra.plugin.checkpoint import CheckpointManager
 from tpu_dra.plugin.cleanup import CheckpointCleanupManager

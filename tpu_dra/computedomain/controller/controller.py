@@ -28,7 +28,6 @@ from tpu_dra.infra.workqueue import WorkQueue, default_controller_rate_limiter
 from tpu_dra.k8sclient import (
     COMPUTE_DOMAIN_CLIQUES,
     COMPUTE_DOMAINS,
-    ApiNotFound,
     Informer,
     ResourceClient,
 )

@@ -12,7 +12,7 @@ the DaemonSet finalizer is only removed once its pods are gone
 from __future__ import annotations
 
 import logging
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 from tpu_dra.computedomain import CD_FINALIZER, CD_LABEL_KEY
 from tpu_dra.infra import featuregates

@@ -13,15 +13,11 @@ readiness probe execs (template :72-94 analog, replacing
 from __future__ import annotations
 
 import argparse
-import json
 import logging
 import os
 import threading
-import time
 from dataclasses import dataclass
-from typing import Optional
 
-from tpu_dra.api import CD_STATUS_READY
 from tpu_dra.computedomain.daemon.bootstrap import (
     render_bootstrap_env,
     write_bootstrap_files,

@@ -15,7 +15,6 @@ import logging
 import signal
 import subprocess
 import threading
-import time
 from typing import Callable, List, Optional
 
 log = logging.getLogger(__name__)

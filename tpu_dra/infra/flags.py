@@ -14,8 +14,8 @@ from __future__ import annotations
 import argparse
 import logging
 import os
-from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Sequence
+from dataclasses import dataclass
+from typing import Any, Optional
 
 from tpu_dra.infra import featuregates
 

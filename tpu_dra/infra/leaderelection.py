@@ -16,7 +16,6 @@ import threading
 import time
 import uuid
 
-from tpu_dra.infra import flags
 from tpu_dra.k8sclient import LEASES, ApiConflict, ResourceClient
 
 log = logging.getLogger(__name__)

@@ -13,7 +13,6 @@ from __future__ import annotations
 import json
 import logging
 import os
-import threading
 import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
@@ -27,7 +26,6 @@ from tpu_dra.k8sclient.resources import (
     ApiNotFound,
     Backend,
     K8sApiError,
-    ResourceDescriptor,
 )
 
 log = logging.getLogger(__name__)

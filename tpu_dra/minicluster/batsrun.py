@@ -17,10 +17,8 @@ a log and dumped (indented, TAP-comment style) on failure.
 from __future__ import annotations
 
 import argparse
-import os
 import re
 import subprocess
-import sys
 import time
 from pathlib import Path
 from typing import List, Optional, Tuple
@@ -90,7 +88,7 @@ def build_script(path: Path, log_dir: Path) -> Tuple[str, List[str]]:
         tlog = log_dir / f"{path.stem}.{i}.log"
         esc = name.replace('"', '\\"')
         lines += [
-            f'if [[ -n "$_FILE_SKIP" ]]; then',
+            'if [[ -n "$_FILE_SKIP" ]]; then',
             f'  echo "__BATS_RESULT__:{i}:skip:$_FILE_SKIP"',
             "else",
             f'  ( exec >"{tlog}" 2>&1 3>&1; set -e; '
@@ -190,7 +188,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             log_f.write(line + "\n")
             log_f.flush()
 
-    out(f"TAP version 13")
+    out("TAP version 13")
     total = {"ok": 0, "fail": 0, "skip": 0}
     for f in files:
         c = run_file(f, log_dir, out, args.file_timeout)

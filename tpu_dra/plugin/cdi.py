@@ -31,7 +31,6 @@ import threading
 import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
-from tpu_dra.plugin.allocatable import AllocatableDevice, VFIO_DEVICE_TYPE
 from tpu_dra.plugin.prepared import PreparedDevices
 
 log = logging.getLogger(__name__)

@@ -25,7 +25,7 @@ import json
 import os
 import zlib
 from dataclasses import dataclass, field
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict
 
 from tpu_dra.infra.flock import Flock
 from tpu_dra.plugin.prepared import PreparedDevices

@@ -15,7 +15,6 @@ import threading
 from typing import Optional
 
 from tpu_dra.k8sclient import RESOURCE_CLAIMS, ApiNotFound, ResourceClient
-from tpu_dra.plugin.checkpoint import CheckpointManager
 from tpu_dra.plugin.device_state import DeviceState
 
 log = logging.getLogger(__name__)

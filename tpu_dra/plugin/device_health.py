@@ -16,7 +16,7 @@ from __future__ import annotations
 import logging
 import queue
 import threading
-from typing import Callable, List, Optional
+from typing import Callable, Optional
 
 from tpu_dra.tpulib.interface import TpuLib
 from tpu_dra.tpulib.types import (

@@ -18,7 +18,7 @@ import logging
 import os
 import threading
 from concurrent import futures
-from typing import Callable, List, Optional
+from typing import List, Optional
 
 import grpc
 

@@ -14,7 +14,7 @@ import logging
 import signal
 import threading
 
-from tpu_dra.infra import featuregates, flags, signals
+from tpu_dra.infra import flags, signals
 from tpu_dra.infra.metrics import start_health_server
 from tpu_dra.plugin.driver import Driver, DriverConfig
 from tpu_dra.tpulib import new_tpulib

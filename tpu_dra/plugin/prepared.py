@@ -11,12 +11,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-from tpu_dra.plugin.allocatable import (
-    SUBSLICE_DYNAMIC_DEVICE_TYPE,
-    SUBSLICE_STATIC_DEVICE_TYPE,
-    TPU_DEVICE_TYPE,
-    VFIO_DEVICE_TYPE,
-)
+from tpu_dra.plugin.allocatable import TPU_DEVICE_TYPE
 
 
 @dataclass

@@ -15,7 +15,7 @@ from __future__ import annotations
 import logging
 import os
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
 from tpu_dra.tpulib.types import ChipInfo
 

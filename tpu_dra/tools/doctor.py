@@ -36,7 +36,6 @@ import argparse
 import json
 import os
 import socket
-import sys
 from typing import Dict, List, Optional
 
 from tpu_dra.plugin.checkpoint import (

@@ -21,12 +21,10 @@ Reference analog: the responsibilities of ``deviceLib``
 
 from __future__ import annotations
 
-import queue
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from tpu_dra.tpulib.types import (
-    ChipHealthEvent,
     ChipInfo,
     Generation,
     IciDomain,

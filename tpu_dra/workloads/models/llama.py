@@ -19,7 +19,7 @@ TPU-first choices:
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Optional
+from typing import Any
 
 import flax.linen as nn
 import jax

@@ -9,14 +9,13 @@ XLA plans the collectives; bf16 params with fp32 AdamW moments.
 from __future__ import annotations
 
 import dataclasses
-import functools
 import logging
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import NamedSharding
 
 from tpu_dra.workloads.models import build_model
 from tpu_dra.workloads.parallel.context import set_global_mesh
