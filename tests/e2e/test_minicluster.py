@@ -166,8 +166,20 @@ def test_scale_drill_16_nodes_cd_ready_and_claim_churn():
 
     from tpu_dra.minicluster.cluster import MiniCluster
 
-    base = f"/tmp/mc{secrets.token_hex(3)}"
-    os.makedirs(base)
+    # Extra-short base: 16-node names ("node-15") push the deepest
+    # registration socket right against the AF_UNIX sun_path cap, which
+    # MiniCluster.start guards loudly. Failed drills KEEP their dir for
+    # evidence, so retry past collisions instead of flaking on a
+    # leftover.
+    for _ in range(50):
+        base = f"/tmp/d{secrets.token_hex(1)}"
+        try:
+            os.makedirs(base)
+            break
+        except FileExistsError:
+            continue
+    else:
+        raise RuntimeError("no free /tmp/dXX base (clean old drill dirs)")
     mc = MiniCluster(base, num_nodes=16).start()
     try:
         env = dict(
@@ -316,6 +328,21 @@ spec:
             time.sleep(2)
         assert not held, f"{len(held)} churn claims never released"
         assert cycles == 100
-    finally:
+    except BaseException:
+        # A scale drill that fails without its evidence is worthless:
+        # keep the base dir (no rmtree) and surface the control plane's
+        # last words in the failure output.
+        import glob as globlib
+        import traceback
+
+        traceback.print_exc()
+        for f in sorted(
+            globlib.glob(os.path.join(base, "logs/tpu-dra-driver/*/*.log"))
+        )[:4]:
+            tail = open(f, errors="replace").read()[-3000:]
+            print(f"==== tail of {f} ====\n{tail}", file=sys.stderr)
+        mc.stop()
+        raise
+    else:
         mc.stop()
         shutil.rmtree(base, ignore_errors=True)

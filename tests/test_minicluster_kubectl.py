@@ -1,0 +1,64 @@
+"""Unit tests for the minicluster kubectl shim's argument handling.
+
+The 16-node scale drill (r5) found `delete pod/a pod/b` silently
+deleting only pod/a — every churn round leaked three Succeeded pods
+whose claims eventually held all 64 chips. Pin the slash-form semantics
+at the unit level so the shim can't regress to first-target-only."""
+
+from tpu_dra.minicluster.kubectl import Args, cmd_delete
+
+
+class _RecordingKC:
+    def __init__(self):
+        self.deleted = []
+
+    def delete(self, rd, ns, name):
+        self.deleted.append((rd.plural, ns, name))
+
+    def list(self, *a, **kw):
+        return []
+
+
+def test_delete_every_slash_form_target():
+    kc = _RecordingKC()
+    rc = cmd_delete(kc, Args(["pod/a", "pod/b", "pod/c", "-n", "ns1"]))
+    assert rc == 0
+    assert [(p, n) for p, _, n in kc.deleted] == [
+        ("pods", "a"), ("pods", "b"), ("pods", "c")
+    ]
+    assert all(ns == "ns1" for _, ns, _ in kc.deleted)
+
+
+def test_delete_kind_then_names():
+    kc = _RecordingKC()
+    rc = cmd_delete(kc, Args(["pods", "x", "y", "-n", "ns2"]))
+    assert rc == 0
+    assert [(p, n) for p, _, n in kc.deleted] == [
+        ("pods", "x"), ("pods", "y")
+    ]
+
+
+def test_delete_mixed_forms_rejected():
+    kc = _RecordingKC()
+    rc = cmd_delete(kc, Args(["pod/a", "plainname"]))
+    assert rc == 1
+    assert kc.deleted == []
+
+
+def test_get_slash_form_multi_targets():
+    kc = _RecordingKC()
+
+    class _GetKC(_RecordingKC):
+        def get(self, rd, ns, name):
+            return {"apiVersion": "v1", "kind": rd.kind,
+                    "metadata": {"name": name, "namespace": ns}}
+
+    from tpu_dra.minicluster.kubectl import cmd_get
+
+    kc = _GetKC()
+    rc = cmd_get(kc, Args(["pod/a", "pod/b", "-n", "ns1", "-o", "name"]))
+    assert rc == 0
+    rc = cmd_get(kc, Args(["pod/a", "deploy/b"]))
+    assert rc == 1  # mixed kinds rejected loudly, not truncated
+    rc = cmd_get(kc, Args(["pods2/a"]))
+    assert rc == 1  # unknown kind diagnostic, not "expected TYPE/name"

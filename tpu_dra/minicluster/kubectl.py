@@ -187,6 +187,26 @@ def _split_slash(token: str):
     return None, token
 
 
+def _slash_targets(pos: List[str]):
+    """Parse EVERY positional as TYPE/name (kubectl slash-form
+    semantics — honoring only pos[0] silently dropped the rest, found
+    by the 16-node scale drill where each churn round's
+    `delete pod/a pod/b pod/c pod/d` leaked three Succeeded pods whose
+    claims eventually held all 64 chips). Returns (targets, error):
+    targets is [(rd, name)]; error is a printable message
+    distinguishing a bare token from a typo'd kind."""
+    out = []
+    for p in pos:
+        if "/" not in p:
+            return None, f"expected TYPE/name, got {p!r}"
+        kind, _, name = p.partition("/")
+        rd = _resolve_kind(kind)
+        if rd is None:
+            return None, f"unknown kind {kind!r} in {p!r}"
+        out.append((rd, name))
+    return out, None
+
+
 def _load_docs(filename: str) -> List[dict]:
     text = (
         sys.stdin.read() if filename == "-" else open(filename).read()
@@ -258,7 +278,13 @@ def cmd_delete(kc: KubeClient, args: Args) -> int:
         pos = list(args.positionals)
         rd, name = _split_slash(pos[0])
         if rd is not None:
-            targets.append((rd, args.namespace, name))
+            slash, err = _slash_targets(pos)
+            if err:
+                print(f"error: {err}", file=sys.stderr)
+                return 1
+            targets.extend(
+                (prd, args.namespace, pname) for prd, pname in slash
+            )
         else:
             rd = _resolve_kind(pos[0])
             if rd is None:
@@ -355,7 +381,20 @@ def cmd_get(kc: KubeClient, args: Args) -> int:
     rd, name = _split_slash(pos[0])
     names: List[str] = []
     if rd is not None:
-        names = [name]
+        # Same multi-target slash-form semantics as delete; this shim
+        # requires one resource kind per get.
+        slash, err = _slash_targets(pos)
+        if err:
+            print(f"error: {err}", file=sys.stderr)
+            return 1
+        kinds = {prd.plural for prd, _ in slash}
+        if len(kinds) > 1:
+            print(
+                f"error: mixed resource kinds in one get not supported "
+                f"({sorted(kinds)})", file=sys.stderr,
+            )
+            return 1
+        names = [pname for _, pname in slash]
     else:
         rd = _resolve_kind(pos[0])
         if rd is None:
