@@ -30,10 +30,16 @@ image:
 bats:
 	bats tests/bats/
 
-# The same 13 suites executed VERBATIM with no cluster/kubectl/helm/jq/
+# The same suites executed VERBATIM with no cluster/kubectl/helm/jq/
 # bats installed: minicluster (kind analog) + toolchain shims.
 bats-exec: native
 	hack/run-bats.sh --log RUN_bats.log
+
+# Hermetic container for the same run (reference tests/bats/Dockerfile
+# analog; needs docker — not available in every build sandbox).
+bats-image:
+	docker build -t tpu-dra-bats -f tests/bats/Dockerfile .
+	docker run --rm tpu-dra-bats
 
 # the same e2e assertions with no cluster/kubectl/bats at all: fake
 # apiserver + real driver binaries as separate processes (45 checks)

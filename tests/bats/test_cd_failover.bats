@@ -53,10 +53,26 @@ bats::on_failure() {
   # Re-create the job so the deletion hits a live run (the setup_file job may
   # already be complete by now).
   kubectl -n cd-demo delete job llama-pjit --ignore-not-found --timeout=120s
+  # Job deletion cascades its pods ASYNCHRONOUSLY; wait them out so the
+  # worker we kill below provably belongs to the NEW run (polling with
+  # old pods still dying raced into deleting a ghost / not-found).
+  local leftover
+  for _ in $(seq 1 60); do
+    leftover="$(kubectl -n cd-demo get pods -l job-name=llama-pjit \
+      --no-headers 2>/dev/null | wc -l)"
+    [ "$leftover" -eq 0 ] && break
+    sleep 2
+  done
+  [ "$leftover" -eq 0 ]
   k_apply "${REPO_ROOT}/demo/specs/computedomain/llama-pjit-job.yaml"
-  sleep 5
-  local worker
-  worker="$(kubectl -n cd-demo get pods -l job-name=llama-pjit -o name | head -1)"
+  # Poll for the first worker (a fixed sleep raced the job controller on
+  # slow boxes and found zero pods to kill).
+  local worker=""
+  for _ in $(seq 1 30); do
+    worker="$(kubectl -n cd-demo get pods -l job-name=llama-pjit -o name | head -1)"
+    [ -n "$worker" ] && break
+    sleep 2
+  done
   [ -n "$worker" ]
   kubectl -n cd-demo delete "$worker" --force --grace-period=0
   kubectl -n cd-demo wait --for=condition=complete job/llama-pjit --timeout=900s

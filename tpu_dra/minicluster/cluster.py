@@ -152,6 +152,8 @@ class MiniCluster:
         self.ns_seen: Set[str] = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._kill_thread: Optional[threading.Thread] = None
+        self._reaper_watch = None
         self._rd_by_gvk = {
             (d.api_version, d.kind): d for d in iter_descriptors()
         }
@@ -186,21 +188,78 @@ class MiniCluster:
             target=self._run, daemon=True, name="minicluster"
         )
         self._thread.start()
+        # Event-driven pod teardown: the sweep in _reconcile_pods also
+        # reaps ghosts, but a full tick can take tens of seconds on a
+        # loaded single-core box — long enough for a force-deleted
+        # worker's PROCESS to keep running, complete a rendezvous with
+        # its partner, and poison a failover drill (a real kubelet kills
+        # the container the moment the pod object dies). Watch DELETED
+        # events and kill immediately.
+        self._kill_thread = threading.Thread(
+            target=self._watch_pod_deletes, daemon=True,
+            name="minicluster-pod-reaper",
+        )
+        self._kill_thread.start()
         log.info(
             "minicluster up: %s (%d nodes) base=%s",
             self.srv.server_url, self.num_nodes, self.base,
         )
         return self
 
+    def _watch_pod_deletes(self) -> None:
+        while not self._stop.is_set():
+            try:
+                # Close any previous stream FIRST: an abandoned _Watch
+                # stays registered and accumulates a copy of every
+                # subsequent pod event into a queue nobody drains.
+                if self._reaper_watch is not None:
+                    try:
+                        self._reaper_watch.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                self._reaper_watch = self.fc.watch(PODS)
+                for ev, obj in self._reaper_watch:
+                    if self._stop.is_set():
+                        return
+                    if ev != "DELETED":
+                        continue
+                    uid = (obj.get("metadata") or {}).get("uid")
+                    if uid and uid in self.sandboxes:
+                        log.info(
+                            "pod %s/%s deleted: killing its sandbox now",
+                            obj["metadata"].get("namespace"),
+                            obj["metadata"].get("name"),
+                        )
+                        try:
+                            self._teardown_pod(uid)
+                        except Exception:  # noqa: BLE001
+                            log.exception("event-driven teardown failed")
+            except Exception:  # noqa: BLE001 — reconnect on any stream
+                # failure; the sweep remains the backstop meanwhile.
+                if not self._stop.wait(1.0):
+                    continue
+                return
+
     def stop(self) -> None:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=10)
+        # Unblock + join the reaper: its watch otherwise sits in q.get()
+        # forever, leaking the thread and a registered _Watch per
+        # cluster.
+        if self._reaper_watch is not None:
+            try:
+                self._reaper_watch.close()
+            except Exception:  # noqa: BLE001
+                pass
+        if self._kill_thread is not None:
+            self._kill_thread.join(timeout=5)
         # Drain in-flight admissions BEFORE killing sandboxes: a worker
         # finishing a blocked prepare after the kill loop would launch an
         # orphan pod process that outlives the cluster.
         self._admit_pool.shutdown(wait=True, cancel_futures=True)
-        for sandbox in self.sandboxes.values():
+        # Snapshot: the reaper may still pop entries concurrently.
+        for sandbox in list(self.sandboxes.values()):
             sandbox.kill()
         self.srv.stop()
 

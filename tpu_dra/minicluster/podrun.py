@@ -25,6 +25,7 @@ writes exactly where a container would have.
 from __future__ import annotations
 
 import os
+import signal
 import socket as socketlib
 import subprocess
 import sys
@@ -160,21 +161,31 @@ class PodSandbox:
         return "Succeeded" if all(rc == 0 for rc in rcs) else "Failed"
 
     def kill(self):
-        for c in self.containers:
-            if c.alive():
+        # Containers start with start_new_session=True; signal the whole
+        # process GROUP so helpers a workload forked die with it — a
+        # surviving child would keep rendezvous/device state alive past
+        # the pod object's deletion.
+        def _signal(c, sig):
+            try:
+                os.killpg(c.proc.pid, sig)
+            except (OSError, ProcessLookupError):
                 try:
-                    c.proc.terminate()
+                    getattr(
+                        c.proc,
+                        "terminate" if sig == signal.SIGTERM else "kill",
+                    )()
                 except OSError:
                     pass
+
+        for c in self.containers:
+            if c.alive():
+                _signal(c, signal.SIGTERM)
         deadline = time.monotonic() + 5
         for c in self.containers:
             while c.alive() and time.monotonic() < deadline:
                 time.sleep(0.05)
             if c.alive():
-                try:
-                    c.proc.kill()
-                except OSError:
-                    pass
+                _signal(c, signal.SIGKILL)
             try:
                 c.proc.wait(timeout=5)
             except Exception:  # noqa: BLE001

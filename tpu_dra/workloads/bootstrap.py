@@ -127,9 +127,21 @@ def initialize_from_env(
             se.num_processes,
             coordinator,
         )
+        # Fail FAST when a peer dies mid-rendezvous: jax's default
+        # initialization window lets a severed worker sit blocked for
+        # many minutes before erroring, so the Job-level restart (the
+        # failover path the cd_failover suite kills workers to test)
+        # converges a whole rendezvous-timeout later than it needs to.
+        # A dead-peer exit within ~2 min turns worker loss into a quick
+        # restart instead of a silent stall. Overridable for genuinely
+        # slow fleets via TPU_DRA_INIT_TIMEOUT_SECONDS.
+        timeout_s = int(
+            (env or os.environ).get("TPU_DRA_INIT_TIMEOUT_SECONDS", "120")
+        )
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=se.num_processes,
             process_id=se.worker_id,
+            initialization_timeout=timeout_s,
         )
     return se
