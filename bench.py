@@ -742,6 +742,11 @@ def _measure_sharing_once(duration: float) -> dict:
                     "BENCH_CLIENT_NAME": f"bench-wl{i}",
                     "BENCH_START_FILE": start_file,
                     "BENCH_SHARE_SECONDS": str(duration),
+                    # TWO live trainers hold params+optimizer in HBM at
+                    # once (same constraint as the rotation leg): the 1B
+                    # bench model OOMs a 16 GiB chip doubled — use the
+                    # ~200M preset.
+                    "BENCH_MODEL": "small",
                     **(
                         {"BENCH_REQUIRE_TPU": "1"}
                         if os.environ.get("BENCH_REQUIRE_TPU")
