@@ -1481,8 +1481,14 @@ def main() -> int:
     s2_env.update({
         "BENCH_SEQ": "2048",
         "BENCH_BATCH": os.environ.get("BENCH_SEQ2048_BATCH", "3"),
-        "BENCH_BLOCK_Q": os.environ.get("BENCH_SEQ2048_BLOCK", "1024"),
-        "BENCH_BLOCK_K": os.environ.get("BENCH_SEQ2048_BLOCK", "1024"),
+        # r5 re-sweep AFTER the exp2+diagonal-tail kernels: the tile
+        # optimum moved DOWN — 512/512 now beats 1024/1024 at seq 2048
+        # (15.95k vs 15.64k tok/s quiet; 512/256 14.5k, 256/256 14.0k,
+        # batch 4 15.6k) because smaller kv blocks raise the mask-free
+        # share of the causal loop. seq-1024 stays at 1024 tiles
+        # (17.07k vs 16.99k — noise; the full-bench record is 17.77k).
+        "BENCH_BLOCK_Q": os.environ.get("BENCH_SEQ2048_BLOCK", "512"),
+        "BENCH_BLOCK_K": os.environ.get("BENCH_SEQ2048_BLOCK", "512"),
         "BENCH_STEPS": "12",
     })
     seq2048 = _run_leg(s2_env)
