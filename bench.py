@@ -221,7 +221,11 @@ def bench_config():
             # loops defeat Mosaic pipelining) and a hoisted [bq, bk]
             # iota-difference mask (13.1k — the 4 MB VMEM resident hurt
             # more than the per-block iotas). fused_ce at seq 2048
-            # (14.5k) and batch 4 (14.1k) also lost to plain batch 3.
+            # (14.5k) and batch 4 (14.1k) also lost to plain batch 3,
+            # and a lax.cond-guarded masked-head split in the dkv
+            # kernel lost too (15.79k vs 15.95k at 512 tiles: the cond
+            # serializes Mosaic's chunk pipeline more than the mask
+            # costs).
             attention_block_q=int(os.environ.get("BENCH_BLOCK_Q", "1024")),
             attention_block_k=int(os.environ.get("BENCH_BLOCK_K", "1024")),
             attention_impl=os.environ.get("BENCH_ATTN_IMPL", "auto"),
