@@ -15,6 +15,15 @@ that catch real bugs rather than style:
   W605  invalid escape sequence in a non-raw string literal (via
         compile() in default warnings-as-errors mode per file)
 
+Beyond Python, the gate also validates chaos fault-schedule documents
+(``*.chaos.json``, the format infra/chaos.py replays) found under the
+lint roots — the check_bench_schema.py treatment: a schedule that names
+an unknown fault kind, drops a required param, or never recovers a
+downed chip fails `make lint`, not a 2am soak:
+
+  C900  unreadable / invalid JSON
+  C901  schema violation (from tpu_dra.infra.chaos.validate_schedule)
+
 Zero findings = exit 0. Any finding prints `path:line: CODE message`
 and exits 1, exactly like a linter in CI.
 """
@@ -173,19 +182,44 @@ def lint_file(path: Path) -> list:
     return v.findings
 
 
+def lint_chaos_schedule(path: Path) -> list:
+    """Validate one ``*.chaos.json`` fault schedule against the shared
+    schema (tpu_dra.infra.chaos.validate_schedule — one source of truth
+    for the loader and this gate)."""
+    import json
+
+    repo_root = str(Path(__file__).resolve().parent.parent)
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    from tpu_dra.infra.chaos import validate_schedule
+
+    try:
+        data = json.loads(path.read_text(encoding="utf-8"))
+    except (OSError, ValueError) as e:
+        return [(path, 0, "C900", f"invalid JSON: {e}")]
+    return [(path, 0, "C901", err) for err in validate_schedule(data)]
+
+
 def main(argv: list) -> int:
     roots = [Path(a) for a in argv] or [Path("tpu_dra"), Path("tests")]
     files: list = []
+    schedules: list = []
     for root in roots:
         if root.is_file():
-            files.append(root)
+            (schedules if root.name.endswith(".chaos.json") else files).append(
+                root
+            )
         else:
             files.extend(sorted(root.rglob("*.py")))
+            schedules.extend(sorted(root.rglob("*.chaos.json")))
     findings = []
     for f in files:
         if "/pb/" in str(f):  # protoc output is generated, not linted
             continue
         findings.extend(lint_file(f))
+    for s in schedules:
+        findings.extend(lint_chaos_schedule(s))
+    files = files + schedules
     for path, lineno, code, msg in findings:
         print(f"{path}:{lineno}: {code} {msg}")
     print(

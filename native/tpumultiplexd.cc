@@ -562,6 +562,29 @@ class Daemon {
       }
     } else if (op == "status") {
       Send(c, StatusJson());
+    } else if (op == "revoke") {
+      // Administrative revocation (remediation on unhealthy chips): kick
+      // the current holder with a revoked push, NO cooldown — the client
+      // is a victim, not a hog, and must be free to re-acquire the
+      // moment the hardware recovers. Python-daemon twin: force_revoke.
+      std::string reason = JsonStringField(line, "reason");
+      if (reason.empty()) reason = "administrative revocation";
+      bool revoked = holder_ != -1;
+      if (revoked) {
+        auto it = conns_.find(holder_);
+        revocations_++;
+        if (it != conns_.end()) {
+          Send(it->second,
+               "{\"event\": \"revoked\", \"reason\": \"" +
+                   JsonEscape(reason) + "\", \"cooldownSeconds\": 0.0}");
+        }
+        fprintf(stderr, "force-revoked lease (%s); %zu revocations total\n",
+                reason.c_str(), revocations_);
+        holder_ = -1;
+        if (gate_.armed()) gate_.Lock();
+      }
+      Send(c, revoked ? "{\"ok\": true, \"revoked\": true}"
+                      : "{\"ok\": true, \"revoked\": false}");
     } else if (op == "ping") {
       Send(c, "{\"ok\": true}");
     } else {

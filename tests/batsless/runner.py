@@ -1511,8 +1511,11 @@ def run_suites(r: Runner, stack: Stack, td: Path) -> int:
         proc.kill()
         proc.wait(timeout=10)
         logf.close()
-        wait_for(lambda: cd_status() == "NotReady", timeout=90,
-                 what="NotReady after daemon crash")
+        # nodeLossPolicy=failFast (default): a previously-Ready domain
+        # that loses a daemon goes Failed promptly; NotReady is tolerated
+        # for the pre-staleness transition window.
+        wait_for(lambda: cd_status() in ("Failed", "NotReady"), timeout=90,
+                 what="Failed/NotReady after daemon crash")
         c2 = make_channel_claim(cd_ns, "wl2", "channel-1", uid)
         cds["wl2"] = c2
         res = prepare(cd_sock, c2)
@@ -1548,9 +1551,18 @@ def run_suites(r: Runner, stack: Stack, td: Path) -> int:
         # dance tests/conftest.py does) so the exerciser gets a 4-device
         # host mesh.
         shim = (
+            "import os\n"
+            "flags = os.environ.get('XLA_FLAGS', '')\n"
+            "if 'xla_force_host_platform_device_count' not in flags:\n"
+            "    os.environ['XLA_FLAGS'] = (\n"
+            "        flags + ' --xla_force_host_platform_device_count=4'\n"
+            "    ).strip()\n"
             "import jax\n"
             "jax.config.update('jax_platforms', 'cpu')\n"
-            "jax.config.update('jax_num_cpu_devices', 4)\n"
+            "try:\n"
+            "    jax.config.update('jax_num_cpu_devices', 4)\n"
+            "except AttributeError:\n"
+            "    pass  # old JAX: XLA_FLAGS fallback above covers it\n"
             "from tpu_dra.workloads.icibandwidth import main\n"
             "raise SystemExit(main(['--size-mb', '1', '--reps', '2',"
             " '--min-gbps', '0.001']))\n"

@@ -21,7 +21,12 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Older JAX has no jax_num_cpu_devices option; the XLA_FLAGS fallback
+    # above already forces the 8-device host platform.
+    pass
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,596 @@
+"""Chaos soak: randomized fault schedules against the in-process stack.
+
+The harness runs the real kubelet-plugin Driver (stub tpulib backend,
+AutoRemediation on) with a live in-process multiplex arbiter + client, and
+drives :mod:`tpu_dra.infra.chaos` schedules into every injection seam:
+
+- chip health flaps  -> the stub's health-event queue,
+- apiserver 429/5xx bursts + watch drops -> the fake apiserver's fault
+  hooks (soak runs the driver over REAL HTTP through rest.KubeClient),
+- kubelet-plugin crash/restart -> rebuild the Driver over the same state
+  dirs (checkpoint + persisted sub-slice replay),
+- multiplex client death mid-lease -> abrupt socket close.
+
+Convergence contract (the acceptance bar): after every schedule the system
+settles with zero leaked leases, zero dangling prepared claims, and
+ResourceSlices matching actual chip health; a recovered chip is
+re-published and re-allocatable WITHOUT a plugin restart.
+
+The smoke test (fast, deterministic, hand-written schedule) runs in tier-1
+and `make chaos`; the randomized multi-seed soak is marked slow.
+"""
+
+import os
+import shutil
+import tempfile
+import threading
+import time
+import uuid as uuidlib
+
+import pytest
+
+from tpu_dra.infra import featuregates as fg
+from tpu_dra.infra.chaos import (
+    APISERVER_ERRORS,
+    APISERVER_THROTTLE,
+    CHIP_DOWN,
+    CHIP_UP,
+    CLIENT_DEATH,
+    PLUGIN_CRASH,
+    WATCH_DROP,
+    ChaosEngine,
+    FaultSchedule,
+    validate_schedule,
+)
+from tpu_dra.k8sclient import (
+    DEPLOYMENTS,
+    RESOURCE_CLAIMS,
+    RESOURCE_SLICES,
+    FakeCluster,
+    ResourceClient,
+)
+from tpu_dra.k8sclient.fakeserver import FakeApiServer
+from tpu_dra.k8sclient.rest import KubeClient
+from tpu_dra.plugin.checkpoint import CLAIM_STATE_PREPARE_COMPLETED
+from tpu_dra.plugin.device_state import DRIVER_NAME
+from tpu_dra.plugin.driver import Driver, DriverConfig
+from tpu_dra.plugin.multiplexd import MultiplexDaemon
+from tpu_dra.plugin.remediation import REMEDIATION_ANNOTATION
+from tpu_dra.tpulib.stub import StubTpuLib
+from tpu_dra.tpulib.types import ChipHealthEvent
+from tpu_dra.workloads.multiplex_client import MultiplexClient
+
+DEBOUNCE = 0.15
+ALL_DEVICES = ["tpu-0", "tpu-1", "tpu-2", "tpu-3"]
+
+
+def gates(**kwargs):
+    g = fg.FeatureGates()
+    for k, v in kwargs.items():
+        g.set(k, v)
+    fg.reset_for_tests(g)
+
+
+def chaos_gates():
+    gates(
+        DeviceHealthCheck=True,
+        AutoRemediation=True,
+        MultiplexingSupport=True,
+    )
+
+
+def wait_for(predicate, timeout=10.0, poll=0.02, msg=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(poll)
+    assert predicate(), msg or "condition did not converge"
+
+
+def make_claim(devices, configs=None, uid=None):
+    uid = uid or str(uuidlib.uuid4())
+    results = [
+        {"request": "req0", "driver": DRIVER_NAME, "pool": "node-0",
+         "device": d}
+        for d in devices
+    ]
+    return {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {
+            "name": f"claim-{uid[:6]}", "namespace": "default", "uid": uid,
+        },
+        "status": {
+            "allocation": {
+                "devices": {"results": results, "config": configs or []}
+            }
+        },
+    }
+
+
+MUX_CONFIG = [{
+    "opaque": {
+        "driver": DRIVER_NAME,
+        "parameters": {
+            "apiVersion": "resource.tpu.google.com/v1beta1",
+            "kind": "TpuConfig",
+            "sharing": {"strategy": "Multiplexing"},
+        },
+    },
+    "requests": [],
+    "source": "FromClaim",
+}]
+
+
+class ChaosHarness:
+    """Driver + arbiter + client + fault seams, over FakeCluster (unit
+    mode) or real HTTP through the fake apiserver (soak mode)."""
+
+    def __init__(self, tmp_path, over_http=False):
+        self.tmp_path = tmp_path
+        self.srv = None
+        if over_http:
+            self.srv = FakeApiServer(watch_heartbeat_seconds=1.0).start()
+            self.cluster = self.srv.cluster
+            self.backend = KubeClient(self.srv.server_url)
+        else:
+            self.cluster = FakeCluster()
+            self.backend = self.cluster
+        # AF_UNIX paths cap at ~108 chars and pytest tmp dirs are deep:
+        # the socket root (root/<claim-uid>/multiplexd.sock) needs a short
+        # prefix of its own.
+        self.socket_root = tempfile.mkdtemp(prefix="cx-")
+        self.daemons = {}       # claim uid -> in-process MultiplexDaemon
+        self.clients = {}       # claim uid -> MultiplexClient (live)
+        self._stop_ready = threading.Event()
+        self._ready_thread = threading.Thread(
+            target=self._auto_ready_loop, daemon=True,
+            name="chaos-auto-ready",
+        )
+        self._ready_thread.start()
+        self.driver = None
+        self.build_driver()
+
+    # The fake cluster has no controller manager: poll-mark every
+    # multiplex-daemon Deployment ready so Prepare's assert_ready gate
+    # passes. Polling (not a watch) stays oblivious to injected watch
+    # drops — this loop plays "kubelet on another node", not a client
+    # under test.
+    def _auto_ready_loop(self):
+        deployments = ResourceClient(self.cluster, DEPLOYMENTS)
+        while not self._stop_ready.wait(0.05):
+            try:
+                for dep in deployments.list(namespace="tpu-dra-driver"):
+                    if (dep.get("status") or {}).get("readyReplicas", 0) < 1:
+                        dep["status"] = {"readyReplicas": 1}
+                        deployments.update_status(dep)
+            except Exception:
+                pass
+
+    def build_driver(self):
+        self.lib = StubTpuLib(
+            config={"generation": "v5e", "hostname": "node-0"},
+            state_dir=str(self.tmp_path / "tpustate"),
+        )
+        cfg = DriverConfig(
+            node_name="node-0",
+            cdi_root=str(self.tmp_path / "cdi"),
+            plugin_data_dir=str(self.tmp_path / "plugin"),
+            kubelet_registrar_dir=str(self.tmp_path / "registry"),
+            start_grpc=False,
+            cdi_hook_source="",
+            multiplex_socket_root=self.socket_root,
+            remediation_debounce_seconds=DEBOUNCE,
+        )
+        self.driver = Driver(self.lib, self.backend, cfg)
+        self.driver.start()
+
+    # --- claims -----------------------------------------------------------
+
+    def create_claim(self, devices, configs=None):
+        claim = make_claim(devices, configs)
+        # Setup writes go straight to the cluster (fault injection must
+        # not flake the arrangement, only the system under test). Like a
+        # real apiserver, create assigns the uid — the kubelet would hand
+        # the plugin the server's view, so graft it into our copy.
+        created = ResourceClient(self.cluster, RESOURCE_CLAIMS).create(claim)
+        claim["metadata"]["uid"] = created["metadata"]["uid"]
+        self.driver.state.prepare(claim)
+        return claim
+
+    def create_mux_claim(self, devices=("tpu-0", "tpu-1")):
+        """A multiplexed claim + the in-process arbiter 'pod' + one live
+        client holding the lease."""
+        claim = self.create_claim(list(devices), configs=MUX_CONFIG)
+        uid = claim["metadata"]["uid"]
+        chips = [
+            self.lib.chips()[int(d.split("-")[1])].uuid for d in devices
+        ]
+        daemon = MultiplexDaemon(
+            os.path.join(self.socket_root, uid), chips, window_seconds=0.5
+        ).start()
+        self.daemons[uid] = daemon
+        client = MultiplexClient(
+            daemon.socket_dir, client_name=f"chaos-{uid[:6]}"
+        )
+        client.acquire()
+        self.clients[uid] = client
+        return claim
+
+    # --- injectors --------------------------------------------------------
+
+    def inject_chip_down(self, ev):
+        chip = self.lib.chips()[int(ev.params["chip_index"])]
+        self.lib.inject_health_event(ChipHealthEvent(
+            chip_uuid=chip.uuid, healthy=False,
+            reason=ev.params.get("reason", "injected"),
+        ))
+
+    def inject_chip_up(self, ev):
+        chip = self.lib.chips()[int(ev.params["chip_index"])]
+        self.lib.inject_health_event(ChipHealthEvent(
+            chip_uuid=chip.uuid, healthy=True,
+            reason=ev.params.get("reason", "recovered"),
+        ))
+
+    def crash_plugin(self, ev=None):
+        """Process-death analog: the old driver's threads stop with NO
+        graceful unprepare/teardown; a fresh driver then replays the
+        persisted checkpoint + sub-slice state from the same dirs."""
+        old = self.driver
+        old.cleanup.stop()
+        old.health_monitor.stop()
+        if old.remediation is not None:
+            old.remediation.stop()
+        self.build_driver()
+
+    def kill_client(self, ev=None):
+        """Abrupt client death mid-lease: close the socket with no
+        release; the arbiter must reap the lease on its own."""
+        for uid, client in sorted(self.clients.items()):
+            if client._sock is not None:
+                client._sock.close()
+                client._sock = None
+                client._file = None
+                del self.clients[uid]
+                return
+
+    def engine_for(self, schedule) -> ChaosEngine:
+        e = ChaosEngine(schedule)
+        e.register(CHIP_DOWN, self.inject_chip_down)
+        e.register(CHIP_UP, self.inject_chip_up)
+        e.register(PLUGIN_CRASH, self.crash_plugin)
+        e.register(CLIENT_DEATH, self.kill_client)
+        if self.srv is not None:
+            e.register(APISERVER_THROTTLE, lambda ev: self.srv.inject_faults(
+                throttle=ev.params["count"],
+                retry_after=ev.params.get("retry_after", 0.05),
+            ))
+            e.register(APISERVER_ERRORS, lambda ev: self.srv.inject_faults(
+                fail=ev.params["count"],
+                fail_status=ev.params.get("status", 503),
+            ))
+            e.register(WATCH_DROP, lambda ev: self.srv.inject_faults(
+                drop_watches=True,
+            ))
+        return e
+
+    # --- convergence ------------------------------------------------------
+
+    def published_device_names(self):
+        slices = ResourceClient(self.cluster, RESOURCE_SLICES).list(
+            label_selector={"tpu.google.com/driver": "true"}
+        )
+        return sorted(d["name"] for s in slices for d in s["spec"]["devices"])
+
+    def settle(self, timeout=15.0):
+        """Wait until the remediation pipeline drained: no debounce timers,
+        no queued/processing requeue work."""
+        rem = self.driver.remediation
+
+        def drained():
+            return (
+                rem is None
+                or (
+                    not rem._pending
+                    and not rem.queue._pending
+                    and not rem.queue._processing
+                    and not rem.queue._dirty
+                )
+            )
+
+        wait_for(drained, timeout, msg="remediation pipeline did not drain")
+
+    def assert_converged(self):
+        # 1. Terminal chip state is all-healthy (schedules guarantee it).
+        assert all(c.healthy for c in self.lib.chips())
+        # 2. ResourceSlices match chip health: every device republished.
+        wait_for(
+            lambda: self.published_device_names() == ALL_DEVICES,
+            15,
+            msg=f"slices stuck at {self.published_device_names()}",
+        )
+        # 3. No dangling prepared claims: every checkpoint entry maps to a
+        # live API claim with the same uid and a completed WAL state.
+        cp = self.driver.state.checkpoints.get()
+        live = {
+            c["metadata"]["uid"]
+            for c in ResourceClient(self.cluster, RESOURCE_CLAIMS).list()
+        }
+        for uid, claim in cp.prepared_claims.items():
+            assert uid in live, f"checkpoint claim {uid} dangles (no API object)"
+            assert claim.checkpoint_state == CLAIM_STATE_PREPARE_COMPLETED
+        # 4. No leaked leases: every arbiter's lease is either free or held
+        # by a client that is still alive.
+        live_names = {c.client_name for c in self.clients.values()}
+        for uid, daemon in self.daemons.items():
+            holder = daemon.state.status()["holder"]
+            assert holder is None or holder in live_names, (
+                f"leaked lease on claim {uid}: holder={holder!r}"
+            )
+
+    def assert_reallocatable(self, chip_index):
+        """A recovered chip is re-allocatable WITHOUT a plugin restart."""
+        claim = self.create_claim([f"tpu-{chip_index}"])
+        self.driver.state.unprepare(claim["metadata"]["uid"])
+
+    def teardown(self):
+        self._stop_ready.set()
+        for client in self.clients.values():
+            client.close()
+        for daemon in self.daemons.values():
+            daemon.stop()
+        self.driver.shutdown()
+        if self.srv is not None:
+            self.srv.stop()
+        shutil.rmtree(self.socket_root, ignore_errors=True)
+
+
+# --- schedule validation (the hack/lint.py gate shares this) ---------------
+
+
+def test_validate_schedule_accepts_generated():
+    for seed in (0, 1, 42):
+        s = FaultSchedule.from_seed(seed, duration=4.0, chips=4)
+        assert validate_schedule(s.to_dict()) == []
+
+
+def test_validate_schedule_rejects_garbage():
+    assert validate_schedule([]) != []
+    assert validate_schedule({"events": []}) != []
+    assert validate_schedule(
+        {"events": [{"at": -1, "kind": "chip_down", "chip_index": 0}]}
+    )
+    assert validate_schedule({"events": [{"at": 0, "kind": "nope"}]})
+    # chip_down without params
+    assert validate_schedule({"events": [{"at": 0, "kind": "chip_down"}]})
+    # throttle without count
+    assert validate_schedule(
+        {"events": [{"at": 0, "kind": "apiserver_throttle"}]}
+    )
+
+
+def test_validate_schedule_requires_recovery():
+    errs = validate_schedule({"events": [
+        {"at": 0.0, "kind": "chip_down", "chip_index": 1, "reason": "x"},
+    ]})
+    assert any("never recovers" in e for e in errs)
+    # ... and rejects an up for a chip never taken down.
+    errs = validate_schedule({"events": [
+        {"at": 0.0, "kind": "chip_up", "chip_index": 1},
+    ]})
+    assert any("not down" in e for e in errs)
+    # Pairing follows the EXECUTION timeline (sorted by 'at'), not file
+    # order: an up that fires before its down leaves the chip down at the
+    # end, which must be rejected.
+    errs = validate_schedule({"events": [
+        {"at": 2.0, "kind": "chip_down", "chip_index": 1, "reason": "x"},
+        {"at": 1.0, "kind": "chip_up", "chip_index": 1},
+    ]})
+    assert errs
+
+
+def test_schedule_is_deterministic_per_seed():
+    a = FaultSchedule.from_seed(1234, duration=5.0, chips=4)
+    b = FaultSchedule.from_seed(1234, duration=5.0, chips=4)
+    assert a.to_dict() == b.to_dict()
+    c = FaultSchedule.from_seed(1235, duration=5.0, chips=4)
+    assert a.to_dict() != c.to_dict()
+
+
+def test_schedule_json_roundtrip(tmp_path):
+    s = FaultSchedule.from_seed(9, duration=4.0, chips=4)
+    path = tmp_path / "drill.chaos.json"
+    import json
+
+    path.write_text(json.dumps(s.to_dict()))
+    loaded = FaultSchedule.from_file(str(path))
+    assert loaded.to_dict()["events"] == s.to_dict()["events"]
+
+
+# --- fakeserver fault hooks -------------------------------------------------
+
+
+def test_fakeserver_5xx_burst_and_recovery():
+    srv = FakeApiServer().start()
+    try:
+        client = KubeClient(srv.server_url)
+        claims = ResourceClient(client, RESOURCE_CLAIMS)
+        # A burst inside the transport's retry budget is absorbed.
+        srv.inject_faults(fail=2, fail_status=503)
+        assert claims.list(namespace="default") == []
+        with srv._fault_lock:
+            assert srv._stats["failed"] == 2
+    finally:
+        srv.stop()
+
+
+# --- the deterministic smoke drill (tier-1 + `make chaos`) ------------------
+
+
+def test_chaos_smoke_remediation_cycle(tmp_path):
+    """Hand-written schedule: the multiplexed claim's chip fails past the
+    debounce, remediation revokes the lease + requeues the claim +
+    unpublishes the chip; recovery republishes and the chip is
+    re-allocatable — all without a plugin restart."""
+    chaos_gates()
+    h = ChaosHarness(tmp_path)
+    try:
+        mux = h.create_mux_claim()
+        solo = h.create_claim(["tpu-3"])
+        mux_uid = mux["metadata"]["uid"]
+        daemon = h.daemons[mux_uid]
+        assert daemon.state.status()["holder"] is not None
+
+        schedule = FaultSchedule.from_dict({
+            "version": 1,
+            "description": "single sustained flap on the shared chip",
+            "events": [
+                {"at": 0.0, "kind": "chip_down", "chip_index": 0,
+                 "reason": "ici-link-down"},
+                {"at": 0.8, "kind": "chip_up", "chip_index": 0,
+                 "reason": "recovered"},
+            ],
+        })
+        engine = h.engine_for(schedule)
+
+        # Fire the failure, then observe the down-window before recovery.
+        assert engine.step().kind == CHIP_DOWN
+        wait_for(
+            lambda: "tpu-0" not in h.published_device_names(), 5,
+            msg="unhealthy chip was not unpublished",
+        )
+        # Debounce elapses -> lease revoked, claim requeued + annotated.
+        wait_for(
+            lambda: daemon.state.status()["holder"] is None, 5,
+            msg="remediation did not revoke the lease",
+        )
+        wait_for(
+            lambda: mux_uid not in
+            h.driver.state.checkpoints.get().prepared_claims, 5,
+            msg="remediation did not requeue the prepared claim",
+        )
+        api_claim = ResourceClient(h.cluster, RESOURCE_CLAIMS).get(
+            mux["metadata"]["name"], "default"
+        )
+        assert REMEDIATION_ANNOTATION in api_claim["metadata"]["annotations"]
+        # The untouched claim survives.
+        assert (
+            solo["metadata"]["uid"]
+            in h.driver.state.checkpoints.get().prepared_claims
+        )
+        # Remediation metrics moved.
+        rendered = h.driver.metrics.render()
+        assert "remediations_total 1.0" in rendered
+        assert "remediation_claims_requeued_total 1.0" in rendered
+
+        # Recovery: chip republished and re-allocatable, no restart.
+        assert engine.step().kind == CHIP_UP
+        wait_for(
+            lambda: h.published_device_names() == ALL_DEVICES, 5,
+            msg="recovered chip was not republished",
+        )
+        h.settle()
+        h.assert_converged()
+        h.assert_reallocatable(0)
+    finally:
+        h.teardown()
+
+
+def test_chaos_smoke_flap_suppressed(tmp_path):
+    """A flap shorter than the debounce window never remediates: the
+    claim keeps its devices and the lease survives."""
+    chaos_gates()
+    h = ChaosHarness(tmp_path)
+    try:
+        mux = h.create_mux_claim()
+        mux_uid = mux["metadata"]["uid"]
+        h.inject_chip_down(type("E", (), {"params": {"chip_index": 0}})())
+        h.inject_chip_up(type("E", (), {"params": {"chip_index": 0}})())
+        # Give the (would-be) debounce window time to fire.
+        time.sleep(DEBOUNCE + 0.3)
+        h.settle()
+        assert (
+            mux_uid in h.driver.state.checkpoints.get().prepared_claims
+        )
+        assert h.daemons[mux_uid].state.status()["holder"] is not None
+        assert (
+            "remediation_flaps_suppressed_total 1.0"
+            in h.driver.metrics.render()
+        )
+        h.assert_converged()
+    finally:
+        h.teardown()
+
+
+# --- the randomized soak (slow; 3 distinct seeds) ---------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [101, 202, 303])
+def test_chaos_soak_converges(tmp_path, seed):
+    chaos_gates()
+    h = ChaosHarness(tmp_path, over_http=True)
+    try:
+        h.create_mux_claim()
+        h.create_claim(["tpu-3"])
+        schedule = FaultSchedule.from_seed(
+            seed, duration=3.0, chips=4, events_per_second=2.5
+        )
+        assert validate_schedule(schedule.to_dict()) == []
+        engine = h.engine_for(schedule)
+        engine.run(time_scale=1.0)
+        assert engine.errors == [], engine.errors
+        # Clear any still-armed fault counters so convergence probes see a
+        # healthy apiserver (the faults themselves already hit mid-run).
+        h.srv.inject_faults(throttle=0, fail=0)
+        h.settle()
+        h.assert_converged()
+        # A failed chip is re-allocatable unless a SURVIVING claim still
+        # legitimately holds it (a flap shorter than the debounce never
+        # remediates, by design).
+        cp = h.driver.state.checkpoints.get()
+        still_held = {
+            pd.device.device_name
+            for claim in cp.prepared_claims.values()
+            for group in claim.prepared_devices
+            for pd in group.devices
+        }
+        failed = sorted({
+            int(e.params["chip_index"])
+            for e in schedule
+            if e.kind == CHIP_DOWN
+        })
+        free_failed = [i for i in failed if f"tpu-{i}" not in still_held]
+        if free_failed:
+            h.assert_reallocatable(free_failed[0])
+    finally:
+        h.teardown()
+
+
+# --- the shipped demo drill stays replayable --------------------------------
+
+
+def test_demo_schedules_validate_and_replay(tmp_path):
+    """Every *.chaos.json shipped under demo/chaos/ must pass the schema
+    gate AND actually replay to convergence (unit mode: apiserver faults
+    are skipped by the engine, which is part of the contract)."""
+    import glob
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = sorted(glob.glob(os.path.join(repo, "demo", "chaos", "*.chaos.json")))
+    assert paths, "no demo chaos schedules shipped"
+    chaos_gates()
+    for path in paths:
+        schedule = FaultSchedule.from_file(path)  # raises on schema drift
+        h = ChaosHarness(tmp_path / os.path.basename(path))
+        try:
+            h.create_mux_claim()
+            engine = h.engine_for(schedule)
+            engine.run(time_scale=1.0)
+            assert engine.errors == [], engine.errors
+            h.settle()
+            h.assert_converged()
+        finally:
+            h.teardown()

@@ -544,12 +544,14 @@ def test_full_computedomain_bringup(fc, tmp_path):
     assert env["JAX_NUM_PROCESSES"] == "2"
     assert env["TPU_WORKER_HOSTNAMES"].count(",") == 1
 
-    # Failover: daemon 1's host dies -> clique drops it -> CD NotReady ->
+    # Failover: daemon 1's host dies -> clique drops it -> CD Failed
+    # (nodeLossPolicy=failFast default: a whole domain that loses a member
+    # fails promptly instead of looking like it's still assembling) ->
     # new workload prepares block again (failure detection story).
     daemons[1].registration.deregister()
     daemons[0].run_once()
     reconcile(controller, cds.get("cd1", NS))
-    assert cds.get("cd1", NS)["status"]["status"] == "NotReady"
+    assert cds.get("cd1", NS)["status"]["status"] == "Failed"
     wl2 = channel_claim(cd, device="channel-1")
     with pytest.raises(PrepareError, match="not ready"):
         state.prepare(wl2)
@@ -765,11 +767,13 @@ def test_legacy_controller_aggregation_and_pruning(fc, tmp_path):
     d1.run_once()
     reconcile(c, cds.get("cd1", NS))
     assert cds.get("cd1", NS)["status"]["status"] == "Ready"
-    # node-1's daemon pod dies: its entry is pruned, the domain degrades.
+    # node-1's daemon pod dies: its entry is pruned, and the previously-
+    # whole domain goes Failed (failFast default), not back to "still
+    # assembling" NotReady.
     ResourceClient(fc, PODS).delete("daemon-node-1", DRIVER_NS)
     reconcile(c, cds.get("cd1", NS))
     cur = cds.get("cd1", NS)
-    assert cur["status"]["status"] == "NotReady"
+    assert cur["status"]["status"] == "Failed"
     assert [n["name"] for n in cur["status"]["nodes"]] == ["node-0"]
 
 
@@ -1058,7 +1062,7 @@ def test_heartbeat_staleness_marks_node_notready(fc, tmp_path):
     for d in daemons:
         d.run_once()
     sm = StatusManager(fc, node_stale_after=5.0)
-    nodes = sm._derive_nodes(cd)
+    nodes, _ = sm._derive_nodes(cd)
     assert [n["status"] for n in nodes] == ["Ready", "Ready"]
 
     # Clock-skew immunity: node-1's daemon stamps a wall-clock time 60s in
@@ -1073,7 +1077,7 @@ def test_heartbeat_staleness_marks_node_notready(fc, tmp_path):
                 ) - datetime.timedelta(seconds=60)
                 e["lastHeartbeatTime"] = old.strftime("%Y-%m-%dT%H:%M:%SZ")
         cliques.update(cl)
-    statuses = {n["name"]: n["status"] for n in sm._derive_nodes(cd)}
+    statuses = {n["name"]: n["status"] for n in sm._derive_nodes(cd)[0]}
     assert statuses == {"node-0": "Ready", "node-1": "Ready"}
 
     # Now the value stops changing: once the controller has observed no
@@ -1082,7 +1086,7 @@ def test_heartbeat_staleness_marks_node_notready(fc, tmp_path):
     for key, (raw, seen) in list(sm._observed.items()):
         if key[2] == "node-1":
             sm._observed[key] = (raw, seen - 60.0)
-    statuses = {n["name"]: n["status"] for n in sm._derive_nodes(cd)}
+    statuses = {n["name"]: n["status"] for n in sm._derive_nodes(cd)[0]}
     assert statuses == {"node-0": "Ready", "node-1": "NotReady"}
 
     # Heartbeat-less entries (written by an older driver) stay live.
@@ -1090,13 +1094,13 @@ def test_heartbeat_staleness_marks_node_notready(fc, tmp_path):
         for e in cl.get("daemons") or []:
             e.pop("lastHeartbeatTime", None)
         cliques.update(cl)
-    statuses = {n["name"]: n["status"] for n in sm._derive_nodes(cd)}
+    statuses = {n["name"]: n["status"] for n in sm._derive_nodes(cd)[0]}
     assert statuses == {"node-0": "Ready", "node-1": "Ready"}
 
     # node_stale_after=0 disables the check entirely.
     assert all(
         n["status"] == "Ready"
-        for n in StatusManager(fc, node_stale_after=0)._derive_nodes(cd)
+        for n in StatusManager(fc, node_stale_after=0)._derive_nodes(cd)[0]
     )
 
     # A deregistered node's observed-at bookkeeping is pruned.
@@ -1261,3 +1265,133 @@ def test_releader_reconciles_with_fresh_controller(fc):
         t.join(timeout=5)
         for c in terms:
             c.stop()
+
+
+# --- node-loss policy (failFast vs shrink) ---------------------------------
+
+
+def _force_stale(sm, node_name):
+    """Backdate the controller's observed-heartbeat bookkeeping so
+    `node_name` counts stale on the next derivation."""
+    for key, (raw, seen) in list(sm._observed.items()):
+        if key[2] == node_name:
+            sm._observed[key] = (raw, seen - 10_000.0)
+
+
+def test_node_loss_shrink_prunes_and_stays_ready(fc, tmp_path):
+    """nodeLossPolicy=shrink: a Ready domain that loses a member prunes
+    the lost clique registration and stays Ready over the survivors; a
+    replacement joiner registering NotReady does NOT flip it to Failed
+    while it boots; once the joiner is Ready the domain is whole again."""
+    cd = make_cd(fc, num_nodes=2)
+    cd["spec"]["nodeLossPolicy"] = "shrink"
+    cds = ResourceClient(fc, COMPUTE_DOMAINS)
+    cd = cds.update(cd)
+    c = ComputeDomainController(fc, driver_namespace=DRIVER_NS)
+    daemons = [make_daemon(fc, cd, i, tmp_path) for i in range(2)]
+    for d in daemons:
+        d.run_once()
+    for d in daemons:
+        d.run_once()
+    reconcile(c, cds.get("cd1", NS))
+    assert cds.get("cd1", NS)["status"]["status"] == "Ready"
+
+    # node-1 goes silent: its heartbeat stops moving on the controller's
+    # clock -> pruned from the clique, domain shrinks but stays Ready.
+    _force_stale(c.status, "node-1")
+    reconcile(c, cds.get("cd1", NS))
+    cur = cds.get("cd1", NS)
+    assert cur["status"]["status"] == "Ready"
+    assert [n["name"] for n in cur["status"]["nodes"]] == ["node-0"]
+    cliques = ResourceClient(fc, COMPUTE_DOMAIN_CLIQUES)
+    clique = cliques.list(NS)[0]
+    assert [d["nodeName"] for d in clique["daemons"]] == ["node-0"]
+
+    # A replacement registers NotReady (assembling): the running domain
+    # must NOT flip Failed while it boots.
+    d1b = make_daemon(fc, cd, 1, tmp_path)
+    d1b.registration.register()  # registers as NotReady, index gap-filled
+    reconcile(c, cds.get("cd1", NS))
+    assert cds.get("cd1", NS)["status"]["status"] == "Ready"
+
+    # The joiner validates and reports Ready -> whole again at full size.
+    d1b.run_once()
+    daemons[0].run_once()
+    d1b.run_once()
+    reconcile(c, cds.get("cd1", NS))
+    cur = cds.get("cd1", NS)
+    assert cur["status"]["status"] == "Ready"
+    assert [n["name"] for n in cur["status"]["nodes"]] == ["node-0", "node-1"]
+
+
+def test_node_loss_fail_fast_flips_failed_then_recovers(fc, tmp_path):
+    """Default failFast: a Ready domain with a heartbeat-stale member goes
+    Failed (not NotReady), keeps the member registered, and clears back to
+    Ready when the heartbeat moves again."""
+    cd = make_cd(fc, num_nodes=2)
+    cds = ResourceClient(fc, COMPUTE_DOMAINS)
+    c = ComputeDomainController(fc, driver_namespace=DRIVER_NS)
+    daemons = [make_daemon(fc, cd, i, tmp_path) for i in range(2)]
+    for d in daemons:
+        d.run_once()
+    for d in daemons:
+        d.run_once()
+    reconcile(c, cds.get("cd1", NS))
+    assert cds.get("cd1", NS)["status"]["status"] == "Ready"
+
+    _force_stale(c.status, "node-1")
+    reconcile(c, cds.get("cd1", NS))
+    cur = cds.get("cd1", NS)
+    assert cur["status"]["status"] == "Failed"
+    # failFast does NOT shrink: the lost node stays registered (NotReady).
+    assert [n["name"] for n in cur["status"]["nodes"]] == ["node-0", "node-1"]
+
+    # Heartbeat moves again (node came back): whole -> Ready. register()
+    # only rewrites a DUE heartbeat and the stamp has 1s resolution, so
+    # make it due and step past the previous second.
+    daemons[1].registration.heartbeat_period = 0.0
+    time.sleep(1.1)
+    daemons[1].run_once()
+    reconcile(c, cds.get("cd1", NS))
+    assert cds.get("cd1", NS)["status"]["status"] == "Ready"
+
+
+def test_daemon_fail_fast_flags_lost_neighbor(fc, tmp_path):
+    """Daemon-side failFast: a peer whose heartbeat value stops moving on
+    OUR monotonic clock flips compute_ready False; clock skew alone (a
+    changing value with an old wall-clock stamp) must not."""
+    import datetime
+
+    cd = make_cd(fc, num_nodes=2)
+    daemons = [make_daemon(fc, cd, i, tmp_path) for i in range(2)]
+    for d in daemons:
+        d.run_once()
+    for d in daemons:
+        d.run_once()
+    d0 = daemons[0]
+    peers = d0.registration.peers()
+    assert d0.compute_ready(peers)
+
+    # Skew immunity: node-1 stamps 10 minutes in the past but the VALUE
+    # keeps changing -> alive.
+    cliques = ResourceClient(fc, COMPUTE_DOMAIN_CLIQUES)
+    for cl in cliques.list(NS):
+        for e in cl.get("daemons") or []:
+            if e["nodeName"] == "node-1":
+                old = datetime.datetime.now(
+                    datetime.timezone.utc
+                ) - datetime.timedelta(seconds=600)
+                e["lastHeartbeatTime"] = old.strftime("%Y-%m-%dT%H:%M:%SZ")
+        cliques.update(cl)
+    peers = d0.registration.peers()
+    assert d0.registration.lost_peers(peers=peers) == []
+    assert d0.compute_ready(peers)
+
+    # The value stops moving for > cutoff on OUR clock -> lost.
+    for name, (raw, seen) in list(d0.registration._peer_observed.items()):
+        d0.registration._peer_observed[name] = (raw, seen - 10_000.0)
+    peers = d0.registration.peers()
+    assert [
+        e["nodeName"] for e in d0.registration.lost_peers(peers=peers)
+    ] == ["node-1"]
+    assert not d0.compute_ready(peers)

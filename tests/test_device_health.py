@@ -1,0 +1,133 @@
+"""DeviceHealthMonitor unit tests.
+
+Reference analog: the NVML XID event loop (device_health.go:146-204) and
+its skip-list (:306-351). Covers: benign skip-list filtering, event
+fan-out to on_change (including callback exceptions not killing the
+loop), and stop() joining the monitor thread within poll_timeout + 1.
+"""
+
+import threading
+import time
+
+from tpu_dra.plugin.device_health import BENIGN_REASONS, DeviceHealthMonitor
+from tpu_dra.tpulib.stub import StubTpuLib
+from tpu_dra.tpulib.types import ChipHealthEvent
+
+
+def make_lib():
+    return StubTpuLib(config={"generation": "v5e", "hostname": "hm-node"})
+
+
+def wait_for(predicate, timeout=3.0, poll=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(poll)
+    return predicate()
+
+
+def test_benign_reasons_filtered():
+    """Unhealthy events with a skip-list reason never reach on_change, and
+    never poison chip state."""
+    lib = make_lib()
+    seen = []
+    mon = DeviceHealthMonitor(lib, seen.append, poll_timeout=0.05)
+    mon.start()
+    try:
+        chip = lib.chips()[0]
+        for reason in sorted(BENIGN_REASONS):
+            lib.inject_health_event(ChipHealthEvent(
+                chip_uuid=chip.uuid, healthy=False, reason=reason,
+            ))
+        # A real fault after the benign burst proves the loop is alive and
+        # the benign events were consumed (not merely queued behind).
+        lib.inject_health_event(ChipHealthEvent(
+            chip_uuid=chip.uuid, healthy=False, reason="ici-link-down",
+        ))
+        assert wait_for(lambda: len(seen) == 1)
+        assert seen[0].reason == "ici-link-down"
+        assert not chip.healthy  # only the non-benign event marked it
+    finally:
+        mon.stop()
+
+
+def test_benign_reason_on_healthy_event_not_filtered():
+    """The skip-list applies to UNHEALTHY events only: a recovery event
+    whose reason happens to be on the list still fans out."""
+    lib = make_lib()
+    seen = []
+    mon = DeviceHealthMonitor(lib, seen.append, poll_timeout=0.05)
+    mon.start()
+    try:
+        chip = lib.chips()[1]
+        lib.inject_health_event(ChipHealthEvent(
+            chip_uuid=chip.uuid, healthy=True, reason="preemption",
+        ))
+        assert wait_for(lambda: len(seen) == 1)
+        assert seen[0].healthy
+    finally:
+        mon.stop()
+
+
+def test_event_fanout_order_and_resilience():
+    """Events fan out to on_change in injection order; a callback that
+    raises is logged and the loop keeps delivering."""
+    lib = make_lib()
+    seen = []
+
+    def cb(ev):
+        seen.append(ev.chip_uuid)
+        if len(seen) == 1:
+            raise RuntimeError("first callback blows up")
+
+    mon = DeviceHealthMonitor(lib, cb, poll_timeout=0.05)
+    mon.start()
+    try:
+        chips = lib.chips()
+        for c in chips[:3]:
+            lib.inject_health_event(ChipHealthEvent(
+                chip_uuid=c.uuid, healthy=False, reason="hbm-uncorrectable",
+            ))
+        assert wait_for(lambda: len(seen) == 3)
+        assert seen == [c.uuid for c in chips[:3]]
+    finally:
+        mon.stop()
+
+
+def test_stop_joins_within_poll_timeout():
+    """stop() must return with the monitor thread dead within
+    poll_timeout + 1 even when the queue is idle (the join bound the
+    monitor promises its callers)."""
+    lib = make_lib()
+    poll_timeout = 0.3
+    mon = DeviceHealthMonitor(lib, lambda ev: None, poll_timeout=poll_timeout)
+    mon.start()
+    assert mon._thread is not None and mon._thread.is_alive()
+    t0 = time.monotonic()
+    mon.stop()
+    elapsed = time.monotonic() - t0
+    assert elapsed <= poll_timeout + 1
+    assert not mon._thread.is_alive()
+
+
+def test_stop_unblocks_callback_in_flight():
+    """A slow callback cannot extend stop() past its promised bound by
+    more than the callback's own remaining work."""
+    lib = make_lib()
+    release = threading.Event()
+    entered = threading.Event()
+
+    def slow_cb(ev):
+        entered.set()
+        release.wait(2)
+
+    mon = DeviceHealthMonitor(lib, slow_cb, poll_timeout=0.2)
+    mon.start()
+    lib.inject_health_event(ChipHealthEvent(
+        chip_uuid=lib.chips()[0].uuid, healthy=False, reason="thermal-trip",
+    ))
+    assert entered.wait(2)
+    release.set()
+    mon.stop()
+    assert not mon._thread.is_alive()

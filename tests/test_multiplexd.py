@@ -749,3 +749,50 @@ def test_wait_histogram_published_in_status(daemon, tmp_path):
         if k not in ("0.01", "0.1")
     ) >= 1
     c0.close()
+
+
+def test_admin_revoke_kicks_holder_no_cooldown(daemon, tmp_path):
+    """The `revoke` op (remediation on unhealthy chips, both daemons):
+    the holder loses its lease with a revoked push, the next waiter is
+    granted, and the victim can re-acquire immediately — NO cooldown."""
+    import json as _json
+
+    holder = MultiplexClient(str(tmp_path), client_name="victim")
+    holder.acquire()
+    waiter = MultiplexClient(str(tmp_path), client_name="waiter")
+    granted = threading.Event()
+    threading.Thread(
+        target=lambda: (waiter.acquire(), granted.set()), daemon=True
+    ).start()
+    _wait_status(holder, lambda st: st["waiting"] == 1)
+
+    with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+        s.settimeout(5)
+        s.connect(os.path.join(str(tmp_path), SOCKET_NAME))
+        s.sendall(b'{"op": "revoke", "reason": "chip chip-a unhealthy"}\n')
+        resp = _json.loads(s.makefile().readline())
+    assert resp == {"ok": True, "revoked": True}
+
+    assert granted.wait(5)  # the waiter got the lease
+    st = _wait_status(waiter, lambda st: st["holder"] == "waiter")
+    assert st["revocations"] == 1
+    # The victim saw the revoked event (folded in on its next rpc) and can
+    # re-acquire right away: no cooldown for administrative revocation.
+    waiter.release()
+    holder.acquire()
+    assert holder.revoked is False or holder.revocations >= 1
+    assert _wait_status(holder, lambda st: st["holder"] == "victim")
+    holder.release()
+    holder.close()
+    waiter.close()
+
+
+def test_admin_revoke_without_holder(daemon, tmp_path):
+    import json as _json
+
+    with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+        s.settimeout(5)
+        s.connect(os.path.join(str(tmp_path), SOCKET_NAME))
+        s.sendall(b'{"op": "revoke"}\n')
+        resp = _json.loads(s.makefile().readline())
+    assert resp == {"ok": True, "revoked": False}

@@ -220,3 +220,101 @@ def test_backoff_is_per_item_not_per_key():
         rl.when(a)
     b = WorkItem(key="", obj=None, callback=lambda o: None)
     assert rl.when(b) == 0.25
+
+
+def test_dead_letter_after_max_retries():
+    """A permanently-failing keyed item stops retrying after max_retries,
+    lands in dead_letters, and bumps workqueue_dead_letter_total — instead
+    of hammering the backoff cap forever."""
+    from tpu_dra.infra.metrics import Metrics
+
+    m = Metrics()
+    q = WorkQueue(
+        ItemExponentialFailureRateLimiter(0.001, 0.005),
+        metrics=m,
+        max_retries=3,
+    )
+    calls = []
+
+    def cb(obj):
+        calls.append(obj)
+        raise RuntimeError("poison")
+
+    q.enqueue("claim-uid", cb, key="requeue/claim")
+    _run(q)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and not q.dead_letters:
+        time.sleep(0.01)
+    assert len(q.dead_letters) == 1
+    assert q.dead_letters[0].key == "requeue/claim"
+    # 1 initial attempt + max_retries retries, then silence.
+    assert len(calls) == 4
+    time.sleep(0.1)
+    assert len(calls) == 4
+    q.shutdown()
+    assert "workqueue_dead_letter_total 1.0" in m.render()
+
+
+def test_dead_letter_unkeyed_item():
+    q = WorkQueue(
+        ItemExponentialFailureRateLimiter(0.001, 0.005), max_retries=1
+    )
+    calls = []
+
+    def cb(obj):
+        calls.append(obj)
+        raise RuntimeError("poison")
+
+    q.enqueue("x", cb)
+    _run(q)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and not q.dead_letters:
+        time.sleep(0.01)
+    assert len(calls) == 2  # initial + one retry
+    q.shutdown()
+
+
+def test_dead_letter_key_can_be_re_enqueued_fresh():
+    """Dead-lettering drops the item AND its limiter state: a later fresh
+    enqueue for the same key runs again with a clean retry budget."""
+    q = WorkQueue(
+        ItemExponentialFailureRateLimiter(0.001, 0.005), max_retries=1
+    )
+    calls = []
+    done = threading.Event()
+
+    def bad(obj):
+        calls.append("bad")
+        raise RuntimeError("poison")
+
+    q.enqueue("o", bad, key="k")
+    _run(q)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and not q.dead_letters:
+        time.sleep(0.01)
+    assert q.dead_letters
+
+    q.enqueue("n", lambda o: done.set(), key="k")
+    assert done.wait(2)
+    q.shutdown()
+
+
+def test_no_dead_letter_by_default():
+    """max_retries=None (the default) keeps today's retry-forever
+    semantics — reconcilers with barrier-style RetryLater callbacks depend
+    on it."""
+    q = WorkQueue(ItemExponentialFailureRateLimiter(0.001, 0.002))
+    calls = []
+    many = threading.Event()
+
+    def cb(obj):
+        calls.append(obj)
+        if len(calls) >= 10:
+            many.set()
+        raise RuntimeError("barrier not met")
+
+    q.enqueue("x", cb, key="k")
+    _run(q)
+    assert many.wait(5)
+    assert not q.dead_letters
+    q.shutdown()

@@ -53,9 +53,13 @@ from tpu_dra.api.configs import (  # noqa: F401
     default_vfio_device_config,
 )
 from tpu_dra.api.computedomain import (  # noqa: F401
+    CD_STATUS_FAILED,
     CD_STATUS_NOT_READY,
     CD_STATUS_NONE,
     CD_STATUS_READY,
+    NODE_LOSS_FAIL_FAST,
+    NODE_LOSS_POLICIES,
+    NODE_LOSS_SHRINK,
     CHANNEL_ALLOCATION_MODE_ALL,
     CHANNEL_ALLOCATION_MODE_SINGLE,
     ComputeDomain,

@@ -23,6 +23,17 @@ _API_VERSION = "resource.tpu.google.com/v1beta1"
 CD_STATUS_NONE = ""
 CD_STATUS_READY = "Ready"
 CD_STATUS_NOT_READY = "NotReady"
+# A previously-Ready domain that lost a node under nodeLossPolicy=failFast:
+# terminal-until-recovery, so workloads and operators can distinguish
+# "lost a member" from "still assembling" (both NotReady in the reference).
+CD_STATUS_FAILED = "Failed"
+
+# spec.nodeLossPolicy: what a Ready domain does when a registered node is
+# lost (stale heartbeat / NotReady daemon).
+NODE_LOSS_FAIL_FAST = "failFast"  # default: fail the domain promptly
+NODE_LOSS_SHRINK = "shrink"       # prune the lost node; stay Ready on the
+                                  # surviving hosts
+NODE_LOSS_POLICIES = (NODE_LOSS_FAIL_FAST, NODE_LOSS_SHRINK)
 
 CHANNEL_ALLOCATION_MODE_SINGLE = "Single"
 CHANNEL_ALLOCATION_MODE_ALL = "All"
@@ -104,6 +115,11 @@ class ComputeDomainSpec(Serde):
     # Multi-slice (DCN/megascale) domains: number of ICI pod slices the
     # domain spans; must divide numNodes. 1 = single-slice (the common case).
     num_slices: int = 1
+    # Node-loss policy for a Ready domain: "failFast" (default; the domain
+    # goes Failed promptly so the job restarts) or "shrink" (the lost
+    # node's registration is pruned and the domain stays Ready over the
+    # survivors).
+    node_loss_policy: str = ""
 
     FIELDS = {
         "numNodes": Field("num_nodes", required=True),
@@ -111,6 +127,7 @@ class ComputeDomainSpec(Serde):
         "topology": Field("topology"),
         "acceleratorType": Field("accelerator_type"),
         "numSlices": Field("num_slices"),
+        "nodeLossPolicy": Field("node_loss_policy"),
     }
 
 

@@ -187,6 +187,12 @@ class ComputeDomainController:
         self.rcts.create_or_update(cd)
         self.daemonsets.create_or_update(cd)
         self.status.assign_slice_indices(cd)
+        # Node-loss handling (spec.nodeLossPolicy): under `shrink` a
+        # Ready domain's heartbeat-stale registrations are pruned from
+        # their cliques before status derivation, so the domain stays
+        # Ready over the survivors; under `failFast` (default) the sync
+        # below flips a degraded domain to Failed promptly.
+        self.status.prune_lost_nodes(cd)
         self.status.sync(cd)
 
     def _teardown(self, cd: dict) -> None:

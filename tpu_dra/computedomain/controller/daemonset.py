@@ -160,6 +160,13 @@ class DaemonSetManager:
                                             cd["spec"].get("numSlices") or 1
                                         ),
                                     },
+                                    {
+                                        "name": "NODE_LOSS_POLICY",
+                                        "value": (
+                                            cd["spec"].get("nodeLossPolicy")
+                                            or "failFast"
+                                        ),
+                                    },
                                     # Downward-API identity: without these
                                     # every daemon registers as '' and all
                                     # hosts collapse onto clique index 0.

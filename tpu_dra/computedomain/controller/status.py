@@ -15,7 +15,13 @@ import logging
 import time
 from typing import Dict, List, Tuple
 
-from tpu_dra.api import CD_STATUS_NOT_READY, CD_STATUS_READY
+from tpu_dra.api import (
+    CD_STATUS_FAILED,
+    CD_STATUS_NOT_READY,
+    CD_STATUS_READY,
+    NODE_LOSS_FAIL_FAST,
+    NODE_LOSS_SHRINK,
+)
 from tpu_dra.computedomain import CD_LABEL_KEY
 from tpu_dra.infra import featuregates
 from tpu_dra.k8sclient import (
@@ -54,20 +60,35 @@ class StatusManager:
         # monotonic time we first saw that value).
         self._observed: Dict[Tuple[str, str, str], Tuple[str, float]] = {}
 
-    def _apply_staleness(self, cd_uid: str, node: dict, entry: dict) -> dict:
-        raw = entry.get("lastHeartbeatTime")
+    def _is_stale(
+        self, cd_uid: str, clique_id: str, node_name: str, raw
+    ) -> bool:
+        """Has this entry's heartbeat stopped moving for longer than
+        ``node_stale_after`` on OUR clock? Feeds both status derivation
+        and (under nodeLossPolicy=shrink) clique pruning. Heartbeat-less
+        entries (older drivers) stay live for upgrade compatibility."""
         if self.node_stale_after <= 0 or not raw:
-            # Heartbeat-less entries (older drivers) stay live for
-            # upgrade compatibility.
-            return node
-        key = (cd_uid, node.get("cliqueID", ""), node.get("name", ""))
+            return False
+        key = (cd_uid, clique_id, node_name)
         now = time.monotonic()
         prev = self._observed.get(key)
         if prev is None or prev[0] != raw:
             # New or changed value: the daemon wrote recently → alive.
             self._observed[key] = (raw, now)
-        elif now - prev[1] > self.node_stale_after:
+            return False
+        return now - prev[1] > self.node_stale_after
+
+    def _apply_staleness(
+        self, cd_uid: str, node: dict, entry: dict, stale_out: set
+    ) -> dict:
+        if self._is_stale(
+            cd_uid,
+            node.get("cliqueID", ""),
+            node.get("name", ""),
+            entry.get("lastHeartbeatTime"),
+        ):
             node["status"] = CD_STATUS_NOT_READY
+            stale_out.add((node.get("cliqueID", ""), node.get("name", "")))
         return node
 
     def _prune_observed(self, cd_uid: str, live_keys: set) -> None:
@@ -119,15 +140,15 @@ class StatusManager:
         name, ns = cd["metadata"]["name"], cd["metadata"]["namespace"]
         # Fast path on the caller's (informer-cached) copy: skip the API
         # round-trips entirely when nothing would change.
-        nodes = self._derive_nodes(cd)
-        if cd.get("status") == self._new_status(cd, nodes):
+        nodes, stale = self._derive_nodes(cd)
+        if cd.get("status") == self._new_status(cd, nodes, stale):
             return cd
         for _ in range(20):
             cur = self.cds.try_get(name, ns)
             if cur is None:
                 return cd
-            nodes = self._derive_nodes(cur)
-            new_status = self._new_status(cur, nodes)
+            nodes, stale = self._derive_nodes(cur)
+            new_status = self._new_status(cur, nodes, stale)
             if cur.get("status") == new_status:
                 return cur
             cur["status"] = new_status
@@ -146,24 +167,74 @@ class StatusManager:
         )
         return cd
 
-    def _derive_nodes(self, cd: dict) -> List[dict]:
+    def _derive_nodes(self, cd: dict) -> "Tuple[List[dict], set]":
+        """(nodes, stale keys) — stale keys are the ``(cliqueID, name)``
+        pairs whose heartbeat lapsed (a subset of the NotReady nodes)."""
         if featuregates.enabled(featuregates.COMPUTE_DOMAIN_CLIQUES):
             return self._nodes_from_cliques(cd)
         return self._nodes_from_status(cd)
 
     @staticmethod
-    def _new_status(cd: dict, nodes: List[dict]) -> dict:
+    def _node_loss_policy(cd: dict) -> str:
+        return cd["spec"].get("nodeLossPolicy") or NODE_LOSS_FAIL_FAST
+
+    def _new_status(self, cd: dict, nodes: List[dict], stale: set) -> dict:
+        """Readiness + node-loss policy:
+
+        - assembling (never Ready): all-or-nothing — Ready only once
+          ``spec.numNodes`` hosts registered AND report Ready (strict
+          slice membership, per JAX multi-host init semantics);
+        - ``failFast`` (default): a Ready domain that loses a member goes
+          **Failed** promptly (and stays Failed until full strength is
+          back) so consumers fail over instead of hanging in collectives;
+        - ``shrink``: a Ready domain prunes lost (heartbeat-stale) members
+          from its node list and stays Ready over the survivors as long
+          as every one of them is Ready. A REPLACEMENT node that joins a
+          shrunk domain registers NotReady while it boots — it must not
+          count against readiness until it has been Ready once, or the
+          join itself would flip the running domain to Failed (the exact
+          disruption shrink exists to avoid)."""
+        prev_status = cd.get("status") or {}
+        prev = prev_status.get("status", "")
+        policy = self._node_loss_policy(cd)
+        required = cd["spec"]["numNodes"]
+        if policy == NODE_LOSS_SHRINK and prev in (
+            CD_STATUS_READY, CD_STATUS_FAILED
+        ):
+            kept = [
+                n for n in nodes
+                if (n.get("cliqueID", ""), n.get("name", "")) not in stale
+            ]
+            if kept:  # never shrink to an empty domain
+                nodes = kept
+            # Required = survivors (Ready in the previous status) plus
+            # anyone Ready right now; a still-assembling joiner is
+            # excluded until it first reports Ready.
+            prev_ready = {
+                (n.get("cliqueID", ""), n.get("name", ""))
+                for n in prev_status.get("nodes") or []
+                if n.get("status") == CD_STATUS_READY
+            }
+            required = max(1, sum(
+                1 for n in nodes
+                if n.get("status") == CD_STATUS_READY
+                or (n.get("cliqueID", ""), n.get("name", "")) in prev_ready
+            ))
         num_ready = sum(1 for n in nodes if n.get("status") == CD_STATUS_READY)
-        status = (
-            CD_STATUS_READY
-            if num_ready >= cd["spec"]["numNodes"]
-            else CD_STATUS_NOT_READY
-        )
+        if num_ready >= required:
+            status = CD_STATUS_READY
+        elif prev in (CD_STATUS_READY, CD_STATUS_FAILED):
+            # Was whole, lost a member (or one went NotReady): that is a
+            # failure, not re-assembly.
+            status = CD_STATUS_FAILED
+        else:
+            status = CD_STATUS_NOT_READY
         return {"status": status, "nodes": nodes}
 
-    def _nodes_from_cliques(self, cd: dict) -> List[dict]:
+    def _nodes_from_cliques(self, cd: dict) -> "Tuple[List[dict], set]":
         uid = cd["metadata"]["uid"]
         nodes: List[dict] = []
+        stale: set = set()
         for clique in self.cliques_for(cd):
             clique_id = clique["metadata"]["name"].removeprefix(uid + ".")
             for d in clique.get("daemons") or []:
@@ -177,18 +248,20 @@ class StatusManager:
                         "status": d.get("status", ""),
                     },
                     d,
+                    stale,
                 ))
         self._prune_observed(
             uid, {(uid, n["cliqueID"], n["name"]) for n in nodes}
         )
         nodes.sort(key=lambda n: (n["cliqueID"], n["index"]))
-        return nodes
+        return nodes, stale
 
-    def _nodes_from_status(self, cd: dict) -> List[dict]:
+    def _nodes_from_status(self, cd: dict) -> "Tuple[List[dict], set]":
         uid = cd["metadata"]["uid"]
         live = self._daemon_pod_node_names(cd)
+        stale: set = set()
         nodes = [
-            self._apply_staleness(uid, dict(n), n)
+            self._apply_staleness(uid, dict(n), n, stale)
             for n in (cd.get("status") or {}).get("nodes") or []
             if n.get("name") in live
         ]
@@ -197,7 +270,7 @@ class StatusManager:
             {(uid, n.get("cliqueID", ""), n.get("name", "")) for n in nodes},
         )
         nodes.sort(key=lambda n: (n.get("cliqueID", ""), n.get("index", 0)))
-        return nodes
+        return nodes, stale
 
     def assign_slice_indices(self, cd: dict) -> None:
         """Pin gap-filled ``sliceIndex`` on cliques that lack one
@@ -243,6 +316,50 @@ class StatusManager:
                     break
             if not conflicted:
                 return
+
+    def prune_lost_nodes(self, cd: dict) -> int:
+        """nodeLossPolicy=shrink: physically remove heartbeat-stale daemon
+        registrations from their clique objects so the clique SHRINKS — a
+        replacement daemon gap-fills the freed index (stable DNS), and the
+        dead entry stops haunting every future status derivation. Only a
+        domain that has been whole (Ready/Failed) shrinks; during assembly
+        a slow-to-boot host is not a lost host. Returns entries removed."""
+        if self._node_loss_policy(cd) != NODE_LOSS_SHRINK:
+            return 0
+        if (cd.get("status") or {}).get("status") not in (
+            CD_STATUS_READY, CD_STATUS_FAILED
+        ):
+            return 0
+        uid = cd["metadata"]["uid"]
+        removed = 0
+        for clique in self.cliques_for(cd):
+            clique_id = clique["metadata"]["name"].removeprefix(uid + ".")
+            daemons = clique.get("daemons") or []
+            kept = [
+                d for d in daemons
+                if not self._is_stale(
+                    uid,
+                    d.get("cliqueID", clique_id),
+                    d.get("nodeName", ""),
+                    d.get("lastHeartbeatTime"),
+                )
+            ]
+            if len(kept) == len(daemons):
+                continue
+            clique["daemons"] = kept
+            try:
+                self.cliques.update(clique)
+            except ApiConflict:
+                continue  # a daemon wrote concurrently; next sync retries
+            lost = {d.get("nodeName", "") for d in daemons} - {
+                d.get("nodeName", "") for d in kept
+            }
+            removed += len(daemons) - len(kept)
+            log.warning(
+                "shrink: pruned lost node(s) %s from clique %s",
+                sorted(lost), clique["metadata"]["name"],
+            )
+        return removed
 
     def delete_cliques(self, cd: dict) -> bool:
         """Delete clique objects on CD teardown; True when all gone."""

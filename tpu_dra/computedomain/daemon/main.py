@@ -25,6 +25,7 @@ from tpu_dra.computedomain.daemon.bootstrap import (
 from tpu_dra.computedomain.daemon.clique import CliqueRegistration
 from tpu_dra.computedomain.daemon.dnsnames import DNSNameManager
 from tpu_dra.computedomain.daemon.podmanager import PodManager
+from tpu_dra.api import NODE_LOSS_FAIL_FAST, NODE_LOSS_SHRINK
 from tpu_dra.computedomain.daemon.registration import MultisliceIdentityPending
 from tpu_dra.computedomain.daemon.status_legacy import DirectStatusRegistration
 from tpu_dra.infra import featuregates, flags, signals
@@ -54,6 +55,10 @@ class DaemonConfig:
     coordinator_port: int = 0
     pod_name: str = ""
     pod_namespace: str = ""
+    # Mirrors CD spec.nodeLossPolicy (rendered into the DaemonSet env):
+    # failFast = a lost ICI neighbor flips us NotReady promptly; shrink =
+    # keep serving the survivors after the controller prunes the loss.
+    node_loss_policy: str = NODE_LOSS_FAIL_FAST
 
 
 class SliceDaemon:
@@ -92,19 +97,43 @@ class SliceDaemon:
         self.dns = DNSNameManager(hosts_path=config.hosts_path)
         self._stop = threading.Event()
         self._ready = False
+        # Latched the first time the slice is whole; shrink semantics only
+        # apply to a slice that HAS been whole (assembly stays strict).
+        self._was_ready = False
 
     # --- readiness ---
 
     def compute_ready(self, peers) -> bool:
-        """All expected hosts registered + local chips healthy (the
-        all-or-nothing slice-membership gate). Peers are slice-local, so
-        the expectation is per-slice; domain-wide readiness is the
-        controller's aggregation across cliques."""
+        """All expected hosts registered + no lost neighbors + local chips
+        healthy (the all-or-nothing slice-membership gate). Peers are
+        slice-local, so the expectation is per-slice; domain-wide
+        readiness is the controller's aggregation across cliques.
+
+        Node-loss policy: under ``failFast`` a peer whose heartbeat lapsed
+        (3 periods — the same reclaim threshold register() uses) flips us
+        NotReady on the next tick, so the domain fails promptly instead of
+        the workload hanging in a collective until the controller's
+        staleness window fires. Under ``shrink``, once this slice has been
+        whole the expectation follows the (controller-pruned) registration
+        list down — the survivors stay Ready."""
         expected = max(
             1, self.config.num_nodes // max(1, self.config.num_slices)
         )
+        if (
+            self.config.node_loss_policy == NODE_LOSS_SHRINK
+            and self._was_ready
+        ):
+            expected = min(expected, max(1, len(peers)))
         if len(peers) < expected:
             return False
+        if self.config.node_loss_policy != NODE_LOSS_SHRINK:
+            lost = self.registration.lost_peers(peers=peers)
+            if lost:
+                log.warning(
+                    "lost ICI neighbor(s) %s (heartbeat stale): failing fast",
+                    [e.get(self.registration.node_key) for e in lost],
+                )
+                return False
         if not all(c.healthy for c in self.tpulib.chips()):
             return False
         return True
@@ -175,6 +204,7 @@ class SliceDaemon:
             log.info("readiness -> %s (%d/%d peers)", ready, len(peers),
                      self.config.num_nodes)
         self._ready = ready
+        self._was_ready = self._was_ready or ready
         self._write_ready_file(ready)
         # Registration readiness follows the pod's kubelet-probed Ready
         # condition when observable (podmanager.go:32-149): local view ->
@@ -223,6 +253,12 @@ def main(argv=None) -> int:
     p.add_argument("--cd-namespace", default=flags.env_default("CD_NAMESPACE", "default"))
     p.add_argument("--num-nodes", type=int, default=flags.env_default("NUM_NODES", 1, int))
     p.add_argument("--num-slices", type=int, default=flags.env_default("NUM_SLICES", 1, int))
+    p.add_argument(
+        "--node-loss-policy",
+        choices=[NODE_LOSS_FAIL_FAST, NODE_LOSS_SHRINK],
+        default=flags.env_default("NODE_LOSS_POLICY", NODE_LOSS_FAIL_FAST),
+        help="Mirror of the ComputeDomain's spec.nodeLossPolicy",
+    )
     p.add_argument("--node-name", default=flags.env_default("NODE_NAME", ""))
     p.add_argument("--pod-ip", default=flags.env_default("POD_IP", ""))
     p.add_argument("--config-dir", default=flags.env_default("CD_CONFIG_DIR", "/tpu-cd"))
@@ -262,6 +298,7 @@ def main(argv=None) -> int:
         cd_namespace=args.cd_namespace,
         num_nodes=args.num_nodes,
         num_slices=args.num_slices,
+        node_loss_policy=args.node_loss_policy,
         coordinator_port=args.coordinator_port,
         node_name=args.node_name,
         pod_ip=args.pod_ip,

@@ -11,7 +11,8 @@ from __future__ import annotations
 
 import datetime
 import logging
-from typing import List, Optional
+import time
+from typing import Dict, List, Optional, Tuple
 
 from tpu_dra.api import CD_STATUS_NOT_READY, CD_STATUS_READY
 from tpu_dra.k8sclient import ApiConflict
@@ -94,6 +95,9 @@ class RegistrationBase:
         self.clique_id = clique_id
         self.heartbeat_period = heartbeat_period
         self.index: Optional[int] = None
+        # Peer-liveness bookkeeping for lost_peers(): peer node name ->
+        # (last seen heartbeat value, monotonic time we first saw it).
+        self._peer_observed: Dict[str, Tuple[str, float]] = {}
 
     # --- subclass surface ---
 
@@ -212,6 +216,54 @@ class RegistrationBase:
         return sorted(
             self._scope(self._entries(obj)), key=lambda e: e.get("index", 0)
         )
+
+    def lost_peers(
+        self,
+        stale_after: Optional[float] = None,
+        peers: Optional[List[dict]] = None,
+    ) -> List[dict]:
+        """Registered peers (not us) whose heartbeat STOPPED MOVING for
+        longer than ``stale_after`` (default: 3 heartbeat periods — the
+        same reclaim threshold register() uses). This is the daemon-side
+        view of a lost ICI neighbor, feeding the node-loss policy: a
+        ``failFast`` domain's daemons flip NotReady promptly instead of
+        hanging the workload in a collective; a ``shrink`` domain's
+        controller prunes the entry and the survivors keep going.
+
+        Staleness is measured like the controller's StatusManager: on OUR
+        monotonic clock, from when we last saw the peer's heartbeat VALUE
+        change — never by comparing the peer's wall-clock stamp against
+        ours, which would let inter-node clock skew declare live peers
+        lost and fail a healthy domain. Heartbeat-less entries (older
+        drivers) are never counted lost. Pass ``peers`` to reuse an
+        already-fetched registration list instead of re-reading the
+        object."""
+        cutoff = (
+            stale_after if stale_after is not None
+            else 3 * self.heartbeat_period
+        )
+        if cutoff <= 0:
+            return []
+        now = time.monotonic()
+        out = []
+        live_names = set()
+        for e in peers if peers is not None else self.peers():
+            name = e.get(self.node_key)
+            if name == self.node_name:
+                continue
+            live_names.add(name)
+            raw = e.get("lastHeartbeatTime")
+            if not raw:
+                continue  # older-driver entry: always live
+            prev = self._peer_observed.get(name)
+            if prev is None or prev[0] != raw:
+                self._peer_observed[name] = (raw, now)
+            elif now - prev[1] > cutoff:
+                out.append(e)
+        # Deregistered peers must not pin stale bookkeeping forever.
+        for name in [n for n in self._peer_observed if n not in live_names]:
+            del self._peer_observed[name]
+        return out
 
     def deregister(self) -> None:
         for _ in range(MAX_CONFLICT_RETRIES):

@@ -63,6 +63,14 @@ CONTEXTUAL_LOGGING = "ContextualLogging"
 # contract.
 MULTIPLEX_PREEMPTION = "MultiplexPreemption"
 
+# Unhealthy-chip auto-remediation (plugin/remediation.py): on a
+# sustained (debounced) unhealthy signal the plugin revokes multiplex
+# leases on the failed chip, requeues affected prepared claims, and
+# republishes without the chip — instead of the reference's behavior of
+# silently dropping the device while its leases/claims dangle. Requires
+# DeviceHealthCheck (the event source).
+AUTO_REMEDIATION = "AutoRemediation"
+
 # Kernel-enforced device boundary for shared claims: the arbiter chowns
 # the chip device nodes to the lease holder's SO_PEERCRED uid (mode 0600)
 # and locks them to 0000 otherwise, so a pod that never talks to the
@@ -84,6 +92,7 @@ DEFAULT_GATE_SPECS: Dict[str, List[VersionedSpec]] = {
     CONTEXTUAL_LOGGING: [VersionedSpec((0, 1), True, Stage.BETA)],
     MULTIPLEX_PREEMPTION: [VersionedSpec((0, 1), True, Stage.BETA)],
     MULTIPLEX_DEVICE_GATE: [VersionedSpec((0, 1), False, Stage.ALPHA)],
+    AUTO_REMEDIATION: [VersionedSpec((0, 1), False, Stage.ALPHA)],
 }
 
 
@@ -186,6 +195,14 @@ class FeatureGates:
             raise FeatureGateError(
                 f"feature gate {MULTIPLEX_DEVICE_GATE} requires "
                 f"{MULTIPLEXING_SUPPORT} to also be enabled"
+            )
+        if self.enabled(AUTO_REMEDIATION) and not self.enabled(
+            DEVICE_HEALTH_CHECK
+        ):
+            raise FeatureGateError(
+                f"feature gate {AUTO_REMEDIATION} requires "
+                f"{DEVICE_HEALTH_CHECK} to also be enabled (it is the "
+                f"event source remediation acts on)"
             )
         # The reference additionally excludes DynamicMIG x MPSSupport
         # (featuregates.go:184-186). Here DynamicSubslice COMPOSES with

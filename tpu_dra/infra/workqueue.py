@@ -177,9 +177,20 @@ class WorkQueue:
         self,
         rate_limiter: Optional[RateLimiter] = None,
         metrics=None,
+        max_retries: Optional[int] = None,
     ):
         self._rl = rate_limiter or default_controller_rate_limiter()
         self.metrics = metrics
+        # Dead-letter cap: after this many retries a still-failing item is
+        # dropped (workqueue_dead_letter_total + a log line with the item)
+        # instead of retrying forever at the backoff cap. None = unlimited —
+        # the right default for reconcilers whose callbacks raise
+        # *barrier* errors by design (e.g. the CD controller's RetryLater
+        # teardown loop); cap queues whose failures mean "this item is
+        # poison", like the remediation requeue pipeline.
+        self.max_retries = max_retries
+        # Most recent dead-lettered items, for the doctor/tests.
+        self.dead_letters: list[WorkItem] = []
         self._heap: list[_Scheduled] = []
         self._cond = threading.Condition()
         # Keyed-item states (client-go's queue/dirty/processing sets):
@@ -266,6 +277,24 @@ class WorkQueue:
         t.start()
         return t
 
+    def _dead_letter_locked(self, item: WorkItem) -> bool:
+        """True when `item` has exhausted its retry budget: record and drop
+        it instead of scheduling another retry. Caller holds the lock."""
+        if self.max_retries is None:
+            return False
+        if self._rl.num_requeues(item) < self.max_retries:
+            return False
+        log.warning(
+            "dead-lettering work item key=%r after %d failed attempts "
+            "(not retrying): %r",
+            item.key, self.max_retries + 1, item.obj,
+        )
+        self._inc("workqueue_dead_letter_total")
+        self.dead_letters.append(item)
+        del self.dead_letters[:-100]
+        self._rl.forget(item)
+        return True
+
     def _finish_key(self, item: WorkItem, failed: bool) -> None:
         """Post-callback bookkeeping for a keyed item, under the lock.
 
@@ -288,9 +317,10 @@ class WorkQueue:
             self._pending[item.key] = newer
             self._push(newer, 0.0)
         elif failed:
-            self._pending[item.key] = item
-            self._push(item, self._rl.when(item))
-            self._inc("workqueue_retries_total")
+            if not self._dead_letter_locked(item):
+                self._pending[item.key] = item
+                self._push(item, self._rl.when(item))
+                self._inc("workqueue_retries_total")
         else:
             self._rl.forget(item)
         self._update_depth()
@@ -308,7 +338,7 @@ class WorkQueue:
             with self._cond:
                 if item.key:
                     self._finish_key(item, failed=True)
-                else:
+                elif not self._dead_letter_locked(item):
                     self._push(item, self._rl.when(item))
                     self._inc("workqueue_retries_total")
                     self._cond.notify()

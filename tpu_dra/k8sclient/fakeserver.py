@@ -97,20 +97,27 @@ class FakeApiServer:
         self._watches = []
         self._watch_lock = threading.Lock()
         # Fault injection + request accounting for transport integration
-        # tests (client-go-grade behavior the reference gets for free):
+        # tests and the chaos harness (infra/chaos.py) — client-go-grade
+        # behavior the reference gets for free:
         #   POST /_fault {"throttle": N, "retryAfter": s} -> next N
         #     non-underscore requests answer 429 with Retry-After;
+        #   POST /_fault {"fail": N, "failStatus": 503} -> next N requests
+        #     answer that 5xx (apiserver-brownout analog);
         #   POST /_fault {"dropWatches": true} -> server-side close of
         #     every open watch stream (network-blip analog).
-        # GET /_stats -> {"lists": n, "watches": n, "throttled": n}.
+        # The same knobs are reachable in-process via inject_faults().
+        # GET /_stats -> {"lists": n, "watches": n, "throttled": n, ...}.
         self._fault_lock = threading.Lock()
         self._throttle_remaining = 0
         self._throttle_retry_after = 1.0
+        self._fail_remaining = 0
+        self._fail_status = 503
         # expireContinue: next N continue-token list requests answer 410
         # (etcd-compaction-mid-pagination analog).
         self._expire_continue = 0
         self._stats = {
             "lists": 0, "watches": 0, "throttled": 0, "bookmarks": 0,
+            "failed": 0, "watch_drops": 0,
         }
         outer = self
 
@@ -238,12 +245,24 @@ class FakeApiServer:
                     raise _BadBody()
 
             def _maybe_throttle(self) -> bool:
+                """Injected-fault gate: 5xx bursts first (a brownout hits
+                before rate limiting would), then 429 bursts."""
+                code = None
+                retry_after = None
                 with outer._fault_lock:
-                    if outer._throttle_remaining <= 0:
-                        return False
-                    outer._throttle_remaining -= 1
-                    outer._stats["throttled"] += 1
-                    retry_after = outer._throttle_retry_after
+                    if outer._fail_remaining > 0:
+                        outer._fail_remaining -= 1
+                        outer._stats["failed"] += 1
+                        code = outer._fail_status
+                        message = "injected server error"
+                    elif outer._throttle_remaining > 0:
+                        outer._throttle_remaining -= 1
+                        outer._stats["throttled"] += 1
+                        retry_after = outer._throttle_retry_after
+                        code = 429
+                        message = "too many requests"
+                if code is None:
+                    return False
                 # Drain any request body: leaving it unread corrupts the
                 # keep-alive framing (body bytes parse as the next request).
                 n = int(self.headers.get("Content-Length", 0) or 0)
@@ -251,11 +270,12 @@ class FakeApiServer:
                     self.rfile.read(n)
                 body = json.dumps({
                     "kind": "Status", "status": "Failure",
-                    "message": "too many requests", "code": 429,
+                    "message": message, "code": code,
                 }).encode()
-                self.send_response(429)
+                self.send_response(code)
                 self.send_header("Content-Type", "application/json")
-                self.send_header("Retry-After", str(retry_after))
+                if retry_after is not None:
+                    self.send_header("Retry-After", str(retry_after))
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -395,20 +415,14 @@ class FakeApiServer:
             def do_POST(self):  # noqa: N802
                 if self.path == "/_fault":
                     body = self._body()
-                    with outer._fault_lock:
-                        if "throttle" in body:
-                            outer._throttle_remaining = int(body["throttle"])
-                            outer._throttle_retry_after = float(
-                                body.get("retryAfter", 1.0)
-                            )
-                        if "expireContinue" in body:
-                            outer._expire_continue = int(
-                                body["expireContinue"]
-                            )
-                    if body.get("dropWatches"):
-                        with outer._watch_lock:
-                            for w in list(outer._watches):
-                                w.close()
+                    outer.inject_faults(
+                        throttle=body.get("throttle"),
+                        retry_after=body.get("retryAfter"),
+                        fail=body.get("fail"),
+                        fail_status=body.get("failStatus"),
+                        expire_continue=body.get("expireContinue"),
+                        drop_watches=bool(body.get("dropWatches")),
+                    )
                     return self._reply(200, {"status": "Success"})
                 if self._maybe_throttle():
                     return None
@@ -529,6 +543,39 @@ class FakeApiServer:
         self._httpd = _Server((address, port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+
+    def inject_faults(
+        self,
+        throttle: Optional[int] = None,
+        retry_after: Optional[float] = None,
+        fail: Optional[int] = None,
+        fail_status: Optional[int] = None,
+        expire_continue: Optional[int] = None,
+        drop_watches: bool = False,
+    ) -> None:
+        """Programmatic fault hook (the chaos harness's seam; the
+        POST /_fault endpoint routes here too): arm 429 bursts
+        (``throttle``/``retry_after``), 5xx bursts (``fail`` requests
+        answering ``fail_status``), continue-token expiry, and server-side
+        watch-stream drops."""
+        with self._fault_lock:
+            if throttle is not None:
+                self._throttle_remaining = int(throttle)
+            if retry_after is not None:
+                self._throttle_retry_after = float(retry_after)
+            if fail is not None:
+                self._fail_remaining = int(fail)
+            if fail_status is not None:
+                self._fail_status = int(fail_status)
+            if expire_continue is not None:
+                self._expire_continue = int(expire_continue)
+        if drop_watches:
+            with self._watch_lock:
+                dropped = list(self._watches)
+            for w in dropped:
+                w.close()
+            with self._fault_lock:
+                self._stats["watch_drops"] += len(dropped)
 
     @property
     def server_url(self) -> str:

@@ -28,6 +28,7 @@ from tpu_dra.plugin.dra_service import (
     RegistrationService,
     serve_unix,
 )
+from tpu_dra.plugin.remediation import RemediationController
 from tpu_dra.plugin.sharing import MultiplexManager
 from tpu_dra.plugin.subslice import build_partitionable_model
 from tpu_dra.plugin.vfio import VfioPciManager
@@ -64,6 +65,10 @@ class DriverConfig:
     # Driver-root resolution (root.go:29-87 analog): host sysfs mount
     # prefix for the vfio manager's driver rebind plumbing.
     sysfs_root: str = "/sys"
+    # Auto-remediation (featureGates.AutoRemediation): how long a chip
+    # must stay unhealthy before leases are revoked and prepared claims
+    # requeued — flaps shorter than this are suppressed.
+    remediation_debounce_seconds: float = 30.0
 
 
 class Driver:
@@ -118,6 +123,19 @@ class Driver:
         self.cleanup = CheckpointCleanupManager(
             self.state, backend, pu_flock=self.pu_flock
         )
+        # Auto-remediation rides the health-event stream; without the gate
+        # the driver keeps the reference's unpublish-only behavior.
+        self.remediation: Optional[RemediationController] = None
+        if fg.enabled(fg.AUTO_REMEDIATION):
+            self.remediation = RemediationController(
+                self.state,
+                backend,
+                multiplex_manager=multiplex,
+                publish=self.publish_with_retry,
+                metrics=self.metrics,
+                debounce_seconds=config.remediation_debounce_seconds,
+                pu_flock=self.pu_flock,
+            )
         self._publish_lock = threading.Lock()
         self._slice_generation = 0
 
@@ -188,11 +206,15 @@ class Driver:
             # events; the stub's hook is a no-op (its queue is test-injected).
             self.tpulib.start_health_monitor()
         self.cleanup.start()
+        if self.remediation is not None:
+            self.remediation.start()
         self.publish_resources()
         self.metrics.set_gauge("allocatable_devices", len(self.state.allocatable))
 
     def shutdown(self) -> None:
         self.cleanup.stop()
+        if self.remediation is not None:
+            self.remediation.stop()
         self.health_monitor.stop()
         self.tpulib.stop_health_monitor()
         for s in self._servers:
@@ -218,9 +240,38 @@ class Driver:
         # sub-slice therefore stays unpublished until ALL its chips recover.
         if self.state.recompute_health():
             self.metrics.inc("health_transitions_total")
-            self.publish_resources()
+            self.publish_with_retry()
+        # Remediation sees EVERY non-benign event, not only device-health
+        # transitions: a second unhealthy reason on an already-unhealthy
+        # chip must not reset or bypass the debounce bookkeeping.
+        if self.remediation is not None:
+            self.remediation.on_health_change(ev)
 
     # --- ResourceSlice publication (driver.go:188-268) ---
+
+    def publish_with_retry(
+        self, attempts: int = 5, delay: float = 0.5
+    ) -> None:
+        """publish_resources, re-armed on failure. Health-driven publishes
+        have no caller to propagate to (the monitor thread just logs), so
+        a transient apiserver failure would otherwise leave the published
+        slices contradicting chip health until the NEXT health event —
+        exactly the stale-inventory window chaos drills flush out."""
+        try:
+            self.publish_resources()
+        except Exception as e:
+            self.metrics.inc("publish_retries_total")
+            if attempts <= 1:
+                log.error("republish failed permanently: %s", e)
+                return
+            log.warning(
+                "republish failed (%s); retrying in %.1fs", e, delay
+            )
+            t = threading.Timer(
+                delay, self.publish_with_retry, args=(attempts - 1, delay)
+            )
+            t.daemon = True
+            t.start()
 
     def publish_resources(self) -> None:
         with self._publish_lock:

@@ -96,6 +96,16 @@ def build_parser() -> argparse.ArgumentParser:
         "Deployments this plugin renders (the chart passes its own "
         "image)",
     )
+    p.add_argument(
+        "--remediation-debounce-seconds",
+        type=float,
+        default=flags.env_default(
+            "TPU_DRA_REMEDIATION_DEBOUNCE_SECONDS", 30.0, float
+        ),
+        help="featureGates.AutoRemediation: how long a chip must stay "
+        "unhealthy before leases are revoked and prepared claims "
+        "requeued (shorter flaps are suppressed)",
+    )
     return p
 
 
@@ -130,6 +140,7 @@ def main(argv=None) -> int:
         multiplex_socket_root=args.multiplex_socket_root,
         multiplex_image=args.multiplex_image,
         sysfs_root=args.sysfs_root,
+        remediation_debounce_seconds=args.remediation_debounce_seconds,
     )
     driver = Driver(tpulib, backend, config)
     driver.start()

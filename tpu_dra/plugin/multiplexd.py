@@ -21,6 +21,9 @@ Protocol: one JSON object per line over ``<socket_dir>/multiplexd.sock``.
   <- {"ok": true}
   -> {"op": "status"}
   <- {"ok": true, "holder": "...", "waiting": N, "chips": [...]}
+  -> {"op": "revoke", "reason": "..."}
+  <- {"ok": true, "revoked": true}   # admin: kick the holder, NO cooldown
+                                     # (remediation on unhealthy chips)
 
 Config via env (set by the Deployment the plugin renders):
 ``TPU_MULTIPLEX_CHIPS`` (comma uuids), ``TPU_MULTIPLEX_SOCKET_DIR``,
@@ -424,6 +427,37 @@ class LeaseState:
             push(event)  # outside the lock: it writes to a socket
         return True
 
+    def force_revoke(self, reason: str) -> bool:
+        """Administrative revocation (the remediation pipeline's seam): the
+        current holder — if any — loses its lease immediately and is told
+        why with a best-effort ``revoked`` push. Unlike hog preemption this
+        starts NO cooldown: the client did nothing wrong (its chip did),
+        and it must be free to re-acquire the moment the hardware
+        recovers. Returns True iff a lease was actually revoked."""
+        with self._granted:
+            offender = self._holder
+            if offender is None:
+                return False
+            self._revocations += 1
+            self._holder = None
+            if self.gate is not None:
+                self.gate.lock()
+            self._granted.notify_all()
+            push = self._push.get(offender)
+            event = {
+                "event": "revoked",
+                "reason": reason,
+                "cooldownSeconds": 0.0,
+            }
+            log.warning(
+                "force-revoked lease of %s: %s (%d revocations total)",
+                self._names.get(offender, offender), reason,
+                self._revocations,
+            )
+        if push is not None:
+            push(event)  # outside the lock: it writes to a socket
+        return True
+
     def release(self, conn_id: str) -> bool:
         with self._granted:
             if self._holder != conn_id:
@@ -562,6 +596,16 @@ class _Handler(socketserver.StreamRequestHandler):
                         return
                 elif op == "release":
                     self._send({"ok": state.release(conn_id)})
+                elif op == "revoke":
+                    # Administrative revocation (remediation pipeline /
+                    # operator): kick the current holder, no cooldown.
+                    reason = (
+                        msg.get("reason") or "administrative revocation"
+                    )
+                    self._send({
+                        "ok": True,
+                        "revoked": state.force_revoke(str(reason)),
+                    })
                 elif op == "status":
                     self._send({"ok": True, **state.status()})
                 elif op == "ping":

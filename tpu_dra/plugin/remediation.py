@@ -1,0 +1,275 @@
+"""Unhealthy-chip auto-remediation pipeline.
+
+The reference driver (device_health.go + driver.go:441-505) and this port's
+health monitor stop at *unpublishing*: an unhealthy chip silently leaves
+the ResourceSlice while its multiplex leases, prepared claims, and
+ComputeDomain membership keep dangling — one flaky chip wedges a
+multi-slice JAX job until an operator intervenes. This controller closes
+the loop. Driven by :class:`~tpu_dra.plugin.device_health.
+DeviceHealthMonitor` events (forwarded by the driver), it:
+
+1. **debounces**: a chip must stay unhealthy for ``debounce_seconds``
+   before remediation fires — transient flaps (recovered before the window
+   closes) are suppressed and counted, never acted on;
+2. **revokes multiplex leases** on the failed chip through each affected
+   claim's control-daemon socket (``revoke`` op — no cooldown: the client
+   is a victim, not a hog);
+3. **requeues prepared claims** covering the chip through a dead-lettered
+   work queue: each claim is unprepared node-locally (its sub-slices torn
+   down, CDI spec dropped, checkpoint entry removed) and its ResourceClaim
+   is annotated ``tpu.google.com/remediation`` so the control plane — and
+   operators — see *why* the claim lost its node;
+4. **republishes** ResourceSlices (without the chip while down; restoring
+   it on recovery — recovery needs no plugin restart).
+
+Requeue work that keeps failing (apiserver down, wedged teardown) dead-
+letters after ``max_requeue_retries`` instead of hammering the backoff cap
+forever; the drop is visible as ``workqueue_dead_letter_total``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional
+
+from tpu_dra.infra.workqueue import (
+    WorkQueue,
+    default_prep_unprep_rate_limiter,
+)
+from tpu_dra.k8sclient import RESOURCE_CLAIMS, ApiNotFound, ResourceClient
+from tpu_dra.plugin.device_state import DeviceState
+from tpu_dra.tpulib.types import ChipHealthEvent, ChipInfo
+
+log = logging.getLogger(__name__)
+
+REMEDIATION_ANNOTATION = "tpu.google.com/remediation"
+
+DEFAULT_DEBOUNCE_SECONDS = 30.0
+DEFAULT_MAX_REQUEUE_RETRIES = 5
+
+
+class RemediationController:
+    """Debounced unhealthy-chip remediation (see module docstring).
+
+    The controller never runs its own poll loop: the driver forwards every
+    non-benign health event to :meth:`on_health_change`, and per-chip
+    debounce timers carry the delay. All mutating work (claim requeue)
+    flows through one dead-lettered :class:`WorkQueue` so a poisoned claim
+    cannot starve the others.
+    """
+
+    def __init__(
+        self,
+        state: DeviceState,
+        backend,
+        multiplex_manager=None,
+        publish=None,
+        metrics=None,
+        debounce_seconds: float = DEFAULT_DEBOUNCE_SECONDS,
+        max_requeue_retries: int = DEFAULT_MAX_REQUEUE_RETRIES,
+        pu_flock=None,
+    ):
+        self.state = state
+        self.claims = ResourceClient(backend, RESOURCE_CLAIMS)
+        self.multiplex_manager = multiplex_manager
+        self.publish = publish or (lambda: None)
+        self.metrics = metrics
+        self.debounce_seconds = debounce_seconds
+        # Serialize requeue-unprepare with the RPC Prepare/Unprepare paths
+        # across plugin processes, exactly like the cleanup manager.
+        self.pu_flock = pu_flock
+        self.queue = WorkQueue(
+            default_prep_unprep_rate_limiter(),
+            metrics=metrics,
+            max_retries=max_requeue_retries,
+        )
+        self._lock = threading.Lock()
+        self._pending: Dict[str, threading.Timer] = {}  # chip uuid -> timer
+        # Chips we remediated and that have not recovered yet.
+        self._quarantined: set = set()
+        self._thread: Optional[threading.Thread] = None
+
+    # --- lifecycle ---
+
+    def start(self) -> None:
+        self._thread = self.queue.run_in_thread()
+
+    def stop(self) -> None:
+        with self._lock:
+            timers = list(self._pending.values())
+            self._pending.clear()
+        for t in timers:
+            t.cancel()
+        self.queue.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def _inc(self, name: str) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(name)
+
+    # --- health-event intake (driver._on_health_change forwards here) ---
+
+    def on_health_change(self, ev: ChipHealthEvent) -> None:
+        if ev.healthy:
+            self._on_recovered(ev)
+        else:
+            self._on_unhealthy(ev)
+
+    def _on_unhealthy(self, ev: ChipHealthEvent) -> None:
+        with self._lock:
+            if ev.chip_uuid in self._pending or ev.chip_uuid in self._quarantined:
+                return  # debounce already running / already remediated
+            t = threading.Timer(
+                self.debounce_seconds, self._debounce_fired, args=(ev.chip_uuid,)
+            )
+            t.daemon = True
+            self._pending[ev.chip_uuid] = t
+        log.info(
+            "chip %s unhealthy (%s): remediation debounce %.1fs started",
+            ev.chip_uuid, ev.reason or "no reason", self.debounce_seconds,
+        )
+        t.start()
+
+    def _on_recovered(self, ev: ChipHealthEvent) -> None:
+        with self._lock:
+            timer = self._pending.pop(ev.chip_uuid, None)
+            was_quarantined = ev.chip_uuid in self._quarantined
+            self._quarantined.discard(ev.chip_uuid)
+        if timer is not None:
+            timer.cancel()
+            self._inc("remediation_flaps_suppressed_total")
+            log.info(
+                "chip %s recovered inside the debounce window: flap "
+                "suppressed, no remediation", ev.chip_uuid,
+            )
+        if was_quarantined:
+            self._inc("remediation_recoveries_total")
+            log.warning(
+                "chip %s recovered after remediation: republishing",
+                ev.chip_uuid,
+            )
+            # The driver's own health path republishes too; this call makes
+            # recovery correct even when remediation runs stand-alone.
+            self.publish()
+
+    # --- remediation proper ---
+
+    def _debounce_fired(self, chip_uuid: str) -> None:
+        chip = self._chip(chip_uuid)
+        # The healthy re-check and the quarantine add happen under ONE
+        # lock acquisition: with them split, a recovery event processed in
+        # between would neither cancel the (already-popped) debounce nor
+        # clear the (not-yet-added) quarantine — remediating a healthy
+        # chip AND muting remediation of its next real outage.
+        with self._lock:
+            if self._pending.pop(chip_uuid, None) is None:
+                return  # recovery cancelled us while the timer raced
+            if chip is None or chip.healthy:
+                return  # recovered at the boundary: not sustained
+            self._quarantined.add(chip_uuid)
+        try:
+            self.remediate(chip)
+        except Exception:
+            log.exception("remediation of chip %s failed", chip_uuid)
+
+    def _chip(self, chip_uuid: str) -> Optional[ChipInfo]:
+        return next(
+            (c for c in self.state.tpulib.chips() if c.uuid == chip_uuid),
+            None,
+        )
+
+    def remediate(self, chip: ChipInfo) -> None:
+        """Act on one sustained-unhealthy chip: revoke leases, requeue the
+        claims it was serving, republish without it."""
+        self._inc("remediations_total")
+        log.warning(
+            "remediating sustained-unhealthy chip %s (index %d)",
+            chip.uuid, chip.index,
+        )
+        if self.multiplex_manager is not None:
+            revoked = self.multiplex_manager.revoke_for_chips(
+                [chip.uuid], reason=f"chip {chip.uuid} unhealthy"
+            )
+            n = sum(1 for v in revoked.values() if v)
+            if n and self.metrics is not None:
+                self.metrics.inc("remediation_leases_revoked_total", n)
+        for uid in self.claims_covering(chip):
+            self.queue.enqueue(uid, self._requeue_claim, key=f"requeue/{uid}")
+        self.publish()
+
+    def claims_covering(self, chip: ChipInfo) -> List[str]:
+        """UIDs of checkpointed prepared claims whose devices cover the
+        chip — directly (chip/vfio device), through a sub-slice's parent
+        chips, or by sharing the chip's topology coordinate."""
+        subslice_parents = {
+            ss.uuid: set(ss.parent_chip_uuids)
+            for ss in self.state.tpulib.list_subslices()
+        }
+        out = []
+        cp = self.state.checkpoints.get()
+        for uid, claim in cp.prepared_claims.items():
+            if self._claim_covers(claim, chip, subslice_parents):
+                out.append(uid)
+        return out
+
+    def _claim_covers(self, claim, chip: ChipInfo, subslice_parents) -> bool:
+        for group in claim.prepared_devices:
+            for pd in group.devices:
+                if pd.chip_uuid == chip.uuid:
+                    return True
+                if pd.subslice_uuid and chip.uuid in subslice_parents.get(
+                    pd.subslice_uuid, ()
+                ):
+                    return True
+                adev = self.state.allocatable.get(pd.device.device_name)
+                if adev is not None and chip.coord in set(adev.chip_coords()):
+                    return True
+        return False
+
+    def _requeue_claim(self, claim_uid: str) -> None:
+        """Requeue one prepared claim off this node: annotate its
+        ResourceClaim with the remediation verdict, then unprepare locally
+        (WAL-checkpointed; sub-slices torn down, CDI spec dropped). The
+        annotation lands FIRST so even a crash mid-unprepare leaves the
+        control plane a breadcrumb; annotation failures other than
+        not-found raise → the work queue retries (and dead-letters a
+        poisoned claim after the cap)."""
+        cp = self.state.checkpoints.get()
+        claim = cp.prepared_claims.get(claim_uid)
+        if claim is None:
+            return  # already unprepared (kubelet or GC beat us)
+        self._annotate(claim_uid, claim)
+        if self.pu_flock is not None:
+            release = self.pu_flock.acquire(timeout=60)
+            try:
+                self.state.unprepare(claim_uid)
+            finally:
+                release()
+        else:
+            self.state.unprepare(claim_uid)
+        self._inc("remediation_claims_requeued_total")
+        log.warning(
+            "requeued claim %s/%s (%s): prepared devices covered an "
+            "unhealthy chip", claim.namespace, claim.name, claim_uid,
+        )
+
+    def _annotate(self, claim_uid: str, claim) -> None:
+        if not claim.name or not claim.namespace:
+            return  # pre-upgrade checkpoint record: nothing to annotate
+        try:
+            live = self.claims.get(claim.name, claim.namespace)
+        except ApiNotFound:
+            return  # claim object already deleted
+        if live["metadata"].get("uid") != claim_uid:
+            return  # delete+recreate under the same name: not our claim
+        ann = live["metadata"].setdefault("annotations", {})
+        if REMEDIATION_ANNOTATION in ann:
+            return  # idempotent retry
+        ann[REMEDIATION_ANNOTATION] = (
+            "requeued: prepared devices covered a sustained-unhealthy chip"
+        )
+        # A write conflict (or any transient API error) propagates: the
+        # work queue retries the whole item with a fresh read.
+        self.claims.update(live)

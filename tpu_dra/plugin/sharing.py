@@ -339,6 +339,54 @@ class MultiplexManager:
                 continue
         return out
 
+    def revoke_for_chips(
+        self,
+        chip_uuids: List[str],
+        reason: str = "chip unhealthy",
+        timeout: float = 0.25,
+    ) -> Dict[str, bool]:
+        """Administratively revoke the live lease of every control daemon
+        whose chip set intersects ``chip_uuids`` (the remediation
+        pipeline's lease-revocation step). Targets come from the same
+        per-claim status walk /metrics uses (poll_status); matching
+        daemons get one ``revoke`` op each. Returns {claim_uid: revoked};
+        daemons that don't answer, own disjoint chips, or predate the
+        ``revoke`` op are skipped — revocation is best-effort by design
+        (a dead daemon has no lease to leak)."""
+        import json as _json
+        import os
+        import socket as _socket
+
+        from tpu_dra.plugin.multiplexd import SOCKET_NAME
+
+        targets = set(chip_uuids)
+        out: Dict[str, bool] = {}
+        for claim_uid, st in self.poll_status(timeout).items():
+            if targets.isdisjoint(st.get("chips") or []):
+                continue
+            path = os.path.join(self.socket_root, claim_uid, SOCKET_NAME)
+            try:
+                with _socket.socket(
+                    _socket.AF_UNIX, _socket.SOCK_STREAM
+                ) as s:
+                    s.settimeout(timeout)
+                    s.connect(path)
+                    s.sendall(_json.dumps(
+                        {"op": "revoke", "reason": reason}
+                    ).encode() + b"\n")
+                    resp = _json.loads(s.makefile().readline())
+            except (OSError, ValueError):
+                continue
+            if resp.get("ok"):
+                revoked = bool(resp.get("revoked"))
+                out[claim_uid] = revoked
+                if revoked:
+                    log.warning(
+                        "revoked multiplex lease for claim %s: %s",
+                        claim_uid, reason,
+                    )
+        return out
+
     def daemon_by_id(self, daemon_id: str) -> MultiplexControlDaemon:
         namespace, name = daemon_id.split("/", 1)
         d = MultiplexControlDaemon.__new__(MultiplexControlDaemon)

@@ -59,6 +59,9 @@ FAILURE_COUNTER_PREFIXES = (
     "tpu_dra_informer_handler_errors_total",
     "tpu_dra_workqueue_failures_total",
     "tpu_dra_workqueue_retry_drops_total",
+    # Dead-lettered work is work the system gave up on — always worth a
+    # human look (the item itself is in the component's logs).
+    "tpu_dra_workqueue_dead_letter_total",
 )
 
 

@@ -16,6 +16,56 @@ are JAX programs designed TPU-first:
 """
 
 
+def force_cpu_devices(n: int) -> None:
+    """Re-pin jax onto ``n`` virtual CPU devices even when the
+    interpreter already imported jax (sitecustomize + device tunnel).
+    Newer JAX exposes this as the ``jax_num_cpu_devices`` config option;
+    older JAX only has the XLA flag spelling, which works as long as no
+    backend consumed XLA_FLAGS yet (XLA parses it once per process) —
+    when it cannot take effect, fail loudly rather than leave the caller
+    sharding over 1 device."""
+    import os
+
+    import jax
+    from jax.extend.backend import clear_backends
+
+    # Probed BEFORE clear_backends, without creating one (device_count()
+    # would both initialize a backend — breaking a later
+    # jax.distributed.initialize() — and consume XLA_FLAGS).
+    backend_was_initialized = bool(
+        getattr(
+            getattr(jax, "_src", None) and jax._src.xla_bridge,
+            "_backends",
+            None,
+        )
+    )
+    clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", int(n))
+    except AttributeError:
+        flags = os.environ.get("XLA_FLAGS", "")
+        flags = " ".join(
+            f for f in flags.split()
+            if "xla_force_host_platform_device_count" not in f
+        )
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={int(n)}"
+        ).strip()
+        if backend_was_initialized:
+            # XLA parses XLA_FLAGS once per process: a backend built
+            # before this call pinned the old value, so the env write
+            # above cannot take effect. Fail loudly rather than leave the
+            # caller silently sharding over 1 device.
+            raise RuntimeError(
+                "this JAX has no jax_num_cpu_devices option and a backend "
+                "was already initialized, so the XLA_FLAGS fallback cannot "
+                "take effect (XLA parses it once per process). Set "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count={int(n)} "
+                "before starting the interpreter."
+            )
+
+
 def apply_forced_platform(environ=None) -> None:
     """Honor ``TPU_DRA_FORCE_PLATFORM=<platform>[:N]`` (e.g. ``cpu:1``):
     re-pin the jax backend before first use. Env vars alone are not
@@ -33,7 +83,8 @@ def apply_forced_platform(environ=None) -> None:
     import jax
     from jax.extend.backend import clear_backends
 
+    if n and platform == "cpu":
+        force_cpu_devices(int(n))
+        return
     clear_backends()
     jax.config.update("jax_platforms", platform)
-    if n and platform == "cpu":
-        jax.config.update("jax_num_cpu_devices", int(n))

@@ -100,7 +100,9 @@ def measure_collectives(
                 # Under shard_map the carry must keep its device-varying
                 # type; a psum output is axis-invariant and would change
                 # the fori_loop carry type.
-                r = lax.pcast(r, axis, to="varying")
+                from tpu_dra.workloads.jaxcompat import pcast
+
+                r = pcast(r, axis, to="varying")
             # Materialize every iteration: without the barrier XLA fuses
             # the whole loop into one kernel and the probe measures
             # registers, not HBM/ICI.
@@ -223,12 +225,9 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     if args.cpu_devices:
-        import jax
-        from jax.extend.backend import clear_backends
+        from tpu_dra.workloads import force_cpu_devices
 
-        clear_backends()
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+        force_cpu_devices(args.cpu_devices)
     if args.distributed:
         from tpu_dra.workloads.bootstrap import initialize_from_env
 

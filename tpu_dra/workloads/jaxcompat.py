@@ -1,0 +1,43 @@
+"""Version-compat shims for JAX APIs the workloads lean on.
+
+The driver control plane is stdlib-only, but the workload payloads track
+moving JAX APIs; these shims keep them importable (and the test suite
+collectable) across the JAX versions the fleet actually runs:
+
+- ``shard_map`` moved from ``jax.experimental.shard_map`` to top-level
+  ``jax.shard_map``;
+- ``lax.pcast`` (marking values device-varying for shard_map's
+  representation checking) does not exist on older JAX — where the
+  varying/invariant type system also doesn't exist, so identity is the
+  faithful fallback.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+try:
+    from jax import shard_map as _shard_map  # newer JAX: top-level
+except ImportError:  # older JAX
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_SHARD_MAP_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+
+
+def shard_map(*args, **kwargs):
+    """``jax.shard_map`` with the representation-check kwarg translated
+    across its rename (``check_rep`` -> ``check_vma``)."""
+    if "check_vma" in kwargs and "check_vma" not in _SHARD_MAP_PARAMS:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map(*args, **kwargs)
+
+
+def pcast(x, axes, to="varying"):
+    """``jax.lax.pcast`` where it exists; identity on older JAX (which has
+    no varying-type checking for the cast to satisfy)."""
+    fn = getattr(jax.lax, "pcast", None)
+    if fn is None:
+        return x
+    return fn(x, axes, to=to)

@@ -88,7 +88,11 @@ def _pallas_int8_matmul(x, w_q, scale, bm=_BM, bn=_BN, bk=_BK,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        # CompilerParams was named TPUCompilerParams on older pallas.
+        compiler_params=getattr(
+            pltpu, "CompilerParams",
+            getattr(pltpu, "TPUCompilerParams", None),
+        )(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -30,7 +30,9 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from tpu_dra.workloads.jaxcompat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 

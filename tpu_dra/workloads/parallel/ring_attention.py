@@ -20,7 +20,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+
+from tpu_dra.workloads.jaxcompat import pcast, shard_map
 
 from tpu_dra.workloads.ops import attention as attn_ops
 from tpu_dra.workloads.ops.attention import (
@@ -127,7 +128,7 @@ def _ring_attention_local(q, k, v, *, axis_name: str, vary_axes: tuple):
 
     # Mark the accumulators device-varying so the fori_loop carry types are
     # consistent with the (varying) K/V they merge with under shard_map.
-    vary = lambda x: jax.lax.pcast(x, vary_axes, to="varying")  # noqa: E731
+    vary = lambda x: pcast(x, vary_axes, to="varying")  # noqa: E731
     acc0 = vary(jnp.zeros((b, sq, h, hd), dtype=jnp.float32))
     lse0 = vary(jnp.full((b, h, sq), NEG_INF, dtype=jnp.float32))
     l0 = vary(jnp.zeros((b, h, sq), dtype=jnp.float32))

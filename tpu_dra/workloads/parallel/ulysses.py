@@ -20,7 +20,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+
+from tpu_dra.workloads.jaxcompat import shard_map
 from tpu_dra.workloads.ops.attention import _repeat_kv, attention
 from tpu_dra.workloads.parallel.context import sequence_parallel_plan
 

@@ -71,8 +71,9 @@ def main(argv=None) -> int:
     import jax
 
     if args.cpu_devices:
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+        from tpu_dra.workloads import force_cpu_devices
+
+        force_cpu_devices(args.cpu_devices)
 
     se = initialize_from_env()
 
