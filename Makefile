@@ -3,7 +3,7 @@
 IMAGE_REPO ?= registry.local/tpu-dra-driver
 IMAGE_TAG  ?= v0.1.0
 
-.PHONY: all native test test-slow bench image bats lint shlint chaos ci clean
+.PHONY: all native test test-slow bench decodebench image bats lint shlint chaos ci clean
 
 all: native test
 
@@ -23,6 +23,13 @@ test-slow: native
 
 bench:
 	python bench.py
+
+# Fast CPU smoke for the r6 serving path (ISSUE 2): asserts the fused
+# decode-attention op dispatches from the decode scan and matches the
+# reference, int8-KV decode agrees with bf16, and the fused sampler is
+# token-identical to the unfused loop — no TPU needed.
+decodebench:
+	python -m tpu_dra.workloads.decodebench
 
 GIT_COMMIT ?= $(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
@@ -74,7 +81,7 @@ shlint:
 # native build, the pytest suite TWICE (flakes surface in CI, not in the
 # judge's rerun), the 13 bats suites executed against the minicluster,
 # the batsless process-level e2e, and the bench artifact schema gate.
-ci: lint shlint native chaos
+ci: lint shlint native chaos decodebench
 	python -m pytest tests/ -q -m 'not slow'
 	python -m pytest tests/ -q -m 'not slow'
 	python -m pytest tests/test_chaos.py -q -m slow

@@ -237,6 +237,10 @@ def bench_config():
             # ~1.7% more tok/s than nn.scan — XLA schedules across layer
             # boundaries (measured on v5e: 17.56k vs 17.27k fetch-timed).
             scan_layers=os.environ.get("BENCH_SCAN", "0") == "1",
+            # r6 serving knobs: fused decode-attention dispatch and its
+            # cache-length chunk (ops/attention.py decode_attention).
+            decode_impl=os.environ.get("BENCH_DECODE_IMPL", "auto"),
+            decode_block_k=int(os.environ.get("BENCH_DECODE_BLOCK_K", "256")),
         )
         # Swept on-chip: batch 4 -> 15.4k, 6 -> 15.8k, 7 -> 14.9k tok/s
         # (8+ fails to compile within this chip's memory).
@@ -369,11 +373,15 @@ def _leg_decode_main() -> int:
     params = model.init_params(jax.random.PRNGKey(0), batch=1, seq=8)
     prompt = jnp.ones((batch, prompt_len), dtype=jnp.int32)
 
-    greedy = jax.jit(
-        lambda p, t: greedy_generate(
-            config, p, t, max_new_tokens=new_tokens
+    def greedy_fn(kv_quant):
+        return jax.jit(
+            lambda p, t: greedy_generate(
+                config, p, t, max_new_tokens=new_tokens, kv_quant=kv_quant
+            )
         )
-    )
+
+    greedy = greedy_fn("none")
+    greedy_kv8 = greedy_fn("int8")
     rng = jax.random.PRNGKey(1)
     sampled = jax.jit(
         lambda p, t, r: sample_generate(
@@ -382,10 +390,23 @@ def _leg_decode_main() -> int:
         )
     )
 
+    # int8 weight-only serving tree (workloads/quantize.py): same decode
+    # code over a quantized param tree — halves the per-step weight read.
+    from tpu_dra.workloads.quantize import quantize_params
+
+    qparams = jax.device_put(quantize_params(params))
+
     results = {}
     for name, run in (
         ("greedy", lambda: greedy(params, prompt)),
         ("sampled", lambda: sampled(params, prompt, rng)),
+        # r6 (ISSUE 2): int8 KV cache (per-token/head scales, fused
+        # decode attention dequantizing in flight) — first alone, then
+        # stacked on the int8 weights: the full quantized serving config
+        # whose floor is the lowest this chip offers.
+        ("greedy_int8kv", lambda: greedy_kv8(params, prompt)),
+        ("greedy_int8", lambda: greedy(qparams, prompt)),
+        ("greedy_w8kv8", lambda: greedy_kv8(qparams, prompt)),
     ):
         out = run()
         fetch(out)  # compile + correctness-shape warmup
@@ -397,31 +418,17 @@ def _leg_decode_main() -> int:
         dt = time.monotonic() - t0
         results[f"{name}_tok_s"] = batch * new_tokens * reps / dt
 
-    # int8 weight-only serving leg (workloads/quantize.py): same decode
-    # code over a quantized param tree — halves the per-step weight read.
-    from tpu_dra.workloads.quantize import quantize_params
-
-    qparams = jax.device_put(quantize_params(params))
-    out = greedy(qparams, prompt)
-    fetch(out)
-    assert out.shape == (batch, prompt_len + new_tokens), out.shape
-    t0 = time.monotonic()
-    for _ in range(reps):
-        out = greedy(qparams, prompt)
-    fetch(out)
-    results["greedy_int8_tok_s"] = (
-        batch * new_tokens * reps / (time.monotonic() - t0)
-    )
     results.update(
         {"batch": batch, "prompt_len": prompt_len,
          "new_tokens": new_tokens, "reps": reps}
     )
-    # Quantified roofline (r5, VERDICT #4): is batch-128 decode on this
-    # model weight-bound? Per-step HBM floor = (matmul weight bytes +
-    # KV-cache bytes) / peak BW, vs the measured per-step wall time. If
-    # the step sits far above the bf16 floor, halving the weight bytes
-    # moves the FLOOR, not the step — the ceiling on what weight-only
-    # int8 can buy. Full arithmetic in BASELINE.md.
+    # Quantified roofline (r5 VERDICT #4, extended r6): per-step HBM
+    # floor = (matmul weight bytes + KV-cache bytes) / peak BW, vs the
+    # measured per-step wall time, for each storage config. int8 KV
+    # stores hd int8 bytes + one f32 scale per (token, head) for K and
+    # V. x_above_* > 1 means the step is NOT bandwidth-bound yet; the
+    # tracked serving goal (ISSUE 2) is x_above_bf16_floor <= 2.0.
+    # Full arithmetic in BASELINE.md and docs/serving.md.
     weight_bytes = 2 * sum(
         leaf.size
         for path, leaf in jax.tree_util.tree_leaves_with_path(params)
@@ -429,28 +436,64 @@ def _leg_decode_main() -> int:
             getattr(k, "key", None) == "kernel" for k in path
         ) and leaf.ndim >= 2
     )
-    kv_bytes = (
-        2 * config.n_layers * batch * (prompt_len + new_tokens)
-        * config.n_kv_heads * config.head_dim * 2
+    kv_positions = (
+        config.n_layers * batch * (prompt_len + new_tokens)
+        * config.n_kv_heads
     )
+    kv_bytes = 2 * kv_positions * config.head_dim * 2
+    kv_bytes_int8 = 2 * kv_positions * (config.head_dim + 4)
     hbm_bw = 819e9  # v5e HBM peak bytes/s
     step_s = batch / results["greedy_tok_s"]
+    step_kv8_s = batch / results["greedy_int8kv_tok_s"]
+    step_w8kv8_s = batch / results["greedy_w8kv8_tok_s"]
     floor_bf16 = (weight_bytes + kv_bytes) / hbm_bw
     floor_int8 = (weight_bytes / 2 + kv_bytes) / hbm_bw
+    floor_int8kv = (weight_bytes + kv_bytes_int8) / hbm_bw
+    floor_w8kv8 = (weight_bytes / 2 + kv_bytes_int8) / hbm_bw
     results["roofline"] = {
         "weight_gb": round(weight_bytes / 1e9, 3),
         "kv_gb": round(kv_bytes / 1e9, 3),
+        "kv_int8_gb": round(kv_bytes_int8 / 1e9, 3),
         "step_ms": round(step_s * 1e3, 3),
+        "step_int8kv_ms": round(step_kv8_s * 1e3, 3),
+        "step_w8kv8_ms": round(step_w8kv8_s * 1e3, 3),
         "hbm_floor_ms_bf16": round(floor_bf16 * 1e3, 3),
         "hbm_floor_ms_int8": round(floor_int8 * 1e3, 3),
-        # >1 means the step is NOT bandwidth-bound; int8's upper bound
-        # is floor_bf16/floor_int8 applied to the BW-bound share only.
+        "hbm_floor_ms_int8kv": round(floor_int8kv * 1e3, 3),
+        "hbm_floor_ms_w8kv8": round(floor_w8kv8 * 1e3, 3),
         "x_above_bf16_floor": round(step_s / floor_bf16, 2),
+        "x_above_int8kv_floor": round(step_kv8_s / floor_int8kv, 2),
+        "x_above_w8kv8_floor": round(step_w8kv8_s / floor_w8kv8, 2),
         "int8_floor_ratio": round(floor_bf16 / floor_int8, 3),
         "int8_measured_ratio": round(
             results["greedy_int8_tok_s"] / results["greedy_tok_s"], 3
         ),
     }
+    # First-class roofline keys (ISSUE 2 satellite): BENCH_r* diffing
+    # must track the gap itself, not just tok/s.
+    results["x_above_bf16_floor"] = results["roofline"]["x_above_bf16_floor"]
+    results["x_above_int8kv_floor"] = results["roofline"][
+        "x_above_int8kv_floor"
+    ]
+    results["sampled_vs_greedy"] = round(
+        results["sampled_tok_s"] / results["greedy_tok_s"], 3
+    )
+    # Fused-sampler gate (ISSUE 2 satellite): with sampling inside the
+    # decode scan the greedy-vs-sampled gap must stay <= 5%. A regression
+    # here is a serving-path bug, not noise — fail the leg loudly.
+    # BENCH_ALLOW_SAMPLED_GAP=1 downgrades to a warning for exploratory
+    # sweeps.
+    if results["sampled_vs_greedy"] < 0.95:
+        msg = (
+            f"sampled decode {results['sampled_tok_s']:.1f} tok/s is "
+            f"{(1 - results['sampled_vs_greedy']) * 100:.1f}% below greedy "
+            f"{results['greedy_tok_s']:.1f} (gate: <= 5%)"
+        )
+        if os.environ.get("BENCH_ALLOW_SAMPLED_GAP"):
+            print(f"WARNING: {msg}", file=sys.stderr)
+        else:
+            print(json.dumps(results))  # keep the numbers for debugging
+            raise RuntimeError(msg)
     print(json.dumps(results))
     return 0
 
@@ -1445,18 +1488,23 @@ def main() -> int:
         file=sys.stderr,
     )
 
-    # Serving: KV-cache decode through the DRA claim env (r3).
+    # Serving: KV-cache decode through the DRA claim env (r3; r6 adds
+    # the int8-KV cache legs and the fused decode-attention path).
     decode = _run_leg(_filter_claim_env(dra_env), flag="--leg-decode")
     print(
         f"decode (batch {decode['batch']}, {decode['new_tokens']} new): "
         f"greedy {decode['greedy_tok_s']:.1f} tok/s, sampled "
-        f"{decode['sampled_tok_s']:.1f} tok/s, int8 weight-only "
-        f"{decode['greedy_int8_tok_s']:.1f} tok/s; roofline: step "
+        f"{decode['sampled_tok_s']:.1f} tok/s "
+        f"(ratio {decode['sampled_vs_greedy']}), int8 weight-only "
+        f"{decode['greedy_int8_tok_s']:.1f} tok/s, int8-KV "
+        f"{decode['greedy_int8kv_tok_s']:.1f} tok/s, w8+kv8 "
+        f"{decode['greedy_w8kv8_tok_s']:.1f} tok/s; roofline: step "
         f"{decode['roofline']['step_ms']}ms = "
-        f"{decode['roofline']['x_above_bf16_floor']}x the bf16 HBM floor "
-        f"({decode['roofline']['hbm_floor_ms_bf16']}ms) — int8 floor "
-        f"ratio {decode['roofline']['int8_floor_ratio']}, measured "
-        f"{decode['roofline']['int8_measured_ratio']}",
+        f"{decode['x_above_bf16_floor']}x the bf16 HBM floor "
+        f"({decode['roofline']['hbm_floor_ms_bf16']}ms), int8-KV step "
+        f"{decode['roofline']['step_int8kv_ms']}ms = "
+        f"{decode['x_above_int8kv_floor']}x its floor "
+        f"({decode['roofline']['hbm_floor_ms_int8kv']}ms)",
         file=sys.stderr,
     )
 
@@ -1535,6 +1583,19 @@ def main() -> int:
                 "decode_int8_tok_s": round(
                     decode["greedy_int8_tok_s"], 1
                 ),
+                "decode_int8kv_tok_s": round(
+                    decode["greedy_int8kv_tok_s"], 1
+                ),
+                "decode_w8kv8_tok_s": round(
+                    decode["greedy_w8kv8_tok_s"], 1
+                ),
+                # First-class roofline-gap keys (ISSUE 2): BENCH_r*
+                # comparisons track the gap itself across rounds.
+                "decode_x_above_bf16_floor": decode["x_above_bf16_floor"],
+                "decode_x_above_int8kv_floor": decode[
+                    "x_above_int8kv_floor"
+                ],
+                "decode_sampled_vs_greedy": decode["sampled_vs_greedy"],
                 "decode_roofline": decode["roofline"],
                 "timeslice_aggregate_tok_s": round(
                     rotation["aggregate_tok_s"], 1

@@ -24,6 +24,15 @@ downed chip fails `make lint`, not a 2am soak:
   C900  unreadable / invalid JSON
   C901  schema violation (from tpu_dra.infra.chaos.validate_schedule)
 
+When bench.py is among the lint targets, its final JSON line is held to
+a SUPERSET rule against the most recent recorded BENCH_r*.json artifact
+(r6, ISSUE 2): every top-level key the last round emitted must still be
+a key of the dict literal bench.py prints — downstream BENCH parsing
+and cross-round comparisons never break on a silent rename/drop:
+
+  B100  bench.py's final JSON dict dropped a key the last BENCH_r*.json
+        artifact carries
+
 Zero findings = exit 0. Any finding prints `path:line: CODE message`
 and exits 1, exactly like a linter in CI.
 """
@@ -200,6 +209,58 @@ def lint_chaos_schedule(path: Path) -> list:
     return [(path, 0, "C901", err) for err in validate_schedule(data)]
 
 
+def _static_bench_keys(tree: ast.Module) -> set:
+    """Top-level keys of the LARGEST dict literal passed to json.dumps —
+    the final result line printed by bench.py's main() (the per-leg
+    result dicts are all much smaller; if that ever stops holding, this
+    check fails loud via missing keys rather than passing silently)."""
+    best: set = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "dumps"
+            and node.args
+            and isinstance(node.args[0], ast.Dict)
+        ):
+            keys = {
+                k.value
+                for k in node.args[0].keys
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)
+            }
+            if len(keys) > len(best):
+                best = keys
+    return best
+
+
+def lint_bench_keys(path: Path) -> list:
+    """B100: the bench result schema only grows. Compare the dict
+    literal bench.py prints as its final JSON line against the newest
+    recorded BENCH_r*.json (driver artifacts wrap the line under
+    "parsed") — any key the last round carried must survive."""
+    import json
+
+    artifacts = sorted(path.resolve().parent.glob("BENCH_r*.json"))
+    if not artifacts:
+        return []
+    last = artifacts[-1]
+    try:
+        data = json.loads(last.read_text(encoding="utf-8"))
+    except (OSError, ValueError) as e:
+        return [(last, 0, "C900", f"invalid JSON: {e}")]
+    if isinstance(data.get("parsed"), dict):
+        data = data["parsed"]
+    static = _static_bench_keys(ast.parse(path.read_text(encoding="utf-8")))
+    return [
+        (
+            path, 0, "B100",
+            f"final JSON dict dropped key {k!r} present in {last.name} "
+            f"(bench schema is append-only)",
+        )
+        for k in sorted(set(data) - static)
+    ]
+
+
 def main(argv: list) -> int:
     roots = [Path(a) for a in argv] or [Path("tpu_dra"), Path("tests")]
     files: list = []
@@ -217,6 +278,8 @@ def main(argv: list) -> int:
         if "/pb/" in str(f):  # protoc output is generated, not linted
             continue
         findings.extend(lint_file(f))
+        if f.name == "bench.py":
+            findings.extend(lint_bench_keys(f))
     for s in schedules:
         findings.extend(lint_chaos_schedule(s))
     files = files + schedules

@@ -1,0 +1,123 @@
+"""Fast CPU decode smoke — ``make decodebench`` (wired into ``ci``).
+
+A hardware-free gate on the r6 serving path (ISSUE 2): tiny config, a
+handful of steps, asserting the things the full bench can only measure
+on a chip —
+
+1. the FUSED decode-attention path actually dispatches from the decode
+   scan (both cache layouts; a silent fall-through to the prefill
+   einsum would void every roofline claim),
+2. the fused op matches the naive fp32 oracle on a random cache (bf16
+   and int8 storage),
+3. int8-KV greedy decode agrees with bf16 decode token-for-token on a
+   short horizon (the argmax-agreement bar from the acceptance
+   criteria),
+4. the fused sampler is token-identical to the unfused per-token loop
+   for a fixed key (the <= 5% sampled-gap gate's correctness half).
+
+Prints one JSON line; exits nonzero on any violation — the same
+contract as bench.py legs, so CI sees a regression before a TPU run
+does.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> int:
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_dra.workloads.generate import (
+        greedy_generate,
+        sample_generate,
+        sample_generate_unfused,
+    )
+    from tpu_dra.workloads.models.llama import TINY_LLAMA, Llama
+    from tpu_dra.workloads.ops import attention as A
+    from tpu_dra.workloads.quantize import dequantize_kv, quantize_kv
+
+    report = {"ok": False}
+
+    # (2) op-level parity on a random cache, both storages.
+    b, S, h, kvh, hd = 2, 32, 8, 2, 64
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, h, hd), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, S, kvh, hd))
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, S, kvh, hd))
+    L = jnp.int32(21)  # chunk-unaligned on purpose
+    ref = A.reference_decode_attention(q, k, v, L)
+    got = A.decode_attention(q, k, v, L, impl="xla", block_k=8)
+    err = float(jnp.max(jnp.abs(got - ref)))
+    assert err < 1e-4, f"fused decode attention drifted {err} from oracle"
+    k8, ks = quantize_kv(k)
+    v8, vs = quantize_kv(v)
+    refq = A.reference_decode_attention(
+        q, dequantize_kv(k8, ks), dequantize_kv(v8, vs), L
+    )
+    gotq = A.decode_attention(
+        q, k8, v8, L, k_scale=ks, v_scale=vs, impl="xla", block_k=8
+    )
+    errq = float(jnp.max(jnp.abs(gotq - refq)))
+    assert errq < 1e-4, f"int8 fused decode attention drifted {errq}"
+    report["op_max_err"] = err
+    report["op_int8_max_err"] = errq
+
+    # (1) + (3): generation through both layouts; the dispatch probe is
+    # trace-time, so reading it after the traced call is sound.
+    cfg = dataclasses.replace(
+        TINY_LLAMA, dtype=jnp.float32, param_dtype=jnp.float32
+    )
+    new_tokens = 12
+    for scan in (True, False):
+        c = dataclasses.replace(cfg, scan_layers=scan)
+        model = Llama(c)
+        params = model.init_params(jax.random.PRNGKey(7), batch=2, seq=8)
+        prompt = jnp.tile(jnp.arange(8, dtype=jnp.int32)[None], (2, 1))
+        A._LAST_DECODE_IMPL = None
+        t0 = time.monotonic()
+        out_bf16 = greedy_generate(c, params, prompt, new_tokens)
+        assert A._LAST_DECODE_IMPL in ("xla", "pallas"), (
+            f"decode scan never dispatched the fused op "
+            f"(scan_layers={scan}; saw {A._LAST_DECODE_IMPL!r})"
+        )
+        out_int8 = greedy_generate(
+            c, params, prompt, new_tokens, kv_quant="int8"
+        )
+        agree = float(
+            np.mean(np.asarray(out_bf16[:, 8:]) == np.asarray(out_int8[:, 8:]))
+        )
+        layout = "stacked" if scan else "unrolled"
+        assert agree >= 0.99, (
+            f"int8-KV disagreed with bf16 decode: {agree:.3f} ({layout})"
+        )
+        report[f"{layout}_impl"] = A._LAST_DECODE_IMPL
+        report[f"{layout}_int8kv_token_agreement"] = agree
+        report[f"{layout}_seconds"] = round(time.monotonic() - t0, 2)
+
+    # (4) fused sampler == unfused oracle, fixed key.
+    model = Llama(cfg)
+    params = model.init_params(jax.random.PRNGKey(7), batch=2, seq=8)
+    prompt = jnp.tile(jnp.arange(8, dtype=jnp.int32)[None], (2, 1))
+    rng = jax.random.PRNGKey(5)
+    fused = sample_generate(
+        cfg, params, prompt, new_tokens, rng, temperature=0.8, top_k=8
+    )
+    unfused = sample_generate_unfused(
+        cfg, params, prompt, new_tokens, rng, temperature=0.8, top_k=8
+    )
+    assert jnp.array_equal(fused, unfused), "fused sampler diverged"
+    report["sampler_parity"] = True
+
+    report["ok"] = True
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

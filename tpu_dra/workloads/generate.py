@@ -1,4 +1,4 @@
-"""KV-cache greedy decoding for the flagship Llama.
+"""KV-cache decoding for the flagship Llama (the serving path).
 
 Serving-side companion to workloads/train.py: prefill + incremental
 decode over a static-shape KV cache, fully jittable (``lax.scan`` over
@@ -6,10 +6,29 @@ decode steps, ``lax.dynamic_update_slice`` cache writes — no Python
 control flow on device values, so XLA compiles one prefill and one
 decode-step executable).
 
+Decode-roofline design (the r6 serving rework — docs/serving.md):
+
+- the KV cache can be stored **int8** with per-(token, head) scales
+  (quantize.quantize_kv): ~2x less KV traffic per step, dequantized on
+  the fly inside the attention contraction — no bf16 KV copy ever
+  exists;
+- every s=1 step goes through the **fused decode attention** op
+  (ops/attention.py decode_attention): GQA-native single-query online
+  softmax split over the cache length. No ``_repeat_kv`` copy, no
+  ``[b, h, 1, max_seq]`` fp32 score tensor, and the contraction stops at
+  the last live position instead of paying full-``max_seq`` compute at
+  small ``pos`` (the length-aware mask — made safe by the zero-tail
+  invariant below);
+- sampling is **fused into the decode scan**: temperature/top-k run on
+  an exact two-stage top-k and draw from the k-entry candidate set, so
+  sampled decode compiles to the same single scan as greedy instead of
+  re-entering XLA per token (``sample_generate_unfused`` keeps the old
+  per-token loop as the parity oracle).
+
 The decode forward is a hand-rolled replay of models/llama.py's math
 over the SAME parameter tree, in either layout: scan-stacked layers or
 unrolled ``layer_{i}`` subtrees (the in-place-cache fast path).
-Equivalence of BOTH is pinned by
+Equivalence of BOTH (bf16 and int8-KV) is pinned by
 tests/test_workloads.py::test_decode_matches_full_forward:
 teacher-forced decode logits must match the training forward's logits
 position by position, so the implementations cannot drift silently.
@@ -32,6 +51,10 @@ from tpu_dra.workloads.models.llama import (
     apply_rope,
     rope_frequencies,
 )
+from tpu_dra.workloads.ops.attention import decode_attention
+from tpu_dra.workloads.quantize import quantize_kv
+
+KV_QUANT_MODES = ("none", "int8")
 
 
 @jax.tree_util.register_pytree_node_class
@@ -52,24 +75,42 @@ class DecodeCache:
       token (the stacked layout pays streamed xs reads + a bulk append
       against a second buffer).
 
-    INVARIANT (stacked layout): slots at positions >= pos are ZERO.
-    init_cache guarantees it and forward_chunk preserves it (each chunk
-    writes exactly [pos, pos+s)); the stacked attention's split value
-    contraction relies on it. Rewinding pos (speculative-decode
-    rejection) or building a cache by other means breaks it silently —
-    call :meth:`zero_tail` first (and :meth:`tail_is_zero` asserts the
-    invariant in tests/debug runs)."""
+    Storage is the model dtype by default, or int8 with per-(token,
+    head) f32 scales (``k_scale``/``v_scale``: [L, b, max_seq, kvh]
+    stacked, L-tuples of [b, max_seq, kvh] unrolled) when built with
+    ``init_cache(..., kv_quant="int8")`` — quantize.quantize_kv rows,
+    dequantized on the fly inside the attention contraction.
+
+    INVARIANT (stacked layout): slots at positions >= pos are ZERO —
+    including the scale arrays. init_cache guarantees it and
+    forward_chunk preserves it (each chunk writes exactly [pos, pos+s));
+    the stacked attention's split value contraction relies on it.
+    Rewinding pos (speculative-decode rejection) or building a cache by
+    other means breaks it silently — call :meth:`zero_tail` first (and
+    :meth:`tail_is_zero` asserts the invariant in tests/debug runs).
+    The s=1 decode step itself is tail-proof either way: decode
+    attention's length mask never admits a position >= pos."""
 
     k: "jnp.ndarray | tuple"  # stacked array or L-tuple of per-layer arrays
     v: "jnp.ndarray | tuple"
     pos: jnp.ndarray  # scalar int32
+    k_scale: "jnp.ndarray | tuple | None" = None  # int8 mode only
+    v_scale: "jnp.ndarray | tuple | None" = None
 
     def tree_flatten(self):
-        return (self.k, self.v, self.pos), None
+        return (self.k, self.v, self.pos, self.k_scale, self.v_scale), None
 
     @classmethod
     def tree_unflatten(cls, _aux, children):
         return cls(*children)
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+    @property
+    def stacked(self) -> bool:
+        return not isinstance(self.k, (tuple, list))
 
     def _seq_mask(self, arr: jnp.ndarray, stacked: bool) -> jnp.ndarray:
         seq_axis = 2 if stacked else 1  # [L, b, s, ...] vs [b, s, ...]
@@ -78,32 +119,39 @@ class DecodeCache:
         shape[seq_axis] = arr.shape[seq_axis]
         return (idx < self.pos).reshape(shape)
 
+    def _arrays(self):
+        """(stacked?, list of (field, value)) over every non-None buffer —
+        k/v and, in int8 mode, their scale arrays."""
+        fields = [("k", self.k), ("v", self.v)]
+        if self.quantized:
+            fields += [("k_scale", self.k_scale), ("v_scale", self.v_scale)]
+        return self.stacked, fields
+
     def zero_tail(self) -> "DecodeCache":
         """Re-establish the zero-tail invariant after an external pos
         rewind (speculative-decode rejection) or a hand-built cache:
-        returns a cache with every slot at positions >= pos zeroed.
-        Jit-safe (pure mask multiply, no data-dependent shapes)."""
-        stacked = not isinstance(self.k, tuple)
-        if stacked:
-            return DecodeCache(
-                k=self.k * self._seq_mask(self.k, True).astype(self.k.dtype),
-                v=self.v * self._seq_mask(self.v, True).astype(self.v.dtype),
-                pos=self.pos,
-            )
-        return DecodeCache(
-            k=tuple(a * self._seq_mask(a, False).astype(a.dtype)
-                    for a in self.k),
-            v=tuple(a * self._seq_mask(a, False).astype(a.dtype)
-                    for a in self.v),
-            pos=self.pos,
-        )
+        returns a cache with every slot at positions >= pos zeroed —
+        values AND scales. Jit-safe (pure mask multiply, no
+        data-dependent shapes)."""
+        stacked, fields = self._arrays()
+
+        def wipe(a):
+            return a * self._seq_mask(a, stacked).astype(a.dtype)
+
+        out = {
+            name: wipe(a) if stacked else tuple(wipe(x) for x in a)
+            for name, a in fields
+        }
+        return DecodeCache(pos=self.pos, **out)
 
     def tail_is_zero(self) -> jnp.ndarray:
         """Scalar bool: does the zero-tail invariant hold? For test
         assertions and opt-in debug checks (cheap enough to run per
         rewind: one masked reduction over the cache)."""
-        stacked = not isinstance(self.k, tuple)
-        arrs = (self.k, self.v) if stacked else tuple(self.k) + tuple(self.v)
+        stacked, fields = self._arrays()
+        arrs = []
+        for _, a in fields:
+            arrs.extend([a] if stacked else list(a))
         ok = jnp.bool_(True)
         for a in arrs:
             tail = a * (~self._seq_mask(a, stacked)).astype(a.dtype)
@@ -112,23 +160,38 @@ class DecodeCache:
 
 
 def init_cache(
-    config: LlamaConfig, batch: int, max_seq: int, stacked: bool = True
+    config: LlamaConfig,
+    batch: int,
+    max_seq: int,
+    stacked: bool = True,
+    kv_quant: str = "none",
 ) -> DecodeCache:
-    shape = (batch, max_seq, config.n_kv_heads, config.head_dim)
-    if stacked:
-        return DecodeCache(
-            k=jnp.zeros((config.n_layers,) + shape, config.dtype),
-            v=jnp.zeros((config.n_layers,) + shape, config.dtype),
-            pos=jnp.zeros((), jnp.int32),
+    if kv_quant not in KV_QUANT_MODES:
+        raise ValueError(
+            f"unknown kv_quant {kv_quant!r}; expected one of {KV_QUANT_MODES}"
         )
+    quant = kv_quant == "int8"
+    kv_dtype = jnp.int8 if quant else config.dtype
+    shape = (batch, max_seq, config.n_kv_heads, config.head_dim)
+    sshape = (batch, max_seq, config.n_kv_heads)
+    if stacked:
+        lead = (config.n_layers,)
+        return DecodeCache(
+            k=jnp.zeros(lead + shape, kv_dtype),
+            v=jnp.zeros(lead + shape, kv_dtype),
+            pos=jnp.zeros((), jnp.int32),
+            k_scale=jnp.zeros(lead + sshape, jnp.float32) if quant else None,
+            v_scale=jnp.zeros(lead + sshape, jnp.float32) if quant else None,
+        )
+    L = config.n_layers
     return DecodeCache(
-        k=tuple(
-            jnp.zeros(shape, config.dtype) for _ in range(config.n_layers)
-        ),
-        v=tuple(
-            jnp.zeros(shape, config.dtype) for _ in range(config.n_layers)
-        ),
+        k=tuple(jnp.zeros(shape, kv_dtype) for _ in range(L)),
+        v=tuple(jnp.zeros(shape, kv_dtype) for _ in range(L)),
         pos=jnp.zeros((), jnp.int32),
+        k_scale=tuple(jnp.zeros(sshape, jnp.float32) for _ in range(L))
+        if quant else None,
+        v_scale=tuple(jnp.zeros(sshape, jnp.float32) for _ in range(L))
+        if quant else None,
     )
 
 
@@ -176,6 +239,59 @@ def _finish_block(c, lp, x, out, b, s):
     return x + _mm(jax.nn.silu(gate) * up, mlp["w_down"])
 
 
+def _key_scale_cols(s: jnp.ndarray) -> jnp.ndarray:
+    """[b, max_seq, kvh] per-key scale -> [b, kvh, 1, 1, max_seq]
+    broadcastable against [b, kvh, n_rep, s, max_seq] chunk scores."""
+    return s.transpose(0, 2, 1)[:, :, None, None, :]
+
+
+def _attend_chunk_scores(c, qg, ck, ks, b, s):
+    """Chunk queries against a full single-layer cache buffer: fp32
+    scores with on-the-fly int8 dequant (the int8->dtype convert fuses
+    into the dot feed; the per-key scale multiplies score columns, so no
+    dequantized KV copy exists)."""
+    kc = ck.astype(c.dtype) if ks is not None else ck
+    logits = jnp.einsum(
+        "bqhrd,bkhd->bhrqk", qg, kc,
+        preferred_element_type=jnp.float32,
+    ) * (c.head_dim ** -0.5)
+    if ks is not None:
+        logits = logits * _key_scale_cols(ks)
+    return logits
+
+
+def _attend_chunk_values(c, probs, cv, vs):
+    """fp32 probabilities x cache values with on-the-fly dequant: the
+    per-key v scale folds into the probabilities (fp32) before the value
+    contraction."""
+    if vs is not None:
+        pv = (probs * _key_scale_cols(vs)).astype(c.dtype)
+        vc = cv.astype(c.dtype)
+    else:
+        pv = probs.astype(cv.dtype)
+        vc = cv
+    return jnp.einsum(
+        "bhrqk,bkhd->bqhrd", pv, vc,
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _write_cache(ck, cv, ks, vs, k, v, pos):
+    """Append a fresh [b, s, kvh, hd] K/V chunk at ``pos`` — quantizing
+    in flight when the cache is int8 (ks/vs not None)."""
+    if ks is not None:
+        kq, ksc = quantize_kv(k)
+        vq, vsc = quantize_kv(v)
+        ck = lax.dynamic_update_slice(ck, kq, (0, pos, 0, 0))
+        cv = lax.dynamic_update_slice(cv, vq, (0, pos, 0, 0))
+        ks = lax.dynamic_update_slice(ks, ksc, (0, pos, 0))
+        vs = lax.dynamic_update_slice(vs, vsc, (0, pos, 0))
+    else:
+        ck = lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
+    return ck, cv, ks, vs
+
+
 def forward_chunk(
     config: LlamaConfig,
     params: dict,
@@ -185,9 +301,10 @@ def forward_chunk(
     """Process ``tokens`` [b, s] at absolute positions
     ``cache.pos .. cache.pos+s-1``: append K/V, attend over everything
     written so far, and return (updated cache, logits [b, s, vocab]).
-    Prefill is a long chunk; a decode step is s=1. Handles both param
-    layouts: scan-stacked (``scan_layers=True``) and unrolled (the
-    cache layout must match — ``_generate`` wires this up)."""
+    Prefill is a long chunk; a decode step is s=1 and dispatches to the
+    fused decode-attention op. Handles both param layouts: scan-stacked
+    (``scan_layers=True``) and unrolled (the cache layout must match —
+    ``_generate`` wires this up) — each in bf16 or int8-KV storage."""
     c = config
     stacked = "layers" in params
     if isinstance(cache.k, (tuple, list)) == stacked:
@@ -197,6 +314,7 @@ def forward_chunk(
             f"{type(cache.k).__name__}; build the cache with "
             f"init_cache(..., stacked={stacked})"
         )
+    quant = cache.quantized
     b, s = tokens.shape
     max_seq = cache.k.shape[2] if stacked else cache.k[0].shape[1]
     x = params["embed"]["embedding"].astype(c.dtype)[tokens]  # [b, s, d]
@@ -204,11 +322,11 @@ def forward_chunk(
     cos, sin = rope_frequencies(c, positions)  # [s, hd/2]
     # Absolute-position mask over the whole static cache: key j visible
     # to query i iff j <= pos+i. Unwritten slots sit at j >= pos+s and
-    # are masked for every query.
+    # are masked for every query. (Prefill chunks only — the s=1 decode
+    # step's masking lives inside decode_attention's length bound.)
     q_abs = positions  # [s]
     karange = jnp.arange(max_seq)
     mask = karange[None, :] <= q_abs[:, None]  # [s, max_seq]
-    scale = c.head_dim ** -0.5
     n_rep = c.n_heads // c.n_kv_heads
 
     def block(x, layer):
@@ -218,100 +336,194 @@ def forward_chunk(
         # emits only the s NEW positions' k/v — rewriting the full cache
         # as stacked scan outputs costs two whole-cache copies per decode
         # step (measured 4x the roofline step time at batch 128 on v5e).
-        lp, ck, cv = layer  # ck/cv: [b, max_seq, kvh, hd]
+        if quant:
+            lp, ck, cv, ks, vs = layer
+        else:
+            lp, ck, cv = layer
+            ks = vs = None
         q, k, v = _project_qkv(c, lp, x, cos, sin, b, s)
-        # GQA without materializing an n_rep-times copy of the cache
-        # (the decode hot path would pay that per layer per step):
-        # group query heads kv-major — head i belongs to kv group
-        # i // n_rep, matching ops/attention.py _repeat_kv order — and
-        # contract straight against the grouped cache.
-        qg = q.reshape(b, s, c.n_kv_heads, n_rep, c.head_dim)
-        # Scores against the (stale-at-[pos,pos+s)) streamed cache, then
-        # overwrite the in-chunk columns with the fresh keys' scores.
-        logits = jnp.einsum(
-            "bqhrd,bkhd->bhrqk", qg, ck,
-            preferred_element_type=jnp.float32,
-        ) * scale
-        chunk_scores = jnp.einsum(
-            "bqhrd,bkhd->bhrqk", qg, k,
-            preferred_element_type=jnp.float32,
-        ) * scale
-        logits = lax.dynamic_update_slice(
-            logits, chunk_scores, (0, 0, 0, 0, cache.pos)
-        )
-        logits = jnp.where(mask[None, None, None], logits, -1e30)
-        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-        pv = probs.astype(cv.dtype)
-        # Value contraction splits the same way: the streamed cache's
-        # [pos, pos+s) columns are zero, so their term vanishes and the
-        # fresh values enter through the sliced correction.
-        out = jnp.einsum(
-            "bhrqk,bkhd->bqhrd", pv, cv,
-            preferred_element_type=jnp.float32,
-        )
-        chunk_probs = lax.dynamic_slice(
-            pv, (0, 0, 0, 0, cache.pos), (b, c.n_kv_heads, n_rep, s, s)
-        )
-        out = out + jnp.einsum(
-            "bhrqk,bkhd->bqhrd", chunk_probs, v,
-            preferred_element_type=jnp.float32,
-        )
-        return _finish_block(c, lp, x, out.astype(c.dtype), b, s), (k, v)
+        if s == 1:
+            # Fused decode step: the streamed cache is stale at the
+            # current position, so the fresh token's K/V ride in exact
+            # (extra_k/extra_v) while the cache part is length-bounded
+            # at pos. GQA-native, no [b, h, max_seq] fp32 scores.
+            out = decode_attention(
+                q[:, 0], ck, cv, cache.pos + 1,
+                k_scale=ks, v_scale=vs,
+                extra_k=k[:, 0], extra_v=v[:, 0],
+                impl=c.decode_impl, block_k=c.decode_block_k,
+            )[:, None]  # [b, 1, h, hd]
+            out = out.astype(c.dtype)
+        else:
+            # GQA without materializing an n_rep-times copy of the cache:
+            # group query heads kv-major — head i belongs to kv group
+            # i // n_rep, matching ops/attention.py _repeat_kv order —
+            # and contract straight against the grouped cache. Scores
+            # against the (stale-at-[pos,pos+s)) streamed cache, then
+            # overwrite the in-chunk columns with the fresh keys' scores.
+            qg = q.reshape(b, s, c.n_kv_heads, n_rep, c.head_dim)
+            scale = c.head_dim ** -0.5
+            logits = _attend_chunk_scores(c, qg, ck, ks, b, s)
+            chunk_scores = jnp.einsum(
+                "bqhrd,bkhd->bhrqk", qg, k,
+                preferred_element_type=jnp.float32,
+            ) * scale
+            logits = lax.dynamic_update_slice(
+                logits, chunk_scores, (0, 0, 0, 0, cache.pos)
+            )
+            logits = jnp.where(mask[None, None, None], logits, -1e30)
+            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+            # Value contraction splits the same way: the streamed cache's
+            # [pos, pos+s) columns are zero (values AND scales), so their
+            # term vanishes and the fresh values enter through the sliced
+            # correction — in the fresh chunk's exact dtype, unquantized.
+            out = _attend_chunk_values(c, probs, cv, vs)
+            chunk_probs = lax.dynamic_slice(
+                probs.astype(v.dtype),
+                (0, 0, 0, 0, cache.pos),
+                (b, c.n_kv_heads, n_rep, s, s),
+            )
+            out = out + jnp.einsum(
+                "bhrqk,bkhd->bqhrd", chunk_probs, v,
+                preferred_element_type=jnp.float32,
+            )
+            out = out.astype(c.dtype)
+        if quant:
+            kq, ksc = quantize_kv(k)
+            vq, vsc = quantize_kv(v)
+            ys = (kq, ksc, vq, vsc)
+        else:
+            ys = (k, v)
+        return _finish_block(c, lp, x, out, b, s), ys
 
     if stacked:
-        x, (k_new, v_new) = lax.scan(
-            block, x, (params["layers"]["block"], cache.k, cache.v)
-        )
-        # One bulk append outside the scan: k_new/v_new are
-        # [L, b, s, kvh, hd] (s tokens per layer), written into the
-        # static cache at pos.
-        new_k = lax.dynamic_update_slice(
-            cache.k, k_new, (0, 0, cache.pos, 0, 0)
-        )
-        new_v = lax.dynamic_update_slice(
-            cache.v, v_new, (0, 0, cache.pos, 0, 0)
-        )
-        new_cache = DecodeCache(k=new_k, v=new_v, pos=cache.pos + s)
+        xs = (params["layers"]["block"], cache.k, cache.v)
+        if quant:
+            xs = xs + (cache.k_scale, cache.v_scale)
+        x, ys = lax.scan(block, x, xs)
+        # One bulk append outside the scan: the ys are [L, b, s, ...]
+        # (s tokens per layer), written into the static cache at pos.
+        if quant:
+            k_new, ks_new, v_new, vs_new = ys
+            new_cache = DecodeCache(
+                k=lax.dynamic_update_slice(
+                    cache.k, k_new, (0, 0, cache.pos, 0, 0)
+                ),
+                v=lax.dynamic_update_slice(
+                    cache.v, v_new, (0, 0, cache.pos, 0, 0)
+                ),
+                pos=cache.pos + s,
+                k_scale=lax.dynamic_update_slice(
+                    cache.k_scale, ks_new, (0, 0, cache.pos, 0)
+                ),
+                v_scale=lax.dynamic_update_slice(
+                    cache.v_scale, vs_new, (0, 0, cache.pos, 0)
+                ),
+            )
+        else:
+            k_new, v_new = ys
+            new_cache = DecodeCache(
+                k=lax.dynamic_update_slice(
+                    cache.k, k_new, (0, 0, cache.pos, 0, 0)
+                ),
+                v=lax.dynamic_update_slice(
+                    cache.v, v_new, (0, 0, cache.pos, 0, 0)
+                ),
+                pos=cache.pos + s,
+            )
     else:
         # Unrolled layers: each layer's cache buffer is updated in place
         # (single def-use chain per step — XLA aliases it across decode
         # iterations; measured 8.3k -> on the way to roofline at batch
         # 128 on v5e vs the stacked path's bulk-append copies).
-        ks, vs = list(cache.k), list(cache.v)
+        ks_l = list(cache.k_scale) if quant else [None] * c.n_layers
+        vs_l = list(cache.v_scale) if quant else [None] * c.n_layers
+        k_l, v_l = list(cache.k), list(cache.v)
         for i in range(c.n_layers):
-            x, ks[i], vs[i] = _block_inplace(
-                c, params[f"layer_{i}"], x, ks[i], vs[i], cache.pos,
-                mask, cos, sin, n_rep, b, s,
+            x, k_l[i], v_l[i], ks_l[i], vs_l[i] = _block_inplace(
+                c, params[f"layer_{i}"], x, k_l[i], v_l[i], ks_l[i],
+                vs_l[i], cache.pos, mask, cos, sin, n_rep, b, s,
             )
         new_cache = DecodeCache(
-            k=tuple(ks), v=tuple(vs), pos=cache.pos + s
+            k=tuple(k_l), v=tuple(v_l), pos=cache.pos + s,
+            k_scale=tuple(ks_l) if quant else None,
+            v_scale=tuple(vs_l) if quant else None,
         )
     x = _rms(x, params["final_norm"]["scale"], c.norm_eps)
     logits = _mm(x, params["lm_head"]).astype(jnp.float32)
     return new_cache, logits
 
 
-def _block_inplace(c, lp, x, ck, cv, pos, mask, cos, sin, n_rep, b, s):
+def _block_inplace(c, lp, x, ck, cv, ks, vs, pos, mask, cos, sin, n_rep,
+                   b, s):
     """One unrolled decoder layer over a single-layer cache
-    [b, max_seq, kvh, hd]: append this chunk's K/V in place, then attend
-    over the updated buffer (the straightforward update-then-attend —
-    correct here because the buffer is not simultaneously a scan input)."""
-    scale = c.head_dim ** -0.5
+    [b, max_seq, kvh, hd] (+ scale buffers when int8): append this
+    chunk's K/V in place — quantizing in flight — then attend over the
+    updated buffer (the straightforward update-then-attend — correct
+    here because the buffer is not simultaneously a scan input). The
+    s=1 step attends through the fused decode-attention op."""
     q, k, v = _project_qkv(c, lp, x, cos, sin, b, s)
-    ck = lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
-    cv = lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
-    qg = q.reshape(b, s, c.n_kv_heads, n_rep, c.head_dim)
-    logits = jnp.einsum(
-        "bqhrd,bkhd->bhrqk", qg, ck,
-        preferred_element_type=jnp.float32,
-    ) * scale
-    logits = jnp.where(mask[None, None, None], logits, -1e30)
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    out = jnp.einsum(
-        "bhrqk,bkhd->bqhrd", probs.astype(cv.dtype), cv,
-        preferred_element_type=jnp.float32,
-    ).astype(c.dtype)
-    return _finish_block(c, lp, x, out, b, s), ck, cv
+    ck, cv, ks, vs = _write_cache(ck, cv, ks, vs, k, v, pos)
+    if s == 1:
+        out = decode_attention(
+            q[:, 0], ck, cv, pos + 1, k_scale=ks, v_scale=vs,
+            impl=c.decode_impl, block_k=c.decode_block_k,
+        )[:, None].astype(c.dtype)
+    else:
+        qg = q.reshape(b, s, c.n_kv_heads, n_rep, c.head_dim)
+        logits = _attend_chunk_scores(c, qg, ck, ks, b, s)
+        logits = jnp.where(mask[None, None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        out = _attend_chunk_values(c, probs, cv, vs).astype(c.dtype)
+    return _finish_block(c, lp, x, out, b, s), ck, cv, ks, vs
+
+
+# --- sampling ---------------------------------------------------------------
+
+# Two-stage top-k chunk width: the vocab splits into _TOPK_CHUNK-wide
+# segments, each segment contributes its own top-k, and the final top-k
+# runs over the (vocab/_TOPK_CHUNK)*k candidates. Exact for any input —
+# every global top-k element is a top-k element of its segment — while
+# replacing one huge partial sort with narrow ones (32k vocab, k=40:
+# 32768-wide sort -> 32x 1024-wide + one 1280-wide).
+_TOPK_CHUNK = 1024
+
+
+def topk_exact(x: jnp.ndarray, k: int) -> tuple:
+    """lax.top_k semantics ([b, vocab] -> values/indices [b, k], values
+    descending, ties to the lower index) via the two-stage split when
+    the shape allows, one direct lax.top_k otherwise."""
+    vocab = x.shape[-1]
+    if vocab % _TOPK_CHUNK or vocab <= _TOPK_CHUNK or k > _TOPK_CHUNK:
+        return lax.top_k(x, k)
+    n = vocab // _TOPK_CHUNK
+    xr = x.reshape(x.shape[0], n, _TOPK_CHUNK)
+    seg_v, seg_i = lax.top_k(xr, k)  # [b, n, k]
+    cand_v = seg_v.reshape(x.shape[0], n * k)
+    cand_i = (
+        seg_i + (jnp.arange(n) * _TOPK_CHUNK)[None, :, None]
+    ).reshape(x.shape[0], n * k)
+    fin_v, fin_pos = lax.top_k(cand_v, k)
+    return fin_v, jnp.take_along_axis(cand_i, fin_pos, axis=-1)
+
+
+def sample_token(
+    logits: jnp.ndarray,
+    rng: jnp.ndarray,
+    temperature: float,
+    top_k: int,
+) -> jnp.ndarray:
+    """Fused temperature/top-k sampler: [b, vocab] logits -> [b] token
+    ids. With top_k > 0 the categorical draw runs over the k-entry
+    candidate set (not the full vocab) and maps back through the top-k
+    indices — same distribution as masking the vocab to the top k, at a
+    fraction of the per-step cost. Scan-body safe: static shapes only."""
+    scaled = logits.astype(jnp.float32) / temperature
+    if top_k > 0:
+        vals, idx = topk_exact(scaled, top_k)
+        choice = jax.random.categorical(rng, vals, axis=-1)
+        return jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0]
+    return jax.random.categorical(rng, scaled, axis=-1)
 
 
 def _generate(
@@ -321,11 +533,18 @@ def _generate(
     max_new_tokens: int,
     max_seq: int,
     pick,
+    kv_quant: str = "none",
 ) -> jnp.ndarray:
     """Shared prefill + scan-decode loop; ``pick(logits[b, v], i)``
     chooses the next token for step i."""
     b, s = prompt.shape
-    max_seq = max_seq or (s + max_new_tokens)
+    if not max_seq:
+        # Auto-sized caches round up to a 64 granule: decode attention
+        # needs a block size dividing max_seq, and an awkward length
+        # (prime, odd) would collapse the chunk to ~1 key per loop
+        # iteration. Padded slots cost cache memory only — the length
+        # mask keeps them out of every contraction.
+        max_seq = -(-(s + max_new_tokens) // 64) * 64
     # All static at trace time: fail loudly instead of letting a full
     # cache clamp dynamic_update_slice writes into silent garbage.
     assert max_new_tokens >= 1, "max_new_tokens must be >= 1"
@@ -333,7 +552,9 @@ def _generate(
         f"cache too small: max_seq={max_seq} < "
         f"prompt {s} + max_new_tokens {max_new_tokens}"
     )
-    cache = init_cache(config, b, max_seq, stacked="layers" in params)
+    cache = init_cache(
+        config, b, max_seq, stacked="layers" in params, kv_quant=kv_quant
+    )
     cache, logits = forward_chunk(config, params, cache, prompt)
     first = pick(logits[:, -1], 0).astype(prompt.dtype)
 
@@ -360,13 +581,16 @@ def greedy_generate(
     prompt: jnp.ndarray,
     max_new_tokens: int,
     max_seq: int = 0,
+    kv_quant: str = "none",
 ) -> jnp.ndarray:
     """Greedy-decode ``max_new_tokens`` after ``prompt`` [b, s]; returns
     [b, s + max_new_tokens]. Jit-friendly: one traced prefill + a
-    ``lax.scan`` of single-token steps."""
+    ``lax.scan`` of single-token steps. ``kv_quant="int8"`` stores the
+    cache int8 with per-(token, head) scales."""
     return _generate(
         config, params, prompt, max_new_tokens, max_seq,
         pick=lambda logits, _i: jnp.argmax(logits, axis=-1),
+        kv_quant=kv_quant,
     )
 
 
@@ -379,26 +603,75 @@ def sample_generate(
     temperature: float = 1.0,
     top_k: int = 0,
     max_seq: int = 0,
+    kv_quant: str = "none",
 ) -> jnp.ndarray:
-    """Temperature / top-k sampling over the same cache machinery.
-    ``top_k=0`` samples the full distribution; ``top_k=1`` or
-    ``temperature=0`` degenerate to greedy."""
+    """Temperature / top-k sampling over the same cache machinery, with
+    the sampler FUSED into the decode scan body (sample_token): sampled
+    decode compiles to the same single scan as greedy — no per-token XLA
+    re-entry, no full-vocab categorical. ``top_k=0`` samples the full
+    distribution; ``top_k=1`` or ``temperature=0`` degenerate to
+    greedy."""
     assert 0 <= top_k <= config.vocab_size, (
         f"top_k={top_k} out of range for vocab {config.vocab_size}"
     )
     if temperature <= 0.0 or top_k == 1:
         return greedy_generate(
-            config, params, prompt, max_new_tokens, max_seq
+            config, params, prompt, max_new_tokens, max_seq,
+            kv_quant=kv_quant,
         )
 
     def pick(logits, i):
-        step_rng = jax.random.fold_in(rng, i)
-        scaled = logits / temperature
-        if top_k > 0:
-            kth = lax.top_k(scaled, top_k)[0][:, -1:]
-            scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
-        return jax.random.categorical(step_rng, scaled, axis=-1)
+        return sample_token(
+            logits, jax.random.fold_in(rng, i), temperature, top_k
+        )
 
     return _generate(
-        config, params, prompt, max_new_tokens, max_seq, pick=pick
+        config, params, prompt, max_new_tokens, max_seq, pick=pick,
+        kv_quant=kv_quant,
     )
+
+
+def sample_generate_unfused(
+    config: LlamaConfig,
+    params: dict,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    rng: jnp.ndarray,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    max_seq: int = 0,
+    kv_quant: str = "none",
+) -> jnp.ndarray:
+    """The pre-fusion serving loop: one XLA entry per generated token (a
+    host round-trip between steps). Kept as the parity oracle for the
+    fused path — same fold_in schedule, same sample_token math — so a
+    fixed key must produce TOKEN-IDENTICAL output to sample_generate
+    (pinned by tests/test_workloads.py::test_fused_sampler_parity)."""
+    assert 0 <= top_k <= config.vocab_size
+    if temperature <= 0.0 or top_k == 1:
+        return greedy_generate(
+            config, params, prompt, max_new_tokens, max_seq,
+            kv_quant=kv_quant,
+        )
+    b, s = prompt.shape
+    if not max_seq:
+        # Same 64-granule auto-sizing as _generate: the parity contract
+        # is bit-level, so the cache (and the decode block size derived
+        # from it) must match exactly.
+        max_seq = -(-(s + max_new_tokens) // 64) * 64
+    assert max_new_tokens >= 1 and max_seq >= s + max_new_tokens
+    cache = init_cache(
+        config, b, max_seq, stacked="layers" in params, kv_quant=kv_quant
+    )
+    cache, logits = forward_chunk(config, params, cache, prompt)
+    tok = sample_token(
+        logits[:, -1], jax.random.fold_in(rng, 0), temperature, top_k
+    ).astype(prompt.dtype)
+    out = [tok]
+    for i in range(1, max_new_tokens):
+        cache, logits = forward_chunk(config, params, cache, tok[:, None])
+        tok = sample_token(
+            logits[:, -1], jax.random.fold_in(rng, i), temperature, top_k
+        ).astype(prompt.dtype)
+        out.append(tok)
+    return jnp.concatenate([prompt] + [t[:, None] for t in out], axis=1)

@@ -57,6 +57,11 @@ class LlamaConfig:
     # the model's return_hidden path.
     fused_ce: bool = False
     ce_chunk: int = 256
+    # Serving path (workloads/generate.py): fused single-query decode
+    # attention dispatch ("auto" | "pallas" | "xla" | "reference") and
+    # its cache-length chunk size (ops/attention.py decode_attention).
+    decode_impl: str = "auto"
+    decode_block_k: int = 256
 
     @property
     def head_dim(self) -> int:

@@ -27,6 +27,7 @@ import logging
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 log = logging.getLogger(__name__)
 
@@ -648,3 +649,344 @@ def attention(
     if impl == "pallas":
         return _flash_attention(q, k, v, causal, block_q, block_k)
     return reference_attention(q, k, v, causal)
+
+
+# --- fused decode attention (single-query serving path) ---------------------
+#
+# The decode hot path is one query row per sequence against a static
+# [b, max_seq, kvh, hd] cache of which only the first `length` positions
+# are live. The generic paths above pay for what decode does not need:
+# reference_attention materializes an n_rep-repeated K/V copy plus a
+# [b, h, 1, max_seq] fp32 score/prob tensor per layer per token, and
+# always contracts the full max_seq extent regardless of `length`.
+#
+# decode_attention is the GQA-native replacement: queries are grouped
+# kv-major (head i -> group i // n_rep, the _repeat_kv order) and
+# contracted straight against the ungrouped cache, with a flash-decode
+# style online softmax split over the cache length so
+#   - no repeated K/V and no full-length fp32 score tensor exist, and
+#   - compute stops at the last block that contains a live position
+#     (the length-aware mask: the zero-tail invariant documented on
+#     DecodeCache means slots >= length hold nothing worth reading).
+#
+# The cache may be int8 (quantize.quantize_kv): per-(token, head) scales
+# ride along and dequantization happens inside the contraction — scores
+# multiply by k_scale per key column, probabilities by v_scale before the
+# value dot — so no dequantized KV copy is ever materialized.
+#
+# Dispatch mirrors attention(): "pallas" is a single-query kernel (one
+# grid program per (batch, kv head), scalar-prefetched length bounding
+# the KV loop), "xla" is a dynamic-trip-count chunked loop with the same
+# online-softmax math, "reference" is the naive masked softmax oracle.
+
+_LAST_DECODE_IMPL = None  # set at trace time; decodebench asserts on it
+
+
+def _group_scale(s: "jnp.ndarray | None"):
+    """[b, skv, kvh] per-key scale -> [b, kvh, 1, skv] broadcastable
+    against grouped [b, kvh, n_rep, skv] scores (None passes through)."""
+    return None if s is None else s.transpose(0, 2, 1)[:, :, None, :]
+
+
+def reference_decode_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    length: jnp.ndarray,
+    k_scale=None,
+    v_scale=None,
+    extra_k=None,
+    extra_v=None,
+) -> jnp.ndarray:
+    """Naive fp32 oracle. q: [b, h, hd]; k/v: [b, skv, kvh, hd] (model
+    dtype, or int8 with [b, skv, kvh] scales). Keys [0, cache_len) are
+    live, where cache_len = length - 1 when ``extra_k``/``extra_v``
+    ([b, kvh, hd]) carry the newest token's K/V out-of-cache (the
+    stacked-layout decode step, whose streamed cache is stale at the
+    current position) and cache_len = length otherwise."""
+    b, h, hd = q.shape
+    kvh = k.shape[2]
+    n_rep = h // kvh
+    scale = hd ** -0.5
+    cache_len = length - (0 if extra_k is None else 1)
+    qg = q.reshape(b, kvh, n_rep, hd).astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    logits = jnp.einsum("bhrd,bkhd->bhrk", qg, kf) * scale
+    if k_scale is not None:
+        logits = logits * _group_scale(k_scale)
+    mask = jnp.arange(k.shape[1])[None, None, None, :] < cache_len
+    logits = jnp.where(mask, logits, NEG_INF)
+    if extra_k is not None:
+        el = jnp.einsum(
+            "bhrd,bhd->bhr", qg, extra_k.astype(jnp.float32)
+        )[..., None] * scale
+        logits = jnp.concatenate([logits, el], axis=-1)
+    probs = jax.nn.softmax(logits, axis=-1)
+    pc = probs[..., : k.shape[1]]
+    if v_scale is not None:
+        pc = pc * _group_scale(v_scale)
+    out = jnp.einsum("bhrk,bkhd->bhrd", pc, vf)
+    if extra_v is not None:
+        # probs[..., -1:] is [b, kvh, n_rep, 1]; broadcast against the
+        # rep axis of extra_v [b, kvh, 1, hd].
+        out = out + probs[..., -1:] * extra_v.astype(jnp.float32)[:, :, None, :]
+    return out.reshape(b, h, hd).astype(q.dtype)
+
+
+def _xla_decode_attention(
+    q, k, v, length, k_scale, v_scale, extra_k, extra_v, block_k: int,
+):
+    """Length-aware chunked online softmax (the XLA serving path): a
+    dynamic-trip-count loop over KV blocks stops at the last block with a
+    live position, carrying fp32 (m, l, acc) — the only per-step score
+    state is [b, kvh, n_rep, block_k], never [b, h, max_seq] fp32."""
+    b, h, hd = q.shape
+    skv, kvh = k.shape[1], k.shape[2]
+    n_rep = h // kvh
+    scale = hd ** -0.5
+    cache_len = length - (0 if extra_k is None else 1)
+    num_blocks = lax.div(cache_len + (block_k - 1), block_k)
+    qg = q.reshape(b, kvh, n_rep, hd)
+
+    m0 = jnp.full((b, kvh, n_rep), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, kvh, n_rep), jnp.float32)
+    acc0 = jnp.zeros((b, kvh, n_rep, hd), jnp.float32)
+
+    def body(i, carry):
+        m, l, acc = carry
+        start = i * block_k
+        kb = lax.dynamic_slice(k, (0, start, 0, 0), (b, block_k, kvh, hd))
+        vb = lax.dynamic_slice(v, (0, start, 0, 0), (b, block_k, kvh, hd))
+        s = jnp.einsum(
+            "bhrd,bkhd->bhrk", qg, kb.astype(qg.dtype),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if k_scale is not None:
+            ksb = lax.dynamic_slice(k_scale, (0, start, 0), (b, block_k, kvh))
+            s = s * _group_scale(ksb)
+        cols = start + jnp.arange(block_k)
+        s = jnp.where(cols[None, None, None, :] < cache_len, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        if v_scale is not None:
+            vsb = lax.dynamic_slice(v_scale, (0, start, 0), (b, block_k, kvh))
+            p = p * _group_scale(vsb)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhrk,bkhd->bhrd", p.astype(qg.dtype), vb.astype(qg.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = lax.fori_loop(0, num_blocks, body, (m0, l0, acc0))
+    if extra_k is not None:
+        # The newest token's K/V enter as one exact (unquantized) online
+        # update — no cache copy, no concat.
+        se = jnp.einsum(
+            "bhrd,bhd->bhr", qg, extra_k.astype(qg.dtype),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        m_new = jnp.maximum(m, se)
+        alpha = jnp.exp(m - m_new)
+        pe = jnp.exp(se - m_new)
+        l = l * alpha + pe
+        acc = acc * alpha[..., None] + (
+            pe[..., None] * extra_v.astype(jnp.float32)[:, :, None]
+        )
+        m = m_new
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.reshape(b, h, hd).astype(q.dtype)
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *rest, block_k: int,
+                   skv: int, scale: float, quantized: bool):
+    """One (batch * kv_head) program: online softmax of the n_rep grouped
+    query rows over KV blocks, loop-bounded by the scalar-prefetched live
+    length (blocks past the last live position are never touched — the
+    kernel-side form of the length-aware mask). int8 caches dequantize in
+    flight: k_scale multiplies the score columns, v_scale the
+    probabilities, so only int8 bytes cross HBM."""
+    import jax.experimental.pallas as pl
+
+    if quantized:
+        ks_ref, vs_ref, o_ref = rest
+    else:
+        o_ref = rest[0]
+    q = q_ref[0]  # [n_rep, hd], model dtype
+    length = len_ref[0]
+    num_visible = lax.div(length + (block_k - 1), block_k)
+
+    n_rep = q.shape[0]
+    m0 = jnp.full((n_rep,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((n_rep,), jnp.float32)
+    acc0 = jnp.zeros(q.shape, jnp.float32)
+
+    def body(ki, carry):
+        m, l, acc = carry
+        kb = k_ref[0, pl.ds(ki * block_k, block_k), :]
+        vb = v_ref[0, pl.ds(ki * block_k, block_k), :]
+        s = jnp.dot(
+            q, kb.astype(q.dtype).T, preferred_element_type=jnp.float32
+        ) * scale
+        if quantized:
+            s = s * ks_ref[0, 0, pl.ds(ki * block_k, block_k)][None, :]
+        cols = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(cols < length, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        if quantized:
+            p = p * vs_ref[0, 0, pl.ds(ki * block_k, block_k)][None, :]
+        acc_new = acc * alpha[:, None] + jnp.dot(
+            p.astype(q.dtype), vb.astype(q.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = lax.fori_loop(0, num_visible, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _pallas_decode_attention(q, k, v, length, k_scale, v_scale, block_k):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, hd = q.shape
+    skv, kvh = k.shape[1], k.shape[2]
+    n_rep = h // kvh
+    quantized = k_scale is not None
+
+    qg = q.reshape(b, kvh, n_rep, hd).reshape(b * kvh, n_rep, hd)
+    kg = _group_kv(k)  # [b*kvh, skv, hd]
+    vg = _group_kv(v)
+    length_arr = jnp.full((1,), length, jnp.int32)
+
+    # Index maps under PrefetchScalarGridSpec also receive the prefetched
+    # scalar refs after the grid indices; this one only needs the head.
+    head_block = lambda i, *_: (i, 0, 0)  # noqa: E731
+    in_specs = [
+        pl.BlockSpec((1, n_rep, hd), head_block, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, skv, hd), head_block, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, skv, hd), head_block, memory_space=pltpu.VMEM),
+    ]
+    args = [qg, kg, vg]
+    if quantized:
+        # [b*kvh, 1, skv]: the singleton axis keeps the block 2D for
+        # mosaic (same trick as the flash kernels' lse rows).
+        args.append(_group_kv(k_scale[..., None])[:, None, :, 0])
+        args.append(_group_kv(v_scale[..., None])[:, None, :, 0])
+        in_specs.extend([
+            pl.BlockSpec((1, 1, skv), head_block, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, skv), head_block, memory_space=pltpu.VMEM),
+        ])
+    kernel = functools.partial(
+        _decode_kernel, block_k=block_k, skv=skv, scale=hd ** -0.5,
+        quantized=quantized,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b * kvh,),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(
+                (1, n_rep, hd), head_block, memory_space=pltpu.VMEM
+            ),
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * kvh, n_rep, hd), q.dtype),
+        interpret=_INTERPRET,
+    )(length_arr, *args)
+    return out.reshape(b, h, hd)
+
+
+def _decode_block_k(skv: int, block_k: int) -> int:
+    """Largest divisor of skv at most block_k (the chunked paths index
+    blocks at i*block_k, so block_k must divide skv or the tail block
+    would read out of bounds). Trace-time only. Awkward cache lengths
+    (primes) necessarily degrade toward 1 — generate._generate rounds
+    auto-sized caches up to a 64 granule so the serving path never hits
+    that (padded slots are inert under the length mask)."""
+    for bk in range(min(block_k, skv), 0, -1):
+        if skv % bk == 0:
+            return bk
+    return 1
+
+
+def _decode_pallas_ok(k, skv: int, hd: int, block_k: int,
+                      extra_k) -> bool:
+    if extra_k is not None or not flash_platform_ok():
+        return False  # stacked-layout stale caches take the XLA path
+    if hd % 64 or skv % block_k:
+        return False
+    return flash_vmem_ok(k)
+
+
+def decode_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    length,
+    k_scale=None,
+    v_scale=None,
+    extra_k=None,
+    extra_v=None,
+    impl: str = "auto",
+    block_k: int = 256,
+) -> jnp.ndarray:
+    """Fused single-query GQA attention over a static KV cache.
+
+    q: [b, h, hd] (ONE query per sequence — the decode step);
+    k/v: [b, max_seq, kvh, hd] cache, model dtype or int8 with
+    per-(token, head) ``k_scale``/``v_scale`` [b, max_seq, kvh];
+    length: traced int32 scalar — keys at positions >= length are dead
+    and are neither read (full blocks) nor admitted (masked tail block);
+    extra_k/extra_v: [b, kvh, hd] newest-token K/V not yet in the cache
+    (position length-1) — the stacked layout's streamed-cache decode;
+    impl: "auto" | "pallas" | "xla" | "reference" (naive fp32 oracle).
+
+    Returns [b, h, hd] in q's dtype.
+    """
+    b, h, hd = q.shape
+    if k.shape[0] != b or v.shape != k.shape or k.shape[3] != hd:
+        raise ValueError(
+            f"decode cache shape mismatch: q {q.shape} vs k {k.shape} "
+            f"v {v.shape}"
+        )
+    kvh = k.shape[2]
+    if h % kvh:
+        raise ValueError(
+            f"query heads ({h}) must be a multiple of kv heads ({kvh})"
+        )
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be provided together")
+    if (extra_k is None) != (extra_v is None):
+        raise ValueError("extra_k and extra_v must be provided together")
+    skv = k.shape[1]
+    bk = _decode_block_k(skv, block_k)
+    if impl == "auto":
+        impl = (
+            "pallas"
+            if _decode_pallas_ok(k, skv, hd, bk, extra_k)
+            else "xla"
+        )
+    global _LAST_DECODE_IMPL
+    _LAST_DECODE_IMPL = impl
+    if impl == "pallas":
+        if extra_k is not None:
+            raise ValueError(
+                "the pallas decode kernel does not take extra_k/extra_v "
+                "(stacked-layout stale caches); use impl='xla' or 'auto'"
+            )
+        return _pallas_decode_attention(q, k, v, length, k_scale, v_scale, bk)
+    if impl == "xla":
+        return _xla_decode_attention(
+            q, k, v, length, k_scale, v_scale, extra_k, extra_v, bk
+        )
+    if impl == "reference":
+        return reference_decode_attention(
+            q, k, v, length, k_scale, v_scale, extra_k, extra_v
+        )
+    raise ValueError(f"unknown decode attention impl: {impl!r}")

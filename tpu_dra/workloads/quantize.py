@@ -76,3 +76,39 @@ def quantize_params(params: dict) -> dict:
 def dequantize_weight(q: dict) -> jnp.ndarray:
     """Exact inverse view (f32) — for tests and fallbacks."""
     return q["kernel_q"].astype(jnp.float32) * q["scale"]
+
+
+# --- KV-cache quantization (serving path) -----------------------------------
+#
+# Decode is KV-bandwidth-bound once weights are int8 (BENCH_r05: 1.611 GB
+# of bf16 KV at batch 128 vs 1.04 GB of int8 weights). Symmetric int8
+# with a PER-TOKEN PER-HEAD scale (one f32 per [batch, position, kv_head]
+# row) keeps the rounding error of each head's hd-vector bounded by its
+# own absmax/254 while cutting KV bytes ~2x (hd=64: 64+4 bytes vs 128).
+# Dequantization happens on the fly inside the attention contraction
+# (ops/attention.py decode path; generate.py prefill) — the int8->compute
+# convert fuses into the dot feed, so no dequantized KV copy ever lands
+# in HBM.
+
+
+def quantize_kv(x: jnp.ndarray) -> tuple:
+    """x [..., heads, head_dim] -> (int8 same shape, f32 scale [..., heads]).
+
+    Symmetric per-(token, head) scale over the head_dim axis. An all-zero
+    row quantizes to zeros with scale 0 — NOT 1 — so freshly-zeroed cache
+    tails keep the zero-tail invariant checkable on the scale arrays too.
+    """
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = absmax / 127.0
+    q = jnp.clip(
+        jnp.round(xf / jnp.where(scale > 0, scale, 1.0)[..., None]),
+        -127, 127,
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    """Exact inverse view (f32) — for tests and the reference decode
+    attention path."""
+    return q.astype(jnp.float32) * scale[..., None]
