@@ -1,296 +1,26 @@
 #!/usr/bin/env python3
-"""Minimal AST linter — the `make lint` gate.
+"""Thin CLI shim over the hack/lints/ static-analysis suite.
 
-The reference gates merges on golangci-lint (.golangci.yaml via
-.github/workflows/golang.yaml:45-75). This environment ships no Python
-linter (no ruff/flake8/pyflakes) and installs are not allowed, so the
-same bar is enforced with a small, deterministic checker over the rules
-that catch real bugs rather than style:
-
-  F401  unused import
-  F811  redefinition of a top-level name by a later def/class
-  E722  bare `except:`
-  B006  mutable default argument (list/dict/set literals)
-  F541  f-string without any placeholders
-  W605  invalid escape sequence in a non-raw string literal (via
-        compile() in default warnings-as-errors mode per file)
-
-Beyond Python, the gate also validates chaos fault-schedule documents
-(``*.chaos.json``, the format infra/chaos.py replays) found under the
-lint roots — the check_bench_schema.py treatment: a schedule that names
-an unknown fault kind, drops a required param, or never recovers a
-downed chip fails `make lint`, not a 2am soak:
-
-  C900  unreadable / invalid JSON
-  C901  schema violation (from tpu_dra.infra.chaos.validate_schedule)
-
-When bench.py is among the lint targets, its final JSON line is held to
-a SUPERSET rule against the most recent recorded BENCH_r*.json artifact
-(r6, ISSUE 2): every top-level key the last round emitted must still be
-a key of the dict literal bench.py prints — downstream BENCH parsing
-and cross-round comparisons never break on a silent rename/drop:
-
-  B100  bench.py's final JSON dict dropped a key the last BENCH_r*.json
-        artifact carries
-
-Zero findings = exit 0. Any finding prints `path:line: CODE message`
-and exits 1, exactly like a linter in CI.
+The 6-rule AST checker that used to live here grew into a multi-pass,
+driver-aware analysis package (ISSUE 3) — see hack/lints/__init__.py
+for the pass inventory and docs/static-analysis.md for every code's
+rationale. This file stays so `make lint`, CI, and any direct
+`python hack/lint.py ...` invocation keep working unchanged; the
+legacy codes (F401/F811/E722/B006/F541/W605/C90x/B100) print in the
+same `path:line: CODE message` format as before.
 """
 
 from __future__ import annotations
 
-import ast
 import sys
-import warnings
 from pathlib import Path
 
-CODES_DISABLED_MARKER = "# lint: disable="
+# Make `lints` importable both as `python hack/lint.py` and from tests.
+_HACK = str(Path(__file__).resolve().parent)
+if _HACK not in sys.path:
+    sys.path.insert(0, _HACK)
 
-
-def _disabled(source_line: str) -> set:
-    if CODES_DISABLED_MARKER not in source_line:
-        return set()
-    return set(
-        source_line.split(CODES_DISABLED_MARKER, 1)[1].strip().split(",")
-    )
-
-
-class _Visitor(ast.NodeVisitor):
-    def __init__(self, path: Path, lines: list):
-        self.path = path
-        self.lines = lines
-        self.findings: list = []
-        # name -> (lineno, used?) for imports at MODULE level only —
-        # function-local import tracking has too many legitimate
-        # late-binding patterns in this codebase (jax-under-jit).
-        self.imports: dict = {}
-        self.used_names: set = set()
-        self.toplevel_defs: dict = {}
-
-    def add(self, lineno: int, code: str, msg: str) -> None:
-        src = self.lines[lineno - 1] if lineno - 1 < len(self.lines) else ""
-        if code in _disabled(src):
-            return
-        self.findings.append((self.path, lineno, code, msg))
-
-    # --- imports ---
-
-    def visit_Module(self, node: ast.Module) -> None:
-        for stmt in node.body:
-            if isinstance(stmt, ast.Import):
-                for a in stmt.names:
-                    name = (a.asname or a.name).split(".")[0]
-                    self.imports[name] = stmt.lineno
-            elif isinstance(stmt, ast.ImportFrom):
-                if stmt.module == "__future__":
-                    continue  # used implicitly by the compiler
-                for a in stmt.names:
-                    if a.name == "*":
-                        continue
-                    self.imports[a.asname or a.name] = stmt.lineno
-            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                   ast.ClassDef)):
-                prev = self.toplevel_defs.get(stmt.name)
-                if prev is not None:
-                    self.add(
-                        stmt.lineno, "F811",
-                        f"redefinition of {stmt.name!r} "
-                        f"(first defined at line {prev})",
-                    )
-                self.toplevel_defs[stmt.name] = stmt.lineno
-        self.generic_visit(node)
-
-    def visit_Name(self, node: ast.Name) -> None:
-        if isinstance(node.ctx, ast.Load):
-            self.used_names.add(node.id)
-        self.generic_visit(node)
-
-    def visit_Attribute(self, node: ast.Attribute) -> None:
-        # `pkg.mod.attr` marks `pkg` used via the Name child; nothing
-        # extra needed, but keep walking.
-        self.generic_visit(node)
-
-    # --- hazards ---
-
-    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
-        if node.type is None:
-            self.add(node.lineno, "E722", "bare `except:`")
-        self.generic_visit(node)
-
-    def _check_defaults(self, node) -> None:
-        for d in list(node.args.defaults) + [
-            d for d in node.args.kw_defaults if d is not None
-        ]:
-            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
-                self.add(
-                    d.lineno, "B006",
-                    "mutable default argument (shared across calls)",
-                )
-
-    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
-        self._check_defaults(node)
-        self.generic_visit(node)
-
-    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
-        self._check_defaults(node)
-        self.generic_visit(node)
-
-    def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
-        if not any(isinstance(v, ast.FormattedValue) for v in node.values):
-            self.add(node.lineno, "F541", "f-string without placeholders")
-        self.generic_visit(node)
-
-    def visit_FormattedValue(self, node: ast.FormattedValue) -> None:
-        # Do NOT recurse into format_spec: `{x:.1f}` carries a nested
-        # placeholder-less JoinedStr ('.1f') that is not an f-string.
-        self.visit(node.value)
-
-    def finish(self, tree: ast.Module, source: str) -> None:
-        # __all__ and doctest-style re-exports count as uses.
-        exported = set()
-        for stmt in tree.body:
-            if (
-                isinstance(stmt, ast.Assign)
-                and any(
-                    isinstance(t, ast.Name) and t.id == "__all__"
-                    for t in stmt.targets
-                )
-                and isinstance(stmt.value, (ast.List, ast.Tuple))
-            ):
-                exported.update(
-                    e.value for e in stmt.value.elts
-                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
-                )
-        for name, lineno in self.imports.items():
-            if name in self.used_names or name in exported:
-                continue
-            if name.startswith("_"):
-                continue
-            src = self.lines[lineno - 1] if lineno - 1 < len(self.lines) else ""
-            if "noqa" in src:
-                continue
-            self.add(lineno, "F401", f"{name!r} imported but unused")
-
-
-def lint_file(path: Path) -> list:
-    source = path.read_text(encoding="utf-8", errors="replace")
-    with warnings.catch_warnings():
-        # W605: DeprecationWarning/SyntaxWarning for bad escapes.
-        warnings.simplefilter("error", SyntaxWarning)
-        warnings.simplefilter("error", DeprecationWarning)
-        try:
-            compile(source, str(path), "exec")
-        except SyntaxError as e:
-            return [(path, e.lineno or 0, "E999", f"syntax error: {e.msg}")]
-        except (SyntaxWarning, DeprecationWarning) as e:
-            return [(path, 0, "W605", str(e))]
-    tree = ast.parse(source)
-    v = _Visitor(path, source.splitlines())
-    v.visit(tree)
-    v.finish(tree, source)
-    return v.findings
-
-
-def lint_chaos_schedule(path: Path) -> list:
-    """Validate one ``*.chaos.json`` fault schedule against the shared
-    schema (tpu_dra.infra.chaos.validate_schedule — one source of truth
-    for the loader and this gate)."""
-    import json
-
-    repo_root = str(Path(__file__).resolve().parent.parent)
-    if repo_root not in sys.path:
-        sys.path.insert(0, repo_root)
-    from tpu_dra.infra.chaos import validate_schedule
-
-    try:
-        data = json.loads(path.read_text(encoding="utf-8"))
-    except (OSError, ValueError) as e:
-        return [(path, 0, "C900", f"invalid JSON: {e}")]
-    return [(path, 0, "C901", err) for err in validate_schedule(data)]
-
-
-def _static_bench_keys(tree: ast.Module) -> set:
-    """Top-level keys of the LARGEST dict literal passed to json.dumps —
-    the final result line printed by bench.py's main() (the per-leg
-    result dicts are all much smaller; if that ever stops holding, this
-    check fails loud via missing keys rather than passing silently)."""
-    best: set = set()
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Attribute)
-            and node.func.attr == "dumps"
-            and node.args
-            and isinstance(node.args[0], ast.Dict)
-        ):
-            keys = {
-                k.value
-                for k in node.args[0].keys
-                if isinstance(k, ast.Constant) and isinstance(k.value, str)
-            }
-            if len(keys) > len(best):
-                best = keys
-    return best
-
-
-def lint_bench_keys(path: Path) -> list:
-    """B100: the bench result schema only grows. Compare the dict
-    literal bench.py prints as its final JSON line against the newest
-    recorded BENCH_r*.json (driver artifacts wrap the line under
-    "parsed") — any key the last round carried must survive."""
-    import json
-
-    artifacts = sorted(path.resolve().parent.glob("BENCH_r*.json"))
-    if not artifacts:
-        return []
-    last = artifacts[-1]
-    try:
-        data = json.loads(last.read_text(encoding="utf-8"))
-    except (OSError, ValueError) as e:
-        return [(last, 0, "C900", f"invalid JSON: {e}")]
-    if isinstance(data.get("parsed"), dict):
-        data = data["parsed"]
-    static = _static_bench_keys(ast.parse(path.read_text(encoding="utf-8")))
-    return [
-        (
-            path, 0, "B100",
-            f"final JSON dict dropped key {k!r} present in {last.name} "
-            f"(bench schema is append-only)",
-        )
-        for k in sorted(set(data) - static)
-    ]
-
-
-def main(argv: list) -> int:
-    roots = [Path(a) for a in argv] or [Path("tpu_dra"), Path("tests")]
-    files: list = []
-    schedules: list = []
-    for root in roots:
-        if root.is_file():
-            (schedules if root.name.endswith(".chaos.json") else files).append(
-                root
-            )
-        else:
-            files.extend(sorted(root.rglob("*.py")))
-            schedules.extend(sorted(root.rglob("*.chaos.json")))
-    findings = []
-    for f in files:
-        if "/pb/" in str(f):  # protoc output is generated, not linted
-            continue
-        findings.extend(lint_file(f))
-        if f.name == "bench.py":
-            findings.extend(lint_bench_keys(f))
-    for s in schedules:
-        findings.extend(lint_chaos_schedule(s))
-    files = files + schedules
-    for path, lineno, code, msg in findings:
-        print(f"{path}:{lineno}: {code} {msg}")
-    print(
-        f"lint: {len(files)} files, {len(findings)} finding(s)",
-        file=sys.stderr,
-    )
-    return 1 if findings else 0
-
+from lints.cli import main  # noqa: E402
 
 if __name__ == "__main__":
     raise SystemExit(main(sys.argv[1:]))

@@ -1,0 +1,32 @@
+"""Driver-aware static analysis suite behind `make lint`.
+
+The package grew out of hack/lint.py's 6-rule checker (ISSUE 3): the
+reference driver gates merges on golangci-lint plus the race detector,
+and the bug classes this repo actually grows — shared-state races in
+the plugin/daemon/workqueue layer, host-sync and traced-branching
+hazards on the serving path, feature-gated code reachable without its
+gate — are exactly the ones a generic style linter cannot see. Each
+pass lives in its own module and registers itself with
+:mod:`lints.registry`; :mod:`lints.cli` discovers files, builds one
+cached :class:`lints.base.FileContext` per file (AST + scope model,
+shared by every pass), applies the suppression baseline
+(hack/lint-baseline.json — shrink-only, enforced by the linter), and
+prints ``path:line: CODE message`` findings plus per-pass timing.
+
+Passes (see docs/static-analysis.md for the full rationale):
+
+  core   F401/F811/E722/B006/F541/W605/E999  (byte-identical to the
+         pre-package hack/lint.py output)
+  F821   scoped undefined-name resolution
+  R200   lock-discipline race lint for thread-spawning classes
+  J300   JAX tracer-safety (tpu_dra/workloads only)
+  G400   feature-gate dominance for gate-registered subsystems
+  L500   import layering / cycle check from the declared layer DAG
+  A600   blocking calls inside ``async def``
+  C90x   chaos fault-schedule JSON validation
+  B100   bench.py result-schema is append-only
+  B90x   baseline hygiene (stale entries, baseline growth)
+"""
+
+from lints.base import Finding, FileContext  # noqa: F401  (public API)
+from lints.registry import all_passes, register  # noqa: F401

@@ -1,0 +1,83 @@
+"""A600 — blocking call inside ``async def``.
+
+One synchronous sleep / subprocess / HTTP call inside a coroutine
+stalls the entire event loop — every other task in the process stops
+making progress, which in a kubelet plugin means missed watch events
+and leases expiring. Flagged calls:
+
+- ``time.sleep`` (use ``asyncio.sleep``),
+- ``subprocess.run/call/check_call/check_output/Popen`` (use
+  ``asyncio.create_subprocess_exec``),
+- ``urllib.request.urlopen`` / ``requests.*`` (use an executor or an
+  async client),
+- ``socket.create_connection``.
+
+Sync work that genuinely must run from a coroutine belongs in
+``loop.run_in_executor`` (which is what the pass suggests).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from lints.base import FileContext, Finding, add_finding, dotted_name
+from lints.registry import register
+
+BLOCKING_CALLS = {
+    "time.sleep": "asyncio.sleep",
+    "subprocess.run": "asyncio.create_subprocess_exec",
+    "subprocess.call": "asyncio.create_subprocess_exec",
+    "subprocess.check_call": "asyncio.create_subprocess_exec",
+    "subprocess.check_output": "asyncio.create_subprocess_exec",
+    "subprocess.Popen": "asyncio.create_subprocess_exec",
+    "urllib.request.urlopen": "loop.run_in_executor",
+    "socket.create_connection": "loop.run_in_executor",
+}
+BLOCKING_PREFIXES = ("requests.",)
+
+
+@register
+class AsyncBlockingPass:
+    name = "A600"
+    codes = ("A600",)
+    scope = "file"
+
+    def run(self, ctx: FileContext) -> List[Finding]:
+        if ctx.tree is None:
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                self._check_async_body(node, ctx, out)
+        out.sort(key=lambda f: f.lineno)
+        return out
+
+    def _check_async_body(
+        self, fn: ast.AsyncFunctionDef, ctx: FileContext, out: List[Finding]
+    ) -> None:
+        # Walk the coroutine body but do NOT descend into nested sync
+        # defs (they may be handed to run_in_executor — that's the fix).
+        stack: List[ast.AST] = list(fn.body)
+        while stack:
+            node = stack.pop()
+            # Nested sync defs may be executor-bound (that's the fix);
+            # nested ASYNC defs are visited separately by run().
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(node, ast.Call):
+                callee = dotted_name(node.func)
+                hint = BLOCKING_CALLS.get(callee)
+                if hint is None and callee.startswith(BLOCKING_PREFIXES):
+                    hint = "an async client or loop.run_in_executor"
+                if hint is not None:
+                    add_finding(
+                        out, ctx, node.lineno, "A600",
+                        f"blocking call `{callee}(...)` inside `async "
+                        f"def {fn.name}` stalls the event loop — use "
+                        f"{hint}",
+                    )
+            for child in ast.iter_child_nodes(node):
+                stack.append(child)

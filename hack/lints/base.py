@@ -1,0 +1,120 @@
+"""Shared per-file context and finding model for every lint pass.
+
+One :class:`FileContext` is built per Python file and handed to every
+registered pass: the source, line table, AST, and (lazily, built at
+most once) the scope model from :mod:`lints.scopes`. Passes therefore
+never re-read or re-parse a file — with ~10 passes over ~250 files the
+parse cost is paid exactly once per file, which is what keeps the full
+suite inside a `make lint` inner loop.
+"""
+
+from __future__ import annotations
+
+import ast
+import warnings
+from pathlib import Path
+from typing import List, NamedTuple, Optional
+
+CODES_DISABLED_MARKER = "# lint: disable="
+
+
+class Finding(NamedTuple):
+    path: Path
+    lineno: int
+    code: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.lineno}: {self.code} {self.message}"
+
+
+def disabled_codes(source_line: str) -> set:
+    """Codes suppressed on this line via ``# lint: disable=C1,C2``.
+
+    Anything after the first whitespace is a free-form justification:
+    ``x = 1  # lint: disable=R200 (thread-confined; see _run)``.
+    """
+    if CODES_DISABLED_MARKER not in source_line:
+        return set()
+    rest = source_line.split(CODES_DISABLED_MARKER, 1)[1].strip()
+    codes = rest.split(None, 1)[0] if rest else ""
+    return set(codes.split(","))
+
+
+class FileContext:
+    """Parsed view of one Python file, shared by all passes."""
+
+    def __init__(self, path: Path, repo_root: Path):
+        self.path = path
+        self.repo_root = repo_root
+        self.source = path.read_text(encoding="utf-8", errors="replace")
+        self.lines: List[str] = self.source.splitlines()
+        # Parse errors are reported by the core pass (E999); passes must
+        # treat ``tree is None`` as "skip this file".
+        self.tree: Optional[ast.Module] = None
+        try:
+            with warnings.catch_warnings():
+                # Escape-sequence warnings are the core pass's job
+                # (W605/E999 via compile-with-errors); parsing here must
+                # neither print them nor poison the warning registry.
+                warnings.simplefilter("ignore")
+                self.tree = ast.parse(self.source)
+        except SyntaxError:
+            pass
+        self._scopes = None
+
+    @property
+    def rel_path(self) -> str:
+        """Repo-relative POSIX path ("tpu_dra/plugin/driver.py")."""
+        try:
+            return self.path.resolve().relative_to(self.repo_root).as_posix()
+        except ValueError:
+            return self.path.as_posix()
+
+    @property
+    def module_name(self) -> str:
+        """Dotted module name for files under the repo root
+        ("tpu_dra.plugin.driver"); "" when not derivable."""
+        rel = self.rel_path
+        if not rel.endswith(".py"):
+            return ""
+        parts = rel[: -len(".py")].split("/")
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(parts)
+
+    def scopes(self):
+        """The file's scope model (lints.scopes.ScopeModel), built once."""
+        if self._scopes is None and self.tree is not None:
+            from lints.scopes import ScopeModel
+
+            self._scopes = ScopeModel(self.tree)
+        return self._scopes
+
+    def line(self, lineno: int) -> str:
+        return self.lines[lineno - 1] if 0 < lineno <= len(self.lines) else ""
+
+    def finding(self, lineno: int, code: str, msg: str) -> Optional[Finding]:
+        """Build a Finding unless the line disables the code."""
+        if code in disabled_codes(self.line(lineno)):
+            return None
+        return Finding(self.path, lineno, code, msg)
+
+
+def add_finding(out: list, ctx: FileContext, lineno: int, code: str,
+                msg: str) -> None:
+    f = ctx.finding(lineno, code, msg)
+    if f is not None:
+        out.append(f)
+
+
+def dotted_name(node: ast.AST) -> str:
+    """"jnp.sum" for Attribute/Name chains; "" for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""

@@ -1,0 +1,168 @@
+"""Suppression baseline — hack/lint-baseline.json, shrink-only.
+
+The baseline exists so a new pass can land with the gate ON while its
+pre-existing findings are burned down, without `# lint: disable=`
+noise at every site. Entries are quotas keyed by repo-relative path
+and code:
+
+    {
+      "_comment": "why each entry is justified",
+      "version": 1,
+      "suppressions": {"tpu_dra/foo.py": {"R200": 2}}
+    }
+
+Shrink-only is enforced by the linter itself, two ways:
+
+- **stale entries fail** (B901): if a file now has FEWER findings of a
+  code than its quota, the run fails until the quota is lowered or
+  the entry removed — a fixed bug permanently shrinks the baseline;
+- **growth vs HEAD fails** (B902): when running in a git checkout,
+  any entry whose quota exceeds the committed baseline's (or that
+  the committed baseline lacks) fails — the baseline can only grow
+  by deliberately committing it first, which review sees as a diff.
+
+E999 (syntax error) and the B9xx codes themselves are never
+baselinable.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from lints.base import Finding
+
+BASELINE_NAME = "lint-baseline.json"
+UNBASELINABLE = {"E999", "B900", "B901", "B902"}
+
+
+def load(path: Path) -> Tuple[Dict[str, Dict[str, int]], List[Finding]]:
+    """(suppressions, findings): B900 findings on a malformed file."""
+    if not path.exists():
+        return {}, []
+    try:
+        data = json.loads(path.read_text(encoding="utf-8"))
+    except (OSError, ValueError) as e:
+        return {}, [Finding(path, 0, "B900", f"invalid baseline: {e}")]
+    supp = data.get("suppressions")
+    if not isinstance(supp, dict):
+        return {}, [Finding(
+            path, 0, "B900", "baseline must carry a 'suppressions' object"
+        )]
+    out: Dict[str, Dict[str, int]] = {}
+    problems: List[Finding] = []
+    for file_key, codes in supp.items():
+        if not isinstance(codes, dict):
+            problems.append(Finding(
+                path, 0, "B900",
+                f"suppressions[{file_key!r}] must map code -> count",
+            ))
+            continue
+        for code, count in codes.items():
+            if code in UNBASELINABLE:
+                problems.append(Finding(
+                    path, 0, "B900", f"code {code} is not baselinable"
+                ))
+            elif not isinstance(count, int) or count < 1:
+                problems.append(Finding(
+                    path, 0, "B900",
+                    f"suppressions[{file_key!r}][{code!r}] must be a "
+                    f"positive int, got {count!r}",
+                ))
+            else:
+                out.setdefault(file_key, {})[code] = count
+    return out, problems
+
+
+def apply(
+    findings: List[Finding],
+    supp: Dict[str, Dict[str, int]],
+    repo_root: Path,
+    baseline_path: Path,
+    linted_paths: Optional[set] = None,
+    selected_codes: Optional[set] = None,
+) -> Tuple[List[Finding], int]:
+    """Split findings into (reported, suppressed_count); unspent quota
+    becomes a B901 stale-entry finding.
+
+    Staleness is only judged for entries this run could have refilled:
+    files actually linted (``linted_paths``, repo-relative; None = all)
+    and codes actually run (``selected_codes``; None = all) — a
+    `--changed-only` or `--select` partial run must not condemn the
+    rest of the baseline."""
+    budget = {
+        fk: dict(codes) for fk, codes in supp.items()
+    }
+    reported: List[Finding] = []
+    suppressed = 0
+    for f in findings:
+        try:
+            rel = Path(f.path).resolve().relative_to(repo_root).as_posix()
+        except ValueError:
+            rel = Path(f.path).as_posix()
+        quota = budget.get(rel, {}).get(f.code, 0)
+        if f.code not in UNBASELINABLE and quota > 0:
+            budget[rel][f.code] = quota - 1
+            suppressed += 1
+        else:
+            reported.append(f)
+    for rel, codes in sorted(budget.items()):
+        if linted_paths is not None and rel not in linted_paths:
+            # Out of this run's scope — UNLESS the file is gone from
+            # the tree entirely: a deleted file can never refill its
+            # quota, so the entry is dead weight on every run (and a
+            # future file reusing the name would silently consume it).
+            if (repo_root / rel).exists():
+                continue
+            for code in sorted(codes):
+                reported.append(Finding(
+                    baseline_path, 0, "B901",
+                    f"baseline entry {rel}:{code} refers to a file that "
+                    f"no longer exists — delete the entry",
+                ))
+            continue
+        for code, left in sorted(codes.items()):
+            if selected_codes and code not in selected_codes:
+                continue
+            if left > 0:
+                reported.append(Finding(
+                    baseline_path, 0, "B901",
+                    f"stale baseline entry {rel}:{code} ({left} unspent "
+                    f"suppression(s)) — the baseline only shrinks: lower "
+                    f"the count or delete the entry",
+                ))
+    return reported, suppressed
+
+
+def check_growth_vs_head(
+    supp: Dict[str, Dict[str, int]], repo_root: Path, baseline_path: Path
+) -> List[Finding]:
+    """B902 when the working-tree baseline exceeds the committed one."""
+    try:
+        rel = baseline_path.resolve().relative_to(repo_root).as_posix()
+        blob = subprocess.run(
+            ["git", "-C", str(repo_root), "show", f"HEAD:{rel}"],
+            capture_output=True, text=True, timeout=10,
+        )
+    except (OSError, ValueError, subprocess.SubprocessError):
+        return []
+    if blob.returncode != 0:
+        return []  # not committed yet: first landing sets the ceiling
+    try:
+        head = json.loads(blob.stdout).get("suppressions") or {}
+    except ValueError:
+        return []
+    out: List[Finding] = []
+    for fk, codes in sorted(supp.items()):
+        for code, count in sorted(codes.items()):
+            head_count = head.get(fk, {}).get(code, 0)
+            if count > head_count:
+                out.append(Finding(
+                    baseline_path, 0, "B902",
+                    f"baseline grew: {fk}:{code} is {count}, HEAD has "
+                    f"{head_count} — fix the finding or use `# lint: "
+                    f"disable={code}` with a justification instead",
+                ))
+    return out

@@ -1,0 +1,72 @@
+"""B100 — bench.py's result schema is append-only.
+
+Ported unchanged from the pre-package hack/lint.py (r6, ISSUE 2):
+when bench.py is among the lint targets, the top-level keys of the
+dict literal it prints as its final JSON line are held to a SUPERSET
+rule against the newest recorded BENCH_r*.json artifact — downstream
+BENCH parsing and cross-round comparisons never break on a silent
+rename/drop.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from typing import List
+
+from lints.base import FileContext, Finding
+from lints.registry import register
+
+
+def _static_bench_keys(tree: ast.Module) -> set:
+    """Top-level keys of the LARGEST dict literal passed to json.dumps —
+    the final result line printed by bench.py's main() (the per-leg
+    result dicts are all much smaller; if that ever stops holding, this
+    check fails loud via missing keys rather than passing silently)."""
+    best: set = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "dumps"
+            and node.args
+            and isinstance(node.args[0], ast.Dict)
+        ):
+            keys = {
+                k.value
+                for k in node.args[0].keys
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)
+            }
+            if len(keys) > len(best):
+                best = keys
+    return best
+
+
+@register
+class BenchSchemaPass:
+    name = "B100"
+    codes = ("B100", "C900")
+    scope = "file"
+
+    def run(self, ctx: FileContext) -> List[Finding]:
+        if ctx.path.name != "bench.py" or ctx.tree is None:
+            return []
+        artifacts = sorted(ctx.path.resolve().parent.glob("BENCH_r*.json"))
+        if not artifacts:
+            return []
+        last = artifacts[-1]
+        try:
+            data = json.loads(last.read_text(encoding="utf-8"))
+        except (OSError, ValueError) as e:
+            return [Finding(last, 0, "C900", f"invalid JSON: {e}")]
+        if isinstance(data.get("parsed"), dict):
+            data = data["parsed"]
+        static = _static_bench_keys(ctx.tree)
+        return [
+            Finding(
+                ctx.path, 0, "B100",
+                f"final JSON dict dropped key {k!r} present in {last.name} "
+                f"(bench schema is append-only)",
+            )
+            for k in sorted(set(data) - static)
+        ]

@@ -1,0 +1,38 @@
+"""C900/C901 — chaos fault-schedule validation (``*.chaos.json``).
+
+Ported unchanged from the pre-package hack/lint.py: a schedule that
+names an unknown fault kind, drops a required param, or never
+recovers a downed chip fails `make lint`, not a 2am soak. The schema
+source of truth stays tpu_dra.infra.chaos.validate_schedule (shared
+with the loader).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+from typing import List
+
+from lints.base import Finding
+from lints.registry import register
+
+
+@register
+class ChaosSchedulePass:
+    name = "C90x"
+    codes = ("C900", "C901")
+    scope = "special"  # driven by the CLI over *.chaos.json files
+
+    def run_schedule(self, path: Path, repo_root: Path) -> List[Finding]:
+        if str(repo_root) not in sys.path:
+            sys.path.insert(0, str(repo_root))
+        from tpu_dra.infra.chaos import validate_schedule
+
+        try:
+            data = json.loads(path.read_text(encoding="utf-8"))
+        except (OSError, ValueError) as e:
+            return [Finding(path, 0, "C900", f"invalid JSON: {e}")]
+        return [
+            Finding(path, 0, "C901", err) for err in validate_schedule(data)
+        ]

@@ -1,0 +1,231 @@
+"""Linter CLI: file discovery, pass orchestration, timing, baseline.
+
+Invoked through the thin hack/lint.py shim (so `make lint` and every
+direct `python hack/lint.py ...` call keeps working):
+
+    python hack/lint.py [roots...]            # full run
+    python hack/lint.py --changed-only [...]  # inner loop (make lint-fast)
+    python hack/lint.py --select R200,J300    # one pass while iterating
+    python hack/lint.py --no-baseline         # show baselined findings too
+
+Exit 1 on any reported finding, exactly like a linter in CI. Findings
+print as `path:line: CODE message` on stdout; the per-pass timing
+table and the `lint: N files, M finding(s)` summary go to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from lints import baseline as baseline_mod
+from lints.base import FileContext, Finding
+from lints.registry import all_passes
+
+# Importing the pass modules registers them (in suite order: core
+# first so its output ordering matches the pre-package linter).
+from lints import legacy      # noqa: F401
+from lints import names       # noqa: F401
+from lints import races       # noqa: F401
+from lints import tracer      # noqa: F401
+from lints import gates       # noqa: F401
+from lints import layering    # noqa: F401
+from lints import asyncblock  # noqa: F401
+from lints import chaosjson   # noqa: F401
+from lints import benchkeys   # noqa: F401
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+
+
+def _discover(roots: List[Path]):
+    files: List[Path] = []
+    schedules: List[Path] = []
+    for root in roots:
+        if root.is_file():
+            (schedules if root.name.endswith(".chaos.json") else files).append(
+                root
+            )
+        else:
+            files.extend(sorted(root.rglob("*.py")))
+            schedules.extend(sorted(root.rglob("*.chaos.json")))
+    files = [f for f in files if "/pb/" not in str(f)]  # generated protoc
+    return files, schedules
+
+
+def _changed_files() -> Optional[set]:
+    """Repo-relative paths changed vs HEAD (+ untracked); None when git
+    is unavailable (caller falls back to a full run)."""
+    try:
+        diff = subprocess.run(
+            ["git", "-C", str(REPO_ROOT), "diff", "--name-only", "HEAD"],
+            capture_output=True, text=True, timeout=15,
+        )
+        untracked = subprocess.run(
+            ["git", "-C", str(REPO_ROOT), "ls-files", "--others",
+             "--exclude-standard"],
+            capture_output=True, text=True, timeout=15,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if diff.returncode != 0 or untracked.returncode != 0:
+        return None
+    return {
+        line.strip()
+        for out in (diff.stdout, untracked.stdout)
+        for line in out.splitlines()
+        if line.strip()
+    }
+
+
+def _rel(path: Path) -> str:
+    try:
+        return path.resolve().relative_to(REPO_ROOT).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def main(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="hack/lint.py",
+        description="driver-aware static analysis suite (see "
+                    "docs/static-analysis.md)",
+    )
+    ap.add_argument("roots", nargs="*", help="files or directories to lint")
+    ap.add_argument(
+        "--changed-only", action="store_true",
+        help="lint only files changed vs HEAD (plus untracked) — the "
+             "`make lint-fast` inner loop",
+    )
+    ap.add_argument(
+        "--select", default="",
+        help="comma-separated pass names or codes to run (default: all)",
+    )
+    ap.add_argument(
+        "--baseline", default=str(REPO_ROOT / "hack" / "lint-baseline.json"),
+        help="suppression baseline path (shrink-only)",
+    )
+    ap.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline (report every finding)",
+    )
+    args = ap.parse_args(argv)
+
+    roots = [Path(a) for a in args.roots] or [Path("tpu_dra"), Path("tests")]
+    files, schedules = _discover(roots)
+    all_discovered = list(files)
+
+    if args.changed_only:
+        changed = _changed_files()
+        if changed is not None:
+            files = [f for f in files if _rel(f) in changed]
+            schedules = [s for s in schedules if _rel(s) in changed]
+
+    # Layer-DAG config sanity: a bad edit to the declared DAG fails the
+    # linter itself, loudly, before any file is checked.
+    dag_problems = layering.validate_dag()
+    if dag_problems:
+        for p in dag_problems:
+            print(f"hack/lints/layering.py:0: L500 {p}")
+        print("lint: config error", file=sys.stderr)
+        return 1
+
+    selected = {
+        s.strip() for s in args.select.split(",") if s.strip()
+    }
+
+    def pass_enabled(p) -> bool:
+        if not selected:
+            return True
+        return p.name in selected or bool(set(p.codes) & selected)
+
+    passes = [cls() for cls in all_passes()]
+    contexts = [FileContext(f, REPO_ROOT) for f in files]
+
+    # findings bucketed per (file order, pass order) so output stays
+    # grouped by file with the core pass first — the pre-package shape.
+    per_file: Dict[str, List[Finding]] = {str(f): [] for f in files}
+    timings: Dict[str, float] = {}
+    counts: Dict[str, int] = {}
+
+    for pi, p in enumerate(passes):
+        if not pass_enabled(p):
+            continue
+        t0 = time.perf_counter()
+        found: List[Finding] = []
+        if p.scope == "file":
+            for ctx in contexts:
+                for f in p.run(ctx):
+                    per_file.setdefault(str(ctx.path), []).append(f)
+                    found.append(f)
+        elif p.scope == "project":
+            # Project passes see the full discovery set too: a partial
+            # (changed-only) run must not lose cross-file facts like
+            # which modules declare feature gates.
+            for f in p.run_project(contexts, extra_paths=all_discovered):
+                per_file.setdefault(str(f.path), []).append(f)
+                found.append(f)
+        elif p.scope == "special":  # chaos schedules
+            for s in schedules:
+                for f in p.run_schedule(s, REPO_ROOT):
+                    per_file.setdefault(str(s), []).append(f)
+                    found.append(f)
+        timings[p.name] = timings.get(p.name, 0.0) + (
+            time.perf_counter() - t0
+        )
+        counts[p.name] = counts.get(p.name, 0) + len(found)
+
+    ordered: List[Finding] = []
+    emitted = set()
+    for f in files:
+        ordered.extend(per_file.get(str(f), []))
+        emitted.add(str(f))
+    for s in schedules:
+        ordered.extend(per_file.get(str(s), []))
+        emitted.add(str(s))
+    for key, fs in per_file.items():  # anything filed under other paths
+        if key not in emitted:
+            ordered.extend(fs)
+
+    suppressed = 0
+    if not args.no_baseline:
+        bpath = Path(args.baseline)
+        supp, bl_findings = baseline_mod.load(bpath)
+        selected_codes = None
+        if selected:
+            selected_codes = set()
+            for p in passes:
+                if pass_enabled(p):
+                    selected_codes.update(p.codes)
+        ordered, suppressed = baseline_mod.apply(
+            ordered, supp, REPO_ROOT, bpath,
+            linted_paths={_rel(f) for f in files + schedules},
+            selected_codes=selected_codes,
+        )
+        ordered.extend(bl_findings)
+        ordered.extend(
+            baseline_mod.check_growth_vs_head(supp, REPO_ROOT, bpath)
+        )
+
+    for f in ordered:
+        print(f.render())
+
+    total = len(files) + len(schedules)
+    total_ms = sum(timings.values()) * 1000
+    for p in passes:
+        if p.name in timings:
+            print(
+                f"lint: pass {p.name:<5} {timings[p.name] * 1000:8.1f}ms"
+                f"  {counts[p.name]} finding(s)",
+                file=sys.stderr,
+            )
+    extra = f", {suppressed} baselined" if suppressed else ""
+    print(
+        f"lint: {total} files, {len(ordered)} finding(s)"
+        f"{extra} [{total_ms:.0f}ms total]",
+        file=sys.stderr,
+    )
+    return 1 if ordered else 0

@@ -1,0 +1,318 @@
+"""G400 — feature-gate dominance.
+
+A module that implements a feature-gated subsystem declares its gate:
+
+    __feature_gate__ = "AutoRemediation"
+
+Any OTHER module (tests are exempt — they construct gated subsystems
+directly on purpose) that calls a public name imported from a gated
+module must do so under a dominating gate check in the same function:
+
+    if fg.enabled(fg.AUTO_REMEDIATION):
+        self.remediation = RemediationController(...)
+
+or behind an early-return guard:
+
+    if not fg.enabled(fg.AUTO_REMEDIATION):
+        return
+    ctl = RemediationController(...)
+
+Recognized gate-check forms: ``fg.enabled(X)`` / ``featuregates.
+enabled(X)`` / ``enabled(X)`` / ``<anything>.enabled(X)`` where X is
+the gate's name constant (``fg.AUTO_REMEDIATION``) or its string
+literal. The check is intraprocedural by design: a call site whose
+gate is established by its caller documents that with
+``# lint: disable=G400`` (and a reason).
+
+This is a project-scope pass: phase 1 collects ``__feature_gate__``
+declarations across every linted file, phase 2 checks call sites.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List
+
+from lints.base import FileContext, Finding, add_finding, dotted_name
+from lints.registry import register
+
+# "AutoRemediation" -> "AUTO_REMEDIATION" (the constant's name in
+# infra/featuregates.py).
+def _const_name(gate: str) -> str:
+    return re.sub(r"(?<!^)(?=[A-Z])", "_", gate).upper()
+
+
+def _declared_gate(ctx: FileContext) -> str:
+    if ctx.tree is None:
+        return ""
+    for stmt in ctx.tree.body:
+        if (
+            isinstance(stmt, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "__feature_gate__"
+                for t in stmt.targets
+            )
+            and isinstance(stmt.value, ast.Constant)
+            and isinstance(stmt.value.value, str)
+        ):
+            return stmt.value.value
+    return ""
+
+
+def _is_enabled_call(node: ast.AST, gate: str) -> bool:
+    """This exact node is `<...>.enabled(GATE)` / `enabled(GATE)`."""
+    if not isinstance(node, ast.Call):
+        return False
+    callee = dotted_name(node.func)
+    if not (callee == "enabled" or callee.endswith(".enabled")):
+        return False
+    if not node.args:
+        return False
+    arg = node.args[0]
+    if isinstance(arg, ast.Constant) and arg.value == gate:
+        return True
+    a_name = dotted_name(arg)
+    return bool(a_name) and a_name.rsplit(".", 1)[-1] == _const_name(gate)
+
+
+def _test_implies_gate(test: ast.AST, gate: str) -> bool:
+    """Truth of `test` guarantees the gate is ON. Respects boolean
+    structure: `enabled(G) and x` implies G; `enabled(G) or x` does
+    NOT (the or-branch is reachable gate-off); `not ...` never implies
+    gate-ON."""
+    if _is_enabled_call(test, gate):
+        return True
+    if isinstance(test, ast.BoolOp):
+        if isinstance(test.op, ast.And):
+            return any(_test_implies_gate(v, gate) for v in test.values)
+        return all(_test_implies_gate(v, gate) for v in test.values)  # Or
+    if isinstance(test, ast.NamedExpr):
+        return _test_implies_gate(test.value, gate)
+    return False
+
+
+def _negated_gate_check(test: ast.AST, gate: str) -> bool:
+    """Falsity of `test` guarantees the gate is ON — the early-return
+    guard shape `if not <something implying G>: return`."""
+    return isinstance(test, ast.UnaryOp) and isinstance(
+        test.op, ast.Not
+    ) and _test_implies_gate(test.operand, gate)
+
+
+def _terminates(body: List[ast.stmt]) -> bool:
+    return bool(body) and isinstance(
+        body[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break)
+    )
+
+
+class _DominanceChecker:
+    """Walk one function body tracking which gates are established."""
+
+    def __init__(self, ctx: FileContext, gated_names: Dict[str, str],
+                 out: List[Finding]):
+        self.ctx = ctx
+        self.gated_names = gated_names
+        self.out = out
+
+    def check_function(self, fn: ast.AST) -> None:
+        self._walk(fn.body, frozenset())
+
+    def _walk(self, body: List[ast.stmt], established: frozenset) -> None:
+        established = set(established)
+        for stmt in body:
+            if isinstance(stmt, ast.If):
+                self._check_expr(stmt.test, established)
+                negated = {
+                    g for g in set(self.gated_names.values())
+                    if _negated_gate_check(stmt.test, g)
+                }
+                if negated:
+                    # `if not enabled(G):` — the IF branch runs gate-OFF
+                    # (nothing established there); the ELSE branch runs
+                    # gate-ON; a terminating guard establishes G for the
+                    # rest of this block.
+                    self._walk(stmt.body, frozenset(established))
+                    self._walk(
+                        stmt.orelse, frozenset(established | negated)
+                    )
+                    if _terminates(stmt.body):
+                        established |= negated
+                    continue
+                # `if enabled(G):` establishes G inside the branch —
+                # only when the test's truth IMPLIES the gate (an
+                # `or`-alternative or a check under `not` does not).
+                inside = set(established)
+                for gate in set(self.gated_names.values()):
+                    if _test_implies_gate(stmt.test, gate):
+                        inside.add(gate)
+                self._walk(stmt.body, frozenset(inside))
+                self._walk(stmt.orelse, frozenset(established))
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # Nested defs are usually deferred callbacks; they run
+                # under whatever gates their registration implies.
+                # Check them with the gates established at def site.
+                self._walk(stmt.body, frozenset(established))
+                continue
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if sub:
+                    self._walk(sub, frozenset(established))
+            for h in getattr(stmt, "handlers", []) or []:
+                self._walk(h.body, frozenset(established))
+            for c in getattr(stmt, "cases", []) or []:
+                self._walk(c.body, frozenset(established))
+            self._check_stmt_calls(stmt, established)
+
+    def _check_stmt_calls(self, stmt: ast.stmt, established: set) -> None:
+        # Only the statement's own (header) expressions — nested blocks
+        # were walked above with their refined gate sets.
+        for expr in _header_exprs(stmt):
+            self._check_expr(expr, established)
+
+    def _check_expr(self, expr: ast.expr, established: set) -> None:
+        for sub in ast.walk(expr):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = dotted_name(sub.func)
+            if not name:
+                continue
+            gate = None
+            for prefix, g in self.gated_names.items():
+                if name == prefix or name.startswith(prefix + "."):
+                    gate = g
+                    break
+            if gate and gate not in established:
+                add_finding(
+                    self.out, self.ctx, sub.lineno, "G400",
+                    f"call to `{name}` (feature-gated subsystem, "
+                    f"gate `{gate}`) is not dominated by a gate "
+                    f"check in this function — guard with "
+                    f"`if fg.enabled(fg.{_const_name(gate)}):`",
+                )
+
+
+def _top_level_functions(tree: ast.Module):
+    """Functions not nested inside another function: module-level defs
+    and (recursively) class methods."""
+    stack: list = list(tree.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+        elif isinstance(node, ast.ClassDef):
+            stack.extend(node.body)
+        elif isinstance(node, (ast.If, ast.Try, ast.With)):
+            # Conditionally-defined module functions still count.
+            for field in ("body", "orelse", "finalbody"):
+                stack.extend(getattr(node, field, []) or [])
+            for h in getattr(node, "handlers", []) or []:
+                stack.extend(h.body)
+
+
+def _header_exprs(stmt: ast.stmt):
+    """Expression children of a statement, excluding nested statement
+    blocks (body/orelse/finalbody/handlers/cases)."""
+    for _field, value in ast.iter_fields(stmt):
+        if isinstance(value, ast.expr):
+            yield value
+        elif isinstance(value, list):
+            for v in value:
+                if isinstance(v, ast.expr):
+                    yield v
+                elif isinstance(v, ast.withitem):
+                    yield v.context_expr
+                    if v.optional_vars is not None:
+                        yield v.optional_vars
+                elif isinstance(v, ast.keyword):
+                    yield v.value
+
+
+@register
+class GateDominancePass:
+    name = "G400"
+    codes = ("G400",)
+    scope = "project"
+
+    def run_project(self, ctxs: List[FileContext],
+                    extra_paths=()) -> List[Finding]:
+        # Phase 1: module -> gate from __feature_gate__ markers — over
+        # the linted files AND (cheap substring pre-filter, then parse)
+        # the rest of the discovery set, so a --changed-only run still
+        # knows about gated modules it is not re-linting.
+        gated_modules: Dict[str, str] = {}
+        for ctx in ctxs:
+            gate = _declared_gate(ctx)
+            if gate:
+                gated_modules[ctx.module_name] = gate
+        seen = {ctx.path for ctx in ctxs}
+        repo_root = ctxs[0].repo_root if ctxs else None
+        for path in extra_paths:
+            if path in seen or repo_root is None:
+                continue
+            try:
+                if "__feature_gate__" not in path.read_text(
+                    encoding="utf-8", errors="replace"
+                ):
+                    continue
+            except OSError:
+                continue
+            extra_ctx = FileContext(path, repo_root)
+            gate = _declared_gate(extra_ctx)
+            if gate:
+                gated_modules[extra_ctx.module_name] = gate
+        if not gated_modules:
+            return []
+        out: List[Finding] = []
+        # Phase 2: call-site dominance, per file.
+        for ctx in ctxs:
+            if ctx.tree is None:
+                continue
+            if ctx.module_name in gated_modules:
+                continue  # a subsystem need not re-check its own gate
+            parts = ctx.rel_path.split("/")[:-1]
+            if "tests" in parts or "demo" in parts:
+                continue  # tests/demos construct gated subsystems freely
+            gated_names = self._imported_gated_names(ctx, gated_modules)
+            if not gated_names:
+                continue
+            checker = _DominanceChecker(ctx, gated_names, out)
+            # Only TOP-LEVEL functions (module defs and class methods):
+            # _walk descends into nested defs itself, carrying the
+            # def-site gate set — re-walking them here would re-check
+            # their bodies with an empty set (false positives on gated
+            # callbacks) and duplicate genuine findings.
+            for fn in _top_level_functions(ctx.tree):
+                checker.check_function(fn)
+        out.sort(key=lambda f: (str(f.path), f.lineno))
+        return out
+
+    def _imported_gated_names(
+        self, ctx: FileContext, gated_modules: Dict[str, str]
+    ) -> Dict[str, str]:
+        """local access-path prefix -> gate, for every way a gated
+        module's names can be reached: `from mod import Name`,
+        `from pkg import mod`, `import mod [as m]`."""
+        names: Dict[str, str] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                gate = gated_modules.get(node.module)
+                if gate:
+                    for a in node.names:
+                        if a.name != "*":
+                            names[a.asname or a.name] = gate
+                for a in node.names:
+                    # `from tpu_dra.plugin import remediation`: the
+                    # gated module itself becomes a local name.
+                    sub_gate = gated_modules.get(f"{node.module}.{a.name}")
+                    if sub_gate:
+                        names[a.asname or a.name] = sub_gate
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    gate = gated_modules.get(a.name)
+                    if gate:
+                        # `import x.y.z` keeps the dotted access path;
+                        # `import x.y.z as m` rebinds it to `m`.
+                        names[a.asname or a.name] = gate
+        return names

@@ -1,0 +1,268 @@
+"""L500 — import layering and cycle check from the declared layer DAG.
+
+The reference repo keeps its layers honest through Go package import
+rules; Python enforces nothing, so the DAG is declared here and
+checked at lint time. Only MODULE-LEVEL imports are constrained —
+a function-local (lazy) import is the sanctioned way to reach across
+layers for a leaf utility (see infra/flags.py) because it cannot
+create an import cycle and documents the exception at the call site.
+
+Declared DAG (a package may import itself, the packages it lists, and
+their transitive closure is NOT implied — list every edge):
+
+    version      -> (nothing)
+    api          -> version
+    infra        -> api, version
+    tpulib       -> infra, api, version
+    k8sclient    -> infra, api, version
+    plugin       -> tpulib, k8sclient, infra, api, version
+    computedomain-> plugin, tpulib, k8sclient, infra, api, version
+    scheduler    -> k8sclient, infra, api, version
+    webhook      -> k8sclient, infra, api, version
+    tools        -> plugin, tpulib, k8sclient, infra, api, version
+    minicluster  -> computedomain, plugin, scheduler, k8sclient,
+                    infra, api, version
+    workloads    -> plugin, computedomain, infra, api, version
+
+Invariants the DAG encodes:
+
+- ``tpulib`` -> ``plugin``/``computedomain`` -> ``minicluster`` is
+  the driver spine; nothing lower imports anything higher;
+- ``workloads`` (the JAX payload layer) is NEVER imported by a driver
+  layer: a driver binary must not pull in jax;
+- the declared DAG itself must be acyclic (checked at startup — a bad
+  edit to this table fails the linter, not production imports).
+
+Test-tree rule: a ``tests/test_*.py`` module must not import another
+``test_*`` module — shared fixtures/helpers live in ``conftest.py``
+or ``tests/helpers.py``, otherwise running one test file silently
+depends on the import-time side effects and collection order of
+another.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from lints.base import FileContext, Finding, add_finding
+from lints.registry import register
+
+TOP_PACKAGE = "tpu_dra"
+
+LAYER_DAG: Dict[str, Set[str]] = {
+    "version": set(),
+    "api": {"version"},
+    "infra": {"api", "version"},
+    "tpulib": {"infra", "api", "version"},
+    "k8sclient": {"infra", "api", "version"},
+    "plugin": {"tpulib", "k8sclient", "infra", "api", "version"},
+    "computedomain": {
+        "plugin", "tpulib", "k8sclient", "infra", "api", "version"
+    },
+    "scheduler": {"k8sclient", "infra", "api", "version"},
+    "webhook": {"k8sclient", "infra", "api", "version"},
+    "tools": {"plugin", "tpulib", "k8sclient", "infra", "api", "version"},
+    "minicluster": {
+        "computedomain", "plugin", "scheduler", "k8sclient", "infra",
+        "api", "version",
+    },
+    "workloads": {"plugin", "computedomain", "infra", "api", "version"},
+}
+
+# Layers that must never appear in any other layer's dependency set
+# (enforced against the table itself so an edit can't sneak it in).
+NEVER_IMPORTED_BY_DRIVER = {"workloads"}
+
+
+def validate_dag() -> List[str]:
+    """Config sanity: unknown deps, forbidden deps, cycles. Returns a
+    list of problems (empty = valid); run once at linter startup."""
+    problems = []
+    for layer, deps in LAYER_DAG.items():
+        for d in deps:
+            if d not in LAYER_DAG:
+                problems.append(f"layer {layer!r} depends on unknown {d!r}")
+        if layer not in NEVER_IMPORTED_BY_DRIVER:
+            hit = deps & NEVER_IMPORTED_BY_DRIVER
+            if hit:
+                problems.append(
+                    f"layer {layer!r} may not depend on {sorted(hit)} "
+                    f"(payload layer; driver binaries must not import jax)"
+                )
+    # Cycle check (DFS three-color).
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {k: WHITE for k in LAYER_DAG}
+
+    def dfs(n: str, path: List[str]) -> None:
+        color[n] = GRAY
+        for d in sorted(LAYER_DAG.get(n, ())):
+            if d not in color:
+                continue
+            if color[d] == GRAY:
+                problems.append(
+                    "layer DAG cycle: " + " -> ".join(path + [n, d])
+                )
+            elif color[d] == WHITE:
+                dfs(d, path + [n])
+        color[n] = BLACK
+
+    for n in sorted(LAYER_DAG):
+        if color[n] == WHITE:
+            dfs(n, [])
+    return problems
+
+
+def _layer_of(rel_path: str) -> str:
+    """'plugin' for .../tpu_dra/plugin/driver.py; '' when unlayered.
+    Matched on the LAST `tpu_dra` path segment so fixture trees under
+    tmp dirs (tests/test_lint.py) layer the same way as the repo."""
+    parts = rel_path.split("/")
+    for i in range(len(parts) - 2, -1, -1):
+        if parts[i] == TOP_PACKAGE:
+            nxt = parts[i + 1]
+            if nxt.endswith(".py"):
+                # tpu_dra/version.py and tpu_dra/__init__.py: the root
+                # module is its own "version"-tier leaf.
+                name = nxt[:-3]
+                return name if name in LAYER_DAG else ""
+            return nxt if nxt in LAYER_DAG else ""
+    return ""
+
+
+def _imported_tpu_dra_module(node: ast.stmt, pkg: str = "") -> List[str]:
+    """Dotted tpu_dra module names imported at this statement.
+    Relative imports (`from ..workloads import x`) are resolved against
+    ``pkg`` (the importing file's package) so they cannot dodge the
+    layer check."""
+    out = []
+    if isinstance(node, ast.Import):
+        for a in node.names:
+            if a.name == TOP_PACKAGE or a.name.startswith(TOP_PACKAGE + "."):
+                out.append(a.name)
+    elif isinstance(node, ast.ImportFrom):
+        if node.level == 0:
+            m = node.module or ""
+        else:
+            base = pkg.split(".") if pkg else []
+            base = base[: len(base) - (node.level - 1)]
+            if not base:
+                return out  # relative import escaping the tree: ignore
+            m = ".".join(base + ([node.module] if node.module else []))
+        if m == TOP_PACKAGE or m.startswith(TOP_PACKAGE + "."):
+            out.append(m)
+    return out
+
+
+def _module_level_imports(tree: ast.Module) -> List[ast.stmt]:
+    """Import statements executed at import time: module body plus
+    module-level `if`/`try` blocks (TYPE_CHECKING / fallback shims) —
+    NOT function bodies (lazy imports are the sanctioned escape)."""
+    out = []
+    stack: List[ast.stmt] = list(tree.body)
+    while stack:
+        stmt = stack.pop(0)
+        if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+            out.append(stmt)
+        elif isinstance(stmt, (ast.If, ast.Try)):
+            for field in ("body", "orelse", "finalbody"):
+                stack.extend(getattr(stmt, field, []) or [])
+            for h in getattr(stmt, "handlers", []) or []:
+                stack.extend(h.body)
+    return out
+
+
+@register
+class LayeringPass:
+    name = "L500"
+    codes = ("L500",)
+    scope = "file"
+
+    def run(self, ctx: FileContext) -> List[Finding]:
+        if ctx.tree is None:
+            return []
+        out: List[Finding] = []
+        rel = ctx.rel_path
+        layer = _layer_of(rel)
+        if layer:
+            allowed = LAYER_DAG[layer] | {layer}
+            pkg = _package_of(rel)
+            for stmt in _module_level_imports(ctx.tree):
+                for mod in _imported_tpu_dra_module(stmt, pkg):
+                    parts = mod.split(".")
+                    target = parts[1] if len(parts) > 1 else ""
+                    if not target:
+                        continue  # `import tpu_dra` alone: no layer edge
+                    # Root-level leaf modules (tpu_dra.version) sit in
+                    # the "version" tier.
+                    if target not in LAYER_DAG:
+                        continue
+                    if target not in allowed:
+                        add_finding(
+                            out, ctx, stmt.lineno, "L500",
+                            f"layer `{layer}` must not import layer "
+                            f"`{target}` at module level "
+                            f"(`{mod}`); allowed: "
+                            f"{', '.join(sorted(LAYER_DAG[layer])) or 'none'}"
+                            f" — use a function-local import if a leaf "
+                            f"utility is genuinely needed",
+                        )
+        # Test-tree rule: test modules don't import test modules.
+        name = rel.rsplit("/", 1)[-1]
+        if "tests" in rel.split("/")[:-1] and name.startswith("test_"):
+            for stmt in _module_level_imports(ctx.tree):
+                for tgt, lineno in _imported_test_modules(stmt):
+                    add_finding(
+                        out, ctx, lineno, "L500",
+                        f"test module imports test module `{tgt}` — "
+                        f"move shared helpers to tests/helpers.py or "
+                        f"conftest.py (cross-test imports couple "
+                        f"collection order and import side effects)",
+                    )
+        out.sort(key=lambda f: f.lineno)
+        return out
+
+
+def _package_of(rel_path: str) -> str:
+    """Dotted package of the file, anchored at the LAST tpu_dra segment
+    ('tpu_dra.plugin' for .../tpu_dra/plugin/driver.py; packages keep
+    themselves for __init__.py)."""
+    parts = rel_path.split("/")
+    anchor = None
+    for i in range(len(parts) - 1, -1, -1):
+        if parts[i] == TOP_PACKAGE:
+            anchor = i
+            break
+    if anchor is None:
+        return ""
+    mod_parts = parts[anchor:]
+    if mod_parts[-1].endswith(".py"):
+        name = mod_parts[-1][:-3]
+        mod_parts = mod_parts[:-1] + ([] if name == "__init__" else [name])
+    # Package = everything above the module itself (__init__ keeps all).
+    if rel_path.endswith("/__init__.py"):
+        return ".".join(mod_parts)
+    return ".".join(mod_parts[:-1])
+
+
+def _imported_test_modules(stmt: ast.stmt) -> List[tuple]:
+    out = []
+    if isinstance(stmt, ast.Import):
+        for a in stmt.names:
+            last = a.name.rsplit(".", 1)[-1]
+            if last.startswith("test_"):
+                out.append((a.name, stmt.lineno))
+    elif isinstance(stmt, ast.ImportFrom):
+        if stmt.module:
+            last = stmt.module.rsplit(".", 1)[-1]
+            if last.startswith("test_"):
+                out.append((stmt.module, stmt.lineno))
+        # `from tests import test_x` / `from . import test_x`: the
+        # imported NAME is the test module (only when the source is a
+        # package — a value merely named test_* is not an import edge).
+        src_pkg = (stmt.module or "").rsplit(".", 1)[-1]
+        if stmt.module is None or src_pkg == "tests":
+            for a in stmt.names:
+                if a.name.startswith("test_"):
+                    out.append((a.name, stmt.lineno))
+    return out

@@ -1,0 +1,156 @@
+"""The original hack/lint.py rule set, preserved byte-for-byte.
+
+F401 unused import, F811 top-level redefinition, E722 bare except,
+B006 mutable default, F541 placeholder-less f-string, W605 invalid
+escape (via compile() with warnings-as-errors), E999 syntax error.
+Message text, per-file finding order, and the `noqa` / leading-
+underscore exemptions are identical to the pre-package linter so any
+tooling parsing `make lint` output keeps working unchanged.
+"""
+
+from __future__ import annotations
+
+import ast
+import warnings
+from typing import List
+
+from lints.base import FileContext, Finding, disabled_codes
+from lints.registry import register
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.findings: List[Finding] = []
+        # name -> lineno for imports at MODULE level only — function-
+        # local import tracking has too many legitimate late-binding
+        # patterns in this codebase (jax-under-jit).
+        self.imports: dict = {}
+        self.used_names: set = set()
+        self.toplevel_defs: dict = {}
+
+    def add(self, lineno: int, code: str, msg: str) -> None:
+        if code in disabled_codes(self.ctx.line(lineno)):
+            return
+        self.findings.append(Finding(self.ctx.path, lineno, code, msg))
+
+    # --- imports ---
+
+    def visit_Module(self, node: ast.Module) -> None:
+        for stmt in node.body:
+            if isinstance(stmt, ast.Import):
+                for a in stmt.names:
+                    name = (a.asname or a.name).split(".")[0]
+                    self.imports[name] = stmt.lineno
+            elif isinstance(stmt, ast.ImportFrom):
+                if stmt.module == "__future__":
+                    continue  # used implicitly by the compiler
+                for a in stmt.names:
+                    if a.name == "*":
+                        continue
+                    self.imports[a.asname or a.name] = stmt.lineno
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                prev = self.toplevel_defs.get(stmt.name)
+                if prev is not None:
+                    self.add(
+                        stmt.lineno, "F811",
+                        f"redefinition of {stmt.name!r} "
+                        f"(first defined at line {prev})",
+                    )
+                self.toplevel_defs[stmt.name] = stmt.lineno
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self.used_names.add(node.id)
+        self.generic_visit(node)
+
+    # --- hazards ---
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self.add(node.lineno, "E722", "bare `except:`")
+        self.generic_visit(node)
+
+    def _check_defaults(self, node) -> None:
+        for d in list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None
+        ]:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                self.add(
+                    d.lineno, "B006",
+                    "mutable default argument (shared across calls)",
+                )
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
+        if not any(isinstance(v, ast.FormattedValue) for v in node.values):
+            self.add(node.lineno, "F541", "f-string without placeholders")
+        self.generic_visit(node)
+
+    def visit_FormattedValue(self, node: ast.FormattedValue) -> None:
+        # Do NOT recurse into format_spec: `{x:.1f}` carries a nested
+        # placeholder-less JoinedStr ('.1f') that is not an f-string.
+        self.visit(node.value)
+
+    def finish(self, tree: ast.Module) -> None:
+        # __all__ and doctest-style re-exports count as uses.
+        exported = set()
+        for stmt in tree.body:
+            if (
+                isinstance(stmt, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == "__all__"
+                    for t in stmt.targets
+                )
+                and isinstance(stmt.value, (ast.List, ast.Tuple))
+            ):
+                exported.update(
+                    e.value for e in stmt.value.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                )
+        for name, lineno in self.imports.items():
+            if name in self.used_names or name in exported:
+                continue
+            if name.startswith("_"):
+                continue
+            if "noqa" in self.ctx.line(lineno):
+                continue
+            self.add(lineno, "F401", f"{name!r} imported but unused")
+
+
+@register
+class CorePass:
+    """F401/F811/E722/B006/F541/W605/E999 — the pre-package rule set."""
+
+    name = "core"
+    codes = ("F401", "F811", "E722", "B006", "F541", "W605", "E999")
+    scope = "file"
+
+    def run(self, ctx: FileContext) -> List[Finding]:
+        with warnings.catch_warnings():
+            # W605: DeprecationWarning/SyntaxWarning for bad escapes.
+            warnings.simplefilter("error", SyntaxWarning)
+            warnings.simplefilter("error", DeprecationWarning)
+            try:
+                compile(ctx.source, str(ctx.path), "exec")
+            except SyntaxError as e:
+                return [Finding(
+                    ctx.path, e.lineno or 0, "E999", f"syntax error: {e.msg}"
+                )]
+            except (SyntaxWarning, DeprecationWarning) as e:
+                return [Finding(ctx.path, 0, "W605", str(e))]
+        if ctx.tree is None:  # unreachable after a clean compile
+            return []
+        v = _Visitor(ctx)
+        v.visit(ctx.tree)
+        v.finish(ctx.tree)
+        return v.findings

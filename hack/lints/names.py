@@ -1,0 +1,39 @@
+"""F821 — scoped undefined-name resolution.
+
+A loaded (or deleted) name must bind in SOME accessible scope: the use
+site's own scope, an enclosing function scope, the module scope, or
+builtins — with real scoping rules (class bodies invisible to nested
+functions, comprehension scopes, ``global``/``nonlocal``, walrus
+hoisting; see :mod:`lints.scopes`). Flow-insensitive by design: the
+pass hunts names that bind NOWHERE (typos, deleted helpers, missing
+imports), not use-before-def races, which keeps it at zero false
+positives over this codebase.
+
+A ``from m import *`` disables the check for everything below it in
+the scope chain (the star may bind anything).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from lints.base import FileContext, Finding, add_finding
+from lints.registry import register
+
+
+@register
+class UndefinedNamePass:
+    name = "F821"
+    codes = ("F821",)
+    scope = "file"
+
+    def run(self, ctx: FileContext) -> List[Finding]:
+        if ctx.tree is None:
+            return []
+        model = ctx.scopes()
+        out: List[Finding] = []
+        for name, node in model.unresolved_uses():
+            add_finding(
+                out, ctx, node.lineno, "F821", f"undefined name {name!r}"
+            )
+        return out

@@ -1,0 +1,236 @@
+"""R200 — lock-discipline race lint.
+
+The Go reference gets this class of bug from the race detector;
+Python's GIL hides it until a chaos soak reorders the interleaving.
+The structural rule enforced here:
+
+  In a class that exhibits concurrency (spawns threads/timers/
+  executors, registers informer/workqueue/collector handlers, or
+  hands bound methods to another component's constructor), any
+  ``self.<attr>`` mutated from two or more methods must be written
+  under ``with self.<lock>`` — where locks are discovered
+  structurally (``self.x = threading.Lock()/RLock()/Condition()``).
+
+Conventions understood by the pass (all present in this codebase):
+
+- ``__init__``/``__new__`` writes are construction, not sharing, and
+  are exempt (the object is not yet visible to other threads);
+- methods named ``*_locked`` document "caller holds the lock" and
+  their writes count as locked (infra/workqueue.py's idiom);
+- ``with self._lock:`` / ``with self._cond:`` (any discovered lock
+  attr) marks the lexical region locked, including ``with a, b:``;
+- ``# lint: disable=R200`` on the write line is the escape hatch for
+  intentionally unsynchronized state (document why at the site).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from lints.base import FileContext, Finding, add_finding, dotted_name
+from lints.registry import register
+
+LOCK_FACTORIES = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "Lock", "RLock", "Condition",
+}
+# Calls (by terminal attribute/name) that mark a class as concurrent.
+SPAWN_CALLS = {
+    "threading.Thread", "threading.Timer", "Thread", "Timer",
+    "ThreadPoolExecutor", "ProcessPoolExecutor",
+}
+SPAWN_METHODS = {
+    "run_in_thread", "add_handler", "register_collector", "enqueue",
+    "create_task", "ensure_future", "run_in_executor", "submit",
+}
+# NOTE: `update` is deliberately absent — `self.<client>.update(obj)`
+# on a ResourceClient (a REST write) is indistinguishable from
+# `self.<dict>.update(...)` without type inference, and the REST form
+# dominates in this codebase.
+MUTATOR_METHODS = {
+    "append", "extend", "insert", "remove", "pop", "popleft", "clear",
+    "add", "discard", "setdefault", "popitem", "appendleft",
+}
+EXEMPT_METHODS = {"__init__", "__new__"}
+
+
+def _self_attr(node: ast.AST) -> str:
+    """'x' for a `self.x` attribute node, else ''."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return ""
+
+
+class _ClassInfo:
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        self.locks: set = set()
+        self.concurrent_because = ""
+        # attr -> list of (method, lineno, locked)
+        self.writes: Dict[str, List[Tuple[str, int, bool]]] = {}
+
+
+def _analyze_class(cls: ast.ClassDef) -> _ClassInfo:
+    info = _ClassInfo(cls)
+    methods = [
+        m for m in cls.body
+        if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    method_names = {m.name for m in methods}
+    # Pass 1: discover locks and concurrency markers anywhere in the class.
+    for m in methods:
+        for sub in ast.walk(m):
+            if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                if isinstance(sub.value, ast.Call):
+                    callee = dotted_name(sub.value.func)
+                    if callee in LOCK_FACTORIES:
+                        targets = (
+                            sub.targets if isinstance(sub, ast.Assign)
+                            else [sub.target]
+                        )
+                        for t in targets:
+                            attr = _self_attr(t)
+                            if attr:
+                                info.locks.add(attr)
+            if isinstance(sub, ast.Call) and not info.concurrent_because:
+                callee = dotted_name(sub.func)
+                terminal = callee.rsplit(".", 1)[-1]
+                if callee in SPAWN_CALLS or terminal in SPAWN_CALLS:
+                    info.concurrent_because = f"calls {callee or terminal}()"
+                elif (
+                    isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in SPAWN_METHODS
+                ):
+                    info.concurrent_because = f"calls .{sub.func.attr}()"
+                elif terminal[:1].isupper() and any(
+                    _self_attr(a) in method_names
+                    for a in list(sub.args)
+                    + [k.value for k in sub.keywords]
+                ):
+                    # Hands a bound METHOD of this class (self.m where
+                    # m is a def in the class body — a plain attribute
+                    # like ValueError(self.path) doesn't count) to
+                    # another component's constructor: that component
+                    # may call it from its own thread (informer
+                    # handlers, the health monitor callback, ...).
+                    info.concurrent_because = (
+                        f"registers a bound method with {terminal}()"
+                    )
+    # Pass 2: record self.<attr> mutations per method with lock context.
+    for m in methods:
+        if m.name in EXEMPT_METHODS:
+            continue
+        assume_locked = m.name.endswith("_locked")
+        _walk_writes(m, m.name, info, assume_locked)
+    return info
+
+
+def _walk_writes(
+    method: ast.AST, method_name: str, info: _ClassInfo, locked: bool
+) -> None:
+    def record(attr: str, lineno: int, locked: bool) -> None:
+        if attr and attr not in info.locks:
+            info.writes.setdefault(attr, []).append(
+                (method_name, lineno, locked)
+            )
+
+    def visit(node: ast.AST, locked: bool) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            holds = locked or any(
+                _self_attr(item.context_expr) in info.locks
+                for item in node.items
+            )
+            for item in node.items:
+                visit(item.context_expr, locked)
+            for stmt in node.body:
+                visit(stmt, holds)
+            return
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                _record_target(t, locked)
+            visit(node.value, locked)
+            return
+        if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            _record_target(node.target, locked)
+            if node.value is not None:
+                visit(node.value, locked)
+            return
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                _record_target(t, locked)
+            return
+        if isinstance(node, ast.Call):
+            # self.x.append(...) and friends mutate self.x in place.
+            if isinstance(node.func, ast.Attribute) and (
+                node.func.attr in MUTATOR_METHODS
+            ):
+                attr = _self_attr(node.func.value)
+                if attr:
+                    record(attr, node.lineno, locked)
+            for child in ast.iter_child_nodes(node):
+                visit(child, locked)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, locked)
+
+    def _record_target(t: ast.AST, locked: bool) -> None:
+        attr = _self_attr(t)
+        if attr:
+            record(attr, t.lineno, locked)
+            return
+        if isinstance(t, ast.Subscript):
+            # self.x[k] = v / del self.x[k]
+            attr = _self_attr(t.value)
+            if attr:
+                record(attr, t.lineno, locked)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for elt in t.elts:
+                _record_target(elt, locked)
+
+    for stmt in getattr(method, "body", []):
+        visit(stmt, locked)
+
+
+@register
+class RaceLintPass:
+    name = "R200"
+    codes = ("R200",)
+    scope = "file"
+
+    def run(self, ctx: FileContext) -> List[Finding]:
+        if ctx.tree is None:
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            info = _analyze_class(node)
+            if not info.concurrent_because:
+                continue
+            for attr, writes in sorted(info.writes.items()):
+                methods = {m for m, _, _ in writes}
+                if len(methods) < 2:
+                    continue
+                for method, lineno, locked in writes:
+                    if locked:
+                        continue
+                    lock_hint = (
+                        f"under `with self.{sorted(info.locks)[0]}`"
+                        if info.locks
+                        else "under a lock (none found in the class)"
+                    )
+                    add_finding(
+                        out, ctx, lineno, "R200",
+                        f"unsynchronized write to `self.{attr}` in "
+                        f"`{node.name}.{method}`: the attribute is "
+                        f"mutated from {len(methods)} methods of a "
+                        f"concurrent class ({info.concurrent_because}) "
+                        f"and must be written {lock_hint}",
+                    )
+        out.sort(key=lambda f: f.lineno)
+        return out

@@ -1,0 +1,33 @@
+"""Pluggable pass registry.
+
+A pass is a class with:
+
+  name   short identifier shown in the timing table ("core", "F821");
+  codes  tuple of codes it can emit (the CLI's --select filter and the
+         docs check key off this);
+  scope  "file" (run(ctx) per Python file) or "project"
+         (run_project(ctxs, extra_files) once, after every FileContext
+         is built — for cross-file analyses like G400's gate-module
+         discovery or L500's cycle check).
+
+Registration order is execution order; the core (legacy) pass runs
+first so its output stays byte-identical to the pre-package linter.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+_PASSES: List[type] = []
+
+
+def register(cls: type) -> type:
+    """Class decorator: add a pass to the suite (in declaration order)."""
+    _PASSES.append(cls)
+    return cls
+
+
+def all_passes() -> List[type]:
+    """Registered pass classes; importing lints.cli registers the
+    built-in suite."""
+    return list(_PASSES)

@@ -1,0 +1,387 @@
+"""Lexical scope model for Python files.
+
+Built once per file (cached on :class:`lints.base.FileContext`) and
+shared by every pass that needs name resolution — today F821, which
+needs *real* scoping rules rather than a flat name set:
+
+- module / class / function / lambda / comprehension scopes;
+- class bodies are skipped when resolving from nested functions
+  (the classic "class attrs are not closure cells" rule);
+- the first iterable of a comprehension evaluates in the enclosing
+  scope (so ``class C: ys = [x for x in xs]`` resolves ``xs``);
+- ``global`` / ``nonlocal`` redirect bindings to the right scope;
+- walrus (``:=``) targets bind in the nearest enclosing function or
+  module scope, skipping class and comprehension scopes;
+- ``from m import *`` poisons resolution for the whole chain (any
+  unresolved name below a star import is assumed imported).
+
+The analysis is deliberately flow-insensitive: a name bound anywhere
+in an accessible scope resolves, regardless of statement order. That
+trades use-before-def detection for a zero-false-positive undefined-
+name check — the class of bug F821 exists for (typos, missing
+imports, renamed helpers) binds nowhere at all.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+from typing import Dict, List, Optional, Tuple
+
+BUILTIN_NAMES = set(dir(builtins))
+
+# Present in every module's namespace at runtime.
+MODULE_IMPLICIT = {
+    "__name__", "__file__", "__doc__", "__package__", "__spec__",
+    "__loader__", "__builtins__", "__debug__", "__annotations__",
+}
+CLASS_IMPLICIT = {"__module__", "__qualname__", "__doc__"}
+
+
+class Scope:
+    __slots__ = (
+        "kind", "node", "parent", "bindings", "globals_", "nonlocals",
+        "star_import", "uses", "in_class",
+    )
+
+    def __init__(self, kind: str, node: ast.AST, parent: Optional["Scope"]):
+        self.kind = kind  # module | class | function | lambda | comprehension
+        self.node = node
+        self.parent = parent
+        self.bindings: set = set()
+        self.globals_: set = set()
+        self.nonlocals: set = set()
+        self.star_import = False
+        # (name, node) for every Name load/delete lexically in this scope.
+        self.uses: List[Tuple[str, ast.AST]] = []
+        # True when this scope is lexically inside a class body (gives
+        # functions the implicit __class__ cell for zero-arg super()).
+        self.in_class = kind == "class" or (
+            parent is not None and parent.in_class
+        )
+
+    def is_function_like(self) -> bool:
+        return self.kind in ("function", "lambda", "comprehension")
+
+
+class ScopeModel:
+    """All scopes of one module + resolution over them."""
+
+    def __init__(self, tree: ast.Module):
+        self.module = Scope("module", tree, None)
+        self.scopes: List[Scope] = [self.module]
+        self._scope_of_node: Dict[ast.AST, Scope] = {tree: self.module}
+        _Builder(self).build(tree)
+
+    # --- construction helpers (used by _Builder) ---
+
+    def new_scope(self, kind: str, node: ast.AST, parent: Scope) -> Scope:
+        s = Scope(kind, node, parent)
+        self.scopes.append(s)
+        self._scope_of_node[node] = s
+        return s
+
+    def scope_for(self, node: ast.AST) -> Optional[Scope]:
+        """The scope introduced BY this node (def/class/lambda/comp)."""
+        return self._scope_of_node.get(node)
+
+    # --- resolution ---
+
+    def resolves(self, name: str, scope: Scope) -> bool:
+        if name in BUILTIN_NAMES or name in MODULE_IMPLICIT:
+            return True
+        if scope.kind == "class" and name in CLASS_IMPLICIT:
+            return True
+        if name == "__class__" and scope.is_function_like() and scope.in_class:
+            return True
+        # `global x` in the use's own function scope pins resolution to
+        # the module scope.
+        if scope.is_function_like() and name in scope.globals_:
+            chain: List[Scope] = [self.module]
+        else:
+            chain = []
+            s: Optional[Scope] = scope
+            first = True
+            while s is not None:
+                # Class scopes only provide names to code directly in
+                # the class body, never to nested scopes.
+                if s.kind != "class" or first:
+                    chain.append(s)
+                first = False
+                s = s.parent
+        for s in chain:
+            if name in s.bindings:
+                return True
+            if s.star_import:
+                return True
+        return False
+
+    def unresolved_uses(self) -> List[Tuple[str, ast.AST]]:
+        out = []
+        for scope in self.scopes:
+            for name, node in scope.uses:
+                if not self.resolves(name, scope):
+                    out.append((name, node))
+        out.sort(key=lambda t: (t[1].lineno, t[1].col_offset))
+        return out
+
+
+class _Builder:
+    """One walk of the AST: creates scopes, records bindings and uses."""
+
+    def __init__(self, model: ScopeModel):
+        self.model = model
+
+    def build(self, tree: ast.Module) -> None:
+        scope = self.model.module
+        for stmt in tree.body:
+            self.visit(stmt, scope)
+
+    # --- binding targets ---
+
+    def bind(self, name: str, scope: Scope) -> None:
+        # global/nonlocal declarations redirect the binding.
+        if scope.is_function_like() and name in scope.globals_:
+            self.model.module.bindings.add(name)
+            return
+        if scope.is_function_like() and name in scope.nonlocals:
+            s = scope.parent
+            while s is not None:
+                if s.kind == "function":
+                    s.bindings.add(name)
+                    return
+                s = s.parent
+            return
+        scope.bindings.add(name)
+
+    def bind_walrus(self, name: str, scope: Scope) -> None:
+        """NamedExpr targets skip class and comprehension scopes."""
+        s: Optional[Scope] = scope
+        while s is not None and s.kind in ("class", "comprehension"):
+            s = s.parent
+        self.bind(name, s if s is not None else self.model.module)
+
+    def bind_target(self, node: ast.AST, scope: Scope) -> None:
+        if isinstance(node, ast.Name):
+            self.bind(node.id, scope)
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            for elt in node.elts:
+                self.bind_target(elt, scope)
+        elif isinstance(node, ast.Starred):
+            self.bind_target(node.value, scope)
+        elif isinstance(node, (ast.Attribute, ast.Subscript)):
+            # self.x = ..., d[k] = ...: the base is a USE, not a binding.
+            self.visit(node, scope)
+
+    def bind_pattern(self, pat: ast.AST, scope: Scope) -> None:
+        """Capture names of a match-statement pattern."""
+        if isinstance(pat, ast.MatchAs):
+            if pat.name:
+                self.bind(pat.name, scope)
+            if pat.pattern:
+                self.bind_pattern(pat.pattern, scope)
+        elif isinstance(pat, ast.MatchStar):
+            if pat.name:
+                self.bind(pat.name, scope)
+        elif isinstance(pat, ast.MatchMapping):
+            if pat.rest:
+                self.bind(pat.rest, scope)
+            for k in pat.keys:
+                self.visit(k, scope)
+            for p in pat.patterns:
+                self.bind_pattern(p, scope)
+        elif isinstance(pat, ast.MatchSequence):
+            for p in pat.patterns:
+                self.bind_pattern(p, scope)
+        elif isinstance(pat, ast.MatchOr):
+            for p in pat.patterns:
+                self.bind_pattern(p, scope)
+        elif isinstance(pat, ast.MatchClass):
+            self.visit(pat.cls, scope)
+            for p in pat.patterns + pat.kwd_patterns:
+                self.bind_pattern(p, scope)
+        elif isinstance(pat, ast.MatchValue):
+            self.visit(pat.value, scope)
+
+    def bind_args(self, args: ast.arguments, scope: Scope) -> None:
+        for a in (
+            list(args.posonlyargs) + list(args.args)
+            + list(args.kwonlyargs)
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        ):
+            scope.bindings.add(a.arg)
+
+    # --- pre-scan for global/nonlocal (whole-scope effect) ---
+
+    def _collect_own_declarations(self, body: List[ast.stmt],
+                                  scope: Scope) -> None:
+        """global/nonlocal statements directly in this scope (not in
+        nested def/class/lambda bodies)."""
+
+        def walk(stmts):
+            for st in stmts:
+                if isinstance(
+                    st,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+                ):
+                    continue
+                if isinstance(st, ast.Global):
+                    scope.globals_.update(st.names)
+                elif isinstance(st, ast.Nonlocal):
+                    scope.nonlocals.update(st.names)
+                for field in ("body", "orelse", "finalbody"):
+                    walk(getattr(st, field, []) or [])
+                for h in getattr(st, "handlers", []) or []:
+                    walk(h.body)
+                for c in getattr(st, "cases", []) or []:
+                    walk(c.body)
+
+        walk(body)
+
+    # --- main dispatch ---
+
+    def visit_body(self, body: List[ast.stmt], scope: Scope) -> None:
+        for stmt in body:
+            self.visit(stmt, scope)
+
+    def visit(self, node: ast.AST, scope: Scope) -> None:  # noqa: C901
+        if node is None:
+            return
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, (ast.Load, ast.Del)):
+                scope.uses.append((node.id, node))
+            else:
+                self.bind(node.id, scope)
+            return
+        if isinstance(node, ast.NamedExpr):
+            self.visit(node.value, scope)
+            if isinstance(node.target, ast.Name):
+                self.bind_walrus(node.target.id, scope)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.bind(node.name, scope)
+            for dec in node.decorator_list:
+                self.visit(dec, scope)
+            self._visit_arg_annotations_and_defaults(node.args, scope)
+            if node.returns:
+                self.visit(node.returns, scope)
+            fn_scope = self.model.new_scope("function", node, scope)
+            self.bind_args(node.args, fn_scope)
+            self._collect_own_declarations(node.body, fn_scope)
+            self.visit_body(node.body, fn_scope)
+            return
+        if isinstance(node, ast.Lambda):
+            self._visit_arg_annotations_and_defaults(node.args, scope)
+            lam_scope = self.model.new_scope("lambda", node, scope)
+            self.bind_args(node.args, lam_scope)
+            self.visit(node.body, lam_scope)
+            return
+        if isinstance(node, ast.ClassDef):
+            self.bind(node.name, scope)
+            for dec in node.decorator_list:
+                self.visit(dec, scope)
+            for base in node.bases:
+                self.visit(base, scope)
+            for kw in node.keywords:
+                self.visit(kw.value, scope)
+            cls_scope = self.model.new_scope("class", node, scope)
+            self._collect_own_declarations(node.body, cls_scope)
+            self.visit_body(node.body, cls_scope)
+            return
+        if isinstance(
+            node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)
+        ):
+            comp_scope = self.model.new_scope("comprehension", node, scope)
+            for i, gen in enumerate(node.generators):
+                # The first iterable evaluates in the ENCLOSING scope.
+                self.visit(gen.iter, scope if i == 0 else comp_scope)
+                self.bind_target(gen.target, comp_scope)
+                for cond in gen.ifs:
+                    self.visit(cond, comp_scope)
+            if isinstance(node, ast.DictComp):
+                self.visit(node.key, comp_scope)
+                self.visit(node.value, comp_scope)
+            else:
+                self.visit(node.elt, comp_scope)
+            return
+        if isinstance(node, ast.Assign):
+            self.visit(node.value, scope)
+            for t in node.targets:
+                self.bind_target(t, scope)
+            return
+        if isinstance(node, ast.AnnAssign):
+            if node.value:
+                self.visit(node.value, scope)
+            self.visit(node.annotation, scope)
+            self.bind_target(node.target, scope)
+            return
+        if isinstance(node, ast.AugAssign):
+            self.visit(node.value, scope)
+            # x += 1 both uses and binds x.
+            if isinstance(node.target, ast.Name):
+                scope.uses.append((node.target.id, node.target))
+                self.bind(node.target.id, scope)
+            else:
+                self.visit(node.target, scope)
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            self.visit(node.iter, scope)
+            self.bind_target(node.target, scope)
+            self.visit_body(node.body, scope)
+            self.visit_body(node.orelse, scope)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                self.visit(item.context_expr, scope)
+                if item.optional_vars:
+                    self.bind_target(item.optional_vars, scope)
+            self.visit_body(node.body, scope)
+            return
+        if isinstance(node, ast.ExceptHandler):
+            if node.type:
+                self.visit(node.type, scope)
+            if node.name:
+                self.bind(node.name, scope)
+            self.visit_body(node.body, scope)
+            return
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                self.bind((a.asname or a.name).split(".")[0], scope)
+            return
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name == "*":
+                    scope.star_import = True
+                else:
+                    self.bind(a.asname or a.name, scope)
+            return
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            return  # handled by _collect_own_declarations
+        if isinstance(node, ast.Match):
+            self.visit(node.subject, scope)
+            for case in node.cases:
+                self.bind_pattern(case.pattern, scope)
+                if case.guard:
+                    self.visit(case.guard, scope)
+                self.visit_body(case.body, scope)
+            return
+        # Generic: visit children.
+        for child in ast.iter_child_nodes(node):
+            self.visit(child, scope)
+
+    def _visit_arg_annotations_and_defaults(
+        self, args: ast.arguments, scope: Scope
+    ) -> None:
+        """Defaults and annotations evaluate in the ENCLOSING scope."""
+        for d in list(args.defaults) + [
+            d for d in args.kw_defaults if d is not None
+        ]:
+            self.visit(d, scope)
+        for a in (
+            list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        ):
+            if a.annotation:
+                self.visit(a.annotation, scope)

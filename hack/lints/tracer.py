@@ -1,0 +1,370 @@
+"""J300 — JAX tracer-safety for the serving/workloads layer.
+
+Scope: files under ``tpu_dra/workloads/`` only (the driver layers are
+not traced code). Three hazards, each a real production failure mode
+on the PR 2 serving path:
+
+- **host sync inside a traced body**: ``.item()``, ``float()/int()/
+  bool()`` over traced values, ``np.asarray``/``np.array``,
+  ``jax.device_get``, ``.block_until_ready()``, ``.tolist()`` inside
+  a function that jit/scan/pallas traces — each one forces a device→
+  host transfer per step (or a trace error), silently serializing a
+  decode scan that should stay on-device.
+- **Python branching on traced values**: an ``if``/``while`` whose
+  test calls ``jnp.*``/``lax.*`` (or ``.any()``/``.all()``) inside a
+  traced body raises TracerBoolConversionError at trace time at best,
+  or bakes one branch in at worst.
+- **jnp at import time**: module-level ``jnp.*`` calls allocate
+  buffers and initialize the backend as a side effect of ``import``
+  — they break CPU-forcing (``force_cpu_devices``) and make every
+  importer pay device-init latency. (``jnp.float32``-style attribute
+  access is fine; only *calls* are flagged.)
+
+Traced bodies are discovered structurally: ``@jax.jit``-style
+decorators (incl. ``partial(jax.jit, ...)``), functions passed to
+``jax.jit``/``lax.scan``/``lax.while_loop``/``lax.fori_loop``/
+``lax.cond``/``lax.switch``/``pl.pallas_call``/``shard_map``/
+``jax.grad``/``jax.vmap``/... by name or as a lambda, plus every
+``def`` nested inside a traced body. Static shape/dtype reads
+(``x.shape``, ``x.ndim``, ``x.dtype``, ``x.size``) never count as
+traced-value uses.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from lints.base import FileContext, Finding, add_finding, dotted_name
+from lints.registry import register
+
+WORKLOADS_PREFIX = "tpu_dra/workloads/"
+
+# func-position argument indices for tracing entry points (by terminal
+# dotted-name suffix): which call args are traced callables.
+TRACING_CALLS = {
+    "jax.jit": (0,),
+    "jit": (0,),
+    "pjit": (0,),
+    "jax.pmap": (0,),
+    "pmap": (0,),
+    "jax.vmap": (0,),
+    "vmap": (0,),
+    "jax.grad": (0,),
+    "grad": (0,),
+    "jax.value_and_grad": (0,),
+    "value_and_grad": (0,),
+    "jax.checkpoint": (0,),
+    "checkpoint": (0,),
+    "jax.remat": (0,),
+    "remat": (0,),
+    "shard_map": (0,),
+    "jax.eval_shape": (0,),
+    "lax.scan": (0,),
+    "jax.lax.scan": (0,),
+    "lax.fori_loop": (2,),
+    "jax.lax.fori_loop": (2,),
+    "lax.while_loop": (0, 1),
+    "jax.lax.while_loop": (0, 1),
+    "lax.cond": (1, 2),
+    "jax.lax.cond": (1, 2),
+    "lax.switch": (),  # every arg from 1 on is a branch; special-cased
+    "jax.lax.switch": (),
+    "pl.pallas_call": (0,),
+    "pallas_call": (0,),
+    "lax.associative_scan": (0,),
+    "jax.lax.associative_scan": (0,),
+}
+
+JIT_DECORATORS = {
+    "jax.jit", "jit", "pjit", "jax.pmap", "pmap", "jax.vmap", "vmap",
+    "jax.checkpoint", "checkpoint", "jax.remat", "remat",
+}
+
+HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready", "numpy"}
+HOST_SYNC_CALLS = {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "jax.device_get", "device_get", "onp.asarray", "onp.array",
+}
+CAST_BUILTINS = {"float", "int", "bool", "complex"}
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "nbytes", "sharding"}
+# Callables that are static when their inputs are (builtins that never
+# close over a traced receiver).
+_STATIC_CALLABLES = {
+    "len", "min", "max", "sum", "abs", "round", "int", "float", "bool",
+    "tuple", "list", "sorted", "range", "divmod",
+}
+ARRAY_NS = ("jnp.", "jax.numpy.", "lax.", "jax.lax.", "jax.nn.", "jax.")
+
+
+def _is_workloads(ctx: FileContext) -> bool:
+    # Segment match rather than repo-relative prefix so fixture trees
+    # (tests/test_lint.py's tmp_path copies) scope the same way.
+    return WORKLOADS_PREFIX in ctx.path.resolve().as_posix() + "/"
+
+
+def _decorator_traces(dec: ast.AST) -> bool:
+    name = dotted_name(dec)
+    if name in JIT_DECORATORS:
+        return True
+    if isinstance(dec, ast.Call):
+        callee = dotted_name(dec.func)
+        if callee in JIT_DECORATORS:
+            return True  # @jax.jit(static_argnames=...)
+        if callee in ("partial", "functools.partial") and dec.args:
+            return dotted_name(dec.args[0]) in JIT_DECORATORS
+    return False
+
+
+class _TracedCollector(ast.NodeVisitor):
+    """Find every function node whose body is traced."""
+
+    def __init__(self):
+        # name (as written) -> def node, for resolving `lax.scan(body, …)`.
+        self.local_defs: dict = {}
+        self.traced: Set[ast.AST] = set()
+
+    def visit_FunctionDef(self, node):
+        self.local_defs[node.name] = node
+        if any(_decorator_traces(d) for d in node.decorator_list):
+            self.traced.add(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call):
+        callee = dotted_name(node.func)
+        key = None
+        for suffix, positions in TRACING_CALLS.items():
+            if callee == suffix or callee.endswith("." + suffix):
+                key = suffix
+                break
+        if key is not None:
+            positions = TRACING_CALLS[key]
+            if key in ("lax.switch", "jax.lax.switch"):
+                positions = tuple(range(1, len(node.args)))
+            for i in positions:
+                if i < len(node.args):
+                    self._mark(node.args[i])
+        self.generic_visit(node)
+
+    def _mark(self, arg: ast.AST) -> None:
+        if isinstance(arg, ast.Lambda):
+            self.traced.add(arg)
+        elif isinstance(arg, ast.Name) and arg.id in self.local_defs:
+            self.traced.add(self.local_defs[arg.id])
+
+
+def _fully_static(node: ast.AST) -> bool:
+    """True when the expression is static at trace time: constants,
+    shape/dtype reads (`x.shape[0]`), and arithmetic/builtin/jnp calls
+    over ONLY those. A bare name is assumed traced."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Attribute):
+        if node.attr in STATIC_ATTRS:
+            return True  # x.shape / x.dtype — static regardless of base
+        return _fully_static(node.value)
+    if isinstance(node, ast.Subscript):
+        return _fully_static(node.value) and _fully_static(node.slice)
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return all(_fully_static(e) for e in node.elts)
+    if isinstance(node, ast.BinOp):
+        return _fully_static(node.left) and _fully_static(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _fully_static(node.operand)
+    if isinstance(node, ast.Compare):
+        return _fully_static(node.left) and all(
+            _fully_static(c) for c in node.comparators
+        )
+    if isinstance(node, ast.BoolOp):
+        return all(_fully_static(v) for v in node.values)
+    if isinstance(node, ast.Call):
+        # len(x.shape), min(x.shape), jnp.prod(x.shape): a call is as
+        # static as its inputs — but only for callables that cannot
+        # smuggle in a traced receiver: known builtins, jnp/lax
+        # functions, or methods on an already-static object. x.sum()
+        # has a traced receiver and zero args; it is NOT static.
+        fname = dotted_name(node.func)
+        func_ok = fname in _STATIC_CALLABLES or fname.startswith(ARRAY_NS)
+        if not func_ok and isinstance(node.func, ast.Attribute):
+            func_ok = _fully_static(node.func.value)
+        if not func_ok:
+            return False
+        return all(_fully_static(a) for a in node.args) and all(
+            k.value is not None and _fully_static(k.value)
+            for k in node.keywords
+        )
+    if isinstance(node, ast.Slice):
+        return all(
+            v is None or _fully_static(v)
+            for v in (node.lower, node.upper, node.step)
+        )
+    if isinstance(node, ast.Index):  # py<3.9 compat nodes, harmless
+        return _fully_static(node.value)
+    return False
+
+
+def _expr_touches_array(node: ast.AST) -> Optional[str]:
+    """A jnp/lax/jax call (or .any()/.all()/.sum()… reduction) over
+    NON-static inputs inside the expression — evidence the expression
+    carries a traced value. `jnp.prod(x.shape)` and friends are static
+    at trace time and do not count; so an expression mixing a traced
+    reduction with a shape read (`jnp.sum(x) / x.shape[0]`) still
+    does. Returns a description or None."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            callee = dotted_name(sub.func)
+            if callee.startswith(ARRAY_NS):
+                if _fully_static(sub):
+                    continue  # jnp over shapes/constants only
+                return f"{callee}(...)"
+            if (
+                isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in ("any", "all", "sum", "max", "min")
+                and not isinstance(sub.func.value, ast.Constant)
+                and not _fully_static(sub.func.value)
+            ):
+                return f".{sub.func.attr}()"
+    return None
+
+
+class _BodyChecker:
+    """Scan one traced body for hazards (nested defs included — they
+    are traced transitively)."""
+
+    def __init__(self, ctx: FileContext, out: List[Finding]):
+        self.ctx = ctx
+        self.out = out
+        self.params: set = set()
+
+    def check(self, fn: ast.AST) -> None:
+        # The traced callable's own parameters are traced values by
+        # definition — `float(x)` over one is the canonical per-step
+        # host sync even with no jnp call in sight.
+        args = fn.args
+        self.params = {
+            a.arg
+            for a in (
+                list(args.posonlyargs) + list(args.args)
+                + list(args.kwonlyargs)
+                + ([args.vararg] if args.vararg else [])
+                + ([args.kwarg] if args.kwarg else [])
+            )
+        }
+        body = fn.body if not isinstance(fn, ast.Lambda) else [fn.body]
+        for stmt in body:
+            self._visit(stmt)
+
+    def _visit(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.If, ast.While)):
+            touch = _expr_touches_array(node.test)
+            if touch is not None:
+                kw = "if" if isinstance(node, ast.If) else "while"
+                add_finding(
+                    self.out, self.ctx, node.lineno, "J300",
+                    f"Python `{kw}` on a traced value ({touch}) inside "
+                    f"a jit/scan/pallas body — use lax.cond/lax.select "
+                    f"(TracerBoolConversionError at trace time)",
+                )
+        if isinstance(node, ast.Call):
+            self._check_call(node)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child)
+
+    def _check_call(self, node: ast.Call) -> None:
+        callee = dotted_name(node.func)
+        if callee in HOST_SYNC_CALLS:
+            add_finding(
+                self.out, self.ctx, node.lineno, "J300",
+                f"host sync `{callee}(...)` inside a jit/scan/pallas "
+                f"body — forces a device->host transfer every step",
+            )
+            return
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in HOST_SYNC_METHODS
+        ):
+            add_finding(
+                self.out, self.ctx, node.lineno, "J300",
+                f"host sync `.{node.func.attr}()` inside a jit/scan/"
+                f"pallas body — forces a device->host transfer",
+            )
+            return
+        if callee in CAST_BUILTINS and node.args:
+            arg = node.args[0]
+            if _fully_static(arg):
+                return  # float(x.shape[0]) — static at trace time
+            touch = _expr_touches_array(arg)
+            if touch is None and isinstance(arg, ast.Name) and (
+                arg.id in self.params
+            ):
+                touch = f"parameter `{arg.id}`"
+            if touch:
+                add_finding(
+                    self.out, self.ctx, node.lineno, "J300",
+                    f"`{callee}()` over a traced value ({touch}) inside "
+                    f"a jit/scan/pallas body — host sync or trace error",
+                )
+
+
+@register
+class TracerSafetyPass:
+    name = "J300"
+    codes = ("J300",)
+    scope = "file"
+
+    def run(self, ctx: FileContext) -> List[Finding]:
+        if ctx.tree is None or not _is_workloads(ctx):
+            return []
+        out: List[Finding] = []
+        collector = _TracedCollector()
+        collector.visit(ctx.tree)
+        checker = _BodyChecker(ctx, out)
+        for fn in collector.traced:
+            checker.check(fn)
+        self._check_import_time_jnp(ctx, out)
+        out.sort(key=lambda f: f.lineno)
+        return out
+
+    def _check_import_time_jnp(
+        self, ctx: FileContext, out: List[Finding]
+    ) -> None:
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, ast.If):
+                # `if __name__ == "__main__":` runs at script time, not
+                # import time; everything else module-level still counts.
+                t = stmt.test
+                if (
+                    isinstance(t, ast.Compare)
+                    and dotted_name(t.left) == "__name__"
+                ):
+                    continue
+            for sub in _walk_import_time(stmt):
+                if isinstance(sub, ast.Call):
+                    callee = dotted_name(sub.func)
+                    if callee.startswith(("jnp.", "jax.numpy.")):
+                        add_finding(
+                            out, ctx, sub.lineno, "J300",
+                            f"`{callee}(...)` at module import time — "
+                            f"initializes the JAX backend as an import "
+                            f"side effect; build arrays lazily",
+                        )
+
+
+def _walk_import_time(stmt: ast.AST):
+    """Walk a module-level statement, skipping nested function bodies
+    (those do not run at import time)."""
+    stack = [stmt]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            stack.append(child)

@@ -1,0 +1,30 @@
+"""Shared test fixture builders.
+
+Helpers used by more than one test module live here (L500's test-tree
+rule: a ``test_*`` module must never import another ``test_*`` module
+— that couples collection order and import side effects between
+files; see docs/static-analysis.md).
+"""
+
+import uuid as uuidlib
+
+from tpu_dra.plugin.device_state import DRIVER_NAME
+
+
+def make_claim(devices=("tpu-0",), configs=None, uid=None, request="req0"):
+    """A minimal allocated ResourceClaim over stub devices."""
+    uid = uid or str(uuidlib.uuid4())
+    results = [
+        {"request": request, "driver": DRIVER_NAME, "pool": "node-0", "device": d}
+        for d in devices
+    ]
+    return {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {"name": f"claim-{uid[:6]}", "namespace": "default", "uid": uid},
+        "status": {
+            "allocation": {
+                "devices": {"results": results, "config": configs or []}
+            }
+        },
+    }

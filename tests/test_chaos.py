@@ -215,7 +215,9 @@ class ChaosHarness:
             daemon.socket_dir, client_name=f"chaos-{uid[:6]}"
         )
         client.acquire()
-        self.clients[uid] = client
+        # Harness state is driven from the test thread only (the chaos
+        # engine replays injectors synchronously).
+        self.clients[uid] = client  # lint: disable=R200
         return claim
 
     # --- injectors --------------------------------------------------------
@@ -253,7 +255,7 @@ class ChaosHarness:
                 client._sock.close()
                 client._sock = None
                 client._file = None
-                del self.clients[uid]
+                del self.clients[uid]  # lint: disable=R200 (test-thread only)
                 return
 
     def engine_for(self, schedule) -> ChaosEngine:

@@ -1,0 +1,1241 @@
+"""Tests for the hack/lints static-analysis suite (ISSUE 3).
+
+Every code the suite can emit — old and new — gets at least one
+positive fixture (the code fires), one negative fixture (a nearby
+correct idiom stays clean), and, where the disable marker applies, a
+``# lint: disable=`` case. Baseline semantics (shrink-only: stale
+entries fail, growth vs the committed copy fails) are covered against
+throwaway git repos, plus a guard that the checked-in baseline never
+grows relative to HEAD.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO / "hack") not in sys.path:
+    sys.path.insert(0, str(REPO / "hack"))
+
+from lints import baseline as baseline_mod  # noqa: E402
+from lints.base import FileContext, Finding, disabled_codes  # noqa: E402
+from lints.asyncblock import AsyncBlockingPass  # noqa: E402
+from lints.benchkeys import BenchSchemaPass  # noqa: E402
+from lints.chaosjson import ChaosSchedulePass  # noqa: E402
+from lints.cli import main as lint_main  # noqa: E402
+from lints.gates import GateDominancePass  # noqa: E402
+from lints.layering import LayeringPass, validate_dag  # noqa: E402
+from lints.legacy import CorePass  # noqa: E402
+from lints.names import UndefinedNamePass  # noqa: E402
+from lints.races import RaceLintPass  # noqa: E402
+from lints.tracer import TracerSafetyPass  # noqa: E402
+
+
+def write(tmp_path: Path, rel: str, source: str) -> Path:
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(source), encoding="utf-8")
+    return p
+
+
+def codes(tmp_path, rel, source, pass_cls):
+    ctx = FileContext(write(tmp_path, rel, source), REPO)
+    return [f.code for f in pass_cls().run(ctx)]
+
+
+# --- marker parsing ---------------------------------------------------------
+
+
+def test_disable_marker_plain_and_with_justification():
+    assert disabled_codes("x = 1  # lint: disable=R200") == {"R200"}
+    assert disabled_codes(
+        "x = 1  # lint: disable=R200,J300 (thread-confined; see _run)"
+    ) == {"R200", "J300"}
+    assert disabled_codes("x = 1  # no marker") == set()
+
+
+# --- core (legacy) codes ----------------------------------------------------
+
+
+def test_f401_unused_import(tmp_path):
+    assert codes(tmp_path, "a.py", "import os\n", CorePass) == ["F401"]
+
+
+def test_f401_negative_used_and_noqa(tmp_path):
+    assert codes(tmp_path, "a.py", "import os\nprint(os.sep)\n", CorePass) == []
+    assert codes(tmp_path, "a.py", "import os  # noqa\n", CorePass) == []
+
+
+def test_f811_redefinition(tmp_path):
+    src = "def f():\n    pass\n\n\ndef f():\n    pass\n"
+    assert codes(tmp_path, "a.py", src, CorePass) == ["F811"]
+
+
+def test_f811_negative_methods(tmp_path):
+    src = "class A:\n    def f(self):\n        pass\n\n\nclass B:\n    def f(self):\n        pass\n"
+    assert codes(tmp_path, "a.py", src, CorePass) == []
+
+
+def test_e722_bare_except(tmp_path):
+    src = "try:\n    pass\nexcept:\n    pass\n"
+    assert codes(tmp_path, "a.py", src, CorePass) == ["E722"]
+
+
+def test_e722_negative_typed(tmp_path):
+    src = "try:\n    pass\nexcept ValueError:\n    pass\n"
+    assert codes(tmp_path, "a.py", src, CorePass) == []
+
+
+def test_b006_mutable_default(tmp_path):
+    assert codes(tmp_path, "a.py", "def f(x=[]):\n    return x\n", CorePass) == ["B006"]
+
+
+def test_b006_negative_none_default(tmp_path):
+    assert codes(tmp_path, "a.py", "def f(x=None):\n    return x\n", CorePass) == []
+
+
+def test_f541_placeholderless_fstring(tmp_path):
+    assert codes(tmp_path, "a.py", "x = f'nope'\n", CorePass) == ["F541"]
+
+
+def test_f541_negative_format_spec(tmp_path):
+    # {v:.1f} carries a nested placeholder-less JoinedStr; not an f-string.
+    assert codes(tmp_path, "a.py", "v = 1.0\nx = f'{v:.1f}'\n", CorePass) == []
+
+
+def test_w605_invalid_escape_flagged(tmp_path):
+    ctx = FileContext(
+        write(tmp_path, "a.py", "import re\nre.compile('\\d+')\n"), REPO
+    )
+    out = CorePass().run(ctx)
+    # Byte-identical to the pre-package linter: on this Python, compile
+    # under warnings-as-errors surfaces the invalid escape as a
+    # SyntaxError (E999); older/newer interpreters may surface the
+    # Warning object itself (W605). Either way the gate fails with the
+    # escape named.
+    assert [f.code for f in out] in (["E999"], ["W605"])
+    assert "invalid escape sequence" in out[0].message
+
+
+def test_w605_negative_raw_string(tmp_path):
+    assert codes(tmp_path, "a.py", "import re\nre.compile(r'\\d+')\n", CorePass) == []
+
+
+def test_e999_syntax_error_short_circuits(tmp_path):
+    assert codes(tmp_path, "a.py", "def f(:\n", CorePass) == ["E999"]
+
+
+def test_core_disable_marker(tmp_path):
+    src = "try:\n    pass\nexcept:  # lint: disable=E722\n    pass\n"
+    assert codes(tmp_path, "a.py", src, CorePass) == []
+
+
+# --- F821 scoped undefined names --------------------------------------------
+
+
+def test_f821_typo_fires(tmp_path):
+    src = "def f():\n    return undefined_nam\n"
+    assert codes(tmp_path, "a.py", src, UndefinedNamePass) == ["F821"]
+
+
+def test_f821_negative_scoping_rules(tmp_path):
+    # Closures, class-body comprehension first-iterable, global/nonlocal,
+    # walrus hoisting, builtins, lambda params, match captures.
+    src = '''
+        import os
+
+        TOP = 1
+
+
+        def outer():
+            local = 2
+
+            def inner():
+                return local + TOP + len(os.sep)
+
+            return inner
+
+
+        class C:
+            xs = [1, 2]
+            ys = [x for x in xs]
+
+            def m(self):
+                return super().__init__()
+
+
+        def walrus(rows):
+            if (n := len(rows)) > 0:
+                return n
+            return 0
+
+
+        def declares_global():
+            global _late
+            _late = 3
+
+
+        def uses_global():
+            return _late
+
+
+        def matcher(obj):
+            match obj:
+                case {"k": v, **rest}:
+                    return v, rest
+                case [first, *others]:
+                    return first, others
+                case _:
+                    return None
+    '''
+    assert codes(tmp_path, "a.py", src, UndefinedNamePass) == []
+
+
+def test_f821_class_scope_invisible_to_methods(tmp_path):
+    src = '''
+        class C:
+            attr = 1
+
+            def m(self):
+                return attr
+    '''
+    assert codes(tmp_path, "a.py", src, UndefinedNamePass) == ["F821"]
+
+
+def test_f821_star_import_suppresses(tmp_path):
+    src = "from os.path import *\n\n\ndef f():\n    return join('a', 'b')\n"
+    assert codes(tmp_path, "a.py", src, UndefinedNamePass) == []
+
+
+def test_f821_disable_marker(tmp_path):
+    src = "def f():\n    return mystery  # lint: disable=F821\n"
+    assert codes(tmp_path, "a.py", src, UndefinedNamePass) == []
+
+
+# --- R200 lock-discipline race lint -----------------------------------------
+
+R200_POSITIVE = '''
+    import threading
+
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._state = {}
+
+        def start(self):
+            threading.Thread(target=self._run).start()
+            self._state["a"] = 1
+
+        def _run(self):
+            self._state["b"] = 2
+'''
+
+
+def test_r200_unlocked_shared_write_fires(tmp_path):
+    assert codes(tmp_path, "a.py", R200_POSITIVE, RaceLintPass) == [
+        "R200", "R200"
+    ]
+
+
+def test_r200_negative_writes_under_lock(tmp_path):
+    src = R200_POSITIVE.replace(
+        'self._state["a"] = 1',
+        'with self._lock:\n                self._state["a"] = 1',
+    ).replace(
+        'self._state["b"] = 2',
+        'with self._lock:\n                self._state["b"] = 2',
+    )
+    assert codes(tmp_path, "a.py", src, RaceLintPass) == []
+
+
+def test_r200_negative_not_concurrent(tmp_path):
+    src = '''
+        class Plain:
+            def a(self):
+                self.x = 1
+
+            def b(self):
+                self.x = 2
+    '''
+    assert codes(tmp_path, "a.py", src, RaceLintPass) == []
+
+
+def test_r200_negative_single_writer_method(tmp_path):
+    src = '''
+        import threading
+
+
+        class OneWriter:
+            def start(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+
+            def _run(self):
+                print("no shared writes here")
+    '''
+    assert codes(tmp_path, "a.py", src, RaceLintPass) == []
+
+
+def test_r200_annotated_lock_assignment_discovered(tmp_path):
+    """Review regression: `self._lock: threading.Lock =
+    threading.Lock()` must register as a lock."""
+    src = '''
+        import threading
+
+
+        class Annotated:
+            def __init__(self):
+                self._lock: threading.Lock = threading.Lock()
+                self._state = {}
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+                with self._lock:
+                    self._state["a"] = 1
+
+            def _run(self):
+                with self._lock:
+                    self._state["b"] = 2
+    '''
+    assert codes(tmp_path, "a.py", src, RaceLintPass) == []
+
+
+def test_r200_locked_suffix_convention(tmp_path):
+    src = '''
+        import threading
+
+
+        class Queue:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._items = []
+
+            def run_in_thread(self):
+                threading.Thread(target=self.run).start()
+
+            def run(self):
+                with self._cond:
+                    self._push_locked(1)
+
+            def _push_locked(self, item):
+                self._items.append(item)
+
+            def add(self, item):
+                with self._cond:
+                    self._items.append(item)
+    '''
+    assert codes(tmp_path, "a.py", src, RaceLintPass) == []
+
+
+def test_r200_negative_plain_attr_to_constructor_not_concurrent(tmp_path):
+    """Review regression: passing a plain self ATTRIBUTE (not a bound
+    method) to a capitalized callable — ValueError(self.root),
+    Path(self.base) — must not mark the class concurrent."""
+    src = '''
+        class SingleThreaded:
+            def __init__(self, root):
+                self.root = root
+                self.state = {}
+
+            def a(self):
+                self.state["a"] = 1
+                raise ValueError(self.root)
+
+            def b(self):
+                self.state["b"] = 2
+    '''
+    assert codes(tmp_path, "a.py", src, RaceLintPass) == []
+
+
+def test_r200_bound_method_to_constructor_is_concurrent(tmp_path):
+    src = '''
+        class HandsOutCallback:
+            def __init__(self):
+                self.state = {}
+                self.mon = Monitor(self._on_event)
+
+            def _on_event(self, ev):
+                self.state["e"] = ev
+
+            def poke(self):
+                self.state["p"] = 1
+    '''
+    assert codes(tmp_path, "a.py", src, RaceLintPass) == ["R200", "R200"]
+
+
+def test_r200_disable_marker(tmp_path):
+    src = R200_POSITIVE.replace(
+        'self._state["b"] = 2',
+        'self._state["b"] = 2  # lint: disable=R200 (why: test)',
+    ).replace(
+        'self._state["a"] = 1',
+        'self._state["a"] = 1  # lint: disable=R200',
+    )
+    assert codes(tmp_path, "a.py", src, RaceLintPass) == []
+
+
+# --- J300 tracer safety ------------------------------------------------------
+
+WL = "tpu_dra/workloads/snippet.py"
+
+
+def test_j300_host_sync_in_jit(tmp_path):
+    src = '''
+        import jax
+        import jax.numpy as jnp
+
+
+        @jax.jit
+        def f(x):
+            return float(jnp.sum(x))
+    '''
+    assert codes(tmp_path, WL, src, TracerSafetyPass) == ["J300"]
+
+
+def test_j300_item_in_scan_body(tmp_path):
+    src = '''
+        from jax import lax
+
+
+        def body(carry, x):
+            v = carry.item()
+            return carry, v
+
+
+        def outer(xs):
+            return lax.scan(body, 0.0, xs)
+    '''
+    assert codes(tmp_path, WL, src, TracerSafetyPass) == ["J300"]
+
+
+def test_j300_traced_branch(tmp_path):
+    src = '''
+        import jax
+        import jax.numpy as jnp
+
+
+        @jax.jit
+        def f(x):
+            if jnp.any(x > 0):
+                x = x + 1
+            return x
+    '''
+    assert codes(tmp_path, WL, src, TracerSafetyPass) == ["J300"]
+
+
+def test_j300_import_time_jnp(tmp_path):
+    src = "import jax.numpy as jnp\n\nX = jnp.ones((4,))\n"
+    assert codes(tmp_path, WL, src, TracerSafetyPass) == ["J300"]
+
+
+def test_j300_static_mention_does_not_mask_traced_use(tmp_path):
+    """Review regression: a shape read inside the expression must not
+    exempt a traced reduction next to it."""
+    src = '''
+        import jax
+        import jax.numpy as jnp
+
+
+        @jax.jit
+        def f(x):
+            m = float(jnp.sum(x) / x.shape[0])
+            if jnp.sum(x) > x.shape[0]:
+                m = m + 1
+            return m
+    '''
+    assert codes(tmp_path, WL, src, TracerSafetyPass) == ["J300", "J300"]
+
+
+def test_j300_bare_param_and_method_reduction_casts(tmp_path):
+    """Review regression: `float(x)` over a traced parameter and
+    `float(x.sum())` (zero-arg method on a traced receiver) are the
+    canonical per-step host syncs and must fire."""
+    src = '''
+        import jax
+
+
+        @jax.jit
+        def f(x):
+            a = float(x.sum())
+            b = float(x)
+            return a + b
+    '''
+    assert codes(tmp_path, WL, src, TracerSafetyPass) == ["J300", "J300"]
+
+
+def test_j300_negative_cast_of_local_python_scalar(tmp_path):
+    # A non-parameter local fed by static values stays unflagged.
+    src = '''
+        import jax
+
+
+        @jax.jit
+        def f(x, scale=2.0):
+            k = len(x.shape)
+            n = float(k)
+            return x, n
+    '''
+    assert codes(tmp_path, WL, src, TracerSafetyPass) == []
+
+
+def test_j300_negative_fully_static_jnp_over_shapes(tmp_path):
+    src = '''
+        import jax
+        import jax.numpy as jnp
+
+
+        @jax.jit
+        def f(x):
+            if jnp.prod(jnp.asarray(x.shape)) > 16:
+                x = x[:2]
+            n = float(x.shape[0])
+            return x, n
+    '''
+    assert codes(tmp_path, WL, src, TracerSafetyPass) == []
+
+
+def test_j300_negative_clean_patterns(tmp_path):
+    # Static branches, shape reads, lax.cond, host sync OUTSIDE jit,
+    # module-level attribute access (dtype), main-guard jnp calls.
+    src = '''
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        DTYPE = jnp.float32
+
+
+        @jax.jit
+        def f(x, flag: bool = True):
+            if flag:
+                x = x + 1
+            if x.shape[0] > 4:
+                x = x[:4]
+            return lax.cond(x[0] > 0, lambda v: v, lambda v: -v, x)
+
+
+        def host_side(x):
+            y = f(x)
+            return float(jnp.sum(y))
+
+
+        if __name__ == "__main__":
+            print(float(jnp.sum(jnp.ones((2,)))))
+    '''
+    assert codes(tmp_path, WL, src, TracerSafetyPass) == []
+
+
+def test_j300_scoped_to_workloads_only(tmp_path):
+    src = '''
+        import jax
+        import jax.numpy as jnp
+
+
+        @jax.jit
+        def f(x):
+            return float(jnp.sum(x))
+    '''
+    assert codes(tmp_path, "tpu_dra/plugin/snippet.py", src, TracerSafetyPass) == []
+
+
+def test_j300_disable_marker(tmp_path):
+    src = '''
+        import jax
+        import jax.numpy as jnp
+
+
+        @jax.jit
+        def f(x):
+            return float(jnp.sum(x))  # lint: disable=J300
+    '''
+    assert codes(tmp_path, WL, src, TracerSafetyPass) == []
+
+
+# --- G400 gate dominance -----------------------------------------------------
+
+GATED_MODULE = '''
+    __feature_gate__ = "AutoRemediation"
+
+
+    class RemediationController:
+        pass
+'''
+
+
+def g400(tmp_path, caller_src):
+    gated = FileContext(
+        write(tmp_path, "tpu_dra/plugin/remediation.py", GATED_MODULE), tmp_path
+    )
+    caller = FileContext(
+        write(tmp_path, "tpu_dra/plugin/driver.py", caller_src), tmp_path
+    )
+    return [f.code for f in GateDominancePass().run_project([gated, caller])]
+
+
+def test_g400_undominated_call_fires(tmp_path):
+    src = '''
+        from tpu_dra.plugin.remediation import RemediationController
+
+
+        def build():
+            return RemediationController()
+    '''
+    assert g400(tmp_path, src) == ["G400"]
+
+
+def test_g400_negative_dominated(tmp_path):
+    src = '''
+        from tpu_dra.infra import featuregates as fg
+        from tpu_dra.plugin.remediation import RemediationController
+
+
+        def build():
+            ctl = None
+            if fg.enabled(fg.AUTO_REMEDIATION):
+                ctl = RemediationController()
+            return ctl
+
+
+        def build_guarded():
+            if not fg.enabled(fg.AUTO_REMEDIATION):
+                return None
+            return RemediationController()
+    '''
+    assert g400(tmp_path, src) == []
+
+
+def test_g400_negative_string_gate_and_else_branch(tmp_path):
+    src = '''
+        from tpu_dra.infra.featuregates import enabled
+        from tpu_dra.plugin.remediation import RemediationController
+
+
+        def build():
+            if enabled("AutoRemediation"):
+                return RemediationController()
+            return None
+    '''
+    assert g400(tmp_path, src) == []
+
+
+def test_g400_negated_guard_without_return_does_not_establish(tmp_path):
+    """Review regression: `if not enabled(G):` must not establish G
+    inside its own (gate-OFF) branch — only a terminating guard
+    establishes it below, and only the ELSE branch runs gate-ON."""
+    src = '''
+        from tpu_dra.infra import featuregates as fg
+        from tpu_dra.plugin.remediation import RemediationController
+
+
+        def build():
+            ctl = None
+            if not fg.enabled(fg.AUTO_REMEDIATION):
+                ctl = RemediationController()
+            return ctl
+    '''
+    assert g400(tmp_path, src) == ["G400"]
+    src_else = '''
+        from tpu_dra.infra import featuregates as fg
+        from tpu_dra.plugin.remediation import RemediationController
+
+
+        def build():
+            if not fg.enabled(fg.AUTO_REMEDIATION):
+                return None
+            else:
+                return RemediationController()
+    '''
+    assert g400(tmp_path, src_else) == []
+
+
+def test_g400_else_branch_not_dominated(tmp_path):
+    src = '''
+        from tpu_dra.infra import featuregates as fg
+        from tpu_dra.plugin.remediation import RemediationController
+
+
+        def build():
+            if fg.enabled(fg.AUTO_REMEDIATION):
+                return None
+            else:
+                return RemediationController()
+    '''
+    assert g400(tmp_path, src) == ["G400"]
+
+
+def test_g400_module_object_import_forms(tmp_path):
+    # `from pkg import gated_module` and dotted `import` both route
+    # through the gate check.
+    src = '''
+        from tpu_dra.plugin import remediation
+
+
+        def build():
+            return remediation.RemediationController()
+    '''
+    assert g400(tmp_path, src) == ["G400"]
+    src2 = '''
+        import tpu_dra.plugin.remediation as rem
+        from tpu_dra.infra import featuregates as fg
+
+
+        def build():
+            if fg.enabled(fg.AUTO_REMEDIATION):
+                return rem.RemediationController()
+            return None
+    '''
+    assert g400(tmp_path, src2) == []
+
+
+def test_g400_or_alternative_does_not_establish(tmp_path):
+    """Review regression: `if enabled(G) or force:` — the or-branch is
+    reachable with the gate off, so the call is NOT dominated; `and`
+    still dominates."""
+    src = '''
+        from tpu_dra.infra import featuregates as fg
+        from tpu_dra.plugin.remediation import RemediationController
+
+
+        def build(force):
+            if fg.enabled(fg.AUTO_REMEDIATION) or force:
+                return RemediationController()
+            return None
+    '''
+    assert g400(tmp_path, src) == ["G400"]
+    src_and = '''
+        from tpu_dra.infra import featuregates as fg
+        from tpu_dra.plugin.remediation import RemediationController
+
+
+        def build(ready):
+            if fg.enabled(fg.AUTO_REMEDIATION) and ready:
+                return RemediationController()
+            return None
+    '''
+    assert g400(tmp_path, src_and) == []
+
+
+def test_g400_tests_exempt(tmp_path):
+    gated = FileContext(
+        write(tmp_path, "tpu_dra/plugin/remediation.py", GATED_MODULE), tmp_path
+    )
+    test_src = '''
+        from tpu_dra.plugin.remediation import RemediationController
+
+
+        def test_it():
+            return RemediationController()
+    '''
+    caller = FileContext(
+        write(tmp_path, "tests/test_thing.py", test_src), tmp_path
+    )
+    assert [f.code for f in GateDominancePass().run_project([gated, caller])] == []
+
+
+def test_g400_disable_marker(tmp_path):
+    src = '''
+        from tpu_dra.plugin.remediation import RemediationController
+
+
+        def build():
+            # Caller establishes the gate (see Driver.start).
+            return RemediationController()  # lint: disable=G400
+    '''
+    assert g400(tmp_path, src) == []
+
+
+def test_g400_real_remediation_module_declares_gate():
+    from tpu_dra.plugin import remediation
+
+    assert remediation.__feature_gate__ == "AutoRemediation"
+
+
+# --- L500 layering ------------------------------------------------------------
+
+
+def test_l500_dag_is_valid():
+    assert validate_dag() == []
+
+
+def test_l500_upward_import_fires(tmp_path):
+    src = "from tpu_dra.plugin.driver import Driver\n"
+    assert codes(tmp_path, "tpu_dra/tpulib/snippet.py", src, LayeringPass) == ["L500"]
+
+
+def test_l500_workloads_never_imported_by_driver_layer(tmp_path):
+    src = "from tpu_dra.workloads import generate\n"
+    assert codes(tmp_path, "tpu_dra/plugin/snippet.py", src, LayeringPass) == ["L500"]
+
+
+def test_l500_negative_downward_and_lazy(tmp_path):
+    src = '''
+        from tpu_dra.tpulib.types import ChipInfo
+
+
+        def late():
+            # Function-local imports are the sanctioned escape.
+            from tpu_dra.minicluster.cluster import MiniCluster
+
+            return MiniCluster, ChipInfo
+    '''
+    assert codes(tmp_path, "tpu_dra/plugin/snippet.py", src, LayeringPass) == []
+
+
+def test_l500_cross_test_import_fires(tmp_path):
+    src = "from tests.test_other import helper\n"
+    assert codes(tmp_path, "tests/test_snippet.py", src, LayeringPass) == ["L500"]
+
+
+def test_l500_relative_import_cannot_dodge_dag(tmp_path):
+    """Review regression: `from ..workloads import x` is the same edge
+    as `from tpu_dra.workloads import x`."""
+    src = "from ..workloads import generate\n"
+    assert codes(tmp_path, "tpu_dra/plugin/snippet.py", src, LayeringPass) == ["L500"]
+    ok = "from ..tpulib import types\nfrom . import cdi\n"
+    assert codes(tmp_path, "tpu_dra/plugin/snippet.py", ok, LayeringPass) == []
+
+
+def test_l500_from_tests_import_test_module_fires(tmp_path):
+    """Review regression: `from tests import test_x` and
+    `from . import test_x` are cross-test imports too."""
+    src = "from tests import test_other\n"
+    assert codes(tmp_path, "tests/test_snippet.py", src, LayeringPass) == ["L500"]
+    src2 = "from . import test_other\n"
+    assert codes(tmp_path, "tests/test_snippet.py", src2, LayeringPass) == ["L500"]
+    ok = "from fixtures import test_data_value\n"
+    assert codes(tmp_path, "tests/test_snippet.py", ok, LayeringPass) == []
+
+
+def test_l500_negative_helpers_import(tmp_path):
+    src = "from tests.helpers import make_claim\nprint(make_claim)\n"
+    assert codes(tmp_path, "tests/test_snippet.py", src, LayeringPass) == []
+
+
+def test_l500_disable_marker(tmp_path):
+    src = "from tests.test_other import helper  # lint: disable=L500\n"
+    assert codes(tmp_path, "tests/test_snippet.py", src, LayeringPass) == []
+
+
+# --- A600 blocking-in-async ---------------------------------------------------
+
+
+def test_a600_blocking_calls_fire(tmp_path):
+    src = '''
+        import subprocess
+        import time
+
+
+        async def handler():
+            time.sleep(1)
+            subprocess.run(["true"])
+    '''
+    assert codes(tmp_path, "a.py", src, AsyncBlockingPass) == ["A600", "A600"]
+
+
+def test_a600_negative_async_and_executor(tmp_path):
+    src = '''
+        import asyncio
+        import time
+
+
+        async def handler():
+            await asyncio.sleep(1)
+            loop = asyncio.get_running_loop()
+
+            def sync_work():
+                time.sleep(1)  # runs on the executor, not the loop
+
+            await loop.run_in_executor(None, sync_work)
+
+
+        def plain():
+            time.sleep(1)
+    '''
+    assert codes(tmp_path, "a.py", src, AsyncBlockingPass) == []
+
+
+def test_a600_disable_marker(tmp_path):
+    src = '''
+        import time
+
+
+        async def handler():
+            time.sleep(0)  # lint: disable=A600
+    '''
+    assert codes(tmp_path, "a.py", src, AsyncBlockingPass) == []
+
+
+# --- C900/C901 chaos schedules ------------------------------------------------
+
+
+def test_c900_invalid_json(tmp_path):
+    p = write(tmp_path, "bad.chaos.json", "{nope")
+    out = ChaosSchedulePass().run_schedule(p, REPO)
+    assert [f.code for f in out] == ["C900"]
+
+
+def test_c901_schema_violation_and_negative(tmp_path):
+    bad = write(tmp_path, "bad2.chaos.json", json.dumps({
+        "seed": 1, "events": [{"at": 0.0, "kind": "not-a-fault"}]
+    }))
+    assert "C901" in [f.code for f in ChaosSchedulePass().run_schedule(bad, REPO)]
+    good = sorted(REPO.rglob("*.chaos.json"))
+    assert good, "repo should carry at least one chaos schedule"
+    assert ChaosSchedulePass().run_schedule(good[0], REPO) == []
+
+
+# --- B100 bench schema --------------------------------------------------------
+
+
+def test_b100_dropped_key_fires_and_superset_passes(tmp_path):
+    write(tmp_path, "BENCH_r01.json", json.dumps(
+        {"parsed": {"keep": 1, "dropped": 2}}
+    ))
+    bench = write(tmp_path, "bench.py", (
+        "import json\n"
+        "print(json.dumps({'keep': 1}))\n"
+    ))
+    out = BenchSchemaPass().run(FileContext(bench, tmp_path))
+    assert [f.code for f in out] == ["B100"]
+    bench.write_text(
+        "import json\nprint(json.dumps({'keep': 1, 'dropped': 2, 'new': 3}))\n"
+    )
+    assert BenchSchemaPass().run(FileContext(bench, tmp_path)) == []
+
+
+# --- baseline semantics -------------------------------------------------------
+
+
+def _findings(path, n, code="R200"):
+    return [Finding(path, i + 1, code, "x") for i in range(n)]
+
+
+def test_baseline_suppresses_up_to_quota(tmp_path):
+    bpath = write(tmp_path, "lint-baseline.json", json.dumps({
+        "version": 1, "suppressions": {"pkg/a.py": {"R200": 2}}
+    }))
+    supp, probs = baseline_mod.load(bpath)
+    assert probs == []
+    target = tmp_path / "pkg" / "a.py"
+    reported, suppressed = baseline_mod.apply(
+        _findings(target, 2), supp, tmp_path, bpath
+    )
+    assert suppressed == 2 and reported == []
+
+
+def test_baseline_overflow_reports_extra(tmp_path):
+    bpath = write(tmp_path, "lint-baseline.json", json.dumps({
+        "version": 1, "suppressions": {"pkg/a.py": {"R200": 1}}
+    }))
+    supp, _ = baseline_mod.load(bpath)
+    target = tmp_path / "pkg" / "a.py"
+    reported, suppressed = baseline_mod.apply(
+        _findings(target, 3), supp, tmp_path, bpath
+    )
+    assert suppressed == 1 and len(reported) == 2
+
+
+def test_baseline_partial_run_does_not_condemn_unlinted_entries(tmp_path):
+    """A --changed-only / --select / single-file run must judge
+    staleness only for entries it could have refilled."""
+    bpath = write(tmp_path, "lint-baseline.json", json.dumps({
+        "version": 1,
+        "suppressions": {
+            "pkg/linted.py": {"R200": 1},
+            "pkg/untouched.py": {"R200": 1, "J300": 2},
+        },
+    }))
+    write(tmp_path, "pkg/linted.py", "x = 1\n")
+    write(tmp_path, "pkg/untouched.py", "x = 1\n")
+    supp, _ = baseline_mod.load(bpath)
+    # Only pkg/linted.py was linted this run; its quota went unspent.
+    reported, _ = baseline_mod.apply(
+        [], supp, tmp_path, bpath, linted_paths={"pkg/linted.py"}
+    )
+    assert [f.code for f in reported] == ["B901"]
+    assert "pkg/linted.py:R200" in reported[0].message
+    # Same run restricted to J300 only: the R200 quota is out of scope.
+    reported, _ = baseline_mod.apply(
+        [], supp, tmp_path, bpath,
+        linted_paths={"pkg/linted.py", "pkg/untouched.py"},
+        selected_codes={"J300"},
+    )
+    assert [f.code for f in reported] == ["B901"]
+    assert "pkg/untouched.py:J300" in reported[0].message
+
+
+def test_g400_nested_def_checked_once_with_def_site_gates(tmp_path):
+    """Review regression: a callback defined under a gate check must
+    inherit the def-site gates (no false positive), and an ungated
+    nested call must be reported exactly once."""
+    gated_ok = '''
+        from tpu_dra.infra import featuregates as fg
+        from tpu_dra.plugin.remediation import RemediationController
+
+
+        def outer(informer):
+            if fg.enabled(fg.AUTO_REMEDIATION):
+                def cb():
+                    return RemediationController()
+
+                informer.add_handler(cb)
+    '''
+    assert g400(tmp_path, gated_ok) == []
+    ungated_nested = '''
+        from tpu_dra.plugin.remediation import RemediationController
+
+
+        def outer(informer):
+            def cb():
+                return RemediationController()
+
+            informer.add_handler(cb)
+    '''
+    assert g400(tmp_path, ungated_nested) == ["G400"]
+
+
+def test_g400_discovers_gated_module_outside_linted_set(tmp_path):
+    """Review regression: a changed-only run that lints a caller but
+    not the gated module must still see the module's gate marker (via
+    run_project's extra_paths)."""
+    gated_path = write(tmp_path, "tpu_dra/plugin/remediation.py",
+                       GATED_MODULE)
+    caller_src = '''
+        from tpu_dra.plugin.remediation import RemediationController
+
+
+        def build():
+            return RemediationController()
+    '''
+    caller = FileContext(
+        write(tmp_path, "tpu_dra/plugin/driver.py", caller_src), tmp_path
+    )
+    # Only the caller is in the linted set; the gated module arrives
+    # through extra_paths (the full discovery list).
+    out = GateDominancePass().run_project(
+        [caller], extra_paths=[gated_path]
+    )
+    assert [f.code for f in out] == ["G400"]
+
+
+def test_baseline_entry_for_deleted_file_is_stale_even_on_partial_run(
+    tmp_path,
+):
+    """Review regression: a quota for a file that no longer exists can
+    never be refilled — B901 on every run, partial or not."""
+    bpath = write(tmp_path, "lint-baseline.json", json.dumps({
+        "version": 1, "suppressions": {"pkg/deleted.py": {"R200": 3}}
+    }))
+    supp, _ = baseline_mod.load(bpath)
+    reported, _ = baseline_mod.apply(
+        [], supp, tmp_path, bpath, linted_paths={"pkg/other.py"}
+    )
+    assert [f.code for f in reported] == ["B901"]
+    assert "no longer exists" in reported[0].message
+    # But an entry for an existing, merely-unlinted file stays quiet.
+    write(tmp_path, "pkg/alive.py", "x = 1\n")
+    bpath.write_text(json.dumps({
+        "version": 1, "suppressions": {"pkg/alive.py": {"R200": 1}}
+    }))
+    supp, _ = baseline_mod.load(bpath)
+    reported, _ = baseline_mod.apply(
+        [], supp, tmp_path, bpath, linted_paths={"pkg/other.py"}
+    )
+    assert reported == []
+
+
+def test_a600_nested_async_def_reported_once(tmp_path):
+    src = '''
+        import time
+
+
+        async def outer():
+            async def inner():
+                time.sleep(1)
+
+            return inner
+    '''
+    assert codes(tmp_path, "a.py", src, AsyncBlockingPass) == ["A600"]
+
+
+def test_baseline_stale_entry_fails_b901(tmp_path):
+    bpath = write(tmp_path, "lint-baseline.json", json.dumps({
+        "version": 1, "suppressions": {"pkg/a.py": {"R200": 2}}
+    }))
+    supp, _ = baseline_mod.load(bpath)
+    reported, suppressed = baseline_mod.apply([], supp, tmp_path, bpath)
+    assert [f.code for f in reported] == ["B901"]
+
+
+def test_baseline_unbaselinable_codes_rejected_b900(tmp_path):
+    bpath = write(tmp_path, "lint-baseline.json", json.dumps({
+        "version": 1, "suppressions": {"pkg/a.py": {"E999": 1}}
+    }))
+    supp, probs = baseline_mod.load(bpath)
+    assert supp == {} and [f.code for f in probs] == ["B900"]
+
+
+def test_baseline_malformed_b900(tmp_path):
+    bpath = write(tmp_path, "lint-baseline.json", "{nope")
+    supp, probs = baseline_mod.load(bpath)
+    assert supp == {} and [f.code for f in probs] == ["B900"]
+
+
+def _git(repo, *args):
+    return subprocess.run(
+        ["git", "-C", str(repo), *args], capture_output=True, text=True
+    )
+
+
+@pytest.fixture
+def git_repo(tmp_path):
+    if _git(tmp_path, "init").returncode != 0:
+        pytest.skip("git unavailable")
+    _git(tmp_path, "config", "user.email", "t@t")
+    _git(tmp_path, "config", "user.name", "t")
+    return tmp_path
+
+
+def test_baseline_growth_vs_head_fails_b902(git_repo):
+    bpath = write(git_repo, "hack/lint-baseline.json", json.dumps({
+        "version": 1, "suppressions": {"pkg/a.py": {"R200": 1}}
+    }))
+    _git(git_repo, "add", "-A")
+    assert _git(git_repo, "commit", "-m", "seed").returncode == 0
+    # Same counts: clean.
+    supp, _ = baseline_mod.load(bpath)
+    assert baseline_mod.check_growth_vs_head(supp, git_repo, bpath) == []
+    # Grown count and a brand-new entry: both fail.
+    bpath.write_text(json.dumps({
+        "version": 1,
+        "suppressions": {"pkg/a.py": {"R200": 2}, "pkg/b.py": {"J300": 1}},
+    }))
+    supp, _ = baseline_mod.load(bpath)
+    out = baseline_mod.check_growth_vs_head(supp, git_repo, bpath)
+    assert [f.code for f in out] == ["B902", "B902"]
+    # Shrunk: clean.
+    bpath.write_text(json.dumps({"version": 1, "suppressions": {}}))
+    supp, _ = baseline_mod.load(bpath)
+    assert baseline_mod.check_growth_vs_head(supp, git_repo, bpath) == []
+
+
+def test_committed_baseline_only_shrinks_vs_head():
+    """The checked-in baseline must never grow relative to HEAD — the
+    linter enforces it at runtime (B902); this pins it in CI too."""
+    bpath = REPO / "hack" / "lint-baseline.json"
+    assert bpath.exists(), "hack/lint-baseline.json must be checked in"
+    supp, probs = baseline_mod.load(bpath)
+    assert probs == []
+    blob = _git(REPO, "show", "HEAD:hack/lint-baseline.json")
+    if blob.returncode != 0:
+        return  # first landing: nothing to compare against
+    head = json.loads(blob.stdout).get("suppressions") or {}
+    for fk, codes_ in supp.items():
+        for code, count in codes_.items():
+            assert count <= head.get(fk, {}).get(code, 0), (
+                f"baseline grew for {fk}:{code} — the baseline only shrinks"
+            )
+
+
+# --- CLI integration ----------------------------------------------------------
+
+
+def test_cli_reports_findings_and_exits_1(tmp_path, capsys):
+    p = write(tmp_path, "scratch.py", "import os\n")
+    rc = lint_main([str(p), "--no-baseline"])
+    out = capsys.readouterr()
+    assert rc == 1
+    assert f"{p}:1: F401 'os' imported but unused" in out.out
+    assert "lint: pass core" in out.err
+    assert "finding(s)" in out.err
+
+
+def test_cli_clean_file_exits_0(tmp_path, capsys):
+    p = write(tmp_path, "scratch.py", "import os\nprint(os.sep)\n")
+    rc = lint_main([str(p), "--no-baseline"])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_cli_select_runs_only_named_passes(tmp_path, capsys):
+    p = write(tmp_path, "scratch.py", "import os\n")
+    rc = lint_main([str(p), "--no-baseline", "--select", "R200"])
+    out = capsys.readouterr()
+    assert rc == 0  # F401 pass not selected
+    assert "pass core" not in out.err and "pass R200" in out.err
+
+
+def test_cli_baseline_suppresses_then_b901_when_stale(tmp_path, capsys):
+    p = write(tmp_path, "scratch.py", "import os\n")
+    rel = p.resolve().relative_to(REPO).as_posix() if str(p).startswith(
+        str(REPO)
+    ) else p.as_posix()
+    bpath = write(tmp_path, "baseline.json", json.dumps({
+        "version": 1, "suppressions": {rel: {"F401": 1}}
+    }))
+    rc = lint_main([str(p), "--baseline", str(bpath)])
+    out = capsys.readouterr()
+    assert rc == 0 and "baselined" in out.err
+    # Fix the finding but keep the entry: stale -> B901, exit 1.
+    p.write_text("import os\nprint(os.sep)\n")
+    rc = lint_main([str(p), "--baseline", str(bpath)])
+    out = capsys.readouterr()
+    assert rc == 1 and "B901" in out.out
+
+
+def test_cli_synthetic_violations_of_every_new_code(tmp_path, capsys):
+    """Acceptance criterion: seeding a synthetic violation of each new
+    code makes `lint` exit 1 with path:line: CODE message."""
+    seeds = {
+        "F821": ("scratch_f821.py", "def f():\n    return typo_name\n"),
+        "R200": ("scratch_r200.py", textwrap.dedent(R200_POSITIVE)),
+        "J300": (
+            "tpu_dra/workloads/scratch_j300.py",
+            "import jax\nimport jax.numpy as jnp\n\n\n@jax.jit\n"
+            "def f(x):\n    return float(jnp.sum(x))\n",
+        ),
+        "L500": (
+            "tpu_dra/tpulib/scratch_l500.py",
+            "from tpu_dra.plugin.driver import Driver\nprint(Driver)\n",
+        ),
+        "A600": (
+            "scratch_a600.py",
+            "import time\n\n\nasync def f():\n    time.sleep(1)\n",
+        ),
+    }
+    for code, (rel, src) in seeds.items():
+        p = write(tmp_path, rel, src)
+        rc = lint_main([str(p), "--no-baseline", "--select", code])
+        out = capsys.readouterr()
+        assert rc == 1, f"{code} did not fail the run"
+        lines = [l for l in out.out.splitlines() if f": {code} " in l]
+        assert lines and lines[0].startswith(f"{p}:"), (code, out.out)
+        lineno_part = lines[0].split(f": {code} ")[0][len(str(p)) + 1:]
+        assert lineno_part.isdigit(), lines[0]
+
+
+def test_cli_g400_synthetic_violation_against_real_tree(tmp_path, capsys):
+    """G400 is project-scoped (needs the gated module in the same run):
+    lint the real remediation module plus a synthetic undominated
+    caller placed under tpu_dra/."""
+    caller = write(tmp_path, "scratch_g400.py", (
+        "from tpu_dra.plugin.remediation import RemediationController\n"
+        "\n"
+        "\n"
+        "def build(state, backend):\n"
+        "    return RemediationController(state, backend)\n"
+    ))
+    rc = lint_main([
+        str(REPO / "tpu_dra" / "plugin" / "remediation.py"),
+        str(caller), "--no-baseline", "--select", "G400",
+    ])
+    out = capsys.readouterr()
+    assert rc == 1
+    assert any(
+        l.startswith(f"{caller}:5: G400 ") for l in out.out.splitlines()
+    ), out.out

@@ -30,6 +30,8 @@ from tpu_dra.plugin.device_state import (
 from tpu_dra.plugin.sharing import MultiplexManager
 from tpu_dra.tpulib.stub import StubTpuLib
 
+from tests.helpers import make_claim  # noqa: F401  (re-export, used below)
+
 
 def gates(**kwargs):
     g = fg.FeatureGates()
@@ -55,24 +57,6 @@ def make_state(tmp_path, backend=None, stub_cfg=None, **kwargs):
         node_name="node-0",
         **kwargs,
     ), backend
-
-
-def make_claim(devices=("tpu-0",), configs=None, uid=None, request="req0"):
-    uid = uid or str(uuidlib.uuid4())
-    results = [
-        {"request": request, "driver": DRIVER_NAME, "pool": "node-0", "device": d}
-        for d in devices
-    ]
-    return {
-        "apiVersion": "resource.k8s.io/v1beta1",
-        "kind": "ResourceClaim",
-        "metadata": {"name": f"claim-{uid[:6]}", "namespace": "default", "uid": uid},
-        "status": {
-            "allocation": {
-                "devices": {"results": results, "config": configs or []}
-            }
-        },
-    }
 
 
 def opaque(params, requests=None):

@@ -12,7 +12,7 @@ from tpu_dra.plugin.device_state import DeviceState
 from tpu_dra.plugin.vfio import VfioError, VfioPciManager
 from tpu_dra.tpulib.stub import StubTpuLib
 
-from tests.test_plugin_device_state import make_claim
+from tests.helpers import make_claim
 
 
 def fabricate_vfio_sysfs(root, addresses, host_driver="google-tpu"):

@@ -16,8 +16,6 @@ import threading
 import time
 import uuid
 
-from tpu_dra.k8sclient import LEASES, ApiConflict, ResourceClient
-
 log = logging.getLogger(__name__)
 
 
@@ -25,6 +23,13 @@ class LeaderElector:
     """Lease-based leader election (simplified client-go leaderelection)."""
 
     def __init__(self, backend, config: "flags.LeaderElectionConfig"):
+        # Lazy import: `infra` sits below `k8sclient` in the layer DAG
+        # (L500) — k8sclient pulls infra.workqueue/cel at module level,
+        # so a module-level import here would be a package cycle. The
+        # function-local form is the sanctioned cross-layer escape
+        # (same as flags.py's KubeClient import).
+        from tpu_dra.k8sclient import LEASES, ResourceClient
+
         self.leases = ResourceClient(backend, LEASES)
         self.config = config
         self.identity = f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}"
@@ -34,6 +39,8 @@ class LeaderElector:
         return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
 
     def acquire_or_renew(self) -> bool:
+        from tpu_dra.k8sclient import ApiConflict  # see __init__ note
+
         name, ns = self.config.lease_name, self.config.namespace
         lease = self.leases.try_get(name, ns)
         if lease is None:

@@ -295,7 +295,7 @@ class WorkQueue:
         self._rl.forget(item)
         return True
 
-    def _finish_key(self, item: WorkItem, failed: bool) -> None:
+    def _finish_key_locked(self, item: WorkItem, failed: bool) -> None:
         """Post-callback bookkeeping for a keyed item, under the lock.
 
         Invariant: when this returns, either the key has no outstanding
@@ -337,7 +337,7 @@ class WorkQueue:
             self._inc("workqueue_failures_total")
             with self._cond:
                 if item.key:
-                    self._finish_key(item, failed=True)
+                    self._finish_key_locked(item, failed=True)
                 elif not self._dead_letter_locked(item):
                     self._push(item, self._rl.when(item))
                     self._inc("workqueue_retries_total")
@@ -345,6 +345,6 @@ class WorkQueue:
         else:
             with self._cond:
                 if item.key:
-                    self._finish_key(item, failed=False)
+                    self._finish_key_locked(item, failed=False)
                 else:
                     self._rl.forget(item)

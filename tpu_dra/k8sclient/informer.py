@@ -115,12 +115,17 @@ class Informer:
                     "informer initial sync failed (%s: %s); retrying",
                     type(e).__name__, e,
                 )
-                if self._watch is not None:
-                    try:
-                        self._watch.close()
-                    except Exception:  # noqa: BLE001
-                        pass
-                    self._watch = None
+                # Clear under the assignment lock (R200): stop() closes
+                # whatever watch it observes here — resetting the slot
+                # unlocked could race its close() with this teardown and
+                # leave the fresh stream registered but orphaned.
+                with self._watch_assign_lock:
+                    if self._watch is not None:
+                        try:
+                            self._watch.close()
+                        except Exception:  # noqa: BLE001
+                            pass
+                        self._watch = None
                 self._stopped.wait(self.resync_backoff)
         return False
 
@@ -165,7 +170,9 @@ class Informer:
                             # as HTTP 200 + in-stream ERROR(410); resuming
                             # from the same RV would loop forever. Drop
                             # the resume point so the resync relists.
-                            self._last_rv = None
+                            # _last_rv is confined to this informer
+                            # thread (every writer runs on it).
+                            self._last_rv = None  # lint: disable=R200
                         break
                     self._apply(event, obj, dispatch=True)
             except Exception as e:  # noqa: BLE001 — any broken stream
@@ -260,7 +267,9 @@ class Informer:
             return
         cur, new = self._rv_int(self._last_rv), self._rv_int(rv)
         if cur is None or (new is not None and new > cur):
-            self._last_rv = rv
+            # Thread-confined: _advance_rv's callers (_run's watch loop,
+            # _relist) all execute on the informer thread.
+            self._last_rv = rv  # lint: disable=R200
 
     def _apply(self, event: str, obj: dict, dispatch: bool) -> None:
         md = obj.get("metadata", {})

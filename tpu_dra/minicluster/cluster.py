@@ -148,7 +148,11 @@ class MiniCluster:
         # Allocation is a read-modify-write over shared cluster capacity:
         # concurrent admits must serialize it (kube-scheduler binds one
         # pod at a time for the same reason). Prepare/launch parallelize.
-        self._alloc_lock = threading.Lock()
+        # Reentrant, and it also guards the pod bookkeeping maps
+        # (next_attempt/_admitting/sandboxes/prepared/released/restarts)
+        # shared by the reconcile thread, the admit pool, and the pod
+        # reaper (R200).
+        self._alloc_lock = threading.RLock()
         self.ns_seen: Set[str] = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -672,9 +676,14 @@ class MiniCluster:
                         # by failing prepares must READ as Pending.
                         pod.setdefault("status", {})["phase"] = "Pending"
                         self._update_status_quiet(PODS, pod)
-                    if uid not in self._admitting:
-                        self._admitting.add(uid)
-                        self._admit_pool.submit(self._admit_async, pod)
+                    with self._alloc_lock:
+                        # Test-and-set under the lock: the pool thread
+                        # discards the uid in _admit_async's finally —
+                        # unlocked, a pod finishing admission right here
+                        # could be submitted twice.
+                        if uid not in self._admitting:
+                            self._admitting.add(uid)
+                            self._admit_pool.submit(self._admit_async, pod)
                 else:
                     self._sync_pod_status(pod, sandbox)
             except Exception:  # noqa: BLE001 — one broken pod must not
@@ -685,9 +694,10 @@ class MiniCluster:
                     pod["metadata"].get("namespace"),
                     pod["metadata"]["name"],
                 )
-                self.next_attempt[uid] = (
-                    time.monotonic() + PREPARE_BACKOFF_SECONDS
-                )
+                with self._alloc_lock:
+                    self.next_attempt[uid] = (
+                        time.monotonic() + PREPARE_BACKOFF_SECONDS
+                    )
         # Pods whose objects are gone: tear down.
         for uid in list(self.sandboxes):
             if uid not in seen_uids:
@@ -878,11 +888,13 @@ class MiniCluster:
                 "pod %s/%s admission failed; backing off",
                 pod["metadata"].get("namespace"), pod["metadata"]["name"],
             )
-            self.next_attempt[uid] = (
-                time.monotonic() + PREPARE_BACKOFF_SECONDS
-            )
+            with self._alloc_lock:
+                self.next_attempt[uid] = (
+                    time.monotonic() + PREPARE_BACKOFF_SECONDS
+                )
         finally:
-            self._admitting.discard(uid)
+            with self._alloc_lock:
+                self._admitting.discard(uid)
 
     def _admit_pod(self, pod: dict) -> None:
         uid = pod["metadata"]["uid"]
@@ -890,14 +902,17 @@ class MiniCluster:
         if self.next_attempt.get(uid, 0) > now:
             return
         with self._alloc_lock:
-            node = self._bind_pod(pod, uid, now)
+            node = self._bind_pod_locked(pod, uid, now)
         if node is None:
             return
         self._prepare_and_launch(pod, node)
 
-    def _bind_pod(self, pod: dict, uid: str, now: float) -> Optional[str]:
-        """Claims + allocation + reservation + node binding (under the
-        binder lock); returns the bound node or None to retry later."""
+    def _bind_pod_locked(
+        self, pod: dict, uid: str, now: float
+    ) -> Optional[str]:
+        """Claims + allocation + reservation + node binding; the caller
+        holds the binder lock (`_locked` suffix — R200 convention).
+        Returns the bound node or None to retry later."""
         ns = pod["metadata"].get("namespace", "default")
         claims = self._claims_of(pod)
         if claims is None:
@@ -1038,12 +1053,14 @@ class MiniCluster:
             )
             # Claims prepared before the failure stay prepared (prepare
             # is idempotent); the retry reuses them.
-            self.prepared.setdefault(uid, {}).update(prepared_here)
-            self.next_attempt[uid] = (
-                time.monotonic() + PREPARE_BACKOFF_SECONDS
-            )
+            with self._alloc_lock:
+                self.prepared.setdefault(uid, {}).update(prepared_here)
+                self.next_attempt[uid] = (
+                    time.monotonic() + PREPARE_BACKOFF_SECONDS
+                )
             return
-        self.prepared.setdefault(uid, {}).update(prepared_here)
+        with self._alloc_lock:
+            self.prepared.setdefault(uid, {}).update(prepared_here)
 
         # Per-container env: only the claims the container asks for —
         # explicit resources.claims refs, plus bridged extended-resource
@@ -1089,12 +1106,14 @@ class MiniCluster:
                 "pod %s/%s init: %s", ns, pod["metadata"]["name"],
                 sandbox.init_failed,
             )
-            self.next_attempt[uid] = (
-                time.monotonic() + PREPARE_BACKOFF_SECONDS
-            )
+            with self._alloc_lock:
+                self.next_attempt[uid] = (
+                    time.monotonic() + PREPARE_BACKOFF_SECONDS
+                )
             return
-        self.sandboxes[uid] = sandbox
-        self.next_attempt.pop(uid, None)
+        with self._alloc_lock:
+            self.sandboxes[uid] = sandbox
+            self.next_attempt.pop(uid, None)
 
     def _grpc_prepare(self, sock: Path, claim: dict) -> None:
         import grpc
@@ -1199,10 +1218,13 @@ class MiniCluster:
             # bumped restartCount, exponential-ish backoff. Claims stay
             # prepared — re-admission re-prepares idempotently.
             sandbox.kill()
-            self.sandboxes.pop(uid, None)
-            n = self.restarts.get(uid, 0) + 1
-            self.restarts[uid] = n
-            self.next_attempt[uid] = time.monotonic() + min(5.0, 0.5 * n)
+            with self._alloc_lock:
+                self.sandboxes.pop(uid, None)
+                n = self.restarts.get(uid, 0) + 1
+                self.restarts[uid] = n
+                self.next_attempt[uid] = (
+                    time.monotonic() + min(5.0, 0.5 * n)
+                )
             status = pod.setdefault("status", {})
             status["phase"] = "Running"
             status["conditions"] = [
@@ -1240,12 +1262,17 @@ class MiniCluster:
             self._release_pod_claims(pod["metadata"]["uid"], delete=False)
 
     def _teardown_pod(self, uid: str) -> None:
-        sandbox = self.sandboxes.pop(uid, None)
+        # Claim-the-sandbox under the lock: the reaper thread and the
+        # reconcile sweep both tear down; whoever pops kills. The kill
+        # itself runs unlocked (it waits on processes).
+        with self._alloc_lock:
+            sandbox = self.sandboxes.pop(uid, None)
         if sandbox is not None:
             sandbox.kill()
         self._release_pod_claims(uid, delete=True)
-        self.next_attempt.pop(uid, None)
-        self.released.discard(uid)
+        with self._alloc_lock:
+            self.next_attempt.pop(uid, None)
+            self.released.discard(uid)
 
     def _release_pod_claims(self, uid: str, delete: bool) -> None:
         """Pod done (terminal or deleted): unprepare what this pod held
@@ -1253,10 +1280,11 @@ class MiniCluster:
         entry, and deallocate standalone claims left unreserved. Claims
         created from templates are ownerRef'd to the pod — the owner GC
         deletes them on pod deletion, releasing their devices."""
-        if not delete and uid in self.released:
-            return
-        self.released.add(uid)
-        held = self.prepared.pop(uid, {})
+        with self._alloc_lock:
+            if not delete and uid in self.released:
+                return
+            self.released.add(uid)
+            held = self.prepared.pop(uid, {})
         for cuid, (cns, cname, driver, node) in held.items():
             claim = self._try_get(RESOURCE_CLAIMS, cns, cname)
             if claim is not None:

@@ -43,6 +43,11 @@ from tpu_dra.tpulib.types import ChipHealthEvent, ChipInfo
 
 log = logging.getLogger(__name__)
 
+# Gate registration for the G400 lint pass: any module calling into
+# this subsystem must dominate the call with a check of this gate
+# (driver.py does; see docs/static-analysis.md).
+__feature_gate__ = "AutoRemediation"
+
 REMEDIATION_ANNOTATION = "tpu.google.com/remediation"
 
 DEFAULT_DEBOUNCE_SECONDS = 30.0

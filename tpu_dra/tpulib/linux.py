@@ -196,7 +196,9 @@ class LinuxTpuLib(BaseTpuLib):
         if getattr(self, "_health_thread", None) is not None:
             return
         self._health_stop = threading.Event()
-        self._health_thread = threading.Thread(
+        # Owner-thread confined: start/stop are driver lifecycle calls
+        # (Driver.start/shutdown), never concurrent with each other.
+        self._health_thread = threading.Thread(  # lint: disable=R200
             target=self._health_poll_loop, args=(period,),
             daemon=True, name="tpulib-health-poller",
         )
@@ -207,7 +209,7 @@ class LinuxTpuLib(BaseTpuLib):
             return
         self._health_stop.set()
         self._health_thread.join(timeout=10)
-        self._health_thread = None
+        self._health_thread = None  # lint: disable=R200 (lifecycle; see start)
 
     def _probe_chip(self, chip: ChipInfo) -> Tuple[bool, str]:
         pci_dir = os.path.join(

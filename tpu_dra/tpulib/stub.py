@@ -203,7 +203,9 @@ class StubTpuLib(BaseTpuLib):
                         reason=str(raw.get("reason", "injected")),
                     ))
 
-        self._hm_thread = threading.Thread(
+        # Owner-thread confined: start/stop are driver lifecycle calls
+        # (Driver.start/shutdown), never concurrent with each other.
+        self._hm_thread = threading.Thread(  # lint: disable=R200
             target=loop, daemon=True, name="stub-health-file-poller"
         )
         self._hm_thread.start()
@@ -213,4 +215,4 @@ class StubTpuLib(BaseTpuLib):
             return
         self._hm_stop.set()
         self._hm_thread.join(timeout=5)
-        self._hm_thread = None
+        self._hm_thread = None  # lint: disable=R200 (lifecycle; see start)
