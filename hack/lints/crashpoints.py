@@ -1,0 +1,194 @@
+"""C700-C702 — crash-point registry discipline.
+
+The crash-matrix soak (tests/test_crash_matrix.py, `make crashmatrix`)
+enumerates ``tpu_dra.infra.crashpoint.CRASH_POINTS`` and proves recovery
+after a kill at every entry. That proof is only as strong as the
+bijection between the table and the ``crashpoint("...")`` call sites
+threaded through the driver:
+
+- **C700** — a call site whose name is not a single string literal, is
+  not dotted-namespaced (``component.operation.site``: at least three
+  lowercase dot-separated segments), or is missing from the canonical
+  table. A non-literal name can't be audited; an unregistered one would
+  raise at runtime but never be exercised by the matrix.
+- **C701** — the same name threaded at more than one call site: "crash
+  at X" must mean ONE instruction window, or a green matrix row proves
+  only that *some* of its windows recover.
+- **C702** — a table entry with no call site anywhere in ``tpu_dra``:
+  a point that fell out of the code during a refactor leaves the matrix
+  silently testing nothing at that row.
+
+Project scope: like G400, the pass sees the full discovery set (via
+``extra_paths``) so a changed-only run can't lose call sites in
+unchanged files. Tests/hack/demo are exempt from call-site collection —
+they *arm* points by name, they don't thread new ones.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from lints.base import FileContext, Finding, add_finding, dotted_name
+from lints.registry import register
+
+_NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+){2,}$")
+
+# The defining module: its own references to the table are not call sites.
+_REGISTRY_REL = "tpu_dra/infra/crashpoint.py"
+
+
+def _call_sites(tree: ast.Module) -> List[Tuple[int, object]]:
+    """(lineno, name-or-None) for every ``crashpoint(...)`` call; name is
+    the literal string when there is exactly one constant-str arg."""
+    out: List[Tuple[int, object]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted_name(node.func)
+        if not (callee == "crashpoint" or callee.endswith(".crashpoint")):
+            continue
+        name = None
+        if (
+            len(node.args) == 1
+            and not node.keywords
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            name = node.args[0].value
+        out.append((node.lineno, name))
+    return out
+
+
+@register
+class CrashPointPass:
+    name = "C700"
+    codes = ("C700", "C701", "C702")
+    scope = "project"
+
+    def _registry(self, repo_root: Path) -> Optional[Dict[str, str]]:
+        """AST-parse ``CRASH_POINTS`` out of the LINTED TREE's registry
+        module. Importing it instead would (a) pick up whatever
+        tpu_dra happens to be on sys.path/sys.modules — not the tree
+        under lint — and (b) run the module's env-arming side effect
+        inside the linter process. None when the tree has no registry
+        module (every call site is then unregistered by definition)."""
+        path = repo_root / _REGISTRY_REL
+        try:
+            tree = ast.parse(path.read_text(encoding="utf-8"))
+        except (OSError, SyntaxError):
+            return None
+        for node in ast.walk(tree):
+            if isinstance(node, ast.AnnAssign):
+                targets, value = [node.target], node.value
+            elif isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            else:
+                continue
+            if not any(
+                isinstance(t, ast.Name) and t.id == "CRASH_POINTS"
+                for t in targets
+            ) or not isinstance(value, ast.Dict):
+                continue
+            out: Dict[str, str] = {}
+            for k, v in zip(value.keys, value.values):
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    out[k.value] = (
+                        v.value
+                        if isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)
+                        else ""
+                    )
+            return out
+        return None
+
+    def run_project(self, ctxs: List[FileContext],
+                    extra_paths=()) -> List[Finding]:
+        out: List[Finding] = []
+        if not ctxs:
+            return out
+        repo_root = ctxs[0].repo_root
+        registry = self._registry(repo_root) or {}
+
+        # Phase 1: collect call sites over the FULL discovery set. Files
+        # outside the linted (possibly changed-only) subset still count
+        # toward uniqueness/coverage but never produce findings of their
+        # own on this run.
+        by_path = {str(c.path): c for c in ctxs}
+        seen: Dict[str, List[Tuple[FileContext, int]]] = {}
+        contexts = dict(by_path)
+        for path in extra_paths:
+            if str(path) not in contexts:
+                contexts[str(path)] = FileContext(Path(path), repo_root)
+        for ctx in contexts.values():
+            rel = ctx.rel_path
+            if ctx.tree is None or not rel.startswith("tpu_dra/"):
+                continue
+            if rel == _REGISTRY_REL:
+                continue
+            for lineno, cname in _call_sites(ctx.tree):
+                reportable = str(ctx.path) in by_path
+                if cname is None:
+                    if reportable:
+                        add_finding(
+                            out, ctx, lineno, "C700",
+                            "crashpoint() name must be a single string "
+                            "literal (an expression can't be audited "
+                            "against the canonical table)",
+                        )
+                    continue
+                if not _NAME_RE.match(cname):
+                    if reportable:
+                        add_finding(
+                            out, ctx, lineno, "C700",
+                            f"crash-point name {cname!r} is not dotted-"
+                            f"namespaced (component.operation.site, "
+                            f"lowercase)",
+                        )
+                    continue
+                if cname not in registry:
+                    if reportable:
+                        add_finding(
+                            out, ctx, lineno, "C700",
+                            f"crash-point {cname!r} is not registered in "
+                            f"the canonical table "
+                            f"({_REGISTRY_REL} CRASH_POINTS)",
+                        )
+                    continue
+                seen.setdefault(cname, []).append((ctx, lineno))
+
+        # Phase 2: uniqueness — one window per name.
+        for cname, sites in sorted(seen.items()):
+            if len(sites) < 2:
+                continue
+            where = ", ".join(
+                f"{c.rel_path}:{ln}" for c, ln in sites
+            )
+            for ctx, lineno in sites:
+                if str(ctx.path) in by_path:
+                    add_finding(
+                        out, ctx, lineno, "C701",
+                        f"crash-point {cname!r} is threaded at "
+                        f"{len(sites)} call sites ({where}); each name "
+                        f"must mark exactly one window",
+                    )
+
+        # Phase 3: coverage — every table entry has a call site. Filed
+        # against the registry module, and only when that module is in
+        # the LINTED set (a changed-only run that didn't touch the
+        # registry must not re-report its coverage). Matched by
+        # repo-relative path: the CLI hands contexts relative paths.
+        registry_ctx = next(
+            (c for c in ctxs if c.rel_path == _REGISTRY_REL), None
+        )
+        if registry_ctx is not None:
+            for cname in sorted(set(registry) - set(seen)):
+                out.append(Finding(
+                    registry_ctx.path, 0, "C702",
+                    f"registered crash-point {cname!r} has no "
+                    f"crashpoint() call site under tpu_dra/ — the crash "
+                    f"matrix would test nothing at that row",
+                ))
+        return out

@@ -1,0 +1,204 @@
+"""Deterministic crash-point drill across a REAL process boundary.
+
+The sibling test_crash_recovery_wire drill creates its kill window with a
+20s sleep inside create_subslice and races a SIGKILL into it. This drill
+uses the crash-point framework instead: ``TPU_DRA_CRASH_POINT`` pins the
+death to a named instruction (``plugin.prepare.before_wal_completed`` —
+devices materialized, CDI spec written, WAL never flipped) and the plugin
+executes ``os._exit(137)`` there on its own, no timing, no sleep.
+
+It also proves the OTHER half of this PR's recovery story end-to-end:
+the restarted plugin rolls the stale ``PrepareStarted`` entry back AT
+BOOT (Driver.start), before any kubelet retry — the orphan sub-slice is
+gone and the WAL is clean the moment the sockets come up.
+
+Both spawns run with the IDENTICAL environment — the supervisor shape
+(minicluster kubelet restarting a pod with ambient env passed through).
+One-shot semantics come from ``TPU_DRA_CRASH_STATE_DIR``: the dying
+process drops a ``<point>.fired`` marker, and the restart sees it and
+declines to re-arm instead of crash-looping.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import uuid
+
+import grpc
+import pytest
+import yaml
+
+from tpu_dra.infra.crashpoint import (
+    CRASH_EXIT_CODE,
+    CRASH_POINT_ENV,
+    CRASH_STATE_DIR_ENV,
+)
+from tpu_dra.plugin.device_state import DRIVER_NAME
+from tpu_dra.plugin.dra_service import DRA_SERVICE_NAME
+from tpu_dra.plugin.pb import dra_v1beta1_pb2 as drapb
+
+CLAIM_UID = str(uuid.uuid4())
+NODE = "node-crashpoint"
+POINT = "plugin.prepare.before_wal_completed"
+
+
+def _live_subslices(state_dir):
+    try:
+        return sorted(f for f in os.listdir(state_dir) if f.endswith(".json"))
+    except FileNotFoundError:
+        return []
+
+
+def _spawn_plugin(td, crash_point=""):
+    env = dict(os.environ)
+    env["TPU_DRA_STUB_CONFIG"] = str(td / "stub.yaml")
+    env.pop("TPU_DRA_CDI_HOOK", None)
+    if crash_point:
+        env[CRASH_POINT_ENV] = crash_point
+        env[CRASH_STATE_DIR_ENV] = str(td / "crash-state")
+    else:
+        env.pop(CRASH_POINT_ENV, None)
+        env.pop(CRASH_STATE_DIR_ENV, None)
+    log_path = td / f"plugin-{int(time.monotonic() * 1000)}.log"
+    log_f = open(log_path, "wb")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "tpu_dra.plugin.main",
+            "--backend", "stub",
+            "--fake-cluster",
+            "--fake-cluster-seed", str(td / "seed"),
+            "--node-name", NODE,
+            "--cdi-root", str(td / "cdi"),
+            "--plugin-data-dir", str(td / "plugin"),
+            "--kubelet-registrar-dir", str(td / "registry"),
+            "--cdi-hook", "",
+            "--feature-gates", "DynamicSubslice=true",
+            "-v", "4",
+        ],
+        env=env,
+        stdout=log_f,
+        stderr=subprocess.STDOUT,
+    )
+    log_f.close()
+    dra_sock = td / "plugin" / "dra.sock"
+    reg_sock = td / "registry" / f"{DRIVER_NAME}-reg.sock"
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if reg_sock.exists() and dra_sock.exists():
+            return proc, dra_sock
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"plugin died at startup:\n{log_path.read_text()[-4000:]}"
+            )
+        time.sleep(0.1)
+    proc.kill()
+    raise RuntimeError("plugin sockets never appeared")
+
+
+def _prepare_rpc(dra_sock, timeout=30):
+    req = drapb.NodePrepareResourcesRequest()
+    c = req.claims.add()
+    c.uid = CLAIM_UID
+    c.name = "crashpoint-claim"
+    c.namespace = "default"
+    with grpc.insecure_channel(f"unix://{dra_sock}") as ch:
+        fn = ch.unary_unary(
+            f"/{DRA_SERVICE_NAME}/NodePrepareResources",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=(
+                drapb.NodePrepareResourcesResponse.FromString
+            ),
+        )
+        return fn(req, timeout=timeout)
+
+
+def _checkpoint_claims(td):
+    path = td / "plugin" / "checkpoint.json"
+    try:
+        with open(path) as f:
+            top = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return (top.get("v2") or {}).get("preparedClaims") or {}
+
+
+@pytest.mark.usefixtures("tmp_path")
+def test_env_armed_crash_point_exits_and_boot_recovers(tmp_path):
+    td = tmp_path
+    (td / "seed").mkdir()
+    state_dir = td / "stub-state"
+    (td / "stub.yaml").write_text(yaml.safe_dump({
+        "generation": "v5e",
+        "hostname": NODE,
+        "chips": 4,
+        "state_dir": str(state_dir),
+    }))
+    claim = {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {
+            "name": "crashpoint-claim", "namespace": "default",
+            "uid": CLAIM_UID,
+        },
+        "status": {"allocation": {"devices": {"results": [{
+            "request": "r0", "driver": DRIVER_NAME,
+            "pool": NODE, "device": "tpu-ss-1x1-0-0-0",
+        }], "config": []}}},
+    }
+    (td / "seed" / "claim.json").write_text(json.dumps(claim))
+
+    # 1. Plugin armed to die at the named point. The Prepare RPC itself
+    #    triggers the death — no sleeps, no race.
+    proc, dra_sock = _spawn_plugin(td, crash_point=POINT)
+    try:
+        with pytest.raises(grpc.RpcError):
+            _prepare_rpc(dra_sock, timeout=30)
+        assert proc.wait(timeout=10) == CRASH_EXIT_CODE
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+
+    # Exactly the window the point names: WAL says PrepareStarted, the
+    # sub-slice is live on "silicon", the CDI spec exists.
+    entry = _checkpoint_claims(td).get(CLAIM_UID)
+    assert entry and entry.get("checkpointState") == "PrepareStarted"
+    assert _live_subslices(state_dir), "expected a live orphan sub-slice"
+
+    # The one-shot marker landed before the exit.
+    assert (td / "crash-state" / f"{POINT}.fired").exists()
+
+    # 2. Restart with the SAME env (the supervisor shape): the marker
+    #    keeps the point disarmed, and boot-time recovery must roll the
+    #    WAL back and obliterate the orphan BEFORE serving — no kubelet
+    #    retry needed.
+    for stale in (
+        td / "plugin" / "dra.sock",
+        td / "registry" / f"{DRIVER_NAME}-reg.sock",
+    ):
+        stale.unlink(missing_ok=True)
+    proc2, dra_sock2 = _spawn_plugin(td, crash_point=POINT)
+    try:
+        assert CLAIM_UID not in _checkpoint_claims(td)
+        assert _live_subslices(state_dir) == []
+
+        # 3. The kubelet retry converges to PrepareCompleted with exactly
+        #    one live sub-slice.
+        resp = _prepare_rpc(dra_sock2)
+        r = resp.claims[CLAIM_UID]
+        assert not r.error, r.error
+        assert len(r.devices) == 1
+        entry = _checkpoint_claims(td).get(CLAIM_UID)
+        assert entry and entry.get("checkpointState") == "PrepareCompleted"
+        assert len(_live_subslices(state_dir)) == 1
+    finally:
+        if proc2.poll() is None:
+            proc2.send_signal(signal.SIGTERM)
+            try:
+                proc2.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc2.kill()
+                proc2.wait(timeout=10)

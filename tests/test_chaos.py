@@ -29,6 +29,7 @@ import uuid as uuidlib
 
 import pytest
 
+from tpu_dra.infra import crashpoint as crashpoint_mod
 from tpu_dra.infra import featuregates as fg
 from tpu_dra.infra.chaos import (
     APISERVER_ERRORS,
@@ -36,6 +37,7 @@ from tpu_dra.infra.chaos import (
     CHIP_DOWN,
     CHIP_UP,
     CLIENT_DEATH,
+    CRASH,
     PLUGIN_CRASH,
     WATCH_DROP,
     ChaosEngine,
@@ -247,6 +249,20 @@ class ChaosHarness:
             old.remediation.stop()
         self.build_driver()
 
+    def inject_crash(self, ev):
+        """Process death pinned to a NAMED crash point: arm the point,
+        drive a checkpoint touch so the write-path points actually fire
+        mid-commit, then restart the driver over the persisted state. A
+        point outside the write path simply doesn't fire here (the arm
+        context disarms on exit) and the event degrades to plain process
+        death — still a valid fault."""
+        with crashpoint_mod.arm(ev.params["point"]):
+            try:
+                self.driver.state.checkpoints.update(lambda c: None)
+            except crashpoint_mod.SimulatedCrash:
+                pass
+        self.crash_plugin()
+
     def kill_client(self, ev=None):
         """Abrupt client death mid-lease: close the socket with no
         release; the arbiter must reap the lease on its own."""
@@ -263,6 +279,7 @@ class ChaosHarness:
         e.register(CHIP_DOWN, self.inject_chip_down)
         e.register(CHIP_UP, self.inject_chip_up)
         e.register(PLUGIN_CRASH, self.crash_plugin)
+        e.register(CRASH, self.inject_crash)
         e.register(CLIENT_DEATH, self.kill_client)
         if self.srv is not None:
             e.register(APISERVER_THROTTLE, lambda ev: self.srv.inject_faults(
@@ -391,6 +408,32 @@ def test_validate_schedule_requires_recovery():
         {"at": 1.0, "kind": "chip_up", "chip_index": 1},
     ]})
     assert errs
+
+
+def test_validate_schedule_crash_kind():
+    """crash events must name a point from the canonical crash-point
+    table; a renamed/unknown point fails the schema gate, not a soak."""
+    ok = {"events": [
+        {"at": 0.0, "kind": "crash",
+         "point": "checkpoint.write.before_replace"},
+    ]}
+    assert validate_schedule(ok) == []
+    for bad_point in ("", "nope.not.registered", 7, None):
+        errs = validate_schedule(
+            {"events": [{"at": 0.0, "kind": "crash", "point": bad_point}]}
+        )
+        assert errs, f"accepted bad crash point {bad_point!r}"
+
+
+def test_seeded_schedule_can_mix_crash_points():
+    """from_seed mixes crash events in (and they carry valid points)."""
+    found = []
+    for seed in range(40):
+        s = FaultSchedule.from_seed(seed, duration=4.0, chips=4)
+        found += [e for e in s if e.kind == CRASH]
+    assert found, "no crash events generated across 40 seeds"
+    for e in found:
+        assert e.params["point"] in crashpoint_mod.CRASH_POINTS
 
 
 def test_schedule_is_deterministic_per_seed():

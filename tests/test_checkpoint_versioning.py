@@ -1,0 +1,239 @@
+"""Checkpoint version round-trips and torn-file tolerance.
+
+The checkpoint file carries BOTH the V1 and V2 renderings (checkpoint.go
+MarshalCheckpoint) so a downgraded driver can still read its older
+schema. These tests pin that contract property-style — randomized
+checkpoints, seeded for reproducibility — plus the explicit torn-file
+fixtures the quarantine machinery must absorb: truncated JSON, a flipped
+CRC byte, an empty file, a leftover ``.tmp``.
+"""
+
+import json
+import os
+import random
+
+import pytest
+
+from tpu_dra.plugin.checkpoint import (
+    CLAIM_STATE_PREPARE_COMPLETED,
+    CLAIM_STATE_PREPARE_STARTED,
+    Checkpoint,
+    CheckpointManager,
+    ChecksumError,
+    PreparedClaim,
+    inspect_file,
+)
+from tpu_dra.plugin.prepared import (
+    KubeletDevice,
+    PreparedDevice,
+    PreparedDeviceGroup,
+    PreparedDevices,
+)
+
+
+def random_checkpoint(rng: random.Random) -> Checkpoint:
+    cp = Checkpoint()
+    for i in range(rng.randint(0, 6)):
+        uid = f"uid-{rng.randrange(10**9)}"
+        state = rng.choice(
+            [CLAIM_STATE_PREPARE_STARTED, CLAIM_STATE_PREPARE_COMPLETED]
+        )
+        devices = PreparedDevices()
+        for _ in range(rng.randint(0, 3)):
+            group = PreparedDeviceGroup()
+            for j in range(rng.randint(1, 3)):
+                pd = PreparedDevice(
+                    type=rng.choice(["tpu", "subslice-dynamic"]),
+                    device=KubeletDevice(
+                        requests=[f"r{j}"],
+                        pool_name="node-0",
+                        device_name=f"tpu-{rng.randrange(16)}",
+                        cdi_device_ids=[f"k8s.tpu.google.com/claim={uid}"],
+                    ),
+                    subslice_uuid=(
+                        f"tpuss-{rng.randrange(10**6)}"
+                        if rng.random() < 0.5 else ""
+                    ),
+                    runtime_env={"TPU_VISIBLE_DEVICES": str(j)},
+                    dev_paths=[f"/dev/accel{j}"],
+                )
+                group.devices.append(pd)
+            devices.append(group)
+        cp.prepared_claims[uid] = PreparedClaim(
+            checkpoint_state=state,
+            status={"allocation": {"devices": {"results": []}}}
+            if rng.random() < 0.5 else {},
+            prepared_devices=devices,
+            name=f"claim-{i}",
+            namespace="default",
+        )
+    return cp
+
+
+def as_comparable(cp: Checkpoint) -> dict:
+    return {
+        uid: c.to_dict() for uid, c in sorted(cp.prepared_claims.items())
+    }
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_v2_marshal_unmarshal_roundtrip_property(seed):
+    rng = random.Random(seed)
+    cp = random_checkpoint(rng)
+    again = Checkpoint.unmarshal(cp.marshal())
+    assert as_comparable(again) == as_comparable(cp)
+    # And marshalling is deterministic (byte-stable for diffing/backup).
+    assert cp.marshal() == again.marshal()
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_v2_to_v1_downgrade_upgrade_roundtrip_property(seed):
+    """A downgraded (V1-only) driver reads the same file: it sees exactly
+    the PrepareCompleted claims with their devices (in-flight detail is a
+    V2 concept); re-upgrading marks everything PrepareCompleted — the
+    documented checkpointv.go ToV2 assumption."""
+    rng = random.Random(1000 + seed)
+    cp = random_checkpoint(rng)
+    top = json.loads(cp.marshal())
+    del top["v2"]  # what a V1-era reader deserializes
+    v1_view = Checkpoint.unmarshal(json.dumps(top).encode())
+
+    completed = {
+        uid: c for uid, c in cp.prepared_claims.items()
+        if c.checkpoint_state == CLAIM_STATE_PREPARE_COMPLETED
+    }
+    assert set(v1_view.prepared_claims) == set(completed)
+    for uid, c in v1_view.prepared_claims.items():
+        assert c.checkpoint_state == CLAIM_STATE_PREPARE_COMPLETED
+        assert (
+            c.prepared_devices.device_names()
+            == completed[uid].prepared_devices.device_names()
+        )
+    # Upgrade what the old driver would persist: still stable.
+    again = Checkpoint.unmarshal(v1_view.marshal())
+    assert as_comparable(again) == as_comparable(v1_view)
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_v1_checksum_covers_v1_view_only_property(seed):
+    """The top-level checksum is over the V1 view alone — mutating V2
+    content must not invalidate a V1-only reader's checksum check."""
+    rng = random.Random(2000 + seed)
+    cp = random_checkpoint(rng)
+    top = json.loads(cp.marshal())
+    # Simulate a V1-era reader that never looks at "v2".
+    stripped = {"checksum": top["checksum"], "v1": top["v1"]}
+    Checkpoint.unmarshal(json.dumps(stripped).encode())  # must not raise
+
+
+# --- torn-file fixtures -----------------------------------------------------
+
+
+def seeded_manager(tmp_path):
+    cpm = CheckpointManager(str(tmp_path))
+    cpm.update(
+        lambda cp: cp.prepared_claims.__setitem__(
+            "u-torn",
+            PreparedClaim(checkpoint_state=CLAIM_STATE_PREPARE_COMPLETED,
+                          name="c", namespace="d"),
+        )
+    )
+    return cpm
+
+
+def reopened(tmp_path) -> Checkpoint:
+    """A fresh manager over the same dir (the restart analog)."""
+    return CheckpointManager(str(tmp_path)).get()
+
+
+def test_truncated_json_recovers_from_bak(tmp_path):
+    cpm = seeded_manager(tmp_path)
+    raw = open(cpm.path, "rb").read()
+    with open(cpm.path, "wb") as f:
+        f.write(raw[: len(raw) // 2])
+    with pytest.raises(ChecksumError):
+        inspect_file(cpm.path)
+    cp = reopened(tmp_path)
+    assert "u-torn" in cp.prepared_claims
+
+
+def test_flipped_crc_byte_recovers_from_bak(tmp_path):
+    cpm = seeded_manager(tmp_path)
+    raw = bytearray(open(cpm.path, "rb").read())
+    # Flip a byte INSIDE the serialized content (not the checksum field):
+    # the CRC no longer matches.
+    idx = raw.rindex(b"preparedClaims") + 3
+    raw[idx] ^= 0x20
+    with open(cpm.path, "wb") as f:
+        f.write(bytes(raw))
+    with pytest.raises(ChecksumError):
+        inspect_file(cpm.path)
+    cp = reopened(tmp_path)
+    assert "u-torn" in cp.prepared_claims
+    assert any(
+        ".corrupt-" in n for n in os.listdir(tmp_path)
+    ), "corrupt original must be quarantined for forensics"
+
+
+def test_empty_file_recovers_from_bak(tmp_path):
+    cpm = seeded_manager(tmp_path)
+    open(cpm.path, "wb").close()
+    with pytest.raises(ChecksumError):
+        inspect_file(cpm.path)
+    cp = reopened(tmp_path)
+    assert "u-torn" in cp.prepared_claims
+
+
+def test_leftover_tmp_is_swept_not_promoted(tmp_path):
+    """A crash between the temp write and os.replace leaves a .tmp whose
+    content was never committed: a restart discards it (WAL semantics)
+    and keeps the committed state."""
+    cpm = seeded_manager(tmp_path)
+    committed = open(cpm.path, "rb").read()
+    stray = Checkpoint()
+    stray.prepared_claims["u-never-committed"] = PreparedClaim(
+        checkpoint_state=CLAIM_STATE_PREPARE_COMPLETED
+    )
+    with open(cpm.path + ".tmp", "wb") as f:
+        f.write(stray.marshal())
+    cp = reopened(tmp_path)
+    assert "u-torn" in cp.prepared_claims
+    assert "u-never-committed" not in cp.prepared_claims
+    assert not os.path.exists(cpm.path + ".tmp")
+    assert open(cpm.path, "rb").read()  # committed file intact
+    assert committed  # (sanity)
+
+
+def test_pre_bak_checkpoint_gets_mirrored_at_init(tmp_path):
+    """Upgrade path: a healthy checkpoint written by a pre-.bak driver
+    must gain its mirror at the FIRST manager construction — otherwise
+    corruption arriving before the first update() would skip straight to
+    the lossy device-scan rebuild."""
+    cpm = seeded_manager(tmp_path)
+    os.remove(cpm.bak_path)  # what an upgraded node's disk looks like
+    cpm2 = CheckpointManager(str(tmp_path))
+    assert os.path.exists(cpm2.bak_path)
+    # The mirror is immediately good for recovery: corrupt main, reopen.
+    open(cpm2.path, "wb").close()
+    assert "u-torn" in reopened(tmp_path).prepared_claims
+
+
+def test_both_copies_bad_rebuild_hook(tmp_path):
+    """When main AND .bak are unreadable the rebuild hook supplies the
+    replacement (the driver wires a device-scan rebuild; default empty)."""
+    cpm = seeded_manager(tmp_path)
+    open(cpm.path, "wb").close()
+    open(cpm.bak_path, "wb").close()
+    calls = []
+
+    def rebuild():
+        calls.append(1)
+        cp = Checkpoint()
+        cp.prepared_claims["u-rebuilt"] = PreparedClaim(
+            checkpoint_state=CLAIM_STATE_PREPARE_COMPLETED
+        )
+        return cp
+
+    cp = CheckpointManager(str(tmp_path), rebuild=rebuild).get()
+    assert calls
+    assert list(cp.prepared_claims) == ["u-rebuilt"]

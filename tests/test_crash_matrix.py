@@ -1,0 +1,619 @@
+"""Crash-matrix soak: kill at EVERY registered crash point, restart, prove
+recovery (``make crashmatrix``).
+
+The WAL design in device_state.py claims that a plugin death at any
+instruction leaves a state the next boot converges from. This matrix makes
+that claim falsifiable: for each entry of the canonical crash-point table
+(``tpu_dra.infra.crashpoint.CRASH_POINTS``) it
+
+1. boots a driver stack over fresh node dirs,
+2. arms the point and drives the lifecycle phase that reaches it
+   (prepare / unprepare / checkpoint GC / CD-plugin prepare+unprepare),
+3. catches the :class:`SimulatedCrash` (the in-process SIGKILL analog —
+   the e2e wire drill covers the real ``os._exit`` flavor),
+4. "restarts": rebuilds tpulib + checkpoint manager + driver over the
+   SAME persisted dirs and runs the boot-time recovery path,
+5. asserts the invariants:
+
+   - the checkpoint is strictly loadable (no quarantine needed),
+   - no leftover ``.tmp`` files anywhere in the plugin data dir,
+   - no orphan sub-slices (live silicon == what completed claims vouch
+     for) and no sub-slice double-materialization,
+   - no overlapping prepared devices across completed claims,
+   - every CDI claim spec belongs to a checkpointed claim,
+   - the interrupted operation RETRIES to success (prepare is idempotent
+     after recovery; unprepare/GC converge to empty).
+
+Corrupt-checkpoint tolerance rides the same harness: a flipped byte at
+boot recovers from ``.bak``; flipping BOTH copies rebuilds from the
+device scan (CDI specs + live sub-slices) instead of crashing the plugin.
+"""
+
+import json
+import os
+
+import pytest
+
+from tpu_dra.infra import crashpoint as crashpoint_mod
+from tpu_dra.infra import featuregates as fg
+from tpu_dra.infra.crashpoint import CRASH_POINTS, SimulatedCrash, arm
+from tpu_dra.k8sclient import RESOURCE_CLAIMS, FakeCluster, ResourceClient
+from tpu_dra.computedomain.cdplugin.device_state import CDDeviceState
+from tpu_dra.computedomain import CD_DRIVER_NAME
+from tpu_dra.plugin.checkpoint import (
+    CLAIM_STATE_PREPARE_COMPLETED,
+    CLAIM_STATE_PREPARE_STARTED,
+    Checkpoint,
+    CheckpointManager,
+)
+from tpu_dra.plugin.cdi import CDIHandler
+from tpu_dra.plugin.device_state import DRIVER_NAME
+from tpu_dra.plugin.driver import Driver, DriverConfig
+from tpu_dra.tpulib.stub import StubTpuLib
+
+SUBSLICE_DEV = "tpu-ss-1x1-0-0-0"  # covers chip (0,0,0) == tpu-0
+CHIP_DEV = "tpu-3"
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    g = fg.FeatureGates()
+    g.set("DynamicSubslice", True)
+    fg.reset_for_tests(g)
+    crashpoint_mod.reset_for_tests()
+    yield
+    crashpoint_mod.reset_for_tests()
+    fg.reset_for_tests(fg.FeatureGates())
+
+
+def make_claim(devices, uid="claim-uid-1"):
+    """One request per device: a sub-slice may never share a request."""
+    results = [
+        {"request": f"r{i}", "driver": DRIVER_NAME, "pool": "node-0",
+         "device": d}
+        for i, d in enumerate(devices)
+    ]
+    return {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {
+            "name": f"claim-{uid[:8]}", "namespace": "default", "uid": uid,
+        },
+        "status": {
+            "allocation": {"devices": {"results": results, "config": []}}
+        },
+    }
+
+
+class MatrixHarness:
+    """The plugin stack over persistent node dirs; boot() is the process-
+    restart analog (fresh objects, same disk)."""
+
+    def __init__(self, tmp_path):
+        self.tmp = tmp_path
+        self.backend = FakeCluster()
+        self.driver = None
+
+    def boot(self) -> Driver:
+        lib = StubTpuLib(
+            config={"generation": "v5e", "hostname": "node-0", "chips": 4},
+            state_dir=str(self.tmp / "tpustate"),
+        )
+        cfg = DriverConfig(
+            node_name="node-0",
+            cdi_root=str(self.tmp / "cdi"),
+            plugin_data_dir=str(self.tmp / "plugin"),
+            kubelet_registrar_dir=str(self.tmp / "registry"),
+            start_grpc=False,
+            cdi_hook_source="",
+        )
+        self.driver = Driver(lib, self.backend, cfg)
+        self.driver.start()
+        return self.driver
+
+    # --- invariants -------------------------------------------------------
+
+    def assert_invariants(self):
+        d = self.driver
+        plugin_dir = str(self.tmp / "plugin")
+        # 1. Checkpoint strictly loadable, and no stray temp files.
+        with open(os.path.join(plugin_dir, "checkpoint.json"), "rb") as f:
+            cp = Checkpoint.unmarshal(f.read())
+        strays = [
+            n for n in os.listdir(plugin_dir) if n.endswith(".tmp")
+        ]
+        assert strays == [], f"leaked temp files: {strays}"
+        # 2. No claim may linger in PrepareStarted after boot recovery.
+        stuck = [
+            uid for uid, c in cp.prepared_claims.items()
+            if c.checkpoint_state == CLAIM_STATE_PREPARE_STARTED
+        ]
+        assert stuck == [], f"claims stuck in PrepareStarted: {stuck}"
+        # 3. No orphan silicon and no double-materialization: every live
+        #    sub-slice is vouched for by a checkpointed claim, exactly
+        #    once. (The converse may transiently not hold: a crash inside
+        #    unprepare leaves a claim vouching for already-torn-down
+        #    silicon until the kubelet retries — each scenario asserts
+        #    full convergence after its retry.)
+        vouched = []
+        for c in cp.prepared_claims.values():
+            for g in c.prepared_devices:
+                for pd in g.devices:
+                    if pd.subslice_uuid:
+                        vouched.append(pd.subslice_uuid)
+        assert len(vouched) == len(set(vouched)), (
+            f"sub-slice double-referenced: {vouched}"
+        )
+        live = sorted(ss.uuid for ss in d.tpulib.list_subslices())
+        orphans = set(live) - set(vouched)
+        assert not orphans, (
+            f"orphan sub-slices: {orphans} (vouched: {vouched})"
+        )
+        # 4. No overlapping prepared devices (by chip coordinate).
+        seen_coords = set()
+        for c in cp.prepared_claims.values():
+            for g in c.prepared_devices:
+                for pd in g.devices:
+                    adev = d.state.allocatable.get(pd.device.device_name)
+                    if adev is None:
+                        continue
+                    coords = set(adev.chip_coords())
+                    assert not (coords & seen_coords), (
+                        f"overlapping prepared devices at {coords}"
+                    )
+                    seen_coords |= coords
+        # 5. Every CDI claim spec belongs to a checkpointed claim.
+        for uid in d.cdi.list_claim_uids():
+            assert uid in cp.prepared_claims, (
+                f"orphan CDI spec for claim {uid}"
+            )
+
+
+# --- which lifecycle phase reaches each point -------------------------------
+
+PREPARE_POINTS = sorted(
+    p for p in CRASH_POINTS
+    if p.startswith(("checkpoint.write.", "plugin.prepare.",
+                     "tpulib.subslice."))
+)
+UNPREPARE_POINTS = sorted(
+    p for p in CRASH_POINTS if p.startswith("plugin.unprepare.")
+)
+GC_POINTS = sorted(p for p in CRASH_POINTS if p.startswith("plugin.gc."))
+CD_POINTS = sorted(p for p in CRASH_POINTS if p.startswith("cdplugin."))
+
+
+def test_matrix_covers_every_registered_point():
+    """The acceptance bar: every registered point is reachable by exactly
+    one scenario below, and the table is big enough to mean something."""
+    covered = PREPARE_POINTS + UNPREPARE_POINTS + GC_POINTS + CD_POINTS
+    assert sorted(covered) == sorted(CRASH_POINTS)
+    assert len(CRASH_POINTS) >= 12
+
+
+# --- the matrix -------------------------------------------------------------
+
+
+@pytest.mark.parametrize("point", PREPARE_POINTS)
+def test_crash_during_prepare_recovers(tmp_path, point):
+    h = MatrixHarness(tmp_path)
+    state = h.boot().state
+    claim = make_claim([SUBSLICE_DEV, CHIP_DEV])
+    with arm(point) as a:
+        with pytest.raises(SimulatedCrash):
+            state.prepare(claim)
+    assert a.fired, f"{point} never fired during prepare"
+
+    # Restart over the same disk; boot recovery rolls the WAL back.
+    state2 = h.boot().state
+    h.assert_invariants()
+    assert h.driver.checkpoints.get().prepared_claims == {}
+
+    # The kubelet retry converges, idempotently.
+    devs = state2.prepare(claim)
+    assert sorted(d.device_name for d in devs) == [CHIP_DEV, SUBSLICE_DEV]
+    devs2 = state2.prepare(claim)
+    assert sorted(d.device_name for d in devs2) == [CHIP_DEV, SUBSLICE_DEV]
+    cp = h.driver.checkpoints.get()
+    assert (
+        cp.prepared_claims[claim["metadata"]["uid"]].checkpoint_state
+        == CLAIM_STATE_PREPARE_COMPLETED
+    )
+    assert len(h.driver.tpulib.list_subslices()) == 1
+    h.assert_invariants()
+
+    # And unprepare returns the silicon.
+    state2.unprepare(claim["metadata"]["uid"])
+    assert h.driver.tpulib.list_subslices() == []
+    h.assert_invariants()
+
+
+@pytest.mark.parametrize("point", UNPREPARE_POINTS)
+def test_crash_during_unprepare_recovers(tmp_path, point):
+    h = MatrixHarness(tmp_path)
+    state = h.boot().state
+    claim = make_claim([SUBSLICE_DEV, CHIP_DEV])
+    state.prepare(claim)
+    with arm(point) as a:
+        with pytest.raises(SimulatedCrash):
+            state.unprepare(claim["metadata"]["uid"])
+    assert a.fired, f"{point} never fired during unprepare"
+
+    state2 = h.boot().state
+    h.assert_invariants()
+    # The kubelet retries Unprepare until it answers cleanly.
+    state2.unprepare(claim["metadata"]["uid"])
+    assert h.driver.checkpoints.get().prepared_claims == {}
+    assert h.driver.tpulib.list_subslices() == []
+    assert h.driver.cdi.list_claim_uids() == []
+    h.assert_invariants()
+
+
+@pytest.mark.parametrize("point", GC_POINTS)
+def test_crash_during_gc_recovers(tmp_path, point):
+    h = MatrixHarness(tmp_path)
+    driver = h.boot()
+    claim = make_claim([SUBSLICE_DEV, CHIP_DEV])
+    driver.state.prepare(claim)
+    # The claim's ResourceClaim never existed in the API server: the GC
+    # judges it stale on its first pass.
+    with arm(point) as a:
+        with pytest.raises(SimulatedCrash):
+            driver.cleanup.cleanup_once()
+    assert a.fired, f"{point} never fired during GC"
+
+    driver2 = h.boot()
+    h.assert_invariants()
+    driver2.cleanup.cleanup_once()  # retry pass converges
+    assert h.driver.checkpoints.get().prepared_claims == {}
+    assert h.driver.tpulib.list_subslices() == []
+    h.assert_invariants()
+
+
+def test_gc_skips_claims_the_apiserver_vouches_for(tmp_path):
+    """Guard for the matrix arrangement: a live ResourceClaim keeps its
+    prepared claim through a GC pass (only truly stale claims are in
+    play above)."""
+    h = MatrixHarness(tmp_path)
+    driver = h.boot()
+    claim = make_claim([CHIP_DEV])
+    created = ResourceClient(h.backend, RESOURCE_CLAIMS).create(claim)
+    claim["metadata"]["uid"] = created["metadata"]["uid"]
+    driver.state.prepare(claim)
+    assert driver.cleanup.cleanup_once() == 0
+    assert (
+        created["metadata"]["uid"]
+        in driver.checkpoints.get().prepared_claims
+    )
+
+
+# --- compute-domain plugin rows ---------------------------------------------
+
+
+CD_DOMAIN_UID = "bf8e1d9e-7d2b-4f80-9c8e-3a9f0a6a1c11"
+
+
+def make_cd_daemon_claim(uid="cd-claim-1", domain=CD_DOMAIN_UID):
+    return {
+        "metadata": {"name": f"dc-{uid[:6]}", "namespace": "default",
+                     "uid": uid},
+        "status": {
+            "allocation": {
+                "devices": {
+                    "results": [
+                        {
+                            "request": "cd-daemon",
+                            "driver": CD_DRIVER_NAME,
+                            "pool": "node-0-cd",
+                            "device": "daemon",
+                        }
+                    ],
+                    "config": [
+                        {
+                            "requests": ["cd-daemon"],
+                            "opaque": {
+                                "driver": CD_DRIVER_NAME,
+                                "parameters": {
+                                    "apiVersion": (
+                                        "resource.tpu.google.com/v1beta1"
+                                    ),
+                                    "kind": "ComputeDomainDaemonConfig",
+                                    "domainID": domain,
+                                },
+                            },
+                        }
+                    ],
+                }
+            }
+        },
+    }
+
+
+class CDMatrixHarness:
+    def __init__(self, tmp_path):
+        self.tmp = tmp_path
+        self.backend = FakeCluster()
+        self.state = None
+
+    def boot(self) -> CDDeviceState:
+        self.state = CDDeviceState(
+            self.backend,
+            cdi=CDIHandler(cdi_root=str(self.tmp / "cd-cdi")),
+            checkpoints=CheckpointManager(str(self.tmp / "cd-ckpt")),
+            node_name="node-0",
+            domains_dir=str(self.tmp / "domains"),
+        )
+        # CDDriver.start analog.
+        self.state.recover_stale_prepares()
+        return self.state
+
+    def assert_invariants(self):
+        ckpt_dir = str(self.tmp / "cd-ckpt")
+        with open(os.path.join(ckpt_dir, "checkpoint.json"), "rb") as f:
+            cp = Checkpoint.unmarshal(f.read())
+        strays = [n for n in os.listdir(ckpt_dir) if n.endswith(".tmp")]
+        assert strays == [], f"leaked temp files: {strays}"
+        stuck = [
+            uid for uid, c in cp.prepared_claims.items()
+            if c.checkpoint_state == CLAIM_STATE_PREPARE_STARTED
+        ]
+        assert stuck == [], f"CD claims stuck in PrepareStarted: {stuck}"
+        for uid in self.state.cdi.list_claim_uids():
+            assert uid in cp.prepared_claims, f"orphan CD CDI spec {uid}"
+
+
+@pytest.mark.parametrize("point", CD_POINTS)
+def test_cd_crash_recovers(tmp_path, point):
+    h = CDMatrixHarness(tmp_path)
+    state = h.boot()
+    claim = make_cd_daemon_claim()
+    uid = claim["metadata"]["uid"]
+    domain_dir = tmp_path / "domains" / CD_DOMAIN_UID
+    if point.startswith("cdplugin.prepare."):
+        with arm(point) as a:
+            with pytest.raises(SimulatedCrash):
+                state.prepare(claim)
+    else:
+        state.prepare(claim)
+        with arm(point) as a:
+            with pytest.raises(SimulatedCrash):
+                state.unprepare(uid)
+    assert a.fired, f"{point} never fired"
+
+    state2 = h.boot()
+    h.assert_invariants()
+    # Retry to the terminal state of the interrupted operation.
+    if point.startswith("cdplugin.prepare."):
+        # Boot rollback removed the orphaned per-domain config dir a
+        # crashed daemon prepare may have created (no other claim
+        # references the domain) — even if the claim is never retried.
+        assert not domain_dir.exists()
+        devs = state2.prepare(claim)
+        assert [d.device_name for d in devs] == ["daemon"]
+        devs2 = state2.prepare(claim)
+        assert [d.device_name for d in devs2] == ["daemon"]
+    state2.unprepare(uid)
+    assert state2.checkpoints.get().prepared_claims == {}
+    assert not domain_dir.exists()
+    h.assert_invariants()
+
+
+# --- corrupt-checkpoint boot tolerance --------------------------------------
+
+
+def _flip_byte(path, offset=20):
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def test_corrupt_checkpoint_at_boot_recovers_from_bak(tmp_path):
+    h = MatrixHarness(tmp_path)
+    state = h.boot().state
+    claim = make_claim([SUBSLICE_DEV])
+    state.prepare(claim)
+    ckpt = tmp_path / "plugin" / "checkpoint.json"
+    _flip_byte(str(ckpt))
+
+    driver2 = h.boot()  # must not raise
+    cp = driver2.checkpoints.get()
+    assert (
+        cp.prepared_claims[claim["metadata"]["uid"]].checkpoint_state
+        == CLAIM_STATE_PREPARE_COMPLETED
+    )
+    # The sub-slice survived recovery (the claim still vouches for it).
+    assert len(driver2.tpulib.list_subslices()) == 1
+    quarantined = [
+        n for n in os.listdir(tmp_path / "plugin") if ".corrupt-" in n
+    ]
+    assert len(quarantined) == 1, quarantined
+    h.assert_invariants()
+
+
+def test_corrupt_checkpoint_and_bak_rebuilds_from_device_scan(tmp_path):
+    h = MatrixHarness(tmp_path)
+    state = h.boot().state
+    claim = make_claim([SUBSLICE_DEV])
+    state.prepare(claim)
+    _flip_byte(str(tmp_path / "plugin" / "checkpoint.json"))
+    _flip_byte(str(tmp_path / "plugin" / "checkpoint.json.bak"))
+
+    driver2 = h.boot()  # must not raise: rebuild from CDI specs + silicon
+    cp = driver2.checkpoints.get()
+    uid = claim["metadata"]["uid"]
+    assert (
+        cp.prepared_claims[uid].checkpoint_state
+        == CLAIM_STATE_PREPARE_COMPLETED
+    )
+    assert cp.prepared_claims[uid].prepared_devices.device_names() == [
+        SUBSLICE_DEV
+    ]
+    # Startup obliteration must NOT destroy the re-attached sub-slice.
+    assert len(driver2.tpulib.list_subslices()) == 1
+    # Idempotent prepare short-circuits on the rebuilt record.
+    devs = driver2.state.prepare(claim)
+    assert [d.device_name for d in devs] == [SUBSLICE_DEV]
+    assert len(driver2.tpulib.list_subslices()) == 1
+    # And unprepare still returns the silicon.
+    driver2.state.unprepare(uid)
+    assert driver2.tpulib.list_subslices() == []
+    h.assert_invariants()
+
+
+def test_rebuild_skips_torn_cdi_spec_instead_of_failing_boot(tmp_path):
+    """The disk incident that ate both checkpoint copies may have torn a
+    CDI spec too: the rebuild loses THAT claim (its devices swept), never
+    the boot."""
+    h = MatrixHarness(tmp_path)
+    state = h.boot().state
+    good = make_claim([CHIP_DEV], uid="good-claim-uid")
+    torn = make_claim([SUBSLICE_DEV], uid="torn-claim-uid")
+    state.prepare(good)
+    state.prepare(torn)
+    _flip_byte(str(tmp_path / "plugin" / "checkpoint.json"))
+    _flip_byte(str(tmp_path / "plugin" / "checkpoint.json.bak"))
+    spec_path = h.driver.cdi.spec_path("torn-claim-uid")
+    with open(spec_path, "w") as f:
+        f.write("{half a spe")
+
+    driver2 = h.boot()  # must not raise
+    cp = driver2.checkpoints.get()
+    assert "good-claim-uid" in cp.prepared_claims
+    assert "torn-claim-uid" not in cp.prepared_claims
+    # The torn claim's sub-slice was swept by startup obliteration
+    # (nothing vouches for it anymore).
+    assert driver2.tpulib.list_subslices() == []
+
+
+def test_cd_corrupt_checkpoint_rebuilds_from_cdi_scan(tmp_path):
+    """CD analog of the device-scan rebuild: both copies corrupt, claims
+    come back from the CD CDI specs — including the CD_UID env a daemon
+    claim's unprepare needs to remove its per-domain config dir (without
+    the rebuild, unprepare would no-op and leak spec + dir forever)."""
+    from tpu_dra.computedomain.cdplugin.driver import CDDriver, CDDriverConfig
+
+    backend = FakeCluster()
+
+    def boot():
+        d = CDDriver(backend, CDDriverConfig(
+            node_name="node-0",
+            cdi_root=str(tmp_path / "cd-cdi"),
+            plugin_data_dir=str(tmp_path / "cd-plugin"),
+            kubelet_registrar_dir=str(tmp_path / "cd-reg"),
+            start_grpc=False,
+        ))
+        d.state.recover_stale_prepares()
+        return d
+
+    driver = boot()
+    claim = make_cd_daemon_claim()
+    uid = claim["metadata"]["uid"]
+    driver.state.prepare(claim)
+    domain_dir = tmp_path / "cd-plugin" / "domains" / CD_DOMAIN_UID
+    assert domain_dir.is_dir()
+    _flip_byte(str(tmp_path / "cd-plugin" / "checkpoint.json"))
+    _flip_byte(str(tmp_path / "cd-plugin" / "checkpoint.json.bak"))
+
+    driver2 = boot()  # must not raise; rebuild from CDI scan
+    cp = driver2.checkpoints.get()
+    assert (
+        cp.prepared_claims[uid].checkpoint_state
+        == CLAIM_STATE_PREPARE_COMPLETED
+    )
+    pd = cp.prepared_claims[uid].prepared_devices[0].devices[0]
+    assert pd.runtime_env.get("CD_UID") == CD_DOMAIN_UID
+    # Unprepare on the rebuilt record cleans up everything.
+    driver2.state.unprepare(uid)
+    assert driver2.cdi.list_claim_uids() == []
+    assert not domain_dir.exists()
+    assert driver2.checkpoints.get().prepared_claims == {}
+
+
+def test_double_crash_during_heal_still_rebuilds(tmp_path):
+    """Both copies corrupt AND the plugin dies mid-heal-write: the next
+    boot finds no committed checkpoint at all, only the quarantine file —
+    that evidence must still route to the device-scan rebuild, not to an
+    empty checkpoint that would let startup obliteration destroy live
+    claims' silicon."""
+    h = MatrixHarness(tmp_path)
+    state = h.boot().state
+    claim = make_claim([SUBSLICE_DEV])
+    state.prepare(claim)
+    _flip_byte(str(tmp_path / "plugin" / "checkpoint.json"))
+    _flip_byte(str(tmp_path / "plugin" / "checkpoint.json.bak"))
+
+    # Crash 2: the heal write itself (quarantine already happened).
+    with arm("checkpoint.write.before_replace") as a:
+        with pytest.raises(SimulatedCrash):
+            h.boot()
+    assert a.fired
+    assert not (tmp_path / "plugin" / "checkpoint.json").exists()
+
+    driver3 = h.boot()  # crash 3 never comes; recovery must be complete
+    uid = claim["metadata"]["uid"]
+    cp = driver3.checkpoints.get()
+    assert (
+        cp.prepared_claims[uid].checkpoint_state
+        == CLAIM_STATE_PREPARE_COMPLETED
+    )
+    assert len(driver3.tpulib.list_subslices()) == 1
+    h.assert_invariants()
+
+
+def test_empty_checkpoint_file_is_quarantined_not_fatal(tmp_path):
+    h = MatrixHarness(tmp_path)
+    h.boot()
+    (tmp_path / "plugin" / "checkpoint.json").write_text("")
+    h.boot()  # must not raise
+    h.assert_invariants()
+
+
+# --- the crash fault kind composes with the chaos schema --------------------
+
+
+def test_chaos_crash_event_drives_matrix_row(tmp_path):
+    """A seeded-soak-shaped drill: a schedule's crash event kills the
+    plugin at a named WAL point mid-prepare; restart converges."""
+    from tpu_dra.infra.chaos import CRASH, ChaosEngine, FaultSchedule
+
+    schedule = FaultSchedule.from_dict({
+        "version": 1,
+        "events": [
+            {"at": 0.0, "kind": "crash",
+             "point": "plugin.prepare.before_wal_completed"},
+        ],
+    })
+    h = MatrixHarness(tmp_path)
+    state = h.boot().state
+    claim = make_claim([SUBSLICE_DEV])
+
+    def inject(ev):
+        with arm(ev.params["point"]) as a:
+            try:
+                state.prepare(claim)
+            except SimulatedCrash:
+                pass
+        assert a.fired
+
+    engine = ChaosEngine(schedule).register(CRASH, inject)
+    engine.run(time_scale=0)
+    assert engine.errors == []
+    assert engine.fired == {"crash": 1}
+
+    state2 = h.boot().state
+    h.assert_invariants()
+    devs = state2.prepare(claim)
+    assert [d.device_name for d in devs] == [SUBSLICE_DEV]
+    h.assert_invariants()
+
+
+def test_crash_points_registry_shape():
+    """Names are dotted component.operation.site and the JSON round-trip
+    used by schedules/tools stays stable."""
+    for name in CRASH_POINTS:
+        parts = name.split(".")
+        assert len(parts) >= 3, name
+        assert all(p and p.replace("_", "a").isalnum() for p in parts), name
+    json.dumps(sorted(CRASH_POINTS))  # serializable for tooling

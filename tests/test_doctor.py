@@ -139,6 +139,63 @@ def test_orphan_spec_with_empty_checkpoint_still_warns(tmp_path):
     assert "aaaa-1111" in warns and "no checkpoint entry" in warns
 
 
+def test_corrupt_checkpoint_warns_readonly_with_bak_verdict(tmp_path):
+    """A CRC-failing checkpoint WARNs with the recovery verdict — and the
+    doctor must NOT heal/quarantine it (read-only diagnostic)."""
+    state, lib = make_state(tmp_path)
+    state.prepare(claim("aaaa-1111"))
+    ckpt = tmp_path / "data" / "checkpoint.json"
+    raw = ckpt.read_text()
+    ckpt.write_text(raw.replace("PrepareCompleted", "PrepareCorrupted"))
+    mutated = ckpt.read_text()
+    report = run_collect(tmp_path, lib)
+    warns = "\n".join(report["warnings"])
+    assert "CORRUPT" in warns
+    assert "recover from it at next boot" in warns  # .bak is readable
+    assert report["checkpoint"]["corrupt"]
+    # Read-only: the corrupt file is untouched, nothing quarantined.
+    assert ckpt.read_text() == mutated
+    assert not [
+        n for n in os.listdir(tmp_path / "data") if ".corrupt-" in n
+    ]
+    # No false orphan-spec accusations off an unreadable claim table.
+    assert "no checkpoint entry" not in warns
+    assert "CORRUPT" in render(report)
+
+
+def test_corrupt_checkpoint_and_bak_warns_device_scan_verdict(tmp_path):
+    state, lib = make_state(tmp_path)
+    state.prepare(claim("aaaa-1111"))
+    (tmp_path / "data" / "checkpoint.json").write_text("{torn")
+    (tmp_path / "data" / "checkpoint.json.bak").write_text("")
+    report = run_collect(tmp_path, lib)
+    warns = "\n".join(report["warnings"])
+    assert "ALSO unreadable" in warns and "device scan" in warns
+
+
+def test_leftover_tmp_and_quarantine_files_warn(tmp_path):
+    state, lib = make_state(tmp_path)
+    state.prepare(claim("aaaa-1111"))
+    (tmp_path / "data" / "checkpoint.json.tmp").write_text("{half a wri")
+    (tmp_path / "data" / "checkpoint.json.corrupt-1700000000000").write_text(
+        "{was corrupt}"
+    )
+    report = run_collect(tmp_path, lib)
+    warns = "\n".join(report["warnings"])
+    assert "leftover checkpoint temp file" in warns
+    assert "NEVER rename it over checkpoint.json" in warns
+    assert "quarantined corrupt checkpoint" in warns
+    assert report["checkpoint"]["residue"]["tmp"] == [
+        "checkpoint.json.tmp"
+    ]
+    assert report["checkpoint"]["residue"]["quarantined"] == [
+        "checkpoint.json.corrupt-1700000000000"
+    ]
+    out = render(report)
+    assert "interrupted write" in out and "(quarantined)" in out
+    # Exit code 1 (probe-friendly) comes from the warnings as usual.
+
+
 def test_missing_cdi_root_is_noted_not_created(tmp_path):
     state, lib = make_state(tmp_path)
     bogus = tmp_path / "no-such-cdi"

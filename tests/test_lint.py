@@ -27,6 +27,7 @@ from lints.asyncblock import AsyncBlockingPass  # noqa: E402
 from lints.benchkeys import BenchSchemaPass  # noqa: E402
 from lints.chaosjson import ChaosSchedulePass  # noqa: E402
 from lints.cli import main as lint_main  # noqa: E402
+from lints.crashpoints import CrashPointPass  # noqa: E402
 from lints.gates import GateDominancePass  # noqa: E402
 from lints.layering import LayeringPass, validate_dag  # noqa: E402
 from lints.legacy import CorePass  # noqa: E402
@@ -887,6 +888,206 @@ def test_c901_schema_violation_and_negative(tmp_path):
     good = sorted(REPO.rglob("*.chaos.json"))
     assert good, "repo should carry at least one chaos schedule"
     assert ChaosSchedulePass().run_schedule(good[0], REPO) == []
+
+
+# --- C700/C701/C702 crash-point registry discipline ---------------------------
+
+
+# The synthetic tree's canonical table: the pass AST-parses this file
+# from the linted tree (never imports the real module).
+C700_REGISTRY_SRC = '''
+CRASH_POINTS = {
+    "plugin.prepare.after_wal_started": "doc",
+    "plugin.unprepare.after_teardown": "doc",
+}
+'''
+
+
+def c700(tmp_path, rel, source):
+    write(tmp_path, "tpu_dra/infra/crashpoint.py", C700_REGISTRY_SRC)
+    ctx = FileContext(write(tmp_path, rel, source), tmp_path)
+    return CrashPointPass().run_project([ctx], extra_paths=[ctx.path])
+
+
+def test_c700_non_literal_name(tmp_path):
+    src = '''
+        from tpu_dra.infra.crashpoint import crashpoint
+
+
+        def f(name):
+            crashpoint(name)
+    '''
+    out = c700(tmp_path, "tpu_dra/plugin/scratch.py", src)
+    assert [f.code for f in out] == ["C700"]
+
+
+def test_c700_not_dotted_namespaced(tmp_path):
+    # The name must read component.operation.site; a flat name gives the
+    # matrix no way to group points by lifecycle phase.
+    src = '''
+        from tpu_dra.infra.crashpoint import crashpoint
+
+
+        def f():
+            crashpoint("justonename")
+    '''
+    out = c700(tmp_path, "tpu_dra/plugin/scratch.py", src)
+    assert [f.code for f in out] == ["C700"]
+
+
+def test_c700_unregistered_name(tmp_path):
+    src = '''
+        from tpu_dra.infra.crashpoint import crashpoint
+
+
+        def f():
+            crashpoint("plugin.prepare.never_registered_anywhere")
+    '''
+    out = c700(tmp_path, "tpu_dra/plugin/scratch.py", src)
+    assert [f.code for f in out] == ["C700"]
+
+
+def test_c701_duplicate_call_sites(tmp_path):
+    # Registered name (real registry), threaded twice.
+    src = '''
+        from tpu_dra.infra.crashpoint import crashpoint
+
+
+        def f():
+            crashpoint("plugin.prepare.after_wal_started")
+
+
+        def g():
+            crashpoint("plugin.prepare.after_wal_started")
+    '''
+    out = c700(tmp_path, "tpu_dra/plugin/scratch.py", src)
+    assert [f.code for f in out] == ["C701", "C701"]
+
+
+def test_c700_negative_unique_registered_names(tmp_path):
+    src = '''
+        from tpu_dra.infra import crashpoint as cpt
+
+
+        def f():
+            cpt.crashpoint("plugin.prepare.after_wal_started")
+            cpt.crashpoint("plugin.unprepare.after_teardown")
+    '''
+    assert c700(tmp_path, "tpu_dra/plugin/scratch.py", src) == []
+
+
+def test_c700_tests_and_hack_trees_exempt(tmp_path):
+    # Arming helpers in tests may spell crashpoint() freely; only
+    # tpu_dra/ threads count as call sites.
+    src = '''
+        def crashpoint(name):
+            return name
+
+
+        crashpoint("whatever")
+    '''
+    assert c700(tmp_path, "tests/scratch.py", src) == []
+
+
+def test_c702_registered_point_with_no_call_site(tmp_path):
+    # Table registers two points, the tree threads one: the other is an
+    # untested matrix row, filed against the (linted) registry module.
+    registry = write(
+        tmp_path, "tpu_dra/infra/crashpoint.py", C700_REGISTRY_SRC
+    )
+    caller = write(tmp_path, "tpu_dra/plugin/scratch.py", (
+        "from tpu_dra.infra.crashpoint import crashpoint\n"
+        "\n"
+        "\n"
+        "def f():\n"
+        "    crashpoint('plugin.prepare.after_wal_started')\n"
+    ))
+    ctxs = [FileContext(caller, tmp_path), FileContext(registry, tmp_path)]
+    out = CrashPointPass().run_project(ctxs, extra_paths=[caller, registry])
+    c702 = [f for f in out if f.code == "C702"]
+    assert len(c702) == 1, out
+    assert "plugin.unprepare.after_teardown" in c702[0].message
+    assert c702[0].path == registry
+
+
+def test_c700_registry_parsed_from_linted_tree_not_import(tmp_path):
+    """The table comes from the TREE under lint (AST), never from the
+    importable tpu_dra: a name only the synthetic tree registers passes,
+    and a name only the REAL module registers fails."""
+    write(tmp_path, "tpu_dra/infra/crashpoint.py", (
+        'CRASH_POINTS = {"synthetic.only.point": "doc"}\n'
+    ))
+    ok = FileContext(write(tmp_path, "tpu_dra/plugin/a.py", (
+        "from tpu_dra.infra.crashpoint import crashpoint\n"
+        "\n"
+        "\n"
+        "def f():\n"
+        "    crashpoint('synthetic.only.point')\n"
+    )), tmp_path)
+    assert CrashPointPass().run_project([ok], extra_paths=[ok.path]) == []
+    real_only = FileContext(write(tmp_path, "tpu_dra/plugin/b.py", (
+        "from tpu_dra.infra.crashpoint import crashpoint\n"
+        "\n"
+        "\n"
+        "def g():\n"
+        "    crashpoint('checkpoint.write.before_tmp')\n"
+    )), tmp_path)
+    out = CrashPointPass().run_project(
+        [real_only], extra_paths=[real_only.path]
+    )
+    assert [f.code for f in out] == ["C700"]
+
+
+def test_c700_tree_without_registry_marks_all_unregistered(tmp_path):
+    src = '''
+        from tpu_dra.infra.crashpoint import crashpoint
+
+
+        def f():
+            crashpoint("plugin.prepare.after_wal_started")
+    '''
+    ctx = FileContext(
+        write(tmp_path, "tpu_dra/plugin/scratch.py", src), tmp_path
+    )
+    out = CrashPointPass().run_project([ctx], extra_paths=[ctx.path])
+    assert [f.code for f in out] == ["C700"]
+
+
+def test_c700_disable_marker(tmp_path):
+    src = '''
+        from tpu_dra.infra.crashpoint import crashpoint
+
+
+        def f(name):
+            crashpoint(name)  # lint: disable=C700 (driven by the matrix)
+    '''
+    assert c700(tmp_path, "tpu_dra/plugin/scratch.py", src) == []
+
+
+def test_c700_changed_only_keeps_cross_file_uniqueness(tmp_path):
+    """A changed-only run linting one file must still see a duplicate
+    call site living in an UNCHANGED file (via extra_paths), and report
+    only on the linted file."""
+    write(tmp_path, "tpu_dra/infra/crashpoint.py", C700_REGISTRY_SRC)
+    linted = FileContext(write(tmp_path, "tpu_dra/plugin/a.py", (
+        "from tpu_dra.infra.crashpoint import crashpoint\n"
+        "\n"
+        "\n"
+        "def f():\n"
+        "    crashpoint('plugin.prepare.after_wal_started')\n"
+    )), tmp_path)
+    unchanged = write(tmp_path, "tpu_dra/plugin/b.py", (
+        "from tpu_dra.infra.crashpoint import crashpoint\n"
+        "\n"
+        "\n"
+        "def g():\n"
+        "    crashpoint('plugin.prepare.after_wal_started')\n"
+    ))
+    out = CrashPointPass().run_project(
+        [linted], extra_paths=[linted.path, unchanged]
+    )
+    assert [f.code for f in out] == ["C701"]
+    assert out[0].path == linted.path
 
 
 # --- B100 bench schema --------------------------------------------------------

@@ -7,6 +7,7 @@ checkpoint V1→V2 migration.
 """
 
 import json
+import os
 import uuid as uuidlib
 
 import pytest
@@ -430,7 +431,12 @@ def test_config_type_mismatch_rejected(tmp_path):
 # --- checkpoint format ------------------------------------------------------
 
 
-def test_checkpoint_corruption_detected(tmp_path):
+def test_checkpoint_corruption_quarantined_and_recovered(tmp_path):
+    """A CRC-failing checkpoint is no longer fatal: the bad file is
+    quarantined as checkpoint.json.corrupt-<ts> (kept for forensics) and
+    the last-good .bak is promoted, with NO data loss for committed
+    claims. Checkpoint.unmarshal itself stays strict (the doctor's
+    read-only inspect path depends on that)."""
     cpm = CheckpointManager(str(tmp_path))
     cpm.update(
         lambda cp: cp.prepared_claims.__setitem__(
@@ -440,8 +446,20 @@ def test_checkpoint_corruption_detected(tmp_path):
     raw = open(cpm.path).read()
     with open(cpm.path, "w") as f:
         f.write(raw.replace("PrepareCompleted", "PrepareCorrupted"))
+    from tpu_dra.plugin.checkpoint import Checkpoint
+
     with pytest.raises(ChecksumError):
-        cpm.get()
+        Checkpoint.unmarshal(open(cpm.path, "rb").read())
+    cp = cpm.get()
+    assert "u1" in cp.prepared_claims  # recovered from .bak
+    quarantined = [
+        n for n in os.listdir(tmp_path) if ".corrupt-" in n
+    ]
+    assert len(quarantined) == 1, quarantined
+    # The healed file is committed back: a direct strict read succeeds.
+    assert "u1" in Checkpoint.unmarshal(
+        open(cpm.path, "rb").read()
+    ).prepared_claims
 
 
 def test_checkpoint_v1_migration(tmp_path):

@@ -33,6 +33,7 @@ from tpu_dra.computedomain import (
     NUM_CHANNELS,
 )
 from tpu_dra.computedomain.daemon.bootstrap import read_bootstrap_env
+from tpu_dra.infra.crashpoint import crashpoint
 from tpu_dra.k8sclient import COMPUTE_DOMAINS, NODES, ResourceClient
 from tpu_dra.plugin.cdi import CDIHandler
 from tpu_dra.plugin.checkpoint import (
@@ -196,6 +197,7 @@ class CDDeviceState:
                 ),
             )
         )
+        crashpoint("cdplugin.prepare.after_wal_started")
 
         if isinstance(config, configapi.ComputeDomainChannelConfig):
             prepared = self._prepare_channel(claim, config, results)
@@ -207,6 +209,7 @@ class CDDeviceState:
             )
 
         self.cdi.create_claim_spec_file(claim_uid, prepared)
+        crashpoint("cdplugin.prepare.before_wal_completed")
         self.checkpoints.update(
             lambda c: c.prepared_claims.__setitem__(
                 claim_uid,
@@ -361,9 +364,69 @@ class CDDeviceState:
                         self.domain_config_dir(cd_uid), ignore_errors=True
                     )
             self.cdi.delete_claim_spec_file(claim_uid)
+            crashpoint("cdplugin.unprepare.before_wal_removed")
             self.checkpoints.update(
                 lambda c: c.prepared_claims.pop(claim_uid, None)
             )
+
+    def recover_stale_prepares(self) -> List[str]:
+        """Boot-time rollback of CD claims stuck in ``PrepareStarted``
+        (the CD analog of DeviceState.recover_stale_prepares): a CD claim
+        holds no silicon, so rollback is dropping the orphaned CDI spec,
+        the WAL entry, and — for a daemon claim whose domain no other
+        claim references — the per-domain config dir ``_prepare_daemon``
+        already created; the periodic label GC then releases the node's
+        CD label once nothing references the domain."""
+        cp = self.checkpoints.get()
+        rolled: List[str] = []
+        for uid, claim in sorted(cp.prepared_claims.items()):
+            if claim.checkpoint_state != CLAIM_STATE_PREPARE_STARTED:
+                continue
+            log.warning(
+                "boot recovery: rolling back stale CD PrepareStarted "
+                "claim %s (%s/%s)", uid, claim.namespace, claim.name,
+            )
+            with self._lock:
+                self.cdi.delete_claim_spec_file(uid)
+                self.checkpoints.update(
+                    lambda c: c.prepared_claims.pop(uid, None)
+                )
+                self._rollback_daemon_config_dir(uid, claim)
+            rolled.append(uid)
+        return rolled
+
+    def _rollback_daemon_config_dir(
+        self, claim_uid: str, claim: PreparedClaim
+    ) -> None:
+        """A crashed DAEMON-claim prepare may have left its per-domain
+        config dir behind (``_prepare_daemon`` creates it before the WAL
+        flips to completed), and with no prepared_devices record the
+        normal unprepare rmtree never runs. The stored claim status names
+        the device and the domain. Channel claims never touch the dir —
+        and a domain any OTHER claim still references keeps it (it is a
+        shared mount)."""
+        results = (
+            claim.status.get("allocation", {}).get("devices", {}).get(
+                "results", []
+            )
+        )
+        is_daemon = any(
+            r.get("driver") == CD_DRIVER_NAME
+            and r.get("device") == DAEMON_DEVICE_NAME
+            for r in results
+        )
+        domain = self._domain_of(claim)
+        if not is_daemon or not domain:
+            return
+        cp = self.checkpoints.get()
+        for other_uid, other in cp.prepared_claims.items():
+            if other_uid != claim_uid and self._domain_of(other) == domain:
+                return
+        log.info(
+            "boot recovery: removing orphaned domain config dir for %s",
+            domain,
+        )
+        shutil.rmtree(self.domain_config_dir(domain), ignore_errors=True)
 
     def cleanup_stale_node_labels(self) -> int:
         """computedomain.go:384-439 analog: drop our node's CD label when no

@@ -15,16 +15,29 @@ from typing import Optional
 from tpu_dra.computedomain import CD_DRIVER_NAME, NUM_CHANNELS
 from tpu_dra.computedomain.cdplugin.device_state import (
     CDDeviceState,
+    CHANNEL_DEVICE_TYPE,
     DAEMON_DEVICE_NAME,
+    DAEMON_DEVICE_TYPE,
     channel_device_name,
 )
 from tpu_dra.infra.flock import Flock
 from tpu_dra.infra.metrics import Metrics
 from tpu_dra.k8sclient import RESOURCE_SLICES, ResourceClient
 from tpu_dra.plugin.cdi import CDIHandler
-from tpu_dra.plugin.checkpoint import CheckpointManager
+from tpu_dra.plugin.checkpoint import (
+    CLAIM_STATE_PREPARE_COMPLETED,
+    Checkpoint,
+    CheckpointManager,
+    PreparedClaim,
+)
 from tpu_dra.plugin.cleanup import CheckpointCleanupManager
 from tpu_dra.plugin.dra_service import DRAService, RegistrationService, serve_unix
+from tpu_dra.plugin.prepared import (
+    KubeletDevice,
+    PreparedDevice,
+    PreparedDeviceGroup,
+    PreparedDevices,
+)
 
 log = logging.getLogger(__name__)
 
@@ -46,7 +59,10 @@ class CDDriver:
         self.clique_id = clique_id
         self.metrics = Metrics(prefix="tpu_dra_cd")
         self.cdi = CDIHandler(cdi_root=config.cdi_root)
-        self.checkpoints = CheckpointManager(config.plugin_data_dir)
+        self.checkpoints = CheckpointManager(
+            config.plugin_data_dir,
+            rebuild=self._rebuild_checkpoint_from_scan,
+        )
         self.pu_flock = Flock(f"{config.plugin_data_dir}/pu.lock")
         self.state = CDDeviceState(
             backend,
@@ -70,7 +86,73 @@ class CDDriver:
         self._stop = threading.Event()
         self._label_gc_thread: Optional[threading.Thread] = None
 
+    def _rebuild_checkpoint_from_scan(self) -> Checkpoint:
+        """Both CD checkpoint copies unreadable: reconstruct
+        ``PrepareCompleted`` records from the per-claim CDI specs (the CD
+        analog of Driver._rebuild_checkpoint_from_scan). The spec's env
+        edits carry ``CD_UID``, so a rebuilt daemon claim's unprepare can
+        still remove its per-domain config dir; without the rebuild,
+        unprepare would no-op on the missing WAL entry and leak every
+        spec and domain dir forever."""
+        cp = Checkpoint()
+        for uid in sorted(self.cdi.list_claim_uids()):
+            try:
+                spec = self.cdi.read_claim_spec(uid)
+            except (OSError, ValueError) as e:
+                log.error(
+                    "rebuild: skipping unreadable CD CDI spec for claim "
+                    "%s: %s", uid, e,
+                )
+                continue
+            if not spec:
+                continue
+            group = PreparedDeviceGroup()
+            for dev in spec.get("devices", []):
+                device_name = self.cdi.parse_claim_device_name(
+                    uid, dev.get("name", "")
+                )
+                if device_name is None:
+                    continue
+                env = {}
+                for kv in (dev.get("containerEdits") or {}).get("env") or []:
+                    k, _, v = kv.partition("=")
+                    env[k] = v
+                group.devices.append(PreparedDevice(
+                    type=(
+                        DAEMON_DEVICE_TYPE
+                        if device_name == DAEMON_DEVICE_NAME
+                        else CHANNEL_DEVICE_TYPE
+                    ),
+                    device=KubeletDevice(
+                        pool_name=f"{self.config.node_name}-cd",
+                        device_name=device_name,
+                        cdi_device_ids=[
+                            self.cdi.qualified_device_id(uid, device_name)
+                        ],
+                    ),
+                    runtime_env=env,
+                ))
+            if group.devices:
+                cp.prepared_claims[uid] = PreparedClaim(
+                    checkpoint_state=CLAIM_STATE_PREPARE_COMPLETED,
+                    prepared_devices=PreparedDevices([group]),
+                )
+        log.error(
+            "rebuilt CD checkpoint from CDI scan: %d claims reconstructed",
+            len(cp.prepared_claims),
+        )
+        return cp
+
     def start(self) -> None:
+        # Boot-time WAL recovery before serving the kubelet: a CD claim
+        # stuck in PrepareStarted (crash mid-prepare) is rolled back so
+        # the kubelet retry starts clean (Driver.start analog).
+        rolled = self.state.recover_stale_prepares()
+        if rolled:
+            log.warning(
+                "rolled back %d stale CD PrepareStarted claim(s) at startup",
+                len(rolled),
+            )
         if self.config.start_grpc:
             dra_socket = f"{self.config.plugin_data_dir}/dra.sock"
             reg_socket = (

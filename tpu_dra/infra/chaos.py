@@ -31,6 +31,8 @@ Schedule JSON format (``*.chaos.json``)::
         {"at": 1.6, "kind": "apiserver_errors", "count": 3, "status": 503},
         {"at": 2.0, "kind": "watch_drop"},
         {"at": 2.5, "kind": "plugin_crash"},
+        {"at": 2.8, "kind": "crash",
+         "point": "checkpoint.write.before_replace"},
         {"at": 3.0, "kind": "client_death"}
       ]
     }
@@ -50,6 +52,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from tpu_dra.infra.crashpoint import CRASH_POINTS
+
 log = logging.getLogger(__name__)
 
 SCHEDULE_VERSION = 1
@@ -67,10 +71,14 @@ APISERVER_ERRORS = "apiserver_errors"      # fakeserver 5xx burst
 WATCH_DROP = "watch_drop"          # fakeserver server-side watch close
 PLUGIN_CRASH = "plugin_crash"      # harness kills/rebuilds the plugin
 CLIENT_DEATH = "client_death"      # multiplex client dies mid-lease
+CRASH = "crash"                    # process death at a NAMED crash point
+#   (tpu_dra.infra.crashpoint registry) — unlike plugin_crash, which kills
+#   the plugin "whenever", a crash event arms a registered crash point so
+#   process death lands at a specific instruction of the WAL lifecycle.
 
 FAULT_KINDS = frozenset({
     CHIP_DOWN, CHIP_UP, APISERVER_THROTTLE, APISERVER_ERRORS,
-    WATCH_DROP, PLUGIN_CRASH, CLIENT_DEATH,
+    WATCH_DROP, PLUGIN_CRASH, CLIENT_DEATH, CRASH,
 })
 
 # Per-kind required params: name -> predicate (check_bench_schema-style).
@@ -82,6 +90,12 @@ _REQUIRED_PARAMS: Dict[str, Dict[str, Callable[[object], bool]]] = {
     },
     APISERVER_ERRORS: {
         "count": lambda v: isinstance(v, int) and v >= 1,
+    },
+    CRASH: {
+        # The point must exist in the canonical crash-point table, or the
+        # soak "passes" while never crashing anywhere (the schedule gate
+        # catches drift when a point is renamed).
+        "point": lambda v: isinstance(v, str) and v in CRASH_POINTS,
     },
 }
 
@@ -301,6 +315,12 @@ class FaultSchedule:
                 events.append(FaultEvent(at, kind, {
                     "count": rng.randint(1, 3),
                     "status": rng.choice([500, 503]),
+                }))
+            elif kind == CRASH:
+                # Seeded soaks mix process death at a random registered
+                # crash point in with the API-weather faults.
+                events.append(FaultEvent(at, kind, {
+                    "point": rng.choice(sorted(CRASH_POINTS)),
                 }))
             else:  # watch_drop / plugin_crash / client_death
                 events.append(FaultEvent(at, kind, {}))

@@ -1,0 +1,268 @@
+"""Named, lint-registered crash points for crash-consistency drills.
+
+The reference driver's WAL design (device_state.go:287-336) is only as
+good as the proof that a kill at *any* instruction between two checkpoint
+writes recovers — and the reference can only prove that against live GPU
+clusters. This module makes process death a first-class, deterministic
+injection seam: every dangerous window in the prepare/unprepare/
+checkpoint-write/GC lifecycle threads a ``crashpoint("<name>")`` call,
+and the crash-matrix soak (``make crashmatrix``) enumerates the canonical
+table below, crashes at each point, restarts over the same persisted
+state, and asserts the recovery invariants.
+
+Firing modes:
+
+- **in-process** (unit/matrix tests): ``arm(name)`` is a one-shot context
+  manager; the next ``crashpoint(name)`` hit *on the arming thread*
+  raises :class:`SimulatedCrash` (a ``BaseException`` so no stray
+  ``except Exception`` handler can swallow the "kill") and disarms.
+  Thread confinement keeps background workers (cleanup GC, remediation)
+  from being killed by a point armed for the test thread.
+- **real process death** (minicluster / e2e wire drills): export
+  ``TPU_DRA_CRASH_POINT=<name>`` before starting the component and the
+  first hit anywhere in the process calls ``os._exit(137)`` — no atexit,
+  no finally blocks, exactly SIGKILL semantics. ``TPU_DRA_CRASH_MODE=raise``
+  downgrades the env arming to the catchable exception. Under a
+  supervisor that restarts the dead process with the SAME environment
+  (the minicluster's kubelet restarts pods with ambient env passed
+  through), also set ``TPU_DRA_CRASH_STATE_DIR``: the firing process
+  drops a ``<point>.fired`` marker there right before exiting, and a
+  restart that finds the marker does NOT re-arm — crash once, then
+  recover, instead of a crash loop.
+
+The table is the single source of truth: the C700 lint pass requires
+every ``crashpoint()`` call site to thread a unique literal name from
+this table (and every table entry to have exactly one call site), so the
+matrix test provably covers all of them.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Dict, Optional
+
+log = logging.getLogger(__name__)
+
+CRASH_POINT_ENV = "TPU_DRA_CRASH_POINT"
+CRASH_MODE_ENV = "TPU_DRA_CRASH_MODE"  # "exit" (default) | "raise"
+CRASH_STATE_DIR_ENV = "TPU_DRA_CRASH_STATE_DIR"  # one-shot across restarts
+CRASH_EXIT_CODE = 137  # the SIGKILL-shaped exit the kubelet would see
+
+# Canonical crash-point table: ``component.operation.site`` -> the window
+# it models. One call site each (C700 enforces the bijection); grouped by
+# the lifecycle phase the crash-matrix drives them through.
+CRASH_POINTS: Dict[str, str] = {
+    # -- checkpoint write path (CheckpointManager._write) --
+    "checkpoint.write.before_tmp":
+        "before the .tmp file is opened: the write never happened",
+    "checkpoint.write.after_tmp":
+        "after the .tmp content is written, before fsync/close: a torn "
+        ".tmp may be left behind; the committed file is untouched",
+    "checkpoint.write.before_replace":
+        "after fsync, before os.replace: a complete .tmp is orphaned; "
+        "the committed file still holds the previous state",
+    "checkpoint.write.before_bak":
+        "after os.replace, before the .bak copy lands: the last-good "
+        "backup lags the committed file by one generation",
+    # -- plugin prepare (DeviceState._prepare_locked) --
+    "plugin.prepare.after_wal_started":
+        "PrepareStarted intent is durable; no device work has happened",
+    "plugin.prepare.between_devices":
+        "mid-_prepare_one fan-out: some devices (and sub-slices) are "
+        "materialized, the WAL still says PrepareStarted",
+    "plugin.prepare.before_wal_completed":
+        "all devices materialized and the CDI spec written, but the WAL "
+        "never flipped to PrepareCompleted",
+    # -- plugin unprepare (DeviceState.unprepare) --
+    "plugin.unprepare.after_teardown":
+        "devices torn down but the CDI spec and WAL entry both remain",
+    "plugin.unprepare.before_wal_removed":
+        "CDI spec deleted; the WAL entry outlives the teardown",
+    # -- sub-slice materialization (BaseTpuLib.create_subslice) --
+    "tpulib.subslice.after_persist":
+        "the sub-slice is live on silicon (persisted state) but the "
+        "caller never learned its uuid — the classic orphan window",
+    # -- checkpoint GC (CheckpointCleanupManager.cleanup_once) --
+    "plugin.gc.before_unprepare":
+        "a claim is judged stale but its unprepare never started",
+    "plugin.gc.between_claims":
+        "one stale claim unprepared, the rest of the GC pass never ran",
+    # -- compute-domain plugin (CDDeviceState) --
+    "cdplugin.prepare.after_wal_started":
+        "CD claim PrepareStarted is durable; no channel/daemon prep ran",
+    "cdplugin.prepare.before_wal_completed":
+        "CD devices prepared and CDI spec written; WAL still says "
+        "PrepareStarted",
+    "cdplugin.unprepare.before_wal_removed":
+        "CD teardown done and CDI spec deleted; the WAL entry remains",
+}
+
+
+class SimulatedCrash(BaseException):
+    """In-process stand-in for SIGKILL at a crash point.
+
+    Derives from BaseException on purpose: production code's broad
+    ``except Exception`` recovery paths must NOT be able to absorb a
+    simulated process death — nothing absorbs a real one.
+    """
+
+    def __init__(self, point: str):
+        super().__init__(f"simulated crash at {point}")
+        self.point = point
+
+
+class _Arming:
+    def __init__(self, point: str, mode: str, thread_id: Optional[int],
+                 marker: Optional[str] = None):
+        self.point = point
+        self.mode = mode  # "raise" | "exit"
+        self.thread_id = thread_id  # None = any thread (env/exit mode)
+        self.marker = marker  # written right before a mode-exit death
+        self.fired = False
+
+
+_lock = threading.Lock()
+_armed: Optional[_Arming] = None
+_fire_counts: Dict[str, int] = {}
+
+
+def _arm_from_env() -> Optional[_Arming]:
+    point = os.environ.get(CRASH_POINT_ENV, "")
+    if not point:
+        return None
+    if point not in CRASH_POINTS:
+        log.error(
+            "%s names unknown crash point %r (known: %s) — ignoring",
+            CRASH_POINT_ENV, point, ", ".join(sorted(CRASH_POINTS)),
+        )
+        return None
+    marker = None
+    state_dir = os.environ.get(CRASH_STATE_DIR_ENV, "")
+    if state_dir:
+        marker = os.path.join(state_dir, f"{point}.fired")
+        if os.path.exists(marker):
+            log.warning(
+                "crash point %s already fired once (marker %s): NOT "
+                "re-arming — this restart runs the recovery path",
+                point, marker,
+            )
+            return None
+    mode = os.environ.get(CRASH_MODE_ENV, "exit")
+    log.warning("crash point %s ARMED from env (mode=%s)", point, mode)
+    return _Arming(point, mode, thread_id=None, marker=marker)
+
+
+_armed = _arm_from_env()
+
+
+def crashpoint(name: str) -> None:
+    """The inline hook: no-op unless ``name`` is the armed point.
+
+    Every call site must thread a literal name from :data:`CRASH_POINTS`
+    (C700). Unknown names raise immediately — a typo here would silently
+    remove a point from the matrix.
+    """
+    if name not in CRASH_POINTS:
+        raise RuntimeError(
+            f"crashpoint({name!r}) is not in the canonical CRASH_POINTS "
+            f"table (tpu_dra/infra/crashpoint.py)"
+        )
+    global _armed
+    with _lock:
+        a = _armed
+        if a is None or a.fired or a.point != name:
+            return
+        if a.thread_id is not None and a.thread_id != threading.get_ident():
+            return
+        a.fired = True
+        _fire_counts[name] = _fire_counts.get(name, 0) + 1
+        mode = a.mode
+        marker = a.marker
+    if mode == "exit":
+        if marker:
+            try:
+                os.makedirs(os.path.dirname(marker), exist_ok=True)
+                with open(marker, "w") as f:
+                    f.write(str(os.getpid()))
+            except OSError as e:
+                log.error("could not write crash marker %s: %s", marker, e)
+        # Flush logging by hand: os._exit skips atexit AND io flushing —
+        # that is the point — but the drill operator deserves the last line.
+        log.critical("crash point %s FIRING: os._exit(%d)", name, CRASH_EXIT_CODE)
+        for h in logging.getLogger().handlers:
+            try:
+                h.flush()
+            except Exception:
+                pass
+        os._exit(CRASH_EXIT_CODE)
+    log.warning("crash point %s FIRING: SimulatedCrash", name)
+    raise SimulatedCrash(name)
+
+
+class arm:
+    """One-shot in-process arming, confined to the arming thread.
+
+    >>> with crashpoint_mod.arm("plugin.prepare.after_wal_started"):
+    ...     with pytest.raises(SimulatedCrash):
+    ...         state.prepare(claim)
+
+    Re-entering the window after the context exits (or after the point
+    fired) is a no-op — recovery retries must run straight through.
+    """
+
+    def __init__(self, point: str, mode: str = "raise",
+                 any_thread: bool = False):
+        if point not in CRASH_POINTS:
+            raise ValueError(f"unknown crash point: {point!r}")
+        if mode not in ("raise", "exit"):
+            raise ValueError(f"unknown crash mode: {mode!r}")
+        self._arming = _Arming(
+            point, mode,
+            thread_id=None if any_thread else threading.get_ident(),
+        )
+
+    @property
+    def fired(self) -> bool:
+        return self._arming.fired
+
+    def __enter__(self) -> "arm":
+        global _armed
+        with _lock:
+            if _armed is not None and not _armed.fired:
+                raise RuntimeError(
+                    f"crash point {_armed.point} is already armed"
+                )
+            _armed = self._arming
+        return self
+
+    def __exit__(self, *exc) -> None:
+        global _armed
+        with _lock:
+            if _armed is self._arming:
+                _armed = None
+        return None
+
+
+def armed_point() -> Optional[str]:
+    """Name of the currently armed (unfired) point, for diagnostics."""
+    with _lock:
+        if _armed is not None and not _armed.fired:
+            return _armed.point
+    return None
+
+
+def fire_count(name: str) -> int:
+    """How many times ``name`` fired in this process (tests assert the
+    matrix actually reached every window)."""
+    with _lock:
+        return _fire_counts.get(name, 0)
+
+
+def reset_for_tests() -> None:
+    """Disarm and zero counters (test isolation)."""
+    global _armed
+    with _lock:
+        _armed = None
+        _fire_counts.clear()

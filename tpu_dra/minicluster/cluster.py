@@ -22,6 +22,15 @@ letting the bats suites (tests/bats/) EXECUTE verbatim:
 Everything the driver does — registering plugins, publishing slices,
 stamping CD daemonsets, arbitrating shared chips — is the production
 code running as chart-installed pods.
+
+Crash drills: pod processes inherit the runner's environment (podrun
+``_container_env`` starts from ``os.environ``), so exporting
+``TPU_DRA_CRASH_POINT=<name>`` + ``TPU_DRA_CRASH_STATE_DIR=<dir>``
+before bringing the cluster up makes the named component die with a real
+``os._exit(137)`` at that WAL instruction; the kubelet's restart-with-
+backoff then replays the boot recovery path, and the state-dir marker
+keeps the re-spawned process from crash-looping (crash once, recover —
+see docs/operations.md "Crash recovery & restart drills").
 """
 
 from __future__ import annotations

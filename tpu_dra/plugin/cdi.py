@@ -97,6 +97,18 @@ class CDIHandler:
     def claim_device_name(self, claim_uid: str, device_name: str) -> str:
         return f"{claim_uid}-{device_name}"
 
+    def parse_claim_device_name(
+        self, claim_uid: str, cdi_device_name: str
+    ) -> Optional[str]:
+        """Inverse of :meth:`claim_device_name`: the bare device name, or
+        None when the CDI device doesn't belong to ``claim_uid``. The
+        checkpoint rebuild-from-scan path reads specs back through this
+        so the naming format lives in exactly one module."""
+        prefix = f"{claim_uid}-"
+        if not cdi_device_name.startswith(prefix):
+            return None
+        return cdi_device_name[len(prefix):]
+
     def qualified_device_id(self, claim_uid: str, device_name: str) -> str:
         return f"{CDI_KIND}={self.claim_device_name(claim_uid, device_name)}"
 

@@ -16,19 +16,32 @@ the k8s checkpointmanager. Design preserved exactly:
   (device_state.go:549-582).
 
 The checkpoint is the node-local source of truth for: idempotent Prepare,
-double-allocation defense, sub-slice orphan GC.
+double-allocation defense, sub-slice orphan GC. Because it is the single
+source of truth, losing it must never be fatal: every committed write is
+mirrored to ``checkpoint.json.bak``, an unreadable/CRC-failing file is
+quarantined as ``checkpoint.json.corrupt-<ts>`` and the ``.bak`` copy is
+promoted, and when BOTH copies are bad the manager rebuilds (by default
+empty — boot-time device-scan reconciliation then destroys whatever the
+rebuilt checkpoint no longer vouches for). Crash points
+(``checkpoint.write.*``) bracket every step of the write path so the
+crash matrix can kill the plugin at each one and prove recovery.
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import os
+import time
 import zlib
 from dataclasses import dataclass, field
-from typing import Callable, Dict
+from typing import Callable, Dict, Optional
 
+from tpu_dra.infra.crashpoint import crashpoint
 from tpu_dra.infra.flock import Flock
 from tpu_dra.plugin.prepared import PreparedDevices
+
+log = logging.getLogger(__name__)
 
 CLAIM_STATE_UNSET = ""
 CLAIM_STATE_PREPARE_STARTED = "PrepareStarted"
@@ -119,7 +132,9 @@ class Checkpoint:
     def unmarshal(cls, data: bytes) -> "Checkpoint":
         try:
             top = json.loads(data)
-        except json.JSONDecodeError as e:
+        except ValueError as e:
+            # JSONDecodeError for torn/empty files, UnicodeDecodeError for
+            # bit rot inside a multi-byte sequence — both are corruption.
             raise ChecksumError(f"corrupt checkpoint JSON: {e}") from e
         v2 = top.get("v2")
         if v2 is not None:
@@ -158,38 +173,163 @@ class Checkpoint:
         return cls()
 
 
+def inspect_file(path: str) -> Checkpoint:
+    """Strict read-only load: unmarshal ``path`` or raise. No quarantine,
+    no ``.bak`` promotion, no side effects — the doctor's view (a
+    diagnostic must not mutate the node)."""
+    with open(path, "rb") as f:
+        return Checkpoint.unmarshal(f.read())
+
+
 class CheckpointManager:
     """File-backed checkpoint with flocked read-modify-write.
 
     Reference analog: k8s checkpointmanager usage + the dedicated cplock
     (device_state.go:141-177 create-if-missing, :549-582 update under lock).
+
+    On top of the reference design: corrupt-checkpoint tolerance. Every
+    committed write mirrors to ``<name>.bak``; a load that fails checksum
+    or JSON parsing quarantines the bad file as ``<name>.corrupt-<ts>``
+    and falls back to the backup; when both copies are bad the ``rebuild``
+    hook supplies a replacement (default: empty — the driver's boot-time
+    device-scan reconciliation then tears down anything the rebuilt
+    checkpoint no longer vouches for). Construction also sweeps stray
+    ``.tmp`` files: a crash between the temp write and ``os.replace``
+    must not leak them forever.
     """
 
-    def __init__(self, directory: str, name: str = "checkpoint.json"):
+    def __init__(
+        self,
+        directory: str,
+        name: str = "checkpoint.json",
+        rebuild: Optional[Callable[[], Checkpoint]] = None,
+    ):
         os.makedirs(directory, exist_ok=True)
         self.path = os.path.join(directory, name)
+        self.bak_path = self.path + ".bak"
+        self._rebuild = rebuild
         self._flock = Flock(self.path + ".lock")
-        if not os.path.exists(self.path):
-            self._write(Checkpoint())
+        with self._flock.held():
+            # WAL semantics make an uncommitted temp write safe to discard:
+            # either the replace happened (no .tmp) or the previous state
+            # is still the committed truth.
+            for stray in (self.path + ".tmp", self.bak_path + ".tmp"):
+                try:
+                    os.remove(stray)
+                    log.warning("removed stray checkpoint temp file %s", stray)
+                except FileNotFoundError:
+                    pass
+            if not os.path.exists(self.path):
+                self._write(self._recover_missing())
+            else:
+                # Surface (and heal) corruption at boot, not mid-Prepare.
+                cp = self._load()
+                if not os.path.exists(self.bak_path):
+                    # Upgrade path: a checkpoint from a pre-.bak driver
+                    # has no mirror yet — write one NOW, or the first
+                    # corruption would skip straight to the lossy
+                    # device-scan rebuild.
+                    self._write(cp)
+
+    # --- write path (each step bracketed by a crash point) ---
 
     def _write(self, cp: Checkpoint) -> None:
+        data = cp.marshal()
+        crashpoint("checkpoint.write.before_tmp")
         tmp = self.path + ".tmp"
         with open(tmp, "wb") as f:
-            f.write(cp.marshal())
+            f.write(data)
+            crashpoint("checkpoint.write.after_tmp")
             f.flush()
             os.fsync(f.fileno())
+        crashpoint("checkpoint.write.before_replace")
         os.replace(tmp, self.path)
+        crashpoint("checkpoint.write.before_bak")
+        # Mirror the committed bytes to the last-good backup. A crash in
+        # between leaves .bak one generation behind — acceptable, it is
+        # only read when the committed file is corrupt.
+        bak_tmp = self.bak_path + ".tmp"
+        with open(bak_tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(bak_tmp, self.bak_path)
+
+    # --- tolerant load path ---
+
+    def _quarantine(self, why: Exception) -> None:
+        dest = f"{self.path}.corrupt-{int(time.time() * 1000)}"
+        try:
+            os.replace(self.path, dest)
+            log.error(
+                "quarantined corrupt checkpoint %s -> %s (%s)",
+                self.path, dest, why,
+            )
+        except FileNotFoundError:
+            pass
+
+    def _lost_checkpoint_evidence(self) -> bool:
+        """True when the dir proves a checkpoint once existed here: a
+        quarantine file survives every recovery (kept for forensics), so
+        a crash DURING the heal write — main already quarantined, the
+        healed copy not yet committed — still reads as "lost", not as a
+        fresh node, on the next boot."""
+        d = os.path.dirname(self.path) or "."
+        prefix = os.path.basename(self.path) + ".corrupt-"
+        try:
+            return any(n.startswith(prefix) for n in os.listdir(d))
+        except OSError:
+            return False
+
+    def _recover_missing(self, had_main: bool = False) -> Checkpoint:
+        """The committed file is gone (first boot, or quarantined): promote
+        the backup, else rebuild. ``had_main`` distinguishes "a checkpoint
+        existed and was lost" (rebuild what the device scan still knows)
+        from a genuine first boot (nothing to recover — start empty)."""
+        bak_was_corrupt = False
+        try:
+            with open(self.bak_path, "rb") as f:
+                cp = Checkpoint.unmarshal(f.read())
+            log.warning(
+                "recovered checkpoint from backup %s (%d claims)",
+                self.bak_path, len(cp.prepared_claims),
+            )
+            return cp
+        except FileNotFoundError:
+            pass
+        except (OSError, ChecksumError) as e:
+            bak_was_corrupt = True
+            log.error(
+                "checkpoint backup %s is also unreadable: %s", self.bak_path, e
+            )
+        lost = had_main or bak_was_corrupt or self._lost_checkpoint_evidence()
+        if lost and self._rebuild is not None:
+            return self._rebuild()
+        return Checkpoint()
+
+    def _load(self) -> Checkpoint:
+        """Load under the held flock, healing corruption in place."""
+        try:
+            with open(self.path, "rb") as f:
+                return Checkpoint.unmarshal(f.read())
+        except FileNotFoundError:
+            cp = self._recover_missing()
+        except (OSError, ChecksumError) as e:
+            self._quarantine(e)
+            cp = self._recover_missing(had_main=True)
+        # Persist the healed state so the next reader sees a good file
+        # (and the quarantined original stays on disk for forensics).
+        self._write(cp)
+        return cp
 
     def get(self) -> Checkpoint:
         with self._flock.held():
-            with open(self.path, "rb") as f:
-                return Checkpoint.unmarshal(f.read())
+            return self._load()
 
     def update(self, mutate: Callable[[Checkpoint], None]) -> Checkpoint:
         """Atomic read-modify-write under the checkpoint flock."""
         with self._flock.held():
-            with open(self.path, "rb") as f:
-                cp = Checkpoint.unmarshal(f.read())
+            cp = self._load()
             mutate(cp)
             self._write(cp)
             return cp

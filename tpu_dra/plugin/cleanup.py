@@ -14,6 +14,7 @@ import logging
 import threading
 from typing import Optional
 
+from tpu_dra.infra.crashpoint import crashpoint
 from tpu_dra.k8sclient import RESOURCE_CLAIMS, ApiNotFound, ResourceClient
 from tpu_dra.plugin.device_state import DeviceState
 
@@ -59,29 +60,57 @@ class CheckpointCleanupManager:
                 log.exception("checkpoint cleanup pass failed")
 
     def cleanup_once(self) -> int:
-        """One GC pass; returns the number of unprepared stale claims."""
+        """One GC pass; returns the number of unprepared stale claims.
+
+        Failures are isolated PER CLAIM: one claim whose staleness probe
+        hits a transient apiserver error (or whose unprepare fails) must
+        not abort the pass for every claim behind it — the reference's
+        loop has the same property (cleanup.go:110-147 logs and moves
+        on), and losing it would let a single flaky GET starve the GC of
+        genuinely stale claims for a full interval.
+        """
         cp = self.state.checkpoints.get()
         cleaned = 0
-        for uid, claim in list(cp.prepared_claims.items()):
-            if self._is_stale(uid, claim):
-                log.info(
-                    "unpreparing stale claim %s/%s (%s)",
-                    claim.namespace,
-                    claim.name,
-                    uid,
+        for uid, claim in sorted(cp.prepared_claims.items()):
+            try:
+                if not self._is_stale(uid, claim):
+                    continue
+            except Exception as e:
+                log.warning(
+                    "staleness probe failed for claim %s (skipping this "
+                    "pass): %s", uid, e,
                 )
-                try:
-                    if self.pu_flock is not None:
-                        release = self.pu_flock.acquire(timeout=60)
-                        try:
-                            self.state.unprepare(uid)
-                        finally:
-                            release()
-                    else:
+                continue
+            log.info(
+                "unpreparing stale claim %s/%s (%s)",
+                claim.namespace,
+                claim.name,
+                uid,
+            )
+            crashpoint("plugin.gc.before_unprepare")
+            try:
+                if self.pu_flock is not None:
+                    # Stop-aware: the worker may sit in this acquire for
+                    # up to 60s while a Prepare holds the node flock;
+                    # stop() must be able to cancel the wait instead of
+                    # abandoning the thread (its join times out at 2s).
+                    try:
+                        release = self.pu_flock.acquire(
+                            timeout=60, cancel_event=self._stop
+                        )
+                    except InterruptedError:
+                        log.info("GC pass cancelled by stop()")
+                        return cleaned
+                    try:
                         self.state.unprepare(uid)
-                    cleaned += 1
-                except Exception as e:
-                    log.warning("stale-claim unprepare failed for %s: %s", uid, e)
+                    finally:
+                        release()
+                else:
+                    self.state.unprepare(uid)
+                cleaned += 1
+            except Exception as e:
+                log.warning("stale-claim unprepare failed for %s: %s", uid, e)
+            crashpoint("plugin.gc.between_claims")
         return cleaned
 
     def _is_stale(self, uid: str, claim) -> bool:

@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Tuple
 from tpu_dra import api as configapi
 from tpu_dra.api.errors import ApiError
 from tpu_dra.infra import featuregates as fg
+from tpu_dra.infra.crashpoint import crashpoint
 from tpu_dra.plugin.allocatable import (
     AllocatableDevice,
     AllocatableDevices,
@@ -168,6 +169,40 @@ class DeviceState:
             for name, d in self.allocatable.items()
         )
 
+    # --- boot-time WAL recovery ---
+
+    def recover_stale_prepares(self) -> List[str]:
+        """Roll back claims stuck in ``PrepareStarted`` at startup.
+
+        The reference defers this rollback to the next kubelet retry
+        (device_state.go:223-228), which leaves a crashed prepare's
+        partial sub-slices live until the kubelet happens to retry — or
+        forever, if the pod was deleted during the outage. Rolling back
+        at boot closes that window: partial device work is torn down,
+        the orphaned CDI spec removed, and the WAL entry popped, so a
+        retry starts from a clean slate and the GC never has to reason
+        about in-flight records. Returns the rolled-back claim uids.
+        """
+        cp = self.checkpoints.get()
+        rolled: List[str] = []
+        for uid, claim in sorted(cp.prepared_claims.items()):
+            if claim.checkpoint_state != CLAIM_STATE_PREPARE_STARTED:
+                continue
+            log.warning(
+                "boot recovery: rolling back stale PrepareStarted claim "
+                "%s (%s/%s)", uid, claim.namespace, claim.name,
+            )
+            with self._lock:
+                # Spec before WAL: _unprepare_partially_prepared_claim
+                # pops the WAL entry as its last step, and once that is
+                # durable nothing would ever come back for the spec — a
+                # crash in between must leave the entry, not the spec
+                # (unprepare()'s teardown -> spec -> WAL ordering).
+                self.cdi.delete_claim_spec_file(uid)
+                self._unprepare_partially_prepared_claim(uid, claim)
+            rolled.append(uid)
+        return rolled
+
     # --- startup obliteration (device_state.go:337-373) ---
 
     def destroy_unknown_subslices(self) -> List[str]:
@@ -237,6 +272,7 @@ class DeviceState:
             )
 
         self.checkpoints.update(mark_started)
+        crashpoint("plugin.prepare.after_wal_started")
 
         tp = time.monotonic()
         try:
@@ -262,6 +298,7 @@ class DeviceState:
                 self.allocatable.remove_sibling_devices(adev)
 
         self.cdi.create_claim_spec_file(claim_uid, prepared)
+        crashpoint("plugin.prepare.before_wal_completed")
 
         def mark_completed(c: Checkpoint) -> None:
             c.prepared_claims[claim_uid] = PreparedClaim(
@@ -289,7 +326,9 @@ class DeviceState:
                 self._unprepare_partially_prepared_claim(claim_uid, claim)
             else:
                 self._unprepare_devices(claim_uid, claim.prepared_devices)
+            crashpoint("plugin.unprepare.after_teardown")
             self.cdi.delete_claim_spec_file(claim_uid)
+            crashpoint("plugin.unprepare.before_wal_removed")
             self.checkpoints.update(
                 lambda c: c.prepared_claims.pop(claim_uid, None)
             )
@@ -475,6 +514,9 @@ class DeviceState:
                 group.devices.append(
                     self._prepare_one(claim, result, config_state)
                 )
+                # A device (possibly a freshly-materialized sub-slice) is
+                # live; its siblings and the WAL completion are not.
+                crashpoint("plugin.prepare.between_devices")
             prepared.append(group)
         # Across ALL groups: devices of one request can land in different
         # config groups (a request whose selector matches both a chip and a

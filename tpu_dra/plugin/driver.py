@@ -9,6 +9,7 @@ Prepare/Unprepare RPC surface (:298-400).
 from __future__ import annotations
 
 import logging
+import random
 import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional
@@ -17,9 +18,24 @@ from tpu_dra.infra import featuregates as fg
 from tpu_dra.infra.flock import Flock
 from tpu_dra.infra.metrics import Metrics
 from tpu_dra.k8sclient import RESOURCE_SLICES, ResourceClient
-from tpu_dra.plugin.allocatable import AllocatableDevice
+from tpu_dra.plugin.allocatable import (
+    AllocatableDevice,
+    SUBSLICE_DYNAMIC_DEVICE_TYPE,
+    dynamic_subslice_device_name,
+)
 from tpu_dra.plugin.cdi import CDIHandler, install_cdi_hook
-from tpu_dra.plugin.checkpoint import CheckpointManager
+from tpu_dra.plugin.checkpoint import (
+    CLAIM_STATE_PREPARE_COMPLETED,
+    Checkpoint,
+    CheckpointManager,
+    PreparedClaim,
+)
+from tpu_dra.plugin.prepared import (
+    KubeletDevice,
+    PreparedDevice,
+    PreparedDeviceGroup,
+    PreparedDevices,
+)
 from tpu_dra.plugin.cleanup import CheckpointCleanupManager
 from tpu_dra.plugin.device_health import DeviceHealthMonitor
 from tpu_dra.plugin.device_state import DRIVER_NAME, DeviceState
@@ -88,7 +104,10 @@ class Driver:
         if hook_path:
             log.info("installed CDI hook at %s", hook_path)
         self.cdi = CDIHandler(cdi_root=config.cdi_root, hook_path=hook_path)
-        self.checkpoints = CheckpointManager(config.plugin_data_dir)
+        self.checkpoints = CheckpointManager(
+            config.plugin_data_dir,
+            rebuild=self._rebuild_checkpoint_from_scan,
+        )
         self.pu_flock = Flock(f"{config.plugin_data_dir}/pu.lock")
         multiplex = MultiplexManager(
             backend,
@@ -184,10 +203,83 @@ class Driver:
                         {**labels, "le": le},
                     )
 
+    def _rebuild_checkpoint_from_scan(self) -> Checkpoint:
+        """Last-resort checkpoint reconstruction: both the committed file
+        and its ``.bak`` are unreadable. Walk the node's other durable
+        surfaces — the per-claim transient CDI specs (claim uid + granted
+        device names) and the live sub-slices on silicon — and rebuild
+        ``PrepareCompleted`` records from them. Request/config detail is
+        gone (it only ever lived in the checkpoint), but the properties
+        the checkpoint exists for survive: Prepare idempotency,
+        double-allocation defense (device names), and orphan GC
+        (sub-slice uuids re-attached by placement name)."""
+        live_by_name = {
+            dynamic_subslice_device_name(ss.placement): ss.uuid
+            for ss in self.tpulib.list_subslices()
+        }
+        cp = Checkpoint()
+        for uid in sorted(self.cdi.list_claim_uids()):
+            try:
+                spec = self.cdi.read_claim_spec(uid)
+            except (OSError, ValueError) as e:
+                # The disk incident that ate the checkpoint may have torn
+                # specs too. A bad spec loses ONE claim (startup
+                # obliteration sweeps its devices); raising here would
+                # lose the boot — the one outcome this hook exists to
+                # prevent.
+                log.error(
+                    "rebuild: skipping unreadable CDI spec for claim %s: %s",
+                    uid, e,
+                )
+                continue
+            if not spec:
+                continue
+            group = PreparedDeviceGroup()
+            for dev in spec.get("devices", []):
+                device_name = self.cdi.parse_claim_device_name(
+                    uid, dev.get("name", "")
+                )
+                if device_name is None:
+                    continue
+                pd = PreparedDevice(
+                    device=KubeletDevice(
+                        pool_name=self.config.node_name,
+                        device_name=device_name,
+                        cdi_device_ids=[
+                            self.cdi.qualified_device_id(uid, device_name)
+                        ],
+                    )
+                )
+                if device_name in live_by_name:
+                    pd.type = SUBSLICE_DYNAMIC_DEVICE_TYPE
+                    pd.subslice_uuid = live_by_name[device_name]
+                group.devices.append(pd)
+            if group.devices:
+                cp.prepared_claims[uid] = PreparedClaim(
+                    checkpoint_state=CLAIM_STATE_PREPARE_COMPLETED,
+                    prepared_devices=PreparedDevices([group]),
+                )
+        log.error(
+            "rebuilt checkpoint from device scan: %d claims reconstructed "
+            "from CDI specs, %d live sub-slices re-attached",
+            len(cp.prepared_claims), len(live_by_name),
+        )
+        return cp
+
     # --- lifecycle (RunPlugin/NewDriver analog) ---
 
     def start(self) -> None:
-        # Startup obliteration before serving the kubelet (driver.go:103).
+        # Boot-time WAL recovery BEFORE startup obliteration: rolling a
+        # stale PrepareStarted back may itself delete the partial claim's
+        # orphan sub-slices, and obliteration then sweeps anything no
+        # completed claim vouches for (driver.go:103).
+        rolled = self.state.recover_stale_prepares()
+        if rolled:
+            self.metrics.inc("boot_recovered_prepares_total", len(rolled))
+            log.warning(
+                "rolled back %d stale PrepareStarted claim(s) at startup",
+                len(rolled),
+            )
         destroyed = self.state.destroy_unknown_subslices()
         if destroyed:
             log.warning("destroyed %d unknown sub-slices at startup", len(destroyed))
@@ -249,14 +341,39 @@ class Driver:
 
     # --- ResourceSlice publication (driver.go:188-268) ---
 
+    MAX_PUBLISH_RETRY_DELAY = 30.0
+
     def publish_with_retry(
-        self, attempts: int = 5, delay: float = 0.5
+        self,
+        attempts: int = 5,
+        delay: float = 0.5,
+        _expected_generation: Optional[int] = None,
     ) -> None:
         """publish_resources, re-armed on failure. Health-driven publishes
         have no caller to propagate to (the monitor thread just logs), so
         a transient apiserver failure would otherwise leave the published
         slices contradicting chip health until the NEXT health event —
-        exactly the stale-inventory window chaos drills flush out."""
+        exactly the stale-inventory window chaos drills flush out.
+
+        Retries back off exponentially with jitter (a 429/5xx burst that
+        defeats the client's own retry budget is the apiserver asking for
+        LESS traffic, and synchronized fixed-delay timers from many nodes
+        are exactly how it stays down). Each retry chain is tagged with
+        the slice generation its failed attempt produced: when the timer
+        fires after a NEWER publish already ran — a later health event,
+        remediation, anything — the stale chain drops out instead of
+        re-publishing and bumping the pool generation for no reason.
+        """
+        if _expected_generation is not None:
+            with self._publish_lock:
+                superseded = self._slice_generation != _expected_generation
+            if superseded:
+                self.metrics.inc("publish_retries_superseded_total")
+                log.info(
+                    "dropping stale publish retry (generation moved past %d)",
+                    _expected_generation,
+                )
+                return
         try:
             self.publish_resources()
         except Exception as e:
@@ -264,11 +381,20 @@ class Driver:
             if attempts <= 1:
                 log.error("republish failed permanently: %s", e)
                 return
+            sleep = delay * random.uniform(0.5, 1.5)
             log.warning(
-                "republish failed (%s); retrying in %.1fs", e, delay
+                "republish failed (%s); retrying in %.1fs", e, sleep
             )
+            with self._publish_lock:
+                chain_generation = self._slice_generation
             t = threading.Timer(
-                delay, self.publish_with_retry, args=(attempts - 1, delay)
+                sleep,
+                self.publish_with_retry,
+                args=(
+                    attempts - 1,
+                    min(delay * 2, self.MAX_PUBLISH_RETRY_DELAY),
+                ),
+                kwargs={"_expected_generation": chain_generation},
             )
             t.daemon = True
             t.start()

@@ -41,7 +41,8 @@ from typing import Dict, List, Optional
 from tpu_dra.plugin.checkpoint import (
     CLAIM_STATE_PREPARE_COMPLETED,
     CLAIM_STATE_PREPARE_STARTED,
-    CheckpointManager,
+    ChecksumError,
+    inspect_file,
 )
 from tpu_dra.plugin.cdi import CDI_VENDOR
 from tpu_dra.plugin.multiplexd import SOCKET_NAME
@@ -202,32 +203,93 @@ def collect(
                  f"unpublished from ResourceSlices until it recovers")
 
     # --- checkpoint (WAL) ---
+    # Strictly read-only (inspect_file): the manager's tolerant load path
+    # quarantines/heals as a side effect, and a diagnostic must not
+    # mutate the node.
     claims: Dict[str, dict] = {}
     ckpt_path = os.path.join(plugin_data_dir, "checkpoint.json")
     ckpt_exists = os.path.exists(ckpt_path)
+    ckpt_corrupt = None
     if ckpt_exists:
-        cp = CheckpointManager(plugin_data_dir).get()
-        for uid, claim in sorted(cp.prepared_claims.items()):
-            devices = claim.prepared_devices.device_names()
-            claims[uid] = {
-                "state": claim.checkpoint_state,
-                "name": claim.name,
-                "namespace": claim.namespace,
-                "devices": devices,
-            }
-            if claim.checkpoint_state == CLAIM_STATE_PREPARE_STARTED:
-                warn(
-                    f"claim {uid} ({claim.namespace}/{claim.name}) is in "
-                    f"PrepareStarted: a prepare crashed mid-flight; the "
-                    f"plugin rolls it back on the next kubelet retry and "
-                    f"the cleanup manager GCs it if the ResourceClaim is "
-                    f"gone"
+        try:
+            cp = inspect_file(ckpt_path)
+        except (ChecksumError, OSError) as e:
+            ckpt_corrupt = str(e)
+            cp = None
+            bak = ckpt_path + ".bak"
+            try:
+                inspect_file(bak)
+                bak_verdict = (
+                    f"the backup {bak} is readable: the plugin will "
+                    f"quarantine the corrupt file and recover from it at "
+                    f"next boot"
                 )
+            except FileNotFoundError:
+                bak_verdict = (
+                    f"no backup at {bak}: the plugin will rebuild from "
+                    f"the device scan (CDI specs + live sub-slices) at "
+                    f"next boot"
+                )
+            except (ChecksumError, OSError) as be:
+                bak_verdict = (
+                    f"the backup {bak} is ALSO unreadable ({be}): the "
+                    f"plugin will rebuild from the device scan (CDI "
+                    f"specs + live sub-slices) at next boot"
+                )
+            warn(
+                f"checkpoint {ckpt_path} is CORRUPT ({e}); {bak_verdict}"
+            )
+        if cp is not None:
+            for uid, claim in sorted(cp.prepared_claims.items()):
+                devices = claim.prepared_devices.device_names()
+                claims[uid] = {
+                    "state": claim.checkpoint_state,
+                    "name": claim.name,
+                    "namespace": claim.namespace,
+                    "devices": devices,
+                }
+                if claim.checkpoint_state == CLAIM_STATE_PREPARE_STARTED:
+                    warn(
+                        f"claim {uid} ({claim.namespace}/{claim.name}) is "
+                        f"in PrepareStarted: a prepare crashed mid-flight; "
+                        f"the plugin rolls it back at next boot (or on the "
+                        f"next kubelet retry) and the cleanup manager GCs "
+                        f"it if the ResourceClaim is gone"
+                    )
     else:
         report.setdefault("notes", []).append(
             f"no checkpoint at {ckpt_path} (plugin never ran here?)"
         )
-    report["checkpoint"] = {"path": ckpt_path, "claims": claims}
+    # Crash residue around the checkpoint file: a .tmp means a write was
+    # interrupted; .corrupt-* quarantine files mean a past recovery ran.
+    residue = {"tmp": [], "quarantined": []}
+    try:
+        for name in sorted(os.listdir(plugin_data_dir)):
+            if name.startswith("checkpoint.json") and name.endswith(".tmp"):
+                residue["tmp"].append(name)
+            elif ".corrupt-" in name:
+                residue["quarantined"].append(name)
+    except FileNotFoundError:
+        pass
+    for name in residue["tmp"]:
+        warn(
+            f"leftover checkpoint temp file {name} — a checkpoint write "
+            f"was interrupted (crash between the temp write and the "
+            f"atomic replace); the plugin sweeps it at next boot, or "
+            f"delete it by hand — NEVER rename it over checkpoint.json"
+        )
+    for name in residue["quarantined"]:
+        warn(
+            f"quarantined corrupt checkpoint {name} — a past boot "
+            f"recovered from .bak or the device scan; inspect it for "
+            f"forensics, then delete it to clear this warning"
+        )
+    report["checkpoint"] = {
+        "path": ckpt_path,
+        "claims": claims,
+        "corrupt": ckpt_corrupt,
+        "residue": residue,
+    }
 
     # --- CDI specs vs checkpoint ---
     # Read the directory directly: constructing CDIHandler would CREATE
@@ -254,8 +316,10 @@ def collect(
     for uid in spec_uids:
         # Keyed on checkpoint-FILE existence, not the claim map's
         # truthiness: an empty checkpoint with a leftover spec is exactly
-        # the crashed-unprepare scenario this check exists for.
-        if ckpt_exists and uid not in claims:
+        # the crashed-unprepare scenario this check exists for. A corrupt
+        # checkpoint says nothing about claims — skip rather than accuse
+        # every spec of being orphaned.
+        if ckpt_exists and ckpt_corrupt is None and uid not in claims:
             warn(
                 f"CDI spec for claim {uid} has no checkpoint entry — an "
                 f"unprepare likely crashed after checkpoint removal; the "
@@ -331,12 +395,20 @@ def render(report: dict) -> str:
             f"  subslice {ss['uuid']} {ss['shape']} @ {ss['origin']}"
         )
     ck = report["checkpoint"]
-    lines.append(f"checkpoint : {ck['path']} ({len(ck['claims'])} claims)")
+    status = " CORRUPT" if ck.get("corrupt") else ""
+    lines.append(
+        f"checkpoint : {ck['path']} ({len(ck['claims'])} claims){status}"
+    )
     for uid, c in ck["claims"].items():
         lines.append(
             f"  {uid} {c['state']} {c['namespace']}/{c['name']} "
             f"devices={c['devices']}"
         )
+    residue = ck.get("residue") or {}
+    for name in residue.get("tmp", []):
+        lines.append(f"  residue: {name} (interrupted write)")
+    for name in residue.get("quarantined", []):
+        lines.append(f"  residue: {name} (quarantined)")
     lines.append(
         f"cdi        : {report['cdi']['root']} "
         f"({len(report['cdi']['claim_specs'])} claim specs)"

@@ -25,6 +25,7 @@ import threading
 import uuid as uuidlib
 from typing import Dict, List, Optional
 
+from tpu_dra.infra.crashpoint import crashpoint
 from tpu_dra.tpulib import native
 from tpu_dra.tpulib.interface import SubsliceInfo, TpuLib, TpuLibError
 from tpu_dra.tpulib.types import (
@@ -131,6 +132,10 @@ class BaseTpuLib(TpuLib):
             self._materialize(info, chips)
             self._subslices[ss_uuid] = info
             self._persist(info)
+            # The orphan window: the sub-slice is durable on "silicon"
+            # but the caller never learns its uuid (the deterministic
+            # analog of the stub's delay.create_subslice sleep).
+            crashpoint("tpulib.subslice.after_persist")
             return info
 
     def delete_subslice(self, uuid: str) -> None:
