@@ -3,7 +3,7 @@
 IMAGE_REPO ?= registry.local/tpu-dra-driver
 IMAGE_TAG  ?= v0.1.0
 
-.PHONY: all native test test-slow bench decodebench image bats lint lint-fast shlint chaos crashmatrix ci clean
+.PHONY: all native test test-slow bench decodebench image bats lint lint-fast shlint chaos crashmatrix apisoak ci clean
 
 all: native test
 
@@ -94,6 +94,17 @@ chaos: native
 crashmatrix:
 	python -m pytest tests/test_crash_matrix.py -q
 
+# Control-plane weather soak (ISSUE 5): deadline budgets, the per-verb
+# circuit breaker, degraded mode, and the apiserver-partition acceptance
+# bar — no kubelet RPC blocks past its budget, and after the heal the
+# driver reconverges (circuit closed, paused loops resumed, checkpoint
+# matching apiserver state) within the recovery bound. The fast target
+# runs the deterministic smoke (single partition window over real HTTP);
+# the seeded partition/latency/throttle storm matrix is slow-marked
+# (pytest tests/test_api_weather.py -m slow — ci runs both).
+apisoak:
+	python -m pytest tests/test_api_weather.py -q -m 'not slow'
+
 shlint:
 	bash hack/shlint.sh
 
@@ -105,10 +116,11 @@ shlint:
 # (flakes surface in CI, not in the judge's rerun), the 13 bats suites
 # executed against the minicluster, the batsless process-level e2e, and
 # the bench artifact schema gate.
-ci: lint lint-fast shlint native chaos crashmatrix decodebench
+ci: lint lint-fast shlint native chaos crashmatrix apisoak decodebench
 	python -m pytest tests/ -q -m 'not slow'
 	python -m pytest tests/ -q -m 'not slow'
 	python -m pytest tests/test_chaos.py -q -m slow
+	python -m pytest tests/test_api_weather.py -q -m slow
 	hack/run-bats.sh --log RUN_bats.log
 	python tests/batsless/runner.py
 	python hack/check_bench_schema.py

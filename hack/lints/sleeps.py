@@ -1,0 +1,96 @@
+"""D800 — bare ``time.sleep`` in driver layers.
+
+A bare ``time.sleep`` in ``plugin``/``computedomain``/``k8sclient``/
+``infra`` is an unconditional stall: it cannot be cancelled by
+component shutdown and it does not consume the calling RPC's deadline
+budget — exactly how apiserver weather turned into wedged
+kubelet-facing calls before ISSUE 5. Waits in those layers must go
+through one of:
+
+- a stop event (``self._stop.wait(delay)`` — shutdown-cancellable),
+- ``tpu_dra.infra.deadline.Budget`` — ``budget.sleep(delay)`` for
+  retry loops (raises the typed retriable error on expiry) or
+  ``budget.pause(delay)`` for poll loops that re-check their own
+  condition (see ``flock.acquire``),
+- an ``Event().wait(delay)`` when neither applies (interruptible by
+  design even if nothing sets it).
+
+Scope is the driver spine only: ``tests``/``demo``/``hack`` trees and
+the ``workloads``/``tpulib``/``minicluster``/``tools`` layers are
+exempt (JAX payloads, the stub's fault timeline, and CLI tools sleep
+on purpose and serve no kubelet RPC). A wait that is genuinely
+correct as a bare sleep documents itself with
+``# lint: disable=D800 <why>``.
+
+Project-scope pass (like G400/C700): the layer set is a property of
+the whole tree, and running after every FileContext is built keeps a
+``--changed-only`` run's semantics identical to a full run.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from lints.base import FileContext, Finding, add_finding, dotted_name
+from lints.registry import register
+
+# Layers whose waits must be stop-aware / budgeted (module-name prefix
+# under tpu_dra.).
+DRIVER_LAYERS = ("plugin", "computedomain", "k8sclient", "infra")
+
+
+def _in_driver_layer(ctx: FileContext) -> bool:
+    parts = ctx.module_name.split(".")
+    if len(parts) < 2 or parts[0] != "tpu_dra":
+        return False
+    return parts[1] in DRIVER_LAYERS
+
+
+def _sleep_aliases(tree: ast.Module) -> set:
+    """Every dotted/local name that is time.sleep at this module's top
+    level: `from time import sleep [as s]` contributes the bare name,
+    `import time [as t]` contributes `time.sleep` / `t.sleep` — the
+    module-alias spelling is the symmetric hole a literal
+    `"time.sleep"` match would leave open."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name == "sleep":
+                    out.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    out.add(f"{a.asname or a.name}.sleep")
+    return out
+
+
+@register
+class DriverSleepPass:
+    name = "D800"
+    codes = ("D800",)
+    scope = "project"
+
+    def run_project(self, ctxs: List[FileContext],
+                    extra_paths=()) -> List[Finding]:
+        out: List[Finding] = []
+        for ctx in ctxs:
+            if ctx.tree is None or not _in_driver_layer(ctx):
+                continue
+            aliases = _sleep_aliases(ctx.tree)
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = dotted_name(node.func)
+                if callee == "time.sleep" or (callee and callee in aliases):
+                    add_finding(
+                        out, ctx, node.lineno, "D800",
+                        f"bare `{callee}(...)` in driver layer "
+                        f"`{ctx.module_name}` — waits here must be "
+                        f"cancellable and budget-aware: use a stop "
+                        f"event's .wait(), deadline.Budget.sleep() "
+                        f"(retry loops), or Budget.pause() (poll loops)",
+                    )
+        out.sort(key=lambda f: (str(f.path), f.lineno))
+        return out

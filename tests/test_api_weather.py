@@ -1,0 +1,1255 @@
+"""Control-plane weather tests (ISSUE 5): deadline budgets, the per-verb
+circuit breaker, degraded-mode operation, and the apiserver-partition
+soak.
+
+Layers under test, bottom up:
+
+- :mod:`tpu_dra.infra.deadline` — the Go-context-style ``Budget``
+  (deadline + stop event, thread-local activation, budget-capped
+  sleeps);
+- :mod:`tpu_dra.k8sclient.circuit` — the closed/open/half-open state
+  machine, probed with a fake clock;
+- :mod:`tpu_dra.k8sclient.rest` — the transport integration: failures
+  trip the breaker, waits consume the caller's budget, reads can serve
+  from an informer cache while the circuit is open;
+- the plugin: budget expiry mid-Prepare is retriable and converges via
+  the PR-4 WAL; the driver pauses GC/publish while degraded, keeps
+  serving prepare/unprepare from checkpoint state, and runs the fenced
+  resync on heal;
+- the acceptance soak (`make apisoak`): under an ``api_partition``
+  window no kubelet RPC blocks past its budget, and after the heal the
+  stack reconverges (circuit closed, checkpoint == apiserver) within
+  the recovery bound. The smoke runs in tier-1; the seeded weather
+  matrix is ``slow``-marked.
+"""
+
+import tempfile
+import threading
+import time
+
+import pytest
+
+from tests.helpers import make_claim
+from tpu_dra.infra import deadline
+from tpu_dra.infra import featuregates as fg
+from tpu_dra.infra.chaos import (
+    API_LATENCY,
+    API_PARTITION,
+    APISERVER_ERRORS,
+    APISERVER_THROTTLE,
+    WATCH_DROP,
+    ChaosEngine,
+    FaultSchedule,
+)
+from tpu_dra.infra.deadline import Budget, BudgetCancelled, BudgetExceeded
+from tpu_dra.infra.flock import Flock
+from tpu_dra.infra.metrics import Metrics
+from tpu_dra.k8sclient import (
+    DEPLOYMENTS,
+    RESOURCE_CLAIMS,
+    FakeCluster,
+    Informer,
+    ResourceClient,
+    install_read_fallback,
+)
+from tpu_dra.k8sclient.circuit import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+    CircuitOpenError,
+)
+from tpu_dra.k8sclient.degraded import DegradedModeController
+from tpu_dra.k8sclient.fakeserver import FakeApiServer
+from tpu_dra.k8sclient.resources import COMPUTE_DOMAINS
+from tpu_dra.k8sclient.rest import KubeClient
+from tpu_dra.plugin.checkpoint import (
+    CLAIM_STATE_PREPARE_COMPLETED,
+    CLAIM_STATE_PREPARE_STARTED,
+)
+from tpu_dra.plugin.device_state import DRIVER_NAME
+from tpu_dra.plugin.driver import Driver, DriverConfig
+from tpu_dra.plugin.pb import dra_v1beta1_pb2 as drapb
+from tpu_dra.tpulib.stub import StubTpuLib
+
+
+def counter(metrics, name, **labels):
+    return metrics._counters.get(metrics._key(name, labels or None), 0.0)
+
+
+def gauge(metrics, name, **labels):
+    return metrics._gauges.get(metrics._key(name, labels or None))
+
+
+def gates(**kwargs):
+    g = fg.FeatureGates()
+    for k, v in kwargs.items():
+        g.set(k, v)
+    fg.reset_for_tests(g)
+
+
+def wait_for(predicate, timeout=10.0, poll=0.02, msg=""):
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        if predicate():
+            return
+        time.sleep(poll)
+    assert predicate(), msg or "condition did not converge"
+
+
+# --- Budget ------------------------------------------------------------------
+
+
+def test_budget_remaining_expiry_and_check():
+    b = Budget(0.05, name="rpc")
+    assert 0 < b.remaining() <= 0.05
+    assert not b.expired()
+    b.check("fetching claim")  # inside budget: no raise
+    time.sleep(0.06)
+    assert b.expired() and b.remaining() == 0.0
+    with pytest.raises(BudgetExceeded) as ei:
+        b.check("fetching claim")
+    assert "rpc fetching claim" in str(ei.value)
+    # Typed retriable: a TimeoutError, NOT wrapped as permanent.
+    assert isinstance(ei.value, TimeoutError)
+    assert ei.value.retriable is True
+
+
+def test_budget_unbounded_only_ends_on_stop():
+    b = Budget()
+    assert b.remaining() is None and not b.expired()
+    b.check()
+    b.stop.set()
+    with pytest.raises(BudgetCancelled):
+        b.check()
+    # BudgetCancelled IS a BudgetExceeded: one except path for callers.
+    assert issubclass(BudgetCancelled, BudgetExceeded)
+
+
+def test_budget_sleep_refuses_uncoverable_wait():
+    b = Budget(0.05)
+    t0 = time.monotonic()
+    with pytest.raises(BudgetExceeded):
+        b.sleep(10.0, "retrying apiserver get")
+    # The refusal is immediate — it must NOT sleep out the budget tail.
+    assert time.monotonic() - t0 < 0.05
+
+
+def test_budget_sleep_cancelled_by_stop_event():
+    b = Budget(5.0)
+    threading.Timer(0.05, b.stop.set).start()
+    t0 = time.monotonic()
+    with pytest.raises(BudgetCancelled):
+        b.sleep(2.0)
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_budget_pause_clamps_and_never_raises():
+    b = Budget(0.05)
+    t0 = time.monotonic()
+    b.pause(5.0)  # clamped to the remaining budget
+    assert time.monotonic() - t0 < 1.0
+    b.pause(0.01)  # expired: returns immediately, still no raise
+
+
+def test_budget_child_takes_min_deadline_and_shares_stop():
+    parent = Budget(0.05)
+    child = parent.child(timeout=10.0)
+    assert child.deadline() == parent.deadline()  # cannot extend
+    tighter = parent.child(timeout=0.01)
+    assert tighter.deadline() < parent.deadline()  # may tighten
+    assert child.stop is parent.stop
+    unbounded_child = Budget(0.05).child()
+    assert unbounded_child.deadline() is not None  # inherits, not None
+
+
+def test_budget_active_is_thread_local():
+    b = Budget(5.0, name="mine")
+    seen = {}
+    with b.active():
+        assert deadline.current() is b
+
+        def other():
+            seen["other"] = deadline.current()
+
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+    assert seen["other"] is deadline.UNLIMITED
+    assert deadline.current() is deadline.UNLIMITED  # restored on exit
+
+
+def test_flock_acquire_consumes_ambient_budget(tmp_path):
+    lock = Flock(str(tmp_path / "pu.lock"))
+    release = lock.acquire(timeout=5)
+    try:
+        with Budget(0.1).active():
+            t0 = time.monotonic()
+            with pytest.raises(BudgetExceeded):
+                lock.acquire(timeout=60, poll_period=0.01)
+            assert time.monotonic() - t0 < 2.0
+    finally:
+        release()
+    # Uncontended acquire under a live budget still works.
+    with Budget(5.0).active():
+        lock.acquire(timeout=5)()
+
+
+# --- circuit breaker state machine -------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def make_breaker(**kw):
+    clock = FakeClock()
+    kw.setdefault("failure_threshold", 3)
+    kw.setdefault("cooldown_seconds", 5.0)
+    return CircuitBreaker(clock=clock, **kw), clock
+
+
+def test_circuit_trips_after_consecutive_failures():
+    cb, _ = make_breaker()
+    for _ in range(2):
+        cb.record_failure("get")
+    assert cb.state("get") == CLOSED  # below threshold
+    cb.record_success("get")  # success resets the streak
+    for _ in range(2):
+        cb.record_failure("get")
+    assert cb.state("get") == CLOSED
+    cb.record_failure("get")
+    assert cb.state("get") == OPEN
+    # Verbs are independent: "create" never failed.
+    assert cb.state("create") == CLOSED
+    cb.check("create")
+
+
+def test_open_circuit_refuses_with_retry_after():
+    cb, clock = make_breaker()
+    for _ in range(3):
+        cb.record_failure("get")
+    clock.t = 2.0
+    with pytest.raises(CircuitOpenError) as ei:
+        cb.check("get")
+    assert ei.value.verb == "get"
+    assert ei.value.retriable is True
+    assert 2.9 < ei.value.retry_after <= 3.0  # 5s cooldown - 2s elapsed
+    assert ei.value.status == 503
+
+
+def test_half_open_admits_exactly_one_probe():
+    cb, clock = make_breaker()
+    for _ in range(3):
+        cb.record_failure("get")
+    clock.t = 6.0  # past the cooldown
+    cb.check("get")  # the probe is admitted
+    assert cb.state("get") == HALF_OPEN
+    with pytest.raises(CircuitOpenError):
+        cb.check("get")  # concurrent caller refused until the probe lands
+    cb.record_success("get")
+    assert cb.state("get") == CLOSED
+    cb.check("get")  # closed again: flows freely
+
+
+def test_half_open_probe_failure_reopens():
+    cb, clock = make_breaker()
+    for _ in range(3):
+        cb.record_failure("get")
+    clock.t = 6.0
+    cb.check("get")
+    cb.record_failure("get")  # the probe itself failed
+    assert cb.state("get") == OPEN
+    with pytest.raises(CircuitOpenError):
+        cb.check("get")  # a fresh cooldown started at t=6
+    clock.t = 12.0
+    cb.check("get")
+    cb.record_success("get")
+    assert cb.state("get") == CLOSED
+
+
+def test_circuit_listener_fires_on_transitions():
+    cb, clock = make_breaker()
+    edges = []
+    cb.add_listener(lambda verb, old, new: edges.append((verb, old, new)))
+    for _ in range(3):
+        cb.record_failure("get")
+    clock.t = 6.0
+    cb.check("get")
+    cb.record_success("get")
+    assert edges == [
+        ("get", CLOSED, OPEN),
+        ("get", OPEN, HALF_OPEN),
+        ("get", HALF_OPEN, CLOSED),
+    ]
+
+
+def test_circuit_metrics_gauge_and_transition_counters():
+    metrics = Metrics()
+    clock = FakeClock()
+    cb = CircuitBreaker(
+        failure_threshold=2, cooldown_seconds=5.0, metrics=metrics,
+        clock=clock,
+    )
+    # Construction exports a closed gauge for every known verb.
+    assert gauge(metrics, "api_circuit_state", verb="get") == 0
+    cb.record_failure("get")
+    cb.record_failure("get")
+    assert gauge(metrics, "api_circuit_state", verb="get") == 2
+    assert counter(
+        metrics, "api_circuit_transitions_total", verb="get", to=OPEN
+    ) == 1
+    clock.t = 6.0
+    cb.check("get")
+    assert gauge(metrics, "api_circuit_state", verb="get") == 1
+    cb.record_success("get")
+    assert gauge(metrics, "api_circuit_state", verb="get") == 0
+    assert counter(
+        metrics, "api_circuit_transitions_total", verb="get", to=CLOSED
+    ) == 1
+
+
+def test_any_open_and_reset():
+    cb, clock = make_breaker(failure_threshold=1)
+    assert not cb.any_open()
+    cb.record_failure("list")
+    assert cb.any_open()
+    # Half-open still counts: not known-good until the probe lands.
+    clock.t = 6.0
+    cb.check("list")
+    assert cb.state("list") == HALF_OPEN and cb.any_open()
+    cb.reset()
+    assert not cb.any_open() and cb.state("list") == CLOSED
+    assert cb.states()["list"] == CLOSED
+
+
+# --- transport integration (rest.KubeClient vs the fake apiserver) -----------
+
+
+def make_client(srv, metrics=None, threshold=2, cooldown=0.25, timeout=0.3):
+    return KubeClient(
+        srv.server_url,
+        qps=10_000, burst=10_000,
+        metrics=metrics,
+        circuit=CircuitBreaker(
+            failure_threshold=threshold, cooldown_seconds=cooldown,
+            metrics=metrics,
+        ),
+        request_timeouts={v: timeout for v in (
+            "get", "list", "create", "update", "patch", "delete", "watch",
+        )},
+    )
+
+
+@pytest.fixture
+def srv():
+    server = FakeApiServer().start()
+    yield server
+    server.stop()
+
+
+def seed_cd(cluster, name="cd-0"):
+    return ResourceClient(cluster, COMPUTE_DOMAINS).create({
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "ComputeDomain",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"numNodes": 1},
+    })
+
+
+def test_rest_5xx_trip_circuit_then_fail_fast(srv):
+    metrics = Metrics()
+    kc = make_client(srv, metrics=metrics)
+    obj = seed_cd(srv.cluster)
+    cds = ResourceClient(kc, COMPUTE_DOMAINS)
+    assert cds.get("cd-0", "default")["metadata"]["uid"] == (
+        obj["metadata"]["uid"]
+    )
+    # A long 5xx burst exhausts the transport's own retries AND trips
+    # the breaker (threshold 2) along the way.
+    srv.inject_faults(fail=8, fail_status=503)
+    with pytest.raises(Exception):
+        cds.get("cd-0", "default")
+    assert kc.circuit.state("get") == OPEN
+    # While open: refused locally, fast, with the circuit_open metric.
+    t0 = time.monotonic()
+    with pytest.raises(CircuitOpenError):
+        cds.get("cd-0", "default")
+    assert time.monotonic() - t0 < 0.1
+    assert counter(
+        metrics, "api_requests_total", verb="get", code="circuit_open"
+    ) >= 1
+    assert counter(metrics, "api_requests_total", verb="get", code="503") >= 1
+    # After the cooldown the half-open probe goes through and closes it
+    # (the burst count is long gone by now... drain whatever remains).
+    wait_for(
+        lambda: _probe_until_closed(cds, kc), timeout=10,
+        msg="circuit never closed after the burst drained",
+    )
+    assert kc.circuit.state("get") == CLOSED
+
+
+def _probe_until_closed(cds, kc):
+    try:
+        cds.get("cd-0", "default")
+    except Exception:
+        return False
+    return kc.circuit.state("get") == CLOSED
+
+
+def test_slow_answering_apiserver_cannot_outlive_budget(srv):
+    """The answered-slowly regime (api_latency weather under the wire
+    timeout) never fires a retry sleep, so budget.sleep alone cannot
+    bound it: each attempt's wire timeout must be clamped to the
+    remaining budget and every new attempt gated on it, or a sequence
+    of ~0.4s answers rides a 55s RPC straight past its deadline."""
+    kc = KubeClient(srv.server_url, qps=10_000, burst=10_000)
+    seed_cd(srv.cluster)
+    cds = ResourceClient(kc, COMPUTE_DOMAINS)
+    cds.get("cd-0", "default")  # warm the connection, fast-weather
+    srv.inject_faults(latency=0.4)
+    t0 = time.monotonic()
+    with Budget(1.0).active():
+        with pytest.raises(BudgetExceeded):
+            for _ in range(10):  # unclamped: ~4s of answered GETs
+                cds.get("cd-0", "default")
+    elapsed = time.monotonic() - t0
+    assert elapsed < 2.5, f"budget did not bound slow answers ({elapsed:.1f}s)"
+    srv.inject_faults(latency=0.0)
+
+
+def test_rest_read_fallback_serves_cache_while_open(srv):
+    """Satellite: reads may serve from an informer cache while the
+    circuit is open — the degraded read path, through the production
+    ``install_read_fallback`` wiring (the ComputeDomain controller
+    installs exactly this over its informers)."""
+    metrics = Metrics()
+    kc = make_client(srv, metrics=metrics)
+    seed_cd(srv.cluster)
+    # A second, breaker-free client feeds the informer (its transport
+    # weather is not under test here).
+    feeder = KubeClient(srv.server_url, qps=10_000, burst=10_000)
+    informer = Informer(feeder, COMPUTE_DOMAINS)
+    informer.start()
+    assert informer.wait_for_sync(timeout=10)
+    try:
+        install_read_fallback(kc, [informer])
+        cds = ResourceClient(kc, COMPUTE_DOMAINS)
+        for verb in ("get", "list", "create"):
+            kc.circuit.record_failure(verb)
+            kc.circuit.record_failure(verb)
+        assert kc.circuit.state("get") == OPEN
+        # Stale-but-available beats unavailable: both reads serve.
+        assert cds.get("cd-0", "default")["metadata"]["name"] == "cd-0"
+        assert [o["metadata"]["name"] for o in cds.list()] == ["cd-0"]
+        assert counter(
+            metrics, "api_reads_served_from_cache_total", verb="get"
+        ) == 1
+        assert counter(
+            metrics, "api_reads_served_from_cache_total", verb="list"
+        ) == 1
+        # A resource NO installed informer watches falls through to the
+        # circuit error (never a fabricated empty answer), as does a
+        # stale-store get miss (unavailability, not ApiNotFound).
+        with pytest.raises(CircuitOpenError):
+            ResourceClient(kc, RESOURCE_CLAIMS).list()
+        with pytest.raises(CircuitOpenError):
+            cds.get("cd-never-seen", "default")
+        # Writes have no cache to serve from: still refused.
+        with pytest.raises(CircuitOpenError):
+            cds.create({
+                "apiVersion": "resource.tpu.google.com/v1beta1",
+                "kind": "ComputeDomain",
+                "metadata": {"name": "cd-1", "namespace": "default"},
+                "spec": {"numNodes": 1},
+            })
+    finally:
+        informer.stop()
+
+
+def test_informer_relist_bypasses_read_fallback(srv):
+    """An informer's own resync list must observe the REAL apiserver.
+    With the fallback installed on the same backend the informer reads
+    through, an open list circuit would otherwise route the relist to
+    an informer cache — typically its own store, whose scope guards
+    pass by construction — faking a successful resync that emits no
+    DELETEDs and resets the reconnect backoff."""
+    kc = make_client(srv, cooldown=30)
+    seed_cd(srv.cluster)
+    informer = Informer(kc, COMPUTE_DOMAINS)
+    informer.start()
+    assert informer.wait_for_sync(timeout=10)
+    try:
+        install_read_fallback(kc, [informer])
+        kc.circuit.record_failure("list")
+        kc.circuit.record_failure("list")
+        assert kc.circuit.state("list") == OPEN
+        # Ordinary reads: stale-but-available beats unavailable.
+        assert ResourceClient(kc, COMPUTE_DOMAINS).list()
+        # The informer's own resync: fails (and keeps backing off)
+        # instead of serving itself a fake relist.
+        with pytest.raises(CircuitOpenError):
+            informer._relist()
+    finally:
+        informer.stop()
+
+
+def test_degraded_heal_request_during_running_fence_not_dropped():
+    """A heal that loses the fence trylock while a previous fence is
+    mid-replay must still run: the earlier fence already drained the
+    parked-publish flag, so dropping the request would strand a publish
+    parked during the replay until the next unrelated outage."""
+    replay_started = threading.Event()
+    release_replay = threading.Event()
+    replays = []
+
+    def replay():
+        replays.append(1)
+        replay_started.set()
+        release_replay.wait(5)
+
+    ctl = DegradedModeController(
+        circuit=CircuitBreaker(failure_threshold=1, cooldown_seconds=0.05),
+        metrics=Metrics(),
+        stop=threading.Event(),
+        probe=lambda: None,
+        resync=lambda: None,
+        replay=replay,
+    )
+    with ctl._lock:
+        ctl._publish_pending_heal = True
+    t1 = threading.Thread(target=ctl._resync_after_heal)
+    t1.start()
+    assert replay_started.wait(5), "fence #1 never reached its replay"
+    # While fence #1 is mid-replay: a new publish parks, and a second
+    # heal request loses the trylock.
+    with ctl._lock:
+        ctl._publish_pending_heal = True
+    ctl._resync_after_heal()  # must record the request, not drop it
+    release_replay.set()
+    t1.join(5)
+    wait_for(
+        lambda: len(replays) == 2 and not ctl.publish_pending_heal,
+        timeout=5,
+        msg="second heal request dropped; parked publish stranded",
+    )
+
+
+def test_informer_serve_read_scope_guards():
+    """serve_read answers only what the store can faithfully answer:
+    nothing before sync, nothing outside the informer's namespace
+    scope, nothing for a selector it did not watch with."""
+    cluster = FakeCluster()
+    ResourceClient(cluster, COMPUTE_DOMAINS).create({
+        "apiVersion": "resource.tpu.google.com/v1beta1",
+        "kind": "ComputeDomain",
+        "metadata": {
+            "name": "cd-a", "namespace": "default",
+            "labels": {"tier": "prod"},
+        },
+        "spec": {"numNodes": 1},
+    })
+    inf = Informer(cluster, COMPUTE_DOMAINS)
+    assert inf.serve_read("default", "cd-a", None) is None  # pre-sync
+    inf.start()
+    assert inf.wait_for_sync(timeout=10)
+    try:
+        assert inf.serve_read("default", "cd-a", None)["metadata"][
+            "name"] == "cd-a"
+        # List filters: namespace and (informer-side unselected) labels.
+        assert [o["metadata"]["name"]
+                for o in inf.serve_read(None, None, None)] == ["cd-a"]
+        assert inf.serve_read("other-ns", None, None) == []
+        assert [o["metadata"]["name"]
+                for o in inf.serve_read(None, None, {"tier": "prod"})
+                ] == ["cd-a"]
+        assert inf.serve_read(None, None, {"tier": "dev"}) == []
+    finally:
+        inf.stop()
+
+    # A namespace- or selector-scoped informer refuses queries outside
+    # its scope instead of answering from a partial store.
+    scoped = Informer(
+        cluster, COMPUTE_DOMAINS, namespace="default",
+        label_selector={"tier": "prod"},
+    )
+    scoped.start()
+    assert scoped.wait_for_sync(timeout=10)
+    try:
+        assert scoped.serve_read(None, None, None) is None
+        assert scoped.serve_read("default", None, {"tier": "dev"}) is None
+        assert [o["metadata"]["name"]
+                for o in scoped.serve_read(
+                    "default", None, {"tier": "prod"})] == ["cd-a"]
+    finally:
+        scoped.stop()
+
+
+def test_rest_retry_waits_consume_budget(srv):
+    """429 Retry-After waits come out of the caller's budget: when the
+    budget cannot cover the directed wait, the call fails retriable NOW
+    instead of sleeping through its deadline."""
+    kc = make_client(srv)
+    seed_cd(srv.cluster)
+    cds = ResourceClient(kc, COMPUTE_DOMAINS)
+    srv.inject_faults(throttle=3, retry_after=30.0)
+    with Budget(0.4).active():
+        t0 = time.monotonic()
+        with pytest.raises(BudgetExceeded):
+            cds.get("cd-0", "default")
+        assert time.monotonic() - t0 < 1.0
+    srv.inject_faults(throttle=0)
+    kc.circuit.reset()
+    assert cds.get("cd-0", "default")["metadata"]["name"] == "cd-0"
+
+
+def test_rest_partition_is_budget_bounded(srv):
+    """An api_partition blackhole cannot hold a budgeted caller past
+    its deadline: the per-verb read timeout fires, retries consume the
+    budget, and the typed retriable error surfaces."""
+    kc = make_client(srv, timeout=0.2)
+    seed_cd(srv.cluster)
+    cds = ResourceClient(kc, COMPUTE_DOMAINS)
+    srv.inject_faults(partition_seconds=2.0)
+    with Budget(0.8).active():
+        t0 = time.monotonic()
+        with pytest.raises((BudgetExceeded, Exception)) as ei:
+            cds.get("cd-0", "default")
+        elapsed = time.monotonic() - t0
+    # Bound: the budget plus at most one in-flight read timeout.
+    assert elapsed < 0.8 + 0.2 + 0.3, (
+        f"partitioned get took {elapsed:.2f}s ({ei.value!r})"
+    )
+    wait_for(
+        lambda: _probe_until_closed(cds, kc), timeout=10,
+        msg="circuit never closed after the partition healed",
+    )
+
+
+def test_rest_per_verb_timeouts_configurable(srv):
+    kc = KubeClient(
+        srv.server_url, request_timeouts={"list": 7.5, "watch": 3.0}
+    )
+    assert kc._timeout("list") == 7.5
+    assert kc._timeout("watch") == 3.0
+    assert kc._timeout("get") == 30.0  # untouched verbs keep the default
+    assert kc._timeout("brand-new-verb") == 30.0
+
+
+# --- plugin: budget expiry mid-prepare converges via the WAL -----------------
+
+
+MUX_CONFIG = [{
+    "opaque": {
+        "driver": DRIVER_NAME,
+        "parameters": {
+            "apiVersion": "resource.tpu.google.com/v1beta1",
+            # The claim below allocates a *sub-slice* device, so the
+            # sharing config must be the subslice kind — a TpuConfig
+            # only matches full chips and would silently fall through
+            # to the daemon-free default subslice config.
+            "kind": "TpuSubsliceConfig",
+            "sharing": {"strategy": "Multiplexing"},
+        },
+    },
+    "requests": [],
+    "source": "FromClaim",
+}]
+
+
+def make_driver(tmp_path, backend=None, **cfg):
+    lib = StubTpuLib(
+        config={"generation": "v5e", "hostname": "node-0"},
+        state_dir=str(tmp_path / "tpustate"),
+    )
+    backend = backend or FakeCluster()
+    cfg.setdefault("cdi_hook_source", "")
+    # AF_UNIX socket paths cap at ~108 chars; tmp_path is deep.
+    cfg.setdefault("multiplex_socket_root", tempfile.mkdtemp(prefix="aw-"))
+    config = DriverConfig(
+        node_name="node-0",
+        cdi_root=str(tmp_path / "cdi"),
+        plugin_data_dir=str(tmp_path / "plugin"),
+        kubelet_registrar_dir=str(tmp_path / "registry"),
+        start_grpc=False,
+        **cfg,
+    )
+    return Driver(lib, backend, config), backend
+
+
+def prepare_rpc(driver, claim):
+    md = claim["metadata"]
+    req = drapb.NodePrepareResourcesRequest(claims=[drapb.Claim(
+        uid=md["uid"], name=md["name"], namespace=md["namespace"],
+    )])
+    t0 = time.monotonic()
+    resp = driver.dra_service.node_prepare_resources(req, None)
+    return resp.claims[md["uid"]], time.monotonic() - t0
+
+
+def unprepare_rpc(driver, claim):
+    md = claim["metadata"]
+    req = drapb.NodeUnprepareResourcesRequest(claims=[drapb.Claim(
+        uid=md["uid"], name=md["name"], namespace=md["namespace"],
+    )])
+    t0 = time.monotonic()
+    resp = driver.dra_service.node_unprepare_resources(req, None)
+    return resp.claims[md["uid"]], time.monotonic() - t0
+
+
+def mark_daemons_ready(cluster):
+    deployments = ResourceClient(cluster, DEPLOYMENTS)
+    for dep in deployments.list(namespace="tpu-dra-driver"):
+        if (dep.get("status") or {}).get("readyReplicas", 0) < 1:
+            dep["status"] = {"readyReplicas": 1}
+            deployments.update_status(dep)
+
+
+def test_budget_expiry_mid_prepare_converges_via_wal(tmp_path):
+    """The satellite scenario end to end: Prepare runs out of budget
+    AFTER the WAL's PrepareStarted record (stalled on the multiplex
+    daemon readiness gate), the kubelet sees a typed retriable error
+    inside the deadline, and the retry with a fresh budget rolls the
+    partial prepare back and converges — no orphan sub-slices."""
+    gates(MultiplexingSupport=True, DynamicSubslice=True)
+    driver, backend = make_driver(tmp_path)
+    claims = ResourceClient(backend, RESOURCE_CLAIMS)
+    claim = make_claim(devices=("tpu-ss-2x2-0-0-0",), configs=MUX_CONFIG)
+    claim["metadata"]["uid"] = claims.create(claim)["metadata"]["uid"]
+    uid = claim["metadata"]["uid"]
+    driver.dra_service.rpc_budget_seconds = 0.4
+
+    # Nothing marks the control daemon's Deployment ready: the
+    # readiness gate consumes the whole RPC budget.
+    result, took = prepare_rpc(driver, claim)
+    assert result.error.startswith("deadline:"), result.error
+    assert took < 2.0  # the RPC surfaced the expiry, it did not hang
+    cp = driver.state.checkpoints.get()
+    assert cp.prepared_claims[uid].checkpoint_state == (
+        CLAIM_STATE_PREPARE_STARTED
+    )  # the WAL intent record is exactly what makes the retry safe
+    assert counter(driver.metrics, "prepare_budget_exceeded_total") == 1
+
+    # The kubelet retries once the daemon is ready (fresh budget).
+    mark_daemons_ready(backend)
+    driver.dra_service.rpc_budget_seconds = 30.0
+    result2, _ = prepare_rpc(driver, claim)
+    assert result2.error == "", result2.error
+    assert [d.device_name for d in result2.devices] == ["tpu-ss-2x2-0-0-0"]
+    cp = driver.state.checkpoints.get()
+    assert cp.prepared_claims[uid].checkpoint_state == (
+        CLAIM_STATE_PREPARE_COMPLETED
+    )
+    # No orphan sub-slices: exactly the claim's one, nothing leaked by
+    # the rolled-back first attempt.
+    assert len(driver.tpulib.list_subslices()) == 1
+
+    # Idempotent re-Prepare (kubelet redelivery) keeps the same answer.
+    result3, _ = prepare_rpc(driver, claim)
+    assert result3.error == ""
+    assert len(driver.tpulib.list_subslices()) == 1
+    driver.shutdown()
+
+
+def test_unprepare_budget_expiry_is_retriable(tmp_path):
+    """Unprepare stuck behind the node flock runs out of budget with a
+    typed error; the retry (lock free again) converges."""
+    driver, backend = make_driver(tmp_path)
+    claims = ResourceClient(backend, RESOURCE_CLAIMS)
+    claim = make_claim(devices=("tpu-0",))
+    claim["metadata"]["uid"] = claims.create(claim)["metadata"]["uid"]
+    result, _ = prepare_rpc(driver, claim)
+    assert result.error == ""
+
+    driver.dra_service.rpc_budget_seconds = 0.3
+    release = driver.pu_flock.acquire(timeout=5)
+    try:
+        result, took = unprepare_rpc(driver, claim)
+        assert result.error.startswith("deadline:"), result.error
+        assert took < 2.0
+        assert counter(driver.metrics, "unprepare_budget_exceeded_total") == 1
+    finally:
+        release()
+    result2, _ = unprepare_rpc(driver, claim)
+    assert result2.error == "", result2.error
+    assert driver.state.checkpoints.get().prepared_claims == {}
+    driver.shutdown()
+
+
+# --- driver degraded mode ----------------------------------------------------
+
+
+class WeatherHarness:
+    """Driver over REAL HTTP through the circuit-broken KubeClient, with
+    the fake apiserver's partition/latency seams and a kubelet-style
+    timed RPC surface."""
+
+    RPC_BUDGET = 1.5
+    # A returned RPC may overshoot its budget by at most one in-flight
+    # per-verb read timeout plus scheduling slack.
+    RPC_SLACK = 1.0
+
+    def __init__(self, tmp_path):
+        self.srv = FakeApiServer(watch_heartbeat_seconds=1.0).start()
+        self.cluster = self.srv.cluster
+        self.metrics = Metrics()
+        self.kc = make_client(
+            self.srv, metrics=self.metrics, threshold=2, cooldown=0.25,
+            timeout=0.25,
+        )
+        self.driver, _ = make_driver(tmp_path, backend=self.kc)
+        self.driver.dra_service.rpc_budget_seconds = self.RPC_BUDGET
+        self.driver.start()
+        self.rpc_durations = []
+
+    def create_claim(self, devices=("tpu-0",)):
+        # Arrangement writes bypass HTTP: fault injection must never
+        # flake the setup, only the system under test.
+        claim = make_claim(devices=devices)
+        created = ResourceClient(self.cluster, RESOURCE_CLAIMS).create(claim)
+        claim["metadata"]["uid"] = created["metadata"]["uid"]
+        return claim
+
+    def timed_prepare(self, claim):
+        result, took = prepare_rpc(self.driver, claim)
+        self.rpc_durations.append(("prepare", took))
+        return result
+
+    def timed_unprepare(self, claim):
+        result, took = unprepare_rpc(self.driver, claim)
+        self.rpc_durations.append(("unprepare", took))
+        return result
+
+    def assert_rpcs_inside_budget(self):
+        bound = self.RPC_BUDGET + self.RPC_SLACK
+        over = [(op, t) for op, t in self.rpc_durations if t > bound]
+        assert not over, (
+            f"kubelet RPCs blocked past their budget (bound {bound}s): "
+            f"{over}"
+        )
+
+    def prepare_until_converged(self, claim, timeout=15.0):
+        """The kubelet's retry loop: re-Prepare with a fresh budget until
+        success, each attempt individually bounded."""
+        end = time.monotonic() + timeout
+        while time.monotonic() < end:
+            result = self.timed_prepare(claim)
+            if not result.error:
+                return result
+            time.sleep(0.1)
+        raise AssertionError(
+            f"prepare of {claim['metadata']['uid']} did not converge "
+            f"within {timeout}s (last error: {result.error})"
+        )
+
+    def assert_converged(self, recovery_bound=15.0):
+        """Post-heal contract: circuit closed, degraded mode exited, and
+        checkpoint == apiserver claim state."""
+        wait_for(
+            lambda: not self.kc.circuit.any_open(), recovery_bound,
+            msg=f"circuit still open: {self.kc.circuit.states()}",
+        )
+        wait_for(
+            lambda: gauge(self.driver.metrics, "api_degraded") == 0,
+            recovery_bound, msg="driver stuck in degraded mode",
+        )
+
+        def checkpoint_matches_api():
+            cp = self.driver.state.checkpoints.get()
+            live = {
+                c["metadata"]["uid"]
+                for c in ResourceClient(self.cluster, RESOURCE_CLAIMS).list()
+            }
+            return all(
+                uid in live
+                and c.checkpoint_state == CLAIM_STATE_PREPARE_COMPLETED
+                for uid, c in cp.prepared_claims.items()
+            )
+
+        wait_for(
+            checkpoint_matches_api, recovery_bound,
+            msg="checkpoint and apiserver state did not reconverge",
+        )
+
+    def teardown(self):
+        self.driver.shutdown()
+        self.srv.stop()
+
+
+def trip_circuit(h):
+    """Force the breaker open deterministically: answer the next
+    requests 503 and burn them with cheap gets."""
+    h.srv.inject_faults(fail=50, fail_status=503)
+    cds = ResourceClient(h.kc, COMPUTE_DOMAINS)
+    wait_for(
+        lambda: _absorb_failure(cds) and h.kc.circuit.any_open(),
+        timeout=10, msg="circuit did not trip",
+    )
+    h.srv.inject_faults(fail=0)
+
+
+def _absorb_failure(cds):
+    try:
+        cds.get("nope", "default")
+    except Exception:
+        pass
+    return True
+
+
+def test_degraded_mode_pauses_gc_defers_publish_and_heals(tmp_path):
+    h = WeatherHarness(tmp_path)
+    try:
+        claim = h.create_claim(devices=("tpu-0",))
+        assert h.timed_prepare(claim).error == ""
+
+        trip_circuit(h)
+        assert gauge(h.driver.metrics, "api_degraded") == 1
+
+        # GC pauses while degraded (the running thread's 600s interval
+        # never ticks in this test — the thread-level gate is covered by
+        # test_cleanup_manager_skips_passes_while_degraded below).
+        before = counter(
+            h.driver.metrics, "cleanup_passes_skipped_degraded_total"
+        )
+        assert h.driver.circuit.any_open()
+        # A health republish while degraded parks itself for the heal.
+        h.driver.publish_with_retry()
+        assert counter(
+            h.driver.metrics, "publish_deferred_degraded_total"
+        ) >= 1
+        assert h.driver._publish_pending_heal is True
+
+        # Prepare of the ALREADY-COMPLETED claim keeps serving from
+        # checkpoint state — a restarting pod must not wedge.
+        result = h.timed_prepare(claim)
+        assert result.error == "", result.error
+        assert counter(h.driver.metrics, "prepare_served_degraded_total") >= 1
+
+        # Unprepare is local: it keeps working while the apiserver is
+        # dark.
+        claim2 = h.create_claim(devices=("tpu-1",))
+        # (prepare of a NEW claim needs the apiserver — retriable error)
+        r2 = h.timed_prepare(claim2)
+        assert r2.error != ""
+
+        # Heal: the kubelet's retry loop drives the half-open probe
+        # through, the circuit closes, the fenced resync runs, and the
+        # parked publish replays.
+        h.prepare_until_converged(claim2)
+        h.assert_converged()
+        wait_for(
+            lambda: counter(h.driver.metrics, "degraded_resyncs_total") >= 1,
+            10, msg="heal resync never ran",
+        )
+        wait_for(
+            lambda: h.driver._publish_pending_heal is False, 10,
+            msg="parked publish never replayed",
+        )
+        h.assert_rpcs_inside_budget()
+        assert before == 0  # the long-interval GC thread never ticked
+    finally:
+        h.teardown()
+
+
+def test_cleanup_manager_skips_passes_while_degraded(tmp_path):
+    """The GC loop's degraded gate, driven through the real thread."""
+    h = WeatherHarness(tmp_path)
+    try:
+        h.driver.cleanup.stop()
+        h.driver.cleanup.interval = 0.02
+        h.driver.cleanup._stop = threading.Event()
+        h.driver.cleanup.start()
+        trip_circuit(h)
+        wait_for(
+            lambda: counter(
+                h.driver.metrics, "cleanup_passes_skipped_degraded_total"
+            ) >= 2,
+            10, msg="degraded GC passes did not skip",
+        )
+    finally:
+        h.teardown()
+
+
+# --- the apiserver-partition soak (acceptance) -------------------------------
+
+
+def run_partition_soak(tmp_path, schedule=None):
+    """Drive weather over the harness while a kubelet loop keeps
+    issuing prepare/unprepare RPCs. Asserts the acceptance bar: every
+    RPC inside its budget, reconvergence after the heal."""
+    h = WeatherHarness(tmp_path)
+    try:
+        # Steady state: two claims prepared over healthy HTTP.
+        stay = h.create_claim(devices=("tpu-0",))
+        doomed = h.create_claim(devices=("tpu-1",))
+        assert h.timed_prepare(stay).error == ""
+        assert h.timed_prepare(doomed).error == ""
+
+        stop = threading.Event()
+        # Longer than one RPC budget: at least one kubelet attempt is
+        # guaranteed to run out of budget inside the blackhole, and the
+        # failed requests trip the circuit so the heal path (half-open
+        # probe, fenced resync) deterministically runs. Seeded storms
+        # layer on top — their events can be individually too short to
+        # trip anything, which must not let the doomed-claim GC
+        # assertion below silently wait on a resync that never fires.
+        h.srv.inject_faults(partition_seconds=2.5)
+        if schedule is not None:
+            engine = ChaosEngine(schedule)
+            for kind, inject in _weather_injectors(h).items():
+                engine.register(kind, inject)
+            t = threading.Thread(
+                target=engine.run, kwargs={"time_scale": 1.0, "stop": stop},
+                daemon=True,
+            )
+            t.start()
+
+        # The apiserver object for `doomed` vanishes while the plugin
+        # cannot see the control plane: the fenced heal resync must GC
+        # it from the checkpoint afterwards.
+        ResourceClient(h.cluster, RESOURCE_CLAIMS).delete(
+            doomed["metadata"]["name"], doomed["metadata"]["namespace"]
+        )
+
+        # Kubelet keeps trying a NEW claim through the weather; every
+        # attempt must return inside its budget (typed error, not a
+        # stall).
+        fresh = h.create_claim(devices=("tpu-2",))
+        saw_retriable_error = False
+        end = time.monotonic() + 6.0
+        while time.monotonic() < end:
+            result = h.timed_prepare(fresh)
+            if result.error:
+                saw_retriable_error = True
+                assert "PermanentError" not in result.error
+                time.sleep(0.05)
+                continue
+            break
+        h.assert_rpcs_inside_budget()
+
+        # Heal + recovery bound: the new claim converges, the circuit
+        # closes, the fenced resync reconciles the deleted claim away.
+        h.prepare_until_converged(fresh)
+        h.assert_converged(recovery_bound=15.0)
+        wait_for(
+            lambda: doomed["metadata"]["uid"] not in (
+                h.driver.state.checkpoints.get().prepared_claims
+            ),
+            15, msg="fenced resync never GC'd the claim deleted "
+                    "during the partition",
+        )
+        # The surviving claim is untouched, and re-Prepare stays
+        # idempotent after the weather.
+        cp = h.driver.state.checkpoints.get()
+        assert cp.prepared_claims[stay["metadata"]["uid"]].checkpoint_state \
+            == CLAIM_STATE_PREPARE_COMPLETED
+        assert h.timed_prepare(stay).error == ""
+        h.assert_rpcs_inside_budget()
+        stop.set()
+        return saw_retriable_error
+    finally:
+        h.teardown()
+
+
+def _weather_injectors(h):
+    return {
+        API_PARTITION: lambda ev: h.srv.inject_faults(
+            partition_seconds=ev.params["duration"],
+        ),
+        API_LATENCY: lambda ev: h.srv.inject_faults(
+            latency=ev.params["delay"],
+            latency_seconds=ev.params["duration"],
+        ),
+        APISERVER_THROTTLE: lambda ev: h.srv.inject_faults(
+            throttle=ev.params["count"],
+            retry_after=ev.params.get("retry_after", 0.05),
+        ),
+        APISERVER_ERRORS: lambda ev: h.srv.inject_faults(
+            fail=ev.params["count"],
+            fail_status=ev.params.get("status", 503),
+        ),
+        WATCH_DROP: lambda ev: h.srv.inject_faults(drop_watches=True),
+    }
+
+
+def test_api_partition_soak_smoke(tmp_path):
+    """Tier-1 acceptance: one partition window. The kubelet sees typed
+    retriable errors inside the budget while the apiserver is dark, and
+    the stack reconverges after the heal."""
+    saw_error = run_partition_soak(tmp_path)
+    assert saw_error, (
+        "the partition window produced no retriable prepare error — "
+        "the fault never landed and the soak proved nothing"
+    )
+
+
+WEATHER_KINDS = [
+    API_PARTITION, API_LATENCY, APISERVER_THROTTLE, APISERVER_ERRORS,
+    WATCH_DROP,
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [11, 23, 47])
+def test_api_weather_soak_matrix(tmp_path, seed):
+    """Seeded storms mixing partitions, latency, throttles, 5xx bursts
+    and watch drops — same acceptance bar as the smoke."""
+    schedule = FaultSchedule.from_seed(
+        seed, duration=3.0, events_per_second=2.0, kinds=WEATHER_KINDS,
+    )
+    run_partition_soak(tmp_path, schedule=schedule)
+
+
+# --- review regressions: probe leaks, listener deadlock, park races --------
+
+
+def test_release_probe_returns_half_open_slot():
+    """A probe abandoned with no outcome (budget expiry before the
+    request left the client) must not wedge the verb half-open."""
+    cb, clock = make_breaker(failure_threshold=1)
+    cb.record_failure("get")
+    assert cb.state("get") == OPEN
+    clock.t += 5.1
+    cb.check("get")  # grants the half-open probe
+    with pytest.raises(CircuitOpenError):
+        cb.check("get")  # concurrent caller refused while probing
+    cb.release_probe("get")
+    cb.check("get")  # the NEXT caller may probe instead of being wedged
+    cb.record_success("get")
+    assert cb.state("get") == CLOSED
+
+
+def test_rest_abandoned_probe_does_not_wedge_half_open(srv):
+    """Transport-level version: the granted probe dies inside the QPS
+    throttle wait (BudgetExceeded) before any outcome reaches the
+    breaker; a later caller must still be able to probe and close."""
+    kc = KubeClient(
+        srv.server_url, qps=1, burst=1,
+        circuit=CircuitBreaker(failure_threshold=2, cooldown_seconds=0.2),
+        request_timeouts={"get": 0.5},
+    )
+    seed_cd(srv.cluster)
+    cds = ResourceClient(kc, COMPUTE_DOMAINS)
+    cds.get("cd-0", "default")  # drains the single-token bucket
+    kc.circuit.record_failure("get")
+    kc.circuit.record_failure("get")
+    assert kc.circuit.state("get") == OPEN
+    time.sleep(0.25)  # cooldown elapses; next check grants the probe
+    # A budget below MIN_ATTEMPT_SECONDS fails BEFORE the breaker is
+    # consulted: no probe slot is granted, the circuit stays untouched.
+    with Budget(0.01).active():
+        with pytest.raises(BudgetExceeded):
+            cds.get("cd-0", "default")
+    assert kc.circuit.state("get") == OPEN
+    # A budget that passes the pre-attempt gate but cannot cover the
+    # ~1s throttle wait IS granted the probe and abandons it there.
+    with Budget(0.1).active():
+        with pytest.raises(BudgetExceeded):
+            cds.get("cd-0", "default")  # ~1s throttle wait, ~100ms budget
+    assert kc.circuit.state("get") == HALF_OPEN
+    # The abandoned slot was returned: an unbudgeted caller probes
+    # through and closes the circuit.
+    wait_for(
+        lambda: _probe_until_closed(cds, kc), timeout=10,
+        msg="half-open probe slot leaked; circuit can never close",
+    )
+
+
+def test_publish_circuit_trip_on_publish_thread_does_not_deadlock(tmp_path):
+    """publish_resources holds _publish_lock across its apiserver calls;
+    when those calls trip the breaker, _on_circuit fires synchronously
+    ON THE PUBLISHING THREAD. It must not re-acquire _publish_lock."""
+    h = WeatherHarness(tmp_path)
+    try:
+        assert gauge(h.driver.metrics, "api_degraded") == 0
+        # Threshold is 2: one publish's list retries record enough 503
+        # failures to trip the breaker mid-call.
+        h.srv.inject_faults(fail=50, fail_status=503)
+        done = threading.Event()
+        err = []
+
+        def _publish():
+            try:
+                h.driver.publish_resources()
+            except Exception as e:
+                err.append(e)
+            finally:
+                done.set()
+
+        t = threading.Thread(target=_publish, daemon=True)
+        t.start()
+        assert done.wait(timeout=20), (
+            "publish_resources deadlocked against the circuit listener"
+        )
+        assert err, "publish should have failed under the 503 burst"
+        assert gauge(h.driver.metrics, "api_degraded") == 1
+        h.srv.inject_faults(fail=0)
+    finally:
+        h.teardown()
+
+
+def test_defer_publish_unparks_when_circuit_closes_mid_park(tmp_path):
+    """The heal resync may drain _publish_pending_heal between the
+    degraded gate and the park; with the circuit already closed again,
+    no future heal will replay the parked publish — the defer must
+    detect the close, take the park back, and let the caller publish."""
+    h = WeatherHarness(tmp_path)
+    try:
+        answers = iter([True, False])  # gate sees the outage; park recheck
+        h.driver.circuit.any_open = lambda: next(answers)  # sees the heal
+        assert h.driver._defer_publish_while_degraded() is False
+        assert h.driver._publish_pending_heal is False
+    finally:
+        del h.driver.circuit.any_open
+        h.teardown()
+
+
+def test_informer_resync_backoff_exponent_capped():
+    """A multi-hour outage pushes the consecutive-failure count past
+    2**1024's float range; the delay must stay capped, not overflow."""
+    inf = Informer(FakeCluster(), COMPUTE_DOMAINS)
+    inf._resync_failures = 5000
+    delay = inf._next_resync_delay()  # must not raise OverflowError
+    # The cap is the documented worst case: jitter spreads below it,
+    # never past it.
+    assert delay <= inf.resync_backoff_max
+
+
+def test_cd_driver_degraded_gauge_and_heal_resync(srv, tmp_path):
+    """CDDriver has the same degraded-mode contract as Driver: the
+    api_degraded gauge tracks the breaker and a fenced resync (claim GC
+    + slice republish) runs on heal."""
+    from tpu_dra.computedomain.cdplugin.driver import CDDriver, CDDriverConfig
+    from tpu_dra.k8sclient import RESOURCE_SLICES
+
+    kc = make_client(srv)
+    driver = CDDriver(
+        kc,
+        CDDriverConfig(
+            node_name="cd-node-0",
+            cdi_root=f"{tmp_path}/cdi",
+            plugin_data_dir=f"{tmp_path}/plugin",
+            start_grpc=False,
+        ),
+        clique_id="s.0",
+    )
+    assert gauge(driver.metrics, "api_degraded") == 0
+    kc.circuit.record_failure("get")
+    kc.circuit.record_failure("get")
+    assert gauge(driver.metrics, "api_degraded") == 1
+    # Heal: the listener leaves degraded mode through the fenced resync,
+    # which republishes this node's CD slices.
+    kc.circuit.record_success("get")
+    assert gauge(driver.metrics, "api_degraded") == 0
+    wait_for(
+        lambda: counter(driver.metrics, "degraded_resyncs_total") >= 1,
+        10, msg="CD heal resync never ran",
+    )
+    wait_for(
+        lambda: len(ResourceClient(kc, RESOURCE_SLICES).list()) > 0,
+        10, msg="CD heal resync never republished the slices",
+    )

@@ -32,6 +32,8 @@ import pytest
 from tpu_dra.infra import crashpoint as crashpoint_mod
 from tpu_dra.infra import featuregates as fg
 from tpu_dra.infra.chaos import (
+    API_LATENCY,
+    API_PARTITION,
     APISERVER_ERRORS,
     APISERVER_THROTTLE,
     CHIP_DOWN,
@@ -292,6 +294,13 @@ class ChaosHarness:
             ))
             e.register(WATCH_DROP, lambda ev: self.srv.inject_faults(
                 drop_watches=True,
+            ))
+            e.register(API_PARTITION, lambda ev: self.srv.inject_faults(
+                partition_seconds=ev.params["duration"],
+            ))
+            e.register(API_LATENCY, lambda ev: self.srv.inject_faults(
+                latency=ev.params["delay"],
+                latency_seconds=ev.params["duration"],
             ))
         return e
 

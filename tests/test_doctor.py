@@ -226,6 +226,10 @@ def test_metrics_probe_surfaces_failing_informer(tmp_path):
     kc.MAX_CONN_RETRIES = 0
     inf = Informer(kc, COMPUTE_DOMAINS, metrics=metrics)
     inf.resync_backoff = 0.02
+    # Keep the reconnect cadence fast for the climb-delta window below:
+    # reconnects now back off exponentially (ISSUE 5), and the capped
+    # delay is what keeps the counter climbing at a steady rate.
+    inf.resync_backoff_max = 0.05
     inf.start()
     try:
         import time
@@ -296,5 +300,94 @@ def test_metrics_probe_quiet_on_stable_counters(tmp_path):
             metrics_endpoints=["127.0.0.1:1"],
         )
         assert any("did not answer" in w for w in report3["warnings"])
+    finally:
+        srv.stop()
+
+
+def test_metrics_probe_surfaces_degraded_mode(tmp_path):
+    """ISSUE 5: a driver riding out apiserver weather exports
+    api_degraded=1 and an open per-verb circuit gauge; doctor names the
+    degraded state, the tripped verb, and what keeps serving."""
+    from tpu_dra.infra.metrics import Metrics, MetricsServer
+
+    metrics = Metrics()
+    metrics.set_gauge("api_degraded", 1)
+    metrics.set_gauge("api_circuit_state", 2, labels={"verb": "get"})
+    metrics.set_gauge("api_circuit_state", 0, labels={"verb": "create"})
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        endpoint = f"127.0.0.1:{srv.port}"
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint],
+        )
+        warns = "\n".join(report["warnings"])
+        assert "DEGRADED mode" in warns
+        assert "circuit for 'get' is open" in warns
+        assert "'create'" not in warns  # closed circuits stay quiet
+        deg = report["metrics"][endpoint]["degraded"]
+        assert deg["api_degraded"] is True
+        assert deg["circuits"] == {"get": "open", "create": "closed"}
+        out = render(report)
+        assert "DEGRADED mode (apiserver circuit open)" in out
+        assert "circuit[get] = open" in out
+        assert "circuit[create]" not in out
+    finally:
+        srv.stop()
+
+
+def test_metrics_probe_sees_cd_plugin_prefix(tmp_path):
+    """The CD plugin's registry renders as tpu_dra_cd_* — the weather
+    gauges are matched by suffix, so its degraded state is not silently
+    invisible to the doctor."""
+    from tpu_dra.infra.metrics import Metrics, MetricsServer
+
+    metrics = Metrics(prefix="tpu_dra_cd")
+    metrics.set_gauge("api_degraded", 1)
+    metrics.set_gauge("api_circuit_state", 2, labels={"verb": "list"})
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        endpoint = f"127.0.0.1:{srv.port}"
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint],
+        )
+        warns = "\n".join(report["warnings"])
+        assert "DEGRADED mode" in warns
+        assert "circuit for 'list' is open" in warns
+        deg = report["metrics"][endpoint]["degraded"]
+        assert deg["api_degraded"] is True
+        assert deg["circuits"] == {"list": "open"}
+    finally:
+        srv.stop()
+
+
+def test_metrics_probe_quiet_when_circuits_closed(tmp_path):
+    """A healthy driver (api_degraded=0, all circuits closed) adds no
+    degraded warnings — the gauges merely being exported is normal."""
+    from tpu_dra.infra.metrics import Metrics, MetricsServer
+
+    metrics = Metrics()
+    metrics.set_gauge("api_degraded", 0)
+    for verb in ("get", "list", "create"):
+        metrics.set_gauge("api_circuit_state", 0, labels={"verb": verb})
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[f"127.0.0.1:{srv.port}"],
+        )
+        assert report["warnings"] == [], report["warnings"]
+        deg = report["metrics"][f"127.0.0.1:{srv.port}"]["degraded"]
+        assert deg["api_degraded"] is False
     finally:
         srv.stop()

@@ -33,6 +33,7 @@ from lints.layering import LayeringPass, validate_dag  # noqa: E402
 from lints.legacy import CorePass  # noqa: E402
 from lints.names import UndefinedNamePass  # noqa: E402
 from lints.races import RaceLintPass  # noqa: E402
+from lints.sleeps import DriverSleepPass  # noqa: E402
 from lints.tracer import TracerSafetyPass  # noqa: E402
 
 
@@ -1088,6 +1089,108 @@ def test_c700_changed_only_keeps_cross_file_uniqueness(tmp_path):
     )
     assert [f.code for f in out] == ["C701"]
     assert out[0].path == linted.path
+
+
+# --- D800 bare time.sleep in driver layers ------------------------------------
+
+
+def d800(tmp_path, rel, source):
+    ctx = FileContext(write(tmp_path, rel, source), tmp_path)
+    return [f.code for f in DriverSleepPass().run_project([ctx])]
+
+
+def test_d800_bare_sleep_in_driver_layer_fires(tmp_path):
+    src = '''
+        import time
+
+
+        def retry():
+            time.sleep(0.5)
+    '''
+    assert d800(tmp_path, "tpu_dra/plugin/driver.py", src) == ["D800"]
+    assert d800(tmp_path, "tpu_dra/k8sclient/rest.py", src) == ["D800"]
+    assert d800(tmp_path, "tpu_dra/infra/flock.py", src) == ["D800"]
+    assert d800(
+        tmp_path, "tpu_dra/computedomain/cdplugin/driver.py", src
+    ) == ["D800"]
+
+
+def test_d800_from_import_alias_fires(tmp_path):
+    src = '''
+        from time import sleep as snooze
+
+
+        def retry():
+            snooze(1.0)
+    '''
+    assert d800(tmp_path, "tpu_dra/plugin/cleanup.py", src) == ["D800"]
+
+
+def test_d800_module_import_alias_fires(tmp_path):
+    src = '''
+        import time as t
+
+
+        def retry():
+            t.sleep(0.5)
+    '''
+    assert d800(tmp_path, "tpu_dra/plugin/cleanup.py", src) == ["D800"]
+
+
+def test_d800_negative_stop_aware_and_budgeted_waits(tmp_path):
+    src = '''
+        import threading
+
+        from tpu_dra.infra import deadline
+
+
+        def retry(stop: threading.Event):
+            stop.wait(0.5)
+            deadline.current().sleep(0.5, "retrying")
+            deadline.current().pause(0.1)
+    '''
+    assert d800(tmp_path, "tpu_dra/plugin/driver.py", src) == []
+
+
+def test_d800_exempt_layers_and_trees(tmp_path):
+    src = '''
+        import time
+
+
+        def wait():
+            time.sleep(1.0)
+    '''
+    # JAX payloads, the device stub, the minicluster, and CLI tools
+    # sleep on purpose; tests/demo/hack are not driver code at all.
+    assert d800(tmp_path, "tpu_dra/workloads/decode.py", src) == []
+    assert d800(tmp_path, "tpu_dra/tpulib/stub.py", src) == []
+    assert d800(tmp_path, "tpu_dra/minicluster/kubelet.py", src) == []
+    assert d800(tmp_path, "tpu_dra/tools/doctor.py", src) == []
+    assert d800(tmp_path, "tests/test_something.py", src) == []
+    assert d800(tmp_path, "hack/tool.py", src) == []
+
+
+def test_d800_disable_marker(tmp_path):
+    src = '''
+        import time
+
+
+        def hold():
+            time.sleep(0.05)  # lint: disable=D800 (injected fault hold)
+    '''
+    assert d800(tmp_path, "tpu_dra/k8sclient/fakeserver.py", src) == []
+
+
+def test_d800_real_driver_layers_are_clean():
+    """The live tree holds the invariant the pass enforces: no
+    unannotated bare sleep anywhere in the driver spine."""
+    ctxs = [
+        FileContext(p, REPO)
+        for layer in ("plugin", "computedomain", "k8sclient", "infra")
+        for p in sorted((REPO / "tpu_dra" / layer).rglob("*.py"))
+        if "/pb/" not in str(p)
+    ]
+    assert DriverSleepPass().run_project(ctxs) == []
 
 
 # --- B100 bench schema --------------------------------------------------------

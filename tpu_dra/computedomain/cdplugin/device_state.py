@@ -33,6 +33,7 @@ from tpu_dra.computedomain import (
     NUM_CHANNELS,
 )
 from tpu_dra.computedomain.daemon.bootstrap import read_bootstrap_env
+from tpu_dra.infra import deadline
 from tpu_dra.infra.crashpoint import crashpoint
 from tpu_dra.k8sclient import COMPUTE_DOMAINS, NODES, ResourceClient
 from tpu_dra.plugin.cdi import CDIHandler
@@ -155,20 +156,25 @@ class CDDeviceState:
 
     def assert_compute_domain_ready(self, cd_uid: str) -> dict:
         """computedomain.go:238-295: raising here holds the workload pod in
-        ContainerCreating; the kubelet retries until the slice is whole."""
-        deadline = time.monotonic() + self.ready_timeout
+        ContainerCreating; the kubelet retries until the slice is whole.
+
+        The wait consumes the calling RPC's deadline budget (expiry is
+        retriable too — the kubelet re-Prepares with a fresh budget)."""
+        budget = deadline.current()
+        ready_deadline = time.monotonic() + self.ready_timeout
         while True:
             cd = self._get_cd_by_uid(cd_uid)
             if cd is None:
                 raise PrepareError(f"ComputeDomain {cd_uid} not found")
             if cd.get("status", {}).get("status") == "Ready":
                 return cd
-            if time.monotonic() >= deadline:
+            if time.monotonic() >= ready_deadline:
                 raise PrepareError(
                     f"ComputeDomain {cd_uid} is not ready "
                     f"({cd.get('status', {}).get('status') or 'no status'})"
                 )
-            time.sleep(0.1)
+            budget.check(f"waiting for ComputeDomain {cd_uid} readiness")
+            budget.pause(0.1)
 
     # --- prepare/unprepare ---
 

@@ -23,6 +23,8 @@ from tpu_dra.computedomain.cdplugin.device_state import (
 from tpu_dra.infra.flock import Flock
 from tpu_dra.infra.metrics import Metrics
 from tpu_dra.k8sclient import RESOURCE_SLICES, ResourceClient
+from tpu_dra.k8sclient.circuit import bind_backend_metrics
+from tpu_dra.k8sclient.degraded import DegradedModeController
 from tpu_dra.plugin.cdi import CDIHandler
 from tpu_dra.plugin.checkpoint import (
     CLAIM_STATE_PREPARE_COMPLETED,
@@ -73,17 +75,41 @@ class CDDriver:
             ready_timeout=config.ready_timeout,
         )
         self.slices = ResourceClient(backend, RESOURCE_SLICES)
+        self._stop = threading.Event()
         # Same RPC surface as the TPU plugin; only the state machine differs
         # (DRAService is generic over anything with prepare/unprepare).
+        # Budgets minted per kubelet RPC carry the stop event; the
+        # transport's circuit breaker (when the backend is rest.
+        # KubeClient) pauses the claim GC while the apiserver is dark.
+        self.circuit = bind_backend_metrics(backend, self.metrics)
         self.dra_service = DRAService(
-            self.state, backend, self.pu_flock, metrics=self.metrics
+            self.state, backend, self.pu_flock, metrics=self.metrics,
+            stop=self._stop,
         )
         self.cleanup = CheckpointCleanupManager(
-            self.state, backend, pu_flock=self.pu_flock
+            self.state, backend, pu_flock=self.pu_flock,
+            metrics=self.metrics, circuit=self.circuit,
         )
+        # Degraded mode, same contract (and shared state machine) as
+        # the TPU plugin's Driver: the api_degraded gauge (prefixed
+        # tpu_dra_cd_ here — the doctor matches the suffix) flips while
+        # any verb's circuit is open, and a fenced resync re-runs the
+        # claim GC + slice republish on heal.
+        self.degraded_ctl: Optional[DegradedModeController] = None
+        if self.circuit is not None:
+            node = config.node_name
+            self.degraded_ctl = DegradedModeController(
+                circuit=self.circuit,
+                metrics=self.metrics,
+                stop=self._stop,
+                probe=lambda: self.slices.get(f"{node}-cd-heal-probe"),
+                resync=self._heal_reconcile,
+                name="cd-",
+            )
+        else:
+            self.metrics.set_gauge("api_degraded", 0)
         self.label_gc_period = 60.0
         self._servers = []
-        self._stop = threading.Event()
         self._label_gc_thread: Optional[threading.Thread] = None
 
     def _rebuild_checkpoint_from_scan(self) -> Checkpoint:
@@ -195,6 +221,27 @@ class CDDriver:
             getattr(self, "_socket_paths", []),
             getattr(self, "registration", None),
         )
+
+    # --- degraded mode (control-plane weather; shared state machine) ---
+
+    def _heal_reconcile(self) -> None:
+        """The CD-specific half of the fenced heal resync
+        (DegradedModeController drives it): re-run the claim GC against
+        the recovered apiserver and republish this node's CD
+        ResourceSlices (a publish that failed while the control plane
+        was dark would otherwise stay missing until restart). Each step
+        fails independently — a flaky GC must not block the
+        republish."""
+        try:
+            cleaned = self.cleanup.cleanup_once()
+            if cleaned:
+                log.warning(
+                    "CD heal resync: unprepared %d claim(s) that went "
+                    "stale during the outage", cleaned,
+                )
+        except Exception as e:  # noqa: BLE001 — resync is best-effort
+            log.warning("CD heal resync claim reconcile failed: %s", e)
+        self.publish_resources()
 
     MAX_DEVICES_PER_SLICE = 128  # apiserver validation cap on spec.devices
 

@@ -30,6 +30,7 @@ from tpu_dra.k8sclient import (
     COMPUTE_DOMAINS,
     Informer,
     ResourceClient,
+    install_read_fallback,
 )
 
 log = logging.getLogger(__name__)
@@ -84,6 +85,14 @@ class ComputeDomainController:
         self.clique_informer.add_handler(self._on_clique_event)
         self.cd_informer.start()
         self.clique_informer.start()
+        # Degraded reads: while the apiserver circuit is open, get/list
+        # for the watched resources serves stale from the informer
+        # stores (reconcile decisions on slightly-old state beat a
+        # controller frozen behind CircuitOpenError; writes still fail
+        # fast and requeue).
+        install_read_fallback(
+            self.backend, [self.cd_informer, self.clique_informer]
+        )
         self._threads.append(self.queue.run_in_thread())
         t = threading.Thread(
             target=self._periodic_sync, daemon=True, name="cd-periodic-sync"

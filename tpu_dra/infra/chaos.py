@@ -29,6 +29,8 @@ Schedule JSON format (``*.chaos.json``)::
         {"at": 1.5, "kind": "apiserver_throttle", "count": 5,
          "retry_after": 0.05},
         {"at": 1.6, "kind": "apiserver_errors", "count": 3, "status": 503},
+        {"at": 1.8, "kind": "api_partition", "duration": 0.5},
+        {"at": 1.9, "kind": "api_latency", "delay": 0.1, "duration": 0.5},
         {"at": 2.0, "kind": "watch_drop"},
         {"at": 2.5, "kind": "plugin_crash"},
         {"at": 2.8, "kind": "crash",
@@ -48,6 +50,7 @@ import json
 import logging
 import os
 import random
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
@@ -75,11 +78,26 @@ CRASH = "crash"                    # process death at a NAMED crash point
 #   (tpu_dra.infra.crashpoint registry) — unlike plugin_crash, which kills
 #   the plugin "whenever", a crash event arms a registered crash point so
 #   process death lands at a specific instruction of the WAL lifecycle.
+API_PARTITION = "api_partition"    # fakeserver blackhole: requests hang
+#   for params["duration"] seconds (then 503) and watch streams drop —
+#   the fault deadline budgets + the circuit breaker exist for.
+API_LATENCY = "api_latency"        # fakeserver injects params["delay"]
+#   seconds into every request for params["duration"] seconds (slow
+#   concierge / overloaded etcd analog).
 
 FAULT_KINDS = frozenset({
     CHIP_DOWN, CHIP_UP, APISERVER_THROTTLE, APISERVER_ERRORS,
     WATCH_DROP, PLUGIN_CRASH, CLIENT_DEATH, CRASH,
+    API_PARTITION, API_LATENCY,
 })
+
+
+def _positive_number(v: object) -> bool:
+    return (
+        isinstance(v, (int, float))
+        and not isinstance(v, bool)
+        and v > 0
+    )
 
 # Per-kind required params: name -> predicate (check_bench_schema-style).
 _REQUIRED_PARAMS: Dict[str, Dict[str, Callable[[object], bool]]] = {
@@ -96,6 +114,13 @@ _REQUIRED_PARAMS: Dict[str, Dict[str, Callable[[object], bool]]] = {
         # soak "passes" while never crashing anywhere (the schedule gate
         # catches drift when a point is renamed).
         "point": lambda v: isinstance(v, str) and v in CRASH_POINTS,
+    },
+    API_PARTITION: {
+        "duration": _positive_number,
+    },
+    API_LATENCY: {
+        "delay": _positive_number,
+        "duration": _positive_number,
     },
 }
 
@@ -322,6 +347,18 @@ class FaultSchedule:
                 events.append(FaultEvent(at, kind, {
                     "point": rng.choice(sorted(CRASH_POINTS)),
                 }))
+            elif kind == API_PARTITION:
+                # Short windows: the soak's convergence assertions need
+                # the terminal state reachable well inside its timeout,
+                # and budgets/circuits trip on fractions of a second.
+                events.append(FaultEvent(at, kind, {
+                    "duration": round(rng.uniform(0.1, 0.8), 3),
+                }))
+            elif kind == API_LATENCY:
+                events.append(FaultEvent(at, kind, {
+                    "delay": round(rng.uniform(0.02, 0.2), 3),
+                    "duration": round(rng.uniform(0.2, 1.0), 3),
+                }))
             else:  # watch_drop / plugin_crash / client_death
                 events.append(FaultEvent(at, kind, {}))
         if not events:
@@ -410,15 +447,21 @@ class ChaosEngine:
         self._fire(ev)
         return ev
 
-    def run(self, time_scale: float = 1.0) -> None:
+    def run(self, time_scale: float = 1.0, stop=None) -> None:
         """Fire all remaining events on the schedule's timeline, scaled by
-        ``time_scale`` (0 = as fast as possible)."""
+        ``time_scale`` (0 = as fast as possible). An optional ``stop``
+        event aborts the drill between events (a harness tearing down
+        early must not leave this thread sleeping out the timeline)."""
+        if stop is None:
+            stop = threading.Event()
         start = time.monotonic()
         while self._cursor < len(self.schedule.events):
             ev = self.schedule.events[self._cursor]
             if time_scale > 0:
                 delay = ev.at * time_scale - (time.monotonic() - start)
-                if delay > 0:
-                    time.sleep(delay)
+                if delay > 0 and stop.wait(delay):
+                    return
+            if stop.is_set():
+                return
             self._cursor += 1
             self._fire(ev)

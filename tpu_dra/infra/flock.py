@@ -21,6 +21,8 @@ import time
 from contextlib import contextmanager
 from typing import Iterator, Optional
 
+from tpu_dra.infra import deadline
+
 
 class FlockTimeout(TimeoutError):
     pass
@@ -35,12 +37,20 @@ class Flock:
         timeout: Optional[float] = None,
         poll_period: float = 0.1,
         cancel_event: Optional[threading.Event] = None,
+        budget: Optional[deadline.Budget] = None,
     ):
         """Acquire the lock; returns a zero-arg release callable.
 
-        Polls every ``poll_period`` seconds until acquired, timed out, or
-        ``cancel_event`` is set (the context-cancellation analog).
+        Polls every ``poll_period`` seconds until acquired, timed out,
+        ``cancel_event`` is set, or the deadline budget runs out. The
+        budget defaults to the caller's ambient one
+        (:func:`tpu_dra.infra.deadline.current`), so a kubelet RPC's
+        deadline bounds this wait even when the call site predates
+        budgets; expiry raises the typed retriable
+        :class:`~tpu_dra.infra.deadline.BudgetExceeded` (a sibling of
+        :class:`FlockTimeout` — both are TimeoutError).
         """
+        budget = budget or deadline.current()
         fd = os.open(self.path, os.O_RDWR | os.O_CREAT, 0o644)
         t0 = time.monotonic()
         try:
@@ -61,7 +71,11 @@ class Flock:
                     raise InterruptedError(
                         f"cancelled while acquiring lock ({self.path})"
                     )
-                time.sleep(poll_period)
+                budget.check(f"acquiring lock ({self.path})")
+                if cancel_event is not None:
+                    cancel_event.wait(poll_period)
+                else:
+                    budget.pause(poll_period)
         except BaseException:
             os.close(fd)
             raise

@@ -40,4 +40,7 @@ from tpu_dra.k8sclient.resources import (  # noqa: F401
     ResourceDescriptor,
 )
 from tpu_dra.k8sclient.fake import FakeCluster  # noqa: F401
-from tpu_dra.k8sclient.informer import Informer  # noqa: F401
+from tpu_dra.k8sclient.informer import (  # noqa: F401
+    Informer,
+    install_read_fallback,
+)

@@ -1,0 +1,285 @@
+"""Per-verb circuit breaker for the apiserver transport.
+
+Reference analog: client-go pairs its rate limiter with backoff
+managers so a flapping apiserver is not hammered by every component at
+once; production control planes additionally front the client with a
+breaker (the pattern ParvaGPU/MISO-class multi-tenant allocators treat
+as table stakes for allocator availability). This module is the state
+machine; :mod:`tpu_dra.k8sclient.rest` wires it around every request.
+
+States, per verb (reads and writes fail independently — a partition
+usually takes out both, but an overloaded apiserver often sheds
+expensive LISTs while GETs still serve):
+
+- **closed**: requests flow; ``failure_threshold`` consecutive
+  transport-class failures (connection errors, timeouts, 5xx) trip it;
+- **open**: requests are refused instantly with
+  :class:`CircuitOpenError` (typed retriable) for ``cooldown_seconds``
+  — the caller gets its budget back instead of burning it on a host
+  that is not answering;
+- **half-open**: after the cooldown ONE probe request is let through;
+  success closes the circuit (and notifies listeners — the driver's
+  fenced resync hangs off that edge), failure re-opens it for another
+  cooldown.
+
+Semantic HTTP errors (404/409/410/4xx) and 429 throttles count as
+*successes* here: the server answered, the control plane is alive.
+
+Metrics (when a :class:`~tpu_dra.infra.metrics.Metrics` registry is
+attached): ``api_circuit_state{verb}`` gauge (0 closed / 1 half-open /
+2 open) and ``api_circuit_transitions_total{verb,to}``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from tpu_dra.k8sclient.resources import K8sApiError
+
+log = logging.getLogger(__name__)
+
+CLOSED = "closed"
+HALF_OPEN = "half_open"
+OPEN = "open"
+
+# Gauge encoding for api_circuit_state{verb}.
+STATE_GAUGE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+DEFAULT_FAILURE_THRESHOLD = 5
+DEFAULT_COOLDOWN_SECONDS = 5.0
+
+# The verbs the transport distinguishes (rest.KubeClient tags each
+# request); anything else gets its own lazily-created slot.
+VERBS = ("get", "list", "create", "update", "patch", "delete", "watch")
+
+
+class CircuitOpenError(K8sApiError):
+    """Refused locally: the circuit for this verb is open. Retriable —
+    the apiserver was never contacted, so retrying after the cooldown
+    (or serving reads from an informer cache) is always safe."""
+
+    retriable = True
+
+    def __init__(self, verb: str, retry_after: float):
+        super().__init__(
+            f"apiserver circuit open for {verb!r} "
+            f"(retry in {retry_after:.1f}s)",
+            status=503,
+        )
+        self.verb = verb
+        self.retry_after = retry_after
+
+
+class _VerbState:
+    __slots__ = ("state", "failures", "opened_at", "probing")
+
+    def __init__(self) -> None:
+        self.state = CLOSED
+        self.failures = 0
+        self.opened_at = 0.0
+        self.probing = False
+
+
+# Listener signature: (verb, old_state, new_state) -> None.
+Listener = Callable[[str, str, str], None]
+
+
+class CircuitBreaker:
+    def __init__(
+        self,
+        failure_threshold: int = DEFAULT_FAILURE_THRESHOLD,
+        cooldown_seconds: float = DEFAULT_COOLDOWN_SECONDS,
+        metrics=None,
+        clock=time.monotonic,
+    ):
+        self.failure_threshold = failure_threshold
+        self.cooldown_seconds = cooldown_seconds
+        self.metrics = metrics
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._verbs: Dict[str, _VerbState] = {}
+        self._listeners: List[Listener] = []
+        if metrics is not None:
+            for verb in VERBS:
+                metrics.set_gauge(
+                    "api_circuit_state", STATE_GAUGE[CLOSED],
+                    labels={"verb": verb},
+                )
+
+    # --- wiring ---
+
+    def add_listener(self, fn: Listener) -> None:
+        """Called on every state transition, OUTSIDE the breaker lock
+        (listeners may issue API calls — the driver's heal resync
+        does)."""
+        self._listeners.append(fn)
+
+    def attach_metrics(self, metrics) -> None:
+        """Late-bind a metrics registry and seed the per-verb state
+        gauges. The real binaries build the transport (KubeClient +
+        breaker) from flags BEFORE the driver's registry exists; the
+        driver attaches its own here so `api_circuit_state` is exported
+        in production, not just in harnesses that pass `metrics=` at
+        construction."""
+        self.metrics = metrics
+        with self._lock:
+            states = {verb: CLOSED for verb in VERBS}
+            states.update(
+                {verb: vs.state for verb, vs in self._verbs.items()}
+            )
+        for verb, state in states.items():
+            metrics.set_gauge(
+                "api_circuit_state", STATE_GAUGE[state],
+                labels={"verb": verb},
+            )
+
+    def _notify(self, verb: str, old: str, new: str) -> None:
+        if self.metrics is not None:
+            self.metrics.set_gauge(
+                "api_circuit_state", STATE_GAUGE[new], labels={"verb": verb}
+            )
+            self.metrics.inc(
+                "api_circuit_transitions_total",
+                labels={"verb": verb, "to": new},
+            )
+        for fn in list(self._listeners):
+            try:
+                fn(verb, old, new)
+            except Exception:  # noqa: BLE001 — a listener must not poison the transport
+                log.exception("circuit listener failed (%s -> %s)", old, new)
+
+    def _slot(self, verb: str) -> _VerbState:
+        vs = self._verbs.get(verb)
+        if vs is None:
+            vs = self._verbs[verb] = _VerbState()
+        return vs
+
+    # --- gate + outcome recording (the transport's three touchpoints) ---
+
+    def check(self, verb: str) -> None:
+        """Gate a request: no-op when it may proceed (possibly as the
+        half-open probe), raises :class:`CircuitOpenError` when the
+        circuit is open and the cooldown has not elapsed."""
+        transition = None
+        with self._lock:
+            vs = self._slot(verb)
+            if vs.state == CLOSED:
+                return
+            now = self._clock()
+            if vs.state == OPEN:
+                elapsed = now - vs.opened_at
+                if elapsed < self.cooldown_seconds:
+                    raise CircuitOpenError(
+                        verb, self.cooldown_seconds - elapsed
+                    )
+                vs.state = HALF_OPEN
+                vs.probing = True
+                transition = (OPEN, HALF_OPEN)
+            elif vs.state == HALF_OPEN:
+                if vs.probing:
+                    # One probe at a time: concurrent callers are
+                    # refused until the in-flight probe reports back.
+                    raise CircuitOpenError(verb, self.cooldown_seconds)
+                vs.probing = True
+        if transition is not None:
+            self._notify(verb, *transition)
+
+    def record_success(self, verb: str) -> None:
+        transition = None
+        with self._lock:
+            vs = self._slot(verb)
+            vs.failures = 0
+            vs.probing = False
+            if vs.state != CLOSED:
+                transition = (vs.state, CLOSED)
+                vs.state = CLOSED
+        if transition is not None:
+            log.info("apiserver circuit for %r closed (probe succeeded)", verb)
+            self._notify(verb, *transition)
+
+    def record_failure(self, verb: str) -> None:
+        transition = None
+        with self._lock:
+            vs = self._slot(verb)
+            vs.failures += 1
+            vs.probing = False
+            if vs.state == HALF_OPEN or (
+                vs.state == CLOSED and vs.failures >= self.failure_threshold
+            ):
+                transition = (vs.state, OPEN)
+                vs.state = OPEN
+                vs.opened_at = self._clock()
+        if transition is not None:
+            log.warning(
+                "apiserver circuit for %r OPENED after %d consecutive "
+                "failure(s); cooling down %.1fs",
+                verb, self._slot(verb).failures, self.cooldown_seconds,
+            )
+            self._notify(verb, *transition)
+
+    def release_probe(self, verb: str) -> None:
+        """Abandon an in-flight half-open probe with NO outcome: the
+        caller failed before the apiserver answered anything (budget
+        expiry in the throttle wait, a stop event, a non-transport
+        exception from the session). Leaving ``probing`` set would wedge
+        the verb half-open forever — every later :meth:`check` would
+        refuse — so the slot is returned and the NEXT caller probes
+        instead."""
+        with self._lock:
+            self._slot(verb).probing = False
+
+    # --- introspection (degraded-mode consumers) ---
+
+    def state(self, verb: str) -> str:
+        with self._lock:
+            return self._slot(verb).state
+
+    def any_open(self) -> bool:
+        """True while ANY verb's circuit is not closed — the driver's
+        degraded-mode predicate (half-open counts: the control plane is
+        not known-good until the probe lands)."""
+        with self._lock:
+            return any(vs.state != CLOSED for vs in self._verbs.values())
+
+    def states(self) -> Dict[str, str]:
+        with self._lock:
+            return {verb: vs.state for verb, vs in self._verbs.items()}
+
+    def reset(self) -> None:
+        """Force every verb closed (tests and operator tooling)."""
+        transitions = []
+        with self._lock:
+            for verb, vs in self._verbs.items():
+                if vs.state != CLOSED:
+                    transitions.append((verb, vs.state, CLOSED))
+                    vs.state = CLOSED
+                vs.failures = 0
+                vs.probing = False
+        for t in transitions:
+            self._notify(*t)
+
+
+def circuit_of(backend) -> Optional[CircuitBreaker]:
+    """The backend's breaker, if the transport carries one (the
+    in-memory FakeCluster does not — unit tests run undegradable)."""
+    return getattr(backend, "circuit", None)
+
+
+def bind_backend_metrics(backend, metrics) -> Optional[CircuitBreaker]:
+    """Late-bind a driver's metrics registry onto a flag-built
+    transport and return its breaker (None for breaker-less backends).
+    The real binaries build the transport (KubeClient + breaker) from
+    flags BEFORE any driver's registry exists; every driver calls this
+    at init so api_requests_total / api_circuit_state export in
+    production, not just in harnesses that pass ``metrics=`` at
+    construction."""
+    circuit = circuit_of(backend)
+    if circuit is not None:
+        if circuit.metrics is None:
+            circuit.attach_metrics(metrics)
+        if getattr(backend, "metrics", None) is None:
+            backend.metrics = metrics
+    return circuit

@@ -1,0 +1,301 @@
+"""Shared degraded-mode state machine for drivers fronted by the
+circuit-broken transport.
+
+Both kubelet plugins (the TPU plugin's ``Driver`` and the ComputeDomain
+``CDDriver``) run the same control-plane-weather contract: while ANY
+verb's circuit is open the component is *degraded* (``api_degraded``
+gauge, background API traffic pauses, prepare/unprepare keep serving
+from gRPC+checkpoint state), a background prober keeps one cheap
+budgeted GET ticking so the breaker's half-open probe has traffic to
+ride even when no kubelet RPC arrives, and when the last verb closes a
+single *fenced* resync reconciles local state against the recovered
+apiserver before normal periodic operation resumes. This class owns
+that machine once; the drivers supply the three component-specific
+pieces as callbacks:
+
+- ``probe``: one cheap read (a GET of a well-known nonexistent object)
+  issued under a budget — ANY answer, including the expected 404,
+  proves the apiserver alive;
+- ``resync``: the fenced post-heal reconcile (claim GC, republish, …);
+- ``replay`` (optional): replays a publish parked via
+  :meth:`defer_publish` while the control plane was dark.
+
+Concurrency contract: ``_lock`` orders every ``_degraded`` /
+``_publish_pending_heal`` write AND the ``any_open()`` read that feeds
+it — two breaker listeners racing a trip on one verb against a close on
+another must not write the gauge in inverted order. The lock is never
+held across API calls or callbacks (the breaker fires listeners
+synchronously on the thread that recorded the tripping failure — which
+may already hold a driver-side publish lock around its apiserver
+calls). Lock order is always ``_lock`` -> breaker lock; the breaker
+fires listeners outside its own lock, so the reverse never occurs.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Optional
+
+from tpu_dra.infra.deadline import Budget
+from tpu_dra.k8sclient.circuit import CLOSED, CircuitBreaker
+from tpu_dra.k8sclient.resources import ApiNotFound
+
+log = logging.getLogger(__name__)
+
+
+class DegradedModeController:
+    # Heal probing cadence: one cheap GET per interval while degraded.
+    # The interval floors at the breaker cooldown so every probe is
+    # actually eligible to be the half-open probe, and the budget bounds
+    # a probe lost in a blackhole.
+    HEAL_PROBE_INTERVAL_FLOOR = 1.0
+    HEAL_PROBE_BUDGET = 5.0
+
+    def __init__(
+        self,
+        circuit: CircuitBreaker,
+        metrics,
+        stop: threading.Event,
+        probe: Callable[[], None],
+        resync: Callable[[], None],
+        replay: Optional[Callable[[], None]] = None,
+        name: str = "",
+    ):
+        self.circuit = circuit
+        self.metrics = metrics
+        self._stop = stop
+        self._probe_get = probe
+        self._resync = resync
+        self._replay = replay
+        # Thread-name / log prefix ("" for the TPU plugin, "cd-" for the
+        # ComputeDomain plugin).
+        self.name = name
+        self._lock = threading.Lock()
+        self._degraded = False
+        self._publish_pending_heal = False
+        self._heal_requested = False
+        self._heal_lock = threading.Lock()
+        self._heal_prober: Optional[threading.Thread] = None
+        metrics.set_gauge("api_degraded", 0)
+        circuit.add_listener(self._on_circuit)
+
+    # --- introspection ---
+
+    @property
+    def degraded(self) -> bool:
+        with self._lock:
+            return self._degraded
+
+    @property
+    def publish_pending_heal(self) -> bool:
+        with self._lock:
+            return self._publish_pending_heal
+
+    # --- the breaker listener ---
+
+    def _on_circuit(self, verb: str, old: str, new: str) -> None:
+        """Circuit-breaker transition listener. Entering degraded mode
+        just flips the gauge (the pauses are pull-based: cleanup and
+        publish check the circuit themselves); LEAVING it runs the
+        fenced heal resync before normal publication resumes."""
+        with self._lock:
+            # any_open is read under the SAME lock that orders the
+            # _degraded/gauge writes: concurrent trip and close
+            # listeners serialize here, so the LAST writer saw the
+            # freshest breaker state and the gauge can never end up
+            # inverted (healthy-looking while a verb is open).
+            degraded = self.circuit.any_open()
+            was = self._degraded
+            self._degraded = degraded
+            if degraded != was:
+                self.metrics.set_gauge("api_degraded", 1 if degraded else 0)
+        if degraded == was:
+            return
+        if degraded:
+            log.warning(
+                "%sentering DEGRADED mode: apiserver circuit %s for %r — "
+                "background API traffic pauses; prepare/unprepare keep "
+                "serving from gRPC+checkpoint state",
+                self.name, new, verb,
+            )
+            self._start_heal_prober()
+            return
+        log.warning(
+            "apiserver circuit closed (%r): %sleaving degraded mode via "
+            "fenced resync", verb, self.name,
+        )
+        # Off the listener thread: the resync issues API calls, and the
+        # listener fires inside the transport's success path.
+        t = threading.Thread(
+            target=self._resync_after_heal, daemon=True,
+            name=f"{self.name}heal-resync",
+        )
+        t.start()
+
+    # --- the fenced heal resync ---
+
+    def _resync_after_heal(self) -> None:
+        """Fenced post-outage reconciliation: ONE thread at a time runs
+        the driver's resync callback against the recovered apiserver,
+        then replays any publish the outage parked — before periodic
+        operation resumes on its own schedule. A re-opened circuit
+        mid-resync simply re-enters degraded mode; the next heal re-runs
+        the fence (idempotent).
+
+        Every caller records its request BEFORE trying the fence lock,
+        and the lock holder loops until no request is outstanding: a
+        heal that lands while a previous (slow) fence is mid-replay must
+        not be dropped — the earlier fence already drained the parked-
+        publish flag, so a publish parked after that drain would
+        otherwise be stranded until the next unrelated outage."""
+        with self._lock:
+            self._heal_requested = True
+        while True:
+            if not self._heal_lock.acquire(blocking=False):
+                # The holder only exits through a post-release re-check
+                # of _heal_requested — the request just recorded is
+                # guaranteed to be seen (by it, or by whoever acquires
+                # next).
+                return
+            ran = False
+            try:
+                with self._lock:
+                    if self._heal_requested:
+                        if self.circuit.any_open():
+                            # Re-degraded while the request was pending:
+                            # leave it recorded for the next heal instead
+                            # of burning a fence against an open circuit.
+                            return
+                        self._heal_requested = False
+                        ran = True
+                if ran:
+                    self._fence_once()
+            finally:
+                self._heal_lock.release()
+            if not ran:
+                # Exit ONLY via a re-check that runs after our release:
+                # a request recorded between the in-lock check and the
+                # release lost its trylock against us and relies on this
+                # pass to be seen (if it lands after this check instead,
+                # the lock is free and its own trylock succeeds).
+                with self._lock:
+                    if not self._heal_requested:
+                        return
+
+    def _fence_once(self) -> None:
+        self.metrics.inc("degraded_resyncs_total")
+        try:
+            self._resync()
+        except Exception as e:  # noqa: BLE001 — resync is best-effort
+            log.warning("%sheal resync reconcile failed: %s", self.name, e)
+        with self._lock:
+            pending = self._publish_pending_heal
+            self._publish_pending_heal = False
+        if pending and self._replay is not None:
+            try:
+                self._replay()
+            except Exception as e:  # noqa: BLE001
+                log.warning(
+                    "%sheal resync publish replay failed: %s",
+                    self.name, e,
+                )
+
+    # --- publish parking ---
+
+    def defer_publish(self) -> bool:
+        """True when the circuit is open and the publish was queued for
+        the heal resync instead (the driver's generation-supersede guard
+        still applies: the heal publishes the LATEST state once, not
+        every queued event)."""
+        if not self.circuit.any_open():
+            return False
+        with self._lock:
+            self._publish_pending_heal = True
+        if not self.circuit.any_open():
+            # The circuit closed between the gate and the park: the heal
+            # resync may already have drained the flag, and no future
+            # heal is coming to replay this publish — take it back and
+            # publish directly (a duplicate with the resync's replay is
+            # harmless; publishing is idempotent).
+            with self._lock:
+                self._publish_pending_heal = False
+            return False
+        self.metrics.inc("publish_deferred_degraded_total")
+        log.info(
+            "deferring ResourceSlice publish: apiserver circuit open "
+            "(will republish on heal)"
+        )
+        return True
+
+    # --- the heal prober ---
+
+    def _start_heal_prober(self) -> None:
+        """While degraded the pauses are load-bearing — GC skips its
+        passes, publish parks for the heal, remediation defers — which
+        means an outage that outlives the last kubelet RPC leaves NO
+        organic traffic to drive the breaker's half-open probe: the
+        circuit would stay open (and the driver degraded) forever after
+        the apiserver healed. One background prober issues a cheap
+        budgeted GET each interval; the heal resync then hangs off the
+        resulting close transition as usual."""
+        with self._lock:
+            # A live slot means a prober is running (an exiting prober
+            # clears the slot under this lock first); a dead one crashed
+            # and is replaced.
+            if self._heal_prober is not None and self._heal_prober.is_alive():
+                return
+            t = threading.Thread(
+                target=self._heal_probe_loop, daemon=True,
+                name=f"{self.name}heal-prober",
+            )
+            self._heal_prober = t
+        t.start()
+
+    def _heal_probe_loop(self) -> None:
+        interval = max(
+            self.circuit.cooldown_seconds, self.HEAL_PROBE_INTERVAL_FLOOR
+        )
+        while not self._stop.wait(interval):
+            with self._lock:
+                if not self.circuit.any_open():
+                    # Clearing the slot under the lock hands off cleanly:
+                    # a trip landing after this check starts a FRESH
+                    # prober instead of counting on one that is exiting.
+                    self._heal_prober = None
+                    return
+            if not self._probe_control_plane():
+                self.metrics.inc(
+                    "heal_probes_total", labels={"outcome": "dark"}
+                )
+                continue
+            self.metrics.inc("heal_probes_total", labels={"outcome": "alive"})
+            # The server answered: the control plane is reachable again.
+            # Verbs other than the probed GET close optimistically — the
+            # breaker only ever trips on transport-class failures, which
+            # are endpoint-agnostic, and a verb the server still cannot
+            # serve re-trips after failure_threshold real failures. The
+            # last close flips any_open and _on_circuit runs the fenced
+            # resync; the next loop pass sees the heal and exits.
+            for verb, state in self.circuit.states().items():
+                if state != CLOSED:
+                    self.circuit.record_success(verb)
+
+    def _probe_control_plane(self) -> bool:
+        """One budgeted liveness probe through the driver's callback.
+        ANY answer — including the expected 404 — proves the apiserver
+        alive (and already fed the breaker's half-open probe via the
+        transport); transport failures and a still-open pre-cooldown
+        circuit report dark."""
+        probe = Budget(
+            self.HEAL_PROBE_BUDGET, stop=self._stop,
+            name=f"{self.name}heal probe",
+        )
+        try:
+            with probe.active():
+                self._probe_get()
+        except ApiNotFound:
+            return True
+        except Exception:  # noqa: BLE001 — dark for any other reason
+            return False
+        return True

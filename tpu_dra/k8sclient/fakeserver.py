@@ -21,6 +21,7 @@ import json
 import os
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlsplit
@@ -115,9 +116,18 @@ class FakeApiServer:
         # expireContinue: next N continue-token list requests answer 410
         # (etcd-compaction-mid-pagination analog).
         self._expire_continue = 0
+        # Control-plane weather windows (chaos api_partition/api_latency):
+        #   partition — requests arriving before _partition_until hang
+        #     (blackhole; the client's read timeout usually fires first)
+        #     and answer 503 once the window ends;
+        #   latency — requests arriving before _latency_until are delayed
+        #     _latency seconds before normal processing.
+        self._partition_until = 0.0
+        self._latency = 0.0
+        self._latency_until = 0.0
         self._stats = {
             "lists": 0, "watches": 0, "throttled": 0, "bookmarks": 0,
-            "failed": 0, "watch_drops": 0,
+            "failed": 0, "watch_drops": 0, "partitioned": 0, "delayed": 0,
         }
         outer = self
 
@@ -244,9 +254,57 @@ class FakeApiServer:
                     })
                     raise _BadBody()
 
+            def _maybe_weather(self) -> bool:
+                """Partition/latency gate, ahead of the burst faults.
+
+                A partition BLACKHOLES the request: the handler holds
+                the connection (no bytes) until the window ends — a
+                budgeted client hits its read timeout mid-hold, which
+                is the behavior deadline budgets exist for — then
+                answers 503 so a still-waiting unbudgeted client sees
+                an error, not silence forever. Injected latency delays
+                the request, then lets it proceed normally."""
+                held = False
+                while True:
+                    with outer._fault_lock:
+                        rem = outer._partition_until - time.monotonic()
+                    if rem <= 0:
+                        break
+                    held = True
+                    time.sleep(min(rem, 0.05))  # lint: disable=D800 (injected fault: the blackhole hold IS the partition being simulated)
+                if held:
+                    with outer._fault_lock:
+                        outer._stats["partitioned"] += 1
+                    n = int(self.headers.get("Content-Length", 0) or 0)
+                    if n:
+                        self.rfile.read(n)
+                    self._reply(503, {
+                        "kind": "Status", "status": "Failure",
+                        "message": "injected network partition",
+                        "code": 503,
+                    })
+                    # The connection spent the partition dark; the
+                    # client side has likely timed out and gone away.
+                    self.close_connection = True
+                    return True
+                with outer._fault_lock:
+                    delay = (
+                        outer._latency
+                        if time.monotonic() < outer._latency_until
+                        else 0.0
+                    )
+                if delay > 0:
+                    with outer._fault_lock:
+                        outer._stats["delayed"] += 1
+                    time.sleep(delay)  # lint: disable=D800 (injected fault: the delay IS the latency being simulated)
+                return False
+
             def _maybe_throttle(self) -> bool:
-                """Injected-fault gate: 5xx bursts first (a brownout hits
-                before rate limiting would), then 429 bursts."""
+                """Injected-fault gate: partition/latency weather first,
+                then 5xx bursts (a brownout hits before rate limiting
+                would), then 429 bursts."""
+                if self._maybe_weather():
+                    return True
                 code = None
                 retry_after = None
                 with outer._fault_lock:
@@ -422,6 +480,9 @@ class FakeApiServer:
                         fail_status=body.get("failStatus"),
                         expire_continue=body.get("expireContinue"),
                         drop_watches=bool(body.get("dropWatches")),
+                        partition_seconds=body.get("partitionSeconds"),
+                        latency=body.get("latency"),
+                        latency_seconds=body.get("latencySeconds"),
                     )
                     return self._reply(200, {"status": "Success"})
                 if self._maybe_throttle():
@@ -552,12 +613,18 @@ class FakeApiServer:
         fail_status: Optional[int] = None,
         expire_continue: Optional[int] = None,
         drop_watches: bool = False,
+        partition_seconds: Optional[float] = None,
+        latency: Optional[float] = None,
+        latency_seconds: Optional[float] = None,
     ) -> None:
         """Programmatic fault hook (the chaos harness's seam; the
         POST /_fault endpoint routes here too): arm 429 bursts
         (``throttle``/``retry_after``), 5xx bursts (``fail`` requests
-        answering ``fail_status``), continue-token expiry, and server-side
-        watch-stream drops."""
+        answering ``fail_status``), continue-token expiry, server-side
+        watch-stream drops, a ``partition_seconds`` blackhole window
+        (requests hang, then 503; open watch streams are dropped — a
+        real partition stalls them the same way), and per-request
+        injected ``latency`` for the next ``latency_seconds``."""
         with self._fault_lock:
             if throttle is not None:
                 self._throttle_remaining = int(throttle)
@@ -569,6 +636,16 @@ class FakeApiServer:
                 self._fail_status = int(fail_status)
             if expire_continue is not None:
                 self._expire_continue = int(expire_continue)
+            if partition_seconds is not None:
+                self._partition_until = (
+                    time.monotonic() + float(partition_seconds)
+                )
+                drop_watches = drop_watches or partition_seconds > 0
+            if latency is not None:
+                self._latency = float(latency)
+                self._latency_until = time.monotonic() + float(
+                    latency_seconds if latency_seconds is not None else 3600.0
+                )
         if drop_watches:
             with self._watch_lock:
                 dropped = list(self._watches)

@@ -20,14 +20,21 @@ from __future__ import annotations
 
 import copy
 import logging
+import random
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
+from tpu_dra.infra.deadline import Budget
 from tpu_dra.k8sclient.resources import ApiGone, Backend, ResourceDescriptor
 
 log = logging.getLogger(__name__)
 
 Handler = Callable[[str, dict], None]  # (event_type, obj)
+
+# Set (thread-locally) around an informer's own backend reads so an
+# installed read fallback declines to answer them from a cache — an
+# informer resyncing from an informer store is a fake resync.
+_FALLBACK_BYPASS = threading.local()
 
 
 class Informer:
@@ -55,7 +62,17 @@ class Informer:
         self._synced = threading.Event()
         self._stopped = threading.Event()
         self._last_rv: Optional[str] = None
-        self.resync_backoff = 1.0  # seconds between reconnect attempts
+        # Reconnect backoff: starts at resync_backoff, doubles per
+        # consecutive failure up to resync_backoff_max, with +/-50%
+        # jitter, and resets on a successful sync. A fixed short delay
+        # here is a thundering herd: every informer in every component
+        # on every node re-listing a *recovering* apiserver on the same
+        # 1s beat is how a brownout becomes an outage (client-go's
+        # reflector backs off exponentially for the same reason).
+        self.resync_backoff = 1.0   # base seconds between reconnects
+        self.resync_backoff_max = 30.0
+        self._resync_failures = 0
+        self._rng = random.Random()
 
     def add_handler(self, handler: Handler) -> None:
         self._handlers.append(handler)
@@ -65,6 +82,29 @@ class Informer:
             self.metrics.inc(
                 name, labels={"informer": self.rd.plural}
             )
+
+    # --- reconnect backoff (informer-thread confined) ---
+
+    def _next_resync_delay(self) -> float:
+        """Jittered ``base * 2^failures`` (capped), counting this call
+        as one more consecutive failure. Informer-thread confined, like
+        _last_rv. The base is re-read each call so tuning
+        ``resync_backoff`` after construction behaves."""
+        # Cap the exponent itself, not just the product: a multi-hour
+        # outage pushes the failure count high enough that 2**n
+        # overflows float conversion before min() can clamp it.
+        delay = min(
+            self.resync_backoff * (2 ** min(self._resync_failures, 32)),
+            self.resync_backoff_max,
+        )
+        self._resync_failures += 1  # lint: disable=R200 (informer thread)
+        # Clamp AFTER jittering: resync_backoff_max is the documented
+        # worst case for noticing a recovered apiserver, so the jitter
+        # may only spread delays below it, never push past it.
+        return min(delay * self._rng.uniform(0.5, 1.5), self.resync_backoff_max)
+
+    def _reset_resync_delay(self) -> None:
+        self._resync_failures = 0  # lint: disable=R200 (informer thread)
 
     def start(self) -> None:
         """Start the list+watch loop. The initial sync happens on the
@@ -107,6 +147,7 @@ class Informer:
                 if not self._assign_watch(watch):
                     return False
                 self._relist()
+                self._reset_resync_delay()
                 self._synced.set()
                 return True
             except Exception as e:  # noqa: BLE001 — any transport failure
@@ -126,11 +167,24 @@ class Informer:
                         except Exception:  # noqa: BLE001
                             pass
                         self._watch = None
-                self._stopped.wait(self.resync_backoff)
+                self._stopped.wait(self._next_resync_delay())
         return False
 
-    def wait_for_sync(self, timeout: float = 5.0) -> bool:
-        return self._synced.wait(timeout)
+    def wait_for_sync(
+        self, timeout: float = 5.0, budget: Optional[Budget] = None
+    ) -> bool:
+        """Block until the initial list+watch sync lands. With a
+        ``budget``, waits out the budget's remaining time (polling the
+        stop event) instead of a flat timeout — callers threading an
+        RPC/startup budget pass it here rather than guessing a number.
+        """
+        if budget is None:
+            return self._synced.wait(timeout)
+        while not self._synced.is_set():
+            if budget.expired() or budget.cancelled():
+                return self._synced.is_set()
+            budget.pause(0.05)
+        return True
 
     def stop(self) -> None:
         self._stopped.set()
@@ -198,7 +252,7 @@ class Informer:
             # again (a failed relist would leave stale deletions in the
             # store), so retry the whole resync.
             while not self._stopped.is_set():
-                self._stopped.wait(self.resync_backoff)
+                self._stopped.wait(self._next_resync_delay())
                 if self._stopped.is_set():
                     return
                 try:
@@ -210,6 +264,7 @@ class Informer:
                             )
                             if not self._assign_watch(w):
                                 return
+                            self._reset_resync_delay()
                             log.debug(
                                 "watch resumed from resourceVersion %s",
                                 self._last_rv,
@@ -226,6 +281,7 @@ class Informer:
                     if not self._assign_watch(w):
                         return
                     self._relist()
+                    self._reset_resync_delay()
                     self._inc("informer_relists_total")
                     break
                 except Exception as e:
@@ -235,8 +291,23 @@ class Informer:
     def _relist(self) -> None:
         """Full (re-)list: upsert everything current — ADDED for keys the
         store has never seen, MODIFIED for known ones — and emit DELETED
-        for objects that vanished while the watch was down."""
-        fresh = self.backend.list(self.rd, self.namespace, self.label_selector)
+        for objects that vanished while the watch was down.
+
+        The list must come from the REAL apiserver: with a read
+        fallback installed on this backend, an open list circuit would
+        otherwise route this very call to an informer cache — typically
+        this informer's own store, whose scope guards pass by
+        construction — silently converting a failed resync into a fake
+        success that emits no DELETEDs, resets the reconnect backoff,
+        and reports the store freshly synced. The thread-local bypass
+        makes the fallback decline informer-originated reads."""
+        _FALLBACK_BYPASS.active = True
+        try:
+            fresh = self.backend.list(
+                self.rd, self.namespace, self.label_selector
+            )
+        finally:
+            _FALLBACK_BYPASS.active = False
         fresh_keys = set()
         for obj in fresh:
             md = obj.get("metadata", {})
@@ -317,3 +388,72 @@ class Informer:
     def list(self) -> List[dict]:
         with self._lock:
             return [copy.deepcopy(o) for o in self._store.values()]
+
+    # --- degraded-read hook (rest.KubeClient.read_fallback) ---
+
+    def serve_read(
+        self,
+        namespace: Optional[str],
+        name: Optional[str],
+        label_selector: Optional[Dict[str, str]],
+    ) -> Optional[object]:
+        """Answer a get (``name`` set) or list (``name`` None) for this
+        informer's resource from the synced store — the stale-read path
+        the transport falls back to while its circuit is open. Returns
+        None (fall through to :class:`CircuitOpenError`) when the store
+        cannot faithfully answer: initial sync never landed, the query
+        is outside this informer's namespace scope, or it was built
+        with a label selector narrower than the query's."""
+        if not self._synced.is_set():
+            return None
+        if self.namespace is not None and namespace != self.namespace:
+            return None
+        if self.label_selector is not None and (
+            label_selector != self.label_selector
+        ):
+            return None
+        if name is not None:
+            if label_selector is not None:
+                return None
+            return self.get(name, namespace)
+        items = self.list()
+        if namespace is not None:
+            items = [
+                o for o in items
+                if o.get("metadata", {}).get("namespace") == namespace
+            ]
+        if label_selector is not None and self.label_selector is None:
+            items = [
+                o for o in items
+                if all(
+                    o.get("metadata", {}).get("labels", {}).get(k) == v
+                    for k, v in label_selector.items()
+                )
+            ]
+        return items
+
+
+def install_read_fallback(backend, informers: List[Informer]) -> None:
+    """Register synced informers as ``backend.read_fallback``: while the
+    transport's circuit is open, get/list for a covered resource serves
+    stale from the matching informer's store instead of failing. A
+    no-op for backends without the hook (the in-memory FakeCluster —
+    unit tests exercise the real path through rest.KubeClient). A get
+    answered None by the store falls through to the circuit error: a
+    stale miss must surface as unavailability, not ApiNotFound."""
+    if not hasattr(backend, "read_fallback"):
+        return
+    by_rd = {inf.rd.plural: inf for inf in informers}
+
+    def fallback(rd, namespace, name, label_selector):
+        if getattr(_FALLBACK_BYPASS, "active", False):
+            # An informer's own resync list: it must observe the real
+            # apiserver (or fail and keep backing off), never be served
+            # a cache — least of all its own store.
+            return None
+        inf = by_rd.get(rd.plural)
+        if inf is None:
+            return None
+        return inf.serve_read(namespace, name, label_selector)
+
+    backend.read_fallback = fallback

@@ -14,12 +14,15 @@ import json
 import logging
 import os
 import time
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 import requests
 import yaml
 
+from tpu_dra.infra import deadline
+from tpu_dra.infra.deadline import BudgetExceeded
 from tpu_dra.infra.workqueue import BucketRateLimiter
+from tpu_dra.k8sclient.circuit import CircuitBreaker, CircuitOpenError
 from tpu_dra.k8sclient.resources import (
     ApiConflict,
     ApiGone,
@@ -34,7 +37,11 @@ SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
 
 class _Throttle:
-    """Client-side QPS throttle over the shared token-bucket limiter."""
+    """Client-side QPS throttle over the shared token-bucket limiter.
+
+    The wait consumes the caller's deadline budget: a kubelet RPC whose
+    budget cannot cover the throttle delay fails retriable NOW instead
+    of sleeping through its deadline first."""
 
     def __init__(self, qps: float, burst: int):
         self._bucket = BucketRateLimiter(qps, burst)
@@ -42,7 +49,7 @@ class _Throttle:
     def wait(self) -> None:
         delay = self._bucket.when(None)
         if delay > 0:
-            time.sleep(delay)
+            deadline.current().sleep(delay, "waiting for the client QPS throttle")
 
 
 class _RestWatch:
@@ -85,6 +92,22 @@ class _RestWatch:
 
 
 class KubeClient(Backend):
+    # Per-verb request timeouts (seconds). Overridable per instance via
+    # the ``request_timeouts`` constructor arg — a LIST of 10k claims
+    # legitimately needs more wire time than a point GET, and operators
+    # tuning for a slow concierge should not have to tune every verb at
+    # once. "watch" is the CONNECT timeout only (the stream itself is
+    # unbounded by design).
+    DEFAULT_REQUEST_TIMEOUTS: Dict[str, float] = {
+        "get": 30.0,
+        "list": 30.0,
+        "create": 30.0,
+        "update": 30.0,
+        "patch": 30.0,
+        "delete": 30.0,
+        "watch": 30.0,
+    }
+
     def __init__(
         self,
         server: str,
@@ -93,6 +116,9 @@ class KubeClient(Backend):
         client_cert: Optional[Tuple[str, str]] = None,
         qps: float = 5.0,
         burst: int = 10,
+        metrics=None,
+        circuit: Optional[CircuitBreaker] = None,
+        request_timeouts: Optional[Dict[str, float]] = None,
     ):
         self.server = server.rstrip("/")
         self._session = requests.Session()
@@ -102,6 +128,22 @@ class KubeClient(Backend):
             self._session.cert = client_cert
         self._session.verify = ca_path if ca_path is not None else True
         self._throttle = _Throttle(qps, burst)
+        self.metrics = metrics
+        # The breaker fronts every request (see circuit.py). Components
+        # observe it for degraded mode via ``backend.circuit``.
+        self.circuit = circuit or CircuitBreaker(metrics=metrics)
+        self._timeouts = dict(self.DEFAULT_REQUEST_TIMEOUTS)
+        if request_timeouts:
+            self._timeouts.update(request_timeouts)
+        # Degraded-mode read path: when the circuit is OPEN, get/list
+        # may serve from an informer cache instead of failing. Callers
+        # that hold a synced informer install
+        # ``(rd, namespace, name_or_None, label_selector) -> result or
+        # None``; None falls through to CircuitOpenError.
+        self.read_fallback: Optional[Callable] = None
+
+    def _timeout(self, verb: str) -> float:
+        return self._timeouts.get(verb, 30.0)
 
     # --- config loading ---
 
@@ -233,18 +275,89 @@ class KubeClient(Backend):
             )
         return False
 
-    def _do(self, send, idempotent: bool = False) -> requests.Response:
-        """Issue a request through the client throttle, retrying 429s with
-        the server's Retry-After (a real apiserver under load sheds this
-        way), transient 5xx, and connection-level failures with exponential
-        backoff. Failing any of these through to the caller would turn
-        routine apiserver weather into component crashes."""
+    # Absolute ceiling on time spent INSIDE one _do call's retry loop
+    # even when the caller runs with an unbounded budget: a background
+    # thread with no deadline must still not wedge on one request
+    # forever (the per-attempt caps above bound attempts, this bounds
+    # their sum including Retry-After-directed waits).
+    MAX_TOTAL_RETRY_SECONDS = 120.0
+
+    def _observe(self, verb: str, code: str, t0: float) -> None:
+        if self.metrics is None:
+            return
+        self.metrics.inc(
+            "api_requests_total", labels={"verb": verb, "code": code}
+        )
+        self.metrics.observe(
+            "api_request_duration_seconds", time.monotonic() - t0
+        )
+
+    # A wire attempt the budget cannot even cover this much of is not
+    # worth starting: fail typed-retriable NOW and hand the remainder
+    # back to the caller (ultimately the kubelet's own retry loop).
+    MIN_ATTEMPT_SECONDS = 0.05
+
+    def _do(self, send, verb: str, idempotent: bool = False) -> requests.Response:
+        """Issue a request through the circuit breaker and client
+        throttle, retrying 429s with the server's Retry-After (a real
+        apiserver under load sheds this way), transient 5xx, and
+        connection-level failures with exponential backoff. Failing any
+        of these through to the caller would turn routine apiserver
+        weather into component crashes.
+
+        ``send`` takes the per-attempt wire timeout; `_do` clamps it to
+        the calling budget's remaining time, so a slow-but-answering
+        apiserver (the regime with no retry sleeps at all) still cannot
+        carry an attempt past the caller's deadline. Every wait is
+        stop-aware and budget-capped
+        (:func:`tpu_dra.infra.deadline.current`): retries consume the
+        calling RPC's budget, and expiry surfaces as a typed retriable
+        error instead of a stall. Total retry time is bounded even for
+        unbudgeted callers (MAX_TOTAL_RETRY_SECONDS)."""
+        budget = deadline.current()
+        t0 = time.monotonic()
+        retry_ceiling = t0 + self.MAX_TOTAL_RETRY_SECONDS
         throttled = errored = served_5xx = 0
+
+        def backoff(delay: float, last_exc: Optional[Exception]) -> None:
+            if time.monotonic() + delay > retry_ceiling:
+                if last_exc is not None:
+                    raise last_exc
+                raise K8sApiError(
+                    f"retry budget for {verb} exhausted after "
+                    f"{time.monotonic() - t0:.1f}s", status=504,
+                )
+            budget.sleep(delay, f"retrying apiserver {verb}")
+
         while True:
-            self._throttle.wait()
+            # Budget accounting BEFORE the breaker is consulted: raising
+            # here can never strand a granted half-open probe slot.
+            budget.check(f"calling apiserver {verb}")
+            wire_timeout = self._timeout(verb)
+            rem = budget.remaining()
+            if rem is not None:
+                if rem < self.MIN_ATTEMPT_SECONDS:
+                    raise BudgetExceeded(
+                        f"deadline budget cannot cover an apiserver "
+                        f"{verb} attempt ({rem:.2f}s left)"
+                    )
+                wire_timeout = min(wire_timeout, rem)
             try:
-                resp = send()
+                self.circuit.check(verb)
+            except CircuitOpenError:
+                # Attempt-scoped duration, like every other outcome: a
+                # local refusal takes microseconds; sampling from t0
+                # would charge all prior retries of this _do call to a
+                # request that never left the process.
+                self._observe(verb, "circuit_open", time.monotonic())
+                raise
+            attempt_t0 = time.monotonic()
+            try:
+                self._throttle.wait()
+                resp = send(wire_timeout)
             except (requests.ConnectionError, requests.Timeout) as e:
+                self.circuit.record_failure(verb)
+                self._observe(verb, "conn_error", attempt_t0)
                 if errored >= self.MAX_CONN_RETRIES:
                     raise
                 if not idempotent and not self._pre_send_failure(e):
@@ -257,8 +370,24 @@ class KubeClient(Backend):
                     type(e).__name__, e, delay, errored,
                     self.MAX_CONN_RETRIES,
                 )
-                time.sleep(delay)
+                backoff(delay, e)
                 continue
+            except BaseException:
+                # No outcome ever reached the breaker — budget expiry in
+                # the throttle wait, a stop event, a non-transport error
+                # from the session. Return a granted half-open probe
+                # slot, or the verb wedges with probing=True forever and
+                # the circuit can never close again.
+                self.circuit.release_probe(verb)
+                raise
+            self._observe(verb, str(resp.status_code), attempt_t0)
+            if resp.status_code in self.RETRYABLE_5XX:
+                self.circuit.record_failure(verb)
+            else:
+                # Any answered request — 2xx, semantic 4xx, even a 429
+                # shed — proves the control plane alive: close/feed the
+                # breaker on it.
+                self.circuit.record_success(verb)
             if resp.status_code == 429 and throttled < self.MAX_429_RETRIES:
                 throttled += 1
                 delay = self._retry_after(resp)
@@ -266,7 +395,7 @@ class KubeClient(Backend):
                     "server throttled (429), retrying in %.1fs (attempt %d)",
                     delay, throttled,
                 )
-                time.sleep(delay)
+                backoff(delay, None)
                 continue
             if (
                 resp.status_code in self.RETRYABLE_5XX
@@ -284,7 +413,7 @@ class KubeClient(Backend):
                     "(attempt %d)",
                     resp.status_code, delay, served_5xx,
                 )
-                time.sleep(delay)
+                backoff(delay, None)
                 continue
             return resp
 
@@ -324,9 +453,23 @@ class KubeClient(Backend):
         return params
 
     def get(self, rd, namespace, name) -> dict:
-        return self._check(self._do(lambda: self._session.get(
-            self.server + rd.path(namespace, name), timeout=30
-        ), idempotent=True))
+        try:
+            return self._check(self._do(lambda t: self._session.get(
+                self.server + rd.path(namespace, name), timeout=t,
+            ), verb="get", idempotent=True))
+        except CircuitOpenError:
+            if self.read_fallback is not None:
+                cached = self.read_fallback(rd, namespace, name, None)
+                if cached is not None:
+                    self._observe_fallback("get")
+                    return cached
+            raise
+
+    def _observe_fallback(self, verb: str) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(
+                "api_reads_served_from_cache_total", labels={"verb": verb}
+            )
 
     # Chunked-list page size (client-go reflector default). Every page is
     # one GET with limit=<page>&continue=<token>; a real apiserver caps
@@ -335,6 +478,25 @@ class KubeClient(Backend):
     LIST_PAGE_SIZE = 500
 
     def list(self, rd, namespace=None, label_selector=None, field_selector=None):
+        try:
+            return self._list_paginated(
+                rd, namespace, label_selector, field_selector
+            )
+        except CircuitOpenError:
+            # field_selector filtering is not implemented by informer
+            # caches; only plain/label-selected lists may serve stale.
+            if self.read_fallback is not None and field_selector is None:
+                cached = self.read_fallback(
+                    rd, namespace, None, label_selector
+                )
+                if cached is not None:
+                    self._observe_fallback("list")
+                    return cached
+            raise
+
+    def _list_paginated(
+        self, rd, namespace=None, label_selector=None, field_selector=None
+    ):
         base = self._selector_params(label_selector, field_selector)
         for attempt in (1, 2):
             items: List[dict] = []
@@ -345,11 +507,10 @@ class KubeClient(Backend):
                     params["limit"] = str(self.LIST_PAGE_SIZE)
                     if cont:
                         params["continue"] = cont
-                    out = self._check(self._do(lambda: self._session.get(
+                    out = self._check(self._do(lambda t: self._session.get(
                         self.server + rd.path(namespace),
-                        params=params,
-                        timeout=30,
-                    ), idempotent=True))
+                        params=params, timeout=t,
+                    ), verb="list", idempotent=True))
                     items.extend(out.get("items", []))
                     cont = out.get("metadata", {}).get("continue")
                     if not cont:
@@ -368,38 +529,36 @@ class KubeClient(Backend):
 
     def create(self, rd, obj) -> dict:
         ns = obj.get("metadata", {}).get("namespace")
-        return self._check(self._do(lambda: self._session.post(
-            self.server + rd.path(ns), json=obj, timeout=30
-        )))
+        return self._check(self._do(lambda t: self._session.post(
+            self.server + rd.path(ns), json=obj, timeout=t,
+        ), verb="create"))
 
     def update(self, rd, obj) -> dict:
         md = obj["metadata"]
-        return self._check(self._do(lambda: self._session.put(
+        return self._check(self._do(lambda t: self._session.put(
             self.server + rd.path(md.get("namespace"), md["name"]),
-            json=obj,
-            timeout=30,
-        )))
+            json=obj, timeout=t,
+        ), verb="update"))
 
     def update_status(self, rd, obj) -> dict:
         md = obj["metadata"]
-        return self._check(self._do(lambda: self._session.put(
+        return self._check(self._do(lambda t: self._session.put(
             self.server + rd.path(md.get("namespace"), md["name"]) + "/status",
-            json=obj,
-            timeout=30,
-        )))
+            json=obj, timeout=t,
+        ), verb="update"))
 
     def patch(self, rd, namespace, name, patch) -> dict:
-        return self._check(self._do(lambda: self._session.patch(
+        return self._check(self._do(lambda t: self._session.patch(
             self.server + rd.path(namespace, name),
             json=patch,
             headers={"Content-Type": "application/merge-patch+json"},
-            timeout=30,
-        )))
+            timeout=t,
+        ), verb="patch"))
 
     def delete(self, rd, namespace, name) -> None:
-        self._check(self._do(lambda: self._session.delete(
-            self.server + rd.path(namespace, name), timeout=30
-        )))
+        self._check(self._do(lambda t: self._session.delete(
+            self.server + rd.path(namespace, name), timeout=t,
+        ), verb="delete"))
 
     def watch(
         self, rd, namespace=None, label_selector=None, resource_version=None
@@ -412,12 +571,14 @@ class KubeClient(Backend):
         params["allowWatchBookmarks"] = "true"
         if resource_version is not None:
             params["resourceVersion"] = str(resource_version)
-        resp = self._do(lambda: self._session.get(
+        # The clamped timeout bounds only the CONNECT phase; the stream
+        # itself is unbounded by design (a watch outlives any budget).
+        resp = self._do(lambda t: self._session.get(
             self.server + rd.path(namespace),
             params=params,
             stream=True,
-            timeout=(30, None),
-        ), idempotent=True)
+            timeout=(t, None),
+        ), verb="watch", idempotent=True)
         if resp.status_code >= 400:
             self._check(resp)
         return _RestWatch(resp)

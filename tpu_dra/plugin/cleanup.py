@@ -30,6 +30,8 @@ class CheckpointCleanupManager:
         backend,
         interval: float = DEFAULT_INTERVAL,
         pu_flock=None,
+        metrics=None,
+        circuit=None,
     ):
         self.state = state
         self.claims = ResourceClient(backend, RESOURCE_CLAIMS)
@@ -38,6 +40,14 @@ class CheckpointCleanupManager:
         # concurrent Prepare/Unprepare across plugin *processes* too
         # (upgrade window), exactly like the RPC paths.
         self.pu_flock = pu_flock
+        self.metrics = metrics
+        # Degraded mode: with the apiserver circuit open every staleness
+        # probe is a guaranteed failure — the pass pauses (skips the
+        # tick) instead of burning its per-claim error isolation on the
+        # whole checkpoint each interval. GC work is deferrable by
+        # definition; the driver's heal resync runs a pass immediately
+        # after the circuit closes.
+        self.circuit = circuit
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -54,6 +64,14 @@ class CheckpointCleanupManager:
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval):
+            if self.circuit is not None and self.circuit.any_open():
+                if self.metrics is not None:
+                    self.metrics.inc("cleanup_passes_skipped_degraded_total")
+                log.info(
+                    "skipping checkpoint GC pass: apiserver circuit open "
+                    "(degraded mode)"
+                )
+                continue
             try:
                 self.cleanup_once()
             except Exception:

@@ -22,7 +22,10 @@ from typing import List, Optional
 
 import grpc
 
+from tpu_dra.infra.deadline import Budget, BudgetExceeded
 from tpu_dra.k8sclient import RESOURCE_CLAIMS, ApiNotFound, ResourceClient
+from tpu_dra.k8sclient.circuit import CircuitOpenError
+from tpu_dra.plugin.checkpoint import CLAIM_STATE_PREPARE_COMPLETED
 from tpu_dra.plugin.device_state import DeviceState, PermanentError, claim_to_string
 from tpu_dra.plugin.pb import dra_v1beta1_pb2 as drapb
 from tpu_dra.plugin.pb import pluginregistration_pb2 as regpb
@@ -32,11 +35,25 @@ log = logging.getLogger(__name__)
 DRA_SERVICE_NAME = "v1beta1.DRAPlugin"
 REGISTRATION_SERVICE_NAME = "pluginregistration.Registration"
 
+# Per-RPC deadline budget. The kubelet's DRA client calls with a 2min
+# context; finishing (even retriable-failing) well inside that keeps the
+# retry loop in the kubelet, where it belongs, instead of stacking
+# blocked RPC handler threads here while the control plane misbehaves.
+DEFAULT_RPC_BUDGET_SECONDS = 55.0
+
 
 class DRAService:
     """NodePrepareResources/NodeUnprepareResources over the node's
     DeviceState, with the node-global prepare/unprepare flock taken around
-    each claim (driver.go:334-400)."""
+    each claim (driver.go:334-400).
+
+    Every RPC runs under a :class:`~tpu_dra.infra.deadline.Budget`
+    (deadline + the driver's stop event) activated for the handler
+    thread: apiserver retries, flock polls, and readiness waits nested
+    anywhere below consume the budget, and expiry surfaces as a typed
+    retriable per-claim error instead of a hung kubelet RPC. The PR-4
+    WAL makes the kubelet's retry idempotent.
+    """
 
     def __init__(
         self,
@@ -44,11 +61,18 @@ class DRAService:
         backend,
         pu_flock,
         metrics=None,
+        rpc_budget_seconds: float = DEFAULT_RPC_BUDGET_SECONDS,
+        stop: Optional[threading.Event] = None,
     ):
         self.state = state
         self.claims = ResourceClient(backend, RESOURCE_CLAIMS)
         self.pu_flock = pu_flock
         self.metrics = metrics
+        self.rpc_budget_seconds = rpc_budget_seconds
+        self.stop = stop if stop is not None else threading.Event()
+
+    def _budget(self, name: str) -> Budget:
+        return Budget(self.rpc_budget_seconds, stop=self.stop, name=name)
 
     # --- RPC handlers ---
 
@@ -56,10 +80,18 @@ class DRAService:
         self, request: drapb.NodePrepareResourcesRequest, context
     ) -> drapb.NodePrepareResourcesResponse:
         resp = drapb.NodePrepareResourcesResponse()
+        budget = self._budget("NodePrepareResources")
         for claim_ref in request.claims:
             result = resp.claims[claim_ref.uid]
             try:
-                devices = self._prepare_one(claim_ref)
+                with budget.active():
+                    # Per-claim gate: a multi-claim request whose earlier
+                    # claims consumed the budget (slow-but-answering
+                    # apiserver — no retry sleep ever fires) must fail
+                    # the REMAINING claims retriable here, not start
+                    # work it cannot finish.
+                    budget.check(f"starting claim {claim_ref.uid}")
+                    devices = self._prepare_one(claim_ref, budget)
                 for d in devices:
                     result.devices.append(
                         drapb.Device(
@@ -76,6 +108,17 @@ class DRAService:
                 log.error(
                     "prepare failed permanently for claim %s: %s", claim_ref.uid, e
                 )
+            except BudgetExceeded as e:
+                # Retriable by construction: nothing after the WAL's
+                # PrepareStarted record survives un-rolled-back, so the
+                # kubelet's next attempt converges.
+                result.error = f"deadline: {e}"
+                if self.metrics is not None:
+                    self.metrics.inc("prepare_budget_exceeded_total")
+                log.warning(
+                    "prepare for claim %s ran out of budget (kubelet will "
+                    "retry): %s", claim_ref.uid, e,
+                )
             except Exception as e:
                 result.error = str(e)
                 log.warning("prepare failed for claim %s: %s", claim_ref.uid, e)
@@ -85,16 +128,27 @@ class DRAService:
         self, request: drapb.NodeUnprepareResourcesRequest, context
     ) -> drapb.NodeUnprepareResourcesResponse:
         resp = drapb.NodeUnprepareResourcesResponse()
+        budget = self._budget("NodeUnprepareResources")
         for claim_ref in request.claims:
             result = resp.claims[claim_ref.uid]
             try:
-                release = self.pu_flock.acquire(timeout=60)
-                try:
-                    self.state.unprepare(claim_ref.uid)
-                finally:
-                    release()
+                with budget.active():
+                    budget.check(f"starting claim {claim_ref.uid}")
+                    release = self.pu_flock.acquire(timeout=60, budget=budget)
+                    try:
+                        self.state.unprepare(claim_ref.uid)
+                    finally:
+                        release()
                 if self.metrics is not None:
                     self.metrics.inc("unprepare_total")
+            except BudgetExceeded as e:
+                result.error = f"deadline: {e}"
+                if self.metrics is not None:
+                    self.metrics.inc("unprepare_budget_exceeded_total")
+                log.warning(
+                    "unprepare for claim %s ran out of budget (kubelet "
+                    "will retry): %s", claim_ref.uid, e,
+                )
             except Exception as e:
                 result.error = str(e)
                 log.warning("unprepare failed for claim %s: %s", claim_ref.uid, e)
@@ -102,19 +156,47 @@ class DRAService:
                     self.metrics.inc("unprepare_failures_total")
         return resp
 
-    def _prepare_one(self, claim_ref: drapb.Claim):
+    def _completed_devices(self, claim_uid: str):
+        """KubeletDevices from a PrepareCompleted checkpoint record, or
+        None. The WAL is the degraded-mode source of truth: a kubelet
+        re-Prepare of an already-prepared claim must keep succeeding
+        while the apiserver is dark."""
+        claim = self.state.checkpoints.get().prepared_claims.get(claim_uid)
+        if (
+            claim is None
+            or claim.checkpoint_state != CLAIM_STATE_PREPARE_COMPLETED
+        ):
+            return None
+        return claim.prepared_devices.get_devices()
+
+    def _prepare_one(self, claim_ref: drapb.Claim, budget: Budget):
         import time
 
         t0 = time.monotonic()
         # Fetch the full claim from the API server (the kubelet only hands
-        # over references).
-        claim = self.claims.get(claim_ref.name, claim_ref.namespace)
+        # over references). With the circuit open or no budget left for
+        # API retries, an ALREADY-COMPLETED claim still serves from the
+        # checkpoint — degraded mode must not wedge a restarting pod
+        # whose node state is fully materialized.
+        try:
+            claim = self.claims.get(claim_ref.name, claim_ref.namespace)
+        except (CircuitOpenError, BudgetExceeded):
+            devices = self._completed_devices(claim_ref.uid)
+            if devices is not None:
+                if self.metrics is not None:
+                    self.metrics.inc("prepare_served_degraded_total")
+                log.warning(
+                    "serving prepare for claim %s from checkpoint "
+                    "(apiserver unavailable)", claim_ref.uid,
+                )
+                return devices
+            raise
         if claim["metadata"]["uid"] != claim_ref.uid:
             raise ApiNotFound(
                 f"claim {claim_ref.namespace}/{claim_ref.name} UID mismatch: "
                 f"have {claim['metadata']['uid']}, want {claim_ref.uid}"
             )
-        release = self.pu_flock.acquire(timeout=60)
+        release = self.pu_flock.acquire(timeout=60, budget=budget)
         log.debug("t_prep_lock_acq %.3f s", time.monotonic() - t0)
         try:
             devices = self.state.prepare(claim)

@@ -18,6 +18,8 @@ from tpu_dra.infra import featuregates as fg
 from tpu_dra.infra.flock import Flock
 from tpu_dra.infra.metrics import Metrics
 from tpu_dra.k8sclient import RESOURCE_SLICES, ResourceClient
+from tpu_dra.k8sclient.circuit import bind_backend_metrics
+from tpu_dra.k8sclient.degraded import DegradedModeController
 from tpu_dra.plugin.allocatable import (
     AllocatableDevice,
     SUBSLICE_DYNAMIC_DEVICE_TYPE,
@@ -134,13 +136,25 @@ class Driver:
             lambda: self._collect_multiplex_metrics(multiplex)
         )
         self.slices = ResourceClient(backend, RESOURCE_SLICES)
+        # Component-wide stop event: budgets minted per kubelet RPC carry
+        # it, so shutdown cancels in-flight waits instead of abandoning
+        # handler threads mid-poll.
+        self._stop = threading.Event()
         self.dra_service = DRAService(
-            self.state, backend, self.pu_flock, metrics=self.metrics
+            self.state, backend, self.pu_flock, metrics=self.metrics,
+            stop=self._stop,
         )
         self._servers = []
         self.health_monitor = DeviceHealthMonitor(tpulib, self._on_health_change)
+        # Control-plane weather: when the transport carries a circuit
+        # breaker (rest.KubeClient does; the in-memory fake does not),
+        # the driver runs an explicit degraded mode — background claim
+        # GC and slice publication pause while any verb's circuit is
+        # open, and a fenced resync runs on heal (DegradedModeController).
+        self.circuit = bind_backend_metrics(backend, self.metrics)
         self.cleanup = CheckpointCleanupManager(
-            self.state, backend, pu_flock=self.pu_flock
+            self.state, backend, pu_flock=self.pu_flock,
+            metrics=self.metrics, circuit=self.circuit,
         )
         # Auto-remediation rides the health-event stream; without the gate
         # the driver keeps the reference's unpublish-only behavior.
@@ -154,9 +168,31 @@ class Driver:
                 metrics=self.metrics,
                 debounce_seconds=config.remediation_debounce_seconds,
                 pu_flock=self.pu_flock,
+                circuit=self.circuit,
             )
         self._publish_lock = threading.Lock()
         self._slice_generation = 0
+        # The degraded-mode state machine (gauge, publish parking, heal
+        # prober, fenced resync) is shared with the CD plugin; this
+        # driver supplies the component-specific probe/resync/replay.
+        # Its internal lock is distinct from _publish_lock and never
+        # held across API calls: the breaker fires the listener
+        # synchronously on whatever thread recorded the tripping failure
+        # — including a publish thread that already holds _publish_lock
+        # around its apiserver calls.
+        self.degraded_ctl: Optional[DegradedModeController] = None
+        if self.circuit is not None:
+            node = config.node_name
+            self.degraded_ctl = DegradedModeController(
+                circuit=self.circuit,
+                metrics=self.metrics,
+                stop=self._stop,
+                probe=lambda: self.slices.get(f"{node}-heal-probe"),
+                resync=self._heal_reconcile,
+                replay=self.publish_with_retry,
+            )
+        else:
+            self.metrics.set_gauge("api_degraded", 0)
 
     def _collect_multiplex_metrics(self, multiplex) -> None:
         statuses = multiplex.poll_status()
@@ -304,6 +340,7 @@ class Driver:
         self.metrics.set_gauge("allocatable_devices", len(self.state.allocatable))
 
     def shutdown(self) -> None:
+        self._stop.set()
         self.cleanup.stop()
         if self.remediation is not None:
             self.remediation.stop()
@@ -321,6 +358,37 @@ class Driver:
         return sockets_healthy(
             getattr(self, "_socket_paths", []),
             getattr(self, "registration", None),
+        )
+
+    # --- degraded mode (control-plane weather) ---
+
+    def _heal_reconcile(self) -> None:
+        """The component-specific half of the fenced heal resync
+        (DegradedModeController drives it): relist claims and reconcile
+        the checkpoint against the recovered apiserver — stale prepared
+        claims whose ResourceClaim vanished during the partition are
+        unprepared."""
+        cleaned = self.cleanup.cleanup_once()
+        if cleaned:
+            log.warning(
+                "heal resync: unprepared %d claim(s) that went stale "
+                "during the outage", cleaned,
+            )
+
+    def _defer_publish_while_degraded(self) -> bool:
+        """True when the circuit is open and the publish was queued for
+        the heal resync instead (generation-supersede still applies: the
+        heal publishes the LATEST state once, not every queued event)."""
+        return (
+            self.degraded_ctl is not None
+            and self.degraded_ctl.defer_publish()
+        )
+
+    @property
+    def _publish_pending_heal(self) -> bool:
+        return (
+            self.degraded_ctl is not None
+            and self.degraded_ctl.publish_pending_heal
         )
 
     # --- health (driver.go:441-505) ---
@@ -374,12 +442,20 @@ class Driver:
                     _expected_generation,
                 )
                 return
+        # Degraded mode: a retry chain ticking against an OPEN circuit is
+        # pure spin — park the publish for the heal resync instead. The
+        # supersede guard makes the parked publish coalesce with anything
+        # newer that arrives while the control plane is dark.
+        if self._defer_publish_while_degraded():
+            return
         try:
             self.publish_resources()
         except Exception as e:
             self.metrics.inc("publish_retries_total")
             if attempts <= 1:
                 log.error("republish failed permanently: %s", e)
+                return
+            if self._defer_publish_while_degraded():
                 return
             sleep = delay * random.uniform(0.5, 1.5)
             log.warning(

@@ -74,6 +74,7 @@ class RemediationController:
         debounce_seconds: float = DEFAULT_DEBOUNCE_SECONDS,
         max_requeue_retries: int = DEFAULT_MAX_REQUEUE_RETRIES,
         pu_flock=None,
+        circuit=None,
     ):
         self.state = state
         self.claims = ResourceClient(backend, RESOURCE_CLAIMS)
@@ -81,6 +82,12 @@ class RemediationController:
         self.publish = publish or (lambda: None)
         self.metrics = metrics
         self.debounce_seconds = debounce_seconds
+        # Degraded mode: with the apiserver circuit open, the annotation
+        # breadcrumb is skipped (not retried into the dead-letter cap —
+        # local unprepare is the action that frees the silicon and needs
+        # no API); the publish callback is the driver's, which defers
+        # itself while degraded.
+        self.circuit = circuit
         # Serialize requeue-unprepare with the RPC Prepare/Unprepare paths
         # across plugin processes, exactly like the cleanup manager.
         self.pu_flock = pu_flock
@@ -263,6 +270,16 @@ class RemediationController:
     def _annotate(self, claim_uid: str, claim) -> None:
         if not claim.name or not claim.namespace:
             return  # pre-upgrade checkpoint record: nothing to annotate
+        if self.circuit is not None and self.circuit.any_open():
+            # The breadcrumb is best-effort; spinning the work queue's
+            # retry budget against an open circuit would dead-letter the
+            # requeue and leave the unhealthy chip's claim prepared.
+            self._inc("remediation_annotations_skipped_degraded_total")
+            log.warning(
+                "skipping remediation annotation for claim %s: apiserver "
+                "circuit open (local unprepare proceeds)", claim_uid,
+            )
+            return
         try:
             live = self.claims.get(claim.name, claim.namespace)
         except ApiNotFound:

@@ -31,6 +31,7 @@ from tpu_dra.api.sharing import (
     TimeSlicingConfig,
     time_slice_ordinal,
 )
+from tpu_dra.infra import deadline
 from tpu_dra.infra import featuregates as fg
 from tpu_dra.k8sclient import DEPLOYMENTS, ResourceClient
 from tpu_dra.plugin.allocatable import AllocatableDevices
@@ -245,15 +246,21 @@ class MultiplexControlDaemon:
 
     def assert_ready(self, timeout: float = 30.0, poll: float = 0.2) -> None:
         """Gate prepare completion on daemon readiness
-        (sharing.go AssertReady :322-378)."""
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        (sharing.go AssertReady :322-378). Consumes the calling RPC's
+        deadline budget: a kubelet Prepare whose budget runs out here
+        fails retriable instead of waiting out the full local timeout."""
+        budget = deadline.current()
+        ready_deadline = time.monotonic() + timeout
+        while time.monotonic() < ready_deadline:
             dep = self.manager.deployments.try_get(self.name, self.namespace)
             if dep is not None:
                 ready = dep.get("status", {}).get("readyReplicas", 0)
                 if ready >= 1:
                     return
-            time.sleep(poll)
+            budget.check(
+                f"waiting for multiplex daemon {self.get_id()} readiness"
+            )
+            budget.pause(poll)
         raise TimeoutError(
             f"multiplex control daemon {self.get_id()} is not yet ready"
         )

@@ -65,6 +65,18 @@ FAILURE_COUNTER_PREFIXES = (
     "tpu_dra_workqueue_dead_letter_total",
 )
 
+# Control-plane weather gauges (ISSUE 5): api_degraded says the driver
+# is in degraded mode RIGHT NOW (claim GC and slice publication paused,
+# prepare/unprepare serving from gRPC+checkpoint state);
+# api_circuit_state{verb} says which verb's breaker tripped
+# (0 closed / 1 half-open / 2 open).
+# Matched by SUFFIX: the TPU plugin exports tpu_dra_api_degraded, the
+# CD plugin tpu_dra_cd_api_degraded (its Metrics prefix differs) — an
+# exact-name match would silently skip the CD plugin's degraded state.
+DEGRADED_GAUGE = "api_degraded"
+CIRCUIT_GAUGE = "api_circuit_state"
+CIRCUIT_STATE_NAMES = {0: "closed", 1: "half-open", 2: "open"}
+
 
 def _scrape(endpoint: str, timeout: float = 2.0) -> Dict[str, float]:
     """Fetch and parse a Prometheus text endpoint into
@@ -149,7 +161,52 @@ def probe_metrics(
                     f"climbing"
                 )
         report[ep] = {"failure_counters": failures}
+        report[ep]["degraded"] = _check_degraded(
+            ep, second or first, warn
+        )
     return report
+
+
+def _check_degraded(
+    ep: str, sample: Dict[str, float], warn
+) -> Dict[str, object]:
+    """Surface the control-plane-weather gauges: degraded mode and any
+    non-closed per-verb circuit. These are gauges, not counters — the
+    current value IS the state, no climb delta needed."""
+    out: Dict[str, object] = {}
+    circuits: Dict[str, str] = {}
+    for series, value in sorted(sample.items()):
+        name = series.split("{", 1)[0]
+        if name.endswith(DEGRADED_GAUGE):
+            out["api_degraded"] = bool(value)
+            if value:
+                warn(
+                    f"{ep}: driver is in DEGRADED mode (apiserver "
+                    f"circuit open) — claim GC and slice publication "
+                    f"are paused; prepare/unprepare still serve from "
+                    f"gRPC+checkpoint state; a fenced resync runs "
+                    f"automatically when the circuit closes"
+                )
+        elif name.endswith(CIRCUIT_GAUGE):
+            verb = "?"
+            if "{" in series:
+                labels = series.split("{", 1)[1].rstrip("}")
+                for part in labels.split(","):
+                    k, _, v = part.partition("=")
+                    if k == "verb":
+                        verb = v.strip('"')
+            state = CIRCUIT_STATE_NAMES.get(int(value), str(value))
+            circuits[verb] = state
+            if state != "closed":
+                warn(
+                    f"{ep}: apiserver circuit for {verb!r} is {state} — "
+                    f"the control plane is (or was very recently) "
+                    f"unreachable from this component; check apiserver "
+                    f"health and network path"
+                )
+    if circuits:
+        out["circuits"] = circuits
+    return out
 
 
 def collect(
@@ -429,6 +486,12 @@ def render(report: dict) -> str:
                 f" (climbed {st['climbed']:g})" if "climbed" in st else ""
             )
             lines.append(f"  {series} = {st['value']:g}{climbed}")
+        deg = m.get("degraded") or {}
+        if deg.get("api_degraded"):
+            lines.append("  DEGRADED mode (apiserver circuit open)")
+        for verb, state in (deg.get("circuits") or {}).items():
+            if state != "closed":
+                lines.append(f"  circuit[{verb}] = {state}")
     for note in report.get("notes", []):
         lines.append(f"note: {note}")
     for w in report["warnings"]:
