@@ -111,6 +111,29 @@ def make_claim(i: int, device: str) -> dict:
     }
 
 
+def measure_allocator() -> dict:
+    """The allocator microbench (ISSUE 6): 1k/10k claim traces over a
+    synthetic 5k-node fleet, indexed+batched vs per-claim re-scan, and
+    packed vs first-fit packing quality (docs/scheduling.md). Pure CPU
+    (no TPU contention with the other legs), run in its own process so
+    a pathological fleet synth can't wedge the bench."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpu_dra.scheduler.allocbench"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    out, err = _communicate_or_kill(proc)
+    if proc.returncode != 0:
+        sys.stderr.write(err[-2000:])
+        raise RuntimeError(
+            f"allocator bench failed (rc={proc.returncode})"
+        )
+    sys.stderr.write(err)
+    return json.loads(out.strip().splitlines()[-1])
+
+
 def measure_claim_prepare_latency(n: int = 20) -> Tuple[float, Dict[str, str]]:
     """(p50 seconds, last claim's injected env) for single-chip claim
     Prepares via the plugin state machine."""
@@ -1414,6 +1437,24 @@ def main() -> int:
         os.environ["BENCH_REQUIRE_TPU"] = "1"
     print(f"probe: platform={platform!r}", file=sys.stderr)
 
+    # Allocator leg first: pure CPU, and a scheduler-side regression
+    # should fail the bench before an hour of TPU legs runs.
+    allocator = measure_allocator()
+    alloc_legs = allocator["legs"]
+    alloc_small = alloc_legs[sorted(alloc_legs, key=int)[0]]
+    print(
+        f"allocator ({allocator['fleet_nodes']} nodes): "
+        f"{allocator['alloc_claims_per_s']:.0f} claims/s at the "
+        f"{sorted(alloc_legs, key=int)[-1]}-claim trace "
+        f"(p50 {allocator['alloc_p50_ms']} ms, p99 "
+        f"{allocator['alloc_p99_ms']} ms, "
+        f"{allocator['alloc_speedup_vs_rescan']}x the per-claim "
+        f"re-scan); frag {allocator['frag_score']} vs first-fit "
+        f"{allocator['firstfit_frag_score']}, util {allocator['util']} "
+        f"vs {allocator['firstfit_util']}",
+        file=sys.stderr,
+    )
+
     prep_p50, dra_env = measure_claim_prepare_latency()
     print(
         f"claim prepare p50: {prep_p50 * 1000:.2f} ms; injected env keys: "
@@ -1612,6 +1653,31 @@ def main() -> int:
                 "timeslice_wait_p90_s": rotation["wait_p90_s"],
                 "seq2048_tok_s": round(seq2048["tok_s"], 1),
                 "mfu_seq2048": mfu2048,
+                # Allocator microbench (ISSUE 6): fleet-scale allocate
+                # latency/throughput + packing quality; the headline
+                # keys come from the largest trace (10k claims over
+                # the 5k-node fleet), the _1k variants from the small
+                # one, both over the same synthesized fleet.
+                "alloc_p50_ms": allocator["alloc_p50_ms"],
+                "alloc_p99_ms": allocator["alloc_p99_ms"],
+                "alloc_claims_per_s": allocator["alloc_claims_per_s"],
+                "alloc_p50_ms_1k": alloc_small["alloc_p50_ms"],
+                "alloc_p99_ms_1k": alloc_small["alloc_p99_ms"],
+                "alloc_claims_per_s_1k": alloc_small[
+                    "alloc_claims_per_s"
+                ],
+                "alloc_speedup_vs_rescan": allocator[
+                    "alloc_speedup_vs_rescan"
+                ],
+                "alloc_index_build_ms": allocator["index_build_ms"],
+                "alloc_unschedulable": allocator["alloc_unschedulable"],
+                "frag_score": allocator["frag_score"],
+                "achievable_util": allocator["achievable_util"],
+                "alloc_util": allocator["util"],
+                "firstfit_frag_score": allocator[
+                    "firstfit_frag_score"
+                ],
+                "firstfit_util": allocator["firstfit_util"],
             }
         )
     )

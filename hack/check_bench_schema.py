@@ -31,15 +31,34 @@ REQUIRED = {
 }
 
 # Keys introduced by later rounds (r6: int8-KV decode + first-class
-# roofline-gap keys): type-checked whenever present; hack/lint.py's B100
-# superset rule makes each permanent the round after it first lands in
-# a recorded artifact, so they don't need hard-requiring here.
+# roofline-gap keys; ISSUE 6: the allocator microbench leg):
+# type-checked whenever present; hack/lint.py's B100 superset rule
+# makes each permanent the round after it first lands in a recorded
+# artifact, so they don't need hard-requiring here.
 TYPED_WHEN_PRESENT = {
     "decode_int8kv_tok_s": (int, float),
     "decode_w8kv8_tok_s": (int, float),
     "decode_x_above_bf16_floor": (int, float),
     "decode_x_above_int8kv_floor": (int, float),
     "decode_sampled_vs_greedy": (int, float),
+    # Allocator leg (ISSUE 6): fleet-scale allocate latency/throughput
+    # + packing quality; the B100 pass additionally requires these in
+    # bench.py's final dict so the leg cannot silently drop out before
+    # its first recorded artifact makes it permanent.
+    "alloc_p50_ms": (int, float),
+    "alloc_p99_ms": (int, float),
+    "alloc_claims_per_s": (int, float),
+    "alloc_p50_ms_1k": (int, float),
+    "alloc_p99_ms_1k": (int, float),
+    "alloc_claims_per_s_1k": (int, float),
+    "alloc_speedup_vs_rescan": (int, float),
+    "alloc_index_build_ms": (int, float),
+    "alloc_unschedulable": int,
+    "frag_score": (int, float),
+    "achievable_util": (int, float),
+    "alloc_util": (int, float),
+    "firstfit_frag_score": (int, float),
+    "firstfit_util": (int, float),
 }
 
 

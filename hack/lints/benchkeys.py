@@ -6,6 +6,14 @@ dict literal it prints as its final JSON line are held to a SUPERSET
 rule against the newest recorded BENCH_r*.json artifact — downstream
 BENCH parsing and cross-round comparisons never break on a silent
 rename/drop.
+
+ISSUE 6 extends the rule with a FORWARD requirement for the allocator
+leg's headline keys (REQUIRED_STATIC): the superset rule only
+protects keys once an artifact has recorded them, so a brand-new leg
+could be wired in, dropped in a refactor, and never missed. The
+allocator keys are scheduler-regression tripwires (alloc p50/p99,
+claims/s, frag score) — their absence from bench.py's final dict is a
+finding even before the first BENCH_r*.json that carries them.
 """
 
 from __future__ import annotations
@@ -16,6 +24,15 @@ from typing import List
 
 from lints.base import FileContext, Finding
 from lints.registry import register
+
+# Keys the allocator microbench leg (ISSUE 6) must keep in bench.py's
+# final JSON dict, artifact or not (see module doc).
+REQUIRED_STATIC = (
+    "alloc_p50_ms",
+    "alloc_p99_ms",
+    "alloc_claims_per_s",
+    "frag_score",
+)
 
 
 def _static_bench_keys(tree: ast.Module) -> set:
@@ -51,22 +68,35 @@ class BenchSchemaPass:
     def run(self, ctx: FileContext) -> List[Finding]:
         if ctx.path.name != "bench.py" or ctx.tree is None:
             return []
+        static = _static_bench_keys(ctx.tree)
+        findings = [
+            Finding(
+                ctx.path, 0, "B100",
+                f"final JSON dict is missing required allocator-leg key "
+                f"{k!r} (scheduler-regression tripwire, required ahead "
+                f"of its first recorded artifact)",
+            )
+            for k in REQUIRED_STATIC
+            if k not in static
+        ]
         artifacts = sorted(ctx.path.resolve().parent.glob("BENCH_r*.json"))
         if not artifacts:
-            return []
+            return findings
         last = artifacts[-1]
         try:
             data = json.loads(last.read_text(encoding="utf-8"))
         except (OSError, ValueError) as e:
-            return [Finding(last, 0, "C900", f"invalid JSON: {e}")]
+            return findings + [
+                Finding(last, 0, "C900", f"invalid JSON: {e}")
+            ]
         if isinstance(data.get("parsed"), dict):
             data = data["parsed"]
-        static = _static_bench_keys(ctx.tree)
-        return [
+        findings.extend(
             Finding(
                 ctx.path, 0, "B100",
                 f"final JSON dict dropped key {k!r} present in {last.name} "
                 f"(bench schema is append-only)",
             )
             for k in sorted(set(data) - static)
-        ]
+        )
+        return findings

@@ -1,0 +1,193 @@
+"""Property/fuzz parity: the indexed + packed allocator vs the oracle.
+
+ISSUE 6 rebuilt the allocator's candidate machinery (persistent
+SliceIndex, packed candidate order, batched entry point) around the
+same exact backtracking search. The refactor's contract is that none
+of it changes *satisfiability* — only which satisfying assignment is
+found first — and that every returned allocation is one the original
+exact search accepts. This suite fuzzes that contract over randomized
+fleets, claim mixes, and churn:
+
+- **Verdict parity**: at every step, given the identical set of prior
+  allocations, the indexed+packed allocator and the full-re-scan
+  catalog-order oracle (the pre-ISSUE-6 path, kept callable) agree on
+  schedulable-vs-Unschedulable. Both searches are exact, so any
+  divergence is a bug — candidate sets drifting (index invalidation),
+  an ordering dropping candidates, or ledger state corruption.
+- **Feasibility**: the surviving allocations are oracle-grade — no
+  device handed to two claims, per-pool shared-counter usage within
+  published capacity (which is what makes overlapping sub-slice
+  placements mutually exclusive: coordinate overlap IS counter
+  overlap).
+- **Candidate-set parity**: for every (class, request-selector)
+  fingerprint, the index returns exactly the devices a full CEL
+  re-scan of the fleet matches, in the same deterministic
+  (pool, name) order — across slice add/modify/delete/resync churn.
+
+Everything is seeded; failures reproduce.
+"""
+
+import random
+
+import pytest
+
+from tpu_dra.scheduler.allocator import (
+    Allocator,
+    DeviceCatalog,
+    Unschedulable,
+)
+from tpu_dra.scheduler.allocbench import (
+    CLASSES,
+    SHAPES,
+    make_claim,
+    make_fleet,
+    validate_results,
+)
+from tpu_dra.scheduler.index import SliceIndex
+
+
+def thinned_fleet(rng: random.Random, nodes: int):
+    """A make_fleet with ~20% of sub-slice devices randomly removed, so
+    pools advertise different placement sets (the asymmetry real
+    reshape churn produces)."""
+    slices = make_fleet(nodes)
+    for s in slices:
+        devs = s["spec"]["devices"]
+        s["spec"]["devices"] = [
+            d for d in devs
+            if d["name"].startswith("chip-") or rng.random() > 0.2
+        ]
+    return slices
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_indexed_packed_matches_backtracking_oracle(seed):
+    rng = random.Random(seed)
+    nodes = rng.randint(2, 8)
+    slices = thinned_fleet(rng, nodes)
+    index = SliceIndex()
+    index.resync(slices)
+    indexed = Allocator(CLASSES, index=index, ordering="packed")
+    allocated = []  # claims with status.allocation, the shared truth
+    for i in range(rng.randint(10, 6 * nodes)):
+        shape = rng.choice(sorted(SHAPES))
+        c = make_claim(i, shape)
+        oracle = Allocator(
+            CLASSES, slices=slices, allocated_claims=allocated,
+            ordering="catalog",
+        )
+        try:
+            oracle.allocate(c)
+            oracle_ok = True
+        except Unschedulable:
+            oracle_ok = False
+        try:
+            res = indexed.allocate(c)
+            ok = True
+        except Unschedulable:
+            ok = False
+        assert ok == oracle_ok, (
+            f"seed {seed} claim {i} ({shape}): indexed+packed "
+            f"{'allocated' if ok else 'refused'} but the oracle "
+            f"{'allocated' if oracle_ok else 'refused'}"
+        )
+        if ok:
+            allocated.append(
+                {**c, "status": {"allocation": res.allocation}}
+            )
+        # Churn: release a random claim and rebuild the indexed
+        # allocator from the surviving set (the controller's snapshot
+        # semantics) — the index itself persists untouched.
+        if allocated and rng.random() < 0.15:
+            del allocated[rng.randrange(len(allocated))]
+            indexed = Allocator(
+                CLASSES, index=index, allocated_claims=allocated,
+                ordering="packed",
+            )
+    validate_results(
+        slices,
+        [
+            (c["metadata"]["name"], c["status"]["allocation"])
+            for c in allocated
+        ],
+    )
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_index_candidates_match_full_scan(seed):
+    rng = random.Random(seed)
+    slices = thinned_fleet(rng, rng.randint(2, 6))
+    index = SliceIndex()
+    index.resync(slices)
+    scan = Allocator(CLASSES, slices=slices)
+    via_index = Allocator(CLASSES, index=index)
+    for shape in sorted(SHAPES):
+        request = make_claim(0, shape)["spec"]["devices"]["requests"][0]
+        a = scan._class_devices(request, [])
+        b = via_index._class_devices(request, [])
+        assert [d.key() for d in a] == [d.key() for d in b]
+        assert [pk for pk, _ in a.buckets] == [pk for pk, _ in b.buckets]
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_index_tracks_random_slice_event_storms(seed):
+    """After any sequence of ADDED/MODIFIED/DELETED events the index's
+    merged catalog equals a from-scratch DeviceCatalog over the live
+    listing — the invalidation rules lose and leak nothing."""
+    rng = random.Random(seed)
+    index = SliceIndex()
+    live = {}
+    pool = make_fleet(10)  # template slices to draw from
+    for step in range(60):
+        op = rng.random()
+        if op < 0.5 or not live:
+            s = dict(rng.choice(pool))
+            live[s["metadata"]["name"]] = s
+            index.on_slice_event("ADDED", s)
+        elif op < 0.75:
+            name = rng.choice(sorted(live))
+            s = {**live[name]}
+            spec = dict(s["spec"])
+            devs = list(spec["devices"])
+            if devs:
+                devs.pop(rng.randrange(len(devs)))
+            spec["devices"] = devs
+            s["spec"] = spec
+            live[name] = s
+            index.on_slice_event("MODIFIED", s)
+        else:
+            name = rng.choice(sorted(live))
+            index.on_slice_event("DELETED", live.pop(name))
+        if step % 20 == 19:  # periodic resync backstop, same listing
+            index.resync(list(live.values()))
+    want = DeviceCatalog(
+        [live[n] for n in sorted(live)]
+    )
+    got = index.catalog()
+    assert sorted(c.key() for c in got.devices) == sorted(
+        c.key() for c in want.devices
+    )
+    assert got.counters == want.counters
+    assert got.pool_totals == want.pool_totals
+
+
+@pytest.mark.parametrize("ordering", ["packed", "catalog"])
+def test_allocation_deterministic_for_fixed_state(ordering):
+    slices = make_fleet(6)
+    index = SliceIndex()
+    index.resync(slices)
+
+    def one_run():
+        alloc = Allocator(CLASSES, index=index, ordering=ordering)
+        out = []
+        for i in range(20):
+            shape = ["1x1x1", "2x1x1", "2x2x1"][i % 3]
+            try:
+                res = alloc.allocate(make_claim(i, shape))
+            except Unschedulable:
+                out.append(None)
+            else:
+                out.append(res.allocation["devices"]["results"])
+        return out
+
+    assert one_run() == one_run()

@@ -391,3 +391,72 @@ def test_metrics_probe_quiet_when_circuits_closed(tmp_path):
         assert deg["api_degraded"] is False
     finally:
         srv.stop()
+
+
+def test_metrics_probe_surfaces_scheduler_fleet_health(tmp_path):
+    """ISSUE 6: a scheduler whose grid is badly fragmented, or whose
+    slice index could not parse every published ResourceSlice, shows
+    up in doctor output with remediation hints."""
+    from tpu_dra.infra.metrics import Metrics, MetricsServer
+
+    metrics = Metrics()
+    metrics.set_gauge("scheduler_frag_score", 0.4)
+    metrics.set_gauge("scheduler_index_slices_seen", 12)
+    metrics.set_gauge("scheduler_index_slices_indexed", 10)
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        endpoint = f"127.0.0.1:{srv.port}"
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint],
+        )
+        warns = "\n".join(report["warnings"])
+        assert "fragmentation score is 0.4" in warns
+        assert "stranded" in warns
+        assert "12 ResourceSlice(s) seen but only 10 indexed" in warns
+        sched = report["metrics"][endpoint]["scheduler"]
+        assert sched == {
+            "frag_score": 0.4, "slices_seen": 12, "slices_indexed": 10,
+        }
+        out = render(report)
+        assert "scheduler: frag_score=0.4 index=10/12 slices" in out
+    finally:
+        srv.stop()
+
+
+def test_metrics_probe_quiet_on_healthy_scheduler(tmp_path):
+    """A tidy grid (frag below threshold) with a fully-indexed fleet
+    reports the section without warning; non-scheduler endpoints get
+    no scheduler section at all."""
+    from tpu_dra.infra.metrics import Metrics, MetricsServer
+
+    metrics = Metrics()
+    metrics.set_gauge("scheduler_frag_score", 0.1)
+    metrics.set_gauge("scheduler_index_slices_seen", 8)
+    metrics.set_gauge("scheduler_index_slices_indexed", 8)
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    plugin_metrics = Metrics()
+    plugin_metrics.set_gauge("api_degraded", 0)
+    srv2 = MetricsServer(plugin_metrics, port=0, address="127.0.0.1")
+    srv2.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        sched_ep = f"127.0.0.1:{srv.port}"
+        plugin_ep = f"127.0.0.1:{srv2.port}"
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[sched_ep, plugin_ep],
+        )
+        assert report["warnings"] == [], report["warnings"]
+        assert report["metrics"][sched_ep]["scheduler"] == {
+            "frag_score": 0.1, "slices_seen": 8, "slices_indexed": 8,
+        }
+        assert "scheduler" not in report["metrics"][plugin_ep]
+    finally:
+        srv.stop()
+        srv2.stop()

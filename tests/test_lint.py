@@ -1196,18 +1196,50 @@ def test_d800_real_driver_layers_are_clean():
 # --- B100 bench schema --------------------------------------------------------
 
 
+def _alloc_keys_literal():
+    """The ISSUE-6 forward-required allocator keys, as dict-literal
+    source the B100 fixtures splice in so they exercise exactly the
+    rule under test."""
+    from lints.benchkeys import REQUIRED_STATIC
+
+    return ", ".join(f"'{k}': 0" for k in REQUIRED_STATIC)
+
+
 def test_b100_dropped_key_fires_and_superset_passes(tmp_path):
     write(tmp_path, "BENCH_r01.json", json.dumps(
         {"parsed": {"keep": 1, "dropped": 2}}
     ))
     bench = write(tmp_path, "bench.py", (
         "import json\n"
-        "print(json.dumps({'keep': 1}))\n"
+        f"print(json.dumps({{'keep': 1, {_alloc_keys_literal()}}}))\n"
     ))
     out = BenchSchemaPass().run(FileContext(bench, tmp_path))
     assert [f.code for f in out] == ["B100"]
+    assert "'dropped'" in out[0].message
     bench.write_text(
-        "import json\nprint(json.dumps({'keep': 1, 'dropped': 2, 'new': 3}))\n"
+        "import json\nprint(json.dumps({'keep': 1, 'dropped': 2, "
+        f"'new': 3, {_alloc_keys_literal()}}}))\n"
+    )
+    assert BenchSchemaPass().run(FileContext(bench, tmp_path)) == []
+
+
+def test_b100_allocator_keys_required_even_without_artifact(tmp_path):
+    """ISSUE 6: the allocator leg's headline keys are required in
+    bench.py's final dict BEFORE any artifact records them — the
+    superset rule alone would let the new leg be dropped unnoticed
+    until the next recorded round."""
+    bench = write(tmp_path, "bench.py", (
+        "import json\n"
+        "print(json.dumps({'metric': 'x', 'alloc_p50_ms': 1.0}))\n"
+    ))
+    out = BenchSchemaPass().run(FileContext(bench, tmp_path))
+    assert sorted(f.code for f in out) == ["B100"] * 3
+    missing = "".join(f.message for f in out)
+    for key in ("alloc_p99_ms", "alloc_claims_per_s", "frag_score"):
+        assert f"'{key}'" in missing
+    # With every required key present (and still no artifact): clean.
+    bench.write_text(
+        f"import json\nprint(json.dumps({{{_alloc_keys_literal()}}}))\n"
     )
     assert BenchSchemaPass().run(FileContext(bench, tmp_path)) == []
 

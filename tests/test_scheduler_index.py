@@ -1,0 +1,492 @@
+"""SliceIndex, batched allocation, and packed-order unit tests (ISSUE 6).
+
+The parity suite (test_alloc_parity.py) proves the indexed+packed
+allocator equivalent to the exact oracle; this file pins the *point*
+behaviors: index invalidation and CEL-verdict caching, staleness
+accounting for unparseable slices, the batch entry point's
+largest-first order, the packing heuristic's pool- and chip-level
+choices, the fleet fragmentation score, and the controller's batch
+reconcile over a fake cluster.
+"""
+
+import time
+
+import pytest
+
+from tpu_dra.infra.metrics import Metrics
+from tpu_dra.k8sclient import (
+    DEVICE_CLASSES,
+    RESOURCE_CLAIMS,
+    RESOURCE_SLICES,
+    FakeCluster,
+    ResourceClient,
+)
+from tpu_dra.scheduler.allocator import Allocator, Unschedulable
+from tpu_dra.scheduler.allocbench import (
+    CLASSES,
+    SUBSLICE_CLASS,
+    TPU_CLASS,
+    make_claim,
+    make_fleet,
+)
+from tpu_dra.scheduler import index as index_mod
+from tpu_dra.scheduler.core import SchedulerCore
+from tpu_dra.scheduler.index import SliceIndex
+
+
+def _devices(alloc_result):
+    return [
+        r["device"]
+        for r in alloc_result.allocation["devices"]["results"]
+    ]
+
+
+def _subslice_request(shape):
+    return make_claim(0, shape)["spec"]["devices"]["requests"][0]
+
+
+# --- index invalidation + caching ---
+
+
+def test_slice_events_update_candidates():
+    a, b = make_fleet(2)
+    idx = SliceIndex()
+    idx.on_slice_event("ADDED", a)
+    alloc = Allocator(CLASSES, index=idx)
+    cands = alloc._class_devices(_subslice_request("2x2x1"), [])
+    assert [d.pool for d in cands] == ["node-00000"]
+
+    idx.on_slice_event("ADDED", b)
+    cands = Allocator(CLASSES, index=idx)._class_devices(
+        _subslice_request("2x2x1"), []
+    )
+    assert [d.pool for d in cands] == ["node-00000", "node-00001"]
+
+    # MODIFIED: drop node 0's 2x2 device -> it leaves the fingerprint.
+    a2 = {**a, "spec": {**a["spec"], "devices": [
+        d for d in a["spec"]["devices"] if d["name"] != "ss-2x2x1-0-0-0"
+    ]}}
+    idx.on_slice_event("MODIFIED", a2)
+    cands = Allocator(CLASSES, index=idx)._class_devices(
+        _subslice_request("2x2x1"), []
+    )
+    assert [d.pool for d in cands] == ["node-00001"]
+
+    idx.on_slice_event("DELETED", b)
+    cands = Allocator(CLASSES, index=idx)._class_devices(
+        _subslice_request("2x2x1"), []
+    )
+    assert list(cands) == []
+
+
+def test_unchanged_slices_run_zero_cel(monkeypatch):
+    """The whole point of the index: allocating claim N+1 against an
+    unchanged fleet evaluates no selector at all, and a single changed
+    slice re-evaluates only that slice."""
+    fleet = make_fleet(4)
+    idx = SliceIndex()
+    idx.resync(fleet)
+    calls = []
+    real = index_mod.selectors_match
+
+    def counting(selectors, dev, reasons, who):
+        calls.append(dev.pool)
+        return real(selectors, dev, reasons, who)
+
+    monkeypatch.setattr(index_mod, "selectors_match", counting)
+    alloc = Allocator(CLASSES, index=idx)
+    alloc._class_devices(_subslice_request("1x1x1"), [])
+    first = len(calls)
+    assert first > 0  # the fingerprint's initial scan
+
+    calls.clear()
+    for _ in range(5):
+        Allocator(CLASSES, index=idx)._class_devices(
+            _subslice_request("1x1x1"), []
+        )
+    assert calls == []  # steady state: zero CEL
+
+    # Touch ONE slice: only its devices are re-judged.
+    changed = {**fleet[2], "spec": {**fleet[2]["spec"], "devices": [
+        d for d in fleet[2]["spec"]["devices"]
+        if d["name"] != "ss-1x1x1-0-0-0"
+    ]}}
+    idx.on_slice_event("MODIFIED", changed)
+    calls.clear()
+    Allocator(CLASSES, index=idx)._class_devices(
+        _subslice_request("1x1x1"), []
+    )
+    assert set(calls) == {"node-00002"}
+    assert 0 < len(calls) < first
+
+
+def test_resync_skips_unchanged_and_drops_vanished():
+    fleet = make_fleet(3)
+    idx = SliceIndex()
+    idx.resync(fleet)
+    gen = idx.generation
+    idx.resync(fleet)  # identical listing: no generation churn
+    assert idx.generation == gen
+    idx.resync(fleet[:2])  # one slice vanished
+    assert idx.generation > gen
+    assert len(idx.catalog().devices) == len(
+        Allocator(CLASSES, slices=fleet[:2]).catalog.devices
+    )
+
+
+def test_unparseable_slice_counts_seen_not_indexed():
+    metrics = Metrics()
+    idx = SliceIndex(metrics=metrics)
+    good, bad = make_fleet(2)
+    bad = {**bad, "spec": {**bad["spec"], "devices": 42}}  # not a list
+    idx.on_slice_event("ADDED", good)
+    idx.on_slice_event("ADDED", bad)
+    assert idx.staleness() == (2, 1)
+    rendered = metrics.render()
+    assert "scheduler_index_slices_seen 2" in rendered
+    assert "scheduler_index_slices_indexed 1" in rendered
+    # The allocator simply cannot place onto the bad slice.
+    assert {c.pool for c in idx.catalog().devices} == {"node-00000"}
+    # Heal: a fixed republish clears the staleness.
+    idx.on_slice_event("MODIFIED", make_fleet(2)[1])
+    assert idx.staleness() == (2, 2)
+
+
+def test_bad_slice_does_not_churn_generation_on_resync():
+    """A permanently-unparseable slice must not bump the generation on
+    every sweep resync — that would invalidate every merged view each
+    pass, reintroducing the O(fleet) steady state the index kills."""
+    idx = SliceIndex()
+    fleet = make_fleet(2)
+    fleet[1] = {**fleet[1], "spec": {**fleet[1]["spec"], "devices": 42}}
+    idx.resync(fleet)
+    gen = idx.generation
+    for _ in range(3):
+        idx.resync(fleet)
+        idx.on_slice_event("MODIFIED", fleet[1])  # same bad content
+    assert idx.generation == gen
+    assert idx.staleness() == (2, 1)
+
+
+def test_fingerprint_shared_across_request_names(monkeypatch):
+    """Verdicts depend on the selectors, not the request name — claims
+    with generated request names must share one fingerprint instead of
+    thrashing the cache back to per-claim fleet scans."""
+    idx = SliceIndex()
+    idx.resync(make_fleet(2))
+    calls = []
+    real = index_mod.selectors_match
+
+    def counting(selectors, dev, reasons, who):
+        calls.append(who)
+        return real(selectors, dev, reasons, who)
+
+    monkeypatch.setattr(index_mod, "selectors_match", counting)
+    alloc = Allocator(CLASSES, index=idx)
+    base = _subslice_request("1x1x1")
+    alloc._class_devices({**base, "name": "gen-a"}, [])
+    assert calls  # first name minted + scanned the fingerprint
+    calls.clear()
+    cl = alloc._class_devices({**base, "name": "gen-b"}, [])
+    assert calls == []  # second name: same fingerprint, zero CEL
+    assert len(cl) > 0
+
+
+def test_fingerprint_eviction_is_lru(monkeypatch):
+    """Touching a fingerprint protects it from eviction: with the cap
+    at 2, re-reading A before minting C evicts B, not A."""
+    monkeypatch.setattr(index_mod, "MAX_FINGERPRINTS", 2)
+    idx = SliceIndex()
+    idx.resync(make_fleet(1))
+    alloc = Allocator(CLASSES, index=idx)
+
+    def request_for(shape):
+        return _subslice_request(shape)
+
+    calls = []
+    real = index_mod.selectors_match
+
+    def counting(selectors, dev, reasons, who):
+        calls.append(who)
+        return real(selectors, dev, reasons, who)
+
+    monkeypatch.setattr(index_mod, "selectors_match", counting)
+    alloc._class_devices(request_for("1x1x1"), [])  # A
+    alloc._class_devices(request_for("2x1x1"), [])  # B
+    alloc._class_devices(request_for("1x1x1"), [])  # touch A
+    alloc._class_devices(request_for("2x2x1"), [])  # C evicts B
+    calls.clear()
+    alloc._class_devices(request_for("1x1x1"), [])  # A still cached
+    assert calls == []
+    alloc._class_devices(request_for("2x1x1"), [])  # B was evicted
+    assert calls != []
+
+
+def test_fingerprint_cache_is_bounded(monkeypatch):
+    monkeypatch.setattr(index_mod, "MAX_FINGERPRINTS", 4)
+    idx = SliceIndex()
+    idx.resync(make_fleet(1))
+    alloc = Allocator(CLASSES, index=idx)
+    for i in range(10):  # unique selector per request
+        req = {
+            "name": f"r{i}",
+            "deviceClassName": SUBSLICE_CLASS["metadata"]["name"],
+            "selectors": [{"cel": {"expression":
+                f"device.attributes['tpu.google.com'].subsliceShape"
+                f" == '1x1x{i}'"}}],
+        }
+        alloc._class_devices(req, [])
+        assert len(idx._fingerprints) <= 4
+
+
+# --- batched allocation ---
+
+
+def test_batch_order_is_largest_first_and_deterministic():
+    idx = SliceIndex()
+    idx.resync(make_fleet(3))
+    alloc = Allocator(CLASSES, index=idx)
+    claims = [
+        make_claim(0, "1x1x1"),
+        make_claim(1, "2x2x1"),
+        make_claim(2, "2x1x1"),
+        make_claim(3, "2x2x1"),
+    ]
+    order = alloc.batch_order(claims)
+    # 2x2s (weight 4) first — name tiebreak keeps claim-1 before
+    # claim-3 — then the row, then the single.
+    assert order == [1, 3, 2, 0]
+    assert order == Allocator(
+        CLASSES, index=idx
+    ).batch_order(claims)
+
+
+def test_allocate_batch_results_in_input_order():
+    idx = SliceIndex()
+    idx.resync(make_fleet(1))  # 4 chips total
+    alloc = Allocator(CLASSES, index=idx)
+    claims = [
+        make_claim(0, "1x1x1"),
+        make_claim(1, "2x2x1"),  # would be stranded if solved last
+        make_claim(2, "2x2x1"),  # loses: only one mesh exists
+    ]
+    results = alloc.allocate_batch(claims)
+    assert len(results) == 3
+    # Input order preserved: claim 0 and exactly one 2x2 fail.
+    assert isinstance(results[0], Unschedulable)
+    assert not isinstance(results[1], Unschedulable)
+    assert isinstance(results[2], Unschedulable)
+
+
+def test_batch_big_claims_win_over_claim_bursts():
+    """The motivating scenario: a burst of 1x1 claims arriving with a
+    2x2 must not strand it — batched largest-first places the 2x2
+    before the singles splinter the grid."""
+    idx = SliceIndex()
+    idx.resync(make_fleet(2))  # 8 chips
+    alloc = Allocator(CLASSES, index=idx)
+    claims = [make_claim(i, "1x1x1") for i in range(4)]
+    claims.append(make_claim(99, "2x2x1"))
+    results = alloc.allocate_batch(claims)
+    assert not any(isinstance(r, Unschedulable) for r in results)
+    two_by_two_node = {
+        r["pool"]
+        for r in results[-1].allocation["devices"]["results"]
+    }
+    assert len(two_by_two_node) == 1
+    # All four singles share the OTHER node.
+    for r in results[:4]:
+        assert {
+            x["pool"] for x in r.allocation["devices"]["results"]
+        }.isdisjoint(two_by_two_node)
+
+
+# --- packed candidate order ---
+
+
+def test_packed_fills_fullest_partial_pool_first():
+    fleet = make_fleet(3)
+    idx = SliceIndex()
+    idx.resync(fleet)
+    alloc = Allocator(CLASSES, index=idx, ordering="packed")
+    # Seed: node-1 half full (a row), node-2 one chip used.
+    r1 = alloc.allocate(make_claim(0, "2x1x1"))
+    assert _devices(r1) == ["ss-2x1x1-0-0-0"]  # lands node-00000
+    # Force usage onto specific nodes via selectors on pool identity:
+    # simplest: allocate a row then a single; packed puts both on the
+    # fullest pool (node 0), so craft the state with catalog instead.
+    state = [
+        {**make_claim(1, "2x1x1"),
+         "status": {"allocation": r1.allocation}},
+    ]
+    alloc2 = Allocator(
+        CLASSES, index=idx, allocated_claims=state, ordering="packed"
+    )
+    # node-0 is the only partial pool: the single must land there, on
+    # the SAME row's remaining half (wait — the row consumed chips
+    # (0,0),(1,0); the frag score prefers keeping row1 intact, so the
+    # single goes to... row0 is gone; both remaining chips are row1;
+    # taking either kills it; tie -> catalog order -> 0,1).
+    r2 = alloc2.allocate(make_claim(2, "1x1x1"))
+    assert _devices(r2) == ["ss-1x1x1-0-1-0"]
+    assert r2.allocation["nodeSelector"]["nodeSelectorTerms"][0][
+        "matchFields"
+    ][0]["values"] == ["node-00000"]
+
+
+def test_packed_single_preserves_intact_row():
+    """The ParvaGPU move, chip-scale: with (0,0) already used, a new
+    single goes to (1,0) — same row — keeping row (0,1)-(1,1) alive
+    for a future 2x1; plain catalog order would pick (0,1) and strand
+    both rows."""
+    idx = SliceIndex()
+    idx.resync(make_fleet(1))
+    first = Allocator(CLASSES, index=idx, ordering="packed")
+    r1 = first.allocate(make_claim(0, "1x1x1"))
+    assert _devices(r1) == ["ss-1x1x1-0-0-0"]
+    held = [{**make_claim(0, "1x1x1"),
+             "status": {"allocation": r1.allocation}}]
+    packed = Allocator(
+        CLASSES, index=idx, allocated_claims=held, ordering="packed"
+    )
+    assert _devices(packed.allocate(make_claim(1, "1x1x1"))) == [
+        "ss-1x1x1-1-0-0"
+    ]
+    catalog = Allocator(
+        CLASSES, index=idx, allocated_claims=held, ordering="catalog"
+    )
+    assert _devices(catalog.allocate(make_claim(1, "1x1x1"))) == [
+        "ss-1x1x1-0-1-0"
+    ]
+
+
+def test_fragmentation_score_reads_stranding():
+    idx = SliceIndex()
+    idx.resync(make_fleet(1))
+    alloc = Allocator(CLASSES, index=idx, ordering="catalog")
+    assert alloc.fragmentation()["frag_score"] == 0.0
+    # Catalog order splits the rows: free chips (1,0),(1,1) can only
+    # serve singles -> 2 free, best feasible 1 -> frag 0.5.
+    alloc.allocate(make_claim(0, "1x1x1"))
+    alloc.allocate(make_claim(1, "1x1x1"))
+    frag = alloc.fragmentation()
+    assert frag["free_chips"] == 2
+    assert frag["achievable_chips"] == 1
+    assert frag["frag_score"] == 0.5
+
+
+# --- the controller's batch reconcile ---
+
+
+@pytest.fixture()
+def fleet_cluster():
+    fc = FakeCluster()
+    classes = ResourceClient(fc, DEVICE_CLASSES)
+    classes.create(dict(TPU_CLASS))
+    classes.create(dict(SUBSLICE_CLASS))
+    return fc
+
+
+def wait_for(pred, timeout=10, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(0.05)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+def test_core_batch_allocates_pending_set(fleet_cluster):
+    claims = ResourceClient(fleet_cluster, RESOURCE_CLAIMS)
+    slices = ResourceClient(fleet_cluster, RESOURCE_SLICES)
+    core = SchedulerCore(fleet_cluster, retry_unschedulable_after=0.3)
+    core.start()
+    try:
+        pend = [make_claim(i, "1x1x1") for i in range(4)]
+        pend.append(make_claim(99, "2x2x1"))
+        for c in pend:
+            claims.create(c)
+        # Capacity arrives AFTER the claims: the slice events coalesce
+        # into one batch solve over the whole pending set.
+        for s in make_fleet(2):
+            slices.create(s)
+
+        def all_allocated():
+            got = [
+                c for c in claims.list("allocbench")
+                if (c.get("status") or {}).get("allocation")
+            ]
+            return got if len(got) == 5 else None
+
+        wait_for(all_allocated, what="batch allocation of 5 claims")
+        # The index saw the slices and the frag gauge refreshed.
+        # (Whether the batch item or racing single-claim reconciles
+        # performed each allocation is timing — the deterministic
+        # batch-path assertions live in the next test.)
+        assert core.index.staleness() == (2, 2)
+        wait_for(
+            lambda: (
+                "scheduler_frag_score" in core.metrics.render()
+            ),
+            what="frag gauge",
+        )
+    finally:
+        core.stop()
+
+
+def test_reconcile_batch_solves_pending_set_in_one_pass(fleet_cluster):
+    """The batch item itself, driven synchronously (no workqueue, no
+    racing single-claim reconciles): one _reconcile_batch call solves
+    the whole pending set against one shared snapshot, commits every
+    allocation, bumps the batch metrics, and refreshes the frag
+    gauge."""
+    claims = ResourceClient(fleet_cluster, RESOURCE_CLAIMS)
+    slices = ResourceClient(fleet_cluster, RESOURCE_SLICES)
+    for s in make_fleet(2):
+        slices.create(s)
+    pend = [make_claim(i, "1x1x1") for i in range(4)]
+    pend.append(make_claim(99, "2x2x1"))
+    for c in pend:
+        claims.create(c)
+    core = SchedulerCore(fleet_cluster, retry_unschedulable_after=999)
+    # Sync the informer stores without starting the controller loops
+    # (start() would add handlers and race this test's direct call).
+    for inf in (
+        core.claim_informer, core.slice_informer, core.class_informer
+    ):
+        inf.start()
+    try:
+        for inf in (
+            core.claim_informer, core.slice_informer,
+            core.class_informer,
+        ):
+            assert inf.wait_for_sync()
+        core.index.resync(core.slice_informer.list())
+        core._reconcile_batch(None)
+        allocated = [
+            c for c in claims.list("allocbench")
+            if (c.get("status") or {}).get("allocation")
+        ]
+        assert len(allocated) == 5
+        assert core.metrics._counters[
+            ("scheduler_batch_total", ())
+        ] == 1
+        assert core.metrics._counters[
+            ("scheduler_allocations_total", ())
+        ] == 5
+        assert "scheduler_frag_score" in core.metrics.render()
+        # Largest-first inside the batch: the 2x2 owns one whole node.
+        big = next(
+            c for c in allocated
+            if c["metadata"]["name"] == "claim-00099"
+        )
+        assert len(
+            big["status"]["allocation"]["devices"]["results"]
+        ) == 1
+    finally:
+        for inf in (
+            core.claim_informer, core.slice_informer,
+            core.class_informer,
+        ):
+            inf.stop()

@@ -8,8 +8,12 @@ writes ``status.allocation`` (reference: the machinery vendored at
 consuming the counters cmd/gpu-kubelet-plugin/partitions.go:45-170
 advertises). No kube-scheduler exists in the cluster-less e2e stacks, so
 this package supplies that half of the DRA contract: :mod:`.allocator`
-is the pure allocation algorithm, :mod:`.core` the claim-watching
-controller, :mod:`.main` the ``tpu-dra-scheduler`` binary.
+is the pure allocation algorithm, :mod:`.index` the persistent
+candidate index over published ResourceSlices (ISSUE 6 — no per-claim
+fleet re-scan), :mod:`.core` the claim-watching controller with the
+batched reconcile path, :mod:`.allocbench` the fleet microbench
+(``make allocbench``), :mod:`.main` the ``tpu-dra-scheduler`` binary.
+docs/scheduling.md covers the architecture.
 """
 
 from tpu_dra.scheduler.allocator import (  # noqa: F401
@@ -18,3 +22,4 @@ from tpu_dra.scheduler.allocator import (  # noqa: F401
     DeviceCatalog,
     Unschedulable,
 )
+from tpu_dra.scheduler.index import SliceIndex  # noqa: F401

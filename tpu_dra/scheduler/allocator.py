@@ -24,10 +24,30 @@ vendor/k8s.io/dynamic-resource-allocation/structured/allocator.go):
   entries as ``FromClaim`` — the order opaque-config consumers rely
   on), and a node selector pinning the claim to the devices' node.
 
-The search is exact over the (small) per-claim candidate sets: requests
-are processed in order with backtracking across candidate choices, so a
+The search is exact over the per-claim candidate sets: requests are
+processed in order with backtracking across candidate choices, so a
 satisfiable combination is always found (matchAttribute + counters make
-greedy insufficient).
+greedy insufficient). Candidate ORDER is where fleet-scale performance
+and placement quality live (docs/scheduling.md):
+
+- with a :class:`~tpu_dra.scheduler.index.SliceIndex` attached, the
+  candidate set for a (class, request-selectors) fingerprint comes from
+  the persistent index — no per-claim CEL re-scan of the fleet — and
+  the catalog/ledger views are copy-on-write, so building allocator
+  N+1 against an unchanged fleet is O(1), not O(fleet);
+- ``ordering="packed"`` (default) walks candidates pool-by-pool —
+  partially-used pools first (fullest first), untouched pools next,
+  counter-exhausted pools last — and inside a pool scores placements
+  to minimize chip-grid fragmentation (ParvaGPU/MISO-style): prefer
+  the origin whose tentative consumption keeps the LARGEST contiguous
+  advertised placement feasible, then the most total placements.
+  Ties keep (pool, name) order, so results are deterministic;
+- ``ordering="catalog"`` is plain first-fit in (pool, name) order —
+  kept callable as the exact-backtracking oracle for the parity suite
+  and as the naive baseline the allocator bench compares against.
+
+Both orders explore the same exact search space; they differ only in
+which satisfying assignment is found first, never in satisfiability.
 """
 
 from __future__ import annotations
@@ -55,24 +75,45 @@ class Candidate:
     attributes: Dict[str, dict]  # enveloped, as published
     capacity: Dict[str, dict]
     consumes_counters: List[dict] = field(default_factory=list)
+    # Memoized views (index-shared Candidates are evaluated by many
+    # claims; recomputing the CEL env per selector per claim measured
+    # as a top-3 hot spot in the allocator bench). Idempotent
+    # same-value writes, so cross-thread races are benign.
+    _env: Optional[dict] = field(default=None, repr=False, compare=False)
+    _weight: Optional[int] = field(default=None, repr=False, compare=False)
 
     def key(self) -> Tuple[str, str, str]:
         return (self.driver, self.pool, self.name)
 
     def cel_env(self) -> dict:
-        attrs = {k: _unwrap_attr(v) for k, v in self.attributes.items()}
-        caps = {
-            k: CelQuantity(str(v.get("value", "0")))
-            for k, v in self.capacity.items()
-        }
-        return {
-            "device": {
-                "driver": self.driver,
-                # k8s scopes both maps by driver/domain name.
-                "attributes": {self.driver: attrs},
-                "capacity": {self.driver: caps},
+        if self._env is None:
+            attrs = {k: _unwrap_attr(v) for k, v in self.attributes.items()}
+            caps = {
+                k: CelQuantity(str(v.get("value", "0")))
+                for k, v in self.capacity.items()
             }
-        }
+            self._env = {
+                "device": {
+                    "driver": self.driver,
+                    # k8s scopes both maps by driver/domain name.
+                    "attributes": {self.driver: attrs},
+                    "capacity": {self.driver: caps},
+                }
+            }
+        return self._env
+
+    @property
+    def weight(self) -> int:
+        """Total counter units consumed — the device's size in chips
+        for sub-slice placements, 1 for a full chip, 0 for devices
+        outside the counter system (CD channels)."""
+        if self._weight is None:
+            self._weight = sum(
+                int(c.get("value", 0))
+                for e in self.consumes_counters
+                for c in (e.get("counters") or {}).values()
+            )
+        return self._weight
 
 
 def _unwrap_attr(v):
@@ -85,6 +126,94 @@ def _unwrap_attr(v):
     return v
 
 
+def selectors_match(
+    selectors: List[dict], dev: Candidate, reasons: List[str], who: str
+) -> bool:
+    """Evaluate CEL selectors against one device (module-level so the
+    slice index can cache verdicts with identical semantics)."""
+    env = dev.cel_env()
+    for sel in selectors or []:
+        expr = (sel.get("cel") or {}).get("expression", "")
+        if not expr:
+            continue
+        try:
+            ok = compile_expr(expr).evaluate(env)
+        except CelError as e:
+            # k8s: a runtime CEL error fails the device, surfaced in
+            # the scheduling event — never silently matches.
+            reasons.append(
+                f"device {dev.name}: {who} selector error: {e}"
+            )
+            return False
+        if ok is not True:
+            return False
+    return True
+
+
+def parse_slice_devices(s: dict) -> List[Candidate]:
+    """Candidates published by one ResourceSlice."""
+    spec = s.get("spec", {})
+    driver = spec.get("driver", "")
+    pool = spec.get("pool", {}).get("name", "")
+    node = spec.get("nodeName")
+    out = []
+    for dev in spec.get("devices", []) or []:
+        basic = dev.get("basic", dev)
+        out.append(Candidate(
+            driver=driver,
+            pool=pool,
+            node_name=node,
+            name=dev.get("name", ""),
+            attributes=basic.get("attributes", {}) or {},
+            capacity=basic.get("capacity", {}) or {},
+            consumes_counters=basic.get("consumesCounters", []) or [],
+        ))
+    return out
+
+
+def parse_slice_counters(
+    s: dict,
+) -> Dict[Tuple[str, str, str], Dict[str, int]]:
+    """(driver, pool, counterSet) -> capacity published by one slice."""
+    spec = s.get("spec", {})
+    driver = spec.get("driver", "")
+    pool = spec.get("pool", {}).get("name", "")
+    out = {}
+    for cs in spec.get("sharedCounters", []) or []:
+        k = (driver, pool, cs.get("name", ""))
+        out[k] = {
+            name: int(c.get("value", 0))
+            for name, c in (cs.get("counters") or {}).items()
+        }
+    return out
+
+
+class CandidateList(list):
+    """Candidates in (pool, name) order plus the derived structure the
+    packing order consumes: per-pool buckets, collected selector-error
+    reasons, and cheap aggregates. Built once per fingerprint by the
+    slice index (then shared read-only across claims) or per claim by
+    the legacy full-scan path."""
+
+    __slots__ = ("buckets", "reasons", "has_counters", "max_weight")
+
+    @classmethod
+    def build(
+        cls, sorted_cands: List[Candidate], reasons=()
+    ) -> "CandidateList":
+        cl = cls(sorted_cands)
+        groups: Dict[Tuple[str, str], List[Candidate]] = {}
+        for d in sorted_cands:
+            groups.setdefault((d.driver, d.pool), []).append(d)
+        cl.buckets = tuple(
+            (pk, tuple(ds)) for pk, ds in groups.items()
+        )
+        cl.reasons = tuple(reasons)
+        cl.has_counters = any(d.consumes_counters for d in sorted_cands)
+        cl.max_weight = max((d.weight for d in sorted_cands), default=0)
+        return cl
+
+
 class DeviceCatalog:
     """All published devices + per-pool shared-counter capacity."""
 
@@ -93,28 +222,27 @@ class DeviceCatalog:
         # (driver, pool, counterSet) -> {counter: int remaining}
         self.counters: Dict[Tuple[str, str, str], Dict[str, int]] = {}
         for s in slices:
-            spec = s.get("spec", {})
-            driver = spec.get("driver", "")
-            pool = spec.get("pool", {}).get("name", "")
-            node = spec.get("nodeName")
-            for cs in spec.get("sharedCounters", []) or []:
-                k = (driver, pool, cs.get("name", ""))
-                self.counters[k] = {
-                    name: int(c.get("value", 0))
-                    for name, c in (cs.get("counters") or {}).items()
-                }
-            for dev in spec.get("devices", []) or []:
-                basic = dev.get("basic", dev)
-                self.devices.append(Candidate(
-                    driver=driver,
-                    pool=pool,
-                    node_name=node,
-                    name=dev.get("name", ""),
-                    attributes=basic.get("attributes", {}) or {},
-                    capacity=basic.get("capacity", {}) or {},
-                    consumes_counters=basic.get("consumesCounters", []) or [],
-                ))
+            self.devices.extend(parse_slice_devices(s))
+            self.counters.update(parse_slice_counters(s))
         self.by_key = {c.key(): c for c in self.devices}
+        # Per-pool aggregate counter capacity: the ledger's pool
+        # fullness arithmetic and the fragmentation score read these.
+        self.pool_totals: Dict[Tuple[str, str], int] = {}
+        for k, v in self.counters.items():
+            pk = (k[0], k[1])
+            self.pool_totals[pk] = (
+                self.pool_totals.get(pk, 0) + sum(v.values())
+            )
+        # Counter-consuming peers per pool, built once per catalog (the
+        # packing score would otherwise rescan the catalog on every
+        # backtrack descent). No in-use filtering needed: an allocated
+        # device's counters are consumed in the ledger, so
+        # can_consume() already scores it infeasible.
+        peers: Dict[Tuple[str, str], List[Candidate]] = {}
+        for c in self.devices:
+            if c.consumes_counters:
+                peers.setdefault((c.driver, c.pool), []).append(c)
+        self.peers_by_pool = {k: tuple(v) for k, v in peers.items()}
 
 
 @dataclass
@@ -124,17 +252,32 @@ class AllocationResult:
 
 
 class _CounterLedger:
-    """Mutable remaining-capacity view with tentative consumption."""
+    """Remaining-capacity view with tentative consumption.
+
+    Copy-on-write over the catalog's counter capacity: building a
+    ledger is O(1) and only counter sets actually touched by a solve
+    (or by the allocated-claims replay) are copied — at fleet scale
+    the old eager deep-copy of every pool's counters dominated
+    per-claim allocator construction. Per-pool aggregates (used units,
+    partially-used set) are maintained on the same writes; the packed
+    candidate order reads them to visit fullest-first and to skip
+    exhausted pools in O(1)."""
 
     def __init__(self, catalog: DeviceCatalog):
-        self.remaining = {
-            k: dict(v) for k, v in catalog.counters.items()
-        }
+        self._base = catalog.counters  # read-only; never mutated here
+        self._touched: Dict[Tuple[str, str, str], Dict[str, int]] = {}
+        self._pool_total = getattr(catalog, "pool_totals", {})
+        self._pool_used: Dict[Tuple[str, str], int] = {}
+        # Insertion-ordered set of pools with 0 < used < total: the
+        # candidates the packing order visits first.
+        self._partial: Dict[Tuple[str, str], None] = {}
 
     def can_consume(self, dev: Candidate) -> bool:
         for entry in dev.consumes_counters:
             k = (dev.driver, dev.pool, entry.get("counterSet", ""))
-            have = self.remaining.get(k)
+            have = self._touched.get(k)
+            if have is None:
+                have = self._base.get(k)
             if have is None:
                 return False  # consumes a set the pool never advertised
             for name, c in (entry.get("counters") or {}).items():
@@ -143,11 +286,117 @@ class _CounterLedger:
         return True
 
     def consume(self, dev: Candidate, sign: int = 1) -> None:
+        moved = 0
         for entry in dev.consumes_counters:
             k = (dev.driver, dev.pool, entry.get("counterSet", ""))
-            have = self.remaining.setdefault(k, {})
+            have = self._touched.get(k)
+            if have is None:
+                have = dict(self._base.get(k) or {})
+                self._touched[k] = have
             for name, c in (entry.get("counters") or {}).items():
-                have[name] = have.get(name, 0) - sign * int(c.get("value", 0))
+                v = int(c.get("value", 0))
+                have[name] = have.get(name, 0) - sign * v
+                moved += v
+        if moved:
+            pk = (dev.driver, dev.pool)
+            used = self._pool_used.get(pk, 0) + sign * moved
+            self._pool_used[pk] = used
+            if 0 < used < self._pool_total.get(pk, 0):
+                self._partial[pk] = None
+            else:
+                self._partial.pop(pk, None)
+
+    # --- pool aggregates (packed-order inputs) ---
+
+    def pool_used(self, pk: Tuple[str, str]) -> int:
+        return self._pool_used.get(pk, 0)
+
+    def pool_free(self, pk: Tuple[str, str]) -> int:
+        return self._pool_total.get(pk, 0) - self._pool_used.get(pk, 0)
+
+    def pool_exhausted(self, pk: Tuple[str, str]) -> bool:
+        total = self._pool_total.get(pk, 0)
+        return total > 0 and self._pool_used.get(pk, 0) >= total
+
+    def partial_pools(self) -> List[Tuple[str, str]]:
+        return list(self._partial)
+
+
+class _PackedOrder:
+    """Lazily-materialized candidate order for one ``_pick``.
+
+    Pool-level order: partially-used pools first (fullest first — fill
+    holes before opening fresh nodes, the bin-packing move that keeps
+    whole nodes free for large shapes), then untouched pools in
+    (pool, name) catalog order, then counter-exhausted pools last
+    (still present: ordering must never drop candidates — exactness).
+    A bucket's candidates are frag-scored only when the scan actually
+    reaches that pool, so a feasible claim pays for the pools it
+    looked at, not for the fleet.
+
+    The order is frozen per ``_pick`` entry in spirit but materialized
+    lazily, so deep backtracks see buckets scored against the ledger
+    state at materialization time — same caveat as the previous
+    least-constraining order: correctness is preserved (``can_take``
+    re-checks the live ledger), only heuristic quality degrades, and
+    the result stays deterministic for identical inputs."""
+
+    __slots__ = (
+        "_alloc", "_mat", "_n", "_by_pool", "_active", "_active_set",
+        "_ai", "_static", "_static_done", "_tail", "_ti",
+    )
+
+    def __init__(self, alloc: "Allocator", cl: CandidateList):
+        self._alloc = alloc
+        self._mat: List[Candidate] = []
+        self._n = len(cl)
+        self._by_pool = dict(cl.buckets)
+        ledger = alloc.ledger
+        active = []
+        for pk in ledger.partial_pools():
+            if pk in self._by_pool:
+                active.append((-ledger.pool_used(pk), pk))
+        active.sort()
+        self._active = [pk for _, pk in active]
+        self._active_set = frozenset(self._active)
+        self._ai = 0
+        self._static = iter(cl.buckets)
+        self._static_done = False
+        self._tail: List[Tuple[Tuple[str, str], tuple]] = []
+        self._ti = 0
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, j: int) -> Candidate:
+        while j >= len(self._mat):
+            self._materialize_next()
+        return self._mat[j]
+
+    def _materialize_next(self) -> None:
+        if self._ai < len(self._active):
+            pk = self._active[self._ai]
+            self._ai += 1
+            self._mat.extend(self._alloc._frag_sorted(
+                pk, self._by_pool[pk]
+            ))
+            return
+        if not self._static_done:
+            for pk, devs in self._static:
+                if pk in self._active_set:
+                    continue
+                if self._alloc.ledger.pool_exhausted(pk):
+                    self._tail.append((pk, devs))
+                    continue
+                self._mat.extend(self._alloc._frag_sorted(pk, devs))
+                return
+            self._static_done = True
+        if self._ti < len(self._tail):
+            pk, devs = self._tail[self._ti]
+            self._ti += 1
+            self._mat.extend(devs)  # exhausted: scoring is pointless
+            return
+        raise IndexError("candidate order exhausted")
 
 
 class Allocator:
@@ -156,28 +405,44 @@ class Allocator:
     Build it fresh per scheduling attempt (stateless, like the
     scheduler's snapshot): existing allocations are replayed into the
     ledger so released claims free their devices automatically on the
-    next snapshot.
-    """
+    next snapshot. With ``index`` attached the catalog and candidate
+    sets come from the persistent :class:`SliceIndex` (O(1) when the
+    fleet is unchanged); without it, ``slices`` are re-scanned — the
+    legacy path, kept callable as the bench baseline and parity
+    oracle. ``ordering`` picks the candidate order: ``"packed"``
+    (default, fragmentation-aware) or ``"catalog"`` (plain first-fit;
+    the oracle)."""
 
     def __init__(
         self,
         classes: List[dict],
-        slices: List[dict],
-        allocated_claims: List[dict],
+        slices: Optional[List[dict]] = None,
+        allocated_claims: Optional[List[dict]] = None,
+        *,
+        index=None,
+        ordering: str = "packed",
     ):
+        if ordering not in ("packed", "catalog"):
+            raise ValueError(f"unknown ordering {ordering!r}")
         self.classes = {
             c["metadata"]["name"]: c for c in classes
         }
-        self.catalog = DeviceCatalog(slices)
+        self.index = index
+        self.ordering = ordering
+        if index is not None:
+            self.catalog = index.catalog()
+        else:
+            self.catalog = DeviceCatalog(slices or [])
         self.ledger = _CounterLedger(self.catalog)
         self.in_use: set = set()
         # Node usage of the CURRENT partial solve (node name -> devices
         # taken): lets _pick prune a second node at candidate-selection
         # time — leaving the single-node invariant to the leaf check
         # alone would enumerate ~C(n, k) doomed cross-node subsets on a
-        # fleet-sized catalog before concluding Unschedulable.
+        # fleet-sized catalog before concluding Unschedulable. Reset at
+        # every allocate() entry (see there).
         self._solve_nodes: Dict[str, int] = {}
-        for claim in allocated_claims:
+        for claim in allocated_claims or []:
             alloc = (claim.get("status") or {}).get("allocation")
             if not alloc:
                 continue
@@ -192,45 +457,12 @@ class Allocator:
                 dev = self.catalog.by_key.get(key)
                 if dev is not None:
                     self.ledger.consume(dev)
-        # Counter-consuming peers per pool, built ONCE per snapshot (the
-        # scoring pass would otherwise rescan the catalog on every
-        # backtrack descent). Devices taken later in this allocation are
-        # excluded implicitly: their counters are consumed, so
-        # ledger.can_consume already scores them infeasible.
-        self._peers_by_pool: Dict[Tuple[str, str], List[Candidate]] = {}
-        for d in self.catalog.devices:
-            if d.consumes_counters and d.key() not in self.in_use:
-                self._peers_by_pool.setdefault(
-                    (d.driver, d.pool), []
-                ).append(d)
 
     # --- selector evaluation ---
 
-    @staticmethod
-    def _selectors_match(
-        selectors: List[dict], dev: Candidate, reasons: List[str], who: str
-    ) -> bool:
-        env = dev.cel_env()
-        for sel in selectors or []:
-            expr = (sel.get("cel") or {}).get("expression", "")
-            if not expr:
-                continue
-            try:
-                ok = compile_expr(expr).evaluate(env)
-            except CelError as e:
-                # k8s: a runtime CEL error fails the device, surfaced in
-                # the scheduling event — never silently matches.
-                reasons.append(
-                    f"device {dev.name}: {who} selector error: {e}"
-                )
-                return False
-            if ok is not True:
-                return False
-        return True
-
     def _class_devices(
         self, request: dict, reasons: List[str]
-    ) -> List[Candidate]:
+    ) -> CandidateList:
         class_name = request.get("deviceClassName", "")
         dc = self.classes.get(class_name)
         if dc is None:
@@ -238,23 +470,57 @@ class Allocator:
                 f"request {request.get('name', '?')!r}: DeviceClass "
                 f"{class_name!r} does not exist"
             )
+        class_sel = dc.get("spec", {}).get("selectors", []) or []
+        req_sel = request.get("selectors", []) or []
+        req_name = request.get("name", "?")
+        if self.index is not None:
+            cl = self.index.candidates(
+                class_name, class_sel, req_name, req_sel
+            )
+            # Snapshot consistency: candidates() serves the index's
+            # LIVE generation, but this allocator's catalog/ledger are
+            # pinned at construction. If the fleet mutated mid-solve,
+            # restrict to devices the pinned catalog knows — a
+            # just-published device must not be handed out against a
+            # ledger that has no capacity entry for it. (Capacity that
+            # VANISHED is harmless here: its candidates simply stop
+            # appearing, and newly-missing counter sets already fail
+            # can_consume.) The claim retries against the next
+            # snapshot either way.
+            pinned = getattr(self.catalog, "generation", None)
+            if pinned is not None and self.index.generation != pinned:
+                # Map back to the PINNED catalog's objects, not just
+                # its keys: a slice MODIFIED mid-solve re-publishes a
+                # same-named device whose counter demands may differ,
+                # and charging the live definition against the pinned
+                # ledger could double-assign chips.
+                cl = CandidateList.build(
+                    [
+                        self.catalog.by_key[d.key()]
+                        for d in cl
+                        if d.key() in self.catalog.by_key
+                    ],
+                    cl.reasons,
+                )
+            reasons.extend(cl.reasons)
+            return cl
         out = []
+        local: List[str] = []
         for dev in self.catalog.devices:
-            if not self._selectors_match(
-                dc.get("spec", {}).get("selectors", []), dev, reasons,
-                f"class {class_name}",
+            if not selectors_match(
+                class_sel, dev, local, f"class {class_name}"
             ):
                 continue
-            if not self._selectors_match(
-                request.get("selectors", []), dev, reasons,
-                f"request {request.get('name', '?')}",
+            if not selectors_match(
+                req_sel, dev, local, f"request {req_name}"
             ):
                 continue
             out.append(dev)
+        reasons.extend(local)
         # Deterministic order: pool then name (the reference's allocator
         # is deterministic over its snapshot too).
         out.sort(key=lambda d: (d.pool, d.name))
-        return out
+        return CandidateList.build(out, local)
 
     # --- constraints ---
 
@@ -330,6 +596,13 @@ class Allocator:
     def allocate(self, claim: dict) -> AllocationResult:
         """Compute (without persisting) the allocation for ``claim``.
         Raises :class:`Unschedulable` with the collected reasons."""
+        # A fresh solve must not inherit the previous claim's node pin:
+        # a successful solve leaves its takes in place (that is how
+        # sequential allocate() calls model exclusivity), but the node
+        # map is per-SOLVE state — carrying it over silently pinned
+        # every later claim on a shared instance (the batch path) to
+        # the first claim's node.
+        self._solve_nodes = {}
         spec = claim.get("spec", {})
         requests = (spec.get("devices") or {}).get("requests", []) or []
         if not requests:
@@ -370,6 +643,87 @@ class Allocator:
             reasons=reasons,
         )
 
+    def batch_order(self, claims: List[dict]) -> List[int]:
+        """The order ``allocate_batch`` solves ``claims`` in, as indices
+        into the input list: largest estimated footprint first
+        (ParvaGPU-style — big partitions placed before a burst of small
+        ones can splinter the grid), namespace/name tiebreak, so batch
+        results are deterministic. Exposed separately so the allocator
+        bench can replay the exact batch order while timing each
+        claim's allocate individually."""
+
+        def est(i: int):
+            spec = claims[i].get("spec", {})
+            total = 0
+            reqs = (spec.get("devices") or {}).get("requests", []) or []
+            for req in reqs:
+                expanded = self._expand_request(req)
+                if not expanded:
+                    continue
+                # First alternative = the preferred shape.
+                _, sub = expanded[0]
+                try:
+                    cl = self._class_devices(sub, [])
+                except Unschedulable:
+                    continue  # fails properly during its own solve
+                w = getattr(cl, "max_weight", 1) or 1
+                if sub.get("allocationMode", "ExactCount") == "All":
+                    n = len(cl)
+                else:
+                    n = int(sub.get("count", 1) or 1)
+                total += n * w
+            md = claims[i].get("metadata", {})
+            return (
+                -total, md.get("namespace") or "", md.get("name") or "", i
+            )
+
+        return sorted(range(len(claims)), key=est)
+
+    def allocate_batch(self, claims: List[dict]) -> List[object]:
+        """Allocate a pending set against this one shared snapshot:
+        index lookups, catalog, and ledger are amortized across the
+        batch, solved in :meth:`batch_order`. Returns one entry per
+        input claim, in input order: :class:`AllocationResult` on
+        success, the :class:`Unschedulable` exception otherwise."""
+        results: List[object] = [None] * len(claims)
+        for i in self.batch_order(claims):
+            try:
+                results[i] = self.allocate(claims[i])
+            except Unschedulable as e:
+                results[i] = e
+        return results
+
+    def fragmentation(self) -> dict:
+        """Fleet fragmentation of the chip grid under the current
+        ledger: per pool, the largest advertised placement still
+        feasible, summed, over the free counter units. 0.0 = every
+        free chip is reachable through the biggest shape its pool
+        advertises; 1.0 = free capacity exists but no placement can
+        use it (fully stranded)."""
+        free_total = 0
+        achievable = 0
+        for pk, peers in self.catalog.peers_by_pool.items():
+            free = self.ledger.pool_free(pk)
+            if free <= 0:
+                continue
+            free_total += free
+            best = 0
+            for c in peers:
+                if (
+                    c.weight > best
+                    and c.key() not in self.in_use
+                    and self.ledger.can_consume(c)
+                ):
+                    best = c.weight
+            achievable += best
+        util = (achievable / free_total) if free_total else 1.0
+        return {
+            "free_chips": free_total,
+            "achievable_chips": achievable,
+            "achievable_util": round(util, 4),
+            "frag_score": round(1.0 - util, 4),
+        }
+
     def _solve(self, per_request, i, chosen, claim_spec) -> bool:
         """Backtracking over candidate subsets, counters consumed
         tentatively; constraints checked at the leaf (claim-level
@@ -385,49 +739,54 @@ class Allocator:
                 return True
         return False
 
-    def _least_constraining(self, cands):
-        """Topology-aware placement order (TPU-native improvement over
-        first-fit): among counter-consuming placements (sub-slices on a
-        chip mesh), prefer the candidate whose tentative consumption
-        leaves the most OTHER advertised placements feasible, weighted
-        by their size in chips. Catalog order corner-packs, but an
-        earlier small claim can split the mesh so no large contiguous
-        shape survives (e.g. two 1x1s landing in different rows of a
-        2x2 kill both 1x2 rows); least-constraining keeps the big
-        placements alive. Ties keep catalog (origin-sorted) order, so
-        behavior is unchanged wherever scores are equal. Non-counter
-        devices (full chips, CD channels) are returned as-is.
-
-        Known limitation: scores are frozen at _pick entry, but the
-        ledger evolves as backtracking consumes candidates WITHIN the
-        request, so deep backtracks explore a stale order. Correctness
-        is preserved (can_take re-checks the live ledger); only the
-        heuristic's quality degrades for multi-device requests."""
-        if len(cands) < 2 or not any(c.consumes_counters for c in cands):
+    def _order_candidates(self, cands, admin: bool):
+        """Candidate order for one _pick (docs/scheduling.md): packed
+        pool-streaming order with in-pool frag scoring, unless the
+        claim is an observer (adminAccess — placement is irrelevant),
+        the ordering mode is the catalog oracle, or no candidate
+        participates in the counter system (full-host devices and CD
+        channels: catalog order, exactly the pre-index behavior)."""
+        if (
+            admin
+            or self.ordering != "packed"
+            or len(cands) < 2
+            or not isinstance(cands, CandidateList)
+            or not cands.has_counters
+        ):
             return cands
+        return _PackedOrder(self, cands)
 
-        def weight(d):
-            return sum(
-                int(c.get("value", 0))
-                for e in d.consumes_counters
-                for c in (e.get("counters") or {}).values()
-            )
+    def _frag_sorted(self, pk, devs):
+        """Fragmentation-aware order within one pool: prefer the
+        placement whose tentative consumption (a) keeps the largest
+        advertised placement feasible and (b) keeps the most total
+        placement weight feasible — the ParvaGPU packing objective on
+        the TPU chip grid (an earlier 1x1 landing in the wrong row of
+        a 2x2 mesh kills both 1x2 rows). Infeasible candidates score
+        lowest. Stable sort: ties keep (pool, name) catalog order, so
+        the result is deterministic."""
+        if len(devs) < 2 or not any(d.consumes_counters for d in devs):
+            return devs
+        peers = self.catalog.peers_by_pool.get(pk, ())
+        ledger = self.ledger
 
         def score(dev):
-            if not self.ledger.can_consume(dev):
-                return float("-inf")
-            peers = self._peers_by_pool.get((dev.driver, dev.pool), ())
-            self.ledger.consume(dev)
-            s = sum(
-                weight(o)
-                for o in peers
-                if o.key() != dev.key() and self.ledger.can_consume(o)
-            )
-            self.ledger.consume(dev, sign=-1)
-            return s
+            if not ledger.can_consume(dev):
+                return (-1, -1)
+            ledger.consume(dev)
+            best = 0
+            total = 0
+            for o in peers:
+                if o.key() != dev.key() and ledger.can_consume(o):
+                    w = o.weight
+                    total += w
+                    if w > best:
+                        best = w
+            ledger.consume(dev, sign=-1)
+            return (best, total)
 
-        scores = {c.key(): score(c) for c in cands}
-        return sorted(cands, key=lambda c: -scores[c.key()])
+        scores = {d.key(): score(d) for d in devs}
+        return sorted(devs, key=lambda d: scores[d.key()], reverse=True)
 
     def _pick(self, req, name, admin, cands, count, start, acc,
               per_request, i, chosen, claim_spec) -> bool:
@@ -439,7 +798,7 @@ class Allocator:
         (found by the bats chan-inject suite). Cross-REQUEST recursion
         via _solve stays (requests are few)."""
         del start, acc  # kept for signature stability; stack-managed now
-        cands = self._least_constraining(cands)
+        cands = self._order_candidates(cands, admin)
 
         def can_take(dev) -> bool:
             if admin:

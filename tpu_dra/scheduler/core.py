@@ -3,11 +3,23 @@
 kube-scheduler allocates claims while binding pods; with no pods to bind
 in the cluster-less stacks, this controller allocates on the claim
 itself: every pending ResourceClaim (no ``status.allocation``) is run
-through :class:`~tpu_dra.scheduler.allocator.Allocator` against a fresh
-snapshot of DeviceClasses + ResourceSlices + allocated claims, and the
-winning allocation is written to ``status.allocation``. Unschedulable
-claims get a core/v1 Event (kube-scheduler's pod-event analog) and are
-retried with backoff — new slices or released claims unblock them.
+through :class:`~tpu_dra.scheduler.allocator.Allocator` and the winning
+allocation is written to ``status.allocation``. Unschedulable claims
+get a core/v1 Event (kube-scheduler's pod-event analog) and are
+retried — new slices or released claims unblock them.
+
+Fleet-scale shape (docs/scheduling.md): the controller owns ONE
+persistent :class:`~tpu_dra.scheduler.index.SliceIndex`, updated
+incrementally from slice informer events (and resynced from the
+informer store each sweep as the missed-event backstop), so building a
+per-attempt allocator no longer re-scans the fleet. Capacity changes
+and the periodic sweep funnel into a single BATCH reconcile item
+(key ``__batch__`` on the same workqueue, so allocation stays
+serialized): all pending claims are solved against one shared
+snapshot/ledger via ``allocate_batch`` — sorted largest-first — which
+amortizes index lookups and constraint checks and lets packing see the
+whole pending set. Individual claim events still take the low-latency
+single-claim path.
 
 Deallocation is implicit and stateless: usage is recomputed from live
 claims each attempt, so a deleted/released claim frees its devices and
@@ -35,8 +47,13 @@ from tpu_dra.k8sclient import (
     ResourceClient,
 )
 from tpu_dra.scheduler.allocator import Allocator, Unschedulable
+from tpu_dra.scheduler.index import SliceIndex
 
 log = logging.getLogger(__name__)
+
+# Workqueue key for the batch reconcile item: every capacity change and
+# sweep collapses onto it, so a relist storm enqueues ONE batch solve.
+BATCH_KEY = "__batch__"
 
 
 class SchedulerCore:
@@ -63,6 +80,10 @@ class SchedulerCore:
             backend, DEVICE_CLASSES, metrics=self.metrics
         )
         self.retry_unschedulable_after = retry_unschedulable_after
+        # Persistent candidate index: slice events keep it current;
+        # the sweep resyncs it from the informer store (backstop for
+        # events missed while not leading).
+        self.index = SliceIndex(metrics=self.metrics)
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         # Event dedup (kube-scheduler's EventRecorder aggregates; we
@@ -78,7 +99,8 @@ class SchedulerCore:
         self.claim_informer.add_handler(self._on_claim_event)
         # New capacity or classes can unblock Unschedulable claims — the
         # DynamicResources plugin re-queues pods on these events too.
-        self.slice_informer.add_handler(self._on_capacity_event)
+        # Slice events additionally feed the persistent index.
+        self.slice_informer.add_handler(self._on_slice_event)
         self.class_informer.add_handler(self._on_capacity_event)
         for inf in (
             self.claim_informer, self.slice_informer, self.class_informer
@@ -121,37 +143,67 @@ class SchedulerCore:
         if not (claim.get("status") or {}).get("allocation"):
             self.queue.enqueue(claim, self._reconcile, key=self._key(claim))
 
+    def _on_slice_event(self, event: str, obj: dict) -> None:
+        self.index.on_slice_event(event, obj)
+        self._on_capacity_event(event, obj)
+
     def _on_capacity_event(self, event: str, obj: dict) -> None:
-        for claim in self.claim_informer.list():
-            if not (claim.get("status") or {}).get("allocation"):
-                self.queue.enqueue(
-                    claim, self._reconcile, key=self._key(claim)
-                )
+        # One batch item per capacity change, not one item per pending
+        # claim: a publish storm over a 5k-node fleet used to fan out
+        # |pending| x |events| reconciles; now it coalesces into the
+        # next batch solve (the workqueue dedups on BATCH_KEY).
+        self.queue.enqueue(None, self._reconcile_batch, key=BATCH_KEY)
 
     def _periodic_sweep(self) -> None:
         """Backstop for Unschedulable claims waiting on capacity that
         arrives without an observable event (and for anything dropped
-        while this scheduler wasn't leading)."""
+        while this scheduler wasn't leading). Also resyncs the slice
+        index against the informer store and refreshes the fleet
+        fragmentation gauge."""
         while not self._stop.wait(self.retry_unschedulable_after):
             try:
-                pending = 0
-                for claim in self.claims.list():
-                    if not (claim.get("status") or {}).get("allocation"):
-                        pending += 1
-                        self.queue.enqueue(
-                            claim, self._reconcile, key=self._key(claim)
-                        )
+                # Resync only from a SYNCED store: pre-sync list() is
+                # empty, and reconciling against it would wipe the
+                # event-populated index until the next sweep.
+                if self.slice_informer.wait_for_sync(timeout=0):
+                    self.index.resync(self.slice_informer.list())
+                snapshot = self.claims.list()
+                pending = sum(
+                    1 for claim in snapshot
+                    if not (claim.get("status") or {}).get("allocation")
+                )
+                if pending:
+                    self.queue.enqueue(
+                        None, self._reconcile_batch, key=BATCH_KEY
+                    )
                 self.metrics.set_gauge("scheduler_pending_claims", pending)
+                self._update_frag_gauge(self._snapshot_allocator(snapshot))
             except Exception:
                 log.exception("scheduler periodic sweep failed")
 
     # --- allocation ---
 
-    def _snapshot_allocator(self) -> Allocator:
+    def _snapshot_allocator(
+        self, claims_snapshot: Optional[List[dict]] = None
+    ) -> Allocator:
+        """Allocator over the current index + allocated-claims replay.
+        Callers that already hold a claims listing pass it in — the
+        batch path and sweep must build the pending set and the replay
+        from ONE listing, or a claim allocated between two back-to-back
+        LISTs shows up in both and double-consumes its capacity."""
+        if claims_snapshot is None:
+            claims_snapshot = self.claims.list()
         return Allocator(
             classes=self.class_informer.list(),
-            slices=self.slice_informer.list(),
-            allocated_claims=self.claims.list(),
+            allocated_claims=claims_snapshot,
+            index=self.index,
+        )
+
+    def _update_frag_gauge(self, alloc: Allocator) -> None:
+        frag = alloc.fragmentation()
+        self.metrics.set_gauge("scheduler_frag_score", frag["frag_score"])
+        self.metrics.set_gauge(
+            "scheduler_free_chips", frag["free_chips"]
         )
 
     def _reconcile(self, claim_snapshot: dict) -> None:
@@ -168,34 +220,85 @@ class SchedulerCore:
         try:
             result = self._snapshot_allocator().allocate(claim)
         except Unschedulable as e:
-            self.metrics.inc("scheduler_unschedulable_total")
-            # Every retry/sweep re-attempts allocation, so an event per
-            # attempt would accumulate ~2/s per stuck claim forever;
-            # emit only when the reason CHANGES (recorder aggregation).
-            with self._unsched_lock:
-                changed = self._last_unsched.get(key) != str(e)
-                if changed:
-                    self._last_unsched[key] = str(e)
-            if changed:
-                self._emit_event(claim, "Unschedulable", str(e))
-                log.info(
-                    "claim %s/%s unschedulable: %s",
-                    md.get("namespace"), md["name"], e,
-                )
+            self._note_unschedulable(claim, e)
             # Raise so the workqueue retries with backoff — capacity
             # changes also re-enqueue via the capacity handlers.
             raise
+        if self._commit(claim, result):
+            self.metrics.observe(
+                "scheduler_allocate_seconds", time.monotonic() - t0
+            )
+
+    def _reconcile_batch(self, _obj) -> None:
+        """Solve every pending claim against ONE shared snapshot —
+        the index-amortized batch path (see module doc). Pending set
+        and allocated-claims replay come from the same listing (see
+        _snapshot_allocator)."""
+        snapshot = self.claims.list()
+        pending = [
+            c for c in snapshot
+            if not (c.get("status") or {}).get("allocation")
+            and not c["metadata"].get("deletionTimestamp")
+        ]
+        if not pending:
+            return
+        t0 = time.monotonic()
+        alloc = self._snapshot_allocator(snapshot)
+        results = alloc.allocate_batch(pending)
+        allocated = 0
+        unschedulable = 0
+        for claim, res in zip(pending, results):
+            if isinstance(res, Unschedulable):
+                unschedulable += 1
+                self._note_unschedulable(claim, res)
+            elif self._commit(claim, res):
+                allocated += 1
+        self.metrics.inc("scheduler_batch_total")
+        self.metrics.observe(
+            "scheduler_allocate_batch_seconds", time.monotonic() - t0
+        )
+        self._update_frag_gauge(alloc)
+        log.info(
+            "batch allocation: %d pending -> %d allocated, "
+            "%d unschedulable in %.3fs",
+            len(pending), allocated, unschedulable,
+            time.monotonic() - t0,
+        )
+        # No raise on partial failure: Unschedulable claims are
+        # retried by the sweep and by capacity events (each enqueues
+        # this batch item again) — per-claim backoff would serialize
+        # the whole batch behind the stuck stragglers.
+
+    def _note_unschedulable(self, claim: dict, e: Unschedulable) -> None:
+        md = claim["metadata"]
+        key = self._key(claim)
+        self.metrics.inc("scheduler_unschedulable_total")
+        # Every retry/sweep re-attempts allocation, so an event per
+        # attempt would accumulate ~2/s per stuck claim forever;
+        # emit only when the reason CHANGES (recorder aggregation).
+        with self._unsched_lock:
+            changed = self._last_unsched.get(key) != str(e)
+            if changed:
+                self._last_unsched[key] = str(e)
+        if changed:
+            self._emit_event(claim, "Unschedulable", str(e))
+            log.info(
+                "claim %s/%s unschedulable: %s",
+                md.get("namespace"), md["name"], e,
+            )
+
+    def _commit(self, claim: dict, result) -> bool:
+        """Write status.allocation; True when it stuck."""
+        md = claim["metadata"]
+        key = self._key(claim)
         claim.setdefault("status", {})["allocation"] = result.allocation
         try:
             self.claims.update_status(claim)
         except (ApiConflict, ApiNotFound):
-            return  # changed underneath us; the claim event re-enqueues
+            return False  # changed underneath us; claim event re-enqueues
         with self._unsched_lock:
             self._last_unsched.pop(key, None)
         self.metrics.inc("scheduler_allocations_total")
-        self.metrics.observe(
-            "scheduler_allocate_seconds", time.monotonic() - t0
-        )
         devices = [
             r["device"] for r in result.allocation["devices"]["results"]
         ]
@@ -206,6 +309,7 @@ class SchedulerCore:
             "allocated claim %s/%s -> %s",
             md.get("namespace"), md["name"], devices,
         )
+        return True
 
     def _emit_event(self, claim: dict, reason: str, message: str) -> None:
         md = claim["metadata"]

@@ -1,0 +1,396 @@
+"""Incremental candidate indexes over published ResourceSlices.
+
+The per-claim path in :mod:`.allocator` historically rebuilt its
+``DeviceCatalog`` — and re-ran every DeviceClass/request CEL selector
+over every published device — from scratch on each allocation attempt.
+Correct, but O(fleet) per claim: at 5k nodes that is ~55k selector
+evaluations before the solver even starts, repeated for every pending
+claim (measured: the re-scan dominates allocate latency ~50:1 at fleet
+scale; see docs/scheduling.md for the bench methodology).
+
+:class:`SliceIndex` is the persistent fix. It is owned by the
+scheduler core, updated on every slice publish/modify/delete event the
+informer delivers, and consumed by the allocator:
+
+- **Parsed-slice store**: each ResourceSlice is parsed once into
+  :class:`~tpu_dra.scheduler.allocator.Candidate` objects + shared
+  counter capacity, keyed by slice name, with a content-version token
+  so replays and resyncs skip unchanged slices.
+- **Fingerprint candidate cache**: the CEL match result of a
+  (DeviceClass selectors + request selectors) combination is cached
+  per slice. Selector evaluation happens only for slices whose
+  content changed since the cached verdict — allocating claim N+1
+  against an unchanged fleet runs **zero** CEL.
+- **Merged views built lazily**: the flat candidate list, the
+  per-pool candidate buckets the packing order consumes, and the
+  merged :class:`IndexCatalog` (devices, counters, per-pool totals,
+  counter-consuming peers) are (re)built at most once per index
+  generation, on first read after a mutation — a publish storm costs
+  nothing until the next allocation actually looks.
+
+Invalidation rules (also documented in docs/scheduling.md):
+
+- slice ADDED/MODIFIED → reparse that slice, bump the generation;
+- slice DELETED → drop the slice, bump the generation;
+- a generation bump invalidates every merged view; per-slice CEL
+  verdicts stay valid for slices whose version token is unchanged;
+- :meth:`resync` reconciles against a full informer listing (the
+  periodic-sweep backstop for missed events) using the same tokens.
+
+Staleness is observable: ``slices_seen`` counts slices the index was
+told about, ``slices_indexed`` those successfully parsed; a slice that
+fails to parse is counted seen-but-not-indexed and surfaces through
+the ``scheduler_index_slices_{seen,indexed}`` gauges the doctor WARNs
+on (the allocator then simply cannot place onto that slice).
+
+Thread-safety: every public method takes the single ``_lock``; readers
+receive immutable tuples / freshly-assembled dicts, and a catalog
+handed to an allocator is never mutated afterwards (mutations assemble
+new merged views). :meth:`candidates` always serves the LIVE
+generation, so a solve whose catalog was pinned before a mid-solve
+fleet mutation could otherwise see devices its ledger has no capacity
+entries for — the allocator detects the generation divergence (the
+catalog records the generation it was built at) and restricts such
+candidate lists to its pinned snapshot; the affected claim simply
+retries against the next snapshot.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from tpu_dra.scheduler.allocator import (
+    Candidate,
+    CandidateList,
+    parse_slice_counters,
+    parse_slice_devices,
+    selectors_match,
+)
+
+log = logging.getLogger(__name__)
+
+# Fingerprint cache bound: distinct (class, selectors, request-name)
+# combinations are few in practice (one per DeviceClass x request
+# shape); the cap only guards against a pathological claim generator
+# minting unique selector strings. Oldest entry is evicted first.
+MAX_FINGERPRINTS = 128
+
+
+class IndexCatalog:
+    """Immutable merged catalog view (DeviceCatalog duck type).
+
+    Built by :meth:`SliceIndex.catalog` at most once per generation;
+    allocators hold it for the duration of a solve. ``counters`` is a
+    fresh dict per build so a copy-on-write ledger's base view cannot
+    shift underneath a running solve.
+    """
+
+    def __init__(
+        self,
+        devices: Tuple[Candidate, ...],
+        counters: Dict[Tuple[str, str, str], Dict[str, int]],
+        pool_totals: Dict[Tuple[str, str], int],
+        peers_by_pool: Dict[Tuple[str, str], Tuple[Candidate, ...]],
+        generation: int = -1,
+    ):
+        self.devices = devices
+        self.counters = counters
+        self.pool_totals = pool_totals
+        self.peers_by_pool = peers_by_pool
+        self.by_key = {c.key(): c for c in devices}
+        # The index generation this view was built at: the allocator
+        # compares it against the live generation to detect a fleet
+        # mutation mid-solve (see Allocator._class_devices).
+        self.generation = generation
+
+
+class _ParsedSlice:
+    """One ResourceSlice, parsed once."""
+
+    def __init__(self, name: str, version: str, obj: dict):
+        self.name = name
+        self.version = version
+        self.devices: List[Candidate] = parse_slice_devices(obj)
+        self.counters = parse_slice_counters(obj)
+
+
+class _Fingerprint:
+    """Cached CEL verdicts for one (class + request selectors) combo."""
+
+    def __init__(self, class_sel: List[dict], req_sel: List[dict],
+                 class_who: str, req_who: str):
+        self.class_sel = class_sel
+        self.req_sel = req_sel
+        self.class_who = class_who
+        self.req_who = req_who
+        # slice name -> (version token, matched candidates, reasons)
+        self.per_slice: Dict[
+            str, Tuple[str, Tuple[Candidate, ...], Tuple[str, ...]]
+        ] = {}
+        self.merged_gen = -1
+        self.merged: Optional[CandidateList] = None
+
+    def match_slice(self, ps: _ParsedSlice) -> None:
+        """(Re)evaluate the selectors over one slice's devices; cached
+        until the slice's version token changes."""
+        cached = self.per_slice.get(ps.name)
+        if cached is not None and cached[0] == ps.version:
+            return
+        matched: List[Candidate] = []
+        reasons: List[str] = []
+        for dev in ps.devices:
+            if not selectors_match(
+                self.class_sel, dev, reasons, self.class_who
+            ):
+                continue
+            if not selectors_match(
+                self.req_sel, dev, reasons, self.req_who
+            ):
+                continue
+            matched.append(dev)
+        self.per_slice[ps.name] = (
+            ps.version, tuple(matched), tuple(reasons)
+        )
+
+
+def _slice_version(obj: dict) -> str:
+    """Content token used to skip re-evaluation of unchanged slices:
+    apiserver resourceVersion when present (the informer path), else a
+    digest of the spec (hand-built slices in tests and the bench)."""
+    rv = (obj.get("metadata") or {}).get("resourceVersion")
+    if rv:
+        return f"rv:{rv}"
+    digest = hashlib.sha256(
+        json.dumps(obj.get("spec", {}), sort_keys=True).encode()
+    ).hexdigest()
+    return f"sha:{digest[:24]}"
+
+
+def _slice_name(obj: dict) -> str:
+    return (obj.get("metadata") or {}).get("name", "")
+
+
+class SliceIndex:
+    """Persistent, event-updated candidate index (see module doc)."""
+
+    def __init__(self, metrics=None):
+        self._lock = threading.Lock()
+        self._metrics = metrics
+        self._slices: Dict[str, _ParsedSlice] = {}
+        # name -> version token of the slice that failed to parse: a
+        # permanently-bad slice must not bump the generation on every
+        # resync (that would invalidate every merged view each sweep —
+        # the O(fleet) steady-state cost this index exists to kill).
+        self._failed: Dict[str, str] = {}
+        self._generation = 0
+        self._catalog: Optional[IndexCatalog] = None
+        self._catalog_gen = -1
+        self._fingerprints: Dict[str, _Fingerprint] = {}
+
+    # --- mutation ---
+
+    def on_slice_event(self, event: str, obj: dict) -> None:
+        """Informer handler: ADDED/MODIFIED reindexes, DELETED drops."""
+        name = _slice_name(obj)
+        if not name:
+            return
+        with self._lock:
+            if event == "DELETED":
+                removed = (
+                    self._slices.pop(name, None) is not None
+                    or self._failed.pop(name, None) is not None
+                )
+                if removed:
+                    self._bump_locked()
+                return
+            self._upsert_locked(name, obj)
+
+    def resync(self, slices: List[dict]) -> None:
+        """Full reconcile against an informer listing — the backstop
+        for events lost while this scheduler was not leading. Slices
+        with an unchanged version token are untouched (no CEL, no
+        generation bump)."""
+        with self._lock:
+            live = set()
+            for obj in slices:
+                name = _slice_name(obj)
+                if not name:
+                    continue
+                live.add(name)
+                cur = self._slices.get(name)
+                if cur is not None and cur.version == _slice_version(obj):
+                    continue
+                self._upsert_locked(name, obj)
+            for name in list(self._slices):
+                if name not in live:
+                    del self._slices[name]
+                    self._bump_locked()
+            for name in list(self._failed):
+                if name not in live:
+                    del self._failed[name]
+                    self._bump_locked()
+
+    def _upsert_locked(self, name: str, obj: dict) -> None:
+        version = _slice_version(obj)
+        cur = self._slices.get(name)
+        if cur is not None and cur.version == version:
+            return
+        if self._failed.get(name) == version:
+            return  # same bad content: already counted + logged
+        try:
+            parsed = _ParsedSlice(name, version, obj)
+        except Exception as e:  # noqa: BLE001 — a bad slice must not
+            # take the scheduler down; it surfaces as index staleness
+            # (seen > indexed) through the gauges + doctor WARN.
+            self._failed[name] = version
+            self._slices.pop(name, None)
+            self._bump_locked()
+            log.warning("slice %s failed to index: %s", name, e)
+            return
+        self._failed.pop(name, None)
+        self._slices[name] = parsed
+        self._bump_locked()
+
+    def _bump_locked(self) -> None:
+        self._generation += 1
+        if self._metrics is not None:
+            self._metrics.set_gauge(
+                "scheduler_index_slices_seen",
+                len(self._slices) + len(self._failed),
+            )
+            self._metrics.set_gauge(
+                "scheduler_index_slices_indexed", len(self._slices)
+            )
+
+    # --- introspection ---
+
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    def staleness(self) -> Tuple[int, int]:
+        """(slices seen, slices indexed) — equal on a healthy index."""
+        with self._lock:
+            indexed = len(self._slices)
+            return indexed + len(self._failed), indexed
+
+    # --- consumption ---
+
+    def catalog(self) -> IndexCatalog:
+        """The merged catalog for the current generation (cached)."""
+        with self._lock:
+            if self._catalog is None or self._catalog_gen != self._generation:
+                self._catalog = self._build_catalog_locked()
+                self._catalog_gen = self._generation
+            return self._catalog
+
+    def _build_catalog_locked(self) -> IndexCatalog:
+        devices: List[Candidate] = []
+        counters: Dict[Tuple[str, str, str], Dict[str, int]] = {}
+        pool_totals: Dict[Tuple[str, str], int] = {}
+        peers: Dict[Tuple[str, str], List[Candidate]] = {}
+        for name in sorted(self._slices):
+            ps = self._slices[name]
+            devices.extend(ps.devices)
+            for k, v in ps.counters.items():
+                counters[k] = dict(v)
+                pk = (k[0], k[1])
+                pool_totals[pk] = pool_totals.get(pk, 0) + sum(v.values())
+            for c in ps.devices:
+                if c.consumes_counters:
+                    peers.setdefault((c.driver, c.pool), []).append(c)
+        return IndexCatalog(
+            devices=tuple(devices),
+            counters=counters,
+            pool_totals=pool_totals,
+            peers_by_pool={k: tuple(v) for k, v in peers.items()},
+            generation=self._generation,
+        )
+
+    def candidates(
+        self,
+        class_name: str,
+        class_selectors: List[dict],
+        request_name: str,
+        request_selectors: List[dict],
+    ) -> CandidateList:
+        """Candidates matching the class + request selectors, sorted by
+        (pool, name), with per-pool buckets attached for the packing
+        order. CEL runs only for slices not yet judged under this
+        fingerprint (or changed since).
+
+        The cache key is the SELECTORS, not the request name: verdicts
+        don't depend on the name, and keying on it would let per-claim
+        generated request names mint unbounded fingerprints and thrash
+        the cache back to O(fleet) CEL per claim. (Selector-error
+        reasons therefore carry the name of the request that first
+        minted the fingerprint — the expressions, the part that
+        matters for fixing the error, are identical.) Eviction is LRU."""
+        class_who = f"class {class_name}"
+        req_who = f"request {request_name}"
+        key = json.dumps(
+            [
+                class_name,
+                [(s.get("cel") or {}).get("expression", "")
+                 for s in class_selectors or []],
+                [(s.get("cel") or {}).get("expression", "")
+                 for s in request_selectors or []],
+            ],
+            sort_keys=True,
+        )
+        with self._lock:
+            fp = self._fingerprints.pop(key, None)
+            if fp is None:
+                if len(self._fingerprints) >= MAX_FINGERPRINTS:
+                    oldest = next(iter(self._fingerprints))
+                    del self._fingerprints[oldest]
+                fp = _Fingerprint(
+                    list(class_selectors or []),
+                    list(request_selectors or []),
+                    class_who, req_who,
+                )
+            # (Re)insert at the end: dict order is the LRU order.
+            self._fingerprints[key] = fp
+            if fp.merged is not None and fp.merged_gen == self._generation:
+                return fp.merged
+            gen = self._generation
+            snapshot = dict(self._slices)
+        # CEL runs OUTSIDE the lock: a cold fingerprint evaluates the
+        # whole fleet (seconds at 5k nodes), and holding the lock for
+        # that would stall the informer's event thread — slice
+        # ingestion must never wait on selector evaluation.
+        # _ParsedSlice/Candidate are immutable, so the snapshot stays
+        # coherent; concurrent evaluators of the SAME fingerprint
+        # write identical (token-keyed) verdicts, so the per-slice
+        # cache mutations are benign.
+        for name in list(fp.per_slice):
+            if name not in snapshot:
+                fp.per_slice.pop(name, None)
+        for ps in snapshot.values():
+            fp.match_slice(ps)
+        merged = self._merge(fp)
+        with self._lock:
+            # Cache only if the fleet didn't move underneath the
+            # evaluation; either way the returned list is coherent
+            # with the snapshot generation (the allocator's pinned-
+            # catalog guard handles any divergence from ITS snapshot).
+            if gen == self._generation:
+                fp.merged = merged
+                fp.merged_gen = gen
+        return merged
+
+    @staticmethod
+    def _merge(fp: _Fingerprint) -> CandidateList:
+        matched: List[Candidate] = []
+        reasons: List[str] = []
+        for name in sorted(fp.per_slice):
+            _, devs, rs = fp.per_slice[name]
+            matched.extend(devs)
+            reasons.extend(rs)
+        matched.sort(key=lambda d: (d.pool, d.name))
+        return CandidateList.build(matched, reasons)

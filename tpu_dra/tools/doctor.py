@@ -77,6 +77,21 @@ DEGRADED_GAUGE = "api_degraded"
 CIRCUIT_GAUGE = "api_circuit_state"
 CIRCUIT_STATE_NAMES = {0: "closed", 1: "half-open", 2: "open"}
 
+# Scheduler fleet-health gauges (ISSUE 6), suffix-matched like the
+# weather gauges: frag_score says how much of the grid's free capacity
+# is stranded (free chips no advertised placement can reach — the
+# ParvaGPU stranding metric over our chip meshes); the index pair says
+# whether every published ResourceSlice actually made it into the
+# scheduler's candidate index (seen > indexed means a slice failed to
+# parse and is INVISIBLE to allocation).
+FRAG_GAUGE = "scheduler_frag_score"
+INDEX_SEEN_GAUGE = "scheduler_index_slices_seen"
+INDEX_INDEXED_GAUGE = "scheduler_index_slices_indexed"
+# Above this, a meaningful share of free capacity is unreachable —
+# the bench's loaded traces stay at 0.0 under packed allocation, so a
+# sustained high score means pathological churn or a placement bug.
+FRAG_WARN_THRESHOLD = 0.25
+
 
 def _scrape(endpoint: str, timeout: float = 2.0) -> Dict[str, float]:
     """Fetch and parse a Prometheus text endpoint into
@@ -164,6 +179,9 @@ def probe_metrics(
         report[ep]["degraded"] = _check_degraded(
             ep, second or first, warn
         )
+        scheduler = _check_scheduler(ep, second or first, warn)
+        if scheduler:
+            report[ep]["scheduler"] = scheduler
     return report
 
 
@@ -206,6 +224,45 @@ def _check_degraded(
                 )
     if circuits:
         out["circuits"] = circuits
+    return out
+
+
+def _check_scheduler(
+    ep: str, sample: Dict[str, float], warn
+) -> Dict[str, object]:
+    """Surface the scheduler's fleet-health gauges (ISSUE 6): the grid
+    fragmentation score and index staleness. Empty dict when the
+    component exports neither (plugin endpoints, older schedulers)."""
+    out: Dict[str, object] = {}
+    for series, value in sorted(sample.items()):
+        name = series.split("{", 1)[0]
+        if name.endswith(FRAG_GAUGE):
+            out["frag_score"] = value
+        elif name.endswith(INDEX_SEEN_GAUGE):
+            out["slices_seen"] = int(value)
+        elif name.endswith(INDEX_INDEXED_GAUGE):
+            out["slices_indexed"] = int(value)
+    if out.get("frag_score", 0.0) > FRAG_WARN_THRESHOLD:
+        warn(
+            f"{ep}: fleet fragmentation score is "
+            f"{out['frag_score']:g} — a meaningful share of free chip "
+            f"capacity is stranded (no advertised placement can reach "
+            f"it); large claims will go Unschedulable despite free "
+            f"capacity. Check for reshape churn leaving odd-shaped "
+            f"holes, and whether the allocator is running with the "
+            f"packed ordering (docs/scheduling.md)"
+        )
+    seen = out.get("slices_seen")
+    indexed = out.get("slices_indexed")
+    if seen is not None and indexed is not None and seen > indexed:
+        warn(
+            f"{ep}: scheduler index is STALE — {seen} ResourceSlice(s) "
+            f"seen but only {indexed} indexed; the difference failed "
+            f"to parse and is invisible to allocation (claims needing "
+            f"those devices go Unschedulable). Find the malformed "
+            f"slice in the scheduler log ('failed to index') and fix "
+            f"its publisher"
+        )
     return out
 
 
@@ -492,6 +549,17 @@ def render(report: dict) -> str:
         for verb, state in (deg.get("circuits") or {}).items():
             if state != "closed":
                 lines.append(f"  circuit[{verb}] = {state}")
+        sched = m.get("scheduler") or {}
+        if sched:
+            frag = sched.get("frag_score")
+            seen = sched.get("slices_seen")
+            indexed = sched.get("slices_indexed")
+            parts = []
+            if frag is not None:
+                parts.append(f"frag_score={frag:g}")
+            if seen is not None or indexed is not None:
+                parts.append(f"index={indexed}/{seen} slices")
+            lines.append(f"  scheduler: {' '.join(parts)}")
     for note in report.get("notes", []):
         lines.append(f"note: {note}")
     for w in report["warnings"]:
