@@ -3,7 +3,7 @@
 IMAGE_REPO ?= registry.local/tpu-dra-driver
 IMAGE_TAG  ?= v0.1.0
 
-.PHONY: all native test test-slow bench decodebench allocbench image bats lint lint-fast shlint chaos crashmatrix apisoak ci clean
+.PHONY: all native test test-slow bench decodebench allocbench enginebench image bats lint lint-fast shlint chaos crashmatrix apisoak ci clean
 
 all: native test
 
@@ -41,6 +41,16 @@ decodebench:
 # `python bench.py` and lands in BENCH_r*.json (docs/scheduling.md).
 allocbench:
 	python -m tpu_dra.scheduler.allocbench --smoke
+
+# Serving-engine CPU smoke (ISSUE 7): paged+fused engine token-identical
+# to the contiguous+unfused oracle on a mixed-length trace, admission/
+# eviction accounting (every request completes exactly once, allocator
+# leak-free, freed pages re-zeroed), the lease-revoke backpressure drill
+# (drain, checkpoint, resume — no lost/duplicated sequences), and the
+# honest fixed-batch padding accounting. The timed configuration runs
+# as `bench.py --leg-serve` and lands in BENCH_r*.json.
+enginebench:
+	python -m tpu_dra.workloads.enginebench --smoke
 
 GIT_COMMIT ?= $(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
@@ -127,7 +137,7 @@ shlint:
 # (flakes surface in CI, not in the judge's rerun), the 13 bats suites
 # executed against the minicluster, the batsless process-level e2e, and
 # the bench artifact schema gate.
-ci: lint lint-fast shlint native chaos crashmatrix apisoak decodebench allocbench
+ci: lint lint-fast shlint native chaos crashmatrix apisoak decodebench allocbench enginebench
 	python -m pytest tests/ -q -m 'not slow'
 	python -m pytest tests/ -q -m 'not slow'
 	python -m pytest tests/test_chaos.py -q -m slow

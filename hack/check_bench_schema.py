@@ -59,6 +59,23 @@ TYPED_WHEN_PRESENT = {
     "alloc_util": (int, float),
     "firstfit_frag_score": (int, float),
     "firstfit_util": (int, float),
+    # Serving-engine leg (ISSUE 7): sustained useful tok/s + per-request
+    # latency under the seeded Poisson trace, the fixed-batch baseline
+    # at equal batch memory, and its honest padding accounting. The
+    # B100 pass forward-requires serve_tok_s/serve_p50_ms/serve_p99_ms
+    # in bench.py's static final dict ahead of their first recorded
+    # artifact.
+    "serve_tok_s": (int, float),
+    "serve_p50_ms": (int, float),
+    "serve_p99_ms": (int, float),
+    "serve_ttft_p50_ms": (int, float),
+    "serve_w8_tok_s": (int, float),
+    "serve_baseline_tok_s": (int, float),
+    "serve_baseline_padded_tok_s": (int, float),
+    "serve_baseline_p50_ms": (int, float),
+    "serve_baseline_p99_ms": (int, float),
+    "serve_vs_fixed_batch": (int, float),
+    "decode_padding_waste": (int, float),
 }
 
 

@@ -25,13 +25,19 @@ from typing import List
 from lints.base import FileContext, Finding
 from lints.registry import register
 
-# Keys the allocator microbench leg (ISSUE 6) must keep in bench.py's
-# final JSON dict, artifact or not (see module doc).
+# Keys a new leg must keep in bench.py's final JSON dict, artifact or
+# not (see module doc): the allocator microbench's headline keys
+# (ISSUE 6) and the serving-engine leg's (ISSUE 7 — sustained tok/s +
+# per-request latency under the Poisson trace; dropping them would
+# silently retire the continuous-batching regression tripwire).
 REQUIRED_STATIC = (
     "alloc_p50_ms",
     "alloc_p99_ms",
     "alloc_claims_per_s",
     "frag_score",
+    "serve_tok_s",
+    "serve_p50_ms",
+    "serve_p99_ms",
 )
 
 
@@ -72,9 +78,9 @@ class BenchSchemaPass:
         findings = [
             Finding(
                 ctx.path, 0, "B100",
-                f"final JSON dict is missing required allocator-leg key "
-                f"{k!r} (scheduler-regression tripwire, required ahead "
-                f"of its first recorded artifact)",
+                f"final JSON dict is missing forward-required bench key "
+                f"{k!r} (regression tripwire, required ahead of its "
+                f"first recorded artifact)",
             )
             for k in REQUIRED_STATIC
             if k not in static

@@ -460,3 +460,77 @@ def test_metrics_probe_quiet_on_healthy_scheduler(tmp_path):
     finally:
         srv.stop()
         srv2.stop()
+
+
+def test_metrics_probe_surfaces_engine_backpressure_and_exhaustion(
+    tmp_path,
+):
+    """ISSUE 7: a serving engine stalled past the threshold (the chip
+    lease is held elsewhere and not coming back) or whose page
+    allocator hit free-list exhaustion shows up in doctor output with
+    remediation hints — suffix-matched like the other gauges."""
+    from tpu_dra.infra.metrics import Metrics, MetricsServer
+
+    metrics = Metrics(prefix="tpu_dra_workload")  # prefix must not matter
+    metrics.set_gauge("engine_admission_stalled", 7.5)
+    metrics.set_gauge("engine_pages_free", 0)
+    metrics.inc("engine_page_exhausted_total", 3)
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        endpoint = f"127.0.0.1:{srv.port}"
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint],
+        )
+        warns = "\n".join(report["warnings"])
+        assert "STALLED for 7.5s" in warns
+        assert "arbiter" in warns
+        assert "free-list" in warns and "exhaustion 3 time(s)" in warns
+        assert "int8 KV" in warns
+        eng = report["metrics"][endpoint]["engine"]
+        assert eng == {
+            "admission_stalled_s": 7.5,
+            "pages_free": 0,
+            "page_exhausted": 3,
+        }
+        out = render(report)
+        assert "engine: stalled=7.5s pages_free=0 exhausted=3" in out
+    finally:
+        srv.stop()
+
+
+def test_metrics_probe_quiet_on_healthy_engine(tmp_path):
+    """A momentary stall below the threshold and a page pool with
+    headroom report the engine section without warnings; non-engine
+    endpoints get no engine section."""
+    from tpu_dra.infra.metrics import Metrics, MetricsServer
+
+    metrics = Metrics()
+    metrics.set_gauge("engine_admission_stalled", 0.2)
+    metrics.set_gauge("engine_pages_free", 17)
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    plain = Metrics()
+    plain.set_gauge("api_degraded", 0)
+    srv2 = MetricsServer(plain, port=0, address="127.0.0.1")
+    srv2.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        eng_ep = f"127.0.0.1:{srv.port}"
+        plain_ep = f"127.0.0.1:{srv2.port}"
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[eng_ep, plain_ep],
+        )
+        assert report["warnings"] == [], report["warnings"]
+        assert report["metrics"][eng_ep]["engine"] == {
+            "admission_stalled_s": 0.2, "pages_free": 17,
+        }
+        assert "engine" not in report["metrics"][plain_ep]
+    finally:
+        srv.stop()
+        srv2.stop()

@@ -1224,18 +1224,23 @@ def test_b100_dropped_key_fires_and_superset_passes(tmp_path):
 
 
 def test_b100_allocator_keys_required_even_without_artifact(tmp_path):
-    """ISSUE 6: the allocator leg's headline keys are required in
-    bench.py's final dict BEFORE any artifact records them — the
-    superset rule alone would let the new leg be dropped unnoticed
-    until the next recorded round."""
+    """ISSUE 6/7: the allocator and serving-engine legs' headline keys
+    are required in bench.py's final dict BEFORE any artifact records
+    them — the superset rule alone would let a new leg be dropped
+    unnoticed until the next recorded round."""
+    from lints.benchkeys import REQUIRED_STATIC
+
     bench = write(tmp_path, "bench.py", (
         "import json\n"
         "print(json.dumps({'metric': 'x', 'alloc_p50_ms': 1.0}))\n"
     ))
     out = BenchSchemaPass().run(FileContext(bench, tmp_path))
-    assert sorted(f.code for f in out) == ["B100"] * 3
+    assert sorted(f.code for f in out) == (
+        ["B100"] * (len(REQUIRED_STATIC) - 1)
+    )
     missing = "".join(f.message for f in out)
-    for key in ("alloc_p99_ms", "alloc_claims_per_s", "frag_score"):
+    for key in ("alloc_p99_ms", "alloc_claims_per_s", "frag_score",
+                "serve_tok_s", "serve_p50_ms", "serve_p99_ms"):
         assert f"'{key}'" in missing
     # With every required key present (and still no artifact): clean.
     bench.write_text(

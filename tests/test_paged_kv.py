@@ -1,0 +1,277 @@
+"""Paged-KV layer tests (ISSUE 7): the ref-counted page allocator, the
+per-page zero-tail invariant, the page-boundary edge cases the satellite
+names, and the block-table attention ops' parity contracts —
+bit-identity with the contiguous chunked path at matching block size
+(what the engine's paged-vs-unpaged oracle relies on) and closeness to
+the naive fp32 oracles."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dra.workloads import paged_kv
+from tpu_dra.workloads.models.llama import TINY_LLAMA
+from tpu_dra.workloads.ops import attention as A
+from tpu_dra.workloads.paged_kv import (
+    PageAllocator,
+    PageExhaustedError,
+    SCRATCH_PAGE,
+    init_paged_cache,
+)
+from tpu_dra.workloads.quantize import dequantize_kv, quantize_kv
+
+
+CFG = dataclasses.replace(
+    TINY_LLAMA, dtype=jnp.float32, param_dtype=jnp.float32
+)
+
+
+# --- allocator ---------------------------------------------------------------
+
+
+def test_allocator_basics_and_scratch_reservation():
+    a = PageAllocator(6)
+    assert a.free_pages == 5  # page 0 is reserved scratch
+    pages = [a.alloc() for _ in range(5)]
+    assert SCRATCH_PAGE not in pages
+    assert sorted(pages) == [1, 2, 3, 4, 5]
+    with pytest.raises(PageExhaustedError):
+        a.alloc()
+    assert a.exhausted == 1
+    with pytest.raises(ValueError):
+        a.decref(SCRATCH_PAGE)
+
+
+def test_allocator_refcounted_reuse_after_evict():
+    """Satellite: ref-counted page reuse after evict — a page freed by
+    one sequence's eviction is handed to the next allocation, and a
+    shared (incref'd) page survives one owner's release."""
+    a = PageAllocator(4)
+    p1, p2 = a.alloc(), a.alloc()
+    a.incref(p1)  # a second table now references p1 (prefix sharing)
+    assert not a.decref(p1)  # first owner evicts: page must survive
+    assert a.refcount(p1) == 1
+    assert a.decref(p2)  # sole owner evicts: page freed
+    assert a.alloc() == p2  # LIFO: the freed page is reused first
+    assert a.decref(p1)  # last reference gone -> freed for real
+    assert a.alloc() == p1
+
+
+def test_allocator_reservation_gates_admission():
+    a = PageAllocator(5)  # 4 usable
+    assert a.reserve(3)
+    assert not a.reserve(2)  # only 1 unreserved page left
+    assert a.reserve(1)
+    a.unreserve(1)
+    a.alloc()
+    a.unreserve(1)
+    assert a.reserved_pages == 2
+    with pytest.raises(ValueError):
+        a.unreserve(3)
+
+
+# --- cache invariants --------------------------------------------------------
+
+
+def _fill_pages(cache, pages, length, seed=0):
+    """Write `length` positions of random K/V (and scales) into the
+    given page list, per layer — the engine's write pattern."""
+    rng = np.random.default_rng(seed)
+    page = cache.page_size
+    out = cache
+    for pos in range(length):
+        pid, off = pages[pos // page], pos % page
+        for name, pool in out._pools():
+            newpool = []
+            for layer in pool:
+                val = rng.normal(size=layer.shape[2:]).astype(
+                    np.float32
+                ) + 1.0  # nonzero
+                newpool.append(layer.at[pid, off].set(val.astype(
+                    layer.dtype
+                )))
+            out = dataclasses.replace(out, **{name: tuple(newpool)})
+    return out
+
+
+@pytest.mark.parametrize("kv", ["none", "int8"])
+def test_tail_is_zero_per_page(kv):
+    """Satellite: zero-tail/tail_is_zero per page — a sequence ending
+    exactly at a page boundary has fully-clean later pages; a mid-page
+    ending leaves the partial page's tail zero; any poison breaks it."""
+    cache = init_paged_cache(CFG, num_pages=5, page_size=4, kv_quant=kv)
+    pages = [1, 2, 3]
+    # Exactly at a page boundary (length == 2 pages exactly).
+    filled = _fill_pages(cache, pages, length=8)
+    assert paged_kv.tail_is_zero(filled, pages, 8)
+    assert paged_kv.pages_are_zero(filled, [3, 4])
+    # Mid-page ending: positions 9..11 of page 3 must be zero.
+    filled = _fill_pages(cache, pages, length=9)
+    assert paged_kv.tail_is_zero(filled, pages, 9)
+    assert not paged_kv.tail_is_zero(filled, pages, 8)  # pos 8 is live
+    # Poison the tail -> the check must catch it.
+    k0 = filled.k[0].at[3, 2].set(
+        jnp.ones_like(filled.k[0][3, 2])
+    )
+    poisoned = dataclasses.replace(
+        filled, k=(k0,) + tuple(filled.k[1:])
+    )
+    assert not paged_kv.tail_is_zero(poisoned, pages, 9)
+
+
+def test_zero_pages_restores_invariant():
+    """Eviction mid-page: zero_pages over the freed list clears values
+    AND scales, so the next owner starts from clean pages."""
+    cache = init_paged_cache(
+        CFG, num_pages=4, page_size=4, kv_quant="int8"
+    )
+    filled = _fill_pages(cache, [1, 2], length=6)  # ends mid-page 2
+    assert not paged_kv.pages_are_zero(filled, [1, 2])
+    wiped = paged_kv.zero_pages(filled, [1, 2])
+    assert paged_kv.pages_are_zero(wiped, [1, 2])
+    assert paged_kv.tail_is_zero(wiped, [1, 2], 0)
+
+
+# --- block-table attention ops ----------------------------------------------
+
+
+def _random_paged(seed, b, num_pages, page, kvh, hd, quant=False):
+    """Random pools + disjoint random tables + mixed lengths (one
+    exactly at a page boundary — the satellite edge)."""
+    key = jax.random.PRNGKey(seed)
+    ks = jax.random.split(key, 4)
+    kp = jax.random.normal(ks[0], (num_pages, page, kvh, hd), jnp.float32)
+    vp = jax.random.normal(ks[1], (num_pages, page, kvh, hd), jnp.float32)
+    max_pages = (num_pages - 1) // b
+    perm = np.random.default_rng(seed).permutation(
+        np.arange(1, num_pages)
+    )
+    tables = np.zeros((b, max_pages), np.int32)
+    for i in range(b):
+        tables[i] = perm[i * max_pages:(i + 1) * max_pages]
+    lengths = np.zeros((b,), np.int32)
+    caps = max_pages * page
+    rng = np.random.default_rng(seed + 1)
+    for i in range(b):
+        lengths[i] = rng.integers(1, caps + 1)
+    lengths[0] = page * max(1, max_pages // 2)  # exact page boundary
+    if b > 1:
+        lengths[1] = 1
+    q = jax.random.normal(ks[2], (b, 2 * kvh, hd), jnp.float32)
+    if quant:
+        kq, ksc = quantize_kv(kp)
+        vq, vsc = quantize_kv(vp)
+        return q, kq, vq, ksc, vsc, jnp.asarray(tables), jnp.asarray(lengths)
+    return q, kp, vp, None, None, jnp.asarray(tables), jnp.asarray(lengths)
+
+
+@pytest.mark.parametrize("quant", [False, True])
+def test_paged_decode_attention_matches_reference(quant):
+    q, kp, vp, ksc, vsc, tables, lengths = _random_paged(
+        0, b=3, num_pages=10, page=4, kvh=2, hd=64, quant=quant
+    )
+    ref = A.reference_paged_decode_attention(
+        q, kp, vp, tables, lengths, k_scale=ksc, v_scale=vsc
+    )
+    got = A.paged_decode_attention(
+        q, kp, vp, tables, lengths, k_scale=ksc, v_scale=vsc
+    )
+    assert float(jnp.max(jnp.abs(got - ref))) < 1e-4
+
+
+def test_paged_decode_attention_bit_identical_to_contiguous():
+    """The engine parity keystone: walking a block table over scattered
+    pages must produce BIT-IDENTICAL output to the contiguous chunked
+    decode op at block_k == page_size, per sequence."""
+    q, kp, vp, _, _, tables, lengths = _random_paged(
+        1, b=3, num_pages=13, page=4, kvh=2, hd=64
+    )
+    got = A.paged_decode_attention(q, kp, vp, tables, lengths)
+    for i in range(q.shape[0]):
+        # Materialize sequence i's cache contiguously.
+        k_seq = kp[tables[i]].reshape(-1, 2, 64)[None]
+        v_seq = vp[tables[i]].reshape(-1, 2, 64)[None]
+        want = A.decode_attention(
+            q[i:i + 1], k_seq, v_seq, lengths[i], impl="xla", block_k=4
+        )
+        assert jnp.array_equal(got[i], want[0]), f"sequence {i} drifted"
+
+
+def test_paged_decode_attention_dead_slot_is_zero():
+    q, kp, vp, _, _, tables, lengths = _random_paged(
+        2, b=3, num_pages=10, page=4, kvh=2, hd=64
+    )
+    lengths = lengths.at[2].set(0)
+    out = A.paged_decode_attention(q, kp, vp, tables, lengths)
+    assert float(jnp.max(jnp.abs(out[2]))) == 0.0
+
+
+@pytest.mark.parametrize("quant", [False, True])
+def test_paged_prefill_attention_matches_causal_reference(quant):
+    """Chunk queries [pos, pos+s) over the block table == causal
+    attention of the q-suffix against the contiguous prefix."""
+    page, kvh, hd, pos, s = 4, 2, 64, 6, 5
+    key = jax.random.PRNGKey(3)
+    ks = jax.random.split(key, 3)
+    total = pos + s
+    k_all = jax.random.normal(ks[0], (1, total, kvh, hd), jnp.float32)
+    v_all = jax.random.normal(ks[1], (1, total, kvh, hd), jnp.float32)
+    q = jax.random.normal(ks[2], (s, 2 * kvh, hd), jnp.float32)
+    num_pages = -(-total // page) + 2
+    table = np.array([2, 1, 3], np.int32)  # scattered on purpose
+    kp = jnp.zeros((num_pages, page, kvh, hd), jnp.float32)
+    vp = jnp.zeros((num_pages, page, kvh, hd), jnp.float32)
+    ksc = vsc = None
+    if quant:
+        k8, k8s = quantize_kv(k_all)
+        v8, v8s = quantize_kv(v_all)
+        k_all = dequantize_kv(k8, k8s)
+        v_all = dequantize_kv(v8, v8s)
+        kp8 = jnp.zeros((num_pages, page, kvh, hd), jnp.int8)
+        vp8 = jnp.zeros((num_pages, page, kvh, hd), jnp.int8)
+        kscp = jnp.zeros((num_pages, page, kvh), jnp.float32)
+        vscp = jnp.zeros((num_pages, page, kvh), jnp.float32)
+        for p in range(total):
+            pid, off = table[p // page], p % page
+            kp8 = kp8.at[pid, off].set(k8[0, p])
+            vp8 = vp8.at[pid, off].set(v8[0, p])
+            kscp = kscp.at[pid, off].set(k8s[0, p])
+            vscp = vscp.at[pid, off].set(v8s[0, p])
+        kp, vp, ksc, vsc = kp8, vp8, kscp, vscp
+    else:
+        for p in range(total):
+            pid, off = table[p // page], p % page
+            kp = kp.at[pid, off].set(k_all[0, p])
+            vp = vp.at[pid, off].set(v_all[0, p])
+    got = A.paged_prefill_attention(
+        q, kp, vp, jnp.asarray(table), jnp.int32(pos),
+        k_scale=ksc, v_scale=vsc,
+    )
+    want = A.reference_attention(q[None], k_all, v_all, causal=True)[0]
+    assert float(jnp.max(jnp.abs(got - want))) < 1e-4
+
+
+def test_paged_decode_attention_validates_shapes():
+    q = jnp.zeros((2, 4, 64))
+    kp = jnp.zeros((5, 4, 2, 64))
+    tables = jnp.zeros((2, 2), jnp.int32)
+    lengths = jnp.zeros((2,), jnp.int32)
+    with pytest.raises(ValueError, match="k_scale and v_scale"):
+        A.paged_decode_attention(
+            q, kp, kp, tables, lengths, k_scale=jnp.zeros((5, 4, 2))
+        )
+    with pytest.raises(ValueError, match="do not match batch"):
+        A.paged_decode_attention(
+            q, kp, kp, tables[:1], lengths
+        )
+    with pytest.raises(ValueError, match="multiple of kv heads"):
+        A.paged_decode_attention(
+            jnp.zeros((2, 3, 64)), kp, kp, tables, lengths
+        )
+    with pytest.raises(ValueError, match="unknown paged"):
+        A.paged_decode_attention(
+            q, kp, kp, tables, lengths, impl="bogus"
+        )

@@ -92,6 +92,21 @@ INDEX_INDEXED_GAUGE = "scheduler_index_slices_indexed"
 # sustained high score means pathological churn or a placement bug.
 FRAG_WARN_THRESHOLD = 0.25
 
+# Serving-engine gauges (ISSUE 7), suffix-matched like the others:
+# engine_admission_stalled is the SECONDS the engine's current
+# backpressure stall has lasted (a co-tenant holds the chip lease, or
+# ours was revoked — the engine drained and is waiting to re-acquire);
+# engine_pages_free / engine_page_exhausted_total say whether the paged
+# KV allocator's free list can still admit work.
+ENGINE_STALL_GAUGE = "engine_admission_stalled"
+ENGINE_PAGES_FREE_GAUGE = "engine_pages_free"
+ENGINE_EXHAUSTED_COUNTER = "engine_page_exhausted_total"
+# Momentary stalls are the multiplexing quantum working as intended; a
+# stall older than this means the lease is not coming back (daemon
+# wedged, cooldown storm, starved FIFO) and requests are aging in the
+# queue.
+ENGINE_STALL_WARN_SECONDS = 1.0
+
 
 def _scrape(endpoint: str, timeout: float = 2.0) -> Dict[str, float]:
     """Fetch and parse a Prometheus text endpoint into
@@ -182,6 +197,9 @@ def probe_metrics(
         scheduler = _check_scheduler(ep, second or first, warn)
         if scheduler:
             report[ep]["scheduler"] = scheduler
+        engine = _check_engine(ep, second or first, warn)
+        if engine:
+            report[ep]["engine"] = engine
     return report
 
 
@@ -262,6 +280,45 @@ def _check_scheduler(
             f"those devices go Unschedulable). Find the malformed "
             f"slice in the scheduler log ('failed to index') and fix "
             f"its publisher"
+        )
+    return out
+
+
+def _check_engine(
+    ep: str, sample: Dict[str, float], warn
+) -> Dict[str, object]:
+    """Surface the serving engine's health gauges (ISSUE 7): a
+    backpressure stall held past the threshold, and page-allocator
+    free-list exhaustion. Empty dict when the component exports neither
+    (non-serving endpoints)."""
+    out: Dict[str, object] = {}
+    for series, value in sorted(sample.items()):
+        name = series.split("{", 1)[0]
+        if name.endswith(ENGINE_STALL_GAUGE):
+            out["admission_stalled_s"] = value
+        elif name.endswith(ENGINE_PAGES_FREE_GAUGE):
+            out["pages_free"] = int(value)
+        elif name.endswith(ENGINE_EXHAUSTED_COUNTER):
+            out["page_exhausted"] = int(value)
+    stalled = out.get("admission_stalled_s", 0.0)
+    if stalled > ENGINE_STALL_WARN_SECONDS:
+        warn(
+            f"{ep}: serving-engine admissions have been STALLED for "
+            f"{stalled:g}s — the chip lease is held elsewhere (or was "
+            f"revoked) and is not coming back; in-flight sequences are "
+            f"checkpointed and waiting. Check the claim's arbiter "
+            f"(doctor's arbiters section: holder/overdue/cooldown) and "
+            f"the co-tenant's behavior; requests are aging in the queue"
+        )
+    if out.get("page_exhausted", 0) > 0:
+        warn(
+            f"{ep}: serving-engine page allocator hit free-list "
+            f"exhaustion {out['page_exhausted']} time(s) "
+            f"({out.get('pages_free', '?')} pages free now) — admission "
+            f"is blocking on KV memory. Lower max concurrent sequences "
+            f"or per-request max_new_tokens, raise the page pool "
+            f"(num_pages), or enable int8 KV (kv_quant) to halve page "
+            f"bytes (docs/serving.md)"
         )
     return out
 
@@ -560,6 +617,18 @@ def render(report: dict) -> str:
             if seen is not None or indexed is not None:
                 parts.append(f"index={indexed}/{seen} slices")
             lines.append(f"  scheduler: {' '.join(parts)}")
+        eng = m.get("engine") or {}
+        if eng:
+            parts = []
+            if "admission_stalled_s" in eng:
+                parts.append(
+                    f"stalled={eng['admission_stalled_s']:g}s"
+                )
+            if "pages_free" in eng:
+                parts.append(f"pages_free={eng['pages_free']}")
+            if "page_exhausted" in eng:
+                parts.append(f"exhausted={eng['page_exhausted']}")
+            lines.append(f"  engine: {' '.join(parts)}")
     for note in report.get("notes", []):
         lines.append(f"note: {note}")
     for w in report["warnings"]:

@@ -1,0 +1,901 @@
+"""Serving engine: continuous batching + paged KV over DRA leases.
+
+The fixed-batch decode path (workloads/generate.py) runs one static
+batch through a scan: every request pads to the longest sequence and
+nothing joins or leaves mid-flight. This module is the request-level
+layer on top of the same forward math — the refactor ROADMAP item 3
+calls for:
+
+- **sequence-state store**: every request is an explicit
+  :class:`_Sequence` (context, emitted tokens, block table, timestamps,
+  reservation) owned by the engine, not a row of an opaque batch;
+- **paged KV** (workloads/paged_kv.py): per-sequence block tables over
+  shared page pools, attention through the block-table ops
+  (ops/attention.py ``paged_decode_attention`` /
+  ``paged_prefill_attention``) — a batch of wildly different lengths
+  pays compute and HBM for its LIVE context only;
+- **continuous batching**: sequences are admitted and evicted BETWEEN
+  scan chunks (``scan_chunk`` decode steps per jitted call), with
+  chunked prefill (``prefill_chunk`` tokens per engine iteration)
+  interleaved with decode — the Sarathi-style chunk budget: a long
+  prompt never stalls in-flight decodes for more than one chunk;
+- **multiplexd-aware backpressure**: the engine runs behind a
+  :class:`LeaseGate`. When the gate closes (a co-tenant holds the chip
+  lease, or the daemon revoked ours — workloads/multiplex_client.py),
+  the engine DRAINS: admissions stop, every in-flight sequence's state
+  is checkpointed host-side (context + tokens emitted so far) and its
+  pages freed, and on re-acquire the drained sequences resume at the
+  FRONT of the queue — re-prefilled from their checkpointed context, so
+  no sequence is lost and no token is emitted twice.
+
+Exact-parity oracles: ``contiguous=True`` allocates each slot a fixed
+physically-consecutive page range (the unpaged layout expressed as a
+trivial block table) and ``fused=False`` replaces the decode scan with
+one jitted step per token — both run the SAME step math, so paged+fused
+output is required to be TOKEN-IDENTICAL to the unpaged/unfused oracle
+(tests/test_engine.py, ``make enginebench``).
+
+No reference counterpart (the reference is a DRA driver); this is the
+workload-payload serving layer. Bench: ``bench.py --leg-serve`` replays
+a seeded Poisson arrival trace (workloads/enginebench.py) and records
+``serve_tok_s`` / ``serve_p50_ms`` / ``serve_p99_ms``.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from tpu_dra.workloads.models.llama import LlamaConfig
+
+# (config, int8?) -> (decode_chunk, decode_step, prefill_chunk) jitted
+# callables — see Engine._jit_fns.
+_JIT_CACHE: dict = {}
+
+# --- lease gates -------------------------------------------------------------
+
+
+class LeaseGate:
+    """May the engine touch the chip right now? The default gate is
+    always open (exclusive claim, no multiplexing)."""
+
+    def ready(self) -> bool:
+        return True
+
+    def wait_ready(self, timeout: Optional[float] = None) -> bool:
+        return True
+
+    def close(self) -> None:
+        pass
+
+
+class EventGate(LeaseGate):
+    """Test/drill gate: revoke() closes it, restore() reopens it."""
+
+    def __init__(self, ready: bool = True):
+        self._ready = ready
+        self.waits = 0
+
+    def ready(self) -> bool:
+        return self._ready
+
+    def wait_ready(self, timeout: Optional[float] = None) -> bool:
+        self.waits += 1
+        return self._ready
+
+    def revoke(self) -> None:
+        self._ready = False
+
+    def restore(self) -> None:
+        self._ready = True
+
+
+class MultiplexLeaseGate(LeaseGate):
+    """The real thing: holds the claim's chip lease through the
+    multiplex daemon. ready() pumps the client's event stream (a status
+    RPC) so an async revocation flips the gate closed; wait_ready()
+    re-acquires, sitting out any post-revocation cooldown the daemon
+    imposes."""
+
+    def __init__(self, client):
+        from tpu_dra.workloads.multiplex_client import MultiplexClient
+
+        assert isinstance(client, MultiplexClient)
+        self._client = client
+        self._lease = None
+
+    def ready(self) -> bool:
+        if self._lease is None:
+            return False
+        self._client.status()  # drains pending async revocation events
+        if self._client.revoked:
+            self._client.revoked = False
+            self._lease = None
+            return False
+        return True
+
+    def wait_ready(self, timeout: Optional[float] = None) -> bool:
+        from tpu_dra.workloads.multiplex_client import LeaseCooldownError
+
+        if self._lease is not None:
+            return True
+        try:
+            self._lease = self._client.acquire()
+            return True
+        except LeaseCooldownError as e:
+            time.sleep(min(e.retry_after, timeout if timeout else 0.1))
+            return False
+
+    def close(self) -> None:
+        if self._lease is not None:
+            self._client.release()
+            self._lease = None
+        self._client.close()
+
+
+def auto_gate(environ=None) -> LeaseGate:
+    """MultiplexLeaseGate iff this process runs in a multiplexed
+    container (the same CDI-injected env contract as
+    multiplex_client.auto_lease), the always-open gate otherwise."""
+    import os
+
+    from tpu_dra.workloads.multiplex_client import MultiplexClient
+
+    environ = os.environ if environ is None else environ
+    if environ.get("TPU_PROCESS_MULTIPLEXING") != "true":
+        return LeaseGate()
+    return MultiplexLeaseGate(
+        MultiplexClient(environ["TPU_MULTIPLEX_SOCKET_DIR"])
+    )
+
+
+# --- request / sequence state ------------------------------------------------
+
+
+@dataclasses.dataclass
+class Request:
+    rid: str
+    prompt: np.ndarray  # 1-D int32 token ids
+    max_new_tokens: int
+    arrival_s: float = 0.0  # offset on the engine's clock; 0 = immediate
+
+
+@dataclasses.dataclass
+class Completion:
+    rid: str
+    tokens: np.ndarray  # the generated tokens (prompt excluded)
+    t_submit: float
+    t_arrival: float  # t_submit + the request's trace arrival offset
+    t_first_token: float
+    t_done: float
+
+    @property
+    def latency_s(self) -> float:
+        """Completion latency from ARRIVAL (a request cannot be served
+        before it exists; counting pre-arrival time would flatter
+        nothing but punish open-loop traces)."""
+        return self.t_done - self.t_arrival
+
+    @property
+    def ttft_s(self) -> float:
+        return self.t_first_token - self.t_arrival
+
+
+class _Sequence:
+    """Engine-internal per-request state (the sequence-state store)."""
+
+    __slots__ = (
+        "req", "context", "out", "slot", "pages", "reserved_left",
+        "prefill_cursor", "prefill_done", "t_submit", "t_first", "drains",
+        "serial",
+    )
+
+    def __init__(self, req: Request, t_submit: float, serial: int = 0):
+        self.serial = serial  # admission order; breaks t_submit ties
+        self.req = req
+        # The tokens to (re-)prefill: the prompt, plus — after a
+        # backpressure drain — everything emitted so far.
+        self.context = np.asarray(req.prompt, np.int32)
+        self.out: List[int] = []  # every emitted token, never reset
+        self.slot: Optional[int] = None
+        self.pages: List[int] = []
+        self.reserved_left = 0
+        self.prefill_cursor = 0
+        self.prefill_done = False
+        self.t_submit = t_submit
+        self.t_first: Optional[float] = None
+        self.drains = 0
+
+    @property
+    def remaining(self) -> int:
+        return self.req.max_new_tokens - len(self.out)
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    page_size: int = 16
+    max_slots: int = 4
+    max_pages_per_seq: int = 16
+    num_pages: int = 0  # 0 => 1 + max_slots * max_pages_per_seq
+    scan_chunk: int = 8  # decode steps per jitted scan chunk
+    prefill_chunk: int = 32  # Sarathi chunk budget per engine iteration
+    kv_quant: str = "none"
+    # Satellite (ROADMAP item 4 nibble): int8 weight-only matmuls on the
+    # WHOLE decode path — attention projections, MLP, and the logits
+    # head all go through generate._mm over a quantize_params tree.
+    weight_quant: str = "none"
+    fused: bool = True  # lax.scan decode chunks; False = per-token oracle
+    contiguous: bool = False  # unpaged oracle: fixed consecutive pages
+
+    def resolved_num_pages(self) -> int:
+        return self.num_pages or 1 + self.max_slots * self.max_pages_per_seq
+
+
+class Engine:
+    """Continuous-batching serving engine over a paged KV cache.
+
+    ``params`` may be either layout; stacked (``scan_layers=True``)
+    trees are unrolled once at construction (the engine steps layers in
+    Python, the unrolled in-place idiom). ``gate`` defaults to the
+    always-open LeaseGate; pass :func:`auto_gate` () in multiplexed
+    containers. ``metrics`` is an optional infra.metrics.Metrics the
+    engine exports its gauges/counters into (the doctor consumes
+    ``engine_admission_stalled`` and the page-pool gauges).
+    """
+
+    def __init__(
+        self,
+        config: LlamaConfig,
+        params: dict,
+        engine_config: Optional[EngineConfig] = None,
+        gate: Optional[LeaseGate] = None,
+        metrics=None,
+        clock=time.monotonic,
+    ):
+        import jax
+
+        from tpu_dra.workloads.generate import unroll_params
+        from tpu_dra.workloads.paged_kv import (
+            PageAllocator,
+            init_paged_cache,
+        )
+        from tpu_dra.workloads.quantize import quantize_params
+
+        self.config = config
+        self.ec = engine_config or EngineConfig()
+        if self.ec.scan_chunk < 1 or self.ec.prefill_chunk < 1:
+            raise ValueError("scan_chunk and prefill_chunk must be >= 1")
+        params = unroll_params(params)
+        if self.ec.weight_quant == "int8":
+            params = quantize_params(params)
+        elif self.ec.weight_quant != "none":
+            raise ValueError(
+                f"unknown weight_quant {self.ec.weight_quant!r}"
+            )
+        self.params = jax.device_put(params)
+        self.gate = gate or LeaseGate()
+        self.metrics = metrics
+        self.clock = clock
+
+        P = self.ec.resolved_num_pages()
+        if self.ec.contiguous:
+            need = 1 + self.ec.max_slots * self.ec.max_pages_per_seq
+            if P < need:
+                raise ValueError(
+                    f"contiguous mode needs {need} pages "
+                    f"(1 + slots*max_pages_per_seq), got {P}"
+                )
+        self.cache = init_paged_cache(
+            config, P, self.ec.page_size, kv_quant=self.ec.kv_quant
+        )
+        self.allocator = PageAllocator(P)
+        B, M = self.ec.max_slots, self.ec.max_pages_per_seq
+        self._tables = np.zeros((B, M), np.int32)  # SCRATCH_PAGE default
+        self._lengths = np.zeros((B,), np.int32)
+        self._last_tokens = np.zeros((B,), np.int32)
+        self._active = np.zeros((B,), bool)
+        self._slots: List[Optional[_Sequence]] = [None] * B
+
+        self._queue: collections.deque = collections.deque()  # _Sequence
+        self._prefilling: collections.deque = collections.deque()
+        self._pending_zero: List[int] = []
+        self._blocked_on_pages = False
+        self._serial = 0
+        self._rids: set = set()  # every rid ever accepted (dup guard)
+        self._progress = 0  # bumps on admission/prefill/tokens: O(1)
+        # idle detection for run() instead of O(live) scans per step
+        self.completed: Dict[str, Completion] = {}
+        self._stalled_since: Optional[float] = None
+        self._exhausted_exported = 0
+        self._jit_fns()
+
+    # --- jitted forward -------------------------------------------------
+
+    def _jit_fns(self):
+        import functools
+
+        import jax
+
+        c = self.config
+        quant = self.ec.kv_quant == "int8"
+        # One jitted callable per (model config, storage mode), shared
+        # across Engine instances: jax's trace cache lives on the
+        # callable, so a fresh engine over the same shapes reuses the
+        # compiled executables instead of re-tracing.
+        key = (c, quant)
+        fns = _JIT_CACHE.get(key)
+        if fns is None:
+            fns = (
+                jax.jit(
+                    functools.partial(_decode_chunk, c, quant),
+                    static_argnames=("steps",),
+                ),
+                jax.jit(functools.partial(_decode_step, c, quant)),
+                jax.jit(functools.partial(_prefill_chunk, c, quant)),
+            )
+            _JIT_CACHE[key] = fns
+        (
+            self._decode_chunk_fn,
+            self._decode_step_fn,
+            self._prefill_chunk_fn,
+        ) = fns
+
+    # --- public API ------------------------------------------------------
+
+    def add_request(self, req: Request) -> None:
+        if len(req.prompt) < 1 or req.max_new_tokens < 1:
+            raise ValueError(
+                f"request {req.rid}: need >= 1 prompt token and >= 1 "
+                f"new token"
+            )
+        # rids key the completion store: a duplicate would make its
+        # second _finish a no-op that never releases the slot — an
+        # engine hang, so refuse it at the door (O(1) set lookup).
+        if req.rid in self._rids:
+            raise ValueError(f"duplicate request rid {req.rid!r}")
+        self._rids.add(req.rid)
+        total = (
+            len(req.prompt) + req.max_new_tokens + self.ec.scan_chunk
+        )
+        if total > self.ec.max_pages_per_seq * self.ec.page_size:
+            raise ValueError(
+                f"request {req.rid}: prompt {len(req.prompt)} + "
+                f"max_new {req.max_new_tokens} (+ chunk slack "
+                f"{self.ec.scan_chunk}) exceeds the per-sequence page "
+                f"budget {self.ec.max_pages_per_seq}x{self.ec.page_size}"
+            )
+        self._serial += 1
+        self._queue.append(
+            _Sequence(req, t_submit=self.clock(), serial=self._serial)
+        )
+
+    @property
+    def busy(self) -> bool:
+        return bool(
+            self._queue or self._prefilling or any(self._slots)
+        )
+
+    def step(self) -> bool:
+        """One engine iteration: gate check (drain on backpressure),
+        admissions, one prefill chunk, one decode chunk. Returns True
+        while work remains; never blocks on the gate (run() waits)."""
+        now = self.clock()
+        if not self.gate.ready():
+            self._enter_stall(now)
+            self._export()
+            return self.busy
+        self._exit_stall()
+        self._admit(now)
+        self._prefill_tick(now)
+        self._decode_tick(now)
+        self._export()
+        return self.busy
+
+    def run(
+        self, requests=None, poll_seconds: float = 0.002
+    ) -> Dict[str, Completion]:
+        """Submit ``requests`` (optional) and step until idle; blocks on
+        the lease gate / future arrivals between steps."""
+        for r in requests or []:
+            self.add_request(r)
+        while self.busy:
+            stalled = self._stalled_since is not None
+            before = self._progress
+            self.step()
+            made_progress = self._progress != before
+            if self._stalled_since is not None:
+                if not self.gate.wait_ready(timeout=poll_seconds):
+                    # A gate whose wait doesn't block (stub gates) must
+                    # not turn the stall into a hot spin.
+                    time.sleep(poll_seconds)
+            elif not made_progress and not stalled:
+                # Idle but not done: waiting on a future arrival.
+                time.sleep(poll_seconds)
+        self._flush_zero()
+        self._export()
+        return self.completed
+
+    def close(self) -> None:
+        self.gate.close()
+
+    def _live(self):
+        """Every not-yet-completed sequence, exactly once (prefilling
+        sequences appear in both _prefilling and _slots)."""
+        seen = set()
+        for s in (
+            list(self._queue) + list(self._prefilling)
+            + [x for x in self._slots if x is not None]
+        ):
+            if id(s) not in seen:
+                seen.add(id(s))
+                yield s
+
+    # --- backpressure ----------------------------------------------------
+
+    def _enter_stall(self, now: float) -> None:
+        if self._stalled_since is None:
+            self._stalled_since = now
+            if self._drain(now):
+                # Count only stalls that actually drained work — a cold
+                # engine waiting for its first lease is not an incident.
+                self._inc("engine_backpressure_drains_total")
+
+    def _exit_stall(self) -> None:
+        self._stalled_since = None
+
+    def _drain(self, now: float) -> int:
+        """Checkpoint every in-flight sequence host-side and free its
+        device state: the co-tenant gets the chip AND the pages. Drained
+        sequences resume at the FRONT of the queue (oldest first) with
+        their emitted tokens folded into the context — nothing is lost,
+        nothing re-emitted. Returns how many sequences were drained."""
+        drained: List[_Sequence] = []
+        for slot, seq in enumerate(self._slots):
+            if seq is None:
+                continue
+            self._release_slot(slot)
+            seq.context = np.concatenate(
+                [np.asarray(seq.req.prompt, np.int32),
+                 np.asarray(seq.out, np.int32)]
+            )
+            seq.prefill_cursor = 0
+            seq.prefill_done = False
+            seq.drains += 1
+            drained.append(seq)
+        self._prefilling.clear()
+        # appendleft inverts iteration order, so walk newest-first to
+        # land oldest at the queue front; the admission serial breaks
+        # t_submit ties (a coarse clock can stamp a whole burst with one
+        # value, and a stable sort alone would then resume newest-first).
+        for seq in sorted(
+            drained, key=lambda s: (s.t_submit, s.serial), reverse=True
+        ):
+            self._queue.appendleft(seq)
+        return len(drained)
+
+    # --- admission / slots ------------------------------------------------
+
+    def _pages_for(self, seq: _Sequence) -> int:
+        """Worst-case page count the sequence can touch: full context +
+        every generated token + one scan chunk of post-completion slack
+        (a sequence finishing mid-chunk keeps writing until the chunk
+        ends)."""
+        total = (
+            len(seq.context) + seq.remaining + self.ec.scan_chunk
+        )
+        return -(-total // self.ec.page_size)
+
+    def _admit(self, now: float) -> None:
+        self._blocked_on_pages = False
+        while self._queue:
+            seq = self._queue[0]
+            if seq.t_submit + seq.req.arrival_s > now and not seq.drains:
+                return  # FIFO: the head hasn't arrived yet
+            slot = next(
+                (i for i, s in enumerate(self._slots) if s is None), None
+            )
+            if slot is None:
+                return
+            need = self._pages_for(seq)
+            if not self.ec.contiguous and not self.allocator.reserve(need):
+                # Page pool too tight for the head-of-line request:
+                # admission WAITS until evictions free pages (FIFO — no
+                # smaller request jumps the line and starves the head).
+                # This is expected backpressure, not exhaustion — it is
+                # exported as the blocked-on-pages gauge, never the
+                # engine_page_exhausted_total counter (that counter
+                # means an allocation the reservation system promised
+                # could not be served: an invariant violation).
+                self._blocked_on_pages = True
+                if not any(s is not None for s in self._slots):
+                    from tpu_dra.workloads.paged_kv import (
+                        PageExhaustedError,
+                    )
+
+                    raise PageExhaustedError(
+                        f"request {seq.req.rid} needs {need} pages but "
+                        f"the pool ({self.allocator.num_pages} pages) "
+                        f"cannot cover it even empty — raise num_pages "
+                        f"or lower max_pages_per_seq"
+                    )
+                return
+            self._queue.popleft()
+            seq.slot = slot
+            seq.reserved_left = 0 if self.ec.contiguous else need
+            self._slots[slot] = seq
+            self._prefilling.append(seq)
+            self._progress += 1
+            self._inc("engine_admitted_total")
+
+    def _flush_zero(self) -> None:
+        """Batch-zero every page released since the last flush. Runs
+        before any page can be re-allocated, so a new owner always
+        starts from zero pages (values and scales)."""
+        from tpu_dra.workloads import paged_kv
+
+        if self._pending_zero:
+            self.cache = paged_kv.zero_pages(self.cache, self._pending_zero)
+            self._pending_zero = []
+
+    def _alloc_page(self, seq: _Sequence) -> int:
+        self._flush_zero()
+        if self.ec.contiguous:
+            j = len(seq.pages)
+            page = 1 + seq.slot * self.ec.max_pages_per_seq + j
+        else:
+            self.allocator.unreserve(1)
+            seq.reserved_left -= 1
+            page = self.allocator.alloc()
+        seq.pages.append(page)
+        self._tables[seq.slot, len(seq.pages) - 1] = page
+        return page
+
+    def _ensure_pages(self, seq: _Sequence, upto: int) -> None:
+        """Grow the block table until it covers positions [0, upto)."""
+        need = -(-upto // self.ec.page_size)
+        while len(seq.pages) < need:
+            self._alloc_page(seq)
+
+    def _release_slot(self, slot: int) -> None:
+        from tpu_dra.workloads import paged_kv
+
+        seq = self._slots[slot]
+        assert seq is not None
+        freed = []
+        if not self.ec.contiguous:
+            for page in seq.pages:
+                if self.allocator.decref(page):
+                    freed.append(page)
+            if seq.reserved_left:
+                self.allocator.unreserve(seq.reserved_left)
+                seq.reserved_left = 0
+        else:
+            freed = list(seq.pages)
+        # Freed pages must be re-zeroed (the per-page zero-tail
+        # invariant) before ANY of them is handed out again — but one
+        # scatter per eviction is pure dispatch overhead, so the zeroing
+        # is DEFERRED and flushed as one batch the moment the next
+        # allocation (or an idle engine) needs it (_flush_zero).
+        self._pending_zero.extend(freed)
+        seq.pages = []
+        seq.slot = None
+        self._slots[slot] = None
+        self._tables[slot] = paged_kv.SCRATCH_PAGE
+        self._lengths[slot] = 0
+        self._last_tokens[slot] = 0
+        self._active[slot] = False
+
+    # --- prefill ----------------------------------------------------------
+
+    def _prefill_tick(self, now: float) -> None:
+        if not self._prefilling:
+            return
+        import jax.numpy as jnp
+
+        seq = self._prefilling[0]
+        slot = seq.slot
+        s = min(
+            self.ec.prefill_chunk, len(seq.context) - seq.prefill_cursor
+        )
+        # Pad the chunk to a power-of-two bucket (capped at the chunk
+        # budget): one trace/compile per bucket instead of one per
+        # distinct prompt length. Pad tokens write to scratch.
+        bucket = 1
+        while bucket < s:
+            bucket *= 2
+        bucket = min(bucket, self.ec.prefill_chunk)
+        self._ensure_pages(seq, seq.prefill_cursor + s)
+        toks = np.zeros(bucket, np.int32)
+        toks[:s] = seq.context[seq.prefill_cursor:seq.prefill_cursor + s]
+        self.cache, logits = self._prefill_chunk_fn(
+            self.params, self.cache,
+            jnp.asarray(self._tables[slot]),
+            jnp.int32(seq.prefill_cursor), jnp.asarray(toks),
+            jnp.int32(s),
+        )
+        seq.prefill_cursor += s
+        self._progress += 1
+        self._inc("engine_prefill_tokens_total", s)
+        if seq.prefill_cursor == len(seq.context):
+            self._prefilling.popleft()
+            seq.prefill_done = True
+            first = int(np.argmax(np.asarray(logits)))
+            self._record_tokens(seq, [first])
+            if seq.slot is not None:  # not finished by that one token
+                self._lengths[slot] = len(seq.context)
+                self._last_tokens[slot] = first
+                self._active[slot] = True
+
+    # --- decode ------------------------------------------------------------
+
+    def _decode_tick(self, now: float) -> None:
+        if not self._active.any():
+            return
+        import jax.numpy as jnp
+
+        steps = self.ec.scan_chunk
+        for slot, seq in enumerate(self._slots):
+            if seq is not None and self._active[slot]:
+                self._ensure_pages(seq, int(self._lengths[slot]) + steps)
+        args = (
+            self.params, self.cache,
+            jnp.asarray(self._tables),
+            jnp.asarray(self._lengths),
+            jnp.asarray(self._last_tokens),
+            jnp.asarray(self._active),
+        )
+        if self.ec.fused:
+            self.cache, lengths, last, out = self._decode_chunk_fn(
+                *args, steps=steps
+            )
+        else:
+            # Unfused oracle: one XLA entry per token, same step math.
+            cache, lengths, last, active = (
+                args[1], args[3], args[4], args[5]
+            )
+            outs = []
+            for _ in range(steps):
+                cache, lengths, last = self._decode_step_fn(
+                    self.params, cache, args[2], lengths, last, active
+                )
+                outs.append(last)
+            self.cache = cache
+            out = jnp.stack(outs)
+        out = np.asarray(out)  # [steps, B]
+        # np.array (copy): asarray over a jax buffer is read-only, and
+        # the slot bookkeeping writes these in place.
+        self._lengths = np.array(lengths)
+        self._last_tokens = np.array(last)
+        active_slots = [
+            (slot, seq) for slot, seq in enumerate(self._slots)
+            if seq is not None and self._active[slot]
+        ]
+        for slot, seq in active_slots:
+            self._record_tokens(seq, out[:, slot].tolist())
+
+    def _record_tokens(self, seq: _Sequence, toks) -> None:
+        # Clock read HERE, after the chunk's host sync (np.asarray /
+        # logits fetch) — stamping the iteration's start time would hide
+        # the chunk's own compute from every latency quantile.
+        now = self.clock()
+        take = min(len(toks), seq.remaining)
+        if take <= 0:
+            return
+        if seq.t_first is None:
+            seq.t_first = now
+        seq.out.extend(int(t) for t in toks[:take])
+        self._progress += 1
+        self._inc("engine_tokens_total", take)
+        if seq.remaining == 0:
+            self._finish(seq, now)
+
+    def _finish(self, seq: _Sequence, now: float) -> None:
+        if seq.req.rid in self.completed:
+            return
+        self._release_slot(seq.slot)
+        self.completed[seq.req.rid] = Completion(
+            rid=seq.req.rid,
+            tokens=np.asarray(seq.out, np.int32),
+            t_submit=seq.t_submit,
+            t_arrival=seq.t_submit + seq.req.arrival_s,
+            t_first_token=seq.t_first if seq.t_first is not None else now,
+            t_done=now,
+        )
+        self._inc("engine_completed_total")
+        if self.metrics is not None:
+            # Same definition as Completion.latency_s: from ARRIVAL —
+            # the exported histogram and the bench quantiles must agree.
+            self.metrics.observe(
+                "engine_request_latency_seconds",
+                now - (seq.t_submit + seq.req.arrival_s),
+            )
+
+    # --- metrics -----------------------------------------------------------
+
+    def _inc(self, name: str, value: float = 1.0) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(name, value)
+
+    def _export(self) -> None:
+        if self.metrics is None:
+            return
+        m = self.metrics
+        m.set_gauge(
+            "engine_active_sequences",
+            float(sum(1 for s in self._slots if s is not None)),
+        )
+        m.set_gauge("engine_queued_sequences", float(len(self._queue)))
+        m.set_gauge(
+            "engine_pages_free", float(self.allocator.free_pages)
+        )
+        stalled = (
+            self.clock() - self._stalled_since
+            if self._stalled_since is not None else 0.0
+        )
+        m.set_gauge("engine_admission_stalled", stalled)
+        m.set_gauge(
+            "engine_admission_blocked_on_pages",
+            1.0 if self._blocked_on_pages else 0.0,
+        )
+        delta = self.allocator.exhausted - self._exhausted_exported
+        if delta:
+            m.inc("engine_page_exhausted_total", delta)
+            self._exhausted_exported = self.allocator.exhausted
+
+
+# --- traced forward (module-level so jit caches stay warm per engine) -------
+
+
+def _decode_step(c, quant, params, cache, tables, lengths, tokens, active):
+    """One paged decode step for the whole slot batch. tokens/lengths/
+    active: [B]. Inactive slots write to the scratch page and contribute
+    exactly zero attention (length 0); their token and length pass
+    through unchanged."""
+    import jax.numpy as jnp
+
+    from tpu_dra.workloads.generate import (
+        _finish_block,
+        _mm,
+        _project_qkv,
+        _rms,
+    )
+    from tpu_dra.workloads.models.llama import rope_frequencies
+    from tpu_dra.workloads.paged_kv import SCRATCH_PAGE, PagedKVCache
+    from tpu_dra.workloads.ops.attention import paged_decode_attention
+    from tpu_dra.workloads.quantize import quantize_kv
+
+    B = tokens.shape[0]
+    page = cache.page_size
+    x = params["embed"]["embedding"].astype(c.dtype)[tokens][:, None, :]
+    cos, sin = rope_frequencies(c, lengths[:, None])  # [B, 1, hd/2]
+    pids = jnp.take_along_axis(
+        tables, (lengths // page)[:, None], axis=1
+    )[:, 0]
+    offs = lengths % page
+    # Masked writes land on the scratch page, never on a live table row.
+    pids = jnp.where(active, pids, SCRATCH_PAGE)
+    offs = jnp.where(active, offs, 0)
+    len_eff = lengths + active.astype(lengths.dtype)
+
+    k_pools, v_pools = list(cache.k), list(cache.v)
+    ks_pools = list(cache.k_scale) if quant else [None] * c.n_layers
+    vs_pools = list(cache.v_scale) if quant else [None] * c.n_layers
+    for layer in range(c.n_layers):
+        lp = params[f"layer_{layer}"]
+        q, k, v = _project_qkv(c, lp, x, cos, sin, B, 1)
+        k1, v1 = k[:, 0], v[:, 0]  # [B, kvh, hd]
+        if quant:
+            k1, ksc = quantize_kv(k1)
+            v1, vsc = quantize_kv(v1)
+            ks_pools[layer] = ks_pools[layer].at[pids, offs].set(ksc)
+            vs_pools[layer] = vs_pools[layer].at[pids, offs].set(vsc)
+        k_pools[layer] = k_pools[layer].at[pids, offs].set(k1)
+        v_pools[layer] = v_pools[layer].at[pids, offs].set(v1)
+        out = paged_decode_attention(
+            q[:, 0], k_pools[layer], v_pools[layer], tables, len_eff,
+            k_scale=ks_pools[layer], v_scale=vs_pools[layer],
+        )[:, None].astype(c.dtype)
+        x = _finish_block(c, lp, x, out, B, 1)
+    x = _rms(x, params["final_norm"]["scale"], c.norm_eps)
+    logits = _mm(x, params["lm_head"]).astype(jnp.float32)[:, 0]
+    nxt = jnp.argmax(logits, axis=-1).astype(tokens.dtype)
+    nxt = jnp.where(active, nxt, tokens)
+    new_cache = PagedKVCache(
+        k=tuple(k_pools), v=tuple(v_pools),
+        k_scale=tuple(ks_pools) if quant else None,
+        v_scale=tuple(vs_pools) if quant else None,
+    )
+    return new_cache, len_eff, nxt
+
+
+def _decode_chunk(
+    c, quant, params, cache, tables, lengths, tokens, active, *, steps
+):
+    """``steps`` decode steps as ONE jitted lax.scan — the fused chunk
+    the engine admits/evicts between."""
+    from jax import lax
+
+    def step(carry, _):
+        cache, lengths, toks = carry
+        cache, lengths, toks = _decode_step(
+            c, quant, params, cache, tables, lengths, toks, active
+        )
+        return (cache, lengths, toks), toks
+
+    (cache, lengths, toks), out = lax.scan(
+        step, (cache, lengths, tokens), None, length=steps
+    )
+    return cache, lengths, toks, out  # out: [steps, B]
+
+
+def _prefill_chunk(c, quant, params, cache, table_row, pos, tokens, valid):
+    """One chunk of ONE sequence's prefill: write the chunk's K/V into
+    its pages (quantizing in flight), attend causally over everything
+    written so far via the block table, and return the logits of the
+    last VALID position (only the final chunk's are consumed — they
+    pick the first generated token).
+
+    ``tokens`` is padded to a power-of-two bucket (bounded trace-cache
+    growth: one compile per bucket, not one per distinct prompt length)
+    and ``valid`` is the traced count of real tokens: pad positions
+    write to the scratch page and their outputs are never read (each
+    query's output depends only on its own q row and the written keys,
+    so pad rows cannot pollute valid rows)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from tpu_dra.workloads.generate import (
+        _finish_block,
+        _mm,
+        _project_qkv,
+        _rms,
+    )
+    from tpu_dra.workloads.models.llama import rope_frequencies
+    from tpu_dra.workloads.paged_kv import SCRATCH_PAGE, PagedKVCache
+    from tpu_dra.workloads.ops.attention import paged_prefill_attention
+    from tpu_dra.workloads.quantize import quantize_kv
+
+    s = tokens.shape[0]
+    page = cache.page_size
+    x = params["embed"]["embedding"].astype(c.dtype)[tokens][None]
+    positions = pos + jnp.arange(s)
+    cos, sin = rope_frequencies(c, positions)  # [s, hd/2]
+    in_valid = jnp.arange(s) < valid
+    safe_rows = jnp.minimum(positions // page, table_row.shape[0] - 1)
+    pids = jnp.where(
+        in_valid, jnp.take(table_row, safe_rows), SCRATCH_PAGE
+    )
+    offs = jnp.where(in_valid, positions % page, 0)
+
+    k_pools, v_pools = list(cache.k), list(cache.v)
+    ks_pools = list(cache.k_scale) if quant else [None] * c.n_layers
+    vs_pools = list(cache.v_scale) if quant else [None] * c.n_layers
+    for layer in range(c.n_layers):
+        lp = params[f"layer_{layer}"]
+        q, k, v = _project_qkv(c, lp, x, cos, sin, 1, s)
+        k1, v1 = k[0], v[0]  # [s, kvh, hd]
+        if quant:
+            k1, ksc = quantize_kv(k1)
+            v1, vsc = quantize_kv(v1)
+            ks_pools[layer] = ks_pools[layer].at[pids, offs].set(ksc)
+            vs_pools[layer] = vs_pools[layer].at[pids, offs].set(vsc)
+        k_pools[layer] = k_pools[layer].at[pids, offs].set(k1)
+        v_pools[layer] = v_pools[layer].at[pids, offs].set(v1)
+        out = paged_prefill_attention(
+            q[0], k_pools[layer], v_pools[layer], table_row, pos,
+            k_scale=ks_pools[layer], v_scale=vs_pools[layer],
+        )[None].astype(c.dtype)
+        x = _finish_block(c, lp, x, out, 1, s)
+    x = _rms(x, params["final_norm"]["scale"], c.norm_eps)
+    x_last = lax.dynamic_slice_in_dim(x, valid - 1, 1, axis=1)
+    logits = _mm(x_last, params["lm_head"]).astype(jnp.float32)[0, 0]
+    new_cache = PagedKVCache(
+        k=tuple(k_pools), v=tuple(v_pools),
+        k_scale=tuple(ks_pools) if quant else None,
+        v_scale=tuple(vs_pools) if quant else None,
+    )
+    return new_cache, logits

@@ -195,6 +195,26 @@ def init_cache(
     )
 
 
+def unroll_params(params: dict) -> dict:
+    """Stacked (``scan_layers=True``) param tree -> the unrolled
+    ``layer_{i}`` layout, by slicing every ``[L, ...]`` leaf of the
+    scanned block per layer. Identity for already-unrolled trees. The
+    serving engine (workloads/engine.py) steps layers in Python over
+    per-layer page pools, so it normalizes to this layout once at
+    construction — the same per-layer in-place idiom the unrolled decode
+    fast path uses."""
+    if "layers" not in params:
+        return params
+    block = params["layers"]["block"]
+    n_layers = jax.tree_util.tree_leaves(block)[0].shape[0]
+    out = {k: v for k, v in params.items() if k != "layers"}
+    for i in range(n_layers):
+        out[f"layer_{i}"] = jax.tree_util.tree_map(
+            lambda leaf, i=i: leaf[i], block
+        )
+    return out
+
+
 def _rms(x: jnp.ndarray, scale: jnp.ndarray, eps: float) -> jnp.ndarray:
     x32 = x.astype(jnp.float32)
     var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)

@@ -990,3 +990,260 @@ def decode_attention(
             q, k, v, length, k_scale, v_scale, extra_k, extra_v
         )
     raise ValueError(f"unknown decode attention impl: {impl!r}")
+
+
+# --- paged (block-table) attention: the serving-engine path ------------------
+#
+# The serving engine (workloads/engine.py) stores KV in a shared POOL of
+# fixed-size pages ([num_pages, page_size, kvh, hd] per layer) instead of
+# one contiguous [b, max_seq, ...] buffer per sequence: each sequence owns
+# a BLOCK TABLE of page ids, so a batch of wildly different lengths pays
+# HBM for exactly the pages it has filled — no per-sequence max_seq
+# padding allocation, no copy when sequences join/leave the batch.
+#
+# paged_decode_attention is the length-aware single-query op over that
+# layout: the same online-softmax block loop as _xla_decode_attention,
+# except each KV block is GATHERED through the per-sequence block table
+# (`k_pages[tables[:, i]]`) instead of sliced from a contiguous buffer,
+# and `lengths` is a PER-SEQUENCE vector — the loop runs to the longest
+# live sequence's last page and every shorter sequence's dead columns are
+# masked. Fully-masked blocks contribute exactly zero to (m, l, acc)
+# (exp(NEG_INF - m) underflows to 0.0, alpha stays 1.0), so the math is
+# BIT-IDENTICAL to running each sequence alone with block_k == page_size
+# — the exact-parity contract the engine's paged-vs-unpaged oracle test
+# pins (a contiguous layout is just a block table whose pages happen to
+# be physically consecutive).
+#
+# paged_prefill_attention is the chunked-prefill companion: s queries of
+# ONE sequence at absolute positions [pos, pos+s) against its own block
+# table, causal within the chunk (write-then-attend like _block_inplace:
+# the chunk's K/V pages are already written when it runs). int8 pools
+# dequantize in flight exactly like the contiguous paths — gathered
+# k_scale pages multiply score columns, v_scale pages the probabilities.
+
+_LAST_PAGED_IMPL = None  # set at trace time; enginebench asserts on it
+
+
+def reference_paged_decode_attention(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    tables: jnp.ndarray,
+    lengths: jnp.ndarray,
+    k_scale=None,
+    v_scale=None,
+) -> jnp.ndarray:
+    """Naive fp32 oracle: gather EVERY table entry into a contiguous
+    per-sequence view and run a masked softmax. q: [b, h, hd];
+    k_pages/v_pages: [P, page, kvh, hd] pools; tables: [b, max_pages]
+    int32; lengths: [b] int32 (keys [0, lengths[i]) of sequence i are
+    live). Tests only — materializes [b, max_pages*page, ...]."""
+    b, h, hd = q.shape
+    page, kvh = k_pages.shape[1], k_pages.shape[2]
+    n_rep = h // kvh
+    max_pages = tables.shape[1]
+    skv = max_pages * page
+
+    def flat(pool):  # [b, max_pages*page, kvh, ...]
+        g = jnp.take(pool, tables, axis=0)  # [b, max_pages, page, kvh, ...]
+        return g.reshape((b, skv) + pool.shape[2:])
+
+    kf = flat(k_pages).astype(jnp.float32)
+    vf = flat(v_pages).astype(jnp.float32)
+    qg = q.reshape(b, kvh, n_rep, hd).astype(jnp.float32)
+    logits = jnp.einsum("bhrd,bkhd->bhrk", qg, kf) * (hd ** -0.5)
+    if k_scale is not None:
+        logits = logits * _group_scale(flat(k_scale))
+    mask = jnp.arange(skv)[None, None, None, :] < lengths[:, None, None, None]
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    # A fully-dead row (lengths == 0) softmaxes NEG_INF uniformly; zero it
+    # so dead slots return exactly 0 like the online path.
+    probs = jnp.where(mask, probs, 0.0)
+    if v_scale is not None:
+        probs = probs * _group_scale(flat(v_scale))
+    out = jnp.einsum("bhrk,bkhd->bhrd", probs, vf)
+    return out.reshape(b, h, hd).astype(q.dtype)
+
+
+def _xla_paged_decode_attention(
+    q, k_pages, v_pages, tables, lengths, k_scale, v_scale
+):
+    """Length-aware block-table walk (the serving path): a dynamic-trip-
+    count loop over page-sized KV blocks, each gathered through the
+    per-sequence block table, carrying fp32 (m, l, acc). Trip count stops
+    at the longest live sequence's last page; shorter sequences' dead
+    columns (and dead slots entirely) are masked to an exact zero
+    contribution."""
+    b, h, hd = q.shape
+    page, kvh = k_pages.shape[1], k_pages.shape[2]
+    n_rep = h // kvh
+    scale = hd ** -0.5
+    qg = q.reshape(b, kvh, n_rep, hd)
+    num_blocks = lax.div(jnp.max(lengths) + (page - 1), page)
+
+    m0 = jnp.full((b, kvh, n_rep), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, kvh, n_rep), jnp.float32)
+    acc0 = jnp.zeros((b, kvh, n_rep, hd), jnp.float32)
+
+    def body(i, carry):
+        m, l, acc = carry
+        pids = jnp.take(tables, i, axis=1)  # [b]
+        kb = jnp.take(k_pages, pids, axis=0)  # [b, page, kvh, hd]
+        vb = jnp.take(v_pages, pids, axis=0)
+        s = jnp.einsum(
+            "bhrd,bkhd->bhrk", qg, kb.astype(qg.dtype),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if k_scale is not None:
+            s = s * _group_scale(jnp.take(k_scale, pids, axis=0))
+        cols = i * page + jnp.arange(page)
+        s = jnp.where(
+            cols[None, None, None, :] < lengths[:, None, None, None],
+            s, NEG_INF,
+        )
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        if v_scale is not None:
+            p = p * _group_scale(jnp.take(v_scale, pids, axis=0))
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhrk,bkhd->bhrd", p.astype(qg.dtype), vb.astype(qg.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = lax.fori_loop(0, num_blocks, body, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    # A slot with NO live key (length 0) never raises m above NEG_INF,
+    # so its masked scores exponentiate to exp(0) = 1 and `out` becomes
+    # an average of whatever its table's pages hold — zero it explicitly
+    # (the documented dead-slot contract). Live slots pass through the
+    # where bit-unchanged, preserving the contiguous-path bit-identity.
+    out = jnp.where(lengths[:, None, None, None] > 0, out, 0.0)
+    return out.reshape(b, h, hd).astype(q.dtype)
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    tables: jnp.ndarray,
+    lengths: jnp.ndarray,
+    k_scale=None,
+    v_scale=None,
+    impl: str = "auto",
+) -> jnp.ndarray:
+    """Single-query GQA attention over a paged KV pool.
+
+    q: [b, h, hd] (one query per sequence slot);
+    k_pages/v_pages: [num_pages, page_size, kvh, hd] shared pools (model
+    dtype, or int8 with [num_pages, page_size, kvh] ``k_scale``/
+    ``v_scale`` pools);
+    tables: [b, max_pages_per_seq] int32 block tables — entry j of row i
+    is the pool page holding sequence i's positions [j*page, (j+1)*page);
+    lengths: [b] int32 traced — keys at positions >= lengths[i] are dead
+    for sequence i (a 0 length makes the slot contribute exactly zero);
+    impl: "auto" | "xla" | "reference".
+
+    Returns [b, h, hd] in q's dtype. The block loop is bit-identical to
+    ``decode_attention(..., impl="xla", block_k=page_size)`` over the
+    equivalent contiguous cache — the engine's parity tests rely on it.
+    """
+    b, h, hd = q.shape
+    if k_pages.shape != v_pages.shape or k_pages.shape[3] != hd:
+        raise ValueError(
+            f"paged cache shape mismatch: q {q.shape} vs k_pages "
+            f"{k_pages.shape} v_pages {v_pages.shape}"
+        )
+    kvh = k_pages.shape[2]
+    if h % kvh:
+        raise ValueError(
+            f"query heads ({h}) must be a multiple of kv heads ({kvh})"
+        )
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be provided together")
+    if tables.shape[0] != b or lengths.shape != (b,):
+        raise ValueError(
+            f"tables {tables.shape} / lengths {lengths.shape} do not "
+            f"match batch {b}"
+        )
+    if impl == "auto":
+        impl = "xla"
+    global _LAST_PAGED_IMPL
+    _LAST_PAGED_IMPL = impl
+    if impl == "xla":
+        return _xla_paged_decode_attention(
+            q, k_pages, v_pages, tables, lengths, k_scale, v_scale
+        )
+    if impl == "reference":
+        return reference_paged_decode_attention(
+            q, k_pages, v_pages, tables, lengths, k_scale, v_scale
+        )
+    raise ValueError(f"unknown paged decode attention impl: {impl!r}")
+
+
+def paged_prefill_attention(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    table: jnp.ndarray,
+    pos,
+    k_scale=None,
+    v_scale=None,
+) -> jnp.ndarray:
+    """Chunked-prefill attention for ONE sequence over its block table.
+
+    q: [s, h, hd] — the chunk's queries at absolute positions
+    [pos, pos+s); k_pages/v_pages: the shared pools (the chunk's own K/V
+    pages are already written — write-then-attend, like the unrolled
+    in-place path); table: [max_pages] int32; pos: traced int32 scalar.
+    Causal: key j is visible to query i iff j <= pos + i. Returns
+    [s, h, hd] in q's dtype.
+    """
+    s_len, h, hd = q.shape
+    page, kvh = k_pages.shape[1], k_pages.shape[2]
+    n_rep = h // kvh
+    scale = hd ** -0.5
+    qg = q.reshape(s_len, kvh, n_rep, hd)
+    q_abs = pos + jnp.arange(s_len)  # [s]
+    num_blocks = lax.div(pos + s_len + (page - 1), page)
+
+    m0 = jnp.full((kvh, n_rep, s_len), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((kvh, n_rep, s_len), jnp.float32)
+    acc0 = jnp.zeros((kvh, n_rep, s_len, hd), jnp.float32)
+
+    def body(i, carry):
+        m, l, acc = carry
+        pid = jnp.take(table, i)
+        kb = jnp.take(k_pages, pid, axis=0)  # [page, kvh, hd]
+        vb = jnp.take(v_pages, pid, axis=0)
+        s = jnp.einsum(
+            "qhrd,khd->hrqk", qg, kb.astype(qg.dtype),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if k_scale is not None:
+            ksb = jnp.take(k_scale, pid, axis=0)  # [page, kvh]
+            s = s * ksb.T[:, None, None, :]
+        cols = i * page + jnp.arange(page)
+        mask = cols[None, :] <= q_abs[:, None]  # [s, page]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        if v_scale is not None:
+            vsb = jnp.take(v_scale, pid, axis=0)
+            p = p * vsb.T[:, None, None, :]
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "hrqk,khd->hrqd", p.astype(qg.dtype), vb.astype(qg.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = lax.fori_loop(0, num_blocks, body, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]  # [kvh, n_rep, s, hd]
+    return (
+        out.transpose(2, 0, 1, 3).reshape(s_len, h, hd).astype(q.dtype)
+    )

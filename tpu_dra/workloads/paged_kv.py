@@ -1,0 +1,272 @@
+"""Paged KV cache: a block/page allocator over the int8 ``DecodeCache``
+storage scheme (the serving engine's memory layer).
+
+The fixed-batch decode path (workloads/generate.py) allocates one
+contiguous ``[b, max_seq, kvh, hd]`` buffer per cache: every sequence
+pays ``max_seq`` positions of HBM whether it is 30 tokens long or 3000,
+and a sequence cannot leave the batch without reshuffling the buffer.
+The engine (workloads/engine.py) replaces that with the vLLM-style
+paged layout:
+
+- **pools**: per layer, one shared ``[num_pages, page_size, kvh, hd]``
+  K pool and one V pool (plus ``[num_pages, page_size, kvh]`` f32 scale
+  pools in int8 mode — the same per-(token, head) symmetric scheme as
+  ``quantize.quantize_kv``, scale 0 for all-zero rows so the zero-tail
+  invariant stays checkable per page);
+- **block tables**: each sequence owns an ordered list of page ids;
+  position ``p`` of the sequence lives at ``(pages[p // page_size],
+  p % page_size)``. Attention walks the table
+  (ops/attention.py ``paged_decode_attention``), so compute and HBM
+  traffic are bounded by the LIVE context, not the allocation;
+- **ref-counted free list** (:class:`PageAllocator`): pages are
+  acquired one at a time as sequences grow, released (and re-zeroed —
+  the per-page zero-tail invariant) when a sequence finishes or is
+  evicted, and ref-counted so a future prefix-sharing / speculative
+  fork can alias one page into two tables without copying.
+
+Page 0 is a RESERVED scratch page: it is never handed out, inactive
+engine slots' masked writes land there, and block-table rows default to
+it — so a gather through an unused table entry reads poison that the
+length mask never admits, rather than aliasing a live sequence's page.
+
+No reference counterpart (the reference is a DRA driver); this is the
+workload-payload serving layer, proven by tests/test_paged_kv.py and
+the engine parity suite.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from tpu_dra.workloads.models.llama import LlamaConfig
+from tpu_dra.workloads.generate import KV_QUANT_MODES
+
+# Page id 0 is the poison scratch page (see module doc).
+SCRATCH_PAGE = 0
+
+
+class PageExhaustedError(RuntimeError):
+    """alloc() found the free list empty. The engine's reservation-gated
+    admission makes this unreachable in normal operation; hitting it
+    means an accounting bug or an admission path that skipped
+    ``reserve()``."""
+
+
+class PageAllocator:
+    """Host-side ref-counted free list over ``num_pages`` pages.
+
+    Pure bookkeeping — device arrays are owned by :class:`PagedKVCache`.
+    ``reserve``/``unreserve`` implement admission control: the engine
+    reserves a sequence's worst-case page count up front, so a sequence
+    that was admitted can always grow to its limit without racing other
+    sequences for the tail of the free list (mid-scan exhaustion is an
+    invariant violation, not a runtime condition).
+    """
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError(
+                f"need >= 2 pages (page {SCRATCH_PAGE} is reserved "
+                f"scratch), got {num_pages}"
+            )
+        self.num_pages = num_pages
+        # LIFO free list: recently-freed (and freshly-zeroed) pages are
+        # reused first, keeping the touched working set small.
+        self._free = list(range(num_pages - 1, SCRATCH_PAGE, -1))
+        self._ref = [0] * num_pages
+        self._reserved = 0
+        # Lifetime count of alloc() calls that found the list empty —
+        # exported by the engine as engine_page_exhausted_total.
+        self.exhausted = 0
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def reserved_pages(self) -> int:
+        return self._reserved
+
+    def can_reserve(self, n: int) -> bool:
+        return self.free_pages - self._reserved >= n
+
+    def reserve(self, n: int) -> bool:
+        """Set aside ``n`` pages of admission headroom (no physical pages
+        move). False when the unreserved free pool is too small."""
+        if not self.can_reserve(n):
+            return False
+        self._reserved += n
+        return True
+
+    def unreserve(self, n: int) -> None:
+        if n > self._reserved:
+            raise ValueError(
+                f"unreserve({n}) exceeds outstanding reservation "
+                f"{self._reserved}"
+            )
+        self._reserved -= n
+
+    def alloc(self) -> int:
+        """Pop a free page (refcount 1). Callers holding a reservation
+        should ``unreserve(1)`` alongside each alloc."""
+        if not self._free:
+            self.exhausted += 1
+            raise PageExhaustedError(
+                f"page pool exhausted ({self.num_pages} pages, "
+                f"{self._reserved} reserved)"
+            )
+        page = self._free.pop()
+        self._ref[page] = 1
+        return page
+
+    def incref(self, page: int) -> None:
+        if self._ref[page] < 1:
+            raise ValueError(f"incref of unallocated page {page}")
+        self._ref[page] += 1
+
+    def decref(self, page: int) -> bool:
+        """Drop one reference; True when the page was freed (refcount hit
+        zero and it returned to the free list)."""
+        if page == SCRATCH_PAGE:
+            raise ValueError("scratch page is never allocated or freed")
+        if self._ref[page] < 1:
+            raise ValueError(f"decref of unallocated page {page}")
+        self._ref[page] -= 1
+        if self._ref[page] == 0:
+            self._free.append(page)
+            return True
+        return False
+
+    def refcount(self, page: int) -> int:
+        return self._ref[page]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class PagedKVCache:
+    """Device half of the paged cache: per-layer page pools.
+
+    ``k``/``v``: L-tuples of ``[num_pages, page_size, kvh, hd]`` (model
+    dtype, or int8 with L-tuples of ``[num_pages, page_size, kvh]`` f32
+    ``k_scale``/``v_scale``). Block tables and per-sequence lengths live
+    with the engine (host-owned, mirrored to device per chunk) — the
+    cache itself is position-agnostic, which is what makes pages
+    reusable across sequences.
+
+    INVARIANT (per page): an allocated page's slots at positions beyond
+    the owning sequence's length are ZERO (values and scales), and FREE
+    pages are entirely zero — ``init_paged_cache`` establishes it, the
+    engine's write path preserves it (each step writes exactly the next
+    position), and :func:`zero_pages` re-establishes it on free. The
+    scratch page is exempt (it absorbs masked writes and holds poison by
+    design). :func:`tail_is_zero` checks it for tests/debug runs."""
+
+    k: tuple
+    v: tuple
+    k_scale: "tuple | None" = None
+    v_scale: "tuple | None" = None
+
+    def tree_flatten(self):
+        return (self.k, self.v, self.k_scale, self.v_scale), None
+
+    @classmethod
+    def tree_unflatten(cls, _aux, children):
+        return cls(*children)
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+    @property
+    def num_pages(self) -> int:
+        return self.k[0].shape[0]
+
+    @property
+    def page_size(self) -> int:
+        return self.k[0].shape[1]
+
+    @property
+    def n_layers(self) -> int:
+        return len(self.k)
+
+    def _pools(self):
+        pools = [("k", self.k), ("v", self.v)]
+        if self.quantized:
+            pools += [("k_scale", self.k_scale), ("v_scale", self.v_scale)]
+        return pools
+
+
+def init_paged_cache(
+    config: LlamaConfig,
+    num_pages: int,
+    page_size: int,
+    kv_quant: str = "none",
+) -> PagedKVCache:
+    if kv_quant not in KV_QUANT_MODES:
+        raise ValueError(
+            f"unknown kv_quant {kv_quant!r}; expected one of {KV_QUANT_MODES}"
+        )
+    quant = kv_quant == "int8"
+    kv_dtype = jnp.int8 if quant else config.dtype
+    shape = (num_pages, page_size, config.n_kv_heads, config.head_dim)
+    sshape = (num_pages, page_size, config.n_kv_heads)
+    L = config.n_layers
+    return PagedKVCache(
+        k=tuple(jnp.zeros(shape, kv_dtype) for _ in range(L)),
+        v=tuple(jnp.zeros(shape, kv_dtype) for _ in range(L)),
+        k_scale=tuple(jnp.zeros(sshape, jnp.float32) for _ in range(L))
+        if quant else None,
+        v_scale=tuple(jnp.zeros(sshape, jnp.float32) for _ in range(L))
+        if quant else None,
+    )
+
+
+def zero_pages(cache: PagedKVCache, page_ids) -> PagedKVCache:
+    """Zero the listed pages in every pool (values AND scales) — the
+    free-side half of the per-page zero-tail invariant. Host-side (runs
+    between engine chunks, not inside the jitted step); ``page_ids`` is
+    a host list/array of pool indices."""
+    ids = jnp.asarray(list(page_ids), jnp.int32)
+    if ids.size == 0:
+        return cache
+    out = {}
+    for name, pool in cache._pools():
+        out[name] = tuple(p.at[ids].set(0) for p in pool)
+    return PagedKVCache(**out)
+
+
+def tail_is_zero(cache: PagedKVCache, pages, length: int) -> bool:
+    """Does the per-page zero-tail invariant hold for a sequence that
+    owns ``pages`` (ordered page ids) with ``length`` positions written?
+    Checks every pool slot of the sequence's pages at positions >=
+    length — values and scales — across all layers. Host/test helper."""
+    page = cache.page_size
+    ok = True
+    for j, pid in enumerate(pages):
+        lo = max(0, min(page, length - j * page))
+        if lo >= page:
+            continue
+        for _, pool in cache._pools():
+            for layer in pool:
+                tail = layer[pid, lo:]
+                ok = ok and bool(
+                    jnp.sum(jnp.abs(tail.astype(jnp.float32))) == 0
+                )
+    return ok
+
+
+def pages_are_zero(cache: PagedKVCache, page_ids) -> bool:
+    """True when every listed page is entirely zero in every pool (the
+    free-page invariant — what a sequence admitted onto a recycled page
+    relies on for its own tail)."""
+    for pid in page_ids:
+        for _, pool in cache._pools():
+            for layer in pool:
+                if bool(
+                    jnp.sum(jnp.abs(layer[pid].astype(jnp.float32))) != 0
+                ):
+                    return False
+    return True
