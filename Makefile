@@ -3,7 +3,7 @@
 IMAGE_REPO ?= registry.local/tpu-dra-driver
 IMAGE_TAG  ?= v0.1.0
 
-.PHONY: all native test test-slow bench decodebench allocbench enginebench image bats lint lint-fast shlint chaos crashmatrix apisoak ci clean
+.PHONY: all native test test-slow bench decodebench allocbench enginebench shardbench image bats lint lint-fast shlint chaos crashmatrix apisoak ci clean
 
 all: native test
 
@@ -51,6 +51,16 @@ allocbench:
 # as `bench.py --leg-serve` and lands in BENCH_r*.json.
 enginebench:
 	python -m tpu_dra.workloads.enginebench --smoke
+
+# Mesh-sharded decode CPU smoke (ISSUE 8): the (batch x model) decode
+# mesh degrades gracefully ((1,1) on one chip), the sharding rules
+# engage (model-axis specs on the column-parallel kernels), and BOTH
+# the greedy path and the full serving engine are TOKEN-IDENTICAL
+# sharded-vs-unsharded on (1,1) and (1,2) CPU meshes — the exactness
+# contract documented in workloads/parallel/mesh.py. The timed sharded
+# leg runs inside `python bench.py` as decode_sharded_tok_s.
+shardbench:
+	python -m tpu_dra.workloads.shardbench
 
 GIT_COMMIT ?= $(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
@@ -137,7 +147,7 @@ shlint:
 # (flakes surface in CI, not in the judge's rerun), the 13 bats suites
 # executed against the minicluster, the batsless process-level e2e, and
 # the bench artifact schema gate.
-ci: lint lint-fast shlint native chaos crashmatrix apisoak decodebench allocbench enginebench
+ci: lint lint-fast shlint native chaos crashmatrix apisoak decodebench allocbench enginebench shardbench
 	python -m pytest tests/ -q -m 'not slow'
 	python -m pytest tests/ -q -m 'not slow'
 	python -m pytest tests/test_chaos.py -q -m slow

@@ -445,6 +445,45 @@ def _leg_decode_main() -> int:
         {"batch": batch, "prompt_len": prompt_len,
          "new_tokens": new_tokens, "reps": reps}
     )
+    # Step-breakdown profiler (ISSUE 8 tentpole): attribute the decode
+    # step to attention vs qkv/wo vs MLP vs embed/norm vs logits vs
+    # sampling at mid-horizon context — the measurement the fusion work
+    # is driven by (and the per-component account of the sampled-vs-
+    # greedy gap). Recorded as decode_step_breakdown in the final JSON.
+    from tpu_dra.workloads.decodebench import measure_step_breakdown
+
+    results["step_breakdown"] = measure_step_breakdown(
+        config, params, batch, prompt_len + new_tokens // 2,
+        reps=int(os.environ.get("BENCH_BREAKDOWN_REPS", "10")),
+    )
+    # Mesh-sharded decode (ISSUE 8): the same greedy program over
+    # decode-sharded params on a (batch x model) mesh across every chip
+    # this claim env exposes — (1, 1) on a single chip, so the key is
+    # comparable across topologies and the multi-chip win shows up the
+    # round a ComputeDomain claim backs the leg.
+    from tpu_dra.workloads.parallel import mesh as meshlib
+
+    dmesh = meshlib.build_decode_mesh(config)
+    sparams = meshlib.shard_decode_params(dmesh, params)
+    # Multi-device mesh: pallas custom calls have no SPMD rule — run the
+    # XLA decode paths (sharded_safe_config); (1, 1) keeps the kernels.
+    scfg = meshlib.sharded_safe_config(config, dmesh)
+    sharded_fn = jax.jit(
+        lambda p, t: greedy_generate(
+            scfg, p, t, max_new_tokens=new_tokens
+        )
+    )
+    out = sharded_fn(sparams, prompt)
+    fetch(out)  # compile outside the timing
+    t0 = time.monotonic()
+    for _ in range(reps):
+        out = sharded_fn(sparams, prompt)
+    fetch(out)
+    dt = time.monotonic() - t0
+    results["sharded_tok_s"] = batch * new_tokens * reps / dt
+    results["mesh"] = (
+        f"{dmesh.shape['batch']}x{dmesh.shape['model']}"
+    )
     # Quantified roofline (r5 VERDICT #4, extended r6): per-step HBM
     # floor = (matmul weight bytes + KV-cache bytes) / peak BW, vs the
     # measured per-step wall time, for each storage config. int8 KV
@@ -1602,6 +1641,18 @@ def main() -> int:
         f"({decode['roofline']['hbm_floor_ms_int8kv']}ms)",
         file=sys.stderr,
     )
+    bd = decode["step_breakdown"]
+    print(
+        f"decode step breakdown (ctx {bd['ctx_len']}): attention "
+        f"{bd['attention_ms']}ms ({bd['attention_frac']}), qkv "
+        f"{bd['qkv_ms']}ms, wo {bd['attn_out_ms']}ms, mlp "
+        f"{bd['mlp_ms']}ms, logits {bd['logits_ms']}ms, sampling "
+        f"{bd['sampling_ms']}ms (sampled step {bd['sampled_step_ms']}ms "
+        f"vs greedy {bd['step_ms']}ms), residual {bd['residual_ms']}ms; "
+        f"sharded decode ({decode['mesh']} mesh): "
+        f"{decode['sharded_tok_s']:.1f} tok/s",
+        file=sys.stderr,
+    )
 
     # Serving engine (ISSUE 7): continuous batching + paged KV vs the
     # fixed-batch baseline at equal batch memory, under a seeded Poisson
@@ -1619,7 +1670,8 @@ def main() -> int:
         f"{serve['serve_p99_ms']:.0f} ms (baseline p50 "
         f"{serve['serve_baseline_p50_ms']:.0f} p99 "
         f"{serve['serve_baseline_p99_ms']:.0f}); w8 engine "
-        f"{serve['serve_w8_tok_s']:.1f} tok/s",
+        f"{serve['serve_w8_tok_s']:.1f} tok/s, sampled engine "
+        f"{serve['serve_sampled_tok_s']:.1f} tok/s",
         file=sys.stderr,
     )
 
@@ -1712,6 +1764,17 @@ def main() -> int:
                 ],
                 "decode_sampled_vs_greedy": decode["sampled_vs_greedy"],
                 "decode_roofline": decode["roofline"],
+                # Step-breakdown profiler + mesh-sharded decode
+                # (ISSUE 8): per-component attribution of the decode
+                # step (the roofline work's measurement), and the same
+                # greedy program over a (batch x model) decode mesh —
+                # (1, 1) on one chip, every chip of a ComputeDomain's
+                # rendered env otherwise.
+                "decode_step_breakdown": decode["step_breakdown"],
+                "decode_sharded_tok_s": round(
+                    decode["sharded_tok_s"], 1
+                ),
+                "decode_mesh": decode["mesh"],
                 # Serving engine (ISSUE 7): sustained useful tok/s and
                 # per-request latency under the seeded Poisson trace,
                 # vs the fixed-batch baseline at equal batch memory —
@@ -1723,6 +1786,8 @@ def main() -> int:
                 "serve_p99_ms": serve["serve_p99_ms"],
                 "serve_ttft_p50_ms": serve["serve_ttft_p50_ms"],
                 "serve_w8_tok_s": serve["serve_w8_tok_s"],
+                # Sampling inside the engine scan (ISSUE 8 satellite).
+                "serve_sampled_tok_s": serve["serve_sampled_tok_s"],
                 "serve_baseline_tok_s": serve["serve_baseline_tok_s"],
                 "serve_baseline_padded_tok_s": serve[
                     "serve_baseline_padded_tok_s"

@@ -76,6 +76,16 @@ TYPED_WHEN_PRESENT = {
     "serve_baseline_p99_ms": (int, float),
     "serve_vs_fixed_batch": (int, float),
     "decode_padding_waste": (int, float),
+    # Decode-roofline instrumentation (ISSUE 8): the per-component step
+    # breakdown (dict of *_ms/*_frac), mesh-sharded decode throughput +
+    # the mesh shape it ran on, and the sampled serving engine. The
+    # B100 pass forward-requires decode_step_breakdown /
+    # decode_sharded_tok_s / serve_sampled_tok_s ahead of their first
+    # recorded artifact.
+    "decode_step_breakdown": dict,
+    "decode_sharded_tok_s": (int, float),
+    "decode_mesh": str,
+    "serve_sampled_tok_s": (int, float),
 }
 
 

@@ -27,9 +27,13 @@ from lints.registry import register
 
 # Keys a new leg must keep in bench.py's final JSON dict, artifact or
 # not (see module doc): the allocator microbench's headline keys
-# (ISSUE 6) and the serving-engine leg's (ISSUE 7 — sustained tok/s +
+# (ISSUE 6), the serving-engine leg's (ISSUE 7 — sustained tok/s +
 # per-request latency under the Poisson trace; dropping them would
-# silently retire the continuous-batching regression tripwire).
+# silently retire the continuous-batching regression tripwire), and the
+# decode-roofline instrumentation (ISSUE 8 — the step-breakdown dict
+# the fusion work is driven by, the mesh-sharded decode rate, and the
+# sampled-engine rate; dropping any of them would blind the roofline
+# trend gate the doctor now enforces).
 REQUIRED_STATIC = (
     "alloc_p50_ms",
     "alloc_p99_ms",
@@ -38,6 +42,9 @@ REQUIRED_STATIC = (
     "serve_tok_s",
     "serve_p50_ms",
     "serve_p99_ms",
+    "decode_step_breakdown",
+    "decode_sharded_tok_s",
+    "serve_sampled_tok_s",
 )
 
 

@@ -534,3 +534,142 @@ def test_metrics_probe_quiet_on_healthy_engine(tmp_path):
     finally:
         srv.stop()
         srv2.stop()
+
+
+# --- decode-roofline trend gate (ISSUE 8) ------------------------------------
+
+
+def _bench_artifact(tmp_path, n, x, wrapped=True, key="decode_x_above_bf16_floor"):
+    payload = {key: x, "decode_tok_s": 9000.0}
+    data = {"n": n, "parsed": payload} if wrapped else payload
+    (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps(data))
+
+
+def test_bench_trend_regression_warns(tmp_path):
+    state, lib = make_state(tmp_path)
+    _bench_artifact(tmp_path, 5, 3.16)
+    _bench_artifact(tmp_path, 6, 3.60)  # +14% — past the 10% gate
+    report = collect(
+        str(tmp_path / "data"), str(tmp_path / "cdi"),
+        str(tmp_path / "mux"), tpulib=lib, bench_dir=str(tmp_path),
+    )
+    warns = [w for w in report["warnings"] if "roofline REGRESSED" in w]
+    assert warns, report["warnings"]
+    assert "decode_step_breakdown" in warns[0]  # remediation hint
+    assert report["bench_trend"]["latest"]["x"] == 3.6
+    assert "BENCH_r06" in render(report)
+
+
+def test_bench_trend_improvement_and_small_wobble_quiet(tmp_path):
+    state, lib = make_state(tmp_path)
+    _bench_artifact(tmp_path, 5, 3.16)
+    _bench_artifact(tmp_path, 6, 1.42)  # the goal trend: improvement
+    report = collect(
+        str(tmp_path / "data"), str(tmp_path / "cdi"),
+        str(tmp_path / "mux"), tpulib=lib, bench_dir=str(tmp_path),
+    )
+    assert not any("roofline" in w for w in report["warnings"])
+    _bench_artifact(tmp_path, 7, 1.48)  # +4% wobble: under the gate
+    report = collect(
+        str(tmp_path / "data"), str(tmp_path / "cdi"),
+        str(tmp_path / "mux"), tpulib=lib, bench_dir=str(tmp_path),
+    )
+    assert not any("roofline" in w for w in report["warnings"])
+    # The trend compares the two NEWEST artifacts, not first-vs-last.
+    assert report["bench_trend"]["previous"]["x"] == 1.42
+
+
+def test_bench_trend_suffix_matched_and_tolerant(tmp_path):
+    """Artifacts predating the key (or unparseable) are skipped, the key
+    is suffix-matched like the scheduler gauges, and < 2 carriers means
+    no verdict (and no crash)."""
+    state, lib = make_state(tmp_path)
+    (tmp_path / "BENCH_r01.json").write_text("{not json")
+    _bench_artifact(tmp_path, 5, 0, key="decode_tok_s_only")  # no carrier
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps({"parsed": {"decode_tok_s": 1.0}})
+    )
+    _bench_artifact(tmp_path, 3, 3.16, wrapped=False)  # unwrapped form
+    report = collect(
+        str(tmp_path / "data"), str(tmp_path / "cdi"),
+        str(tmp_path / "mux"), tpulib=lib, bench_dir=str(tmp_path),
+    )
+    assert not any("roofline" in w for w in report["warnings"])
+    assert "latest" not in report["bench_trend"]
+    # A second carrier under a renamed-but-suffixed key still engages.
+    (tmp_path / "BENCH_r04.json").write_text(
+        json.dumps({"parsed": {"serving_x_above_bf16_floor": 4.0}})
+    )
+    report = collect(
+        str(tmp_path / "data"), str(tmp_path / "cdi"),
+        str(tmp_path / "mux"), tpulib=lib, bench_dir=str(tmp_path),
+    )
+    assert any("roofline REGRESSED" in w for w in report["warnings"])
+
+
+def test_bench_trend_absent_without_bench_dir(tmp_path):
+    state, lib = make_state(tmp_path)
+    report = run_collect(tmp_path, lib)
+    assert "bench_trend" not in report
+
+
+def test_bench_trend_reads_nested_roofline_key(tmp_path):
+    """BENCH_r05 and earlier carry the ratio only inside the
+    decode_roofline dict — the suffix match must search one nested
+    level or the gate is disarmed for the first real comparison."""
+    state, lib = make_state(tmp_path)
+    (tmp_path / "BENCH_r05.json").write_text(json.dumps(
+        {"parsed": {"decode_roofline": {"x_above_bf16_floor": 3.16}}}
+    ))
+    _bench_artifact(tmp_path, 6, 3.60)  # new top-level form, +14%
+    report = collect(
+        str(tmp_path / "data"), str(tmp_path / "cdi"),
+        str(tmp_path / "mux"), tpulib=lib, bench_dir=str(tmp_path),
+    )
+    assert any("roofline REGRESSED" in w for w in report["warnings"])
+    assert report["bench_trend"]["previous"]["x"] == 3.16
+
+
+def test_render_still_prints_notes(tmp_path):
+    """Regression pin: inserting the bench-trend render line must not
+    swallow the notes section (missing CDI root / no checkpoint)."""
+    state, lib = make_state(tmp_path)
+    report = collect(
+        str(tmp_path / "data"), str(tmp_path / "missing-cdi"),
+        str(tmp_path / "mux"), tpulib=lib, bench_dir=str(tmp_path),
+    )
+    out = render(report)
+    assert "note:" in out and "missing-cdi" in out
+
+
+def test_bench_trend_skips_non_object_artifact(tmp_path):
+    """Valid JSON that is not an object (truncated/mis-redirected bench
+    output) is skipped like any other unparseable artifact — one bad
+    file must not cost the whole diagnostic run."""
+    state, lib = make_state(tmp_path)
+    (tmp_path / "BENCH_r01.json").write_text("[1, 2, 3]")
+    (tmp_path / "BENCH_r02.json").write_text('"half a redirect"')
+    _bench_artifact(tmp_path, 5, 3.16)
+    _bench_artifact(tmp_path, 6, 3.60)
+    report = collect(
+        str(tmp_path / "data"), str(tmp_path / "cdi"),
+        str(tmp_path / "mux"), tpulib=lib, bench_dir=str(tmp_path),
+    )
+    assert any("roofline REGRESSED" in w for w in report["warnings"])
+
+
+def test_bench_trend_sorts_rounds_numerically(tmp_path):
+    """BENCH_r100 must compare against BENCH_r99, not sort between r10
+    and r11 — lexicographic order would freeze the gate at three-digit
+    rounds."""
+    state, lib = make_state(tmp_path)
+    _bench_artifact(tmp_path, 98, 2.0)
+    _bench_artifact(tmp_path, 99, 2.0)
+    _bench_artifact(tmp_path, 100, 2.5)  # +25% in the true newest
+    report = collect(
+        str(tmp_path / "data"), str(tmp_path / "cdi"),
+        str(tmp_path / "mux"), tpulib=lib, bench_dir=str(tmp_path),
+    )
+    assert any("roofline REGRESSED" in w for w in report["warnings"])
+    assert report["bench_trend"]["latest"]["path"] == "BENCH_r100.json"
+    assert report["bench_trend"]["previous"]["path"] == "BENCH_r99.json"

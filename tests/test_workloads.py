@@ -855,3 +855,258 @@ def test_quantize_rejects_unexpected_kernel_nodes():
         }})
     with pytest.raises(ValueError, match="unquantizable"):
         quantize_params({"wq": {"kernel": jnp.ones((4,))}})
+
+
+# --- fused decode MLP block (ISSUE 8) ----------------------------------------
+
+
+def _mlp_tree(seed, d, f, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 4)
+    return (
+        jax.random.normal(ks[0], (d,), dtype),  # norm scale
+        {
+            "w_gate": {"kernel": jax.random.normal(ks[1], (d, f), dtype)},
+            "w_up": {"kernel": jax.random.normal(ks[2], (d, f), dtype)},
+            "w_down": {"kernel": jax.random.normal(ks[3], (f, d), dtype)},
+        },
+    )
+
+
+def test_decode_mlp_xla_matches_reference():
+    from tpu_dra.workloads.ops import decode_mlp as DM
+
+    scale, mlp = _mlp_tree(0, d=64, f=128)
+    x = jax.random.normal(jax.random.PRNGKey(9), (3, 64), jnp.float32)
+    ref = DM.decode_mlp(x, scale, mlp, 1e-5, impl="reference")
+    xla = DM.decode_mlp(x, scale, mlp, 1e-5, impl="xla")
+    assert float(jnp.max(jnp.abs(xla - ref))) < 1e-4
+
+
+def test_decode_mlp_pallas_interpret_matches_reference(monkeypatch):
+    from tpu_dra.workloads.ops import attention as A
+    from tpu_dra.workloads.ops import decode_mlp as DM
+
+    monkeypatch.setattr(A, "_INTERPRET", True)
+    scale, mlp = _mlp_tree(1, d=256, f=512)
+    x = jax.random.normal(jax.random.PRNGKey(2), (4, 256), jnp.float32)
+    ref = DM.decode_mlp(x, scale, mlp, 1e-5, impl="reference")
+    for bf in (128, 512):
+        got = DM.decode_mlp(
+            x, scale, mlp, 1e-5, impl="pallas", block_f=bf
+        )
+        rel = float(
+            jnp.max(jnp.abs(got - ref)) / jnp.max(jnp.abs(ref))
+        )
+        assert rel < 1e-5, f"block_f={bf}: rel err {rel}"
+    # auto under interpret (stand-in for TPU) picks pallas for aligned
+    # shapes...
+    DM._LAST_DECODE_MLP_IMPL = None
+    DM.decode_mlp(x, scale, mlp, 1e-5)
+    assert DM._LAST_DECODE_MLP_IMPL == "pallas"
+    # ...and falls back to xla for unaligned or int8 trees.
+    scale2, mlp2 = _mlp_tree(2, d=64, f=96)
+    x2 = jax.random.normal(jax.random.PRNGKey(3), (2, 64), jnp.float32)
+    DM._LAST_DECODE_MLP_IMPL = None
+    DM.decode_mlp(x2, scale2, mlp2, 1e-5)
+    assert DM._LAST_DECODE_MLP_IMPL == "xla"
+    from tpu_dra.workloads.quantize import quantize_params
+
+    qmlp = quantize_params(mlp)
+    DM._LAST_DECODE_MLP_IMPL = None
+    out_q = DM.decode_mlp(x, scale, qmlp, 1e-5)
+    assert DM._LAST_DECODE_MLP_IMPL == "xla"
+    assert out_q.shape == x.shape
+    with pytest.raises(ValueError, match="plain 2D kernels"):
+        DM.decode_mlp(x, scale, qmlp, 1e-5, impl="pallas")
+
+
+def test_decode_step_dispatches_fused_mlp():
+    """greedy_generate's s=1 steps must route the norm+MLP chain through
+    ops/decode_mlp.py (a silent fall-through to the inline chain would
+    void the fusion-inventory claim)."""
+    import dataclasses
+
+    from tpu_dra.workloads.generate import greedy_generate
+    from tpu_dra.workloads.ops import decode_mlp as DM
+
+    cfg = dataclasses.replace(
+        TINY_LLAMA, dtype=jnp.float32, param_dtype=jnp.float32
+    )
+    params = Llama(cfg).init_params(jax.random.PRNGKey(7), batch=2, seq=8)
+    prompt = jnp.tile(jnp.arange(8, dtype=jnp.int32)[None], (2, 1))
+    DM._LAST_DECODE_MLP_IMPL = None
+    greedy_generate(cfg, params, prompt, 4)
+    assert DM._LAST_DECODE_MLP_IMPL in ("xla", "pallas")
+
+
+def test_generate_weight_quant_knob_matches_external_quantization():
+    """weight_quant="int8" on greedy_generate == quantizing the tree
+    yourself and passing it in — the knob is sugar, not a third path."""
+    import dataclasses
+
+    from tpu_dra.workloads.generate import greedy_generate
+    from tpu_dra.workloads.quantize import quantize_params
+
+    cfg = dataclasses.replace(
+        TINY_LLAMA, dtype=jnp.float32, param_dtype=jnp.float32
+    )
+    params = Llama(cfg).init_params(jax.random.PRNGKey(7), batch=2, seq=8)
+    prompt = jnp.tile(jnp.arange(8, dtype=jnp.int32)[None], (2, 1))
+    via_knob = greedy_generate(cfg, params, prompt, 6, weight_quant="int8")
+    external = greedy_generate(cfg, quantize_params(params), prompt, 6)
+    assert np.array_equal(np.asarray(via_knob), np.asarray(external))
+    with pytest.raises(ValueError, match="unknown weight_quant"):
+        greedy_generate(cfg, params, prompt, 2, weight_quant="fp4")
+
+
+def test_step_breakdown_schema_and_consistency():
+    """The decode_step_breakdown contract bench.py records: every
+    component key present, positive, fractions normalized by step_ms."""
+    import dataclasses
+
+    from tpu_dra.workloads.decodebench import measure_step_breakdown
+
+    cfg = dataclasses.replace(
+        TINY_LLAMA, dtype=jnp.float32, param_dtype=jnp.float32
+    )
+    params = Llama(cfg).init_params(jax.random.PRNGKey(7), batch=2, seq=8)
+    bd = measure_step_breakdown(cfg, params, batch=2, ctx_len=20, reps=2)
+    for key in (
+        "step_ms", "sampled_step_ms", "sampling_ms", "attention_ms",
+        "qkv_ms", "attn_out_ms", "mlp_ms", "embed_norm_ms", "logits_ms",
+        "residual_ms", "sampled_overhead_ms",
+    ):
+        assert key in bd, key
+        if key.endswith("_ms") and key not in (
+            "residual_ms", "sampled_overhead_ms"
+        ):
+            assert bd[key] > 0, (key, bd[key])
+    assert bd["ctx_len"] == 20 and bd["batch"] == 2
+    assert abs(
+        bd["attention_frac"] - bd["attention_ms"] / bd["step_ms"]
+    ) < 0.01
+
+
+# --- decode mesh (ISSUE 8) ---------------------------------------------------
+
+
+def test_decode_mesh_shape_ladder_and_clamp():
+    from tpu_dra.workloads.parallel import mesh as meshlib
+
+    assert meshlib.decode_mesh_shape(1) == (1, 1)
+    assert meshlib.decode_mesh_shape(2) == (1, 2)
+    assert meshlib.decode_mesh_shape(4) == (2, 2)
+    assert meshlib.decode_mesh_shape(8) == (2, 4)
+    # TINY_LLAMA has 2 kv heads: the model axis clamps to 2 at 8
+    # devices and the remainder folds into batch.
+    assert meshlib.decode_mesh_shape(8, TINY_LLAMA) == (4, 2)
+    assert meshlib.decode_mesh_shape(2, TINY_LLAMA) == (1, 2)
+    assert meshlib.decode_mesh_shape(1, TINY_LLAMA) == (1, 1)
+
+
+def test_decode_param_spec_rules():
+    from tpu_dra.workloads.parallel import mesh as meshlib
+
+    assert meshlib.decode_param_spec("layer_0/attention/wq/kernel") == P(
+        None, "model"
+    )
+    assert meshlib.decode_param_spec("layer_0/mlp/w_gate/kernel") == P(
+        None, "model"
+    )
+    assert meshlib.decode_param_spec("lm_head/kernel") == P(None, "model")
+    # int8 weight-only: kernel_q takes the kernel's spec, its scale
+    # replicates.
+    assert meshlib.decode_param_spec(
+        "layer_0/mlp/w_up/kernel_q"
+    ) == P(None, "model")
+    assert meshlib.decode_param_spec("layer_0/mlp/w_up/scale") == P()
+    # Contraction-splitting layouts stay replicated (the exactness
+    # contract): wo, w_down, embed, norms.
+    assert meshlib.decode_param_spec("layer_0/attention/wo/kernel") == P()
+    assert meshlib.decode_param_spec("layer_0/mlp/w_down/kernel") == P()
+    assert meshlib.decode_param_spec("embed/embedding") == P()
+    assert meshlib.decode_param_spec("final_norm/scale") == P()
+
+
+def test_sharded_greedy_decode_token_identical():
+    """The shardbench contract as a tier-1 pin: greedy_generate over
+    decode-sharded params on the (1, 2) mesh == the unsharded run,
+    token for token."""
+    import dataclasses
+
+    from tpu_dra.workloads.generate import greedy_generate
+    from tpu_dra.workloads.parallel import mesh as meshlib
+
+    cfg = dataclasses.replace(
+        TINY_LLAMA, dtype=jnp.float32, param_dtype=jnp.float32
+    )
+    params = Llama(cfg).init_params(jax.random.PRNGKey(7), batch=2, seq=8)
+    prompt = jnp.tile(jnp.arange(8, dtype=jnp.int32)[None], (2, 1))
+    fn = jax.jit(lambda p, t: greedy_generate(cfg, p, t, max_new_tokens=8))
+    base = np.asarray(fn(params, prompt))
+    mesh = meshlib.build_decode_mesh(cfg, jax.devices()[:2])
+    assert dict(mesh.shape) == {"batch": 1, "model": 2}
+    sharded = np.asarray(fn(meshlib.shard_decode_params(mesh, params), prompt))
+    assert np.array_equal(base, sharded)
+
+
+def test_decode_mesh_clamp_steps_through_odd_ladders():
+    """A non-power-of-2 ladder value must not collapse to a batch-only
+    mesh when a smaller model axis fits: 12 devices with 8 kv heads
+    lands on (3, 4), not (12, 1)."""
+    import dataclasses
+
+    from tpu_dra.workloads.parallel import mesh as meshlib
+
+    cfg = dataclasses.replace(
+        TINY_LLAMA, n_kv_heads=8, n_heads=8, ffn_dim=128, vocab_size=256
+    )
+    assert meshlib.decode_mesh_shape(12, cfg) == (3, 4)
+    assert meshlib.decode_mesh_shape(6, cfg) == (3, 2)
+
+
+def test_sharded_safe_config_forces_xla_on_multi_device_mesh():
+    """pallas custom calls have no SPMD partitioning rule: under a
+    multi-device mesh every pallas-capable decode op must take its XLA
+    path; a (1, 1) mesh keeps the config untouched."""
+    from tpu_dra.workloads.parallel import mesh as meshlib
+
+    mesh1 = meshlib.build_decode_mesh(TINY_LLAMA, jax.devices()[:1])
+    assert meshlib.sharded_safe_config(TINY_LLAMA, mesh1) is TINY_LLAMA
+    mesh2 = meshlib.build_decode_mesh(TINY_LLAMA, jax.devices()[:2])
+    safe = meshlib.sharded_safe_config(TINY_LLAMA, mesh2)
+    assert safe.decode_impl == "xla"
+    assert safe.decode_mlp_impl == "xla"
+    assert safe.paged_decode_impl == "xla"
+
+
+def test_decode_mlp_block_picker_is_lane_aligned():
+    """The ffn block width must be a multiple of 128 lanes AND divide
+    ffn — a plain largest-divisor search returns 344 for LLaMA-7B's
+    ffn 11008, which mosaic rejects; the right answer under a 512
+    target is 256. No viable width -> None (dispatch keeps xla)."""
+    from tpu_dra.workloads.ops.decode_mlp import (
+        _mlp_pallas_ok,
+        _pick_block_f,
+    )
+
+    assert _pick_block_f(11008, 4096, 2, 512) == 256
+    assert _pick_block_f(8192, 2048, 2, 512) == 512
+    assert _pick_block_f(512, 256, 4, 128) == 128
+    # Budget cap can exclude every aligned width.
+    assert _pick_block_f(11008, 4096, 2, 512) is not None
+    assert _pick_block_f(128, 10_000_000, 4, 512) is None
+    blocked = {
+        "w_gate": {"kernel": jnp.zeros((128, 11008), jnp.float32)},
+        "w_up": {"kernel": jnp.zeros((128, 11008), jnp.float32)},
+        "w_down": {"kernel": jnp.zeros((11008, 128), jnp.float32)},
+    }
+    from tpu_dra.workloads.ops import attention as A
+
+    orig = A._INTERPRET
+    A._INTERPRET = True
+    try:
+        x = jnp.zeros((2, 128), jnp.float32)
+        assert _mlp_pallas_ok(x, blocked, 512)  # 256 fits
+    finally:
+        A._INTERPRET = orig

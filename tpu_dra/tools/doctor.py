@@ -107,6 +107,17 @@ ENGINE_EXHAUSTED_COUNTER = "engine_page_exhausted_total"
 # queue.
 ENGINE_STALL_WARN_SECONDS = 1.0
 
+# Decode-roofline trend gate (ISSUE 8): the key bench.py records as the
+# gap between the measured decode step and the bf16 HBM floor. Matched
+# by SUFFIX inside the artifact (like the scheduler/engine gauges): the
+# key lives at the top level today and inside decode_roofline as
+# x_above_bf16_floor — a rename/move between rounds must not silently
+# disarm the gate. A >10% climb between the two newest BENCH_r*.json
+# artifacts means the serving perf work regressed and nothing else
+# caught it.
+BENCH_TREND_KEY = "x_above_bf16_floor"
+BENCH_TREND_REGRESSION = 0.10
+
 
 def _scrape(endpoint: str, timeout: float = 2.0) -> Dict[str, float]:
     """Fetch and parse a Prometheus text endpoint into
@@ -323,6 +334,84 @@ def _check_engine(
     return out
 
 
+def _bench_floor_x(path: str) -> Optional[float]:
+    """decode_x_above_bf16_floor from one BENCH_r*.json, suffix-matched
+    over the (possibly "parsed"-wrapped) top level; None when the
+    artifact predates the key or doesn't parse (older rounds are not
+    evidence of anything)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(data, dict):
+        # Valid JSON but not an object (truncated/mis-redirected bench
+        # output): skip it like any other unparseable artifact.
+        return None
+    if isinstance(data.get("parsed"), dict):
+        data = data["parsed"]
+    # Top level first, then one nested level: older artifacts (BENCH_r05
+    # and earlier) carry the ratio only inside the decode_roofline dict
+    # — an exact-location match would silently disarm the gate for the
+    # first real comparison.
+    for sample in [data] + [
+        v for _, v in sorted(data.items()) if isinstance(v, dict)
+    ]:
+        for key in sorted(sample):
+            if key.endswith(BENCH_TREND_KEY):
+                value = sample[key]
+                if isinstance(value, (int, float)):
+                    return float(value)
+    return None
+
+
+def check_bench_trend(bench_dir: str, warn) -> Dict[str, object]:
+    """Compare decode_x_above_bf16_floor across the two newest
+    BENCH_r*.json artifacts (the ISSUE 8 trend gate: the roofline goal
+    is a TREND in BENCH_r*, not a one-off number) and WARN on a >10%
+    regression. Silent when fewer than two artifacts carry the key."""
+    import glob as _glob
+    import re as _re
+
+    def _round_of(path: str) -> int:
+        m = _re.search(r"BENCH_r(\d+)", os.path.basename(path))
+        return int(m.group(1)) if m else -1
+
+    # Numeric round order, not lexicographic: BENCH_r100.json must sort
+    # AFTER BENCH_r99.json or the gate is permanently stuck comparing
+    # two stale artifacts once rounds gain a digit.
+    paths = sorted(
+        _glob.glob(os.path.join(bench_dir, "BENCH_r*.json")),
+        key=_round_of,
+    )
+    carrying = [
+        (p, x) for p in paths
+        if (x := _bench_floor_x(p)) is not None
+    ]
+    out: Dict[str, object] = {"artifacts": len(paths)}
+    if len(carrying) < 2:
+        return out
+    (prev_path, prev), (last_path, last) = carrying[-2], carrying[-1]
+    out.update({
+        "previous": {"path": os.path.basename(prev_path), "x": prev},
+        "latest": {"path": os.path.basename(last_path), "x": last},
+    })
+    if prev > 0 and last > prev * (1.0 + BENCH_TREND_REGRESSION):
+        warn(
+            f"decode roofline REGRESSED: {os.path.basename(last_path)} "
+            f"has decode_x_above_bf16_floor = {last:g} vs {prev:g} in "
+            f"{os.path.basename(prev_path)} (> {BENCH_TREND_REGRESSION:.0%} "
+            f"climb) — the decode step moved AWAY from the bf16 HBM "
+            f"floor. Check decode_step_breakdown in the artifact for the "
+            f"component that grew (attention vs mlp vs logits vs "
+            f"sampling), whether the fused decode attention/MLP paths "
+            f"still dispatch (make decodebench asserts both), and the "
+            f"sharded-decode mesh shape (docs/serving.md 'Decode "
+            f"roofline')"
+        )
+    return out
+
+
 def collect(
     plugin_data_dir: str,
     cdi_root: str,
@@ -330,6 +419,7 @@ def collect(
     tpulib=None,
     metrics_endpoints: Optional[List[str]] = None,
     metrics_interval: float = 0.0,
+    bench_dir: Optional[str] = None,
 ) -> dict:
     """Gather every section; pure data (rendering and exit codes are the
     caller's problem, so tests and future UIs can reuse this)."""
@@ -547,6 +637,10 @@ def collect(
         report["metrics"] = probe_metrics(
             metrics_endpoints, interval=metrics_interval, warn=warn
         )
+
+    # --- bench artifact trend (decode roofline) ---
+    if bench_dir:
+        report["bench_trend"] = check_bench_trend(bench_dir, warn)
     return report
 
 
@@ -631,6 +725,20 @@ def render(report: dict) -> str:
             lines.append(f"  engine: {' '.join(parts)}")
     for note in report.get("notes", []):
         lines.append(f"note: {note}")
+    trend = report.get("bench_trend")
+    if trend is not None:
+        if "latest" in trend:
+            lines.append(
+                f"bench      : decode_x_above_bf16_floor "
+                f"{trend['latest']['x']:g} ({trend['latest']['path']}) "
+                f"vs {trend['previous']['x']:g} "
+                f"({trend['previous']['path']})"
+            )
+        else:
+            lines.append(
+                f"bench      : {trend['artifacts']} artifact(s), no "
+                f"roofline trend yet"
+            )
     for w in report["warnings"]:
         lines.append(f"WARN: {w}")
     if not report["warnings"]:
@@ -666,12 +774,22 @@ def main(argv=None) -> int:
         help="Sample each metrics endpoint twice, this many seconds "
         "apart, and warn only on counters that climbed in the window",
     )
+    p.add_argument(
+        "--bench-dir",
+        default=os.environ.get("TPU_DRA_BENCH_DIR", ""),
+        help="Directory holding BENCH_r*.json artifacts; when given "
+        "(or TPU_DRA_BENCH_DIR is set) the doctor WARNs when "
+        "decode_x_above_bf16_floor regressed >10%% between the two "
+        "newest. OPT-IN: a bench perf trend is not node health, so a "
+        "plain doctor run never couples its exit code to it",
+    )
     p.add_argument("--json", action="store_true", dest="as_json")
     args = p.parse_args(argv)
     report = collect(
         args.plugin_data_dir, args.cdi_root, args.multiplex_socket_root,
         metrics_endpoints=args.metrics_endpoints,
         metrics_interval=args.metrics_interval,
+        bench_dir=args.bench_dir or None,
     )
     if args.as_json:
         print(json.dumps(report, indent=2))

@@ -27,6 +27,176 @@ import sys
 import time
 
 
+def measure_step_breakdown(
+    config,
+    params,
+    batch: int,
+    ctx_len: int,
+    reps: int = 10,
+    temperature: float = 0.8,
+    top_k: int = 40,
+) -> dict:
+    """Per-component timing of ONE decode step at a fixed context length
+    — the measurement ROADMAP item 4 demands before any fusion work:
+    attention is near its HBM floor (BENCH_r05: 1.046x), so the roofline
+    gap lives in everything AROUND it, and this attributes the step to
+    attention vs qkv/wo projections vs MLP vs embed/norm vs logits vs
+    sampling, each as its own jitted, fetch-closed timing over ``reps``
+    calls against the SAME cache state (the unrolled in-place layout the
+    bench decodes with).
+
+    Also times the full fused greedy step and the full sampled step, so
+    the ``decode_sampled_vs_greedy`` gap is attributable per-component
+    (``sampling_ms`` vs ``attention_ms`` — the ISSUE 8 satellite).
+    ``residual_ms`` = step - sum(parts): dispatch/fusion slack the
+    components don't explain (negative means XLA fuses across the
+    component boundaries — also worth knowing). Returns a JSON-ready
+    dict (the bench records it as ``decode_step_breakdown``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dra.workloads.generate import (
+        _mm,
+        _project_qkv,
+        _rms,
+        forward_chunk,
+        init_cache,
+        sample_token,
+        unroll_params,
+    )
+    from tpu_dra.workloads.icibandwidth import fetch
+    from tpu_dra.workloads.models.llama import rope_frequencies
+    from tpu_dra.workloads.ops.attention import decode_attention
+    from tpu_dra.workloads.ops.decode_mlp import decode_mlp
+
+    c = config
+    params = unroll_params(params)
+    max_seq = -(-(ctx_len + 1) // 64) * 64
+    rng = jax.random.PRNGKey(0)
+    prompt = jax.random.randint(
+        rng, (batch, ctx_len), 1, c.vocab_size, jnp.int32
+    )
+    cache = init_cache(c, batch, max_seq, stacked=False)
+    cache, _ = jax.jit(
+        lambda p, cc, t: forward_chunk(c, p, cc, t)
+    )(params, cache, prompt)
+    tok = prompt[:, -1:]
+    x = jax.random.normal(
+        jax.random.PRNGKey(1), (batch, 1, c.dim), c.dtype
+    )
+    q1 = jax.random.normal(
+        jax.random.PRNGKey(2), (batch, c.n_heads, c.head_dim), c.dtype
+    )
+    logits = jax.random.normal(
+        jax.random.PRNGKey(3), (batch, c.vocab_size), jnp.float32
+    )
+
+    def fetch_tree(out):
+        for leaf in jax.tree_util.tree_leaves(out):
+            fetch(leaf)
+
+    def timed(fn, *args) -> float:
+        f = jax.jit(fn)
+        fetch_tree(f(*args))  # compile + warm outside the timing
+        t0 = time.monotonic()
+        for _ in range(reps):
+            out = f(*args)
+        fetch_tree(out)
+        return (time.monotonic() - t0) / reps * 1e3
+
+    def attention_all(cc, q):
+        outs = []
+        for i in range(c.n_layers):
+            outs.append(decode_attention(
+                q, cc.k[i], cc.v[i], cc.pos,
+                k_scale=None if cc.k_scale is None else cc.k_scale[i],
+                v_scale=None if cc.v_scale is None else cc.v_scale[i],
+                impl=c.decode_impl, block_k=c.decode_block_k,
+            ))
+        return jnp.stack(outs)
+
+    def qkv_all(p, xx):
+        cos, sin = rope_frequencies(c, cache.pos + jnp.arange(1))
+        outs = []
+        for i in range(c.n_layers):
+            outs.append(_project_qkv(
+                c, p[f"layer_{i}"], xx, cos, sin, batch, 1
+            )[0])
+        return jnp.stack(outs)
+
+    def attn_out_all(p, q):
+        flat = q.reshape(batch, 1, c.n_heads * c.head_dim)
+        return jnp.stack([
+            _mm(flat, p[f"layer_{i}"]["attention"]["wo"])
+            for i in range(c.n_layers)
+        ])
+
+    def mlp_all(p, xx):
+        x2 = xx[:, 0]
+        outs = []
+        for i in range(c.n_layers):
+            lp = p[f"layer_{i}"]
+            outs.append(decode_mlp(
+                x2, lp["mlp_norm"]["scale"], lp["mlp"], c.norm_eps,
+                impl=c.decode_mlp_impl, block_f=c.decode_mlp_block_f,
+            ))
+        return jnp.stack(outs)
+
+    def embed_norm(p, t, xx):
+        emb = p["embed"]["embedding"].astype(c.dtype)[t]
+        return emb, _rms(xx, p["final_norm"]["scale"], c.norm_eps)
+
+    def logits_head(p, xx):
+        # The final norm is timed in embed_norm; this times ONLY the
+        # lm_head matmul (xx stands in for the normalized activation —
+        # same shape/dtype), so the parts sum counts the norm once.
+        return _mm(xx, p["lm_head"]).astype(jnp.float32)
+
+    def greedy_step(p, cc, t):
+        cc2, lg = forward_chunk(c, p, cc, t)
+        return cc2.pos, jnp.argmax(lg[:, -1], axis=-1)
+
+    def sampled_step(p, cc, t, r):
+        cc2, lg = forward_chunk(c, p, cc, t)
+        return cc2.pos, sample_token(lg[:, -1], r, temperature, top_k)
+
+    step_ms = timed(greedy_step, params, cache, tok)
+    sampled_ms = timed(
+        sampled_step, params, cache, tok, jax.random.PRNGKey(9)
+    )
+    parts = {
+        "attention_ms": timed(attention_all, cache, q1),
+        "qkv_ms": timed(qkv_all, params, x),
+        "attn_out_ms": timed(attn_out_all, params, q1[:, None]),
+        "mlp_ms": timed(mlp_all, params, x),
+        "embed_norm_ms": timed(embed_norm, params, tok, x),
+        "logits_ms": timed(logits_head, params, x),
+    }
+    sampling_ms = timed(
+        lambda lg, r: sample_token(lg, r, temperature, top_k),
+        logits, jax.random.PRNGKey(9),
+    )
+    explained = sum(parts.values())
+    out = {
+        "ctx_len": ctx_len,
+        "batch": batch,
+        "reps": reps,
+        "step_ms": round(step_ms, 3),
+        "sampled_step_ms": round(sampled_ms, 3),
+        "sampling_ms": round(sampling_ms, 3),
+        # The sampled-vs-greedy gap, attributed: the step-level delta
+        # next to the isolated sampler cost (they should roughly agree;
+        # a large difference means the sampler is breaking fusion
+        # somewhere else in the scan body).
+        "sampled_overhead_ms": round(sampled_ms - step_ms, 3),
+        "residual_ms": round(step_ms - explained, 3),
+    }
+    out.update({k: round(v, 3) for k, v in parts.items()})
+    for k, v in parts.items():
+        out[k.replace("_ms", "_frac")] = round(v / max(step_ms, 1e-9), 3)
+    return out
+
+
 def main() -> int:
     import dataclasses
 
@@ -41,6 +211,7 @@ def main() -> int:
     )
     from tpu_dra.workloads.models.llama import TINY_LLAMA, Llama
     from tpu_dra.workloads.ops import attention as A
+    from tpu_dra.workloads.ops import decode_mlp as DM
     from tpu_dra.workloads.quantize import dequantize_kv, quantize_kv
 
     report = {"ok": False}
@@ -80,11 +251,16 @@ def main() -> int:
         params = model.init_params(jax.random.PRNGKey(7), batch=2, seq=8)
         prompt = jnp.tile(jnp.arange(8, dtype=jnp.int32)[None], (2, 1))
         A._LAST_DECODE_IMPL = None
+        DM._LAST_DECODE_MLP_IMPL = None
         t0 = time.monotonic()
         out_bf16 = greedy_generate(c, params, prompt, new_tokens)
         assert A._LAST_DECODE_IMPL in ("xla", "pallas"), (
             f"decode scan never dispatched the fused op "
             f"(scan_layers={scan}; saw {A._LAST_DECODE_IMPL!r})"
+        )
+        assert DM._LAST_DECODE_MLP_IMPL in ("xla", "pallas"), (
+            f"decode scan never dispatched the fused MLP block "
+            f"(scan_layers={scan}; saw {DM._LAST_DECODE_MLP_IMPL!r})"
         )
         out_int8 = greedy_generate(
             c, params, prompt, new_tokens, kv_quant="int8"
@@ -100,9 +276,42 @@ def main() -> int:
         report[f"{layout}_int8kv_token_agreement"] = agree
         report[f"{layout}_seconds"] = round(time.monotonic() - t0, 2)
 
-    # (4) fused sampler == unfused oracle, fixed key.
+    # (5) int8 weight-only as a generate-path knob (ISSUE 8: the full
+    # decode path — prefill, projections, MLP, logits — over the
+    # quantized tree, previously engine-only): near-total token
+    # agreement with the full-precision run on a short horizon.
     model = Llama(cfg)
     params = model.init_params(jax.random.PRNGKey(7), batch=2, seq=8)
+    prompt = jnp.tile(jnp.arange(8, dtype=jnp.int32)[None], (2, 1))
+    base = greedy_generate(cfg, params, prompt, new_tokens)
+    w8 = greedy_generate(
+        cfg, params, prompt, new_tokens, weight_quant="int8"
+    )
+    w8_agree = float(
+        np.mean(np.asarray(base[:, 8:]) == np.asarray(w8[:, 8:]))
+    )
+    assert w8_agree >= 0.95, (
+        f"int8 weight-only decode disagreed with bf16: {w8_agree:.3f}"
+    )
+    report["w8_token_agreement"] = w8_agree
+
+    # (6) the step-breakdown profiler (ISSUE 8 tentpole): every
+    # component key present and positive — the TPU bench records this
+    # dict as decode_step_breakdown, and the optimization loop is
+    # driven by it, so its schema is a CI contract.
+    bd = measure_step_breakdown(cfg, params, batch=2, ctx_len=24, reps=2)
+    for key in (
+        "step_ms", "sampled_step_ms", "sampling_ms", "attention_ms",
+        "qkv_ms", "attn_out_ms", "mlp_ms", "embed_norm_ms", "logits_ms",
+        "residual_ms", "attention_frac",
+    ):
+        assert key in bd, f"step breakdown missing {key}"
+        if key.endswith("_ms") and "residual" not in key:
+            assert bd[key] > 0, f"step breakdown {key} = {bd[key]}"
+    report["breakdown_step_ms"] = bd["step_ms"]
+    report["breakdown_attention_frac"] = bd["attention_frac"]
+
+    # (4) fused sampler == unfused oracle, fixed key.
     prompt = jnp.tile(jnp.arange(8, dtype=jnp.int32)[None], (2, 1))
     rng = jax.random.PRNGKey(5)
     fused = sample_generate(

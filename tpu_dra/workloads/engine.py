@@ -230,9 +230,36 @@ class EngineConfig:
     weight_quant: str = "none"
     fused: bool = True  # lax.scan decode chunks; False = per-token oracle
     contiguous: bool = False  # unpaged oracle: fixed consecutive pages
+    # Sampling INSIDE the decode scan (PR-2's sample_token/topk_exact):
+    # temperature > 0 draws temperature/top-k tokens with a per-sequence
+    # key folded as (sample_seed, sequence serial, position) — position-
+    # keyed, so the fused scan, the per-token unfused oracle, and a
+    # post-drain resume all sample the IDENTICAL token at every position
+    # (the engine's sampled parity test pins it). temperature == 0 is
+    # greedy (argmax), the previous behavior.
+    temperature: float = 0.0
+    top_k: int = 0
+    sample_seed: int = 0
+    # Mesh-sharded decode (SNIPPETS [3] GSPMD pattern): build a
+    # (batch x model) mesh over every chip the ComputeDomain's rendered
+    # env exposes and NamedShard params / KV pools / batch arrays so the
+    # SAME jitted step runs collectively across them — degrading
+    # gracefully to a (1, 1) mesh on a single chip. The sharding rules
+    # (workloads/parallel/mesh.py) are exactness-preserving: sharded
+    # decode is token-identical to unsharded (the shardbench gate).
+    sharded: bool = False
 
     def resolved_num_pages(self) -> int:
         return self.num_pages or 1 + self.max_slots * self.max_pages_per_seq
+
+    def sampling(self) -> "tuple | None":
+        """(temperature, top_k) when sampling is on, None for greedy —
+        the STATIC half of the jitted step's signature. The seed is a
+        traced input (it rides the device state), so changing seeds
+        never recompiles."""
+        if self.temperature <= 0.0:
+            return None
+        return (self.temperature, self.top_k)
 
 
 class Engine:
@@ -276,7 +303,25 @@ class Engine:
             raise ValueError(
                 f"unknown weight_quant {self.ec.weight_quant!r}"
             )
-        self.params = jax.device_put(params)
+        self.mesh = None
+        self._row_sharding = None
+        if self.ec.sharded:
+            from tpu_dra.workloads.parallel import mesh as meshlib
+
+            self.mesh = meshlib.build_decode_mesh(config)
+            # Multi-device mesh: the pallas-capable decode ops must run
+            # their XLA paths (no SPMD rule for custom kernels — see
+            # mesh.sharded_safe_config). Re-binds self.config so the
+            # jit cache keys on the adjusted config.
+            self.config = config = meshlib.sharded_safe_config(
+                config, self.mesh
+            )
+            self.params = meshlib.shard_decode_params(self.mesh, params)
+            self._row_sharding = meshlib.decode_data_sharding(
+                self.mesh, self.ec.max_slots
+            )
+        else:
+            self.params = jax.device_put(params)
         self.gate = gate or LeaseGate()
         self.metrics = metrics
         self.clock = clock
@@ -292,13 +337,36 @@ class Engine:
         self.cache = init_paged_cache(
             config, P, self.ec.page_size, kv_quant=self.ec.kv_quant
         )
+        if self.mesh is not None:
+            from tpu_dra.workloads.parallel import mesh as meshlib
+
+            self.cache = jax.tree_util.tree_map(
+                lambda leaf: jax.device_put(
+                    leaf,
+                    meshlib.decode_pool_sharding(
+                        self.mesh, config.n_kv_heads, leaf.ndim
+                    ),
+                ),
+                self.cache,
+            )
         self.allocator = PageAllocator(P)
         B, M = self.ec.max_slots, self.ec.max_pages_per_seq
         self._tables = np.zeros((B, M), np.int32)  # SCRATCH_PAGE default
         self._lengths = np.zeros((B,), np.int32)
         self._last_tokens = np.zeros((B,), np.int32)
         self._active = np.zeros((B,), bool)
+        self._seeds = np.zeros((B,), np.int32)  # per-slot sampling serial
+        # The engine-wide sample seed rides as a TRACED scalar (not a
+        # jit static): engines differing only by seed share one
+        # compiled executable.
+        self._seed_scalar = np.int32(self.ec.sample_seed)
         self._slots: List[Optional[_Sequence]] = [None] * B
+        # Device mirror of (tables, lengths, last, active, seeds): the
+        # fused chunk RETURNS lengths/last as device arrays, so a steady
+        # full-slot decode stretch feeds them straight back instead of a
+        # host->device round trip per chunk; any host-side mutation
+        # (page alloc, admission/eviction, prefill) invalidates it.
+        self._dev_state = None
 
         self._queue: collections.deque = collections.deque()  # _Sequence
         self._prefilling: collections.deque = collections.deque()
@@ -322,19 +390,22 @@ class Engine:
 
         c = self.config
         quant = self.ec.kv_quant == "int8"
-        # One jitted callable per (model config, storage mode), shared
-        # across Engine instances: jax's trace cache lives on the
-        # callable, so a fresh engine over the same shapes reuses the
-        # compiled executables instead of re-tracing.
-        key = (c, quant)
+        sampling = self.ec.sampling()
+        # One jitted callable per (model config, storage mode, sampling
+        # statics), shared across Engine instances: jax's trace cache
+        # lives on the callable, so a fresh engine over the same shapes
+        # reuses the compiled executables instead of re-tracing.
+        # (Sharded instances share these too — jit re-lowers per input
+        # sharding on its own cache.)
+        key = (c, quant, sampling)
         fns = _JIT_CACHE.get(key)
         if fns is None:
             fns = (
                 jax.jit(
-                    functools.partial(_decode_chunk, c, quant),
+                    functools.partial(_decode_chunk, c, quant, sampling),
                     static_argnames=("steps",),
                 ),
-                jax.jit(functools.partial(_decode_step, c, quant)),
+                jax.jit(functools.partial(_decode_step, c, quant, sampling)),
                 jax.jit(functools.partial(_prefill_chunk, c, quant)),
             )
             _JIT_CACHE[key] = fns
@@ -527,6 +598,8 @@ class Engine:
             seq.slot = slot
             seq.reserved_left = 0 if self.ec.contiguous else need
             self._slots[slot] = seq
+            self._seeds[slot] = seq.serial
+            self._dev_state = None
             self._prefilling.append(seq)
             self._progress += 1
             self._inc("engine_admitted_total")
@@ -552,6 +625,7 @@ class Engine:
             page = self.allocator.alloc()
         seq.pages.append(page)
         self._tables[seq.slot, len(seq.pages) - 1] = page
+        self._dev_state = None
         return page
 
     def _ensure_pages(self, seq: _Sequence, upto: int) -> None:
@@ -588,6 +662,8 @@ class Engine:
         self._lengths[slot] = 0
         self._last_tokens[slot] = 0
         self._active[slot] = False
+        self._seeds[slot] = 0
+        self._dev_state = None
 
     # --- prefill ----------------------------------------------------------
 
@@ -619,18 +695,59 @@ class Engine:
         )
         seq.prefill_cursor += s
         self._progress += 1
+        self._dev_state = None
         self._inc("engine_prefill_tokens_total", s)
         if seq.prefill_cursor == len(seq.context):
             self._prefilling.popleft()
             seq.prefill_done = True
-            first = int(np.argmax(np.asarray(logits)))
+            first = self._pick_first(seq, logits)
             self._record_tokens(seq, [first])
             if seq.slot is not None:  # not finished by that one token
                 self._lengths[slot] = len(seq.context)
                 self._last_tokens[slot] = first
                 self._active[slot] = True
 
+    def _pick_first(self, seq: _Sequence, logits) -> int:
+        """First generated token from the prefill logits: argmax, or —
+        under sampling — the SAME (seed, serial, position) key schedule
+        the decode scan uses, at position len(context). A drained
+        sequence re-prefills a longer context and re-samples at the new
+        frontier with the same key it would have used mid-scan, so
+        resume cannot fork the trajectory."""
+        sampling = self.ec.sampling()
+        if sampling is None:
+            return int(np.argmax(np.asarray(logits)))
+        import jax
+        import jax.numpy as jnp
+
+        from tpu_dra.workloads.generate import sample_token
+
+        temperature, top_k = sampling
+        key = jax.random.fold_in(
+            jax.random.fold_in(
+                jax.random.PRNGKey(self.ec.sample_seed), seq.serial
+            ),
+            len(seq.context),
+        )
+        return int(
+            np.asarray(
+                sample_token(
+                    jnp.asarray(logits)[None], key, temperature, top_k
+                )
+            )[0]
+        )
+
     # --- decode ------------------------------------------------------------
+
+    def _put_row(self, arr):
+        """Host batch array -> device, with the decode mesh's batch
+        sharding when the engine runs sharded."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._row_sharding is not None:
+            return jax.device_put(arr, self._row_sharding)
+        return jnp.asarray(arr)
 
     def _decode_tick(self, now: float) -> None:
         if not self._active.any():
@@ -641,30 +758,44 @@ class Engine:
         for slot, seq in enumerate(self._slots):
             if seq is not None and self._active[slot]:
                 self._ensure_pages(seq, int(self._lengths[slot]) + steps)
-        args = (
-            self.params, self.cache,
-            jnp.asarray(self._tables),
-            jnp.asarray(self._lengths),
-            jnp.asarray(self._last_tokens),
-            jnp.asarray(self._active),
+        if self._dev_state is None:
+            # Host bookkeeping changed since the last chunk: re-upload.
+            self._dev_state = (
+                self._put_row(self._tables),
+                self._put_row(self._lengths),
+                self._put_row(self._last_tokens),
+                self._put_row(self._active),
+                self._put_row(self._seeds),
+                jnp.asarray(self._seed_scalar),
+            )
+        tables_d, lengths_d, last_d, active_d, seeds_d, seed_d = (
+            self._dev_state
         )
         if self.ec.fused:
             self.cache, lengths, last, out = self._decode_chunk_fn(
-                *args, steps=steps
+                self.params, self.cache, tables_d, lengths_d, last_d,
+                active_d, seeds_d, seed_d, steps=steps,
             )
         else:
             # Unfused oracle: one XLA entry per token, same step math.
-            cache, lengths, last, active = (
-                args[1], args[3], args[4], args[5]
-            )
+            cache, lengths, last = self.cache, lengths_d, last_d
             outs = []
             for _ in range(steps):
                 cache, lengths, last = self._decode_step_fn(
-                    self.params, cache, args[2], lengths, last, active
+                    self.params, cache, tables_d, lengths, last,
+                    active_d, seeds_d, seed_d,
                 )
                 outs.append(last)
             self.cache = cache
             out = jnp.stack(outs)
+        # The chunk's outputs ARE next chunk's inputs: keep them on
+        # device (a steady full-slot stretch re-uploads nothing — the
+        # per-chunk host->device round trip the roofline work removed);
+        # any host mutation below (a mid-chunk finisher evicting) just
+        # resets _dev_state.
+        self._dev_state = (
+            tables_d, lengths, last, active_d, seeds_d, seed_d
+        )
         out = np.asarray(out)  # [steps, B]
         # np.array (copy): asarray over a jax buffer is read-only, and
         # the slot bookkeeping writes these in place.
@@ -750,11 +881,16 @@ class Engine:
 # --- traced forward (module-level so jit caches stay warm per engine) -------
 
 
-def _decode_step(c, quant, params, cache, tables, lengths, tokens, active):
+def _decode_step(c, quant, sampling, params, cache, tables, lengths,
+                 tokens, active, seeds, sample_seed):
     """One paged decode step for the whole slot batch. tokens/lengths/
-    active: [B]. Inactive slots write to the scratch page and contribute
-    exactly zero attention (length 0); their token and length pass
-    through unchanged."""
+    active/seeds: [B]; sample_seed: traced scalar. Inactive slots write
+    to the scratch page and contribute exactly zero attention (length
+    0); their token and length pass through unchanged. ``sampling`` is
+    the static (temperature, top_k) pair or None for greedy; sampled
+    tokens draw with a key folded as (seed, slot serial, position) so
+    the fused scan, the unfused oracle, and a post-drain resume all
+    agree per position."""
     import jax.numpy as jnp
 
     from tpu_dra.workloads.generate import (
@@ -798,11 +934,14 @@ def _decode_step(c, quant, params, cache, tables, lengths, tokens, active):
         out = paged_decode_attention(
             q[:, 0], k_pools[layer], v_pools[layer], tables, len_eff,
             k_scale=ks_pools[layer], v_scale=vs_pools[layer],
+            impl=c.paged_decode_impl,
         )[:, None].astype(c.dtype)
         x = _finish_block(c, lp, x, out, B, 1)
     x = _rms(x, params["final_norm"]["scale"], c.norm_eps)
     logits = _mm(x, params["lm_head"]).astype(jnp.float32)[:, 0]
-    nxt = jnp.argmax(logits, axis=-1).astype(tokens.dtype)
+    nxt = _pick_tokens(
+        sampling, logits, seeds, len_eff, tokens.dtype, sample_seed
+    )
     nxt = jnp.where(active, nxt, tokens)
     new_cache = PagedKVCache(
         k=tuple(k_pools), v=tuple(v_pools),
@@ -812,8 +951,32 @@ def _decode_step(c, quant, params, cache, tables, lengths, tokens, active):
     return new_cache, len_eff, nxt
 
 
+def _pick_tokens(sampling, logits, seeds, positions, dtype, sample_seed):
+    """Next-token choice for the whole slot batch: argmax (greedy) or
+    the PR-2 fused sampler with per-slot position-folded keys. The token
+    picked here will sit AT ``positions`` (= length after the current
+    write), so its key is fold(fold(seed_key, serial), position) — the
+    same key the prefill pick uses for the first generated token."""
+    import jax
+    import jax.numpy as jnp
+
+    if sampling is None:
+        return jnp.argmax(logits, axis=-1).astype(dtype)
+    from tpu_dra.workloads.generate import sample_token
+
+    temperature, top_k = sampling
+    base = jax.random.PRNGKey(sample_seed)
+
+    def one(lg, sd, pos):
+        key = jax.random.fold_in(jax.random.fold_in(base, sd), pos)
+        return sample_token(lg[None], key, temperature, top_k)[0]
+
+    return jax.vmap(one)(logits, seeds, positions).astype(dtype)
+
+
 def _decode_chunk(
-    c, quant, params, cache, tables, lengths, tokens, active, *, steps
+    c, quant, sampling, params, cache, tables, lengths, tokens, active,
+    seeds, sample_seed, *, steps
 ):
     """``steps`` decode steps as ONE jitted lax.scan — the fused chunk
     the engine admits/evicts between."""
@@ -822,7 +985,8 @@ def _decode_chunk(
     def step(carry, _):
         cache, lengths, toks = carry
         cache, lengths, toks = _decode_step(
-            c, quant, params, cache, tables, lengths, toks, active
+            c, quant, sampling, params, cache, tables, lengths, toks,
+            active, seeds, sample_seed,
         )
         return (cache, lengths, toks), toks
 

@@ -308,8 +308,21 @@ def run_serve_bench(config, params, env) -> dict:
         kv_quant=kv_quant, weight_quant="int8",
     )
     engine_w8 = run_engine_trace(config, params, ec_w8, trace)
+    # Sampled serving (ISSUE 8 satellite: PR-2's sample_token/topk_exact
+    # wired into the engine scan): same trace, temperature/top-k drawn
+    # inside the fused chunk — the step-breakdown's sampling_ms says
+    # where any gap vs the greedy engine comes from.
+    import dataclasses as _dc
+
+    ec_sampled = _dc.replace(
+        ec,
+        temperature=float(env.get("BENCH_SERVE_TEMP", "0.8")),
+        top_k=int(env.get("BENCH_SERVE_TOPK", "40")),
+    )
+    engine_sampled = run_engine_trace(config, params, ec_sampled, trace)
     result = {
         "serve_tok_s": engine["tok_s"],
+        "serve_sampled_tok_s": engine_sampled["tok_s"],
         "serve_p50_ms": engine["p50_ms"],
         "serve_p99_ms": engine["p99_ms"],
         "serve_ttft_p50_ms": engine["ttft_p50_ms"],
@@ -481,6 +494,41 @@ def main(argv=None) -> int:
     assert abs(acc["decode_padding_waste"] - expect) < 5e-5  # 4-dp round
     assert acc["useful_decode_tokens"] == useful
     report["decode_padding_waste"] = acc["decode_padding_waste"]
+
+    # (6) sampling inside the engine scan (ISSUE 8 satellite): the
+    # fused sampled engine must be TOKEN-IDENTICAL to the per-token
+    # unfused oracle with the same (seed, serial, position) key
+    # schedule — the same parity bar the greedy oracles set.
+    samp_kw = dict(temperature=0.8, top_k=8, sample_seed=11)
+    sampled = run_engine_trace(
+        cfg, params, ec(**samp_kw), trace, warmup=False
+    )
+    sampled_oracle = run_engine_trace(
+        cfg, params, ec(fused=False, contiguous=True, **samp_kw),
+        trace, warmup=False,
+    )
+    assert set(sampled["completions"]) == set(sampled_oracle["completions"])
+    samp_mismatch = [
+        rid for rid in sampled["completions"]
+        if not np.array_equal(
+            sampled["completions"][rid].tokens,
+            sampled_oracle["completions"][rid].tokens,
+        )
+    ]
+    assert not samp_mismatch, (
+        f"sampled fused engine diverged from the unfused oracle on "
+        f"{samp_mismatch}"
+    )
+    # Sampling must actually sample: a trace-wide argmax match would
+    # mean the sampler silently degenerated to greedy.
+    assert any(
+        not np.array_equal(
+            sampled["completions"][rid].tokens,
+            paged["completions"][rid].tokens,
+        )
+        for rid in sampled["completions"]
+    ), "sampled engine emitted the greedy trajectory on every request"
+    report["sampled_parity_requests"] = len(sampled["completions"])
 
     # (5) int8 KV + int8 weight-only engine knobs complete and agree
     # with the f32 engine on almost every token (quantization noise

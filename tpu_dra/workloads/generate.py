@@ -52,9 +52,30 @@ from tpu_dra.workloads.models.llama import (
     rope_frequencies,
 )
 from tpu_dra.workloads.ops.attention import decode_attention
+from tpu_dra.workloads.ops.decode_mlp import decode_mlp
 from tpu_dra.workloads.quantize import quantize_kv
 
 KV_QUANT_MODES = ("none", "int8")
+WEIGHT_QUANT_MODES = ("none", "int8")
+
+
+def _maybe_quantize_params(params: dict, weight_quant: str) -> dict:
+    """int8 weight-only as a first-class knob on the WHOLE decode path
+    (prefill, per-step projections/MLP, logits head — everything that
+    goes through _mm), matching the engine's EngineConfig.weight_quant.
+    Under jit the quantization happens at trace time against the traced
+    params; for a long-lived server, pre-quantize once
+    (quantize.quantize_params) and pass the quantized tree instead."""
+    if weight_quant == "none":
+        return params
+    if weight_quant not in WEIGHT_QUANT_MODES:
+        raise ValueError(
+            f"unknown weight_quant {weight_quant!r}; expected one of "
+            f"{WEIGHT_QUANT_MODES}"
+        )
+    from tpu_dra.workloads.quantize import quantize_params
+
+    return quantize_params(params)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -248,11 +269,19 @@ def _project_qkv(c, lp, x, cos, sin, b, s):
 
 def _finish_block(c, lp, x, out, b, s):
     """Shared back half: attention output projection + residual MLP
-    (identical in both cache layouts)."""
+    (identical in both cache layouts). The s=1 decode step routes its
+    norm+MLP chain through the fused block (ops/decode_mlp.py) — the
+    pallas streaming kernel on TPU, the identical xla op chain
+    elsewhere."""
     att = lp["attention"]
     out = out.reshape(b, s, c.n_heads * c.head_dim)
     x = x + _mm(out, att["wo"])
     mlp = lp["mlp"]
+    if s == 1:
+        return decode_mlp(
+            x[:, 0], lp["mlp_norm"]["scale"], mlp, c.norm_eps,
+            impl=c.decode_mlp_impl, block_f=c.decode_mlp_block_f,
+        )[:, None]
     h2 = _rms(x, lp["mlp_norm"]["scale"], c.norm_eps)
     gate = _mm(h2, mlp["w_gate"])
     up = _mm(h2, mlp["w_up"])
@@ -554,9 +583,11 @@ def _generate(
     max_seq: int,
     pick,
     kv_quant: str = "none",
+    weight_quant: str = "none",
 ) -> jnp.ndarray:
     """Shared prefill + scan-decode loop; ``pick(logits[b, v], i)``
     chooses the next token for step i."""
+    params = _maybe_quantize_params(params, weight_quant)
     b, s = prompt.shape
     if not max_seq:
         # Auto-sized caches round up to a 64 granule: decode attention
@@ -602,15 +633,18 @@ def greedy_generate(
     max_new_tokens: int,
     max_seq: int = 0,
     kv_quant: str = "none",
+    weight_quant: str = "none",
 ) -> jnp.ndarray:
     """Greedy-decode ``max_new_tokens`` after ``prompt`` [b, s]; returns
     [b, s + max_new_tokens]. Jit-friendly: one traced prefill + a
     ``lax.scan`` of single-token steps. ``kv_quant="int8"`` stores the
-    cache int8 with per-(token, head) scales."""
+    cache int8 with per-(token, head) scales; ``weight_quant="int8"``
+    runs every matmul on the path (projections, MLP, logits) over the
+    int8 weight-only tree."""
     return _generate(
         config, params, prompt, max_new_tokens, max_seq,
         pick=lambda logits, _i: jnp.argmax(logits, axis=-1),
-        kv_quant=kv_quant,
+        kv_quant=kv_quant, weight_quant=weight_quant,
     )
 
 
@@ -624,6 +658,7 @@ def sample_generate(
     top_k: int = 0,
     max_seq: int = 0,
     kv_quant: str = "none",
+    weight_quant: str = "none",
 ) -> jnp.ndarray:
     """Temperature / top-k sampling over the same cache machinery, with
     the sampler FUSED into the decode scan body (sample_token): sampled
@@ -637,7 +672,7 @@ def sample_generate(
     if temperature <= 0.0 or top_k == 1:
         return greedy_generate(
             config, params, prompt, max_new_tokens, max_seq,
-            kv_quant=kv_quant,
+            kv_quant=kv_quant, weight_quant=weight_quant,
         )
 
     def pick(logits, i):
@@ -647,7 +682,7 @@ def sample_generate(
 
     return _generate(
         config, params, prompt, max_new_tokens, max_seq, pick=pick,
-        kv_quant=kv_quant,
+        kv_quant=kv_quant, weight_quant=weight_quant,
     )
 
 
@@ -661,6 +696,7 @@ def sample_generate_unfused(
     top_k: int = 0,
     max_seq: int = 0,
     kv_quant: str = "none",
+    weight_quant: str = "none",
 ) -> jnp.ndarray:
     """The pre-fusion serving loop: one XLA entry per generated token (a
     host round-trip between steps). Kept as the parity oracle for the
@@ -671,8 +707,9 @@ def sample_generate_unfused(
     if temperature <= 0.0 or top_k == 1:
         return greedy_generate(
             config, params, prompt, max_new_tokens, max_seq,
-            kv_quant=kv_quant,
+            kv_quant=kv_quant, weight_quant=weight_quant,
         )
+    params = _maybe_quantize_params(params, weight_quant)
     b, s = prompt.shape
     if not max_seq:
         # Same 64-granule auto-sizing as _generate: the parity contract

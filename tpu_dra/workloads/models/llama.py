@@ -62,6 +62,18 @@ class LlamaConfig:
     # its cache-length chunk size (ops/attention.py decode_attention).
     decode_impl: str = "auto"
     decode_block_k: int = 256
+    # Fused decode MLP+norm block for the s=1 step (ops/decode_mlp.py:
+    # pallas ffn-block streaming kernel on TPU, the identical xla op
+    # chain elsewhere) and its ffn tile width.
+    decode_mlp_impl: str = "auto"  # auto | pallas | xla | reference
+    decode_mlp_block_f: int = 512
+    # Block-table attention dispatch for the paged serving engine
+    # (ops/attention.py paged_decode_attention). Multi-device sharded
+    # decode forces "xla": pallas custom calls have no SPMD partitioning
+    # rule, so under GSPMD they would replicate and all-gather the very
+    # weight/KV shards the mesh exists to split (engine/bench set this
+    # alongside decode_mlp_impl when the decode mesh spans >1 device).
+    paged_decode_impl: str = "auto"  # auto | pallas | xla | reference
 
     @property
     def head_dim(self) -> int:

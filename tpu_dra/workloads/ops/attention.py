@@ -1125,6 +1125,150 @@ def _xla_paged_decode_attention(
     return out.reshape(b, h, hd).astype(q.dtype)
 
 
+def _paged_decode_kernel(len_ref, tbl_ref, q_ref, k_ref, v_ref, *rest,
+                         page: int, max_pages: int, scale: float,
+                         quantized: bool):
+    """One (sequence, table-entry) program of the pallas paged-decode
+    path. The grid's second dimension walks the sequence's block table;
+    the PER-SEQUENCE length and the table itself are SCALAR-PREFETCHED,
+    so the page id feeds the BlockSpec index map and the K/V page DMA
+    starts before the kernel body runs (the gather never goes through a
+    VMEM-resident table). (m, l, acc) carry across table entries in VMEM
+    scratch; entries past the sequence's last live page are skipped
+    (their index map re-targets the previous page, so no new DMA is
+    issued either). The block math is the SAME online-softmax update as
+    _xla_paged_decode_attention; interpret-mode parity is pinned at ulp
+    level by tests/test_paged_kv.py (bit-equality across the two
+    compiled graphs is at the mercy of backend fusion — the XLA gather
+    path remains the engine's bit-level oracle)."""
+    import jax.experimental.pallas as pl
+
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
+    i = pl.program_id(1)
+    length = len_ref[pl.program_id(0)]
+    num_visible = lax.div(length + (page - 1), page)
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full(m_ref.shape, NEG_INF, jnp.float32)
+        l_ref[...] = jnp.zeros(l_ref.shape, jnp.float32)
+        acc_ref[...] = jnp.zeros(acc_ref.shape, jnp.float32)
+
+    @pl.when(i < num_visible)
+    def _block():
+        q = q_ref[0]  # [h, hd], model dtype
+        h, hd = q.shape
+        kb = k_ref[0]  # [page, kvh, hd]
+        vb = v_ref[0]
+        kvh = kb.shape[1]
+        qg = q.reshape(kvh, h // kvh, hd)
+        s = jnp.einsum(
+            "hrd,khd->hrk", qg, kb.astype(qg.dtype),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if quantized:
+            ksb = ks_ref[0]  # [page, kvh]
+            s = s * ksb.T[:, None, :]
+        cols = i * page + lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(cols < length, s, NEG_INF)
+        m = m_ref[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1)
+        if quantized:
+            vsb = vs_ref[0]
+            p = p * vsb.T[:, None, :]
+        acc_ref[...] = acc_ref[...] * alpha[..., None] + jnp.einsum(
+            "hrk,khd->hrd", p.astype(qg.dtype), vb.astype(qg.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = m_new
+
+    @pl.when(i == max_pages - 1)
+    def _flush():
+        # A dead slot (length 0) never runs a block: acc stays 0 and
+        # 0 / 1e-30 is exactly 0.0 — the documented dead-slot contract,
+        # with no explicit where needed.
+        out = acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)[..., None]
+        kvh, n_rep, hd = out.shape
+        o_ref[0] = out.reshape(kvh * n_rep, hd).astype(o_ref.dtype)
+
+
+def _pallas_paged_decode_attention(
+    q, k_pages, v_pages, tables, lengths, k_scale, v_scale
+):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, hd = q.shape
+    page, kvh = k_pages.shape[1], k_pages.shape[2]
+    n_rep = h // kvh
+    max_pages = tables.shape[1]
+    quantized = k_scale is not None
+
+    def _page_of(s, i, len_ref, tbl_ref):
+        # Entries past the last live page re-target the LAST live page
+        # (clamped index): pallas skips the DMA when consecutive block
+        # indices coincide, so dead trips cost neither bandwidth nor
+        # compute (the kernel body is pl.when-guarded too). A 0-length
+        # sequence clamps to entry 0 — always a valid pool page (the
+        # engine fills unused table rows with the scratch page).
+        last = jnp.maximum(
+            lax.div(len_ref[s] + (page - 1), page) - 1, 0
+        )
+        return tbl_ref[s, jnp.minimum(i, last)]
+
+    q_map = lambda s, i, *_: (s, 0, 0)  # noqa: E731
+    kv_map = lambda s, i, *refs: (_page_of(s, i, *refs), 0, 0, 0)  # noqa: E731
+    sc_map = lambda s, i, *refs: (_page_of(s, i, *refs), 0, 0)  # noqa: E731
+
+    in_specs = [
+        pl.BlockSpec((1, h, hd), q_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, page, kvh, hd), kv_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, page, kvh, hd), kv_map, memory_space=pltpu.VMEM),
+    ]
+    args = [q, k_pages, v_pages]
+    if quantized:
+        in_specs.extend([
+            pl.BlockSpec((1, page, kvh), sc_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, page, kvh), sc_map, memory_space=pltpu.VMEM),
+        ])
+        args.extend([k_scale, v_scale])
+    kernel = functools.partial(
+        _paged_decode_kernel, page=page, max_pages=max_pages,
+        scale=hd ** -0.5, quantized=quantized,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,  # lengths [b], tables [b, max_pages]
+            grid=(b, max_pages),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(
+                (1, h, hd), q_map, memory_space=pltpu.VMEM
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((kvh, n_rep), jnp.float32),
+                pltpu.VMEM((kvh, n_rep), jnp.float32),
+                pltpu.VMEM((kvh, n_rep, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, hd), q.dtype),
+        interpret=_INTERPRET,
+    )(lengths.astype(jnp.int32), tables.astype(jnp.int32), *args)
+
+
+def _paged_pallas_ok(k_pages, hd: int) -> bool:
+    """May the pallas paged-decode kernel run here? Platform plus the
+    head-dim lane constraint; one page of K+V (+scales) trivially fits
+    VMEM for any sane page size, so no budget check is needed."""
+    return flash_platform_ok() and hd % 64 == 0
+
+
 def paged_decode_attention(
     q: jnp.ndarray,
     k_pages: jnp.ndarray,
@@ -1145,11 +1289,18 @@ def paged_decode_attention(
     is the pool page holding sequence i's positions [j*page, (j+1)*page);
     lengths: [b] int32 traced — keys at positions >= lengths[i] are dead
     for sequence i (a 0 length makes the slot contribute exactly zero);
-    impl: "auto" | "xla" | "reference".
+    impl: "auto" | "pallas" | "xla" | "reference".
 
     Returns [b, h, hd] in q's dtype. The block loop is bit-identical to
     ``decode_attention(..., impl="xla", block_k=page_size)`` over the
     equivalent contiguous cache — the engine's parity tests rely on it.
+    "pallas" is the scalar-prefetched block-table kernel (auto picks it
+    on TPU): the per-sequence table feeds the BlockSpec index map, so
+    page DMA is issued ahead of the kernel body. It runs the SAME block
+    update as the "xla" path — agreement is pinned at ulp level (the
+    two compile to different graphs, and backend fusion choices differ
+    by a last-place bit on some inputs); the "xla" gather path stays
+    the BIT-level parity oracle against the contiguous op.
     """
     b, h, hd = q.shape
     if k_pages.shape != v_pages.shape or k_pages.shape[3] != hd:
@@ -1170,9 +1321,13 @@ def paged_decode_attention(
             f"match batch {b}"
         )
     if impl == "auto":
-        impl = "xla"
+        impl = "pallas" if _paged_pallas_ok(k_pages, hd) else "xla"
     global _LAST_PAGED_IMPL
     _LAST_PAGED_IMPL = impl
+    if impl == "pallas":
+        return _pallas_paged_decode_attention(
+            q, k_pages, v_pages, tables, lengths, k_scale, v_scale
+        )
     if impl == "xla":
         return _xla_paged_decode_attention(
             q, k_pages, v_pages, tables, lengths, k_scale, v_scale

@@ -1,0 +1,256 @@
+"""Fused decode MLP+norm block (the non-attention half of the roofline).
+
+BENCH_r05 pinned the open decode gap: the fused step runs 3.16x the
+bf16 HBM floor while the ATTENTION inside it is already at 1.046x its
+own floor — the waste is everything around attention, and the largest
+single slab is the MLP (gate/up/down are ~2/3 of per-layer weight
+bytes). This module fuses the decode step's ``rms-norm -> gate/up ->
+silu*mul -> down -> +residual`` chain for the s=1 case:
+
+- **pallas** (TPU): one kernel, grid over ffn blocks. The normalized
+  activation is computed ONCE into VMEM scratch; each grid step streams
+  a ``[d, block_f]`` slab of w_gate/w_up and the matching ``[block_f,
+  d]`` slab of w_down through VMEM, accumulating the down-projection in
+  an fp32 scratch and writing ``x + acc`` on the last step. Nothing in
+  the chain round-trips through HBM between the norm and the residual
+  add — weight bytes are read exactly once, which is the roofline's
+  floor assumption;
+- **xla** (CPU tests, fallback): the EXACT op sequence generate.py's
+  decode step has always run (same _rms / matmul ordering), so
+  dispatching through this module changes nothing numerically off-TPU —
+  every existing parity oracle (paged-vs-unpaged engine trace,
+  teacher-forced decode-vs-forward) keeps its bit-level meaning;
+- **reference**: naive fp32, the numerics oracle for the kernel's
+  interpret-mode tests.
+
+int8 weight-only trees ({"kernel_q", "scale"} leaves) take the xla path
+(ops/int8mm.py handles the in-flight dequant); the pallas kernel covers
+the plain-kernel layouts. Dispatch mirrors decode_attention: ``impl``
+"auto" | "pallas" | "xla" | "reference", with a trace-time
+``_LAST_DECODE_MLP_IMPL`` probe decodebench asserts on.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_dra.workloads.ops.attention import flash_platform_ok
+
+_LAST_DECODE_MLP_IMPL = None  # set at trace time; decodebench asserts
+
+# One w_gate/w_up/w_down slab triple must fit VMEM with headroom for the
+# activation scratch and double-buffering (see attention.py's budget).
+_VMEM_MLP_BUDGET_BYTES = 8 * 1024 * 1024
+
+
+def _kernels(mlp: dict):
+    """(w_gate, w_up, w_down) plain kernels, or None when the tree is
+    int8 weight-only (or otherwise not bare 2D kernels)."""
+    try:
+        ws = tuple(mlp[n]["kernel"] for n in ("w_gate", "w_up", "w_down"))
+    except (KeyError, TypeError):
+        return None
+    if any(w.ndim != 2 for w in ws):
+        return None
+    return ws
+
+
+def _matmul(x: jnp.ndarray, w: dict) -> jnp.ndarray:
+    """generate._mm's dispatch, inlined to keep the layer DAG acyclic
+    (generate imports this module): plain {"kernel"} or int8 weight-only
+    {"kernel_q", "scale"} through ops/int8mm.py."""
+    if "kernel_q" in w:
+        from tpu_dra.workloads.ops.int8mm import int8_matmul
+
+        return int8_matmul(x, w["kernel_q"], w["scale"])
+    return x @ w["kernel"].astype(x.dtype)
+
+
+def _rms(x: jnp.ndarray, scale: jnp.ndarray, eps: float) -> jnp.ndarray:
+    # Byte-for-byte the op sequence of generate._rms: the xla path must
+    # preserve the decode step's existing numerics exactly.
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    return (
+        x32 * lax.rsqrt(var + eps) * scale.astype(jnp.float32)
+    ).astype(x.dtype)
+
+
+def _xla_decode_mlp(x, norm_scale, mlp, eps):
+    h = _rms(x, norm_scale, eps)
+    gate = _matmul(h, mlp["w_gate"])
+    up = _matmul(h, mlp["w_up"])
+    return x + _matmul(jax.nn.silu(gate) * up, mlp["w_down"])
+
+
+def reference_decode_mlp(x, norm_scale, mlp, eps):
+    """Naive fp32 oracle (plain kernels only)."""
+    wg, wu, wd = _kernels(mlp)
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    h = x32 * lax.rsqrt(var + eps) * norm_scale.astype(jnp.float32)
+    gate = h @ wg.astype(jnp.float32)
+    up = h @ wu.astype(jnp.float32)
+    out = (jax.nn.silu(gate) * up) @ wd.astype(jnp.float32)
+    return (x32 + out).astype(x.dtype)
+
+
+def _decode_mlp_kernel(x_ref, s_ref, wg_ref, wu_ref, wd_ref, o_ref,
+                       xn_ref, acc_ref, *, eps: float, num_blocks: int):
+    """One ffn-block program: partial gate/up/silu/down over a
+    ``block_f`` slab, accumulated in fp32 scratch. The normalized
+    activation is computed once (first step) into VMEM scratch; the
+    output block (constant index map) stays VMEM-resident across the
+    whole grid and is written once, on the last step."""
+    import jax.experimental.pallas as pl
+
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _init():
+        x32 = x_ref[...].astype(jnp.float32)
+        var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+        xn_ref[...] = (
+            x32 * lax.rsqrt(var + eps)
+            * s_ref[...].astype(jnp.float32)
+        ).astype(xn_ref.dtype)
+        acc_ref[...] = jnp.zeros(acc_ref.shape, jnp.float32)
+
+    h = xn_ref[...]
+    gate = jnp.dot(h, wg_ref[...], preferred_element_type=jnp.float32)
+    up = jnp.dot(h, wu_ref[...], preferred_element_type=jnp.float32)
+    act = (jax.nn.silu(gate) * up).astype(h.dtype)
+    acc_ref[...] += jnp.dot(
+        act, wd_ref[...], preferred_element_type=jnp.float32
+    )
+
+    @pl.when(j == num_blocks - 1)
+    def _flush():
+        o_ref[...] = (
+            x_ref[...].astype(jnp.float32) + acc_ref[...]
+        ).astype(o_ref.dtype)
+
+
+def _pick_block_f(ffn: int, d: int, itemsize: int,
+                  target: int) -> "int | None":
+    """Largest LANE-ALIGNED (multiple of 128) divisor of ffn at most
+    ``target`` whose three weight slabs (two [d, bf] + one [bf, d]) fit
+    the VMEM budget, or None when no such width exists (the dispatcher
+    then keeps the xla path). Alignment is load-bearing: mosaic rejects
+    a [d, bf] BlockSpec whose trailing dim is neither 128-aligned nor
+    the full dimension — e.g. ffn 11008's largest plain divisor <= 512
+    is 344, which compiles nowhere."""
+    cap = _VMEM_MLP_BUDGET_BYTES // max(3 * d * itemsize, 1)
+    best = None
+    for bf in range(128, min(ffn, target, cap) + 1, 128):
+        if ffn % bf == 0:
+            best = bf
+    return best
+
+
+def _pallas_decode_mlp(x, norm_scale, wg, wu, wd, eps, block_f):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, d = x.shape
+    ffn = wg.shape[1]
+    bf = _pick_block_f(ffn, d, wg.dtype.itemsize, block_f)
+    if bf is None:
+        raise ValueError(
+            f"no lane-aligned ffn block <= {block_f} divides ffn {ffn} "
+            f"within the VMEM budget; use impl='xla' (auto does)"
+        )
+    num_blocks = ffn // bf
+
+    whole = lambda j: (0, 0)  # noqa: E731
+    col_block = lambda j: (0, j)  # noqa: E731
+    row_block = lambda j: (j, 0)  # noqa: E731
+
+    kernel = functools.partial(
+        _decode_mlp_kernel, eps=eps, num_blocks=num_blocks
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(num_blocks,),
+        in_specs=[
+            pl.BlockSpec((b, d), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((d, bf), col_block, memory_space=pltpu.VMEM),
+            pl.BlockSpec((d, bf), col_block, memory_space=pltpu.VMEM),
+            pl.BlockSpec((bf, d), row_block, memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((b, d), whole, memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b, d), x.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((b, d), x.dtype),
+            pltpu.VMEM((b, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x, norm_scale.reshape(1, d), wg, wu, wd)
+
+
+def _interpret() -> bool:
+    from tpu_dra.workloads.ops import attention as A
+
+    return A._INTERPRET
+
+
+def _mlp_pallas_ok(x, mlp, block_f: int) -> bool:
+    ws = _kernels(mlp)
+    if ws is None or not flash_platform_ok():
+        return False
+    d = x.shape[-1]
+    # Lane alignment for the streamed slabs — including a viable
+    # lane-aligned ffn block width; tiny CPU-test dims fall back to the
+    # (numerically identical today) xla path.
+    return (
+        d % 128 == 0
+        and ws[0].shape[1] % 128 == 0
+        and _pick_block_f(
+            ws[0].shape[1], d, ws[0].dtype.itemsize, block_f
+        ) is not None
+    )
+
+
+def decode_mlp(
+    x: jnp.ndarray,
+    norm_scale: jnp.ndarray,
+    mlp: dict,
+    eps: float,
+    impl: str = "auto",
+    block_f: int = 512,
+) -> jnp.ndarray:
+    """The decode step's full post-attention block for a [b, d] token
+    batch: ``x + w_down(silu(w_gate(rms(x))) * w_up(rms(x)))``.
+
+    ``mlp`` is the layer's param subtree ({"w_gate", "w_up", "w_down"},
+    plain or int8 weight-only leaves). impl "auto" picks the pallas
+    kernel on TPU for plain-kernel trees and the xla chain otherwise;
+    the xla chain is op-for-op the path generate.py always ran, so
+    off-TPU numerics are unchanged by dispatching through here.
+    """
+    if x.ndim != 2:
+        raise ValueError(f"decode_mlp expects [b, d] tokens, got {x.shape}")
+    if impl == "auto":
+        impl = "pallas" if _mlp_pallas_ok(x, mlp, block_f) else "xla"
+    global _LAST_DECODE_MLP_IMPL
+    _LAST_DECODE_MLP_IMPL = impl
+    if impl == "pallas":
+        ws = _kernels(mlp)
+        if ws is None:
+            raise ValueError(
+                "the pallas decode MLP kernel needs plain 2D kernels "
+                "(int8 weight-only trees take impl='xla' or 'auto')"
+            )
+        return _pallas_decode_mlp(
+            x, norm_scale, *ws, eps=eps, block_f=block_f
+        )
+    if impl == "xla":
+        return _xla_decode_mlp(x, norm_scale, mlp, eps)
+    if impl == "reference":
+        return reference_decode_mlp(x, norm_scale, mlp, eps)
+    raise ValueError(f"unknown decode mlp impl: {impl!r}")
