@@ -3,7 +3,7 @@
 IMAGE_REPO ?= registry.local/tpu-dra-driver
 IMAGE_TAG  ?= v0.1.0
 
-.PHONY: all native test test-slow bench decodebench allocbench enginebench shardbench image bats lint lint-fast shlint chaos crashmatrix apisoak ci clean
+.PHONY: all native test test-slow bench decodebench allocbench enginebench shardbench fleetbench image bats lint lint-fast shlint chaos crashmatrix apisoak ci clean
 
 all: native test
 
@@ -51,6 +51,19 @@ allocbench:
 # as `bench.py --leg-serve` and lands in BENCH_r*.json.
 enginebench:
 	python -m tpu_dra.workloads.enginebench --smoke
+
+# Control-plane fleet smoke (ISSUE 10): small simulated fleet (96
+# nodes) through the REAL scheduler + publisher + informers — hard
+# asserts on trace determinism, the SLO keys being present, the
+# sharded-prepare + diffed/coalesced-publish path beating the
+# per-event/unsharded baseline on p99 claim-ready (structural backlog
+# by design, not machine luck), relist-storm flatness (store sizes,
+# cache bytes, watch slots back to baseline), field-selector-scoped
+# informers staying O(node), and hot-shard fairness. The full 5k-node
+# configuration runs as `bench.py --leg-fleet` and lands in
+# BENCH_r*.json (docs/operations.md).
+fleetbench:
+	python -m tpu_dra.tools.fleetsim --smoke
 
 # Mesh-sharded decode CPU smoke (ISSUE 8): the (batch x model) decode
 # mesh degrades gracefully ((1,1) on one chip), the sharding rules
@@ -147,7 +160,7 @@ shlint:
 # (flakes surface in CI, not in the judge's rerun), the 13 bats suites
 # executed against the minicluster, the batsless process-level e2e, and
 # the bench artifact schema gate.
-ci: lint lint-fast shlint native chaos crashmatrix apisoak decodebench allocbench enginebench shardbench
+ci: lint lint-fast shlint native chaos crashmatrix apisoak decodebench allocbench enginebench shardbench fleetbench
 	python -m pytest tests/ -q -m 'not slow'
 	python -m pytest tests/ -q -m 'not slow'
 	python -m pytest tests/test_chaos.py -q -m slow

@@ -612,6 +612,18 @@ def _leg_serve_main() -> int:
     return 0
 
 
+def _leg_fleet_main() -> int:
+    """Control-plane fleet leg (ISSUE 10): 5k synthetic nodes, seeded
+    open-loop claim trace with churn + publish storms, relist-storm
+    drill — claim-submitted -> pod-env-injected p50/p99 as the SLO,
+    optimized (sharded prepares + diffed/coalesced publishes) measured
+    against the per-event/unsharded baseline. Pure CPU, no TPU probe
+    (see tpu_dra/tools/fleetsim.py; methodology: docs/operations.md)."""
+    from tpu_dra.tools.fleetsim import main as fleet_main
+
+    return fleet_main([])
+
+
 def _leg_rotate_main() -> int:
     """Time-slice rotation client: a live trainer that steps only while
     holding the arbiter lease and yields at the quantum. Both clients
@@ -1499,6 +1511,8 @@ def main() -> int:
         return _leg_decode_main()
     if "--leg-serve" in sys.argv:
         return _leg_serve_main()
+    if "--leg-fleet" in sys.argv:
+        return _leg_fleet_main()
     if "--leg-rotate" in sys.argv:
         return _leg_rotate_main()
 
@@ -1545,6 +1559,26 @@ def main() -> int:
         f"re-scan); frag {allocator['frag_score']} vs first-fit "
         f"{allocator['firstfit_frag_score']}, util {allocator['util']} "
         f"vs {allocator['firstfit_util']}",
+        file=sys.stderr,
+    )
+
+    # Fleet control-plane leg (ISSUE 10): CPU-side like the allocator
+    # leg, run before any TPU leg so a control-plane regression fails
+    # the bench early. Own process: the 5k-node simulator's thread fleet
+    # must not share an interpreter with the TPU legs.
+    fleetrep = _run_leg({}, flag="--leg-fleet")
+    print(
+        f"fleet ({fleetrep['fleet_nodes']} nodes, "
+        f"{fleetrep['fleet_claims']} claims at "
+        f"{fleetrep['rate_claims_per_s']}/s): claim-ready p50 "
+        f"{fleetrep['fleet_claim_ready_p50_ms']} ms p99 "
+        f"{fleetrep['fleet_claim_ready_p99_ms']} ms "
+        f"({fleetrep['fleet_p99_speedup']}x the per-event/unsharded "
+        f"baseline p99 {fleetrep['fleet_baseline_claim_ready_p99_ms']} "
+        f"ms); relist storm p99 {fleetrep['fleet_relist_storm_p99_ms']} "
+        f"ms over {fleetrep['fleet_watch_slots']} watch slots; publish "
+        f"writes {fleetrep['fleet_publish_writes']} vs baseline "
+        f"{fleetrep['fleet_baseline_publish_writes']}",
         file=sys.stderr,
     )
 
@@ -1836,6 +1870,34 @@ def main() -> int:
                     "firstfit_frag_score"
                 ],
                 "firstfit_util": allocator["firstfit_util"],
+                # Fleet control-plane leg (ISSUE 10): claim-submitted ->
+                # pod-env-injected SLO over the 5k-node simulated fleet
+                # (the same synthetic fleet the allocator leg measures),
+                # the relist-storm heal latency, and the measured win of
+                # the sharded-workqueue + diffed/coalesced-publish path
+                # over the per-event/unsharded baseline.
+                "fleet_nodes": fleetrep["fleet_nodes"],
+                "fleet_claims": fleetrep["fleet_claims"],
+                "fleet_claim_ready_p50_ms": fleetrep[
+                    "fleet_claim_ready_p50_ms"
+                ],
+                "fleet_claim_ready_p99_ms": fleetrep[
+                    "fleet_claim_ready_p99_ms"
+                ],
+                "fleet_relist_storm_p99_ms": fleetrep[
+                    "fleet_relist_storm_p99_ms"
+                ],
+                "fleet_p99_speedup": fleetrep["fleet_p99_speedup"],
+                "fleet_baseline_claim_ready_p99_ms": fleetrep[
+                    "fleet_baseline_claim_ready_p99_ms"
+                ],
+                "fleet_publish_writes": fleetrep["fleet_publish_writes"],
+                "fleet_baseline_publish_writes": fleetrep[
+                    "fleet_baseline_publish_writes"
+                ],
+                "fleet_scoped_informer_max_objects": fleetrep[
+                    "fleet_scoped_informer_max_objects"
+                ],
             }
         )
     )

@@ -86,6 +86,21 @@ TYPED_WHEN_PRESENT = {
     "decode_sharded_tok_s": (int, float),
     "decode_mesh": str,
     "serve_sampled_tok_s": (int, float),
+    # Fleet control-plane leg (ISSUE 10): claim-ready SLO over the
+    # simulated 5k-node fleet, relist-storm heal latency, and the
+    # sharded+batched vs per-event/unsharded p99 ratio. The B100 pass
+    # forward-requires the headline five ahead of their first recorded
+    # artifact.
+    "fleet_nodes": int,
+    "fleet_claims": int,
+    "fleet_claim_ready_p50_ms": (int, float),
+    "fleet_claim_ready_p99_ms": (int, float),
+    "fleet_relist_storm_p99_ms": (int, float),
+    "fleet_p99_speedup": (int, float),
+    "fleet_baseline_claim_ready_p99_ms": (int, float),
+    "fleet_publish_writes": int,
+    "fleet_baseline_publish_writes": int,
+    "fleet_scoped_informer_max_objects": int,
 }
 
 

@@ -45,6 +45,17 @@ REQUIRED_STATIC = (
     "decode_step_breakdown",
     "decode_sharded_tok_s",
     "serve_sampled_tok_s",
+    # Fleet control-plane leg (ISSUE 10): the claim-submitted ->
+    # pod-env-injected SLO over the simulated 5k-node fleet, the
+    # relist-storm heal latency, and the measured sharded+batched vs
+    # per-event/unsharded p99 ratio — dropping any of them would blind
+    # the control-plane-scale regression tripwire before its first
+    # recorded artifact.
+    "fleet_nodes",
+    "fleet_claim_ready_p50_ms",
+    "fleet_claim_ready_p99_ms",
+    "fleet_relist_storm_p99_ms",
+    "fleet_p99_speedup",
 )
 
 

@@ -19,7 +19,8 @@ their transitive closure is NOT implied — list every edge):
     computedomain-> plugin, tpulib, k8sclient, infra, api, version
     scheduler    -> k8sclient, infra, api, version
     webhook      -> k8sclient, infra, api, version
-    tools        -> plugin, tpulib, k8sclient, infra, api, version
+    tools        -> plugin, scheduler, tpulib, k8sclient, infra, api,
+                    version
     minicluster  -> computedomain, plugin, scheduler, k8sclient,
                     infra, api, version
     workloads    -> plugin, computedomain, infra, api, version
@@ -62,7 +63,10 @@ LAYER_DAG: Dict[str, Set[str]] = {
     },
     "scheduler": {"k8sclient", "infra", "api", "version"},
     "webhook": {"k8sclient", "infra", "api", "version"},
-    "tools": {"plugin", "tpulib", "k8sclient", "infra", "api", "version"},
+    "tools": {
+        "plugin", "scheduler", "tpulib", "k8sclient", "infra", "api",
+        "version",
+    },
     "minicluster": {
         "computedomain", "plugin", "scheduler", "k8sclient", "infra",
         "api", "version",

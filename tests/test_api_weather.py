@@ -1167,7 +1167,11 @@ def test_publish_circuit_trip_on_publish_thread_does_not_deadlock(tmp_path):
     try:
         assert gauge(h.driver.metrics, "api_degraded") == 0
         # Threshold is 2: one publish's list retries record enough 503
-        # failures to trip the breaker mid-call.
+        # failures to trip the breaker mid-call. The content-diffed
+        # publisher would make a repeat publish a zero-write no-op
+        # (never reaching the apiserver); drop its cache so this pass
+        # must relist + write — the regime the deadlock guard protects.
+        h.driver._publisher.invalidate()
         h.srv.inject_faults(fail=50, fail_status=503)
         done = threading.Event()
         err = []

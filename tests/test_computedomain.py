@@ -1395,3 +1395,29 @@ def test_daemon_fail_fast_flags_lost_neighbor(fc, tmp_path):
         e["nodeName"] for e in d0.registration.lost_peers(peers=peers)
     ] == ["node-1"]
     assert not d0.compute_ready(peers)
+
+
+def test_controller_shard_routing_is_stable_across_uid_change():
+    """ISSUE 10 review fix: shard routing keys on ns/name (the dedup
+    key), NOT the UID — a domain deleted and recreated (new UID) must
+    keep its entire lifetime on ONE shard, or a stale teardown retry
+    could reconcile concurrently with the new incarnation."""
+    from tpu_dra.k8sclient.fake import FakeCluster
+
+    ctrl = ComputeDomainController(FakeCluster())
+    cd_v1 = {"metadata": {
+        "namespace": "team-a", "name": "cd-x", "uid": "uid-1",
+    }}
+    cd_v2 = {"metadata": {
+        "namespace": "team-a", "name": "cd-x", "uid": "uid-2",
+    }}
+    # No worker threads running: both enqueues land in pending state.
+    ctrl._enqueue(cd_v1)
+    ctrl._enqueue(cd_v2)
+    pending = [
+        (i, len(q._pending)) for i, q in enumerate(ctrl.queue.shards)
+        if q._pending
+    ]
+    # One shard holds the (deduped) single pending item for ns/name.
+    assert len(pending) == 1 and pending[0][1] == 1
+    ctrl.queue.shutdown()

@@ -673,3 +673,82 @@ def test_bench_trend_sorts_rounds_numerically(tmp_path):
     assert any("roofline REGRESSED" in w for w in report["warnings"])
     assert report["bench_trend"]["latest"]["path"] == "BENCH_r100.json"
     assert report["bench_trend"]["previous"]["path"] == "BENCH_r99.json"
+
+
+def test_metrics_probe_warns_on_growing_workqueue_depth(tmp_path):
+    """ISSUE 10: a deep reconcile queue that is STILL GROWING across
+    the probe interval means the reconciler is falling behind — WARN
+    with the slow-callback-vs-event-storm remediation split; per-shard
+    series are matched individually."""
+    import threading
+
+    from tpu_dra.infra.metrics import Metrics, MetricsServer
+
+    metrics = Metrics()
+    metrics.set_gauge("workqueue_depth", 150, labels={"shard": "3"})
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    bump = threading.Timer(
+        0.1,
+        lambda: metrics.set_gauge(
+            "workqueue_depth", 180, labels={"shard": "3"}
+        ),
+    )
+    bump.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        endpoint = f"127.0.0.1:{srv.port}"
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint], metrics_interval=0.4,
+        )
+        warns = "\n".join(report["warnings"])
+        assert "still GROWING" in warns
+        assert "workqueue_work_duration_seconds" in warns
+        assert 'shard="3"' in warns
+        out = render(report)
+        assert "workqueue: depth[3]=180+30" in out
+    finally:
+        bump.cancel()
+        srv.stop()
+
+
+def test_metrics_probe_quiet_on_draining_or_shallow_workqueue(tmp_path):
+    """Deep but DRAINING (depth falling across the interval) and
+    shallow queues stay quiet; a single-sample deep queue gets the
+    re-probe hint instead of the growth verdict."""
+    import threading
+
+    from tpu_dra.infra.metrics import Metrics, MetricsServer
+
+    metrics = Metrics()
+    metrics.set_gauge("workqueue_depth", 150)
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    drain = threading.Timer(
+        0.1, lambda: metrics.set_gauge("workqueue_depth", 90)
+    )
+    drain.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        endpoint = f"127.0.0.1:{srv.port}"
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint], metrics_interval=0.4,
+        )
+        assert report["warnings"] == [], report["warnings"]
+        # Single sample, deep: flagged with the re-probe hint.
+        metrics.set_gauge("workqueue_depth", 150)
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint],
+        )
+        warns = "\n".join(report["warnings"])
+        assert "deep reconcile backlog" in warns
+        assert "--metrics-interval" in warns
+    finally:
+        drain.cancel()
+        srv.stop()

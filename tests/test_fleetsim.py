@@ -1,0 +1,352 @@
+"""Fleet-scale control-plane pieces (ISSUE 10): the content-diffed
+slice publisher, field-selector-scoped informers, and the fleetsim
+harness's relist-storm / claim-ready drills at toy scale.
+
+The CI smoke (`make fleetbench`) runs the 96-node contract; these
+tests pin the underlying mechanisms deterministically and cheaply.
+"""
+
+import time
+
+import pytest
+
+from tpu_dra.infra.metrics import Metrics
+from tpu_dra.k8sclient import (
+    RESOURCE_SLICES,
+    Informer,
+    ResourceClient,
+)
+from tpu_dra.k8sclient.fake import FakeCluster
+from tpu_dra.k8sclient.resources import ApiConflict
+from tpu_dra.plugin.slicepub import SlicePublisher, slice_content_digest
+from tpu_dra.scheduler import fleet
+from tpu_dra.tools import fleetsim
+
+
+def wait_for(pred, timeout=10.0, tick=0.01, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(tick)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+def _build_for(index, degraded=False):
+    def build(generation):
+        s = fleet.make_node_slice(index, generation=generation)
+        if degraded:
+            s["spec"]["devices"][0]["basic"]["attributes"]["health"] = {
+                "string": "degraded"
+            }
+        return [s]
+    return build
+
+
+# --- SlicePublisher -------------------------------------------------------
+
+
+def test_publisher_unchanged_content_costs_zero_writes():
+    cluster = FakeCluster()
+    slices = ResourceClient(cluster, RESOURCE_SLICES)
+    m = Metrics()
+    pub = SlicePublisher(slices, node_name=fleet.node_name(0), metrics=m,
+                         presume_empty=True)
+    assert pub.publish(_build_for(0)) == 1  # cold create
+    assert pub.generation == 1
+    stored = slices.list()[0]
+    rv = stored["metadata"]["resourceVersion"]
+    # Republish the identical content: zero API writes, generation
+    # parked, resourceVersion untouched (no MODIFIED fan-out to any
+    # watcher in the cluster).
+    for _ in range(5):
+        assert pub.publish(_build_for(0)) == 0
+    assert pub.generation == 1
+    assert slices.list()[0]["metadata"]["resourceVersion"] == rv
+    assert m.get_counter("publish_skipped_unchanged_total") == 5
+
+
+def test_publisher_content_change_patches_and_bumps_generation():
+    cluster = FakeCluster()
+    slices = ResourceClient(cluster, RESOURCE_SLICES)
+    pub = SlicePublisher(slices, node_name=fleet.node_name(0),
+                         presume_empty=True)
+    pub.publish(_build_for(0))
+    assert pub.publish(_build_for(0, degraded=True)) == 1
+    assert pub.generation == 2
+    s = slices.list()[0]
+    assert s["spec"]["pool"]["generation"] == 2
+    assert s["spec"]["devices"][0]["basic"]["attributes"]["health"] == {
+        "string": "degraded"
+    }
+    # Flap settles back: one more write, one more generation.
+    assert pub.publish(_build_for(0)) == 1
+    assert pub.generation == 3
+
+
+def test_publisher_digest_masks_generation_only():
+    a = fleet.make_node_slice(3, generation=1)
+    b = fleet.make_node_slice(3, generation=9)
+    assert slice_content_digest(a) == slice_content_digest(b)
+    c = fleet.make_node_slice(4, generation=1)
+    assert slice_content_digest(a) != slice_content_digest(c)
+
+
+def test_publisher_recreates_externally_deleted_slice():
+    cluster = FakeCluster()
+    slices = ResourceClient(cluster, RESOURCE_SLICES)
+    pub = SlicePublisher(slices, node_name=fleet.node_name(0),
+                         presume_empty=True)
+    pub.publish(_build_for(0))
+    slices.delete(f"slice-{fleet.node_name(0)}")
+    # Next CHANGED publish heals via the ApiNotFound -> create path.
+    pub.publish(_build_for(0, degraded=True))
+    assert len(slices.list()) == 1
+
+
+def test_publisher_conflict_invalidates_cache_and_relists():
+    """A create racing an external writer 409s; the publisher drops its
+    cache so the caller's retry (publish_with_retry in the driver)
+    relists, adopts the existing slice, and converges by patching."""
+    cluster = FakeCluster()
+    slices = ResourceClient(cluster, RESOURCE_SLICES)
+    # The slice already exists (another incarnation beat us to it) but
+    # the publisher was told the server starts empty.
+    slices.create(fleet.make_node_slice(0))
+    pub = SlicePublisher(slices, node_name=fleet.node_name(0),
+                         presume_empty=True)
+    with pytest.raises(ApiConflict):
+        pub.publish(_build_for(0, degraded=True))
+    # The cache was dropped: the retry relists, adopts the server's
+    # slice, and converges via PATCH.
+    assert pub.publish(_build_for(0, degraded=True)) == 1
+    s = slices.list()[0]
+    assert s["spec"]["devices"][0]["basic"]["attributes"]["health"] == {
+        "string": "degraded"
+    }
+    assert len(slices.list()) == 1
+
+
+def test_publisher_adopts_preexisting_slices_on_cold_start():
+    """A process restart (no presume_empty) relists and adopts its own
+    earlier slices: identical content publishes nothing."""
+    cluster = FakeCluster()
+    slices = ResourceClient(cluster, RESOURCE_SLICES)
+    first = SlicePublisher(slices, node_name=fleet.node_name(0),
+                           presume_empty=True)
+    first.publish(_build_for(0))
+    reborn = SlicePublisher(slices, node_name=fleet.node_name(0))
+    assert reborn.publish(_build_for(0)) == 0
+
+
+# --- field-selector-scoped informers --------------------------------------
+
+
+def test_informer_field_selector_scopes_store_and_watch():
+    cluster = FakeCluster()
+    slices = ResourceClient(cluster, RESOURCE_SLICES)
+    for i in range(6):
+        slices.create(fleet.make_node_slice(i))
+    node = fleet.node_name(2)
+    inf = Informer(
+        cluster, RESOURCE_SLICES,
+        field_selector={"spec.nodeName": node},
+    )
+    events = []
+    inf.add_handler(lambda ev, obj: events.append(
+        (ev, obj["spec"]["nodeName"])
+    ))
+    inf.start()
+    assert inf.wait_for_sync(timeout=10)
+    try:
+        # The store holds ONE node's slice, not the fleet's.
+        assert inf.store_size() == 1
+        assert inf.list()[0]["spec"]["nodeName"] == node
+        # Events for other nodes never reach the scoped watch.
+        slices.create(fleet.make_node_slice(17))
+        s2 = fleet.make_node_slice(2)
+        s2["spec"]["pool"]["generation"] = 2
+        cur = slices.list(field_selector={"spec.nodeName": node})[0]
+        s2["metadata"]["resourceVersion"] = cur["metadata"][
+            "resourceVersion"
+        ]
+        slices.update(s2)
+        wait_for(
+            lambda: ("MODIFIED", node) in events,
+            what="scoped MODIFIED event",
+        )
+        assert all(n == node for _ev, n in events)
+        assert inf.store_size() == 1
+    finally:
+        inf.stop()
+
+
+def test_serve_read_filters_field_selected_query_client_side():
+    """Satellite pin (rest.py degraded reads): a field-selected list
+    against a WIDER informer store comes back scoped — client-side
+    filtering with the backends' own matcher — never silently
+    unfiltered; and a scoped informer refuses mismatched scopes."""
+    cluster = FakeCluster()
+    slices = ResourceClient(cluster, RESOURCE_SLICES)
+    for i in range(4):
+        slices.create(fleet.make_node_slice(i))
+    node = fleet.node_name(1)
+    wide = Informer(cluster, RESOURCE_SLICES)
+    wide.start()
+    assert wide.wait_for_sync(timeout=10)
+    try:
+        out = wide.serve_read(None, None, None, {"spec.nodeName": node})
+        assert [o["spec"]["nodeName"] for o in out] == [node]
+        # Unmatched field selector: empty scoped result, not the fleet.
+        assert wide.serve_read(
+            None, None, None, {"spec.nodeName": "node-nope"}
+        ) == []
+    finally:
+        wide.stop()
+    scoped = Informer(
+        cluster, RESOURCE_SLICES,
+        field_selector={"spec.nodeName": node},
+    )
+    scoped.start()
+    assert scoped.wait_for_sync(timeout=10)
+    try:
+        # Same scope: served. Different/missing scope: refused (None ->
+        # CircuitOpenError surfaces instead of a wrong answer).
+        assert [
+            o["spec"]["nodeName"]
+            for o in scoped.serve_read(
+                None, None, None, {"spec.nodeName": node}
+            )
+        ] == [node]
+        assert scoped.serve_read(None, None, None) is None
+        assert scoped.serve_read(
+            None, None, None, {"spec.nodeName": "node-00000"}
+        ) is None
+    finally:
+        scoped.stop()
+
+
+# --- relist-storm drill (toy scale, deterministic pieces) ------------------
+
+
+def test_relist_storm_returns_to_baseline_at_toy_scale():
+    """The acceptance drill, small: informer store sizes, cache bytes,
+    and live watch-slot counts return exactly to baseline after an
+    event-window-overflow + watch-drop avalanche; node-scoped informers
+    stay O(node) throughout."""
+    mode = fleetsim._ModeRun(
+        nodes=10, claims=12, rate=200.0, seed=7, optimized=True,
+        storm_tick=0.05, storm_frac=0.2, prepare_ms=0.5, churn=0.25,
+        sample_scoped=3,
+    )
+    mode.start()
+    try:
+        res = mode.run_trace()
+        assert res["unready"] == 0
+        assert res["claims"] == 12
+        assert res["claim_ready_p99_ms"] > 0
+        storm = mode.relist_storm()  # carries the hard asserts
+        assert storm["relist_p99_ms"] > 0
+        assert storm["watch_slots_after"] == storm["watch_slots_before"]
+        assert storm["stores_flat"]
+        assert storm["scoped_informer_max_objects"] <= 1
+        assert storm["unscoped_informer_objects"] == 10
+    finally:
+        mode.stop()
+
+
+def test_fleet_trace_is_deterministic_for_a_seed():
+    import json
+
+    a = fleet.make_trace(50, 99)
+    b = fleet.make_trace(50, 99)
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
+def test_shard_fairness_drill():
+    """Satellite: hot domain floods its shard; cold domains' latency
+    stays bounded by their own shard's service time."""
+    out = fleetsim._assert_shard_fairness(prepare_ms=2.0)
+    assert out["sharded_cold_p100_ms"] < out["serial_cold_p100_ms"]
+
+
+def test_kubelet_sim_prepares_each_claim_exactly_once():
+    """Duplicate claim events (MODIFIED storms) must not double-prepare
+    or double-stamp the ready time."""
+    cluster = FakeCluster()
+    m = Metrics()
+    kub = fleetsim.KubeletSim(cluster, m, sharded=True, prepare_ms=0.0)
+    claim = {
+        "metadata": {"name": "c-1", "namespace": "fleetsim", "uid": "u1"},
+        "status": {"allocation": {"devices": {"results": [
+            {"driver": fleet.DRIVER, "pool": fleet.node_name(0),
+             "device": "ss-1x1x1-0-0-0"},
+        ]}}},
+    }
+    kub.start()
+    try:
+        for _ in range(5):
+            kub._on_claim("MODIFIED", claim)
+        wait_for(lambda: kub.ready_count() == 1, what="claim prepared")
+        time.sleep(0.05)
+        assert kub.ready_count() == 1
+        _t, env = kub.ready["c-1"]
+        assert env["TPU_DRA_DEVICE_0"] == "node-00000/ss-1x1x1-0-0-0"
+    finally:
+        kub.stop()
+
+
+def test_scoped_informer_over_real_http_wire():
+    """fieldSelector flows through the REST transport and the
+    fakeserver's watch path: a node-scoped informer over real HTTP
+    holds O(node) objects and receives only its node's events."""
+    from tpu_dra.k8sclient.fakeserver import FakeApiServer
+    from tpu_dra.k8sclient.rest import KubeClient
+
+    srv = FakeApiServer(port=0).start()
+    try:
+        kc = KubeClient(server=srv.server_url, qps=1000, burst=1000)
+        slices = ResourceClient(kc, RESOURCE_SLICES)
+        for i in range(5):
+            slices.create(fleet.make_node_slice(i))
+        node = fleet.node_name(3)
+        inf = Informer(
+            kc, RESOURCE_SLICES,
+            field_selector={"spec.nodeName": node},
+        )
+        inf.start()
+        assert inf.wait_for_sync(timeout=10)
+        try:
+            assert inf.store_size() == 1
+            assert inf.list()[0]["spec"]["nodeName"] == node
+            slices.create(fleet.make_node_slice(9))
+            time.sleep(0.2)  # give a mismatched event time to arrive
+            assert inf.store_size() == 1
+        finally:
+            inf.stop()
+    finally:
+        srv.stop()
+
+
+def test_publisher_reverify_heals_external_deletion():
+    """Trust-but-verify: an EXTERNAL slice deletion with unchanged
+    desired content is healed on the first publish after the reverify
+    window (the diff cache alone would no-op forever)."""
+    cluster = FakeCluster()
+    slices = ResourceClient(cluster, RESOURCE_SLICES)
+    pub = SlicePublisher(slices, node_name=fleet.node_name(0),
+                         presume_empty=True, reverify_seconds=0.05)
+    pub.publish(_build_for(0))
+    slices.delete(f"slice-{fleet.node_name(0)}")
+    assert pub.publish(_build_for(0)) == 0  # window not elapsed: no-op
+    time.sleep(0.08)
+    assert pub.publish(_build_for(0)) == 1  # reverified -> recreated
+    assert len(slices.list()) == 1
+    # And invalidate() forces the same heal immediately (the degraded
+    # heal-resync path in both drivers calls it before replaying).
+    slices.delete(f"slice-{fleet.node_name(0)}")
+    pub.reverify_seconds = 0.0
+    assert pub.publish(_build_for(0)) == 0
+    pub.invalidate()
+    assert pub.publish(_build_for(0)) == 1

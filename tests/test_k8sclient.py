@@ -306,7 +306,7 @@ def test_informer_error_410_event_forces_relist(fc, cds):
             return self.fc.list(*a, **k)
 
         def watch(self, rd, namespace=None, label_selector=None,
-                  resource_version=None):
+                  resource_version=None, field_selector=None):
             if resource_version is not None:
                 self.resume_rvs.append(resource_version)
                 return Stream([
@@ -438,7 +438,7 @@ def test_informer_survives_raising_watch_stream():
             return self.fc.list(*a, **k)
 
         def watch(self, rd, namespace=None, label_selector=None,
-                  resource_version=None):
+                  resource_version=None, field_selector=None):
             if not self.raised:
                 self.raised = True
                 real = self.fc.watch(rd, namespace, label_selector)

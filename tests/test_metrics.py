@@ -30,11 +30,11 @@ def test_timing_window_bounded():
     m = Metrics()
     for i in range(TIMING_WINDOW + 500):
         m.observe("t", float(i))
-    assert len(m._timing_recent["t"]) == TIMING_WINDOW
+    assert len(m._timing_recent[("t", ())]) == TIMING_WINDOW
     # Quantiles reflect the recent window (old observations dropped).
     assert m.quantile("t", 0.0) == 500.0
     # Cumulative sum/count keep the full history.
-    assert m._timing_count["t"] == TIMING_WINDOW + 500
+    assert m._timing_count[("t", ())] == TIMING_WINDOW + 500
 
 
 def test_quantile_empty_series():

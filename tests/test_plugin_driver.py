@@ -354,3 +354,89 @@ def test_reenumeration_preserves_health_state(tmp_path):
     assert driver.state.allocatable["tpu-1"].healthy is False
     driver.state.allocatable = driver.state._enumerate_allocatable()
     assert driver.state.allocatable["tpu-1"].healthy is False
+
+
+def test_publish_unchanged_content_is_zero_writes(tmp_path):
+    """ISSUE 10: republishing an unchanged pool set touches nothing —
+    no resourceVersion churn, no MODIFIED fan-out, generation parked."""
+    driver, backend = make_driver(tmp_path)
+    driver.publish_resources()
+    slices = ResourceClient(backend, RESOURCE_SLICES)
+    rv = slices.list()[0]["metadata"]["resourceVersion"]
+    gen = driver._slice_generation
+    for _ in range(3):
+        driver.publish_resources()
+    assert slices.list()[0]["metadata"]["resourceVersion"] == rv
+    assert driver._slice_generation == gen
+    assert driver.metrics.get_counter(
+        "publish_skipped_unchanged_total"
+    ) == 3
+
+
+def test_publish_soon_coalesces_event_storms(tmp_path):
+    """A burst of publish triggers within the coalesce window collapses
+    into ONE diffed pass; window 0 restores per-event (synchronous)
+    publishing."""
+    driver, backend = make_driver(
+        tmp_path, publish_coalesce_seconds=0.1
+    )
+    driver.publish_resources()
+    writes_before = driver.metrics.get_counter("publish_writes_total")
+    for _ in range(5):
+        driver.publish_soon()
+    assert driver.metrics.get_counter("publish_coalesced_total") == 4
+    deadline = time.monotonic() + 5
+    while (
+        driver._coalesce_timer is not None
+        and time.monotonic() < deadline
+    ):
+        time.sleep(0.01)
+    # The one coalesced pass ran — and, content unchanged, wrote nothing.
+    assert driver.metrics.get_counter("publish_writes_total") == writes_before
+    assert driver.metrics.get_counter(
+        "publish_skipped_unchanged_total"
+    ) >= 1
+
+    sync_driver, _ = make_driver(
+        tmp_path / "sync", publish_coalesce_seconds=0.0
+    )
+    sync_driver.publish_resources()
+    skipped = sync_driver.metrics.get_counter(
+        "publish_skipped_unchanged_total"
+    )
+    sync_driver.publish_soon()  # window 0: runs inline, no timer
+    assert sync_driver._coalesce_timer is None
+    assert sync_driver.metrics.get_counter(
+        "publish_skipped_unchanged_total"
+    ) == skipped + 1
+
+
+def test_health_transition_publishes_changed_content(tmp_path):
+    """A real health transition DOES change content: the coalesced pass
+    must commit it (the diff is against content, not against time)."""
+    gates(DeviceHealthCheck=True)
+    driver, backend = make_driver(
+        tmp_path, publish_coalesce_seconds=0.05
+    )
+    driver.publish_resources()
+    gen = driver._slice_generation
+    chips = driver.tpulib.chips()
+    driver.tpulib.inject_health_event(
+        ChipHealthEvent(chip_uuid=chips[0].uuid, healthy=False, reason="ici")
+    )
+    driver._on_health_change(
+        ChipHealthEvent(chip_uuid=chips[0].uuid, healthy=False, reason="ici")
+    )
+    slices = ResourceClient(backend, RESOURCE_SLICES)
+
+    def unpublished():
+        names = [
+            d["name"] for s in slices.list() for d in s["spec"]["devices"]
+        ]
+        return "tpu-0" not in names
+
+    deadline = time.monotonic() + 5
+    while not unpublished() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert unpublished(), "unhealthy device still published after window"
+    assert driver._slice_generation == gen + 1

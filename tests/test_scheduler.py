@@ -723,3 +723,20 @@ def test_conformance_unsatisfiable_multinode_fails_fast():
         r["pool"] for r in got.allocation["devices"]["results"]
     }
     assert len(nodes) == 1
+
+
+def test_claim_delete_clears_unschedulable_dedup(cluster):
+    """ISSUE 10: the batch funnel removed the single-claim reconcile
+    that used to clear the unschedulable-event dedup entry for a gone
+    claim. DELETED events clear it now — a RECREATED ns/name that is
+    unschedulable for the same reason gets its operator-facing event
+    again instead of silent suppression (and churn can't grow the map
+    unboundedly)."""
+    core = SchedulerCore(cluster, retry_unschedulable_after=999)
+    c = claim("c-dedup", [req()])
+    key = core._key(c)
+    with core._unsched_lock:
+        core._last_unsched[key] = "no chips"
+    core._on_claim_event("DELETED", c)
+    with core._unsched_lock:
+        assert key not in core._last_unsched

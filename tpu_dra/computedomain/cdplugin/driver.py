@@ -40,6 +40,7 @@ from tpu_dra.plugin.prepared import (
     PreparedDeviceGroup,
     PreparedDevices,
 )
+from tpu_dra.plugin.slicepub import SlicePublisher
 
 log = logging.getLogger(__name__)
 
@@ -75,6 +76,18 @@ class CDDriver:
             ready_timeout=config.ready_timeout,
         )
         self.slices = ResourceClient(backend, RESOURCE_SLICES)
+        # Content-diffed pool-set publisher, same machinery as the TPU
+        # plugin: a republish with unchanged channel/daemon devices (the
+        # common case — CD slices are near-static) costs zero writes.
+        # The publisher is NOT internally locked; _publish_lock
+        # serializes its callers (start()'s thread vs the degraded
+        # controller's heal thread), mirroring the TPU Driver.
+        self._publisher = SlicePublisher(
+            self.slices, node_name=config.node_name,
+            label_selector={"tpu.google.com/cd-driver": "true"},
+            metrics=self.metrics,
+        )
+        self._publish_lock = threading.Lock()
         self._stop = threading.Event()
         # Same RPC surface as the TPU plugin; only the state machine differs
         # (DRAService is generic over anything with prepare/unprepare).
@@ -241,6 +254,11 @@ class CDDriver:
                 )
         except Exception as e:  # noqa: BLE001 — resync is best-effort
             log.warning("CD heal resync claim reconcile failed: %s", e)
+        # Drop the diff cache first: the outage may have eaten the
+        # slices, and a trusted cache would turn the heal republish
+        # into a zero-write no-op.
+        with self._publish_lock:
+            self._publisher.invalidate()
         self.publish_resources()
 
     MAX_DEVICES_PER_SLICE = 128  # apiserver validation cap on spec.devices
@@ -270,30 +288,31 @@ class CDDriver:
             devices[i : i + self.MAX_DEVICES_PER_SLICE]
             for i in range(0, len(devices), self.MAX_DEVICES_PER_SLICE)
         ]
-        for idx, chunk in enumerate(chunks):
-            s = {
-                "apiVersion": "resource.k8s.io/v1beta1",
-                "kind": "ResourceSlice",
-                "metadata": {
-                    "name": f"{self.config.node_name}-{CD_DRIVER_NAME}-{idx}",
-                    "labels": {"tpu.google.com/cd-driver": "true"},
-                },
-                "spec": {
-                    "driver": CD_DRIVER_NAME,
-                    "nodeName": self.config.node_name,
-                    "pool": {
-                        "name": f"{self.config.node_name}-cd",
-                        "generation": 1,
-                        "resourceSliceCount": len(chunks),
+
+        def build(generation: int):
+            return [
+                {
+                    "apiVersion": "resource.k8s.io/v1beta1",
+                    "kind": "ResourceSlice",
+                    "metadata": {
+                        "name": (
+                            f"{self.config.node_name}-{CD_DRIVER_NAME}-{idx}"
+                        ),
+                        "labels": {"tpu.google.com/cd-driver": "true"},
                     },
-                    "devices": chunk,
-                },
-            }
-            cur = self.slices.try_get(s["metadata"]["name"])
-            if cur is None:
-                self.slices.create(s)
-            else:
-                s["metadata"]["resourceVersion"] = cur["metadata"][
-                    "resourceVersion"
-                ]
-                self.slices.update(s)
+                    "spec": {
+                        "driver": CD_DRIVER_NAME,
+                        "nodeName": self.config.node_name,
+                        "pool": {
+                            "name": f"{self.config.node_name}-cd",
+                            "generation": generation,
+                            "resourceSliceCount": len(chunks),
+                        },
+                        "devices": chunk,
+                    },
+                }
+                for idx, chunk in enumerate(chunks)
+            ]
+
+        with self._publish_lock:
+            self._publisher.publish(build)

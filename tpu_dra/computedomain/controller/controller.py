@@ -24,7 +24,10 @@ from tpu_dra.computedomain.controller.node import NodeLabelManager
 from tpu_dra.computedomain.controller.rct import ResourceClaimTemplateManager
 from tpu_dra.computedomain.controller.status import StatusManager
 from tpu_dra.infra.metrics import Metrics
-from tpu_dra.infra.workqueue import WorkQueue, default_controller_rate_limiter
+from tpu_dra.infra.workqueue import (
+    ShardedWorkQueue,
+    default_controller_rate_limiter,
+)
 from tpu_dra.k8sclient import (
     COMPUTE_DOMAIN_CLIQUES,
     COMPUTE_DOMAINS,
@@ -50,6 +53,7 @@ class ComputeDomainController:
         daemon_service_account: str = "",
         node_stale_after: float = 60.0,
         metrics: Optional[Metrics] = None,
+        queue_shards: int = 8,
     ):
         self.metrics = metrics if metrics is not None else Metrics()
         self.backend = backend
@@ -67,8 +71,16 @@ class ComputeDomainController:
             node_stale_after=node_stale_after,
         )
         self.node_labels = NodeLabelManager(backend)
-        self.queue = WorkQueue(
-            default_controller_rate_limiter(), metrics=self.metrics
+        # Sharded per domain (ISSUE 10): one hot domain — a flapping
+        # clique storm, a teardown stuck on its RetryLater barriers —
+        # used to serialize every other domain behind a single worker.
+        # Dedup and shard routing both key on ns/name (see _enqueue for
+        # why the UID must not route), so a domain's entire lifetime,
+        # deletion and recreation included, stays on one queue.
+        self.queue = ShardedWorkQueue(
+            shards=queue_shards,
+            rate_limiter_factory=default_controller_rate_limiter,
+            metrics=self.metrics,
         )
         self.cd_informer = Informer(backend, COMPUTE_DOMAINS, metrics=self.metrics)
         self.clique_informer = Informer(
@@ -93,7 +105,7 @@ class ComputeDomainController:
         install_read_fallback(
             self.backend, [self.cd_informer, self.clique_informer]
         )
-        self._threads.append(self.queue.run_in_thread())
+        self._threads.extend(self.queue.run_in_threads())
         t = threading.Thread(
             target=self._periodic_sync, daemon=True, name="cd-periodic-sync"
         )
@@ -154,6 +166,13 @@ class ComputeDomainController:
         return f"{cd['metadata']['namespace']}/{cd['metadata']['name']}"
 
     def _enqueue(self, cd: dict) -> None:
+        # Shard key == dedup key (ns/name), NOT the UID: a domain
+        # deleted and recreated changes UID, and routing the two
+        # incarnations of one ns/name to different shards would let a
+        # stale teardown retry run CONCURRENTLY with the new domain's
+        # reconcile — the one-reconcile-in-flight-per-domain invariant
+        # the dedup exists for. ns/name gives identical hot-domain
+        # isolation (a hot domain IS one ns/name) without the race.
         self.queue.enqueue(cd, self._reconcile, key=self._key(cd))
 
     def _on_cd_event(self, event: str, cd: dict) -> None:

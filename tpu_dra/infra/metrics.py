@@ -64,19 +64,39 @@ class Metrics:
         with self._lock:
             self._gauges.pop(self._key(name, labels), None)
 
-    def observe(self, name: str, seconds: float):
+    def observe(self, name: str, seconds: float, labels: Optional[Dict[str, str]] = None):
+        # Timings key like counters/gauges: (name, labels) — a sharded
+        # workqueue's per-shard service times must not fold into one
+        # aggregate series, or a slow callback on one shard hides
+        # behind the other shards' healthy work.
+        k = self._key(name, labels)
         with self._lock:
-            self._timing_sum[name] = self._timing_sum.get(name, 0.0) + seconds
-            self._timing_count[name] = self._timing_count.get(name, 0) + 1
-            recent = self._timing_recent.setdefault(name, [])
+            self._timing_sum[k] = self._timing_sum.get(k, 0.0) + seconds
+            self._timing_count[k] = self._timing_count.get(k, 0) + 1
+            recent = self._timing_recent.setdefault(k, [])
             recent.append(seconds)
             if len(recent) > TIMING_WINDOW:
                 del recent[: len(recent) - TIMING_WINDOW]
 
-    def quantile(self, name: str, q: float) -> Optional[float]:
+    def get_counter(self, name: str, labels: Optional[Dict[str, str]] = None) -> float:
+        """Current value of one counter series (0.0 if never bumped) —
+        harness/test probe, no text-format parsing needed."""
+        with self._lock:
+            return self._counters.get(self._key(name, labels), 0.0)
+
+    def get_gauge(self, name: str, labels: Optional[Dict[str, str]] = None) -> Optional[float]:
+        """Current value of one gauge series (None if never set)."""
+        with self._lock:
+            return self._gauges.get(self._key(name, labels))
+
+    def quantile(
+        self, name: str, q: float, labels: Optional[Dict[str, str]] = None
+    ) -> Optional[float]:
         """q-quantile over the recent observation window (None if empty)."""
         with self._lock:
-            recent = sorted(self._timing_recent.get(name, []))
+            recent = sorted(
+                self._timing_recent.get(self._key(name, labels), [])
+            )
         return _quantile_from_sorted(recent, q)
 
     def render(self) -> str:
@@ -93,17 +113,26 @@ class Metrics:
             for (name, labels), v in sorted(self._gauges.items()):
                 out.append(f"# TYPE {self.prefix}_{name} gauge")
                 out.append(f"{self.prefix}_{name}{self._fmt(labels)} {v}")
-            for name in sorted(self._timing_sum):
+            for key in sorted(self._timing_sum):
+                name, labels = key
                 out.append(f"# TYPE {self.prefix}_{name} summary")
-                recent = sorted(self._timing_recent.get(name, []))
+                recent = sorted(self._timing_recent.get(key, []))
                 for q in QUANTILES:
                     v = _quantile_from_sorted(recent, q)
                     if v is not None:
                         out.append(
-                            f'{self.prefix}_{name}{{quantile="{q}"}} {v}'
+                            f"{self.prefix}_{name}"
+                            f"{self._fmt(labels + (('quantile', str(q)),))}"
+                            f" {v}"
                         )
-                out.append(f"{self.prefix}_{name}_sum {self._timing_sum[name]}")
-                out.append(f"{self.prefix}_{name}_count {self._timing_count[name]}")
+                out.append(
+                    f"{self.prefix}_{name}_sum{self._fmt(labels)} "
+                    f"{self._timing_sum[key]}"
+                )
+                out.append(
+                    f"{self.prefix}_{name}_count{self._fmt(labels)} "
+                    f"{self._timing_count[key]}"
+                )
         return "\n".join(out) + "\n"
 
     @staticmethod

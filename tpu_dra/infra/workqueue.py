@@ -33,8 +33,9 @@ import logging
 import random
 import threading
 import time
+import zlib
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 log = logging.getLogger(__name__)
 
@@ -178,9 +179,14 @@ class WorkQueue:
         rate_limiter: Optional[RateLimiter] = None,
         metrics=None,
         max_retries: Optional[int] = None,
+        labels: Optional[Dict[str, str]] = None,
     ):
         self._rl = rate_limiter or default_controller_rate_limiter()
         self.metrics = metrics
+        # Metric labels for this queue's series (a ShardedWorkQueue
+        # passes {"shard": i} so per-shard depth is visible on /metrics
+        # — one hot shard must be diagnosable, not averaged away).
+        self.labels = labels
         # Dead-letter cap: after this many retries a still-failing item is
         # dropped (workqueue_dead_letter_total + a log line with the item)
         # instead of retrying forever at the backoff cap. None = unlimited —
@@ -205,12 +211,13 @@ class WorkQueue:
 
     def _inc(self, name: str) -> None:
         if self.metrics is not None:
-            self.metrics.inc(name)
+            self.metrics.inc(name, labels=self.labels)
 
     def _update_depth(self) -> None:
         if self.metrics is not None:
             self.metrics.set_gauge(
-                "workqueue_depth", len(self._pending) + len(self._dirty)
+                "workqueue_depth", len(self._pending) + len(self._dirty),
+                labels=self.labels,
             )
 
     def enqueue(self, obj: Any, callback: Callable[[Any], None], key: str = "") -> None:
@@ -328,6 +335,7 @@ class WorkQueue:
 
     def _process(self, item: WorkItem) -> None:
         attempts = self._rl.num_requeues(item)
+        t0 = time.monotonic()
         try:
             item.callback(item.obj)
         except Exception as e:
@@ -348,3 +356,96 @@ class WorkQueue:
                     self._finish_key_locked(item, failed=False)
                 else:
                     self._rl.forget(item)
+        finally:
+            # Per-item service time (success AND failure): sustained
+            # depth growth is only diagnosable with the work duration
+            # next to it — "queue deep because arrivals spiked" and
+            # "queue deep because one callback got slow" need different
+            # fixes (the doctor pairs this with the depth gauge).
+            if self.metrics is not None:
+                self.metrics.observe(
+                    "workqueue_work_duration_seconds",
+                    time.monotonic() - t0,
+                    labels=self.labels,
+                )
+
+
+class ShardedWorkQueue:
+    """N independent :class:`WorkQueue` shards, items routed by a stable
+    hash of their shard key.
+
+    Why: one WorkQueue serializes every key behind a single worker
+    thread — at fleet scale one hot domain's slow reconcile delays every
+    other domain's. Sharding bounds the blast radius: a key's work lands
+    on exactly one shard (crc32, deterministic across processes — the
+    built-in ``hash`` is salted per run), so per-key dedup/coalescing/
+    ordering keep their single-queue semantics, while the other shards'
+    workers keep draining independently. Per-shard fairness inside a
+    shard comes from the underlying queue's per-key dedup (a hot key
+    holds at most one pending + one dirty slot) and its FIFO heap.
+
+    ``shard_key`` defaults to the dedup key — and when the dedup key
+    identifies the isolation domain (the common case), leave it that
+    way: routing by an attribute that can CHANGE across the domain's
+    lifetime (e.g. a UID across delete/recreate) sends two incarnations
+    of one dedup key to different shards, and their reconciles then run
+    concurrently — the per-key in-flight invariant only holds within a
+    shard (the CD controller learned this; see controller._enqueue).
+    Pass an explicit ``shard_key`` only for stable groupings COARSER
+    than the dedup key (e.g. many claims sharded by their node).
+    Keyless items (no dedup key, no shard key) round-robin so
+    background one-shots don't all pile onto shard 0.
+
+    Depth is exported per shard (``workqueue_depth{shard="i"}``); the
+    doctor flags sustained growth of any one series.
+    """
+
+    def __init__(
+        self,
+        shards: int = 8,
+        rate_limiter_factory: Optional[Callable[[], RateLimiter]] = None,
+        metrics=None,
+        max_retries: Optional[int] = None,
+    ):
+        if shards < 1:
+            raise ValueError("shards must be >= 1")
+        factory = rate_limiter_factory or default_controller_rate_limiter
+        self.shards: List[WorkQueue] = [
+            WorkQueue(
+                factory(), metrics=metrics, max_retries=max_retries,
+                labels={"shard": str(i)},
+            )
+            for i in range(shards)
+        ]
+        self._rr = 0
+        self._rr_lock = threading.Lock()
+
+    def shard_of(self, shard_key: str) -> int:
+        return zlib.crc32(shard_key.encode("utf-8")) % len(self.shards)
+
+    def enqueue(
+        self,
+        obj: Any,
+        callback: Callable[[Any], None],
+        key: str = "",
+        shard_key: Optional[str] = None,
+    ) -> None:
+        sk = shard_key if shard_key is not None else key
+        if sk:
+            idx = self.shard_of(sk)
+        else:
+            with self._rr_lock:
+                idx = self._rr % len(self.shards)
+                self._rr += 1
+        self.shards[idx].enqueue(obj, callback, key=key)
+
+    def run_in_threads(self) -> List[threading.Thread]:
+        return [q.run_in_thread() for q in self.shards]
+
+    def shutdown(self) -> None:
+        for q in self.shards:
+            q.shutdown()
+
+    @property
+    def dead_letters(self) -> List[WorkItem]:
+        return [item for q in self.shards for item in q.dead_letters]

@@ -35,6 +35,7 @@ from tpu_dra.k8sclient.resources import (
     Backend,
     K8sApiError,
     ResourceDescriptor,
+    match_field_selector,
     match_label_selector,
 )
 
@@ -59,10 +60,11 @@ def merge_patch(dst: dict, src: dict) -> dict:
 
 
 class _Watch:
-    def __init__(self, rd, namespace, selector):
+    def __init__(self, rd, namespace, selector, field_selector=None):
         self.rd = rd
         self.namespace = namespace
         self.selector = selector or {}
+        self.field_selector = field_selector or {}
         self.q: "queue.Queue[Optional[Tuple[str, dict]]]" = queue.Queue()
         self.closed = False
 
@@ -70,6 +72,10 @@ class _Watch:
         if rd.plural != self.rd.plural or rd.group != self.rd.group:
             return False
         if self.namespace and obj["metadata"].get("namespace") != self.namespace:
+            return False
+        if self.field_selector and not match_field_selector(
+            obj, self.field_selector
+        ):
             return False
         return match_label_selector(
             obj["metadata"].get("labels", {}) or {}, self.selector
@@ -309,17 +315,11 @@ class FakeCluster(Backend):
                 return None
             return str(self._rv)
 
-    @staticmethod
-    def _match_fields(obj: dict, sel: Dict[str, str]) -> bool:
-        for path, want in sel.items():
-            cur = obj
-            for part in path.split("."):
-                if not isinstance(cur, dict) or part not in cur:
-                    return False
-                cur = cur[part]
-            if str(cur) != want:
-                return False
-        return True
+    # Field matching is the SHARED helper (resources.match_field_selector)
+    # so a scoped watch, a scoped list, and the informer's client-side
+    # degraded-read filter agree on semantics; kept as a staticmethod
+    # alias for callers that predate the move.
+    _match_fields = staticmethod(match_field_selector)
 
     def create(self, rd, obj, preserve_uid: bool = False) -> dict:
         obj = copy.deepcopy(obj)
@@ -455,9 +455,10 @@ class FakeCluster(Backend):
             self._emit("DELETED", rd, cur)
 
     def watch(
-        self, rd, namespace=None, label_selector=None, resource_version=None
+        self, rd, namespace=None, label_selector=None, resource_version=None,
+        field_selector=None,
     ) -> _Watch:
-        w = _Watch(rd, namespace, label_selector)
+        w = _Watch(rd, namespace, label_selector, field_selector)
         with self._lock:
             if resource_version is not None:
                 try:
@@ -485,6 +486,15 @@ class FakeCluster(Backend):
         return w
 
     # --- test conveniences ---
+
+    def live_watch_count(self) -> int:
+        """Open watch streams — the fake's watch-slot accounting (the
+        fleet harness asserts this returns to baseline after a relist
+        storm: no leaked watchers). Prunes client-closed entries, which
+        previously accumulated forever across informer reconnects."""
+        with self._lock:
+            self._watches = [w for w in self._watches if not w.closed]
+            return len(self._watches)
 
     def clear_watches(self):
         with self._lock:

@@ -359,14 +359,16 @@ class FakeApiServer:
                             200, outer.cluster.get(r.rd, r.namespace, r.name)
                         )
                     labels = _parse_selector(qs, "labelSelector")
+                    fields = _parse_selector(qs, "fieldSelector")
                     if watching:
                         rv = qs.get("resourceVersion", [None])[0]
                         bookmarks = (
                             qs.get("allowWatchBookmarks", ["false"])[0]
                             == "true"
                         )
-                        return self._serve_watch(r, labels, rv, bookmarks)
-                    fields = _parse_selector(qs, "fieldSelector")
+                        return self._serve_watch(
+                            r, labels, rv, bookmarks, fields
+                        )
                     limit_raw = qs.get("limit", [None])[0]
                     # limit=0 means "no limit" on a real apiserver.
                     limit = (int(limit_raw) or None) if limit_raw else None
@@ -403,11 +405,11 @@ class FakeApiServer:
                     return self._error(e)
 
             def _serve_watch(self, r: _Route, labels, rv=None,
-                             bookmarks=False) -> None:
+                             bookmarks=False, fields=None) -> None:
                 try:
                     w = outer.cluster.watch(
                         r.rd, r.namespace, label_selector=labels,
-                        resource_version=rv,
+                        resource_version=rv, field_selector=fields,
                     )
                 except Exception as e:
                     return self._error(e)

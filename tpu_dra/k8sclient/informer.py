@@ -25,7 +25,13 @@ import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 from tpu_dra.infra.deadline import Budget
-from tpu_dra.k8sclient.resources import ApiGone, Backend, ResourceDescriptor
+from tpu_dra.k8sclient.resources import (
+    ApiGone,
+    Backend,
+    ResourceDescriptor,
+    match_field_selector,
+    match_label_selector,
+)
 
 log = logging.getLogger(__name__)
 
@@ -44,12 +50,18 @@ class Informer:
         rd: ResourceDescriptor,
         namespace: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
+        field_selector: Optional[Dict[str, str]] = None,
         metrics=None,
     ):
         self.backend = backend
         self.rd = rd
         self.namespace = namespace
         self.label_selector = label_selector
+        # Server-side scope (``spec.nodeName=...`` style): a node-local
+        # informer over a fleet-sized resource watches ONE node's
+        # objects, so its cache stays O(node), not O(fleet) — the
+        # field-selector scoping the 5k-node harness measures.
+        self.field_selector = field_selector
         self.metrics = metrics  # optional infra.metrics.Metrics
         self._store: Dict[Tuple[Optional[str], str], dict] = {}
         self._lock = threading.RLock()
@@ -142,7 +154,8 @@ class Informer:
         while not self._stopped.is_set():
             try:
                 watch = self.backend.watch(
-                    self.rd, self.namespace, self.label_selector
+                    self.rd, self.namespace, self.label_selector,
+                    field_selector=self.field_selector,
                 )
                 if not self._assign_watch(watch):
                     return False
@@ -261,6 +274,7 @@ class Informer:
                             w = self.backend.watch(
                                 self.rd, self.namespace, self.label_selector,
                                 resource_version=self._last_rv,
+                                field_selector=self.field_selector,
                             )
                             if not self._assign_watch(w):
                                 return
@@ -276,7 +290,8 @@ class Informer:
                                 self._last_rv,
                             )
                     w = self.backend.watch(
-                        self.rd, self.namespace, self.label_selector
+                        self.rd, self.namespace, self.label_selector,
+                        field_selector=self.field_selector,
                     )
                     if not self._assign_watch(w):
                         return
@@ -304,7 +319,8 @@ class Informer:
         _FALLBACK_BYPASS.active = True
         try:
             fresh = self.backend.list(
-                self.rd, self.namespace, self.label_selector
+                self.rd, self.namespace, self.label_selector,
+                field_selector=self.field_selector,
             )
         finally:
             _FALLBACK_BYPASS.active = False
@@ -360,6 +376,15 @@ class Informer:
                     if pi is not None and ni is not None and ni < pi:
                         return  # replayed event older than the store
                 self._store[key] = obj
+            size = len(self._store)
+        if self.metrics is not None:
+            # Cache-size gauge: the fleet harness asserts this stays
+            # flat across a relist storm (no unbounded growth, scoped
+            # informers staying O(node)) instead of eyeballing RSS.
+            self.metrics.set_gauge(
+                "informer_store_objects", size,
+                labels={"informer": self.rd.plural},
+            )
         if dispatch:
             for h in self._handlers:
                 try:
@@ -389,6 +414,25 @@ class Informer:
         with self._lock:
             return [copy.deepcopy(o) for o in self._store.values()]
 
+    def store_size(self) -> int:
+        """Number of cached objects (no copy — harness/gauge probe)."""
+        with self._lock:
+            return len(self._store)
+
+    def list_refs(self) -> List[dict]:
+        """The stored objects WITHOUT the defensive deep copy.
+
+        READ-ONLY CONTRACT: callers must not mutate the returned
+        objects — they ARE the cache. This exists for fleet-scale hot
+        loops that only *parse* the listing (the scheduler's per-sweep
+        ``SliceIndex.resync`` over 5k ResourceSlices paid ~O(40MB) of
+        deepcopy every 500ms through :meth:`list`; the harness exposed
+        it as the sweep pinning a core). The snapshot is the list
+        itself (safe to iterate after release); the elements are live.
+        """
+        with self._lock:
+            return list(self._store.values())
+
     # --- degraded-read hook (rest.KubeClient.read_fallback) ---
 
     def serve_read(
@@ -396,6 +440,7 @@ class Informer:
         namespace: Optional[str],
         name: Optional[str],
         label_selector: Optional[Dict[str, str]],
+        field_selector: Optional[Dict[str, str]] = None,
     ) -> Optional[object]:
         """Answer a get (``name`` set) or list (``name`` None) for this
         informer's resource from the synced store — the stale-read path
@@ -403,7 +448,11 @@ class Informer:
         None (fall through to :class:`CircuitOpenError`) when the store
         cannot faithfully answer: initial sync never landed, the query
         is outside this informer's namespace scope, or it was built
-        with a label selector narrower than the query's."""
+        with a label or field selector narrower than the query's. A
+        field-selected query against a wider store is filtered
+        CLIENT-SIDE with the backends' own matcher — a degraded
+        node-scoped list must come back scoped, never silently
+        unfiltered."""
         if not self._synced.is_set():
             return None
         if self.namespace is not None and namespace != self.namespace:
@@ -412,8 +461,12 @@ class Informer:
             label_selector != self.label_selector
         ):
             return None
+        if self.field_selector is not None and (
+            field_selector != self.field_selector
+        ):
+            return None
         if name is not None:
-            if label_selector is not None:
+            if label_selector is not None or field_selector is not None:
                 return None
             return self.get(name, namespace)
         items = self.list()
@@ -425,10 +478,14 @@ class Informer:
         if label_selector is not None and self.label_selector is None:
             items = [
                 o for o in items
-                if all(
-                    o.get("metadata", {}).get("labels", {}).get(k) == v
-                    for k, v in label_selector.items()
+                if match_label_selector(
+                    o.get("metadata", {}).get("labels", {}) or {},
+                    label_selector,
                 )
+            ]
+        if field_selector is not None and self.field_selector is None:
+            items = [
+                o for o in items if match_field_selector(o, field_selector)
             ]
         return items
 
@@ -445,7 +502,7 @@ def install_read_fallback(backend, informers: List[Informer]) -> None:
         return
     by_rd = {inf.rd.plural: inf for inf in informers}
 
-    def fallback(rd, namespace, name, label_selector):
+    def fallback(rd, namespace, name, label_selector, field_selector=None):
         if getattr(_FALLBACK_BYPASS, "active", False):
             # An informer's own resync list: it must observe the real
             # apiserver (or fail and keep backing off), never be served
@@ -454,6 +511,6 @@ def install_read_fallback(backend, informers: List[Informer]) -> None:
         inf = by_rd.get(rd.plural)
         if inf is None:
             return None
-        return inf.serve_read(namespace, name, label_selector)
+        return inf.serve_read(namespace, name, label_selector, field_selector)
 
     backend.read_fallback = fallback

@@ -194,6 +194,22 @@ def match_label_selector(labels: Dict[str, str], selector: Dict[str, str]) -> bo
     return all(labels.get(k) == v for k, v in selector.items())
 
 
+def match_field_selector(obj: dict, selector: Dict[str, str]) -> bool:
+    """Dotted-path equality match (``spec.nodeName=node-3`` style) —
+    the apiserver's field-selector subset every backend and the
+    informer's client-side degraded-read filter share, so a scoped
+    watch and a scoped cached list agree on what "matches" means."""
+    for path, want in selector.items():
+        cur = obj
+        for part in path.split("."):
+            if not isinstance(cur, dict) or part not in cur:
+                return False
+            cur = cur[part]
+        if str(cur) != want:
+            return False
+    return True
+
+
 class Backend:
     """What a transport must provide (implemented by FakeCluster and
     rest.KubeClient)."""
@@ -233,11 +249,14 @@ class Backend:
         namespace: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
         resource_version: Optional[str] = None,
+        field_selector: Optional[Dict[str, str]] = None,
     ):
         """Returns an iterator of (event_type, obj) plus a close() handle.
         With ``resource_version``, replays events after that version
         (raising :class:`ApiGone` when it fell out of the server's event
-        window)."""
+        window). ``field_selector`` scopes the stream server-side
+        (``spec.nodeName=...`` is how a node-local informer avoids
+        holding the whole fleet's slices in memory)."""
         raise NotImplementedError
 
 
@@ -287,8 +306,10 @@ class ResourceClient:
         namespace: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
         resource_version: Optional[str] = None,
+        field_selector: Optional[Dict[str, str]] = None,
     ):
         return self.backend.watch(
             self.rd, namespace, label_selector,
             resource_version=resource_version,
+            field_selector=field_selector,
         )

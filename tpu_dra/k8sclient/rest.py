@@ -138,8 +138,9 @@ class KubeClient(Backend):
         # Degraded-mode read path: when the circuit is OPEN, get/list
         # may serve from an informer cache instead of failing. Callers
         # that hold a synced informer install
-        # ``(rd, namespace, name_or_None, label_selector) -> result or
-        # None``; None falls through to CircuitOpenError.
+        # ``(rd, namespace, name_or_None, label_selector,
+        # field_selector) -> result or None``; None falls through to
+        # CircuitOpenError.
         self.read_fallback: Optional[Callable] = None
 
     def _timeout(self, verb: str) -> float:
@@ -459,7 +460,7 @@ class KubeClient(Backend):
             ), verb="get", idempotent=True))
         except CircuitOpenError:
             if self.read_fallback is not None:
-                cached = self.read_fallback(rd, namespace, name, None)
+                cached = self.read_fallback(rd, namespace, name, None, None)
                 if cached is not None:
                     self._observe_fallback("get")
                     return cached
@@ -483,11 +484,14 @@ class KubeClient(Backend):
                 rd, namespace, label_selector, field_selector
             )
         except CircuitOpenError:
-            # field_selector filtering is not implemented by informer
-            # caches; only plain/label-selected lists may serve stale.
-            if self.read_fallback is not None and field_selector is None:
+            # Field-selected lists serve stale too: the informer filters
+            # its store client-side (Informer.serve_read) with the same
+            # matcher the backends use (resources.match_field_selector),
+            # so a degraded node-scoped list is SCOPED, not silently
+            # unfiltered.
+            if self.read_fallback is not None:
                 cached = self.read_fallback(
-                    rd, namespace, None, label_selector
+                    rd, namespace, None, label_selector, field_selector
                 )
                 if cached is not None:
                     self._observe_fallback("list")
@@ -561,9 +565,10 @@ class KubeClient(Backend):
         ), verb="delete"))
 
     def watch(
-        self, rd, namespace=None, label_selector=None, resource_version=None
+        self, rd, namespace=None, label_selector=None, resource_version=None,
+        field_selector=None,
     ) -> _RestWatch:
-        params = self._selector_params(label_selector)
+        params = self._selector_params(label_selector, field_selector)
         params["watch"] = "true"
         # Ask for BOOKMARK progress events: an idle or tightly-filtered
         # watch still advances its resume point, so reconnecting after a

@@ -48,6 +48,7 @@ from tpu_dra.plugin.dra_service import (
 )
 from tpu_dra.plugin.remediation import RemediationController
 from tpu_dra.plugin.sharing import MultiplexManager
+from tpu_dra.plugin.slicepub import SlicePublisher
 from tpu_dra.plugin.subslice import build_partitionable_model
 from tpu_dra.plugin.vfio import VfioPciManager
 from tpu_dra.tpulib.interface import TpuLib
@@ -87,6 +88,11 @@ class DriverConfig:
     # must stay unhealthy before leases are revoked and prepared claims
     # requeued — flaps shorter than this are suppressed.
     remediation_debounce_seconds: float = 30.0
+    # Publish coalescing (ISSUE 10): health-event-driven publishes
+    # arriving within this window collapse into ONE content-diffed
+    # pass (publish_soon). 0 = publish synchronously per event (the
+    # pre-fleet behavior; unit drills that assert immediately use it).
+    publish_coalesce_seconds: float = 0.25
 
 
 class Driver:
@@ -171,7 +177,18 @@ class Driver:
                 circuit=self.circuit,
             )
         self._publish_lock = threading.Lock()
-        self._slice_generation = 0
+        # Content-diffed pool-set publisher (plugin/slicepub.py): the
+        # steady state (nothing changed) costs ZERO apiserver writes,
+        # and the pool generation advances only when content moved.
+        # Serialized by _publish_lock; its generation is the supersede
+        # guard's token.
+        self._publisher = SlicePublisher(
+            self.slices, node_name=config.node_name, metrics=self.metrics,
+        )
+        # Coalesced publish trigger (publish_soon): one armed timer per
+        # window; storms ride it instead of each publishing.
+        self._coalesce_lock = threading.Lock()
+        self._coalesce_timer: Optional[threading.Timer] = None
         # The degraded-mode state machine (gauge, publish parking, heal
         # prober, fenced resync) is shared with the CD plugin; this
         # driver supplies the component-specific probe/resync/replay.
@@ -341,6 +358,10 @@ class Driver:
 
     def shutdown(self) -> None:
         self._stop.set()
+        with self._coalesce_lock:
+            if self._coalesce_timer is not None:
+                self._coalesce_timer.cancel()
+                self._coalesce_timer = None
         self.cleanup.stop()
         if self.remediation is not None:
             self.remediation.stop()
@@ -374,6 +395,12 @@ class Driver:
                 "heal resync: unprepared %d claim(s) that went stale "
                 "during the outage", cleaned,
             )
+        # The outage may have eaten our slices (apiserver restore, GC):
+        # drop the publisher's diff cache so the replayed publish
+        # re-verifies against the recovered server instead of trusting
+        # pre-outage resourceVersions into a zero-write no-op.
+        with self._publish_lock:
+            self._publisher.invalidate()
 
     def _defer_publish_while_degraded(self) -> bool:
         """True when the circuit is open and the publish was queued for
@@ -400,7 +427,9 @@ class Driver:
         # sub-slice therefore stays unpublished until ALL its chips recover.
         if self.state.recompute_health():
             self.metrics.inc("health_transitions_total")
-            self.publish_with_retry()
+            # Coalesced: a flap storm collapses into one diffed publish
+            # pass per window instead of one write burst per event.
+            self.publish_soon()
         # Remediation sees EVERY non-benign event, not only device-health
         # transitions: a second unhealthy reason on an already-unhealthy
         # chip must not reset or bypass the debounce bookkeeping.
@@ -410,6 +439,40 @@ class Driver:
     # --- ResourceSlice publication (driver.go:188-268) ---
 
     MAX_PUBLISH_RETRY_DELAY = 30.0
+
+    @property
+    def _slice_generation(self) -> int:
+        """Supersede-guard token (read under _publish_lock): the
+        publisher's committed pool generation. It advances only when a
+        publish pass actually changed content, so a stale retry chain
+        parked behind an unchanged no-op pass correctly survives."""
+        return self._publisher.generation
+
+    def publish_soon(self) -> None:
+        """Coalesced publish trigger: the first call in a
+        ``publish_coalesce_seconds`` window arms one timer; calls
+        landing while it is armed ride it (``publish_coalesced_total``)
+        — an event storm becomes one content-diffed pass. Window <= 0
+        publishes synchronously (per-event, the pre-fleet behavior)."""
+        window = self.config.publish_coalesce_seconds
+        if window <= 0:
+            self.publish_with_retry()
+            return
+        with self._coalesce_lock:
+            if self._stop.is_set():
+                return
+            if self._coalesce_timer is not None:
+                self.metrics.inc("publish_coalesced_total")
+                return
+            t = threading.Timer(window, self._coalesced_publish)
+            t.daemon = True
+            self._coalesce_timer = t
+            t.start()
+
+    def _coalesced_publish(self) -> None:
+        with self._coalesce_lock:
+            self._coalesce_timer = None
+        self.publish_with_retry()
 
     def publish_with_retry(
         self,
@@ -476,34 +539,23 @@ class Driver:
             t.start()
 
     def publish_resources(self) -> None:
+        """One content-diffed publish pass (SlicePublisher): zero API
+        writes when the desired pool set is unchanged, one PATCH/create
+        per slice (plus deletes) when it is not."""
         with self._publish_lock:
-            self._slice_generation += 1
             if self.config.resource_api_version == "v1beta1":
-                slices = self._generate_split_slices()
+                build = self._generate_split_slices
             else:
-                slices = self._generate_combined_slices()
-            existing = {
-                s["metadata"]["name"]: s
-                for s in self.slices.list(
-                    label_selector={"tpu.google.com/driver": "true"}
-                )
-                if s["spec"].get("nodeName") == self.config.node_name
-            }
-            want_names = set()
-            for s in slices:
-                name = s["metadata"]["name"]
-                want_names.add(name)
-                cur = existing.get(name)
-                if cur is None:
-                    self.slices.create(s)
-                else:
-                    s["metadata"]["resourceVersion"] = cur["metadata"][
-                        "resourceVersion"
-                    ]
-                    self.slices.update(s)
-            for name in set(existing) - want_names:
-                self.slices.delete(name)
-            self.metrics.set_gauge("published_resource_slices", len(slices))
+                build = self._generate_combined_slices
+            count = {"n": 0}
+
+            def counted_build(generation: int):
+                slices = build(generation)
+                count["n"] = len(slices)
+                return slices
+
+            self._publisher.publish(counted_build)
+            self.metrics.set_gauge("published_resource_slices", count["n"])
 
     def _device_entry(self, dev: AllocatableDevice) -> Optional[dict]:
         if not dev.healthy:
@@ -517,7 +569,9 @@ class Driver:
             entry["basic"]["capacity"] = capacity
         return entry
 
-    def _slice_skeleton(self, name_suffix: str, device_entries: List[dict]) -> dict:
+    def _slice_skeleton(
+        self, name_suffix: str, device_entries: List[dict], generation: int
+    ) -> dict:
         return {
             "apiVersion": "resource.k8s.io/v1beta1",
             "kind": "ResourceSlice",
@@ -530,14 +584,14 @@ class Driver:
                 "nodeName": self.config.node_name,
                 "pool": {
                     "name": self.config.node_name,
-                    "generation": self._slice_generation,
+                    "generation": generation,
                     "resourceSliceCount": 1,
                 },
                 "devices": device_entries,
             },
         }
 
-    def _generate_split_slices(self) -> List[dict]:
+    def _generate_split_slices(self, generation: int) -> List[dict]:
         """Flat slices, one per device type (generateSplitResourceSlices,
         driver.go:188-225): older API servers reject counter fields."""
         by_type: Dict[str, List[dict]] = {}
@@ -547,7 +601,9 @@ class Driver:
                 by_type.setdefault(dev.type, []).append(entry)
         out = []
         for t, entries in sorted(by_type.items()):
-            out.append(self._slice_skeleton(t, sorted(entries, key=lambda e: e["name"])))
+            out.append(self._slice_skeleton(
+                t, sorted(entries, key=lambda e: e["name"]), generation
+            ))
         # The pool is only consistent when every slice declares the total
         # slice count at this generation (DRA pool semantics; the reference
         # delegates this bookkeeping to the k8s resourceslice helper).
@@ -555,7 +611,7 @@ class Driver:
             s["spec"]["pool"]["resourceSliceCount"] = len(out)
         return out
 
-    def _generate_combined_slices(self) -> List[dict]:
+    def _generate_combined_slices(self, generation: int) -> List[dict]:
         """One combined partitionable slice with KEP-4815 shared counters
         (generateCombinedResourceSlices, driver.go:230-268)."""
         model = build_partitionable_model(self.tpulib, self.state.allocatable)
@@ -568,7 +624,7 @@ class Driver:
             if consumption:
                 entry["basic"]["consumesCounters"] = consumption
             entries.append(entry)
-        s = self._slice_skeleton("combined", entries)
+        s = self._slice_skeleton("combined", entries, generation)
         s["apiVersion"] = f"resource.k8s.io/{self.config.resource_api_version}"
         s["spec"]["sharedCounters"] = model.counter_sets
         s["spec"]["perDeviceNodeSelection"] = False

@@ -1,0 +1,198 @@
+"""Content-diffed, batched ResourceSlice publication (ISSUE 10).
+
+The pre-fleet publisher did, on EVERY ``publish_resources`` call — every
+health event, every retry-chain tick, every remediation republish — one
+LIST of the node's slices plus one full UPDATE per desired slice,
+whether anything changed or not. One node flapping is noise; 5k nodes
+doing it is an apiserver write storm, and every no-op UPDATE still
+bumps resourceVersions and fans out to every slice watcher in the
+cluster (the scheduler's index, every informer) as a MODIFIED event.
+
+This publisher makes the steady state free and the changed state
+minimal:
+
+- **Content diff**: desired slices are digested with the pool
+  generation masked out. When the digest set matches the last committed
+  write, the publish is a no-op — zero API calls, zero watcher events
+  (``publish_skipped_unchanged_total``). The pool generation only
+  advances when content actually changed, so watchers see a new
+  generation exactly when there is something new to see.
+- **Pool-set writes**: when content DID change, the whole pool set is
+  written in one pass (merge-PATCH per known slice, create per new,
+  delete per vanished) so the pool's slices always agree on generation
+  and ``resourceSliceCount`` — DRA pool consistency is per pool set,
+  not per slice.
+- **No LIST per publish**: the last-committed content digests are
+  remembered from our own writes; only the cold start, a create
+  conflict, or the periodic trust-but-verify window pays a relist.
+  Writes are plain merge-PATCHes (no optimistic concurrency): an
+  external MODIFICATION of our slice is overwritten on the next
+  content change, an external DELETION/CREATION heals via the
+  not-found/conflict paths or the reverify relist.
+
+The driver (plugin/driver.py) additionally COALESCES publish triggers
+through :meth:`Driver.publish_soon` — a storm of health events within
+the coalesce window collapses into one diffed pass, riding the existing
+generation-supersede guard for retry chains.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import time
+from typing import Callable, Dict, List, Optional
+
+from tpu_dra.k8sclient.resources import ApiConflict, ApiNotFound
+
+log = logging.getLogger(__name__)
+
+
+def slice_content_digest(s: dict) -> str:
+    """Digest of everything that makes a slice *mean* something —
+    metadata name/labels and the spec with the pool generation masked
+    (the generation is bookkeeping ABOUT change, not content; including
+    it would make every diff a change)."""
+    spec = dict(s["spec"])
+    if isinstance(spec.get("pool"), dict):
+        spec["pool"] = {**spec["pool"], "generation": 0}
+    body = {
+        "name": s["metadata"]["name"],
+        "labels": s["metadata"].get("labels"),
+        "apiVersion": s.get("apiVersion"),
+        "spec": spec,
+    }
+    return hashlib.sha1(
+        json.dumps(body, sort_keys=True, default=str).encode("utf-8")
+    ).hexdigest()
+
+
+class SlicePublisher:
+    """One node's pool-set publisher. NOT internally locked: the owner
+    serializes calls (the driver holds ``_publish_lock`` across
+    :meth:`publish`; each fleetsim node agent owns its publisher)."""
+
+    def __init__(
+        self,
+        slices,  # ResourceClient bound to RESOURCE_SLICES
+        node_name: str,
+        label_selector: Optional[Dict[str, str]] = None,
+        metrics=None,
+        presume_empty: bool = False,
+        reverify_seconds: float = 300.0,
+    ):
+        self.slices = slices
+        self.node_name = node_name
+        self.label_selector = label_selector or {
+            "tpu.google.com/driver": "true"
+        }
+        self.metrics = metrics
+        self.generation = 0
+        # Periodic trust-but-verify: the diff cache makes unchanged
+        # publishes free, which also means an EXTERNAL deletion (admin
+        # cleanup, apiserver GC, etcd restore) would never be healed by
+        # unchanged-content triggers. At most every reverify_seconds a
+        # publish re-lists the server before diffing, so drift heals on
+        # the next trigger within a bounded window. 0 disables (tests).
+        self.reverify_seconds = reverify_seconds
+        self._last_verify = time.monotonic()
+        # name -> content digest of every slice WE committed; None =
+        # never synced (cold start relists to adopt pre-existing slices
+        # from an earlier process incarnation). ``presume_empty`` skips
+        # that adoption relist — the fleet harness spins up thousands
+        # of publishers against a server it KNOWS starts empty, and N
+        # cold LISTs of an N-node fleet is O(N^2).
+        self._published: Optional[Dict[str, str]] = (
+            {} if presume_empty else None
+        )
+
+    def _inc(self, name: str, n: int = 1) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(name, n)
+
+    def _relist(self) -> Dict[str, str]:
+        existing = {}
+        for s in self.slices.list(label_selector=self.label_selector):
+            if s["spec"].get("nodeName") != self.node_name:
+                continue
+            existing[s["metadata"]["name"]] = slice_content_digest(s)
+        return existing
+
+    def invalidate(self) -> None:
+        """Drop the write cache; the next publish relists. Called when
+        an external writer is known to have touched the pool set."""
+        self._published = None
+
+    def publish(self, build: Callable[[int], List[dict]]) -> int:
+        """Diff-and-write one pass; returns the number of API writes.
+
+        ``build(generation)`` produces the desired pool set stamped with
+        the PROPOSED generation. When the content (generation masked) is
+        unchanged since the last committed pass, nothing is written and
+        the generation does not advance."""
+        if self._published is not None and self.reverify_seconds > 0:
+            now = time.monotonic()
+            if now - self._last_verify >= self.reverify_seconds:
+                self._published = None
+        if self._published is None:
+            self._published = self._relist()
+            self._last_verify = time.monotonic()
+        proposed = self.generation + 1
+        desired = build(proposed)
+        digests = {
+            s["metadata"]["name"]: slice_content_digest(s) for s in desired
+        }
+        stale = set(self._published) - set(digests)
+        changed = {
+            name for name, d in digests.items()
+            if self._published.get(name) != d
+        }
+        if not changed and not stale:
+            self._inc("publish_skipped_unchanged_total")
+            return 0
+        # Content moved: commit the WHOLE pool set at the new generation
+        # (per-slice partial writes would leave the pool's slices
+        # disagreeing on generation/resourceSliceCount).
+        writes = 0
+        try:
+            for s in desired:
+                name = s["metadata"]["name"]
+                known = self._published.get(name)
+                if known is None:
+                    self.slices.create(s)
+                else:
+                    body = {
+                        "metadata": {"labels": s["metadata"].get("labels")},
+                        "spec": s["spec"],
+                    }
+                    if s.get("apiVersion"):
+                        body["apiVersion"] = s["apiVersion"]
+                    try:
+                        self.slices.patch(name, body)
+                    except ApiNotFound:
+                        # Externally deleted behind our cache.
+                        self.slices.create(s)
+                writes += 1
+                self._published[name] = digests[name]
+            for name in sorted(stale):
+                try:
+                    self.slices.delete(name)
+                    writes += 1
+                except ApiNotFound:
+                    pass
+                self._published.pop(name, None)
+        except ApiConflict:
+            # An external writer raced us: our cache is stale. Drop it
+            # (next attempt relists) and let the caller's retry logic
+            # re-drive the pass.
+            self.invalidate()
+            raise
+        except Exception:
+            # A partial pass leaves the cache half-updated relative to
+            # the server; relist on the next attempt rather than trust it.
+            self.invalidate()
+            raise
+        self.generation = proposed
+        self._inc("publish_writes_total", writes)
+        return writes

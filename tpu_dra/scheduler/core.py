@@ -12,14 +12,16 @@ Fleet-scale shape (docs/scheduling.md): the controller owns ONE
 persistent :class:`~tpu_dra.scheduler.index.SliceIndex`, updated
 incrementally from slice informer events (and resynced from the
 informer store each sweep as the missed-event backstop), so building a
-per-attempt allocator no longer re-scans the fleet. Capacity changes
-and the periodic sweep funnel into a single BATCH reconcile item
-(key ``__batch__`` on the same workqueue, so allocation stays
-serialized): all pending claims are solved against one shared
+per-attempt allocator no longer re-scans the fleet. Capacity changes,
+claim arrivals, and the periodic sweep ALL funnel into a single BATCH
+reconcile item (key ``__batch__`` on the same workqueue, so allocation
+stays serialized): all pending claims are solved against one shared
 snapshot/ledger via ``allocate_batch`` — sorted largest-first — which
 amortizes index lookups and constraint checks and lets packing see the
-whole pending set. Individual claim events still take the low-latency
-single-claim path.
+whole pending set. A lone claim's batch pass costs what its old
+single-claim reconcile did (one LIST + one allocate); a 250/s claim
+storm collapses into back-to-back batch passes instead of O(storm)
+full-snapshot reconciles (the fleetsim p99 finding, ISSUE 10).
 
 Deallocation is implicit and stateless: usage is recomputed from live
 claims each attempt, so a deleted/released claim frees its devices and
@@ -80,6 +82,10 @@ class SchedulerCore:
             backend, DEVICE_CLASSES, metrics=self.metrics
         )
         self.retry_unschedulable_after = retry_unschedulable_after
+        # Idle-sweep refresh period for the O(fleet) fragmentation
+        # gauge (batch reconciles refresh it on every solve anyway).
+        self.frag_refresh_period = 10.0
+        self._last_frag = 0.0
         # Persistent candidate index: slice events keep it current;
         # the sweep resyncs it from the informer store (backstop for
         # events missed while not leading).
@@ -139,9 +145,27 @@ class SchedulerCore:
 
     def _on_claim_event(self, event: str, claim: dict) -> None:
         if event == "DELETED":
-            return  # release is implicit in the next snapshot
+            # Release is implicit in the next snapshot, but the
+            # unschedulable-event dedup entry must clear HERE (it used
+            # to clear in the single-claim reconcile's gone-claim
+            # check): otherwise entries leak per churned claim, and a
+            # RECREATED ns/name that is unschedulable for the same
+            # reason would have its operator-facing event silently
+            # suppressed.
+            with self._unsched_lock:
+                self._last_unsched.pop(self._key(claim), None)
+            return
         if not (claim.get("status") or {}).get("allocation"):
-            self.queue.enqueue(claim, self._reconcile, key=self._key(claim))
+            # Funnel into the batch item (ISSUE 10): a per-claim
+            # reconcile pays a full claims LIST + allocator build PER
+            # CLAIM — at a 250 claims/s fleet storm that serialized the
+            # queue behind O(pending) snapshots and dominated the
+            # claim-ready p99 (fleetsim finding). The workqueue dedups
+            # BATCH_KEY, so a storm collapses into back-to-back batch
+            # passes, each solving EVERYTHING pending against one
+            # snapshot; a lone claim costs the same as its old single
+            # reconcile (one list + one allocate).
+            self.queue.enqueue(None, self._reconcile_batch, key=BATCH_KEY)
 
     def _on_slice_event(self, event: str, obj: dict) -> None:
         self.index.on_slice_event(event, obj)
@@ -164,9 +188,12 @@ class SchedulerCore:
             try:
                 # Resync only from a SYNCED store: pre-sync list() is
                 # empty, and reconciling against it would wipe the
-                # event-populated index until the next sweep.
+                # event-populated index until the next sweep. list_refs
+                # (no deep copy): the index only PARSES the slices —
+                # at 5k nodes the defensive copy was ~40MB per sweep,
+                # pinning a core for nothing (fleetsim finding).
                 if self.slice_informer.wait_for_sync(timeout=0):
-                    self.index.resync(self.slice_informer.list())
+                    self.index.resync(self.slice_informer.list_refs())
                 snapshot = self.claims.list()
                 pending = sum(
                     1 for claim in snapshot
@@ -177,7 +204,18 @@ class SchedulerCore:
                         None, self._reconcile_batch, key=BATCH_KEY
                     )
                 self.metrics.set_gauge("scheduler_pending_claims", pending)
-                self._update_frag_gauge(self._snapshot_allocator(snapshot))
+                # The frag gauge is O(fleet) pure Python (every pool's
+                # feasibility probe): refreshing it EVERY sweep pegged
+                # the GIL at 5k nodes and starved the allocation thread
+                # (fleetsim finding). Batch reconciles refresh it for
+                # free; the sweep only backstops an idle scheduler on
+                # its own (longer) period.
+                now = time.monotonic()
+                if now - self._last_frag >= self.frag_refresh_period:
+                    self._last_frag = now  # lint: disable=R200 (sweep + workqueue race is benign: both only throttle the gauge)
+                    self._update_frag_gauge(
+                        self._snapshot_allocator(snapshot)
+                    )
             except Exception:
                 log.exception("scheduler periodic sweep failed")
 
@@ -205,29 +243,6 @@ class SchedulerCore:
         self.metrics.set_gauge(
             "scheduler_free_chips", frag["free_chips"]
         )
-
-    def _reconcile(self, claim_snapshot: dict) -> None:
-        md = claim_snapshot["metadata"]
-        key = self._key(claim_snapshot)
-        claim = self.claims.try_get(md["name"], md.get("namespace"))
-        if claim is None or (claim.get("status") or {}).get("allocation"):
-            with self._unsched_lock:
-                self._last_unsched.pop(key, None)
-            return
-        if claim["metadata"].get("deletionTimestamp"):
-            return
-        t0 = time.monotonic()
-        try:
-            result = self._snapshot_allocator().allocate(claim)
-        except Unschedulable as e:
-            self._note_unschedulable(claim, e)
-            # Raise so the workqueue retries with backoff — capacity
-            # changes also re-enqueue via the capacity handlers.
-            raise
-        if self._commit(claim, result):
-            self.metrics.observe(
-                "scheduler_allocate_seconds", time.monotonic() - t0
-            )
 
     def _reconcile_batch(self, _obj) -> None:
         """Solve every pending claim against ONE shared snapshot —
@@ -257,6 +272,7 @@ class SchedulerCore:
         self.metrics.observe(
             "scheduler_allocate_batch_seconds", time.monotonic() - t0
         )
+        self._last_frag = time.monotonic()  # lint: disable=R200 (workqueue thread + sweep race is benign: both only throttle the gauge)
         self._update_frag_gauge(alloc)
         log.info(
             "batch allocation: %d pending -> %d allocated, "

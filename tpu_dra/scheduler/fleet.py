@@ -1,0 +1,177 @@
+"""Shared synthetic-fleet generator (ISSUE 10, satellite of the fleet
+harness).
+
+One builder produces the fleet every fleet-scale measurement runs
+against: the allocator microbench (:mod:`tpu_dra.scheduler.allocbench`),
+the parity fuzzers, AND the control-plane fleet simulator
+(:mod:`tpu_dra.tools.fleetsim`). Before this module each consumer could
+drift its own fleet shape, and "the allocator does X claims/s at 5k
+nodes" and "claim-ready p99 is Y ms at 5k nodes" would quietly describe
+*different* fleets. Now they are the identical ResourceSlices by
+construction.
+
+Fleet shape: one ResourceSlice per node — 4 chips on a 2x2x1 mesh,
+every SHAPES placement advertised as a sub-slice device, one shared
+counter set making overlapping placements mutually exclusive (the
+KEP-4815 partitionable model the plugin publishes for real nodes).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Tuple
+
+DRIVER = "tpu.google.com"
+
+# Shape -> (origin, chip coordinates covered) on the per-node 2x2x1
+# mesh. Row shapes (2x1x1) are deliberately the only advertised pair:
+# an intra-pool 1x1 placement that splits BOTH rows strands them — the
+# asymmetry the frag score exists to avoid. Devices are named by origin
+# coordinate, so plain (pool, name) first-fit walks 1x1 origins
+# column-major (0,0 then 0,1 — across the rows), the natural naive
+# order a coordinate-sorted catalog produces.
+MESH_COORDS = ["0,0,0", "0,1,0", "1,0,0", "1,1,0"]
+SHAPES: Dict[str, List[Tuple[str, List[str]]]] = {
+    "1x1x1": [(c, [c]) for c in MESH_COORDS],
+    "2x1x1": [
+        ("0,0,0", ["0,0,0", "1,0,0"]),
+        ("0,1,0", ["0,1,0", "1,1,0"]),
+    ],
+    "2x2x1": [("0,0,0", list(MESH_COORDS))],
+}
+# Arrival mix: mean footprint ~2.35 chips, tuned so the standard
+# traces (10k claims over the 5k-node/20k-chip fleet, 30% churn
+# between waves) land the grid at ~94% — the regime where the fate of
+# every churn-freed pool decides whether a late 2x2 fits, i.e. where
+# packing strategies actually diverge. A small-heavy mix leaves enough
+# untouched pools (and enough hole-filling 1x1 arrivals) that ANY
+# order packs perfectly and the bench measures nothing.
+SHAPE_WEIGHTS = [("1x1x1", 35), ("2x1x1", 30), ("2x2x1", 35)]
+
+TPU_CLASS = {
+    "apiVersion": "resource.k8s.io/v1beta1",
+    "kind": "DeviceClass",
+    "metadata": {"name": "tpu.google.com"},
+    "spec": {
+        "selectors": [{"cel": {"expression":
+            "device.driver == 'tpu.google.com' && "
+            "device.attributes['tpu.google.com'].type == 'tpu'"}}],
+    },
+}
+SUBSLICE_CLASS = {
+    "apiVersion": "resource.k8s.io/v1beta1",
+    "kind": "DeviceClass",
+    "metadata": {"name": "tpu-subslice.google.com"},
+    "spec": {
+        "selectors": [{"cel": {"expression":
+            "device.driver == 'tpu.google.com' && "
+            "device.attributes['tpu.google.com'].type"
+            ".startsWith('subslice')"}}],
+    },
+}
+CLASSES = [TPU_CLASS, SUBSLICE_CLASS]
+
+
+def node_name(i: int) -> str:
+    return f"node-{i:05d}"
+
+
+def make_node_devices(i: int) -> List[dict]:
+    """The device list one node's ResourceSlice advertises."""
+    devices = [
+        {
+            "name": f"chip-{c.replace(',', '-')}",
+            "basic": {
+                "attributes": {
+                    "type": {"string": "tpu"},
+                    "topologyCoord": {"string": c},
+                    "iciDomainID": {"string": f"ici.{i}"},
+                },
+                "capacity": {"hbm": {"value": "103079215104"}},
+                "consumesCounters": [{
+                    "counterSet": "tpu-host-mesh",
+                    "counters": {f"chip-{c}": {"value": "1"}},
+                }],
+            },
+        }
+        for c in MESH_COORDS
+    ]
+    for shape, placements in SHAPES.items():
+        for origin, coords in placements:
+            devices.append({
+                "name": f"ss-{shape}-{origin.replace(',', '-')}",
+                "basic": {
+                    "attributes": {
+                        "type": {"string": "subslice-dynamic"},
+                        "subsliceShape": {"string": shape},
+                        "iciDomainID": {"string": f"ici.{i}"},
+                    },
+                    "consumesCounters": [{
+                        "counterSet": "tpu-host-mesh",
+                        "counters": {
+                            f"chip-{c}": {"value": "1"}
+                            for c in coords
+                        },
+                    }],
+                },
+            })
+    return devices
+
+
+def make_node_slice(i: int, generation: int = 1) -> dict:
+    node = node_name(i)
+    return {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceSlice",
+        "metadata": {
+            "name": f"slice-{node}",
+            # Same label the real plugin stamps: the fleet harness's
+            # publishers adopt/relist by it, exactly like the driver.
+            "labels": {"tpu.google.com/driver": "true"},
+        },
+        "spec": {
+            "driver": DRIVER,
+            "nodeName": node,
+            "pool": {"name": node, "generation": generation},
+            "devices": make_node_devices(i),
+            "sharedCounters": [{
+                "name": "tpu-host-mesh",
+                "counters": {
+                    f"chip-{c}": {"value": "1"} for c in MESH_COORDS
+                },
+            }],
+        },
+    }
+
+
+def make_fleet(nodes: int) -> List[dict]:
+    """One ResourceSlice per node (see module doc)."""
+    return [make_node_slice(i) for i in range(nodes)]
+
+
+def make_claim(i: int, shape: str) -> dict:
+    return {
+        "apiVersion": "resource.k8s.io/v1beta1",
+        "kind": "ResourceClaim",
+        "metadata": {
+            "name": f"claim-{i:05d}",
+            "namespace": "allocbench",
+            "uid": f"uid-{i:05d}",
+        },
+        "spec": {"devices": {"requests": [{
+            "name": "tpu",
+            "deviceClassName": SUBSLICE_CLASS["metadata"]["name"],
+            "selectors": [{"cel": {"expression":
+                f"device.attributes['{DRIVER}'].subsliceShape == "
+                f"'{shape}'"}}],
+        }]}},
+    }
+
+
+def make_trace(n: int, seed: int) -> List[dict]:
+    rng = random.Random(seed)
+    shapes = [s for s, _ in SHAPE_WEIGHTS]
+    weights = [w for _, w in SHAPE_WEIGHTS]
+    return [
+        make_claim(i, rng.choices(shapes, weights)[0]) for i in range(n)
+    ]

@@ -107,6 +107,18 @@ ENGINE_EXHAUSTED_COUNTER = "engine_page_exhausted_total"
 # queue.
 ENGINE_STALL_WARN_SECONDS = 1.0
 
+# Workqueue pressure (ISSUE 10), suffix-matched like the other gauges
+# (per-shard series carry a {shard="i"} label): depth is the number of
+# pending+parked reconciles. A deep queue that is still GROWING across
+# the probe interval means the reconciler is falling behind its event
+# rate — arrivals outpacing service — and every domain behind that
+# queue is aging. Matched with the workqueue_work_duration summary next
+# to it, the remediation differs: long durations mean one slow
+# callback; short durations with growth mean an event storm (or too
+# few shards).
+WORKQUEUE_DEPTH_GAUGE = "workqueue_depth"
+WORKQUEUE_DEPTH_WARN = 100
+
 # Decode-roofline trend gate (ISSUE 8): the key bench.py records as the
 # gap between the measured decode step and the bf16 HBM floor. Matched
 # by SUFFIX inside the artifact (like the scheduler/engine gauges): the
@@ -211,7 +223,53 @@ def probe_metrics(
         engine = _check_engine(ep, second or first, warn)
         if engine:
             report[ep]["engine"] = engine
+        wq = _check_workqueue(ep, first, second, warn)
+        if wq:
+            report[ep]["workqueue"] = wq
     return report
+
+
+def _check_workqueue(
+    ep: str, first: Dict[str, float], second: Optional[Dict[str, float]],
+    warn,
+) -> Dict[str, object]:
+    """Surface workqueue pressure (ISSUE 10): per-queue (and per-shard)
+    depth, WARNing on sustained growth past the threshold. With two
+    samples, a deep-but-draining queue stays quiet — only deep AND
+    still growing is the falling-behind signal; a single sample can
+    only flag depth and ask for a re-probe."""
+    out: Dict[str, object] = {}
+    sample = second if second is not None else first
+    for series, value in sorted(sample.items()):
+        name = series.split("{", 1)[0]
+        if not name.endswith(WORKQUEUE_DEPTH_GAUGE):
+            continue
+        entry: Dict[str, float] = {"depth": value}
+        if second is not None:
+            entry["grew"] = value - first.get(series, 0.0)
+        out[series] = entry
+        if value <= WORKQUEUE_DEPTH_WARN:
+            continue
+        if second is not None:
+            if entry["grew"] > 0:
+                warn(
+                    f"{ep}: {series} = {value:g} and still GROWING "
+                    f"(+{entry['grew']:g} over the probe interval) — the "
+                    f"reconciler is falling behind its event rate and "
+                    f"work is aging. Check the component's "
+                    f"workqueue_work_duration_seconds next to it: long "
+                    f"durations mean one slow callback (fix the "
+                    f"reconcile, or move its slow I/O off the queue); "
+                    f"short durations mean an event storm — coalesce "
+                    f"the producer or raise the queue's shard count"
+                )
+        else:
+            warn(
+                f"{ep}: {series} = {value:g} — deep reconcile backlog; "
+                f"re-run with --metrics-interval to see whether it is "
+                f"draining or still growing"
+            )
+    return out
 
 
 def _check_degraded(
@@ -723,6 +781,21 @@ def render(report: dict) -> str:
             if "page_exhausted" in eng:
                 parts.append(f"exhausted={eng['page_exhausted']}")
             lines.append(f"  engine: {' '.join(parts)}")
+        wq = m.get("workqueue") or {}
+        if wq:
+            parts = []
+            for series, st in sorted(wq.items()):
+                label = series.split("{", 1)
+                shard = ""
+                if len(label) > 1 and "shard=" in label[1]:
+                    shard = "[" + label[1].rstrip("}").split(
+                        "shard=", 1
+                    )[1].strip('"') + "]"
+                grew = (
+                    f"+{st['grew']:g}" if st.get("grew", 0) > 0 else ""
+                )
+                parts.append(f"depth{shard}={st['depth']:g}{grew}")
+            lines.append(f"  workqueue: {' '.join(parts)}")
     for note in report.get("notes", []):
         lines.append(f"note: {note}")
     trend = report.get("bench_trend")
