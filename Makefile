@@ -3,7 +3,7 @@
 IMAGE_REPO ?= registry.local/tpu-dra-driver
 IMAGE_TAG  ?= v0.1.0
 
-.PHONY: all native test test-slow bench decodebench allocbench enginebench shardbench fleetbench image bats lint lint-fast shlint chaos crashmatrix apisoak ci clean
+.PHONY: all native test test-slow bench decodebench allocbench enginebench shardbench fleetbench fabricbench image bats lint lint-fast shlint chaos crashmatrix apisoak ci clean
 
 all: native test
 
@@ -64,6 +64,19 @@ enginebench:
 # BENCH_r*.json (docs/operations.md).
 fleetbench:
 	python -m tpu_dra.tools.fleetsim --smoke
+
+# Serving-fabric CPU smoke (ISSUE 11): small fleet of engine replicas
+# behind the multi-tenant router + claim-driven autoscaler, over the
+# REAL scheduler/publisher stack — hard asserts on trace determinism,
+# the submitted->first-token SLO keys, the WFQ fairness gate (a hot
+# tenant cannot degrade a quiet tenant's p99 beyond the pinned bound
+# vs the hot-absent baseline), a scale-up placed by the packer, and a
+# lossless token-identical scale-down drain BEFORE the claim delete.
+# The full configuration (>= 8 replicas, 10k+ concurrent sequences)
+# runs as `bench.py --leg-fabric` and lands in BENCH_r*.json
+# (docs/serving.md).
+fabricbench:
+	python -m tpu_dra.serving.fabricbench --smoke
 
 # Mesh-sharded decode CPU smoke (ISSUE 8): the (batch x model) decode
 # mesh degrades gracefully ((1,1) on one chip), the sharding rules
@@ -160,7 +173,7 @@ shlint:
 # (flakes surface in CI, not in the judge's rerun), the 13 bats suites
 # executed against the minicluster, the batsless process-level e2e, and
 # the bench artifact schema gate.
-ci: lint lint-fast shlint native chaos crashmatrix apisoak decodebench allocbench enginebench shardbench fleetbench
+ci: lint lint-fast shlint native chaos crashmatrix apisoak decodebench allocbench enginebench shardbench fleetbench fabricbench
 	python -m pytest tests/ -q -m 'not slow'
 	python -m pytest tests/ -q -m 'not slow'
 	python -m pytest tests/test_chaos.py -q -m slow

@@ -624,6 +624,24 @@ def _leg_fleet_main() -> int:
     return fleet_main([])
 
 
+def _leg_fabric_main() -> int:
+    """Serving-fabric leg (ISSUE 11): the tier above the engine —
+    multi-tenant router (token-WFQ + SLO-class admission + affinity),
+    claim-driven autoscaling placed by the real scheduler's packer, and
+    N engine replicas over the synthetic fleet, replaying a seeded
+    open-loop multi-tenant trace. Headline: user-request-submitted ->
+    first-token p50/p99 at 10k+ concurrent sequences over >= 8
+    replicas, plus per-tenant fairness and autoscale reaction keys.
+    Engines are PINNED TO CPU (TINY model): the leg measures routing /
+    fairness / autoscaling, where queueing dominates by design —
+    per-chip serving speed is --leg-serve's number
+    (tpu_dra/serving/fabricbench.py; methodology: docs/serving.md)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from tpu_dra.serving.fabricbench import main as fabric_main
+
+    return fabric_main([])
+
+
 def _leg_rotate_main() -> int:
     """Time-slice rotation client: a live trainer that steps only while
     holding the arbiter lease and yields at the quantum. Both clients
@@ -1513,6 +1531,8 @@ def main() -> int:
         return _leg_serve_main()
     if "--leg-fleet" in sys.argv:
         return _leg_fleet_main()
+    if "--leg-fabric" in sys.argv:
+        return _leg_fabric_main()
     if "--leg-rotate" in sys.argv:
         return _leg_rotate_main()
 
@@ -1579,6 +1599,27 @@ def main() -> int:
         f"ms over {fleetrep['fleet_watch_slots']} watch slots; publish "
         f"writes {fleetrep['fleet_publish_writes']} vs baseline "
         f"{fleetrep['fleet_baseline_publish_writes']}",
+        file=sys.stderr,
+    )
+
+    # Serving-fabric leg (ISSUE 11): CPU-side like the fleet leg (the
+    # engines are pinned to CPU — this measures the tier ABOVE the
+    # engine), own process so its replica/router thread fleet never
+    # shares an interpreter with the TPU legs.
+    fabric = _run_leg({}, flag="--leg-fabric")
+    print(
+        f"fabric ({fabric['fabric_replicas']} replicas, "
+        f"{fabric['fabric_tenants']} tenants, "
+        f"{fabric['fabric_requests']} requests): submitted->first-token "
+        f"p50 {fabric['fabric_ttft_p50_ms']} ms p99 "
+        f"{fabric['fabric_ttft_p99_ms']} ms at peak "
+        f"{fabric['fabric_peak_concurrent']} concurrent; quiet-tenant "
+        f"p99 {fabric['fabric_quiet_p99_ms']} ms under the hot tenant "
+        f"(baseline {fabric['fabric_quiet_baseline_p99_ms']} ms, hot "
+        f"tenant's own {fabric['fabric_hot_tenant_p99_ms']} ms); "
+        f"autoscale reaction {fabric['fabric_scaleup_reaction_ms']} ms, "
+        f"scale-down drain {fabric['fabric_scaledown_drain_ms']} ms, "
+        f"flaps {fabric['fabric_autoscaler_flaps']}",
         file=sys.stderr,
     )
 
@@ -1897,6 +1938,46 @@ def main() -> int:
                 ],
                 "fleet_scoped_informer_max_objects": fleetrep[
                     "fleet_scoped_informer_max_objects"
+                ],
+                # Serving-fabric leg (ISSUE 11): the multi-tenant
+                # router + claim-driven autoscaler over the synthetic
+                # fleet — submitted->first-token SLO at 10k+ concurrent
+                # sequences, the WFQ fairness contract (quiet tenant
+                # p99 with vs without the hot tenant), and the
+                # autoscaler's reaction/drain/flap record.
+                "fabric_nodes": fabric["fabric_nodes"],
+                "fabric_replicas": fabric["fabric_replicas"],
+                "fabric_tenants": fabric["fabric_tenants"],
+                "fabric_requests": fabric["fabric_requests"],
+                "fabric_rejected": fabric["fabric_rejected"],
+                "fabric_ttft_p50_ms": fabric["fabric_ttft_p50_ms"],
+                "fabric_ttft_p99_ms": fabric["fabric_ttft_p99_ms"],
+                "fabric_peak_concurrent": fabric[
+                    "fabric_peak_concurrent"
+                ],
+                "fabric_wfq_max_lag_tokens": fabric[
+                    "fabric_wfq_max_lag_tokens"
+                ],
+                "fabric_affinity_hit_rate": fabric[
+                    "fabric_affinity_hit_rate"
+                ],
+                "fabric_tenant_shares": fabric["fabric_tenant_shares"],
+                "fabric_quiet_p99_ms": fabric["fabric_quiet_p99_ms"],
+                "fabric_quiet_baseline_p99_ms": fabric[
+                    "fabric_quiet_baseline_p99_ms"
+                ],
+                "fabric_quiet_p99_x": fabric["fabric_quiet_p99_x"],
+                "fabric_hot_tenant_p99_ms": fabric[
+                    "fabric_hot_tenant_p99_ms"
+                ],
+                "fabric_scaleup_reaction_ms": fabric[
+                    "fabric_scaleup_reaction_ms"
+                ],
+                "fabric_scaledown_drain_ms": fabric[
+                    "fabric_scaledown_drain_ms"
+                ],
+                "fabric_autoscaler_flaps": fabric[
+                    "fabric_autoscaler_flaps"
                 ],
             }
         )

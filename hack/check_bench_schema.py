@@ -101,6 +101,29 @@ TYPED_WHEN_PRESENT = {
     "fleet_publish_writes": int,
     "fleet_baseline_publish_writes": int,
     "fleet_scoped_informer_max_objects": int,
+    # Serving-fabric leg (ISSUE 11): submitted -> first-token SLO over
+    # the engine-replica fleet, per-tenant fairness, and the
+    # claim-driven autoscaler record. The B100 pass forward-requires
+    # fabric_replicas / fabric_ttft_p50_ms / fabric_ttft_p99_ms /
+    # fabric_quiet_p99_ms / fabric_scaleup_reaction_ms.
+    "fabric_nodes": int,
+    "fabric_replicas": int,
+    "fabric_tenants": int,
+    "fabric_requests": int,
+    "fabric_rejected": int,
+    "fabric_ttft_p50_ms": (int, float),
+    "fabric_ttft_p99_ms": (int, float),
+    "fabric_peak_concurrent": int,
+    "fabric_wfq_max_lag_tokens": (int, float),
+    "fabric_affinity_hit_rate": (int, float),
+    "fabric_tenant_shares": dict,
+    "fabric_quiet_p99_ms": (int, float),
+    "fabric_quiet_baseline_p99_ms": (int, float),
+    "fabric_quiet_p99_x": (int, float),
+    "fabric_hot_tenant_p99_ms": (int, float),
+    "fabric_scaleup_reaction_ms": (int, float),
+    "fabric_scaledown_drain_ms": (int, float),
+    "fabric_autoscaler_flaps": int,
 }
 
 

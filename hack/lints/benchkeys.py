@@ -56,6 +56,16 @@ REQUIRED_STATIC = (
     "fleet_claim_ready_p99_ms",
     "fleet_relist_storm_p99_ms",
     "fleet_p99_speedup",
+    # Serving-fabric leg (ISSUE 11): the end-to-end submitted ->
+    # first-token SLO over the replica fleet, the WFQ fairness
+    # contract, and the claim-driven autoscaler's reaction time —
+    # dropping any of them would blind the multi-tenant-serving
+    # regression tripwire before its first recorded artifact.
+    "fabric_replicas",
+    "fabric_ttft_p50_ms",
+    "fabric_ttft_p99_ms",
+    "fabric_quiet_p99_ms",
+    "fabric_scaleup_reaction_ms",
 )
 
 

@@ -24,13 +24,18 @@ their transitive closure is NOT implied — list every edge):
     minicluster  -> computedomain, plugin, scheduler, k8sclient,
                     infra, api, version
     workloads    -> plugin, computedomain, infra, api, version
+    serving      -> workloads, tools, scheduler, k8sclient, infra,
+                    api, version
 
 Invariants the DAG encodes:
 
 - ``tpulib`` -> ``plugin``/``computedomain`` -> ``minicluster`` is
   the driver spine; nothing lower imports anything higher;
 - ``workloads`` (the JAX payload layer) is NEVER imported by a driver
-  layer: a driver binary must not pull in jax;
+  layer: a driver binary must not pull in jax; ``serving`` (the
+  multi-tenant fabric over engine replicas, ISSUE 11) sits ABOVE
+  workloads — it is payload-side too, and only bench.py and tests
+  reach it;
 - the declared DAG itself must be acyclic (checked at startup — a bad
   edit to this table fails the linter, not production imports).
 
@@ -72,11 +77,15 @@ LAYER_DAG: Dict[str, Set[str]] = {
         "api", "version",
     },
     "workloads": {"plugin", "computedomain", "infra", "api", "version"},
+    "serving": {
+        "workloads", "tools", "scheduler", "k8sclient", "infra", "api",
+        "version",
+    },
 }
 
 # Layers that must never appear in any other layer's dependency set
 # (enforced against the table itself so an edit can't sneak it in).
-NEVER_IMPORTED_BY_DRIVER = {"workloads"}
+NEVER_IMPORTED_BY_DRIVER = {"workloads", "serving"}
 
 
 def validate_dag() -> List[str]:

@@ -752,3 +752,133 @@ def test_metrics_probe_quiet_on_draining_or_shallow_workqueue(tmp_path):
     finally:
         drain.cancel()
         srv.stop()
+
+
+# --- serving-fabric checks (ISSUE 11) ---------------------------------------
+
+
+def test_metrics_probe_warns_on_sustained_tenant_starvation(tmp_path):
+    """A tenant whose WFQ virtual-time lag is past the threshold AND
+    still growing across the probe interval is being starved — WARN
+    with the weight/affinity/inflight-cap remediation hints; per-tenant
+    series matched individually."""
+    import threading
+
+    from tpu_dra.infra.metrics import Metrics, MetricsServer
+
+    metrics = Metrics()
+    metrics.set_gauge(
+        "fabric_tenant_vtime_lag", 2000, labels={"tenant": "silver"}
+    )
+    metrics.set_gauge(
+        "fabric_tenant_vtime_lag", 12, labels={"tenant": "gold"}
+    )
+    metrics.set_gauge("fabric_replicas", 4)
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    bump = threading.Timer(
+        0.1,
+        lambda: metrics.set_gauge(
+            "fabric_tenant_vtime_lag", 2600, labels={"tenant": "silver"}
+        ),
+    )
+    bump.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        endpoint = f"127.0.0.1:{srv.port}"
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint], metrics_interval=0.4,
+        )
+        warns = "\n".join(report["warnings"])
+        assert "STARVED" in warns
+        assert 'tenant="silver"' in warns
+        assert 'tenant="gold"' not in warns
+        assert "weight" in warns and "affinity" in warns
+        out = render(report)
+        assert "fabric: replicas=4" in out
+        assert "lag[silver]=2600+600" in out
+    finally:
+        bump.cancel()
+        srv.stop()
+
+
+def test_metrics_probe_fabric_quiet_and_single_sample_reprobe(tmp_path):
+    """A large lag that is DRAINING stays quiet; a single sample past
+    the threshold asks for the re-probe instead of the starvation
+    verdict; healthy lags report without warning."""
+    import threading
+
+    from tpu_dra.infra.metrics import Metrics, MetricsServer
+
+    metrics = Metrics()
+    metrics.set_gauge(
+        "fabric_tenant_vtime_lag", 2000, labels={"tenant": "bulk"}
+    )
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    drain = threading.Timer(
+        0.1,
+        lambda: metrics.set_gauge(
+            "fabric_tenant_vtime_lag", 900, labels={"tenant": "bulk"}
+        ),
+    )
+    drain.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        endpoint = f"127.0.0.1:{srv.port}"
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint], metrics_interval=0.4,
+        )
+        assert report["warnings"] == [], report["warnings"]
+        metrics.set_gauge(
+            "fabric_tenant_vtime_lag", 2000, labels={"tenant": "bulk"}
+        )
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint],
+        )
+        warns = "\n".join(report["warnings"])
+        assert "--metrics-interval" in warns and "WFQ lag" in warns
+    finally:
+        drain.cancel()
+        srv.stop()
+
+
+def test_metrics_probe_warns_on_autoscaler_flapping(tmp_path):
+    """fabric_autoscaler_flaps_total > 0 (scale up+down desired within
+    one cooldown window) WARNs with the widen-the-hysteresis
+    remediation; with two samples only a CLIMBING counter warns (an old
+    flap already acted on stays quiet)."""
+    from tpu_dra.infra.metrics import Metrics, MetricsServer
+
+    metrics = Metrics()
+    metrics.inc("fabric_autoscaler_flaps_total", 2)
+    metrics.set_gauge("fabric_replicas", 3)
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        endpoint = f"127.0.0.1:{srv.port}"
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint],
+        )
+        warns = "\n".join(report["warnings"])
+        assert "FLAPPING" in warns
+        assert "cooldown_seconds" in warns
+        assert "fabric: replicas=3 flaps=2" in render(report)
+        # Two samples, not climbing: the historical flap stays quiet.
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint], metrics_interval=0.2,
+        )
+        assert report["warnings"] == [], report["warnings"]
+    finally:
+        srv.stop()

@@ -440,3 +440,157 @@ def test_health_transition_publishes_changed_content(tmp_path):
         time.sleep(0.01)
     assert unpublished(), "unhealthy device still published after window"
     assert driver._slice_generation == gen + 1
+
+
+# --- node-scoped slice informer (ISSUE 11, ROADMAP item 5 nibble) -----------
+
+
+def _start_slice_informer(driver):
+    assert driver.slice_informer is not None
+    driver.slice_informer.start()
+    assert driver.slice_informer.wait_for_sync(timeout=10)
+
+
+def test_slice_informer_is_node_scoped(tmp_path):
+    """The plugin's slice watcher holds THIS node's slices only — the
+    PR-10 field-selector scoping wired into the real plugin: a foreign
+    node's slice never enters the store."""
+    driver, backend = make_driver(tmp_path)
+    driver.publish_resources()
+    own = len(ResourceClient(backend, RESOURCE_SLICES).list())
+    assert own > 0
+    _start_slice_informer(driver)
+    try:
+        slices = ResourceClient(backend, RESOURCE_SLICES)
+        slices.create({
+            "metadata": {"name": "foreign-slice"},
+            "spec": {"nodeName": "some-other-node", "pool": {
+                "name": "some-other-node", "generation": 1,
+            }, "devices": []},
+        })
+        time.sleep(0.2)  # would have dispatched by now
+        assert driver.slice_informer.store_size() == own
+        assert driver.metrics.get_counter(
+            "slice_drift_detected_total"
+        ) == 0
+    finally:
+        driver.slice_informer.stop()
+
+
+def test_slice_informer_heals_external_deletion(tmp_path):
+    """An admin/GC deletion of a slice we committed is external drift:
+    the informer event invalidates the publisher's diff cache and rides
+    the coalesced republish — the slice is back within the window, not
+    after the reverify poll."""
+    driver, backend = make_driver(
+        tmp_path, publish_coalesce_seconds=0.05
+    )
+    driver.publish_resources()
+    _start_slice_informer(driver)
+    try:
+        slices = ResourceClient(backend, RESOURCE_SLICES)
+        victim = slices.list()[0]["metadata"]["name"]
+        # Our own publishes never count as drift.
+        driver.publish_resources()
+        assert driver.metrics.get_counter(
+            "slice_drift_detected_total"
+        ) == 0
+        slices.delete(victim)
+        deadline = time.monotonic() + 10
+        while (
+            slices.try_get(victim) is None
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        assert slices.try_get(victim) is not None, (
+            "externally deleted slice was not republished"
+        )
+        assert driver.metrics.get_counter(
+            "slice_drift_detected_total"
+        ) >= 1
+    finally:
+        driver.slice_informer.stop()
+
+
+def test_slice_informer_stomps_external_modification(tmp_path):
+    """An external writer rewriting our slice's spec is drift too: the
+    next coalesced pass restores the desired content (merge-PATCH
+    last-writer-wins, us last)."""
+    from tpu_dra.plugin.slicepub import slice_content_digest
+
+    driver, backend = make_driver(
+        tmp_path, publish_coalesce_seconds=0.05
+    )
+    driver.publish_resources()
+    _start_slice_informer(driver)
+    try:
+        slices = ResourceClient(backend, RESOURCE_SLICES)
+        victim = slices.list()[0]
+        name = victim["metadata"]["name"]
+        want = slice_content_digest(victim)
+        with driver._publish_lock:
+            assert driver._publisher.committed_digest(name) == want
+        mangled = dict(victim["spec"])
+        mangled["devices"] = []
+        slices.patch(name, {"spec": mangled})
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            cur = slices.try_get(name)
+            if cur is not None and slice_content_digest(cur) == want:
+                break
+            time.sleep(0.01)
+        cur = slices.try_get(name)
+        assert cur is not None and slice_content_digest(cur) == want, (
+            "externally modified slice was not stomped back to desired"
+        )
+    finally:
+        driver.slice_informer.stop()
+
+
+def test_watch_slices_false_keeps_poll_only_behavior(tmp_path):
+    driver, _backend = make_driver(tmp_path, watch_slices=False)
+    assert driver.slice_informer is None
+
+
+def test_slice_drift_republish_is_rate_limited(tmp_path):
+    """A PERSISTENT external writer (split-brain second plugin, an
+    operator loop) must not drive a hot republish war: one drift-driven
+    heal per cooldown window. The diff cache is still invalidated every
+    time, so any other publish trigger re-verifies and heals."""
+    driver, backend = make_driver(
+        tmp_path, publish_coalesce_seconds=0.0
+    )
+    driver.publish_resources()
+    _start_slice_informer(driver)
+    try:
+        slices = ResourceClient(backend, RESOURCE_SLICES)
+        victim = slices.list()[0]["metadata"]["name"]
+        slices.delete(victim)
+        deadline = time.monotonic() + 10
+        while (
+            slices.try_get(victim) is None
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        assert slices.try_get(victim) is not None
+        # Second drift inside the window: detected, but the heal is
+        # deferred (no republish burst).
+        slices.delete(victim)
+        deadline = time.monotonic() + 1.0
+        while (
+            driver.metrics.get_counter("slice_drift_detected_total") < 2
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        assert driver.metrics.get_counter(
+            "slice_drift_detected_total"
+        ) >= 2
+        time.sleep(0.3)
+        assert slices.try_get(victim) is None, (
+            "drift republish ignored the cooldown window"
+        )
+        # The cache WAS invalidated: the next ordinary publish heals.
+        driver.publish_resources()
+        assert slices.try_get(victim) is not None
+    finally:
+        driver.slice_informer.stop()

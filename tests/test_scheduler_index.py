@@ -490,3 +490,51 @@ def test_reconcile_batch_solves_pending_set_in_one_pass(fleet_cluster):
             core.class_informer,
         ):
             inf.stop()
+
+
+def test_claim_delete_triggers_prompt_batch_reallocation(fleet_cluster):
+    """ISSUE 11: deleting an ALLOCATED claim frees capacity that may
+    unblock an Unschedulable claim RIGHT NOW — the DELETED event must
+    enqueue a batch solve instead of leaving the waiter to the periodic
+    sweep (the serving fabric's scale-down deletes a claim exactly so a
+    waiting scale-up can place; seconds of sweep latency would land in
+    its reaction time)."""
+    claims = ResourceClient(fleet_cluster, RESOURCE_CLAIMS)
+    slices = ResourceClient(fleet_cluster, RESOURCE_SLICES)
+    for s in make_fleet(1):  # one node: exactly one 2x2 placement
+        slices.create(s)
+    # Sweep far away: only event-driven reallocation can pass the test.
+    core = SchedulerCore(fleet_cluster, retry_unschedulable_after=999)
+    core.start()
+    try:
+        holder = make_claim(0, "2x2x1")
+        claims.create(holder)
+        wait_for(
+            lambda: (
+                claims.try_get(
+                    holder["metadata"]["name"], "allocbench"
+                ).get("status") or {}
+            ).get("allocation"),
+            what="holder allocation",
+        )
+        waiter = make_claim(1, "2x2x1")
+        claims.create(waiter)
+        # The fleet is full: the waiter must be Unschedulable.
+        wait_for(
+            lambda: core.metrics.get_counter(
+                "scheduler_unschedulable_total"
+            ) > 0,
+            what="waiter marked unschedulable",
+        )
+        claims.delete(holder["metadata"]["name"], "allocbench")
+        wait_for(
+            lambda: (
+                claims.try_get(
+                    waiter["metadata"]["name"], "allocbench"
+                ).get("status") or {}
+            ).get("allocation"),
+            timeout=30,
+            what="waiter allocated after holder deletion (event-driven)",
+        )
+    finally:
+        core.stop()

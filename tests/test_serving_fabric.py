@@ -1,0 +1,607 @@
+"""Serving-fabric tests (ISSUE 11): token-WFQ fairness and starvation
+lag, latency-tier admission, session/prefix affinity, the claim-driven
+autoscaler's state machine (scale-up reaction, drain-before-delete
+ordering, flap counting), the engine's evacuation primitive, and the
+cheap-replica premise (N replicas with one (config, int8) key share one
+compiled executable through the engine's _JIT_CACHE)."""
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dra.infra.metrics import Metrics
+from tpu_dra.serving.autoscaler import AutoscalerConfig, ClaimAutoscaler
+from tpu_dra.serving.router import (
+    BATCH,
+    INTERACTIVE,
+    Replica,
+    Router,
+    RouterConfig,
+    TenantSpec,
+)
+from tpu_dra.workloads.engine import (
+    Completion,
+    Engine,
+    EngineConfig,
+    Evacuated,
+    Request,
+)
+from tpu_dra.workloads.models.llama import TINY_LLAMA, Llama
+
+CFG = dataclasses.replace(
+    TINY_LLAMA, dtype=jnp.float32, param_dtype=jnp.float32
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return Llama(CFG).init_params(jax.random.PRNGKey(7), batch=2, seq=8)
+
+
+def _ec(**kw):
+    base = dict(
+        page_size=4, max_slots=3, max_pages_per_seq=10,
+        scan_chunk=3, prefill_chunk=8,
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _req(rid, plen=4, out=5):
+    return Request(
+        rid=rid, prompt=np.ones(plen, np.int32), max_new_tokens=out
+    )
+
+
+# --- stub engine: the Engine surface the router/replica touch --------------
+
+
+class StubEngine:
+    """Deterministic no-JAX engine stand-in: one request completes per
+    step, in arrival order; evacuate hands back whatever is queued."""
+
+    def __init__(self):
+        self.queue = []
+        self.completed = {}
+        self.order = []  # rids in arrival order (the dispatch record)
+        self.closed = False
+
+    def add_request(self, req):
+        self.queue.append(req)
+        self.order.append(req.rid)
+
+    @property
+    def busy(self):
+        return bool(self.queue)
+
+    def step(self):
+        if self.queue:
+            r = self.queue.pop(0)
+            now = time.monotonic()
+            self.completed[r.rid] = Completion(
+                rid=r.rid,
+                tokens=np.arange(r.max_new_tokens, dtype=np.int32),
+                t_submit=now, t_arrival=now,
+                t_first_token=now, t_done=now,
+            )
+        return self.busy
+
+    def evacuate(self):
+        out = [
+            Evacuated(
+                req=r, emitted=np.zeros(0, np.int32),
+                t_submit=0.0, t_first=None,
+            )
+            for r in self.queue
+        ]
+        self.queue = []
+        return out
+
+    def close(self):
+        self.closed = True
+
+
+def _stub_replica(name):
+    return Replica(name, StubEngine())
+
+
+def _drive(router, reps, steps=200):
+    """Single-threaded drive: poll, then step every stub engine once,
+    then drain outboxes — deterministic, no replica threads."""
+    for _ in range(steps):
+        router.poll()
+        for rep in reps:
+            if rep.engine.busy:
+                rep.engine.step()
+            rep._drain_outbox()
+        if not router.busy:
+            break
+    router.poll()
+
+
+# --- WFQ --------------------------------------------------------------------
+
+
+def test_wfq_dispatch_tracks_weight_ratio():
+    """Two flooding tenants at weight 3:1 — dispatch order over any
+    prefix converges to the weight ratio (the fairness contract)."""
+    a = TenantSpec("a", INTERACTIVE, weight=3.0)
+    b = TenantSpec("b", BATCH, weight=1.0)
+    rep = _stub_replica("r0")
+    router = Router(
+        [a, b], [rep],
+        RouterConfig(backlog_cap_tokens=1e9, max_inflight_per_replica=1),
+    )
+    for i in range(12):
+        router.submit("a", _req(f"a{i}", plen=5, out=5))
+        router.submit("b", _req(f"b{i}", plen=5, out=5))
+    _drive(router, [rep], steps=100)
+    assert len(router.completions) == 24
+    first12 = rep.engine.order[:12]
+    na = sum(1 for rid in first12 if rid.startswith("a"))
+    assert na == 9, (
+        f"weight-3 tenant got {na}/12 of the first dispatches, want 9: "
+        f"{first12}"
+    )
+
+
+def test_wfq_late_quiet_arrival_preempts_hot_backlog():
+    """A quiet tenant arriving into a hot tenant's deep backlog is
+    dispatched at the NEXT headroom, not behind the backlog."""
+    quiet = TenantSpec("quiet", INTERACTIVE, weight=1.0)
+    hot = TenantSpec("hot", BATCH, weight=1.0)
+    rep = _stub_replica("r0")
+    router = Router(
+        [quiet, hot], [rep],
+        RouterConfig(backlog_cap_tokens=1e9, max_inflight_per_replica=1),
+    )
+    for i in range(20):
+        router.submit("hot", _req(f"h{i:02d}"))
+    # Two hot dispatches happen, then the quiet request lands.
+    router.poll()
+    rep.engine.step()
+    rep._drain_outbox()
+    router.poll()
+    router.submit("quiet", _req("q0"))
+    _drive(router, [rep], steps=60)
+    order = rep.engine.order
+    assert order.index("q0") <= 3, (
+        f"quiet arrival served at position {order.index('q0')}: {order}"
+    )
+
+
+def test_wfq_starvation_lag_stays_bounded_and_exports():
+    """Healthy WFQ: every backlogged tenant's virtual-time lag gauge
+    stays within ~one request cost of zero."""
+    a = TenantSpec("a", INTERACTIVE, weight=2.0)
+    b = TenantSpec("b", BATCH, weight=1.0)
+    rep = _stub_replica("r0")
+    m = Metrics()
+    router = Router(
+        [a, b], [rep],
+        RouterConfig(backlog_cap_tokens=1e9, max_inflight_per_replica=1),
+        metrics=m,
+    )
+    router._export_period = 0.0  # export every poll for the assert
+    for i in range(10):
+        router.submit("a", _req(f"a{i}"))
+        router.submit("b", _req(f"b{i}"))
+    _drive(router, [rep], steps=80)
+    assert m.get_gauge("fabric_tenant_vtime_lag", {"tenant": "a"}) is not None
+    cost = 4 + 5
+    assert router.max_lag_tokens <= 2 * cost, (
+        f"WFQ lag hit {router.max_lag_tokens} tokens on a healthy drive"
+    )
+
+
+# --- latency-tier admission -------------------------------------------------
+
+
+def test_admission_sheds_batch_tier_first():
+    gold = TenantSpec("gold", INTERACTIVE)  # admit_frac 1.0
+    bulk = TenantSpec("bulk", BATCH)  # admit_frac 0.6
+    router = Router(
+        [gold, bulk], [],
+        RouterConfig(backlog_cap_tokens=100.0),
+    )
+    # Fill to 54 tokens of backlog (6 requests x 9): the next batch
+    # request would cross batch's ceiling (60); interactive's (100) is
+    # still open.
+    for i in range(6):
+        assert router.submit("bulk", _req(f"fill{i}", plen=4, out=5))
+    assert not router.submit("bulk", _req("shed", plen=4, out=5))
+    assert router.submit("gold", _req("vip", plen=4, out=5))
+    stats = router.tenant_stats()
+    assert stats["bulk"]["rejected"] == 1
+    assert stats["gold"]["rejected"] == 0
+    # The hard cap holds even for the interactive tier.
+    for i in range(5):
+        router.submit("gold", _req(f"vip{i}", plen=4, out=5))
+    assert not router.submit("gold", _req("overcap", plen=4, out=5))
+
+
+# --- affinity ---------------------------------------------------------------
+
+
+def test_session_affinity_sticks_and_spills():
+    t = TenantSpec("t", INTERACTIVE)
+    r0, r1 = _stub_replica("r0"), _stub_replica("r1")
+    router = Router(
+        [t], [r0, r1],
+        RouterConfig(backlog_cap_tokens=1e9, max_inflight_per_replica=4),
+    )
+    for i in range(4):
+        router.submit("t", _req(f"s{i}"), session="sticky")
+    router.poll()
+    homes = [rep for rep in (r0, r1) if rep.engine.order]
+    assert len(homes) == 1, "one session landed on two replicas"
+    home = homes[0]
+    assert len(home.engine.order) == 4
+    assert router.affinity_hits == 4
+    # The preferred replica is at its inflight cap now: the next
+    # request for the same session SPILLS to the other replica.
+    router.submit("t", _req("s4"), session="sticky")
+    router.poll()
+    other = r1 if home is r0 else r0
+    assert other.engine.order == ["s4"]
+    assert router.affinity_misses == 1
+
+
+def test_prefix_affinity_groups_identical_prompts():
+    """No session: requests sharing a prompt prefix share a replica
+    (the KV-locality hint for one system prompt over many users)."""
+    t = TenantSpec("t", INTERACTIVE)
+    r0, r1 = _stub_replica("r0"), _stub_replica("r1")
+    router = Router(
+        [t], [r0, r1],
+        RouterConfig(
+            backlog_cap_tokens=1e9, max_inflight_per_replica=8,
+            affinity_prefix_tokens=4,
+        ),
+    )
+    sys_prompt = np.asarray([7, 8, 9, 10], np.int32)
+    for i in range(6):
+        router.submit("t", Request(
+            rid=f"p{i}",
+            prompt=np.concatenate([sys_prompt, np.full(i + 1, i + 20,
+                                                       np.int32)]),
+            max_new_tokens=3,
+        ))
+    router.poll()
+    homes = [rep for rep in (r0, r1) if rep.engine.order]
+    assert len(homes) == 1, (
+        "one shared prefix scattered across replicas"
+    )
+
+
+# --- evacuation splice ------------------------------------------------------
+
+
+def test_requeue_evacuated_resumes_on_surviving_replica():
+    t = TenantSpec("t", INTERACTIVE)
+    r0, r1 = _stub_replica("r0"), _stub_replica("r1")
+    router = Router(
+        [t], [r0, r1],
+        RouterConfig(backlog_cap_tokens=1e9, max_inflight_per_replica=4),
+    )
+    for i in range(6):
+        router.submit("t", _req(f"e{i}"), session=f"sess-{i}")
+    router.poll()
+    victim = r0 if r0.engine.order else r1
+    victim_rids = list(victim.engine.order)
+    assert victim_rids, "nothing dispatched to the victim"
+    victim.quiesced = True
+    victim._evacuated = victim.engine.evacuate()
+    n = router.requeue_evacuated(victim)
+    assert n == len(victim_rids)
+    router.remove_replica(victim)
+    _drive(router, [r0, r1], steps=60)
+    assert len(router.completions) == 6, "a sequence was lost"
+    # Every evacuated rid finished on the surviving replica.
+    survivor = r1 if victim is r0 else r0
+    for rid in victim_rids:
+        assert rid in survivor.engine.order
+
+
+# --- autoscaler state machine ----------------------------------------------
+
+
+class StubClaims:
+    def __init__(self):
+        self.store = {}
+        self.deleted = []
+
+    def create(self, obj):
+        self.store[obj["metadata"]["name"]] = obj
+        return obj
+
+    def try_get(self, name, namespace=None):
+        return self.store.get(name)
+
+    def delete(self, name, namespace=None):
+        self.deleted.append(name)
+        self.store.pop(name, None)
+
+    def allocate(self, name):
+        self.store[name].setdefault("status", {})["allocation"] = {
+            "devices": {"results": [
+                {"pool": "node-0", "device": "ss-1x1x1-0-0-0"},
+            ]},
+        }
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def _autoscaler(router, claims, clock, **cfg):
+    base = dict(
+        min_replicas=1, max_replicas=3,
+        target_tokens_per_replica=100.0,
+        up_factor=1.0, down_factor=0.2, cooldown_seconds=5.0,
+    )
+    base.update(cfg)
+    made = []
+
+    def make_replica(claim):
+        rep = _stub_replica(claim["metadata"]["name"])
+        made.append(rep)
+        return rep
+
+    a = ClaimAutoscaler(
+        router, claims,
+        make_claim=lambda name: {"metadata": {"name": name},
+                                 "spec": {"devices": {"requests": []}}},
+        make_replica=make_replica,
+        config=AutoscalerConfig(**base),
+        clock=clock,
+    )
+    a._made = made
+    return a
+
+
+def test_scale_up_waits_for_packer_and_records_reaction():
+    t = TenantSpec("t", INTERACTIVE)
+    rep = _stub_replica("boot")
+    router = Router([t], [rep], RouterConfig(backlog_cap_tokens=1e9))
+    clock = FakeClock()
+    claims = StubClaims()
+    a = _autoscaler(router, claims, clock)
+    # Load the queue past target*up: 30 requests x 9 tokens = 270 > 100
+    # (nothing dispatches: inflight cap is default 16 -> some dispatch;
+    # queued still > 100).
+    for i in range(30):
+        router.submit("t", _req(f"x{i}"))
+    a.tick()
+    assert a._pending_claim is not None
+    name = a._pending_claim["metadata"]["name"]
+    assert name in claims.store
+    # Not allocated yet: replica set unchanged no matter how often we
+    # tick.
+    clock.t += 1.0
+    a.tick()
+    assert len(router.replicas) == 1
+    # The packer places it -> next tick binds the replica.
+    clock.t += 2.0
+    claims.allocate(name)
+    a.tick()
+    assert len(router.replicas) == 2
+    assert a.scaleups == 1
+    assert a.reaction_s == [3.0]
+
+
+def test_scale_down_drains_before_deleting_claim():
+    t = TenantSpec("t", INTERACTIVE)
+    r0, r1 = _stub_replica("c0"), _stub_replica("c1")
+    r0.claim_name, r1.claim_name = "c0", "c1"
+    router = Router(
+        [t], [r0, r1],
+        RouterConfig(backlog_cap_tokens=1e9, max_inflight_per_replica=4),
+    )
+    clock = FakeClock()
+    claims = StubClaims()
+    claims.store["c0"] = {"metadata": {"name": "c0"}}
+    claims.store["c1"] = {"metadata": {"name": "c1"}}
+    a = _autoscaler(router, claims, clock)
+    # In-flight work on both replicas, empty queue -> scale down.
+    for i in range(6):
+        router.submit("t", _req(f"d{i}"), session=f"s{i}")
+    router.poll()
+    assert router.queued_tokens() == 0
+    a.tick()
+    assert a._draining is not None
+    victim = a._draining
+    assert victim.quiesced
+    # The stub replica has no thread: run the evacuation handshake the
+    # replica loop would.
+    victim._evacuated = victim.engine.evacuate()
+    victim._evac_done.set()
+    inflight_rids = set(victim.inflight)
+    clock.t += 0.5
+    a.tick()
+    assert a.scaledowns == 1
+    assert victim.claim_name in claims.deleted
+    down = [e for e in a.events if e[0] == "down-complete"][0]
+    assert down[3]["engine_empty_at_delete"]
+    assert down[3]["requeued"] == len(inflight_rids)
+    assert victim.engine.closed
+    # The survivors finish everything.
+    _drive(router, [r for r in (r0, r1) if r is not victim], steps=60)
+    assert len(router.completions) == 6
+
+
+def test_reversal_within_cooldown_counts_flap_and_suppresses():
+    t = TenantSpec("t", INTERACTIVE)
+    r0, r1 = _stub_replica("c0"), _stub_replica("c1")
+    r0.claim_name, r1.claim_name = "c0", "c1"
+    router = Router(
+        [t], [r0, r1],
+        RouterConfig(backlog_cap_tokens=1e9, max_inflight_per_replica=1),
+    )
+    clock = FakeClock()
+    claims = StubClaims()
+    m = Metrics()
+    a = _autoscaler(router, claims, clock, cooldown_seconds=10.0)
+    a.metrics = m
+    # Pressure -> scale up begins (cooldown stamp taken).
+    for i in range(30):
+        router.submit("t", _req(f"f{i}"))
+    a.tick()
+    name = a._pending_claim["metadata"]["name"]
+    claims.allocate(name)
+    a.tick()
+    assert a.scaleups == 1
+    # Load evaporates INSIDE the cooldown: wanting down now is a flap —
+    # counted, suppressed.
+    _drive(router, router.replicas, steps=200)
+    assert router.queued_tokens() == 0
+    clock.t += 1.0
+    a.tick()
+    assert a.flaps == 1
+    assert a.scaledowns == 0 and a._draining is None
+    assert m.get_counter("fabric_autoscaler_flaps_total") == 1
+    # One flap per EPISODE, not per tick: the control loop ticks at
+    # sub-ms frequency, and the same suppressed reversal must not
+    # inflate the counter with loop-frequency noise.
+    for _ in range(50):
+        clock.t += 0.01
+        a.tick()
+    assert a.flaps == 1
+    assert m.get_counter("fabric_autoscaler_flaps_total") == 1
+    # After the cooldown the same signal acts instead of flapping.
+    clock.t += 20.0
+    a.tick()
+    assert a._draining is not None
+    assert a.flaps == 1
+
+
+# --- engine evacuation + TTFT (real engines) --------------------------------
+
+
+def test_engine_evacuate_moves_sequences_losslessly(params):
+    """The scale-down primitive end-to-end on real engines: drain a
+    mid-generation engine, resume the evacuees on a second engine by
+    re-prefilling prompt+emitted, and the stitched tokens are IDENTICAL
+    to an uninterrupted run (greedy determinism across replicas)."""
+    rng = np.random.default_rng(3)
+    reqs = [
+        Request(
+            rid=f"r{i}",
+            prompt=rng.integers(1, CFG.vocab_size, 6).astype(np.int32),
+            max_new_tokens=9,
+        )
+        for i in range(5)
+    ]
+    ref = Engine(CFG, params, _ec()).run(
+        [dataclasses.replace(r) for r in reqs]
+    )
+    eng = Engine(CFG, params, _ec())
+    for r in reqs:
+        eng.add_request(dataclasses.replace(r))
+    for _ in range(4):
+        eng.step()
+    ev = eng.evacuate()
+    assert not eng.busy, "evacuate left the engine busy"
+    assert eng.allocator.free_pages == eng.allocator.num_pages - 1, (
+        "evacuate leaked pages"
+    )
+    assert eng.allocator.reserved_pages == 0
+    assert any(len(e.emitted) > 0 for e in ev), (
+        "drill never caught a mid-generation sequence"
+    )
+    done = dict(eng.completed)
+    assert len(ev) + len(done) == len(reqs)
+    resume = Engine(CFG, params, _ec())
+    for e in ev:
+        resume.add_request(Request(
+            rid=e.req.rid,
+            prompt=np.concatenate(
+                [np.asarray(e.req.prompt, np.int32), e.emitted]
+            ),
+            max_new_tokens=e.remaining,
+        ))
+    done2 = resume.run([])
+    for e in ev:
+        got = np.concatenate([e.emitted, done2[e.req.rid].tokens])
+        assert np.array_equal(got, ref[e.req.rid].tokens), e.req.rid
+    for rid, c in done.items():
+        assert np.array_equal(c.tokens, ref[rid].tokens), rid
+
+
+def test_engine_exports_ttft_histogram(params):
+    """Satellite: engine_ttft_seconds is a first-class exported series
+    measured from ARRIVAL, agreeing exactly with Completion.ttft_s."""
+    m = Metrics()
+    eng = Engine(CFG, params, _ec(), metrics=m)
+    rng = np.random.default_rng(5)
+    reqs = [
+        Request(
+            rid=f"t{i}",
+            prompt=rng.integers(1, CFG.vocab_size, 5).astype(np.int32),
+            max_new_tokens=4,
+        )
+        for i in range(3)
+    ]
+    done = eng.run(reqs)
+    text = m.render()
+    assert "engine_ttft_seconds" in text
+    q_max = m.quantile("engine_ttft_seconds", 1.0)
+    assert q_max == pytest.approx(
+        max(c.ttft_s for c in done.values()), abs=1e-9
+    )
+    q_min = m.quantile("engine_ttft_seconds", 0.0)
+    assert q_min == pytest.approx(
+        min(c.ttft_s for c in done.values()), abs=1e-9
+    )
+    # A cross-replica resume (router sets ttft_preobserved: the first
+    # token already happened on the drained replica) must NOT pollute
+    # the histogram with a bogus near-zero "first token".
+    count_before = len(m._timing_recent[m._key("engine_ttft_seconds", None)])
+    eng.run([Request(
+        rid="resumed", prompt=np.ones(5, np.int32), max_new_tokens=3,
+        ttft_preobserved=True,
+    )])
+    assert len(
+        m._timing_recent[m._key("engine_ttft_seconds", None)]
+    ) == count_before, "a resumed sequence re-observed engine_ttft_seconds"
+
+
+# --- the cheap-replica premise (satellite: _JIT_CACHE) ----------------------
+
+
+def test_replicas_share_one_compiled_executable(params):
+    """N replicas with identical (config, int8, sampling) keys in one
+    process share ONE set of jitted callables — and running the second
+    replica re-traces NOTHING (compile-count probe via the jit cache
+    size). The fabric's cheap-replica story rests on this."""
+    ec = _ec()
+    trace = [
+        Request(rid=f"j{i}", prompt=np.full(5, 3, np.int32),
+                max_new_tokens=4)
+        for i in range(3)
+    ]
+    e1 = Engine(CFG, params, ec)
+    e1.run([dataclasses.replace(r) for r in trace])
+    fns = (e1._decode_chunk_fn, e1._decode_step_fn, e1._prefill_chunk_fn)
+    sizes = [f._cache_size() for f in fns]
+    assert sizes[0] >= 1, "decode chunk never compiled?"
+    e2 = Engine(CFG, params, ec)
+    assert e2._decode_chunk_fn is e1._decode_chunk_fn
+    assert e2._decode_step_fn is e1._decode_step_fn
+    assert e2._prefill_chunk_fn is e1._prefill_chunk_fn
+    e2.run([dataclasses.replace(r) for r in trace])
+    assert [f._cache_size() for f in fns] == sizes, (
+        "a second identical replica re-traced the decode program — "
+        "replicas are NOT sharing compiled executables"
+    )
+    # A different storage mode is a different executable, by design.
+    e3 = Engine(CFG, params, _ec(kv_quant="int8"))
+    assert e3._decode_chunk_fn is not e1._decode_chunk_fn

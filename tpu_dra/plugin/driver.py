@@ -11,13 +11,14 @@ from __future__ import annotations
 import logging
 import random
 import threading
+import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from tpu_dra.infra import featuregates as fg
 from tpu_dra.infra.flock import Flock
 from tpu_dra.infra.metrics import Metrics
-from tpu_dra.k8sclient import RESOURCE_SLICES, ResourceClient
+from tpu_dra.k8sclient import RESOURCE_SLICES, Informer, ResourceClient
 from tpu_dra.k8sclient.circuit import bind_backend_metrics
 from tpu_dra.k8sclient.degraded import DegradedModeController
 from tpu_dra.plugin.allocatable import (
@@ -48,7 +49,7 @@ from tpu_dra.plugin.dra_service import (
 )
 from tpu_dra.plugin.remediation import RemediationController
 from tpu_dra.plugin.sharing import MultiplexManager
-from tpu_dra.plugin.slicepub import SlicePublisher
+from tpu_dra.plugin.slicepub import SlicePublisher, slice_content_digest
 from tpu_dra.plugin.subslice import build_partitionable_model
 from tpu_dra.plugin.vfio import VfioPciManager
 from tpu_dra.tpulib.interface import TpuLib
@@ -93,6 +94,13 @@ class DriverConfig:
     # pass (publish_soon). 0 = publish synchronously per event (the
     # pre-fleet behavior; unit drills that assert immediately use it).
     publish_coalesce_seconds: float = 0.25
+    # Node-scoped slice watcher (ISSUE 11, ROADMAP item 5 nibble): a
+    # field-selector-scoped informer over THIS node's ResourceSlices —
+    # the harness-proved <=O(node)-objects scoping wired into the real
+    # plugin. External drift (admin delete, apiserver restore) heals
+    # event-driven instead of waiting out the publisher's periodic
+    # reverify relist. False keeps the pre-ISSUE-11 poll-only behavior.
+    watch_slices: bool = True
 
 
 class Driver:
@@ -189,6 +197,37 @@ class Driver:
         # window; storms ride it instead of each publishing.
         self._coalesce_lock = threading.Lock()
         self._coalesce_timer: Optional[threading.Timer] = None
+        # Node-scoped slice informer (ISSUE 11): field-selector keeps
+        # the store at THIS node's slices (<= a handful of objects on a
+        # 5k-node fleet — the PR-10 scoping, now in the real plugin),
+        # and its events turn external slice drift into an immediate
+        # coalesced republish (_on_slice_event) instead of a fact the
+        # publisher's reverify poll discovers minutes later.
+        self.slice_informer: Optional[Informer] = None
+        # Drift-triggered republish cooldown: a PERSISTENT external
+        # writer (split-brain: a second plugin incarnation on this
+        # node, an operator script) would otherwise turn the
+        # event-driven heal into a hot republish war — each side seeing
+        # the other's write as drift. One heal attempt per window keeps
+        # convergence fast for the one-shot cases (admin delete,
+        # apiserver restore) and bounds the war to a slow drip for the
+        # pathological one; the cache is still invalidated every time,
+        # so any OTHER publish trigger also re-verifies.
+        self._drift_republish_cooldown = 5.0
+        self._last_drift_republish = -1e18
+        if config.watch_slices:
+            self.slice_informer = Informer(
+                backend, RESOURCE_SLICES,
+                field_selector={"spec.nodeName": config.node_name},
+                metrics=self.metrics,
+            )
+            self.slice_informer.add_handler(self._on_slice_event)
+            self.metrics.register_collector(
+                lambda: self.metrics.set_gauge(
+                    "plugin_slice_informer_objects",
+                    float(self.slice_informer.store_size()),
+                )
+            )
         # The degraded-mode state machine (gauge, publish parking, heal
         # prober, fenced resync) is shared with the CD plugin; this
         # driver supplies the component-specific probe/resync/replay.
@@ -353,6 +392,8 @@ class Driver:
         self.cleanup.start()
         if self.remediation is not None:
             self.remediation.start()
+        if self.slice_informer is not None:
+            self.slice_informer.start()
         self.publish_resources()
         self.metrics.set_gauge("allocatable_devices", len(self.state.allocatable))
 
@@ -365,6 +406,8 @@ class Driver:
         self.cleanup.stop()
         if self.remediation is not None:
             self.remediation.stop()
+        if self.slice_informer is not None:
+            self.slice_informer.stop()
         self.health_monitor.stop()
         self.tpulib.stop_health_monitor()
         for s in self._servers:
@@ -435,6 +478,40 @@ class Driver:
         # chip must not reset or bypass the debounce bookkeeping.
         if self.remediation is not None:
             self.remediation.on_health_change(ev)
+
+    def _on_slice_event(self, event: str, obj: dict) -> None:
+        """Node-scoped slice watch (ISSUE 11): compare every event for
+        a slice WE committed against the publisher's content digest.
+        Our own writes echo back digest-equal (the handler serializes
+        behind _publish_lock, so a mid-pass event waits for the commit
+        it belongs to) and are ignored; a DELETED slice we still claim,
+        or content that no longer matches, is external drift — drop the
+        diff cache and ride the coalesced republish. A stale
+        mid-sequence event can at worst force one spurious relist whose
+        diff then writes nothing."""
+        name = obj["metadata"]["name"]
+        with self._publish_lock:
+            known = self._publisher.committed_digest(name)
+            if known is None:
+                return  # not ours / cache cold (adoption relist owns it)
+            if event == "DELETED":
+                drift = True
+            else:
+                drift = slice_content_digest(obj) != known
+            if not drift:
+                return
+            self._publisher.invalidate()
+        self.metrics.inc("slice_drift_detected_total")
+        now = time.monotonic()
+        if now - self._last_drift_republish < self._drift_republish_cooldown:
+            # See __init__: one drift-driven heal per window — a
+            # persistent external writer must not drive a republish war.
+            return
+        self._last_drift_republish = now  # lint: disable=R200 (informer dispatch is single-threaded; worst case a racing reader publishes once more inside the window)
+        log.warning(
+            "slice %s drifted externally (%s); republishing", name, event
+        )
+        self.publish_soon()
 
     # --- ResourceSlice publication (driver.go:188-268) ---
 

@@ -124,6 +124,17 @@ class SlicePublisher:
         an external writer is known to have touched the pool set."""
         self._published = None
 
+    def committed_digest(self, name: str) -> Optional[str]:
+        """The content digest this publisher last committed for
+        ``name`` (None when unknown or the cache is cold). The driver's
+        node-scoped slice informer compares watch events against it to
+        tell OUR writes (digest matches) from external drift (ISSUE 11
+        satellite: event-driven healing instead of the reverify poll).
+        Read under the owner's publish serialization, like publish()."""
+        if self._published is None:
+            return None
+        return self._published.get(name)
+
     def publish(self, build: Callable[[int], List[dict]]) -> int:
         """Diff-and-write one pass; returns the number of API writes.
 

@@ -154,6 +154,16 @@ class SchedulerCore:
             # suppressed.
             with self._unsched_lock:
                 self._last_unsched.pop(self._key(claim), None)
+            # A deleted ALLOCATED claim frees capacity that may unblock
+            # an Unschedulable claim right now — only the periodic
+            # sweep used to notice (seconds of added latency on the
+            # serving fabric's scale-up path, ISSUE 11: scale-down
+            # deletes a claim exactly so a waiting scale-up can place).
+            # Same coalesced batch item as every other capacity event.
+            if (claim.get("status") or {}).get("allocation"):
+                self.queue.enqueue(
+                    None, self._reconcile_batch, key=BATCH_KEY
+                )
             return
         if not (claim.get("status") or {}).get("allocation"):
             # Funnel into the batch item (ISSUE 10): a per-claim

@@ -1,0 +1,38 @@
+"""Serving fabric (ISSUE 11): the tier above one engine.
+
+One :class:`~tpu_dra.workloads.engine.Engine` serves one DRA lease;
+heavy traffic from millions of users needs the layer that spreads an
+open-loop multi-tenant trace across a FLEET of engine replicas:
+
+- :mod:`tpu_dra.serving.router` — session/prefix-affinity-aware
+  dispatch, per-tenant SLO classes (latency-tier admission control),
+  and weighted fair queuing over *tokens* so one hot tenant cannot
+  starve the rest (the ShardedWorkQueue fairness story applied to the
+  data plane);
+- :mod:`tpu_dra.serving.autoscaler` — claim-driven autoscaling: the
+  replica set grows by CREATING ResourceClaims (the PR-6 packer places
+  them) and shrinks by evacuating an engine through the PR-7
+  backpressure drain (host checkpoint, pages freed, lossless resume on
+  another replica) BEFORE its ResourceClaim is deleted;
+- :mod:`tpu_dra.serving.fabricbench` — fleetsim + engines composed into
+  one end-to-end bench leg (``bench.py --leg-fabric`` /
+  ``make fabricbench``): user-request-submitted → first-token p50/p99
+  over the synthetic fleet, next to per-tenant fairness and autoscale
+  reaction-time keys.
+"""
+
+from tpu_dra.serving.router import (  # noqa: F401
+    BATCH,
+    INTERACTIVE,
+    STANDARD,
+    FabricCompletion,
+    Replica,
+    Router,
+    RouterConfig,
+    SLOClass,
+    TenantSpec,
+)
+from tpu_dra.serving.autoscaler import (  # noqa: F401
+    AutoscalerConfig,
+    ClaimAutoscaler,
+)

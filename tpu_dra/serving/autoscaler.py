@@ -1,0 +1,271 @@
+"""Claim-driven autoscaling: the replica set IS a set of ResourceClaims.
+
+The autoscaler never picks a node and never places anything — it scales
+the serving fabric by creating and deleting ResourceClaims and lets the
+scheduler's fragmentation-aware packer (PR 6) place them, exactly like
+any other tenant of the control plane:
+
+- **scale-up**: create one claim (the caller's ``make_claim`` template
+  names the sub-slice shape), wait for ``status.allocation`` to appear
+  (the batch solve places it), then ``make_replica(claim)`` binds a new
+  engine to the allocated device and the router starts dispatching to
+  it. Decision → first-dispatchable is recorded as the **reaction
+  time** (``fabric_autoscaler_reaction_seconds``).
+- **scale-down**: quiesce the least-loaded replica, drive the PR-7
+  backpressure drain through :meth:`Engine.evacuate` (host checkpoint,
+  pages freed), splice the evacuated sequences back into the router's
+  WFQ for lossless resume on the surviving replicas, and ONLY THEN
+  delete the ResourceClaim — the tenant-transparent eviction ordering
+  the fabric smoke gates (zero lost or duplicated sequences,
+  token-identical completions under greedy decoding).
+
+Decisions are load-derived (MISO, PAPERS.md 2207.11428): the signal is
+the router's queued token backlog per live replica vs a target, with a
+hysteresis band (``up_factor`` / ``down_factor``) and a cooldown
+between actions. A desired REVERSAL inside the cooldown window is the
+flapping signal — counted as ``fabric_autoscaler_flaps_total`` (and
+suppressed); the doctor WARNs on it with the widen-the-band
+remediation.
+
+``tick()`` is a non-blocking state machine (steady → waiting-alloc →
+steady, steady → draining → steady) advanced from the fabric's control
+thread, so tests drive every transition deterministically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, List, Optional
+
+from tpu_dra.serving.router import Replica, Router
+
+
+@dataclasses.dataclass
+class AutoscalerConfig:
+    min_replicas: int = 1
+    max_replicas: int = 8
+    # Queued-backlog target per live replica, in tokens. Above
+    # target * up_factor per replica -> scale up; below
+    # target * down_factor -> scale down. The gap between the two
+    # factors is the hysteresis band that keeps a steady load from
+    # oscillating the replica count.
+    target_tokens_per_replica: float = 4096.0
+    up_factor: float = 1.25
+    down_factor: float = 0.25
+    cooldown_seconds: float = 3.0
+    # A claim the packer cannot place within this window is deleted and
+    # the scale-up abandoned until the next pressure signal (capacity
+    # may have been freed meanwhile — item 1's repacker will help).
+    alloc_timeout_seconds: float = 30.0
+    namespace: str = "fabric"
+
+
+class ClaimAutoscaler:
+    """``make_claim(name) -> dict`` builds the ResourceClaim body
+    (shape/class selectors are the caller's policy);
+    ``make_replica(claim) -> Replica`` binds a started replica to an
+    ALLOCATED claim (the engine is cheap: same (config, int8) key =
+    shared compiled executables via the engine's _JIT_CACHE)."""
+
+    def __init__(
+        self,
+        router: Router,
+        claims,  # ResourceClient bound to RESOURCE_CLAIMS
+        make_claim: Callable[[str], dict],
+        make_replica: Callable[[dict], Replica],
+        config: Optional[AutoscalerConfig] = None,
+        metrics=None,
+        clock=time.monotonic,
+    ):
+        self.router = router
+        self.claims = claims
+        self.make_claim = make_claim
+        self.make_replica = make_replica
+        self.config = config or AutoscalerConfig()
+        self.metrics = metrics
+        self.clock = clock
+        self.flaps = 0
+        self.scaleups = 0
+        self.scaledowns = 0
+        self.reaction_s: List[float] = []
+        self.drain_s: List[float] = []
+        # Event log for tests and the bench: (kind, claim_name, t, info).
+        self.events: List[tuple] = []
+        self._serial = 0
+        self._last_action: Optional[str] = None  # "up" | "down"
+        self._last_action_t = -1e18
+        # One flap per reversal EPISODE: tick() runs at control-loop
+        # frequency (sub-ms), so counting every suppressed tick would
+        # make the flap metric loop-frequency-dependent. The latch
+        # clears when the reversal desire goes away or an action runs.
+        self._flap_latched = False
+        # In-flight transitions (at most one of each at a time).
+        self._pending_claim: Optional[dict] = None
+        self._pending_t0 = 0.0
+        self._draining: Optional[Replica] = None
+        self._drain_t0 = 0.0
+
+    # --- the control-thread entry point ---
+
+    def tick(self) -> None:
+        if self._pending_claim is not None:
+            self._tick_pending_alloc()
+            return
+        if self._draining is not None:
+            self._tick_draining()
+            return
+        self._maybe_scale()
+
+    # --- decision ---
+
+    def _load_per_replica(self) -> float:
+        n = max(1, len(self.router.live_replicas()))
+        return self.router.queued_tokens() / n
+
+    def _maybe_scale(self) -> None:
+        c = self.config
+        n = len(self.router.live_replicas())
+        load = self._load_per_replica()
+        want: Optional[str] = None
+        if load > c.target_tokens_per_replica * c.up_factor:
+            if n < c.max_replicas:
+                want = "up"
+        elif load < c.target_tokens_per_replica * c.down_factor:
+            if n > c.min_replicas:
+                want = "down"
+        if want is None:
+            self._flap_latched = False
+            return
+        now = self.clock()
+        if now - self._last_action_t < c.cooldown_seconds:
+            if self._last_action is not None and want != self._last_action:
+                # Up+down inside one cooldown window: the hysteresis
+                # band is too tight for this load's variance. Count it
+                # ONCE per episode (the doctor's flapping WARN) and
+                # suppress the action.
+                if not self._flap_latched:
+                    self._flap_latched = True
+                    self.flaps += 1
+                    if self.metrics is not None:
+                        self.metrics.inc("fabric_autoscaler_flaps_total")
+            else:
+                self._flap_latched = False
+            return
+        self._flap_latched = False
+        if want == "up":
+            self._begin_scale_up(now)
+        else:
+            self._begin_scale_down(now)
+
+    # --- scale-up: create claim -> packer places -> bind replica ---
+
+    def _begin_scale_up(self, now: float) -> None:
+        self._serial += 1
+        name = f"fabric-replica-{self._serial:04d}"
+        claim = self.make_claim(name)
+        claim["metadata"]["name"] = name
+        claim["metadata"]["namespace"] = self.config.namespace
+        self.claims.create(claim)
+        self._pending_claim = claim
+        self._pending_t0 = now
+        self._last_action, self._last_action_t = "up", now
+        self.events.append(("up-requested", name, now, {}))
+
+    def _tick_pending_alloc(self) -> None:
+        name = self._pending_claim["metadata"]["name"]
+        now = self.clock()
+        cur = self.claims.try_get(name, self.config.namespace)
+        alloc = ((cur or {}).get("status") or {}).get("allocation")
+        if not alloc:
+            if now - self._pending_t0 > self.config.alloc_timeout_seconds:
+                # Unschedulable: abandon (delete so the claim does not
+                # squat the queue) and re-decide on the next pressure.
+                try:
+                    self.claims.delete(name, self.config.namespace)
+                except Exception:  # noqa: BLE001 — already gone
+                    pass
+                self.events.append(("up-unplaceable", name, now, {}))
+                self._pending_claim = None
+            return
+        rep = self.make_replica(cur)
+        rep.claim_name = name
+        rep.claim = cur
+        self.router.add_replica(rep)
+        self._pending_claim = None
+        self.scaleups += 1
+        reaction = now - self._pending_t0
+        self.reaction_s.append(reaction)
+        if self.metrics is not None:
+            self.metrics.observe(
+                "fabric_autoscaler_reaction_seconds", reaction
+            )
+            self.metrics.inc("fabric_autoscaler_scaleups_total")
+        self.events.append(("up-ready", name, now, {
+            "reaction_s": reaction,
+            "devices": [
+                r["device"] for r in alloc["devices"]["results"]
+            ],
+        }))
+
+    # --- scale-down: quiesce -> evacuate -> requeue -> DELETE claim ---
+
+    def _victim(self) -> Optional[Replica]:
+        live = self.router.live_replicas()
+        if len(live) <= self.config.min_replicas:
+            return None
+        # Least in-flight work moves the least state; claim-less
+        # replicas (bootstrap) are never preferred over claim-backed
+        # ones — deleting their "claim" would be a no-op and the
+        # measured drill wants the real ordering.
+        return min(
+            live,
+            key=lambda r: (not r.claim_name, len(r.inflight)),
+        )
+
+    def _begin_scale_down(self, now: float) -> None:
+        victim = self._victim()
+        if victim is None:
+            return
+        victim.quiesced = True
+        victim.begin_evacuate()
+        self._draining = victim
+        self._drain_t0 = now
+        self._last_action, self._last_action_t = "down", now
+        self.events.append(
+            ("down-draining", victim.claim_name, now, {})
+        )
+
+    def _tick_draining(self) -> None:
+        victim = self._draining
+        if not victim.evac_done:
+            return
+        now = self.clock()
+        requeued = self.router.requeue_evacuated(victim)
+        engine_empty = not victim.engine.busy
+        # THE ordering contract: the ResourceClaim is deleted only
+        # after the drain handed every sequence back (pages freed,
+        # engine empty) — eviction is tenant-transparent.
+        if victim.claim_name:
+            try:
+                self.claims.delete(
+                    victim.claim_name, self.config.namespace
+                )
+            except Exception:  # noqa: BLE001 — already gone
+                pass
+        self.router.remove_replica(victim)
+        victim.stop()
+        self._draining = None
+        self.scaledowns += 1
+        drain = now - self._drain_t0
+        self.drain_s.append(drain)
+        if self.metrics is not None:
+            self.metrics.inc("fabric_autoscaler_scaledowns_total")
+            self.metrics.observe(
+                "fabric_autoscaler_drain_seconds", drain
+            )
+        self.events.append(("down-complete", victim.claim_name, now, {
+            "requeued": requeued,
+            "drain_s": drain,
+            "engine_empty_at_delete": engine_empty,
+        }))

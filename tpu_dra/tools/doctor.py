@@ -119,6 +119,20 @@ ENGINE_STALL_WARN_SECONDS = 1.0
 WORKQUEUE_DEPTH_GAUGE = "workqueue_depth"
 WORKQUEUE_DEPTH_WARN = 100
 
+# Serving-fabric gauges (ISSUE 11), suffix-matched like the others.
+# fabric_tenant_vtime_lag{tenant=} is the router's WFQ starvation
+# signal: how far (in weighted tokens) the fabric's virtual clock has
+# run past a backlogged tenant's head turn. Healthy WFQ keeps it within
+# ~one request cost; a large AND growing lag means that tenant is owed
+# service others are receiving — a mis-weighted config, a quiesced
+# affinity home, or a router bug. fabric_autoscaler_flaps_total counts
+# scale-direction REVERSALS desired inside one cooldown window (the
+# autoscaler suppresses the action and bumps this instead).
+FABRIC_LAG_GAUGE = "fabric_tenant_vtime_lag"
+FABRIC_LAG_WARN_TOKENS = 1024.0
+FABRIC_FLAP_COUNTER = "fabric_autoscaler_flaps_total"
+FABRIC_REPLICAS_GAUGE = "fabric_replicas"
+
 # Decode-roofline trend gate (ISSUE 8): the key bench.py records as the
 # gap between the measured decode step and the bf16 HBM floor. Matched
 # by SUFFIX inside the artifact (like the scheduler/engine gauges): the
@@ -226,6 +240,9 @@ def probe_metrics(
         wq = _check_workqueue(ep, first, second, warn)
         if wq:
             report[ep]["workqueue"] = wq
+        fabric = _check_fabric(ep, first, second, warn)
+        if fabric:
+            report[ep]["fabric"] = fabric
     return report
 
 
@@ -268,6 +285,72 @@ def _check_workqueue(
                 f"{ep}: {series} = {value:g} — deep reconcile backlog; "
                 f"re-run with --metrics-interval to see whether it is "
                 f"draining or still growing"
+            )
+    return out
+
+
+def _check_fabric(
+    ep: str, first: Dict[str, float], second: Optional[Dict[str, float]],
+    warn,
+) -> Dict[str, object]:
+    """Surface the serving fabric's health (ISSUE 11): sustained
+    per-tenant WFQ starvation and autoscaler flapping. Like the
+    workqueue check, starvation needs TWO samples to warn decisively —
+    a large lag that is DRAINING is a recovering fabric, not a sick
+    one; a single sample past the threshold asks for a re-probe."""
+    out: Dict[str, object] = {}
+    sample = second if second is not None else first
+    lags: Dict[str, Dict[str, float]] = {}
+    for series, value in sorted(sample.items()):
+        name = series.split("{", 1)[0]
+        if name.endswith(FABRIC_REPLICAS_GAUGE):
+            out["replicas"] = int(value)
+        elif name.endswith(FABRIC_FLAP_COUNTER):
+            out["flaps"] = int(value)
+        elif name.endswith(FABRIC_LAG_GAUGE):
+            entry: Dict[str, float] = {"lag": value}
+            if second is not None:
+                entry["grew"] = value - first.get(series, 0.0)
+            lags[series] = entry
+            if value <= FABRIC_LAG_WARN_TOKENS:
+                continue
+            if second is not None:
+                if entry["grew"] > 0:
+                    warn(
+                        f"{ep}: {series} = {value:g} weighted tokens "
+                        f"and still GROWING (+{entry['grew']:g} over "
+                        f"the probe interval) — this tenant is being "
+                        f"STARVED: service others received was owed to "
+                        f"its queue head. Check the tenant's weight vs "
+                        f"its SLO class, whether its affinity home "
+                        f"replica is quiesced/draining, and the "
+                        f"router's per-replica inflight cap "
+                        f"(docs/serving.md, 'Serving fabric')"
+                    )
+            else:
+                warn(
+                    f"{ep}: {series} = {value:g} weighted tokens of "
+                    f"WFQ lag — re-run with --metrics-interval to see "
+                    f"whether the tenant is draining or being starved"
+                )
+    if lags:
+        out["tenant_lags"] = lags
+    flaps = out.get("flaps", 0)
+    if flaps:
+        climbed = None
+        if second is not None:
+            for series, value in second.items():
+                if series.split("{", 1)[0].endswith(FABRIC_FLAP_COUNTER):
+                    climbed = value - first.get(series, 0.0)
+        if climbed is None or climbed > 0 or second is None:
+            warn(
+                f"{ep}: autoscaler FLAPPING — {flaps} scale-direction "
+                f"reversal(s) desired inside one cooldown window "
+                f"(suppressed, but the signal means the hysteresis "
+                f"band is too tight for this load's variance). Widen "
+                f"the up_factor/down_factor gap or raise "
+                f"cooldown_seconds (docs/operations.md, 'Serving "
+                f"fabric autoscaler')"
             )
     return out
 
@@ -781,6 +864,27 @@ def render(report: dict) -> str:
             if "page_exhausted" in eng:
                 parts.append(f"exhausted={eng['page_exhausted']}")
             lines.append(f"  engine: {' '.join(parts)}")
+        fabric = m.get("fabric") or {}
+        if fabric:
+            parts = []
+            if "replicas" in fabric:
+                parts.append(f"replicas={fabric['replicas']}")
+            if "flaps" in fabric:
+                parts.append(f"flaps={fabric['flaps']}")
+            for series, st in sorted(
+                (fabric.get("tenant_lags") or {}).items()
+            ):
+                label = series.split("{", 1)
+                tenant = ""
+                if len(label) > 1 and "tenant=" in label[1]:
+                    tenant = "[" + label[1].rstrip("}").split(
+                        "tenant=", 1
+                    )[1].strip('"') + "]"
+                grew = (
+                    f"+{st['grew']:g}" if st.get("grew", 0) > 0 else ""
+                )
+                parts.append(f"lag{tenant}={st['lag']:g}{grew}")
+            lines.append(f"  fabric: {' '.join(parts)}")
         wq = m.get("workqueue") or {}
         if wq:
             parts = []

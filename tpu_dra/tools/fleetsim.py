@@ -156,6 +156,23 @@ class NodeAgent:
         self.naive_writes += 1
 
 
+def spin_fleet(cluster, nodes: int, metrics: Metrics) -> List[NodeAgent]:
+    """Publish the shared synthetic fleet into ``cluster`` through the
+    driver's REAL publisher and register the device classes — the
+    composition point the serving fabric reuses (ISSUE 11): fabricbench
+    stands its engine replicas on the IDENTICAL fleet the allocator
+    microbench and this control-plane harness measure."""
+    slices = ResourceClient(cluster, RESOURCE_SLICES)
+    for cls in fleet.CLASSES:
+        ResourceClient(cluster, DEVICE_CLASSES).create(
+            json.loads(json.dumps(cls))
+        )
+    agents = [NodeAgent(i, slices, metrics) for i in range(nodes)]
+    for a in agents:
+        a.publish()
+    return agents
+
+
 class KubeletSim:
     """The fleet's kubelet+plugin analog: watches claims; when an
     allocation lands, 'prepares' the claim on its owning node (a fixed

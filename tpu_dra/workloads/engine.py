@@ -162,6 +162,11 @@ class Request:
     prompt: np.ndarray  # 1-D int32 token ids
     max_new_tokens: int
     arrival_s: float = 0.0  # offset on the engine's clock; 0 = immediate
+    # The caller (the fabric router, resuming an evacuated sequence on
+    # a NEW replica) already recorded this request's first token
+    # elsewhere: the engine must not observe engine_ttft_seconds again
+    # — the resume's "first" token would log a bogus near-zero sample.
+    ttft_preobserved: bool = False
 
 
 @dataclasses.dataclass
@@ -183,6 +188,25 @@ class Completion:
     @property
     def ttft_s(self) -> float:
         return self.t_first_token - self.t_arrival
+
+
+@dataclasses.dataclass
+class Evacuated:
+    """One sequence handed back by :meth:`Engine.evacuate` — the
+    host-side checkpoint the serving fabric moves to another replica:
+    the ORIGINAL request, every token this engine emitted for it, and
+    the arrival-side timestamps (the fabric's submitted→first-token SLO
+    must survive the move). ``remaining`` new tokens are still owed; a
+    resume prefills ``prompt + emitted`` and generates the rest."""
+
+    req: Request
+    emitted: np.ndarray  # tokens THIS engine emitted (may be empty)
+    t_submit: float
+    t_first: Optional[float]  # None when no token was emitted yet
+
+    @property
+    def remaining(self) -> int:
+        return self.req.max_new_tokens - len(self.emitted)
 
 
 class _Sequence:
@@ -492,6 +516,37 @@ class Engine:
 
     def close(self) -> None:
         self.gate.close()
+
+    def evacuate(self) -> "List[Evacuated]":
+        """Tenant-transparent eviction (ISSUE 11): drain every in-flight
+        sequence to host state via the PR-7 backpressure drain (pages
+        freed, contexts folded), then hand the WHOLE live set — drained
+        and still-queued alike — back to the caller, leaving the engine
+        empty. The serving fabric's autoscaler uses this as the
+        scale-down primitive: the claim behind this engine is only
+        deleted once evacuate() returned, and the evacuated sequences
+        resume on another replica by prefilling ``prompt + emitted`` —
+        no sequence lost, no token re-emitted (under greedy decoding a
+        resumed continuation is token-identical to the uninterrupted
+        run; sampled trajectories are only preserved WITHIN one engine,
+        whose (seed, serial, position) key schedule a new replica does
+        not share). rids are forgotten, so a sequence may later be
+        resubmitted to this same engine."""
+        self._drain(self.clock())
+        out: List[Evacuated] = []
+        while self._queue:
+            seq = self._queue.popleft()
+            self._rids.discard(seq.req.rid)
+            out.append(Evacuated(
+                req=seq.req,
+                emitted=np.asarray(seq.out, np.int32),
+                t_submit=seq.t_submit,
+                t_first=seq.t_first,
+            ))
+        self._flush_zero()
+        self._inc("engine_evacuated_total", len(out))
+        self._export()
+        return out
 
     def _live(self):
         """Every not-yet-completed sequence, exactly once (prefilling
@@ -818,6 +873,20 @@ class Engine:
             return
         if seq.t_first is None:
             seq.t_first = now
+            if self.metrics is not None and not seq.req.ttft_preobserved:
+                # First-token latency from ARRIVAL, same definition as
+                # Completion.ttft_s (ISSUE 11): the router's SLO
+                # classes and the fabric bench leg consume TTFT as a
+                # first-class exported series, not only a per-request
+                # field. Same-engine drains never re-observe (t_first
+                # survives the drain); a CROSS-replica resume arrives
+                # as a new Request with ttft_preobserved set by the
+                # router when the first token already happened
+                # elsewhere.
+                self.metrics.observe(
+                    "engine_ttft_seconds",
+                    now - (seq.t_submit + seq.req.arrival_s),
+                )
         seq.out.extend(int(t) for t in toks[:take])
         self._progress += 1
         self._inc("engine_tokens_total", take)
