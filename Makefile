@@ -3,7 +3,7 @@
 IMAGE_REPO ?= registry.local/tpu-dra-driver
 IMAGE_TAG  ?= v0.1.0
 
-.PHONY: all native test test-slow bench decodebench allocbench enginebench shardbench fleetbench fabricbench image bats lint lint-fast shlint chaos crashmatrix apisoak ci clean
+.PHONY: all native test test-slow bench decodebench allocbench enginebench shardbench fleetbench fabricbench repackbench image bats lint lint-fast shlint chaos crashmatrix apisoak ci clean
 
 all: native test
 
@@ -77,6 +77,20 @@ fleetbench:
 # (docs/serving.md).
 fabricbench:
 	python -m tpu_dra.serving.fabricbench --smoke
+
+# Elastic-repacker CPU smoke (ISSUE 12): churn strands the synthetic
+# fleet, the leader-elected repacker migrates residents without
+# evicting tenants — hard asserts on: migrations happened and fleet
+# fragmentation strictly dropped; the stranded 2x2 replica places
+# after defrag and aggregate tok/s beats the fragmented fleet; the
+# mid-generation migration is lossless and TOKEN-IDENTICAL to an
+# uninterrupted reference; claim-ready p99 stays inside the pinned
+# bound of the quiet baseline during a disruption-budgeted repack
+# storm under real Lease leader election. The full fleet-scale
+# configuration runs as `bench.py --leg-repack` and lands in
+# BENCH_r*.json (docs/scheduling.md, "Autonomous repacking").
+repackbench:
+	python -m tpu_dra.serving.repackbench --smoke
 
 # Mesh-sharded decode CPU smoke (ISSUE 8): the (batch x model) decode
 # mesh degrades gracefully ((1,1) on one chip), the sharding rules
@@ -173,7 +187,7 @@ shlint:
 # (flakes surface in CI, not in the judge's rerun), the 13 bats suites
 # executed against the minicluster, the batsless process-level e2e, and
 # the bench artifact schema gate.
-ci: lint lint-fast shlint native chaos crashmatrix apisoak decodebench allocbench enginebench shardbench fleetbench fabricbench
+ci: lint lint-fast shlint native chaos crashmatrix apisoak decodebench allocbench enginebench shardbench fleetbench fabricbench repackbench
 	python -m pytest tests/ -q -m 'not slow'
 	python -m pytest tests/ -q -m 'not slow'
 	python -m pytest tests/test_chaos.py -q -m slow

@@ -642,6 +642,25 @@ def _leg_fabric_main() -> int:
     return fabric_main([])
 
 
+def _leg_repack_main() -> int:
+    """Elastic-repacker leg (ISSUE 12): the autonomous defragmenter
+    over the synthetic fleet — a serving drill where churn strands a
+    2x2 replica until the repacker migrates a resident mid-generation
+    (lossless, token-identical greedy resume through the PR-11
+    evacuation primitive) and aggregate tok/s is measured fragmented vs
+    packed, plus a fleet-scale repack STORM (real Lease leader
+    election, disruption-budgeted concurrent migrations) gated on the
+    claim-ready p99 staying inside the PR-10 SLO. Engines pinned to
+    CPU like the fabric leg — this measures the control plane and the
+    migration machinery, not per-chip speed
+    (tpu_dra/serving/repackbench.py; methodology: docs/scheduling.md
+    'Autonomous repacking')."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from tpu_dra.serving.repackbench import main as repack_main
+
+    return repack_main([])
+
+
 def _leg_rotate_main() -> int:
     """Time-slice rotation client: a live trainer that steps only while
     holding the arbiter lease and yields at the quantum. Both clients
@@ -1533,6 +1552,8 @@ def main() -> int:
         return _leg_fleet_main()
     if "--leg-fabric" in sys.argv:
         return _leg_fabric_main()
+    if "--leg-repack" in sys.argv:
+        return _leg_repack_main()
     if "--leg-rotate" in sys.argv:
         return _leg_rotate_main()
 
@@ -1620,6 +1641,25 @@ def main() -> int:
         f"autoscale reaction {fabric['fabric_scaleup_reaction_ms']} ms, "
         f"scale-down drain {fabric['fabric_scaledown_drain_ms']} ms, "
         f"flaps {fabric['fabric_autoscaler_flaps']}",
+        file=sys.stderr,
+    )
+
+    # Elastic-repacker leg (ISSUE 12): CPU-side like the fabric leg,
+    # own process (its repacker/scheduler/kubelet thread fleet must not
+    # share an interpreter with the TPU legs).
+    repack = _run_leg({}, flag="--leg-repack")
+    print(
+        f"repack ({repack['repack_nodes']} nodes): frag "
+        f"{repack['repack_frag_before']} -> {repack['repack_frag_after']} "
+        f"over {repack['repack_migrations']} migrations "
+        f"({repack['repack_aborted']} aborted, "
+        f"{repack['repack_deferred']} budget-deferred); serving "
+        f"{repack['repack_tok_s_fragmented']} -> "
+        f"{repack['repack_tok_s_packed']} tok/s "
+        f"(x{repack['repack_tok_s_gain']}); claim-ready p99 under the "
+        f"storm {repack['repack_storm_claim_ready_p99_ms']} ms vs quiet "
+        f"{repack['repack_quiet_claim_ready_p99_ms']} ms "
+        f"(x{repack['repack_storm_p99_x']})",
         file=sys.stderr,
     )
 
@@ -1979,6 +2019,24 @@ def main() -> int:
                 "fabric_autoscaler_flaps": fabric[
                     "fabric_autoscaler_flaps"
                 ],
+                "repack_nodes": repack["repack_nodes"],
+                "repack_frag_before": repack["repack_frag_before"],
+                "repack_frag_after": repack["repack_frag_after"],
+                "repack_migrations": repack["repack_migrations"],
+                "repack_aborted": repack["repack_aborted"],
+                "repack_deferred": repack["repack_deferred"],
+                "repack_tok_s_fragmented": repack[
+                    "repack_tok_s_fragmented"
+                ],
+                "repack_tok_s_packed": repack["repack_tok_s_packed"],
+                "repack_tok_s_gain": repack["repack_tok_s_gain"],
+                "repack_quiet_claim_ready_p99_ms": repack[
+                    "repack_quiet_claim_ready_p99_ms"
+                ],
+                "repack_storm_claim_ready_p99_ms": repack[
+                    "repack_storm_claim_ready_p99_ms"
+                ],
+                "repack_storm_p99_x": repack["repack_storm_p99_x"],
             }
         )
     )

@@ -124,6 +124,23 @@ TYPED_WHEN_PRESENT = {
     "fabric_scaleup_reaction_ms": (int, float),
     "fabric_scaledown_drain_ms": (int, float),
     "fabric_autoscaler_flaps": int,
+    # Elastic-repacker leg (ISSUE 12): fleet defragmentation achieved
+    # by the autonomous repacker + the packed-vs-fragmented serving
+    # gain + the claim-ready SLO under a repack storm. The B100 pass
+    # forward-requires repack_frag_before / repack_frag_after /
+    # repack_migrations / repack_tok_s_gain.
+    "repack_nodes": int,
+    "repack_frag_before": (int, float),
+    "repack_frag_after": (int, float),
+    "repack_migrations": int,
+    "repack_aborted": int,
+    "repack_deferred": int,
+    "repack_tok_s_fragmented": (int, float),
+    "repack_tok_s_packed": (int, float),
+    "repack_tok_s_gain": (int, float),
+    "repack_quiet_claim_ready_p99_ms": (int, float),
+    "repack_storm_claim_ready_p99_ms": (int, float),
+    "repack_storm_p99_x": (int, float),
 }
 
 

@@ -66,6 +66,15 @@ REQUIRED_STATIC = (
     "fabric_ttft_p99_ms",
     "fabric_quiet_p99_ms",
     "fabric_scaleup_reaction_ms",
+    # Elastic-repacker leg (ISSUE 12): the fleet defragmentation the
+    # autonomous repacker achieved (frag before/after + migration
+    # count) and the packed-vs-fragmented serving-capacity gain —
+    # dropping any of them would blind the defrag regression tripwire
+    # before its first recorded artifact.
+    "repack_frag_before",
+    "repack_frag_after",
+    "repack_migrations",
+    "repack_tok_s_gain",
 )
 
 

@@ -882,3 +882,133 @@ def test_metrics_probe_warns_on_autoscaler_flapping(tmp_path):
         assert report["warnings"] == [], report["warnings"]
     finally:
         srv.stop()
+
+
+# --- elastic-repacker checks (ISSUE 12) --------------------------------------
+
+
+def _repacker_metrics(frag=0.4, leader=1.0, active=0, oldest=0.0,
+                      migrations=5):
+    from tpu_dra.infra.metrics import Metrics
+
+    metrics = Metrics()
+    metrics.set_gauge("repacker_frag_score", frag)
+    metrics.set_gauge("repacker_leader", leader)
+    metrics.set_gauge("repacker_active_migrations", active)
+    metrics.set_gauge("repacker_oldest_migration_seconds", oldest)
+    metrics.inc("repacker_migrations_total", migrations)
+    return metrics
+
+
+def test_metrics_probe_warns_on_frag_high_and_repacker_not_leading(
+    tmp_path,
+):
+    """Fragmentation past the threshold while the repacker does not
+    hold the Lease: stranded capacity has no one acting on it — WARN
+    with the leader-election remediation, plus the repacker render
+    line."""
+    from tpu_dra.infra.metrics import MetricsServer
+
+    metrics = _repacker_metrics(frag=0.4, leader=0.0)
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        endpoint = f"127.0.0.1:{srv.port}"
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint],
+        )
+        warns = "\n".join(report["warnings"])
+        assert "NOT LEADING" in warns
+        assert "Lease" in warns
+        out = render(report)
+        assert "repacker: leader=0 active=0 migrations=5 frag=0.4" in out
+    finally:
+        srv.stop()
+
+
+def test_metrics_probe_warns_on_frag_high_and_repacker_idle(tmp_path):
+    """Leading but idle under high fragmentation (and, with two
+    samples, migrations_total flat): likely misconfigured — WARN with
+    the threshold/budget remediation. A repacker actively migrating
+    (or one whose counter is climbing) stays quiet."""
+    from tpu_dra.infra.metrics import MetricsServer
+
+    metrics = _repacker_metrics(frag=0.4, leader=1.0, active=0)
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        endpoint = f"127.0.0.1:{srv.port}"
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint],
+        )
+        warns = "\n".join(report["warnings"])
+        assert "IDLE" in warns
+        assert "frag_threshold" in warns
+        # Mid-burst (active migrations): quiet despite the high score.
+        metrics.set_gauge("repacker_active_migrations", 2)
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint],
+        )
+        assert report["warnings"] == [], report["warnings"]
+        # Two samples with the counter CLIMBING: also quiet (the
+        # repacker is making progress between the samples).
+        import threading
+
+        metrics.set_gauge("repacker_active_migrations", 0)
+        bump = threading.Timer(
+            0.1, lambda: metrics.inc("repacker_migrations_total")
+        )
+        bump.start()
+        try:
+            report = collect(
+                str(tmp_path / "data"), str(tmp_path / "cdi"),
+                str(tmp_path / "mux"), tpulib=lib,
+                metrics_endpoints=[endpoint], metrics_interval=0.4,
+            )
+            assert report["warnings"] == [], report["warnings"]
+        finally:
+            bump.cancel()
+    finally:
+        srv.stop()
+
+
+def test_metrics_probe_warns_on_stuck_migration(tmp_path):
+    """A migration in flight past the budget window is holding a
+    drained tenant in limbo — WARN with the drain/unschedulable/WAL
+    remediation split. A fast in-flight migration stays quiet, as does
+    a low-frag healthy repacker."""
+    from tpu_dra.infra.metrics import MetricsServer
+
+    metrics = _repacker_metrics(frag=0.01, oldest=120.0, active=1)
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        endpoint = f"127.0.0.1:{srv.port}"
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint],
+        )
+        warns = "\n".join(report["warnings"])
+        assert "past the disruption-budget window" in warns
+        assert "repack.tpu.google.com/state" in warns
+        assert "oldest=120s" in render(report)
+        # Healthy: fast migration, low frag.
+        metrics.set_gauge("repacker_oldest_migration_seconds", 2.0)
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint],
+        )
+        assert report["warnings"] == [], report["warnings"]
+    finally:
+        srv.stop()

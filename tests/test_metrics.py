@@ -59,3 +59,24 @@ def test_failing_collector_does_not_break_scrape():
     m.inc("ok_counter")
     m.register_collector(lambda: (_ for _ in ()).throw(RuntimeError("boom")))
     assert "ok_counter 1" in m.render()
+
+
+def test_remove_gauges_drops_label_superset_series():
+    """remove_gauges(name, match) drops every series whose labels
+    CONTAIN the match — the cleanup for per-entity histogram-bucket
+    families whose extra `le` label the caller cannot enumerate
+    (exact-key remove_gauge leaks them forever under entity churn)."""
+    from tpu_dra.infra.metrics import Metrics
+
+    m = Metrics()
+    for le in ("0.1", "1", "+Inf"):
+        m.set_gauge(
+            "lease_wait_bucket", 1.0, {"claim": "dead", "le": le}
+        )
+        m.set_gauge(
+            "lease_wait_bucket", 2.0, {"claim": "live", "le": le}
+        )
+    m.remove_gauges("lease_wait_bucket", {"claim": "dead"})
+    out = m.render()
+    assert 'claim="dead"' not in out
+    assert out.count('claim="live"') == 3

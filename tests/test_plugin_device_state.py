@@ -785,3 +785,58 @@ def test_multiplexing_on_dynamic_subslice(tmp_path):
     state.unprepare(claim["metadata"]["uid"])
     assert deployments.list(namespace="tpu-dra-driver") == []
     assert state.tpulib.list_subslices() == []
+
+
+# --- moved allocation (elastic repack, ISSUE 12) -----------------------------
+
+
+def test_moved_allocation_reprepares_instead_of_serving_stale(tmp_path):
+    """A claim whose allocation was rewritten WHILE prepared (the
+    elastic repacker moved its sub-slice) must not be served from the
+    stale PrepareCompleted checkpoint: the old placement is torn down
+    and the new one prepared fresh — the plugin-side half of a
+    tenant-transparent migration."""
+    gates(DynamicSubslice=True)
+    state, _ = make_state(tmp_path)
+    claim = make_claim(["tpu-ss-1x2-0-0-0"])
+    uid = claim["metadata"]["uid"]
+    devices = state.prepare(claim)
+    assert devices[0].device_name == "tpu-ss-1x2-0-0-0"
+    old_ss = state.tpulib.list_subslices()
+    assert len(old_ss) == 1
+
+    # The repacker committed a new placement for the same claim.
+    moved = json.loads(json.dumps(claim))
+    moved["status"]["allocation"]["devices"]["results"][0]["device"] = (
+        "tpu-ss-1x2-1-0-0"
+    )
+    devices2 = state.prepare(moved)
+    assert [d.device_name for d in devices2] == ["tpu-ss-1x2-1-0-0"]
+    live = state.tpulib.list_subslices()
+    assert len(live) == 1, "old sub-slice leaked across the move"
+    assert live[0].uuid != old_ss[0].uuid
+    cp = state.checkpoints.get()
+    entry = cp.prepared_claims[uid]
+    assert entry.checkpoint_state == CLAIM_STATE_PREPARE_COMPLETED
+    assert entry.prepared_devices.device_names() == ["tpu-ss-1x2-1-0-0"]
+    # The re-prepare is idempotent like any other: same allocation
+    # again short-circuits.
+    devices3 = state.prepare(moved)
+    assert [d.device_name for d in devices3] == ["tpu-ss-1x2-1-0-0"]
+    assert len(state.tpulib.list_subslices()) == 1
+    # And unprepare converges cleanly.
+    state.unprepare(uid)
+    assert state.tpulib.list_subslices() == []
+
+
+def test_unmoved_allocation_still_short_circuits(tmp_path):
+    """The idempotency fast path is untouched when the allocation did
+    NOT move: a second prepare of the identical claim creates nothing."""
+    gates(DynamicSubslice=True)
+    state, _ = make_state(tmp_path)
+    claim = make_claim(["tpu-ss-1x2-0-0-0"])
+    state.prepare(claim)
+    first = state.tpulib.list_subslices()
+    state.prepare(json.loads(json.dumps(claim)))
+    live = state.tpulib.list_subslices()
+    assert [ss.uuid for ss in live] == [ss.uuid for ss in first]

@@ -1,0 +1,693 @@
+"""Elastic repacker (ISSUE 12): planning, disruption budget, leader
+election, crash-safe two-phase moves, scheduler coexistence, and the
+cached fragmentation poll.
+
+The crash-matrix rows for the ``repack.migrate.*`` points live in
+tests/test_crash_matrix.py next to the other WAL drills; this file
+covers the controller's behavior contract."""
+
+import json
+import time
+
+import pytest
+
+from tpu_dra.infra.flags import LeaderElectionConfig
+from tpu_dra.infra.leaderelection import LeaderElector
+from tpu_dra.infra.metrics import Metrics
+from tpu_dra.k8sclient import (
+    DEVICE_CLASSES,
+    RESOURCE_CLAIMS,
+    RESOURCE_SLICES,
+    FakeCluster,
+    ResourceClient,
+)
+from tpu_dra.scheduler import fleet
+from tpu_dra.scheduler.allocator import Allocator
+from tpu_dra.scheduler.core import SchedulerCore
+from tpu_dra.scheduler.index import SliceIndex
+from tpu_dra.scheduler.repacker import (
+    PHASE_EVACUATED,
+    PHASE_PLANNED,
+    PHASE_RELEASED,
+    REPACK_ANNOTATION,
+    Repacker,
+    RepackerConfig,
+    ServingAdapter,
+    repack_owned,
+    repack_state,
+)
+
+NS = "default"
+
+
+# --- harness -----------------------------------------------------------------
+
+
+def make_cluster(nodes=2):
+    cluster = FakeCluster()
+    classes = ResourceClient(cluster, DEVICE_CLASSES)
+    for c in fleet.CLASSES:
+        classes.create(json.loads(json.dumps(c)))
+    slices = ResourceClient(cluster, RESOURCE_SLICES)
+    for i in range(nodes):
+        slices.create(fleet.make_node_slice(i))
+    return cluster
+
+
+def place(cluster, i, node_idx, dev, shape="1x1x1"):
+    """Create claim i allocated to one named sub-slice device — precise
+    placement control the scheduler's packer would refuse to produce."""
+    claims = ResourceClient(cluster, RESOURCE_CLAIMS)
+    c = fleet.make_claim(i, shape)
+    c["metadata"]["namespace"] = NS
+    c["status"] = {"allocation": {"devices": {"results": [{
+        "request": "tpu", "driver": fleet.DRIVER,
+        "pool": fleet.node_name(node_idx), "device": dev,
+    }]}}}
+    claims.create(c)
+    claims.update_status(c)
+    return c["metadata"]["name"]
+
+
+def spread_two(cluster):
+    """One 1x1 resident per node: 6 free chips, no 2x2 reachable —
+    frag 1 - 4/6. The canonical improvable state."""
+    a = place(cluster, 0, 0, "ss-1x1x1-0-0-0")
+    b = place(cluster, 1, 1, "ss-1x1x1-0-0-0")
+    return a, b
+
+
+def claim_of(cluster, name):
+    return ResourceClient(cluster, RESOURCE_CLAIMS).try_get(name, NS)
+
+
+def devices_of(claim):
+    alloc = (claim.get("status") or {}).get("allocation") or {}
+    return {
+        (r["pool"], r["device"])
+        for r in alloc.get("devices", {}).get("results", [])
+    }
+
+
+def assert_placements_valid(cluster):
+    """Oracle-grade end-state check: every allocated claim's devices
+    exist, and no two claims overlap on chip counters (replay through
+    the real ledger)."""
+    claims = ResourceClient(cluster, RESOURCE_CLAIMS).list()
+    slices = ResourceClient(cluster, RESOURCE_SLICES).list()
+    classes = ResourceClient(cluster, DEVICE_CLASSES).list()
+    alloc = Allocator(classes, slices=slices)
+    for c in claims:
+        for key in [
+            (r["driver"], r["pool"], r["device"])
+            for r in ((c.get("status") or {}).get("allocation") or {})
+            .get("devices", {}).get("results", [])
+        ]:
+            dev = alloc.catalog.by_key.get(key)
+            assert dev is not None, f"allocated device {key} not published"
+            assert key not in alloc.in_use, f"device {key} double-assigned"
+            assert alloc.ledger.can_consume(dev), (
+                f"device {key} overlaps another claim's counters"
+            )
+            alloc.ledger.consume(dev)
+            alloc.in_use.add(key)
+
+
+class RecordingAdapter(ServingAdapter):
+    def __init__(self, drain_ready=True):
+        self.drain_ready = drain_ready
+        self.calls = []
+
+    def begin_drain(self, key):
+        self.calls.append(("begin_drain", key))
+
+    def drain_done(self, key):
+        return self.drain_ready
+
+    def finish_drain(self, key):
+        self.calls.append(("finish_drain", key))
+        return 1
+
+    def rebind(self, key, claim):
+        self.calls.append(("rebind", key))
+
+    def abort(self, key):
+        self.calls.append(("abort", key))
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def mk_repacker(cluster, adapter=None, clock=None, metrics=None, **cfg):
+    defaults = dict(
+        poll_period=0.0, frag_threshold=0.05,
+        min_disruption_interval_seconds=0.0,
+    )
+    defaults.update(cfg)
+    return Repacker(
+        cluster, RepackerConfig(**defaults),
+        serving=adapter, metrics=metrics or Metrics(),
+        clock=clock or time.monotonic,
+    )
+
+
+@pytest.fixture(autouse=True)
+def _fresh_frag_cache():
+    Allocator.reset_frag_cache_for_tests()
+    yield
+    Allocator.reset_frag_cache_for_tests()
+
+
+# --- planning + execution ----------------------------------------------------
+
+
+def test_migrates_resident_to_reduce_fragmentation():
+    cluster = make_cluster()
+    a, b = spread_two(cluster)
+    adapter = RecordingAdapter()
+    metrics = Metrics()
+    rp = mk_repacker(cluster, adapter, metrics=metrics)
+    for _ in range(8):
+        rp.tick()
+    assert rp.migrations == 1 and rp.aborted == 0
+    # The two residents are co-located now; the whole move ran through
+    # the serving protocol and the WAL annotation is gone.
+    ca, cb = claim_of(cluster, a), claim_of(cluster, b)
+    pools = {next(iter(devices_of(c)))[0] for c in (ca, cb)}
+    assert len(pools) == 1, f"residents still spread: {pools}"
+    assert repack_state(ca) is None and repack_state(cb) is None
+    moved = [k for _, k in adapter.calls if _ == "rebind"]
+    assert len(moved) == 1
+    kinds = [k for k, _ in adapter.calls]
+    assert kinds == ["begin_drain", "finish_drain", "rebind"]
+    assert_placements_valid(cluster)
+    assert metrics.get_counter("repacker_migrations_total") == 1
+    assert metrics.get_gauge("repacker_frag_score_before") == pytest.approx(
+        0.3333, abs=1e-3
+    )
+    assert metrics.get_gauge("repacker_frag_score_after") == 0.0
+    # Converged: further ticks plan nothing.
+    rp.tick()
+    assert rp.migrations == 1 and not rp._active
+
+
+def test_below_threshold_fleet_is_left_alone():
+    cluster = make_cluster()
+    # Co-located pair: frag 0 — nothing to do.
+    place(cluster, 0, 0, "ss-1x1x1-0-0-0")
+    place(cluster, 1, 0, "ss-1x1x1-1-0-0")
+    rp = mk_repacker(cluster)
+    for _ in range(3):
+        rp.tick()
+    assert rp.migrations == 0 and not rp._active
+
+
+def test_non_leader_never_plans():
+    cluster = make_cluster()
+    spread_two(cluster)
+    rp = mk_repacker(cluster)
+    rp.is_leader = False
+    for _ in range(5):
+        rp.tick()
+    assert rp.migrations == 0 and not rp._active
+    for c in ResourceClient(cluster, RESOURCE_CLAIMS).list():
+        assert repack_state(c) is None
+
+
+def test_idle_claims_migrate_before_busy_ones():
+    """MISO: the utilization signal orders victims — the busy resident
+    stays put when an idle one fixes the fleet."""
+    cluster = make_cluster()
+    a, b = spread_two(cluster)
+    util = {f"{NS}/{a}": 1.0, f"{NS}/{b}": 0.0}
+    rp = mk_repacker(cluster, RecordingAdapter())
+    rp.utilization = lambda: util
+    for _ in range(8):
+        rp.tick()
+    assert rp.migrations == 1
+    # b (idle) moved into a's pool; a untouched.
+    assert devices_of(claim_of(cluster, a)) == {
+        (fleet.node_name(0), "ss-1x1x1-0-0-0")
+    }
+    assert next(iter(devices_of(claim_of(cluster, b))))[0] == (
+        fleet.node_name(0)
+    )
+
+
+# --- disruption budget -------------------------------------------------------
+
+
+def test_min_disruption_interval_defers_recent_victims():
+    cluster = make_cluster()
+    a, b = spread_two(cluster)
+    clock = FakeClock()
+    metrics = Metrics()
+    rp = mk_repacker(
+        cluster, RecordingAdapter(), clock=clock, metrics=metrics,
+        min_disruption_interval_seconds=60.0,
+    )
+    # Both candidates were just disrupted: every plan defers.
+    rp._last_disrupted = {f"{NS}/{a}": clock(), f"{NS}/{b}": clock()}
+    rp.tick()
+    assert rp.migrations == 0 and not rp._active
+    assert rp.deferred >= 1
+    assert metrics.get_counter(
+        "repacker_disruption_budget_deferred_total"
+    ) >= 1
+    # Past the window the same fleet migrates.
+    clock.t += 61.0
+    for _ in range(8):
+        rp.tick()
+    assert rp.migrations == 1
+
+
+def test_max_concurrent_migrations_bounds_the_storm():
+    cluster = make_cluster(nodes=4)
+    for i in range(4):
+        place(cluster, i, i, "ss-1x1x1-0-0-0")
+    adapter = RecordingAdapter(drain_ready=False)  # drains stall
+    rp = mk_repacker(
+        cluster, adapter, max_concurrent_migrations=2,
+        drain_timeout_seconds=1e9,
+    )
+    for _ in range(6):
+        rp.tick()
+    assert len(rp._active) == 2, (
+        f"budget violated: {len(rp._active)} concurrent migrations"
+    )
+    # Release the drains: the storm completes within budget, fleet
+    # converges, placements stay oracle-valid.
+    adapter.drain_ready = True
+    for _ in range(30):
+        rp.tick()
+        if not rp._active and rp.migrations >= 2:
+            break
+    assert rp.migrations >= 2
+    assert_placements_valid(cluster)
+
+
+def test_drain_timeout_aborts_and_rolls_back():
+    cluster = make_cluster()
+    a, b = spread_two(cluster)
+    clock = FakeClock()
+    adapter = RecordingAdapter(drain_ready=False)
+    rp = mk_repacker(
+        cluster, adapter, clock=clock, drain_timeout_seconds=5.0,
+        # An aborted victim counts as disrupted: the budget window must
+        # keep the next poll from immediately re-planning it.
+        min_disruption_interval_seconds=60.0,
+    )
+    rp.tick()
+    assert len(rp._active) == 1
+    before = {
+        n: devices_of(claim_of(cluster, n)) for n in (a, b)
+    }
+    # Defer the OTHER resident too: after the abort the planner would
+    # (correctly) try it next; this test isolates the rollback.
+    rp._last_disrupted[f"{NS}/{b}"] = clock.t
+    clock.t += 6.0
+    rp.tick()
+    assert rp.aborted == 1 and not rp._active
+    assert any(k == "abort" for k, _ in adapter.calls)
+    # Rolled back: placements untouched, WAL gone.
+    for n in (a, b):
+        c = claim_of(cluster, n)
+        assert devices_of(c) == before[n]
+        assert repack_state(c) is None
+
+
+# --- leader election ---------------------------------------------------------
+
+
+def test_lease_lost_mid_migration_aborts_at_next_boundary():
+    cluster = make_cluster()
+    a, b = spread_two(cluster)
+    adapter = RecordingAdapter(drain_ready=False)
+    metrics = Metrics()
+    rp = mk_repacker(cluster, adapter, metrics=metrics)
+    rp.tick()
+    assert len(rp._active) == 1  # draining, annotation persisted
+    annotated = [
+        c for c in ResourceClient(cluster, RESOURCE_CLAIMS).list()
+        if repack_state(c) is not None
+    ]
+    assert len(annotated) == 1
+    rp.is_leader = False  # the Lease is gone
+    rp.tick()
+    assert rp.aborted == 1 and not rp._active
+    assert metrics.get_counter("repacker_migrations_aborted_total") == 1
+    assert any(k == "abort" for k, _ in adapter.calls)
+    # Pre-release phases roll back fully: allocation intact, WAL gone.
+    for n in (a, b):
+        c = claim_of(cluster, n)
+        assert devices_of(c)
+        assert repack_state(c) is None
+    assert_placements_valid(cluster)
+
+
+def test_only_the_lease_holder_repacks():
+    """Two elector-backed repackers over one cluster: exactly one leads
+    and migrates; the loser never touches a claim — no concurrent
+    repackers."""
+    cluster = make_cluster()
+    spread_two(cluster)
+
+    def mk(name):
+        elector = LeaderElector(cluster, LeaderElectionConfig(
+            enabled=True, lease_name="tpu-dra-repacker",
+            lease_duration=30.0, renew_deadline=20.0, retry_period=0.05,
+        ))
+        return Repacker(
+            cluster,
+            RepackerConfig(
+                poll_period=0.05, min_disruption_interval_seconds=0.0,
+            ),
+            serving=RecordingAdapter(), metrics=Metrics(),
+            elector=elector,
+        )
+
+    r1, r2 = mk("one"), mk("two")
+    r1.start()
+    deadline = time.monotonic() + 10
+    while not r1.is_leader and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert r1.is_leader
+    r2.start()
+    try:
+        deadline = time.monotonic() + 10
+        while r1.migrations < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert r1.migrations == 1
+        assert not r2.is_leader
+        assert r2.migrations == 0 and r2.aborted == 0
+    finally:
+        r1.stop()
+        r2.stop()
+    assert_placements_valid(cluster)
+
+
+# --- recovery (a restarted leader over WAL'd half-moves) ---------------------
+
+
+def _annotate(cluster, name, phase, from_results, t=None):
+    claims = ResourceClient(cluster, RESOURCE_CLAIMS)
+    c = claims.try_get(name, NS)
+    c["metadata"].setdefault("annotations", {})[REPACK_ANNOTATION] = (
+        json.dumps({
+            "phase": phase, "from": from_results,
+            "t": time.time() if t is None else t, "by": "dead-leader",
+        })
+    )
+    return claims.update(c)
+
+
+def test_recover_rolls_back_pre_release_phases():
+    cluster = make_cluster()
+    a, b = spread_two(cluster)
+    from_a = [{
+        "request": "tpu", "driver": fleet.DRIVER,
+        "pool": fleet.node_name(0), "device": "ss-1x1x1-0-0-0",
+    }]
+    _annotate(cluster, a, PHASE_PLANNED, from_a)
+    _annotate(cluster, b, PHASE_EVACUATED, [])
+    adapter = RecordingAdapter()
+    rp = mk_repacker(cluster, adapter)
+    resolved = rp.recover()
+    assert resolved == 2
+    for n in (a, b):
+        c = claim_of(cluster, n)
+        assert repack_state(c) is None
+        assert devices_of(c)  # old placement intact
+    # The tenants were resumed in place.
+    assert sorted(k for k, _ in adapter.calls) == ["abort", "abort"]
+
+
+def test_recover_rolls_released_half_move_forward():
+    cluster = make_cluster()
+    a, b = spread_two(cluster)
+    # Simulate the between_unprepare_prepare crash: b released (no
+    # allocation) with the WAL saying so.
+    claims = ResourceClient(cluster, RESOURCE_CLAIMS)
+    cb = claims.try_get(b, NS)
+    from_b = cb["status"]["allocation"]["devices"]["results"]
+    cb["status"].pop("allocation")
+    claims.update(cb)
+    _annotate(cluster, b, PHASE_RELEASED, from_b)
+    adapter = RecordingAdapter()
+    rp = mk_repacker(cluster, adapter)
+    rp.recover()
+    assert len(rp._active) == 1
+    for _ in range(8):
+        rp.tick()
+    cb = claim_of(cluster, b)
+    assert devices_of(cb), "half-move never rolled forward"
+    assert repack_state(cb) is None
+    # Rolled forward PACKED: co-located with a on node 0.
+    assert next(iter(devices_of(cb)))[0] == fleet.node_name(0)
+    assert any(k == "rebind" for k, _ in adapter.calls)
+    assert_placements_valid(cluster)
+
+
+def test_recover_clears_annotation_when_scheduler_took_over():
+    """A stale plan the scheduler already re-allocated: recovery just
+    drops the WAL and stands down."""
+    cluster = make_cluster()
+    a, _b = spread_two(cluster)
+    _annotate(
+        cluster, a, PHASE_RELEASED,
+        [{"request": "tpu", "driver": fleet.DRIVER,
+          "pool": fleet.node_name(0), "device": "ss-1x1x1-0-0-0"}],
+        t=time.time() - 999,
+    )
+    rp = mk_repacker(cluster, RecordingAdapter())
+    rp.recover()
+    c = claim_of(cluster, a)
+    assert repack_state(c) is None
+    assert devices_of(c)
+    assert not rp._active
+
+
+# --- scheduler coexistence ---------------------------------------------------
+
+
+def test_repack_owned_semantics():
+    c = {"metadata": {"name": "x", "annotations": {}}}
+    assert not repack_owned(c)
+    c["metadata"]["annotations"][REPACK_ANNOTATION] = json.dumps(
+        {"phase": "released", "t": time.time()}
+    )
+    assert repack_owned(c)
+    c["metadata"]["annotations"][REPACK_ANNOTATION] = json.dumps(
+        {"phase": "released", "t": time.time() - 999}
+    )
+    assert not repack_owned(c)  # stale: the scheduler takes it back
+    c["metadata"]["annotations"][REPACK_ANNOTATION] = "not json{"
+    assert not repack_owned(c)  # corrupt degrades to scheduler-owned
+
+
+def test_scheduler_skips_fresh_repack_claims_and_takes_over_stale():
+    cluster = make_cluster()
+    core = SchedulerCore(cluster, retry_unschedulable_after=0.1)
+    core.start()
+    try:
+        deadline = time.monotonic() + 30
+        for inf in (
+            core.claim_informer, core.slice_informer, core.class_informer
+        ):
+            assert inf.wait_for_sync(timeout=deadline - time.monotonic())
+        claims = ResourceClient(cluster, RESOURCE_CLAIMS)
+        c = fleet.make_claim(7, "1x1x1")
+        c["metadata"]["namespace"] = NS
+        c["metadata"]["annotations"] = {REPACK_ANNOTATION: json.dumps(
+            {"phase": "released", "from": [], "t": time.time()}
+        )}
+        claims.create(c)
+        name = c["metadata"]["name"]
+        time.sleep(0.6)  # several sweeps
+        assert not (
+            (claims.try_get(name, NS) or {}).get("status") or {}
+        ).get("allocation"), (
+            "scheduler allocated a claim a FRESH repack plan owns"
+        )
+        # Stale plan: the scheduler takes the claim back.
+        cur = claims.try_get(name, NS)
+        cur["metadata"]["annotations"][REPACK_ANNOTATION] = json.dumps(
+            {"phase": "released", "from": [], "t": time.time() - 999}
+        )
+        claims.update(cur)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            got = claims.try_get(name, NS)
+            if ((got or {}).get("status") or {}).get("allocation"):
+                break
+            time.sleep(0.05)
+        assert ((got or {}).get("status") or {}).get("allocation"), (
+            "scheduler never took over the stale repack plan"
+        )
+    finally:
+        core.stop()
+
+
+def test_commit_race_yields_to_the_other_writer():
+    """The optimistic-commit protocol: a rival allocation landing on
+    the repacker's target devices between its snapshot and its commit
+    makes the repacker release again and retry — the end state never
+    double-assigns."""
+    cluster = make_cluster(nodes=3)
+    a, b = spread_two(cluster)
+    claims = ResourceClient(cluster, RESOURCE_CLAIMS)
+    raced = {}
+
+    def rival_prepare(claim, allocation):
+        # Fires between the repacker's allocate and its commit: steal
+        # the exact devices it chose, once.
+        if raced:
+            return
+        raced["done"] = True
+        rival = fleet.make_claim(99, "1x1x1")
+        rival["metadata"]["namespace"] = NS
+        rival["status"] = {"allocation": json.loads(
+            json.dumps(allocation)
+        )}
+        claims.create(rival)
+        claims.update_status(rival)
+
+    rp = mk_repacker(cluster, RecordingAdapter())
+    rp.prepare_hook = rival_prepare
+    for _ in range(12):
+        rp.tick()
+    assert raced, "the race never fired"
+    assert rp.migrations + rp.aborted >= 1
+    assert_placements_valid(cluster)
+    for c in claims.list():
+        assert repack_state(c) is None, "WAL left behind after the race"
+
+
+# --- the cached fragmentation poll (satellite) -------------------------------
+
+
+def _indexed_allocator(cluster, claims_list):
+    index = SliceIndex()
+    index.resync(ResourceClient(cluster, RESOURCE_SLICES).list())
+    classes = ResourceClient(cluster, DEVICE_CLASSES).list()
+    return index, lambda: Allocator(
+        classes, allocated_claims=claims_list, index=index
+    )
+
+
+def test_fragmentation_at_zero_recompute_on_unchanged_fleet():
+    cluster = make_cluster()
+    spread_two(cluster)
+    claims_list = ResourceClient(cluster, RESOURCE_CLAIMS).list()
+    index, build = _indexed_allocator(cluster, claims_list)
+    a1 = build()
+    first = a1.fragmentation_at(a1.catalog.generation)
+    assert Allocator.frag_computes == 1
+    # Fresh snapshots over the identical fleet + usage: pure cache hits.
+    for _ in range(5):
+        a = build()
+        assert a.fragmentation_at(a.catalog.generation) == first
+    assert Allocator.frag_computes == 1, (
+        "fragmentation recomputed on an unchanged fleet"
+    )
+
+
+def test_fragmentation_at_recomputes_on_fleet_or_usage_change():
+    cluster = make_cluster()
+    spread_two(cluster)
+    all_claims = ResourceClient(cluster, RESOURCE_CLAIMS).list()
+    index, build = _indexed_allocator(cluster, all_claims)
+    a1 = build()
+    a1.fragmentation_at(a1.catalog.generation)
+    assert Allocator.frag_computes == 1
+    # Usage changed (one claim released): new key, recompute.
+    classes = ResourceClient(cluster, DEVICE_CLASSES).list()
+    a2 = Allocator(classes, allocated_claims=all_claims[:1], index=index)
+    a2.fragmentation_at(a2.catalog.generation)
+    assert Allocator.frag_computes == 2
+    # Fleet changed (slice event bumps the generation): recompute.
+    index.on_slice_event("ADDED", fleet.make_node_slice(7))
+    a3 = Allocator(classes, allocated_claims=all_claims[:1], index=index)
+    a3.fragmentation_at(a3.catalog.generation)
+    assert Allocator.frag_computes == 3
+
+
+# --- review-hardening pins ---------------------------------------------------
+
+
+def test_released_phase_abort_keeps_replica_quiesced():
+    """Lease lost past the point of no return: the local abort must NOT
+    resume the replica — its placement was already released/unprepared,
+    and serving on it would ride silicon the claim no longer holds. The
+    drained work was requeued at the evacuated boundary, so nothing is
+    stranded; the next leader's recover() owns the claim."""
+    from tpu_dra.scheduler.repacker import _Migration
+
+    cluster = make_cluster()
+    spread_two(cluster)
+    adapter = RecordingAdapter()
+    rp = mk_repacker(cluster, adapter)
+    m = _Migration("default/claim-00000", "claim-00000", NS, [], 0.0)
+    m.phase = PHASE_RELEASED
+    rp._active.append(m)
+    rp.is_leader = False
+    rp.tick()
+    assert rp.aborted == 1 and not rp._active
+    assert not any(k == "abort" for k, _ in adapter.calls), (
+        "released-phase abort resumed a placement-less replica"
+    )
+
+
+def test_commit_race_detects_counter_overlap_not_just_device_keys():
+    """The post-commit verify must be counter-aware: a racing solve
+    that placed an OVERLAPPING sub-slice (different device name, same
+    chips) shares no (driver, pool, device) key with ours — a bare key
+    intersection would bless a double-assignment."""
+    cluster = make_cluster()
+    # Ours: the 1x1 at chip (1,0). Rival: the 2x1 row covering chips
+    # (0,0)+(1,0) — disjoint device keys, shared chip counters.
+    ours_name = place(cluster, 0, 0, "ss-1x1x1-1-0-0")
+    ours = claim_of(cluster, ours_name)
+    rp = mk_repacker(cluster, RecordingAdapter())
+    assert not rp._lost_capacity_race(ours), (
+        "false positive with no rival"
+    )
+    place(cluster, 1, 0, "ss-2x1x1-0-0-0")
+    assert rp._lost_capacity_race(ours), (
+        "counter-overlapping rival placement not detected — a bare "
+        "device-key intersection would bless this double-assignment"
+    )
+    # A rival on the OTHER row shares nothing: no race.
+    cluster2 = make_cluster()
+    ours2_name = place(cluster2, 0, 0, "ss-1x1x1-1-0-0")
+    place(cluster2, 1, 0, "ss-2x1x1-0-1-0")
+    rp2 = mk_repacker(cluster2, RecordingAdapter())
+    assert not rp2._lost_capacity_race(claim_of(cluster2, ours2_name))
+
+
+def test_re_release_preserves_original_plan_state():
+    """A lost commit race rebuilds the WAL annotation on a claim whose
+    commit just removed it: the rebuilt state must carry the ORIGINAL
+    'from' placement (the rollback target) and the ORIGINAL wall stamp
+    (retries must not extend repacker ownership — the stale-plan
+    scheduler takeover is the tenant's escape hatch)."""
+    from tpu_dra.scheduler.repacker import _Migration
+
+    cluster = make_cluster()
+    a, _b = spread_two(cluster)
+    rp = mk_repacker(cluster, RecordingAdapter())
+    from_results = [{"request": "tpu", "driver": fleet.DRIVER,
+                     "pool": fleet.node_name(0),
+                     "device": "ss-1x1x1-0-0-0"}]
+    m = _Migration(f"{NS}/{a}", a, NS, from_results, 0.0, wall_t0=123.5)
+    claim = {"metadata": {"name": a, "annotations": {}}}
+    rp._set_phase_ann(claim, PHASE_RELEASED, m)
+    st = json.loads(claim["metadata"]["annotations"][REPACK_ANNOTATION])
+    assert st["from"] == from_results
+    assert st["t"] == 123.5
+    assert st["phase"] == PHASE_RELEASED

@@ -97,6 +97,23 @@ CRASH_POINTS: Dict[str, str] = {
         "PrepareStarted",
     "cdplugin.unprepare.before_wal_removed":
         "CD teardown done and CDI spec deleted; the WAL entry remains",
+    # -- elastic repacker two-phase migration (scheduler/repacker.py) --
+    "repack.migrate.after_plan_persisted":
+        "the migration plan annotation is durable on the claim; nothing "
+        "moved yet — recovery must roll the plan back",
+    "repack.migrate.after_evacuate":
+        "the tenant's sequences are drained/requeued and the WAL says "
+        "evacuated; the old placement is still committed — recovery "
+        "rolls back to it",
+    "repack.migrate.between_unprepare_prepare":
+        "the old placement is released (allocation cleared, sub-slice "
+        "unprepared) and the new one does not exist yet — the classic "
+        "half-move window; recovery must roll FORWARD to a packed "
+        "placement",
+    "repack.migrate.before_commit":
+        "the new placement is computed and prepared but the claim's "
+        "allocation was never committed; recovery re-allocates "
+        "idempotently and commits",
 }
 
 

@@ -64,6 +64,20 @@ class Metrics:
         with self._lock:
             self._gauges.pop(self._key(name, labels), None)
 
+    def remove_gauges(self, name: str, match_labels: Dict[str, str]):
+        """Drop EVERY series of ``name`` whose labels contain
+        ``match_labels`` — the cleanup for families that carry extra
+        labels the caller cannot enumerate (histogram buckets'
+        ``le``): an exact-key remove_gauge would leave those series
+        behind forever as their entity churns."""
+        want = set(match_labels.items())
+        with self._lock:
+            for k in [
+                k for k in self._gauges
+                if k[0] == name and want <= set(k[1])
+            ]:
+                self._gauges.pop(k, None)
+
     def observe(self, name: str, seconds: float, labels: Optional[Dict[str, str]] = None):
         # Timings key like counters/gauges: (name, labels) — a sharded
         # workqueue's per-shard service times must not fold into one

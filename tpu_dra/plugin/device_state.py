@@ -242,14 +242,41 @@ class DeviceState:
         log.debug("t_prep_get_checkpoint %.3f s", time.monotonic() - t0)
 
         # Idempotency: PrepareCompleted short-circuits before we would
-        # overwrite it with PrepareStarted (device_state.go:196-207).
+        # overwrite it with PrepareStarted (device_state.go:196-207) —
+        # UNLESS the claim's allocation moved underneath the checkpoint
+        # (the elastic repacker rewrote status.allocation while the
+        # claim was prepared, ISSUE 12): serving the stale sub-slice
+        # would hand the container devices the allocation no longer
+        # grants. The moved claim is torn down and re-prepared fresh —
+        # the plugin-side "unprepare/prepare of the moved sub-slice".
         prev = cp.prepared_claims.get(claim_uid)
         if prev is not None and prev.checkpoint_state == CLAIM_STATE_PREPARE_COMPLETED:
+            if self._allocated_device_set(prev.status) == \
+                    self._allocated_device_set(claim.get("status", {})):
+                log.info(
+                    "skip prepare: claim already PrepareCompleted: %s",
+                    claim_to_string(claim),
+                )
+                return prev.prepared_devices.get_devices()
             log.info(
-                "skip prepare: claim already PrepareCompleted: %s",
+                "claim %s allocation moved while prepared (repack): "
+                "tearing down the old placement and re-preparing",
                 claim_to_string(claim),
             )
-            return prev.prepared_devices.get_devices()
+            # Teardown first, checkpoint entry second: a crash between
+            # the two leaves a PrepareCompleted record whose sub-slices
+            # are gone — the kubelet retry lands back here (the
+            # allocation still differs) and _unprepare_devices is
+            # idempotent over already-destroyed silicon.
+            self._unprepare_devices(claim_uid, prev.prepared_devices)
+            self.cdi.delete_claim_spec_file(claim_uid)
+
+            def drop_moved(c: Checkpoint) -> None:
+                c.prepared_claims.pop(claim_uid, None)
+
+            self.checkpoints.update(drop_moved)
+            cp = self.checkpoints.get()
+            prev = None
 
         # Double-allocation defense (device_state.go:211-216, :1118-1154).
         self._validate_no_overlapping_prepared_devices(cp, claim)
@@ -447,6 +474,19 @@ class DeviceState:
             for r in alloc.get("devices", {}).get("results", [])
             if r.get("driver") == DRIVER_NAME
         ]
+
+    @staticmethod
+    def _allocated_device_set(status: dict) -> frozenset:
+        """The (pool, device) set one claim status grants this driver —
+        the moved-allocation probe: a prepared claim whose CURRENT set
+        differs from the checkpointed one was repacked and must be
+        re-prepared, not served from the stale checkpoint."""
+        alloc = (status or {}).get("allocation") or {}
+        return frozenset(
+            (r.get("pool", ""), r.get("device", ""))
+            for r in (alloc.get("devices") or {}).get("results", []) or []
+            if r.get("driver") == DRIVER_NAME
+        )
 
     def _prepare_devices(self, claim: dict) -> PreparedDevices:
         results = self._allocation_results(claim)

@@ -259,9 +259,17 @@ class Driver:
             labels = {"claim": claim_uid}
             for name in (
                 "multiplex_revocations", "multiplex_waiting",
-                "multiplex_overdue",
+                "multiplex_overdue", "multiplex_claim_occupancy",
+                "multiplex_lease_wait_seconds_count",
+                "multiplex_lease_wait_seconds_sum",
+                "multiplex_lease_wait_seconds_max",
             ):
                 self.metrics.remove_gauge(name, labels)
+            # Bucket series carry an extra le label per edge — the
+            # subset-matched removal is the only way to drop them all.
+            self.metrics.remove_gauges(
+                "multiplex_lease_wait_seconds_bucket", labels
+            )
         self._mux_claims_seen = set(statuses)
         for claim_uid, st in statuses.items():
             labels = {"claim": claim_uid}
@@ -274,24 +282,36 @@ class Driver:
             self.metrics.set_gauge(
                 "multiplex_overdue", 1.0 if st.get("overdue") else 0.0, labels
             )
-            # Grant-wait histogram (r5): time-to-first-step visibility —
-            # a late joiner starving behind a holder's long compile is a
-            # dashboard alert, not a bench-tail surprise.
+            # Per-claim occupancy (ISSUE 12): lease-held fraction of
+            # daemon uptime — the utilization signal the elastic
+            # repacker's planner reads (idle claims migrate first,
+            # MISO-style). Absent from older/native daemons: .get().
+            if "occupancy" in st:
+                self.metrics.set_gauge(
+                    "multiplex_claim_occupancy", st["occupancy"], labels
+                )
+            # Grant-wait summary (r5, renamed for ISSUE 12 — the
+            # planner's lease-wait signal): time-to-first-step
+            # visibility; a late joiner starving behind a holder's
+            # long compile is a dashboard alert, not a bench-tail
+            # surprise.
             ws = st.get("waitSeconds") or {}
             if ws:
                 self.metrics.set_gauge(
-                    "multiplex_wait_seconds_count", ws.get("count", 0),
+                    "multiplex_lease_wait_seconds_count",
+                    ws.get("count", 0), labels,
+                )
+                self.metrics.set_gauge(
+                    "multiplex_lease_wait_seconds_sum", ws.get("sum", 0.0),
                     labels,
                 )
                 self.metrics.set_gauge(
-                    "multiplex_wait_seconds_sum", ws.get("sum", 0.0), labels
-                )
-                self.metrics.set_gauge(
-                    "multiplex_wait_seconds_max", ws.get("max", 0.0), labels
+                    "multiplex_lease_wait_seconds_max", ws.get("max", 0.0),
+                    labels,
                 )
                 for le, count in (ws.get("buckets") or {}).items():
                     self.metrics.set_gauge(
-                        "multiplex_wait_seconds_bucket", count,
+                        "multiplex_lease_wait_seconds_bucket", count,
                         {**labels, "le": le},
                     )
 

@@ -284,6 +284,22 @@ class LeaseState:
         self._wait_count = 0
         self._wait_sum = 0.0
         self._wait_max = 0.0
+        # Occupancy accounting (ISSUE 12): cumulative held seconds over
+        # daemon uptime — the per-claim utilization signal the elastic
+        # repacker's planner reads (an idle claim is the cheapest to
+        # migrate). Published in `status` as `occupancy` (0..1); the
+        # plugin's /metrics collector exports it as
+        # multiplex_claim_occupancy{claim=}.
+        self._started = time.monotonic()
+        self._held_total = 0.0
+
+    def _end_hold_locked(self) -> None:
+        """Accrue the ending hold into the occupancy total. Call at
+        every site that clears ``_holder`` (release, revocations,
+        dropped connections)."""
+        if self._hold_started:
+            self._held_total += time.monotonic() - self._hold_started
+            self._hold_started = 0.0
 
     def _record_wait_locked(self, wait: float) -> None:
         self._wait_count += 1
@@ -401,6 +417,7 @@ class LeaseState:
             key = self._cooldown_keys.get(offender, name)
             self._cooldown_until[key] = now + cooldown
             self._revocations += 1
+            self._end_hold_locked()
             self._holder = None
             if self.gate is not None:
                 # Revocation is not advisory: the kernel stops honoring
@@ -439,6 +456,7 @@ class LeaseState:
             if offender is None:
                 return False
             self._revocations += 1
+            self._end_hold_locked()
             self._holder = None
             if self.gate is not None:
                 self.gate.lock()
@@ -462,6 +480,7 @@ class LeaseState:
         with self._granted:
             if self._holder != conn_id:
                 return False
+            self._end_hold_locked()
             self._holder = None
             if self.gate is not None:
                 self.gate.lock()
@@ -479,6 +498,7 @@ class LeaseState:
 
     def _drop_locked(self, conn_id: str) -> None:
         if self._holder == conn_id:
+            self._end_hold_locked()
             self._holder = None
             if self.gate is not None:
                 self.gate.lock()
@@ -492,9 +512,10 @@ class LeaseState:
 
     def status(self) -> dict:
         with self._lock:
-            held = (
-                time.monotonic() - self._hold_started if self._holder else 0.0
-            )
+            now = time.monotonic()
+            held = now - self._hold_started if self._holder else 0.0
+            uptime = max(now - self._started, 1e-9)
+            occupancy = min(1.0, (self._held_total + held) / uptime)
             return {
                 "holder": (
                     self._names.get(self._holder, self._holder)
@@ -521,6 +542,10 @@ class LeaseState:
                 "revocations": self._revocations,
                 "preemption": self.preempt_after_quanta is not None,
                 "deviceGate": self.gate is not None,
+                # Lease-held fraction of daemon uptime (ISSUE 12): the
+                # repacker's per-claim utilization signal. The native
+                # twin may omit it; consumers must .get() it.
+                "occupancy": round(occupancy, 4),
                 "waitSeconds": {
                     "count": self._wait_count,
                     "sum": round(self._wait_sum, 6),

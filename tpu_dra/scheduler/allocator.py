@@ -702,19 +702,11 @@ class Allocator:
         use it (fully stranded)."""
         free_total = 0
         achievable = 0
-        for pk, peers in self.catalog.peers_by_pool.items():
-            free = self.ledger.pool_free(pk)
+        for pk in self.catalog.peers_by_pool:
+            free, best = self.pool_stranding(pk)
             if free <= 0:
                 continue
             free_total += free
-            best = 0
-            for c in peers:
-                if (
-                    c.weight > best
-                    and c.key() not in self.in_use
-                    and self.ledger.can_consume(c)
-                ):
-                    best = c.weight
             achievable += best
         util = (achievable / free_total) if free_total else 1.0
         return {
@@ -723,6 +715,65 @@ class Allocator:
             "achievable_util": round(util, 4),
             "frag_score": round(1.0 - util, 4),
         }
+
+    def pool_stranding(self, pk: Tuple[str, str]) -> Tuple[int, int]:
+        """One pool's ``(free_chips, best_achievable)`` under the
+        current ledger — the per-pool term of :meth:`fragmentation`.
+        The repacker's planner scores a candidate move by the delta of
+        this over only the AFFECTED pools (source + destination), so
+        evaluating a migration never costs an O(fleet) pass."""
+        free = self.ledger.pool_free(pk)
+        if free <= 0:
+            return (free, 0)
+        best = 0
+        for c in self.catalog.peers_by_pool.get(pk, ()):
+            if (
+                c.weight > best
+                and c.key() not in self.in_use
+                and self.ledger.can_consume(c)
+            ):
+                best = c.weight
+        return (free, best)
+
+    # Single-entry cache behind fragmentation_at(): the full score is
+    # O(fleet) pure Python (every pool's feasibility probe) — exactly
+    # the work the ISSUE-10 GIL fix throttled out of the scheduler's
+    # sweep. The repacker polls the score every few seconds from its own
+    # thread; without the cache an idle 5k-node fleet would pay the full
+    # pass per poll. Keyed on (index identity, index generation, usage
+    # set): an unchanged fleet with unchanged allocations is a hit no
+    # matter how many fresh Allocator snapshots asked.
+    _frag_cache: Dict[tuple, dict] = {}
+    frag_computes = 0  # class-level; tests pin zero-recompute steady state
+
+    def fragmentation_at(self, generation) -> dict:
+        """Cached :meth:`fragmentation` for pollers holding no snapshot
+        of their own. ``generation`` is the slice-index generation this
+        allocator's catalog was pinned at (``None`` disables caching —
+        a bare slices-list allocator has no cheap fleet-change token).
+        The usage set rides the key too: allocations move chips without
+        moving the slice generation, and a stale score would blind the
+        repacker to churn-freed capacity."""
+        if generation is None:
+            return self.fragmentation()
+        key = (
+            id(self.index) if self.index is not None else None,
+            generation,
+            frozenset(self.in_use),
+        )
+        hit = Allocator._frag_cache.get(key)
+        if hit is not None:
+            return hit
+        out = self.fragmentation()
+        Allocator.frag_computes += 1
+        Allocator._frag_cache.clear()  # single entry: latest fleet only
+        Allocator._frag_cache[key] = out
+        return out
+
+    @classmethod
+    def reset_frag_cache_for_tests(cls) -> None:
+        cls._frag_cache.clear()
+        cls.frag_computes = 0
 
     def _solve(self, per_request, i, chosen, claim_spec) -> bool:
         """Backtracking over candidate subsets, counters consumed

@@ -50,6 +50,7 @@ from tpu_dra.k8sclient import (
 )
 from tpu_dra.scheduler.allocator import Allocator, Unschedulable
 from tpu_dra.scheduler.index import SliceIndex
+from tpu_dra.scheduler.repacker import repack_owned
 
 log = logging.getLogger(__name__)
 
@@ -248,7 +249,13 @@ class SchedulerCore:
         )
 
     def _update_frag_gauge(self, alloc: Allocator) -> None:
-        frag = alloc.fragmentation()
+        # Cached by (index generation, usage set): the idle sweep's
+        # periodic refresh over an unchanged fleet costs a dict lookup,
+        # not the O(fleet) feasibility pass (ISSUE 12 satellite — the
+        # repacker's poll shares the same cache).
+        frag = alloc.fragmentation_at(
+            getattr(alloc.catalog, "generation", None)
+        )
         self.metrics.set_gauge("scheduler_frag_score", frag["frag_score"])
         self.metrics.set_gauge(
             "scheduler_free_chips", frag["free_chips"]
@@ -264,6 +271,13 @@ class SchedulerCore:
             c for c in snapshot
             if not (c.get("status") or {}).get("allocation")
             and not c["metadata"].get("deletionTimestamp")
+            # A claim mid-repack is the repacker's to place: its fresh
+            # WAL annotation owns the released->committed window, and
+            # allocating it here would race the mover for the same
+            # claim. A STALE plan (dead repacker) does NOT own — the
+            # claim is taken back so its tenant is never wedged; the
+            # repacker's recovery sees the allocation and stands down.
+            and not repack_owned(c)
         ]
         if not pending:
             return

@@ -20,6 +20,7 @@ from tpu_dra.infra import flags, signals
 from tpu_dra.infra.leaderelection import LeaderElector
 from tpu_dra.infra.metrics import Metrics, start_health_server
 from tpu_dra.scheduler.core import SchedulerCore
+from tpu_dra.scheduler.repacker import Repacker, RepackerConfig
 
 log = logging.getLogger(__name__)
 
@@ -43,6 +44,44 @@ def main(argv=None) -> int:
         default=flags.env_default("HEALTH_PORT", 0, int),
         help="Serve /healthz + Prometheus /metrics (0 disables)",
     )
+    # Elastic repacker (ISSUE 12): rides THIS binary's leadership — the
+    # scheduler's Lease already guarantees a single allocator, and the
+    # repacker must never run next to someone else's batch solves.
+    p.add_argument(
+        "--repack",
+        action="store_true",
+        default=flags.env_default("REPACK", False, bool),
+        help="Run the autonomous elastic repacker next to the "
+        "allocator (leader-gated; docs/scheduling.md 'Autonomous "
+        "repacking')",
+    )
+    p.add_argument(
+        "--repack-poll-period",
+        type=float,
+        default=flags.env_default("REPACK_POLL_PERIOD", 5.0, float),
+        help="Seconds between repacker planning passes",
+    )
+    p.add_argument(
+        "--repack-frag-threshold",
+        type=float,
+        default=flags.env_default("REPACK_FRAG_THRESHOLD", 0.05, float),
+        help="Act only above this fleet frag score",
+    )
+    p.add_argument(
+        "--repack-max-concurrent",
+        type=int,
+        default=flags.env_default("REPACK_MAX_CONCURRENT", 1, int),
+        help="Disruption budget: concurrent migrations",
+    )
+    p.add_argument(
+        "--repack-min-disruption-interval",
+        type=float,
+        default=flags.env_default(
+            "REPACK_MIN_DISRUPTION_INTERVAL", 30.0, float
+        ),
+        help="Disruption budget: seconds between disruptions of the "
+        "same claim",
+    )
     args = p.parse_args(argv)
     flags.LoggingConfig.from_args(args).apply()
     signals.start_debug_signal_handlers()
@@ -51,7 +90,7 @@ def main(argv=None) -> int:
 
     backend = flags.KubeClientConfig.from_args(args).new_client()
     metrics = Metrics()
-    current: dict = {"core": None}
+    current: dict = {"core": None, "repacker": None}
 
     def build_core() -> SchedulerCore:
         c = SchedulerCore(
@@ -61,6 +100,26 @@ def main(argv=None) -> int:
         )
         current["core"] = c
         return c
+
+    def start_repacker(core: SchedulerCore):
+        if not args.repack:
+            return None
+        r = Repacker(
+            backend,
+            RepackerConfig(
+                poll_period=args.repack_poll_period,
+                frag_threshold=args.repack_frag_threshold,
+                max_concurrent_migrations=args.repack_max_concurrent,
+                min_disruption_interval_seconds=(
+                    args.repack_min_disruption_interval
+                ),
+            ),
+            index=core.index,  # shared: slice events keep it current
+            metrics=metrics,
+        )
+        r.start()  # elector-less: gated by THIS binary's leadership
+        current["repacker"] = r
+        return r
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
@@ -89,9 +148,12 @@ def main(argv=None) -> int:
             core = build_core()
             metrics.set_gauge("leader", 1)
             core.start()
+            repacker = start_repacker(core)
 
             def stop_lead():
                 metrics.set_gauge("leader", 0)
+                if repacker is not None:
+                    repacker.stop()
                 core.stop()
 
             return stop_lead
@@ -107,7 +169,10 @@ def main(argv=None) -> int:
         core = build_core()
         metrics.set_gauge("leader", 1)
         core.start()
+        repacker = start_repacker(core)
         stop.wait()
+        if repacker is not None:
+            repacker.stop()
         core.stop()
     if health_server:
         health_server.stop()

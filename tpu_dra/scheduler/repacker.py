@@ -1,0 +1,929 @@
+"""Autonomous elastic repacker: leader-elected, crash-safe, disruption-
+budgeted defragmentation of the live fleet (ISSUE 12, ROADMAP item 1).
+
+PR 4 made reshape crash-safe and PR 6 gave the allocator a fragmentation
+objective, but nothing ACTED on it: a churned fleet strands free chips
+until an operator intervenes. This controller closes the loop — the
+reconfiguration-during-execution move Flex-MIG (PAPERS.md 2511.09143)
+shows is the decisive win over static partitioning, with the shape/
+victim choice driven by measured utilization signals per MISO
+(PAPERS.md 2207.11428):
+
+- **watch**: poll the fleet fragmentation score through the cached
+  :meth:`~tpu_dra.scheduler.allocator.Allocator.fragmentation_at`
+  (an unchanged fleet costs no O(fleet) recompute — the ISSUE-10 GIL
+  lesson) plus a caller-supplied per-claim utilization signal
+  (multiplexd lease-wait / occupancy, or the serving router's in-flight
+  load);
+- **plan**: for each pool with stranded free capacity (free chips the
+  largest advertised placement cannot reach), simulate re-allocating a
+  resident claim against the packed snapshot; a move is planned only
+  when the stranding over the AFFECTED pools strictly drops. Idle
+  claims move first — a busy tenant is the most expensive to disturb;
+- **execute** without evicting tenants: drain the victim's engine
+  through the serving tier's evacuation primitive (PR 11
+  ``Engine.evacuate`` — host-side checkpoint, pages freed, sequences
+  requeued at their tenants' queue front, token-identical resume under
+  greedy), release the old placement, re-allocate packed, rebind,
+  resume.
+
+**Crash safety.** Every migration is a WAL'd two-phase move: the plan
+lives in a ``repack.tpu.google.com/state`` annotation ON THE CLAIM
+(one apiserver object carries both the WAL state and the allocation it
+governs, and it survives leader failover — a node-local file would
+not). The four ``repack.migrate.*`` crash points
+(:mod:`tpu_dra.infra.crashpoint`) thread the dangerous windows, and the
+crash matrix kills at each one and proves a restarted leader's
+:meth:`Repacker.recover` converges to either the old or the new
+placement — never a half-move:
+
+=============  ==========================================================
+phase          recovery
+=============  ==========================================================
+``planned``    roll BACK: allocation untouched, drop the annotation,
+               resume the tenant in place
+``evacuated``  roll BACK: same — the old placement is still committed
+``released``   roll FORWARD: the old placement is gone; re-allocate
+               against the packed snapshot and commit (idempotent); if
+               something else already allocated the claim (a stale plan
+               the scheduler took over), just drop the annotation
+=============  ==========================================================
+
+**Scheduler coexistence.** A released claim is pending at the
+apiserver; the scheduler's batch reconcile SKIPS claims whose repack
+annotation is fresh (:func:`repack_owned`) so the two allocators never
+race for the same claim — but a plan older than
+``stale_plan_seconds`` is abandoned property (a dead repacker must not
+wedge a tenant forever) and the scheduler allocates it normally;
+recovery then sees the allocation and simply clears the annotation.
+Capacity races with OTHER claims' solves are closed optimistically:
+after committing, the repacker re-lists and verifies no overlap; on a
+lost race it is the YIELDING writer — it releases again and retries
+(the scheduler never re-allocates an allocated claim, so a verified
+commit is stable).
+
+**Disruption budget.** ``max_concurrent_migrations`` bounds the blast
+radius of a repack storm; ``min_disruption_interval_seconds`` keeps any
+single claim from being bounced repeatedly (deferred plans count into
+``repacker_disruption_budget_deferred_total``); a drain that exceeds
+``drain_timeout_seconds`` aborts and rolls back. Losing the leader
+Lease mid-migration aborts cleanly at the next crash-safe boundary:
+in-memory execution stops, the tenant resumes, and the WAL'd state is
+left for the next leader's ``recover()``.
+
+Threading: ``tick()`` is a non-blocking state machine. Embedded in the
+serving fabric it runs on the fabric's control thread (the thread that
+owns router/replica mutation); standalone, :meth:`start` runs it on a
+leader-elected background loop (``infra/leaderelection.py`` Lease).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import socket
+import threading
+import time
+import uuid
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from tpu_dra.infra.crashpoint import crashpoint
+from tpu_dra.k8sclient import (
+    DEVICE_CLASSES,
+    RESOURCE_CLAIMS,
+    RESOURCE_SLICES,
+    ApiConflict,
+    ApiNotFound,
+    ResourceClient,
+)
+from tpu_dra.scheduler.allocator import Allocator, Unschedulable
+
+log = logging.getLogger(__name__)
+
+REPACK_ANNOTATION = "repack.tpu.google.com/state"
+
+PHASE_PLANNED = "planned"
+PHASE_EVACUATED = "evacuated"
+PHASE_RELEASED = "released"
+
+# A plan whose wall-clock stamp is older than this is abandoned
+# property: the scheduler allocates the claim normally and recovery
+# clears the annotation. Shared default for the scheduler-side check.
+DEFAULT_STALE_PLAN_SECONDS = 120.0
+
+
+def repack_state(claim: dict) -> Optional[dict]:
+    """The claim's repack WAL entry, or None. Malformed JSON reads as
+    None — a corrupted annotation must degrade to 'scheduler owns the
+    claim', never crash a reconcile."""
+    raw = (claim.get("metadata", {}).get("annotations") or {}).get(
+        REPACK_ANNOTATION
+    )
+    if not raw:
+        return None
+    try:
+        st = json.loads(raw)
+    except ValueError:
+        return None
+    return st if isinstance(st, dict) else None
+
+
+def repack_owned(
+    claim: dict,
+    now: Optional[float] = None,
+    stale_seconds: float = DEFAULT_STALE_PLAN_SECONDS,
+) -> bool:
+    """True when a FRESH repack plan owns this claim (the scheduler's
+    batch reconcile must not allocate it out from under the mover). A
+    stale plan — the repacker died, or leadership never returned — does
+    NOT own: the control plane takes the claim back rather than wedge
+    its tenant forever."""
+    st = repack_state(claim)
+    if st is None:
+        return False
+    t = st.get("t")
+    if not isinstance(t, (int, float)):
+        return False
+    if now is None:
+        now = time.time()
+    return (now - t) < stale_seconds
+
+
+def _alloc_keys(claim: dict) -> Set[Tuple[str, str, str]]:
+    out: Set[Tuple[str, str, str]] = set()
+    alloc = (claim.get("status") or {}).get("allocation") or {}
+    for r in (alloc.get("devices") or {}).get("results", []) or []:
+        out.add((r.get("driver", ""), r.get("pool", ""), r.get("device", "")))
+    return out
+
+
+class ServingAdapter:
+    """How the repacker talks to whatever serves the claim's tenant.
+    The default is a no-op for claims with no live serving tier (the
+    fleetsim storm harness, batch claims): migration is placement-only.
+    The serving fabric's implementation
+    (:class:`tpu_dra.serving.repack.FabricRepackAdapter`) drives the
+    PR-11 evacuation handshake. All methods take the claim key
+    ``namespace/name``; every implementation must tolerate a key it has
+    never seen (recovery aborts plans for claims whose replica died
+    with the previous leader)."""
+
+    def begin_drain(self, key: str) -> None:
+        """Start draining the engine behind ``key`` (non-blocking)."""
+
+    def drain_done(self, key: str) -> bool:
+        return True
+
+    def finish_drain(self, key: str) -> int:
+        """Hand the drained sequences back to the routing tier; returns
+        how many were requeued (the lossless-accounting probe)."""
+        return 0
+
+    def rebind(self, key: str, claim: dict) -> None:
+        """The claim is committed at its new placement: bind a fresh
+        engine to it and resume dispatch."""
+
+    def abort(self, key: str) -> None:
+        """Roll back: resume the tenant on its OLD placement (requeue
+        anything drained, un-quiesce)."""
+
+
+@dataclasses.dataclass
+class RepackerConfig:
+    poll_period: float = 5.0
+    # Act only when the fleet frag score is above this: near-zero
+    # stranding is not worth a tenant disruption.
+    frag_threshold: float = 0.05
+    # --- disruption budget ---
+    max_concurrent_migrations: int = 1
+    min_disruption_interval_seconds: float = 30.0
+    drain_timeout_seconds: float = 30.0
+    # How many candidate claims one poll may SIMULATE (each simulation
+    # is an exact re-allocation — bounded so a repack poll can never
+    # monopolize the GIL at fleet scale).
+    max_candidates_per_poll: int = 8
+    # Claims busier than this (occupancy 0..1 from the utilization
+    # signal) are disturbed only when nothing idler improves the score.
+    busy_threshold: float = 0.9
+    # Commit-race retries before yielding the claim to the scheduler.
+    max_commit_attempts: int = 3
+    # A plan older than this is abandoned to the scheduler (see
+    # repack_owned); also the doctor's stuck-migration window.
+    stale_plan_seconds: float = DEFAULT_STALE_PLAN_SECONDS
+    # Restrict planning to claims in one namespace (None = fleet-wide).
+    namespace: Optional[str] = None
+
+
+class _Migration:
+    __slots__ = (
+        "key", "name", "namespace", "phase", "from_results", "t0",
+        "wall_t0", "attempts", "requeued",
+    )
+
+    def __init__(self, key, name, namespace, from_results, t0,
+                 wall_t0=0.0):
+        self.key = key
+        self.name = name
+        self.namespace = namespace
+        self.phase = PHASE_PLANNED
+        self.from_results = from_results  # allocation results to roll back to
+        self.t0 = t0
+        # The plan's ORIGINAL wall stamp: every annotation rewrite
+        # carries it forward, so a retrying migration cannot extend its
+        # own stale_plan_seconds ownership window indefinitely — the
+        # scheduler-takeover escape hatch stays on the tenant's clock.
+        self.wall_t0 = wall_t0
+        self.attempts = 0
+        self.requeued = 0
+
+
+class Repacker:
+    """See module doc. ``index`` is the scheduler's persistent
+    :class:`~tpu_dra.scheduler.index.SliceIndex` when embedded next to
+    a running core (slices are then never re-listed); without it the
+    repacker lists ResourceSlices per poll. ``utilization`` maps claim
+    key -> occupancy in [0, 1] (idle first); ``unprepare_hook(claim)``
+    / ``prepare_hook(claim, allocation)`` model the plugin-side
+    sub-slice teardown/materialization of the moved placement (the real
+    kubelet path re-prepares on its own when it sees the moved
+    allocation — device_state's moved-claim re-prepare)."""
+
+    def __init__(
+        self,
+        backend,
+        config: Optional[RepackerConfig] = None,
+        index=None,
+        serving: Optional[ServingAdapter] = None,
+        utilization: Optional[Callable[[], Dict[str, float]]] = None,
+        unprepare_hook: Optional[Callable[[dict], None]] = None,
+        prepare_hook: Optional[Callable[[dict, dict], None]] = None,
+        metrics=None,
+        clock=time.monotonic,
+        wall_clock=time.time,
+        elector=None,
+    ):
+        self.claims = ResourceClient(backend, RESOURCE_CLAIMS)
+        self.classes_client = ResourceClient(backend, DEVICE_CLASSES)
+        self.slices_client = ResourceClient(backend, RESOURCE_SLICES)
+        self.config = config or RepackerConfig()
+        self.index = index
+        self.serving = serving or ServingAdapter()
+        self.utilization = utilization
+        self.unprepare_hook = unprepare_hook
+        self.prepare_hook = prepare_hook
+        self.metrics = metrics
+        self.clock = clock
+        self.wall_clock = wall_clock
+        self.elector = elector
+        self.identity = f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}"
+        self.is_leader = elector is None
+        self._active: List[_Migration] = []
+        self._last_disrupted: Dict[str, float] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.migrations = 0  # completed (also a counter metric)
+        self.aborted = 0
+        self.deferred = 0
+        # Planning is throttled to poll_period even when tick() rides a
+        # hot control loop (the fabric drives it per poll iteration):
+        # a plan pass lists claims and builds an allocator — paying
+        # that per millisecond-tick would be the ISSUE-10 GIL mistake
+        # all over again. Active migrations still advance every tick.
+        self._last_plan = -1e18
+
+    # --- lifecycle (standalone leader-elected mode) ---------------------
+
+    def start(self) -> None:
+        """Run the poll loop on a background thread. With an elector the
+        loop only runs while this instance holds the Lease (losing it
+        stops the loop at the next boundary; re-acquiring restarts it
+        through recover())."""
+        if self.elector is not None:
+            def target():
+                self.elector.run_leading(self._lead)
+        else:
+            self._set_leader(True)
+            stop = threading.Event()
+            self._stop_lead = stop
+
+            def target():
+                self._run_loop(stop)
+
+        self._thread = threading.Thread(
+            target=target, daemon=True, name="repacker"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self.elector is not None:
+            self.elector.stop()
+        elif getattr(self, "_stop_lead", None) is not None:
+            self._stop_lead.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+
+    def _lead(self):
+        self._set_leader(True)
+        stop = threading.Event()
+        t = threading.Thread(
+            target=self._run_loop, args=(stop,), daemon=True,
+            name="repacker-loop",
+        )
+        t.start()
+
+        def stop_lead():
+            # Lease lost (or shutdown): leadership flips FIRST so any
+            # in-flight tick aborts at its next boundary check, then
+            # the loop is joined — no concurrent repackers. The abort
+            # itself runs HERE, after the join: the parked loop thread
+            # may wake straight into its stop check without another
+            # tick, so "aborts at the next crash-safe boundary" cannot
+            # depend on one. Single-writer holds: the loop thread is
+            # dead before this thread touches _active.
+            self._set_leader(False)
+            stop.set()
+            t.join(timeout=30)
+            if self._active:
+                self._abort_all("leader lease lost")
+
+        return stop_lead
+
+    def _set_leader(self, leading: bool) -> None:
+        self.is_leader = leading
+        if self.metrics is not None:
+            self.metrics.set_gauge("repacker_leader", 1.0 if leading else 0.0)
+
+    def _run_loop(self, stop: threading.Event) -> None:
+        # A fresh leadership term starts from the WAL alone: anything
+        # left in _active belongs to a PREVIOUS term whose plans
+        # recover() is about to roll back or forward — advancing a
+        # stale in-memory migration would re-execute a move the
+        # recovery just resolved.
+        self._active = []  # lint: disable=R200 (single-writer: the previous loop thread was joined before this one started)
+        try:
+            self.recover()
+        except Exception:
+            log.exception("repacker recovery failed; leading anyway")
+        while not self._stop.is_set() and not stop.is_set():
+            try:
+                self.tick()
+            except Exception:
+                log.exception("repacker tick failed")
+            # Active migrations advance on drain completion — poll them
+            # tighter than the planning period.
+            period = 0.05 if self._active else self.config.poll_period
+            if stop.wait(period):
+                break
+
+    # --- the control entry point ----------------------------------------
+
+    def tick(self) -> None:
+        """One pass: abort if not leading, advance active migrations,
+        plan new ones within the disruption budget, export gauges."""
+        if not self.is_leader:
+            if self._active:
+                self._abort_all("leader lease lost")
+            self._export()
+            return
+        for m in list(self._active):
+            self._advance(m)
+        now = self.clock()
+        if now - self._last_plan >= self.config.poll_period:
+            self._last_plan = now
+            self._maybe_plan()
+        self._export()
+
+    # --- recovery ---------------------------------------------------------
+
+    def recover(self) -> int:
+        """Resolve every WAL'd half-move left by a dead leader (see the
+        module-doc table). Returns how many plans were resolved."""
+        resolved = 0
+        for claim in self.claims.list():
+            st = repack_state(claim)
+            if st is None:
+                continue
+            md = claim["metadata"]
+            key = f"{md.get('namespace')}/{md['name']}"
+            phase = st.get("phase")
+            allocated = bool((claim.get("status") or {}).get("allocation"))
+            if phase in (PHASE_PLANNED, PHASE_EVACUATED) or (
+                phase == PHASE_RELEASED and allocated
+            ):
+                # Old placement intact (or someone — a stale-plan
+                # takeover, a crashed commit that landed — already
+                # allocated it): roll back to what is committed.
+                self._drop_annotation(md["name"], md.get("namespace"))
+                self.serving.abort(key)
+                log.info("repack recovery: rolled back %s (%s)", key, phase)
+            elif phase == PHASE_RELEASED:
+                # The half-move window: roll FORWARD.
+                t_wall = st.get("t")
+                m = _Migration(
+                    key, md["name"], md.get("namespace"),
+                    st.get("from") or [], self.clock(),
+                    wall_t0=(
+                        t_wall if isinstance(t_wall, (int, float))
+                        else self.wall_clock()
+                    ),
+                )
+                m.phase = PHASE_RELEASED
+                self._active.append(m)  # lint: disable=R200 (single-writer: recover/tick run on ONE thread — the control thread or the sole leader loop, joined across leadership handoffs)
+                log.info("repack recovery: resuming half-move %s", key)
+            else:
+                self._drop_annotation(md["name"], md.get("namespace"))
+            resolved += 1
+            self._inc("repacker_recoveries_total")
+        return resolved
+
+    # --- planning ---------------------------------------------------------
+
+    def _classes(self) -> List[dict]:
+        return self.classes_client.list()
+
+    def _build_allocator(
+        self,
+        snapshot: List[dict],
+        classes: List[dict],
+        slices: Optional[List[dict]],
+    ) -> Allocator:
+        if self.index is not None:
+            return Allocator(
+                classes, allocated_claims=snapshot, index=self.index
+            )
+        return Allocator(
+            classes, slices=slices or [], allocated_claims=snapshot
+        )
+
+    def _allocator(self, snapshot: List[dict]) -> Allocator:
+        return self._build_allocator(
+            snapshot,
+            self._classes(),
+            None if self.index is not None
+            else self.slices_client.list(),
+        )
+
+    def _frag(self, alloc: Allocator) -> dict:
+        return alloc.fragmentation_at(
+            getattr(alloc.catalog, "generation", None)
+        )
+
+    def _maybe_plan(self) -> None:
+        c = self.config
+        if len(self._active) >= c.max_concurrent_migrations:
+            return
+        snapshot = self.claims.list()
+        # One fetch per plan pass (classes are tiny; slices are O(fleet)
+        # without an index): _improves simulates up to
+        # max_candidates_per_poll re-allocations against these SAME
+        # immutable inputs — re-listing per candidate would be the
+        # O(fleet)-per-candidate cost the planner's budget forbids.
+        classes = self._classes()
+        slices = (
+            None if self.index is not None else self.slices_client.list()
+        )
+        alloc = self._build_allocator(snapshot, classes, slices)
+        frag = self._frag(alloc)
+        if self.metrics is not None:
+            self.metrics.set_gauge("repacker_frag_score", frag["frag_score"])
+        if frag["frag_score"] <= c.frag_threshold:
+            return
+        stranded = set()
+        for pk in alloc.catalog.peers_by_pool:
+            free, best = alloc.pool_stranding(pk)
+            if free > 0 and best < free:
+                stranded.add(pk)
+        if not stranded:
+            return
+        occupancy = {}
+        if self.utilization is not None:
+            try:
+                occupancy = self.utilization() or {}
+            except Exception:  # noqa: BLE001 — a dead signal reads as idle
+                log.exception("utilization signal failed; treating as idle")
+        active_keys = {m.key for m in self._active}
+        now = self.clock()
+        candidates = []
+        for claim in snapshot:
+            md = claim["metadata"]
+            key = f"{md.get('namespace')}/{md['name']}"
+            if key in active_keys or repack_state(claim) is not None:
+                continue
+            if c.namespace is not None and md.get("namespace") != c.namespace:
+                continue
+            if md.get("deletionTimestamp"):
+                continue
+            keys = _alloc_keys(claim)
+            if not keys or not any((k[0], k[1]) in stranded for k in keys):
+                continue
+            footprint = sum(
+                d.weight
+                for k in keys
+                if (d := alloc.catalog.by_key.get(k)) is not None
+            )
+            candidates.append(
+                (occupancy.get(key, 0.0), footprint, key, claim)
+            )
+        # Idle-and-small first (MISO: utilization drives the choice; a
+        # busy tenant is the most expensive disruption), key tiebreak
+        # for determinism. A claim above busy_threshold is skipped while
+        # any idler candidate exists — it becomes eligible only on a
+        # poll where it is the only thing left to move.
+        candidates.sort(key=lambda t: (t[0], t[1], t[2]))
+        any_idle = any(t[0] < c.busy_threshold for t in candidates)
+        simulated = 0
+        for occ, _fp, key, claim in candidates:
+            if len(self._active) >= c.max_concurrent_migrations:
+                return
+            if simulated >= c.max_candidates_per_poll:
+                return
+            if occ >= c.busy_threshold and any_idle:
+                continue
+            last = self._last_disrupted.get(key)
+            if last is not None and (
+                now - last < c.min_disruption_interval_seconds
+            ):
+                self.deferred += 1
+                self._inc("repacker_disruption_budget_deferred_total")
+                continue
+            simulated += 1
+            if self._improves(claim, snapshot, alloc, classes, slices):
+                self._begin(claim, frag["frag_score"])
+
+    def _improves(
+        self,
+        claim: dict,
+        snapshot: List[dict],
+        base: Allocator,
+        classes: List[dict],
+        slices: Optional[List[dict]],
+    ) -> bool:
+        """Exact what-if: re-allocate ``claim`` with everything else in
+        place; accept only a move that strictly reduces stranding over
+        the affected pools (source + destination). ``classes``/
+        ``slices`` are the plan pass's one-fetch inputs (see
+        _maybe_plan)."""
+        uid_key = id(claim)
+        others = [c for c in snapshot if id(c) != uid_key]
+        sim = self._build_allocator(others, classes, slices)
+        try:
+            res = sim.allocate(claim)
+        except Unschedulable:
+            return False
+        old_keys = _alloc_keys(claim)
+        new_keys = {
+            (r["driver"], r["pool"], r["device"])
+            for r in res.allocation["devices"]["results"]
+        }
+        if new_keys == old_keys:
+            return False
+        affected = {(k[0], k[1]) for k in old_keys | new_keys}
+
+        def stranding(alloc: Allocator) -> int:
+            total = 0
+            for pk in affected:
+                free, best = alloc.pool_stranding(pk)
+                total += max(0, free - best)
+            return total
+
+        # `sim` holds the post-move state (allocate leaves its takes in
+        # the ledger); `base` holds the pre-move state.
+        return stranding(sim) < stranding(base)
+
+    # --- execution --------------------------------------------------------
+
+    def _begin(self, claim: dict, frag_before: float) -> None:
+        md = claim["metadata"]
+        key = f"{md.get('namespace')}/{md['name']}"
+        from_results = (
+            ((claim.get("status") or {}).get("allocation") or {})
+            .get("devices", {}).get("results", [])
+        )
+        t_wall = self.wall_clock()
+        ann = json.dumps({
+            "phase": PHASE_PLANNED,
+            "from": from_results,
+            "t": t_wall,
+            "by": self.identity,
+        })
+
+        def set_ann(cur: dict) -> None:
+            cur["metadata"].setdefault("annotations", {})[
+                REPACK_ANNOTATION
+            ] = ann
+
+        if self._update_claim(md["name"], md.get("namespace"), set_ann) is None:
+            return  # claim vanished under us: nothing to move
+        if self.metrics is not None:
+            self.metrics.set_gauge("repacker_frag_score_before", frag_before)
+        crashpoint("repack.migrate.after_plan_persisted")
+        m = _Migration(
+            key, md["name"], md.get("namespace"), from_results,
+            self.clock(), wall_t0=t_wall,
+        )
+        self._active.append(m)  # lint: disable=R200 (single-writer, same contract as recover)
+        log.info("repack: planned migration of %s", key)
+
+    def _advance(self, m: _Migration) -> None:
+        if m.phase == PHASE_PLANNED:
+            self.serving.begin_drain(m.key)
+            m.phase = "draining"
+        if m.phase == "draining":
+            if not self.serving.drain_done(m.key):
+                if self.clock() - m.t0 > self.config.drain_timeout_seconds:
+                    self._rollback(m, "drain timeout")
+                return
+            m.requeued = self.serving.finish_drain(m.key)
+            if self._write_phase(m, PHASE_EVACUATED) is None:
+                self._rollback(m, "claim vanished during drain")
+                return
+            m.phase = PHASE_EVACUATED
+            crashpoint("repack.migrate.after_evacuate")
+            if not self.is_leader:
+                return  # crash-safe boundary; abort handled next tick
+        if m.phase == PHASE_EVACUATED:
+            cur = self.claims.try_get(m.name, m.namespace)
+            if cur is None:
+                self._forget(m)
+                return
+            if self.unprepare_hook is not None:
+                self.unprepare_hook(cur)
+
+            def release(c: dict) -> None:
+                self._set_phase_ann(c, PHASE_RELEASED, m)
+                (c.get("status") or {}).pop("allocation", None)
+
+            if self._update_claim(m.name, m.namespace, release) is None:
+                self._forget(m)
+                return
+            m.phase = PHASE_RELEASED
+            crashpoint("repack.migrate.between_unprepare_prepare")
+            if not self.is_leader:
+                return
+        if m.phase == PHASE_RELEASED:
+            self._reallocate_and_commit(m)
+
+    def _reallocate_and_commit(self, m: _Migration) -> None:
+        cur = self.claims.try_get(m.name, m.namespace)
+        if cur is None:
+            self._forget(m)
+            return
+        if (cur.get("status") or {}).get("allocation"):
+            # A stale-plan takeover (or our own crashed commit) already
+            # allocated it: the move is complete from the claim's view.
+            self._drop_annotation(m.name, m.namespace)
+            self.serving.rebind(m.key, cur)
+            self._complete(m)
+            return
+        snapshot = self.claims.list()
+        alloc = self._allocator(snapshot)
+        try:
+            res = alloc.allocate(cur)
+        except Unschedulable:
+            self._restore_or_yield(m, cur)
+            return
+        if self.prepare_hook is not None:
+            self.prepare_hook(cur, res.allocation)
+        crashpoint("repack.migrate.before_commit")
+
+        def commit(c: dict) -> None:
+            c.setdefault("status", {})["allocation"] = res.allocation
+            anns = c["metadata"].get("annotations") or {}
+            anns.pop(REPACK_ANNOTATION, None)
+            c["metadata"]["annotations"] = anns
+
+        committed = self._update_claim(m.name, m.namespace, commit)
+        if committed is None:
+            self._forget(m)
+            return
+        if self._lost_capacity_race(committed):
+            # Another solve claimed (some of) our devices between our
+            # snapshot and our commit. We are the yielding writer:
+            # release again and retry against the next snapshot.
+            m.attempts += 1
+            self._inc("repacker_commit_races_total")
+            if m.attempts >= self.config.max_commit_attempts:
+                self._restore_or_yield(m, committed)
+                return
+
+            def re_release(c: dict) -> None:
+                self._set_phase_ann(c, PHASE_RELEASED, m)
+                (c.get("status") or {}).pop("allocation", None)
+
+            if self._update_claim(m.name, m.namespace, re_release) is None:
+                self._forget(m)
+            return
+        self.serving.rebind(m.key, committed)
+        if self.metrics is not None:
+            frag_after = self._frag(self._allocator(self.claims.list()))
+            self.metrics.set_gauge(
+                "repacker_frag_score_after", frag_after["frag_score"]
+            )
+            self.metrics.set_gauge(
+                "repacker_frag_score", frag_after["frag_score"]
+            )
+        self._complete(m)
+        log.info(
+            "repack: migrated %s -> %s",
+            m.key,
+            [r["device"] for r in res.allocation["devices"]["results"]],
+        )
+
+    def _lost_capacity_race(self, committed: dict) -> bool:
+        """Did another solve claim (part of) our placement between our
+        snapshot and our commit? Counter-aware through the real ledger,
+        not a bare device-key intersection: an OVERLAPPING sub-slice
+        placed by the racing solve shares none of our keys but consumes
+        our chips' counters — exactly the double-assignment the verify
+        exists to catch."""
+        my_key = (
+            f"{committed['metadata'].get('namespace')}/"
+            f"{committed['metadata']['name']}"
+        )
+        others = [
+            c for c in self.claims.list()
+            if f"{c['metadata'].get('namespace')}/"
+            f"{c['metadata']['name']}" != my_key
+        ]
+        alloc = self._allocator(others)
+        for k in _alloc_keys(committed):
+            dev = alloc.catalog.by_key.get(k)
+            if (
+                dev is None
+                or k in alloc.in_use
+                or not alloc.ledger.can_consume(dev)
+            ):
+                return True
+            alloc.ledger.consume(dev)
+            alloc.in_use.add(k)
+        return False
+
+    def _restore_or_yield(self, m: _Migration, cur: dict) -> None:
+        """No packed placement exists (or the commit race burned its
+        retries): put the claim back where it was; if even THAT spot is
+        gone, yield the pending claim to the scheduler (annotation
+        dropped => the next batch solve owns it)."""
+        if m.from_results:
+            snapshot = [
+                c for c in self.claims.list()
+                if f"{c['metadata'].get('namespace')}/"
+                f"{c['metadata']['name']}" != m.key
+            ]
+            # Counter-aware feasibility through the real ledger (a bare
+            # device-key check would miss an OVERLAPPING placement — a
+            # 1x1 that moved onto one of the 2x2's chips shares no key
+            # but consumes its counters, and restoring on top of it
+            # would double-assign silicon).
+            alloc = self._allocator(snapshot)
+            old_keys = {
+                (r.get("driver", ""), r.get("pool", ""), r.get("device", ""))
+                for r in m.from_results
+            }
+            feasible = True
+            for k in old_keys:
+                dev = alloc.catalog.by_key.get(k)
+                if (
+                    dev is None
+                    or k in alloc.in_use
+                    or not alloc.ledger.can_consume(dev)
+                ):
+                    feasible = False
+                    break
+                alloc.ledger.consume(dev)  # multi-device claims compose
+            if feasible:
+                def restore(c: dict) -> None:
+                    c.setdefault("status", {})["allocation"] = {
+                        "devices": {"results": list(m.from_results)}
+                    }
+                    anns = c["metadata"].get("annotations") or {}
+                    anns.pop(REPACK_ANNOTATION, None)
+                    c["metadata"]["annotations"] = anns
+
+                restored = self._update_claim(m.name, m.namespace, restore)
+                if restored is not None:
+                    self.serving.rebind(m.key, restored)
+                    self._abort_done(m, "no better placement; restored")
+                    return
+        self._drop_annotation(m.name, m.namespace)
+        self._abort_done(m, "yielded to the scheduler")
+
+    # --- rollback / abort -------------------------------------------------
+
+    def _abort_all(self, why: str) -> None:
+        for m in list(self._active):
+            if m.phase in (PHASE_PLANNED, "draining", PHASE_EVACUATED):
+                # Old placement still committed: full rollback.
+                self._rollback(m, why)
+            else:
+                # Past the point of no return: the WAL'd half-move is
+                # the next leader's recover() to roll forward; locally
+                # just stop executing. NOT serving.abort(): that would
+                # un-quiesce a replica whose placement was already
+                # released/unprepared — it must not serve until a
+                # rebind binds it to a committed claim. The drained
+                # sequences were already requeued at the evacuated
+                # boundary, so no tenant is stranded.
+                self._abort_done(m, why)
+
+    def _rollback(self, m: _Migration, why: str) -> None:
+        self._drop_annotation(m.name, m.namespace)
+        self.serving.abort(m.key)
+        self._abort_done(m, why)
+
+    def _abort_done(self, m: _Migration, why: str) -> None:
+        self._forget(m)
+        self.aborted += 1
+        self._inc("repacker_migrations_aborted_total")
+        self._last_disrupted[m.key] = self.clock()  # lint: disable=R200 (single-writer, same contract as recover)
+        log.warning("repack: migration of %s aborted: %s", m.key, why)
+
+    def _complete(self, m: _Migration) -> None:
+        self._forget(m)
+        self.migrations += 1
+        self._inc("repacker_migrations_total")
+        self._last_disrupted[m.key] = self.clock()  # lint: disable=R200 (single-writer, same contract as recover)
+
+    def _forget(self, m: _Migration) -> None:
+        self._active = [x for x in self._active if x is not m]  # lint: disable=R200 (single-writer, same contract as recover)
+
+    # --- claim-write helpers ----------------------------------------------
+
+    def _set_phase_ann(
+        self, claim: dict, phase: str, m: Optional[_Migration] = None
+    ) -> None:
+        """Rewrite the WAL annotation to ``phase``. When the claim
+        carries no annotation (the commit just atomically removed it
+        and a lost race is re-releasing), the state is rebuilt from the
+        migration record — the original ``from`` placement and wall
+        stamp must survive, or a crashed retry loses its rollback
+        target and each retry silently extends repacker ownership."""
+        st = repack_state(claim)
+        if st is None:
+            st = (
+                {"from": m.from_results, "t": m.wall_t0}
+                if m is not None else {"t": self.wall_clock()}
+            )
+        st["phase"] = phase
+        st.setdefault("t", self.wall_clock())
+        st["by"] = self.identity
+        claim["metadata"].setdefault("annotations", {})[
+            REPACK_ANNOTATION
+        ] = json.dumps(st)
+
+    def _write_phase(self, m: _Migration, phase: str) -> Optional[dict]:
+        return self._update_claim(
+            m.name, m.namespace, lambda c: self._set_phase_ann(c, phase, m)
+        )
+
+    def _drop_annotation(self, name: str, namespace: Optional[str]) -> None:
+        def drop(c: dict) -> None:
+            anns = c["metadata"].get("annotations") or {}
+            anns.pop(REPACK_ANNOTATION, None)
+            c["metadata"]["annotations"] = anns
+
+        self._update_claim(name, namespace, drop)
+
+    def _update_claim(
+        self, name: str, namespace: Optional[str], mutate
+    ) -> Optional[dict]:
+        """Read-mutate-update with conflict retry. A full update writes
+        metadata AND status in one apiserver transaction (the fake/
+        fakeserver PUT semantics), which is what makes the
+        released-phase transition atomic: the WAL phase and the
+        allocation it describes can never be observed out of step.
+        Returns the stored object, or None when the claim is gone."""
+        for _ in range(8):
+            cur = self.claims.try_get(name, namespace)
+            if cur is None:
+                return None
+            mutate(cur)
+            try:
+                return self.claims.update(cur)
+            except ApiConflict:
+                continue
+            except ApiNotFound:
+                return None
+        raise ApiConflict(
+            f"repack: claim {namespace}/{name} update lost the race 8 "
+            f"times in a row"
+        )
+
+    # --- observability ----------------------------------------------------
+
+    def _inc(self, name: str, value: float = 1.0) -> None:
+        if self.metrics is not None:
+            self.metrics.inc(name, value)
+
+    def _export(self) -> None:
+        if self.metrics is None:
+            return
+        m = self.metrics
+        m.set_gauge("repacker_leader", 1.0 if self.is_leader else 0.0)
+        m.set_gauge("repacker_active_migrations", float(len(self._active)))
+        oldest = 0.0
+        if self._active:
+            now = self.clock()
+            oldest = max(now - x.t0 for x in self._active)
+        m.set_gauge("repacker_oldest_migration_seconds", oldest)

@@ -211,8 +211,16 @@ class ClaimAutoscaler:
     # --- scale-down: quiesce -> evacuate -> requeue -> DELETE claim ---
 
     def _victim(self) -> Optional[Replica]:
-        live = self.router.live_replicas()
-        if len(live) <= self.config.min_replicas:
+        # A replica mid-repack is NOT a scale-down candidate (ISSUE 12):
+        # the repacker is moving its claim, not retiring it — deleting
+        # the claim under the mover would strand the half-move. The
+        # replica count still includes it (its claim still serves).
+        live = [
+            r for r in self.router.live_replicas() if not r.migrating
+        ]
+        if len(self.router.live_replicas()) <= self.config.min_replicas:
+            return None
+        if not live:
             return None
         # Least in-flight work moves the least state; claim-less
         # replicas (bootstrap) are never preferred over claim-backed

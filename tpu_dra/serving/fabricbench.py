@@ -245,10 +245,14 @@ class Fabric:
         trace,
         autoscale: bool = False,
         timeout: float = 600.0,
+        extra_tick=None,
     ) -> dict:
         """Replay the trace open-loop (arrivals on the wall clock) on
         the control thread: submit due arrivals, poll the router, tick
-        the autoscaler, until drained."""
+        the autoscaler, until drained. ``extra_tick`` (optional) runs
+        once per loop pass on the SAME control thread — the repack
+        bench rides the repacker's tick() through it (ISSUE 12), per
+        the router's threading contract."""
         i = 0
         submitted = 0
         rejected = 0
@@ -271,6 +275,8 @@ class Fabric:
                     )
             if autoscale:
                 self.autoscaler.tick()
+            if extra_tick is not None:
+                extra_tick()
             scaling = (
                 self.autoscaler._pending_claim is not None
                 or self.autoscaler._draining is not None

@@ -180,6 +180,11 @@ class Replica:
         self.claim_name = claim_name
         self.claim = claim
         self.quiesced = False  # router stops dispatching; engine drains
+        # Mid-repack (ISSUE 12): the repacker owns this replica's fate;
+        # the autoscaler must not pick it as a scale-down victim (the
+        # claim is being MOVED, not retired — deleting it would turn a
+        # defrag into an outage).
+        self.migrating = False
         self.error: Optional[BaseException] = None  # engine-thread death
         self.outbox: Deque[Completion] = collections.deque()
         self.inflight: Dict[str, _FabricReq] = {}  # router-thread-owned

@@ -133,6 +133,23 @@ FABRIC_LAG_WARN_TOKENS = 1024.0
 FABRIC_FLAP_COUNTER = "fabric_autoscaler_flaps_total"
 FABRIC_REPLICAS_GAUGE = "fabric_replicas"
 
+# Elastic-repacker gauges (ISSUE 12), suffix-matched like the others.
+# repacker_frag_score is the fleet fragmentation the repacker itself
+# last observed; repacker_leader says whether this instance holds the
+# Lease; repacker_active_migrations / repacker_oldest_migration_seconds
+# describe in-flight moves; repacker_migrations_total counts completed
+# ones. The two failure shapes the doctor catches: fragmentation HIGH
+# while the repacker sits idle (not leading, or mis-thresholded — free
+# capacity stays stranded and large claims go Unschedulable), and a
+# migration stuck past its budget window (a wedged drain or an
+# unschedulable re-allocation holding a tenant in limbo).
+REPACKER_FRAG_GAUGE = "repacker_frag_score"
+REPACKER_LEADER_GAUGE = "repacker_leader"
+REPACKER_ACTIVE_GAUGE = "repacker_active_migrations"
+REPACKER_OLDEST_GAUGE = "repacker_oldest_migration_seconds"
+REPACKER_MIGRATIONS_COUNTER = "repacker_migrations_total"
+REPACKER_STUCK_WARN_SECONDS = 60.0
+
 # Decode-roofline trend gate (ISSUE 8): the key bench.py records as the
 # gap between the measured decode step and the bf16 HBM floor. Matched
 # by SUFFIX inside the artifact (like the scheduler/engine gauges): the
@@ -243,7 +260,88 @@ def probe_metrics(
         fabric = _check_fabric(ep, first, second, warn)
         if fabric:
             report[ep]["fabric"] = fabric
+        repacker = _check_repacker(ep, first, second, warn)
+        if repacker:
+            report[ep]["repacker"] = repacker
     return report
+
+
+def _check_repacker(
+    ep: str, first: Dict[str, float], second: Optional[Dict[str, float]],
+    warn,
+) -> Dict[str, object]:
+    """Surface the elastic repacker's health (ISSUE 12). Two WARN
+    shapes: (a) fragmentation high while the repacker is IDLE — not
+    holding the Lease, or configured so it never acts (with two samples
+    an idle verdict also requires migrations_total NOT climbing, so a
+    repacker mid-burst stays quiet); (b) a migration stuck past the
+    budget window — the WAL'd move is holding a tenant in limbo. Empty
+    dict when the endpoint exports no repacker series."""
+    out: Dict[str, object] = {}
+    sample = second if second is not None else first
+    migrations_series = None
+    for series, value in sorted(sample.items()):
+        name = series.split("{", 1)[0]
+        if name.endswith(REPACKER_FRAG_GAUGE):
+            out["frag_score"] = value
+        elif name.endswith(REPACKER_LEADER_GAUGE):
+            out["leader"] = bool(value)
+        elif name.endswith(REPACKER_ACTIVE_GAUGE):
+            out["active"] = int(value)
+        elif name.endswith(REPACKER_OLDEST_GAUGE):
+            out["oldest_migration_s"] = value
+        elif name.endswith(REPACKER_MIGRATIONS_COUNTER):
+            out["migrations"] = int(value)
+            migrations_series = series
+    if not out:
+        return out
+    frag = out.get("frag_score", 0.0)
+    if frag > FRAG_WARN_THRESHOLD:
+        if not out.get("leader", False):
+            warn(
+                f"{ep}: fleet fragmentation is {frag:g} and this "
+                f"repacker is NOT LEADING — if no other instance holds "
+                f"the Lease, stranded capacity stays stranded and large "
+                f"claims go Unschedulable. Check the repacker Lease "
+                f"(holder, renewTime) and that leader election is "
+                f"enabled/healthy (docs/scheduling.md, 'Autonomous "
+                f"repacking')"
+            )
+        elif out.get("active", 0) == 0:
+            climbed = None
+            if second is not None and migrations_series is not None:
+                climbed = sample.get(migrations_series, 0.0) - first.get(
+                    migrations_series, 0.0
+                )
+            if climbed is None or climbed <= 0:
+                warn(
+                    f"{ep}: fleet fragmentation is {frag:g} but the "
+                    f"repacker is IDLE (leading, no active migrations"
+                    + (
+                        ", migrations_total flat over the probe interval"
+                        if second is not None else ""
+                    )
+                    + ") — likely misconfigured: frag_threshold above "
+                    "the live score, every candidate deferred by the "
+                    "disruption budget, or no move improves the score "
+                    "(check repacker_disruption_budget_deferred_total "
+                    "and the planner log; docs/scheduling.md, "
+                    "'Autonomous repacking')"
+                )
+    oldest = out.get("oldest_migration_s", 0.0)
+    if oldest > REPACKER_STUCK_WARN_SECONDS:
+        warn(
+            f"{ep}: a repack migration has been in flight for "
+            f"{oldest:g}s — past the disruption-budget window; its "
+            f"tenant may be drained and waiting. Check whether the "
+            f"victim engine's drain is wedged (engine_admission_stalled "
+            f"on the serving endpoint), whether the re-allocation is "
+            f"Unschedulable (scheduler events for the claim), and the "
+            f"claim's repack.tpu.google.com/state annotation phase — "
+            f"recovery rolls a stale plan back/forward on the next "
+            f"leader (docs/scheduling.md, 'Autonomous repacking')"
+        )
+    return out
 
 
 def _check_workqueue(
@@ -885,6 +983,20 @@ def render(report: dict) -> str:
                 )
                 parts.append(f"lag{tenant}={st['lag']:g}{grew}")
             lines.append(f"  fabric: {' '.join(parts)}")
+        rep = m.get("repacker") or {}
+        if rep:
+            parts = []
+            if "leader" in rep:
+                parts.append(f"leader={1 if rep['leader'] else 0}")
+            if "active" in rep:
+                parts.append(f"active={rep['active']}")
+            if "migrations" in rep:
+                parts.append(f"migrations={rep['migrations']}")
+            if "frag_score" in rep:
+                parts.append(f"frag={rep['frag_score']:g}")
+            if rep.get("oldest_migration_s", 0.0) > 0:
+                parts.append(f"oldest={rep['oldest_migration_s']:g}s")
+            lines.append(f"  repacker: {' '.join(parts)}")
         wq = m.get("workqueue") or {}
         if wq:
             parts = []
