@@ -3,7 +3,7 @@
 IMAGE_REPO ?= registry.local/tpu-dra-driver
 IMAGE_TAG  ?= v0.1.0
 
-.PHONY: all native test test-slow bench decodebench allocbench enginebench shardbench fleetbench fabricbench repackbench image bats lint lint-fast shlint chaos crashmatrix apisoak ci clean
+.PHONY: all native test test-slow bench decodebench allocbench enginebench shardbench fleetbench fabricbench repackbench tracecheck image bats lint lint-fast shlint chaos crashmatrix apisoak ci clean
 
 all: native test
 
@@ -91,6 +91,18 @@ fabricbench:
 # BENCH_r*.json (docs/scheduling.md, "Autonomous repacking").
 repackbench:
 	python -m tpu_dra.serving.repackbench --smoke
+
+# Claim-lifecycle tracing smoke (ISSUE 13): a tiny fleet through the
+# real scheduler + publisher + kubelet analog, a stub-silicon plugin
+# prepare, and a stub-engine fabric round trip — hard asserts that
+# every registered lifecycle span fires and parents as the SPAN_NAMES
+# taxonomy declares, that a claim's kubelet prepare stitches into its
+# scheduler trace VIA the ctx annotation, and that the Chrome/Perfetto
+# export is schema-valid trace_event JSON. The T900 lint keeps the
+# span-name table honest statically; this keeps it honest dynamically
+# (docs/observability.md).
+tracecheck:
+	python -m tpu_dra.tools.tracecheck
 
 # Mesh-sharded decode CPU smoke (ISSUE 8): the (batch x model) decode
 # mesh degrades gracefully ((1,1) on one chip), the sharding rules
@@ -187,7 +199,7 @@ shlint:
 # (flakes surface in CI, not in the judge's rerun), the 13 bats suites
 # executed against the minicluster, the batsless process-level e2e, and
 # the bench artifact schema gate.
-ci: lint lint-fast shlint native chaos crashmatrix apisoak decodebench allocbench enginebench shardbench fleetbench fabricbench repackbench
+ci: lint lint-fast shlint native chaos crashmatrix apisoak decodebench allocbench enginebench shardbench fleetbench fabricbench repackbench tracecheck
 	python -m pytest tests/ -q -m 'not slow'
 	python -m pytest tests/ -q -m 'not slow'
 	python -m pytest tests/test_chaos.py -q -m slow

@@ -1979,6 +1979,13 @@ def main() -> int:
                 "fleet_scoped_informer_max_objects": fleetrep[
                     "fleet_scoped_informer_max_objects"
                 ],
+                # Claim-lifecycle tracing overhead (ISSUE 13): traced
+                # vs TPU_DRA_TRACE=0 claim-ready p99 on the identical
+                # seeded trace — the fleetbench gate that keeps
+                # tracing-on near-free (<5% at the full-leg scale).
+                "fleet_trace_overhead_pct": fleetrep[
+                    "fleet_trace_overhead_pct"
+                ],
                 # Serving-fabric leg (ISSUE 11): the multi-tenant
                 # router + claim-driven autoscaler over the synthetic
                 # fleet — submitted->first-token SLO at 10k+ concurrent

@@ -101,6 +101,12 @@ TYPED_WHEN_PRESENT = {
     "fleet_publish_writes": int,
     "fleet_baseline_publish_writes": int,
     "fleet_scoped_informer_max_objects": int,
+    # Claim-lifecycle tracing (ISSUE 13): traced vs TPU_DRA_TRACE=0
+    # claim-ready p99 overhead (percent; may be negative — noise on a
+    # quiet machine) and the untraced reference p99. The B100 pass
+    # forward-requires fleet_trace_overhead_pct.
+    "fleet_trace_overhead_pct": (int, float),
+    "fleet_untraced_claim_ready_p99_ms": (int, float),
     # Serving-fabric leg (ISSUE 11): submitted -> first-token SLO over
     # the engine-replica fleet, per-tenant fairness, and the
     # claim-driven autoscaler record. The B100 pass forward-requires

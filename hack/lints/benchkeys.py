@@ -75,6 +75,11 @@ REQUIRED_STATIC = (
     "repack_frag_after",
     "repack_migrations",
     "repack_tok_s_gain",
+    # Claim-lifecycle tracing (ISSUE 13): the traced-vs-untraced
+    # claim-ready p99 overhead on the identical seeded fleet trace —
+    # dropping it would blind the tracing-is-free gate before its
+    # first recorded artifact.
+    "fleet_trace_overhead_pct",
 )
 
 

@@ -36,6 +36,7 @@ from lints import gates       # noqa: F401
 from lints import layering    # noqa: F401
 from lints import asyncblock  # noqa: F401
 from lints import crashpoints  # noqa: F401
+from lints import spannames   # noqa: F401
 from lints import sleeps      # noqa: F401
 from lints import chaosjson   # noqa: F401
 from lints import benchkeys   # noqa: F401

@@ -1,0 +1,185 @@
+"""T900-T902 — span-name registry discipline.
+
+The tracing core (tpu_dra/infra/trace.py) stitches claim/request
+timelines out of named spans; `make tracecheck` proves the lifecycle
+set fires and parents, and `doctor explain` buckets stage budgets by
+span name. Both are only as strong as the bijection between the
+canonical ``SPAN_NAMES`` table and the ``span("...")`` /
+``record_span("...")`` call sites threaded through the driver (the
+C700 crash-point discipline, applied to spans):
+
+- **T900** — a call site whose name is not a single string literal, is
+  not dotted-namespaced (``component.entity.stage``: at least three
+  lowercase dot-separated segments), or is missing from the canonical
+  table. A computed name can't be audited, and an unregistered one
+  would never be asserted by the tracecheck smoke or documented in the
+  taxonomy table.
+- **T901** — the same name minted at more than one call site: a span
+  name must mean ONE stage, or a stage-budget line in `doctor explain`
+  aggregates unrelated code paths under one label. Single-mint helpers
+  (the repacker's ``_migration_span``) are the sanctioned pattern for
+  a name needed from several flows.
+- **T902** — a table entry with no call site anywhere in ``tpu_dra``:
+  a span that fell out of the code during a refactor leaves the
+  taxonomy documenting (and tracecheck asserting) a stage that no
+  longer exists.
+
+Project scope like C700: the pass sees the full discovery set so a
+changed-only run can't lose call sites in unchanged files. Tests/hack/
+demo are exempt from call-site collection — they open ad-hoc spans to
+drive the machinery, they don't define lifecycle stages.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from lints.base import FileContext, Finding, add_finding, dotted_name
+from lints.registry import register
+
+_NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+){2,}$")
+
+# The defining module: its own references to the table are not call
+# sites (and its internal span plumbing is exempt by construction).
+_REGISTRY_REL = "tpu_dra/infra/trace.py"
+
+_CALLEES = ("span", "record_span")
+
+
+def _call_sites(tree: ast.Module) -> List[Tuple[int, object]]:
+    """(lineno, name-or-None) for every ``span(...)``/``record_span(...)``
+    call; name is the literal string when the first positional arg is a
+    constant str (keyword args — attrs/ctx/root — are fine)."""
+    out: List[Tuple[int, object]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted_name(node.func)
+        leaf = callee.rsplit(".", 1)[-1]
+        if leaf not in _CALLEES:
+            continue
+        name = None
+        if (
+            node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            name = node.args[0].value
+        out.append((node.lineno, name))
+    return out
+
+
+@register
+class SpanNamePass:
+    name = "T900"
+    codes = ("T900", "T901", "T902")
+    scope = "project"
+
+    def _registry(self, repo_root: Path) -> Optional[Dict[str, object]]:
+        """AST-parse ``SPAN_NAMES`` out of the LINTED TREE's trace
+        module (the C700 rationale: importing would lint whatever
+        tpu_dra is on sys.path, not the tree under lint)."""
+        path = repo_root / _REGISTRY_REL
+        try:
+            tree = ast.parse(path.read_text(encoding="utf-8"))
+        except (OSError, SyntaxError):
+            return None
+        for node in ast.walk(tree):
+            if isinstance(node, ast.AnnAssign):
+                targets, value = [node.target], node.value
+            elif isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            else:
+                continue
+            if not any(
+                isinstance(t, ast.Name) and t.id == "SPAN_NAMES"
+                for t in targets
+            ) or not isinstance(value, ast.Dict):
+                continue
+            out: Dict[str, object] = {}
+            for k in value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    out[k.value] = True
+            return out
+        return None
+
+    def run_project(self, ctxs: List[FileContext],
+                    extra_paths=()) -> List[Finding]:
+        out: List[Finding] = []
+        if not ctxs:
+            return out
+        repo_root = ctxs[0].repo_root
+        registry = self._registry(repo_root) or {}
+
+        by_path = {str(c.path): c for c in ctxs}
+        seen: Dict[str, List[Tuple[FileContext, int]]] = {}
+        contexts = dict(by_path)
+        for path in extra_paths:
+            if str(path) not in contexts:
+                contexts[str(path)] = FileContext(Path(path), repo_root)
+        for ctx in contexts.values():
+            rel = ctx.rel_path
+            if ctx.tree is None or not rel.startswith("tpu_dra/"):
+                continue
+            if rel == _REGISTRY_REL:
+                continue
+            for lineno, sname in _call_sites(ctx.tree):
+                reportable = str(ctx.path) in by_path
+                if sname is None:
+                    if reportable:
+                        add_finding(
+                            out, ctx, lineno, "T900",
+                            "span()/record_span() name must be a single "
+                            "string literal (a computed name can't be "
+                            "audited against SPAN_NAMES)",
+                        )
+                    continue
+                if not _NAME_RE.match(sname):
+                    if reportable:
+                        add_finding(
+                            out, ctx, lineno, "T900",
+                            f"span name {sname!r} is not dotted-"
+                            f"namespaced (component.entity.stage, "
+                            f"lowercase)",
+                        )
+                    continue
+                if sname not in registry:
+                    if reportable:
+                        add_finding(
+                            out, ctx, lineno, "T900",
+                            f"span name {sname!r} is not registered in "
+                            f"the canonical table "
+                            f"({_REGISTRY_REL} SPAN_NAMES)",
+                        )
+                    continue
+                seen.setdefault(sname, []).append((ctx, lineno))
+
+        for sname, sites in sorted(seen.items()):
+            if len(sites) < 2:
+                continue
+            where = ", ".join(f"{c.rel_path}:{ln}" for c, ln in sites)
+            for ctx, lineno in sites:
+                if str(ctx.path) in by_path:
+                    add_finding(
+                        out, ctx, lineno, "T901",
+                        f"span name {sname!r} is minted at {len(sites)} "
+                        f"call sites ({where}); each name must mean one "
+                        f"stage (route shared names through a single-"
+                        f"mint helper)",
+                    )
+
+        registry_ctx = next(
+            (c for c in ctxs if c.rel_path == _REGISTRY_REL), None
+        )
+        if registry_ctx is not None:
+            for sname in sorted(set(registry) - set(seen)):
+                out.append(Finding(
+                    registry_ctx.path, 0, "T902",
+                    f"registered span name {sname!r} has no span()/"
+                    f"record_span() call site under tpu_dra/ — the "
+                    f"taxonomy documents a stage that no longer exists",
+                ))
+        return out

@@ -28,3 +28,104 @@ def make_claim(devices=("tpu-0",), configs=None, uid=None, request="req0"):
             }
         },
     }
+
+
+# --- elastic-repacker harness (shared by test_repacker + test_trace) ---------
+
+REPACK_NS = "default"
+
+
+def make_repack_cluster(nodes=2):
+    """A small published fleet (classes + per-node slices) on a fresh
+    FakeCluster — the repacker drills' starting state."""
+    import json
+
+    from tpu_dra.k8sclient import (
+        DEVICE_CLASSES, RESOURCE_SLICES, FakeCluster, ResourceClient,
+    )
+    from tpu_dra.scheduler import fleet
+
+    cluster = FakeCluster()
+    classes = ResourceClient(cluster, DEVICE_CLASSES)
+    for c in fleet.CLASSES:
+        classes.create(json.loads(json.dumps(c)))
+    slices = ResourceClient(cluster, RESOURCE_SLICES)
+    for i in range(nodes):
+        slices.create(fleet.make_node_slice(i))
+    return cluster
+
+
+def place_claim(cluster, i, node_idx, dev, shape="1x1x1"):
+    """Create claim i allocated to one named sub-slice device — precise
+    placement control the scheduler's packer would refuse to produce."""
+    from tpu_dra.k8sclient import RESOURCE_CLAIMS, ResourceClient
+    from tpu_dra.scheduler import fleet
+
+    claims = ResourceClient(cluster, RESOURCE_CLAIMS)
+    c = fleet.make_claim(i, shape)
+    c["metadata"]["namespace"] = REPACK_NS
+    c["status"] = {"allocation": {"devices": {"results": [{
+        "request": "tpu", "driver": fleet.DRIVER,
+        "pool": fleet.node_name(node_idx), "device": dev,
+    }]}}}
+    claims.create(c)
+    claims.update_status(c)
+    return c["metadata"]["name"]
+
+
+def spread_two_residents(cluster):
+    """One 1x1 resident per node: 6 free chips, no 2x2 reachable —
+    frag 1 - 4/6. The canonical improvable state."""
+    a = place_claim(cluster, 0, 0, "ss-1x1x1-0-0-0")
+    b = place_claim(cluster, 1, 1, "ss-1x1x1-0-0-0")
+    return a, b
+
+
+def get_claim(cluster, name):
+    from tpu_dra.k8sclient import RESOURCE_CLAIMS, ResourceClient
+
+    return ResourceClient(cluster, RESOURCE_CLAIMS).try_get(
+        name, REPACK_NS
+    )
+
+
+class RecordingRepackAdapter:
+    """ServingAdapter stand-in that records the drain/rebind protocol."""
+
+    def __init__(self, drain_ready=True):
+        self.drain_ready = drain_ready
+        self.calls = []
+
+    def begin_drain(self, key):
+        self.calls.append(("begin_drain", key))
+
+    def drain_done(self, key):
+        return self.drain_ready
+
+    def finish_drain(self, key):
+        self.calls.append(("finish_drain", key))
+        return 1
+
+    def rebind(self, key, claim):
+        self.calls.append(("rebind", key))
+
+    def abort(self, key):
+        self.calls.append(("abort", key))
+
+
+def make_repacker(cluster, adapter=None, clock=None, metrics=None, **cfg):
+    import time as _time
+
+    from tpu_dra.infra.metrics import Metrics
+    from tpu_dra.scheduler.repacker import Repacker, RepackerConfig
+
+    defaults = dict(
+        poll_period=0.0, frag_threshold=0.05,
+        min_disruption_interval_seconds=0.0,
+    )
+    defaults.update(cfg)
+    return Repacker(
+        cluster, RepackerConfig(**defaults),
+        serving=adapter, metrics=metrics or Metrics(),
+        clock=clock or _time.monotonic,
+    )

@@ -28,6 +28,7 @@ from lints.benchkeys import BenchSchemaPass  # noqa: E402
 from lints.chaosjson import ChaosSchedulePass  # noqa: E402
 from lints.cli import main as lint_main  # noqa: E402
 from lints.crashpoints import CrashPointPass  # noqa: E402
+from lints.spannames import SpanNamePass  # noqa: E402
 from lints.gates import GateDominancePass  # noqa: E402
 from lints.layering import LayeringPass, validate_dag  # noqa: E402
 from lints.legacy import CorePass  # noqa: E402
@@ -1580,3 +1581,124 @@ def test_cli_g400_synthetic_violation_against_real_tree(tmp_path, capsys):
     assert any(
         l.startswith(f"{caller}:5: G400 ") for l in out.out.splitlines()
     ), out.out
+
+
+# --- T900/T901/T902 span-name registry discipline -----------------------------
+
+
+# The synthetic tree's canonical table (the pass AST-parses the trace
+# module out of the linted tree, never imports the real one).
+T900_REGISTRY_SRC = """
+SPAN_NAMES = {
+    "scheduler.claim.pending": ("scheduler", "", "doc"),
+    "plugin.claim.prepare": ("plugin", "scheduler.claim.pending", "doc"),
+}
+"""
+
+
+def t900(tmp_path, rel, source):
+    write(tmp_path, "tpu_dra/infra/trace.py", T900_REGISTRY_SRC)
+    ctx = FileContext(write(tmp_path, rel, source), tmp_path)
+    return SpanNamePass().run_project([ctx], extra_paths=[ctx.path])
+
+
+def test_t900_non_literal_name(tmp_path):
+    src = """
+        from tpu_dra.infra import trace
+
+
+        def f(name):
+            with trace.span(name):
+                pass
+    """
+    out = t900(tmp_path, "tpu_dra/plugin/scratch.py", src)
+    assert [f.code for f in out] == ["T900"]
+
+
+def test_t900_not_dotted_namespaced(tmp_path):
+    src = """
+        from tpu_dra.infra import trace
+
+
+        def f():
+            trace.record_span("flatname", 0.0, 1.0)
+    """
+    out = t900(tmp_path, "tpu_dra/plugin/scratch.py", src)
+    assert [f.code for f in out] == ["T900"]
+
+
+def test_t900_unregistered_name(tmp_path):
+    src = """
+        from tpu_dra.infra import trace
+
+
+        def f():
+            with trace.span("plugin.claim.never_registered"):
+                pass
+    """
+    out = t900(tmp_path, "tpu_dra/plugin/scratch.py", src)
+    assert [f.code for f in out] == ["T900"]
+
+
+def test_t901_duplicate_call_sites(tmp_path):
+    src = """
+        from tpu_dra.infra import trace
+
+
+        def f():
+            with trace.span("plugin.claim.prepare"):
+                pass
+
+
+        def g():
+            trace.record_span("plugin.claim.prepare", 0.0, 1.0)
+    """
+    out = t900(tmp_path, "tpu_dra/plugin/scratch.py", src)
+    assert [f.code for f in out] == ["T901", "T901"]
+
+
+def test_t900_negative_unique_registered_names(tmp_path):
+    src = """
+        from tpu_dra.infra import trace
+
+
+        def f():
+            with trace.span("scheduler.claim.pending", root=True):
+                trace.record_span("plugin.claim.prepare", 0.0, 1.0)
+    """
+    assert t900(tmp_path, "tpu_dra/plugin/scratch.py", src) == []
+
+
+def test_t900_tests_tree_exempt(tmp_path):
+    src = """
+        from tpu_dra.infra import trace
+
+
+        def drive():
+            with trace.span("whatever"):
+                pass
+    """
+    assert t900(tmp_path, "tests/scratch.py", src) == []
+
+
+def test_t902_registered_span_with_no_call_site(tmp_path):
+    registry = write(tmp_path, "tpu_dra/infra/trace.py", T900_REGISTRY_SRC)
+    caller = write(tmp_path, "tpu_dra/plugin/scratch.py", (
+        "from tpu_dra.infra import trace\n"
+        "def f():\n"
+        "    with trace.span('scheduler.claim.pending'):\n"
+        "        pass\n"
+    ))
+    ctxs = [FileContext(registry, tmp_path), FileContext(caller, tmp_path)]
+    out = SpanNamePass().run_project(ctxs, extra_paths=[c.path for c in ctxs])
+    assert [f.code for f in out] == ["T902"]
+    assert "plugin.claim.prepare" in out[0].message
+
+
+def test_t900_real_tree_is_clean_and_bijective():
+    """The live tree: every SPAN_NAMES entry threaded exactly once,
+    every call site literal+registered (the taxonomy table in
+    docs/observability.md mirrors SPAN_NAMES)."""
+    files = sorted((REPO / "tpu_dra").rglob("*.py"))
+    ctxs = [FileContext(p, REPO) for p in files]
+    assert SpanNamePass().run_project(ctxs, extra_paths=[]) == []

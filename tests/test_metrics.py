@@ -80,3 +80,93 @@ def test_remove_gauges_drops_label_superset_series():
     out = m.render()
     assert 'claim="dead"' not in out
     assert out.count('claim="live"') == 3
+
+
+# --- label-value escaping (ISSUE 13 satellite) ------------------------------
+
+
+def test_render_escapes_hostile_label_values():
+    """Claim names carrying quotes/backslashes/newlines must emit VALID
+    exposition lines — one hostile label used to poison the whole
+    scrape. Round-trip: parse the rendered line back and recover the
+    original value."""
+    m = Metrics()
+    hostile = 'claim-"quoted"\\back\nslash'
+    m.set_gauge("per_claim", 1.0, labels={"claim": hostile})
+    line = next(
+        ln for ln in m.render().splitlines()
+        if ln.startswith("tpu_dra_per_claim{")
+    )
+    # A valid exposition line is one physical line: name{k="v"} value.
+    assert "\n" not in line
+    body = line.split("{", 1)[1].rsplit("}", 1)[0]
+    assert body.startswith('claim="') and body.endswith('"')
+    escaped = body[len('claim="'):-1]
+    # Unescape per the Prometheus text-format rules and recover the
+    # original hostile value exactly.
+    out, i = [], 0
+    while i < len(escaped):
+        ch = escaped[i]
+        if ch == "\\" and i + 1 < len(escaped):
+            nxt = escaped[i + 1]
+            out.append({"n": "\n", '"': '"', "\\": "\\"}.get(nxt, nxt))
+            i += 2
+        else:
+            assert ch != '"', "unescaped quote inside a label value"
+            out.append(ch)
+            i += 1
+    assert "".join(out) == hostile
+
+
+# --- cardinality guard (ISSUE 13 satellite) ---------------------------------
+
+
+def test_series_cap_refuses_unbounded_growth():
+    m = Metrics(series_cap=3)
+    for i in range(10):
+        m.set_gauge("per_claim", 1.0, labels={"claim": f"c{i}"})
+    text = m.render()
+    # Exactly the cap's worth of series exist; the overflow landed in
+    # the guard counter keyed by the offending NAME.
+    assert text.count("tpu_dra_per_claim{") == 3
+    assert (
+        m.get_counter("metrics_series_capped_total",
+                      labels={"name": "per_claim"}) == 7
+    )
+    # Existing series still update past the cap.
+    m.set_gauge("per_claim", 9.0, labels={"claim": "c0"})
+    assert m.get_gauge("per_claim", labels={"claim": "c0"}) == 9.0
+
+
+def test_series_cap_applies_to_counters_and_timings():
+    m = Metrics(series_cap=2)
+    for i in range(4):
+        m.inc("per_req", labels={"rid": f"r{i}"})
+        m.observe("per_req_seconds", 0.01, labels={"rid": f"r{i}"})
+    assert (
+        m.get_counter("metrics_series_capped_total",
+                      labels={"name": "per_req"}) == 2
+    )
+    assert (
+        m.get_counter("metrics_series_capped_total",
+                      labels={"name": "per_req_seconds"}) == 2
+    )
+
+
+def test_series_cap_frees_slots_on_gauge_removal():
+    """remove_gauge/remove_gauges give their slots back: per-entity
+    cleanup (the PR-12 dead-claim series removal) keeps a churning
+    fleet under the cap forever."""
+    m = Metrics(series_cap=2)
+    m.set_gauge("per_claim", 1.0, labels={"claim": "a"})
+    m.set_gauge("per_claim", 1.0, labels={"claim": "b"})
+    m.remove_gauge("per_claim", labels={"claim": "a"})
+    m.set_gauge("per_claim", 1.0, labels={"claim": "c"})
+    assert m.get_gauge("per_claim", labels={"claim": "c"}) == 1.0
+    assert (
+        m.get_counter("metrics_series_capped_total",
+                      labels={"name": "per_claim"}) == 0
+    )
+    m.remove_gauges("per_claim", {"claim": "b"})
+    m.set_gauge("per_claim", 1.0, labels={"claim": "d"})
+    assert m.get_gauge("per_claim", labels={"claim": "d"}) == 1.0

@@ -18,7 +18,6 @@ from tpu_dra.k8sclient import (
     DEVICE_CLASSES,
     RESOURCE_CLAIMS,
     RESOURCE_SLICES,
-    FakeCluster,
     ResourceClient,
 )
 from tpu_dra.scheduler import fleet
@@ -32,53 +31,19 @@ from tpu_dra.scheduler.repacker import (
     REPACK_ANNOTATION,
     Repacker,
     RepackerConfig,
-    ServingAdapter,
     repack_owned,
     repack_state,
 )
 
-NS = "default"
-
-
-# --- harness -----------------------------------------------------------------
-
-
-def make_cluster(nodes=2):
-    cluster = FakeCluster()
-    classes = ResourceClient(cluster, DEVICE_CLASSES)
-    for c in fleet.CLASSES:
-        classes.create(json.loads(json.dumps(c)))
-    slices = ResourceClient(cluster, RESOURCE_SLICES)
-    for i in range(nodes):
-        slices.create(fleet.make_node_slice(i))
-    return cluster
-
-
-def place(cluster, i, node_idx, dev, shape="1x1x1"):
-    """Create claim i allocated to one named sub-slice device — precise
-    placement control the scheduler's packer would refuse to produce."""
-    claims = ResourceClient(cluster, RESOURCE_CLAIMS)
-    c = fleet.make_claim(i, shape)
-    c["metadata"]["namespace"] = NS
-    c["status"] = {"allocation": {"devices": {"results": [{
-        "request": "tpu", "driver": fleet.DRIVER,
-        "pool": fleet.node_name(node_idx), "device": dev,
-    }]}}}
-    claims.create(c)
-    claims.update_status(c)
-    return c["metadata"]["name"]
-
-
-def spread_two(cluster):
-    """One 1x1 resident per node: 6 free chips, no 2x2 reachable —
-    frag 1 - 4/6. The canonical improvable state."""
-    a = place(cluster, 0, 0, "ss-1x1x1-0-0-0")
-    b = place(cluster, 1, 1, "ss-1x1x1-0-0-0")
-    return a, b
-
-
-def claim_of(cluster, name):
-    return ResourceClient(cluster, RESOURCE_CLAIMS).try_get(name, NS)
+from tests.helpers import (  # noqa: E402  (shared harness)
+    REPACK_NS as NS,
+    RecordingRepackAdapter as RecordingAdapter,
+    get_claim as claim_of,
+    make_repack_cluster as make_cluster,
+    make_repacker as mk_repacker,
+    place_claim as place,
+    spread_two_residents as spread_two,
+)
 
 
 def devices_of(claim):
@@ -113,47 +78,12 @@ def assert_placements_valid(cluster):
             alloc.in_use.add(key)
 
 
-class RecordingAdapter(ServingAdapter):
-    def __init__(self, drain_ready=True):
-        self.drain_ready = drain_ready
-        self.calls = []
-
-    def begin_drain(self, key):
-        self.calls.append(("begin_drain", key))
-
-    def drain_done(self, key):
-        return self.drain_ready
-
-    def finish_drain(self, key):
-        self.calls.append(("finish_drain", key))
-        return 1
-
-    def rebind(self, key, claim):
-        self.calls.append(("rebind", key))
-
-    def abort(self, key):
-        self.calls.append(("abort", key))
-
-
 class FakeClock:
     def __init__(self):
         self.t = 1000.0
 
     def __call__(self):
         return self.t
-
-
-def mk_repacker(cluster, adapter=None, clock=None, metrics=None, **cfg):
-    defaults = dict(
-        poll_period=0.0, frag_threshold=0.05,
-        min_disruption_interval_seconds=0.0,
-    )
-    defaults.update(cfg)
-    return Repacker(
-        cluster, RepackerConfig(**defaults),
-        serving=adapter, metrics=metrics or Metrics(),
-        clock=clock or time.monotonic,
-    )
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,504 @@
+"""Claim-lifecycle tracing (ISSUE 13): the span core, the flight
+recorder, cross-process propagation via the ctx annotation, WAL/crash
+survival of the trace context, and `doctor explain` stitching.
+
+The tracecheck smoke (`make tracecheck`) drives the full lifecycle over
+the real scheduler stack; this file pins the unit contracts — above
+all that tracing OFF is a shared no-op (identity), that the repacker's
+two-phase WAL and the prepare crash matrix preserve the claim's trace
+id, and that the doctor's stage budget sums to the window.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from tpu_dra.infra import crashpoint as cp
+from tpu_dra.infra import trace
+from tpu_dra.infra.metrics import Metrics, MetricsServer
+from tpu_dra.k8sclient import RESOURCE_CLAIMS, ResourceClient
+from tpu_dra.tools import doctor
+
+from tests.helpers import (
+    REPACK_NS as NS,
+    RecordingRepackAdapter as RecordingAdapter,
+    get_claim as claim_of,
+    make_repack_cluster as make_cluster,
+    make_repacker as mk_repacker,
+    spread_two_residents as spread_two,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracing():
+    trace.set_enabled(True)
+    trace.reset_for_tests()
+    yield
+    trace.reset_for_tests()
+    cp.reset_for_tests()
+
+
+# --- enabled/disabled contract ----------------------------------------------
+
+
+def test_disabled_span_is_the_shared_noop_object():
+    """The overhead gate's structural half: with tracing off, span()
+    returns ONE shared object — no allocation, no recorder traffic
+    (identity-pinned, per the acceptance criteria)."""
+    trace.set_enabled(False)
+    s1 = trace.span("scheduler.solve.batch")
+    s2 = trace.span("scheduler.solve.pack", attrs={"x": 1})
+    assert s1 is trace.NOOP_SPAN and s2 is trace.NOOP_SPAN
+    with s1 as inner:
+        assert inner is trace.NOOP_SPAN
+        inner.event("anything")
+        inner.set_attr("k", "v")
+        assert inner.context() is None
+    trace.record_span("scheduler.claim.allocated", 0.0, 1.0)
+    assert trace.RECORDER.spans() == []
+    assert trace.new_ctx() is None
+
+
+def test_disabled_extract_returns_none():
+    trace.set_enabled(False)
+    obj = {"metadata": {"annotations": {trace.TRACE_ANNOTATION: "a:b"}}}
+    assert trace.extract(obj) is None
+
+
+# --- span mechanics ----------------------------------------------------------
+
+
+def test_ambient_parenting_and_events():
+    with trace.span("scheduler.solve.batch", root=True) as outer:
+        with trace.span("scheduler.solve.pack") as inner:
+            inner.event("mark", detail=7)
+        assert inner.trace_id == outer.trace_id
+        assert inner.parent_id == outer.span_id
+    spans = {s["name"]: s for s in trace.RECORDER.spans()}
+    assert spans["scheduler.solve.pack"]["events"][0]["name"] == "mark"
+    assert spans["scheduler.solve.pack"]["events"][0]["detail"] == 7
+    assert spans["scheduler.solve.batch"]["status"] == "ok"
+
+
+def test_exception_marks_status_and_still_records():
+    with pytest.raises(ValueError):
+        with trace.span("scheduler.solve.batch", root=True):
+            raise ValueError("boom")
+    (s,) = trace.RECORDER.spans()
+    assert s["status"] == "error: ValueError"
+
+
+def test_ctx_adoption_overrides_ambient():
+    ctx = trace.new_ctx()
+    with trace.span("scheduler.solve.batch", root=True):
+        s = trace.span("plugin.claim.prepare", ctx=ctx)
+        s.end()
+    assert s.trace_id == ctx.trace_id and s.parent_id == ctx.span_id
+
+
+def test_context_encode_decode_roundtrip_and_malformed():
+    ctx = trace.new_ctx()
+    back = trace.SpanContext.decode(ctx.encode())
+    assert (back.trace_id, back.span_id) == (ctx.trace_id, ctx.span_id)
+    for bad in ("", "nocolon", ":", "a:", ":b", None):
+        assert trace.SpanContext.decode(bad or "") is None
+
+
+def test_stamp_and_extract_on_claim_dicts():
+    claim = {"metadata": {"name": "c"}}
+    ctx = trace.new_ctx()
+    trace.stamp(claim, ctx)
+    got = trace.extract(claim)
+    assert got.trace_id == ctx.trace_id and got.span_id == ctx.span_id
+    trace.stamp(claim, None)  # no-op, never raises
+    assert trace.extract({"metadata": {}}) is None
+
+
+# --- flight recorder ---------------------------------------------------------
+
+
+def test_recorder_bounded_drop_oldest_and_counter():
+    trace.RECORDER.capacity = 4
+    metrics = Metrics()
+    trace.RECORDER.bind_metrics(metrics)
+    for i in range(7):
+        s = trace.span("scheduler.solve.batch", root=True,
+                       attrs={"i": i})
+        s.end()
+    spans = trace.RECORDER.spans()
+    assert len(spans) == 4
+    assert [s["attrs"]["i"] for s in spans] == [3, 4, 5, 6]  # oldest out
+    assert trace.RECORDER.dropped == 3
+    assert metrics.get_counter("trace_spans_dropped_total") == 3
+
+
+def test_chrome_export_and_text_timeline(tmp_path):
+    with trace.span("scheduler.claim.pending", root=True) as pend:
+        pend.event("seen")
+        with trace.span("scheduler.claim.allocated"):
+            pass
+    path = str(tmp_path / "t.json")
+    n = trace.RECORDER.export_chrome(path)
+    doc = json.loads(open(path).read())
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    instants = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+    assert len(xs) == 2 and len(instants) == 1 and n == 3
+    assert all(e["args"]["trace"] == pend.trace_id for e in xs)
+    text = trace.RECORDER.render_text(pend.trace_id)
+    assert "scheduler.claim.pending" in text
+    # The child renders nested (two-space indent under its parent).
+    assert "\n  " in text and "scheduler.claim.allocated" in text
+
+
+# --- WAL / crash survival of the trace context (satellite 3) -----------------
+
+
+def _stamp_claims(cluster, names):
+    claims = ResourceClient(cluster, RESOURCE_CLAIMS)
+    ctxs = {}
+    for name in names:
+        c = claims.try_get(name, NS)
+        ctxs[name] = trace.new_ctx()
+        trace.stamp(c, ctxs[name])
+        claims.update(c)
+    return ctxs
+
+
+def test_trace_ctx_survives_full_migration():
+    """The repacker's two-phase WAL rewrites the claim (annotations AND
+    status) at every phase; the trace ctx annotation must ride through
+    untouched, and the migration span must adopt the claim's trace id
+    with the phase transitions as events."""
+    cluster = make_cluster()
+    a, b = spread_two(cluster)
+    ctxs = _stamp_claims(cluster, (a, b))
+    rp = mk_repacker(cluster, RecordingAdapter())
+    for _ in range(8):
+        rp.tick()
+    assert rp.migrations == 1
+    for name in (a, b):
+        got = trace.extract(claim_of(cluster, name))
+        assert got is not None, f"trace ctx annotation lost on {name}"
+        assert got.trace_id == ctxs[name].trace_id
+    migrate = [
+        s for s in trace.RECORDER.spans()
+        if s["name"] == "repacker.claim.migrate"
+    ]
+    assert len(migrate) == 1
+    moved_name = migrate[0]["attrs"]["claim"].split("/", 1)[1]
+    assert migrate[0]["trace"] == ctxs[moved_name].trace_id
+    phases = [e["name"] for e in migrate[0]["events"]]
+    assert phases == [
+        "phase.planned", "phase.evacuated", "phase.released",
+        "phase.committed",
+    ]
+
+
+@pytest.mark.parametrize("point", [
+    "repack.migrate.after_plan_persisted",
+    "repack.migrate.after_evacuate",
+    "repack.migrate.between_unprepare_prepare",
+    "repack.migrate.before_commit",
+])
+def test_trace_ctx_survives_repack_crash_and_recovery(point):
+    """Kill the repacker at every WAL window, recover with a fresh
+    instance: the claim's ctx annotation is intact, the recovered
+    timeline still stitches into the SAME trace id (the recovery span
+    adopts it), and recovery rows land as span events."""
+    cluster = make_cluster()
+    a, b = spread_two(cluster)
+    ctxs = _stamp_claims(cluster, (a, b))
+    rp = mk_repacker(cluster, RecordingAdapter())
+    with cp.arm(point):
+        with pytest.raises(cp.SimulatedCrash):
+            for _ in range(8):
+                rp.tick()
+    # The dead leader's claim still carries BOTH annotations (or the
+    # repack one resolved); the trace ctx always survives.
+    for name in (a, b):
+        got = trace.extract(claim_of(cluster, name))
+        assert got is not None, (
+            f"trace ctx lost at {point} on {name}"
+        )
+        assert got.trace_id == ctxs[name].trace_id
+    # Fresh leader recovers; the recovery span must join the claim's
+    # trace and carry the recovery row as an event.
+    rp2 = mk_repacker(cluster, RecordingAdapter())
+    rp2.recover()
+    for _ in range(8):
+        rp2.tick()
+    for name in (a, b):
+        c = claim_of(cluster, name)
+        from tpu_dra.scheduler.repacker import repack_state
+        assert repack_state(c) is None, "WAL annotation not resolved"
+        assert trace.extract(c).trace_id == ctxs[name].trace_id
+    recovery = [
+        s for s in trace.RECORDER.spans()
+        if s["name"] == "repacker.claim.migrate"
+        and s["attrs"].get("recovery")
+    ]
+    assert recovery, f"no recovery span recorded after crash at {point}"
+    rec = recovery[-1]
+    moved_name = rec["attrs"]["claim"].split("/", 1)[1]
+    assert rec["trace"] == ctxs[moved_name].trace_id, (
+        "recovered timeline does not stitch into the original trace id"
+    )
+    assert any(e["name"] == "recovered" for e in rec["events"])
+
+
+@pytest.mark.parametrize("point", [
+    "plugin.prepare.after_wal_started",
+    "plugin.prepare.between_devices",
+    "plugin.prepare.before_wal_completed",
+])
+def test_prepare_crash_retry_stitches_one_trace(point, tmp_path):
+    """A kill at any prepare WAL window + the kubelet's retry: both the
+    crashed and the recovered prepare spans carry the claim's ONE trace
+    id (no orphan spans), and the crossed crash-point windows are
+    visible as events on the crashed span."""
+    from tests.test_plugin_device_state import make_state
+    from tests.helpers import make_claim
+
+    state, _ = make_state(tmp_path)
+    claim = make_claim(["tpu-0"])
+    ctx = trace.new_ctx()
+    trace.stamp(claim, ctx)
+    with cp.arm(point):
+        with pytest.raises(cp.SimulatedCrash):
+            state.prepare(claim)
+    devices = state.prepare(claim)  # the kubelet retry converges
+    assert len(devices) == 1
+    prepares = [
+        s for s in trace.RECORDER.spans()
+        if s["name"] == "plugin.claim.prepare"
+    ]
+    assert len(prepares) == 2
+    assert {s["trace"] for s in prepares} == {ctx.trace_id}, (
+        "retry prepare did not stitch into the claim's trace"
+    )
+    crashed = prepares[0]
+    assert crashed["status"] == "error: SimulatedCrash"
+    crossed = [
+        e["point"] for e in crashed["events"]
+        if e["name"] == "crashpoint"
+    ]
+    assert crossed and crossed[-1] == point, (
+        f"crash-point windows not on the timeline: {crossed}"
+    )
+
+
+# --- scheduler stamping ------------------------------------------------------
+
+
+def test_scheduler_commit_stamps_ctx_annotation():
+    """_commit writes the allocation AND the ctx annotation in ONE
+    update; the pending span ends with the claim's trace id matching
+    the stamped annotation."""
+    from tpu_dra.k8sclient import FakeCluster
+    from tpu_dra.scheduler.core import SchedulerCore
+
+    cluster = make_cluster()
+    core = SchedulerCore(cluster)
+    claims = ResourceClient(cluster, RESOURCE_CLAIMS)
+    from tpu_dra.scheduler import fleet
+    c = fleet.make_claim(0, "1x1x1")
+    c["metadata"]["namespace"] = NS
+    claims.create(c)
+    stored = claims.try_get(c["metadata"]["name"], NS)
+    core._ensure_claim_span(stored)
+
+    class _Res:
+        allocation = {"devices": {"results": [{
+            "request": "tpu", "driver": fleet.DRIVER,
+            "pool": fleet.node_name(0), "device": "ss-1x1x1-0-0-0",
+        }]}}
+
+    assert core._commit(stored, _Res())
+    live = claims.try_get(c["metadata"]["name"], NS)
+    ctx = trace.extract(live)
+    assert ctx is not None
+    assert (live.get("status") or {}).get("allocation")
+    pend = [
+        s for s in trace.RECORDER.spans()
+        if s["name"] == "scheduler.claim.pending"
+    ]
+    assert len(pend) == 1 and pend[0]["trace"] == ctx.trace_id
+    alloc_spans = [
+        s for s in trace.RECORDER.spans()
+        if s["name"] == "scheduler.claim.allocated"
+    ]
+    assert len(alloc_spans) == 1
+    assert alloc_spans[0]["parent"] == ctx.span_id
+
+
+# --- /debug/traces + doctor explain ------------------------------------------
+
+
+def _claim_shaped_trace():
+    """A synthetic claim lifecycle in the recorder; returns (trace_id,
+    submit->ready window in seconds)."""
+    t0 = time.monotonic()
+    with trace.span("scheduler.claim.pending", root=True,
+                    attrs={"claim": f"{NS}/c0"}) as pend:
+        time.sleep(0.02)
+        ctx = pend.context()
+    trace.record_span(
+        "scheduler.claim.allocated", t0 + 0.015, t0 + 0.02, ctx=ctx,
+    )
+    with trace.span("kubelet.claim.prepare", ctx=ctx) as prep:
+        time.sleep(0.03)
+    t1 = prep.t1
+    return ctx.trace_id, t1 - t0
+
+
+def test_debug_traces_endpoint_serves_recorder():
+    metrics = Metrics()
+    trace.RECORDER.bind_metrics(metrics)
+    trace_id, _ = _claim_shaped_trace()
+    server = MetricsServer(metrics, port=0, address="127.0.0.1")
+    server.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/debug/traces", timeout=5
+        ) as r:
+            doc = json.loads(r.read().decode())
+    finally:
+        server.stop()
+    names = {s["name"] for s in doc["spans"]
+             if s["trace"] == trace_id}
+    assert names == {
+        "scheduler.claim.pending", "scheduler.claim.allocated",
+        "kubelet.claim.prepare",
+    }
+    assert doc["dropped"] == 0
+
+
+def test_doctor_explain_stage_budget_sums_to_window(capsys):
+    """`doctor explain --trace-id ... --trace-endpoint ...` stitches
+    the recorder dump and prints a stage budget whose rows (stages +
+    unattributed) sum to the claim's submit->ready window within 5% —
+    the acceptance bar's in-process half."""
+    trace_id, window = _claim_shaped_trace()
+    server = MetricsServer(Metrics(), port=0, address="127.0.0.1")
+    server.start()
+    try:
+        rc = doctor.main([
+            "explain", "--trace-id", trace_id,
+            "--trace-endpoint", f"127.0.0.1:{server.port}",
+            "--json",
+        ])
+    finally:
+        server.stop()
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    budget = doc["budget"]
+    total = sum(budget["stages"].values()) + budget["unattributed_s"]
+    assert budget["window_s"] == pytest.approx(window, rel=0.05)
+    assert total == pytest.approx(budget["window_s"], rel=0.05)
+    # The dominant stage is the kubelet prepare (the 30ms sleep).
+    top = max(budget["stages"], key=budget["stages"].get)
+    assert top == "kubelet.claim.prepare"
+
+
+def test_doctor_explain_fetches_claim_annotation():
+    """--claim ns/name resolves the trace id through the apiserver
+    annotation (the operator-facing entry point)."""
+    cluster = make_cluster()
+    claims = ResourceClient(cluster, RESOURCE_CLAIMS)
+    from tpu_dra.scheduler import fleet
+    c = fleet.make_claim(0, "1x1x1")
+    c["metadata"]["namespace"] = NS
+    claims.create(c)
+    stored = claims.try_get(c["metadata"]["name"], NS)
+    ctx = trace.new_ctx()
+    trace.stamp(stored, ctx)
+    claims.update(stored)
+    raw = (claims.try_get(c["metadata"]["name"], NS)["metadata"]
+           ["annotations"][trace.TRACE_ANNOTATION])
+    got = trace.SpanContext.decode(raw)
+    assert got.trace_id == ctx.trace_id
+
+
+def test_doctor_warns_on_capped_series():
+    warns = []
+    doctor._check_cardinality(
+        "ep:1",
+        {'tpu_dra_metrics_series_capped_total{name="per_claim"}': 5.0},
+        warns.append,
+    )
+    assert warns and "DROPPED" in warns[0]
+    assert not doctor._check_cardinality(
+        "ep:1", {"tpu_dra_prepare_total": 3.0}, warns.append,
+    )
+    assert len(warns) == 1
+
+
+# --- review-hardening pins ----------------------------------------------------
+
+
+def test_stage_budget_overlapping_siblings_sum_to_window():
+    """A serving-shaped trace: first_token (submit->t_first) fully
+    overlaps its prefill/dispatch siblings. Deepest-covering
+    attribution keeps the rows summing to the window — per-span
+    self-time would sum to ~200%."""
+    t0 = time.monotonic()
+    ctx = trace.new_ctx()
+    trace.record_span("serving.request.queued", t0, t0 + 0.010,
+                      self_ctx=ctx)
+    trace.record_span("serving.request.prefill", t0 + 0.010, t0 + 0.050,
+                      ctx=ctx)
+    trace.record_span("serving.request.first_token", t0, t0 + 0.050,
+                      ctx=ctx)
+    spans = trace.RECORDER.spans()
+    budget = doctor.stage_budget(spans)
+    total = sum(budget["stages"].values()) + budget["unattributed_s"]
+    assert total == pytest.approx(budget["window_s"], rel=1e-6)
+    # The prefill window is attributed to prefill (later-started
+    # sibling wins the tie), the pre-dispatch wait to first_token.
+    # wall anchors are derived per record_span call, so boundaries
+    # carry µs-level jitter — compare with a loose absolute tolerance.
+    assert budget["stages"]["serving.request.prefill"] == (
+        pytest.approx(0.040, abs=1e-3)
+    )
+    assert budget["stages"]["serving.request.first_token"] == (
+        pytest.approx(0.010, abs=1e-3)
+    )
+
+
+def test_empty_batch_records_no_solve_spans():
+    """A no-op reconcile (nothing pending) must not churn the ring:
+    busy fleets fire batch items on every event."""
+    cluster = make_cluster()
+    from tpu_dra.scheduler.core import SchedulerCore
+
+    core = SchedulerCore(cluster)
+    core._reconcile_batch(None)
+    assert trace.RECORDER.spans() == []
+
+
+def test_claim_span_pruned_when_claim_vanishes_mid_solve():
+    """A claim deleted between two batch passes (DELETE handler ran
+    before the span was re-minted) must not leak its entry forever:
+    the next batch prunes anything not in its pending snapshot."""
+    cluster = make_cluster()
+    from tpu_dra.scheduler import fleet
+    from tpu_dra.scheduler.core import SchedulerCore
+
+    core = SchedulerCore(cluster)
+    claims = ResourceClient(cluster, RESOURCE_CLAIMS)
+    c = fleet.make_claim(0, "1x1x1")
+    c["metadata"]["namespace"] = NS
+    claims.create(c)
+    stored = claims.try_get(c["metadata"]["name"], NS)
+    core._ensure_claim_span(stored)
+    assert len(core._claim_spans) == 1
+    claims.delete(c["metadata"]["name"], NS)
+    core._reconcile_batch(None)
+    assert core._claim_spans == {}
+    gone = [
+        s for s in trace.RECORDER.spans()
+        if s["name"] == "scheduler.claim.pending"
+    ]
+    assert gone and gone[-1]["status"] == "gone"

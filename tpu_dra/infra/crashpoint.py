@@ -43,6 +43,8 @@ import os
 import threading
 from typing import Dict, Optional
 
+from tpu_dra.infra import trace
+
 log = logging.getLogger(__name__)
 
 CRASH_POINT_ENV = "TPU_DRA_CRASH_POINT"
@@ -186,6 +188,11 @@ def crashpoint(name: str) -> None:
             f"crashpoint({name!r}) is not in the canonical CRASH_POINTS "
             f"table (tpu_dra/infra/crashpoint.py)"
         )
+    # Every crossed window lands on the ambient span as an event (noop
+    # when tracing is off or no span is open): the crash matrix's
+    # recovered timelines show exactly which WAL windows a prepare
+    # crossed before it died (docs/observability.md).
+    trace.current().event("crashpoint", point=name)
     global _armed
     with _lock:
         a = _armed

@@ -28,15 +28,29 @@ def _quantile_from_sorted(recent: list, q: float) -> Optional[float]:
     return recent[idx]
 
 
+# Per-name series cap (cardinality guard): past this many label sets
+# for ONE metric name, new series are refused and counted instead of
+# allocated. Unbounded label values (claim names under churn, the PR-12
+# remove_gauges lesson) become a visible counter, never an OOM.
+DEFAULT_SERIES_CAP = 1000
+
+# The guard's own counter (one series per capped NAME — bounded by the
+# number of distinct metric names, so it is exempt from the cap).
+SERIES_CAPPED_COUNTER = "metrics_series_capped_total"
+
+
 class Metrics:
-    def __init__(self, prefix: str = "tpu_dra"):
+    def __init__(self, prefix: str = "tpu_dra",
+                 series_cap: int = DEFAULT_SERIES_CAP):
         self.prefix = prefix
+        self.series_cap = series_cap
         self._lock = threading.Lock()
         self._counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
         self._gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
         self._timing_sum: Dict[str, float] = {}
         self._timing_count: Dict[str, int] = {}
         self._timing_recent: Dict[str, list] = {}
+        self._series_count: Dict[str, int] = {}
         self._collectors: list = []
 
     def register_collector(self, fn) -> None:
@@ -49,20 +63,51 @@ class Metrics:
     def _key(name: str, labels: Optional[Dict[str, str]]):
         return (name, tuple(sorted((labels or {}).items())))
 
+    def _admit_locked(self, store: dict, k) -> bool:
+        """Cardinality guard (call under the lock): an existing series
+        always updates; a NEW series allocates only while its name is
+        under ``series_cap`` label sets. Past the cap the write is
+        dropped and ``metrics_series_capped_total{name=}`` bumps —
+        the hard backstop behind per-entity series cleanup (the PR-12
+        ``remove_gauges`` lesson): a label explosion becomes a doctor
+        WARN, never unbounded registry growth."""
+        if k in store:
+            return True
+        name = k[0]
+        if self._series_count.get(name, 0) >= self.series_cap:
+            ck = (SERIES_CAPPED_COUNTER, (("name", name),))
+            # Direct insert: the guard's own counter is exempt (one
+            # series per capped NAME, bounded by the name universe).
+            self._counters[ck] = self._counters.get(ck, 0.0) + 1.0
+            return False
+        self._series_count[name] = self._series_count.get(name, 0) + 1
+        return True
+
     def inc(self, name: str, value: float = 1.0, labels: Optional[Dict[str, str]] = None):
         k = self._key(name, labels)
         with self._lock:
-            self._counters[k] = self._counters.get(k, 0.0) + value
+            if self._admit_locked(self._counters, k):
+                self._counters[k] = self._counters.get(k, 0.0) + value
 
     def set_gauge(self, name: str, value: float, labels: Optional[Dict[str, str]] = None):
+        k = self._key(name, labels)
         with self._lock:
-            self._gauges[self._key(name, labels)] = value
+            if self._admit_locked(self._gauges, k):
+                self._gauges[k] = value
+
+    def _forget_series_locked(self, name: str) -> None:
+        n = self._series_count.get(name, 0)
+        if n <= 1:
+            self._series_count.pop(name, None)
+        else:
+            self._series_count[name] = n - 1
 
     def remove_gauge(self, name: str, labels: Optional[Dict[str, str]] = None):
         """Drop one gauge series (collectors use this when the entity
         behind a labeled series disappears)."""
         with self._lock:
-            self._gauges.pop(self._key(name, labels), None)
+            if self._gauges.pop(self._key(name, labels), None) is not None:
+                self._forget_series_locked(name)
 
     def remove_gauges(self, name: str, match_labels: Dict[str, str]):
         """Drop EVERY series of ``name`` whose labels contain
@@ -77,6 +122,7 @@ class Metrics:
                 if k[0] == name and want <= set(k[1])
             ]:
                 self._gauges.pop(k, None)
+                self._forget_series_locked(name)
 
     def observe(self, name: str, seconds: float, labels: Optional[Dict[str, str]] = None):
         # Timings key like counters/gauges: (name, labels) — a sharded
@@ -85,6 +131,8 @@ class Metrics:
         # behind the other shards' healthy work.
         k = self._key(name, labels)
         with self._lock:
+            if not self._admit_locked(self._timing_sum, k):
+                return
             self._timing_sum[k] = self._timing_sum.get(k, 0.0) + seconds
             self._timing_count[k] = self._timing_count.get(k, 0) + 1
             recent = self._timing_recent.setdefault(k, [])
@@ -150,10 +198,23 @@ class Metrics:
         return "\n".join(out) + "\n"
 
     @staticmethod
+    def _esc(value) -> str:
+        """Prometheus exposition label-value escaping: backslash,
+        double-quote, and newline must be escaped or a hostile value
+        (a claim name carrying ``"`` or ``\\``) emits a malformed
+        line that poisons the whole scrape."""
+        return (
+            str(value)
+            .replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n")
+        )
+
+    @staticmethod
     def _fmt(labels) -> str:
         if not labels:
             return ""
-        inner = ",".join(f'{k}="{v}"' for k, v in labels)
+        inner = ",".join(f'{k}="{Metrics._esc(v)}"' for k, v in labels)
         return "{" + inner + "}"
 
 
@@ -180,6 +241,15 @@ class MetricsServer:
                     body = msg.encode()
                     self.send_response(200 if ok else 503)
                     self.send_header("Content-Type", "text/plain")
+                elif self.path == "/debug/traces":
+                    # The process flight recorder as JSON — what
+                    # `doctor explain` scrapes to stitch a claim's
+                    # cross-process timeline (docs/observability.md).
+                    from tpu_dra.infra import trace
+
+                    body = trace.RECORDER.export_json().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                 else:
                     body = b"not found"
                     self.send_response(404)
@@ -226,6 +296,12 @@ def start_health_server(metrics: Metrics, port: int, healthz=None):
     port is unset/disabled."""
     if not port or port <= 0:
         return None
+    from tpu_dra.infra import trace
+
+    # Every binary that serves /metrics also serves /debug/traces from
+    # the process recorder; binding here gives the recorder's drop
+    # counter a registry to land in.
+    trace.RECORDER.bind_metrics(metrics)
     server = MetricsServer(metrics, port=port, healthz=healthz)
     server.start()
     return server

@@ -1,0 +1,632 @@
+"""Claim-lifecycle tracing: spans, a per-process flight recorder, and
+cross-process context propagation via object annotations.
+
+The fleet has SLOs (claim-ready p99, fabric TTFT) and a doctor that says
+*that* something is unhealthy; this module answers *why a specific claim
+or request was slow*. The path claim-submitted → batch solve →
+allocation → slice publish → kubelet prepare → engine admission → first
+token crosses four processes; the reference driver reconstructs it by
+eyeballing klog breadcrumbs. Here every hot lifecycle stage emits a
+:class:`Span`, spans land in a bounded in-memory :class:`FlightRecorder`
+ring per process (never-blocking, drop-oldest), and the pieces stitch
+back into ONE timeline by trace id:
+
+- **in-process**: a thread-ambient current span (``contextvars``, the
+  :mod:`~tpu_dra.infra.deadline` idiom) parents nested spans without
+  threading a parameter through every signature;
+- **cross-process**: the scheduler stamps ``trace.tpu.google.com/ctx``
+  (``<trace_id>:<span_id>``) on the ResourceClaim in a metadata update
+  immediately before committing ``status.allocation`` (a real
+  apiserver's status subresource ignores metadata, so the stamp needs
+  its own write — one extra request per allocated claim, only while
+  tracing is on); the plugin's prepare path, the CD controller, and
+  the repacker ADOPT that context from the claim, and the serving
+  fabric threads a ctx per Request — so a claim's kubelet prepare and
+  a request's first token become child spans of the submit-side trace;
+- **out**: ``FlightRecorder.export_chrome(path)`` writes Perfetto/
+  Chrome ``trace_event`` JSON, ``render_text(trace_id)`` prints a
+  per-trace timeline, ``/debug/traces`` on every metrics endpoint
+  serves the recorder as JSON, and ``doctor explain --claim ns/name``
+  stitches the involved processes' recorders into a stage budget
+  breakdown (docs/observability.md).
+
+Tracing is free when off: ``TPU_DRA_TRACE=0`` makes :func:`span` return
+one shared no-op object (identity-pinned by test) and every recorder
+call a no-op; the fleetbench overhead gate (``fleet_trace_overhead_pct``)
+keeps the enabled path honest.
+
+Span names are governed like crash points: literal, dotted, registered
+in :data:`SPAN_NAMES`, one call site each (the T900 lint pass keeps the
+bijection; ``make tracecheck`` proves the lifecycle set actually fires
+and parents).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+TRACE_ENV = "TPU_DRA_TRACE"
+
+# The claim/request annotation key carrying "<trace_id>:<span_id>".
+TRACE_ANNOTATION = "trace.tpu.google.com/ctx"
+
+# Canonical span-name table: ``component.entity.stage`` -> (producer,
+# parent span name or "" for a root, description). The T900 lint pass
+# requires every span()/record_span() call site to thread a unique
+# literal name from this table; `make tracecheck` asserts the lifecycle
+# subset fires and parents as declared (docs/observability.md has the
+# rendered taxonomy).
+SPAN_NAMES: Dict[str, Tuple[str, str, str]] = {
+    # -- scheduler (SchedulerCore) --
+    "scheduler.claim.pending": (
+        "scheduler", "",
+        "first sight of the pending claim to its allocation commit; "
+        "mints the claim's trace id (stamped as the ctx annotation "
+        "right before the commit write)"),
+    "scheduler.solve.batch": (
+        "scheduler", "",
+        "one batch solve over every pending claim (own trace; claim "
+        "spans carry its trace id as the solve_trace attr)"),
+    "scheduler.solve.snapshot": (
+        "scheduler", "scheduler.solve.batch",
+        "claims LIST + allocator build over the persistent index "
+        "(index parse + CEL verdict cache refresh for changed slices)"),
+    "scheduler.solve.pack": (
+        "scheduler", "scheduler.solve.batch",
+        "allocate_batch: candidate ordering, CEL evaluation of cold "
+        "fingerprints, packing, ledger commits"),
+    "scheduler.solve.index_resync": (
+        "scheduler", "",
+        "sweep's SliceIndex.resync against the informer store (the "
+        "missed-event backstop; periodic, not per-solve)"),
+    "scheduler.claim.allocated": (
+        "scheduler", "scheduler.claim.pending",
+        "the status.allocation write (includes the conflict retry "
+        "surface; ends the pending span when it sticks)"),
+    # -- slice publisher (SlicePublisher) --
+    "publisher.slice.publish": (
+        "plugin/node-agent", "",
+        "one content-diffed publish pass; attr writes= is the apiserver "
+        "write count (0 = diffed away)"),
+    # -- kubelet plugin (DeviceState) --
+    "plugin.claim.prepare": (
+        "plugin", "scheduler.claim.pending",
+        "NodePrepareResources for one claim, ctx adopted from the "
+        "claim's annotation; WAL phase flips and crash-point names "
+        "land as span events"),
+    "plugin.device.prepare": (
+        "plugin", "plugin.claim.prepare",
+        "one device's materialization inside the prepare fan-out "
+        "(sub-slice create, CDI edits)"),
+    "plugin.claim.unprepare": (
+        "plugin", "",
+        "NodeUnprepareResources teardown for one claim"),
+    # -- kubelet simulator (tools/fleetsim KubeletSim) --
+    "kubelet.claim.prepare": (
+        "fleetsim", "scheduler.claim.pending",
+        "the harness's prepare+CDI-env stand-in; its end stamp IS the "
+        "claim-ready SLO's t_ready"),
+    # -- elastic repacker (Repacker) --
+    "repacker.claim.migrate": (
+        "repacker", "",
+        "one two-phase WAL migration, ctx adopted from the claim's "
+        "annotation; phase transitions and recovery rows land as span "
+        "events"),
+    # -- serving fabric (Router) --
+    "serving.request.queued": (
+        "serving", "",
+        "submit to WFQ dispatch (per-request root span; the request's "
+        "trace id is minted at submit)"),
+    "serving.request.dispatch": (
+        "serving", "serving.request.queued",
+        "the dispatch decision + hand-off into the replica's engine "
+        "(admission happens at the engine's next chunk boundary)"),
+    "serving.request.prefill": (
+        "serving", "serving.request.queued",
+        "dispatch to first emitted token (engine admission + chunked "
+        "prefill; recorded retroactively from the completion stamps)"),
+    "serving.request.first_token": (
+        "serving", "serving.request.queued",
+        "submit to first token — the TTFT the fabric SLO quantiles "
+        "measure, as a span"),
+    "serving.request.evacuate": (
+        "serving", "serving.request.queued",
+        "a drained sequence's hand-back + front-splice requeue "
+        "(attr emitted= tokens carried to the surviving replica)"),
+}
+
+# The hot-lifecycle subset `make tracecheck` must observe end-to-end
+# (fleetsim drives the claim path, a stub fabric drives the request
+# path, a stub plugin prepare drives the device path).
+LIFECYCLE_SPANS: Tuple[str, ...] = (
+    "scheduler.claim.pending",
+    "scheduler.solve.batch",
+    "scheduler.solve.snapshot",
+    "scheduler.solve.pack",
+    "scheduler.claim.allocated",
+    "publisher.slice.publish",
+    "kubelet.claim.prepare",
+    "plugin.claim.prepare",
+    "plugin.device.prepare",
+    "serving.request.queued",
+    "serving.request.dispatch",
+    "serving.request.prefill",
+    "serving.request.first_token",
+)
+
+# Default ring size: ~4k spans is minutes of a busy node's lifecycle at
+# a few hundred bytes each — bounded memory, and the doctor only ever
+# needs the recent window (docs/observability.md "Flight recorder
+# sizing").
+DEFAULT_RING_SPANS = 4096
+
+
+def _enabled_from_env() -> bool:
+    return os.environ.get(TRACE_ENV, "1") not in ("0", "false", "off")
+
+
+_enabled = _enabled_from_env()
+
+
+def enabled() -> bool:
+    """Whether tracing is on (module-level flag; ``TPU_DRA_TRACE=0``
+    kills it at import, :func:`set_enabled` flips it for tests and the
+    overhead bench)."""
+    return _enabled
+
+
+def set_enabled(on: bool) -> bool:
+    """Flip the module flag; returns the previous value (callers
+    restore it — the overhead bench and tests use this instead of
+    re-importing with a different env)."""
+    global _enabled
+    prev = _enabled
+    _enabled = bool(on)
+    return prev
+
+
+def _ids(nbytes: int) -> str:
+    return os.urandom(nbytes).hex()
+
+
+class SpanContext:
+    """The propagated identity of a span: (trace_id, span_id)."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def encode(self) -> str:
+        """The annotation wire format: ``<trace_id>:<span_id>``."""
+        return f"{self.trace_id}:{self.span_id}"
+
+    @staticmethod
+    def decode(raw: str) -> Optional["SpanContext"]:
+        """Parse the annotation format; None on anything malformed — a
+        corrupted annotation must degrade to 'untraced', never crash a
+        prepare path."""
+        if not raw or ":" not in raw:
+            return None
+        trace_id, _, span_id = raw.partition(":")
+        if not trace_id or not span_id:
+            return None
+        return SpanContext(trace_id, span_id)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"SpanContext({self.encode()})"
+
+
+class Span:
+    """One timed stage. ``t0``/``t1`` are monotonic; ``wall0`` anchors
+    the monotonic window to the wall clock so recorders from different
+    processes stitch on a shared axis. Mutation is single-writer (the
+    thread that opened the span); the recorder copies on add."""
+
+    __slots__ = (
+        "name", "trace_id", "span_id", "parent_id", "t0", "t1",
+        "wall0", "attrs", "status", "events", "_token",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        trace_id: str,
+        span_id: str,
+        parent_id: str = "",
+        attrs: Optional[dict] = None,
+    ):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.t0 = time.monotonic()
+        self.wall0 = time.time()
+        self.t1: Optional[float] = None
+        self.attrs: dict = dict(attrs) if attrs else {}
+        self.status = "ok"
+        self.events: List[dict] = []
+        self._token = None
+
+    # --- mutation (owning thread only) ---
+
+    def set_attr(self, key: str, value) -> None:
+        self.attrs[key] = value
+
+    def event(self, name: str, **attrs) -> None:
+        """Append a point-in-time marker (WAL phase flips, crash-point
+        names, recovery rows)."""
+        ev = {"name": name, "t": time.monotonic() - self.t0}
+        if attrs:
+            ev.update(attrs)
+        self.events.append(ev)
+
+    def set_status(self, status: str) -> None:
+        self.status = status
+
+    def context(self) -> SpanContext:
+        return SpanContext(self.trace_id, self.span_id)
+
+    def end(self) -> None:
+        if self.t1 is None:
+            self.t1 = time.monotonic()
+            RECORDER.add(self)
+
+    # --- context-manager protocol (installs as the ambient span) ---
+
+    def __enter__(self) -> "Span":
+        self._token = _CURRENT.set(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if self._token is not None:
+            _CURRENT.reset(self._token)
+            self._token = None
+        if exc_type is not None and self.status == "ok":
+            self.status = f"error: {exc_type.__name__}"
+        self.end()
+        return None
+
+    # --- export ---
+
+    def duration_s(self) -> float:
+        return (self.t1 if self.t1 is not None else time.monotonic()) - self.t0
+
+    def to_dict(self) -> dict:
+        return {
+            "pid": os.getpid(),
+            "name": self.name,
+            "trace": self.trace_id,
+            "span": self.span_id,
+            "parent": self.parent_id,
+            "wall0": self.wall0,
+            "dur_s": self.duration_s(),
+            "status": self.status,
+            "attrs": dict(self.attrs),
+            "events": list(self.events),
+        }
+
+
+class _NoopSpan:
+    """The shared do-nothing span handed out while tracing is off (and
+    as the ambient default). Every mutator is a no-op; ``context()``
+    returns None so propagation sites skip the annotation stamp."""
+
+    __slots__ = ()
+
+    def set_attr(self, key: str, value) -> None:
+        pass
+
+    def event(self, name: str, **attrs) -> None:
+        pass
+
+    def set_status(self, status: str) -> None:
+        pass
+
+    def context(self) -> None:
+        return None
+
+    def end(self) -> None:
+        pass
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+
+NOOP_SPAN = _NoopSpan()
+
+_CURRENT: contextvars.ContextVar = contextvars.ContextVar(
+    "tpu_dra_trace_span", default=NOOP_SPAN
+)
+
+
+def current():
+    """The ambient span (``NOOP_SPAN`` when none is open) — the
+    :func:`~tpu_dra.infra.deadline.current` idiom for trace context."""
+    return _CURRENT.get()
+
+
+def span(
+    name: str,
+    attrs: Optional[dict] = None,
+    ctx: Optional[SpanContext] = None,
+    root: bool = False,
+):
+    """Open a span (use as a context manager, or call ``.end()``).
+
+    Parenting, in precedence order: an explicit ``ctx`` (adopted from a
+    claim/request annotation — the new span joins THAT trace as a child
+    of the encoded span); else the thread-ambient current span; else a
+    fresh root trace. ``root=True`` skips the ambient parent (a batch
+    solve must not accidentally nest under an unrelated span).
+
+    When tracing is off this returns the shared :data:`NOOP_SPAN` —
+    one attribute load and one identity check, no allocation.
+    """
+    if not _enabled:
+        return NOOP_SPAN
+    if ctx is not None:
+        return Span(name, ctx.trace_id, _ids(8), parent_id=ctx.span_id,
+                    attrs=attrs)
+    if not root:
+        cur = _CURRENT.get()
+        if cur is not NOOP_SPAN and isinstance(cur, Span):
+            return Span(name, cur.trace_id, _ids(8),
+                        parent_id=cur.span_id, attrs=attrs)
+    return Span(name, _ids(16), _ids(8), attrs=attrs)
+
+
+def new_ctx() -> Optional[SpanContext]:
+    """Mint a fresh root context (the serving fabric's per-Request
+    identity, assigned at submit and threaded through dispatch /
+    evacuation / completion). None while tracing is off — every
+    consumer treats a None ctx as 'untraced'."""
+    if not _enabled:
+        return None
+    return SpanContext(_ids(16), _ids(8))
+
+
+def record_span(
+    name: str,
+    t0: float,
+    t1: float,
+    ctx: Optional[SpanContext] = None,
+    self_ctx: Optional[SpanContext] = None,
+    wall0: Optional[float] = None,
+    attrs: Optional[dict] = None,
+    status: str = "ok",
+) -> None:
+    """Record a RETROACTIVE span from already-taken monotonic stamps
+    (the serving fabric knows a request's dispatch/first-token times
+    only when the completion surfaces — re-timing them live would mean
+    touching the engine hot loop). ``ctx`` parents the new span;
+    ``self_ctx`` instead fixes the span's OWN identity (a pre-minted
+    per-request root). ``wall0`` anchors ``t0`` to the wall clock; when
+    omitted it is derived from now."""
+    if not _enabled:
+        return
+    now_m = time.monotonic()
+    if self_ctx is not None:
+        s = Span(name, self_ctx.trace_id, self_ctx.span_id, attrs=attrs)
+    elif ctx is not None:
+        s = Span(name, ctx.trace_id, _ids(8), parent_id=ctx.span_id,
+                 attrs=attrs)
+    else:
+        s = Span(name, _ids(16), _ids(8), attrs=attrs)
+    s.t0 = t0
+    s.t1 = t1
+    s.wall0 = wall0 if wall0 is not None else (time.time() - (now_m - t0))
+    s.status = status
+    RECORDER.add(s)
+
+
+# --- claim/object annotation propagation -------------------------------
+
+
+def stamp(obj: dict, ctx: Optional[SpanContext]) -> None:
+    """Write the ctx annotation onto a k8s object dict (no-op for a
+    None ctx, i.e. tracing off). Callers fold this into a write they
+    were already making — propagation must cost zero extra requests."""
+    if ctx is None:
+        return
+    obj.setdefault("metadata", {}).setdefault("annotations", {})[
+        TRACE_ANNOTATION
+    ] = ctx.encode()
+
+
+def extract(obj: dict) -> Optional[SpanContext]:
+    """Read the ctx annotation off a k8s object dict (None when absent,
+    malformed, or tracing is off — adopting a context while disabled
+    would allocate spans the operator asked not to pay for)."""
+    if not _enabled:
+        return None
+    raw = ((obj.get("metadata") or {}).get("annotations") or {}).get(
+        TRACE_ANNOTATION, ""
+    )
+    return SpanContext.decode(raw)
+
+
+# --- the per-process flight recorder -----------------------------------
+
+
+class FlightRecorder:
+    """Bounded ring of FINISHED spans. Never blocks the caller beyond a
+    short lock, never grows past ``capacity``: when full the oldest
+    span is dropped and ``trace_spans_dropped_total`` bumps on the
+    bound metrics (plus an internal counter even unbound)."""
+
+    def __init__(self, capacity: int = DEFAULT_RING_SPANS, metrics=None):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._ring: List[dict] = []
+        self._head = 0  # index of the oldest entry once the ring wrapped
+        self.dropped = 0
+        self._metrics = metrics
+
+    def bind_metrics(self, metrics) -> None:
+        """Late-bind the process's Metrics (binaries construct the
+        recorder at import, the registry at main())."""
+        self._metrics = metrics
+
+    def add(self, span: Span) -> None:
+        if not _enabled:
+            return
+        entry = span.to_dict()
+        dropped_one = False
+        with self._lock:
+            if len(self._ring) < self.capacity:
+                self._ring.append(entry)
+            else:
+                self._ring[self._head] = entry
+                self._head = (self._head + 1) % self.capacity
+                self.dropped += 1
+                dropped_one = True
+        if dropped_one and self._metrics is not None:
+            # Outside the ring lock; Metrics has its own.
+            self._metrics.inc("trace_spans_dropped_total")
+
+    def spans(self) -> List[dict]:
+        """Oldest-first snapshot."""
+        with self._lock:
+            return self._ring[self._head:] + self._ring[: self._head]
+
+    def by_trace(self, trace_id: str) -> List[dict]:
+        return [s for s in self.spans() if s["trace"] == trace_id]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring = []
+            self._head = 0
+            self.dropped = 0
+
+    # --- exporters ---
+
+    def export_json(self) -> str:
+        """The /debug/traces payload: every retained span + drop count."""
+        return json.dumps({
+            "dropped": self.dropped,
+            "spans": self.spans(),
+        })
+
+    def export_chrome(self, path: str) -> int:
+        """Write Chrome/Perfetto ``trace_event`` JSON; returns the
+        event count. Spans become complete ("X") events on a wall-clock
+        microsecond axis (cross-process stitching happens on trace ids
+        carried in args); span events become instants ("i")."""
+        events = chrome_events(self.spans())
+        with open(path, "w") as f:
+            json.dump(
+                {"traceEvents": events, "displayTimeUnit": "ms"}, f
+            )
+        return len(events)
+
+    def render_text(self, trace_id: str) -> str:
+        """Plain-text per-trace timeline (the doctor's building block)."""
+        return render_timeline(self.by_trace(trace_id))
+
+
+def chrome_events(spans: List[dict]) -> List[dict]:
+    """Span dicts -> Chrome trace_event list (shared by the recorder
+    export and the doctor's stitched multi-process export)."""
+    pid = os.getpid()
+    out: List[dict] = []
+    for s in spans:
+        ts_us = s["wall0"] * 1e6
+        out.append({
+            "name": s["name"],
+            "cat": "tpu_dra",
+            "ph": "X",
+            "ts": ts_us,
+            "dur": max(s["dur_s"], 0.0) * 1e6,
+            "pid": s.get("pid", pid),
+            "tid": abs(hash(s["trace"])) % 100000,
+            "args": {
+                "trace": s["trace"],
+                "span": s["span"],
+                "parent": s["parent"],
+                "status": s["status"],
+                **s.get("attrs", {}),
+            },
+        })
+        for ev in s.get("events", []):
+            out.append({
+                "name": f"{s['name']}:{ev['name']}",
+                "cat": "tpu_dra",
+                "ph": "i",
+                "s": "t",
+                "ts": ts_us + ev.get("t", 0.0) * 1e6,
+                "pid": s.get("pid", pid),
+                "tid": abs(hash(s["trace"])) % 100000,
+                "args": {k: v for k, v in ev.items() if k not in ("name", "t")},
+            })
+    return out
+
+
+def render_timeline(spans: List[dict]) -> str:
+    """One trace's spans as an indented, time-ordered text timeline.
+    Unknown parents render at the root (a span whose parent rotated out
+    of the ring must still show up, flagged)."""
+    if not spans:
+        return "(no spans)"
+    by_id = {s["span"]: s for s in spans}
+    children: Dict[str, List[dict]] = {}
+    roots: List[dict] = []
+    for s in sorted(spans, key=lambda x: x["wall0"]):
+        if s["parent"] and s["parent"] in by_id:
+            children.setdefault(s["parent"], []).append(s)
+        else:
+            roots.append(s)
+    t_base = min(s["wall0"] for s in spans)
+    lines: List[str] = []
+
+    def walk(s: dict, depth: int) -> None:
+        orphan = " (parent not retained)" if (
+            s["parent"] and s["parent"] not in by_id
+        ) else ""
+        lines.append(
+            f"{'  ' * depth}{(s['wall0'] - t_base) * 1000:9.1f}ms "
+            f"+{s['dur_s'] * 1000:.1f}ms {s['name']}"
+            f"{'' if s['status'] == 'ok' else ' [' + s['status'] + ']'}"
+            f"{orphan}"
+        )
+        for ev in s.get("events", []):
+            extra = ", ".join(
+                f"{k}={v}" for k, v in ev.items()
+                if k not in ("name", "t")
+            )
+            lines.append(
+                f"{'  ' * (depth + 1)}· {ev['name']}"
+                f"{'(' + extra + ')' if extra else ''} "
+                f"@+{ev.get('t', 0.0) * 1000:.1f}ms"
+            )
+        for c in children.get(s["span"], []):
+            walk(c, depth + 1)
+
+    for r in roots:
+        walk(r, 0)
+    return "\n".join(lines)
+
+
+# The process-global recorder every span lands in; binaries expose it
+# at /debug/traces via MetricsServer and bind their Metrics for the
+# drop counter.
+RECORDER = FlightRecorder()
+
+
+def reset_for_tests(capacity: int = DEFAULT_RING_SPANS) -> None:
+    """Clear the global recorder and restore the env-derived enabled
+    flag (test isolation)."""
+    global _enabled
+    RECORDER.clear()
+    RECORDER.capacity = capacity
+    RECORDER.bind_metrics(None)
+    _enabled = _enabled_from_env()

@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Tuple
 from tpu_dra import api as configapi
 from tpu_dra.api.errors import ApiError
 from tpu_dra.infra import featuregates as fg
+from tpu_dra.infra import trace
 from tpu_dra.infra.crashpoint import crashpoint
 from tpu_dra.plugin.allocatable import (
     AllocatableDevice,
@@ -233,8 +234,17 @@ class DeviceState:
 
     def prepare(self, claim: dict) -> List[KubeletDevice]:
         t0 = time.monotonic()
-        with self._lock:
-            return self._prepare_locked(claim, t0)
+        # Adopt the claim's trace ctx (stamped by the scheduler in the
+        # allocation-commit write): this prepare becomes a child span of
+        # the submit-side claim trace, so `doctor explain` can say how
+        # much of the claim-ready budget the kubelet prepare ate.
+        with trace.span(
+            "plugin.claim.prepare",
+            ctx=trace.extract(claim),
+            attrs={"claim": claim_to_string(claim)},
+        ):
+            with self._lock:
+                return self._prepare_locked(claim, t0)
 
     def _prepare_locked(self, claim: dict, t0: float) -> List[KubeletDevice]:
         claim_uid = claim["metadata"]["uid"]
@@ -299,6 +309,7 @@ class DeviceState:
             )
 
         self.checkpoints.update(mark_started)
+        trace.current().event("wal.prepare_started")
         crashpoint("plugin.prepare.after_wal_started")
 
         tp = time.monotonic()
@@ -325,6 +336,7 @@ class DeviceState:
                 self.allocatable.remove_sibling_devices(adev)
 
         self.cdi.create_claim_spec_file(claim_uid, prepared)
+        trace.current().event("cdi.spec_written")
         crashpoint("plugin.prepare.before_wal_completed")
 
         def mark_completed(c: Checkpoint) -> None:
@@ -337,28 +349,34 @@ class DeviceState:
             )
 
         self.checkpoints.update(mark_completed)
+        trace.current().event("wal.prepare_completed")
         log.debug("t_prep_total %.3f s", time.monotonic() - t0)
         return prepared.get_devices()
 
     # --- Unprepare (device_state.go:375-441) ---
 
     def unprepare(self, claim_uid: str) -> None:
-        with self._lock:
+        with self._lock, trace.span(
+            "plugin.claim.unprepare", attrs={"claim_uid": claim_uid}
+        ) as s:
             cp = self.checkpoints.get()
             claim = cp.prepared_claims.get(claim_uid)
             if claim is None:
                 log.info("unprepare noop: no checkpointed claim %s", claim_uid)
+                s.set_status("noop")
                 return
             if claim.checkpoint_state == CLAIM_STATE_PREPARE_STARTED:
                 self._unprepare_partially_prepared_claim(claim_uid, claim)
             else:
                 self._unprepare_devices(claim_uid, claim.prepared_devices)
+            s.event("teardown.done")
             crashpoint("plugin.unprepare.after_teardown")
             self.cdi.delete_claim_spec_file(claim_uid)
             crashpoint("plugin.unprepare.before_wal_removed")
             self.checkpoints.update(
                 lambda c: c.prepared_claims.pop(claim_uid, None)
             )
+            s.event("wal.removed")
 
     def _unprepare_partially_prepared_claim(
         self, claim_uid: str, claim: PreparedClaim
@@ -551,9 +569,13 @@ class DeviceState:
             config_state = self._apply_config(cfg, claim, cfg_results)
             group = PreparedDeviceGroup(config_state=config_state)
             for result in cfg_results:
-                group.devices.append(
-                    self._prepare_one(claim, result, config_state)
-                )
+                with trace.span(
+                    "plugin.device.prepare",
+                    attrs={"device": result.get("device", "")},
+                ):
+                    group.devices.append(
+                        self._prepare_one(claim, result, config_state)
+                    )
                 # A device (possibly a freshly-materialized sub-slice) is
                 # live; its siblings and the WAL completion are not.
                 crashpoint("plugin.prepare.between_devices")

@@ -44,6 +44,7 @@ import logging
 import time
 from typing import Callable, Dict, List, Optional
 
+from tpu_dra.infra import trace
 from tpu_dra.k8sclient.resources import ApiConflict, ApiNotFound
 
 log = logging.getLogger(__name__)
@@ -142,6 +143,7 @@ class SlicePublisher:
         the PROPOSED generation. When the content (generation masked) is
         unchanged since the last committed pass, nothing is written and
         the generation does not advance."""
+        t_pass = time.monotonic()
         if self._published is not None and self.reverify_seconds > 0:
             now = time.monotonic()
             if now - self._last_verify >= self.reverify_seconds:
@@ -206,4 +208,15 @@ class SlicePublisher:
             raise
         self.generation = proposed
         self._inc("publish_writes_total", writes)
+        # Only committed passes record a span: at fleet scale the
+        # steady state is diffed-away no-ops, and a span per no-op
+        # would churn the flight-recorder ring with nothing to show.
+        trace.record_span(
+            "publisher.slice.publish", t_pass, time.monotonic(),
+            attrs={
+                "writes": writes,
+                "node": self.node_name,
+                "generation": self.generation,
+            },
+        )
         return writes

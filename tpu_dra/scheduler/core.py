@@ -36,6 +36,7 @@ import threading
 import time
 from typing import List, Optional
 
+from tpu_dra.infra import trace
 from tpu_dra.infra.metrics import Metrics
 from tpu_dra.infra.workqueue import WorkQueue, default_controller_rate_limiter
 from tpu_dra.k8sclient import (
@@ -99,6 +100,13 @@ class SchedulerCore:
         # bounding growth to currently-pending claims.
         self._last_unsched: dict = {}
         self._unsched_lock = threading.Lock()
+        # Per-claim lifecycle spans (claim key -> open
+        # scheduler.claim.pending Span): minted at first solve touch,
+        # ended at the allocation commit (which stamps the claim's ctx
+        # annotation) or claim deletion. Written on the workqueue
+        # thread; DELETED cleanup comes from the informer thread.
+        self._claim_spans: dict = {}
+        self._claim_spans_lock = threading.Lock()
 
     # --- lifecycle ---
 
@@ -155,6 +163,11 @@ class SchedulerCore:
             # suppressed.
             with self._unsched_lock:
                 self._last_unsched.pop(self._key(claim), None)
+            with self._claim_spans_lock:
+                s = self._claim_spans.pop(self._key(claim), None)
+            if s is not None:
+                s.set_status("deleted")
+                s.end()
             # A deleted ALLOCATED claim frees capacity that may unblock
             # an Unschedulable claim right now — only the periodic
             # sweep used to notice (seconds of added latency on the
@@ -204,7 +217,12 @@ class SchedulerCore:
                 # at 5k nodes the defensive copy was ~40MB per sweep,
                 # pinning a core for nothing (fleetsim finding).
                 if self.slice_informer.wait_for_sync(timeout=0):
-                    self.index.resync(self.slice_informer.list_refs())
+                    with trace.span("scheduler.solve.index_resync",
+                                    root=True) as s:
+                        self.index.resync(self.slice_informer.list_refs())
+                        s.set_attr(
+                            "slices", self.slice_informer.store_size()
+                        )
                 snapshot = self.claims.list()
                 pending = sum(
                     1 for claim in snapshot
@@ -261,11 +279,27 @@ class SchedulerCore:
             "scheduler_free_chips", frag["free_chips"]
         )
 
+    def _ensure_claim_span(self, claim: dict):
+        """The claim's ``scheduler.claim.pending`` span, minted at the
+        first solve that touches it — its trace id IS the claim's trace
+        id, stamped onto the claim at the allocation commit."""
+        key = self._key(claim)
+        with self._claim_spans_lock:
+            s = self._claim_spans.get(key)
+            if s is None:
+                s = trace.span(
+                    "scheduler.claim.pending",
+                    attrs={"claim": key}, root=True,
+                )
+                self._claim_spans[key] = s
+            return s
+
     def _reconcile_batch(self, _obj) -> None:
         """Solve every pending claim against ONE shared snapshot —
         the index-amortized batch path (see module doc). Pending set
         and allocated-claims replay come from the same listing (see
         _snapshot_allocator)."""
+        t_list = time.monotonic()
         snapshot = self.claims.list()
         pending = [
             c for c in snapshot
@@ -279,19 +313,49 @@ class SchedulerCore:
             # repacker's recovery sees the allocation and stands down.
             and not repack_owned(c)
         ]
+        # Prune claim spans whose claim is no longer pending in this
+        # snapshot (deleted mid-solve after the DELETE handler ran, or
+        # allocated by another writer): without this, an entry
+        # re-minted after the DELETE pop would linger forever.
+        pending_keys = {self._key(c) for c in pending}
+        with self._claim_spans_lock:
+            stale = [
+                (k, s) for k, s in self._claim_spans.items()
+                if k not in pending_keys
+            ]
+            for k, _s in stale:
+                self._claim_spans.pop(k, None)
+        for _k, s in stale:
+            s.set_status("gone")
+            s.end()
         if not pending:
+            # No spans for a no-op pass: a busy fleet's event stream
+            # fires this constantly, and recording empty batches would
+            # churn the claim spans out of the flight-recorder ring
+            # (the slicepub committed-passes-only rationale).
             return
-        t0 = time.monotonic()
-        alloc = self._snapshot_allocator(snapshot)
-        results = alloc.allocate_batch(pending)
-        allocated = 0
-        unschedulable = 0
-        for claim, res in zip(pending, results):
-            if isinstance(res, Unschedulable):
-                unschedulable += 1
-                self._note_unschedulable(claim, res)
-            elif self._commit(claim, res):
-                allocated += 1
+        with trace.span("scheduler.solve.batch", root=True) as solve:
+            with trace.span("scheduler.solve.snapshot") as snap:
+                snap.set_attr(
+                    "list_ms", round((time.monotonic() - t_list) * 1e3, 3)
+                )
+                t0 = time.monotonic()
+                alloc = self._snapshot_allocator(snapshot)
+            solve.set_attr("pending", len(pending))
+            for claim in pending:
+                self._ensure_claim_span(claim)
+            with trace.span("scheduler.solve.pack"):
+                results = alloc.allocate_batch(pending)
+            allocated = 0
+            unschedulable = 0
+            for claim, res in zip(pending, results):
+                if isinstance(res, Unschedulable):
+                    unschedulable += 1
+                    self._note_unschedulable(claim, res)
+                elif self._commit(claim, res, solve):
+                    allocated += 1
+            solve.set_attr("allocated", allocated)
+            solve.set_attr("unschedulable", unschedulable)
         self.metrics.inc("scheduler_batch_total")
         self.metrics.observe(
             "scheduler_allocate_batch_seconds", time.monotonic() - t0
@@ -327,15 +391,74 @@ class SchedulerCore:
                 md.get("namespace"), md["name"], e,
             )
 
-    def _commit(self, claim: dict, result) -> bool:
-        """Write status.allocation; True when it stuck."""
+    def _commit(self, claim: dict, result, solve=trace.NOOP_SPAN) -> bool:
+        """Write status.allocation; True when it stuck. With tracing
+        on, the claim's trace ctx annotation is stamped in a METADATA
+        update immediately before the status commit: a real apiserver's
+        status subresource ignores metadata on status writes AND
+        ignores status on main-resource writes, so the two halves need
+        their own verbs (the chart's scheduler ClusterRole carries
+        resourceclaims update for the stamp; the repacker's WAL
+        annotation already relied on it). A stamp that lands without
+        its status commit (conflict in between) is harmless — the
+        pending span stays open and the retry re-stamps the same ctx.
+        With tracing off this is the single update_status it always
+        was."""
         md = claim["metadata"]
         key = self._key(claim)
-        claim.setdefault("status", {})["allocation"] = result.allocation
+        with self._claim_spans_lock:
+            pending_span = self._claim_spans.get(key)
+        ctx = pending_span.context() if pending_span is not None \
+            else None
+        t_commit = time.monotonic()
+        if ctx is not None:
+            trace.stamp(claim, ctx)
+            try:
+                # The returned object carries the new resourceVersion,
+                # so the status CAS below sees our own write.
+                fresh = self.claims.update(claim)
+                fresh.setdefault("status", {})["allocation"] = (
+                    result.allocation
+                )
+                claim = fresh
+            except (ApiConflict, ApiNotFound):
+                return False  # changed underneath us; event re-enqueues
+        else:
+            claim.setdefault("status", {})["allocation"] = (
+                result.allocation
+            )
         try:
             self.claims.update_status(claim)
-        except (ApiConflict, ApiNotFound):
+        except ApiConflict:
             return False  # changed underneath us; claim event re-enqueues
+        except ApiNotFound:
+            # Deleted underneath us: the DELETE handler may have run
+            # BEFORE _ensure_claim_span re-minted this entry — clean it
+            # here or it would linger until the next batch's prune. End
+            # only the span our pop actually returned: the informer
+            # thread's DELETE handler may win the pop concurrently, and
+            # Span.end() is single-ender by contract.
+            with self._claim_spans_lock:
+                popped = self._claim_spans.pop(key, None)
+            if popped is not None:
+                popped.set_status("deleted")
+                popped.end()
+            return False
+        if ctx is not None:
+            trace.record_span(
+                "scheduler.claim.allocated", t_commit, time.monotonic(),
+                ctx=ctx, attrs={
+                    "claim": key,
+                    "solve_trace": getattr(solve, "trace_id", ""),
+                },
+            )
+        # End only the span the pop returned (same single-ender rule
+        # as the ApiNotFound path: a concurrent DELETE handler may
+        # have popped-and-ended it already).
+        with self._claim_spans_lock:
+            popped = self._claim_spans.pop(key, None)
+        if popped is not None:
+            popped.end()
         with self._unsched_lock:
             self._last_unsched.pop(key, None)
         self.metrics.inc("scheduler_allocations_total")

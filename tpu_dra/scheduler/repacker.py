@@ -88,6 +88,7 @@ import time
 import uuid
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
+from tpu_dra.infra import trace
 from tpu_dra.infra.crashpoint import crashpoint
 from tpu_dra.k8sclient import (
     DEVICE_CLASSES,
@@ -218,11 +219,15 @@ class RepackerConfig:
 class _Migration:
     __slots__ = (
         "key", "name", "namespace", "phase", "from_results", "t0",
-        "wall_t0", "attempts", "requeued",
+        "wall_t0", "attempts", "requeued", "span",
     )
 
     def __init__(self, key, name, namespace, from_results, t0,
                  wall_t0=0.0):
+        # The migration's trace span (adopts the claim's ctx annotation
+        # so the move shows up on the claim's own timeline); phase
+        # transitions and recovery rows land on it as events.
+        self.span = trace.NOOP_SPAN
         self.key = key
         self.name = name
         self.namespace = namespace
@@ -415,6 +420,10 @@ class Repacker:
                 # Old placement intact (or someone — a stale-plan
                 # takeover, a crashed commit that landed — already
                 # allocated it): roll back to what is committed.
+                s = self._migration_span(claim, recovery="rollback")
+                s.event("recovered", phase=phase, action="rollback")
+                s.set_status("recovered: rollback")
+                s.end()
                 self._drop_annotation(md["name"], md.get("namespace"))
                 self.serving.abort(key)
                 log.info("repack recovery: rolled back %s (%s)", key, phase)
@@ -430,6 +439,8 @@ class Repacker:
                     ),
                 )
                 m.phase = PHASE_RELEASED
+                m.span = self._migration_span(claim, recovery="forward")
+                m.span.event("recovered", phase=phase, action="forward")
                 self._active.append(m)  # lint: disable=R200 (single-writer: recover/tick run on ONE thread — the control thread or the sole leader loop, joined across leadership handoffs)
                 log.info("repack recovery: resuming half-move %s", key)
             else:
@@ -623,6 +634,8 @@ class Repacker:
             key, md["name"], md.get("namespace"), from_results,
             self.clock(), wall_t0=t_wall,
         )
+        m.span = self._migration_span(claim)
+        m.span.event("phase.planned")
         self._active.append(m)  # lint: disable=R200 (single-writer, same contract as recover)
         log.info("repack: planned migration of %s", key)
 
@@ -640,6 +653,7 @@ class Repacker:
                 self._rollback(m, "claim vanished during drain")
                 return
             m.phase = PHASE_EVACUATED
+            m.span.event("phase.evacuated", requeued=m.requeued)
             crashpoint("repack.migrate.after_evacuate")
             if not self.is_leader:
                 return  # crash-safe boundary; abort handled next tick
@@ -659,6 +673,7 @@ class Repacker:
                 self._forget(m)
                 return
             m.phase = PHASE_RELEASED
+            m.span.event("phase.released")
             crashpoint("repack.migrate.between_unprepare_prepare")
             if not self.is_leader:
                 return
@@ -703,6 +718,7 @@ class Repacker:
             # snapshot and our commit. We are the yielding writer:
             # release again and retry against the next snapshot.
             m.attempts += 1
+            m.span.event("commit.race_yield", attempt=m.attempts)
             self._inc("repacker_commit_races_total")
             if m.attempts >= self.config.max_commit_attempts:
                 self._restore_or_yield(m, committed)
@@ -833,6 +849,7 @@ class Repacker:
         self._abort_done(m, why)
 
     def _abort_done(self, m: _Migration, why: str) -> None:
+        m.span.set_status(f"aborted: {why}")
         self._forget(m)
         self.aborted += 1
         self._inc("repacker_migrations_aborted_total")
@@ -840,13 +857,34 @@ class Repacker:
         log.warning("repack: migration of %s aborted: %s", m.key, why)
 
     def _complete(self, m: _Migration) -> None:
+        m.span.event("phase.committed")
         self._forget(m)
         self.migrations += 1
         self._inc("repacker_migrations_total")
         self._last_disrupted[m.key] = self.clock()  # lint: disable=R200 (single-writer, same contract as recover)
 
     def _forget(self, m: _Migration) -> None:
+        m.span.end()
         self._active = [x for x in self._active if x is not m]  # lint: disable=R200 (single-writer, same contract as recover)
+
+    def _migration_span(self, claim: dict, recovery: str = ""):
+        """The single mint point for ``repacker.claim.migrate`` spans
+        (T900 pins one call site per name): adopts the claim's trace
+        ctx annotation — which every WAL phase rewrite preserves, so a
+        recovered half-move still stitches into the claim's original
+        trace id."""
+        s = trace.span(
+            "repacker.claim.migrate",
+            ctx=trace.extract(claim),
+            root=True,
+            attrs={
+                "claim": f"{claim['metadata'].get('namespace')}/"
+                         f"{claim['metadata']['name']}",
+            },
+        )
+        if recovery:
+            s.set_attr("recovery", recovery)
+        return s
 
     # --- claim-write helpers ----------------------------------------------
 

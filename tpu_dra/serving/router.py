@@ -58,6 +58,7 @@ from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
+from tpu_dra.infra import trace
 from tpu_dra.workloads.engine import Completion, Evacuated, Request
 
 
@@ -133,7 +134,7 @@ class _FabricReq:
     __slots__ = (
         "rid", "tenant", "prompt", "max_new", "session", "cost",
         "start_tag", "finish_tag", "t_submit", "t_first", "emitted",
-        "replicas",
+        "replicas", "trace_ctx", "t_dispatch",
     )
 
     def __init__(self, rid, tenant, prompt, max_new, session, cost):
@@ -149,6 +150,12 @@ class _FabricReq:
         self.t_first: Optional[float] = None
         self.emitted = np.zeros(0, np.int32)
         self.replicas: List[str] = []
+        # The request's trace identity (None while tracing is off):
+        # minted at submit, it is the serving.request.queued span's
+        # own ctx; dispatch/prefill/first-token/evacuate spans parent
+        # under it (recorded retroactively from the completion stamps).
+        self.trace_ctx = trace.new_ctx()
+        self.t_dispatch: Optional[float] = None
 
     @property
     def remaining(self) -> int:
@@ -447,19 +454,35 @@ class Router:
                         ) * other.spec.weight
                         if lag > self.max_lag_tokens:
                             self.max_lag_tokens = lag
+            now = self.clock()
+            if fr.t_dispatch is None:
+                fr.t_dispatch = now
+                # The queued (root) span closes at FIRST dispatch; an
+                # evacuation re-dispatch must not re-record it under
+                # the same span id.
+                trace.record_span(
+                    "serving.request.queued", fr.t_submit, now,
+                    self_ctx=fr.trace_ctx,
+                    attrs={"rid": fr.rid, "tenant": fr.tenant},
+                )
             prompt = (
                 np.concatenate([fr.prompt, fr.emitted])
                 if len(fr.emitted) else fr.prompt
             )
             rep.inflight[fr.rid] = fr
             fr.replicas.append(rep.name)
-            rep.submit(Request(
-                rid=fr.rid, prompt=prompt, max_new_tokens=fr.remaining,
-                # A resumed sequence whose first token already happened
-                # on the drained replica must not re-observe the
-                # engine's TTFT histogram with a near-zero sample.
-                ttft_preobserved=fr.t_first is not None,
-            ))
+            with trace.span(
+                "serving.request.dispatch", ctx=fr.trace_ctx,
+                attrs={"rid": fr.rid, "replica": rep.name},
+            ):
+                rep.submit(Request(
+                    rid=fr.rid, prompt=prompt, max_new_tokens=fr.remaining,
+                    # A resumed sequence whose first token already
+                    # happened on the drained replica must not
+                    # re-observe the engine's TTFT histogram with a
+                    # near-zero sample.
+                    ttft_preobserved=fr.t_first is not None,
+                ))
             moved = True
         return moved
 
@@ -482,6 +505,24 @@ class Router:
                     t_submit=fr.t_submit, t_first_token=t_first,
                     t_done=c.t_done, replicas=fr.replicas,
                 )
+                if fr.trace_ctx is not None and t_first is not None:
+                    # Retroactive engine-side stages (the completion is
+                    # the first moment the router knows them): prefill
+                    # = dispatch -> first token, first_token = the TTFT
+                    # span the fabric SLO quantiles measure.
+                    if fr.t_dispatch is not None:
+                        trace.record_span(
+                            "serving.request.prefill",
+                            fr.t_dispatch, t_first, ctx=fr.trace_ctx,
+                            attrs={"rid": fr.rid,
+                                   "replica": fr.replicas[0]
+                                   if fr.replicas else ""},
+                        )
+                    trace.record_span(
+                        "serving.request.first_token",
+                        fr.t_submit, t_first, ctx=fr.trace_ctx,
+                        attrs={"rid": fr.rid, "tenant": fr.tenant},
+                    )
                 ts = self._tenants[fr.tenant]
                 with self._lock:
                     ts.served_tokens += len(tokens)
@@ -511,11 +552,25 @@ class Router:
                 fr.emitted = np.concatenate([fr.emitted, ev.emitted])
             if fr.t_first is None:
                 fr.t_first = ev.t_first
+            t_evac = self.clock()
             ts = self._tenants[fr.tenant]
             with self._lock:
                 fr.start_tag = fr.finish_tag = self._vtime
                 ts.queue.appendleft(fr)
                 self._inflight_tokens -= fr.cost
+            if fr.trace_ctx is not None:
+                # The span covers the HAND-BACK + front-splice only
+                # (the taxonomy's "evacuate" stage) — the sequence's
+                # whole residence on the drained replica belongs to
+                # its prefill/decode stages, not this one.
+                trace.record_span(
+                    "serving.request.evacuate", t_evac, self.clock(),
+                    ctx=fr.trace_ctx,
+                    attrs={
+                        "rid": fr.rid, "from_replica": rep.name,
+                        "emitted": int(len(fr.emitted)),
+                    },
+                )
             n += 1
         return n
 

@@ -36,6 +36,7 @@ import argparse
 import json
 import os
 import socket
+import sys
 from typing import Dict, List, Optional
 
 from tpu_dra.plugin.checkpoint import (
@@ -150,6 +151,15 @@ REPACKER_OLDEST_GAUGE = "repacker_oldest_migration_seconds"
 REPACKER_MIGRATIONS_COUNTER = "repacker_migrations_total"
 REPACKER_STUCK_WARN_SECONDS = 60.0
 
+# Metrics cardinality guard (ISSUE 13), suffix-matched like the others:
+# metrics_series_capped_total{name=} counts writes the registry REFUSED
+# because one metric name hit its per-name label-set cap. Any nonzero
+# value means some label carries an unbounded value (a claim name under
+# churn, a request id) and series are being silently dropped from the
+# scrape — the PR-12 remove_gauges lesson showing up as a visible
+# counter instead of unbounded memory.
+SERIES_CAPPED_COUNTER = "metrics_series_capped_total"
+
 # Decode-roofline trend gate (ISSUE 8): the key bench.py records as the
 # gap between the measured decode step and the bf16 HBM floor. Matched
 # by SUFFIX inside the artifact (like the scheduler/engine gauges): the
@@ -162,17 +172,25 @@ BENCH_TREND_KEY = "x_above_bf16_floor"
 BENCH_TREND_REGRESSION = 0.10
 
 
+def _endpoint_url(endpoint: str, path: str) -> str:
+    """host:port / URL -> a full http URL ending in ``path`` (shared
+    by the /metrics scrape and explain's /debug/traces scrape so the
+    normalization rules cannot diverge)."""
+    url = endpoint
+    if not url.startswith(("http://", "https://")):
+        url = f"http://{url}"
+    if not url.endswith(path):
+        url = url.rstrip("/") + path
+    return url
+
+
 def _scrape(endpoint: str, timeout: float = 2.0) -> Dict[str, float]:
     """Fetch and parse a Prometheus text endpoint into
     ``{"name{labels}": value}`` for counters/gauges (summaries included,
     harmless)."""
     import urllib.request
 
-    url = endpoint
-    if not url.startswith(("http://", "https://")):
-        url = f"http://{url}"
-    if not url.endswith("/metrics"):
-        url = url.rstrip("/") + "/metrics"
+    url = _endpoint_url(endpoint, "/metrics")
     out: Dict[str, float] = {}
     with urllib.request.urlopen(url, timeout=timeout) as r:
         for line in r.read().decode().splitlines():
@@ -263,7 +281,34 @@ def probe_metrics(
         repacker = _check_repacker(ep, first, second, warn)
         if repacker:
             report[ep]["repacker"] = repacker
+        capped = _check_cardinality(ep, second or first, warn)
+        if capped:
+            report[ep]["series_capped"] = capped
     return report
+
+
+def _check_cardinality(
+    ep: str, sample: Dict[str, float], warn
+) -> Dict[str, float]:
+    """WARN on any nonzero metrics_series_capped_total{name=} series:
+    the registry is refusing new label sets for that metric name, so
+    some entity's series are missing from this very scrape."""
+    out: Dict[str, float] = {}
+    for series, value in sample.items():
+        base = series.split("{", 1)[0]
+        if not base.endswith(SERIES_CAPPED_COUNTER) or value <= 0:
+            continue
+        out[series] = value
+        warn(
+            f"{ep}: {series} = {value:g} — a metric name hit its "
+            f"per-name series cap and new label sets are being DROPPED "
+            f"from the scrape. Some label carries an unbounded value "
+            f"(claim/request ids under churn): fix the label choice or "
+            f"add the per-entity cleanup (Metrics.remove_gauges) the "
+            f"exporter is missing; raising Metrics(series_cap=) only "
+            f"defers the explosion"
+        )
+    return out
 
 
 def _check_repacker(
@@ -997,6 +1042,8 @@ def render(report: dict) -> str:
             if rep.get("oldest_migration_s", 0.0) > 0:
                 parts.append(f"oldest={rep['oldest_migration_s']:g}s")
             lines.append(f"  repacker: {' '.join(parts)}")
+        for series, v in sorted((m.get("series_capped") or {}).items()):
+            lines.append(f"  series-capped: {series} = {v:g}")
         wq = m.get("workqueue") or {}
         if wq:
             parts = []
@@ -1035,7 +1082,241 @@ def render(report: dict) -> str:
     return "\n".join(lines)
 
 
+# --- `doctor explain` — claim-lifecycle timeline stitching (ISSUE 13) --
+
+
+def _scrape_traces(endpoint: str, timeout: float = 2.0) -> List[dict]:
+    """Fetch one process's /debug/traces flight-recorder dump."""
+    import urllib.request
+
+    url = _endpoint_url(endpoint, "/debug/traces")
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        doc = json.loads(r.read().decode())
+    return doc.get("spans", []) if isinstance(doc, dict) else []
+
+
+def stitch(all_spans: List[dict], trace_id: str) -> List[dict]:
+    """Merge every process's spans for one trace id, deduped by span id
+    (a span can appear in two dumps when recorders are scraped through
+    a shared endpoint), time-ordered."""
+    seen = {}
+    for s in all_spans:
+        if s.get("trace") == trace_id:
+            seen[s["span"]] = s
+    return sorted(seen.values(), key=lambda s: s["wall0"])
+
+
+def stage_budget(spans: List[dict]) -> dict:
+    """The stage breakdown: every instant of the trace window is
+    attributed to exactly ONE span — the DEEPEST (most-nested; ties to
+    the latest-started) span covering it — or to `(unattributed)` when
+    nothing covers it, so the rows SUM to the window by construction.
+    This is the tool that turns 'p99 is 12.7s' into '11.9s was kubelet
+    prepare serialization' without hiding time nothing instruments —
+    and it stays honest for traces with OVERLAPPING siblings (the
+    serving request's first_token measurement span covers the same
+    wall time its prefill/dispatch siblings do; per-span self-time
+    would sum to >100% of the window)."""
+    if not spans:
+        return {"window_s": 0.0, "stages": {}, "unattributed_s": 0.0}
+    t0 = min(s["wall0"] for s in spans)
+    t1 = max(s["wall0"] + max(s["dur_s"], 0.0) for s in spans)
+    by_id = {s["span"]: s for s in spans}
+
+    def depth(s: dict) -> int:
+        d, cur, hops = 0, s, 0
+        while cur["parent"] in by_id and hops < len(spans):
+            cur = by_id[cur["parent"]]
+            d += 1
+            hops += 1
+        return d
+
+    depths = {s["span"]: depth(s) for s in spans}
+    ivals = [
+        (s["wall0"], s["wall0"] + max(s["dur_s"], 0.0), s)
+        for s in spans
+    ]
+    # Sweep over elementary segments between interval boundaries; the
+    # span count per trace is small, so O(segments x spans) is fine.
+    cuts = sorted({a for a, _b, _s in ivals} | {b for _a, b, _s in ivals})
+    stages: Dict[str, float] = {}
+    unattributed = 0.0
+    for seg_a, seg_b in zip(cuts, cuts[1:]):
+        if seg_b <= seg_a:
+            continue
+        covering = [
+            s for a, b, s in ivals if a <= seg_a and b >= seg_b
+        ]
+        if not covering:
+            unattributed += seg_b - seg_a
+            continue
+        winner = max(
+            covering, key=lambda s: (depths[s["span"]], s["wall0"])
+        )
+        stages[winner["name"]] = (
+            stages.get(winner["name"], 0.0) + (seg_b - seg_a)
+        )
+    # Zero-length rows for every span name so the render still lists
+    # instantaneous stages (a 0.0 ms device prepare is information).
+    for s in spans:
+        stages.setdefault(s["name"], 0.0)
+    return {
+        "window_s": t1 - t0,
+        "stages": stages,
+        "unattributed_s": unattributed,
+    }
+
+
+def render_explain(
+    claim_key: str, trace_id: str, spans: List[dict], budget: dict
+) -> str:
+    from tpu_dra.infra import trace as trace_mod
+
+    lines = [
+        f"claim      : {claim_key}",
+        f"trace      : {trace_id} ({len(spans)} spans)",
+        "",
+        trace_mod.render_timeline(spans),
+        "",
+        f"stage budget (window {budget['window_s'] * 1000:.1f} ms):",
+    ]
+    window = budget["window_s"] or 1.0
+    rows = sorted(
+        budget["stages"].items(), key=lambda kv: kv[1], reverse=True
+    )
+    for name, self_t in rows:
+        lines.append(
+            f"  {name:<32} {self_t * 1000:9.1f} ms "
+            f"({self_t / window * 100:5.1f}%)"
+        )
+    lines.append(
+        f"  {'(unattributed)':<32} "
+        f"{budget['unattributed_s'] * 1000:9.1f} ms "
+        f"({budget['unattributed_s'] / window * 100:5.1f}%)"
+    )
+    return "\n".join(lines)
+
+
+def explain_main(argv) -> int:
+    """`doctor explain --claim ns/name`: fetch the claim's ctx
+    annotation, scrape the involved processes' flight recorders, stitch
+    ONE timeline by trace id, and print the stage budget breakdown."""
+    from tpu_dra.infra import flags
+    from tpu_dra.infra import trace as trace_mod
+
+    p = argparse.ArgumentParser(
+        "tpu-dra-doctor explain", description=explain_main.__doc__
+    )
+    flags.KubeClientConfig.add_flags(p)
+    p.add_argument(
+        "--claim", default="",
+        metavar="NS/NAME",
+        help="ResourceClaim whose lifecycle to explain (its "
+        "trace.tpu.google.com/ctx annotation names the trace)",
+    )
+    p.add_argument(
+        "--trace-id", default="",
+        help="Explain this trace id directly (skips the claim fetch — "
+        "for request traces or already-deleted claims)",
+    )
+    p.add_argument(
+        "--trace-endpoint", action="append", default=[],
+        dest="trace_endpoints", metavar="HOST:PORT",
+        help="Component /debug/traces endpoint to scrape (repeatable: "
+        "scheduler + the claim's node plugin + the serving router)",
+    )
+    p.add_argument(
+        "--chrome-out", default="",
+        help="Also write the stitched trace as Chrome/Perfetto "
+        "trace_event JSON to this path",
+    )
+    p.add_argument("--json", action="store_true", dest="as_json")
+    args = p.parse_args(argv)
+
+    trace_id = args.trace_id
+    claim_key = args.claim or "(direct trace id)"
+    if not trace_id:
+        if not args.claim or "/" not in args.claim:
+            print(
+                "doctor explain: need --claim NS/NAME or --trace-id",
+                file=sys.stderr,
+            )
+            return 2
+        ns, _, name = args.claim.partition("/")
+        from tpu_dra.k8sclient import (
+            ApiNotFound, RESOURCE_CLAIMS, ResourceClient,
+        )
+
+        backend = flags.KubeClientConfig.from_args(args).new_client()
+        try:
+            claim = ResourceClient(backend, RESOURCE_CLAIMS).get(name, ns)
+        except ApiNotFound:
+            print(
+                f"doctor explain: claim {args.claim} not found",
+                file=sys.stderr,
+            )
+            return 1
+        raw = (claim["metadata"].get("annotations") or {}).get(
+            trace_mod.TRACE_ANNOTATION, ""
+        )
+        ctx = trace_mod.SpanContext.decode(raw)
+        if ctx is None:
+            print(
+                f"claim {args.claim} carries no "
+                f"{trace_mod.TRACE_ANNOTATION} annotation (allocated "
+                f"before tracing was enabled, or tracing is off)",
+                file=sys.stderr,
+            )
+            return 1
+        trace_id = ctx.trace_id
+    all_spans: List[dict] = []
+    for ep in args.trace_endpoints:
+        # ValueError covers a 200 with a non-JSON body (a proxy error
+        # page, some other service on the port): skip-and-continue so
+        # the remaining recorders still stitch.
+        try:
+            all_spans.extend(_scrape_traces(ep))
+        except (OSError, ValueError) as e:
+            print(
+                f"doctor explain: {ep} did not answer: {e}",
+                file=sys.stderr,
+            )
+    spans = stitch(all_spans, trace_id)
+    if not spans:
+        print(
+            f"no spans for trace {trace_id} in "
+            f"{len(args.trace_endpoints)} recorder(s) — the window may "
+            f"have rotated out of the ring (flight recorders are "
+            f"bounded; docs/observability.md 'Flight recorder sizing')",
+            file=sys.stderr,
+        )
+        return 1
+    budget = stage_budget(spans)
+    if args.chrome_out:
+        with open(args.chrome_out, "w") as f:
+            json.dump(
+                {
+                    "traceEvents": trace_mod.chrome_events(spans),
+                    "displayTimeUnit": "ms",
+                },
+                f,
+            )
+    if args.as_json:
+        print(json.dumps({
+            "claim": claim_key,
+            "trace": trace_id,
+            "spans": spans,
+            "budget": budget,
+        }, indent=2))
+    else:
+        print(render_explain(claim_key, trace_id, spans, budget))
+    return 0
+
+
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if argv and argv[0] == "explain":
+        return explain_main(argv[1:])
     p = argparse.ArgumentParser("tpu-dra-doctor", description=__doc__)
     p.add_argument(
         "--plugin-data-dir",

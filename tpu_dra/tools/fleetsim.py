@@ -64,6 +64,7 @@ import threading
 import time
 from typing import Dict, List
 
+from tpu_dra.infra import trace
 from tpu_dra.infra.metrics import Metrics
 from tpu_dra.infra.workqueue import (
     ShardedWorkQueue,
@@ -242,19 +243,28 @@ class KubeletSim:
         with self._lock:
             if name in self.ready:
                 return
-        results = claim["status"]["allocation"]["devices"]["results"]
-        env = {
-            "TPU_DRA_CLAIM": claim["metadata"].get("uid", name),
-        }
-        for i, r in enumerate(results):
-            env[f"TPU_DRA_DEVICE_{i}"] = f"{r['pool']}/{r['device']}"
-        if self.prepare_ms > 0:
-            # The kubelet RPC + CDI spec write stand-in; serialized per
-            # node like the real plugin's prepare path.
-            time.sleep(self.prepare_ms / 1000.0)
-        with self._lock:
-            if name not in self.ready:
-                self.ready[name] = (time.monotonic(), env)
+        # Adopt the claim's ctx annotation (stamped by the scheduler's
+        # allocation commit): the harness's prepare stand-in stitches
+        # into the claim's trace exactly like the real plugin's
+        # plugin.claim.prepare does — `make tracecheck` asserts it.
+        with trace.span(
+            "kubelet.claim.prepare",
+            ctx=trace.extract(claim),
+            attrs={"claim": name},
+        ):
+            results = claim["status"]["allocation"]["devices"]["results"]
+            env = {
+                "TPU_DRA_CLAIM": claim["metadata"].get("uid", name),
+            }
+            for i, r in enumerate(results):
+                env[f"TPU_DRA_DEVICE_{i}"] = f"{r['pool']}/{r['device']}"
+            if self.prepare_ms > 0:
+                # The kubelet RPC + CDI spec write stand-in; serialized
+                # per node like the real plugin's prepare path.
+                time.sleep(self.prepare_ms / 1000.0)
+            with self._lock:
+                if name not in self.ready:
+                    self.ready[name] = (time.monotonic(), env)
 
     def ready_count(self) -> int:
         with self._lock:
@@ -738,6 +748,46 @@ def run(
         base["claim_ready_p99_ms"] / opt["claim_ready_p99_ms"]
         if opt["claim_ready_p99_ms"] > 0 else 0.0
     )
+
+    # Tracing-overhead leg (ISSUE 13): the IDENTICAL seeded trace over
+    # the optimized stack with TPU_DRA_TRACE=0 semantics — the traced
+    # mode above vs this one is the `fleet_trace_overhead_pct` the
+    # overhead gate rides (tracing must be near-free when on, a shared
+    # no-op when off).
+    _note(
+        "untraced: rerunning the optimized leg with tracing disabled "
+        "(overhead measurement)"
+    )
+    prev_traced = trace.set_enabled(False)
+    try:
+        untraced_mode = _ModeRun(
+            nodes, claims, rate, seed, True, storm_tick,
+            storm_frac, prepare_ms, churn, sample_scoped=8,
+        )
+        untraced_mode.start()
+        try:
+            untraced = untraced_mode.run_trace()
+            if untraced["unready"]:
+                raise RuntimeError(
+                    f"untraced: {untraced['unready']} claim(s) never "
+                    f"became ready"
+                )
+        finally:
+            untraced_mode.stop()
+    finally:
+        trace.set_enabled(prev_traced)
+    overhead_pct = (
+        (opt["claim_ready_p99_ms"] / untraced["claim_ready_p99_ms"] - 1.0)
+        * 100.0
+        if untraced["claim_ready_p99_ms"] > 0 else 0.0
+    )
+    modes["untraced"] = untraced
+    _note(
+        f"trace overhead: traced p99 {opt['claim_ready_p99_ms']} ms vs "
+        f"untraced {untraced['claim_ready_p99_ms']} ms -> "
+        f"{overhead_pct:+.1f}%"
+    )
+
     fairness = _assert_shard_fairness()
     report.update({
         "fleet_claims": claims,
@@ -750,6 +800,9 @@ def run(
         "fleet_baseline_publish_writes": base["publish_writes"],
         "fleet_baseline_claim_ready_p50_ms": base["claim_ready_p50_ms"],
         "fleet_baseline_claim_ready_p99_ms": base["claim_ready_p99_ms"],
+        "fleet_trace_overhead_pct": round(overhead_pct, 2),
+        "fleet_untraced_claim_ready_p99_ms":
+            untraced["claim_ready_p99_ms"],
         "fleet_scoped_informer_max_objects":
             opt["relist_storm"]["scoped_informer_max_objects"],
         "fleet_unscoped_informer_objects":
@@ -760,8 +813,23 @@ def run(
         "modes": modes,
     })
 
+    allow_gap = os.environ.get("FLEETSIM_ALLOW_GAP") == "1"
+    # Tracing-overhead gate, smoke AND full leg. The acceptance bound
+    # is <5% at the full-leg scale (where p99 is seconds and stable);
+    # the smoke's p99 is tens of milliseconds on a shared CI machine,
+    # so the smoke bound is loosened to absorb scheduler-tick noise
+    # while still catching a structural regression (a lock, a sync
+    # write, an O(n) pass on the hot path shows up as x2, not +25%).
+    bound = 25.0 if smoke else 5.0
+    if not allow_gap:
+        assert overhead_pct < bound, (
+            f"trace overhead gate: traced claim-ready p99 "
+            f"{opt['claim_ready_p99_ms']} ms is {overhead_pct:+.1f}% "
+            f"over the untraced {untraced['claim_ready_p99_ms']} ms "
+            f"(bound {bound}%; FLEETSIM_ALLOW_GAP=1 to bypass on a "
+            f"hostile machine)"
+        )
     if smoke:
-        allow_gap = os.environ.get("FLEETSIM_ALLOW_GAP") == "1"
         # The SLO keys the bench leg records must be present and sane.
         for key in (
             "fleet_claim_ready_p50_ms", "fleet_claim_ready_p99_ms",
@@ -785,8 +853,9 @@ def run(
         )
         _note(
             "smoke contract: SLO keys present, p99 gate "
-            f"({speedup:.2f}x), publish batching, relist flatness, "
-            "shard fairness — all hold"
+            f"({speedup:.2f}x), publish batching, trace overhead "
+            f"({overhead_pct:+.1f}%), relist flatness, shard fairness "
+            "— all hold"
         )
     return report
 
