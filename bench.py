@@ -1622,6 +1622,18 @@ def main() -> int:
         f"{fleetrep['fleet_baseline_publish_writes']}",
         file=sys.stderr,
     )
+    print(
+        f"slo (wire, {fleetrep['slo_nodes']} nodes): write budget "
+        f"{fleetrep['slo_writes_per_node_per_hour']}/node/h (burn "
+        f"{fleetrep['slo_write_budget_burn_rate']}, "
+        f"ok={fleetrep['slo_write_budget_ok']}), claim-ready p99 "
+        f"{fleetrep['slo_claim_ready_p99_s']}s (burn "
+        f"{fleetrep['slo_claim_ready_burn_rate']}); injected "
+        f"naive-publish regression -> "
+        f"{fleetrep['slo_regression_alert']} at burn "
+        f"{fleetrep['slo_regression_burn_rate']}",
+        file=sys.stderr,
+    )
 
     # Serving-fabric leg (ISSUE 11): CPU-side like the fleet leg (the
     # engines are pinned to CPU — this measures the tier ABOVE the
@@ -1986,6 +1998,36 @@ def main() -> int:
                 "fleet_trace_overhead_pct": fleetrep[
                     "fleet_trace_overhead_pct"
                 ],
+                # Fleet SLO engine (ISSUE 14): the write budget and
+                # claim-ready objectives evaluated OVER THE WIRE by
+                # fleetmon scraping the live wire-mode fleet —
+                # ROADMAP item 5's apiserver write budget as a
+                # first-class SLO (the content-diffed publisher's
+                # zero-write steady state monitored, with the injected
+                # naive-publish regression tripping the multi-window
+                # burn-rate page), plus fabricbench's per-class TTFT
+                # verdicts from the identical catalog.
+                "slo_write_budget_ok": fleetrep["slo_write_budget_ok"],
+                "slo_write_budget_burn_rate": fleetrep[
+                    "slo_write_budget_burn_rate"
+                ],
+                "slo_writes_per_node_per_hour": fleetrep[
+                    "slo_writes_per_node_per_hour"
+                ],
+                "slo_claim_ready_burn_rate": fleetrep[
+                    "slo_claim_ready_burn_rate"
+                ],
+                "slo_claim_ready_p99_s": fleetrep[
+                    "slo_claim_ready_p99_s"
+                ],
+                "slo_regression_alert": fleetrep["slo_regression_alert"],
+                "slo_regression_burn_rate": fleetrep[
+                    "slo_regression_burn_rate"
+                ],
+                "slo_ttft_interactive_burn_rate": fabric[
+                    "slo_ttft_interactive_burn_rate"
+                ],
+                "slo_ttft_batch_ok": fabric["slo_ttft_batch_ok"],
                 # Serving-fabric leg (ISSUE 11): the multi-tenant
                 # router + claim-driven autoscaler over the synthetic
                 # fleet — submitted->first-token SLO at 10k+ concurrent

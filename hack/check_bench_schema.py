@@ -107,6 +107,21 @@ TYPED_WHEN_PRESENT = {
     # forward-requires fleet_trace_overhead_pct.
     "fleet_trace_overhead_pct": (int, float),
     "fleet_untraced_claim_ready_p99_ms": (int, float),
+    # Fleet SLO engine (ISSUE 14): fleetmon's over-the-wire verdicts —
+    # the apiserver write budget (bool verdict + burn rate + measured
+    # writes/node/h), the claim-ready burn rate, the injected
+    # naive-publish regression's alert state, and fabricbench's
+    # per-class TTFT catalog keys. The B100 pass forward-requires
+    # slo_write_budget_ok / slo_claim_ready_burn_rate.
+    "slo_write_budget_ok": bool,
+    "slo_write_budget_burn_rate": (int, float),
+    "slo_writes_per_node_per_hour": (int, float),
+    "slo_claim_ready_burn_rate": (int, float),
+    "slo_claim_ready_p99_s": (int, float),
+    "slo_regression_alert": str,
+    "slo_regression_burn_rate": (int, float),
+    "slo_ttft_interactive_burn_rate": (int, float),
+    "slo_ttft_batch_ok": bool,
     # Serving-fabric leg (ISSUE 11): submitted -> first-token SLO over
     # the engine-replica fleet, per-tenant fairness, and the
     # claim-driven autoscaler record. The B100 pass forward-requires

@@ -80,6 +80,13 @@ REQUIRED_STATIC = (
     # dropping it would blind the tracing-is-free gate before its
     # first recorded artifact.
     "fleet_trace_overhead_pct",
+    # Fleet SLO engine (ISSUE 14): the apiserver write budget evaluated
+    # over the wire (the content-diffed publisher's zero-write steady
+    # state as a monitored objective) and the claim-ready burn rate —
+    # dropping either would blind the SLO-engine regression tripwire
+    # before its first recorded artifact.
+    "slo_write_budget_ok",
+    "slo_claim_ready_burn_rate",
 )
 
 

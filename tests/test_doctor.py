@@ -1012,3 +1012,85 @@ def test_metrics_probe_warns_on_stuck_migration(tmp_path):
         assert report["warnings"] == [], report["warnings"]
     finally:
         srv.stop()
+
+
+def test_metrics_probe_warns_on_fleetmon_target_down(tmp_path):
+    """A fleet monitor reporting a dead scrape target means the SLO
+    engine is judging burn rates over a partial view — WARN with the
+    endpoint/--target remediation, 'fleetmon:' render line."""
+    from tpu_dra.infra.metrics import Metrics, MetricsServer
+
+    metrics = Metrics()
+    metrics.set_gauge("fleetmon_scrape_interval_seconds", 15.0)
+    metrics.set_gauge(
+        "fleetmon_target_up", 0.0, labels={"target": "plugin"}
+    )
+    metrics.set_gauge(
+        "fleetmon_target_up", 1.0, labels={"target": "scheduler"}
+    )
+    metrics.set_gauge(
+        "fleetmon_scrape_age_seconds", 2.0,
+        labels={"target": "scheduler"},
+    )
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[f"127.0.0.1:{srv.port}"],
+        )
+        warns = "\n".join(report["warnings"])
+        assert "'plugin' is DOWN" in warns
+        assert "PARTIAL view" in warns
+        assert "scheduler" not in warns  # the healthy target is quiet
+        out = render(report)
+        assert "fleetmon: up=1/2" in out
+        assert "down[plugin]" in out
+    finally:
+        srv.stop()
+
+
+def test_metrics_probe_warns_on_fleetmon_staleness(tmp_path):
+    """A target that answers up=1 but whose last successful scrape is
+    older than 3 intervals is STALE — the burn rates are running on
+    old samples. Fresh targets stay quiet."""
+    from tpu_dra.infra.metrics import Metrics, MetricsServer
+
+    metrics = Metrics()
+    metrics.set_gauge("fleetmon_scrape_interval_seconds", 15.0)
+    metrics.set_gauge(
+        "fleetmon_target_up", 1.0, labels={"target": "router"}
+    )
+    metrics.set_gauge(
+        "fleetmon_scrape_age_seconds", 100.0,
+        labels={"target": "router"},
+    )
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        endpoint = f"127.0.0.1:{srv.port}"
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint],
+        )
+        warns = "\n".join(report["warnings"])
+        assert "'router' is STALE" in warns
+        assert "old samples" in warns
+        assert "stale[router]=100s" in render(report)
+        # Fresh again: quiet.
+        metrics.set_gauge(
+            "fleetmon_scrape_age_seconds", 3.0,
+            labels={"target": "router"},
+        )
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint],
+        )
+        assert report["warnings"] == [], report["warnings"]
+    finally:
+        srv.stop()

@@ -1,0 +1,516 @@
+"""fleetmon (ISSUE 14): exposition parsing round-trips the registry's
+escaping, TYPE-line classification, the scraper's target health, and
+`doctor slo` snapshot triage."""
+
+import json
+import time
+
+import pytest
+
+from tpu_dra.infra import slo
+from tpu_dra.infra.metrics import Metrics, MetricsServer
+from tpu_dra.tools import fleetmon
+from tpu_dra.tools.fleetmon import (
+    FleetMon,
+    Target,
+    builtin_catalog,
+    parse_exposition,
+    render_dashboard,
+)
+
+
+def _assert_round_trip(m: Metrics):
+    """Golden contract: parse(render()) recovers every series the
+    registry holds — exact name, exact labels (escaping honored), exact
+    value — and classifies each family from its `# TYPE` line."""
+    samples = parse_exposition(m.render())
+    by_key = {(s.name, s.labels): s for s in samples}
+    assert len(by_key) == len(samples), "duplicate series in render"
+    with m._lock:
+        counters = dict(m._counters)
+        gauges = dict(m._gauges)
+        timing_keys = list(m._timing_sum)
+    for (name, labels), v in counters.items():
+        s = by_key[(f"{m.prefix}_{name}", labels)]
+        assert s.value == pytest.approx(v)
+        assert s.type == "counter"
+    for (name, labels), v in gauges.items():
+        s = by_key[(f"{m.prefix}_{name}", labels)]
+        assert s.value == pytest.approx(v)
+        assert s.type == "gauge"
+    for name, labels in timing_keys:
+        full = f"{m.prefix}_{name}"
+        fam = [
+            s for s in samples
+            if s.name in (full, f"{full}_sum", f"{full}_count")
+            and set(labels) <= set(s.labels)
+        ]
+        assert fam, f"summary family {full} missing from parse"
+        assert all(s.type == "summary" for s in fam), fam
+        q99 = [
+            s for s in fam
+            if ("quantile", "0.99") in s.labels
+        ]
+        assert q99, f"summary {full} rendered no 0.99 quantile"
+    return samples
+
+
+def test_round_trip_synthetic_registry_with_hostile_labels():
+    m = Metrics()
+    hostile = 'claim-"q"\\b\nnl,eq=x'
+    m.inc("prepare_total", 3, labels={"claim": hostile})
+    m.inc("prepare_total", 1, labels={"claim": "plain"})
+    m.set_gauge("occupancy", 0.5, labels={"claim": hostile, "le": "1"})
+    for i in range(50):
+        m.observe("prepare_seconds", i / 100.0, labels={"node": "n,1"})
+    samples = _assert_round_trip(m)
+    # The hostile value itself survived the wire exactly.
+    assert any(
+        ("claim", hostile) in s.labels for s in samples
+    )
+
+
+def _wait_for(pred, timeout=10.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+def test_round_trip_real_control_plane_registries():
+    """Every real component's render output round-trips: the publisher
+    + scheduler + kubelet-analog stack sharing one registry over a
+    live mini-fleet run (the composition fleetmon actually scrapes)."""
+    from tpu_dra.k8sclient import RESOURCE_CLAIMS, ResourceClient
+    from tpu_dra.k8sclient.fake import FakeCluster
+    from tpu_dra.scheduler import fleet
+    from tpu_dra.scheduler.core import SchedulerCore
+    from tpu_dra.tools import fleetsim
+
+    cluster = FakeCluster()
+    m = Metrics()
+    fleetsim.spin_fleet(cluster, 2, m)
+    submit = {}
+    core = SchedulerCore(cluster, metrics=m)
+    kub = fleetsim.KubeletSim(
+        cluster, m, sharded=True, prepare_ms=0.0,
+        submit_time_of=submit.get,
+    )
+    core.start()
+    kub.start()
+    try:
+        claims = ResourceClient(cluster, RESOURCE_CLAIMS)
+        for i in range(3):
+            c = fleet.make_claim(i, "1x1x1")
+            submit[c["metadata"]["name"]] = time.monotonic()
+            claims.create(c)
+        _wait_for(lambda: kub.ready_count() == 3, what="claims prepared")
+        # The kubelet exported the SLO engine's claim-ready series.
+        assert m.quantile("claim_ready_seconds", 0.99) is not None
+        samples = _assert_round_trip(m)
+        names = {s.name for s in samples}
+        # The catalog's fleet series are present and typed.
+        assert "tpu_dra_publish_writes_total" in names
+        assert "tpu_dra_scheduler_frag_score" in names
+        assert "tpu_dra_claim_ready_seconds" in names
+    finally:
+        kub.stop()
+        core.stop()
+
+
+def test_round_trip_workqueue_and_informer_registries():
+    from tpu_dra.infra.workqueue import (
+        ShardedWorkQueue,
+        default_controller_rate_limiter,
+    )
+    from tpu_dra.k8sclient import RESOURCE_SLICES, Informer
+    from tpu_dra.k8sclient.fake import FakeCluster
+
+    m = Metrics()
+    q = ShardedWorkQueue(
+        shards=2, metrics=m,
+        rate_limiter_factory=default_controller_rate_limiter,
+    )
+    q.run_in_threads()
+    done = []
+    q.enqueue({"x": 1}, done.append, key="a", shard_key="a")
+    deadline = time.monotonic() + 5
+    while not done and time.monotonic() < deadline:
+        time.sleep(0.01)
+    q.shutdown()
+    inf = Informer(FakeCluster(), RESOURCE_SLICES, metrics=m)
+    inf.start()
+    assert inf.wait_for_sync(timeout=5)
+    inf.stop()
+    _assert_round_trip(m)
+
+
+def test_type_line_classifies_without_suffix_heuristics():
+    """A counter whose name carries no _total suffix still classifies
+    as a counter — from the TYPE line, not a name heuristic."""
+    text = (
+        "# TYPE weird counter\n"
+        "weird 3.0\n"
+        "# TYPE lat summary\n"
+        'lat{quantile="0.5"} 0.01\n'
+        "lat_sum 1.5\n"
+        "lat_count 100\n"
+        "untyped_thing 7\n"
+    )
+    samples = {s.name: s for s in parse_exposition(text)}
+    assert samples["weird"].type == "counter"
+    assert samples["lat"].type == "summary"
+    assert samples["lat_sum"].type == "summary"
+    assert samples["lat_count"].type == "summary"
+    assert samples["untyped_thing"].type == "untyped"
+
+
+def test_parser_skips_malformed_lines_not_whole_page():
+    text = (
+        "# TYPE ok gauge\n"
+        "ok 1.0\n"
+        "broken{unclosed 3\n"
+        "alsobroken notanumber\n"
+        "ok2 2.0\n"
+    )
+    names = {s.name for s in parse_exposition(text)}
+    assert names == {"ok", "ok2"}
+
+
+# --- the scraper -------------------------------------------------------------
+
+
+def test_fleetmon_scrapes_real_http_endpoint_and_reports_up():
+    m = Metrics()
+    m.inc("publish_writes_total", 5)
+    srv = MetricsServer(m, port=0, address="127.0.0.1")
+    srv.start()
+    own = Metrics()
+    try:
+        fm = FleetMon(
+            [Target("fleet", f"127.0.0.1:{srv.port}")],
+            catalog=builtin_catalog(nodes=4),
+            interval_s=0.1, metrics=own,
+        )
+        assert fm.scrape_once() == {"fleet": True}
+        assert fm.store.keys("publish_writes_total")
+        assert own.get_gauge(
+            "fleetmon_target_up", {"target": "fleet"}
+        ) == 1.0
+        rep = fm.target_report()
+        assert rep["fleet"]["up"] and not rep["fleet"]["stale"]
+    finally:
+        srv.stop()
+
+
+def test_dead_target_reports_down_and_snapshot_carries_it():
+    own = Metrics()
+    fm = FleetMon(
+        [Target("ghost", "127.0.0.1:1")],
+        catalog=[], interval_s=0.1, metrics=own,
+    )
+    assert fm.scrape_once() == {"ghost": False}
+    assert own.get_gauge(
+        "fleetmon_target_up", {"target": "ghost"}
+    ) == 0.0
+    assert own.get_counter(
+        "fleetmon_scrape_errors_total", {"target": "ghost"}
+    ) == 1.0
+    snap = fm.snapshot()
+    assert snap["targets"]["ghost"]["up"] is False
+    assert snap["targets"]["ghost"]["last_error"]
+    assert "DOWN" in render_dashboard(snap)
+
+
+def test_staleness_past_three_intervals():
+    m = Metrics()
+    clock = {"t": 100.0}
+    fm = FleetMon(
+        [Target("t", fetch=m.render)],
+        catalog=[], interval_s=1.0, metrics=Metrics(),
+        clock=lambda: clock["t"],
+    )
+    fm.scrape_once()
+    assert fm.target_report()["t"]["stale"] is False
+    clock["t"] += 3.5  # > 3 intervals since the last success
+    rep = fm.target_report()
+    assert rep["t"]["stale"] is True
+    assert rep["t"]["up"] is True  # up-but-stale is its own verdict
+    # The exported age gauge refreshes at render (collector).
+    text = fm.metrics.render()
+    assert "fleetmon_scrape_age_seconds" in text
+
+
+def test_scrape_feeds_catalog_to_page(tmp_path):
+    """End to end in-process: a regressing write counter scraped on a
+    fake clock drives the write-budget SLO to page."""
+    m = Metrics()
+    clock = {"t": 0.0}
+    fm = FleetMon(
+        [Target("fleet", fetch=m.render)],
+        catalog=builtin_catalog(nodes=2, window_scale=1.0 / 600.0),
+        interval_s=0.5, clock=lambda: clock["t"],
+    )
+    m.inc("publish_writes_total", 0)  # the publisher exists, at zero
+    for _ in range(30):  # 15s of steady state
+        clock["t"] += 0.5
+        fm.scrape_once()
+    st = fm.status_of("write-budget")
+    assert st.data and st.ok and st.alert is None
+    for _ in range(30):  # 15s of 4-writes-per-half-second regression
+        clock["t"] += 0.5
+        m.inc("publish_writes_total", 4)
+        fm.scrape_once()
+    st = fm.status_of("write-budget")
+    # 8/s over 2 nodes = 14400/node/h = 240x the 60/h budget.
+    assert st.alert == "page"
+    assert st.burn_rate > 14.4
+
+
+# --- doctor slo --------------------------------------------------------------
+
+
+def _paging_snapshot() -> dict:
+    m = Metrics()
+    clock = {"t": 0.0}
+    fm = FleetMon(
+        [Target("fleet", fetch=m.render), Target("ghost", "127.0.0.1:1")],
+        catalog=builtin_catalog(nodes=2, window_scale=1.0 / 600.0),
+        interval_s=0.5, clock=lambda: clock["t"],
+    )
+    for _ in range(30):
+        clock["t"] += 0.5
+        m.inc("publish_writes_total", 4)
+        m.set_gauge("scheduler_frag_score", 0.0)
+        fm.scrape_once()
+    return fm.snapshot()
+
+
+def test_doctor_slo_renders_burn_budget_and_remediation(
+    tmp_path, capsys
+):
+    from tpu_dra.tools import doctor
+
+    snap = _paging_snapshot()
+    path = tmp_path / "slo.json"
+    path.write_text(json.dumps(snap))
+    rc = doctor.main(["slo", "--snapshot", str(path)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "write-budget" in out and "PAGE" in out
+    assert "burn=" in out and "budget-left=" in out
+    # The catalog's remediation text reaches the operator.
+    assert "content-diffed publisher" in out
+    # The dead target is a warning too.
+    assert any(
+        "DOWN" in line for line in out.splitlines()
+        if line.startswith("WARN")
+    )
+
+
+def test_doctor_slo_healthy_snapshot_rc0(tmp_path, capsys):
+    from tpu_dra.tools import doctor
+
+    m = Metrics()
+    clock = {"t": 0.0}
+    fm = FleetMon(
+        [Target("fleet", fetch=m.render)],
+        catalog=builtin_catalog(nodes=2, window_scale=1.0 / 600.0),
+        interval_s=0.5, clock=lambda: clock["t"],
+    )
+    for _ in range(20):
+        clock["t"] += 0.5
+        m.set_gauge("scheduler_frag_score", 0.05)
+        fm.scrape_once()
+    path = tmp_path / "slo.json"
+    path.write_text(json.dumps(fm.snapshot()))
+    rc = doctor.main(["slo", "--snapshot", str(path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "healthy: every SLO inside budget" in out
+
+
+def test_doctor_slo_flags_counter_reset_not_bogus_burn(
+    tmp_path, capsys
+):
+    """Satellite: a restarted exporter must surface as 'process
+    restarted', never as a bogus burn verdict."""
+    from tpu_dra.tools import doctor
+
+    m = Metrics()
+    clock = {"t": 0.0}
+    fm = FleetMon(
+        [Target("fleet", fetch=m.render)],
+        catalog=builtin_catalog(nodes=2, window_scale=1.0 / 600.0),
+        interval_s=0.5, clock=lambda: clock["t"],
+    )
+    m.inc("publish_writes_total", 500)  # long-lived process...
+    for _ in range(10):
+        clock["t"] += 0.5
+        fm.scrape_once()
+    # ...restarts: the counter re-exports from zero.
+    with m._lock:
+        m._counters[("publish_writes_total", ())] = 0.0
+    for _ in range(10):
+        clock["t"] += 0.5
+        fm.scrape_once()
+    st = fm.status_of("write-budget")
+    assert st.resets >= 1
+    assert st.alert is None  # the 500-drop never became a burn
+    assert all(b >= 0 for b in st.burn.values())
+    path = tmp_path / "slo.json"
+    path.write_text(json.dumps(fm.snapshot()))
+    rc = doctor.main(["slo", "--snapshot", str(path)])
+    out = capsys.readouterr().out
+    assert "counter reset" in out and "RESTARTED" in out
+    assert rc == 0  # a restart alone is not an SLO violation
+
+
+def test_doctor_slo_bad_args(tmp_path, capsys):
+    from tpu_dra.tools import doctor
+
+    assert doctor.main(["slo"]) == 2
+    assert doctor.main(
+        ["slo", "--snapshot", str(tmp_path / "missing.json")]
+    ) == 2
+    capsys.readouterr()
+
+
+def test_fleetmon_cli_once_writes_snapshot(tmp_path, capsys):
+    m = Metrics()
+    m.set_gauge("scheduler_frag_score", 0.0)
+    srv = MetricsServer(m, port=0, address="127.0.0.1")
+    srv.start()
+    try:
+        out_path = tmp_path / "snap.json"
+        rc = fleetmon.main([
+            "--target", f"fleet=127.0.0.1:{srv.port}",
+            "--interval", "0.1", "--once",
+            "--json-out", str(out_path),
+        ])
+        capsys.readouterr()
+        assert rc == 0
+        snap = json.loads(out_path.read_text())
+        assert snap["targets"]["fleet"]["up"] is True
+        assert any(
+            s["name"] == "frag-ceiling" and s["data"]
+            for s in snap["slos"]
+        )
+    finally:
+        srv.stop()
+
+
+def test_fleetmon_cli_requires_targets(capsys):
+    assert fleetmon.main(["--once"]) == 2
+    capsys.readouterr()
+
+
+def test_sample_store_integration_prefix_agnostic():
+    """A CD-prefixed registry (tpu_dra_cd_...) still matches the
+    catalog's suffix series."""
+    m = Metrics(prefix="tpu_dra_cd")
+    m.set_gauge("api_circuit_state", 2.0, labels={"verb": "update"})
+    store = slo.SampleStore()
+    store.ingest(parse_exposition(m.render()), t=1.0)
+    assert store.keys("api_circuit_state")
+
+
+# --- review-hardening pins ---------------------------------------------------
+
+
+def test_parser_accepts_optional_trailing_timestamp():
+    """The exposition format allows `name{l=\"v\"} value timestamp`;
+    a standard exporter's stamped lines must parse, not silently empty
+    the store."""
+    text = (
+        "# TYPE w counter\n"
+        'w{l="a"} 3.5 1690000000000\n'
+        "plain 2 1690000000000\n"
+    )
+    samples = {s.name: s for s in parse_exposition(text)}
+    assert samples["w"].value == 3.5
+    assert samples["plain"].value == 2.0
+
+
+def test_doctor_label_extraction_is_escape_aware():
+    """A target name carrying a comma or escaped quote must not split
+    into a phantom target (doctor delegates to fleetmon's parser)."""
+    from tpu_dra.tools.doctor import _label_of
+    from tpu_dra.tools.fleetmon import parse_series_labels
+
+    m = Metrics()
+    hostile = 'a,b="c'
+    m.set_gauge("fleetmon_target_up", 0.0, labels={"target": hostile})
+    line = next(
+        ln for ln in m.render().splitlines()
+        if ln.startswith("tpu_dra_fleetmon_target_up{")
+    )
+    series = line.rsplit(" ", 1)[0]
+    assert _label_of(series, "target") == hostile
+    assert parse_series_labels(series) == {"target": hostile}
+    assert _label_of("no_labels_here", "target") == "?"
+
+
+def test_stop_unhooks_collector_and_drops_health_gauges():
+    """A stopped monitor on a shared registry must not keep exporting
+    ever-growing scrape ages (the doctor would flag STALE targets for
+    a monitor that was deliberately stopped)."""
+    shared = Metrics()
+    m = Metrics()
+    fm = FleetMon(
+        [Target("t", fetch=m.render)],
+        catalog=[], interval_s=0.05, metrics=shared,
+    )
+    fm.start()
+    deadline = time.monotonic() + 5
+    while (
+        shared.get_gauge("fleetmon_target_up", {"target": "t"}) is None
+        and time.monotonic() < deadline
+    ):
+        time.sleep(0.01)
+    assert "fleetmon_scrape_age_seconds" in shared.render()
+    fm.stop()
+    text = shared.render()
+    assert "fleetmon_target_up" not in text
+    assert "fleetmon_scrape_age_seconds" not in text
+    assert "fleetmon_scrape_interval_seconds" not in text
+    assert fm._export_ages not in shared._collectors
+    # Restart re-hooks symmetrically (and never double-registers).
+    fm.start()
+    assert shared._collectors.count(fm._export_ages) == 1
+    fm.stop()
+
+
+def test_cross_target_identical_series_do_not_merge():
+    """Two components legitimately export the SAME series name
+    (every node plugin has publish_writes_total): without the
+    per-target instance label their counters would interleave in one
+    ring — target A's 1000 -> target B's 10 read as a counter reset
+    EVERY scrape, a phantom page on a healthy fleet."""
+    a, b = Metrics(), Metrics()
+    a.inc("publish_writes_total", 1000)
+    b.inc("publish_writes_total", 10)
+    clock = {"t": 0.0}
+    fm = FleetMon(
+        [Target("a", fetch=a.render), Target("b", fetch=b.render)],
+        catalog=builtin_catalog(nodes=2, window_scale=1.0 / 600.0),
+        interval_s=0.5, clock=lambda: clock["t"],
+    )
+    for _ in range(20):  # steady: neither counter moves
+        clock["t"] += 0.5
+        fm.scrape_once()
+    st = fm.status_of("write-budget")
+    assert st.series == 2  # one series PER TARGET, not one merged ring
+    assert st.resets == 0
+    assert st.burn_rate == 0.0 and st.ok and st.alert is None
+    # And a real burn still sums across the fleet's instances.
+    for _ in range(10):
+        clock["t"] += 0.5
+        a.inc("publish_writes_total", 2)
+        b.inc("publish_writes_total", 2)
+        fm.scrape_once()
+    st = fm.status_of("write-budget")
+    # 8/s fleet-wide over 2 nodes = 14400/node/h = 240x budget.
+    assert st.alert == "page" and st.resets == 0

@@ -350,3 +350,36 @@ def test_publisher_reverify_heals_external_deletion():
     assert pub.publish(_build_for(0)) == 0
     pub.invalidate()
     assert pub.publish(_build_for(0)) == 1
+
+
+def test_kubelet_sim_exports_claim_ready_seconds_once():
+    """ISSUE 14: with a submit-time lookup, the kubelet analog exports
+    the claim-submitted -> pod-env-injected latency as the
+    `claim_ready_seconds` summary (the series fleetmon's
+    claim-ready-p99 SLO evaluates over the wire) — observed exactly
+    once per claim, MODIFIED storms included."""
+    cluster = FakeCluster()
+    m = Metrics()
+    t0 = time.monotonic() - 0.25
+    kub = fleetsim.KubeletSim(
+        cluster, m, sharded=True, prepare_ms=0.0,
+        submit_time_of={"c-1": t0}.get,
+    )
+    claim = {
+        "metadata": {"name": "c-1", "namespace": "fleetsim", "uid": "u1"},
+        "status": {"allocation": {"devices": {"results": [
+            {"driver": fleet.DRIVER, "pool": fleet.node_name(0),
+             "device": "ss-1x1x1-0-0-0"},
+        ]}}},
+    }
+    kub.start()
+    try:
+        for _ in range(4):
+            kub._on_claim("MODIFIED", claim)
+        wait_for(lambda: kub.ready_count() == 1, what="claim prepared")
+        time.sleep(0.05)
+        assert m._timing_count[("claim_ready_seconds", ())] == 1
+        lat = m.quantile("claim_ready_seconds", 0.5)
+        assert lat is not None and lat >= 0.25
+    finally:
+        kub.stop()

@@ -170,3 +170,33 @@ def test_series_cap_frees_slots_on_gauge_removal():
     m.remove_gauges("per_claim", {"claim": "b"})
     m.set_gauge("per_claim", 1.0, labels={"claim": "d"})
     assert m.get_gauge("per_claim", labels={"claim": "d"}) == 1.0
+
+
+# --- exposition TYPE lines (ISSUE 14 satellite) ------------------------------
+
+
+def test_type_lines_emitted_once_per_family():
+    """One `# TYPE` line per metric NAME, not per labeled series (the
+    exposition format forbids repeats, and the fleetmon parser
+    classifies series from these lines)."""
+    m = Metrics()
+    m.inc("writes_total", labels={"node": "a"})
+    m.inc("writes_total", labels={"node": "b"})
+    m.set_gauge("depth", 1.0, labels={"shard": "0"})
+    m.set_gauge("depth", 2.0, labels={"shard": "1"})
+    m.observe("lat_seconds", 0.1, labels={"shard": "0"})
+    m.observe("lat_seconds", 0.2, labels={"shard": "1"})
+    text = m.render()
+    assert text.count("# TYPE tpu_dra_writes_total counter") == 1
+    assert text.count("# TYPE tpu_dra_depth gauge") == 1
+    assert text.count("# TYPE tpu_dra_lat_seconds summary") == 1
+    # Each family's TYPE line precedes its first series line.
+    lines = text.splitlines()
+    for family in ("writes_total", "depth", "lat_seconds"):
+        first_series = next(
+            i for i, ln in enumerate(lines)
+            if ln.startswith(f"tpu_dra_{family}")
+        )
+        assert lines[first_series - 1].startswith(
+            f"# TYPE tpu_dra_{family} "
+        )

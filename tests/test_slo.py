@@ -1,0 +1,315 @@
+"""SLO engine (ISSUE 14): reset-safe series math + the multi-window
+multi-burn-rate evaluator matrix."""
+
+import dataclasses
+
+import pytest
+
+from tpu_dra.infra.slo import (
+    BurnWindow,
+    SampleStore,
+    SLOSpec,
+    evaluate,
+    fmt_window,
+    key_of,
+    scaled_policy,
+)
+
+# A compact policy for tests: page on >14.4x over (5s, 60s), ticket on
+# >6x over (30s, 360s) — the SRE shape at second scale.
+POLICY = (
+    BurnWindow(5.0, 60.0, 14.4, "page"),
+    BurnWindow(30.0, 360.0, 6.0, "ticket"),
+)
+
+
+def _threshold_spec(**kw) -> SLOSpec:
+    base = dict(
+        name="t", description="test threshold", kind="threshold",
+        series="some_gauge", threshold=1.0, op="le", budget=0.05,
+        window_s=3600.0, policy=POLICY,
+    )
+    base.update(kw)
+    return SLOSpec(**base)
+
+
+# --- the store ---------------------------------------------------------------
+
+
+def test_increase_survives_counter_reset():
+    """A restarted process re-exports its counter from zero; the
+    increase over a window spanning the reset must NEVER go negative —
+    it sums positive deltas and counts the post-reset value as the
+    increase since the restart."""
+    s = SampleStore()
+    k = key_of("publish_writes_total")
+    for t, v in [(0, 0), (10, 10), (20, 20), (30, 5), (40, 15)]:
+        s.add("publish_writes_total", None, float(t), float(v))
+    inc, elapsed, resets = s.increase(k, 100.0, 40.0)
+    # 0->10->20 (+20), reset to 5 (+5 since restart), 5->15 (+10).
+    assert inc == 35.0
+    assert inc >= 0
+    assert elapsed == 40.0
+    assert resets == 1
+    # Naive last-first would have been 15 - 0 = 15 (and negative over
+    # the [20, 30] sub-window); pin the sub-window too:
+    inc2, _, resets2 = s.increase(k, 12.0, 31.0)  # samples at 20, 30
+    assert inc2 == 5.0 and resets2 == 1
+
+
+def test_increase_needs_two_samples_and_rate():
+    s = SampleStore()
+    k = key_of("c")
+    assert s.increase(k, 60.0, 100.0) is None
+    s.add("c", None, 100.0, 7.0)
+    assert s.increase(k, 60.0, 100.0) is None
+    s.add("c", None, 110.0, 27.0)
+    assert s.rate(k, 60.0, 110.0) == pytest.approx(2.0)
+
+
+def test_store_ring_and_series_bounds():
+    s = SampleStore(max_samples_per_series=8, max_series=2)
+    for i in range(20):
+        s.add("a", None, float(i), float(i))
+    assert len(s.window(key_of("a"), 1e9, 100.0)) == 8
+    s.add("b", {"x": "1"}, 0.0, 1.0)
+    s.add("overflow", None, 0.0, 1.0)  # third series: dropped, counted
+    assert s.series_count() == 2
+    assert s.dropped_series == 1
+
+
+def test_suffix_and_label_matching():
+    s = SampleStore()
+    s.add("tpu_dra_claim_ready_seconds", {"quantile": "0.99"}, 1.0, 0.5)
+    s.add("tpu_dra_claim_ready_seconds", {"quantile": "0.5"}, 1.0, 0.1)
+    s.add("tpu_dra_cd_api_circuit_state", {"verb": "get"}, 1.0, 0.0)
+    assert len(s.keys("claim_ready_seconds")) == 2
+    assert len(s.keys("claim_ready_seconds", {"quantile": "0.99"})) == 1
+    # Suffix match crosses registry prefixes (the doctor convention).
+    assert len(s.keys("api_circuit_state")) == 1
+
+
+# --- the evaluator matrix (satellite) ----------------------------------------
+
+
+def test_burn_exactly_at_budget_does_not_alert():
+    """Burning exactly at budget = burn rate ~1.0: the budget empties
+    precisely at the window's end, which is the DESIGNED spend — no
+    page, no ticket."""
+    s = SampleStore()
+    spec = _threshold_spec(budget=0.5)
+    # Alternate good/bad every second for 400s: every window's bad
+    # fraction is ~0.5 == budget.
+    for t in range(400):
+        s.add("some_gauge", None, float(t), 2.0 if t % 2 else 0.0)
+    st = evaluate(s, spec, 399.0)
+    assert st.data
+    assert st.burn_rate == pytest.approx(1.0, abs=0.2)
+    for burn in st.burn.values():
+        assert burn == pytest.approx(1.0, abs=0.25)
+    assert st.alert is None
+
+
+def test_fast_window_spike_alone_does_not_page():
+    """A short spike trips the fast window but not the 1h-analog long
+    window — the multi-window AND is exactly what keeps a blip from
+    paging a human."""
+    s = SampleStore()
+    spec = _threshold_spec(budget=0.05)
+    for t in range(400):
+        bad = t >= 395  # only the last 5s violate
+        s.add("some_gauge", None, float(t), 2.0 if bad else 0.0)
+    st = evaluate(s, spec, 399.0)
+    assert st.burn[fmt_window(5.0)] > 14.4  # fast window IS burning
+    assert st.burn[fmt_window(60.0)] < 14.4  # long window is not
+    assert st.alert is None
+    assert st.ok is False  # currently violating — visible, not paging
+
+
+def test_slow_sustained_burn_pages():
+    s = SampleStore()
+    spec = _threshold_spec(budget=0.05)
+    for t in range(400):
+        s.add("some_gauge", None, float(t), 2.0)  # violating throughout
+    st = evaluate(s, spec, 399.0)
+    assert st.burn[fmt_window(5.0)] > 14.4
+    assert st.burn[fmt_window(60.0)] > 14.4
+    assert st.alert == "page"
+    assert st.ok is False
+    assert st.budget_remaining == 0.0
+
+
+def test_ticket_fires_without_page():
+    """A 6-14x burn sustained over the slow pair tickets but does not
+    page (the severity ladder)."""
+    s = SampleStore()
+    spec = _threshold_spec(budget=0.05)
+    # ~50% bad throughout: burn = 0.5/0.05 = 10 -> over the ticket
+    # threshold (6) on both slow windows, under the page threshold
+    # (14.4) everywhere.
+    for t in range(400):
+        s.add("some_gauge", None, float(t), 2.0 if t % 2 else 0.0)
+    st = evaluate(s, spec, 399.0)
+    assert st.alert == "ticket"
+
+
+def test_empty_window_is_no_data_not_zero():
+    s = SampleStore()
+    st = evaluate(s, _threshold_spec(), 100.0)
+    assert st.data is False
+    assert st.ok is None and st.burn_rate is None and st.alert is None
+    # A series outside every window is equally no-data for burn math.
+    s.add("some_gauge", None, 0.0, 5.0)
+    st2 = evaluate(s, _threshold_spec(policy=POLICY), 10_000.0)
+    assert st2.burn == {}
+    assert st2.alert is None
+
+
+def test_threshold_multiseries_evaluates_worst():
+    """One open circuit is a bad interval no matter how many other
+    verbs are closed (worst-series semantics)."""
+    s = SampleStore()
+    spec = _threshold_spec(series="api_circuit_state", threshold=0.0)
+    for t in range(100):
+        s.add("api_circuit_state", {"verb": "get"}, float(t), 0.0)
+        s.add("api_circuit_state", {"verb": "update"}, float(t), 2.0)
+    st = evaluate(s, spec, 99.0)
+    assert st.current == 2.0  # the violating extreme
+    assert st.ok is False
+    assert st.burn[fmt_window(60.0)] == pytest.approx(1.0 / 0.05, rel=0.1)
+
+
+def test_write_budget_slo_from_replayed_publisher_trace():
+    """The apiserver write budget computed from a replayed
+    publish_writes_total trace (satellite): steady zero-write state is
+    inside budget; a naive-publish regression burns; a counter reset
+    mid-trace (process restart) is FLAGGED and never produces a
+    negative increase or a bogus burn."""
+    s = SampleStore()
+    spec = SLOSpec(
+        name="write-budget", description="writes/node/h", kind="rate",
+        series="publish_writes_total", budget=60.0, per_seconds=3600.0,
+        divisor=4.0, window_s=3600.0, policy=POLICY,
+    )
+    t = 0.0
+    v = 100.0
+    # 300s of steady state: zero increase.
+    for _ in range(300):
+        s.add("publish_writes_total", None, t, v)
+        t += 1.0
+    st = evaluate(s, spec, t - 1.0)
+    assert st.data and st.ok and st.alert is None
+    assert st.burn_rate == 0.0
+    assert st.current == 0.0
+    # Restart: the counter resets to zero, then the regressed process
+    # republishes per event at 8 writes/s.
+    v = 0.0
+    for _ in range(120):
+        s.add("publish_writes_total", None, t, v)
+        t += 1.0
+        v += 8.0
+    st = evaluate(s, spec, t - 1.0)
+    assert st.resets >= 1  # the restart is visible, not silent
+    # 8/s over 4 nodes = 7200 writes/node/h = 120x the 60/h budget on
+    # the windows the regression covers.
+    assert st.burn[fmt_window(5.0)] == pytest.approx(120.0, rel=0.2)
+    assert st.burn[fmt_window(60.0)] == pytest.approx(120.0, rel=0.2)
+    assert st.alert == "page"
+    assert all(b >= 0 for b in st.burn.values())
+    assert 0.0 <= st.budget_remaining <= 1.0
+
+
+def test_rate_burn_exactly_at_budget_is_ok_no_alert():
+    s = SampleStore()
+    spec = SLOSpec(
+        name="wb", description="", kind="rate",
+        series="writes_total", budget=3600.0, per_seconds=3600.0,
+        window_s=3600.0, policy=POLICY,
+    )
+    for t in range(400):  # exactly 1 write/s == 3600/h == the budget
+        s.add("writes_total", None, float(t), float(t))
+    st = evaluate(s, spec, 399.0)
+    assert st.burn_rate == pytest.approx(1.0, rel=0.05)
+    assert st.ok is True  # at budget is inside budget
+    assert st.alert is None
+
+
+# --- policy / plumbing -------------------------------------------------------
+
+
+def test_scaled_policy_shrinks_windows_not_thresholds():
+    p = scaled_policy(1.0 / 600.0)
+    assert p[0].short_s == pytest.approx(0.5)
+    assert p[0].long_s == pytest.approx(6.0)
+    assert p[0].burn_threshold == 14.4 and p[0].severity == "page"
+    assert p[1].severity == "ticket"
+
+
+def test_fmt_window_labels():
+    assert fmt_window(300) == "5m"
+    assert fmt_window(3600) == "1h"
+    assert fmt_window(21600) == "6h"
+    assert fmt_window(0.5) == "0.5s"
+
+
+def test_spec_validation_and_objective_text():
+    with pytest.raises(ValueError):
+        _threshold_spec(kind="nope")
+    with pytest.raises(ValueError):
+        _threshold_spec(op="eq")
+    with pytest.raises(ValueError):
+        _threshold_spec(budget=0.0)
+    spec = _threshold_spec(threshold=2.0, budget=0.05, window_s=21600.0)
+    assert "<= 2" in spec.objective_text()
+    assert "95.0%" in spec.objective_text()
+    rate_spec = dataclasses.replace(
+        _threshold_spec(), kind="rate", budget=60.0
+    )
+    assert "60/1h" in rate_spec.objective_text()
+
+
+def test_status_to_json_round_trips():
+    import json
+
+    s = SampleStore()
+    for t in range(100):
+        s.add("some_gauge", None, float(t), 0.0)
+    st = evaluate(s, _threshold_spec(), 99.0)
+    doc = json.loads(json.dumps(st.to_json()))
+    assert doc["name"] == "t" and doc["ok"] is True
+    assert doc["kind"] == "threshold"
+
+
+def test_builtin_catalog_shape():
+    from tpu_dra.tools.fleetmon import builtin_catalog
+
+    cat = builtin_catalog(nodes=96, window_scale=1.0 / 600.0)
+    names = {c.name for c in cat}
+    assert {
+        "claim-ready-p99", "write-budget", "frag-ceiling",
+        "circuit-open", "ttft-p99-interactive", "ttft-p99-standard",
+        "ttft-p99-batch",
+    } <= names
+    wb = next(c for c in cat if c.name == "write-budget")
+    assert wb.kind == "rate" and wb.divisor == 96.0
+    assert wb.policy[0].long_s == pytest.approx(6.0)
+    assert all(c.remediation for c in cat)
+
+
+def test_stale_latest_sample_does_not_pin_violating_forever():
+    """A dead/removed exporter's frozen last sample must not yield a
+    permanent 'VIOLATING right now' verdict after its burn windows
+    aged out — `current`/`ok` are bounded to the widest alert
+    window."""
+    s = SampleStore()
+    spec = _threshold_spec(series="api_circuit_state", threshold=0.0)
+    for t in range(10):
+        s.add("api_circuit_state", {"verb": "get"}, float(t), 2.0)
+    st = evaluate(s, spec, 9.0)
+    assert st.ok is False and st.current == 2.0  # live: violating
+    # The exporter is decommissioned; 10x the widest window later the
+    # frozen sample is no longer 'current' — no-data, not VIOLATING.
+    later = 9.0 + 10 * max(b.long_s for b in POLICY)
+    st = evaluate(s, spec, later)
+    assert st.current is None and st.ok is None
+    assert st.data is False

@@ -59,6 +59,16 @@ class Metrics:
         daemons reachable only over their sockets — can refresh gauges."""
         self._collectors.append(fn)
 
+    def unregister_collector(self, fn) -> None:
+        """Remove a scrape-time hook (no-op if absent): a component
+        whose registry outlives it — a stopped FleetMon on a shared
+        fleet registry — must not keep running its collector on every
+        render forever."""
+        try:
+            self._collectors.remove(fn)
+        except ValueError:
+            pass
+
     @staticmethod
     def _key(name: str, labels: Optional[Dict[str, str]]):
         return (name, tuple(sorted((labels or {}).items())))
@@ -168,16 +178,31 @@ class Metrics:
             except Exception:  # noqa: BLE001 — scrape must never 500
                 pass
         out = []
+        # ONE `# TYPE` line per metric NAME (the exposition format
+        # forbids repeating it per labeled series): the fleetmon parser
+        # classifies counter/gauge/summary from these lines instead of
+        # name-suffix heuristics, and a repeated TYPE header would make
+        # the round-trip output malformed for any family with more
+        # than one label set.
+        typed = None
         with self._lock:
             for (name, labels), v in sorted(self._counters.items()):
-                out.append(f"# TYPE {self.prefix}_{name} counter")
+                if name != typed:
+                    out.append(f"# TYPE {self.prefix}_{name} counter")
+                    typed = name
                 out.append(f"{self.prefix}_{name}{self._fmt(labels)} {v}")
+            typed = None
             for (name, labels), v in sorted(self._gauges.items()):
-                out.append(f"# TYPE {self.prefix}_{name} gauge")
+                if name != typed:
+                    out.append(f"# TYPE {self.prefix}_{name} gauge")
+                    typed = name
                 out.append(f"{self.prefix}_{name}{self._fmt(labels)} {v}")
+            typed = None
             for key in sorted(self._timing_sum):
                 name, labels = key
-                out.append(f"# TYPE {self.prefix}_{name} summary")
+                if name != typed:
+                    out.append(f"# TYPE {self.prefix}_{name} summary")
+                    typed = name
                 recent = sorted(self._timing_recent.get(key, []))
                 for q in QUANTILES:
                     v = _quantile_from_sorted(recent, q)

@@ -1,0 +1,459 @@
+"""Declarative SLOs over scraped time series (ISSUE 14).
+
+Every gate the benches enforce — claim-ready p99, TTFT p99, the
+publisher's zero-write steady state, the frag ceiling — lives as an
+ad-hoc assert inside one bench leg, invisible at runtime. This module
+is the runtime half: a ring-buffer time-series store fed by scraped
+Prometheus samples (:mod:`tpu_dra.tools.fleetmon` is the scraper), a
+declarative SLO spec (objective, window, budget), and Google-SRE
+**multi-window multi-burn-rate** alerting (fast 5m/1h + slow 30m/6h
+pairs by default; :func:`scaled_policy` shrinks them uniformly so a
+30-second harness run exercises the identical alert math a 30-day
+window would).
+
+Two SLO kinds cover the catalog:
+
+- ``threshold`` — an instantaneous compliance check on a gauge or
+  quantile series (claim-ready p99 <= target, frag score <= ceiling,
+  circuit closed). The error ratio over a window is the fraction of
+  scraped samples violating the bound ("bad-minutes" semantics; with a
+  fixed scrape cadence the sample fraction IS the time fraction), and
+  ``budget`` is the allowed bad fraction of the SLO window.
+- ``rate`` — a consumption budget on a counter (slice writes per node
+  per hour, ROADMAP item 5's apiserver write budget). ``budget`` is
+  the allowed units per ``per_seconds`` per ``divisor`` (e.g. 60
+  writes / 3600 s / node); the burn rate is simply measured-rate /
+  budget-rate, so burn 1.0 means consuming exactly at budget.
+
+**Counter resets are first-class**: a restarted process re-exports its
+counters from zero, and a naive ``last - first`` over the reset would
+be negative (or a huge bogus burn once negated). :meth:`SampleStore.
+increase` sums positive deltas and treats any drop as a reset — the
+post-reset value is the increase since the restart — and the reset
+count rides every :class:`SLOStatus` so ``doctor slo`` can say
+"process restarted" instead of reporting a bogus burn.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# Ring bound per series: at fleetmon's default 15 s cadence this holds
+# ~17 h of samples — enough for the 6 h slow alert window with room,
+# without unbounded memory on a long-lived scraper.
+DEFAULT_SERIES_SAMPLES = 4096
+
+# Defensive bound on distinct series the store will hold (a scraped
+# component with a label explosion must not OOM the scraper; the
+# registry-side cardinality guard is the first line, this is the
+# second). Overflow is counted, never silent.
+DEFAULT_MAX_SERIES = 20000
+
+# The budget window an objective is stated over (Google SRE's 30 days);
+# scaled together with the alert windows for harness runs.
+DEFAULT_SLO_WINDOW_S = 30 * 24 * 3600.0
+
+Key = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+
+def key_of(name: str, labels=None) -> Key:
+    items = labels.items() if isinstance(labels, dict) else (labels or ())
+    return (name, tuple(sorted(items)))
+
+
+def fmt_window(seconds: float) -> str:
+    """5m/1h/6h-style window labels (falls back to seconds for the
+    scaled harness windows)."""
+    s = float(seconds)
+    if s >= 3600.0 and s % 3600.0 == 0:
+        return f"{int(s // 3600)}h"
+    if s >= 60.0 and s % 60.0 == 0:
+        return f"{int(s // 60)}m"
+    return f"{s:g}s"
+
+
+class SampleStore:
+    """Ring-buffer store of ``(t, value)`` samples per labeled series.
+
+    Timestamps are whatever monotonic clock the caller scrapes on; all
+    window math is relative to the ``now`` the caller passes, so tests
+    can drive it with a fake clock.
+    """
+
+    def __init__(
+        self,
+        max_samples_per_series: int = DEFAULT_SERIES_SAMPLES,
+        max_series: int = DEFAULT_MAX_SERIES,
+    ):
+        self.max_samples_per_series = max_samples_per_series
+        self.max_series = max_series
+        self.dropped_series = 0
+        self._lock = threading.Lock()
+        self._series: Dict[Key, List[Tuple[float, float]]] = {}
+
+    def add(self, name: str, labels, t: float, value: float) -> None:
+        k = key_of(name, labels)
+        with self._lock:
+            buf = self._series.get(k)
+            if buf is None:
+                if len(self._series) >= self.max_series:
+                    self.dropped_series += 1
+                    return
+                buf = self._series[k] = []
+            buf.append((t, value))
+            if len(buf) > self.max_samples_per_series:
+                del buf[: len(buf) - self.max_samples_per_series]
+
+    def ingest(self, samples: Iterable, t: float) -> int:
+        """Append scraped samples (anything with .name/.labels/.value —
+        fleetmon's parsed exposition) at one timestamp."""
+        n = 0
+        for s in samples:
+            self.add(s.name, s.labels, t, s.value)
+            n += 1
+        return n
+
+    def series_count(self) -> int:
+        with self._lock:
+            return len(self._series)
+
+    def keys(self, suffix: str, labels: Optional[Dict[str, str]] = None
+             ) -> List[Key]:
+        """Series whose name ends with ``suffix`` (prefixes vary per
+        component — the doctor's suffix-match convention) and whose
+        labels CONTAIN ``labels``."""
+        want = set((labels or {}).items())
+        with self._lock:
+            return [
+                k for k in self._series
+                if k[0].endswith(suffix) and want <= set(k[1])
+            ]
+
+    def window(self, key: Key, window_s: float, now: float
+               ) -> List[Tuple[float, float]]:
+        """Samples in ``[now - window_s, now]``, ascending."""
+        lo = now - window_s
+        with self._lock:
+            buf = self._series.get(key, [])
+            return [(t, v) for t, v in buf if lo <= t <= now]
+
+    def count(self, key: Key, window_s: float, now: float) -> int:
+        """Sample count in the window without materializing the
+        copies ``window()`` makes (evaluation bookkeeping runs per
+        probe tick)."""
+        lo = now - window_s
+        with self._lock:
+            buf = self._series.get(key, [])
+            return sum(1 for t, _ in buf if lo <= t <= now)
+
+    def latest(self, key: Key) -> Optional[Tuple[float, float]]:
+        with self._lock:
+            buf = self._series.get(key)
+            return buf[-1] if buf else None
+
+    def increase(self, key: Key, window_s: float, now: float
+                 ) -> Optional[Tuple[float, float, int]]:
+        """Counter increase over the window, **reset-safe**: sums
+        positive deltas; a drop means the exporting process restarted
+        and its counter re-started from zero, so the post-drop value is
+        the increase since the reset (never a negative contribution).
+        Returns ``(increase, elapsed_s, resets)`` or None with fewer
+        than two samples in the window."""
+        samples = self.window(key, window_s, now)
+        if len(samples) < 2:
+            return None
+        inc, resets = 0.0, 0
+        for (_, prev), (_, cur) in zip(samples, samples[1:]):
+            delta = cur - prev
+            if delta >= 0:
+                inc += delta
+            else:
+                resets += 1
+                inc += cur
+        return (inc, samples[-1][0] - samples[0][0], resets)
+
+    def rate(self, key: Key, window_s: float, now: float
+             ) -> Optional[float]:
+        """Reset-safe per-second rate over the window."""
+        got = self.increase(key, window_s, now)
+        if got is None or got[1] <= 0:
+            return None
+        return got[0] / got[1]
+
+    def sum_increase(
+        self, suffix: str, labels: Optional[Dict[str, str]],
+        window_s: float, now: float,
+    ) -> Tuple[float, float, int, int]:
+        """Reset-safe increase summed over every matching series.
+        Returns ``(total_increase, max_elapsed_s, resets, series_with_
+        data)`` — elapsed is the widest covered span so a partially
+        covered window never inflates the rate."""
+        total, elapsed, resets, n = 0.0, 0.0, 0, 0
+        for k in self.keys(suffix, labels):
+            got = self.increase(k, window_s, now)
+            if got is None:
+                continue
+            total += got[0]
+            elapsed = max(elapsed, got[1])
+            resets += got[2]
+            n += 1
+        return total, elapsed, resets, n
+
+
+# --- alert policy ------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class BurnWindow:
+    """One multi-window alert rule: fire ``severity`` when the burn
+    rate exceeds ``burn_threshold`` over BOTH windows — the long one
+    proves the burn is sustained, the short one proves it is still
+    happening (so a healed incident stops paging)."""
+
+    short_s: float
+    long_s: float
+    burn_threshold: float
+    severity: str  # "page" | "ticket"
+
+
+# The Google-SRE multi-window multi-burn-rate pairs: page on a burn
+# that would exhaust a 30-day budget in ~2 days (14.4x) sustained over
+# 1h and still visible at 5m; ticket on a slower 6x burn over 6h/30m.
+GOOGLE_SRE_POLICY: Tuple[BurnWindow, ...] = (
+    BurnWindow(300.0, 3600.0, 14.4, "page"),
+    BurnWindow(1800.0, 21600.0, 6.0, "ticket"),
+)
+
+
+def scaled_policy(
+    scale: float, base: Tuple[BurnWindow, ...] = GOOGLE_SRE_POLICY,
+) -> Tuple[BurnWindow, ...]:
+    """Shrink every window by ``scale`` (thresholds unchanged) so a
+    seconds-long harness run drives the identical alert math."""
+    return tuple(
+        BurnWindow(b.short_s * scale, b.long_s * scale,
+                   b.burn_threshold, b.severity)
+        for b in base
+    )
+
+
+# --- SLO spec ----------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOSpec:
+    """One declarative objective over a suffix-matched series family.
+
+    ``threshold`` kind: good while the instantaneous value satisfies
+    ``op threshold``; ``budget`` is the allowed bad fraction of
+    ``window_s``. Multiple matching series (per-verb circuits, per-node
+    gauges) evaluate to the WORST series — one open circuit is a bad
+    interval no matter how many others are closed.
+
+    ``rate`` kind: ``budget`` units per ``per_seconds`` per ``divisor``
+    allowed; burn = measured rate / budget rate. Matching series are
+    SUMMED (a fleet of publishers consumes one apiserver budget).
+    """
+
+    name: str
+    description: str
+    kind: str  # "threshold" | "rate"
+    series: str  # suffix-matched series name
+    labels: Tuple[Tuple[str, str], ...] = ()
+    threshold: float = 0.0
+    op: str = "le"  # good when value <= threshold ("le") / >= ("ge")
+    budget: float = 0.01
+    per_seconds: float = 3600.0
+    divisor: float = 1.0
+    window_s: float = DEFAULT_SLO_WINDOW_S
+    policy: Tuple[BurnWindow, ...] = GOOGLE_SRE_POLICY
+    remediation: str = ""
+
+    def __post_init__(self):
+        if self.kind not in ("threshold", "rate"):
+            raise ValueError(f"SLO {self.name}: unknown kind {self.kind!r}")
+        if self.op not in ("le", "ge"):
+            raise ValueError(f"SLO {self.name}: unknown op {self.op!r}")
+        if self.budget <= 0:
+            raise ValueError(f"SLO {self.name}: budget must be > 0")
+
+    def label_dict(self) -> Dict[str, str]:
+        return dict(self.labels)
+
+    def objective_text(self) -> str:
+        if self.kind == "rate":
+            per = fmt_window(self.per_seconds)
+            div = "" if self.divisor == 1.0 else "/divisor"
+            return f"<= {self.budget:g}/{per}{div}"
+        sym = "<=" if self.op == "le" else ">="
+        return (
+            f"{sym} {self.threshold:g} for "
+            f"{(1.0 - self.budget):.1%} of {fmt_window(self.window_s)}"
+        )
+
+    def complies(self, value: float) -> bool:
+        return (
+            value <= self.threshold if self.op == "le"
+            else value >= self.threshold
+        )
+
+
+@dataclasses.dataclass
+class SLOStatus:
+    """One evaluation verdict. ``burn`` maps window label -> burn rate
+    (absent where the window held no data); ``burn_rate`` is the
+    headline — the page pair's long window, the number that says how
+    many budgets-per-window the fleet is currently consuming."""
+
+    name: str
+    kind: str
+    description: str
+    objective: str
+    budget: float
+    data: bool = False
+    ok: Optional[bool] = None
+    current: Optional[float] = None
+    burn: Dict[str, float] = dataclasses.field(default_factory=dict)
+    burn_rate: Optional[float] = None
+    budget_remaining: Optional[float] = None
+    alert: Optional[str] = None
+    resets: int = 0
+    series: int = 0
+    samples: int = 0
+    remediation: str = ""
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _round(x: Optional[float], nd: int = 4) -> Optional[float]:
+    return None if x is None else round(x, nd)
+
+
+def _threshold_burn(
+    store: SampleStore, spec: SLOSpec, window_s: float, now: float,
+) -> Optional[float]:
+    """Worst-series bad fraction over the window, as a burn multiple of
+    the budget."""
+    worst = None
+    for k in store.keys(spec.series, spec.label_dict()):
+        samples = store.window(k, window_s, now)
+        if not samples:
+            continue
+        bad = sum(1 for _, v in samples if not spec.complies(v))
+        ratio = bad / len(samples)
+        worst = ratio if worst is None else max(worst, ratio)
+    if worst is None:
+        return None
+    return worst / spec.budget
+
+
+def _rate_burn(
+    store: SampleStore, spec: SLOSpec, window_s: float, now: float,
+) -> Optional[Tuple[float, float, int, float, float]]:
+    """(burn, measured units per per_seconds per divisor, resets,
+    total_increase, elapsed_s) over the window, or None without
+    enough data — everything a caller needs in ONE store scan."""
+    total, elapsed, resets, n = store.sum_increase(
+        spec.series, spec.label_dict(), window_s, now
+    )
+    if n == 0 or elapsed <= 0:
+        return None
+    rate_units = total / elapsed * spec.per_seconds / max(spec.divisor, 1e-9)
+    return (rate_units / spec.budget, rate_units, resets, total, elapsed)
+
+
+def evaluate(store: SampleStore, spec: SLOSpec, now: float) -> SLOStatus:
+    st = SLOStatus(
+        name=spec.name, kind=spec.kind, description=spec.description,
+        objective=spec.objective_text(), budget=spec.budget,
+        remediation=spec.remediation,
+    )
+    keys = store.keys(spec.series, spec.label_dict())
+    st.series = len(keys)
+    st.samples = sum(
+        store.count(k, max(spec.window_s, 1e-9), now) for k in keys
+    )
+    windows = sorted(
+        {w for b in spec.policy for w in (b.short_s, b.long_s)}
+    )
+    if spec.kind == "threshold":
+        for w in windows:
+            burn = _threshold_burn(store, spec, w, now)
+            if burn is not None:
+                st.burn[fmt_window(w)] = round(burn, 4)
+        # "Current" means LIVE: a dead exporter's frozen last sample
+        # must not yield a permanent VIOLATING verdict after its burn
+        # windows aged out — bound the latest sample to the widest
+        # alert window (fall back to the SLO window for an empty
+        # policy).
+        bound = now - (windows[-1] if windows else spec.window_s)
+        latest = [
+            got[1] for k in keys
+            if (got := store.latest(k)) is not None and got[0] >= bound
+        ]
+        if latest:
+            # The violating direction's extreme: the series an operator
+            # must look at first.
+            st.current = max(latest) if spec.op == "le" else min(latest)
+            st.ok = spec.complies(st.current)
+        full = _threshold_burn(store, spec, spec.window_s, now)
+        if full is not None:
+            st.budget_remaining = _round(max(0.0, 1.0 - full))
+    else:
+        # One store scan per window: the burn loop's results are kept
+        # and reused for `current` (the shortest window's measured
+        # rate), and the full-window scan below feeds both the reset
+        # count and the budget arithmetic.
+        by_window: Dict[float, Tuple[float, float, int, float, float]] = {}
+        for w in windows:
+            got = _rate_burn(store, spec, w, now)
+            if got is not None:
+                st.burn[fmt_window(w)] = round(got[0], 4)
+                by_window[w] = got
+        if windows and windows[0] in by_window:
+            st.current = round(by_window[windows[0]][1], 4)
+        full = _rate_burn(store, spec, spec.window_s, now)
+        if full is not None:
+            _burn, _rate, resets, total, elapsed = full
+            st.resets = resets
+            # Budget left over the (partially covered) SLO window:
+            # consumed vs. what the window's covered span allowed.
+            allowed = (
+                spec.budget * max(spec.divisor, 1e-9)
+                * elapsed / spec.per_seconds
+            )
+            if allowed > 0:
+                st.budget_remaining = _round(
+                    max(0.0, 1.0 - total / allowed)
+                )
+    st.data = bool(st.burn) or st.current is not None
+    page_long = fmt_window(spec.policy[0].long_s) if spec.policy else None
+    if page_long in st.burn:
+        st.burn_rate = st.burn[page_long]
+    elif st.burn:
+        # Fall back to the widest window that held data.
+        st.burn_rate = list(st.burn.values())[-1]
+    if spec.kind == "rate" and st.burn_rate is not None:
+        st.ok = st.burn_rate <= 1.0
+    # Multi-window alerting: a rule fires only when the burn exceeds
+    # its threshold over BOTH windows; first firing severity wins
+    # (policy orders page before ticket).
+    for bw in spec.policy:
+        bs = st.burn.get(fmt_window(bw.short_s))
+        bl = st.burn.get(fmt_window(bw.long_s))
+        if (
+            bs is not None and bl is not None
+            and bs > bw.burn_threshold and bl > bw.burn_threshold
+        ):
+            st.alert = bw.severity
+            break
+    return st
+
+
+def evaluate_catalog(
+    store: SampleStore, specs: Iterable[SLOSpec], now: float,
+) -> List[SLOStatus]:
+    return [evaluate(store, spec, now) for spec in specs]

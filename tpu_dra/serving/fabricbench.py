@@ -172,8 +172,12 @@ class Fabric:
         self.metrics = Metrics()
         self.cluster = FakeCluster()
         self.agents = spin_fleet(self.cluster, nodes, self.metrics)
+        # One registry for the whole fabric (publisher + scheduler +
+        # router): the SLO-evaluated mode scrapes a single /metrics
+        # endpoint the way fleetmon would scrape a co-located stack.
         self.core = SchedulerCore(
-            self.cluster, retry_unschedulable_after=0.5
+            self.cluster, retry_unschedulable_after=0.5,
+            metrics=self.metrics,
         )
         self.core.start()
         self.claims = ResourceClient(self.cluster, RESOURCE_CLAIMS)
@@ -356,7 +360,7 @@ def warm_jit(config, params, ec: EngineConfig) -> None:
 
 def run_headline(
     config, params, nodes, replicas, traffic, seed, cap,
-    slots, timeout,
+    slots, timeout, slo_eval=False,
 ) -> dict:
     tenants = [t.spec for t in traffic]
     max_p = max(max(t.prompt_lens) for t in traffic)
@@ -372,7 +376,32 @@ def run_headline(
             min_replicas=replicas, max_replicas=replicas,
         ),
     )
+    mon = srv = None
     try:
+        if slo_eval:
+            # SLO-evaluated mode (ISSUE 14): fleetmon scrapes the live
+            # run's /metrics over HTTP while the trace replays, and the
+            # per-class TTFT gates become SLO-catalog verdicts (scaled
+            # SRE burn windows — the identical alert math a 30-day
+            # window runs).
+            from tpu_dra.infra.metrics import MetricsServer
+            from tpu_dra.serving.router import SLO_CLASSES
+            from tpu_dra.tools import fleetmon as fleetmon_mod
+
+            srv = MetricsServer(fab.metrics, port=0, address="127.0.0.1")
+            srv.start()
+            mon = fleetmon_mod.FleetMon(
+                [fleetmon_mod.Target("fabric", f"127.0.0.1:{srv.port}")],
+                catalog=fleetmon_mod.builtin_catalog(
+                    nodes=nodes, window_scale=1.0 / 600.0,
+                    ttft_targets_s={
+                        c.name: c.ttft_target_ms / 1000.0
+                        for c in SLO_CLASSES
+                    },
+                ),
+                interval_s=0.25, metrics=fab.metrics,
+            )
+            mon.start()
         fab.scale_to(replicas)
         trace = make_fabric_trace(seed, traffic, config.vocab_size)
         res = fab.drive(trace, timeout=timeout)
@@ -404,8 +433,27 @@ def run_headline(
             f"lost sequences: {res['submitted']} admitted, "
             f"{len(done)} completed"
         )
+        if mon is not None:
+            # One final scrape so the quantiles of the last completions
+            # are in the store, then judge the catalog.
+            mon.scrape_once()
+            out["slo"] = {
+                st.name: {
+                    "data": st.data,
+                    "ok": st.ok,
+                    "current": st.current,
+                    "burn_rate": st.burn_rate,
+                    "alert": st.alert,
+                    "budget_remaining": st.budget_remaining,
+                }
+                for st in mon.evaluate()
+            }
         return out
     finally:
+        if mon is not None:
+            mon.stop()
+        if srv is not None:
+            srv.stop()
         fab.stop()
 
 
@@ -624,7 +672,8 @@ def run(
         f"{requests} requests at ~{rate:g}/s aggregate"
     )
     headline = run_headline(
-        config, params, nodes, replicas, mix, seed, cap, slots, timeout
+        config, params, nodes, replicas, mix, seed, cap, slots, timeout,
+        slo_eval=True,
     )
     _note(
         f"headline: ttft p50 {headline['ttft']['p50_ms']} ms p99 "
@@ -670,6 +719,24 @@ def run(
         "seed": seed,
     }
 
+    # SLO-catalog verdicts (ISSUE 14): the headline ran with fleetmon
+    # scraping it live — the per-class TTFT gates are now catalog
+    # verdicts over scraped series, recorded next to the harness-side
+    # quantiles they must agree with.
+    slo_verdicts = headline.get("slo", {})
+    for cls in ("interactive", "standard", "batch"):
+        st = slo_verdicts.get(f"ttft-p99-{cls}")
+        assert st is not None and st["data"], (
+            f"SLO catalog has no data for ttft-p99-{cls} — the "
+            f"router's fabric_ttft_seconds summary was not scraped"
+        )
+    report.update({
+        "slo_ttft_interactive_burn_rate":
+            slo_verdicts["ttft-p99-interactive"]["burn_rate"],
+        "slo_ttft_batch_ok": bool(slo_verdicts["ttft-p99-batch"]["ok"]),
+        "slo_fabric_catalog": slo_verdicts,
+    })
+
     allow_gap = os.environ.get("FABRIC_ALLOW_GAP") == "1"
     allow_scale = os.environ.get("FABRIC_ALLOW_SCALE") == "1"
     for key in (
@@ -705,11 +772,20 @@ def run(
             f"record anyway)"
         )
     if smoke:
+        # The batch tier's 30s objective is structurally safe at smoke
+        # scale — a violation means the scrape/evaluate path itself
+        # broke, not the machine was slow. (interactive's 250ms target
+        # is recorded but not gated: CI jitter owns that band.)
+        assert report["slo_ttft_batch_ok"], (
+            f"batch-class TTFT SLO violating at smoke scale: "
+            f"{slo_verdicts['ttft-p99-batch']}"
+        )
         _note(
             "smoke contract: trace determinism, SLO keys, fairness "
             f"gate (x{fairness['quiet_p99_x']}), packer-placed "
             "scale-up, lossless token-identical scale-down before "
-            "claim delete — all hold"
+            "claim delete, SLO-catalog TTFT verdicts scraped live — "
+            "all hold"
         )
     return report
 

@@ -79,6 +79,10 @@ class SLOClass:
 INTERACTIVE = SLOClass("interactive", 0, 1.0, 250.0)
 STANDARD = SLOClass("standard", 1, 0.85, 1000.0)
 BATCH = SLOClass("batch", 2, 0.6, 30000.0)
+# The built-in tiers, in tier order — what fleetmon's catalog states
+# its per-class TTFT objectives from (fabricbench's SLO mode passes
+# these targets in; tools cannot import serving per the layer DAG).
+SLO_CLASSES = (INTERACTIVE, STANDARD, BATCH)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -524,6 +528,18 @@ class Router:
                         attrs={"rid": fr.rid, "tenant": fr.tenant},
                     )
                 ts = self._tenants[fr.tenant]
+                if self.metrics is not None and t_first is not None:
+                    # The SLO engine's per-class series (ISSUE 14):
+                    # submitted -> first-token, keyed by SLO CLASS (3
+                    # classes, bounded cardinality — per-tenant would
+                    # explode under tenant churn). fleetmon's catalog
+                    # evaluates ttft-p99-<cls> against the rendered
+                    # {cls=,quantile="0.99"} quantile of this summary.
+                    self.metrics.observe(
+                        "fabric_ttft_seconds",
+                        max(t_first - fr.t_submit, 0.0),
+                        labels={"cls": ts.spec.slo.name},
+                    )
                 with self._lock:
                     ts.served_tokens += len(tokens)
                     self._backlog_tokens -= fr.cost

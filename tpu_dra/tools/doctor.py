@@ -37,6 +37,7 @@ import json
 import os
 import socket
 import sys
+import time
 from typing import Dict, List, Optional
 
 from tpu_dra.plugin.checkpoint import (
@@ -47,6 +48,13 @@ from tpu_dra.plugin.checkpoint import (
 )
 from tpu_dra.plugin.cdi import CDI_VENDOR
 from tpu_dra.plugin.multiplexd import SOCKET_NAME
+# ONE staleness constant: the doctor's live-probe verdict and
+# fleetmon's own snapshot `stale` flag must agree on what "stale"
+# means, or `doctor --metrics-endpoint` and `doctor slo --snapshot`
+# would disagree about the same target.
+from tpu_dra.tools.fleetmon import (
+    STALE_AFTER_INTERVALS as FLEETMON_STALE_INTERVALS,
+)
 from tpu_dra.tpulib import new_tpulib
 
 
@@ -160,6 +168,17 @@ REPACKER_STUCK_WARN_SECONDS = 60.0
 # counter instead of unbounded memory.
 SERIES_CAPPED_COUNTER = "metrics_series_capped_total"
 
+# fleetmon scrape health (ISSUE 14), suffix-matched like the others:
+# fleetmon_target_up{target=} says whether the fleet monitor's LAST
+# scrape of that component succeeded; fleetmon_scrape_age_seconds is
+# how long ago the last SUCCESSFUL scrape was; the interval gauge lets
+# the staleness verdict be stated in intervals. A down or stale target
+# means the fleet's SLO verdicts are being computed over a PARTIAL
+# view — every burn rate involving that component's series is stale.
+FLEETMON_UP_GAUGE = "fleetmon_target_up"
+FLEETMON_AGE_GAUGE = "fleetmon_scrape_age_seconds"
+FLEETMON_INTERVAL_GAUGE = "fleetmon_scrape_interval_seconds"
+
 # Decode-roofline trend gate (ISSUE 8): the key bench.py records as the
 # gap between the measured decode step and the bf16 HBM floor. Matched
 # by SUFFIX inside the artifact (like the scheduler/engine gauges): the
@@ -173,15 +192,13 @@ BENCH_TREND_REGRESSION = 0.10
 
 
 def _endpoint_url(endpoint: str, path: str) -> str:
-    """host:port / URL -> a full http URL ending in ``path`` (shared
-    by the /metrics scrape and explain's /debug/traces scrape so the
-    normalization rules cannot diverge)."""
-    url = endpoint
-    if not url.startswith(("http://", "https://")):
-        url = f"http://{url}"
-    if not url.endswith(path):
-        url = url.rstrip("/") + path
-    return url
+    """host:port / URL -> a full http URL ending in ``path``. One rule
+    shared by the /metrics scrape, explain's /debug/traces scrape AND
+    fleetmon's scraper (the canonical implementation lives there) so
+    the normalization cannot diverge."""
+    from tpu_dra.tools.fleetmon import endpoint_url
+
+    return endpoint_url(endpoint, path)
 
 
 def _scrape(endpoint: str, timeout: float = 2.0) -> Dict[str, float]:
@@ -284,7 +301,78 @@ def probe_metrics(
         capped = _check_cardinality(ep, second or first, warn)
         if capped:
             report[ep]["series_capped"] = capped
+        fleetmon = _check_fleetmon(ep, second or first, warn)
+        if fleetmon:
+            report[ep]["fleetmon"] = fleetmon
     return report
+
+
+def _label_of(series: str, key: str) -> str:
+    """Extract one label's value from a rendered series key (the
+    scrape dict's ``name{k="v",...}`` form) — escape-aware via
+    fleetmon's parser, so a target name carrying ``,`` or an escaped
+    quote never splits into a phantom target."""
+    from tpu_dra.tools.fleetmon import parse_series_labels
+
+    return parse_series_labels(series).get(key, "?")
+
+
+def _check_fleetmon(
+    ep: str, sample: Dict[str, float], warn
+) -> Dict[str, object]:
+    """Surface the fleet monitor's own scrape health (ISSUE 14): a
+    target whose last scrape failed (``fleetmon_target_up == 0``) or
+    whose last SUCCESS is older than 3 scrape intervals means the SLO
+    engine is evaluating burn rates over a partial or stale view —
+    the monitoring, not the fleet, is what needs fixing first. Empty
+    dict when the endpoint exports no fleetmon series."""
+    out: Dict[str, object] = {}
+    targets: Dict[str, dict] = {}
+    interval = None
+    for series, value in sorted(sample.items()):
+        name = series.split("{", 1)[0]
+        if name.endswith(FLEETMON_INTERVAL_GAUGE):
+            interval = value
+        elif name.endswith(FLEETMON_UP_GAUGE):
+            targets.setdefault(
+                _label_of(series, "target"), {}
+            )["up"] = bool(value)
+        elif name.endswith(FLEETMON_AGE_GAUGE):
+            targets.setdefault(
+                _label_of(series, "target"), {}
+            )["age_s"] = value
+    if not targets and interval is None:
+        return out
+    if interval is not None:
+        out["interval_s"] = interval
+    out["targets"] = targets
+    for tname, t in sorted(targets.items()):
+        if t.get("up") is False:
+            warn(
+                f"{ep}: fleetmon target {tname!r} is DOWN (last scrape "
+                f"failed) — the fleet's SLOs are being evaluated over "
+                f"a PARTIAL view and every burn rate that reads this "
+                f"component's series is blind. Check the component's "
+                f"MetricsServer port and the fleetmon --target "
+                f"spelling (docs/observability.md, 'Fleet SLOs & "
+                f"burn-rate alerting')"
+            )
+            continue
+        age = t.get("age_s")
+        if (
+            interval and age is not None
+            and age > FLEETMON_STALE_INTERVALS * interval
+        ):
+            warn(
+                f"{ep}: fleetmon scrape of {tname!r} is STALE — last "
+                f"success {age:g}s ago (> {FLEETMON_STALE_INTERVALS:g} "
+                f"x the {interval:g}s interval); burn rates are "
+                f"running on old samples. The target answers up=1 but "
+                f"new scrapes are not landing: check whether the "
+                f"fleetmon scrape loop is wedged or the target slowed "
+                f"past the scrape timeout"
+            )
+    return out
 
 
 def _check_cardinality(
@@ -1059,6 +1147,23 @@ def render(report: dict) -> str:
                 )
                 parts.append(f"depth{shard}={st['depth']:g}{grew}")
             lines.append(f"  workqueue: {' '.join(parts)}")
+        fmon = m.get("fleetmon") or {}
+        if fmon.get("targets"):
+            tgts = fmon["targets"]
+            up = sum(1 for t in tgts.values() if t.get("up"))
+            parts = [f"up={up}/{len(tgts)}"]
+            if "interval_s" in fmon:
+                parts.append(f"interval={fmon['interval_s']:g}s")
+            interval = fmon.get("interval_s") or 0
+            for tname, t in sorted(tgts.items()):
+                if t.get("up") is False:
+                    parts.append(f"down[{tname}]")
+                elif (
+                    interval and t.get("age_s") is not None
+                    and t["age_s"] > FLEETMON_STALE_INTERVALS * interval
+                ):
+                    parts.append(f"stale[{tname}]={t['age_s']:g}s")
+            lines.append(f"  fleetmon: {' '.join(parts)}")
     for note in report.get("notes", []):
         lines.append(f"note: {note}")
     trend = report.get("bench_trend")
@@ -1313,10 +1418,127 @@ def explain_main(argv) -> int:
     return 0
 
 
+# --- `doctor slo` — SLO snapshot triage (ISSUE 14) ---------------------------
+
+
+def render_slo(snapshot: dict, warn) -> str:
+    """Render a fleetmon snapshot (``fleetmon --once --json-out``) as
+    per-SLO triage: burn rate, remaining budget, alert state, and the
+    catalog's remediation for everything burning. Counter resets are
+    FLAGGED, not folded into the burn — a restarted exporter re-counts
+    from zero and the reset-safe increase already absorbed it; the
+    operator should know a restart happened, not chase a bogus burn."""
+    targets = snapshot.get("targets", {})
+    up = sum(1 for t in targets.values() if t.get("up"))
+    age_s = max(0.0, time.time() - snapshot.get("ts", time.time()))
+    lines = [
+        f"slo        : {len(snapshot.get('slos', []))} SLOs, "
+        f"{up}/{len(targets)} targets up "
+        f"(snapshot age {age_s:.0f}s)",
+    ]
+    for tname, t in sorted(targets.items()):
+        if not t.get("up"):
+            warn(
+                f"fleetmon target {tname!r} was DOWN at snapshot time "
+                f"({t.get('last_error') or 'scrape failed'}) — verdicts "
+                f"below cover a partial fleet"
+            )
+        elif t.get("stale"):
+            warn(
+                f"fleetmon scrape of {tname!r} was STALE at snapshot "
+                f"time (age {t.get('age_s')}s) — burn rates ran on old "
+                f"samples"
+            )
+    from tpu_dra.tools.fleetmon import slo_state
+
+    for s in snapshot.get("slos", []):
+        state = slo_state(s)
+        burn = s.get("burn_rate")
+        left = s.get("budget_remaining")
+        windows = " ".join(
+            f"{w}={b:g}" for w, b in (s.get("burn") or {}).items()
+        )
+        lines.append(
+            f"  {s['name']:<20} {state:<9} "
+            f"burn={'-' if burn is None else f'{burn:g}'} "
+            f"budget-left={'-' if left is None else f'{left:.0%}'} "
+            f"[{windows or 'no windows'}] "
+            f"objective {s.get('objective', '?')}"
+        )
+        if s.get("resets"):
+            lines.append(
+                f"    note: {s['resets']} counter reset(s) in the "
+                f"window — an exporting process RESTARTED and "
+                f"re-counted from zero; the burn above is reset-safe "
+                f"(increase sums positive deltas), so do not read the "
+                f"raw counter drop as budget coming back"
+            )
+        if s.get("alert"):
+            sev = s["alert"]
+            warn(
+                f"SLO {s['name']!r} is {'PAGING' if sev == 'page' else 'TICKETING'}: "
+                f"burn rate {burn:g}x budget over the "
+                f"{'fast' if sev == 'page' else 'slow'} window pair "
+                f"({windows}). {s.get('remediation') or ''}".rstrip()
+            )
+        elif s.get("ok") is False:
+            warn(
+                f"SLO {s['name']!r} is out of objective right now "
+                f"(current {s.get('current')}, {s.get('objective')}) "
+                f"but not yet burning past an alert window. "
+                f"{s.get('remediation') or ''}".rstrip()
+            )
+    return "\n".join(lines)
+
+
+def slo_main(argv) -> int:
+    """`doctor slo --snapshot PATH`: read a fleetmon snapshot and
+    print per-SLO burn rate, remaining budget, and remediation.
+    Exit 0 healthy, 1 when any SLO alerts / violates / a target was
+    down (probe-friendly, like the main doctor)."""
+    p = argparse.ArgumentParser(
+        "tpu-dra-doctor slo", description=slo_main.__doc__
+    )
+    p.add_argument(
+        "--snapshot", default="",
+        help="fleetmon snapshot JSON (`fleetmon --once --json-out P`); "
+        "'-' reads stdin",
+    )
+    p.add_argument("--json", action="store_true", dest="as_json")
+    args = p.parse_args(argv)
+    if not args.snapshot:
+        print("doctor slo: need --snapshot PATH (or '-')", file=sys.stderr)
+        return 2
+    try:
+        if args.snapshot == "-":
+            snapshot = json.load(sys.stdin)
+        else:
+            with open(args.snapshot) as f:
+                snapshot = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"doctor slo: cannot read snapshot: {e}", file=sys.stderr)
+        return 2
+    warnings: List[str] = []
+    body = render_slo(snapshot, warnings.append)
+    if args.as_json:
+        print(json.dumps(
+            {"snapshot": snapshot, "warnings": warnings}, indent=2
+        ))
+    else:
+        print(body)
+        for w in warnings:
+            print(f"WARN: {w}")
+        if not warnings:
+            print("healthy: every SLO inside budget")
+    return 1 if warnings else 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:]) if argv is None else list(argv)
     if argv and argv[0] == "explain":
         return explain_main(argv[1:])
+    if argv and argv[0] == "slo":
+        return slo_main(argv[1:])
     p = argparse.ArgumentParser("tpu-dra-doctor", description=__doc__)
     p.add_argument(
         "--plugin-data-dir",

@@ -1,0 +1,746 @@
+"""fleetmon: fleet-wide metrics aggregation + the SLO engine's scraper
+(ISSUE 14).
+
+Every component already exports Prometheus text on its MetricsServer
+(plugin, scheduler + repacker leader, CD controller, multiplexd driver,
+serving router, fleetsim's kubelet analog). fleetmon is the tier above:
+it scrapes every configured ``/metrics`` endpoint on one cadence,
+parses the exposition **round-trip against the registry's label
+escaping** (a claim name carrying ``"`` or ``\\`` must survive
+scrape -> store -> dashboard exactly), classifies series from the
+``# TYPE`` lines (no name-suffix heuristics), feeds a
+:class:`tpu_dra.infra.slo.SampleStore`, and evaluates the built-in SLO
+catalog with multi-window burn-rate alerting.
+
+Per-target health is itself exported (and doctor-checked):
+``fleetmon_target_up{target=}``, ``fleetmon_scrape_age_seconds{target=}``
+(refreshed at scrape time via a collector), and
+``fleetmon_scrape_interval_seconds`` — a target whose age exceeds 3
+intervals is STALE and the doctor says so.
+
+CLI::
+
+    python -m tpu_dra.tools.fleetmon \
+        --target scheduler=127.0.0.1:9093 --target plugin=:9092 \
+        --once --json-out /tmp/slo.json      # one snapshot (2 scrapes)
+    python -m tpu_dra.tools.fleetmon --target ... --watch   # dashboard
+
+``doctor slo --snapshot /tmp/slo.json`` renders the snapshot with
+per-SLO burn rate, remaining budget, and remediation
+(docs/observability.md, "Fleet SLOs & burn-rate alerting").
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from tpu_dra.infra import slo
+from tpu_dra.infra.metrics import Metrics
+
+# Default scrape cadence; the staleness verdict is stated in intervals
+# so it survives retuning.
+DEFAULT_INTERVAL_S = 15.0
+STALE_AFTER_INTERVALS = 3.0
+
+_UNESCAPE = {"n": "\n", '"': '"', "\\": "\\"}
+
+
+def endpoint_url(endpoint: str, path: str) -> str:
+    """host:port / URL -> a full http URL ending in ``path`` (the one
+    normalization shared by fleetmon's scrape, doctor's /metrics probe
+    and explain's /debug/traces scrape, so the rules cannot diverge)."""
+    url = endpoint
+    if not url.startswith(("http://", "https://")):
+        url = f"http://{url}"
+    if not url.endswith(path):
+        url = url.rstrip("/") + path
+    return url
+
+
+# --- exposition parsing ------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Sample:
+    """One parsed series sample. ``type`` comes from the family's
+    ``# TYPE`` line ("counter"/"gauge"/"summary"; summaries cover their
+    ``_sum``/``_count`` children), or "untyped" when absent."""
+
+    name: str
+    labels: Tuple[Tuple[str, str], ...]
+    value: float
+    type: str = "untyped"
+
+    def label_dict(self) -> Dict[str, str]:
+        return dict(self.labels)
+
+
+def _parse_labels(body: str) -> Dict[str, str]:
+    """The inside of ``{...}``, escape-aware: label VALUES may contain
+    ``,``/``=``/escaped quotes — the naive split-on-comma parser is
+    exactly what the registry's escaping exists to defeat."""
+    out: Dict[str, str] = {}
+    i = 0
+    while i < len(body):
+        eq = body.index("=", i)
+        key = body[i:eq].strip()
+        if eq + 1 >= len(body) or body[eq + 1] != '"':
+            raise ValueError(f"unquoted label value for {key!r}")
+        i = eq + 2
+        buf: List[str] = []
+        while True:
+            if i >= len(body):
+                raise ValueError(f"unterminated label value for {key!r}")
+            ch = body[i]
+            if ch == "\\" and i + 1 < len(body):
+                buf.append(_UNESCAPE.get(body[i + 1], body[i + 1]))
+                i += 2
+            elif ch == '"':
+                i += 1
+                break
+            else:
+                buf.append(ch)
+                i += 1
+        out[key] = "".join(buf)
+        if i < len(body) and body[i] == ",":
+            i += 1
+    return out
+
+
+def _find_label_end(line: str, start: int) -> int:
+    """Index of the closing ``}`` of a label block opened at ``start``,
+    skipping escaped characters and quoted sections."""
+    i = start + 1
+    in_quotes = False
+    while i < len(line):
+        ch = line[i]
+        if in_quotes:
+            if ch == "\\":
+                i += 1
+            elif ch == '"':
+                in_quotes = False
+        elif ch == '"':
+            in_quotes = True
+        elif ch == "}":
+            return i
+        i += 1
+    raise ValueError("unterminated label block")
+
+
+def parse_series_labels(series: str) -> Dict[str, str]:
+    """Labels of a rendered series key (``name{k="v",...}``),
+    escape-aware — the doctor's label extraction delegates here so a
+    label value carrying ``,``/``=``/escaped quotes never mis-parses
+    (empty dict for an unlabeled or malformed key)."""
+    brace = series.find("{")
+    if brace == -1:
+        return {}
+    try:
+        end = _find_label_end(series, brace)
+        return _parse_labels(series[brace + 1:end])
+    except (ValueError, IndexError):
+        return {}
+
+
+def parse_exposition(text: str) -> List[Sample]:
+    """Parse a Prometheus text-format page into typed samples. Lines
+    that do not parse are skipped (one hostile series must not poison
+    the whole scrape), but label escaping is honored exactly — the
+    golden round-trip tests pin parse(render()) == registry state."""
+    types: Dict[str, str] = {}
+    out: List[Sample] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3].strip()
+            continue
+        try:
+            brace = line.find("{")
+            space = line.find(" ")
+            if brace != -1 and (space == -1 or brace < space):
+                name = line[:brace]
+                end = _find_label_end(line, brace)
+                labels = _parse_labels(line[brace + 1:end])
+                rest = line[end + 1:]
+            else:
+                name, _, rest = line.partition(" ")
+                labels = {}
+            # `<value> [timestamp]`: the format allows an optional
+            # trailing millisecond timestamp — float() over the whole
+            # remainder would reject every line a standard exporter
+            # stamps, silently emptying the store.
+            value = float(rest.split()[0])
+        except (ValueError, IndexError):
+            continue
+        mtype = types.get(name, "untyped")
+        if mtype == "untyped":
+            for suffix in ("_sum", "_count"):
+                base = name[: -len(suffix)] if name.endswith(suffix) else None
+                if base and types.get(base) == "summary":
+                    mtype = "summary"
+                    break
+        out.append(Sample(
+            name=name, labels=tuple(sorted(labels.items())),
+            value=value, type=mtype,
+        ))
+    return out
+
+
+# --- the scraper -------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Target:
+    """One component /metrics endpoint. ``fetch`` overrides the HTTP
+    GET for in-process composition (harness legs scrape their own
+    registry without a port when they want to)."""
+
+    name: str
+    endpoint: str = ""
+    fetch: Optional[Callable[[], str]] = None
+
+    def scrape(self, timeout: float = 2.0) -> str:
+        if self.fetch is not None:
+            return self.fetch()
+        import urllib.request
+
+        url = endpoint_url(self.endpoint, "/metrics")
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.read().decode()
+
+
+class FleetMon:
+    """Scrape loop + store + catalog evaluation, one object.
+
+    Threading: ``scrape_once`` may run on a background thread while
+    ``evaluate``/``snapshot`` run on the caller's — per-target state is
+    guarded by one lock; the SampleStore locks itself.
+    """
+
+    def __init__(
+        self,
+        targets: List[Target],
+        catalog: Optional[List[slo.SLOSpec]] = None,
+        interval_s: float = DEFAULT_INTERVAL_S,
+        store: Optional[slo.SampleStore] = None,
+        metrics: Optional[Metrics] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.targets = list(targets)
+        self.catalog = list(catalog) if catalog is not None else []
+        self.interval_s = interval_s
+        self.store = store or slo.SampleStore()
+        self.metrics = metrics
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._up: Dict[str, bool] = {}
+        self._last_ok: Dict[str, float] = {}
+        self._errors: Dict[str, int] = {}
+        self._scrapes: Dict[str, int] = {}
+        self._last_error: Dict[str, str] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if self.metrics is not None:
+            self.metrics.set_gauge(
+                "fleetmon_scrape_interval_seconds", self.interval_s
+            )
+            # Ages refresh at scrape time (the doctor's staleness
+            # verdict must see the CURRENT age, not the age at the
+            # last successful pass).
+            self.metrics.register_collector(self._export_ages)
+
+    # -- scraping --
+
+    def scrape_once(self, now: Optional[float] = None) -> Dict[str, bool]:
+        """One pass over every target; per-target failures are recorded
+        (``fleetmon_target_up`` 0, error counter), never raised — the
+        fleet view must survive one sick component."""
+        now = self.clock() if now is None else now
+        verdicts: Dict[str, bool] = {}
+        import http.client
+
+        scrape_errors = (OSError, ValueError, http.client.HTTPException)
+        for t in self.targets:
+            try:
+                samples = [
+                    # Per-target `instance` label before ingest: two
+                    # components legitimately export the SAME series
+                    # (every KubeClient has api_circuit_state{verb=},
+                    # every node plugin has publish_writes_total) and
+                    # merging them into one ring would read target A's
+                    # 1000 -> target B's 10 as a counter reset every
+                    # cycle — phantom resets, garbage burns, a false
+                    # page on a healthy fleet. Rate SLOs still SUM
+                    # across the per-instance series (one fleet, one
+                    # budget); threshold SLOs keep worst-series
+                    # semantics per component.
+                    dataclasses.replace(
+                        s, labels=s.labels + (("instance", t.name),)
+                    )
+                    for s in parse_exposition(t.scrape())
+                ]
+            except scrape_errors as e:
+                with self._lock:
+                    self._up[t.name] = False
+                    self._errors[t.name] = self._errors.get(t.name, 0) + 1
+                    self._scrapes[t.name] = self._scrapes.get(t.name, 0) + 1
+                    self._last_error[t.name] = str(e)
+                if self.metrics is not None:
+                    self.metrics.set_gauge(
+                        "fleetmon_target_up", 0.0,
+                        labels={"target": t.name},
+                    )
+                    self.metrics.inc(
+                        "fleetmon_scrape_errors_total",
+                        labels={"target": t.name},
+                    )
+                verdicts[t.name] = False
+                continue
+            self.store.ingest(samples, now)
+            with self._lock:
+                self._up[t.name] = True
+                self._last_ok[t.name] = now
+                self._scrapes[t.name] = self._scrapes.get(t.name, 0) + 1
+                self._last_error.pop(t.name, None)
+            if self.metrics is not None:
+                self.metrics.set_gauge(
+                    "fleetmon_target_up", 1.0, labels={"target": t.name}
+                )
+                self.metrics.inc("fleetmon_scrapes_total")
+            verdicts[t.name] = True
+        return verdicts
+
+    def _export_ages(self) -> None:
+        now = self.clock()
+        with self._lock:
+            ages = {
+                t.name: now - self._last_ok[t.name]
+                for t in self.targets if t.name in self._last_ok
+            }
+        for name, age in ages.items():
+            self.metrics.set_gauge(
+                "fleetmon_scrape_age_seconds", age,
+                labels={"target": name},
+            )
+
+    def start(self) -> None:
+        """Background scrape loop at ``interval_s`` (idempotent: a
+        second start() while running is a no-op — an orphan second
+        loop would halve the apparent scrape interval and double-count
+        every scrape). The check and the thread assignment stay under
+        ONE lock hold, or two concurrent start()s both pass the check
+        and both spawn loops; the new thread's first scrape simply
+        waits out the remainder of this critical section."""
+
+        def loop():
+            self.scrape_once()
+            while not self._stop.wait(self.interval_s):
+                self.scrape_once()
+
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stop.clear()
+            if self.metrics is not None:
+                # Symmetric with stop()'s cleanup: a restarted monitor
+                # re-hooks its age collector (unregister first so a
+                # start/start never double-registers).
+                self.metrics.unregister_collector(self._export_ages)
+                self.metrics.register_collector(self._export_ages)
+                self.metrics.set_gauge(
+                    "fleetmon_scrape_interval_seconds", self.interval_s
+                )
+            self._thread = threading.Thread(
+                target=loop, daemon=True, name="fleetmon-scrape"
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            t, self._thread = self._thread, None
+        if t is not None:
+            # Join OUTSIDE the lock: the loop thread takes it per
+            # scrape and must be able to finish its last pass.
+            t.join(timeout=10)
+        if self.metrics is not None:
+            # The registry may outlive this monitor (harness legs share
+            # the fleet registry): unhook the age collector and drop
+            # the health gauges, or a deliberately-stopped monitor
+            # keeps exporting ever-growing ages the doctor would flag
+            # as STALE targets (and pins this object alive).
+            self.metrics.unregister_collector(self._export_ages)
+            for name in (
+                "fleetmon_target_up", "fleetmon_scrape_age_seconds",
+            ):
+                self.metrics.remove_gauges(name, {})
+            self.metrics.remove_gauge("fleetmon_scrape_interval_seconds")
+
+    # -- evaluation --
+
+    def evaluate(self, now: Optional[float] = None) -> List[slo.SLOStatus]:
+        now = self.clock() if now is None else now
+        return slo.evaluate_catalog(self.store, self.catalog, now)
+
+    def status_of(self, name: str, now: Optional[float] = None
+                  ) -> Optional[slo.SLOStatus]:
+        # One spec, one evaluation: hot probe loops poll this per tick
+        # and must not pay the whole catalog's store scans each time.
+        now = self.clock() if now is None else now
+        for spec in self.catalog:
+            if spec.name == name:
+                return slo.evaluate(self.store, spec, now)
+        return None
+
+    def target_report(self, now: Optional[float] = None) -> Dict[str, dict]:
+        now = self.clock() if now is None else now
+        with self._lock:
+            out = {}
+            for t in self.targets:
+                age = (
+                    now - self._last_ok[t.name]
+                    if t.name in self._last_ok else None
+                )
+                out[t.name] = {
+                    "endpoint": t.endpoint,
+                    "up": self._up.get(t.name, False),
+                    "age_s": None if age is None else round(age, 3),
+                    "stale": bool(
+                        age is not None
+                        and age > STALE_AFTER_INTERVALS * self.interval_s
+                    ),
+                    "scrapes": self._scrapes.get(t.name, 0),
+                    "errors": self._errors.get(t.name, 0),
+                    "last_error": self._last_error.get(t.name),
+                }
+            return out
+
+    def snapshot(self, now: Optional[float] = None) -> dict:
+        """The JSON document ``--once``/``--json-out`` writes and
+        ``doctor slo`` reads: wall timestamp, per-target health, and
+        every catalog verdict."""
+        now = self.clock() if now is None else now
+        return {
+            "ts": time.time(),
+            "interval_s": self.interval_s,
+            "targets": self.target_report(now),
+            "slos": [st.to_json() for st in self.evaluate(now)],
+        }
+
+
+# --- the built-in catalog ----------------------------------------------------
+
+# Per-class TTFT objectives mirror the router's SLOClass constants
+# (serving/router.py); stated here rather than imported because the
+# layer DAG points serving -> tools, not the reverse.
+DEFAULT_TTFT_TARGETS_S = {
+    "interactive": 0.25,
+    "standard": 1.0,
+    "batch": 30.0,
+}
+
+# ROADMAP item 5's apiserver write budget: slice writes per node per
+# hour. The content-diffed publisher's steady state is ZERO writes, so
+# a budget of one write per node per minute is generous headroom for
+# real weather while a naive per-event republisher blows through it in
+# seconds.
+DEFAULT_WRITE_BUDGET_PER_NODE_PER_HOUR = 60.0
+
+
+def builtin_catalog(
+    nodes: Optional[int] = None,
+    window_scale: float = 1.0,
+    claim_ready_target_s: float = 30.0,
+    ttft_targets_s: Optional[Dict[str, float]] = None,
+    write_budget_per_node_per_hour: float =
+        DEFAULT_WRITE_BUDGET_PER_NODE_PER_HOUR,
+    frag_ceiling: float = 0.25,
+) -> List[slo.SLOSpec]:
+    """The SLO catalog every harness and the CLI share. Specs whose
+    series a fleet does not export simply evaluate to no-data — the
+    catalog is a superset, discovery is what the scrape finds."""
+    policy = slo.scaled_policy(window_scale)
+    window_s = slo.DEFAULT_SLO_WINDOW_S * window_scale
+    ttft = dict(DEFAULT_TTFT_TARGETS_S)
+    ttft.update(ttft_targets_s or {})
+    catalog = [
+        slo.SLOSpec(
+            name="claim-ready-p99",
+            description="claim-submitted -> pod-env-injected p99",
+            kind="threshold",
+            series="claim_ready_seconds",
+            labels=(("quantile", "0.99"),),
+            threshold=claim_ready_target_s, op="le", budget=0.05,
+            window_s=window_s, policy=policy,
+            remediation=(
+                "claim-ready latency is over target: check the "
+                "scheduler's workqueue depth + batch solve latency "
+                "(doctor's workqueue/scheduler sections) and the "
+                "kubelet prepare path (docs/operations.md, 'Fleet "
+                "scale & claim-ready SLO')"
+            ),
+        ),
+        slo.SLOSpec(
+            name="write-budget",
+            description="apiserver slice writes per node per hour",
+            kind="rate",
+            series="publish_writes_total",
+            budget=write_budget_per_node_per_hour,
+            per_seconds=3600.0,
+            divisor=float(nodes) if nodes else 1.0,
+            window_s=window_s, policy=policy,
+            remediation=(
+                "slice publishes are outrunning the apiserver write "
+                "budget: the content-diffed publisher's steady state "
+                "is ZERO writes, so a sustained burn means something "
+                "republishes unchanged content per event (check "
+                "publish_skipped_unchanged_total is climbing next to "
+                "it — flat means the diff cache is being invalidated), "
+                "an external writer is fighting the publisher "
+                "(slice_drift_detected_total), or real weather is "
+                "flapping health faster than coalescing absorbs "
+                "(docs/operations.md, 'The apiserver write budget')"
+            ),
+        ),
+        slo.SLOSpec(
+            name="frag-ceiling",
+            description="fleet fragmentation score ceiling",
+            kind="threshold",
+            series="scheduler_frag_score",
+            threshold=frag_ceiling, op="le", budget=0.10,
+            window_s=window_s, policy=policy,
+            remediation=(
+                "free capacity is stranded past the ceiling: check "
+                "the repacker is leading and migrating (doctor's "
+                "repacker section) and that allocation runs the "
+                "packed ordering (docs/scheduling.md)"
+            ),
+        ),
+        slo.SLOSpec(
+            name="circuit-open",
+            description="apiserver circuit-open minutes",
+            kind="threshold",
+            series="api_circuit_state",
+            threshold=0.0, op="le", budget=0.01,
+            window_s=window_s, policy=policy,
+            remediation=(
+                "a component's apiserver circuit keeps opening: the "
+                "control plane is flapping from that component's view "
+                "— check apiserver health, the network path, and the "
+                "component's degraded-mode counters "
+                "(docs/operations.md, 'Control-plane outages')"
+            ),
+        ),
+    ]
+    for cls, target_s in sorted(ttft.items()):
+        catalog.append(slo.SLOSpec(
+            name=f"ttft-p99-{cls}",
+            description=f"{cls}-class submitted -> first-token p99",
+            kind="threshold",
+            series="fabric_ttft_seconds",
+            labels=(("cls", cls), ("quantile", "0.99")),
+            threshold=target_s, op="le", budget=0.05,
+            window_s=window_s, policy=policy,
+            remediation=(
+                f"the {cls} tier's TTFT p99 is over its objective: "
+                f"check per-tenant WFQ lag (doctor's fabric section), "
+                f"the autoscaler's replica count vs queued tokens, "
+                f"and whether a scale-up is stuck waiting on "
+                f"allocation (docs/serving.md, 'Serving fabric')"
+            ),
+        ))
+    return catalog
+
+
+# --- rendering ---------------------------------------------------------------
+
+
+def slo_state(status: dict) -> str:
+    """The one-word triage state of a snapshot SLO entry — shared by
+    the watch dashboard and `doctor slo` so the two renderers can
+    never disagree on what counts as PAGE vs VIOLATING vs no-data."""
+    if not status.get("data"):
+        return "no-data"
+    if status.get("alert"):
+        return status["alert"].upper()
+    if status.get("ok") is False:
+        return "VIOLATING"
+    return "ok"
+
+
+def render_dashboard(snapshot: dict) -> str:
+    """The watch-mode text dashboard (also what tests golden)."""
+    targets = snapshot.get("targets", {})
+    up = sum(1 for t in targets.values() if t.get("up"))
+    lines = [
+        f"fleetmon   : {up}/{len(targets)} targets up, interval "
+        f"{snapshot.get('interval_s', 0):g}s",
+    ]
+    for name, t in sorted(targets.items()):
+        mark = "UP " if t.get("up") else "DOWN"
+        age = t.get("age_s")
+        stale = " STALE" if t.get("stale") else ""
+        lines.append(
+            f"  target {name:<12} [{mark}] "
+            f"age={'-' if age is None else f'{age:g}s'}{stale} "
+            f"scrapes={t.get('scrapes', 0)} errors={t.get('errors', 0)}"
+        )
+    lines.append(
+        f"{'SLO':<22} {'state':<8} {'current':>12} "
+        f"{'burn':>8} {'left':>6}  windows"
+    )
+    for s in snapshot.get("slos", []):
+        state = slo_state(s)
+        burn = s.get("burn_rate")
+        left = s.get("budget_remaining")
+        cur = s.get("current")
+        windows = " ".join(
+            f"{w}={b:g}" for w, b in (s.get("burn") or {}).items()
+        )
+        reset = " RESET" if s.get("resets") else ""
+        lines.append(
+            f"  {s['name']:<20} {state:<8} "
+            f"{'-' if cur is None else f'{cur:g}':>12} "
+            f"{'-' if burn is None else f'{burn:g}':>8} "
+            f"{'-' if left is None else f'{left:.0%}':>6}  "
+            f"{windows}{reset}"
+        )
+    return "\n".join(lines)
+
+
+# --- CLI ---------------------------------------------------------------------
+
+
+def _parse_target(arg: str) -> Target:
+    name, sep, ep = arg.partition("=")
+    if not sep:
+        # Bare endpoint: name it by its address.
+        return Target(name=arg, endpoint=arg)
+    return Target(name=name, endpoint=ep)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("fleetmon", description=__doc__)
+    p.add_argument(
+        "--target", action="append", default=[], dest="targets",
+        metavar="NAME=HOST:PORT",
+        help="component /metrics endpoint to scrape (repeatable)",
+    )
+    p.add_argument("--interval", type=float, default=DEFAULT_INTERVAL_S)
+    p.add_argument(
+        "--once", action="store_true",
+        help="scrape twice (rates need two samples), print one JSON "
+        "snapshot, exit 0/1 by alert state",
+    )
+    p.add_argument(
+        "--watch", action="store_true",
+        help="scrape on the interval and redraw the text dashboard",
+    )
+    p.add_argument(
+        "--window-scale", type=float, default=1.0,
+        help="shrink the SRE alert windows uniformly (harness runs)",
+    )
+    p.add_argument(
+        "--nodes", type=int, default=0,
+        help="fleet node count for the per-node write budget",
+    )
+    p.add_argument(
+        "--claim-ready-target", type=float, default=30.0,
+        help="claim-ready p99 objective, seconds",
+    )
+    p.add_argument(
+        "--write-budget", type=float,
+        default=DEFAULT_WRITE_BUDGET_PER_NODE_PER_HOUR,
+        help="allowed slice writes per node per hour",
+    )
+    p.add_argument("--json-out", default="", help="write the snapshot here")
+    p.add_argument(
+        "--metrics-port", type=int, default=0,
+        help="serve fleetmon's OWN /metrics here (fleetmon_target_up, "
+        "scrape ages — what `doctor --metrics-endpoint` probes); "
+        "0 = off",
+    )
+    args = p.parse_args(argv)
+    if not args.targets:
+        print("fleetmon: need at least one --target", file=sys.stderr)
+        return 2
+    own = Metrics()
+    fm = FleetMon(
+        [_parse_target(t) for t in args.targets],
+        catalog=builtin_catalog(
+            nodes=args.nodes or None,
+            window_scale=args.window_scale,
+            claim_ready_target_s=args.claim_ready_target,
+            write_budget_per_node_per_hour=args.write_budget,
+        ),
+        interval_s=args.interval,
+        metrics=own,
+    )
+    mon_srv = None
+    if args.metrics_port:
+        from tpu_dra.infra.metrics import start_health_server
+
+        mon_srv = start_health_server(own, args.metrics_port)
+        if mon_srv is not None:
+            print(
+                f"fleetmon: serving /metrics on :{mon_srv.port}",
+                file=sys.stderr,
+            )
+    if args.watch:
+        try:
+            while True:
+                fm.scrape_once()
+                snap = fm.snapshot()
+                if args.json_out:
+                    # Continuously refreshed snapshot: the documented
+                    # `doctor slo --snapshot` pairing works against a
+                    # live watcher, not only a --once run (atomic
+                    # replace so a reader never sees a torn file).
+                    tmp = args.json_out + ".tmp"
+                    with open(tmp, "w") as f:
+                        f.write(json.dumps(snap, indent=2) + "\n")
+                    os.replace(tmp, args.json_out)
+                print("\n" + render_dashboard(snap), flush=True)
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+        finally:
+            if mon_srv is not None:
+                mon_srv.stop()
+    # --once (default): two spaced scrapes so rate()/increase() have a
+    # window to work with.
+    try:
+        fm.scrape_once()
+        time.sleep(min(args.interval, 2.0))
+        fm.scrape_once()
+        snap = fm.snapshot()
+        doc = json.dumps(snap, indent=2)
+        if args.json_out:
+            with open(args.json_out, "w") as f:
+                f.write(doc + "\n")
+        print(doc)
+        paging = [
+            s["name"] for s in snap["slos"] if s.get("alert") == "page"
+        ]
+        down = [
+            n for n, t in snap["targets"].items() if not t.get("up")
+        ]
+        return 1 if paging or down else 0
+    finally:
+        if mon_srv is not None:
+            mon_srv.stop()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
