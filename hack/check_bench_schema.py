@@ -86,6 +86,25 @@ TYPED_WHEN_PRESENT = {
     "decode_sharded_tok_s": (int, float),
     "decode_mesh": str,
     "serve_sampled_tok_s": (int, float),
+    # Speculative decoding + COW prefix sharing + batched chunked
+    # prefill (ISSUE 15): spec-vs-nonspec on the lookup-friendly
+    # trace, the live acceptance rate, the fleet-of-N peak-page
+    # saving, and the batched-vs-serial first-token p50. The B100 pass
+    # forward-requires serve_spec_tok_s / spec_accept_rate /
+    # prefix_pages_saved / prefill_batched_ttft_p50_ms ahead of their
+    # first recorded artifact.
+    "serve_spec_tok_s": (int, float),
+    "serve_spec_baseline_tok_s": (int, float),
+    "serve_spec_vs_nonspec": (int, float),
+    "spec_accept_rate": (int, float),
+    "spec_k": int,
+    "prefix_pages_saved": int,
+    "prefix_fleet_n": int,
+    "prefix_private_peak_pages": int,
+    "prefix_shared_peak_pages": int,
+    "prefill_batched_ttft_p50_ms": (int, float),
+    "prefill_serial_ttft_p50_ms": (int, float),
+    "fabric_prefix_pages_saved": int,
     # Fleet control-plane leg (ISSUE 10): claim-ready SLO over the
     # simulated 5k-node fleet, relist-storm heal latency, and the
     # sharded+batched vs per-event/unsharded p99 ratio. The B100 pass

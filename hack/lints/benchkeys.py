@@ -87,6 +87,16 @@ REQUIRED_STATIC = (
     # before its first recorded artifact.
     "slo_write_budget_ok",
     "slo_claim_ready_burn_rate",
+    # Speculative decoding + COW prefix sharing + batched chunked
+    # prefill (ISSUE 15): the spec-vs-nonspec serving rate on the
+    # lookup-friendly trace, the live acceptance rate, the
+    # fleet-of-N prefix page saving, and the batched-prefill TTFT —
+    # dropping any of them would blind the raw-decode-speed regression
+    # tripwire before its first recorded artifact.
+    "serve_spec_tok_s",
+    "spec_accept_rate",
+    "prefix_pages_saved",
+    "prefill_batched_ttft_p50_ms",
 )
 
 

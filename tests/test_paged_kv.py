@@ -386,6 +386,153 @@ def test_paged_dispatch_auto_prefers_pallas_on_platform(
     assert A._LAST_PAGED_IMPL == "xla"
 
 
+# --- COW page forks + rewind primitives (ISSUE 15) ---------------------------
+
+
+@pytest.mark.parametrize("kv", ["none", "int8"])
+def test_copy_page_forks_all_pools_including_scales(kv):
+    """Satellite: int8 scale pools are forked WITH their pages — a COW
+    copy carries values and scales for every layer, so a forked int8
+    sequence dequantizes identically to its parent."""
+    cache = init_paged_cache(CFG, num_pages=5, page_size=4, kv_quant=kv)
+    filled = _fill_pages(cache, [1], length=3)
+    forked = paged_kv.copy_page(filled, src=1, dst=2)
+    for _, pool in forked._pools():
+        for layer in pool:
+            assert jnp.array_equal(layer[2], layer[1])
+    # The source is untouched and other pages stay zero.
+    assert paged_kv.pages_are_zero(forked, [3, 4])
+
+
+def test_copy_page_prefix_freezes_zero_tail():
+    """The frozen-boundary fork: only [0, upto) copies; the tail of the
+    destination is ZERO even when the source page carries the
+    registrant's own tokens past the prefix (the zero-tail invariant
+    every sharer forks from)."""
+    cache = init_paged_cache(
+        CFG, num_pages=5, page_size=4, kv_quant="int8"
+    )
+    filled = _fill_pages(cache, [1], length=4)  # source fully written
+    frozen = paged_kv.copy_page_prefix(filled, src=1, dst=2, upto=2)
+    for _, pool in frozen._pools():
+        for layer in pool:
+            assert jnp.array_equal(layer[2][:2], layer[1][:2])
+            assert float(
+                jnp.sum(jnp.abs(layer[2][2:].astype(jnp.float32)))
+            ) == 0.0
+    assert paged_kv.tail_is_zero(frozen, [2], 2)
+
+
+def test_zero_page_tail_rewinds_in_place():
+    """Speculative rewind: positions >= start of one page are wiped in
+    every pool; earlier positions survive byte-for-byte."""
+    cache = init_paged_cache(
+        CFG, num_pages=4, page_size=4, kv_quant="int8"
+    )
+    filled = _fill_pages(cache, [1], length=4)
+    wiped = paged_kv.zero_page_tail(filled, 1, start=1)
+    for (name, pool), (_, opool) in zip(wiped._pools(), filled._pools()):
+        for layer, orig in zip(pool, opool):
+            assert jnp.array_equal(layer[1][:1], orig[1][:1]), name
+            assert float(
+                jnp.sum(jnp.abs(layer[1][1:].astype(jnp.float32)))
+            ) == 0.0, name
+    assert paged_kv.tail_is_zero(wiped, [1], 1)
+
+
+def test_allocator_shared_extra_and_min_free():
+    a = PageAllocator(6)
+    assert a.shared_extra() == 0
+    p1, p2 = a.alloc(), a.alloc()
+    a.incref(p1)
+    a.incref(p1)
+    assert a.shared_extra() == 2  # one page standing in for 3 copies
+    assert a.min_free == 3
+    a.decref(p1)
+    assert a.shared_extra() == 1
+    a.decref(p2)
+    a.alloc()
+    assert a.min_free == 3  # low-water survives the free
+
+
+def test_allocator_shared_extra_discounts_registry_pins():
+    """A reference held by a cache/registry stands in for no
+    allocation: a registered-but-never-shared page reports 0 saved;
+    savings count only the effective (sequence-held) refcount."""
+    a = PageAllocator(6)
+    p1, p2 = a.alloc(), a.alloc()
+    a.incref(p1)  # registry pin: registrant 1 + registry 1
+    assert a.shared_extra() == 1
+    assert a.shared_extra(discount={p1: 1}) == 0
+    a.incref(p1)  # one real sharer
+    assert a.shared_extra(discount={p1: 1}) == 1
+    # p2 registry-only (frozen boundary page, no sequence holder yet).
+    assert a.shared_extra(discount={p1: 1, p2: 1}) == 1
+    a.incref(p2)
+    a.incref(p2)  # two sharers fork off the frozen page
+    assert a.shared_extra(discount={p1: 1, p2: 1}) == 2
+
+
+# --- multiquery (verify / batched-prefill) op (ISSUE 15) ---------------------
+
+
+@pytest.mark.parametrize("quant", [False, True])
+def test_paged_multiquery_matches_reference(quant):
+    q1, kp, vp, ksc, vsc, tables, lengths = _random_paged(
+        10, b=3, num_pages=13, page=4, kvh=2, hd=64, quant=quant
+    )
+    s = 3
+    key = jax.random.PRNGKey(21)
+    q = jax.random.normal(key, (3, s, 4, 64), jnp.float32)
+    # Chunk starts: the queries sit at [pos, pos+s) — keep them inside
+    # each sequence's table capacity.
+    pos = jnp.asarray([0, 2, 5], jnp.int32)
+    ref = A.reference_paged_multiquery_attention(
+        q, kp, vp, tables, pos, k_scale=ksc, v_scale=vsc
+    )
+    got = A.paged_multiquery_attention(
+        q, kp, vp, tables, pos, k_scale=ksc, v_scale=vsc
+    )
+    assert float(jnp.max(jnp.abs(got - ref))) < 1e-4
+
+
+def test_paged_multiquery_row_matches_single_sequence_prefill_op():
+    """A batch row of the multiquery op runs the SAME online-softmax
+    block walk as the single-sequence chunked-prefill op — appended
+    fully-masked blocks (another row's longer frontier) contribute
+    exactly zero, so rows are independent of their batchmates. This is
+    the op-level half of the batched-prefill token-parity contract."""
+    _, kp, vp, _, _, tables, _ = _random_paged(
+        11, b=3, num_pages=13, page=4, kvh=2, hd=64
+    )
+    s = 3
+    q = jax.random.normal(jax.random.PRNGKey(5), (3, s, 4, 64), jnp.float32)
+    pos = jnp.asarray([1, 4, 7], jnp.int32)
+    batched = A.paged_multiquery_attention(q, kp, vp, tables, pos)
+    for i in range(3):
+        single = A.paged_prefill_attention(
+            q[i], kp, vp, tables[i], pos[i]
+        )
+        assert float(
+            jnp.max(jnp.abs(batched[i] - single))
+        ) <= 2e-6, f"row {i} diverged from the single-sequence op"
+
+
+def test_paged_multiquery_validates_shapes():
+    q = jnp.zeros((2, 3, 4, 64))
+    kp = jnp.zeros((5, 4, 2, 64))
+    tables = jnp.zeros((2, 2), jnp.int32)
+    pos = jnp.zeros((2,), jnp.int32)
+    with pytest.raises(ValueError, match="k_scale and v_scale"):
+        A.paged_multiquery_attention(
+            q, kp, kp, tables, pos, k_scale=jnp.zeros((5, 4, 2))
+        )
+    with pytest.raises(ValueError, match="do not match batch"):
+        A.paged_multiquery_attention(q, kp, kp, tables[:1], pos)
+    with pytest.raises(ValueError, match="unknown paged multiquery"):
+        A.paged_multiquery_attention(q, kp, kp, tables, pos, impl="bogus")
+
+
 def test_paged_dispatch_auto_falls_back_on_bad_head_dim(interpret_mode):
     """hd not a lane multiple -> the kernel is ineligible and auto
     quietly takes the gather path instead of tripping mosaic."""

@@ -117,16 +117,30 @@ class TenantTraffic:
     prompt_lens: List[int]
     output_lens: List[int]
     sessions: int = 0  # 0 = affinity by prompt-prefix digest
+    # ISSUE 15: the tenant's requests share one system prompt — the
+    # first shared_prefix_len tokens of every prompt are a per-tenant
+    # constant (drawn once from the tenant's rng). The router's
+    # prefix digest then matches across the tenant's traffic and the
+    # engines share the prefix pages copy-on-write; fabricbench
+    # records the fleet-level saving as fabric_prefix_pages_saved.
+    shared_prefix_len: int = 0
 
 
 def make_fabric_trace(seed: int, traffic: List[TenantTraffic], vocab: int):
     """Seeded merged trace: per-tenant Poisson arrivals, prompt/output
-    mixes, optional session ids. Returns arrival-sorted
-    ``(arrival_s, tenant, Request, session)`` tuples — the contract the
-    smoke pins as deterministic before spending minutes replaying it."""
+    mixes, optional session ids and a per-tenant shared system-prompt
+    prefix. Returns arrival-sorted ``(arrival_s, tenant, Request,
+    session)`` tuples — the contract the smoke pins as deterministic
+    before spending minutes replaying it."""
     out = []
     for ti, tt in enumerate(traffic):
         rng = np.random.default_rng((seed, ti))
+        shared = (
+            rng.integers(
+                1, vocab, tt.shared_prefix_len
+            ).astype(np.int32)
+            if tt.shared_prefix_len else None
+        )
         arrivals = np.cumsum(
             rng.exponential(1.0 / tt.rate_rps, tt.requests)
         )
@@ -137,12 +151,15 @@ def make_fabric_trace(seed: int, traffic: List[TenantTraffic], vocab: int):
                 f"{tt.spec.name}-s{int(rng.integers(tt.sessions))}"
                 if tt.sessions else None
             )
+            prompt = rng.integers(1, vocab, plen).astype(np.int32)
+            if shared is not None and plen > len(shared):
+                prompt[: len(shared)] = shared
             out.append((
                 float(arrivals[i]),
                 tt.spec.name,
                 Request(
                     rid=f"{tt.spec.name}-{i:05d}",
-                    prompt=rng.integers(1, vocab, plen).astype(np.int32),
+                    prompt=prompt,
                     max_new_tokens=olen,
                 ),
                 session,
@@ -416,8 +433,17 @@ def run_headline(
             for name, st in fab.router.tenant_stats().items()
         }
         hits, misses = fab.router.affinity_hits, fab.router.affinity_misses
+        # Fleet-level COW prefix sharing (ISSUE 15): high-water of
+        # page allocations the engines avoided by incref'ing shared
+        # prefix pages, summed over the replica fleet — the router's
+        # prefix grouping measured as MEMORY, not just hit-rate.
+        prefix_saved = sum(
+            int(getattr(rep.engine, "prefix_saved_hw", 0))
+            for rep in fab.router.replicas
+        )
         out = {
             **res,
+            "prefix_pages_saved": prefix_saved,
             "replicas": len(fab.router.live_replicas()),
             "completed": len(done),
             "ttft": fab.ttft_quantiles(),
@@ -652,7 +678,14 @@ def run(
         TenantTraffic(
             TenantSpec("gold", INTERACTIVE, weight=4.0),
             requests=int(requests * 0.27), rate_rps=rate * 0.25,
-            prompt_lens=[4, 8, 12], output_lens=[2, 4, 6], sessions=50,
+            # One shared 16-token system prompt across the tenant
+            # (ISSUE 15): the router's affinity-prefix digest matches
+            # across gold's traffic, the engines share the prefix's
+            # pages copy-on-write, and the headline records the fleet
+            # saving as fabric_prefix_pages_saved. Prompts run past
+            # the prefix so the share point stays page-aligned.
+            prompt_lens=[20, 24, 28], output_lens=[2, 4, 6],
+            sessions=50, shared_prefix_len=16,
         ),
         TenantTraffic(
             TenantSpec("silver", STANDARD, weight=2.0),
@@ -706,6 +739,7 @@ def run(
         "fabric_peak_concurrent": headline["peak_concurrent"],
         "fabric_wfq_max_lag_tokens": headline["wfq_max_lag_tokens"],
         "fabric_affinity_hit_rate": headline["affinity_hit_rate"],
+        "fabric_prefix_pages_saved": headline["prefix_pages_saved"],
         "fabric_tenant_shares": headline["tenant_token_shares"],
         "fabric_per_tenant_ttft": headline["per_tenant_ttft"],
         "fabric_quiet_p99_ms": fairness["quiet_p99_ms"],
@@ -779,6 +813,14 @@ def run(
         assert report["slo_ttft_batch_ok"], (
             f"batch-class TTFT SLO violating at smoke scale: "
             f"{slo_verdicts['ttft-p99-batch']}"
+        )
+        # Gold's shared system prompt must actually share pages on the
+        # engines (ISSUE 15): the router stamps its popular prefix and
+        # at least one replica registers + increfs it.
+        assert report["fabric_prefix_pages_saved"] >= 1, (
+            "fabric_prefix_pages_saved is 0 — the shared gold prefix "
+            "never shared a page on any replica (router stamping or "
+            "engine registry broke)"
         )
         _note(
             "smoke contract: trace determinism, SLO keys, fairness "

@@ -138,7 +138,7 @@ class _FabricReq:
     __slots__ = (
         "rid", "tenant", "prompt", "max_new", "session", "cost",
         "start_tag", "finish_tag", "t_submit", "t_first", "emitted",
-        "replicas", "trace_ctx", "t_dispatch",
+        "replicas", "trace_ctx", "t_dispatch", "prefix_key",
     )
 
     def __init__(self, rid, tenant, prompt, max_new, session, cost):
@@ -160,6 +160,10 @@ class _FabricReq:
         # under it (recorded retroactively from the completion stamps).
         self.trace_ctx = trace.new_ctx()
         self.t_dispatch: Optional[float] = None
+        # Content digest of the prompt's affinity prefix — the engine's
+        # prefix-sharing id (ISSUE 15), stamped at dispatch only once
+        # the prefix has proven popular (>= 2 submissions).
+        self.prefix_key: Optional[str] = None
 
     @property
     def remaining(self) -> int:
@@ -299,6 +303,16 @@ class Router:
         self.affinity_hits = 0
         self.affinity_misses = 0
         self.max_lag_tokens = 0.0  # high-water starvation lag observed
+        # Prefix popularity (ISSUE 15): content-digest -> submissions
+        # seen, bounded LRU. A request is stamped with prefix_id /
+        # prefix_len for the ENGINE's copy-on-write sharing only once
+        # its prefix digest has been seen >= 2 times — unique-prompt
+        # traffic never pays the engine-side registration cost, while
+        # a shared system prompt starts sharing from its second user.
+        self._prefix_seen: "collections.OrderedDict[str, int]" = (
+            collections.OrderedDict()
+        )
+        self._prefix_seen_cap = 1024
         # Gauge export rides poll() but is throttled: the control loop
         # polls every ~ms and re-rendering the whole per-tenant gauge
         # set each pass starves the engine threads of the GIL for
@@ -346,6 +360,19 @@ class Router:
                 req.rid, tenant, np.asarray(req.prompt, np.int32),
                 req.max_new_tokens, session, cost,
             )
+            npfx = min(
+                self.config.affinity_prefix_tokens, len(fr.prompt)
+            )
+            if npfx > 1:
+                pkey = hashlib.sha1(
+                    fr.prompt[:npfx].tobytes()
+                ).hexdigest()
+                self._prefix_seen[pkey] = (
+                    self._prefix_seen.pop(pkey, 0) + 1
+                )
+                while len(self._prefix_seen) > self._prefix_seen_cap:
+                    self._prefix_seen.popitem(last=False)
+                fr.prefix_key = pkey
             fr.t_submit = self.clock()
             fr.start_tag = max(self._vtime, ts.tail_tag)
             fr.finish_tag = fr.start_tag + cost / ts.spec.weight
@@ -446,6 +473,11 @@ class Router:
                 ts.queue.popleft()
                 self._vtime = max(self._vtime, fr.start_tag)
                 self._inflight_tokens += fr.cost
+                # Read under the same lock submit() mutates it under.
+                popular = (
+                    fr.prefix_key is not None
+                    and self._prefix_seen.get(fr.prefix_key, 0) >= 2
+                )
                 # High-water starvation lag is tracked HERE — vtime
                 # only moves on dispatch, so sampling it in the
                 # throttled export would miss any spike that drains
@@ -475,6 +507,13 @@ class Router:
             )
             rep.inflight[fr.rid] = fr
             fr.replicas.append(rep.name)
+            # Prefix sharing (ISSUE 15): stamp the engine's COW fields
+            # once the prefix digest is popular (>= 2 submissions). The
+            # digest is over fr.prompt — a resumed sequence's folded
+            # emitted tokens ride AFTER the prefix, so its prefix
+            # tokens still match the registered entry and the resume
+            # RE-ATTACHES via incref instead of re-materializing
+            # private pages.
             with trace.span(
                 "serving.request.dispatch", ctx=fr.trace_ctx,
                 attrs={"rid": fr.rid, "replica": rep.name},
@@ -486,6 +525,11 @@ class Router:
                     # re-observe the engine's TTFT histogram with a
                     # near-zero sample.
                     ttft_preobserved=fr.t_first is not None,
+                    prefix_id=fr.prefix_key if popular else None,
+                    prefix_len=min(
+                        self.config.affinity_prefix_tokens,
+                        len(fr.prompt),
+                    ) if popular else 0,
                 ))
             moved = True
         return moved

@@ -110,6 +110,17 @@ FRAG_WARN_THRESHOLD = 0.25
 ENGINE_STALL_GAUGE = "engine_admission_stalled"
 ENGINE_PAGES_FREE_GAUGE = "engine_pages_free"
 ENGINE_EXHAUSTED_COUNTER = "engine_page_exhausted_total"
+# Speculative decoding (ISSUE 15): proposed/accepted draft-token
+# counters and the live prefix-sharing gauge. A live acceptance rate
+# under the floor while speculation is enabled means every verify pass
+# is paying K wasted positions of compute and a rewind — pure overhead
+# vs plain decoding; the floor only arms once enough proposals exist
+# for the ratio to mean something.
+ENGINE_SPEC_PROPOSED_COUNTER = "engine_spec_proposed_total"
+ENGINE_SPEC_ACCEPTED_COUNTER = "engine_spec_accepted_total"
+ENGINE_PREFIX_SHARED_GAUGE = "engine_prefix_shared_pages"
+ENGINE_SPEC_ACCEPT_WARN_RATE = 0.1
+ENGINE_SPEC_MIN_PROPOSED = 64
 # Momentary stalls are the multiplexing quantum working as intended; a
 # stall older than this means the lease is not coming back (daemon
 # wedged, cooldown storm, starved FIFO) and requests are aging in the
@@ -683,6 +694,12 @@ def _check_engine(
             out["pages_free"] = int(value)
         elif name.endswith(ENGINE_EXHAUSTED_COUNTER):
             out["page_exhausted"] = int(value)
+        elif name.endswith(ENGINE_SPEC_PROPOSED_COUNTER):
+            out["spec_proposed"] = int(value)
+        elif name.endswith(ENGINE_SPEC_ACCEPTED_COUNTER):
+            out["spec_accepted"] = int(value)
+        elif name.endswith(ENGINE_PREFIX_SHARED_GAUGE):
+            out["prefix_shared_pages"] = int(value)
     stalled = out.get("admission_stalled_s", 0.0)
     if stalled > ENGINE_STALL_WARN_SECONDS:
         warn(
@@ -703,6 +720,22 @@ def _check_engine(
             f"(num_pages), or enable int8 KV (kv_quant) to halve page "
             f"bytes (docs/serving.md)"
         )
+    proposed = out.get("spec_proposed", 0)
+    if proposed >= ENGINE_SPEC_MIN_PROPOSED:
+        rate = out.get("spec_accepted", 0) / proposed
+        out["spec_accept_rate"] = round(rate, 4)
+        if rate < ENGINE_SPEC_ACCEPT_WARN_RATE:
+            warn(
+                f"{ep}: speculative-decoding acceptance rate is "
+                f"{rate:.3f} over {proposed} proposed draft tokens "
+                f"(floor {ENGINE_SPEC_ACCEPT_WARN_RATE}) — at this "
+                f"rate every verify pass pays K wasted positions and "
+                f"a rewind: speculation is PURE OVERHEAD vs plain "
+                f"decoding. Disable it (spec_k=0) or raise the lookup "
+                f"order (spec_lookup_order) so the proposer only "
+                f"fires on real structure (docs/serving.md, "
+                f"'Speculative decoding & prefix sharing')"
+            )
     return out
 
 
@@ -1094,6 +1127,16 @@ def render(report: dict) -> str:
                 parts.append(f"pages_free={eng['pages_free']}")
             if "page_exhausted" in eng:
                 parts.append(f"exhausted={eng['page_exhausted']}")
+            if "spec_accept_rate" in eng:
+                parts.append(
+                    f"spec_accept={eng['spec_accept_rate']:g} "
+                    f"({eng.get('spec_accepted', 0)}/"
+                    f"{eng.get('spec_proposed', 0)})"
+                )
+            if "prefix_shared_pages" in eng:
+                parts.append(
+                    f"shared_pages={eng['prefix_shared_pages']}"
+                )
             lines.append(f"  engine: {' '.join(parts)}")
         fabric = m.get("fabric") or {}
         if fabric:

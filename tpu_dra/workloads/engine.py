@@ -16,9 +16,25 @@ calls for:
   pays compute and HBM for its LIVE context only;
 - **continuous batching**: sequences are admitted and evicted BETWEEN
   scan chunks (``scan_chunk`` decode steps per jitted call), with
-  chunked prefill (``prefill_chunk`` tokens per engine iteration)
-  interleaved with decode — the Sarathi-style chunk budget: a long
-  prompt never stalls in-flight decodes for more than one chunk;
+  chunked prefill interleaved with decode — the Sarathi-style chunk
+  budget: a long prompt never stalls in-flight decodes for more than
+  one chunk. Since ISSUE 15 prefill is BATCHED: chunks from every
+  currently-prefilling sequence pack into one padded bucket per
+  iteration (``prefill_batch``), so TTFT under admission bursts is no
+  longer serialized;
+- **speculative decoding** (ISSUE 15, ``spec_k``): a pluggable
+  :class:`~tpu_dra.workloads.specdraft.DraftSource` (default n-gram
+  prompt lookup) proposes up to K tokens; ONE jitted verify pass
+  evaluates all K+1 positions against the paged cache, each position's
+  pick replaying the exact (seed, serial, position) schedule — so
+  acceptance is exact-parity (the per-token oracle token-matches,
+  greedy AND sampled) and rejected positions rewind host-side (pages
+  freed past the accepted length, boundary tail re-zeroed);
+- **copy-on-write prefix sharing** (ISSUE 15, ``Request.prefix_id``):
+  sequences sharing a verified prompt prefix map its pages once via
+  ``PageAllocator.incref`` and fork on the first divergent write —
+  one system prompt costs one page set; the registry is an LRU that
+  sheds under page pressure and flushes on drain (resume re-attaches);
 - **multiplexd-aware backpressure**: the engine runs behind a
   :class:`LeaseGate`. When the gate closes (a co-tenant holds the chip
   lease, or the daemon revoked ours — workloads/multiplex_client.py),
@@ -167,6 +183,15 @@ class Request:
     # elsewhere: the engine must not observe engine_ttft_seconds again
     # — the resume's "first" token would log a bogus near-zero sample.
     ttft_preobserved: bool = False
+    # Prefix sharing (ISSUE 15): requests declaring the same prefix_id
+    # AND whose first prefix_len prompt tokens actually match (the
+    # engine verifies — an id is a hint, never trusted) map the shared
+    # prefix's pages ONCE via PageAllocator.incref and fork
+    # copy-on-write at the first divergent write. The fabric router
+    # stamps these from its affinity-prefix digest; callers may set
+    # them explicitly. 0 / None = no sharing.
+    prefix_id: "str | None" = None
+    prefix_len: int = 0
 
 
 @dataclasses.dataclass
@@ -239,6 +264,23 @@ class _Sequence:
         return self.req.max_new_tokens - len(self.out)
 
 
+class _SharedPrefix:
+    """One registered shared prefix: the verified token prefix, the
+    page set covering it (full pages shared in place with the
+    registering sequence; a mid-page boundary is a FROZEN private copy
+    whose tail is zero — so sharers always fork from a page honoring
+    the zero-tail invariant), and the registry's own page references
+    (dropped on eviction/flush)."""
+
+    __slots__ = ("prefix_id", "tokens", "length", "pages")
+
+    def __init__(self, prefix_id, tokens, length, pages):
+        self.prefix_id = prefix_id
+        self.tokens = tokens  # np.int32 [length]
+        self.length = length
+        self.pages = pages  # ordered page ids covering [0, length)
+
+
 @dataclasses.dataclass
 class EngineConfig:
     page_size: int = 16
@@ -272,6 +314,32 @@ class EngineConfig:
     # (workloads/parallel/mesh.py) are exactness-preserving: sharded
     # decode is token-identical to unsharded (the shardbench gate).
     sharded: bool = False
+    # Speculative decoding (ISSUE 15): spec_k > 0 replaces the decode
+    # scan with one jitted VERIFY pass per iteration — a DraftSource
+    # (default: NgramDraft(spec_lookup_order) prompt lookup) proposes
+    # up to spec_k tokens, their K/V is written into the sequence's
+    # pages, and all spec_k+1 positions are evaluated at once. The pick
+    # at every position replays the exact (seed, serial, position)
+    # schedule the per-token path uses (greedy argmax or the PR-2
+    # sampler), so acceptance is exact-parity by construction: the
+    # unfused per-token oracle token-matches no matter what the
+    # proposer guesses. Rejected positions rewind host-side (pages
+    # freed past the accepted length, boundary tail re-zeroed).
+    spec_k: int = 0
+    spec_lookup_order: int = 3
+    # Batched chunked prefill (ISSUE 15): 0 = pack chunks from EVERY
+    # currently-prefilling sequence into one padded bucket per
+    # iteration (TTFT under admission bursts stops being serialized);
+    # n >= 1 caps the rows per bucket (1 = the old one-sequence-per-
+    # iteration behavior, kept as the serialized TTFT baseline the
+    # bench compares against). The Sarathi stall bound stays the
+    # bucket's CHUNK length (<= prefill_chunk); the row count rides the
+    # hardware's batch parallelism.
+    prefill_batch: int = 0
+    # Prefix-sharing registry capacity (LRU): how many distinct shared
+    # prefixes this engine keeps pinned. Entries hold page references;
+    # eviction (cap, drain, idle exit, page pressure) decrefs them.
+    prefix_cache_entries: int = 8
 
     def resolved_num_pages(self) -> int:
         return self.num_pages or 1 + self.max_slots * self.max_pages_per_seq
@@ -306,6 +374,7 @@ class Engine:
         gate: Optional[LeaseGate] = None,
         metrics=None,
         clock=time.monotonic,
+        draft_source=None,
     ):
         import jax
 
@@ -320,6 +389,25 @@ class Engine:
         self.ec = engine_config or EngineConfig()
         if self.ec.scan_chunk < 1 or self.ec.prefill_chunk < 1:
             raise ValueError("scan_chunk and prefill_chunk must be >= 1")
+        if self.ec.spec_k < 0 or self.ec.prefill_batch < 0:
+            raise ValueError("spec_k and prefill_batch must be >= 0")
+        if self.ec.spec_k > 0 and not self.ec.fused:
+            raise ValueError(
+                "spec_k requires fused=True — the unfused per-token "
+                "path IS the exactness oracle speculation is verified "
+                "against"
+            )
+        if self.ec.spec_k > 0 and self.ec.sharded:
+            raise ValueError(
+                "spec_k with sharded=True is not supported yet (the "
+                "verify pass has no GSPMD sharding rules); run "
+                "speculation on single-chip engines"
+            )
+        self._draft = draft_source
+        if self._draft is None and self.ec.spec_k > 0:
+            from tpu_dra.workloads.specdraft import NgramDraft
+
+            self._draft = NgramDraft(self.ec.spec_lookup_order)
         params = unroll_params(params)
         if self.ec.weight_quant == "int8":
             params = quantize_params(params)
@@ -403,6 +491,19 @@ class Engine:
         self.completed: Dict[str, Completion] = {}
         self._stalled_since: Optional[float] = None
         self._exhausted_exported = 0
+        # Prefix-sharing registry (ISSUE 15): prefix_id -> _SharedPrefix
+        # holding ONE page-reference set per distinct shared prefix
+        # (insertion-ordered dict = LRU by registration). Entries pin
+        # their pages (incref); flushed on drain/evacuate/idle exit,
+        # LRU-evicted at the cap, and shed under page pressure.
+        self._prefix_registry: Dict[str, _SharedPrefix] = {}
+        # Lifetime speculation accounting (bench-readable without a
+        # metrics registry).
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.prefix_attached = 0
+        self.cow_copies = 0
+        self.prefix_saved_hw = 0  # high-water of allocator.shared_extra
         self._jit_fns()
 
     # --- jitted forward -------------------------------------------------
@@ -430,13 +531,15 @@ class Engine:
                     static_argnames=("steps",),
                 ),
                 jax.jit(functools.partial(_decode_step, c, quant, sampling)),
-                jax.jit(functools.partial(_prefill_chunk, c, quant)),
+                jax.jit(functools.partial(_prefill_batch, c, quant)),
+                jax.jit(functools.partial(_verify_chunk, c, quant, sampling)),
             )
             _JIT_CACHE[key] = fns
         (
             self._decode_chunk_fn,
             self._decode_step_fn,
             self._prefill_chunk_fn,
+            self._verify_chunk_fn,
         ) = fns
 
     # --- public API ------------------------------------------------------
@@ -510,6 +613,10 @@ class Engine:
             elif not made_progress and not stalled:
                 # Idle but not done: waiting on a future arrival.
                 time.sleep(poll_seconds)
+        # Idle exit: the prefix cache releases its page pins so a
+        # completed run leaves the allocator leak-free (the zero-leak
+        # acceptance); the next run re-registers on first use.
+        self._flush_prefix_registry()
         self._flush_zero()
         self._export()
         return self.completed
@@ -579,6 +686,12 @@ class Engine:
         sequences resume at the FRONT of the queue (oldest first) with
         their emitted tokens folded into the context — nothing is lost,
         nothing re-emitted. Returns how many sequences were drained."""
+        # The prefix cache's page pins go too — the co-tenant gets ALL
+        # the pages. Resume re-registers through the normal path: the
+        # first re-prefilled sharer re-freezes the prefix and the rest
+        # RE-ATTACH via incref (sharing survives the drain — pinned by
+        # the drain-under-COW test).
+        self._flush_prefix_registry()
         drained: List[_Sequence] = []
         for slot, seq in enumerate(self._slots):
             if seq is None:
@@ -627,6 +740,16 @@ class Engine:
             if slot is None:
                 return
             need = self._pages_for(seq)
+            if not self.ec.contiguous and not self.allocator.can_reserve(
+                need
+            ):
+                # Shed prefix-cache pins before declaring backpressure:
+                # a cache must never block admission (its pages only
+                # free for real once no live table references them).
+                while self._prefix_registry and not (
+                    self.allocator.can_reserve(need)
+                ):
+                    self._evict_one_prefix()
             if not self.ec.contiguous and not self.allocator.reserve(need):
                 # Page pool too tight for the head-of-line request:
                 # admission WAITS until evictions free pages (FIFO — no
@@ -720,47 +843,268 @@ class Engine:
         self._seeds[slot] = 0
         self._dev_state = None
 
+    # --- prefix sharing (ISSUE 15) ----------------------------------------
+
+    def _try_attach_prefix(self, seq: _Sequence) -> None:
+        """Map a registered shared prefix's pages into this sequence's
+        table via incref and skip prefilling those positions. Only a
+        sequence that has not started (no pages, cursor 0) may attach;
+        the id is a hint — the registered TOKENS must match the
+        sequence's own context, or nothing is shared."""
+        pid = seq.req.prefix_id
+        if not pid or self.ec.contiguous:
+            return
+        entry = self._prefix_registry.get(pid)
+        if entry is None or entry.length > len(seq.context) - 1:
+            return
+        if not np.array_equal(seq.context[: entry.length], entry.tokens):
+            return
+        page = self.ec.page_size
+        for pg in entry.pages:
+            self.allocator.incref(pg)
+        seq.pages = list(entry.pages)
+        self._tables[seq.slot, : len(entry.pages)] = entry.pages
+        seq.prefill_cursor = entry.length
+        # The attached pages come off the worst-case reservation —
+        # minus one page of copy-on-write allowance when the prefix
+        # ends mid-page (the first divergent write forks that page).
+        release = len(entry.pages) - (1 if entry.length % page else 0)
+        release = min(release, seq.reserved_left)
+        if release > 0:
+            self.allocator.unreserve(release)
+            seq.reserved_left -= release
+        # LRU touch: re-insert at the tail.
+        self._prefix_registry[pid] = self._prefix_registry.pop(pid)
+        self.prefix_attached += 1
+        self._dev_state = None
+        self._inc("engine_prefix_attached_total")
+        self._track_shared()
+
+    def _maybe_register_prefix(self, seq: _Sequence) -> None:
+        """Register this sequence's prefix pages for future sharers
+        (called at prefill completion, when the pages exist). Full
+        pages are shared in place; a mid-page boundary is FROZEN into a
+        private copy holding exactly [0, p) with a zero tail, so the
+        registering sequence keeps growing its own boundary page
+        privately and every sharer forks from a clean page."""
+        from tpu_dra.workloads import paged_kv
+
+        pid = seq.req.prefix_id
+        plen = seq.req.prefix_len
+        if not pid or plen < 1 or self.ec.contiguous:
+            return
+        if pid in self._prefix_registry:
+            return
+        # Clamp inside the PROMPT (a drained sequence's context carries
+        # emitted tokens — the shared prefix is a prompt property) and
+        # so at least one context token remains to prefill: the first
+        # generated token needs the last context position's logits,
+        # which only a real prefill chunk produces.
+        p = min(plen, len(seq.req.prompt), len(seq.context) - 1)
+        if p < 1:
+            return
+        page = self.ec.page_size
+        n_full = p // page
+        partial = p % page
+        pages = list(seq.pages[:n_full])
+        if partial:
+            # The frozen boundary copy needs one page of UNRESERVED
+            # headroom — a cache never eats into admission guarantees.
+            if self.allocator.free_pages - self.allocator.reserved_pages < 1:
+                return
+            self._flush_zero()
+            frozen = self.allocator.alloc()
+            self.cache = paged_kv.copy_page_prefix(
+                self.cache, seq.pages[n_full], frozen, partial
+            )
+            pages.append(frozen)
+        for pg in pages[:n_full]:
+            self.allocator.incref(pg)
+        self._prefix_registry[pid] = _SharedPrefix(
+            pid, np.asarray(seq.context[:p], np.int32).copy(), p, pages
+        )
+        while len(self._prefix_registry) > max(
+            self.ec.prefix_cache_entries, 1
+        ):
+            self._evict_one_prefix(exclude=pid)
+        self._inc("engine_prefix_registered_total")
+        self._track_shared()
+
+    def _evict_one_prefix(self, exclude: Optional[str] = None) -> bool:
+        for key in self._prefix_registry:
+            if key != exclude:
+                entry = self._prefix_registry.pop(key)
+                for pg in entry.pages:
+                    if self.allocator.decref(pg):
+                        self._pending_zero.append(pg)
+                return True
+        return False
+
+    def _flush_prefix_registry(self) -> None:
+        while self._evict_one_prefix():
+            pass
+
+    def _cow_range(self, seq: _Sequence, lo: int, hi: int) -> None:
+        """Copy-on-write guard for a coming write to positions
+        [lo, hi): any already-mapped page in that range still shared
+        with another table (refcount > 1) is forked — full-page device
+        copy (values AND scales travel together), swap into this
+        sequence's table, drop the shared reference. The shared page's
+        other holders are untouched; it is never zeroed while they
+        hold it (decref cannot free it here)."""
+        if self.ec.contiguous:
+            return
+        from tpu_dra.workloads import paged_kv
+
+        page = self.ec.page_size
+        for j in range(lo // page, min(-(-hi // page), len(seq.pages))):
+            old = seq.pages[j]
+            if self.allocator.refcount(old) <= 1:
+                continue
+            self._flush_zero()
+            if seq.reserved_left > 0:
+                self.allocator.unreserve(1)
+                seq.reserved_left -= 1
+            new = self.allocator.alloc()
+            self.cache = paged_kv.copy_page(self.cache, old, new)
+            self.allocator.decref(old)  # shared: never frees/zeroes here
+            seq.pages[j] = new
+            self._tables[seq.slot, j] = new
+            self.cow_copies += 1
+            self._dev_state = None
+            self._inc("engine_cow_copies_total")
+
+    def _track_shared(self) -> int:
+        # The registry's own pins stand in for no allocation — a
+        # registered-but-never-shared prefix must report 0 saved, so
+        # its references are discounted from the sharing count.
+        pins: Dict[int, int] = {}
+        for entry in self._prefix_registry.values():
+            for pg in entry.pages:
+                pins[pg] = pins.get(pg, 0) + 1
+        saved = self.allocator.shared_extra(discount=pins)
+        if saved > self.prefix_saved_hw:
+            self.prefix_saved_hw = saved
+        return saved
+
     # --- prefill ----------------------------------------------------------
 
     def _prefill_tick(self, now: float) -> None:
+        """One batched-prefill iteration (ISSUE 15): chunks from up to
+        ``prefill_batch`` (0 = all) currently-prefilling sequences pack
+        into ONE padded bucket. The Sarathi stall bound is the bucket's
+        CHUNK LENGTH (still capped by prefill_chunk); its row count
+        rides the hardware's batch parallelism — so k waiting prompts
+        advance a chunk each for ~one chunk of decode stall, and TTFT
+        under admission bursts stops being serialized. The bucket's
+        batch dim is the ROW count padded to a power of two (capped at
+        max_slots), so a lone prompt pays ~its own cost, not
+        max_slots rows; idle pad rows carry valid=0 and write
+        nothing."""
         if not self._prefilling:
             return
         import jax.numpy as jnp
 
-        seq = self._prefilling[0]
-        slot = seq.slot
-        s = min(
-            self.ec.prefill_chunk, len(seq.context) - seq.prefill_cursor
+        limit = (
+            len(self._prefilling) if self.ec.prefill_batch == 0
+            else self.ec.prefill_batch
         )
-        # Pad the chunk to a power-of-two bucket (capped at the chunk
-        # budget): one trace/compile per bucket instead of one per
-        # distinct prompt length. Pad tokens write to scratch.
+        rows: List[_Sequence] = []
+        leading: set = set()
+        for seq in self._prefilling:
+            if len(rows) >= limit:
+                break
+            if seq.prefill_cursor == 0 and not seq.pages:
+                self._try_attach_prefix(seq)
+            pid = seq.req.prefix_id
+            unregistered = (
+                pid and seq.req.prefix_len > 0
+                and pid not in self._prefix_registry
+                and not self.ec.contiguous
+            )
+            if unregistered and pid in leading:
+                # Another row in THIS bucket will register this prefix
+                # when it completes; prefilling the same prefix
+                # privately in parallel would defeat the sharing — the
+                # follower waits a tick and attaches instead.
+                continue
+            if unregistered:
+                leading.add(pid)
+            rows.append(seq)
+        # PER-ROW chunk budget: the bucket's wall clock is set by its
+        # CHUNK LENGTH, not its row count (the batch dimension rides
+        # the hardware's parallelism — that is the whole win: k waiting
+        # prompts advance a chunk each for ~one chunk of decode stall,
+        # where the serial schedule advanced one). Splitting the budget
+        # across rows would keep the iteration count identical to
+        # serial and merely reorder who waits.
+        takes = [
+            min(
+                self.ec.prefill_chunk,
+                len(seq.context) - seq.prefill_cursor,
+            )
+            for seq in rows
+        ]
+        # Pad the bucket's chunk length to a power of two (capped at
+        # the budget): one trace/compile per bucket, pad tokens write
+        # to scratch.
         bucket = 1
-        while bucket < s:
+        while bucket < max(takes):
             bucket *= 2
         bucket = min(bucket, self.ec.prefill_chunk)
-        self._ensure_pages(seq, seq.prefill_cursor + s)
-        toks = np.zeros(bucket, np.int32)
-        toks[:s] = seq.context[seq.prefill_cursor:seq.prefill_cursor + s]
+        # The ROW count is bucketed to a power of two as well (capped
+        # at max_slots): a lone arriving prompt must not pay
+        # max_slots x its own FLOPs through every layer for idle
+        # scratch rows. Trace-cache growth stays bounded at
+        # #chunk-buckets x #row-buckets; idle rows carry valid=0.
+        B = 1
+        while B < len(rows):
+            B *= 2
+        B = min(B, self.ec.max_slots)
+        tokens = np.zeros((B, bucket), np.int32)
+        starts = np.zeros((B,), np.int32)
+        valids = np.zeros((B,), np.int32)
+        trows = np.zeros((B,) + self._tables.shape[1:],
+                         self._tables.dtype)
+        for i, (seq, take) in enumerate(zip(rows, takes)):
+            self._cow_range(
+                seq, seq.prefill_cursor, seq.prefill_cursor + take
+            )
+            self._ensure_pages(seq, seq.prefill_cursor + take)
+            tokens[i, :take] = seq.context[
+                seq.prefill_cursor: seq.prefill_cursor + take
+            ]
+            starts[i] = seq.prefill_cursor
+            valids[i] = take
+            trows[i] = self._tables[seq.slot]
         self.cache, logits = self._prefill_chunk_fn(
             self.params, self.cache,
-            jnp.asarray(self._tables[slot]),
-            jnp.int32(seq.prefill_cursor), jnp.asarray(toks),
-            jnp.int32(s),
+            jnp.asarray(trows),
+            jnp.asarray(starts), jnp.asarray(tokens),
+            jnp.asarray(valids),
         )
-        seq.prefill_cursor += s
+        logits_h = None
+        finished: List[_Sequence] = []
+        for i, (seq, take) in enumerate(zip(rows, takes)):
+            slot = seq.slot
+            seq.prefill_cursor += take
+            self._inc("engine_prefill_tokens_total", take)
+            if seq.prefill_cursor == len(seq.context):
+                finished.append(seq)
+                seq.prefill_done = True
+                self._maybe_register_prefix(seq)
+                if logits_h is None:
+                    logits_h = np.asarray(logits)
+                first = self._pick_first(seq, logits_h[i])
+                self._record_tokens(seq, [first])
+                if seq.slot is not None:  # not finished by that token
+                    self._lengths[slot] = len(seq.context)
+                    self._last_tokens[slot] = first
+                    self._active[slot] = True
+        for seq in finished:
+            self._prefilling.remove(seq)
         self._progress += 1
         self._dev_state = None
-        self._inc("engine_prefill_tokens_total", s)
-        if seq.prefill_cursor == len(seq.context):
-            self._prefilling.popleft()
-            seq.prefill_done = True
-            first = self._pick_first(seq, logits)
-            self._record_tokens(seq, [first])
-            if seq.slot is not None:  # not finished by that one token
-                self._lengths[slot] = len(seq.context)
-                self._last_tokens[slot] = first
-                self._active[slot] = True
 
     def _pick_first(self, seq: _Sequence, logits) -> int:
         """First generated token from the prefill logits: argmax, or —
@@ -807,6 +1151,8 @@ class Engine:
     def _decode_tick(self, now: float) -> None:
         if not self._active.any():
             return
+        if self.ec.spec_k > 0:
+            return self._spec_tick(now)
         import jax.numpy as jnp
 
         steps = self.ec.scan_chunk
@@ -862,6 +1208,141 @@ class Engine:
         ]
         for slot, seq in active_slots:
             self._record_tokens(seq, out[:, slot].tolist())
+
+    # --- speculative decode (ISSUE 15) -------------------------------------
+
+    def _spec_tick(self, now: float) -> None:
+        """One speculative iteration: the DraftSource proposes up to
+        spec_k tokens per active sequence (host-side, from the
+        sequence's own history), their K/V is written into the paged
+        cache, and ONE jitted verify pass evaluates all spec_k + 1
+        positions — each position's pick replays the exact
+        (seed, serial, position) schedule, so the accepted run plus the
+        first correction token is byte-what the per-token path would
+        have emitted. Rejected positions rewind host-side."""
+        import jax.numpy as jnp
+
+        K = self.ec.spec_k
+        B = self.ec.max_slots
+        drafts = np.zeros((B, K), np.int32)
+        counts = np.zeros((B,), np.int32)
+        for slot, seq in enumerate(self._slots):
+            if seq is None or not self._active[slot]:
+                continue
+            cap = min(K, seq.remaining - 1)
+            if cap > 0 and self._draft is not None:
+                history = np.concatenate([
+                    np.asarray(seq.req.prompt, np.int32),
+                    np.asarray(seq.out, np.int32),
+                ])
+                d = np.asarray(
+                    self._draft.propose(history, cap), np.int32
+                ).ravel()[:cap]
+                # In-vocab guard: a proposer echoing out-of-range ids
+                # would index the embedding out of bounds; truncate at
+                # the first bad token (later ones depend on it anyway).
+                bad = np.flatnonzero(
+                    (d < 0) | (d >= self.config.vocab_size)
+                )
+                if bad.size:
+                    d = d[: int(bad[0])]
+                drafts[slot, : len(d)] = d
+                counts[slot] = len(d)
+            L = int(self._lengths[slot])
+            upto = L + int(counts[slot]) + 1
+            self._cow_range(seq, L, upto)
+            self._ensure_pages(seq, upto)
+        if self._dev_state is None:
+            self._dev_state = (
+                self._put_row(self._tables),
+                self._put_row(self._lengths),
+                self._put_row(self._last_tokens),
+                self._put_row(self._active),
+                self._put_row(self._seeds),
+                jnp.asarray(self._seed_scalar),
+            )
+        tables_d, lengths_d, last_d, active_d, seeds_d, seed_d = (
+            self._dev_state
+        )
+        self.cache, new_len, new_last, n_acc, picked = (
+            self._verify_chunk_fn(
+                self.params, self.cache, tables_d, lengths_d, last_d,
+                jnp.asarray(drafts), jnp.asarray(counts), active_d,
+                seeds_d, seed_d,
+            )
+        )
+        # Verified lengths/last tokens ARE next iteration's inputs:
+        # keep them device-resident like the fused chunk does.
+        self._dev_state = (
+            tables_d, new_len, new_last, active_d, seeds_d, seed_d
+        )
+        n_acc_h = np.asarray(n_acc)
+        picked_h = np.asarray(picked)
+        prev_len = self._lengths.copy()
+        self._lengths = np.array(new_len)
+        self._last_tokens = np.array(new_last)
+        active_slots = [
+            (slot, seq) for slot, seq in enumerate(self._slots)
+            if seq is not None and self._active[slot]
+        ]
+        for slot, seq in active_slots:
+            na = int(n_acc_h[slot])
+            npp = int(counts[slot])
+            self.spec_proposed += npp
+            self.spec_accepted += na
+            if npp:
+                self._inc("engine_spec_proposed_total", npp)
+            if na:
+                self._inc("engine_spec_accepted_total", na)
+            valid = int(prev_len[slot]) + na + 1
+            written = int(prev_len[slot]) + npp + 1
+            self._record_tokens(seq, picked_h[slot, : na + 1].tolist())
+            if seq.slot is not None and written > valid:
+                self._rewind(seq, valid, written)
+
+    def _rewind(self, seq: _Sequence, valid_len: int,
+                written_len: int) -> None:
+        """Host-side speculative rewind: the verify pass wrote K/V at
+        positions [valid_len, written_len) that the acceptance rule
+        rejected. Pages wholly past the accepted extent roll out of the
+        block table and free (the batch zero path re-establishes their
+        invariant before reuse, and they re-enter the sequence's
+        worst-case reservation); the kept boundary page's rejected tail
+        is re-zeroed in place."""
+        from tpu_dra.workloads import paged_kv
+
+        page = self.ec.page_size
+        keep = -(-valid_len // page)
+        dropped = seq.pages[keep:]
+        if dropped:
+            seq.pages = seq.pages[:keep]
+            if self.ec.contiguous:
+                self._pending_zero.extend(dropped)
+            else:
+                for pg in dropped:
+                    if self.allocator.decref(pg):
+                        self._pending_zero.append(pg)
+                # Infallible BY CONSTRUCTION: every dropped page was
+                # private (the verify pass only writes COW-forked
+                # pages) and was just freed above, so the headroom
+                # exists. Failing silently here would let a later
+                # _alloc_page steal another admitted sequence's
+                # reserved headroom — make any regression loud.
+                if not self.allocator.reserve(len(dropped)):
+                    raise RuntimeError(
+                        f"rewind of {seq.req.rid} could not restore "
+                        f"{len(dropped)} reserved pages — a dropped "
+                        f"page was not freed (shared page in the "
+                        f"rejected extent?)"
+                    )
+                seq.reserved_left += len(dropped)
+            self._tables[seq.slot, keep:] = paged_kv.SCRATCH_PAGE
+            self._dev_state = None
+        off = valid_len % page
+        if off and written_len > valid_len:
+            self.cache = paged_kv.zero_page_tail(
+                self.cache, seq.pages[keep - 1], off
+            )
 
     def _record_tokens(self, seq: _Sequence, toks) -> None:
         # Clock read HERE, after the chunk's host sync (np.asarray /
@@ -940,6 +1421,11 @@ class Engine:
         m.set_gauge(
             "engine_admission_blocked_on_pages",
             1.0 if self._blocked_on_pages else 0.0,
+        )
+        # Live prefix sharing: how many page allocations incref'd
+        # tables are currently standing in for (0 when nothing shares).
+        m.set_gauge(
+            "engine_prefix_shared_pages", float(self._track_shared())
         )
         delta = self.allocator.exhausted - self._exhausted_exported
         if delta:
@@ -1065,52 +1551,78 @@ def _decode_chunk(
     return cache, lengths, toks, out  # out: [steps, B]
 
 
-def _prefill_chunk(c, quant, params, cache, table_row, pos, tokens, valid):
-    """One chunk of ONE sequence's prefill: write the chunk's K/V into
-    its pages (quantizing in flight), attend causally over everything
-    written so far via the block table, and return the logits of the
-    last VALID position (only the final chunk's are consumed — they
-    pick the first generated token).
-
-    ``tokens`` is padded to a power-of-two bucket (bounded trace-cache
-    growth: one compile per bucket, not one per distinct prompt length)
-    and ``valid`` is the traced count of real tokens: pad positions
-    write to the scratch page and their outputs are never read (each
-    query's output depends only on its own q row and the written keys,
-    so pad rows cannot pollute valid rows)."""
+def _prefill_batch(c, quant, params, cache, tables, starts, tokens, valids):
+    """One BATCHED prefill bucket (ISSUE 15): chunks from several
+    sequences — one row per participating sequence, gathered by the
+    host — written and attended in a single pass. tables: [B,
+    max_pages]; starts/valids: [B] (valid 0 = idle pad row); tokens:
+    [B, s]; both s and B are padded to power-of-two buckets (bounded
+    trace-cache growth, and a sparse bucket pays ~its own row count,
+    not max_slots). Pad positions and idle rows write to the
+    scratch page and their outputs are never read: each query's output
+    depends only on its own q row and its own table's written keys, so
+    rows cannot pollute each other — per-row math is the same
+    write-then-attend chunk the one-sequence path ran, which is what
+    keeps batched prefill inside the engine's token-parity contract.
+    Returns the cache and the last-VALID-position logits per row
+    ([B, vocab]; only rows finishing their prefill consume them)."""
     import jax.numpy as jnp
-    from jax import lax
 
+    from tpu_dra.workloads.generate import _mm
+    from tpu_dra.workloads.paged_kv import SCRATCH_PAGE
+
+    B, s = tokens.shape
+    page = cache.page_size
+    positions = starts[:, None] + jnp.arange(s)[None]  # [B, s]
+    in_valid = jnp.arange(s)[None] < valids[:, None]  # [B, s]
+    safe_rows = jnp.minimum(positions // page, tables.shape[1] - 1)
+    pids = jnp.where(
+        in_valid, jnp.take_along_axis(tables, safe_rows, axis=1),
+        SCRATCH_PAGE,
+    )
+    offs = jnp.where(in_valid, positions % page, 0)
+    new_cache, x = _write_then_attend(
+        c, quant, params, cache, tables, pids, offs, starts, tokens,
+        positions,
+    )
+    # Last valid position per row (idle rows index position 0 — their
+    # logits are never read).
+    last_idx = jnp.maximum(valids - 1, 0)[:, None, None]
+    x_last = jnp.take_along_axis(x, last_idx, axis=1)  # [B, 1, d]
+    logits = _mm(x_last, params["lm_head"]).astype(jnp.float32)[:, 0]
+    return new_cache, logits
+
+
+def _write_then_attend(c, quant, params, cache, tables, pids, offs,
+                       pos_q, toks, positions):
+    """The shared write-then-attend body of :func:`_prefill_batch` and
+    :func:`_verify_chunk`: embed ``toks`` [B, S], write every
+    position's K/V (quantizing in flight) through the caller's
+    (pids, offs) scatter, attend all S positions causally via
+    paged_multiquery_attention with per-row chunk starts ``pos_q``,
+    and return the updated cache plus the final-norm hidden states.
+    ONE implementation — a change to the scatter/quantize/attend path
+    cannot split the spec-vs-prefill token-parity contract."""
     from tpu_dra.workloads.generate import (
         _finish_block,
-        _mm,
         _project_qkv,
         _rms,
     )
     from tpu_dra.workloads.models.llama import rope_frequencies
-    from tpu_dra.workloads.paged_kv import SCRATCH_PAGE, PagedKVCache
-    from tpu_dra.workloads.ops.attention import paged_prefill_attention
+    from tpu_dra.workloads.paged_kv import PagedKVCache
+    from tpu_dra.workloads.ops.attention import paged_multiquery_attention
     from tpu_dra.workloads.quantize import quantize_kv
 
-    s = tokens.shape[0]
-    page = cache.page_size
-    x = params["embed"]["embedding"].astype(c.dtype)[tokens][None]
-    positions = pos + jnp.arange(s)
-    cos, sin = rope_frequencies(c, positions)  # [s, hd/2]
-    in_valid = jnp.arange(s) < valid
-    safe_rows = jnp.minimum(positions // page, table_row.shape[0] - 1)
-    pids = jnp.where(
-        in_valid, jnp.take(table_row, safe_rows), SCRATCH_PAGE
-    )
-    offs = jnp.where(in_valid, positions % page, 0)
-
+    B, S = toks.shape
+    x = params["embed"]["embedding"].astype(c.dtype)[toks]  # [B, S, d]
+    cos, sin = rope_frequencies(c, positions)  # [B, S, hd/2]
     k_pools, v_pools = list(cache.k), list(cache.v)
     ks_pools = list(cache.k_scale) if quant else [None] * c.n_layers
     vs_pools = list(cache.v_scale) if quant else [None] * c.n_layers
     for layer in range(c.n_layers):
         lp = params[f"layer_{layer}"]
-        q, k, v = _project_qkv(c, lp, x, cos, sin, 1, s)
-        k1, v1 = k[0], v[0]  # [s, kvh, hd]
+        q, k, v = _project_qkv(c, lp, x, cos, sin, B, S)
+        k1, v1 = k, v  # [B, S, kvh, hd]
         if quant:
             k1, ksc = quantize_kv(k1)
             v1, vsc = quantize_kv(v1)
@@ -1118,17 +1630,95 @@ def _prefill_chunk(c, quant, params, cache, table_row, pos, tokens, valid):
             vs_pools[layer] = vs_pools[layer].at[pids, offs].set(vsc)
         k_pools[layer] = k_pools[layer].at[pids, offs].set(k1)
         v_pools[layer] = v_pools[layer].at[pids, offs].set(v1)
-        out = paged_prefill_attention(
-            q[0], k_pools[layer], v_pools[layer], table_row, pos,
+        out = paged_multiquery_attention(
+            q, k_pools[layer], v_pools[layer], tables, pos_q,
             k_scale=ks_pools[layer], v_scale=vs_pools[layer],
-        )[None].astype(c.dtype)
-        x = _finish_block(c, lp, x, out, 1, s)
+        ).astype(c.dtype)
+        x = _finish_block(c, lp, x, out, B, S)
     x = _rms(x, params["final_norm"]["scale"], c.norm_eps)
-    x_last = lax.dynamic_slice_in_dim(x, valid - 1, 1, axis=1)
-    logits = _mm(x_last, params["lm_head"]).astype(jnp.float32)[0, 0]
     new_cache = PagedKVCache(
         k=tuple(k_pools), v=tuple(v_pools),
         k_scale=tuple(ks_pools) if quant else None,
         v_scale=tuple(vs_pools) if quant else None,
     )
-    return new_cache, logits
+    return new_cache, x
+
+
+def _verify_chunk(c, quant, sampling, params, cache, tables, lengths,
+                  tokens, drafts, draft_count, active, seeds, sample_seed):
+    """The speculative verify pass (ISSUE 15): ONE jitted evaluation of
+    K+1 positions per sequence against the paged cache.
+
+    tokens: [B] — each sequence's real last token (not yet written);
+    drafts: [B, K] draft guesses (pad past draft_count); the pass
+    writes K/V for [token, d_0, ..., d_{K-1}] at positions
+    [L, L+K] (masked rows/pads go to scratch), attends all positions
+    causally through the block tables in one paged_multiquery_attention
+    call, and picks every position's next token with the exact
+    (seed, serial, position) schedule. Acceptance is computed ON
+    DEVICE: n_acc = longest prefix where pick[i] == draft[i], the
+    emitted run is pick[0..n_acc] (accepted guesses + the first
+    correction), new lengths/last tokens return as device arrays so a
+    steady verify stretch re-uploads nothing. Exactness: pick[i] only
+    depends on K/V at positions <= L+i, which hold REAL tokens
+    whenever i <= n_acc — so the accepted run is byte-identical to
+    what the unfused per-token oracle emits, greedy or sampled, no
+    matter what the proposer guessed."""
+    import jax.numpy as jnp
+
+    from tpu_dra.workloads.generate import _mm
+    from tpu_dra.workloads.paged_kv import SCRATCH_PAGE
+
+    B, K = drafts.shape
+    S = K + 1
+    page = cache.page_size
+    toks = jnp.concatenate([tokens[:, None], drafts], axis=1)  # [B, S]
+    positions = lengths[:, None] + jnp.arange(S)[None]  # [B, S]
+    write_ok = active[:, None] & (
+        jnp.arange(S)[None] < (draft_count + 1)[:, None]
+    )
+    safe_rows = jnp.minimum(positions // page, tables.shape[1] - 1)
+    pids = jnp.where(
+        write_ok, jnp.take_along_axis(tables, safe_rows, axis=1),
+        SCRATCH_PAGE,
+    )
+    offs = jnp.where(write_ok, positions % page, 0)
+    pos_q = jnp.where(active, lengths, 0)
+    new_cache, x = _write_then_attend(
+        c, quant, params, cache, tables, pids, offs, pos_q, toks,
+        positions,
+    )
+    logits = _mm(x, params["lm_head"]).astype(jnp.float32)  # [B, S, V]
+    picked = _pick_tokens_batched(
+        sampling, logits, seeds, positions + 1, tokens.dtype, sample_seed
+    )  # [B, S]
+    match = (picked[:, :K] == drafts) & (
+        jnp.arange(K)[None] < draft_count[:, None]
+    )
+    n_acc = jnp.sum(
+        jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1
+    )  # [B]
+    new_len = jnp.where(active, lengths + 1 + n_acc, lengths)
+    new_last = jnp.where(
+        active,
+        jnp.take_along_axis(picked, n_acc[:, None], axis=1)[:, 0],
+        tokens,
+    )
+    return new_cache, new_len, new_last, n_acc, picked
+
+
+def _pick_tokens_batched(sampling, logits, seeds, positions, dtype,
+                         sample_seed):
+    """:func:`_pick_tokens` over [B, S] positions at once — the verify
+    pass's picks, vmapped over the position axis so the single-step
+    path's fold(fold(seed_key, serial), position) schedule has exactly
+    ONE definition and every position's pick is byte-identical to the
+    per-token oracle's."""
+    import jax
+
+    def per_pos(lg, pos):  # lg: [B, V], pos: [B]
+        return _pick_tokens(sampling, lg, seeds, pos, dtype, sample_seed)
+
+    return jax.vmap(per_pos, in_axes=(1, 1), out_axes=1)(
+        logits, positions
+    )

@@ -1402,3 +1402,193 @@ def paged_prefill_attention(
     return (
         out.transpose(2, 0, 1, 3).reshape(s_len, h, hd).astype(q.dtype)
     )
+
+
+# --- multi-query (batched suffix) attention: verify step + batched prefill ---
+#
+# Speculative verification (ISSUE 15) evaluates K+1 query positions per
+# SEQUENCE in one pass — the draft tokens' K/V are already written into
+# the sequences' pages (write-then-attend, like chunked prefill), and
+# query i of sequence b sits at absolute position pos[b] + i, attending
+# causally over everything at or before it. Batched chunked prefill is
+# the SAME computation with per-sequence chunk starts: both ride
+# paged_multiquery_attention, so one op (and one parity contract)
+# covers the verify step and the multi-sequence prefill bucket.
+#
+# The block walk is the per-sequence-table gather of
+# _xla_paged_decode_attention extended to s queries: fully-masked
+# blocks contribute exactly zero to (m, l, acc) and the per-query math
+# is the SAME online-softmax update as paged_prefill_attention — so a
+# single row of the batch is bit-comparable to the single-sequence
+# chunk op, which is what the engine's spec-vs-oracle token-identity
+# contract rests on.
+
+_LAST_MULTIQUERY_IMPL = None  # set at trace time; specbench asserts on it
+
+
+def reference_paged_multiquery_attention(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    tables: jnp.ndarray,
+    pos: jnp.ndarray,
+    k_scale=None,
+    v_scale=None,
+) -> jnp.ndarray:
+    """Naive fp32 oracle: materialize every sequence's cache through
+    its table and run a masked softmax per query. q: [b, s, h, hd];
+    tables: [b, max_pages]; pos: [b] — query i of sequence b is at
+    absolute position pos[b] + i and sees keys at positions <= its own.
+    Tests only."""
+    b, s, h, hd = q.shape
+    page, kvh = k_pages.shape[1], k_pages.shape[2]
+    n_rep = h // kvh
+    max_pages = tables.shape[1]
+    skv = max_pages * page
+
+    def flat(pool):
+        g = jnp.take(pool, tables, axis=0)
+        return g.reshape((b, skv) + pool.shape[2:])
+
+    kf = flat(k_pages).astype(jnp.float32)
+    vf = flat(v_pages).astype(jnp.float32)
+    qg = q.reshape(b, s, kvh, n_rep, hd).astype(jnp.float32)
+    logits = jnp.einsum("bshrd,bkhd->bhrsk", qg, kf) * (hd ** -0.5)
+    if k_scale is not None:
+        logits = logits * flat(k_scale).transpose(0, 2, 1)[:, :, None, None, :]
+    q_abs = pos[:, None] + jnp.arange(s)[None]  # [b, s]
+    mask = (
+        jnp.arange(skv)[None, None, None, None, :]
+        <= q_abs[:, None, None, :, None]
+    )
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    probs = jnp.where(mask, probs, 0.0)
+    if v_scale is not None:
+        probs = probs * flat(v_scale).transpose(0, 2, 1)[:, :, None, None, :]
+    out = jnp.einsum("bhrsk,bkhd->bhrsd", probs, vf)
+    return (
+        out.transpose(0, 3, 1, 2, 4).reshape(b, s, h, hd).astype(q.dtype)
+    )
+
+
+def _xla_paged_multiquery_attention(
+    q, k_pages, v_pages, tables, pos, k_scale, v_scale
+):
+    """Length-aware block-table walk over s queries per sequence: the
+    same dynamic-trip-count gather loop as _xla_paged_decode_attention,
+    carrying fp32 (m, l, acc) per query. The trip count stops at the
+    last page any sequence's final query can see; a sequence whose own
+    frontier is earlier sees its later blocks fully masked — an exact
+    zero contribution, so each row is independent of its batchmates."""
+    b, s, h, hd = q.shape
+    page, kvh = k_pages.shape[1], k_pages.shape[2]
+    n_rep = h // kvh
+    scale = hd ** -0.5
+    qg = q.reshape(b, s, kvh, n_rep, hd)
+    q_abs = pos[:, None] + jnp.arange(s)[None]  # [b, s]
+    num_blocks = lax.div(jnp.max(pos) + s + (page - 1), page)
+
+    m0 = jnp.full((b, kvh, n_rep, s), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, kvh, n_rep, s), jnp.float32)
+    acc0 = jnp.zeros((b, kvh, n_rep, s, hd), jnp.float32)
+
+    def body(i, carry):
+        m, l, acc = carry
+        pids = jnp.take(tables, i, axis=1)  # [b]
+        kb = jnp.take(k_pages, pids, axis=0)  # [b, page, kvh, hd]
+        vb = jnp.take(v_pages, pids, axis=0)
+        sc = jnp.einsum(
+            "bshrd,bkhd->bhrsk", qg, kb.astype(qg.dtype),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if k_scale is not None:
+            ksb = jnp.take(k_scale, pids, axis=0)  # [b, page, kvh]
+            sc = sc * ksb.transpose(0, 2, 1)[:, :, None, None, :]
+        cols = i * page + jnp.arange(page)
+        mask = cols[None, None, None, None, :] <= q_abs[:, None, None, :, None]
+        sc = jnp.where(mask, sc, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(sc, axis=-1))
+        p = jnp.exp(sc - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        if v_scale is not None:
+            vsb = jnp.take(v_scale, pids, axis=0)
+            p = p * vsb.transpose(0, 2, 1)[:, :, None, None, :]
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhrsk,bkhd->bhrsd", p.astype(qg.dtype), vb.astype(qg.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = lax.fori_loop(0, num_blocks, body, (m0, l0, acc0))
+    # Every query admits at least the key at its own position (the
+    # causal mask includes q_abs, which num_blocks always covers), so l
+    # is strictly positive and no dead-row zeroing is needed.
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return (
+        out.transpose(0, 3, 1, 2, 4).reshape(b, s, h, hd).astype(q.dtype)
+    )
+
+
+def paged_multiquery_attention(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    tables: jnp.ndarray,
+    pos: jnp.ndarray,
+    k_scale=None,
+    v_scale=None,
+    impl: str = "auto",
+) -> jnp.ndarray:
+    """Causal multi-query GQA attention over a paged KV pool, batched
+    over sequences with PER-SEQUENCE chunk starts.
+
+    q: [b, s, h, hd] — s queries per sequence; query i of sequence b is
+    at absolute position pos[b] + i (its K/V, like the whole chunk's,
+    is already written: write-then-attend);
+    k_pages/v_pages: the shared pools (model dtype, or int8 with
+    [num_pages, page_size, kvh] scale pools);
+    tables: [b, max_pages_per_seq] int32 block tables;
+    pos: [b] int32 traced — the chunk's first absolute position per
+    sequence.
+
+    Serves BOTH the speculative verify step (pos = current lengths,
+    s = spec_k + 1) and the batched-prefill bucket (pos = per-sequence
+    prefill cursors). impl: "auto" | "xla" | "reference" — per-row math
+    is the same online-softmax block walk as paged_prefill_attention,
+    with appended fully-masked blocks contributing exactly zero.
+    """
+    b, s, h, hd = q.shape
+    if k_pages.shape != v_pages.shape or k_pages.shape[3] != hd:
+        raise ValueError(
+            f"paged cache shape mismatch: q {q.shape} vs k_pages "
+            f"{k_pages.shape} v_pages {v_pages.shape}"
+        )
+    kvh = k_pages.shape[2]
+    if h % kvh:
+        raise ValueError(
+            f"query heads ({h}) must be a multiple of kv heads ({kvh})"
+        )
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be provided together")
+    if tables.shape[0] != b or pos.shape != (b,):
+        raise ValueError(
+            f"tables {tables.shape} / pos {pos.shape} do not match "
+            f"batch {b}"
+        )
+    if impl == "auto":
+        impl = "xla"
+    global _LAST_MULTIQUERY_IMPL
+    _LAST_MULTIQUERY_IMPL = impl
+    if impl == "xla":
+        return _xla_paged_multiquery_attention(
+            q, k_pages, v_pages, tables, pos, k_scale, v_scale
+        )
+    if impl == "reference":
+        return reference_paged_multiquery_attention(
+            q, k_pages, v_pages, tables, pos, k_scale, v_scale
+        )
+    raise ValueError(
+        f"unknown paged multiquery attention impl: {impl!r}"
+    )

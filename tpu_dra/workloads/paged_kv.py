@@ -77,7 +77,12 @@ class PageAllocator:
         # reused first, keeping the touched working set small.
         self._free = list(range(num_pages - 1, SCRATCH_PAGE, -1))
         self._ref = [0] * num_pages
+        # Pages with refcount > 1, maintained incrementally so
+        # shared_extra() costs O(#shared pages), not O(num_pages) —
+        # it runs inside the engine's per-step metrics export.
+        self._multi: set = set()
         self._reserved = 0
+        self._min_free = len(self._free)
         # Lifetime count of alloc() calls that found the list empty —
         # exported by the engine as engine_page_exhausted_total.
         self.exhausted = 0
@@ -120,12 +125,15 @@ class PageAllocator:
             )
         page = self._free.pop()
         self._ref[page] = 1
+        if len(self._free) < self._min_free:
+            self._min_free = len(self._free)
         return page
 
     def incref(self, page: int) -> None:
         if self._ref[page] < 1:
             raise ValueError(f"incref of unallocated page {page}")
         self._ref[page] += 1
+        self._multi.add(page)
 
     def decref(self, page: int) -> bool:
         """Drop one reference; True when the page was freed (refcount hit
@@ -135,6 +143,8 @@ class PageAllocator:
         if self._ref[page] < 1:
             raise ValueError(f"decref of unallocated page {page}")
         self._ref[page] -= 1
+        if self._ref[page] <= 1:
+            self._multi.discard(page)
         if self._ref[page] == 0:
             self._free.append(page)
             return True
@@ -142,6 +152,35 @@ class PageAllocator:
 
     def refcount(self, page: int) -> int:
         return self._ref[page]
+
+    def shared_extra(self, discount=None) -> int:
+        """Total extra references across all pages — how many page
+        allocations prefix sharing is currently avoiding (a page with
+        refcount r stands in for r separately-allocated copies, saving
+        r - 1). ``discount`` maps page -> references held by a cache or
+        registry rather than by a sequence: those stand in for no
+        allocation (a registered-but-never-shared prefix saves
+        nothing), so savings count only the effective refcount
+        ``r - discount``. Exported by the engine as
+        ``engine_prefix_shared_pages``. O(#shared pages): the scan
+        covers only the incrementally-maintained refcount>1 set, so
+        the per-step metrics export is free while sharing is idle."""
+        total = 0
+        for page in self._multi:
+            eff = self._ref[page] - (
+                discount.get(page, 0) if discount else 0
+            )
+            if eff > 1:
+                total += eff - 1
+        return total
+
+    @property
+    def min_free(self) -> int:
+        """Low-water mark of the free list — ``num_pages - 1 -
+        min_free`` is the peak number of pages simultaneously allocated
+        over the allocator's lifetime (the honest memory number the
+        prefix-sharing bench compares against its unshared twin)."""
+        return self._min_free
 
 
 @jax.tree_util.register_pytree_node_class
@@ -236,6 +275,58 @@ def zero_pages(cache: PagedKVCache, page_ids) -> PagedKVCache:
     for name, pool in cache._pools():
         out[name] = tuple(p.at[ids].set(0) for p in pool)
     return PagedKVCache(**out)
+
+
+def copy_page(cache: PagedKVCache, src: int, dst: int) -> PagedKVCache:
+    """Copy one page's content (values AND scales, every layer) from
+    ``src`` to ``dst`` — the copy-on-write fork: a sequence about to
+    write into a page another table still references copies it first
+    and writes into its private copy. The int8 scale pools travel with
+    their pages, so a forked int8 sequence dequantizes identically to
+    its parent. Host-side (runs between engine chunks)."""
+    si = jnp.int32(src)
+    di = jnp.int32(dst)
+    out = {}
+    for name, pool in cache._pools():
+        out[name] = tuple(p.at[di].set(p[si]) for p in pool)
+    return PagedKVCache(**out)
+
+
+def copy_page_prefix(
+    cache: PagedKVCache, src: int, dst: int, upto
+) -> PagedKVCache:
+    """Copy positions ``[0, upto)`` of page ``src`` into ``dst`` and
+    ZERO the rest of ``dst`` — the frozen-prefix fork: a registered
+    prefix ending mid-page freezes exactly the shared positions, so
+    every later sharer sees a page whose tail honors the zero-tail
+    invariant regardless of what the registering sequence wrote past
+    the prefix. ``upto`` may be traced (one compiled scatter per pool
+    shape, not per offset)."""
+    si = jnp.int32(src)
+    di = jnp.int32(dst)
+    page = cache.page_size
+    keep = jnp.arange(page) < upto  # [page]
+    out = {}
+    for name, pool in cache._pools():
+        newpool = []
+        for p in pool:
+            mask = keep.reshape((page,) + (1,) * (p.ndim - 2))
+            newpool.append(
+                p.at[di].set(jnp.where(mask, p[si], 0).astype(p.dtype))
+            )
+        out[name] = tuple(newpool)
+    return PagedKVCache(**out)
+
+
+def zero_page_tail(cache: PagedKVCache, page_id: int, start) -> PagedKVCache:
+    """Zero positions ``[start, page_size)`` of one page in every pool
+    — the speculative-rewind half of the zero-tail invariant: rejected
+    draft K/V written past the accepted length is wiped from the kept
+    boundary page (pages wholly past it are freed and re-zeroed through
+    the normal batch path). ``start`` may be traced. Exactly the
+    frozen-prefix fork with src == dst, so the masked scatter lives in
+    one place."""
+    return copy_page_prefix(cache, page_id, page_id, start)
 
 
 def tail_is_zero(cache: PagedKVCache, pages, length: int) -> bool:

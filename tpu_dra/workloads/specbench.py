@@ -1,0 +1,241 @@
+"""Speculative-decoding + prefix-sharing + batched-prefill CPU smoke —
+``make specbench`` (wired into ``ci``), the hardware-free gate on the
+ISSUE 15 serving-engine optimizations.
+
+Hard contract asserts (exit nonzero on any violation — the same shape
+as every other bench smoke, so CI sees a regression before a TPU run
+does):
+
+1. **spec == oracle token identity, greedy AND sampled**: the
+   speculative engine (n-gram draft + one jitted K+1-position verify
+   per iteration) must be TOKEN-IDENTICAL to the unfused per-token /
+   contiguous-page oracle on a lookup-friendly trace (real acceptance)
+   AND on a rejection-heavy random trace (the rewind path under fire)
+   AND with an adversarial always-wrong draft source — a proposer can
+   only affect speed, never tokens;
+2. **rewind hygiene**: after a rejection-heavy run, the page allocator
+   is leak-free and every non-scratch page is fully zeroed (rejected
+   draft K/V was rewound: boundary tails re-zeroed in place, dropped
+   pages through the batch zero path);
+3. **COW prompt fleet**: N sequences sharing one system prompt
+   (prefix_id + incref + copy-on-write) allocate a fraction of the
+   private fleet's peak pages — the saving is asserted against the
+   shared prefix's page count, with token identity and zero leaks
+   checked inside the fleet helper;
+4. **batched prefill beats serial TTFT**: the same admission burst
+   through the bucket-packing schedule must cut first-token p50 vs the
+   one-sequence-per-iteration schedule.
+
+The timed spec-vs-nonspec throughput gate lives in ``bench.py
+--leg-serve`` (hard on TPU, warning on CPU drill sizes /
+``BENCH_ALLOW_SERVE_GAP=1`` — per-chunk host dispatch swamps the tiny
+CPU matmuls, so only on-chip ratios mean anything).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import numpy as np
+
+
+def _model():
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dra.workloads.models.llama import TINY_LLAMA, Llama
+
+    cfg = dataclasses.replace(
+        TINY_LLAMA, dtype=jnp.float32, param_dtype=jnp.float32
+    )
+    model = Llama(cfg)
+    params = model.init_params(jax.random.PRNGKey(7), batch=2, seq=8)
+    return cfg, params
+
+
+def _lookup_reqs(cfg, n=5, seed=3, max_new=20):
+    """Repetitive prompts: the n-gram proposer has structure to hit."""
+    from tpu_dra.workloads.engine import Request
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        motif = rng.integers(1, cfg.vocab_size, 6).astype(np.int32)
+        out.append(
+            Request(
+                rid=f"l{i}", prompt=np.tile(motif, 4)[:22],
+                max_new_tokens=max_new,
+            )
+        )
+    return out
+
+
+def _random_reqs(cfg, n=6, seed=11):
+    """Structureless prompts: near-zero acceptance — every verify pass
+    exercises the rewind."""
+    from tpu_dra.workloads.engine import Request
+
+    rng = np.random.default_rng(seed)
+    return [
+        Request(
+            rid=f"r{i}",
+            prompt=rng.integers(
+                1, cfg.vocab_size, int(rng.integers(4, 15))
+            ).astype(np.int32),
+            max_new_tokens=int(rng.integers(3, 12)),
+        )
+        for i in range(n)
+    ]
+
+
+def _assert_identical(got, want, label):
+    assert set(got) == set(want), (
+        f"{label}: completion sets differ: {set(got) ^ set(want)}"
+    )
+    bad = [
+        rid for rid in got
+        if not np.array_equal(got[rid].tokens, want[rid].tokens)
+    ]
+    assert not bad, f"{label}: tokens diverged from the oracle on {bad}"
+
+
+def main(argv=None) -> int:
+    import dataclasses
+
+    from tpu_dra.workloads import paged_kv
+    from tpu_dra.workloads.engine import Engine, EngineConfig
+    from tpu_dra.workloads.enginebench import (
+        run_prefill_ttft_pair,
+        run_prefix_fleet,
+    )
+    from tpu_dra.workloads.ops import attention as A
+    from tpu_dra.workloads.specdraft import StaticDraft
+
+    report = {"ok": False}
+    cfg, params = _model()
+
+    def ec(**kw):
+        base = dict(
+            page_size=4, max_slots=3, max_pages_per_seq=16,
+            scan_chunk=3, prefill_chunk=8,
+        )
+        base.update(kw)
+        return EngineConfig(**base)
+
+    def rerun(reqs_fn, config, **engine_kw):
+        eng = Engine(cfg, params, config, **engine_kw)
+        return eng.run(reqs_fn()), eng
+
+    # (1a) greedy spec parity on the lookup-friendly trace, with real
+    # acceptance (a 0-acceptance run would vacuously "verify" nothing).
+    # NOTE: _LAST_MULTIQUERY_IMPL can't detect a dead verify path —
+    # batched prefill dispatches the same multiquery op. The verify
+    # pass having actually run is asserted through spec_proposed /
+    # spec_accepted below (only _spec_tick moves them).
+    A._LAST_MULTIQUERY_IMPL = None
+    spec, eng = rerun(lambda: _lookup_reqs(cfg), ec(spec_k=4))
+    assert A._LAST_MULTIQUERY_IMPL is not None, (
+        "the engine never dispatched the multiquery op at all"
+    )
+    oracle, _ = rerun(
+        lambda: _lookup_reqs(cfg), ec(fused=False, contiguous=True)
+    )
+    _assert_identical(spec, oracle, "greedy lookup")
+    rate = eng.spec_accepted / max(eng.spec_proposed, 1)
+    assert eng.spec_proposed > 0 and rate > 0.2, (
+        f"lookup trace acceptance {rate:.3f} over {eng.spec_proposed} "
+        f"proposals — the n-gram proposer is not engaging"
+    )
+    report["lookup_accept_rate"] = round(rate, 4)
+    report["lookup_proposed"] = eng.spec_proposed
+
+    # (1b) sampled spec parity — the (seed, serial, position) schedule
+    # makes acceptance exact under sampling too.
+    samp = dict(temperature=0.8, top_k=8, sample_seed=11)
+    sspec, _ = rerun(lambda: _lookup_reqs(cfg), ec(spec_k=4, **samp))
+    soracle, _ = rerun(
+        lambda: _lookup_reqs(cfg),
+        ec(fused=False, contiguous=True, **samp),
+    )
+    _assert_identical(sspec, soracle, "sampled lookup")
+
+    # (1c) rejection-heavy trace (random prompts): parity + (2) rewind
+    # hygiene — leak-free allocator, fully-zeroed pool.
+    rspec, reng = rerun(lambda: _random_reqs(cfg), ec(spec_k=4))
+    roracle, _ = rerun(
+        lambda: _random_reqs(cfg), ec(fused=False, contiguous=True)
+    )
+    _assert_identical(rspec, roracle, "rejection-heavy")
+    rej_rate = reng.spec_accepted / max(reng.spec_proposed, 1)
+    alloc = reng.allocator
+    assert alloc.free_pages == alloc.num_pages - 1, (
+        "rejection-heavy spec run leaked pages"
+    )
+    assert alloc.reserved_pages == 0, "reservation leak"
+    assert paged_kv.pages_are_zero(
+        reng.cache, list(range(1, alloc.num_pages))
+    ), "rewind left unzeroed pages (zero-tail invariant)"
+    report["rejection_accept_rate"] = round(rej_rate, 4)
+
+    # (1d) adversarial proposer: always-wrong drafts cost throughput,
+    # never tokens.
+    wrong = StaticDraft(np.zeros(8, np.int32) + 1)
+    adv, _ = rerun(
+        lambda: _random_reqs(cfg, seed=17), ec(spec_k=3),
+        draft_source=wrong,
+    )
+    aoracle, _ = rerun(
+        lambda: _random_reqs(cfg, seed=17),
+        ec(fused=False, contiguous=True),
+    )
+    _assert_identical(adv, aoracle, "adversarial draft")
+
+    # (3) COW prompt fleet: pages saved vs the private twin (token
+    # identity + leak/zero asserted inside the helper).
+    fleet_n = 6
+    fl = run_prefix_fleet(
+        cfg, params, fleet_n=fleet_n, prompt_len=17, max_new=6,
+        page_size=4, vocab=cfg.vocab_size,
+    )
+    n_full = (17 - 1) // 4  # page-aligned shared prefix pages
+    want_saved = (fleet_n - 1) * n_full
+    assert fl["prefix_pages_saved"] >= want_saved - 1, (
+        f"COW fleet saved {fl['prefix_pages_saved']} pages; expected "
+        f"~{want_saved} ((N-1) x shared prefix pages) — sharing is "
+        f"not engaging"
+    )
+    assert fl["prefix_attached"] >= fleet_n - 1, (
+        f"only {fl['prefix_attached']} of {fleet_n - 1} followers "
+        f"attached via incref"
+    )
+    report["prefix_pages_saved"] = fl["prefix_pages_saved"]
+    report["prefix_private_peak"] = fl["private_peak_pages"]
+    report["prefix_shared_peak"] = fl["shared_peak_pages"]
+
+    # (4) batched prefill beats the serialized schedule on first-token
+    # p50 for the same admission burst.
+    pair = run_prefill_ttft_pair(
+        cfg, params,
+        EngineConfig(
+            page_size=4, max_slots=6, max_pages_per_seq=10,
+            scan_chunk=3, prefill_chunk=16,
+        ),
+        burst_n=6, prompt_len=12, vocab=cfg.vocab_size,
+    )
+    assert pair["batched_ttft_p50_ms"] < pair["serial_ttft_p50_ms"], (
+        f"batched prefill p50 {pair['batched_ttft_p50_ms']} ms did not "
+        f"beat serial {pair['serial_ttft_p50_ms']} ms"
+    )
+    report["prefill_batched_ttft_p50_ms"] = pair["batched_ttft_p50_ms"]
+    report["prefill_serial_ttft_p50_ms"] = pair["serial_ttft_p50_ms"]
+
+    report["ok"] = True
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
