@@ -3,7 +3,7 @@
 IMAGE_REPO ?= registry.local/tpu-dra-driver
 IMAGE_TAG  ?= v0.1.0
 
-.PHONY: all native test test-slow bench decodebench allocbench enginebench specbench shardbench fleetbench fabricbench repackbench tracecheck slocheck image bats lint lint-fast shlint chaos crashmatrix apisoak ci clean
+.PHONY: all native test test-slow bench decodebench allocbench enginebench specbench shardbench fleetbench fabricbench faultbench repackbench tracecheck slocheck image bats lint lint-fast shlint chaos crashmatrix apisoak ci clean
 
 all: native test
 
@@ -91,6 +91,22 @@ fleetbench:
 # (docs/serving.md).
 fabricbench:
 	python -m tpu_dra.serving.fabricbench --smoke
+
+# Crash-tolerance CPU smoke (ISSUE 16): a seeded chaos schedule kills
+# one live replica hard and wedges a second MID-GENERATION under an
+# open-loop trace — hard asserts on zero lost / zero duplicated
+# sequences (write-ahead dispatch journal, exactly-once), greedy AND
+# sampled completions token-identical to an uninterrupted reference
+# (sampled via the journaled (seed, serial) schedule), both detection
+# paths firing (engine-thread death + stuck-iteration watchdog),
+# post-kill TTFT p99 inside the gated recovery window, and the
+# crash-loop drill: the breaker quarantines the flapping claim and the
+# autoscaler replaces it through the normal packer path. The old
+# fail-loudly death path is structurally gone — no replica death
+# raises out of Fabric.drive. Timed leg: `bench.py --leg-fault`
+# (docs/serving.md, "Failure semantics").
+faultbench:
+	python -m tpu_dra.serving.faultbench --smoke
 
 # Elastic-repacker CPU smoke (ISSUE 12): churn strands the synthetic
 # fleet, the leader-elected repacker migrates residents without
@@ -228,7 +244,7 @@ shlint:
 # (flakes surface in CI, not in the judge's rerun), the 13 bats suites
 # executed against the minicluster, the batsless process-level e2e, and
 # the bench artifact schema gate.
-ci: lint lint-fast shlint native chaos crashmatrix apisoak decodebench allocbench enginebench specbench shardbench fleetbench fabricbench repackbench tracecheck slocheck
+ci: lint lint-fast shlint native chaos crashmatrix apisoak decodebench allocbench enginebench specbench shardbench fleetbench fabricbench faultbench repackbench tracecheck slocheck
 	python -m pytest tests/ -q -m 'not slow'
 	python -m pytest tests/ -q -m 'not slow'
 	python -m pytest tests/test_chaos.py -q -m slow

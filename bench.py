@@ -664,6 +664,24 @@ def _leg_fabric_main() -> int:
     return fabric_main([])
 
 
+def _leg_fault_main() -> int:
+    """Crash-tolerance leg (ISSUE 16): the fabric's failure semantics
+    measured under load — a seeded chaos schedule hard-kills one live
+    replica and wedges a second mid-generation (greedy AND sampled
+    drills), plus the crash-loop drill where the breaker quarantines a
+    flapping claim and the autoscaler replaces it. Headline:
+    fault_recovery_p99_ms (post-kill submitted -> first-token p99)
+    with the exactly-once and token-identity contracts asserted inside
+    the bench. Engines pinned to CPU like the fabric leg — this
+    measures detection + journal recovery, not per-chip speed
+    (tpu_dra/serving/faultbench.py; methodology: docs/serving.md
+    'Failure semantics')."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from tpu_dra.serving.faultbench import main as fault_main
+
+    return fault_main([])
+
+
 def _leg_repack_main() -> int:
     """Elastic-repacker leg (ISSUE 12): the autonomous defragmenter
     over the synthetic fleet — a serving drill where churn strands a
@@ -1574,6 +1592,8 @@ def main() -> int:
         return _leg_fleet_main()
     if "--leg-fabric" in sys.argv:
         return _leg_fabric_main()
+    if "--leg-fault" in sys.argv:
+        return _leg_fault_main()
     if "--leg-repack" in sys.argv:
         return _leg_repack_main()
     if "--leg-rotate" in sys.argv:
@@ -1675,6 +1695,24 @@ def main() -> int:
         f"autoscale reaction {fabric['fabric_scaleup_reaction_ms']} ms, "
         f"scale-down drain {fabric['fabric_scaledown_drain_ms']} ms, "
         f"flaps {fabric['fabric_autoscaler_flaps']}",
+        file=sys.stderr,
+    )
+
+    # Crash-tolerance leg (ISSUE 16): CPU-side like the fabric leg, own
+    # process (its chaos-killed replica threads must not share an
+    # interpreter with the TPU legs).
+    fault = _run_leg({}, flag="--leg-fault")
+    print(
+        f"fault: {fault['fault_deaths']} replica deaths across the "
+        f"drills, {fault['fault_redispatched']} journal re-dispatches, "
+        f"{fault['fault_lost_sequences']} lost, "
+        f"{fault['fault_duplicates_dropped']} duplicates dropped; "
+        f"post-kill ttft p99 {fault['fault_recovery_p99_ms']} ms "
+        f"(sampled {fault['fault_recovery_sampled_p99_ms']} ms); "
+        f"circuit opens {fault['fault_circuit_opens']}, claims "
+        f"replaced {fault['fault_claims_replaced']}; token identity "
+        f"greedy={fault['fault_greedy_identical']} "
+        f"sampled={fault['fault_sampled_identical']}",
         file=sys.stderr,
     )
 
@@ -2129,6 +2167,25 @@ def main() -> int:
                 ],
                 "fabric_autoscaler_flaps": fabric[
                     "fabric_autoscaler_flaps"
+                ],
+                "fault_deaths": fault["fault_deaths"],
+                "fault_redispatched": fault["fault_redispatched"],
+                "fault_lost_sequences": fault["fault_lost_sequences"],
+                "fault_duplicates_dropped": fault[
+                    "fault_duplicates_dropped"
+                ],
+                "fault_recovery_p99_ms": fault["fault_recovery_p99_ms"],
+                "fault_recovery_sampled_p99_ms": fault[
+                    "fault_recovery_sampled_p99_ms"
+                ],
+                "fault_circuit_opens": fault["fault_circuit_opens"],
+                "fault_claims_replaced": fault["fault_claims_replaced"],
+                "fault_rebinds": fault["fault_rebinds"],
+                "fault_greedy_identical": fault[
+                    "fault_greedy_identical"
+                ],
+                "fault_sampled_identical": fault[
+                    "fault_sampled_identical"
                 ],
                 "repack_nodes": repack["repack_nodes"],
                 "repack_frag_before": repack["repack_frag_before"],

@@ -181,6 +181,23 @@ TYPED_WHEN_PRESENT = {
     "repack_quiet_claim_ready_p99_ms": (int, float),
     "repack_storm_claim_ready_p99_ms": (int, float),
     "repack_storm_p99_x": (int, float),
+    # Crash-tolerant serving fabric (ISSUE 16): the chaos-drill leg —
+    # detection + journal-recovery counters, the post-kill TTFT
+    # recovery window, circuit-breaker/claim-replacement proof, and
+    # the token-identity verdicts. The B100 pass forward-requires
+    # fault_recovery_p99_ms / fault_lost_sequences /
+    # fault_redispatched.
+    "fault_deaths": int,
+    "fault_redispatched": int,
+    "fault_lost_sequences": int,
+    "fault_duplicates_dropped": int,
+    "fault_recovery_p99_ms": (int, float),
+    "fault_recovery_sampled_p99_ms": (int, float),
+    "fault_circuit_opens": int,
+    "fault_claims_replaced": int,
+    "fault_rebinds": int,
+    "fault_greedy_identical": bool,
+    "fault_sampled_identical": bool,
 }
 
 

@@ -97,6 +97,14 @@ REQUIRED_STATIC = (
     "spec_accept_rate",
     "prefix_pages_saved",
     "prefill_batched_ttft_p50_ms",
+    # Crash-tolerant serving fabric (ISSUE 16): the post-kill TTFT p99
+    # recovery window, the zero-lost-sequences contract, and the
+    # journal re-dispatch count — dropping any of them would blind the
+    # fault-recovery regression tripwire before its first recorded
+    # artifact.
+    "fault_recovery_p99_ms",
+    "fault_lost_sequences",
+    "fault_redispatched",
 )
 
 

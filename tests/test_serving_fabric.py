@@ -448,6 +448,11 @@ def test_reversal_within_cooldown_counts_flap_and_suppresses():
     )
     clock = FakeClock()
     claims = StubClaims()
+    # The pre-seeded replicas' claims must exist in the store: the
+    # autoscaler's liveness sweep treats a bound-but-vanished claim as
+    # a replica death (ISSUE 16), which is not what this test probes.
+    claims.store["c0"] = {"metadata": {"name": "c0"}}
+    claims.store["c1"] = {"metadata": {"name": "c1"}}
     m = Metrics()
     a = _autoscaler(router, claims, clock, cooldown_seconds=10.0)
     a.metrics = m

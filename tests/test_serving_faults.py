@@ -1,0 +1,830 @@
+"""Crash-tolerance tests (ISSUE 16): failure detection (engine-thread
+death, the stuck-iteration watchdog, claim-vanished), journaled
+sequence recovery (exactly-once re-dispatch, duplicate drop, the
+crash-matrix restart drill over journal snapshots), containment
+(deterministic jittered backoff, the circuit breaker, graceful
+degradation shedding BATCH first), the autoscaler's rebind-vs-
+quarantine-vs-replace decisions, the Replica.stop() join-timeout fix,
+the journaled (seed, serial) sampling schedule, the new chaos kinds'
+schedule validation, and the doctor's fabric crash checks."""
+
+import dataclasses
+import threading
+import time
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dra.infra import chaos
+from tpu_dra.infra.metrics import Metrics
+from tpu_dra.serving.autoscaler import AutoscalerConfig, ClaimAutoscaler
+from tpu_dra.serving.faults import (
+    CircuitBreaker,
+    DispatchJournal,
+    ReplicaFault,
+    redispatch_backoff,
+)
+from tpu_dra.serving.router import (
+    BATCH,
+    INTERACTIVE,
+    Replica,
+    Router,
+    RouterConfig,
+    TenantSpec,
+)
+from tpu_dra.tools.doctor import _check_fabric
+from tpu_dra.workloads.engine import (
+    Completion,
+    Engine,
+    EngineConfig,
+    Evacuated,
+    Request,
+)
+from tpu_dra.workloads.models.llama import TINY_LLAMA, Llama
+
+CFG = dataclasses.replace(
+    TINY_LLAMA, dtype=jnp.float32, param_dtype=jnp.float32
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return Llama(CFG).init_params(jax.random.PRNGKey(7), batch=2, seq=8)
+
+
+def _req(rid, plen=4, out=5):
+    return Request(
+        rid=rid, prompt=np.ones(plen, np.int32), max_new_tokens=out
+    )
+
+
+class StubEngine:
+    """Deterministic no-JAX engine stand-in (test_serving_fabric's):
+    one request completes per step, in arrival order."""
+
+    def __init__(self):
+        self.queue = []
+        self.completed = {}
+        self.order = []
+        self.closed = False
+
+    def add_request(self, req):
+        self.queue.append(req)
+        self.order.append(req.rid)
+
+    @property
+    def busy(self):
+        return bool(self.queue)
+
+    def step(self):
+        if self.queue:
+            r = self.queue.pop(0)
+            now = time.monotonic()
+            self.completed[r.rid] = Completion(
+                rid=r.rid,
+                tokens=np.arange(r.max_new_tokens, dtype=np.int32),
+                t_submit=now, t_arrival=now,
+                t_first_token=now, t_done=now,
+            )
+        return self.busy
+
+    def evacuate(self):
+        out = [
+            Evacuated(
+                req=r, emitted=np.zeros(0, np.int32),
+                t_submit=0.0, t_first=None,
+            )
+            for r in self.queue
+        ]
+        self.queue = []
+        return out
+
+    def close(self):
+        self.closed = True
+
+
+def _stub_replica(name, claim_name=""):
+    rep = Replica(name, StubEngine(), claim_name=claim_name)
+    return rep
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def _drive(router, reps, steps=200, clock=None, dt=0.05):
+    """Single-threaded deterministic drive; a FakeClock (when given)
+    advances each pass so re-dispatch backoffs expire."""
+    for _ in range(steps):
+        if clock is not None:
+            clock.t += dt
+        else:
+            time.sleep(0.002)  # let real-clock re-dispatch backoffs lapse
+        router.poll()
+        for rep in reps:
+            if rep.engine.busy:
+                rep.engine.step()
+            rep._drain_outbox()
+        if not router.busy:
+            break
+    router.poll()
+
+
+# --- faults.py units ---------------------------------------------------------
+
+
+def test_redispatch_backoff_deterministic_jittered_capped():
+    a = redispatch_backoff(1, 0.05, 2.0, "rid-1")
+    assert a == redispatch_backoff(1, 0.05, 2.0, "rid-1")
+    # Jitter band [0.5x, 1.0x] of the raw exponential.
+    assert 0.025 <= a <= 0.05
+    b = redispatch_backoff(1, 0.05, 2.0, "rid-2")
+    assert a != b  # token-derived jitter actually spreads
+    # Exponential growth, then the cap.
+    assert 0.05 * 0.5 * 2 <= redispatch_backoff(3, 0.05, 2.0, "x") <= 0.2
+    assert redispatch_backoff(30, 0.05, 2.0, "x") <= 2.0
+
+
+def test_circuit_breaker_opens_on_edge_and_ages_out():
+    clock = FakeClock()
+    br = CircuitBreaker(max_deaths=3, window_seconds=10.0, clock=clock)
+    assert br.record_death("c0") is False
+    assert br.record_death("c0") is False
+    assert br.is_open("c0") is False
+    assert br.record_death("c0") is True  # the OPENING edge
+    assert br.is_open("c0") and br.open_keys() == ["c0"]
+    assert br.opened_total == 1
+    # Further deaths while open are not new opens.
+    assert br.record_death("c0") is False
+    assert br.opened_total == 1
+    # Other keys are independent.
+    assert br.is_open("other") is False
+    # Deaths age out of the window -> half-closes by itself.
+    clock.t += 11.0
+    assert br.is_open("c0") is False
+    # Snapshot/restore round-trip preserves open state.
+    br2 = CircuitBreaker(max_deaths=3, window_seconds=10.0, clock=clock)
+    for _ in range(3):
+        br2.record_death("k")
+    restored = CircuitBreaker(
+        max_deaths=3, window_seconds=10.0, clock=clock
+    )
+    restored.restore(br2.snapshot())
+    assert restored.is_open("k") and restored.opened_total == 1
+
+
+def test_journal_snapshot_restore_round_trip():
+    j = DispatchJournal()
+    fr = types.SimpleNamespace(
+        rid="r1", tenant="t", prompt=np.array([1, 2], np.int32),
+        max_new=5, session="s", cost=7.0,
+        emitted=np.array([9], np.int32), t_submit=1.0, t_first=1.5,
+        t_dispatch=2.0, replicas=["a"], sample_seed=13,
+        sample_serial=4, retries=1, trace_ctx=None,
+    )
+    j.record(fr, "a")
+    fr2 = types.SimpleNamespace(**{**fr.__dict__, "rid": "r2"})
+    j.record(fr2, "a")
+    j.close("r2")
+    assert j.is_closed("r2") and not j.is_closed("r1")
+    assert j.sample_schedule("r1") == (13, 4)
+    assert j.sample_schedule("r2") == (13, 4)  # closed entries retained
+    snap = j.snapshot()
+    j2 = DispatchJournal.restore(snap)
+    assert [e.rid for e in j2.open_entries()] == ["r1"]
+    assert j2.is_closed("r2")  # exactly-once marker survives restart
+    e = j2.get("r1")
+    assert e.sample_seed == 13 and e.sample_serial == 4
+    assert list(e.emitted) == [9] and e.retries == 1
+
+
+# --- detection + journal recovery -------------------------------------------
+
+
+def _router(tenants, reps, clock=None, **cfg):
+    base = dict(
+        backlog_cap_tokens=1e9, max_inflight_per_replica=4,
+        redispatch_backoff_base_seconds=0.01,
+        redispatch_backoff_cap_seconds=0.02,
+    )
+    base.update(cfg)
+    kw = {"clock": clock} if clock is not None else {}
+    return Router(tenants, reps, RouterConfig(**base), **kw)
+
+
+def test_crash_death_redispatches_exactly_once():
+    """Engine-thread death: the reaper pulls the replica, the journal
+    rebuilds its in-flight sequences at the queue front, survivors
+    complete every admitted rid exactly once — and nothing raises out
+    of poll()."""
+    t = TenantSpec("t", INTERACTIVE)
+    r0, r1 = _stub_replica("r0"), _stub_replica("r1")
+    clock = FakeClock()
+    router = _router([t], [r0, r1], clock=clock)
+    for i in range(8):
+        assert router.submit("t", _req(f"x{i}"), session=f"s{i}")
+    router.poll()
+    victims = set(r0.inflight)
+    assert victims, "no work landed on r0 — affinity spread broke"
+    r0.error = ReplicaFault("chaos: injected crash")
+    router.poll()  # must not raise (the old fail-loudly path is gone)
+    assert r0.dead and r0.death_reason == "crash"
+    assert r0 not in router.replicas
+    assert router.deaths == 1
+    assert router.death_log[0][0] == "r0"
+    assert router.redispatched == len(victims)
+    _drive(router, [r1], clock=clock)
+    assert set(router.completions) == {f"x{i}" for i in range(8)}
+    assert router.duplicates_dropped == 0
+    # Journal fully closed: nothing owed, replay-after-restart empty.
+    assert not router.journal.entries
+    assert router.take_dead() == [r0]
+
+
+def test_late_completion_from_dead_replica_dropped():
+    t = TenantSpec("t", INTERACTIVE)
+    r0 = _stub_replica("r0")
+    router = _router([t], [r0])
+    router.submit("t", _req("a"))
+    _drive(router, [r0])
+    assert set(router.completions) == {"a"}
+    # A half-dead engine races the same completion out again after the
+    # rid was already collected: exactly-once drops the late copy.
+    now = time.monotonic()
+    r0.outbox.append(Completion(
+        rid="a", tokens=np.zeros(2, np.int32), t_submit=now,
+        t_arrival=now, t_first_token=now, t_done=now,
+    ))
+    router.poll()
+    assert router.duplicates_dropped == 1
+    assert len(router.completions["a"].tokens) == 5  # original kept
+
+
+def test_stall_watchdog_marks_replica_dead():
+    """No step progress past the deadline while work is in flight =
+    stall. Progress bumps re-arm the budget; only a genuinely wedged
+    engine dies."""
+    t = TenantSpec("t", INTERACTIVE)
+    r0, r1 = _stub_replica("r0"), _stub_replica("r1")
+    r0.engine.progress = 0  # heartbeat source; never advances
+    r0.engine.step = lambda: True  # wedged: busy but no progress
+    router = _router([t], [r0, r1], stall_deadline_seconds=0.05)
+    router.submit("t", _req("w0", out=3), session="pin")
+    router.poll()
+    # Force the request onto the wedged replica regardless of affinity.
+    if "w0" not in r0.inflight:
+        fr = r1.inflight.pop("w0")
+        r0.inflight["w0"] = fr
+        r0.engine.queue.extend(r1.engine.queue)
+        r1.engine.queue = []
+    router.poll()  # arms the watchdog
+    assert not r0.dead
+    # Progress advancing re-arms: no false positive on a slow engine.
+    time.sleep(0.06)
+    r0.engine.progress += 1
+    router.poll()
+    assert not r0.dead
+    time.sleep(0.06)  # now genuinely stuck past the deadline
+    router.poll()
+    assert r0.dead and r0.death_reason == "stall"
+    _drive(router, [r1])
+    assert "w0" in router.completions
+
+
+def test_redispatch_backoff_gates_requeue():
+    """A journal-recovered head cools off for its backoff window; the
+    WFQ skips the tenant rather than busy-spinning the request."""
+    t = TenantSpec("t", INTERACTIVE)
+    r0, r1 = _stub_replica("r0"), _stub_replica("r1")
+    clock = FakeClock()
+    router = _router(
+        [t], [r0, r1], clock=clock,
+        redispatch_backoff_base_seconds=10.0,
+        redispatch_backoff_cap_seconds=10.0,
+        max_inflight_per_replica=1,
+    )
+    router.submit("t", _req("a"), session="s")
+    router.poll()
+    (victim,) = [r for r in (r0, r1) if "a" in r.inflight]
+    survivor = r1 if victim is r0 else r0
+    victim.error = ReplicaFault("boom")
+    router.poll()
+    # Cooling off: nothing dispatches although a survivor has headroom.
+    router.poll()
+    assert "a" not in survivor.inflight
+    clock.t += 11.0  # past the jittered [5, 10]s backoff
+    router.poll()
+    assert "a" in survivor.inflight
+    _drive(router, [survivor], clock=clock)
+    assert set(router.completions) == {"a"}
+
+
+def test_degradation_sheds_batch_first_and_exports():
+    """Dead-but-unreplaced capacity shrinks the effective admission cap
+    by live/(live+owed): BATCH (admit_frac 0.6) sheds at the door while
+    INTERACTIVE still admits; fabric_degraded + shed counters export."""
+    gold = TenantSpec("gold", INTERACTIVE)
+    bulk = TenantSpec("bulk", BATCH)
+    r0, r1 = _stub_replica("r0"), _stub_replica("r1")
+    m = Metrics()
+    router = Router(
+        [gold, bulk], [r0, r1],
+        RouterConfig(backlog_cap_tokens=100.0),
+        metrics=m,
+    )
+    router._export_period = 0.0
+    router.mark_dead(r0, "crash")
+    assert router._capacity_owed == 1
+    assert m.get_gauge("fabric_degraded") == 0.5
+    # BATCH ceiling: 0.6 * 100 * 0.5 = 30 < cost 40 -> shed;
+    # INTERACTIVE: 1.0 * 100 * 0.5 = 50 >= 40 -> admitted.
+    assert not router.submit("bulk", _req("b", plen=20, out=20))
+    assert router.submit("gold", _req("g", plen=20, out=20))
+    assert router.shed == {BATCH.name: 1}
+    assert m.get_counter(
+        "fabric_shed_total", {"cls": BATCH.name}
+    ) == 1.0
+    assert m.get_counter(
+        "fabric_replica_deaths_total", {"reason": "crash"}
+    ) == 1.0
+    # Capacity restored (re-bind/replacement): degradation recovers.
+    router.add_replica(_stub_replica("r2"))
+    assert router._capacity_owed == 0
+    assert m.get_gauge("fabric_degraded") == 0.0
+
+
+def test_circuit_open_quarantines_routing():
+    t = TenantSpec("t", INTERACTIVE)
+    r0 = _stub_replica("r0", claim_name="c0")
+    clock = FakeClock()
+    router = _router([t], [r0], clock=clock, breaker_deaths=1)
+    router.submit("t", _req("a"))
+    router.poll()
+    r0.error = ReplicaFault("boom")
+    router.poll()
+    assert router.breaker.is_open("c0")
+    # A fresh replica on the SAME claim is quarantined (no routing);
+    # one on a fresh claim serves.
+    rb = _stub_replica("r0b", claim_name="c0")
+    router.add_replica(rb)
+    clock.t += 1.0
+    router.poll()
+    assert "a" not in rb.inflight
+    fresh = _stub_replica("r1", claim_name="c1")
+    router.add_replica(fresh)
+    _drive(router, [rb, fresh], clock=clock)
+    assert set(router.completions) == {"a"}
+    assert not rb.engine.order and "a" in fresh.engine.order
+
+
+# --- Replica.stop() join-timeout satellite ----------------------------------
+
+
+class WedgedEngine:
+    """busy forever; step blocks until released — a thread stop()
+    cannot join."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.completed = {}
+        self.closed = False
+        self.busy = True
+
+    def add_request(self, req):
+        pass
+
+    def step(self):
+        self.release.wait(30.0)
+        return False
+
+    def evacuate(self):
+        return []
+
+    def close(self):
+        self.closed = True
+
+
+def test_stop_timeout_returns_false_counts_and_marks_dead():
+    m = Metrics()
+    rep = Replica("w0", WedgedEngine(), metrics=m)
+    rep.start()
+    time.sleep(0.05)  # let the thread enter the wedged step
+    t0 = time.monotonic()
+    joined = rep.stop(timeout=0.2)
+    assert time.monotonic() - t0 < 5.0  # no silent 30s hang
+    assert joined is False
+    assert rep.dead and rep.death_reason == "stop-timeout"
+    assert rep.engine.closed  # close still runs
+    assert m.get_counter("fabric_replica_stop_timeouts_total") == 1.0
+    rep.engine.release.set()  # unwedge for a clean test exit
+    rep._thread.join(timeout=2.0)
+    assert not rep._thread.is_alive()
+
+
+def test_injected_crash_kills_thread_without_reraise():
+    rep = Replica("x0", StubEngine())
+    rep.start()
+    rep.inject_fault("crash")
+    deadline = time.monotonic() + 2.0
+    while rep.error is None and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert isinstance(rep.error, ReplicaFault)
+    assert rep.stop(timeout=1.0) is True  # thread exited cleanly
+    assert not any(
+        th.name == "replica-x0" for th in threading.enumerate()
+    )
+
+
+# --- autoscaler: rebind vs quarantine vs replace ----------------------------
+
+
+class StubClaims:
+    def __init__(self):
+        self.store = {}
+        self.deleted = []
+
+    def create(self, obj):
+        self.store[obj["metadata"]["name"]] = obj
+        return obj
+
+    def try_get(self, name, namespace=None):
+        return self.store.get(name)
+
+    def delete(self, name, namespace=None):
+        self.deleted.append(name)
+        self.store.pop(name, None)
+
+    def allocate(self, name):
+        self.store[name].setdefault("status", {})["allocation"] = {
+            "devices": {"results": [
+                {"pool": "node-0", "device": "ss-1x1x1-0-0-0"},
+            ]},
+        }
+
+
+def _autoscaler(router, claims, clock, **cfg):
+    base = dict(
+        min_replicas=1, max_replicas=3,
+        target_tokens_per_replica=1e9,  # park load-driven scaling
+        cooldown_seconds=1.0,
+    )
+    base.update(cfg)
+    made = []
+
+    def make_replica(claim):
+        rep = _stub_replica(claim["metadata"]["name"])
+        made.append(rep)
+        return rep
+
+    a = ClaimAutoscaler(
+        router, claims,
+        make_claim=lambda name: {"metadata": {"name": name},
+                                 "spec": {"devices": {"requests": []}}},
+        make_replica=make_replica,
+        config=AutoscalerConfig(**base),
+        clock=clock,
+    )
+    a._made = made
+    return a
+
+
+def test_first_death_hot_rebinds_still_allocated_claim():
+    t = TenantSpec("t", INTERACTIVE)
+    r0 = _stub_replica("c0", claim_name="c0")
+    clock = FakeClock()
+    router = _router([t], [r0], clock=clock)
+    claims = StubClaims()
+    claims.create({"metadata": {"name": "c0"}})
+    claims.allocate("c0")
+    a = _autoscaler(router, claims, clock)
+    router.mark_dead(r0, "crash")
+    a.tick()
+    assert a.rebinds == 1 and a.quarantined == 0
+    assert [e[0] for e in a.events] == ["rebind"]
+    (rep2,) = router.replicas
+    assert rep2 is not r0 and rep2.claim_name == "c0"
+    assert r0.engine.closed  # corpse joined + closed
+
+
+def test_crash_loop_quarantines_and_replaces_claim():
+    t = TenantSpec("t", INTERACTIVE)
+    r0 = _stub_replica("c0", claim_name="c0")
+    clock = FakeClock()
+    router = _router([t], [r0], clock=clock, breaker_deaths=2)
+    claims = StubClaims()
+    claims.create({"metadata": {"name": "c0"}})
+    claims.allocate("c0")
+    a = _autoscaler(router, claims, clock)
+    router.mark_dead(r0, "crash")
+    a.tick()  # death 1: hot re-bind onto the same claim
+    (rep2,) = router.replicas
+    assert rep2.claim_name == "c0"
+    clock.t += 0.1
+    router.mark_dead(rep2, "crash")  # death 2: circuit opens
+    assert router.breaker.is_open("c0")
+    a.tick()
+    assert a.quarantined == 1
+    assert "c0" in claims.deleted  # quarantine DELETES the claim
+    assert claims.try_get("c0") is None
+    quarantine = [e for e in a.events if e[0] == "quarantine"]
+    assert quarantine and quarantine[0][1] == "c0"
+    assert quarantine[0][3]["reason"] == "crash"
+    # Replacement flows through the normal one-pending-claim path,
+    # bypassing the scale cooldown (repair, not a load decision).
+    clock.t += 0.01
+    a.tick()
+    assert a.replaced == 1
+    replace = [e for e in a.events if e[0] == "replace-requested"]
+    name = replace[0][1]
+    assert name.startswith("fabric-replica-")
+    assert claims.try_get(name) is not None
+    claims.allocate(name)
+    clock.t += 0.1
+    a.tick()  # packer placed it -> replica binds
+    assert any(
+        e[0] == "up-ready" and e[1] == name for e in a.events
+    )
+    assert [r.claim_name or r.name for r in router.replicas] == [name]
+
+
+def test_claim_vanished_detection_kills_and_replaces():
+    t = TenantSpec("t", INTERACTIVE)
+    r0 = _stub_replica("c0", claim_name="c0")
+    clock = FakeClock()
+    router = _router([t], [r0], clock=clock)
+    router.submit("t", _req("a"))
+    router.poll()
+    assert "a" in r0.inflight
+    claims = StubClaims()  # c0 never existed from this store's view
+    a = _autoscaler(router, claims, clock)
+    a.tick()
+    assert r0.dead and r0.death_reason == "claim-vanished"
+    assert router.death_log[0][1] == "claim-vanished"
+    gone = [e for e in a.events if e[0] == "dead-claim-gone"]
+    assert gone and gone[0][1] == "c0"
+    clock.t += 0.01
+    a.tick()
+    assert a.replaced == 1
+    # The journaled sequence waits for the replacement, not lost.
+    assert router.in_system() == 1
+
+
+# --- sampling schedule (satellite: journaled (seed, serial)) ----------------
+
+
+def test_dispatch_carries_journaled_sampling_schedule():
+    t = TenantSpec("t", INTERACTIVE)
+    r0 = _stub_replica("r0")
+    r0.engine.ec = types.SimpleNamespace(sample_seed=7)
+    router = _router([t], [r0])
+    router.submit("t", _req("a"))
+    router.submit("t", _req("b"))
+    router.poll()
+    # The engine saw the router's schedule on the Request itself.
+    by_rid = {r.rid: r for r in r0.engine.queue}
+    assert by_rid["a"].sample_seed == 7
+    assert by_rid["a"].sample_serial == 1
+    assert by_rid["b"].sample_serial == 2
+    # And the journal carries it for a cross-replica resume.
+    assert router.journal.sample_schedule("a") == (7, 1)
+    assert router.journal.sample_schedule("b") == (7, 2)
+
+
+def test_sampled_parity_with_pinned_schedule_across_engines(params):
+    """The parity pin for the plumbing fix: a sampled sequence replayed
+    on a DIFFERENT engine with the journaled (seed, serial) produces
+    token-identical output, even though its admission serial there
+    would have been different."""
+    ec = EngineConfig(
+        page_size=4, max_slots=3, max_pages_per_seq=10,
+        scan_chunk=3, prefill_chunk=8,
+        temperature=0.8, top_k=16, sample_seed=5,
+    )
+    rng = np.random.default_rng(0)
+    r1 = Request(
+        rid="r1",
+        prompt=rng.integers(1, CFG.vocab_size, 4).astype(np.int32),
+        max_new_tokens=6,
+    )
+    r2 = Request(
+        rid="r2",
+        prompt=rng.integers(1, CFG.vocab_size, 4).astype(np.int32),
+        max_new_tokens=6,
+    )
+    ref = Engine(CFG, params, ec).run(
+        [dataclasses.replace(r1), dataclasses.replace(r2)]
+    )
+    # On the second engine r2 would be admission serial 1 — the pinned
+    # schedule overrides it, so the key stream matches the first run.
+    out = Engine(CFG, params, ec).run([
+        dataclasses.replace(r2, sample_seed=5, sample_serial=2),
+    ])
+    assert np.array_equal(out["r2"].tokens, ref["r2"].tokens)
+    # A seed mismatch is refused, never silently forked.
+    eng = Engine(CFG, params, ec)
+    with pytest.raises(ValueError, match="sample_seed"):
+        eng.add_request(dataclasses.replace(r1, sample_seed=99))
+
+
+# --- crash-matrix restart drill (satellite) ---------------------------------
+
+
+def test_restart_post_journal_replays_to_exactly_once():
+    """Kill the control thread right after the write-ahead journal
+    (dispatch happened, nothing completed): a fresh router adopting the
+    restored snapshot completes every journaled rid exactly once."""
+    t = TenantSpec("t", INTERACTIVE)
+    r0 = _stub_replica("r0")
+    router1 = _router([t], [r0])
+    for i in range(3):
+        router1.submit("t", _req(f"j{i}"))
+    router1.poll()  # dispatch -> journaled
+    snap = router1.journal.snapshot()
+    assert len(snap["open"]) == 3 and snap["closed"] == []
+    # "Restart": a brand-new router + replicas over the snapshot.
+    r1 = _stub_replica("r1")
+    router2 = _router([t], [r1])
+    n = router2.recover_from_journal(DispatchJournal.restore(snap))
+    assert n == 3 and router2.in_system() == 3
+    _drive(router2, [r1])
+    assert set(router2.completions) == {"j0", "j1", "j2"}
+    assert not router2.journal.entries  # all closed
+
+
+def test_restart_post_redispatch_skips_closed_rids():
+    """Kill between re-dispatch and completion: rids that completed
+    BEFORE the crash stay closed across the restart (never replayed);
+    open ones complete exactly once."""
+    t = TenantSpec("t", INTERACTIVE)
+    r0, r1 = _stub_replica("r0"), _stub_replica("r1")
+    clock = FakeClock()
+    router1 = _router([t], [r0, r1], clock=clock)
+    for i in range(6):
+        router1.submit("t", _req(f"k{i}"), session=f"s{i}")
+    router1.poll()
+    victims = set(r0.inflight)
+    assert victims
+    # One survivor-side rid completes pre-crash.
+    r1.engine.step()
+    r1._drain_outbox()
+    router1.poll()
+    done_before = set(router1.completions)
+    assert len(done_before) == 1
+    r0.error = ReplicaFault("boom")
+    clock.t += 1.0
+    router1.poll()  # reap + journal re-queue (post-redispatch phase)
+    assert router1.redispatched == len(victims)
+    snap = router1.journal.snapshot()
+    assert set(snap["closed"]) == done_before
+    r2 = _stub_replica("r2")
+    router2 = _router([t], [r2])
+    router2.recover_from_journal(DispatchJournal.restore(snap))
+    _drive(router2, [r2])
+    want = {f"k{i}" for i in range(6)} - done_before
+    assert set(router2.completions) == want  # closed rid NOT replayed
+    assert router2.duplicates_dropped == 0
+
+
+def test_restart_post_circuit_open_preserves_quarantine():
+    """Kill after the circuit opened: the breaker snapshot restores the
+    quarantine, so the restarted router still refuses the poisoned
+    claim while a fresh claim serves the replayed backlog."""
+    t = TenantSpec("t", INTERACTIVE)
+    clock = FakeClock()
+    router1 = _router([t], [], clock=clock, breaker_deaths=2)
+    for i in range(2):
+        rep = _stub_replica(f"r{i}", claim_name="bad-claim")
+        router1.add_replica(rep)
+        router1.submit("t", _req(f"c{i}"), session=f"s{i}")
+        clock.t += 0.1  # past any re-dispatch backoff from the prior death
+        router1.poll()
+        assert rep.inflight  # journaled before the engine saw it
+        rep.error = ReplicaFault("loop")
+        router1.poll()
+    assert router1.breaker.is_open("bad-claim")
+    jsnap = router1.journal.snapshot()
+    bsnap = router1.breaker.snapshot()
+    # Restart.
+    router2 = _router([t], [], clock=clock, breaker_deaths=2)
+    router2.breaker.restore(bsnap)
+    router2.recover_from_journal(DispatchJournal.restore(jsnap))
+    assert router2.breaker.is_open("bad-claim")
+    poisoned = _stub_replica("rX", claim_name="bad-claim")
+    fresh = _stub_replica("rY", claim_name="good-claim")
+    router2.add_replica(poisoned)
+    router2.add_replica(fresh)
+    _drive(router2, [poisoned, fresh], clock=clock)
+    assert set(router2.completions) == {"c0", "c1"}
+    assert not poisoned.engine.order  # quarantine held across restart
+    # No orphaned replica threads anywhere in the drill (stub replicas
+    # never started threads; started ones were joined in other tests).
+    assert not any(
+        th.name.startswith("replica-") for th in threading.enumerate()
+    )
+
+
+# --- chaos schedule validation for the new kinds ----------------------------
+
+
+def test_chaos_serving_kinds_validate():
+    good = chaos.FaultSchedule.from_dict({
+        "version": 1,
+        "events": [
+            {"at": 0.1, "kind": "replica_crash", "replica_index": 0},
+            {"at": 0.2, "kind": "replica_stall", "replica_index": 1},
+            {"at": 0.3, "kind": "replica_crash_loop",
+             "replica_index": 0, "count": 2},
+        ],
+    })
+    assert [e.kind for e in good.events] == [
+        "replica_crash", "replica_stall", "replica_crash_loop",
+    ]
+    with pytest.raises(ValueError, match="replica_index"):
+        chaos.FaultSchedule.from_dict({
+            "version": 1,
+            "events": [{"at": 0.1, "kind": "replica_crash"}],
+        })
+    with pytest.raises(ValueError, match="count"):
+        # A crash LOOP needs >= 2 deaths to be distinguishable from a
+        # one-off crash the re-bind path absorbs.
+        chaos.FaultSchedule.from_dict({
+            "version": 1,
+            "events": [{"at": 0.1, "kind": "replica_crash_loop",
+                        "replica_index": 0, "count": 1}],
+        })
+
+
+def test_chaos_from_seed_default_excludes_serving_kinds():
+    # Seeded soak reproducibility: pre-ISSUE-16 seeds must generate
+    # exactly what they always did.
+    for seed in (0, 7, 20260807):
+        sched = chaos.FaultSchedule.from_seed(seed)
+        assert not any(
+            e.kind in chaos.SERVING_FAULT_KINDS for e in sched.events
+        )
+    # Opt-in generation produces valid serving events.
+    sched = chaos.FaultSchedule.from_seed(
+        3, kinds=[chaos.REPLICA_CRASH, chaos.REPLICA_CRASH_LOOP],
+        replicas=4,
+    )
+    assert sched.events
+    for e in sched.events:
+        assert 0 <= e.params["replica_index"] < 4
+        if e.kind == chaos.REPLICA_CRASH_LOOP:
+            assert e.params["count"] >= 2
+
+
+# --- doctor fabric checks ---------------------------------------------------
+
+
+def test_doctor_warns_on_death_growth_and_quarantine():
+    warnings = []
+    first = {
+        'fabric_replica_deaths_total{reason="crash"}': 1.0,
+        "fabric_circuit_open": 0.0,
+        "fabric_replicas": 3.0,
+        "fabric_in_system_sequences": 5.0,
+    }
+    second = dict(first)
+    second['fabric_replica_deaths_total{reason="crash"}'] = 3.0
+    second["fabric_circuit_open"] = 1.0
+    out = _check_fabric("ep", first, second, warnings.append)
+    assert out["deaths"] == 3
+    assert out["deaths_by_reason"] == {"crash": 3}
+    assert out["circuit_open"] == 1
+    assert any("DYING" in w for w in warnings)
+    assert any("QUARANTINED" in w for w in warnings)
+    assert not any("ERROR" in w for w in warnings)
+
+
+def test_doctor_errors_when_no_capacity_for_admitted_load():
+    warnings = []
+    sample = {
+        "fabric_replicas": 0.0,
+        "fabric_in_system_sequences": 4.0,
+        "fabric_degraded": 1.0,
+    }
+    _check_fabric("ep", sample, None, warnings.append)
+    assert any(
+        "ERROR" in w and "live capacity" in w for w in warnings
+    )
+
+
+def test_doctor_quiet_fabric_stays_quiet():
+    warnings = []
+    sample = {
+        "fabric_replicas": 2.0,
+        "fabric_in_system_sequences": 3.0,
+        "fabric_circuit_open": 0.0,
+        "fabric_degraded": 0.0,
+    }
+    out = _check_fabric("ep", sample, None, warnings.append)
+    assert warnings == []
+    assert "deaths" not in out

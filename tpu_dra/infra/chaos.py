@@ -35,7 +35,11 @@ Schedule JSON format (``*.chaos.json``)::
         {"at": 2.5, "kind": "plugin_crash"},
         {"at": 2.8, "kind": "crash",
          "point": "checkpoint.write.before_replace"},
-        {"at": 3.0, "kind": "client_death"}
+        {"at": 3.0, "kind": "client_death"},
+        {"at": 3.2, "kind": "replica_crash", "replica_index": 1},
+        {"at": 3.5, "kind": "replica_stall", "replica_index": 0},
+        {"at": 3.8, "kind": "replica_crash_loop", "replica_index": 2,
+         "count": 3}
       ]
     }
 
@@ -84,12 +88,29 @@ API_PARTITION = "api_partition"    # fakeserver blackhole: requests hang
 API_LATENCY = "api_latency"        # fakeserver injects params["delay"]
 #   seconds into every request for params["duration"] seconds (slow
 #   concierge / overloaded etcd analog).
+REPLICA_CRASH = "replica_crash"    # serving fabric (ISSUE 16): a
+#   replica's engine thread raises mid-generation — the hard-death
+#   path the router's reaper + dispatch journal recover.
+REPLICA_STALL = "replica_stall"    # serving fabric: a replica's engine
+#   thread wedges (no step progress, thread alive) — the path the
+#   stuck-iteration watchdog exists to catch.
+REPLICA_CRASH_LOOP = "replica_crash_loop"  # serving fabric: re-crash
+#   the replica on every re-bind, params["count"] times total — drives
+#   the circuit breaker open and the autoscaler's claim replacement.
+
+# Serving-layer kinds target the fabric harness (faultbench), not the
+# control-plane soaks; they are EXCLUDED from from_seed's default
+# population so adding them did not change what any existing seed
+# generates (seeded soak reproducibility is the whole point).
+SERVING_FAULT_KINDS = frozenset({
+    REPLICA_CRASH, REPLICA_STALL, REPLICA_CRASH_LOOP,
+})
 
 FAULT_KINDS = frozenset({
     CHIP_DOWN, CHIP_UP, APISERVER_THROTTLE, APISERVER_ERRORS,
     WATCH_DROP, PLUGIN_CRASH, CLIENT_DEATH, CRASH,
     API_PARTITION, API_LATENCY,
-})
+}) | SERVING_FAULT_KINDS
 
 
 def _positive_number(v: object) -> bool:
@@ -121,6 +142,22 @@ _REQUIRED_PARAMS: Dict[str, Dict[str, Callable[[object], bool]]] = {
     API_LATENCY: {
         "delay": _positive_number,
         "duration": _positive_number,
+    },
+    REPLICA_CRASH: {
+        "replica_index": lambda v: isinstance(v, int)
+        and not isinstance(v, bool) and v >= 0,
+    },
+    REPLICA_STALL: {
+        "replica_index": lambda v: isinstance(v, int)
+        and not isinstance(v, bool) and v >= 0,
+    },
+    REPLICA_CRASH_LOOP: {
+        "replica_index": lambda v: isinstance(v, int)
+        and not isinstance(v, bool) and v >= 0,
+        # Fewer than 2 deaths cannot distinguish a crash LOOP from a
+        # one-off crash the re-bind path absorbs.
+        "count": lambda v: isinstance(v, int)
+        and not isinstance(v, bool) and v >= 2,
     },
 }
 
@@ -282,6 +319,7 @@ class FaultSchedule:
         events_per_second: float = 2.0,
         kinds: Optional[List[str]] = None,
         max_chips_down: Optional[int] = None,
+        replicas: int = 2,
     ) -> "FaultSchedule":
         """Generate a randomized-but-deterministic schedule.
 
@@ -292,7 +330,14 @@ class FaultSchedule:
         instant — a schedule that takes out the whole host tests nothing
         but the empty ResourceSlice."""
         rng = random.Random(seed)
-        kinds = list(kinds or sorted(FAULT_KINDS - {CHIP_UP}))
+        # Serving-fabric kinds are opt-in (pass them via ``kinds``):
+        # keeping them out of the default population preserves what
+        # every pre-existing seed generates for the control-plane
+        # soaks. ``replicas`` bounds their replica_index.
+        kinds = list(
+            kinds
+            or sorted(FAULT_KINDS - {CHIP_UP} - SERVING_FAULT_KINDS)
+        )
         # Chip flaps are the fault the remediation pipeline exists for:
         # weight them so every non-trivial schedule exercises that path.
         population = kinds + [CHIP_DOWN] * (2 if CHIP_DOWN in kinds else 0)
@@ -358,6 +403,15 @@ class FaultSchedule:
                 events.append(FaultEvent(at, kind, {
                     "delay": round(rng.uniform(0.02, 0.2), 3),
                     "duration": round(rng.uniform(0.2, 1.0), 3),
+                }))
+            elif kind in (REPLICA_CRASH, REPLICA_STALL):
+                events.append(FaultEvent(at, kind, {
+                    "replica_index": rng.randrange(max(1, replicas)),
+                }))
+            elif kind == REPLICA_CRASH_LOOP:
+                events.append(FaultEvent(at, kind, {
+                    "replica_index": rng.randrange(max(1, replicas)),
+                    "count": rng.randint(2, 4),
                 }))
             else:  # watch_drop / plugin_crash / client_death
                 events.append(FaultEvent(at, kind, {}))

@@ -59,6 +59,15 @@ class AutoscalerConfig:
     # may have been freed meanwhile — item 1's repacker will help).
     alloc_timeout_seconds: float = 30.0
     namespace: str = "fabric"
+    # --- crash tolerance (ISSUE 16) ---
+    # How often the claim-vanished detector polls the claim store (a
+    # deleted/lost claim means the replica's device lease is gone: the
+    # router must reclaim its sequences even though the thread lives).
+    claim_check_seconds: float = 1.0
+    # Join timeout when collecting a dead replica's thread: bounded so
+    # a wedged thread cannot stall the control loop (the stop-timeout
+    # path logs + counts it and leaves the corpse dead).
+    dead_join_timeout_seconds: float = 1.0
 
 
 class ClaimAutoscaler:
@@ -88,6 +97,9 @@ class ClaimAutoscaler:
         self.flaps = 0
         self.scaleups = 0
         self.scaledowns = 0
+        self.rebinds = 0
+        self.quarantined = 0
+        self.replaced = 0
         self.reaction_s: List[float] = []
         self.drain_s: List[float] = []
         # Event log for tests and the bench: (kind, claim_name, t, info).
@@ -103,19 +115,129 @@ class ClaimAutoscaler:
         # In-flight transitions (at most one of each at a time).
         self._pending_claim: Optional[dict] = None
         self._pending_t0 = 0.0
+        self._pending_is_replace = False
         self._draining: Optional[Replica] = None
         self._drain_t0 = 0.0
+        # Crash tolerance (ISSUE 16): replacements owed to quarantined
+        # or claim-less dead replicas (drained one at a time through
+        # the single pending-claim slot), and the claim-vanished
+        # detector's last poll time.
+        self._replace_owed = 0
+        self._last_claim_check = -1e18
 
     # --- the control-thread entry point ---
 
     def tick(self) -> None:
+        self._check_claims()
+        self._tick_dead()
         if self._pending_claim is not None:
             self._tick_pending_alloc()
             return
         if self._draining is not None:
             self._tick_draining()
             return
+        if self._replace_owed > 0:
+            # Replacement is a repair, not a load decision: it bypasses
+            # the cooldown/hysteresis band (the fleet is OWED this
+            # capacity) but still flows through the one-at-a-time
+            # pending-claim slot the packer places.
+            self._begin_replace(self.clock())
+            return
         self._maybe_scale()
+
+    # --- crash tolerance (ISSUE 16) ---
+
+    def _check_claims(self) -> None:
+        """Claim-vanished detection: a live replica whose ResourceClaim
+        no longer exists has lost its device lease — the router must
+        reclaim its journaled sequences even though the thread is
+        healthy."""
+        now = self.clock()
+        if now - self._last_claim_check < self.config.claim_check_seconds:
+            return
+        self._last_claim_check = now
+        for rep in list(self.router.replicas):
+            if not rep.claim_name or rep.dead or rep is self._draining:
+                continue
+            cur = self.claims.try_get(
+                rep.claim_name, self.config.namespace
+            )
+            if cur is None:
+                self.router.mark_dead(rep, "claim-vanished")
+
+    def _tick_dead(self) -> None:
+        """Collect replicas the router declared dead: join the thread
+        (bounded), then either hot RE-BIND a fresh replica onto the
+        still-allocated claim, or — when the claim's circuit is open
+        (crash-looping) or the claim is gone — QUARANTINE: delete the
+        claim and owe a replacement through the normal claim path."""
+        for rep in self.router.take_dead():
+            now = self.clock()
+            rep.stop(timeout=self.config.dead_join_timeout_seconds)
+            key = rep.claim_name or rep.name
+            claim = (
+                self.claims.try_get(
+                    rep.claim_name, self.config.namespace
+                )
+                if rep.claim_name else None
+            )
+            alloc = ((claim or {}).get("status") or {}).get("allocation")
+            if self.router.breaker.is_open(key):
+                # Crash loop: re-binding would feed the loop. Replace
+                # the claim — fresh name, fresh placement, closed
+                # circuit.
+                if rep.claim_name and claim is not None:
+                    try:
+                        self.claims.delete(
+                            rep.claim_name, self.config.namespace
+                        )
+                    except Exception:  # noqa: BLE001 — already gone
+                        pass
+                self.quarantined += 1
+                if self.metrics is not None:
+                    self.metrics.inc("fabric_quarantined_total")
+                self.events.append(("quarantine", rep.claim_name, now, {
+                    "reason": rep.death_reason,
+                }))
+                self._replace_owed += 1
+            elif alloc:
+                # First (or rare) death with the claim still allocated:
+                # hot re-bind a fresh engine onto the same devices.
+                rep2 = self.make_replica(claim)
+                rep2.claim_name = rep.claim_name
+                rep2.claim = claim
+                self.router.add_replica(rep2)
+                self.rebinds += 1
+                if self.metrics is not None:
+                    self.metrics.inc("fabric_rebinds_total")
+                self.events.append(("rebind", rep.claim_name, now, {
+                    "reason": rep.death_reason,
+                }))
+            else:
+                # Claim vanished (or claim-less bootstrap replica):
+                # nothing to re-bind onto — owe a replacement.
+                self.events.append(
+                    ("dead-claim-gone", rep.claim_name, now, {
+                        "reason": rep.death_reason,
+                    })
+                )
+                self._replace_owed += 1
+
+    def _begin_replace(self, now: float) -> None:
+        self._replace_owed -= 1
+        self._serial += 1
+        name = f"fabric-replica-{self._serial:04d}"
+        claim = self.make_claim(name)
+        claim["metadata"]["name"] = name
+        claim["metadata"]["namespace"] = self.config.namespace
+        self.claims.create(claim)
+        self._pending_claim = claim
+        self._pending_t0 = now
+        self._pending_is_replace = True
+        self.replaced += 1
+        if self.metrics is not None:
+            self.metrics.inc("fabric_claims_replaced_total")
+        self.events.append(("replace-requested", name, now, {}))
 
     # --- decision ---
 
@@ -169,6 +291,7 @@ class ClaimAutoscaler:
         self.claims.create(claim)
         self._pending_claim = claim
         self._pending_t0 = now
+        self._pending_is_replace = False
         self._last_action, self._last_action_t = "up", now
         self.events.append(("up-requested", name, now, {}))
 
@@ -186,6 +309,11 @@ class ClaimAutoscaler:
                 except Exception:  # noqa: BLE001 — already gone
                     pass
                 self.events.append(("up-unplaceable", name, now, {}))
+                if self._pending_is_replace:
+                    # A replacement is a debt, not an opportunity: an
+                    # unplaceable one stays owed and retries on a later
+                    # tick (capacity may free meanwhile).
+                    self._replace_owed += 1
                 self._pending_claim = None
             return
         rep = self.make_replica(cur)
@@ -262,7 +390,9 @@ class ClaimAutoscaler:
             except Exception:  # noqa: BLE001 — already gone
                 pass
         self.router.remove_replica(victim)
-        victim.stop()
+        victim.stop(
+            timeout=self.router.config.replica_join_timeout_seconds
+        )
         self._draining = None
         self.scaledowns += 1
         drain = now - self._drain_t0

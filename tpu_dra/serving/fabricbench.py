@@ -233,6 +233,7 @@ class Fabric:
         rep = Replica(
             claim["metadata"]["name"], engine,
             claim_name=claim["metadata"]["name"], claim=claim,
+            metrics=self.metrics,
         )
         rep.start()
         return rep
@@ -287,13 +288,12 @@ class Fabric:
                 else:
                     rejected += 1
                 i += 1
+            # A replica death no longer raises out of the drive loop:
+            # poll()'s reaper classifies it, the dispatch journal
+            # re-queues its in-flight sequences onto survivors, and the
+            # autoscaler (when ticking) re-binds or replaces the claim
+            # (ISSUE 16 — the old fail-loudly block lived here).
             moved = self.router.poll()
-            for rep in self.router.replicas:
-                if rep.error is not None:
-                    raise RuntimeError(
-                        f"replica {rep.name} engine thread died: "
-                        f"{rep.error!r}"
-                    )
             if autoscale:
                 self.autoscaler.tick()
             if extra_tick is not None:
@@ -301,6 +301,10 @@ class Fabric:
             scaling = (
                 self.autoscaler._pending_claim is not None
                 or self.autoscaler._draining is not None
+                or (autoscale and (
+                    self.autoscaler._replace_owed > 0
+                    or bool(self.router.dead_replicas)
+                ))
             )
             if i >= len(trace) and not self.router.busy and not scaling:
                 break
@@ -320,6 +324,10 @@ class Fabric:
     def stop(self) -> None:
         for rep in list(self.router.replicas):
             rep.stop()
+        # Dead replicas the autoscaler never collected (autoscale=False
+        # drives) still own threads; join them bounded.
+        for rep in list(self.router.dead_replicas):
+            rep.stop(timeout=2.0)
         self.core.stop()
 
     # --- reporting ---

@@ -39,6 +39,24 @@ reuse is a locality property even before copy-on-write sharing lands;
 ROADMAP item 3). A preferred replica with no headroom spills to the
 least-loaded one: affinity is a hint, never a hot spot.
 
+**Crash tolerance** (ISSUE 16): replicas are mortal. The control loop
+classifies a replica dead on engine-thread death (``Replica.error``),
+on a stuck-iteration watchdog (no engine step progress past a
+``deadline.Budget`` while work is in flight), or when the autoscaler
+reports its claim vanished. Every dispatch is journaled
+(:class:`~tpu_dra.serving.faults.DispatchJournal`), so a dead
+replica's in-flight sequences are reconstructed WITHOUT the engine's
+cooperation and re-dispatched to survivors at their tenants' queue
+front — token-identical under greedy, and token-identical under
+sampling via the journaled per-request ``(seed, serial)`` schedule.
+Containment: re-dispatches carry jittered exponential backoff, a
+crash-looping claim's circuit opens
+(:class:`~tpu_dra.serving.faults.CircuitBreaker`) so the autoscaler
+replaces it instead of hot re-binding, and lost capacity degrades
+admission gracefully — the backlog cap scales down by the owed
+fraction, so BATCH sheds at the door first (``fabric_shed_total{cls=}``
+counts it, ``fabric_degraded`` gauges it for fleetmon).
+
 Threading contract: ``submit()`` may be called from any thread (the
 open-loop trace threads); ``poll()`` and everything the autoscaler
 calls run on ONE control thread; each :class:`Replica` owns the only
@@ -51,6 +69,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import hashlib
+import logging
 import threading
 import time
 import zlib
@@ -58,8 +77,16 @@ from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
-from tpu_dra.infra import trace
+from tpu_dra.infra import deadline, trace
+from tpu_dra.serving.faults import (
+    CircuitBreaker,
+    DispatchJournal,
+    ReplicaFault,
+    redispatch_backoff,
+)
 from tpu_dra.workloads.engine import Completion, Evacuated, Request
+
+log = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -109,6 +136,25 @@ class RouterConfig:
     # Prompt tokens digested into the affinity key when the request
     # has no session id (one shared system prompt -> one replica).
     affinity_prefix_tokens: int = 16
+    # --- crash tolerance (ISSUE 16) ---
+    # Stuck-iteration watchdog: a replica with in-flight work whose
+    # engine step-progress counter stands still this long is declared
+    # dead (hung device call, wedged thread). deadline.Budget-backed.
+    stall_deadline_seconds: float = 5.0
+    # Circuit breaker: this many deaths of one claim inside the window
+    # opens its circuit — the router stops routing to it and the
+    # autoscaler REPLACES the claim instead of hot re-binding.
+    breaker_deaths: int = 3
+    breaker_window_seconds: float = 30.0
+    # Jittered exponential backoff before a dead replica's sequence is
+    # re-dispatched (a poisoned request must not hot-loop survivors).
+    redispatch_backoff_base_seconds: float = 0.05
+    redispatch_backoff_cap_seconds: float = 2.0
+    # Replica.stop() join timeout: a wedged engine thread past this is
+    # logged + counted (fabric_replica_stop_timeouts_total) and the
+    # replica left in the dead state instead of silently blocking the
+    # control thread for 30s while pretending it stopped.
+    replica_join_timeout_seconds: float = 30.0
 
 
 @dataclasses.dataclass
@@ -139,6 +185,7 @@ class _FabricReq:
         "rid", "tenant", "prompt", "max_new", "session", "cost",
         "start_tag", "finish_tag", "t_submit", "t_first", "emitted",
         "replicas", "trace_ctx", "t_dispatch", "prefix_key",
+        "sample_seed", "sample_serial", "retries", "not_before",
     )
 
     def __init__(self, rid, tenant, prompt, max_new, session, cost):
@@ -164,6 +211,15 @@ class _FabricReq:
         # prefix-sharing id (ISSUE 15), stamped at dispatch only once
         # the prefix has proven popular (>= 2 submissions).
         self.prefix_key: Optional[str] = None
+        # Sampling schedule (ISSUE 16): serial assigned at submit (the
+        # router's counter, engine-independent so it survives replica
+        # death); seed captured from the first engine dispatched to.
+        self.sample_seed: Optional[int] = None
+        self.sample_serial: Optional[int] = None
+        # Re-dispatch containment: death-recovery retry count and the
+        # earliest clock time the next dispatch may run (backoff).
+        self.retries = 0
+        self.not_before = 0.0
 
     @property
     def remaining(self) -> int:
@@ -189,11 +245,12 @@ class Replica:
     ``take_evacuated``) the autoscaler's scale-down drives."""
 
     def __init__(self, name: str, engine, claim_name: str = "",
-                 claim: Optional[dict] = None):
+                 claim: Optional[dict] = None, metrics=None):
         self.name = name
         self.engine = engine
         self.claim_name = claim_name
         self.claim = claim
+        self.metrics = metrics
         self.quiesced = False  # router stops dispatching; engine drains
         # Mid-repack (ISSUE 12): the repacker owns this replica's fate;
         # the autoscaler must not pick it as a scale-down victim (the
@@ -201,6 +258,16 @@ class Replica:
         # defrag into an outage).
         self.migrating = False
         self.error: Optional[BaseException] = None  # engine-thread death
+        # Dead state (ISSUE 16): set by Router.mark_dead (crash / stall
+        # / claim-vanished) or by a stop() join timeout. A dead replica
+        # is out of the routing set; its thread may still be wedged.
+        self.dead = False
+        self.death_reason = ""
+        # Watchdog state, control-thread-owned: the engine progress
+        # value last seen and the deadline budget it must beat.
+        self.last_progress: Optional[int] = None
+        self.watchdog: Optional[deadline.Budget] = None
+        self._fault: Optional[str] = None  # chaos injection seam
         self.outbox: Deque[Completion] = collections.deque()
         self.inflight: Dict[str, _FabricReq] = {}  # router-thread-owned
         self._evac_request = threading.Event()
@@ -216,12 +283,49 @@ class Replica:
         )
         self._thread.start()
 
-    def stop(self) -> None:
+    def signal_stop(self) -> None:
+        """Ask the engine thread to exit WITHOUT joining: the control
+        loop must not block on a thread that may be wedged (that is the
+        exact failure being contained). The autoscaler joins later with
+        a bounded timeout via :meth:`stop`."""
         self._stop.set()
         self._wake.set()
+
+    def stop(self, timeout: Optional[float] = None) -> bool:
+        """Stop the engine thread; returns True if it actually exited
+        within ``timeout`` seconds. A join timeout no longer hangs
+        silently: it is logged, counted
+        (``fabric_replica_stop_timeouts_total``), and the replica is
+        left in the dead state instead of pretending it stopped."""
+        if timeout is None:
+            timeout = 30.0
+        self._stop.set()
+        self._wake.set()
+        joined = True
         if self._thread is not None:
-            self._thread.join(timeout=30)
+            self._thread.join(timeout=timeout)
+            joined = not self._thread.is_alive()
+        if not joined:
+            log.warning(
+                "replica %s: engine thread did not stop within %.1fs "
+                "(wedged); leaving it dead", self.name, timeout,
+            )
+            self.dead = True
+            if not self.death_reason:
+                self.death_reason = "stop-timeout"
+            if self.metrics is not None:
+                self.metrics.inc("fabric_replica_stop_timeouts_total")
         self.engine.close()
+        return joined
+
+    def inject_fault(self, kind: str) -> None:
+        """Chaos seam (ISSUE 16): arm a fault the engine thread trips
+        before its next step. ``"crash"`` raises :class:`ReplicaFault`
+        out of the loop (the hard-death path); ``"stall"`` wedges the
+        thread — it stops stepping and produces no progress, exactly
+        what the router's stuck-iteration watchdog exists to catch."""
+        self._fault = kind  # lint: disable=R200 (one-shot flag handoff: single writer arms, the engine thread consumes-and-clears; a GIL-atomic attribute store is the whole protocol)
+        self._wake.set()
 
     def submit(self, req: Request) -> None:
         self.engine.add_request(req)
@@ -247,6 +351,19 @@ class Replica:
     def _loop(self) -> None:
         try:
             while not self._stop.is_set():
+                fault = self._fault
+                if fault == "crash":
+                    self._fault = None  # lint: disable=R200 (consume side of the inject_fault one-shot flag handoff)
+                    raise ReplicaFault(
+                        f"chaos: injected crash on replica {self.name}"
+                    )
+                if fault == "stall":
+                    # A wedged engine: no steps, no outbox drain, no
+                    # progress — only the stop flag gets it out. The
+                    # router's watchdog must detect this on its own.
+                    while not self._stop.is_set():
+                        time.sleep(0.005)
+                    break
                 if self._evac_request.is_set():
                     # Runs ON the engine thread between steps: evacuate
                     # is a host-side drain, never concurrent with a
@@ -260,9 +377,17 @@ class Replica:
                 if not busy:
                     self._wake.wait(0.002)
                     self._wake.clear()
+        except ReplicaFault as e:
+            # Injected (chaos) death: expected and recovered — record
+            # it for the control loop's reaper without the traceback
+            # noise a re-raise through the thread excepthook produces.
+            self.error = e
         except BaseException as e:  # noqa: BLE001 — surfaced to control
             # A dead engine thread must not look like a stuck queue:
-            # the control loop checks .error and fails loudly.
+            # the control loop checks .error, journals the replica's
+            # in-flight sequences onto survivors, and keeps serving
+            # (ISSUE 16 — the old behavior here was to fail loudly and
+            # take every tenant down with one bad replica).
             self.error = e
             raise
 
@@ -296,6 +421,31 @@ class Router:
         self._vtime = 0.0
         self._lock = threading.Lock()  # guards WFQ state vs submit()
         self.completions: Dict[str, FabricCompletion] = {}
+        # --- crash tolerance (ISSUE 16), control-thread-owned ---
+        self.journal = DispatchJournal()
+        self.breaker = CircuitBreaker(
+            max_deaths=self.config.breaker_deaths,
+            window_seconds=self.config.breaker_window_seconds,
+            clock=clock,
+        )
+        # Dead replicas parked for the autoscaler (take_dead): it joins
+        # their threads with a bounded timeout and decides re-bind vs
+        # quarantine+replace.
+        self.dead_replicas: List[Replica] = []
+        self.deaths = 0
+        self.death_log: List[tuple] = []  # (name, reason, t)
+        self.redispatched = 0
+        self.duplicates_dropped = 0
+        # Replicas owed: died and not yet replaced. While > 0 the
+        # admission cap scales down by live/(live+owed), so BATCH sheds
+        # at the door first (graceful degradation, not a cliff).
+        self._capacity_owed = 0
+        self.shed: Dict[str, int] = {}  # per-SLO-class shed counts
+        # Router-level sampling serial: engine-independent, assigned at
+        # submit, journaled at dispatch — a re-dispatched SAMPLED
+        # sequence pins it so the new engine replays the same
+        # (seed, serial, position) key schedule.
+        self._sample_serial = 0
         self._in_system = 0
         self.peak_concurrent = 0
         self._backlog_tokens = 0.0  # queued + inflight costs
@@ -324,6 +474,12 @@ class Router:
 
     def add_replica(self, rep: Replica) -> None:
         self.replicas.append(rep)  # lint: disable=R200 (replica-set mutation is control-thread-only by the module's threading contract; submit() threads never touch it)
+        if self._capacity_owed > 0:
+            # Capacity restored (re-bind or replacement claim): the
+            # degradation factor recovers with it. Written under the
+            # lock because submit() reads it for the admission ceiling.
+            with self._lock:
+                self._capacity_owed -= 1
         self._export()
 
     def remove_replica(self, rep: Replica) -> None:
@@ -332,6 +488,13 @@ class Router:
 
     def live_replicas(self) -> List[Replica]:
         return [r for r in self.replicas if not r.quiesced]
+
+    def take_dead(self) -> List[Replica]:
+        """Hand the parked dead replicas to the autoscaler (which joins
+        their threads with a bounded timeout and re-binds or replaces
+        their claims); clears the parking list."""
+        out, self.dead_replicas = self.dead_replicas, []  # lint: disable=R200 (control-thread-only: poll() parks corpses, the autoscaler tick — same thread by contract — takes them)
+        return out
 
     # --- intake ---
 
@@ -348,6 +511,16 @@ class Router:
             ceiling = (
                 ts.spec.slo.admit_frac * self.config.backlog_cap_tokens
             )
+            owed = self._capacity_owed
+            if owed > 0:
+                # Graceful degradation (ISSUE 16): dead-but-unreplaced
+                # replicas shrink the effective cap by the lost
+                # fraction, so tier ceilings bite sooner and BATCH
+                # (admit_frac 0.6) sheds at the door FIRST while
+                # INTERACTIVE keeps admitting — capacity loss degrades
+                # the deferrable traffic, never a hard outage.
+                live = len(self.replicas)  # lint: disable=R200 (len() of the atomically-swapped list; submit threads read, control thread swaps)
+                ceiling *= live / float(live + owed)
             if self._backlog_tokens + cost > ceiling:
                 ts.rejected += 1
                 if self.metrics is not None:
@@ -355,6 +528,13 @@ class Router:
                         "fabric_rejected_total",
                         labels={"tenant": tenant},
                     )
+                if owed > 0:
+                    cls = ts.spec.slo.name
+                    self.shed[cls] = self.shed.get(cls, 0) + 1
+                    if self.metrics is not None:
+                        self.metrics.inc(
+                            "fabric_shed_total", labels={"cls": cls}
+                        )
                 return False
             fr = _FabricReq(
                 req.rid, tenant, np.asarray(req.prompt, np.int32),
@@ -374,6 +554,8 @@ class Router:
                     self._prefix_seen.popitem(last=False)
                 fr.prefix_key = pkey
             fr.t_submit = self.clock()
+            self._sample_serial += 1
+            fr.sample_serial = self._sample_serial
             fr.start_tag = max(self._vtime, ts.tail_tag)
             fr.finish_tag = fr.start_tag + cost / ts.spec.weight
             ts.tail_tag = fr.finish_tag
@@ -386,16 +568,170 @@ class Router:
     # --- control loop ---
 
     def poll(self) -> bool:
-        """One control-loop pass: collect completions, dispatch from
-        the WFQ into replicas with headroom, export gauges. Returns
-        True when any work moved."""
-        moved = self._collect()
+        """One control-loop pass: reap dead replicas (journal-recover
+        their in-flight work), collect completions, dispatch from the
+        WFQ into replicas with headroom, export gauges. Returns True
+        when any work moved. A replica death never raises out of here —
+        it is detected, contained, and recovered (ISSUE 16)."""
+        moved = self._reap()
+        moved = self._collect() or moved
         moved = self._dispatch() or moved
         now = self.clock()
         if now - self._last_export >= self._export_period:
             self._last_export = now
             self._export()
         return moved
+
+    # --- failure detection + journal recovery (ISSUE 16) ---
+
+    def _reap(self) -> bool:
+        """Detection: engine-thread death (``Replica.error``) and the
+        stuck-iteration watchdog (no step progress past the deadline
+        while work is in flight). Claim-vanished detection lives in the
+        autoscaler (it owns the claim store) and calls
+        :meth:`mark_dead` with reason ``"claim-vanished"``."""
+        moved = False
+        for rep in list(self.replicas):
+            if rep.error is not None and not rep.dead:
+                self.mark_dead(rep, "crash")
+                moved = True
+            elif self._stalled(rep):
+                self.mark_dead(rep, "stall")
+                moved = True
+        return moved
+
+    def _stalled(self, rep: Replica) -> bool:
+        prog = getattr(rep.engine, "progress", None)
+        if prog is None or rep.quiesced or not rep.inflight:
+            # No heartbeat source (stub engines), draining, or idle:
+            # nothing to watchdog. Drop any armed budget so an idle
+            # stretch never counts against the next burst.
+            rep.watchdog = None
+            return False
+        if rep.watchdog is None or prog != rep.last_progress:
+            rep.last_progress = prog
+            rep.watchdog = deadline.Budget(
+                timeout=self.config.stall_deadline_seconds,
+                name=f"replica-{rep.name}-progress",
+            )
+            return False
+        return rep.watchdog.expired()
+
+    def mark_dead(self, rep: Replica, reason: str) -> int:
+        """Classify ``rep`` dead, recover its in-flight sequences from
+        the dispatch journal, and park it for the autoscaler. Returns
+        how many sequences were re-queued. Idempotent per replica."""
+        if rep.dead:
+            return 0
+        now = self.clock()
+        rep.dead = True
+        rep.quiesced = True
+        rep.death_reason = reason
+        # Never join here: the control thread must not block on a
+        # possibly-wedged thread. The autoscaler joins with a bounded
+        # timeout when it takes the corpse.
+        rep.signal_stop()
+        self.deaths += 1
+        self.death_log.append((rep.name, reason, now))
+        key = rep.claim_name or rep.name
+        opened = self.breaker.record_death(key)
+        # Sequences that FINISHED before the death are sitting in the
+        # outbox — collect them first so the journal replay covers
+        # exactly the in-flight set (zero duplicates).
+        self._collect()
+        n = self._reclaim(rep, now)
+        self.replicas = [r for r in self.replicas if r is not rep]  # lint: disable=R200 (control-thread-only, same contract as add_replica)
+        self.dead_replicas.append(rep)  # lint: disable=R200 (control-thread-only parking list, same contract as take_dead)
+        with self._lock:
+            # submit() reads the owed count for the degraded ceiling.
+            self._capacity_owed += 1
+        if self.metrics is not None:
+            self.metrics.inc(
+                "fabric_replica_deaths_total", labels={"reason": reason}
+            )
+            if n:
+                self.metrics.inc("fabric_redispatched_total", float(n))
+            if opened:
+                self.metrics.inc("fabric_circuit_opened_total")
+        log.warning(
+            "replica %s dead (%s): %d in-flight sequences recovered "
+            "from the journal%s", rep.name, reason, n,
+            "; circuit OPEN" if opened else "",
+        )
+        self._export()
+        return n
+
+    def _reclaim(self, rep: Replica, now: float) -> int:
+        """Journal recovery: rebuild every sequence the dead replica
+        still held and splice it at the FRONT of its tenant's queue
+        (its virtual cost was charged at first dispatch — re-entry is
+        free), with jittered backoff gating the re-dispatch."""
+        n = 0
+        for rid in list(rep.inflight):
+            rep.inflight.pop(rid)
+            e = self.journal.get(rid)
+            if e is None or self.journal.is_closed(rid):
+                continue  # completed (collected above) or never journaled
+            fr = self._from_journal(e)
+            fr.retries += 1
+            fr.not_before = now + redispatch_backoff(
+                fr.retries,
+                self.config.redispatch_backoff_base_seconds,
+                self.config.redispatch_backoff_cap_seconds,
+                fr.rid,
+            )
+            ts = self._tenants[fr.tenant]
+            with self._lock:
+                fr.start_tag = fr.finish_tag = self._vtime
+                ts.queue.appendleft(fr)
+                self._inflight_tokens -= fr.cost
+                self.redispatched += 1
+            n += 1
+        return n
+
+    def _from_journal(self, e) -> _FabricReq:
+        """A fresh _FabricReq carrying everything the journal knows —
+        the dead engine contributes nothing."""
+        fr = _FabricReq(
+            e.rid, e.tenant, e.prompt, e.max_new, e.session, e.cost
+        )
+        fr.emitted = np.asarray(e.emitted, np.int32)
+        fr.t_submit = e.t_submit
+        fr.t_first = e.t_first
+        fr.t_dispatch = e.t_dispatch
+        fr.replicas = list(e.replicas)
+        fr.sample_seed = e.sample_seed
+        fr.sample_serial = e.sample_serial
+        fr.retries = e.retries
+        if e.trace_ctx is not None:
+            fr.trace_ctx = e.trace_ctx
+        return fr
+
+    def recover_from_journal(self, journal: DispatchJournal) -> int:
+        """Crash-matrix restart path: a NEW router adopts a restored
+        journal — every open entry re-enters its tenant's queue front
+        (first-dispatch order), accounting is rebuilt, and closed rids
+        stay closed so replay is exactly-once. Returns the number of
+        sequences re-queued."""
+        self.journal = journal
+        n = 0
+        # appendleft inverts order: walk newest-first so the oldest
+        # dispatch lands at the queue head.
+        for e in reversed(journal.open_entries()):
+            if e.tenant not in self._tenants:
+                continue
+            fr = self._from_journal(e)
+            fr.retries += 1
+            ts = self._tenants[fr.tenant]
+            with self._lock:
+                fr.start_tag = fr.finish_tag = self._vtime
+                ts.queue.appendleft(fr)
+                self._backlog_tokens += fr.cost
+                self._in_system += 1
+            n += 1
+        with self._lock:
+            self.redispatched += n
+        return n
 
     @property
     def busy(self) -> bool:
@@ -420,10 +756,15 @@ class Router:
 
     # --- WFQ dispatch ---
 
-    def _next_tenant(self) -> Optional[_TenantState]:
+    def _next_tenant(self, now: float) -> Optional[_TenantState]:
         best = None
         for ts in self._tenants.values():
             if not ts.queue:
+                continue
+            if ts.queue[0].not_before > now:
+                # Re-dispatch backoff (ISSUE 16): this head is cooling
+                # off after its replica died; skip the tenant this pass
+                # rather than busy-spin the poisoned request.
                 continue
             if best is None or (
                 ts.queue[0].finish_tag < best.queue[0].finish_tag
@@ -438,7 +779,13 @@ class Router:
         return hashlib.sha1(prefix.tobytes()).hexdigest()
 
     def _pick_replica(self, fr: _FabricReq) -> Optional[Replica]:
-        live = self.live_replicas()
+        # An open circuit quarantines the claim: no routing to any
+        # replica bound to it until the autoscaler replaces it (or the
+        # deaths age out of the breaker window).
+        live = [
+            r for r in self.live_replicas()
+            if not self.breaker.is_open(r.claim_name or r.name)
+        ]
         if not live:
             return None
         cap = self.config.max_inflight_per_replica
@@ -462,7 +809,7 @@ class Router:
         moved = False
         while True:
             with self._lock:
-                ts = self._next_tenant()
+                ts = self._next_tenant(self.clock())
                 if ts is None:
                     break
                 fr = ts.queue[0]
@@ -507,6 +854,17 @@ class Router:
             )
             rep.inflight[fr.rid] = fr
             fr.replicas.append(rep.name)
+            if fr.sample_seed is None:
+                # The engine-wide seed, captured at FIRST dispatch:
+                # with the router-assigned serial it is the journaled
+                # sampling schedule a cross-replica resume pins.
+                fr.sample_seed = getattr(
+                    getattr(rep.engine, "ec", None), "sample_seed", None
+                )
+            # Write-ahead: the journal entry must cover this dispatch
+            # BEFORE the engine can touch the request — a death at any
+            # later point finds everything needed to rebuild.
+            self.journal.record(fr, rep.name)
             # Prefix sharing (ISSUE 15): stamp the engine's COW fields
             # once the prefix digest is popular (>= 2 submissions). The
             # digest is over fr.prompt — a resumed sequence's folded
@@ -530,6 +888,11 @@ class Router:
                         self.config.affinity_prefix_tokens,
                         len(fr.prompt),
                     ) if popular else 0,
+                    # Pin the journaled sampling schedule: a sampled
+                    # sequence resumed on ANY replica replays the same
+                    # (seed, serial, position) keys (ISSUE 16).
+                    sample_seed=fr.sample_seed,
+                    sample_serial=fr.sample_serial,
                 ))
             moved = True
         return moved
@@ -539,7 +902,18 @@ class Router:
         for rep in self.replicas:
             while rep.outbox:
                 c = rep.outbox.popleft()
-                fr = rep.inflight.pop(c.rid)
+                fr = rep.inflight.pop(c.rid, None)
+                if fr is None or fr.rid in self.completions:
+                    # Not ours anymore: the rid was journal-recovered
+                    # onto another replica (or already completed there)
+                    # after this engine raced its completion out.
+                    # Exactly-once means the LATE copy is dropped.
+                    self.duplicates_dropped += 1
+                    if self.metrics is not None:
+                        self.metrics.inc(
+                            "fabric_duplicates_dropped_total"
+                        )
+                    continue
                 tokens = (
                     np.concatenate([fr.emitted, c.tokens])
                     if len(fr.emitted) else np.asarray(c.tokens)
@@ -589,6 +963,7 @@ class Router:
                     self._backlog_tokens -= fr.cost
                     self._inflight_tokens -= fr.cost
                     self._in_system -= 1
+                self.journal.close(fr.rid)
                 moved = True
         return moved
 
@@ -612,6 +987,9 @@ class Router:
                 fr.emitted = np.concatenate([fr.emitted, ev.emitted])
             if fr.t_first is None:
                 fr.t_first = ev.t_first
+            # Keep the journal's emitted-so-far current: a death after
+            # this drain must not replay tokens the drain preserved.
+            self.journal.note_progress(fr.rid, fr.emitted, fr.t_first)
             t_evac = self.clock()
             ts = self._tenants[fr.tenant]
             with self._lock:
@@ -657,6 +1035,19 @@ class Router:
             m.set_gauge("fabric_backlog_tokens", self._backlog_tokens)
             m.set_gauge("fabric_in_system_sequences", self._in_system)
             m.set_gauge("fabric_replicas", len(self.live_replicas()))
+            # Degradation fraction (ISSUE 16): owed/(live+owed) — 0 in
+            # a healthy fabric, climbing toward 1 as deaths outpace
+            # replacement. fleetmon burn-rates it (fabric-degraded).
+            owed = self._capacity_owed
+            live = len(self.replicas)
+            m.set_gauge(
+                "fabric_degraded",
+                owed / float(live + owed) if owed else 0.0,
+            )
+            m.set_gauge(
+                "fabric_circuit_open",
+                float(len(self.breaker.open_keys())),
+            )
             for name, ts in self._tenants.items():
                 # Starvation lag (weighted tokens): how far the fabric
                 # clock ran past a backlogged tenant's head turn. Near

@@ -152,6 +152,18 @@ FABRIC_LAG_GAUGE = "fabric_tenant_vtime_lag"
 FABRIC_LAG_WARN_TOKENS = 1024.0
 FABRIC_FLAP_COUNTER = "fabric_autoscaler_flaps_total"
 FABRIC_REPLICAS_GAUGE = "fabric_replicas"
+# Crash-tolerance signals (ISSUE 16). fabric_replica_deaths_total{
+# reason=crash|stall|claim-vanished} counts control-loop death
+# classifications; fabric_circuit_open is the number of QUARANTINED
+# claims (the breaker stopped routing to a crash-looper and the
+# autoscaler owes a replacement); fabric_in_system_sequences against
+# fabric_replicas == 0 is the live-capacity-vs-admitted-load check —
+# admitted user state with nothing left to run it on is an outage, not
+# a warning.
+FABRIC_DEATHS_COUNTER = "fabric_replica_deaths_total"
+FABRIC_CIRCUIT_GAUGE = "fabric_circuit_open"
+FABRIC_DEGRADED_GAUGE = "fabric_degraded"
+FABRIC_INSYSTEM_GAUGE = "fabric_in_system_sequences"
 
 # Elastic-repacker gauges (ISSUE 12), suffix-matched like the others.
 # repacker_frag_score is the fleet fragmentation the repacker itself
@@ -539,13 +551,35 @@ def _check_fabric(
     per-tenant WFQ starvation and autoscaler flapping. Like the
     workqueue check, starvation needs TWO samples to warn decisively —
     a large lag that is DRAINING is a recovering fabric, not a sick
-    one; a single sample past the threshold asks for a re-probe."""
+    one; a single sample past the threshold asks for a re-probe.
+    ISSUE 16 adds the crash-tolerance checks: replica deaths (growth
+    over the interval means replicas are dying right now), quarantined
+    claims (the breaker opened — the autoscaler owes a replacement),
+    and the live-capacity-vs-admitted-load outage check."""
     out: Dict[str, object] = {}
     sample = second if second is not None else first
     lags: Dict[str, Dict[str, float]] = {}
+    deaths = 0.0
+    deaths_grew = 0.0
+    in_system = 0.0
     for series, value in sorted(sample.items()):
         name = series.split("{", 1)[0]
-        if name.endswith(FABRIC_REPLICAS_GAUGE):
+        if name.endswith(FABRIC_DEATHS_COUNTER):
+            # Labeled by reason= — sum the series for the headline,
+            # keep the per-reason split for the render line.
+            deaths += value
+            if second is not None:
+                deaths_grew += value - first.get(series, 0.0)
+            by = out.setdefault("deaths_by_reason", {})
+            by[_label_of(series, "reason")] = int(value)
+        elif name.endswith(FABRIC_CIRCUIT_GAUGE):
+            out["circuit_open"] = int(value)
+        elif name.endswith(FABRIC_DEGRADED_GAUGE):
+            out["degraded"] = value
+        elif name.endswith(FABRIC_INSYSTEM_GAUGE):
+            in_system = value
+            out["in_system"] = int(value)
+        elif name.endswith(FABRIC_REPLICAS_GAUGE):
             out["replicas"] = int(value)
         elif name.endswith(FABRIC_FLAP_COUNTER):
             out["flaps"] = int(value)
@@ -594,6 +628,41 @@ def _check_fabric(
                 f"cooldown_seconds (docs/operations.md, 'Serving "
                 f"fabric autoscaler')"
             )
+    # Crash-tolerance checks (ISSUE 16).
+    if deaths:
+        out["deaths"] = int(deaths)
+        if deaths_grew > 0:
+            warn(
+                f"{ep}: fabric replicas DYING — "
+                f"{FABRIC_DEATHS_COUNTER} climbed by {deaths_grew:g} "
+                f"over the probe interval (total {deaths:g}, by reason "
+                f"{out.get('deaths_by_reason')}). The journal re-queues "
+                f"their in-flight sequences, but sustained deaths mean "
+                f"a sick node, a poisoned model rev, or a watchdog "
+                f"deadline tighter than the engine's real step time "
+                f"(docs/serving.md, 'Failure semantics')"
+            )
+    circuit = int(out.get("circuit_open", 0) or 0)
+    if circuit:
+        warn(
+            f"{ep}: {circuit} claim(s) QUARANTINED — the circuit "
+            f"breaker saw repeated deaths inside one window and "
+            f"stopped routing to them. The autoscaler deletes the "
+            f"claim and requests a packer-placed replacement; if the "
+            f"replacement loops too, the fault travels with the "
+            f"workload or the node pool, not the claim — check the "
+            f"node's chip health and the replica's last death reasons "
+            f"(docs/serving.md, 'Failure semantics')"
+        )
+    if out.get("replicas") == 0 and in_system > 0:
+        warn(
+            f"{ep}: ERROR — live capacity below admitted load: 0 live "
+            f"replicas with {in_system:g} admitted sequence(s) in the "
+            f"system. Nothing can serve the journaled backlog until a "
+            f"replacement claim binds; check the autoscaler's pending "
+            f"claim, the scheduler's placement feasibility, and the "
+            f"quarantine list (docs/serving.md, 'Failure semantics')"
+        )
     return out
 
 
@@ -1143,6 +1212,19 @@ def render(report: dict) -> str:
             parts = []
             if "replicas" in fabric:
                 parts.append(f"replicas={fabric['replicas']}")
+            if "deaths" in fabric:
+                by = fabric.get("deaths_by_reason") or {}
+                split = ",".join(
+                    f"{k}:{v}" for k, v in sorted(by.items())
+                )
+                parts.append(
+                    f"deaths={fabric['deaths']}"
+                    + (f"({split})" if split else "")
+                )
+            if fabric.get("circuit_open"):
+                parts.append(f"circuit_open={fabric['circuit_open']}")
+            if fabric.get("degraded"):
+                parts.append(f"degraded={fabric['degraded']:g}")
             if "flaps" in fabric:
                 parts.append(f"flaps={fabric['flaps']}")
             for series, st in sorted(

@@ -542,6 +542,24 @@ def builtin_catalog(
                 "(docs/operations.md, 'Control-plane outages')"
             ),
         ),
+        slo.SLOSpec(
+            name="fabric-degraded",
+            description="serving fabric capacity-loss minutes",
+            kind="threshold",
+            series="fabric_degraded",
+            threshold=0.0, op="le", budget=0.01,
+            window_s=window_s, policy=policy,
+            remediation=(
+                "the serving fabric is running below its owed replica "
+                "count — replicas died faster than replacements bound, "
+                "and BATCH-class admissions are being shed at the "
+                "door. Check fabric_replica_deaths_total by reason "
+                "(doctor's fabric section), fabric_circuit_open for "
+                "quarantined claims awaiting packer-placed "
+                "replacements, and the autoscaler's pending claim "
+                "(docs/serving.md, 'Failure semantics')"
+            ),
+        ),
     ]
     for cls, target_s in sorted(ttft.items()):
         catalog.append(slo.SLOSpec(

@@ -192,6 +192,18 @@ class Request:
     # them explicitly. 0 / None = no sharing.
     prefix_id: "str | None" = None
     prefix_len: int = 0
+    # Sampling-schedule pinning (ISSUE 16): the fabric router journals
+    # each request's (seed, serial) so a SAMPLED sequence re-dispatched
+    # to a different replica resumes with the exact key schedule the
+    # dead engine was using — PR-8's position-keyed folding makes the
+    # serial + position the whole schedule. ``sample_serial`` overrides
+    # the engine's admission serial in the sampling key only (admission
+    # order still breaks drain ties); ``sample_seed`` is an ASSERTION —
+    # the seed is an engine-wide traced scalar, so an engine refuses a
+    # request pinned to a different seed rather than silently forking
+    # the trajectory. None = engine defaults (unpinned).
+    sample_seed: "int | None" = None
+    sample_serial: "int | None" = None
 
 
 @dataclasses.dataclass
@@ -240,11 +252,16 @@ class _Sequence:
     __slots__ = (
         "req", "context", "out", "slot", "pages", "reserved_left",
         "prefill_cursor", "prefill_done", "t_submit", "t_first", "drains",
-        "serial",
+        "serial", "sample_serial",
     )
 
     def __init__(self, req: Request, t_submit: float, serial: int = 0):
         self.serial = serial  # admission order; breaks t_submit ties
+        # The sampling-key serial: the caller's pinned schedule when
+        # set (cross-replica resume), else the admission serial.
+        self.sample_serial = (
+            req.sample_serial if req.sample_serial is not None else serial
+        )
         self.req = req
         # The tokens to (re-)prefill: the prompt, plus — after a
         # backpressure drain — everything emitted so far.
@@ -555,6 +572,19 @@ class Engine:
         # engine hang, so refuse it at the door (O(1) set lookup).
         if req.rid in self._rids:
             raise ValueError(f"duplicate request rid {req.rid!r}")
+        # A pinned sampling schedule is only reproducible on an engine
+        # sharing the pinned seed (the seed is engine-wide; the serial
+        # is per-request). Refuse a mismatch loudly — silently sampling
+        # under a different seed would fork the trajectory the caller
+        # journaled.
+        if (
+            req.sample_seed is not None
+            and req.sample_seed != self.ec.sample_seed
+        ):
+            raise ValueError(
+                f"request {req.rid}: pinned sample_seed "
+                f"{req.sample_seed} != engine seed {self.ec.sample_seed}"
+            )
         self._rids.add(req.rid)
         total = (
             len(req.prompt) + req.max_new_tokens + self.ec.scan_chunk
@@ -576,6 +606,15 @@ class Engine:
         return bool(
             self._queue or self._prefilling or any(self._slots)
         )
+
+    @property
+    def progress(self) -> int:
+        """Monotonic step-progress heartbeat: bumps on every admission,
+        prefill chunk, and decode chunk that moved work. The fabric's
+        stuck-iteration watchdog (ISSUE 16) declares a replica dead
+        when this stands still past a deadline while work is in
+        flight."""
+        return self._progress
 
     def step(self) -> bool:
         """One engine iteration: gate check (drain on backpressure),
@@ -635,10 +674,11 @@ class Engine:
         resume on another replica by prefilling ``prompt + emitted`` —
         no sequence lost, no token re-emitted (under greedy decoding a
         resumed continuation is token-identical to the uninterrupted
-        run; sampled trajectories are only preserved WITHIN one engine,
-        whose (seed, serial, position) key schedule a new replica does
-        not share). rids are forgotten, so a sequence may later be
-        resubmitted to this same engine."""
+        run; a SAMPLED trajectory survives the move too when the caller
+        pins the journaled schedule via ``Request.sample_seed`` /
+        ``sample_serial`` — the (seed, serial, position) key is then
+        identical on the new replica). rids are forgotten, so a
+        sequence may later be resubmitted to this same engine."""
         self._drain(self.clock())
         out: List[Evacuated] = []
         while self._queue:
@@ -776,7 +816,7 @@ class Engine:
             seq.slot = slot
             seq.reserved_left = 0 if self.ec.contiguous else need
             self._slots[slot] = seq
-            self._seeds[slot] = seq.serial
+            self._seeds[slot] = seq.sample_serial
             self._dev_state = None
             self._prefilling.append(seq)
             self._progress += 1
@@ -1124,7 +1164,8 @@ class Engine:
         temperature, top_k = sampling
         key = jax.random.fold_in(
             jax.random.fold_in(
-                jax.random.PRNGKey(self.ec.sample_seed), seq.serial
+                jax.random.PRNGKey(self.ec.sample_seed),
+                seq.sample_serial,
             ),
             len(seq.context),
         )
