@@ -3,7 +3,7 @@
 IMAGE_REPO ?= registry.local/tpu-dra-driver
 IMAGE_TAG  ?= v0.1.0
 
-.PHONY: all native test test-slow bench decodebench allocbench enginebench specbench shardbench fleetbench fabricbench faultbench repackbench tracecheck slocheck image bats lint lint-fast shlint chaos crashmatrix apisoak ci clean
+.PHONY: all native test test-slow bench decodebench allocbench enginebench specbench shardbench fleetbench fabricbench faultbench disaggbench repackbench tracecheck slocheck image bats lint lint-fast shlint chaos crashmatrix apisoak ci clean
 
 all: native test
 
@@ -107,6 +107,23 @@ fabricbench:
 # (docs/serving.md, "Failure semantics").
 faultbench:
 	python -m tpu_dra.serving.faultbench --smoke
+
+# Disaggregated prefill/decode CPU smoke (ISSUE 17): phase-role
+# replica pools with live paged-KV migration — prefill replicas export
+# each sequence's block-table extent at prefill completion and decode
+# replicas incref-graft it, resuming WITHOUT recomputing a position.
+# Hard asserts on: token parity across migration vs an un-migrated
+# reference (greedy AND the journaled (seed, serial, position) sampled
+# schedule), at least one real shipped migration with leak-free
+# allocators on both pools, a kill at the migration boundary (decode
+# replica crashed with grafts in flight) losing/duplicating nothing
+# via journal re-prefill, and the measured colocated baseline shipping
+# ZERO migrations on the identical seeded prompt-heavy trace at equal
+# chips. The full configuration additionally gates disagg beating
+# colocated on BOTH TTFT p99 and ITL p99; it runs as
+# `bench.py --leg-disagg` (docs/serving.md, "Disaggregated serving").
+disaggbench:
+	python -m tpu_dra.serving.disaggbench --smoke
 
 # Elastic-repacker CPU smoke (ISSUE 12): churn strands the synthetic
 # fleet, the leader-elected repacker migrates residents without
@@ -244,7 +261,7 @@ shlint:
 # (flakes surface in CI, not in the judge's rerun), the 13 bats suites
 # executed against the minicluster, the batsless process-level e2e, and
 # the bench artifact schema gate.
-ci: lint lint-fast shlint native chaos crashmatrix apisoak decodebench allocbench enginebench specbench shardbench fleetbench fabricbench faultbench repackbench tracecheck slocheck
+ci: lint lint-fast shlint native chaos crashmatrix apisoak decodebench allocbench enginebench specbench shardbench fleetbench fabricbench faultbench disaggbench repackbench tracecheck slocheck
 	python -m pytest tests/ -q -m 'not slow'
 	python -m pytest tests/ -q -m 'not slow'
 	python -m pytest tests/test_chaos.py -q -m slow

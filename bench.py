@@ -682,6 +682,26 @@ def _leg_fault_main() -> int:
     return fault_main([])
 
 
+def _leg_disagg_main() -> int:
+    """Disaggregated prefill/decode leg (ISSUE 17): phase-role replica
+    pools with live paged-KV migration at prefill completion — the
+    handoff ships the sequence's block-table extent and incref-grafts
+    it into the decode replica's allocator instead of re-prefilling.
+    Measures colocated vs disaggregated on the identical seeded
+    prompt-heavy trace at equal chips (TTFT p99 AND ITL p99 must both
+    win in full mode; DISAGG_ALLOW_GAP=1 on CPU drill sizes), with
+    token parity across migration (greedy + sampled) and a
+    kill-at-the-migration-boundary drill asserted inside the bench.
+    Engines pinned to CPU like the fabric leg — this measures the
+    phase split and migration machinery, not per-chip speed
+    (tpu_dra/serving/disaggbench.py; methodology: docs/serving.md
+    'Disaggregated serving')."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from tpu_dra.serving.disaggbench import main as disagg_main
+
+    return disagg_main([])
+
+
 def _leg_repack_main() -> int:
     """Elastic-repacker leg (ISSUE 12): the autonomous defragmenter
     over the synthetic fleet — a serving drill where churn strands a
@@ -1594,6 +1614,8 @@ def main() -> int:
         return _leg_fabric_main()
     if "--leg-fault" in sys.argv:
         return _leg_fault_main()
+    if "--leg-disagg" in sys.argv:
+        return _leg_disagg_main()
     if "--leg-repack" in sys.argv:
         return _leg_repack_main()
     if "--leg-rotate" in sys.argv:
@@ -1713,6 +1735,28 @@ def main() -> int:
         f"replaced {fault['fault_claims_replaced']}; token identity "
         f"greedy={fault['fault_greedy_identical']} "
         f"sampled={fault['fault_sampled_identical']}",
+        file=sys.stderr,
+    )
+
+    # Disaggregated prefill/decode leg (ISSUE 17): CPU-side like the
+    # fabric leg, own process (its two replica fleets must not share an
+    # interpreter with the TPU legs).
+    disagg = _run_leg({}, flag="--leg-disagg")
+    print(
+        f"disagg ({disagg['disagg_replicas']} replicas, "
+        f"{disagg['disagg_prefill_replicas']} prefill / "
+        f"{disagg['disagg_replicas'] - disagg['disagg_prefill_replicas']}"
+        f" decode, {disagg['disagg_requests']} requests): ttft p99 "
+        f"{disagg['disagg_ttft_p99_ms']} ms vs colocated "
+        f"{disagg['disagg_colocated_ttft_p99_ms']} ms "
+        f"(x{disagg['disagg_vs_colocated_ttft']}); itl p99 "
+        f"{disagg['disagg_itl_p99_ms']} ms vs "
+        f"{disagg['disagg_colocated_itl_p99_ms']} ms "
+        f"(x{disagg['disagg_vs_colocated_itl']}); "
+        f"{disagg['disagg_kv_migrations']} shipped migrations "
+        f"({disagg['disagg_kv_migrated_pages']} pages, p50 "
+        f"{disagg['disagg_migration_p50_ms']} ms, "
+        f"{disagg['disagg_kv_migration_fallbacks']} fallbacks)",
         file=sys.stderr,
     )
 
@@ -2186,6 +2230,41 @@ def main() -> int:
                 ],
                 "fault_sampled_identical": fault[
                     "fault_sampled_identical"
+                ],
+                # Disaggregated prefill/decode leg (ISSUE 17):
+                # phase-role pools + live paged-KV migration, measured
+                # against the colocated baseline on the identical
+                # prompt-heavy trace at equal chips.
+                "disagg_replicas": disagg["disagg_replicas"],
+                "disagg_prefill_replicas": disagg[
+                    "disagg_prefill_replicas"
+                ],
+                "disagg_requests": disagg["disagg_requests"],
+                "disagg_ttft_p50_ms": disagg["disagg_ttft_p50_ms"],
+                "disagg_ttft_p99_ms": disagg["disagg_ttft_p99_ms"],
+                "disagg_itl_p50_ms": disagg["disagg_itl_p50_ms"],
+                "disagg_itl_p99_ms": disagg["disagg_itl_p99_ms"],
+                "disagg_colocated_ttft_p99_ms": disagg[
+                    "disagg_colocated_ttft_p99_ms"
+                ],
+                "disagg_colocated_itl_p99_ms": disagg[
+                    "disagg_colocated_itl_p99_ms"
+                ],
+                "disagg_vs_colocated_ttft": disagg[
+                    "disagg_vs_colocated_ttft"
+                ],
+                "disagg_vs_colocated_itl": disagg[
+                    "disagg_vs_colocated_itl"
+                ],
+                "disagg_kv_migrations": disagg["disagg_kv_migrations"],
+                "disagg_kv_migration_fallbacks": disagg[
+                    "disagg_kv_migration_fallbacks"
+                ],
+                "disagg_kv_migrated_pages": disagg[
+                    "disagg_kv_migrated_pages"
+                ],
+                "disagg_migration_p50_ms": disagg[
+                    "disagg_migration_p50_ms"
                 ],
                 "repack_nodes": repack["repack_nodes"],
                 "repack_frag_before": repack["repack_frag_before"],

@@ -198,6 +198,27 @@ TYPED_WHEN_PRESENT = {
     "fault_rebinds": int,
     "fault_greedy_identical": bool,
     "fault_sampled_identical": bool,
+    # Disaggregated prefill/decode serving (ISSUE 17): phase-role
+    # pools + live paged-KV migration measured against the colocated
+    # baseline at equal chips. The B100 pass forward-requires
+    # disagg_ttft_p99_ms / disagg_itl_p99_ms /
+    # disagg_vs_colocated_ttft / disagg_vs_colocated_itl /
+    # disagg_kv_migrations.
+    "disagg_replicas": int,
+    "disagg_prefill_replicas": int,
+    "disagg_requests": int,
+    "disagg_ttft_p50_ms": (int, float),
+    "disagg_ttft_p99_ms": (int, float),
+    "disagg_itl_p50_ms": (int, float),
+    "disagg_itl_p99_ms": (int, float),
+    "disagg_colocated_ttft_p99_ms": (int, float),
+    "disagg_colocated_itl_p99_ms": (int, float),
+    "disagg_vs_colocated_ttft": (int, float),
+    "disagg_vs_colocated_itl": (int, float),
+    "disagg_kv_migrations": int,
+    "disagg_kv_migration_fallbacks": int,
+    "disagg_kv_migrated_pages": int,
+    "disagg_migration_p50_ms": (int, float),
 }
 
 

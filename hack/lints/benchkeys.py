@@ -105,6 +105,17 @@ REQUIRED_STATIC = (
     "fault_recovery_p99_ms",
     "fault_lost_sequences",
     "fault_redispatched",
+    # Disaggregated prefill/decode serving (ISSUE 17): the two
+    # headline tails, both disagg-vs-colocated ratios (the equal-chips
+    # phase-split claim), and the shipped-migration count proving the
+    # live paged-KV handoff actually engaged — dropping any of them
+    # would blind the disaggregation regression tripwire before its
+    # first recorded artifact.
+    "disagg_ttft_p99_ms",
+    "disagg_itl_p99_ms",
+    "disagg_vs_colocated_ttft",
+    "disagg_vs_colocated_itl",
+    "disagg_kv_migrations",
 )
 
 

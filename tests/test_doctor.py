@@ -1094,3 +1094,189 @@ def test_metrics_probe_warns_on_fleetmon_staleness(tmp_path):
         assert report["warnings"] == [], report["warnings"]
     finally:
         srv.stop()
+
+
+# --- disaggregated serving (ISSUE 17) ---------------------------------------
+
+
+def _disagg_gauges(metrics, n_p=2, n_d=1, backlog=0.0, p_tok=0.0,
+                   d_tok=0.0):
+    metrics.set_gauge(
+        "fabric_phase_replicas", n_p, labels={"phase": "prefill"}
+    )
+    metrics.set_gauge(
+        "fabric_phase_replicas", n_d, labels={"phase": "decode"}
+    )
+    metrics.set_gauge("fabric_migration_backlog", backlog)
+    metrics.set_gauge("fabric_queued_prefill_tokens", p_tok)
+    metrics.set_gauge("fabric_queued_decode_tokens", d_tok)
+
+
+def test_metrics_probe_warns_on_growing_migration_backlog(tmp_path):
+    """A migration waiting room climbing across the probe interval
+    means the decode pool is grafting slower than prefill exports —
+    WARN with the scale-decode-up remediation; a DRAINING backlog of
+    the same size stays quiet; the 'disagg:' render line carries the
+    pools/backlog/migrations summary."""
+    import threading
+
+    from tpu_dra.infra.metrics import Metrics, MetricsServer
+
+    metrics = Metrics()
+    _disagg_gauges(metrics, backlog=4.0)
+    metrics.inc(
+        "fabric_kv_migrations_total", 9, labels={"outcome": "shipped"}
+    )
+    metrics.inc(
+        "fabric_kv_migrations_total", 2, labels={"outcome": "fallback"}
+    )
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    bump = threading.Timer(
+        0.1, lambda: metrics.set_gauge("fabric_migration_backlog", 9.0)
+    )
+    bump.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        endpoint = f"127.0.0.1:{srv.port}"
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint], metrics_interval=0.4,
+        )
+        warns = "\n".join(report["warnings"])
+        assert "KV-migration backlog GROWING" in warns
+        assert "scale the decode pool up" in warns
+        assert "docs/serving.md" in warns
+        out = render(report)
+        assert "disagg: pools=decode:1,prefill:2" in out
+        assert "backlog=9+5" in out
+        assert "migrations=fallback:2,shipped:9" in out
+        # Draining: same level, shrinking — quiet.
+        metrics.set_gauge("fabric_migration_backlog", 9.0)
+        drain = threading.Timer(
+            0.1,
+            lambda: metrics.set_gauge("fabric_migration_backlog", 3.0),
+        )
+        drain.start()
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint], metrics_interval=0.4,
+        )
+        drain.cancel()
+        assert report["warnings"] == [], report["warnings"]
+    finally:
+        bump.cancel()
+        srv.stop()
+
+
+def test_metrics_probe_disagg_single_sample_asks_reprobe(tmp_path):
+    """One sample with a nonzero waiting room cannot tell growth from
+    drain — the doctor asks for --metrics-interval instead of guessing."""
+    from tpu_dra.infra.metrics import Metrics, MetricsServer
+
+    metrics = Metrics()
+    _disagg_gauges(metrics, backlog=6.0)
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        endpoint = f"127.0.0.1:{srv.port}"
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint],
+        )
+        warns = "\n".join(report["warnings"])
+        assert "fabric_migration_backlog = 6" in warns
+        assert "--metrics-interval" in warns
+    finally:
+        srv.stop()
+
+
+def test_metrics_probe_warns_on_phase_pool_imbalance(tmp_path):
+    """Per-replica backlog of one phase dwarfing the idle other pool
+    WARNs in BOTH directions with the move-replicas/autoscaler hints;
+    balanced or sub-floor loads stay quiet."""
+    from tpu_dra.infra.metrics import Metrics, MetricsServer
+
+    metrics = Metrics()
+    _disagg_gauges(metrics, n_p=1, n_d=1, p_tok=9000.0, d_tok=10.0)
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        endpoint = f"127.0.0.1:{srv.port}"
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint],
+        )
+        warns = "\n".join(report["warnings"])
+        assert "phase-pool IMBALANCE" in warns
+        assert "prefill backlog" in warns and "TTFT" in warns
+        assert "prefill-ward" in warns
+        assert "queued=p:9000/d:10" in render(report)
+        # The other direction: decode drowning, prefill idle.
+        _disagg_gauges(metrics, n_p=1, n_d=1, p_tok=10.0, d_tok=9000.0)
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint],
+        )
+        warns = "\n".join(report["warnings"])
+        assert "decode backlog" in warns and "ITL" in warns
+        assert "decode-ward" in warns
+        # Balanced load, both pools busy: quiet.
+        _disagg_gauges(metrics, n_p=1, n_d=1, p_tok=4000.0, d_tok=3000.0)
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint],
+        )
+        assert report["warnings"] == [], report["warnings"]
+        # Sub-floor imbalance (tiny absolute backlog): quiet.
+        _disagg_gauges(metrics, n_p=1, n_d=1, p_tok=400.0, d_tok=1.0)
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint],
+        )
+        assert report["warnings"] == [], report["warnings"]
+    finally:
+        srv.stop()
+
+
+def test_metrics_probe_colocated_fleet_has_no_disagg_section(tmp_path):
+    """A colocated fleet (no phase-role replicas, empty waiting room)
+    gets no 'disagg:' section at all — the section's absence IS the
+    'not disaggregated' signal."""
+    from tpu_dra.infra.metrics import Metrics, MetricsServer
+
+    metrics = Metrics()
+    metrics.set_gauge("fabric_replicas", 3)
+    metrics.set_gauge(
+        "fabric_phase_replicas", 0, labels={"phase": "prefill"}
+    )
+    metrics.set_gauge(
+        "fabric_phase_replicas", 0, labels={"phase": "decode"}
+    )
+    metrics.set_gauge("fabric_migration_backlog", 0.0)
+    metrics.set_gauge("fabric_queued_prefill_tokens", 50.0)
+    metrics.set_gauge("fabric_queued_decode_tokens", 70.0)
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        endpoint = f"127.0.0.1:{srv.port}"
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint],
+        )
+        assert report["warnings"] == [], report["warnings"]
+        assert "disagg" not in report["metrics"][endpoint]
+        assert "disagg:" not in render(report)
+    finally:
+        srv.stop()

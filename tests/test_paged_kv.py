@@ -549,3 +549,153 @@ def test_paged_dispatch_auto_falls_back_on_bad_head_dim(interpret_mode):
     assert A._LAST_PAGED_IMPL == "xla"
     ref = A.reference_paged_decode_attention(q, kp, vp, tables, lengths)
     assert float(jnp.max(jnp.abs(out - ref))) < 1e-4
+
+
+# --- KV extents: serialize -> graft round-trip (ISSUE 17) --------------------
+
+
+def _pages_equal(src_cache, src_pages, dst_cache, dst_pages):
+    """Bit-equality of the listed pages across every pool and layer,
+    src_pages[i] compared against dst_pages[i]."""
+    sids = jnp.asarray(list(src_pages), jnp.int32)
+    dids = jnp.asarray(list(dst_pages), jnp.int32)
+    for (_, spool), (_, dpool) in zip(
+        src_cache._pools(), dst_cache._pools()
+    ):
+        for sl, dl in zip(spool, dpool):
+            if not np.array_equal(np.asarray(sl[sids]), np.asarray(dl[dids])):
+                return False
+    return True
+
+
+@pytest.mark.parametrize(
+    "kv,dtype",
+    [
+        ("none", jnp.float32),
+        ("none", jnp.bfloat16),
+        ("int8", jnp.float32),
+    ],
+)
+def test_kv_extent_roundtrip_property(kv, dtype):
+    """Satellite: randomized serialize->graft round-trips — random page
+    counts and lengths (including exactly-at-page-boundary), bf16 and
+    int8 scale pools. The grafted copy is bit-identical, keeps the
+    zero-tail invariant, and both allocators' ledgers balance: the
+    destination debits exactly n pages, the source releases exactly
+    once (a second release is a ledger violation the allocator rejects)."""
+    rng = np.random.default_rng(17)
+    cfg = dataclasses.replace(TINY_LLAMA, dtype=dtype, param_dtype=jnp.float32)
+    page = 4
+    for trial in range(6):
+        n_pages = int(rng.integers(2, 5))
+        length = int(
+            rng.integers((n_pages - 1) * page + 1, n_pages * page + 1)
+        )
+        if trial == 0:
+            length = n_pages * page  # ends exactly at a page boundary
+        src = init_paged_cache(cfg, num_pages=8, page_size=page, kv_quant=kv)
+        sa = PageAllocator(8)
+        pages = [sa.alloc() for _ in range(n_pages)]
+        src = _fill_pages(src, pages, length, seed=trial)
+        ext = paged_kv.serialize_extent(src, pages, length)
+        assert ext.n_payload_pages == n_pages and ext.n_shared_pages == 0
+        assert ext.length == length and ext.nbytes > 0
+
+        dst = init_paged_cache(cfg, num_pages=8, page_size=page, kv_quant=kv)
+        da = PageAllocator(8)
+        free_before = da.free_pages
+        dst, dpages = paged_kv.graft_extent(dst, da, ext)
+        assert len(dpages) == n_pages
+        assert da.free_pages == free_before - n_pages
+        assert all(da.refcount(p) == 1 for p in dpages)
+        assert _pages_equal(src, pages, dst, dpages)
+        assert paged_kv.tail_is_zero(dst, dpages, length)
+
+        for p in pages:
+            assert sa.decref(p)  # freed on the first release...
+        assert sa.free_pages == 8 - 1  # ...and the ledger is whole
+        with pytest.raises(ValueError):
+            sa.decref(pages[0])  # a double release never passes silently
+
+
+@pytest.mark.parametrize("kv", ["none", "int8"])
+def test_kv_extent_shared_prefix_carried_by_id(kv):
+    """A refcount>1 shared-prefix page travels by id: the graft increfs
+    it instead of copying, so only the non-shared tail costs a page."""
+    cache = init_paged_cache(CFG, num_pages=6, page_size=4, kv_quant=kv)
+    a = PageAllocator(6)
+    prefix, tail = a.alloc(), a.alloc()
+    a.incref(prefix)  # a registered shared prefix: refcount 2
+    cache = _fill_pages(cache, [prefix, tail], length=6)
+    ext = paged_kv.serialize_extent(cache, [prefix, tail], 6, by_id=[prefix])
+    assert ext.n_shared_pages == 1 and ext.n_payload_pages == 1
+    rc, free_before = a.refcount(prefix), a.free_pages
+    cache2, pages = paged_kv.graft_extent(cache, a, ext)
+    assert pages[0] == prefix  # carried by reference, never copied
+    assert a.refcount(prefix) == rc + 1
+    assert a.free_pages == free_before - 1  # only the tail page allocs
+    assert _pages_equal(cache, [tail], cache2, [pages[1]])
+    assert paged_kv.tail_is_zero(cache2, pages, 6)
+
+
+@pytest.mark.parametrize("kv", ["none", "int8"])
+def test_kv_extent_attach_increfs_destination_page(kv):
+    """``attach`` maps a slot to a destination page the importer already
+    holds equivalent content for (a registered prefix): that slot increfs
+    the local page and skips both the alloc and the scatter."""
+    src = init_paged_cache(CFG, num_pages=6, page_size=4, kv_quant=kv)
+    sa = PageAllocator(6)
+    spages = [sa.alloc(), sa.alloc()]
+    src = _fill_pages(src, spages, length=8, seed=3)
+    ext = paged_kv.serialize_extent(src, spages, 8)
+
+    dst = init_paged_cache(CFG, num_pages=6, page_size=4, kv_quant=kv)
+    da = PageAllocator(6)
+    held = da.alloc()
+    # Same rng draw order as the first page of the source fill -> the
+    # held page's content is identical to slot 0's payload.
+    dst = _fill_pages(dst, [held], length=4, seed=3)
+    free_before = da.free_pages
+    dst2, pages = paged_kv.graft_extent(dst, da, ext, attach={0: held})
+    assert pages[0] == held and da.refcount(held) == 2
+    assert da.free_pages == free_before - 1  # only slot 1 allocated
+    assert _pages_equal(src, spages, dst2, pages)
+    assert paged_kv.tail_is_zero(dst2, pages, 8)
+
+
+@pytest.mark.parametrize("kv", ["none", "int8"])
+def test_kv_extent_graft_failure_releases_everything(kv):
+    """Exhaustion mid-graft (shared page increfed, first payload page
+    allocated, second alloc raises) rolls everything back: no page stays
+    allocated, no refcount stays raised."""
+    cache = init_paged_cache(CFG, num_pages=6, page_size=4, kv_quant=kv)
+    a = PageAllocator(6)
+    spages = [a.alloc() for _ in range(3)]
+    a.alloc()  # pin one page: exactly one free page remains
+    cache = _fill_pages(cache, spages, length=12, seed=5)
+    ext = paged_kv.serialize_extent(cache, spages, 12, by_id=[spages[0]])
+    assert ext.n_payload_pages == 2
+    rc, free_before = a.refcount(spages[0]), a.free_pages
+    assert free_before == 1
+    with pytest.raises(PageExhaustedError):
+        paged_kv.graft_extent(cache, a, ext)
+    assert a.refcount(spages[0]) == rc
+    assert a.free_pages == free_before
+
+
+def test_kv_extent_validates_shape_and_mode():
+    """serialize refuses a length the page list can't cover; graft
+    refuses page-size and kv-quantization mismatches."""
+    cache = init_paged_cache(CFG, num_pages=4, page_size=4, kv_quant="none")
+    with pytest.raises(ValueError, match="exceeds"):
+        paged_kv.serialize_extent(cache, [1], 5)
+    a = PageAllocator(4)
+    p = a.alloc()
+    filled = _fill_pages(cache, [p], 3)
+    ext = paged_kv.serialize_extent(filled, [p], 3)
+    qcache = init_paged_cache(CFG, num_pages=4, page_size=4, kv_quant="int8")
+    with pytest.raises(ValueError, match="quantization"):
+        paged_kv.graft_extent(qcache, PageAllocator(4), ext)
+    wide = init_paged_cache(CFG, num_pages=4, page_size=8, kv_quant="none")
+    with pytest.raises(ValueError, match="page_size"):
+        paged_kv.graft_extent(wide, PageAllocator(4), ext)

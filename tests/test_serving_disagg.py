@@ -1,0 +1,602 @@
+"""Disaggregated prefill/decode tests (ISSUE 17): the engine's
+export/import live-migration primitive (token parity greedy AND under
+the pinned sampling schedule, TTFT riding ``ttft_preobserved``), the
+router's phase-role migration paths (shipped, no-decode-pool fallback,
+capacity-rejection fallback, death at the migration boundary —
+exactly-once), the per-phase gauges and migration counters, and the
+claim autoscaler's independent phase-pool sizing."""
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dra.infra.metrics import Metrics
+from tpu_dra.serving.autoscaler import AutoscalerConfig, ClaimAutoscaler
+from tpu_dra.serving.router import (
+    INTERACTIVE,
+    Replica,
+    Router,
+    RouterConfig,
+    TenantSpec,
+)
+from tpu_dra.workloads.engine import (
+    Completion,
+    Engine,
+    EngineConfig,
+    Evacuated,
+    Request,
+    SequenceExtent,
+)
+from tpu_dra.workloads.models.llama import TINY_LLAMA, Llama
+
+CFG = dataclasses.replace(
+    TINY_LLAMA, dtype=jnp.float32, param_dtype=jnp.float32
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return Llama(CFG).init_params(jax.random.PRNGKey(7), batch=2, seq=8)
+
+
+def _ec(**kw):
+    base = dict(
+        page_size=4, max_slots=3, max_pages_per_seq=10,
+        scan_chunk=3, prefill_chunk=8,
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _req(rid, plen=4, out=5, **kw):
+    return Request(
+        rid=rid, prompt=np.ones(plen, np.int32), max_new_tokens=out, **kw
+    )
+
+
+# --- engine: export -> import resumes decode without recomputing ------------
+
+
+def _split_run(params, ec, req):
+    """Prefill + first token on a source engine, export the sequence,
+    graft it into a fresh destination engine, and finish there."""
+    src = Engine(CFG, params, ec)
+    src.add_request(dataclasses.replace(req))
+    while not src.decoding_rids():
+        src.step()
+    sx = src.export_sequence(req.rid)
+    # The export released the slot and every page exactly once: the
+    # source's allocator ledger is whole again.
+    assert src.allocator.free_pages == src.allocator.num_pages - 1
+    assert src.allocator.reserved_pages == 0
+    src.close()
+    dst = Engine(CFG, params, ec)
+    assert dst.import_sequence(sx), "fresh engine must have headroom"
+    done = dst.run()
+    assert dst.allocator.free_pages == dst.allocator.num_pages - 1
+    assert dst.allocator.reserved_pages == 0
+    dst.close()
+    return sx, done[req.rid]
+
+
+@pytest.mark.parametrize(
+    "label,eckw",
+    [
+        ("greedy", {}),
+        ("sampled", {"temperature": 0.8, "sample_seed": 11}),
+    ],
+)
+def test_export_import_token_identical_to_unmigrated_twin(
+    params, label, eckw
+):
+    """Tentpole parity bar: a migrated sequence's emitted + resumed
+    tokens equal an un-migrated twin's, greedy AND under the journaled
+    (seed, serial, position) sampled schedule — no position recomputed,
+    no sample re-drawn."""
+    ec = _ec(**eckw)
+    rng = np.random.default_rng(17)
+    req = Request(
+        rid="mig0",
+        prompt=rng.integers(1, CFG.vocab_size, 6).astype(np.int32),
+        max_new_tokens=7,
+    )
+    twin = Engine(CFG, params, ec)
+    ref = twin.run([dataclasses.replace(req)])[req.rid]
+    twin.close()
+    sx, comp = _split_run(params, ec, req)
+    assert len(sx.emitted) >= 1
+    got = np.concatenate([sx.emitted, comp.tokens])
+    assert got.tolist() == ref.tokens.tolist(), (
+        f"[{label}] migrated {got.tolist()} != twin {ref.tokens.tolist()}"
+    )
+
+
+def test_ttft_preobserved_rides_migration(params):
+    """Satellite: the destination engine never observes a bogus
+    near-zero engine_ttft_seconds for a sequence whose first token
+    happened on the source — the resume request carries
+    ``ttft_preobserved``."""
+    ec = _ec()
+    src = Engine(CFG, params, ec)
+    src.add_request(_req("t0", plen=5, out=6))
+    while not src.decoding_rids():
+        src.step()
+    sx = src.export_sequence("t0")
+    src.close()
+    assert sx.t_first is not None
+    assert sx.resume_request().ttft_preobserved
+    m = Metrics()
+    dst = Engine(CFG, params, ec, metrics=m)
+    assert dst.import_sequence(sx)
+    dst.run()
+    dst.close()
+    assert "engine_ttft_seconds" not in m.render(), (
+        "a migrated-in sequence re-observed TTFT on the destination"
+    )
+
+
+# --- stub engine with the disagg surface ------------------------------------
+
+
+class _FakeKV:
+    """Stands in for paged_kv.KVExtent at the router layer (the router
+    only reads ``n_pages``)."""
+
+    def __init__(self, n_pages):
+        self.n_pages = n_pages
+        self.page_size = 4
+
+
+class DisaggStubEngine:
+    """No-JAX engine stand-in with the migration surface: a step
+    finishes at most one prefill (emitting the first token), every
+    OTHER decoding sequence advances one token and completes when its
+    budget is spent — so a freshly-prefilled sequence survives at least
+    one step in the decoding set and an export can catch it."""
+
+    def __init__(self):
+        self.queue = []
+        self.decoding = {}  # rid -> [req, out, t_submit, t_first]
+        self._base = {}  # rid -> tokens emitted before the graft
+        self.completed = {}
+        self.order = []
+        self.exports = 0
+        self.imports = 0
+        self.accept_imports = True
+        self.reject_next_imports = 0  # transient capacity rejections
+        self.closed = False
+
+    def add_request(self, req):
+        self.queue.append(req)
+        self.order.append(req.rid)
+
+    @property
+    def busy(self):
+        return bool(self.queue or self.decoding)
+
+    def step(self):
+        now = time.monotonic()
+        fresh = None
+        if self.queue:
+            r = self.queue.pop(0)
+            fresh = r.rid
+            self.decoding[r.rid] = [r, [101], now, now]
+        for rid in list(self.decoding):
+            if rid == fresh:
+                continue
+            r, out, t_sub, t_first = self.decoding[rid]
+            if t_first is None:
+                self.decoding[rid][3] = t_first = now
+            if len(out) < r.max_new_tokens:
+                out.append(101 + self._base.get(rid, 0) + len(out))
+            if len(out) >= r.max_new_tokens:
+                del self.decoding[rid]
+                self.completed[rid] = Completion(
+                    rid=rid, tokens=np.asarray(out, np.int32),
+                    t_submit=t_sub, t_arrival=t_sub,
+                    t_first_token=t_first, t_done=now,
+                )
+        return self.busy
+
+    def decoding_rids(self):
+        return list(self.decoding)
+
+    def export_sequence(self, rid):
+        r, out, t_sub, t_first = self.decoding.pop(rid)
+        self.exports += 1
+        return SequenceExtent(
+            req=r, emitted=np.asarray(out, np.int32),
+            extent=_FakeKV(-(-(len(r.prompt) + len(out)) // 4)),
+            kv_len=len(r.prompt) + len(out) - 1,
+            t_submit=t_sub, t_first=t_first,
+            sample_seed=0, sample_serial=0,
+        )
+
+    def import_sequence(self, sx, req=None):
+        if not self.accept_imports:
+            return False
+        if self.reject_next_imports > 0:
+            self.reject_next_imports -= 1
+            return False
+        self.imports += 1
+        remaining = sx.req.max_new_tokens - len(sx.emitted)
+        req = dataclasses.replace(sx.req, max_new_tokens=remaining)
+        # out=[] — this engine's completion carries only the tokens IT
+        # emits; the router concatenates the source's emitted prefix.
+        # _base keeps the token VALUES position-numbered across the
+        # handoff so a duplicated or dropped token shows in the stream.
+        self._base[sx.req.rid] = len(sx.emitted)
+        self.decoding[sx.req.rid] = [req, [], sx.t_submit, sx.t_first]
+        return True
+
+    def evacuate(self):
+        out = [
+            Evacuated(
+                req=r, emitted=np.zeros(0, np.int32),
+                t_submit=0.0, t_first=None,
+            )
+            for r in self.queue
+        ]
+        self.queue = []
+        return out
+
+    def close(self):
+        self.closed = True
+
+
+def _disagg_replica(name, role):
+    return Replica(name, DisaggStubEngine(), role=role)
+
+
+def _drive_disagg(router, reps, steps=300):
+    """Single-threaded drive mirroring Replica._loop's disagg order:
+    graft queued imports between steps, step, drain the outbox, then
+    export finished prefills when the role/gate allows."""
+    for _ in range(steps):
+        router.poll()
+        for rep in reps:
+            if rep.dead:
+                continue
+            while rep._import_inbox:
+                sx, t0 = rep._import_inbox.popleft()
+                rep.import_results.append(
+                    (sx, rep.engine.import_sequence(sx), t0)
+                )
+            if rep.engine.busy:
+                rep.engine.step()
+            rep._drain_outbox()
+            if rep.role == "prefill" and rep.export_enabled:
+                for rid in rep.engine.decoding_rids():
+                    rep.migration_outbox.append(
+                        rep.engine.export_sequence(rid)
+                    )
+        if not router.busy:
+            break
+    router.poll()
+    return router
+
+
+# --- router: migration shipped / fallback / exactly-once --------------------
+
+
+def test_migration_ships_prefill_to_decode_pool():
+    """The happy path: prefill-role replicas export every sequence at
+    prefill completion, the decode pool grafts and finishes them, and
+    the completion splices source + destination tokens with the
+    source-side TTFT."""
+    t = TenantSpec("t", INTERACTIVE, weight=1.0)
+    p0, d0 = _disagg_replica("p0", "prefill"), _disagg_replica("d0", "decode")
+    m = Metrics()
+    router = Router(
+        [t], [p0, d0],
+        RouterConfig(backlog_cap_tokens=1e9, max_inflight_per_replica=4),
+        metrics=m,
+    )
+    for i in range(5):
+        router.submit("t", _req(f"s{i}", plen=4, out=4))
+    t_decode_start = time.monotonic()
+    _drive_disagg(router, [p0, d0])
+    assert len(router.completions) == 5
+    assert router.kv_migrations.get("shipped", 0) == 5
+    assert "fallback" not in router.kv_migrations
+    assert p0.engine.exports == 5 and d0.engine.imports == 5
+    assert router.duplicates_dropped == 0
+    for c in router.completions.values():
+        assert len(c.tokens) == 4
+        assert c.tokens.tolist() == [101, 102, 103, 104]
+        assert c.replicas == ["p0", "d0"]
+        # TTFT happened on the prefill side, before any decode step.
+        assert c.t_first_token <= t_decode_start + 5.0
+    assert m.get_counter(
+        "fabric_kv_migrations_total", labels={"outcome": "shipped"}
+    ) == 5
+    assert m.get_counter("fabric_kv_migrated_pages_total") >= 5
+    assert m.quantile("fabric_kv_migration_seconds", 0.5) is not None
+
+
+def test_migration_falls_back_when_decode_pool_vanishes():
+    """An exported extent whose decode pool died before dispatch falls
+    back to re-prefill on the general pool — nothing lost, nothing
+    duplicated, and the fallback counter says why."""
+    t = TenantSpec("t", INTERACTIVE, weight=1.0)
+    p0, d0 = _disagg_replica("p0", "prefill"), _disagg_replica("d0", "decode")
+    m = Metrics()
+    router = Router(
+        [t], [p0, d0],
+        RouterConfig(backlog_cap_tokens=1e9, max_inflight_per_replica=4),
+        metrics=m,
+    )
+    router.submit("t", _req("f0", plen=4, out=4))
+    router.poll()  # dispatch to p0; export gate opens (decode pool live)
+    assert p0.export_enabled
+    p0.engine.step()
+    for rid in p0.engine.decoding_rids():
+        p0.migration_outbox.append(p0.engine.export_sequence(rid))
+    router.mark_dead(d0, "chaos")
+    _drive_disagg(router, [p0])
+    assert len(router.completions) == 1
+    c = router.completions["f0"]
+    assert len(c.tokens) == 4  # emitted prefix + re-prefilled remainder
+    assert router.kv_migrations == {"fallback": 1}
+    assert router.duplicates_dropped == 0
+    assert m.get_counter(
+        "fabric_kv_migrations_total", labels={"outcome": "fallback"}
+    ) == 1
+
+
+def test_migration_capacity_rejection_falls_back():
+    """A decode engine rejecting the graft for capacity (import returns
+    False) is normal backpressure: the sequence re-enters the WFQ front,
+    re-prefills, and its NEXT export ships once the capacity clears —
+    full token budget, nothing duplicated."""
+    t = TenantSpec("t", INTERACTIVE, weight=1.0)
+    p0, d0 = _disagg_replica("p0", "prefill"), _disagg_replica("d0", "decode")
+    d0.engine.reject_next_imports = 1
+    router = Router(
+        [t], [p0, d0],
+        RouterConfig(backlog_cap_tokens=1e9, max_inflight_per_replica=4),
+    )
+    router.submit("t", _req("c0", plen=4, out=4))
+    _drive_disagg(router, [p0, d0])
+    assert len(router.completions) == 1
+    assert len(router.completions["c0"].tokens) == 4
+    assert router.kv_migrations.get("fallback", 0) == 1
+    assert router.kv_migrations.get("shipped", 0) == 1
+    assert router.duplicates_dropped == 0
+    assert d0.engine.imports == 1  # the rejection never counted
+
+
+def test_decode_death_at_migration_boundary_is_exactly_once():
+    """Kill the decode replica AFTER the extent was dispatched to it
+    (source pages already released — only the journal can reconstruct):
+    journal replay re-prefills prompt + emitted elsewhere, the sequence
+    completes once with the full token budget."""
+    t = TenantSpec("t", INTERACTIVE, weight=1.0)
+    p0, d0 = _disagg_replica("p0", "prefill"), _disagg_replica("d0", "decode")
+    router = Router(
+        [t], [p0, d0],
+        RouterConfig(
+            backlog_cap_tokens=1e9, max_inflight_per_replica=4,
+            # No re-dispatch cooloff: the single-threaded driver does
+            # not wait out wall-clock backoff.
+            redispatch_backoff_base_seconds=0.0,
+            redispatch_backoff_cap_seconds=0.0,
+        ),
+    )
+    router.submit("t", _req("k0", plen=4, out=4))
+    router.poll()
+    p0.engine.step()
+    for rid in p0.engine.decoding_rids():
+        p0.migration_outbox.append(p0.engine.export_sequence(rid))
+    router.poll()  # collect export, dispatch the extent onto d0
+    assert "k0" in d0.inflight and d0._import_inbox
+    router.mark_dead(d0, "chaos: died with the extent in hand")
+    _drive_disagg(router, [p0])
+    assert set(router.completions) == {"k0"}
+    assert len(router.completions["k0"].tokens) == 4
+    assert router.duplicates_dropped == 0
+
+
+def test_colocated_both_role_never_exports():
+    """The colocated default is untouched: 'both'-role replicas decode
+    their own prefills — zero exports, zero migration counters."""
+    t = TenantSpec("t", INTERACTIVE, weight=1.0)
+    r0, r1 = _disagg_replica("r0", "both"), _disagg_replica("r1", "both")
+    router = Router(
+        [t], [r0, r1],
+        RouterConfig(backlog_cap_tokens=1e9, max_inflight_per_replica=4),
+    )
+    for i in range(6):
+        router.submit("t", _req(f"b{i}", plen=4, out=4))
+    _drive_disagg(router, [r0, r1])
+    assert len(router.completions) == 6
+    assert router.kv_migrations == {}
+    assert r0.engine.exports == 0 and r1.engine.exports == 0
+    assert not r0.export_enabled and not r1.export_enabled
+
+
+def test_phase_gauges_export():
+    """Satellite: fabric_queued_prefill_tokens / _decode_tokens split
+    the queued work by phase, and fabric_phase_replicas counts the
+    pools."""
+    t = TenantSpec("t", INTERACTIVE, weight=1.0)
+    p0, d0 = _disagg_replica("p0", "prefill"), _disagg_replica("d0", "decode")
+    m = Metrics()
+    router = Router(
+        [t], [p0, d0],
+        RouterConfig(backlog_cap_tokens=1e9, max_inflight_per_replica=4),
+        metrics=m,
+    )
+    for i in range(3):
+        router.submit("t", _req(f"g{i}", plen=5, out=7))
+    router._last_export = -1e18
+    router._export()
+    assert m.get_gauge("fabric_queued_prefill_tokens") == 15.0
+    assert m.get_gauge("fabric_queued_decode_tokens") == 21.0
+    assert m.get_gauge(
+        "fabric_phase_replicas", labels={"phase": "prefill"}
+    ) == 1
+    assert m.get_gauge(
+        "fabric_phase_replicas", labels={"phase": "decode"}
+    ) == 1
+
+
+# --- autoscaler: independent phase-pool sizing ------------------------------
+
+
+class StubClaims:
+    def __init__(self):
+        self.store = {}
+        self.deleted = []
+
+    def create(self, obj):
+        self.store[obj["metadata"]["name"]] = obj
+        return obj
+
+    def try_get(self, name, namespace=None):
+        return self.store.get(name)
+
+    def delete(self, name, namespace=None):
+        self.deleted.append(name)
+        self.store.pop(name, None)
+
+    def allocate(self, name):
+        self.store[name].setdefault("status", {})["allocation"] = {
+            "devices": {"results": [
+                {"pool": "node-0", "device": "ss-1x1x1-0-0-0"},
+            ]},
+        }
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def _disagg_autoscaler(router, claims, clock, **cfg):
+    base = dict(
+        min_replicas=1, max_replicas=5,
+        target_tokens_per_replica=100.0,
+        up_factor=1.0, down_factor=0.2, cooldown_seconds=5.0,
+        disaggregated=True,
+    )
+    base.update(cfg)
+    made = []
+
+    def make_replica(claim, role=None):
+        rep = _disagg_replica(
+            claim["metadata"]["name"], role or "both"
+        )
+        made.append(rep)
+        return rep
+
+    a = ClaimAutoscaler(
+        router, claims,
+        make_claim=lambda name: {"metadata": {"name": name},
+                                 "spec": {"devices": {"requests": []}}},
+        make_replica=make_replica,
+        config=AutoscalerConfig(**base),
+        clock=clock,
+    )
+    a._made = made
+    return a
+
+
+def _phase_router(**cfg):
+    t = TenantSpec("t", INTERACTIVE, weight=1.0)
+    p0, d0 = _disagg_replica("p0", "prefill"), _disagg_replica("d0", "decode")
+    base = dict(backlog_cap_tokens=1e9, max_inflight_per_replica=1)
+    base.update(cfg)
+    return Router([t], [p0, d0], RouterConfig(**base)), p0, d0
+
+
+def test_disagg_scale_up_targets_prefill_pool():
+    """Prefill-heavy queue (fat prompts, thin outputs) -> the up claim
+    binds a PREFILL-role replica."""
+    router, _p0, _d0 = _phase_router()
+    clock, claims = FakeClock(), StubClaims()
+    a = _disagg_autoscaler(router, claims, clock)
+    for i in range(30):
+        router.submit("t", _req(f"x{i}", plen=20, out=2))
+    a.tick()
+    assert a._pending_claim is not None
+    up = [e for e in a.events if e[0] == "up-requested"][-1]
+    assert up[3] == {"role": "prefill"}
+    name = a._pending_claim["metadata"]["name"]
+    claims.allocate(name)
+    clock.t += 1.0
+    a.tick()
+    assert a._made[-1].role == "prefill"
+    assert a._made[-1] in router.replicas
+
+
+def test_disagg_scale_up_targets_decode_pool():
+    """Decode-heavy queue (thin prompts, fat outputs) -> the up claim
+    binds a DECODE-role replica."""
+    router, _p0, _d0 = _phase_router()
+    clock, claims = FakeClock(), StubClaims()
+    a = _disagg_autoscaler(router, claims, clock)
+    for i in range(10):
+        router.submit("t", _req(f"y{i}", plen=2, out=50))
+    a.tick()
+    up = [e for e in a.events if e[0] == "up-requested"][-1]
+    assert up[3] == {"role": "decode"}
+    name = a._pending_claim["metadata"]["name"]
+    claims.allocate(name)
+    clock.t += 1.0
+    a.tick()
+    assert a._made[-1].role == "decode"
+
+
+def test_disagg_scale_down_never_empties_a_phase():
+    """Idle 2-prefill + 1-decode fleet: scale-down retires a PREFILL
+    replica (the decode pool is already at its floor); an idle
+    1-prefill + 1-decode fleet does not scale down at all — dropping a
+    phase to zero would deadlock its half of the pipeline."""
+    t = TenantSpec("t", INTERACTIVE, weight=1.0)
+    p0, p1, d0 = (
+        _disagg_replica("p0", "prefill"),
+        _disagg_replica("p1", "prefill"),
+        _disagg_replica("d0", "decode"),
+    )
+    for rep in (p0, p1, d0):
+        rep.claim_name = rep.name
+    router = Router(
+        [t], [p0, p1, d0], RouterConfig(backlog_cap_tokens=1e9)
+    )
+    clock, claims = FakeClock(), StubClaims()
+    for rep in (p0, p1, d0):
+        claims.store[rep.name] = {"metadata": {"name": rep.name}}
+    a = _disagg_autoscaler(router, claims, clock)
+    a.tick()
+    assert a._draining is not None and a._draining.role == "prefill"
+    # Minimal phase pools: no further scale-down, ever.
+    router2, _p, _d = _phase_router()
+    a2 = _disagg_autoscaler(router2, StubClaims(), FakeClock())
+    a2.tick()
+    assert a2._draining is None
+    assert not [e for e in a2.events if e[0] == "down-requested"]
+
+
+def test_disagg_dead_replica_rebind_inherits_role():
+    """A decode replica's death is a repair, not a pool-sizing event:
+    the hot re-bind onto its still-allocated claim keeps the role."""
+    router, p0, d0 = _phase_router()
+    p0.claim_name, d0.claim_name = "p0", "d0"
+    clock, claims = FakeClock(), StubClaims()
+    claims.store["p0"] = {"metadata": {"name": "p0"}}
+    claims.store["d0"] = {"metadata": {"name": "d0"}}
+    claims.allocate("d0")
+    a = _disagg_autoscaler(router, claims, clock)
+    router.mark_dead(d0, "chaos")
+    a.tick()
+    assert a.rebinds == 1
+    assert a._made[-1].role == "decode"
+    assert a._made[-1] in router.replicas

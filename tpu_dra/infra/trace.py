@@ -138,6 +138,11 @@ SPAN_NAMES: Dict[str, Tuple[str, str, str]] = {
         "serving", "serving.request.queued",
         "a drained sequence's hand-back + front-splice requeue "
         "(attr emitted= tokens carried to the surviving replica)"),
+    "serving.request.migrate": (
+        "serving", "serving.request.queued",
+        "one live paged-KV migration: prefill-replica export to "
+        "decode-replica graft (attrs rid=, to_replica=, pages=; "
+        "fallbacks re-enter the WFQ and do not span)"),
 }
 
 # The hot-lifecycle subset `make tracecheck` must observe end-to-end

@@ -68,6 +68,15 @@ class AutoscalerConfig:
     # a wedged thread cannot stall the control loop (the stop-timeout
     # path logs + counts it and leaves the corpse dead).
     dead_join_timeout_seconds: float = 1.0
+    # --- disaggregated prefill/decode (ISSUE 17) ---
+    # When True the fleet runs phase-role pools: the load signal splits
+    # into queued PREFILL tokens per prefill replica vs queued DECODE
+    # tokens per decode replica, scale-up creates a claim for the
+    # needier phase (``make_replica`` is then called as
+    # ``make_replica(claim, role)``), scale-down retires from the
+    # emptier pool without ever dropping a phase to zero replicas, and
+    # a dead replica's replacement inherits its role.
+    disaggregated: bool = False
 
 
 class ClaimAutoscaler:
@@ -124,6 +133,12 @@ class ClaimAutoscaler:
         # detector's last poll time.
         self._replace_owed = 0
         self._last_claim_check = -1e18
+        # Disaggregation (ISSUE 17): the role the in-flight scale-up /
+        # replacement claim will bind as, and the roles owed by
+        # quarantined or claim-less dead replicas (FIFO next to
+        # _replace_owed; empty when not disaggregated).
+        self._pending_role: Optional[str] = None
+        self._replace_roles: List[str] = []
 
     # --- the control-thread entry point ---
 
@@ -200,10 +215,18 @@ class ClaimAutoscaler:
                     "reason": rep.death_reason,
                 }))
                 self._replace_owed += 1
+                if self.config.disaggregated:
+                    self._replace_roles.append(rep.role)
             elif alloc:
                 # First (or rare) death with the claim still allocated:
-                # hot re-bind a fresh engine onto the same devices.
-                rep2 = self.make_replica(claim)
+                # hot re-bind a fresh engine onto the same devices —
+                # with the dead replica's phase role: the pools' sizes
+                # are the autoscaler's decision, not the crash's.
+                rep2 = (
+                    self.make_replica(claim, rep.role)
+                    if self.config.disaggregated
+                    else self.make_replica(claim)
+                )
                 rep2.claim_name = rep.claim_name
                 rep2.claim = claim
                 self.router.add_replica(rep2)
@@ -222,9 +245,14 @@ class ClaimAutoscaler:
                     })
                 )
                 self._replace_owed += 1
+                if self.config.disaggregated:
+                    self._replace_roles.append(rep.role)
 
     def _begin_replace(self, now: float) -> None:
         self._replace_owed -= 1
+        self._pending_role = (
+            self._replace_roles.pop(0) if self._replace_roles else None
+        )
         self._serial += 1
         name = f"fabric-replica-{self._serial:04d}"
         claim = self.make_claim(name)
@@ -245,7 +273,31 @@ class ClaimAutoscaler:
         n = max(1, len(self.router.live_replicas()))
         return self.router.queued_tokens() / n
 
+    def _gate_cooldown(self, want: str, now: float) -> bool:
+        """Shared cooldown/hysteresis gate: False suppresses the
+        action. A desired REVERSAL inside the cooldown window is the
+        flapping signal, counted once per episode."""
+        c = self.config
+        if now - self._last_action_t < c.cooldown_seconds:
+            if self._last_action is not None and want != self._last_action:
+                # Up+down inside one cooldown window: the hysteresis
+                # band is too tight for this load's variance. Count it
+                # ONCE per episode (the doctor's flapping WARN) and
+                # suppress the action.
+                if not self._flap_latched:
+                    self._flap_latched = True
+                    self.flaps += 1
+                    if self.metrics is not None:
+                        self.metrics.inc("fabric_autoscaler_flaps_total")
+            else:
+                self._flap_latched = False
+            return False
+        self._flap_latched = False
+        return True
+
     def _maybe_scale(self) -> None:
+        if self.config.disaggregated:
+            return self._maybe_scale_disagg()
         c = self.config
         n = len(self.router.live_replicas())
         load = self._load_per_replica()
@@ -260,29 +312,61 @@ class ClaimAutoscaler:
             self._flap_latched = False
             return
         now = self.clock()
-        if now - self._last_action_t < c.cooldown_seconds:
-            if self._last_action is not None and want != self._last_action:
-                # Up+down inside one cooldown window: the hysteresis
-                # band is too tight for this load's variance. Count it
-                # ONCE per episode (the doctor's flapping WARN) and
-                # suppress the action.
-                if not self._flap_latched:
-                    self._flap_latched = True
-                    self.flaps += 1
-                    if self.metrics is not None:
-                        self.metrics.inc("fabric_autoscaler_flaps_total")
-            else:
-                self._flap_latched = False
+        if not self._gate_cooldown(want, now):
             return
-        self._flap_latched = False
         if want == "up":
             self._begin_scale_up(now)
         else:
             self._begin_scale_down(now)
 
+    def _maybe_scale_disagg(self) -> None:
+        """Per-phase pool sizing (ISSUE 17): the load signal is queued
+        PREFILL tokens per prefill replica vs queued DECODE tokens per
+        decode replica — the split of ``queued_tokens()`` the router
+        maintains. The needier phase scales up; scale-down retires from
+        the emptier pool, never dropping a phase below one replica (a
+        phaseless fleet would deadlock its half of the pipeline into
+        the re-prefill fallback)."""
+        c = self.config
+        live = self.router.live_replicas()
+        n = len(live)
+        n_p = sum(1 for r in live if r.role == "prefill")
+        n_d = sum(1 for r in live if r.role == "decode")
+        load_p = self.router.queued_prefill_tokens() / max(1, n_p)
+        load_d = self.router.queued_decode_tokens() / max(1, n_d)
+        want: Optional[str] = None
+        role: Optional[str] = None
+        if max(load_p, load_d) > c.target_tokens_per_replica * c.up_factor:
+            if n < c.max_replicas:
+                want = "up"
+                role = "prefill" if load_p >= load_d else "decode"
+        elif (
+            load_p < c.target_tokens_per_replica * c.down_factor
+            and load_d < c.target_tokens_per_replica * c.down_factor
+            and n > c.min_replicas
+            and (n_p > 1 or n_d > 1)
+        ):
+            want = "down"
+            if n_d <= 1 or (load_p <= load_d and n_p > 1):
+                role = "prefill"
+            else:
+                role = "decode"
+        if want is None:
+            self._flap_latched = False
+            return
+        now = self.clock()
+        if not self._gate_cooldown(want, now):
+            return
+        if want == "up":
+            self._begin_scale_up(now, role=role)
+        else:
+            self._begin_scale_down(now, role=role)
+
     # --- scale-up: create claim -> packer places -> bind replica ---
 
-    def _begin_scale_up(self, now: float) -> None:
+    def _begin_scale_up(
+        self, now: float, role: Optional[str] = None
+    ) -> None:
         self._serial += 1
         name = f"fabric-replica-{self._serial:04d}"
         claim = self.make_claim(name)
@@ -292,8 +376,11 @@ class ClaimAutoscaler:
         self._pending_claim = claim
         self._pending_t0 = now
         self._pending_is_replace = False
+        self._pending_role = role
         self._last_action, self._last_action_t = "up", now
-        self.events.append(("up-requested", name, now, {}))
+        self.events.append(
+            ("up-requested", name, now, {"role": role} if role else {})
+        )
 
     def _tick_pending_alloc(self) -> None:
         name = self._pending_claim["metadata"]["name"]
@@ -314,9 +401,17 @@ class ClaimAutoscaler:
                     # unplaceable one stays owed and retries on a later
                     # tick (capacity may free meanwhile).
                     self._replace_owed += 1
+                    if self._pending_role is not None:
+                        self._replace_roles.append(self._pending_role)
                 self._pending_claim = None
+                self._pending_role = None
             return
-        rep = self.make_replica(cur)
+        rep = (
+            self.make_replica(cur, self._pending_role)
+            if self._pending_role is not None
+            else self.make_replica(cur)
+        )
+        self._pending_role = None
         rep.claim_name = name
         rep.claim = cur
         self.router.add_replica(rep)
@@ -338,7 +433,7 @@ class ClaimAutoscaler:
 
     # --- scale-down: quiesce -> evacuate -> requeue -> DELETE claim ---
 
-    def _victim(self) -> Optional[Replica]:
+    def _victim(self, role: Optional[str] = None) -> Optional[Replica]:
         # A replica mid-repack is NOT a scale-down candidate (ISSUE 12):
         # the repacker is moving its claim, not retiring it — deleting
         # the claim under the mover would strand the half-move. The
@@ -346,6 +441,8 @@ class ClaimAutoscaler:
         live = [
             r for r in self.router.live_replicas() if not r.migrating
         ]
+        if role is not None:
+            live = [r for r in live if r.role == role]
         if len(self.router.live_replicas()) <= self.config.min_replicas:
             return None
         if not live:
@@ -359,8 +456,10 @@ class ClaimAutoscaler:
             key=lambda r: (not r.claim_name, len(r.inflight)),
         )
 
-    def _begin_scale_down(self, now: float) -> None:
-        victim = self._victim()
+    def _begin_scale_down(
+        self, now: float, role: Optional[str] = None
+    ) -> None:
+        victim = self._victim(role)
         if victim is None:
             return
         victim.quiesced = True

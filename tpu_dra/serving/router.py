@@ -245,12 +245,29 @@ class Replica:
     ``take_evacuated``) the autoscaler's scale-down drives."""
 
     def __init__(self, name: str, engine, claim_name: str = "",
-                 claim: Optional[dict] = None, metrics=None):
+                 claim: Optional[dict] = None, metrics=None,
+                 role: str = "both"):
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(
+                f"replica {name}: role must be prefill/decode/both, "
+                f"got {role!r}"
+            )
         self.name = name
         self.engine = engine
         self.claim_name = claim_name
         self.claim = claim
         self.metrics = metrics
+        # Phase role (ISSUE 17): "prefill" replicas take prompt
+        # dispatches and EXPORT each sequence to the decode pool at
+        # prefill completion (live paged-KV migration); "decode"
+        # replicas take migrated extents only; "both" is the colocated
+        # default — no exports, all dispatches, every pre-existing
+        # behavior unchanged.
+        self.role = role
+        # Set by the router's control loop: exports only run while a
+        # live decode-role replica exists to receive them (otherwise a
+        # fallback re-prefill would bounce straight back here).
+        self.export_enabled = False
         self.quiesced = False  # router stops dispatching; engine drains
         # Mid-repack (ISSUE 12): the repacker owns this replica's fate;
         # the autoscaler must not pick it as a scale-down victim (the
@@ -269,6 +286,13 @@ class Replica:
         self.watchdog: Optional[deadline.Budget] = None
         self._fault: Optional[str] = None  # chaos injection seam
         self.outbox: Deque[Completion] = collections.deque()
+        # KV-migration mailboxes (ISSUE 17). GIL-atomic deque append /
+        # popleft is the whole protocol: the engine thread produces
+        # exports and import results, the control thread consumes them
+        # (and produces the import inbox the engine thread consumes).
+        self.migration_outbox: Deque = collections.deque()  # SequenceExtent
+        self._import_inbox: Deque = collections.deque()  # (sx, t0)
+        self.import_results: Deque = collections.deque()  # (sx, ok, t0)
         self.inflight: Dict[str, _FabricReq] = {}  # router-thread-owned
         self._evac_request = threading.Event()
         self._evac_done = threading.Event()
@@ -331,6 +355,14 @@ class Replica:
         self.engine.add_request(req)
         self._wake.set()
 
+    def submit_extent(self, sx, t0: float) -> None:
+        """Hand a migrated sequence's KV extent to this replica's
+        engine thread for grafting (control thread side of the import
+        handshake). The result — grafted or rejected for capacity —
+        comes back through ``import_results``."""
+        self._import_inbox.append((sx, t0))  # lint: disable=R200 (GIL-atomic deque mailbox: control thread appends, engine thread popleft-drains)
+        self._wake.set()
+
     # --- evacuation handshake (autoscaler scale-down) ---
 
     def begin_evacuate(self) -> None:
@@ -372,8 +404,24 @@ class Replica:
                     self._evacuated = self.engine.evacuate()  # lint: disable=R200 (handshake-ordered: _evac_done.set() below is the fence the control-thread reader waits on)
                     self._evac_request.clear()
                     self._evac_done.set()
+                # Graft migrated-in extents BETWEEN steps (host-side,
+                # never concurrent with a chunk). A capacity rejection
+                # is a normal result — the router falls back to
+                # re-prefill dispatch.
+                while self._import_inbox:
+                    sx, t0 = self._import_inbox.popleft()  # lint: disable=R200 (GIL-atomic deque mailbox: consumer side of submit_extent)
+                    ok = self.engine.import_sequence(sx)
+                    self.import_results.append((sx, ok, t0))
                 busy = self.engine.step() if self.engine.busy else False
                 self._drain_outbox()
+                if self.role == "prefill" and self.export_enabled:
+                    # Phase handoff (ISSUE 17): every sequence that
+                    # completed prefill ships its pages to the decode
+                    # pool instead of decoding here.
+                    for rid in self.engine.decoding_rids():
+                        self.migration_outbox.append(
+                            self.engine.export_sequence(rid)
+                        )
                 if not busy:
                     self._wake.wait(0.002)
                     self._wake.clear()
@@ -450,6 +498,21 @@ class Router:
         self.peak_concurrent = 0
         self._backlog_tokens = 0.0  # queued + inflight costs
         self._inflight_tokens = 0.0  # dispatched-not-completed costs
+        # Per-phase split of the queued work (ISSUE 17): prefill-side
+        # tokens still to be computed (prompt + folded emitted at next
+        # dispatch) vs decode-side tokens still owed (remaining), for
+        # queued requests plus the migration waiting room. The sums
+        # track the same mutations as _backlog/_inflight under the same
+        # lock; the autoscaler sizes the two phase pools from them.
+        self._queued_prefill_tokens = 0.0
+        self._queued_decode_tokens = 0.0
+        # Migration waiting room (ISSUE 17): sequences exported off a
+        # prefill replica, pages in hand, waiting for a decode replica
+        # with headroom. Control-thread-owned.
+        self._migrating: Deque = collections.deque()  # (sx, fr, t0)
+        self.kv_migrations: Dict[str, int] = {}  # outcome -> count
+        self.kv_migrated_pages = 0
+        self.migration_seconds: List[float] = []
         self.affinity_hits = 0
         self.affinity_misses = 0
         self.max_lag_tokens = 0.0  # high-water starvation lag observed
@@ -561,6 +624,8 @@ class Router:
             ts.tail_tag = fr.finish_tag
             ts.queue.append(fr)
             self._backlog_tokens += cost
+            self._queued_prefill_tokens += len(fr.prompt)
+            self._queued_decode_tokens += fr.max_new
             self._in_system += 1
             self.peak_concurrent = max(self.peak_concurrent, self._in_system)
         return True
@@ -574,6 +639,13 @@ class Router:
         when any work moved. A replica death never raises out of here —
         it is detected, contained, and recovered (ISSUE 16)."""
         moved = self._reap()
+        # Migrations settle BEFORE completions: a fast decode replica
+        # can graft an extent AND finish the sequence inside one poll
+        # interval — collecting the completion first would pop the
+        # in-flight entry and orphan the import result (the migration
+        # would never count as shipped).
+        moved = self._collect_migrations() or moved
+        moved = self._dispatch_migrations() or moved
         moved = self._collect() or moved
         moved = self._dispatch() or moved
         now = self.clock()
@@ -685,6 +757,10 @@ class Router:
                 fr.start_tag = fr.finish_tag = self._vtime
                 ts.queue.appendleft(fr)
                 self._inflight_tokens -= fr.cost
+                self._queued_prefill_tokens += (
+                    len(fr.prompt) + len(fr.emitted)
+                )
+                self._queued_decode_tokens += fr.remaining
                 self.redispatched += 1
             n += 1
         return n
@@ -727,6 +803,10 @@ class Router:
                 fr.start_tag = fr.finish_tag = self._vtime
                 ts.queue.appendleft(fr)
                 self._backlog_tokens += fr.cost
+                self._queued_prefill_tokens += (
+                    len(fr.prompt) + len(fr.emitted)
+                )
+                self._queued_decode_tokens += fr.remaining
                 self._in_system += 1
             n += 1
         with self._lock:
@@ -753,6 +833,24 @@ class Router:
 
     def in_system(self) -> int:
         return self._in_system
+
+    def queued_prefill_tokens(self) -> float:
+        """Prefill-side queued work: prompt (+ folded emitted) tokens
+        the next dispatches will have to compute — the signal that says
+        the PREFILL pool is too small."""
+        with self._lock:
+            return self._queued_prefill_tokens
+
+    def queued_decode_tokens(self) -> float:
+        """Decode-side queued work: tokens still owed by queued
+        requests plus the migration waiting room — the signal that says
+        the DECODE pool is too small."""
+        with self._lock:
+            return self._queued_decode_tokens
+
+    def migration_backlog(self) -> int:
+        """Extents waiting for a decode replica with headroom."""
+        return len(self._migrating)
 
     # --- WFQ dispatch ---
 
@@ -786,6 +884,13 @@ class Router:
             r for r in self.live_replicas()
             if not self.breaker.is_open(r.claim_name or r.name)
         ]
+        # Phase roles (ISSUE 17): prompt dispatches go to
+        # prefill-capable replicas; the decode pool only receives
+        # migrated extents. If every prefill-capable replica is gone
+        # (deaths outpacing replacement), serving degraded on the
+        # decode pool beats deadlocking the queue.
+        prefill_capable = [r for r in live if r.role != "decode"]
+        live = prefill_capable or live
         if not live:
             return None
         cap = self.config.max_inflight_per_replica
@@ -820,6 +925,10 @@ class Router:
                 ts.queue.popleft()
                 self._vtime = max(self._vtime, fr.start_tag)
                 self._inflight_tokens += fr.cost
+                self._queued_prefill_tokens -= (
+                    len(fr.prompt) + len(fr.emitted)
+                )
+                self._queued_decode_tokens -= fr.remaining
                 # Read under the same lock submit() mutates it under.
                 popular = (
                     fr.prefix_key is not None
@@ -967,6 +1076,149 @@ class Router:
                 moved = True
         return moved
 
+    # --- live KV migration (ISSUE 17) ---
+
+    def _decode_pool(self) -> List[Replica]:
+        return [
+            r for r in self.live_replicas()
+            if r.role == "decode"
+            and not self.breaker.is_open(r.claim_name or r.name)
+        ]
+
+    def _collect_migrations(self) -> bool:
+        """Drain both migration mailboxes: exports coming OFF prefill
+        replicas enter the waiting room (journal updated FIRST — from
+        this moment a crash anywhere replays ``prompt + emitted`` by
+        re-prefill, losing and duplicating nothing), and import results
+        coming back from decode replicas settle as shipped (pages
+        grafted, decode resumed) or fall back to re-prefill dispatch."""
+        moved = False
+        has_decode = bool(self._decode_pool())
+        now = self.clock()
+        for rep in self.replicas:
+            if rep.role == "prefill":
+                rep.export_enabled = has_decode and not rep.quiesced  # lint: disable=R200 (GIL-atomic bool gate read by the engine thread before each export batch)
+            while rep.migration_outbox:
+                sx = rep.migration_outbox.popleft()
+                fr = rep.inflight.pop(sx.req.rid, None)
+                if fr is None:
+                    # Journal-recovered off this replica already (the
+                    # death path owns it); the extent is just pages —
+                    # dropping it loses nothing.
+                    continue
+                if len(sx.emitted):
+                    fr.emitted = np.concatenate([fr.emitted, sx.emitted])
+                if fr.t_first is None:
+                    fr.t_first = sx.t_first
+                # Crash-safety line: the journal's emitted-so-far is
+                # current BEFORE the extent travels anywhere, so a death
+                # mid-transfer (source already released its pages) falls
+                # back to journal replay — re-prefill, token-identical
+                # under the pinned (seed, serial, position) schedule.
+                self.journal.note_progress(fr.rid, fr.emitted, fr.t_first)
+                with self._lock:
+                    self._inflight_tokens -= fr.cost
+                    self._queued_decode_tokens += fr.remaining
+                self._migrating.append((sx, fr, now))  # lint: disable=R200 (control-thread-owned: every reader/writer of the migration waiting room and counters runs on the single poll() thread)
+                moved = True
+            while rep.import_results:
+                sx, ok, t0 = rep.import_results.popleft()
+                fr = rep.inflight.get(sx.req.rid)
+                if fr is None:
+                    continue  # reclaimed by a death in between
+                if ok:
+                    dt = now - t0
+                    self.kv_migrations["shipped"] = (  # lint: disable=R200 (control-thread-owned: every reader/writer of the migration waiting room and counters runs on the single poll() thread)
+                        self.kv_migrations.get("shipped", 0) + 1
+                    )
+                    self.kv_migrated_pages += sx.extent.n_pages
+                    self.migration_seconds.append(dt)
+                    if self.metrics is not None:
+                        self.metrics.inc(
+                            "fabric_kv_migrations_total",
+                            labels={"outcome": "shipped"},
+                        )
+                        self.metrics.inc(
+                            "fabric_kv_migrated_pages_total",
+                            float(sx.extent.n_pages),
+                        )
+                        self.metrics.observe(
+                            "fabric_kv_migration_seconds", dt
+                        )
+                    trace.record_span(
+                        "serving.request.migrate", t0, now,
+                        ctx=fr.trace_ctx,
+                        attrs={
+                            "rid": fr.rid, "to_replica": rep.name,
+                            "pages": int(sx.extent.n_pages),
+                        },
+                    )
+                else:
+                    # Capacity race on the destination: the sequence is
+                    # NOT lost — it re-enters the WFQ front and the next
+                    # dispatch re-prefills prompt + emitted.
+                    rep.inflight.pop(sx.req.rid)
+                    with self._lock:
+                        self._inflight_tokens -= fr.cost
+                    self._migration_fallback(fr)
+                moved = True
+        return moved
+
+    def _dispatch_migrations(self) -> bool:
+        """Move the waiting room onto decode replicas with headroom.
+        With no decode pool at all (scaled away, all dead), waiting
+        would deadlock — every extent falls back to re-prefill."""
+        moved = False
+        cap = self.config.max_inflight_per_replica
+        while self._migrating:
+            pool = self._decode_pool()
+            if not pool:
+                sx, fr, _t0 = self._migrating.popleft()  # lint: disable=R200 (control-thread-owned: every reader/writer of the migration waiting room and counters runs on the single poll() thread)
+                with self._lock:
+                    self._queued_decode_tokens -= fr.remaining
+                self._migration_fallback(fr)
+                moved = True
+                continue
+            with_headroom = [r for r in pool if len(r.inflight) < cap]
+            if not with_headroom:
+                break  # decode pool full: extents wait, pages in hand
+            sx, fr, t0 = self._migrating.popleft()  # lint: disable=R200 (control-thread-owned: every reader/writer of the migration waiting room and counters runs on the single poll() thread)
+            rep = min(with_headroom, key=lambda r: len(r.inflight))
+            rep.inflight[fr.rid] = fr
+            fr.replicas.append(rep.name)
+            with self._lock:
+                self._queued_decode_tokens -= fr.remaining
+                self._inflight_tokens += fr.cost
+            # Write-ahead, like _dispatch: the journal names the decode
+            # replica BEFORE its engine can touch the extent.
+            self.journal.record(fr, rep.name)
+            rep.submit_extent(sx, t0)
+            moved = True
+        return moved
+
+    def _migration_fallback(self, fr: _FabricReq) -> None:
+        """Re-prefill fallback: splice the sequence back at its
+        tenant's queue front (virtual cost charged at first dispatch —
+        re-entry is free). The journal already carries every emitted
+        token, so nothing is lost and _collect's duplicate drop keeps
+        exactly-once intact."""
+        self.kv_migrations["fallback"] = (  # lint: disable=R200 (control-thread-owned: every reader/writer of the migration waiting room and counters runs on the single poll() thread)
+            self.kv_migrations.get("fallback", 0) + 1
+        )
+        if self.metrics is not None:
+            self.metrics.inc(
+                "fabric_kv_migrations_total",
+                labels={"outcome": "fallback"},
+            )
+        ts = self._tenants[fr.tenant]
+        with self._lock:
+            fr.start_tag = fr.finish_tag = self._vtime
+            ts.queue.appendleft(fr)
+            self._queued_prefill_tokens += (
+                len(fr.prompt) + len(fr.emitted)
+            )
+            self._queued_decode_tokens += fr.remaining
+
     # --- evacuation splice (autoscaler scale-down) ---
 
     def requeue_evacuated(self, rep: Replica) -> int:
@@ -996,6 +1248,10 @@ class Router:
                 fr.start_tag = fr.finish_tag = self._vtime
                 ts.queue.appendleft(fr)
                 self._inflight_tokens -= fr.cost
+                self._queued_prefill_tokens += (
+                    len(fr.prompt) + len(fr.emitted)
+                )
+                self._queued_decode_tokens += fr.remaining
             if fr.trace_ctx is not None:
                 # The span covers the HAND-BACK + front-splice only
                 # (the taxonomy's "evacuate" stage) — the sequence's
@@ -1048,6 +1304,28 @@ class Router:
                 "fabric_circuit_open",
                 float(len(self.breaker.open_keys())),
             )
+            # Per-phase backlog + migration waiting room (ISSUE 17):
+            # the autoscaler's pool-sizing signals, and the doctor's
+            # imbalance / migration-backlog probes.
+            m.set_gauge(
+                "fabric_queued_prefill_tokens",
+                self._queued_prefill_tokens,
+            )
+            m.set_gauge(
+                "fabric_queued_decode_tokens",
+                self._queued_decode_tokens,
+            )
+            m.set_gauge(
+                "fabric_migration_backlog", float(len(self._migrating))
+            )
+            roles = {"prefill": 0, "decode": 0, "both": 0}
+            for r in self.live_replicas():
+                roles[r.role] = roles.get(r.role, 0) + 1
+            for role, count in roles.items():
+                m.set_gauge(
+                    "fabric_phase_replicas", float(count),
+                    labels={"phase": role},
+                )
             for name, ts in self._tenants.items():
                 # Starvation lag (weighted tokens): how far the fabric
                 # clock ran past a backlogged tenant's head turn. Near

@@ -165,6 +165,28 @@ FABRIC_CIRCUIT_GAUGE = "fabric_circuit_open"
 FABRIC_DEGRADED_GAUGE = "fabric_degraded"
 FABRIC_INSYSTEM_GAUGE = "fabric_in_system_sequences"
 
+# Disaggregated-serving signals (ISSUE 17). fabric_migration_backlog is
+# the router's migration WAITING ROOM — exported extents whose pages
+# are in hand but which no decode replica has headroom to graft; a
+# backlog GROWING across the probe interval means the decode pool is
+# undersized (or dead) while prefill keeps exporting.
+# fabric_queued_prefill_tokens / fabric_queued_decode_tokens split the
+# queued-token backlog by phase, and fabric_phase_replicas{phase=}
+# counts the live pools — together they expose the imbalance shape:
+# one phase's per-replica backlog far above the other's while the
+# other pool sits idle.
+DISAGG_BACKLOG_GAUGE = "fabric_migration_backlog"
+DISAGG_PREFILL_GAUGE = "fabric_queued_prefill_tokens"
+DISAGG_DECODE_GAUGE = "fabric_queued_decode_tokens"
+DISAGG_PHASE_GAUGE = "fabric_phase_replicas"
+DISAGG_MIGRATIONS_COUNTER = "fabric_kv_migrations_total"
+# Imbalance warns only past BOTH bars: the loaded phase carries at
+# least IMBALANCE_X times the idle phase's per-replica backlog AND at
+# least FLOOR tokens absolute (sub-floor backlogs are noise on any
+# machine).
+DISAGG_IMBALANCE_X = 8.0
+DISAGG_IMBALANCE_FLOOR_TOKENS = 512.0
+
 # Elastic-repacker gauges (ISSUE 12), suffix-matched like the others.
 # repacker_frag_score is the fleet fragmentation the repacker itself
 # last observed; repacker_leader says whether this instance holds the
@@ -318,6 +340,9 @@ def probe_metrics(
         fabric = _check_fabric(ep, first, second, warn)
         if fabric:
             report[ep]["fabric"] = fabric
+        disagg = _check_disagg(ep, first, second, warn)
+        if disagg:
+            report[ep]["disagg"] = disagg
         repacker = _check_repacker(ep, first, second, warn)
         if repacker:
             report[ep]["repacker"] = repacker
@@ -663,6 +688,109 @@ def _check_fabric(
             f"claim, the scheduler's placement feasibility, and the "
             f"quarantine list (docs/serving.md, 'Failure semantics')"
         )
+    return out
+
+
+def _check_disagg(
+    ep: str, first: Dict[str, float], second: Optional[Dict[str, float]],
+    warn,
+) -> Dict[str, object]:
+    """Surface disaggregated-serving health (ISSUE 17): a migration
+    waiting room GROWING across the probe interval (exported page
+    extents piling up faster than the decode pool grafts them), and a
+    phase-pool imbalance (one phase's per-replica backlog far above
+    the other's while the other pool idles). Empty dict when the
+    endpoint runs no phase-role replicas — colocated fleets get no
+    disagg section."""
+    out: Dict[str, object] = {}
+    sample = second if second is not None else first
+    backlog = None
+    backlog_first = None
+    prefill_tokens = decode_tokens = 0.0
+    pools: Dict[str, int] = {}
+    migrations: Dict[str, int] = {}
+    for series, value in sorted(sample.items()):
+        name = series.split("{", 1)[0]
+        if name.endswith(DISAGG_BACKLOG_GAUGE):
+            backlog = value
+            if second is not None:
+                backlog_first = first.get(series)
+        elif name.endswith(DISAGG_PREFILL_GAUGE):
+            prefill_tokens = value
+        elif name.endswith(DISAGG_DECODE_GAUGE):
+            decode_tokens = value
+        elif name.endswith(DISAGG_PHASE_GAUGE):
+            pools[_label_of(series, "phase")] = int(value)
+        elif name.endswith(DISAGG_MIGRATIONS_COUNTER):
+            migrations[_label_of(series, "outcome")] = int(value)
+    n_p, n_d = pools.get("prefill", 0), pools.get("decode", 0)
+    if n_p == 0 and n_d == 0 and not (backlog or 0):
+        return out  # colocated fleet (or no fabric at all)
+    out["pools"] = pools
+    out["queued_prefill_tokens"] = prefill_tokens
+    out["queued_decode_tokens"] = decode_tokens
+    if backlog is not None:
+        out["migration_backlog"] = int(backlog)
+    if migrations:
+        out["migrations"] = migrations
+    if backlog:
+        if second is not None and backlog_first is not None:
+            grew = backlog - backlog_first
+            out["backlog_grew"] = grew
+            if grew > 0:
+                warn(
+                    f"{ep}: KV-migration backlog GROWING — "
+                    f"{DISAGG_BACKLOG_GAUGE} climbed by {grew:g} over "
+                    f"the probe interval (now {backlog:g} extents "
+                    f"waiting, pages already exported off the prefill "
+                    f"pool). The decode pool ({n_d} replica(s)) is not "
+                    f"grafting as fast as prefill exports: scale the "
+                    f"decode pool up, check for dead/quiesced decode "
+                    f"replicas, or lower the prefill pool's share "
+                    f"(docs/serving.md, 'Disaggregated serving')"
+                )
+        elif second is None:
+            warn(
+                f"{ep}: {DISAGG_BACKLOG_GAUGE} = {backlog:g} extents "
+                f"in the migration waiting room — re-run with "
+                f"--metrics-interval to see whether the decode pool is "
+                f"draining it or falling behind"
+            )
+    # Phase imbalance: per-replica backlog of one phase dwarfing the
+    # other's while that other pool idles. Warn only when BOTH pools
+    # exist (a missing pool is the outage check's job, not a tuning
+    # hint) and the loaded side clears the absolute floor.
+    if n_p > 0 and n_d > 0:
+        load_p = prefill_tokens / n_p
+        load_d = decode_tokens / n_d
+        if (
+            load_p > DISAGG_IMBALANCE_FLOOR_TOKENS
+            and load_p > DISAGG_IMBALANCE_X * max(load_d, 1.0)
+        ):
+            warn(
+                f"{ep}: phase-pool IMBALANCE — prefill backlog "
+                f"{prefill_tokens:g} tokens over {n_p} replica(s) "
+                f"({load_p:.0f}/replica) while the decode pool idles "
+                f"({load_d:.0f}/replica over {n_d}). TTFT is queueing "
+                f"on prompts the decode pool cannot help with: move "
+                f"replicas prefill-ward or let the disaggregated "
+                f"autoscaler resize the pools "
+                f"(docs/serving.md, 'Disaggregated serving')"
+            )
+        elif (
+            load_d > DISAGG_IMBALANCE_FLOOR_TOKENS
+            and load_d > DISAGG_IMBALANCE_X * max(load_p, 1.0)
+        ):
+            warn(
+                f"{ep}: phase-pool IMBALANCE — decode backlog "
+                f"{decode_tokens:g} tokens over {n_d} replica(s) "
+                f"({load_d:.0f}/replica) while the prefill pool idles "
+                f"({load_p:.0f}/replica over {n_p}). ITL is queueing "
+                f"on migrated sequences the prefill pool cannot help "
+                f"with: move replicas decode-ward or let the "
+                f"disaggregated autoscaler resize the pools "
+                f"(docs/serving.md, 'Disaggregated serving')"
+            )
     return out
 
 
@@ -1241,6 +1369,38 @@ def render(report: dict) -> str:
                 )
                 parts.append(f"lag{tenant}={st['lag']:g}{grew}")
             lines.append(f"  fabric: {' '.join(parts)}")
+        disagg = m.get("disagg") or {}
+        if disagg:
+            parts = []
+            pools = disagg.get("pools") or {}
+            if pools:
+                parts.append(
+                    "pools="
+                    + ",".join(
+                        f"{k}:{v}" for k, v in sorted(pools.items())
+                    )
+                )
+            parts.append(
+                f"queued=p:{disagg.get('queued_prefill_tokens', 0):g}"
+                f"/d:{disagg.get('queued_decode_tokens', 0):g}"
+            )
+            if "migration_backlog" in disagg:
+                grew = (
+                    f"+{disagg['backlog_grew']:g}"
+                    if disagg.get("backlog_grew", 0) > 0 else ""
+                )
+                parts.append(
+                    f"backlog={disagg['migration_backlog']}{grew}"
+                )
+            mig = disagg.get("migrations") or {}
+            if mig:
+                parts.append(
+                    "migrations="
+                    + ",".join(
+                        f"{k}:{v}" for k, v in sorted(mig.items())
+                    )
+                )
+            lines.append(f"  disagg: {' '.join(parts)}")
         rep = m.get("repacker") or {}
         if rep:
             parts = []

@@ -246,6 +246,53 @@ class Evacuated:
         return self.req.max_new_tokens - len(self.emitted)
 
 
+@dataclasses.dataclass
+class SequenceExtent:
+    """One sequence lifted off an engine by :meth:`Engine.export_sequence`
+    — the live-migration unit (ISSUE 17). Unlike :class:`Evacuated`
+    (host checkpoint, resume re-prefills), this carries the sequence's
+    KV pages themselves (:class:`~tpu_dra.workloads.paged_kv.KVExtent`),
+    so :meth:`Engine.import_sequence` grafts them into the destination
+    and decode resumes WITHOUT recomputing a single position. The
+    payload is never the source of truth: ``req`` + ``emitted`` suffice
+    to rebuild by re-prefill (the crash fallback), token-identically
+    under greedy and the pinned (seed, serial, position) schedule."""
+
+    req: Request  # the SOURCE engine's request (prompt = its context)
+    emitted: np.ndarray  # tokens the source engine emitted (>= 1)
+    extent: object  # paged_kv.KVExtent covering [0, kv_len)
+    kv_len: int  # positions written on the source = len(prompt')-1
+    t_submit: float
+    t_first: Optional[float]
+    sample_seed: int  # the source engine's seed — pinned on resume
+    sample_serial: int  # the source sequence's sampling serial
+
+    @property
+    def remaining(self) -> int:
+        return self.req.max_new_tokens - len(self.emitted)
+
+    def resume_request(self) -> Request:
+        """The destination-side request: emitted tokens fold into the
+        prompt (exactly the fabric's re-dispatch shape), the sampling
+        schedule pins, and TTFT rides ``ttft_preobserved`` — the first
+        token already happened on the source, so the destination must
+        never observe a bogus near-zero sample."""
+        return Request(
+            rid=self.req.rid,
+            prompt=np.concatenate([
+                np.asarray(self.req.prompt, np.int32),
+                np.asarray(self.emitted, np.int32),
+            ]),
+            max_new_tokens=self.remaining,
+            arrival_s=0.0,
+            ttft_preobserved=self.t_first is not None,
+            prefix_id=self.req.prefix_id,
+            prefix_len=self.req.prefix_len,
+            sample_seed=self.sample_seed,
+            sample_serial=self.sample_serial,
+        )
+
+
 class _Sequence:
     """Engine-internal per-request state (the sequence-state store)."""
 
@@ -694,6 +741,196 @@ class Engine:
         self._inc("engine_evacuated_total", len(out))
         self._export()
         return out
+
+    # --- live KV migration (ISSUE 17) -------------------------------------
+
+    def decoding_rids(self) -> List[str]:
+        """rids of sequences that finished prefill and are actively
+        decoding — the migration candidates a prefill-role replica
+        ships to the decode pool."""
+        return [
+            s.req.rid
+            for s in self._slots
+            if s is not None and s.prefill_done and s.out
+        ]
+
+    def export_sequence(self, rid: str) -> SequenceExtent:
+        """Lift a decoding sequence off this engine: serialize its
+        block-table extent (K/V pools per page, int8 scales included),
+        release its slot and pages (each page decref'd exactly once —
+        shared-prefix pages stay pinned by the registry/other tables),
+        and forget the rid so a fallback may resubmit here. The
+        returned :class:`SequenceExtent` grafts into another engine via
+        :meth:`import_sequence` and decode resumes at the exact
+        position, token-identical to an un-migrated twin."""
+        from tpu_dra.workloads import paged_kv
+
+        if self.ec.contiguous:
+            raise ValueError(
+                "contiguous (oracle) engines do not export extents — "
+                "their block tables are fixed physical ranges"
+            )
+        seq = next(
+            (
+                s for s in self._slots
+                if s is not None and s.req.rid == rid
+            ),
+            None,
+        )
+        if seq is None or not seq.prefill_done or not seq.out:
+            raise ValueError(
+                f"rid {rid!r} is not an exportable decoding sequence"
+            )
+        slot = seq.slot
+        kv_len = int(self._lengths[slot])
+        page = self.ec.page_size
+        keep = -(-kv_len // page)
+        # Pages past the written extent exist only as scan-chunk slack
+        # and are entirely zero (the invariant) — they stay behind and
+        # free with the slot.
+        extent = paged_kv.serialize_extent(
+            self.cache, seq.pages[:keep], kv_len
+        )
+        sx = SequenceExtent(
+            req=seq.req,
+            emitted=np.asarray(seq.out, np.int32),
+            extent=extent,
+            kv_len=kv_len,
+            t_submit=seq.t_submit,
+            t_first=seq.t_first,
+            sample_seed=self.ec.sample_seed,
+            sample_serial=seq.sample_serial,
+        )
+        self._release_slot(slot)
+        self._rids.discard(rid)
+        self._progress += 1
+        self._inc("engine_kv_exports_total")
+        return sx
+
+    def import_sequence(
+        self, sx: SequenceExtent, req: Optional[Request] = None
+    ) -> bool:
+        """Graft an exported sequence into this engine and resume its
+        decode at position ``kv_len`` — no position recomputed. False
+        when the engine lacks a free slot or page headroom RIGHT NOW
+        (normal backpressure: the caller falls back to re-prefill
+        dispatch); config mismatches raise. Leading full pages of a
+        prefix this engine already has registered attach by INCREF
+        instead of copying (the by-id carry), and the imported prefix
+        registers here for future sharers."""
+        from tpu_dra.workloads import paged_kv
+
+        if self.ec.contiguous:
+            raise ValueError(
+                "contiguous (oracle) engines do not import extents"
+            )
+        req = req if req is not None else sx.resume_request()
+        if req.rid in self._rids:
+            raise ValueError(f"duplicate request rid {req.rid!r}")
+        if (
+            req.sample_seed is not None
+            and req.sample_seed != self.ec.sample_seed
+        ):
+            raise ValueError(
+                f"request {req.rid}: pinned sample_seed "
+                f"{req.sample_seed} != engine seed {self.ec.sample_seed}"
+            )
+        if sx.extent.page_size != self.ec.page_size:
+            raise ValueError(
+                f"extent page_size {sx.extent.page_size} != engine "
+                f"page_size {self.ec.page_size}"
+            )
+        if len(req.prompt) != sx.kv_len + 1 or req.max_new_tokens < 1:
+            raise ValueError(
+                f"request {req.rid}: prompt must cover kv_len "
+                f"{sx.kv_len} + 1 not-yet-written token, with >= 1 "
+                f"token still owed"
+            )
+        total = (
+            len(req.prompt) + req.max_new_tokens + self.ec.scan_chunk
+        )
+        if total > self.ec.max_pages_per_seq * self.ec.page_size:
+            raise ValueError(
+                f"request {req.rid}: prompt {len(req.prompt)} + "
+                f"max_new {req.max_new_tokens} (+ chunk slack "
+                f"{self.ec.scan_chunk}) exceeds the per-sequence page "
+                f"budget {self.ec.max_pages_per_seq}x{self.ec.page_size}"
+            )
+        slot = next(
+            (i for i, s in enumerate(self._slots) if s is None), None
+        )
+        if slot is None:
+            return False
+        self._serial += 1
+        seq = _Sequence(req, t_submit=sx.t_submit, serial=self._serial)
+        seq.t_first = sx.t_first
+        seq.prefill_done = True
+        seq.prefill_cursor = len(seq.context)
+        need = self._pages_for(seq)
+        if not self.allocator.reserve(need):
+            while self._prefix_registry and not self.allocator.can_reserve(
+                need
+            ):
+                self._evict_one_prefix()
+            if not self.allocator.reserve(need):
+                return False
+        self._rids.add(req.rid)
+        seq.slot = slot
+        seq.reserved_left = need
+        # Import-side by-id carry: leading FULL pages of a registered
+        # matching prefix attach via incref — the extent's payload for
+        # those slots is ignored (prefill KV is a deterministic
+        # function of the tokens, so the registered pages hold
+        # byte-identical content).
+        attach: Dict[int, int] = {}
+        entry = (
+            self._prefix_registry.get(req.prefix_id)
+            if req.prefix_id else None
+        )
+        if entry is not None and np.array_equal(
+            seq.context[: entry.length], entry.tokens
+        ):
+            n_full = min(
+                entry.length // self.ec.page_size, sx.extent.n_pages
+            )
+            for j in range(n_full):
+                attach[j] = entry.pages[j]
+        # Deferred zeroing must land before any freed page can be
+        # re-allocated into the graft.
+        self._flush_zero()
+
+        def _alloc():
+            self.allocator.unreserve(1)
+            seq.reserved_left -= 1
+            return self.allocator.alloc()
+
+        self.cache, pages = paged_kv.graft_extent(
+            self.cache, self.allocator, sx.extent,
+            alloc=_alloc, attach=attach,
+        )
+        if attach:
+            # Attached pages come off the worst-case reservation (full
+            # pages only — writes land past them, COW forks are the
+            # write path's business).
+            release = min(len(attach), seq.reserved_left)
+            if release > 0:
+                self.allocator.unreserve(release)
+                seq.reserved_left -= release
+            self.prefix_attached += 1
+            self._inc("engine_prefix_attached_total")
+        seq.pages = pages
+        self._slots[slot] = seq
+        self._tables[slot, : len(pages)] = pages
+        self._lengths[slot] = sx.kv_len
+        self._last_tokens[slot] = int(seq.context[-1])
+        self._active[slot] = True
+        self._seeds[slot] = seq.sample_serial
+        self._dev_state = None
+        self._maybe_register_prefix(seq)
+        self._track_shared()
+        self._progress += 1
+        self._inc("engine_kv_imports_total")
+        return True
 
     def _live(self):
         """Every not-yet-completed sequence, exactly once (prefilling

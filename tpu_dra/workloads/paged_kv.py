@@ -361,3 +361,162 @@ def pages_are_zero(cache: PagedKVCache, page_ids) -> bool:
                 ):
                     return False
     return True
+
+
+# --- KV extents: ship a sequence's pages between caches (ISSUE 17) ---
+
+
+@dataclasses.dataclass
+class KVExtent:
+    """A sequence's KV state lifted off its cache — the transferable
+    unit behind live prefill→decode migration.
+
+    ``slots[i]`` describes position range ``[i*page_size,
+    (i+1)*page_size)`` of the sequence: either ``("page", source_id)``
+    for a page carried BY ID (a shared-prefix page both caches can
+    already reach — grafting increfs it instead of copying), or
+    ``("payload", j)`` for a page whose content rides in ``payload``
+    at row ``j``. ``payload`` maps pool name ("k"/"v" and, in int8
+    mode, "k_scale"/"v_scale") to an L-tuple of host arrays of shape
+    ``[n_payload, page_size, ...]`` — full pages including their zero
+    tails, so the zero-tail invariant transfers with the content and
+    needs no re-establishment on the destination."""
+
+    page_size: int
+    length: int
+    quantized: bool
+    slots: tuple  # of ("page", id) | ("payload", row)
+    payload: dict  # pool name -> L-tuple of [n_payload, page, ...] host
+
+    @property
+    def n_pages(self) -> int:
+        return len(self.slots)
+
+    @property
+    def n_payload_pages(self) -> int:
+        return sum(1 for kind, _ in self.slots if kind == "payload")
+
+    @property
+    def n_shared_pages(self) -> int:
+        return self.n_pages - self.n_payload_pages
+
+    @property
+    def nbytes(self) -> int:
+        return sum(
+            layer.nbytes for pool in self.payload.values() for layer in pool
+        )
+
+
+def serialize_extent(
+    cache: PagedKVCache, pages, length: int, by_id=()
+) -> KVExtent:
+    """Gather a sequence's block-table extent off ``cache`` into host
+    memory. ``pages`` is the ordered page-id list covering ``length``
+    written positions; ids in ``by_id`` (shared-prefix pages the
+    destination can reach without a copy) are carried by reference, the
+    rest as full-page payload — one device gather per pool per layer,
+    not per page. The caller must have flushed any deferred page
+    zeroing first: a payload page is copied verbatim, zero tail and
+    all."""
+    import numpy as np
+
+    pages = [int(p) for p in pages]
+    by_id = set(int(p) for p in by_id)
+    if length > len(pages) * cache.page_size:
+        raise ValueError(
+            f"length {length} exceeds {len(pages)} pages of "
+            f"{cache.page_size}"
+        )
+    slots = []
+    rows = []
+    for pid in pages:
+        if pid in by_id:
+            slots.append(("page", pid))
+        else:
+            slots.append(("payload", len(rows)))
+            rows.append(pid)
+    payload = {}
+    if rows:
+        ids = jnp.asarray(rows, jnp.int32)
+        for name, pool in cache._pools():
+            payload[name] = tuple(
+                np.asarray(jax.device_get(layer[ids])) for layer in pool
+            )
+    else:
+        for name, _pool in cache._pools():
+            payload[name] = ()
+    return KVExtent(
+        page_size=cache.page_size,
+        length=length,
+        quantized=cache.quantized,
+        slots=tuple(slots),
+        payload=payload,
+    )
+
+
+def graft_extent(
+    cache: PagedKVCache,
+    allocator: PageAllocator,
+    extent: KVExtent,
+    *,
+    alloc=None,
+    id_map=None,
+    attach=None,
+):
+    """Materialize ``extent`` into ``cache``/``allocator``: by-id slots
+    are INCREF'd (through ``id_map`` when the destination knows the
+    shared pages under different ids), payload slots get fresh pages via
+    ``alloc`` (defaults to ``allocator.alloc`` — the engine passes a
+    callable that also burns its admission reservation) and one scatter
+    per pool per layer writes their content. ``attach`` maps slot INDEX
+    -> destination page id the importer already holds equivalent
+    content for (a registered shared prefix): those slots incref the
+    destination page instead of copying, payload or not. Returns
+    ``(new_cache, pages)`` with ``pages`` the sequence's ordered block
+    table. On any failure nothing is left allocated or increfed."""
+    if extent.page_size != cache.page_size:
+        raise ValueError(
+            f"extent page_size {extent.page_size} != cache "
+            f"{cache.page_size}"
+        )
+    if extent.quantized != cache.quantized:
+        raise ValueError("extent/cache kv-quantization modes differ")
+    alloc = alloc or allocator.alloc
+    id_map = id_map or {}
+    attach = attach or {}
+    pages = []
+    increfed = []
+    fresh = []
+    rows = []  # (payload row, fresh page) scatter pairs
+    try:
+        for i, (kind, val) in enumerate(extent.slots):
+            if i in attach:
+                pid = int(attach[i])
+                allocator.incref(pid)
+                increfed.append(pid)
+            elif kind == "page":
+                pid = int(id_map.get(val, val))
+                allocator.incref(pid)
+                increfed.append(pid)
+            else:
+                pid = alloc()
+                fresh.append(pid)
+                rows.append(val)
+            pages.append(pid)
+    except BaseException:
+        for pid in increfed:
+            allocator.decref(pid)
+        for pid in fresh:
+            allocator.decref(pid)
+        raise
+    if fresh:
+        dst = jnp.asarray(fresh, jnp.int32)
+        sel = jnp.asarray(rows, jnp.int32)
+        out = {}
+        for name, pool in cache._pools():
+            out[name] = tuple(
+                layer.at[dst].set(jnp.asarray(prows)[sel])
+                for layer, prows in zip(pool, extent.payload[name])
+            )
+        cache = PagedKVCache(**out)
+    return cache, pages
