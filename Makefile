@@ -3,7 +3,7 @@
 IMAGE_REPO ?= registry.local/tpu-dra-driver
 IMAGE_TAG  ?= v0.1.0
 
-.PHONY: all native test test-slow bench decodebench allocbench enginebench specbench shardbench fleetbench fabricbench faultbench disaggbench repackbench tracecheck slocheck image bats lint lint-fast shlint chaos crashmatrix apisoak ci clean
+.PHONY: all native test test-slow bench decodebench allocbench enginebench specbench shardbench fleetbench fabricbench faultbench disaggbench repackbench tracecheck slocheck image bats lint lint-fast shlint lockdep lock-graph chaos crashmatrix apisoak ci clean
 
 all: native test
 
@@ -208,15 +208,18 @@ batsless: native
 # B006/F541/W605), scoped undefined names (F821), the lock-discipline
 # race lint (R200), JAX tracer-safety over workloads (J300), feature-
 # gate dominance (G400), the layer-DAG import check (L500), blocking-
-# in-async (A600), chaos fault-schedule validation (C90x) and the
-# append-only bench schema (B100) — per-pass timings + total findings
-# print on stderr; suppressions live in hack/lint-baseline.json
+# in-async (A600), chaos fault-schedule validation (C90x), the
+# append-only bench schema (B100), and the whole-program concurrency
+# suite (D800 lock-order cycles, D801 blocking-under-lock, D802
+# thread-ownership, D803 annotation drift) — per-pass timings + total
+# findings print on stderr; the --budget gate keeps the whole suite
+# inside hack/lint-budget.json; suppressions live in hack/lint-baseline.json
 # (shrink-only, enforced by the linter). docs/static-analysis.md has
 # every code's rationale. Plus the bash/bats syntax gate (shlint).
 LINT_ROOTS = tpu_dra hack tests demo bench.py __graft_entry__.py
 
 lint:
-	python hack/lint.py $(LINT_ROOTS)
+	python hack/lint.py --budget hack/lint-budget.json $(LINT_ROOTS)
 
 # Inner loop: changed-files-only (git diff vs HEAD + untracked).
 lint-fast:
@@ -253,6 +256,31 @@ apisoak:
 shlint:
 	bash hack/shlint.sh
 
+# Runtime lockdep (ISSUE 18): run the fabric/fault/repack smokes under
+# the env-gated lock shim (tpu_dra/infra/lockdep.py) — every run must
+# end with an acyclic observed acquisition graph and every declared
+# single-owner role driven by one thread — then diff the observed
+# graphs against the static D800 graph (hack/lockdep_diff.py): a
+# runtime lock or acquisition edge the static pass never derived is an
+# interprocedural blind spot and fails the target. The committed
+# docs/lock-order.dot is regenerated by `make lock-graph`.
+LOCKDEP_DUMPS = /tmp/tpu-dra-lockdep
+
+lockdep:
+	mkdir -p $(LOCKDEP_DUMPS)
+	TPU_DRA_LOCKDEP=1 TPU_DRA_LOCKDEP_DUMP=$(LOCKDEP_DUMPS)/fabric.json \
+		python -m tpu_dra.infra.lockdep tpu_dra.serving.fabricbench --smoke
+	TPU_DRA_LOCKDEP=1 TPU_DRA_LOCKDEP_DUMP=$(LOCKDEP_DUMPS)/fault.json \
+		python -m tpu_dra.infra.lockdep tpu_dra.serving.faultbench --smoke
+	TPU_DRA_LOCKDEP=1 TPU_DRA_LOCKDEP_DUMP=$(LOCKDEP_DUMPS)/repack.json \
+		python -m tpu_dra.infra.lockdep tpu_dra.serving.repackbench --smoke
+	python hack/lockdep_diff.py $(LOCKDEP_DUMPS)/fabric.json \
+		$(LOCKDEP_DUMPS)/fault.json $(LOCKDEP_DUMPS)/repack.json
+
+# Regenerate the committed lock-order graph from the static D800 pass.
+lock-graph:
+	python hack/lint.py tpu_dra --select D800 --graph docs/lock-order.dot
+
 # THE merge bar (.github/workflows/ci.yaml runs exactly this): one
 # command reproduces the full green record from a clean tree — lint
 # (the full suite; lint-fast also runs once so the changed-files
@@ -261,7 +289,7 @@ shlint:
 # (flakes surface in CI, not in the judge's rerun), the 13 bats suites
 # executed against the minicluster, the batsless process-level e2e, and
 # the bench artifact schema gate.
-ci: lint lint-fast shlint native chaos crashmatrix apisoak decodebench allocbench enginebench specbench shardbench fleetbench fabricbench faultbench disaggbench repackbench tracecheck slocheck
+ci: lint lint-fast shlint lockdep native chaos crashmatrix apisoak decodebench allocbench enginebench specbench shardbench fleetbench fabricbench faultbench disaggbench repackbench tracecheck slocheck
 	python -m pytest tests/ -q -m 'not slow'
 	python -m pytest tests/ -q -m 'not slow'
 	python -m pytest tests/test_chaos.py -q -m slow

@@ -2291,4 +2291,11 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    # Runtime lockdep (TPU_DRA_LOCKDEP=1): observe every lock the legs
+    # take, assert acyclicity + ownership at exit (docs/static-analysis.md).
+    from tpu_dra.infra import lockdep as _lockdep
+
+    _lockdep.install_if_enabled()
+    _rc = main()
+    _lockdep.check()
+    raise SystemExit(_rc)

@@ -16,6 +16,7 @@ table and the `lint: N files, M finding(s)` summary go to stderr.
 from __future__ import annotations
 
 import argparse
+import json
 import subprocess
 import sys
 import time
@@ -40,6 +41,7 @@ from lints import spannames   # noqa: F401
 from lints import sleeps      # noqa: F401
 from lints import chaosjson   # noqa: F401
 from lints import benchkeys   # noqa: F401
+from lints import lockdep     # noqa: F401
 
 REPO_ROOT = Path(__file__).resolve().parent.parent.parent
 
@@ -114,6 +116,16 @@ def main(argv: List[str]) -> int:
     ap.add_argument(
         "--no-baseline", action="store_true",
         help="ignore the baseline (report every finding)",
+    )
+    ap.add_argument(
+        "--graph", nargs="?", const="-", default=None, metavar="PATH",
+        help="emit the discovered lock-order graph as GraphViz DOT to "
+             "PATH (default stdout) instead of exiting on findings",
+    )
+    ap.add_argument(
+        "--budget", default=None, metavar="PATH",
+        help="runtime-budget file ({\"total_ms\": N}); fail when the "
+             "suite takes >20%% longer than N, naming the slowest pass",
     )
     args = ap.parse_args(argv)
 
@@ -231,4 +243,45 @@ def main(argv: List[str]) -> int:
         f"{extra} [{total_ms:.0f}ms total]",
         file=sys.stderr,
     )
+
+    if args.graph is not None:
+        dot = ""
+        for p in passes:
+            if hasattr(p, "dot") and p.analysis is not None:
+                dot = p.dot()
+        if not dot:
+            print("lint: --graph needs the D800 pass (drop --select or "
+                  "include D800)", file=sys.stderr)
+            return 2
+        if args.graph == "-":
+            sys.stdout.write(dot)
+        else:
+            Path(args.graph).write_text(dot, encoding="utf-8")
+            print(f"lint: lock-order graph -> {args.graph}",
+                  file=sys.stderr)
+        return 0
+
+    if args.budget:
+        bpath = Path(args.budget)
+        try:
+            budget_ms = float(
+                json.loads(bpath.read_text(encoding="utf-8"))["total_ms"]
+            )
+        except (OSError, ValueError, KeyError) as exc:
+            print(f"lint: unreadable budget file {bpath}: {exc}",
+                  file=sys.stderr)
+            return 2
+        allowed = budget_ms * 1.2
+        if total_ms > allowed:
+            slowest = max(timings, key=timings.get)
+            print(
+                f"lint: runtime budget exceeded: {total_ms:.0f}ms > "
+                f"{allowed:.0f}ms (120% of the {budget_ms:.0f}ms budget "
+                f"in {bpath.name}); slowest pass: {slowest} "
+                f"({timings[slowest] * 1000:.0f}ms) — optimize it or "
+                f"raise the budget deliberately",
+                file=sys.stderr,
+            )
+            return 1
+
     return 1 if ordered else 0

@@ -1,0 +1,1178 @@
+"""D800–D803 — lockdep: whole-program lock-order + thread-ownership.
+
+The Go reference merges nothing without golangci-lint PLUS the race
+detector. R200 covers the per-class lock-discipline half; this pass is
+the other half the race detector provides in Go: lock *ordering*
+across components and the thread-ownership contracts the serving
+fabric depends on, checked statically (the runtime twin is
+:mod:`tpu_dra.infra.lockdep`, validated against this pass by
+``make lockdep``).
+
+The analysis discovers every ``threading.Lock/RLock/Condition`` in the
+tree (``self.x = threading.Lock()`` class attrs and module-level
+``_LOCK = threading.Lock()`` globals; ``Condition(self._lock)``
+aliases to its underlying lock) and builds an interprocedural **lock
+acquisition graph** — nodes are lock classes (all instances of
+``Router._lock`` are one node, the classic lockdep reduction), edges
+mean "B acquired while A held". ``with self._lock:`` regions,
+``with a, b:`` multi-lock items (left-to-right edges), and explicit
+``.acquire()``/``.release()`` pairs are tracked through same-class,
+same-module and attr-typed cross-class calls (``self._router.poll()``
+resolves through ``self._router = Router(...)``), including
+``*_locked`` callees. ``acquire(blocking=False)`` is a trylock: it
+cannot block, so it takes no incoming edge, but locks acquired while
+it is held still edge FROM it.
+
+Codes:
+
+- **D800** — cycle in the acquisition graph: a potential deadlock.
+  Reported once per cycle with every edge's witness site. Self-edges
+  on an RLock are reentrancy, not deadlock, and are skipped.
+- **D801** — a blocking call while a lock is held: ``time.sleep`` /
+  ``Budget.sleep/pause``, ``Event.wait`` / ``Condition.wait`` on a
+  DIFFERENT lock than the one(s) held, ``Thread.join``,
+  ``Future.result``, socket/HTTP sends, ``subprocess.run``, and the
+  JAX host syncs (``block_until_ready``, ``jax.device_get``). Every
+  waiter on that lock inherits the stall. Waiting on the held
+  condition itself is the one sanctioned form (wait releases it).
+- **D802** — thread-ownership violation. The structured annotation
+  ``# thread: <domain>`` (grammar below) replaces the prose threading
+  contracts: annotated methods may only be called from methods of the
+  same domain, and annotated attrs may only be touched (read OR
+  written) by methods of their owning domain. Unannotated private
+  helpers inherit their callers' domain when all callers agree.
+- **D803** — annotation drift: malformed/misplaced ``# thread:``
+  markers, an annotated attr no code touches anymore, or an owned
+  attr whose class has no method of the owning domain left.
+
+Annotation grammar (one trailing comment, or the line above a def)::
+
+    def poll(self):  # thread: control
+    # thread: replica (entry: started by Replica.start)
+    def _loop(self):
+    self.inflight = {}  # thread: control
+    def submit(self, req):  # thread: any
+
+``<domain>`` is ``[A-Za-z_][A-Za-z0-9_-]*``; an ``-only`` suffix and
+an ``owner=`` prefix are accepted and stripped (``control-only`` and
+``owner=replica`` read naturally at attr sites); anything after the
+domain in parentheses is free-form justification. ``any`` is the
+explicit "safe from any thread" domain: an ``any`` method must not
+call into or touch single-domain state (that is the point of writing
+it down). Scope is the ``tpu_dra`` tree; ``workloads``/``tpulib``/
+``minicluster`` and bench/CLI mains drive everything single-threaded
+and are exempt from D801 (they sleep and sync on purpose) but still
+contribute locks and edges to the graph.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from typing import Dict, List, Optional, Set, Tuple
+
+from lints.base import (
+    FileContext, Finding, add_finding, disabled_codes, dotted_name,
+)
+from lints.registry import register
+
+LOCK_FACTORIES = {
+    "threading.Lock": "lock", "threading.RLock": "rlock",
+    "threading.Condition": "condition",
+    "Lock": "lock", "RLock": "rlock", "Condition": "condition",
+}
+
+# Layers where a blocking call under a lock is routine and single-
+# threaded by construction (JAX payloads block on device work; bench
+# mains and the minicluster drive everything from one thread). They
+# still contribute locks/edges to the graph — only D801 is scoped out.
+D801_EXEMPT_LAYERS = ("workloads", "tpulib", "minicluster")
+
+# Duck-typed attrs whose concrete project class is fixed by convention:
+# `self.metrics = metrics` is never annotated but is always the infra
+# Metrics sink (or None). Used as a fallback when neither a constructor
+# call nor a parameter annotation pins the type.
+WELL_KNOWN_ATTR_TYPES = {
+    "metrics": ("tpu_dra.infra.metrics", "Metrics"),
+    "_metrics": ("tpu_dra.infra.metrics", "Metrics"),
+}
+
+THREAD_ANN_RE = re.compile(r"#\s*thread:\s*(?P<rest>.*)$")
+DOMAIN_RE = re.compile(r"^(?:owner=)?(?P<dom>[A-Za-z_][A-Za-z0-9_-]*)")
+
+# Terminal attribute names that block unconditionally.
+ALWAYS_BLOCKING_ATTRS = {
+    "block_until_ready", "result", "wait_for", "getresponse", "sendall",
+    "recv", "accept", "urlopen",
+}
+BLOCKING_DOTTED = {
+    "jax.device_get", "socket.create_connection", "subprocess.run",
+    "subprocess.check_call", "subprocess.check_output", "subprocess.call",
+    "urllib.request.urlopen",
+}
+
+
+def _parse_domain(comment_rest: str) -> Optional[str]:
+    """Domain token out of the text after ``# thread:``; None = malformed."""
+    m = DOMAIN_RE.match(comment_rest.strip())
+    if not m:
+        return None
+    dom = m.group("dom")
+    if dom.endswith("-only"):
+        dom = dom[: -len("-only")]
+    return dom or None
+
+
+def _self_attr(node: ast.AST) -> str:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return ""
+
+
+class LockDef:
+    __slots__ = ("lid", "kind", "rel_path", "line")
+
+    def __init__(self, lid: str, kind: str, rel_path: str, line: int):
+        self.lid = lid        # display id, e.g. "serving.router.Router._lock"
+        self.kind = kind      # lock | rlock | condition
+        self.rel_path = rel_path
+        self.line = line
+
+
+class FuncModel:
+    """One function/method: its AST plus where it lives."""
+
+    __slots__ = ("key", "node", "ctx", "cls", "domain", "domain_line",
+                 "inherited_domain", "summary", "in_progress")
+
+    def __init__(self, key, node, ctx, cls):
+        self.key = key            # (module, qualname)
+        self.node = node
+        self.ctx = ctx
+        self.cls = cls            # ClassModel or None
+        self.domain: Optional[str] = None       # explicit annotation
+        self.domain_line: int = 0
+        self.inherited_domain: Optional[str] = None  # propagated
+        self.summary = None       # computed lazily (interprocedural)
+        self.in_progress = False  # recursion guard
+
+
+class ClassModel:
+    def __init__(self, module: str, node: ast.ClassDef, ctx: FileContext):
+        self.module = module
+        self.name = node.name
+        self.node = node
+        self.ctx = ctx
+        self.bases: List[str] = [dotted_name(b) for b in node.bases]
+        self.locks: Dict[str, LockDef] = {}       # attr -> LockDef
+        self.cond_alias: Dict[str, str] = {}      # cond attr -> lock attr
+        self.attr_types: Dict[str, Set[Tuple[str, str]]] = {}
+        self.thread_attrs: Set[str] = set()       # self.x = Thread(...)
+        self.methods: Dict[str, FuncModel] = {}
+        self.attr_domains: Dict[str, Tuple[str, int]] = {}
+        self._eff_locks: Optional[Dict[str, LockDef]] = None
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.module, self.name)
+
+
+class _Event:
+    """One propagated fact: a lock acquisition or a blocking call,
+    with the locks held AT that point (outermost first)."""
+
+    __slots__ = ("kind", "what", "held", "rel_path", "line", "via",
+                 "origin")
+
+    def __init__(self, kind, what, held, rel_path, line, via="",
+                 origin=None):
+        self.kind = kind      # "acquire" | "try_acquire" | "block"
+        self.what = what      # lock id, or blocking-call display name
+        self.held = held      # tuple of lock ids, acquisition order
+        self.rel_path = rel_path
+        self.line = line
+        self.via = via        # call chain, e.g. "poll -> _dispatch"
+        # Ultimate blocking site (rel_path, line), preserved through
+        # lifting so a disable marker AT the primitive ("this wait is
+        # deliberately budget-bounded") silences every lifted report.
+        self.origin = origin or (rel_path, line)
+
+
+class _Summary:
+    __slots__ = ("events",)
+
+    def __init__(self):
+        self.events: List[_Event] = []
+
+
+class Analysis:
+    """Whole-tree model: every class, function, lock; resolution."""
+
+    def __init__(self, ctxs: List[FileContext]):
+        self.classes: Dict[Tuple[str, str], ClassModel] = {}
+        self.mod_funcs: Dict[Tuple[str, str], FuncModel] = {}
+        self.mod_locks: Dict[str, Dict[str, LockDef]] = {}  # module -> name
+        self.imports: Dict[str, Dict[str, str]] = {}  # module -> name->dotted
+        self.locks: Dict[str, LockDef] = {}           # lid -> LockDef
+        # ``# thread:`` lines consumed by a def or attr assignment (for
+        # the D803 misplaced-marker check) and malformed marker sites.
+        self.ann_consumed: Dict[str, Set[int]] = {}
+        self.malformed: List[Tuple[FileContext, int, str]] = []
+        self.attr_uses: Dict[str, int] = {}
+        # Lock-order graph: (src lid, dst lid) -> witness sites.
+        self.edges: Dict[Tuple[str, str],
+                         List[Tuple[FileContext, int, str]]] = {}
+        self.d801: List[Tuple[FileContext, int, str]] = []
+        self._d801_seen: Set[Tuple[str, int, str]] = set()
+        self.ctxs = [c for c in ctxs if self._in_tree(c)]
+        self._by_rel: Dict[str, FileContext] = {
+            c.rel_path: c for c in self.ctxs
+        }
+        for ctx in self.ctxs:
+            self._index_file(ctx)
+
+    @staticmethod
+    def _in_tree(ctx: FileContext) -> bool:
+        mod = ctx.module_name
+        return mod == "tpu_dra" or mod.startswith("tpu_dra.")
+
+    @staticmethod
+    def _short(module: str) -> str:
+        return module[len("tpu_dra."):] if module.startswith("tpu_dra.") \
+            else module
+
+    # --- per-file indexing -------------------------------------------------
+
+    def _index_file(self, ctx: FileContext) -> None:
+        if ctx.tree is None:
+            return
+        mod = ctx.module_name
+        self.imports[mod] = imps = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute):
+                # Global attr-name tally: the D803 stale check must see
+                # cross-class touches (Router reads rep.inflight even
+                # though Replica itself never does).
+                self.attr_uses[node.attr] = \
+                    self.attr_uses.get(node.attr, 0) + 1
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    imps[(a.asname or a.name).split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    if a.name != "*":
+                        imps[a.asname or a.name] = f"{node.module}.{a.name}"
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.ClassDef):
+                self._index_class(mod, stmt, ctx)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                key = (mod, stmt.name)
+                self.mod_funcs[key] = FuncModel(key, stmt, ctx, None)
+                self._read_method_annotation(self.mod_funcs[key])
+            elif isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                value = stmt.value
+                if isinstance(value, ast.Call):
+                    kind = LOCK_FACTORIES.get(dotted_name(value.func))
+                    if kind:
+                        targets = (
+                            stmt.targets if isinstance(stmt, ast.Assign)
+                            else [stmt.target]
+                        )
+                        for t in targets:
+                            if isinstance(t, ast.Name):
+                                lid = f"{self._short(mod)}.{t.id}"
+                                ld = LockDef(lid, kind, ctx.rel_path,
+                                             stmt.lineno)
+                                self.mod_locks.setdefault(mod, {})[t.id] = ld
+                                self.locks[lid] = ld
+
+    def _index_class(self, mod: str, node: ast.ClassDef,
+                     ctx: FileContext) -> None:
+        cm = ClassModel(mod, node, ctx)
+        self.classes[cm.key] = cm
+        for m in node.body:
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fm = FuncModel((mod, f"{node.name}.{m.name}"), m, ctx, cm)
+                cm.methods[m.name] = fm
+                self._read_method_annotation(fm)
+        # Locks, attr types, and attr domains from every method (locks
+        # are usually minted in __init__ but start() patterns exist).
+        for m in cm.methods.values():
+            param_types: Dict[str, Tuple[str, str]] = {}
+            for arg in (m.node.args.args + m.node.args.kwonlyargs):
+                ann = arg.annotation
+                # Optional[X] / typing.Optional[X] unwraps to X.
+                if isinstance(ann, ast.Subscript) and dotted_name(
+                        ann.value).split(".")[-1] == "Optional":
+                    ann = ann.slice
+                tkey = self._resolve_type(mod, dotted_name(ann)) \
+                    if ann is not None else None
+                if tkey is not None:
+                    param_types[arg.arg] = tkey
+            for sub in ast.walk(m.node):
+                if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = (
+                    sub.targets if isinstance(sub, ast.Assign)
+                    else [sub.target]
+                )
+                attr = next(
+                    (a for a in (_self_attr(t) for t in targets) if a), ""
+                )
+                if not attr:
+                    continue
+                if isinstance(sub.value, ast.Name) and \
+                        sub.value.id in param_types:
+                    # self.circuit = circuit  (param typed CircuitBreaker)
+                    cm.attr_types.setdefault(attr, set()).add(
+                        param_types[sub.value.id]
+                    )
+                elif isinstance(sub.value, ast.Name) and \
+                        attr in WELL_KNOWN_ATTR_TYPES:
+                    # `self.metrics = metrics` is untyped everywhere in
+                    # this codebase yet always carries the same service
+                    # class; without this the runtime shim observes
+                    # <holder>._lock -> Metrics._lock edges the static
+                    # graph lacks (found by `make lockdep` divergence).
+                    wmod, wname = WELL_KNOWN_ATTR_TYPES[attr]
+                    if (wmod, wname) in self.classes:
+                        cm.attr_types.setdefault(attr, set()).add(
+                            (wmod, wname)
+                        )
+                if isinstance(sub.value, ast.Call):
+                    callee = dotted_name(sub.value.func)
+                    kind = LOCK_FACTORIES.get(callee)
+                    if kind:
+                        if kind == "condition" and sub.value.args:
+                            under = _self_attr(sub.value.args[0])
+                            if under:
+                                # Condition(self._lock): same lock.
+                                cm.cond_alias[attr] = under
+                                continue
+                        lid = (f"{self._short(mod)}.{node.name}.{attr}")
+                        ld = LockDef(lid, kind, ctx.rel_path, sub.lineno)
+                        cm.locks[attr] = ld
+                        self.locks[lid] = ld
+                        continue
+                    if callee in ("threading.Thread", "Thread",
+                                  "threading.Timer", "Timer"):
+                        cm.thread_attrs.add(attr)
+                    tkey = self._resolve_type(mod, callee)
+                    if tkey is not None:
+                        cm.attr_types.setdefault(attr, set()).add(tkey)
+                if m.node.name == "__init__":
+                    marker = self._marker(ctx, sub.lineno)
+                    if marker is not None:
+                        self._consume(ctx, sub.lineno)
+                        if marker:
+                            cm.attr_domains[attr] = (marker, sub.lineno)
+                        else:
+                            self.malformed.append(
+                                (ctx, sub.lineno, "unparseable domain")
+                            )
+
+    def _read_method_annotation(self, fm: FuncModel) -> None:
+        """Explicit ``# thread:`` on the def line or the line above."""
+        ctx, node = fm.ctx, fm.node
+        found: List[Tuple[int, Optional[str]]] = []
+        for lineno in (node.lineno, node.lineno - 1):
+            line = ctx.line(lineno)
+            if lineno != node.lineno and not line.lstrip().startswith("#"):
+                continue
+            marker = self._marker(ctx, lineno)
+            if marker is not None:
+                self._consume(ctx, lineno)
+                found.append((lineno, marker or None))
+        if not found:
+            return
+        fm.domain_line, fm.domain = found[0]
+        if fm.domain is None:
+            self.malformed.append(
+                (fm.ctx, fm.domain_line, "unparseable domain")
+            )
+        elif len(found) == 2 and found[1][1] not in (None, fm.domain):
+            self.malformed.append(
+                (fm.ctx, fm.domain_line,
+                 f"conflicting domains `{fm.domain}` (def line) vs "
+                 f"`{found[1][1]}` (line above)")
+            )
+
+    @staticmethod
+    def _marker(ctx: FileContext, lineno: int) -> Optional[str]:
+        """None = no ``# thread:`` marker on the line; "" = marker
+        present but the domain is unparseable; else the domain."""
+        m = THREAD_ANN_RE.search(ctx.line(lineno))
+        if not m:
+            return None
+        return _parse_domain(m.group("rest")) or ""
+
+    def _consume(self, ctx: FileContext, lineno: int) -> None:
+        self.ann_consumed.setdefault(ctx.rel_path, set()).add(lineno)
+
+    # --- resolution --------------------------------------------------------
+
+    def _resolve_type(self, mod: str,
+                      callee: str) -> Optional[Tuple[str, str]]:
+        """(module, ClassName) for `Name(...)` / `pkg.Name(...)` when it
+        is a project class; None otherwise."""
+        if not callee:
+            return None
+        head, _, rest = callee.partition(".")
+        imps = self.imports.get(mod, {})
+        if not rest:
+            if (mod, callee) in self.classes:
+                return (mod, callee)
+            full = imps.get(callee, "")
+            if full:
+                fmod, _, fname = full.rpartition(".")
+                if (fmod, fname) in self.classes:
+                    return (fmod, fname)
+            return None
+        base = imps.get(head, "")
+        if base:
+            cand = f"{base}.{rest}"
+            cmod, _, cname = cand.rpartition(".")
+            if (cmod, cname) in self.classes:
+                return (cmod, cname)
+        return None
+
+    def effective_locks(self, cm: ClassModel,
+                        _seen=None) -> Dict[str, LockDef]:
+        """Own + inherited lock attrs (tpulib.base's RLock pattern)."""
+        if cm._eff_locks is not None:
+            return cm._eff_locks
+        seen = _seen or set()
+        if cm.key in seen:
+            return dict(cm.locks)
+        seen.add(cm.key)
+        out: Dict[str, LockDef] = {}
+        for base in cm.bases:
+            bkey = self._resolve_type(cm.module, base)
+            if bkey is not None:
+                out.update(self.effective_locks(self.classes[bkey], seen))
+        out.update(cm.locks)
+        cm._eff_locks = out
+        return out
+
+    def lookup_method(self, cm: ClassModel, name: str,
+                      _seen=None) -> Optional[FuncModel]:
+        seen = _seen or set()
+        if cm.key in seen:
+            return None
+        seen.add(cm.key)
+        if name in cm.methods:
+            return cm.methods[name]
+        for base in cm.bases:
+            bkey = self._resolve_type(cm.module, base)
+            if bkey is not None:
+                fm = self.lookup_method(self.classes[bkey], name, seen)
+                if fm is not None:
+                    return fm
+        return None
+
+    def lock_for_attr(self, cm: Optional[ClassModel],
+                      attr: str) -> Optional[LockDef]:
+        if cm is None:
+            return None
+        eff = self.effective_locks(cm)
+        attr = cm.cond_alias.get(attr, attr)
+        return eff.get(attr)
+
+    def lock_for_name(self, mod: str, name: str) -> Optional[LockDef]:
+        return self.mod_locks.get(mod, {}).get(name)
+
+    # --- interprocedural summaries -----------------------------------------
+
+    def summarize(self, fm: FuncModel) -> "_Summary":
+        """Flattened event summary of ``fm`` (memoized). Held sets in
+        the returned events are relative to function entry; callers
+        prepend their own held locks when lifting."""
+        if fm.summary is not None:
+            return fm.summary
+        if fm.in_progress:     # call-graph cycle: cut the back edge
+            return _Summary()
+        fm.in_progress = True
+        s = _Summary()
+        held: List[Tuple[str, str]] = []  # (lid, kind)
+        if fm.node.name.endswith("_locked") and fm.cls is not None:
+            # "_locked" documents "caller holds the lock"; with exactly
+            # one lock in the class there is no ambiguity about which.
+            eff = self.effective_locks(fm.cls)
+            if len(eff) == 1:
+                ld = next(iter(eff.values()))
+                held.append((ld.lid, ld.kind))
+        _EventWalker(self, fm, s).walk_stmts(fm.node.body, held)
+        fm.summary = s
+        fm.in_progress = False
+        return s
+
+    def d801_exempt(self, fm: FuncModel) -> bool:
+        short = self._short(fm.key[0])
+        return short.split(".")[0] in D801_EXEMPT_LAYERS
+
+    def record_edge(self, src: str, dst: str, ctx: FileContext,
+                    lineno: int, via: str) -> None:
+        if src == dst:
+            return  # reentrancy (or a D800 self-cycle, handled below)
+        sites = self.edges.setdefault((src, dst), [])
+        if len(sites) < 3:
+            sites.append((ctx, lineno, via))
+
+    def record_block(self, ctx: FileContext, lineno: int, what: str,
+                     held: Tuple[str, ...], via: str,
+                     origin: Optional[Tuple[str, int]] = None) -> None:
+        key = (ctx.rel_path, lineno, what)
+        if key in self._d801_seen:
+            return
+        self._d801_seen.add(key)
+        if origin is not None:
+            octx = self._by_rel.get(origin[0])
+            if octx is not None and "D801" in disabled_codes(
+                    octx.line(origin[1])):
+                return
+        locks = ", ".join(f"`{h}`" for h in held)
+        via_txt = f" (via {via})" if via else ""
+        self.d801.append(
+            (ctx, lineno,
+             f"blocking call `{what}` while holding {locks}{via_txt} — "
+             f"every waiter on that lock stalls with it")
+        )
+
+
+class _EventWalker:
+    """Walks one function body once, tracking the held-lock stack,
+    emitting edges/D801 findings, and flattening callee summaries into
+    this function's summary for the next caller up."""
+
+    def __init__(self, an: Analysis, fm: FuncModel, out: _Summary):
+        self.an = an
+        self.fm = fm
+        self.ctx = fm.ctx
+        self.mod = fm.key[0]
+        self.out = out
+        self.local_funcs: Dict[str, FuncModel] = {}
+        self.exempt_d801 = an.d801_exempt(fm)
+
+    # -- lock resolution ----------------------------------------------------
+
+    def _lock_of(self, node: ast.AST) -> Optional[LockDef]:
+        attr = _self_attr(node)
+        if attr:
+            return self.an.lock_for_attr(self.fm.cls, attr)
+        if isinstance(node, ast.Name):
+            return self.an.lock_for_name(self.mod, node.id)
+        return None
+
+    # -- event emission -----------------------------------------------------
+
+    def _emit_acquire(self, ld: LockDef, held, lineno: int,
+                      trylock: bool) -> None:
+        kind = "try_acquire" if trylock else "acquire"
+        held_ids = tuple(h[0] for h in held)
+        if not trylock:
+            for h in held_ids:
+                if h == ld.lid and ld.kind in ("rlock", "condition"):
+                    continue  # reentrant by construction
+                self.an.record_edge(h, ld.lid, self.ctx, lineno,
+                                    self._where())
+        self.out.events.append(
+            _Event(kind, ld.lid, held_ids, self.ctx.rel_path, lineno)
+        )
+
+    def _emit_block(self, what: str, held, lineno: int, via: str = "") -> None:
+        held_ids = tuple(h[0] for h in held)
+        origin = (self.ctx.rel_path, lineno)
+        self.out.events.append(
+            _Event("block", what, held_ids, self.ctx.rel_path, lineno, via,
+                   origin=origin)
+        )
+        if held_ids and not self.exempt_d801:
+            self.an.record_block(self.ctx, lineno, what, held_ids, via,
+                                 origin=origin)
+
+    def _where(self) -> str:
+        mod, qual = self.fm.key
+        return f"{Analysis._short(mod)}.{qual}"
+
+    def _inline(self, callee: FuncModel, held, lineno: int) -> None:
+        """Lift a callee's flattened summary into this function."""
+        summary = self.an.summarize(callee)
+        if not summary.events:
+            return
+        held_ids = tuple(h[0] for h in held)
+        _, callee_qual = callee.key
+        for ev in summary.events:
+            merged = held_ids + tuple(
+                h for h in ev.held if h not in held_ids
+            )
+            via = f"{callee_qual}() -> {ev.via}" if ev.via else \
+                f"{callee_qual}() at {ev.rel_path}:{ev.line}"
+            if ev.kind in ("acquire", "try_acquire"):
+                if ev.kind == "acquire":
+                    ld = self.an.locks.get(ev.what)
+                    reentrant = ld is not None and ld.kind in (
+                        "rlock", "condition")
+                    for h in held_ids:
+                        if h == ev.what and reentrant:
+                            continue
+                        self.an.record_edge(h, ev.what, self.ctx, lineno,
+                                            f"{self._where()} -> {via}")
+                self.out.events.append(_Event(
+                    ev.kind, ev.what, merged, self.ctx.rel_path, lineno, via
+                ))
+            else:  # block
+                self.out.events.append(_Event(
+                    "block", ev.what, merged, self.ctx.rel_path, lineno,
+                    via, origin=ev.origin,
+                ))
+                # The callee (or a level below) reports the event when
+                # it held locks itself; this level reports only when
+                # the held set first becomes non-empty here.
+                if held_ids and not ev.held and not self.exempt_d801:
+                    self.an.record_block(self.ctx, lineno, ev.what,
+                                         held_ids, via, origin=ev.origin)
+
+    # -- call handling ------------------------------------------------------
+
+    def _handle_call(self, call: ast.Call, held) -> None:
+        func = call.func
+        # 1. explicit acquire/release on a known lock
+        if isinstance(func, ast.Attribute) and func.attr in (
+                "acquire", "release"):
+            ld = self._lock_of(func.value)
+            if ld is not None:
+                if func.attr == "release":
+                    for i in range(len(held) - 1, -1, -1):
+                        if held[i][0] == ld.lid:
+                            del held[i]
+                            break
+                else:
+                    self._emit_acquire(ld, held, call.lineno,
+                                       trylock=_is_trylock(call))
+                    held.append((ld.lid, ld.kind))
+                return
+        # 2. blocking calls
+        blocked = self._blocking_name(call, held)
+        if blocked:
+            self._emit_block(blocked, held, call.lineno)
+            return
+        # 3. project calls: inline the callee's summary
+        for callee in self._resolve_call(call):
+            self._inline(callee, held, call.lineno)
+
+    def _blocking_name(self, call: ast.Call, held) -> str:
+        func = call.func
+        dotted = dotted_name(func)
+        resolved = self._resolve_dotted(dotted)
+        if resolved in BLOCKING_DOTTED or resolved == "time.sleep":
+            return resolved
+        if not isinstance(func, ast.Attribute):
+            return ""
+        t = func.attr
+        recv = dotted_name(func.value) or "<expr>"
+        if t in ("sleep", "pause"):
+            return f"{recv}.{t}"
+        if t in ("wait", "wait_for"):
+            ld = self._lock_of(func.value)
+            if ld is not None and any(h[0] == ld.lid for h in held):
+                # Condition.wait on the held condition releases it
+                # while waiting — sanctioned, unless OTHER locks are
+                # also held (those stay held across the wait).
+                others = [h for h in held if h[0] != ld.lid]
+                if others:
+                    self._emit_block(f"{recv}.{t}", others, call.lineno)
+                return ""
+            return f"{recv}.{t}"
+        if t == "join":
+            attr = _self_attr(func.value)
+            threadish = (
+                (self.fm.cls is not None
+                 and attr in self.fm.cls.thread_attrs)
+                or "thread" in recv.lower()
+            )
+            return f"{recv}.join" if threadish else ""
+        if t in ALWAYS_BLOCKING_ATTRS:
+            return f"{recv}.{t}"
+        return ""
+
+    def _resolve_dotted(self, dotted: str) -> str:
+        if not dotted:
+            return ""
+        head, _, rest = dotted.partition(".")
+        full = self.an.imports.get(self.mod, {}).get(head, "")
+        if full:
+            return f"{full}.{rest}" if rest else full
+        return dotted
+
+    def _resolve_call(self, call: ast.Call) -> List[FuncModel]:
+        func = call.func
+        out: List[FuncModel] = []
+        if isinstance(func, ast.Name):
+            name = func.id
+            if name in self.local_funcs:
+                return [self.local_funcs[name]]
+            fm = self.an.mod_funcs.get((self.mod, name))
+            if fm is not None:
+                return [fm]
+            full = self._resolve_dotted(name)
+            fmod, _, fname = full.rpartition(".")
+            fm = self.an.mod_funcs.get((fmod, fname))
+            if fm is not None:
+                return [fm]
+            tkey = self.an._resolve_type(self.mod, name)
+            if tkey is not None:
+                init = self.an.lookup_method(self.an.classes[tkey],
+                                             "__init__")
+                if init is not None:
+                    return [init]
+            return out
+        if not isinstance(func, ast.Attribute):
+            return out
+        recv, mname = func.value, func.attr
+        if isinstance(recv, ast.Name) and recv.id == "self":
+            if self.fm.cls is not None:
+                fm = self.an.lookup_method(self.fm.cls, mname)
+                if fm is not None:
+                    out.append(fm)
+            return out
+        attr = _self_attr(recv)
+        if attr and self.fm.cls is not None:
+            for tkey in self.fm.cls.attr_types.get(attr, ()):
+                fm = self.an.lookup_method(self.an.classes[tkey], mname)
+                if fm is not None:
+                    out.append(fm)
+            return out
+        if isinstance(recv, ast.Name):  # module attr: crashpoint.fire()
+            full = self._resolve_dotted(recv.id)
+            fm = self.an.mod_funcs.get((full, mname))
+            if fm is not None:
+                out.append(fm)
+        return out
+
+    # -- statement walking --------------------------------------------------
+
+    def walk_stmts(self, stmts: List[ast.stmt], held: List) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                mod, qual = self.fm.key
+                self.local_funcs[stmt.name] = FuncModel(
+                    (mod, f"{qual}.<locals>.{stmt.name}"),
+                    stmt, self.ctx, self.fm.cls,
+                )
+                continue
+            if isinstance(stmt, ast.ClassDef):
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                self._walk_with(stmt, held)
+                continue
+            # Expressions first (an acquire in `if not x.acquire(...)`
+            # governs the statements after it in this suite).
+            for expr in _stmt_exprs(stmt):
+                self._walk_expr(expr, held)
+            for body in _stmt_bodies(stmt):
+                self.walk_stmts(body, list(held))
+
+    def _walk_with(self, stmt, held: List) -> None:
+        pushed = 0
+        for item in stmt.items:
+            ld = self._lock_of(item.context_expr)
+            if ld is not None:
+                self._emit_acquire(ld, held, item.context_expr.lineno,
+                                   trylock=False)
+                held.append((ld.lid, ld.kind))
+                pushed += 1
+            else:
+                self._walk_expr(item.context_expr, held)
+        self.walk_stmts(stmt.body, list(held))
+        if pushed:
+            del held[-pushed:]
+
+    def _walk_expr(self, node: ast.AST, held: List) -> None:
+        """Visit an expression tree in source order, handling calls."""
+        if node is None:
+            return
+        if isinstance(node, ast.Call):
+            # Arguments evaluate before the call itself.
+            for child in ast.iter_child_nodes(node):
+                if child is not node.func:
+                    self._walk_expr(child, held)
+            self._walk_expr_func_only(node.func, held)
+            self._handle_call(node, held)
+            return
+        if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            return  # deferred execution
+        for child in ast.iter_child_nodes(node):
+            self._walk_expr(child, held)
+
+    def _walk_expr_func_only(self, func: ast.AST, held: List) -> None:
+        # The receiver chain may itself contain calls: a.b().c()
+        for child in ast.iter_child_nodes(func):
+            self._walk_expr(child, held)
+
+
+def _is_trylock(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "blocking" and isinstance(kw.value, ast.Constant):
+            return kw.value.value is False
+        if kw.arg == "timeout" and isinstance(kw.value, ast.Constant):
+            return kw.value.value == 0
+    if call.args and isinstance(call.args[0], ast.Constant):
+        return call.args[0].value is False
+    return False
+
+
+def _stmt_exprs(stmt: ast.stmt) -> List[ast.AST]:
+    """The expression children of a statement (not nested suites)."""
+    out: List[ast.AST] = []
+    for name, value in ast.iter_fields(stmt):
+        if name in ("body", "orelse", "finalbody", "handlers"):
+            continue
+        if isinstance(value, ast.AST):
+            out.append(value)
+        elif isinstance(value, list):
+            out.extend(v for v in value if isinstance(v, ast.AST))
+    return out
+
+
+def _stmt_bodies(stmt: ast.stmt) -> List[List[ast.stmt]]:
+    out: List[List[ast.stmt]] = []
+    for name in ("body", "orelse", "finalbody"):
+        suite = getattr(stmt, name, None)
+        if suite:
+            out.append(suite)
+    for handler in getattr(stmt, "handlers", []) or []:
+        out.append(handler.body)
+    return out
+
+
+# --- D800: cycles ---------------------------------------------------------
+
+def _sccs(nodes: Set[str],
+          edges: Dict[Tuple[str, str], list]) -> List[List[str]]:
+    """Tarjan, iterative; returns SCCs with >1 node (sorted)."""
+    adj: Dict[str, List[str]] = {n: [] for n in nodes}
+    for (src, dst) in edges:
+        adj.setdefault(src, []).append(dst)
+        adj.setdefault(dst, [])
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    counter = [0]
+    out: List[List[str]] = []
+
+    for root in sorted(adj):
+        if root in index:
+            continue
+        work = [(root, iter(sorted(adj[root])))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(adj[nxt]))))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    n = stack.pop()
+                    on_stack.discard(n)
+                    scc.append(n)
+                    if n == node:
+                        break
+                if len(scc) > 1:
+                    out.append(sorted(scc))
+    return out
+
+
+def _comment_linenos(ctx: FileContext) -> Set[int]:
+    """Line numbers bearing a real COMMENT token (not docstring prose
+    that happens to mention the marker)."""
+    out: Set[int] = set()
+    try:
+        for tok in tokenize.generate_tokens(
+                io.StringIO(ctx.source).readline):
+            if tok.type == tokenize.COMMENT:
+                out.add(tok.start[0])
+    except (tokenize.TokenizeError, SyntaxError, ValueError):
+        return set(range(1, len(ctx.lines) + 1))  # fall back: check all
+    return out
+
+
+# --- D802/D803: thread ownership ------------------------------------------
+
+OWNERSHIP_EXEMPT = {"__init__", "__new__"}
+
+
+def _check_ownership(an: Analysis, cm: ClassModel, out: List) -> None:
+    annotated = bool(cm.attr_domains) or any(
+        fm.domain for fm in cm.methods.values()
+    )
+    if not annotated:
+        return
+
+    # Effective domains: explicit, then inherited by private helpers
+    # whose same-class callers all agree.
+    eff: Dict[str, str] = {
+        name: fm.domain for name, fm in cm.methods.items() if fm.domain
+    }
+    self_calls: Dict[str, List[Tuple[str, int]]] = {}
+    xclass_calls: Dict[str, List[Tuple[Tuple[str, str], str, int]]] = {}
+    touches: Dict[str, List[Tuple[str, int]]] = {}
+    for name, fm in cm.methods.items():
+        sc, xc, tc = [], [], []
+        for node in ast.walk(fm.node):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute):
+                recv = node.func.value
+                if isinstance(recv, ast.Name) and recv.id == "self":
+                    sc.append((node.func.attr, node.lineno))
+                else:
+                    a = _self_attr(recv)
+                    if a:
+                        for tkey in cm.attr_types.get(a, ()):
+                            xc.append((tkey, node.func.attr, node.lineno))
+            elif isinstance(node, ast.Attribute):
+                a = _self_attr(node)
+                if a and a in cm.attr_domains:
+                    tc.append((a, node.lineno))
+        self_calls[name], xclass_calls[name], touches[name] = sc, xc, tc
+
+    callers: Dict[str, Set[str]] = {name: set() for name in cm.methods}
+    for caller, calls in self_calls.items():
+        for callee, _ in calls:
+            if callee in callers:
+                callers[callee].add(caller)
+
+    changed = True
+    while changed:
+        changed = False
+        for name in cm.methods:
+            if name in eff or not name.startswith("_") \
+                    or name.startswith("__"):
+                continue
+            who = callers[name]
+            if not who or any(c not in eff for c in who):
+                continue
+            doms = {eff[c] for c in who}
+            if len(doms) == 1:
+                eff[name] = next(iter(doms))
+                changed = True
+
+    emitted: Set[Tuple[int, str]] = set()
+
+    def emit(ctx, lineno, code, msg):
+        if (lineno, code) not in emitted:
+            emitted.add((lineno, code))
+            add_finding(out, ctx, lineno, code, msg)
+
+    for name, fm in cm.methods.items():
+        if name in OWNERSHIP_EXEMPT:
+            continue
+        dom = eff.get(name)
+        for callee, lineno in self_calls[name]:
+            target = cm.methods.get(callee)
+            if target is None or not target.domain or \
+                    target.domain == "any":
+                continue
+            if dom is None:
+                emit(fm.ctx, lineno, "D802",
+                     f"call to `{callee}()` ({target.domain}-only) from "
+                     f"un-annotated method `{name}` — annotate `{name}` "
+                     f"with `# thread: {target.domain}` or route through "
+                     f"the owning thread")
+            elif dom != target.domain:
+                emit(fm.ctx, lineno, "D802",
+                     f"call to `{callee}()` ({target.domain}-only) from "
+                     f"`{name}` which runs on `{dom}`")
+        for tkey, mname, lineno in xclass_calls[name]:
+            other = an.classes.get(tkey)
+            if other is None:
+                continue
+            target = an.lookup_method(other, mname)
+            if target is None or not target.domain or \
+                    target.domain == "any":
+                continue
+            if dom is not None and dom != target.domain:
+                emit(fm.ctx, lineno, "D802",
+                     f"call to `{other.name}.{mname}()` "
+                     f"({target.domain}-only) from `{name}` which runs "
+                     f"on `{dom}`")
+        if name.startswith("__") and fm.domain is None:
+            continue  # dunders (repr/len/...) read for debug from anywhere
+        for attr, lineno in touches[name]:
+            adom = cm.attr_domains[attr][0]
+            if adom == "any":
+                continue
+            if dom is None:
+                emit(fm.ctx, lineno, "D802",
+                     f"`self.{attr}` is {adom}-owned but `{name}` has no "
+                     f"thread domain — annotate `{name}`")
+            elif dom != adom:
+                emit(fm.ctx, lineno, "D802",
+                     f"`self.{attr}` is {adom}-owned; `{name}` runs on "
+                     f"`{dom}`")
+
+    # D803: annotated attr nothing touches outside __init__ any more.
+    # Cross-class touches (Router reads rep.inflight) count via the
+    # global attr-name tally; same-name attrs elsewhere make this
+    # check conservative (it under-reports, never false-positives).
+    for attr, (adom, lineno) in sorted(cm.attr_domains.items()):
+        used = any(
+            t == attr
+            for name, tlist in touches.items()
+            if name not in OWNERSHIP_EXEMPT
+            for t, _ in tlist
+        )
+        init_count = sum(
+            1 for t, _ in touches.get("__init__", []) if t == attr
+        )
+        used = used or an.attr_uses.get(attr, 0) > init_count
+        if not used:
+            add_finding(
+                out, cm.ctx, lineno, "D803",
+                f"`self.{attr}` is annotated `# thread: {adom}` but no "
+                f"method outside __init__ touches it — drop the "
+                f"annotation or the attr",
+            )
+
+
+@register
+class LockdepPass:
+    """D800–D803 whole-program lock-order + thread-ownership."""
+
+    name = "D80x"
+    codes = ("D800", "D801", "D802", "D803")
+    scope = "project"
+
+    def __init__(self):
+        self.analysis: Optional[Analysis] = None
+
+    def run_project(self, contexts: List[FileContext],
+                    extra_paths=None) -> List[Finding]:
+        out: List[Finding] = []
+        an = Analysis(contexts)
+        self.analysis = an
+
+        # Summaries for every function/method: fills the edge graph and
+        # the D801 list as a side effect.
+        for fm in an.mod_funcs.values():
+            an.summarize(fm)
+        for cm in an.classes.values():
+            for fm in cm.methods.values():
+                an.summarize(fm)
+
+        # D800 — cycles (plus Lock/plain-condition self-acquire, which
+        # record_edge drops; re-derive self-deadlocks from events here
+        # would be redundant: a non-reentrant self-acquire hangs the
+        # first tier-1 test that hits it).
+        for scc in _sccs(set(an.locks), an.edges):
+            in_cycle = set(scc)
+            path = []
+            for src in scc:
+                for dst in sorted(in_cycle):
+                    if (src, dst) in an.edges:
+                        ctx, lineno, via = an.edges[(src, dst)][0]
+                        path.append(
+                            f"`{src}` -> `{dst}` "
+                            f"[{ctx.rel_path}:{lineno} in {via}]"
+                        )
+            ctx, lineno, _ = an.edges[
+                min(k for k in an.edges if k[0] in in_cycle
+                    and k[1] in in_cycle)
+            ][0]
+            add_finding(
+                out, ctx, lineno, "D800",
+                "lock-order cycle (potential deadlock): "
+                + "; ".join(sorted(path)),
+            )
+
+        # D801 — blocking call under a lock.
+        for ctx, lineno, msg in an.d801:
+            add_finding(out, ctx, lineno, "D801", msg)
+
+        # D802/D803 — ownership and drift.
+        for key in sorted(an.classes):
+            _check_ownership(an, an.classes[key], out)
+        for ctx, lineno, why in an.malformed:
+            add_finding(out, ctx, lineno, "D803",
+                        f"malformed `# thread:` annotation ({why}); "
+                        f"grammar: `# thread: <domain>` with optional "
+                        f"`owner=` prefix / `-only` suffix")
+        for ctx in an.ctxs:
+            consumed = an.ann_consumed.get(ctx.rel_path, set())
+            comment_lines = None  # lazy: tokenize only files that match
+            for i, line in enumerate(ctx.lines, start=1):
+                if i in consumed:
+                    continue
+                if THREAD_ANN_RE.search(line):
+                    if comment_lines is None:
+                        comment_lines = _comment_linenos(ctx)
+                    if i not in comment_lines:
+                        continue  # docstring prose, not a marker
+                    add_finding(
+                        out, ctx, i, "D803",
+                        "`# thread:` marker not attached to a def or an "
+                        "`__init__` self-attr assignment — move it to "
+                        "the def line (or the line above) or the attr "
+                        "line",
+                    )
+        return out
+
+    # --- --graph -----------------------------------------------------------
+
+    def dot(self) -> str:
+        """The discovered lock-order graph as GraphViz DOT."""
+        an = self.analysis
+        lines = [
+            "// Lock acquisition order discovered by hack/lint.py D800.",
+            "// Edge A -> B: B is acquired while A is held (witness in",
+            "// the edge label). Regenerate: python hack/lint.py --graph",
+            "digraph lock_order {",
+            "  rankdir=LR;",
+            "  node [shape=box, fontname=\"monospace\", fontsize=10];",
+            "  edge [fontname=\"monospace\", fontsize=8];",
+        ]
+        if an is not None:
+            used = {n for e in an.edges for n in e}
+            for lid in sorted(set(an.locks) | used):
+                ld = an.locks.get(lid)
+                shape = ("diamond" if ld and ld.kind == "condition"
+                         else "box")
+                peripheries = 2 if ld and ld.kind == "rlock" else 1
+                lines.append(
+                    f'  "{lid}" [shape={shape}, '
+                    f"peripheries={peripheries}];"
+                )
+            for (src, dst) in sorted(an.edges):
+                ctx, lineno, _ = an.edges[(src, dst)][0]
+                lines.append(
+                    f'  "{src}" -> "{dst}" '
+                    f'[label="{ctx.rel_path}:{lineno}"];'
+                )
+        lines.append("}")
+        return "\n".join(lines) + "\n"

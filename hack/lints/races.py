@@ -19,6 +19,18 @@ Conventions understood by the pass (all present in this codebase):
   their writes count as locked (infra/workqueue.py's idiom);
 - ``with self._lock:`` / ``with self._cond:`` (any discovered lock
   attr) marks the lexical region locked, including ``with a, b:``;
+- explicit ``self._lock.acquire()`` ... ``self._lock.release()``
+  statements bracket a locked region the same way (the
+  ``acquire(); try: ... finally: release()`` idiom included), for
+  Locks and Conditions alike — a Condition's ``wait()``/``notify()``
+  happen inside such a region, so writes around them are locked;
+- attributes whose write discipline the D802 thread-ownership pass
+  already enforces are NOT double-reported here: an attr carrying a
+  ``# thread: <domain>`` annotation in ``__init__``, or one written
+  only from methods annotated with one common domain (other than
+  ``any``), is single-writer by *enforced* contract — demanding a
+  lock on top of that would be noise (see docs/static-analysis.md,
+  "Concurrency analysis");
 - ``# lint: disable=R200`` on the write line is the escape hatch for
   intentionally unsynchronized state (document why at the site).
 """
@@ -30,6 +42,19 @@ from typing import Dict, List, Tuple
 
 from lints.base import FileContext, Finding, add_finding, dotted_name
 from lints.registry import register
+
+
+def _thread_domain(ctx: FileContext, node: ast.AST) -> str:
+    """The `# thread:` domain annotating a def (trailing or line above)
+    or an attr-assignment line; "" when absent/malformed. Lazy import:
+    the grammar lives with its enforcing pass."""
+    from lints.lockdep import THREAD_ANN_RE, _parse_domain
+
+    for lineno in (node.lineno, node.lineno - 1):
+        m = THREAD_ANN_RE.search(ctx.line(lineno))
+        if m:
+            return _parse_domain(m.group("rest")) or ""
+    return ""
 
 LOCK_FACTORIES = {
     "threading.Lock", "threading.RLock", "threading.Condition",
@@ -73,9 +98,12 @@ class _ClassInfo:
         self.concurrent_because = ""
         # attr -> list of (method, lineno, locked)
         self.writes: Dict[str, List[Tuple[str, int, bool]]] = {}
+        # attrs/methods under the D802 single-writer contract
+        self.domain_attrs: set = set()
+        self.method_domains: Dict[str, str] = {}
 
 
-def _analyze_class(cls: ast.ClassDef) -> _ClassInfo:
+def _analyze_class(cls: ast.ClassDef, ctx: FileContext) -> _ClassInfo:
     info = _ClassInfo(cls)
     methods = [
         m for m in cls.body
@@ -121,6 +149,23 @@ def _analyze_class(cls: ast.ClassDef) -> _ClassInfo:
                     info.concurrent_because = (
                         f"registers a bound method with {terminal}()"
                     )
+    # Pass 1.5: thread-domain annotations (the D802 pass enforces
+    # these; R200 defers to them instead of double-reporting).
+    for m in methods:
+        dom = _thread_domain(ctx, m)
+        if dom:
+            info.method_domains[m.name] = dom
+        if m.name == "__init__":
+            for sub in ast.walk(m):
+                if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                    targets = (
+                        sub.targets if isinstance(sub, ast.Assign)
+                        else [sub.target]
+                    )
+                    for t in targets:
+                        attr = _self_attr(t)
+                        if attr and _thread_domain(ctx, sub):
+                            info.domain_attrs.add(attr)
     # Pass 2: record self.<attr> mutations per method with lock context.
     for m in methods:
         if m.name in EXEMPT_METHODS:
@@ -128,6 +173,22 @@ def _analyze_class(cls: ast.ClassDef) -> _ClassInfo:
         assume_locked = m.name.endswith("_locked")
         _walk_writes(m, m.name, info, assume_locked)
     return info
+
+
+def _lock_call(node: ast.AST, info: _ClassInfo) -> Tuple[str, str]:
+    """("<attr>", "acquire"/"release") for a bare `self.<lock>.acquire()`
+    / `.release()` call on a discovered lock or condition; ("", "")
+    otherwise. Trylocks (`acquire(blocking=False)` / `timeout=...`)
+    count: a *successful* trylock holds the lock either way, and the
+    idiom here brackets them with try/finally like a hard acquire."""
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("acquire", "release")):
+        return "", ""
+    attr = _self_attr(node.func.value)
+    if attr in info.locks:
+        return attr, node.func.attr
+    return "", ""
 
 
 def _walk_writes(
@@ -139,6 +200,41 @@ def _walk_writes(
                 (method_name, lineno, locked)
             )
 
+    def visit_block(stmts, locked: bool) -> bool:
+        """Statements in source order; a bare `self._lock.acquire()` /
+        `.release()` statement flips the lock state for its *siblings*
+        (the `acquire(); try: ... finally: release()` idiom — the try
+        body runs with the lock held). Returns the state at block end
+        so try/finally bodies compose."""
+        for stmt in stmts:
+            if isinstance(stmt, ast.Expr):
+                attr, op = _lock_call(stmt.value, info)
+                if op == "acquire":
+                    locked = True
+                    continue
+                if op == "release":
+                    locked = False
+                    continue
+            visit(stmt, locked)
+            if isinstance(stmt, ast.Try):
+                # An acquire anywhere in the try body (common: first
+                # statement) covers the statements after the try too
+                # when no handler/finally releases it.
+                locked = _block_end_state(stmt, locked)
+        return locked
+
+    def _block_end_state(tr: ast.Try, locked: bool) -> bool:
+        state = locked
+        for stmts in (tr.body, tr.finalbody):
+            for stmt in stmts:
+                if isinstance(stmt, ast.Expr):
+                    _, op = _lock_call(stmt.value, info)
+                    if op == "acquire":
+                        state = True
+                    elif op == "release":
+                        state = False
+        return state
+
     def visit(node: ast.AST, locked: bool) -> None:
         if isinstance(node, (ast.With, ast.AsyncWith)):
             holds = locked or any(
@@ -147,8 +243,22 @@ def _walk_writes(
             )
             for item in node.items:
                 visit(item.context_expr, locked)
-            for stmt in node.body:
-                visit(stmt, holds)
+            visit_block(node.body, holds)
+            return
+        if isinstance(node, (ast.If, ast.While, ast.For, ast.AsyncFor)):
+            for field in ("test", "iter", "target"):
+                child = getattr(node, field, None)
+                if child is not None:
+                    visit(child, locked)
+            visit_block(node.body, locked)
+            visit_block(node.orelse, locked)
+            return
+        if isinstance(node, ast.Try):
+            end = visit_block(node.body, locked)
+            for h in node.handlers:
+                visit_block(h.body, locked)
+            visit_block(node.orelse, end)
+            visit_block(node.finalbody, locked)
             return
         if isinstance(node, ast.Assign):
             for t in node.targets:
@@ -192,8 +302,7 @@ def _walk_writes(
             for elt in t.elts:
                 _record_target(elt, locked)
 
-    for stmt in getattr(method, "body", []):
-        visit(stmt, locked)
+    visit_block(getattr(method, "body", []), locked)
 
 
 @register
@@ -209,12 +318,25 @@ class RaceLintPass:
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.ClassDef):
                 continue
-            info = _analyze_class(node)
+            info = _analyze_class(node, ctx)
             if not info.concurrent_because:
                 continue
             for attr, writes in sorted(info.writes.items()):
                 methods = {m for m, _, _ in writes}
                 if len(methods) < 2:
+                    continue
+                if attr in info.domain_attrs:
+                    # The D802 pass enforces this attr's single-writer
+                    # thread domain; a lock on top would be noise.
+                    continue
+                doms = {
+                    info.method_domains.get(m) for m in methods
+                }
+                if len(doms) == 1 and None not in doms \
+                        and doms != {"any"}:
+                    # Every writing method is pinned to ONE thread
+                    # domain (enforced by D802): single-writer, no
+                    # lock needed.
                     continue
                 for method, lineno, locked in writes:
                     if locked:

@@ -1,4 +1,4 @@
-"""D800 — bare ``time.sleep`` in driver layers.
+"""S800 — bare ``time.sleep`` in driver layers.
 
 A bare ``time.sleep`` in ``plugin``/``computedomain``/``k8sclient``/
 ``infra`` is an unconditional stall: it cannot be cancelled by
@@ -20,7 +20,7 @@ the ``workloads``/``tpulib``/``minicluster``/``tools`` layers are
 exempt (JAX payloads, the stub's fault timeline, and CLI tools sleep
 on purpose and serve no kubelet RPC). A wait that is genuinely
 correct as a bare sleep documents itself with
-``# lint: disable=D800 <why>``.
+``# lint: disable=S800 <why>``.
 
 Project-scope pass (like G400/C700): the layer set is a property of
 the whole tree, and running after every FileContext is built keeps a
@@ -68,8 +68,8 @@ def _sleep_aliases(tree: ast.Module) -> set:
 
 @register
 class DriverSleepPass:
-    name = "D800"
-    codes = ("D800",)
+    name = "S800"
+    codes = ("S800",)
     scope = "project"
 
     def run_project(self, ctxs: List[FileContext],
@@ -85,7 +85,7 @@ class DriverSleepPass:
                 callee = dotted_name(node.func)
                 if callee == "time.sleep" or (callee and callee in aliases):
                     add_finding(
-                        out, ctx, node.lineno, "D800",
+                        out, ctx, node.lineno, "S800",
                         f"bare `{callee}(...)` in driver layer "
                         f"`{ctx.module_name}` — waits here must be "
                         f"cancellable and budget-aware: use a stop "

@@ -1,0 +1,111 @@
+"""Static-vs-runtime lockdep divergence check (`make lockdep`).
+
+Reads one or more runtime dumps written by
+``tpu_dra.infra.lockdep.check`` (``TPU_DRA_LOCKDEP_DUMP=path``), builds
+the static D800 lock graph over ``tpu_dra/``, joins the two on lock
+creation site (``path:line`` — the LockDef key on the static side and
+the wrapper's identity on the runtime side), and reports divergence:
+
+- a runtime lock whose creation site the static pass never discovered
+  (a discovery blind spot in ``hack/lints/lockdep.py``);
+- an *observed* acquisition edge the static interprocedural analysis
+  never derived (a modeling blind spot — the scarier kind: the static
+  cycle check is only as strong as its edge set).
+
+Static edges with no runtime witness are fine (the smokes do not
+exercise every path) and only count toward the coverage line. Exit 1 on
+any divergence, 0 otherwise.
+
+Usage: ``python hack/lockdep_diff.py DUMP.json [DUMP.json ...]``
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+from typing import Dict, Set, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT / "hack"))
+
+from lints.base import FileContext  # noqa: E402
+from lints import lockdep as static_lockdep  # noqa: E402
+
+
+def _static_graph() -> Tuple[Dict[str, str], Set[Tuple[str, str]]]:
+    """(site -> lock id, set of (src_lid, dst_lid)) from the D800 pass
+    over the product tree."""
+    files = sorted((REPO_ROOT / "tpu_dra").rglob("*.py"))
+    files = [f for f in files if "/pb/" not in str(f)]
+    ctxs = [FileContext(f, REPO_ROOT) for f in files]
+    p = static_lockdep.LockdepPass()
+    list(p.run_project(ctxs, extra_paths=files))
+    an = p.analysis
+    site_to_lid = {
+        f"{ld.rel_path}:{ld.line}": lid for lid, ld in an.locks.items()
+    }
+    return site_to_lid, set(an.edges)
+
+
+def main(argv) -> int:
+    if not argv:
+        print("usage: python hack/lockdep_diff.py DUMP.json [...]",
+              file=sys.stderr)
+        return 2
+    site_to_lid, static_edges = _static_graph()
+    problems = []
+    observed: Dict[Tuple[str, str], str] = {}
+    unknown: Set[str] = set()
+    runtime_edges = 0
+    for path in argv:
+        rep = json.loads(Path(path).read_text(encoding="utf-8"))
+        if not rep.get("installed"):
+            print(f"lockdep-diff: {path}: shim was not installed "
+                  f"(empty run?)", file=sys.stderr)
+            return 2
+        for e in rep.get("edges", []):
+            src, dst = e["src"], e["dst"]
+            # Locks allocated by tests, benches, or stdlib containers
+            # are outside the static pass's product scope.
+            if not (src.startswith("tpu_dra/")
+                    and dst.startswith("tpu_dra/")):
+                continue
+            runtime_edges += 1
+            slid = site_to_lid.get(src)
+            dlid = site_to_lid.get(dst)
+            for site, lid in ((src, slid), (dst, dlid)):
+                if lid is None and site not in unknown:
+                    unknown.add(site)
+                    problems.append(
+                        f"runtime lock at {site} is unknown to the "
+                        f"static pass — D800 discovery blind spot"
+                    )
+            if slid and dlid and slid != dlid:
+                observed.setdefault(
+                    (slid, dlid),
+                    f"{Path(path).name}: thread {e['thread']!r} "
+                    f"{e['count']}x",
+                )
+    for (a, b), wit in sorted(observed.items()):
+        if (a, b) not in static_edges:
+            problems.append(
+                f"observed edge {a} -> {b} ({wit}) is missing from the "
+                f"static graph — interprocedural modeling blind spot"
+            )
+    covered = sum(1 for e in static_edges if e in observed)
+    print(
+        f"lockdep-diff: {len(observed)} observed edge(s) across "
+        f"{len(argv)} dump(s), {len(static_edges)} static edge(s), "
+        f"{covered} covered by runtime witnesses",
+        file=sys.stderr,
+    )
+    if problems:
+        for p in problems:
+            print(f"lockdep-diff: DIVERGENCE: {p}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -44,7 +44,18 @@ if not all(
         capture_output=True,
     )
 
-from tpu_dra.infra import featuregates  # noqa: E402
+from tpu_dra.infra import featuregates, lockdep  # noqa: E402
+
+# Runtime lockdep (TPU_DRA_LOCKDEP=1, see docs/static-analysis.md):
+# instrument the threading lock factories BEFORE tests construct any
+# product objects, then assert acyclicity + single-ownership over the
+# whole session's observed graph at exit. `make lockdep` drives this.
+_LOCKDEP = lockdep.install_if_enabled()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if _LOCKDEP:
+        lockdep.check()
 
 
 @pytest.fixture(autouse=True)

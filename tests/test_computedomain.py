@@ -439,6 +439,63 @@ def test_channel_prepare_gates_on_readiness(fc, tmp_path):
     assert state.checkpoints.get().prepared_claims == {}
 
 
+def test_readiness_wait_does_not_hold_claim_lock(fc, tmp_path):
+    """Regression for the D801 lockdep finding: the readiness wait used
+    to sleep inside ``with self._lock`` — wedging every other claim's
+    Prepare/Unprepare on this node for up to ready_timeout. The wait
+    must poll OUTSIDE the lock (retry-outside-lock: each locked attempt
+    is single-shot, the pause happens with the lock released)."""
+    import threading as _threading
+
+    cd = make_cd(fc)
+    state = make_cd_state(fc, tmp_path, ready_timeout=5.0)
+    claim = channel_claim(cd)
+    results = {}
+
+    def run_prepare():
+        try:
+            results["devices"] = state.prepare(claim)
+        except Exception as exc:  # pragma: no cover - failure detail
+            results["error"] = exc
+
+    t = _threading.Thread(target=run_prepare, daemon=True)
+    t.start()
+    # While prepare() waits for CD readiness, the claim lock must be
+    # FREE: another claim's prepare on this node must not queue behind
+    # the wait. Poll briefly: the waiter releases between attempts.
+    acquired = False
+    deadline_ = time.monotonic() + 3.0
+    while time.monotonic() < deadline_ and not acquired:
+        acquired = state._lock.acquire(blocking=False)
+        if acquired:
+            state._lock.release()
+            break
+        time.sleep(0.01)
+    assert acquired, "readiness wait held the claim lock"
+
+    # Flip the CD to Ready + render bootstrap: the parked prepare must
+    # complete on its next (locked, single-shot) attempt.
+    cds = ResourceClient(fc, COMPUTE_DOMAINS)
+    cur = cds.get("cd1", NS)
+    cur["status"] = {"status": "Ready", "nodes": []}
+    cds.update_status(cur)
+    from tpu_dra.computedomain.daemon.bootstrap import write_bootstrap_files
+
+    cfg_dir = state.domain_config_dir(cd["metadata"]["uid"])
+    write_bootstrap_files(
+        cfg_dir,
+        render_bootstrap_env(0, 2, "v5p-16", "2x2x2", []),
+        [],
+    )
+    t.join(timeout=10)
+    assert not t.is_alive(), "prepare never returned after readiness"
+    assert "error" not in results, results.get("error")
+    assert results["devices"][0].device_name == "channel-0"
+
+    state.unprepare(claim["metadata"]["uid"])
+    assert state.checkpoints.get().prepared_claims == {}
+
+
 def test_channel_claim_namespace_assertion(fc, tmp_path):
     cd = make_cd(fc)
     state = make_cd_state(fc, tmp_path)

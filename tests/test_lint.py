@@ -1092,15 +1092,15 @@ def test_c700_changed_only_keeps_cross_file_uniqueness(tmp_path):
     assert out[0].path == linted.path
 
 
-# --- D800 bare time.sleep in driver layers ------------------------------------
+# --- S800 bare time.sleep in driver layers ------------------------------------
 
 
-def d800(tmp_path, rel, source):
+def s800(tmp_path, rel, source):
     ctx = FileContext(write(tmp_path, rel, source), tmp_path)
     return [f.code for f in DriverSleepPass().run_project([ctx])]
 
 
-def test_d800_bare_sleep_in_driver_layer_fires(tmp_path):
+def test_s800_bare_sleep_in_driver_layer_fires(tmp_path):
     src = '''
         import time
 
@@ -1108,15 +1108,15 @@ def test_d800_bare_sleep_in_driver_layer_fires(tmp_path):
         def retry():
             time.sleep(0.5)
     '''
-    assert d800(tmp_path, "tpu_dra/plugin/driver.py", src) == ["D800"]
-    assert d800(tmp_path, "tpu_dra/k8sclient/rest.py", src) == ["D800"]
-    assert d800(tmp_path, "tpu_dra/infra/flock.py", src) == ["D800"]
-    assert d800(
+    assert s800(tmp_path, "tpu_dra/plugin/driver.py", src) == ["S800"]
+    assert s800(tmp_path, "tpu_dra/k8sclient/rest.py", src) == ["S800"]
+    assert s800(tmp_path, "tpu_dra/infra/flock.py", src) == ["S800"]
+    assert s800(
         tmp_path, "tpu_dra/computedomain/cdplugin/driver.py", src
-    ) == ["D800"]
+    ) == ["S800"]
 
 
-def test_d800_from_import_alias_fires(tmp_path):
+def test_s800_from_import_alias_fires(tmp_path):
     src = '''
         from time import sleep as snooze
 
@@ -1124,10 +1124,10 @@ def test_d800_from_import_alias_fires(tmp_path):
         def retry():
             snooze(1.0)
     '''
-    assert d800(tmp_path, "tpu_dra/plugin/cleanup.py", src) == ["D800"]
+    assert s800(tmp_path, "tpu_dra/plugin/cleanup.py", src) == ["S800"]
 
 
-def test_d800_module_import_alias_fires(tmp_path):
+def test_s800_module_import_alias_fires(tmp_path):
     src = '''
         import time as t
 
@@ -1135,10 +1135,10 @@ def test_d800_module_import_alias_fires(tmp_path):
         def retry():
             t.sleep(0.5)
     '''
-    assert d800(tmp_path, "tpu_dra/plugin/cleanup.py", src) == ["D800"]
+    assert s800(tmp_path, "tpu_dra/plugin/cleanup.py", src) == ["S800"]
 
 
-def test_d800_negative_stop_aware_and_budgeted_waits(tmp_path):
+def test_s800_negative_stop_aware_and_budgeted_waits(tmp_path):
     src = '''
         import threading
 
@@ -1150,10 +1150,10 @@ def test_d800_negative_stop_aware_and_budgeted_waits(tmp_path):
             deadline.current().sleep(0.5, "retrying")
             deadline.current().pause(0.1)
     '''
-    assert d800(tmp_path, "tpu_dra/plugin/driver.py", src) == []
+    assert s800(tmp_path, "tpu_dra/plugin/driver.py", src) == []
 
 
-def test_d800_exempt_layers_and_trees(tmp_path):
+def test_s800_exempt_layers_and_trees(tmp_path):
     src = '''
         import time
 
@@ -1163,26 +1163,26 @@ def test_d800_exempt_layers_and_trees(tmp_path):
     '''
     # JAX payloads, the device stub, the minicluster, and CLI tools
     # sleep on purpose; tests/demo/hack are not driver code at all.
-    assert d800(tmp_path, "tpu_dra/workloads/decode.py", src) == []
-    assert d800(tmp_path, "tpu_dra/tpulib/stub.py", src) == []
-    assert d800(tmp_path, "tpu_dra/minicluster/kubelet.py", src) == []
-    assert d800(tmp_path, "tpu_dra/tools/doctor.py", src) == []
-    assert d800(tmp_path, "tests/test_something.py", src) == []
-    assert d800(tmp_path, "hack/tool.py", src) == []
+    assert s800(tmp_path, "tpu_dra/workloads/decode.py", src) == []
+    assert s800(tmp_path, "tpu_dra/tpulib/stub.py", src) == []
+    assert s800(tmp_path, "tpu_dra/minicluster/kubelet.py", src) == []
+    assert s800(tmp_path, "tpu_dra/tools/doctor.py", src) == []
+    assert s800(tmp_path, "tests/test_something.py", src) == []
+    assert s800(tmp_path, "hack/tool.py", src) == []
 
 
-def test_d800_disable_marker(tmp_path):
+def test_s800_disable_marker(tmp_path):
     src = '''
         import time
 
 
         def hold():
-            time.sleep(0.05)  # lint: disable=D800 (injected fault hold)
+            time.sleep(0.05)  # lint: disable=S800 (injected fault hold)
     '''
-    assert d800(tmp_path, "tpu_dra/k8sclient/fakeserver.py", src) == []
+    assert s800(tmp_path, "tpu_dra/k8sclient/fakeserver.py", src) == []
 
 
-def test_d800_real_driver_layers_are_clean():
+def test_s800_real_driver_layers_are_clean():
     """The live tree holds the invariant the pass enforces: no
     unannotated bare sleep anywhere in the driver spine."""
     ctxs = [
@@ -1702,3 +1702,516 @@ def test_t900_real_tree_is_clean_and_bijective():
     files = sorted((REPO / "tpu_dra").rglob("*.py"))
     ctxs = [FileContext(p, REPO) for p in files]
     assert SpanNamePass().run_project(ctxs, extra_paths=[]) == []
+
+
+# --- D800-D803 lockdep (lock order + thread ownership) ----------------------
+
+from lints.lockdep import LockdepPass  # noqa: E402
+
+
+def d80x(tmp_path, sources):
+    """Run the project-scope lockdep pass over {relpath: source}
+    fixtures rooted at tmp_path (so `tpu_dra/...` paths get product
+    module names)."""
+    ctxs = [
+        FileContext(write(tmp_path, rel, src), tmp_path)
+        for rel, src in sources.items()
+    ]
+    return LockdepPass().run_project(ctxs, extra_paths=[c.path for c in ctxs])
+
+
+def d80x_codes(tmp_path, src, rel="tpu_dra/serving/fix.py"):
+    return [f.code for f in d80x(tmp_path, {rel: src})]
+
+
+D800_CYCLE_SRC = """
+    import threading
+
+
+    class A:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def one(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def two(self):
+            with self._b:
+                with self._a:
+                    pass
+"""
+
+
+def test_d800_lock_order_cycle_fires(tmp_path):
+    out = d80x(tmp_path, {"tpu_dra/serving/fix.py": D800_CYCLE_SRC})
+    assert [f.code for f in out] == ["D800"]
+    # The finding names BOTH locks and a witness site per direction.
+    assert "A._a" in out[0].message and "A._b" in out[0].message
+
+
+def test_d800_negative_consistent_order(tmp_path):
+    src = """
+        import threading
+
+
+        class A:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._a:
+                    with self._b:
+                        pass
+    """
+    assert d80x_codes(tmp_path, src) == []
+
+
+def test_d800_interprocedural_cycle_through_helper(tmp_path):
+    """one() holds _a and calls helper() which takes _b; two() nests
+    the other way around — the edge comes from following the call."""
+    src = """
+        import threading
+
+
+        class A:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def helper(self):
+                with self._b:
+                    pass
+
+            def one(self):
+                with self._a:
+                    self.helper()
+
+            def two(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """
+    assert d80x_codes(tmp_path, src) == ["D800"]
+
+
+def test_d800_trylock_takes_no_edge(tmp_path):
+    """A non-blocking acquire cannot deadlock-wait: it must not
+    contribute an ordering edge (but a consistent-order nesting on the
+    other side stays clean too)."""
+    src = """
+        import threading
+
+
+        class A:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    if self._b.acquire(blocking=False):
+                        self._b.release()
+
+            def two(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """
+    assert d80x_codes(tmp_path, src) == []
+
+
+def test_d801_blocking_call_under_lock_fires(tmp_path):
+    src = """
+        import threading
+        import time
+
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def f(self):
+                with self._lock:
+                    time.sleep(1.0)
+    """
+    assert d80x_codes(tmp_path, src) == ["D801"]
+
+
+def test_d801_negative_sleep_outside_lock(tmp_path):
+    src = """
+        import threading
+        import time
+
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def f(self):
+                with self._lock:
+                    x = 1
+                time.sleep(1.0)
+                return x
+    """
+    assert d80x_codes(tmp_path, src) == []
+
+
+def test_d801_interprocedural_blocking_reported_at_call_site(tmp_path):
+    """The lock is held in f(); the sleep lives in helper(). The report
+    lands where the lock first becomes held, with the via chain."""
+    src = """
+        import threading
+        import time
+
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def helper(self):
+                time.sleep(0.5)
+
+            def f(self):
+                with self._lock:
+                    self.helper()
+    """
+    out = d80x(tmp_path, {"tpu_dra/serving/fix.py": D800_CYCLE_SRC and src})
+    assert [f.code for f in out] == ["D801"]
+    assert "helper" in out[0].message
+
+
+def test_d801_condition_wait_on_held_condition_exempt(tmp_path):
+    """cond.wait() RELEASES the lock it waits on — the canonical
+    pattern must not be flagged."""
+    src = """
+        import threading
+
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+
+            def f(self):
+                with self._cond:
+                    self._cond.wait(timeout=1.0)
+    """
+    assert d80x_codes(tmp_path, src) == []
+
+
+def test_d801_condition_wait_still_fires_for_other_held_lock(tmp_path):
+    """wait() releases ITS lock, not every lock the thread holds."""
+    src = """
+        import threading
+
+
+        class A:
+            def __init__(self):
+                self._other = threading.Lock()
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+
+            def f(self):
+                with self._other:
+                    with self._cond:
+                        self._cond.wait(timeout=1.0)
+    """
+    assert d80x_codes(tmp_path, src) == ["D801"]
+
+
+def test_d801_origin_disable_silences_lifted_reports(tmp_path):
+    """A disable on the deliberately-blocking primitive line silences
+    every interprocedurally-lifted report of it (the flock poll idiom)."""
+    src = """
+        import threading
+        import time
+
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def helper(self):
+                time.sleep(0.5)  # lint: disable=D801 (bounded poll)
+
+            def f(self):
+                with self._lock:
+                    self.helper()
+    """
+    assert d80x_codes(tmp_path, src) == []
+
+
+D802_SRC = """
+    import threading
+
+
+    class A:
+        def __init__(self):
+            self.state = 0  # thread: control
+            self._spawned = threading.Thread(target=self.worker)
+
+        def poll(self):  # thread: control
+            self.state += 1
+
+        def worker(self):  # thread: worker
+            self.state = 2
+"""
+
+
+def test_d802_wrong_thread_attr_touch_fires(tmp_path):
+    out = d80x(tmp_path, {"tpu_dra/serving/fix.py": D802_SRC})
+    assert [f.code for f in out] == ["D802"]
+    assert "control" in out[0].message and "worker" in out[0].message
+
+
+def test_d802_negative_same_domain(tmp_path):
+    src = """
+        class A:
+            def __init__(self):
+                self.state = 0  # thread: control
+
+            def poll(self):  # thread: control
+                self.state += 1
+
+            def tick(self):  # thread: control
+                self.state = 0
+    """
+    assert d80x_codes(tmp_path, src) == []
+
+
+def test_d802_unannotated_caller_of_domain_method_fires(tmp_path):
+    """Enforcement is opt-in per class, but once on, completeness is
+    forced: an unannotated method calling a domain-only one is flagged."""
+    src = """
+        class A:
+            def poll(self):  # thread: control
+                pass
+
+            def entry(self):
+                self.poll()
+    """
+    out = d80x(tmp_path, {"tpu_dra/serving/fix.py": src})
+    assert [f.code for f in out] == ["D802"]
+    assert "entry" in out[0].message
+
+
+def test_d802_any_method_touching_owned_state_fires(tmp_path):
+    """`any` is a claim of thread-safety: touching single-domain state
+    from it is exactly the violation the annotation would hide."""
+    src = """
+        class A:
+            def __init__(self):
+                self.state = 0  # thread: control
+
+            def poll(self):  # thread: control
+                self.state += 1
+
+            def status(self):  # thread: any (lock-free read... not!)
+                self.state = -1
+    """
+    assert d80x_codes(tmp_path, src) == ["D802"]
+
+
+def test_d802_private_methods_inherit_caller_domain(tmp_path):
+    src = """
+        class A:
+            def __init__(self):
+                self.state = 0  # thread: control
+
+            def poll(self):  # thread: control
+                self._step()
+
+            def _step(self):
+                self.state += 1
+    """
+    assert d80x_codes(tmp_path, src) == []
+
+
+def test_d803_stale_attr_annotation_fires(tmp_path):
+    src = """
+        class A:
+            def __init__(self):
+                self.state = 0  # thread: control
+
+            def poll(self):  # thread: control
+                pass
+    """
+    out = d80x(tmp_path, {"tpu_dra/serving/fix.py": src})
+    assert [f.code for f in out] == ["D803"]
+    assert "state" in out[0].message
+
+
+def test_d803_malformed_marker_fires(tmp_path):
+    src = """
+        class A:
+            def poll(self):  # thread: !!!
+                pass
+    """
+    assert d80x_codes(tmp_path, src) == ["D803"]
+
+
+def test_d803_misplaced_marker_fires(tmp_path):
+    src = """
+        class A:
+            def poll(self):
+                x = 1  # thread: control
+                return x
+    """
+    assert d80x_codes(tmp_path, src) == ["D803"]
+
+
+def test_d803_negative_prose_mention_in_docstring(tmp_path):
+    src = '''
+        class A:
+            """Annotate methods with ``# thread: control`` to pin them."""
+
+            def poll(self):
+                pass
+    '''
+    assert d80x_codes(tmp_path, src) == []
+
+
+def test_d80x_real_tree_is_clean():
+    """The live tree carries no lock-order cycles, no blocking calls
+    under locks, and no ownership violations — with an EMPTY baseline."""
+    files = sorted((REPO / "tpu_dra").rglob("*.py"))
+    files = [f for f in files if "/pb/" not in str(f)]
+    ctxs = [FileContext(p, REPO) for p in files]
+    assert LockdepPass().run_project(ctxs, extra_paths=files) == []
+
+
+def test_d80x_dot_graph_emits_nodes_and_edges():
+    files = sorted((REPO / "tpu_dra").rglob("*.py"))
+    files = [f for f in files if "/pb/" not in str(f)]
+    ctxs = [FileContext(p, REPO) for p in files]
+    p = LockdepPass()
+    list(p.run_project(ctxs, extra_paths=files))
+    dot = p.dot()
+    assert "digraph lock_order {" in dot
+    assert "Metrics._lock" in dot
+    # The well-known Router._lock -> Metrics._lock edge (closed static
+    # blind spot: found by runtime divergence, see hack/lockdep_diff.py)
+    assert '"serving.router.Router._lock" -> "infra.metrics.Metrics._lock"' \
+        in dot
+
+
+# --- R200 extension: explicit acquire/release + D802 deference --------------
+
+
+def test_r200_explicit_acquire_release_region_is_locked(tmp_path):
+    """The acquire(); try: ... finally: release() idiom counts as a
+    locked region — previously only `with` did."""
+    src = """
+        import threading
+
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.shared = 0
+                threading.Thread(target=self.b).start()
+
+            def a(self):
+                self._lock.acquire()
+                try:
+                    self.shared = 1
+                finally:
+                    self._lock.release()
+
+            def b(self):
+                with self._lock:
+                    self.shared = 2
+    """
+    assert codes(tmp_path, "c.py", src, RaceLintPass) == []
+
+
+def test_r200_write_after_release_still_fires(tmp_path):
+    src = """
+        import threading
+
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.shared = 0
+                threading.Thread(target=self.b).start()
+
+            def a(self):
+                self._lock.acquire()
+                self._lock.release()
+                self.shared = 1
+
+            def b(self):
+                with self._lock:
+                    self.shared = 2
+    """
+    assert codes(tmp_path, "c.py", src, RaceLintPass) == ["R200"]
+
+
+def test_r200_defers_to_d802_domain_annotated_methods(tmp_path):
+    """Attrs written only from methods pinned to ONE thread domain are
+    single-writer by enforced (D802) contract: no lock demanded, no
+    double-report."""
+    src = """
+        import threading
+
+
+        class C:
+            def __init__(self):
+                self.shared = 0
+                threading.Thread(target=self.b).start()
+
+            def a(self):  # thread: control
+                self.shared = 1
+
+            def b(self):  # thread: control
+                self.shared = 2
+    """
+    assert codes(tmp_path, "c.py", src, RaceLintPass) == []
+
+
+def test_r200_mixed_domain_writers_still_fire(tmp_path):
+    src = """
+        import threading
+
+
+        class C:
+            def __init__(self):
+                self.shared = 0
+                threading.Thread(target=self.b).start()
+
+            def a(self):  # thread: control
+                self.shared = 1
+
+            def b(self):
+                self.shared = 2
+    """
+    assert codes(tmp_path, "c.py", src, RaceLintPass) == ["R200", "R200"]
+
+
+def test_r200_defers_to_d802_domain_annotated_attr(tmp_path):
+    src = """
+        import threading
+
+
+        class C:
+            def __init__(self):
+                self.shared = 0  # thread: control
+                threading.Thread(target=self.b).start()
+
+            def a(self):
+                self.shared = 1
+
+            def b(self):
+                self.shared = 2
+    """
+    assert codes(tmp_path, "c.py", src, RaceLintPass) == []

@@ -1,0 +1,181 @@
+"""Runtime lockdep shim unit tests (tpu_dra/infra/lockdep.py).
+
+These install the shim explicitly (not via TPU_DRA_LOCKDEP) so they run
+in the ordinary tier-1 suite, inject deliberate lock-order inversions
+and ownership violations, and assert :func:`lockdep.check` names the
+offending locks/threads at teardown.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pytest
+
+from tpu_dra.infra import lockdep
+
+
+@pytest.fixture
+def shim():
+    """Fresh recorder per test; restores the session shim (if the suite
+    itself runs under TPU_DRA_LOCKDEP=1) afterwards."""
+    prev = lockdep._STATE
+    lockdep._STATE = None
+    lockdep.install()
+    yield
+    lockdep.uninstall()
+    if prev is not None:
+        lockdep._STATE = prev
+        threading.Lock = lockdep._lock_factory
+        threading.RLock = lockdep._rlock_factory
+
+
+def test_disabled_is_a_noop(monkeypatch):
+    monkeypatch.delenv(lockdep.ENV_VAR, raising=False)
+    assert not lockdep.enabled()
+    assert not lockdep.install_if_enabled()
+    # Factories untouched: a plain allocation is NOT a wrapper.
+    assert not isinstance(threading.Lock(), lockdep._LockBase)
+    # And the product hook is a no-op, not an error.
+    lockdep.single_owner(object(), "control")
+    assert lockdep.check() == {"installed": False}
+
+
+def test_install_wraps_and_uninstall_restores(shim):
+    lk = threading.Lock()
+    assert isinstance(lk, lockdep._Lock)
+    rl = threading.RLock()
+    assert isinstance(rl, lockdep._RLock)
+    lockdep.uninstall()
+    try:
+        assert not isinstance(threading.Lock(), lockdep._LockBase)
+        assert lockdep._STATE is None
+    finally:
+        lockdep.install()  # fixture teardown expects an installed shim
+
+
+def test_clean_run_check_passes(shim, tmp_path):
+    a = threading.Lock()
+    b = threading.Lock()
+    with a:
+        with b:
+            pass
+    with a:  # same order again: still acyclic
+        with b:
+            pass
+    dump = tmp_path / "dump.json"
+    rep = lockdep.check(dump_path=str(dump))
+    assert rep["installed"]
+    assert {a.site, b.site} <= set(rep["locks"])
+    assert rep["edges"][0]["src"] == a.site
+    assert rep["edges"][0]["dst"] == b.site
+    assert rep["edges"][0]["count"] == 2
+    # max_held is recorded per site, in ms.
+    assert a.site in rep["max_held_ms"]
+    # The dump round-trips as JSON (hack/lockdep_diff.py reads it).
+    assert json.loads(dump.read_text())["installed"]
+
+
+def test_injected_inversion_names_both_locks(shim):
+    """The ISSUE's acceptance case: an A->B / B->A inversion that never
+    actually deadlocks (the two orders run sequentially) must still
+    fail check() with a message naming both locks."""
+    a = threading.Lock()
+    b = threading.Lock()
+    with a:
+        with b:
+            pass
+
+    def inverted():
+        with b:
+            with a:
+                pass
+
+    t = threading.Thread(target=inverted, name="inverter")
+    t.start()
+    t.join()
+    with pytest.raises(lockdep.LockdepError) as ei:
+        lockdep.check()
+    msg = str(ei.value)
+    assert "lock-order cycle" in msg
+    assert a.site in msg and b.site in msg
+    assert "inverter" in msg  # the thread that drove the inverted hop
+
+
+def test_single_owner_violation_names_every_thread(shim):
+    class Router:
+        pass
+
+    obj = Router()
+    lockdep.single_owner(obj, "control")
+
+    def impostor():
+        lockdep.single_owner(obj, "control")
+
+    t = threading.Thread(target=impostor, name="impostor")
+    t.start()
+    t.join()
+    with pytest.raises(lockdep.LockdepError) as ei:
+        lockdep.check()
+    msg = str(ei.value)
+    assert "single-owner violation" in msg
+    assert "Router role='control'" in msg
+    assert "impostor" in msg and "MainThread" in msg
+
+
+def test_single_owner_same_thread_many_calls_is_fine(shim):
+    obj = object()
+    for _ in range(3):
+        lockdep.single_owner(obj, "control")
+    lockdep.single_owner(obj, "replica")  # distinct role, same thread
+    rep = lockdep.check()
+    assert len(rep["owners"]) == 2
+
+
+def test_rlock_reentrancy_takes_no_self_edge(shim):
+    r = threading.RLock()
+    with r:
+        with r:
+            pass
+    assert lockdep.observed_edges() == set()
+    lockdep.check()
+
+
+def test_condition_rides_through_wrapped_lock(shim):
+    lk = threading.Lock()
+    cond = threading.Condition(lk)
+    state = {"go": False}
+
+    def waiter():
+        with cond:
+            while not state["go"]:
+                cond.wait(timeout=5)
+
+    t = threading.Thread(target=waiter, name="waiter")
+    t.start()
+    with cond:
+        state["go"] = True
+        cond.notify_all()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    rep = lockdep.check()  # wait/notify on one lock: no edges, no cycle
+    assert lk.site in rep["locks"]
+
+
+def test_trylock_and_out_of_order_release_unwind(shim):
+    a = threading.Lock()
+    b = threading.Lock()
+    assert a.acquire(blocking=False)
+    assert b.acquire(blocking=False)
+    a.release()  # out-of-order: release the outer lock first
+    b.release()
+    assert not a.locked() and not b.locked()
+    # The held stack unwound: a fresh acquisition records no stale edge
+    # from a lock that is no longer held.
+    c = threading.Lock()
+    with c:
+        pass
+    edges = lockdep.observed_edges()
+    assert (a.site, c.site) not in edges
+    assert (b.site, c.site) not in edges

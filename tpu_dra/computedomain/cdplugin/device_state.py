@@ -64,6 +64,16 @@ def channel_device_name(i: int) -> str:
 DAEMON_DEVICE_NAME = "daemon"
 
 
+class _NotReadyRetry(Exception):
+    """The ComputeDomain exists but is not Ready yet and the deadline
+    has not expired. Internal control flow only: ``prepare()`` catches
+    it after releasing the device lock, pauses, and retries."""
+
+    def __init__(self, cd_uid: str):
+        super().__init__(cd_uid)
+        self.cd_uid = cd_uid
+
+
 class CDDeviceState:
     def __init__(
         self,
@@ -154,35 +164,52 @@ class CDDeviceState:
                 self.node_name, {"metadata": {"labels": {CD_LABEL_KEY: None}}}
             )
 
-    def assert_compute_domain_ready(self, cd_uid: str) -> dict:
+    def assert_compute_domain_ready(
+        self, cd_uid: str, ready_deadline: float
+    ) -> dict:
         """computedomain.go:238-295: raising here holds the workload pod in
         ContainerCreating; the kubelet retries until the slice is whole.
 
-        The wait consumes the calling RPC's deadline budget (expiry is
-        retriable too — the kubelet re-Prepares with a fresh budget)."""
-        budget = deadline.current()
-        ready_deadline = time.monotonic() + self.ready_timeout
-        while True:
-            cd = self._get_cd_by_uid(cd_uid)
-            if cd is None:
-                raise PrepareError(f"ComputeDomain {cd_uid} not found")
-            if cd.get("status", {}).get("status") == "Ready":
-                return cd
-            if time.monotonic() >= ready_deadline:
-                raise PrepareError(
-                    f"ComputeDomain {cd_uid} is not ready "
-                    f"({cd.get('status', {}).get('status') or 'no status'})"
-                )
-            budget.check(f"waiting for ComputeDomain {cd_uid} readiness")
-            budget.pause(0.1)
+        Single-shot check: not-Ready before the deadline raises
+        :class:`_NotReadyRetry`, which ``prepare()`` catches OUTSIDE the
+        device lock to pause and retry — the readiness wait must never
+        hold ``self._lock``, or every other claim's prepare/unprepare on
+        this node stalls behind one domain's assembly."""
+        cd = self._get_cd_by_uid(cd_uid)
+        if cd is None:
+            raise PrepareError(f"ComputeDomain {cd_uid} not found")
+        if cd.get("status", {}).get("status") == "Ready":
+            return cd
+        if time.monotonic() >= ready_deadline:
+            raise PrepareError(
+                f"ComputeDomain {cd_uid} is not ready "
+                f"({cd.get('status', {}).get('status') or 'no status'})"
+            )
+        raise _NotReadyRetry(cd_uid)
 
     # --- prepare/unprepare ---
 
     def prepare(self, claim: dict) -> List[KubeletDevice]:
-        with self._lock:
-            return self._prepare_locked(claim)
+        budget = deadline.current()
+        ready_deadline = time.monotonic() + self.ready_timeout
+        while True:
+            try:
+                with self._lock:
+                    return self._prepare_locked(claim, ready_deadline)
+            except _NotReadyRetry as nr:
+                # Pause with the lock RELEASED, then re-run the whole
+                # locked attempt (label/WAL steps are idempotent). The
+                # wait consumes the calling RPC's deadline budget
+                # (expiry is retriable too — the kubelet re-Prepares
+                # with a fresh budget).
+                budget.check(
+                    f"waiting for ComputeDomain {nr.cd_uid} readiness"
+                )
+                budget.pause(0.1)
 
-    def _prepare_locked(self, claim: dict) -> List[KubeletDevice]:
+    def _prepare_locked(
+        self, claim: dict, ready_deadline: float
+    ) -> List[KubeletDevice]:
         claim_uid = claim["metadata"]["uid"]
         cp = self.checkpoints.get()
         prev = cp.prepared_claims.get(claim_uid)
@@ -206,7 +233,9 @@ class CDDeviceState:
         crashpoint("cdplugin.prepare.after_wal_started")
 
         if isinstance(config, configapi.ComputeDomainChannelConfig):
-            prepared = self._prepare_channel(claim, config, results)
+            prepared = self._prepare_channel(
+                claim, config, results, ready_deadline
+            )
         elif isinstance(config, configapi.ComputeDomainDaemonConfig):
             prepared = self._prepare_daemon(claim, config, results)
         else:
@@ -235,6 +264,7 @@ class CDDeviceState:
         claim: dict,
         config: configapi.ComputeDomainChannelConfig,
         results: List[dict],
+        ready_deadline: float,
     ) -> PreparedDevices:
         cd = self._get_cd_by_uid(config.domain_id)
         if cd is None:
@@ -244,7 +274,7 @@ class CDDeviceState:
             claim, config.domain_id, results
         )
         self.add_node_label(config.domain_id)
-        self.assert_compute_domain_ready(config.domain_id)
+        self.assert_compute_domain_ready(config.domain_id, ready_deadline)
 
         config_dir = self.domain_config_dir(config.domain_id)
         env = read_bootstrap_env(config_dir) or {}

@@ -35,10 +35,10 @@ class ProcessManager:
         self._proc: Optional[subprocess.Popen] = None
         self._lock = threading.RLock()
         self._stop = threading.Event()
-        self._watchdog: Optional[threading.Thread] = None
-        self.restarts = 0
+        self._watchdog: Optional[threading.Thread] = None  # thread: daemon-main
+        self.restarts = 0  # thread: pm-watchdog (sole writer; read via on_restart on the same thread)
 
-    def ensure_started(self) -> None:
+    def ensure_started(self) -> None:  # thread: daemon-main
         with self._lock:
             if self._proc is not None and self._proc.poll() is None:
                 return
@@ -50,6 +50,7 @@ class ProcessManager:
             )
             self._watchdog.start()
 
+    # thread: pm-watchdog (entry: the watchdog thread target)
     def _watch(self) -> None:
         """1s-tick polling watchdog (process.go:169-204)."""
         while not self._stop.wait(self.watchdog_tick):
@@ -71,16 +72,16 @@ class ProcessManager:
             with self._lock:
                 self._proc = subprocess.Popen(self.argv)
 
-    def signal(self, sig: int) -> None:
+    def signal(self, sig: int) -> None:  # thread: any (lock-guarded)
         with self._lock:
             if self._proc is not None and self._proc.poll() is None:
                 self._proc.send_signal(sig)
 
-    def is_running(self) -> bool:
+    def is_running(self) -> bool:  # thread: any (lock-guarded)
         with self._lock:
             return self._proc is not None and self._proc.poll() is None
 
-    def stop(self, term_timeout: float = 5.0) -> None:
+    def stop(self, term_timeout: float = 5.0) -> None:  # thread: daemon-main
         """Graceful SIGTERM, then SIGKILL (process.go stop semantics)."""
         self._stop.set()
         with self._lock:

@@ -72,10 +72,16 @@ class Flock:
                         f"cancelled while acquiring lock ({self.path})"
                     )
                 budget.check(f"acquiring lock ({self.path})")
+                # Callers deliberately poll this cross-process lock
+                # while holding their in-process claim lock: the whole
+                # Prepare/Unprepare IS the critical section being
+                # serialized across driver processes, the wait is
+                # bounded by the RPC deadline budget, and the flock is
+                # a leaf (its holder takes no further locks).
                 if cancel_event is not None:
-                    cancel_event.wait(poll_period)
+                    cancel_event.wait(poll_period)  # lint: disable=D801 (budget-bounded cross-process poll)
                 else:
-                    budget.pause(poll_period)
+                    budget.pause(poll_period)  # lint: disable=D801 (budget-bounded cross-process poll)
         except BaseException:
             os.close(fd)
             raise

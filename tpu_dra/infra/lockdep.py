@@ -1,0 +1,483 @@
+"""Runtime lockdep: observed lock-order + thread-ownership checking.
+
+The static half of this lives in ``hack/lints/lockdep.py`` (the
+D800–D803 passes, see docs/static-analysis.md). This module is the
+runtime half: an env-gated shim that wraps ``threading.Lock`` /
+``threading.RLock`` so every *real* acquisition during a test or bench
+run feeds an observed acquisition graph — which locks were taken while
+which others were held, on which thread, and for how long. At teardown
+:func:`check` asserts the observed graph is acyclic (a cycle is a
+deadlock the scheduler just happened not to hit) and that every
+declared single-owner role was driven by at most one thread.
+
+Design rules:
+
+- **Zero overhead when off.** Nothing is patched unless
+  ``TPU_DRA_LOCKDEP=1`` (see :func:`install_if_enabled`); the product
+  hook :func:`single_owner` is a single global-read + ``None`` check
+  when the shim is not installed.
+- **Lock identity = creation site.** A lock is classed by the
+  ``path:line`` of its allocation — the same ``self._lock =
+  threading.Lock()`` line the static pass keys its ``LockDef`` on, so
+  the two graphs join on (path, line) and *divergence is itself a
+  finding*: an observed edge the static pass never derived means the
+  interprocedural analysis has a blind spot (``hack/lockdep_diff.py``
+  reports it; ``make lockdep`` runs the comparison).
+- **Condition rides for free.** ``threading.Condition(lock)`` binds the
+  (wrapped) lock's ``acquire``/``release``, so waits/notifies are
+  recorded through the lock wrapper without patching Condition itself.
+
+Ownership roles: the serving fabric's contract is about *roles*, not
+raw thread identity — "the autoscaler ticks on the SAME thread that
+drives Router.poll". Product code declares that with
+``single_owner(obj, role)`` at each role entry point (Router.poll and
+ClaimAutoscaler.tick both declare ``(router, "control")``; a second
+distinct thread showing up for the same (object, role) key fails
+:func:`check` naming every thread involved).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+ENV_VAR = "TPU_DRA_LOCKDEP"
+DUMP_VAR = "TPU_DRA_LOCKDEP_DUMP"
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_SELF_FILE = __file__.replace("\\", "/")
+
+
+class LockdepError(AssertionError):
+    """An observed lock-order cycle or ownership violation."""
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV_VAR, "") not in ("", "0")
+
+
+def _creation_site() -> str:
+    """``path:line`` of the frame that called the lock factory, with
+    interpreter/threading internals skipped so the site names the
+    product line (``tpu_dra/serving/router.py:262``)."""
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename.replace("\\", "/")
+        if fn != _SELF_FILE and not fn.endswith("threading.py"):
+            break
+        f = f.f_back
+    if f is None:
+        return "<unknown>:0"
+    fn = f.f_code.co_filename.replace("\\", "/")
+    for marker in ("/tpu_dra/", "/tests/", "/hack/", "/demo/"):
+        i = fn.rfind(marker)
+        if i >= 0:
+            fn = fn[i + 1:]
+            break
+    return f"{fn}:{f.f_lineno}"
+
+
+def _thread_name() -> str:
+    """The current thread's name WITHOUT threading.current_thread():
+    that call materializes a _DummyThread for unregistered threads,
+    whose bootstrap takes an Event -> Condition -> Lock — re-entering
+    this shim forever. A raw registry read cannot recurse."""
+    ident = threading.get_ident()
+    t = threading._active.get(ident)
+    return t.name if t is not None else f"thread-{ident}"
+
+
+class _Recorder:
+    """The observed graph. All shared maps are guarded by a *real*
+    (un-instrumented) lock; per-thread held stacks are only touched by
+    their own thread once created."""
+
+    def __init__(self):
+        self._mu = _REAL_LOCK()
+        # site -> kind ("lock"/"rlock"); every instrumented lock ever made.
+        self.lock_sites: Dict[str, str] = {}
+        # (src_site, dst_site) -> (thread_name, count)
+        self.edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        # site -> max observed held seconds
+        self.max_held: Dict[str, float] = {}
+        # (id(obj), role) -> {thread_ident: thread_name}; label keeps the
+        # object's type for the error message after the obj is gone.
+        self.owners: Dict[Tuple[int, str], Dict[int, str]] = {}
+        self.owner_labels: Dict[Tuple[int, str], str] = {}
+        # thread ident -> [wrapper, ...] currently held, acquisition order
+        self._held: Dict[int, List["_LockBase"]] = {}
+
+    def held_stack(self) -> List["_LockBase"]:
+        ident = threading.get_ident()
+        stack = self._held.get(ident)
+        if stack is None:
+            stack = []
+            with self._mu:
+                self._held[ident] = stack
+        return stack
+
+    def note_acquired(self, lock: "_LockBase") -> None:
+        stack = self.held_stack()
+        tname = _thread_name()
+        with self._mu:
+            for held in stack:
+                if held is lock:
+                    continue
+                key = (held.site, lock.site)
+                _, n = self.edges.get(key, (tname, 0))
+                self.edges[key] = (tname, n + 1)
+        stack.append(lock)
+
+    def note_released(self, lock: "_LockBase", held_for: float) -> None:
+        stack = self.held_stack()
+        # Remove the most recent entry for this lock; out-of-order
+        # release (legal for plain locks) still unwinds correctly.
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is lock:
+                del stack[i]
+                break
+        with self._mu:
+            if held_for > self.max_held.get(lock.site, 0.0):
+                self.max_held[lock.site] = held_for
+
+    def note_owner(self, obj, role: str) -> None:
+        key = (id(obj), role)
+        ident = threading.get_ident()
+        with self._mu:
+            self.owners.setdefault(key, {})[ident] = _thread_name()
+            self.owner_labels.setdefault(
+                key, f"{type(obj).__name__} role={role!r}"
+            )
+
+
+_STATE: Optional[_Recorder] = None
+
+
+class _LockBase:
+    """Shared wrapper protocol: context manager + acquire/release with
+    recording. Identity (``site``) is fixed at construction."""
+
+    __slots__ = ("_inner", "site", "_t0", "_depth")
+    kind = "lock"
+
+    def __init__(self, inner, site: str):
+        self._inner = inner
+        self.site = site
+        self._t0 = 0.0
+        self._depth = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._on_acquired()
+        return got
+
+    def _on_acquired(self) -> None:
+        rec = _STATE
+        if rec is None:
+            return
+        if self.kind == "rlock" and self._depth > 0:
+            # Re-entrant re-acquire: no new edge, no double-push.
+            self._depth += 1
+            return
+        self._depth += 1
+        self._t0 = time.monotonic()
+        rec.note_acquired(self)
+
+    def release(self):
+        self._on_release()
+        self._inner.release()
+
+    def _on_release(self) -> None:
+        rec = _STATE
+        if rec is None:
+            return
+        if self._depth > 1:
+            self._depth -= 1
+            return
+        self._depth = 0
+        rec.note_released(self, time.monotonic() - self._t0)
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<lockdep {self.kind} {self.site}>"
+
+
+class _Lock(_LockBase):
+    __slots__ = ()
+    kind = "lock"
+
+
+class _RLock(_LockBase):
+    __slots__ = ()
+    kind = "rlock"
+
+    # threading.Condition picks these up from its backing lock (when
+    # present) so wait() can fully release a multiply-acquired RLock;
+    # delegate AND keep the held bookkeeping honest.
+    def _release_save(self):
+        rec = _STATE
+        if rec is not None and self._depth > 0:
+            depth = self._depth
+            self._depth = 0
+            rec.note_released(self, time.monotonic() - self._t0)
+        else:
+            depth = 0
+        state = self._inner._release_save()
+        return (state, depth)
+
+    def _acquire_restore(self, saved):
+        state, depth = saved
+        self._inner._acquire_restore(state)
+        rec = _STATE
+        if rec is not None:
+            self._depth = max(depth, 1)
+            self._t0 = time.monotonic()
+            rec.note_acquired(self)
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+
+def _internal_caller() -> bool:
+    """True when the lock is being allocated by threading.py itself
+    (Thread bootstrap Events, default Condition locks, ...). Those must
+    stay un-instrumented: they are noise in the product graph and the
+    Thread-bootstrap ones re-enter the shim mid-registration."""
+    fn = sys._getframe(2).f_code.co_filename
+    return fn.endswith("threading.py")
+
+
+def _lock_factory():
+    if _STATE is None or _internal_caller():
+        return _REAL_LOCK()
+    site = _creation_site()
+    with _STATE._mu:
+        _STATE.lock_sites.setdefault(site, "lock")
+    return _Lock(_REAL_LOCK(), site)
+
+
+def _rlock_factory():
+    if _STATE is None or _internal_caller():
+        return _REAL_RLOCK()
+    site = _creation_site()
+    with _STATE._mu:
+        _STATE.lock_sites.setdefault(site, "rlock")
+    return _RLock(_REAL_RLOCK(), site)
+
+
+def install() -> None:
+    """Patch the ``threading`` lock factories; idempotent. Locks made
+    *before* install are invisible — install as early as possible
+    (tests/conftest.py does it at collection time when enabled)."""
+    global _STATE
+    if _STATE is None:
+        _STATE = _Recorder()
+    threading.Lock = _lock_factory
+    threading.RLock = _rlock_factory
+
+
+def uninstall() -> None:
+    """Restore the real factories and drop the recorder."""
+    global _STATE
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    _STATE = None
+
+
+def install_if_enabled() -> bool:
+    if enabled():
+        install()
+        return True
+    return False
+
+
+def single_owner(obj, role: str) -> None:
+    """Declare "the current thread is acting as ``role`` for ``obj``".
+
+    Call at every entry point of a single-owner role (Router.poll and
+    ClaimAutoscaler.tick both declare the fabric's control role *keyed
+    on the router object*, so an autoscaler ticked from a second thread
+    is caught even though each call site is individually consistent).
+    No-op unless the shim is installed.
+    """
+    rec = _STATE
+    if rec is None:
+        return
+    rec.note_owner(obj, role)
+
+
+def observed_edges() -> Set[Tuple[str, str]]:
+    rec = _STATE
+    if rec is None:
+        return set()
+    with rec._mu:
+        return set(rec.edges)
+
+
+def _find_cycle(edges) -> Optional[List[str]]:
+    """First cycle in the observed graph as a node list (A, B, ..., A);
+    iterative DFS, deterministic order."""
+    graph: Dict[str, List[str]] = {}
+    for a, b in sorted(edges):
+        graph.setdefault(a, []).append(b)
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in graph}
+    for root in sorted(graph):
+        if color.get(root, WHITE) != WHITE:
+            continue
+        stack: List[Tuple[str, int]] = [(root, 0)]
+        path = [root]
+        color[root] = GREY
+        while stack:
+            node, idx = stack[-1]
+            nbrs = graph.get(node, [])
+            if idx < len(nbrs):
+                stack[-1] = (node, idx + 1)
+                nxt = nbrs[idx]
+                c = color.get(nxt, WHITE)
+                if c == GREY:
+                    return path[path.index(nxt):] + [nxt]
+                if c == WHITE:
+                    color[nxt] = GREY
+                    stack.append((nxt, 0))
+                    path.append(nxt)
+            else:
+                color[node] = BLACK
+                stack.pop()
+                path.pop()
+    return None
+
+
+def report() -> dict:
+    """The observed graph as plain data (also what ``DUMP`` writes)."""
+    rec = _STATE
+    if rec is None:
+        return {"installed": False}
+    with rec._mu:
+        return {
+            "installed": True,
+            "locks": dict(rec.lock_sites),
+            "edges": [
+                {"src": a, "dst": b, "thread": t, "count": n}
+                for (a, b), (t, n) in sorted(rec.edges.items())
+            ],
+            "max_held_ms": {
+                site: round(sec * 1000, 3)
+                for site, sec in sorted(rec.max_held.items())
+            },
+            "owners": [
+                {
+                    "label": rec.owner_labels[key],
+                    "threads": sorted(rec.owners[key].values()),
+                }
+                for key in sorted(rec.owners, key=lambda k: (k[1], k[0]))
+            ],
+        }
+
+
+def check(dump_path: Optional[str] = None) -> dict:
+    """Teardown assertion: acyclic observed graph + single ownership.
+
+    Raises :class:`LockdepError` naming both locks of the first cycle
+    edge pair (and the threads that drove each direction), or every
+    thread that drove a supposedly single-owner role. On success
+    returns :func:`report` (and writes it to ``dump_path`` or
+    ``$TPU_DRA_LOCKDEP_DUMP`` when set — ``hack/lockdep_diff.py``
+    compares that dump against the static D800 graph).
+    """
+    rec = _STATE
+    rep = report()
+    if rec is None:
+        return rep
+    dump_path = dump_path or os.environ.get(DUMP_VAR)
+    if dump_path:
+        with open(dump_path, "w", encoding="utf-8") as fh:
+            json.dump(rep, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+    problems: List[str] = []
+    with rec._mu:
+        edges = dict(rec.edges)
+        owners = {k: dict(v) for k, v in rec.owners.items()}
+        labels = dict(rec.owner_labels)
+    cycle = _find_cycle(edges)
+    if cycle is not None:
+        hops = []
+        for a, b in zip(cycle, cycle[1:]):
+            t, n = edges[(a, b)]
+            hops.append(f"{a} -> {b} (thread {t!r}, {n}x)")
+        problems.append(
+            "lock-order cycle between "
+            + " and ".join(sorted(set(cycle[:-1])))
+            + ": " + "; ".join(hops)
+        )
+    for key, threads in sorted(owners.items(), key=lambda kv: kv[0][1]):
+        if len(threads) > 1:
+            problems.append(
+                f"single-owner violation: {labels[key]} was driven by "
+                f"{len(threads)} threads: "
+                + ", ".join(sorted(threads.values()))
+            )
+    if problems:
+        raise LockdepError(
+            "runtime lockdep found "
+            f"{len(problems)} problem(s):\n  - "
+            + "\n  - ".join(problems)
+        )
+    return rep
+
+
+def _main(argv: List[str]) -> int:
+    """``python -m tpu_dra.infra.lockdep <module> [args...]``: install
+    the shim, run ``<module>`` as ``__main__`` (its own argv), then run
+    :func:`check` over everything the run acquired. This is how
+    ``make lockdep`` drives the fabric/fault/repack smokes."""
+    if not argv:
+        print(
+            "usage: python -m tpu_dra.infra.lockdep <module> [args...]",
+            file=sys.stderr,
+        )
+        return 2
+    install()
+    import runpy
+
+    sys.argv = argv
+    rc = 0
+    try:
+        runpy.run_module(argv[0], run_name="__main__", alter_sys=True)
+    except SystemExit as exc:
+        code = exc.code
+        rc = code if isinstance(code, int) else (0 if code is None else 1)
+    rep = check()
+    print(
+        f"lockdep: {len(rep.get('locks', {}))} lock(s), "
+        f"{len(rep.get('edges', []))} observed edge(s), "
+        f"{len(rep.get('owners', []))} owner role(s) — clean",
+        file=sys.stderr,
+    )
+    return rc
+
+
+if __name__ == "__main__":
+    # `-m` runs this file under the name __main__, which would be a
+    # SECOND module instance: product imports of tpu_dra.infra.lockdep
+    # would see _STATE=None and single_owner would no-op. Delegate to
+    # the canonical instance so there is exactly one recorder.
+    from tpu_dra.infra import lockdep as _canonical
+
+    raise SystemExit(_canonical._main(sys.argv[1:]))

@@ -271,7 +271,7 @@ class FakeApiServer:
                     if rem <= 0:
                         break
                     held = True
-                    time.sleep(min(rem, 0.05))  # lint: disable=D800 (injected fault: the blackhole hold IS the partition being simulated)
+                    time.sleep(min(rem, 0.05))  # lint: disable=S800 (injected fault: the blackhole hold IS the partition being simulated)
                 if held:
                     with outer._fault_lock:
                         outer._stats["partitioned"] += 1
@@ -296,7 +296,7 @@ class FakeApiServer:
                 if delay > 0:
                     with outer._fault_lock:
                         outer._stats["delayed"] += 1
-                    time.sleep(delay)  # lint: disable=D800 (injected fault: the delay IS the latency being simulated)
+                    time.sleep(delay)  # lint: disable=S800 (injected fault: the delay IS the latency being simulated)
                 return False
 
             def _maybe_throttle(self) -> bool:

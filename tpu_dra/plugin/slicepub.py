@@ -72,7 +72,10 @@ def slice_content_digest(s: dict) -> str:
 class SlicePublisher:
     """One node's pool-set publisher. NOT internally locked: the owner
     serializes calls (the driver holds ``_publish_lock`` across
-    :meth:`publish`; each fleetsim node agent owns its publisher)."""
+    :meth:`publish`; each fleetsim node agent owns its publisher).
+    The serialization is a ROLE, not a fixed thread identity, so the
+    ``# thread: publisher`` annotations below name that role; the D802
+    pass keeps every mutating entry point inside it."""
 
     def __init__(
         self,
@@ -97,14 +100,14 @@ class SlicePublisher:
         # publish re-lists the server before diffing, so drift heals on
         # the next trigger within a bounded window. 0 disables (tests).
         self.reverify_seconds = reverify_seconds
-        self._last_verify = time.monotonic()
+        self._last_verify = time.monotonic()  # thread: publisher
         # name -> content digest of every slice WE committed; None =
         # never synced (cold start relists to adopt pre-existing slices
         # from an earlier process incarnation). ``presume_empty`` skips
         # that adoption relist — the fleet harness spins up thousands
         # of publishers against a server it KNOWS starts empty, and N
         # cold LISTs of an N-node fleet is O(N^2).
-        self._published: Optional[Dict[str, str]] = (
+        self._published: Optional[Dict[str, str]] = (  # thread: publisher (serialized by the owner's publish lock)
             {} if presume_empty else None
         )
 
@@ -120,12 +123,12 @@ class SlicePublisher:
             existing[s["metadata"]["name"]] = slice_content_digest(s)
         return existing
 
-    def invalidate(self) -> None:
+    def invalidate(self) -> None:  # thread: publisher
         """Drop the write cache; the next publish relists. Called when
         an external writer is known to have touched the pool set."""
         self._published = None
 
-    def committed_digest(self, name: str) -> Optional[str]:
+    def committed_digest(self, name: str) -> Optional[str]:  # thread: publisher
         """The content digest this publisher last committed for
         ``name`` (None when unknown or the cache is cold). The driver's
         node-scoped slice informer compares watch events against it to
@@ -136,7 +139,7 @@ class SlicePublisher:
             return None
         return self._published.get(name)
 
-    def publish(self, build: Callable[[int], List[dict]]) -> int:
+    def publish(self, build: Callable[[int], List[dict]]) -> int:  # thread: publisher
         """Diff-and-write one pass; returns the number of API writes.
 
         ``build(generation)`` produces the desired pool set stamped with

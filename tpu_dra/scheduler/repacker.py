@@ -74,7 +74,10 @@ left for the next leader's ``recover()``.
 Threading: ``tick()`` is a non-blocking state machine. Embedded in the
 serving fabric it runs on the fabric's control thread (the thread that
 owns router/replica mutation); standalone, :meth:`start` runs it on a
-leader-elected background loop (``infra/leaderelection.py`` Lease).
+leader-elected background loop (``infra/leaderelection.py`` Lease)
+which ASSUMES the control role — single-writer, joined across
+leadership handoffs. Enforced by the D802 lint pass via the
+``# thread: control`` annotations below.
 """
 
 from __future__ import annotations
@@ -313,7 +316,8 @@ class Repacker:
             self._stop_lead = stop
 
             def target():
-                self._run_loop(stop)
+                # The spawned thread is the control role's owner.
+                self._run_loop(stop)  # lint: disable=D802 (thread entry: this call IS the role assumption)
 
         self._thread = threading.Thread(
             target=target, daemon=True, name="repacker"
@@ -351,7 +355,7 @@ class Repacker:
             stop.set()
             t.join(timeout=30)
             if self._active:
-                self._abort_all("leader lease lost")
+                self._abort_all("leader lease lost")  # lint: disable=D802 (handoff point: the loop thread was joined above, so this thread now holds the control role)
 
         return stop_lead
 
@@ -360,13 +364,17 @@ class Repacker:
         if self.metrics is not None:
             self.metrics.set_gauge("repacker_leader", 1.0 if leading else 0.0)
 
+    # thread: control (the leader loop thread assumes the control role)
     def _run_loop(self, stop: threading.Event) -> None:
         # A fresh leadership term starts from the WAL alone: anything
         # left in _active belongs to a PREVIOUS term whose plans
         # recover() is about to roll back or forward — advancing a
         # stale in-memory migration would re-execute a move the
         # recovery just resolved.
-        self._active = []  # lint: disable=R200 (single-writer: the previous loop thread was joined before this one started)
+        # Single-writer: the previous loop thread was joined before
+        # this one started; the control-domain annotations (D802)
+        # carry the contract.
+        self._active = []
         try:
             self.recover()
         except Exception:
@@ -384,7 +392,7 @@ class Repacker:
 
     # --- the control entry point ----------------------------------------
 
-    def tick(self) -> None:
+    def tick(self) -> None:  # thread: control
         """One pass: abort if not leading, advance active migrations,
         plan new ones within the disruption budget, export gauges."""
         if not self.is_leader:
@@ -402,7 +410,7 @@ class Repacker:
 
     # --- recovery ---------------------------------------------------------
 
-    def recover(self) -> int:
+    def recover(self) -> int:  # thread: control
         """Resolve every WAL'd half-move left by a dead leader (see the
         module-doc table). Returns how many plans were resolved."""
         resolved = 0
@@ -441,7 +449,7 @@ class Repacker:
                 m.phase = PHASE_RELEASED
                 m.span = self._migration_span(claim, recovery="forward")
                 m.span.event("recovered", phase=phase, action="forward")
-                self._active.append(m)  # lint: disable=R200 (single-writer: recover/tick run on ONE thread — the control thread or the sole leader loop, joined across leadership handoffs)
+                self._active.append(m)
                 log.info("repack recovery: resuming half-move %s", key)
             else:
                 self._drop_annotation(md["name"], md.get("namespace"))
@@ -605,7 +613,7 @@ class Repacker:
 
     # --- execution --------------------------------------------------------
 
-    def _begin(self, claim: dict, frag_before: float) -> None:
+    def _begin(self, claim: dict, frag_before: float) -> None:  # thread: control
         md = claim["metadata"]
         key = f"{md.get('namespace')}/{md['name']}"
         from_results = (
@@ -636,7 +644,7 @@ class Repacker:
         )
         m.span = self._migration_span(claim)
         m.span.event("phase.planned")
-        self._active.append(m)  # lint: disable=R200 (single-writer, same contract as recover)
+        self._active.append(m)
         log.info("repack: planned migration of %s", key)
 
     def _advance(self, m: _Migration) -> None:
@@ -827,6 +835,7 @@ class Repacker:
 
     # --- rollback / abort -------------------------------------------------
 
+    # thread: control (elector callback runs it only AFTER joining the loop thread: the role moves with the handoff)
     def _abort_all(self, why: str) -> None:
         for m in list(self._active):
             if m.phase in (PHASE_PLANNED, "draining", PHASE_EVACUATED):
@@ -843,29 +852,30 @@ class Repacker:
                 # boundary, so no tenant is stranded.
                 self._abort_done(m, why)
 
+    # thread: control
     def _rollback(self, m: _Migration, why: str) -> None:
         self._drop_annotation(m.name, m.namespace)
         self.serving.abort(m.key)
         self._abort_done(m, why)
 
-    def _abort_done(self, m: _Migration, why: str) -> None:
+    def _abort_done(self, m: _Migration, why: str) -> None:  # thread: control
         m.span.set_status(f"aborted: {why}")
         self._forget(m)
         self.aborted += 1
         self._inc("repacker_migrations_aborted_total")
-        self._last_disrupted[m.key] = self.clock()  # lint: disable=R200 (single-writer, same contract as recover)
+        self._last_disrupted[m.key] = self.clock()
         log.warning("repack: migration of %s aborted: %s", m.key, why)
 
-    def _complete(self, m: _Migration) -> None:
+    def _complete(self, m: _Migration) -> None:  # thread: control
         m.span.event("phase.committed")
         self._forget(m)
         self.migrations += 1
         self._inc("repacker_migrations_total")
-        self._last_disrupted[m.key] = self.clock()  # lint: disable=R200 (single-writer, same contract as recover)
+        self._last_disrupted[m.key] = self.clock()
 
-    def _forget(self, m: _Migration) -> None:
+    def _forget(self, m: _Migration) -> None:  # thread: control
         m.span.end()
-        self._active = [x for x in self._active if x is not m]  # lint: disable=R200 (single-writer, same contract as recover)
+        self._active = [x for x in self._active if x is not m]
 
     def _migration_span(self, claim: dict, recovery: str = ""):
         """The single mint point for ``repacker.claim.migrate`` spans

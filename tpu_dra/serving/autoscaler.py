@@ -38,6 +38,7 @@ import dataclasses
 import time
 from typing import Callable, List, Optional
 
+from tpu_dra.infra import lockdep
 from tpu_dra.serving.router import Replica, Router
 
 
@@ -142,7 +143,10 @@ class ClaimAutoscaler:
 
     # --- the control-thread entry point ---
 
-    def tick(self) -> None:
+    def tick(self) -> None:  # thread: control
+        # Keyed on the ROUTER: the contract is "ticks on the same
+        # thread that drives Router.poll", not merely self-consistency.
+        lockdep.single_owner(self.router, "control")
         self._check_claims()
         self._tick_dead()
         if self._pending_claim is not None:

@@ -22,9 +22,10 @@ protocol:
   the migrating mark; the tenant keeps serving on the old placement.
 
 Threading: every method runs on the fabric's CONTROL thread (the same
-thread that drives ``Router.poll`` and the autoscaler), per the
-router's threading contract — the repacker's ``tick()`` is called from
-that thread when embedded in a fabric.
+thread that drives ``Router.poll`` and the autoscaler) — the
+repacker's ``tick()`` is called from that thread when embedded in a
+fabric. The contract is enforced by the D802 lint pass via the
+``# thread: control`` annotations below (see docs/static-analysis.md).
 """
 
 from __future__ import annotations
@@ -68,7 +69,7 @@ class FabricRepackAdapter(ServingAdapter):
 
     # --- the repacker protocol ---
 
-    def begin_drain(self, key: str) -> None:
+    def begin_drain(self, key: str) -> None:  # thread: control
         rep = self._replica(key)
         if rep is None:
             return  # no live tenant behind this claim: placement-only
@@ -77,18 +78,18 @@ class FabricRepackAdapter(ServingAdapter):
         rep.begin_evacuate()
         self._draining.add(key)
 
-    def drain_done(self, key: str) -> bool:
+    def drain_done(self, key: str) -> bool:  # thread: control
         rep = self._replica(key)
         return rep is None or rep.evac_done
 
-    def finish_drain(self, key: str) -> int:
+    def finish_drain(self, key: str) -> int:  # thread: control
         rep = self._replica(key)
         if rep is None or key not in self._draining:
             return 0
         self._draining.discard(key)
         return self.router.requeue_evacuated(rep)
 
-    def rebind(self, key: str, claim: dict) -> None:
+    def rebind(self, key: str, claim: dict) -> None:  # thread: control
         old = self._replica(key)
         new = self.make_replica(claim)
         new.claim_name = claim["metadata"]["name"]
@@ -99,7 +100,7 @@ class FabricRepackAdapter(ServingAdapter):
             old.stop()
         self.rebinds += 1
 
-    def abort(self, key: str) -> None:
+    def abort(self, key: str) -> None:  # thread: control
         rep = self._replica(key)
         if rep is None:
             return
@@ -120,7 +121,7 @@ class FabricRepackAdapter(ServingAdapter):
 
     # --- the utilization signal (MISO: idle claims move first) ---
 
-    def utilization(self) -> Dict[str, float]:
+    def utilization(self) -> Dict[str, float]:  # thread: control
         """Per-claim occupancy in [0, 1]: the replica's in-flight share
         of its dispatch cap. The repacker takes this callable directly
         as its ``utilization`` signal when embedded in a fabric."""

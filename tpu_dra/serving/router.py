@@ -57,9 +57,12 @@ admission gracefully — the backlog cap scales down by the owed
 fraction, so BATCH sheds at the door first (``fabric_shed_total{cls=}``
 counts it, ``fabric_degraded`` gauges it for fleetmon).
 
-Threading contract: ``submit()`` may be called from any thread (the
-open-loop trace threads); ``poll()`` and everything the autoscaler
-calls run on ONE control thread; each :class:`Replica` owns the only
+Threading contract — ENFORCED by ``# thread:`` annotations (lint codes
+D802/D803, runtime twin :mod:`tpu_dra.infra.lockdep`), not prose:
+``submit()`` and the lock-guarded gauges are ``# thread: any``;
+``poll()`` and everything the autoscaler/repacker call are
+``# thread: control`` (one thread assumes the control role per
+fabric); ``Replica._loop`` is ``# thread: replica`` — it owns the only
 thread that touches its engine's internals (dispatch rides the
 engine's append-only ``add_request``).
 """
@@ -77,7 +80,7 @@ from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
-from tpu_dra.infra import deadline, trace
+from tpu_dra.infra import deadline, lockdep, trace
 from tpu_dra.serving.faults import (
     CircuitBreaker,
     DispatchJournal,
@@ -282,8 +285,8 @@ class Replica:
         self.death_reason = ""
         # Watchdog state, control-thread-owned: the engine progress
         # value last seen and the deadline budget it must beat.
-        self.last_progress: Optional[int] = None
-        self.watchdog: Optional[deadline.Budget] = None
+        self.last_progress: Optional[int] = None  # thread: control
+        self.watchdog: Optional[deadline.Budget] = None  # thread: control
         self._fault: Optional[str] = None  # chaos injection seam
         self.outbox: Deque[Completion] = collections.deque()
         # KV-migration mailboxes (ISSUE 17). GIL-atomic deque append /
@@ -293,7 +296,7 @@ class Replica:
         self.migration_outbox: Deque = collections.deque()  # SequenceExtent
         self._import_inbox: Deque = collections.deque()  # (sx, t0)
         self.import_results: Deque = collections.deque()  # (sx, ok, t0)
-        self.inflight: Dict[str, _FabricReq] = {}  # router-thread-owned
+        self.inflight: Dict[str, _FabricReq] = {}  # thread: control (router dispatch bookkeeping)
         self._evac_request = threading.Event()
         self._evac_done = threading.Event()
         self._evacuated: List[Evacuated] = []
@@ -301,13 +304,13 @@ class Replica:
         self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
-    def start(self) -> None:
+    def start(self) -> None:  # thread: control
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name=f"replica-{self.name}"
         )
         self._thread.start()
 
-    def signal_stop(self) -> None:
+    def signal_stop(self) -> None:  # thread: control
         """Ask the engine thread to exit WITHOUT joining: the control
         loop must not block on a thread that may be wedged (that is the
         exact failure being contained). The autoscaler joins later with
@@ -315,7 +318,7 @@ class Replica:
         self._stop.set()
         self._wake.set()
 
-    def stop(self, timeout: Optional[float] = None) -> bool:
+    def stop(self, timeout: Optional[float] = None) -> bool:  # thread: control
         """Stop the engine thread; returns True if it actually exited
         within ``timeout`` seconds. A join timeout no longer hangs
         silently: it is logged, counted
@@ -342,7 +345,7 @@ class Replica:
         self.engine.close()
         return joined
 
-    def inject_fault(self, kind: str) -> None:
+    def inject_fault(self, kind: str) -> None:  # thread: control
         """Chaos seam (ISSUE 16): arm a fault the engine thread trips
         before its next step. ``"crash"`` raises :class:`ReplicaFault`
         out of the loop (the hard-death path); ``"stall"`` wedges the
@@ -351,11 +354,11 @@ class Replica:
         self._fault = kind  # lint: disable=R200 (one-shot flag handoff: single writer arms, the engine thread consumes-and-clears; a GIL-atomic attribute store is the whole protocol)
         self._wake.set()
 
-    def submit(self, req: Request) -> None:
+    def submit(self, req: Request) -> None:  # thread: control
         self.engine.add_request(req)
         self._wake.set()
 
-    def submit_extent(self, sx, t0: float) -> None:
+    def submit_extent(self, sx, t0: float) -> None:  # thread: control
         """Hand a migrated sequence's KV extent to this replica's
         engine thread for grafting (control thread side of the import
         handshake). The result — grafted or rejected for capacity —
@@ -365,22 +368,23 @@ class Replica:
 
     # --- evacuation handshake (autoscaler scale-down) ---
 
-    def begin_evacuate(self) -> None:
+    def begin_evacuate(self) -> None:  # thread: control
         self._evac_done.clear()
         self._evac_request.set()
         self._wake.set()
 
     @property
-    def evac_done(self) -> bool:
+    def evac_done(self) -> bool:  # thread: control
         return self._evac_done.is_set()
 
-    def take_evacuated(self) -> List[Evacuated]:
+    def take_evacuated(self) -> List[Evacuated]:  # thread: control
         out, self._evacuated = self._evacuated, []  # lint: disable=R200 (handshake-ordered: written by the engine thread BEFORE _evac_done.set(), read by the control thread only AFTER evac_done — the Event is the fence)
         return out
 
     # --- engine thread ---
 
-    def _loop(self) -> None:
+    def _loop(self) -> None:  # thread: replica (entry: Thread target started by start())
+        lockdep.single_owner(self, "replica")
         try:
             while not self._stop.is_set():
                 fault = self._fault
@@ -535,7 +539,7 @@ class Router:
 
     # --- replica set (autoscaler-mutated, control thread only) ---
 
-    def add_replica(self, rep: Replica) -> None:
+    def add_replica(self, rep: Replica) -> None:  # thread: control
         self.replicas.append(rep)  # lint: disable=R200 (replica-set mutation is control-thread-only by the module's threading contract; submit() threads never touch it)
         if self._capacity_owed > 0:
             # Capacity restored (re-bind or replacement claim): the
@@ -545,14 +549,14 @@ class Router:
                 self._capacity_owed -= 1
         self._export()
 
-    def remove_replica(self, rep: Replica) -> None:
+    def remove_replica(self, rep: Replica) -> None:  # thread: control
         self.replicas = [r for r in self.replicas if r is not rep]  # lint: disable=R200 (control-thread-only, same contract as add_replica)
         self._export()
 
-    def live_replicas(self) -> List[Replica]:
+    def live_replicas(self) -> List[Replica]:  # thread: control
         return [r for r in self.replicas if not r.quiesced]
 
-    def take_dead(self) -> List[Replica]:
+    def take_dead(self) -> List[Replica]:  # thread: control
         """Hand the parked dead replicas to the autoscaler (which joins
         their threads with a bounded timeout and re-binds or replaces
         their claims); clears the parking list."""
@@ -561,6 +565,7 @@ class Router:
 
     # --- intake ---
 
+    # thread: any (the open-loop trace threads; WFQ state is lock-guarded)
     def submit(
         self, tenant: str, req: Request, session: Optional[str] = None
     ) -> bool:
@@ -632,12 +637,13 @@ class Router:
 
     # --- control loop ---
 
-    def poll(self) -> bool:
+    def poll(self) -> bool:  # thread: control
         """One control-loop pass: reap dead replicas (journal-recover
         their in-flight work), collect completions, dispatch from the
         WFQ into replicas with headroom, export gauges. Returns True
         when any work moved. A replica death never raises out of here —
         it is detected, contained, and recovered (ISSUE 16)."""
+        lockdep.single_owner(self, "control")
         moved = self._reap()
         # Migrations settle BEFORE completions: a fast decode replica
         # can graft an extent AND finish the sequence inside one poll
@@ -689,7 +695,7 @@ class Router:
             return False
         return rep.watchdog.expired()
 
-    def mark_dead(self, rep: Replica, reason: str) -> int:
+    def mark_dead(self, rep: Replica, reason: str) -> int:  # thread: control
         """Classify ``rep`` dead, recover its in-flight sequences from
         the dispatch journal, and park it for the autoscaler. Returns
         how many sequences were re-queued. Idempotent per replica."""
@@ -783,7 +789,7 @@ class Router:
             fr.trace_ctx = e.trace_ctx
         return fr
 
-    def recover_from_journal(self, journal: DispatchJournal) -> int:
+    def recover_from_journal(self, journal: DispatchJournal) -> int:  # thread: control
         """Crash-matrix restart path: a NEW router adopts a restored
         journal — every open entry re-enters its tenant's queue front
         (first-dispatch order), accounting is rebuilt, and closed rids
@@ -814,16 +820,16 @@ class Router:
         return n
 
     @property
-    def busy(self) -> bool:
+    def busy(self) -> bool:  # thread: any (lock-guarded read)
         if self._in_system > 0:
             return True
         return any(r.outbox for r in self.replicas)
 
-    def backlog_tokens(self) -> float:
+    def backlog_tokens(self) -> float:  # thread: any (lock-guarded read)
         with self._lock:
             return self._backlog_tokens
 
-    def queued_tokens(self) -> float:
+    def queued_tokens(self) -> float:  # thread: any (lock-guarded read)
         """Token cost still waiting in the WFQ (excludes dispatched
         work) — the autoscaler's load signal: in-flight cost is bounded
         by the per-replica inflight cap and finishes on its own; it is
@@ -831,24 +837,24 @@ class Router:
         with self._lock:
             return self._backlog_tokens - self._inflight_tokens
 
-    def in_system(self) -> int:
+    def in_system(self) -> int:  # thread: any (lock-guarded read)
         return self._in_system
 
-    def queued_prefill_tokens(self) -> float:
+    def queued_prefill_tokens(self) -> float:  # thread: any (lock-guarded read)
         """Prefill-side queued work: prompt (+ folded emitted) tokens
         the next dispatches will have to compute — the signal that says
         the PREFILL pool is too small."""
         with self._lock:
             return self._queued_prefill_tokens
 
-    def queued_decode_tokens(self) -> float:
+    def queued_decode_tokens(self) -> float:  # thread: any (lock-guarded read)
         """Decode-side queued work: tokens still owed by queued
         requests plus the migration waiting room — the signal that says
         the DECODE pool is too small."""
         with self._lock:
             return self._queued_decode_tokens
 
-    def migration_backlog(self) -> int:
+    def migration_backlog(self) -> int:  # thread: any (lock-guarded read)
         """Extents waiting for a decode replica with headroom."""
         return len(self._migrating)
 
@@ -1221,7 +1227,7 @@ class Router:
 
     # --- evacuation splice (autoscaler scale-down) ---
 
-    def requeue_evacuated(self, rep: Replica) -> int:
+    def requeue_evacuated(self, rep: Replica) -> int:  # thread: control
         """Fold a drained replica's evacuated sequences back into the
         WFQ at the FRONT of their tenants' queues (they already waited
         their fair turn once — their virtual cost was charged at first
@@ -1270,7 +1276,7 @@ class Router:
 
     # --- observability ---
 
-    def tenant_stats(self) -> Dict[str, dict]:
+    def tenant_stats(self) -> Dict[str, dict]:  # thread: any (lock-guarded read)
         out = {}
         with self._lock:
             for name, ts in self._tenants.items():

@@ -608,6 +608,7 @@ class Engine:
 
     # --- public API ------------------------------------------------------
 
+    # thread: any (append-only handoff, safe concurrent with the owner's step; see serving/router.py)
     def add_request(self, req: Request) -> None:
         if len(req.prompt) < 1 or req.max_new_tokens < 1:
             raise ValueError(
