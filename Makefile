@@ -3,7 +3,7 @@
 IMAGE_REPO ?= registry.local/tpu-dra-driver
 IMAGE_TAG  ?= v0.1.0
 
-.PHONY: all native test test-slow bench decodebench allocbench enginebench specbench shardbench fleetbench fabricbench faultbench disaggbench repackbench tracecheck slocheck image bats lint lint-fast shlint lockdep lock-graph chaos crashmatrix apisoak ci clean
+.PHONY: all native test test-slow bench decodebench allocbench enginebench specbench shardbench fleetbench fabricbench faultbench disaggbench repackbench gangbench tracecheck slocheck image bats lint lint-fast shlint lockdep lock-graph chaos crashmatrix apisoak ci clean
 
 all: native test
 
@@ -138,6 +138,21 @@ disaggbench:
 # BENCH_r*.json (docs/scheduling.md, "Autonomous repacking").
 repackbench:
 	python -m tpu_dra.serving.repackbench --smoke
+
+# Gang-scheduling CPU smoke (ISSUE 19): all-or-nothing multi-node gangs
+# over a heterogeneous v5e/v5p fleet — hard asserts on: the
+# corridor-preserving packed policy strictly beating naive first-fit on
+# perf-weighted achievable utilization over the identical workload
+# (every gang seated, none partial); and the corridor repack drill — a
+# provably-unschedulable 4-member full-node gang becomes schedulable
+# after the repacker's corridor-mode consolidation migrations open a
+# whole-node corridor, then seats atomically through the WAL'd
+# commit_gang path on distinct nodes with no WAL residue. The full
+# fleet-scale configuration runs as `bench.py --leg-gang` and lands in
+# BENCH_r*.json (docs/scheduling.md, "Gang scheduling & heterogeneous
+# fleets").
+gangbench:
+	python -m tpu_dra.scheduler.gangbench --smoke
 
 # Claim-lifecycle tracing smoke (ISSUE 13): a tiny fleet through the
 # real scheduler + publisher + kubelet analog, a stub-silicon plugin
@@ -289,7 +304,7 @@ lock-graph:
 # (flakes surface in CI, not in the judge's rerun), the 13 bats suites
 # executed against the minicluster, the batsless process-level e2e, and
 # the bench artifact schema gate.
-ci: lint lint-fast shlint lockdep native chaos crashmatrix apisoak decodebench allocbench enginebench specbench shardbench fleetbench fabricbench faultbench disaggbench repackbench tracecheck slocheck
+ci: lint lint-fast shlint lockdep native chaos crashmatrix apisoak decodebench allocbench enginebench specbench shardbench fleetbench fabricbench faultbench disaggbench repackbench gangbench tracecheck slocheck
 	python -m pytest tests/ -q -m 'not slow'
 	python -m pytest tests/ -q -m 'not slow'
 	python -m pytest tests/test_chaos.py -q -m slow

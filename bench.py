@@ -721,6 +721,22 @@ def _leg_repack_main() -> int:
     return repack_main([])
 
 
+def _leg_gang_main() -> int:
+    """Gang-scheduling leg (ISSUE 19): all-or-nothing multi-node gangs
+    over a heterogeneous v5e/v5p fleet — perf-weighted achievable
+    utilization of the corridor-preserving packed policy vs naive
+    first-fit on the identical workload, plus the repacker corridor
+    drill (consolidation migrations opening a whole-node corridor a
+    pending gang then seats through the atomic commit path). Pure
+    CPU — this measures the scheduler, not chips
+    (tpu_dra/scheduler/gangbench.py; methodology: docs/scheduling.md
+    'Gang scheduling & heterogeneous fleets')."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from tpu_dra.scheduler.gangbench import main as gang_main
+
+    return gang_main([])
+
+
 def _leg_rotate_main() -> int:
     """Time-slice rotation client: a live trainer that steps only while
     holding the arbiter lease and yields at the quantum. Both clients
@@ -1618,6 +1634,8 @@ def main() -> int:
         return _leg_disagg_main()
     if "--leg-repack" in sys.argv:
         return _leg_repack_main()
+    if "--leg-gang" in sys.argv:
+        return _leg_gang_main()
     if "--leg-rotate" in sys.argv:
         return _leg_rotate_main()
 
@@ -1776,6 +1794,18 @@ def main() -> int:
         f"storm {repack['repack_storm_claim_ready_p99_ms']} ms vs quiet "
         f"{repack['repack_quiet_claim_ready_p99_ms']} ms "
         f"(x{repack['repack_storm_p99_x']})",
+        file=sys.stderr,
+    )
+
+    gang = _run_leg({}, flag="--leg-gang")
+    print(
+        f"gang ({gang['fleet_nodes']} nodes, {gang['gang_count']} gangs "
+        f"x {gang['gang_size']}): util packed {gang['gang_util_packed']} "
+        f"vs first-fit {gang['gang_util_firstfit']} "
+        f"({gang['gang_seated_packed']} vs "
+        f"{gang['gang_seated_firstfit']} gangs seated); corridor "
+        f"{gang['gang_corridor_nodes']} nodes opened in "
+        f"{gang['gang_repack_migrations']} migrations",
         file=sys.stderr,
     )
 
@@ -2284,6 +2314,18 @@ def main() -> int:
                     "repack_storm_claim_ready_p99_ms"
                 ],
                 "repack_storm_p99_x": repack["repack_storm_p99_x"],
+                # Gang-scheduling leg (ISSUE 19): all-or-nothing gangs
+                # over a heterogeneous fleet — packed vs first-fit on
+                # perf-weighted utilization, plus the corridor repack
+                # drill.
+                "gang_util_packed": gang["gang_util_packed"],
+                "gang_util_firstfit": gang["gang_util_firstfit"],
+                "gang_seated_packed": gang["gang_seated_packed"],
+                "gang_seated_firstfit": gang["gang_seated_firstfit"],
+                "gang_corridor_nodes": gang["gang_corridor_nodes"],
+                "gang_repack_migrations": gang[
+                    "gang_repack_migrations"
+                ],
             }
         )
     )

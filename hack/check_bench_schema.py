@@ -219,6 +219,18 @@ TYPED_WHEN_PRESENT = {
     "disagg_kv_migration_fallbacks": int,
     "disagg_kv_migrated_pages": int,
     "disagg_migration_p50_ms": (int, float),
+    # Gang scheduling over heterogeneous fleets (ISSUE 19): the
+    # packed-vs-first-fit perf-weighted utilization pair, how many
+    # gangs each strategy fully seated, and the corridor repack
+    # drill's opened-corridor size + migration count. The B100 pass
+    # forward-requires gang_util_packed / gang_util_firstfit /
+    # gang_corridor_nodes / gang_repack_migrations.
+    "gang_util_packed": (int, float),
+    "gang_util_firstfit": (int, float),
+    "gang_seated_packed": int,
+    "gang_seated_firstfit": int,
+    "gang_corridor_nodes": int,
+    "gang_repack_migrations": int,
 }
 
 

@@ -1,0 +1,466 @@
+#!/usr/bin/env python3
+"""Gang-scheduling interleaving fuzzer (ISSUE 19, tentpole).
+
+Each seed is one adversarial history over a seeded heterogeneous fleet
+(:func:`tpu_dra.scheduler.fleet.make_hetero_fleet`): gang and singleton
+claims arrive, claims get deleted (including members of committed
+gangs), nodes vanish under allocated members, and the scheduler
+"process" dies at a randomly chosen ``gang.commit.*`` /
+``gang.teardown.*`` crash point mid-protocol — after which a fresh
+process recovers from the apiserver-durable WAL alone
+(:func:`tpu_dra.scheduler.gang.recover_gangs`), exactly the way a real
+leader failover does.
+
+The scheduler pass here is the synchronous model of
+``SchedulerCore._reconcile_batch``'s gang path (same helpers, same
+order: WAL recovery -> broken-gang teardown -> gangs largest-first via
+``allocate_gang``/``commit_gang`` -> singles via ``allocate_batch``),
+driven without informer threads so a SimulatedCrash lands on the
+calling thread and every interleaving is deterministic for its seed.
+
+Invariants, checked after EVERY step (not just at the end):
+
+- **feasibility oracle** — no device handed to two claims and every
+  (pool, counter-set) within published capacity, validated against the
+  full original fleet catalog (``allocbench.validate_results``, the
+  same oracle the parity suite trusts);
+- **all-or-nothing** — at every observable point outside a crash
+  window, each gang's present members are either all allocated or all
+  pending (a member deletion may shrink the gang; it must never split
+  it);
+- **quiescence** (after each completed pass) — zero
+  ``gang.tpu.google.com/state`` WAL residue; fully-seated gangs have
+  every declared member, all on live pools;
+- **convergence** — the closing pass is a fixed point: replaying it
+  byte-identically changes no claim.
+
+A violation raises :class:`InvariantViolation` with the seed in the
+message, so any failure is a one-command repro:
+``python hack/fuzz_gang.py --seeds 1 --seed0 <seed>``.
+
+The acceptance bar (``main``): >= 200 seeds by default, every
+registered gang crash point fired at least once across the run, gangs
+actually committed / rolled back / torn down (a fuzzer that never
+reaches the dangerous windows proves nothing), zero violations.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tpu_dra.infra.crashpoint import (  # noqa: E402
+    SimulatedCrash,
+    arm,
+    fire_count,
+)
+from tpu_dra.infra.metrics import Metrics  # noqa: E402
+from tpu_dra.k8sclient import (  # noqa: E402
+    DEVICE_CLASSES,
+    RESOURCE_CLAIMS,
+    RESOURCE_SLICES,
+    FakeCluster,
+    ResourceClient,
+)
+from tpu_dra.scheduler.allocator import (  # noqa: E402
+    Allocator,
+    Unschedulable,
+)
+from tpu_dra.scheduler.allocbench import validate_results  # noqa: E402
+from tpu_dra.scheduler.fleet import (  # noqa: E402
+    CLASSES,
+    GENERATIONS,
+    make_claim,
+    make_gang_claims,
+    make_hetero_fleet,
+)
+from tpu_dra.scheduler.gang import (  # noqa: E402
+    GangCommitError,
+    commit_gang,
+    gang_name,
+    gang_size,
+    gang_state,
+    recover_gangs,
+    teardown_gang,
+)
+
+NS = "gangfuzz"
+
+# Every registered gang crash point; main() fails unless each fired at
+# least once across the run (test_crash_matrix pins this tuple against
+# the registry, so a new gang.* point cannot dodge the fuzzer).
+GANG_POINTS: Tuple[str, ...] = (
+    "gang.commit.between_intents",
+    "gang.commit.after_intent_persisted",
+    "gang.commit.between_members",
+    "gang.commit.before_finalize",
+    "gang.teardown.after_intent",
+)
+
+# Arrival op mix: scheduling (clean or crash-armed) roughly as often as
+# arrivals, so most histories reach the commit windows several times.
+OPS: List[Tuple[str, int]] = [
+    ("single", 5),
+    ("gang", 5),
+    ("schedule", 4),
+    ("crash", 4),
+    ("delete", 3),
+    ("node_loss", 1),
+    ("partial_gang", 1),
+]
+
+
+class InvariantViolation(AssertionError):
+    """A gang invariant broke; the message carries the seed."""
+
+
+class GangFuzzer:
+    """One seeded interleaving (see module doc)."""
+
+    def __init__(self, seed: int, steps: int = 14):
+        self.seed = seed
+        self.steps = steps
+        self.rng = random.Random(seed)
+        self.metrics = Metrics()
+        self.cluster = FakeCluster()
+        classes = ResourceClient(self.cluster, DEVICE_CLASSES)
+        for c in CLASSES:
+            classes.create(json.loads(json.dumps(c)))
+        self.nodes = self.rng.randint(6, 12)
+        # 55/45 so small fleets still draw both generations; the full
+        # original fleet stays around as the validation catalog even
+        # after node-loss ops delete live slices.
+        self.fleet = make_hetero_fleet(
+            self.nodes, seed, gen_weights=[("v5e", 55), ("v5p", 45)]
+        )
+        self.slices = ResourceClient(self.cluster, RESOURCE_SLICES)
+        for s in self.fleet:
+            self.slices.create(json.loads(json.dumps(s)))
+        self.claims = ResourceClient(self.cluster, RESOURCE_CLAIMS)
+        self.next_single = 0
+        self.next_gang = 0
+        self.stats: Dict[str, int] = {
+            "steps": 0, "gangs_committed": 0, "gangs_unschedulable": 0,
+            "commit_errors": 0, "singles_allocated": 0,
+            "crashes_fired": 0, "crashes_missed": 0, "teardowns": 0,
+            "recoveries": 0, "deletes": 0, "nodes_lost": 0,
+        }
+
+    # --- ops ---------------------------------------------------------
+
+    def op_single(self) -> None:
+        shape = self.rng.choice(["1x1x1", "2x1x1", "2x2x1"])
+        gen = self.rng.choice([None, None, "v5e", "v5p"])
+        c = make_claim(self.next_single, shape, gen=gen, namespace=NS)
+        self.next_single += 1
+        self.claims.create(c)
+
+    def op_gang(self, short: bool = False) -> None:
+        gen = self.rng.choice(["v5e", "v5p"])
+        # Largest shapes the generation advertises dominate: corridor
+        # pressure is the point. 4x2x1 exists only on v5p.
+        shape = self.rng.choice(
+            [s for s in GENERATIONS[gen]["shapes"] if s != "1x1x1"]
+        )
+        size = self.rng.randint(2, 4)
+        members = make_gang_claims(
+            f"gang-{self.next_gang:03d}", 100_000 + self.next_gang * 100,
+            size, shape, gen=gen, namespace=NS,
+        )
+        self.next_gang += 1
+        if short:
+            # Declared size never arrives: the grouping guard must park
+            # the rump gang as unschedulable forever, allocating none.
+            members = members[: size - 1] or members[:1]
+        for c in members:
+            self.claims.create(c)
+
+    def op_partial_gang(self) -> None:
+        self.op_gang(short=True)
+
+    def op_delete(self) -> None:
+        snapshot = self.claims.list()
+        if not snapshot:
+            return
+        c = self.rng.choice(snapshot)
+        self.claims.delete(c["metadata"]["name"], NS)
+        self.stats["deletes"] += 1
+
+    def op_node_loss(self) -> None:
+        live = self.slices.list()
+        if len(live) <= 3:  # keep a rump fleet so passes stay meaningful
+            return
+        s = self.rng.choice(live)
+        self.slices.delete(s["metadata"]["name"])
+        self.stats["nodes_lost"] += 1
+
+    def op_schedule(self) -> None:
+        self._pass()
+        self._check(quiescent=True)
+
+    def op_crash(self) -> None:
+        point = self.rng.choice(GANG_POINTS)
+        crashed = False
+        with arm(point) as a:
+            try:
+                self._pass()
+            except SimulatedCrash:
+                crashed = True
+        if crashed and not a.fired:
+            raise InvariantViolation(
+                f"seed {self.seed}: crash without {point} firing"
+            )
+        self.stats["crashes_fired" if crashed else "crashes_missed"] += 1
+        # The WAL (if any) survives the death un-aged; the restart path
+        # (core.start's eager recovery + first batch) must converge.
+        self.stats["recoveries"] += recover_gangs(
+            self.claims, identity="fuzz-restart", metrics=self.metrics
+        )
+        self._pass()
+        self._check(quiescent=True)
+
+    # --- the scheduler pass (synchronous _reconcile_batch model) -----
+
+    def _live_pools(self) -> set:
+        return {
+            s["spec"]["pool"]["name"] for s in self.slices.list()
+        }
+
+    def _groups(self, snapshot: List[dict]) -> Dict[str, List[dict]]:
+        groups: Dict[str, List[dict]] = {}
+        for c in snapshot:
+            g = gang_name(c)
+            if g:
+                groups.setdefault(g, []).append(c)
+        return groups
+
+    def _teardown_broken(self, snapshot: List[dict]) -> bool:
+        """The _gang_prepass model: tear down (journaled) every gang
+        with an allocated member that lost a sibling or its node."""
+        live = self._live_pools()
+        mutated = False
+        for g in sorted(self._groups(snapshot)):
+            members = self._groups(snapshot)[g]
+            allocated = [
+                c for c in members
+                if (c.get("status") or {}).get("allocation")
+            ]
+            if not allocated:
+                continue
+            size = gang_size(members[0])
+            broken = (
+                len(allocated) < len(members) or len(members) < size
+            )
+            if not broken:
+                for c in allocated:
+                    res = (c["status"]["allocation"].get("devices")
+                           or {}).get("results", []) or []
+                    if any(r.get("pool") not in live for r in res):
+                        broken = True
+                        break
+            if broken:
+                teardown_gang(
+                    self.claims, members, reason="fuzz prepass",
+                    identity="fuzz", metrics=self.metrics,
+                )
+                self.stats["teardowns"] += 1
+                mutated = True
+        return mutated
+
+    def _pass(self) -> None:
+        snapshot = self.claims.list()
+        if any(gang_state(c) is not None for c in snapshot):
+            self.stats["recoveries"] += recover_gangs(
+                self.claims, identity="fuzz-lazy", metrics=self.metrics
+            )
+            snapshot = self.claims.list()
+        if self._teardown_broken(snapshot):
+            snapshot = self.claims.list()
+        alloc = Allocator(
+            CLASSES, allocated_claims=snapshot,
+            slices=self.slices.list(),
+        )
+        pending = [
+            c for c in snapshot
+            if not (c.get("status") or {}).get("allocation")
+            and gang_state(c) is None
+        ]
+        gangs = self._groups(pending)
+        singles = [c for c in pending if gang_name(c) is None]
+        for g in sorted(gangs, key=lambda k: (-len(gangs[k]), k)):
+            members = sorted(
+                gangs[g], key=lambda c: c["metadata"]["name"]
+            )
+            size = gang_size(members[0])
+            if size <= 0 or len(members) != size:
+                self.stats["gangs_unschedulable"] += 1
+                continue
+            try:
+                results = alloc.allocate_gang(members)
+            except Unschedulable:
+                self.stats["gangs_unschedulable"] += 1
+                continue
+            try:
+                commit_gang(
+                    self.claims, g, members, results,
+                    identity="fuzz", metrics=self.metrics,
+                )
+                self.stats["gangs_committed"] += 1
+            except GangCommitError:
+                for res in results:
+                    alloc._untake_result(res)
+                self.stats["commit_errors"] += 1
+        for c, res in zip(singles, alloc.allocate_batch(singles)):
+            if isinstance(res, Unschedulable):
+                continue
+            cur = self.claims.try_get(c["metadata"]["name"], NS)
+            if cur is None:
+                continue
+            cur.setdefault("status", {})["allocation"] = res.allocation
+            self.claims.update(cur)
+            self.stats["singles_allocated"] += 1
+
+    # --- invariants --------------------------------------------------
+
+    def _check(self, quiescent: bool = False) -> None:
+        snapshot = self.claims.list()
+        results = [
+            (c["metadata"]["name"], c["status"]["allocation"])
+            for c in snapshot
+            if (c.get("status") or {}).get("allocation")
+        ]
+        try:
+            # Against the FULL original fleet: claims stranded on a
+            # lost node still count toward the exclusivity oracle.
+            validate_results(self.fleet, results)
+        except AssertionError as e:
+            raise InvariantViolation(f"seed {self.seed}: {e}") from e
+        for g, members in sorted(self._groups(snapshot).items()):
+            allocated = [
+                c for c in members
+                if (c.get("status") or {}).get("allocation")
+            ]
+            if allocated and len(allocated) != len(members):
+                raise InvariantViolation(
+                    f"seed {self.seed}: gang {g} split — "
+                    f"{len(allocated)}/{len(members)} present members "
+                    f"allocated"
+                )
+            if quiescent and allocated:
+                size = gang_size(members[0])
+                if len(members) != size:
+                    raise InvariantViolation(
+                        f"seed {self.seed}: gang {g} seated with "
+                        f"{len(members)} members, declared {size}"
+                    )
+                live = self._live_pools()
+                for c in allocated:
+                    res = (c["status"]["allocation"].get("devices")
+                           or {}).get("results", []) or []
+                    dead = [r["pool"] for r in res
+                            if r.get("pool") not in live]
+                    if dead:
+                        raise InvariantViolation(
+                            f"seed {self.seed}: gang {g} quiescent on "
+                            f"dead pool(s) {dead}"
+                        )
+        if quiescent:
+            residue = [
+                c["metadata"]["name"] for c in snapshot
+                if gang_state(c) is not None
+            ]
+            if residue:
+                raise InvariantViolation(
+                    f"seed {self.seed}: WAL residue at quiescence on "
+                    f"{residue}"
+                )
+
+    def _snapshot_str(self) -> str:
+        return json.dumps(sorted(
+            (c["metadata"]["name"],
+             json.dumps(c.get("status") or {}, sort_keys=True),
+             json.dumps(c["metadata"].get("annotations") or {},
+                        sort_keys=True))
+            for c in self.claims.list()
+        ))
+
+    # --- driver ------------------------------------------------------
+
+    def run(self) -> Dict[str, int]:
+        ops = [o for o, _ in OPS]
+        weights = [w for _, w in OPS]
+        self._check()
+        for _ in range(self.steps):
+            op = self.rng.choices(ops, weights)[0]
+            getattr(self, f"op_{op}")()
+            self._check()
+            self.stats["steps"] += 1
+        # Closing pass must quiesce AND be a fixed point.
+        self._pass()
+        self._check(quiescent=True)
+        before = self._snapshot_str()
+        self._pass()
+        if self._snapshot_str() != before:
+            raise InvariantViolation(
+                f"seed {self.seed}: closing pass is not idempotent"
+            )
+        self._check(quiescent=True)
+        return self.stats
+
+
+def run_seed(seed: int, steps: int = 14) -> Dict[str, int]:
+    return GangFuzzer(seed, steps=steps).run()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seeds", type=int, default=200,
+                    help="number of seeded interleavings (default 200)")
+    ap.add_argument("--seed0", type=int, default=0,
+                    help="first seed (repro: --seeds 1 --seed0 N)")
+    ap.add_argument("--steps", type=int, default=14,
+                    help="ops per interleaving (default 14)")
+    args = ap.parse_args(argv)
+
+    agg: Dict[str, int] = {}
+    for seed in range(args.seed0, args.seed0 + args.seeds):
+        stats = run_seed(seed, steps=args.steps)
+        for k, v in stats.items():
+            agg[k] = agg.get(k, 0) + v
+    fired = {p: fire_count(p) for p in GANG_POINTS}
+    print(
+        f"fuzz_gang: {args.seeds} interleavings x {args.steps} steps: "
+        f"{agg.get('gangs_committed', 0)} gangs committed, "
+        f"{agg.get('crashes_fired', 0)} crashes, "
+        f"{agg.get('teardowns', 0)} teardowns, "
+        f"{agg.get('recoveries', 0)} recoveries, 0 violations",
+        file=sys.stderr,
+    )
+    print(json.dumps({"seeds": args.seeds, "fired": fired, **agg}))
+    failures = []
+    if args.seeds >= 50:
+        # Coverage bar only when the run is big enough to demand it
+        # (a --seeds 1 repro of a single seed must not fail on it).
+        failures += [
+            f"crash point {p} never fired" for p, n in fired.items()
+            if n == 0
+        ]
+        for key in ("gangs_committed", "crashes_fired", "teardowns",
+                    "recoveries", "gangs_unschedulable"):
+            if not agg.get(key):
+                failures.append(f"no {key} across the whole run")
+    for f in failures:
+        print(f"fuzz_gang: FAIL: {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -116,6 +116,16 @@ REQUIRED_STATIC = (
     "disagg_vs_colocated_ttft",
     "disagg_vs_colocated_itl",
     "disagg_kv_migrations",
+    # Gang scheduling over heterogeneous fleets (ISSUE 19): the
+    # packed-vs-first-fit perf-weighted utilization pair (the
+    # all-or-nothing seating claim) and the corridor repack drill's
+    # opened-corridor size + migration count — dropping any of them
+    # would blind the gang-scheduling regression tripwire before its
+    # first recorded artifact.
+    "gang_util_packed",
+    "gang_util_firstfit",
+    "gang_corridor_nodes",
+    "gang_repack_migrations",
 )
 
 

@@ -184,6 +184,12 @@ CD_POINTS = sorted(p for p in CRASH_POINTS if p.startswith("cdplugin."))
 REPACK_POINTS = sorted(
     p for p in CRASH_POINTS if p.startswith("repack.")
 )
+GANG_COMMIT_POINTS = sorted(
+    p for p in CRASH_POINTS if p.startswith("gang.commit.")
+)
+GANG_TEARDOWN_POINTS = sorted(
+    p for p in CRASH_POINTS if p.startswith("gang.teardown.")
+)
 
 
 def test_matrix_covers_every_registered_point():
@@ -191,7 +197,7 @@ def test_matrix_covers_every_registered_point():
     one scenario below, and the table is big enough to mean something."""
     covered = (
         PREPARE_POINTS + UNPREPARE_POINTS + GC_POINTS + CD_POINTS
-        + REPACK_POINTS
+        + REPACK_POINTS + GANG_COMMIT_POINTS + GANG_TEARDOWN_POINTS
     )
     assert sorted(covered) == sorted(CRASH_POINTS)
     assert len(CRASH_POINTS) >= 12
@@ -815,6 +821,131 @@ def test_repack_lease_loss_plus_crash_still_recovers():
     rp2.recover()
     for _ in range(12):
         rp2.tick()
+    h.assert_invariants()
+
+
+# --- gang two-phase commit rows (ISSUE 19) ----------------------------------
+#
+# One row per gang.commit.* / gang.teardown.* window: kill there, then a
+# fresh "scheduler" recovers from the apiserver WAL alone and the fleet
+# converges — never a partial gang, never a leaked or double-assigned
+# chip. The gang fuzzer (tests/test_gang_fuzz) drives the same windows
+# under randomized interleavings; these rows are the deterministic
+# minimal repros.
+
+
+class _GangHarness:
+    """3 published nodes + a 2-member full-node (2x2x1) gang, pending."""
+
+    def __init__(self):
+        from tpu_dra.scheduler import fleet
+        from tpu_dra.k8sclient import DEVICE_CLASSES, RESOURCE_SLICES
+
+        self.fleet = fleet
+        self.cluster = FakeCluster()
+        for c in fleet.CLASSES:
+            ResourceClient(self.cluster, DEVICE_CLASSES).create(
+                json.loads(json.dumps(c))
+            )
+        self.slices = ResourceClient(self.cluster, RESOURCE_SLICES)
+        for i in range(3):
+            self.slices.create(fleet.make_node_slice(i))
+        self.claims = ResourceClient(self.cluster, RESOURCE_CLAIMS)
+        self.members = [
+            self.claims.create(c) for c in fleet.make_gang_claims(
+                "mg", 0, 2, "2x2x1", namespace="default"
+            )
+        ]
+
+    def refetch(self):
+        return [
+            self.claims.try_get(c["metadata"]["name"], "default")
+            for c in self.members
+        ]
+
+    def solve(self):
+        from tpu_dra.scheduler.allocator import Allocator
+
+        members = self.refetch()
+        alloc = Allocator(
+            self.fleet.CLASSES, allocated_claims=self.claims.list(),
+            slices=self.slices.list(),
+        )
+        return members, alloc.allocate_gang(members)
+
+    def allocated(self):
+        return [
+            c for c in self.refetch()
+            if (c.get("status") or {}).get("allocation")
+        ]
+
+    def assert_invariants(self):
+        from tpu_dra.scheduler.allocbench import validate_results
+        from tpu_dra.scheduler.gang import gang_state
+
+        live = self.claims.list()
+        # WAL fully resolved; all-or-nothing; exclusivity + counter
+        # capacity against the published fleet.
+        for c in live:
+            assert gang_state(c) is None, (
+                f"unresolved gang WAL on {c['metadata']['name']}"
+            )
+        assert len(self.allocated()) in (0, len(self.members))
+        validate_results(self.slices.list(), [
+            (c["metadata"]["name"], c["status"]["allocation"])
+            for c in live
+            if (c.get("status") or {}).get("allocation")
+        ])
+
+
+@pytest.mark.parametrize("point", GANG_COMMIT_POINTS)
+def test_gang_commit_crash_recovers(point):
+    from tpu_dra.scheduler.gang import commit_gang, recover_gangs
+
+    h = _GangHarness()
+    members, results = h.solve()
+    with arm(point) as a:
+        with pytest.raises(SimulatedCrash):
+            commit_gang(h.claims, "mg", members, results,
+                        identity="matrix")
+    assert a.fired, f"{point} never fired during the commit"
+
+    # "Restart": recovery resolves the WAL (back or forward) from the
+    # apiserver alone, then the retry converges to a whole gang.
+    assert recover_gangs(h.claims, identity="matrix-restart") == 1
+    h.assert_invariants()
+    if not h.allocated():  # rolled back: the retry re-seats it
+        members, results = h.solve()
+        commit_gang(h.claims, "mg", members, results, identity="retry")
+    assert len(h.allocated()) == len(h.members)
+    h.assert_invariants()
+    # Idempotent: nothing left for a second recovery pass.
+    assert recover_gangs(h.claims, identity="again") == 0
+
+
+@pytest.mark.parametrize("point", GANG_TEARDOWN_POINTS)
+def test_gang_teardown_crash_recovers(point):
+    from tpu_dra.scheduler.gang import (
+        commit_gang, recover_gangs, teardown_gang,
+    )
+
+    h = _GangHarness()
+    members, results = h.solve()
+    commit_gang(h.claims, "mg", members, results, identity="matrix")
+    with arm(point) as a:
+        with pytest.raises(SimulatedCrash):
+            teardown_gang(h.claims, h.refetch(), reason="node loss",
+                          identity="matrix")
+    assert a.fired, f"{point} never fired during the teardown"
+
+    # Recovery completes the journaled teardown: fully pending, and the
+    # freed chips are immediately reusable (the gang re-seats whole).
+    assert recover_gangs(h.claims, identity="matrix-restart") == 1
+    h.assert_invariants()
+    assert h.allocated() == []
+    members, results = h.solve()
+    commit_gang(h.claims, "mg", members, results, identity="reseat")
+    assert len(h.allocated()) == len(h.members)
     h.assert_invariants()
 
 

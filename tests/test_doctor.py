@@ -1280,3 +1280,120 @@ def test_metrics_probe_colocated_fleet_has_no_disagg_section(tmp_path):
         assert "disagg:" not in render(report)
     finally:
         srv.stop()
+
+
+# --- gang-scheduling checks (ISSUE 19) ---------------------------------------
+
+
+def _gang_metrics(pending=0, wal_oldest=0.0, unschedulable=0,
+                  members=4, frag=0.0, rollbacks=0):
+    from tpu_dra.infra.metrics import Metrics
+
+    metrics = Metrics()
+    metrics.set_gauge("gang_members", members)
+    metrics.set_gauge("scheduler_gang_pending", pending)
+    metrics.set_gauge("scheduler_gang_wal_oldest_seconds", wal_oldest)
+    metrics.set_gauge("scheduler_gang_unschedulable", unschedulable)
+    metrics.set_gauge("scheduler_frag_score", frag)
+    if rollbacks:
+        metrics.inc("gang_partial_rollbacks_total", rollbacks)
+    return metrics
+
+
+def test_metrics_probe_warns_on_stuck_gang_wal(tmp_path):
+    """A gang commit WAL outstanding far past one commit's duration
+    means a scheduler died mid-protocol — WARN with the recovery
+    remediation (members are fenced from prepare until it resolves),
+    plus the gang render line. A fresh WAL (a commit in flight right
+    now) stays quiet."""
+    from tpu_dra.infra.metrics import MetricsServer
+
+    metrics = _gang_metrics(pending=2, wal_oldest=300.0)
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        endpoint = f"127.0.0.1:{srv.port}"
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint],
+        )
+        warns = "\n".join(report["warnings"])
+        assert "gang commit WAL" in warns
+        assert "gang.tpu.google.com/state" in warns
+        assert "mid-protocol" in warns
+        out = render(report)
+        assert "gang: members=4 pending=2 wal_oldest=300s" in out
+        # A WAL inside the commit window is the protocol working.
+        metrics.set_gauge("scheduler_gang_wal_oldest_seconds", 1.5)
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint],
+        )
+        assert report["warnings"] == [], report["warnings"]
+    finally:
+        srv.stop()
+
+
+def test_metrics_probe_warns_on_gang_unschedulable_with_high_frag(
+    tmp_path,
+):
+    """Gangs stuck Unschedulable while the frag score says free
+    capacity is stranded: a corridor-opening repack could seat them —
+    WARN pointing at the repacker's corridor mode. The same stuck
+    gangs over a defragmented fleet stay quiet (capacity is genuinely
+    insufficient; no repack can help)."""
+    from tpu_dra.infra.metrics import MetricsServer
+
+    metrics = _gang_metrics(pending=4, unschedulable=1, frag=0.4)
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        endpoint = f"127.0.0.1:{srv.port}"
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint],
+        )
+        warns = "\n".join(report["warnings"])
+        assert "Unschedulable" in warns
+        assert "corridor mode" in warns
+        assert "unschedulable=1" in render(report)
+        # Defragmented fleet: the frag-driven WARN disarms (the
+        # scheduler's own frag WARN would fire separately if high —
+        # here it is low, so the report is clean).
+        metrics.set_gauge("scheduler_frag_score", 0.0)
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint],
+        )
+        assert report["warnings"] == [], report["warnings"]
+    finally:
+        srv.stop()
+
+
+def test_metrics_probe_gangless_endpoint_has_no_gang_section(tmp_path):
+    """An endpoint exporting no gang series gets no 'gang:' section —
+    the section's absence IS the 'no gangs here' signal."""
+    from tpu_dra.infra.metrics import Metrics, MetricsServer
+
+    metrics = Metrics()
+    metrics.set_gauge("scheduler_frag_score", 0.1)
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        endpoint = f"127.0.0.1:{srv.port}"
+        report = collect(
+            str(tmp_path / "data"), str(tmp_path / "cdi"),
+            str(tmp_path / "mux"), tpulib=lib,
+            metrics_endpoints=[endpoint],
+        )
+        assert "gang" not in report["metrics"][endpoint]
+        assert "gang:" not in render(report)
+    finally:
+        srv.stop()

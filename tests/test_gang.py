@@ -1,0 +1,421 @@
+"""Gang two-phase commit protocol unit tests (ISSUE 19).
+
+The interleaving fuzzer (test_gang_fuzz) and the crash matrix prove
+convergence under randomized and per-window death; this suite pins the
+protocol's *contract* case by case so a regression names the exact rule
+it broke: the commit phase table, rollback-vs-roll-forward recovery,
+journaled teardown, ``allocate_gang``'s exact in-memory rollback, the
+heterogeneous corridor packing order, WAL parsing edge cases, and the
+kubelet plugin's refusal to prepare a claim mid-protocol.
+"""
+
+import json
+
+import pytest
+
+from tpu_dra.infra.crashpoint import SimulatedCrash, arm
+from tpu_dra.infra.metrics import Metrics
+from tpu_dra.k8sclient import (
+    DEVICE_CLASSES,
+    RESOURCE_CLAIMS,
+    RESOURCE_SLICES,
+    FakeCluster,
+    ResourceClient,
+)
+from tpu_dra.scheduler import fleet
+from tpu_dra.scheduler.allocator import Allocator, Unschedulable
+from tpu_dra.scheduler.gang import (
+    GANG_ANNOTATION,
+    PHASE_COMMITTED,
+    PHASE_ROLLING_BACK,
+    GangCommitError,
+    commit_gang,
+    gang_owned,
+    gang_state,
+    recover_gangs,
+    teardown_gang,
+    wal_age,
+    wal_stale,
+)
+
+NS = "default"
+
+
+def make_cluster(nodes=3, gens=None):
+    """classes + ``nodes`` published slices; gens[i] picks each node's
+    generation (default all v5e)."""
+    cluster = FakeCluster()
+    classes = ResourceClient(cluster, DEVICE_CLASSES)
+    for c in fleet.CLASSES:
+        classes.create(json.loads(json.dumps(c)))
+    slices = ResourceClient(cluster, RESOURCE_SLICES)
+    for i in range(nodes):
+        gen = (gens or {}).get(i, "v5e")
+        slices.create(fleet.make_node_slice(i, gen=gen))
+    return cluster
+
+
+def clients(cluster):
+    return (
+        ResourceClient(cluster, RESOURCE_CLAIMS),
+        ResourceClient(cluster, RESOURCE_SLICES),
+    )
+
+
+def make_gang(cluster, size=2, shape="2x2x1", gen=None, name="g0", i0=0):
+    claims, _ = clients(cluster)
+    members = fleet.make_gang_claims(
+        name, i0, size, shape, gen=gen, namespace=NS
+    )
+    return [claims.create(c) for c in members]
+
+
+def snapshot_allocator(cluster):
+    claims, slices = clients(cluster)
+    return Allocator(
+        fleet.CLASSES, allocated_claims=claims.list(),
+        slices=slices.list(),
+    )
+
+
+def allocated_members(cluster, gang="g0"):
+    claims, _ = clients(cluster)
+    return [
+        c for c in claims.list()
+        if (c["metadata"].get("labels") or {}).get(
+            "gang.tpu.google.com/name"
+        ) == gang and (c.get("status") or {}).get("allocation")
+    ]
+
+
+def wal_members(cluster):
+    claims, _ = clients(cluster)
+    return [c for c in claims.list() if gang_state(c) is not None]
+
+
+# --- commit ------------------------------------------------------------------
+
+
+def test_commit_all_members_distinct_pools_no_wal_residue():
+    cluster = make_cluster(nodes=3)
+    claims, _ = clients(cluster)
+    members = make_gang(cluster, size=2)
+    alloc = snapshot_allocator(cluster)
+    results = alloc.allocate_gang(members)
+    metrics = Metrics()
+    stored = commit_gang(
+        claims, "g0", members, results, identity="test", metrics=metrics
+    )
+    assert len(stored) == 2
+    pools = set()
+    for c in stored:
+        res = c["status"]["allocation"]["devices"]["results"]
+        pools.update(r["pool"] for r in res)
+        assert gang_state(c) is None
+    # One full 2x2x1 per member forces one node each: distinct pools.
+    assert len(pools) == 2
+    assert wal_members(cluster) == []
+    assert metrics.get_counter(
+        "gang_allocations_total", labels={"result": "committed"}
+    ) == 1
+    assert metrics.get_counter("gang_partial_rollbacks_total") == 0
+
+
+def test_commit_member_vanishing_midway_rolls_back_and_raises():
+    """A member deleted between solve and commit: commit_gang rolls the
+    already-committed members back on the apiserver before raising —
+    never a partial gang, and the rollback is counted."""
+    cluster = make_cluster(nodes=3)
+    claims, _ = clients(cluster)
+    members = make_gang(cluster, size=2)
+    alloc = snapshot_allocator(cluster)
+    results = alloc.allocate_gang(members)
+    claims.delete(members[1]["metadata"]["name"], NS)
+    metrics = Metrics()
+    with pytest.raises(GangCommitError):
+        commit_gang(
+            claims, "g0", members, results,
+            identity="test", metrics=metrics,
+        )
+    assert allocated_members(cluster) == []
+    assert wal_members(cluster) == []
+    assert metrics.get_counter(
+        "gang_allocations_total", labels={"result": "rolled_back"}
+    ) == 1
+
+
+# --- the crash phase table ---------------------------------------------------
+
+# point -> (allocations expected after recovery, rollback expected):
+# everything before the finalize fence rolls BACK (all-or-nothing
+# forbids keeping the half-committed members); a crash after every
+# member committed rolls FORWARD (the gang is whole — recovery only
+# drops the remaining WAL annotations).
+COMMIT_PHASES = [
+    ("gang.commit.between_intents", 0, True),
+    ("gang.commit.after_intent_persisted", 0, True),
+    ("gang.commit.between_members", 0, True),
+    ("gang.commit.before_finalize", 2, False),
+]
+
+
+@pytest.mark.parametrize("point,allocs_after,rolled_back", COMMIT_PHASES)
+def test_commit_crash_recovery_phase_table(point, allocs_after, rolled_back):
+    cluster = make_cluster(nodes=3)
+    claims, _ = clients(cluster)
+    members = make_gang(cluster, size=2)
+    alloc = snapshot_allocator(cluster)
+    results = alloc.allocate_gang(members)
+    with arm(point) as a:
+        with pytest.raises(SimulatedCrash):
+            commit_gang(claims, "g0", members, results, identity="test")
+    assert a.fired
+    assert wal_members(cluster), "crash left no WAL to recover from"
+
+    metrics = Metrics()
+    assert recover_gangs(claims, identity="restart", metrics=metrics) == 1
+    assert len(allocated_members(cluster)) == allocs_after
+    assert wal_members(cluster) == []
+    expected_rollbacks = 1 if rolled_back and point in (
+        "gang.commit.between_members",
+    ) else 0
+    # partial_rollbacks counts only recoveries that CLEARED an
+    # allocation; intent-only crashes had nothing to clear.
+    assert metrics.get_counter(
+        "gang_partial_rollbacks_total"
+    ) == expected_rollbacks
+
+    # The retry after a rollback converges; after a roll-forward the
+    # gang is already whole and a fresh solve sees no pending members.
+    if rolled_back:
+        alloc2 = snapshot_allocator(cluster)
+        fresh = [claims.try_get(c["metadata"]["name"], NS)
+                 for c in members]
+        results2 = alloc2.allocate_gang(fresh)
+        commit_gang(claims, "g0", fresh, results2, identity="retry")
+    assert len(allocated_members(cluster)) == 2
+    assert wal_members(cluster) == []
+
+
+# --- teardown ----------------------------------------------------------------
+
+
+def test_teardown_clears_all_members_and_is_idempotent():
+    cluster = make_cluster(nodes=3)
+    claims, _ = clients(cluster)
+    members = make_gang(cluster, size=2)
+    results = snapshot_allocator(cluster).allocate_gang(members)
+    commit_gang(claims, "g0", members, results, identity="test")
+    live = [claims.try_get(c["metadata"]["name"], NS) for c in members]
+    assert teardown_gang(
+        claims, live, reason="node loss", identity="test"
+    ) == 2
+    assert allocated_members(cluster) == []
+    assert wal_members(cluster) == []
+    live = [claims.try_get(c["metadata"]["name"], NS) for c in members]
+    assert teardown_gang(
+        claims, live, reason="again", identity="test"
+    ) == 0
+
+
+def test_teardown_crash_after_intent_completes_on_recovery():
+    cluster = make_cluster(nodes=3)
+    claims, _ = clients(cluster)
+    members = make_gang(cluster, size=2)
+    results = snapshot_allocator(cluster).allocate_gang(members)
+    commit_gang(claims, "g0", members, results, identity="test")
+    live = [claims.try_get(c["metadata"]["name"], NS) for c in members]
+    with arm("gang.teardown.after_intent") as a:
+        with pytest.raises(SimulatedCrash):
+            teardown_gang(claims, live, reason="loss", identity="test")
+    assert a.fired
+    # The rolling_back intent is durable; members still hold chips.
+    assert len(wal_members(cluster)) == 2
+    assert recover_gangs(claims, identity="restart") == 1
+    assert allocated_members(cluster) == []
+    assert wal_members(cluster) == []
+
+
+def test_recovery_rolling_back_anywhere_beats_committed_everywhere():
+    """The precedence rule: one surviving rolling_back intent forces
+    teardown even when every member looks committed+allocated — the
+    teardown writer knew something (node loss) the allocations don't
+    show."""
+    cluster = make_cluster(nodes=3)
+    claims, _ = clients(cluster)
+    members = make_gang(cluster, size=2)
+    results = snapshot_allocator(cluster).allocate_gang(members)
+    commit_gang(claims, "g0", members, results, identity="test")
+    keys = [f"{NS}/{c['metadata']['name']}" for c in members]
+    first = claims.try_get(members[0]["metadata"]["name"], NS)
+    first["metadata"].setdefault("annotations", {})[GANG_ANNOTATION] = (
+        json.dumps({
+            "phase": PHASE_ROLLING_BACK, "gang": "g0",
+            "members": keys, "t": 0,
+        })
+    )
+    claims.update(first)
+    second = claims.try_get(members[1]["metadata"]["name"], NS)
+    second["metadata"].setdefault("annotations", {})[GANG_ANNOTATION] = (
+        json.dumps({
+            "phase": PHASE_COMMITTED, "gang": "g0",
+            "members": keys, "t": 0,
+        })
+    )
+    claims.update(second)
+    assert recover_gangs(claims, identity="restart") == 1
+    assert allocated_members(cluster) == []
+    assert wal_members(cluster) == []
+
+
+def test_recovery_resolves_corrupt_wal_as_teardown():
+    """A garbled WAL annotation must read as rolling_back (the
+    conservative outcome) and resolve to a clean teardown — never
+    crash recovery, never read as 'no protocol in flight'."""
+    cluster = make_cluster(nodes=3)
+    claims, _ = clients(cluster)
+    members = make_gang(cluster, size=2)
+    results = snapshot_allocator(cluster).allocate_gang(members)
+    commit_gang(claims, "g0", members, results, identity="test")
+    c = claims.try_get(members[0]["metadata"]["name"], NS)
+    c["metadata"].setdefault("annotations", {})[GANG_ANNOTATION] = (
+        "{not json"
+    )
+    claims.update(c)
+    st = gang_state(claims.try_get(members[0]["metadata"]["name"], NS))
+    assert st["phase"] == PHASE_ROLLING_BACK and st["corrupt"]
+    assert recover_gangs(claims, identity="restart") == 1
+    assert allocated_members(cluster) == []
+    assert wal_members(cluster) == []
+
+
+# --- allocate_gang in-memory exactness ---------------------------------------
+
+
+def test_allocate_gang_rollback_leaves_ledger_exactly_as_found():
+    """An infeasible late member rolls back every prior member's takes:
+    in_use is byte-identical and a full-fleet singleton replay still
+    succeeds (the ledger holds no phantom consumption)."""
+    cluster = make_cluster(nodes=2)
+    members = make_gang(cluster, size=3)  # 3 full nodes wanted, 2 exist
+    alloc = snapshot_allocator(cluster)
+    before = set(alloc.in_use)
+    with pytest.raises(Unschedulable) as ei:
+        alloc.allocate_gang(members)
+    assert "gang member" in str(ei.value)
+    assert set(alloc.in_use) == before
+    # Both full-node placements must still be takeable on the SAME
+    # allocator instance: any leaked counter would fail one of them.
+    singles = [
+        fleet.make_claim(100 + i, "2x2x1", namespace=NS)
+        for i in range(2)
+    ]
+    for s in singles:
+        alloc.allocate(s)
+
+
+def test_gang_counter_exclusivity_within_one_solve():
+    """Two members can never land on overlapping placements: on a
+    one-node fleet a two-member full-node gang must be infeasible (the
+    first member's takes are visible to the second's solve)."""
+    cluster = make_cluster(nodes=1)
+    members = make_gang(cluster, size=2)
+    with pytest.raises(Unschedulable):
+        snapshot_allocator(cluster).allocate_gang(members)
+
+
+# --- heterogeneous corridor order --------------------------------------------
+
+
+def test_singles_spill_to_small_generation_pools_first():
+    """Corridor packing order: an untouched v5p node (the only pool
+    advertising 4x2x1 corridors) is visited AFTER untouched v5e pools,
+    so generation-agnostic singles never splinter it — regardless of
+    catalog (name) order, where node-0 comes first."""
+    cluster = make_cluster(nodes=3, gens={0: "v5p"})
+    claims, _ = clients(cluster)
+    alloc = snapshot_allocator(cluster)
+    for i in range(4):  # 2 v5e nodes hold 4x 2x1x1 exactly
+        res = alloc.allocate(
+            fleet.make_claim(200 + i, "2x1x1", namespace=NS)
+        )
+        pools = {
+            r["pool"] for r in res.allocation["devices"]["results"]
+        }
+        assert pools.issubset(
+            {fleet.node_name(1), fleet.node_name(2)}
+        ), f"single #{i} touched the v5p corridor node: {pools}"
+    # Only once the small pools are exhausted does v5p admit a single.
+    res = alloc.allocate(fleet.make_claim(299, "2x1x1", namespace=NS))
+    assert {
+        r["pool"] for r in res.allocation["devices"]["results"]
+    } == {fleet.node_name(0)}
+
+
+def test_gang_of_corridor_shapes_survives_single_pressure():
+    """End to end: singles arrive first under the packed order, then a
+    2-member 4x2x1 v5p gang still seats — the corridor sort left both
+    v5p nodes whole."""
+    cluster = make_cluster(nodes=4, gens={0: "v5p", 2: "v5p"})
+    claims, _ = clients(cluster)
+    alloc = snapshot_allocator(cluster)
+    for i in range(4):
+        alloc.allocate(fleet.make_claim(300 + i, "2x1x1", namespace=NS))
+    members = make_gang(
+        cluster, size=2, shape="4x2x1", gen="v5p", name="cg", i0=400
+    )
+    results = alloc.allocate_gang(members)
+    pools = set()
+    for res in results:
+        pools.update(
+            r["pool"] for r in res.allocation["devices"]["results"]
+        )
+    assert pools == {fleet.node_name(0), fleet.node_name(2)}
+
+
+# --- WAL parsing edges -------------------------------------------------------
+
+
+def test_wal_age_and_staleness_edges():
+    c = {"metadata": {"name": "x", "namespace": NS, "annotations": {
+        GANG_ANNOTATION: json.dumps({"phase": "committing", "t": 100.0})
+    }}}
+    assert wal_age(c, now=130.0) == 30.0
+    assert wal_stale(c, now=130.0, stale_seconds=30.0)
+    assert not wal_stale(c, now=120.0, stale_seconds=30.0)
+    assert gang_owned(c, now=120.0)
+    assert not gang_owned(c, now=200.0)
+    # A stampless WAL reads as infinitely old: never protocol-owned,
+    # always eligible for recovery.
+    c["metadata"]["annotations"][GANG_ANNOTATION] = json.dumps(
+        {"phase": "committing"}
+    )
+    assert wal_age(c, now=0.0) == float("inf")
+    assert wal_stale(c) and not gang_owned(c)
+    del c["metadata"]["annotations"][GANG_ANNOTATION]
+    assert wal_age(c) is None and not gang_owned(c)
+
+
+# --- kubelet fence -----------------------------------------------------------
+
+
+def test_plugin_refuses_to_prepare_mid_protocol_claim(tmp_path):
+    """The plugin-side fence: a claim still carrying the gang WAL may
+    be rolled back any moment — prepare must refuse (retryably), and
+    succeed once the annotation is gone."""
+    from tests.test_plugin_device_state import make_state
+    from tpu_dra.plugin.device_state import PrepareError
+    from tests.helpers import make_claim as make_plugin_claim
+
+    state, _ = make_state(tmp_path)
+    claim = make_plugin_claim()
+    claim["metadata"]["annotations"] = {
+        GANG_ANNOTATION: json.dumps(
+            {"phase": "committed", "gang": "g0", "t": 0}
+        )
+    }
+    with pytest.raises(PrepareError, match="gang"):
+        state.prepare(claim)
+    del claim["metadata"]["annotations"][GANG_ANNOTATION]
+    devs = state.prepare(claim)
+    assert [d.device_name for d in devs] == ["tpu-0"]

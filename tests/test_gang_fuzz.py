@@ -1,0 +1,83 @@
+"""Gang-scheduling interleaving fuzzer, as a test (ISSUE 19).
+
+``hack/fuzz_gang.py`` is the real artifact (``python hack/fuzz_gang.py``
+runs the 200-seed acceptance bar); this suite pins its contract so a
+refactor cannot quietly hollow it out: a fast batch proves every
+``gang.*`` crash point is reachable and every outcome class occurs,
+determinism makes any violation a one-command repro, and the full
+200-seed run rides the slow lane next to the chaos soak.
+"""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+from tpu_dra.infra import crashpoint
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO / "hack") not in sys.path:
+    sys.path.insert(0, str(REPO / "hack"))
+
+import fuzz_gang  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_crashpoints():
+    crashpoint.reset_for_tests()
+    yield
+    crashpoint.reset_for_tests()
+
+
+def test_gang_points_tuple_matches_registry():
+    """The fuzzer's coverage bar is pinned to the registry: a newly
+    registered gang.* crash point that the fuzzer does not know about
+    fails HERE, not silently in main()'s fired-count check."""
+    registered = sorted(
+        p for p in crashpoint.CRASH_POINTS if p.startswith("gang.")
+    )
+    assert sorted(fuzz_gang.GANG_POINTS) == registered
+
+
+def test_fuzz_batch_covers_every_crash_point_and_outcome():
+    """A 40-seed batch (seconds, not minutes) already reaches every
+    gang crash window and every outcome class, with zero invariant
+    violations — the tier-1 guarantee that the protocol's dangerous
+    interleavings stay covered on every run."""
+    agg = {}
+    for seed in range(40):
+        stats = fuzz_gang.run_seed(seed, steps=14)
+        for k, v in stats.items():
+            agg[k] = agg.get(k, 0) + v
+    for point in fuzz_gang.GANG_POINTS:
+        assert crashpoint.fire_count(point) > 0, (
+            f"{point} never fired across 40 seeds — the fuzzer lost "
+            f"its reach into the commit windows"
+        )
+    for key in ("gangs_committed", "gangs_unschedulable",
+                "crashes_fired", "teardowns", "recoveries",
+                "singles_allocated", "deletes", "nodes_lost"):
+        assert agg.get(key), f"outcome class {key} never occurred"
+
+
+def test_fuzz_seed_is_deterministic():
+    """Same seed, same history, same stats — the property that turns a
+    red run's seed number into a repro command."""
+    a = fuzz_gang.run_seed(7, steps=14)
+    crashpoint.reset_for_tests()
+    b = fuzz_gang.run_seed(7, steps=14)
+    assert a == b
+
+
+def test_fuzz_main_single_seed_repro_mode():
+    """--seeds 1 --seed0 N (the repro invocation printed on failure)
+    runs clean and skips the whole-run coverage bar."""
+    assert fuzz_gang.main(["--seeds", "1", "--seed0", "3"]) == 0
+
+
+@pytest.mark.slow
+def test_fuzz_full_acceptance_bar():
+    """The ISSUE-19 acceptance run: >= 200 seeded interleavings, every
+    gang crash point fired, zero violations (main() exits non-zero on
+    any gap)."""
+    assert fuzz_gang.main(["--seeds", "200"]) == 0

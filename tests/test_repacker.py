@@ -621,3 +621,145 @@ def test_re_release_preserves_original_plan_state():
     assert st["from"] == from_results
     assert st["t"] == 123.5
     assert st["phase"] == PHASE_RELEASED
+
+
+# --- gang coexistence (ISSUE 19) ---------------------------------------------
+
+
+def place_gang_member(cluster, gang, i, node_idx, size=2):
+    """One COMMITTED gang member: labeled, allocated to a named 1x1
+    sub-slice — the placement the repacker must treat as untouchable."""
+    from tpu_dra.k8sclient import RESOURCE_CLAIMS, ResourceClient
+
+    claims = ResourceClient(cluster, RESOURCE_CLAIMS)
+    c = fleet.make_gang_claims(gang, i, size, "1x1x1", namespace=NS)[0]
+    c["metadata"]["name"] = f"claim-{i:05d}"
+    c["status"] = {"allocation": {"devices": {"results": [{
+        "request": "tpu", "driver": fleet.DRIVER,
+        "pool": fleet.node_name(node_idx), "device": "ss-1x1x1-0-0-0",
+    }]}}}
+    claims.create(c)
+    claims.update_status(c)
+    return c["metadata"]["name"]
+
+
+def create_pending_gang(cluster, gang="wg", size=2, shape="2x2x1", i0=600):
+    from tpu_dra.k8sclient import RESOURCE_CLAIMS, ResourceClient
+
+    claims = ResourceClient(cluster, RESOURCE_CLAIMS)
+    return [
+        claims.create(c)["metadata"]["name"]
+        for c in fleet.make_gang_claims(
+            gang, i0, size, shape, namespace=NS
+        )
+    ]
+
+
+def test_gang_members_are_never_victims():
+    """The canonical improvable spread (one 1x1 per node, 2x2
+    stranded) — but both residents are committed gang members: the
+    repacker must refuse to plan ANY migration, because moving one
+    member tears the whole gang down through the scheduler's
+    broken-gang pre-pass (exactly the disruption it exists to avoid)."""
+    cluster = make_cluster()
+    names = [
+        place_gang_member(cluster, "pg", 500, 0),
+        place_gang_member(cluster, "pg", 501, 1),
+    ]
+    adapter = RecordingAdapter()
+    rp = mk_repacker(cluster, adapter)
+    before = {n: devices_of(claim_of(cluster, n)) for n in names}
+    for _ in range(6):
+        rp.tick()
+    assert adapter.calls == [], "a gang member was selected as victim"
+    for n in names:
+        assert devices_of(claim_of(cluster, n)) == before[n]
+        assert repack_state(claim_of(cluster, n)) is None
+    assert_placements_valid(cluster)
+
+
+def test_corridor_storm_opens_nodes_without_touching_the_gang():
+    """The repack storm drill: corridor mode engages on a pending gang
+    even with the frag threshold unreachable, consolidates the movable
+    singletons until three whole pools are free, and never selects a
+    committed gang member — at the end the pending gang actually
+    seats, and every placement (incl. both pinned members) is intact."""
+    cluster = make_cluster(nodes=6)
+    singles = [
+        place(cluster, 0, 0, "ss-1x1x1-0-0-0"),
+        place(cluster, 1, 1, "ss-1x1x1-0-0-0"),
+        place(cluster, 2, 2, "ss-1x1x1-0-0-0"),
+    ]
+    pinned = [
+        place_gang_member(cluster, "pg", 500, 4),
+        place_gang_member(cluster, "pg", 501, 5),
+    ]
+    pending = create_pending_gang(cluster, size=3, shape="2x2x1")
+    metrics = Metrics()
+    adapter = RecordingAdapter()
+    # frag_threshold=10: stranding-driven planning can never trigger —
+    # every migration below is corridor mode's doing.
+    rp = mk_repacker(cluster, adapter, metrics=metrics,
+                     frag_threshold=10.0)
+    before = {n: devices_of(claim_of(cluster, n)) for n in pinned}
+
+    def free_pools():
+        alloc = Allocator(
+            ResourceClient(cluster, DEVICE_CLASSES).list(),
+            allocated_claims=ResourceClient(
+                cluster, RESOURCE_CLAIMS
+            ).list(),
+            slices=ResourceClient(cluster, RESOURCE_SLICES).list(),
+        )
+        return sum(
+            1 for pk in alloc.catalog.peers_by_pool
+            if alloc.ledger.pool_used(pk) == 0
+        )
+
+    for _ in range(60):
+        rp.tick()
+        if free_pools() >= 3 and not rp._active:
+            break
+    assert metrics.get_gauge("repacker_corridor_mode") == 1
+    assert free_pools() >= 3, "corridor never opened"
+    assert any(op == "rebind" for op, _k in adapter.calls), (
+        "no migration ever completed"
+    )
+    moved = {k for op, k in adapter.calls if op == "begin_drain"}
+    assert moved.issubset({f"{NS}/{n}" for n in singles}), (
+        f"storm drained a gang member: {moved}"
+    )
+    for n in pinned:
+        assert devices_of(claim_of(cluster, n)) == before[n]
+    assert_placements_valid(cluster)
+    # The opened corridor is real: the pending gang seats whole.
+    claims = ResourceClient(cluster, RESOURCE_CLAIMS)
+    members = [claims.try_get(n, NS) for n in pending]
+    alloc = Allocator(
+        ResourceClient(cluster, DEVICE_CLASSES).list(),
+        allocated_claims=claims.list(),
+        slices=ResourceClient(cluster, RESOURCE_SLICES).list(),
+    )
+    results = alloc.allocate_gang(members)
+    pools = set()
+    for res in results:
+        pools.update(
+            r["pool"] for r in res.allocation["devices"]["results"]
+        )
+    assert len(pools) == 3
+
+
+def test_no_corridor_mode_without_pending_gang_members():
+    """Same unreachable threshold, no pending gang: the repacker stays
+    idle and the corridor gauge reads 0 — corridor mode is strictly
+    gang-demand-driven, never a general planning override."""
+    cluster = make_cluster()
+    spread_two(cluster)
+    metrics = Metrics()
+    adapter = RecordingAdapter()
+    rp = mk_repacker(cluster, adapter, metrics=metrics,
+                     frag_threshold=10.0)
+    for _ in range(4):
+        rp.tick()
+    assert adapter.calls == []
+    assert metrics.get_gauge("repacker_corridor_mode") == 0

@@ -116,6 +116,27 @@ CRASH_POINTS: Dict[str, str] = {
         "the new placement is computed and prepared but the claim's "
         "allocation was never committed; recovery re-allocates "
         "idempotently and commits",
+    # -- gang two-phase commit (scheduler/gang.py, ISSUE 19) --
+    "gang.commit.between_intents":
+        "the first member's committing-phase WAL annotation is durable, "
+        "the rest were never written; no allocation exists — recovery "
+        "rolls the partial intent back (drops the annotations)",
+    "gang.commit.after_intent_persisted":
+        "every member carries a committing-phase WAL annotation; no "
+        "allocation was written — recovery rolls back to pending",
+    "gang.commit.between_members":
+        "some members hold their allocation (WAL phase committed), the "
+        "rest still say committing with no allocation — the half-placed-"
+        "gang window; recovery clears the committed members' allocations "
+        "and rolls the whole gang back to pending",
+    "gang.commit.before_finalize":
+        "every member is allocated with WAL phase committed but no "
+        "annotation was dropped yet — recovery rolls FORWARD (drops the "
+        "annotations; the gang is complete)",
+    "gang.teardown.after_intent":
+        "every member's WAL says rolling_back but allocations were not "
+        "cleared yet (node loss / member delete mid-teardown) — recovery "
+        "completes the teardown and requeues the gang",
 }
 
 

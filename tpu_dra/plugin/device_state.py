@@ -248,6 +248,21 @@ class DeviceState:
 
     def _prepare_locked(self, claim: dict, t0: float) -> List[KubeletDevice]:
         claim_uid = claim["metadata"]["uid"]
+        # Gang two-phase commit guard (ISSUE 19): a claim still carrying
+        # a gang.tpu.google.com/state WAL annotation is mid-protocol —
+        # its allocation may be ROLLED BACK by gang recovery, and
+        # materializing sub-slices for an allocation that is about to
+        # vanish would orphan silicon. Retryable: the scheduler drops
+        # the annotation within one commit round trip (finalize) or
+        # clears the allocation (rollback), and the kubelet retries.
+        if (claim.get("metadata", {}).get("annotations") or {}).get(
+            "gang.tpu.google.com/state"
+        ):
+            raise PrepareError(
+                "claim is mid gang commit (gang.tpu.google.com/state "
+                "present): refusing to prepare until the gang protocol "
+                "resolves"
+            )
         cp = self.checkpoints.get()
         log.debug("t_prep_get_checkpoint %.3f s", time.monotonic() - t0)
 

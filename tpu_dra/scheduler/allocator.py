@@ -188,6 +188,27 @@ def parse_slice_counters(
     return out
 
 
+def hetero_generations(devices) -> bool:
+    """True when the counter-consuming devices span more than one TPU
+    generation — the gate for the corridor packing order (ISSUE 19).
+    Keyed on the ``generation`` device attribute, NOT on pool-size
+    variance: a fleet whose slices merely advertise different chip
+    counts (partial publishes, network-attached pools, hand-built
+    fixtures) is not heterogeneous, and reordering it would change
+    long-standing single-generation packing behavior. Devices without
+    the attribute are ignored (pre-ISSUE-19 fixtures carry none)."""
+    gens: set = set()
+    for d in devices:
+        if not d.consumes_counters:
+            continue
+        g = (d.attributes.get("generation") or {}).get("string")
+        if g:
+            gens.add(g)
+            if len(gens) > 1:
+                return True
+    return False
+
+
 class CandidateList(list):
     """Candidates in (pool, name) order plus the derived structure the
     packing order consumes: per-pool buckets, collected selector-error
@@ -195,7 +216,9 @@ class CandidateList(list):
     slice index (then shared read-only across claims) or per claim by
     the legacy full-scan path."""
 
-    __slots__ = ("buckets", "reasons", "has_counters", "max_weight")
+    __slots__ = (
+        "buckets", "reasons", "has_counters", "max_weight", "_corridor",
+    )
 
     @classmethod
     def build(
@@ -243,6 +266,12 @@ class DeviceCatalog:
             if c.consumes_counters:
                 peers.setdefault((c.driver, c.pool), []).append(c)
         self.peers_by_pool = {k: tuple(v) for k, v in peers.items()}
+        # Heterogeneous-generation fleet (ISSUE 19): the packed order
+        # visits untouched SMALL pools before large ones so
+        # big-corridor pools stay whole for multi-chip shapes.
+        # Computed once per catalog; homogeneous fleets skip the
+        # corridor sort entirely (zero overhead on the standard bench).
+        self.hetero_totals = hetero_generations(self.devices)
 
 
 @dataclass
@@ -322,6 +351,29 @@ class _CounterLedger:
         return list(self._partial)
 
 
+def _corridor_buckets(catalog, cl: CandidateList):
+    """Untouched-pool visit order for ``_PackedOrder``: catalog order
+    on a homogeneous fleet (the historical behavior, byte-for-byte),
+    ascending pool capacity on a heterogeneous one — spill singles and
+    small shapes onto the small-generation pools first so the large
+    pools (the only ones advertising multi-chip ICI corridors) stay
+    whole for gangs. The sorted view is cached on the CandidateList
+    (shared across claims by the index) keyed by the catalog's
+    pool-totals identity, so the sort runs once per fingerprint per
+    fleet generation."""
+    if not getattr(catalog, "hetero_totals", False):
+        return cl.buckets
+    totals = catalog.pool_totals
+    cached = getattr(cl, "_corridor", None)
+    if cached is not None and cached[0] is totals:
+        return cached[1]
+    buckets = tuple(sorted(
+        cl.buckets, key=lambda b: totals.get(b[0], 0)
+    ))  # stable: equal-size pools keep (pool, name) catalog order
+    cl._corridor = (totals, buckets)
+    return buckets
+
+
 class _PackedOrder:
     """Lazily-materialized candidate order for one ``_pick``.
 
@@ -360,7 +412,7 @@ class _PackedOrder:
         self._active = [pk for _, pk in active]
         self._active_set = frozenset(self._active)
         self._ai = 0
-        self._static = iter(cl.buckets)
+        self._static = iter(_corridor_buckets(alloc.catalog, cl))
         self._static_done = False
         self._tail: List[Tuple[Tuple[str, str], tuple]] = []
         self._ti = 0
@@ -692,6 +744,52 @@ class Allocator:
             except Unschedulable as e:
                 results[i] = e
         return results
+
+    def allocate_gang(self, claims: List[dict]) -> List[AllocationResult]:
+        """All-or-nothing solve of a gang's members against this one
+        snapshot (ISSUE 19): members are solved in :meth:`batch_order`
+        with their takes accumulating (gang-wide counter exclusivity —
+        two members can never land on overlapping placements), and the
+        first infeasible member rolls every prior member's takes back
+        before raising, leaving the ledger and ``in_use`` exactly as
+        found. Returns results aligned with the input order. The packed
+        order's corridor sort (see ``_corridor_buckets``) is what keeps
+        multi-node large-shape gangs feasible late in a mixed fleet."""
+        order = self.batch_order(claims)
+        results: List[Optional[AllocationResult]] = [None] * len(claims)
+        done: List[AllocationResult] = []
+        for i in order:
+            try:
+                res = self.allocate(claims[i])
+            except Unschedulable as e:
+                for prior in done:
+                    self._untake_result(prior)
+                name = claims[i].get("metadata", {}).get("name", "?")
+                raise Unschedulable(
+                    f"gang member {name!r} (member "
+                    f"{len(done) + 1}/{len(claims)}): {e}"
+                ) from e
+            results[i] = res
+            done.append(res)
+        return results  # type: ignore[return-value]
+
+    def _untake_result(self, res: AllocationResult) -> None:
+        """Release one solved member's devices (gang rollback): cheaper
+        than snapshotting the fleet-sized ``in_use`` set up front, and
+        exact — the result's device keys are precisely what its solve
+        took (adminAccess entries took nothing)."""
+        devs = (res.allocation.get("devices") or {}).get("results", [])
+        for entry in devs or []:
+            if entry.get("adminAccess"):
+                continue
+            key = (
+                entry.get("driver", ""), entry.get("pool", ""),
+                entry.get("device", ""),
+            )
+            self.in_use.discard(key)
+            dev = self.catalog.by_key.get(key)
+            if dev is not None:
+                self.ledger.consume(dev, sign=-1)
 
     def fragmentation(self) -> dict:
         """Fleet fragmentation of the chip grid under the current

@@ -71,14 +71,20 @@ from tpu_dra.scheduler.allocator import Allocator, Unschedulable
 from tpu_dra.scheduler.fleet import (  # noqa: F401 — re-exported API
     CLASSES,
     DRIVER,
+    GEN_PERF,
+    GENERATIONS,
     MESH_COORDS,
     SHAPE_WEIGHTS,
     SHAPES,
     SUBSLICE_CLASS,
     TPU_CLASS,
+    fleet_perf_capacity,
     make_claim,
     make_fleet,
+    make_gang_claims,
+    make_hetero_fleet,
     make_trace,
+    slice_generation,
 )
 from tpu_dra.scheduler.index import SliceIndex
 

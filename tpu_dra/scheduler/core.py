@@ -50,6 +50,18 @@ from tpu_dra.k8sclient import (
     ResourceClient,
 )
 from tpu_dra.scheduler.allocator import Allocator, Unschedulable
+from tpu_dra.scheduler.gang import (
+    GangCommitError,
+    commit_gang,
+    gang_name,
+    gang_owned,
+    gang_size,
+    gang_state,
+    recover_gangs,
+    teardown_gang,
+    wal_age,
+    wal_stale,
+)
 from tpu_dra.scheduler.index import SliceIndex
 from tpu_dra.scheduler.repacker import repack_owned
 
@@ -111,6 +123,22 @@ class SchedulerCore:
     # --- lifecycle ---
 
     def start(self) -> None:
+        # Eager gang-WAL recovery BEFORE any allocation can run: a
+        # crash mid gang commit/teardown left member claims journaled
+        # in gang.tpu.google.com/state, and the batch path skips
+        # WAL-owned claims — resolving them first means the very first
+        # batch solve sees a converged fleet (the lazy stale-WAL path
+        # in _gang_prepass remains as the backstop for WALs written by
+        # OTHER schedulers that die later).
+        try:
+            n = recover_gangs(
+                self.claims, identity="scheduler-start",
+                metrics=self.metrics,
+            )
+            if n:
+                log.warning("startup gang recovery resolved %d gang(s)", n)
+        except Exception:
+            log.exception("startup gang recovery failed")
         self.claim_informer.add_handler(self._on_claim_event)
         # New capacity or classes can unblock Unschedulable claims — the
         # DynamicResources plugin re-queues pods on these events too.
@@ -233,6 +261,7 @@ class SchedulerCore:
                         None, self._reconcile_batch, key=BATCH_KEY
                     )
                 self.metrics.set_gauge("scheduler_pending_claims", pending)
+                self._set_gang_gauges(snapshot)
                 # The frag gauge is O(fleet) pure Python (every pool's
                 # feasibility probe): refreshing it EVERY sweep pegged
                 # the GIL at 5k nodes and starved the allocation thread
@@ -301,6 +330,14 @@ class SchedulerCore:
         _snapshot_allocator)."""
         t_list = time.monotonic()
         snapshot = self.claims.list()
+        # Gang lifecycle pre-pass (stale-WAL recovery, broken-gang
+        # teardown — member deleted or node lost under an allocated
+        # member). Runs on this workqueue thread, the single-writer
+        # path. A teardown frees capacity and requeues the members, and
+        # this very solve must see both — the ISSUE-19 "gang delete
+        # funnels into the __batch__ solve" rule.
+        if self._gang_prepass(snapshot):
+            snapshot = self.claims.list()
         pending = [
             c for c in snapshot
             if not (c.get("status") or {}).get("allocation")
@@ -312,6 +349,10 @@ class SchedulerCore:
             # claim is taken back so its tenant is never wedged; the
             # repacker's recovery sees the allocation and stands down.
             and not repack_owned(c)
+            # Same ownership rule for a FRESH gang WAL: the two-phase
+            # gang protocol (possibly another scheduler's) owns the
+            # claim until it commits, finalizes, or goes stale.
+            and not gang_owned(c)
         ]
         # Prune claim spans whose claim is no longer pending in this
         # snapshot (deleted mid-solve after the DELETE handler ran, or
@@ -333,6 +374,7 @@ class SchedulerCore:
             # fires this constantly, and recording empty batches would
             # churn the claim spans out of the flight-recorder ring
             # (the slicepub committed-passes-only rationale).
+            self._set_gang_gauges(snapshot)
             return
         with trace.span("scheduler.solve.batch", root=True) as solve:
             with trace.span("scheduler.solve.snapshot") as snap:
@@ -341,14 +383,41 @@ class SchedulerCore:
                 )
                 t0 = time.monotonic()
                 alloc = self._snapshot_allocator(snapshot)
+            # Gang members solve together (all-or-nothing), singles
+            # through the existing batch path against the SAME shared
+            # snapshot/ledger.
+            gangs: dict = {}
+            singles: List[dict] = []
+            for c in pending:
+                g = gang_name(c)
+                if g:
+                    gangs.setdefault(g, []).append(c)
+                else:
+                    singles.append(c)
             solve.set_attr("pending", len(pending))
+            if gangs:
+                solve.set_attr("gangs", len(gangs))
             for claim in pending:
                 self._ensure_claim_span(claim)
-            with trace.span("scheduler.solve.pack"):
-                results = alloc.allocate_batch(pending)
             allocated = 0
             unschedulable = 0
-            for claim, res in zip(pending, results):
+            gang_committed_members = 0
+            gangs_unschedulable = 0
+            with trace.span("scheduler.solve.pack"):
+                # Gangs FIRST, largest member count first: multi-node
+                # corridors are the scarcest structure in the snapshot,
+                # and singles landing before the gang would splinter
+                # exactly the pools the corridor order protects.
+                for g in sorted(gangs, key=lambda k: (-len(gangs[k]), k)):
+                    members = sorted(gangs[g], key=self._key)
+                    a, u = self._solve_gang(alloc, g, members)
+                    allocated += a
+                    gang_committed_members += a
+                    unschedulable += u
+                    if u:
+                        gangs_unschedulable += 1
+                results = alloc.allocate_batch(singles)
+            for claim, res in zip(singles, results):
                 if isinstance(res, Unschedulable):
                     unschedulable += 1
                     self._note_unschedulable(claim, res)
@@ -356,6 +425,12 @@ class SchedulerCore:
                     allocated += 1
             solve.set_attr("allocated", allocated)
             solve.set_attr("unschedulable", unschedulable)
+        self.metrics.set_gauge(
+            "scheduler_gang_unschedulable", gangs_unschedulable
+        )
+        self._set_gang_gauges(
+            snapshot, committed_members=gang_committed_members
+        )
         self.metrics.inc("scheduler_batch_total")
         self.metrics.observe(
             "scheduler_allocate_batch_seconds", time.monotonic() - t0
@@ -372,6 +447,182 @@ class SchedulerCore:
         # retried by the sweep and by capacity events (each enqueues
         # this batch item again) — per-claim backoff would serialize
         # the whole batch behind the stuck stragglers.
+
+    # --- gang scheduling (ISSUE 19) ---
+
+    def _gang_prepass(self, snapshot: List[dict]) -> bool:
+        """Gang lifecycle pre-pass on the single-writer workqueue
+        path: finish any STALE WAL a dead scheduler left (start()
+        already ran the eager recovery; this is the live backstop),
+        then tear down gangs broken by member deletion or node loss —
+        through the journaled path, so a crash mid-teardown still
+        converges. Returns True when claims were mutated (the caller
+        re-lists so freed capacity funnels into this same solve)."""
+        mutated = False
+        if any(
+            wal_stale(c) for c in snapshot if gang_state(c) is not None
+        ):
+            try:
+                mutated = bool(recover_gangs(
+                    self.claims, identity="scheduler-lazy",
+                    metrics=self.metrics,
+                )) or mutated
+            except Exception:
+                log.exception("lazy gang recovery failed")
+        groups: dict = {}
+        for c in snapshot:
+            g = gang_name(c)
+            if g:
+                groups.setdefault(g, []).append(c)
+        if not groups:
+            return mutated
+        # Node-loss probes only once the index has seen the fleet: a
+        # unit setup driving _reconcile_batch before any slice event
+        # must not read an empty index as 'every node died'.
+        probe_pools = self.index.staleness()[1] > 0
+        pool_ok: dict = {}
+        for g in sorted(groups):
+            members = groups[g]
+            if any(gang_owned(c) for c in members):
+                continue  # a live protocol writer owns these
+            allocated = [
+                c for c in members
+                if (c.get("status") or {}).get("allocation")
+            ]
+            if not allocated:
+                continue  # fully pending: nothing to tear down
+            size = gang_size(members[0])
+            broken = None
+            if len(allocated) < len(members) or len(members) < size:
+                broken = (
+                    f"gang {g}: only {len(allocated)} of "
+                    f"{size or '?'} members hold an allocation — "
+                    f"all-or-nothing teardown"
+                )
+            elif probe_pools:
+                for c in allocated:
+                    res = (c.get("status") or {}).get("allocation") or {}
+                    for r in (res.get("devices") or {}).get(
+                        "results", []
+                    ) or []:
+                        pool = r.get("pool", "")
+                        ok = pool_ok.get(pool)
+                        if ok is None:
+                            ok = pool_ok[pool] = self.index.has_pool(pool)
+                        if not ok:
+                            broken = (
+                                f"gang {g}: node {pool} lost under "
+                                f"member {self._key(c)}"
+                            )
+                            break
+                    if broken:
+                        break
+            if broken:
+                log.warning("tearing down %s", broken)
+                try:
+                    teardown_gang(
+                        self.claims, members, reason=broken,
+                        identity="scheduler", metrics=self.metrics,
+                    )
+                    mutated = True
+                    self._emit_event(members[0], "GangTornDown", broken)
+                except Exception:
+                    log.exception("gang teardown failed for %s", g)
+        return mutated
+
+    def _solve_gang(
+        self, alloc: Allocator, g: str, members: List[dict]
+    ) -> "tuple[int, int]":
+        """Solve + atomically commit one gang against the shared batch
+        snapshot. Returns (members allocated, members unschedulable) —
+        one of the two is always zero (all-or-nothing)."""
+        size = gang_size(members[0])
+        if size <= 0 or len(members) != size:
+            e = Unschedulable(
+                f"gang {g!r}: {len(members)} member claim(s) present, "
+                f"declared size "
+                f"{size if size > 0 else 'missing/invalid'}"
+            )
+            for c in members:
+                self._note_unschedulable(c, e)
+            return 0, len(members)
+        try:
+            results = alloc.allocate_gang(members)
+        except Unschedulable as e:
+            for c in members:
+                self._note_unschedulable(c, e)
+            return 0, len(members)
+        try:
+            commit_gang(
+                self.claims, g, members, results,
+                identity="scheduler", metrics=self.metrics,
+            )
+        except GangCommitError as e:
+            # The apiserver side already rolled back; release the
+            # in-memory takes too so later claims in THIS pass can
+            # still use the chips.
+            for res in results:
+                alloc._untake_result(res)
+            err = Unschedulable(str(e))
+            for c in members:
+                self._note_unschedulable(c, err)
+            return 0, len(members)
+        for c, res in zip(members, results):
+            self._finish_gang_member(c, g, res)
+        log.info(
+            "gang %s committed: %d members allocated", g, len(members)
+        )
+        return len(members), 0
+
+    def _finish_gang_member(self, claim: dict, g: str, result) -> None:
+        """Post-commit bookkeeping for one gang member (the gang path's
+        analog of _commit's tail: commit_gang already persisted the
+        allocation atomically)."""
+        key = self._key(claim)
+        with self._claim_spans_lock:
+            popped = self._claim_spans.pop(key, None)
+        if popped is not None:
+            popped.end()
+        with self._unsched_lock:
+            self._last_unsched.pop(key, None)
+        self.metrics.inc("scheduler_allocations_total")
+        devices = [
+            r["device"] for r in result.allocation["devices"]["results"]
+        ]
+        self._emit_event(
+            claim, "Allocated",
+            f"gang {g}: allocated devices: {', '.join(devices)}",
+        )
+
+    def _set_gang_gauges(
+        self, snapshot: List[dict], committed_members: int = 0
+    ) -> None:
+        """Gang observability gauges from one claims listing (the
+        doctor's _check_gang reads these): allocated gang members,
+        pending gang members, and the oldest in-flight gang WAL age —
+        a WAL that keeps aging here belongs to a dead writer."""
+        members_alloc = 0
+        members_pending = 0
+        oldest = 0.0
+        for c in snapshot:
+            if gang_name(c):
+                if (c.get("status") or {}).get("allocation"):
+                    members_alloc += 1
+                else:
+                    members_pending += 1
+            age = wal_age(c)
+            if age is not None:
+                oldest = max(oldest, min(age, 1e6))
+        self.metrics.set_gauge(
+            "gang_members", members_alloc + committed_members
+        )
+        self.metrics.set_gauge(
+            "scheduler_gang_pending",
+            max(0, members_pending - committed_members),
+        )
+        self.metrics.set_gauge(
+            "scheduler_gang_wal_oldest_seconds", round(oldest, 3)
+        )
 
     def _note_unschedulable(self, claim: dict, e: Unschedulable) -> None:
         md = claim["metadata"]

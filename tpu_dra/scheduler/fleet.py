@@ -14,14 +14,27 @@ Fleet shape: one ResourceSlice per node — 4 chips on a 2x2x1 mesh,
 every SHAPES placement advertised as a sub-slice device, one shared
 counter set making overlapping placements mutually exclusive (the
 KEP-4815 partitionable model the plugin publishes for real nodes).
+
+ISSUE 19 adds **heterogeneous generations**: a node is stamped with a
+TPU generation (``v5e`` — the original 2x2x1 grid — or ``v5p``, a
+4x2x1 grid with 8 chips and a higher per-chip perf weight), the
+generation rides every device as an attribute (CEL-selectable) and the
+slice as a label, and :func:`make_hetero_fleet` mixes generations with
+a seeded rng. The default ``make_fleet``/``make_node_slice`` output
+keeps the homogeneous v5e fleet every pre-existing bench and test was
+built on: same devices, names, shapes, and counters (plus the new
+generation attribute, which no existing selector reads). :func:`make_gang_claims` mints an all-or-nothing
+gang (N claims sharing ``gang.tpu.google.com/name``/``size`` labels)
+for the gang scheduler (:mod:`tpu_dra.scheduler.gang`).
 """
 
 from __future__ import annotations
 
 import random
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 DRIVER = "tpu.google.com"
+GEN_LABEL = "tpu.google.com/gen"
 
 # Shape -> (origin, chip coordinates covered) on the per-node 2x2x1
 # mesh. Row shapes (2x1x1) are deliberately the only advertised pair:
@@ -39,6 +52,39 @@ SHAPES: Dict[str, List[Tuple[str, List[str]]]] = {
     ],
     "2x2x1": [("0,0,0", list(MESH_COORDS))],
 }
+# The v5p analog: 8 chips on a 4x2x1 grid. Placements tile the grid
+# the same way the v5e table does — pairs vary x, quads cover 2x2
+# blocks at even x origins, plus the full-node 4x2x1 corridor shape
+# only this generation advertises (a multi-node gang of these is the
+# ICI pod-slice the corridor scoring protects).
+V5P_MESH_COORDS = [f"{x},{y},0" for x in range(4) for y in range(2)]
+V5P_SHAPES: Dict[str, List[Tuple[str, List[str]]]] = {
+    "1x1x1": [(c, [c]) for c in V5P_MESH_COORDS],
+    "2x1x1": [
+        (f"{x},{y},0", [f"{x},{y},0", f"{x + 1},{y},0"])
+        for x in (0, 2) for y in (0, 1)
+    ],
+    "2x2x1": [
+        (f"{x},0,0",
+         [f"{x},0,0", f"{x},1,0", f"{x + 1},0,0", f"{x + 1},1,0"])
+        for x in (0, 2)
+    ],
+    "4x2x1": [("0,0,0", list(V5P_MESH_COORDS))],
+}
+
+# Generation table: chip grid + advertised placements + relative
+# per-chip perf weight (the MISO-style utilization currency — a v5p
+# chip does ~2.3x the work of a v5e chip, so "achievable utilization"
+# over a mixed fleet is perf-weighted, not chip-counted).
+GENERATIONS: Dict[str, dict] = {
+    "v5e": {"mesh": MESH_COORDS, "shapes": SHAPES, "perf": 1.0},
+    "v5p": {"mesh": V5P_MESH_COORDS, "shapes": V5P_SHAPES, "perf": 2.3},
+}
+GEN_DEFAULT = "v5e"
+GEN_PERF: Dict[str, float] = {
+    g: spec["perf"] for g, spec in GENERATIONS.items()
+}
+
 # Arrival mix: mean footprint ~2.35 chips, tuned so the standard
 # traces (10k claims over the 5k-node/20k-chip fleet, 30% churn
 # between waves) land the grid at ~94% — the regime where the fate of
@@ -76,14 +122,16 @@ def node_name(i: int) -> str:
     return f"node-{i:05d}"
 
 
-def make_node_devices(i: int) -> List[dict]:
+def make_node_devices(i: int, gen: str = GEN_DEFAULT) -> List[dict]:
     """The device list one node's ResourceSlice advertises."""
+    spec = GENERATIONS[gen]
     devices = [
         {
             "name": f"chip-{c.replace(',', '-')}",
             "basic": {
                 "attributes": {
                     "type": {"string": "tpu"},
+                    "generation": {"string": gen},
                     "topologyCoord": {"string": c},
                     "iciDomainID": {"string": f"ici.{i}"},
                 },
@@ -94,15 +142,16 @@ def make_node_devices(i: int) -> List[dict]:
                 }],
             },
         }
-        for c in MESH_COORDS
+        for c in spec["mesh"]
     ]
-    for shape, placements in SHAPES.items():
+    for shape, placements in spec["shapes"].items():
         for origin, coords in placements:
             devices.append({
                 "name": f"ss-{shape}-{origin.replace(',', '-')}",
                 "basic": {
                     "attributes": {
                         "type": {"string": "subslice-dynamic"},
+                        "generation": {"string": gen},
                         "subsliceShape": {"string": shape},
                         "iciDomainID": {"string": f"ici.{i}"},
                     },
@@ -118,7 +167,9 @@ def make_node_devices(i: int) -> List[dict]:
     return devices
 
 
-def make_node_slice(i: int, generation: int = 1) -> dict:
+def make_node_slice(
+    i: int, generation: int = 1, gen: str = GEN_DEFAULT
+) -> dict:
     node = node_name(i)
     return {
         "apiVersion": "resource.k8s.io/v1beta1",
@@ -127,17 +178,21 @@ def make_node_slice(i: int, generation: int = 1) -> dict:
             "name": f"slice-{node}",
             # Same label the real plugin stamps: the fleet harness's
             # publishers adopt/relist by it, exactly like the driver.
-            "labels": {"tpu.google.com/driver": "true"},
+            # The generation label lets fleet-aware consumers (the gang
+            # bench's perf weighting, the corridor drill) map a pool to
+            # its chip grid without re-parsing devices.
+            "labels": {"tpu.google.com/driver": "true", GEN_LABEL: gen},
         },
         "spec": {
             "driver": DRIVER,
             "nodeName": node,
             "pool": {"name": node, "generation": generation},
-            "devices": make_node_devices(i),
+            "devices": make_node_devices(i, gen),
             "sharedCounters": [{
                 "name": "tpu-host-mesh",
                 "counters": {
-                    f"chip-{c}": {"value": "1"} for c in MESH_COORDS
+                    f"chip-{c}": {"value": "1"}
+                    for c in GENERATIONS[gen]["mesh"]
                 },
             }],
         },
@@ -149,23 +204,97 @@ def make_fleet(nodes: int) -> List[dict]:
     return [make_node_slice(i) for i in range(nodes)]
 
 
-def make_claim(i: int, shape: str) -> dict:
+def make_hetero_fleet(
+    nodes: int,
+    seed: int = 0,
+    gen_weights: Optional[List[Tuple[str, int]]] = None,
+) -> List[dict]:
+    """A seeded mixed-generation fleet: each node draws its generation
+    from ``gen_weights`` (default 60% v5e / 40% v5p). Deterministic for
+    a fixed seed — the gang fuzzer and gangbench replay identical
+    fleets across orderings and crash interleavings."""
+    gen_weights = gen_weights or [("v5e", 60), ("v5p", 40)]
+    rng = random.Random(seed)
+    gens = [g for g, _ in gen_weights]
+    weights = [w for _, w in gen_weights]
+    return [
+        make_node_slice(i, gen=rng.choices(gens, weights)[0])
+        for i in range(nodes)
+    ]
+
+
+def slice_generation(s: dict) -> str:
+    """A slice's TPU generation (the label stamped by make_node_slice;
+    absent on pre-ISSUE-19 hand-built slices, which are all v5e)."""
+    labels = (s.get("metadata") or {}).get("labels") or {}
+    return labels.get(GEN_LABEL, GEN_DEFAULT)
+
+
+def fleet_perf_capacity(slices: List[dict]) -> float:
+    """Total perf-weighted chip capacity of a fleet — the denominator
+    of achievable utilization over mixed generations."""
+    total = 0.0
+    for s in slices:
+        gen = slice_generation(s)
+        total += len(GENERATIONS[gen]["mesh"]) * GEN_PERF[gen]
+    return total
+
+
+def make_claim(
+    i: int,
+    shape: str,
+    gen: Optional[str] = None,
+    namespace: str = "allocbench",
+) -> dict:
+    selectors = [{"cel": {"expression":
+        f"device.attributes['{DRIVER}'].subsliceShape == "
+        f"'{shape}'"}}]
+    if gen is not None:
+        selectors.append({"cel": {"expression":
+            f"device.attributes['{DRIVER}'].generation == "
+            f"'{gen}'"}})
     return {
         "apiVersion": "resource.k8s.io/v1beta1",
         "kind": "ResourceClaim",
         "metadata": {
             "name": f"claim-{i:05d}",
-            "namespace": "allocbench",
+            "namespace": namespace,
             "uid": f"uid-{i:05d}",
         },
         "spec": {"devices": {"requests": [{
             "name": "tpu",
             "deviceClassName": SUBSLICE_CLASS["metadata"]["name"],
-            "selectors": [{"cel": {"expression":
-                f"device.attributes['{DRIVER}'].subsliceShape == "
-                f"'{shape}'"}}],
+            "selectors": selectors,
         }]}},
     }
+
+
+def make_gang_claims(
+    gang: str,
+    i0: int,
+    size: int,
+    shape: str,
+    gen: Optional[str] = None,
+    namespace: str = "allocbench",
+) -> List[dict]:
+    """``size`` member claims of one all-or-nothing gang: each member
+    wants one ``shape`` sub-slice (optionally pinned to a generation)
+    and carries the gang identity labels the scheduler's gang grouping
+    and the repacker's victim pin key off. Single-node claims on
+    distinct nodes by construction: the allocator's one-node-per-claim
+    invariant plus gang-wide counter exclusivity spread members across
+    the fleet."""
+    from tpu_dra.scheduler.gang import GANG_NAME_LABEL, GANG_SIZE_LABEL
+
+    out = []
+    for k in range(size):
+        c = make_claim(i0 + k, shape, gen=gen, namespace=namespace)
+        c["metadata"]["labels"] = {
+            GANG_NAME_LABEL: gang,
+            GANG_SIZE_LABEL: str(size),
+        }
+        out.append(c)
+    return out
 
 
 def make_trace(n: int, seed: int) -> List[dict]:

@@ -1,0 +1,475 @@
+"""All-or-nothing gang scheduling: the crash-safe two-phase commit
+(ISSUE 19, ROADMAP item 1 — the multi-node ComputeDomain/pod-slice
+story re-imagined over ICI).
+
+A **gang** is a set of ResourceClaims that must allocate together or
+not at all: the members carry ``gang.tpu.google.com/name`` and
+``gang.tpu.google.com/size`` labels (permanent identity — they survive
+every WAL transition), and the scheduler's batch reconcile solves all
+members against one packed snapshot via
+:meth:`~tpu_dra.scheduler.allocator.Allocator.allocate_gang`. The hard
+part is not placement but crash atomicity: a scheduler death between
+member commits must never leave a half-placed gang holding chips
+hostage. This module is that protocol — the PR-12 repacker's
+apiserver-durable WAL pattern, generalized from one claim to N:
+
+- WAL state lives in a ``gang.tpu.google.com/state`` annotation **on
+  each member claim** (one apiserver object carries both the WAL entry
+  and the allocation it governs; a node-local file would not survive
+  leader failover);
+- every allocation-bearing write is a FULL update (PUT), which the
+  fake/fakeserver/real-apiserver semantics make atomic across metadata
+  and status — the WAL phase and the allocation it describes can never
+  be observed out of step;
+- the ``gang.commit.*`` / ``gang.teardown.*`` crash points
+  (:mod:`tpu_dra.infra.crashpoint`) thread every dangerous window, and
+  the crash matrix + gang fuzzer kill at each one and prove
+  :func:`recover_gangs` converges.
+
+Commit phases (``commit_gang``)::
+
+    phase 1  per member: write WAL {phase: committing, members, t}
+             crash here -> no allocation exists; recovery DROPS the
+             partial intent (roll back)
+    phase 2  per member: ONE PUT sets status.allocation AND flips the
+             WAL to committed
+             crash here -> mixed committed/committing; recovery CLEARS
+             the committed members' allocations (roll back — never a
+             partial gang)
+    phase 3  per member: drop the annotation (finalize)
+             crash here -> every member committed+allocated; recovery
+             rolls FORWARD (drops the remaining annotations)
+
+Rollback-vs-roll-forward rule (``recover_gangs``): a gang rolls
+forward iff **every** member listed in the WAL exists, is allocated,
+and no surviving WAL phase is ``committing`` or ``rolling_back``;
+anything else rolls back to pending. Teardown (node loss, member
+delete, post-crash rollback) is itself journaled through a
+``rolling_back`` intent on every member first — a crash mid-teardown
+recovers by completing the teardown, so the gang converges to
+fully-pending, never half-dead.
+
+The scheduler skips claims carrying an unresolved gang WAL (the
+protocol owns them) exactly like ``repack_owned``; a stale WAL (the
+writing scheduler died) is recovered lazily at the next batch pass and
+eagerly at startup.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Dict, List, Optional
+
+from tpu_dra.infra.crashpoint import crashpoint
+from tpu_dra.k8sclient import ApiConflict, ApiNotFound
+
+log = logging.getLogger(__name__)
+
+GANG_NAME_LABEL = "gang.tpu.google.com/name"
+GANG_SIZE_LABEL = "gang.tpu.google.com/size"
+GANG_ANNOTATION = "gang.tpu.google.com/state"
+
+PHASE_COMMITTING = "committing"
+PHASE_COMMITTED = "committed"
+PHASE_ROLLING_BACK = "rolling_back"
+
+# A WAL older than this belongs to a dead scheduler: the live batch
+# reconcile recovers it inline instead of skipping the claim forever.
+# Deliberately shorter than the repacker's stale-plan window — a gang
+# commit is a few PUTs, not a drain.
+DEFAULT_STALE_WAL_SECONDS = 30.0
+
+
+def claim_key(claim: dict) -> str:
+    md = claim.get("metadata", {})
+    return f"{md.get('namespace')}/{md.get('name')}"
+
+
+def gang_name(claim: dict) -> Optional[str]:
+    """The claim's gang identity label, or None for a singleton."""
+    labels = (claim.get("metadata", {}).get("labels") or {})
+    return labels.get(GANG_NAME_LABEL) or None
+
+
+def gang_size(claim: dict) -> int:
+    """Declared member count; 0 when absent/garbled (the grouping then
+    treats the declared size as unsatisfiable rather than guessing)."""
+    labels = (claim.get("metadata", {}).get("labels") or {})
+    try:
+        return int(labels.get(GANG_SIZE_LABEL, "0"))
+    except ValueError:
+        return 0
+
+
+def gang_state(claim: dict) -> Optional[dict]:
+    """The claim's gang WAL entry, or None. Malformed JSON reads as a
+    ``rolling_back`` entry — a corrupted WAL must resolve to teardown
+    (the conservative all-or-nothing outcome), never crash a reconcile
+    and never be mistaken for 'no protocol in flight'."""
+    raw = (claim.get("metadata", {}).get("annotations") or {}).get(
+        GANG_ANNOTATION
+    )
+    if not raw:
+        return None
+    try:
+        st = json.loads(raw)
+    except ValueError:
+        st = None
+    if not isinstance(st, dict):
+        return {
+            "phase": PHASE_ROLLING_BACK,
+            "gang": gang_name(claim) or claim_key(claim),
+            "corrupt": True,
+        }
+    return st
+
+
+def wal_age(
+    claim: dict, now: Optional[float] = None
+) -> Optional[float]:
+    """Seconds since the claim's WAL was stamped; None without a WAL
+    or a usable stamp (a stampless WAL reads as infinitely old — age 0
+    would hide it from the stale-recovery path forever)."""
+    st = gang_state(claim)
+    if st is None:
+        return None
+    t = st.get("t")
+    if not isinstance(t, (int, float)):
+        return float("inf")
+    if now is None:
+        now = time.time()
+    return max(0.0, now - t)
+
+
+def wal_stale(
+    claim: dict,
+    now: Optional[float] = None,
+    stale_seconds: float = DEFAULT_STALE_WAL_SECONDS,
+) -> bool:
+    """True when the claim carries a gang WAL old enough that its
+    writer must be dead (see DEFAULT_STALE_WAL_SECONDS)."""
+    age = wal_age(claim, now)
+    return age is not None and age >= stale_seconds
+
+
+def gang_owned(claim: dict, now: Optional[float] = None) -> bool:
+    """True while an unresolved (fresh) gang WAL owns this claim: the
+    batch reconcile must neither allocate it nor count it pending —
+    the protocol (or the recovery about to run) decides its fate."""
+    return gang_state(claim) is not None and not wal_stale(claim, now)
+
+
+def _update_claim(claims, name, namespace, mutate) -> Optional[dict]:
+    """Read-mutate-update with conflict retry (the repacker's helper,
+    protocol-local). Returns the stored object, or None when the claim
+    is gone; a persistent conflict storm raises ApiConflict."""
+    for _ in range(8):
+        cur = claims.try_get(name, namespace)
+        if cur is None:
+            return None
+        mutate(cur)
+        try:
+            return claims.update(cur)
+        except ApiConflict:
+            continue
+        except ApiNotFound:
+            return None
+    raise ApiConflict(
+        f"gang: claim {namespace}/{name} update lost the race 8 "
+        f"times in a row"
+    )
+
+
+def _set_wal(claim: dict, st: dict) -> None:
+    claim["metadata"].setdefault("annotations", {})[
+        GANG_ANNOTATION
+    ] = json.dumps(st)
+
+
+def _drop_wal(claim: dict) -> None:
+    anns = claim["metadata"].get("annotations") or {}
+    anns.pop(GANG_ANNOTATION, None)
+    claim["metadata"]["annotations"] = anns
+
+
+def _clear_and_drop(claim: dict) -> None:
+    """One PUT's mutation: allocation gone AND WAL gone, atomically —
+    the rollback/teardown end state for a member."""
+    (claim.get("status") or {}).pop("allocation", None)
+    _drop_wal(claim)
+
+
+def _inc(metrics, name: str, value: float = 1.0, labels=None) -> None:
+    if metrics is not None:
+        metrics.inc(name, value, labels=labels)
+
+
+class GangCommitError(Exception):
+    """A member write failed mid-commit (claim vanished / persistent
+    conflict); the partial gang was rolled back before raising."""
+
+
+def commit_gang(
+    claims,
+    gang: str,
+    members: List[dict],
+    results: List[object],
+    *,
+    identity: str = "",
+    metrics=None,
+    wall_clock=time.time,
+) -> List[dict]:
+    """Atomically commit ``results[i].allocation`` onto ``members[i]``
+    — all of them, or none (see module doc for the phase table).
+    Returns the stored member objects on success; raises
+    :exc:`GangCommitError` after rolling the partial gang back when
+    any member write fails. A :class:`SimulatedCrash` (or real death)
+    anywhere in between leaves the WAL for :func:`recover_gangs`."""
+    t0 = time.monotonic()
+    keys = [claim_key(c) for c in members]
+    wal = {
+        "phase": PHASE_COMMITTING,
+        "gang": gang,
+        "size": len(members),
+        "members": keys,
+        "t": wall_clock(),
+        "by": identity,
+    }
+    intended: List[dict] = []
+
+    def fail(why: str, committed: List[dict]) -> None:
+        # Undo in reverse commit order: committed members lose their
+        # allocation and WAL in one PUT each, intent-only members just
+        # lose the WAL. Counted as a partial rollback only when an
+        # allocation actually existed to clear.
+        for c in committed:
+            md = c["metadata"]
+            _update_claim(claims, md["name"], md.get("namespace"),
+                          _clear_and_drop)
+        for c in intended:
+            if any(c is d for d in committed):
+                continue
+            md = c["metadata"]
+            _update_claim(claims, md["name"], md.get("namespace"),
+                          _drop_wal)
+        if committed:
+            _inc(metrics, "gang_partial_rollbacks_total")
+        _inc(metrics, "gang_allocations_total",
+             labels={"result": "rolled_back"})
+        raise GangCommitError(f"gang {gang!r}: {why}")
+
+    # Phase 1 — durable intent on every member.
+    for c in members:
+        md = c["metadata"]
+        try:
+            stored = _update_claim(
+                claims, md["name"], md.get("namespace"),
+                lambda cur: _set_wal(cur, wal),
+            )
+        except ApiConflict:
+            stored = None
+        if stored is None:
+            fail(f"member {claim_key(c)} vanished writing intent", [])
+        intended.append(c)
+        crashpoint("gang.commit.between_intents")
+    crashpoint("gang.commit.after_intent_persisted")
+
+    # Phase 2 — per member, allocation + WAL flip in ONE PUT.
+    committed: List[dict] = []
+    stored_members: List[dict] = []
+    for c, res in zip(members, results):
+        md = c["metadata"]
+        member_wal = dict(wal, phase=PHASE_COMMITTED)
+
+        def commit_one(cur: dict) -> None:
+            cur.setdefault("status", {})["allocation"] = res.allocation
+            _set_wal(cur, member_wal)
+
+        try:
+            stored = _update_claim(
+                claims, md["name"], md.get("namespace"), commit_one
+            )
+        except ApiConflict:
+            stored = None
+        if stored is None:
+            fail(
+                f"member {claim_key(c)} vanished mid-commit", committed
+            )
+        committed.append(c)
+        stored_members.append(stored)
+        crashpoint("gang.commit.between_members")
+    crashpoint("gang.commit.before_finalize")
+
+    # Phase 3 — finalize: the WAL comes off each member. A member
+    # vanishing HERE is benign for atomicity (all members committed;
+    # the deletion's own event tears the survivors down through the
+    # journaled path).
+    out: List[dict] = []
+    for c, stored in zip(members, stored_members):
+        md = c["metadata"]
+        final = _update_claim(
+            claims, md["name"], md.get("namespace"), _drop_wal
+        )
+        out.append(final if final is not None else stored)
+    _inc(metrics, "gang_allocations_total",
+         labels={"result": "committed"})
+    if metrics is not None:
+        metrics.observe("gang_commit_seconds", time.monotonic() - t0)
+    return out
+
+
+def teardown_gang(
+    claims,
+    members: List[dict],
+    *,
+    reason: str = "",
+    identity: str = "",
+    metrics=None,
+    wall_clock=time.time,
+) -> int:
+    """Journaled whole-gang teardown (node loss under a member, member
+    deletion, operator action): first a ``rolling_back`` intent on
+    every member, then allocation+WAL cleared per member in one PUT.
+    Idempotent — recovery re-runs it to completion. Returns how many
+    members had an allocation cleared."""
+    if not members:
+        return 0
+    gang = gang_name(members[0]) or claim_key(members[0])
+    keys = [claim_key(c) for c in members]
+    wal = {
+        "phase": PHASE_ROLLING_BACK,
+        "gang": gang,
+        "size": len(members),
+        "members": keys,
+        "t": wall_clock(),
+        "by": identity,
+        "reason": reason[:256],
+    }
+    for c in members:
+        md = c["metadata"]
+        try:
+            _update_claim(
+                claims, md["name"], md.get("namespace"),
+                lambda cur: _set_wal(cur, wal),
+            )
+        except ApiConflict:
+            continue  # the completion loop below still clears it
+    crashpoint("gang.teardown.after_intent")
+    cleared = 0
+    for c in members:
+        md = c["metadata"]
+        had_alloc = False
+
+        def complete(cur: dict) -> None:
+            nonlocal had_alloc
+            had_alloc = bool((cur.get("status") or {}).get("allocation"))
+            _clear_and_drop(cur)
+
+        try:
+            stored = _update_claim(
+                claims, md["name"], md.get("namespace"), complete
+            )
+        except ApiConflict:
+            stored = None
+        if stored is not None and had_alloc:
+            cleared += 1
+    if cleared:
+        _inc(metrics, "gang_teardowns_total")
+    log.info(
+        "gang %s torn down (%d allocations cleared): %s",
+        gang, cleared, reason or "requested",
+    )
+    return cleared
+
+
+def recover_gangs(
+    claims,
+    *,
+    identity: str = "",
+    metrics=None,
+    wall_clock=time.time,
+) -> int:
+    """Resolve every gang WAL left by a dead scheduler (see the
+    module-doc rule): ``rolling_back`` anywhere -> finish the
+    teardown; a fully-committed gang -> roll forward (drop the WALs);
+    anything else -> roll back to pending. Returns the number of gangs
+    resolved. Safe to run concurrently with a live commit only in the
+    sense the caller enforces (the core runs it on the same serialized
+    path as commits; the fuzzer/crash-matrix call it on a fresh
+    scheduler)."""
+    snapshot = claims.list()
+    by_key: Dict[str, dict] = {claim_key(c): c for c in snapshot}
+    # Gang identity -> every claim key the WALs implicate (the members
+    # lists find finalized members whose annotation is already gone;
+    # the label scan finds members whose WAL write never landed).
+    groups: Dict[str, set] = {}
+    for c in snapshot:
+        st = gang_state(c)
+        if st is None:
+            continue
+        g = st.get("gang") or gang_name(c) or claim_key(c)
+        ks = groups.setdefault(g, set())
+        ks.add(claim_key(c))
+        for k in st.get("members") or []:
+            if isinstance(k, str):
+                ks.add(k)
+    if not groups:
+        return 0
+    for c in snapshot:
+        g = gang_name(c)
+        if g in groups:
+            groups[g].add(claim_key(c))
+    resolved = 0
+    for g, keys in sorted(groups.items()):
+        present = [by_key[k] for k in sorted(keys) if k in by_key]
+        states = [s for s in (gang_state(c) for c in present)
+                  if s is not None]
+        phases = {s.get("phase") for s in states}
+        all_exist = all(k in by_key for k in keys)
+        all_allocated = present and all(
+            (c.get("status") or {}).get("allocation") for c in present
+        )
+        if PHASE_ROLLING_BACK in phases:
+            # A teardown was in flight: complete it.
+            teardown_gang(
+                claims, present, reason="recovery: teardown completion",
+                identity=identity, metrics=metrics,
+                wall_clock=wall_clock,
+            )
+            _inc(metrics, "gang_allocations_total",
+                 labels={"result": "rolled_back"})
+            action = "teardown completed"
+        elif (
+            all_exist and all_allocated
+            and phases <= {PHASE_COMMITTED}
+        ):
+            # Crash mid-finalize: the gang is whole — roll forward.
+            for c in present:
+                md = c["metadata"]
+                _update_claim(
+                    claims, md["name"], md.get("namespace"), _drop_wal
+                )
+            action = "rolled forward"
+        else:
+            # The half-placed window (or a member died): all-or-nothing
+            # says none — clear every member's allocation and WAL.
+            cleared = 0
+            for c in present:
+                had = bool((c.get("status") or {}).get("allocation"))
+                md = c["metadata"]
+                _update_claim(
+                    claims, md["name"], md.get("namespace"),
+                    _clear_and_drop,
+                )
+                cleared += 1 if had else 0
+            if cleared:
+                _inc(metrics, "gang_partial_rollbacks_total")
+            _inc(metrics, "gang_allocations_total",
+                 labels={"result": "rolled_back"})
+            action = f"rolled back ({cleared} allocations cleared)"
+        resolved += 1
+        _inc(metrics, "gang_recoveries_total")
+        log.warning("gang recovery: %s %s", g, action)
+    return resolved

@@ -1,0 +1,435 @@
+"""Gang-scheduling bench: heterogeneous-fleet packing + corridor repack.
+
+ISSUE 19 made the scheduler gang-aware over mixed TPU generations: an
+all-or-nothing gang of full-node claims only seats when enough WHOLE
+nodes of the right generation are free, and the packing order + the
+repacker's corridor mode exist to manufacture that state. This module
+measures both halves with the same trace-replay discipline as
+:mod:`tpu_dra.scheduler.allocbench`:
+
+**Phase A — heterogeneous packing.** A seeded mixed v5e/v5p fleet
+(:func:`~tpu_dra.scheduler.fleet.make_hetero_fleet`, 75/25 mix) first
+absorbs a load of generation-agnostic singletons sized to the v5e
+capacity, then v5p full-node (4x2x1) gangs land — the big training
+job arriving on an already-busy fleet. Two strategies replay the
+identical workload:
+
+- *packed* — the shipping policy: the reconcile window solves gangs
+  first (largest first) through ``Allocator.allocate_gang`` on one
+  shared snapshot, then the singletons through the largest-first
+  batch order with the corridor-preserving bucket order (small pools
+  first on a heterogeneous fleet, so singletons never touch a v5p
+  node while a v5e seat exists);
+- *first-fit* — arrival order, catalog bucket order, gang members
+  allocated independently with no atomicity.
+
+The headline is **perf-weighted achievable utilization**
+(``gang_util_packed`` / ``gang_util_firstfit``): each SEATED claim
+contributes its chip footprint weighted by the
+:data:`~tpu_dra.scheduler.fleet.GEN_PERF` of the generation it
+*demands* (a gen-agnostic singleton is v5e work wherever it lands —
+parking it on a v5p node serves no more demand, it just strands the
+big node), divided by
+:func:`~tpu_dra.scheduler.fleet.fleet_perf_capacity`. Members of a
+gang that did not FULLY seat contribute nothing — a partial gang is
+stranded capacity, which is exactly what all-or-nothing semantics
+exist to name. First-fit walks singletons across the node list in
+name order, touching v5p nodes it never needed, and the late gangs
+cannot find whole free nodes; packed keeps the big nodes whole and
+seats them.
+
+**Phase B — corridor repack drill.** Six v5p nodes, four 1x1 residents
+hand-placed one-per-node (nodes 0-3), and a pending 4-member 4x2x1
+gang that provably cannot seat (only two whole nodes free). The
+repacker is ticked in corridor mode until consolidation opens a
+4-node corridor, then the gang is seated through
+``allocate_gang`` + ``commit_gang`` and the end state is verified
+(distinct nodes, no WAL residue). ``gang_corridor_nodes`` /
+``gang_repack_migrations`` record the drill.
+
+Entry points::
+
+    python -m tpu_dra.scheduler.gangbench          # full fleet
+    python -m tpu_dra.scheduler.gangbench --smoke  # CI leg + asserts
+
+``--smoke`` (the ``make gangbench`` leg) shrinks the fleet and asserts
+the contract: packed strictly beats first-fit on perf-weighted
+utilization, the gang is unschedulable before the repack drill and
+seated after it, and the corridor is at least gang-sized. Knobs (env):
+``GANGBENCH_NODES``, ``GANGBENCH_SEED``, ``GANGBENCH_GANGS``,
+``GANGBENCH_GANG_SIZE``.
+
+bench.py runs ``--leg-gang`` and folds the ``gang_*`` keys into the
+final BENCH JSON line (methodology: docs/scheduling.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+from typing import List, Tuple
+
+from tpu_dra.k8sclient import (
+    DEVICE_CLASSES,
+    RESOURCE_CLAIMS,
+    RESOURCE_SLICES,
+    FakeCluster,
+    ResourceClient,
+)
+from tpu_dra.scheduler.allocator import Allocator, Unschedulable
+from tpu_dra.scheduler.fleet import (
+    CLASSES,
+    GEN_PERF,
+    SHAPE_WEIGHTS,
+    fleet_perf_capacity,
+    make_claim,
+    make_gang_claims,
+    make_hetero_fleet,
+    make_node_slice,
+    node_name,
+    slice_generation,
+)
+from tpu_dra.scheduler.gang import commit_gang, gang_state
+from tpu_dra.scheduler.index import SliceIndex
+
+NS = "gangbench"
+
+
+def _note(msg: str) -> None:
+    print(f"gangbench: {msg}", file=sys.stderr)
+
+
+def _device_chips(device: str) -> int:
+    """Chip count of a sub-slice device from its name (``ss-<shape>-…``
+    — the shape IS the footprint: AxBxC covers A*B*C chips)."""
+    shape = device.split("-")[1]
+    a, b, c = shape.split("x")
+    return int(a) * int(b) * int(c)
+
+
+def _used_perf(results: List[Tuple[str, dict, str]]) -> float:
+    """Served demand: chips × the perf of the generation the claim
+    DEMANDS (not where it landed — see module doc)."""
+    total = 0.0
+    for _, allocation, want_gen in results:
+        for r in allocation["devices"]["results"]:
+            total += _device_chips(r["device"]) * GEN_PERF[want_gen]
+    return total
+
+
+def _make_workload(
+    nodes: int, seed: int, gangs: int, gang_size: int
+) -> Tuple[List[dict], List[dict], List[Tuple[str, List[dict]]]]:
+    """(slices, arrival-ordered singleton claims, gangs). Singleton
+    footprint is sized to ~90% of the v5e capacity: it FITS on the
+    small generation, so every v5p node a strategy touches with a
+    singleton is a self-inflicted wound — the regime where first-fit's
+    name-order walk costs whole-node gang seats and the
+    small-pools-first corridor order does not."""
+    rng = random.Random(seed ^ 0x6A16)
+    slices = make_hetero_fleet(
+        nodes, seed, gen_weights=[("v5e", 75), ("v5p", 25)]
+    )
+    gens = [slice_generation(s) for s in slices]
+    v5e_chips = 4 * sum(1 for g in gens if g == "v5e")
+    target = 0.9 * v5e_chips
+    shapes = [s for s, _ in SHAPE_WEIGHTS]
+    weights = [w for _, w in SHAPE_WEIGHTS]
+    singles: List[dict] = []
+    footprint = 0
+    i = 0
+    while footprint < target:
+        shape = rng.choices(shapes, weights)[0]
+        singles.append(make_claim(i, shape, namespace=NS))
+        footprint += _device_chips(f"ss-{shape}-x")
+        i += 1
+    gang_list = [
+        (
+            f"gang-{g:02d}",
+            make_gang_claims(
+                f"gang-{g:02d}", 100_000 + g * 100, gang_size,
+                "4x2x1", gen="v5p", namespace=NS,
+            ),
+        )
+        for g in range(gangs)
+    ]
+    return slices, singles, gang_list
+
+
+def _replay_packed(
+    index: SliceIndex,
+    singles: List[dict],
+    gang_list: List[Tuple[str, List[dict]]],
+) -> Tuple[List[Tuple[str, dict, str]], int]:
+    """The shipping policy on one shared snapshot: gangs first (largest
+    member count first, name tiebreak — the core's solve order), then
+    singletons through the batch order with the corridor bucket
+    ordering."""
+    alloc = Allocator(CLASSES, allocated_claims=[], index=index,
+                      ordering="packed")
+    results: List[Tuple[str, dict, str]] = []
+    seated = 0
+    for g, members in sorted(
+        gang_list, key=lambda t: (-len(t[1]), t[0])
+    ):
+        try:
+            out = alloc.allocate_gang(members)
+        except Unschedulable:
+            continue
+        seated += 1
+        results.extend(
+            (m["metadata"]["name"], r.allocation, "v5p")
+            for m, r in zip(members, out)
+        )
+    for k in alloc.batch_order(singles):
+        try:
+            res = alloc.allocate(singles[k])
+        except Unschedulable:
+            continue
+        results.append(
+            (singles[k]["metadata"]["name"], res.allocation, "v5e")
+        )
+    return results, seated
+
+
+def _replay_firstfit(
+    index: SliceIndex,
+    singles: List[dict],
+    gang_list: List[Tuple[str, List[dict]]],
+) -> Tuple[List[Tuple[str, dict, str]], int]:
+    """Arrival order (singletons first, then the gangs), catalog bucket
+    order, no gang atomicity: members allocate independently and a
+    partial gang keeps its seats (and its chips) without ever becoming
+    useful work."""
+    alloc = Allocator(CLASSES, allocated_claims=[], index=index,
+                      ordering="catalog")
+    results: List[Tuple[str, dict, str]] = []
+    seated = 0
+    for c in singles:
+        try:
+            res = alloc.allocate(c)
+        except Unschedulable:
+            continue
+        results.append((c["metadata"]["name"], res.allocation, "v5e"))
+    for g, members in gang_list:
+        got = []
+        for m in members:
+            try:
+                got.append((m["metadata"]["name"], alloc.allocate(m)))
+            except Unschedulable:
+                pass
+        if len(got) == len(members):
+            seated += 1
+            results.extend((n, r.allocation, "v5p") for n, r in got)
+        # Partial gangs: chips stay consumed in the ledger (first-fit
+        # has no rollback) but count for nothing — stranded capacity.
+    return results, seated
+
+
+def run_phase_a(
+    nodes: int, seed: int, gangs: int, gang_size: int
+) -> dict:
+    slices, singles, gang_list = _make_workload(
+        nodes, seed, gangs, gang_size
+    )
+    v5p_nodes = sum(
+        1 for s in slices if slice_generation(s) == "v5p"
+    )
+    perf_cap = fleet_perf_capacity(slices)
+    index = SliceIndex()
+    index.resync(slices)
+    t0 = time.perf_counter()
+    packed, packed_seated = _replay_packed(index, singles, gang_list)
+    packed_s = time.perf_counter() - t0
+    firstfit, ff_seated = _replay_firstfit(index, singles, gang_list)
+    util_packed = round(_used_perf(packed) / perf_cap, 4)
+    util_firstfit = round(_used_perf(firstfit) / perf_cap, 4)
+    _note(
+        f"phase A: {nodes} nodes ({v5p_nodes} v5p), "
+        f"{len(singles)} singletons, {gangs} gangs x {gang_size}: "
+        f"packed util {util_packed} ({packed_seated}/{gangs} gangs, "
+        f"{packed_s * 1000:.0f} ms), first-fit util {util_firstfit} "
+        f"({ff_seated}/{gangs} gangs)"
+    )
+    return {
+        "gang_util_packed": util_packed,
+        "gang_util_firstfit": util_firstfit,
+        "gang_seated_packed": packed_seated,
+        "gang_seated_firstfit": ff_seated,
+        "gang_count": gangs,
+        "gang_size": gang_size,
+        "fleet_nodes": nodes,
+        "seed": seed,
+    }
+
+
+# --- Phase B: corridor repack drill -----------------------------------------
+
+CORRIDOR_NODES = 6
+CORRIDOR_GANG = 4
+
+
+def _free_pools(cluster) -> int:
+    used = set()
+    for c in ResourceClient(cluster, RESOURCE_CLAIMS).list():
+        alloc = (c.get("status") or {}).get("allocation") or {}
+        for r in alloc.get("devices", {}).get("results", []):
+            used.add(r["pool"])
+    return CORRIDOR_NODES - len(used)
+
+
+def _corridor_allocator(cluster) -> Allocator:
+    claims = ResourceClient(cluster, RESOURCE_CLAIMS).list()
+    return Allocator(
+        ResourceClient(cluster, DEVICE_CLASSES).list(),
+        slices=ResourceClient(cluster, RESOURCE_SLICES).list(),
+        allocated_claims=[
+            c for c in claims
+            if (c.get("status") or {}).get("allocation")
+        ],
+    )
+
+
+def run_phase_b() -> dict:
+    """See module doc: consolidate residents until a 4-node corridor
+    opens, then seat the pending gang through the real commit path."""
+    from tpu_dra.infra.metrics import Metrics
+    from tpu_dra.scheduler.repacker import Repacker, RepackerConfig
+
+    cluster = FakeCluster()
+    classes = ResourceClient(cluster, DEVICE_CLASSES)
+    for c in CLASSES:
+        classes.create(json.loads(json.dumps(c)))
+    slices = ResourceClient(cluster, RESOURCE_SLICES)
+    for i in range(CORRIDOR_NODES):
+        slices.create(make_node_slice(i, gen="v5p"))
+    claims = ResourceClient(cluster, RESOURCE_CLAIMS)
+    for i in range(CORRIDOR_GANG):
+        c = make_claim(i, "1x1x1", namespace=NS)
+        c["status"] = {"allocation": {"devices": {"results": [{
+            "request": "tpu", "driver": "tpu.google.com",
+            "pool": node_name(i), "device": "ss-1x1x1-0-0-0",
+        }]}}}
+        claims.create(c)
+        claims.update_status(c)
+    members = make_gang_claims(
+        "corridor", 200_000, CORRIDOR_GANG, "4x2x1", gen="v5p",
+        namespace=NS,
+    )
+    for m in members:
+        claims.create(m)
+    # The gang must be provably stuck first: 4 whole nodes needed, 2
+    # free.
+    stuck = False
+    try:
+        _corridor_allocator(cluster).allocate_gang(members)
+    except Unschedulable:
+        stuck = True
+    rp = Repacker(
+        cluster,
+        RepackerConfig(
+            poll_period=0.0, min_disruption_interval_seconds=0.0,
+        ),
+        metrics=Metrics(),
+    )
+    ticks = 0
+    while ticks < 200 and (
+        _free_pools(cluster) < CORRIDOR_GANG or rp._active
+    ):
+        rp.tick()
+        ticks += 1
+    corridor = _free_pools(cluster)
+    seated_pools: List[str] = []
+    if corridor >= CORRIDOR_GANG:
+        fresh = [
+            claims.get(m["metadata"]["name"], NS) for m in members
+        ]
+        results = _corridor_allocator(cluster).allocate_gang(fresh)
+        commit_gang(
+            claims, "corridor", fresh, results, identity="gangbench"
+        )
+        for m in members:
+            cur = claims.get(m["metadata"]["name"], NS)
+            assert gang_state(cur) is None, "gang WAL left behind"
+            seated_pools.extend(
+                r["pool"] for r in cur["status"]["allocation"]
+                ["devices"]["results"]
+            )
+    _note(
+        f"phase B: corridor {corridor} free nodes after "
+        f"{rp.migrations} migrations ({ticks} ticks), gang "
+        f"{'seated on ' + ','.join(sorted(seated_pools)) if seated_pools else 'NOT seated'}"
+    )
+    return {
+        "gang_corridor_nodes": corridor,
+        "gang_repack_migrations": rp.migrations,
+        "gang_corridor_stuck_before": stuck,
+        "gang_corridor_seated_pools": sorted(seated_pools),
+    }
+
+
+def _assert_contract(report: dict) -> None:
+    """The smoke-mode acceptance bar (see module doc)."""
+    assert report["gang_util_packed"] > report["gang_util_firstfit"], (
+        f"packed does not beat first-fit on perf-weighted utilization: "
+        f"{report['gang_util_packed']} vs {report['gang_util_firstfit']}"
+    )
+    assert report["gang_seated_packed"] >= report["gang_seated_firstfit"], (
+        "packed seated fewer gangs than first-fit"
+    )
+    assert report["gang_seated_packed"] == report["gang_count"], (
+        f"packed left a gang stranded: "
+        f"{report['gang_seated_packed']}/{report['gang_count']}"
+    )
+    assert report["gang_corridor_stuck_before"], (
+        "drill invalid: gang was schedulable before the repack"
+    )
+    assert report["gang_corridor_nodes"] >= CORRIDOR_GANG, (
+        f"repacker never opened a {CORRIDOR_GANG}-node corridor "
+        f"(got {report['gang_corridor_nodes']})"
+    )
+    assert report["gang_repack_migrations"] >= 1, (
+        "corridor opened without any migration — drill degenerate"
+    )
+    pools = report["gang_corridor_seated_pools"]
+    assert len(pools) == CORRIDOR_GANG == len(set(pools)), (
+        f"gang not seated on {CORRIDOR_GANG} distinct nodes: {pools}"
+    )
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("gangbench", description=__doc__)
+    p.add_argument(
+        "--smoke", action="store_true",
+        help="small fleet + hard contract asserts (the CI leg)",
+    )
+    args = p.parse_args(argv)
+    if args.smoke:
+        nodes = int(os.environ.get("GANGBENCH_NODES", "48"))
+        gangs = int(os.environ.get("GANGBENCH_GANGS", "3"))
+        gang_size = int(os.environ.get("GANGBENCH_GANG_SIZE", "3"))
+    else:
+        # Gang demand covers ~80% of the expected v5p nodes (25% of
+        # the fleet): the contended regime where whole-node stranding
+        # decides seats — with slack, any order seats everything and
+        # the bench measures nothing.
+        nodes = int(os.environ.get("GANGBENCH_NODES", "400"))
+        gangs = int(os.environ.get("GANGBENCH_GANGS", "20"))
+        gang_size = int(os.environ.get("GANGBENCH_GANG_SIZE", "4"))
+    seed = int(os.environ.get("GANGBENCH_SEED", "20260807"))
+    report = run_phase_a(nodes, seed, gangs, gang_size)
+    report.update(run_phase_b())
+    if args.smoke:
+        _assert_contract(report)
+        _note("smoke contract: packed > first-fit, corridor opened, "
+              "gang seated atomically — all hold")
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -66,6 +66,7 @@ from typing import Dict, List, Optional, Tuple
 from tpu_dra.scheduler.allocator import (
     Candidate,
     CandidateList,
+    hetero_generations,
     parse_slice_counters,
     parse_slice_devices,
     selectors_match,
@@ -106,6 +107,10 @@ class IndexCatalog:
         # compares it against the live generation to detect a fleet
         # mutation mid-solve (see Allocator._class_devices).
         self.generation = generation
+        # Heterogeneous generations (ISSUE 19): gates the packed
+        # order's small-pools-first corridor sort (see
+        # allocator._corridor_buckets / hetero_generations).
+        self.hetero_totals = hetero_generations(devices)
 
 
 class _ParsedSlice:
@@ -278,6 +283,18 @@ class SliceIndex:
         with self._lock:
             indexed = len(self._slices)
             return indexed + len(self._failed), indexed
+
+    def has_pool(self, pool: str) -> bool:
+        """Whether ANY indexed slice still publishes ``pool`` — False
+        after the last slice for a node is DELETED, which is how the
+        scheduler core distinguishes node loss (tear the gang down)
+        from a routine slice update."""
+        with self._lock:
+            return any(
+                any(c.pool == pool for c in ps.devices)
+                or any(k[1] == pool for k in ps.counters)
+                for ps in self._slices.values()
+            )
 
     # --- consumption ---
 

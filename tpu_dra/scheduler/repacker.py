@@ -102,6 +102,7 @@ from tpu_dra.k8sclient import (
     ResourceClient,
 )
 from tpu_dra.scheduler.allocator import Allocator, Unschedulable
+from tpu_dra.scheduler.gang import gang_name
 
 log = logging.getLogger(__name__)
 
@@ -507,14 +508,31 @@ class Repacker:
         frag = self._frag(alloc)
         if self.metrics is not None:
             self.metrics.set_gauge("repacker_frag_score", frag["frag_score"])
-        if frag["frag_score"] <= c.frag_threshold:
+        # Corridor mode (ISSUE 19): while gang members sit pending, the
+        # objective shifts from "reduce stranding" to "open multi-node
+        # corridors" — migrate residents off nearly-free pools so WHOLE
+        # pools come free (a 4-node gang needs 4 empty nodes, a state no
+        # single arrival can create). The per-pool frag score can read
+        # healthy in exactly that state, so corridor mode plans even
+        # below the frag threshold.
+        corridor = any(
+            gang_name(cl) is not None
+            and not (cl.get("status") or {}).get("allocation")
+            and not cl["metadata"].get("deletionTimestamp")
+            for cl in snapshot
+        )
+        if self.metrics is not None:
+            self.metrics.set_gauge(
+                "repacker_corridor_mode", 1 if corridor else 0
+            )
+        if frag["frag_score"] <= c.frag_threshold and not corridor:
             return
         stranded = set()
         for pk in alloc.catalog.peers_by_pool:
             free, best = alloc.pool_stranding(pk)
             if free > 0 and best < free:
                 stranded.add(pk)
-        if not stranded:
+        if not stranded and not corridor:
             return
         occupancy = {}
         if self.utilization is not None:
@@ -534,8 +552,25 @@ class Repacker:
                 continue
             if md.get("deletionTimestamp"):
                 continue
+            # Gang members are PINNED (ISSUE 19): a committed gang's
+            # placement is an all-or-nothing unit — migrating one member
+            # would tear the whole gang down through the scheduler's
+            # broken-gang pre-pass, the exact disruption the repacker
+            # exists to avoid (the Replica.migrating analog, fleet-side).
+            if gang_name(claim) is not None:
+                continue
             keys = _alloc_keys(claim)
-            if not keys or not any((k[0], k[1]) in stranded for k in keys):
+            if not keys:
+                continue
+            touches_stranded = any((k[0], k[1]) in stranded for k in keys)
+            # Corridor candidates: residents of any pool with free room
+            # left — moving the last residents out of nearly-free pools
+            # is what turns "frag-healthy but gang-unschedulable" into
+            # whole free nodes.
+            opens_corridor = corridor and any(
+                alloc.ledger.pool_free((k[0], k[1])) > 0 for k in keys
+            )
+            if not touches_stranded and not opens_corridor:
                 continue
             footprint = sum(
                 d.weight
@@ -568,7 +603,8 @@ class Repacker:
                 self._inc("repacker_disruption_budget_deferred_total")
                 continue
             simulated += 1
-            if self._improves(claim, snapshot, alloc, classes, slices):
+            if self._improves(claim, snapshot, alloc, classes, slices,
+                              corridor=corridor):
                 self._begin(claim, frag["frag_score"])
 
     def _improves(
@@ -578,12 +614,14 @@ class Repacker:
         base: Allocator,
         classes: List[dict],
         slices: Optional[List[dict]],
+        corridor: bool = False,
     ) -> bool:
         """Exact what-if: re-allocate ``claim`` with everything else in
         place; accept only a move that strictly reduces stranding over
-        the affected pools (source + destination). ``classes``/
-        ``slices`` are the plan pass's one-fetch inputs (see
-        _maybe_plan)."""
+        the affected pools (source + destination) — or, in corridor
+        mode, one that concentrates residents without increasing
+        stranding (see below). ``classes``/``slices`` are the plan
+        pass's one-fetch inputs (see _maybe_plan)."""
         uid_key = id(claim)
         others = [c for c in snapshot if id(c) != uid_key]
         sim = self._build_allocator(others, classes, slices)
@@ -609,7 +647,40 @@ class Repacker:
 
         # `sim` holds the post-move state (allocate leaves its takes in
         # the ledger); `base` holds the pre-move state.
-        return stranding(sim) < stranding(base)
+        base_strand = stranding(base)
+        sim_strand = stranding(sim)
+        if sim_strand < base_strand:
+            return True
+        if not corridor or sim_strand > base_strand:
+            return False
+        # Corridor acceptance: stranding no worse AND the move
+        # concentrates usage — more fully-free CAPACITY across the
+        # affected pools (weighted by pool size, so vacating a big v5p
+        # node for an empty small v5e node is an improvement, not a
+        # wash), or (the stepping-stone case) a higher sum-of-squares
+        # of per-pool usage. Moving w chips from a pool at u_s onto one
+        # at u_d raises the sum of squares iff u_d + w > u_s, i.e.
+        # exactly the moves that drain emptier pools into fuller ones.
+        # The pair (free_capacity, ssq) rises lexicographically on
+        # every accepted move and both components are bounded, so a
+        # corridor repack storm terminates.
+
+        def profile(alloc: Allocator) -> Tuple[int, int]:
+            totals = alloc.catalog.pool_totals
+            free_cap = 0
+            ssq = 0
+            for pk in affected:
+                used = alloc.ledger.pool_used(pk)
+                if used == 0:
+                    free_cap += totals.get(pk, 0)
+                ssq += used * used
+            return free_cap, ssq
+
+        base_free, base_ssq = profile(base)
+        sim_free, sim_ssq = profile(sim)
+        return sim_free > base_free or (
+            sim_free == base_free and sim_ssq > base_ssq
+        )
 
     # --- execution --------------------------------------------------------
 

@@ -204,6 +204,26 @@ REPACKER_OLDEST_GAUGE = "repacker_oldest_migration_seconds"
 REPACKER_MIGRATIONS_COUNTER = "repacker_migrations_total"
 REPACKER_STUCK_WARN_SECONDS = 60.0
 
+# Gang-scheduling gauges (ISSUE 19), suffix-matched like the others.
+# gang_members counts claims currently seated (or being committed) as
+# part of an all-or-nothing gang; scheduler_gang_pending is gang-labeled
+# claims awaiting a gang solve; scheduler_gang_wal_oldest_seconds is the
+# age of the OLDEST gang.tpu.google.com/state WAL annotation — the
+# commit protocol holds it only for the duration of one atomic commit,
+# so an old WAL means a scheduler died mid-commit and nothing has run
+# recovery since; scheduler_gang_unschedulable counts gangs the last
+# reconcile pass could not seat. The two failure shapes the doctor
+# catches: a WAL stuck pre-commit past the threshold (members are
+# half-committed and fenced from kubelet prepare until recovery
+# resolves them), and gangs Unschedulable while the fleet's frag score
+# says a corridor-opening repack could seat them.
+GANG_MEMBERS_GAUGE = "gang_members"
+GANG_PENDING_GAUGE = "scheduler_gang_pending"
+GANG_WAL_OLDEST_GAUGE = "scheduler_gang_wal_oldest_seconds"
+GANG_UNSCHED_GAUGE = "scheduler_gang_unschedulable"
+GANG_ROLLBACKS_COUNTER = "gang_partial_rollbacks_total"
+GANG_WAL_STUCK_WARN_SECONDS = 90.0
+
 # Metrics cardinality guard (ISSUE 13), suffix-matched like the others:
 # metrics_series_capped_total{name=} counts writes the registry REFUSED
 # because one metric name hit its per-name label-set cap. Any nonzero
@@ -346,6 +366,9 @@ def probe_metrics(
         repacker = _check_repacker(ep, first, second, warn)
         if repacker:
             report[ep]["repacker"] = repacker
+        gangd = _check_gang(ep, second or first, warn)
+        if gangd:
+            report[ep]["gang"] = gangd
         capped = _check_cardinality(ep, second or first, warn)
         if capped:
             report[ep]["series_capped"] = capped
@@ -521,6 +544,71 @@ def _check_repacker(
             f"claim's repack.tpu.google.com/state annotation phase — "
             f"recovery rolls a stale plan back/forward on the next "
             f"leader (docs/scheduling.md, 'Autonomous repacking')"
+        )
+    return out
+
+
+def _check_gang(
+    ep: str, sample: Dict[str, float], warn
+) -> Dict[str, object]:
+    """Surface gang-scheduling health (ISSUE 19). Two WARN shapes:
+    (a) a gang WAL stuck pre-commit past the threshold — the atomic
+    commit holds the ``gang.tpu.google.com/state`` annotation only for
+    one commit's duration, so an old WAL means a scheduler died
+    mid-protocol and no recovery has resolved the half-committed
+    members (the plugin fences them from prepare until it does);
+    (b) gangs Unschedulable while the fleet's fragmentation score is
+    high — whole-node corridors are exactly what the repacker's
+    corridor mode manufactures, so a stuck gang plus a fragmented
+    fleet means the repacker is absent or idle. Empty dict when the
+    endpoint exports no gang series."""
+    out: Dict[str, object] = {}
+    for series, value in sorted(sample.items()):
+        name = series.split("{", 1)[0]
+        if name.endswith(GANG_PENDING_GAUGE):
+            out["pending"] = int(value)
+        elif name.endswith(GANG_WAL_OLDEST_GAUGE):
+            out["wal_oldest_s"] = value
+        elif name.endswith(GANG_UNSCHED_GAUGE):
+            out["unschedulable"] = int(value)
+        elif name.endswith(GANG_ROLLBACKS_COUNTER):
+            out["partial_rollbacks"] = int(value)
+        elif name.endswith(GANG_MEMBERS_GAUGE):
+            out["members"] = int(value)
+        elif name.endswith(FRAG_GAUGE):
+            out["_frag"] = value
+    frag = out.pop("_frag", 0.0)
+    if not out:
+        return out
+    wal_oldest = out.get("wal_oldest_s", 0.0)
+    if wal_oldest > GANG_WAL_STUCK_WARN_SECONDS:
+        warn(
+            f"{ep}: a gang commit WAL has been outstanding for "
+            f"{wal_oldest:g}s — far past one commit's duration, so a "
+            f"scheduler died mid-protocol and its members are "
+            f"half-committed (the plugin refuses to prepare them until "
+            f"the protocol resolves). Recovery is automatic on the "
+            f"next scheduler start or reconcile pass (rolling_back "
+            f"anywhere -> teardown; all-committed -> roll forward; "
+            f"anything else -> roll back): check that a scheduler is "
+            f"actually running and leading, then the members' "
+            f"gang.tpu.google.com/state annotation phases "
+            f"(docs/scheduling.md, 'Gang scheduling & heterogeneous "
+            f"fleets')"
+        )
+    if out.get("unschedulable", 0) > 0 and frag > FRAG_WARN_THRESHOLD:
+        warn(
+            f"{ep}: {out['unschedulable']} gang(s) are Unschedulable "
+            f"while the fleet fragmentation score is {frag:g} — free "
+            f"capacity exists but no whole-node corridor does, which "
+            f"is the exact state the repacker's corridor mode "
+            f"defragments (it migrates residents off nearly-free pools "
+            f"while gang members sit pending). Check that a repacker "
+            f"is running and leading (repacker_leader), and that the "
+            f"disruption budget is not deferring every candidate "
+            f"(repacker_disruption_budget_deferred_total; "
+            f"docs/scheduling.md, 'Gang scheduling & heterogeneous "
+            f"fleets')"
         )
     return out
 
@@ -1415,6 +1503,22 @@ def render(report: dict) -> str:
             if rep.get("oldest_migration_s", 0.0) > 0:
                 parts.append(f"oldest={rep['oldest_migration_s']:g}s")
             lines.append(f"  repacker: {' '.join(parts)}")
+        gng = m.get("gang") or {}
+        if gng:
+            parts = []
+            if "members" in gng:
+                parts.append(f"members={gng['members']}")
+            if "pending" in gng:
+                parts.append(f"pending={gng['pending']}")
+            if gng.get("unschedulable"):
+                parts.append(f"unschedulable={gng['unschedulable']}")
+            if gng.get("wal_oldest_s", 0.0) > 0:
+                parts.append(f"wal_oldest={gng['wal_oldest_s']:g}s")
+            if gng.get("partial_rollbacks"):
+                parts.append(
+                    f"partial_rollbacks={gng['partial_rollbacks']}"
+                )
+            lines.append(f"  gang: {' '.join(parts)}")
         for series, v in sorted((m.get("series_capped") or {}).items()):
             lines.append(f"  series-capped: {series} = {v:g}")
         wq = m.get("workqueue") or {}
