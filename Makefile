@@ -3,7 +3,7 @@
 IMAGE_REPO ?= registry.local/tpu-dra-driver
 IMAGE_TAG  ?= v0.1.0
 
-.PHONY: all native test test-slow bench decodebench allocbench enginebench specbench shardbench fleetbench fabricbench faultbench disaggbench repackbench gangbench tracecheck slocheck image bats lint lint-fast shlint lockdep lock-graph chaos crashmatrix apisoak ci clean
+.PHONY: all native test test-slow bench decodebench allocbench enginebench specbench shardbench fleetbench fabricbench faultbench disaggbench repackbench gangbench stormbench tracecheck slocheck image bats lint lint-fast shlint lockdep lock-graph chaos crashmatrix apisoak ci clean
 
 all: native test
 
@@ -78,6 +78,14 @@ specbench:
 # BENCH_r*.json (docs/operations.md).
 fleetbench:
 	python -m tpu_dra.tools.fleetsim --smoke
+
+# Wire-honest storm smoke (ISSUE 20): publishers/scheduler/kubelet in
+# real processes over fakeserver HTTP, the mid-storm apiserver restart
+# drill (convergence asserted, recovery p99 recorded), and the
+# node-count cliff ladder with the bottleneck named. The full 5k-node
+# run is `python -m tpu_dra.tools.stormsim` (no --smoke).
+stormbench:
+	python -m tpu_dra.tools.stormsim --smoke
 
 # Serving-fabric CPU smoke (ISSUE 11): small fleet of engine replicas
 # behind the multi-tenant router + claim-driven autoscaler, over the
@@ -304,7 +312,7 @@ lock-graph:
 # (flakes surface in CI, not in the judge's rerun), the 13 bats suites
 # executed against the minicluster, the batsless process-level e2e, and
 # the bench artifact schema gate.
-ci: lint lint-fast shlint lockdep native chaos crashmatrix apisoak decodebench allocbench enginebench specbench shardbench fleetbench fabricbench faultbench disaggbench repackbench gangbench tracecheck slocheck
+ci: lint lint-fast shlint lockdep native chaos crashmatrix apisoak decodebench allocbench enginebench specbench shardbench fleetbench fabricbench faultbench disaggbench repackbench gangbench stormbench tracecheck slocheck
 	python -m pytest tests/ -q -m 'not slow'
 	python -m pytest tests/ -q -m 'not slow'
 	python -m pytest tests/test_chaos.py -q -m slow

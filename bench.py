@@ -646,6 +646,21 @@ def _leg_fleet_main() -> int:
     return fleet_main([])
 
 
+def _leg_storm_main() -> int:
+    """Wire-honest storm leg (ISSUE 20): the fleet re-run with every
+    hop on real HTTP — NodeAgent publishers sharded across worker
+    processes, the scheduler in its own process behind a leader lease,
+    a kubelet analog preparing over the wire — plus the mid-storm
+    apiserver restart drill (convergence asserted, recovery p99
+    measured) and the node-count cliff ladder with the bottleneck
+    named. Smoke scale here; `python -m tpu_dra.tools.stormsim` runs
+    the 5k-node version (methodology: docs/operations.md, 'Apiserver
+    flow control & restart semantics')."""
+    from tpu_dra.tools.stormsim import main as storm_main
+
+    return storm_main(["--smoke"])
+
+
 def _leg_fabric_main() -> int:
     """Serving-fabric leg (ISSUE 11): the tier above the engine —
     multi-tenant router (token-WFQ + SLO-class admission + affinity),
@@ -1626,6 +1641,8 @@ def main() -> int:
         return _leg_serve_main()
     if "--leg-fleet" in sys.argv:
         return _leg_fleet_main()
+    if "--leg-storm" in sys.argv:
+        return _leg_storm_main()
     if "--leg-fabric" in sys.argv:
         return _leg_fabric_main()
     if "--leg-fault" in sys.argv:
@@ -1806,6 +1823,19 @@ def main() -> int:
         f"{gang['gang_seated_firstfit']} gangs seated); corridor "
         f"{gang['gang_corridor_nodes']} nodes opened in "
         f"{gang['gang_repack_migrations']} migrations",
+        file=sys.stderr,
+    )
+
+    storm = _run_leg({}, flag="--leg-storm")
+    print(
+        f"storm ({storm['fleet_wire_nodes']} nodes over the wire, "
+        f"{storm['fleet_wire_claims']} claims): claim-ready p50 "
+        f"{storm['fleet_wire_claim_ready_p50_ms']} ms p99 "
+        f"{storm['fleet_wire_claim_ready_p99_ms']} ms "
+        f"(+{storm['fleet_wire_vs_inproc_p99_pct']}% vs in-process); "
+        f"restart recovery p99 {storm['storm_recovery_p99_ms']} ms; "
+        f"cliff at {storm['fleet_wire_cliff_nodes']} nodes "
+        f"({storm['fleet_wire_cliff_bottleneck']})",
         file=sys.stderr,
     )
 
@@ -2326,6 +2356,29 @@ def main() -> int:
                 "gang_repack_migrations": gang[
                     "gang_repack_migrations"
                 ],
+                # Wire-honest storm leg (ISSUE 20): every hop on real
+                # HTTP, the mid-storm apiserver restart drill, and the
+                # node-count cliff with its bottleneck named.
+                "fleet_wire_nodes": storm["fleet_wire_nodes"],
+                "fleet_wire_claims": storm["fleet_wire_claims"],
+                "fleet_wire_claim_ready_p50_ms": storm[
+                    "fleet_wire_claim_ready_p50_ms"
+                ],
+                "fleet_wire_claim_ready_p99_ms": storm[
+                    "fleet_wire_claim_ready_p99_ms"
+                ],
+                "fleet_wire_vs_inproc_p99_pct": storm[
+                    "fleet_wire_vs_inproc_p99_pct"
+                ],
+                "fleet_wire_cliff_nodes": storm[
+                    "fleet_wire_cliff_nodes"
+                ],
+                "fleet_wire_cliff_bottleneck": storm[
+                    "fleet_wire_cliff_bottleneck"
+                ],
+                "storm_recovery_p99_ms": storm["storm_recovery_p99_ms"],
+                "storm_restarts": storm["storm_restarts"],
+                "storm_flow_rejected": storm["storm_flow_rejected"],
             }
         )
     )

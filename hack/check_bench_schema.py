@@ -231,6 +231,23 @@ TYPED_WHEN_PRESENT = {
     "gang_seated_firstfit": int,
     "gang_corridor_nodes": int,
     "gang_repack_migrations": int,
+    # Wire-honest storm leg (ISSUE 20): over-the-wire claim-ready
+    # percentiles, the wire-vs-in-process delta, the mid-storm restart
+    # drill's recovery p99, and the named node-count cliff. The B100
+    # pass forward-requires fleet_wire_nodes /
+    # fleet_wire_claim_ready_p99_ms / fleet_wire_vs_inproc_p99_pct /
+    # fleet_wire_cliff_nodes / fleet_wire_cliff_bottleneck /
+    # storm_recovery_p99_ms.
+    "fleet_wire_nodes": int,
+    "fleet_wire_claims": int,
+    "fleet_wire_claim_ready_p50_ms": (int, float),
+    "fleet_wire_claim_ready_p99_ms": (int, float),
+    "fleet_wire_vs_inproc_p99_pct": (int, float),
+    "fleet_wire_cliff_nodes": int,
+    "fleet_wire_cliff_bottleneck": str,
+    "storm_recovery_p99_ms": (int, float),
+    "storm_restarts": int,
+    "storm_flow_rejected": dict,
 }
 
 

@@ -126,6 +126,18 @@ REQUIRED_STATIC = (
     "gang_util_firstfit",
     "gang_corridor_nodes",
     "gang_repack_migrations",
+    # Wire-honest storm leg (ISSUE 20): the over-the-wire claim-ready
+    # percentiles with the in-process delta (the honesty gap itself is
+    # the headline), the mid-storm restart drill's recovery p99, and
+    # the node-count cliff with its bottleneck named — dropping any of
+    # them would blind the robustness regression tripwire before its
+    # first recorded artifact.
+    "fleet_wire_nodes",
+    "fleet_wire_claim_ready_p99_ms",
+    "fleet_wire_vs_inproc_p99_pct",
+    "fleet_wire_cliff_nodes",
+    "fleet_wire_cliff_bottleneck",
+    "storm_recovery_p99_ms",
 )
 
 

@@ -1,0 +1,41 @@
+"""The mid-storm apiserver restart drill, compact (ISSUE 20).
+
+`make stormbench` runs the full smoke; this e2e keeps a tight version
+of the same convergence contract in tier-1: NodeAgent publishers in
+real worker processes, the scheduler in its own process holding a
+leader lease, a kubelet worker preparing over the wire — and the
+apiserver restarting UNDERNEATH the open submission loop. The drill
+asserts what `run_storm_leg` asserts: every claim converges to exactly
+one allocation, no device is double-allocated across the restart, no
+gang/repack WAL annotation survives, and the leader lease is re-renewed
+on the far side.
+"""
+
+from tpu_dra.tools.stormsim import run_storm_leg
+
+
+def test_wire_storm_converges_through_apiserver_restart():
+    report = run_storm_leg(
+        nodes=12,
+        claims=10,
+        rate=120.0,
+        seed=20260807,
+        workers=2,
+        prepare_ms=1.0,
+        outage_s=0.4,
+        gangs=1,
+        gang_size=2,
+        flap_tick=0.2,
+        flap_frac=0.05,
+        smoke=True,
+    )
+    # Convergence itself is asserted inside the leg; the report must
+    # additionally carry the headline observables stormbench publishes.
+    assert report["storm_restarts"] == 1
+    assert report["fleet_wire_claims"] == 12  # 10 + one 2-member gang
+    assert report["fleet_wire_claim_ready_p99_ms"] > 0
+    assert report["storm_recovery_claims"] > 0
+    assert report["storm_recovery_p99_ms"] > 0
+    assert set(report["storm_flow_rejected"]) == {
+        "system-leader", "claim-status", "workload", "slice-publish",
+    }

@@ -1397,3 +1397,164 @@ def test_metrics_probe_gangless_endpoint_has_no_gang_section(tmp_path):
         assert "gang:" not in render(report)
     finally:
         srv.stop()
+
+
+# --- apiserver flow control + retry budget (ISSUE 20) -----------------------
+
+
+def _flow_probe(tmp_path, lib, endpoint, interval=None):
+    return collect(
+        str(tmp_path / "data"), str(tmp_path / "cdi"),
+        str(tmp_path / "mux"), tpulib=lib,
+        metrics_endpoints=[endpoint],
+        **({"metrics_interval": interval} if interval else {}),
+    )
+
+
+def test_metrics_probe_quiet_when_nothing_ever_shed(tmp_path):
+    """A fleet that has never shed exports no rejected series: no
+    'apiflow:' section, no warnings — silence is the healthy signal."""
+    from tpu_dra.infra.metrics import Metrics, MetricsServer
+
+    metrics = Metrics()
+    metrics.inc(
+        "apiserver_flow_admitted_total", labels={"flow": "workload"}
+    )
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        endpoint = f"127.0.0.1:{srv.port}"
+        report = _flow_probe(tmp_path, lib, endpoint)
+        assert "apiflow" not in report["metrics"][endpoint]
+        assert "apiflow:" not in render(report)
+        assert not any("SHEDDING" in w for w in report["warnings"])
+    finally:
+        srv.stop()
+
+
+def test_metrics_probe_warns_on_active_flow_shedding(tmp_path):
+    """A rejected counter still CLIMBING across the probe interval is a
+    live brownout: doctor names the flow and says it is being shed
+    RIGHT NOW."""
+    import threading
+    import time
+
+    from tpu_dra.infra.metrics import Metrics, MetricsServer
+
+    metrics = Metrics()
+    metrics.inc(
+        "apiserver_flow_rejected_total",
+        labels={"flow": "slice-publish"}, value=10,
+    )
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    stop = threading.Event()
+
+    def keep_shedding():
+        while not stop.wait(0.05):
+            metrics.inc(
+                "apiserver_flow_rejected_total",
+                labels={"flow": "slice-publish"},
+            )
+
+    t = threading.Thread(target=keep_shedding, daemon=True)
+    t.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        endpoint = f"127.0.0.1:{srv.port}"
+        report = _flow_probe(tmp_path, lib, endpoint, interval=0.4)
+        assert any(
+            "SHEDDING" in w and "slice-publish" in w
+            for w in report["warnings"]
+        ), report["warnings"]
+        out = render(report)
+        assert "apiflow:" in out and "slice-publish" in out
+    finally:
+        stop.set()
+        t.join(timeout=5)
+        srv.stop()
+
+
+def test_metrics_probe_past_brownout_is_history_not_a_page(tmp_path):
+    """A nonzero-but-static rejected counter across two samples is a
+    past brownout: the totals still render (the operator can see the
+    history) but no warning fires."""
+    from tpu_dra.infra.metrics import Metrics, MetricsServer
+
+    metrics = Metrics()
+    metrics.inc(
+        "apiserver_flow_rejected_total",
+        labels={"flow": "slice-publish"}, value=44,
+    )
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        endpoint = f"127.0.0.1:{srv.port}"
+        report = _flow_probe(tmp_path, lib, endpoint, interval=0.3)
+        apiflow = report["metrics"][endpoint]["apiflow"]
+        assert apiflow["rejected"]["slice-publish"]["rejected"] == 44.0
+        assert not any(
+            "SHEDDING" in w for w in report["warnings"]
+        ), report["warnings"]
+    finally:
+        srv.stop()
+
+
+def test_metrics_probe_single_sample_shed_asks_for_reprobe(tmp_path):
+    """One sample cannot tell live shedding from history: doctor flags
+    the total and asks for a --metrics-interval re-probe."""
+    from tpu_dra.infra.metrics import Metrics, MetricsServer
+
+    metrics = Metrics()
+    metrics.inc(
+        "apiserver_flow_rejected_total",
+        labels={"flow": "claim-status"}, value=3,
+    )
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        endpoint = f"127.0.0.1:{srv.port}"
+        report = _flow_probe(tmp_path, lib, endpoint)
+        assert any(
+            "--metrics-interval" in w and "claim-status" in w
+            for w in report["warnings"]
+        ), report["warnings"]
+    finally:
+        srv.stop()
+
+
+def test_metrics_probe_warns_on_burning_retry_budget(tmp_path):
+    """api_retry_budget_exhausted_total climbing across the interval:
+    the process is refusing its own retries — doctor says so and
+    points at the apiserver-side pressure first."""
+    import threading
+
+    from tpu_dra.infra.metrics import Metrics, MetricsServer
+
+    metrics = Metrics()
+    metrics.inc("api_retry_budget_exhausted_total", value=5)
+    srv = MetricsServer(metrics, port=0, address="127.0.0.1")
+    srv.start()
+    stop = threading.Event()
+
+    def keep_burning():
+        while not stop.wait(0.05):
+            metrics.inc("api_retry_budget_exhausted_total")
+
+    t = threading.Thread(target=keep_burning, daemon=True)
+    t.start()
+    try:
+        _s, lib = make_state(tmp_path)
+        endpoint = f"127.0.0.1:{srv.port}"
+        report = _flow_probe(tmp_path, lib, endpoint, interval=0.4)
+        assert any(
+            "retry budget is EXHAUSTED" in w for w in report["warnings"]
+        ), report["warnings"]
+        assert "budget-exhausted" in render(report)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+        srv.stop()

@@ -39,7 +39,10 @@ Schedule JSON format (``*.chaos.json``)::
         {"at": 3.2, "kind": "replica_crash", "replica_index": 1},
         {"at": 3.5, "kind": "replica_stall", "replica_index": 0},
         {"at": 3.8, "kind": "replica_crash_loop", "replica_index": 2,
-         "count": 3}
+         "count": 3},
+        {"at": 4.0, "kind": "apiserver_restart", "outage": 0.5},
+        {"at": 4.5, "kind": "apiserver_brownout", "concurrency": 2,
+         "duration": 1.0}
       ]
     }
 
@@ -98,6 +101,15 @@ REPLICA_CRASH_LOOP = "replica_crash_loop"  # serving fabric: re-crash
 #   the replica on every re-bind, params["count"] times total — drives
 #   the circuit breaker open and the autoscaler's claim replacement.
 
+APISERVER_RESTART = "apiserver_restart"  # full process restart (ISSUE
+#   20): FakeApiServer.restart — state snapshot/restore, every watch
+#   dropped, resourceVersions advanced past the event window (410 on
+#   resume -> relist), the port dark for params["outage"] seconds.
+APISERVER_BROWNOUT = "apiserver_brownout"  # flow-control squeeze: the
+#   live server's APF concurrency drops to params["concurrency"] for
+#   params["duration"] seconds, shedding low-share flows with 429 —
+#   the sustained-overload regime, vs apiserver_throttle's burst.
+
 # Serving-layer kinds target the fabric harness (faultbench), not the
 # control-plane soaks; they are EXCLUDED from from_seed's default
 # population so adding them did not change what any existing seed
@@ -106,11 +118,20 @@ SERVING_FAULT_KINDS = frozenset({
     REPLICA_CRASH, REPLICA_STALL, REPLICA_CRASH_LOOP,
 })
 
+# Control-plane recovery kinds (ISSUE 20) are likewise opt-in: a full
+# apiserver restart or brownout inside the long-standing chip-flap
+# soaks would change what every existing seed generates AND what those
+# soaks assert (they converge through weather, not through relists).
+# The storm drills pass these via ``kinds`` explicitly.
+CONTROL_PLANE_FAULT_KINDS = frozenset({
+    APISERVER_RESTART, APISERVER_BROWNOUT,
+})
+
 FAULT_KINDS = frozenset({
     CHIP_DOWN, CHIP_UP, APISERVER_THROTTLE, APISERVER_ERRORS,
     WATCH_DROP, PLUGIN_CRASH, CLIENT_DEATH, CRASH,
     API_PARTITION, API_LATENCY,
-}) | SERVING_FAULT_KINDS
+}) | SERVING_FAULT_KINDS | CONTROL_PLANE_FAULT_KINDS
 
 
 def _positive_number(v: object) -> bool:
@@ -158,6 +179,17 @@ _REQUIRED_PARAMS: Dict[str, Dict[str, Callable[[object], bool]]] = {
         # one-off crash the re-bind path absorbs.
         "count": lambda v: isinstance(v, int)
         and not isinstance(v, bool) and v >= 2,
+    },
+    APISERVER_RESTART: {
+        # A zero-length outage is a valid drill (watch-cache loss with
+        # no dark window), so only the presence of a number is checked.
+        "outage": lambda v: isinstance(v, (int, float))
+        and not isinstance(v, bool) and v >= 0,
+    },
+    APISERVER_BROWNOUT: {
+        "concurrency": lambda v: isinstance(v, int)
+        and not isinstance(v, bool) and v >= 1,
+        "duration": _positive_number,
     },
 }
 
@@ -336,7 +368,10 @@ class FaultSchedule:
         # soaks. ``replicas`` bounds their replica_index.
         kinds = list(
             kinds
-            or sorted(FAULT_KINDS - {CHIP_UP} - SERVING_FAULT_KINDS)
+            or sorted(
+                FAULT_KINDS - {CHIP_UP} - SERVING_FAULT_KINDS
+                - CONTROL_PLANE_FAULT_KINDS
+            )
         )
         # Chip flaps are the fault the remediation pipeline exists for:
         # weight them so every non-trivial schedule exercises that path.
@@ -412,6 +447,18 @@ class FaultSchedule:
                 events.append(FaultEvent(at, kind, {
                     "replica_index": rng.randrange(max(1, replicas)),
                     "count": rng.randint(2, 4),
+                }))
+            elif kind == APISERVER_RESTART:
+                # Dark windows sized to the transport's connection
+                # backoff ladder (0.2..3.2s): every refused dial-in
+                # retries through within the drill.
+                events.append(FaultEvent(at, kind, {
+                    "outage": round(rng.uniform(0.2, 1.0), 3),
+                }))
+            elif kind == APISERVER_BROWNOUT:
+                events.append(FaultEvent(at, kind, {
+                    "concurrency": rng.randint(1, 4),
+                    "duration": round(rng.uniform(0.5, 2.0), 3),
                 }))
             else:  # watch_drop / plugin_crash / client_death
                 events.append(FaultEvent(at, kind, {}))

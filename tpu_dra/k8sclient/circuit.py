@@ -262,6 +262,108 @@ class CircuitBreaker:
             self._notify(*t)
 
 
+class RetryBudget:
+    """Shared per-process retry-token bucket (client-go's
+    ``--retry-budget`` analog, the resilience4j "retry budget" pattern):
+    every retry SLEEP the transport is about to take costs one token;
+    when the bucket is empty the request fails over to its caller
+    instead of retrying. Motivation (ISSUE 20): under an apiserver
+    brownout every component in the process starts retrying at once —
+    429-directed waits, connection backoffs, 5xx backoffs — and without
+    a shared ceiling the retry traffic itself becomes the storm that
+    keeps the server brown. One bucket per process bounds the total
+    retry amplification no matter how many KubeClients or threads share
+    it; first-attempt traffic is never charged.
+
+    Sized generously (capacity 256, refill 32/s by default): routine
+    weather — a handful of components riding a few seconds of 5xx —
+    never exhausts it. Only a sustained many-caller storm does, which
+    is exactly when shedding load client-side is correct. Tunable via
+    ``TPU_DRA_RETRY_BUDGET_CAPACITY`` / ``TPU_DRA_RETRY_BUDGET_REFILL``
+    (storm harnesses tighten it to prove the failover edge).
+    """
+
+    DEFAULT_CAPACITY = 256.0
+    DEFAULT_REFILL_PER_SECOND = 32.0
+
+    def __init__(
+        self,
+        capacity: float = DEFAULT_CAPACITY,
+        refill_per_second: float = DEFAULT_REFILL_PER_SECOND,
+        clock=time.monotonic,
+    ):
+        self.capacity = float(capacity)
+        self.refill_per_second = float(refill_per_second)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._tokens = self.capacity
+        self._last = clock()
+        self.exhausted_total = 0
+
+    def _refill_locked(self) -> None:
+        now = self._clock()
+        self._tokens = min(
+            self.capacity,
+            self._tokens + (now - self._last) * self.refill_per_second,
+        )
+        self._last = now
+
+    def try_spend(self, cost: float = 1.0) -> bool:
+        """Charge one retry against the budget. False means the budget
+        is exhausted and the caller must NOT retry — fail the request
+        through to its own caller instead."""
+        with self._lock:
+            self._refill_locked()
+            if self._tokens >= cost:
+                self._tokens -= cost
+                return True
+            self.exhausted_total += 1
+            return False
+
+    def tokens(self) -> float:
+        with self._lock:
+            self._refill_locked()
+            return self._tokens
+
+    def reset(self) -> None:
+        with self._lock:
+            self._tokens = self.capacity
+            self._last = self._clock()
+            self.exhausted_total = 0
+
+
+_PROCESS_RETRY_BUDGET: Optional[RetryBudget] = None
+_PROCESS_RETRY_BUDGET_LOCK = threading.Lock()
+
+
+def process_retry_budget() -> RetryBudget:
+    """The per-process shared bucket every KubeClient charges retries
+    against (see :class:`RetryBudget`). Env-tunable at first use."""
+    global _PROCESS_RETRY_BUDGET
+    with _PROCESS_RETRY_BUDGET_LOCK:
+        if _PROCESS_RETRY_BUDGET is None:
+            import os
+
+            _PROCESS_RETRY_BUDGET = RetryBudget(
+                capacity=float(os.environ.get(
+                    "TPU_DRA_RETRY_BUDGET_CAPACITY",
+                    RetryBudget.DEFAULT_CAPACITY,
+                )),
+                refill_per_second=float(os.environ.get(
+                    "TPU_DRA_RETRY_BUDGET_REFILL",
+                    RetryBudget.DEFAULT_REFILL_PER_SECOND,
+                )),
+            )
+        return _PROCESS_RETRY_BUDGET
+
+
+def reset_process_retry_budget() -> None:
+    """Drop the process singleton (tests re-read the env knobs)."""
+    global _PROCESS_RETRY_BUDGET
+    with _PROCESS_RETRY_BUDGET_LOCK:
+        _PROCESS_RETRY_BUDGET = None
+
+
 def circuit_of(backend) -> Optional[CircuitBreaker]:
     """The backend's breaker, if the transport carries one (the
     in-memory FakeCluster does not — unit tests run undegradable)."""

@@ -131,6 +131,12 @@ class FakeCluster(Backend):
         self._event_log: "deque[Tuple[int, ResourceDescriptor, str, dict]]" = (
             deque(maxlen=window)
         )
+        # Compaction horizon: watch/continue resumes below this RV get
+        # 410 Gone even though the event log is empty. A server restart
+        # (FakeApiServer.restart -> restore) raises it so every
+        # pre-restart resume relists, like a real apiserver losing its
+        # watch cache across a restart.
+        self._compacted_below = 0
 
     # --- seeding (subprocess e2e / demo path) ---
 
@@ -256,14 +262,15 @@ class FakeCluster(Backend):
                     f"invalid continue token: {e}", status=400
                 )
             with self._lock:
-                if (
+                if token_rv < self._compacted_below or (
                     self._event_log
                     and len(self._event_log) == self._event_log.maxlen
                     and token_rv < self._event_log[0][0] - 1
                 ):
                     raise ApiGone(
                         f"continue token resourceVersion {token_rv} is too "
-                        f"old (oldest retained: {self._event_log[0][0]})"
+                        f"old (compacted below "
+                        f"{max(self._compacted_below, self._event_log[0][0] if self._event_log else 0)})"
                     )
         if limit is not None and limit <= 0:
             limit = None  # limit=0 is "unlimited" on a real apiserver
@@ -469,21 +476,51 @@ class FakeCluster(Backend):
                     ) from e
                 # The requested horizon must still be inside the retained
                 # window — UNLESS nothing was ever dropped (log shorter
-                # than its bound covers everything since rv 0).
-                if (
+                # than its bound covers everything since rv 0). A restart
+                # compaction (_compacted_below) invalidates older RVs
+                # unconditionally.
+                if from_rv < self._compacted_below or (
                     self._event_log
                     and len(self._event_log) == self._event_log.maxlen
                     and from_rv < self._event_log[0][0] - 1
                 ):
                     raise ApiGone(
                         f"resourceVersion {from_rv} is too old "
-                        f"(oldest retained: {self._event_log[0][0]})"
+                        f"(compacted below "
+                        f"{max(self._compacted_below, self._event_log[0][0] if self._event_log else 0)})"
                     )
                 for ev_rv, ev_rd, event, obj in self._event_log:
                     if ev_rv > from_rv and w.matches(ev_rd, obj):
                         w.q.put((event, copy.deepcopy(obj)))
             self._watches.append(w)
         return w
+
+    # --- restart semantics (FakeApiServer.restart) ---
+
+    def snapshot(self) -> dict:
+        """Deep-copied store state (an etcd snapshot analog): everything
+        :meth:`restore` needs to bring an identical cluster back after a
+        simulated apiserver restart."""
+        with self._lock:
+            return {
+                "objs": copy.deepcopy(self._objs),
+                "rv": self._rv,
+            }
+
+    def restore(self, snap: dict, rv_skip: int = 1000) -> None:
+        """Reload a :meth:`snapshot` with restart semantics: objects and
+        uids survive byte-identical, but the resourceVersion counter
+        jumps ``rv_skip`` ahead and the watch-event history is compacted
+        away — every watch (or continue token) resuming from a
+        pre-restart RV answers 410 Gone and must relist, and all open
+        watches are dropped. This is the contract informers must survive
+        when a real apiserver restarts."""
+        with self._lock:
+            self._objs = copy.deepcopy(snap["objs"])
+            self._rv = int(snap["rv"]) + int(rv_skip)
+            self._event_log.clear()
+            self._compacted_below = self._rv
+        self.clear_watches()
 
     # --- test conveniences ---
 

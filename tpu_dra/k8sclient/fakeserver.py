@@ -26,6 +26,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlsplit
 
+from collections import deque
+
+from tpu_dra.infra.metrics import Metrics
 from tpu_dra.k8sclient.authz import (
     AdmissionDenied,
     Authorizer,
@@ -38,6 +41,7 @@ from tpu_dra.k8sclient.resources import (
     ResourceDescriptor,
     iter_descriptors,
 )
+from tpu_dra.k8sclient.rest import FLOW_HEADER
 
 log = logging.getLogger(__name__)
 
@@ -76,15 +80,279 @@ def _parse_selector(qs: Dict[str, List[str]], key: str) -> Optional[Dict[str, st
     return out
 
 
+class FlowSpec:
+    """One flow's fair-queuing configuration: ``shares`` is the flow's
+    weight in the virtual-finish-time schedule (the real APF's nominal
+    concurrency share), ``queue_depth`` the per-flow bound past which
+    arrivals are shed immediately (queueLengthLimit)."""
+
+    __slots__ = ("name", "shares", "queue_depth")
+
+    def __init__(self, name: str, shares: float, queue_depth: int):
+        self.name = name
+        self.shares = float(shares)
+        self.queue_depth = int(queue_depth)
+
+
+DEFAULT_FLOW = "workload"
+
+# The fleet's flow table (rest.flow_of stamps the matching header):
+# leader-lease renewals above everything — deposing a healthy leader
+# because 5k kubelets published inventory is the failure mode APF
+# exists to rule out — claim/allocation writes next, reads and
+# unclassified traffic in the middle, slice publishes last.
+DEFAULT_FLOWS = (
+    FlowSpec("system-leader", shares=8.0, queue_depth=128),
+    FlowSpec("claim-status", shares=6.0, queue_depth=256),
+    FlowSpec(DEFAULT_FLOW, shares=4.0, queue_depth=256),
+    FlowSpec("slice-publish", shares=1.0, queue_depth=128),
+)
+
+_QUEUED, _GRANTED, _CANCELLED = "queued", "granted", "cancelled"
+
+
+class _Ticket:
+    __slots__ = ("vft", "state")
+
+    def __init__(self, vft: float):
+        self.vft = vft
+        self.state = _QUEUED
+
+
+class _FlowState:
+    __slots__ = ("spec", "queue", "last_vft", "inflight",
+                 "admitted", "rejected")
+
+    def __init__(self, spec: FlowSpec):
+        self.spec = spec
+        self.queue: deque = deque()
+        self.last_vft = 0.0
+        self.inflight = 0
+        self.admitted = 0
+        self.rejected = 0
+
+
+class FlowControl:
+    """API Priority and Fairness analog: weighted fair queuing over
+    flow identities with bounded concurrency and bounded queues.
+
+    Every non-long-running request acquires a seat before it is routed.
+    When all ``concurrency`` seats are busy, the request queues in its
+    flow; seats are granted in virtual-finish-time order — each request
+    in a flow with weight ``shares`` costs ``1/shares`` of virtual
+    time, so over any contended window flows progress in proportion to
+    their shares regardless of arrival rates. Overflow (queue at depth,
+    or a ticket aging past ``max_queue_seconds``) is shed with 429 +
+    Retry-After, which the client transport's 429 loop and circuit
+    breaker already honor. Shedding is therefore flow-ordered by
+    construction: a saturating low-share storm fills its own queue and
+    rejects while high-share flows still clear.
+
+    Watches (long-running) and the ``/_*`` control endpoints bypass the
+    filter, as the real APF exempts long-running requests.
+
+    Per-flow inflight/queued gauges and admitted/rejected counters are
+    exported on the attached registry (served at ``GET /metrics``) for
+    fleetmon, and snapshotted into ``/_stats`` under ``"apf"``.
+    """
+
+    def __init__(
+        self,
+        concurrency: int = 64,
+        flows: Optional[Tuple[FlowSpec, ...]] = None,
+        max_queue_seconds: float = 15.0,
+        retry_after_seconds: float = 1.0,
+        metrics: Optional[Metrics] = None,
+        clock=time.monotonic,
+    ):
+        self._cond = threading.Condition()
+        self.concurrency = int(concurrency)
+        self.max_queue_seconds = float(max_queue_seconds)
+        self.retry_after_seconds = float(retry_after_seconds)
+        self.metrics = metrics
+        self._clock = clock
+        self._inflight = 0
+        self._vtime = 0.0
+        # Copy the specs: configure() retunes them in place, and a
+        # brownout drill's squeeze on one server must not leak into the
+        # module-level default table (or any other live server).
+        self._flows: Dict[str, _FlowState] = {
+            spec.name: _FlowState(
+                FlowSpec(spec.name, spec.shares, spec.queue_depth)
+            )
+            for spec in (flows or DEFAULT_FLOWS)
+        }
+        self._default = (
+            DEFAULT_FLOW if DEFAULT_FLOW in self._flows
+            else next(iter(self._flows))
+        )
+        if metrics is not None:
+            for st in self._flows.values():
+                self._export_locked(st)
+
+    def canonical(self, flow: str) -> str:
+        """Map a request's flow header to a configured flow (unknown or
+        absent identities land in the default flow, like APF's
+        catch-all FlowSchema)."""
+        return flow if flow in self._flows else self._default
+
+    def _export_locked(self, st: _FlowState) -> None:
+        if self.metrics is None:
+            return
+        labels = {"flow": st.spec.name}
+        self.metrics.set_gauge(
+            "apiserver_flow_inflight", st.inflight, labels=labels
+        )
+        self.metrics.set_gauge(
+            "apiserver_flow_queued", len(st.queue), labels=labels
+        )
+
+    def _dispatch_locked(self) -> None:
+        granted = False
+        while self._inflight < self.concurrency:
+            best: Optional[_FlowState] = None
+            for st in self._flows.values():
+                if st.queue and (
+                    best is None or st.queue[0].vft < best.queue[0].vft
+                ):
+                    best = st
+            if best is None:
+                break
+            t = best.queue.popleft()
+            t.state = _GRANTED
+            self._vtime = t.vft
+            self._inflight += 1
+            best.inflight += 1
+            granted = True
+            self._export_locked(best)
+        if granted:
+            self._cond.notify_all()
+
+    def _reject_locked(self, st: _FlowState) -> Tuple[None, float]:
+        st.rejected += 1
+        if self.metrics is not None:
+            self.metrics.inc(
+                "apiserver_flow_rejected_total",
+                labels={"flow": st.spec.name},
+            )
+        self._export_locked(st)
+        return None, self.retry_after_seconds
+
+    def acquire(self, flow: str) -> Tuple[Optional[str], float]:
+        """Admit a request: returns ``(canonical_flow, 0.0)`` once a
+        seat is held (the caller MUST :meth:`release` it), or
+        ``(None, retry_after)`` when the request is shed."""
+        name = self.canonical(flow)
+        wait_deadline = self._clock() + self.max_queue_seconds
+        with self._cond:
+            st = self._flows[name]
+            if len(st.queue) >= st.spec.queue_depth:
+                return self._reject_locked(st)
+            t = _Ticket(max(self._vtime, st.last_vft) + 1.0 / st.spec.shares)
+            st.last_vft = t.vft
+            st.queue.append(t)
+            self._export_locked(st)
+            self._dispatch_locked()
+            while t.state == _QUEUED:
+                rem = wait_deadline - self._clock()
+                if rem <= 0:
+                    t.state = _CANCELLED
+                    try:
+                        st.queue.remove(t)
+                    except ValueError:
+                        pass
+                    return self._reject_locked(st)
+                self._cond.wait(rem)
+            if t.state is not _GRANTED:  # flushed by a server restart
+                return self._reject_locked(st)
+            st.admitted += 1
+            if self.metrics is not None:
+                self.metrics.inc(
+                    "apiserver_flow_admitted_total",
+                    labels={"flow": st.spec.name},
+                )
+            return name, 0.0
+
+    def release(self, flow: str) -> None:
+        with self._cond:
+            st = self._flows.get(self.canonical(flow))
+            self._inflight = max(0, self._inflight - 1)
+            if st is not None:
+                st.inflight = max(0, st.inflight - 1)
+                self._export_locked(st)
+            self._dispatch_locked()
+
+    def flush(self) -> None:
+        """Cancel every queued ticket and wake its waiter (server
+        restart: the listening socket is gone, so queued requests
+        answer 429 to their — likely already dead — connections)."""
+        with self._cond:
+            for st in self._flows.values():
+                for t in st.queue:
+                    t.state = _CANCELLED
+                st.queue.clear()
+                self._export_locked(st)
+            self._cond.notify_all()
+
+    def configure(
+        self,
+        concurrency: Optional[int] = None,
+        max_queue_seconds: Optional[float] = None,
+        shares: Optional[Dict[str, float]] = None,
+        queue_depth: Optional[Dict[str, int]] = None,
+    ) -> None:
+        """Retune a LIVE server (brownout drills squeeze concurrency on
+        a serving fleet; widening a flow's share is the doctor's
+        remediation for sustained shedding)."""
+        with self._cond:
+            if concurrency is not None:
+                self.concurrency = int(concurrency)
+            if max_queue_seconds is not None:
+                self.max_queue_seconds = float(max_queue_seconds)
+            for name, value in (shares or {}).items():
+                if name in self._flows:
+                    self._flows[name].spec.shares = float(value)
+            for name, value in (queue_depth or {}).items():
+                if name in self._flows:
+                    self._flows[name].spec.queue_depth = int(value)
+            self._dispatch_locked()
+
+    def stats(self) -> Dict[str, dict]:
+        with self._cond:
+            return {
+                name: {
+                    "shares": st.spec.shares,
+                    "inflight": st.inflight,
+                    "queued": len(st.queue),
+                    "admitted": st.admitted,
+                    "rejected": st.rejected,
+                }
+                for name, st in self._flows.items()
+            }
+
+
 class FakeApiServer:
     """ThreadingHTTPServer wrapper; one shared FakeCluster behind it."""
 
     def __init__(self, cluster: Optional[FakeCluster] = None,
                  port: int = 0, address: str = "127.0.0.1",
                  enforce_rbac: bool = False,
-                 watch_heartbeat_seconds: float = WATCH_HEARTBEAT_SECONDS):
+                 watch_heartbeat_seconds: float = WATCH_HEARTBEAT_SECONDS,
+                 flow_control: Optional[FlowControl] = None,
+                 metrics: Optional[Metrics] = None):
         self.cluster = cluster or FakeCluster()
         self._heartbeat = watch_heartbeat_seconds
+        # Server-side observability registry, served at GET /metrics so
+        # fleetmon/doctor scrape the apiserver like any other component.
+        self.metrics = metrics or Metrics()
+        # Priority-and-fairness admission (ISSUE 20). Always on, like
+        # the real apiserver's APF — the defaults are generous enough
+        # that an uncontended harness never queues; storm drills pass a
+        # tight FlowControl (or configure() a live one) to force the
+        # shedding edge.
+        self.flow = flow_control or FlowControl(metrics=self.metrics)
+        if self.flow.metrics is None:
+            self.flow.metrics = self.metrics
         # Admission (stored ValidatingWebhookConfigurations + the
         # resourceslices node-restriction policy) is ALWAYS active, like a
         # real apiserver — it simply no-ops until such objects are
@@ -128,6 +396,7 @@ class FakeApiServer:
         self._stats = {
             "lists": 0, "watches": 0, "throttled": 0, "bookmarks": 0,
             "failed": 0, "watch_drops": 0, "partitioned": 0, "delayed": 0,
+            "flow_rejected": 0, "restarts": 0,
         }
         outer = self
 
@@ -262,8 +531,8 @@ class FakeApiServer:
                 budgeted client hits its read timeout mid-hold, which
                 is the behavior deadline budgets exist for — then
                 answers 503 so a still-waiting unbudgeted client sees
-                an error, not silence forever. Injected latency delays
-                the request, then lets it proceed normally."""
+                an error, not silence forever. (Injected latency is
+                spent later, inside the flow seat — _seat_latency.)"""
                 held = False
                 while True:
                     with outer._fault_lock:
@@ -287,6 +556,15 @@ class FakeApiServer:
                     # client side has likely timed out and gone away.
                     self.close_connection = True
                     return True
+                return False
+
+            def _seat_latency(self) -> None:
+                """Injected handler latency, spent while HOLDING the
+                flow seat: a loaded apiserver is slow while occupying
+                its concurrency share (real APF seats are held for the
+                request's full server-side duration), which is what
+                lets constrained-seat brownout drills overrun the
+                queue bound and shed."""
                 with outer._fault_lock:
                     delay = (
                         outer._latency
@@ -297,7 +575,6 @@ class FakeApiServer:
                     with outer._fault_lock:
                         outer._stats["delayed"] += 1
                     time.sleep(delay)  # lint: disable=S800 (injected fault: the delay IS the latency being simulated)
-                return False
 
             def _maybe_throttle(self) -> bool:
                 """Injected-fault gate: partition/latency weather first,
@@ -339,17 +616,86 @@ class FakeApiServer:
                 self.wfile.write(body)
                 return True
 
+            def _flow_admit(self) -> Optional[str]:
+                """Priority-and-fairness admission. Returns the
+                canonical flow whose seat the caller must release, or
+                None when the request was shed (429 + Retry-After
+                already written)."""
+                admitted, retry_after = outer.flow.acquire(
+                    self.headers.get(FLOW_HEADER, "")
+                )
+                if admitted is not None:
+                    return admitted
+                with outer._fault_lock:
+                    outer._stats["flow_rejected"] += 1
+                # Drain any body before the error reply (keep-alive
+                # framing), exactly like _maybe_throttle.
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                if n:
+                    self.rfile.read(n)
+                flow = outer.flow.canonical(
+                    self.headers.get(FLOW_HEADER, "")
+                )
+                body = json.dumps({
+                    "kind": "Status", "status": "Failure",
+                    "reason": "TooManyRequests",
+                    "message": f"flow {flow!r} is over its fair share",
+                    "code": 429,
+                }).encode()
+                try:
+                    self.send_response(429)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Retry-After", str(retry_after))
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    # A restart flushed this ticket after the client
+                    # gave up on the connection; nothing to tell it.
+                    self.close_connection = True
+                return None
+
+            def _serve_metrics(self) -> None:
+                data = outer.metrics.render().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
             def do_GET(self):  # noqa: N802
                 if self.path == "/_stats":
+                    stats: dict = {}
                     with outer._fault_lock:
-                        return self._reply(200, dict(outer._stats))
+                        stats = dict(outer._stats)
+                    stats["apf"] = outer.flow.stats()
+                    return self._reply(200, stats)
+                if self.path == "/metrics":
+                    return self._serve_metrics()
                 if self._maybe_throttle():
                     return None
+                qs = parse_qs(urlsplit(self.path).query)
+                watching = qs.get("watch", ["false"])[0] == "true"
+                # Watches are long-running: they bypass the flow gate
+                # (the real APF exempts long-running requests) — a
+                # fleet's standing watches must not pin the seats
+                # request/response traffic is queued for.
+                flow = "" if watching else self._flow_admit()
+                if flow is None:
+                    return None
+                try:
+                    self._seat_latency()
+                    return self._get_inner(qs, watching)
+                finally:
+                    if flow:
+                        outer.flow.release(flow)
+
+            def _get_inner(self, qs, watching: bool):
                 r = self._route()
                 if r is None:
                     return self._reply(404, {"message": "no such route"})
-                qs = parse_qs(urlsplit(self.path).query)
-                watching = qs.get("watch", ["false"])[0] == "true"
                 verb = "get" if r.name else ("watch" if watching else "list")
                 if not self._authorize(r, verb):
                     return None
@@ -489,6 +835,16 @@ class FakeApiServer:
                     return self._reply(200, {"status": "Success"})
                 if self._maybe_throttle():
                     return None
+                flow = self._flow_admit()
+                if flow is None:
+                    return None
+                try:
+                    self._seat_latency()
+                    return self._post_inner()
+                finally:
+                    outer.flow.release(flow)
+
+            def _post_inner(self):
                 try:
                     obj = self._body_or_400()
                 except _BadBody:
@@ -512,6 +868,16 @@ class FakeApiServer:
             def do_PUT(self):  # noqa: N802
                 if self._maybe_throttle():
                     return None
+                flow = self._flow_admit()
+                if flow is None:
+                    return None
+                try:
+                    self._seat_latency()
+                    return self._put_inner()
+                finally:
+                    outer.flow.release(flow)
+
+            def _put_inner(self):
                 try:
                     obj = self._body_or_400()
                 except _BadBody:
@@ -539,6 +905,16 @@ class FakeApiServer:
             def do_PATCH(self):  # noqa: N802
                 if self._maybe_throttle():
                     return None
+                flow = self._flow_admit()
+                if flow is None:
+                    return None
+                try:
+                    self._seat_latency()
+                    return self._patch_inner()
+                finally:
+                    outer.flow.release(flow)
+
+            def _patch_inner(self):
                 try:
                     body = self._body_or_400()
                 except _BadBody:
@@ -576,6 +952,16 @@ class FakeApiServer:
             def do_DELETE(self):  # noqa: N802
                 if self._maybe_throttle():
                     return None
+                flow = self._flow_admit()
+                if flow is None:
+                    return None
+                try:
+                    self._seat_latency()
+                    return self._delete_inner()
+                finally:
+                    outer.flow.release(flow)
+
+            def _delete_inner(self):
                 r = self._route()
                 if r is None or not r.name:
                     return self._reply(404, {"message": "no such route"})
@@ -597,12 +983,53 @@ class FakeApiServer:
         # multi-process e2e (4+ daemons with 1s heartbeats, two plugins,
         # the controller, and the test client, each a distinct process)
         # accept bursts overflow that and the kernel REFUSES connections.
-        # Round 3's flagship failure started exactly there. A real
-        # apiserver listens with a deep backlog; so do we.
+        # Round 3's flagship failure started exactly there, and 256 still
+        # refused connects under the wire fleetsim's worker-shard bursts
+        # (hundreds of publisher processes dialing at once while the
+        # accept loop lags behind the GIL). A real apiserver listens with
+        # a deep backlog; so do we — 1024 rides under the kernel's
+        # somaxconn cap and absorbs a full worker fleet's simultaneous
+        # dial-in (pinned by test_accept_burst).
         class _Server(ThreadingHTTPServer):
-            request_queue_size = 256
+            request_queue_size = 1024
             daemon_threads = True
 
+            # Established keep-alive connections, tracked so stop() can
+            # sever them. shutdown() only stops the ACCEPT loop: pooled
+            # client connections (urllib3 keep-alive) would otherwise
+            # keep being served by their handler threads straight
+            # through an "outage" — and a restart's restore would then
+            # wipe writes those clients saw acknowledged.
+            def __init__(self, *args, **kwargs):
+                super().__init__(*args, **kwargs)
+                self._conns: set = set()
+                self._conns_lock = threading.Lock()
+
+            def get_request(self):
+                sock, addr = super().get_request()
+                with self._conns_lock:
+                    self._conns.add(sock)
+                return sock, addr
+
+            def shutdown_request(self, request):  # noqa: N802
+                with self._conns_lock:
+                    self._conns.discard(request)
+                super().shutdown_request(request)
+
+            def close_all_connections(self) -> None:
+                import socket as _socket
+
+                with self._conns_lock:
+                    conns = list(self._conns)
+                for s in conns:
+                    try:
+                        s.shutdown(_socket.SHUT_RDWR)
+                    except OSError:
+                        pass  # already torn down
+
+        self._address = address
+        self._handler_cls = Handler
+        self._server_cls = _Server
         self._httpd = _Server((address, port), Handler)
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
@@ -701,7 +1128,54 @@ class FakeApiServer:
             for w in list(self._watches):
                 w.close()
         self._httpd.shutdown()
+        # Sever established connections too: a stopped apiserver must
+        # not keep answering pooled keep-alive clients.
+        self._httpd.close_all_connections()
         self._httpd.server_close()
+
+    def restart(self, outage_seconds: float = 0.0,
+                rv_skip: int = 1000) -> None:
+        """Simulate an apiserver PROCESS restart on the same endpoint:
+        snapshot the backing store, stop serving (every open watch
+        stream drops; queued flow-control tickets flush), keep the
+        port dark for ``outage_seconds`` (clients see connection
+        refused — the transport's pre-send retry territory), then
+        restore the store with resourceVersions advanced past the
+        retained event window and serve again. Pre-restart watch
+        resumes answer 410 Gone and relist — the contract a real
+        apiserver restart (watch-cache loss + etcd compaction)
+        imposes on every informer."""
+        # Stop BEFORE snapshotting: any write acknowledged to a client
+        # must survive the restart (etcd durability) — snapshotting a
+        # still-serving store would silently drop writes that land
+        # between the copy and the socket close.
+        self.stop()
+        # Handler threads are daemonic: a request admitted before the
+        # sockets were severed may still be committing. Wait for the
+        # flow gate to read idle twice in a row so every acknowledged
+        # write is inside the snapshot.
+        drain_deadline = time.monotonic() + 5.0
+        idle_streak = 0
+        while idle_streak < 2 and time.monotonic() < drain_deadline:
+            busy = any(
+                st["inflight"] or st["queued"]
+                for st in self.flow.stats().values()
+            )
+            idle_streak = 0 if busy else idle_streak + 1
+            time.sleep(0.02)  # lint: disable=S800 (drain poll, not a sync point)
+        snap = self.cluster.snapshot()
+        self.flow.flush()
+        with self._fault_lock:
+            self._stats["restarts"] += 1
+        self.metrics.inc("apiserver_restarts_total")
+        if outage_seconds > 0:
+            time.sleep(outage_seconds)  # lint: disable=S800 (injected fault: the dark window IS the restart being simulated)
+        self.cluster.restore(snap, rv_skip=rv_skip)
+        self._httpd = self._server_cls(
+            (self._address, self.port), self._handler_cls
+        )
+        self.port = self._httpd.server_address[1]
+        self.start()
 
 
 def main(argv=None) -> int:
@@ -717,11 +1191,21 @@ def main(argv=None) -> int:
     p.add_argument("--watch-heartbeat", type=float,
                    default=WATCH_HEARTBEAT_SECONDS,
                    help="Idle-watch heartbeat/bookmark period in seconds")
+    p.add_argument("--apf-concurrency", type=int, default=64,
+                   help="Priority-and-fairness concurrency seats "
+                   "(storm harnesses tighten this to force shedding)")
+    p.add_argument("--apf-queue-seconds", type=float, default=15.0,
+                   help="Max seconds a request may queue before it is "
+                   "shed with 429")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     srv = FakeApiServer(
         port=args.port, address=args.address, enforce_rbac=args.rbac,
         watch_heartbeat_seconds=args.watch_heartbeat,
+        flow_control=FlowControl(
+            concurrency=args.apf_concurrency,
+            max_queue_seconds=args.apf_queue_seconds,
+        ),
     )
     if args.seed:
         n = srv.cluster.load_dir(args.seed)

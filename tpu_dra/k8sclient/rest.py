@@ -22,7 +22,12 @@ import yaml
 from tpu_dra.infra import deadline
 from tpu_dra.infra.deadline import BudgetExceeded
 from tpu_dra.infra.workqueue import BucketRateLimiter
-from tpu_dra.k8sclient.circuit import CircuitBreaker, CircuitOpenError
+from tpu_dra.k8sclient.circuit import (
+    CircuitBreaker,
+    CircuitOpenError,
+    RetryBudget,
+    process_retry_budget,
+)
 from tpu_dra.k8sclient.resources import (
     ApiConflict,
     ApiGone,
@@ -34,6 +39,45 @@ from tpu_dra.k8sclient.resources import (
 log = logging.getLogger(__name__)
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+# --- API flow identity (ISSUE 20) ---
+#
+# Every request carries a flow-identity header so the apiserver's
+# priority-and-fairness analog (fakeserver.FlowControl; the real
+# apiserver's APF keys on user+FlowSchema) can queue and shed by WHO is
+# asking, not arrival order. The mapping is deliberately coarse and
+# derived from what the request touches:
+#
+#   leases (any verb)          -> system-leader   (highest share: losing
+#                                 a lease renewal to a publish storm
+#                                 deposes a healthy leader)
+#   resourceclaims writes      -> claim-status    (high: allocation and
+#                                 device-status writes are the
+#                                 workload-visible control loop)
+#   resourceslices writes      -> slice-publish   (low: inventory
+#                                 publishes are reconciled-eventually
+#                                 traffic; 5k nodes' worth must never
+#                                 starve the two flows above)
+#   everything else            -> workload        (reads, node objects…)
+FLOW_HEADER = "X-Tpu-Dra-Flow"
+FLOW_SYSTEM_LEADER = "system-leader"
+FLOW_CLAIM_STATUS = "claim-status"
+FLOW_SLICE_PUBLISH = "slice-publish"
+FLOW_WORKLOAD = "workload"
+
+_WRITE_VERBS = frozenset({"create", "update", "patch", "delete"})
+
+
+def flow_of(rd, verb: str) -> str:
+    """The flow-identity value stamped into :data:`FLOW_HEADER`."""
+    plural = getattr(rd, "plural", "") or ""
+    if plural == "leases":
+        return FLOW_SYSTEM_LEADER
+    if plural == "resourceclaims" and verb in _WRITE_VERBS:
+        return FLOW_CLAIM_STATUS
+    if plural == "resourceslices" and verb in _WRITE_VERBS:
+        return FLOW_SLICE_PUBLISH
+    return FLOW_WORKLOAD
 
 
 class _Throttle:
@@ -119,6 +163,7 @@ class KubeClient(Backend):
         metrics=None,
         circuit: Optional[CircuitBreaker] = None,
         request_timeouts: Optional[Dict[str, float]] = None,
+        retry_budget: Optional[RetryBudget] = None,
     ):
         self.server = server.rstrip("/")
         self._session = requests.Session()
@@ -132,6 +177,10 @@ class KubeClient(Backend):
         # The breaker fronts every request (see circuit.py). Components
         # observe it for degraded mode via ``backend.circuit``.
         self.circuit = circuit or CircuitBreaker(metrics=metrics)
+        # Retries (NOT first attempts) are charged against a bucket
+        # shared by every client in the process, so a brownout cannot
+        # self-amplify through retry traffic (see circuit.RetryBudget).
+        self.retry_budget = retry_budget or process_retry_budget()
         self._timeouts = dict(self.DEFAULT_REQUEST_TIMEOUTS)
         if request_timeouts:
             self._timeouts.update(request_timeouts)
@@ -328,6 +377,34 @@ class KubeClient(Backend):
                     f"retry budget for {verb} exhausted after "
                     f"{time.monotonic() - t0:.1f}s", status=504,
                 )
+            # Every retry sleep spends one token from the PROCESS-wide
+            # bucket; an empty bucket means the process as a whole is
+            # already retrying at its ceiling, and this request fails
+            # over to its caller instead of joining the storm.
+            if not self.retry_budget.try_spend():
+                if self.metrics is not None:
+                    self.metrics.inc(
+                        "api_retry_budget_exhausted_total",
+                        labels={"verb": verb},
+                    )
+                    self.metrics.set_gauge(
+                        "api_retry_budget_tokens",
+                        self.retry_budget.tokens(),
+                    )
+                log.warning(
+                    "process retry budget exhausted; failing %s through "
+                    "instead of retrying", verb,
+                )
+                if last_exc is not None:
+                    raise last_exc
+                raise K8sApiError(
+                    f"process retry budget exhausted; not retrying {verb}",
+                    status=429,
+                )
+            if self.metrics is not None:
+                self.metrics.set_gauge(
+                    "api_retry_budget_tokens", self.retry_budget.tokens()
+                )
             budget.sleep(delay, f"retrying apiserver {verb}")
 
         while True:
@@ -457,6 +534,7 @@ class KubeClient(Backend):
         try:
             return self._check(self._do(lambda t: self._session.get(
                 self.server + rd.path(namespace, name), timeout=t,
+                headers={FLOW_HEADER: flow_of(rd, "get")},
             ), verb="get", idempotent=True))
         except CircuitOpenError:
             if self.read_fallback is not None:
@@ -514,6 +592,7 @@ class KubeClient(Backend):
                     out = self._check(self._do(lambda t: self._session.get(
                         self.server + rd.path(namespace),
                         params=params, timeout=t,
+                        headers={FLOW_HEADER: flow_of(rd, "list")},
                     ), verb="list", idempotent=True))
                     items.extend(out.get("items", []))
                     cont = out.get("metadata", {}).get("continue")
@@ -535,6 +614,7 @@ class KubeClient(Backend):
         ns = obj.get("metadata", {}).get("namespace")
         return self._check(self._do(lambda t: self._session.post(
             self.server + rd.path(ns), json=obj, timeout=t,
+            headers={FLOW_HEADER: flow_of(rd, "create")},
         ), verb="create"))
 
     def update(self, rd, obj) -> dict:
@@ -542,6 +622,7 @@ class KubeClient(Backend):
         return self._check(self._do(lambda t: self._session.put(
             self.server + rd.path(md.get("namespace"), md["name"]),
             json=obj, timeout=t,
+            headers={FLOW_HEADER: flow_of(rd, "update")},
         ), verb="update"))
 
     def update_status(self, rd, obj) -> dict:
@@ -549,19 +630,24 @@ class KubeClient(Backend):
         return self._check(self._do(lambda t: self._session.put(
             self.server + rd.path(md.get("namespace"), md["name"]) + "/status",
             json=obj, timeout=t,
+            headers={FLOW_HEADER: flow_of(rd, "update")},
         ), verb="update"))
 
     def patch(self, rd, namespace, name, patch) -> dict:
         return self._check(self._do(lambda t: self._session.patch(
             self.server + rd.path(namespace, name),
             json=patch,
-            headers={"Content-Type": "application/merge-patch+json"},
+            headers={
+                "Content-Type": "application/merge-patch+json",
+                FLOW_HEADER: flow_of(rd, "patch"),
+            },
             timeout=t,
         ), verb="patch"))
 
     def delete(self, rd, namespace, name) -> None:
         self._check(self._do(lambda t: self._session.delete(
             self.server + rd.path(namespace, name), timeout=t,
+            headers={FLOW_HEADER: flow_of(rd, "delete")},
         ), verb="delete"))
 
     def watch(
@@ -583,6 +669,7 @@ class KubeClient(Backend):
             params=params,
             stream=True,
             timeout=(t, None),
+            headers={FLOW_HEADER: flow_of(rd, "watch")},
         ), verb="watch", idempotent=True)
         if resp.status_code >= 400:
             self._check(resp)

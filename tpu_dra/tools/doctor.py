@@ -244,6 +244,19 @@ FLEETMON_UP_GAUGE = "fleetmon_target_up"
 FLEETMON_AGE_GAUGE = "fleetmon_scrape_age_seconds"
 FLEETMON_INTERVAL_GAUGE = "fleetmon_scrape_interval_seconds"
 
+# Apiserver flow-control health (ISSUE 20), suffix-matched like the
+# others: apiserver_flow_rejected_total{flow=} counts requests the
+# priority-and-fairness gate SHED with 429 + Retry-After, per flow;
+# api_retry_budget_exhausted_total{verb=} counts retries a component
+# wanted but could not afford from its process-wide retry-token bucket
+# (it failed the request through instead of joining the storm). A
+# CLIMBING rejected counter means the apiserver is actively shedding
+# that flow right now — flow-ordered, so the flow name says who is over
+# their share; an exhausted retry budget means the component's retry
+# pressure has outrun its refill and errors are surfacing to callers.
+APIFLOW_REJECTED_COUNTER = "apiserver_flow_rejected_total"
+APIFLOW_BUDGET_EXHAUSTED_COUNTER = "api_retry_budget_exhausted_total"
+
 # Decode-roofline trend gate (ISSUE 8): the key bench.py records as the
 # gap between the measured decode step and the bf16 HBM floor. Matched
 # by SUFFIX inside the artifact (like the scheduler/engine gauges): the
@@ -375,6 +388,9 @@ def probe_metrics(
         fleetmon = _check_fleetmon(ep, second or first, warn)
         if fleetmon:
             report[ep]["fleetmon"] = fleetmon
+        apiflow = _check_apiflow(ep, first, second, warn)
+        if apiflow:
+            report[ep]["apiflow"] = apiflow
     return report
 
 
@@ -443,6 +459,92 @@ def _check_fleetmon(
                 f"fleetmon scrape loop is wedged or the target slowed "
                 f"past the scrape timeout"
             )
+    return out
+
+
+def _check_apiflow(
+    ep: str, first: Dict[str, float], second: Optional[Dict[str, float]],
+    warn,
+) -> Dict[str, object]:
+    """Surface apiserver flow-control shedding and client retry-budget
+    exhaustion (ISSUE 20). With two samples, only a counter that is
+    still CLIMBING warns — a nonzero total from a past brownout is
+    history, not a page; a single sample can only flag the total and
+    ask for a re-probe. Empty dict (and silence) on fleets that export
+    neither series or have never shed."""
+    out: Dict[str, object] = {}
+    sample = second if second is not None else first
+    rejected: Dict[str, Dict[str, float]] = {}
+    exhausted_total = 0.0
+    exhausted_climbed = 0.0
+    for series, value in sorted(sample.items()):
+        name = series.split("{", 1)[0]
+        if name.endswith(APIFLOW_REJECTED_COUNTER):
+            if value <= 0:
+                continue
+            flow = _label_of(series, "flow")
+            entry: Dict[str, float] = {"rejected": value}
+            if second is not None:
+                entry["climbed"] = value - first.get(series, 0.0)
+            rejected[flow] = entry
+        elif name.endswith(APIFLOW_BUDGET_EXHAUSTED_COUNTER):
+            if value <= 0:
+                continue
+            exhausted_total += value
+            if second is not None:
+                exhausted_climbed += value - first.get(series, 0.0)
+    if not rejected and exhausted_total <= 0:
+        return out
+    if rejected:
+        out["rejected"] = rejected
+    if exhausted_total > 0:
+        out["retry_budget_exhausted"] = exhausted_total
+        if second is not None:
+            out["retry_budget_climbed"] = exhausted_climbed
+    for flow, entry in sorted(rejected.items()):
+        if second is not None:
+            if entry.get("climbed", 0.0) > 0:
+                warn(
+                    f"{ep}: apiserver is SHEDDING the {flow!r} flow "
+                    f"right now — apiserver_flow_rejected_total"
+                    f"{{flow={flow!r}}} climbed by "
+                    f"{entry['climbed']:g} over the probe interval "
+                    f"(total {entry['rejected']:g}). The gate sheds "
+                    f"flow-ordered, so this flow is over its share: "
+                    f"either widen its share (FlowControl.configure) "
+                    f"or slow the producer — for slice-publish that "
+                    f"means publisher storm weather outrunning "
+                    f"coalescing (docs/operations.md, 'Apiserver flow "
+                    f"control & restart semantics')"
+                )
+        else:
+            warn(
+                f"{ep}: apiserver_flow_rejected_total"
+                f"{{flow={flow!r}}} = {entry['rejected']:g} — this "
+                f"flow has been shed; re-run with --metrics-interval "
+                f"to see whether it is still being shed or the "
+                f"brownout has passed"
+            )
+    if second is not None and exhausted_climbed > 0:
+        warn(
+            f"{ep}: the process retry budget is EXHAUSTED and still "
+            f"burning — api_retry_budget_exhausted_total climbed by "
+            f"{exhausted_climbed:g} over the probe interval (total "
+            f"{exhausted_total:g}); retries this component wanted are "
+            f"being refused and errors are failing through to "
+            f"callers. The apiserver is either shedding or flapping "
+            f"faster than the budget refills: fix the apiserver-side "
+            f"pressure first (see the apiflow shed warnings), then "
+            f"widen TPU_DRA_RETRY_BUDGET_CAPACITY/REFILL only if the "
+            f"weather is expected"
+        )
+    elif second is None and exhausted_total > 0:
+        warn(
+            f"{ep}: api_retry_budget_exhausted_total = "
+            f"{exhausted_total:g} — this process has refused retries "
+            f"for want of budget; re-run with --metrics-interval to "
+            f"see whether the budget is still exhausted"
+        )
     return out
 
 
@@ -1553,6 +1655,29 @@ def render(report: dict) -> str:
                 ):
                     parts.append(f"stale[{tname}]={t['age_s']:g}s")
             lines.append(f"  fleetmon: {' '.join(parts)}")
+        aflow = m.get("apiflow") or {}
+        if aflow:
+            parts = []
+            for flow, entry in sorted(
+                (aflow.get("rejected") or {}).items()
+            ):
+                climbed = (
+                    f"+{entry['climbed']:g}"
+                    if entry.get("climbed", 0) > 0 else ""
+                )
+                parts.append(
+                    f"rejected[{flow}]={entry['rejected']:g}{climbed}"
+                )
+            if aflow.get("retry_budget_exhausted"):
+                climbed = (
+                    f"+{aflow['retry_budget_climbed']:g}"
+                    if aflow.get("retry_budget_climbed", 0) > 0 else ""
+                )
+                parts.append(
+                    f"budget-exhausted="
+                    f"{aflow['retry_budget_exhausted']:g}{climbed}"
+                )
+            lines.append(f"  apiflow: {' '.join(parts)}")
     for note in report.get("notes", []):
         lines.append(f"note: {note}")
     trend = report.get("bench_trend")

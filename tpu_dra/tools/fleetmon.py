@@ -560,6 +560,44 @@ def builtin_catalog(
                 "(docs/serving.md, 'Failure semantics')"
             ),
         ),
+        slo.SLOSpec(
+            name="flow-rejection-rate",
+            description="apiserver priority-and-fairness 429 sheds",
+            kind="rate",
+            series="apiserver_flow_rejected_total",
+            budget=60.0,  # sheds/hour fleet-wide; brownouts blow through
+            per_seconds=3600.0,
+            window_s=window_s, policy=policy,
+            remediation=(
+                "the apiserver is shedding requests by flow: per-flow "
+                "rejected counters (doctor's apiflow line, or "
+                "apiserver_flow_rejected_total{flow=...}) name WHICH "
+                "flow is over its share — slice-publish sheds mean "
+                "publisher storm weather (widen coalescing or the "
+                "flow's share), claim-status or system-leader sheds "
+                "mean the control plane itself is starving "
+                "(docs/operations.md, 'Apiserver flow control & "
+                "restart semantics')"
+            ),
+        ),
+        slo.SLOSpec(
+            name="claim-ready-recovery-p99",
+            description="post-restart claim-submitted -> ready p99",
+            kind="threshold",
+            series="claim_ready_recovery_seconds",
+            labels=(("quantile", "0.99"),),
+            threshold=claim_ready_target_s * 2.0, op="le", budget=0.05,
+            window_s=window_s, policy=policy,
+            remediation=(
+                "claims submitted after an apiserver restart are not "
+                "reconverging inside the recovery objective: informers "
+                "should relist on 410 Gone, the leader should re-renew "
+                "inside one lease duration, and publishers should "
+                "reverify-and-heal — `make stormbench` reproduces the "
+                "drill; see docs/operations.md, 'Apiserver flow "
+                "control & restart semantics'"
+            ),
+        ),
     ]
     for cls, target_s in sorted(ttft.items()):
         catalog.append(slo.SLOSpec(

@@ -62,7 +62,7 @@ import statistics
 import sys
 import threading
 import time
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from tpu_dra.infra import trace
 from tpu_dra.infra.metrics import Metrics
@@ -106,7 +106,13 @@ class NodeAgent:
     """One synthetic node's publisher — the driver's publish path
     without the silicon underneath it."""
 
-    def __init__(self, index: int, slices: ResourceClient, metrics: Metrics):
+    def __init__(
+        self,
+        index: int,
+        slices: ResourceClient,
+        metrics: Metrics,
+        reverify_seconds: float = 0.0,
+    ):
         self.index = index
         self.node = fleet.node_name(index)
         self.slices = slices
@@ -114,10 +120,14 @@ class NodeAgent:
         self.publisher = SlicePublisher(
             slices, node_name=self.node, metrics=metrics,
             presume_empty=True,
-            # No trust-but-verify relists: the harness owns the cluster
-            # (no external drift), and N agents re-listing an N-node
-            # fleet on the reverify beat would be O(N^2).
-            reverify_seconds=0.0,
+            # Default: no trust-but-verify relists — the in-process
+            # harness owns the cluster (no external drift), and N agents
+            # re-listing an N-node fleet on the reverify beat would be
+            # O(N^2). The wire-mode storm workers (stormsim) OVERRIDE
+            # this: there the apiserver restarts underneath the
+            # publisher mid-run, and the reverify pass is exactly the
+            # heal path the drill asserts.
+            reverify_seconds=reverify_seconds,
         )
         self.naive_gen = 0
         self.naive_writes = 0
@@ -197,10 +207,17 @@ class KubeletSim:
         shards: int = 16,
         prepare_ms: float = 1.0,
         submit_time_of=None,
+        on_ready=None,
     ):
         self.metrics = metrics
         self.sharded = sharded
         self.prepare_ms = prepare_ms
+        # Optional (name, claim, env) callback fired exactly once per
+        # claim after the ready stamp: the wire-mode kubelet worker
+        # (stormsim) uses it to PATCH a ready annotation back onto the
+        # claim so the parent process can observe pod-env-injected over
+        # the apiserver instead of a shared-memory dict.
+        self.on_ready = on_ready
         # Optional claim-name -> submit monotonic-time lookup: with it,
         # the kubelet EXPORTS the claim-submitted -> pod-env-injected
         # latency as the `claim_ready_seconds` summary — the series the
@@ -288,6 +305,8 @@ class KubeletSim:
                     self.metrics.observe(
                         "claim_ready_seconds", t_ready - t_submit
                     )
+            if stamped and self.on_ready is not None:
+                self.on_ready(name, claim, env)
 
     def ready_count(self) -> int:
         with self._lock:
@@ -731,7 +750,7 @@ def run_slo_leg(
     run is live, evaluating the built-in catalog with scaled SRE burn
     windows.
 
-    Two asserted phases (the `make slocheck` contract, also run by
+    Asserted phases (the `make slocheck` contract, also run by
     ``bench.py --leg-fleet``):
 
     1. **steady state**: the content-diffed publisher stays INSIDE the
@@ -744,7 +763,12 @@ def run_slo_leg(
        naive per-event republish — the write-budget burn rate blows
        through the page thresholds on BOTH fast windows and the
        multi-window alert FIRES. The zero-write steady state is a
-       monitored objective now, not a one-shot bench assert.
+       monitored objective now, not a one-shot bench assert;
+    3. **brownout + restart** (ISSUE 20): seats squeezed under a
+       saturating storm — the flow-rejection-rate SLO must page with
+       the sheds landing on the slice-publish flow; then a mid-watch
+       apiserver restart followed by a fresh claim wave — the
+       claim-ready-recovery-p99 SLO must carry data and hold.
     """
     from tpu_dra.infra.metrics import MetricsServer
     from tpu_dra.k8sclient.fakeserver import FakeApiServer
@@ -769,9 +793,24 @@ def run_slo_leg(
         core = SchedulerCore(
             client(), retry_unschedulable_after=0.5, metrics=metrics
         )
+        # Phase 3's restart drill: claims submitted after the restart
+        # instant additionally export claim_ready_recovery_seconds —
+        # the series the claim-ready-recovery-p99 SLO evaluates.
+        restart_t: List[Optional[float]] = [None]
+
+        def observe_recovery(name: str, claim: dict, env: dict) -> None:
+            t0 = restart_t[0]
+            t_submit = submit_times.get(name)
+            if t0 is not None and t_submit is not None and t_submit >= t0:
+                metrics.observe(
+                    "claim_ready_recovery_seconds",
+                    time.monotonic() - t_submit,
+                )
+
         kubelet = KubeletSim(
             client(), metrics, sharded=True, prepare_ms=prepare_ms,
             submit_time_of=submit_times.get,
+            on_ready=observe_recovery,
         )
         core.start()
         kubelet.start()
@@ -795,6 +834,12 @@ def run_slo_leg(
         fm = fleetmon_mod.FleetMon(
             [
                 fleetmon_mod.Target("fleet", f"127.0.0.1:{msrv.port}"),
+                # The apiserver exports its own registry at GET
+                # /metrics (flow-control + restart counters); the
+                # flow-rejection-rate SLO reads this target. The
+                # endpoint bypasses the flow gate, so scrapes survive
+                # the brownout they are measuring.
+                fleetmon_mod.Target("apiserver", f"127.0.0.1:{srv.port}"),
                 # The deliberately-broken target: nothing listens on
                 # port 1 — fleetmon_target_up must report it down
                 # (what the doctor's fleetmon section WARNs on).
@@ -812,7 +857,13 @@ def run_slo_leg(
             # steady state, exercised continuously while monitored.
             while not stop.wait(interval_s):
                 for i in rng.sample(range(nodes), flap):
-                    agents[i].publish(degraded=False)
+                    try:
+                        agents[i].publish(degraded=False)
+                    except Exception:  # noqa: BLE001
+                        # Phase 3's restart/brownout sever pooled
+                        # connections mid-PUT; the publisher's reverify
+                        # heals, the flap loop must survive to see it.
+                        pass
 
         t = threading.Thread(target=storm, daemon=True, name="slo-storm")
         t.start()
@@ -911,6 +962,123 @@ def run_slo_leg(
             f"naive-publish regression did NOT trip the write-budget "
             f"page alert: {fm.status_of('write-budget')}"
         )
+
+        # Phase 3a: injected BROWNOUT — the apiserver's seats squeezed
+        # to 2 with loaded-handler latency, under a saturating naive
+        # publish storm. The flow gate must shed the low-priority
+        # slice-publish flow (429 + Retry-After) and the
+        # flow-rejection-rate SLO must PAGE — shedding is a monitored
+        # objective, not just a unit-tested mechanism.
+        # Latency is spent while HOLDING a seat: 8 writers over 2
+        # seats at 100ms each queue ~0.4s — past the 0.2s bound, so
+        # the gate sheds flow-ordered.
+        srv.flow.configure(concurrency=2, max_queue_seconds=0.2)
+        srv.inject_faults(latency=0.1, latency_seconds=120.0)
+        brown_stop = threading.Event()
+
+        def brown_loop(part: List[NodeAgent]) -> None:
+            while not brown_stop.is_set():
+                for a in part:
+                    if brown_stop.is_set():
+                        break
+                    try:
+                        a.naive_publish()
+                    except Exception:  # noqa: BLE001
+                        # Shed-after-retries IS the drill; the counter
+                        # the SLO reads already recorded it.
+                        pass
+
+        browners = [
+            threading.Thread(
+                target=brown_loop, args=(agents[j::4],),
+                daemon=True, name=f"slo-brownout-{j}",
+            )
+            for j in range(4)
+        ]
+        for t in browners:
+            t.start()
+        flow_alerted = None
+        try:
+            probe_deadline = time.monotonic() + max(regress_s, 30.0)
+            while (
+                flow_alerted is None
+                and time.monotonic() < probe_deadline
+            ):
+                st = fm.status_of("flow-rejection-rate")
+                if st is not None and st.alert == "page":
+                    flow_alerted = st
+                else:
+                    time.sleep(interval_s)
+        finally:
+            brown_stop.set()
+            for t in browners:
+                t.join(timeout=10)
+            # Lift the brownout: stock seats back, latency cleared.
+            srv.flow.configure(concurrency=64, max_queue_seconds=15.0)
+            srv.inject_faults(latency=0.0, latency_seconds=0.0)
+        assert flow_alerted is not None, (
+            f"apiserver brownout did NOT trip the flow-rejection-rate "
+            f"page alert: {fm.status_of('flow-rejection-rate')}"
+        )
+        flow_rejected = {
+            f: s["rejected"] for f, s in srv.flow.stats().items()
+        }
+        assert flow_rejected.get("slice-publish", 0) > 0, (
+            f"brownout sheds did not land on the slice-publish flow: "
+            f"{flow_rejected}"
+        )
+
+        # Phase 3b: apiserver RESTART mid-watch, then a fresh claim
+        # wave. Informers relist off 410 Gone, the transport rides the
+        # refused-connect window, and the recovery wave's
+        # submitted -> ready latency exports as
+        # claim_ready_recovery_seconds — the claim-ready-recovery-p99
+        # SLO must carry data and hold.
+        # The phase-1 workloads are done: release their claims so the
+        # recovery wave contends for transport + scheduling latency,
+        # not for devices (a fleet sized for one wave cannot hold two —
+        # leftover allocations would read as "recovery wedged" when the
+        # truth is "unschedulable forever"). ready_count keeps the old
+        # names: the 2×claims drain below still counts both waves.
+        for c in claims_client.list(NS):
+            claims_client.delete(c["metadata"]["name"], NS)
+        restart_t[0] = time.monotonic()
+        srv.restart(outage_seconds=0.3)
+        rec_trace = fleet.make_trace(claims, seed ^ 0x77)
+        arr_rec = random.Random(seed ^ 0x77)
+        t_next = time.monotonic()
+        for c in rec_trace:
+            t_next += arr_rec.expovariate(rate)
+            now = time.monotonic()
+            if t_next > now:
+                time.sleep(t_next - now)
+            c = json.loads(json.dumps(c))
+            c["metadata"]["name"] = "rec-" + c["metadata"]["name"]
+            c["metadata"]["namespace"] = NS
+            c["metadata"].pop("uid", None)
+            submit_times[c["metadata"]["name"]] = time.monotonic()
+            claims_client.create(c)
+        rec_deadline = time.monotonic() + 120
+        while kubelet.ready_count() < 2 * claims:
+            if time.monotonic() > rec_deadline:
+                raise RuntimeError(
+                    f"post-restart recovery wedged: "
+                    f"{2 * claims - kubelet.ready_count()} claim(s) "
+                    f"never became ready after the apiserver restart"
+                )
+            time.sleep(0.02)
+        time.sleep(page.long_s + 3 * interval_s)
+        rec = fm.status_of("claim-ready-recovery-p99")
+        assert rec is not None and rec.data, (
+            "claim-ready-recovery-p99 SLO has no data — "
+            "claim_ready_recovery_seconds not scraped after the "
+            "restart drill"
+        )
+        assert rec.ok, (
+            f"post-restart claim-ready p99 {rec.current}s blew the "
+            f"recovery objective"
+        )
+
         snapshot = fm.snapshot()
         report = {
             "slo_nodes": nodes,
@@ -925,6 +1093,10 @@ def run_slo_leg(
             "slo_regression_burn_rate": round(
                 alerted.burn_rate or 0.0, 2
             ),
+            "slo_flow_rejection_alert": flow_alerted.alert,
+            "slo_flow_rejected": flow_rejected,
+            "slo_recovery_p99_s": round(rec.current or 0.0, 4),
+            "slo_recovery_ok": bool(rec.ok),
             "slo_targets_up": sum(
                 1 for t in snapshot["targets"].values() if t["up"]
             ),
@@ -950,7 +1122,12 @@ def run_slo_leg(
                 f"claim-ready burn "
                 f"{report['slo_claim_ready_burn_rate']}, regression "
                 f"alert={report['slo_regression_alert']} (burn "
-                f"{report['slo_regression_burn_rate']}), dead target "
+                f"{report['slo_regression_burn_rate']}), brownout "
+                f"alert={report['slo_flow_rejection_alert']} with "
+                f"sheds on "
+                f"{[f for f, n in flow_rejected.items() if n]}, "
+                f"post-restart recovery p99 "
+                f"{report['slo_recovery_p99_s']}s, dead target "
                 "reported down — all hold"
             )
         return report

@@ -1,0 +1,1005 @@
+"""Wire-honest fleet storm bench (ISSUE 20): the fleet as REAL OS
+processes over REAL HTTP, apiserver priority-and-fairness under its
+own weight, and the mid-storm apiserver restart convergence drill.
+
+The in-process fleetsim (ISSUE 10) proved the control plane's
+*algorithms* scale to 5k nodes; every component there shared one
+interpreter and one FakeCluster, so the apiserver's transport — accept
+backlog, per-connection handler threads, flow-control queuing, 429
+shedding, connection-refused windows — was never load-bearing. This
+harness removes that flattery:
+
+- **NodeAgents are sharded across worker subprocesses** (``--publish-
+  worker``): each worker owns a contiguous index range of the synthetic
+  fleet and drives the driver's REAL publisher
+  (:class:`tpu_dra.plugin.slicepub.SlicePublisher`, reverify enabled —
+  the heal path the restart drill asserts) over fakeserver HTTP.
+- **The scheduler is the shipped binary** (``python -m
+  tpu_dra.scheduler.main``): leader-elected against a Lease, elastic
+  repacker riding its leadership, talking to the same endpoint.
+- **The kubelet analog is its own process** (``--kubelet-worker``):
+  :class:`tpu_dra.tools.fleetsim.KubeletSim` preparing allocated
+  claims, then PATCHing a ready annotation back onto each claim so the
+  parent observes claim-submitted -> pod-env-injected through the
+  apiserver, not through shared memory.
+
+Headline: ``fleet_wire_claim_ready_p50/p99_ms`` at fleet scale plus
+``fleet_wire_vs_inproc_p99_pct`` — the honest price of the wire,
+measured against the identical in-process trace
+(:class:`tpu_dra.tools.fleetsim._ModeRun`).
+
+**Restart drill** (the robustness tentpole): halfway through the claim
+storm the apiserver process-restarts (state snapshot/restore, watches
+dropped, resourceVersions jumped past the retained window, listen
+socket dark for the outage) with the scheduler, publishers, repacker
+and gang WALs all live. Afterwards the drill asserts CONVERGENCE, not
+vibes: every claim holds exactly one allocation, allocated devices are
+fleet-wide disjoint, zero gang/repack WAL annotations survive, the
+scheduler's Lease was re-acquired/renewed past the outage, and
+``storm_recovery_p99_ms`` records claim-ready p99 for claims submitted
+into the recovery window.
+
+**Cliff ladder**: node count is pushed rung by rung until the endpoint
+breaks — sustained flow-control shedding, refused connections, or
+publish throughput collapse — and the breaking rung's bottleneck is
+NAMED from the server's per-flow APF counters and the workers' client
+tallies (``fleet_wire_cliff_nodes`` / ``fleet_wire_cliff_bottleneck``).
+
+Entry points::
+
+    python -m tpu_dra.tools.stormsim            # full (5k nodes, wire)
+    python -m tpu_dra.tools.stormsim --smoke    # `make stormbench` leg
+
+Knobs (env): STORMSIM_NODES, STORMSIM_CLAIMS, STORMSIM_RATE,
+STORMSIM_WORKERS, STORMSIM_SEED, STORMSIM_OUTAGE, STORMSIM_PREPARE_MS,
+STORMSIM_CLIFF_RUNGS, STORMSIM_CLIFF_WINDOW, STORMSIM_CLIFF_SEATS.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from tpu_dra.infra.metrics import Metrics
+from tpu_dra.k8sclient import (
+    DEVICE_CLASSES,
+    LEASES,
+    RESOURCE_CLAIMS,
+    RESOURCE_SLICES,
+    ApiConflict,
+    ApiNotFound,
+    Informer,
+    ResourceClient,
+)
+from tpu_dra.k8sclient.rest import KubeClient
+from tpu_dra.scheduler import fleet
+from tpu_dra.scheduler.gang import GANG_ANNOTATION
+from tpu_dra.scheduler.repacker import REPACK_ANNOTATION
+from tpu_dra.tools.fleetsim import KubeletSim, NodeAgent, _pct
+
+NS = "stormsim"
+READY_ANNOTATION = "storm.tpu.google.com/ready"
+LEASE_NAME = "tpu-dra-scheduler"
+LEASE_NS = "default"
+# Worker stdout protocol: exactly these two prefixed JSON lines; logs
+# go to stderr so the protocol stream stays parseable.
+READY_PREFIX = "#stormsim-ready "
+STATS_PREFIX = "#stormsim-stats "
+
+_VERBS = ("get", "list", "create", "update", "patch", "delete", "watch")
+
+
+def _note(msg: str) -> None:
+    print(f"stormsim: {msg}", file=sys.stderr)
+
+
+def _client(server: str, metrics: Optional[Metrics] = None) -> KubeClient:
+    return KubeClient(server=server, qps=5000, burst=5000, metrics=metrics)
+
+
+def _sum_code(metrics: Metrics, code: str) -> int:
+    return int(sum(
+        metrics.get_counter(
+            "api_requests_total", labels={"verb": v, "code": code}
+        )
+        for v in _VERBS
+    ))
+
+
+def _client_tally(metrics: Metrics) -> Dict[str, int]:
+    """The transport-level weather one process absorbed: answered
+    sheds, connection-level failures, and retries refused by the
+    process-wide retry budget."""
+    return {
+        "sheds_429": _sum_code(metrics, "429"),
+        "conn_errors": _sum_code(metrics, "conn_error"),
+        "retry_budget_exhausted": int(sum(
+            metrics.get_counter(
+                "api_retry_budget_exhausted_total", labels={"verb": v}
+            )
+            for v in _VERBS
+        )),
+    }
+
+
+# --- worker subprocess mains -------------------------------------------------
+
+
+def _publish_worker_main(args) -> int:
+    """One shard of the fleet's publishers: agents [start, start+count)
+    publishing over HTTP, then seeded settling health flaps until the
+    parent closes stdin. Every flap toggles real content, so every
+    publish is a real apiserver write on the slice-publish flow —
+    exactly the low-priority pressure the APF analog exists to shed
+    before it starves lease renewals."""
+    metrics = Metrics()
+    kc = _client(args.server, metrics)
+    slices = ResourceClient(kc, RESOURCE_SLICES)
+    agents = [
+        NodeAgent(i, slices, metrics, reverify_seconds=args.reverify)
+        for i in range(args.start, args.start + args.count)
+    ]
+    retried = 0
+    t0 = time.monotonic()
+    for a in agents:
+        for attempt in range(6):
+            try:
+                a.publish()
+                break
+            except Exception:  # noqa: BLE001 — weather; retry the agent
+                retried += 1
+                time.sleep(0.2 * (attempt + 1))
+    print(READY_PREFIX + json.dumps({
+        "start": args.start, "count": args.count,
+        "publish_wall_s": round(time.monotonic() - t0, 3),
+        "publish_retries": retried,
+    }), flush=True)
+
+    stop = threading.Event()
+    failed = [0] * max(1, args.flap_threads)
+
+    def flaps(tid: int, part: List[NodeAgent]) -> None:
+        # One flap thread per partition: the threads publish
+        # CONCURRENTLY, so a worker's offered load is flap_threads
+        # outstanding requests, not one — the concurrency the cliff
+        # ladder needs to actually overrun the server's seats. A
+        # publish that fails THROUGH (the client exhausted its own
+        # retries/budget) is counted as a failure; transient weather
+        # the transport absorbed never reaches here.
+        rng = random.Random(args.seed ^ args.start ^ (tid * 0x9E37))
+        degraded: Dict[int, bool] = {}
+        n_flap = max(1, int(len(part) * args.flap_frac))
+        while not stop.wait(args.flap_tick):
+            for k in rng.sample(range(len(part)), min(n_flap, len(part))):
+                if stop.is_set():
+                    break
+                degraded[k] = not degraded.get(k, False)
+                try:
+                    part[k].publish(degraded=degraded[k])
+                except Exception:  # noqa: BLE001
+                    failed[tid] += 1
+
+    n_threads = max(1, args.flap_threads)
+    threads = [
+        threading.Thread(
+            target=flaps, args=(tid, agents[tid::n_threads]),
+            daemon=True, name=f"storm-flaps-{tid}",
+        )
+        for tid in range(n_threads)
+        if agents[tid::n_threads]
+    ]
+    for t in threads:
+        t.start()
+    sys.stdin.read()  # parent closes our stdin to stop us
+    stop.set()
+    for t in threads:
+        t.join(timeout=15)
+    publish_failures = sum(failed)
+    tally = _client_tally(metrics)
+    tally.update({
+        "writes": int(metrics.get_counter("publish_writes_total")),
+        "skipped_unchanged": int(
+            metrics.get_counter("publish_skipped_unchanged_total")
+        ),
+        "publish_failures": publish_failures,
+        "publish_retries": retried,
+    })
+    print(STATS_PREFIX + json.dumps(tally), flush=True)
+    return 0
+
+
+def _kubelet_worker_main(args) -> int:
+    """The fleet's kubelet analog as its own process: prepares
+    allocated claims (sharded by node) and PATCHes the ready annotation
+    back through the apiserver — the parent's only view of
+    pod-env-injected, as in a real cluster."""
+    metrics = Metrics()
+    claims = ResourceClient(_client(args.server, metrics), RESOURCE_CLAIMS)
+    patch_errors = [0]
+
+    def on_ready(name: str, claim: dict, env: dict) -> None:
+        ns = claim["metadata"].get("namespace")
+        for attempt in range(10):
+            try:
+                claims.patch(name, {
+                    "metadata": {"annotations": {READY_ANNOTATION: "1"}},
+                }, ns)
+                return
+            except ApiNotFound:
+                return  # churned away; nothing to stamp
+            except Exception:  # noqa: BLE001 — outage window; retry
+                patch_errors[0] += 1
+                time.sleep(0.2 * (attempt + 1))
+
+    kubelet = KubeletSim(
+        _client(args.server, metrics), metrics, sharded=True,
+        prepare_ms=args.prepare_ms, on_ready=on_ready,
+    )
+    kubelet.start()
+    if not kubelet.informer.wait_for_sync(timeout=120):
+        print(READY_PREFIX + json.dumps({"error": "sync timeout"}),
+              flush=True)
+        return 1
+    print(READY_PREFIX + json.dumps({"synced": True}), flush=True)
+    sys.stdin.read()
+    kubelet.stop()
+    tally = _client_tally(metrics)
+    tally.update({
+        "prepared": kubelet.ready_count(),
+        "patch_errors": patch_errors[0],
+    })
+    print(STATS_PREFIX + json.dumps(tally), flush=True)
+    return 0
+
+
+# --- parent-side worker handle -----------------------------------------------
+
+
+class _Worker:
+    """A protocol-speaking subprocess: argv in, #stormsim-ready /
+    #stormsim-stats JSON lines out, stopped by closing its stdin."""
+
+    def __init__(self, argv: List[str], name: str):
+        self.name = name
+        self.proc = subprocess.Popen(
+            argv, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            text=True, bufsize=1,
+        )
+        self.ready: Optional[dict] = None
+        self.stats: Optional[dict] = None
+        self._ready_evt = threading.Event()
+        self._stats_evt = threading.Event()
+        self._reader = threading.Thread(
+            target=self._read, daemon=True, name=f"{name}-reader"
+        )
+        self._reader.start()
+
+    def _read(self) -> None:
+        for line in self.proc.stdout:
+            if line.startswith(READY_PREFIX):
+                self.ready = json.loads(line[len(READY_PREFIX):])
+                self._ready_evt.set()
+            elif line.startswith(STATS_PREFIX):
+                self.stats = json.loads(line[len(STATS_PREFIX):])
+                self._stats_evt.set()
+        # EOF: a worker that died unready must not wedge the parent.
+        self._ready_evt.set()
+        self._stats_evt.set()
+
+    def wait_ready(self, timeout: float) -> dict:
+        if not self._ready_evt.wait(timeout) or self.ready is None:
+            raise RuntimeError(
+                f"storm worker {self.name} never reported ready "
+                f"(rc={self.proc.poll()})"
+            )
+        return self.ready
+
+    def stop(self, timeout: float = 30.0) -> Optional[dict]:
+        try:
+            self.proc.stdin.close()
+        except OSError:
+            pass
+        self._stats_evt.wait(timeout)
+        try:
+            self.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+        return self.stats
+
+    def kill(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+
+
+def _spawn_publishers(
+    server: str, nodes: int, workers: int, seed: int,
+    flap_tick: float, flap_frac: float, reverify: float,
+    flap_threads: int = 2,
+) -> List[_Worker]:
+    out = []
+    per = (nodes + workers - 1) // workers
+    start = 0
+    while start < nodes:
+        count = min(per, nodes - start)
+        out.append(_Worker([
+            sys.executable, "-m", "tpu_dra.tools.stormsim",
+            "--publish-worker", "--server", server,
+            "--start", str(start), "--count", str(count),
+            "--seed", str(seed), "--flap-tick", str(flap_tick),
+            "--flap-frac", str(flap_frac), "--reverify", str(reverify),
+            "--flap-threads", str(flap_threads),
+        ], name=f"publish-{start}"))
+        start += count
+    return out
+
+
+def _merge_tallies(tallies: List[Optional[dict]]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for t in tallies:
+        for k, v in (t or {}).items():
+            if isinstance(v, (int, float)):
+                out[k] = out.get(k, 0) + int(v)
+    return out
+
+
+def _apf_stats(server: str) -> dict:
+    """The server's own view over the wire: GET /_stats (flow-control
+    admission/rejection per flow, restart count)."""
+    import urllib.request
+
+    with urllib.request.urlopen(f"{server}/_stats", timeout=10) as r:
+        return json.loads(r.read())
+
+
+# --- the storm leg -----------------------------------------------------------
+
+
+def run_storm_leg(
+    nodes: int,
+    claims: int,
+    rate: float,
+    seed: int = 20260807,
+    workers: int = 4,
+    prepare_ms: float = 2.0,
+    outage_s: float = 0.75,
+    gangs: int = 2,
+    gang_size: int = 3,
+    flap_tick: float = 0.25,
+    flap_frac: float = 0.02,
+    drain_timeout_s: float = 300.0,
+    smoke: bool = False,
+) -> dict:
+    """The wire fleet + the mid-storm apiserver restart drill. Returns
+    the ``fleet_wire_*`` / ``storm_*`` report; raises on any
+    convergence violation."""
+    from tpu_dra.k8sclient.fakeserver import FakeApiServer
+
+    srv = FakeApiServer(port=0).start()
+    server = srv.server_url
+    parent_metrics = Metrics()
+    kc = _client(server, parent_metrics)
+    for cls in fleet.CLASSES:
+        ResourceClient(kc, DEVICE_CLASSES).create(
+            json.loads(json.dumps(cls))
+        )
+
+    pubs: List[_Worker] = []
+    kubelet: Optional[_Worker] = None
+    sched: Optional[subprocess.Popen] = None
+    claim_inf: Optional[Informer] = None
+    kc_dir = None
+    try:
+        t0 = time.monotonic()
+        pubs = _spawn_publishers(
+            server, nodes, workers, seed, flap_tick, flap_frac,
+            reverify=2.0,
+        )
+        for w in pubs:
+            w.wait_ready(timeout=600)
+        publish_wall = time.monotonic() - t0
+        n_slices = len(ResourceClient(kc, RESOURCE_SLICES).list())
+        if n_slices < nodes:
+            raise RuntimeError(
+                f"initial publish incomplete: {n_slices}/{nodes} slices"
+            )
+        _note(
+            f"{nodes} nodes published over the wire by {len(pubs)} "
+            f"worker processes in {publish_wall:.1f}s"
+        )
+
+        kubelet = _Worker([
+            sys.executable, "-m", "tpu_dra.tools.stormsim",
+            "--kubelet-worker", "--server", server,
+            "--prepare-ms", str(prepare_ms),
+        ], name="kubelet")
+
+        import tempfile
+
+        kc_dir = tempfile.mkdtemp(prefix="stormsim-")
+        kubeconfig = srv.write_kubeconfig(
+            os.path.join(kc_dir, "kubeconfig")
+        )
+        sched = subprocess.Popen([
+            sys.executable, "-m", "tpu_dra.scheduler.main",
+            "--kubeconfig", kubeconfig,
+            "--kube-api-qps", "5000", "--kube-api-burst", "5000",
+            "--leader-election",
+            "--leader-election-namespace", LEASE_NS,
+            "--leader-election-lease-name", LEASE_NAME,
+            "--leader-election-lease-duration", "4",
+            "--retry-unschedulable-after", "0.5",
+            "--repack", "--repack-poll-period", "1.0",
+        ])
+        leases = ResourceClient(kc, LEASES)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            lease = leases.try_get(LEASE_NAME, LEASE_NS)
+            if lease and (lease.get("spec") or {}).get("holderIdentity"):
+                break
+            if sched.poll() is not None:
+                raise RuntimeError(
+                    f"scheduler exited rc={sched.returncode} before "
+                    f"acquiring leadership"
+                )
+            time.sleep(0.1)
+        else:
+            raise RuntimeError("scheduler never acquired the Lease")
+        kubelet.wait_ready(timeout=120)
+
+        # Parent-side observation: submit times stamped at create, ready
+        # times stamped when the kubelet's annotation arrives on the
+        # claim WATCH — both ends observed through the apiserver.
+        submit_times: Dict[str, float] = {}
+        ready_times: Dict[str, float] = {}
+        obs_lock = threading.Lock()
+
+        def on_claim(event: str, claim: dict) -> None:
+            if event == "DELETED":
+                return
+            if READY_ANNOTATION not in (
+                claim["metadata"].get("annotations") or {}
+            ):
+                return
+            name = claim["metadata"]["name"]
+            with obs_lock:
+                if name in submit_times and name not in ready_times:
+                    ready_times[name] = time.monotonic()
+
+        claim_inf = Informer(_client(server), RESOURCE_CLAIMS, namespace=NS)
+        claim_inf.add_handler(on_claim)
+        claim_inf.start()
+        if not claim_inf.wait_for_sync(timeout=60):
+            raise RuntimeError("parent claim informer never synced")
+
+        trace_claims = fleet.make_trace(claims, seed)
+        # Gang claims ride the same storm so live gang WALs cross the
+        # restart: members submitted back-to-back at seeded offsets.
+        gang_members: List[List[dict]] = [
+            fleet.make_gang_claims(
+                f"storm-gang-{g}", claims + g * gang_size, gang_size,
+                "1x1x1", namespace=NS,
+            )
+            for g in range(gangs)
+        ]
+        gang_at = {
+            max(1, (g + 1) * claims // (gangs + 1)): g
+            for g in range(gangs)
+        }
+
+        restart_done = threading.Event()
+        lease_before = leases.get(LEASE_NAME, LEASE_NS)
+        restart_info: Dict[str, float] = {}
+
+        def fire_restart() -> None:
+            restart_info["t_start"] = time.monotonic()
+            srv.restart(outage_seconds=outage_s)
+            restart_info["t_up"] = time.monotonic()
+            restart_done.set()
+
+        claims_rc = ResourceClient(_client(server), RESOURCE_CLAIMS)
+
+        def submit_one(c: dict) -> None:
+            c = json.loads(json.dumps(c))
+            c["metadata"]["namespace"] = NS
+            c["metadata"].pop("uid", None)
+            with obs_lock:
+                submit_times[c["metadata"]["name"]] = time.monotonic()
+            # A create racing the restart can see its connection die
+            # AFTER the write was acknowledged server-side: the
+            # transport (correctly) refuses to auto-retry a
+            # non-idempotent verb on that ambiguity, so the submitter
+            # owns it — replay until stored, and 409 means the first
+            # attempt landed.
+            for attempt in range(12):
+                try:
+                    claims_rc.create(c)
+                    return
+                except ApiConflict:
+                    return
+                except Exception:  # noqa: BLE001 — outage window
+                    if attempt == 11:
+                        raise
+                    time.sleep(0.25 * (attempt + 1))
+
+        arr = random.Random(seed ^ 0x570)
+        t_next = time.monotonic()
+        restart_thread = None
+        for i, c in enumerate(trace_claims):
+            t_next += arr.expovariate(rate)
+            now = time.monotonic()
+            if t_next > now:
+                time.sleep(t_next - now)
+            if i == claims // 2 and outage_s >= 0:
+                # Mid-storm: the apiserver goes dark UNDER the open
+                # submission loop; creates during the window ride the
+                # transport's refused-connect retries.
+                restart_thread = threading.Thread(
+                    target=fire_restart, daemon=True, name="storm-restart"
+                )
+                restart_thread.start()
+            if i in gang_at:
+                for m in gang_members[gang_at[i]]:
+                    submit_one(m)
+            submit_one(c)
+        if restart_thread is not None:
+            restart_thread.join(timeout=outage_s + 120)
+            assert restart_done.is_set(), "apiserver restart never completed"
+
+        total = claims + gangs * gang_size
+        # The wire pace is claims-proportional (every allocation is its
+        # own GET+PUT round trips): scale the convergence deadline with
+        # the trace instead of wedging full-scale runs on a smoke bound.
+        drain_deadline = time.monotonic() + max(
+            drain_timeout_s, 120.0 + 0.6 * total
+        )
+        while True:
+            with obs_lock:
+                n_ready = len(ready_times)
+            if n_ready >= total:
+                break
+            if time.monotonic() > drain_deadline:
+                with obs_lock:
+                    missing = sorted(set(submit_times) - set(ready_times))
+                raise RuntimeError(
+                    f"storm never converged: {total - n_ready}/{total} "
+                    f"claim(s) still unready at the drain deadline "
+                    f"(first missing: {missing[:5]})"
+                )
+            if sched.poll() is not None:
+                raise RuntimeError(
+                    f"scheduler died mid-storm rc={sched.returncode}"
+                )
+            time.sleep(0.05)
+
+        # --- convergence: asserted, not eyeballed ---
+        # Readiness is NOT quiescence: gang/repack WAL finalize (drop
+        # the commit annotation) trails the allocation landing, and a
+        # post-restart gang recovery may roll a partially-allocated
+        # gang back (teardown) and re-place it AFTER the kubelet first
+        # reported the members ready.  Poll until the cluster is truly
+        # settled — full count, every claim allocated, zero WAL
+        # residue — then run the hard asserts on that settled state.
+        def _settle_scan():
+            stored = claims_rc.list(NS)
+            unalloc, residue = [], []
+            for c in stored:
+                name = c["metadata"]["name"]
+                alloc = (c.get("status") or {}).get("allocation")
+                results = (
+                    ((alloc or {}).get("devices") or {}).get("results")
+                    or []
+                )
+                if not results:
+                    unalloc.append(name)
+                anns = c["metadata"].get("annotations") or {}
+                if GANG_ANNOTATION in anns or REPACK_ANNOTATION in anns:
+                    residue.append(name)
+            return stored, unalloc, residue
+
+        settle_deadline = time.monotonic() + max(90.0, 0.1 * total)
+        while True:
+            stored, unalloc, wal_residue = _settle_scan()
+            if len(stored) == total and not unalloc and not wal_residue:
+                break
+            if time.monotonic() > settle_deadline:
+                break  # fall through to the asserts for a precise error
+            if sched.poll() is not None:
+                raise RuntimeError(
+                    f"scheduler died while settling rc={sched.returncode}"
+                )
+            time.sleep(0.25)
+        assert len(stored) == total, (
+            f"claim count diverged: {len(stored)} stored vs {total} "
+            f"submitted"
+        )
+        assert not unalloc, (
+            f"claim(s) converged without an allocation: {unalloc[:5]}"
+        )
+        assert not wal_residue, (
+            f"WAL residue survived convergence on: {wal_residue}"
+        )
+        seen_devices: Dict[tuple, str] = {}
+        for c in stored:
+            name = c["metadata"]["name"]
+            alloc = (c.get("status") or {}).get("allocation")
+            for r in (alloc.get("devices") or {}).get("results") or []:
+                pair = (r["pool"], r["device"])
+                assert pair not in seen_devices, (
+                    f"device {pair} allocated to BOTH "
+                    f"{seen_devices[pair]} and {name} — the restart "
+                    f"double-allocated"
+                )
+                seen_devices[pair] = name
+        lease_after = leases.get(LEASE_NAME, LEASE_NS)
+        spec_after = lease_after.get("spec") or {}
+        assert spec_after.get("holderIdentity"), (
+            "no leader after the restart"
+        )
+        assert (
+            spec_after.get("renewTime", "")
+            > (lease_before.get("spec") or {}).get("renewTime", "")
+        ), "the Lease was never renewed after the apiserver restart"
+
+        with obs_lock:
+            lat_ms = sorted(
+                (ready_times[n] - submit_times[n]) * 1000.0
+                for n in ready_times
+            )
+            recovery_ms = sorted(
+                (ready_times[n] - submit_times[n]) * 1000.0
+                for n in ready_times
+                if submit_times[n] >= restart_info.get("t_start", 0.0)
+            )
+        apf = _apf_stats(server)
+        flow_rejected = {
+            f: s["rejected"] for f, s in (apf.get("apf") or {}).items()
+        }
+        report = {
+            "fleet_wire_nodes": nodes,
+            "fleet_wire_claims": total,
+            "fleet_wire_workers": len(pubs) + 2,  # + kubelet + scheduler
+            "fleet_wire_publish_wall_s": round(publish_wall, 2),
+            "fleet_wire_claim_ready_p50_ms": round(_pct(lat_ms, 0.5), 2),
+            "fleet_wire_claim_ready_p99_ms": round(_pct(lat_ms, 0.99), 2),
+            "storm_recovery_p99_ms": round(_pct(recovery_ms, 0.99), 2),
+            "storm_recovery_claims": len(recovery_ms),
+            "storm_outage_s": outage_s,
+            "storm_restarts": int(apf.get("restarts", 0)),
+            "storm_flow_rejected": flow_rejected,
+            "storm_gangs": gangs,
+        }
+        return report
+    finally:
+        if claim_inf is not None:
+            claim_inf.stop()
+        if sched is not None and sched.poll() is None:
+            sched.terminate()
+            try:
+                sched.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                sched.kill()
+                sched.wait(timeout=10)
+        tallies = []
+        if kubelet is not None:
+            tallies.append(("kubelet", kubelet.stop()))
+        for w in pubs:
+            tallies.append((w.name, w.stop()))
+        srv.stop()
+        if kc_dir:
+            import shutil
+
+            shutil.rmtree(kc_dir, ignore_errors=True)
+        # Stash for the caller even on the failure path (diagnosis).
+        merged = _merge_tallies([t for _n, t in tallies])
+        _note(f"client weather (all processes): {merged}")
+        run_storm_leg.last_tallies = merged  # type: ignore[attr-defined]
+
+
+# --- the cliff ladder --------------------------------------------------------
+
+
+def _name_bottleneck(flow_stats: Dict[str, dict], tally: Dict[str, int],
+                     seats: int) -> str:
+    rejected = {f: s.get("rejected", 0) for f, s in flow_stats.items()}
+    total_rej = sum(rejected.values())
+    if total_rej:
+        top = max(rejected, key=rejected.get)
+        return (
+            f"apf fair-queue shed at {seats} seats: flow '{top}' "
+            f"rejected {rejected[top]}/{total_rej} rejections "
+            f"(flow-ordered: low-share publish traffic sheds first)"
+        )
+    if tally.get("conn_errors", 0):
+        return (
+            f"transport: {tally['conn_errors']} connection-level "
+            f"failures (accept backlog / handler thread exhaustion)"
+        )
+    return (
+        "handler saturation: publish throughput collapsed with zero "
+        "shed — the single-process apiserver's CPU (GIL) is the wall"
+    )
+
+
+def probe_cliff(
+    rungs: List[int],
+    workers: int,
+    seed: int,
+    window_s: float = 5.0,
+    seats: Optional[int] = None,
+    shed_bound: float = 0.02,
+) -> dict:
+    """Push node count rung by rung until the endpoint breaks. A rung
+    FAILS when the shed rate (429s per request) crosses ``shed_bound``,
+    connections start failing, or a worker dies; the failing rung's
+    bottleneck is named from the server's per-flow counters. ``seats``
+    pins the APF concurrency (smoke squeezes it so the cliff is
+    reachable at CI scale; the full leg runs the shipped default)."""
+    from tpu_dra.k8sclient.fakeserver import FakeApiServer
+
+    ladder = []
+    cliff_nodes = 0
+    bottleneck = ""
+    for nodes in rungs:
+        srv = FakeApiServer(port=0).start()
+        if seats is not None:
+            srv.flow.configure(concurrency=seats, max_queue_seconds=0.5)
+        kc = _client(srv.server_url)
+        for cls in fleet.CLASSES:
+            ResourceClient(kc, DEVICE_CLASSES).create(
+                json.loads(json.dumps(cls))
+            )
+        pubs = []
+        wedged = ""
+        try:
+            t0 = time.monotonic()
+            pubs = _spawn_publishers(
+                srv.server_url, nodes, workers, seed,
+                flap_tick=0.05, flap_frac=0.5, reverify=0.0,
+                flap_threads=8,
+            )
+            for w in pubs:
+                w.wait_ready(timeout=600)
+            publish_wall = time.monotonic() - t0
+            if seats is not None:
+                # Constrained mode (smoke): seats alone cannot overrun
+                # when handlers answer in a millisecond — add the
+                # handler latency a loaded apiserver actually has, so
+                # queue waits cross max_queue_seconds and the shed
+                # machinery engages at CI scale. 16 concurrent writers
+                # over 2 seats at 100ms/handler queue ~0.7s — past the
+                # 0.5s bound, so the gate sheds flow-ordered.
+                srv.inject_faults(
+                    latency=0.1, latency_seconds=window_s + 30.0,
+                )
+            time.sleep(window_s)  # the saturation window
+        except RuntimeError as e:
+            # A rung the fleet cannot even STAND UP on is the cliff,
+            # not a harness bug: record it, don't crash the ladder.
+            wedged = str(e)
+            publish_wall = time.monotonic() - t0
+        finally:
+            tallies = [w.stop() for w in pubs]
+            flow_stats = srv.flow.stats()
+            srv.stop()
+        tally = _merge_tallies(tallies)
+        requests_total = (
+            tally.get("writes", 0) + tally.get("sheds_429", 0)
+            + tally.get("conn_errors", 0)
+        )
+        shed_rate = (
+            (tally.get("sheds_429", 0) + tally.get("conn_errors", 0))
+            / requests_total if requests_total else 0.0
+        )
+        broke = (
+            bool(wedged)
+            or shed_rate > shed_bound
+            or tally.get("publish_failures", 0) > 0
+            or tally.get("retry_budget_exhausted", 0) > 0
+        )
+        rung = {
+            "nodes": nodes,
+            "publish_wall_s": round(publish_wall, 2),
+            "writes": tally.get("writes", 0),
+            "sheds_429": tally.get("sheds_429", 0),
+            "conn_errors": tally.get("conn_errors", 0),
+            "shed_rate": round(shed_rate, 4),
+            "broke": broke,
+        }
+        ladder.append(rung)
+        _note(f"cliff rung: {rung}")
+        if broke:
+            cliff_nodes = nodes
+            if wedged:
+                bottleneck = f"initial publish wedged: {wedged}"
+            else:
+                bottleneck = _name_bottleneck(
+                    flow_stats, tally,
+                    seats if seats is not None else 64,
+                )
+            break
+    if not cliff_nodes and ladder:
+        # The ladder never broke: record the last rung as the measured
+        # frontier, named honestly as such — a silent cap would read as
+        # "covered everything".
+        cliff_nodes = ladder[-1]["nodes"]
+        bottleneck = (
+            f"no break up to {cliff_nodes} nodes at this window — "
+            f"frontier, not cliff (raise STORMSIM_CLIFF_RUNGS)"
+        )
+    return {
+        "fleet_wire_cliff_nodes": cliff_nodes,
+        "fleet_wire_cliff_bottleneck": bottleneck,
+        "fleet_wire_cliff_ladder": ladder,
+    }
+
+
+# --- in-process reference (the wire delta's denominator) ---------------------
+
+
+def run_inproc_reference(
+    nodes: int, claims: int, rate: float, seed: int, prepare_ms: float,
+    flap_tick: float, flap_frac: float,
+) -> dict:
+    """The IDENTICAL trace through the in-process fleetsim stack (one
+    interpreter, no HTTP): the denominator of
+    ``fleet_wire_vs_inproc_p99_pct``."""
+    from tpu_dra.tools.fleetsim import _ModeRun
+
+    mode = _ModeRun(
+        nodes, claims, rate, seed, optimized=True,
+        storm_tick=flap_tick, storm_frac=flap_frac,
+        prepare_ms=prepare_ms, churn=0.0, sample_scoped=0,
+    )
+    mode.start()
+    try:
+        res = mode.run_trace()
+    finally:
+        mode.stop()
+    if res["unready"]:
+        raise RuntimeError(
+            f"in-process reference wedged: {res['unready']} unready"
+        )
+    return res
+
+
+# --- entrypoint --------------------------------------------------------------
+
+
+def run(
+    nodes: int, claims: int, rate: float, seed: int, workers: int,
+    prepare_ms: float, outage_s: float, cliff_rungs: List[int],
+    cliff_window_s: float, cliff_seats: Optional[int],
+    smoke: bool = False,
+) -> dict:
+    flap_tick, flap_frac = 0.25, 0.02
+    wire = run_storm_leg(
+        nodes, claims, rate, seed=seed, workers=workers,
+        prepare_ms=prepare_ms, outage_s=outage_s,
+        flap_tick=flap_tick, flap_frac=flap_frac, smoke=smoke,
+    )
+    tallies = getattr(run_storm_leg, "last_tallies", {})
+    _note(
+        f"wire: claim-ready p50 {wire['fleet_wire_claim_ready_p50_ms']} "
+        f"ms p99 {wire['fleet_wire_claim_ready_p99_ms']} ms; restart "
+        f"recovery p99 {wire['storm_recovery_p99_ms']} ms over "
+        f"{wire['storm_recovery_claims']} claims"
+    )
+    inproc = run_inproc_reference(
+        nodes, claims, rate, seed, prepare_ms, flap_tick, flap_frac,
+    )
+    delta_pct = (
+        (wire["fleet_wire_claim_ready_p99_ms"]
+         / inproc["claim_ready_p99_ms"] - 1.0) * 100.0
+        if inproc["claim_ready_p99_ms"] > 0 else 0.0
+    )
+    _note(
+        f"in-process reference p99 {inproc['claim_ready_p99_ms']} ms -> "
+        f"wire delta {delta_pct:+.1f}%"
+    )
+    cliff = probe_cliff(
+        cliff_rungs, workers, seed, window_s=cliff_window_s,
+        seats=cliff_seats,
+    )
+    _note(
+        f"cliff: {cliff['fleet_wire_cliff_nodes']} nodes — "
+        f"{cliff['fleet_wire_cliff_bottleneck']}"
+    )
+    report = dict(wire)
+    report.update(cliff)
+    report.update({
+        "fleet_wire_inproc_p99_ms": inproc["claim_ready_p99_ms"],
+        "fleet_wire_vs_inproc_p99_pct": round(delta_pct, 1),
+        "storm_client_weather": tallies,
+    })
+    if smoke:
+        # The stormbench contract, hard-asserted at CI scale.
+        assert report["storm_restarts"] >= 1, "the restart never fired"
+        assert report["fleet_wire_claim_ready_p99_ms"] > 0
+        assert report["storm_recovery_p99_ms"] > 0, (
+            "no claim latencies recorded in the recovery window"
+        )
+        assert report["fleet_wire_cliff_nodes"] > 0
+        assert report["fleet_wire_cliff_bottleneck"]
+        _note(
+            "stormbench contract: wire fleet converged through the "
+            "mid-storm apiserver restart (one allocation per claim, "
+            "disjoint devices, zero WAL residue, leader renewed), "
+            "recovery + cliff recorded — all hold"
+        )
+    return report
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("stormsim", description=__doc__)
+    p.add_argument("--smoke", action="store_true",
+                   help="CI scale + hard contract asserts "
+                   "(`make stormbench`)")
+    p.add_argument("--publish-worker", action="store_true",
+                   help="internal: publisher shard subprocess")
+    p.add_argument("--kubelet-worker", action="store_true",
+                   help="internal: kubelet analog subprocess")
+    p.add_argument("--server", default="")
+    p.add_argument("--start", type=int, default=0)
+    p.add_argument("--count", type=int, default=0)
+    p.add_argument("--seed", type=int, default=20260807)
+    p.add_argument("--flap-tick", type=float, default=0.25)
+    p.add_argument("--flap-frac", type=float, default=0.02)
+    p.add_argument("--flap-threads", type=int, default=2)
+    p.add_argument("--reverify", type=float, default=0.0)
+    p.add_argument("--prepare-ms", type=float, default=2.0)
+    args = p.parse_args(argv)
+    if args.publish_worker:
+        return _publish_worker_main(args)
+    if args.kubelet_worker:
+        return _kubelet_worker_main(args)
+    env = os.environ.get
+    if args.smoke:
+        nodes = int(env("STORMSIM_NODES", "64"))
+        claims = int(env("STORMSIM_CLAIMS", "72"))
+        rate = float(env("STORMSIM_RATE", "150"))
+        workers = int(env("STORMSIM_WORKERS", "4"))
+        # Smoke cliff: APF seats squeezed so the shed cliff is
+        # reachable at CI scale — the point is exercising the
+        # detection + naming machinery, not sizing a laptop.
+        default_rungs, default_window, default_seats = "48,96,192", 2.0, 2
+    else:
+        nodes = int(env("STORMSIM_NODES", "5000"))
+        claims = int(env("STORMSIM_CLAIMS", "1500"))
+        rate = float(env("STORMSIM_RATE", "250"))
+        workers = int(env("STORMSIM_WORKERS", "8"))
+        default_rungs, default_window, default_seats = (
+            "5000,7500,10000,15000", 10.0, None,
+        )
+    rungs = [
+        int(x) for x in env("STORMSIM_CLIFF_RUNGS", default_rungs).split(",")
+        if x.strip()
+    ]
+    seats_env = env("STORMSIM_CLIFF_SEATS", "")
+    seats = int(seats_env) if seats_env else default_seats
+    report = run(
+        nodes, claims, rate,
+        seed=int(env("STORMSIM_SEED", "20260807")),
+        workers=workers,
+        prepare_ms=float(env("STORMSIM_PREPARE_MS", "2.0")),
+        outage_s=float(env("STORMSIM_OUTAGE", "0.75")),
+        cliff_rungs=rungs,
+        cliff_window_s=float(env("STORMSIM_CLIFF_WINDOW",
+                                 str(default_window))),
+        cliff_seats=seats,
+        smoke=args.smoke,
+    )
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
